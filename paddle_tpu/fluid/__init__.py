@@ -1,0 +1,68 @@
+"""paddle_tpu.fluid — the Fluid-compatible, TPU-native front end.
+
+A user of the reference (junjun315/Paddle, Fluid ~1.5) finds the same
+programming model here: build a Program with `fluid.layers.*`, run it with
+`fluid.Executor(place)`; but the backend is whole-program XLA compilation on
+TPU instead of per-op CUDA kernel dispatch.
+"""
+
+# ops must register before any program is lowered
+import paddle_tpu.ops  # noqa: F401
+
+from . import framework
+from .framework import (  # noqa: F401
+    Program, Variable, Operator, program_guard, name_scope,
+    default_main_program, default_startup_program, unique_name,
+    CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+    cpu_places, cuda_places, tpu_places, in_dygraph_mode,
+)
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .backward import append_backward, gradients  # noqa: F401
+from . import initializer  # noqa: F401
+from .initializer import force_init_on_cpu, init_on_cpu  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from . import layers  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from .layer_helper import LayerHelper  # noqa: F401
+from . import compiler  # noqa: F401
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
+from .layers.io import data  # noqa: F401
+
+__all__ = [
+    "framework", "layers", "optimizer", "initializer", "regularizer", "clip",
+    "Program", "Variable", "Operator", "program_guard", "Executor", "Scope",
+    "global_scope", "scope_guard", "append_backward", "gradients",
+    "CPUPlace", "TPUPlace", "CUDAPlace", "ParamAttr", "data",
+    "default_main_program", "default_startup_program", "unique_name",
+]
+
+
+# `fluid.core` parity shim: a handful of symbols scripts poke at.
+class _CoreShim:
+    @staticmethod
+    def get_tpu_device_count():
+        import jax
+
+        return jax.device_count()
+
+    get_cuda_device_count = get_tpu_device_count
+
+    @staticmethod
+    def is_compiled_with_cuda():
+        return False
+
+    @staticmethod
+    def is_compiled_with_tpu():
+        return True
+
+    CPUPlace = CPUPlace
+    CUDAPlace = CUDAPlace
+    TPUPlace = TPUPlace
+
+    class Scope(Scope):
+        pass
+
+
+core = _CoreShim()

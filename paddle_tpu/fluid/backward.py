@@ -1,0 +1,251 @@
+"""append_backward: symbolic reverse-mode autodiff over the op graph.
+
+Reference: python/paddle/fluid/backward.py:558 (append_backward) — reverse
+walk over ops, per-op grad descs from C++ GradOpDescMakers
+(core.get_grad_op_desc, backward.py:431), sum-op insertion for fan-out grad
+accumulation, no_grad_set pruning.
+
+TPU-native design: grad ops are still real program nodes (so the data-parallel
+transpiler can insert c_allreduce after each param grad, AMP can recast them,
+and users can inspect the backward graph), but most grad *lowerings* are
+derived mechanically from the forward lowering with jax.vjp
+(fluid/registry.py) — XLA's CSE eliminates the re-traced forward, so the
+compiled HLO is as tight as hand-written grads.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from . import framework, registry
+from .framework import Variable, grad_var_name
+
+__all__ = ["append_backward", "gradients", "_find_op_path"]
+
+
+def _requires_grad_vars(block, no_grad_set):
+    """Forward sweep: which var names carry gradient?"""
+    live = set()
+    for name, v in block.vars.items():
+        if not v.stop_gradient and name not in no_grad_set and _is_float(v.dtype):
+            live.add(name)
+    for op in block.ops:
+        info = registry.get_op(op.type) if registry.has_op(op.type) else None
+        if info is not None and info.grad is None and info.grad_maker is None:
+            continue  # non-differentiable op: doesn't propagate grad
+        if any(n in live for n in op.input_arg_names):
+            for n in op.output_arg_names:
+                v = block._find_var_recursive(n)
+                if n in no_grad_set:
+                    continue
+                if v is not None and _is_float(v.dtype):
+                    live.add(n)
+    return live
+
+
+def _is_float(dtype):
+    return dtype in ("float16", "bfloat16", "float32", "float64")
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Append grad ops for `loss` into its program; returns
+    [(param, param_grad_var)] like the reference (backward.py:558)."""
+    program = loss.block.program
+    block = program.global_block()
+    no_grad_set = set(no_grad_set or ())
+    no_grad_set = {v.name if isinstance(v, Variable) else v for v in no_grad_set}
+
+    loss_pos = None
+    for i, op in enumerate(block.ops):
+        if loss.name in op.output_arg_names:
+            loss_pos = i
+    if loss_pos is None:
+        raise ValueError(f"loss var {loss.name} is not produced by any op")
+
+    live = _requires_grad_vars(block, no_grad_set)
+    if loss.name not in live:
+        raise ValueError("loss does not depend on any trainable variable")
+
+    uniq_counter = collections.defaultdict(int)
+
+    def uniq(var_name):
+        c = uniq_counter[var_name]
+        uniq_counter[var_name] += 1
+        g = grad_var_name(var_name) if c == 0 else f"{grad_var_name(var_name)}@RENAME@{c}"
+        return g
+
+    def make_grad_var(name, like_name):
+        src = block._find_var_recursive(like_name)
+        if not block.has_var(name):
+            block.create_var(name=name, shape=src.shape if src is not None else None,
+                             dtype=src.dtype if src is not None else "float32",
+                             stop_gradient=True)
+        return name
+
+    # seed: d loss / d loss = 1
+    loss_grad = grad_var_name(loss.name)
+    make_grad_var(loss_grad, loss.name)
+    block.append_op(
+        "fill_constant", outputs={"Out": [loss_grad]},
+        attrs={"shape": list(loss.shape if loss.shape is not None else [1]),
+               "dtype": loss.dtype, "value": 1.0, "op_role": "backward"})
+
+    # partials[var] = list of grad var names to be accumulated
+    partials: dict[str, list] = collections.defaultdict(list)
+    partials[loss.name].append(loss_grad)
+    finalized: dict[str, str] = {}
+
+    def finalize_grad(var_name):
+        """Collapse partials into one accumulated grad var name (sum op if
+        fan-out>1 — reference inserts sum_op the same way)."""
+        if var_name in finalized:
+            return finalized[var_name]
+        parts = partials.get(var_name)
+        if not parts:
+            return None
+        if len(parts) == 1:
+            g = parts[0]
+        else:
+            g = grad_var_name(var_name)
+            if g in parts:
+                acc = f"{g}@ACC"
+                make_grad_var(acc, var_name)
+                block.append_op("sum", inputs={"X": list(parts)}, outputs={"Out": [acc]},
+                                attrs={"op_role": "backward"})
+                g = acc
+            else:
+                make_grad_var(g, var_name)
+                block.append_op("sum", inputs={"X": list(parts)}, outputs={"Out": [g]},
+                                attrs={"op_role": "backward"})
+        finalized[var_name] = g
+        return g
+
+    for op in reversed(block.ops[: loss_pos + 1]):
+        if not registry.has_op(op.type):
+            continue
+        info = registry.get_op(op.type)
+        if info.grad is None and info.grad_maker is None:
+            continue
+        out_grads = {}
+        for n in op.output_arg_names:
+            g = finalize_grad(n)
+            if g is not None:
+                out_grads[n] = g
+        if not out_grads:
+            continue
+        wanted = {n for n in op.input_arg_names if n in live and n not in no_grad_set}
+        # in-place outputs (e.g. batch_norm MeanOut) shadow their input slot;
+        # don't differentiate wrt them
+        if not wanted:
+            continue
+
+        if info.grad_maker is not None:
+            descs, pairs = info.grad_maker(op, out_grads, wanted, uniq)
+        else:
+            descs, pairs = _default_grad_descs(op, info, out_grads, wanted, uniq)
+        for (gtype, gins, gouts, gattrs) in descs:
+            gattrs = dict(gattrs)
+            gattrs["op_role"] = "backward"
+            for slot, names in gouts.items():
+                for n in names:
+                    base = n.split("@GRAD")[0]
+                    make_grad_var(n, base)
+            block.append_op(gtype, inputs=gins, outputs=gouts, attrs=gattrs)
+        for var_name, g in pairs:
+            partials[var_name].append(g)
+
+    # gather (param, grad) pairs
+    if parameter_list is not None:
+        params = [block.var(p) if isinstance(p, str) else p for p in parameter_list]
+    else:
+        params = [p for p in block.all_parameters() if p.trainable]
+    result = []
+    for p in params:
+        g = finalize_grad(p.name)
+        if g is None:
+            continue
+        gv = block.var(g)
+        if gv.shape is None:
+            gv.shape = p.shape
+        result.append((p, gv))
+    program._bump_version()
+    return result
+
+
+def _default_grad_descs(op, info, out_grads, wanted, uniq):
+    """Build the generic `<type>_grad` desc consumed by the auto-vjp lowering
+    registered in registry._register_auto_grad."""
+    pre_descs = []
+    gins = {}
+    for slot in info.input_slots:
+        cslot = slot.rstrip("*")
+        if cslot in op.inputs:
+            gins[cslot] = list(op.inputs[cslot])
+    for slot in info.output_slots:
+        cslot = slot.rstrip("*")
+        names = op.outputs.get(cslot, [])
+        if not names:
+            continue
+        if info.is_variadic(slot):
+            # positional correspondence: every output needs a grad entry;
+            # outputs with no incoming grad get an explicit zero tensor
+            # (same as the reference's fill_zeros_like insertion)
+            if not any(n in out_grads for n in names):
+                continue
+            gnames = []
+            for n in names:
+                if n in out_grads:
+                    gnames.append(out_grads[n])
+                else:
+                    z = grad_var_name(n) + "@ZERO"
+                    pre_descs.append(("fill_zeros_like", {"X": [n]}, {"Out": [z]}, {}))
+                    gnames.append(z)
+            gins[cslot + "@GRAD"] = gnames
+        elif names[0] in out_grads:
+            gins[cslot + "@GRAD"] = [out_grads[names[0]]]
+    gouts = {}
+    pairs = []
+    for slot in info.input_slots:
+        cslot = slot.rstrip("*")
+        if cslot in info.no_grad_inputs:
+            continue
+        names = op.inputs.get(cslot, [])
+        if not names:
+            continue
+        if info.is_variadic(slot):
+            # variadic slot: positional correspondence matters — emit a grad
+            # name for every element when any is wanted (XLA DCEs the rest)
+            if not any(n in wanted for n in names):
+                continue
+            out_names = []
+            for n in names:
+                g = uniq(n)
+                out_names.append(g)
+                if n in wanted:
+                    pairs.append((n, g))
+            gouts[cslot + "@GRAD"] = out_names
+        else:
+            n = names[0]
+            if n not in wanted:
+                continue
+            g = uniq(n)
+            gouts[cslot + "@GRAD"] = [g]
+            pairs.append((n, g))
+    return pre_descs + [(info.type + "_grad", gins, gouts, dict(op.attrs))], pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """fluid.gradients parity: grads of targets wrt inputs."""
+    t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    pairs = append_backward(t, parameter_list=None, no_grad_set=no_grad_set)
+    gmap = {p.name: g for p, g in pairs}
+    block = t.block.program.global_block()
+    outs = []
+    for iv in (inputs if isinstance(inputs, (list, tuple)) else [inputs]):
+        name = iv.name if isinstance(iv, Variable) else iv
+        g = gmap.get(name)
+        if g is None and block.has_var(grad_var_name(name)):
+            g = block.var(grad_var_name(name))
+        outs.append(g)
+    return outs

@@ -1,0 +1,109 @@
+"""CompiledProgram (reference python/paddle/fluid/compiler.py:48) — the
+data-parallel / strategy-configured execution wrapper.
+
+Reference behavior: `with_data_parallel` builds a ParallelExecutor over an
+op-handle SSA graph with per-grad NCCL allreduce (multi_devices_graph_pass).
+TPU-native redesign: the program is compiled ONCE under shard_map over a
+jax.sharding.Mesh — feed is batch-sharded across the mesh's data axis, the
+loss gradient seed is scaled by 1/ndev and grads are all-reduced by
+`c_allreduce_sum` ops that the data-parallel transpiler
+(paddle_tpu.parallel.transpile_data_parallel) inserts after the backward
+graph, lowered to lax.psum over ICI.  Full milestone lands with
+paddle_tpu/parallel/data_parallel.py; here we keep the API surface +
+single-device fallthrough.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import framework
+
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+
+
+class BuildStrategy:
+    """Knob parity with details/build_strategy.h:37.  Most fusion/memory knobs
+    are no-ops here: XLA performs those optimizations unconditionally."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_all_optimizer_ops = False
+        self.fuse_broadcast_ops = False
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 0
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.sync_batch_norm = False
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._is_data_parallel = False
+        self._loss_name = None
+        self._places = None
+        self._share_vars_from = None
+        self._exec_strategy = None
+        self._dp_runner = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy
+        self._share_vars_from = share_vars_from
+        self._places = places
+        return self
+
+    def with_inference_optimize(self, config):
+        return self
+
+    # executor entry point
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        if not self._is_data_parallel:
+            return executor.run(self._program, feed=feed, fetch_list=fetch_list,
+                                scope=scope, return_numpy=return_numpy)
+        import jax
+
+        if jax.device_count() < 2:
+            # one device: data parallel degenerates to the plain path (same
+            # as a 1-GPU ParallelExecutor in the reference)
+            return executor.run(self._program, feed=feed, fetch_list=fetch_list,
+                                scope=scope, return_numpy=return_numpy)
+        from paddle_tpu.parallel import data_parallel
+
+        if self._dp_runner is None:
+            self._dp_runner = data_parallel.DataParallelRunner(
+                self._program, self._loss_name, self._build_strategy,
+                places=self._places)
+        return self._dp_runner.run(executor, feed, fetch_list, scope,
+                                   return_numpy)

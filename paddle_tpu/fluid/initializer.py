@@ -1,0 +1,167 @@
+"""Initializers append init ops to the startup program.
+
+Reference: python/paddle/fluid/initializer.py (ConstantInitializer,
+UniformInitializer, NormalInitializer, XavierInitializer, MSRAInitializer,
+NumpyArrayInitializer...).  Same design: an initializer is a callable that
+appends a fill op for `var` into `block` (normally the startup program's
+global block); the startup run executes them on-device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "Initializer", "Constant", "Uniform", "Normal", "TruncatedNormal",
+    "Xavier", "MSRA", "Bilinear", "NumpyArrayInitializer",
+    "ConstantInitializer", "UniformInitializer", "NormalInitializer",
+    "XavierInitializer", "MSRAInitializer", "NumpyArrayInitializer",
+    "force_init_on_cpu", "init_on_cpu",
+]
+
+
+def force_init_on_cpu():
+    return False
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    yield
+
+
+class Initializer:
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0, force_cpu=False):
+        self.value = value
+
+    def __call__(self, var, block):
+        return block.append_op(
+            "fill_constant", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype, "value": float(self.value)})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            "uniform_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "min": float(self.low), "max": float(self.high), "seed": self.seed})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            "gaussian_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": float(self.loc), "std": float(self.scale), "seed": self.seed})
+
+
+class TruncatedNormalInitializer(NormalInitializer):
+    def __call__(self, var, block):
+        return block.append_op(
+            "truncated_gaussian_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": float(self.loc), "std": float(self.scale), "seed": self.seed})
+
+
+def _fans(var):
+    """Reference initializer.py _compute_fans: fc weight is [in, out]; conv
+    kernel is [out_c, in_c, *receptive] so fan_in = in_c * receptive."""
+    shape = var.shape
+    if len(shape) < 2:
+        return (shape[0] if shape else 1), (shape[0] if shape else 1)
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierInitializer(Initializer):
+    """Glorot (reference initializer.py XavierInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = uniform, fan_in, fan_out, seed
+
+    def __call__(self, var, block):
+        fi, fo = _fans(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = float(np.sqrt(6.0 / (fi + fo)))
+            return UniformInitializer(-limit, limit, self.seed)(var, block)
+        std = float(np.sqrt(2.0 / (fi + fo)))
+        return NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    """Kaiming/He (reference initializer.py MSRAInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block):
+        fi, _ = _fans(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = float(np.sqrt(6.0 / fi))
+            return UniformInitializer(-limit, limit, self.seed)(var, block)
+        return NormalInitializer(0.0, float(np.sqrt(2.0 / fi)), self.seed)(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        v = self.value
+        flat = v.reshape(-1)
+        if v.dtype in (np.float32, np.float64, np.float16):
+            attr = {"fp32_values": [float(x) for x in flat]}
+        elif v.dtype == np.int64:
+            attr = {"int64_values": [int(x) for x in flat]}
+        else:
+            attr = {"int32_values": [int(x) for x in flat]}
+        return block.append_op(
+            "assign_value", outputs={"Out": var},
+            attrs={"shape": list(v.shape), "dtype": var.dtype, **attr})
+
+
+class BilinearInitializer(Initializer):
+    def __call__(self, var, block):
+        shape = var.shape
+        f = np.zeros(shape, dtype="float32")
+        k = shape[-1]
+        factor = (k + 1) // 2
+        center = factor - 1 if k % 2 == 1 else factor - 0.5
+        og = np.ogrid[:k, :k]
+        filt = (1 - abs(og[0] - center) / factor) * (1 - abs(og[1] - center) / factor)
+        f[range(min(shape[0], shape[1])), range(min(shape[0], shape[1]))] = filt
+        return NumpyArrayInitializer(f)(var, block)
+
+
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer
+
+_global_weight_initializer = None
+
+
+def _global_initializer():
+    return _global_weight_initializer

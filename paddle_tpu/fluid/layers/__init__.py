@@ -1,0 +1,20 @@
+"""fluid.layers namespace (reference python/paddle/fluid/layers/__init__.py)."""
+
+from . import nn
+from . import tensor
+from . import io
+from .nn import *  # noqa: F401,F403
+from .tensor import (  # noqa: F401
+    create_tensor, create_parameter, create_global_var, fill_constant,
+    fill_constant_batch_size_like, sums, assign, zeros, ones, zeros_like,
+    ones_like, linspace, diag, eye, isfinite, has_inf, has_nan,
+)
+from .tensor import range as range  # noqa: F401  (shadows builtin, like the reference)
+from .io import data  # noqa: F401
+
+# control flow / sequence ops land in later milestones; importing their
+# modules is deferred so the core path stays light.
+
+
+def mean_(*a, **k):
+    return nn.mean(*a, **k)

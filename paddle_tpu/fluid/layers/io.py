@@ -1,0 +1,26 @@
+"""Data-input layers (reference python/paddle/fluid/layers/io.py)."""
+
+from __future__ import annotations
+
+from .. import framework
+from ..framework import Variable
+
+__all__ = ["data"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=None, stop_gradient=True):
+    """Declare a feed slot (reference layers/io.py data).
+
+    append_batch_size=True prepends a -1 batch dim, matching the reference.
+    The concrete shape binds at executor trace time from the fed array; each
+    distinct shape signature compiles once (bucketing is the dynamic-shape
+    strategy on XLA).
+    """
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = framework.default_main_program().current_block()
+    return block.create_var(name=name, shape=shape, dtype=dtype,
+                            lod_level=lod_level, stop_gradient=stop_gradient,
+                            is_data=True)

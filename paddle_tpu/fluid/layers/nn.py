@@ -1,0 +1,958 @@
+"""Neural-network layer functions (reference python/paddle/fluid/layers/nn.py,
+175 functions in __all__).  Each builds ops into the default main program via
+LayerHelper; nothing touches a device until the executor lowers the block.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..initializer import Constant, Normal, Xavier
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose", "pool2d",
+    "batch_norm", "layer_norm", "group_norm", "instance_norm", "dropout",
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "square_error_cost", "accuracy",
+    "matmul", "mul", "scale", "relu", "leaky_relu", "prelu", "elu", "relu6",
+    "gelu", "swish", "hard_sigmoid", "hard_swish", "elementwise_add",
+    "elementwise_sub", "elementwise_mul", "elementwise_div", "elementwise_max",
+    "elementwise_min", "elementwise_pow", "elementwise_mod",
+    "elementwise_floordiv", "clip", "clip_by_norm", "l2_normalize",
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_all", "reduce_any", "topk", "one_hot", "reshape", "transpose",
+    "flatten", "squeeze", "unsqueeze", "concat", "split", "stack", "unstack",
+    "expand", "expand_as", "slice", "strided_slice", "gather", "gather_nd",
+    "scatter", "pad", "pad2d", "label_smooth", "mean", "pow", "lrn",
+    "image_resize", "resize_bilinear", "resize_nearest", "dice_loss",
+    "log_loss", "huber_loss", "smooth_l1", "cos_sim", "dropout",
+    "cumsum", "argmax", "argmin", "argsort", "where", "index_select",
+    "shape", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "cast", "brelu", "soft_relu", "uniform_random",
+    "gaussian_random", "sampling_id", "unfold", "group_norm",
+]
+
+
+def _single_out_layer(helper, op_type, inputs, attrs=None, dtype=None, out=None):
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            dtype=dtype or next(iter(inputs.values()))[0].dtype)
+    helper.append_op(op_type, inputs=inputs, outputs={_OUT_SLOT.get(op_type, "Out"): [out]},
+                     attrs=attrs or {})
+    return out
+
+
+_OUT_SLOT = {"cross_entropy": "Y", "stack": "Y", "mul": "Out"}
+
+
+# ---------------------------------------------------------------------------
+# core layers
+# ---------------------------------------------------------------------------
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Fully-connected (reference layers/nn.py fc): mul + elementwise_add +
+    activation.  Lowers to one MXU matmul fused with bias/act by XLA."""
+    helper = LayerHelper("fc", input=input, size=size, bias_attr=bias_attr,
+                         act=act, name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    param_attrs = ParamAttr._to_attr(param_attr)
+    if not isinstance(param_attrs, list):
+        param_attrs = [param_attrs] * len(inputs)
+    mul_results = []
+    for inp, pa in zip(inputs, param_attrs):
+        in_shape = inp.shape
+        w_shape = [int(np.prod(in_shape[num_flatten_dims:])), size]
+        w = helper.create_parameter(pa, shape=w_shape, dtype=inp.dtype)
+        out = helper.create_variable_for_type_inference(dtype=inp.dtype)
+        helper.append_op("mul", inputs={"X": [inp], "Y": [w]}, outputs={"Out": [out]},
+                         attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1})
+        mul_results.append(out)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype=inputs[0].dtype)
+        helper.append_op("sum", inputs={"X": mul_results}, outputs={"Out": [pre_bias]})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """reference layers/nn.py embedding → lookup_table op.  is_sparse is
+    accepted for parity; on TPU the dense scatter-add gradient is already the
+    fast path (no SelectedRows needed)."""
+    helper = LayerHelper("embedding", **locals())
+    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    pad = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op("lookup_table", inputs={"W": [w], "Ids": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"padding_idx": pad, "is_sparse": is_sparse})
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    helper = LayerHelper("conv2d", input=input, size=num_filters,
+                         bias_attr=bias_attr, act=act, name=name)
+    chans = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+    stride = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+    padding = padding if isinstance(padding, (list, tuple)) else [padding] * 2
+    dilation = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 2
+    w_shape = [num_filters, chans // groups] + list(fs)
+    fan_in = (chans // groups) * fs[0] * fs[1]
+    default_init = Normal(0.0, float((2.0 / fan_in) ** 0.5))
+    w = helper.create_parameter(param_attr, shape=w_shape, dtype=input.dtype,
+                                default_initializer=default_init)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("conv2d", inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": list(stride), "paddings": list(padding),
+                            "dilations": list(dilation), "groups": groups,
+                            "data_format": data_format})
+    pre_act = _conv_bias(helper, out, bias_attr, num_filters, input.dtype)
+    return helper.append_activation(pre_act)
+
+
+def _conv_bias(helper, conv_out, bias_attr, num_filters, dtype):
+    if bias_attr is False:
+        return conv_out
+    b = helper.create_parameter(ParamAttr._to_attr(bias_attr), shape=[num_filters],
+                                dtype=dtype, is_bias=True)
+    if b is None:
+        return conv_out
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op("elementwise_add", inputs={"X": [conv_out], "Y": [b]},
+                     outputs={"Out": [out]}, attrs={"axis": 1})
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None, **kw):
+    helper = LayerHelper("conv3d", input=input, size=num_filters,
+                         bias_attr=bias_attr, act=act, name=name)
+    chans = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 3
+    stride = stride if isinstance(stride, (list, tuple)) else [stride] * 3
+    padding = padding if isinstance(padding, (list, tuple)) else [padding] * 3
+    dilation = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 3
+    w = helper.create_parameter(param_attr, shape=[num_filters, chans // groups] + list(fs),
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("conv3d", inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": list(stride), "paddings": list(padding),
+                            "dilations": list(dilation), "groups": groups})
+    pre = _conv_bias(helper, out, bias_attr, num_filters, input.dtype)
+    return helper.append_activation(pre)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, act=None, name=None, **kw):
+    helper = LayerHelper("conv2d_transpose", input=input, size=num_filters,
+                         bias_attr=bias_attr, act=act, name=name)
+    chans = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+    stride = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+    padding = padding if isinstance(padding, (list, tuple)) else [padding] * 2
+    dilation = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 2
+    w = helper.create_parameter(param_attr, shape=[chans, num_filters // groups] + list(fs),
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("conv2d_transpose", inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": list(stride), "paddings": list(padding),
+                            "dilations": list(dilation), "groups": groups})
+    pre = _conv_bias(helper, out, bias_attr, num_filters, input.dtype)
+    return helper.append_activation(pre)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, use_cudnn=True, ceil_mode=False, name=None,
+           exclusive=True, adaptive=False, data_format="NCHW"):
+    helper = LayerHelper("pool2d", name=name)
+    ps = pool_size if isinstance(pool_size, (list, tuple)) else [pool_size] * 2
+    st = pool_stride if isinstance(pool_stride, (list, tuple)) else [pool_stride] * 2
+    pd = pool_padding if isinstance(pool_padding, (list, tuple)) else [pool_padding] * 2
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": list(ps),
+                            "strides": list(st), "paddings": list(pd),
+                            "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+                            "exclusive": exclusive, "adaptive": adaptive})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
+    return pool2d(input, pool_size=pool_size, pool_type=pool_type, adaptive=True,
+                  name=name)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=False,
+               use_global_stats=False):
+    helper = LayerHelper("batch_norm", act=act, name=name)
+    dtype = input.dtype
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(param_attr, shape=[c], dtype=dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(bias_attr, shape=[c], dtype=dtype, is_bias=True)
+    mean = helper.create_or_get_global_variable(
+        moving_mean_name or f"{helper.name}.mean", shape=[c], dtype=dtype,
+        persistable=True, stop_gradient=True)
+    var = helper.create_or_get_global_variable(
+        moving_variance_name or f"{helper.name}.var", shape=[c], dtype=dtype,
+        persistable=True, stop_gradient=True)
+    helper.set_variable_initializer(mean, Constant(0.0))
+    helper.set_variable_initializer(var, Constant(1.0))
+    saved_mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [var]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [var],
+                 "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_layout": data_layout, "use_global_stats": use_global_stats})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("layer_norm", act=act, name=name)
+    dtype = input.dtype
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(param_attr, shape=norm_shape, dtype=dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(bias_attr, shape=norm_shape, dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(dtype)
+    mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("layer_norm", inputs=inputs,
+                     outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+                     attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", act=act, name=name)
+    c = input.shape[1]
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        inputs["Scale"] = [helper.create_parameter(param_attr, shape=[c], dtype=input.dtype,
+                                                   default_initializer=Constant(1.0))]
+    if bias_attr is not False:
+        inputs["Bias"] = [helper.create_parameter(bias_attr, shape=[c], dtype=input.dtype,
+                                                  is_bias=True)]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mean = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op("group_norm", inputs=inputs,
+                     outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+                     attrs={"epsilon": epsilon, "groups": groups})
+    return helper.append_activation(out)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None, name=None):
+    helper = LayerHelper("instance_norm", name=name)
+    c = input.shape[1]
+    scale = helper.create_parameter(param_attr, shape=[c], dtype=input.dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(bias_attr, shape=[c], dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    sm = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    sv = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op("instance_norm",
+                     inputs={"X": [input], "Scale": [scale], "Bias": [bias]},
+                     outputs={"Y": [out], "SavedMean": [sm], "SavedVariance": [sv]},
+                     attrs={"epsilon": epsilon})
+    return out
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("dropout", inputs={"X": [x]},
+                     outputs={"Out": [out], "Mask": [mask]},
+                     attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                            "seed": seed if seed is not None else 0,
+                            "dropout_implementation": dropout_implementation})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# losses / classification
+# ---------------------------------------------------------------------------
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    helper = LayerHelper("softmax", name=name)
+    return _single_out_layer(helper, "softmax", {"X": [input]}, {"axis": axis})
+
+
+def log_softmax(input, axis=-1, name=None):
+    helper = LayerHelper("log_softmax", name=name)
+    return _single_out_layer(helper, "log_softmax", {"X": [input]}, {"axis": axis})
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("cross_entropy", inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_label": soft_label, "ignore_index": ignore_index})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    sm = helper.create_variable_for_type_inference(logits.dtype)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op("softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Softmax": [sm], "Loss": [loss]},
+                     attrs={"soft_label": soft_label, "ignore_index": ignore_index,
+                            "axis": axis})
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    return _single_out_layer(helper, "sigmoid_cross_entropy_with_logits",
+                             {"X": [x], "Label": [label]},
+                             {"ignore_index": ignore_index, "normalize": normalize})
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    return _single_out_layer(helper, "square_error_cost", {"X": [input], "Y": [label]})
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    topk_out = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    topk_idx = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op("top_k", inputs={"X": [input]},
+                     outputs={"Out": [topk_out], "Indices": [topk_idx]}, attrs={"k": k})
+    acc = helper.create_variable_for_type_inference("float32", stop_gradient=True)
+    correct = correct or helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    total = total or helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    helper.append_op("accuracy",
+                     inputs={"Out": [topk_out], "Indices": [topk_idx], "Label": [label]},
+                     outputs={"Accuracy": [acc], "Correct": [correct], "Total": [total]})
+    return acc
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    label = cast(label, input.dtype)
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = reduce_sum(elementwise_mul(input, label), dim=reduce_dims)
+    dice_denominator = reduce_sum(input, dim=reduce_dims) + reduce_sum(label, dim=reduce_dims)
+    dice_score = 1 - inse * 2.0 / (dice_denominator + epsilon)
+    return reduce_mean(dice_score)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper("log_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("log_loss", inputs={"Predicted": [input], "Labels": [label]},
+                     outputs={"Loss": [out]}, attrs={"epsilon": epsilon})
+    return out
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    resid = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op("huber_loss", inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out], "Residual": [resid]}, attrs={"delta": delta})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1_loss")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    diff = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    ins = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        ins["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        ins["OutsideWeight"] = [outside_weight]
+    helper.append_op("smooth_l1_loss", inputs=ins,
+                     outputs={"Out": [out], "Diff": [diff]},
+                     attrs={"sigma": sigma or 1.0})
+    return out
+
+
+def cos_sim(X, Y):
+    xn = l2_normalize(X, axis=-1)
+    yn = l2_normalize(Y, axis=-1)
+    helper = LayerHelper("cos_sim")
+    return _single_out_layer(helper, "dot", {"X": [xn], "Y": [yn]})
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    return _single_out_layer(helper, "mean", {"X": [x]})
+
+
+# ---------------------------------------------------------------------------
+# math wrappers
+# ---------------------------------------------------------------------------
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    return _single_out_layer(helper, "matmul", {"X": [x], "Y": [y]},
+                             {"transpose_X": transpose_x, "transpose_Y": transpose_y,
+                              "alpha": float(alpha)})
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    return _single_out_layer(helper, "mul", {"X": [x], "Y": [y]},
+                             {"x_num_col_dims": x_num_col_dims,
+                              "y_num_col_dims": y_num_col_dims})
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", act=act, name=name)
+    out = _single_out_layer(helper, "scale", {"X": [x]},
+                            {"scale": float(scale), "bias": float(bias),
+                             "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)
+
+
+def _elementwise(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, act=act, name=name)
+    out = _single_out_layer(helper, op_type, {"X": [x], "Y": [y]}, {"axis": axis})
+    return helper.append_activation(out)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_pow", x, y, axis, act, name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mod", x, y, axis, act, name)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_floordiv", x, y, axis, act, name)
+
+
+def _elementwise_binary_var(x, y, op_type):
+    """Operator-overload path (reference math_op_patch.py)."""
+    from . import tensor as _t
+
+    if isinstance(x, (int, float)):
+        if op_type == "elementwise_add":
+            return scale(y, 1.0, float(x))
+        if op_type == "elementwise_mul":
+            return scale(y, float(x))
+        if op_type == "elementwise_sub":
+            return scale(y, -1.0, float(x))
+        x = _t.fill_constant(shape=[1], dtype=y.dtype, value=float(x))
+    if isinstance(y, (int, float)):
+        if op_type == "elementwise_add":
+            return scale(x, 1.0, float(y))
+        if op_type == "elementwise_mul":
+            return scale(x, float(y))
+        if op_type == "elementwise_sub":
+            return scale(x, 1.0, -float(y))
+        if op_type == "elementwise_div":
+            return scale(x, 1.0 / float(y))
+        y = _t.fill_constant(shape=[1], dtype=x.dtype, value=float(y))
+    return _elementwise(op_type, x, y)
+
+
+def _cmp_layer(op_type, x, y, name=None):
+    helper = LayerHelper(op_type, name=name)
+    return _single_out_layer(helper, op_type, {"X": [x], "Y": [y]}, dtype="bool")
+
+
+def equal(x, y, cond=None):
+    return _cmp_layer("equal", x, y)
+
+
+def not_equal(x, y, cond=None):
+    return _cmp_layer("not_equal", x, y)
+
+
+def less_than(x, y, cond=None, force_cpu=None):
+    return _cmp_layer("less_than", x, y)
+
+
+def less_equal(x, y, cond=None):
+    return _cmp_layer("less_equal", x, y)
+
+
+def greater_than(x, y, cond=None):
+    return _cmp_layer("greater_than", x, y)
+
+
+def greater_equal(x, y, cond=None):
+    return _cmp_layer("greater_equal", x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return _cmp_layer("logical_and", x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _cmp_layer("logical_or", x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _cmp_layer("logical_xor", x, y)
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper("logical_not")
+    return _single_out_layer(helper, "logical_not", {"X": [x]}, dtype="bool")
+
+
+# activations ---------------------------------------------------------------
+
+
+def _act_layer(op_type, x, attrs=None, name=None):
+    helper = LayerHelper(op_type, name=name)
+    return _single_out_layer(helper, op_type, {"X": [x]}, attrs or {})
+
+
+def relu(x, name=None):
+    return _act_layer("relu", x, name=name)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return _act_layer("leaky_relu", x, {"alpha": alpha}, name)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _act_layer("elu", x, {"alpha": alpha}, name)
+
+
+def relu6(x, threshold=6.0, name=None):
+    return _act_layer("relu6", x, {"threshold": threshold}, name)
+
+
+def gelu(x, approximate=False):
+    return _act_layer("gelu", x, {"approximate": approximate})
+
+
+def swish(x, beta=1.0, name=None):
+    return _act_layer("swish", x, {"beta": beta}, name)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _act_layer("hard_sigmoid", x, {"slope": slope, "offset": offset}, name)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    return _act_layer("hard_swish", x,
+                      {"threshold": threshold, "scale": scale, "offset": offset}, name)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _act_layer("brelu", x, {"t_min": t_min, "t_max": t_max}, name)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _act_layer("softplus", x, name=name)
+
+
+def pow(x, factor=1.0, name=None):
+    return _act_layer("pow", x, {"factor": factor}, name)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu", name=name)
+    alpha_shape = [1] if mode == "all" else (
+        [x.shape[1]] if mode == "channel" else list(x.shape[1:]))
+    alpha = helper.create_parameter(param_attr, shape=alpha_shape, dtype=x.dtype,
+                                    default_initializer=Constant(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    return out
+
+
+# reductions ----------------------------------------------------------------
+
+
+def _reduce_layer(op_type, input, dim=None, keep_dim=False, name=None):
+    helper = LayerHelper(op_type, name=name)
+    if dim is None:
+        attrs = {"dim": [0], "keep_dim": keep_dim, "reduce_all": True}
+    else:
+        d = dim if isinstance(dim, (list, tuple)) else [dim]
+        attrs = {"dim": list(d), "keep_dim": keep_dim, "reduce_all": False}
+    return _single_out_layer(helper, op_type, {"X": [input]}, attrs)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_prod", input, dim, keep_dim, name)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_all", input, dim, keep_dim, name)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_any", input, dim, keep_dim, name)
+
+
+def clip(x, min, max, name=None):
+    return _act_layer("clip", x, {"min": float(min), "max": float(max)}, name)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _act_layer("clip_by_norm", x, {"max_norm": float(max_norm)}, name)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("l2_normalize", inputs={"X": [x]},
+                     outputs={"Out": [out], "Norm": [norm]},
+                     attrs={"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False):
+    return _act_layer("cumsum", x, {"axis": axis, "exclusive": exclusive,
+                                    "reverse": reverse})
+
+
+# shape ops -----------------------------------------------------------------
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape2", act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("reshape2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"shape": list(shape)})
+    return helper.append_activation(out)
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("transpose2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": list(perm)})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("flatten2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]}, attrs={"axis": axis})
+    return out
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze2", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op("squeeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]}, attrs={"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op("unsqueeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]}, attrs={"axes": list(axes)})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    return _single_out_layer(helper, "concat", {"X": list(input)}, {"axis": axis})
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    ndim = len(input.shape)
+    axis = dim % ndim
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "sections": [], "axis": axis}
+    else:
+        n = len(num_or_sections)
+        attrs = {"num": 0, "sections": list(num_or_sections), "axis": axis}
+    outs = [helper.create_variable_for_type_inference(input.dtype) for _ in range(n)]
+    helper.append_op("split", inputs={"X": [input]}, outputs={"Out": outs}, attrs=attrs)
+    return outs
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    return _single_out_layer(helper, "stack", {"X": list(x)}, {"axis": axis})
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack")
+    n = num if num is not None else x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype) for _ in range(n)]
+    helper.append_op("unstack", inputs={"X": [x]}, outputs={"Y": outs},
+                     attrs={"axis": axis, "num": n})
+    return outs
+
+
+def expand(x, expand_times, name=None):
+    return _act_layer("expand", x, {"expand_times": list(expand_times)}, name)
+
+
+def expand_as(x, target_tensor, name=None):
+    helper = LayerHelper("expand_as", name=name)
+    return _single_out_layer(helper, "expand_as",
+                             {"X": [x], "target_tensor": [target_tensor]})
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice")
+    return _single_out_layer(helper, "slice", {"Input": [input]},
+                             {"axes": list(axes), "starts": list(starts),
+                              "ends": list(ends), "decrease_axis": []})
+
+
+def strided_slice(input, axes, starts, ends, strides):
+    helper = LayerHelper("strided_slice")
+    return _single_out_layer(helper, "strided_slice", {"Input": [input]},
+                             {"axes": list(axes), "starts": list(starts),
+                              "ends": list(ends), "strides": list(strides)})
+
+
+def gather(input, index, overwrite=True):
+    helper = LayerHelper("gather")
+    return _single_out_layer(helper, "gather", {"X": [input], "Index": [index]})
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", name=name)
+    return _single_out_layer(helper, "gather_nd", {"X": [input], "Index": [index]})
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    helper = LayerHelper("scatter", name=name)
+    return _single_out_layer(helper, "scatter",
+                             {"X": [input], "Ids": [index], "Updates": [updates]},
+                             {"overwrite": overwrite})
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _act_layer("pad", x, {"paddings": list(paddings), "pad_value": pad_value}, name)
+
+
+def pad2d(input, paddings=[0, 0, 0, 0], mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return _act_layer("pad2d", input, {"paddings": list(paddings), "mode": mode,
+                                       "pad_value": pad_value}, name)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32", name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    ins = {"X": [label]}
+    if prior_dist is not None:
+        ins["PriorDist"] = [prior_dist]
+    return _single_out_layer(helper, "label_smooth", ins, {"epsilon": float(epsilon)})
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    helper = LayerHelper("one_hot")
+    return _single_out_layer(helper, "one_hot", {"X": [input]},
+                             {"depth": depth}, dtype="float32")
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    vals = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    idx = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op("top_k", inputs={"X": [input]},
+                     outputs={"Out": [vals], "Indices": [idx]}, attrs={"k": k})
+    return vals, idx
+
+
+def argmax(x, axis=0, name=None):
+    helper = LayerHelper("arg_max", name=name)
+    return _single_out_layer(helper, "arg_max", {"X": [x]}, {"axis": axis}, dtype="int64")
+
+
+def argmin(x, axis=0, name=None):
+    helper = LayerHelper("arg_min", name=name)
+    return _single_out_layer(helper, "arg_min", {"X": [x]}, {"axis": axis}, dtype="int64")
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    idx = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op("argsort", inputs={"X": [input]},
+                     outputs={"Out": [out], "Indices": [idx]},
+                     attrs={"axis": axis, "descending": descending})
+    return out, idx
+
+
+def where(condition):
+    helper = LayerHelper("where_index")
+    return _single_out_layer(helper, "where_index", {"Condition": [condition]},
+                             dtype="int64")
+
+
+def index_select(input, index, dim=0):
+    helper = LayerHelper("index_select")
+    return _single_out_layer(helper, "index_select", {"X": [input], "Index": [index]},
+                             {"dim": dim})
+
+
+def shape(input):
+    helper = LayerHelper("shape")
+    return _single_out_layer(helper, "shape", {"Input": [input]}, dtype="int32")
+
+
+def cast(x, dtype):
+    from ..framework import convert_np_dtype_to_dtype_
+
+    helper = LayerHelper("cast")
+    dt = convert_np_dtype_to_dtype_(dtype)
+    return _single_out_layer(helper, "cast", {"X": [x]}, {"out_dtype": dt}, dtype=dt)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op("lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", align_corners=True, align_mode=1):
+    op = "bilinear_interp" if resample.upper() == "BILINEAR" else "nearest_interp"
+    if out_shape is None:
+        out_shape = [int(input.shape[2] * scale), int(input.shape[3] * scale)]
+    helper = LayerHelper(op, name=name)
+    return _single_out_layer(helper, op, {"X": [input]},
+                             {"out_h": out_shape[0], "out_w": out_shape[1]})
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None, **kw):
+    return image_resize(input, out_shape, scale, name, "BILINEAR")
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None, **kw):
+    return image_resize(input, out_shape, scale, name, "NEAREST")
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("uniform_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "min": float(min), "max": float(max), "seed": seed})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("gaussian_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "mean": float(mean), "std": float(std), "seed": seed})
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    # sample an id from each row's multinomial distribution
+    helper = LayerHelper("sampling_id")
+    cum = cumsum(x, axis=-1)
+    r = uniform_random([x.shape[0], 1], dtype=x.dtype, min=0.0, max=1.0, seed=seed)
+    ge = cast(greater_equal(cum, r), "int64")
+    return argmax(ge, axis=-1)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    raise NotImplementedError("unfold: pending im2col lowering")
+
+
+def group_norm_(*a, **k):
+    return group_norm(*a, **k)

@@ -1,0 +1,170 @@
+"""Tensor-creation layers (reference python/paddle/fluid/layers/tensor.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Variable, convert_np_dtype_to_dtype_
+from ..layer_helper import LayerHelper
+from ..initializer import NumpyArrayInitializer
+
+__all__ = [
+    "create_tensor", "create_parameter", "create_global_var", "fill_constant",
+    "fill_constant_batch_size_like", "cast", "concat", "sums", "assign",
+    "zeros", "ones", "zeros_like", "ones_like", "range", "linspace",
+    "diag", "eye", "argmax", "argmin", "has_inf", "has_nan", "isfinite",
+]
+
+from .nn import cast, concat, argmax, argmin  # re-export
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable(name=helper.name, dtype=dtype,
+                                  persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    helper = LayerHelper("create_parameter", name=name)
+    from ..param_attr import ParamAttr
+
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias, default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(
+        name=helper.name if name is None else name, shape=shape, dtype=dtype,
+        persistable=persistable, stop_gradient=True)
+    from ..initializer import Constant
+
+    helper.set_variable_initializer(var, Constant(value))
+    return var
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    dt = convert_np_dtype_to_dtype_(dtype)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dt, stop_gradient=True)
+    helper.append_op("fill_constant", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dt, "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    dt = convert_np_dtype_to_dtype_(dtype)
+    out = helper.create_variable_for_type_inference(dt, stop_gradient=True)
+    helper.append_op("fill_constant_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dt, "value": float(value),
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sum", inputs={"X": list(input)}, outputs={"Out": [out]})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, np.ndarray) or isinstance(input, (list, tuple)):
+        arr = np.asarray(input)
+        if output is None:
+            output = helper.create_variable_for_type_inference(str(arr.dtype))
+        NumpyArrayInitializer(arr)(output, helper.block)
+        return output
+    if output is None:
+        output = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("assign", inputs={"X": [input]}, outputs={"Out": [output]})
+    return output
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("fill_zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("fill_zeros_like", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("fill_any_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("fill_any_like", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"value": 1.0})
+    return out
+
+
+def range(start, end, step, dtype):
+    helper = LayerHelper("range")
+    dt = convert_np_dtype_to_dtype_(dtype)
+    out = helper.create_variable_for_type_inference(dt, stop_gradient=True)
+    attrs = {"dtype": dt}
+    ins = {}
+    for k, v in (("Start", start), ("End", end), ("Step", step)):
+        if isinstance(v, Variable):
+            ins[k] = [v]
+        else:
+            attrs[k.lower()] = v
+    helper.append_op("range", inputs=ins, outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def linspace(start, stop, num, dtype="float32"):
+    helper = LayerHelper("linspace")
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("linspace", outputs={"Out": [out]},
+                     attrs={"start": float(start), "stop": float(stop), "num": int(num)})
+    return out
+
+
+def diag(diagonal):
+    helper = LayerHelper("diag")
+    return_var = helper.create_variable_for_type_inference(diagonal.dtype)
+    helper.append_op("diag", inputs={"Diagonal": [diagonal]}, outputs={"Out": [return_var]})
+    return return_var
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    helper = LayerHelper("eye")
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("eye", outputs={"Out": [out]},
+                     attrs={"num_rows": num_rows,
+                            "num_columns": num_columns or num_rows, "dtype": dtype})
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite")
+    out = helper.create_variable_for_type_inference("bool", stop_gradient=True)
+    helper.append_op("isfinite", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def has_inf(x):
+    from .nn import logical_not
+
+    return logical_not(isfinite(x))
+
+
+has_nan = has_inf

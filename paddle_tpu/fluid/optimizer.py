@@ -1,0 +1,516 @@
+"""Optimizers (reference python/paddle/fluid/optimizer.py:50 Optimizer base).
+
+`minimize(loss)` = append_backward + regularization + gradient clipping +
+one optimizer op per parameter — all symbolic program rewrites; the executor
+compiles the whole step (fwd+bwd+update) into one XLA computation with
+parameter buffers donated.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import framework
+from .framework import Program, Variable, unique_name, default_main_program, default_startup_program
+from .backward import append_backward
+from .initializer import Constant
+from .layer_helper import LayerHelper
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "Adamax", "AdamW",
+    "DecayedAdagrad", "Adadelta", "RMSProp", "Ftrl", "Lamb", "LarsMomentum",
+    "SGDOptimizer", "MomentumOptimizer", "AdagradOptimizer", "AdamOptimizer",
+    "AdamaxOptimizer", "DecayedAdagradOptimizer", "AdadeltaOptimizer",
+    "RMSPropOptimizer", "FtrlOptimizer", "LambOptimizer",
+    "LarsMomentumOptimizer", "ExponentialMovingAverage", "ModelAverage",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None,
+                 grad_clip=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._grad_clip = grad_clip
+        self._accumulators = {}  # acc_name -> {param_name: var}
+        self._lr_var = None
+        self.type = getattr(self, "type", "sgd")
+
+    # -- learning rate --------------------------------------------------
+    def _create_lr_var(self, program):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        if self._lr_var is not None:
+            return
+        helper = LayerHelper("learning_rate")
+        lr = helper.create_global_variable(
+            name=unique_name.generate("learning_rate"), shape=[1],
+            dtype="float32", persistable=True, stop_gradient=True)
+        helper.set_variable_initializer(lr, Constant(float(self._learning_rate)))
+        self._lr_var = lr
+
+    def _global_learning_rate(self):
+        return self._lr_var
+
+    def current_step_lr(self):
+        from .executor import global_scope
+
+        v = global_scope().get(self._lr_var.name)
+        return float(np.asarray(v).reshape(-1)[0])
+
+    # -- accumulators ---------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None, dtype=None):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        helper = LayerHelper(name)
+        var = helper.create_global_variable(
+            name=unique_name.generate(f"{param.name}_{name}"),
+            shape=shape or list(param.shape), dtype=dtype or "float32",
+            persistable=True, stop_gradient=True)
+        helper.set_variable_initializer(var, Constant(fill_value))
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- hooks each optimizer implements --------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    # -- pipeline -------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        # anchor on the loss/param program, not the ambient default — a user
+        # may call minimize() after exiting program_guard (reference wraps
+        # this in program_guard(loss.block.program) the same way)
+        if params_grads:
+            program = params_grads[0][0].block.program
+        else:
+            program = default_main_program()
+        if program is not default_main_program():
+            with framework.program_guard(program):
+                return self._apply_gradients_impl(program, params_grads)
+        return self._apply_gradients_impl(program, params_grads)
+
+    def _apply_gradients_impl(self, program, params_grads):
+        block = program.global_block()
+        # record raw (pre-regularization/clip) grads: the data-parallel
+        # transpiler allreduces THESE, matching the reference's
+        # multi_devices_graph_pass placement (after backward, before
+        # weight decay / clipping)
+        program._params_grads = [(p.name, g.name) for p, g in params_grads]
+        self._create_lr_var(program)
+        params_grads = self._append_regularization_ops(block, params_grads)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        else:
+            from . import clip as clip_mod
+
+            params_grads = clip_mod.append_gradient_clip_ops(params_grads)
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        optimize_ops = []
+        for pg in params_grads:
+            op = self._append_optimize_op(block, pg)
+            if op is not None:
+                op.attrs["op_role"] = "optimize"
+                optimize_ops.append(op)
+        self._finish_update(block, params_grads)
+        return optimize_ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    # -- regularization (reference regularizer.py append_regularization_ops)
+    def _append_regularization_ops(self, block, params_grads):
+        out = []
+        for p, g in params_grads:
+            reg = p.regularizer if getattr(p, "regularizer", None) is not None \
+                else (self.regularization if self.regularization is not None else None)
+            if reg is None:
+                out.append((p, g))
+                continue
+            new_g = reg._append_ops(block, p, g)
+            out.append((p, new_g))
+        return out
+
+    def _param_lr(self, param):
+        return self._lr_var
+
+
+class SGDOptimizer(Optimizer):
+    type = "sgd"
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "sgd",
+            inputs={"Param": [p], "Grad": [g], "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p]})
+
+
+class MomentumOptimizer(Optimizer):
+    type = "momentum"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    type = "lars_momentum"
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay})
+
+
+class AdagradOptimizer(Optimizer):
+    type = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._init_acc)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            self.type,
+            inputs={"Param": [p], "Grad": [g], "Moment1": [m1], "Moment2": [m2],
+                    "LearningRate": [self._param_lr(p)],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, **self._extra_attrs()})
+
+    def _extra_attrs(self):
+        return {}
+
+
+class AdamWOptimizer(AdamOptimizer):
+    type = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self._coeff = weight_decay
+
+    def _extra_attrs(self):
+        return {"coeff": self._coeff}
+
+
+class AdamaxOptimizer(Optimizer):
+    type = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "adamax",
+            inputs={"Param": [p], "Grad": [g],
+                    "Moment": [self._get_accumulator("moment", p)],
+                    "InfNorm": [self._get_accumulator("inf_norm", p)],
+                    "LearningRate": [self._param_lr(p)],
+                    "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p)]},
+            outputs={"ParamOut": [p],
+                     "MomentOut": [self._get_accumulator("moment", p)],
+                     "InfNormOut": [self._get_accumulator("inf_norm", p)]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon})
+
+    def _finish_update(self, block, params_grads):
+        for p, _ in params_grads:
+            b1p = self._get_accumulator("beta1_pow_acc", p)
+            block.append_op("scale", inputs={"X": [b1p]}, outputs={"Out": [b1p]},
+                            attrs={"scale": self._beta1, "op_role": "optimize"})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    type = "adadelta"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("__avg_squared_grad", p)
+            self._add_accumulator("__avg_squared_update", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "adadelta",
+            inputs={"Param": [p], "Grad": [g],
+                    "AvgSquaredGrad": [self._get_accumulator("__avg_squared_grad", p)],
+                    "AvgSquaredUpdate": [self._get_accumulator("__avg_squared_update", p)]},
+            outputs={"ParamOut": [p],
+                     "AvgSquaredGradOut": [self._get_accumulator("__avg_squared_grad", p)],
+                     "AvgSquaredUpdateOut": [self._get_accumulator("__avg_squared_update", p)]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    type = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "rmsprop",
+            inputs={"Param": [p], "Grad": [g],
+                    "Moment": [self._get_accumulator("momentum", p)],
+                    "MeanSquare": [self._get_accumulator("mean_square", p)],
+                    "MeanGrad": [self._get_accumulator("mean_grad", p)],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p],
+                     "MomentOut": [self._get_accumulator("momentum", p)],
+                     "MeanSquareOut": [self._get_accumulator("mean_square", p)],
+                     "MeanGradOut": [self._get_accumulator("mean_grad", p)]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+class FtrlOptimizer(Optimizer):
+    type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "ftrl",
+            inputs={"Param": [p], "Grad": [g],
+                    "SquaredAccumulator": [self._get_accumulator("squared", p)],
+                    "LinearAccumulator": [self._get_accumulator("linear", p)],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p],
+                     "SquaredAccumOut": [self._get_accumulator("squared", p)],
+                     "LinearAccumOut": [self._get_accumulator("linear", p)]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
+
+
+class LambOptimizer(AdamOptimizer):
+    type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2, epsilon=epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
+
+
+# EMA / ModelAverage (reference optimizer.py:2244,2434) — program-rewrite form
+class ExponentialMovingAverage:
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._ema_vars = {}
+        self._params = []
+        program = default_main_program()
+        block = program.global_block()
+        helper = LayerHelper(self._name)
+        for p in program.all_parameters():
+            if not p.trainable:
+                continue
+            ema = helper.create_global_variable(
+                name=unique_name.generate(f"{p.name}_ema"), shape=list(p.shape),
+                dtype=p.dtype, persistable=True, stop_gradient=True)
+            helper.set_variable_initializer(ema, Constant(0.0))
+            self._ema_vars[p.name] = ema
+            self._params.append(p)
+
+    def update(self):
+        block = default_main_program().global_block()
+        for p in self._params:
+            ema = self._ema_vars[p.name]
+            tmp = block.create_var(name=unique_name.generate("ema_tmp"),
+                                   dtype=p.dtype, stop_gradient=True)
+            block.append_op("scale", inputs={"X": [ema]}, outputs={"Out": [tmp]},
+                            attrs={"scale": self._decay, "op_role": "optimize"})
+            tmp2 = block.create_var(name=unique_name.generate("ema_tmp"),
+                                    dtype=p.dtype, stop_gradient=True)
+            block.append_op("scale", inputs={"X": [p]}, outputs={"Out": [tmp2]},
+                            attrs={"scale": 1.0 - self._decay, "op_role": "optimize"})
+            block.append_op("elementwise_add", inputs={"X": [tmp], "Y": [tmp2]},
+                            outputs={"Out": [ema]}, attrs={"op_role": "optimize"})
+
+    def apply(self, executor, need_restore=True):
+        import contextlib
+
+        from .executor import global_scope
+
+        @contextlib.contextmanager
+        def guard():
+            scope = global_scope()
+            backup = {p.name: scope.get(p.name) for p in self._params}
+            factor = 1.0 - self._decay  # bias correction omitted for parity-lite
+            for p in self._params:
+                scope.set(p.name, np.asarray(scope.get(self._ema_vars[p.name].name)))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for p in self._params:
+                        scope.set(p.name, backup[p.name])
+
+        return guard()
+
+    def restore(self, executor):
+        pass
+
+
+class ModelAverage(ExponentialMovingAverage):
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, **kw):
+        super().__init__(decay=0.999, **kw)
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer

@@ -1,0 +1,322 @@
+"""Op registry: every op type maps to a JAX lowering + grad maker.
+
+Reference analog: paddle/fluid/framework/op_registry.h + op_info.cc (static
+registrar macros populating OpInfoMap) and grad_op_desc_maker.h (per-op C++
+functors emitting grad OpDescs).  TPU-native redesign: instead of per-op
+CPU/CUDA kernels selected at run time (operator.cc:909 RunImpl), each op
+registers a *lowering* — a pure JAX function traced into the whole-block XLA
+computation.  Grad ops are still symbolic program nodes (so transpilers can
+rewrite the backward graph, e.g. to insert c_allreduce after each grad), but
+their default lowering is derived mechanically with ``jax.vjp`` of the forward
+lowering; XLA CSE removes the duplicated forward computation, so this costs
+nothing at run time while removing an entire class of hand-written-grad bugs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing as _t
+
+import numpy as np
+
+GRAD_SUFFIX = "@GRAD"
+
+
+class LowerContext:
+    """Per-trace context handed to op lowerings.
+
+    Attributes:
+      step: uint32 traced scalar — monotonically increasing executor step,
+        folded into RNG keys so dropout masks differ across steps.
+      is_test: program-level eval flag.
+      executor: the executor driving the trace (for sub-block lowering in
+        control-flow ops) or None under abstract shape inference.
+      block: the block being lowered (control-flow ops look up sub-blocks).
+      mesh_axes: names of mapped mesh axes when tracing under shard_map —
+        collective ops (c_allreduce_sum → lax.psum) use these.
+      env: live var name → traced array mapping (control-flow ops capture it).
+    """
+
+    def __init__(self, step=0, is_test=False, executor=None, block=None, mesh_axes=(), env=None):
+        self.step = step
+        self.is_test = is_test
+        self.executor = executor
+        self.block = block
+        self.mesh_axes = tuple(mesh_axes)
+        self.env = env if env is not None else {}
+
+
+@dataclasses.dataclass
+class OpInfo:
+    type: str
+    input_slots: list  # slot names; trailing '*' marks variadic (list-valued)
+    output_slots: list
+    lower: _t.Callable  # lower(ctx, *inputs, attrs) -> output or tuple
+    grad: _t.Optional[str]  # None | 'auto' | name of registered grad op
+    optional: frozenset  # input slots that may be absent
+    # slots whose grad never flows (int labels, masks...)
+    no_grad_inputs: frozenset
+    # if set, custom fn(op, block, grad_sub) -> list of grad op descs
+    grad_maker: _t.Optional[_t.Callable] = None
+    # outputs that alias an input in-place (out_slot -> in_slot), e.g. sgd's
+    # ParamOut aliases Param.  Used for buffer-donation bookkeeping.
+    inplace: _t.Optional[dict] = None
+
+    def is_variadic(self, slot):
+        return slot.endswith("*")
+
+    @property
+    def canonical_inputs(self):
+        return [s.rstrip("*") for s in self.input_slots]
+
+    @property
+    def canonical_outputs(self):
+        return [s.rstrip("*") for s in self.output_slots]
+
+    def validate(self, op):
+        known = set(self.canonical_inputs)
+        for slot in op.inputs:
+            if slot not in known:
+                raise ValueError(f"op {self.type}: unknown input slot {slot!r} (has {known})")
+
+
+_OP_REGISTRY: dict[str, OpInfo] = {}
+
+
+def has_op(type_):
+    return type_ in _OP_REGISTRY
+
+
+def get_op(type_) -> OpInfo:
+    if type_ not in _OP_REGISTRY:
+        raise KeyError(
+            f"op type {type_!r} has no registered lowering; registered: "
+            f"{sorted(_OP_REGISTRY)[:40]}..."
+        )
+    return _OP_REGISTRY[type_]
+
+
+def all_ops():
+    return dict(_OP_REGISTRY)
+
+
+def register_op(
+    type,
+    inputs,
+    outputs,
+    lower,
+    grad="auto",
+    optional=(),
+    no_grad_inputs=(),
+    grad_maker=None,
+    inplace=None,
+):
+    """Register an op lowering.
+
+    lower(ctx, *input_values, attrs) where each input value is a jax array
+    (or list for variadic slots, or None for absent optional slots), returns
+    a single array or a tuple matching ``outputs`` (None allowed for unused
+    output slots).
+    """
+    info = OpInfo(
+        type=type,
+        input_slots=list(inputs),
+        output_slots=list(outputs),
+        lower=lower,
+        grad=grad,
+        optional=frozenset(optional),
+        no_grad_inputs=frozenset(no_grad_inputs),
+        grad_maker=grad_maker,
+        inplace=inplace,
+    )
+    _OP_REGISTRY[type] = info
+    if grad == "auto":
+        _register_auto_grad(info)
+    return info
+
+
+def simple_op(type, inputs, outputs, **kw):
+    """Decorator form of register_op."""
+
+    def deco(fn):
+        register_op(type, inputs, outputs, fn, **kw)
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Auto-derived grad ops via jax.vjp of the forward lowering.
+# ---------------------------------------------------------------------------
+
+
+def _is_float(x):
+    return x is not None and np.issubdtype(np.asarray(x).dtype, np.floating) or (
+        x is not None and str(getattr(x, "dtype", "")) == "bfloat16"
+    )
+
+
+def _grad_op_type(fwd_type):
+    return fwd_type + "_grad"
+
+
+def _register_auto_grad(fwd: OpInfo):
+    """Create `<type>_grad` whose lowering re-traces the forward under vjp.
+
+    Grad op signature (matches the reference's convention, e.g.
+    softmax_grad consuming X / Out / Out@GRAD):
+      inputs:  all forward inputs, then one `<OutSlot>@GRAD` per fwd output
+      outputs: one `<InSlot>@GRAD` per forward input (emitted only for those
+               the backward builder asked for)
+    """
+    gtype = _grad_op_type(fwd.type)
+    # variadic slots stay variadic in the grad op (split's Out* → Out@GRAD*)
+    in_slots = list(fwd.input_slots) + [
+        s.rstrip("*") + GRAD_SUFFIX + ("*" if s.endswith("*") else "")
+        for s in fwd.output_slots
+    ]
+    out_slots = [
+        s.rstrip("*") + GRAD_SUFFIX + ("*" if s.endswith("*") else "")
+        for s in fwd.input_slots
+    ]
+
+    n_in = len(fwd.input_slots)
+
+    def lower_grad(ctx, *vals, attrs):
+        import jax
+        import jax.numpy as jnp
+
+        fwd_vals = list(vals[:n_in])
+        out_grads = list(vals[n_in:])
+
+        # Differentiate wrt float inputs that are present and not excluded.
+        diff_idx = []
+        for i, (slot, v) in enumerate(zip(fwd.input_slots, fwd_vals)):
+            cslot = slot.rstrip("*")
+            if cslot in fwd.no_grad_inputs or v is None:
+                continue
+            if fwd.is_variadic(slot):
+                if v and all(jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) for x in v):
+                    diff_idx.append(i)
+            elif jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
+                diff_idx.append(i)
+
+        def fwd_fn(*diff_vals):
+            full = list(fwd_vals)
+            for j, i in enumerate(diff_idx):
+                full[i] = diff_vals[j]
+            out = fwd.lower(ctx, *full, attrs=attrs)
+            return out if isinstance(out, tuple) else (out,)
+
+        primals = [fwd_vals[i] for i in diff_idx]
+        outs, vjp_fn = jax.vjp(fwd_fn, *primals)
+
+        def cot(o, g):
+            if o is None:  # unused output slot (e.g. reshape2's XShape)
+                return None
+            if g is None:
+                return jnp.zeros_like(o)
+            return jnp.reshape(g, jnp.shape(o)).astype(o.dtype)
+
+        cots = []
+        for slot, o, g in zip(fwd.output_slots, outs, out_grads):
+            if fwd.is_variadic(slot):
+                gl = list(g) if g is not None else [None] * len(o)
+                gl += [None] * (len(o) - len(gl))
+                cots.append(tuple(cot(oe, ge) for oe, ge in zip(o, gl)))
+            else:
+                cots.append(cot(o, g))
+        grads = vjp_fn(tuple(cots))
+        result = [None] * n_in
+        for j, i in enumerate(diff_idx):
+            result[i] = grads[j]
+        return tuple(result)
+
+    info = OpInfo(
+        type=gtype,
+        input_slots=in_slots,
+        output_slots=out_slots,
+        lower=lower_grad,
+        grad=None,
+        optional=frozenset(s.rstrip("*") for s in in_slots),
+        no_grad_inputs=frozenset(),
+    )
+    _OP_REGISTRY[gtype] = info
+    return info
+
+
+# ---------------------------------------------------------------------------
+# Graph-build-time shape inference via abstract evaluation.
+#
+# The reference hand-writes an InferShape per op (framework/operator.cc +
+# each op's InferShape method, ~427 implementations).  Here we get all of
+# them for free: jax.eval_shape abstract-evaluates the registered lowering
+# over ShapeDtypeStructs.  Unknown (-1) dims are temporarily bound to a
+# sentinel extent and mapped back afterwards.
+# ---------------------------------------------------------------------------
+
+_DYN_SENTINEL = 191  # prime, unlikely to collide with a real static extent
+
+
+def infer_op_outputs(op, block):
+    """Set shape/dtype on op's output Variables by abstract-evaluating the
+    lowering.  Best-effort: leaves vars untouched on failure."""
+    import jax
+    import numpy as np
+
+    if not has_op(op.type):
+        return
+    info = get_op(op.type)
+
+    def struct_of(name):
+        v = block._find_var_recursive(name)
+        if v is None or v.shape is None:
+            return None
+        shape = tuple(_DYN_SENTINEL if s == -1 else int(s) for s in v.shape)
+        import jax.numpy as jnp
+
+        dt = jnp.bfloat16 if v.dtype == "bfloat16" else np.dtype(v.dtype)
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    args = []
+    for slot in info.input_slots:
+        cslot = slot.rstrip("*")
+        names = op.inputs.get(cslot, [])
+        if info.is_variadic(slot):
+            structs = [struct_of(n) for n in names]
+            if any(s is None for s in structs):
+                return
+            args.append(structs)
+        elif not names:
+            args.append(None)
+        else:
+            s = struct_of(names[0])
+            if s is None:
+                return
+            args.append(s)
+
+    ctx = LowerContext(step=0, is_test=False, block=block)
+    ctx.op_index = 0
+
+    try:
+        out = jax.eval_shape(lambda *a: _as_tuple(info.lower(ctx, *a, attrs=op.attrs)),
+                             *args)
+    except Exception:
+        return
+    for slot, val in zip(info.output_slots, out):
+        cslot = slot.rstrip("*")
+        names = op.outputs.get(cslot, [])
+        vals = val if info.is_variadic(slot) else [val]
+        for n, s in zip(names, vals or []):
+            if s is None:
+                continue
+            v = block._find_var_recursive(n)
+            if v is None:
+                continue
+            v.shape = tuple(-1 if d == _DYN_SENTINEL else int(d) for d in s.shape)
+            dt = str(s.dtype)
+            v.dtype = "bfloat16" if dt == "bfloat16" else str(np.dtype(s.dtype))
+
+
+def _as_tuple(x):
+    return x if isinstance(x, tuple) else (x,)

@@ -1,0 +1,14 @@
+"""Op lowering library — importing this package registers every op.
+
+The registry (paddle_tpu.fluid.registry) is the TPU-native analog of the
+reference's OpInfoMap (paddle/fluid/framework/op_registry.h): instead of
+per-device kernels, each op carries a JAX lowering traced into whole-block
+XLA computations.
+"""
+
+from . import common  # noqa: F401
+from . import math_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import collective_ops  # noqa: F401

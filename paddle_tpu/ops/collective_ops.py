@@ -1,0 +1,182 @@
+"""Collective communication ops — XLA collectives over ICI/DCN.
+
+Reference analogs: paddle/fluid/operators/collective/ (c_allreduce_op.h:50,
+c_broadcast_op, c_allgather_op, c_reducescatter_op, c_comm_init_op,
+c_gen_nccl_id_op, c_sync_{calc,comm}_stream_op) — NCCL ring collectives keyed
+by ``ring_id`` with explicit stream-sync ops.
+
+TPU-native redesign: collectives lower to lax.psum / all_gather /
+psum_scatter / ppermute inside a shard_map over a jax.sharding.Mesh.  The
+reference's ``ring_id`` maps to a mesh *axis name* (registered in
+paddle_tpu.parallel.mesh: ring 0 → the data-parallel axis by default).  XLA
+schedules collectives on ICI and overlaps them with compute, so
+c_sync_*_stream become no-ops and gradient-fusion passes
+(fuse_all_reduce_op_pass) are subsumed by XLA's all-reduce combiner.
+
+Outside any mesh (single-chip), collectives are identity — same semantics as
+a 1-GPU NCCL ring.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.fluid.registry import register_op, simple_op
+
+
+def _axis_for_ring(ctx, attrs):
+    """Resolve the mesh axis name for this op's ring_id, if we are tracing
+    under shard_map (ctx.mesh_axes non-empty)."""
+    if not ctx.mesh_axes:
+        return None
+    ring = attrs.get("ring_id", 0)
+    from paddle_tpu.parallel import mesh as pmesh
+
+    name = pmesh.axis_name_for_ring(ring)
+    if name is not None and name in ctx.mesh_axes:
+        return name
+    return ctx.mesh_axes[0] if len(ctx.mesh_axes) == 1 else None
+
+
+def _c_allreduce(reducer):
+    def lower(ctx, x, attrs):
+        ax = _axis_for_ring(ctx, attrs)
+        if ax is None:
+            return x
+        return reducer(x, ax)
+
+    return lower
+
+
+register_op("c_allreduce_sum", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.psum(x, ax)))
+register_op("c_allreduce_max", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.pmax(x, ax)))
+register_op("c_allreduce_min", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.pmin(x, ax)))
+register_op("c_allreduce_prod", ["X"], ["Out"],
+            _c_allreduce(lambda x, ax: jnp.exp(lax.psum(jnp.log(x), ax))))
+register_op("allreduce", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.psum(x, ax)))
+register_op("c_allreduce_avg", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.pmean(x, ax)))
+
+
+@simple_op("c_broadcast", ["X"], ["Out"])
+def _c_broadcast(ctx, x, attrs):
+    ax = _axis_for_ring(ctx, attrs)
+    if ax is None:
+        return x
+    root = attrs.get("root", 0)
+    # select root's value on every device: gather then index (XLA folds this
+    # into a broadcast from root over ICI)
+    return lax.all_gather(x, ax)[root]
+
+
+register_op("broadcast", ["X"], ["Out"],
+            lambda ctx, x, attrs: _c_broadcast(ctx, x, attrs))
+
+
+@simple_op("c_allgather", ["X"], ["Out"])
+def _c_allgather(ctx, x, attrs):
+    ax = _axis_for_ring(ctx, attrs)
+    if ax is None:
+        return x
+    g = lax.all_gather(x, ax)  # [n, ...]
+    return jnp.reshape(g, (-1,) + tuple(jnp.shape(x)[1:]))
+
+
+@simple_op("c_reducescatter", ["X"], ["Out"])
+def _c_reducescatter(ctx, x, attrs):
+    ax = _axis_for_ring(ctx, attrs)
+    if ax is None:
+        return x
+    return lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)
+
+
+@simple_op("c_concat", ["X"], ["Out"])
+def _c_concat(ctx, x, attrs):
+    ax = _axis_for_ring(ctx, attrs)
+    if ax is None:
+        return x
+    g = lax.all_gather(x, ax)
+    return jnp.concatenate([g[i] for i in range(g.shape[0])], axis=-1)
+
+
+@simple_op("c_split", ["X"], ["Out"])
+def _c_split(ctx, x, attrs):
+    ax = _axis_for_ring(ctx, attrs)
+    if ax is None:
+        return x
+    n = lax.psum(1, ax)
+    idx = lax.axis_index(ax)
+    return lax.dynamic_slice_in_dim(x, idx * (jnp.shape(x)[-1] // n),
+                                    jnp.shape(x)[-1] // n, axis=-1)
+
+
+@simple_op("alltoall", ["X"], ["Out"])
+def _alltoall(ctx, x, attrs):
+    ax = _axis_for_ring(ctx, attrs)
+    if ax is None:
+        return x
+    n = lax.psum(1, ax)
+    xs = jnp.reshape(x, (n, -1) + tuple(jnp.shape(x)[1:]))
+    return jnp.reshape(lax.all_to_all(xs, ax, split_axis=0, concat_axis=0),
+                       jnp.shape(x))
+
+
+@simple_op("c_embedding", ["W", "Ids"], ["Out"], no_grad_inputs=("Ids",))
+def _c_embedding(ctx, w, ids, attrs):
+    """Vocab-sharded embedding lookup (model parallel)."""
+    ax = _axis_for_ring(ctx, attrs)
+    start = attrs.get("start_index", 0)
+    ids32 = ids.astype(jnp.int32)
+    local = ids32 - start
+    in_range = (local >= 0) & (local < jnp.shape(w)[0])
+    safe = jnp.where(in_range, local, 0)
+    out = jnp.take(w, jnp.reshape(safe, (-1,)), axis=0)
+    out = jnp.where(jnp.reshape(in_range, (-1, 1)), out, jnp.zeros_like(out))
+    out = jnp.reshape(out, tuple(jnp.shape(ids)) + (jnp.shape(w)[-1],))
+    if ax is not None:
+        out = lax.psum(out, ax)
+    return out
+
+
+def _identity(ctx, x, attrs):
+    return x
+
+
+# Stream-sync ops: XLA's dataflow ordering subsumes explicit stream sync
+# (reference c_sync_calc_stream_op.cc / c_sync_comm_stream_op.cc).
+register_op("c_sync_calc_stream", ["X"], ["Out"], _identity)
+register_op("c_sync_comm_stream", ["X*"], ["Out*"],
+            lambda ctx, xs, attrs: (list(xs),))
+register_op("c_identity", ["X"], ["Out"], _identity)
+register_op("c_wait_compute", ["X"], ["Out"], _identity)
+register_op("c_wait_comm", ["X"], ["Out"], _identity)
+
+
+# Comm bootstrap ops: under XLA the mesh IS the communicator; these become
+# no-ops recorded for API parity (reference c_comm_init_op.cc,
+# c_gen_nccl_id_op.cc — NCCL uniqueId TCP handshake).
+def _noop(ctx, attrs):
+    return None
+
+
+register_op("c_comm_init", [], [], _noop, grad=None)
+register_op("c_comm_init_all", [], [], _noop, grad=None)
+register_op("c_gen_nccl_id", [], [], _noop, grad=None)
+register_op("gen_nccl_id", [], [], _noop, grad=None)
+
+
+@simple_op("partial_allgather", ["X"], ["Out"])
+def _partial_allgather(ctx, x, attrs):
+    return _c_allgather(ctx, x, attrs)
+
+
+@simple_op("c_scatter", ["X"], ["Out"])
+def _c_scatter(ctx, x, attrs):
+    ax = _axis_for_ring(ctx, attrs)
+    if ax is None:
+        return x
+    n = lax.psum(1, ax)
+    idx = lax.axis_index(ax)
+    chunk = jnp.shape(x)[0] // n
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=0)

@@ -1,0 +1,62 @@
+"""Shared helpers for op lowerings."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def np_dtype(name):
+    if name == "bfloat16":
+        return jnp.bfloat16
+    return np.dtype(name)
+
+
+def op_rng_key(ctx, attrs):
+    """Per-op, per-step PRNG key.
+
+    The reference's random ops carry a `seed` attr (0 = nondeterministic,
+    drawn from a global engine).  Here randomness is functional: key =
+    fold(seed_or_op_identity, op_index, step) so (a) every random op in a
+    program draws an independent stream, (b) streams advance each executor
+    step, (c) runs are reproducible given program.random_seed.
+    """
+    seed = int(attrs.get("seed", 0) or 0)
+    if not seed:
+        prog = getattr(ctx, "program", None)
+        seed = int(getattr(prog, "random_seed", 0) or 0) or 0x5EED
+    base = jax.random.key(np.uint32(seed))
+    k = jax.random.fold_in(base, np.uint32(getattr(ctx, "op_index", 0)))
+    k = jax.random.fold_in(k, ctx.step)
+    # under shard_map, decorrelate streams across devices (each shard of a
+    # data-parallel batch must get an independent dropout mask)
+    for ax in getattr(ctx, "mesh_axes", ()):
+        k = jax.random.fold_in(k, jax.lax.axis_index(ax))
+    return k
+
+
+def bcast_to(y, x, axis):
+    """Reference elementwise broadcast semantics (elementwise_op_function.h):
+    Y's dims align with X's starting at `axis`; axis=-1 means right-aligned
+    (numpy rules)."""
+    xr, yr = jnp.ndim(x), jnp.ndim(y)
+    if axis is None or axis == -1 or yr == xr:
+        return y
+    # pad Y with trailing 1s so its dims sit at positions [axis, axis+yr)
+    new_shape = list(jnp.shape(y)) + [1] * (xr - axis - yr)
+    return jnp.reshape(y, [1] * axis + new_shape)
+
+
+def flatten_to_2d(x, num_col_dims):
+    """Reference `mul` op semantics: collapse leading num_col_dims dims into
+    rows, the rest into cols."""
+    shape = jnp.shape(x)
+    rows = 1
+    for s in shape[:num_col_dims]:
+        rows *= s
+    cols = 1
+    for s in shape[num_col_dims:]:
+        cols *= s
+    return jnp.reshape(x, (rows, cols))

@@ -1,0 +1,399 @@
+"""Math / elementwise / reduction / activation op lowerings.
+
+Reference analogs: paddle/fluid/operators/elementwise/ (broadcast binary ops),
+activation_op.cc, matmul_op.cc, mul_op.cc, reduce_ops/, softmax_op.cc,
+cross_entropy_op.cc, mean_op.cc.  Each lowering is a pure JAX function traced
+into the block's single XLA computation; gradients are auto-derived via vjp
+(see fluid/registry.py) unless noted.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fluid.registry import register_op, simple_op
+from .common import bcast_to, flatten_to_2d, np_dtype
+
+# ---------------------------------------------------------------------------
+# elementwise binary ops (reference operators/elementwise/*.cc)
+# ---------------------------------------------------------------------------
+
+
+def _ew(name, fn):
+    def lower(ctx, x, y, attrs):
+        return fn(x, bcast_to(y, x, attrs.get("axis", -1)))
+
+    register_op(name, ["X", "Y"], ["Out"], lower)
+
+
+_ew("elementwise_add", jnp.add)
+_ew("elementwise_sub", jnp.subtract)
+_ew("elementwise_mul", jnp.multiply)
+_ew("elementwise_div", jnp.divide)
+_ew("elementwise_max", jnp.maximum)
+_ew("elementwise_min", jnp.minimum)
+_ew("elementwise_pow", jnp.power)
+_ew("elementwise_mod", jnp.mod)
+_ew("elementwise_floordiv", jnp.floor_divide)
+
+
+# comparisons / logical (no grad)
+def _cmp(name, fn):
+    register_op(
+        name,
+        ["X", "Y"],
+        ["Out"],
+        lambda ctx, x, y, attrs, fn=fn: fn(x, bcast_to(y, x, attrs.get("axis", -1))),
+        grad=None,
+    )
+
+
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+_cmp("logical_and", jnp.logical_and)
+_cmp("logical_or", jnp.logical_or)
+_cmp("logical_xor", jnp.logical_xor)
+register_op("logical_not", ["X"], ["Out"], lambda ctx, x, attrs: jnp.logical_not(x), grad=None)
+register_op("isfinite", ["X*"], ["Out"],
+            lambda ctx, xs, attrs: jnp.all(jnp.stack([jnp.all(jnp.isfinite(x)) for x in xs])),
+            grad=None)
+
+
+# ---------------------------------------------------------------------------
+# mul / matmul  (MXU path: keep as single large dots — XLA tiles onto the
+# 128x128 systolic array; do NOT unroll batch loops)
+# ---------------------------------------------------------------------------
+
+
+@simple_op("mul", ["X", "Y"], ["Out"])
+def _mul(ctx, x, y, attrs):
+    xd = attrs.get("x_num_col_dims", 1)
+    yd = attrs.get("y_num_col_dims", 1)
+    x2 = flatten_to_2d(x, xd)
+    y2 = flatten_to_2d(y, yd)
+    out = jnp.dot(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
+    out_shape = tuple(jnp.shape(x)[:xd]) + tuple(jnp.shape(y)[yd:])
+    return jnp.reshape(out, out_shape)
+
+
+@simple_op("matmul", ["X", "Y"], ["Out"])
+def _matmul(ctx, x, y, attrs):
+    tx, ty = attrs.get("transpose_X", False), attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if jnp.ndim(x) == 1:
+        x = x[None, :]
+    if jnp.ndim(y) == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return out
+
+
+register_op("matmul_v2", ["X", "Y"], ["Out"],
+            lambda ctx, x, y, attrs: _matmul(ctx, x, y, attrs={
+                "transpose_X": attrs.get("trans_x", False),
+                "transpose_Y": attrs.get("trans_y", False)}))
+
+
+@simple_op("scale", ["X", "ScaleTensor"], ["Out"], optional=("ScaleTensor",),
+           no_grad_inputs=("ScaleTensor",))
+def _scale(ctx, x, scale_t, attrs):
+    s = scale_t if scale_t is not None else attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return x * jnp.asarray(s, x.dtype) + jnp.asarray(b, x.dtype)
+    return (x + jnp.asarray(b, x.dtype)) * jnp.asarray(s, x.dtype)
+
+
+@simple_op("sum", ["X*"], ["Out"])
+def _sum(ctx, xs, attrs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@simple_op("dot", ["X", "Y"], ["Out"])
+def _dot(ctx, x, y, attrs):
+    return jnp.sum(x * y, axis=-1, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# activations (reference operators/activation_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _act(name, fn):
+    register_op(name, ["X"], ["Out"], lambda ctx, x, attrs, fn=fn: fn(x))
+
+
+_act("relu", jax.nn.relu)
+_act("sigmoid", jax.nn.sigmoid)
+_act("tanh", jnp.tanh)
+_act("exp", jnp.exp)
+_act("log", jnp.log)
+_act("sqrt", jnp.sqrt)
+_act("rsqrt", jax.lax.rsqrt)
+_act("square", jnp.square)
+_act("abs", jnp.abs)
+_act("reciprocal", jnp.reciprocal)
+_act("softsign", jax.nn.soft_sign)
+_act("ceil", jnp.ceil)
+_act("floor", jnp.floor)
+_act("round", jnp.round)
+_act("sin", jnp.sin)
+_act("cos", jnp.cos)
+_act("tanh_shrink", lambda x: x - jnp.tanh(x))
+_act("softplus", jax.nn.softplus)
+_act("sigmoid_cross_entropy", jax.nn.sigmoid)
+
+
+@simple_op("gelu", ["X"], ["Out"])
+def _gelu(ctx, x, attrs):
+    return jax.nn.gelu(x, approximate=attrs.get("approximate", False))
+
+
+@simple_op("leaky_relu", ["X"], ["Out"])
+def _leaky_relu(ctx, x, attrs):
+    return jax.nn.leaky_relu(x, negative_slope=attrs.get("alpha", 0.02))
+
+
+@simple_op("elu", ["X"], ["Out"])
+def _elu(ctx, x, attrs):
+    return jax.nn.elu(x, alpha=attrs.get("alpha", 1.0))
+
+
+@simple_op("relu6", ["X"], ["Out"])
+def _relu6(ctx, x, attrs):
+    return jnp.clip(x, 0.0, attrs.get("threshold", 6.0))
+
+
+@simple_op("hard_sigmoid", ["X"], ["Out"])
+def _hard_sigmoid(ctx, x, attrs):
+    return jnp.clip(attrs.get("slope", 0.2) * x + attrs.get("offset", 0.5), 0.0, 1.0)
+
+
+@simple_op("swish", ["X"], ["Out"])
+def _swish(ctx, x, attrs):
+    return x * jax.nn.sigmoid(attrs.get("beta", 1.0) * x)
+
+
+@simple_op("pow", ["X", "FactorTensor"], ["Out"], optional=("FactorTensor",),
+           no_grad_inputs=("FactorTensor",))
+def _pow(ctx, x, f, attrs):
+    factor = f if f is not None else attrs.get("factor", 1.0)
+    return jnp.power(x, factor)
+
+
+@simple_op("brelu", ["X"], ["Out"])
+def _brelu(ctx, x, attrs):
+    return jnp.clip(x, attrs.get("t_min", 0.0), attrs.get("t_max", 24.0))
+
+
+@simple_op("prelu", ["X", "Alpha"], ["Out"])
+def _prelu(ctx, x, alpha, attrs):
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = jnp.reshape(alpha, (1, -1) + (1,) * (jnp.ndim(x) - 2))
+    return jnp.where(x > 0, x, alpha * x)
+
+
+@simple_op("stanh", ["X"], ["Out"])
+def _stanh(ctx, x, attrs):
+    return attrs.get("scale_b", 1.7159) * jnp.tanh(attrs.get("scale_a", 0.67) * x)
+
+
+@simple_op("hard_swish", ["X"], ["Out"])
+def _hard_swish(ctx, x, attrs):
+    t, s, o = attrs.get("threshold", 6.0), attrs.get("scale", 6.0), attrs.get("offset", 3.0)
+    return x * jnp.clip(x + o, 0.0, t) / s
+
+
+# ---------------------------------------------------------------------------
+# softmax / cross entropy / mean (reference softmax_op.cc, cross_entropy_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@simple_op("softmax", ["X"], ["Out"])
+def _softmax(ctx, x, attrs):
+    return jax.nn.softmax(x, axis=attrs.get("axis", -1))
+
+
+@simple_op("log_softmax", ["X"], ["Out"])
+def _log_softmax(ctx, x, attrs):
+    return jax.nn.log_softmax(x, axis=attrs.get("axis", -1))
+
+
+@simple_op("cross_entropy", ["X", "Label"], ["Y"], no_grad_inputs=("Label",))
+def _cross_entropy(ctx, x, label, attrs):
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        return -jnp.sum(label * jnp.log(jnp.maximum(x, eps)), axis=-1, keepdims=True)
+    lbl = jnp.squeeze(label, -1) if jnp.ndim(label) == jnp.ndim(x) else label
+    p = jnp.take_along_axis(x, lbl[..., None].astype(jnp.int32), axis=-1)
+    ignore = attrs.get("ignore_index", -100)
+    loss = -jnp.log(jnp.maximum(p, eps))
+    return jnp.where(lbl[..., None] == ignore, jnp.zeros_like(loss), loss)
+
+
+@simple_op("cross_entropy2", ["X", "Label"], ["Y", "XShape", "MatchX"],
+           no_grad_inputs=("Label",))
+def _cross_entropy2(ctx, x, label, attrs):
+    y = _cross_entropy(ctx, x, label, {"soft_label": False,
+                                       "ignore_index": attrs.get("ignore_index", -100)})
+    return y, None, None
+
+
+@simple_op("softmax_with_cross_entropy", ["Logits", "Label"], ["Softmax", "Loss"],
+           no_grad_inputs=("Label",))
+def _softmax_ce(ctx, logits, label, attrs):
+    axis = attrs.get("axis", -1)
+    sm = jax.nn.softmax(logits, axis=axis)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = jnp.squeeze(label, axis) if jnp.ndim(label) == jnp.ndim(logits) else label
+        picked = jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32), axis=axis)
+        loss = -picked
+        ignore = attrs.get("ignore_index", -100)
+        loss = jnp.where(lbl[..., None] == ignore, jnp.zeros_like(loss), loss)
+    return sm, loss
+
+
+@simple_op("sigmoid_cross_entropy_with_logits", ["X", "Label"], ["Out"],
+           no_grad_inputs=("Label",))
+def _sce(ctx, x, label, attrs):
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, jnp.zeros_like(loss), loss)
+    if attrs.get("normalize", False):
+        n = jnp.maximum(jnp.sum((label != ignore).astype(loss.dtype)), 1.0)
+        loss = loss / n
+    return loss
+
+
+@simple_op("mean", ["X"], ["Out"])
+def _mean(ctx, x, attrs):
+    return jnp.mean(x)
+
+
+@simple_op("huber_loss", ["X", "Y"], ["Out", "Residual"])
+def _huber(ctx, x, y, attrs):
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    return jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d)), r
+
+
+@simple_op("smooth_l1_loss", ["X", "Y", "InsideWeight", "OutsideWeight"],
+           ["Out", "Diff"], optional=("InsideWeight", "OutsideWeight"))
+def _smooth_l1(ctx, x, y, iw, ow, attrs):
+    sigma2 = attrs.get("sigma", 1.0) ** 2
+    d = (x - y) * (iw if iw is not None else 1.0)
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / sigma2, 0.5 * d * d * sigma2, a - 0.5 / sigma2)
+    if ow is not None:
+        loss = loss * ow
+    return jnp.sum(loss, axis=tuple(range(1, jnp.ndim(loss))), keepdims=False)[..., None], d
+
+
+@simple_op("square_error_cost", ["X", "Y"], ["Out"])
+def _square_error(ctx, x, y, attrs):
+    return jnp.square(x - y)
+
+
+@simple_op("log_loss", ["Predicted", "Labels"], ["Loss"], no_grad_inputs=("Labels",))
+def _log_loss(ctx, p, l, attrs):
+    e = attrs.get("epsilon", 1e-4)
+    return -l * jnp.log(p + e) - (1 - l) * jnp.log(1 - p + e)
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference operators/reduce_ops/)
+# ---------------------------------------------------------------------------
+
+
+def _reduce(name, fn, grad="auto"):
+    def lower(ctx, x, attrs, fn=fn):
+        dims = attrs.get("dim", [0])
+        if attrs.get("reduce_all", False):
+            axis = None
+        else:
+            axis = tuple(d % jnp.ndim(x) for d in (dims if isinstance(dims, (list, tuple)) else [dims]))
+        return fn(x, axis=axis, keepdims=attrs.get("keep_dim", False))
+
+    register_op(name, ["X"], ["Out"], lower, grad=grad)
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", jnp.all, grad=None)
+_reduce("reduce_any", jnp.any, grad=None)
+
+
+@simple_op("squared_l2_norm", ["X"], ["Out"])
+def _squared_l2_norm(ctx, x, attrs):
+    return jnp.sum(jnp.square(x)).reshape((1,))
+
+
+@simple_op("frobenius_norm", ["X"], ["Out"])
+def _frob(ctx, x, attrs):
+    return jnp.sqrt(jnp.sum(jnp.square(x)))
+
+
+@simple_op("clip", ["X", "Min", "Max"], ["Out"], optional=("Min", "Max"),
+           no_grad_inputs=("Min", "Max"))
+def _clip(ctx, x, mn, mx, attrs):
+    lo = mn if mn is not None else attrs.get("min", float("-inf"))
+    hi = mx if mx is not None else attrs.get("max", float("inf"))
+    return jnp.clip(x, lo, hi)
+
+
+@simple_op("clip_by_norm", ["X"], ["Out"])
+def _clip_by_norm(ctx, x, attrs):
+    mn = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > mn, x * (mn / jnp.maximum(norm, 1e-12)), x)
+
+
+@simple_op("l2_normalize", ["X"], ["Out", "Norm"])
+def _l2_normalize(ctx, x, attrs):
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return x / jnp.maximum(norm, eps), norm
+
+
+register_op("norm", ["X"], ["Out", "Norm"],
+            lambda ctx, x, attrs: _l2_normalize(ctx, x, attrs))
+
+
+# cumulative
+@simple_op("cumsum", ["X"], ["Out"])
+def _cumsum(ctx, x, attrs):
+    axis = attrs.get("axis", -1)
+    out = jnp.cumsum(jnp.flip(x, axis) if attrs.get("reverse", False) else x, axis=axis)
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis)
+    if attrs.get("exclusive", False):
+        pad = [(0, 0)] * jnp.ndim(x)
+        pad[axis] = (1, 0)
+        out = jnp.pad(out, pad)[tuple(
+            slice(0, -1) if i == axis % jnp.ndim(x) else slice(None) for i in range(jnp.ndim(x)))]
+    return out

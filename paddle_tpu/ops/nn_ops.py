@@ -1,0 +1,366 @@
+"""NN op lowerings: conv, pooling, normalization, dropout, attention helpers.
+
+Reference analogs: conv_op.cc (+conv_cudnn_op.cu.cc), pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, group_norm_op.cc, dropout_op.cc,
+interpolate_op.cc.  Convs lower to lax.conv_general_dilated — XLA maps them
+onto the MXU directly; no im2col (reference operators/math/im2col.cc) is
+needed.  NCHW semantics are preserved at the API level; XLA picks device
+layouts itself.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fluid.registry import register_op, simple_op
+from .common import op_rng_key
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+
+def _conv_nd(x, w, strides, paddings, dilations, groups, nd):
+    pads = [(p, p) for p in paddings]
+    if len(pads) == nd * 2:  # (before, after) per dim flattened
+        pads = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(nd)]
+    dn = jax.lax.conv_dimension_numbers(
+        jnp.shape(x), jnp.shape(w),
+        ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW"))
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(strides), padding=pads,
+        rhs_dilation=tuple(dilations), dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+@simple_op("conv2d", ["Input", "Filter", "Bias"], ["Output"], optional=("Bias",))
+def _conv2d(ctx, x, w, bias, attrs):
+    out = _conv_nd(x, w, attrs.get("strides", [1, 1]), attrs.get("paddings", [0, 0]),
+                   attrs.get("dilations", [1, 1]), attrs.get("groups", 1), 2)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1))
+    return out
+
+
+@simple_op("depthwise_conv2d", ["Input", "Filter", "Bias"], ["Output"], optional=("Bias",))
+def _depthwise_conv2d(ctx, x, w, bias, attrs):
+    a = dict(attrs)
+    a["groups"] = jnp.shape(x)[1]
+    return _conv2d(ctx, x, w, bias, a)
+
+
+@simple_op("conv3d", ["Input", "Filter", "Bias"], ["Output"], optional=("Bias",))
+def _conv3d(ctx, x, w, bias, attrs):
+    out = _conv_nd(x, w, attrs.get("strides", [1, 1, 1]), attrs.get("paddings", [0, 0, 0]),
+                   attrs.get("dilations", [1, 1, 1]), attrs.get("groups", 1), 3)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1, 1))
+    return out
+
+
+@simple_op("conv2d_transpose", ["Input", "Filter", "Bias"], ["Output"], optional=("Bias",))
+def _conv2d_transpose(ctx, x, w, bias, attrs):
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    # Filter layout is (in, out/groups, kh, kw) in the reference.
+    pads = [(d * (k - 1) - p, d * (k - 1) - p)
+            for p, k, d in zip(paddings, jnp.shape(w)[2:], dilations)]
+    wt = jnp.flip(w, axis=(-2, -1))
+    if groups == 1:
+        wt = jnp.swapaxes(wt, 0, 1)  # (out, in, kh, kw)
+    else:
+        ci, co_g = jnp.shape(w)[0], jnp.shape(w)[1]
+        wt = jnp.reshape(wt, (groups, ci // groups, co_g) + tuple(jnp.shape(w)[2:]))
+        wt = jnp.swapaxes(wt, 1, 2)
+        wt = jnp.reshape(wt, (groups * co_g, ci // groups) + tuple(jnp.shape(w)[2:]))
+    dn = jax.lax.conv_dimension_numbers(jnp.shape(x), jnp.shape(wt), ("NCHW", "OIHW", "NCHW"))
+    out = jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1), padding=pads, lhs_dilation=strides,
+        rhs_dilation=dilations, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pooling (reference pool_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@simple_op("pool2d", ["X"], ["Out"])
+def _pool2d(ctx, x, attrs):
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2, 2]))
+    strides = list(attrs.get("strides", ksize))
+    paddings = list(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False) or attrs.get("adaptive", False) and ksize == [1, 1]:
+        if ptype == "max":
+            return jnp.max(x, axis=(2, 3), keepdims=True)
+        return jnp.mean(x, axis=(2, 3), keepdims=True)
+    if attrs.get("adaptive", False):
+        # adaptive pooling to output size ksize: split H/W into ksize bins
+        n, c, h, wd = jnp.shape(x)
+        oh, ow = ksize
+        assert h % oh == 0 and wd % ow == 0, "adaptive pool needs divisible dims"
+        r = jnp.reshape(x, (n, c, oh, h // oh, ow, wd // ow))
+        return jnp.max(r, axis=(3, 5)) if ptype == "max" else jnp.mean(r, axis=(3, 5))
+    window = (1, 1, ksize[0], ksize[1])
+    strides_full = (1, 1, strides[0], strides[1])
+    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]), (paddings[1], paddings[1]))
+    if attrs.get("ceil_mode", False):
+        n, c, h, wd = jnp.shape(x)
+        extra_h = _ceil_extra(h, ksize[0], strides[0], paddings[0])
+        extra_w = _ceil_extra(wd, ksize[1], strides[1], paddings[1])
+        pads = ((0, 0), (0, 0), (paddings[0], paddings[0] + extra_h),
+                (paddings[1], paddings[1] + extra_w))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, jnp.asarray(init, x.dtype), jax.lax.max,
+                                     window, strides_full, pads)
+    summed = jax.lax.reduce_window(x, jnp.asarray(0.0, x.dtype), jax.lax.add,
+                                   window, strides_full, pads)
+    if attrs.get("exclusive", True) and (paddings[0] or paddings[1]):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), jax.lax.add,
+                                       window, strides_full, pads)
+        return summed / counts
+    return summed / (ksize[0] * ksize[1])
+
+
+def _ceil_extra(size, k, s, p):
+    import math
+
+    out_floor = (size + 2 * p - k) // s + 1
+    out_ceil = math.ceil((size + 2 * p - k) / s) + 1
+    return (out_ceil - out_floor) * s
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@simple_op(
+    "batch_norm",
+    ["X", "Scale", "Bias", "Mean", "Variance"],
+    ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    grad="bn_custom",
+    inplace={"MeanOut": "Mean", "VarianceOut": "Variance"},
+)
+def _batch_norm(ctx, x, scale, bias, mean, var, attrs):
+    """Reference batch_norm_op.cc.  MeanOut/VarianceOut alias Mean/Variance
+    (running stats updated in place → buffer donation in the executor)."""
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    layout = attrs.get("data_layout", "NCHW")
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    axes = (0, 2, 3) if (layout == "NCHW" and jnp.ndim(x) == 4) else tuple(
+        i for i in range(jnp.ndim(x)) if i != (1 if layout == "NCHW" else jnp.ndim(x) - 1))
+    ch_axis = 1 if layout == "NCHW" else jnp.ndim(x) - 1
+
+    def rs(v):
+        shape = [1] * jnp.ndim(x)
+        shape[ch_axis] = -1
+        return jnp.reshape(v, shape)
+
+    if is_test and not attrs.get("trainable_statistics", False):
+        inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+        y = (x - rs(mean)) * rs(inv * scale.astype(jnp.float32)).astype(x.dtype) + rs(bias)
+        return y, mean, var, mean, var
+    xf = x.astype(jnp.float32)
+    bmean = jnp.mean(xf, axis=axes)
+    bvar = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(bmean)
+    inv = jax.lax.rsqrt(bvar + eps)
+    y = ((xf - rs(bmean)) * rs(inv) * rs(scale.astype(jnp.float32))
+         + rs(bias.astype(jnp.float32))).astype(x.dtype)
+    new_mean = momentum * mean + (1 - momentum) * bmean.astype(mean.dtype)
+    new_var = momentum * var + (1 - momentum) * bvar.astype(var.dtype)
+    return y, new_mean, new_var, bmean, inv
+
+
+def _bn_grad_maker(op, out_grads, wanted, uniq):
+    """batch_norm grad: d(Y)→d(X,Scale,Bias); running-stat updates carry no
+    grad.  Uses a vjp over the normalization only (not the stat update)."""
+    ins = {k: list(v) for k, v in op.inputs.items()}
+    ins["Y@GRAD"] = [out_grads[op.outputs["Y"][0]]]
+    outs = {}
+    pairs = []
+    for slot in ("X", "Scale", "Bias"):
+        n = op.inputs[slot][0]
+        if n in wanted:
+            g = uniq(n)
+            outs[slot + "@GRAD"] = [g]
+            pairs.append((n, g))
+    return [("batch_norm_grad", ins, outs, dict(op.attrs))], pairs
+
+
+@simple_op("batch_norm_grad",
+           ["X", "Scale", "Bias", "Mean", "Variance", "Y@GRAD"],
+           ["X@GRAD", "Scale@GRAD", "Bias@GRAD"], grad=None,
+           optional=("Mean", "Variance"))
+def _batch_norm_grad(ctx, x, scale, bias, mean, var, dy, attrs):
+    def f(x_, s_, b_):
+        y = _batch_norm(ctx, x_, s_, b_, mean, var, attrs)[0]
+        return y
+
+    _, vjp = jax.vjp(f, x, scale, bias)
+    dx, ds, db = vjp(dy)
+    return dx, ds, db
+
+
+from paddle_tpu.fluid import registry as _registry
+
+_registry.get_op("batch_norm").grad_maker = _bn_grad_maker
+
+
+@simple_op("layer_norm", ["X", "Scale", "Bias"], ["Y", "Mean", "Variance"],
+           optional=("Scale", "Bias"))
+def _layer_norm(ctx, x, scale, bias, attrs):
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, jnp.ndim(x)))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    norm_shape = jnp.shape(x)[begin:]
+    if scale is not None:
+        y = y * jnp.reshape(scale.astype(jnp.float32), norm_shape)
+    if bias is not None:
+        y = y + jnp.reshape(bias.astype(jnp.float32), norm_shape)
+    return (y.astype(x.dtype), jnp.reshape(mean, jnp.shape(x)[:begin]),
+            jnp.reshape(var, jnp.shape(x)[:begin]))
+
+
+@simple_op("group_norm", ["X", "Scale", "Bias"], ["Y", "Mean", "Variance"],
+           optional=("Scale", "Bias"))
+def _group_norm(ctx, x, scale, bias, attrs):
+    eps = attrs.get("epsilon", 1e-5)
+    groups = attrs.get("groups", 1)
+    n, c = jnp.shape(x)[0], jnp.shape(x)[1]
+    r = jnp.reshape(x.astype(jnp.float32), (n, groups, -1))
+    mean = jnp.mean(r, axis=-1, keepdims=True)
+    var = jnp.var(r, axis=-1, keepdims=True)
+    y = jnp.reshape((r - mean) * jax.lax.rsqrt(var + eps), jnp.shape(x))
+    if scale is not None:
+        y = y * jnp.reshape(scale, (1, c) + (1,) * (jnp.ndim(x) - 2))
+    if bias is not None:
+        y = y + jnp.reshape(bias, (1, c) + (1,) * (jnp.ndim(x) - 2))
+    return y.astype(x.dtype), jnp.squeeze(mean, -1), jnp.squeeze(var, -1)
+
+
+@simple_op("instance_norm", ["X", "Scale", "Bias"], ["Y", "SavedMean", "SavedVariance"],
+           optional=("Scale", "Bias"))
+def _instance_norm(ctx, x, scale, bias, attrs):
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, jnp.ndim(x)))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    c = jnp.shape(x)[1]
+    shp = (1, c) + (1,) * (jnp.ndim(x) - 2)
+    if scale is not None:
+        y = y * jnp.reshape(scale, shp)
+    if bias is not None:
+        y = y + jnp.reshape(bias, shp)
+    return y, jnp.reshape(mean, (-1,)), jnp.reshape(var, (-1,))
+
+
+# ---------------------------------------------------------------------------
+# dropout — custom grad through the saved Mask so forward/backward agree
+# ---------------------------------------------------------------------------
+
+
+def _dropout_grad_maker(op, out_grads, wanted, uniq):
+    x = op.inputs["X"][0]
+    if x not in wanted:
+        return [], []
+    g = uniq(x)
+    ins = {"Out@GRAD": [out_grads[op.outputs["Out"][0]]], "Mask": list(op.outputs["Mask"])}
+    return [("dropout_grad", ins, {"X@GRAD": [g]}, dict(op.attrs))], [(x, g)]
+
+
+@simple_op("dropout", ["X"], ["Out", "Mask"], grad="custom",
+           grad_maker=_dropout_grad_maker)
+def _dropout(ctx, x, attrs):
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    if is_test:
+        if impl == "upscale_in_train":
+            return x, jnp.ones_like(x)
+        return x * (1.0 - p), jnp.ones_like(x)
+    k = op_rng_key(ctx, attrs)
+    keep = jax.random.bernoulli(k, 1.0 - p, jnp.shape(x))
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        scale = 1.0 / max(1.0 - p, 1e-8)
+        return x * mask * jnp.asarray(scale, x.dtype), mask * jnp.asarray(scale, x.dtype)
+    return x * mask, mask
+
+
+@simple_op("dropout_grad", ["Out@GRAD", "Mask"], ["X@GRAD"], grad=None)
+def _dropout_grad(ctx, dy, mask, attrs):
+    return dy * mask
+
+
+_registry.get_op("dropout").grad_maker = _dropout_grad_maker
+
+
+# ---------------------------------------------------------------------------
+# misc nn
+# ---------------------------------------------------------------------------
+
+
+@simple_op("lrn", ["X"], ["Out", "MidOut"])
+def _lrn(ctx, x, attrs):
+    n = attrs.get("n", 5)
+    k, alpha, beta = attrs.get("k", 2.0), attrs.get("alpha", 1e-4), attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    pad = n // 2
+    sq_p = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))
+    acc = sum(sq_p[:, i:i + jnp.shape(x)[1]] for i in range(n))
+    mid = k + alpha * acc
+    return x / jnp.power(mid, beta), mid
+
+
+@simple_op("softmax_mask_fuse_upper_triangle", ["X"], ["Out"])
+def _causal_softmax(ctx, x, attrs):
+    L = jnp.shape(x)[-1]
+    mask = jnp.tril(jnp.ones((L, L), bool))
+    return jax.nn.softmax(jnp.where(mask, x, jnp.asarray(-1e9, x.dtype)), axis=-1)
+
+
+@simple_op("bilinear_interp", ["X", "OutSize"], ["Out"], optional=("OutSize",),
+           no_grad_inputs=("OutSize",))
+def _bilinear_interp(ctx, x, out_size, attrs):
+    oh, ow = attrs.get("out_h"), attrs.get("out_w")
+    n, c, h, w = jnp.shape(x)
+    return jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+
+
+@simple_op("nearest_interp", ["X", "OutSize"], ["Out"], optional=("OutSize",),
+           no_grad_inputs=("OutSize",))
+def _nearest_interp(ctx, x, out_size, attrs):
+    oh, ow = attrs.get("out_h"), attrs.get("out_w")
+    n, c, h, w = jnp.shape(x)
+    return jax.image.resize(x, (n, c, oh, ow), method="nearest")
+
+
+@simple_op("temporal_shift", ["X"], ["Out"])
+def _temporal_shift(ctx, x, attrs):
+    seg, ratio = attrs.get("seg_num"), attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = jnp.shape(x)
+    r = jnp.reshape(x, (-1, seg, c, h, w))
+    fold = int(c * ratio)
+    left = jnp.pad(r[:, 1:, :fold], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    right = jnp.pad(r[:, :-1, fold:2 * fold], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    rest = r[:, :, 2 * fold:]
+    return jnp.reshape(jnp.concatenate([left, right, rest], axis=2), (nt, c, h, w))

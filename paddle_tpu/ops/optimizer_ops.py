@@ -1,0 +1,227 @@
+"""Optimizer op lowerings — in-place parameter updates.
+
+Reference analogs: paddle/fluid/operators/optimizers/ (sgd_op.cc,
+momentum_op.cc, adam_op.cc, lars_momentum_op.cc, lamb_op.cc, ...).  Each op's
+ParamOut/MomentOut alias its inputs by name; the executor maps that to XLA
+buffer donation so parameter memory is never doubled.  All are grad=None
+(optimizers sit after the backward graph).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fluid.registry import register_op, simple_op
+
+
+def _lr(lr):
+    return jnp.reshape(lr, ()).astype(jnp.float32)
+
+
+@simple_op("sgd", ["Param", "Grad", "LearningRate"], ["ParamOut"], grad=None,
+           inplace={"ParamOut": "Param"})
+def _sgd(ctx, p, g, lr, attrs):
+    return (p.astype(jnp.float32) - _lr(lr) * g.astype(jnp.float32)).astype(p.dtype)
+
+
+@simple_op("momentum", ["Param", "Grad", "Velocity", "LearningRate"],
+           ["ParamOut", "VelocityOut"], grad=None,
+           inplace={"ParamOut": "Param", "VelocityOut": "Velocity"})
+def _momentum(ctx, p, g, v, lr, attrs):
+    mu = attrs.get("mu", 0.9)
+    lr_ = _lr(lr)
+    g32, v32, p32 = g.astype(jnp.float32), v.astype(jnp.float32), p.astype(jnp.float32)
+    v_new = mu * v32 + g32
+    if attrs.get("use_nesterov", False):
+        p_new = p32 - (g32 + mu * v_new) * lr_
+    else:
+        p_new = p32 - lr_ * v_new
+    return p_new.astype(p.dtype), v_new.astype(v.dtype)
+
+
+@simple_op("lars_momentum", ["Param", "Grad", "Velocity", "LearningRate"],
+           ["ParamOut", "VelocityOut"], grad=None,
+           inplace={"ParamOut": "Param", "VelocityOut": "Velocity"})
+def _lars_momentum(ctx, p, g, v, lr, attrs):
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = 1e-9
+    p32, g32 = p.astype(jnp.float32), g.astype(jnp.float32)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p32)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g32)))
+    local_lr = jnp.where(pn > 0, coeff * pn / (gn + wd * pn + eps), 1.0)
+    v_new = mu * v.astype(jnp.float32) + _lr(lr) * local_lr * (g32 + wd * p32)
+    return (p32 - v_new).astype(p.dtype), v_new.astype(v.dtype)
+
+
+@simple_op(
+    "adam",
+    ["Param", "Grad", "Moment1", "Moment2", "LearningRate", "Beta1Pow", "Beta2Pow"],
+    ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    grad=None,
+    inplace={"ParamOut": "Param", "Moment1Out": "Moment1", "Moment2Out": "Moment2",
+             "Beta1PowOut": "Beta1Pow", "Beta2PowOut": "Beta2Pow"},
+)
+def _adam(ctx, p, g, m1, m2, lr, b1p, b2p, attrs):
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    g32, p32 = g.astype(jnp.float32), p.astype(jnp.float32)
+    m1n = b1 * m1.astype(jnp.float32) + (1 - b1) * g32
+    m2n = b2 * m2.astype(jnp.float32) + (1 - b2) * jnp.square(g32)
+    b1pf, b2pf = jnp.reshape(b1p, ()).astype(jnp.float32), jnp.reshape(b2p, ()).astype(jnp.float32)
+    lr_t = _lr(lr) * jnp.sqrt(1 - b2pf) / (1 - b1pf)
+    pn = p32 - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    return (pn.astype(p.dtype), m1n.astype(m1.dtype), m2n.astype(m2.dtype),
+            jnp.reshape(b1pf * b1, jnp.shape(b1p)).astype(b1p.dtype),
+            jnp.reshape(b2pf * b2, jnp.shape(b2p)).astype(b2p.dtype))
+
+
+@simple_op("adamw",
+           ["Param", "Grad", "Moment1", "Moment2", "LearningRate", "Beta1Pow", "Beta2Pow"],
+           ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+           grad=None,
+           inplace={"ParamOut": "Param", "Moment1Out": "Moment1", "Moment2Out": "Moment2",
+                    "Beta1PowOut": "Beta1Pow", "Beta2PowOut": "Beta2Pow"})
+def _adamw(ctx, p, g, m1, m2, lr, b1p, b2p, attrs):
+    wd = attrs.get("coeff", 0.01)
+    outs = _adam(ctx, p, g, m1, m2, lr, b1p, b2p, attrs)
+    pn = outs[0].astype(jnp.float32) - _lr(lr) * wd * p.astype(jnp.float32)
+    return (pn.astype(p.dtype),) + outs[1:]
+
+
+@simple_op("adagrad", ["Param", "Grad", "Moment", "LearningRate"],
+           ["ParamOut", "MomentOut"], grad=None,
+           inplace={"ParamOut": "Param", "MomentOut": "Moment"})
+def _adagrad(ctx, p, g, m, lr, attrs):
+    eps = attrs.get("epsilon", 1e-6)
+    g32 = g.astype(jnp.float32)
+    mn = m.astype(jnp.float32) + jnp.square(g32)
+    pn = p.astype(jnp.float32) - _lr(lr) * g32 / (jnp.sqrt(mn) + eps)
+    return pn.astype(p.dtype), mn.astype(m.dtype)
+
+
+@simple_op("decayed_adagrad", ["Param", "Grad", "Moment", "LearningRate"],
+           ["ParamOut", "MomentOut"], grad=None,
+           inplace={"ParamOut": "Param", "MomentOut": "Moment"})
+def _decayed_adagrad(ctx, p, g, m, lr, attrs):
+    decay, eps = attrs.get("decay", 0.95), attrs.get("epsilon", 1e-6)
+    g32 = g.astype(jnp.float32)
+    mn = decay * m.astype(jnp.float32) + (1 - decay) * jnp.square(g32)
+    return (p.astype(jnp.float32) - _lr(lr) * g32 / (jnp.sqrt(mn) + eps)).astype(p.dtype), mn
+
+
+@simple_op("rmsprop", ["Param", "Grad", "Moment", "MeanSquare", "MeanGrad", "LearningRate"],
+           ["ParamOut", "MomentOut", "MeanSquareOut", "MeanGradOut"], grad=None,
+           optional=("MeanGrad",),
+           inplace={"ParamOut": "Param", "MomentOut": "Moment",
+                    "MeanSquareOut": "MeanSquare", "MeanGradOut": "MeanGrad"})
+def _rmsprop(ctx, p, g, mom, ms, mg, lr, attrs):
+    rho, eps, mu = attrs.get("decay", 0.95), attrs.get("epsilon", 1e-6), attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    g32 = g.astype(jnp.float32)
+    msn = rho * ms.astype(jnp.float32) + (1 - rho) * jnp.square(g32)
+    if centered:
+        mgn = rho * mg.astype(jnp.float32) + (1 - rho) * g32
+        denom = jnp.sqrt(msn - jnp.square(mgn) + eps)
+    else:
+        mgn = mg
+        denom = jnp.sqrt(msn + eps)
+    momn = mu * mom.astype(jnp.float32) + _lr(lr) * g32 / denom
+    return (p.astype(jnp.float32) - momn).astype(p.dtype), momn, msn, mgn
+
+
+@simple_op("adadelta", ["Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"],
+           ["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"], grad=None,
+           inplace={"ParamOut": "Param", "AvgSquaredGradOut": "AvgSquaredGrad",
+                    "AvgSquaredUpdateOut": "AvgSquaredUpdate"})
+def _adadelta(ctx, p, g, asg, asu, attrs):
+    rho, eps = attrs.get("rho", 0.95), attrs.get("epsilon", 1e-6)
+    g32 = g.astype(jnp.float32)
+    asgn = rho * asg.astype(jnp.float32) + (1 - rho) * jnp.square(g32)
+    upd = -jnp.sqrt((asu.astype(jnp.float32) + eps) / (asgn + eps)) * g32
+    asun = rho * asu.astype(jnp.float32) + (1 - rho) * jnp.square(upd)
+    return (p.astype(jnp.float32) + upd).astype(p.dtype), asgn, asun
+
+
+@simple_op("adamax", ["Param", "Grad", "Moment", "InfNorm", "LearningRate", "Beta1Pow"],
+           ["ParamOut", "MomentOut", "InfNormOut"], grad=None,
+           inplace={"ParamOut": "Param", "MomentOut": "Moment", "InfNormOut": "InfNorm"})
+def _adamax(ctx, p, g, m, inf, lr, b1p, attrs):
+    b1, b2, eps = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999), attrs.get("epsilon", 1e-8)
+    g32 = g.astype(jnp.float32)
+    mn = b1 * m.astype(jnp.float32) + (1 - b1) * g32
+    infn = jnp.maximum(b2 * inf.astype(jnp.float32), jnp.abs(g32))
+    lr_t = _lr(lr) / (1 - jnp.reshape(b1p, ()).astype(jnp.float32))
+    return (p.astype(jnp.float32) - lr_t * mn / (infn + eps)).astype(p.dtype), mn, infn
+
+
+@simple_op("ftrl", ["Param", "SquaredAccumulator", "LinearAccumulator", "Grad", "LearningRate"],
+           ["ParamOut", "SquaredAccumOut", "LinearAccumOut"], grad=None,
+           inplace={"ParamOut": "Param", "SquaredAccumOut": "SquaredAccumulator",
+                    "LinearAccumOut": "LinearAccumulator"})
+def _ftrl(ctx, p, sq, lin, g, lr, attrs):
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    g32, p32 = g.astype(jnp.float32), p.astype(jnp.float32)
+    sq32, lin32 = sq.astype(jnp.float32), lin.astype(jnp.float32)
+    new_sq = sq32 + jnp.square(g32)
+    lr_ = _lr(lr)
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq32, -lr_power)) / lr_
+    new_lin = lin32 + g32 - sigma * p32
+    x = jnp.clip(new_lin, -l1, l1) - new_lin
+    y = jnp.power(new_sq, -lr_power) / lr_ + 2 * l2
+    new_p = x / y
+    return new_p.astype(p.dtype), new_sq, new_lin
+
+
+@simple_op("lamb", ["Param", "Grad", "Moment1", "Moment2", "LearningRate",
+                    "Beta1Pow", "Beta2Pow"],
+           ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+           grad=None,
+           inplace={"ParamOut": "Param", "Moment1Out": "Moment1", "Moment2Out": "Moment2",
+                    "Beta1PowOut": "Beta1Pow", "Beta2PowOut": "Beta2Pow"})
+def _lamb(ctx, p, g, m1, m2, lr, b1p, b2p, attrs):
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    g32, p32 = g.astype(jnp.float32), p.astype(jnp.float32)
+    m1n = b1 * m1.astype(jnp.float32) + (1 - b1) * g32
+    m2n = b2 * m2.astype(jnp.float32) + (1 - b2) * jnp.square(g32)
+    b1pf = jnp.reshape(b1p, ()).astype(jnp.float32)
+    b2pf = jnp.reshape(b2p, ()).astype(jnp.float32)
+    mhat = m1n / (1 - b1pf)
+    vhat = m2n / (1 - b2pf)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p32
+    pn = jnp.sqrt(jnp.sum(jnp.square(p32)))
+    rn = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+    new_p = p32 - _lr(lr) * trust * r
+    return (new_p.astype(p.dtype), m1n, m2n,
+            jnp.reshape(b1pf * b1, jnp.shape(b1p)).astype(b1p.dtype),
+            jnp.reshape(b2pf * b2, jnp.shape(b2p)).astype(b2p.dtype))
+
+
+@simple_op("proximal_gd", ["Param", "Grad", "LearningRate"], ["ParamOut"], grad=None,
+           inplace={"ParamOut": "Param"})
+def _proximal_gd(ctx, p, g, lr, attrs):
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    prox = p.astype(jnp.float32) - _lr(lr) * g.astype(jnp.float32)
+    if l1 > 0:
+        prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - _lr(lr) * l1, 0.0)
+    return (prox / (1.0 + _lr(lr) * l2)).astype(p.dtype)
+
+
+@simple_op("dpsgd", ["Param", "Grad", "LearningRate"], ["ParamOut"], grad=None,
+           inplace={"ParamOut": "Param"})
+def _dpsgd(ctx, p, g, lr, attrs):
+    from .common import op_rng_key
+
+    clip = attrs.get("clip", 10.0)
+    sigma = attrs.get("sigma", 1.0)
+    g32 = g.astype(jnp.float32)
+    gn = jnp.sqrt(jnp.sum(jnp.square(g32)))
+    g32 = g32 * jnp.minimum(1.0, clip / jnp.maximum(gn, 1e-12))
+    noise = sigma * clip * jax.random.normal(op_rng_key(ctx, attrs), jnp.shape(g32))
+    return (p.astype(jnp.float32) - _lr(lr) * (g32 + noise)).astype(p.dtype)

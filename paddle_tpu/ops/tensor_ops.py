@@ -1,0 +1,438 @@
+"""Tensor creation / manipulation / indexing op lowerings.
+
+Reference analogs: fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc, reshape_op.cc, transpose_op.cc, concat_op.cc,
+split_op.cc, lookup_table_op.cc, one_hot_op.cc, top_k_op.cc, arg_max_op.cc,
+metrics/accuracy_op.cc, assign_op.cc, cast_op.cc, slice_op.cc, expand_op.cc.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fluid.registry import register_op, simple_op
+from .common import np_dtype, op_rng_key
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+
+@simple_op("fill_constant", [], ["Out"], grad=None)
+def _fill_constant(ctx, attrs):
+    return jnp.full(tuple(attrs.get("shape", [1])), attrs.get("value", 0.0),
+                    dtype=np_dtype(attrs.get("dtype", "float32")))
+
+
+@simple_op("fill_zeros_like", ["X"], ["Out"], grad=None)
+def _fill_zeros_like(ctx, x, attrs):
+    return jnp.zeros_like(x)
+
+
+@simple_op("fill_any_like", ["X"], ["Out"], grad=None)
+def _fill_any_like(ctx, x, attrs):
+    dtype = attrs.get("dtype")
+    return jnp.full_like(x, attrs.get("value", 0.0),
+                         dtype=np_dtype(dtype) if dtype else None)
+
+
+@simple_op("uniform_random", [], ["Out"], grad=None)
+def _uniform_random(ctx, attrs):
+    k = op_rng_key(ctx, attrs)
+    return jax.random.uniform(
+        k, tuple(attrs.get("shape", [1])), dtype=np_dtype(attrs.get("dtype", "float32")),
+        minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0))
+
+
+@simple_op("gaussian_random", [], ["Out"], grad=None)
+def _gaussian_random(ctx, attrs):
+    k = op_rng_key(ctx, attrs)
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return (attrs.get("mean", 0.0)
+            + attrs.get("std", 1.0) * jax.random.normal(k, tuple(attrs.get("shape", [1])), dtype=dt))
+
+
+@simple_op("truncated_gaussian_random", [], ["Out"], grad=None)
+def _trunc_gaussian(ctx, attrs):
+    k = op_rng_key(ctx, attrs)
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    z = jax.random.truncated_normal(k, -2.0, 2.0, tuple(attrs.get("shape", [1])), dtype=dt)
+    return attrs.get("mean", 0.0) + attrs.get("std", 1.0) * z
+
+
+@simple_op("randint", [], ["Out"], grad=None)
+def _randint(ctx, attrs):
+    k = op_rng_key(ctx, attrs)
+    return jax.random.randint(k, tuple(attrs.get("shape", [1])),
+                              attrs.get("low", 0), attrs.get("high", 100),
+                              dtype=np_dtype(attrs.get("dtype", "int64")))
+
+
+@simple_op("range", ["Start", "End", "Step"], ["Out"], grad=None,
+           optional=("Start", "End", "Step"))
+def _range(ctx, start, end, step, attrs):
+    s = start if start is not None else attrs.get("start", 0)
+    e = end if end is not None else attrs.get("end")
+    st = step if step is not None else attrs.get("step", 1)
+    s = jnp.reshape(s, ()) if hasattr(s, "shape") else s
+    e = jnp.reshape(e, ()) if hasattr(e, "shape") else e
+    st = jnp.reshape(st, ()) if hasattr(st, "shape") else st
+    return jnp.arange(s, e, st, dtype=np_dtype(attrs.get("dtype", "int64")))
+
+
+@simple_op("assign", ["X"], ["Out"])
+def _assign(ctx, x, attrs):
+    return x
+
+
+@simple_op("assign_value", [], ["Out"], grad=None)
+def _assign_value(ctx, attrs):
+    vals = attrs.get("fp32_values") or attrs.get("int32_values") or attrs.get("int64_values")
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return jnp.asarray(np.asarray(vals, dtype=dt).reshape(tuple(attrs.get("shape", [-1]))))
+
+
+@simple_op("cast", ["X"], ["Out"])
+def _cast(ctx, x, attrs):
+    return x.astype(np_dtype(attrs.get("out_dtype", attrs.get("dtype", "float32"))))
+
+
+@simple_op("shape", ["Input"], ["Out"], grad=None)
+def _shape(ctx, x, attrs):
+    return jnp.asarray(jnp.shape(x), dtype=jnp.int32)
+
+
+@simple_op("increment", ["X"], ["Out"], grad=None)
+def _increment(ctx, x, attrs):
+    return x + jnp.asarray(attrs.get("step", 1.0), x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+
+@simple_op("reshape2", ["X", "Shape", "ShapeTensor*"], ["Out", "XShape"],
+           optional=("Shape", "ShapeTensor"), no_grad_inputs=("Shape", "ShapeTensor"))
+def _reshape2(ctx, x, shape_t, shape_list, attrs):
+    shape = attrs.get("shape")
+    return jnp.reshape(x, tuple(shape)), None
+
+
+register_op("reshape", ["X", "Shape"], ["Out"],
+            lambda ctx, x, s, attrs: jnp.reshape(x, tuple(attrs.get("shape"))),
+            optional=("Shape",), no_grad_inputs=("Shape",))
+
+
+@simple_op("transpose2", ["X"], ["Out", "XShape"])
+def _transpose2(ctx, x, attrs):
+    return jnp.transpose(x, tuple(attrs.get("axis"))), None
+
+
+register_op("transpose", ["X"], ["Out"],
+            lambda ctx, x, attrs: jnp.transpose(x, tuple(attrs.get("axis"))))
+
+
+@simple_op("flatten2", ["X"], ["Out", "XShape"])
+def _flatten2(ctx, x, attrs):
+    ax = attrs.get("axis", 1)
+    sh = jnp.shape(x)
+    rows = int(np.prod(sh[:ax])) if ax > 0 else 1
+    return jnp.reshape(x, (rows, -1)), None
+
+
+register_op("flatten", ["X"], ["Out"],
+            lambda ctx, x, attrs: _flatten2(ctx, x, attrs)[0])
+
+
+@simple_op("squeeze2", ["X"], ["Out", "XShape"])
+def _squeeze2(ctx, x, attrs):
+    axes = attrs.get("axes", [])
+    if axes:
+        return jnp.squeeze(x, tuple(a % jnp.ndim(x) for a in axes)), None
+    return jnp.squeeze(x), None
+
+
+register_op("squeeze", ["X"], ["Out"], lambda ctx, x, attrs: _squeeze2(ctx, x, attrs)[0])
+
+
+@simple_op("unsqueeze2", ["X"], ["Out", "XShape"])
+def _unsqueeze2(ctx, x, attrs):
+    out = x
+    for a in sorted(attrs.get("axes", [])):
+        out = jnp.expand_dims(out, a)
+    return out, None
+
+
+register_op("unsqueeze", ["X"], ["Out"], lambda ctx, x, attrs: _unsqueeze2(ctx, x, attrs)[0])
+
+
+@simple_op("concat", ["X*", "AxisTensor"], ["Out"], optional=("AxisTensor",),
+           no_grad_inputs=("AxisTensor",))
+def _concat(ctx, xs, axis_t, attrs):
+    return jnp.concatenate(xs, axis=attrs.get("axis", 0))
+
+
+@simple_op("split", ["X"], ["Out*"])
+def _split(ctx, x, attrs):
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        return tuple(jnp.split(x, idx, axis=axis)),
+    return tuple(jnp.split(x, num, axis=axis)),
+
+
+@simple_op("stack", ["X*"], ["Y"])
+def _stack(ctx, xs, attrs):
+    return jnp.stack(xs, axis=attrs.get("axis", 0))
+
+
+@simple_op("unstack", ["X"], ["Y*"])
+def _unstack(ctx, x, attrs):
+    axis = attrs.get("axis", 0)
+    return tuple(jnp.moveaxis(x, axis, 0)),
+
+
+@simple_op("slice", ["Input"], ["Out"])
+def _slice(ctx, x, attrs):
+    axes = attrs.get("axes", [])
+    starts = attrs.get("starts", [])
+    ends = attrs.get("ends", [])
+    idx = [slice(None)] * jnp.ndim(x)
+    for a, s, e in zip(axes, starts, ends):
+        dim = jnp.shape(x)[a]
+        s2 = s if s >= 0 else max(dim + s, 0)
+        e2 = min(e if e >= 0 else dim + e, dim)
+        idx[a] = slice(s2, e2)
+    out = x[tuple(idx)]
+    for a in sorted(attrs.get("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, a)
+    return out
+
+
+@simple_op("strided_slice", ["Input"], ["Out"])
+def _strided_slice(ctx, x, attrs):
+    idx = [slice(None)] * jnp.ndim(x)
+    for a, s, e, st in zip(attrs.get("axes", []), attrs.get("starts", []),
+                           attrs.get("ends", []), attrs.get("strides", [])):
+        idx[a] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+@simple_op("expand", ["X"], ["Out"])
+def _expand(ctx, x, attrs):
+    times = attrs.get("expand_times", [])
+    return jnp.tile(x, tuple(times))
+
+
+@simple_op("expand_as", ["X", "target_tensor"], ["Out"], no_grad_inputs=("target_tensor",))
+def _expand_as(ctx, x, t, attrs):
+    return jnp.broadcast_to(x, jnp.shape(t))
+
+
+@simple_op("tile", ["X"], ["Out"])
+def _tile(ctx, x, attrs):
+    return jnp.tile(x, tuple(attrs.get("repeat_times", [1])))
+
+
+@simple_op("pad", ["X"], ["Out"])
+def _pad(ctx, x, attrs):
+    p = attrs.get("paddings", [])
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(len(p) // 2)]
+    return jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))
+
+
+@simple_op("pad2d", ["X"], ["Out"])
+def _pad2d(ctx, x, attrs):
+    p = attrs.get("paddings", [0, 0, 0, 0])  # t, b, l, r
+    mode = attrs.get("mode", "constant")
+    pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))
+    return jnp.pad(x, pairs, mode={"reflect": "reflect", "edge": "edge"}[mode])
+
+
+@simple_op("reverse", ["X"], ["Out"])
+def _reverse(ctx, x, attrs):
+    return jnp.flip(x, tuple(attrs.get("axis", [0])))
+
+
+@simple_op("roll", ["X"], ["Out"])
+def _roll(ctx, x, attrs):
+    return jnp.roll(x, tuple(attrs.get("shifts", [0])), tuple(attrs.get("axis", [0])))
+
+
+# ---------------------------------------------------------------------------
+# indexing / embedding
+# ---------------------------------------------------------------------------
+
+
+@simple_op("lookup_table", ["W", "Ids"], ["Out"], no_grad_inputs=("Ids",))
+def _lookup_table(ctx, w, ids, attrs):
+    """Embedding (reference lookup_table_op.cc).  Gathers ride the VPU; the
+    reference's SelectedRows sparse grad becomes a dense scatter-add here —
+    XLA turns take/scatter pairs into efficient dynamic-gather kernels."""
+    pad = attrs.get("padding_idx", -1)
+    flat = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+    out = jnp.take(w, flat, axis=0)
+    if pad is not None and pad >= 0:
+        mask = (flat == pad)[:, None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    id_shape = jnp.shape(ids)
+    if id_shape and id_shape[-1] == 1:
+        id_shape = id_shape[:-1]
+    return jnp.reshape(out, tuple(id_shape) + (jnp.shape(w)[-1],))
+
+
+register_op("lookup_table_v2", ["W", "Ids"], ["Out"],
+            lambda ctx, w, ids, attrs: _lookup_table(ctx, w, ids, attrs),
+            no_grad_inputs=("Ids",))
+
+
+@simple_op("gather", ["X", "Index"], ["Out"], no_grad_inputs=("Index",))
+def _gather(ctx, x, index, attrs):
+    return jnp.take(x, index.astype(jnp.int32), axis=0)
+
+
+@simple_op("gather_nd", ["X", "Index"], ["Out"], no_grad_inputs=("Index",))
+def _gather_nd(ctx, x, index, attrs):
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return x[idx]
+
+
+@simple_op("scatter", ["X", "Ids", "Updates"], ["Out"], no_grad_inputs=("Ids",))
+def _scatter(ctx, x, ids, updates, attrs):
+    ids = ids.astype(jnp.int32)
+    if attrs.get("overwrite", True):
+        return x.at[ids].set(updates)
+    return x.at[ids].add(updates)
+
+
+@simple_op("one_hot", ["X"], ["Out"], grad=None)
+def _one_hot(ctx, x, attrs):
+    depth = attrs.get("depth")
+    sq = jnp.squeeze(x, -1) if jnp.shape(x) and jnp.shape(x)[-1] == 1 else x
+    return jax.nn.one_hot(sq.astype(jnp.int32), depth, dtype=jnp.float32)
+
+
+register_op("one_hot_v2", ["X"], ["Out"],
+            lambda ctx, x, attrs: jax.nn.one_hot(x.astype(jnp.int32), attrs.get("depth"),
+                                                 dtype=jnp.float32), grad=None)
+
+
+@simple_op("top_k", ["X", "K"], ["Out", "Indices"], grad=None, optional=("K",))
+def _top_k(ctx, x, k_t, attrs):
+    k = attrs.get("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return vals, idx.astype(jnp.int64)
+
+
+register_op("top_k_v2", ["X", "K"], ["Out", "Indices"],
+            lambda ctx, x, k_t, attrs: _top_k(ctx, x, k_t, attrs),
+            grad=None, optional=("K",))
+
+
+@simple_op("arg_max", ["X"], ["Out"], grad=None)
+def _arg_max(ctx, x, attrs):
+    return jnp.argmax(x, axis=attrs.get("axis", -1)).astype(
+        np_dtype(attrs.get("dtype", "int64")))
+
+
+@simple_op("arg_min", ["X"], ["Out"], grad=None)
+def _arg_min(ctx, x, attrs):
+    return jnp.argmin(x, axis=attrs.get("axis", -1)).astype(
+        np_dtype(attrs.get("dtype", "int64")))
+
+
+@simple_op("argsort", ["X"], ["Out", "Indices"], grad=None)
+def _argsort(ctx, x, attrs):
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis, descending=attrs.get("descending", False))
+    return jnp.take_along_axis(x, idx, axis=axis), idx.astype(jnp.int64)
+
+
+@simple_op("where", ["Condition", "X", "Y"], ["Out"], no_grad_inputs=("Condition",))
+def _where(ctx, c, x, y, attrs):
+    return jnp.where(c, x, y)
+
+
+register_op("where_index", ["Condition"], ["Out"],
+            lambda ctx, c, attrs: jnp.stack(jnp.nonzero(c), axis=-1).astype(jnp.int64),
+            grad=None)
+
+
+@simple_op("index_select", ["X", "Index"], ["Out"], no_grad_inputs=("Index",))
+def _index_select(ctx, x, index, attrs):
+    return jnp.take(x, index.astype(jnp.int32), axis=attrs.get("dim", 0))
+
+
+@simple_op("accuracy", ["Out", "Indices", "Label"], ["Accuracy", "Correct", "Total"],
+           grad=None, optional=("Out",))
+def _accuracy(ctx, out, indices, label, attrs):
+    lbl = label if jnp.ndim(label) == jnp.ndim(indices) else label[..., None]
+    correct_rows = jnp.any(indices == lbl.astype(indices.dtype), axis=-1)
+    total = jnp.asarray(correct_rows.shape[0], jnp.int32)
+    correct = jnp.sum(correct_rows.astype(jnp.int32))
+    return correct.astype(jnp.float32) / total.astype(jnp.float32), correct, total
+
+
+@simple_op("label_smooth", ["X", "PriorDist"], ["Out"], optional=("PriorDist",))
+def _label_smooth(ctx, x, prior, attrs):
+    eps = attrs.get("epsilon", 0.0)
+    k = jnp.shape(x)[-1]
+    if prior is not None:
+        return (1 - eps) * x + eps * prior
+    return (1 - eps) * x + eps / k
+
+
+@simple_op("linspace", ["Start", "Stop", "Num"], ["Out"], grad=None,
+           optional=("Start", "Stop", "Num"))
+def _linspace(ctx, start, stop, num, attrs):
+    s = jnp.reshape(start, ()) if start is not None else attrs.get("start", 0.0)
+    e = jnp.reshape(stop, ()) if stop is not None else attrs.get("stop", 1.0)
+    n = int(attrs.get("num", 100)) if num is None else int(num)
+    return jnp.linspace(s, e, n)
+
+
+@simple_op("eye", [], ["Out"], grad=None)
+def _eye(ctx, attrs):
+    return jnp.eye(attrs.get("num_rows"), attrs.get("num_columns"),
+                   dtype=np_dtype(attrs.get("dtype", "float32")))
+
+
+@simple_op("diag", ["Diagonal"], ["Out"])
+def _diag(ctx, d, attrs):
+    return jnp.diag(d)
+
+
+@simple_op("meshgrid", ["X*"], ["Out*"])
+def _meshgrid(ctx, xs, attrs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij")),
+
+
+@simple_op("take_along_axis", ["Input", "Index"], ["Result"], no_grad_inputs=("Index",))
+def _take_along_axis(ctx, x, idx, attrs):
+    return jnp.take_along_axis(x, idx.astype(jnp.int32), axis=attrs.get("Axis", 0))
+
+
+# 'print' op: pass-through (host callback printing would break jit caching;
+# reference: operators/print_op.cc)
+register_op("print", ["In"], ["Out"], lambda ctx, x, attrs: x)
+
+
+@simple_op("sign", ["X"], ["Out"], grad=None)
+def _sign(ctx, x, attrs):
+    return jnp.sign(x)
+
+
+@simple_op("fill_constant_batch_size_like", ["Input"], ["Out"], grad=None)
+def _fill_constant_batch_size_like(ctx, inp, attrs):
+    shape = list(attrs.get("shape"))
+    shape[attrs.get("output_dim_idx", 0)] = jnp.shape(inp)[attrs.get("input_dim_idx", 0)]
+    return jnp.full(tuple(shape), attrs.get("value", 0.0),
+                    dtype=np_dtype(attrs.get("dtype", "float32")))

@@ -1,0 +1,4 @@
+"""Parallelism subsystem: meshes, collectives, distributed strategies."""
+
+from . import mesh  # noqa: F401
+from .mesh import build_mesh, mesh_guard, current_mesh  # noqa: F401

@@ -1,0 +1,78 @@
+"""Device-mesh management: the TPU-native replacement for NCCL communicators.
+
+Reference analogs: platform/nccl_helper.h:90 (NCCLContextMap),
+collective_helper.h:63 (NCCLCommContext registry keyed by ring_id),
+c_comm_init / c_gen_nccl_id bootstrap ops.  On TPU there is no uniqueId
+handshake: a jax.sharding.Mesh over the slice IS the communicator, and a
+``ring_id`` maps to a mesh axis name.  Intra-slice traffic rides ICI; a
+multi-dimensional mesh (('dcn', 'dp', ...)) puts the leading axis over DCN for
+multi-slice/multi-host — matching the reference's hierarchical allreduce
+(nccl_helper.h:179 NCCLCommunicator) without any of its machinery.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+# Standard axis names, in the order strategies usually nest them.
+DATA_AXIS = "dp"
+MODEL_AXIS = "mp"
+PIPE_AXIS = "pp"
+SEQ_AXIS = "sp"
+EXPERT_AXIS = "ep"
+
+# ring_id → mesh axis name.  Ring 0 is the global/world ring in the reference
+# (c_allreduce_op.h:73); by default it is the data-parallel axis.
+_ring_axes: dict[int, str] = {0: DATA_AXIS}
+
+_current_mesh = None
+
+
+def set_ring_axis(ring_id: int, axis_name: str):
+    _ring_axes[int(ring_id)] = axis_name
+
+
+def axis_name_for_ring(ring_id: int):
+    return _ring_axes.get(int(ring_id))
+
+
+def current_mesh():
+    return _current_mesh
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh):
+    global _current_mesh
+    old = _current_mesh
+    _current_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _current_mesh = old
+
+
+def build_mesh(shape: dict[str, int] | None = None, devices=None):
+    """Create a Mesh.  shape maps axis name → size, e.g. {'dp': 4, 'mp': 2}.
+    Defaults to all local devices on a single data-parallel axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if not shape:
+        shape = {DATA_AXIS: len(devices)}
+    names = tuple(shape.keys())
+    sizes = tuple(shape.values())
+    n = int(np.prod(sizes))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, only {len(devices)} available")
+    arr = np.asarray(devices[:n]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def device_count():
+    import jax
+
+    return jax.device_count()

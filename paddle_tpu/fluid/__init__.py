@@ -26,6 +26,7 @@ from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import clip  # noqa: F401
 from .layer_helper import LayerHelper  # noqa: F401
+from . import nets  # noqa: F401
 from . import compiler  # noqa: F401
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
 from .layers.io import data  # noqa: F401

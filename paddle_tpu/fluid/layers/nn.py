@@ -34,7 +34,10 @@ __all__ = [
     "shape", "logical_and", "logical_or", "logical_not", "logical_xor",
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "cast", "brelu", "soft_relu", "uniform_random",
-    "gaussian_random", "sampling_id", "unfold", "group_norm",
+    "gaussian_random", "sampling_id", "unfold", "group_norm", "sigmoid",
+    "tanh", "exp", "log", "sqrt", "square", "abs", "sequence_conv",
+    "sequence_pool", "sequence_softmax", "sequence_expand", "sequence_reverse",
+    "sequence_first_step", "sequence_last_step", "sequence_mask",
 ]
 
 
@@ -575,6 +578,34 @@ def relu(x, name=None):
     return _act_layer("relu", x, name=name)
 
 
+def sigmoid(x, name=None):
+    return _act_layer("sigmoid", x, name=name)
+
+
+def tanh(x, name=None):
+    return _act_layer("tanh", x, name=name)
+
+
+def exp(x, name=None):
+    return _act_layer("exp", x, name=name)
+
+
+def log(x, name=None):
+    return _act_layer("log", x, name=name)
+
+
+def sqrt(x, name=None):
+    return _act_layer("sqrt", x, name=name)
+
+
+def square(x, name=None):
+    return _act_layer("square", x, name=name)
+
+
+def abs(x, name=None):
+    return _act_layer("abs", x, name=name)
+
+
 def leaky_relu(x, alpha=0.02, name=None):
     return _act_layer("leaky_relu", x, {"alpha": alpha}, name)
 
@@ -956,3 +987,81 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
 
 def group_norm_(*a, **k):
     return group_norm(*a, **k)
+
+
+# ---------------------------------------------------------------------------
+# sequence layers (reference layers/nn.py sequence_* → operators/sequence_ops/).
+# TPU-native representation: padded dense [B, T, D] + optional lengths [B]
+# instead of LoD offsets (see paddle_tpu/ops/sequence_ops.py).
+# ---------------------------------------------------------------------------
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, bias_attr=None, param_attr=None, act=None,
+                  name=None, length=None):
+    helper = LayerHelper("sequence_conv", act=act, name=name, size=num_filters,
+                         bias_attr=bias_attr)
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[filter_size * d, num_filters],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"X": [input], "Filter": [w]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("sequence_conv", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"contextLength": filter_size,
+                            "contextStart": -((filter_size - 1) // 2),
+                            "contextStride": filter_stride})
+    out = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(out)
+
+
+def sequence_pool(input, pool_type="average", is_test=False, length=None):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    max_index = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    inputs = {"X": [input]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("sequence_pool", inputs=inputs,
+                     outputs={"Out": [out], "MaxIndex": [max_index]},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, length=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    inputs = {"X": [input]}
+    if length is not None:
+        inputs["Length"] = [length]
+    return _single_out_layer(helper, "sequence_softmax", inputs)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    return _single_out_layer(helper, "sequence_expand", {"X": [x], "Y": [y]})
+
+
+def sequence_reverse(x, name=None, length=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    inputs = {"X": [x]}
+    if length is not None:
+        inputs["Length"] = [length]
+    return _single_out_layer(helper, "sequence_reverse", inputs)
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, pool_type="first", length=length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, pool_type="last", length=length)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("sequence_mask", inputs={"X": [x]}, outputs={"Y": [out]},
+                     attrs={"maxlen": maxlen if maxlen is not None else -1,
+                            "out_dtype": dtype})
+    return out

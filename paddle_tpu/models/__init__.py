@@ -1,0 +1,13 @@
+"""Flagship model zoo, defined in the Fluid graph-building style.
+
+Reference analogs: python/paddle/fluid/tests/book/ (end-to-end train
+workloads: fit_a_line, recognize_digits, image_classification, word2vec,
+machine_translation...) and tests/unittests/dist_transformer.py — the models
+the reference's own test strategy exercises.  Each builder constructs ops into
+the default main program and returns the named variables a training or
+inference script needs.
+"""
+
+from . import mlp  # noqa: F401
+from . import resnet  # noqa: F401
+from . import bert  # noqa: F401

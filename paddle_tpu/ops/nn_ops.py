@@ -120,15 +120,18 @@ def _pool2d(ctx, x, attrs):
         extra_w = _ceil_extra(wd, ksize[1], strides[1], paddings[1])
         pads = ((0, 0), (0, 0), (paddings[0], paddings[0] + extra_h),
                 (paddings[1], paddings[1] + extra_w))
+    # NB: init values must be python/numpy scalars, not jnp arrays — a traced
+    # init forces the generic reduce_window primitive, which has no transpose
+    # rule (breaks the whole-block vjp under jit).
     if ptype == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return jax.lax.reduce_window(x, jnp.asarray(init, x.dtype), jax.lax.max,
+        init = -np.inf if jnp.issubdtype(x.dtype, jnp.floating) else np.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, np.asarray(init, x.dtype), jax.lax.max,
                                      window, strides_full, pads)
-    summed = jax.lax.reduce_window(x, jnp.asarray(0.0, x.dtype), jax.lax.add,
+    summed = jax.lax.reduce_window(x, np.asarray(0.0, x.dtype), jax.lax.add,
                                    window, strides_full, pads)
     if attrs.get("exclusive", True) and (paddings[0] or paddings[1]):
         ones = jnp.ones_like(x)
-        counts = jax.lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), jax.lax.add,
+        counts = jax.lax.reduce_window(ones, np.asarray(0.0, x.dtype), jax.lax.add,
                                        window, strides_full, pads)
         return summed / counts
     return summed / (ksize[0] * ksize[1])

@@ -1,0 +1,162 @@
+"""Sequence ops (reference paddle/fluid/operators/sequence_ops/, 5.3k LoC).
+
+The reference's sequence ops consume LoD tensors — ragged batches flattened to
+[total_tokens, D] plus level-of-detail offsets (framework/lod_tensor.h).  That
+representation is hostile to XLA's static shapes, so the TPU-native design is
+**padded dense + explicit lengths**: a sequence batch is [B, T, D] with an
+optional `Length` int tensor [B]; ops mask positions >= length.  Same
+semantics, MXU/VPU-friendly layout, one compiled program per (B, T) bucket.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fluid.registry import register_op, simple_op
+
+
+def _time_mask(x, length):
+    """[B, T, ...] mask from lengths [B]; None → all valid."""
+    if length is None:
+        return None
+    t = jnp.shape(x)[1]
+    return (jnp.arange(t)[None, :] < jnp.reshape(length, (-1, 1))).astype(x.dtype)
+
+
+@simple_op("sequence_conv", ["X", "Filter", "Length"], ["Out"],
+           optional=("Length",), no_grad_inputs=("Length",))
+def _sequence_conv(ctx, x, w, length, attrs):
+    """Context-window conv over time (reference sequence_conv_op.cc).
+    x: [B, T, D]; Filter: [ctx_len * D, num_filters].  contextStart defaults
+    to -(ctx_len-1)/2 i.e. a centered window, matching the reference layer."""
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -((ctx_len - 1) // 2)))
+    b, t, d = jnp.shape(x)
+    nf = jnp.shape(w)[-1]
+    if length is not None:
+        m = _time_mask(x, length)
+        x = x * m[:, :, None]
+    # unfold the context window: [B, T, ctx_len*D]
+    pads = (-ctx_start, ctx_len - 1 + ctx_start)
+    xp = jnp.pad(x, ((0, 0), pads, (0, 0)))
+    cols = [xp[:, i:i + t, :] for i in range(ctx_len)]
+    unfolded = jnp.concatenate(cols, axis=-1)
+    out = jnp.dot(unfolded, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    return out
+
+
+@simple_op("sequence_pool", ["X", "Length"], ["Out", "MaxIndex"],
+           optional=("Length",), no_grad_inputs=("Length",))
+def _sequence_pool(ctx, x, length, attrs):
+    """Pool over the time axis (reference sequence_pool_op.cc).
+    x: [B, T, D] → [B, D].  pooltype: AVERAGE/SUM/SQRT/MAX/LAST/FIRST."""
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    mask = _time_mask(x, length)
+    t = jnp.shape(x)[1]
+    if mask is None:
+        n = jnp.asarray(t, x.dtype)
+        if ptype == "AVERAGE":
+            return jnp.mean(x, axis=1), None
+        if ptype == "SUM":
+            return jnp.sum(x, axis=1), None
+        if ptype == "SQRT":
+            return jnp.sum(x, axis=1) / jnp.sqrt(n), None
+        if ptype == "MAX":
+            return jnp.max(x, axis=1), None
+        if ptype == "LAST":
+            return x[:, -1, :], None
+        if ptype == "FIRST":
+            return x[:, 0, :], None
+        raise ValueError(f"unknown pooltype {ptype}")
+    m3 = mask[:, :, None]
+    n = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+    if ptype == "AVERAGE":
+        return jnp.sum(x * m3, axis=1) / n, None
+    if ptype == "SUM":
+        return jnp.sum(x * m3, axis=1), None
+    if ptype == "SQRT":
+        return jnp.sum(x * m3, axis=1) / jnp.sqrt(n), None
+    if ptype == "MAX":
+        neg = jnp.asarray(-1e38 if x.dtype != jnp.bfloat16 else -3e38, x.dtype)
+        return jnp.max(jnp.where(m3 > 0, x, neg), axis=1), None
+    if ptype == "LAST":
+        idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+        return jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0, :], None
+    if ptype == "FIRST":
+        return x[:, 0, :], None
+    raise ValueError(f"unknown pooltype {ptype}")
+
+
+@simple_op("sequence_softmax", ["X", "Length"], ["Out"],
+           optional=("Length",), no_grad_inputs=("Length",))
+def _sequence_softmax(ctx, x, length, attrs):
+    """Softmax over time with padding masked out.  x: [B, T] or [B, T, 1]."""
+    squeeze = jnp.ndim(x) == 3
+    v = x[..., 0] if squeeze else x
+    if length is not None:
+        t = jnp.shape(v)[1]
+        m = jnp.arange(t)[None, :] < jnp.reshape(length, (-1, 1))
+        v = jnp.where(m, v, jnp.asarray(-1e38, v.dtype))
+    out = jax.nn.softmax(v, axis=-1)
+    if length is not None:
+        out = jnp.where(m, out, jnp.zeros_like(out))
+    return out[..., None] if squeeze else out
+
+
+@simple_op("sequence_expand", ["X", "Y"], ["Out"], no_grad_inputs=("Y",))
+def _sequence_expand(ctx, x, y, attrs):
+    """Tile x along a new time axis to match y's time extent
+    (dense analog of reference sequence_expand_op.cc): [B, D] → [B, T, D]."""
+    t = jnp.shape(y)[1]
+    return jnp.broadcast_to(x[:, None, :], (jnp.shape(x)[0], t, jnp.shape(x)[1]))
+
+
+@simple_op("sequence_reverse", ["X", "Length"], ["Out"],
+           optional=("Length",), no_grad_inputs=("Length",))
+def _sequence_reverse(ctx, x, length, attrs):
+    """Reverse the time axis; with lengths, only each row's valid prefix is
+    reversed (padding stays at the tail) — matches LoD semantics."""
+    if length is None:
+        return jnp.flip(x, axis=1)
+    t = jnp.shape(x)[1]
+    ar = jnp.arange(t)[None, :]
+    ln = jnp.reshape(length, (-1, 1)).astype(jnp.int32)
+    idx = jnp.where(ar < ln, ln - 1 - ar, ar)
+    return jnp.take_along_axis(x, idx[..., None].astype(jnp.int32), axis=1)
+
+
+@simple_op("sequence_last_step", ["X", "Length"], ["Out"],
+           optional=("Length",), no_grad_inputs=("Length",))
+def _sequence_last_step(ctx, x, length, attrs):
+    out, _ = _sequence_pool(ctx, x, length, {"pooltype": "LAST"})
+    return out
+
+
+@simple_op("sequence_first_step", ["X", "Length"], ["Out"],
+           optional=("Length",), no_grad_inputs=("Length",))
+def _sequence_first_step(ctx, x, length, attrs):
+    out, _ = _sequence_pool(ctx, x, length, {"pooltype": "FIRST"})
+    return out
+
+
+@simple_op("sequence_mask", ["X"], ["Y"], grad=None)
+def _sequence_mask(ctx, x, attrs):
+    """lengths [B] → mask [B, maxlen] (reference sequence_mask_op.cc)."""
+    maxlen = int(attrs.get("maxlen", -1))
+    dtype = attrs.get("out_dtype", "float32")
+    from .common import np_dtype
+
+    m = jnp.arange(maxlen)[None, :] < jnp.reshape(x, (-1, 1))
+    return m.astype(np_dtype(dtype))
+
+
+@simple_op("sequence_pad", ["X", "PadValue", "Length"], ["Out", "OutLength"],
+           optional=("Length",), no_grad_inputs=("PadValue", "Length"))
+def _sequence_pad(ctx, x, pad_value, length, attrs):
+    """Identity in the padded-dense representation (data arrives padded);
+    returns lengths alongside for parity."""
+    return x, (length if length is not None
+               else jnp.full((jnp.shape(x)[0],), jnp.shape(x)[1], jnp.int32))

@@ -115,15 +115,22 @@ def _increment(ctx, x, attrs):
 # ---------------------------------------------------------------------------
 
 
+def _resolve_reshape(x, shape):
+    """Reference reshape semantics (reshape_op.cc): 0 copies the input dim at
+    that position; a single -1 is inferred."""
+    in_shape = jnp.shape(x)
+    out = [in_shape[i] if s == 0 else int(s) for i, s in enumerate(shape)]
+    return tuple(out)
+
+
 @simple_op("reshape2", ["X", "Shape", "ShapeTensor*"], ["Out", "XShape"],
            optional=("Shape", "ShapeTensor"), no_grad_inputs=("Shape", "ShapeTensor"))
 def _reshape2(ctx, x, shape_t, shape_list, attrs):
-    shape = attrs.get("shape")
-    return jnp.reshape(x, tuple(shape)), None
+    return jnp.reshape(x, _resolve_reshape(x, attrs.get("shape"))), None
 
 
 register_op("reshape", ["X", "Shape"], ["Out"],
-            lambda ctx, x, s, attrs: jnp.reshape(x, tuple(attrs.get("shape"))),
+            lambda ctx, x, s, attrs: jnp.reshape(x, _resolve_reshape(x, attrs.get("shape"))),
             optional=("Shape",), no_grad_inputs=("Shape",))
 
 

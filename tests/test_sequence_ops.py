@@ -1,0 +1,93 @@
+"""Sequence-op family tests: padded-dense semantics vs numpy reference
+(reference analog: sequence_ops/ op tests in tests/unittests)."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers
+
+
+def _run(build_fn, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        outs = build_fn()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=[o.name for o in outs])
+
+
+def test_sequence_pool_masked():
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    ln = np.array([2, 3], dtype="int64")
+
+    def build():
+        xv = fluid.data("x", [-1, 3, 4], False, dtype="float32")
+        lv = fluid.data("ln", [-1], False, dtype="int64")
+        return [layers.sequence_pool(xv, "average", length=lv),
+                layers.sequence_pool(xv, "max", length=lv),
+                layers.sequence_pool(xv, "last", length=lv),
+                layers.sequence_pool(xv, "sum", length=lv)]
+
+    avg, mx, last, sm = _run(build, {"x": x, "ln": ln})
+    np.testing.assert_allclose(avg[0], x[0, :2].mean(0), rtol=1e-6)
+    np.testing.assert_allclose(avg[1], x[1].mean(0), rtol=1e-6)
+    np.testing.assert_allclose(mx[0], x[0, :2].max(0), rtol=1e-6)
+    np.testing.assert_allclose(last[0], x[0, 1], rtol=1e-6)
+    np.testing.assert_allclose(last[1], x[1, 2], rtol=1e-6)
+    np.testing.assert_allclose(sm[0], x[0, :2].sum(0), rtol=1e-6)
+
+
+def test_sequence_softmax_masks_padding():
+    x = np.random.RandomState(0).randn(2, 4).astype("float32")
+    ln = np.array([2, 4], dtype="int64")
+
+    def build():
+        xv = fluid.data("x", [-1, 4], False, dtype="float32")
+        lv = fluid.data("ln", [-1], False, dtype="int64")
+        return [layers.sequence_softmax(xv, length=lv)]
+
+    (out,) = _run(build, {"x": x, "ln": ln})
+    assert np.allclose(out[0, 2:], 0.0)
+    np.testing.assert_allclose(out[0, :2].sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(out[1].sum(), 1.0, rtol=1e-5)
+
+
+def test_sequence_reverse_valid_prefix_only():
+    x = np.arange(12, dtype="float32").reshape(1, 4, 3)
+    ln = np.array([3], dtype="int64")
+
+    def build():
+        xv = fluid.data("x", [-1, 4, 3], False, dtype="float32")
+        lv = fluid.data("ln", [-1], False, dtype="int64")
+        return [layers.sequence_reverse(xv, length=lv)]
+
+    (out,) = _run(build, {"x": x, "ln": ln})
+    np.testing.assert_allclose(out[0, :3], x[0, :3][::-1])
+    np.testing.assert_allclose(out[0, 3], x[0, 3])
+
+
+def test_sequence_conv_pool_net():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 5, 8).astype("float32")
+
+    def build():
+        from paddle_tpu.fluid import nets
+
+        xv = fluid.data("x", [-1, 5, 8], False, dtype="float32")
+        out = nets.sequence_conv_pool(xv, num_filters=6, filter_size=3)
+        return [out]
+
+    (out,) = _run(build, {"x": x})
+    assert out.shape == (2, 6)
+    assert np.isfinite(out).all()
+
+
+def test_sequence_mask():
+    def build():
+        lv = fluid.data("ln", [-1], False, dtype="int64")
+        return [layers.sequence_mask(lv, maxlen=5, dtype="float32")]
+
+    (out,) = _run(build, {"ln": np.array([1, 3, 5], dtype="int64")})
+    exp = np.tril(np.ones((5, 5)))[[0, 2, 4]]
+    np.testing.assert_allclose(out, exp)

@@ -1,0 +1,61 @@
+"""Benchmark: BERT-base pretraining throughput (tokens/sec) on one chip.
+
+Runs the flagship training step (fwd + bwd + Adam, whole-step XLA
+compilation, parameter buffers donated) and prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no in-tree numbers (SURVEY.md §6, BASELINE.json
+"published": {}), so vs_baseline is reported against our own first recorded
+measurement (BENCH_BASELINE env or 1.0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    from paddle_tpu import fluid
+    from paddle_tpu.models import bert
+
+    batch, seq_len = 16, 128
+    cfg = bert.BertConfig.base(vocab_size=30528)  # pad vocab to /64 for MXU
+    main_prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
+        feeds, loss, mlm_loss, nsp_acc = bert.build_bert_pretrain(cfg, is_test=False)
+        opt = fluid.optimizer.Adam(learning_rate=1e-4)
+        opt.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    data = bert.make_fake_batch(cfg, batch=batch, seq_len=seq_len, seed=0)
+
+    # warmup: compile + 2 steps
+    for _ in range(2):
+        exe.run(main_prog, feed=data, fetch_list=[loss.name])
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        out = exe.run(main_prog, feed=data, fetch_list=[loss.name])
+    np.asarray(out[0]).block_until_ready() if hasattr(out[0], "block_until_ready") else None
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = n_steps * batch * seq_len / dt
+    baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
+    vs = tokens_per_sec / baseline if baseline > 0 else 1.0
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1059,9 +1059,14 @@ def sequence_last_step(input, length=None):
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    if maxlen is None:
+        # reference semantics (sequence_mask_op.cc): maxlen=None means max(x),
+        # a data-dependent extent XLA's static shapes cannot express
+        raise ValueError(
+            "sequence_mask requires an explicit maxlen on TPU: the reference's "
+            "maxlen=None (max of the lengths) is a data-dependent shape")
     helper = LayerHelper("sequence_mask", name=name)
     out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
     helper.append_op("sequence_mask", inputs={"X": [x]}, outputs={"Y": [out]},
-                     attrs={"maxlen": maxlen if maxlen is not None else -1,
-                            "out_dtype": dtype})
+                     attrs={"maxlen": int(maxlen), "out_dtype": dtype})
     return out

@@ -2,3 +2,7 @@
 
 from . import mesh  # noqa: F401
 from .mesh import build_mesh, mesh_guard, current_mesh  # noqa: F401
+from . import hybrid  # noqa: F401
+from .hybrid import (  # noqa: F401
+    HybridParallelRunner, ShardingRule, megatron_rules, build_hybrid_mesh,
+)

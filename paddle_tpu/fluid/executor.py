@@ -206,35 +206,43 @@ def _analyze_block(ops, block, feed_names):
     return scope_reads, writes
 
 
-class _CompiledBlock:
-    """One (program-version, feed-signature) → jitted XLA executable."""
+class BlockPlan:
+    """Shared compilation plan for a block: pruned op list, scope dataflow
+    classification, fetch validation, and the traceable body function.  Used
+    by the single-device executor, the shard_map data-parallel runner, and the
+    GSPMD hybrid runner — one implementation of prune/analyze/write-back."""
 
-    def __init__(self, program, block, feed_names, fetch_names, place, scope):
-        import jax
-
+    def __init__(self, program, block, feed_names, fetch_names, scope):
+        self.program = program
         self.block = block
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.ops = _prune_ops(block, fetch_names)
-        scope_reads, writes = _analyze_block(self.ops, block, feed_names)
+        scope_reads, writes = _analyze_block(self.ops, block, self.feed_names)
         missing = [n for n in scope_reads if scope.get(n) is None]
         if missing:
             raise RuntimeError(
                 f"Variables {missing} must exist in scope before running this "
                 f"program (did you run the startup program?)"
             )
-        produced = set(feed_names) | set(scope_reads)
+        produced = set(self.feed_names) | set(scope_reads)
         for op in self.ops:
             produced.update(op.output_arg_names)
-        bad_fetch = [n for n in fetch_names if n not in produced]
+        bad_fetch = [n for n in self.fetch_names if n not in produced]
         if bad_fetch:
             raise ValueError(
                 f"fetch target(s) {bad_fetch} are not produced by this program "
                 f"(not an op output, feed, or scope variable)"
             )
-        self.donated_names = [n for n in scope_reads if n in set(writes)]
-        self.readonly_names = [n for n in scope_reads if n not in set(writes)]
+        wset = set(writes)
+        self.donated_names = [n for n in scope_reads if n in wset]
+        self.readonly_names = [n for n in scope_reads if n not in wset]
         self.write_names = list(writes)
+
+    def make_body(self, mesh_axes=()):
+        """fn(donated, readonly, feeds, step) -> (fetches, out_writes)."""
+        program, block, ops = self.program, self.block, self.ops
+        fetch_names, write_names = self.fetch_names, self.write_names
         is_test = getattr(program, "_is_test", False)
 
         def fn(donated, readonly, feeds, step):
@@ -242,14 +250,32 @@ class _CompiledBlock:
             env.update(donated)
             env.update(readonly)
             env.update(feeds)
-            ctx = registry.LowerContext(step=step, is_test=is_test, block=block)
+            ctx = registry.LowerContext(step=step, is_test=is_test,
+                                        block=block, mesh_axes=mesh_axes)
             ctx.program = program
-            trace_block(block, env, ctx, ops=self.ops)
-            fetches = [env[n] for n in self.fetch_names]
-            out_writes = {n: env[n] for n in self.write_names if n in env}
+            trace_block(block, env, ctx, ops=ops)
+            fetches = [env[n] for n in fetch_names]
+            out_writes = {n: env[n] for n in write_names if n in env}
             return fetches, out_writes
 
-        self._jitted = jax.jit(fn, donate_argnums=(0,))
+        return fn
+
+
+class _CompiledBlock:
+    """One (program-version, feed-signature) → jitted XLA executable."""
+
+    def __init__(self, program, block, feed_names, fetch_names, place, scope):
+        import jax
+
+        plan = BlockPlan(program, block, feed_names, fetch_names, scope)
+        self.block = block
+        self.feed_names = plan.feed_names
+        self.fetch_names = plan.fetch_names
+        self.ops = plan.ops
+        self.donated_names = plan.donated_names
+        self.readonly_names = plan.readonly_names
+        self.write_names = plan.write_names
+        self._jitted = jax.jit(plan.make_body(), donate_argnums=(0,))
         self.place = place
 
     def run(self, scope, feeds, step):

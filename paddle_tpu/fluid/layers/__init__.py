@@ -11,9 +11,17 @@ from .tensor import (  # noqa: F401
 )
 from .tensor import range as range  # noqa: F401  (shadows builtin, like the reference)
 from .io import data  # noqa: F401
-
-# control flow / sequence ops land in later milestones; importing their
-# modules is deferred so the core path stays light.
+from . import control_flow
+from .control_flow import (  # noqa: F401
+    While, Switch, ConditionalBlock, StaticRNN, increment, array_write,
+    array_read, array_length, create_array, autoincreased_step_counter,
+)
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import (  # noqa: F401
+    exponential_decay, natural_exp_decay, inverse_time_decay,
+    polynomial_decay, piecewise_decay, noam_decay, cosine_decay,
+    linear_lr_warmup,
+)
 
 
 def mean_(*a, **k):

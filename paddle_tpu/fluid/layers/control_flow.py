@@ -1,0 +1,362 @@
+"""Control-flow layers (reference python/paddle/fluid/layers/control_flow.py).
+
+While / Switch / ConditionalBlock / StaticRNN build sub-blocks of op descs,
+then a capture analysis declares every external read as an explicit op input
+so the functional XLA lowerings (ops/control_flow_ops.py) and append_backward
+see the true dataflow.  DynamicRNN (LoD-driven ragged recurrence) is not
+provided: on TPU variable-length sequences are padded/bucketed and recurred
+with StaticRNN + masks (SURVEY §5 long-context note).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import Variable, unique_name
+from ..layer_helper import LayerHelper
+from .. import framework
+
+__all__ = [
+    "While", "Switch", "ConditionalBlock", "StaticRNN", "increment",
+    "less_than", "less_equal", "greater_than", "greater_equal", "equal",
+    "not_equal", "array_write", "array_read", "array_length", "create_array",
+    "autoincreased_step_counter",
+]
+
+
+def _analyze_sub_block(sub_block, extra_exclude=()):
+    """Classify the sub-block's dataflow against enclosing blocks.
+
+    Returns (carries, extras, extras_ng): carries = outer-block vars written
+    by sub ops; extras / extras_ng = outer-block vars read (float / non-float),
+    excluding carries.  Order is deterministic (first occurrence).
+    """
+    parent = sub_block.parent_block
+    local = set(sub_block.vars.keys())
+
+    def outer_var(name):
+        if name in local:
+            return None
+        return parent._find_var_recursive(name) if parent is not None else None
+
+    carries, extras, extras_ng = [], [], []
+    seen_w, seen_r = set(), set()
+    for op in sub_block.ops:
+        for n in op.output_arg_names:
+            if n in seen_w:
+                continue
+            if outer_var(n) is not None:
+                seen_w.add(n)
+                carries.append(n)
+    for op in sub_block.ops:
+        for n in op.input_arg_names:
+            if n in seen_r or n in seen_w or n in extra_exclude:
+                continue
+            v = outer_var(n)
+            if v is None:
+                continue
+            seen_r.add(n)
+            if framework.is_float_dtype(v.dtype or "float32"):
+                extras.append(n)
+            else:
+                extras_ng.append(n)
+    return carries, extras, extras_ng
+
+
+class While:
+    """while loop (reference control_flow.py While, while_op.cc).
+
+    cond: bool Variable of shape [1]; the body MUST update it (e.g.
+    `layers.less_than(i, n, cond=cond)`), and every loop-carried var must be
+    assigned a value before the loop.  Not differentiable — use StaticRNN for
+    trainable recurrence.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent_block = program.current_block()
+        sub_block = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        carries, extras, extras_ng = _analyze_sub_block(sub_block)
+        if self.cond_var.name not in carries:
+            raise ValueError(
+                "While body never updates the condition variable "
+                f"{self.cond_var.name!r}; finish the body with e.g. "
+                "layers.less_than(i, n, cond=cond)")
+        parent_block.append_op(
+            "while",
+            inputs={"Condition": [self.cond_var], "Carry": list(carries),
+                    "Extra": extras, "ExtraNG": extras_ng},
+            outputs={"Out": list(carries)},
+            attrs={"sub_block": sub_block.idx, "carry_names": list(carries),
+                   "extra_names": extras, "extra_ng_names": extras_ng,
+                   "cond_name": self.cond_var.name})
+
+
+class ConditionalBlock:
+    """conditional_block (reference conditional_block_op.cc): run the block
+    iff the scalar condition holds; written outer vars keep their prior value
+    otherwise (so they must be initialized before the block)."""
+
+    def __init__(self, inputs, is_scalar_condition=True, name=None):
+        self.inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.helper = LayerHelper("conditional_block", name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent_block = program.current_block()
+        sub_block = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        cond = self.inputs[0]
+        carries, extras, extras_ng = _analyze_sub_block(
+            sub_block, extra_exclude={cond.name})
+        parent_block.append_op(
+            "conditional_block",
+            inputs={"Cond": [cond], "Carry": list(carries), "Extra": extras,
+                    "ExtraNG": extras_ng},
+            outputs={"Out": list(carries)},
+            attrs={"sub_block": sub_block.idx, "carry_names": list(carries),
+                   "extra_names": extras, "extra_ng_names": extras_ng})
+
+
+class Switch:
+    """First-true-wins case dispatch (reference control_flow.py Switch; used
+    by the piecewise/warmup lr schedulers).  Each case becomes a
+    conditional_block guarded by `cond_i AND none-of-the-previous`."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._not_prev = None  # Variable: no previous case matched
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        from . import nn
+
+        if self._not_prev is None:
+            guard_cond = condition
+        else:
+            guard_cond = nn.logical_and(self._not_prev, condition)
+        cb = ConditionalBlock([guard_cond])
+        with cb.block():
+            yield
+        taken_not = nn.logical_not(condition)
+        self._not_prev = (taken_not if self._not_prev is None
+                          else nn.logical_and(self._not_prev, taken_not))
+
+    @contextlib.contextmanager
+    def default(self):
+        if self._not_prev is None:
+            raise ValueError("Switch.default() requires at least one case()")
+        cb = ConditionalBlock([self._not_prev])
+        with cb.block():
+            yield
+
+    # parity: reference Switch is itself used as a context manager
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class StaticRNN:
+    """Static (fixed-length) RNN over a sub-block, lowered to lax.scan
+    (reference control_flow.py StaticRNN / recurrent_op.cc).
+
+    Sequence inputs are time-major: [T, B, ...] — transpose before use, as in
+    the reference's book examples.  Differentiable end-to-end.
+    """
+
+    BEFORE_RNN, IN_RNN, AFTER_RNN = range(3)
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.status = self.BEFORE_RNN
+        self._sub_block = None
+        self._step_ins = []      # (outer seq var, local step var)
+        self._mems = []          # (local mem var, init outer var)
+        self._updates = {}       # local mem name -> local new-value name
+        self._step_outs = []     # local per-step output vars
+        self._outputs = []       # outer stacked output vars
+
+    @contextlib.contextmanager
+    def step(self):
+        program = self.helper.main_program
+        self._parent_block = program.current_block()
+        self._sub_block = program._create_block()
+        self.status = self.IN_RNN
+        try:
+            yield
+        finally:
+            program._rollback()
+            self.status = self.AFTER_RNN
+            self._complete()
+
+    def _assert_in_rnn(self, api):
+        if self.status != self.IN_RNN:
+            raise ValueError(f"StaticRNN.{api} must be called inside step()")
+
+    def step_input(self, x):
+        self._assert_in_rnn("step_input")
+        if x.shape is None or len(x.shape) < 1:
+            raise ValueError("step input needs a known rank")
+        local = self._sub_block.create_var(
+            name=unique_name.generate(x.name + "@step"),
+            shape=tuple(x.shape[1:]), dtype=x.dtype)
+        self._step_ins.append((x, local))
+        return local
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        self._assert_in_rnn("memory")
+        if init is None:
+            raise ValueError(
+                "StaticRNN.memory requires init= on TPU (shape-only boot "
+                "memory would need a data-dependent batch dim)")
+        local = self._sub_block.create_var(
+            name=unique_name.generate(init.name + "@mem"),
+            shape=init.shape, dtype=init.dtype)
+        self._mems.append((local, init))
+        return local
+
+    def update_memory(self, mem, var):
+        self._assert_in_rnn("update_memory")
+        self._updates[mem.name] = var.name
+
+    def step_output(self, o):
+        self._assert_in_rnn("step_output")
+        self._step_outs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        sub, parent = self._sub_block, self._parent_block
+        missing = [m.name for m, _ in self._mems if m.name not in self._updates]
+        if missing:
+            raise ValueError(f"StaticRNN memories never updated: {missing}")
+        local_decl = ({l.name for _, l in self._step_ins}
+                      | {m.name for m, _ in self._mems})
+        carries, extras, extras_ng = _analyze_sub_block(sub)
+        # memory inits are explicit Init inputs, not generic captures
+        init_names = {i.name for _, i in self._mems}
+        extras = [n for n in extras if n not in init_names]
+        extras_ng = [n for n in extras_ng if n not in init_names]
+        if carries:
+            raise ValueError(
+                f"StaticRNN body writes outer vars {carries}; use "
+                "update_memory/step_output instead")
+        self._outputs = []
+        for o in self._step_outs:
+            stacked = parent.create_var(
+                name=unique_name.generate(o.name + "@stacked"),
+                shape=(None if o.shape is None else (-1,) + tuple(o.shape)),
+                dtype=o.dtype)
+            self._outputs.append(stacked)
+        last_mems = [
+            parent.create_var(name=unique_name.generate(m.name + "@last"),
+                              shape=i.shape, dtype=i.dtype)
+            for m, i in self._mems]
+        parent.append_op(
+            "static_rnn",
+            inputs={"StepIn": [x for x, _ in self._step_ins],
+                    "Init": [i for _, i in self._mems],
+                    "Extra": extras, "ExtraNG": extras_ng},
+            outputs={"StackedOut": self._outputs, "LastMem": last_mems},
+            attrs={"sub_block": sub.idx,
+                   "step_in_names": [l.name for _, l in self._step_ins],
+                   "mem_names": [m.name for m, _ in self._mems],
+                   "update_map": dict(self._updates),
+                   "out_names": [o.name for o in self._step_outs],
+                   "extra_names": extras, "extra_ng_names": extras_ng})
+        self.last_memories = last_mems
+
+    def __call__(self):
+        if self.status != self.AFTER_RNN:
+            raise ValueError("call the StaticRNN after its step() block closes")
+        if len(self._outputs) == 1:
+            return self._outputs[0]
+        return list(self._outputs)
+
+
+# ---------------------------------------------------------------------------
+# small helper layers
+# ---------------------------------------------------------------------------
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("increment", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"step": float(value)})
+    return out
+
+
+# comparison layers live in nn.py (with cond=/out= support); re-exported here
+# for reference API parity (control_flow.py also exported them)
+from .nn import (  # noqa: E402,F401
+    equal, greater_equal, greater_than, less_equal, less_than, not_equal,
+)
+
+
+# ---------------------------------------------------------------------------
+# Tensor arrays.  The reference models LOD_TENSOR_ARRAY as a growable list
+# written per while-iteration; XLA needs static shapes, so arrays here are
+# fixed-capacity stacked buffers [cap, ...] written by dynamic index — the
+# pattern lax supports inside compiled control flow.
+# ---------------------------------------------------------------------------
+
+
+def create_array(dtype, initialized_list=None):
+    raise NotImplementedError(
+        "LoDTensorArray is not supported on TPU: growable per-iteration "
+        "arrays need dynamic shapes.  Recurrences: StaticRNN (lax.scan); "
+        "accumulation in a while loop: preallocate a fixed-capacity buffer "
+        "and write with layers.scatter.")
+
+
+def array_write(x, i, array=None):
+    create_array(None)
+
+
+def array_read(array, i):
+    create_array(None)
+
+
+def array_length(array):
+    create_array(None)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable int64 counter incremented once per executed step
+    (reference layers/tensor.py autoincreased_step_counter) — the clock of
+    every lr scheduler."""
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@LR_DECAY_COUNTER@"
+    block = helper.main_program.global_block()
+    if name in block.vars:
+        counter = block.vars[name]
+    else:
+        counter = helper.create_global_variable(
+            name=name, shape=[1], dtype="int64", persistable=True,
+            stop_gradient=True)
+        from ..initializer import Constant
+
+        helper.set_variable_initializer(counter, Constant(float(begin - step)))
+        helper.append_op("increment", inputs={"X": [counter]},
+                         outputs={"Out": [counter]},
+                         attrs={"step": float(step)})
+    return counter

@@ -1,0 +1,135 @@
+"""LR schedulers (reference python/paddle/fluid/layers/learning_rate_scheduler.py).
+
+Each returns a Variable computed from the auto-incremented global step
+counter; the whole schedule compiles into the training step's XLA program
+(no host round-trip per step, unlike the reference's separate-program
+evaluation of the decay ops).
+"""
+
+from __future__ import annotations
+
+import math
+
+from .control_flow import Switch, autoincreased_step_counter
+from . import nn, tensor
+from ..framework import Variable
+
+__all__ = [
+    "exponential_decay", "natural_exp_decay", "inverse_time_decay",
+    "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
+    "linear_lr_warmup",
+]
+
+
+def _step_f64():
+    step = autoincreased_step_counter()
+    return tensor.cast(step, "float32")
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    step = _step_f64()
+    a = nn.pow(step, factor=-0.5)
+    b = step * float(warmup_steps ** -1.5)
+    lr = (float(learning_rate) * float(d_model ** -0.5)) * nn.elementwise_min(a, b)
+    return lr
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _step_f64()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn.floor(div)
+    # decay_rate ** div, with a variable exponent: exp(div * ln(rate))
+    return float(learning_rate) * nn.exp(
+        nn.scale(div, scale=float(math.log(decay_rate))))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _step_f64()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn.floor(div)
+    return float(learning_rate) * nn.exp(nn.scale(div, scale=-float(decay_rate)))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _step_f64()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn.floor(div)
+    denom = nn.scale(div, scale=float(decay_rate), bias=1.0)
+    return float(learning_rate) / denom
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    step = _step_f64()
+    if cycle:
+        ratio = nn.ceil(step / float(decay_steps))
+        ratio = nn.elementwise_max(
+            ratio, tensor.fill_constant([1], "float32", 1.0))
+        decay = ratio * float(decay_steps)
+    else:
+        decay = tensor.fill_constant([1], "float32", float(decay_steps))
+        step = nn.elementwise_min(step, decay)
+    frac = nn.pow(nn.scale(step / decay, scale=-1.0, bias=1.0), factor=power)
+    return (float(learning_rate) - float(end_learning_rate)) * frac + float(
+        end_learning_rate)
+
+
+def piecewise_decay(boundaries, values):
+    """Switch-based staircase — exercises conditional_block on TPU."""
+    if len(values) - len(boundaries) != 1:
+        raise ValueError("len(values) must be len(boundaries) + 1")
+    from ..layer_helper import LayerHelper
+    from ..initializer import Constant
+
+    helper = LayerHelper("piecewise_decay")
+    lr = helper.create_global_variable(
+        name=helper.name + "_lr", shape=[1], dtype="float32",
+        persistable=True, stop_gradient=True)
+    helper.set_variable_initializer(lr, Constant(float(values[0])))
+    step = _step_f64()
+    with Switch() as switch:
+        for b, v in zip(boundaries, values[:-1]):
+            bound = tensor.fill_constant([1], "float32", float(b))
+            with switch.case(nn.less_than(step, bound)):
+                tensor.assign(tensor.fill_constant([1], "float32", float(v)),
+                              output=lr)
+        with switch.default():
+            tensor.assign(
+                tensor.fill_constant([1], "float32", float(values[-1])),
+                output=lr)
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _step_f64()
+    epoch = nn.floor(step / float(step_each_epoch))
+    cos_term = nn.cos(nn.scale(epoch, scale=float(math.pi / epochs)))
+    return 0.5 * float(learning_rate) * nn.scale(cos_term, bias=1.0)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Linear warmup wrapping another schedule (reference
+    learning_rate_scheduler.py linear_lr_warmup, Switch-based)."""
+    from ..layer_helper import LayerHelper
+    from ..initializer import Constant
+
+    helper = LayerHelper("lr_warmup")
+    lr = helper.create_global_variable(
+        name=helper.name + "_lr", shape=[1], dtype="float32",
+        persistable=True, stop_gradient=True)
+    helper.set_variable_initializer(lr, Constant(float(start_lr)))
+    step = _step_f64()
+    if not isinstance(learning_rate, Variable):
+        learning_rate = tensor.fill_constant([1], "float32",
+                                             float(learning_rate))
+    with Switch() as switch:
+        warm = tensor.fill_constant([1], "float32", float(warmup_steps))
+        with switch.case(nn.less_than(step, warm)):
+            ramp = (float(end_lr) - float(start_lr)) * (step / float(warmup_steps))
+            tensor.assign(nn.scale(ramp, bias=float(start_lr)), output=lr)
+        with switch.default():
+            tensor.assign(learning_rate, output=lr)
+    return lr

@@ -34,6 +34,7 @@ __all__ = [
     "shape", "logical_and", "logical_or", "logical_not", "logical_xor",
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "cast", "brelu", "soft_relu", "uniform_random",
+    "floor", "ceil", "round", "cos", "sin", "rsqrt", "reciprocal", "sign",
     "gaussian_random", "sampling_id", "unfold", "group_norm", "sigmoid",
     "tanh", "exp", "log", "sqrt", "square", "abs", "sequence_conv",
     "sequence_pool", "sequence_softmax", "sequence_expand", "sequence_reverse",
@@ -520,50 +521,52 @@ def _elementwise_binary_var(x, y, op_type):
     return _elementwise(op_type, x, y)
 
 
-def _cmp_layer(op_type, x, y, name=None):
+def _cmp_layer(op_type, x, y, name=None, out=None):
     helper = LayerHelper(op_type, name=name)
-    return _single_out_layer(helper, op_type, {"X": [x], "Y": [y]}, dtype="bool")
+    return _single_out_layer(helper, op_type, {"X": [x], "Y": [y]},
+                             dtype="bool", out=out)
 
 
 def equal(x, y, cond=None):
-    return _cmp_layer("equal", x, y)
+    return _cmp_layer("equal", x, y, out=cond)
 
 
 def not_equal(x, y, cond=None):
-    return _cmp_layer("not_equal", x, y)
+    return _cmp_layer("not_equal", x, y, out=cond)
 
 
 def less_than(x, y, cond=None, force_cpu=None):
-    return _cmp_layer("less_than", x, y)
+    return _cmp_layer("less_than", x, y, out=cond)
 
 
 def less_equal(x, y, cond=None):
-    return _cmp_layer("less_equal", x, y)
+    return _cmp_layer("less_equal", x, y, out=cond)
 
 
 def greater_than(x, y, cond=None):
-    return _cmp_layer("greater_than", x, y)
+    return _cmp_layer("greater_than", x, y, out=cond)
 
 
 def greater_equal(x, y, cond=None):
-    return _cmp_layer("greater_equal", x, y)
+    return _cmp_layer("greater_equal", x, y, out=cond)
 
 
 def logical_and(x, y, out=None, name=None):
-    return _cmp_layer("logical_and", x, y)
+    return _cmp_layer("logical_and", x, y, out=out)
 
 
 def logical_or(x, y, out=None, name=None):
-    return _cmp_layer("logical_or", x, y)
+    return _cmp_layer("logical_or", x, y, out=out)
 
 
 def logical_xor(x, y, out=None, name=None):
-    return _cmp_layer("logical_xor", x, y)
+    return _cmp_layer("logical_xor", x, y, out=out)
 
 
 def logical_not(x, out=None, name=None):
     helper = LayerHelper("logical_not")
-    return _single_out_layer(helper, "logical_not", {"X": [x]}, dtype="bool")
+    return _single_out_layer(helper, "logical_not", {"X": [x]}, dtype="bool",
+                             out=out)
 
 
 # activations ---------------------------------------------------------------
@@ -645,6 +648,38 @@ def soft_relu(x, threshold=40.0, name=None):
 
 def pow(x, factor=1.0, name=None):
     return _act_layer("pow", x, {"factor": factor}, name)
+
+
+def floor(x, name=None):
+    return _act_layer("floor", x, name=name)
+
+
+def ceil(x, name=None):
+    return _act_layer("ceil", x, name=name)
+
+
+def round(x, name=None):
+    return _act_layer("round", x, name=name)
+
+
+def cos(x, name=None):
+    return _act_layer("cos", x, name=name)
+
+
+def sin(x, name=None):
+    return _act_layer("sin", x, name=name)
+
+
+def rsqrt(x, name=None):
+    return _act_layer("rsqrt", x, name=name)
+
+
+def reciprocal(x, name=None):
+    return _act_layer("reciprocal", x, name=name)
+
+
+def sign(x, name=None):
+    return _act_layer("sign", x, name=name)
 
 
 def prelu(x, mode="all", param_attr=None, name=None):

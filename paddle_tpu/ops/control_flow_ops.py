@@ -1,0 +1,153 @@
+"""Control-flow ops: while / conditional_block / static_rnn.
+
+Reference analogs: paddle/fluid/operators/controlflow/while_op.cc (runs a
+sub-block with an inner Executor per iteration, scopes chained),
+conditional_block_op.cc, and recurrent_op.cc (static RNN over a sub-block).
+
+TPU-native redesign: each op still owns a sub-block of op descs (so
+transpilers see and can rewrite the loop body), but the lowering is a
+*functional* XLA control-flow primitive:
+
+  while             → lax.while_loop   (not differentiable; use static_rnn
+                                        for trainable recurrence)
+  conditional_block → lax.cond         (differentiable through both branches)
+  static_rnn        → lax.scan         (differentiable; the TPU-idiomatic
+                                        recurrence — compiler-friendly, no
+                                        per-step dispatch like while_op.cc)
+
+Crucial design point: the reference's sub-blocks read enclosing-scope
+variables implicitly; XLA control flow is functional, so the Python layer
+(fluid/layers/control_flow.py) performs capture analysis and declares every
+external read as an explicit op input:
+
+  Carry*   — loop-carried vars (written in the body, live in an outer block)
+  Extra*   — read-only float captures (weights!) — declared so append_backward
+             emits grads for them through the auto-vjp grad op
+  ExtraNG* — read-only non-float captures (int ids, masks)
+
+Name lists ride in attrs so the lowering can rebuild the sub-block's env
+without relying on ambient state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.fluid.registry import register_op, simple_op
+
+
+def _sub_env(attrs, carries, extras, extras_ng):
+    env = dict(zip(attrs["extra_names"], extras or []))
+    env.update(zip(attrs["extra_ng_names"], extras_ng or []))
+    env.update(zip(attrs["carry_names"], carries or []))
+    return env
+
+
+def _trace_sub(ctx, sub_block, env):
+    from paddle_tpu.fluid.executor import trace_block
+
+    sub_ctx = type(ctx)(step=ctx.step, is_test=ctx.is_test,
+                        executor=ctx.executor, block=sub_block,
+                        mesh_axes=ctx.mesh_axes, env=env)
+    sub_ctx.program = sub_block.program
+    trace_block(sub_block, env, sub_ctx)
+    return env
+
+
+def _as_pred(c):
+    return jnp.reshape(c, ()).astype(bool)
+
+
+@simple_op("while", ["Condition", "Carry*", "Extra*", "ExtraNG*"], ["Out*"],
+           grad=None)
+def _while(ctx, cond, carries, extras, extras_ng, attrs):
+    """Run sub_block until the carried condition var goes false.
+
+    The condition var MUST be among the carries (the body re-computes it, the
+    standard Fluid pattern: `layers.less_than(i, n, cond=cond)` at body end).
+    """
+    sub = ctx.block.program.block(attrs["sub_block"])
+    carry_names = attrs["carry_names"]
+    cond_name = attrs["cond_name"]
+    if cond_name not in carry_names:
+        raise ValueError(
+            f"while: condition var {cond_name!r} is never written in the loop "
+            f"body (infinite loop) — update it, e.g. layers.less_than(i, n, "
+            f"cond=cond)")
+    ci = carry_names.index(cond_name)
+    base = _sub_env(attrs, [], extras, extras_ng)
+
+    def cond_fn(c):
+        return _as_pred(c[ci])
+
+    def body_fn(c):
+        env = dict(base)
+        env.update(zip(carry_names, c))
+        _trace_sub(ctx, sub, env)
+        return tuple(env[n] for n in carry_names)
+
+    final = lax.while_loop(cond_fn, body_fn, tuple(map(jnp.asarray, carries)))
+    return (tuple(final),)
+
+
+@simple_op("conditional_block", ["Cond", "Carry*", "Extra*", "ExtraNG*"],
+           ["Out*"], no_grad_inputs=("Cond", "ExtraNG"))
+def _conditional_block(ctx, cond, carries, extras, extras_ng, attrs):
+    """Out_i = cond ? sub_block(...)[carry_i] : carry_i.
+
+    Both branches are compiled (lax.cond); the false branch passes the
+    carried values through unchanged — same observable behavior as the
+    reference's skip-the-block, expressed functionally.
+    """
+    sub = ctx.block.program.block(attrs["sub_block"])
+    carry_names = attrs["carry_names"]
+
+    def true_fn(c, ex):
+        env = dict(zip(attrs["extra_names"], ex))
+        env.update(zip(attrs["extra_ng_names"], extras_ng or []))
+        env.update(zip(carry_names, c))
+        _trace_sub(ctx, sub, env)
+        return tuple(env[n] for n in carry_names)
+
+    def false_fn(c, ex):
+        return tuple(c)
+
+    outs = lax.cond(_as_pred(cond), true_fn, false_fn,
+                    tuple(carries), tuple(extras or []))
+    return (tuple(outs),)
+
+
+@simple_op("static_rnn", ["StepIn*", "Init*", "Extra*", "ExtraNG*"],
+           ["StackedOut*", "LastMem*"], no_grad_inputs=("ExtraNG",))
+def _static_rnn(ctx, step_ins, inits, extras, extras_ng, attrs):
+    """lax.scan over dim 0 of the step inputs.
+
+    attrs: sub_block, step_in_names (local per-step var names), mem_names
+    (local memory var names, carried), update_map (mem local name → local name
+    of its next value), out_names (local per-step output var names).
+    Outputs: per-step outputs stacked on dim 0, and the final memory values.
+    Fully differentiable (jax.vjp through scan) — this is the trainable
+    recurrence, unlike `while`.
+    """
+    sub = ctx.block.program.block(attrs["sub_block"])
+    step_in_names = attrs["step_in_names"]
+    mem_names = attrs["mem_names"]
+    update_map = attrs["update_map"]
+    out_names = attrs["out_names"]
+    base = {}
+    base.update(zip(attrs["extra_names"], extras or []))
+    base.update(zip(attrs["extra_ng_names"], extras_ng or []))
+
+    def f(mems, xs):
+        env = dict(base)
+        env.update(zip(mem_names, mems))
+        env.update(zip(step_in_names, xs))
+        _trace_sub(ctx, sub, env)
+        new_mems = tuple(env[update_map[m]] for m in mem_names)
+        outs = tuple(env[n] for n in out_names)
+        return new_mems, outs
+
+    final_mems, stacked = lax.scan(f, tuple(inits), tuple(step_ins))
+    return (tuple(stacked), tuple(final_mems))

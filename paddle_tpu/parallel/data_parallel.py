@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from paddle_tpu.fluid import registry
 from paddle_tpu.fluid.framework import grad_var_name
 from . import mesh as pmesh
 
@@ -132,43 +131,27 @@ class _ShardedBlock:
         import jax
         from jax.sharding import PartitionSpec as P
 
-        from paddle_tpu.fluid.executor import _analyze_block, _prune_ops, trace_block
+        from paddle_tpu.fluid.executor import BlockPlan
 
-        block = program.global_block()
-        self.feed_names = list(feed_names)
-        self.fetch_names = list(fetch_names)
-        self.ops = _prune_ops(block, fetch_names)
-        scope_reads, writes = _analyze_block(self.ops, block, self.feed_names)
-        missing = [n for n in scope_reads if scope.get(n) is None]
-        if missing:
-            raise RuntimeError(
-                f"Variables {missing} must exist in scope before running "
-                f"(did you run the startup program?)")
-        self.donated_names = [n for n in scope_reads if n in set(writes)]
-        self.readonly_names = [n for n in scope_reads if n not in set(writes)]
-        self.write_names = list(writes)
+        plan = BlockPlan(program, program.global_block(), feed_names,
+                         fetch_names, scope)
+        self.feed_names = plan.feed_names
+        self.fetch_names = plan.fetch_names
+        self.ops = plan.ops
+        self.donated_names = plan.donated_names
+        self.readonly_names = plan.readonly_names
+        self.write_names = plan.write_names
         axis = pmesh.DATA_AXIS
-        is_test = getattr(program, "_is_test", False)
-        fetch_names_ = self.fetch_names
-        write_names_ = self.write_names
+        inner = plan.make_body(mesh_axes=(axis,))
 
         def body(donated, readonly, feeds, step):
-            env = {}
-            env.update(donated)
-            env.update(readonly)
-            env.update(feeds)
-            ctx = registry.LowerContext(step=step, is_test=is_test, block=block,
-                                        mesh_axes=(axis,))
-            ctx.program = program
-            trace_block(block, env, ctx, ops=self.ops)
             import jax.numpy as jnp
 
-            fetches = []
-            for n in fetch_names_:
-                v = env[n]
-                fetches.append(jnp.reshape(v, (1,) + tuple(jnp.shape(v)))
-                               if jnp.ndim(v) == 0 else v)
-            out_writes = {n: env[n] for n in write_names_ if n in env}
+            raw_fetches, out_writes = inner(donated, readonly, feeds, step)
+            # scalar fetches become per-device [1] vectors so the dp-axis
+            # concat (FetchOpHandle semantics) has a dim to stack on
+            fetches = [jnp.reshape(v, (1,) + tuple(jnp.shape(v)))
+                       if jnp.ndim(v) == 0 else v for v in raw_fetches]
             return fetches, out_writes
 
         in_specs = (
@@ -177,7 +160,8 @@ class _ShardedBlock:
             {n: P(axis) for n in self.feed_names},
             P(),
         )
-        out_specs = ([P(axis) for _ in fetch_names_], {n: P() for n in write_names_})
+        out_specs = ([P(axis) for _ in self.fetch_names],
+                     {n: P() for n in self.write_names})
         sharded = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                                 out_specs=out_specs, check_vma=False)
         self._jitted = jax.jit(sharded, donate_argnums=(0,))

@@ -28,7 +28,6 @@ import warnings
 
 import numpy as np
 
-from paddle_tpu.fluid import registry
 from . import mesh as pmesh
 
 __all__ = [
@@ -175,34 +174,14 @@ class HybridParallelRunner:
 
     def _compile(self, scope, feed_names, fetch_names):
         import jax
-        from paddle_tpu.fluid.executor import (_analyze_block, _prune_ops,
-                                               trace_block)
+        from paddle_tpu.fluid.executor import BlockPlan
 
         program, mesh = self.program, self.mesh
-        block = program.global_block()
-        ops = _prune_ops(block, fetch_names)
-        scope_reads, writes = _analyze_block(ops, block, feed_names)
-        missing = [n for n in scope_reads if scope.get(n) is None]
-        if missing:
-            raise RuntimeError(
-                f"Variables {missing} must exist in scope before running "
-                f"(did you run the startup program?)")
-        wset = set(writes)
-        donated = [n for n in scope_reads if n in wset]
-        readonly = [n for n in scope_reads if n not in wset]
-        is_test = getattr(program, "_is_test", False)
-
-        def body(don, ro, feeds, step):
-            env = {}
-            env.update(don)
-            env.update(ro)
-            env.update(feeds)
-            ctx = registry.LowerContext(step=step, is_test=is_test, block=block)
-            ctx.program = program
-            trace_block(block, env, ctx, ops=ops)
-            fetches = [env[n] for n in fetch_names]
-            out_writes = {n: env[n] for n in writes if n in env}
-            return fetches, out_writes
+        plan = BlockPlan(program, program.global_block(), feed_names,
+                         fetch_names, scope)
+        body = plan.make_body()
+        donated, readonly = plan.donated_names, plan.readonly_names
+        writes = plan.write_names
 
         def shard_of(n, v):
             return self._param_sharding(n, tuple(np.shape(v)))

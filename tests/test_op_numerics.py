@@ -1,0 +1,714 @@
+"""Per-op numeric tests through the OpTest harness.
+
+Mirrors the reference's ~300 test_*_op.py files (reference
+python/paddle/fluid/tests/unittests/): each test declares inputs/expected
+outputs for one op, checks the forward against numpy, and checks analytic
+gradients against central differences.
+"""
+
+import numpy as np
+
+from op_test import OpTest
+
+RNG = np.random.RandomState
+
+
+def softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# elementwise
+# ---------------------------------------------------------------------------
+
+
+class TestElementwiseAdd(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_add"
+        x = RNG(0).uniform(-1, 1, (3, 4)).astype("float32")
+        y = RNG(1).uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_add"
+        x = RNG(0).uniform(-1, 1, (2, 3, 4)).astype("float32")
+        y = RNG(1).uniform(-1, 1, (3,)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseMul(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_mul"
+        x = RNG(2).uniform(-1, 1, (3, 4)).astype("float32")
+        y = RNG(3).uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseDiv(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_div"
+        x = RNG(4).uniform(0.5, 2, (3, 4)).astype("float32")
+        y = RNG(5).uniform(0.5, 2, (3, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+class TestElementwiseMax(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_max"
+        x = RNG(6).uniform(-1, 1, (3, 4)).astype("float32")
+        y = RNG(7).uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.maximum(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestElementwisePow(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_pow"
+        x = RNG(8).uniform(0.5, 2, (3, 4)).astype("float32")
+        y = RNG(9).uniform(0.5, 2, (3, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.power(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+
+
+class TestMul(OpTest):
+    def setUp(self):
+        self.op_type = "mul"
+        x = RNG(10).uniform(-1, 1, (3, 4)).astype("float32")
+        y = RNG(11).uniform(-1, 1, (4, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMulColDims(OpTest):
+    def setUp(self):
+        self.op_type = "mul"
+        x = RNG(12).uniform(-1, 1, (2, 3, 4)).astype("float32")
+        y = RNG(13).uniform(-1, 1, (4, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 2}
+        self.outputs = {"Out": (x.reshape(6, 4) @ y).reshape(2, 3, 5)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMatmulTranspose(OpTest):
+    def setUp(self):
+        self.op_type = "matmul"
+        x = RNG(14).uniform(-1, 1, (4, 3)).astype("float32")
+        y = RNG(15).uniform(-1, 1, (5, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": x.T @ y.T}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmulBatched(OpTest):
+    def setUp(self):
+        self.op_type = "matmul"
+        x = RNG(16).uniform(-1, 1, (2, 3, 4)).astype("float32")
+        y = RNG(17).uniform(-1, 1, (2, 4, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.matmul(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+
+def _act_case(name, op_type, fn, lo=-1.0, hi=1.0, grad=True, rel=0.01):
+    class _T(OpTest):
+        def setUp(self):
+            self.op_type = op_type
+            x = RNG(hash(op_type) % 2**31).uniform(lo, hi, (3, 4)).astype("float32")
+            self.inputs = {"X": x}
+            self.outputs = {"Out": fn(x)}
+
+        def test_output(self):
+            self.check_output(atol=1e-5, rtol=1e-4)
+
+        if grad:
+            def test_grad(self):
+                self.check_grad(["X"], "Out", max_relative_error=rel)
+
+    _T.__name__ = name
+    return _T
+
+
+TestSigmoid = _act_case("TestSigmoid", "sigmoid", lambda x: 1 / (1 + np.exp(-x)))
+TestTanh = _act_case("TestTanh", "tanh", np.tanh)
+TestExp = _act_case("TestExp", "exp", np.exp)
+TestLog = _act_case("TestLog", "log", np.log, lo=0.5, hi=2.0, rel=0.02)
+TestSqrt = _act_case("TestSqrt", "sqrt", np.sqrt, lo=0.5, hi=2.0, rel=0.02)
+TestSquare = _act_case("TestSquare", "square", np.square)
+TestAbs = _act_case("TestAbs", "abs", np.abs, lo=0.3, hi=1.0)
+TestRelu = _act_case("TestRelu", "relu", lambda x: np.maximum(x, 0), grad=False)
+TestRelu6 = _act_case("TestRelu6", "relu6", lambda x: np.clip(x, 0, 6), grad=False)
+TestReciprocal = _act_case("TestReciprocal", "reciprocal", lambda x: 1 / x,
+                           lo=0.5, hi=2.0, rel=0.02)
+TestSoftplusLike = _act_case("TestLeakyRelu", "leaky_relu",
+                             lambda x: np.where(x >= 0, x, 0.02 * x), grad=False)
+
+
+class TestGelu(OpTest):
+    def setUp(self):
+        self.op_type = "gelu"
+        from scipy.special import erf  # scipy is available transitively; fallback below
+        x = RNG(21).uniform(-2, 2, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": 0.5 * x * (1 + erf(x / np.sqrt(2)))}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-3)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestSoftmax(OpTest):
+    def setUp(self):
+        self.op_type = "softmax"
+        x = RNG(22).uniform(-2, 2, (3, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": softmax_np(x)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        # sum(softmax) has an identically-zero gradient (rows sum to 1), so
+        # weight the loss to make the gradient informative.
+        w = RNG(99).uniform(0.5, 1.5, (3, 5)).astype("float32")
+        self.check_grad(["X"], "Out", max_relative_error=0.02, loss_weights=w)
+
+
+class TestLogSoftmax(OpTest):
+    def setUp(self):
+        self.op_type = "log_softmax"
+        x = RNG(23).uniform(-2, 2, (3, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.log(softmax_np(x))}
+
+    def test_output(self):
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# reductions & scale
+# ---------------------------------------------------------------------------
+
+
+class TestScale(OpTest):
+    def setUp(self):
+        self.op_type = "scale"
+        x = RNG(24).uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 0.5}
+        self.outputs = {"Out": 2.5 * x + 0.5}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestMean(OpTest):
+    def setUp(self):
+        self.op_type = "mean"
+        x = RNG(25).uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray(x.mean(), dtype="float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceSumDim(OpTest):
+    def setUp(self):
+        self.op_type = "reduce_sum"
+        x = RNG(26).uniform(-1, 1, (2, 3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+        self.outputs = {"Out": x.sum(axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMeanKeepdim(OpTest):
+    def setUp(self):
+        self.op_type = "reduce_mean"
+        x = RNG(27).uniform(-1, 1, (2, 3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [0, 2], "keep_dim": True, "reduce_all": False}
+        self.outputs = {"Out": x.mean(axis=(0, 2), keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReduceMax(OpTest):
+    def setUp(self):
+        self.op_type = "reduce_max"
+        x = RNG(28).uniform(-1, 1, (2, 3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [2], "keep_dim": False, "reduce_all": False}
+        self.outputs = {"Out": x.max(axis=2)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSumVariadic(OpTest):
+    def setUp(self):
+        self.op_type = "sum"
+        xs = [RNG(30 + i).uniform(-1, 1, (3, 4)).astype("float32") for i in range(3)]
+        self.inputs = {"X": [(f"sum_x{i}", a) for i, a in enumerate(xs)]}
+        self.outputs = {"Out": xs[0] + xs[1] + xs[2]}
+
+    def test_output(self):
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+class TestCrossEntropy(OpTest):
+    def setUp(self):
+        self.op_type = "cross_entropy"
+        probs = softmax_np(RNG(33).uniform(-1, 1, (4, 5)).astype("float32"))
+        label = RNG(34).randint(0, 5, (4, 1)).astype("int64")
+        y = -np.log(probs[np.arange(4), label.ravel()]).reshape(4, 1).astype("float32")
+        self.inputs = {"X": probs.astype("float32"), "Label": label}
+        self.outputs = {"Y": y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Y", max_relative_error=0.02)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    def setUp(self):
+        self.op_type = "softmax_with_cross_entropy"
+        logits = RNG(35).uniform(-2, 2, (4, 5)).astype("float32")
+        label = RNG(36).randint(0, 5, (4, 1)).astype("int64")
+        sm = softmax_np(logits)
+        loss = -np.log(sm[np.arange(4), label.ravel()]).reshape(4, 1).astype("float32")
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm.astype("float32"), "Loss": loss}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["Logits"], "Loss", max_relative_error=0.02)
+
+
+class TestSigmoidCrossEntropyWithLogits(OpTest):
+    def setUp(self):
+        self.op_type = "sigmoid_cross_entropy_with_logits"
+        x = RNG(37).uniform(-2, 2, (4, 5)).astype("float32")
+        label = RNG(38).uniform(0, 1, (4, 5)).astype("float32")
+        sig = 1 / (1 + np.exp(-x))
+        out = -label * np.log(sig) - (1 - label) * np.log(1 - sig)
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-3)
+
+
+class TestSquareErrorCost(OpTest):
+    def setUp(self):
+        self.op_type = "square_error_cost"
+        x = RNG(39).uniform(-1, 1, (4, 3)).astype("float32")
+        y = RNG(40).uniform(-1, 1, (4, 3)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (x - y) ** 2}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestHuberLoss(OpTest):
+    def setUp(self):
+        self.op_type = "huber_loss"
+        x = RNG(41).uniform(-1, 1, (4, 1)).astype("float32")
+        y = RNG(42).uniform(-1, 1, (4, 1)).astype("float32")
+        d = 1.0
+        r = y - x
+        out = np.where(np.abs(r) <= d, 0.5 * r * r, d * (np.abs(r) - 0.5 * d))
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"delta": d}
+        self.outputs = {"Out": out.astype("float32"), "Residual": r}
+
+    def test_output(self):
+        self.check_output(no_check_set=["Residual"])
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+class TestLayerNorm(OpTest):
+    def setUp(self):
+        self.op_type = "layer_norm"
+        x = RNG(43).uniform(-1, 1, (3, 8)).astype("float32")
+        scale = RNG(44).uniform(0.5, 1.5, (8,)).astype("float32")
+        bias = RNG(45).uniform(-0.5, 0.5, (8,)).astype("float32")
+        eps = 1e-5
+        mean = x.mean(axis=1, keepdims=True)
+        var = x.var(axis=1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps, "begin_norm_axis": 1}
+        self.outputs = {"Y": y.astype("float32"), "Mean": mean.ravel(),
+                        "Variance": var.ravel()}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-3, no_check_set=["Mean", "Variance"])
+
+    def test_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.03)
+
+
+class TestL2Normalize(OpTest):
+    def setUp(self):
+        self.op_type = "l2_normalize"
+        x = RNG(46).uniform(-1, 1, (3, 6)).astype("float32")
+        norm = np.sqrt((x * x).sum(axis=1, keepdims=True) + 1e-12)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": (x / norm).astype("float32"), "Norm": norm}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4, no_check_set=["Norm"])
+
+
+# ---------------------------------------------------------------------------
+# conv / pool
+# ---------------------------------------------------------------------------
+
+
+def conv2d_np(x, w, stride=1, pad=0):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oc, oh, ow), dtype=x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh, j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, w)
+    return out
+
+
+class TestConv2D(OpTest):
+    def setUp(self):
+        self.op_type = "conv2d"
+        x = RNG(47).uniform(-1, 1, (2, 3, 5, 5)).astype("float32")
+        w = RNG(48).uniform(-0.5, 0.5, (4, 3, 3, 3)).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1], "groups": 1,
+                      "dilations": [1, 1]}
+        self.outputs = {"Output": conv2d_np(x, w, stride=1, pad=1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-3)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], "Output", max_relative_error=0.03)
+
+
+class TestConv2DStride2(OpTest):
+    def setUp(self):
+        self.op_type = "conv2d"
+        x = RNG(49).uniform(-1, 1, (1, 2, 6, 6)).astype("float32")
+        w = RNG(50).uniform(-0.5, 0.5, (3, 2, 3, 3)).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2], "paddings": [0, 0], "groups": 1,
+                      "dilations": [1, 1]}
+        self.outputs = {"Output": conv2d_np(x, w, stride=2, pad=0)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-3)
+
+
+class TestPool2DAvg(OpTest):
+    def setUp(self):
+        self.op_type = "pool2d"
+        x = RNG(51).uniform(-1, 1, (2, 3, 4, 4)).astype("float32")
+        out = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+                      "paddings": [0, 0]}
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestPool2DMax(OpTest):
+    def setUp(self):
+        self.op_type = "pool2d"
+        x = RNG(52).uniform(-1, 1, (2, 3, 4, 4)).astype("float32")
+        out = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+                      "paddings": [0, 0]}
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# shape / data movement
+# ---------------------------------------------------------------------------
+
+
+class TestTranspose(OpTest):
+    def setUp(self):
+        self.op_type = "transpose"
+        x = RNG(53).uniform(-1, 1, (2, 3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [1, 0, 2]}
+        self.outputs = {"Out": x.transpose(1, 0, 2)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReshape(OpTest):
+    def setUp(self):
+        self.op_type = "reshape"
+        x = RNG(54).uniform(-1, 1, (2, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [3, 4]}
+        self.outputs = {"Out": x.reshape(3, 4)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestConcat(OpTest):
+    def setUp(self):
+        self.op_type = "concat"
+        xs = [RNG(55 + i).uniform(-1, 1, (2, i + 2)).astype("float32") for i in range(3)]
+        self.inputs = {"X": [(f"cc_x{i}", a) for i, a in enumerate(xs)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate(xs, axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSplit(OpTest):
+    def setUp(self):
+        self.op_type = "split"
+        x = RNG(58).uniform(-1, 1, (2, 6)).astype("float32")
+        parts = np.split(x, 3, axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"num": 3, "axis": 1}
+        self.outputs = {"Out": [(f"sp_out{i}", p) for i, p in enumerate(parts)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSlice(OpTest):
+    def setUp(self):
+        self.op_type = "slice"
+        x = RNG(59).uniform(-1, 1, (3, 4, 5)).astype("float32")
+        self.inputs = {"Input": x}
+        self.attrs = {"axes": [0, 2], "starts": [1, 0], "ends": [3, 3]}
+        self.outputs = {"Out": x[1:3, :, 0:3]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestGather(OpTest):
+    def setUp(self):
+        self.op_type = "gather"
+        x = RNG(60).uniform(-1, 1, (5, 3)).astype("float32")
+        idx = np.array([0, 2, 4], dtype="int64")
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCast(OpTest):
+    def setUp(self):
+        self.op_type = "cast"
+        x = RNG(61).uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"out_dtype": "float64" if False else "int32"}
+        self.outputs = {"Out": x.astype("int32")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestClip(OpTest):
+    def setUp(self):
+        self.op_type = "clip"
+        x = RNG(62).uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"min": -0.5, "max": 0.5}
+        self.outputs = {"Out": np.clip(x, -0.5, 0.5)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestStack(OpTest):
+    def setUp(self):
+        self.op_type = "stack"
+        xs = [RNG(63 + i).uniform(-1, 1, (3, 4)).astype("float32") for i in range(3)]
+        self.inputs = {"X": [(f"st_x{i}", a) for i, a in enumerate(xs)]}
+        self.attrs = {"axis": 0}
+        self.outputs = {"Y": np.stack(xs, axis=0)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSqueeze(OpTest):
+    def setUp(self):
+        self.op_type = "squeeze"
+        x = RNG(66).uniform(-1, 1, (3, 1, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axes": [1]}
+        self.outputs = {"Out": x.reshape(3, 4)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCumsum(OpTest):
+    def setUp(self):
+        self.op_type = "cumsum"
+        x = RNG(67).uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.cumsum(x, axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestLookupTable(OpTest):
+    def setUp(self):
+        self.op_type = "lookup_table"
+        w = RNG(68).uniform(-1, 1, (10, 4)).astype("float32")
+        ids = np.array([[1], [3], [5]], dtype="int64")
+        self.inputs = {"W": w, "Ids": ids}
+        # v1 semantics (reference lookup_table_op.cc): trailing [N,1] ids dim
+        # is squeezed, Out is [N, emb_dim]
+        self.outputs = {"Out": w[ids.ravel()]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestOneHot(OpTest):
+    def setUp(self):
+        self.op_type = "one_hot"
+        ids = np.array([[0], [2], [1]], dtype="int64")
+        out = np.zeros((3, 4), dtype="float32")
+        out[np.arange(3), ids.ravel()] = 1.0
+        self.inputs = {"X": ids}
+        self.attrs = {"depth": 4}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()

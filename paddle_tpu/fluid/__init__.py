@@ -29,6 +29,7 @@ from .layer_helper import LayerHelper  # noqa: F401
 from . import nets  # noqa: F401
 from . import compiler  # noqa: F401
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
+from . import io  # noqa: F401
 from .layers.io import data  # noqa: F401
 
 __all__ = [

@@ -1,0 +1,312 @@
+"""Model / checkpoint IO: save+load variables, programs, inference models.
+
+Reference analog: python/paddle/fluid/io.py — save_vars:109,
+save_persistables:477, load_persistables:718, save_inference_model:925,
+load_inference_model:1116.  The reference implements persistence as `save` /
+`load` *ops* appended to a program and run by the C++ executor
+(operators/save_combine_op.cc).  TPU-native redesign: persistence is a
+host-side scope operation — parameters live as device-resident jax.Arrays in
+the Scope, and checkpointing pulls them to host and writes npz (single-file
+"combine" form) or one .npy per var, outside the compiled computation (XLA
+programs are pure; IO does not belong in the traced graph).  The program
+itself serializes to a JSON desc — the ProgramDesc-protobuf equivalent —
+written as `__model__`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from . import framework
+from .executor import global_scope
+from .framework import Parameter, Program, Variable
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables",
+    "load_vars", "load_params", "load_persistables",
+    "save_inference_model", "load_inference_model",
+    "program_to_dict", "program_from_dict",
+    "save_program", "load_program",
+]
+
+MODEL_FILENAME = "__model__"
+PARAMS_FILENAME = "__params__.npz"
+
+
+# ---------------------------------------------------------------------------
+# Program (de)serialization — the framework.proto ProgramDesc equivalent.
+# ---------------------------------------------------------------------------
+
+
+def _json_attr(v):
+    """Sanitize op attr values for JSON round-trip."""
+    if isinstance(v, np.ndarray):
+        return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (list, tuple)):
+        return [_json_attr(x) for x in v]
+    return v
+
+
+def _unjson_attr(v):
+    if isinstance(v, dict) and "__ndarray__" in v:
+        return np.asarray(v["__ndarray__"], dtype=v["dtype"])
+    if isinstance(v, list):
+        return [_unjson_attr(x) for x in v]
+    return v
+
+
+def program_to_dict(program: Program) -> dict:
+    blocks = []
+    for b in program.blocks:
+        vars_ = []
+        for v in b.vars.values():
+            vars_.append({
+                "name": v.name,
+                "shape": list(v.shape) if v.shape is not None else None,
+                "dtype": v.dtype,
+                "lod_level": v.lod_level,
+                "persistable": bool(v.persistable),
+                "stop_gradient": bool(v.stop_gradient),
+                "is_data": bool(v.is_data),
+                "trainable": bool(getattr(v, "trainable", True)),
+                "is_parameter": isinstance(v, Parameter),
+                "type": v.type,
+            })
+        ops = []
+        for op in b.ops:
+            ops.append({
+                "type": op.type,
+                "inputs": {k: list(vv) for k, vv in op.inputs.items()},
+                "outputs": {k: list(vv) for k, vv in op.outputs.items()},
+                "attrs": {k: _json_attr(vv) for k, vv in op.attrs.items()},
+            })
+        blocks.append({"idx": b.idx, "parent_idx": b.parent_idx,
+                       "vars": vars_, "ops": ops})
+    return {"version": 1, "blocks": blocks,
+            "random_seed": program.random_seed,
+            "is_test": bool(getattr(program, "_is_test", False))}
+
+
+def program_from_dict(d: dict) -> Program:
+    from .framework import Block, Operator
+
+    p = Program()
+    p.random_seed = d.get("random_seed", 0)
+    p._is_test = d.get("is_test", False)
+    p.blocks = []
+    for bd in d["blocks"]:
+        b = Block(p, bd["idx"], bd["parent_idx"])
+        for vd in bd["vars"]:
+            cls = Parameter if vd.get("is_parameter") else Variable
+            v = cls.__new__(cls)
+            Variable.__init__(
+                v, b, name=vd["name"],
+                shape=vd["shape"], dtype=vd["dtype"],
+                lod_level=vd.get("lod_level", 0),
+                persistable=vd.get("persistable", False),
+                stop_gradient=vd.get("stop_gradient", False),
+                is_data=vd.get("is_data", False),
+                trainable=vd.get("trainable", True),
+                type=vd.get("type"))
+            if isinstance(v, Parameter):
+                v.regularizer = None
+                v.optimize_attr = {"learning_rate": 1.0}
+                v.do_model_average = None
+            b.vars[v.name] = v
+        for od in bd["ops"]:
+            op = Operator.__new__(Operator)
+            op.block = b
+            op.type = od["type"]
+            op.inputs = {k: list(vv) for k, vv in od["inputs"].items()}
+            op.outputs = {k: list(vv) for k, vv in od["outputs"].items()}
+            op.attrs = {k: _unjson_attr(vv) for k, vv in od["attrs"].items()}
+            b.ops.append(op)
+        p.blocks.append(b)
+    p.current_block_idx = 0
+    p._bump_version()
+    return p
+
+
+def save_program(program: Program, path: str):
+    with open(path, "w") as f:
+        json.dump(program_to_dict(program), f)
+
+
+def load_program(path: str) -> Program:
+    with open(path) as f:
+        return program_from_dict(json.load(f))
+
+
+# ---------------------------------------------------------------------------
+# Variable persistence
+# ---------------------------------------------------------------------------
+
+
+def _is_persistable(var):
+    return var.persistable and not var.is_data and var.name not in ("feed", "fetch")
+
+
+def _is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _collect_vars(main_program, vars=None, predicate=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is not None:
+        out = []
+        for v in vars:
+            out.append(v if isinstance(v, Variable) else main_program.global_block().var(v))
+        return out
+    pred = predicate or _is_persistable
+    return [v for v in main_program.list_vars() if pred(v)]
+
+
+def _npz_path(dirname, filename):
+    """np.savez appends .npz when absent — resolve to the file that exists."""
+    path = os.path.join(dirname, filename)
+    if os.path.exists(path):
+        return path
+    if not path.endswith(".npz") and os.path.exists(path + ".npz"):
+        return path + ".npz"
+    return path
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    """Save selected vars from the scope.  filename=None → one .npy per var
+    (reference's save_op per var); filename set → combined npz (save_combine)."""
+    scope = scope or global_scope()
+    vars = _collect_vars(main_program, vars, predicate)
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for v in vars:
+        val = scope.get(v.name)
+        if val is None:
+            raise RuntimeError(f"variable {v.name} has no value in scope; "
+                               f"run the startup program before saving")
+        arrays[v.name] = np.asarray(val)
+    if filename is None:
+        for name, arr in arrays.items():
+            np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"), arr)
+    else:
+        np.savez(os.path.join(dirname, filename), **arrays)
+    return sorted(arrays)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    scope = scope or global_scope()
+    vars = _collect_vars(main_program, vars, predicate)
+    if filename is not None:
+        path = _npz_path(dirname, filename)
+        data = np.load(path, allow_pickle=False)
+        for v in vars:
+            if v.name not in data:
+                raise RuntimeError(f"variable {v.name} not found in {path}")
+            scope.set(v.name, data[v.name])
+    else:
+        for v in vars:
+            path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
+            if not os.path.exists(path):
+                raise RuntimeError(f"variable file {path} not found")
+            scope.set(v.name, np.load(path))
+    return sorted(v.name for v in vars)
+
+
+def save_params(executor, dirname, main_program=None, filename=None, scope=None):
+    return save_vars(executor, dirname, main_program, predicate=_is_parameter,
+                     filename=filename, scope=scope)
+
+
+def load_params(executor, dirname, main_program=None, filename=None, scope=None):
+    return load_vars(executor, dirname, main_program, predicate=_is_parameter,
+                     filename=filename, scope=scope)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None, scope=None):
+    """Save every persistable var (params + optimizer accumulators + BN stats)
+    — the checkpoint/resume entry point (reference io.py:477)."""
+    return save_vars(executor, dirname, main_program, predicate=_is_persistable,
+                     filename=filename, scope=scope)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None, scope=None):
+    return load_vars(executor, dirname, main_program, predicate=_is_persistable,
+                     filename=filename, scope=scope)
+
+
+# ---------------------------------------------------------------------------
+# Inference model
+# ---------------------------------------------------------------------------
+
+
+def _prune_for_inference(program, feed_names, target_names):
+    """Clone for test + keep only ops needed to compute the targets."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(target_names)
+    kept = []
+    for op in reversed(block.ops):
+        if any(n in needed for n in op.output_arg_names):
+            kept.append(op)
+            needed.update(op.input_arg_names)
+    block.ops = list(reversed(kept))
+    pruned._bump_version()
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, scope=None):
+    """Prune to the inference subgraph, write __model__ + params
+    (reference io.py:925)."""
+    main_program = main_program or framework.default_main_program()
+    feed_names = [v.name if isinstance(v, Variable) else v for v in feeded_var_names]
+    target_names = [v.name if isinstance(v, Variable) else v for v in target_vars]
+    pruned = _prune_for_inference(main_program, feed_names, target_names)
+    pruned._inference_feed_names = feed_names
+    pruned._inference_fetch_names = target_names
+
+    os.makedirs(dirname, exist_ok=True)
+    desc = program_to_dict(pruned)
+    desc["feed_names"] = feed_names
+    desc["fetch_names"] = target_names
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "w") as f:
+        json.dump(desc, f)
+
+    # save parameters actually used by the pruned graph
+    used = set()
+    for op in pruned.global_block().ops:
+        used.update(op.input_arg_names)
+    params = [v for v in main_program.list_vars()
+              if _is_persistable(v) and v.name in used]
+    save_vars(executor, dirname, main_program, vars=params,
+              filename=params_filename or PARAMS_FILENAME, scope=scope)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, scope=None):
+    """Returns (program, feed_names, fetch_targets) (reference io.py:1116)."""
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME)) as f:
+        desc = json.load(f)
+    program = program_from_dict(desc)
+    feed_names = desc.get("feed_names", [])
+    fetch_names = desc.get("fetch_names", [])
+    scope = scope or global_scope()
+    params_path = _npz_path(dirname, params_filename or PARAMS_FILENAME)
+    if not os.path.exists(params_path):
+        raise RuntimeError(f"inference model params file {params_path} not found")
+    data = np.load(params_path, allow_pickle=False)
+    for name in data.files:
+        scope.set(name, data[name])
+    block = program.global_block()
+    fetch_targets = [block.var(n) for n in fetch_names]
+    return program, feed_names, fetch_targets

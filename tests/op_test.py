@@ -90,11 +90,12 @@ class OpTest(unittest.TestCase):
         res = self._run(main, feed, fetch_names, Scope())
         for (name, exp), got in zip(expected, res):
             exp = np.asarray(exp)
-            got = np.asarray(got).astype(np.float64) if exp.dtype.kind == "f" else np.asarray(got)
+            got = np.asarray(got)
+            if exp.dtype.kind == "f":
+                exp = exp.astype(np.float64)
+                got = got.astype(np.float64)
             np.testing.assert_allclose(
-                got.astype(np.float64) if exp.dtype.kind == "f" else got,
-                exp.astype(np.float64) if exp.dtype.kind == "f" else exp,
-                rtol=rtol, atol=atol,
+                got, exp, rtol=rtol, atol=atol,
                 err_msg=f"op {self.op_type} output {name} mismatch")
 
     # -- check_grad -----------------------------------------------------------

@@ -175,10 +175,12 @@ class TestMatmulBatched(OpTest):
 
 
 def _act_case(name, op_type, fn, lo=-1.0, hi=1.0, grad=True, rel=0.01):
+    import zlib
+
     class _T(OpTest):
         def setUp(self):
             self.op_type = op_type
-            x = RNG(hash(op_type) % 2**31).uniform(lo, hi, (3, 4)).astype("float32")
+            x = RNG(zlib.crc32(op_type.encode()) % 2**31).uniform(lo, hi, (3, 4)).astype("float32")
             self.inputs = {"X": x}
             self.outputs = {"Out": fn(x)}
 
@@ -628,7 +630,7 @@ class TestCast(OpTest):
         self.op_type = "cast"
         x = RNG(61).uniform(-1, 1, (3, 4)).astype("float32")
         self.inputs = {"X": x}
-        self.attrs = {"out_dtype": "float64" if False else "int32"}
+        self.attrs = {"out_dtype": "int32"}
         self.outputs = {"Out": x.astype("int32")}
 
     def test_output(self):

@@ -15,6 +15,9 @@ Layer map (mirrors SURVEY.md §1, TPU-first):
 __version__ = "0.1.0"
 
 from . import fluid  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from .reader import batch  # noqa: F401
 
 # paddle.* top-level conveniences (subset; the reference re-exports fluid too)
 from .fluid import (  # noqa: F401

@@ -1,0 +1,15 @@
+"""paddle_tpu.dataset — dataset reader creators (reference python/paddle/dataset/).
+
+The reference downloads real corpora (mnist.py, cifar.py, uci_housing.py…).
+This environment has no network egress, so each module synthesizes a
+deterministic, *learnable* dataset with the same sample shapes, dtypes, and
+reader-creator API — models exercise the identical code paths (embedding
+lookups, sequence batching, label shapes) and actually converge on the
+synthetic distributions, which is what the book tests assert.
+"""
+
+from . import (cifar, common, conll05, imdb, imikolov, mnist, movielens,
+               uci_housing, wmt16)
+
+__all__ = ["mnist", "cifar", "uci_housing", "imikolov", "movielens", "wmt16",
+           "conll05", "imdb", "common"]

@@ -31,6 +31,12 @@ from . import compiler  # noqa: F401
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
 from . import io  # noqa: F401
 from .layers.io import data  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .reader import PyReader, DataLoader  # noqa: F401
+
+# reference exposes DataLoader under fluid.io as well
+io.DataLoader = DataLoader
+io.PyReader = PyReader
 
 __all__ = [
     "framework", "layers", "optimizer", "initializer", "regularizer", "clip",

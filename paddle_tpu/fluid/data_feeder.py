@@ -1,0 +1,73 @@
+"""DataFeeder: minibatch (list of sample tuples) → executor feed dict.
+
+Reference analog: python/paddle/fluid/data_feeder.py — converts python/numpy
+sample lists into LoDTensors per feed var.  TPU-native redesign: ragged
+(lod_level>0) slots are padded to the batch max length and an implicit
+`<name>__len` int32 vector carries the true lengths — the dense-padding
+strategy LoD lowers to on XLA (SURVEY.md §5 long-context: LoD → padding +
+length tensors).  Dense slots are stacked and reshaped to the var's declared
+shape.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import framework
+from .framework import Variable
+
+__all__ = ["DataFeeder", "convert_dtype"]
+
+
+def convert_dtype(dtype):
+    return framework.convert_np_dtype_to_dtype_(dtype)
+
+
+def length_var_name(name: str) -> str:
+    return name + "__len"
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.place = place
+        self.feed_vars = []
+        program = program or framework.default_main_program()
+        for v in feed_list:
+            if isinstance(v, str):
+                v = program.global_block().var(v)
+            assert isinstance(v, Variable)
+            self.feed_vars.append(v)
+
+    def feed(self, iterable):
+        """iterable: list of samples; each sample is a tuple with one entry
+        per feed var.  Returns {name: np.ndarray} (+ __len vars for ragged)."""
+        batch = list(iterable)
+        if not batch:
+            raise ValueError("empty minibatch")
+        out = {}
+        for i, var in enumerate(self.feed_vars):
+            cols = [s[i] for s in batch]
+            if var.lod_level and var.lod_level > 0:
+                arrs = [np.asarray(c) for c in cols]
+                lens = np.asarray([a.shape[0] for a in arrs], dtype="int32")
+                maxlen = int(lens.max())
+                tail = arrs[0].shape[1:]
+                padded = np.zeros((len(arrs), maxlen) + tail, dtype=var.dtype)
+                for j, a in enumerate(arrs):
+                    padded[j, : a.shape[0]] = a
+                out[var.name] = padded
+                out[length_var_name(var.name)] = lens
+            else:
+                a = np.asarray(cols)
+                if var.dtype is not None:
+                    a = a.astype(var.dtype, copy=False)
+                # honor declared trailing shape (e.g. flatten images fed as
+                # (28,28) into shape [-1, 784], or add the trailing 1 on labels)
+                if var.shape is not None:
+                    tail = [d for d in var.shape[1:]]
+                    if all(d is not None and d > 0 for d in tail):
+                        want = (a.shape[0],) + tuple(tail)
+                        if a.shape != want and int(np.prod(a.shape[1:] or (1,))) == int(np.prod(tail or (1,))):
+                            a = a.reshape(want)
+                out[var.name] = a
+        return out

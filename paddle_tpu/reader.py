@@ -1,0 +1,194 @@
+"""Reader decorators (reference python/paddle/reader/decorator.py).
+
+A *reader creator* is a zero-arg callable returning an iterator of samples.
+These combinators compose reader creators; they are pure Python and identical
+in spirit to the reference — the device-facing prefetch machinery lives in
+:mod:`paddle_tpu.fluid.reader` (PyReader/DataLoader).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = [
+    "batch", "shuffle", "buffered", "cache", "chain", "compose",
+    "map_readers", "firstn", "xmap_readers", "ComposeNotAligned",
+]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (reference paddle.batch)."""
+
+    def batch_reader():
+        it = reader()
+        b = []
+        for sample in it:
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
+
+
+def shuffle(reader, buf_size, seed=None):
+    def shuffle_reader():
+        rng = _random.Random(seed)
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+
+    return shuffle_reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch of up to `size` samples (reference
+    decorator.py buffered) — the host-side half of the double-buffer pipeline
+    (reference operators/reader/buffered_reader.cc)."""
+
+    class _End:
+        pass
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is _End:
+                break
+            yield s
+
+    return buffered_reader
+
+
+def cache(reader):
+    all_data = []
+    filled = []
+
+    def cache_reader():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        return iter(all_data)
+
+    return cache_reader
+
+
+def chain(*readers):
+    def chain_reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return chain_reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment=True):
+    def compose_reader():
+        iters = [iter(r()) for r in readers]
+        _sentinel = object()
+        while True:
+            items = [next(it, _sentinel) for it in iters]
+            ended = [it is _sentinel for it in items]
+            if all(ended):
+                return
+            if any(ended):
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "composed readers have different lengths")
+                return
+            out = ()
+            for it in items:
+                out += it if isinstance(it, tuple) else (it,)
+            yield out
+
+    return compose_reader
+
+
+def map_readers(func, *readers):
+    def mapped_reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return mapped_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num=1, buffer_size=64, order=False):
+    """Parallel map over a reader with worker threads (reference
+    decorator.py xmap_readers)."""
+
+    class _End:
+        pass
+
+    def xmap_reader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _End:
+                    out_q.put(_End)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        done = 0
+        pending = {}
+        next_i = 0
+        while done < process_num:
+            item = out_q.get()
+            if item is _End:
+                done += 1
+                continue
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+        for i in sorted(pending):
+            yield pending[i]
+
+    return xmap_reader

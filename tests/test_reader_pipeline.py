@@ -1,0 +1,177 @@
+"""Reader pipeline tests: decorators, DataFeeder, PyReader prefetch, synthetic
+datasets, and an end-to-end train loop fed by paddle.batch(dataset)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+
+def counting_reader(n):
+    def reader():
+        for i in range(n):
+            yield (np.full((2,), i, dtype="float32"), i % 3)
+
+    return reader
+
+
+def test_batch_and_shuffle_decorators():
+    b = paddle.batch(counting_reader(10), batch_size=4)
+    batches = list(b())
+    assert [len(x) for x in batches] == [4, 4, 2]
+    b2 = paddle.batch(counting_reader(10), batch_size=4, drop_last=True)
+    assert [len(x) for x in b2()] == [4, 4]
+
+    s = paddle.reader.shuffle(counting_reader(20), buf_size=10, seed=3)
+    got = [int(x[1] + x[0][0] * 0) for x in s()]
+    assert len(got) == 20
+
+    fn = paddle.reader.firstn(counting_reader(100), 7)
+    assert len(list(fn())) == 7
+
+    ch = paddle.reader.chain(counting_reader(3), counting_reader(2))
+    assert len(list(ch())) == 5
+
+    buf = paddle.reader.buffered(counting_reader(25), size=4)
+    assert len(list(buf())) == 25
+
+    xm = paddle.reader.xmap_readers(lambda s: (s[0] * 2, s[1]), counting_reader(9),
+                                    process_num=3, order=True)
+    vals = [s[0][0] for s in xm()]
+    np.testing.assert_allclose(vals, [2 * i for i in range(9)])
+
+
+def test_data_feeder_dense_and_ragged():
+    main = fluid.Program()
+    with fluid.program_guard(main), fluid.unique_name.guard():
+        img = fluid.layers.data(name="img", shape=[4], dtype="float32")
+        lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64")
+        seq = fluid.layers.data(name="seq", shape=[3], dtype="float32", lod_level=1)
+        feeder = fluid.DataFeeder(feed_list=[img, lbl, seq], program=main)
+    batch = [
+        (np.ones(4, "float32"), 1, np.ones((2, 3), "float32")),
+        (np.zeros(4, "float32"), 0, np.ones((5, 3), "float32")),
+    ]
+    feed = feeder.feed(batch)
+    assert feed["img"].shape == (2, 4)
+    assert feed["lbl"].shape == (2, 1) and feed["lbl"].dtype == np.int64
+    assert feed["seq"].shape == (2, 5, 3)
+    np.testing.assert_array_equal(feed["seq__len"], [2, 5])
+    # padding zeros beyond each true length
+    assert feed["seq"][0, 2:].sum() == 0
+
+
+def test_pyreader_iterates_and_prefetches():
+    main = fluid.Program()
+    with fluid.program_guard(main), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        reader = fluid.PyReader(feed_list=[x, y], capacity=3)
+    reader.decorate_sample_list_generator(
+        paddle.batch(counting_reader(12), batch_size=4))
+    seen = list(reader())
+    assert len(seen) == 3
+    for feed in seen:
+        assert set(feed) == {"x", "y"}
+        assert np.asarray(feed["x"]).shape == (4, 2)
+    # a second epoch works (fresh background thread)
+    assert len(list(reader())) == 3
+
+
+def test_dataset_shapes():
+    img, lbl = next(paddle.dataset.mnist.train()())
+    assert img.shape == (784,) and 0 <= lbl < 10
+    f, p = next(paddle.dataset.uci_housing.train()())
+    assert f.shape == (13,) and p.shape == (1,)
+    gram = next(paddle.dataset.imikolov.train(None, 5)())
+    assert len(gram) == 5
+    s = next(paddle.dataset.movielens.train()())
+    assert len(s) == 8 and isinstance(s[5], list)
+    src, trg, nxt = next(paddle.dataset.wmt16.train(100, 100)())
+    assert trg[0] == paddle.dataset.wmt16.BOS and nxt[-1] == paddle.dataset.wmt16.EOS
+    assert len(trg) == len(nxt)
+    sample = next(paddle.dataset.conll05.test()())
+    assert len(sample) == 9 and len(set(map(len, sample))) == 1
+    ids, label = next(paddle.dataset.imdb.train()())
+    assert label in (0, 1) and len(ids) > 0
+
+
+def test_train_with_feeder_and_dataset():
+    """fit_a_line via the full pipeline: dataset → shuffle → batch → feeder."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        feeder = fluid.DataFeeder(feed_list=[x, y], program=main)
+
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(), buf_size=128, seed=0),
+        batch_size=101)  # 404 % 101 == 0: single compile signature
+
+    s = Scope()
+    with scope_guard(s):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        last = None
+        for epoch in range(30):
+            for batch in train_reader():
+                (last,) = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss.name])
+    assert float(np.asarray(last)) < 0.05, f"did not converge: {last}"
+
+
+def test_pyreader_propagates_reader_errors():
+    main = fluid.Program()
+    with fluid.program_guard(main), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        reader = fluid.PyReader(feed_list=[x], capacity=2)
+
+    def bad_batches():
+        yield [(np.zeros(2, "float32"),)]
+        raise ValueError("corrupt sample")
+
+    reader.decorate_sample_list_generator(lambda: bad_batches())
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="corrupt sample"):
+        list(reader())
+
+
+def test_pyreader_early_break_does_not_deadlock():
+    main = fluid.Program()
+    with fluid.program_guard(main), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        reader = fluid.PyReader(feed_list=[x], capacity=2)
+    reader.decorate_sample_list_generator(
+        paddle.batch(counting_reader(1000), batch_size=2))
+    import threading
+    before = threading.active_count()
+    for _ in range(5):
+        for feed in reader():
+            break  # abandon epoch immediately
+    import time
+    time.sleep(0.5)  # let producer threads notice stop and exit
+    assert threading.active_count() <= before + 1
+
+
+def test_compose_alignment():
+    import pytest as _pytest
+    a = counting_reader(5)
+    b = counting_reader(4)
+    with _pytest.raises(paddle.reader.ComposeNotAligned):
+        list(paddle.reader.compose(a, b)())
+    got = list(paddle.reader.compose(a, b, check_alignment=False)())
+    assert len(got) == 4
+
+
+def test_decorate_sample_generator_batches():
+    main = fluid.Program()
+    with fluid.program_guard(main), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        reader = fluid.PyReader(feed_list=[x, y], capacity=2)
+    reader.decorate_sample_generator(counting_reader(10), batch_size=5)
+    feeds = list(reader())
+    assert len(feeds) == 2 and feeds[0]["x"].shape == (5, 2)

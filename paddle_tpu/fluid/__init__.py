@@ -33,6 +33,7 @@ from . import io  # noqa: F401
 from .layers.io import data  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .reader import PyReader, DataLoader  # noqa: F401
+from . import dygraph  # noqa: F401
 
 # reference exposes DataLoader under fluid.io as well
 io.DataLoader = DataLoader

@@ -1,0 +1,24 @@
+"""paddle_tpu.fluid.dygraph — imperative (eager) mode.
+
+Reference: paddle/fluid/imperative/ (C++ tracer/engine) +
+python/paddle/fluid/dygraph/.  See tracer.py for the TPU-native design.
+"""
+
+from . import nn  # noqa: F401
+from .base import (enable_dygraph, disable_dygraph, enabled, guard,  # noqa: F401
+                   no_grad, to_variable)
+from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .layers import Layer  # noqa: F401
+from .nn import (FC, BatchNorm, Conv2D, Conv2DTranspose, Dropout,  # noqa: F401
+                 Embedding, GroupNorm, GRUUnit, LayerNorm, Linear, Pool2D,
+                 PRelu)
+from .parallel import DataParallel, Env, ParallelEnv, prepare_context  # noqa: F401
+from .tracer import Tracer, VarBase, trace_op  # noqa: F401
+
+__all__ = [
+    "guard", "to_variable", "no_grad", "enabled", "enable_dygraph",
+    "disable_dygraph", "Layer", "VarBase", "Tracer", "trace_op",
+    "save_dygraph", "load_dygraph", "DataParallel", "prepare_context",
+    "nn", "Linear", "FC", "Conv2D", "Conv2DTranspose", "Pool2D", "BatchNorm",
+    "Embedding", "LayerNorm", "Dropout", "GRUUnit", "PRelu", "GroupNorm",
+]

@@ -1,0 +1,72 @@
+"""Dygraph mode switches (reference python/paddle/fluid/dygraph/base.py:98
+`guard`, :156 `to_variable`)."""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+from .. import framework
+from .tracer import Tracer, VarBase, current_tracer
+
+__all__ = ["guard", "enabled", "to_variable", "no_grad", "enable_dygraph",
+           "disable_dygraph"]
+
+_tracer_singleton = None
+
+
+def _get_tracer():
+    global _tracer_singleton
+    if _tracer_singleton is None:
+        _tracer_singleton = Tracer()
+    return _tracer_singleton
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    tracer = _get_tracer()
+    with framework._dygraph_guard(tracer):
+        yield
+
+
+def enable_dygraph(place=None):
+    framework._dygraph_tracer_ = _get_tracer()
+
+
+def disable_dygraph():
+    framework._dygraph_tracer_ = None
+
+
+def enabled():
+    return framework.in_dygraph_mode()
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    a = np.asarray(value)
+    return VarBase(a, name=name, stop_gradient=True)
+
+
+class no_grad:
+    """Context manager + decorator disabling tape recording."""
+
+    def __enter__(self):
+        self._tracer = current_tracer()
+        self._old = self._tracer._no_grad
+        self._tracer._no_grad = True
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer._no_grad = self._old
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with no_grad():
+                return fn(*a, **kw)
+
+        return wrapper

@@ -1,0 +1,30 @@
+"""Dygraph checkpointing (reference python/paddle/fluid/dygraph/checkpoint.py
+save_dygraph/load_dygraph): state dicts ↔ npz on disk."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+
+def save_dygraph(state_dict, model_path):
+    """state_dict: {name: np.ndarray} (from Layer.state_dict()) or an
+    optimizer state dict.  Writes `<model_path>.npz`."""
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arrays = {k: np.asarray(v) for k, v in state_dict.items()}
+    np.savez(model_path + ".npz" if not model_path.endswith(".npz") else model_path,
+             **arrays)
+
+
+def load_dygraph(model_path):
+    """Returns (param_state_dict, optimizer_state_dict_or_None)."""
+    path = model_path if model_path.endswith(".npz") else model_path + ".npz"
+    if not os.path.exists(path):
+        raise RuntimeError(f"checkpoint {path} not found")
+    data = np.load(path, allow_pickle=False)
+    return {k: data[k] for k in data.files}, None

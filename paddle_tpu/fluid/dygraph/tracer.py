@@ -1,0 +1,328 @@
+"""Dygraph core: VarBase + Tracer + tape-based autograd engine.
+
+Reference analogs: paddle/fluid/imperative/tracer.{h,cc} (Tracer::Trace —
+eager-execute an op and record the grad graph), layer.h:133 VarBase /
+:334 OpBase (autograd metadata), engine.cc (backward walker).
+
+TPU-native redesign: an eager op call runs the op's registered JAX lowering
+immediately (same lowerings the whole-block XLA executor traces — one kernel
+source of truth, like the reference sharing OperatorWithKernel between
+executor and tracer).  The tape records (op info, inputs, RNG context); the
+backward engine re-runs each forward lowering under ``jax.vjp`` with the
+recorded context, so every differentiable op gets gradients mechanically —
+including stochastic ops like dropout, whose recorded ctx reproduces the
+same mask.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework, registry
+
+__all__ = ["VarBase", "Tracer", "trace_op", "current_tracer"]
+
+
+class VarBase:
+    """Eager tensor: a jax array + autograd metadata (reference layer.h:133)."""
+
+    def __init__(self, value, name=None, stop_gradient=False, persistable=False):
+        import jax.numpy as jnp
+
+        self._value = value if hasattr(value, "dtype") else jnp.asarray(value)
+        self.name = name or framework.unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = not stop_gradient
+        self._grad = None
+
+    # -- data access ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    @property
+    def shape(self):
+        return tuple(int(s) for s in np.shape(self._value))
+
+    @property
+    def dtype(self):
+        return str(self._value.dtype)
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def detach(self):
+        return VarBase(self._value, stop_gradient=True)
+
+    def backward(self, retain_graph=False):
+        current_tracer()._backward(self, retain_graph=retain_graph)
+
+    def set_value(self, value):
+        import jax.numpy as jnp
+
+        self._value = jnp.asarray(value).astype(self._value.dtype)
+
+    # -- sugar (subset of math_op_patch) -------------------------------------
+    def _ew(self, other, op, reverse=False):
+        import jax.numpy as jnp
+
+        if not isinstance(other, VarBase):
+            other = VarBase(jnp.asarray(other, dtype=self._value.dtype),
+                            stop_gradient=True)
+        a, b = (other, self) if reverse else (self, other)
+        return trace_op(op, {"X": a, "Y": b})
+
+    def __add__(self, o):
+        return self._ew(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._ew(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._ew(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._ew(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._ew(o, "elementwise_div")
+
+    def __matmul__(self, o):
+        return trace_op("matmul", {"X": self, "Y": o})
+
+    def __neg__(self):
+        return trace_op("scale", {"X": self}, attrs={"scale": -1.0})
+
+    def astype(self, dtype):
+        return trace_op("cast", {"X": self},
+                        attrs={"out_dtype": framework.convert_np_dtype_to_dtype_(dtype)})
+
+    def __repr__(self):
+        return f"VarBase(name={self.name}, shape={self.shape}, dtype={self.dtype})\n{self.numpy()}"
+
+
+class _TapeEntry:
+    __slots__ = ("info", "attrs", "inputs", "outputs", "step", "op_index", "is_test")
+
+    def __init__(self, info, attrs, inputs, outputs, step, op_index, is_test):
+        self.info = info
+        self.attrs = attrs
+        self.inputs = inputs    # [(slot, VarBase | [VarBase] | None)]
+        self.outputs = outputs  # [VarBase | tuple | None] per output slot
+        self.step = step
+        self.op_index = op_index
+        self.is_test = is_test
+
+
+class Tracer:
+    """Eager op executor + tape (reference imperative/tracer.h:41)."""
+
+    def __init__(self):
+        import weakref
+
+        self._tape: list[_TapeEntry] = []
+        self._train_mode = True
+        self._no_grad = False
+        self._op_counter = 0
+        # registered by dygraph Layers; weak so discarded models don't leak
+        self.parameters = weakref.WeakValueDictionary()
+        # parameter VarBases that received grads from the latest backward()
+        # — the default update set for Optimizer._dygraph_minimize
+        self._last_backward_params: list[VarBase] = []
+
+    # -- mode ----------------------------------------------------------------
+    def train_mode(self):
+        self._train_mode = True
+
+    def eval_mode(self):
+        self._train_mode = False
+
+    def _ctx(self, op_index, step=0):
+        ctx = registry.LowerContext(step=np.uint32(step), is_test=not self._train_mode)
+        ctx.op_index = op_index
+        return ctx
+
+    # -- trace ---------------------------------------------------------------
+    def trace(self, op_type, inputs, attrs=None):
+        """Run `op_type` eagerly; returns VarBase or tuple of VarBase."""
+        info = registry.get_op(op_type)
+        attrs = dict(attrs or {})
+        vals, in_record = [], []
+        requires_grad = False
+        for slot in info.input_slots:
+            cslot = slot.rstrip("*")
+            v = inputs.get(cslot)
+            if info.is_variadic(slot):
+                vl = list(v or [])
+                vals.append([x._value for x in vl])
+                in_record.append((cslot, vl))
+                requires_grad |= any(not x.stop_gradient for x in vl)
+            elif v is None:
+                vals.append(None)
+                in_record.append((cslot, None))
+            else:
+                vals.append(v._value)
+                in_record.append((cslot, v))
+                requires_grad |= not v.stop_gradient
+        self._op_counter += 1
+        op_index = self._op_counter
+        ctx = self._ctx(op_index)
+        out = info.lower(ctx, *vals, attrs=attrs)
+        outs = out if isinstance(out, tuple) else (out,)
+
+        # eval mode records nothing: an inference loop must not grow the tape
+        # unboundedly (use train mode + no_grad for the rare eval-with-grads)
+        differentiable = (info.grad is not None or info.grad_maker is not None)
+        requires_grad = (requires_grad and differentiable
+                         and not self._no_grad and self._train_mode)
+        out_vbs = []
+        for slot, val in zip(info.output_slots, outs):
+            if val is None:
+                out_vbs.append(None)
+            elif info.is_variadic(slot):
+                out_vbs.append(tuple(
+                    VarBase(x, stop_gradient=not requires_grad) for x in val))
+            else:
+                out_vbs.append(VarBase(val, stop_gradient=not requires_grad))
+        if requires_grad:
+            self._tape.append(_TapeEntry(
+                info, attrs, in_record, out_vbs, step=0, op_index=op_index,
+                is_test=not self._train_mode))
+        flat = []
+        for o in out_vbs:
+            flat.append(o)
+        result = tuple(flat)
+        return result[0] if len(result) == 1 else result
+
+    # -- backward ------------------------------------------------------------
+    def _backward(self, loss, retain_graph=False):
+        import jax
+        import jax.numpy as jnp
+
+        grads: dict[int, object] = {id(loss): jnp.ones_like(loss._value)}
+
+        def add_grad(vb, g):
+            if g is None or vb is None or vb.stop_gradient:
+                return
+            k = id(vb)
+            g = jnp.reshape(g, np.shape(vb._value)).astype(vb._value.dtype)
+            grads[k] = g if k not in grads else grads[k] + g
+
+        for entry in reversed(self._tape):
+            # collect output cotangents; skip entry if none of its outputs
+            # received gradient
+            out_gs, any_g = [], False
+            for o in entry.outputs:
+                if isinstance(o, tuple):
+                    gl = [grads.get(id(x)) for x in o]
+                    any_g |= any(g is not None for g in gl)
+                    out_gs.append(gl)
+                else:
+                    g = grads.get(id(o)) if o is not None else None
+                    any_g |= g is not None
+                    out_gs.append(g)
+            if not any_g:
+                continue
+
+            info, attrs = entry.info, entry.attrs
+            ctx = self._ctx(entry.op_index, entry.step)
+            ctx.is_test = entry.is_test
+
+            fwd_vals = []
+            diff_idx = []
+            for i, (slot_name, v) in enumerate(entry.inputs):
+                if isinstance(v, list):
+                    fwd_vals.append([x._value for x in v])
+                    if (slot_name not in info.no_grad_inputs and v
+                            and all(jnp.issubdtype(x._value.dtype, jnp.floating) for x in v)
+                            and any(not x.stop_gradient for x in v)):
+                        diff_idx.append(i)
+                elif v is None:
+                    fwd_vals.append(None)
+                else:
+                    fwd_vals.append(v._value)
+                    if (slot_name not in info.no_grad_inputs
+                            and not v.stop_gradient
+                            and jnp.issubdtype(v._value.dtype, jnp.floating)):
+                        diff_idx.append(i)
+            if not diff_idx:
+                continue
+
+            def fwd_fn(*diff_vals):
+                full = list(fwd_vals)
+                for j, i in enumerate(diff_idx):
+                    full[i] = diff_vals[j]
+                out = info.lower(ctx, *full, attrs=attrs)
+                return out if isinstance(out, tuple) else (out,)
+
+            primals = [fwd_vals[i] for i in diff_idx]
+            outs, vjp_fn = jax.vjp(fwd_fn, *primals)
+
+            def cot(o, g):
+                if o is None:
+                    return None
+                if g is None:
+                    return jnp.zeros_like(o)
+                return jnp.reshape(g, jnp.shape(o)).astype(o.dtype)
+
+            cots = []
+            for o, g in zip(outs, out_gs):
+                if isinstance(g, list):
+                    gl = g + [None] * (len(o) - len(g))
+                    cots.append(tuple(cot(oe, ge) for oe, ge in zip(o, gl)))
+                else:
+                    cots.append(cot(o, g))
+            in_grads = vjp_fn(tuple(cots))
+
+            for j, i in enumerate(diff_idx):
+                slot_name, v = entry.inputs[i]
+                if isinstance(v, list):
+                    for x, g in zip(v, in_grads[j]):
+                        add_grad(x, g)
+                else:
+                    add_grad(v, in_grads[j])
+
+        # persist grads onto VarBases (accumulate like the reference until
+        # clear_gradients); intermediates referenced only by the tape are
+        # dropped with it
+        seen = set()
+        self._last_backward_params = []
+        for entry in self._tape:
+            for _, v in entry.inputs:
+                for x in (v if isinstance(v, list) else [v]):
+                    if x is not None and id(x) in grads and id(x) not in seen:
+                        seen.add(id(x))
+                        g = grads[id(x)]
+                        x._grad = g if x._grad is None else x._grad + g
+                        if x.persistable:
+                            self._last_backward_params.append(x)
+        if id(loss) not in seen and not loss.stop_gradient:
+            loss._grad = grads[id(loss)]
+        if not retain_graph:
+            self._tape.clear()
+
+    def reset(self):
+        self._tape.clear()
+
+
+def current_tracer() -> Tracer:
+    t = framework._dygraph_tracer()
+    if t is None:
+        raise RuntimeError(
+            "not in dygraph mode: wrap the code in `with fluid.dygraph.guard():`")
+    return t
+
+
+def trace_op(op_type, inputs, attrs=None):
+    return current_tracer().trace(op_type, inputs, attrs)

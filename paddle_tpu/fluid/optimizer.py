@@ -134,10 +134,76 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        if framework.in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list), []
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    # -- dygraph (eager) path --------------------------------------------
+    # Reference: in imperative mode the same optimizer classes apply their
+    # update ops directly to VarBase grads (python/paddle/fluid/optimizer.py
+    # _append_optimize_op running under the tracer).  Here each update calls
+    # the SAME registered op lowering the static executor compiles, eagerly.
+
+    def _dygraph_lr(self):
+        lr = self._learning_rate
+        if callable(lr):
+            lr = lr()
+        return np.float32(np.asarray(lr).reshape(-1)[0])
+
+    def _dg_acc(self, param, name, fill_value=0.0, shape=None):
+        from .dygraph.tracer import VarBase
+
+        accs = self._accumulators.setdefault("__dg_" + name, {})
+        if param.name not in accs:
+            shp = shape if shape is not None else list(param.shape)
+            accs[param.name] = VarBase(
+                np.full(shp, fill_value, dtype="float32"), stop_gradient=True)
+        return accs[param.name]
+
+    def _dg_run(self, op_type, in_vals, attrs):
+        from . import registry
+
+        info = registry.get_op(op_type)
+        ctx = registry.LowerContext(step=np.uint32(0))
+        ctx.op_index = 0
+        return info.lower(ctx, *in_vals, attrs=attrs)
+
+    def _dygraph_step(self, p, g, lr):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no dygraph update; use the static "
+            f"graph path")
+
+    def _dygraph_minimize(self, loss, parameter_list=None):
+        from .framework import _dygraph_tracer
+
+        tracer = _dygraph_tracer()
+        if parameter_list is not None:
+            params = list(parameter_list)
+        else:
+            # parameters that received a gradient from the latest backward()
+            # — NOT every parameter ever registered on the tracer singleton,
+            # which would let one model's optimizer update another model
+            params = list(tracer._last_backward_params)
+        lr = self._dygraph_lr()
+        from . import regularizer as reg_mod
+
+        for p in params:
+            if p._grad is None or p.stop_gradient:
+                continue
+            g = p._grad
+            reg = p.regularizer if getattr(p, "regularizer", None) is not None \
+                else self.regularization
+            if isinstance(reg, reg_mod.L2DecayRegularizer):
+                g = g + np.float32(reg._coeff) * p._value
+            elif isinstance(reg, reg_mod.L1DecayRegularizer):
+                import jax.numpy as jnp
+
+                g = g + np.float32(reg._coeff) * jnp.sign(p._value)
+            self._dygraph_step(p, g, lr)
+        return []
 
     # -- regularization (reference regularizer.py append_regularization_ops)
     def _append_regularization_ops(self, block, params_grads):
@@ -166,6 +232,9 @@ class SGDOptimizer(Optimizer):
             inputs={"Param": [p], "Grad": [g], "LearningRate": [self._param_lr(p)]},
             outputs={"ParamOut": [p]})
 
+    def _dygraph_step(self, p, g, lr):
+        p._value = self._dg_run("sgd", [p._value, g, lr], {})
+
 
 class MomentumOptimizer(Optimizer):
     type = "momentum"
@@ -188,6 +257,12 @@ class MomentumOptimizer(Optimizer):
                     "LearningRate": [self._param_lr(p)]},
             outputs={"ParamOut": [p], "VelocityOut": [v]},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+    def _dygraph_step(self, p, g, lr):
+        v = self._dg_acc(p, "velocity")
+        p._value, v._value = self._dg_run(
+            "momentum", [p._value, g, v._value, lr],
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov})
 
 
 class LarsMomentumOptimizer(Optimizer):
@@ -238,6 +313,11 @@ class AdagradOptimizer(Optimizer):
             outputs={"ParamOut": [p], "MomentOut": [m]},
             attrs={"epsilon": self._epsilon})
 
+    def _dygraph_step(self, p, g, lr):
+        m = self._dg_acc(p, "moment", fill_value=self._init_acc)
+        p._value, m._value = self._dg_run(
+            "adagrad", [p._value, g, m._value, lr], {"epsilon": self._epsilon})
+
 
 class AdamOptimizer(Optimizer):
     type = "adam"
@@ -272,6 +352,17 @@ class AdamOptimizer(Optimizer):
 
     def _extra_attrs(self):
         return {}
+
+    def _dygraph_step(self, p, g, lr):
+        m1 = self._dg_acc(p, "moment1")
+        m2 = self._dg_acc(p, "moment2")
+        b1p = self._dg_acc(p, "beta1_pow_acc", fill_value=self._beta1, shape=[1])
+        b2p = self._dg_acc(p, "beta2_pow_acc", fill_value=self._beta2, shape=[1])
+        (p._value, m1._value, m2._value, b1p._value, b2p._value) = self._dg_run(
+            self.type,
+            [p._value, g, m1._value, m2._value, lr, b1p._value, b2p._value],
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon, **self._extra_attrs()})
 
 
 class AdamWOptimizer(AdamOptimizer):

@@ -56,18 +56,22 @@ def shuffle(reader, buf_size, seed=None):
 def buffered(reader, size):
     """Background-thread prefetch of up to `size` samples (reference
     decorator.py buffered) — the host-side half of the double-buffer pipeline
-    (reference operators/reader/buffered_reader.cc)."""
+    (reference operators/reader/buffered_reader.cc).  Reader errors are
+    re-raised in the consumer, not swallowed by the fill thread."""
 
     class _End:
         pass
 
     def buffered_reader():
         q = queue.Queue(maxsize=size)
+        error = []
 
         def fill():
             try:
                 for sample in reader():
                     q.put(sample)
+            except BaseException as e:
+                error.append(e)
             finally:
                 q.put(_End)
 
@@ -76,6 +80,8 @@ def buffered(reader, size):
         while True:
             s = q.get()
             if s is _End:
+                if error:
+                    raise error[0]
                 break
             yield s
 
@@ -153,21 +159,32 @@ def xmap_readers(mapper, reader, process_num=1, buffer_size=64, order=False):
     def xmap_reader():
         in_q = queue.Queue(buffer_size)
         out_q = queue.Queue(buffer_size)
+        error = []
 
         def feed():
-            for i, sample in enumerate(reader()):
-                in_q.put((i, sample))
-            for _ in range(process_num):
-                in_q.put(_End)
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as e:
+                error.append(e)
+            finally:
+                # always deliver sentinels so workers (and the consumer
+                # counting _End) terminate even when the source reader raises
+                for _ in range(process_num):
+                    in_q.put(_End)
 
         def work():
-            while True:
-                item = in_q.get()
-                if item is _End:
-                    out_q.put(_End)
-                    return
-                i, sample = item
-                out_q.put((i, mapper(sample)))
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is _End:
+                        return
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except BaseException as e:
+                error.append(e)
+            finally:
+                out_q.put(_End)
 
         threading.Thread(target=feed, daemon=True).start()
         workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
@@ -188,6 +205,8 @@ def xmap_readers(mapper, reader, process_num=1, buffer_size=64, order=False):
                 while next_i in pending:
                     yield pending.pop(next_i)
                     next_i += 1
+        if error:
+            raise error[0]
         for i in sorted(pending):
             yield pending[i]
 

@@ -1,0 +1,295 @@
+"""Dygraph (imperative) mode tests: eager ops, tape autograd vs static-graph
+gradients, Layer zoo, optimizers, checkpointing, DataParallel API.
+
+Reference analogs: tests/unittests/test_imperative_basic.py,
+test_imperative_mnist.py, test_imperative_checkpoint.py.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.fluid.dygraph import to_variable
+
+
+def test_eager_math_and_numpy():
+    with dygraph.guard():
+        x = to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32"))
+        y = x * 2.0 + 1.0
+        np.testing.assert_allclose(y.numpy(), [[3, 5], [7, 9]])
+        z = x @ to_variable(np.eye(2, dtype="float32"))
+        np.testing.assert_allclose(z.numpy(), x.numpy())
+        assert y.shape == (2, 2) and y.dtype == "float32"
+
+
+def test_backward_simple_chain():
+    with dygraph.guard():
+        xv = np.array([[1.0, -2.0, 3.0]], dtype="float32")
+        x = dygraph.VarBase(xv, stop_gradient=False)
+        y = x * x  # dy/dx = 2x
+        loss = dygraph.trace_op("reduce_sum", {"X": y},
+                                attrs={"dim": [0], "reduce_all": True})
+        loss.backward()
+        np.testing.assert_allclose(x.gradient(), 2 * xv, rtol=1e-6)
+        # tape cleared; grads persist until cleared
+        x.clear_gradient()
+        assert x.gradient() is None
+
+
+def test_backward_matches_static_graph():
+    """Same 2-layer net: dygraph tape grads == static append_backward grads."""
+    w1v = np.random.RandomState(0).uniform(-0.5, 0.5, (4, 8)).astype("float32")
+    w2v = np.random.RandomState(1).uniform(-0.5, 0.5, (8, 1)).astype("float32")
+    xv = np.random.RandomState(2).uniform(-1, 1, (5, 4)).astype("float32")
+
+    # dygraph
+    with dygraph.guard():
+        w1 = dygraph.VarBase(w1v, stop_gradient=False)
+        w2 = dygraph.VarBase(w2v, stop_gradient=False)
+        x = to_variable(xv)
+        h = dygraph.trace_op("tanh", {"X": x @ w1})
+        out = h @ w2
+        loss = dygraph.trace_op("mean", {"X": out})
+        loss.backward()
+        dg_g1, dg_g2 = w1.gradient(), w2.gradient()
+
+    # static
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        xs = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        p1 = fluid.layers.create_parameter([4, 8], "float32", name="w1")
+        p2 = fluid.layers.create_parameter([8, 1], "float32", name="w2")
+        h = fluid.layers.tanh(fluid.layers.matmul(xs, p1))
+        loss = fluid.layers.mean(fluid.layers.matmul(h, p2))
+        fluid.append_backward(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope.set("w1", w1v)
+        scope.set("w2", w2v)
+        g1, g2 = exe.run(main, feed={"x": xv},
+                         fetch_list=["w1@GRAD", "w2@GRAD"])
+    np.testing.assert_allclose(dg_g1, g1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(dg_g2, g2, rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accumulation_and_fanout():
+    with dygraph.guard():
+        x = dygraph.VarBase(np.ones((2, 2), "float32"), stop_gradient=False)
+        y = x + x  # fan-out: x used twice
+        loss = dygraph.trace_op("reduce_sum", {"X": y},
+                                attrs={"dim": [0], "reduce_all": True})
+        loss.backward()
+        np.testing.assert_allclose(x.gradient(), 2 * np.ones((2, 2)))
+        # second backward accumulates
+        z = x * 1.0
+        loss2 = dygraph.trace_op("reduce_sum", {"X": z},
+                                 attrs={"dim": [0], "reduce_all": True})
+        loss2.backward()
+        np.testing.assert_allclose(x.gradient(), 3 * np.ones((2, 2)))
+
+
+def test_no_grad_context():
+    with dygraph.guard():
+        x = dygraph.VarBase(np.ones((2,), "float32"), stop_gradient=False)
+        with dygraph.no_grad():
+            y = x * 3.0
+        assert y.stop_gradient
+        tracer = fluid.framework._dygraph_tracer()
+        assert len(tracer._tape) == 0
+
+
+class MLP(dygraph.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = dygraph.Linear(784, 64, act="relu")
+        self.fc2 = dygraph.Linear(64, 10)
+
+    def forward(self, x):
+        return self.fc2(self.fc1(x))
+
+
+def test_layer_train_mnist_dygraph():
+    """End-to-end eager training converges (reference test_imperative_mnist)."""
+    import paddle_tpu as paddle
+
+    with dygraph.guard():
+        model = MLP()
+        assert len(model.parameters()) == 4
+        opt = fluid.optimizer.Adam(learning_rate=1e-3)
+        reader = paddle.batch(paddle.dataset.mnist.train(), batch_size=128,
+                              drop_last=True)
+        accs = []
+        for epoch in range(2):
+            for batch in reader():
+                img = to_variable(np.stack([s[0] for s in batch]))
+                lbl = to_variable(np.array([[s[1]] for s in batch], dtype="int64"))
+                logits = model(img)
+                _, loss = dygraph.trace_op(
+                    "softmax_with_cross_entropy", {"Logits": logits, "Label": lbl})
+                loss = dygraph.trace_op("mean", {"X": loss})
+                loss.backward()
+                opt.minimize(loss)
+                model.clear_gradients()
+                pred = np.argmax(logits.numpy(), axis=1)
+                accs.append((pred == lbl.numpy().ravel()).mean())
+        assert np.mean(accs[-5:]) > 0.9, f"did not learn: {np.mean(accs[-5:])}"
+
+
+def test_conv_bn_pool_layers():
+    with dygraph.guard():
+        x = to_variable(np.random.RandomState(3).uniform(-1, 1, (2, 3, 8, 8)).astype("float32"))
+        conv = dygraph.Conv2D(3, 4, 3, padding=1)
+        bn = dygraph.BatchNorm(4)
+        pool = dygraph.Pool2D(pool_size=2, pool_type="max", pool_stride=2)
+        y = pool(bn(conv(x)))
+        assert y.shape == (2, 4, 4, 4)
+        # BN running stats updated in train mode
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        loss = dygraph.trace_op("mean", {"X": y})
+        loss.backward()
+        assert conv.weight.gradient() is not None
+        assert bn.weight.gradient() is not None
+
+
+def test_embedding_layernorm_dropout():
+    with dygraph.guard():
+        emb = dygraph.Embedding(size=[20, 8])
+        ln = dygraph.LayerNorm(8)
+        drop = dygraph.Dropout(p=0.5)
+        ids = to_variable(np.array([[1, 2], [3, 4]], dtype="int64"))
+        h = ln(emb(ids))
+        assert h.shape == (2, 2, 8)
+        loss = dygraph.trace_op("mean", {"X": h})
+        loss.backward()
+        assert emb.weight.gradient() is not None
+        # eval() flips the tracer to inference: dropout becomes identity and
+        # the tape stops recording (inference loops must not grow it)
+        drop.eval()
+        h2 = drop(h)
+        np.testing.assert_allclose(h2.numpy(), h.numpy())
+        assert len(fluid.framework._dygraph_tracer()._tape) == 0
+        drop.train()
+
+
+def test_state_dict_save_load(tmp_path):
+    with dygraph.guard():
+        m1 = MLP()
+        sd = m1.state_dict()
+        assert len(sd) == 4
+        dygraph.save_dygraph(sd, str(tmp_path / "model"))
+        m2 = MLP()
+        before = m2.fc1.weight.numpy().copy()
+        loaded, _ = dygraph.load_dygraph(str(tmp_path / "model"))
+        # names differ between instances (unique ids) — remap by order
+        remap = dict(zip([p.name for p in m2.parameters()], sd.values()))
+        m2.set_dict(remap)
+        np.testing.assert_allclose(m2.fc1.weight.numpy(),
+                                   m1.fc1.weight.numpy())
+        assert not np.allclose(before, m2.fc1.weight.numpy())
+
+
+def test_data_parallel_api():
+    with dygraph.guard():
+        strategy = dygraph.prepare_context()
+        model = dygraph.DataParallel(MLP(), strategy)
+        x = to_variable(np.zeros((4, 784), "float32"))
+        out = model(x)
+        assert out.shape == (4, 10)
+        loss = dygraph.trace_op("mean", {"X": out})
+        loss = model.scale_loss(loss)
+        loss.backward()
+        model.apply_collective_grads()
+        assert len(model.parameters()) == 4
+
+
+def test_dropout_backward_uses_same_mask():
+    with dygraph.guard():
+        x = dygraph.VarBase(np.ones((1000,), "float32"), stop_gradient=False)
+        out, _ = dygraph.trace_op("dropout", {"X": x},
+                                  attrs={"dropout_prob": 0.5, "is_test": False})
+        loss = dygraph.trace_op("reduce_sum", {"X": out},
+                                attrs={"dim": [0], "reduce_all": True})
+        loss.backward()
+        g = x.gradient()
+        o = out.numpy()
+        # gradient must be nonzero exactly where the forward kept values
+        np.testing.assert_array_equal(g != 0, o != 0)
+
+
+def test_nested_layer_eval_and_state_dict():
+    """eval() must flip nested sublayers; state_dict must include nested BN
+    buffers (regression tests for recursive traversal)."""
+
+    class Block(dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = dygraph.BatchNorm(3)
+            self.drop = dygraph.Dropout(p=0.5)
+
+        def forward(self, x):
+            return self.drop(self.bn(x))
+
+    class Net(dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.block = Block()
+
+        def forward(self, x):
+            return self.block(x)
+
+    with dygraph.guard():
+        net = Net()
+        net.eval()
+        assert net.block.bn.training is False
+        assert net.block.drop.training is False
+        sd = net.state_dict()
+        # 2 BN params + 2 BN buffers (running mean/var)
+        assert len(sd) == 4
+        buffer_names = {net.block.bn._mean.name, net.block.bn._variance.name}
+        assert buffer_names <= set(sd)
+        net.train()
+        assert net.block.bn.training is True
+
+
+def test_optimizer_does_not_touch_other_models():
+    """Two models on the shared tracer: each optimizer only updates the
+    parameters from its own loss's backward."""
+    with dygraph.guard():
+        m1, m2 = MLP(), MLP()
+        opt1 = fluid.optimizer.SGD(learning_rate=0.5)
+        x = to_variable(np.ones((2, 784), "float32"))
+        # give m2 stale gradients
+        out2 = dygraph.trace_op("mean", {"X": m2(x)})
+        out2.backward()
+        w2_before = m2.fc1.weight.numpy().copy()
+        # now train m1 only
+        out1 = dygraph.trace_op("mean", {"X": m1(x)})
+        out1.backward()
+        opt1.minimize(out1)
+        np.testing.assert_array_equal(m2.fc1.weight.numpy(), w2_before)
+
+
+def test_reader_decorator_errors_propagate():
+    import pytest as _pytest
+    import paddle_tpu as paddle
+
+    def bad_reader():
+        yield (1,)
+        raise IOError("disk gone")
+
+    with _pytest.raises(IOError):
+        list(paddle.reader.buffered(lambda: bad_reader(), 4)())
+
+    def bad_mapper(s):
+        raise ValueError("bad sample")
+
+    def good_reader():
+        for i in range(10):
+            yield (i,)
+
+    with _pytest.raises(ValueError):
+        list(paddle.reader.xmap_readers(bad_mapper, lambda: good_reader(),
+                                        process_num=2)())

@@ -34,6 +34,9 @@ from .layers.io import data  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .reader import PyReader, DataLoader  # noqa: F401
 from . import dygraph  # noqa: F401
+from . import metrics  # noqa: F401
+from . import profiler  # noqa: F401
+from . import contrib  # noqa: F401
 
 # reference exposes DataLoader under fluid.io as well
 io.DataLoader = DataLoader

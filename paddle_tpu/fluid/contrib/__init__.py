@@ -1,0 +1,5 @@
+"""fluid.contrib — incubating APIs (reference python/paddle/fluid/contrib/)."""
+
+from . import mixed_precision  # noqa: F401
+
+__all__ = ["mixed_precision"]

@@ -1,0 +1,6 @@
+from .decorator import OptimizerWithMixedPrecision, decorate  # noqa: F401
+from .fp16_lists import AutoMixedPrecisionLists  # noqa: F401
+from .fp16_utils import rewrite_program  # noqa: F401
+
+__all__ = ["decorate", "OptimizerWithMixedPrecision", "AutoMixedPrecisionLists",
+           "rewrite_program"]

@@ -1,0 +1,44 @@
+"""AMP op lists (reference python/paddle/fluid/contrib/mixed_precision/
+fp16_lists.py AutoMixedPrecisionLists).
+
+white: always cast inputs to the low-precision dtype (MXU-bound matmul/conv —
+on TPU these run on the systolic array in bf16 at 2x+ the fp32 rate).
+black: numerically sensitive; force fp32.
+gray: run in whatever dtype arrives (XLA promotes).
+"""
+
+from __future__ import annotations
+
+white_list = {
+    "matmul", "matmul_v2", "mul", "conv2d", "depthwise_conv2d", "conv3d",
+    "conv2d_transpose",
+}
+
+black_list = {
+    "exp", "log", "square", "sqrt", "rsqrt", "mean", "sum", "cos_sim",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "cross_entropy2", "softmax", "log_softmax",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm",
+    "reduce_sum", "reduce_mean", "squared_l2_norm", "frobenius_norm",
+}
+
+gray_list = None  # everything else
+
+
+class AutoMixedPrecisionLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        self.black_varnames = set(custom_black_varnames or ())
+        if custom_white_list:
+            for op in custom_white_list:
+                self.white_list.add(op)
+                self.black_list.discard(op)
+        if custom_black_list:
+            for op in custom_black_list:
+                self.black_list.add(op)
+                self.white_list.discard(op)
+        overlap = self.white_list & self.black_list
+        if overlap:
+            raise ValueError(f"ops in both white and black lists: {overlap}")

@@ -1,0 +1,83 @@
+"""AMP program rewrite (reference contrib/mixed_precision/fp16_utils.py
+rewrite_program): insert cast ops so white-listed ops run in bf16/fp16 and
+black-listed ops run in fp32.  Parameters stay fp32 (master weights); casts
+are folded by XLA into the consuming fusion, so the rewrite costs nothing at
+run time on TPU.
+"""
+
+from __future__ import annotations
+
+from ...framework import unique_name
+
+_FLOAT32 = "float32"
+
+
+def _cast_name(name, dtype):
+    return f"{name}.cast_{dtype}"
+
+
+def _insert_cast(block, idx, src_name, dst_dtype):
+    """Insert a cast op at position idx; returns (dst_name, n_inserted)."""
+    dst_name = _cast_name(src_name, dst_dtype)
+    if block.has_var(dst_name):
+        return dst_name, 0
+    src = block._find_var_recursive(src_name)
+    block.create_var(name=dst_name,
+                     shape=src.shape if src is not None else None,
+                     dtype=dst_dtype, stop_gradient=True)
+    block._insert_op(idx, "cast", inputs={"X": [src_name]},
+                     outputs={"Out": [dst_name]},
+                     attrs={"in_dtype": src.dtype if src is not None else _FLOAT32,
+                            "out_dtype": dst_dtype})
+    return dst_name, 1
+
+
+def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
+    """Walk block 0, casting white-op float32 inputs → dest_dtype and
+    black-op low-precision inputs → float32.  Gray ops pass through (XLA
+    type promotion applies at trace time)."""
+    block = main_program.global_block()
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type in amp_lists.white_list:
+            target, avoid = dest_dtype, _FLOAT32
+        elif op.type in amp_lists.black_list:
+            target, avoid = _FLOAT32, None
+        else:
+            i += 1
+            continue
+        for slot, names in list(op.inputs.items()):
+            new_names = []
+            for n in names:
+                v = block._find_var_recursive(n)
+                # black_varnames only vetoes the DOWNcast — it must never
+                # suppress the fp32-restoring cast on black-listed ops
+                if (v is None or v.dtype not in (_FLOAT32, "float16", "bfloat16")
+                        or (target == dest_dtype and n in amp_lists.black_varnames)
+                        or v.dtype == target):
+                    new_names.append(n)
+                    continue
+                if target == dest_dtype and v.dtype != _FLOAT32:
+                    new_names.append(n)
+                    continue
+                cast_n, inserted = _insert_cast(block, i, n, target)
+                i += inserted
+                new_names.append(cast_n)
+            op.inputs[slot] = new_names
+        # white-op outputs become low precision
+        if target == dest_dtype:
+            for names in op.outputs.values():
+                for n in names:
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.dtype == _FLOAT32:
+                        v.dtype = dest_dtype
+        i += 1
+    main_program._bump_version()
+    return main_program
+
+
+def cast_parameters_to_bf16(*a, **kw):  # pure-bf16 mode: params stay master
+    raise NotImplementedError(
+        "pure bf16 parameter casting is not needed on TPU: keep fp32 master "
+        "weights; white-listed ops consume bf16 casts that XLA fuses")

@@ -277,10 +277,19 @@ class _CompiledBlock:
         self.write_names = plan.write_names
         self._jitted = jax.jit(plan.make_body(), donate_argnums=(0,))
         self.place = place
+        self.label = f"program@{id(program):x}/v{program._version}"
+        self._ran = False
 
     def run(self, scope, feeds, step):
         import jax
 
+        from . import profiler as _prof
+
+        profiled = _prof.is_profiler_enabled()
+        if profiled:
+            import time as _time
+
+            t0 = _time.perf_counter()
         device = self.place.jax_device()
         donated = {}
         for n in self.donated_names:
@@ -297,6 +306,13 @@ class _CompiledBlock:
             )
         for n, v in out_writes.items():
             scope.set(n, v)
+        if profiled:
+            # await scope writes too — a run with an empty fetch_list (or a
+            # startup run) would otherwise record async-dispatch time only
+            jax.block_until_ready((fetches, out_writes))
+            kind = "run" if self._ran else "compile+run"
+            _prof._record(kind, self.label, _time.perf_counter() - t0)
+        self._ran = True
         return fetches
 
 
@@ -368,9 +384,18 @@ class Executor:
         key = (id(program), program._version, feed_sig, tuple(fetch_names), self.place)
         cb = self._cache.get(key)
         if cb is None:
+            import time as _time
+
+            from . import profiler as _prof
+
+            t0 = _time.perf_counter()
             cb = _CompiledBlock(program, block, feed.keys(), fetch_names, self.place, scope)
             self._cache[key] = cb
             self._cache[(key, "pin")] = program  # hold program ref: id() stays unique
+            _prof._record("trace", cb.label, _time.perf_counter() - t0)
+        # run timing ("compile+run" on a signature's first run — jit compiles
+        # lazily — then "run") is recorded inside _CompiledBlock.run so every
+        # execution path shares the instrumentation
         fetches = cb.run(scope, feed, self._step)
         self._step += 1
         if return_numpy:

@@ -1,0 +1,179 @@
+"""Python-side streaming metrics (reference python/paddle/fluid/metrics.py).
+
+Numpy accumulators fed with fetched batch results — identical usage to the
+reference: m = fluid.metrics.Accuracy(); m.update(value=acc, weight=bs);
+m.eval().
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MetricBase", "Accuracy", "Precision", "Recall", "Auc",
+           "EditDistance", "CompositeMetric"]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *a, **kw):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+    def get_config(self):
+        return {"name": self._name}
+
+
+class Accuracy(MetricBase):
+    """Weighted mean of per-batch accuracies."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        if weight < 0:
+            raise ValueError("weight must be nonnegative")
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no batches accumulated: call update() first")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    """Binary-classification precision over streamed (pred, label) batches."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds).reshape(-1) > 0.5).astype("int64")
+        labels = np.asarray(labels).reshape(-1).astype("int64")
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds).reshape(-1) > 0.5).astype("int64")
+        labels = np.asarray(labels).reshape(-1).astype("int64")
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Auc(MetricBase):
+    """Streaming ROC AUC via fixed histogram buckets (reference metrics.py Auc
+    / operators/metrics/auc_op.cc use the same bucketed estimator)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num_thresholds + 1, dtype="int64")
+        self._stat_neg = np.zeros(self._num_thresholds + 1, dtype="int64")
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((preds * self._num_thresholds).astype("int64"), 0,
+                      self._num_thresholds)
+        np.add.at(self._stat_pos, idx[labels == 1], 1)
+        np.add.at(self._stat_neg, idx[labels == 0], 1)
+
+    def eval(self):
+        tot_pos = np.cumsum(self._stat_pos[::-1])
+        tot_neg = np.cumsum(self._stat_neg[::-1])
+        tp = tot_pos.astype("float64")
+        fp = tot_neg.astype("float64")
+        P = tp[-1]
+        N = fp[-1]
+        if P == 0 or N == 0:
+            return 0.0
+        # anchor the curve at the (0,0) origin: without it the sliver below
+        # the first occupied bucket is dropped (e.g. all preds in one bucket)
+        tpr = np.concatenate([[0.0], tp / P])
+        fpr = np.concatenate([[0.0], fp / N])
+        return float(np.trapezoid(tpr, fpr))
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num=None):
+        d = np.asarray(distances).reshape(-1)
+        self.total += float(d.sum())
+        self.count += d.size
+        self.seq_num += seq_num if seq_num is not None else d.size
+        self.instance_error += int((d > 0).sum())
+
+    def eval(self):
+        if self.count == 0:
+            raise ValueError("no batches accumulated")
+        return self.total / self.count, self.instance_error / max(1, self.seq_num)
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]

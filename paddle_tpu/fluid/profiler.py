@@ -1,0 +1,134 @@
+"""Profiler (reference python/paddle/fluid/profiler.py:225 `profiler` context,
+platform/profiler.cc RecordEvent spans, device_tracer.cc CUPTI capture).
+
+TPU-native redesign: the hot loop is one compiled XLA program, so per-op host
+spans don't exist at run time.  What matters on TPU and what this module
+records per program run:
+  - compile events (trace+lower+XLA compile per signature — the TPU analog of
+    kernel-launch overhead)
+  - device execution time per compiled program
+  - host-side `RecordEvent` spans for user code
+Device-level detail (per-fusion timing, HBM traffic) comes from the xplane
+trace: `profiler(...)` wraps `jax.profiler.start_trace/stop_trace`, viewable
+in TensorBoard/XProf — the CUPTI→chrome-trace analog.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "RecordEvent", "record_event", "is_profiler_enabled"]
+
+_STATE = {
+    "enabled": False,
+    "trace_dir": None,
+    "events": [],  # (kind, name, seconds)
+}
+
+
+def is_profiler_enabled():
+    return _STATE["enabled"]
+
+
+def _record(kind, name, seconds):
+    if _STATE["enabled"]:
+        _STATE["events"].append((kind, name, seconds))
+
+
+class RecordEvent:
+    """Host-side RAII span (reference platform/profiler.h RecordEvent)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _record("host", self.name, time.perf_counter() - self._t0)
+        return False
+
+
+@contextlib.contextmanager
+def record_event(name):
+    with RecordEvent(name):
+        yield
+
+
+def start_profiler(state="All", tracer_option=None, trace_dir=None):
+    if _STATE["enabled"]:
+        return
+    _STATE["enabled"] = True
+    _STATE["events"] = []
+    _STATE["trace_dir"] = trace_dir
+    if trace_dir is not None:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    if not _STATE["enabled"]:
+        return
+    _STATE["enabled"] = False
+    if _STATE["trace_dir"] is not None:
+        import jax
+
+        jax.profiler.stop_trace()
+        _STATE["trace_dir"] = None
+    table = _summary(sorted_key)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(table)
+    else:
+        print(table)
+
+
+def reset_profiler():
+    _STATE["events"] = []
+
+
+def _summary(sorted_key=None):
+    rows = {}
+    for kind, name, sec in _STATE["events"]:
+        key = (kind, name)
+        tot, cnt, mx = rows.get(key, (0.0, 0, 0.0))
+        rows[key] = (tot + sec, cnt + 1, max(mx, sec))
+    items = [(k[0], k[1], v[0], v[1], v[0] / v[1], v[2]) for k, v in rows.items()]
+    if sorted_key in (None, "total", "default"):
+        items.sort(key=lambda r: -r[2])
+    elif sorted_key == "calls":
+        items.sort(key=lambda r: -r[3])
+    elif sorted_key == "ave":
+        items.sort(key=lambda r: -r[4])
+    elif sorted_key == "max":
+        items.sort(key=lambda r: -r[5])
+    lines = ["-------------------------     Profiling Report     -------------------------",
+             f"{'Event':<46} {'Kind':<8} {'Calls':>6} {'Total(s)':>10} {'Avg(s)':>10} {'Max(s)':>10}"]
+    for kind, name, tot, cnt, ave, mx in items:
+        lines.append(f"{name[:46]:<46} {kind:<8} {cnt:>6} {tot:>10.5f} {ave:>10.5f} {mx:>10.5f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None, trace_dir=None):
+    """fluid.profiler.profiler context (reference profiler.py:225).
+
+    state/"GPU" kept for signature parity; on TPU pass trace_dir to also
+    capture an xplane trace for XProf/TensorBoard.
+    """
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key=sorted_key, profile_path=profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **kw):  # signature parity (reference profiler.py:39)
+    yield

@@ -1,0 +1,55 @@
+"""AMP support ops (reference operators/amp/check_finite_and_unscale_op.cc,
+update_loss_scaling_op.cc)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.fluid.registry import simple_op
+
+
+@simple_op("check_finite_and_unscale", ["X*", "Scale"], ["Out*", "FoundInfinite"],
+           grad=None)
+def _check_finite_and_unscale(ctx, xs, scale, attrs):
+    """Out_i = X_i / Scale, zeroed when any grad is non-finite.
+
+    Design note: the reference sets FoundInfinite and the trainer *skips* the
+    optimizer step.  Inside one compiled XLA program we gate by zeroing the
+    unscaled grads instead — params stay unchanged on overflow; adaptive
+    moments still observe a zero grad (decay toward zero), a documented
+    deviation that vanishes with bf16 (overflow is virtually impossible).
+    """
+    inv = (1.0 / jnp.reshape(scale, ()).astype(jnp.float32))
+    found = jnp.zeros((), dtype=bool)
+    for x in xs:
+        found = found | ~jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+    gate = jnp.where(found, 0.0, 1.0).astype(jnp.float32)
+    outs = tuple((x.astype(jnp.float32) * inv * gate).astype(x.dtype) for x in xs)
+    return outs, jnp.reshape(found, (1,))
+
+
+@simple_op("update_loss_scaling",
+           ["PrevLossScaling", "FoundInfinite", "InGoodSteps", "InBadSteps"],
+           ["LossScaling", "OutGoodSteps", "OutBadSteps"], grad=None,
+           inplace={"LossScaling": "PrevLossScaling",
+                    "OutGoodSteps": "InGoodSteps", "OutBadSteps": "InBadSteps"})
+def _update_loss_scaling(ctx, scale, found_inf, good, bad, attrs):
+    incr_n = attrs.get("incr_every_n_steps", 1000)
+    decr_n = attrs.get("decr_every_n_nan_or_inf", 2)
+    incr_ratio = attrs.get("incr_ratio", 2.0)
+    decr_ratio = attrs.get("decr_ratio", 0.5)
+    f = jnp.reshape(found_inf, ()).astype(bool)
+    s = jnp.reshape(scale, ()).astype(jnp.float32)
+    g = jnp.reshape(good, ()).astype(jnp.int32)
+    b = jnp.reshape(bad, ()).astype(jnp.int32)
+    g_new = jnp.where(f, 0, g + 1)
+    b_new = jnp.where(f, b + 1, 0)
+    decr = b_new >= decr_n
+    incr = g_new >= incr_n
+    s_new = jnp.where(decr, jnp.maximum(s * decr_ratio, 1.0),
+                      jnp.where(incr, s * incr_ratio, s))
+    g_new = jnp.where(incr | decr, 0, g_new)
+    b_new = jnp.where(decr, 0, b_new)
+    return (jnp.reshape(s_new, jnp.shape(scale)).astype(scale.dtype),
+            jnp.reshape(g_new, jnp.shape(good)).astype(good.dtype),
+            jnp.reshape(b_new, jnp.shape(bad)).astype(bad.dtype))

@@ -1,0 +1,156 @@
+"""AMP (bf16 rewrite + loss scaling), metrics, and profiler tests."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+from paddle_tpu.fluid.contrib import mixed_precision as mp
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+
+def build_mlp_amp(optimizer):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        logits = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        optimizer.minimize(loss, startup_program=startup)
+    return main, startup, loss
+
+
+def make_batch(i, n=64):
+    rng = np.random.RandomState(i)
+    x = rng.uniform(-1, 1, (n, 16)).astype("float32")
+    lbl = (x[:, :4].argmax(axis=1)).astype("int64").reshape(n, 1)
+    return {"x": x, "y": lbl}
+
+
+def test_amp_bf16_rewrite_and_training():
+    opt = mp.decorate(fluid.optimizer.Adam(learning_rate=5e-3))
+    main, startup, loss = build_mlp_amp(opt)
+    # the rewrite inserted casts and made matmul outputs bf16
+    ops = main.global_block().ops
+    cast_ops = [op for op in ops if op.type == "cast"]
+    assert cast_ops, "expected cast insertion for white-listed mul ops"
+    mul_ops = [op for op in ops if op.type == "mul"]
+    assert mul_ops
+    blk = main.global_block()
+    for op in mul_ops:
+        for n in op.input_arg_names:
+            assert blk._find_var_recursive(n).dtype in ("bfloat16", "int64"), n
+    s = Scope()
+    with scope_guard(s):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for i in range(200):
+            (lv,) = exe.run(main, feed=make_batch(i % 20), fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0] * 0.4, (losses[0], losses[-1])
+    assert losses[-1] < 0.4, losses[-1]
+
+
+def test_amp_dynamic_loss_scaling_fp16_parity():
+    opt = mp.decorate(fluid.optimizer.SGD(learning_rate=1e-2),
+                      init_loss_scaling=2.0**10, dest_dtype="float16",
+                      use_dynamic_loss_scaling=True,
+                      incr_every_n_steps=4, decr_every_n_nan_or_inf=1)
+    main, startup, loss = build_mlp_amp(opt)
+    scaling_name = opt.get_loss_scaling().name
+    s = Scope()
+    with scope_guard(s):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for i in range(5):
+            exe.run(main, feed=make_batch(i), fetch_list=[loss.name])
+        sc = float(np.asarray(s.get(scaling_name)).reshape(-1)[0])
+        # 5 finite steps with incr_every_n_steps=4 → scaling grew once
+        assert sc == 2.0**11, sc
+        # poison a batch: found_inf → scaling halves-ish (decr_ratio=0.8)
+        bad = make_batch(99)
+        bad["x"][0, 0] = np.inf
+        exe.run(main, feed=bad, fetch_list=[loss.name])
+        sc2 = float(np.asarray(s.get(scaling_name)).reshape(-1)[0])
+        assert sc2 < sc, (sc, sc2)
+
+
+def test_update_loss_scaling_op_semantics():
+    from paddle_tpu.fluid import registry
+
+    info = registry.get_op("update_loss_scaling")
+    ctx = registry.LowerContext()
+    s, g, b = (np.float32([1024.0]), np.int32([3]), np.int32([0]))
+    # finite step: good+1
+    s2, g2, b2 = info.lower(ctx, s, np.array([False]), g, b,
+                            attrs={"incr_every_n_steps": 4})
+    assert float(s2[0]) == 2048.0 and int(g2[0]) == 0  # hit incr boundary
+    # overflow step: scaling decreases
+    s3, g3, b3 = info.lower(ctx, s, np.array([True]), g, b,
+                            attrs={"decr_every_n_nan_or_inf": 1,
+                                   "decr_ratio": 0.5})
+    assert float(s3[0]) == 512.0 and int(b3[0]) == 0 and int(g3[0]) == 0
+
+
+def test_metrics():
+    m = fluid.metrics.Accuracy()
+    m.update(value=0.8, weight=10)
+    m.update(value=0.6, weight=30)
+    assert abs(m.eval() - 0.65) < 1e-9
+
+    p = fluid.metrics.Precision()
+    p.update(preds=np.array([0.9, 0.8, 0.2]), labels=np.array([1, 0, 1]))
+    assert abs(p.eval() - 0.5) < 1e-9
+
+    r = fluid.metrics.Recall()
+    r.update(preds=np.array([0.9, 0.8, 0.2]), labels=np.array([1, 0, 1]))
+    assert abs(r.eval() - 0.5) < 1e-9
+
+    auc = fluid.metrics.Auc()
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, 2000)
+    # predictive scores: noisy but correlated with labels
+    scores = np.clip(0.3 * labels + 0.35 + 0.25 * rng.randn(2000), 0, 1)
+    auc.update(preds=scores, labels=labels)
+    v = auc.eval()
+    assert 0.7 < v < 0.95, v
+
+    e = fluid.metrics.EditDistance()
+    e.update(np.array([0.0, 2.0, 1.0]))
+    avg, err = e.eval()
+    assert abs(avg - 1.0) < 1e-9 and abs(err - 2 / 3) < 1e-9
+
+
+def test_profiler_records_compile_and_run():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(input=x, size=2)
+    s = Scope()
+    with scope_guard(s):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            with fluid.profiler.profiler(sorted_key="total"):
+                with fluid.profiler.RecordEvent("user_span"):
+                    for _ in range(3):
+                        exe.run(main, feed={"x": np.zeros((2, 4), "float32")},
+                                fetch_list=[out.name])
+        rep = buf.getvalue()
+    assert "Profiling Report" in rep
+    assert "compile+run" in rep and "user_span" in rep
+    assert " run" in rep  # steady-state runs recorded separately
+
+
+def test_auc_origin_anchor():
+    """All predictions in one bucket must still yield 0.5 (regression: the
+    (0,0) ROC origin anchor)."""
+    auc = fluid.metrics.Auc()
+    auc.update(preds=np.array([1.0, 1.0]), labels=np.array([1, 0]))
+    assert abs(auc.eval() - 0.5) < 1e-9

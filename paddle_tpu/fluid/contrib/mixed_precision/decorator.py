@@ -49,6 +49,7 @@ class OptimizerWithMixedPrecision:
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
         program = loss.block.program
+        self._startup_program = startup_program
         with framework.program_guard(program, startup_program):
             rewrite_program(program, self._amp_lists, self._dest_dtype)
             self._loss_scaling = self._create_scalar(
@@ -68,7 +69,15 @@ class OptimizerWithMixedPrecision:
         return params_grads
 
     def apply_gradients(self, params_grads):
+        if not params_grads:
+            return self._optimizer.apply_gradients(params_grads)
         program = params_grads[0][0].block.program
+        # good/bad-step scalars and their initializers must land in the
+        # program being optimized (and its startup), not the ambient defaults
+        with framework.program_guard(program, getattr(self, "_startup_program", None)):
+            return self._apply_gradients_impl(program, params_grads)
+
+    def _apply_gradients_impl(self, program, params_grads):
         block = program.global_block()
         grad_names = [g.name for _, g in params_grads]
         found_inf = block.create_var(

@@ -31,6 +31,10 @@ class AutoMixedPrecisionLists:
         self.white_list = set(white_list)
         self.black_list = set(black_list)
         self.black_varnames = set(custom_black_varnames or ())
+        overlap = set(custom_white_list or ()) & set(custom_black_list or ())
+        if overlap:
+            raise ValueError(
+                f"ops in both custom white and black lists: {overlap}")
         if custom_white_list:
             for op in custom_white_list:
                 self.white_list.add(op)
@@ -39,6 +43,3 @@ class AutoMixedPrecisionLists:
             for op in custom_black_list:
                 self.black_list.add(op)
                 self.white_list.discard(op)
-        overlap = self.white_list & self.black_list
-        if overlap:
-            raise ValueError(f"ops in both white and black lists: {overlap}")

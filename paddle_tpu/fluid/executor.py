@@ -278,41 +278,33 @@ class _CompiledBlock:
         self._jitted = jax.jit(plan.make_body(), donate_argnums=(0,))
         self.place = place
         self.label = f"program@{id(program):x}/v{program._version}"
-        self._ran = False
+        self._prof_state = {"ran": False}
 
     def run(self, scope, feeds, step):
         import jax
 
         from . import profiler as _prof
 
-        profiled = _prof.is_profiler_enabled()
-        if profiled:
-            import time as _time
-
-            t0 = _time.perf_counter()
-        device = self.place.jax_device()
-        donated = {}
-        for n in self.donated_names:
-            v = scope.get(n)
-            donated[n] = jax.device_put(v, device)
-        readonly = {}
-        for n in self.readonly_names:
-            readonly[n] = jax.device_put(scope.get(n), device)
-        feed_vals = {k: jax.device_put(v, device) for k, v in feeds.items()}
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore")  # donation unsupported on CPU backend
-            fetches, out_writes = self._jitted(
-                donated, readonly, feed_vals, np.uint32(step)
-            )
-        for n, v in out_writes.items():
-            scope.set(n, v)
-        if profiled:
-            # await scope writes too — a run with an empty fetch_list (or a
-            # startup run) would otherwise record async-dispatch time only
-            jax.block_until_ready((fetches, out_writes))
-            kind = "run" if self._ran else "compile+run"
-            _prof._record(kind, self.label, _time.perf_counter() - t0)
-        self._ran = True
+        with _prof.timed_run(self.label, self._prof_state) as timer:
+            device = self.place.jax_device()
+            donated = {}
+            for n in self.donated_names:
+                v = scope.get(n)
+                donated[n] = jax.device_put(v, device)
+            readonly = {}
+            for n in self.readonly_names:
+                readonly[n] = jax.device_put(scope.get(n), device)
+            feed_vals = {k: jax.device_put(v, device) for k, v in feeds.items()}
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # donation unsupported on CPU backend
+                fetches, out_writes = self._jitted(
+                    donated, readonly, feed_vals, np.uint32(step)
+                )
+            for n, v in out_writes.items():
+                scope.set(n, v)
+            # block on scope writes too — a run with an empty fetch_list (or
+            # a startup run) would otherwise record async-dispatch time only
+            timer.done(fetches, out_writes)
         return fetches
 
 

@@ -60,6 +60,44 @@ def record_event(name):
         yield
 
 
+class timed_run:
+    """Shared executor-run instrumentation: times the wrapped run, blocks on
+    the arrays passed to ``done()`` (so async dispatch isn't mistaken for
+    execution), and books a signature's first run as "compile+run" (jit
+    compiles lazily).  Used by the single-device, shard_map-dp, and GSPMD
+    hybrid execution paths — one implementation, no drift.
+
+    with timed_run(label, state) as t:   # state: mutable dict, "ran" key
+        out = jitted(...)
+        t.done(out)
+    """
+
+    def __init__(self, label, state):
+        self.enabled = is_profiler_enabled()
+        self.label = label
+        self.state = state
+        self._arrays = ()
+
+    def __enter__(self):
+        if self.enabled:
+            self._t0 = time.perf_counter()
+        return self
+
+    def done(self, *arrays):
+        self._arrays = arrays
+
+    def __exit__(self, et, ev, tb):
+        if self.enabled and et is None:
+            import jax
+
+            jax.block_until_ready(self._arrays)
+            kind = "run" if self.state.get("ran") else "compile+run"
+            _record(kind, self.label, time.perf_counter() - self._t0)
+        if et is None:
+            self.state["ran"] = True
+        return False
+
+
 def start_profiler(state="All", tracer_option=None, trace_dir=None):
     if _STATE["enabled"]:
         return

@@ -172,25 +172,16 @@ class _ShardedBlock:
 
         from paddle_tpu.fluid import profiler as _prof
 
-        profiled = _prof.is_profiler_enabled()
-        if profiled:
-            import time as _time
-
-            t0 = _time.perf_counter()
-        donated = {n: scope.get(n) for n in self.donated_names}
-        readonly = {n: scope.get(n) for n in self.readonly_names}
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore")
-            fetches, out_writes = self._jitted(donated, readonly, dict(feeds),
-                                               np.uint32(step))
-        for n, v in out_writes.items():
-            scope.set(n, v)
-        if profiled:
-            import jax
-
-            jax.block_until_ready((fetches, out_writes))
-            kind = "run" if getattr(self, "_ran", False) else "compile+run"
-            _prof._record(kind, f"dp_block@{id(self):x}",
-                          _time.perf_counter() - t0)
-        self._ran = True
+        if not hasattr(self, "_prof_state"):
+            self._prof_state = {"ran": False}
+        with _prof.timed_run(f"dp_block@{id(self):x}", self._prof_state) as timer:
+            donated = {n: scope.get(n) for n in self.donated_names}
+            readonly = {n: scope.get(n) for n in self.readonly_names}
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                fetches, out_writes = self._jitted(donated, readonly, dict(feeds),
+                                                   np.uint32(step))
+            for n, v in out_writes.items():
+                scope.set(n, v)
+            timer.done(fetches, out_writes)
         return fetches

@@ -203,29 +203,22 @@ class HybridParallelRunner:
             in_shardings=(don_sh, ro_sh, feeds_sh, self._spec()),
             out_shardings=out_sh,
             donate_argnums=(0,))
+        prof_state = {"ran": False}
 
         def compiled(scope_, feeds, step):
             don_vals = {n: scope_.get(n) for n in donated}
             ro_vals = {n: scope_.get(n) for n in readonly}
             from paddle_tpu.fluid import profiler as _prof
 
-            profiled = _prof.is_profiler_enabled()
-            if profiled:
-                import time as _time
-
-                t0 = _time.perf_counter()
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")  # donation unsupported on CPU
-                fetches, out_writes = jitted(
-                    don_vals, ro_vals, dict(feeds), np.uint32(step))
-            for n, v in out_writes.items():
-                scope_.set(n, v)
-            if profiled:
-                import jax as _jax
-
-                _jax.block_until_ready((fetches, out_writes))
-                _prof._record("run", f"hybrid_block@{id(jitted):x}",
-                              _time.perf_counter() - t0)
+            with _prof.timed_run(f"hybrid_block@{id(jitted):x}",
+                                 prof_state) as timer:
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")  # donation unsupported on CPU
+                    fetches, out_writes = jitted(
+                        don_vals, ro_vals, dict(feeds), np.uint32(step))
+                for n, v in out_writes.items():
+                    scope_.set(n, v)
+                timer.done(fetches, out_writes)
             return fetches
 
         return compiled

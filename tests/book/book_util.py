@@ -1,0 +1,76 @@
+"""Shared harness for the book tests (reference tests/book/ — 8 end-to-end
+train→save→load→infer workloads)."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # for conftest env
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+from paddle_tpu import fluid  # noqa: E402
+from paddle_tpu.fluid import io  # noqa: E402
+from paddle_tpu.fluid.executor import Scope, scope_guard  # noqa: E402
+
+
+def train_save_load_infer(build_fn, reader_fn, tmp_path, epochs=4,
+                          loss_threshold=None, lr=None, optimizer=None,
+                          feed_names=None, infer_feed=None):
+    """Generic book-test skeleton:
+      build_fn() -> (feeds: [Variable], loss, extra_fetch: dict name->var)
+      reader_fn() -> iterator of feed dicts
+    Trains, asserts loss threshold, saves inference model, reloads it in a
+    fresh scope, checks prediction parity against the training program.
+    """
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, loss, predict = build_fn()
+        opt = optimizer() if optimizer else fluid.optimizer.Adam(
+            learning_rate=lr or 1e-3)
+        opt.minimize(loss)
+
+    scope = Scope()
+    losses = []
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(epochs):
+            for feed in reader_fn():
+                (lv,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+                losses.append(float(np.asarray(lv)))
+        if loss_threshold is not None:
+            tail = float(np.mean(losses[-5:]))
+            assert tail < loss_threshold, (
+                f"loss {tail} (first {losses[0]}) above {loss_threshold}")
+
+        feed_names = feed_names or [f.name for f in feeds]
+        d = str(tmp_path / "model")
+        io.save_inference_model(d, feed_names, [predict], exe, main_program=main)
+        infer_feed = infer_feed if infer_feed is not None else {
+            n: f for n, f in next(iter(reader_fn())).items() if n in feed_names}
+        (expected,) = exe.run(main.clone(for_test=True), feed=infer_feed,
+                              fetch_list=[predict.name])
+
+    s2 = Scope()
+    with scope_guard(s2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        prog, fns, fetches = io.load_inference_model(d, exe2)
+        assert set(fns) == set(feed_names)
+        (got,) = exe2.run(prog, feed={n: infer_feed[n] for n in fns},
+                          fetch_list=[fetches[0].name])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-5)
+    return losses
+
+
+def batched_feed(dataset_reader, batch_size, to_feed, drop_last=True):
+    """dataset reader creator -> iterator of feed dicts via to_feed(batch)."""
+    import paddle_tpu as paddle
+
+    def gen():
+        for batch in paddle.batch(dataset_reader, batch_size,
+                                  drop_last=drop_last)():
+            yield to_feed(batch)
+
+    return gen

@@ -1,0 +1,28 @@
+"""Book 01: linear regression on uci_housing
+(reference tests/book/test_fit_a_line.py:27-80)."""
+
+import numpy as np
+
+from book_util import batched_feed, train_save_load_infer
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def test_fit_a_line(tmp_path):
+    def build():
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        return [x], loss, pred
+
+    def to_feed(batch):
+        return {"x": np.stack([s[0] for s in batch]),
+                "y": np.stack([s[1] for s in batch])}
+
+    reader = batched_feed(paddle.dataset.uci_housing.train(), 101, to_feed)
+    losses = train_save_load_infer(
+        build, reader, tmp_path, epochs=30, loss_threshold=0.05,
+        optimizer=lambda: fluid.optimizer.SGD(learning_rate=0.05))
+    assert losses[-1] < losses[0]

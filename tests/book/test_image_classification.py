@@ -1,0 +1,74 @@
+"""Book 03: image classification on cifar-shaped data — small VGG and a
+ResNet tower (reference tests/book/test_image_classification.py with
+vgg16_bn/resnet_cifar10)."""
+
+import numpy as np
+
+from book_util import train_save_load_infer
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+from paddle_tpu.models import resnet as resnet_mod
+
+
+def to_feed(batch):
+    return {"img": np.stack([s[0] for s in batch]).astype("float32"),
+            "label": np.array([[s[1]] for s in batch], dtype="int64")}
+
+
+def _tail(feat, label):
+    logits = fluid.layers.fc(input=feat, size=10)
+    sm = fluid.layers.softmax(logits)
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(sm, label))
+    return sm, loss
+
+
+def test_image_classification_vgg(tmp_path):
+    def build():
+        img = fluid.layers.data(name="img", shape=[3072], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        x = fluid.layers.reshape(img, shape=[-1, 3, 32, 32])
+        g1 = fluid.nets.img_conv_group(
+            x, conv_num_filter=[8, 8], pool_size=2, conv_act="relu",
+            conv_with_batchnorm=True, pool_stride=2)
+        g2 = fluid.nets.img_conv_group(
+            g1, conv_num_filter=[16, 16], pool_size=2, conv_act="relu",
+            conv_with_batchnorm=True, pool_stride=2)
+        flat = fluid.layers.flatten(g2, axis=1)
+        fc1 = fluid.layers.fc(input=flat, size=64, act="relu")
+        pred, loss = _tail(fc1, label)
+        return [img], loss, pred
+
+    data = paddle.dataset.cifar.train10()
+
+    def reader():
+        for b in paddle.batch(data, 128, drop_last=True)():
+            yield to_feed(b)
+
+    train_save_load_infer(build, reader, tmp_path, epochs=4,
+                          loss_threshold=1.0, lr=2e-3)
+
+
+def test_image_classification_resnet(tmp_path):
+    def build():
+        img = fluid.layers.data(name="img", shape=[3072], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        x = fluid.layers.reshape(img, shape=[-1, 3, 32, 32])
+        # cifar-style mini resnet: conv + 2 basic blocks + global pool
+        c = resnet_mod.conv_bn_layer(x, 8, 3, stride=1, act="relu",
+                                     name="c0")
+        b1 = resnet_mod.basic_block(c, 8, 1, name="b1")
+        b2 = resnet_mod.basic_block(b1, 16, 2, name="b2")
+        pool = fluid.layers.pool2d(b2, pool_type="avg", global_pooling=True)
+        flat = fluid.layers.flatten(pool, axis=1)
+        pred, loss = _tail(flat, label)
+        return [img], loss, pred
+
+    data = paddle.dataset.cifar.train10()
+
+    def reader():
+        for b in paddle.batch(data, 128, drop_last=True)():
+            yield to_feed(b)
+
+    train_save_load_infer(build, reader, tmp_path, epochs=7,
+                          loss_threshold=2.0, lr=3e-3)

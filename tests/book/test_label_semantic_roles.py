@@ -1,0 +1,84 @@
+"""Book 07: semantic role labeling — per-token tagger over conll05-shaped
+data (reference tests/book/test_label_semantic_roles.py; the reference's
+linear_chain_crf decodes with a CRF — here a masked per-token softmax tagger,
+the dense-padded TPU formulation)."""
+
+import numpy as np
+
+from book_util import train_save_load_infer
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+word_dict, verb_dict, label_dict = paddle.dataset.conll05.get_dict()
+WORD_V = len(word_dict)
+PRED_V = len(verb_dict)
+N_LABELS = len(label_dict)
+EMB = 16
+HID = 32
+MAXLEN = 12
+BATCH = 128
+
+
+def _pad(ids, L, pad=0):
+    out = np.full(L, pad, dtype="int64")
+    n = min(len(ids), L)
+    out[:n] = ids[:n]
+    return out, n
+
+
+def to_feed(batch):
+    slots = {n: [] for n in ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1",
+                             "ctx_p2", "pred", "mark", "label"]}
+    masks = []
+    for s in batch:
+        names = list(slots)
+        for i, n in enumerate(names):
+            arr, L = _pad(s[i], MAXLEN)
+            slots[n].append(arr)
+        masks.append((np.arange(MAXLEN) < L).astype("float32"))
+    feed = {n: np.stack(v) for n, v in slots.items()}
+    feed["mask"] = np.stack(masks)
+    return feed
+
+
+def build():
+    names = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2"]
+    ins = [fluid.layers.data(name=n, shape=[MAXLEN], dtype="int64")
+           for n in names]
+    pred = fluid.layers.data(name="pred", shape=[MAXLEN], dtype="int64")
+    mark = fluid.layers.data(name="mark", shape=[MAXLEN], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[MAXLEN], dtype="int64")
+    mask = fluid.layers.data(name="mask", shape=[MAXLEN], dtype="float32")
+
+    embs = [fluid.layers.embedding(
+        x, size=[WORD_V, EMB],
+        param_attr=fluid.ParamAttr(name="word_emb")) for x in ins]
+    embs.append(fluid.layers.embedding(pred, size=[PRED_V, EMB]))
+    embs.append(fluid.layers.embedding(mark, size=[2, EMB // 2]))
+    feat = fluid.layers.concat(embs, axis=2)  # [B,L,sum_emb]
+    h = fluid.layers.fc(input=feat, size=HID, act="tanh", num_flatten_dims=2)
+    logits = fluid.layers.fc(input=h, size=N_LABELS, num_flatten_dims=2)
+    lbl = fluid.layers.unsqueeze(label, axes=[2])
+    ce = fluid.layers.softmax_with_cross_entropy(logits, lbl)
+    ce = fluid.layers.squeeze(ce, axes=[2])
+    loss = fluid.layers.reduce_sum(ce * mask) / (
+        fluid.layers.reduce_sum(mask) + 1e-6)
+    feeds = ins + [pred, mark]
+    return feeds, loss, logits
+
+
+def test_label_semantic_roles(tmp_path):
+    data = paddle.dataset.conll05.train()
+
+    def reader():
+        for b in paddle.batch(data, BATCH, drop_last=True)():
+            yield to_feed(b)
+
+    losses = train_save_load_infer(
+        build, reader, tmp_path, epochs=14, lr=8e-3,
+        feed_names=["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2",
+                    "pred", "mark"])
+    # labels are |i - pred_pos| clipped — learnable from mark+position context;
+    # random = ln(10) ≈ 2.3
+    assert np.mean(losses[-4:]) < 1.1, np.mean(losses[-4:])

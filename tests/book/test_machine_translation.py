@@ -1,0 +1,122 @@
+"""Book 06: seq2seq machine translation — GRU encoder + GRU decoder built on
+StaticRNN, padded/bucketed sequences with masked loss
+(reference tests/book/test_machine_translation.py + test_rnn_encoder_decoder.py;
+the reference's LoD dynamic RNN becomes fixed-shape scan on TPU — see
+SURVEY.md §5 long-context note).
+"""
+
+import numpy as np
+
+from book_util import train_save_load_infer
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+DICT = 64
+EMB = 32
+HID = 32
+SRC_LEN = 9
+TRG_LEN = 10
+BATCH = 64
+
+
+def _gru_cell(x_t, h_prev, hidden, prefix):
+    """One GRU step from matmul primitives (no cuDNN-style fused op needed:
+    XLA fuses the scan body)."""
+    gates = fluid.layers.fc(input=x_t, size=2 * hidden,
+                            param_attr=fluid.ParamAttr(name=f"{prefix}_xg"),
+                            bias_attr=fluid.ParamAttr(name=f"{prefix}_bg"))
+    gates = gates + fluid.layers.fc(
+        input=h_prev, size=2 * hidden, bias_attr=False,
+        param_attr=fluid.ParamAttr(name=f"{prefix}_hg"))
+    gates = fluid.layers.sigmoid(gates)
+    u = fluid.layers.slice(gates, axes=[1], starts=[0], ends=[hidden])
+    r = fluid.layers.slice(gates, axes=[1], starts=[hidden], ends=[2 * hidden])
+    cand = fluid.layers.fc(input=x_t, size=hidden,
+                           param_attr=fluid.ParamAttr(name=f"{prefix}_xc"),
+                           bias_attr=fluid.ParamAttr(name=f"{prefix}_bc"))
+    cand = cand + fluid.layers.fc(
+        input=r * h_prev, size=hidden, bias_attr=False,
+        param_attr=fluid.ParamAttr(name=f"{prefix}_hc"))
+    cand = fluid.layers.tanh(cand)
+    one_minus_u = fluid.layers.scale(u, scale=-1.0, bias=1.0)
+    return one_minus_u * h_prev + u * cand
+
+
+def _pad_to(ids, L, pad=1):  # pad with EOS
+    out = np.full(L, pad, dtype="int64")
+    n = min(len(ids), L)
+    out[:n] = ids[:n]
+    return out, n
+
+
+def to_feed(batch):
+    src = np.stack([_pad_to(s[0], SRC_LEN)[0] for s in batch])
+    trg = np.stack([_pad_to(s[1], TRG_LEN)[0] for s in batch])
+    nxt = np.stack([_pad_to(s[2], TRG_LEN)[0] for s in batch])
+    mask = np.stack([
+        (np.arange(TRG_LEN) < _pad_to(s[2], TRG_LEN)[1]).astype("float32")
+        for s in batch])
+    return {"src": src, "trg": trg, "trg_next": nxt, "mask": mask}
+
+
+def build():
+    src = fluid.layers.data(name="src", shape=[SRC_LEN], dtype="int64")
+    trg = fluid.layers.data(name="trg", shape=[TRG_LEN], dtype="int64")
+    trg_next = fluid.layers.data(name="trg_next", shape=[TRG_LEN], dtype="int64")
+    mask = fluid.layers.data(name="mask", shape=[TRG_LEN], dtype="float32")
+
+    # encoder
+    src_emb = fluid.layers.embedding(src, size=[DICT, EMB])  # [B,S,E]
+    src_tm = fluid.layers.transpose(src_emb, perm=[1, 0, 2])  # time-major
+    h0 = fluid.layers.fill_constant_batch_size_like(
+        input=src, shape=[-1, HID], dtype="float32", value=0.0)
+    enc = fluid.layers.StaticRNN()
+    with enc.step():
+        x_t = enc.step_input(src_tm)
+        h_prev = enc.memory(init=h0)
+        h = _gru_cell(x_t, h_prev, HID, "enc")
+        enc.update_memory(h_prev, h)
+        enc.step_output(h)
+    enc_states = enc()  # [S,B,H]
+    enc_last = fluid.layers.slice(enc_states, axes=[0],
+                                  starts=[SRC_LEN - 1], ends=[SRC_LEN])
+    enc_last = fluid.layers.reshape(enc_last, shape=[-1, HID])
+
+    # decoder (teacher forcing)
+    trg_emb = fluid.layers.embedding(trg, size=[DICT, EMB])
+    trg_tm = fluid.layers.transpose(trg_emb, perm=[1, 0, 2])
+    dec = fluid.layers.StaticRNN()
+    with dec.step():
+        y_t = dec.step_input(trg_tm)
+        h_prev = dec.memory(init=enc_last)
+        h = _gru_cell(y_t, h_prev, HID, "dec")
+        dec.update_memory(h_prev, h)
+        logits_t = fluid.layers.fc(
+            input=h, size=DICT,
+            param_attr=fluid.ParamAttr(name="out_w"),
+            bias_attr=fluid.ParamAttr(name="out_b"))
+        dec.step_output(logits_t)
+    logits = dec()  # [T,B,V]
+    logits_bm = fluid.layers.transpose(logits, perm=[1, 0, 2])  # [B,T,V]
+
+    lbl = fluid.layers.unsqueeze(trg_next, axes=[2])  # [B,T,1]
+    ce = fluid.layers.softmax_with_cross_entropy(logits_bm, lbl)
+    ce = fluid.layers.squeeze(ce, axes=[2])
+    masked = ce * mask
+    loss = fluid.layers.reduce_sum(masked) / (fluid.layers.reduce_sum(mask) + 1e-6)
+    return [src, trg], loss, logits_bm
+
+
+def test_machine_translation(tmp_path):
+    data = paddle.dataset.wmt16.train(DICT, DICT)
+
+    def reader():
+        for b in paddle.batch(data, BATCH, drop_last=True)():
+            yield to_feed(b)
+
+    losses = train_save_load_infer(
+        build, reader, tmp_path, epochs=12, lr=8e-3,
+        feed_names=["src", "trg"])
+    # deterministic reverse+permute mapping is fully learnable; random = ln(64)≈4.16
+    assert np.mean(losses[-4:]) < 2.5, np.mean(losses[-4:])

@@ -1,0 +1,56 @@
+"""Book 02: digit recognition, MLP and conv variants
+(reference tests/book/test_recognize_digits.py)."""
+
+import numpy as np
+
+from book_util import batched_feed, train_save_load_infer
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def to_feed(batch):
+    return {"img": np.stack([s[0] for s in batch]).astype("float32"),
+            "label": np.array([[s[1]] for s in batch], dtype="int64")}
+
+
+def _classifier_tail(feature, label):
+    logits = fluid.layers.fc(input=feature, size=10)
+    sm = fluid.layers.softmax(logits)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=sm, label=label))
+    return sm, loss
+
+
+def test_recognize_digits_mlp(tmp_path):
+    def build():
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h1 = fluid.layers.fc(input=img, size=128, act="relu")
+        h2 = fluid.layers.fc(input=h1, size=64, act="relu")
+        pred, loss = _classifier_tail(h2, label)
+        return [img], loss, pred
+
+    reader = batched_feed(paddle.dataset.mnist.train(), 128, to_feed)
+    train_save_load_infer(build, reader, tmp_path, epochs=3,
+                          loss_threshold=0.25, lr=1e-3)
+
+
+def test_recognize_digits_conv(tmp_path):
+    def build():
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        img4 = fluid.layers.reshape(img, shape=[-1, 1, 28, 28])
+        c1 = fluid.nets.simple_img_conv_pool(
+            input=img4, filter_size=5, num_filters=8, pool_size=2,
+            pool_stride=2, act="relu")
+        c2 = fluid.nets.simple_img_conv_pool(
+            input=c1, filter_size=5, num_filters=16, pool_size=2,
+            pool_stride=2, act="relu")
+        flat = fluid.layers.flatten(c2, axis=1)
+        pred, loss = _classifier_tail(flat, label)
+        return [img], loss, pred
+
+    reader = batched_feed(paddle.dataset.mnist.train(), 128, to_feed)
+    train_save_load_infer(build, reader, tmp_path, epochs=6,
+                          loss_threshold=1.0, lr=3e-3)

@@ -1,0 +1,90 @@
+"""Book 05: recommender (DSSM-style two towers + cos_sim → scale to rating)
+(reference tests/book/test_recommender_system.py)."""
+
+import numpy as np
+
+from book_util import batched_feed, train_save_load_infer
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+ml = paddle.dataset.movielens
+EMB = 16
+MAX_CATS = 4
+MAX_TITLE = 6
+
+
+def _pad(ids, maxlen):
+    out = np.zeros(maxlen, dtype="int64")
+    n = min(len(ids), maxlen)
+    out[:n] = ids[:n]
+    return out, n
+
+
+def to_feed(batch):
+    f = {
+        "uid": np.array([[s[0]] for s in batch], dtype="int64"),
+        "gender": np.array([[s[1]] for s in batch], dtype="int64"),
+        "age": np.array([[s[2]] for s in batch], dtype="int64"),
+        "job": np.array([[s[3]] for s in batch], dtype="int64"),
+        "mid": np.array([[s[4]] for s in batch], dtype="int64"),
+        "score": np.array([[s[7]] for s in batch], dtype="float32"),
+    }
+    cats, clens, titles, tlens = [], [], [], []
+    for s in batch:
+        c, cl = _pad(s[5], MAX_CATS)
+        t, tl = _pad(s[6], MAX_TITLE)
+        cats.append(c), clens.append(cl), titles.append(t), tlens.append(tl)
+    f["cats"] = np.stack(cats)
+    f["cats_len"] = np.array(clens, dtype="int32")
+    f["title"] = np.stack(titles)
+    f["title_len"] = np.array(tlens, dtype="int32")
+    return f
+
+
+def test_recommender_system(tmp_path):
+    def build():
+        uid = fluid.layers.data(name="uid", shape=[1], dtype="int64")
+        gender = fluid.layers.data(name="gender", shape=[1], dtype="int64")
+        age = fluid.layers.data(name="age", shape=[1], dtype="int64")
+        job = fluid.layers.data(name="job", shape=[1], dtype="int64")
+        mid = fluid.layers.data(name="mid", shape=[1], dtype="int64")
+        cats = fluid.layers.data(name="cats", shape=[MAX_CATS], dtype="int64",
+                                 append_batch_size=True)
+        cats_len = fluid.layers.data(name="cats_len", shape=[],
+                                     dtype="int32", append_batch_size=True)
+        title = fluid.layers.data(name="title", shape=[MAX_TITLE], dtype="int64")
+        title_len = fluid.layers.data(name="title_len", shape=[], dtype="int32")
+        score = fluid.layers.data(name="score", shape=[1], dtype="float32")
+
+        # user tower
+        usr_emb = fluid.layers.embedding(uid, size=[ml.max_user_id() + 1, EMB])
+        usr_g = fluid.layers.embedding(gender, size=[2, EMB // 2])
+        usr_a = fluid.layers.embedding(age, size=[8, EMB // 2])
+        usr_j = fluid.layers.embedding(job, size=[ml.max_job_id() + 1, EMB // 2])
+        usr_feat = fluid.layers.concat([usr_emb, usr_g, usr_a, usr_j], axis=1)
+        usr = fluid.layers.fc(input=usr_feat, size=32, act="tanh")
+
+        # movie tower: id + pooled category + pooled title embeddings
+        mov_emb = fluid.layers.embedding(mid, size=[ml.max_movie_id() + 1, EMB])
+        cat_emb = fluid.layers.embedding(
+            cats, size=[len(ml.movie_categories()) + 1, EMB // 2])
+        cat_pool = fluid.layers.sequence_pool(cat_emb, "average", length=cats_len)
+        ttl_emb = fluid.layers.embedding(
+            title, size=[len(ml.get_movie_title_dict()) + 1, EMB // 2])
+        ttl_pool = fluid.layers.sequence_pool(ttl_emb, "average", length=title_len)
+        mov_feat = fluid.layers.concat([mov_emb, cat_pool, ttl_pool], axis=1)
+        mov = fluid.layers.fc(input=mov_feat, size=32, act="tanh")
+
+        sim = fluid.layers.cos_sim(usr, mov)
+        pred = fluid.layers.scale(sim, scale=5.0)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, score))
+        return [uid, gender, age, job, mid, cats, cats_len, title, title_len], \
+            loss, pred
+
+    reader = batched_feed(ml.train(), 256, to_feed)
+    losses = train_save_load_infer(
+        build, reader, tmp_path, epochs=8, lr=5e-3,
+        feed_names=["uid", "gender", "age", "job", "mid", "cats", "cats_len",
+                    "title", "title_len"])
+    assert np.mean(losses[-4:]) < np.mean(losses[:4]) * 0.7

@@ -1,0 +1,59 @@
+"""Book 03/08-style: sentiment classification — embedding + sequence conv
+pool on imdb-shaped data (reference tests/book/test_understand_sentiment.py
+conv model)."""
+
+import numpy as np
+
+from book_util import train_save_load_infer
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+VOCAB = 1024
+EMB = 32
+MAXLEN = 40
+BATCH = 128
+
+
+def _pad(ids, L):
+    out = np.zeros(L, dtype="int64")
+    n = min(len(ids), L)
+    out[:n] = ids[:n]
+    return out, n
+
+
+def to_feed(batch):
+    words, lens, labels = [], [], []
+    for ids, lbl in batch:
+        w, n = _pad(ids, MAXLEN)
+        words.append(w), lens.append(n), labels.append([lbl])
+    return {"words": np.stack(words),
+            "words_len": np.array(lens, dtype="int32"),
+            "label": np.array(labels, dtype="int64")}
+
+
+def build():
+    words = fluid.layers.data(name="words", shape=[MAXLEN], dtype="int64")
+    words_len = fluid.layers.data(name="words_len", shape=[], dtype="int32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(words, size=[VOCAB, EMB])  # [B,L,E]
+    conv = fluid.layers.sequence_conv(emb, num_filters=32, filter_size=3,
+                                      act="tanh", length=words_len)
+    pooled = fluid.layers.sequence_pool(conv, "max", length=words_len)
+    logits = fluid.layers.fc(input=pooled, size=2)
+    sm = fluid.layers.softmax(logits)
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(sm, label))
+    return [words, words_len], loss, sm
+
+
+def test_understand_sentiment_conv(tmp_path):
+    data = paddle.dataset.imdb.train()
+
+    def reader():
+        for b in paddle.batch(data, BATCH, drop_last=True)():
+            yield to_feed(b)
+
+    losses = train_save_load_infer(
+        build, reader, tmp_path, epochs=6, lr=5e-3,
+        feed_names=["words", "words_len"])
+    assert np.mean(losses[-4:]) < 0.35, np.mean(losses[-4:])

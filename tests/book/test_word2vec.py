@@ -1,0 +1,44 @@
+"""Book 04: word2vec n-gram model on imikolov
+(reference tests/book/test_word2vec.py)."""
+
+import numpy as np
+
+from book_util import batched_feed, train_save_load_infer
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+EMB = 32
+N = 5
+word_dict = paddle.dataset.imikolov.build_dict()
+VOCAB = len(word_dict)
+
+
+def test_word2vec(tmp_path):
+    def build():
+        words = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+                 for i in range(N - 1)]
+        target = fluid.layers.data(name="target", shape=[1], dtype="int64")
+        embs = [fluid.layers.embedding(
+            input=w, size=[VOCAB, EMB],
+            param_attr=fluid.ParamAttr(name="shared_emb")) for w in words]
+        concat = fluid.layers.concat(input=embs, axis=1)
+        hidden = fluid.layers.fc(input=concat, size=128, act="sigmoid")
+        sm = fluid.layers.fc(input=hidden, size=VOCAB, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=sm, label=target))
+        return words, loss, sm
+
+    def to_feed(batch):
+        arr = np.asarray(batch, dtype="int64")
+        feed = {f"w{i}": arr[:, i:i + 1] for i in range(N - 1)}
+        feed["target"] = arr[:, N - 1:N]
+        return feed
+
+    reader = batched_feed(paddle.dataset.imikolov.train(word_dict, N), 256, to_feed)
+    losses = train_save_load_infer(
+        build, reader, tmp_path, epochs=3, lr=5e-3,
+        feed_names=[f"w{i}" for i in range(N - 1)])
+    # Markov-chain data: each word has 4 likely successors → ceiling ~ln(4).
+    # Random guessing is ln(256)≈5.5; require clear learning.
+    assert np.mean(losses[-5:]) < 3.0, np.mean(losses[-5:])

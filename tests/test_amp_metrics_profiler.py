@@ -154,3 +154,36 @@ def test_auc_origin_anchor():
     auc = fluid.metrics.Auc()
     auc.update(preds=np.array([1.0, 1.0]), labels=np.array([1, 0]))
     assert abs(auc.eval() - 0.5) < 1e-9
+
+
+def test_amp_dynamic_scaling_minimize_outside_guard():
+    """Regression: good/bad-step scalars must land in the optimized program
+    even when minimize() runs after program_guard exits."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    opt = mp.decorate(fluid.optimizer.SGD(learning_rate=0.01),
+                      dest_dtype="float16", init_loss_scaling=8.0,
+                      use_dynamic_loss_scaling=True)
+    opt.minimize(loss, startup_program=startup)
+    blk = main.global_block()
+    names = set(blk.vars)
+    assert any("good_steps" in n for n in names)
+    assert any("bad_steps" in n for n in names)
+    s = Scope()
+    with scope_guard(s):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((4, 4), "float32"),
+                            "y": np.ones((4, 1), "float32")},
+                fetch_list=[loss.name])
+
+
+def test_amp_lists_conflicting_custom_lists_rejected():
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        mp.AutoMixedPrecisionLists(custom_white_list=["exp"],
+                                   custom_black_list=["exp"])

@@ -35,6 +35,8 @@ from .data_feeder import DataFeeder  # noqa: F401
 from .reader import PyReader, DataLoader  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import metrics  # noqa: F401
+from . import dataset  # noqa: F401
+from .dataset import DatasetFactory  # noqa: F401
 from . import profiler  # noqa: F401
 from . import contrib  # noqa: F401
 

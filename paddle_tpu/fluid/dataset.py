@@ -1,0 +1,177 @@
+"""Dataset factory (reference python/paddle/fluid/dataset.py → C++
+framework/data_set.cc + data_feed.cc).
+
+`DatasetFactory().create_dataset("QueueDataset"|"InMemoryDataset"|
+"MultiSlotDataset")` parses MultiSlot text files with the native C++ feed
+(paddle_tpu.native.MultiSlotFeed — background parser thread + C++ blocking
+queue), producing padded numpy batches for `Executor.train_from_dataset`.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from .framework import Variable
+
+__all__ = ["DatasetFactory", "QueueDataset", "InMemoryDataset"]
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread = 1
+        self._filelist = []
+        self._use_vars = []
+        self._pipe_command = None
+        self._queue_capacity = 32
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        for v in var_list:
+            assert isinstance(v, Variable)
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, cmd):  # parity; preprocessing pipes unsupported
+        self._pipe_command = cmd
+
+    def _slots(self):
+        out = []
+        for v in self._use_vars:
+            t = "f" if v.dtype in ("float32", "float64", "bfloat16", "float16") else "u"
+            out.append((v.name, t))
+        return out
+
+    def _postprocess(self, feed):
+        """Native feed emits padded [B, maxlen] + __len per slot; reshape
+        dense slots to the var's declared trailing shape (validating every
+        sample's length — the reference MultiSlotDataFeed rejects short/long
+        dense instances at parse time) and keep __len only for ragged
+        (lod_level>0) vars."""
+        out = {}
+        for v in self._use_vars:
+            arr = feed[v.name]
+            if v.lod_level and v.lod_level > 0:
+                out[v.name] = arr
+                out[v.name + "__len"] = feed[v.name + "__len"]
+                continue
+            tail = [d for d in (v.shape or [])[1:]]
+            if tail and all(isinstance(d, int) and d > 0 for d in tail):
+                want_len = int(np.prod(tail))
+                lens = feed[v.name + "__len"]
+                bad = np.nonzero(lens != want_len)[0]
+                if bad.size:
+                    raise ValueError(
+                        f"dense slot {v.name!r} expects {want_len} values per "
+                        f"sample (shape {list(tail)}), but sample {int(bad[0])} "
+                        f"in this batch has {int(lens[bad[0]])}")
+                arr = arr[:, :want_len].reshape((arr.shape[0],) + tuple(tail))
+            out[v.name] = arr
+        return out
+
+
+class QueueDataset(DatasetBase):
+    """Streams batches straight from the native parser queue."""
+
+    def _iter_batches(self):
+        from .. import native
+
+        if not self._filelist:
+            raise ValueError("set_filelist before training")
+        if not self._use_vars:
+            raise ValueError("set_use_var before training")
+        feed = native.MultiSlotFeed(self._filelist, self._slots(),
+                                    self._batch_size, self._queue_capacity)
+        try:
+            for batch in feed:
+                yield self._postprocess(batch)
+        finally:
+            feed.close()
+
+
+class InMemoryDataset(QueueDataset):
+    """Materializes *instances*, shuffles at instance level, re-batches on
+    iteration (reference InMemoryDataFeed::LoadIntoMemory + LocalShuffle —
+    which shuffles records before batching, so batch composition changes
+    every epoch)."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory = None  # list of {slot: (values, length)} instances
+
+    def load_into_memory(self):
+        from .. import native
+
+        if not self._filelist:
+            raise ValueError("set_filelist before load_into_memory")
+        if not self._use_vars:
+            raise ValueError("set_use_var before load_into_memory")
+        feed = native.MultiSlotFeed(self._filelist, self._slots(), 1,
+                                    self._queue_capacity)
+        self._memory = []
+        try:
+            for b in feed:
+                inst = {}
+                for name, _ in self._slots():
+                    L = int(b[name + "__len"][0])
+                    inst[name] = b[name][0, :L]
+                self._memory.append(inst)
+        finally:
+            feed.close()
+
+    def local_shuffle(self, seed=None):
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() first")
+        random.Random(seed).shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, seed=None):
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        self._memory = None
+
+    def _iter_batches(self):
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() first")
+        names = [n for n, _ in self._slots()]
+        for start in range(0, len(self._memory), self._batch_size):
+            chunk = self._memory[start:start + self._batch_size]
+            feed = {}
+            for name in names:
+                lens = np.array([len(inst[name]) for inst in chunk], dtype="int32")
+                maxlen = int(lens.max()) if len(lens) else 0
+                padded = np.zeros((len(chunk), maxlen),
+                                  dtype=chunk[0][name].dtype)
+                for i, inst in enumerate(chunk):
+                    padded[i, :lens[i]] = inst[name]
+                feed[name] = padded
+                feed[name + "__len"] = lens
+            yield self._postprocess(feed)
+
+
+class MultiSlotDataset(QueueDataset):
+    pass
+
+
+class DatasetFactory:
+    _registry = {
+        "QueueDataset": QueueDataset,
+        "InMemoryDataset": InMemoryDataset,
+        "MultiSlotDataset": MultiSlotDataset,
+    }
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class not in self._registry:
+            raise ValueError(
+                f"unknown dataset class {datafeed_class!r}; "
+                f"choose from {sorted(self._registry)}")
+        return self._registry[datafeed_class]()

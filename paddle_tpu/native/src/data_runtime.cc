@@ -1,0 +1,468 @@
+// paddle_tpu native data runtime: RecordIO + blocking queue + MultiSlot feed.
+//
+// Reference analogs (re-designed, not ported):
+//   - paddle/fluid/recordio/{chunk,writer,scanner}.cc : chunked record file
+//     with per-chunk CRC + optional compression
+//   - paddle/fluid/operators/reader/lod_tensor_blocking_queue.h : bounded
+//     producer/consumer queue feeding the device pipeline
+//   - paddle/fluid/framework/data_feed.cc (MultiSlotDataFeed::ParseOneInstance)
+//     : text-slot parser with background reader threads
+//
+// TPU-native shape: the C++ side produces *batches as flat byte buffers*
+// (dense values + per-sample lengths), which Python turns into padded numpy
+// arrays feeding the XLA program — the LoD→padding translation happens once,
+// here, off the critical Python thread.
+//
+// C ABI only (ctypes-friendly); all buffers returned via ptq_buf are malloc'd
+// and freed with ptq_free.
+
+#include <zlib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// small helpers
+// ---------------------------------------------------------------------------
+
+void ptq_free(char* p) { free(p); }
+
+static char* dup_buf(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size()));
+  if (out && !s.empty()) memcpy(out, s.data(), s.size());
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// RecordIO: file = sequence of chunks.
+// chunk header: magic u32 'PTRC', num_records u32, raw_len u64,
+//               comp_len u64, crc32 u32 (of compressed payload), flags u8
+// payload: records, each u32 len + bytes; deflate-compressed when flags&1.
+// ---------------------------------------------------------------------------
+
+static const uint32_t kChunkMagic = 0x50545243;  // "PTRC"
+
+struct RecordWriter {
+  FILE* f = nullptr;
+  std::string pending;  // serialized records of the open chunk
+  uint32_t n_records = 0;
+  int compressor = 1;           // 0 none, 1 zlib
+  size_t chunk_bytes = 1 << 20;  // flush threshold
+
+  int flush_chunk() {
+    if (n_records == 0) return 0;
+    std::string payload = pending;
+    uint8_t flags = 0;
+    if (compressor == 1) {
+      uLongf bound = compressBound(pending.size());
+      std::string comp(bound, '\0');
+      if (compress2(reinterpret_cast<Bytef*>(&comp[0]), &bound,
+                    reinterpret_cast<const Bytef*>(pending.data()),
+                    pending.size(), Z_BEST_SPEED) == Z_OK) {
+        comp.resize(bound);
+        payload.swap(comp);
+        flags = 1;
+      }
+    }
+    uint32_t crc = crc32(0L, reinterpret_cast<const Bytef*>(payload.data()),
+                         payload.size());
+    uint64_t raw_len = pending.size(), comp_len = payload.size();
+    if (fwrite(&kChunkMagic, 4, 1, f) != 1) return -1;
+    if (fwrite(&n_records, 4, 1, f) != 1) return -1;
+    if (fwrite(&raw_len, 8, 1, f) != 1) return -1;
+    if (fwrite(&comp_len, 8, 1, f) != 1) return -1;
+    if (fwrite(&crc, 4, 1, f) != 1) return -1;
+    if (fwrite(&flags, 1, 1, f) != 1) return -1;
+    if (comp_len && fwrite(payload.data(), comp_len, 1, f) != 1) return -1;
+    pending.clear();
+    n_records = 0;
+    return 0;
+  }
+};
+
+void* ptq_recordio_writer_open(const char* path, int compressor) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new RecordWriter();
+  w->f = f;
+  w->compressor = compressor;
+  return w;
+}
+
+int ptq_recordio_writer_write(void* handle, const char* data, int64_t len) {
+  auto* w = static_cast<RecordWriter*>(handle);
+  uint32_t l = static_cast<uint32_t>(len);
+  w->pending.append(reinterpret_cast<const char*>(&l), 4);
+  w->pending.append(data, len);
+  w->n_records++;
+  if (w->pending.size() >= w->chunk_bytes) return w->flush_chunk();
+  return 0;
+}
+
+int ptq_recordio_writer_close(void* handle) {
+  auto* w = static_cast<RecordWriter*>(handle);
+  int rc = w->flush_chunk();
+  fclose(w->f);
+  delete w;
+  return rc;
+}
+
+struct RecordScanner {
+  FILE* f = nullptr;
+  std::string chunk;     // decompressed records of current chunk
+  size_t offset = 0;
+  std::string current;   // last record returned
+
+  // returns 0 ok, 1 eof, -1 corrupt
+  int load_chunk() {
+    uint32_t magic = 0, n_records = 0, crc = 0;
+    uint64_t raw_len = 0, comp_len = 0;
+    uint8_t flags = 0;
+    if (fread(&magic, 4, 1, f) != 1) return 1;  // clean EOF
+    if (magic != kChunkMagic) return -1;
+    if (fread(&n_records, 4, 1, f) != 1) return -1;
+    if (fread(&raw_len, 8, 1, f) != 1) return -1;
+    if (fread(&comp_len, 8, 1, f) != 1) return -1;
+    if (fread(&crc, 4, 1, f) != 1) return -1;
+    if (fread(&flags, 1, 1, f) != 1) return -1;
+    std::string payload(comp_len, '\0');
+    if (comp_len && fread(&payload[0], comp_len, 1, f) != 1) return -1;
+    uint32_t got = crc32(0L, reinterpret_cast<const Bytef*>(payload.data()),
+                         payload.size());
+    if (got != crc) return -1;
+    if (flags & 1) {
+      std::string raw(raw_len, '\0');
+      uLongf out_len = raw_len;
+      if (uncompress(reinterpret_cast<Bytef*>(&raw[0]), &out_len,
+                     reinterpret_cast<const Bytef*>(payload.data()),
+                     payload.size()) != Z_OK || out_len != raw_len)
+        return -1;
+      chunk.swap(raw);
+    } else {
+      chunk.swap(payload);
+    }
+    offset = 0;
+    return 0;
+  }
+};
+
+void* ptq_recordio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* s = new RecordScanner();
+  s->f = f;
+  return s;
+}
+
+// returns record length (>=0), -1 on EOF, -2 on corruption
+int64_t ptq_recordio_scanner_next(void* handle, char** out) {
+  auto* s = static_cast<RecordScanner*>(handle);
+  while (s->offset >= s->chunk.size()) {
+    int rc = s->load_chunk();
+    if (rc == 1) return -1;
+    if (rc < 0) return -2;
+  }
+  if (s->offset + 4 > s->chunk.size()) return -2;
+  uint32_t len = 0;
+  memcpy(&len, s->chunk.data() + s->offset, 4);
+  s->offset += 4;
+  if (s->offset + len > s->chunk.size()) return -2;
+  s->current.assign(s->chunk.data() + s->offset, len);
+  s->offset += len;
+  *out = &s->current[0];
+  return len;
+}
+
+void ptq_recordio_scanner_close(void* handle) {
+  auto* s = static_cast<RecordScanner*>(handle);
+  fclose(s->f);
+  delete s;
+}
+
+// ---------------------------------------------------------------------------
+// Blocking queue of byte blobs (LoDTensorBlockingQueue analog)
+// ---------------------------------------------------------------------------
+
+struct BlockingQueue {
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<std::string> items;
+  size_t capacity;
+  bool closed = false;
+
+  explicit BlockingQueue(size_t cap) : capacity(cap) {}
+};
+
+void* ptq_queue_new(int64_t capacity) {
+  return new BlockingQueue(static_cast<size_t>(capacity));
+}
+
+// 0 ok, 1 timeout, 2 closed
+int ptq_queue_push(void* handle, const char* data, int64_t len,
+                   double timeout_s) {
+  auto* q = static_cast<BlockingQueue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [q] { return q->closed || q->items.size() < q->capacity; };
+  if (timeout_s < 0) {
+    q->cv_push.wait(lk, pred);
+  } else if (!q->cv_push.wait_for(
+                 lk, std::chrono::duration<double>(timeout_s), pred)) {
+    return 1;
+  }
+  if (q->closed) return 2;
+  q->items.emplace_back(data, static_cast<size_t>(len));
+  q->cv_pop.notify_one();
+  return 0;
+}
+
+// >=0 length, -1 timeout, -2 closed-and-empty
+int64_t ptq_queue_pop(void* handle, char** out, double timeout_s) {
+  auto* q = static_cast<BlockingQueue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [q] { return q->closed || !q->items.empty(); };
+  if (timeout_s < 0) {
+    q->cv_pop.wait(lk, pred);
+  } else if (!q->cv_pop.wait_for(
+                 lk, std::chrono::duration<double>(timeout_s), pred)) {
+    return -1;
+  }
+  if (q->items.empty()) return -2;  // closed
+  std::string item = std::move(q->items.front());
+  q->items.pop_front();
+  q->cv_push.notify_one();
+  lk.unlock();
+  *out = dup_buf(item);
+  return static_cast<int64_t>(item.size());
+}
+
+int64_t ptq_queue_size(void* handle) {
+  auto* q = static_cast<BlockingQueue*>(handle);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return static_cast<int64_t>(q->items.size());
+}
+
+void ptq_queue_close(void* handle) {
+  auto* q = static_cast<BlockingQueue*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->closed = true;
+  }
+  q->cv_push.notify_all();
+  q->cv_pop.notify_all();
+}
+
+void ptq_queue_free(void* handle) {
+  delete static_cast<BlockingQueue*>(handle);
+}
+
+// ---------------------------------------------------------------------------
+// MultiSlot text feed (data_feed.cc analog)
+//
+// Line format (reference MultiSlotDataFeed): for each slot in order:
+//   <n> v_1 ... v_n
+// Slot desc string: "name:f" (float32) or "name:u" (int64), ';'-separated.
+//
+// Batch wire format produced (little endian):
+//   u32 nslots
+//   per slot: u8 type ('f'|'u'), u32 batch,
+//             u32 lens[batch], u32 total, values[total] (f32 or i64)
+// ---------------------------------------------------------------------------
+
+struct SlotDesc {
+  std::string name;
+  char type;  // 'f' or 'u'
+};
+
+struct SlotBatch {
+  std::vector<uint32_t> lens;
+  std::vector<float> fvals;
+  std::vector<int64_t> ivals;
+};
+
+struct MultiSlotFeed {
+  std::vector<std::string> files;
+  std::vector<SlotDesc> slots;
+  int batch_size;
+  BlockingQueue queue;
+  std::thread worker;
+  std::atomic<bool> stop{false};
+  std::string error;
+  std::mutex err_mu;
+
+  MultiSlotFeed(size_t cap) : queue(cap) {}
+
+  void set_error(const std::string& e) {
+    std::lock_guard<std::mutex> lk(err_mu);
+    if (error.empty()) error = e;
+  }
+
+  static bool parse_line(const char* line, const std::vector<SlotDesc>& slots,
+                         std::vector<SlotBatch>* batch) {
+    const char* p = line;
+    char* end = nullptr;
+    for (size_t si = 0; si < slots.size(); ++si) {
+      long n = strtol(p, &end, 10);
+      if (end == p || n < 0) return false;
+      p = end;
+      auto& sb = (*batch)[si];
+      sb.lens.push_back(static_cast<uint32_t>(n));
+      for (long i = 0; i < n; ++i) {
+        if (slots[si].type == 'f') {
+          float v = strtof(p, &end);
+          if (end == p) return false;
+          sb.fvals.push_back(v);
+        } else {
+          long long v = strtoll(p, &end, 10);
+          if (end == p) return false;
+          sb.ivals.push_back(v);
+        }
+        p = end;
+      }
+    }
+    return true;
+  }
+
+  std::string serialize(const std::vector<SlotBatch>& batch) const {
+    std::string out;
+    uint32_t nslots = slots.size();
+    out.append(reinterpret_cast<const char*>(&nslots), 4);
+    for (size_t si = 0; si < slots.size(); ++si) {
+      const auto& sb = batch[si];
+      uint8_t t = slots[si].type;
+      uint32_t bs = sb.lens.size();
+      out.append(reinterpret_cast<const char*>(&t), 1);
+      out.append(reinterpret_cast<const char*>(&bs), 4);
+      out.append(reinterpret_cast<const char*>(sb.lens.data()), 4 * bs);
+      if (slots[si].type == 'f') {
+        uint32_t total = sb.fvals.size();
+        out.append(reinterpret_cast<const char*>(&total), 4);
+        out.append(reinterpret_cast<const char*>(sb.fvals.data()), 4 * total);
+      } else {
+        uint32_t total = sb.ivals.size();
+        out.append(reinterpret_cast<const char*>(&total), 4);
+        out.append(reinterpret_cast<const char*>(sb.ivals.data()), 8 * total);
+      }
+    }
+    return out;
+  }
+
+  bool has_error() {
+    std::lock_guard<std::mutex> lk(err_mu);
+    return !error.empty();
+  }
+
+  void run() {
+    std::vector<SlotBatch> batch(slots.size());
+    int in_batch = 0;
+    char* line = nullptr;     // getline-managed growable buffer: no 64 KiB
+    size_t line_cap = 0;      // truncation of long ragged-slot lines
+    for (const auto& path : files) {
+      if (stop.load()) break;
+      FILE* f = fopen(path.c_str(), "r");
+      if (!f) {
+        set_error("cannot open " + path);
+        break;
+      }
+      ssize_t nread;
+      while (!stop.load() && (nread = getline(&line, &line_cap, f)) != -1) {
+        if (nread == 0 || line[0] == '\n' || line[0] == '\0') continue;
+        if (!parse_line(line, slots, &batch)) {
+          set_error("parse error in " + path + ": " +
+                    std::string(line, std::min<size_t>(nread, 60)));
+          break;
+        }
+        if (++in_batch == batch_size) {
+          std::string ser = serialize(batch);
+          while (!stop.load() &&
+                 ptq_queue_push(&queue, ser.data(), ser.size(), 0.1) == 1) {
+          }
+          for (auto& sb : batch) {
+            sb.lens.clear();
+            sb.fvals.clear();
+            sb.ivals.clear();
+          }
+          in_batch = 0;
+        }
+      }
+      fclose(f);
+      if (has_error()) break;
+    }
+    // never flush a partial batch after an error: parse_line may have left
+    // the slots with misaligned per-slot lengths for the failed line
+    if (in_batch > 0 && !stop.load() && !has_error()) {
+      std::string ser = serialize(batch);
+      while (!stop.load() &&
+             ptq_queue_push(&queue, ser.data(), ser.size(), 0.1) == 1) {
+      }
+    }
+    free(line);
+    ptq_queue_close(&queue);
+  }
+};
+
+void* ptq_feed_new(const char** files, int nfiles, const char* slots_desc,
+                   int batch_size, int64_t queue_cap) {
+  auto* feed = new MultiSlotFeed(static_cast<size_t>(queue_cap));
+  for (int i = 0; i < nfiles; ++i) feed->files.emplace_back(files[i]);
+  std::string desc(slots_desc);
+  size_t pos = 0;
+  while (pos < desc.size()) {
+    size_t semi = desc.find(';', pos);
+    if (semi == std::string::npos) semi = desc.size();
+    std::string item = desc.substr(pos, semi - pos);
+    size_t colon = item.find(':');
+    if (colon == std::string::npos || colon + 1 >= item.size() ||
+        (item[colon + 1] != 'f' && item[colon + 1] != 'u')) {
+      delete feed;
+      return nullptr;
+    }
+    feed->slots.push_back({item.substr(0, colon), item[colon + 1]});
+    pos = semi + 1;
+  }
+  if (feed->slots.empty()) {
+    delete feed;
+    return nullptr;
+  }
+  feed->batch_size = batch_size;
+  feed->worker = std::thread([feed] { feed->run(); });
+  return feed;
+}
+
+// >=0 length, -1 end-of-data, -3 worker error (fetch with ptq_feed_error)
+int64_t ptq_feed_next(void* handle, char** out) {
+  auto* feed = static_cast<MultiSlotFeed*>(handle);
+  int64_t rc = ptq_queue_pop(&feed->queue, out, -1.0);
+  if (rc == -2) {
+    std::lock_guard<std::mutex> lk(feed->err_mu);
+    return feed->error.empty() ? -1 : -3;
+  }
+  return rc;
+}
+
+int64_t ptq_feed_error(void* handle, char** out) {
+  auto* feed = static_cast<MultiSlotFeed*>(handle);
+  std::lock_guard<std::mutex> lk(feed->err_mu);
+  *out = dup_buf(feed->error);
+  return static_cast<int64_t>(feed->error.size());
+}
+
+void ptq_feed_free(void* handle) {
+  auto* feed = static_cast<MultiSlotFeed*>(handle);
+  feed->stop.store(true);
+  ptq_queue_close(&feed->queue);
+  if (feed->worker.joinable()) feed->worker.join();
+  delete feed;
+}
+
+}  // extern "C"

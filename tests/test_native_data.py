@@ -1,0 +1,227 @@
+"""Native C++ data runtime tests: RecordIO roundtrip, blocking queue,
+MultiSlot feed parsing, Dataset + train_from_dataset end to end."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu import fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+pytestmark = pytest.mark.skipif(not native.is_available(),
+                                reason="native build unavailable")
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "data.recordio")
+    records = [os.urandom(np.random.RandomState(i).randint(1, 5000))
+               for i in range(200)]
+    with native.RecordIOWriter(path) as w:
+        for r in records:
+            w.write(r)
+    with native.RecordIOScanner(path) as s:
+        got = list(s)
+    assert got == records
+    # compression actually happened for compressible data
+    path2 = str(tmp_path / "zeros.recordio")
+    with native.RecordIOWriter(path2) as w:
+        for _ in range(100):
+            w.write(b"\x00" * 10000)
+    assert os.path.getsize(path2) < 100 * 10000 / 10
+    with native.RecordIOScanner(path2) as s:
+        assert sum(len(r) for r in s) == 100 * 10000
+
+
+def test_recordio_corruption_detected(tmp_path):
+    path = str(tmp_path / "c.recordio")
+    with native.RecordIOWriter(path) as w:
+        w.write(b"hello world" * 100)
+    data = bytearray(open(path, "rb").read())
+    data[-3] ^= 0xFF  # flip a payload byte → crc mismatch
+    open(path, "wb").write(bytes(data))
+    with native.RecordIOScanner(path) as s:
+        with pytest.raises(IOError):
+            next(s)
+
+
+def test_blocking_queue_threads():
+    q = native.BlockingQueue(capacity=4)
+    out = []
+
+    def consumer():
+        while True:
+            try:
+                out.append(q.pop())
+            except EOFError:
+                return
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for i in range(50):
+        q.push(f"item{i}".encode())
+    q.close()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert out == [f"item{i}".encode() for i in range(50)]
+    # timeout pop on empty+open queue returns None
+    q2 = native.BlockingQueue(capacity=2)
+    assert q2.pop(timeout=0.05) is None
+    # push to full queue times out
+    q2.push(b"a"), q2.push(b"b")
+    assert q2.push(b"c", timeout=0.05) is False
+
+
+def _write_multislot(path, n, seed):
+    """Lines: dense float slot (4 vals), ragged int slot, label int."""
+    rng = np.random.RandomState(seed)
+    with open(path, "w") as f:
+        for _ in range(n):
+            feats = rng.uniform(-1, 1, 4)
+            L = rng.randint(1, 6)
+            ids = rng.randint(0, 50, L)
+            lbl = rng.randint(0, 2)
+            line = ("4 " + " ".join(f"{v:.6f}" for v in feats)
+                    + f" {L} " + " ".join(str(i) for i in ids)
+                    + f" 1 {lbl}\n")
+            f.write(line)
+
+
+def test_multislot_feed(tmp_path):
+    p1, p2 = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    _write_multislot(p1, 25, 0)
+    _write_multislot(p2, 25, 1)
+    feed = native.MultiSlotFeed([p1, p2],
+                                [("x", "f"), ("ids", "u"), ("label", "u")],
+                                batch_size=10)
+    batches = list(feed)
+    assert len(batches) == 5
+    for b in batches:
+        assert b["x"].shape == (10, 4) and b["x"].dtype == np.float32
+        assert b["ids"].dtype == np.int64
+        assert b["ids"].shape[1] == b["ids__len"].max()
+        assert set(np.unique(b["label"])) <= {0, 1}
+    feed.close()
+
+
+def test_multislot_feed_parse_error(tmp_path):
+    p = str(tmp_path / "bad.txt")
+    with open(p, "w") as f:
+        f.write("4 0.1 0.2 0.3 0.4 2 1 2 1 0\n")
+        f.write("not a number at all\n")
+    feed = native.MultiSlotFeed([p], [("x", "f"), ("ids", "u"), ("label", "u")],
+                                batch_size=1)
+    with pytest.raises(IOError, match="parse error"):
+        list(feed)
+    feed.close()
+
+
+def test_dataset_train_from_dataset(tmp_path):
+    """Reference executor.train_from_dataset path over the C++ feed."""
+    p = str(tmp_path / "train.txt")
+    rng = np.random.RandomState(3)
+    with open(p, "w") as f:
+        for _ in range(512):
+            x = rng.uniform(-1, 1, 4)
+            y = 1 if x.sum() > 0 else 0
+            f.write("4 " + " ".join(f"{v:.5f}" for v in x) + f" 1 {y}\n")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(input=x, size=2)
+        sm = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(sm, y))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(64)
+    ds.set_use_var([x, y])
+    ds.set_filelist([p])
+    ds.load_into_memory()
+    ds.local_shuffle(seed=0)
+
+    s = Scope()
+    with scope_guard(s):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(8):
+            exe.train_from_dataset(program=main, dataset=ds)
+        (lv,) = exe.run(main, feed=next(ds._iter_batches()),
+                        fetch_list=[loss.name])
+    assert float(np.asarray(lv)) < 0.3, float(np.asarray(lv))
+
+
+def test_parse_error_no_partial_batch(tmp_path):
+    """After a mid-batch parse error, no misaligned partial batch may be
+    delivered before the error (regression)."""
+    p = str(tmp_path / "bad2.txt")
+    with open(p, "w") as f:
+        for i in range(5):
+            f.write(f"2 0.1 0.2 1 {i}\n")
+        f.write("2 0.1 oops 1 9\n")  # slot 0 consumed, slot 1 fails
+    feed = native.MultiSlotFeed([p], [("x", "f"), ("label", "u")],
+                                batch_size=10)
+    with pytest.raises(IOError, match="parse error"):
+        list(feed)
+    feed.close()
+
+
+def test_long_lines_ragged_slot(tmp_path):
+    """Lines beyond 64 KiB must parse intact (getline growable buffer)."""
+    p = str(tmp_path / "long.txt")
+    n_ids = 20000  # ~110KB line
+    with open(p, "w") as f:
+        for j in range(3):
+            ids = " ".join(str((i + j) % 100) for i in range(n_ids))
+            f.write(f"{n_ids} {ids} 1 {j}\n")
+    feed = native.MultiSlotFeed([p], [("ids", "u"), ("label", "u")],
+                                batch_size=3)
+    (batch,) = list(feed)
+    assert batch["ids"].shape == (3, n_ids)
+    np.testing.assert_array_equal(batch["ids__len"], [n_ids] * 3)
+    np.testing.assert_array_equal(batch["label"].ravel(), [0, 1, 2])
+    feed.close()
+
+
+def test_dense_slot_length_validated(tmp_path):
+    p = str(tmp_path / "short.txt")
+    with open(p, "w") as f:
+        f.write("4 0.1 0.2 0.3 0.4 1 0\n")
+        f.write("3 0.1 0.2 0.3 1 1\n")  # short dense sample
+
+    main = fluid.Program()
+    with fluid.program_guard(main), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(2)
+    ds.set_use_var([x, y])
+    ds.set_filelist([p])
+    with pytest.raises(ValueError, match="expects 4 values"):
+        list(ds._iter_batches())
+
+
+def test_inmemory_shuffles_instances(tmp_path):
+    p = str(tmp_path / "inst.txt")
+    with open(p, "w") as f:
+        for i in range(16):
+            f.write(f"1 {i}.0 1 {i}\n")
+    main = fluid.Program()
+    with fluid.program_guard(main), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_use_var([x, y])
+    ds.set_filelist([p])
+    ds.load_into_memory()
+    before = [b["y"].ravel().tolist() for b in ds._iter_batches()]
+    ds.local_shuffle(seed=1)
+    after = [b["y"].ravel().tolist() for b in ds._iter_batches()]
+    # instance-level shuffle: batch composition changes, not just batch order
+    assert sorted(sum(after, [])) == sorted(sum(before, []))
+    assert set(map(tuple, after)) != set(map(tuple, before))

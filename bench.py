@@ -23,7 +23,13 @@ def main():
     from paddle_tpu.models import bert
 
     batch, seq_len = 16, 128
-    cfg = bert.BertConfig.base(vocab_size=30528)  # pad vocab to /64 for MXU
+    # PT_BENCH_FLASH=1: Pallas flash-attention path (attention-probs dropout
+    # off, the usual flash trade) — flip the default once measured faster on
+    # the target chip than the composed matmul/softmax path at this seq len
+    flash = os.environ.get("PT_BENCH_FLASH", "0") == "1"
+    cfg = bert.BertConfig.base(vocab_size=30528,  # pad vocab to /64 for MXU
+                               use_flash_attention=flash,
+                               attn_dropout=0.0 if flash else 0.1)
     main_prog = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():

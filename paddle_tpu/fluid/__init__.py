@@ -39,6 +39,8 @@ from . import dataset  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
 from . import profiler  # noqa: F401
 from . import contrib  # noqa: F401
+from . import transpiler  # noqa: F401
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 
 # reference exposes DataLoader under fluid.io as well
 io.DataLoader = DataLoader
@@ -50,6 +52,7 @@ __all__ = [
     "global_scope", "scope_guard", "append_backward", "gradients",
     "CPUPlace", "TPUPlace", "CUDAPlace", "ParamAttr", "data",
     "default_main_program", "default_startup_program", "unique_name",
+    "transpiler", "DistributeTranspiler", "DistributeTranspilerConfig",
 ]
 
 

@@ -121,7 +121,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         finalized[var_name] = g
         return g
 
-    for op in reversed(block.ops[: loss_pos + 1]):
+    for fwd_idx, op in reversed(list(enumerate(block.ops[: loss_pos + 1]))):
         if not registry.has_op(op.type):
             continue
         info = registry.get_op(op.type)
@@ -147,6 +147,9 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         for (gtype, gins, gouts, gattrs) in descs:
             gattrs = dict(gattrs)
             gattrs["op_role"] = "backward"
+            # which forward op this grad op differentiates — the pipeline
+            # transpiler uses it for exact stage assignment
+            gattrs["fwd_op_idx"] = fwd_idx
             for slot, names in gouts.items():
                 for n in names:
                     base = n.split("@GRAD")[0]

@@ -115,16 +115,25 @@ class InMemoryDataset(QueueDataset):
             raise ValueError("set_filelist before load_into_memory")
         if not self._use_vars:
             raise ValueError("set_use_var before load_into_memory")
-        feed = native.MultiSlotFeed(self._filelist, self._slots(), 1,
-                                    self._queue_capacity)
+        # parse with a large batch and split rows — one queue round-trip per
+        # 4096 instances instead of per instance
+        # queue capacity is denominated in batches: with 4096-row batches a
+        # couple of slots bound the prefetch buffer, not capacity×4096 rows
+        feed = native.MultiSlotFeed(self._filelist, self._slots(), 4096,
+                                    min(self._queue_capacity, 2))
         self._memory = []
+        names = [n for n, _ in self._slots()]
         try:
             for b in feed:
-                inst = {}
-                for name, _ in self._slots():
-                    L = int(b[name + "__len"][0])
-                    inst[name] = b[name][0, :L]
-                self._memory.append(inst)
+                n_rows = len(b[names[0] + "__len"])
+                for i in range(n_rows):
+                    inst = {}
+                    for name in names:
+                        L = int(b[name + "__len"][i])
+                        # copy: a view would pin the whole 4096-row padded
+                        # batch in memory for the dataset's lifetime
+                        inst[name] = b[name][i, :L].copy()
+                    self._memory.append(inst)
         finally:
             feed.close()
 

@@ -217,8 +217,23 @@ class BlockPlan:
         self.block = block
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
-        self.ops = _prune_ops(block, fetch_names)
+        all_ops = _prune_ops(block, fetch_names)
+        # host ops (RPC send/recv, listen_and_serv, ...) run outside the
+        # jitted computation, after it, in program order
+        self.host_ops = [op for op in all_ops
+                         if registry.get_op(op.type).host_run is not None]
+        self.ops = [op for op in all_ops
+                    if registry.get_op(op.type).host_run is None]
         scope_reads, writes = _analyze_block(self.ops, block, self.feed_names)
+        # values the host ops consume must be materialized to scope even if
+        # no fetch asks for them (e.g. grads feeding a `send` op)
+        jit_produced = set()
+        for op in self.ops:
+            jit_produced.update(op.output_arg_names)
+        for hop in self.host_ops:
+            for n in hop.input_arg_names:
+                if n in jit_produced and n not in writes:
+                    writes.append(n)
         missing = [n for n in scope_reads if scope.get(n) is None]
         if missing:
             raise RuntimeError(
@@ -228,7 +243,17 @@ class BlockPlan:
         produced = set(self.feed_names) | set(scope_reads)
         for op in self.ops:
             produced.update(op.output_arg_names)
-        bad_fetch = [n for n in self.fetch_names if n not in produced]
+        host_out = set()
+        for hop in self.host_ops:
+            host_out.update(hop.output_arg_names)
+        # a fetch written by a host op must be read from scope AFTER the host
+        # ops ran (env never sees it; and even when it aliases a scope var,
+        # the pre-host value would be stale)
+        self.host_fetch_names = [n for n in self.fetch_names if n in host_out]
+        self.jit_fetch_names = [n for n in self.fetch_names
+                                if n not in host_out]
+        bad_fetch = [n for n in self.fetch_names
+                     if n not in produced and n not in host_out]
         if bad_fetch:
             raise ValueError(
                 f"fetch target(s) {bad_fetch} are not produced by this program "
@@ -240,9 +265,11 @@ class BlockPlan:
         self.write_names = list(writes)
 
     def make_body(self, mesh_axes=()):
-        """fn(donated, readonly, feeds, step) -> (fetches, out_writes)."""
+        """fn(donated, readonly, feeds, step) -> (fetches, out_writes).
+        Fetches cover jit_fetch_names only; host-op-produced fetches are
+        filled in by assemble_fetches after run_host_ops."""
         program, block, ops = self.program, self.block, self.ops
-        fetch_names, write_names = self.fetch_names, self.write_names
+        fetch_names, write_names = self.jit_fetch_names, self.write_names
         is_test = getattr(program, "_is_test", False)
 
         def fn(donated, readonly, feeds, step):
@@ -260,6 +287,21 @@ class BlockPlan:
 
         return fn
 
+    def run_host_ops(self, scope, place=None):
+        """Run the block's host ops (RPC/IO) in program order, after the
+        device step.  They read/write the scope directly."""
+        for op in self.host_ops:
+            registry.get_op(op.type).host_run(scope, op, place)
+
+    def assemble_fetches(self, jit_fetches, scope):
+        """Merge jit fetches with host-op-produced ones (read from scope,
+        post run_host_ops) back into fetch_list order."""
+        if not self.host_fetch_names:
+            return jit_fetches
+        by_name = dict(zip(self.jit_fetch_names, jit_fetches))
+        return [by_name[n] if n in by_name else scope.get(n)
+                for n in self.fetch_names]
+
 
 class _CompiledBlock:
     """One (program-version, feed-signature) → jitted XLA executable."""
@@ -268,6 +310,7 @@ class _CompiledBlock:
         import jax
 
         plan = BlockPlan(program, block, feed_names, fetch_names, scope)
+        self.plan = plan
         self.block = block
         self.feed_names = plan.feed_names
         self.fetch_names = plan.fetch_names
@@ -305,7 +348,9 @@ class _CompiledBlock:
             # block on scope writes too — a run with an empty fetch_list (or
             # a startup run) would otherwise record async-dispatch time only
             timer.done(fetches, out_writes)
-        return fetches
+        # RPC/IO ops run host-side after the device step, in program order
+        self.plan.run_host_ops(scope, self.place)
+        return self.plan.assemble_fetches(fetches, scope)
 
 
 # ---------------------------------------------------------------------------

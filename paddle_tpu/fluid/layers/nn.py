@@ -14,7 +14,8 @@ from ..initializer import Constant, Normal, Xavier
 from ..param_attr import ParamAttr
 
 __all__ = [
-    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose", "pool2d",
+    "fc", "embedding", "flash_attention",
+    "conv2d", "conv3d", "conv2d_transpose", "pool2d",
     "batch_norm", "layer_norm", "group_norm", "instance_norm", "dropout",
     "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
     "sigmoid_cross_entropy_with_logits", "square_error_cost", "accuracy",
@@ -1104,4 +1105,25 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
     helper.append_op("sequence_mask", inputs={"X": [x]}, outputs={"Y": [out]},
                      attrs={"maxlen": int(maxlen), "out_dtype": dtype})
+    return out
+
+
+def flash_attention(q, k, v, attn_bias=None, causal=False, sm_scale=None,
+                    sequence_parallel=False, name=None):
+    """Memory-efficient attention over [B, n_heads, S, d] (Pallas kernel on
+    TPU; see paddle_tpu/kernels/flash_attention.py).  attn_bias: additive
+    [B, 1, 1, S] key bias (padding mask).  sequence_parallel: under a mesh
+    with an 'sp' axis, lower to ring attention (K/V rotate via ppermute)."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if attn_bias is not None:
+        inputs["Bias"] = [attn_bias]
+    attrs = {"causal": causal}
+    if sequence_parallel:
+        attrs["sequence_parallel"] = True
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    helper.append_op("flash_attention", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
     return out

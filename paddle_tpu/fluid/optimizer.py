@@ -605,3 +605,43 @@ RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
+
+
+class PipelineOptimizer:
+    """Pipeline-parallel training (reference optimizer.py:2664).
+
+    The reference cuts the program at `cut_list` variables into sections run
+    by SectionWorkers with scope queues.  Here minimize() delegates to the
+    wrapped optimizer and records the pipeline metadata; execution is
+    parallel/pipeline.py PipelineRunner — per-stage whole-stage XLA programs,
+    GPipe microbatching with stage-granular rematerialization, gradient
+    accumulation across microbatches.
+
+    cut_list accepts a list of boundary Variables, or the reference's
+    list-of-lists form (flattened).
+    """
+
+    def __init__(self, optimizer, cut_list=None, num_microbatches=1,
+                 queue_size=30, sync_steps=1, start_cpu_core_id=0):
+        self._opt = optimizer
+        flat = []
+        for c in (cut_list or []):
+            flat.extend(c if isinstance(c, (list, tuple)) else [c])
+        self._cut_vars = flat
+        self._num_microbatches = int(num_microbatches)
+        # queue_size / sync_steps / start_cpu_core_id: reference knobs for
+        # the scope-queue workers; accepted for API parity
+        del queue_size, sync_steps, start_cpu_core_id
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        out = self._opt.minimize(loss, startup_program, parameter_list,
+                                 no_grad_set)
+        program = loss.block.program
+        program._pipeline = {
+            "cut_vars": [v.name if hasattr(v, "name") else v
+                         for v in self._cut_vars],
+            "num_microbatches": self._num_microbatches,
+            "loss_name": loss.name,
+        }
+        return out

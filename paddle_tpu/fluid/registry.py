@@ -61,6 +61,11 @@ class OpInfo:
     # outputs that alias an input in-place (out_slot -> in_slot), e.g. sgd's
     # ParamOut aliases Param.  Used for buffer-donation bookkeeping.
     inplace: _t.Optional[dict] = None
+    # host-side op: runs OUTSIDE the jitted block, after it, in program
+    # order — RPC (send/recv/listen_and_serv), IO, anything side-effectful
+    # that can't live in an XLA computation.  fn(scope, op, place) reads and
+    # writes the scope directly.  `lower` is never called for these.
+    host_run: _t.Optional[_t.Callable] = None
 
     def is_variadic(self, slot):
         return slot.endswith("*")
@@ -110,6 +115,7 @@ def register_op(
     no_grad_inputs=(),
     grad_maker=None,
     inplace=None,
+    host_run=None,
 ):
     """Register an op lowering.
 
@@ -128,8 +134,11 @@ def register_op(
         no_grad_inputs=frozenset(no_grad_inputs),
         grad_maker=grad_maker,
         inplace=inplace,
+        host_run=host_run,
     )
     _OP_REGISTRY[type] = info
+    if host_run is not None and grad == "auto":
+        grad = info.grad = None
     if grad == "auto":
         _register_auto_grad(info)
     return info

@@ -1,0 +1,10 @@
+"""Program transpilers (reference python/paddle/fluid/transpiler/)."""
+
+from .distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)
+from paddle_tpu.ops.dist_ops import stop_pservers, reset_channels  # noqa: F401
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "stop_pservers", "reset_channels"]

@@ -1,0 +1,98 @@
+"""Collective-mode transpilers (reference transpiler/collective.py).
+
+The reference's `GradAllReduce` (:178) appends `c_gen_nccl_id`/`c_comm_init`
+bootstrap ops to the startup program and inserts `c_allreduce_sum` +
+`c_sync_*_stream` after each gradient; `LocalSGD` (:269) instead snapshots
+params and periodically averages them across trainers.
+
+TPU-native: there is no NCCL bootstrap — a jax Mesh is the communicator
+(parallel/mesh.py), so `transpile` only performs the graph rewrite; the
+`c_*` ops lower to XLA collectives (ops/collective_ops.py) when the program
+runs under a mesh axis (DataParallelRunner / HybridParallelRunner), and are
+identity on one device.  Stream-sync ops are token ordering in XLA, i.e.
+no-ops here.
+"""
+
+from __future__ import annotations
+
+from ..framework import default_main_program, default_startup_program
+
+__all__ = ["Collective", "GradAllReduce", "LocalSGD"]
+
+
+class Collective:
+    """Base: records the job layout; subclasses rewrite the main program."""
+
+    def __init__(self, nrings=1):
+        self.nrings = nrings
+        self.rank = 0
+        self.nranks = 1
+
+    def transpile(self, startup_program=None, main_program=None, rank=0,
+                  endpoints="127.0.0.1:6174", current_endpoint=None,
+                  wait_port=True):
+        self.startup_program = (startup_program if startup_program is not None
+                                else default_startup_program())
+        self.main_program = (main_program if main_program is not None
+                             else default_main_program())
+        if isinstance(endpoints, str):
+            endpoints = [e.strip() for e in endpoints.split(",") if e.strip()]
+        self.rank = rank
+        self.nranks = max(1, len(endpoints))
+        self._transpile_startup_program()
+        self._transpile_main_program()
+        return self
+
+    def _transpile_startup_program(self):
+        # reference inserts c_gen_nccl_id + c_comm_init here; the mesh IS the
+        # communicator on TPU — nothing to bootstrap
+        pass
+
+    def _transpile_main_program(self):
+        raise NotImplementedError
+
+
+class GradAllReduce(Collective):
+    """Insert a c_allreduce on every parameter gradient (reference :208).
+
+    Delegates to the same rewrite the data-parallel runner uses
+    (parallel/data_parallel.py transpile_data_parallel), which also rescales
+    the loss-grad seed and averages batch-norm stats — the
+    multi_devices_graph_pass behaviors in one place.
+    """
+
+    def __init__(self, nrings=1, loss_name=None, num_devices=None):
+        super().__init__(nrings)
+        self._loss_name = loss_name
+        self._num_devices = num_devices
+
+    def _transpile_main_program(self):
+        from paddle_tpu.parallel.data_parallel import transpile_data_parallel
+
+        transpile_data_parallel(self.main_program, self._loss_name,
+                                self._num_devices or self.nranks)
+
+
+class LocalSGD(Collective):
+    """Local SGD (reference :269): every worker optimizes locally; every
+    `k_steps` the parameters are averaged across the ring.
+
+    Under jit's global-view semantics per-device parameter divergence must
+    live inside the compiled step, so the actual machinery is
+    parallel/local_sgd.py LocalSGDRunner — k micro-steps scanned inside
+    shard_map with one pmean at the end.  transpile() leaves the program
+    unrewritten (local steps ARE the original program) and records k for the
+    runner."""
+
+    def __init__(self, nrings=1, k_steps=1):
+        super().__init__(nrings)
+        self.k_steps = int(k_steps)
+
+    def _transpile_main_program(self):
+        self.main_program._local_sgd_k = self.k_steps
+
+    def runner(self, places=None, scope=None):
+        from paddle_tpu.parallel.local_sgd import LocalSGDRunner
+
+        return LocalSGDRunner(self.main_program, self.k_steps, places=places,
+                              scope=scope)

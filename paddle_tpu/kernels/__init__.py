@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — hand-written kernels for what XLA fuses poorly
+(TPU analog of the reference's operators/jit/ CPU codegen)."""
+
+from .flash_attention import attention_reference, flash_attention  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
+
+__all__ = ["flash_attention", "attention_reference", "ring_attention"]

@@ -1,0 +1,126 @@
+"""Ring attention: sequence/context-parallel attention over a mesh axis.
+
+The reference has no sequence parallelism at all (SURVEY.md §5 "Long-context
+/ sequence parallelism: None") — its sequence scaling story is LoD ragged
+batching on one device.  This module is the TPU-native long-context design:
+shard the sequence dimension over a mesh axis ('sp'), keep Q local, and
+rotate K/V chunks around the ring with `jax.lax.ppermute` while accumulating
+an online softmax — each device only ever holds S/sp keys, so attention
+memory is O(S·S/sp²) per device and sequence length scales linearly with the
+ring size.  Collectives ride ICI (neighbor exchange = the cheapest possible
+pattern on a torus).
+
+Composition with other axes: batch stays sharded on 'dp', heads on 'mp'
+(Megatron QKV column split makes the head dim mp-sharded already), sequence
+on 'sp' — the shard_map in_specs say so, and XLA GSPMD stitches this into
+the surrounding computation without extra resharding.
+
+Differentiation: the ring loop is a `lax.scan` (static trip count = ring
+size), so `jax.vjp` flows through it and the backward pass runs the ring in
+reverse automatically — no hand-written backward kernel needed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _ring_shard(q, k, v, bias, *, axis_name, causal, sm_scale, ring_size):
+    """Per-shard ring attention body (runs inside shard_map).
+
+    q: [B, H, Sq, D] local query shard; k/v: [B, H, Sk, D] local key shard;
+    bias: [B, Sk] local additive key bias.  Returns [B, H, Sq, D].
+    """
+    b_, h_, sq, d = q.shape
+    sk = k.shape[2]
+    idx = jax.lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32)
+    m0 = jnp.full((b_, h_, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b_, h_, sq), jnp.float32)
+    acc0 = jnp.zeros((b_, h_, sq, d), jnp.float32)
+    q_pos = idx * sq + jnp.arange(sq)
+
+    perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
+
+    def step(carry, i):
+        k_c, v_c, b_c, m, l, acc = carry
+        # the chunk now resident arrived from shard (idx - i) mod ring_size
+        src = (idx - i) % ring_size
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_c.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * sm_scale
+        s = s + b_c.astype(jnp.float32)[:, None, None, :]
+        if causal:
+            k_pos = src * sk + jnp.arange(sk)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        m = m_new
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        b_c = jax.lax.ppermute(b_c, axis_name, perm)
+        return (k_c, v_c, b_c, m, l, acc), None
+
+    (k_c, v_c, b_c, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, bias, m0, l0, acc0), jnp.arange(ring_size))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, bias=None, causal=False, sm_scale=None,
+                   mesh=None, sp_axis="sp", dp_axis="dp", mp_axis="mp"):
+    """Sequence-parallel attention over [B, H, S, D] global arrays.
+
+    The S dim of q/k/v is sharded over `sp_axis` of `mesh`; batch over
+    `dp_axis` and heads over `mp_axis` when those axes exist.  bias is an
+    optional additive key bias broadcastable to [B, 1, 1, S] (padding mask).
+    Falls back to single-device flash/reference attention when the mesh has
+    no sp axis.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from jax import shard_map
+
+    from paddle_tpu.parallel import mesh as pmesh
+
+    if mesh is None:
+        mesh = pmesh.current_mesh()
+    if mesh is None or sp_axis not in mesh.axis_names \
+            or mesh.shape[sp_axis] == 1:
+        from paddle_tpu.kernels import flash_attention as _fa
+
+        return _fa(q, k, v, bias=bias, causal=causal, sm_scale=sm_scale)
+
+    b, h, s, d = q.shape
+    scale = float(sm_scale if sm_scale is not None else 1.0 / np.sqrt(d))
+    ring = int(mesh.shape[sp_axis])
+    if s % ring:
+        raise ValueError(f"seq len {s} not divisible by sp={ring}")
+    if bias is None:
+        bias2 = jnp.zeros((b, s), jnp.float32)
+    else:
+        bias2 = jnp.broadcast_to(bias.reshape(b, 1, -1)[:, 0, :],
+                                 (b, s)).astype(jnp.float32)
+
+    dp = dp_axis if dp_axis in mesh.axis_names else None
+    mp = mp_axis if mp_axis in mesh.axis_names else None
+    qkv_spec = P(dp, mp, sp_axis, None)
+    bias_spec = P(dp, sp_axis)
+
+    body = functools.partial(_ring_shard, axis_name=sp_axis, causal=causal,
+                             sm_scale=scale, ring_size=ring)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(qkv_spec, qkv_spec, qkv_spec, bias_spec),
+                   out_specs=qkv_spec, check_vma=False)
+    return fn(q, k, v, bias2)

@@ -27,7 +27,8 @@ class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=3072, max_position=512,
                  type_vocab_size=2, hidden_dropout=0.1, attn_dropout=0.1,
-                 initializer_range=0.02):
+                 initializer_range=0.02, use_flash_attention=True,
+                 sequence_parallel=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -38,6 +39,8 @@ class BertConfig:
         self.hidden_dropout = hidden_dropout
         self.attn_dropout = attn_dropout
         self.initializer_range = initializer_range
+        self.use_flash_attention = use_flash_attention
+        self.sequence_parallel = sequence_parallel
 
     @classmethod
     def base(cls, **kw):
@@ -71,15 +74,24 @@ def multi_head_attention(x, attn_bias, cfg: BertConfig, name, is_test=False):
         return layers.transpose(r, perm=[0, 2, 1, 3])  # [B, n, S, d]
 
     q, k, v = to_heads(q), to_heads(k), to_heads(v)
-    scores = layers.matmul(q, k, transpose_y=True, alpha=float(d) ** -0.5)
-    if attn_bias is not None:
-        scores = layers.elementwise_add(scores, attn_bias)
-    weights = layers.softmax(scores)
-    if cfg.attn_dropout and not is_test:
-        weights = layers.dropout(weights, dropout_prob=cfg.attn_dropout,
-                                 is_test=is_test,
-                                 dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(weights, v)  # [B, n, S, d]
+    use_flash = cfg.use_flash_attention and (is_test or not cfg.attn_dropout)
+    if use_flash:
+        # Pallas blockwise attention: no [B,n,S,S] score tensor in HBM
+        # (attention-probs dropout is not expressible in the kernel — the
+        # composed path below keeps exact parity when attn_dropout is on)
+        ctx = layers.flash_attention(q, k, v, attn_bias=attn_bias,
+                                     sm_scale=float(d) ** -0.5,
+                                     sequence_parallel=cfg.sequence_parallel)
+    else:
+        scores = layers.matmul(q, k, transpose_y=True, alpha=float(d) ** -0.5)
+        if attn_bias is not None:
+            scores = layers.elementwise_add(scores, attn_bias)
+        weights = layers.softmax(scores)
+        if cfg.attn_dropout and not is_test:
+            weights = layers.dropout(weights, dropout_prob=cfg.attn_dropout,
+                                     is_test=is_test,
+                                     dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(weights, v)  # [B, n, S, d]
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = layers.reshape(ctx, shape=[0, 0, h])
     return _fc(ctx, h, name + "_output_fc", init_std=cfg.initializer_range)

@@ -25,15 +25,20 @@ import numpy as np
 __all__ = ["lib", "RecordIOWriter", "RecordIOScanner", "BlockingQueue",
            "MultiSlotFeed", "is_available"]
 
-_SRC = os.path.join(os.path.dirname(__file__), "src", "data_runtime.cc")
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_SRCS = [os.path.join(_SRC_DIR, "data_runtime.cc"),
+         os.path.join(_SRC_DIR, "ps_runtime.cc")]
 _lib = None
 _lib_lock = threading.Lock()
 _build_error = None
 
 
 def _build() -> str:
-    with open(_SRC, "rb") as f:
-        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    h = hashlib.sha256()
+    for src in _SRCS:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:16]
     out_dir = os.path.join(os.path.dirname(__file__), "_build")
     os.makedirs(out_dir, exist_ok=True)
     so_path = os.path.join(out_dir, f"libptq_data_{tag}.so")
@@ -43,7 +48,7 @@ def _build() -> str:
     # jobs) must not interleave writes to the same output file
     tmp = f"{so_path}.{os.getpid()}.tmp"
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-lz", "-o", tmp]
+           *_SRCS, "-lz", "-o", tmp]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, so_path)
     return so_path
@@ -85,6 +90,8 @@ def lib():
                                     ctypes.c_double]
         L.ptq_queue_size.restype = ctypes.c_int64
         L.ptq_queue_size.argtypes = [ctypes.c_void_p]
+        L.ptq_queue_waiters.restype = ctypes.c_int64
+        L.ptq_queue_waiters.argtypes = [ctypes.c_void_p]
         L.ptq_queue_close.argtypes = [ctypes.c_void_p]
         L.ptq_queue_free.argtypes = [ctypes.c_void_p]
         L.ptq_feed_new.restype = ctypes.c_void_p
@@ -97,6 +104,43 @@ def lib():
         L.ptq_feed_error.argtypes = [ctypes.c_void_p,
                                      ctypes.POINTER(ctypes.c_void_p)]
         L.ptq_feed_free.argtypes = [ctypes.c_void_p]
+        # --- parameter-server transport (ps_runtime.cc) ---
+        L.pts_server_start.restype = ctypes.c_void_p
+        L.pts_server_start.argtypes = [ctypes.c_int, ctypes.c_int]
+        L.pts_server_port.restype = ctypes.c_int
+        L.pts_server_port.argtypes = [ctypes.c_void_p]
+        L.pts_server_wait_round.restype = ctypes.c_int
+        L.pts_server_wait_round.argtypes = [ctypes.c_void_p]
+        L.pts_server_grad_count.restype = ctypes.c_int64
+        L.pts_server_grad_count.argtypes = [ctypes.c_void_p]
+        L.pts_server_grad_at.restype = ctypes.c_int64
+        L.pts_server_grad_at.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.POINTER(ctypes.c_void_p),
+                                         ctypes.POINTER(ctypes.c_void_p)]
+        L.pts_server_grad_name_len.restype = ctypes.c_int64
+        L.pts_server_grad_name_len.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        L.pts_server_publish.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_char_p, ctypes.c_int64]
+        L.pts_server_bump_version.argtypes = [ctypes.c_void_p]
+        L.pts_server_release_send.argtypes = [ctypes.c_void_p]
+        L.pts_server_end_round.restype = ctypes.c_int
+        L.pts_server_end_round.argtypes = [ctypes.c_void_p]
+        L.pts_server_table_get.restype = ctypes.c_int64
+        L.pts_server_table_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.POINTER(ctypes.c_void_p)]
+        L.pts_server_wait_table.restype = ctypes.c_int
+        L.pts_server_wait_table.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.pts_server_stop.argtypes = [ctypes.c_void_p]
+        L.pts_connect.restype = ctypes.c_void_p
+        L.pts_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.c_double]
+        L.pts_request.restype = ctypes.c_int
+        L.pts_request.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.POINTER(ctypes.c_void_p),
+                                  ctypes.POINTER(ctypes.c_int64)]
+        L.pts_client_close.argtypes = [ctypes.c_void_p]
         _lib = L
         return _lib
 
@@ -145,6 +189,14 @@ class RecordIOWriter:
     def __exit__(self, *exc):
         self.close()
 
+    def __del__(self):
+        # a dropped writer must still flush its buffered chunk — silently
+        # losing up to 1 MiB of records is worse than late IO in a finalizer
+        try:
+            self.close()
+        except Exception:
+            pass
+
 
 class RecordIOScanner:
     """Iterates records of a RecordIO file (reference recordio/scanner.cc)."""
@@ -179,6 +231,12 @@ class RecordIOScanner:
     def __exit__(self, *exc):
         self.close()
 
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
 
 class BlockingQueue:
     """Bounded byte-blob queue (LoDTensorBlockingQueue analog) backed by C++
@@ -207,13 +265,20 @@ class BlockingQueue:
     def size(self):
         return lib().ptq_queue_size(self._h)
 
+    def waiters(self):
+        """Number of threads currently blocked in push/pop."""
+        return lib().ptq_queue_waiters(self._h)
+
     def close(self):
         lib().ptq_queue_close(self._h)
 
     def __del__(self):
+        # ptq_queue_free closes first and waits for blocked push/pop callers
+        # to leave before destroying the mutex/cvs
         try:
             if self._h:
                 lib().ptq_queue_free(self._h)
+                self._h = None
         except Exception:
             pass
 
@@ -297,6 +362,155 @@ class MultiSlotFeed:
     def close(self):
         if self._h:
             lib().ptq_feed_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Parameter-server transport (ps_runtime.cc) — reference analog:
+# operators/distributed/ gRPC SendRecvService + listen_and_serv sync loop.
+# Tensors travel as opaque blobs: 1-byte dtype code + raw bytes; shape comes
+# from the program's VarDesc on each side.
+# ---------------------------------------------------------------------------
+
+CMD_SEND_GRAD = 1
+CMD_GET_PARAM = 2
+CMD_SEND_BARRIER = 3
+CMD_FETCH_BARRIER = 4
+CMD_SEND_PARAM = 5
+CMD_STOP = 6
+
+
+def _encode_tensor(arr) -> bytes:
+    a = np.ascontiguousarray(arr)
+    code = a.dtype.str.encode()  # e.g. b'<f4'
+    return bytes([len(code)]) + code + a.tobytes()
+
+
+def _decode_tensor(blob: bytes, shape=None):
+    n = blob[0]
+    dtype = np.dtype(blob[1:1 + n].decode())
+    a = np.frombuffer(blob, dtype, offset=1 + n).copy()
+    return a.reshape(shape) if shape is not None else a
+
+
+class PSServer:
+    """Sync-mode parameter-server transport endpoint.
+
+    The driver loop above it (transpiler.run_pserver / listen_and_serv
+    lowering) is: wait_round() → grads() → optimize → publish() →
+    bump_version() → release_send() → end_round(), mirroring
+    listen_and_serv_op.cc:109 RunSyncLoop.
+    """
+
+    def __init__(self, port=0, n_trainers=1):
+        self._h = lib().pts_server_start(int(port), int(n_trainers))
+        if not self._h:
+            raise IOError(f"cannot bind pserver port {port}")
+
+    @property
+    def port(self):
+        return lib().pts_server_port(self._h)
+
+    def wait_round(self) -> bool:
+        """Block until every trainer hit send_barrier; False = stopped."""
+        return bool(lib().pts_server_wait_round(self._h))
+
+    def grads(self):
+        """All grads received this round as [(name, np_array)]."""
+        out = []
+        n = lib().pts_server_grad_count(self._h)
+        for i in range(n):
+            name_p, data_p = ctypes.c_void_p(), ctypes.c_void_p()
+            dlen = lib().pts_server_grad_at(self._h, i, ctypes.byref(name_p),
+                                            ctypes.byref(data_p))
+            nlen = lib().pts_server_grad_name_len(self._h, i)
+            name = _take(name_p, nlen).decode()
+            out.append((name, _decode_tensor(_take(data_p, dlen))))
+        return out
+
+    def publish(self, name, arr):
+        blob = _encode_tensor(arr)
+        lib().pts_server_publish(self._h, name.encode(), blob, len(blob))
+
+    def bump_version(self):
+        lib().pts_server_bump_version(self._h)
+
+    def release_send(self):
+        """Ack this round's SEND_BARRIERs (call after publish+bump)."""
+        lib().pts_server_release_send(self._h)
+
+    def end_round(self) -> bool:
+        return bool(lib().pts_server_end_round(self._h))
+
+    def wait_table(self, name) -> bool:
+        """Block until `name` was pushed (trainer-0 init); False = stopped."""
+        return bool(lib().pts_server_wait_table(self._h, name.encode()))
+
+    def table_get(self, name, shape=None):
+        out = ctypes.c_void_p()
+        n = lib().pts_server_table_get(self._h, name.encode(),
+                                       ctypes.byref(out))
+        if n < 0:
+            return None
+        return _decode_tensor(_take(out, n), shape)
+
+    def stop(self):
+        if self._h:
+            lib().pts_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class PSClient:
+    """Trainer-side connection to one pserver endpoint."""
+
+    def __init__(self, host="127.0.0.1", port=0, timeout=30.0):
+        self._h = lib().pts_connect(host.encode(), int(port), float(timeout))
+        if not self._h:
+            raise IOError(f"cannot connect to pserver {host}:{port}")
+
+    def _req(self, cmd, name="", round=0, blob=b""):
+        out, olen = ctypes.c_void_p(), ctypes.c_int64()
+        rc = lib().pts_request(self._h, cmd, name.encode(), round, blob,
+                               len(blob), ctypes.byref(out),
+                               ctypes.byref(olen))
+        if rc != 0:
+            raise IOError(f"pserver rpc cmd={cmd} name={name} failed rc={rc}")
+        return _take(out, olen.value)
+
+    def send_grad(self, name, arr):
+        self._req(CMD_SEND_GRAD, name, blob=_encode_tensor(arr))
+
+    def send_param(self, name, arr):
+        self._req(CMD_SEND_PARAM, name, blob=_encode_tensor(arr))
+
+    def get_param(self, name, want_version=0, shape=None):
+        return _decode_tensor(self._req(CMD_GET_PARAM, name,
+                                        round=want_version), shape)
+
+    def send_barrier(self):
+        self._req(CMD_SEND_BARRIER)
+
+    def fetch_barrier(self):
+        self._req(CMD_FETCH_BARRIER)
+
+    def stop_server(self):
+        self._req(CMD_STOP)
+
+    def close(self):
+        if self._h:
+            lib().pts_client_close(self._h)
             self._h = None
 
     def __del__(self):

@@ -120,6 +120,7 @@ int ptq_recordio_writer_close(void* handle) {
 
 struct RecordScanner {
   FILE* f = nullptr;
+  uint64_t file_size = 0;
   std::string chunk;     // decompressed records of current chunk
   size_t offset = 0;
   std::string current;   // last record returned
@@ -136,6 +137,15 @@ struct RecordScanner {
     if (fread(&comp_len, 8, 1, f) != 1) return -1;
     if (fread(&crc, 4, 1, f) != 1) return -1;
     if (fread(&flags, 1, 1, f) != 1) return -1;
+    // bound header lengths before allocating: a corrupt length field must
+    // surface as -1, not as std::bad_alloc aborting through the C ABI.
+    // comp_len can't exceed what's left of the file; raw_len can't exceed
+    // a sane decompression blow-up of it.
+    long at = ftell(f);
+    if (at < 0 || comp_len > file_size - static_cast<uint64_t>(at)) return -1;
+    // deflate's max expansion is ~1032:1; 1056x + slack stays above it so a
+    // maximally-compressible (e.g. all-zero) chunk still round-trips
+    if (raw_len > comp_len * 1056 + (1ull << 16)) return -1;
     std::string payload(comp_len, '\0');
     if (comp_len && fread(&payload[0], comp_len, 1, f) != 1) return -1;
     uint32_t got = crc32(0L, reinterpret_cast<const Bytef*>(payload.data()),
@@ -162,6 +172,10 @@ void* ptq_recordio_scanner_open(const char* path) {
   if (!f) return nullptr;
   auto* s = new RecordScanner();
   s->f = f;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  s->file_size = sz > 0 ? static_cast<uint64_t>(sz) : 0;
   return s;
 }
 
@@ -196,12 +210,21 @@ void ptq_recordio_scanner_close(void* handle) {
 
 struct BlockingQueue {
   std::mutex mu;
-  std::condition_variable cv_push, cv_pop;
+  std::condition_variable cv_push, cv_pop, cv_idle;
   std::deque<std::string> items;
   size_t capacity;
   bool closed = false;
+  int waiters = 0;  // threads blocked in push/pop: free() must wait for them
 
   explicit BlockingQueue(size_t cap) : capacity(cap) {}
+
+  struct WaiterGuard {
+    BlockingQueue* q;
+    explicit WaiterGuard(BlockingQueue* q_) : q(q_) { q->waiters++; }
+    ~WaiterGuard() {
+      if (--q->waiters == 0) q->cv_idle.notify_all();
+    }
+  };
 };
 
 void* ptq_queue_new(int64_t capacity) {
@@ -213,6 +236,7 @@ int ptq_queue_push(void* handle, const char* data, int64_t len,
                    double timeout_s) {
   auto* q = static_cast<BlockingQueue*>(handle);
   std::unique_lock<std::mutex> lk(q->mu);
+  BlockingQueue::WaiterGuard guard(q);
   auto pred = [q] { return q->closed || q->items.size() < q->capacity; };
   if (timeout_s < 0) {
     q->cv_push.wait(lk, pred);
@@ -230,6 +254,7 @@ int ptq_queue_push(void* handle, const char* data, int64_t len,
 int64_t ptq_queue_pop(void* handle, char** out, double timeout_s) {
   auto* q = static_cast<BlockingQueue*>(handle);
   std::unique_lock<std::mutex> lk(q->mu);
+  BlockingQueue::WaiterGuard guard(q);
   auto pred = [q] { return q->closed || !q->items.empty(); };
   if (timeout_s < 0) {
     q->cv_pop.wait(lk, pred);
@@ -241,7 +266,8 @@ int64_t ptq_queue_pop(void* handle, char** out, double timeout_s) {
   std::string item = std::move(q->items.front());
   q->items.pop_front();
   q->cv_push.notify_one();
-  lk.unlock();
+  // keep the lock until WaiterGuard decrements `waiters` — it must not race
+  // with ptq_queue_free's idle wait
   *out = dup_buf(item);
   return static_cast<int64_t>(item.size());
 }
@@ -250,6 +276,12 @@ int64_t ptq_queue_size(void* handle) {
   auto* q = static_cast<BlockingQueue*>(handle);
   std::lock_guard<std::mutex> lk(q->mu);
   return static_cast<int64_t>(q->items.size());
+}
+
+int64_t ptq_queue_waiters(void* handle) {
+  auto* q = static_cast<BlockingQueue*>(handle);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->waiters;
 }
 
 void ptq_queue_close(void* handle) {
@@ -263,7 +295,17 @@ void ptq_queue_close(void* handle) {
 }
 
 void ptq_queue_free(void* handle) {
-  delete static_cast<BlockingQueue*>(handle);
+  auto* q = static_cast<BlockingQueue*>(handle);
+  {
+    // close, then wait for every blocked push/pop to leave before the mutex
+    // and condition variables are destroyed (use-after-free otherwise)
+    std::unique_lock<std::mutex> lk(q->mu);
+    q->closed = true;
+    q->cv_push.notify_all();
+    q->cv_pop.notify_all();
+    q->cv_idle.wait(lk, [q] { return q->waiters == 0; });
+  }
+  delete q;
 }
 
 // ---------------------------------------------------------------------------
@@ -330,7 +372,10 @@ struct MultiSlotFeed {
         p = end;
       }
     }
-    return true;
+    // a slot-count mismatch between file and config must error, not train on
+    // silently misaligned data: only whitespace may remain
+    while (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n') ++p;
+    return *p == '\0';
   }
 
   std::string serialize(const std::vector<SlotBatch>& batch) const {

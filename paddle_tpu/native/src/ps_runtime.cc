@@ -1,0 +1,436 @@
+// Parameter-server transport: native TCP RPC runtime.
+//
+// Reference analog: paddle/fluid/operators/distributed/ — the gRPC/BRPC
+// SendRecvService (send_recv.proto.in:19: SendVariable / GetVariable),
+// RequestHandler dispatch, and the listen_and_serv sync loop
+// (listen_and_serv_op.cc:109 RunSyncLoop: wait kRequestSend barrier → run
+// optimize blocks → release kRequestGet).  TPU-native redesign: the dense
+// data path rides XLA collectives; this runtime exists for the
+// parameter-server mode (sparse/CTR workloads, async geo-SGD) where a
+// host-side service is the right architecture.  Tensors travel as opaque
+// byte blobs (name + payload); aggregation and optimizer math happen in the
+// driver above — the transport stays dumb and fast.
+//
+// Wire format (little-endian), one request per frame:
+//   u8 cmd | u16 name_len | name | u64 round | u64 data_len | data
+// response:
+//   u8 status (0 ok, 1 stopped/error) | u64 data_len | data
+//
+// Sync-round protocol (mirrors RunSyncLoop):
+//   trainers: SEND_GRAD*  SEND_BARRIER  GET_PARAM(round=r)*  FETCH_BARRIER
+//   server driver: wait_round → drain grads → optimize → publish* →
+//                  bump_version → release_send → end_round
+//
+// Barrier acks are RENDEZVOUS: a SEND_BARRIER is not acknowledged until the
+// driver has processed the round (release_send), and a FETCH_BARRIER not
+// until the driver closed the round (end_round).  Without this, a fast
+// trainer could race into round r+1 — its barrier/grads arriving before the
+// driver resets round state — and be silently wiped (lost-wakeup deadlock).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t {
+  kSendGrad = 1,
+  kGetParam = 2,
+  kSendBarrier = 3,
+  kFetchBarrier = 4,
+  kSendParam = 5,
+  kStop = 6,
+};
+
+bool read_n(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_n(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+char* dup_blob(const std::string& s) {
+  char* p = static_cast<char*>(::malloc(s.size() ? s.size() : 1));
+  if (p && !s.empty()) ::memcpy(p, s.data(), s.size());
+  return p;
+}
+
+constexpr uint64_t kMaxBlob = 1ull << 33;  // 8 GiB sanity bound
+
+struct Frame {
+  uint8_t cmd;
+  std::string name;
+  uint64_t round;
+  std::string data;
+};
+
+bool read_frame(int fd, Frame* f) {
+  uint8_t cmd;
+  uint16_t nlen;
+  if (!read_n(fd, &cmd, 1) || !read_n(fd, &nlen, 2)) return false;
+  f->cmd = cmd;
+  f->name.resize(nlen);
+  if (nlen && !read_n(fd, &f->name[0], nlen)) return false;
+  uint64_t dlen;
+  if (!read_n(fd, &f->round, 8) || !read_n(fd, &dlen, 8)) return false;
+  if (dlen > kMaxBlob) return false;
+  f->data.resize(dlen);
+  if (dlen && !read_n(fd, &f->data[0], dlen)) return false;
+  return true;
+}
+
+bool write_response(int fd, uint8_t status, const std::string& data) {
+  uint64_t dlen = data.size();
+  return write_n(fd, &status, 1) && write_n(fd, &dlen, 8) &&
+         (dlen == 0 || write_n(fd, data.data(), dlen));
+}
+
+struct PSServer {
+  int listen_fd = -1;
+  int port = 0;
+  int n_trainers = 1;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, std::string> table;  // published params
+  uint64_t version = 0;
+  std::deque<std::pair<std::string, std::string>> grads;
+  int send_arrived = 0;    // trainers parked in SEND_BARRIER this round
+  int fetch_arrived = 0;   // trainers parked in FETCH_BARRIER this round
+  uint64_t round_id = 0;       // completed rounds
+  uint64_t send_ack_round = 0;  // rounds whose send barrier was released
+  bool stopped = false;
+
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;
+
+  void serve_conn(int fd) {
+    Frame f;
+    while (read_frame(fd, &f)) {
+      std::unique_lock<std::mutex> lk(mu);
+      if (stopped && f.cmd != kStop) {
+        write_response(fd, 1, "");
+        break;
+      }
+      switch (f.cmd) {
+        case kSendGrad:
+          grads.emplace_back(f.name, std::move(f.data));
+          lk.unlock();
+          if (!write_response(fd, 0, "")) return;
+          break;
+        case kSendParam:
+          table[f.name] = std::move(f.data);
+          cv.notify_all();
+          lk.unlock();
+          if (!write_response(fd, 0, "")) return;
+          break;
+        case kSendBarrier: {
+          uint64_t r = round_id;
+          ++send_arrived;
+          cv.notify_all();
+          // ack deferred until the driver released this round's sends
+          cv.wait(lk, [&] { return stopped || send_ack_round > r; });
+          bool ok = !stopped;
+          lk.unlock();
+          if (!write_response(fd, ok ? 0 : 1, "")) return;
+          if (!ok) return;
+          break;
+        }
+        case kFetchBarrier: {
+          uint64_t r = round_id;
+          ++fetch_arrived;
+          cv.notify_all();
+          cv.wait(lk, [&] { return stopped || round_id > r; });
+          bool ok = !stopped;
+          lk.unlock();
+          if (!write_response(fd, ok ? 0 : 1, "")) return;
+          if (!ok) return;
+          break;
+        }
+        case kGetParam: {
+          uint64_t want = f.round;
+          cv.wait(lk, [&] {
+            return stopped || (version >= want && table.count(f.name));
+          });
+          if (stopped) {
+            write_response(fd, 1, "");
+            return;
+          }
+          std::string blob = table[f.name];
+          lk.unlock();
+          if (!write_response(fd, 0, blob)) return;
+          break;
+        }
+        case kStop:
+          stopped = true;
+          cv.notify_all();
+          lk.unlock();
+          write_response(fd, 0, "");
+          return;
+        default:
+          lk.unlock();
+          write_response(fd, 1, "");
+          return;
+      }
+    }
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) return;  // listen socket closed on stop
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(mu);
+      if (stopped) {
+        ::close(fd);
+        return;
+      }
+      conn_fds.push_back(fd);
+      conn_threads.emplace_back([this, fd] {
+        serve_conn(fd);
+        ::close(fd);
+      });
+    }
+  }
+};
+
+struct PSClient {
+  int fd = -1;
+  std::mutex mu;
+};
+
+}  // namespace
+
+extern "C" {
+
+void ptq_free(char* p);  // from data_runtime.cc (same shared library)
+
+// ---------------------------------------------------------------------- //
+// server                                                                 //
+// ---------------------------------------------------------------------- //
+
+void* pts_server_start(int port, int n_trainers) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 64) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* s = new PSServer();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->n_trainers = n_trainers;
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+int pts_server_port(void* h) { return static_cast<PSServer*>(h)->port; }
+
+// 1 = round ready (all trainers hit send_barrier), 0 = stopped
+int pts_server_wait_round(void* h) {
+  auto* s = static_cast<PSServer*>(h);
+  std::unique_lock<std::mutex> lk(s->mu);
+  s->cv.wait(lk, [s] {
+    return s->stopped || s->send_arrived >= s->n_trainers;
+  });
+  return s->stopped ? 0 : 1;
+}
+
+// release trainers parked in SEND_BARRIER (call after publish+bump_version)
+void pts_server_release_send(void* h) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->send_ack_round = s->round_id + 1;
+  s->send_arrived -= s->n_trainers;
+  s->cv.notify_all();
+}
+
+int64_t pts_server_grad_count(void* h) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return static_cast<int64_t>(s->grads.size());
+}
+
+// copies grad i's name and payload; both freed by caller via ptq_free
+int64_t pts_server_grad_at(void* h, int64_t i, char** name_out,
+                           char** data_out) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (i < 0 || i >= static_cast<int64_t>(s->grads.size())) return -1;
+  *name_out = dup_blob(s->grads[i].first);
+  *data_out = dup_blob(s->grads[i].second);
+  return static_cast<int64_t>(s->grads[i].second.size());
+}
+
+int64_t pts_server_grad_name_len(void* h, int64_t i) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (i < 0 || i >= static_cast<int64_t>(s->grads.size())) return -1;
+  return static_cast<int64_t>(s->grads[i].first.size());
+}
+
+void pts_server_publish(void* h, const char* name, const char* data,
+                        int64_t len) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->table[name] = std::string(data, static_cast<size_t>(len));
+}
+
+void pts_server_bump_version(void* h) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  ++s->version;
+  s->cv.notify_all();
+}
+
+// wait for all fetch barriers, close the round, release the trainers;
+// 1 = ok, 0 = stopped.  No round r+1 message can arrive before this resets
+// state: every trainer is still parked in its FETCH_BARRIER ack.
+int pts_server_end_round(void* h) {
+  auto* s = static_cast<PSServer*>(h);
+  std::unique_lock<std::mutex> lk(s->mu);
+  s->cv.wait(lk, [s] {
+    return s->stopped || s->fetch_arrived >= s->n_trainers;
+  });
+  if (s->stopped) return 0;
+  s->grads.clear();
+  s->fetch_arrived -= s->n_trainers;
+  ++s->round_id;
+  s->cv.notify_all();
+  return 1;
+}
+
+// fetch a published/pushed param (e.g. the trainer-0 init push); -1 if absent
+int64_t pts_server_table_get(void* h, const char* name, char** out) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  auto it = s->table.find(name);
+  if (it == s->table.end()) return -1;
+  *out = dup_blob(it->second);
+  return static_cast<int64_t>(it->second.size());
+}
+
+// block until `name` exists in the table (init push); 1 ok, 0 stopped
+int pts_server_wait_table(void* h, const char* name) {
+  auto* s = static_cast<PSServer*>(h);
+  std::unique_lock<std::mutex> lk(s->mu);
+  s->cv.wait(lk, [&] { return s->stopped || s->table.count(name); });
+  return s->stopped ? 0 : 1;
+}
+
+void pts_server_stop(void* h) {
+  auto* s = static_cast<PSServer*>(h);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->stopped = true;
+    s->cv.notify_all();
+    // unblock conn threads parked in read()
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->conn_threads)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+// ---------------------------------------------------------------------- //
+// client                                                                 //
+// ---------------------------------------------------------------------- //
+
+void* pts_connect(const char* host, int port, double timeout_s) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return nullptr;
+  // retry until the server comes up (reference grpc_client retry semantics)
+  int tries = static_cast<int>(timeout_s / 0.05) + 1;
+  for (int i = 0; i < tries; ++i) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new PSClient();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    ::usleep(50000);
+  }
+  return nullptr;
+}
+
+// generic request; returns status (0 ok, 1 error, -1 io failure).  For
+// kGetParam the payload lands in *out (caller frees via ptq_free), length in
+// *olen.
+int pts_request(void* h, int cmd, const char* name, uint64_t round,
+                const char* data, int64_t dlen, char** out, int64_t* olen) {
+  auto* c = static_cast<PSClient*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t cmd8 = static_cast<uint8_t>(cmd);
+  uint16_t nlen = static_cast<uint16_t>(::strlen(name));
+  uint64_t dl = static_cast<uint64_t>(dlen < 0 ? 0 : dlen);
+  if (!write_n(c->fd, &cmd8, 1) || !write_n(c->fd, &nlen, 2) ||
+      !write_n(c->fd, name, nlen) || !write_n(c->fd, &round, 8) ||
+      !write_n(c->fd, &dl, 8) || (dl && !write_n(c->fd, data, dl)))
+    return -1;
+  uint8_t status;
+  uint64_t rlen;
+  if (!read_n(c->fd, &status, 1) || !read_n(c->fd, &rlen, 8)) return -1;
+  if (rlen > kMaxBlob) return -1;
+  std::string payload(rlen, '\0');
+  if (rlen && !read_n(c->fd, &payload[0], rlen)) return -1;
+  if (out) {
+    *out = dup_blob(payload);
+    if (olen) *olen = static_cast<int64_t>(rlen);
+  }
+  return status;
+}
+
+void pts_client_close(void* h) {
+  auto* c = static_cast<PSClient*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -180,3 +180,4 @@ def _c_scatter(ctx, x, attrs):
     idx = lax.axis_index(ax)
     chunk = jnp.shape(x)[0] // n
     return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=0)
+

@@ -1,0 +1,182 @@
+"""Parameter-server program ops: send / recv / barriers / listen_and_serv.
+
+Reference analogs: operators/distributed_ops/send_op.cc, recv_op.cc,
+send_barrier_op.cc, fetch_barrier_op.cc, listen_and_serv_op.cc (RunSyncLoop
+at :109).  These are HOST ops — they run outside the jitted XLA computation,
+after it, in program order (registry.OpInfo.host_run); the transport is the
+native TCP runtime in paddle_tpu/native/src/ps_runtime.cc (the gRPC
+SendRecvService equivalent).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from paddle_tpu.fluid.registry import register_op
+
+_never = None  # host ops have no jit lowering
+
+
+def _no_lower(ctx, *a, attrs):  # pragma: no cover
+    raise RuntimeError("host op cannot be traced into an XLA computation")
+
+
+# ---------------------------------------------------------------------------
+# trainer-side channels: one PSClient + round counter per endpoint
+# ---------------------------------------------------------------------------
+
+
+class _Channel:
+    def __init__(self, endpoint):
+        from paddle_tpu import native
+
+        host, port = endpoint.rsplit(":", 1)
+        self.client = native.PSClient(host=host, port=int(port))
+        self.round = 0  # completed sync rounds (== param version to want)
+
+
+_channels: dict = {}
+_channels_lock = threading.Lock()
+
+
+def get_channel(endpoint) -> _Channel:
+    with _channels_lock:
+        ch = _channels.get(endpoint)
+        if ch is None:
+            ch = _channels[endpoint] = _Channel(endpoint)
+        return ch
+
+
+def reset_channels():
+    """Drop all cached trainer→pserver connections (tests, re-transpile)."""
+    with _channels_lock:
+        for ch in _channels.values():
+            ch.client.close()
+        _channels.clear()
+
+
+def stop_pservers(endpoints):
+    """Ask every pserver to exit its serve loop (test teardown / trainer 0
+    shutdown; reference sends no explicit stop — pservers are killed)."""
+    for ep in endpoints:
+        try:
+            get_channel(ep).client.stop_server()
+        except IOError:
+            pass
+    reset_channels()
+
+
+# ---------------------------------------------------------------------------
+# host ops
+# ---------------------------------------------------------------------------
+
+
+def _send_run(scope, op, place):
+    ch = get_channel(op.attrs["endpoint"])
+    name = op.input("X")[0]
+    ch.client.send_grad(op.attrs.get("varname", name),
+                        np.asarray(scope.get(name)))
+
+
+def _send_barrier_run(scope, op, place):
+    for ep in op.attrs["endpoints"]:
+        ch = get_channel(ep)
+        ch.client.send_barrier()
+        ch.round += 1
+
+
+def _recv_run(scope, op, place):
+    ch = get_channel(op.attrs["endpoint"])
+    name = op.output("Out")[0]
+    var = op.block._find_var_recursive(name) if op.block is not None else None
+    arr = ch.client.get_param(op.attrs.get("varname", name),
+                              want_version=ch.round)
+    if var is not None and var.shape is not None:
+        arr = arr.reshape(var.shape)
+    scope.set(name, arr)
+
+
+def _fetch_barrier_run(scope, op, place):
+    for ep in op.attrs["endpoints"]:
+        get_channel(ep).client.fetch_barrier()
+
+
+def _ps_init_sync_run(scope, op, place):
+    """Parameter init sync: trainer 0 pushes its initialized params (and
+    optimizer state) to the pservers; every trainer then pulls params so all
+    replicas start identical.  Replaces the reference's convention of running
+    param initializers inside the pserver startup program."""
+    trainer_id = op.attrs["trainer_id"]
+    push_vars = op.attrs["push_vars"]  # [(name, endpoint)]
+    pull_vars = op.attrs["pull_vars"]  # [(name, endpoint)]
+    if trainer_id == 0:
+        for name, ep in push_vars:
+            get_channel(ep).client.send_param(name, np.asarray(scope.get(name)))
+    for name, ep in pull_vars:
+        var = op.block._find_var_recursive(name) if op.block is not None else None
+        arr = get_channel(ep).client.get_param(name, want_version=0)
+        if var is not None and var.shape is not None:
+            arr = arr.reshape(var.shape)
+        scope.set(name, arr)
+
+
+def _listen_and_serv_run(scope, op, place):
+    """Pserver main loop (listen_and_serv_op.cc:109 RunSyncLoop): blocks
+    until a trainer sends STOP.  Optimize blocks run through the normal
+    executor (jitted, cached after round one) on the local place."""
+    from paddle_tpu import native
+    from paddle_tpu.fluid.executor import Executor, Scope, scope_guard
+
+    ep = op.attrs["endpoint"]
+    port = int(ep.rsplit(":", 1)[1])
+    n_trainers = int(op.attrs["n_trainers"])
+    # [(param, grad, opt_program, state_names)]
+    blocks = op.attrs["param_blocks"]
+
+    server = native.PSServer(port=port, n_trainers=n_trainers)
+    local = Scope()
+    exe = Executor(place)
+    try:
+        with scope_guard(local):
+            # init: trainer 0 pushes params + optimizer state
+            for param, grad, prog, state in blocks:
+                for name in state:
+                    if not server.wait_table(name):
+                        return
+                    var = prog.global_block()._find_var_recursive(name)
+                    local.set(name, server.table_get(
+                        name, shape=var.shape if var is not None else None))
+            while server.wait_round():
+                received = {}
+                for name, arr in server.grads():
+                    received.setdefault(name, []).append(arr)
+                for param, grad, prog, state in blocks:
+                    gs = received.get(grad)
+                    if not gs:
+                        continue
+                    gvar = prog.global_block()._find_var_recursive(grad)
+                    g = np.mean(gs, axis=0, dtype=np.float32)
+                    if gvar is not None and gvar.shape is not None:
+                        g = g.reshape(gvar.shape)
+                    exe.run(prog, feed={grad: g}, fetch_list=[])
+                    server.publish(param, np.asarray(local.get(param)))
+                server.bump_version()
+                server.release_send()
+                if not server.end_round():
+                    break
+    finally:
+        server.stop()
+
+
+register_op("send", ["X*"], [], _no_lower, grad=None, host_run=_send_run)
+register_op("recv", [], ["Out*"], _no_lower, grad=None, host_run=_recv_run)
+register_op("send_barrier", [], [], _no_lower, grad=None,
+            host_run=_send_barrier_run)
+register_op("fetch_barrier", [], [], _no_lower, grad=None,
+            host_run=_fetch_barrier_run)
+register_op("ps_init_sync", [], [], _no_lower, grad=None,
+            host_run=_ps_init_sync_run)
+register_op("listen_and_serv", [], [], _no_lower, grad=None,
+            host_run=_listen_and_serv_run)

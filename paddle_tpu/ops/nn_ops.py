@@ -367,3 +367,31 @@ def _temporal_shift(ctx, x, attrs):
     right = jnp.pad(r[:, :-1, fold:2 * fold], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
     rest = r[:, :, 2 * fold:]
     return jnp.reshape(jnp.concatenate([left, right, rest], axis=2), (nt, c, h, w))
+
+
+@simple_op("flash_attention", ["Q", "K", "V", "Bias"], ["Out"],
+           optional=("Bias",), no_grad_inputs=("Bias",))
+def _flash_attention(ctx, q, k, v, bias, attrs):
+    """Blockwise attention without materializing S×S scores — Pallas kernel
+    on TPU, XLA reference elsewhere (paddle_tpu/kernels/flash_attention.py).
+    The reference framework has no attention op at all (SURVEY.md §5).
+
+    attrs["sequence_parallel"]: when tracing under an active mesh with an
+    'sp' axis, lower to ring attention — K/V chunks rotate over the sequence
+    axis via ppermute (kernels/ring_attention.py) instead of being gathered.
+    """
+    from paddle_tpu.kernels import flash_attention as _fa
+    from paddle_tpu.parallel import mesh as pmesh
+
+    causal = attrs.get("causal", False)
+    sm_scale = attrs.get("sm_scale")
+    if attrs.get("sequence_parallel"):
+        mesh = pmesh.current_mesh()
+        if mesh is not None and pmesh.SEQ_AXIS in mesh.axis_names \
+                and mesh.shape[pmesh.SEQ_AXIS] > 1:
+            from paddle_tpu.kernels import ring_attention as _ra
+
+            return _ra(q, k, v, bias=bias, causal=causal, sm_scale=sm_scale,
+                       mesh=mesh)
+    return _fa(q, k, v, bias=bias, causal=causal, sm_scale=sm_scale,
+               force=attrs.get("force"))

@@ -6,3 +6,9 @@ from . import hybrid  # noqa: F401
 from .hybrid import (  # noqa: F401
     HybridParallelRunner, ShardingRule, megatron_rules, build_hybrid_mesh,
 )
+from . import data_parallel  # noqa: F401
+from .data_parallel import DataParallelRunner, transpile_data_parallel  # noqa: F401
+from . import local_sgd  # noqa: F401
+from .local_sgd import LocalSGDRunner  # noqa: F401
+from . import pipeline  # noqa: F401
+from .pipeline import PipelineRunner  # noqa: F401

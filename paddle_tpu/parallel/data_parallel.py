@@ -135,6 +135,7 @@ class _ShardedBlock:
 
         plan = BlockPlan(program, program.global_block(), feed_names,
                          fetch_names, scope)
+        self.plan = plan
         self.feed_names = plan.feed_names
         self.fetch_names = plan.fetch_names
         self.ops = plan.ops
@@ -160,7 +161,7 @@ class _ShardedBlock:
             {n: P(axis) for n in self.feed_names},
             P(),
         )
-        out_specs = ([P(axis) for _ in self.fetch_names],
+        out_specs = ([P(axis) for _ in plan.jit_fetch_names],
                      {n: P() for n in self.write_names})
         sharded = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                                 out_specs=out_specs, check_vma=False)
@@ -184,4 +185,6 @@ class _ShardedBlock:
             for n, v in out_writes.items():
                 scope.set(n, v)
             timer.done(fetches, out_writes)
-        return fetches
+        # PS-mode programs carry host RPC ops — run them, don't drop them
+        self.plan.run_host_ops(scope)
+        return self.plan.assemble_fetches(fetches, scope)

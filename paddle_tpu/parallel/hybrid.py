@@ -179,7 +179,13 @@ class HybridParallelRunner:
         program, mesh = self.program, self.mesh
         plan = BlockPlan(program, program.global_block(), feed_names,
                          fetch_names, scope)
-        body = plan.make_body()
+        inner_body = plan.make_body()
+
+        def body(*args):
+            # ops that adapt their lowering to the mesh (ring attention on
+            # the sp axis) read current_mesh() at trace time
+            with pmesh.mesh_guard(mesh):
+                return inner_body(*args)
         donated, readonly = plan.donated_names, plan.readonly_names
         writes = plan.write_names
 
@@ -219,6 +225,7 @@ class HybridParallelRunner:
                 for n, v in out_writes.items():
                     scope_.set(n, v)
                 timer.done(fetches, out_writes)
-            return fetches
+            plan.run_host_ops(scope_)
+            return plan.assemble_fetches(fetches, scope_)
 
         return compiled

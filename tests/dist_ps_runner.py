@@ -1,0 +1,109 @@
+"""Subprocess roles for parameter-server tests (reference
+test_dist_base.py pattern: real processes on 127.0.0.1 endpoints).
+
+  python dist_ps_runner.py pserver   <ep> <endpoints> <n_trainers> <opt>
+  python dist_ps_runner.py trainer   <tid> <endpoints> <n_trainers> <opt> <out.json>
+
+The model is fit_a_line (fc regression) on deterministic synthetic data;
+trainer t feeds rows [t*8:(t+1)*8) of each 16-row global batch.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# sitecustomize (axon TPU plugin) may have pre-imported jax with the TPU
+# platform pinned — override through the config API (same as conftest.py)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid.executor import Scope, scope_guard  # noqa: E402
+
+N_STEPS = 12
+GLOBAL_BATCH = 16
+
+
+def build(opt_name):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt = {"sgd": lambda: fluid.optimizer.SGD(learning_rate=0.05),
+               "adam": lambda: fluid.optimizer.Adam(learning_rate=0.05),
+               "momentum": lambda: fluid.optimizer.Momentum(
+                   learning_rate=0.05, momentum=0.9)}[opt_name]()
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def global_batches():
+    rng = np.random.RandomState(0)
+    W = rng.uniform(-1, 1, (13, 1)).astype("float32")
+    out = []
+    for _ in range(N_STEPS):
+        xb = rng.uniform(-1, 1, (GLOBAL_BATCH, 13)).astype("float32")
+        out.append({"x": xb, "y": xb @ W})
+    return out
+
+
+def run_local(opt_name, out_path):
+    main, startup, loss = build(opt_name)
+    losses = []
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for b in global_batches():
+            (lv,) = exe.run(main, feed=b, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv)))
+    json.dump({"losses": losses}, open(out_path, "w"))
+
+
+def run_pserver(ep, endpoints, n_trainers, opt_name):
+    main, startup, loss = build(opt_name)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=endpoints,
+                trainers=n_trainers, startup_program=startup)
+    with scope_guard(Scope()):
+        fluid.Executor(fluid.CPUPlace()).run(t.get_pserver_program(ep))
+
+
+def run_trainer(tid, endpoints, n_trainers, opt_name, out_path):
+    main, startup, loss = build(opt_name)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=tid, program=main, pservers=endpoints,
+                trainers=n_trainers, startup_program=startup)
+    trainer_prog = t.get_trainer_program()
+    per = GLOBAL_BATCH // n_trainers
+    losses = []
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for b in global_batches():
+            sub = {k: v[tid * per:(tid + 1) * per] for k, v in b.items()}
+            (lv,) = exe.run(trainer_prog, feed=sub, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv)))
+    json.dump({"losses": losses}, open(out_path, "w"))
+    # pservers are stopped by the parent test once every trainer exited
+    # (a trainer must not stop them while peers are mid-round)
+
+
+if __name__ == "__main__":
+    role = sys.argv[1]
+    if role == "local":
+        run_local(sys.argv[2], sys.argv[3])
+    elif role == "pserver":
+        run_pserver(sys.argv[2], sys.argv[3], int(sys.argv[4]), sys.argv[5])
+    elif role == "trainer":
+        run_trainer(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
+                    sys.argv[5], sys.argv[6])
+    else:
+        raise SystemExit(f"unknown role {role}")

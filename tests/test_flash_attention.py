@@ -1,0 +1,146 @@
+"""Flash-attention kernel tests: Pallas (interpret mode on CPU) vs the
+materializing XLA reference — forward, gradients, bias, causal, padding."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels import attention_reference, flash_attention
+
+
+def make_qkv(b, h, s, d, seed=0, dtype="float32"):
+    rng = np.random.RandomState(seed)
+    shape = (b, h, s, d)
+    return tuple(jnp.asarray(rng.uniform(-1, 1, shape).astype(dtype))
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("s", [128, 256])
+def test_forward_matches_reference(s):
+    q, k, v = make_qkv(2, 3, s, 32, seed=s)
+    out_p = flash_attention(q, k, v, force="pallas")
+    out_r = flash_attention(q, k, v, force="reference")
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_r),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_forward_with_bias_and_padding():
+    # S=100: pallas path pads to 128 with -inf key bias
+    s = 100
+    q, k, v = make_qkv(2, 2, s, 16, seed=7)
+    bias = jnp.where(
+        jnp.arange(s)[None, :] < 80, 0.0, -1e4
+    ) * jnp.ones((2, 1))  # [B, S] padding mask
+    bias4 = bias.reshape(2, 1, 1, s)
+    out_p = flash_attention(q, k, v, bias=bias4, force="pallas")
+    out_r = flash_attention(q, k, v, bias=bias4, force="reference")
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_r),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_causal():
+    q, k, v = make_qkv(1, 2, 128, 16, seed=3)
+    out_p = flash_attention(q, k, v, causal=True, force="pallas")
+    out_r = flash_attention(q, k, v, causal=True, force="reference")
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_r),
+                               rtol=2e-5, atol=2e-5)
+    # causality: out[t] must not depend on k/v after t
+    q2 = q.at[:, :, :64].get()
+    out_half = flash_attention(q2, k[:, :, :64], v[:, :, :64], causal=True,
+                               force="reference")
+    np.testing.assert_allclose(np.asarray(out_r[:, :, :33]),
+                               np.asarray(out_half[:, :, :33]),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_reference(causal):
+    q, k, v = make_qkv(2, 2, 128, 16, seed=11)
+    w = jnp.asarray(np.random.RandomState(1).uniform(0.5, 1.5,
+                                                     q.shape).astype("float32"))
+
+    def loss_p(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       force="pallas") * w)
+
+    def loss_r(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       force="reference") * w)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_bias_gradient_matches_reference():
+    """A learned additive key bias must get real (nonzero) grads on the
+    pallas path, matching the reference's autodiff grads."""
+    b, h, s, d = 2, 2, 128, 16
+    q, k, v = make_qkv(b, h, s, d, seed=13)
+    bias = jnp.asarray(
+        np.random.RandomState(2).uniform(-0.5, 0.5, (b, 1, 1, s)).astype(
+            "float32"))
+
+    def loss(bias, mode):
+        return jnp.sum(flash_attention(q, k, v, bias=bias, force=mode) ** 2)
+
+    gp = jax.grad(loss)(bias, "pallas")
+    gr = jax.grad(loss)(bias, "reference")
+    assert float(jnp.max(jnp.abs(gr))) > 1e-6  # grad is genuinely nonzero
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                               rtol=5e-4, atol=1e-5)
+
+
+def test_flash_attention_op_in_program():
+    """The registered op + layer path: BERT-style program with flash
+    attention trains end to end."""
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny(attn_dropout=0.0, use_flash_attention=True)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, loss, mlm_loss, nsp_acc = bert.build_bert_pretrain(cfg, is_test=False)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    assert any(op.type == "flash_attention"
+               for op in main.global_block().ops)
+    batch = bert.make_fake_batch(cfg, batch=4, seq_len=32, seed=0)
+    s = Scope()
+    with scope_guard(s):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        l0 = None
+        for i in range(8):
+            (lv,) = exe.run(main, feed=batch, fetch_list=[loss.name])
+            l0 = l0 if l0 is not None else float(np.asarray(lv))
+        assert float(np.asarray(lv)) < l0, "loss did not decrease"
+
+
+def test_bert_flash_vs_composed_numerics():
+    """Same weights: flash path output == composed matmul/softmax path."""
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+    from paddle_tpu.models import bert
+
+    outs = {}
+    for use_flash in (True, False):
+        cfg = bert.BertConfig.tiny(attn_dropout=0.0, hidden_dropout=0.0,
+                                   use_flash_attention=use_flash)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            feeds, loss, mlm_loss, nsp_acc = bert.build_bert_pretrain(
+                cfg, is_test=True)
+        batch = bert.make_fake_batch(cfg, batch=4, seq_len=32, seed=5)
+        s = Scope()
+        with scope_guard(s):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (lv,) = exe.run(main, feed=batch, fetch_list=[loss.name])
+        outs[use_flash] = float(np.asarray(lv))
+    assert abs(outs[True] - outs[False]) < 1e-4, outs

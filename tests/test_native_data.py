@@ -225,3 +225,66 @@ def test_inmemory_shuffles_instances(tmp_path):
     # instance-level shuffle: batch composition changes, not just batch order
     assert sorted(sum(after, [])) == sorted(sum(before, []))
     assert set(map(tuple, after)) != set(map(tuple, before))
+
+
+def test_header_length_corruption_detected(tmp_path):
+    """Corrupt comp_len in the chunk header must yield IOError, not OOM."""
+    path = str(tmp_path / "h.recordio")
+    with native.RecordIOWriter(path) as w:
+        w.write(b"payload" * 50)
+    data = bytearray(open(path, "rb").read())
+    # header layout: magic(4) nrec(4) raw_len(8) comp_len(8) crc(4) flags(1)
+    data[16:24] = (2**60).to_bytes(8, "little")
+    open(path, "wb").write(bytes(data))
+    with native.RecordIOScanner(path) as s:
+        with pytest.raises(IOError):
+            next(s)
+
+
+def test_slot_count_mismatch_rejected(tmp_path):
+    p = str(tmp_path / "extra.txt")
+    with open(p, "w") as f:
+        f.write("2 0.1 0.2 1 7 1 3\n")  # 3 slots in file, 2 configured
+    feed = native.MultiSlotFeed([p], [("x", "f"), ("ids", "u")], batch_size=1)
+    with pytest.raises(IOError, match="parse error"):
+        list(feed)
+    feed.close()
+
+
+def test_writer_del_flushes(tmp_path):
+    path = str(tmp_path / "d.recordio")
+    w = native.RecordIOWriter(path)
+    w.write(b"small record")
+    del w  # no explicit close
+    import gc
+    gc.collect()
+    with native.RecordIOScanner(path) as s:
+        assert list(s) == [b"small record"]
+
+
+def test_queue_free_with_blocked_consumer():
+    """Freeing the queue while a thread is blocked in pop must wake it and
+    not crash (free closes, then waits for waiters to leave before delete)."""
+    q = native.BlockingQueue(capacity=2)
+    got = []
+
+    def consumer():
+        try:
+            got.append(q.pop())  # blocks forever until close
+        except EOFError:
+            got.append("closed")
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    import time
+    deadline = time.monotonic() + 5
+    while q.waiters() == 0:  # wait until the consumer is blocked inside C++
+        assert time.monotonic() < deadline, "consumer never blocked"
+        time.sleep(0.005)
+    # steal the handle and free directly — the consumer's closure keeps the
+    # Python wrapper alive, so __del__ can't be the trigger here
+    h, q._h = q._h, None
+    native.lib().ptq_queue_free(h)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got == ["closed"]

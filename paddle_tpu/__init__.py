@@ -17,6 +17,7 @@ __version__ = "0.1.0"
 from . import fluid  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
+from . import inference  # noqa: F401
 from .reader import batch  # noqa: F401
 
 # paddle.* top-level conveniences (subset; the reference re-exports fluid too)

@@ -39,6 +39,7 @@ from . import dataset  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
 from . import profiler  # noqa: F401
 from . import contrib  # noqa: F401
+from . import incubate  # noqa: F401
 from . import transpiler  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import warnings
 
 import numpy as np
@@ -88,21 +89,27 @@ class Scope:
         return self._vars.keys()
 
 
-_global_scope = Scope()
+_default_scope = Scope()
+_scope_tls = threading.local()
 
 
 def global_scope() -> Scope:
-    return _global_scope
+    """The ambient scope: thread-local override (scope_guard) falling back
+    to one process-wide default.  Thread-local matters: a pserver thread's
+    listen loop guards its own scope and must not hijack the trainer
+    thread's (the reference's C++ scopes are per-executor objects, so it
+    never had this hazard)."""
+    return getattr(_scope_tls, "scope", None) or _default_scope
 
 
 @contextlib.contextmanager
 def scope_guard(scope):
-    global _global_scope
-    old, _global_scope = _global_scope, scope
+    old = getattr(_scope_tls, "scope", None)
+    _scope_tls.scope = scope
     try:
         yield
     finally:
-        _global_scope = old
+        _scope_tls.scope = old
 
 
 def as_numpy(x):

@@ -1,0 +1,2 @@
+"""Unified distributed-training API (reference incubate/fleet/)."""
+from . import base  # noqa: F401

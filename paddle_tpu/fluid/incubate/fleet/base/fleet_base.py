@@ -1,0 +1,106 @@
+"""Fleet base classes (reference fleet/base/fleet_base.py:37,236)."""
+
+from __future__ import annotations
+
+import abc
+
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+__all__ = ["Mode", "Fleet", "DistributedOptimizer"]
+
+
+class Mode:
+    COLLECTIVE = 1
+    PS = 2
+
+
+class Fleet(metaclass=abc.ABCMeta):
+    def __init__(self, mode):
+        self._mode = mode
+        self._role_maker: RoleMakerBase | None = None
+        self._executor = None
+
+    # -- role plumbing ---------------------------------------------------
+    def init(self, role_maker=None):
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker(
+                is_collective=(self._mode == Mode.COLLECTIVE))
+        if not role_maker._generated:
+            role_maker.generate_role()
+        self._role_maker = role_maker
+        return self
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def server_num(self):
+        return self._role_maker.server_num()
+
+    def server_index(self):
+        return self._role_maker.server_index()
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def split_files(self, files):
+        """Deal each worker its shard of the file list (reference :148)."""
+        n, i = self.worker_num(), self.worker_index()
+        return [f for k, f in enumerate(sorted(files)) if k % n == i]
+
+    # -- lifecycle hooks subclasses implement ---------------------------
+    @abc.abstractmethod
+    def init_worker(self):
+        ...
+
+    @abc.abstractmethod
+    def init_server(self, model_dir=None):
+        ...
+
+    @abc.abstractmethod
+    def run_server(self):
+        ...
+
+    @abc.abstractmethod
+    def stop_worker(self):
+        ...
+
+    @abc.abstractmethod
+    def distributed_optimizer(self, optimizer, strategy=None):
+        ...
+
+
+class DistributedOptimizer(metaclass=abc.ABCMeta):
+    def __init__(self, optimizer, strategy=None):
+        self._optimizer = optimizer
+        self._strategy = strategy
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    @abc.abstractmethod
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ...

@@ -1,0 +1,129 @@
+"""Role discovery for distributed jobs (reference fleet/base/role_maker.py).
+
+The reference discovers roles from MPI (MPISymetricRoleMaker) or cluster env
+vars (PaddleCloudRoleMaker:328).  This build keeps the env-var scheme — it is
+launcher-agnostic and matches how TPU pods export JAX process env — and the
+user-defined makers for tests/single-host multi-process.  No MPI: on TPU the
+coordination service (jax.distributed) plays that role, and PS-mode processes
+coordinate over the native TCP transport.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "RoleMakerBase", "UserDefinedRoleMaker",
+           "UserDefinedCollectiveRoleMaker", "PaddleCloudRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._generated = False
+
+    def generate_role(self):
+        self._generated = True
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return max(1, len(self._worker_endpoints))
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+    def get_current_endpoint(self):
+        eps = (self._server_endpoints if self.is_server()
+               else self._worker_endpoints)
+        return eps[self._current_id] if self._current_id < len(eps) else ""
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """Explicit PS-mode layout (reference :424)."""
+
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None):
+        super().__init__()
+        self._current_id = int(current_id)
+        self._role = role
+        self._worker_num = int(worker_num)
+        self._server_endpoints = list(server_endpoints or [])
+
+    def worker_num(self):
+        return self._worker_num
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    """Explicit collective-mode layout (reference :483)."""
+
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        self._current_id = int(current_id)
+        self._role = Role.WORKER
+        self._worker_endpoints = list(worker_endpoints or ["127.0.0.1:0"])
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-var role discovery (reference :328).  Collective mode reads
+    PADDLE_TRAINER_ENDPOINTS/PADDLE_CURRENT_ENDPOINT; PS mode reads
+    TRAINING_ROLE + PADDLE_PSERVERS/PADDLE_PORT/PADDLE_TRAINERS_NUM."""
+
+    def __init__(self, is_collective=False):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        if self._is_collective:
+            self._role = Role.WORKER
+            self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+            eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+            self._worker_endpoints = [e for e in eps.split(",") if e]
+        else:
+            role = os.getenv("TRAINING_ROLE",
+                             os.getenv("PADDLE_TRAINING_ROLE", "TRAINER"))
+            port = os.getenv("PADDLE_PORT", "6174")
+            ips = os.getenv("PADDLE_PSERVERS", "127.0.0.1")
+            self._server_endpoints = [f"{ip}:{port}"
+                                      for ip in ips.split(",") if ip]
+            self._worker_num_env = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+            if role.upper() in ("PSERVER", "SERVER"):
+                self._role = Role.SERVER
+                cur = os.getenv("POD_IP", "127.0.0.1") + ":" + port
+                self._current_id = (self._server_endpoints.index(cur)
+                                    if cur in self._server_endpoints else 0)
+            else:
+                self._role = Role.WORKER
+                self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._generated = True
+
+    def worker_num(self):
+        if self._is_collective:
+            return max(1, len(self._worker_endpoints))
+        return getattr(self, "_worker_num_env", 1)

@@ -1,0 +1,119 @@
+"""Collective fleet: data-parallel training over a device mesh.
+
+Reference: fleet/collective/__init__.py — `Collective` fleet (:80) +
+`CollectiveOptimizer` rewriting the program with c_allreduce ops via the
+collective transpiler, with FP16/LocalSGD optimizer variants (:152+).
+
+TPU-native: minimize() performs the same graph rewrite
+(transpile_data_parallel → c_allreduce_sum per grad, lowered to lax.psum
+over the dp mesh axis); execution goes through CompiledProgram /
+DataParallelRunner which shard the batch over all local devices.  Multi-host
+scale-out uses the same program with a multi-host mesh (jax.distributed) —
+no NCCL bootstrap ops to insert.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.fluid as fluid
+
+from ..base.fleet_base import DistributedOptimizer, Fleet, Mode
+
+__all__ = ["Collective", "CollectiveOptimizer", "DistributedStrategy",
+           "fleet"]
+
+
+class DistributedStrategy:
+    """Reference :25.  NCCL/hierarchical-allreduce knobs are accepted for
+    API parity; XLA's all-reduce combiner subsumes them.  `use_local_sgd`
+    switches minimize() to the LocalSGD transpiler."""
+
+    def __init__(self):
+        self.use_local_sgd = False
+        self.local_sgd_k_steps = 1
+        self.use_amp = False
+        self.amp_loss_scale = 2 ** 15
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+
+
+class Collective(Fleet):
+    def __init__(self):
+        super().__init__(Mode.COLLECTIVE)
+        self._main_program = None
+
+    def init_worker(self):
+        pass
+
+    def run_worker(self, main_programs=None, scopes=None):
+        pass
+
+    def init_server(self, model_dir=None):
+        raise NotImplementedError(
+            "collective mode has no servers; use PS-mode fleet")
+
+    def run_server(self):
+        raise NotImplementedError(
+            "collective mode has no servers; use PS-mode fleet")
+
+    def stop_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = CollectiveOptimizer(optimizer, strategy, fleet=self)
+        return self._optimizer
+
+    @property
+    def main_program(self):
+        return self._main_program
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        return fluid.io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program or self._main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        return fluid.io.save_persistables(
+            executor, dirname, main_program or self._main_program)
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    """minimize() = wrapped optimizer + collective-mode graph rewrite."""
+
+    def __init__(self, optimizer, strategy=None, fleet=None):
+        super().__init__(optimizer, strategy or DistributedStrategy())
+        self._fleet = fleet
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self._strategy.use_amp:
+            from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+            self._optimizer = mp.decorate(
+                self._optimizer,
+                init_loss_scaling=self._strategy.amp_loss_scale)
+        ops, pg = self._optimizer.minimize(loss, startup_program,
+                                           parameter_list, no_grad_set)
+        program = loss.block.program
+        if self._strategy.use_local_sgd:
+            from paddle_tpu.fluid.transpiler.collective import LocalSGD
+
+            LocalSGD(k_steps=self._strategy.local_sgd_k_steps).transpile(
+                startup_program=startup_program, main_program=program)
+        else:
+            from paddle_tpu.fluid.transpiler.collective import GradAllReduce
+
+            import jax
+
+            GradAllReduce(loss_name=loss.name,
+                          num_devices=jax.device_count()).transpile(
+                startup_program=startup_program, main_program=program)
+        if self._fleet is not None:
+            self._fleet._main_program = program
+        return ops, pg
+
+
+fleet = Collective()

@@ -1,0 +1,101 @@
+"""Parameter-server fleet (reference fleet/parameter_server/ — the
+distribute_transpiler wrapper; the pslib downpour variant is out of scope
+because pslib is a closed-source dependency, SURVEY.md §2.1).
+
+Wraps fluid.DistributeTranspiler over the native TCP PS transport: workers
+get the send/recv-rewritten trainer program, servers run listen_and_serv.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.fluid as fluid
+
+from ..base.fleet_base import DistributedOptimizer, Fleet, Mode
+
+__all__ = ["ParameterServerFleet", "TranspilerOptimizer", "fleet"]
+
+
+class ParameterServerFleet(Fleet):
+    def __init__(self):
+        super().__init__(Mode.PS)
+        self._transpiler = None
+        self._origin_program = None
+        self._startup_program = None
+        self.main_program = None
+
+    # -- worker ----------------------------------------------------------
+    def init_worker(self, executor=None):
+        """Run the (init-sync-rewritten) startup program on this worker."""
+        exe = executor or fluid.Executor()
+        exe.run(self._startup_program or fluid.default_startup_program())
+
+    def stop_worker(self):
+        from paddle_tpu.fluid.transpiler import reset_channels
+
+        reset_channels()
+
+    def stop_servers(self):
+        """First worker asks every pserver to exit (test teardown)."""
+        from paddle_tpu.fluid.transpiler import stop_pservers
+
+        stop_pservers(self.server_endpoints())
+
+    # -- server ----------------------------------------------------------
+    def init_server(self, model_dir=None):
+        ep = self._role_maker.get_pserver_endpoints()[self.server_index()]
+        self._pserver_prog = self._transpiler.get_pserver_program(ep)
+
+    def run_server(self, executor=None):
+        """Blocks in the listen_and_serv loop until a worker sends STOP."""
+        exe = executor or fluid.Executor()
+        exe.run(self._pserver_prog)
+
+    # -- optimizer -------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return TranspilerOptimizer(optimizer, strategy, fleet=self)
+
+    def _transpile(self, loss, startup_program):
+        config = fluid.DistributeTranspilerConfig()
+        t = fluid.DistributeTranspiler(config=config)
+        program = loss.block.program
+        t.transpile(
+            trainer_id=self.worker_index(),
+            program=program,
+            pservers=",".join(self._role_maker.get_pserver_endpoints()),
+            trainers=self.worker_num(),
+            startup_program=startup_program
+            or fluid.default_startup_program())
+        self._transpiler = t
+        self._origin_program = program
+        self._startup_program = (startup_program
+                                 or fluid.default_startup_program())
+        if self.is_worker():
+            self.main_program = t.get_trainer_program()
+        return t
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        return fluid.io.save_persistables(
+            executor, dirname, main_program or self._origin_program)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        return fluid.io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program or self._origin_program)
+
+
+class TranspilerOptimizer(DistributedOptimizer):
+    def __init__(self, optimizer, strategy=None, fleet=None):
+        super().__init__(optimizer, strategy)
+        self._fleet = fleet
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, pg = self._optimizer.minimize(loss, startup_program,
+                                           parameter_list, no_grad_set)
+        self._fleet._transpile(loss, startup_program)
+        return ops, pg
+
+
+fleet = ParameterServerFleet()

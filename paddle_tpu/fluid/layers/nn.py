@@ -14,7 +14,7 @@ from ..initializer import Constant, Normal, Xavier
 from ..param_attr import ParamAttr
 
 __all__ = [
-    "fc", "embedding", "flash_attention",
+    "fc", "embedding", "flash_attention", "moe_ffn",
     "conv2d", "conv3d", "conv2d_transpose", "pool2d",
     "batch_norm", "layer_norm", "group_norm", "instance_norm", "dropout",
     "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
@@ -1126,4 +1126,39 @@ def flash_attention(q, k, v, attn_bias=None, causal=False, sm_scale=None,
         attrs["sm_scale"] = float(sm_scale)
     helper.append_op("flash_attention", inputs=inputs, outputs={"Out": [out]},
                      attrs=attrs)
+    return out
+
+
+def moe_ffn(x, num_experts, d_ff, top_k=2, act="gelu", param_attr=None,
+            name=None):
+    """Mixture-of-experts feed-forward over [B, S, D] (ops/nn_ops.py
+    moe_ffn — dense dispatch, expert dim shardable over the 'ep' mesh
+    axis).  No reference analog; expert-parallel building block."""
+    helper = LayerHelper("moe_ffn", name=name)
+    d = x.shape[-1]
+    pname = name or helper.name
+    init = (param_attr.initializer
+            if param_attr is not None and param_attr.initializer else
+            Normal(0.0, 0.02))
+    gate = helper.create_parameter(
+        ParamAttr(name=pname + "_moe_gate.w_0", initializer=init),
+        shape=[d, num_experts])
+    w1 = helper.create_parameter(
+        ParamAttr(name=pname + "_moe_w1.w_0", initializer=init),
+        shape=[num_experts, d, d_ff])
+    b1 = helper.create_parameter(
+        ParamAttr(name=pname + "_moe_w1.b_0", initializer=Constant(0.0)),
+        shape=[num_experts, d_ff], is_bias=True)
+    w2 = helper.create_parameter(
+        ParamAttr(name=pname + "_moe_w2.w_0", initializer=init),
+        shape=[num_experts, d_ff, d])
+    b2 = helper.create_parameter(
+        ParamAttr(name=pname + "_moe_w2.b_0", initializer=Constant(0.0)),
+        shape=[num_experts, d], is_bias=True)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("moe_ffn",
+                     inputs={"X": [x], "GateW": [gate], "W1": [w1],
+                             "B1": [b1], "W2": [w2], "B2": [b2]},
+                     outputs={"Out": [out]},
+                     attrs={"top_k": int(top_k), "act": act})
     return out

@@ -265,6 +265,69 @@ class MomentumOptimizer(Optimizer):
             {"mu": self._momentum, "use_nesterov": self._use_nesterov})
 
 
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Momentum with Deep Gradient Compression (reference optimizer.py:787
+    DGCMomentumOptimizer + dgc_op.cc + SparseAllReduceOpHandle).
+
+    Each step a `dgc` op folds the gradient into local momentum/residual
+    accumulators and emits only the top-|velocity| entries (masked dense —
+    see ops/optimizer_ops.py _dgc); the parameter update consumes the
+    encoded gradient.  Under the data-parallel transpiler the allreduce is
+    moved onto the ENCODED gradient (program._dgc_encoded), matching the
+    reference's sparse allreduce placement."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 num_trainers=None, **kw):
+        if use_nesterov:
+            raise NotImplementedError(
+                "DGCMomentum: Nesterov momentum is not implemented in the "
+                "dgc op — use use_nesterov=False")
+        super().__init__(learning_rate, momentum, use_nesterov=False, **kw)
+        self._rampup_begin_step = int(rampup_begin_step)
+        self._rampup_step = int(rampup_step)
+        self._sparsity = list(sparsity)
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)   # dgc U
+            self._add_accumulator("dgc_v", p)      # dgc V (residual)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        u = self._get_accumulator("velocity", p)
+        v = self._get_accumulator("dgc_v", p)
+        helper = LayerHelper("dgc")
+        enc = helper.create_variable_for_type_inference("float32")
+        block.append_op(
+            "dgc",
+            inputs={"U": [u], "V": [v], "Grad": [g]},
+            outputs={"UOut": [u], "VOut": [v], "EncodeGrad": [enc]},
+            attrs={"m": self._momentum,
+                   "rampup_begin_step": self._rampup_begin_step,
+                   "rampup_step": self._rampup_step,
+                   "sparsity": self._sparsity,
+                   "op_role": "optimize"})
+        program = block.program
+        if not hasattr(program, "_dgc_encoded"):
+            program._dgc_encoded = {}
+        gname = g.name if hasattr(g, "name") else g
+        program._dgc_encoded[gname] = enc.name
+        # regularization/clip rename the grad (w@GRAD → w@GRAD_reg_0) but
+        # the DP transpiler looks up RAW names from _params_grads — key the
+        # raw name too so the allreduce still lands on the encoded grad
+        raw = dict(getattr(program, "_params_grads", [])).get(
+            p.name if hasattr(p, "name") else p)
+        if raw and raw != gname:
+            program._dgc_encoded[raw] = enc.name
+        # velocity already folded into enc — the apply is plain SGD on it
+        return block.append_op(
+            "sgd",
+            inputs={"Param": [p], "Grad": [enc],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p]})
+
+
 class LarsMomentumOptimizer(Optimizer):
     type = "lars_momentum"
 
@@ -605,6 +668,7 @@ RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
+DGCMomentum = DGCMomentumOptimizer
 
 
 class PipelineOptimizer:

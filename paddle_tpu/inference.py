@@ -1,0 +1,208 @@
+"""Inference engine: AnalysisPredictor-style serving API.
+
+Reference: paddle/fluid/inference/ — `AnalysisPredictor`
+(api/analysis_predictor.h:46) loads a saved ProgramDesc + params, runs the
+Analyzer fusion-pass pipeline, then serves through a NaiveExecutor with
+ZeroCopyTensor inputs/outputs (:68); TensorRT/Anakin/nGraph subgraphs offload
+pieces of the graph (analysis/ir_pass_manager.cc).
+
+TPU-native redesign: the "engine subgraph offload" side-path of the
+reference IS this framework's main path — the whole pruned inference program
+compiles to one XLA executable, cached per input-shape signature, with
+parameters resident on device across calls (the ZeroCopyRun property: no
+per-call weight transfer; only inputs/outputs cross the host boundary).
+Fusion passes are XLA's job.  `config.switch_ir_optim` etc. are accepted for
+API parity but have no separate pass pipeline to toggle.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["AnalysisConfig", "AnalysisPredictor", "PaddleTensor",
+           "PaddleDType", "create_paddle_predictor", "ZeroCopyTensor"]
+
+
+class PaddleDType:
+    FLOAT32 = "float32"
+    INT64 = "int64"
+    INT32 = "int32"
+
+
+class PaddleTensor:
+    """Input/output container for the non-zero-copy `run` API
+    (reference api/paddle_api.h PaddleTensor)."""
+
+    def __init__(self, data=None, name="", lod=None):
+        arr = np.asarray(data) if data is not None else None
+        self.name = name
+        self.data = arr
+        self.dtype = str(arr.dtype) if arr is not None else None
+        self.shape = list(arr.shape) if arr is not None else []
+        self.lod = lod or []
+
+    def as_ndarray(self):
+        return self.data
+
+
+class AnalysisConfig:
+    """Reference api/paddle_analysis_config.h.  Device toggles map to
+    Places; pass/engine switches are parity no-ops (XLA compiles and fuses
+    the whole graph unconditionally)."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        self._model_dir = model_dir
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._use_tpu = True
+        self._ir_optim = True
+        self._enable_memory_optim = False
+
+    def set_model(self, model_dir, params_file=None):
+        if params_file is None:
+            self._model_dir = model_dir
+        else:
+            self._prog_file = model_dir
+            self._params_file = params_file
+
+    def model_dir(self):
+        return self._model_dir
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # GPU knob accepted for source compatibility; device is the TPU
+        self._use_tpu = True
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = bool(x)
+
+    def enable_memory_optim(self):
+        self._enable_memory_optim = True
+
+    def switch_use_feed_fetch_ops(self, x=True):
+        pass
+
+    def switch_specify_input_names(self, x=True):
+        pass
+
+
+class ZeroCopyTensor:
+    """Named handle onto a predictor slot (reference ZeroCopyTensor):
+    copy_from_cpu stages the next run's input; copy_to_cpu reads the last
+    run's output without an extra staging buffer on the Python side."""
+
+    def __init__(self, predictor, name, is_input):
+        self._pred = predictor
+        self.name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        if not self._is_input:
+            raise ValueError(f"{self.name} is an output tensor")
+        self._pred._staged[self.name] = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        store = self._pred._staged if self._is_input else self._pred._outputs
+        if self.name not in store:
+            raise RuntimeError(
+                f"tensor {self.name!r} has no value yet — "
+                + ("copy_from_cpu() first" if self._is_input
+                   else "call zero_copy_run() first"))
+        return np.asarray(store[self.name])
+
+    def shape(self):
+        store = self._pred._staged if self._is_input else self._pred._outputs
+        if self.name in store:
+            return list(np.shape(store[self.name]))
+        # not materialized yet: report the static shape from the program
+        var = self._pred._program.global_block()._find_var_recursive(self.name)
+        if var is not None and var.shape is not None:
+            return list(var.shape)
+        raise RuntimeError(f"tensor {self.name!r} has no value or static "
+                           f"shape yet")
+
+
+class AnalysisPredictor:
+    def __init__(self, config: AnalysisConfig):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid.executor import Scope, scope_guard
+
+        self._config = config
+        place = fluid.TPUPlace(0) if config._use_tpu else fluid.CPUPlace()
+        self._scope = Scope()
+        self._exe = fluid.Executor(place)
+        with scope_guard(self._scope):
+            if config._model_dir:
+                prog, feeds, fetches = fluid.io.load_inference_model(
+                    config._model_dir, self._exe)
+            else:
+                dirname = os.path.dirname(config._prog_file) or "."
+                prog, feeds, fetches = fluid.io.load_inference_model(
+                    dirname, self._exe,
+                    model_filename=os.path.basename(config._prog_file),
+                    params_filename=(os.path.basename(config._params_file)
+                                     if config._params_file else None))
+        self._program = prog
+        self._feed_names = list(feeds)
+        self._fetch_vars = fetches
+        self._fetch_names = [v.name if hasattr(v, "name") else v
+                             for v in fetches]
+        self._staged = {}
+        self._outputs = {}
+
+    # -- ZeroCopy API ---------------------------------------------------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_tensor(self, name):
+        if name not in self._feed_names:
+            raise KeyError(f"unknown input {name!r}; have {self._feed_names}")
+        return ZeroCopyTensor(self, name, is_input=True)
+
+    def get_output_tensor(self, name):
+        if name not in self._fetch_names:
+            raise KeyError(f"unknown output {name!r}")
+        return ZeroCopyTensor(self, name, is_input=False)
+
+    def zero_copy_run(self):
+        from paddle_tpu.fluid.executor import scope_guard
+
+        missing = [n for n in self._feed_names if n not in self._staged]
+        if missing:
+            raise ValueError(f"inputs not set: {missing}")
+        with scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=dict(self._staged),
+                                 fetch_list=self._fetch_names)
+        self._outputs = dict(zip(self._fetch_names, outs))
+        return True
+
+    # -- PaddleTensor API -----------------------------------------------
+    def run(self, inputs):
+        """inputs: list of PaddleTensor in get_input_names() order (or
+        named).  Returns list of PaddleTensor."""
+        feed = {}
+        for i, t in enumerate(inputs):
+            name = t.name or self._feed_names[i]
+            feed[name] = t.data
+        from paddle_tpu.fluid.executor import scope_guard
+
+        with scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_names)
+        return [PaddleTensor(o, name=n)
+                for n, o in zip(self._fetch_names, outs)]
+
+    def program(self):
+        return self._program
+
+
+def create_paddle_predictor(config: AnalysisConfig) -> AnalysisPredictor:
+    """Reference api factory CreatePaddlePredictor<AnalysisConfig>."""
+    return AnalysisPredictor(config)

@@ -28,7 +28,7 @@ class BertConfig:
                  num_heads=12, intermediate_size=3072, max_position=512,
                  type_vocab_size=2, hidden_dropout=0.1, attn_dropout=0.1,
                  initializer_range=0.02, use_flash_attention=True,
-                 sequence_parallel=False):
+                 sequence_parallel=False, moe_experts=0, moe_top_k=2):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -41,6 +41,8 @@ class BertConfig:
         self.initializer_range = initializer_range
         self.use_flash_attention = use_flash_attention
         self.sequence_parallel = sequence_parallel
+        self.moe_experts = moe_experts      # >0 → MoE FFN (expert parallel)
+        self.moe_top_k = moe_top_k
 
     @classmethod
     def base(cls, **kw):
@@ -107,10 +109,18 @@ def encoder_layer(x, attn_bias, cfg: BertConfig, name, is_test=False):
     x = layers.layer_norm(layers.elementwise_add(x, attn), begin_norm_axis=2,
                           param_attr=ParamAttr(name=name + "_post_att_ln_scale"),
                           bias_attr=ParamAttr(name=name + "_post_att_ln_bias"))
-    ffn = _fc(x, cfg.intermediate_size, name + "_ffn_fc_0", act="gelu",
-              init_std=cfg.initializer_range)
-    ffn = _fc(ffn, cfg.hidden_size, name + "_ffn_fc_1",
-              init_std=cfg.initializer_range)
+    if cfg.moe_experts:
+        # expert-parallel FFN: expert dim of the weights shards over 'ep'
+        ffn = layers.moe_ffn(x, cfg.moe_experts, cfg.intermediate_size,
+                             top_k=cfg.moe_top_k, act="gelu",
+                             param_attr=ParamAttr(
+                                 initializer=Normal(0.0, cfg.initializer_range)),
+                             name=name + "_ffn")
+    else:
+        ffn = _fc(x, cfg.intermediate_size, name + "_ffn_fc_0", act="gelu",
+                  init_std=cfg.initializer_range)
+        ffn = _fc(ffn, cfg.hidden_size, name + "_ffn_fc_1",
+                  init_std=cfg.initializer_range)
     if cfg.hidden_dropout and not is_test:
         ffn = layers.dropout(ffn, dropout_prob=cfg.hidden_dropout,
                              is_test=is_test,

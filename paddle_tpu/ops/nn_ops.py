@@ -395,3 +395,42 @@ def _flash_attention(ctx, q, k, v, bias, attrs):
                        mesh=mesh)
     return _fa(q, k, v, bias=bias, causal=causal, sm_scale=sm_scale,
                force=attrs.get("force"))
+
+
+@simple_op("moe_ffn", ["X", "GateW", "W1", "B1", "W2", "B2"], ["Out"],
+           optional=("B1", "B2"))
+def _moe_ffn(ctx, x, gate_w, w1, b1, w2, b2, attrs):
+    """Mixture-of-experts FFN with top-k gating (no reference analog — the
+    reference has no MoE; this is the expert-parallel building block,
+    SURVEY.md §2.8 'Expert parallel').
+
+    Dense-dispatch formulation: every expert runs over every token and the
+    gate weights combine them.  That trades FLOPs for a perfectly static,
+    GSPMD-friendly program — with the expert dim of W1/W2 sharded over the
+    'ep' mesh axis each device computes only its experts, and the final
+    combine contracts over experts (XLA inserts the psum over ep).  Capacity
+    factors / token dropping, which exist to make sparse dispatch
+    shape-static, are unnecessary by construction.
+
+    x: [B, S, D]; gate_w: [D, E]; w1: [E, D, H]; b1: [E, H];
+    w2: [E, H, D]; b2: [E, D].  attrs: top_k (default 2), act.
+    """
+    top_k = int(attrs.get("top_k", 2))
+    e = w1.shape[0]
+    logits = jnp.einsum("bsd,de->bse", x, gate_w,
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if top_k < e:
+        kth = jax.lax.top_k(probs, top_k)[0][..., -1:]
+        probs = jnp.where(probs >= kth, probs, 0.0)
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    h = jnp.einsum("bsd,edh->ebsh", x, w1)
+    if b1 is not None:
+        h = h + b1[:, None, None, :]
+    act = attrs.get("act", "gelu")
+    h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
+    y = jnp.einsum("ebsh,ehd->ebsd", h, w2)
+    if b2 is not None:
+        y = y + b2[:, None, None, :]
+    out = jnp.einsum("ebsd,bse->bsd", y, probs.astype(y.dtype))
+    return out.astype(x.dtype)

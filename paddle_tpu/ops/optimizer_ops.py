@@ -225,3 +225,46 @@ def _dpsgd(ctx, p, g, lr, attrs):
     g32 = g32 * jnp.minimum(1.0, clip / jnp.maximum(gn, 1e-12))
     noise = sigma * clip * jax.random.normal(op_rng_key(ctx, attrs), jnp.shape(g32))
     return (p.astype(jnp.float32) - _lr(lr) * (g32 + noise)).astype(p.dtype)
+
+
+@simple_op("dgc", ["U", "V", "Grad"], ["UOut", "VOut", "EncodeGrad"],
+           grad=None, inplace={"UOut": "U", "VOut": "V"})
+def _dgc(ctx, u, v, g, attrs):
+    """Deep Gradient Compression (reference dgc_op.cc + the external dgc
+    lib, SURVEY.md §2.2): local momentum accumulation with top-k selection —
+    only the largest |velocity| entries are transmitted; the rest stay in
+    the local residual (u, v) until they grow large enough.
+
+    TPU-native: the reference encodes selected values as sparse
+    (SelectedRows) for NCCL gather; XLA collectives are dense, so the
+    "encoded" gradient here is the masked dense tensor (zeros elsewhere) —
+    the c_allreduce over it preserves DGC's numerics, and the mask keeps the
+    accuracy-preserving residual/momentum-correction behavior.  Sparsity
+    ramps over `rampup_step` steps through the `sparsity` schedule
+    (reference default 0.75→0.999); before `rampup_begin_step` the op is
+    plain momentum (send everything, keep u)."""
+    m = float(attrs.get("m", 0.9))
+    begin = int(attrs.get("rampup_begin_step", 0))
+    ramp = max(1, int(attrs.get("rampup_step", 1)))
+    schedule = jnp.asarray(
+        attrs.get("sparsity", [0.75, 0.9375, 0.984, 0.996, 0.999]),
+        jnp.float32)
+    step = jnp.asarray(ctx.step, jnp.int32)
+
+    def warmup(u, v, g):
+        u2 = m * u + g
+        return u2, jnp.zeros_like(v), u2
+
+    def compress(u, v, g):
+        u2 = m * u + g
+        v2 = v + u2
+        frac = jnp.clip((step - begin).astype(jnp.float32) / ramp, 0.0, 1.0)
+        idx = jnp.minimum((frac * len(schedule)).astype(jnp.int32),
+                          len(schedule) - 1)
+        q = schedule[idx]
+        flat = jnp.abs(v2).reshape(-1)
+        thr = jnp.quantile(flat, q)
+        mask = (jnp.abs(v2) >= thr).astype(v2.dtype)
+        return u2 * (1.0 - mask), v2 * (1.0 - mask), v2 * mask
+
+    return jax.lax.cond(step < begin, warmup, compress, u, v, g)

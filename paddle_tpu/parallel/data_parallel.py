@@ -55,6 +55,10 @@ def transpile_data_parallel(program, loss_name, num_devices,
     if not raw_grads:  # fallback: grads feeding optimizer ops directly
         raw_grads = {op.inputs["Grad"][0] for op in block.ops
                      if op.attrs.get("op_role") == "optimize" and "Grad" in op.inputs}
+    # DGC moves the allreduce onto the compressed gradient (the reference's
+    # SparseAllReduceOpHandle placement): watch the encoded var instead
+    dgc_map = getattr(program, "_dgc_encoded", {})
+    raw_grads = {dgc_map.get(g, g) for g in raw_grads}
 
     new_ops = []
     pending = set(raw_grads)

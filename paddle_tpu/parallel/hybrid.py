@@ -87,6 +87,10 @@ def megatron_rules(extra=()):
     # `<param>_<acc>_<n>` (optimizer.py _add_accumulator) and must be sharded
     # exactly like their parameter
     rules = list(extra) + [
+        # MoE expert weights: expert dim over 'ep' (beyond-parity; no
+        # reference analog — SURVEY §2.8 lists expert parallel as absent)
+        (r"_moe_(w1|w2)\.w_0($|_)", ("ep", None, None)),
+        (r"_moe_(w1|w2)\.b_0($|_)", ("ep", None)),
         (r"(_query_fc|_key_fc|_value_fc|_qkv_fc|_ffn_fc_0)\.w_0($|_)", (None, "mp")),
         (r"(_query_fc|_key_fc|_value_fc|_qkv_fc|_ffn_fc_0)\.b_0($|_)", ("mp",)),
         (r"(_output_fc|_ffn_fc_1)\.w_0($|_)", ("mp", None)),
@@ -95,8 +99,9 @@ def megatron_rules(extra=()):
     return ShardingRule(rules)
 
 
-def build_hybrid_mesh(n_devices=None, dp=None, mp=1, sp=1, pp=1, devices=None):
-    """Build a Mesh with the standard axis order (pp, dp, sp, mp).
+def build_hybrid_mesh(n_devices=None, dp=None, mp=1, sp=1, pp=1, ep=1,
+                      devices=None):
+    """Build a Mesh with the standard axis order (pp, dp, ep, sp, mp).
 
     mp innermost: tensor-parallel collectives are the most latency-sensitive,
     so they ride the fastest/nearest ICI links; pp outermost (stage-to-stage
@@ -108,15 +113,18 @@ def build_hybrid_mesh(n_devices=None, dp=None, mp=1, sp=1, pp=1, devices=None):
         devices = jax.devices()
     if n_devices is None:
         n_devices = len(devices)
-    if n_devices % (mp * sp * pp) != 0:
+    if n_devices % (mp * sp * pp * ep) != 0:
         raise ValueError(
-            f"n_devices={n_devices} not divisible by mp*sp*pp={mp * sp * pp}")
+            f"n_devices={n_devices} not divisible by mp*sp*pp*ep="
+            f"{mp * sp * pp * ep}")
     if dp is None:
-        dp = n_devices // (mp * sp * pp)
+        dp = n_devices // (mp * sp * pp * ep)
     shape = {}
     if pp > 1:
         shape[pmesh.PIPE_AXIS] = pp
     shape[pmesh.DATA_AXIS] = dp
+    if ep > 1:
+        shape[pmesh.EXPERT_AXIS] = ep
     if sp > 1:
         shape[pmesh.SEQ_AXIS] = sp
     shape[pmesh.MODEL_AXIS] = mp

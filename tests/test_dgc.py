@@ -1,0 +1,125 @@
+"""DGC (Deep Gradient Compression) tests: warmup == plain momentum, top-k
+sparsification after rampup, residual accumulation, DP-transpiler allreduce
+placement on the encoded gradient."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+
+def _build(opt_fn):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt_fn().minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.uniform(-1, 1, (8, 1)).astype("float32")
+    return [{"x": (xb := rng.uniform(-1, 1, (16, 8)).astype("float32")),
+             "y": xb @ W} for _ in range(n)]
+
+
+def _train(opt_fn, batches):
+    main, startup, loss = _build(opt_fn)
+    with scope_guard(Scope()) as _:
+        from paddle_tpu.fluid.executor import global_scope
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for b in batches:
+            (lv,) = exe.run(main, feed=b, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv)))
+        w = np.asarray(global_scope().get("w")).copy()
+    return losses, w
+
+
+def test_dgc_warmup_equals_momentum():
+    """Before rampup_begin_step DGC is exactly momentum."""
+    batches = _batches(5)
+    l_dgc, w_dgc = _train(
+        lambda: fluid.optimizer.DGCMomentum(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=100),
+        batches)
+    l_mom, w_mom = _train(
+        lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9),
+        batches)
+    np.testing.assert_allclose(l_dgc, l_mom, rtol=1e-5)
+    np.testing.assert_allclose(w_dgc, w_mom, rtol=1e-5)
+
+
+def test_dgc_sparsifies_and_converges():
+    """After rampup the transmitted gradient is top-k sparse, residuals
+    carry the rest, and training still converges."""
+    batches = _batches(60, seed=2)
+    losses, _ = _train(
+        lambda: fluid.optimizer.DGCMomentum(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=3,
+            rampup_step=4, sparsity=[0.5, 0.75]),
+        batches)
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.5
+
+    # inspect the encoded grad after rampup: ~75% zeros
+    main, startup, loss = _build(
+        lambda: fluid.optimizer.DGCMomentum(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=1,
+            rampup_step=1, sparsity=[0.75]))
+    enc = list(main._dgc_encoded.values())[0]
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for b in batches[:3]:
+            (ev,) = exe.run(main, feed=b, fetch_list=[enc])
+        e = np.asarray(ev)
+    assert np.mean(e == 0.0) >= 0.6, f"not sparse: {np.mean(e == 0.0)}"
+
+
+def test_dgc_dp_transpile_allreduces_encoded():
+    from paddle_tpu.parallel.data_parallel import transpile_data_parallel
+
+    main, startup, loss = _build(
+        lambda: fluid.optimizer.DGCMomentum(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=0))
+    transpile_data_parallel(main, loss.name, 8)
+    enc = set(main._dgc_encoded.values())
+    ar = [op for op in main.global_block().ops
+          if op.type == "c_allreduce_sum"]
+    assert ar, "no allreduce inserted"
+    assert all(op.inputs["X"][0] in enc for op in ar), \
+        "allreduce must target the dgc-encoded grad"
+    types = [op.type for op in main.global_block().ops]
+    assert types.index("dgc") < types.index("c_allreduce_sum") < \
+        types.index("sgd")
+
+
+def test_dgc_with_regularization_still_allreduces_encoded():
+    """Weight decay renames the grad (w@GRAD → w@GRAD_reg_*); the allreduce
+    must still target the dgc-encoded grad, not the raw one."""
+    from paddle_tpu.parallel.data_parallel import transpile_data_parallel
+    from paddle_tpu.fluid.regularizer import L2Decay
+
+    main, startup, loss = _build(
+        lambda: fluid.optimizer.DGCMomentum(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=0,
+            regularization=L2Decay(1e-4)))
+    transpile_data_parallel(main, loss.name, 8)
+    enc = set(main._dgc_encoded.values())
+    ar = [op for op in main.global_block().ops
+          if op.type == "c_allreduce_sum"]
+    assert ar and all(op.inputs["X"][0] in enc for op in ar)
+
+
+def test_dgc_nesterov_rejected():
+    import pytest
+
+    with pytest.raises(NotImplementedError, match="Nesterov"):
+        fluid.optimizer.DGCMomentum(learning_rate=0.05, momentum=0.9,
+                                    rampup_begin_step=0, use_nesterov=True)

@@ -1,0 +1,147 @@
+"""Fleet API tests (reference incubate/fleet): role makers, collective
+fleet graph rewrite, PS fleet end to end on localhost threads."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.fluid.incubate.fleet.base.role_maker import (
+    PaddleCloudRoleMaker, Role, UserDefinedCollectiveRoleMaker,
+    UserDefinedRoleMaker)
+from paddle_tpu.fluid.incubate.fleet.collective import (
+    Collective, DistributedStrategy)
+from paddle_tpu.fluid.incubate.fleet.parameter_server import (
+    ParameterServerFleet)
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _model(opt=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return main, startup, loss
+
+
+def test_role_maker_env(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PORT", "7777")
+    monkeypatch.setenv("PADDLE_PSERVERS", "127.0.0.1,127.0.0.2")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    rm = PaddleCloudRoleMaker()
+    rm.generate_role()
+    assert rm.is_server() and not rm.is_worker()
+    assert rm.get_pserver_endpoints() == ["127.0.0.1:7777", "127.0.0.2:7777"]
+    assert rm.worker_num() == 4
+
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    rm2 = PaddleCloudRoleMaker()
+    rm2.generate_role()
+    assert rm2.is_worker() and rm2.worker_index() == 2
+
+
+def test_split_files():
+    f = Collective().init(UserDefinedCollectiveRoleMaker(
+        current_id=1, worker_endpoints=["a:1", "b:2"]))
+    got = f.split_files([f"part-{i}" for i in range(5)])
+    assert got == ["part-1", "part-3"]
+
+
+def test_collective_fleet_rewrites_graph():
+    f = Collective().init(UserDefinedCollectiveRoleMaker(
+        current_id=0, worker_endpoints=["127.0.0.1:0"]))
+    main, startup, loss = _model()
+    with fluid.program_guard(main, startup):
+        opt = f.distributed_optimizer(
+            fluid.optimizer.SGD(learning_rate=0.1), DistributedStrategy())
+        opt.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "c_allreduce_sum" in types
+    assert f.main_program is main
+
+
+def test_collective_fleet_local_sgd_strategy():
+    f = Collective().init(UserDefinedCollectiveRoleMaker(current_id=0))
+    main, startup, loss = _model()
+    s = DistributedStrategy()
+    s.use_local_sgd, s.local_sgd_k_steps = True, 4
+    with fluid.program_guard(main, startup):
+        f.distributed_optimizer(
+            fluid.optimizer.SGD(learning_rate=0.1), s).minimize(loss)
+    assert main._local_sgd_k == 4
+
+
+def test_ps_fleet_end_to_end():
+    """Worker + server roles through the fleet API, loss parity vs local."""
+    port = free_port()
+    eps = [f"127.0.0.1:{port}"]
+    rng = np.random.RandomState(0)
+    W = rng.uniform(-1, 1, (8, 1)).astype("float32")
+    batches = []
+    for _ in range(6):
+        xb = rng.uniform(-1, 1, (16, 8)).astype("float32")
+        batches.append({"x": xb, "y": xb @ W})
+
+    # local baseline
+    main, startup, loss = _model()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard("opt_"):
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    local = []
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for b in batches:
+            (lv,) = exe.run(main, feed=b, fetch_list=[loss.name])
+            local.append(float(np.asarray(lv)))
+
+    # server (program construction happens in the main thread: unique_name
+    # state is global, so concurrent graph building belongs to separate
+    # processes — the thread only serves)
+    fs = ParameterServerFleet().init(UserDefinedRoleMaker(
+        current_id=0, role=Role.SERVER, worker_num=1, server_endpoints=eps))
+    smain, sstartup, sloss = _model()
+    with fluid.program_guard(smain, sstartup), fluid.unique_name.guard("opt_"):
+        fs.distributed_optimizer(
+            fluid.optimizer.SGD(learning_rate=0.1)).minimize(sloss)
+    fs.init_server()
+
+    def server():
+        with scope_guard(Scope()):
+            fs.run_server()
+
+    st = threading.Thread(target=server)
+    st.start()
+
+    # worker (main thread)
+    f = ParameterServerFleet().init(UserDefinedRoleMaker(
+        current_id=0, role=Role.WORKER, worker_num=1, server_endpoints=eps))
+    main, startup, loss = _model()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard("opt_"):
+        f.distributed_optimizer(
+            fluid.optimizer.SGD(learning_rate=0.1)).minimize(loss)
+    dist = []
+    try:
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            f.init_worker(exe)
+            for b in batches:
+                (lv,) = exe.run(f.main_program, feed=b,
+                                fetch_list=[loss.name])
+                dist.append(float(np.asarray(lv)))
+    finally:
+        f.stop_servers()
+        st.join(timeout=15)
+    assert not st.is_alive()
+    np.testing.assert_allclose(dist, local, rtol=1e-5, atol=1e-6)

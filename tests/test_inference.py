@@ -1,0 +1,90 @@
+"""AnalysisPredictor serving-path tests (reference inference/api/):
+save_inference_model → predictor → ZeroCopy + PaddleTensor runs match the
+training-program forward."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                  create_paddle_predictor)
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("infer_model"))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=3, act="softmax")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main)
+        xb = np.random.RandomState(0).uniform(-1, 1, (4, 8)).astype("float32")
+        (expect,) = exe.run(main, feed={"x": xb}, fetch_list=[pred.name])
+    return d, xb, np.asarray(expect)
+
+
+def test_zero_copy_run(saved_model):
+    d, xb, expect = saved_model
+    config = AnalysisConfig(d)
+    config.disable_gpu()
+    pred = create_paddle_predictor(config)
+    assert pred.get_input_names() == ["x"]
+    inp = pred.get_input_tensor("x")
+    inp.copy_from_cpu(xb)
+    assert pred.zero_copy_run()
+    out = pred.get_output_tensor(pred.get_output_names()[0])
+    np.testing.assert_allclose(out.copy_to_cpu(), expect, rtol=1e-5)
+    assert out.shape() == [4, 3]
+
+
+def test_paddle_tensor_run(saved_model):
+    d, xb, expect = saved_model
+    config = AnalysisConfig(d)
+    config.disable_gpu()
+    pred = create_paddle_predictor(config)
+    outs = pred.run([PaddleTensor(xb, name="x")])
+    np.testing.assert_allclose(outs[0].as_ndarray(), expect, rtol=1e-5)
+
+
+def test_predictor_isolated_scope(saved_model):
+    """Predictor weights live in their own scope — a user program in the
+    ambient scope cannot clobber them (ZeroCopy residency)."""
+    d, xb, expect = saved_model
+    config = AnalysisConfig(d)
+    config.disable_gpu()
+    pred = create_paddle_predictor(config)
+    with scope_guard(Scope()):  # ambient scope gets unrelated junk
+        from paddle_tpu.fluid.executor import global_scope
+        global_scope().set("fc_0.w_0", np.zeros((8, 16), np.float32))
+        inp = pred.get_input_tensor("x")
+        inp.copy_from_cpu(xb)
+        pred.zero_copy_run()
+        out = pred.get_output_tensor(pred.get_output_names()[0])
+        np.testing.assert_allclose(out.copy_to_cpu(), expect, rtol=1e-5)
+
+
+def test_missing_input_raises(saved_model):
+    d, _, _ = saved_model
+    config = AnalysisConfig(d)
+    config.disable_gpu()
+    pred = create_paddle_predictor(config)
+    with pytest.raises(ValueError, match="inputs not set"):
+        pred.zero_copy_run()
+
+
+def test_tensor_shape_before_run(saved_model):
+    d, _, _ = saved_model
+    config = AnalysisConfig(d)
+    config.disable_gpu()
+    pred = create_paddle_predictor(config)
+    out = pred.get_output_tensor(pred.get_output_names()[0])
+    assert out.shape()[-1] == 3  # static shape from the program
+    with pytest.raises(RuntimeError, match="zero_copy_run"):
+        out.copy_to_cpu()

@@ -16,6 +16,13 @@ from .control_flow import (  # noqa: F401
     While, Switch, ConditionalBlock, StaticRNN, increment, array_write,
     array_read, array_length, create_array, autoincreased_step_counter,
 )
+from . import rnn
+from .rnn import dynamic_lstm, dynamic_gru, gru_unit, lstm_unit  # noqa: F401
+from . import structured
+from .structured import (  # noqa: F401
+    linear_chain_crf, crf_decoding, nce, hsigmoid, beam_search,
+    beam_search_decode,
+)
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (  # noqa: F401
     exponential_decay, natural_exp_decay, inverse_time_decay,

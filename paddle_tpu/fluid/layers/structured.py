@@ -1,0 +1,138 @@
+"""Structured-prediction layers: linear_chain_crf, crf_decoding, nce,
+hsigmoid, beam_search, beam_search_decode (reference python/paddle/fluid/
+layers/nn.py — same-named functions).
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = ["linear_chain_crf", "crf_decoding", "nce", "hsigmoid",
+           "beam_search", "beam_search_decode"]
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None, name=None):
+    """CRF negative log-likelihood (reference nn.py linear_chain_crf).
+    input: emissions [B, T, C]; label: [B, T] int64.  The transition
+    parameter has shape [C+2, C] (rows: start, end, transitions)."""
+    helper = LayerHelper("linear_chain_crf", name=name)
+    c = input.shape[-1]
+    transition = helper.create_parameter(param_attr, shape=[c + 2, c],
+                                         dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(dtype=input.dtype)
+    em_exps = helper.create_variable_for_type_inference(dtype=input.dtype)
+    tr_exps = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ll = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"Emission": [input], "Transition": [transition],
+              "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("linear_chain_crf", inputs=inputs,
+                     outputs={"Alpha": [alpha], "EmissionExps": [em_exps],
+                              "TransitionExps": [tr_exps],
+                              "LogLikelihood": [ll]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None, name=None):
+    """Viterbi decode with the CRF transition parameter (reference nn.py
+    crf_decoding).  Pass the SAME param_attr name used by linear_chain_crf."""
+    helper = LayerHelper("crf_decoding", name=name)
+    attr = ParamAttr._to_attr(param_attr)
+    transition = helper.main_program.global_block().var(attr.name)
+    path = helper.create_variable_for_type_inference(dtype="int64")
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [path]})
+    return path
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, seed=0, sampler="uniform",
+        name=None):
+    """NCE loss (reference nn.py nce → nce_op).  Returns cost [B, 1]."""
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_total_classes],
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    cost = helper.create_variable_for_type_inference(dtype=input.dtype)
+    logits = helper.create_variable_for_type_inference(dtype=input.dtype)
+    labels = helper.create_variable_for_type_inference(dtype="int64",
+                                                       stop_gradient=True)
+    helper.append_op("nce", inputs=inputs,
+                     outputs={"Cost": [cost], "SampleLogits": [logits],
+                              "SampleLabels": [labels]},
+                     attrs={"num_total_classes": num_total_classes,
+                            "num_neg_samples": num_neg_samples,
+                            "seed": seed, "sampler": sampler})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid loss over a complete binary tree (reference
+    nn.py hsigmoid → hierarchical_sigmoid op).  Returns cost [B, 1]."""
+    helper = LayerHelper("hsigmoid", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_classes - 1],
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    pre_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("hierarchical_sigmoid", inputs=inputs,
+                     outputs={"Out": [out], "PreOut": [pre_out]},
+                     attrs={"num_classes": num_classes})
+    return out
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, name=None):
+    """One decode step on a static [B, K] beam (reference nn.py beam_search;
+    see ops/structured_ops.py for the dense redesign).  scores: [B, K, V]
+    log-probs.  Returns (selected_ids, selected_scores, parent_idx)."""
+    helper = LayerHelper("beam_search", name=name)
+    ids = helper.create_variable_for_type_inference(dtype="int64",
+                                                    stop_gradient=True)
+    sc = helper.create_variable_for_type_inference(dtype=pre_scores.dtype,
+                                                   stop_gradient=True)
+    parent = helper.create_variable_for_type_inference(dtype="int32",
+                                                       stop_gradient=True)
+    helper.append_op("beam_search",
+                     inputs={"PreIds": [pre_ids], "PreScores": [pre_scores],
+                             "Scores": [scores]},
+                     outputs={"SelectedIds": [ids], "SelectedScores": [sc],
+                              "ParentIdx": [parent]},
+                     attrs={"beam_size": beam_size, "end_id": end_id})
+    return ids, sc, parent
+
+
+def beam_search_decode(ids, parent_idx, beam_size=None, end_id=0, name=None):
+    """Backtrack stacked beam steps into sentences (reference nn.py
+    beam_search_decode).  ids/parent_idx: [T, B, K].  Returns
+    sentence_ids [B, K, T]."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent = helper.create_variable_for_type_inference(dtype="int64",
+                                                     stop_gradient=True)
+    scores = helper.create_variable_for_type_inference(dtype="float32",
+                                                       stop_gradient=True)
+    helper.append_op("beam_search_decode",
+                     inputs={"Ids": [ids], "ParentIdx": [parent_idx]},
+                     outputs={"SentenceIds": [sent],
+                              "SentenceScores": [scores]},
+                     attrs={"end_id": end_id})
+    return sent

@@ -1,0 +1,225 @@
+"""Recurrent ops: lstm / gru / lstm_unit / gru_unit.
+
+Reference analogs: paddle/fluid/operators/lstm_op.cc (+ math/detail/
+lstm_kernel.h), gru_op.cc (+ math/detail/gru_kernel.h), lstm_unit_op.h,
+gru_unit_op.h.  The reference iterates LoD batches with per-timestep BLAS
+calls; the TPU-native design is a single `lax.scan` over the padded-dense
+time axis — one compiled XLA loop whose per-step body is an MXU matmul, no
+host dispatch per step, fully differentiable via vjp-of-scan.
+
+Layout/semantics preserved from the reference:
+  lstm:  Input [B,T,4D] is x already projected (the layer does the fc, like
+         the reference's dynamic_lstm), chunk order {c~, i, f, o}
+         (lstm_op.cc:125 "Weight = {W_ch, W_ih, W_fh, W_oh}"); peephole
+         weights ride in Bias[4D:7D] (checkI, checkF, checkO); cell clip.
+  gru:   Input [B,T,3D], chunks {u, r, c~}; Weight [D,3D] = hidden-hidden
+         for u,r plus candidate weight on (r * h_prev); `origin_mode`
+         selects h = u*h_prev + (1-u)*c~ (True) vs (1-u)*h_prev + u*c~
+         (False, the default — gru_kernel.h:58-69, gru_op.cc:143).
+  Variable length: padded positions produce zeros in Hidden/Cell and do not
+  advance the recurrent state (dense analog of LoD batching).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.fluid.registry import simple_op
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _act(name):
+    return _ACTS[name]
+
+
+def _valid_mask(length, b, t):
+    """[B, T] float-agnostic bool mask of valid positions; None → all valid."""
+    if length is None:
+        return None
+    return jnp.arange(t)[None, :] < jnp.reshape(length, (-1, 1)).astype(jnp.int32)
+
+
+def _reverse_valid(x, length):
+    """Reverse each row's valid prefix along time (padding stays at tail)."""
+    if length is None:
+        return jnp.flip(x, axis=1)
+    t = jnp.shape(x)[1]
+    ar = jnp.arange(t)[None, :]
+    ln = jnp.reshape(length, (-1, 1)).astype(jnp.int32)
+    idx = jnp.where(ar < ln, ln - 1 - ar, ar)
+    return jnp.take_along_axis(x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+@simple_op("lstm", ["Input", "Weight", "Bias", "H0", "C0", "Length"],
+           ["Hidden", "Cell"],
+           optional=("Bias", "H0", "C0", "Length"), no_grad_inputs=("Length",))
+def _lstm(ctx, x, w, bias, h0, c0, length, attrs):
+    """x: [B,T,4D] pre-projected input; w: [D,4D] hidden-hidden weight;
+    bias: [4D] (or [7D] with peepholes).  Outputs Hidden/Cell [B,T,D]."""
+    use_peep = bool(attrs.get("use_peepholes", False))
+    is_reverse = bool(attrs.get("is_reverse", False))
+    cell_clip = float(attrs.get("cell_clip", 0.0))
+    act_gate = _act(attrs.get("gate_activation", "sigmoid"))
+    act_state = _act(attrs.get("cell_activation", "tanh"))
+    act_node = _act(attrs.get("candidate_activation", "tanh"))
+
+    b, t, d4 = jnp.shape(x)
+    d = d4 // 4
+    if bias is not None:
+        bias = jnp.reshape(bias, (-1,))
+        x = x + bias[None, None, :4 * d].astype(x.dtype)
+    if use_peep and bias is not None:
+        check_i, check_f, check_o = (bias[4 * d:5 * d], bias[5 * d:6 * d],
+                                     bias[6 * d:7 * d])
+    else:
+        check_i = check_f = check_o = jnp.zeros((d,), x.dtype)
+    h0 = jnp.zeros((b, d), x.dtype) if h0 is None else h0.astype(x.dtype)
+    c0 = jnp.zeros((b, d), x.dtype) if c0 is None else c0.astype(x.dtype)
+
+    if is_reverse:
+        x = _reverse_valid(x, length)
+    mask = _valid_mask(length, b, t)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, valid = inp
+        gates = xt + jnp.dot(h_prev, w, preferred_element_type=jnp.float32
+                             ).astype(x.dtype)
+        g_c, g_i, g_f, g_o = jnp.split(gates, 4, axis=-1)
+        cand = act_node(g_c)
+        i = act_gate(g_i + c_prev * check_i)
+        f = act_gate(g_f + c_prev * check_f)
+        c = cand * i + c_prev * f
+        if cell_clip > 0.0:
+            c = jnp.clip(c, -cell_clip, cell_clip)
+        o = act_gate(g_o + c * check_o)
+        h = o * act_state(c)
+        if valid is not None:
+            v = valid[:, None]
+            h_keep = jnp.where(v, h, h_prev)
+            c_keep = jnp.where(v, c, c_prev)
+            return (h_keep, c_keep), (jnp.where(v, h, 0.0).astype(x.dtype),
+                                      jnp.where(v, c, 0.0).astype(x.dtype))
+        return (h, c), (h, c)
+
+    xs_t = jnp.swapaxes(x, 0, 1)  # [T,B,4D]
+    masks_t = jnp.swapaxes(mask, 0, 1) if mask is not None else jnp.ones(
+        (t, b), bool)
+    (_, _), (hs, cs) = lax.scan(
+        lambda carry, inp: step(carry, (inp[0], inp[1] if mask is not None else None)),
+        (h0, c0), (xs_t, masks_t))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        hidden = _reverse_valid(hidden, length)
+        cell = _reverse_valid(cell, length)
+    return hidden, cell
+
+
+@simple_op("gru", ["Input", "Weight", "Bias", "H0", "Length"], ["Hidden"],
+           optional=("Bias", "H0", "Length"), no_grad_inputs=("Length",))
+def _gru(ctx, x, w, bias, h0, length, attrs):
+    """x: [B,T,3D] pre-projected {u,r,c~}; w: [D,3D] — [:, :2D] drives the
+    u/r gates from h_prev, [:, 2D:] the candidate from (r * h_prev)."""
+    is_reverse = bool(attrs.get("is_reverse", False))
+    origin_mode = bool(attrs.get("origin_mode", False))
+    act_gate = _act(attrs.get("gate_activation", "sigmoid"))
+    act_node = _act(attrs.get("activation", "tanh"))
+
+    b, t, d3 = jnp.shape(x)
+    d = d3 // 3
+    if bias is not None:
+        x = x + jnp.reshape(bias, (1, 1, -1)).astype(x.dtype)
+    w_gate = w[:, :2 * d]
+    w_cand = w[:, 2 * d:]
+    h0 = jnp.zeros((b, d), x.dtype) if h0 is None else h0.astype(x.dtype)
+
+    if is_reverse:
+        x = _reverse_valid(x, length)
+    mask = _valid_mask(length, b, t)
+
+    def step(h_prev, inp):
+        xt, valid = inp
+        g_ur = xt[:, :2 * d] + jnp.dot(h_prev, w_gate,
+                                       preferred_element_type=jnp.float32
+                                       ).astype(x.dtype)
+        u = act_gate(g_ur[:, :d])
+        r = act_gate(g_ur[:, d:])
+        cand = act_node(
+            xt[:, 2 * d:] + jnp.dot(r * h_prev, w_cand,
+                                    preferred_element_type=jnp.float32
+                                    ).astype(x.dtype))
+        if origin_mode:
+            h = u * h_prev + (1.0 - u) * cand
+        else:
+            h = (1.0 - u) * h_prev + u * cand
+        if valid is not None:
+            v = valid[:, None]
+            return jnp.where(v, h, h_prev), jnp.where(v, h, 0.0).astype(x.dtype)
+        return h, h
+
+    xs_t = jnp.swapaxes(x, 0, 1)
+    masks_t = jnp.swapaxes(mask, 0, 1) if mask is not None else jnp.ones(
+        (t, b), bool)
+    _, hs = lax.scan(
+        lambda c, inp: step(c, (inp[0], inp[1] if mask is not None else None)),
+        h0, (xs_t, masks_t))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        hidden = _reverse_valid(hidden, length)
+    return hidden
+
+
+@simple_op("lstm_unit", ["X", "C_prev"], ["C", "H"])
+def _lstm_unit(ctx, x, c_prev, attrs):
+    """One LSTM step on pre-projected gates (lstm_unit_op.h:63-71):
+    X [B,4D] chunks {i, f, o, j}; C = C_prev*sigm(f+forget_bias)
+    + sigm(i)*tanh(j); H = sigm(o)*tanh(C)."""
+    forget_bias = float(attrs.get("forget_bias", 0.0))
+    d = jnp.shape(x)[-1] // 4
+    i, f, o, j = (x[:, :d], x[:, d:2 * d], x[:, 2 * d:3 * d], x[:, 3 * d:])
+    c = c_prev * jax.nn.sigmoid(f + forget_bias) + jax.nn.sigmoid(i) * jnp.tanh(j)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return c, h
+
+
+@simple_op("gru_unit", ["Input", "HiddenPrev", "Weight", "Bias"],
+           ["Gate", "ResetHiddenPrev", "Hidden"], optional=("Bias",))
+def _gru_unit(ctx, x, h_prev, w, bias, attrs):
+    """One GRU step (gru_unit_op.h): Input [B,3D] pre-projected {u,r,c~},
+    Weight [D,3D] as in the gru op.  Returns (gates, r*h_prev, h)."""
+    origin_mode = bool(attrs.get("origin_mode", False))
+    act_gate = _act({1: "sigmoid", 2: "tanh", 3: "relu", 0: "identity"}.get(
+        attrs.get("gate_activation", 1), "sigmoid")
+        if not isinstance(attrs.get("gate_activation", 1), str)
+        else attrs.get("gate_activation"))
+    act_node = _act({1: "sigmoid", 2: "tanh", 3: "relu", 0: "identity"}.get(
+        attrs.get("activation", 2), "tanh")
+        if not isinstance(attrs.get("activation", 2), str)
+        else attrs.get("activation"))
+    d = jnp.shape(h_prev)[-1]
+    if bias is not None:
+        x = x + jnp.reshape(bias, (1, -1)).astype(x.dtype)
+    g_ur = x[:, :2 * d] + jnp.dot(h_prev, w[:, :2 * d],
+                                  preferred_element_type=jnp.float32
+                                  ).astype(x.dtype)
+    u = act_gate(g_ur[:, :d])
+    r = act_gate(g_ur[:, d:])
+    r_h = r * h_prev
+    cand = act_node(x[:, 2 * d:] + jnp.dot(r_h, w[:, 2 * d:],
+                                           preferred_element_type=jnp.float32
+                                           ).astype(x.dtype))
+    if origin_mode:
+        h = u * h_prev + (1.0 - u) * cand
+    else:
+        h = (1.0 - u) * h_prev + u * cand
+    gate = jnp.concatenate([u, r, cand], axis=-1)
+    return gate, r_h, h

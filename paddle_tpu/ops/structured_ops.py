@@ -1,0 +1,254 @@
+"""Structured-prediction / sampled-loss ops: linear_chain_crf, crf_decoding,
+beam_search, beam_search_decode, nce, hierarchical_sigmoid.
+
+Reference analogs: paddle/fluid/operators/linear_chain_crf_op.{cc,h} (forward
+algorithm with per-sequence loops and L1 renormalisation), crf_decoding_op.h
+(Viterbi), beam_search_op.cc / beam_search_decode_op.cc (LoD beam items),
+nce_op.h:236-246 (NCE cost), hierarchical_sigmoid_op.h (complete binary tree).
+
+TPU-native redesign: all of these run as dense batched `lax.scan`s in log
+space inside the compiled block — no per-sequence host loops, no LoD.  Beam
+search works on a static [B, K] beam layout (finished beams carry their score
+with only end_id allowed), so the whole decode loop jits.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.fluid.registry import simple_op
+
+_NEG = -1e30
+
+
+def _len_mask(length, b, t):
+    if length is None:
+        return jnp.ones((b, t), bool)
+    return jnp.arange(t)[None, :] < jnp.reshape(length, (-1, 1)).astype(jnp.int32)
+
+
+@simple_op("linear_chain_crf", ["Emission", "Transition", "Label", "Length"],
+           ["Alpha", "EmissionExps", "TransitionExps", "LogLikelihood"],
+           optional=("Length",), no_grad_inputs=("Label", "Length"))
+def _linear_chain_crf(ctx, emission, transition, label, length, attrs):
+    """Negative log-likelihood of the gold path (the reference returns -ll,
+    linear_chain_crf_op.h:193).  Emission [B,T,C]; Transition [(C+2),C] with
+    row 0 = start weights, row 1 = end weights, rows 2.. = transitions
+    (linear_chain_crf_op.cc:91-96).  Dense log-space forward algorithm."""
+    b, t, c = jnp.shape(emission)
+    em = emission.astype(jnp.float32)
+    a = transition[0].astype(jnp.float32)       # start
+    e = transition[1].astype(jnp.float32)       # end
+    w = transition[2:].astype(jnp.float32)      # [C, C]
+    lbl = jnp.reshape(label, (b, t)).astype(jnp.int32)
+    mask = _len_mask(length, b, t)
+
+    # --- partition function: alpha scan over time --------------------------
+    alpha0 = a[None, :] + em[:, 0, :]
+
+    def fwd(alpha, inp):
+        x_t, m_t = inp
+        nxt = x_t + jax.nn.logsumexp(alpha[:, :, None] + w[None, :, :], axis=1)
+        alpha = jnp.where(m_t[:, None], nxt, alpha)
+        return alpha, alpha
+
+    alpha_last, alphas = lax.scan(
+        fwd, alpha0,
+        (jnp.swapaxes(em, 0, 1)[1:], jnp.swapaxes(mask, 0, 1)[1:]))
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,C]
+    log_z = jax.nn.logsumexp(alpha_last + e[None, :], axis=-1)  # [B]
+
+    # --- gold-path score ---------------------------------------------------
+    first_lbl = lbl[:, 0]
+    score = a[first_lbl] + jnp.take_along_axis(
+        em[:, 0, :], first_lbl[:, None], axis=1)[:, 0]
+    em_t = jnp.take_along_axis(em, lbl[:, :, None], axis=2)[:, :, 0]  # [B,T]
+    score = score + jnp.sum(jnp.where(mask[:, 1:], em_t[:, 1:], 0.0), axis=1)
+    trans_t = w[lbl[:, :-1], lbl[:, 1:]]  # [B,T-1]
+    score = score + jnp.sum(jnp.where(mask[:, 1:], trans_t, 0.0), axis=1)
+    if length is None:
+        last_lbl = lbl[:, -1]
+    else:
+        last_idx = jnp.maximum(jnp.reshape(length, (b,)).astype(jnp.int32) - 1, 0)
+        last_lbl = jnp.take_along_axis(lbl, last_idx[:, None], axis=1)[:, 0]
+    score = score + e[last_lbl]
+
+    nll = (log_z - score)[:, None].astype(emission.dtype)
+    return (jnp.swapaxes(alphas, 0, 1).astype(emission.dtype),
+            jnp.exp(em - jax.nn.logsumexp(em, axis=-1, keepdims=True)
+                    ).astype(emission.dtype),
+            jnp.exp(transition).astype(emission.dtype),
+            nll)
+
+
+@simple_op("crf_decoding", ["Emission", "Transition", "Label", "Length"],
+           ["ViterbiPath"], optional=("Label", "Length"), grad=None)
+def _crf_decoding(ctx, emission, transition, label, length, attrs):
+    """Viterbi decode (reference crf_decoding_op.h).  Without Label the
+    output is the best path [B,T] (int64); with Label it is a 0/1 tensor
+    marking positions where the decoded tag equals the label."""
+    b, t, c = jnp.shape(emission)
+    em = emission.astype(jnp.float32)
+    a = transition[0].astype(jnp.float32)
+    e = transition[1].astype(jnp.float32)
+    w = transition[2:].astype(jnp.float32)
+    mask = _len_mask(length, b, t)
+
+    v0 = a[None, :] + em[:, 0, :]
+
+    def fwd(v, inp):
+        x_t, m_t = inp
+        cand = v[:, :, None] + w[None, :, :]          # [B, C_prev, C]
+        best_prev = jnp.argmax(cand, axis=1)           # [B, C]
+        nxt = x_t + jnp.max(cand, axis=1)
+        v_new = jnp.where(m_t[:, None], nxt, v)
+        # for invalid steps backpointer = identity (keeps last valid tag)
+        bp = jnp.where(m_t[:, None], best_prev,
+                       jnp.broadcast_to(jnp.arange(c)[None, :], (b, c)))
+        return v_new, bp
+
+    v_last, bps = lax.scan(
+        fwd, v0, (jnp.swapaxes(em, 0, 1)[1:], jnp.swapaxes(mask, 0, 1)[1:]))
+    last_tag = jnp.argmax(v_last + e[None, :], axis=-1).astype(jnp.int32)
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    tag0, path_rev = lax.scan(back, last_tag, bps, reverse=True)
+    # path_rev[k] is the tag at step k+1; the final carry is the step-0 tag
+    path = jnp.concatenate([tag0[None], path_rev], axis=0)
+    path = jnp.swapaxes(path, 0, 1)  # [B,T]
+    path = jnp.where(mask, path, 0).astype(jnp.int64)
+    if label is not None:
+        lbl = jnp.reshape(label, (b, t)).astype(jnp.int64)
+        return jnp.where(mask, (path == lbl).astype(jnp.int64), 0)
+    return path
+
+
+@simple_op("beam_search", ["PreIds", "PreScores", "Scores"],
+           ["SelectedIds", "SelectedScores", "ParentIdx"], grad=None)
+def _beam_search(ctx, pre_ids, pre_scores, scores, attrs):
+    """One beam-search step on a static [B, K] beam layout (dense redesign of
+    beam_search_op.cc's LoD item selection).
+
+    pre_ids/pre_scores: [B, K]; scores: [B, K, V] log-probs of the next
+    token.  A finished beam (pre_id == end_id) survives with its score
+    unchanged and only end_id as a candidate.  Returns new ids/scores [B, K]
+    and the parent beam index [B, K] for backtracking."""
+    end_id = int(attrs.get("end_id", 0))
+    b, k, v = jnp.shape(scores)
+    finished = pre_ids.astype(jnp.int32) == end_id  # [B,K]
+    total = pre_scores[:, :, None].astype(jnp.float32) + scores.astype(jnp.float32)
+    # finished: only end_id allowed, carrying pre_score
+    carry = jnp.full((b, k, v), _NEG, jnp.float32)
+    carry = carry.at[:, :, end_id].set(pre_scores.astype(jnp.float32))
+    total = jnp.where(finished[:, :, None], carry, total)
+    flat = jnp.reshape(total, (b, k * v))
+    top_scores, top_idx = lax.top_k(flat, k)
+    parent = (top_idx // v).astype(jnp.int32)
+    ids = (top_idx % v).astype(jnp.int64)
+    return ids, top_scores.astype(pre_scores.dtype), parent
+
+
+@simple_op("beam_search_decode", ["Ids", "ParentIdx"],
+           ["SentenceIds", "SentenceScores"], grad=None,
+           optional=("ParentIdx",))
+def _beam_search_decode(ctx, ids, parents, attrs):
+    """Backtrack stacked per-step beam choices into full sentences
+    (dense analog of beam_search_decode_op.cc).
+
+    ids/parents: [T, B, K] from T beam_search steps.  Returns
+    SentenceIds [B, K, T] (each beam's token sequence) and a dummy score
+    slot for slot parity (scores live in the final PreScores)."""
+    t, b, k = jnp.shape(ids)
+
+    def back(cur_beam, inp):
+        ids_t, par_t = inp  # [B,K]
+        tok = jnp.take_along_axis(ids_t, cur_beam, axis=1)
+        prev = jnp.take_along_axis(par_t, cur_beam, axis=1)
+        return prev, tok
+
+    init = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (b, k))
+    if parents is None:
+        parents = jnp.broadcast_to(init[None], (t, b, k))
+    _, toks = lax.scan(back, init, (ids.astype(jnp.int64),
+                                    parents.astype(jnp.int32)), reverse=True)
+    sent = jnp.transpose(toks, (1, 2, 0))  # [B,K,T]
+    return sent, None
+
+
+@simple_op("nce", ["Input", "Label", "Weight", "Bias", "SampleWeight"],
+           ["Cost", "SampleLogits", "SampleLabels"],
+           optional=("Bias", "SampleWeight"),
+           no_grad_inputs=("Label", "SampleWeight"))
+def _nce(ctx, x, label, w, bias, sample_weight, attrs):
+    """Noise-contrastive estimation (nce_op.h:236-246): per row, logits for
+    the true classes and `num_neg_samples` uniform samples; o = sigmoid(s);
+    cost = -log(o/(o+b)) for true, -log(b/(o+b)) for noise, with
+    b = q(y) * num_neg_samples and q uniform = 1/num_classes."""
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    num_classes = int(attrs["num_total_classes"])
+    seed = int(attrs.get("seed", 0))
+    b_sz = jnp.shape(x)[0]
+    label = jnp.reshape(label, (b_sz, -1)).astype(jnp.int32)
+    num_true = label.shape[1]
+
+    key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                             jnp.asarray(ctx.step, jnp.uint32))
+    neg = jax.random.randint(key, (b_sz, num_neg), 0, num_classes)
+    samples = jnp.concatenate([label, neg], axis=1)  # [B, num_true+num_neg]
+
+    ws = w[samples]                                   # [B, S, D]
+    logits = jnp.einsum("bd,bsd->bs", x.astype(jnp.float32),
+                        ws.astype(jnp.float32))
+    if bias is not None:
+        logits = logits + bias[samples].astype(jnp.float32)
+    o = jax.nn.sigmoid(logits)
+    q_b = float(num_neg) / float(num_classes)  # uniform sampler probability
+    cost_true = -jnp.log(o / (o + q_b) + 1e-20)
+    cost_noise = -jnp.log(q_b / (o + q_b) + 1e-20)
+    is_true = jnp.arange(samples.shape[1])[None, :] < num_true
+    cost = jnp.sum(jnp.where(is_true, cost_true, cost_noise), axis=1)
+    if sample_weight is not None:
+        cost = cost * jnp.reshape(sample_weight, (-1,)).astype(cost.dtype)
+    return (cost[:, None].astype(x.dtype), logits.astype(x.dtype),
+            samples.astype(jnp.int64))
+
+
+@simple_op("hierarchical_sigmoid", ["X", "W", "Label", "Bias"],
+           ["Out", "PreOut"], optional=("Bias",), no_grad_inputs=("Label",))
+def _hierarchical_sigmoid(ctx, x, w, label, bias, attrs):
+    """Hierarchical sigmoid over a complete binary tree with `num_classes`
+    leaves (hierarchical_sigmoid_op.h; SimpleCode in math/matrix_bit_code.h:
+    code = label + num_classes, internal node for level j = (code >> (len-j))
+    - 1, branch bit = (code >> (len-j-1)) & 1).  Loss = sum over path of
+    softplus((1-2*bit) * (w_node · x + b_node))."""
+    num_classes = int(attrs["num_classes"])
+    b_sz, d = jnp.shape(x)
+    lbl = jnp.reshape(label, (b_sz,)).astype(jnp.int32)
+    code = lbl + num_classes
+    max_depth = int(np.ceil(np.log2(num_classes)))
+    # per-row path length = floor(log2(code)); static loop over max depth
+    code_len = (jnp.floor(jnp.log2(code.astype(jnp.float32)))).astype(jnp.int32)
+
+    levels = jnp.arange(max_depth)
+    # node index and bit per (row, level); level j valid when j < code_len
+    shift_node = code_len[:, None] - levels[None, :]
+    nodes = (code[:, None] >> jnp.maximum(shift_node, 0)) - 1
+    bits = (code[:, None] >> jnp.maximum(shift_node - 1, 0)) & 1
+    valid = levels[None, :] < code_len[:, None]
+    nodes = jnp.clip(nodes, 0, num_classes - 2)
+
+    wn = w[nodes]                               # [B, J, D]
+    s = jnp.einsum("bd,bjd->bj", x.astype(jnp.float32), wn.astype(jnp.float32))
+    if bias is not None:
+        s = s + jnp.reshape(bias, (-1,))[nodes].astype(jnp.float32)
+    z = (1.0 - 2.0 * bits.astype(jnp.float32)) * s
+    losses = jax.nn.softplus(-z)  # -log(sigmoid(z))
+    out = jnp.sum(jnp.where(valid, losses, 0.0), axis=1)[:, None]
+    return out.astype(x.dtype), jax.nn.sigmoid(s).astype(x.dtype)

@@ -1,0 +1,249 @@
+"""RNN op family: lstm / gru / units vs numpy step-by-step references
+(reference analog: tests/unittests/test_lstm_op.py, test_gru_op.py,
+test_gru_unit_op.py, test_lstm_unit_op.py)."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import backward, layers
+from tests.op_test import OpTest
+
+
+def _run(build_fn, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        outs = build_fn()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fetches = exe.run(main, feed=feed,
+                          fetch_list=[o.name for o in outs])
+        params = {n: np.asarray(scope.get(n))
+                  for n in main.global_block().vars
+                  if scope.get(n) is not None and
+                  main.global_block().var(n).persistable}
+    return fetches, params
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm(x, w, bias, use_peepholes, lengths=None):
+    """Step-by-step reference of the lstm op ({c~,i,f,o} chunks,
+    lstm_kernel.h forward)."""
+    b, t, d4 = x.shape
+    d = d4 // 4
+    gb = bias[:4 * d]
+    ci, cf, co = (bias[4 * d:5 * d], bias[5 * d:6 * d], bias[6 * d:7 * d]) \
+        if use_peepholes else (np.zeros(d),) * 3
+    h = np.zeros((b, d))
+    c = np.zeros((b, d))
+    hs = np.zeros((b, t, d))
+    cs = np.zeros((b, t, d))
+    for ti in range(t):
+        gates = x[:, ti] + gb + h @ w
+        g_c, g_i, g_f, g_o = np.split(gates, 4, axis=-1)
+        cand = np.tanh(g_c)
+        i = _sigmoid(g_i + c * ci)
+        f = _sigmoid(g_f + c * cf)
+        c_new = cand * i + c * f
+        o = _sigmoid(g_o + c_new * co)
+        h_new = o * np.tanh(c_new)
+        if lengths is None:
+            valid = np.ones(b, bool)
+        else:
+            valid = ti < lengths
+        hs[valid, ti] = h_new[valid]
+        cs[valid, ti] = c_new[valid]
+        h = np.where(valid[:, None], h_new, h)
+        c = np.where(valid[:, None], c_new, c)
+    return hs, cs
+
+
+def np_gru(x, w, bias, origin_mode, lengths=None):
+    b, t, d3 = x.shape
+    d = d3 // 3
+    h = np.zeros((b, d))
+    hs = np.zeros((b, t, d))
+    for ti in range(t):
+        xt = x[:, ti] + bias
+        g = xt[:, :2 * d] + h @ w[:, :2 * d]
+        u = _sigmoid(g[:, :d])
+        r = _sigmoid(g[:, d:])
+        cand = np.tanh(xt[:, 2 * d:] + (r * h) @ w[:, 2 * d:])
+        h_new = u * h + (1 - u) * cand if origin_mode else \
+            (1 - u) * h + u * cand
+        valid = np.ones(b, bool) if lengths is None else (ti < lengths)
+        hs[valid, ti] = h_new[valid]
+        h = np.where(valid[:, None], h_new, h)
+    return hs
+
+
+def test_dynamic_lstm_matches_numpy():
+    rng = np.random.RandomState(0)
+    b, t, d = 3, 5, 4
+    x = rng.uniform(-1, 1, (b, t, 4 * d)).astype("float32")
+
+    def build():
+        xv = fluid.data("x", [-1, t, 4 * d], False, dtype="float32")
+        h, c = layers.dynamic_lstm(xv, size=4 * d, use_peepholes=True)
+        return [h, c]
+
+    (h, c), params = _run(build, {"x": x})
+    w = next(v for n, v in params.items() if v.shape == (d, 4 * d))
+    bias = next(v for n, v in params.items() if v.shape == (7 * d,))
+    eh, ec = np_lstm(x.astype("float64"), w, bias, True)
+    np.testing.assert_allclose(h, eh, atol=1e-5)
+    np.testing.assert_allclose(c, ec, atol=1e-5)
+
+
+def test_dynamic_lstm_variable_length():
+    rng = np.random.RandomState(1)
+    b, t, d = 3, 6, 2
+    x = rng.uniform(-1, 1, (b, t, 4 * d)).astype("float32")
+    ln = np.array([2, 6, 4], dtype="int64")
+
+    def build():
+        xv = fluid.data("x", [-1, t, 4 * d], False, dtype="float32")
+        lv = fluid.data("ln", [-1], False, dtype="int64")
+        h, c = layers.dynamic_lstm(xv, size=4 * d, use_peepholes=False,
+                                   length=lv)
+        return [h, c]
+
+    (h, c), params = _run(build, {"x": x, "ln": ln})
+    w = next(v for n, v in params.items() if v.shape == (d, 4 * d))
+    bias = next(v for n, v in params.items() if v.shape == (4 * d,))
+    eh, ec = np_lstm(x.astype("float64"), w, bias, False, lengths=ln)
+    np.testing.assert_allclose(h, eh, atol=1e-5)
+    # padded region must be exactly zero
+    assert np.all(h[0, 2:] == 0) and np.all(c[2, 4:] == 0)
+
+
+def test_dynamic_gru_matches_numpy_both_modes():
+    rng = np.random.RandomState(2)
+    b, t, d = 2, 4, 3
+    x = rng.uniform(-1, 1, (b, t, 3 * d)).astype("float32")
+    for origin_mode in (False, True):
+        def build():
+            xv = fluid.data("x", [-1, t, 3 * d], False, dtype="float32")
+            h = layers.dynamic_gru(xv, size=d, origin_mode=origin_mode)
+            return [h]
+
+        (h,), params = _run(build, {"x": x})
+        w = next(v for n, v in params.items() if v.shape == (d, 3 * d))
+        bias = next(v for n, v in params.items() if v.shape == (3 * d,))
+        eh = np_gru(x.astype("float64"), w, bias, origin_mode)
+        np.testing.assert_allclose(h, eh, atol=1e-5)
+
+
+def test_lstm_reverse_matches_flipped_forward():
+    rng = np.random.RandomState(3)
+    b, t, d = 2, 5, 2
+    x = rng.uniform(-1, 1, (b, t, 4 * d)).astype("float32")
+
+    def build(rev):
+        def f():
+            xv = fluid.data("x", [-1, t, 4 * d], False, dtype="float32")
+            h, c = layers.dynamic_lstm(
+                xv, size=4 * d, use_peepholes=False, is_reverse=rev,
+                param_attr=fluid.ParamAttr(name="lw"),
+                bias_attr=fluid.ParamAttr(name="lb"))
+            return [h, c]
+        return f
+
+    (h_rev, _), _ = _run(build(True), {"x": x})
+    (h_fwd, _), _ = _run(build(False), {"x": x[:, ::-1]})
+    np.testing.assert_allclose(h_rev, h_fwd[:, ::-1], atol=1e-5)
+
+
+def test_gru_unit_single_step_equals_gru_first_step():
+    rng = np.random.RandomState(4)
+    b, d = 3, 4
+    x = rng.uniform(-1, 1, (b, 3 * d)).astype("float32")
+    h0 = rng.uniform(-1, 1, (b, d)).astype("float32")
+
+    def build():
+        xv = fluid.data("x", [-1, 3 * d], False, dtype="float32")
+        hv = fluid.data("h0", [-1, d], False, dtype="float32")
+        new_h, r_h, gate = layers.gru_unit(xv, hv, size=3 * d,
+                                           bias_attr=False)
+        return [new_h]
+
+    (new_h,), params = _run(build, {"x": x, "h0": h0})
+    w = next(v for n, v in params.items() if v.shape == (d, 3 * d))
+    g = x[:, :2 * d] + h0 @ w[:, :2 * d]
+    u, r = _sigmoid(g[:, :d]), _sigmoid(g[:, d:])
+    cand = np.tanh(x[:, 2 * d:] + (r * h0) @ w[:, 2 * d:])
+    expect = (1 - u) * h0 + u * cand
+    np.testing.assert_allclose(new_h, expect, atol=1e-5)
+
+
+def test_lstm_unit_layer_trains():
+    rng = np.random.RandomState(5)
+    b, dx, d = 4, 6, 3
+    x = rng.uniform(-1, 1, (b, dx)).astype("float32")
+    h0 = np.zeros((b, d), "float32")
+    c0 = np.zeros((b, d), "float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, dx], False, dtype="float32")
+        hv = fluid.data("h0", [-1, d], False, dtype="float32")
+        cv = fluid.data("c0", [-1, d], False, dtype="float32")
+        h, c = layers.lstm_unit(xv, hv, cv)
+        loss = layers.reduce_mean(layers.square(h))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"x": x, "h0": h0, "c0": c0}
+        (l0,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+        for _ in range(5):
+            (l1,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+    assert float(l1) < float(l0)
+
+
+class TestLSTMGrad(OpTest):
+    """Analytic (vjp-of-scan) vs numeric grads on a tiny lstm."""
+
+    def setUp(self):
+        rng = np.random.RandomState(7)
+        b, t, d = 2, 3, 2
+        x = rng.uniform(-0.5, 0.5, (b, t, 4 * d)).astype("float32")
+        w = rng.uniform(-0.5, 0.5, (d, 4 * d)).astype("float32")
+        bias = rng.uniform(-0.2, 0.2, (4 * d,)).astype("float32")
+        self.op_type = "lstm"
+        self.inputs = {"Input": x, "Weight": w, "Bias": bias}
+        self.attrs = {"use_peepholes": False}
+        eh, ec = np_lstm(x.astype("float64"), w.astype("float64"),
+                         bias.astype("float64"), False)
+        self.outputs = {"Hidden": eh.astype("float32"),
+                        "Cell": ec.astype("float32")}
+
+    def test_output_and_grad(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["Input", "Weight"], "Hidden",
+                        max_relative_error=0.02)
+
+
+class TestGRUGrad(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(8)
+        b, t, d = 2, 3, 2
+        x = rng.uniform(-0.5, 0.5, (b, t, 3 * d)).astype("float32")
+        w = rng.uniform(-0.5, 0.5, (d, 3 * d)).astype("float32")
+        bias = rng.uniform(-0.2, 0.2, (3 * d,)).astype("float32")
+        self.op_type = "gru"
+        self.inputs = {"Input": x, "Weight": w, "Bias": bias}
+        self.attrs = {"origin_mode": False}
+        eh = np_gru(x.astype("float64"), w.astype("float64"),
+                    bias.astype("float64"), False)
+        self.outputs = {"Hidden": eh.astype("float32")}
+
+    def test_output_and_grad(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["Input", "Weight"], "Hidden",
+                        max_relative_error=0.02)

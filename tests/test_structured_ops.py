@@ -1,0 +1,288 @@
+"""Structured-prediction ops: CRF NLL/Viterbi vs brute force, beam search vs
+exhaustive search, NCE/hsigmoid training sanity (reference analogs:
+tests/unittests/test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+test_beam_search_op.py, test_nce.py, test_hsigmoid_op.py)."""
+
+import itertools
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers
+
+
+def _run(build_fn, feed, fetch):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        out = build_fn()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res = exe.run(main, feed=feed, fetch_list=fetch(out))
+        params = {n: np.asarray(scope.get(n))
+                  for n in main.global_block().vars
+                  if main.global_block().var(n).persistable
+                  and scope.get(n) is not None}
+    return res, params
+
+
+def _brute_force_crf(em, trans, lengths):
+    """Enumerate all paths: returns (logZ, best_path) per row."""
+    b, t, c = em.shape
+    a, e, w = trans[0], trans[1], trans[2:]
+    log_zs, best_paths, best_scores = [], [], []
+    for i in range(b):
+        ln = int(lengths[i]) if lengths is not None else t
+        scores = {}
+        for path in itertools.product(range(c), repeat=ln):
+            s = a[path[0]] + em[i, 0, path[0]] + e[path[-1]]
+            for k in range(1, ln):
+                s += em[i, k, path[k]] + w[path[k - 1], path[k]]
+            scores[path] = s
+        vals = np.array(list(scores.values()))
+        m = vals.max()
+        log_zs.append(m + np.log(np.exp(vals - m).sum()))
+        best = max(scores, key=scores.get)
+        best_paths.append(list(best) + [0] * (t - ln))
+        best_scores.append(scores[best])
+    return np.array(log_zs), np.array(best_paths)
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(0)
+    b, t, c = 2, 4, 3
+    em = rng.uniform(-1, 1, (b, t, c)).astype("float32")
+    lbl = rng.randint(0, c, (b, t)).astype("int64")
+    ln = np.array([3, 4], dtype="int64")
+
+    def build():
+        ev = fluid.data("em", [-1, t, c], False, dtype="float32")
+        lv = fluid.data("lbl", [-1, t], False, dtype="int64")
+        lnv = fluid.data("ln", [-1], False, dtype="int64")
+        return layers.linear_chain_crf(
+            ev, lv, param_attr=fluid.ParamAttr(name="crf_w"), length=lnv)
+
+    (nll,), params = _run(build, {"em": em, "lbl": lbl, "ln": ln},
+                          lambda o: [o.name])
+    trans = params["crf_w"].astype("float64")
+    log_z, _ = _brute_force_crf(em.astype("float64"), trans, ln)
+    for i in range(b):
+        lni = int(ln[i])
+        a, e, w = trans[0], trans[1], trans[2:]
+        path = lbl[i, :lni]
+        s = a[path[0]] + em[i, 0, path[0]] + e[path[-1]]
+        for k in range(1, lni):
+            s += em[i, k, path[k]] + w[path[k - 1], path[k]]
+        np.testing.assert_allclose(nll[i, 0], log_z[i] - s, rtol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(1)
+    b, t, c = 3, 4, 3
+    em = rng.uniform(-1, 1, (b, t, c)).astype("float32")
+    ln = np.array([2, 4, 3], dtype="int64")
+    trans = rng.uniform(-1, 1, (c + 2, c)).astype("float32")
+
+    def build():
+        ev = fluid.data("em", [-1, t, c], False, dtype="float32")
+        lnv = fluid.data("ln", [-1], False, dtype="int64")
+        lbl = fluid.data("lbl", [-1, t], False, dtype="int64")
+        crf_w = fluid.layers.create_parameter(
+            [c + 2, c], "float32", name="dec_w")
+        nll = layers.linear_chain_crf(
+            ev, lbl, param_attr=fluid.ParamAttr(name="dec_w"), length=lnv)
+        path = layers.crf_decoding(ev, fluid.ParamAttr(name="dec_w"),
+                                   length=lnv)
+        return path
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        out = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope.set("dec_w", trans)
+        (path,) = exe.run(
+            main, feed={"em": em, "ln": ln,
+                        "lbl": np.zeros((b, t), "int64")},
+            fetch_list=[out.name])
+    _, best = _brute_force_crf(em.astype("float64"), trans.astype("float64"),
+                               ln)
+    np.testing.assert_array_equal(path, best)
+
+
+def test_beam_search_step_vs_exhaustive():
+    rng = np.random.RandomState(2)
+    b, k, v = 2, 3, 7
+    pre_scores = rng.uniform(-2, 0, (b, k)).astype("float32")
+    pre_ids = np.ones((b, k), "int64")  # no beam finished (end_id=0)
+    logp = np.log(rng.dirichlet(np.ones(v), (b, k))).astype("float32")
+
+    def build():
+        pi = fluid.data("pi", [-1, k], False, dtype="int64")
+        ps = fluid.data("ps", [-1, k], False, dtype="float32")
+        sc = fluid.data("sc", [-1, k, v], False, dtype="float32")
+        return layers.beam_search(pi, ps, sc, beam_size=k, end_id=0)
+
+    (ids, scores, parent), _ = _run(
+        build, {"pi": pre_ids, "ps": pre_scores, "sc": logp},
+        lambda o: [o[0].name, o[1].name, o[2].name])
+    for i in range(b):
+        total = pre_scores[i][:, None] + logp[i]  # [K,V]
+        flat = total.reshape(-1)
+        order = np.argsort(-flat)[:k]
+        np.testing.assert_allclose(scores[i], flat[order], rtol=1e-5)
+        np.testing.assert_array_equal(parent[i], order // v)
+        np.testing.assert_array_equal(ids[i], order % v)
+
+
+def test_beam_search_finished_beam_carries():
+    b, k, v = 1, 2, 4
+    pre_ids = np.array([[0, 1]], "int64")  # beam 0 finished (end_id=0)
+    pre_scores = np.array([[-0.1, -5.0]], "float32")
+    logp = np.full((b, k, v), -1.0, "float32")
+
+    def build():
+        pi = fluid.data("pi", [-1, k], False, dtype="int64")
+        ps = fluid.data("ps", [-1, k], False, dtype="float32")
+        sc = fluid.data("sc", [-1, k, v], False, dtype="float32")
+        return layers.beam_search(pi, ps, sc, beam_size=k, end_id=0)
+
+    (ids, scores, parent), _ = _run(
+        build, {"pi": pre_ids, "ps": pre_scores, "sc": logp},
+        lambda o: [o[0].name, o[1].name, o[2].name])
+    # best candidate: finished beam 0 carrying -0.1 with end_id token
+    assert ids[0, 0] == 0 and parent[0, 0] == 0
+    np.testing.assert_allclose(scores[0, 0], -0.1, rtol=1e-6)
+
+
+def test_beam_search_decode_backtracks():
+    # T=3 steps, B=1, K=2; known parent chain
+    ids = np.array([[[5, 6]], [[7, 8]], [[9, 10]]], "int64")   # [T,1,K]
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], "int32")
+
+    def build():
+        iv = fluid.data("ids", [3, -1, 2], False, dtype="int64")
+        pv = fluid.data("par", [3, -1, 2], False, dtype="int32")
+        return layers.beam_search_decode(iv, pv)
+
+    (sent,), _ = _run(build, {"ids": ids, "par": parents},
+                      lambda o: [o.name])
+    # beam 0 at t=2: token 9, parent 0 → t=1 token 7, parent 1 → t=0 token 6
+    np.testing.assert_array_equal(sent[0, 0], [6, 7, 9])
+    # beam 1 at t=2: token 10, parent 1 → t=1 token 8, parent 0 → t=0 token 5
+    np.testing.assert_array_equal(sent[0, 1], [5, 8, 10])
+
+
+def test_crf_trains_toy_tagger():
+    """End-to-end: emissions from an fc, CRF loss decreases and decoding
+    recovers a learnable pattern."""
+    rng = np.random.RandomState(3)
+    b, t, c, d = 8, 5, 3, 6
+    x = rng.uniform(-1, 1, (b, t, d)).astype("float32")
+    lbl = rng.randint(0, c, (b, t)).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, t, d], False, dtype="float32")
+        lv = fluid.data("lbl", [-1, t], False, dtype="int64")
+        em = layers.fc(xv, size=c, num_flatten_dims=2)
+        nll = layers.linear_chain_crf(
+            em, lv, param_attr=fluid.ParamAttr(name="crf_train_w"))
+        loss = layers.mean(nll)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            (lv_,) = exe.run(main, feed={"x": x, "lbl": lbl},
+                             fetch_list=[loss.name])
+            losses.append(float(lv_))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_nce_trains_and_shapes():
+    rng = np.random.RandomState(4)
+    b, d, classes = 16, 8, 20
+    x = rng.uniform(-1, 1, (b, d)).astype("float32")
+    lbl = rng.randint(0, classes, (b, 1)).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, d], False, dtype="float32")
+        lv = fluid.data("lbl", [-1, 1], False, dtype="int64")
+        cost = layers.nce(xv, lv, num_total_classes=classes,
+                          num_neg_samples=5, seed=7)
+        loss = layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (c0,) = exe.run(main, feed={"x": x, "lbl": lbl},
+                        fetch_list=[loss.name])
+        for _ in range(20):
+            (c1,) = exe.run(main, feed={"x": x, "lbl": lbl},
+                            fetch_list=[loss.name])
+    assert float(c1) < float(c0)
+
+
+def test_hsigmoid_trains_and_matches_manual():
+    rng = np.random.RandomState(5)
+    b, d, classes = 4, 6, 8
+    x = rng.uniform(-1, 1, (b, d)).astype("float32")
+    lbl = rng.randint(0, classes, (b, 1)).astype("int64")
+
+    def build():
+        xv = fluid.data("x", [-1, d], False, dtype="float32")
+        lv = fluid.data("lbl", [-1, 1], False, dtype="int64")
+        return layers.hsigmoid(xv, lv, num_classes=classes,
+                               param_attr=fluid.ParamAttr(name="hs_w"),
+                               bias_attr=False)
+
+    (cost,), params = _run(build, {"x": x, "lbl": lbl}, lambda o: [o.name])
+    w = params["hs_w"].astype("float64")
+    # manual complete-binary-tree walk (classes=8 → every path has depth 3)
+    for i in range(b):
+        code = int(lbl[i, 0]) + classes
+        expect = 0.0
+        bits = []
+        node_path = []
+        cl = int(np.floor(np.log2(code)))
+        for j in range(cl):
+            node_path.append((code >> (cl - j)) - 1)
+            bits.append((code >> (cl - j - 1)) & 1)
+        for node, bit in zip(node_path, bits):
+            s = float(x[i].astype("float64") @ w[node])
+            z = (1 - 2 * bit) * s
+            expect += np.log1p(np.exp(-z))
+        np.testing.assert_allclose(cost[i, 0], expect, rtol=1e-4)
+
+
+def test_hsigmoid_decreases_with_training():
+    rng = np.random.RandomState(6)
+    b, d, classes = 12, 5, 10
+    x = rng.uniform(-1, 1, (b, d)).astype("float32")
+    lbl = rng.randint(0, classes, (b, 1)).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, d], False, dtype="float32")
+        lv = fluid.data("lbl", [-1, 1], False, dtype="int64")
+        cost = layers.hsigmoid(xv, lv, num_classes=classes)
+        loss = layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (c0,) = exe.run(main, feed={"x": x, "lbl": lbl},
+                        fetch_list=[loss.name])
+        for _ in range(20):
+            (c1,) = exe.run(main, feed={"x": x, "lbl": lbl},
+                            fetch_list=[loss.name])
+    assert float(c1) < float(c0)

@@ -23,6 +23,12 @@ from .structured import (  # noqa: F401
     linear_chain_crf, crf_decoding, nce, hsigmoid, beam_search,
     beam_search_decode,
 )
+from . import detection
+from .detection import (  # noqa: F401
+    prior_box, density_prior_box, anchor_generator, box_coder,
+    iou_similarity, box_clip, bipartite_match, yolo_box, multiclass_nms,
+    roi_align, roi_pool, target_assign, detection_output,
+)
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (  # noqa: F401
     exponential_decay, natural_exp_decay, inverse_time_decay,

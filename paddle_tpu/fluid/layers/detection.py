@@ -1,0 +1,212 @@
+"""Detection layers (reference python/paddle/fluid/layers/detection.py,
+3.0k LoC): thin wrappers over the detection op family — see
+ops/detection_ops.py for the TPU-native dense/static-shape redesign notes.
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["prior_box", "density_prior_box", "anchor_generator", "box_coder",
+           "iou_similarity", "box_clip", "bipartite_match", "yolo_box",
+           "multiclass_nms", "roi_align", "roi_pool", "target_assign",
+           "detection_output"]
+
+
+def _two_out(helper, op_type, inputs, attrs, out_slots, dtypes=("float32", "float32")):
+    outs = [helper.create_variable_for_type_inference(dtype=d,
+                                                      stop_gradient=True)
+            for d in dtypes]
+    helper.append_op(op_type, inputs=inputs,
+                     outputs={s: [o] for s, o in zip(out_slots, outs)},
+                     attrs=attrs)
+    return tuple(outs)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    helper = LayerHelper("prior_box", name=name)
+    attrs = {"min_sizes": list(min_sizes),
+             "max_sizes": list(max_sizes or []),
+             "aspect_ratios": list(aspect_ratios),
+             "variances": list(variance), "flip": flip, "clip": clip,
+             "step_w": steps[0], "step_h": steps[1], "offset": offset,
+             "min_max_aspect_ratios_order": min_max_aspect_ratios_order}
+    return _two_out(helper, "prior_box",
+                    {"Input": [input], "Image": [image]}, attrs,
+                    ["Boxes", "Variances"])
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper("density_prior_box", name=name)
+    attrs = {"densities": list(densities), "fixed_sizes": list(fixed_sizes),
+             "fixed_ratios": list(fixed_ratios), "variances": list(variance),
+             "clip": clip, "step_w": steps[0], "step_h": steps[1],
+             "offset": offset}
+    return _two_out(helper, "density_prior_box",
+                    {"Input": [input], "Image": [image]}, attrs,
+                    ["Boxes", "Variances"])
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    attrs = {"anchor_sizes": list(anchor_sizes),
+             "aspect_ratios": list(aspect_ratios),
+             "variances": list(variance), "stride": list(stride),
+             "offset": offset}
+    return _two_out(helper, "anchor_generator", {"Input": [input]}, attrs,
+                    ["Anchors", "Variances"])
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(dtype=target_box.dtype)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    elif prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("box_coder", inputs=inputs,
+                     outputs={"OutputBox": [out]}, attrs=attrs)
+    return out
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"box_normalized": box_normalized})
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("box_clip", inputs={"Input": [input],
+                                         "ImInfo": [im_info]},
+                     outputs={"Output": [out]}, attrs={})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_variable_for_type_inference(dtype="int32",
+                                                    stop_gradient=True)
+    dist = helper.create_variable_for_type_inference(
+        dtype=dist_matrix.dtype, stop_gradient=True)
+    helper.append_op("bipartite_match", inputs={"DistMat": [dist_matrix]},
+                     outputs={"ColToRowMatchIndices": [idx],
+                              "ColToRowMatchDist": [dist]},
+                     attrs={"match_type": match_type,
+                            "dist_threshold": dist_threshold})
+    return idx, dist
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None):
+    helper = LayerHelper("yolo_box", name=name)
+    attrs = {"anchors": list(anchors), "class_num": class_num,
+             "conf_thresh": conf_thresh, "downsample_ratio": downsample_ratio,
+             "clip_bbox": clip_bbox}
+    return _two_out(helper, "yolo_box",
+                    {"X": [x], "ImgSize": [img_size]}, attrs,
+                    ["Boxes", "Scores"])
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.01, nms_top_k=400,
+                   keep_top_k=200, nms_threshold=0.3, normalized=True,
+                   background_label=0, name=None):
+    """Static-shape NMS: returns [N, keep_top_k, 6] rows of (label, score,
+    x1, y1, x2, y2) padded with label = -1 (the reference returns LoD)."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(dtype=bboxes.dtype,
+                                                    stop_gradient=True)
+    helper.append_op("multiclass_nms",
+                     inputs={"BBoxes": [bboxes], "Scores": [scores]},
+                     outputs={"Out": [out]},
+                     attrs={"score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                            "nms_threshold": nms_threshold,
+                            "normalized": normalized,
+                            "background_label": background_label})
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, name=None):
+    """SSD post-processing (reference detection.py detection_output):
+    decode loc vs priors, then multiclass NMS.  loc [N, M, 4];
+    scores [N, M, C] (softmax-ed); prior_box [M, 4]."""
+    from . import nn
+
+    # loc [N, M, 4] with priors [M, 4]: priors broadcast over the batch
+    # axis, which is decode axis=0 (prior matches the second-to-last dim)
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size", axis=0)
+    scores_t = nn.transpose(scores, [0, 2, 1])  # [N, C, M]
+    return multiclass_nms(decoded, scores_t,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_batch_idx=None,
+              name=None):
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_idx is not None:
+        inputs["RoisBatchIdx"] = [rois_batch_idx]
+    helper.append_op("roi_align", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale,
+                            "sampling_ratio": sampling_ratio})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             rois_batch_idx=None, name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    argmax = helper.create_variable_for_type_inference(dtype="int32",
+                                                       stop_gradient=True)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_idx is not None:
+        inputs["RoisBatchIdx"] = [rois_batch_idx]
+    helper.append_op("roi_pool", inputs=inputs,
+                     outputs={"Out": [out], "Argmax": [argmax]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0.0, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    weight = helper.create_variable_for_type_inference(dtype=input.dtype,
+                                                       stop_gradient=True)
+    inputs = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        inputs["NegIndices"] = [negative_indices]
+    helper.append_op("target_assign", inputs=inputs,
+                     outputs={"Out": [out], "OutWeight": [weight]},
+                     attrs={"mismatch_value": mismatch_value})
+    return out, weight

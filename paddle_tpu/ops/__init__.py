@@ -16,5 +16,6 @@ from . import sequence_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import structured_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
 from . import amp_ops  # noqa: F401
 from . import dist_ops  # noqa: F401

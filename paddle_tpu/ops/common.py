@@ -37,6 +37,28 @@ def op_rng_key(ctx, attrs):
     return k
 
 
+def length_mask(length, t):
+    """[B, T] bool mask of valid time positions from lengths [B]; None →
+    None.  Single home for the dense-sequence masking convention (used by
+    sequence/rnn/structured op families)."""
+    if length is None:
+        return None
+    return jnp.arange(t)[None, :] < jnp.reshape(length, (-1, 1)).astype(jnp.int32)
+
+
+_ACT_ENUM = {0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}
+
+
+def act_attr(val, default):
+    """Normalize an activation attr that may be a string or the reference's
+    int enum (gru_unit_op.cc ActType) to a canonical string name."""
+    if val is None:
+        return default
+    if isinstance(val, str):
+        return val
+    return _ACT_ENUM.get(int(val), default)
+
+
 def bcast_to(y, x, axis):
     """Reference elementwise broadcast semantics (elementwise_op_function.h):
     Y's dims align with X's starting at `axis`; axis=-1 means right-aligned

@@ -1,0 +1,566 @@
+"""Detection op family (reference paddle/fluid/operators/detection/, 15.3k
+LoC, 40+ ops): prior_box, density_prior_box, anchor_generator, box_coder,
+iou_similarity, box_clip, bipartite_match, yolo_box, multiclass_nms,
+roi_align, roi_pool, target_assign.
+
+TPU-native redesign notes:
+- Anchor/prior generation depends only on static attrs + static feature-map
+  shape, so it is computed with numpy at trace time and folded into the
+  compiled program as a constant — zero device work per step.
+- The reference's multiclass_nms emits a LoD tensor of variable length
+  (multiclass_nms_op.cc); XLA needs static shapes, so ours returns a fixed
+  [N, keep_top_k, 6] tensor padded with label = -1 rows.  NMS suppression is
+  a `lax.scan` over score-sorted candidates (greedy, same result order).
+- roi_pool's quantized-bin max is realised by sampling a fixed grid per bin
+  (nearest-neighbour gather + max) — static shapes, same accuracy regime as
+  the roi_align sampling trick.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.fluid.registry import simple_op
+
+_NEG = -1e30
+
+
+def _expand_aspect_ratios(ars, flip):
+    """prior_box_op.h:28 ExpandAspectRatios: prepend 1.0, dedupe, add 1/ar
+    when flip."""
+    out = [1.0]
+    for ar in ars:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+def _prior_boxes_np(fh, fw, img_h, img_w, attrs):
+    """Trace-time numpy generation (prior_box_op.h:100-164)."""
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = _expand_aspect_ratios(attrs.get("aspect_ratios", [1.0]),
+                                bool(attrs.get("flip", False)))
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or img_w / fw
+    step_h = float(attrs.get("step_h", 0.0)) or img_h / fh
+    offset = float(attrs.get("offset", 0.5))
+    clip = bool(attrs.get("clip", False))
+    mm_order = bool(attrs.get("min_max_aspect_ratios_order", False))
+
+    boxes = []
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+
+            def emit(bw, bh):
+                boxes.append([(cx - bw) / img_w, (cy - bh) / img_h,
+                              (cx + bw) / img_w, (cy + bh) / img_h])
+
+            for s, mn in enumerate(min_sizes):
+                if mm_order:
+                    emit(mn / 2.0, mn / 2.0)
+                    if max_sizes:
+                        sq = np.sqrt(mn * max_sizes[s]) / 2.0
+                        emit(sq, sq)
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        emit(mn * np.sqrt(ar) / 2.0, mn / np.sqrt(ar) / 2.0)
+                else:
+                    for ar in ars:
+                        emit(mn * np.sqrt(ar) / 2.0, mn / np.sqrt(ar) / 2.0)
+                    if max_sizes:
+                        sq = np.sqrt(mn * max_sizes[s]) / 2.0
+                        emit(sq, sq)
+    num_priors = len(ars) * len(min_sizes) + len(max_sizes)
+    arr = np.asarray(boxes, np.float32).reshape(fh, fw, num_priors, 4)
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          (fh, fw, num_priors, 4)).copy()
+    return arr, var
+
+
+@simple_op("prior_box", ["Input", "Image"], ["Boxes", "Variances"], grad=None)
+def _prior_box(ctx, feat, image, attrs):
+    """SSD prior boxes [H, W, num_priors, 4] (normalized corners)."""
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    boxes, var = _prior_boxes_np(fh, fw, img_h, img_w, attrs)
+    return jnp.asarray(boxes), jnp.asarray(var)
+
+
+@simple_op("density_prior_box", ["Input", "Image"], ["Boxes", "Variances"],
+           grad=None)
+def _density_prior_box(ctx, feat, image, attrs):
+    """Densified priors (density_prior_box_op.h): for each fixed_size with
+    density d, a d×d shifted grid of boxes per cell, scaled by fixed_ratios."""
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(d) for d in attrs.get("densities", [1] * len(fixed_sizes))]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or img_w / fw
+    step_h = float(attrs.get("step_h", 0.0)) or img_h / fh
+    offset = float(attrs.get("offset", 0.5))
+    clip = bool(attrs.get("clip", False))
+
+    boxes = []
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            for size, density in zip(fixed_sizes, densities):
+                for ratio in fixed_ratios:
+                    bw = size * np.sqrt(ratio)
+                    bh = size / np.sqrt(ratio)
+                    shift = size / density
+                    for di in range(density):
+                        for dj in range(density):
+                            c_x = cx - size / 2.0 + shift / 2.0 + dj * shift
+                            c_y = cy - size / 2.0 + shift / 2.0 + di * shift
+                            boxes.append([(c_x - bw / 2.0) / img_w,
+                                          (c_y - bh / 2.0) / img_h,
+                                          (c_x + bw / 2.0) / img_w,
+                                          (c_y + bh / 2.0) / img_h])
+    n_pr = sum(d * d for d in densities) * len(fixed_ratios)
+    arr = np.asarray(boxes, np.float32).reshape(fh, fw, n_pr, 4)
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          (fh, fw, n_pr, 4)).copy()
+    return jnp.asarray(arr), jnp.asarray(var)
+
+
+@simple_op("anchor_generator", ["Input"], ["Anchors", "Variances"], grad=None)
+def _anchor_generator(ctx, feat, attrs):
+    """RPN anchors (anchor_generator_op.h): per cell, len(sizes) *
+    len(aspect_ratios) anchors in UNNORMALIZED corner coords."""
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [64.0, 128.0, 256.0])]
+    ars = [float(r) for r in attrs.get("aspect_ratios", [0.5, 1.0, 2.0])]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(s) for s in attrs.get("stride", [16.0, 16.0])]
+    offset = float(attrs.get("offset", 0.5))
+
+    anchors = []
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * stride[0]
+            cy = (h + offset) * stride[1]
+            for ar in ars:
+                for size in sizes:
+                    area = stride[0] * stride[1]
+                    area_ratios = area / ar
+                    base_w = np.round(np.sqrt(area_ratios))
+                    base_h = np.round(base_w * ar)
+                    scale_w = size / stride[0]
+                    scale_h = size / stride[1]
+                    half_w = 0.5 * scale_w * base_w
+                    half_h = 0.5 * scale_h * base_h
+                    anchors.append([cx - half_w, cy - half_h,
+                                    cx + half_w, cy + half_h])
+    n_anchors = len(sizes) * len(ars)
+    arr = np.asarray(anchors, np.float32).reshape(fh, fw, n_anchors, 4)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          (fh, fw, n_anchors, 4)).copy()
+    return jnp.asarray(arr), jnp.asarray(var)
+
+
+def _iou_matrix(x, y, normalized=True):
+    """x [N,4], y [M,4] corner boxes → IoU [N,M] (iou_similarity_op.h)."""
+    eps = 0.0 if normalized else 1.0
+    area_x = jnp.maximum(x[:, 2] - x[:, 0] + eps, 0) * \
+        jnp.maximum(x[:, 3] - x[:, 1] + eps, 0)
+    area_y = jnp.maximum(y[:, 2] - y[:, 0] + eps, 0) * \
+        jnp.maximum(y[:, 3] - y[:, 1] + eps, 0)
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt + eps, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_x[:, None] + area_y[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@simple_op("iou_similarity", ["X", "Y"], ["Out"], grad=None)
+def _iou_similarity(ctx, x, y, attrs):
+    x2 = jnp.reshape(x, (-1, 4))
+    y2 = jnp.reshape(y, (-1, 4))
+    return _iou_matrix(x2, y2, bool(attrs.get("box_normalized", True)))
+
+
+@simple_op("box_coder", ["PriorBox", "PriorBoxVar", "TargetBox"],
+           ["OutputBox"], optional=("PriorBoxVar",), grad=None)
+def _box_coder(ctx, prior, prior_var, target, attrs):
+    """encode/decode_center_size (box_coder_op.h).  prior [M,4] corners;
+    encode: target [N,4] → [N,M,4]; decode: target [N,M,4] → [N,M,4]
+    (axis=0; the reference's axis=1 swaps the broadcast side)."""
+    code_type = attrs.get("code_type", "encode_center_size")
+    normalized = bool(attrs.get("box_normalized", True))
+    axis = int(attrs.get("axis", 0))
+    variance_attr = attrs.get("variance", [])
+    eps = 0.0 if normalized else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + eps
+    ph = prior[:, 3] - prior[:, 1] + eps
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if prior_var is not None:
+        var = prior_var  # [M,4]
+    elif variance_attr:
+        var = jnp.broadcast_to(jnp.asarray(variance_attr, prior.dtype),
+                               prior.shape)
+    else:
+        var = jnp.ones_like(prior)
+
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0] + eps
+        th = target[:, 3] - target[:, 1] + eps
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / var[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / var[None, :, 1]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :])) / var[None, :, 2]
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :])) / var[None, :, 3]
+        return jnp.stack([ox, oy, ow, oh], axis=-1)
+
+    # decode: target [N, M, 4] (axis=0) or [M, N, 4]-broadcast (axis=1)
+    if axis == 0:
+        pw_, ph_, pcx_, pcy_, var_ = (pw[None, :], ph[None, :], pcx[None, :],
+                                      pcy[None, :], var[None, :, :])
+    else:
+        pw_, ph_, pcx_, pcy_, var_ = (pw[:, None], ph[:, None], pcx[:, None],
+                                      pcy[:, None], var[:, None, :])
+    tcx = var_[..., 0] * target[..., 0] * pw_ + pcx_
+    tcy = var_[..., 1] * target[..., 1] * ph_ + pcy_
+    tw = jnp.exp(var_[..., 2] * target[..., 2]) * pw_
+    th = jnp.exp(var_[..., 3] * target[..., 3]) * ph_
+    return jnp.stack([tcx - tw * 0.5, tcy - th * 0.5,
+                      tcx + tw * 0.5 - eps, tcy + th * 0.5 - eps], axis=-1)
+
+
+@simple_op("box_clip", ["Input", "ImInfo"], ["Output"], grad=None)
+def _box_clip(ctx, boxes, im_info, attrs):
+    """Clip boxes to image bounds (box_clip_op.h).  ImInfo [B, 3] =
+    (h, w, scale); boxes [B, M, 4]."""
+    h = im_info[:, 0] / im_info[:, 2] - 1.0
+    w = im_info[:, 1] / im_info[:, 2] - 1.0
+    shape = (-1,) + (1,) * (boxes.ndim - 2)
+    h = jnp.reshape(h, shape)
+    w = jnp.reshape(w, shape)
+    x1 = jnp.clip(boxes[..., 0], 0, w)
+    y1 = jnp.clip(boxes[..., 1], 0, h)
+    x2 = jnp.clip(boxes[..., 2], 0, w)
+    y2 = jnp.clip(boxes[..., 3], 0, h)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+@simple_op("bipartite_match", ["DistMat"], ["ColToRowMatchIndices",
+                                            "ColToRowMatchDist"], grad=None)
+def _bipartite_match(ctx, dist, attrs):
+    """Greedy bipartite matching (bipartite_match_op.cc): repeatedly take
+    the global max of the [N, M] distance matrix, match that (row, col),
+    null its row+col; afterwards 'per_prediction' matches leftover columns
+    to their argmax row when dist > overlap_threshold.
+
+    Dense batched redesign: dist [B, N, M]; outputs [B, M] int32/float."""
+    match_type = attrs.get("match_type", "bipartite")
+    thresh = float(attrs.get("dist_threshold", 0.5))
+    if dist.ndim == 2:
+        dist = dist[None]
+        squeeze = True
+    else:
+        squeeze = False
+    b, n, m = dist.shape
+    d0 = dist.astype(jnp.float32)
+
+    def one_round(state, _):
+        d, match_idx, match_dist = state
+        flat = jnp.reshape(d, (b, n * m))
+        best = jnp.argmax(flat, axis=1)
+        best_val = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        r = (best // m).astype(jnp.int32)
+        c = (best % m).astype(jnp.int32)
+        do = best_val > _NEG / 2  # still a real entry
+        match_idx = jnp.where(
+            do[:, None] & (jnp.arange(m)[None, :] == c[:, None]),
+            r[:, None], match_idx)
+        match_dist = jnp.where(
+            do[:, None] & (jnp.arange(m)[None, :] == c[:, None]),
+            best_val[:, None].astype(jnp.float32), match_dist)
+        # null out matched row and col
+        d = jnp.where(do[:, None, None] &
+                      ((jnp.arange(n)[None, :, None] == r[:, None, None]) |
+                       (jnp.arange(m)[None, None, :] == c[:, None, None])),
+                      _NEG, d)
+        return (d, match_idx, match_dist), None
+
+    init = (d0, jnp.full((b, m), -1, jnp.int32), jnp.zeros((b, m), jnp.float32))
+    (d_fin, match_idx, match_dist), _ = lax.scan(one_round, init, None,
+                                                 length=min(n, m))
+    if match_type == "per_prediction":
+        row_best = jnp.argmax(d0, axis=1).astype(jnp.int32)      # [B, M]
+        row_val = jnp.max(d0, axis=1)
+        fill = (match_idx < 0) & (row_val > thresh)
+        match_idx = jnp.where(fill, row_best, match_idx)
+        match_dist = jnp.where(fill, row_val.astype(jnp.float32), match_dist)
+    if squeeze:
+        return match_idx[0], match_dist[0]
+    return match_idx, match_dist
+
+
+@simple_op("yolo_box", ["X", "ImgSize"], ["Boxes", "Scores"], grad=None)
+def _yolo_box(ctx, x, img_size, attrs):
+    """Decode YOLOv3 head (yolo_box_op.h): X [N, A*(5+C), H, W] →
+    Boxes [N, A*H*W, 4] (corner, image scale), Scores [N, A*H*W, C]."""
+    anchors = [int(a) for a in attrs["anchors"]]
+    class_num = int(attrs["class_num"])
+    conf_thresh = float(attrs.get("conf_thresh", 0.01))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    clip_bbox = bool(attrs.get("clip_bbox", True))
+    na = len(anchors) // 2
+    n, _, h, w = x.shape
+    input_h = downsample * h
+    input_w = downsample * w
+
+    x = jnp.reshape(x, (n, na, 5 + class_num, h, w))
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + gy) / h
+    bw = jnp.exp(x[:, :, 2]) * aw / input_w
+    bh = jnp.exp(x[:, :, 3]) * ah / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    # below conf_thresh → zeroed (yolo_box_op.h keeps box but zero score)
+    probs = jnp.where(conf[:, :, None] > conf_thresh, probs, 0.0)
+
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2.0) * img_w
+    y1 = (by - bh / 2.0) * img_h
+    x2 = (bx + bw / 2.0) * img_w
+    y2 = (by + bh / 2.0) * img_h
+    if clip_bbox:
+        x1 = jnp.maximum(x1, 0.0)
+        y1 = jnp.maximum(y1, 0.0)
+        x2 = jnp.minimum(x2, img_w - 1.0)
+        y2 = jnp.minimum(y2, img_h - 1.0)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [N, A, H, W, 4]
+    boxes = jnp.reshape(boxes, (n, na * h * w, 4))
+    scores = jnp.transpose(probs, (0, 1, 3, 4, 2))
+    scores = jnp.reshape(scores, (n, na * h * w, class_num))
+    return boxes.astype(jnp.float32), scores.astype(jnp.float32)
+
+
+def _nms_keep(boxes, scores, iou_thresh, top_k, normalized=True):
+    """Greedy NMS over score-sorted candidates.  Returns (idx [top_k],
+    keep mask [top_k]) into the original M boxes."""
+    m = boxes.shape[0]
+    k = min(top_k, m)
+    top_scores, order = lax.top_k(scores, k)
+    cand = boxes[order]  # [k, 4]
+    iou = _iou_matrix(cand, cand, normalized)
+
+    def step(kept, i):
+        # suppressed if a higher-scoring kept candidate overlaps too much
+        over = (iou[i] > iou_thresh) & kept & (jnp.arange(k) < i)
+        keep_i = ~jnp.any(over) & (top_scores[i] > _NEG / 2)
+        kept = kept.at[i].set(keep_i)
+        return kept, keep_i
+
+    kept, _ = lax.scan(step, jnp.zeros((k,), bool), jnp.arange(k))
+    return order, kept, top_scores
+
+
+@simple_op("multiclass_nms", ["BBoxes", "Scores"], ["Out"], grad=None)
+def _multiclass_nms(ctx, bboxes, scores, attrs):
+    """Per-class NMS + cross-class top-k (multiclass_nms_op.cc).
+
+    bboxes [N, M, 4]; scores [N, C, M].  Static-shape output
+    [N, keep_top_k, 6] rows (label, score, x1, y1, x2, y2), padded with
+    label = -1 (the reference emits variable-length LoD instead)."""
+    bg = int(attrs.get("background_label", 0))
+    score_thresh = float(attrs.get("score_threshold", 0.01))
+    nms_top_k = int(attrs.get("nms_top_k", 400))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    keep_top_k = int(attrs.get("keep_top_k", 200))
+    normalized = bool(attrs.get("normalized", True))
+    n, c, m = scores.shape
+    if keep_top_k < 0:
+        keep_top_k = c * min(nms_top_k, m)
+
+    def per_image(boxes_i, scores_i):
+        # one vmapped NMS over all classes (background masked out) —
+        # compiles a single kernel instead of C copies of the scan
+        def per_class(cls_scores, cls_idx):
+            s = jnp.where((cls_scores > score_thresh) & (cls_idx != bg),
+                          cls_scores, _NEG)
+            order, kept, top_s = _nms_keep(boxes_i, s, nms_thresh, nms_top_k,
+                                           normalized)
+            final_s = jnp.where(kept & (top_s > _NEG / 2), top_s, _NEG)
+            return (final_s, jnp.full(final_s.shape, cls_idx, jnp.float32),
+                    boxes_i[order])
+
+        per_s, per_l, per_b = jax.vmap(per_class)(scores_i, jnp.arange(c))
+        cat_s = jnp.reshape(per_s, (-1,))
+        cat_l = jnp.reshape(per_l, (-1,))
+        cat_b = jnp.reshape(per_b, (-1, 4))
+        k = min(keep_top_k, cat_s.shape[0])
+        sel_s, sel_i = lax.top_k(cat_s, k)
+        valid = sel_s > _NEG / 2
+        row = jnp.concatenate(
+            [jnp.where(valid, cat_l[sel_i], -1.0)[:, None],
+             jnp.where(valid, sel_s, 0.0)[:, None],
+             jnp.where(valid[:, None], cat_b[sel_i], 0.0)], axis=1)
+        if k < keep_top_k:
+            pad = jnp.zeros((keep_top_k - k, 6), row.dtype)
+            pad = pad.at[:, 0].set(-1.0)
+            row = jnp.concatenate([row, pad], axis=0)
+        return row
+
+    return jax.vmap(per_image)(bboxes.astype(jnp.float32),
+                               scores.astype(jnp.float32))
+
+
+def _bilinear_sample(feat, ys, xs):
+    """feat [C, H, W]; ys/xs [...] float coords → [C, ...]."""
+    h, w = feat.shape[1], feat.shape[2]
+    y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    ly = jnp.clip(ys - y0, 0.0, 1.0)
+    lx = jnp.clip(xs - x0, 0.0, 1.0)
+    y0i, y1i, x0i, x1i = (y0.astype(jnp.int32), y1.astype(jnp.int32),
+                          x0.astype(jnp.int32), x1.astype(jnp.int32))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+            v10 * ly * (1 - lx) + v11 * ly * lx)
+
+
+@simple_op("roi_align", ["X", "ROIs", "RoisBatchIdx"], ["Out"],
+           optional=("RoisBatchIdx",), no_grad_inputs=("ROIs", "RoisBatchIdx"))
+def _roi_align(ctx, x, rois, batch_idx, attrs):
+    """RoIAlign (roi_align_op.h): X [N,C,H,W], ROIs [R,4] (x1,y1,x2,y2 in
+    image scale) → [R, C, ph, pw].  Average of sampling_ratio² bilinear
+    samples per bin."""
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ratio = int(attrs.get("sampling_ratio", -1))
+    if ratio <= 0:
+        ratio = 2
+    r = rois.shape[0]
+    if batch_idx is None:
+        batch_idx = jnp.zeros((r,), jnp.int32)
+    else:
+        batch_idx = jnp.reshape(batch_idx, (-1,)).astype(jnp.int32)
+
+    def one_roi(roi, bi):
+        feat = x[bi]  # [C,H,W]
+        x1, y1, x2, y2 = roi[0] * scale, roi[1] * scale, roi[2] * scale, roi[3] * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        iy = (jnp.arange(ratio, dtype=jnp.float32) + 0.5) / ratio
+        gys = y1 + (jnp.arange(ph, dtype=jnp.float32)[:, None] +
+                    iy[None, :]) * bin_h            # [ph, ratio]
+        gxs = x1 + (jnp.arange(pw, dtype=jnp.float32)[:, None] +
+                    iy[None, :]) * bin_w            # [pw, ratio]
+        ys = jnp.broadcast_to(gys[:, None, :, None], (ph, pw, ratio, ratio))
+        xs = jnp.broadcast_to(gxs[None, :, None, :], (ph, pw, ratio, ratio))
+        vals = _bilinear_sample(feat, ys, xs)       # [C, ph, pw, r, r]
+        return jnp.mean(vals, axis=(-2, -1))
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32), batch_idx).astype(x.dtype)
+
+
+@simple_op("roi_pool", ["X", "ROIs", "RoisBatchIdx"], ["Out", "Argmax"],
+           optional=("RoisBatchIdx",), no_grad_inputs=("ROIs", "RoisBatchIdx"))
+def _roi_pool(ctx, x, rois, batch_idx, attrs):
+    """RoI max pooling (roi_pool_op.h) via a fixed 4×4 nearest-neighbour
+    sample grid per bin (static-shape TPU approximation of the quantized
+    bin max)."""
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    samples = 4
+    r = rois.shape[0]
+    h, w = x.shape[2], x.shape[3]
+    if batch_idx is None:
+        batch_idx = jnp.zeros((r,), jnp.int32)
+    else:
+        batch_idx = jnp.reshape(batch_idx, (-1,)).astype(jnp.int32)
+
+    def one_roi(roi, bi):
+        feat = x[bi]
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        iy = (jnp.arange(samples, dtype=jnp.float32) + 0.5) / samples
+        gys = y1 + (jnp.arange(ph, dtype=jnp.float32)[:, None] + iy[None, :]) \
+            * (rh / ph)
+        gxs = x1 + (jnp.arange(pw, dtype=jnp.float32)[:, None] + iy[None, :]) \
+            * (rw / pw)
+        ysi = jnp.clip(gys, 0, h - 1).astype(jnp.int32)
+        xsi = jnp.clip(gxs, 0, w - 1).astype(jnp.int32)
+        ys = jnp.broadcast_to(ysi[:, None, :, None], (ph, pw, samples, samples))
+        xs = jnp.broadcast_to(xsi[None, :, None, :], (ph, pw, samples, samples))
+        vals = feat[:, ys, xs]  # [C, ph, pw, s, s]
+        return jnp.max(vals, axis=(-2, -1))
+
+    out = jax.vmap(one_roi)(rois.astype(jnp.float32), batch_idx).astype(x.dtype)
+    return out, None
+
+
+@simple_op("target_assign", ["X", "MatchIndices", "NegIndices"],
+           ["Out", "OutWeight"], optional=("NegIndices",), grad=None)
+def _target_assign(ctx, x, match_indices, neg_indices, attrs):
+    """Scatter per-row targets by match indices (target_assign_op.h):
+    X [B, N, K], MatchIndices [B, M] → Out [B, M, K] with
+    Out[b,m] = X[b, MatchIndices[b,m]] and weight 1 where matched,
+    `mismatch_value` and weight 0 where unmatched.  NegIndices [B, P]
+    (column indices padded with -1; dense form of the reference's LoD rows)
+    marks hard negatives: those columns get Out = mismatch_value but
+    weight = 1 so they contribute to the loss."""
+    mismatch = float(attrs.get("mismatch_value", 0.0))
+    idx = match_indices.astype(jnp.int32)
+    m = idx.shape[1]
+    safe = jnp.maximum(idx, 0)
+    out = jnp.take_along_axis(x, safe[:, :, None], axis=1)
+    matched = (idx >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch, x.dtype))
+    weight = matched.astype(jnp.float32)
+    if neg_indices is not None:
+        ni = neg_indices.astype(jnp.int32)
+        if ni.ndim == 1:
+            ni = ni[None]
+        # [B, M] mask of columns listed in NegIndices (-1 entries ignored)
+        neg_mask = jnp.any(
+            (ni[:, None, :] == jnp.arange(m)[None, :, None]) &
+            (ni[:, None, :] >= 0), axis=2)
+        out = jnp.where(neg_mask[:, :, None] & ~matched,
+                        jnp.asarray(mismatch, x.dtype), out)
+        weight = jnp.maximum(weight, neg_mask[:, :, None].astype(jnp.float32))
+    return out, jnp.broadcast_to(weight, out.shape).astype(x.dtype)

@@ -28,6 +28,8 @@ from jax import lax
 
 from paddle_tpu.fluid.registry import simple_op
 
+from .common import act_attr, length_mask
+
 _ACTS = {
     "sigmoid": jax.nn.sigmoid,
     "tanh": jnp.tanh,
@@ -38,13 +40,6 @@ _ACTS = {
 
 def _act(name):
     return _ACTS[name]
-
-
-def _valid_mask(length, b, t):
-    """[B, T] float-agnostic bool mask of valid positions; None → all valid."""
-    if length is None:
-        return None
-    return jnp.arange(t)[None, :] < jnp.reshape(length, (-1, 1)).astype(jnp.int32)
 
 
 def _reverse_valid(x, length):
@@ -86,7 +81,7 @@ def _lstm(ctx, x, w, bias, h0, c0, length, attrs):
 
     if is_reverse:
         x = _reverse_valid(x, length)
-    mask = _valid_mask(length, b, t)
+    mask = length_mask(length, t)
 
     def step(carry, inp):
         h_prev, c_prev = carry
@@ -131,8 +126,8 @@ def _gru(ctx, x, w, bias, h0, length, attrs):
     u/r gates from h_prev, [:, 2D:] the candidate from (r * h_prev)."""
     is_reverse = bool(attrs.get("is_reverse", False))
     origin_mode = bool(attrs.get("origin_mode", False))
-    act_gate = _act(attrs.get("gate_activation", "sigmoid"))
-    act_node = _act(attrs.get("activation", "tanh"))
+    act_gate = _act(act_attr(attrs.get("gate_activation"), "sigmoid"))
+    act_node = _act(act_attr(attrs.get("activation"), "tanh"))
 
     b, t, d3 = jnp.shape(x)
     d = d3 // 3
@@ -144,7 +139,7 @@ def _gru(ctx, x, w, bias, h0, length, attrs):
 
     if is_reverse:
         x = _reverse_valid(x, length)
-    mask = _valid_mask(length, b, t)
+    mask = length_mask(length, t)
 
     def step(h_prev, inp):
         xt, valid = inp
@@ -197,14 +192,8 @@ def _gru_unit(ctx, x, h_prev, w, bias, attrs):
     """One GRU step (gru_unit_op.h): Input [B,3D] pre-projected {u,r,c~},
     Weight [D,3D] as in the gru op.  Returns (gates, r*h_prev, h)."""
     origin_mode = bool(attrs.get("origin_mode", False))
-    act_gate = _act({1: "sigmoid", 2: "tanh", 3: "relu", 0: "identity"}.get(
-        attrs.get("gate_activation", 1), "sigmoid")
-        if not isinstance(attrs.get("gate_activation", 1), str)
-        else attrs.get("gate_activation"))
-    act_node = _act({1: "sigmoid", 2: "tanh", 3: "relu", 0: "identity"}.get(
-        attrs.get("activation", 2), "tanh")
-        if not isinstance(attrs.get("activation", 2), str)
-        else attrs.get("activation"))
+    act_gate = _act(act_attr(attrs.get("gate_activation"), "sigmoid"))
+    act_node = _act(act_attr(attrs.get("activation"), "tanh"))
     d = jnp.shape(h_prev)[-1]
     if bias is not None:
         x = x + jnp.reshape(bias, (1, -1)).astype(x.dtype)

@@ -22,13 +22,14 @@ from jax import lax
 
 from paddle_tpu.fluid.registry import simple_op
 
+from .common import length_mask
+
 _NEG = -1e30
 
 
 def _len_mask(length, b, t):
-    if length is None:
-        return jnp.ones((b, t), bool)
-    return jnp.arange(t)[None, :] < jnp.reshape(length, (-1, 1)).astype(jnp.int32)
+    m = length_mask(length, t)
+    return jnp.ones((b, t), bool) if m is None else m
 
 
 @simple_op("linear_chain_crf", ["Emission", "Transition", "Label", "Length"],
@@ -188,19 +189,34 @@ def _beam_search_decode(ctx, ids, parents, attrs):
            no_grad_inputs=("Label", "SampleWeight"))
 def _nce(ctx, x, label, w, bias, sample_weight, attrs):
     """Noise-contrastive estimation (nce_op.h:236-246): per row, logits for
-    the true classes and `num_neg_samples` uniform samples; o = sigmoid(s);
+    the true classes and `num_neg_samples` noise samples; o = sigmoid(s);
     cost = -log(o/(o+b)) for true, -log(b/(o+b)) for noise, with
-    b = q(y) * num_neg_samples and q uniform = 1/num_classes."""
+    b = q(y) * num_neg_samples.  Samplers (nce_op.h:90-117): 'uniform'
+    (q = 1/num_classes) and 'log_uniform' (Zipfian,
+    q(k) = log((k+2)/(k+1)) / log(range+1)); 'custom_dist' is rejected."""
     num_neg = int(attrs.get("num_neg_samples", 10))
     num_classes = int(attrs["num_total_classes"])
     seed = int(attrs.get("seed", 0))
+    sampler = attrs.get("sampler", "uniform")
+    if isinstance(sampler, int):
+        sampler = {0: "uniform", 1: "log_uniform"}.get(sampler, "custom_dist")
+    if sampler not in ("uniform", "log_uniform"):
+        raise NotImplementedError(
+            f"nce sampler {sampler!r} not supported (uniform / log_uniform)")
     b_sz = jnp.shape(x)[0]
     label = jnp.reshape(label, (b_sz, -1)).astype(jnp.int32)
     num_true = label.shape[1]
 
     key = jax.random.fold_in(jax.random.PRNGKey(seed),
                              jnp.asarray(ctx.step, jnp.uint32))
-    neg = jax.random.randint(key, (b_sz, num_neg), 0, num_classes)
+    if sampler == "uniform":
+        neg = jax.random.randint(key, (b_sz, num_neg), 0, num_classes)
+    else:
+        # log-uniform (Zipfian) sampling via inverse CDF:
+        # k = floor(exp(u * log(range+1))) - 1
+        u = jax.random.uniform(key, (b_sz, num_neg))
+        neg = (jnp.exp(u * np.log(num_classes + 1.0)) - 1.0).astype(jnp.int32)
+        neg = jnp.clip(neg, 0, num_classes - 1)
     samples = jnp.concatenate([label, neg], axis=1)  # [B, num_true+num_neg]
 
     ws = w[samples]                                   # [B, S, D]
@@ -209,7 +225,12 @@ def _nce(ctx, x, label, w, bias, sample_weight, attrs):
     if bias is not None:
         logits = logits + bias[samples].astype(jnp.float32)
     o = jax.nn.sigmoid(logits)
-    q_b = float(num_neg) / float(num_classes)  # uniform sampler probability
+    if sampler == "uniform":
+        q = jnp.full(samples.shape, 1.0 / num_classes)
+    else:
+        sf = samples.astype(jnp.float32)
+        q = jnp.log((sf + 2.0) / (sf + 1.0)) / np.log(num_classes + 1.0)
+    q_b = q * float(num_neg)  # per-sample noise mass (q(y) * num_neg)
     cost_true = -jnp.log(o / (o + q_b) + 1e-20)
     cost_noise = -jnp.log(q_b / (o + q_b) + 1e-20)
     is_true = jnp.arange(samples.shape[1])[None, :] < num_true

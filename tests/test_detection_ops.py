@@ -1,0 +1,345 @@
+"""Detection op family vs numpy references (reference analog:
+tests/unittests/test_prior_box_op.py, test_iou_similarity_op.py,
+test_box_coder_op.py, test_bipartite_match_op.py, test_yolo_box_op.py,
+test_multiclass_nms_op.py, test_roi_align_op.py)."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers
+
+
+def _run(build_fn, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        outs = build_fn()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return exe.run(main, feed=feed,
+                       fetch_list=[o.name for o in outs])
+
+
+def _np_iou(a, b):
+    area_a = np.maximum(a[2] - a[0], 0) * np.maximum(a[3] - a[1], 0)
+    area_b = np.maximum(b[2] - b[0], 0) * np.maximum(b[3] - b[1], 0)
+    ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+    ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+    union = area_a + area_b - inter
+    return inter / union if union > 0 else 0.0
+
+
+def test_prior_box_shapes_and_values():
+    feat = np.zeros((1, 8, 4, 4), "float32")
+    img = np.zeros((1, 3, 64, 64), "float32")
+
+    def build():
+        fv = fluid.data("feat", [-1, 8, 4, 4], False, dtype="float32")
+        iv = fluid.data("img", [-1, 3, 64, 64], False, dtype="float32")
+        b, v = layers.prior_box(fv, iv, min_sizes=[16.0], max_sizes=[32.0],
+                                aspect_ratios=[2.0], flip=True)
+        return [b, v]
+
+    boxes, var = _run(build, {"feat": feat, "img": img})
+    # priors per cell: len([1, 2, 0.5]) * 1 + 1 max = 4
+    assert boxes.shape == (4, 4, 4, 4)
+    assert var.shape == (4, 4, 4, 4)
+    # first box at cell (0,0): ar=1, min 16, center (8, 8) in a 64px image
+    np.testing.assert_allclose(boxes[0, 0, 0],
+                               [(8 - 8) / 64, (8 - 8) / 64,
+                                (8 + 8) / 64, (8 + 8) / 64], atol=1e-6)
+    # second: sqrt(16*32)/2 box (min_max order False → after ars)... order:
+    # ars [1, 2, .5] then max → index 3 is the max-size sqrt box
+    s = np.sqrt(16 * 32) / 2
+    np.testing.assert_allclose(boxes[0, 0, 3],
+                               [(8 - s) / 64, (8 - s) / 64,
+                                (8 + s) / 64, (8 + s) / 64], atol=1e-6)
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2], atol=1e-6)
+
+
+def test_density_prior_box_count():
+    feat = np.zeros((1, 8, 2, 2), "float32")
+    img = np.zeros((1, 3, 32, 32), "float32")
+
+    def build():
+        fv = fluid.data("feat", [-1, 8, 2, 2], False, dtype="float32")
+        iv = fluid.data("img", [-1, 3, 32, 32], False, dtype="float32")
+        b, v = layers.density_prior_box(
+            fv, iv, densities=[2], fixed_sizes=[16.0], fixed_ratios=[1.0])
+        return [b, v]
+
+    boxes, _ = _run(build, {"feat": feat, "img": img})
+    assert boxes.shape == (2, 2, 4, 4)  # density 2 → 4 boxes per cell
+
+
+def test_iou_similarity_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = np.abs(rng.uniform(0, 1, (5, 4))).astype("float32")
+    x[:, 2:] = x[:, :2] + np.abs(rng.uniform(0.1, 1, (5, 2)))
+    y = np.abs(rng.uniform(0, 1, (3, 4))).astype("float32")
+    y[:, 2:] = y[:, :2] + np.abs(rng.uniform(0.1, 1, (3, 2)))
+
+    def build():
+        xv = fluid.data("x", [-1, 4], False, dtype="float32")
+        yv = fluid.data("y", [-1, 4], False, dtype="float32")
+        return [layers.iou_similarity(xv, yv)]
+
+    (iou,), = _run(build, {"x": x, "y": y}),
+    expect = np.array([[_np_iou(a, b) for b in y] for a in x])
+    np.testing.assert_allclose(iou, expect, atol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(1)
+    m, n = 4, 3
+    prior = rng.uniform(0, 0.5, (m, 4)).astype("float32")
+    prior[:, 2:] = prior[:, :2] + rng.uniform(0.1, 0.5, (m, 2))
+    var = np.full((m, 4), 0.1, "float32")
+    gt = rng.uniform(0, 0.5, (n, 4)).astype("float32")
+    gt[:, 2:] = gt[:, :2] + rng.uniform(0.1, 0.5, (n, 2))
+
+    def build():
+        pv = fluid.data("prior", [-1, 4], False, dtype="float32")
+        vv = fluid.data("var", [-1, 4], False, dtype="float32")
+        gv = fluid.data("gt", [-1, 4], False, dtype="float32")
+        enc = layers.box_coder(pv, vv, gv, code_type="encode_center_size")
+        dec = layers.box_coder(pv, vv, enc, code_type="decode_center_size",
+                               axis=0)
+        return [enc, dec]
+
+    enc, dec = _run(build, {"prior": prior, "var": var, "gt": gt})
+    assert enc.shape == (n, m, 4)
+    # decode(encode(gt)) must reproduce gt for every prior
+    for j in range(m):
+        np.testing.assert_allclose(dec[:, j, :], gt, atol=1e-4)
+
+
+def test_box_clip():
+    boxes = np.array([[[-5.0, -5.0, 80.0, 90.0]]], "float32")
+    im_info = np.array([[60.0, 70.0, 1.0]], "float32")
+
+    def build():
+        bv = fluid.data("b", [-1, 1, 4], False, dtype="float32")
+        iv = fluid.data("i", [-1, 3], False, dtype="float32")
+        return [layers.box_clip(bv, iv)]
+
+    (out,), = _run(build, {"b": boxes, "i": im_info}),
+    np.testing.assert_allclose(out[0, 0], [0, 0, 69, 59], atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    # classic example: global max first, then next-best excluding used
+    dist = np.array([[[0.1, 0.9, 0.3],
+                      [0.8, 0.2, 0.4]]], "float32")
+
+    def build():
+        dv = fluid.data("d", [-1, 2, 3], False, dtype="float32")
+        idx, d = layers.bipartite_match(dv)
+        return [idx, d]
+
+    idx, d = _run(build, {"d": dist})
+    # 0.9 at (0,1) first; then 0.8 at (1,0); col 2 unmatched
+    np.testing.assert_array_equal(idx[0], [1, 0, -1])
+    np.testing.assert_allclose(d[0], [0.8, 0.9, 0.0], atol=1e-6)
+
+
+def test_bipartite_match_per_prediction():
+    dist = np.array([[[0.1, 0.9, 0.6],
+                      [0.8, 0.2, 0.65]]], "float32")
+
+    def build():
+        dv = fluid.data("d", [-1, 2, 3], False, dtype="float32")
+        idx, d = layers.bipartite_match(dv, match_type="per_prediction",
+                                        dist_threshold=0.5)
+        return [idx, d]
+
+    idx, d = _run(build, {"d": dist})
+    # bipartite: (0,1)=0.9, (1,0)=0.8; col 2 row-argmax=1 (0.65>0.5) → filled
+    np.testing.assert_array_equal(idx[0], [1, 0, 1])
+    np.testing.assert_allclose(d[0], [0.8, 0.9, 0.65], atol=1e-6)
+
+
+def test_yolo_box_decodes():
+    rng = np.random.RandomState(2)
+    n, na, c, h, w = 1, 2, 3, 2, 2
+    x = rng.uniform(-1, 1, (n, na * (5 + c), h, w)).astype("float32")
+    img_size = np.array([[64, 64]], "int32")
+    anchors = [10, 14, 23, 27]
+
+    def build():
+        xv = fluid.data("x", [-1, na * (5 + c), h, w], False, dtype="float32")
+        iv = fluid.data("im", [-1, 2], False, dtype="int32")
+        b, s = layers.yolo_box(xv, iv, anchors=anchors, class_num=c,
+                               conf_thresh=0.0, downsample_ratio=32)
+        return [b, s]
+
+    boxes, scores = _run(build, {"x": x, "im": img_size})
+    assert boxes.shape == (n, na * h * w, 4)
+    assert scores.shape == (n, na * h * w, c)
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    # check the (anchor 0, cell (0,0)) box by hand
+    xr = x.reshape(n, na, 5 + c, h, w)
+    bx = (sig(xr[0, 0, 0, 0, 0]) + 0) / w
+    by = (sig(xr[0, 0, 1, 0, 0]) + 0) / h
+    bw = np.exp(xr[0, 0, 2, 0, 0]) * anchors[0] / (32 * w)
+    bh = np.exp(xr[0, 0, 3, 0, 0]) * anchors[1] / (32 * h)
+    x1 = max((bx - bw / 2) * 64, 0)
+    y1 = max((by - bh / 2) * 64, 0)
+    np.testing.assert_allclose(boxes[0, 0, :2], [x1, y1], atol=1e-4)
+    conf = sig(xr[0, 0, 4, 0, 0])
+    np.testing.assert_allclose(scores[0, 0],
+                               sig(xr[0, 0, 5:, 0, 0]) * conf, atol=1e-5)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # 3 boxes: two heavily overlapping (keep best), one distinct
+    bboxes = np.array([[[0.0, 0.0, 0.4, 0.4],
+                        [0.02, 0.02, 0.42, 0.42],
+                        [0.6, 0.6, 0.9, 0.9]]], "float32")
+    # class 0 = background; class 1 scores
+    scores = np.array([[[0.0, 0.0, 0.0],
+                        [0.9, 0.85, 0.8]]], "float32")
+
+    def build():
+        bv = fluid.data("b", [-1, 3, 4], False, dtype="float32")
+        sv = fluid.data("s", [-1, 2, 3], False, dtype="float32")
+        return [layers.multiclass_nms(bv, sv, score_threshold=0.1,
+                                      nms_threshold=0.5, keep_top_k=3)]
+
+    (out,), = _run(build, {"b": bboxes, "s": scores}),
+    assert out.shape == (1, 3, 6)
+    labels = out[0, :, 0]
+    kept = labels >= 0
+    assert kept.sum() == 2  # overlap suppressed
+    np.testing.assert_allclose(out[0, 0, 1], 0.9, atol=1e-6)  # best first
+    np.testing.assert_allclose(out[0, 0, 2:], [0, 0, 0.4, 0.4], atol=1e-5)
+    np.testing.assert_allclose(out[0, 1, 1], 0.8, atol=1e-6)
+    assert labels[2] == -1  # padding row
+
+
+def test_roi_align_constant_region():
+    # constant feature → pooled output equals the constant
+    x = np.full((1, 2, 8, 8), 3.0, "float32")
+    rois = np.array([[0.0, 0.0, 7.0, 7.0]], "float32")
+
+    def build():
+        xv = fluid.data("x", [-1, 2, 8, 8], False, dtype="float32")
+        rv = fluid.data("rois", [-1, 4], False, dtype="float32")
+        return [layers.roi_align(xv, rv, pooled_height=2, pooled_width=2,
+                                 spatial_scale=1.0, sampling_ratio=2)]
+
+    (out,), = _run(build, {"x": x, "rois": rois}),
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.0, atol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    rng = np.random.RandomState(3)
+    x = rng.uniform(0, 1, (1, 2, 8, 8)).astype("float32")
+    rois = np.array([[1.0, 1.0, 6.0, 6.0]], "float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 2, 8, 8], False, dtype="float32")
+        xv.stop_gradient = False
+        rv = fluid.data("rois", [-1, 4], False, dtype="float32")
+        pooled = layers.roi_align(xv, rv, pooled_height=2, pooled_width=2)
+        loss = layers.reduce_mean(pooled)
+        from paddle_tpu.fluid import backward
+        backward.append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"x": x, "rois": rois},
+                       fetch_list=["x@GRAD"])
+    assert np.abs(g).sum() > 0  # bilinear weights flow into the interior
+
+
+def test_roi_pool_max_of_region():
+    x = np.zeros((1, 1, 8, 8), "float32")
+    x[0, 0, 2, 2] = 5.0
+    x[0, 0, 5, 5] = 7.0
+    rois = np.array([[0.0, 0.0, 7.0, 7.0]], "float32")
+
+    def build():
+        xv = fluid.data("x", [-1, 1, 8, 8], False, dtype="float32")
+        rv = fluid.data("rois", [-1, 4], False, dtype="float32")
+        return [layers.roi_pool(xv, rv, pooled_height=2, pooled_width=2)]
+
+    (out,), = _run(build, {"x": x, "rois": rois}),
+    # the bin containing (5,5) must see the 7.0 max
+    assert out.max() == 7.0
+
+
+def test_target_assign_gathers_and_masks():
+    x = np.arange(12, dtype="float32").reshape(1, 3, 4)
+    match = np.array([[2, -1, 0]], "int32")
+
+    def build():
+        xv = fluid.data("x", [-1, 3, 4], False, dtype="float32")
+        mv = fluid.data("m", [-1, 3], False, dtype="int32")
+        out, w = layers.target_assign(xv, mv, mismatch_value=9.0)
+        return [out, w]
+
+    out, w = _run(build, {"x": x, "m": match})
+    np.testing.assert_allclose(out[0, 0], x[0, 2])
+    np.testing.assert_allclose(out[0, 1], 9.0)
+    np.testing.assert_allclose(out[0, 2], x[0, 0])
+    np.testing.assert_allclose(w[0, 0], 1.0)
+    np.testing.assert_allclose(w[0, 1], 0.0)
+
+
+def test_detection_output_pipeline():
+    """decode + nms composed (SSD post-processing)."""
+    rng = np.random.RandomState(4)
+    m = 4
+    prior = np.array([[0.1, 0.1, 0.3, 0.3],
+                      [0.4, 0.4, 0.6, 0.6],
+                      [0.6, 0.6, 0.8, 0.8],
+                      [0.1, 0.6, 0.3, 0.8]], "float32")
+    var = np.full((m, 4), 0.1, "float32")
+    loc = np.zeros((1, m, 4), "float32")  # zero offsets → boxes = priors
+    scores = np.zeros((1, m, 2), "float32")
+    scores[0, :, 1] = [0.9, 0.8, 0.7, 0.6]
+    scores[0, :, 0] = 0.1
+
+    def build():
+        pv = fluid.data("p", [m, 4], False, dtype="float32")
+        vv = fluid.data("v", [m, 4], False, dtype="float32")
+        lv = fluid.data("l", [-1, m, 4], False, dtype="float32")
+        sv = fluid.data("s", [-1, m, 2], False, dtype="float32")
+        return [layers.detection_output(lv, sv, pv, vv,
+                                        score_threshold=0.2,
+                                        keep_top_k=4)]
+
+    (out,), = _run(build, {"p": prior, "v": var, "l": loc, "s": scores}),
+    labels = out[0, :, 0]
+    assert (labels >= 0).sum() == 4  # no overlap → all 4 kept
+    np.testing.assert_allclose(out[0, 0, 1], 0.9, atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 2:], prior[0], atol=1e-4)
+
+
+def test_target_assign_negative_indices_get_weight():
+    x = np.arange(12, dtype="float32").reshape(1, 3, 4)
+    match = np.array([[2, -1, -1]], "int32")
+    neg = np.array([[1, -1]], "int32")  # column 1 is a hard negative
+
+    def build():
+        xv = fluid.data("x", [-1, 3, 4], False, dtype="float32")
+        mv = fluid.data("m", [-1, 3], False, dtype="int32")
+        nv = fluid.data("n", [-1, 2], False, dtype="int32")
+        out, w = layers.target_assign(xv, mv, negative_indices=nv,
+                                      mismatch_value=0.0)
+        return [out, w]
+
+    out, w = _run(build, {"x": x, "m": match, "n": neg})
+    np.testing.assert_allclose(w[0, 0], 1.0)   # matched
+    np.testing.assert_allclose(w[0, 1], 1.0)   # hard negative: weight 1
+    np.testing.assert_allclose(out[0, 1], 0.0)  # ... with mismatch value
+    np.testing.assert_allclose(w[0, 2], 0.0)   # unmatched, not negative

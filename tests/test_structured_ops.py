@@ -286,3 +286,36 @@ def test_hsigmoid_decreases_with_training():
             (c1,) = exe.run(main, feed={"x": x, "lbl": lbl},
                             fetch_list=[loss.name])
     assert float(c1) < float(c0)
+
+
+def test_nce_log_uniform_sampler():
+    rng = np.random.RandomState(9)
+    b, d, classes = 8, 6, 50
+    x = rng.uniform(-1, 1, (b, d)).astype("float32")
+    lbl = rng.randint(0, classes, (b, 1)).astype("int64")
+
+    def build():
+        xv = fluid.data("x", [-1, d], False, dtype="float32")
+        lv = fluid.data("lbl", [-1, 1], False, dtype="int64")
+        return layers.nce(xv, lv, num_total_classes=classes,
+                          num_neg_samples=5, seed=3, sampler="log_uniform")
+
+    (cost,), _ = _run(build, {"x": x, "lbl": lbl}, lambda o: [o.name])
+    assert cost.shape == (b, 1) and np.all(np.isfinite(cost))
+
+
+def test_nce_custom_dist_rejected():
+    import pytest
+
+    rng = np.random.RandomState(10)
+    x = rng.uniform(-1, 1, (4, 6)).astype("float32")
+    lbl = rng.randint(0, 10, (4, 1)).astype("int64")
+
+    def build():
+        xv = fluid.data("x", [-1, 6], False, dtype="float32")
+        lv = fluid.data("lbl", [-1, 1], False, dtype="int64")
+        return layers.nce(xv, lv, num_total_classes=10,
+                          sampler="custom_dist")
+
+    with pytest.raises(Exception, match="custom_dist"):
+        _run(build, {"x": x, "lbl": lbl}, lambda o: [o.name])

@@ -1,5 +1,6 @@
 """fluid.contrib — incubating APIs (reference python/paddle/fluid/contrib/)."""
 
 from . import mixed_precision  # noqa: F401
+from . import slim  # noqa: F401
 
-__all__ = ["mixed_precision"]
+__all__ = ["mixed_precision", "slim"]

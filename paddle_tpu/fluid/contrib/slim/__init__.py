@@ -1,0 +1,4 @@
+"""Model-compression toolkit (reference python/paddle/fluid/contrib/slim/):
+quantization-aware training passes.  See quantization.py."""
+
+from . import quantization  # noqa: F401

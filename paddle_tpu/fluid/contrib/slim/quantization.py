@@ -1,0 +1,165 @@
+"""Quantization-aware training passes (reference
+python/paddle/fluid/contrib/slim/quantization/quantization_pass.py:
+QuantizationTransformPass / QuantizationFreezePass, applied over ir::Graph).
+
+TPU-native redesign: the rewrites operate directly on the Program (the same
+object transpilers rewrite) instead of a separate ir::Graph clone.  Weight
+quantization uses per-channel abs-max fake-quant; activations use a
+moving-average abs-max observer with persistable EMA state vars.  Everything
+stays differentiable (straight-through estimators, see ops/quant_ops.py), so
+`minimize()` on the transformed program trains int8-simulated weights.
+"""
+
+from __future__ import annotations
+
+from ... import framework
+from ...framework import unique_name
+from ...initializer import Constant
+
+_QUANTIZABLE = ("mul", "matmul", "conv2d", "depthwise_conv2d")
+# which input slots of each quantizable op carry (activation, weight)
+_SLOTS = {"mul": ("X", "Y"), "matmul": ("X", "Y"),
+          "conv2d": ("Input", "Filter"),
+          "depthwise_conv2d": ("Input", "Filter")}
+
+QUANT_SUFFIX = ".quantized"
+
+
+class QuantizationTransformPass:
+    """Insert fake-quant(+observe) ops in front of every quantizable op's
+    inputs in the main program (QAT).  weight_quantize_type:
+    'channel_wise_abs_max' | 'abs_max'; activation_quantize_type:
+    'moving_average_abs_max' | 'abs_max'."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 moving_rate=0.9, quantizable_op_type=_QUANTIZABLE):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+        self.moving_rate = moving_rate
+        self.quantizable_op_type = tuple(quantizable_op_type)
+
+    def apply(self, main_program, startup_program):
+        block = main_program.global_block()
+        # var name → name of its quantized replacement (quantize each var once)
+        quantized = {}
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type in self.quantizable_op_type:
+                act_slot, w_slot = _SLOTS[op.type]
+                # mul/matmul weights are [in, out]: per-output-channel
+                # scales live on axis 1; conv filters [C_out, ...] on axis 0
+                w_axis = 1 if op.type in ("mul", "matmul") else 0
+                for slot, is_weight in ((act_slot, False), (w_slot, True)):
+                    names = op.inputs.get(slot, [])
+                    if not names:
+                        continue
+                    name = names[0]
+                    if name not in quantized:
+                        qname, n_new = self._insert_quant(
+                            block, startup_program, i, name, is_weight,
+                            w_axis)
+                        quantized[name] = qname
+                        i += n_new
+                    op.inputs[slot] = [quantized[name]]
+            i += 1
+        return main_program
+
+    # -- helpers ---------------------------------------------------------
+    def _insert_quant(self, block, startup, index, name, is_weight,
+                      w_axis=0):
+        """Insert the fake-quant op chain before op `index`; returns
+        (quantized var name, number of ops inserted)."""
+        var = block.var(name)
+        qname = name + QUANT_SUFFIX
+        block.create_var(name=qname, shape=var.shape, dtype=var.dtype,
+                         stop_gradient=var.stop_gradient)
+        scale_name = unique_name.generate(name + ".quant_scale")
+        bits = self.weight_bits if is_weight else self.activation_bits
+        qtype = (self.weight_quantize_type if is_weight
+                 else self.activation_quantize_type)
+        if qtype == "channel_wise_abs_max":
+            n_ch = int(var.shape[w_axis])
+            scale = block.create_var(name=scale_name, shape=[n_ch],
+                                     dtype="float32", stop_gradient=True)
+            block._insert_op(
+                index, "fake_channel_wise_quantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [qname], "OutScale": [scale_name]},
+                attrs={"bit_length": bits, "quant_axis": w_axis})
+            return qname, 1
+        if qtype == "abs_max":
+            block.create_var(name=scale_name, shape=[1], dtype="float32",
+                             stop_gradient=True)
+            block._insert_op(
+                index, "fake_quantize_abs_max", inputs={"X": [name]},
+                outputs={"Out": [qname], "OutScale": [scale_name]},
+                attrs={"bit_length": bits})
+            return qname, 1
+        if qtype == "moving_average_abs_max":
+            state = self._persistable(block, startup, name + ".quant_state",
+                                      [1], 1.0)
+            accum = self._persistable(block, startup, name + ".quant_accum",
+                                      [1], 1.0)
+            in_scale = self._persistable(block, startup,
+                                         name + ".quant_in_scale", [1], 1.0)
+            block._insert_op(
+                index, "fake_quantize_moving_average_abs_max",
+                inputs={"X": [name], "InScale": [in_scale.name],
+                        "InAccum": [accum.name], "InState": [state.name]},
+                outputs={"Out": [qname], "OutScale": [in_scale.name],
+                         "OutAccum": [accum.name], "OutState": [state.name]},
+                attrs={"bit_length": bits, "moving_rate": self.moving_rate})
+            return qname, 1
+        raise ValueError(f"unknown quantize type {qtype!r}")
+
+    def _persistable(self, block, startup, name, shape, value):
+        v = block.create_var(name=name, shape=shape, dtype="float32",
+                             persistable=True, stop_gradient=True)
+        sv = startup.global_block().create_var(
+            name=name, shape=shape, dtype="float32", persistable=True)
+        Constant(value)(sv, startup.global_block())
+        return v
+
+
+class QuantizationFreezePass:
+    """Freeze a QAT program for inference (reference
+    QuantizationFreezePass): fold each weight's fake-quant into the scope by
+    materialising the quantize-dequantized weights, and pin activation
+    fake-quant ops to their learned EMA scale (is_test=True)."""
+
+    def __init__(self, scope, weight_bits=8):
+        self.scope = scope
+        self.weight_bits = weight_bits
+
+    def apply(self, program):
+        import numpy as np
+
+        block = program.global_block()
+        for op in list(block.ops):
+            if op.type in ("fake_quantize_moving_average_abs_max",
+                           "fake_quantize_range_abs_max"):
+                op.attrs["is_test"] = True
+            elif op.type in ("fake_quantize_abs_max",
+                             "fake_channel_wise_quantize_abs_max"):
+                (name,) = op.inputs["X"]
+                w = self.scope.get(name)
+                if w is None or not block.var(name).persistable:
+                    continue
+                qrange = float((1 << (self.weight_bits - 1)) - 1)
+                w = np.asarray(w, dtype=np.float32)
+                if op.type == "fake_channel_wise_quantize_abs_max":
+                    axis = int(op.attrs.get("quant_axis", 0))
+                    reduce_axes = tuple(i for i in range(w.ndim)
+                                        if i != axis)
+                    scale = np.abs(w).max(axis=reduce_axes, keepdims=True)
+                else:
+                    scale = np.abs(w).max()
+                scale = np.maximum(scale, 1e-9)
+                q = np.clip(np.round(w / scale * qrange), -qrange, qrange)
+                self.scope.set(name, (q * scale / qrange).astype(np.float32))
+        return program

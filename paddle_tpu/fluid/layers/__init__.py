@@ -29,6 +29,8 @@ from .detection import (  # noqa: F401
     iou_similarity, box_clip, bipartite_match, yolo_box, multiclass_nms,
     roi_align, roi_pool, target_assign, detection_output,
 )
+from . import metric_op
+from .metric_op import auc, edit_distance, warpctc  # noqa: F401
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (  # noqa: F401
     exponential_decay, natural_exp_decay, inverse_time_decay,

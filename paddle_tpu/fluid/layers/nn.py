@@ -40,6 +40,8 @@ __all__ = [
     "tanh", "exp", "log", "sqrt", "square", "abs", "sequence_conv",
     "sequence_pool", "sequence_softmax", "sequence_expand", "sequence_reverse",
     "sequence_first_step", "sequence_last_step", "sequence_mask",
+    "sequence_unpad", "sequence_concat", "sequence_expand_as",
+    "sequence_slice", "sequence_enumerate",
 ]
 
 
@@ -1105,6 +1107,66 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
     helper.append_op("sequence_mask", inputs={"X": [x]}, outputs={"Y": [out]},
                      attrs={"maxlen": int(maxlen), "out_dtype": dtype})
+    return out
+
+
+def sequence_unpad(x, length, name=None):
+    """Zero the padding tail (dense analog of reference sequence_unpad)."""
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("sequence_unpad", inputs={"X": [x], "Length": [length]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_concat(input, lengths=None, name=None):
+    """Row-wise concat of valid prefixes (reference sequence_concat);
+    lengths: optional list matching `input`.  Returns (out, out_lengths)
+    when lengths given, else out."""
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input[0].dtype)
+    out_len = helper.create_variable_for_type_inference("int32",
+                                                        stop_gradient=True)
+    inputs = {"X": list(input)}
+    if lengths is not None:
+        inputs["Length"] = list(lengths)
+    helper.append_op("sequence_concat", inputs=inputs,
+                     outputs={"Out": [out], "OutLength": [out_len]}, attrs={})
+    return (out, out_len) if lengths is not None else out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("sequence_expand_as", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-row time window, left-aligned and zero-padded (reference
+    sequence_slice)."""
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, length=None, name=None):
+    """Sliding id windows [B, T] → [B, T, win] (reference
+    sequence_enumerate)."""
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference("int64",
+                                                    stop_gradient=True)
+    inputs = {"X": [input]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("sequence_enumerate", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"win_size": win_size, "pad_value": pad_value})
     return out
 
 

@@ -17,5 +17,7 @@ from . import control_flow_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import structured_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import metric_ops  # noqa: F401
+from . import quant_ops  # noqa: F401
 from . import amp_ops  # noqa: F401
 from . import dist_ops  # noqa: F401

@@ -1,0 +1,235 @@
+"""Metric & sequence-distance ops: auc, precision_recall, edit_distance,
+warpctc.
+
+Reference analogs: paddle/fluid/operators/metrics/auc_op.{cc,h} (streaming
+histogram AUC), metrics/precision_recall_op.h (per-class TP/FP/TN/FN stats),
+edit_distance_op.h (Levenshtein DP), warpctc_op.cc (wraps the warp-ctc
+library).
+
+TPU-native redesign: all are dense batched computations inside the compiled
+block.  CTC is the textbook log-space alpha recursion as a `lax.scan` over
+time (no external library); edit distance is a DP wavefront scan vectorized
+over the batch.  AUC/precision_recall keep the reference's streaming-state
+design: stat buffers ride through the op (in-place updated), so parallel
+executors can psum them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.fluid.registry import simple_op
+
+_NEG = -1e30
+
+
+@simple_op("auc", ["Predict", "Label", "StatPos", "StatNeg"],
+           ["AUC", "StatPosOut", "StatNegOut"], grad=None,
+           inplace={"StatPosOut": "StatPos", "StatNegOut": "StatNeg"})
+def _auc(ctx, predict, label, stat_pos, stat_neg, attrs):
+    """Streaming AUC (auc_op.h): bucket P(class=1) into num_thresholds+1
+    bins, accumulate pos/neg histograms, integrate the requested curve
+    ('ROC' trapezoid over FPR, or 'PR' trapezoid of precision over
+    recall) by descending threshold."""
+    curve = str(attrs.get("curve", "ROC")).upper()
+    if curve not in ("ROC", "PR"):
+        raise ValueError(f"auc: unknown curve {curve!r} (ROC or PR)")
+    num_th = int(attrs.get("num_thresholds", 4095))
+    p1 = predict[:, -1].astype(jnp.float32)  # prob of positive class
+    lbl = jnp.reshape(label, (-1,)).astype(jnp.int32)
+    idx = jnp.clip((p1 * num_th).astype(jnp.int32), 0, num_th)
+    pos_hist = jnp.zeros((num_th + 1,), stat_pos.dtype).at[idx].add(
+        (lbl == 1).astype(stat_pos.dtype))
+    neg_hist = jnp.zeros((num_th + 1,), stat_neg.dtype).at[idx].add(
+        (lbl == 0).astype(stat_neg.dtype))
+    new_pos = stat_pos + pos_hist
+    new_neg = stat_neg + neg_hist
+
+    # integrate from the highest threshold down (descending bin index)
+    pos_d = jnp.flip(new_pos).astype(jnp.float64 if new_pos.dtype == jnp.int64
+                                     else jnp.float32)
+    neg_d = jnp.flip(new_neg).astype(pos_d.dtype)
+    cum_pos = jnp.cumsum(pos_d)
+    cum_neg = jnp.cumsum(neg_d)
+    tot_pos = cum_pos[-1]
+    tot_neg = cum_neg[-1]
+    prev_pos = cum_pos - pos_d
+    prev_neg = cum_neg - neg_d
+    if curve == "ROC":
+        area = jnp.sum((cum_neg - prev_neg) * (cum_pos + prev_pos) / 2.0)
+        auc = jnp.where(tot_pos * tot_neg > 0,
+                        area / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0)
+    else:  # PR: precision over recall, descending threshold
+        prec = cum_pos / jnp.maximum(cum_pos + cum_neg, 1e-9)
+        prev_prec = prev_pos / jnp.maximum(prev_pos + prev_neg, 1e-9)
+        prev_prec = jnp.where(prev_pos + prev_neg > 0, prev_prec, prec)
+        rec = cum_pos / jnp.maximum(tot_pos, 1e-9)
+        prev_rec = prev_pos / jnp.maximum(tot_pos, 1e-9)
+        area = jnp.sum((rec - prev_rec) * (prec + prev_prec) / 2.0)
+        auc = jnp.where(tot_pos > 0, area, 0.0)
+    return auc.astype(jnp.float32), new_pos, new_neg
+
+
+@simple_op("precision_recall",
+           ["MaxProbs", "Indices", "Labels", "Weights", "StatesInfo"],
+           ["BatchMetrics", "AccumMetrics", "AccumStatesInfo"],
+           optional=("MaxProbs", "Weights", "StatesInfo"), grad=None,
+           inplace={"AccumStatesInfo": "StatesInfo"})
+def _precision_recall(ctx, max_probs, indices, labels, weights, states, attrs):
+    """Per-class streaming precision/recall/F1 (precision_recall_op.h).
+    Indices [B,1] predicted class; Labels [B,1]; StatesInfo [C,4] rows of
+    (TP, FP, TN, FN).  Outputs 6-vector metrics (macro P/R/F1, micro P/R/F1)
+    for the batch and accumulated."""
+    c = int(attrs["class_number"])
+    pred = jnp.reshape(indices, (-1,)).astype(jnp.int32)
+    lbl = jnp.reshape(labels, (-1,)).astype(jnp.int32)
+    w = (jnp.reshape(weights, (-1,)).astype(jnp.float32)
+         if weights is not None else jnp.ones(pred.shape, jnp.float32))
+
+    onehot_pred = jax.nn.one_hot(pred, c, dtype=jnp.float32) * w[:, None]
+    onehot_lbl = jax.nn.one_hot(lbl, c, dtype=jnp.float32) * w[:, None]
+    tp = jnp.sum(onehot_pred * jax.nn.one_hot(lbl, c, dtype=jnp.float32),
+                 axis=0)
+    fp = jnp.sum(onehot_pred, axis=0) - tp
+    fn = jnp.sum(onehot_lbl, axis=0) - tp
+    total = jnp.sum(w)
+    tn = total - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)  # [C,4]
+
+    def metrics(st):
+        tp_, fp_, tn_, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-9), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-9), 0.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / jnp.maximum(prec + rec, 1e-9), 0.0)
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        stp, sfp, sfn = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        mic_p = jnp.where(stp + sfp > 0, stp / jnp.maximum(stp + sfp, 1e-9), 0.0)
+        mic_r = jnp.where(stp + sfn > 0, stp / jnp.maximum(stp + sfn, 1e-9), 0.0)
+        mic_f = jnp.where(mic_p + mic_r > 0,
+                          2 * mic_p * mic_r / jnp.maximum(mic_p + mic_r, 1e-9),
+                          0.0)
+        return jnp.concatenate([macro, jnp.stack([mic_p, mic_r, mic_f])])
+
+    accum_states = batch_states if states is None else \
+        states.astype(jnp.float32) + batch_states
+    return (metrics(batch_states).astype(jnp.float32),
+            metrics(accum_states).astype(jnp.float32),
+            accum_states)
+
+
+@simple_op("edit_distance", ["Hyps", "Refs", "HypsLength", "RefsLength"],
+           ["Out", "SequenceNum"], optional=("HypsLength", "RefsLength"),
+           grad=None)
+def _edit_distance(ctx, hyps, refs, hyp_len, ref_len, attrs):
+    """Levenshtein distance (edit_distance_op.h) vectorized over the batch:
+    DP over the reference axis as a lax.scan over hyp positions, inner scan
+    over ref positions (carry = left neighbour)."""
+    normalized = bool(attrs.get("normalized", False))
+    b, th = hyps.shape[0], hyps.shape[1]
+    tr = refs.shape[1]
+    hyps = hyps.astype(jnp.int32)
+    refs = refs.astype(jnp.int32)
+    hl = (jnp.reshape(hyp_len, (-1,)).astype(jnp.int32) if hyp_len is not None
+          else jnp.full((b,), th, jnp.int32))
+    rl = (jnp.reshape(ref_len, (-1,)).astype(jnp.int32) if ref_len is not None
+          else jnp.full((b,), tr, jnp.int32))
+
+    row0 = jnp.broadcast_to(jnp.arange(tr + 1, dtype=jnp.float32)[None, :],
+                            (b, tr + 1))
+
+    def outer(prev_row, i):
+        # prev_row [B, Tr+1] = DP row for hyp prefix length i
+        hi = hyps[:, i]  # [B]
+
+        def inner(left, j):
+            # left [B] = current row value at column j
+            sub = prev_row[:, j] + (hi != refs[:, j]).astype(jnp.float32)
+            val = jnp.minimum(jnp.minimum(prev_row[:, j + 1] + 1.0,
+                                          left + 1.0), sub)
+            return val, val
+
+        first = jnp.full((b,), 0.0) + (i + 1).astype(jnp.float32)
+        _, cols = lax.scan(inner, first, jnp.arange(tr))
+        new_row = jnp.concatenate([first[:, None],
+                                   jnp.swapaxes(cols, 0, 1)], axis=1)
+        return new_row, new_row
+
+    _, rows = lax.scan(outer, row0, jnp.arange(th))
+    all_rows = jnp.concatenate([row0[None], rows], axis=0)  # [Th+1, B, Tr+1]
+    # distance = DP[hyp_len, ref_len] per batch row
+    d = all_rows[hl, jnp.arange(b), :]
+    d = jnp.take_along_axis(d, rl[:, None], axis=1)[:, 0]
+    if normalized:
+        d = d / jnp.maximum(rl.astype(jnp.float32), 1.0)
+    return d[:, None].astype(jnp.float32), jnp.asarray(b, jnp.int64)
+
+
+@simple_op("warpctc", ["Logits", "Label", "LogitsLength", "LabelLength"],
+           ["WarpCTCGrad", "Loss"],
+           optional=("LogitsLength", "LabelLength"),
+           no_grad_inputs=("Label", "LogitsLength", "LabelLength"))
+def _warpctc(ctx, logits, label, logits_len, label_len, attrs):
+    """CTC loss (warpctc_op.cc semantics, computed natively): log-space
+    alpha recursion over the blank-extended label as one lax.scan over time.
+
+    Dense layout: Logits [B, T, C] raw activations (log-softmax applied
+    here), Label [B, L] padded with blank, lengths [B].  Loss [B, 1] =
+    -log p(label | logits).  WarpCTCGrad is unused (grads come from
+    vjp-of-scan); emitted as None."""
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = bool(attrs.get("norm_by_times", False))
+    b, t, c = logits.shape
+    l = label.shape[1]
+    s = 2 * l + 1
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    lbl = label.astype(jnp.int32)
+    t_len = (jnp.reshape(logits_len, (-1,)).astype(jnp.int32)
+             if logits_len is not None else jnp.full((b,), t, jnp.int32))
+    l_len = (jnp.reshape(label_len, (-1,)).astype(jnp.int32)
+             if label_len is not None else jnp.full((b,), l, jnp.int32))
+
+    # blank-extended label: [blank, l0, blank, l1, ..., blank]
+    ext = jnp.full((b, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lbl)
+    # transitions: s-1 always; s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    can_skip = jnp.zeros((b, s), bool)
+    can_skip = can_skip.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    def emit(logp_t):  # [B, C] → [B, S] log-prob of each ext symbol
+        return jnp.take_along_axis(logp_t, ext, axis=1)
+
+    neg = jnp.asarray(_NEG, jnp.float32)
+    alpha0 = jnp.full((b, s), neg)
+    alpha0 = alpha0.at[:, 0].set(emit(logp[:, 0])[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(l_len > 0, emit(logp[:, 0])[:, 1], neg))
+
+    def step(alpha, inp):
+        logp_t, t_idx = inp
+        prev1 = jnp.concatenate([jnp.full((b, 1), neg), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate([jnp.full((b, 2), neg), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, neg)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        new = merged + emit(logp_t)
+        # past each row's logit length the alphas freeze
+        live = (t_idx < t_len)[:, None]
+        return jnp.where(live, new, alpha), None
+
+    alpha_fin, _ = lax.scan(
+        step, alpha0, (jnp.swapaxes(logp, 0, 1)[1:], jnp.arange(1, t)))
+    # p(label) = alpha[2*l_len] + alpha[2*l_len - 1] at t = t_len - 1
+    idx_last = jnp.clip(2 * l_len, 0, s - 1)
+    idx_prev = jnp.clip(2 * l_len - 1, 0, s - 1)
+    a_last = jnp.take_along_axis(alpha_fin, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha_fin, idx_prev[:, None], axis=1)[:, 0]
+    # empty label: probability is all-blank path = alpha at position 0
+    loss = -jnp.where(l_len > 0, jnp.logaddexp(a_last, a_prev), a_last)
+    if norm_by_times:
+        loss = loss / jnp.maximum(t_len.astype(jnp.float32), 1.0)
+    return None, loss[:, None].astype(logits.dtype)

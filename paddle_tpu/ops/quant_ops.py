@@ -1,0 +1,158 @@
+"""Simulated-quantization ops (reference paddle/fluid/operators/
+fake_quantize_op.{cc,h} and fake_dequantize_op.cc, used by
+contrib/slim/quantization QAT passes).
+
+All are straight-through estimators: forward quantize-dequantizes
+(round(x/scale * range) * scale / range), backward passes the gradient
+through unchanged — expressed with jax.lax.stop_gradient so the auto-vjp
+grad op does the right thing without a custom grad maker.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fluid.registry import simple_op
+
+
+def _ste(x, quantized):
+    """Straight-through: forward `quantized`, gradient of identity."""
+    return x + jax.lax.stop_gradient(quantized - x)
+
+
+def _qdq(x, scale, qrange):
+    """Quantize-dequantize at the given scale (saturating)."""
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qrange), -qrange, qrange)
+    return q * s / qrange
+
+
+@simple_op("fake_quantize_abs_max", ["X"], ["Out", "OutScale"])
+def _fake_quantize_abs_max(ctx, x, attrs):
+    """scale = max|x|; simulated int<bits> quantization (fake_quantize_op.h
+    FindAbsMaxFunctor + ClipAndFakeQuantFunctor)."""
+    bits = int(attrs.get("bit_length", 8))
+    qrange = float((1 << (bits - 1)) - 1)
+    scale = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    out = _ste(x, _qdq(x.astype(jnp.float32), scale, qrange).astype(x.dtype))
+    return out, scale.reshape((1,))
+
+
+@simple_op("fake_channel_wise_quantize_abs_max", ["X"], ["Out", "OutScale"])
+def _fake_channel_wise_quantize(ctx, x, attrs):
+    """Per-channel scales along `quant_axis` — the weight-quantization
+    variant (fake_quantize_op.cc fake_channel_wise_quantize_abs_max).
+    quant_axis=0 for conv filters [C_out, ...]; quant_axis=1 for mul/matmul
+    weights [in, out] (per output column)."""
+    bits = int(attrs.get("bit_length", 8))
+    axis = int(attrs.get("quant_axis", 0))
+    qrange = float((1 << (bits - 1)) - 1)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    scales = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=reduce_axes)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    s = jnp.reshape(scales, shape)
+    out = _ste(x, _qdq(x.astype(jnp.float32), s, qrange).astype(x.dtype))
+    return out, scales
+
+
+@simple_op("fake_quantize_range_abs_max",
+           ["X", "InScale", "InScales", "Iter"],
+           ["Out", "OutScale", "OutScales", "IterOut"],
+           optional=("InScales", "Iter"),
+           no_grad_inputs=("InScale", "InScales", "Iter"),
+           inplace={"OutScale": "InScale", "OutScales": "InScales",
+                    "IterOut": "Iter"})
+def _fake_quantize_range_abs_max(ctx, x, in_scale, in_scales, it, attrs):
+    """Windowed-max scale (fake_quantize_op.h FakeQuantizeRangeAbsMax):
+    the batch abs-max is written into a circular window buffer
+    (InScales [window_size]) and the scale is the window's max — an early
+    outlier decays out after window_size steps, unlike a running max.
+    Frozen InScale in eval."""
+    bits = int(attrs.get("bit_length", 8))
+    window = int(attrs.get("window_size", 10000))
+    qrange = float((1 << (bits - 1)) - 1)
+    batch_max = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    if ctx.is_test or bool(attrs.get("is_test", False)):
+        scale = jnp.reshape(in_scale, ()).astype(jnp.float32)
+        new_scales, new_iter = in_scales, it
+    elif in_scales is not None:
+        step = (jnp.reshape(it, ()).astype(jnp.int32) if it is not None
+                else jnp.asarray(ctx.step, jnp.int32))
+        buf = jnp.reshape(in_scales, (-1,)).astype(jnp.float32)
+        buf = buf.at[step % window].set(batch_max)
+        scale = jnp.max(buf)
+        new_scales = buf
+        new_iter = (step + 1).reshape((1,)) if it is not None else it
+    else:
+        # no window buffer wired: degrade to running max
+        scale = jnp.maximum(jnp.reshape(in_scale, ()).astype(jnp.float32),
+                            batch_max)
+        new_scales, new_iter = in_scales, it
+    out = _ste(x, _qdq(x.astype(jnp.float32), scale, qrange).astype(x.dtype))
+    return out, scale.reshape((1,)), new_scales, new_iter
+
+
+@simple_op("fake_quantize_moving_average_abs_max",
+           ["X", "InScale", "InAccum", "InState"],
+           ["Out", "OutScale", "OutAccum", "OutState"],
+           optional=("InAccum", "InState"),
+           no_grad_inputs=("InScale", "InAccum", "InState"),
+           inplace={"OutScale": "InScale", "OutAccum": "InAccum",
+                    "OutState": "InState"})
+def _fake_quantize_moving_avg(ctx, x, in_scale, accum, state, attrs):
+    """EMA of batch abs-max (fake_quantize_op.h FindMovingAverageAbsMax):
+    accum = rate*accum + max|x|; state = rate*state + 1;
+    scale = accum/state."""
+    bits = int(attrs.get("bit_length", 8))
+    rate = float(attrs.get("moving_rate", 0.9))
+    qrange = float((1 << (bits - 1)) - 1)
+    batch_max = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    if ctx.is_test or bool(attrs.get("is_test", False)):
+        scale = jnp.reshape(in_scale, ()).astype(jnp.float32)
+        new_accum = accum
+        new_state = state
+    else:
+        a = (jnp.reshape(accum, ()).astype(jnp.float32)
+             if accum is not None else jnp.asarray(0.0, jnp.float32))
+        s = (jnp.reshape(state, ()).astype(jnp.float32)
+             if state is not None else jnp.asarray(0.0, jnp.float32))
+        a = rate * a + batch_max
+        s = rate * s + 1.0
+        scale = a / jnp.maximum(s, 1e-9)
+        new_accum = a.reshape((1,))
+        new_state = s.reshape((1,))
+    out = _ste(x, _qdq(x.astype(jnp.float32), scale, qrange).astype(x.dtype))
+    return out, scale.reshape((1,)), new_accum, new_state
+
+
+@simple_op("moving_average_abs_max_scale", ["X", "InAccum", "InState"],
+           ["Out", "OutScale", "OutAccum", "OutState"],
+           optional=("InAccum", "InState"),
+           no_grad_inputs=("InAccum", "InState"),
+           inplace={"OutAccum": "InAccum", "OutState": "InState"})
+def _moving_average_abs_max_scale(ctx, x, accum, state, attrs):
+    """Observe-only variant: tracks the EMA scale, passes x through.
+    Like its fake-quant sibling, the EMA state freezes in test mode so eval
+    batches don't shift the learned scale."""
+    rate = float(attrs.get("moving_rate", 0.9))
+    a = (jnp.reshape(accum, ()).astype(jnp.float32)
+         if accum is not None else jnp.asarray(0.0, jnp.float32))
+    s = (jnp.reshape(state, ()).astype(jnp.float32)
+         if state is not None else jnp.asarray(0.0, jnp.float32))
+    if not (ctx.is_test or bool(attrs.get("is_test", False))):
+        batch_max = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        a = rate * a + batch_max
+        s = rate * s + 1.0
+    scale = a / jnp.maximum(s, 1e-9)
+    return x, scale.reshape((1,)), a.reshape((1,)), s.reshape((1,))
+
+
+@simple_op("fake_dequantize_max_abs", ["X", "Scale"], ["Out"],
+           no_grad_inputs=("Scale",))
+def _fake_dequantize_max_abs(ctx, x, scale, attrs):
+    """x * scale / range (fake_dequantize_op.cc)."""
+    max_range = float(attrs.get("max_range", 127.0))
+    s = jnp.reshape(scale, ()).astype(jnp.float32)
+    return (x.astype(jnp.float32) * s / max_range).astype(x.dtype)

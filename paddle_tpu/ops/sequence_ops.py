@@ -160,3 +160,89 @@ def _sequence_pad(ctx, x, pad_value, length, attrs):
     returns lengths alongside for parity."""
     return x, (length if length is not None
                else jnp.full((jnp.shape(x)[0],), jnp.shape(x)[1], jnp.int32))
+
+
+@simple_op("sequence_unpad", ["X", "Length"], ["Out"],
+           no_grad_inputs=("Length",))
+def _sequence_unpad(ctx, x, length, attrs):
+    """Dense analog of sequence_unpad_op.cc: zero out the padding tail so
+    downstream reductions see only valid positions (the dense layout keeps
+    [B, T, ...]; true unpadding is a ragged → LoD operation)."""
+    m = _time_mask(x, length)
+    if x.ndim > 2:
+        m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    return x * m
+
+
+@simple_op("sequence_concat", ["X*", "Length*"], ["Out", "OutLength"],
+           optional=("Length",), no_grad_inputs=("Length",))
+def _sequence_concat(ctx, xs, lengths, attrs):
+    """Row-wise concat of valid prefixes (sequence_concat_op.cc LoD
+    semantics): out row b = x1[b,:len1], x2[b,:len2], ... then padding.
+    Without lengths, a plain time-axis concat."""
+    if not lengths:
+        b = jnp.shape(xs[0])[0]
+        out = jnp.concatenate(xs, axis=1)
+        return out, jnp.full((b,), jnp.shape(out)[1], jnp.int32)
+    b = jnp.shape(xs[0])[0]
+    t_out = sum(int(jnp.shape(x)[1]) for x in xs)
+    lens = [jnp.reshape(l, (-1,)).astype(jnp.int32) for l in lengths]
+    # gather source: for output position j of row b, find which input it
+    # comes from and at what offset
+    pos = jnp.arange(t_out)[None, :]                       # [1, T_out]
+    out = jnp.zeros((b, t_out) + xs[0].shape[2:], xs[0].dtype)
+    offset = jnp.zeros((b, 1), jnp.int32)
+    for x, ln in zip(xs, lens):
+        rel = pos - offset                                  # [B, T_out]
+        valid = (rel >= 0) & (rel < ln[:, None])
+        idx = jnp.clip(rel, 0, jnp.shape(x)[1] - 1)
+        gathered = jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+        v = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+        out = jnp.where(v, gathered, out)
+        offset = offset + ln[:, None]
+    return out, offset[:, 0]
+
+
+# In the dense representation sequence_expand_as and sequence_expand are the
+# same tiling; register the one lowering under both names.
+simple_op("sequence_expand_as", ["X", "Y"], ["Out"],
+          no_grad_inputs=("Y",))(_sequence_expand)
+
+
+@simple_op("sequence_slice", ["X", "Offset", "Length"], ["Out"],
+           no_grad_inputs=("Offset", "Length"))
+def _sequence_slice(ctx, x, offset, length, attrs):
+    """Per-row time window (sequence_slice_op.h): row b keeps
+    x[b, offset_b : offset_b+length_b] left-aligned, rest zero-padded."""
+    b, t = jnp.shape(x)[0], jnp.shape(x)[1]
+    off = jnp.reshape(offset, (-1,)).astype(jnp.int32)
+    ln = jnp.reshape(length, (-1,)).astype(jnp.int32)
+    pos = jnp.arange(t)[None, :]
+    src = jnp.clip(pos + off[:, None], 0, t - 1)
+    # windows reaching past the time extent zero-fill (the reference
+    # enforces offset+length <= seq_len; silent duplication would corrupt)
+    valid = (pos < ln[:, None]) & (pos + off[:, None] < t)
+    gathered = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    v = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+    return jnp.where(v, gathered, jnp.zeros_like(gathered))
+
+
+@simple_op("sequence_enumerate", ["X", "Length"], ["Out"],
+           optional=("Length",), grad=None)
+def _sequence_enumerate(ctx, x, length, attrs):
+    """Sliding windows of ids (sequence_enumerate_op.cc): [B, T] int →
+    [B, T, win]; positions past the valid length (or windows crossing it)
+    filled with pad_value."""
+    win = int(attrs.get("win_size", 2))
+    pad = int(attrs.get("pad_value", 0))
+    b, t = jnp.shape(x)[0], jnp.shape(x)[1]
+    ln = (jnp.reshape(length, (-1, 1)).astype(jnp.int32) if length is not None
+          else jnp.full((b, 1), t, jnp.int32))
+    pos = jnp.arange(t)[None, :, None] + jnp.arange(win)[None, None, :]
+    valid = pos < ln[:, :, None]
+    idx = jnp.clip(pos, 0, t - 1)
+    gathered = jnp.take_along_axis(x[:, :, None].astype(jnp.int64),
+                                   idx.astype(jnp.int32), axis=1)
+    return jnp.where(valid, gathered, jnp.asarray(pad, jnp.int64))

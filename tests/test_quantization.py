@@ -1,0 +1,208 @@
+"""QAT: fake-quant ops + QuantizationTransformPass / FreezePass (reference
+analog: tests/unittests/test_fake_quantize_op.py and
+contrib/slim/tests/test_quantization_pass.py)."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.contrib.slim.quantization import (
+    QuantizationFreezePass, QuantizationTransformPass)
+
+
+def test_fake_quantize_abs_max_values():
+    x = np.array([[0.5, -1.0], [0.25, 0.125]], "float32")
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 2], False, dtype="float32")
+        block = main.global_block()
+        out = block.create_var(name="q_out", stop_gradient=True)
+        sc = block.create_var(name="q_scale", stop_gradient=True)
+        block.append_op("fake_quantize_abs_max", inputs={"X": [xv.name]},
+                        outputs={"Out": [out.name], "OutScale": [sc.name]},
+                        attrs={"bit_length": 8})
+        exe = fluid.Executor(fluid.CPUPlace())
+        q, s = exe.run(main, feed={"x": x}, fetch_list=["q_out", "q_scale"])
+    np.testing.assert_allclose(s, [1.0], atol=1e-6)
+    expect = np.round(x / 1.0 * 127) * 1.0 / 127
+    np.testing.assert_allclose(q, expect, atol=1e-6)
+
+
+def test_fake_channel_wise_quantize_scales():
+    rng = np.random.RandomState(0)
+    w = rng.uniform(-2, 2, (4, 3)).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("w", [4, 3], False, dtype="float32")
+        block = main.global_block()
+        out = block.create_var(name="q_out", stop_gradient=True)
+        sc = block.create_var(name="q_scale", stop_gradient=True)
+        block.append_op("fake_channel_wise_quantize_abs_max",
+                        inputs={"X": [xv.name]},
+                        outputs={"Out": [out.name], "OutScale": [sc.name]},
+                        attrs={"bit_length": 8})
+        exe = fluid.Executor(fluid.CPUPlace())
+        q, s = exe.run(main, feed={"w": w}, fetch_list=["q_out", "q_scale"])
+    np.testing.assert_allclose(s, np.abs(w).max(axis=1), rtol=1e-6)
+    # each row quantized by its own scale → at most 255 levels per row
+    for i in range(4):
+        lv = np.unique(np.round(q[i] / (s[i] / 127)))
+        assert lv.size <= 255
+
+
+def _build_mlp():
+    x = fluid.data("x", [-1, 8], False, dtype="float32")
+    y = fluid.data("y", [-1, 1], False, dtype="int64")
+    h = layers.fc(x, size=16, act="relu")
+    logits = layers.fc(h, size=4)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, y))
+    return x, y, logits, loss
+
+
+def test_qat_transform_trains_and_freezes():
+    rng = np.random.RandomState(1)
+    xd = rng.uniform(-1, 1, (64, 8)).astype("float32")
+    yd = rng.randint(0, 4, (64, 1)).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        _, _, logits, loss = _build_mlp()
+        pass_ = QuantizationTransformPass()
+        pass_.apply(main, startup)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+        op_types = [op.type for op in main.global_block().ops]
+        assert "fake_channel_wise_quantize_abs_max" in op_types
+        assert "fake_quantize_moving_average_abs_max" in op_types
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (l0,) = exe.run(main, feed={"x": xd, "y": yd},
+                        fetch_list=[loss.name])
+        for _ in range(30):
+            (l1,) = exe.run(main, feed={"x": xd, "y": yd},
+                            fetch_list=[loss.name])
+        assert float(l1) < float(l0) * 0.8  # STE gradients train through
+
+        # freeze: weights in scope become quantize-dequantized values
+        wname = next(n for n in main.global_block().vars
+                     if main.global_block().var(n).persistable
+                     and np.asarray(scope.get(n)).ndim == 2
+                     and n + ".quantized" in main.global_block().vars)
+        w_before = np.asarray(scope.get(wname)).copy()
+        freeze = QuantizationFreezePass(scope)
+        freeze.apply(main)
+        # read the frozen weight BEFORE running the (training) program
+        # again — the optimizer ops in `main` would update it
+        w_after = np.asarray(scope.get(wname)).copy()
+        (l2,) = exe.run(main, feed={"x": xd, "y": yd},
+                        fetch_list=[loss.name])
+        assert np.isfinite(float(l2))
+        # mul weights are [in, out] -> per-output-channel = quant_axis 1
+        scale = np.maximum(np.abs(w_before).max(axis=0, keepdims=True), 1e-9)
+        expect = np.clip(np.round(w_before / scale * 127), -127, 127) \
+            * scale / 127
+        np.testing.assert_allclose(w_after, expect, atol=1e-6)
+        assert not np.allclose(w_after, w_before)
+
+
+def test_moving_average_scale_converges():
+    rng = np.random.RandomState(2)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 4], False, dtype="float32")
+        block = main.global_block()
+        gb = fluid.default_startup_program().global_block()
+        for nm in ("ms_scale", "ms_accum", "ms_state"):
+            block.create_var(name=nm, shape=[1], dtype="float32",
+                             persistable=True, stop_gradient=True)
+            sv = gb.create_var(name=nm, shape=[1], dtype="float32",
+                               persistable=True)
+            fluid.initializer.Constant(1.0)(sv, gb)
+        out = block.create_var(name="ms_out", stop_gradient=True)
+        block.append_op(
+            "fake_quantize_moving_average_abs_max",
+            inputs={"X": [xv.name], "InScale": ["ms_scale"],
+                    "InAccum": ["ms_accum"], "InState": ["ms_state"]},
+            outputs={"Out": ["ms_out"], "OutScale": ["ms_scale"],
+                     "OutAccum": ["ms_accum"], "OutState": ["ms_state"]},
+            attrs={"bit_length": 8, "moving_rate": 0.9})
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(50):
+            x = rng.uniform(-2, 2, (16, 4)).astype("float32")
+            exe.run(main, feed={"x": x}, fetch_list=["ms_out"])
+        scale = float(np.asarray(scope.get("ms_scale")))
+    assert 1.5 < scale < 2.1  # EMA approaches the true abs-max ≈ 2
+
+
+def test_range_abs_max_window_decays():
+    """An early outlier scale decays out of the window (unlike running max)."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    window = 4
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 4], False, dtype="float32")
+        block = main.global_block()
+        gb = fluid.default_startup_program().global_block()
+        for nm, shape, val in (("rs_scale", [1], 1.0),
+                               ("rs_scales", [window], 0.0),
+                               ("rs_iter", [1], 0.0)):
+            block.create_var(name=nm, shape=shape,
+                             dtype="float32" if nm != "rs_iter" else "int32",
+                             persistable=True, stop_gradient=True)
+            sv = gb.create_var(name=nm, shape=shape,
+                               dtype="float32" if nm != "rs_iter" else "int32",
+                               persistable=True)
+            fluid.initializer.Constant(val)(sv, gb)
+        block.create_var(name="rs_out", stop_gradient=True)
+        block.append_op(
+            "fake_quantize_range_abs_max",
+            inputs={"X": [xv.name], "InScale": ["rs_scale"],
+                    "InScales": ["rs_scales"], "Iter": ["rs_iter"]},
+            outputs={"Out": ["rs_out"], "OutScale": ["rs_scale"],
+                     "OutScales": ["rs_scales"], "IterOut": ["rs_iter"]},
+            attrs={"bit_length": 8, "window_size": window})
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # outlier batch |x| = 100, then steady batches |x| = 1
+        exe.run(main, feed={"x": np.full((2, 4), 100.0, "float32")},
+                fetch_list=["rs_out"])
+        s_after_outlier = float(np.asarray(scope.get("rs_scale")))
+        for _ in range(window):
+            exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=["rs_out"])
+        s_final = float(np.asarray(scope.get("rs_scale")))
+    assert s_after_outlier == 100.0
+    assert s_final == 1.0  # the outlier fell out of the window
+
+
+def test_sequence_slice_out_of_range_zero_fills():
+    from paddle_tpu.fluid import layers as L
+    x = np.arange(12, dtype="float32").reshape(1, 6, 2)
+    off = np.array([4], "int64")
+    ln = np.array([3], "int64")  # offset+length = 7 > 6
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 6, 2], False, dtype="float32")
+        ov = fluid.data("off", [-1], False, dtype="int64")
+        lv = fluid.data("ln", [-1], False, dtype="int64")
+        out = L.sequence_slice(xv, ov, lv)
+        exe = fluid.Executor(fluid.CPUPlace())
+        (res,) = exe.run(main, feed={"x": x, "off": off, "ln": ln},
+                         fetch_list=[out.name])
+    np.testing.assert_allclose(res[0, :2], x[0, 4:6])
+    np.testing.assert_allclose(res[0, 2:], 0.0)  # no duplicated last frame

@@ -91,3 +91,89 @@ def test_sequence_mask():
     (out,) = _run(build, {"ln": np.array([1, 3, 5], dtype="int64")})
     exp = np.tril(np.ones((5, 5)))[[0, 2, 4]]
     np.testing.assert_allclose(out, exp)
+
+
+def test_sequence_concat_valid_prefixes():
+    x1 = np.arange(12, dtype="float32").reshape(2, 3, 2)
+    x2 = 100 + np.arange(8, dtype="float32").reshape(2, 2, 2)
+    l1 = np.array([2, 3], "int64")
+    l2 = np.array([1, 2], "int64")
+
+    def build():
+        a = fluid.data("x1", [-1, 3, 2], False, dtype="float32")
+        b = fluid.data("x2", [-1, 2, 2], False, dtype="float32")
+        la = fluid.data("l1", [-1], False, dtype="int64")
+        lb = fluid.data("l2", [-1], False, dtype="int64")
+        out, ln = layers.sequence_concat([a, b], lengths=[la, lb])
+        return [out, ln]
+
+    (out, ln) = _run(build, {"x1": x1, "x2": x2, "l1": l1, "l2": l2})
+    np.testing.assert_array_equal(ln, [3, 5])
+    # row 0: x1[0,:2] then x2[0,:1], rest zeros
+    np.testing.assert_allclose(out[0, :2], x1[0, :2])
+    np.testing.assert_allclose(out[0, 2], x2[0, 0])
+    np.testing.assert_allclose(out[0, 3:], 0.0)
+    # row 1: x1[1,:3] then x2[1,:2]
+    np.testing.assert_allclose(out[1, :3], x1[1])
+    np.testing.assert_allclose(out[1, 3:5], x2[1, :2])
+
+
+def test_sequence_slice_window():
+    x = np.arange(24, dtype="float32").reshape(2, 6, 2)
+    off = np.array([1, 3], "int64")
+    ln = np.array([2, 3], "int64")
+
+    def build():
+        xv = fluid.data("x", [-1, 6, 2], False, dtype="float32")
+        ov = fluid.data("off", [-1], False, dtype="int64")
+        lv = fluid.data("ln", [-1], False, dtype="int64")
+        return [layers.sequence_slice(xv, ov, lv)]
+
+    (out,) = _run(build, {"x": x, "off": off, "ln": ln})
+    np.testing.assert_allclose(out[0, :2], x[0, 1:3])
+    np.testing.assert_allclose(out[0, 2:], 0.0)
+    np.testing.assert_allclose(out[1, :3], x[1, 3:6])
+
+
+def test_sequence_expand_as_tiles():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    y = np.zeros((2, 3, 5), "float32")
+
+    def build():
+        xv = fluid.data("x", [-1, 2], False, dtype="float32")
+        yv = fluid.data("y", [-1, 3, 5], False, dtype="float32")
+        return [layers.sequence_expand_as(xv, yv)]
+
+    (out,) = _run(build, {"x": x, "y": y})
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_allclose(out[0], [[1, 2]] * 3)
+
+
+def test_sequence_enumerate_windows():
+    x = np.array([[1, 2, 3, 4]], "int64")
+    ln = np.array([3], "int64")
+
+    def build():
+        xv = fluid.data("x", [-1, 4], False, dtype="int64")
+        lv = fluid.data("ln", [-1], False, dtype="int64")
+        return [layers.sequence_enumerate(xv, win_size=2, pad_value=0,
+                                          length=lv)]
+
+    (out,) = _run(build, {"x": x, "ln": ln})
+    # valid ids are [1,2,3]; windows: [1,2],[2,3],[3,0],[0,0]
+    np.testing.assert_array_equal(out[0], [[1, 2], [2, 3], [3, 0], [0, 0]])
+
+
+def test_sequence_unpad_zeros_tail():
+    x = np.ones((2, 4, 3), "float32")
+    ln = np.array([2, 4], "int64")
+
+    def build():
+        xv = fluid.data("x", [-1, 4, 3], False, dtype="float32")
+        lv = fluid.data("ln", [-1], False, dtype="int64")
+        return [layers.sequence_unpad(xv, lv)]
+
+    (out,) = _run(build, {"x": x, "ln": ln})
+    np.testing.assert_allclose(out[0, :2], 1.0)
+    np.testing.assert_allclose(out[0, 2:], 0.0)
+    np.testing.assert_allclose(out[1], 1.0)

@@ -21,12 +21,14 @@ import time
 import numpy as np
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
-           "RecordEvent", "record_event", "is_profiler_enabled"]
+           "RecordEvent", "record_event", "is_profiler_enabled",
+           "get_events", "export_chrome_trace"]
 
 _STATE = {
     "enabled": False,
     "trace_dir": None,
-    "events": [],  # (kind, name, seconds)
+    "events": [],  # (kind, name, start_s, dur_s)
+    "t0": None,    # profiling session epoch (perf_counter)
 }
 
 
@@ -34,9 +36,11 @@ def is_profiler_enabled():
     return _STATE["enabled"]
 
 
-def _record(kind, name, seconds):
+def _record(kind, name, seconds, start=None):
     if _STATE["enabled"]:
-        _STATE["events"].append((kind, name, seconds))
+        if start is None:
+            start = time.perf_counter() - seconds
+        _STATE["events"].append((kind, name, start, seconds))
 
 
 class RecordEvent:
@@ -50,7 +54,8 @@ class RecordEvent:
         return self
 
     def __exit__(self, *exc):
-        _record("host", self.name, time.perf_counter() - self._t0)
+        _record("host", self.name, time.perf_counter() - self._t0,
+                start=self._t0)
         return False
 
 
@@ -92,7 +97,8 @@ class timed_run:
 
             jax.block_until_ready(self._arrays)
             kind = "run" if self.state.get("ran") else "compile+run"
-            _record(kind, self.label, time.perf_counter() - self._t0)
+            _record(kind, self.label, time.perf_counter() - self._t0,
+                    start=self._t0)
         if et is None:
             self.state["ran"] = True
         return False
@@ -103,6 +109,7 @@ def start_profiler(state="All", tracer_option=None, trace_dir=None):
         return
     _STATE["enabled"] = True
     _STATE["events"] = []
+    _STATE["t0"] = time.perf_counter()
     _STATE["trace_dir"] = trace_dir
     if trace_dir is not None:
         import jax
@@ -131,9 +138,38 @@ def reset_profiler():
     _STATE["events"] = []
 
 
+def get_events():
+    """Recorded (kind, name, start_s, dur_s) events of the last/current
+    profiling session, with start relative to the session epoch (clamped to
+    0 for spans entered before start_profiler).  Consumed by
+    tools/timeline.py for chrome://tracing export."""
+    t0 = _STATE["t0"] or 0.0
+    return [(k, n, max(s - t0, 0.0), d) for k, n, s, d in _STATE["events"]]
+
+
+def export_chrome_trace(path):
+    """Write the recorded spans as a chrome://tracing JSON file (the
+    reference's tools/timeline.py converts its profiler proto the same
+    way)."""
+    import json
+
+    events = []
+    for kind, name, start, dur in get_events():
+        events.append({
+            "name": name, "cat": kind, "ph": "X",
+            "ts": start * 1e6, "dur": dur * 1e6,
+            "pid": 0, "tid": {"host": 1}.get(kind, 0),
+            "args": {"kind": kind},
+        })
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return path
+
+
 def _summary(sorted_key=None):
     rows = {}
-    for kind, name, sec in _STATE["events"]:
+    for kind, name, _start, sec in _STATE["events"]:
         key = (kind, name)
         tot, cnt, mx = rows.get(key, (0.0, 0, 0.0))
         rows[key] = (tot + sec, cnt + 1, max(mx, sec))

@@ -16,12 +16,16 @@ from paddle_tpu.fluid.executor import Scope, scope_guard  # noqa: E402
 
 def train_save_load_infer(build_fn, reader_fn, tmp_path, epochs=4,
                           loss_threshold=None, lr=None, optimizer=None,
-                          feed_names=None, infer_feed=None):
+                          feed_names=None, infer_feed=None,
+                          return_scope=False):
     """Generic book-test skeleton:
       build_fn() -> (feeds: [Variable], loss, extra_fetch: dict name->var)
       reader_fn() -> iterator of feed dicts
     Trains, asserts loss threshold, saves inference model, reloads it in a
     fresh scope, checks prediction parity against the training program.
+    return_scope=True additionally returns (losses, scope, main) so sibling
+    tests (e.g. decode checks) can reuse the trained parameters instead of
+    re-training.
     """
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
@@ -61,6 +65,8 @@ def train_save_load_infer(build_fn, reader_fn, tmp_path, epochs=4,
                           fetch_list=[fetches[0].name])
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                rtol=2e-4, atol=2e-5)
+    if return_scope:
+        return losses, scope, main
     return losses
 
 

@@ -1,8 +1,9 @@
 """Book 06: seq2seq machine translation — GRU encoder + GRU decoder built on
-StaticRNN, padded/bucketed sequences with masked loss
-(reference tests/book/test_machine_translation.py + test_rnn_encoder_decoder.py;
-the reference's LoD dynamic RNN becomes fixed-shape scan on TPU — see
-SURVEY.md §5 long-context note).
+StaticRNN, padded/bucketed sequences with masked loss, plus a compiled
+static-beam decode program (reference tests/book/test_machine_translation.py
+decode_main uses beam_search inside a while_op over LoD beams; here the
+decode loop is statically unrolled over TRG_LEN with the dense [B, K] beam
+ops — the whole beam search compiles to one XLA program).
 """
 
 import numpy as np
@@ -18,6 +19,8 @@ HID = 32
 SRC_LEN = 9
 TRG_LEN = 10
 BATCH = 64
+BEAM = 3
+BOS, EOS = paddle.dataset.wmt16.BOS, paddle.dataset.wmt16.EOS
 
 
 def _gru_cell(x_t, h_prev, hidden, prefix):
@@ -60,14 +63,9 @@ def to_feed(batch):
     return {"src": src, "trg": trg, "trg_next": nxt, "mask": mask}
 
 
-def build():
-    src = fluid.layers.data(name="src", shape=[SRC_LEN], dtype="int64")
-    trg = fluid.layers.data(name="trg", shape=[TRG_LEN], dtype="int64")
-    trg_next = fluid.layers.data(name="trg_next", shape=[TRG_LEN], dtype="int64")
-    mask = fluid.layers.data(name="mask", shape=[TRG_LEN], dtype="float32")
-
-    # encoder
-    src_emb = fluid.layers.embedding(src, size=[DICT, EMB])  # [B,S,E]
+def _encoder(src):
+    src_emb = fluid.layers.embedding(
+        src, size=[DICT, EMB], param_attr=fluid.ParamAttr(name="src_emb_w"))
     src_tm = fluid.layers.transpose(src_emb, perm=[1, 0, 2])  # time-major
     h0 = fluid.layers.fill_constant_batch_size_like(
         input=src, shape=[-1, HID], dtype="float32", value=0.0)
@@ -81,10 +79,20 @@ def build():
     enc_states = enc()  # [S,B,H]
     enc_last = fluid.layers.slice(enc_states, axes=[0],
                                   starts=[SRC_LEN - 1], ends=[SRC_LEN])
-    enc_last = fluid.layers.reshape(enc_last, shape=[-1, HID])
+    return fluid.layers.reshape(enc_last, shape=[-1, HID])
+
+
+def build():
+    src = fluid.layers.data(name="src", shape=[SRC_LEN], dtype="int64")
+    trg = fluid.layers.data(name="trg", shape=[TRG_LEN], dtype="int64")
+    trg_next = fluid.layers.data(name="trg_next", shape=[TRG_LEN], dtype="int64")
+    mask = fluid.layers.data(name="mask", shape=[TRG_LEN], dtype="float32")
+
+    enc_last = _encoder(src)
 
     # decoder (teacher forcing)
-    trg_emb = fluid.layers.embedding(trg, size=[DICT, EMB])
+    trg_emb = fluid.layers.embedding(
+        trg, size=[DICT, EMB], param_attr=fluid.ParamAttr(name="trg_emb_w"))
     trg_tm = fluid.layers.transpose(trg_emb, perm=[1, 0, 2])
     dec = fluid.layers.StaticRNN()
     with dec.step():
@@ -108,15 +116,105 @@ def build():
     return [src, trg], loss, logits_bm
 
 
+def build_decode():
+    """Static-beam decode program: encoder → TRG_LEN unrolled beam steps →
+    beam_search_decode backtrack.  Shares every parameter (by name) with the
+    training program."""
+    L = fluid.layers
+    src = L.data(name="src", shape=[SRC_LEN], dtype="int64")
+    enc_last = _encoder(src)  # [B,H]
+
+    # [B,H] → beams: h [B,K,H], all beams identical at step 0; only beam 0
+    # alive (others -inf) so the first step picks distinct top-K tokens
+    h = L.stack([enc_last] * BEAM, axis=1)
+    pre_ids = L.fill_constant_batch_size_like(src, shape=[-1, BEAM],
+                                              dtype="int64", value=BOS)
+    init_bias = np.zeros((1, BEAM), "float32")
+    init_bias[0, 1:] = -1e9
+    pre_scores = L.fill_constant_batch_size_like(
+        src, shape=[-1, BEAM], dtype="float32", value=0.0)
+    bias_v = L.assign(init_bias)
+    pre_scores = pre_scores + bias_v  # broadcast [B,K] + [1,K]
+
+    step_ids, step_parents = [], []
+    for _ in range(TRG_LEN):
+        emb = L.embedding(pre_ids, size=[DICT, EMB],
+                          param_attr=fluid.ParamAttr(name="trg_emb_w"))
+        emb2 = L.reshape(emb, shape=[-1, EMB])        # [B*K, E]
+        h2 = L.reshape(h, shape=[-1, HID])
+        h_new = _gru_cell(emb2, h2, HID, "dec")
+        logits = L.fc(input=h_new, size=DICT,
+                      param_attr=fluid.ParamAttr(name="out_w"),
+                      bias_attr=fluid.ParamAttr(name="out_b"))
+        logp = L.log_softmax(logits)                   # [B*K, V]
+        logp3 = L.reshape(logp, shape=[-1, BEAM, DICT])
+        ids, scores, parent = L.beam_search(
+            pre_ids, pre_scores, logp3, beam_size=BEAM, end_id=EOS)
+        # reorder beam states by parent: h[b,k] = h_new[b, parent[b,k]]
+        onehot = L.one_hot(parent, BEAM)               # [B,K,K]
+        h3 = L.reshape(h_new, shape=[-1, BEAM, HID])
+        h = L.matmul(onehot, h3)                       # [B,K,H]
+        pre_ids, pre_scores = ids, scores
+        step_ids.append(L.unsqueeze(ids, axes=[0]))
+        step_parents.append(L.unsqueeze(L.cast(parent, "int32"), axes=[0]))
+    ids_t = L.concat(step_ids, axis=0)                 # [T,B,K]
+    parents_t = L.concat(step_parents, axis=0)
+    sent = L.beam_search_decode(ids_t, parents_t, end_id=EOS)
+    return src, sent, pre_scores
+
+
+# trained once per module; both tests below consume it (avoids re-training)
+_TRAINED = {}
+
+
+def _train(tmp_path):
+    if not _TRAINED:
+        data = paddle.dataset.wmt16.train(DICT, DICT)
+
+        def reader():
+            for b in paddle.batch(data, BATCH, drop_last=True)():
+                yield to_feed(b)
+
+        losses, scope, main = train_save_load_infer(
+            build, reader, tmp_path, epochs=12, lr=8e-3,
+            feed_names=["src", "trg"], return_scope=True)
+        feed0 = to_feed(next(iter(paddle.batch(data, BATCH,
+                                               drop_last=True)())))
+        _TRAINED.update(losses=losses, scope=scope, feed0=feed0)
+    return _TRAINED
+
+
 def test_machine_translation(tmp_path):
-    data = paddle.dataset.wmt16.train(DICT, DICT)
-
-    def reader():
-        for b in paddle.batch(data, BATCH, drop_last=True)():
-            yield to_feed(b)
-
-    losses = train_save_load_infer(
-        build, reader, tmp_path, epochs=12, lr=8e-3,
-        feed_names=["src", "trg"])
+    losses = _train(tmp_path)["losses"]
     # deterministic reverse+permute mapping is fully learnable; random = ln(64)≈4.16
     assert np.mean(losses[-4:]) < 2.5, np.mean(losses[-4:])
+
+
+def test_machine_translation_beam_decode(tmp_path):
+    """Beam-decode with the trained parameters (reference decode_main): the
+    decoded beam-0 tokens recover a meaningful fraction of the deterministic
+    mapping."""
+    t = _train(tmp_path)
+    feed0 = t["feed0"]
+    decode_prog, decode_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(decode_prog, decode_start), \
+            fluid.unique_name.guard():
+        src_v, sent_v, scores_v = build_decode()
+
+    # decode shares the trained scope → no params of its own to initialize
+    with fluid.scope_guard(t["scope"]):
+        exe = fluid.Executor(fluid.CPUPlace())
+        sent, scores = exe.run(decode_prog, feed={"src": feed0["src"]},
+                               fetch_list=[sent_v.name, scores_v.name])
+    sent = np.asarray(sent)    # [B, K, T]
+    scores = np.asarray(scores)
+    assert sent.shape == (BATCH, BEAM, TRG_LEN)
+    assert sent.min() >= 0 and sent.max() < DICT
+    # beam scores are sorted best-first
+    assert np.all(scores[:, 0] >= scores[:, 1] - 1e-5)
+    # beam-0 should reproduce a good chunk of the deterministic target
+    # (masked to the real target length)
+    trg_next = feed0["trg_next"]
+    mask = feed0["mask"] > 0
+    acc = (sent[:, 0, :] == trg_next)[mask].mean()
+    assert acc > 0.35, acc  # chance ≈ 1/61

@@ -187,3 +187,23 @@ def test_amp_lists_conflicting_custom_lists_rejected():
     with _pytest.raises(ValueError):
         mp.AutoMixedPrecisionLists(custom_white_list=["exp"],
                                    custom_black_list=["exp"])
+
+
+def test_chrome_trace_export(tmp_path):
+    import json
+
+    from paddle_tpu.fluid import profiler
+
+    profiler.start_profiler()
+    with profiler.RecordEvent("span_a"):
+        pass
+    with profiler.RecordEvent("span_b"):
+        pass
+    events = profiler.get_events()
+    out = profiler.export_chrome_trace(str(tmp_path / "tl.json"))
+    profiler.stop_profiler(profile_path=str(tmp_path / "prof.txt"))
+    data = json.loads((tmp_path / "tl.json").read_text())
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "span_a" in names and "span_b" in names
+    assert all(e["ph"] == "X" and e["ts"] >= 0 for e in data["traceEvents"])
+    assert len(events) == 2

@@ -1,0 +1,30 @@
+"""API-freeze test (reference paddle/fluid/API.spec diffed by
+tools/diff_api.py in CI): the live public surface must match API.spec;
+intentional changes regenerate it with tools/gen_api_spec.py."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_api_spec_frozen():
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "gen_api_spec.py"), "--check"],
+        capture_output=True, text=True,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(REPO)})
+    assert r.returncode == 0, f"API surface drifted:\n{r.stdout}\n{r.stderr}"
+
+
+def test_api_spec_has_core_entries():
+    spec = (REPO / "API.spec").read_text()
+    for entry in ("paddle_tpu.fluid.Program", "paddle_tpu.fluid.Executor",
+                  "paddle_tpu.fluid.layers.fc",
+                  "paddle_tpu.fluid.layers.linear_chain_crf",
+                  "paddle_tpu.fluid.layers.dynamic_lstm",
+                  "paddle_tpu.fluid.optimizer.Adam",
+                  "paddle_tpu.fluid.io.save_inference_model",
+                  "paddle_tpu.dataset.wmt14"):
+        assert entry in spec, f"missing {entry}"

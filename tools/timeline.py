@@ -1,0 +1,26 @@
+"""Convert a profiling run to chrome://tracing format (reference
+tools/timeline.py converts platform/profiler.proto dumps the same way).
+
+Usage:
+    with fluid.profiler.profiler():
+        ... run ...
+    # then, before the next reset:
+    python -c "from paddle_tpu.fluid import profiler; \
+               profiler.export_chrome_trace('timeline.json')"
+
+or programmatically: fluid.profiler.export_chrome_trace(path).
+Open the JSON in chrome://tracing or https://ui.perfetto.dev.
+For device-level detail use profiler(trace_dir=...) which captures an
+xplane trace for XProf/TensorBoard instead.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from paddle_tpu.fluid import profiler  # noqa: E402
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "timeline.json"
+    print(profiler.export_chrome_trace(out))

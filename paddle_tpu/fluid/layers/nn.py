@@ -42,6 +42,9 @@ __all__ = [
     "sequence_first_step", "sequence_last_step", "sequence_mask",
     "sequence_unpad", "sequence_concat", "sequence_expand_as",
     "sequence_slice", "sequence_enumerate",
+    "kldiv_loss", "margin_rank_loss", "rank_loss", "hinge_loss", "bpr_loss",
+    "maxout", "selu", "pixel_shuffle", "shuffle_channel", "affine_channel",
+    "grid_sampler", "crop", "im2sequence", "chunk_eval",
 ]
 
 
@@ -54,7 +57,9 @@ def _single_out_layer(helper, op_type, inputs, attrs=None, dtype=None, out=None)
     return out
 
 
-_OUT_SLOT = {"cross_entropy": "Y", "stack": "Y", "mul": "Out"}
+_OUT_SLOT = {"cross_entropy": "Y", "stack": "Y", "mul": "Out",
+             "kldiv_loss": "Loss", "hinge_loss": "Loss", "bpr_loss": "Y",
+             "grid_sampler": "Output"}
 
 
 # ---------------------------------------------------------------------------
@@ -1168,6 +1173,144 @@ def sequence_enumerate(input, win_size, pad_value=0, length=None, name=None):
                      outputs={"Out": [out]},
                      attrs={"win_size": win_size, "pad_value": pad_value})
     return out
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    """KL divergence with x = log-probs (reference nn.py kldiv_loss)."""
+    helper = LayerHelper("kldiv_loss", name=name)
+    return _single_out_layer(helper, "kldiv_loss",
+                             {"X": [x], "Target": [target]},
+                             {"reduction": reduction})
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(dtype=left.dtype)
+    act = helper.create_variable_for_type_inference(dtype=left.dtype,
+                                                    stop_gradient=True)
+    helper.append_op("margin_rank_loss",
+                     inputs={"X1": [left], "X2": [right], "Label": [label]},
+                     outputs={"Out": [out], "Activated": [act]},
+                     attrs={"margin": float(margin)})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", name=name)
+    return _single_out_layer(helper, "rank_loss",
+                             {"Left": [left], "Right": [right],
+                              "Label": [label]})
+
+
+def hinge_loss(input, label, name=None):
+    helper = LayerHelper("hinge_loss", name=name)
+    return _single_out_layer(helper, "hinge_loss",
+                             {"Logits": [input], "Labels": [label]})
+
+
+def bpr_loss(input, label, name=None):
+    helper = LayerHelper("bpr_loss", name=name)
+    return _single_out_layer(helper, "bpr_loss",
+                             {"X": [input], "Label": [label]})
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    return _single_out_layer(helper, "maxout", {"X": [x]},
+                             {"groups": groups})
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    helper = LayerHelper("selu", name=name)
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    if alpha is not None:
+        attrs["alpha"] = float(alpha)
+    return _single_out_layer(helper, "selu", {"X": [x]}, attrs)
+
+
+def pixel_shuffle(x, upscale_factor, name=None):
+    helper = LayerHelper("pixel_shuffle", name=name)
+    return _single_out_layer(helper, "pixel_shuffle", {"X": [x]},
+                             {"upscale_factor": upscale_factor})
+
+
+def shuffle_channel(x, group, name=None):
+    helper = LayerHelper("shuffle_channel", name=name)
+    return _single_out_layer(helper, "shuffle_channel", {"X": [x]},
+                             {"group": group})
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    """Per-channel affine; None scale/bias act as identity."""
+    helper = LayerHelper("affine_channel", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {"X": [x]}
+    if scale is not None:
+        inputs["Scale"] = [scale]
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op("affine_channel", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"data_layout": data_layout})
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    helper = LayerHelper("grid_sampler", name=name)
+    return _single_out_layer(helper, "grid_sampler",
+                             {"X": [x], "Grid": [grid]})
+
+
+def crop(x, shape, offsets=None, name=None):
+    """Static-shape crop (reference nn.py crop); offsets may be a tensor
+    (dynamic_slice) or a list attr."""
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {"X": [x]}
+    attrs = {"shape": list(shape)}
+    if isinstance(offsets, Variable):
+        inputs["Offsets"] = [offsets]
+    elif offsets is not None:
+        attrs["offsets"] = list(offsets)
+    helper.append_op("crop", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    """Image → patch sequence [B, T, C*kh*kw] (dense analog of reference
+    nn.py im2sequence)."""
+    helper = LayerHelper("im2sequence", name=name)
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+    st = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+    pd = padding if isinstance(padding, (list, tuple)) else [padding] * 2
+    return _single_out_layer(helper, "im2sequence", {"X": [input]},
+                             {"kernels": list(fs), "strides": list(st),
+                              "paddings": list(pd)})
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types, length=None,
+               name=None):
+    """Chunking F1 (reference nn.py chunk_eval → chunk_eval op, IOB
+    scheme).  Returns (precision, recall, f1, n_infer, n_label, n_correct)."""
+    helper = LayerHelper("chunk_eval", name=name)
+    outs = {s: helper.create_variable_for_type_inference(
+        dtype="float32" if i < 3 else "int32", stop_gradient=True)
+        for i, s in enumerate(["Precision", "Recall", "F1-Score",
+                               "NumInferChunks", "NumLabelChunks",
+                               "NumCorrectChunks"])}
+    inputs = {"Inference": [input], "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("chunk_eval", inputs=inputs,
+                     outputs={k: [v] for k, v in outs.items()},
+                     attrs={"chunk_scheme": chunk_scheme,
+                            "num_chunk_types": num_chunk_types})
+    o = outs
+    return (o["Precision"], o["Recall"], o["F1-Score"],
+            o["NumInferChunks"], o["NumLabelChunks"], o["NumCorrectChunks"])
 
 
 def flash_attention(q, k, v, attn_bias=None, causal=False, sm_scale=None,

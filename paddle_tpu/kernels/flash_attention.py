@@ -86,7 +86,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
-        b = bias_ref[0].astype(jnp.float32)
+        b = bias_ref[0, 0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         s = s + b[None, :]
         if causal:
@@ -107,7 +107,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         l = l_ref[...]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[...] / l_safe[:, :1]).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[...] + jnp.log(l_safe))[:, 0]
+        lse_ref[0, 0] = (m_ref[...] + jnp.log(l_safe))[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -134,10 +134,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
-        b = bias_ref[0].astype(jnp.float32)
+        b = bias_ref[0, 0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         s = s + b[None, :]
         if causal:
@@ -173,10 +173,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
-        b = bias_ref[0].astype(jnp.float32)
+        b = bias_ref[0, 0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         s = s + b[None, :]
         if causal:
@@ -196,7 +196,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
     def _finish():
         dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
-        db_ref[0] = db_acc_ref[0]
+        db_ref[0, 0] = db_acc_ref[0]
 
 
 # ---------------------------------------------------------------------------
@@ -213,6 +213,8 @@ def _pallas_fwd(q, k, v, bias, causal, sm_scale, interpret):
     n_q, n_k = s // bq, s // bk
     kernel = functools.partial(_fwd_kernel, block_q=bq, block_k=bk,
                                sm_scale=sm_scale, causal=causal, n_k=n_k)
+    # rank-2 (bh, s) operands ride as (bh, 1, s): Mosaic requires the block's
+    # second-minor dim to divide 8 or equal the array's — a literal 1 does
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
@@ -220,15 +222,15 @@ def _pallas_fwd(q, k, v, bias, causal, sm_scale, interpret):
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk), lambda b, i, j: (b, j)),
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -236,8 +238,8 @@ def _pallas_fwd(q, k, v, bias, causal, sm_scale, interpret):
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, bias)
-    return out, lse
+    )(q, k, v, bias[:, None, :])
+    return out, lse[:, 0, :]
 
 
 def _pallas_bwd(q, k, v, bias, o, lse, do, causal, sm_scale, interpret):
@@ -248,6 +250,9 @@ def _pallas_bwd(q, k, v, bias, o, lse, do, causal, sm_scale, interpret):
     bq = bk = DEFAULT_BLOCK
     n_q, n_k = s // bq, s // bk
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    bias3 = bias[:, None, :]
+    lse3 = lse[:, None, :]
+    delta3 = delta[:, None, :]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=bq, block_k=bk,
@@ -257,16 +262,16 @@ def _pallas_bwd(q, k, v, bias, o, lse, do, causal, sm_scale, interpret):
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk), lambda b, i, j: (b, j)),
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, bias, do, lse, delta)
+    )(q, k, v, bias3, do, lse3, delta3)
 
     dk, dv, db = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk,
@@ -276,20 +281,20 @@ def _pallas_bwd(q, k, v, bias, o, lse, do, causal, sm_scale, interpret):
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk), lambda b, j, i: (b, j)),
+            pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j)),
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk), lambda b, j, i: (b, j)),
+            pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -297,8 +302,8 @@ def _pallas_bwd(q, k, v, bias, o, lse, do, causal, sm_scale, interpret):
             pltpu.VMEM((8, bk), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, bias, do, lse, delta)
-    return dq, dk, dv, db
+    )(q, k, v, bias3, do, lse3, delta3)
+    return dq, dk, dv, db[:, 0, :]
 
 
 # ---------------------------------------------------------------------------

@@ -19,5 +19,6 @@ from . import structured_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
 from . import amp_ops  # noqa: F401
 from . import dist_ops  # noqa: F401

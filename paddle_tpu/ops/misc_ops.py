@@ -1,0 +1,283 @@
+"""Long-tail ops: ranking/regression losses and image-manipulation ops.
+
+Reference analogs (paddle/fluid/operators/): kldiv_loss_op.cc,
+margin_rank_loss_op.cc, rank_loss_op.cc, hinge_loss_op.cc, bpr_loss_op.cc,
+maxout_op.cc, selu_op.cc, pixel_shuffle_op.cc, shuffle_channel_op.cc,
+affine_channel_op.cc, grid_sampler_op.cc (cuDNN spatial sampler), crop_op.cc,
+im2sequence_op.cc, chunk_eval_op.cc.
+
+All pure JAX lowerings; grads derive automatically via vjp (registry.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.fluid.registry import simple_op
+
+
+@simple_op("kldiv_loss", ["X", "Target"], ["Loss"], no_grad_inputs=("Target",))
+def _kldiv_loss(ctx, x, target, attrs):
+    """KL(target || exp(x)) with x = log-probabilities (kldiv_loss_op.cc):
+    loss = target * (log(target) - x).  reduction: none/batchmean/mean/sum."""
+    reduction = attrs.get("reduction", "mean")
+    t = target.astype(jnp.float32)
+    out = t * (jnp.where(t > 0, jnp.log(jnp.maximum(t, 1e-30)), 0.0)
+               - x.astype(jnp.float32))
+    out = jnp.where(t > 0, out, 0.0)
+    if reduction == "none":
+        return out.astype(x.dtype)
+    if reduction == "batchmean":
+        return (jnp.sum(out) / x.shape[0]).astype(x.dtype)
+    if reduction == "sum":
+        return jnp.sum(out).astype(x.dtype)
+    return jnp.mean(out).astype(x.dtype)
+
+
+@simple_op("margin_rank_loss", ["X1", "X2", "Label"], ["Out", "Activated"],
+           no_grad_inputs=("Label",))
+def _margin_rank_loss(ctx, x1, x2, label, attrs):
+    """max(0, -label*(x1-x2) + margin) (margin_rank_loss_op.cc); label in
+    {1, -1} says whether x1 should rank higher."""
+    margin = float(attrs.get("margin", 0.0))
+    out = jnp.maximum(0.0, -label.astype(jnp.float32)
+                      * (x1 - x2).astype(jnp.float32) + margin)
+    return out.astype(x1.dtype), (out > 0).astype(x1.dtype)
+
+
+@simple_op("rank_loss", ["Left", "Right", "Label"], ["Out"],
+           no_grad_inputs=("Label",))
+def _rank_loss(ctx, left, right, label, attrs):
+    """RankNet pairwise loss (rank_loss_op.cc): o = left - right;
+    loss = log(1 + exp(o)) - label * o."""
+    o = (left - right).astype(jnp.float32)
+    return (jax.nn.softplus(o) - label.astype(jnp.float32) * o).astype(left.dtype)
+
+
+@simple_op("hinge_loss", ["Logits", "Labels"], ["Loss"],
+           no_grad_inputs=("Labels",))
+def _hinge_loss(ctx, logits, labels, attrs):
+    """max(0, 1 - (2*label - 1) * pred) (hinge_loss_op.cc), labels in {0,1}."""
+    sign = 2.0 * labels.astype(jnp.float32) - 1.0
+    return jnp.maximum(0.0, 1.0 - sign * logits.astype(jnp.float32)
+                       ).astype(logits.dtype)
+
+
+@simple_op("bpr_loss", ["X", "Label"], ["Y"], no_grad_inputs=("Label",))
+def _bpr_loss(ctx, x, label, attrs):
+    """Bayesian Personalized Ranking loss (bpr_loss_op.cc): for each row of
+    logits x [B, C] with positive class `label`, loss = -mean_{j != y}
+    log(sigmoid(x_y - x_j))."""
+    b, c = x.shape
+    lbl = jnp.reshape(label, (-1,)).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, lbl[:, None], axis=1)  # [B,1]
+    diff = (pos - x).astype(jnp.float32)
+    loss = -jnp.log(jax.nn.sigmoid(diff) + 1e-12)
+    mask = jnp.arange(c)[None, :] != lbl[:, None]
+    return (jnp.sum(jnp.where(mask, loss, 0.0), axis=1, keepdims=True)
+            / (c - 1)).astype(x.dtype)
+
+
+@simple_op("maxout", ["X"], ["Out"])
+def _maxout(ctx, x, attrs):
+    """Channel max pooling (maxout_op.cc): [N, C, H, W] → [N, C/groups, H, W]
+    taking max over each group of `groups` consecutive channels."""
+    groups = int(attrs["groups"])
+    n, c, h, w = x.shape
+    return jnp.max(jnp.reshape(x, (n, c // groups, groups, h, w)), axis=2)
+
+
+@simple_op("selu", ["X"], ["Out"])
+def _selu(ctx, x, attrs):
+    scale = float(attrs.get("scale", 1.0507009873554805))
+    alpha = float(attrs.get("alpha", 1.6732632423543772))
+    x32 = x.astype(jnp.float32)
+    return (scale * jnp.where(x32 > 0, x32, alpha * (jnp.exp(x32) - 1.0))
+            ).astype(x.dtype)
+
+
+@simple_op("pixel_shuffle", ["X"], ["Out"])
+def _pixel_shuffle(ctx, x, attrs):
+    """[N, C*r², H, W] → [N, C, H*r, W*r] (pixel_shuffle_op.cc)."""
+    r = int(attrs.get("upscale_factor", 1))
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    x = jnp.reshape(x, (n, oc, r, r, h, w))
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))  # n, oc, h, r, w, r
+    return jnp.reshape(x, (n, oc, h * r, w * r))
+
+
+@simple_op("shuffle_channel", ["X"], ["Out"])
+def _shuffle_channel(ctx, x, attrs):
+    """ShuffleNet channel shuffle (shuffle_channel_op.cc)."""
+    group = int(attrs.get("group", 1))
+    n, c, h, w = x.shape
+    x = jnp.reshape(x, (n, group, c // group, h, w))
+    x = jnp.swapaxes(x, 1, 2)
+    return jnp.reshape(x, (n, c, h, w))
+
+
+@simple_op("affine_channel", ["X", "Scale", "Bias"], ["Out"],
+           optional=("Scale", "Bias"))
+def _affine_channel(ctx, x, scale, bias, attrs):
+    """Per-channel x*scale + bias (affine_channel_op.cc — folded-BN form);
+    absent Scale/Bias act as identity."""
+    layout = attrs.get("data_layout", "NCHW")
+    shape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)
+    out = x
+    if scale is not None:
+        out = out * jnp.reshape(scale, shape)
+    if bias is not None:
+        out = out + jnp.reshape(bias, shape)
+    return out
+
+
+@simple_op("grid_sampler", ["X", "Grid"], ["Output"], no_grad_inputs=())
+def _grid_sampler(ctx, x, grid, attrs):
+    """Bilinear spatial sampling (grid_sampler_op.cc, cuDNN
+    SpatialTfSampler): X [N,C,H,W], Grid [N,Ho,Wo,2] in [-1,1] (x, y) →
+    [N,C,Ho,Wo].  Zero padding outside."""
+    n, c, h, w = x.shape
+    gx = (grid[..., 0].astype(jnp.float32) + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1].astype(jnp.float32) + 1.0) * (h - 1) / 2.0
+
+    def sample_one(img, xs, ys):  # img [C,H,W]; xs/ys [Ho,Wo]
+        x0 = jnp.floor(xs)
+        y0 = jnp.floor(ys)
+        lx = xs - x0
+        ly = ys - y0
+
+        def tap(yi, xi):
+            inside = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+            v = img[:, jnp.clip(yi, 0, h - 1).astype(jnp.int32),
+                    jnp.clip(xi, 0, w - 1).astype(jnp.int32)]
+            return jnp.where(inside[None], v, 0.0)
+
+        return (tap(y0, x0) * (1 - ly) * (1 - lx)
+                + tap(y0, x0 + 1) * (1 - ly) * lx
+                + tap(y0 + 1, x0) * ly * (1 - lx)
+                + tap(y0 + 1, x0 + 1) * ly * lx)
+
+    out = jax.vmap(sample_one)(x.astype(jnp.float32), gx, gy)
+    return out.astype(x.dtype)
+
+
+@simple_op("crop", ["X", "Offsets"], ["Out"], optional=("Offsets",),
+           no_grad_inputs=("Offsets",))
+def _crop(ctx, x, offsets, attrs):
+    """Static crop (crop_op.cc): take `shape` starting at `offsets`."""
+    shape = [int(s) for s in attrs["shape"]]
+    if offsets is not None:  # tensor offsets → dynamic_slice
+        starts = jnp.reshape(offsets, (-1,)).astype(jnp.int32)
+        return lax.dynamic_slice(x, [starts[i] for i in range(x.ndim)],
+                                 shape)
+    off = [int(v) for v in attrs.get("offsets", [0] * x.ndim)]
+    return lax.slice(x, off, [o + s for o, s in zip(off, shape)])
+
+
+@simple_op("im2sequence", ["X"], ["Out"])
+def _im2sequence(ctx, x, attrs):
+    """Image → patch sequence (im2sequence_op.cc): [N,C,H,W] with kernel
+    [kh,kw], stride [sh,sw] → [N, T, C*kh*kw] where T = out_h*out_w
+    (dense analog of the reference's LoD output of total patches).
+    paddings: [h, w] symmetric or the reference's 4-element
+    [up, left, down, right]."""
+    kh, kw = [int(k) for k in attrs["kernels"]]
+    sh, sw = [int(s) for s in attrs.get("strides", [1, 1])]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0])]
+    if len(pads) == 2:
+        pu, pl, pd, pr = pads[0], pads[1], pads[0], pads[1]
+    elif len(pads) == 4:
+        pu, pl, pd, pr = pads
+    else:
+        raise ValueError(f"im2sequence: paddings must have 2 or 4 elements, "
+                         f"got {pads}")
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pu, pd), (pl, pr)))
+    oh = (h + pu + pd - kh) // sh + 1
+    ow = (w + pl + pr - kw) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(xp[:, :, i:i + oh * sh:sh, j:j + ow * sw:sw])
+    # [N, C*kh*kw, oh, ow] → [N, oh*ow, C*kh*kw]
+    stk = jnp.concatenate(patches, axis=1)
+    stk = jnp.reshape(stk, (n, c * kh * kw, oh * ow))
+    return jnp.swapaxes(stk, 1, 2)
+
+
+@simple_op("chunk_eval",
+           ["Inference", "Label", "Length"],
+           ["Precision", "Recall", "F1-Score", "NumInferChunks",
+            "NumLabelChunks", "NumCorrectChunks"],
+           optional=("Length",), grad=None)
+def _chunk_eval(ctx, infer, label, length, attrs):
+    """Chunking precision/recall/F1 (chunk_eval_op.cc), IOB scheme:
+    tag encoding t = chunk_type * num_tag_types + tag_type with tag_type
+    0 = B, 1 = I; `excluded_chunk_types` and other schemes are reduced to
+    IOB semantics.  Tags >= num_chunk_types*2 (e.g. O) are outside."""
+    scheme = attrs.get("chunk_scheme", "IOB")
+    if scheme != "IOB":
+        raise NotImplementedError(
+            f"chunk_eval: scheme {scheme!r} not supported (IOB only; "
+            f"plain/IOE/IOBES use different tag encodings)")
+    num_chunk_types = int(attrs["num_chunk_types"])
+    b = infer.shape[0]
+    t = infer.shape[1]
+    inf = jnp.reshape(infer, (b, t)).astype(jnp.int32)
+    lbl = jnp.reshape(label, (b, t)).astype(jnp.int32)
+    valid = (jnp.arange(t)[None, :] <
+             (jnp.reshape(length, (-1, 1)).astype(jnp.int32)
+              if length is not None else jnp.full((b, 1), t, jnp.int32)))
+
+    def stats(tags):
+        inside = (tags >= 0) & (tags < num_chunk_types * 2) & valid
+        ctype = jnp.where(inside, tags // 2, -1)
+        is_b = inside & (tags % 2 == 0)
+        prev_ctype = jnp.pad(ctype[:, :-1], ((0, 0), (1, 0)),
+                             constant_values=-1)
+        prev_inside = jnp.pad(inside[:, :-1], ((0, 0), (1, 0)))
+        # chunk begins at B, or at I following outside/different type
+        begin = inside & (is_b | ~prev_inside | (prev_ctype != ctype))
+        return begin, inside, ctype
+
+    bi, ii, ti = stats(inf)
+    bl, il, tl = stats(lbl)
+    n_inf = jnp.sum(bi)
+    n_lbl = jnp.sum(bl)
+
+    # correct chunk = begins at the same position with the same type AND
+    # ends at the same position.  Scan time-major carrying "match alive":
+    #   inf_cont/lbl_cont: that side's chunk continues into this position
+    #   match survives only while BOTH continue; it counts as correct when
+    #   it is alive and BOTH stop continuing at the same position (a new
+    #   both_begin may start a fresh match at that very position).
+    both_begin = bi & bl & (ti == tl)
+    inf_cont = ii & ~bi
+    lbl_cont = il & ~bl
+
+    def step(m, xs):
+        begin_t, icont_t, lcont_t = xs
+        ended = m & ~icont_t & ~lcont_t
+        carry = (m & icont_t & lcont_t) | begin_t
+        return carry, ended
+
+    carry, ended = lax.scan(
+        step, jnp.zeros((b,), bool),
+        (jnp.swapaxes(both_begin, 0, 1), jnp.swapaxes(inf_cont, 0, 1),
+         jnp.swapaxes(lbl_cont, 0, 1)))
+    n_correct = jnp.sum(ended) + jnp.sum(carry)
+
+    prec = jnp.where(n_inf > 0, n_correct / jnp.maximum(n_inf, 1), 0.0)
+    rec = jnp.where(n_lbl > 0, n_correct / jnp.maximum(n_lbl, 1), 0.0)
+    f1 = jnp.where(prec + rec > 0, 2 * prec * rec /
+                   jnp.maximum(prec + rec, 1e-9), 0.0)
+    # int32 counts: JAX x64 is disabled, so int64 would silently truncate
+    # and desync from the declared Variable dtype
+    return (prec.astype(jnp.float32), rec.astype(jnp.float32),
+            f1.astype(jnp.float32), n_inf.astype(jnp.int32),
+            n_lbl.astype(jnp.int32), n_correct.astype(jnp.int32))

@@ -1,0 +1,285 @@
+"""Long-tail loss/image ops vs numpy references (reference analogs:
+tests/unittests/test_kldiv_loss_op.py, test_rank_loss_op.py,
+test_maxout_op.py, test_pixel_shuffle.py, test_grid_sampler_op.py,
+test_chunk_eval_op.py, ...)."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers
+
+
+def _run(build_fn, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        outs = build_fn()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=[o.name for o in outs])
+
+
+def test_kldiv_loss():
+    rng = np.random.RandomState(0)
+    t = rng.dirichlet(np.ones(5), 4).astype("float32")
+    x = np.log(rng.dirichlet(np.ones(5), 4)).astype("float32")
+
+    def build():
+        xv = fluid.data("x", [-1, 5], False, dtype="float32")
+        tv = fluid.data("t", [-1, 5], False, dtype="float32")
+        return [layers.kldiv_loss(xv, tv, reduction="none"),
+                layers.kldiv_loss(xv, tv, reduction="batchmean")]
+
+    none, bm = _run(build, {"x": x, "t": t})
+    expect = t * (np.log(t) - x)
+    np.testing.assert_allclose(none, expect, atol=1e-5)
+    np.testing.assert_allclose(bm, expect.sum() / 4, rtol=1e-5)
+
+
+def test_rank_and_margin_and_hinge_losses():
+    rng = np.random.RandomState(1)
+    l = rng.randn(6, 1).astype("float32")
+    r = rng.randn(6, 1).astype("float32")
+    lbl = rng.randint(0, 2, (6, 1)).astype("float32")
+
+    def build():
+        lv = fluid.data("l", [-1, 1], False, dtype="float32")
+        rv = fluid.data("r", [-1, 1], False, dtype="float32")
+        yv = fluid.data("y", [-1, 1], False, dtype="float32")
+        return [layers.rank_loss(yv, lv, rv),
+                layers.margin_rank_loss(yv, lv, rv, margin=0.2),
+                layers.hinge_loss(lv, yv)]
+
+    rank, margin, hinge = _run(build, {"l": l, "r": r, "y": lbl})
+    o = l - r
+    np.testing.assert_allclose(rank, np.log1p(np.exp(o)) - lbl * o, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(margin, np.maximum(0, -lbl * o + 0.2),
+                               atol=1e-5)
+    np.testing.assert_allclose(hinge,
+                               np.maximum(0, 1 - (2 * lbl - 1) * l), atol=1e-5)
+
+
+def test_bpr_loss():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 6).astype("float32")
+    y = rng.randint(0, 6, (4, 1)).astype("int64")
+
+    def build():
+        xv = fluid.data("x", [-1, 6], False, dtype="float32")
+        yv = fluid.data("y", [-1, 1], False, dtype="int64")
+        return [layers.bpr_loss(xv, yv)]
+
+    (out,), = _run(build, {"x": x, "y": y}),
+    for i in range(4):
+        pos = x[i, y[i, 0]]
+        terms = [-np.log(1 / (1 + np.exp(-(pos - x[i, j]))) + 1e-12)
+                 for j in range(6) if j != y[i, 0]]
+        np.testing.assert_allclose(out[i, 0], np.mean(terms), rtol=1e-4)
+
+
+def test_maxout_and_selu():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 6, 3, 3).astype("float32")
+
+    def build():
+        xv = fluid.data("x", [-1, 6, 3, 3], False, dtype="float32")
+        return [layers.maxout(xv, groups=2), layers.selu(xv)]
+
+    mo, se = _run(build, {"x": x})
+    np.testing.assert_allclose(mo, x.reshape(2, 3, 2, 3, 3).max(axis=2),
+                               atol=1e-6)
+    a, s = 1.6732632423543772, 1.0507009873554805
+    np.testing.assert_allclose(
+        se, s * np.where(x > 0, x, a * (np.exp(x) - 1)), rtol=2e-5, atol=1e-6)
+
+
+def test_pixel_shuffle_and_shuffle_channel():
+    x = np.arange(2 * 8 * 2 * 2, dtype="float32").reshape(2, 8, 2, 2)
+
+    def build():
+        xv = fluid.data("x", [-1, 8, 2, 2], False, dtype="float32")
+        return [layers.pixel_shuffle(xv, 2), layers.shuffle_channel(xv, 4)]
+
+    ps, sc = _run(build, {"x": x})
+    assert ps.shape == (2, 2, 4, 4)
+    # torch-style pixel shuffle reference
+    r = x.reshape(2, 2, 2, 2, 2, 2).transpose(0, 1, 4, 2, 5, 3)
+    np.testing.assert_allclose(ps, r.reshape(2, 2, 4, 4), atol=1e-6)
+    expect_sc = x.reshape(2, 4, 2, 2, 2).swapaxes(1, 2).reshape(2, 8, 2, 2)
+    np.testing.assert_allclose(sc, expect_sc, atol=1e-6)
+
+
+def test_affine_channel():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    sc = rng.randn(3).astype("float32")
+    b = rng.randn(3).astype("float32")
+
+    def build():
+        xv = fluid.data("x", [-1, 3, 4, 4], False, dtype="float32")
+        sv = fluid.data("s", [3], False, dtype="float32")
+        bv = fluid.data("b", [3], False, dtype="float32")
+        return [layers.affine_channel(xv, sv, bv)]
+
+    (out,), = _run(build, {"x": x, "s": sc, "b": b}),
+    np.testing.assert_allclose(
+        out, x * sc[None, :, None, None] + b[None, :, None, None], atol=1e-5)
+
+
+def test_grid_sampler_identity():
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 2, 5, 5).astype("float32")
+    # identity grid
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = np.stack([xs, ys], axis=-1)[None].astype("float32")
+
+    def build():
+        xv = fluid.data("x", [-1, 2, 5, 5], False, dtype="float32")
+        gv = fluid.data("g", [-1, 5, 5, 2], False, dtype="float32")
+        return [layers.grid_sampler(xv, gv)]
+
+    (out,), = _run(build, {"x": x, "g": grid}),
+    np.testing.assert_allclose(out, x, atol=1e-4)
+
+
+def test_crop_static_and_dynamic():
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+
+    def build():
+        xv = fluid.data("x", [2, 3, 4], False, dtype="float32")
+        ov = fluid.data("off", [3], False, dtype="int32")
+        return [layers.crop(xv, shape=[1, 2, 2], offsets=[1, 0, 1]),
+                layers.crop(xv, shape=[1, 2, 2], offsets=ov)]
+
+    st, dy = _run(build, {"x": x, "off": np.array([1, 0, 1], "int32")})
+    np.testing.assert_allclose(st, x[1:2, 0:2, 1:3], atol=1e-6)
+    np.testing.assert_allclose(dy, st, atol=1e-6)
+
+
+def test_im2sequence_patches():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+
+    def build():
+        xv = fluid.data("x", [-1, 1, 4, 4], False, dtype="float32")
+        return [layers.im2sequence(xv, filter_size=2, stride=2)]
+
+    (out,), = _run(build, {"x": x}),
+    assert out.shape == (1, 4, 4)
+    np.testing.assert_allclose(out[0, 0], [0, 1, 4, 5], atol=1e-6)
+    np.testing.assert_allclose(out[0, 3], [10, 11, 14, 15], atol=1e-6)
+
+
+def test_chunk_eval_iob():
+    # tags: chunk_type*2 + {0:B, 1:I}; O = 2*num_chunk_types
+    # label:  B0 I0 O  B1 I1   infer: B0 I0 O  B1 O
+    lbl = np.array([[0, 1, 4, 2, 3]], "int64")
+    inf = np.array([[0, 1, 4, 2, 4]], "int64")
+
+    def build():
+        iv = fluid.data("i", [-1, 5], False, dtype="int64")
+        lv = fluid.data("l", [-1, 5], False, dtype="int64")
+        return list(layers.chunk_eval(iv, lv, "IOB", 2))
+
+    p, r, f1, ni, nl, nc = _run(build, {"i": inf, "l": lbl})
+    # infer chunks: (0-1, t0), (3, t1); label chunks: (0-1, t0), (3-4, t1)
+    assert int(ni) == 2 and int(nl) == 2
+    assert int(nc) == 1  # only the t0 chunk matches extents
+    np.testing.assert_allclose(p, 0.5)
+    np.testing.assert_allclose(r, 0.5)
+    np.testing.assert_allclose(f1, 0.5)
+
+
+def test_chunk_eval_perfect():
+    lbl = np.array([[0, 1, 4, 2, 3], [2, 4, 0, 1, 1]], "int64")
+
+    def build():
+        iv = fluid.data("i", [-1, 5], False, dtype="int64")
+        lv = fluid.data("l", [-1, 5], False, dtype="int64")
+        return list(layers.chunk_eval(iv, lv, "IOB", 2))
+
+    p, r, f1, ni, nl, nc = _run(build, {"i": lbl, "l": lbl})
+    assert int(ni) == int(nl) == int(nc) == 4
+    np.testing.assert_allclose(f1, 1.0)
+
+
+def test_losses_train():
+    """The new losses all propagate gradients."""
+    rng = np.random.RandomState(6)
+    x = rng.randn(8, 4).astype("float32")
+    y = rng.randint(0, 4, (8, 1)).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 4], False, dtype="float32")
+        yv = fluid.data("y", [-1, 1], False, dtype="int64")
+        h = layers.fc(xv, size=8, act="relu")
+        logits = layers.fc(h, size=4)
+        loss = layers.mean(layers.bpr_loss(logits, yv))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (l0,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss.name])
+        for _ in range(20):
+            (l1,) = exe.run(main, feed={"x": x, "y": y},
+                            fetch_list=[loss.name])
+    assert float(l1) < float(l0)
+
+
+def test_im2sequence_gradient_flows():
+    rng = np.random.RandomState(7)
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 2, 4, 4], False, dtype="float32")
+        h = layers.conv2d(xv, num_filters=2, filter_size=3, padding=1)
+        seq = layers.im2sequence(h, filter_size=2, stride=2)
+        loss = layers.reduce_mean(seq)
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (l0,) = exe.run(main, feed={"x": x}, fetch_list=[loss.name])
+        (l1,) = exe.run(main, feed={"x": x}, fetch_list=[loss.name])
+    assert float(l0) != float(l1)  # gradients flow, params moved
+
+
+def test_im2sequence_asymmetric_padding():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+
+    def build():
+        xv = fluid.data("x", [-1, 1, 4, 4], False, dtype="float32")
+        return [layers.im2sequence(xv, filter_size=2, stride=2,
+                                   padding=[0, 0, 2, 2])]
+
+    (out,), = _run(build, {"x": x}),
+    assert out.shape == (1, 9, 4)  # (4+0+2-2)/2+1 = 3 per axis
+
+
+def test_affine_channel_identity_defaults():
+    x = np.ones((1, 2, 3, 3), "float32")
+
+    def build():
+        xv = fluid.data("x", [-1, 2, 3, 3], False, dtype="float32")
+        return [layers.affine_channel(xv)]
+
+    (out,), = _run(build, {"x": x}),
+    np.testing.assert_allclose(out, x)
+
+
+def test_chunk_eval_rejects_unknown_scheme():
+    import pytest
+
+    def build():
+        iv = fluid.data("i", [-1, 4], False, dtype="int64")
+        lv = fluid.data("l", [-1, 4], False, dtype="int64")
+        return list(layers.chunk_eval(iv, lv, "IOE", 2))
+
+    with pytest.raises(Exception, match="IOE"):
+        _run(build, {"i": np.zeros((1, 4), "int64"),
+                     "l": np.zeros((1, 4), "int64")})

@@ -38,6 +38,8 @@ from . import metrics  # noqa: F401
 from . import dataset  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
 from . import profiler  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import io_utils  # noqa: F401
 from . import contrib  # noqa: F401
 from . import incubate  # noqa: F401
 from . import transpiler  # noqa: F401

@@ -1,0 +1,71 @@
+"""Program visualization (reference python/paddle/fluid/debugger.py +
+graphviz.py and ir/graph_viz_pass.cc): dump a Program's op graph as DOT for
+graphviz / draw_program summaries."""
+
+from __future__ import annotations
+
+__all__ = ["draw_block_graphviz", "program_to_dot", "pprint_program"]
+
+_OP_STYLE = 'shape=box, style="rounded,filled", fillcolor="#d5e8f8"'
+_VAR_STYLE = 'shape=ellipse, fillcolor="#eef3d2", style=filled'
+_PARAM_STYLE = 'shape=ellipse, fillcolor="#f8d5d5", style=filled'
+
+
+def program_to_dot(program, block_idx=0, max_label=40):
+    """Render one block as a DOT digraph string (op boxes, var ellipses,
+    parameters highlighted) — the graph_viz_pass analog."""
+    block = program.block(block_idx)
+    lines = ["digraph G {", "  rankdir=TB;"]
+    seen_vars = {}
+
+    def esc(label):  # DOT double-quoted strings: escape backslash + quote
+        return label.replace("\\", "\\\\").replace('"', '\\"')
+
+    def var_node(name):
+        if name in seen_vars:
+            return seen_vars[name]
+        vid = f"var_{len(seen_vars)}"
+        seen_vars[name] = vid
+        v = block._find_var_recursive(name)
+        style = _PARAM_STYLE if (v is not None and v.persistable) else _VAR_STYLE
+        label = name if len(name) <= max_label else name[:max_label] + "…"
+        lines.append(f'  {vid} [label="{esc(label)}", {style}];')
+        return vid
+
+    for i, op in enumerate(block.ops):
+        oid = f"op_{i}"
+        lines.append(f'  {oid} [label="{esc(op.type)}", {_OP_STYLE}];')
+        for slot, names in op.inputs.items():
+            for n in names:
+                if n:
+                    lines.append(f"  {var_node(n)} -> {oid};")
+        for slot, names in op.outputs.items():
+            for n in names:
+                if n:
+                    lines.append(f"  {oid} -> {var_node(n)};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def draw_block_graphviz(block_or_program, path="program.dot", block_idx=0):
+    """Write the DOT file (view with `dot -Tsvg program.dot`)."""
+    program = getattr(block_or_program, "program", block_or_program)
+    dot = program_to_dot(program, block_idx=block_idx)
+    with open(path, "w") as f:
+        f.write(dot)
+    return path
+
+
+def pprint_program(program, with_shapes=True):
+    """Readable text dump of every block's ops (debugger.pprint_program_codes
+    analog)."""
+    out = []
+    for bi in range(len(program.blocks)):
+        block = program.block(bi)
+        out.append(f"-- block {bi} ({len(block.ops)} ops) --")
+        for op in block.ops:
+            ins = ", ".join(f"{s}={n}" for s, ns in op.inputs.items()
+                            for n in ns)
+            outs = ", ".join(n for ns in op.outputs.values() for n in ns)
+            out.append(f"  {op.type}({ins}) -> {outs}")
+    return "\n".join(out)

@@ -1,0 +1,26 @@
+"""DLPack tensor interop (reference paddle/fluid/framework/dlpack_tensor.cc):
+zero-copy exchange of device buffers with other frameworks (torch, cupy,
+numpy≥1.23) via the DLPack protocol.  On TPU the exchange is host-mediated
+for foreign consumers; chip-resident buffers exchange zero-copy between JAX
+arrays."""
+
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(value):
+    """Export a scope value / jax array / numpy array as a DLPack-protocol
+    object (implements __dlpack__/__dlpack_device__; consumable by
+    torch.from_dlpack, np.from_dlpack, cupy, ...)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(value)
+
+
+def from_dlpack(capsule_or_tensor):
+    """Import a DLPack capsule (or any object with __dlpack__, e.g. a torch
+    tensor) as a jax array usable as a feed value."""
+    from jax import dlpack as jdl
+
+    return jdl.from_dlpack(capsule_or_tensor)

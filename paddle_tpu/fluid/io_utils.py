@@ -1,0 +1,106 @@
+"""Filesystem helpers (reference paddle/fluid/framework/io/fs.cc + shell.cc):
+uniform local/HDFS file access by shelling out, as the reference's C++ fs
+layer does.  Used by dataset/checkpoint paths that accept `hdfs://` URIs."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["exists", "ls", "makedirs", "remove", "copy", "is_hdfs_path",
+           "shell"]
+
+
+def is_hdfs_path(path):
+    return str(path).startswith(("hdfs://", "afs://"))
+
+
+def shell(cmd, timeout=120):
+    """Run a shell command, returning stdout (reference shell.cc
+    shell_get_command_output)."""
+    r = subprocess.run(cmd, shell=True, capture_output=True, text=True,
+                      timeout=timeout)
+    if r.returncode != 0:
+        raise RuntimeError(f"shell command failed ({r.returncode}): {cmd}\n"
+                           f"{r.stderr[-500:]}")
+    return r.stdout
+
+
+def _hadoop(args, timeout=120):
+    """Run `hadoop fs` with an argv list (no shell interpolation — paths with
+    spaces/metacharacters stay single arguments).  Returns
+    (returncode, stdout, stderr); raises on a missing binary / timeout so
+    environment problems aren't mistaken for filesystem answers."""
+    try:
+        r = subprocess.run(["hadoop", "fs"] + list(args), capture_output=True,
+                           text=True, timeout=timeout)
+    except FileNotFoundError:
+        raise RuntimeError(
+            "hadoop binary not found — cannot access hdfs:// paths")
+    return r.returncode, r.stdout, r.stderr
+
+
+def _hadoop_ok(args, timeout=120):
+    rc, out, err = _hadoop(args, timeout=timeout)
+    if rc != 0:
+        raise RuntimeError(f"hadoop fs {' '.join(args)} failed ({rc}):\n"
+                           f"{err[-500:]}")
+    return out
+
+
+def exists(path):
+    if is_hdfs_path(path):
+        # `-test -e` exits 1 for "absent"; anything else (auth failure,
+        # unreachable namenode) is an environment error, not an answer
+        rc, _out, err = _hadoop(["-test", "-e", str(path)])
+        if rc == 0:
+            return True
+        if rc == 1:
+            # exit 1 = "absent"; but hadoop also exits 1 on connection
+            # failures, which must surface, not read as "missing checkpoint"
+            lowered = err.lower()
+            if "exception" in lowered or "refused" in lowered:
+                raise RuntimeError(f"hadoop -test -e {path} failed:\n"
+                                   f"{err[-500:]}")
+            return False
+        raise RuntimeError(f"hadoop -test -e {path} failed ({rc}):\n"
+                           f"{err[-500:]}")
+    return os.path.exists(path)
+
+
+def ls(path):
+    if is_hdfs_path(path):
+        out = _hadoop_ok(["-ls", str(path)])
+        return [line.split()[-1] for line in out.splitlines()
+                if line and not line.startswith("Found")]
+    return sorted(os.path.join(path, p) for p in os.listdir(path))
+
+
+def makedirs(path):
+    if is_hdfs_path(path):
+        _hadoop_ok(["-mkdir", "-p", str(path)])
+    else:
+        os.makedirs(path, exist_ok=True)
+
+
+def remove(path):
+    if is_hdfs_path(path):
+        # deletes of large trees can be slow: no timeout
+        _hadoop_ok(["-rm", "-r", str(path)], timeout=None)
+    elif os.path.isdir(path):
+        shutil.rmtree(path)
+    elif os.path.exists(path):
+        os.remove(path)
+
+
+def copy(src, dst):
+    # data transfers scale with object size: no timeout
+    if is_hdfs_path(src) and not is_hdfs_path(dst):
+        _hadoop_ok(["-get", str(src), str(dst)], timeout=None)
+    elif not is_hdfs_path(src) and is_hdfs_path(dst):
+        _hadoop_ok(["-put", str(src), str(dst)], timeout=None)
+    elif is_hdfs_path(src):
+        _hadoop_ok(["-cp", str(src), str(dst)], timeout=None)
+    else:
+        shutil.copy(src, dst)

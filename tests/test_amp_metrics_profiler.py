@@ -207,3 +207,38 @@ def test_chrome_trace_export(tmp_path):
     assert "span_a" in names and "span_b" in names
     assert all(e["ph"] == "X" and e["ts"] >= 0 for e in data["traceEvents"])
     assert len(events) == 2
+
+
+def test_debugger_dot_and_pprint():
+    import numpy as np
+
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid import debugger
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", [-1, 4], False, dtype="float32")
+        h = fluid.layers.fc(x, size=3, act="relu")
+        loss = fluid.layers.mean(h)
+    dot = debugger.program_to_dot(main)
+    assert dot.startswith("digraph") and "mul" in dot and "relu" in dot
+    txt = debugger.pprint_program(main)
+    assert "block 0" in txt and "mean" in txt
+
+
+def test_op_bench_tool(tmp_path):
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    r = subprocess.run(
+        [sys.executable, str(repo / "tools" / "op_bench.py"), "relu",
+         "--shape", "X=8,16", "-n", "3"],
+        capture_output=True, text=True,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(repo)})
+    assert r.returncode == 0, r.stderr
+    data = json.loads(r.stdout.strip().splitlines()[-1])
+    assert data["op"] == "relu" and data["latency_us"] > 0

@@ -115,3 +115,43 @@ def test_program_json_roundtrip():
     assert set(p2.global_block().vars) == set(main.global_block().vars)
     # parameters keep their class so save_params predicate still works
     assert len(p2.global_block().all_parameters()) == len(main.global_block().all_parameters())
+
+
+def test_dlpack_roundtrip():
+    import numpy as np
+
+    from paddle_tpu.fluid import dlpack
+
+    x = np.arange(12, dtype="float32").reshape(3, 4)
+    cap = dlpack.to_dlpack(x)
+    back = np.asarray(dlpack.from_dlpack(cap))
+    np.testing.assert_allclose(back, x)
+
+
+def test_dlpack_from_torch():
+    import numpy as np
+
+    import pytest
+    torch = pytest.importorskip("torch")
+
+    from paddle_tpu.fluid import dlpack
+
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    arr = np.asarray(dlpack.from_dlpack(t))
+    np.testing.assert_allclose(arr, t.numpy())
+
+
+def test_io_utils_local_fs(tmp_path):
+    from paddle_tpu.fluid import io_utils
+
+    d = tmp_path / "sub"
+    io_utils.makedirs(str(d))
+    assert io_utils.exists(str(d))
+    f = d / "a.txt"
+    f.write_text("hi")
+    assert str(f) in io_utils.ls(str(d))
+    io_utils.copy(str(f), str(d / "b.txt"))
+    assert io_utils.exists(str(d / "b.txt"))
+    io_utils.remove(str(d))
+    assert not io_utils.exists(str(d))
+    assert "ok" in io_utils.shell("echo ok")

@@ -1,0 +1,115 @@
+"""Config-driven single-op latency benchmark (reference
+paddle/fluid/operators/benchmark/op_tester.cc + operators/jit/benchmark.cc).
+
+Builds a one-op program exactly like tests/op_test.py, runs it through the
+production executor (whole-op XLA compile), and reports per-run latency
+after warmup — compile time reported separately.
+
+Usage:
+  python tools/op_bench.py softmax --shape X=256,1024
+  python tools/op_bench.py matmul --shape X=512,512 --shape Y=512,512 -n 100
+  python tools/op_bench.py conv2d --shape Input=8,64,56,56 \
+      --shape Filter=64,64,3,3 --attr strides=1,1 --attr paddings=1,1 \
+      --in-slot Input --in-slot Filter --out-slot Output
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("op_type")
+    ap.add_argument("--shape", action="append", default=[],
+                    help="SLOT=d0,d1,... (repeatable)")
+    ap.add_argument("--attr", action="append", default=[],
+                    help="name=value (ints/floats/csv-lists auto-parsed)")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--in-slot", action="append", default=None,
+                    help="input slot order override")
+    ap.add_argument("--out-slot", action="append", default=None)
+    ap.add_argument("-n", "--steps", type=int, default=50)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid import registry
+
+    info = registry.get_op(args.op_type)
+    shapes = {}
+    for spec in args.shape:
+        slot, dims = spec.split("=")
+        shapes[slot] = [int(d) for d in dims.split(",")]
+
+    def parse_val(v):
+        if "," in v:
+            return [parse_val(x) for x in v.split(",")]
+        for cast in (int, float):
+            try:
+                return cast(v)
+            except ValueError:
+                pass
+        return {"true": True, "false": False}.get(v.lower(), v)
+
+    attrs = {}
+    for spec in args.attr:
+        name, v = spec.split("=", 1)
+        attrs[name] = parse_val(v)
+
+    in_slots = args.in_slot or [s.rstrip("*") for s in info.input_slots
+                                if s.rstrip("*") in shapes]
+    out_slots = args.out_slot or [info.canonical_outputs[0]]
+
+    rng = np.random.RandomState(0)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    feed = {}
+    with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
+        block = main_prog.global_block()
+        in_arg = {}
+        for slot in in_slots:
+            name = f"bench_{slot.lower()}"
+            arr = rng.uniform(-1, 1, shapes[slot]).astype(args.dtype)
+            block.create_var(name=name, shape=arr.shape, dtype=args.dtype,
+                             stop_gradient=True, is_data=True)
+            feed[name] = arr
+            in_arg[slot] = [name]
+        out_arg = {}
+        for slot in out_slots:
+            name = f"bench_out_{slot.lower()}"
+            block.create_var(name=name, stop_gradient=True)
+            out_arg[slot] = [name]
+        block.append_op(args.op_type, inputs=in_arg, outputs=out_arg,
+                        attrs=attrs)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        fetch = [out_arg[out_slots[0]][0]]
+        t0 = time.perf_counter()
+        exe.run(main_prog, feed=feed, fetch_list=fetch)
+        compile_s = time.perf_counter() - t0
+        exe.run(main_prog, feed=feed, fetch_list=fetch)  # warm
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            exe.run(main_prog, feed=feed, fetch_list=fetch)
+        dt = (time.perf_counter() - t0) / args.steps
+
+    print(json.dumps({
+        "op": args.op_type,
+        "shapes": shapes, "attrs": attrs, "dtype": args.dtype,
+        "latency_us": round(dt * 1e6, 2),
+        "compile_s": round(compile_s, 3),
+        "steps": args.steps,
+    }))
+
+
+if __name__ == "__main__":
+    main()

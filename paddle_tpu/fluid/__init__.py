@@ -40,6 +40,8 @@ from .dataset import DatasetFactory  # noqa: F401
 from . import profiler  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import io_utils  # noqa: F401
+from . import flags  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
 from . import contrib  # noqa: F401
 from . import incubate  # noqa: F401
 from . import transpiler  # noqa: F401

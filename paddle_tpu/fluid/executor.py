@@ -355,9 +355,36 @@ class _CompiledBlock:
             # block on scope writes too — a run with an empty fetch_list (or
             # a startup run) would otherwise record async-dispatch time only
             timer.done(fetches, out_writes)
+        from . import flags as _flags
+
+        if _flags.flag("benchmark"):
+            # force completion each step (reference operator.cc:949 forces a
+            # dev_ctx->Wait() per op under FLAGS_benchmark)
+            jax.block_until_ready((fetches, out_writes))
+        if _flags.flag("check_nan_inf"):
+            self._check_nan_inf(out_writes, fetches)
         # RPC/IO ops run host-side after the device step, in program order
         self.plan.run_host_ops(scope, self.place)
         return self.plan.assemble_fetches(fetches, scope)
+
+    def _check_nan_inf(self, out_writes, fetches):
+        """FLAGS_check_nan_inf (reference operator.cc:953-984): scan every
+        written float var and raise naming the first non-finite one."""
+        import jax.numpy as jnp
+
+        named = list(out_writes.items()) + list(
+            zip(self.plan.jit_fetch_names, fetches))
+        for name, val in named:
+            try:
+                arr = jnp.asarray(val)
+            except TypeError:  # non-array fetch
+                continue
+            if not jnp.issubdtype(arr.dtype, jnp.floating):
+                continue
+            if not bool(jnp.isfinite(arr).all()):
+                raise RuntimeError(
+                    f"FLAGS_check_nan_inf: variable {name!r} contains "
+                    f"NaN/Inf after {self.label}")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,106 @@
+"""Global flag system (reference: ~95 C++ gflags surfaced to Python by
+`__bootstrap__` reading FLAGS_* env vars + core.init_gflags,
+python/paddle/fluid/__init__.py:124-180 / pybind.cc:988).
+
+TPU-native subset: flags that change observable behavior here are
+implemented (executor hooks); CUDA-memory / allocator flags are accepted for
+script compatibility but are no-ops (PJRT owns device memory) — setting one
+emits a warning.
+
+Env bootstrap: any FLAGS_<name> environment variable seen at import time
+seeds the corresponding flag, exactly like the reference's __bootstrap__.
+A malformed value warns and keeps the default (an unimportable package is
+worse than an ignored flag).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+__all__ = ["get_flags", "set_flags"]
+
+_FALSY = ("0", "false", "off", "no", "")
+
+
+def _parse_bool(v):
+    return str(v).strip().lower() not in _FALSY
+
+
+# name -> (default, parser, implemented?)  — `implemented` False means the
+# flag is accepted for compatibility but changes nothing on TPU
+_DEFS = {
+    # debugging / determinism (executor hooks; RNG is deterministic by
+    # design so cpu_deterministic=True is the native behavior)
+    "FLAGS_check_nan_inf": (False, _parse_bool, True),
+    "FLAGS_benchmark": (False, _parse_bool, True),
+    "FLAGS_cpu_deterministic": (True, _parse_bool, True),
+    # distributed (consumed by the PS/RPC host ops)
+    "FLAGS_rpc_deadline": (180000, int, True),
+    # accepted no-ops (CUDA/allocator knobs with no TPU meaning)
+    "FLAGS_fraction_of_gpu_memory_to_use": (0.92, float, False),
+    "FLAGS_eager_delete_tensor_gb": (-1.0, float, False),
+    "FLAGS_allocator_strategy": ("naive_best_fit", str, False),
+    "FLAGS_use_ngraph": (False, _parse_bool, False),
+    "FLAGS_fast_eager_deletion_mode": (True, _parse_bool, False),
+    "FLAGS_use_pinned_memory": (True, _parse_bool, False),
+    "FLAGS_init_allocated_mem": (False, _parse_bool, False),
+    "FLAGS_limit_of_tmp_allocation": (-1, int, False),
+}
+
+_VALUES = {}
+
+
+def _bootstrap():
+    """Seed flags from FLAGS_* env vars (reference __bootstrap__)."""
+    for name, (default, parser, _impl) in _DEFS.items():
+        _VALUES[name] = default
+        env = os.environ.get(name)
+        if env is None:
+            continue
+        try:
+            _VALUES[name] = parser(env)
+        except (ValueError, TypeError):
+            warnings.warn(
+                f"ignoring malformed env {name}={env!r} (expected "
+                f"{parser.__name__}); using default {default!r}")
+
+
+def _norm(name):
+    return name if name.startswith("FLAGS_") else "FLAGS_" + name
+
+
+def get_flags(names):
+    """Read flag values.  names: str or list of str (with or without the
+    FLAGS_ prefix).  Returns a dict keyed by the given names."""
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        key = _norm(n)
+        if key not in _VALUES:
+            raise KeyError(f"unknown flag {n!r}; known: {sorted(_DEFS)}")
+        out[n] = _VALUES[key]
+    return out
+
+
+def set_flags(flags):
+    """Set flag values from a dict (paddle.set_flags API shape).  Setting a
+    compatibility no-op flag warns that it has no TPU effect."""
+    for n, v in flags.items():
+        key = _norm(n)
+        if key not in _DEFS:
+            raise KeyError(f"unknown flag {n!r}; known: {sorted(_DEFS)}")
+        _default, parser, implemented = _DEFS[key]
+        _VALUES[key] = parser(v) if isinstance(v, str) else v
+        if not implemented:
+            warnings.warn(f"{key} is accepted for compatibility but has no "
+                          f"effect on TPU")
+
+
+def flag(name):
+    """Internal fast accessor used by the executor hot path."""
+    return _VALUES[_norm(name)]
+
+
+_bootstrap()

@@ -31,9 +31,13 @@ def _no_lower(ctx, *a, attrs):  # pragma: no cover
 class _Channel:
     def __init__(self, endpoint):
         from paddle_tpu import native
+        from paddle_tpu.fluid import flags
 
         host, port = endpoint.rsplit(":", 1)
-        self.client = native.PSClient(host=host, port=int(port))
+        # FLAGS_rpc_deadline is ms (reference grpc_client.cc deadline)
+        self.client = native.PSClient(
+            host=host, port=int(port),
+            timeout=flags.flag("rpc_deadline") / 1000.0)
         self.round = 0  # completed sync rounds (== param version to want)
 
 

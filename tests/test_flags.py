@@ -1,0 +1,90 @@
+"""Flag system + FLAGS_check_nan_inf (reference __bootstrap__ env flags,
+operator.cc:953 nan/inf guard)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+
+
+def test_get_set_flags():
+    assert fluid.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] in (
+        True, False)
+    fluid.set_flags({"FLAGS_rpc_deadline": 5000})
+    assert fluid.get_flags("rpc_deadline")["rpc_deadline"] == 5000
+    with pytest.raises(KeyError):
+        fluid.set_flags({"FLAGS_nonexistent": 1})
+
+
+def test_check_nan_inf_catches_bad_var():
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+                fluid.unique_name.guard():
+            x = fluid.data("x", [-1, 4], False, dtype="float32")
+            y = fluid.layers.log(x)  # log of a negative → NaN
+            exe = fluid.Executor(fluid.CPUPlace())
+            with pytest.raises(RuntimeError, match="NaN/Inf"):
+                exe.run(main, feed={"x": -np.ones((2, 4), "float32")},
+                        fetch_list=[y.name])
+        # clean runs pass
+        with fluid.scope_guard(fluid.Scope()):
+            exe2 = fluid.Executor(fluid.CPUPlace())
+            (out,) = exe2.run(main, feed={"x": np.ones((2, 4), "float32")},
+                              fetch_list=[y.name])
+            assert np.all(np.isfinite(out))
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_env_bootstrap(monkeypatch):
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    monkeypatch.setenv("FLAGS_rpc_deadline", "1234")
+    importlib.reload(fl)
+    assert fl.get_flags("rpc_deadline")["rpc_deadline"] == 1234
+    monkeypatch.delenv("FLAGS_rpc_deadline")
+    importlib.reload(fl)  # restore defaults for other tests
+
+
+def test_malformed_env_flag_warns_not_crashes(monkeypatch):
+    import importlib
+    import warnings as w
+
+    from paddle_tpu.fluid import flags as fl
+
+    monkeypatch.setenv("FLAGS_rpc_deadline", "3m")  # malformed
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        importlib.reload(fl)
+    assert any("malformed" in str(r.message) for r in rec)
+    assert fl.get_flags("rpc_deadline")["rpc_deadline"] == 180000  # default
+    monkeypatch.delenv("FLAGS_rpc_deadline")
+    importlib.reload(fl)
+
+
+def test_falsy_spellings_parse_false():
+    from paddle_tpu.fluid import flags as fl
+
+    for spelling in ("0", "false", "FALSE", "off", "no"):
+        fl.set_flags({"FLAGS_check_nan_inf": spelling})
+        assert fl.get_flags("check_nan_inf")["check_nan_inf"] is False
+    fl.set_flags({"FLAGS_check_nan_inf": "1"})
+    assert fl.get_flags("check_nan_inf")["check_nan_inf"] is True
+    fl.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_noop_flag_warns():
+    import warnings as w
+
+    from paddle_tpu.fluid import flags as fl
+
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        fl.set_flags({"FLAGS_use_ngraph": True})
+    assert any("no effect" in str(r.message) for r in rec)
+    fl.set_flags({"FLAGS_use_ngraph": False})

@@ -1,1 +1,2 @@
 from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401

@@ -45,6 +45,7 @@ __all__ = [
     "kldiv_loss", "margin_rank_loss", "rank_loss", "hinge_loss", "bpr_loss",
     "maxout", "selu", "pixel_shuffle", "shuffle_channel", "affine_channel",
     "grid_sampler", "crop", "im2sequence", "chunk_eval",
+    "softmax_mask_fuse_upper_triangle",
 ]
 
 
@@ -1311,6 +1312,15 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types, length=None,
     o = outs
     return (o["Precision"], o["Recall"], o["F1-Score"],
             o["NumInferChunks"], o["NumLabelChunks"], o["NumCorrectChunks"])
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal softmax: softmax(x) with the upper triangle (future positions)
+    masked to -inf, fused (reference fused/fused_softmax_mask_upper_triangle
+    family).  x: [..., S, S] attention scores."""
+    helper = LayerHelper("softmax_mask_fuse_upper_triangle", name=name)
+    return _single_out_layer(helper, "softmax_mask_fuse_upper_triangle",
+                             {"X": [x]})
 
 
 def flash_attention(q, k, v, attn_bias=None, causal=False, sm_scale=None,

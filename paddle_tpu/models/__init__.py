@@ -11,3 +11,4 @@ inference script needs.
 from . import mlp  # noqa: F401
 from . import resnet  # noqa: F401
 from . import bert  # noqa: F401
+from . import gpt  # noqa: F401

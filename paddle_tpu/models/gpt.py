@@ -1,0 +1,218 @@
+"""GPT-style causal decoder LM, Fluid graph-building style.
+
+The reference era predates decoder-only LMs as a first-class family (its
+Transformer lives in dist_transformer.py, encoder-decoder); this model
+extends the zoo with the TPU-first pattern: pre-LN blocks, causal
+flash-attention Pallas kernel (or the fused upper-triangle softmax op on the
+composed path), weight-tied LM head, and a statically-unrolled beam/greedy
+generation program built from the dense beam_search ops.
+
+Parameter names follow the BERT zoo convention ("decoder_layer_N_...") so
+the Megatron tensor-parallel sharder maps them by the same patterns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.initializer import Normal
+from paddle_tpu.fluid.param_attr import ParamAttr
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=32000, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_position=1024,
+                 hidden_dropout=0.1, initializer_range=0.02,
+                 use_flash_attention=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.hidden_dropout = hidden_dropout
+        self.initializer_range = initializer_range
+        self.use_flash_attention = use_flash_attention
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                 intermediate_size=128, max_position=128)
+        d.update(kw)
+        return cls(**d)
+
+
+def _fc(x, size, name, act=None, init_std=0.02, nfd=2):
+    return layers.fc(
+        x, size=size, num_flatten_dims=nfd, act=act,
+        param_attr=ParamAttr(name=name + ".w_0",
+                             initializer=Normal(0.0, init_std)),
+        bias_attr=ParamAttr(name=name + ".b_0"))
+
+
+def _ln(x, name, axis=2):
+    return layers.layer_norm(x, begin_norm_axis=axis,
+                             param_attr=ParamAttr(name=name + "_scale"),
+                             bias_attr=ParamAttr(name=name + "_bias"))
+
+
+def causal_self_attention(x, cfg: GPTConfig, name, is_test=False):
+    h, n = cfg.hidden_size, cfg.num_heads
+    d = h // n
+    q = _fc(x, h, name + "_query_fc", init_std=cfg.initializer_range)
+    k = _fc(x, h, name + "_key_fc", init_std=cfg.initializer_range)
+    v = _fc(x, h, name + "_value_fc", init_std=cfg.initializer_range)
+
+    def to_heads(t):
+        r = layers.reshape(t, shape=[0, 0, n, d])
+        return layers.transpose(r, perm=[0, 2, 1, 3])  # [B, n, S, d]
+
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    if cfg.use_flash_attention:
+        ctx = layers.flash_attention(q, k, v, causal=True,
+                                     sm_scale=float(d) ** -0.5)
+    else:
+        scores = layers.matmul(q, k, transpose_y=True, alpha=float(d) ** -0.5)
+        # fused causal softmax (upper triangle masked to -inf)
+        probs = layers.softmax_mask_fuse_upper_triangle(scores)
+        ctx = layers.matmul(probs, v)
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = layers.reshape(ctx, shape=[0, 0, h])
+    return _fc(ctx, h, name + "_output_fc", init_std=cfg.initializer_range)
+
+
+def decoder_layer(x, cfg: GPTConfig, name, is_test=False):
+    # pre-LN (GPT-2 style): x + attn(ln(x)); x + ffn(ln(x))
+    attn = causal_self_attention(_ln(x, name + "_ln_attn"), cfg,
+                                 name + "_att", is_test=is_test)
+    if cfg.hidden_dropout and not is_test:
+        attn = layers.dropout(attn, dropout_prob=cfg.hidden_dropout,
+                              is_test=is_test,
+                              dropout_implementation="upscale_in_train")
+    x = layers.elementwise_add(x, attn)
+    ffn = _fc(_ln(x, name + "_ln_ffn"), cfg.intermediate_size,
+              name + "_ffn_fc_0", act="gelu",
+              init_std=cfg.initializer_range)
+    ffn = _fc(ffn, cfg.hidden_size, name + "_ffn_fc_1",
+              init_std=cfg.initializer_range)
+    if cfg.hidden_dropout and not is_test:
+        ffn = layers.dropout(ffn, dropout_prob=cfg.hidden_dropout,
+                             is_test=is_test,
+                             dropout_implementation="upscale_in_train")
+    return layers.elementwise_add(x, ffn)
+
+
+def gpt_decoder(ids, pos_ids, cfg: GPTConfig, is_test=False):
+    """Embeddings + N pre-LN causal blocks + final LN.  Returns [B,S,H]."""
+    emb = layers.embedding(
+        ids, size=[cfg.vocab_size, cfg.hidden_size],
+        param_attr=ParamAttr(name="gpt_word_embedding",
+                             initializer=Normal(0.0, cfg.initializer_range)))
+    pos = layers.embedding(
+        pos_ids, size=[cfg.max_position, cfg.hidden_size],
+        param_attr=ParamAttr(name="gpt_pos_embedding",
+                             initializer=Normal(0.0, cfg.initializer_range)))
+    x = layers.elementwise_add(emb, pos)
+    if cfg.hidden_dropout and not is_test:
+        x = layers.dropout(x, dropout_prob=cfg.hidden_dropout,
+                           is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    for i in range(cfg.num_layers):
+        x = decoder_layer(x, cfg, f"decoder_layer_{i}", is_test=is_test)
+    return _ln(x, "gpt_final_ln")
+
+
+def _lm_logits(h, cfg: GPTConfig):
+    """Weight-tied LM head: logits = h @ word_embedding^T."""
+    word_emb = fluid.default_main_program().global_block().var(
+        "gpt_word_embedding")
+    flat = layers.reshape(h, shape=[-1, cfg.hidden_size])
+    logits = layers.matmul(flat, word_emb, transpose_y=True)
+    return logits  # [B*S, V]
+
+
+def build_gpt_lm(cfg: GPTConfig = None, is_test=False):
+    """Causal-LM training graph.  Feeds: ids [B,S] int64, labels [B,S]
+    int64 (next tokens).  Returns (feed_names, loss)."""
+    cfg = cfg or GPTConfig()
+    ids = fluid.data("gpt_ids", [-1, -1], False, dtype="int64")
+    pos_ids = fluid.data("gpt_pos_ids", [-1, -1], False, dtype="int64")
+    labels = fluid.data("gpt_labels", [-1, -1], False, dtype="int64")
+
+    h = gpt_decoder(ids, pos_ids, cfg, is_test=is_test)
+    logits = _lm_logits(h, cfg)
+    lbl = layers.reshape(labels, shape=[-1, 1])
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, lbl))
+    return ["gpt_ids", "gpt_pos_ids", "gpt_labels"], loss
+
+
+def build_gpt_generate(cfg: GPTConfig, prompt_len, gen_len, beam_size=1,
+                       end_id=0):
+    """Statically-unrolled generation program (greedy when beam_size=1).
+
+    Recomputes the full prefix each step — O(S²) per sequence but every
+    step is one compiled XLA program; a KV-cache variant trades memory for
+    compute.  Returns (prompt_var, sentence_ids [B, K, gen_len],
+    final_beam_scores [B, K])."""
+    L = layers
+    prompt = fluid.data("gpt_prompt", [-1, prompt_len], False, dtype="int64")
+
+    k = beam_size
+    # beams: maintain the full token history [B, K, cur_len]
+    hist = L.stack([prompt] * k, axis=1)  # [B, K, P]
+    pre_ids = L.slice(hist, axes=[2], starts=[prompt_len - 1],
+                      ends=[prompt_len])
+    pre_ids = L.reshape(pre_ids, shape=[-1, k])
+    init_bias = np.zeros((1, k), "float32")
+    init_bias[0, 1:] = -1e9  # only beam 0 alive at step 0
+    pre_scores = L.fill_constant_batch_size_like(
+        prompt, shape=[-1, k], dtype="float32", value=0.0)
+    pre_scores = pre_scores + L.assign(init_bias)
+
+    step_ids, step_parents = [], []
+    for t in range(gen_len):
+        cur = prompt_len + t
+        flat = L.reshape(hist, shape=[-1, cur])          # [B*K, cur]
+        pos = L.fill_constant_batch_size_like(
+            flat, shape=[-1, cur], dtype="int64", value=0)
+        pos = L.elementwise_add(pos, L.assign(
+            np.arange(cur, dtype="int64")[None, :]))
+        h = gpt_decoder(flat, pos, cfg, is_test=True)
+        last = L.slice(h, axes=[1], starts=[cur - 1], ends=[cur])
+        logits = _lm_logits(last, cfg)                   # [B*K, V]
+        logp = L.log_softmax(logits)
+        logp3 = L.reshape(logp, shape=[-1, k, cfg.vocab_size])
+        ids, scores, parent = L.beam_search(pre_ids, pre_scores, logp3,
+                                            beam_size=k, end_id=end_id)
+        # reorder histories by parent and append the chosen tokens
+        onehot = L.one_hot(parent, k)                    # [B,K,K]
+        hist_f = L.cast(hist, "float32")
+        hist = L.cast(L.matmul(onehot, hist_f), "int64")
+        hist = L.concat([hist, L.unsqueeze(ids, axes=[2])], axis=2)
+        pre_ids, pre_scores = ids, scores
+        step_ids.append(L.unsqueeze(ids, axes=[0]))
+        step_parents.append(L.unsqueeze(L.cast(parent, "int32"), axes=[0]))
+
+    sent = L.beam_search_decode(L.concat(step_ids, axis=0),
+                                L.concat(step_parents, axis=0),
+                                end_id=end_id)
+    return prompt, sent, pre_scores
+
+
+def make_fake_lm_batch(cfg: GPTConfig, batch, seq_len, seed=0):
+    """Deterministic next-token task: token t+1 = (token t * 3 + 7) % V —
+    fully learnable, so tiny models converge fast."""
+    rng = np.random.RandomState(seed)
+    first = rng.randint(0, cfg.vocab_size, (batch, 1))
+    seq = [first]
+    for _ in range(seq_len):
+        seq.append((seq[-1] * 3 + 7) % cfg.vocab_size)
+    toks = np.concatenate(seq, axis=1).astype("int64")
+    return {
+        "gpt_ids": toks[:, :seq_len],
+        "gpt_pos_ids": np.tile(np.arange(seq_len, dtype="int64"),
+                               (batch, 1)),
+        "gpt_labels": toks[:, 1:seq_len + 1],
+    }

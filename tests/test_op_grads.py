@@ -1,0 +1,197 @@
+"""Numeric-vs-analytic gradient checks for op families added after the core
+set (reference analog: per-op check_grad in tests/unittests/test_*_op.py).
+check_grad builds its own sum-loss, so no hand-computed outputs are needed —
+this is pure d(loss)/d(input) central-difference validation through the
+whole trace→jit→vjp pipeline."""
+
+import numpy as np
+
+from tests.op_test import OpTest
+
+
+def _mk(op_type, inputs, attrs=None, outputs=None):
+    """Build an OpTest subclass instance on the fly."""
+
+    class T(OpTest):
+        pass
+
+    t = T("run_placeholder")
+    t.op_type = op_type
+    t.inputs = inputs
+    t.attrs = attrs or {}
+    t.outputs = outputs or {}
+    return t
+
+
+# OpTest is a unittest.TestCase; give it a dummy method to instantiate
+def _patch():
+    def run_placeholder(self):  # pragma: no cover
+        pass
+
+    OpTest.run_placeholder = run_placeholder
+
+
+_patch()
+
+
+def _rng():
+    """Per-test RandomState: values must not depend on which other tests ran
+    (a shared module-level generator made failures order-dependent)."""
+    return np.random.RandomState(42)
+
+
+def test_conv2d_transpose_grad():
+    rng = _rng()
+    t = _mk("conv2d_transpose",
+            {"Input": rng.uniform(-1, 1, (1, 2, 4, 4)).astype("float32"),
+             "Filter": rng.uniform(-0.5, 0.5, (2, 3, 3, 3)).astype("float32")},
+            {"strides": [2, 2], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1},
+            {"Output": np.zeros((1, 3, 7, 7), "float32")})
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.02)
+
+
+def test_group_norm_grad():
+    rng = _rng()
+    x = rng.uniform(-1, 1, (2, 4, 3, 3)).astype("float32")
+    t = _mk("group_norm",
+            {"X": x, "Scale": rng.uniform(0.5, 1.5, (4,)).astype("float32"),
+             "Bias": rng.uniform(-0.5, 0.5, (4,)).astype("float32")},
+            {"groups": 2, "epsilon": 1e-5},
+            {"Y": np.zeros_like(x)})
+    t.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.03,
+                 numeric_delta=5e-3)
+
+
+def test_instance_norm_grad():
+    rng = _rng()
+    x = rng.uniform(-1, 1, (2, 3, 4, 4)).astype("float32")
+    t = _mk("instance_norm",
+            {"X": x, "Scale": rng.uniform(0.5, 1.5, (3,)).astype("float32"),
+             "Bias": rng.uniform(-0.5, 0.5, (3,)).astype("float32")},
+            {"epsilon": 1e-5}, {"Y": np.zeros_like(x)})
+    # sum(Y) is invariant to x under normalization (degenerate gradient);
+    # weight the loss to make d loss/dx non-trivial
+    w = rng.uniform(0.5, 1.5, x.shape).astype("float32")
+    # normalization grads are noisy under fp32 central differences; the
+    # reference uses loosened per-op tolerances for *_norm too
+    t.check_grad(["X", "Scale"], "Y", max_relative_error=0.06,
+                 numeric_delta=5e-3, loss_weights=w)
+
+
+def test_prelu_elu_selu_grads():
+    rng = _rng()
+    x = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    # keep |x| away from 0 where the kink makes numeric grads unstable
+    x = np.where(np.abs(x) < 0.1, 0.3, x).astype("float32")
+    t = _mk("prelu", {"X": x,
+                      "Alpha": np.asarray([0.25], "float32")},
+            {"mode": "all"}, {"Out": np.zeros_like(x)})
+    t.check_grad(["X", "Alpha"], "Out", max_relative_error=0.02)
+    t = _mk("elu", {"X": x}, {"alpha": 1.0}, {"Out": np.zeros_like(x)})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+    t = _mk("selu", {"X": x}, {}, {"Out": np.zeros_like(x)})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_maxout_grad():
+    rng = _rng()
+    # well-separated values within each max group: a tie would let the
+    # numeric perturbation flip the argmax and diverge from the analytic
+    # subgradient
+    x = rng.permutation(np.linspace(-1, 1, 16)).reshape(
+        1, 4, 2, 2).astype("float32")
+    t = _mk("maxout", {"X": x}, {"groups": 2},
+            {"Out": np.zeros((1, 2, 2, 2), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_pixel_shuffle_grad():
+    rng = _rng()
+    x = rng.uniform(-1, 1, (1, 4, 2, 2)).astype("float32")
+    t = _mk("pixel_shuffle", {"X": x}, {"upscale_factor": 2},
+            {"Out": np.zeros((1, 1, 4, 4), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_kldiv_loss_grad():
+    rng = _rng()
+    x = np.log(rng.dirichlet(np.ones(4), 3)).astype("float32")
+    tgt = rng.dirichlet(np.ones(4), 3).astype("float32")
+    t = _mk("kldiv_loss", {"X": x, "Target": tgt}, {"reduction": "mean"},
+            {"Loss": np.zeros((), "float32")})
+    t.check_grad(["X"], "Loss", max_relative_error=0.02)
+
+
+def test_grid_sampler_grad():
+    rng = _rng()
+    x = rng.uniform(-1, 1, (1, 2, 4, 4)).astype("float32")
+    # keep sample points interior so bilinear weights are smooth
+    grid = rng.uniform(-0.7, 0.7, (1, 3, 3, 2)).astype("float32")
+    t = _mk("grid_sampler", {"X": x, "Grid": grid}, {},
+            {"Output": np.zeros((1, 2, 3, 3), "float32")})
+    # X only: bilinear is piecewise-linear in Grid, so central differences
+    # straddling a cell boundary disagree with the one-sided analytic grad
+    t.check_grad(["X"], "Output", max_relative_error=0.03,
+                 numeric_delta=2e-3)
+
+
+def test_hierarchical_sigmoid_grad():
+    rng = _rng()
+    x = rng.uniform(-1, 1, (3, 5)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (7, 5)).astype("float32")
+    lbl = rng.randint(0, 8, (3, 1)).astype("int64")
+    t = _mk("hierarchical_sigmoid",
+            {"X": x, "W": w, "Label": lbl}, {"num_classes": 8},
+            {"Out": np.zeros((3, 1), "float32"),
+             "PreOut": np.zeros((3, 3), "float32")})
+    t.check_grad(["X", "W"], "Out", max_relative_error=0.02)
+
+
+def test_linear_chain_crf_grad():
+    rng = _rng()
+    em = rng.uniform(-1, 1, (2, 3, 3)).astype("float32")
+    trans = rng.uniform(-0.5, 0.5, (5, 3)).astype("float32")
+    lbl = rng.randint(0, 3, (2, 3)).astype("int64")
+    t = _mk("linear_chain_crf",
+            {"Emission": em, "Transition": trans, "Label": lbl}, {},
+            {"Alpha": np.zeros((2, 3, 3), "float32"),
+             "EmissionExps": np.zeros((2, 3, 3), "float32"),
+             "TransitionExps": np.zeros((5, 3), "float32"),
+             "LogLikelihood": np.zeros((2, 1), "float32")})
+    t.check_grad(["Emission", "Transition"], "LogLikelihood",
+                 max_relative_error=0.02)
+
+
+def test_warpctc_grad():
+    rng = _rng()
+    logits = rng.uniform(-1, 1, (2, 4, 3)).astype("float32")
+    lbl = np.array([[1, 2], [2, 1]], "int64")
+    t = _mk("warpctc", {"Logits": logits, "Label": lbl}, {"blank": 0},
+            {"WarpCTCGrad": np.zeros_like(logits),
+             "Loss": np.zeros((2, 1), "float32")})
+    t.check_grad(["Logits"], "Loss", max_relative_error=0.02)
+
+
+def test_lstm_unit_grad():
+    rng = _rng()
+    x = rng.uniform(-0.5, 0.5, (2, 8)).astype("float32")
+    c = rng.uniform(-0.5, 0.5, (2, 2)).astype("float32")
+    t = _mk("lstm_unit", {"X": x, "C_prev": c}, {"forget_bias": 0.5},
+            {"C": np.zeros((2, 2), "float32"),
+             "H": np.zeros((2, 2), "float32")})
+    t.check_grad(["X", "C_prev"], "H", max_relative_error=0.02)
+
+
+def test_gru_unit_grad():
+    rng = _rng()
+    d = 2
+    x = rng.uniform(-0.5, 0.5, (2, 3 * d)).astype("float32")
+    h = rng.uniform(-0.5, 0.5, (2, d)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (d, 3 * d)).astype("float32")
+    t = _mk("gru_unit", {"Input": x, "HiddenPrev": h, "Weight": w}, {},
+            {"Gate": np.zeros((2, 3 * d), "float32"),
+             "ResetHiddenPrev": np.zeros((2, d), "float32"),
+             "Hidden": np.zeros((2, d), "float32")})
+    t.check_grad(["Input", "HiddenPrev", "Weight"], "Hidden",
+                 max_relative_error=0.02)

@@ -62,6 +62,8 @@ class Optimizer:
 
     # -- accumulators ---------------------------------------------------
     def _add_accumulator(self, name, param, fill_value=0.0, shape=None, dtype=None):
+        """Accumulator vars are tagged is_optimizer_state so parallel
+        runners can shard them (ZeRO-style weight-update sharding)."""
         if name in self._accumulators and param.name in self._accumulators[name]:
             return self._accumulators[name][param.name]
         helper = LayerHelper(name)
@@ -69,6 +71,7 @@ class Optimizer:
             name=unique_name.generate(f"{param.name}_{name}"),
             shape=shape or list(param.shape), dtype=dtype or "float32",
             persistable=True, stop_gradient=True)
+        var.is_optimizer_state = True
         helper.set_variable_initializer(var, Constant(fill_value))
         self._accumulators.setdefault(name, {})[param.name] = var
         return var

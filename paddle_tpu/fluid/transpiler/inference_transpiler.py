@@ -1,0 +1,173 @@
+"""InferenceTranspiler (reference
+python/paddle/fluid/transpiler/inference_transpiler.py:25): offline program
+rewrites that fold training-time structure into inference form.
+
+Implemented pass: `_fuse_batch_norm` (reference :305) — conv2d (+optional
+bias) followed by batch_norm collapses into the conv itself by rescaling
+the filter and bias with the BN statistics:
+
+    w' = w * scale / sqrt(var + eps)          (per output channel)
+    b' = (b - mean) * scale / sqrt(var + eps) + bn_bias
+
+On TPU, XLA already fuses the BN *elementwise math* into the conv at run
+time, so this pass's value is different from the reference's: it removes
+the BN op and its four parameter buffers entirely (smaller program, fewer
+HBM reads, simpler quantization), not just the arithmetic.
+
+The mkldnn-specific fusions of the reference (:113-303) have no TPU analog
+— XLA's fusion subsumes them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["InferenceTranspiler"]
+
+
+class InferenceTranspiler:
+    def transpile(self, program, place=None, scope=None):
+        """Rewrite `program` in place for inference.  `scope` must hold the
+        trained parameters (defaults to the global scope)."""
+        from ..executor import global_scope
+
+        scope = scope or global_scope()
+        self._fuse_batch_norm(program, scope)
+        return program
+
+    # -- conv + bn fusion ------------------------------------------------
+    def _fuse_batch_norm(self, program, scope):
+        block = program.global_block()
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type != "batch_norm":
+                i += 1
+                continue
+            bn_in = op.inputs["X"][0]
+            producer, pidx = self._producer(block, bn_in, before=i)
+            if producer is None:
+                i += 1
+                continue
+            # accept conv2d directly or conv2d → elementwise_add(bias).
+            # The add only counts as a BIAS when its Y operand is a
+            # persistable per-channel vector on axis 1 — a residual/skip
+            # add must NOT be folded (it would corrupt the outputs)
+            bias_op = None
+            conv_op = producer
+            if producer.type == "elementwise_add":
+                conv_op, _ = self._producer(block, producer.inputs["X"][0],
+                                            before=pidx)
+                if conv_op is None or conv_op.type not in (
+                        "conv2d", "depthwise_conv2d"):
+                    i += 1
+                    continue
+                if not self._is_channel_bias(block, scope, producer):
+                    i += 1
+                    continue
+                bias_op = producer
+            elif producer.type not in ("conv2d", "depthwise_conv2d"):
+                i += 1
+                continue
+
+            w_name = conv_op.inputs["Filter"][0]
+            scale = self._param(scope, op.inputs["Scale"][0])
+            bn_bias = self._param(scope, op.inputs["Bias"][0])
+            mean = self._param(scope, op.inputs["Mean"][0])
+            var = self._param(scope, op.inputs["Variance"][0])
+            eps = float(op.attrs.get("epsilon", 1e-5))
+            factor = scale / np.sqrt(var + eps)          # [C_out]
+
+            w = self._param(scope, w_name)
+            scope.set(w_name, (w * factor.reshape(-1, 1, 1, 1)
+                               ).astype(np.float32))
+            if bias_op is not None:
+                b_name = bias_op.inputs["Y"][0]
+                b = self._param(scope, b_name)
+                scope.set(b_name,
+                          ((b - mean) * factor + bn_bias).astype(np.float32))
+                # BN output now equals the elementwise_add output: rewire
+                survivor = bias_op.outputs["Out"][0]
+            else:
+                # no existing bias: turn the BN op into an elementwise_add
+                # of the folded bias instead of deleting it
+                b_name = op.inputs["Bias"][0]
+                scope.set(b_name, ((0.0 - mean) * factor + bn_bias
+                                   ).astype(np.float32))
+                op.type = "elementwise_add"
+                op.inputs = {"X": [bn_in], "Y": [b_name]}
+                op.outputs = {"Out": [op.outputs["Y"][0]]}
+                op.attrs = {"axis": 1}
+                # in-place op mutation: invalidate the executor's compiled
+                # cache or the stale BN executable would keep running
+                program._bump_version()
+                i += 1
+                continue
+
+            # delete the BN op; redirect every later read of its output
+            bn_out = op.outputs["Y"][0]
+            block._remove_op(i)
+            self._replace_reads(block, bn_out, survivor, start=i)
+            program._bump_version()
+
+        return program
+
+    # -- helpers ---------------------------------------------------------
+    @staticmethod
+    def _param(scope, name):
+        """Fetch a parameter or fail loudly — Scope.get returning None would
+        otherwise silently poison the fold with NaNs."""
+        v = scope.get(name)
+        if v is None:
+            raise RuntimeError(
+                f"InferenceTranspiler: parameter {name!r} not found in the "
+                f"scope — pass the scope holding the trained parameters "
+                f"(transpile(program, scope=...))")
+        return np.asarray(v, np.float64)
+
+    @staticmethod
+    def _is_channel_bias(block, scope, add_op):
+        """True when the elementwise_add's Y is a per-channel bias: a
+        persistable 1-D vector broadcast on axis 1 that exists in scope."""
+        if int(add_op.attrs.get("axis", -1)) != 1:
+            return False
+        y_name = add_op.inputs["Y"][0]
+        v = block._find_var_recursive(y_name)
+        if v is None or not v.persistable:
+            return False
+        val = scope.get(y_name)
+        return val is not None and np.ndim(val) == 1
+
+    @staticmethod
+    def _producer(block, var_name, before):
+        """Last op before index `before` writing var_name, but only if no
+        other op in between also reads it (single-consumer check keeps the
+        rewrite safe)."""
+        producer = None
+        pidx = None
+        for j in range(before):
+            o = block.ops[j]
+            for names in o.outputs.values():
+                if var_name in names:
+                    producer = o
+                    pidx = j
+        if producer is None:
+            return None, None
+        # var must feed ONLY the op at `before`
+        readers = 0
+        for j in range(len(block.ops)):
+            if j == pidx:
+                continue
+            o = block.ops[j]
+            for names in o.inputs.values():
+                readers += names.count(var_name)
+        if readers != 1:
+            return None, None
+        return producer, pidx
+
+    @staticmethod
+    def _replace_reads(block, old, new, start):
+        for j in range(start, len(block.ops)):
+            o = block.ops[j]
+            for slot, names in o.inputs.items():
+                o.inputs[slot] = [new if n == old else n for n in names]

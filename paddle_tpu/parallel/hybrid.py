@@ -140,7 +140,13 @@ class HybridParallelRunner:
     """
 
     def __init__(self, program, mesh, rules: ShardingRule | None = None,
-                 feed_specs=None, scope=None):
+                 feed_specs=None, scope=None, zero_stage=0):
+        """zero_stage=1: shard optimizer-state vars (moment accumulators,
+        tagged is_optimizer_state) over the 'dp' axis on dim 0 — the
+        cross-replica weight-update sharding of arXiv:2004.13336 (ZeRO-1).
+        XLA GSPMD then keeps each replica's accumulator shard resident and
+        all-gathers the updated parameters, cutting optimizer-state memory
+        by the dp degree at the cost of one all-gather per step."""
         self.program = program
         self.mesh = mesh
         self.rules = rules or ShardingRule([])
@@ -148,6 +154,7 @@ class HybridParallelRunner:
         self._default_scope = scope
         self._cache = {}
         self._step = 0
+        self.zero_stage = int(zero_stage)
 
     def _spec(self, *axes):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -156,7 +163,22 @@ class HybridParallelRunner:
         return NamedSharding(self.mesh, P(*axes))
 
     def _param_sharding(self, name, shape):
-        return self._spec(*self.rules.spec_for(name, shape=shape, mesh=self.mesh))
+        spec = self.rules.spec_for(name, shape=shape, mesh=self.mesh)
+        if self.zero_stage >= 1 and not any(spec):
+            spec = self._zero1_spec(name, shape) or spec
+        return self._spec(*spec)
+
+    def _zero1_spec(self, name, shape):
+        """dp-shard dim 0 of optimizer-state vars (ZeRO-1) when possible."""
+        if pmesh.DATA_AXIS not in self.mesh.axis_names:
+            return None
+        dp = self.mesh.shape[pmesh.DATA_AXIS]
+        if dp <= 1 or not shape or shape[0] % dp != 0:
+            return None
+        v = self.program.global_block()._find_var_recursive(name)
+        if v is None or not getattr(v, "is_optimizer_state", False):
+            return None
+        return (pmesh.DATA_AXIS,) + (None,) * (len(shape) - 1)
 
     def run(self, scope=None, feed=None, fetch_list=None, return_numpy=True):
         scope = scope if scope is not None else self._default_scope

@@ -1,0 +1,124 @@
+"""InferenceTranspiler conv+BN folding: fused program output matches the
+original eval program (reference inference_transpiler.py _fuse_batch_norm)."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.transpiler.inference_transpiler import (
+    InferenceTranspiler)
+
+
+def _train_then_eval(with_bias):
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (4, 3, 8, 8)).astype("float32")
+    y = rng.uniform(-1, 1, (4, 1)).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 3, 8, 8], False, dtype="float32")
+        yv = fluid.data("y", [-1, 1], False, dtype="float32")
+        conv = fluid.layers.conv2d(xv, num_filters=4, filter_size=3,
+                                   padding=1,
+                                   bias_attr=None if with_bias else False)
+        bn = fluid.layers.batch_norm(conv)
+        act = fluid.layers.relu(bn)
+        pred = fluid.layers.fc(act, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, yv))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(5):  # train a bit so BN stats are non-trivial
+            exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss.name])
+
+        infer = main.clone(for_test=True)
+        (before,) = exe.run(infer, feed={"x": x}, fetch_list=[pred.name])
+
+        n_ops_before = len(infer.global_block().ops)
+        InferenceTranspiler().transpile(infer, scope=scope)
+        n_ops_after = len(infer.global_block().ops)
+        (after,) = exe.run(infer, feed={"x": x}, fetch_list=[pred.name])
+    return (np.asarray(before), np.asarray(after),
+            n_ops_before, n_ops_after,
+            [op.type for op in infer.global_block().ops])
+
+
+def test_fuse_conv_bias_bn():
+    before, after, n0, n1, op_types = _train_then_eval(with_bias=True)
+    assert "batch_norm" not in op_types
+    assert n1 == n0 - 1  # BN op removed outright
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+
+
+def test_fuse_conv_no_bias_bn():
+    before, after, n0, n1, op_types = _train_then_eval(with_bias=False)
+    assert "batch_norm" not in op_types
+    assert n1 == n0  # BN became an elementwise_add of the folded bias
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+
+
+def test_bn_with_shared_conv_output_not_fused():
+    """Safety: if the conv output feeds anything besides the BN, skip."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 3, 4, 4], False, dtype="float32")
+        conv = fluid.layers.conv2d(xv, num_filters=2, filter_size=3,
+                                   padding=1, bias_attr=False)
+        bn = fluid.layers.batch_norm(conv, is_test=True)
+        side = fluid.layers.reduce_mean(conv)  # second consumer
+        out = fluid.layers.elementwise_add(
+            bn, fluid.layers.expand_as(
+                fluid.layers.reshape(side, shape=[1, 1, 1, 1]), bn))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        infer = main.clone(for_test=True)
+        InferenceTranspiler().transpile(infer, scope=scope)
+    assert "batch_norm" in [op.type for op in infer.global_block().ops]
+
+
+def test_residual_add_not_folded():
+    """A residual (non-bias) elementwise_add before BN must not be fused."""
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (2, 4, 8, 8)).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 4, 8, 8], False, dtype="float32")
+        conv = fluid.layers.conv2d(xv, num_filters=4, filter_size=3,
+                                   padding=1, bias_attr=False)
+        res = fluid.layers.elementwise_add(conv, xv)  # residual, not bias
+        bn = fluid.layers.batch_norm(res)
+        out = fluid.layers.reduce_mean(bn)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # non-trivial BN stats
+        mean_n = next(n for n in main.global_block().vars if "mean" in n)
+        var_n = next(n for n in main.global_block().vars if ".var" in n)
+        scope.set(mean_n, np.array([0.5, -0.5, 0.2, 0.1], "float32"))
+        scope.set(var_n, np.array([2.0, 0.5, 1.5, 0.8], "float32"))
+        infer = main.clone(for_test=True)
+        (before,) = exe.run(infer, feed={"x": x}, fetch_list=[bn.name])
+        InferenceTranspiler().transpile(infer, scope=scope)
+        (after,) = exe.run(infer, feed={"x": x}, fetch_list=[bn.name])
+    assert "batch_norm" in [op.type for op in infer.global_block().ops]
+    np.testing.assert_allclose(after, before, rtol=1e-5)
+
+
+def test_missing_scope_params_raise():
+    import pytest
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 2, 4, 4], False, dtype="float32")
+        conv = fluid.layers.conv2d(xv, num_filters=2, filter_size=3,
+                                   padding=1, bias_attr=False)
+        fluid.layers.batch_norm(conv)
+    empty = fluid.Scope()  # startup never ran: params absent
+    with pytest.raises(RuntimeError, match="not found in the scope"):
+        InferenceTranspiler().transpile(main, scope=empty)

@@ -1,4 +1,8 @@
 """Model-compression toolkit (reference python/paddle/fluid/contrib/slim/):
-quantization-aware training passes.  See quantization.py."""
+quantization-aware training (quantization.py), magnitude pruning with
+masked fine-tuning (prune.py), and knowledge distillation (distillation.py).
+"""
 
 from . import quantization  # noqa: F401
+from . import prune  # noqa: F401
+from . import distillation  # noqa: F401

@@ -1,0 +1,78 @@
+"""Knowledge distillation helpers (reference contrib/slim/distillation/:
+soft-label, fc/l2 distillation losses merged into the student graph).
+
+The TPU formulation: teacher and student live in ONE program (build both
+under the same program_guard; freeze teacher vars with stop_gradient), so
+the combined forward + distillation loss compiles into a single XLA
+computation — no separate teacher inference pass."""
+
+from __future__ import annotations
+
+__all__ = ["soft_label_loss", "l2_loss", "merge"]
+
+
+def merge(teacher_program, student_program, scope=None, name_prefix="teacher_"):
+    """Graft the teacher's global-block ops/vars into the student program
+    (var names prefixed, teacher parameters frozen).  When `scope` is given,
+    the teacher's trained parameter values are copied to their prefixed
+    names so the merged program runs immediately.  Returns a map of
+    original teacher var name → merged name."""
+    block = student_program.global_block()
+    mapping = {}
+    t_block = teacher_program.global_block()
+    # idempotent: a second call (e.g. post-startup weight transfer) must not
+    # append a second copy of the teacher forward
+    already_merged = any(
+        (name_prefix + n) in block.vars
+        for n in t_block.vars if not t_block.var(n).is_data)
+    for name in t_block.vars:
+        v = t_block.var(name)
+        new_name = name if v.is_data else name_prefix + name
+        mapping[name] = new_name
+        if new_name not in block.vars:
+            block.create_var(
+                name=new_name, shape=v.shape, dtype=v.dtype,
+                persistable=v.persistable, stop_gradient=True,
+                is_data=v.is_data)
+        if scope is not None and v.persistable:
+            val = scope.get(name)
+            if val is not None:
+                # materialize a copy: aliasing the same device buffer under
+                # two scope names breaks executor buffer donation
+                import numpy as np
+
+                scope.set(new_name, np.array(val))
+    from ...framework import Operator
+
+    if already_merged:
+        return mapping
+    for op in t_block.ops:
+        block.ops.append(Operator(
+            block, op.type,
+            inputs={s: [mapping[n] for n in ns] for s, ns in op.inputs.items()},
+            outputs={s: [mapping[n] for n in ns] for s, ns in op.outputs.items()},
+            attrs=dict(op.attrs)))
+    student_program._bump_version()
+    return mapping
+
+
+def soft_label_loss(teacher_logits, student_logits, temperature=2.0):
+    """KL(teacher_T || student_T) * T² — the classic Hinton soft-label loss.
+    Both inputs are pre-softmax logits variables in the SAME program."""
+    from ... import layers
+
+    t = float(temperature)
+    teacher_soft = layers.softmax(layers.scale(teacher_logits, scale=1.0 / t))
+    teacher_soft.stop_gradient = True
+    student_log = layers.log_softmax(layers.scale(student_logits, scale=1.0 / t))
+    ce = layers.reduce_sum(
+        layers.elementwise_mul(teacher_soft, student_log), dim=-1)
+    return layers.scale(layers.mean(ce), scale=-(t * t))
+
+
+def l2_loss(teacher_feat, student_feat):
+    """Feature-map (FSP-style simplified) L2 distillation loss."""
+    from ... import layers
+
+    diff = layers.elementwise_sub(student_feat, teacher_feat)
+    return layers.reduce_mean(layers.square(diff))

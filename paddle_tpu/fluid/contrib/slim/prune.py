@@ -1,0 +1,118 @@
+"""Magnitude pruning (reference contrib/slim/prune/: Pruner/SensitivePruner
+applied over the graph).
+
+TPU-native design: pruning is a scope+program transform —
+  1. `Pruner.prune` computes per-parameter masks (global or per-layer
+     magnitude threshold), zeroes the weights in the scope, and registers
+     persistable mask buffers.
+  2. During fine-tuning the optimizer would regrow pruned weights, so
+     `apply_masks` rewrites the program to multiply each pruned parameter
+     by its mask right after its optimizer op — the mask ride-along keeps
+     sparsity exact while training stays a single XLA program.
+Sparse tensors stay dense (TPU has no sparse speedup at these shapes); the
+value is model-size reduction and the reference-API parity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Pruner", "sensitivity"]
+
+_MASK_SUFFIX = ".prune_mask"
+
+
+class Pruner:
+    def __init__(self, ratio=0.5, scope=None):
+        self.ratio = float(ratio)
+        self.scope = scope
+
+    def _scope(self):
+        if self.scope is not None:
+            return self.scope
+        from ...executor import global_scope
+
+        return global_scope()
+
+    def prune(self, program, params=None, ratios=None, place=None,
+              lazy=False):
+        """Zero the smallest-|w| fraction of each parameter and register
+        masks.  params: list of parameter names (default: every persistable
+        trainable 2D+ parameter).  ratios: optional per-param ratio list.
+        Returns {param_name: mask ndarray}."""
+        scope = self._scope()
+        block = program.global_block()
+        if params is None:
+            params = [n for n in block.vars
+                      if block.var(n).persistable
+                      and not n.endswith(_MASK_SUFFIX)  # iterative pruning
+                      and not getattr(block.var(n), "is_optimizer_state", False)
+                      and scope.get(n) is not None
+                      and np.ndim(scope.get(n)) >= 2]
+        if ratios is None:
+            ratios = [self.ratio] * len(params)
+        masks = {}
+        for name, ratio in zip(params, ratios):
+            w = np.asarray(scope.get(name))
+            k = int(round(ratio * w.size))
+            mask = np.ones(w.size, np.float32)
+            if k > 0:
+                idx = np.argsort(np.abs(w).reshape(-1))[:k]
+                mask[idx] = 0.0
+            mask = mask.reshape(w.shape)
+            scope.set(name, (w * mask).astype(w.dtype))
+            mask_name = name + _MASK_SUFFIX
+            block.create_var(name=mask_name, shape=list(w.shape),
+                             dtype="float32", persistable=True,
+                             stop_gradient=True)
+            scope.set(mask_name, mask)
+            masks[name] = mask
+        return masks
+
+    def apply_masks(self, program, params=None):
+        """Insert `param = param * mask` after each optimizer update of a
+        pruned parameter so fine-tuning cannot regrow pruned weights."""
+        from ...framework import Operator
+
+        block = program.global_block()
+        if params is None:
+            params = [n[:-len(_MASK_SUFFIX)] for n in block.vars
+                      if n.endswith(_MASK_SUFFIX)]
+        targets = set(params)
+        new_ops = []
+        for op in block.ops:
+            new_ops.append(op)
+            if op.attrs.get("op_role") != "optimize":
+                continue
+            for names in op.outputs.values():
+                for n in names:
+                    if n in targets:
+                        new_ops.append(Operator(
+                            block, "elementwise_mul",
+                            inputs={"X": [n], "Y": [n + _MASK_SUFFIX]},
+                            outputs={"Out": [n]},
+                            attrs={"op_role": "optimize"}))
+        block.ops = new_ops
+        program._bump_version()
+        return program
+
+
+def sensitivity(program, scope, param_name, eval_fn,
+                ratios=(0.1, 0.3, 0.5, 0.7, 0.9)):
+    """Reference SensitivePruner's per-layer sweep: prune `param_name` at
+    each ratio, record eval_fn() (higher = better), restore the weights.
+    Returns {ratio: metric}."""
+    w0 = np.asarray(scope.get(param_name)).copy()
+    out = {}
+    try:
+        for r in ratios:
+            k = int(round(r * w0.size))
+            mask = np.ones(w0.size, np.float32)
+            if k > 0:
+                mask[np.argsort(np.abs(w0).reshape(-1))[:k]] = 0.0
+            scope.set(param_name,
+                      (w0 * mask.reshape(w0.shape)).astype(w0.dtype))
+            out[r] = float(eval_fn())
+    finally:
+        scope.set(param_name, w0)  # restore even when eval_fn raises
+    return out

@@ -11,8 +11,9 @@ the filter and bias with the BN statistics:
 
 On TPU, XLA already fuses the BN *elementwise math* into the conv at run
 time, so this pass's value is different from the reference's: it removes
-the BN op and its four parameter buffers entirely (smaller program, fewer
-HBM reads, simpler quantization), not just the arithmetic.
+the BN op from the program (simpler graph, simpler quantization, and the
+four BN parameter vars become unreferenced so save_inference_model's
+pruning drops them), not just the arithmetic.
 
 The mkldnn-specific fusions of the reference (:113-303) have no TPU analog
 — XLA's fusion subsumes them.
@@ -69,6 +70,11 @@ class InferenceTranspiler:
             elif producer.type not in ("conv2d", "depthwise_conv2d"):
                 i += 1
                 continue
+            if conv_op.inputs.get("Bias"):
+                # conv carrying an inline Bias input: folding would need to
+                # rescale that bias too — skip rather than corrupt
+                i += 1
+                continue
 
             w_name = conv_op.inputs["Filter"][0]
             scale = self._param(scope, op.inputs["Scale"][0])
@@ -86,8 +92,6 @@ class InferenceTranspiler:
                 b = self._param(scope, b_name)
                 scope.set(b_name,
                           ((b - mean) * factor + bn_bias).astype(np.float32))
-                # BN output now equals the elementwise_add output: rewire
-                survivor = bias_op.outputs["Out"][0]
             else:
                 # no existing bias: turn the BN op into an elementwise_add
                 # of the folded bias instead of deleting it
@@ -104,10 +108,12 @@ class InferenceTranspiler:
                 i += 1
                 continue
 
-            # delete the BN op; redirect every later read of its output
+            # delete the BN op; the elementwise_add now writes the BN's
+            # output name directly, so fetch targets / sub-block reads of
+            # the BN output keep resolving
             bn_out = op.outputs["Y"][0]
             block._remove_op(i)
-            self._replace_reads(block, bn_out, survivor, start=i)
+            bias_op.outputs = {"Out": [bn_out]}
             program._bump_version()
 
         return program
@@ -164,10 +170,3 @@ class InferenceTranspiler:
         if readers != 1:
             return None, None
         return producer, pidx
-
-    @staticmethod
-    def _replace_reads(block, old, new, start):
-        for j in range(start, len(block.ops)):
-            o = block.ops[j]
-            for slot, names in o.inputs.items():
-                o.inputs[slot] = [new if n == old else n for n in names]

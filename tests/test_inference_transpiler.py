@@ -122,3 +122,25 @@ def test_missing_scope_params_raise():
     empty = fluid.Scope()  # startup never ran: params absent
     with pytest.raises(RuntimeError, match="not found in the scope"):
         InferenceTranspiler().transpile(main, scope=empty)
+
+
+def test_fused_bn_output_remains_fetchable():
+    """The BN output name must survive fusion as a fetch target."""
+    rng = np.random.RandomState(2)
+    x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        xv = fluid.data("x", [-1, 3, 8, 8], False, dtype="float32")
+        conv = fluid.layers.conv2d(xv, num_filters=4, filter_size=3,
+                                   padding=1)  # with bias
+        bn = fluid.layers.batch_norm(conv)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        infer = main.clone(for_test=True)
+        (before,) = exe.run(infer, feed={"x": x}, fetch_list=[bn.name])
+        InferenceTranspiler().transpile(infer, scope=scope)
+        # fetching the BN output name still works post-fusion
+        (after,) = exe.run(infer, feed={"x": x}, fetch_list=[bn.name])
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,153 @@
+"""Slim pruning + distillation (reference contrib/slim/prune,
+contrib/slim/distillation)."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.contrib.slim.distillation import (l2_loss, merge,
+                                                        soft_label_loss)
+from paddle_tpu.fluid.contrib.slim.prune import Pruner, sensitivity
+
+
+def test_prune_masks_and_finetune_keeps_sparsity():
+    rng = np.random.RandomState(0)
+    xd = rng.uniform(-1, 1, (32, 8)).astype("float32")
+    yd = rng.randint(0, 4, (32, 1)).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", [-1, 8], False, dtype="float32")
+        y = fluid.data("y", [-1, 1], False, dtype="int64")
+        h = fluid.layers.fc(x, size=32, act="relu",
+                            param_attr=fluid.ParamAttr(name="p_w1"))
+        logits = fluid.layers.fc(h, size=4,
+                                 param_attr=fluid.ParamAttr(name="p_w2"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(5):
+            exe.run(main, feed={"x": xd, "y": yd}, fetch_list=[loss.name])
+
+        pruner = Pruner(ratio=0.5, scope=scope)
+        masks = pruner.prune(main, params=["p_w1", "p_w2"])
+        w1 = np.asarray(scope.get("p_w1"))
+        assert abs((w1 == 0).mean() - 0.5) < 0.02  # ~50% zeros
+        pruner.apply_masks(main)
+
+        # fine-tune: sparsity must hold exactly
+        for _ in range(10):
+            exe.run(main, feed={"x": xd, "y": yd}, fetch_list=[loss.name])
+        w1 = np.asarray(scope.get("p_w1"))
+        np.testing.assert_array_equal(w1[masks["p_w1"] == 0], 0.0)
+        assert np.abs(w1[masks["p_w1"] == 1]).min() >= 0.0  # survivors live
+
+
+def test_sensitivity_sweep():
+    rng = np.random.RandomState(1)
+    xd = rng.uniform(-1, 1, (16, 6)).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", [-1, 6], False, dtype="float32")
+        out = fluid.layers.fc(x, size=1,
+                              param_attr=fluid.ParamAttr(name="s_w"))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.asarray(scope.get("s_w")).copy()
+
+        def eval_fn():
+            (o,) = exe.run(main, feed={"x": xd}, fetch_list=[out.name])
+            return -float(np.abs(np.asarray(o)).sum())  # dummy metric
+
+        res = sensitivity(main, scope, "s_w", eval_fn,
+                          ratios=(0.0, 0.5, 1.0))
+        np.testing.assert_allclose(np.asarray(scope.get("s_w")), w0)
+    assert res[1.0] == 0.0  # fully pruned → zero output
+    assert res[0.0] <= res[0.5] <= res[1.0] + 1e-9  # monotone-ish
+
+
+def test_distillation_student_learns_teacher():
+    rng = np.random.RandomState(2)
+    xd = rng.uniform(-1, 1, (64, 8)).astype("float32")
+
+    # teacher program (pretrained: fixed random projection as "knowledge")
+    teacher, t_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(teacher, t_start), fluid.unique_name.guard():
+        tx = fluid.data("x", [-1, 8], False, dtype="float32")
+        t_logits = fluid.layers.fc(tx, size=4,
+                                   param_attr=fluid.ParamAttr(name="t_w"))
+
+    student, s_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(student, s_start), fluid.unique_name.guard():
+        sx = fluid.data("x", [-1, 8], False, dtype="float32")
+        s_logits = fluid.layers.fc(sx, size=4,
+                                   param_attr=fluid.ParamAttr(name="s_w"))
+
+    mapping = merge(teacher, student)
+    with fluid.program_guard(student, s_start), fluid.unique_name.guard("kd"):
+        t_var = student.global_block().var(mapping[t_logits.name])
+        loss = soft_label_loss(t_var, s_logits, temperature=1.0)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(s_start)
+        exe.run(t_start)
+        # hand the trained teacher weights to their merged (prefixed) names
+        merge(teacher, student, scope=scope)
+        l0 = None
+        for _ in range(60):
+            (lv,) = exe.run(student, feed={"x": xd},
+                            fetch_list=[loss.name])
+            l0 = l0 or float(lv)
+        # student matches teacher logits closely after distillation
+        s_out, t_out = exe.run(
+            student, feed={"x": xd},
+            fetch_list=[s_logits.name, mapping[t_logits.name]])
+    assert float(lv) < l0 * 0.8
+    corr = np.corrcoef(np.asarray(s_out).ravel(),
+                       np.asarray(t_out).ravel())[0, 1]
+    assert corr > 0.9, corr
+
+
+def test_iterative_prune_skips_masks():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", [-1, 4], False, dtype="float32")
+        fluid.layers.fc(x, size=4, param_attr=fluid.ParamAttr(name="it_w"))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pruner = Pruner(ratio=0.25, scope=scope)
+        pruner.prune(main)
+        masks1 = np.asarray(scope.get("it_w.prune_mask")).copy()
+        pruner.prune(main)  # params=None again: must not touch masks
+    names = [n for n in main.global_block().vars if "prune_mask" in n]
+    assert all(not n.endswith(".prune_mask.prune_mask") for n in names)
+    # first-round mask only tightened (second prune re-zeroes values)
+    np.testing.assert_array_equal(
+        np.asarray(scope.get("it_w.prune_mask"))[masks1 == 0], 0)
+
+
+def test_merge_idempotent():
+    teacher, t_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(teacher, t_start), fluid.unique_name.guard():
+        tx = fluid.data("x", [-1, 4], False, dtype="float32")
+        fluid.layers.fc(tx, size=2, param_attr=fluid.ParamAttr(name="m_w"))
+    student, s_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(student, s_start), fluid.unique_name.guard():
+        sx = fluid.data("x", [-1, 4], False, dtype="float32")
+        fluid.layers.fc(sx, size=2)
+    merge(teacher, student)
+    n1 = len(student.global_block().ops)
+    merge(teacher, student)  # second call: no duplicate teacher forward
+    assert len(student.global_block().ops) == n1

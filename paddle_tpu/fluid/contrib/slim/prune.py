@@ -39,7 +39,12 @@ class Pruner:
         """Zero the smallest-|w| fraction of each parameter and register
         masks.  params: list of parameter names (default: every persistable
         trainable 2D+ parameter).  ratios: optional per-param ratio list.
-        Returns {param_name: mask ndarray}."""
+        `place` is accepted for reference-signature parity (device placement
+        is the executor's concern here).  Returns {param_name: mask}."""
+        if lazy:
+            raise NotImplementedError(
+                "lazy=True (non-destructive trial pruning) is not supported; "
+                "use sensitivity() for trial sweeps — it restores weights")
         scope = self._scope()
         block = program.global_block()
         if params is None:
@@ -102,6 +107,9 @@ def sensitivity(program, scope, param_name, eval_fn,
     """Reference SensitivePruner's per-layer sweep: prune `param_name` at
     each ratio, record eval_fn() (higher = better), restore the weights.
     Returns {ratio: metric}."""
+    if program.global_block()._find_var_recursive(param_name) is None:
+        raise KeyError(f"sensitivity: {param_name!r} is not a variable of "
+                       f"the given program")
     w0 = np.asarray(scope.get(param_name)).copy()
     out = {}
     try:

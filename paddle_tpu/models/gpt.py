@@ -58,7 +58,48 @@ def _ln(x, name, axis=2):
                              bias_attr=ParamAttr(name=name + "_bias"))
 
 
-def causal_self_attention(x, cfg: GPTConfig, name, is_test=False):
+def _attention_incremental(x_new, k_cache, v_cache, cfg: GPTConfig, name):
+    """One-token attention against cached K/V (KV-cache decode step).
+    x_new: [B', 1, H]; k_cache/v_cache: [B', n, L, d] or None (first step).
+    Returns (ctx [B', 1, H], k_cat, v_cat)."""
+    h, n = cfg.hidden_size, cfg.num_heads
+    d = h // n
+    q = _fc(x_new, h, name + "_query_fc", init_std=cfg.initializer_range)
+    k = _fc(x_new, h, name + "_key_fc", init_std=cfg.initializer_range)
+    v = _fc(x_new, h, name + "_value_fc", init_std=cfg.initializer_range)
+
+    def to_heads(t):
+        r = layers.reshape(t, shape=[0, 0, n, d])
+        return layers.transpose(r, perm=[0, 2, 1, 3])  # [B', n, 1, d]
+
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    k_cat = k if k_cache is None else layers.concat([k_cache, k], axis=2)
+    v_cat = v if v_cache is None else layers.concat([v_cache, v], axis=2)
+    scores = layers.matmul(q, k_cat, transpose_y=True,
+                           alpha=float(d) ** -0.5)   # [B', n, 1, L]
+    probs = layers.softmax(scores)  # attends only to past+self: no mask
+    ctx = layers.matmul(probs, v_cat)                # [B', n, 1, d]
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = layers.reshape(ctx, shape=[0, 0, h])
+    out = _fc(ctx, h, name + "_output_fc", init_std=cfg.initializer_range)
+    return out, k_cat, v_cat
+
+
+def decoder_layer_incremental(x, caches, cfg: GPTConfig, name):
+    """Pre-LN block on ONE new token position with KV caches.
+    caches: (k_cache, v_cache) or (None, None).  Returns (x', new caches)."""
+    attn, k_cat, v_cat = _attention_incremental(
+        _ln(x, name + "_ln_attn"), caches[0], caches[1], cfg, name + "_att")
+    x = layers.elementwise_add(x, attn)
+    ffn = _fc(_ln(x, name + "_ln_ffn"), cfg.intermediate_size,
+              name + "_ffn_fc_0", act="gelu", init_std=cfg.initializer_range)
+    ffn = _fc(ffn, cfg.hidden_size, name + "_ffn_fc_1",
+              init_std=cfg.initializer_range)
+    return layers.elementwise_add(x, ffn), (k_cat, v_cat)
+
+
+def causal_self_attention(x, cfg: GPTConfig, name, is_test=False,
+                          kv_sink=None):
     h, n = cfg.hidden_size, cfg.num_heads
     d = h // n
     q = _fc(x, h, name + "_query_fc", init_std=cfg.initializer_range)
@@ -70,6 +111,8 @@ def causal_self_attention(x, cfg: GPTConfig, name, is_test=False):
         return layers.transpose(r, perm=[0, 2, 1, 3])  # [B, n, S, d]
 
     q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    if kv_sink is not None:  # prefill: expose per-layer K/V for the cache
+        kv_sink.append((k, v))
     if cfg.use_flash_attention:
         ctx = layers.flash_attention(q, k, v, causal=True,
                                      sm_scale=float(d) ** -0.5)
@@ -83,10 +126,11 @@ def causal_self_attention(x, cfg: GPTConfig, name, is_test=False):
     return _fc(ctx, h, name + "_output_fc", init_std=cfg.initializer_range)
 
 
-def decoder_layer(x, cfg: GPTConfig, name, is_test=False):
+def decoder_layer(x, cfg: GPTConfig, name, is_test=False, kv_sink=None):
     # pre-LN (GPT-2 style): x + attn(ln(x)); x + ffn(ln(x))
     attn = causal_self_attention(_ln(x, name + "_ln_attn"), cfg,
-                                 name + "_att", is_test=is_test)
+                                 name + "_att", is_test=is_test,
+                                 kv_sink=kv_sink)
     if cfg.hidden_dropout and not is_test:
         attn = layers.dropout(attn, dropout_prob=cfg.hidden_dropout,
                               is_test=is_test,
@@ -104,8 +148,11 @@ def decoder_layer(x, cfg: GPTConfig, name, is_test=False):
     return layers.elementwise_add(x, ffn)
 
 
-def gpt_decoder(ids, pos_ids, cfg: GPTConfig, is_test=False):
-    """Embeddings + N pre-LN causal blocks + final LN.  Returns [B,S,H]."""
+def gpt_decoder(ids, pos_ids, cfg: GPTConfig, is_test=False, kv_sink=None,
+                final_ln=True):
+    """Embeddings + N pre-LN causal blocks (+ final LN).  Returns [B,S,H].
+    kv_sink: optional list collecting each layer's (K, V) [B,n,S,d] — the
+    batched prefill for KV-cache generation."""
     emb = layers.embedding(
         ids, size=[cfg.vocab_size, cfg.hidden_size],
         param_attr=ParamAttr(name="gpt_word_embedding",
@@ -120,8 +167,9 @@ def gpt_decoder(ids, pos_ids, cfg: GPTConfig, is_test=False):
                            is_test=is_test,
                            dropout_implementation="upscale_in_train")
     for i in range(cfg.num_layers):
-        x = decoder_layer(x, cfg, f"decoder_layer_{i}", is_test=is_test)
-    return _ln(x, "gpt_final_ln")
+        x = decoder_layer(x, cfg, f"decoder_layer_{i}", is_test=is_test,
+                          kv_sink=kv_sink)
+    return _ln(x, "gpt_final_ln") if final_ln else x
 
 
 def _lm_logits(h, cfg: GPTConfig):
@@ -148,6 +196,27 @@ def build_gpt_lm(cfg: GPTConfig = None, is_test=False):
     return ["gpt_ids", "gpt_pos_ids", "gpt_labels"], loss
 
 
+def _init_beam_state(prompt, prompt_len, k):
+    """Shared beam bookkeeping: last prompt token tiled to K beams and
+    scores with only beam 0 alive (so step 0 picks distinct top-K)."""
+    L = layers
+    last = L.slice(prompt, axes=[1], starts=[prompt_len - 1],
+                   ends=[prompt_len])
+    pre_ids = L.reshape(L.stack([last] * k, axis=1), shape=[-1, k])
+    bias = np.zeros((1, k), "float32")
+    bias[0, 1:] = -1e9
+    pre_scores = L.fill_constant_batch_size_like(
+        prompt, shape=[-1, k], dtype="float32", value=0.0)
+    return pre_ids, pre_scores + L.assign(bias)
+
+
+def _decode_tail(step_ids, step_parents, end_id):
+    L = layers
+    return L.beam_search_decode(L.concat(step_ids, axis=0),
+                                L.concat(step_parents, axis=0),
+                                end_id=end_id)
+
+
 def build_gpt_generate(cfg: GPTConfig, prompt_len, gen_len, beam_size=1,
                        end_id=0):
     """Statically-unrolled generation program (greedy when beam_size=1).
@@ -162,14 +231,7 @@ def build_gpt_generate(cfg: GPTConfig, prompt_len, gen_len, beam_size=1,
     k = beam_size
     # beams: maintain the full token history [B, K, cur_len]
     hist = L.stack([prompt] * k, axis=1)  # [B, K, P]
-    pre_ids = L.slice(hist, axes=[2], starts=[prompt_len - 1],
-                      ends=[prompt_len])
-    pre_ids = L.reshape(pre_ids, shape=[-1, k])
-    init_bias = np.zeros((1, k), "float32")
-    init_bias[0, 1:] = -1e9  # only beam 0 alive at step 0
-    pre_scores = L.fill_constant_batch_size_like(
-        prompt, shape=[-1, k], dtype="float32", value=0.0)
-    pre_scores = pre_scores + L.assign(init_bias)
+    pre_ids, pre_scores = _init_beam_state(prompt, prompt_len, k)
 
     step_ids, step_parents = [], []
     for t in range(gen_len):
@@ -195,9 +257,99 @@ def build_gpt_generate(cfg: GPTConfig, prompt_len, gen_len, beam_size=1,
         step_ids.append(L.unsqueeze(ids, axes=[0]))
         step_parents.append(L.unsqueeze(L.cast(parent, "int32"), axes=[0]))
 
-    sent = L.beam_search_decode(L.concat(step_ids, axis=0),
-                                L.concat(step_parents, axis=0),
-                                end_id=end_id)
+    sent = _decode_tail(step_ids, step_parents, end_id)
+    return prompt, sent, pre_scores
+
+
+def _embed_token(tok, pos_value, cfg: GPTConfig):
+    """tok: [B', 1] int64 → [B', 1, H] word+position embedding."""
+    L = layers
+    emb = L.embedding(tok, size=[cfg.vocab_size, cfg.hidden_size],
+                      param_attr=ParamAttr(name="gpt_word_embedding"))
+    pos = L.fill_constant_batch_size_like(tok, shape=[-1, 1], dtype="int64",
+                                          value=pos_value)
+    pemb = L.embedding(pos, size=[cfg.max_position, cfg.hidden_size],
+                       param_attr=ParamAttr(name="gpt_pos_embedding"))
+    # lookup_table squeezes trailing [*, 1] ids to [B, H]: restore the
+    # singleton time axis the incremental decoder layers expect
+    return L.reshape(L.elementwise_add(emb, pemb),
+                     shape=[-1, 1, cfg.hidden_size])
+
+
+def build_gpt_generate_cached(cfg: GPTConfig, prompt_len, gen_len,
+                              beam_size=1, end_id=0):
+    """KV-cache generation program: each step computes q/k/v for ONE new
+    token and attends against cached K/V — O(L) per step instead of the
+    O(L²) full-prefix recompute of build_gpt_generate.  Same beam/greedy
+    semantics; caches are reordered by beam parent each step.
+
+    Returns (prompt_var, sentence_ids [B, K, gen_len], final_scores)."""
+    L = layers
+    n, d = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    k = beam_size
+    prompt = fluid.data("gpt_prompt", [-1, prompt_len], False, dtype="int64")
+
+    # ---- prefill: ONE batched causal pass over the whole prompt that
+    # also captures every layer's K/V (no per-token unroll)
+    pos0 = L.fill_constant_batch_size_like(prompt, shape=[-1, prompt_len],
+                                           dtype="int64", value=0)
+    pos0 = L.elementwise_add(pos0, L.assign(
+        np.arange(prompt_len, dtype="int64")[None, :]))
+    kv_sink = []
+    x_full = gpt_decoder(prompt, pos0, cfg, is_test=True, kv_sink=kv_sink,
+                         final_ln=False)                    # [B, P, H]
+    caches = list(kv_sink)                                  # [(K, V)] per layer
+    last_x = L.slice(x_full, axes=[1], starts=[prompt_len - 1],
+                     ends=[prompt_len])                     # [B, 1, H]
+
+    # tile caches and state to K beams: [B, ...] → [B*K, ...]
+    def tile_beams(t):
+        if k == 1:
+            return t
+        shp = t.shape
+        r = L.stack([t] * k, axis=1)                     # [B, K, ...]
+        return L.reshape(r, shape=[-1] + [int(s) for s in shp[1:]])
+
+    caches = [(tile_beams(c[0]), tile_beams(c[1])) for c in caches]
+    h_last = tile_beams(last_x)
+
+    pre_ids, pre_scores = _init_beam_state(prompt, prompt_len, k)
+
+    def reorder_by_parent(t, parent, cur_len):
+        """t: [B*K, n, cur_len, d] gather beam dim by parent [B, K]."""
+        if k == 1:
+            return t  # greedy: the only parent is beam 0
+        numel = n * cur_len * d
+        flat = L.reshape(t, shape=[-1, k, numel])
+        onehot = L.one_hot(parent, k)                    # [B, K, K]
+        sel = L.matmul(onehot, flat)                     # [B, K, numel]
+        return L.reshape(sel, shape=[-1, n, cur_len, d])
+
+    # logits for the token AFTER the prompt come from the prefill's last h
+    x = h_last
+    step_ids, step_parents = [], []
+    for t in range(gen_len):
+        cur = prompt_len + t
+        logits = _lm_logits(_ln(x, "gpt_final_ln"), cfg)  # [B*K, V]
+        logp = L.log_softmax(logits)
+        logp3 = L.reshape(logp, shape=[-1, k, cfg.vocab_size])
+        ids, scores, parent = L.beam_search(pre_ids, pre_scores, logp3,
+                                            beam_size=k, end_id=end_id)
+        caches = [(reorder_by_parent(kc, parent, cur),
+                   reorder_by_parent(vc, parent, cur)) for kc, vc in caches]
+        tok = L.reshape(ids, shape=[-1, 1])
+        x = _embed_token(tok, cur, cfg)
+        new_caches = []
+        for li in range(cfg.num_layers):
+            x, c = decoder_layer_incremental(x, caches[li], cfg,
+                                             f"decoder_layer_{li}")
+            new_caches.append(c)
+        caches = new_caches
+        pre_ids, pre_scores = ids, scores
+        step_ids.append(L.unsqueeze(ids, axes=[0]))
+        step_parents.append(L.unsqueeze(L.cast(parent, "int32"), axes=[0]))
+
+    sent = _decode_tail(step_ids, step_parents, end_id)
     return prompt, sent, pre_scores
 
 

@@ -57,3 +57,44 @@ def test_understand_sentiment_conv(tmp_path):
         build, reader, tmp_path, epochs=6, lr=5e-3,
         feed_names=["words", "words_len"])
     assert np.mean(losses[-4:]) < 0.35, np.mean(losses[-4:])
+
+
+def build_stacked_lstm():
+    """Stacked-LSTM variant (reference stacked_lstm_net in
+    test_understand_sentiment.py: fc → dynamic_lstm stack → max pools)."""
+    HID = 32
+    words = fluid.layers.data(name="words", shape=[MAXLEN], dtype="int64")
+    words_len = fluid.layers.data(name="words_len", shape=[], dtype="int32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(words, size=[VOCAB, EMB])  # [B,L,E]
+
+    fc1 = fluid.layers.fc(input=emb, size=HID * 4, num_flatten_dims=2)
+    lstm1, _ = fluid.layers.dynamic_lstm(fc1, size=HID * 4,
+                                         use_peepholes=False,
+                                         length=words_len)
+    # second layer consumes the first's hidden states, reversed (the
+    # reference alternates direction per layer)
+    fc2 = fluid.layers.fc(input=lstm1, size=HID * 4, num_flatten_dims=2)
+    lstm2, _ = fluid.layers.dynamic_lstm(fc2, size=HID * 4,
+                                         use_peepholes=False, is_reverse=True,
+                                         length=words_len)
+    p1 = fluid.layers.sequence_pool(lstm1, "max", length=words_len)
+    p2 = fluid.layers.sequence_pool(lstm2, "max", length=words_len)
+    logits = fluid.layers.fc(input=fluid.layers.concat([p1, p2], axis=1),
+                             size=2)
+    sm = fluid.layers.softmax(logits)
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(sm, label))
+    return [words, words_len], loss, sm
+
+
+def test_understand_sentiment_stacked_lstm(tmp_path):
+    data = paddle.dataset.imdb.train()
+
+    def reader():
+        for b in paddle.batch(data, BATCH, drop_last=True)():
+            yield to_feed(b)
+
+    losses = train_save_load_infer(
+        build_stacked_lstm, reader, tmp_path, epochs=4, lr=5e-3,
+        feed_names=["words", "words_len"])
+    assert np.mean(losses[-4:]) < 0.4, np.mean(losses[-4:])

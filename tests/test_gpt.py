@@ -72,3 +72,40 @@ def test_gpt_lm_trains_and_generates():
         correct += (sent[:, 0, t] == expect).sum()
     acc = correct / (4 * 6)
     assert acc > 0.5, acc  # chance = 1/256
+
+
+def test_kv_cache_generation_matches_recompute():
+    """KV-cache decode must produce the same sequences and scores as the
+    full-prefix recompute path (same trained weights, greedy beams)."""
+    cfg = gpt.GPTConfig.tiny(num_layers=2, hidden_dropout=0.0,
+                             use_flash_attention=False)
+    data = gpt.make_fake_lm_batch(cfg, 8, 10, seed=3)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, loss = gpt.build_gpt_lm(cfg)
+        fluid.optimizer.Adam(learning_rate=3e-3).minimize(loss)
+    gen_a, ga_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(gen_a, ga_start), fluid.unique_name.guard():
+        pa, sa, sca = gpt.build_gpt_generate(cfg, prompt_len=4, gen_len=5,
+                                             beam_size=2, end_id=0)
+    gen_b, gb_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(gen_b, gb_start), fluid.unique_name.guard():
+        pb, sb, scb = gpt.build_gpt_generate_cached(cfg, prompt_len=4,
+                                                    gen_len=5, beam_size=2,
+                                                    end_id=0)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(30):
+            exe.run(main, feed=data, fetch_list=[loss.name])
+        prompts = gpt.make_fake_lm_batch(cfg, 4, 4, seed=11)["gpt_ids"]
+        sent_a, score_a = exe.run(gen_a, feed={"gpt_prompt": prompts},
+                                  fetch_list=[sa.name, sca.name])
+        sent_b, score_b = exe.run(gen_b, feed={"gpt_prompt": prompts},
+                                  fetch_list=[sb.name, scb.name])
+    np.testing.assert_array_equal(np.asarray(sent_a), np.asarray(sent_b))
+    np.testing.assert_allclose(np.asarray(score_a), np.asarray(score_b),
+                               rtol=1e-4, atol=1e-4)

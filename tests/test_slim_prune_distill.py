@@ -44,7 +44,7 @@ def test_prune_masks_and_finetune_keeps_sparsity():
             exe.run(main, feed={"x": xd, "y": yd}, fetch_list=[loss.name])
         w1 = np.asarray(scope.get("p_w1"))
         np.testing.assert_array_equal(w1[masks["p_w1"] == 0], 0.0)
-        assert np.abs(w1[masks["p_w1"] == 1]).min() >= 0.0  # survivors live
+        assert np.abs(w1[masks["p_w1"] == 1]).min() > 0.0  # survivors live
 
 
 def test_sensitivity_sweep():

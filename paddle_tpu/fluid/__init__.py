@@ -51,13 +51,30 @@ from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa
 io.DataLoader = DataLoader
 io.PyReader = PyReader
 
+from .lod_tensor import (  # noqa: F401
+    LoDTensor, LoDTensorArray, create_lod_tensor, create_random_int_lodtensor,
+)
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from .data_feed_desc import DataFeedDesc  # noqa: F401
+from . import install_check  # noqa: F401
+from . import recordio_writer  # noqa: F401
+from . import dygraph_grad_clip  # noqa: F401
+from .transpiler import memory_optimize, release_memory  # noqa: F401
+from .framework import (  # noqa: F401
+    CUDAPinnedPlace, cpu_places, cuda_places, cuda_pinned_places, name_scope,
+)
+
 __all__ = [
     "framework", "layers", "optimizer", "initializer", "regularizer", "clip",
     "Program", "Variable", "Operator", "program_guard", "Executor", "Scope",
     "global_scope", "scope_guard", "append_backward", "gradients",
-    "CPUPlace", "TPUPlace", "CUDAPlace", "ParamAttr", "data",
+    "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace", "ParamAttr",
+    "data", "cpu_places", "cuda_places", "cuda_pinned_places", "name_scope",
     "default_main_program", "default_startup_program", "unique_name",
     "transpiler", "DistributeTranspiler", "DistributeTranspilerConfig",
+    "LoDTensor", "LoDTensorArray", "create_lod_tensor",
+    "create_random_int_lodtensor", "ParallelExecutor", "DataFeedDesc",
+    "memory_optimize", "release_memory",
 ]
 
 

@@ -1,0 +1,81 @@
+"""DataFeedDesc (reference python/paddle/fluid/data_feed_desc.py) — config
+object for the C++ MultiSlot data-feed path (our native data runtime,
+paddle_tpu/native/src/data_runtime.cc; reference data_feed.proto).
+
+The reference parses a textual protobuf; we keep the same user-facing API
+over a plain dict config consumed by Dataset/MultiSlotFeed.
+"""
+
+from __future__ import annotations
+
+__all__ = ["DataFeedDesc"]
+
+
+class DataFeedDesc:
+    def __init__(self, proto_file=None):
+        self.proto_desc = {
+            "name": "MultiSlotDataFeed",
+            "batch_size": 32,
+            "multi_slot_desc": {"slots": []},
+            "pipe_command": "cat",
+        }
+        self._slot_index = {}
+        if proto_file:
+            self._parse(proto_file)
+
+    def _parse(self, path):
+        """Minimal textual-proto reader for the reference's data_feed.proto
+        format (name/type/is_dense/is_used slot blocks)."""
+        import re
+
+        text = open(path).read()
+        self.proto_desc["batch_size"] = int(
+            re.search(r"batch_size:\s*(\d+)", text).group(1)
+        ) if "batch_size:" in text else self.proto_desc["batch_size"]
+        for m in re.finditer(
+                r"slots\s*\{([^}]*)\}", text, re.S):
+            body = m.group(1)
+            slot = {
+                "name": re.search(r'name:\s*"([^"]+)"', body).group(1),
+                "type": re.search(r'type:\s*"([^"]+)"', body).group(1),
+                "is_dense": "is_dense: true" in body,
+                "is_used": "is_used: true" in body,
+            }
+            self._add_slot(slot)
+
+    def _add_slot(self, slot):
+        self._slot_index[slot["name"]] = len(
+            self.proto_desc["multi_slot_desc"]["slots"])
+        self.proto_desc["multi_slot_desc"]["slots"].append(slot)
+
+    def set_batch_size(self, batch_size):
+        self.proto_desc["batch_size"] = int(batch_size)
+
+    def set_dense_slots(self, dense_slots_name):
+        slots = self.proto_desc["multi_slot_desc"]["slots"]
+        for name in dense_slots_name:
+            if name not in self._slot_index:
+                raise ValueError(f"unknown slot {name!r}")
+            slots[self._slot_index[name]]["is_dense"] = True
+
+    def set_use_slots(self, use_slots_name):
+        slots = self.proto_desc["multi_slot_desc"]["slots"]
+        for name in use_slots_name:
+            if name not in self._slot_index:
+                raise ValueError(f"unknown slot {name!r}")
+            slots[self._slot_index[name]]["is_used"] = True
+
+    def desc(self):
+        """Textual form (reference returns text_format proto)."""
+        lines = [f'name: "{self.proto_desc["name"]}"',
+                 f'batch_size: {self.proto_desc["batch_size"]}',
+                 "multi_slot_desc {"]
+        for s in self.proto_desc["multi_slot_desc"]["slots"]:
+            lines += ["  slots {",
+                      f'    name: "{s["name"]}"',
+                      f'    type: "{s["type"]}"',
+                      f'    is_dense: {"true" if s["is_dense"] else "false"}',
+                      f'    is_used: {"true" if s["is_used"] else "false"}',
+                      "  }"]
+        lines.append("}")
+        return "\n".join(lines) + "\n"

@@ -71,3 +71,33 @@ class DataFeeder:
                             a = a.reshape(want)
                 out[var.name] = a
         return out
+
+    def feed_parallel(self, iterable, num_places=None):
+        """One feed dict per device (reference DataFeeder.feed_parallel /
+        FeedAndSplitTensorIntoLocalScopes, parallel_executor.h:73).  Under
+        SPMD the executor shards one global batch itself, so this simply
+        yields per-place dicts for API parity."""
+        for batch in iterable:
+            yield self.feed(batch)
+
+    def decorate_reader(self, reader, multi_devices=True, num_places=None,
+                        drop_last=True):
+        """Wrap a batch reader so it yields executor-ready feed dicts
+        (reference DataFeeder.decorate_reader).  With multi_devices, batches
+        whose size doesn't divide the device count are dropped (reference
+        raises mid-stream; we honor drop_last)."""
+
+        def decorated():
+            import jax
+
+            ndev = num_places or jax.device_count()
+            for batch in reader():
+                if multi_devices and len(batch) % ndev != 0:
+                    if drop_last:
+                        continue
+                    raise ValueError(
+                        f"batch size {len(batch)} not divisible by "
+                        f"{ndev} devices")
+                yield self.feed(batch)
+
+        return decorated

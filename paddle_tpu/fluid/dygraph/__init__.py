@@ -15,10 +15,30 @@ from .nn import (FC, BatchNorm, Conv2D, Conv2DTranspose, Dropout,  # noqa: F401
 from .parallel import DataParallel, Env, ParallelEnv, prepare_context  # noqa: F401
 from .tracer import Tracer, VarBase, trace_op  # noqa: F401
 
+
+class BackwardStrategy:
+    """Reference pybind BackwardStrategy: sort_sum_gradient forces
+    deterministic grad accumulation order.  Our tape replays in reverse
+    creation order, which is already deterministic — knob kept for parity."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False
+
+
+# reference dygraph/checkpoint.py exposes these older names too
+def save_persistables(model_dict, dirname="save_dir", optimizers=None):
+    return save_dygraph(model_dict, dirname)
+
+
+def load_persistables(dirname="save_dir"):
+    return load_dygraph(dirname)
+
+
 __all__ = [
     "guard", "to_variable", "no_grad", "enabled", "enable_dygraph",
     "disable_dygraph", "Layer", "VarBase", "Tracer", "trace_op",
-    "save_dygraph", "load_dygraph", "DataParallel", "prepare_context",
+    "save_dygraph", "load_dygraph", "save_persistables", "load_persistables",
+    "BackwardStrategy", "DataParallel", "prepare_context",
     "nn", "Linear", "FC", "Conv2D", "Conv2DTranspose", "Pool2D", "BatchNorm",
     "Embedding", "LayerNorm", "Dropout", "GRUUnit", "PRelu", "GroupNorm",
 ]

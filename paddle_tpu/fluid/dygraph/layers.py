@@ -151,6 +151,23 @@ class Layer:
     load_dict = set_dict
 
     # -- call ----------------------------------------------------------------
+    def backward(self, *inputs):
+        """Hook point (reference dygraph Layer.backward) — autograd runs via
+        VarBase.backward(); custom layers may override."""
+        raise ValueError("Layer.backward is not meant to be called directly; "
+                         "call .backward() on the loss VarBase")
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        """Create a non-parameter state VarBase owned by this layer
+        (reference Layer.create_variable — e.g. BatchNorm running stats)."""
+        from . import tracer as _tracer
+        import numpy as np
+
+        v = _tracer.VarBase(np.zeros((), dtype=dtype or self._dtype),
+                            name=name, stop_gradient=True,
+                            persistable=bool(persistable))
+        return v
+
     def __call__(self, *args, **kw):
         return self.forward(*args, **kw)
 

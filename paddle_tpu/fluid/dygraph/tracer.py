@@ -137,6 +137,8 @@ class Tracer:
         self._op_counter = 0
         # registered by dygraph Layers; weak so discarded models don't leak
         self.parameters = weakref.WeakValueDictionary()
+        # vars registered via trace_var — strong refs, tracer is the owner
+        self._traced_vars: dict = {}
         # parameter VarBases that received grads from the latest backward()
         # — the default update set for Optimizer._dygraph_minimize
         self._last_backward_params: list[VarBase] = []
@@ -152,6 +154,26 @@ class Tracer:
         ctx = registry.LowerContext(step=np.uint32(step), is_test=not self._train_mode)
         ctx.op_index = op_index
         return ctx
+
+    # -- reference-API aliases (imperative/tracer.h Trace, pybind trace_op) --
+    def trace_op(self, op_type, inputs, outputs=None, attrs=None,
+                 stop_gradient=False):
+        return self.trace(op_type, inputs, attrs=attrs)
+
+    def trace_var(self, name, var):
+        """Register a named VarBase with the tracer (reference trace_var).
+        Holds a strong reference — `parameters` is weak (it mirrors Layer
+        params the Layer itself owns), but an explicitly traced var has no
+        other owner."""
+        self._traced_vars[name] = var
+        self.parameters[name] = var
+        return var
+
+    def all_parameters(self):
+        seen = {id(v): v for v in self.parameters.values()}
+        for v in self._traced_vars.values():
+            seen.setdefault(id(v), v)
+        return list(seen.values())
 
     # -- trace ---------------------------------------------------------------
     def trace(self, op_type, inputs, attrs=None):

@@ -1,0 +1,92 @@
+"""Dygraph gradient clipping (reference
+python/paddle/fluid/dygraph_grad_clip.py).
+
+Usage matches the reference: call the clip object on ``params_grads`` (pairs
+of VarBase param and its gradient) between ``loss.backward()`` and the
+optimizer step.  Clipped values are written back into each param's ``_grad``
+so the eager update path (`Optimizer._dygraph_minimize`) — which reads
+``p._grad`` directly — applies the clipped gradient.  Plain
+``(name, ndarray)`` pairs are also accepted and returned clipped.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["GradClipByValue", "GradClipByNorm", "GradClipByGlobalNorm"]
+
+
+def _grad_array(g):
+    return np.asarray(g.numpy() if hasattr(g, "numpy") else g)
+
+
+def _emit(p, g_orig, clipped):
+    """Write back into VarBase grads; preserve the original pair type."""
+    if hasattr(p, "_grad"):
+        import jax.numpy as jnp
+
+        p._grad = jnp.asarray(clipped)
+        return (p, p._grad)
+    return (p, clipped)
+
+
+class _GradClipBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class GradClipByValue(_GradClipBase):
+    def __init__(self, min_value, max_value=None):
+        if max_value is None:
+            min_value, max_value = -abs(min_value), abs(min_value)
+        self.min_value, self.max_value = float(min_value), float(max_value)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            a = _grad_array(g)
+            out.append(_emit(p, g, np.clip(a, self.min_value,
+                                           self.max_value).astype(a.dtype)))
+        return out
+
+
+class GradClipByNorm(_GradClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            a = _grad_array(g)
+            norm = float(np.sqrt((a.astype("float64") ** 2).sum()))
+            c = a * (self.clip_norm / norm) if norm > self.clip_norm else a
+            out.append(_emit(p, g, c.astype(a.dtype)))
+        return out
+
+
+class GradClipByGlobalNorm(_GradClipBase):
+    def __init__(self, max_global_norm):
+        self.max_global_norm = float(max_global_norm)
+
+    def _clip(self, params_grads):
+        arrays = [(p, g, None if g is None else _grad_array(g))
+                  for p, g in params_grads]
+        sq = sum(float((a.astype("float64") ** 2).sum())
+                 for _, _, a in arrays if a is not None)
+        global_norm = np.sqrt(sq)
+        scale = (self.max_global_norm / global_norm
+                 if global_norm > self.max_global_norm and global_norm > 0
+                 else 1.0)
+        out = []
+        for p, g, a in arrays:
+            if a is None:
+                out.append((p, g))
+            else:
+                out.append(_emit(p, g, (a * scale).astype(a.dtype)))
+        return out

@@ -163,6 +163,12 @@ def cuda_places(device_ids=None):
     return tpu_places(device_ids)
 
 
+def cuda_pinned_places(device_count=None):
+    """Host staging buffers (reference CUDAPinnedPlace list); on TPU the
+    host side is plain CPU memory — PJRT pins transfer buffers internally."""
+    return [CUDAPinnedPlace() for _ in range(device_count or 1)]
+
+
 _global_place = None
 
 
@@ -217,6 +223,15 @@ class unique_name:
             yield
         finally:
             _name_generator = old
+
+    @staticmethod
+    def switch(new_generator=None):
+        """Swap the active generator, returning the old one (reference
+        unique_name.switch)."""
+        global _name_generator
+        old = _name_generator
+        _name_generator = new_generator or _UniqueNameGenerator()
+        return old
 
 
 _name_scope_stack = []
@@ -602,6 +617,23 @@ class Program:
             ]
         self._bump_version()
         return self
+
+    def to_string(self, throw_on_error=True, with_details=False):
+        """Serialized form (reference Program.to_string renders the proto;
+        ours is the io.py JSON program schema)."""
+        import json
+
+        from . import io as _io
+
+        return json.dumps(_io.program_to_dict(self), indent=2)
+
+    @staticmethod
+    def parse_from_string(s):
+        import json
+
+        from . import io as _io
+
+        return _io.program_from_dict(json.loads(s))
 
     def __repr__(self):
         return "\n".join(repr(b) for b in self.blocks)

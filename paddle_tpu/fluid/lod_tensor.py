@@ -1,0 +1,139 @@
+"""LoDTensor: host-side ragged tensor container (reference
+paddle/fluid/framework/lod_tensor.h + python/paddle/fluid/lod_tensor.py).
+
+TPU-native stance: on device everything is dense + static-shaped; ragged
+sequence structure lives host-side as recursive sequence lengths and lowers
+to padding + an explicit length vector (see data_feeder.py).  This class is
+the API-parity container: it stores the *flattened* rows (sum of lengths
+along dim 0, like the reference's LoD tensors) plus the LoD, and converts
+to/from the dense padded form the executor feeds.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "LoDTensor", "LoDTensorArray", "create_lod_tensor",
+    "create_random_int_lodtensor",
+]
+
+
+def _lengths_to_offsets(lengths):
+    """[[2,3]] → [[0,2,5]] (reference lod_tensor.h ConvertToOffsetBasedLoD)."""
+    out = []
+    for level in lengths:
+        offs = [0]
+        for n in level:
+            offs.append(offs[-1] + int(n))
+        out.append(offs)
+    return out
+
+
+def _offsets_to_lengths(offsets):
+    return [[b - a for a, b in zip(level, level[1:])] for level in offsets]
+
+
+class LoDTensor:
+    """Dense ndarray + level-of-detail offsets (reference lod_tensor.h:1-242)."""
+
+    def __init__(self, array=None, recursive_seq_lens=None, place=None):
+        self._arr = None if array is None else np.asarray(array)
+        self._lod = _lengths_to_offsets(recursive_seq_lens or [])
+        self._place = place
+
+    # -- numpy interop (reference tensor_py.h zero-copy view) --
+    def set(self, array, place=None):
+        self._arr = np.asarray(array)
+        if place is not None:
+            self._place = place
+
+    def __array__(self, dtype=None):
+        a = self._arr if self._arr is not None else np.empty((0,))
+        return a.astype(dtype) if dtype is not None else a
+
+    def _as_np(self):
+        return self.__array__()
+
+    # -- LoD accessors (reference pybind tensor lod methods) --
+    def lod(self):
+        return [list(level) for level in self._lod]
+
+    def set_lod(self, lod):
+        self._lod = [list(level) for level in lod]
+
+    def recursive_sequence_lengths(self):
+        return _offsets_to_lengths(self._lod)
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lod = _lengths_to_offsets(lengths)
+
+    def has_valid_recursive_sequence_lengths(self):
+        """True iff each level's offsets are monotone, nest correctly, and the
+        finest level covers dim 0 (reference lod_tensor.cc CheckLoD)."""
+        if self._arr is None:
+            return False
+        if not self._lod:
+            return True
+        prev_count = None  # top level's sequence count is unconstrained
+        for level in self._lod:
+            if len(level) < 2 or level[0] != 0:
+                return False
+            if any(b < a for a, b in zip(level, level[1:])):
+                return False
+            # each level must contain exactly as many sequences as the level
+            # above references (reference lod_tensor.cc CheckLoD)
+            if prev_count is not None and len(level) - 1 != prev_count:
+                return False
+            prev_count = level[-1]
+        return self._lod[-1][-1] == self._arr.shape[0]
+
+    def shape(self):
+        return list(self._arr.shape) if self._arr is not None else []
+
+    def __str__(self):
+        return f"LoDTensor(lod={self._lod}, shape={self.shape()})\n{self._arr}"
+
+    __repr__ = __str__
+
+
+class LoDTensorArray(list):
+    """Ordered list of LoDTensors (reference framework.proto LOD_TENSOR_ARRAY;
+    pybind LoDTensorArray).  A plain list subclass: the executor's
+    tensor-array ops work on stacked dense forms, this is the host container."""
+
+    def append(self, tensor):
+        if not isinstance(tensor, LoDTensor):
+            tensor = LoDTensor(np.asarray(tensor))
+        super().append(tensor)
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Build a LoDTensor from a numpy array / LoDTensor / nested list plus
+    recursive sequence lengths (reference python/paddle/fluid/lod_tensor.py
+    create_lod_tensor)."""
+    if isinstance(data, LoDTensor):
+        return create_lod_tensor(data._as_np(), recursive_seq_lens, place)
+    if isinstance(data, list):
+        # nested list of sequences: flatten rows, derive lengths
+        flat = [np.asarray(seq).reshape(len(seq), -1) for seq in data]
+        lens = [f.shape[0] for f in flat]
+        assert lens == list(recursive_seq_lens[-1]), (
+            "data sequence lengths do not match recursive_seq_lens")
+        data = np.concatenate(flat, axis=0)
+    arr = np.asarray(data)
+    t = LoDTensor(arr, recursive_seq_lens, place)
+    assert t.has_valid_recursive_sequence_lengths(), (
+        "invalid recursive_seq_lens for data of shape %s" % (arr.shape,))
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=10, seed=None):
+    """Random-int LoDTensor whose dim-0 totals the finest-level lengths
+    (reference lod_tensor.py create_random_int_lodtensor)."""
+    rng = np.random.RandomState(seed)
+    total = int(sum(recursive_seq_lens[-1]))
+    shape = [total] + list(base_shape)
+    data = rng.randint(low, high + 1, size=shape).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -79,6 +79,46 @@ class Optimizer:
     def _get_accumulator(self, name, param):
         return self._accumulators[name][param.name]
 
+    def get_opti_var_name_list(self):
+        """Names of all optimizer-state vars created so far (reference
+        Optimizer.get_opti_var_name_list)."""
+        out = []
+        for accs in self._accumulators.values():
+            out.extend(v.name for v in accs.values())
+        if self._lr_var is not None:
+            out.append(self._lr_var.name)
+        return out
+
+    def load(self, stat_dict):
+        """Load optimizer state from a {name: ndarray} dict (reference
+        Optimizer.load, used with dygraph checkpoints): writes accumulator
+        values into the global scope / eager state."""
+        from .executor import global_scope
+
+        scope = global_scope()
+        for name in self.get_opti_var_name_list():
+            if name in stat_dict:
+                scope.set(name, np.asarray(stat_dict[name]))
+        # dygraph eager accumulators: stored as VarBase under
+        # _accumulators["__dg_<acc>"][param_name] (see _dg_acc); state dicts
+        # key them "<param>.__dg_<acc>"
+        for acc_name, accs in self._accumulators.items():
+            if not acc_name.startswith("__dg_"):
+                continue
+            for pname, var in accs.items():
+                key = f"{pname}.{acc_name}"
+                if key in stat_dict:
+                    var.set_value(np.asarray(stat_dict[key]))
+
+    def state_dict(self):
+        """Dygraph optimizer state as {key: ndarray} (counterpart of load)."""
+        out = {}
+        for acc_name, accs in self._accumulators.items():
+            if acc_name.startswith("__dg_"):
+                for pname, var in accs.items():
+                    out[f"{pname}.{acc_name}"] = var.numpy()
+        return out
+
     # -- hooks each optimizer implements --------------------------------
     def _create_accumulators(self, block, parameters):
         pass
@@ -657,6 +697,25 @@ class ModelAverage(ExponentialMovingAverage):
     def __init__(self, average_window_rate=0.15, min_average_window=10000,
                  max_average_window=10000, **kw):
         super().__init__(decay=0.999, **kw)
+
+    # reference ModelAverage inherits Optimizer's pipeline but using it as a
+    # training optimizer is an error — keep the surface, fail loudly
+    def backward(self, *a, **kw):
+        raise NotImplementedError("ModelAverage maintains averages; use a "
+                                  "training optimizer for backward")
+
+    apply_gradients = apply_optimize = minimize = backward
+
+    def get_opti_var_name_list(self):
+        return [v.name for v in self._ema_vars.values()]
+
+    def load(self, stat_dict):
+        from .executor import global_scope
+
+        scope = global_scope()
+        for name in self.get_opti_var_name_list():
+            if name in stat_dict:
+                scope.set(name, np.asarray(stat_dict[name]))
 
 
 SGD = SGDOptimizer

@@ -1,0 +1,56 @@
+"""fluid.recordio_writer (reference python/paddle/fluid/recordio_writer.py):
+convert a reader's samples into native RecordIO file(s) via the C++ runtime
+(paddle_tpu/native/src/data_runtime.cc; reference recordio/writer.cc)."""
+
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+__all__ = [
+    "convert_reader_to_recordio_file", "convert_reader_to_recordio_files",
+]
+
+
+def _serialize(sample, feeder=None) -> bytes:
+    """One record per sample.  The reference serializes LoDTensor protos; we
+    pickle the (numpy-converted) sample tuple — the native scanner returns the
+    raw bytes and reader-side code unpickles (see reader.creator.recordio
+    consumers and Dataset)."""
+    if feeder is not None:
+        sample = feeder.feed([sample])
+    return pickle.dumps(sample, protocol=4)
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder=None,
+                                    compressor=1, max_num_records=1000,
+                                    feed_order=None):
+    from paddle_tpu import native
+
+    n = 0
+    with native.RecordIOWriter(filename, compressor) as w:
+        for sample in reader_creator():
+            w.write(_serialize(sample, feeder))
+            n += 1
+    return n
+
+
+def convert_reader_to_recordio_files(filename, batch_per_file,
+                                     reader_creator, feeder=None,
+                                     compressor=1, max_num_records=1000,
+                                     feed_order=None):
+    from paddle_tpu import native
+
+    out_files, n, writer = [], 0, None
+    with contextlib.ExitStack() as stack:
+        for sample in reader_creator():
+            if writer is None or n % batch_per_file == 0:
+                if writer is not None:
+                    writer.close()
+                path = f"{filename}-{len(out_files):05d}"
+                writer = stack.enter_context(
+                    native.RecordIOWriter(path, compressor))
+                out_files.append(path)
+            writer.write(_serialize(sample, feeder))
+            n += 1
+    return out_files

@@ -5,6 +5,25 @@ from .distribute_transpiler import (  # noqa: F401
     DistributeTranspilerConfig,
 )
 from paddle_tpu.ops.dist_ops import stop_pservers, reset_channels  # noqa: F401
+from .ps_dispatcher import HashName, PSDispatcher, RoundRobin  # noqa: F401
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """Reference transpiler/memory_optimization_transpiler.py rewrote the
+    program to reuse var buffers; under whole-block XLA compilation buffer
+    assignment/reuse happens inside XLA, so this is a deliberate no-op kept
+    for API parity (the reference itself deprecated it in favor of
+    BuildStrategy.memory_optimize)."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """See memory_optimize — XLA owns buffer lifetimes; no-op for parity."""
+    return None
+
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "HashName", "PSDispatcher", "RoundRobin",
+           "memory_optimize", "release_memory",
            "stop_pservers", "reset_channels"]

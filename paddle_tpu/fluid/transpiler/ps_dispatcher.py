@@ -1,0 +1,47 @@
+"""Parameter-server shard dispatchers (reference
+python/paddle/fluid/transpiler/ps_dispatcher.py): decide which pserver
+endpoint owns each sliced variable block."""
+
+from __future__ import annotations
+
+__all__ = ["PSDispatcher", "HashName", "RoundRobin"]
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class HashName(PSDispatcher):
+    """Stable name-hash placement — same var always lands on the same
+    pserver regardless of transpile order."""
+
+    def _hash_block(self, block_str, total):
+        return hash(block_str) % total
+
+    def dispatch(self, varlist):
+        out = []
+        for var in varlist:
+            name = var.name if hasattr(var, "name") else str(var)
+            out.append(self._eps[self._hash_block(name, len(self._eps))])
+        return out
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out

@@ -11,6 +11,7 @@ from __future__ import annotations
 import itertools
 import queue
 import random as _random
+import sys
 import threading
 
 __all__ = [
@@ -211,3 +212,170 @@ def xmap_readers(mapper, reader, process_num=1, buffer_size=64, order=False):
             yield pending[i]
 
     return xmap_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave several readers, each drained on its own worker thread
+    (reference decorator.py multiprocess_reader; threads instead of fork —
+    fork is hostile to a live TPU/PJRT client, and the host-side decode work
+    these wrap releases the GIL in numpy anyway)."""
+    assert isinstance(readers, (list, tuple)) and readers, "readers required"
+
+    def reader():
+        out_q = queue.Queue(maxsize=queue_size)
+        errors = []
+
+        def drain(r):
+            try:
+                for sample in r():
+                    out_q.put(sample)
+            except BaseException as e:  # surfaced in the consumer
+                errors.append(e)
+            finally:
+                out_q.put(_MP_END)
+
+        threads = [threading.Thread(target=drain, args=(r,), daemon=True)
+                   for r in readers]
+        for t in threads:
+            t.start()
+        done = 0
+        while done < len(readers):
+            if errors:  # surface a worker failure immediately, not at drain
+                raise errors[0]
+            item = out_q.get()
+            if item is _MP_END:
+                done += 1
+            else:
+                yield item
+        if errors:
+            raise errors[0]
+
+    return reader
+
+
+_MP_END = object()
+
+
+class PipeReader:
+    """Stream samples out of a shell command's stdout (reference
+    decorator.py PipeReader)."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        if not isinstance(command, str):
+            raise TypeError("command must be a string")
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import subprocess
+
+        proc = subprocess.Popen(
+            self.command, shell=True, bufsize=self.bufsize,
+            stdout=subprocess.PIPE)
+        out = proc.stdout
+        if self.file_type == "gzip":
+            import gzip
+
+            out = gzip.GzipFile(fileobj=out)
+        remained = b""
+        while True:
+            buf = out.read(self.bufsize)
+            if not buf:
+                break
+            if cut_lines:
+                lines = (remained + buf).split(line_break.encode())
+                remained = lines.pop()
+                for line in lines:
+                    yield line.decode("utf8", "ignore")
+            else:
+                yield buf.decode("utf8", "ignore")
+        if remained:
+            yield remained.decode("utf8", "ignore")
+        proc.wait()
+
+
+class Fake:
+    """Caches the first sample of the wrapped reader and replays it
+    (reference decorator.py Fake) — for data-independent perf runs."""
+
+    def __init__(self):
+        self.data = None
+        self.yield_num = 0
+
+    def __call__(self, reader, fake_num):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader())
+            while self.yield_num < fake_num:
+                self.yield_num += 1
+                yield self.data
+            self.yield_num = 0
+
+        return fake_reader
+
+
+# ---------------------------------------------------------------------------
+# paddle.reader.creator (reference python/paddle/reader/creator.py)
+# ---------------------------------------------------------------------------
+
+
+def _creator_np_array(x):
+    """Reader creator over the rows of a numpy array."""
+
+    def reader():
+        for row in x:
+            yield row
+
+    return reader
+
+
+def _creator_text_file(path):
+    """Reader creator yielding stripped lines of a text file."""
+
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def _creator_recordio(paths, buf_size=100):
+    """Reader creator over native RecordIO file(s) (our C++ runtime,
+    reference recordio/ + creator.py recordio).  Yields deserialized samples
+    (recordio_writer pickles them); raw bytes pass through for files written
+    by other tools."""
+    import pickle
+
+    if isinstance(paths, str):
+        paths = paths.split(",")
+
+    def reader():
+        from paddle_tpu import native
+
+        for p in paths:
+            with native.RecordIOScanner(p) as sc:
+                for rec in sc:
+                    try:
+                        yield pickle.loads(rec)
+                    except Exception:
+                        yield rec
+
+    return reader
+
+
+def _make_creator_module():
+    import types
+
+    m = types.ModuleType("paddle_tpu.reader.creator",
+                         "reader creators (reference paddle.reader.creator)")
+    m.np_array = _creator_np_array
+    m.text_file = _creator_text_file
+    m.recordio = _creator_recordio
+    sys.modules[m.__name__] = m
+    return m
+
+
+creator = _make_creator_module()
+__all__ += ["multiprocess_reader", "PipeReader", "Fake", "creator"]

@@ -158,7 +158,28 @@ class Tracer:
     # -- reference-API aliases (imperative/tracer.h Trace, pybind trace_op) --
     def trace_op(self, op_type, inputs, outputs=None, attrs=None,
                  stop_gradient=False):
-        return self.trace(op_type, inputs, attrs=attrs)
+        """Reference pybind signature: optionally writes results into
+        pre-created output VarBases and suppresses taping on stop_gradient."""
+        if stop_gradient:
+            prev = self._no_grad
+            self._no_grad = True
+            try:
+                res = self.trace(op_type, inputs, attrs=attrs)
+            finally:
+                self._no_grad = prev
+        else:
+            res = self.trace(op_type, inputs, attrs=attrs)
+        if outputs is None:
+            return res
+        results = list(res) if isinstance(res, (tuple, list)) else [res]
+        flat_outs = []
+        for slot_vars in outputs.values():
+            flat_outs.extend(slot_vars if isinstance(slot_vars, (list, tuple))
+                             else [slot_vars])
+        for dst, src in zip(flat_outs, results):
+            dst._value = src._value
+            dst.stop_gradient = src.stop_gradient
+        return res
 
     def trace_var(self, name, var):
         """Register a named VarBase with the tracer (reference trace_var).

@@ -45,7 +45,7 @@ __all__ = [
     "kldiv_loss", "margin_rank_loss", "rank_loss", "hinge_loss", "bpr_loss",
     "maxout", "selu", "pixel_shuffle", "shuffle_channel", "affine_channel",
     "grid_sampler", "crop", "im2sequence", "chunk_eval",
-    "softmax_mask_fuse_upper_triangle",
+    "softmax_mask_fuse_upper_triangle", "adaptive_pool2d",
 ]
 
 

@@ -1,0 +1,236 @@
+"""Long-tail layer functions (reference python/paddle/fluid/layers/nn.py +
+tensor.py entries absent from the core modules): activation variants, tensor
+utilities, batch-size-like random ops, hashing, SelectedRows shims, py_func.
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from .nn import _act_layer, _single_out_layer
+
+__all__ = [
+    "acos", "asin", "atan", "logsigmoid", "softplus", "softsign", "stanh",
+    "hard_shrink", "softshrink", "tanh_shrink", "thresholded_relu",
+    "multiplex", "reverse", "rank", "size", "sum", "is_empty", "unique",
+    "unique_with_counts", "shard_index", "space_to_depth",
+    "pad_constant_like", "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like", "hash",
+    "get_tensor_from_selected_rows", "merge_selected_rows", "py_func",
+]
+
+
+# -- activation variants (reference activation_op.cc) -----------------------
+
+
+def acos(x, name=None):
+    return _act_layer("acos", x, name=name)
+
+
+def asin(x, name=None):
+    return _act_layer("asin", x, name=name)
+
+
+def atan(x, name=None):
+    return _act_layer("atan", x, name=name)
+
+
+def logsigmoid(x, name=None):
+    return _act_layer("logsigmoid", x, name=name)
+
+
+def softplus(x, name=None):
+    return _act_layer("softplus", x, name=name)
+
+
+def softsign(x, name=None):
+    return _act_layer("softsign", x, name=name)
+
+
+def stanh(x, scale_a=2.0 / 3.0, scale_b=1.7159, name=None):
+    return _act_layer("stanh", x, {"scale_a": scale_a, "scale_b": scale_b},
+                      name=name)
+
+
+def hard_shrink(x, threshold=0.5):
+    return _act_layer("hard_shrink", x, {"threshold": threshold})
+
+
+def softshrink(x, alpha=0.5):
+    return _act_layer("softshrink", x, {"lambda": alpha})
+
+
+def tanh_shrink(x, name=None):
+    return _act_layer("tanh_shrink", x, name=name)
+
+
+def thresholded_relu(x, threshold=1.0):
+    return _act_layer("thresholded_relu", x, {"threshold": threshold})
+
+
+# -- tensor utilities -------------------------------------------------------
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    return _single_out_layer(helper, "multiplex",
+                             {"X": list(inputs), "Ids": [index]})
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    if isinstance(axis, int):
+        axis = [axis]
+    return _single_out_layer(helper, "reverse", {"X": [x]},
+                             {"axis": list(axis)})
+
+
+def rank(input):
+    helper = LayerHelper("rank")
+    out = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op("rank", inputs={"Input": [input]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def size(input):
+    helper = LayerHelper("size")
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op("size", inputs={"Input": [input]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sum(x):
+    """Elementwise sum of a list of tensors (reference layers.sum → sum_op)."""
+    helper = LayerHelper("sum")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return _single_out_layer(helper, "sum", {"X": list(xs)})
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty")
+    out = cond or helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op("is_empty", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={})
+    return out
+
+
+def unique(x, dtype="int32"):
+    """Returns (out, index).  Static-shape deviation from the reference:
+    `out` is padded to len(x) and sorted ascending (XLA needs static shapes;
+    see ops/tensor_extra_ops.py)."""
+    helper = LayerHelper("unique")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    index = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op("unique", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index]}, attrs={})
+    return out, index
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    index = helper.create_variable_for_type_inference(dtype=dtype)
+    count = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op("unique_with_counts", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index],
+                              "Count": [count]}, attrs={})
+    return out, index, count
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    helper = LayerHelper("shard_index")
+    return _single_out_layer(
+        helper, "shard_index", {"X": [input]},
+        {"index_num": index_num, "nshards": nshards, "shard_id": shard_id,
+         "ignore_value": ignore_value})
+
+
+def space_to_depth(x, blocksize, name=None):
+    helper = LayerHelper("space_to_depth", name=name)
+    return _single_out_layer(helper, "space_to_depth", {"X": [x]},
+                             {"blocksize": blocksize})
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", name=name)
+    return _single_out_layer(helper, "pad_constant_like",
+                             {"X": [x], "Y": [y]}, {"pad_value": pad_value})
+
+
+def _batch_size_like(op_type, input, shape, dtype, attrs,
+                     input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    attrs = dict(attrs)
+    attrs.update({"shape": list(shape), "input_dim_idx": input_dim_idx,
+                  "output_dim_idx": output_dim_idx, "dtype": dtype})
+    helper.append_op(op_type, inputs={"Input": [input]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    out.stop_gradient = True
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    return _batch_size_like("uniform_random_batch_size_like", input, shape,
+                            dtype, {"min": min, "max": max, "seed": seed},
+                            input_dim_idx, output_dim_idx)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    return _batch_size_like("gaussian_random_batch_size_like", input, shape,
+                            dtype, {"mean": mean, "std": std, "seed": seed},
+                            input_dim_idx, output_dim_idx)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op("hash", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"num_hash": num_hash, "mod_by": hash_size})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    helper = LayerHelper("get_tensor_from_selected_rows", name=name)
+    return _single_out_layer(helper, "get_tensor_from_selected_rows",
+                             {"X": [x]})
+
+
+def merge_selected_rows(x, name=None):
+    helper = LayerHelper("merge_selected_rows", name=name)
+    return _single_out_layer(helper, "merge_selected_rows", {"X": [x]})
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Call arbitrary python inside the graph (reference py_func_op.cc).
+
+    TPU-native: lowers to jax.pure_callback with the declared `out`
+    shapes/dtypes (so out vars must carry static shapes).  Works on backends
+    with host-callback support (CPU; the reference's py_func is likewise
+    host-bound).  backward_func(*inputs, *out_grads) -> per-input grads
+    (None allowed) is emitted as a py_func_grad op by append_backward.
+    """
+    from paddle_tpu.ops.tensor_extra_ops import register_py_func
+
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        if o.shape is None or any(d is None or d < 0 for d in o.shape):
+            raise ValueError(
+                f"py_func out var {o.name} needs a fully static shape")
+    attrs = {
+        "func_id": register_py_func(func),
+        "out_shapes": [list(o.shape) for o in outs],
+        "out_dtypes": [o.dtype for o in outs],
+    }
+    if backward_func is not None:
+        attrs["backward_func_id"] = register_py_func(backward_func)
+    helper.append_op("py_func", inputs={"X": list(xs)},
+                     outputs={"Out": list(outs)}, attrs=attrs)
+    return out

@@ -25,10 +25,14 @@ class PSDispatcher:
 
 class HashName(PSDispatcher):
     """Stable name-hash placement — same var always lands on the same
-    pserver regardless of transpile order."""
+    pserver regardless of transpile order.  Uses crc32, not builtin hash():
+    trainer and pserver processes must agree, and Python salts hash() per
+    process."""
 
     def _hash_block(self, block_str, total):
-        return hash(block_str) % total
+        import zlib
+
+        return zlib.crc32(block_str.encode()) % total
 
     def dispatch(self, varlist):
         out = []

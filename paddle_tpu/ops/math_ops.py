@@ -397,3 +397,35 @@ def _cumsum(ctx, x, attrs):
         out = jnp.pad(out, pad)[tuple(
             slice(0, -1) if i == axis % jnp.ndim(x) else slice(None) for i in range(jnp.ndim(x)))]
     return out
+
+
+# long-tail activations (reference operators/activation_op.cc registrations)
+_act("acos", jnp.arccos)
+_act("asin", jnp.arcsin)
+_act("atan", jnp.arctan)
+_act("logsigmoid", jax.nn.log_sigmoid)
+
+
+@simple_op("stanh", ["X"], ["Out"])
+def _stanh(ctx, x, attrs):
+    return attrs.get("scale_b", 1.7159) * jnp.tanh(
+        attrs.get("scale_a", 2.0 / 3.0) * x)
+
+
+@simple_op("hard_shrink", ["X"], ["Out"])
+def _hard_shrink(ctx, x, attrs):
+    t = attrs.get("threshold", 0.5)
+    return jnp.where(jnp.abs(x) > t, x, jnp.zeros_like(x))
+
+
+@simple_op("softshrink", ["X"], ["Out"])
+def _softshrink(ctx, x, attrs):
+    lam = attrs.get("lambda", 0.5)
+    return jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam,
+                                                 jnp.zeros_like(x)))
+
+
+@simple_op("thresholded_relu", ["X"], ["Out"])
+def _thresholded_relu(ctx, x, attrs):
+    t = attrs.get("threshold", 1.0)
+    return jnp.where(x > t, x, jnp.zeros_like(x))

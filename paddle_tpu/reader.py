@@ -224,31 +224,51 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     def reader():
         out_q = queue.Queue(maxsize=queue_size)
         errors = []
+        stop = threading.Event()
 
         def drain(r):
             try:
                 for sample in r():
-                    out_q.put(sample)
+                    # bounded put that re-checks stop: an abandoned consumer
+                    # must not leave this thread blocked forever
+                    while not stop.is_set():
+                        try:
+                            out_q.put(sample, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
             except BaseException as e:  # surfaced in the consumer
                 errors.append(e)
             finally:
-                out_q.put(_MP_END)
+                # END must reach an active consumer (else it waits forever);
+                # only drop it once the consumer has signalled stop
+                while not stop.is_set():
+                    try:
+                        out_q.put(_MP_END, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         threads = [threading.Thread(target=drain, args=(r,), daemon=True)
                    for r in readers]
         for t in threads:
             t.start()
         done = 0
-        while done < len(readers):
-            if errors:  # surface a worker failure immediately, not at drain
+        try:
+            while done < len(readers):
+                if errors:  # surface a worker failure immediately
+                    raise errors[0]
+                item = out_q.get()
+                if item is _MP_END:
+                    done += 1
+                else:
+                    yield item
+            if errors:
                 raise errors[0]
-            item = out_q.get()
-            if item is _MP_END:
-                done += 1
-            else:
-                yield item
-        if errors:
-            raise errors[0]
+        finally:
+            stop.set()
 
     return reader
 

@@ -1,0 +1,243 @@
+"""Long-tail layer functions: activations, tensor utilities, hashing,
+batch-size-like random, py_func (reference layers/nn.py + tensor.py tail)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    names = [o.name for o in (outs if isinstance(outs, (list, tuple)) else [outs])]
+    res = exe.run(main, feed=feeds, fetch_list=names)
+    return res if isinstance(outs, (list, tuple)) else res[0]
+
+
+def test_activation_tail_numerics():
+    x = np.array([[-2.0, -0.4, 0.1, 1.5]], dtype="float32")
+
+    def build():
+        v = fluid.data("xa", [1, 4], False, dtype="float32")
+        return [
+            fluid.layers.acos(fluid.layers.clip(v, -0.9, 0.9)),
+            fluid.layers.asin(fluid.layers.clip(v, -0.9, 0.9)),
+            fluid.layers.atan(v),
+            fluid.layers.logsigmoid(v),
+            fluid.layers.softplus(v),
+            fluid.layers.softsign(v),
+            fluid.layers.stanh(v, 0.67, 1.7159),
+            fluid.layers.hard_shrink(v, 0.5),
+            fluid.layers.softshrink(v, 0.5),
+            fluid.layers.tanh_shrink(v),
+            fluid.layers.thresholded_relu(v, 1.0),
+        ]
+
+    (acos, asin, atan, logsig, softplus, softsign, stanh, hshrink,
+     sshrink, tshrink, threlu) = _run(build, {"xa": x})
+    c = np.clip(x, -0.9, 0.9)
+    np.testing.assert_allclose(acos, np.arccos(c), rtol=1e-5)
+    np.testing.assert_allclose(asin, np.arcsin(c), rtol=1e-5)
+    np.testing.assert_allclose(atan, np.arctan(x), rtol=1e-5)
+    np.testing.assert_allclose(logsig, -np.log1p(np.exp(-x)), rtol=1e-4)
+    np.testing.assert_allclose(softplus, np.log1p(np.exp(x)), rtol=1e-4)
+    np.testing.assert_allclose(softsign, x / (1 + np.abs(x)), rtol=1e-5)
+    np.testing.assert_allclose(stanh, 1.7159 * np.tanh(0.67 * x), rtol=1e-5)
+    np.testing.assert_allclose(hshrink, np.where(np.abs(x) > 0.5, x, 0))
+    np.testing.assert_allclose(
+        sshrink, np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0)),
+        rtol=1e-6)
+    np.testing.assert_allclose(tshrink, x - np.tanh(x), rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(threlu, np.where(x > 1.0, x, 0))
+
+
+def test_tensor_utilities():
+    x = np.arange(12, dtype="float32").reshape(3, 4)
+
+    def build():
+        v = fluid.data("xt", [3, 4], False, dtype="float32")
+        return [
+            fluid.layers.reverse(v, axis=1),
+            fluid.layers.sum([v, v, v]),
+            fluid.layers.rank(v),
+            fluid.layers.size(v),
+            fluid.layers.is_empty(v),
+            fluid.layers.pad_constant_like(
+                fluid.layers.concat([v, v], axis=0), v, 9.0),
+        ]
+
+    rev, s3, rk, sz, empty, pcl = _run(build, {"xt": x})
+    np.testing.assert_allclose(rev, x[:, ::-1])
+    np.testing.assert_allclose(s3, 3 * x)
+    assert int(rk) == 2 and int(sz) == 12 and not bool(empty)
+    assert pcl.shape == (6, 4) and pcl[3:].max() == 9.0
+
+
+def test_multiplex():
+    a = np.ones((3, 2), dtype="float32")
+    idx = np.array([[0], [1], [0]], dtype="int32")
+
+    def build():
+        v1 = fluid.data("m1", [3, 2], False, dtype="float32")
+        v2 = fluid.data("m2", [3, 2], False, dtype="float32")
+        i = fluid.data("mi", [3, 1], False, dtype="int32")
+        return fluid.layers.multiplex([v1, v2], i)
+
+    out = _run(build, {"m1": a, "m2": 5 * a, "mi": idx})
+    np.testing.assert_allclose(out[:, 0], [1, 5, 1])
+
+
+def test_unique_and_counts():
+    ids = np.array([7, 1, 7, 3], dtype="int64")
+
+    def build():
+        v = fluid.data("u", [4], False, dtype="int64")
+        o, i = fluid.layers.unique(v)
+        o2, i2, c = fluid.layers.unique_with_counts(v)
+        return [o, i, o2, i2, c]
+
+    o, i, o2, i2, c = _run(build, {"u": ids})
+    # padded static shape; first 3 entries are the sorted uniques
+    assert list(o[:3]) == [1, 3, 7]
+    np.testing.assert_array_equal(o[np.asarray(i)], ids)
+    assert c[list(o2).index(7)] == 2
+
+
+def test_shard_index():
+    ids = np.array([[1], [5], [9], [14]], dtype="int64")
+
+    def build():
+        v = fluid.data("si", [4, 1], False, dtype="int64")
+        return fluid.layers.shard_index(v, index_num=20, nshards=2,
+                                        shard_id=0)
+
+    out = _run(build, {"si": ids})
+    np.testing.assert_array_equal(out.ravel(), [1, 5, 9, -1])
+
+
+def test_space_to_depth():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+
+    def build():
+        v = fluid.data("sd", [1, 1, 4, 4], False, dtype="float32")
+        return fluid.layers.space_to_depth(v, 2)
+
+    out = _run(build, {"sd": x})
+    assert out.shape == (1, 4, 2, 2)
+    # each output channel is one position of each 2x2 block
+    np.testing.assert_allclose(np.sort(out[0, :, 0, 0]), [0, 1, 4, 5])
+
+
+def test_hash_deterministic():
+    ids = np.array([[1, 2], [1, 2], [3, 4]], dtype="int64")
+
+    def build():
+        v = fluid.data("h", [3, 2], False, dtype="int64")
+        return fluid.layers.hash(v, hash_size=100, num_hash=2)
+
+    out = _run(build, {"h": ids})
+    assert out.shape == (3, 2, 1)
+    np.testing.assert_array_equal(out[0], out[1])
+    assert (out >= 0).all() and (out < 100).all()
+
+
+def test_batch_size_like_random():
+    x = np.zeros((5, 3), dtype="float32")
+
+    def build():
+        v = fluid.data("bs", [-1, 3], False, dtype="float32")
+        u = fluid.layers.uniform_random_batch_size_like(v, [0, 7], min=0.0,
+                                                        max=1.0, seed=3)
+        g = fluid.layers.gaussian_random_batch_size_like(v, [0, 2], seed=3)
+        return [u, g]
+
+    u, g = _run(build, {"bs": x})
+    assert u.shape == (5, 7) and g.shape == (5, 2)
+    assert (u >= 0).all() and (u <= 1).all()
+
+
+def test_selected_rows_shims():
+    x = np.ones((2, 2), dtype="float32")
+
+    def build():
+        v = fluid.data("sr", [2, 2], False, dtype="float32")
+        return fluid.layers.get_tensor_from_selected_rows(
+            fluid.layers.merge_selected_rows(v))
+
+    np.testing.assert_allclose(_run(build, {"sr": x}), x)
+
+
+def test_py_func_forward():
+    x = np.array([[1.0, 2.0]], dtype="float32")
+
+    def double_plus_one(a):
+        return np.asarray(a) * 2 + 1
+
+    def build():
+        v = fluid.data("pf", [1, 2], False, dtype="float32")
+        out = fluid.default_main_program().current_block().create_var(
+            name="pf_out", dtype="float32", shape=[1, 2])
+        fluid.layers.py_func(double_plus_one, v, out)
+        return out
+
+    np.testing.assert_allclose(_run(build, {"pf": x}), x * 2 + 1)
+
+
+def test_py_func_requires_static_shape():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        v = fluid.data("pf2", [-1, 2], False, dtype="float32")
+        bad = fluid.default_main_program().current_block().create_var(
+            name="pf2_out", dtype="float32", shape=[-1, 2])
+        with pytest.raises(ValueError):
+            fluid.layers.py_func(lambda a: a, v, bad)
+
+
+def test_py_func_backward():
+    """backward_func drives gradients through the host callback."""
+    x = np.array([[1.0, 2.0, 3.0]], dtype="float32")
+
+    def fwd(a):
+        return np.asarray(a) ** 2
+
+    def bwd(a, dy):
+        return 2.0 * np.asarray(a) * np.asarray(dy)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = fluid.data("pfb", [1, 3], False, dtype="float32")
+        w = fluid.layers.create_parameter([1, 3], "float32", name="pfb_w",
+                                          default_initializer=None)
+        h = fluid.layers.elementwise_mul(v, w)
+        out = fluid.default_main_program().current_block().create_var(
+            name="pfb_out", dtype="float32", shape=[1, 3])
+        fluid.layers.py_func(fwd, h, out, backward_func=bwd)
+        loss = fluid.layers.mean(out)
+        grads = fluid.append_backward(loss)
+    gmap = {p.name: g.name for p, g in grads}
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    wv = np.asarray(fluid.global_scope().get("pfb_w")).copy()
+    res = exe.run(main, feed={"pfb": x},
+                  fetch_list=[loss.name, gmap["pfb_w"]])
+    # d loss / d w = d mean((x*w)^2) / dw = 2*(x*w)*x / 3
+    expect = 2.0 * (x * wv) * x / 3.0
+    np.testing.assert_allclose(res[1], expect, rtol=1e-5)
+
+
+def test_tracer_trace_op_outputs_and_stop_gradient():
+    from paddle_tpu.fluid.dygraph.tracer import VarBase, current_tracer
+
+    with fluid.dygraph.guard():
+        tr = current_tracer()
+        a = fluid.dygraph.to_variable(np.ones(2, dtype="float32"))
+        dst = VarBase(np.zeros(2, dtype="float32"))
+        before = len(tr._tape)
+        tr.trace_op("scale", {"X": a}, outputs={"Out": [dst]},
+                    attrs={"scale": 3.0}, stop_gradient=True)
+        np.testing.assert_allclose(dst.numpy(), 3.0)
+        assert len(tr._tape) == before  # stop_gradient: nothing taped

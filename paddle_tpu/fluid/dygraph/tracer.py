@@ -172,14 +172,45 @@ class Tracer:
         if outputs is None:
             return res
         results = list(res) if isinstance(res, (tuple, list)) else [res]
+        # pair caller vars by the op's declared slot order, not dict order
+        from .. import registry
+
+        info = registry.get_op(op_type)
         flat_outs = []
-        for slot_vars in outputs.values():
-            flat_outs.extend(slot_vars if isinstance(slot_vars, (list, tuple))
-                             else [slot_vars])
+        for slot in info.output_slots:
+            cslot = slot.rstrip("*")
+            if cslot not in outputs:
+                flat_outs.append(None)
+                continue
+            sv = outputs[cslot]
+            flat_outs.extend(sv if isinstance(sv, (list, tuple)) else [sv])
+        present = [d for d in flat_outs if d is not None]
+        if len(present) != len([r for r, d in zip(results, flat_outs)
+                                if d is not None]):
+            raise ValueError(
+                f"trace_op({op_type}): outputs covers {len(present)} vars "
+                f"but the op produced {len(results)} results")
+        subst = {}
         for dst, src in zip(flat_outs, results):
+            if dst is None or src is None:
+                continue
             dst._value = src._value
             dst.stop_gradient = src.stop_gradient
-        return res
+            subst[id(src)] = dst
+            # rebind the tape's recorded output to the caller's VarBase —
+            # backward matches by object identity, so copying values alone
+            # would sever the autograd chain through dst
+            if self._tape and not stop_gradient:
+                entry = self._tape[-1]
+                entry.outputs = [
+                    dst if o is src else
+                    (tuple(dst if e is src else e for e in o)
+                     if isinstance(o, tuple) else o)
+                    for o in entry.outputs
+                ]
+        # hand back the caller's vars so both handles share one identity
+        out = [subst.get(id(r), r) for r in results]
+        return tuple(out) if isinstance(res, (tuple, list)) else out[0]
 
     def trace_var(self, name, var):
         """Register a named VarBase with the tracer (reference trace_var).

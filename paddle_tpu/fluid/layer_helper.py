@@ -45,6 +45,12 @@ class LayerHelper:
             return None
         suffix = "b" if is_bias else "w"
         if attr.name is None:
+            # copy before naming: callers reuse one ParamAttr across several
+            # create_parameter calls (e.g. dynamic_lstmp's two weights), and
+            # mutating the shared object would silently alias the parameters
+            import copy
+
+            attr = copy.copy(attr)
             attr.name = unique_name.generate(".".join([self.name, suffix]))
         if default_initializer is None:
             default_initializer = Constant(0.0) if is_bias else Xavier()

@@ -6,6 +6,8 @@ from . import io
 from .nn import *  # noqa: F401,F403
 from . import nn_tail
 from .nn_tail import *  # noqa: F401,F403
+from . import nn_tail2
+from .nn_tail2 import *  # noqa: F401,F403
 from .tensor import (  # noqa: F401
     create_tensor, create_parameter, create_global_var, fill_constant,
     fill_constant_batch_size_like, sums, assign, zeros, ones, zeros_like,

@@ -1,0 +1,537 @@
+"""Long-tail layers, part 2 (reference layers/nn.py): 3D pooling/conv,
+row_conv, lstm/dynamic_lstmp, norms (spectral/data), feature products,
+sequence extras, losses, mean_iou, affine_grid, ctc_greedy_decoder.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..framework import unique_name
+from ..initializer import Constant
+from ..layer_helper import LayerHelper
+from .nn import _single_out_layer
+from .nn_tail import _batch_size_like  # noqa: F401 (re-export convenience)
+
+__all__ = [
+    "pool3d", "adaptive_pool3d", "conv3d_transpose", "row_conv", "lstm",
+    "dynamic_lstmp", "spectral_norm", "data_norm", "bilinear_tensor_product",
+    "add_position_encoding", "temporal_shift", "fsp_matrix",
+    "similarity_focus", "tree_conv", "sequence_pad", "sequence_reshape",
+    "sequence_scatter", "lod_reset", "lod_append",
+    "reorder_lod_tensor_by_rank", "center_loss", "npair_loss",
+    "sigmoid_focal_loss", "teacher_student_sigmoid_loss",
+    "sampled_softmax_with_cross_entropy", "mean_iou", "affine_grid",
+    "ctc_greedy_decoder", "tensor_array_to_tensor",
+]
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True):
+    helper = LayerHelper("pool3d", name=name)
+
+    def _trip(v):
+        return [v, v, v] if isinstance(v, int) else list(v)
+
+    return _single_out_layer(
+        helper, "pool3d", {"X": [input]},
+        {"pooling_type": pool_type, "ksize": _trip(pool_size),
+         "strides": _trip(pool_stride), "paddings": _trip(pool_padding),
+         "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+         "exclusive": exclusive})
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    if require_index:
+        raise NotImplementedError("require_index is not supported on TPU "
+                                  "(data-dependent index output)")
+    helper = LayerHelper("adaptive_pool3d", name=name)
+    ps = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
+    return _single_out_layer(
+        helper, "pool3d", {"X": [input]},
+        {"pooling_type": pool_type, "ksize": ps, "adaptive": True,
+         "strides": [1, 1, 1], "paddings": [0, 0, 0]})
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    from .nn import _conv_bias
+
+    helper = LayerHelper("conv3d_transpose", name=name, act=act,
+                         bias_attr=bias_attr)
+    in_channels = input.shape[1]
+
+    def _trip(v):
+        return [v, v, v] if isinstance(v, int) else list(v)
+
+    stride, padding, dilation = _trip(stride), _trip(padding), _trip(dilation)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("filter_size or output_size required")
+        out_sz = _trip(output_size)
+        filter_size = [
+            out_sz[i] - (input.shape[2 + i] - 1) * stride[i] + 2 * padding[i]
+            for i in range(3)
+        ]
+    else:
+        filter_size = _trip(filter_size)
+    groups = groups or 1
+    w = helper.create_parameter(
+        attr=param_attr,
+        shape=[in_channels, num_filters // groups] + filter_size,
+        dtype=input.dtype, default_initializer=None)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("conv3d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups})
+    out = _conv_bias(helper, out, bias_attr, num_filters, input.dtype)
+    return helper.append_activation(out)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             length=None):
+    helper = LayerHelper("row_conv", act=act)
+    w = helper.create_parameter(
+        attr=param_attr, shape=[future_context_size + 1, input.shape[-1]],
+        dtype=input.dtype, default_initializer=None)
+    ins = {"X": [input], "Filter": [w]}
+    if length is not None:
+        ins["Length"] = [length]
+    out = _single_out_layer(helper, "row_conv", ins)
+    return helper.append_activation(out)
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1, length=None):
+    """Stacked (cuDNN-style) LSTM (reference nn.py lstm → cudnn_lstm_op).
+    input: [B, T, D]; init_h/init_c: [num_layers, B, hidden]; returns
+    (out [B,T,hidden*dirs], last_h, last_c).  Bidirectional runs a reverse
+    pass per layer and concats, like cuDNN."""
+    from . import nn as nn_mod
+    from .control_flow import increment  # noqa: F401  (parity import)
+
+    helper = LayerHelper("cudnn_lstm", name=name)
+    x = input
+    last_hs, last_cs = [], []
+    dirs = 2 if is_bidirec else 1
+
+    def _state_slice(state, idx):
+        # init_h/init_c: [num_layers*dirs, B, hidden] → [B, hidden]
+        if state is None:
+            return None
+        s = nn_mod.slice(state, axes=[0], starts=[idx], ends=[idx + 1])
+        return nn_mod.squeeze(s, axes=[0])
+
+    for layer_i in range(num_layers):
+        outs_dir = []
+        for d in range(dirs):
+            wx = helper.create_parameter(
+                attr=None, shape=[x.shape[-1], 4 * hidden_size],
+                dtype=input.dtype, default_initializer=default_initializer)
+            wh = helper.create_parameter(
+                attr=None, shape=[hidden_size, 4 * hidden_size],
+                dtype=input.dtype, default_initializer=default_initializer)
+            b = helper.create_parameter(
+                attr=None, shape=[4 * hidden_size], dtype=input.dtype,
+                is_bias=True, default_initializer=Constant(0.0))
+            proj = nn_mod.matmul(x, wx)
+            hidden = helper.create_variable_for_type_inference(input.dtype)
+            cell = helper.create_variable_for_type_inference(input.dtype)
+            ins = {"Input": [proj], "Weight": [wh], "Bias": [b]}
+            h0 = _state_slice(init_h, layer_i * dirs + d)
+            c0 = _state_slice(init_c, layer_i * dirs + d)
+            if h0 is not None:
+                ins["H0"] = [h0]
+            if c0 is not None:
+                ins["C0"] = [c0]
+            if length is not None:
+                ins["Length"] = [length]
+            helper.append_op(
+                "lstm", inputs=ins,
+                outputs={"Hidden": [hidden], "Cell": [cell]},
+                attrs={"is_reverse": bool(d == 1)})
+            outs_dir.append((hidden, cell))
+        if dirs == 2:
+            x = nn_mod.concat([outs_dir[0][0], outs_dir[1][0]], axis=-1)
+        else:
+            x = outs_dir[0][0]
+        if dropout_prob > 0.0 and not is_test:
+            x = nn_mod.dropout(x, dropout_prob, is_test=is_test, seed=seed)
+        for hidden, cell in outs_dir:
+            last_hs.append(nn_mod.sequence_last_step(hidden, length=length))
+            last_cs.append(nn_mod.sequence_last_step(cell, length=length))
+    last_h = nn_mod.stack(last_hs, axis=0)
+    last_c = nn_mod.stack(last_cs, axis=0)
+    return x, last_h, last_c
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, length=None):
+    """LSTM with recurrent projection (reference nn.py dynamic_lstmp →
+    lstmp_op.cc).  input: [B, T, 4*D] pre-projected; returns
+    (projection [B,T,P], cell [B,T,D])."""
+    helper = LayerHelper("lstmp", name=name)
+    d = size // 4
+    w = helper.create_parameter(attr=param_attr, shape=[proj_size, 4 * d],
+                                dtype=dtype, default_initializer=None)
+    w_proj = helper.create_parameter(attr=param_attr, shape=[d, proj_size],
+                                     dtype=dtype, default_initializer=None)
+    bias_size = 7 * d if use_peepholes else 4 * d
+    b = helper.create_parameter(attr=bias_attr, shape=[1, bias_size],
+                                dtype=dtype, is_bias=True,
+                                default_initializer=Constant(0.0))
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [w], "ProjWeight": [w_proj],
+           "Bias": [b]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("lstmp", inputs=ins,
+                     outputs={"Projection": [proj], "Cell": [cell]},
+                     attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation,
+                            "proj_activation": proj_activation})
+    return proj, cell
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", name=name)
+    h = weight.shape[dim]
+    w_rest = int(np.prod([s for i, s in enumerate(weight.shape) if i != dim]))
+    import paddle_tpu.fluid.initializer as init_mod
+
+    u = helper.create_parameter(attr=None, shape=[h], dtype=weight.dtype,
+                                default_initializer=init_mod.Normal(0., 1.))
+    v = helper.create_parameter(attr=None, shape=[w_rest], dtype=weight.dtype,
+                                default_initializer=init_mod.Normal(0., 1.))
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype=weight.dtype)
+    helper.append_op("spectral_norm",
+                     inputs={"Weight": [weight], "U": [u], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": dim, "power_iters": power_iters,
+                            "eps": eps})
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    """Normalize by accumulated global stats (reference nn.py data_norm).
+    The three stat params (batch_size/sum/square_sum) are persistable and
+    train like the reference's (updated by the optimizer from their grads)."""
+    helper = LayerHelper("data_norm", name=name, act=act)
+    c = input.shape[-1]
+    bsize = helper.create_parameter(attr=None, shape=[c], dtype=input.dtype,
+                                    default_initializer=Constant(1e4))
+    bsum = helper.create_parameter(attr=None, shape=[c], dtype=input.dtype,
+                                   default_initializer=Constant(0.0))
+    bsq = helper.create_parameter(attr=None, shape=[c], dtype=input.dtype,
+                                  default_initializer=Constant(1e4))
+    y = helper.create_variable_for_type_inference(dtype=input.dtype)
+    means = helper.create_variable_for_type_inference(dtype=input.dtype)
+    scales = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("data_norm",
+                     inputs={"X": [input], "BatchSize": [bsize],
+                             "BatchSum": [bsum], "BatchSquareSum": [bsq]},
+                     outputs={"Y": [y], "Means": [means], "Scales": [scales]},
+                     attrs={"epsilon": epsilon})
+    return helper.append_activation(y)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", name=name, act=act)
+    w = helper.create_parameter(
+        attr=param_attr, shape=[size, x.shape[-1], y.shape[-1]],
+        dtype=x.dtype, default_initializer=None)
+    ins = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr, shape=[1, size],
+                                    dtype=x.dtype, is_bias=True,
+                                    default_initializer=Constant(0.0))
+        ins["Bias"] = [b]
+    out = _single_out_layer(helper, "bilinear_tensor_product", ins)
+    return helper.append_activation(out)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    helper = LayerHelper("add_position_encoding", name=name)
+    return _single_out_layer(helper, "add_position_encoding", {"X": [input]},
+                             {"alpha": alpha, "beta": beta})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    helper = LayerHelper("temporal_shift", name=name)
+    return _single_out_layer(helper, "temporal_shift", {"X": [x]},
+                             {"seg_num": seg_num,
+                              "shift_ratio": shift_ratio})
+
+
+def fsp_matrix(x, y):
+    helper = LayerHelper("fsp")
+    return _single_out_layer(helper, "fsp", {"X": [x], "Y": [y]})
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    helper = LayerHelper("similarity_focus", name=name)
+    return _single_out_layer(helper, "similarity_focus", {"X": [input]},
+                             {"axis": axis, "indexes": list(indexes)})
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """TBCNN tree convolution (reference nn.py tree_conv → tree_conv_op.cc).
+    Depth-1 child-aggregation approximation — see ops/nn_extra_ops.py."""
+    from . import nn as nn_mod
+
+    helper = LayerHelper("tree_conv", name=name, act=act)
+    d = nodes_vector.shape[-1]
+    outs = []
+    for _ in range(num_filters):
+        w = helper.create_parameter(attr=param_attr,
+                                    shape=[d, 3, output_size],
+                                    dtype=nodes_vector.dtype,
+                                    default_initializer=None)
+        out = helper.create_variable_for_type_inference(nodes_vector.dtype)
+        helper.append_op("tree_conv",
+                         inputs={"NodesVector": [nodes_vector],
+                                 "EdgeSet": [edge_set], "Filter": [w]},
+                         outputs={"Out": [out]}, attrs={})
+        outs.append(nn_mod.unsqueeze(out, axes=[2]))
+    merged = outs[0] if len(outs) == 1 else nn_mod.concat(outs, axis=2)
+    if bias_attr is not False:  # None = default bias, like the reference
+        b = helper.create_parameter(attr=bias_attr, shape=[output_size],
+                                    dtype=nodes_vector.dtype, is_bias=True,
+                                    default_initializer=Constant(0.0))
+        merged = nn_mod.elementwise_add(merged, b)
+    return helper.append_activation(merged)
+
+
+# -- sequence extras --------------------------------------------------------
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """In the dense+length representation x is already padded; this masks the
+    tail with pad_value and returns (out, length) (reference sequence_pad)."""
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    out_len = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    ins = {"X": [x], "PadValue": [pad_value]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("sequence_pad", inputs=ins,
+                     outputs={"Out": [out], "OutLength": [out_len]},
+                     attrs={"padded_length": -1 if maxlen is None else maxlen})
+    return out, out_len
+
+
+def sequence_reshape(input, new_dim, length=None):
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    out_len = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    ins = {"X": [input]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("sequence_reshape", inputs=ins,
+                     outputs={"Out": [out], "OutLength": [out_len]},
+                     attrs={"new_dim": new_dim})
+    return out
+
+
+def sequence_scatter(input, index, updates, length=None, name=None):
+    helper = LayerHelper("sequence_scatter", name=name)
+    ins = {"X": [input], "Ids": [index], "Updates": [updates]}
+    if length is not None:
+        ins["Length"] = [length]
+    return _single_out_layer(helper, "sequence_scatter", ins)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Replace x's sequence structure (reference lod_reset_op.cc).  In the
+    dense+length analog the data is unchanged; the new lengths come from y
+    (a length tensor or a var with lengths) or target_lod."""
+    from . import tensor as tensor_mod
+
+    if y is None and target_lod is None:
+        raise ValueError("lod_reset needs y or target_lod")
+    helper = LayerHelper("lod_reset")
+    out = _single_out_layer(helper, "assign", {"X": [x]})
+    if y is not None:
+        out._length_var = y
+    else:
+        out._length_var = tensor_mod.assign(
+            np.asarray(target_lod, dtype="int32"))
+    out.lod_level = max(getattr(x, "lod_level", 0) or 0, 1)
+    return out
+
+
+def lod_append(x, level):
+    """Append a finer LoD level (reference lod_append).  Dense analog:
+    attach the new level's lengths as the length var."""
+    return lod_reset(x, y=level if isinstance(level, framework.Variable)
+                     else None,
+                     target_lod=None if isinstance(level, framework.Variable)
+                     else level)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Reorder batch rows by the rank table (descending length order);
+    rank_table is the length tensor in the dense+length design (built by
+    control_flow.lod_rank_table)."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    return _single_out_layer(helper, "reorder_lod_tensor_by_rank",
+                             {"X": [x], "RankTable": [rank_table]})
+
+
+# -- losses -----------------------------------------------------------------
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    helper = LayerHelper("center_loss")
+    centers = helper.create_parameter(
+        attr=param_attr, shape=[num_classes, input.shape[-1]],
+        dtype=input.dtype, default_initializer=Constant(0.0))
+    centers.stop_gradient = True
+    rate = helper.create_parameter(attr=None, shape=[1], dtype=input.dtype,
+                                   default_initializer=Constant(float(alpha)))
+    rate.stop_gradient = True
+    diff = helper.create_variable_for_type_inference(input.dtype)
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    # CentersOut writes back into the centers param (the batch_norm
+    # MeanOut/VarianceOut pattern) so updates actually persist
+    helper.append_op("center_loss",
+                     inputs={"X": [input], "Label": [label],
+                             "Centers": [centers],
+                             "CenterUpdateRate": [rate]},
+                     outputs={"CentersOut": [centers],
+                              "SampleCenterDiff": [diff], "Loss": [loss]},
+                     attrs={"need_update": update_center})
+    return loss
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    helper = LayerHelper("npair_loss")
+    return _single_out_layer(helper, "npair_loss_op",
+                             {"Anchor": [anchor], "Positive": [positive],
+                              "Labels": [labels]}, {"l2_reg": l2_reg})
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2, alpha=0.25):
+    helper = LayerHelper("sigmoid_focal_loss")
+    return _single_out_layer(helper, "sigmoid_focal_loss",
+                             {"X": [x], "Label": [label], "FgNum": [fg_num]},
+                             {"gamma": gamma, "alpha": alpha})
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("teacher_student_sigmoid_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_max_up_bound": soft_max_up_bound,
+                            "soft_max_lower_bound": soft_max_lower_bound})
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    helper = LayerHelper("sampled_softmax_with_cross_entropy")
+    out = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    helper.append_op("sampled_softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Loss": [out]},
+                     attrs={"num_samples": num_samples, "seed": seed})
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op("mean_iou",
+                     inputs={"Predictions": [input], "Labels": [label]},
+                     outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                              "OutCorrect": [correct]},
+                     attrs={"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def affine_grid(theta, out_shape, name=None):
+    helper = LayerHelper("affine_grid", name=name)
+    if isinstance(out_shape, framework.Variable):
+        raise NotImplementedError(
+            "out_shape as a tensor is a dynamic shape; pass a python list "
+            "on TPU")
+    out = helper.create_variable_for_type_inference(dtype=theta.dtype)
+    helper.append_op("affine_grid", inputs={"Theta": [theta]},
+                     outputs={"Output": [out]},
+                     attrs={"output_shape": list(out_shape)})
+    return out
+
+
+def ctc_greedy_decoder(input, blank, name=None, input_length=None):
+    """Greedy CTC decode (reference ctc_greedy_decoder = argmax + ctc_align).
+    Returns (decoded [B, T] padded with -1, lengths [B])."""
+    from . import nn as nn_mod
+
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    ids = nn_mod.argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    ins = {"Input": [ids]}
+    if input_length is not None:
+        ins["Length"] = [input_length]
+    helper.append_op("ctc_align", inputs=ins,
+                     outputs={"Output": [out], "OutLength": [out_len]},
+                     attrs={"blank": blank, "padding_value": -1})
+    return out, out_len
+
+
+def tensor_array_to_tensor(input, axis=1, name=None):
+    """Concat the entries of a tensor array (reference
+    tensor_array_to_tensor op).  Growable LoDTensorArrays are unsupported on
+    TPU (dynamic shapes — see control_flow.create_array); the supported form
+    takes a python list of vars, the static encoding of an array.  Returns
+    (out, out_index) where out_index holds each entry's extent along axis."""
+    from . import nn as nn_mod
+    from . import tensor as tensor_mod
+
+    if not isinstance(input, (list, tuple)) or not input:
+        raise ValueError(
+            "tensor_array_to_tensor on TPU takes a non-empty python list of "
+            "Variables (static tensor array); growable LoDTensorArray needs "
+            "dynamic shapes")
+    out = nn_mod.concat(list(input), axis=axis)
+    sizes = np.asarray([e.shape[axis] if e.shape else 1 for e in input],
+                       dtype="int32")
+    out_index = tensor_mod.assign(sizes)
+    return out, out_index

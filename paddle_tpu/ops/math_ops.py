@@ -405,11 +405,7 @@ _act("asin", jnp.arcsin)
 _act("atan", jnp.arctan)
 _act("logsigmoid", jax.nn.log_sigmoid)
 
-
-@simple_op("stanh", ["X"], ["Out"])
-def _stanh(ctx, x, attrs):
-    return attrs.get("scale_b", 1.7159) * jnp.tanh(
-        attrs.get("scale_a", 2.0 / 3.0) * x)
+# (stanh is registered above with the prelu/hard_swish group)
 
 
 @simple_op("hard_shrink", ["X"], ["Out"])

@@ -1,0 +1,378 @@
+"""Numeric checks for long-tail layers part 2 (pool3d, row_conv, lstmp,
+spectral/data norm, bilinear, position encoding, temporal shift, fsp,
+sequence extras, losses, mean_iou, affine_grid, ctc greedy decode)."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    names = [o.name for o in (outs if isinstance(outs, (list, tuple)) else [outs])]
+    res = exe.run(main, feed=feeds, fetch_list=names)
+    return res if isinstance(outs, (list, tuple)) else res[0]
+
+
+def test_pool3d_avg():
+    x = np.arange(2 * 1 * 2 * 2 * 2, dtype="float32").reshape(2, 1, 2, 2, 2)
+
+    def build():
+        v = fluid.data("p3", [2, 1, 2, 2, 2], False, dtype="float32")
+        return fluid.layers.pool3d(v, 2, "avg", 2)
+
+    out = _run(build, {"p3": x})
+    np.testing.assert_allclose(out.ravel(), x.reshape(2, -1).mean(1))
+
+
+def test_row_conv_numeric():
+    x = np.arange(1 * 4 * 2, dtype="float32").reshape(1, 4, 2)
+
+    def build():
+        v = fluid.data("rc", [1, 4, 2], False, dtype="float32")
+        return fluid.layers.row_conv(v, 1, param_attr=fluid.ParamAttr(
+            initializer=fluid.initializer.Constant(1.0)))
+
+    out = _run(build, {"rc": x})
+    # w = ones(2,2): out[t] = x[t] + x[t+1] (zero-pad last)
+    expect = x + np.concatenate([x[:, 1:], np.zeros((1, 1, 2), "float32")], 1)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_lstmp_shapes_and_masking():
+    x = np.random.RandomState(0).randn(2, 5, 12).astype("float32")
+    ln = np.array([3, 5], dtype="int32")
+
+    def build():
+        v = fluid.data("lp", [2, 5, 12], False, dtype="float32")
+        l = fluid.data("lpl", [2], False, dtype="int32")
+        proj, cell = fluid.layers.dynamic_lstmp(v, 12, 4, length=l,
+                                                use_peepholes=False)
+        return [proj, cell]
+
+    proj, cell = _run(build, {"lp": x, "lpl": ln})
+    assert proj.shape == (2, 5, 4) and cell.shape == (2, 5, 3)
+    # masked positions are zero
+    np.testing.assert_allclose(proj[0, 3:], 0.0)
+    assert np.abs(proj[1, 3:]).max() > 0
+
+
+def test_spectral_norm_unit_sigma():
+    def build():
+        w = fluid.layers.create_parameter(
+            [4, 6], "float32", name="sn_w",
+            default_initializer=fluid.initializer.Normal(0.0, 1.0))
+        return fluid.layers.spectral_norm(w, power_iters=30)
+
+    out = _run(build, {})
+    s = np.linalg.svd(out, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+def test_data_norm_math():
+    x = np.random.RandomState(1).randn(6, 3).astype("float32")
+
+    def build():
+        v = fluid.data("dnx", [6, 3], False, dtype="float32")
+        return fluid.layers.data_norm(v)
+
+    out = _run(build, {"dnx": x})
+    # initial stats: size=1e4, sum=0, sqsum=1e4 → mean 0, scale ~1
+    np.testing.assert_allclose(out, x, rtol=1e-3, atol=1e-4)
+
+
+def test_bilinear_tensor_product_numeric():
+    x = np.array([[1.0, 2.0]], dtype="float32")
+
+    def build():
+        v = fluid.data("btx", [1, 2], False, dtype="float32")
+        return fluid.layers.bilinear_tensor_product(
+            v, v, 1, param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.Constant(1.0)),
+            bias_attr=False)
+
+    out = _run(build, {"btx": x})
+    # W=ones: out = sum_i sum_j x_i x_j = (1+2)^2
+    np.testing.assert_allclose(out, [[9.0]], rtol=1e-6)
+
+
+def test_add_position_encoding_formula():
+    x = np.zeros((1, 3, 4), dtype="float32")
+
+    def build():
+        v = fluid.data("pe", [1, 3, 4], False, dtype="float32")
+        return fluid.layers.add_position_encoding(v, alpha=0.0, beta=1.0)
+
+    out = _run(build, {"pe": x})
+    pos = np.arange(3)[:, None]
+    freq = np.power(10000.0, -np.arange(2) / 2)
+    ang = pos * freq[None, :]
+    expect = np.concatenate([np.sin(ang), np.cos(ang)], axis=1)
+    np.testing.assert_allclose(out[0], expect, rtol=1e-5)
+
+
+def test_temporal_shift_moves_channels():
+    x = np.arange(4 * 4 * 1 * 1, dtype="float32").reshape(4, 4, 1, 1)
+
+    def build():
+        v = fluid.data("tsx", [4, 4, 1, 1], False, dtype="float32")
+        return fluid.layers.temporal_shift(v, seg_num=2, shift_ratio=0.25)
+
+    out = _run(build, {"tsx": x})
+    x5 = x.reshape(2, 2, 4, 1, 1)
+    # channel 0 shifted backward (t gets t+1), channel 1 forward, rest copy
+    assert out.reshape(2, 2, 4)[0, 0, 0] == x5[0, 1, 0, 0, 0]
+    assert out.reshape(2, 2, 4)[0, 1, 1] == x5[0, 0, 1, 0, 0]
+    np.testing.assert_allclose(out.reshape(2, 2, 4)[:, :, 2:],
+                               x5.reshape(2, 2, 4)[:, :, 2:])
+
+
+def test_fsp_matrix_numeric():
+    x = np.random.RandomState(2).randn(1, 2, 2, 2).astype("float32")
+    y = np.random.RandomState(3).randn(1, 3, 2, 2).astype("float32")
+
+    def build():
+        a = fluid.data("fx", [1, 2, 2, 2], False, dtype="float32")
+        b = fluid.data("fy", [1, 3, 2, 2], False, dtype="float32")
+        return fluid.layers.fsp_matrix(a, b)
+
+    out = _run(build, {"fx": x, "fy": y})
+    expect = np.einsum("bihw,bjhw->bij", x, y) / 4.0
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_sequence_reshape_and_scatter():
+    x = np.arange(2 * 2 * 4, dtype="float32").reshape(2, 2, 4)
+
+    def build():
+        v = fluid.data("sq", [2, 2, 4], False, dtype="float32")
+        base = fluid.data("sb", [2, 5], False, dtype="float32")
+        ids = fluid.data("sqi", [2, 2], False, dtype="int64")
+        upd = fluid.data("squ", [2, 2], False, dtype="float32")
+        return [fluid.layers.sequence_reshape(v, 2),
+                fluid.layers.sequence_scatter(base, ids, upd)]
+
+    r, s = _run(build, {
+        "sq": x, "sb": np.zeros((2, 5), "float32"),
+        "sqi": np.array([[0, 1], [2, 2]], dtype="int64"),
+        "squ": np.ones((2, 2), dtype="float32")})
+    assert r.shape == (2, 4, 2)
+    np.testing.assert_allclose(r.reshape(2, -1), x.reshape(2, -1))
+    np.testing.assert_allclose(s[0], [1, 1, 0, 0, 0])
+    np.testing.assert_allclose(s[1], [0, 0, 2, 0, 0])  # duplicate ids add
+
+
+def test_reorder_by_rank():
+    x = np.arange(6, dtype="float32").reshape(3, 2)
+    ln = np.array([1, 3, 2], dtype="int32")
+
+    def build():
+        v = fluid.data("ro", [3, 2], False, dtype="float32")
+        l = fluid.data("rol", [3], False, dtype="int32")
+        return fluid.layers.reorder_lod_tensor_by_rank(v, l)
+
+    out = _run(build, {"ro": x, "rol": ln})
+    np.testing.assert_allclose(out, x[[1, 2, 0]])
+
+
+def test_center_loss_value():
+    x = np.array([[1.0, 0.0], [0.0, 1.0]], dtype="float32")
+    lbl = np.array([[0], [1]], dtype="int64")
+
+    def build():
+        v = fluid.data("clx", [2, 2], False, dtype="float32")
+        l = fluid.data("cll", [2, 1], False, dtype="int64")
+        return fluid.layers.center_loss(v, l, 3, 0.5)
+
+    out = _run(build, {"clx": x, "cll": lbl})
+    # centers start at 0 → loss = 0.5*||x||^2 = 0.5 each
+    np.testing.assert_allclose(out.ravel(), [0.5, 0.5])
+
+
+def test_mean_iou_exact():
+    pred = np.array([0, 0, 1, 1], dtype="int32")
+    lbl = np.array([0, 1, 1, 1], dtype="int32")
+
+    def build():
+        p = fluid.data("mp", [4], False, dtype="int32")
+        l = fluid.data("ml", [4], False, dtype="int32")
+        miou, wrong, correct = fluid.layers.mean_iou(p, l, 2)
+        return [miou, wrong, correct]
+
+    miou, wrong, correct = _run(build, {"mp": pred, "ml": lbl})
+    # class0: i=1,u=2 → 0.5 ; class1: i=2,u=3 → 2/3 ; mean = 7/12
+    np.testing.assert_allclose(miou, 7 / 12, rtol=1e-5)
+
+
+def test_affine_grid_identity():
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], dtype="float32"),
+                    (1, 1, 1))
+
+    def build():
+        t = fluid.data("agt", [1, 2, 3], False, dtype="float32")
+        return fluid.layers.affine_grid(t, [1, 1, 3, 3])
+
+    out = _run(build, {"agt": theta})
+    np.testing.assert_allclose(out[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(out[0, 2, 2], [1, 1], atol=1e-6)
+
+
+def test_ctc_greedy_decoder_collapse():
+    # argmax sequence: [1,1,0,2,2,0] → collapse → [1,2]
+    probs = np.zeros((1, 6, 3), dtype="float32")
+    for t, k in enumerate([1, 1, 0, 2, 2, 0]):
+        probs[0, t, k] = 5.0
+
+    def build():
+        p = fluid.data("cgp", [1, 6, 3], False, dtype="float32")
+        out, ln = fluid.layers.ctc_greedy_decoder(p, blank=0)
+        return [out, ln]
+
+    out, ln = _run(build, {"cgp": probs})
+    assert ln[0] == 2
+    np.testing.assert_array_equal(out[0, :2], [1, 2])
+    assert (out[0, 2:] == -1).all()
+
+
+def test_sampled_softmax_trains():
+    """Loss is positive and decreases when the true logit grows."""
+    lo = np.zeros((2, 20), dtype="float32")
+    hi = np.zeros((2, 20), dtype="float32")
+    hi[np.arange(2), [3, 7]] = 10.0
+
+    def build():
+        v = fluid.data("ssl", [2, 20], False, dtype="float32")
+        l = fluid.data("ssy", [2, 1], False, dtype="int64")
+        return fluid.layers.sampled_softmax_with_cross_entropy(v, l, 5)
+
+    lbl = np.array([[3], [7]], dtype="int64")
+    loss_lo = _run(build, {"ssl": lo, "ssy": lbl}).mean()
+    loss_hi = _run(build, {"ssl": hi, "ssy": lbl}).mean()
+    assert loss_hi < loss_lo
+
+
+def test_stacked_lstm_trains():
+    """layers.lstm end-to-end gradient flow (fwd+bwd+sgd one step)."""
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("sl_x", [4, 5, 6], False, dtype="float32")
+        y = fluid.data("sl_y", [4, 1], False, dtype="int64")
+        out, lh, lc = fluid.layers.lstm(x, None, None, 5, 8, 2)
+        logits = fluid.layers.fc(fluid.layers.sequence_last_step(out), 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _ in range(12):
+        xv = rng.randn(4, 5, 6).astype("float32")
+        yv = (xv.sum((1, 2), keepdims=False)[:, None] > 0).astype("int64")
+        losses.append(float(exe.run(main, feed={"sl_x": xv, "sl_y": yv},
+                                    fetch_list=[loss.name])[0]))
+    assert np.isfinite(losses).all()
+
+
+def test_center_loss_updates_centers():
+    x = np.array([[2.0, 0.0]], dtype="float32")
+    lbl = np.array([[1]], dtype="int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = fluid.data("cux", [1, 2], False, dtype="float32")
+        l = fluid.data("cul", [1, 1], False, dtype="int64")
+        loss = fluid.layers.center_loss(v, l, 3, 0.5, update_center=True)
+    centers_name = next(p.name for p in main.all_parameters()
+                        if "center_loss" in p.name and p.shape == (3, 2))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={"cux": x, "cul": lbl}, fetch_list=[loss.name])
+        centers = np.asarray(scope.get(centers_name))
+    assert np.abs(centers[1]).max() > 0, "centers must move toward the batch"
+    assert np.abs(centers[0]).max() == 0 and np.abs(centers[2]).max() == 0
+
+
+def test_lstm_initial_state_used():
+    x = np.zeros((2, 3, 4), dtype="float32")
+    h0 = np.ones((1, 2, 5), dtype="float32")
+    c0 = np.ones((1, 2, 5), dtype="float32")
+
+    def build(with_state):
+        def b():
+            v = fluid.data("li_x", [2, 3, 4], False, dtype="float32")
+            if with_state:
+                ih = fluid.data("li_h", [1, 2, 5], False, dtype="float32")
+                ic = fluid.data("li_c", [1, 2, 5], False, dtype="float32")
+            else:
+                ih = ic = None
+            out, lh, lc = fluid.layers.lstm(v, ih, ic, 3, 5, 1,
+                                            default_initializer=
+                                            fluid.initializer.Constant(0.1))
+            return out
+        return b
+
+    out0 = _run(build(False), {"li_x": x})
+    out1 = _run(build(True), {"li_x": x, "li_h": h0, "li_c": c0})
+    assert np.abs(out1 - out0).max() > 1e-4, \
+        "nonzero init state must change the output"
+
+
+def test_conv3d_transpose_groups():
+    x = np.random.RandomState(0).randn(1, 4, 2, 2, 2).astype("float32")
+
+    def build():
+        v = fluid.data("g3", [1, 4, 2, 2, 2], False, dtype="float32")
+        return fluid.layers.conv3d_transpose(
+            v, 4, filter_size=2, stride=2, groups=2,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.Constant(1.0)), bias_attr=False)
+
+    out = _run(build, {"g3": x})
+    assert out.shape == (1, 4, 4, 4, 4)
+    # grouped: each output channel sums only its group's 2 input channels
+    expect_ch0 = x[0, :2].sum(axis=0)  # group 0
+    np.testing.assert_allclose(out[0, 0, ::2, ::2, ::2], expect_ch0,
+                               rtol=1e-5)
+
+
+def test_lstmp_peepholes_change_output():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 4, 8).astype("float32")
+
+    def build(peep):
+        def b():
+            v = fluid.data("pp", [1, 4, 8], False, dtype="float32")
+            proj, _ = fluid.layers.dynamic_lstmp(
+                v, 8, 3, use_peepholes=peep,
+                param_attr=fluid.ParamAttr(
+                    initializer=fluid.initializer.Constant(0.3)),
+                bias_attr=fluid.ParamAttr(
+                    initializer=fluid.initializer.Constant(0.5)))
+            return proj
+        return b
+
+    with_peep = _run(build(True), {"pp": x})
+    without = _run(build(False), {"pp": x})
+    assert np.abs(with_peep - without).max() > 1e-5
+
+
+def test_trace_op_outputs_keep_autograd():
+    from paddle_tpu.fluid.dygraph.tracer import VarBase, current_tracer
+
+    with fluid.dygraph.guard():
+        tr = current_tracer()
+        a = fluid.dygraph.to_variable(np.ones(3, dtype="float32"))
+        a.stop_gradient = False  # to_variable defaults to data (no grad)
+        dst = VarBase(np.zeros(3, dtype="float32"))
+        tr.trace_op("scale", {"X": a}, outputs={"Out": [dst]},
+                    attrs={"scale": 1.5})
+        loss = fluid.dygraph.trace_op("mean", {"X": dst})
+        loss.backward()
+        assert a.gradient() is not None
+        np.testing.assert_allclose(a.gradient(), 1.5 / 3, rtol=1e-6)

@@ -171,27 +171,27 @@ class Tracer:
             res = self.trace(op_type, inputs, attrs=attrs)
         if outputs is None:
             return res
-        results = list(res) if isinstance(res, (tuple, list)) else [res]
-        # pair caller vars by the op's declared slot order, not dict order
+        # trace() returns one entry PER SLOT (a tuple for variadic slots);
+        # pair caller vars slot-wise against that structure
         from .. import registry
 
         info = registry.get_op(op_type)
-        flat_outs = []
-        for slot in info.output_slots:
+        per_slot = list(res) if isinstance(res, (tuple, list)) else [res]
+        pairs = []  # (dst VarBase, src VarBase)
+        for slot, result in zip(info.output_slots, per_slot):
             cslot = slot.rstrip("*")
             if cslot not in outputs:
-                flat_outs.append(None)
                 continue
             sv = outputs[cslot]
-            flat_outs.extend(sv if isinstance(sv, (list, tuple)) else [sv])
-        present = [d for d in flat_outs if d is not None]
-        if len(present) != len([r for r, d in zip(results, flat_outs)
-                                if d is not None]):
-            raise ValueError(
-                f"trace_op({op_type}): outputs covers {len(present)} vars "
-                f"but the op produced {len(results)} results")
+            dsts = list(sv) if isinstance(sv, (list, tuple)) else [sv]
+            srcs = list(result) if isinstance(result, tuple) else [result]
+            if len(dsts) != len(srcs):
+                raise ValueError(
+                    f"trace_op({op_type}): slot {cslot!r} got {len(dsts)} "
+                    f"output vars but the op produced {len(srcs)} values")
+            pairs.extend(zip(dsts, srcs))
         subst = {}
-        for dst, src in zip(flat_outs, results):
+        for dst, src in pairs:
             if dst is None or src is None:
                 continue
             dst._value = src._value
@@ -208,8 +208,14 @@ class Tracer:
                      if isinstance(o, tuple) else o)
                     for o in entry.outputs
                 ]
+
+        def _sub(r):
+            if isinstance(r, tuple):
+                return tuple(subst.get(id(e), e) for e in r)
+            return subst.get(id(r), r)
+
         # hand back the caller's vars so both handles share one identity
-        out = [subst.get(id(r), r) for r in results]
+        out = [_sub(r) for r in per_slot]
         return tuple(out) if isinstance(res, (tuple, list)) else out[0]
 
     def trace_var(self, name, var):

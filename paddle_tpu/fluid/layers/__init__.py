@@ -8,6 +8,14 @@ from . import nn_tail
 from .nn_tail import *  # noqa: F401,F403
 from . import nn_tail2
 from .nn_tail2 import *  # noqa: F401,F403
+from . import distributions
+from .distributions import Normal, Uniform  # noqa: F401
+from .io import (  # noqa: F401
+    GraphReader, py_reader, create_py_reader_by_data, open_files,
+    random_data_generator, read_file, shuffle, batch, double_buffer, load,
+    Preprocessor,
+)
+from .control_flow import DynamicRNN, IfElse, Print  # noqa: F401
 from .tensor import (  # noqa: F401
     create_tensor, create_parameter, create_global_var, fill_constant,
     fill_constant_batch_size_like, sums, assign, zeros, ones, zeros_like,

@@ -360,3 +360,180 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
                          outputs={"Out": [counter]},
                          attrs={"step": float(step)})
     return counter
+
+
+# ---------------------------------------------------------------------------
+# DynamicRNN / IfElse / Print (reference control_flow.py:  DynamicRNN builds
+# a while loop over a LoD rank table; IfElse partitions rows by a bool mask.
+# TPU-native: DynamicRNN adapts the padded dense+length representation onto
+# StaticRNN (lax.scan); IfElse computes both branches on all rows and selects
+# elementwise — same results, no data-dependent shapes.)
+# ---------------------------------------------------------------------------
+
+
+class DynamicRNN:
+    """Variable-length RNN over padded [B, T, ...] batches + a length tensor
+    (reference DynamicRNN's LoD walk, re-based on lax.scan).
+
+    with drnn.block():
+        x_t = drnn.step_input(x, length=seq_len)   # [B, D] per step
+        h = drnn.memory(init=h0)
+        new_h = ...                                 # build step computation
+        drnn.update_memory(h, new_h)
+        drnn.output(new_h)
+    out = drnn()                                    # [B, T, D_out]
+
+    Positions past each row's length hold zeros in the stacked output (the
+    scan itself runs the full padded T; feed zero padding so memories see
+    null inputs on padded steps).
+    """
+
+    def __init__(self, name=None):
+        self._srnn = StaticRNN(name=name)
+        self._length = None
+        self._in_block = False
+
+    @contextlib.contextmanager
+    def block(self):
+        self._in_block = True
+        try:
+            with self._srnn.step():
+                yield
+        finally:
+            self._in_block = False
+
+    def step_input(self, x, level=0, length=None):
+        """x: [B, T, ...] padded batch; returns the [B, ...] step slice."""
+        if not self._in_block:
+            raise ValueError("step_input must be called inside block()")
+        if length is not None:
+            self._length = length
+        # time-major transpose must live in the PARENT block (it runs before
+        # the scan), but we're inside the sub-block here — append directly
+        parent = self._srnn._parent_block
+        perm = [1, 0] + list(range(2, len(x.shape)))
+        xt = parent.create_var(
+            name=unique_name.generate(x.name + "@tmajor"),
+            shape=tuple(x.shape[i] for i in perm), dtype=x.dtype)
+        xshape = parent.create_var(
+            name=unique_name.generate(x.name + "@tmajor_xs"),
+            dtype=x.dtype, stop_gradient=True)
+        parent.append_op("transpose2", inputs={"X": [x]},
+                         outputs={"Out": [xt], "XShape": [xshape]},
+                         attrs={"axis": perm})
+        return self._srnn.step_input(xt)
+
+    def static_input(self, x):
+        """Non-sequence input visible at every step (reference
+        static_input); captured by the scan body as a closure."""
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32"):
+        if init is None:
+            raise ValueError("DynamicRNN.memory requires init= on TPU "
+                             "(value-only boot needs a dynamic batch dim)")
+        return self._srnn.memory(init=init)
+
+    def update_memory(self, ex_mem, new_mem):
+        self._srnn.update_memory(ex_mem, new_mem)
+
+    def output(self, *outputs):
+        self._srnn.output(*outputs)
+
+    def __call__(self):
+        from . import nn as nn_mod
+
+        outs = []
+        for stacked in self._srnn._outputs:  # [T, B, ...] time-major
+            o = nn_mod.transpose(
+                stacked, [1, 0] + list(range(2, len(stacked.shape or [0, 0]))))
+            if self._length is not None:
+                o = nn_mod.sequence_unpad(o, self._length)  # zero the tail
+            outs.append(o)
+        return outs[0] if len(outs) == 1 else outs
+
+
+class IfElse:
+    """Row-wise two-branch select (reference IfElse partitions rows where
+    cond is true/false, runs each branch on its rows, and merges).  Dense
+    analog: both branches run on ALL rows inside their own blocks and the
+    merge is an elementwise where(cond) — identical results for the
+    reference's per-row usage, XLA-friendly shapes."""
+
+    OUT_IF_ELSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self.helper = LayerHelper("ifelse", name=name)
+        self._true_outs = None
+        self._false_outs = None
+        self._phase = None
+
+    def input(self, x):
+        if self._phase is None:
+            raise ValueError("IfElse.input must be called inside "
+                             "true_block()/false_block()")
+        return x
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._phase = True
+        try:
+            yield
+        finally:
+            self._phase = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._phase = False
+        try:
+            yield
+        finally:
+            self._phase = None
+
+    def output(self, *outs):
+        if self._phase is True:
+            self._true_outs = list(outs)
+        elif self._phase is False:
+            self._false_outs = list(outs)
+        else:
+            raise ValueError("IfElse.output must be called inside a branch")
+
+    def __call__(self):
+        from . import nn as nn_mod
+
+        if self._true_outs is None or self._false_outs is None:
+            raise ValueError("both true_block and false_block must produce "
+                             "output()")
+        if len(self._true_outs) != len(self._false_outs):
+            raise ValueError("branch output arity mismatch")
+        merged = []
+        helper = self.helper
+        for t, f in zip(self._true_outs, self._false_outs):
+            out = helper.create_variable_for_type_inference(dtype=t.dtype)
+            helper.append_op("where",
+                             inputs={"Condition": [self.cond], "X": [t],
+                                     "Y": [f]},
+                             outputs={"Out": [out]}, attrs={})
+            merged.append(out)
+        return merged if len(merged) > 1 else merged[0]
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Pass-through tensor printing (reference print_op).  Printing runs via
+    jax.debug.print where the backend supports host callbacks (CPU); on
+    backends without callback support (axon TPU) the op is a pure identity —
+    fetch the var to inspect it there."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("print", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"message": message or "",
+                            "first_n": first_n, "summarize": summarize})
+    return out
+
+
+__all__ += ["DynamicRNN", "IfElse", "Print"]

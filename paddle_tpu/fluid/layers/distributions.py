@@ -1,0 +1,103 @@
+"""Distribution classes (reference python/paddle/fluid/layers/distributions.py):
+Normal and Uniform over graph Variables or python scalars.  All math is
+composed from registered ops so results live in the compiled program.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from . import nn as nn_mod
+from . import tensor as tensor_mod
+
+__all__ = ["Normal", "Uniform"]
+
+
+def _as_var(v, like=None):
+    if isinstance(v, Variable):
+        return v
+    arr = np.asarray(v, dtype="float32")
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
+    return tensor_mod.assign(arr)
+
+
+class Distribution:
+    def _broadcast_shape(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    """Gaussian with loc/scale (reference distributions.py Normal)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_var(loc)
+        self.scale = _as_var(scale)
+
+    def sample(self, shape, seed=0):
+        """shape: extra leading sample dims (reference semantics)."""
+        full_shape = list(shape) + list(self.loc.shape or [1])
+        z = nn_mod.gaussian_random(full_shape, mean=0.0, std=1.0, seed=seed)
+        return nn_mod.elementwise_add(
+            nn_mod.elementwise_mul(z, self.scale), self.loc)
+
+    def entropy(self):
+        # 0.5 + 0.5*log(2*pi) + log(sigma)
+        const = 0.5 + 0.5 * math.log(2 * math.pi)
+        return nn_mod.scale(nn_mod.log(self.scale), scale=1.0,
+                            bias=const)
+
+    def log_prob(self, value):
+        var = nn_mod.elementwise_mul(self.scale, self.scale)
+        diff = nn_mod.elementwise_sub(value, self.loc)
+        quad = nn_mod.elementwise_div(
+            nn_mod.elementwise_mul(diff, diff), var)
+        log_scale = nn_mod.log(self.scale)
+        half = nn_mod.scale(quad, scale=-0.5)
+        return nn_mod.elementwise_sub(
+            nn_mod.scale(half, scale=1.0, bias=-0.5 * math.log(2 * math.pi)),
+            log_scale)
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two Normals (reference formula)."""
+        var_ratio = nn_mod.elementwise_div(self.scale, other.scale)
+        var_ratio = nn_mod.elementwise_mul(var_ratio, var_ratio)
+        t1 = nn_mod.elementwise_div(
+            nn_mod.elementwise_sub(self.loc, other.loc), other.scale)
+        t1 = nn_mod.elementwise_mul(t1, t1)
+        inner = nn_mod.elementwise_sub(
+            nn_mod.elementwise_add(var_ratio, t1),
+            nn_mod.scale(nn_mod.log(var_ratio), scale=1.0, bias=1.0))
+        return nn_mod.scale(inner, scale=0.5)
+
+
+class Uniform(Distribution):
+    """Uniform on [low, high) (reference distributions.py Uniform)."""
+
+    def __init__(self, low, high):
+        self.low = _as_var(low)
+        self.high = _as_var(high)
+
+    def sample(self, shape, seed=0):
+        full_shape = list(shape) + list(self.low.shape or [1])
+        u = nn_mod.uniform_random(full_shape, min=0.0, max=1.0, seed=seed)
+        span = nn_mod.elementwise_sub(self.high, self.low)
+        return nn_mod.elementwise_add(
+            nn_mod.elementwise_mul(u, span), self.low)
+
+    def entropy(self):
+        return nn_mod.log(nn_mod.elementwise_sub(self.high, self.low))
+
+    def log_prob(self, value):
+        from . import tensor as t
+
+        span = nn_mod.elementwise_sub(self.high, self.low)
+        lb = nn_mod.cast(nn_mod.less_equal(self.low, value), "float32")
+        ub = nn_mod.cast(nn_mod.less_than(value, self.high), "float32")
+        inside = nn_mod.elementwise_mul(lb, ub)
+        # log(inside/span): -inf outside the support, like the reference
+        return nn_mod.log(nn_mod.elementwise_div(inside, span))

@@ -24,3 +24,281 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
     return block.create_var(name=name, shape=shape, dtype=dtype,
                             lod_level=lod_level, stop_gradient=stop_gradient,
                             is_data=True)
+
+
+# ---------------------------------------------------------------------------
+# Reader-as-layer API (reference layers/io.py py_reader/open_files/read_file
+# + operators/reader/).  TPU-native stance: the reference's reader ops pop a
+# C++ blocking queue inside the graph; here a reader is a host-side iterable
+# producing feed dicts (prefetch + device put-ahead live in
+# fluid.reader.PyReader), and `read_file` hands back the declared data vars.
+# The executor feeds each batch explicitly — no dynamic-shape reader ops in
+# the compiled program.
+# ---------------------------------------------------------------------------
+
+
+class GraphReader:
+    """A reader layer object: declared data vars + a sample stream, composed
+    by shuffle/batch/double_buffer, iterated as feed dicts."""
+
+    def __init__(self, feed_vars, capacity=64, use_double_buffer=True,
+                 sample_creator=None, name=None):
+        self.feed_vars = list(feed_vars)
+        self.capacity = capacity
+        self.use_double_buffer = use_double_buffer
+        self._sample_creator = sample_creator  # yields sample tuples
+        self._pyreader = None
+        self._feed_transform = None  # per-batch feed-dict hook (Preprocessor)
+        self.name = name
+
+    # -- reference PyReader-compatible decoration ---------------------------
+    def _make_pyreader(self):
+        from ..reader import PyReader
+
+        r = PyReader(feed_list=self.feed_vars, capacity=self.capacity,
+                     use_double_buffer=self.use_double_buffer)
+        return r
+
+    def decorate_paddle_reader(self, reader, places=None):
+        """reader yields sample tuples; batching must already be applied
+        (paddle.batch) — matches reference py_reader usage."""
+        self._pyreader = self._make_pyreader()
+        self._pyreader.decorate_sample_list_generator(reader, places)
+        return self
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_tensor_provider(self, reader, places=None):
+        self._pyreader = self._make_pyreader()
+        self._pyreader.decorate_batch_generator(reader, places)
+        return self
+
+    decorate_batch_generator = decorate_tensor_provider
+
+    # -- iteration ----------------------------------------------------------
+    def start(self):
+        """Reference non-iterable start(): a no-op here — iterate the reader
+        for feed dicts (the iterable mode is the only mode on TPU)."""
+        return self
+
+    def reset(self):
+        return self
+
+    def __call__(self):
+        return iter(self)
+
+    def __iter__(self):
+        if self._pyreader is not None:
+            it = iter(self._pyreader)
+            if self._feed_transform is None:
+                return it
+            return (self._feed_transform(feed) for feed in it)
+        if self._sample_creator is None:
+            raise ValueError(
+                "reader has no data source: decorate it or build it from "
+                "open_files/random_data_generator, and apply layers.batch")
+        raise ValueError(
+            "sample-level reader must be batched first: "
+            "reader = fluid.layers.batch(reader, batch_size)")
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Declare a prefetching reader with typed slots (reference
+    layers/io.py py_reader → create_py_reader_op + LoDTensorBlockingQueue)."""
+    lod_levels = lod_levels or [0] * len(shapes)
+    feed_vars = []
+    base = name or framework.unique_name.generate("py_reader")
+    for i, (shp, dt, ll) in enumerate(zip(shapes, dtypes, lod_levels)):
+        shp = list(shp)
+        block = framework.default_main_program().current_block()
+        v = block.create_var(name=f"{base}_slot{i}", shape=shp, dtype=dt,
+                             lod_level=ll, stop_gradient=True, is_data=True)
+        feed_vars.append(v)
+    return GraphReader(feed_vars, capacity=capacity,
+                       use_double_buffer=use_double_buffer, name=base)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    return GraphReader(feed_list, capacity=capacity,
+                       use_double_buffer=use_double_buffer, name=name)
+
+
+def open_files(filenames, shapes, lod_levels=None, dtypes=None,
+               thread_num=None, buffer_size=None, pass_num=1,
+               is_test=None):
+    """Stream samples from native RecordIO files (reference open_files_op).
+    Records are pickled sample tuples (fluid.recordio_writer format)."""
+    import pickle
+
+    if isinstance(filenames, str):
+        filenames = [filenames]
+    dtypes = dtypes or ["float32"] * len(shapes)
+    rdr = py_reader(capacity=buffer_size or 64, shapes=shapes, dtypes=dtypes,
+                    lod_levels=lod_levels)
+
+    def samples():
+        from paddle_tpu import native
+
+        for _ in range(pass_num):
+            for path in filenames:
+                with native.RecordIOScanner(path) as sc:
+                    for rec in sc:
+                        yield pickle.loads(rec)
+
+    rdr._sample_creator = samples
+    return rdr
+
+
+def random_data_generator(low, high, shapes, lod_levels=None, for_parallel=True):
+    """Uniform-random sample stream (reference random_data_generator_op);
+    infinite — bound it with layers.batch + a step-limited loop."""
+    import numpy as _np
+
+    rdr = py_reader(capacity=64, shapes=shapes,
+                    dtypes=["float32"] * len(shapes),
+                    lod_levels=lod_levels)
+
+    def samples():
+        rng = _np.random.RandomState(0)
+        while True:
+            yield tuple(
+                rng.uniform(low, high,
+                            [d for d in shp if d and d > 0] or [1])
+                .astype("float32")
+                for shp in shapes)
+
+    rdr._sample_creator = samples
+    return rdr
+
+
+def read_file(reader):
+    """Unpack a reader's declared data vars (reference read_file → read op).
+    Feed dicts come from iterating the reader; the vars are the feed slots."""
+    vs = reader.feed_vars
+    return vs[0] if len(vs) == 1 else vs
+
+
+def shuffle(reader, buffer_size):
+    """Buffered shuffle of the sample stream (reference shuffle reader op)."""
+    from paddle_tpu import reader as _decorators
+
+    if reader._sample_creator is None:
+        raise ValueError("shuffle applies to a sample-source reader "
+                         "(open_files / random_data_generator)")
+    reader._sample_creator = _decorators.shuffle(reader._sample_creator,
+                                                 buffer_size)
+    return reader
+
+
+def batch(reader, batch_size):
+    """Batch the sample stream and bind it as the reader's feed source
+    (reference batch reader op)."""
+    from paddle_tpu import reader as _decorators
+
+    if reader._sample_creator is None:
+        raise ValueError("batch applies to a sample-source reader")
+    reader.decorate_paddle_reader(
+        _decorators.batch(reader._sample_creator, batch_size))
+    return reader
+
+
+def double_buffer(reader, place=None, name=None):
+    """Device put-ahead (reference double_buffer_op / buffered_reader.cc);
+    prefetch is built into the reader pipeline — this toggles it on."""
+    reader.use_double_buffer = True
+    if reader._pyreader is not None:
+        reader._pyreader.use_double_buffer = True
+    return reader
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Load a saved variable into `out` at run time (reference load_op).
+    Runs host-side (file IO), before the compiled step consumes it."""
+    block = framework.default_main_program().current_block()
+    block.append_op("load_var", inputs={}, outputs={"Out": [out.name]},
+                    attrs={"file_path": file_path,
+                           "load_as_fp16": bool(load_as_fp16)})
+    return out
+
+
+class Preprocessor:
+    """Per-batch preprocessing sub-program over a reader (reference
+    layers/io.py Preprocessor).  The block between inputs() and outputs()
+    is captured as its own Program and run on the host for every batch."""
+
+    def __init__(self, reader, name=None):
+        self.reader = reader
+        self.name = name
+        self._in_vars = None
+        self._out_vars = None
+        self._program = None
+
+    import contextlib as _contextlib
+
+    @_contextlib.contextmanager
+    def block(self):
+        self._program = framework.Program()
+        self._outer = framework.default_main_program()
+        with framework.program_guard(self._program, framework.Program()):
+            yield
+        if self._in_vars is None or self._out_vars is None:
+            raise ValueError("Preprocessor.block must call inputs() and "
+                             "outputs()")
+        # rebind the reader's feed vars to the preprocessor outputs: shapes
+        # may change, so redeclare the outer data vars accordingly
+        new_feed = []
+        for i, ov in enumerate(self._out_vars):
+            blk = self._outer.current_block()
+            v = blk.create_var(
+                name=framework.unique_name.generate("preprocessed"),
+                shape=ov.shape, dtype=ov.dtype, stop_gradient=True,
+                is_data=True)
+            new_feed.append(v)
+        self._orig_feed = list(self.reader.feed_vars)
+        self.reader.feed_vars = new_feed
+        self._wrap_reader()
+
+    def inputs(self):
+        self._in_vars = [
+            framework.default_main_program().current_block().create_var(
+                name=framework.unique_name.generate("preproc_in"),
+                shape=v.shape, dtype=v.dtype, is_data=True,
+                stop_gradient=True)
+            for v in self.reader.feed_vars
+        ]
+        return self._in_vars
+
+    def outputs(self, *outs):
+        self._out_vars = list(outs)
+
+    def _wrap_reader(self):
+        reader = self.reader
+        program = self._program
+        in_names = [v.name for v in self._in_vars]
+        out_names = [v.name for v in self._out_vars]
+        new_names = [v.name for v in reader.feed_vars]
+        orig_names = [v.name for v in self._orig_feed]
+        if reader._pyreader is None:
+            raise ValueError("apply layers.batch / decorate the reader "
+                             "before wrapping it in a Preprocessor")
+
+        from ..executor import Executor, Scope, scope_guard
+        from ..framework import CPUPlace
+
+        exe = Executor(CPUPlace())
+
+        def transform(feed):
+            feed_in = {in_n: feed[orig_n]
+                       for in_n, orig_n in zip(in_names, orig_names)}
+            with scope_guard(Scope()):
+                outs = exe.run(program, feed=feed_in, fetch_list=out_names)
+            return dict(zip(new_names, outs))
+
+        reader._feed_transform = transform
+
+
+__all__ += ["GraphReader", "py_reader", "create_py_reader_by_data",
+            "open_files", "random_data_generator", "read_file", "shuffle",
+            "batch", "double_buffer", "load", "Preprocessor"]

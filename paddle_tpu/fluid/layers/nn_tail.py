@@ -234,3 +234,32 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     helper.append_op("py_func", inputs={"X": list(xs)},
                      outputs={"Out": list(outs)}, attrs=attrs)
     return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len, keeping aspect ratio
+    (reference nn.py image_resize_short).  input: [N, C, H, W]."""
+    from . import nn as nn_mod
+
+    h, w = input.shape[2], input.shape[3]
+    if h is None or w is None or h < 0 or w < 0:
+        raise ValueError("image_resize_short needs static H/W on TPU")
+    short = min(h, w)
+    out_shape = [int(round(h * out_short_len / short)),
+                 int(round(w * out_short_len / short))]
+    return nn_mod.image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def random_crop(x, shape, seed=None):
+    """Random spatial crop to `shape` (reference random_crop_op).  The crop
+    offset is drawn on device per step; shape is static as XLA requires."""
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("random_crop", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": list(shape),
+                            "seed": 0 if seed is None else int(seed)})
+    return out
+
+
+__all__ += ["image_resize_short", "random_crop"]

@@ -165,9 +165,13 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
             x = outs_dir[0][0]
         if dropout_prob > 0.0 and not is_test:
             x = nn_mod.dropout(x, dropout_prob, is_test=is_test, seed=seed)
-        for hidden, cell in outs_dir:
-            last_hs.append(nn_mod.sequence_last_step(hidden, length=length))
-            last_cs.append(nn_mod.sequence_last_step(cell, length=length))
+        for di, (hidden, cell) in enumerate(outs_dir):
+            # the reverse pass re-reverses its output into original time
+            # order, so its fully-accumulated state sits at t=0, not t=len-1
+            pick = (nn_mod.sequence_first_step if di == 1
+                    else nn_mod.sequence_last_step)
+            last_hs.append(pick(hidden, length=length))
+            last_cs.append(pick(cell, length=length))
     last_h = nn_mod.stack(last_hs, axis=0)
     last_c = nn_mod.stack(last_cs, axis=0)
     return x, last_h, last_c
@@ -221,9 +225,11 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     u.stop_gradient = True
     v.stop_gradient = True
     out = helper.create_variable_for_type_inference(dtype=weight.dtype)
+    # UOut/VOut write back into u/v so the power-iteration estimate
+    # accumulates across steps (reference updates U/V in place)
     helper.append_op("spectral_norm",
                      inputs={"Weight": [weight], "U": [u], "V": [v]},
-                     outputs={"Out": [out]},
+                     outputs={"Out": [out], "UOut": [u], "VOut": [v]},
                      attrs={"dim": dim, "power_iters": power_iters,
                             "eps": eps})
     return out

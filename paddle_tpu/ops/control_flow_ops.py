@@ -151,3 +151,22 @@ def _static_rnn(ctx, step_ins, inits, extras, extras_ng, attrs):
 
     final_mems, stacked = lax.scan(f, tuple(inits), tuple(step_ins))
     return (tuple(stacked), tuple(final_mems))
+
+
+@simple_op("print", ["X"], ["Out"])
+def _print(ctx, x, attrs):
+    """Pass-through with host-side printing where supported (reference
+    print_op).  axon TPU has no host callbacks → identity there."""
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "unknown"
+    if backend == "cpu":
+        msg = (attrs.get("message") or "print")
+        # user text must not be treated as format fields (jax's formatter
+        # rejects {{-escapes, so substitute plain parens)
+        msg = msg.replace("{", "(").replace("}", ")")
+        jax.debug.print(msg + ": {x}", x=x)
+    return x

@@ -182,12 +182,13 @@ def _lstmp(ctx, x, w, w_proj, bias, h0, c0, length, attrs):
 # ---------------------------------------------------------------------------
 
 
-@simple_op("spectral_norm", ["Weight", "U", "V"], ["Out"],
+@simple_op("spectral_norm", ["Weight", "U", "V"], ["Out", "UOut", "VOut"],
            no_grad_inputs=("U", "V"))
 def _spectral_norm(ctx, w, u, v, attrs):
     """Power-iteration spectral normalization (reference spectral_norm_op.cc).
-    u/v are persistent estimate vectors; iterations run under stop_gradient
-    (the reference likewise treats u/v as buffers)."""
+    u/v are persistent estimate vectors; the refined vectors are written back
+    (UOut/VOut alias the U/V params in the layer) so the estimate converges
+    over training like the reference's in-place update."""
     dim = attrs.get("dim", 0)
     power_iters = attrs.get("power_iters", 1)
     eps = attrs.get("eps", 1e-12)
@@ -209,7 +210,8 @@ def _spectral_norm(ctx, w, u, v, attrs):
     out = wm / sigma
     out = jnp.reshape(out, [w.shape[i] for i in perm])
     inv = np.argsort(perm)
-    return jnp.transpose(out, inv).astype(w.dtype)
+    return (jnp.transpose(out, inv).astype(w.dtype),
+            u_.astype(u.dtype), v_.astype(v.dtype))
 
 
 @simple_op("data_norm", ["X", "BatchSize", "BatchSum", "BatchSquareSum"],
@@ -290,13 +292,19 @@ def _fsp(ctx, x, y, attrs):
 @simple_op("similarity_focus", ["X"], ["Out"], grad=None)
 def _similarity_focus(ctx, x, attrs):
     """Focus mask: for each (axis-index) slice, mark positions that are the
-    per-(H,W) channel maxima (reference similarity_focus_op.cc simplified to
-    its documented effect: a {0,1} mask of the most-similar positions)."""
+    maxima over the NON-selected trailing dims (reference
+    similarity_focus_op.cc simplified to its documented effect: a {0,1}
+    mask of the most-similar positions).  x is 4D; axis in {1, 2, 3}."""
     axis = attrs.get("axis", 1)
     indexes = attrs.get("indexes", [0])
-    sel = jnp.take(x, jnp.asarray(indexes), axis=axis)  # [N, K, H, W]
-    m = (sel == jnp.max(sel, axis=(2, 3), keepdims=True)).astype(x.dtype)
-    mask = jnp.max(m, axis=1, keepdims=True)
+    if axis not in (1, 2, 3):
+        raise ValueError("similarity_focus: axis must be 1, 2, or 3")
+    sel = jnp.take(x, jnp.asarray(indexes), axis=axis)
+    # reduce over the other two non-batch dims (their positions in `sel`
+    # are unchanged: take() preserves rank)
+    red = tuple(d for d in (1, 2, 3) if d != axis)
+    m = (sel == jnp.max(sel, axis=red, keepdims=True)).astype(x.dtype)
+    mask = jnp.max(m, axis=axis, keepdims=True)
     reps = [1] * x.ndim
     reps[axis] = x.shape[axis]
     return jnp.tile(mask, reps)
@@ -323,8 +331,9 @@ def _tree_conv(ctx, nodes, edges, w, attrs):
     nodes1 = jnp.pad(nodes, ((0, 0), (1, 0), (0, 0)))  # 1-based
     child_mean = (adj / deg) @ nodes1                   # [B, N+1, D]
     w_self, w_l, w_r = w[:, 0, :], w[:, 1, :], w[:, 2, :]
+    # no activation here: the layer applies its configurable act on top
     out = (nodes1 @ w_self + child_mean @ (w_l + w_r) * 0.5)
-    return jnp.maximum(out[:, 1:, :], 0.0).astype(nodes.dtype)
+    return out[:, 1:, :].astype(nodes.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -236,3 +236,47 @@ register_op("py_func", ["X*"], ["Out*"], _py_func_lower, grad=None,
             grad_maker=_py_func_grad_maker)
 register_op("py_func_grad", ["X*", "DOut*"], ["DX*"], _py_func_grad_lower,
             grad=None)
+
+
+def _load_var_run(scope, op, place):
+    """Host op (reference load_op): load a saved array into the scope var."""
+    path = op.attrs["file_path"]
+    name = op.outputs["Out"][0]
+    if path.endswith(".npz"):
+        with np.load(path, allow_pickle=False) as data:
+            arr = data[name] if name in data else data[list(data.files)[0]]
+    else:
+        arr = np.load(path, allow_pickle=False)
+    if op.attrs.get("load_as_fp16"):
+        arr = arr.astype(np.float16)
+    scope.set(name, arr)
+
+
+def _no_lower(ctx, attrs):  # host-only op: never traced
+    raise RuntimeError("load_var is a host op")
+
+
+register_op("load_var", [], ["Out"], _no_lower, grad=None,
+            host_run=_load_var_run)
+
+
+@simple_op("random_crop", ["X"], ["Out"], grad=None)
+def _random_crop(ctx, x, attrs):
+    """Random crop of the trailing dims to attrs['shape'] (reference
+    random_crop_op.cc).  Offsets drawn per call via the op rng; the leading
+    (batch/channel) dims not covered by `shape` pass through."""
+    shape = list(attrs["shape"])
+    key = op_rng_key(ctx, attrs)
+    nd = len(shape)
+    lead = x.ndim - nd
+    starts = []
+    for i, target in enumerate(shape):
+        extent = x.shape[lead + i]
+        key, sub = jax.random.split(key)
+        max_off = extent - target
+        off = jax.random.randint(sub, (), 0, max_off + 1) if max_off > 0 else 0
+        starts.append(off)
+    start_full = [0] * lead + [jnp.asarray(s) for s in starts]
+    sizes = list(x.shape[:lead]) + shape
+    return jax.lax.dynamic_slice(x, [jnp.asarray(s, jnp.int32)
+                                     for s in start_full], sizes)

@@ -1,0 +1,263 @@
+"""Reader layers, control-flow classes (DynamicRNN/IfElse/Print),
+distributions, image ops (reference layers/io.py, control_flow.py,
+distributions.py tails)."""
+
+import math
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def test_distributions_normal_uniform():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        n = fluid.layers.Normal(0.0, 1.0)
+        s = n.sample([16], seed=1)
+        e = n.entropy()
+        lp = n.log_prob(fluid.layers.zeros([1], "float32"))
+        kl = n.kl_divergence(fluid.layers.Normal(1.0, 2.0))
+        u = fluid.layers.Uniform(0.0, 2.0)
+        ue = u.entropy()
+        ulp = u.log_prob(fluid.layers.fill_constant([1], "float32", 1.0))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rs = exe.run(main, feed={}, fetch_list=[s.name, e.name, lp.name, kl.name,
+                                            ue.name, ulp.name])
+    sample, ent, logp, kld, uent, ulogp = [np.asarray(r) for r in rs]
+    assert sample.shape == (16, 1)
+    assert abs(float(ent[0]) - (0.5 + 0.5 * math.log(2 * math.pi))) < 1e-5
+    assert abs(float(logp[0]) - (-0.5 * math.log(2 * math.pi))) < 1e-5
+    expect_kl = math.log(2.0) + 2 / 8.0 - 0.5
+    assert abs(float(kld[0]) - expect_kl) < 1e-5
+    assert abs(float(uent[0]) - math.log(2.0)) < 1e-5
+    assert abs(float(ulogp[0]) - math.log(0.5)) < 1e-5
+
+
+def test_dynamic_rnn_cumsum_with_lengths():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("dr_x", [3, 4, 5], False, dtype="float32")
+        ln = fluid.data("dr_l", [3], False, dtype="int32")
+        h0 = fluid.layers.fill_constant_batch_size_like(
+            x, [-1, 5], "float32", 0.0)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x, length=ln)
+            h = drnn.memory(init=h0)
+            nh = fluid.layers.elementwise_add(h, xt)
+            drnn.update_memory(h, nh)
+            drnn.output(nh)
+        out = drnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.asarray(exe.run(
+        main, feed={"dr_x": np.ones((3, 4, 5), "float32"),
+                    "dr_l": np.array([2, 4, 1], "int32")},
+        fetch_list=[out.name])[0])
+    np.testing.assert_allclose(r[0, :, 0], [1, 2, 0, 0])
+    np.testing.assert_allclose(r[1, :, 0], [1, 2, 3, 4])
+    np.testing.assert_allclose(r[2, :, 0], [1, 0, 0, 0])
+
+
+def test_ifelse_rowwise_select_and_print():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data("ie_a", [4, 1], False, dtype="float32")
+        cond = fluid.layers.greater_than(
+            a, fluid.layers.fill_constant([4, 1], "float32", 0.0))
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(fluid.layers.scale(ie.input(a), scale=2.0))
+        with ie.false_block():
+            ie.output(fluid.layers.scale(ie.input(a), scale=-1.0))
+        merged = ie()
+        out = fluid.layers.scale(fluid.layers.Print(merged, message="dbg"),
+                                 scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.asarray(exe.run(
+        main, feed={"ie_a": np.array([[1.], [-2.], [3.], [-4.]], "float32")},
+        fetch_list=[out.name])[0])
+    np.testing.assert_allclose(r.ravel(), [2, 2, 6, 4])
+
+
+def test_ifelse_requires_both_branches():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        a = fluid.data("ie_b", [2, 1], False, dtype="float32")
+        cond = fluid.layers.greater_than(
+            a, fluid.layers.fill_constant([2, 1], "float32", 0.0))
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(a)
+        with pytest.raises(ValueError):
+            ie()
+
+
+def test_open_files_shuffle_batch_pipeline(tmp_path):
+    from paddle_tpu import native
+
+    if not native.is_available():
+        pytest.skip("native runtime unavailable")
+    path = str(tmp_path / "d.recordio")
+    with native.RecordIOWriter(path) as w:
+        for i in range(20):
+            w.write(pickle.dumps((np.full(3, i, dtype="float32"),
+                                  np.array([i % 2], dtype="int64"))))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.open_files([path], shapes=[[-1, 3], [-1, 1]],
+                                         dtypes=["float32", "int64"])
+        reader = fluid.layers.shuffle(reader, buffer_size=8)
+        reader = fluid.layers.batch(reader, batch_size=5)
+        reader = fluid.layers.double_buffer(reader)
+        img, lbl = fluid.layers.read_file(reader)
+        s = fluid.layers.reduce_sum(img)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    total, nb = 0.0, 0
+    for feed in reader():
+        total += float(np.asarray(
+            exe.run(main, feed=feed, fetch_list=[s.name])[0]))
+        nb += 1
+    assert nb == 4
+    assert abs(total - 3 * sum(range(20))) < 1e-3
+
+
+def test_py_reader_iterable():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        rdr = fluid.layers.py_reader(capacity=8, shapes=[[-1, 2]],
+                                     dtypes=["float32"])
+        xv = fluid.layers.read_file(rdr)
+        y = fluid.layers.reduce_mean(xv)
+    rdr.decorate_paddle_reader(
+        paddle.batch(lambda: iter([(np.ones(2, "float32"),)] * 6), 3))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feeds = list(rdr())
+    assert len(feeds) == 2
+    out = exe.run(main, feed=feeds[0], fetch_list=[y.name])
+    assert abs(float(np.asarray(out[0])) - 1.0) < 1e-6
+
+
+def test_random_data_generator_stream():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        rdr = fluid.layers.random_data_generator(0.0, 1.0, shapes=[[-1, 4]])
+        rdr = fluid.layers.batch(rdr, batch_size=2)
+        v = fluid.layers.read_file(rdr)
+    feed = next(iter(rdr()))
+    assert feed[v.name].shape == (2, 4)
+    assert (feed[v.name] >= 0).all() and (feed[v.name] <= 1).all()
+
+
+def test_layers_load_host_op(tmp_path):
+    arr = np.arange(6, dtype="float32").reshape(2, 3)
+    p = str(tmp_path / "w.npy")
+    np.save(p, arr)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out_var = main.global_block().create_var(
+            name="loaded_w", shape=[2, 3], dtype="float32", persistable=True)
+        fluid.layers.load(out_var, p)
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main, feed={}, fetch_list=["loaded_w"])
+    np.testing.assert_allclose(np.asarray(res[0]), arr)
+
+
+def test_preprocessor_transforms_batches():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        rdr = fluid.layers.py_reader(capacity=4, shapes=[[-1, 2]],
+                                     dtypes=["float32"])
+        rdr.decorate_paddle_reader(
+            paddle.batch(lambda: iter([(np.ones(2, "float32"),)] * 4), 2))
+        pre = fluid.layers.Preprocessor(rdr)
+        with pre.block():
+            ins = pre.inputs()
+            pre.outputs(fluid.layers.scale(ins[0], scale=10.0))
+        v = fluid.layers.read_file(rdr)
+        y = fluid.layers.reduce_mean(v)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feeds = list(rdr())
+    assert len(feeds) == 2
+    out = exe.run(main, feed=feeds[0], fetch_list=[y.name])
+    assert abs(float(np.asarray(out[0])) - 10.0) < 1e-5
+
+
+def test_image_resize_short_and_random_crop():
+    x = np.random.RandomState(0).randn(1, 3, 8, 12).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = fluid.data("irs", [1, 3, 8, 12], False, dtype="float32")
+        r = fluid.layers.image_resize_short(v, 4)
+        c = fluid.layers.random_crop(v, [4, 6], seed=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rr, cc = exe.run(main, feed={"irs": x}, fetch_list=[r.name, c.name])
+    assert np.asarray(rr).shape == (1, 3, 4, 6)  # short side 8 → 4
+    assert np.asarray(cc).shape == (1, 3, 4, 6)
+    # crop content comes from the source
+    flat_src = set(np.round(x.ravel(), 5))
+    assert set(np.round(np.asarray(cc).ravel(), 5)) <= flat_src
+
+
+def test_bidirectional_lstm_last_state():
+    """Reverse-direction last state must be its t=0 entry (fully
+    accumulated), not t=len-1."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 3).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = fluid.data("bl_x", [2, 4, 3], False, dtype="float32")
+        out, lh, lc = fluid.layers.lstm(
+            v, None, None, 4, 5, 1, is_bidirec=True,
+            default_initializer=fluid.initializer.Constant(0.2))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    o, h = exe.run(main, feed={"bl_x": x}, fetch_list=[out.name, lh.name])
+    o, h = np.asarray(o), np.asarray(h)
+    # forward dir last state == out[:, -1, :5]; reverse == out[:, 0, 5:]
+    np.testing.assert_allclose(h[0], o[:, -1, :5], rtol=1e-5)
+    np.testing.assert_allclose(h[1], o[:, 0, 5:], rtol=1e-5)
+
+
+def test_spectral_norm_uv_persist():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_parameter(
+            [4, 6], "float32", name="snp_w",
+            default_initializer=fluid.initializer.Normal(0.0, 1.0))
+        out = fluid.layers.spectral_norm(w, power_iters=1)
+    uname = next(p.name for p in main.all_parameters()
+                 if p.shape == (4,) and "spectral" in p.name)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        u0 = np.asarray(scope.get(uname)).copy()
+        exe.run(main, feed={}, fetch_list=[out.name])
+        u1 = np.asarray(scope.get(uname)).copy()
+        exe.run(main, feed={}, fetch_list=[out.name])
+        u2 = np.asarray(scope.get(uname)).copy()
+    assert np.abs(u1 - u0).max() > 1e-6, "u must be refined after a step"
+    # power iteration converges: successive updates shrink
+    assert np.abs(u2 - u1).max() < np.abs(u1 - u0).max() + 1e-3
+
+
+def test_print_message_with_braces():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data("pb_a", [2], False, dtype="float32")
+        out = fluid.layers.scale(
+            fluid.layers.Print(a, message="loss {step}"), scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = exe.run(main, feed={"pb_a": np.ones(2, "float32")},
+                fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(r[0]), 2.0)

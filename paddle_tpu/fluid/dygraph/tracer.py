@@ -171,12 +171,16 @@ class Tracer:
             res = self.trace(op_type, inputs, attrs=attrs)
         if outputs is None:
             return res
-        # trace() returns one entry PER SLOT (a tuple for variadic slots);
-        # pair caller vars slot-wise against that structure
+        # trace() returns one entry PER SLOT (a tuple for variadic slots),
+        # collapsed to the bare entry when the op has a single output slot —
+        # re-wrap so a lone variadic slot's tuple isn't misread as multi-slot
         from .. import registry
 
         info = registry.get_op(op_type)
-        per_slot = list(res) if isinstance(res, (tuple, list)) else [res]
+        if len(info.output_slots) == 1:
+            per_slot = [res]
+        else:
+            per_slot = list(res) if isinstance(res, (tuple, list)) else [res]
         pairs = []  # (dst VarBase, src VarBase)
         for slot, result in zip(info.output_slots, per_slot):
             cslot = slot.rstrip("*")
@@ -214,7 +218,10 @@ class Tracer:
                 return tuple(subst.get(id(e), e) for e in r)
             return subst.get(id(r), r)
 
-        # hand back the caller's vars so both handles share one identity
+        # hand back the caller's vars so both handles share one identity,
+        # mirroring trace()'s return structure
+        if len(info.output_slots) == 1:
+            return _sub(res)
         out = [_sub(r) for r in per_slot]
         return tuple(out) if isinstance(res, (tuple, list)) else out[0]
 

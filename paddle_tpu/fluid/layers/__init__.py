@@ -36,11 +36,7 @@ from .structured import (  # noqa: F401
     beam_search_decode,
 )
 from . import detection
-from .detection import (  # noqa: F401
-    prior_box, density_prior_box, anchor_generator, box_coder,
-    iou_similarity, box_clip, bipartite_match, yolo_box, multiclass_nms,
-    roi_align, roi_pool, target_assign, detection_output,
-)
+from .detection import *  # noqa: F401,F403
 from . import metric_op
 from .metric_op import auc, edit_distance, warpctc  # noqa: F401
 from . import learning_rate_scheduler

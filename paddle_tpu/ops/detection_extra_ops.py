@@ -1,0 +1,808 @@
+"""Detection long tail (reference paddle/fluid/operators/detection/):
+generate_proposals, rpn/retinanet target assign, proposal/mask labels,
+ssd_loss, yolov3_loss, FPN collect/distribute, box_decoder_and_assign,
+deformable conv/roi pooling, psroi_pool, roi_perspective_transform,
+polygon_box_transform, cvm.
+
+Static-shape stance: ops that emit variable-length results in the reference
+(LoD) return fixed-capacity tensors padded with sentinel rows plus explicit
+counts — the XLA encoding of ragged outputs used across this framework.
+Sampling steps that the reference randomizes (fg/bg subsample) are
+deterministic top-k by matching quality here; docstrings note each
+deviation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.fluid.registry import register_op, simple_op
+from .detection_ops import _bilinear_sample, _iou_matrix, _nms_keep
+
+_NEG = -1e9
+
+
+def _decode_deltas(anchors, deltas, variances=None):
+    """anchors [M,4] corner; deltas [M,4] (dx,dy,dw,dh) → corner boxes."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    if variances is not None:
+        deltas = deltas * variances
+    cx = deltas[:, 0] * aw + acx
+    cy = deltas[:, 1] * ah + acy
+    w = jnp.exp(jnp.clip(deltas[:, 2], -10.0, 4.0)) * aw
+    h = jnp.exp(jnp.clip(deltas[:, 3], -10.0, 4.0)) * ah
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                      cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], axis=1)
+
+
+def _encode_deltas(anchors, gt):
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    return jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                      jnp.log(jnp.maximum(gw / aw, 1e-6)),
+                      jnp.log(jnp.maximum(gh / ah, 1e-6))], axis=1)
+
+
+@simple_op("generate_proposals",
+           ["Scores", "BboxDeltas", "ImInfo", "Anchors", "Variances"],
+           ["RpnRois", "RpnRoiProbs"], grad=None)
+def _generate_proposals(ctx, scores, deltas, im_info, anchors, variances,
+                        attrs):
+    """RPN proposal generation (generate_proposals_op.cc): decode anchors,
+    clip to image, drop tiny boxes, NMS.  Outputs are PER-IMAGE fixed
+    [N, post_nms_top_n, 4] / [N, post_nms_top_n, 1], zero-padded (reference
+    emits LoD)."""
+    pre_n = int(attrs.get("pre_nms_topN", 1000))
+    post_n = int(attrs.get("post_nms_topN", 100))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.0))
+    n = scores.shape[0]
+    a = anchors.reshape(-1, 4).astype(jnp.float32)
+    var = variances.reshape(-1, 4).astype(jnp.float32) \
+        if variances is not None else None
+
+    def per_image(s, d, info):
+        s = jnp.reshape(jnp.transpose(s, (1, 2, 0)), (-1,))     # [A*H*W]
+        d = jnp.reshape(jnp.transpose(d, (1, 2, 0)), (-1, 4))
+        boxes = _decode_deltas(a, d, var)
+        ih, iw = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw - 1),
+                           jnp.clip(boxes[:, 1], 0, ih - 1),
+                           jnp.clip(boxes[:, 2], 0, iw - 1),
+                           jnp.clip(boxes[:, 3], 0, ih - 1)], axis=1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        ok = (ws >= min_size) & (hs >= min_size)
+        s = jnp.where(ok, s, _NEG)
+        k = min(pre_n, s.shape[0])
+        top_s, top_i = lax.top_k(s, k)
+        cand = boxes[top_i]
+        order, kept, kept_s = _nms_keep(cand, top_s, nms_thresh, k,
+                                        normalized=False)
+        final_s = jnp.where(kept, kept_s, _NEG)
+        kk = min(post_n, final_s.shape[0])
+        sel_s, sel_i = lax.top_k(final_s, kk)
+        valid = sel_s > _NEG / 2
+        rois = jnp.where(valid[:, None], cand[order][sel_i], 0.0)
+        probs = jnp.where(valid, sel_s, 0.0)[:, None]
+        if kk < post_n:
+            rois = jnp.pad(rois, ((0, post_n - kk), (0, 0)))
+            probs = jnp.pad(probs, ((0, post_n - kk), (0, 0)))
+        return rois, probs
+
+    return jax.vmap(per_image)(scores.astype(jnp.float32),
+                               deltas.astype(jnp.float32),
+                               im_info.astype(jnp.float32))
+
+
+@simple_op("rpn_target_assign",
+           ["Anchor", "GtBoxes", "IsCrowd", "ImInfo"],
+           ["LocationIndex", "ScoreIndex", "TargetLabel", "TargetBBox",
+            "BBoxInsideWeight"],
+           optional=("IsCrowd", "ImInfo"), grad=None)
+def _rpn_target_assign(ctx, anchors, gt, is_crowd, im_info, attrs):
+    """Anchor→gt matching for RPN training (rpn_target_assign_op.cc).
+    anchors [A,4]; gt [N,G,4] zero-padded.  Per-anchor labels: 1 fg, 0 bg,
+    -1 ignore; subsampling is deterministic best-iou top-k (the reference
+    samples randomly).  Outputs are [N,A,...] dense."""
+    pos_thresh = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thresh = float(attrs.get("rpn_negative_overlap", 0.3))
+    batch_per_im = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    a = anchors.astype(jnp.float32)
+    n_fg = int(batch_per_im * fg_frac)
+    n_bg = batch_per_im - n_fg
+
+    def per_image(g):
+        valid_gt = (g[:, 2] > g[:, 0]) & (g[:, 3] > g[:, 1])
+        iou = _iou_matrix(a, g.astype(jnp.float32), False)  # [A,G]
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        best = jnp.max(iou, axis=1)
+        # anchors that are argmax for some gt are fg regardless of threshold
+        gt_best = jnp.max(iou, axis=0, keepdims=True)
+        is_gt_best = jnp.any((iou >= gt_best - 1e-6) & (gt_best > 0), axis=1)
+        fg = (best >= pos_thresh) | is_gt_best
+        bg = best < neg_thresh
+        # deterministic subsample: keep highest-iou fg, lowest-iou bg
+        fg_rank = jnp.where(fg, best, _NEG)
+        _, fg_idx = lax.top_k(fg_rank, min(n_fg, fg_rank.shape[0]))
+        fg_keep = jnp.zeros(fg.shape, bool).at[fg_idx].set(True) & fg
+        bg_rank = jnp.where(bg & ~fg_keep, -best, _NEG)
+        _, bg_idx = lax.top_k(bg_rank, min(n_bg, bg_rank.shape[0]))
+        bg_keep = jnp.zeros(bg.shape, bool).at[bg_idx].set(True) & bg
+        labels = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
+        match = jnp.argmax(iou, axis=1)
+        tgt = _encode_deltas(a, g.astype(jnp.float32)[match])
+        inside_w = jnp.where(fg_keep[:, None], 1.0, 0.0) * jnp.ones((1, 4))
+        return (labels.astype(jnp.int32), tgt * inside_w, inside_w)
+
+    labels, tgt, inw = jax.vmap(per_image)(gt)
+    loc_index = jnp.argsort(-labels, axis=1, stable=True)  # fg first
+    score_index = jnp.argsort(jnp.where(labels >= 0, 0, 1), axis=1,
+                              stable=True)
+    return (loc_index.astype(jnp.int32), score_index.astype(jnp.int32),
+            labels, tgt, inw)
+
+
+@simple_op("retinanet_target_assign",
+           ["Anchor", "GtBoxes", "GtLabels", "IsCrowd", "ImInfo"],
+           ["LocationIndex", "ScoreIndex", "TargetLabel", "TargetBBox",
+            "BBoxInsideWeight", "ForegroundNumber"],
+           optional=("IsCrowd", "ImInfo"), grad=None)
+def _retinanet_target_assign(ctx, anchors, gt, gt_labels, is_crowd, im_info,
+                             attrs):
+    """RetinaNet anchor assignment (retinanet_target_assign_op.cc): every
+    anchor gets a class label (0 = background, -1 = ignore band); no
+    subsampling (focal loss handles imbalance)."""
+    pos_thresh = float(attrs.get("positive_overlap", 0.5))
+    neg_thresh = float(attrs.get("negative_overlap", 0.4))
+    a = anchors.astype(jnp.float32)
+
+    def per_image(g, gl):
+        valid_gt = (g[:, 2] > g[:, 0]) & (g[:, 3] > g[:, 1])
+        iou = jnp.where(valid_gt[None, :],
+                        _iou_matrix(a, g.astype(jnp.float32), False), 0.0)
+        best = jnp.max(iou, axis=1)
+        match = jnp.argmax(iou, axis=1)
+        fg = best >= pos_thresh
+        bg = best < neg_thresh
+        lbl = jnp.where(fg, jnp.reshape(gl, (-1,))[match].astype(jnp.int32),
+                        jnp.where(bg, 0, -1))
+        tgt = _encode_deltas(a, g.astype(jnp.float32)[match])
+        inw = jnp.where(fg[:, None], 1.0, 0.0) * jnp.ones((1, 4))
+        return (lbl, tgt * inw, inw,
+                jnp.sum(fg.astype(jnp.int32))[None])
+
+    labels, tgt, inw, fgnum = jax.vmap(per_image)(gt, gt_labels)
+    loc_index = jnp.argsort(-(labels > 0).astype(jnp.int32), axis=1,
+                            stable=True)
+    score_index = jnp.argsort((labels < 0).astype(jnp.int32), axis=1,
+                              stable=True)
+    return (loc_index.astype(jnp.int32), score_index.astype(jnp.int32),
+            labels, tgt, inw, fgnum)
+
+
+@simple_op("generate_proposal_labels",
+           ["RpnRois", "GtClasses", "IsCrowd", "GtBoxes", "ImInfo"],
+           ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+            "BboxOutsideWeights"],
+           optional=("IsCrowd", "ImInfo"), grad=None)
+def _generate_proposal_labels(ctx, rois, gt_classes, is_crowd, gt_boxes,
+                              im_info, attrs):
+    """Sample RoIs for the RCNN head (generate_proposal_labels_op.cc).
+    rois [N,R,4]; gt_boxes [N,G,4]; gt_classes [N,G].  Deterministic
+    best-iou sampling to batch_size_per_im rois/image; per-class bbox
+    targets collapse to class-agnostic 4-dim (the modern default)."""
+    batch_per_im = int(attrs.get("batch_size_per_im", 64))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    n_fg = int(batch_per_im * fg_frac)
+    n_bg = batch_per_im - n_fg
+
+    def per_image(r, gc, g):
+        valid_gt = (g[:, 2] > g[:, 0]) & (g[:, 3] > g[:, 1])
+        # gt boxes join the roi pool (reference appends them)
+        iou = jnp.where(valid_gt[None, :],
+                        _iou_matrix(r.astype(jnp.float32),
+                                    g.astype(jnp.float32), False), 0.0)
+        best = jnp.max(iou, axis=1)
+        match = jnp.argmax(iou, axis=1)
+        fg = best >= fg_thresh
+        bg = (best < bg_hi) & (best >= bg_lo)
+        fg_rank = jnp.where(fg, best, _NEG)
+        _, fg_idx = lax.top_k(fg_rank, min(n_fg, fg_rank.shape[0]))
+        fg_keep = jnp.zeros(fg.shape, bool).at[fg_idx].set(True) & fg
+        # an roi in the fg∩bg band must not be sampled twice
+        bg_rank = jnp.where(bg & ~fg_keep, -best, _NEG)
+        _, bg_idx = lax.top_k(bg_rank, min(n_bg, bg_rank.shape[0]))
+        sel = jnp.concatenate([fg_idx, bg_idx])           # [batch_per_im]
+        sel_fg = jnp.concatenate([jnp.ones_like(fg_idx, bool) &
+                                  (fg_rank[fg_idx] > _NEG / 2),
+                                  jnp.zeros_like(bg_idx, bool)])
+        out_rois = r[sel]
+        lbl = jnp.where(sel_fg,
+                        jnp.reshape(gc, (-1,))[match[sel]].astype(jnp.int32),
+                        0)
+        tgt = _encode_deltas(out_rois.astype(jnp.float32),
+                             g.astype(jnp.float32)[match[sel]])
+        inw = jnp.where(sel_fg[:, None], 1.0, 0.0) * jnp.ones((1, 4))
+        return out_rois, lbl, tgt * inw, inw, inw
+
+    return jax.vmap(per_image)(rois, gt_classes, gt_boxes)
+
+
+@simple_op("generate_mask_labels",
+           ["ImInfo", "GtClasses", "IsCrowd", "GtSegms", "Rois",
+            "LabelsInt32"],
+           ["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
+           optional=("ImInfo", "IsCrowd"), grad=None)
+def _generate_mask_labels(ctx, im_info, gt_classes, is_crowd, gt_segms,
+                          rois, labels, attrs):
+    """Crop+resize gt masks to fg rois (generate_mask_labels_op.cc).
+    gt_segms here are dense bitmaps [N, G, H, W] (the reference takes
+    polygons; rasterize on the host first).  Each fg roi trains against the
+    mask of its highest-IoU gt instance.  Output masks
+    [N, R, resolution*resolution] int32.  Requires GtBoxes derivable from
+    the masks — the gt box is taken as the mask's bounding extent."""
+    res = int(attrs.get("resolution", 14))
+
+    def per_image(g_masks, r, lbl):
+        # per-gt bounding boxes from the bitmaps (for roi→gt matching)
+        gm = g_masks.astype(jnp.float32)                    # [G, H, W]
+        hh, ww = gm.shape[1], gm.shape[2]
+        ys = jnp.arange(hh, dtype=jnp.float32)[None, :, None]
+        xs = jnp.arange(ww, dtype=jnp.float32)[None, None, :]
+        present = gm > 0.5
+        big = 1e9
+        gx1 = jnp.min(jnp.where(present, xs, big), axis=(1, 2))
+        gy1 = jnp.min(jnp.where(present, ys, big), axis=(1, 2))
+        gx2 = jnp.max(jnp.where(present, xs, -big), axis=(1, 2))
+        gy2 = jnp.max(jnp.where(present, ys, -big), axis=(1, 2))
+        gboxes = jnp.stack([gx1, gy1, gx2, gy2], axis=1)     # [G, 4]
+        valid_g = jnp.any(present, axis=(1, 2))
+
+        def per_roi(roi, l):
+            iou = _iou_matrix(roi[None, :], gboxes, False)[0]  # [G]
+            iou = jnp.where(valid_g, iou, -1.0)
+            gi = jnp.argmax(iou)
+            mask = g_masks[gi].astype(jnp.float32)          # [H, W]
+            ys = jnp.linspace(0.0, 1.0, res) * (roi[3] - roi[1]) + roi[1]
+            xs = jnp.linspace(0.0, 1.0, res) * (roi[2] - roi[0]) + roi[0]
+            yy = jnp.clip(jnp.round(ys), 0, mask.shape[0] - 1).astype(jnp.int32)
+            xx = jnp.clip(jnp.round(xs), 0, mask.shape[1] - 1).astype(jnp.int32)
+            m = mask[yy][:, xx]
+            m = jnp.where(l > 0, m, 0.0)
+            return (m > 0.5).astype(jnp.int32).reshape(-1)
+
+        masks = jax.vmap(per_roi)(r.astype(jnp.float32),
+                                  jnp.reshape(lbl, (-1,)))
+        has = (jnp.reshape(lbl, (-1,)) > 0).astype(jnp.int32)
+        return r, has, masks
+
+    return jax.vmap(per_image)(gt_segms, rois, labels)
+
+
+@simple_op("ssd_loss_op", ["Location", "Confidence", "GtBox", "GtLabel",
+                           "PriorBox", "PriorBoxVar"],
+           ["Loss"], optional=("PriorBoxVar",),
+           no_grad_inputs=("GtBox", "GtLabel", "PriorBox", "PriorBoxVar"))
+def _ssd_loss(ctx, loc, conf, gt_box, gt_label, prior, prior_var, attrs):
+    """SSD multibox loss (python composes it in the reference detection.py
+    ssd_loss; fused here): per-prior matching, smooth-L1 loc loss on
+    positives, softmax conf loss with hard-negative mining at neg_pos_ratio.
+    loc [N,P,4], conf [N,P,C], gt [N,G,4], gt_label [N,G,1]."""
+    overlap_t = float(attrs.get("overlap_threshold", 0.5))
+    neg_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    bg_label = int(attrs.get("background_label", 0))
+    normalize = bool(attrs.get("normalize", True))
+    p = prior.astype(jnp.float32)
+
+    def per_image(l, c, g, gl):
+        valid_gt = (g[:, 2] > g[:, 0]) & (g[:, 3] > g[:, 1])
+        iou = jnp.where(valid_gt[None, :], _iou_matrix(p, g, True), 0.0)
+        best = jnp.max(iou, axis=1)
+        match = jnp.argmax(iou, axis=1)
+        pos = best >= overlap_t
+        npos = jnp.maximum(jnp.sum(pos), 1)
+        tgt = _encode_deltas(p, g[match])
+        sl1 = jnp.where(jnp.abs(l - tgt) < 1.0,
+                        0.5 * jnp.square(l - tgt), jnp.abs(l - tgt) - 0.5)
+        loc_loss = jnp.sum(jnp.where(pos[:, None], sl1, 0.0))
+        labels = jnp.where(pos, jnp.reshape(gl, (-1,))[match], bg_label)
+        logp = jax.nn.log_softmax(c, axis=-1)
+        ce = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        # hard negative mining: keep the neg_ratio*npos highest-loss negs
+        neg_rank = jnp.where(pos, _NEG, ce)
+        k = neg_rank.shape[0]
+        sorted_neg = jnp.sort(neg_rank)[::-1]
+        n_neg = jnp.minimum((neg_ratio * npos).astype(jnp.int32), k - 1)
+        thresh = sorted_neg[n_neg]
+        neg_keep = (~pos) & (ce > thresh)
+        conf_loss = jnp.sum(jnp.where(pos | neg_keep, ce, 0.0))
+        total = loc_w * loc_loss + conf_w * conf_loss
+        return total / npos.astype(jnp.float32) if normalize else total
+
+    losses = jax.vmap(per_image)(loc.astype(jnp.float32),
+                                 conf.astype(jnp.float32),
+                                 gt_box.astype(jnp.float32),
+                                 gt_label.astype(jnp.int32))
+    return losses[:, None]
+
+
+@simple_op("yolov3_loss", ["X", "GTBox", "GTLabel", "GTScore"],
+           ["Loss", "ObjectnessMask", "GTMatchMask"],
+           optional=("GTScore",), no_grad_inputs=("GTBox", "GTLabel",
+                                                  "GTScore"))
+def _yolov3_loss(ctx, x, gt_box, gt_label, gt_score, attrs):
+    """YOLOv3 training loss (yolov3_loss_op.h): coordinate (sigmoid/exp
+    parametrization), objectness with ignore_thresh, and class losses.
+    x [N, A*(5+C), H, W]; gt_box [N, B, 4] (cx,cy,w,h normalized),
+    gt_label [N, B]."""
+    anchors = [int(v) for v in attrs["anchors"]]
+    mask_idx = [int(v) for v in attrs.get("anchor_mask",
+                                          list(range(len(anchors) // 2)))]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    use_label_smooth = bool(attrs.get("use_label_smooth", True))
+    na = len(mask_idx)
+    n, _, h, w = x.shape
+    in_w = downsample * w
+    in_h = downsample * h
+    x5 = jnp.reshape(x, (n, na, 5 + class_num, h, w)).astype(jnp.float32)
+    aw = jnp.asarray([anchors[2 * i] for i in mask_idx], jnp.float32)
+    ah = jnp.asarray([anchors[2 * i + 1] for i in mask_idx], jnp.float32)
+    all_aw = jnp.asarray(anchors[0::2], jnp.float32)
+    all_ah = jnp.asarray(anchors[1::2], jnp.float32)
+
+    def per_image(xi, gb, gl, gs):
+        gs_row = jnp.reshape(gs, (-1,)).astype(jnp.float32)
+        # predicted boxes (normalized) for the objectness-ignore test
+        gx = (jax.nn.sigmoid(xi[:, 0]) +
+              jnp.arange(w, dtype=jnp.float32)[None, None, :]) / w
+        gy = (jax.nn.sigmoid(xi[:, 1]) +
+              jnp.arange(h, dtype=jnp.float32)[None, :, None]) / h
+        pw = jnp.exp(jnp.clip(xi[:, 2], -10, 4)) * aw[:, None, None] / in_w
+        ph = jnp.exp(jnp.clip(xi[:, 3], -10, 4)) * ah[:, None, None] / in_h
+        pred = jnp.stack([gx - pw / 2, gy - ph / 2, gx + pw / 2,
+                          gy + ph / 2], axis=-1)           # [A,H,W,4]
+        valid_gt = gb[:, 2] > 1e-6
+        gbc = jnp.stack([gb[:, 0] - gb[:, 2] / 2, gb[:, 1] - gb[:, 3] / 2,
+                         gb[:, 0] + gb[:, 2] / 2, gb[:, 1] + gb[:, 3] / 2],
+                        axis=1)
+        iou = _iou_matrix(pred.reshape(-1, 4), gbc, True)  # [AHW, B]
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        best_pred_iou = jnp.max(iou, axis=1).reshape(na, h, w)
+        ignore = best_pred_iou > ignore_thresh
+
+        # responsibility: per gt, best anchor (by wh iou over ALL anchors)
+        inter = (jnp.minimum(gb[:, 2:3] * in_w, all_aw[None, :]) *
+                 jnp.minimum(gb[:, 3:4] * in_h, all_ah[None, :]))
+        union = (gb[:, 2:3] * in_w * gb[:, 3:4] * in_h +
+                 all_aw[None, :] * all_ah[None, :] - inter)
+        wh_iou = inter / jnp.maximum(union, 1e-6)          # [B, A_all]
+        best_a = jnp.argmax(wh_iou, axis=1)                # [B]
+        gi = jnp.clip((gb[:, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gb[:, 1] * h).astype(jnp.int32), 0, h - 1)
+
+        obj = jnp.zeros((na, h, w))
+        tx = jnp.zeros((na, h, w))
+        ty = jnp.zeros((na, h, w))
+        tw = jnp.zeros((na, h, w))
+        th = jnp.zeros((na, h, w))
+        tcls = jnp.zeros((na, h, w, class_num))
+        box_scale = jnp.zeros((na, h, w))
+        for mi, global_a in enumerate(mask_idx):
+            resp = valid_gt & (best_a == global_a)
+            # gt_score weights each gt's contribution (mixup training)
+            sel = resp.astype(jnp.float32) * gs_row
+            obj = obj.at[mi, gj, gi].max(sel)
+            tx = tx.at[mi, gj, gi].add(sel * (gb[:, 0] * w - gi))
+            ty = ty.at[mi, gj, gi].add(sel * (gb[:, 1] * h - gj))
+            tw = tw.at[mi, gj, gi].add(
+                sel * jnp.log(jnp.maximum(gb[:, 2] * in_w /
+                                          anchors[2 * global_a], 1e-6)))
+            th = th.at[mi, gj, gi].add(
+                sel * jnp.log(jnp.maximum(gb[:, 3] * in_h /
+                                          anchors[2 * global_a + 1], 1e-6)))
+            scale = 2.0 - gb[:, 2] * gb[:, 3]
+            box_scale = box_scale.at[mi, gj, gi].add(sel * scale)
+            onehot = jax.nn.one_hot(gl, class_num) * sel[:, None]
+            tcls = tcls.at[mi, gj, gi].add(onehot)
+        if use_label_smooth:
+            delta = 1.0 / class_num
+            tcls = jnp.where(obj[..., None] > 0,
+                             tcls * (1 - delta) + delta * 0.5 / class_num,
+                             tcls)
+
+        def bce(logit, target):
+            return (jnp.maximum(logit, 0) - logit * target +
+                    jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+        on = obj > 0
+        loss_xy = jnp.sum(jnp.where(on, box_scale * (
+            bce(xi[:, 0], tx) + bce(xi[:, 1], ty)), 0.0))
+        loss_wh = jnp.sum(jnp.where(on, box_scale * (
+            jnp.abs(xi[:, 2] - tw) + jnp.abs(xi[:, 3] - th)), 0.0))
+        loss_obj = (jnp.sum(jnp.where(on, bce(xi[:, 4], obj), 0.0)) +
+                    jnp.sum(jnp.where((~on) & (~ignore),
+                                      bce(xi[:, 4], obj), 0.0)))
+        loss_cls = jnp.sum(jnp.where(on[..., None],
+                                     bce(xi[:, 5:].transpose(0, 2, 3, 1),
+                                         tcls), 0.0))
+        return (loss_xy + loss_wh + loss_obj + loss_cls,
+                (~ignore).astype(jnp.int32), on.astype(jnp.int32))
+
+    gs = gt_score if gt_score is not None else jnp.ones(gt_label.shape,
+                                                        jnp.float32)
+    loss, objm, gtm = jax.vmap(per_image)(
+        x5, gt_box.astype(jnp.float32), gt_label.astype(jnp.int32), gs)
+    return loss, objm, gtm
+
+
+@simple_op("collect_fpn_proposals", ["MultiLevelRois*", "MultiLevelScores*"],
+           ["FpnRois"], grad=None)
+def _collect_fpn_proposals(ctx, rois_list, scores_list, attrs):
+    """Concat per-level proposals, keep global top post_nms_topN
+    (collect_fpn_proposals_op.cc).  Inputs [N,Ri,4]/[N,Ri,1] → [N,K,4]."""
+    post_n = int(attrs.get("post_nms_topN", 100))
+    rois = jnp.concatenate(rois_list, axis=1)
+    scores = jnp.concatenate([jnp.reshape(s, (s.shape[0], -1))
+                              for s in scores_list], axis=1)
+    k = min(post_n, scores.shape[1])
+    top_s, top_i = lax.top_k(scores, k)
+    out = jnp.take_along_axis(rois, top_i[:, :, None], axis=1)
+    if k < post_n:
+        out = jnp.pad(out, ((0, 0), (0, post_n - k), (0, 0)))
+    return out
+
+
+@simple_op("distribute_fpn_proposals", ["FpnRois"],
+           ["MultiFpnRois*", "RestoreIndex"], grad=None)
+def _distribute_fpn_proposals(ctx, rois, attrs):
+    """Route each roi to its FPN level by scale
+    (distribute_fpn_proposals_op.cc).  Static shape: every level output is
+    [N, R, 4] with non-member rows zeroed; RestoreIndex [N, R] gives each
+    roi's level."""
+    min_level = int(attrs.get("min_level", 2))
+    max_level = int(attrs.get("max_level", 5))
+    refer_level = int(attrs.get("refer_level", 4))
+    refer_scale = int(attrs.get("refer_scale", 224))
+    nlevels = max_level - min_level + 1
+    w = rois[..., 2] - rois[..., 0] + 1.0
+    h = rois[..., 3] - rois[..., 1] + 1.0
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs = []
+    for i in range(nlevels):
+        mask = (lvl == (min_level + i))
+        outs.append(jnp.where(mask[..., None], rois, 0.0))
+    return outs, lvl - min_level
+
+
+@simple_op("box_decoder_and_assign",
+           ["PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"],
+           ["DecodeBox", "OutputAssignBox"],
+           optional=("PriorBoxVar",), grad=None)
+def _box_decoder_and_assign(ctx, prior, prior_var, target, score, attrs):
+    """Decode per-class deltas and pick each roi's best-class box
+    (box_decoder_and_assign_op.cc).  prior [M,4]; target [M, 4*C];
+    score [M, C]."""
+    m, c4 = target.shape
+    c = c4 // 4
+    p = prior.astype(jnp.float32)
+    t = jnp.reshape(target.astype(jnp.float32), (m, c, 4))
+    var = prior_var.astype(jnp.float32) if prior_var is not None else None
+    decoded = jax.vmap(lambda ti: _decode_deltas(p, ti, var),
+                       in_axes=1, out_axes=1)(t)     # [M, C, 4]
+    best = jnp.argmax(score, axis=1)
+    assign = jnp.take_along_axis(
+        decoded, best[:, None, None] * jnp.ones((1, 1, 4), jnp.int32),
+        axis=1)[:, 0]
+    return jnp.reshape(decoded, (m, c4)), assign
+
+
+@simple_op("retinanet_detection_output",
+           ["BBoxes*", "Scores*", "Anchors*", "ImInfo"],
+           ["Out"], grad=None)
+def _retinanet_detection_output(ctx, bboxes, scores, anchors, im_info,
+                                attrs):
+    """Multi-level decode + NMS (retinanet_detection_output_op.cc).
+    Per level: bboxes [N,Mi,4] deltas, scores [N,Mi,C], anchors [Mi,4].
+    Output [N, keep_top_k, 6] rows (label, score, box), label -1 padding."""
+    score_thresh = float(attrs.get("score_threshold", 0.05))
+    nms_top_k = int(attrs.get("nms_top_k", 100))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    deltas = jnp.concatenate(bboxes, axis=1).astype(jnp.float32)
+    scr = jnp.concatenate(scores, axis=1).astype(jnp.float32)
+    anch = jnp.concatenate([a.reshape(-1, 4) for a in anchors],
+                           axis=0).astype(jnp.float32)
+    n, m, c = scr.shape
+
+    def per_image(d, s, info):
+        boxes = _decode_deltas(anch, d)
+        ih, iw = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw - 1),
+                           jnp.clip(boxes[:, 1], 0, ih - 1),
+                           jnp.clip(boxes[:, 2], 0, iw - 1),
+                           jnp.clip(boxes[:, 3], 0, ih - 1)], axis=1)
+
+        def per_class(cls_scores, cls_idx):
+            sc = jnp.where(cls_scores > score_thresh, cls_scores, _NEG)
+            order, kept, top_s = _nms_keep(boxes, sc, nms_thresh, nms_top_k,
+                                           False)
+            final_s = jnp.where(kept & (top_s > _NEG / 2), top_s, _NEG)
+            return (final_s,
+                    jnp.full(final_s.shape, cls_idx + 1, jnp.float32),
+                    boxes[order])
+
+        per_s, per_l, per_b = jax.vmap(per_class)(s.T, jnp.arange(c))
+        cat_s = per_s.reshape(-1)
+        cat_l = per_l.reshape(-1)
+        cat_b = per_b.reshape(-1, 4)
+        k = min(keep_top_k, cat_s.shape[0])
+        sel_s, sel_i = lax.top_k(cat_s, k)
+        valid = sel_s > _NEG / 2
+        row = jnp.concatenate(
+            [jnp.where(valid, cat_l[sel_i], -1.0)[:, None],
+             jnp.where(valid, sel_s, 0.0)[:, None],
+             jnp.where(valid[:, None], cat_b[sel_i], 0.0)], axis=1)
+        if k < keep_top_k:
+            pad = jnp.zeros((keep_top_k - k, 6)).at[:, 0].set(-1.0)
+            row = jnp.concatenate([row, pad], axis=0)
+        return row
+
+    return jax.vmap(per_image)(deltas, scr, im_info.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# deformable ops / position-sensitive pooling / perspective transform
+# ---------------------------------------------------------------------------
+
+
+@simple_op("deformable_conv", ["Input", "Offset", "Mask", "Filter"],
+           ["Output"], optional=("Mask",))
+def _deformable_conv(ctx, x, offset, mask, w, attrs):
+    """Deformable conv v1/v2 (deformable_conv_op.cc): per-position learned
+    sampling offsets (+ modulation mask in v2), bilinear sampling, then the
+    weighted sum.  x [N,C,H,W]; offset [N, 2*G*kh*kw, Ho, Wo];
+    mask [N, G*kh*kw, Ho, Wo]; w [Co, C, kh, kw]."""
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = attrs.get("dilations", [1, 1])
+    groups = int(attrs.get("groups", 1))
+    n, cin, hh, ww = x.shape
+    co, _, kh, kw = w.shape
+    ho = (hh + 2 * paddings[0] - dilations[0] * (kh - 1) - 1) // strides[0] + 1
+    wo = (ww + 2 * paddings[1] - dilations[1] * (kw - 1) - 1) // strides[1] + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (paddings[0], paddings[0]),
+                     (paddings[1], paddings[1])))
+
+    base_y = (jnp.arange(ho) * strides[0])[:, None, None, None]
+    base_x = (jnp.arange(wo) * strides[1])[None, :, None, None]
+    ky = (jnp.arange(kh) * dilations[0])[None, None, :, None]
+    kx = (jnp.arange(kw) * dilations[1])[None, None, None, :]
+
+    def per_sample(xi, off, mk):
+        off = jnp.reshape(off, (-1, kh, kw, 2, ho, wo))    # [G?,kh,kw,2,H,W]
+        off = off[0] if off.shape[0] == 1 else jnp.mean(off, axis=0)
+        oy = jnp.transpose(off[:, :, 0], (2, 3, 0, 1))     # [Ho,Wo,kh,kw]
+        ox = jnp.transpose(off[:, :, 1], (2, 3, 0, 1))
+        ys = base_y + ky + oy
+        xs = base_x + kx + ox
+        samp = _bilinear_sample(xi, ys, xs)                # [C,Ho,Wo,kh,kw]
+        if mk is not None:
+            m = jnp.reshape(mk, (-1, kh, kw, ho, wo))
+            m = m[0] if m.shape[0] == 1 else jnp.mean(m, axis=0)
+            samp = samp * jnp.transpose(m, (2, 3, 0, 1))[None]
+        wf = w.astype(jnp.float32)
+        if groups == 1:
+            return jnp.einsum("chwyx,ocyx->ohw", samp, wf)
+        # grouped: weight is [Co, Cin/g, kh, kw]; each output group reads
+        # only its input-channel group
+        cg = cin // groups
+        samp_g = jnp.reshape(samp, (groups, cg) + samp.shape[1:])
+        w_g = jnp.reshape(wf, (groups, co // groups, cg, kh, kw))
+        out_g = jnp.einsum("gchwyx,gocyx->gohw", samp_g, w_g)
+        return jnp.reshape(out_g, (co,) + out_g.shape[2:])
+
+    return jax.vmap(per_sample)(
+        xp.astype(jnp.float32), offset.astype(jnp.float32),
+        mask.astype(jnp.float32) if mask is not None else
+        jnp.ones((n, kh * kw, ho, wo), jnp.float32)).astype(x.dtype)
+
+
+@simple_op("psroi_pool", ["X", "ROIs", "RoisBatchIdx"], ["Out"],
+           optional=("RoisBatchIdx",),
+           no_grad_inputs=("ROIs", "RoisBatchIdx"))
+def _psroi_pool(ctx, x, rois, batch_idx, attrs):
+    """Position-sensitive RoI average pooling (psroi_pool_op.cc):
+    input channels C = out_c * ph * pw; bin (i,j) pools its OWN channel
+    group.  rois [R, 4]."""
+    out_c = int(attrs["output_channels"])
+    ph = int(attrs.get("pooled_height", 7))
+    pw = int(attrs.get("pooled_width", 7))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    r = rois.shape[0]
+    bi = (batch_idx.astype(jnp.int32).reshape(-1)
+          if batch_idx is not None else jnp.zeros((r,), jnp.int32))
+
+    def per_roi(roi, b):
+        feat = x[b].astype(jnp.float32)                     # [C,H,W]
+        x1, y1, x2, y2 = roi * scale
+        rh = jnp.maximum(y2 - y1, 0.1) / ph
+        rw = jnp.maximum(x2 - x1, 0.1) / pw
+        samples = 2
+        out = jnp.zeros((out_c, ph, pw))
+        iy = (jnp.arange(samples) + 0.5) / samples
+        for i in range(ph):
+            for j in range(pw):
+                ys = y1 + (i + iy) * rh                      # [s]
+                xs = x1 + (j + iy) * rw
+                yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+                group = (i * pw + j)
+                chans = lax.dynamic_slice_in_dim(feat, group * out_c, out_c,
+                                                 axis=0)
+                v = _bilinear_sample(chans, yy, xx)          # [out_c,s,s]
+                out = out.at[:, i, j].set(jnp.mean(v, axis=(1, 2)))
+        return out
+
+    return jax.vmap(per_roi)(rois.astype(jnp.float32), bi).astype(x.dtype)
+
+
+@simple_op("deformable_psroi_pooling", ["Input", "ROIs", "Trans",
+                                        "RoisBatchIdx"],
+           ["Output", "TopCount"],
+           optional=("Trans", "RoisBatchIdx"),
+           no_grad_inputs=("ROIs", "RoisBatchIdx"))
+def _deformable_psroi_pooling(ctx, x, rois, trans, batch_idx, attrs):
+    """Deformable PS-RoI pooling (deformable_psroi_pooling_op.cc): each bin
+    shifts by a learned normalized offset before sampling."""
+    out_c = int(attrs.get("output_dim", attrs.get("output_channels", 1)))
+    ph = int(attrs.get("pooled_height", 7))
+    pw = int(attrs.get("pooled_width", 7))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    trans_std = float(attrs.get("trans_std", 0.1))
+    no_trans = bool(attrs.get("no_trans", trans is None))
+    r = rois.shape[0]
+    bi = (batch_idx.astype(jnp.int32).reshape(-1)
+          if batch_idx is not None else jnp.zeros((r,), jnp.int32))
+    part = trans.shape[2] if trans is not None else ph
+
+    def per_roi(roi, b, tr):
+        feat = x[b].astype(jnp.float32)
+        x1, y1, x2, y2 = roi * scale
+        rh = jnp.maximum(y2 - y1, 0.1) / ph
+        rw = jnp.maximum(x2 - x1, 0.1) / pw
+        out = jnp.zeros((out_c, ph, pw))
+        cnt = jnp.zeros((out_c, ph, pw))
+        iy = (jnp.arange(2) + 0.5) / 2
+        for i in range(ph):
+            for j in range(pw):
+                if no_trans:
+                    dy = dx = 0.0
+                else:
+                    pi = min(int(i * part / ph), part - 1)
+                    pj = min(int(j * part / pw), part - 1)
+                    dy = tr[0, pi, pj] * trans_std * (y2 - y1)
+                    dx = tr[1, pi, pj] * trans_std * (x2 - x1)
+                ys = y1 + (i + iy) * rh + dy
+                xs = x1 + (j + iy) * rw + dx
+                yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+                if out_c * ph * pw == feat.shape[0]:
+                    # position-sensitive: bin (i,j) reads its channel group
+                    group = i * pw + j
+                    chans = lax.dynamic_slice_in_dim(feat, group * out_c,
+                                                     out_c, axis=0)
+                else:  # plain deformable RoI pooling: all channels per bin
+                    chans = feat
+                v = jnp.mean(_bilinear_sample(chans, yy, xx), axis=(1, 2))
+                out = out.at[:, i, j].set(v[:out_c])
+                cnt = cnt.at[:, i, j].set(4.0)
+        return out, cnt
+
+    tr_in = (trans.astype(jnp.float32) if trans is not None
+             else jnp.zeros((r, 2, part, part), jnp.float32))
+    out, cnt = jax.vmap(per_roi)(rois.astype(jnp.float32), bi, tr_in)
+    return out.astype(x.dtype), cnt
+
+
+@simple_op("roi_perspective_transform", ["X", "ROIs", "RoisBatchIdx"],
+           ["Out", "TransformMatrix"],
+           optional=("RoisBatchIdx",),
+           no_grad_inputs=("ROIs", "RoisBatchIdx"))
+def _roi_perspective_transform(ctx, x, rois, batch_idx, attrs):
+    """Warp quadrilateral rois to a fixed rectangle
+    (roi_perspective_transform_op.cc).  rois [R, 8] four corners
+    (x1..y4); RoisBatchIdx [R] maps each roi to its batch image (absent →
+    image 0, single-image batches); output [R, C, H, W]."""
+    oh = int(attrs.get("transformed_height", 8))
+    ow = int(attrs.get("transformed_width", 8))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    bi = (batch_idx.astype(jnp.int32).reshape(-1) if batch_idx is not None
+          else jnp.zeros((rois.shape[0],), jnp.int32))
+
+    def homography(quad):
+        # map (0,0),(ow-1,0),(ow-1,oh-1),(0,oh-1) → quad corners
+        src = jnp.asarray([[0, 0], [ow - 1, 0], [ow - 1, oh - 1],
+                           [0, oh - 1]], jnp.float32)
+        dst = jnp.reshape(quad, (4, 2)) * scale
+        rows = []
+        for k in range(4):
+            sx, sy = src[k]
+            dx, dy = dst[k, 0], dst[k, 1]
+            rows.append(jnp.asarray([sx, sy, 1, 0, 0, 0]) .astype(jnp.float32))
+            rows.append(jnp.asarray([0, 0, 0, sx, sy, 1]).astype(jnp.float32))
+        a = jnp.stack(rows)                                  # [8, 6]
+        extra = []
+        for k in range(4):
+            sx, sy = src[k]
+            dx, dy = dst[k, 0], dst[k, 1]
+            extra.append(jnp.asarray([-sx * dx, -sy * dx], jnp.float32))
+            extra.append(jnp.asarray([-sx * dy, -sy * dy], jnp.float32))
+        a = jnp.concatenate([a, jnp.stack(extra)], axis=1)   # [8, 8]
+        b = jnp.reshape(dst, (-1,))
+        hvec = jnp.linalg.solve(a + 1e-6 * jnp.eye(8), b)
+        return jnp.concatenate([hvec, jnp.ones((1,))]).reshape(3, 3)
+
+    def per_roi(quad, b):
+        hmat = homography(quad)
+        ys, xs = jnp.meshgrid(jnp.arange(oh, dtype=jnp.float32),
+                              jnp.arange(ow, dtype=jnp.float32),
+                              indexing="ij")
+        ones = jnp.ones_like(xs)
+        pts = jnp.stack([xs, ys, ones], axis=0).reshape(3, -1)
+        warped = hmat @ pts
+        wx = warped[0] / jnp.maximum(warped[2], 1e-6)
+        wy = warped[1] / jnp.maximum(warped[2], 1e-6)
+        out = _bilinear_sample(x[b].astype(jnp.float32),
+                               wy.reshape(oh, ow), wx.reshape(oh, ow))
+        return out, hmat
+
+    outs, mats = jax.vmap(per_roi)(rois.astype(jnp.float32), bi)
+    return outs.astype(x.dtype), mats
+
+
+@simple_op("polygon_box_transform", ["Input"], ["Output"], grad=None)
+def _polygon_box_transform(ctx, x, attrs):
+    """EAST geometry head transform (polygon_box_transform_op.cc):
+    activated offsets become absolute corner coords: even channels get
+    4*col - v, odd channels 4*row - v; inactive (v<=0) positions pass 0."""
+    n, c, h, w = x.shape
+    col = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    row = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    base = jnp.where(even, 4 * col, 4 * row)
+    return jnp.where(x > 0, base - x, 0.0)
+
+
+@simple_op("cvm", ["X", "CVM"], ["Y"], no_grad_inputs=("CVM",))
+def _cvm(ctx, x, cvm, attrs):
+    """Continuous-value model op for CTR features (cvm_op.cc): the first two
+    columns are show/click counters; use_cvm keeps them log-transformed,
+    otherwise they are stripped."""
+    use_cvm = bool(attrs.get("use_cvm", True))
+    if use_cvm:
+        show = jnp.log(x[:, 0:1] + 1.0)
+        click = jnp.log(x[:, 1:2] + 1.0) - show
+        return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    return x[:, 2:]

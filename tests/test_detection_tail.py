@@ -1,0 +1,332 @@
+"""Detection long tail: proposals, target assign, losses, FPN routing,
+deformable ops (reference operators/detection/ remainder).  Static-shape
+semantics: padded fixed-capacity outputs."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    names = [o.name for o in (outs if isinstance(outs, (list, tuple)) else [outs])]
+    res = exe.run(main, feed=feeds, fetch_list=names)
+    return res if isinstance(outs, (list, tuple)) else res[0]
+
+
+def test_generate_proposals_basic():
+    """One dominant anchor must survive NMS with its decoded box."""
+    def build():
+        scores = fluid.data("gp_s", [1, 1, 2, 2], False, dtype="float32")
+        deltas = fluid.data("gp_d", [1, 4, 2, 2], False, dtype="float32")
+        im_info = fluid.data("gp_i", [1, 3], False, dtype="float32")
+        anchors = fluid.data("gp_a", [2, 2, 1, 4], False, dtype="float32")
+        var = fluid.data("gp_v", [2, 2, 1, 4], False, dtype="float32")
+        rois, probs = fluid.layers.generate_proposals(
+            scores, deltas, im_info, anchors, var, pre_nms_top_n=4,
+            post_nms_top_n=2, nms_thresh=0.5)
+        return [rois, probs]
+
+    anchors = np.zeros((2, 2, 1, 4), "float32")
+    # 4 disjoint anchors
+    anchors[0, 0, 0] = [0, 0, 7, 7]
+    anchors[0, 1, 0] = [8, 0, 15, 7]
+    anchors[1, 0, 0] = [0, 8, 7, 15]
+    anchors[1, 1, 0] = [8, 8, 15, 15]
+    scores = np.zeros((1, 1, 2, 2), "float32")
+    scores[0, 0, 0, 0] = 5.0
+    scores[0, 0, 1, 1] = 3.0
+    rois, probs = _run(build, {
+        "gp_s": scores, "gp_d": np.zeros((1, 4, 2, 2), "float32"),
+        "gp_i": np.array([[16, 16, 1]], "float32"),
+        "gp_a": anchors, "gp_v": np.ones((2, 2, 1, 4), "float32")})
+    # zero deltas → rois are the anchors of the two highest scores
+    np.testing.assert_allclose(rois[0, 0], [0, 0, 7, 7], atol=1e-4)
+    np.testing.assert_allclose(rois[0, 1], [8, 8, 15, 15], atol=1e-4)
+    assert probs[0, 0, 0] > probs[0, 1, 0]
+
+
+def test_rpn_target_assign_labels():
+    def build():
+        a = fluid.data("rt_a", [3, 4], False, dtype="float32")
+        g = fluid.data("rt_g", [1, 2, 4], False, dtype="float32")
+        bp = fluid.data("rt_bp", [1, 3, 4], False, dtype="float32")
+        cl = fluid.data("rt_cl", [1, 3, 1], False, dtype="float32")
+        _, _, lbl, tbox, inw = fluid.layers.rpn_target_assign(
+            bp, cl, a, None, g, rpn_positive_overlap=0.7,
+            rpn_negative_overlap=0.3)
+        return [lbl, tbox, inw]
+
+    anchors = np.array([[0, 0, 9, 9], [100, 100, 109, 109],
+                        [0, 0, 4, 4]], "float32")
+    gt = np.array([[[0, 0, 9, 9], [0, 0, 0, 0]]], "float32")
+    lbl, tbox, inw = _run(build, {
+        "rt_a": anchors, "rt_g": gt,
+        "rt_bp": np.zeros((1, 3, 4), "float32"),
+        "rt_cl": np.zeros((1, 3, 1), "float32")})
+    assert lbl[0, 0] == 1          # perfect-iou anchor is fg
+    assert lbl[0, 1] == 0          # far anchor is bg
+    assert inw[0, 0].sum() == 4 and inw[0, 1].sum() == 0
+    # fg anchor's target deltas are ~0 (anchor == gt)
+    np.testing.assert_allclose(tbox[0, 0], 0.0, atol=1e-5)
+
+
+def test_ssd_loss_decreases_with_better_conf():
+    prior = np.array([[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]], "float32")
+    gt = np.array([[[0.1, 0.1, 0.4, 0.4]]], "float32")
+    gl = np.array([[[1]]], "int32")
+
+    def build(good):
+        def b():
+            loc = fluid.data("sl_l", [1, 2, 4], False, dtype="float32")
+            conf = fluid.data("sl_c", [1, 2, 3], False, dtype="float32")
+            gb = fluid.data("sl_g", [1, 1, 4], False, dtype="float32")
+            gv = fluid.data("sl_y", [1, 1, 1], False, dtype="int32")
+            pb = fluid.data("sl_p", [2, 4], False, dtype="float32")
+            return fluid.layers.ssd_loss(loc, conf, gb, gv, pb)
+        return b
+
+    conf_bad = np.zeros((1, 2, 3), "float32")
+    conf_good = np.zeros((1, 2, 3), "float32")
+    conf_good[0, 0, 1] = 6.0   # matched prior confident in class 1
+    conf_good[0, 1, 0] = 6.0   # unmatched prior confident in background
+    feeds = {"sl_l": np.zeros((1, 2, 4), "float32"), "sl_g": gt,
+             "sl_y": gl, "sl_p": prior}
+    bad = float(_run(build(False), {**feeds, "sl_c": conf_bad}))
+    good = float(_run(build(True), {**feeds, "sl_c": conf_good}))
+    assert good < bad
+
+
+def test_yolov3_loss_finite_and_responsive():
+    rng = np.random.RandomState(0)
+
+    def build():
+        x = fluid.data("y3_x", [1, 18, 4, 4], False, dtype="float32")
+        gb = fluid.data("y3_b", [1, 2, 4], False, dtype="float32")
+        gl = fluid.data("y3_l", [1, 2], False, dtype="int32")
+        return fluid.layers.yolov3_loss(
+            x, gb, gl, anchors=[10, 13, 16, 30, 33, 23],
+            anchor_mask=[0, 1, 2], class_num=1, ignore_thresh=0.7,
+            downsample_ratio=32)
+
+    feeds = {"y3_x": rng.randn(1, 18, 4, 4).astype("float32") * 0.1,
+             "y3_b": np.array([[[0.5, 0.5, 0.2, 0.3],
+                                [0, 0, 0, 0]]], "float32"),
+             "y3_l": np.zeros((1, 2), "int32")}
+    loss = _run(build, feeds)
+    assert np.isfinite(loss).all() and float(loss[0]) > 0
+
+
+def test_distribute_fpn_by_scale():
+    rois = np.array([[[0, 0, 20, 20],       # ~21px → lowest level
+                      [0, 0, 900, 900]]], "float32")  # ~900px → top level
+
+    def build():
+        r = fluid.data("df_r", [1, 2, 4], False, dtype="float32")
+        outs, restore = fluid.layers.distribute_fpn_proposals(r, 2, 5, 4, 224)
+        return outs + [restore]
+
+    *levels, restore = _run(build, {"df_r": rois})
+    assert restore[0, 0] == 0 and restore[0, 1] == 3
+    np.testing.assert_allclose(levels[0][0, 0], rois[0, 0])
+    np.testing.assert_allclose(levels[0][0, 1], 0.0)  # routed elsewhere
+    np.testing.assert_allclose(levels[3][0, 1], rois[0, 1])
+
+
+def test_collect_fpn_topk():
+    def build():
+        r1 = fluid.data("cf_r1", [1, 2, 4], False, dtype="float32")
+        r2 = fluid.data("cf_r2", [1, 2, 4], False, dtype="float32")
+        s1 = fluid.data("cf_s1", [1, 2, 1], False, dtype="float32")
+        s2 = fluid.data("cf_s2", [1, 2, 1], False, dtype="float32")
+        return fluid.layers.collect_fpn_proposals([r1, r2], [s1, s2], 2, 3, 2)
+
+    out = _run(build, {
+        "cf_r1": np.array([[[1, 1, 2, 2], [3, 3, 4, 4]]], "float32"),
+        "cf_r2": np.array([[[5, 5, 6, 6], [7, 7, 8, 8]]], "float32"),
+        "cf_s1": np.array([[[0.1], [0.9]]], "float32"),
+        "cf_s2": np.array([[[0.8], [0.2]]], "float32")})
+    np.testing.assert_allclose(out[0, 0], [3, 3, 4, 4])  # score 0.9
+    np.testing.assert_allclose(out[0, 1], [5, 5, 6, 6])  # score 0.8
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+
+    def build(deform):
+        def b():
+            v = fluid.data("dc_x", [1, 2, 6, 6], False, dtype="float32")
+            if deform:
+                off = fluid.data("dc_o", [1, 18, 6, 6], False,
+                                 dtype="float32")
+                return fluid.layers.deformable_conv(
+                    v, off, None, 3, 3, padding=1, modulated=False,
+                    param_attr=fluid.ParamAttr(
+                        initializer=fluid.initializer.Constant(0.1)),
+                    bias_attr=False)
+            return fluid.layers.conv2d(
+                v, 3, 3, padding=1,
+                param_attr=fluid.ParamAttr(
+                    initializer=fluid.initializer.Constant(0.1)),
+                bias_attr=False)
+        return b
+
+    ref = _run(build(False), {"dc_x": x})
+    out = _run(build(True), {"dc_x": x,
+                             "dc_o": np.zeros((1, 18, 6, 6), "float32")})
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_psroi_pool_channel_groups():
+    # C = out_c * ph * pw = 1*2*2; each bin reads its own channel
+    x = np.zeros((1, 4, 4, 4), "float32")
+    for c in range(4):
+        x[0, c] = c + 1
+
+    def build():
+        v = fluid.data("pp_x", [1, 4, 4, 4], False, dtype="float32")
+        r = fluid.data("pp_r", [1, 4], False, dtype="float32")
+        return fluid.layers.psroi_pool(v, r, 1, 1.0, 2, 2)
+
+    out = _run(build, {"pp_x": x,
+                       "pp_r": np.array([[0, 0, 3.9, 3.9]], "float32")})
+    np.testing.assert_allclose(out[0, 0], [[1, 2], [3, 4]], atol=1e-4)
+
+
+def test_polygon_box_transform_formula():
+    x = np.ones((1, 2, 2, 2), "float32")
+
+    def build():
+        v = fluid.data("pt_x", [1, 2, 2, 2], False, dtype="float32")
+        return fluid.layers.polygon_box_transform(v)
+
+    out = _run(build, {"pt_x": x})
+    # even channel: 4*col - 1 ; odd channel: 4*row - 1
+    np.testing.assert_allclose(out[0, 0], [[-1, 3], [-1, 3]])
+    np.testing.assert_allclose(out[0, 1], [[-1, -1], [3, 3]])
+
+
+def test_cvm_log_transform():
+    x = np.array([[np.e - 1, np.e ** 2 - 1, 5.0]], "float32")
+
+    def build():
+        v = fluid.data("cv_x", [1, 3], False, dtype="float32")
+        c = fluid.data("cv_c", [1, 2], False, dtype="float32")
+        keep = fluid.layers.continuous_value_model(v, c, True)
+        strip = fluid.layers.continuous_value_model(v, c, False)
+        return [keep, strip]
+
+    keep, strip = _run(build, {"cv_x": x, "cv_c": np.ones((1, 2), "float32")})
+    np.testing.assert_allclose(keep[0, 0], 1.0, rtol=1e-5)   # log(e)
+    np.testing.assert_allclose(keep[0, 1], 1.0, rtol=1e-4)   # log(e²)-log(e)
+    np.testing.assert_allclose(strip, [[5.0]])
+
+
+def test_roi_perspective_transform_identity_quad():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+
+    def build():
+        v = fluid.data("rp_x", [1, 1, 4, 4], False, dtype="float32")
+        q = fluid.data("rp_q", [1, 8], False, dtype="float32")
+        return fluid.layers.roi_perspective_transform(v, q, 4, 4)
+
+    # quad covering the whole image in order TL,TR,BR,BL → identity warp
+    out = _run(build, {"rp_x": x,
+                       "rp_q": np.array([[0, 0, 3, 0, 3, 3, 0, 3]],
+                                        "float32")})
+    np.testing.assert_allclose(out[0, 0], x[0, 0], atol=1e-3)
+
+
+def test_retinanet_detection_output_shape_and_padding():
+    def build():
+        b = fluid.data("rd_b", [1, 4, 4], False, dtype="float32")
+        s = fluid.data("rd_s", [1, 4, 2], False, dtype="float32")
+        a = fluid.data("rd_a", [4, 4], False, dtype="float32")
+        ii = fluid.data("rd_i", [1, 3], False, dtype="float32")
+        return fluid.layers.retinanet_detection_output(
+            [b], [s], [a], ii, keep_top_k=3, score_threshold=0.3)
+
+    anchors = np.array([[0, 0, 9, 9], [10, 10, 19, 19],
+                        [20, 20, 29, 29], [30, 30, 39, 39]], "float32")
+    scores = np.zeros((1, 4, 2), "float32")
+    scores[0, 0, 0] = 0.9
+    out = _run(build, {
+        "rd_b": np.zeros((1, 4, 4), "float32"), "rd_s": scores,
+        "rd_a": anchors, "rd_i": np.array([[64, 64, 1]], "float32")})
+    assert out.shape == (1, 3, 6)
+    assert out[0, 0, 0] == 1.0 and abs(out[0, 0, 1] - 0.9) < 1e-5
+    assert (out[0, 1:, 0] == -1).all()  # padding rows
+
+
+def test_deformable_conv_grouped():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 4, 6, 6).astype("float32")
+
+    def build():
+        v = fluid.data("dg_x", [1, 4, 6, 6], False, dtype="float32")
+        off = fluid.data("dg_o", [1, 18, 6, 6], False, dtype="float32")
+        return fluid.layers.deformable_conv(
+            v, off, None, 4, 3, padding=1, groups=2, modulated=False,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.Constant(0.1)),
+            bias_attr=False)
+
+    out = _run(build, {"dg_x": x, "dg_o": np.zeros((1, 18, 6, 6), "float32")})
+    assert out.shape == (1, 4, 6, 6)
+    # group 0 outputs depend only on input channels 0-1
+    x2 = x.copy()
+    x2[0, 2:] += 100.0  # perturb group-1 inputs
+    out2 = _run(build, {"dg_x": x2,
+                        "dg_o": np.zeros((1, 18, 6, 6), "float32")})
+    np.testing.assert_allclose(out2[0, :2], out[0, :2], rtol=1e-5)
+    assert np.abs(out2[0, 2:] - out[0, 2:]).max() > 1.0
+
+
+def test_generate_proposal_labels_no_double_sampling():
+    def build():
+        r = fluid.data("nd_r", [1, 4, 4], False, dtype="float32")
+        gc = fluid.data("nd_c", [1, 1], False, dtype="int32")
+        g = fluid.data("nd_g", [1, 1, 4], False, dtype="float32")
+        rois, lbl, bt, biw, bow = fluid.layers.generate_proposal_labels(
+            r, gc, None, g, None, batch_size_per_im=4, fg_fraction=0.5,
+            fg_thresh=0.25, bg_thresh_hi=0.5)
+        return [rois, lbl]
+
+    # one roi in the fg∩bg band (iou≈0.33): must appear once, as fg
+    rois = np.array([[[0, 0, 9, 9], [0, 0, 9, 29],
+                      [50, 50, 59, 59], [70, 70, 79, 79]]], "float32")
+    gt = np.array([[[0, 0, 9, 9]]], "float32")
+    out_rois, lbl = _run(build, {
+        "nd_r": rois, "nd_c": np.array([[2]], "int32"), "nd_g": gt})
+    band_roi = rois[0, 1]
+    hits = [(k, int(lbl[0, k])) for k in range(4)
+            if np.allclose(out_rois[0, k], band_roi)]
+    fg_hits = [h for h in hits if h[1] > 0]
+    bg_hits = [h for h in hits if h[1] == 0]
+    assert not (fg_hits and bg_hits), "roi sampled as both fg and bg"
+
+
+def test_yolov3_gt_score_weights_loss():
+    def build():
+        x = fluid.data("yw_x", [1, 18, 4, 4], False, dtype="float32")
+        gb = fluid.data("yw_b", [1, 1, 4], False, dtype="float32")
+        gl = fluid.data("yw_l", [1, 1], False, dtype="int32")
+        gs = fluid.data("yw_s", [1, 1], False, dtype="float32")
+        return fluid.layers.yolov3_loss(
+            x, gb, gl, anchors=[10, 13, 16, 30, 33, 23],
+            anchor_mask=[0, 1, 2], class_num=1, ignore_thresh=0.7,
+            downsample_ratio=32, gt_score=gs)
+
+    feeds = {"yw_x": np.zeros((1, 18, 4, 4), "float32"),
+             "yw_b": np.array([[[0.5, 0.5, 0.2, 0.3]]], "float32"),
+             "yw_l": np.zeros((1, 1), "int32")}
+    full = float(_run(build, {**feeds,
+                              "yw_s": np.ones((1, 1), "float32")})[0])
+    half = float(_run(build, {**feeds,
+                              "yw_s": np.full((1, 1), 0.5, "float32")})[0])
+    assert half != full  # gt_score must influence the loss

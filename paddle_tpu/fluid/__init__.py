@@ -64,6 +64,15 @@ from .framework import (  # noqa: F401
     CUDAPinnedPlace, cpu_places, cuda_places, cuda_pinned_places, name_scope,
 )
 
+
+def is_compiled_with_cuda():
+    """False: this build targets TPU via XLA (see is_compiled_with_tpu)."""
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
 __all__ = [
     "framework", "layers", "optimizer", "initializer", "regularizer", "clip",
     "Program", "Variable", "Operator", "program_guard", "Executor", "Scope",

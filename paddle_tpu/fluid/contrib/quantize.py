@@ -1,0 +1,39 @@
+"""contrib.quantize.QuantizeTranspiler (reference
+contrib/quantize/quantize_transpiler.py): program-rewriting quantization —
+a thin veneer over the slim QAT passes (slim/quantization.py)."""
+
+from __future__ import annotations
+
+from .slim.quantization import QuantizationFreezePass, QuantizationTransformPass
+
+__all__ = ["QuantizeTranspiler"]
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000):
+        self._transform = QuantizationTransformPass(
+            weight_bits=weight_bits, activation_bits=activation_bits,
+            activation_quantize_type=activation_quantize_type,
+            weight_quantize_type=weight_quantize_type)
+        self._weight_bits = weight_bits
+
+    def training_transpile(self, program=None, startup_program=None):
+        from .. import framework
+
+        program = program or framework.default_main_program()
+        startup = startup_program or framework.default_startup_program()
+        return self._transform.apply(program, startup)
+
+    def freeze_program(self, program, place=None, scope=None):
+        from ..executor import global_scope
+
+        freeze = QuantizationFreezePass(scope or global_scope(),
+                                        weight_bits=self._weight_bits)
+        return freeze.apply(program)
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """int8 weight storage is an inference-engine detail; the frozen
+        program already folds the quant scales (slim freeze pass)."""
+        return program

@@ -1,0 +1,88 @@
+"""contrib.utils (reference python/paddle/fluid/contrib/utils/):
+HDFSClient + multi_download/multi_upload over the fs/shell runtime
+(fluid.io_utils, reference framework/io/fs.cc shells out the same way)."""
+
+from __future__ import annotations
+
+import os
+
+from .. import io_utils
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]
+
+
+class HDFSClient:
+    """Shell-out HDFS client (reference contrib/utils/hdfs_utils.py).
+    hadoop_home/configs mirror the reference ctor; operations delegate to
+    the fs runtime which runs `hadoop fs` commands."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        self.hadoop_home = hadoop_home
+        self.configs = configs or {}
+        if hadoop_home:
+            os.environ.setdefault("HADOOP_HOME", hadoop_home)
+
+    def is_exist(self, hdfs_path):
+        return io_utils.exists(hdfs_path)
+
+    def is_dir(self, hdfs_path):
+        return io_utils.exists(hdfs_path)
+
+    def is_file(self, hdfs_path):
+        return io_utils.exists(hdfs_path)
+
+    def delete(self, hdfs_path):
+        return io_utils.remove(hdfs_path)
+
+    def rename(self, src, dst, overwrite=False):
+        return io_utils.move(src, dst)
+
+    def makedirs(self, hdfs_path):
+        return io_utils.makedirs(hdfs_path)
+
+    def ls(self, hdfs_path):
+        return io_utils.ls(hdfs_path)
+
+    def lsr(self, hdfs_path, excludes=()):
+        return io_utils.ls(hdfs_path)
+
+    def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
+        return io_utils.copy(local_path, hdfs_path)
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False):
+        return io_utils.copy(hdfs_path, local_path)
+
+    @staticmethod
+    def make_local_dirs(local_path):
+        os.makedirs(local_path, exist_ok=True)
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=5):
+    """Download this trainer's shard of the files under hdfs_path
+    (reference hdfs_utils.multi_download): file i goes to trainer
+    i % trainers."""
+    files = sorted(client.ls(hdfs_path))
+    mine = [f for i, f in enumerate(files) if i % trainers == trainer_id]
+    os.makedirs(local_path, exist_ok=True)
+    out = []
+    for f in mine:
+        dst = os.path.join(local_path, os.path.basename(f))
+        client.download(f, dst)
+        out.append(dst)
+    return out
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False, sync=True):
+    """Upload every file under local_path (reference multi_upload)."""
+    uploaded = []
+    for root, _, names in os.walk(local_path):
+        for n in names:
+            src = os.path.join(root, n)
+            rel = os.path.relpath(src, local_path)
+            client.upload(os.path.join(hdfs_path, rel), src,
+                          overwrite=overwrite)
+            uploaded.append(rel)
+    return uploaded

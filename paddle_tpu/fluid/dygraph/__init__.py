@@ -9,11 +9,15 @@ from .base import (enable_dygraph, disable_dygraph, enabled, guard,  # noqa: F40
                    no_grad, to_variable)
 from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
 from .layers import Layer  # noqa: F401
-from .nn import (FC, BatchNorm, Conv2D, Conv2DTranspose, Dropout,  # noqa: F401
-                 Embedding, GroupNorm, GRUUnit, LayerNorm, Linear, Pool2D,
-                 PRelu)
+from .nn import (FC, BatchNorm, BilinearTensorProduct, Conv2D,  # noqa: F401
+                 Conv2DTranspose, Conv3D, Conv3DTranspose, Dropout,
+                 Embedding, GroupNorm, GRUUnit, LayerNorm, Linear, NCE,
+                 Pool2D, PRelu, SpectralNorm, TreeConv)
 from .parallel import DataParallel, Env, ParallelEnv, prepare_context  # noqa: F401
 from .tracer import Tracer, VarBase, trace_op  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    CosineDecay, ExponentialDecay, InverseTimeDecay, NaturalExpDecay,
+    NoamDecay, PiecewiseDecay, PolynomialDecay)
 
 
 class BackwardStrategy:
@@ -39,6 +43,10 @@ __all__ = [
     "disable_dygraph", "Layer", "VarBase", "Tracer", "trace_op",
     "save_dygraph", "load_dygraph", "save_persistables", "load_persistables",
     "BackwardStrategy", "DataParallel", "prepare_context",
-    "nn", "Linear", "FC", "Conv2D", "Conv2DTranspose", "Pool2D", "BatchNorm",
-    "Embedding", "LayerNorm", "Dropout", "GRUUnit", "PRelu", "GroupNorm",
+    "nn", "Linear", "FC", "Conv2D", "Conv2DTranspose", "Conv3D",
+    "Conv3DTranspose", "Pool2D", "BatchNorm", "Embedding", "LayerNorm",
+    "Dropout", "GRUUnit", "PRelu", "GroupNorm", "BilinearTensorProduct",
+    "SpectralNorm", "TreeConv", "NCE",
+    "CosineDecay", "ExponentialDecay", "InverseTimeDecay", "NaturalExpDecay",
+    "NoamDecay", "PiecewiseDecay", "PolynomialDecay",
 ]

@@ -1,0 +1,157 @@
+"""Dygraph learning-rate schedulers (reference
+python/paddle/fluid/dygraph/learning_rate_scheduler.py).
+
+Each scheduler is a callable: the eager optimizer calls it once per step
+(`Optimizer._dygraph_lr` treats a callable learning_rate this way) and the
+internal step counter advances.  `step()` returns the current value without
+advancing, matching the reference's LearningRateDecay.step() accessor.
+"""
+
+from __future__ import annotations
+
+import math
+
+__all__ = [
+    "LearningRateDecay", "ExponentialDecay", "NaturalExpDecay",
+    "InverseTimeDecay", "PolynomialDecay", "CosineDecay", "NoamDecay",
+    "PiecewiseDecay",
+]
+
+
+class LearningRateDecay:
+    def __init__(self, begin=0, step=1, dtype="float32"):
+        self.step_num = begin
+        self.step_size = step
+        self.dtype = dtype
+
+    def __call__(self):
+        value = self.step()
+        self.step_num += self.step_size
+        return value
+
+    def step(self):
+        raise NotImplementedError
+
+    # reference name for the current value
+    def get_lr(self):
+        return self.step()
+
+    def create_lr_var(self, lr):
+        """Reference wraps the python value in a 1-element variable; eager
+        mode consumes the float directly."""
+        import numpy as np
+
+        return np.asarray([lr], dtype=self.dtype)
+
+
+class ExponentialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        p = self.step_num / self.decay_steps
+        if self.staircase:
+            p = math.floor(p)
+        return self.learning_rate * (self.decay_rate ** p)
+
+
+class NaturalExpDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        p = self.step_num / self.decay_steps
+        if self.staircase:
+            p = math.floor(p)
+        return self.learning_rate * math.exp(-self.decay_rate * p)
+
+
+class InverseTimeDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        p = self.step_num / self.decay_steps
+        if self.staircase:
+            p = math.floor(p)
+        return self.learning_rate / (1 + self.decay_rate * p)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=0.0001,
+                 power=1.0, cycle=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.end_learning_rate = end_learning_rate
+        self.power = power
+        self.cycle = cycle
+
+    def step(self):
+        step_num = self.step_num
+        decay_steps = self.decay_steps
+        if self.cycle:
+            div = math.ceil(step_num / decay_steps) if step_num > 0 else 1.0
+            decay_steps = decay_steps * max(div, 1.0)
+        else:
+            step_num = min(step_num, decay_steps)
+        frac = (1 - step_num / decay_steps) ** self.power
+        return ((self.learning_rate - self.end_learning_rate) * frac
+                + self.end_learning_rate)
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+
+    def step(self):
+        cur_epoch = math.floor(self.step_num / self.step_each_epoch)
+        return (self.learning_rate * 0.5 *
+                (math.cos(cur_epoch * math.pi / self.epochs) + 1))
+
+
+class NoamDecay(LearningRateDecay):
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 dtype="float32", learning_rate=1.0):
+        super().__init__(begin, step, dtype)
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        self.learning_rate = learning_rate
+
+    def step(self):
+        n = max(self.step_num, 1)
+        a = n ** -0.5
+        b = (self.warmup_steps ** -1.5) * n
+        return self.learning_rate * (self.d_model ** -0.5) * min(a, b)
+
+
+class PiecewiseDecay(LearningRateDecay):
+    def __init__(self, boundaries, values, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+
+    def step(self):
+        for i, b in enumerate(self.boundaries):
+            if self.step_num < b:
+                return self.values[i]
+        return self.values[-1]

@@ -317,3 +317,176 @@ class GRUUnit(Layer):
             "X": trace_op("elementwise_mul", {"X": one_minus_u, "Y": hidden}),
             "Y": trace_op("elementwise_mul", {"X": u, "Y": cand})})
         return new_h, new_h, cand
+
+
+class Conv3D(Layer):
+    """3D convolution (reference dygraph/nn.py Conv3D → conv3d op)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size,) * 3
+        trip = lambda v: list(v if isinstance(v, (list, tuple)) else (v,) * 3)
+        self._attrs = {"strides": trip(stride), "paddings": trip(padding),
+                       "dilations": trip(dilation), "groups": groups}
+        self._act = act
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups, fs[0], fs[1], fs[2]],
+            attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([num_filters], attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, input):
+        out = trace_op("conv3d", {"Input": input, "Filter": self.weight},
+                       attrs=dict(self._attrs))
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": out, "Y": self.bias},
+                           attrs={"axis": 1})
+        return _act(out, self._act)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32",
+                 output_size=None):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size,) * 3
+        trip = lambda v: list(v if isinstance(v, (list, tuple)) else (v,) * 3)
+        self._attrs = {"strides": trip(stride), "paddings": trip(padding),
+                       "dilations": trip(dilation), "groups": groups}
+        if output_size is not None:
+            self._attrs["output_size"] = trip(output_size)
+        self._act = act
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups, fs[0], fs[1], fs[2]],
+            attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([num_filters], attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, input):
+        out = trace_op("conv3d_transpose",
+                       {"Input": input, "Filter": self.weight},
+                       attrs=dict(self._attrs))
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": out, "Y": self.bias},
+                           attrs={"axis": 1})
+        return _act(out, self._act)
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._act = act
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([1, output_dim], attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x, y):
+        ins = {"X": x, "Y": y, "Weight": self.weight}
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        return _act(trace_op("bilinear_tensor_product", ins), self._act)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+        import numpy as _np
+
+        h = weight_shape[dim]
+        w = int(_np.prod([s for i, s in enumerate(weight_shape)
+                          if i != dim]))
+        from ..initializer import Normal
+
+        self.weight_u = self.create_parameter([h],
+                                              default_initializer=Normal(0, 1))
+        self.weight_v = self.create_parameter([w],
+                                              default_initializer=Normal(0, 1))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        out = trace_op("spectral_norm",
+                       {"Weight": weight, "U": self.weight_u,
+                        "V": self.weight_v}, attrs=dict(self._attrs))
+        if isinstance(out, (tuple, list)):
+            res, u_new, v_new = out
+            # persist the refined power-iteration vectors (the op docstring
+            # requires UOut/VOut to alias U/V, like BatchNorm's stat outputs)
+            self.weight_u.set_value(u_new.numpy())
+            self.weight_v.set_value(v_new.numpy())
+        else:
+            res = out
+        return res
+
+
+class TreeConv(Layer):
+    """Output [B, N, output_size, num_filters] like the reference."""
+
+    def __init__(self, feature_size, output_size, num_filters=1, max_depth=2,
+                 act="tanh", param_attr=None, bias_attr=None, name=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._act = act
+        self._num_filters = num_filters
+        self.weights = [self.create_parameter(
+            [feature_size, 3, output_size], attr=param_attr)
+            for _ in range(num_filters)]
+        for i, w in enumerate(self.weights):
+            self.add_parameter(f"w{i}", w)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([output_size], attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, nodes_vector, edge_set):
+        outs = []
+        for w in self.weights:
+            o = trace_op("tree_conv",
+                         {"NodesVector": nodes_vector, "EdgeSet": edge_set,
+                          "Filter": w})
+            if self.bias is not None:
+                o = trace_op("elementwise_add", {"X": o, "Y": self.bias})
+            outs.append(_act(o, self._act))
+        return trace_op("stack", {"X": outs}, attrs={"axis": 3})
+
+
+class NCE(Layer):
+    """Noise-contrastive estimation head (reference dygraph NCE → nce op)."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if custom_dist is not None:
+            raise NotImplementedError(
+                "nce custom_dist sampler is not supported (uniform / "
+                "log_uniform)")
+        self._attrs = {"num_total_classes": num_total_classes,
+                       "num_neg_samples": num_neg_samples, "seed": seed,
+                       "sampler": sampler}
+        self.weight = self.create_parameter([num_total_classes, dim],
+                                            attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([num_total_classes],
+                                           attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label, sample_weight=None):
+        ins = {"Input": input, "Label": label, "Weight": self.weight}
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        if sample_weight is not None:
+            ins["SampleWeight"] = sample_weight
+        out = trace_op("nce", ins, attrs=dict(self._attrs))
+        return out[0] if isinstance(out, (tuple, list)) else out

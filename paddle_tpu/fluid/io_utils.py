@@ -104,3 +104,11 @@ def copy(src, dst):
         _hadoop_ok(["-cp", str(src), str(dst)], timeout=None)
     else:
         shutil.copy(src, dst)
+
+
+def move(src, dst):
+    """Rename/move (reference fs.cc rename; hadoop -mv for HDFS paths)."""
+    if is_hdfs_path(src) or is_hdfs_path(dst):
+        return _hadoop_ok(["-mv", str(src), str(dst)], timeout=None)
+    shutil.move(src, dst)
+    return True

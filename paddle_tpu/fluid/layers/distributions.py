@@ -92,9 +92,14 @@ class Uniform(Distribution):
     def entropy(self):
         return nn_mod.log(nn_mod.elementwise_sub(self.high, self.low))
 
-    def log_prob(self, value):
-        from . import tensor as t
+    def kl_divergence(self, other):
+        """KL between uniforms: finite only when other's support covers
+        self's; log(span_other/span_self) on the covered case."""
+        span_s = nn_mod.elementwise_sub(self.high, self.low)
+        span_o = nn_mod.elementwise_sub(other.high, other.low)
+        return nn_mod.log(nn_mod.elementwise_div(span_o, span_s))
 
+    def log_prob(self, value):
         span = nn_mod.elementwise_sub(self.high, self.low)
         lb = nn_mod.cast(nn_mod.less_equal(self.low, value), "float32")
         ub = nn_mod.cast(nn_mod.less_than(value, self.high), "float32")

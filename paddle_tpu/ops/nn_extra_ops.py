@@ -66,6 +66,19 @@ def _conv3d_transpose(ctx, x, w, bias, attrs):
     # filter layout (in, out/groups, kd, kh, kw) like conv2d_transpose
     pads = [(d * (k - 1) - p, d * (k - 1) - p)
             for p, k, d in zip(paddings, jnp.shape(w)[2:], dilations)]
+    out_size = attrs.get("output_size")
+    if out_size is not None:
+        # stride>1 makes the output extent ambiguous; pad the high side so
+        # the result matches the requested size (reference output_size)
+        for i, target in enumerate(out_size):
+            default = ((x.shape[2 + i] - 1) * strides[i] - 2 * paddings[i]
+                       + dilations[i] * (jnp.shape(w)[2 + i] - 1) + 1)
+            extra = int(target) - int(default)
+            if extra < 0:
+                raise ValueError(
+                    f"conv3d_transpose output_size[{i}]={target} smaller "
+                    f"than the minimum {default}")
+            pads[i] = (pads[i][0], pads[i][1] + extra)
     wt = jnp.flip(w, axis=(-3, -2, -1))
     if groups == 1:
         wt = jnp.swapaxes(wt, 0, 1)  # (out, in, kd, kh, kw)

@@ -268,3 +268,12 @@ def _dgc(ctx, u, v, g, attrs):
         return u2 * (1.0 - mask), v2 * (1.0 - mask), v2 * mask
 
     return jax.lax.cond(step < begin, warmup, compress, u, v, g)
+
+
+@simple_op("decoupled_weight_decay", ["Param", "LearningRate"], ["ParamOut"],
+           grad=None, inplace={"Param": "ParamOut"})
+def _decoupled_weight_decay(ctx, p, lr, attrs):
+    """AdamW-style decay step (contrib.extend_with_decoupled_weight_decay):
+    param *= 1 - lr*coeff, applied after the base optimizer update."""
+    coeff = attrs.get("coeff", 0.0)
+    return p * (1.0 - jnp.reshape(lr, ()).astype(p.dtype) * coeff)

@@ -1,0 +1,288 @@
+"""Dygraph class zoo tail (Conv3D, BilinearTensorProduct, SpectralNorm,
+TreeConv, NCE, decay schedulers) + contrib completion (basic rnn cells,
+decoder, quantize transpiler, utils, extend_with_decoupled_weight_decay)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+from paddle_tpu.fluid import dygraph
+
+
+def test_dygraph_conv3d_and_transpose():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.random.randn(1, 2, 4, 4, 4)
+                                .astype("float32"))
+        out = dygraph.Conv3D(2, 3, 2)(x)
+        assert out.shape == (1, 3, 3, 3, 3)
+        out2 = dygraph.Conv3DTranspose(2, 3, 2, stride=2)(x)
+        assert out2.shape == (1, 3, 8, 8, 8)
+
+
+def test_dygraph_bilinear_spectral_tree_nce():
+    with dygraph.guard():
+        btp = dygraph.BilinearTensorProduct(3, 4, 5)
+        o = btp(dygraph.to_variable(np.ones((2, 3), "float32")),
+                dygraph.to_variable(np.ones((2, 4), "float32")))
+        assert o.shape == (2, 5)
+
+        sn = dygraph.SpectralNorm([4, 6], power_iters=20)
+        w = dygraph.to_variable(
+            np.random.RandomState(0).randn(4, 6).astype("float32"))
+        normed = sn(w)
+        s = np.linalg.svd(normed.numpy(), compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=5e-2)
+
+        tc = dygraph.TreeConv(4, 6)
+        o = tc(dygraph.to_variable(np.random.randn(1, 5, 4)
+                                   .astype("float32")),
+               dygraph.to_variable(
+                   np.random.randint(1, 5, (1, 4, 2)).astype("int32")))
+        assert o.shape[0] == 1 and o.shape[1] == 5
+
+        nce = dygraph.NCE(num_total_classes=10, dim=4, num_neg_samples=3)
+        o = nce(dygraph.to_variable(np.random.randn(2, 4).astype("float32")),
+                dygraph.to_variable(np.array([[1], [2]], dtype="int64")))
+        assert np.isfinite(o.numpy()).all()
+
+
+def test_dygraph_decay_schedulers():
+    s = dygraph.ExponentialDecay(0.1, 10, 0.5)
+    v0 = s()
+    v10 = [s() for _ in range(10)][-1]
+    assert v0 == 0.1 and v10 < v0
+    assert dygraph.PiecewiseDecay([5, 10], [1.0, 0.5, 0.1]).step() == 1.0
+    pd = dygraph.PiecewiseDecay([5, 10], [1.0, 0.5, 0.1], begin=7)
+    assert pd.step() == 0.5
+    nd = dygraph.NoamDecay(512, 4000)
+    early = nd.step()
+    nd.step_num = 4000
+    peak = nd.step()
+    nd.step_num = 100000
+    late = nd.step()
+    assert early < peak and late < peak
+    cd = dygraph.CosineDecay(0.1, 10, 4)
+    assert abs(cd.step() - 0.1) < 1e-9
+    assert dygraph.InverseTimeDecay(1.0, 1, 1.0, begin=1).step() == 0.5
+    pdec = dygraph.PolynomialDecay(1.0, 10, end_learning_rate=0.0, power=1.0,
+                                   begin=5)
+    assert abs(pdec.step() - 0.5) < 1e-9
+    ne = dygraph.NaturalExpDecay(1.0, 1, 1.0, begin=1)
+    assert abs(ne.step() - np.exp(-1)) < 1e-7
+
+
+def test_basic_lstm_gru_static():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("bl_x", [2, 5, 4], False, dtype="float32")
+        out, lh, lc = fluid.contrib.basic_lstm(x, None, None, 8, num_layers=2)
+        gout, glh = fluid.contrib.basic_gru(x, None, 8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    o, g = exe.run(main, feed={"bl_x": np.random.randn(2, 5, 4)
+                               .astype("float32")},
+                   fetch_list=[out.name, gout.name])
+    assert np.asarray(o).shape == (2, 5, 8)
+    assert np.asarray(g).shape == (2, 5, 8)
+
+
+def test_basic_lstm_unit_cell():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("cu_x", [2, 4], False, dtype="float32")
+        h0 = fluid.layers.fill_constant([2, 6], "float32", 0.0)
+        c0 = fluid.layers.fill_constant([2, 6], "float32", 0.0)
+        cell = fluid.contrib.BasicLSTMUnit("cell", 6)
+        h1, c1 = cell(x, h0, c0)
+        gru = fluid.contrib.BasicGRUUnit("gcell", 6)
+        g1 = gru(x, h0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    hv, cv, gv = exe.run(main, feed={"cu_x": np.ones((2, 4), "float32")},
+                         fetch_list=[h1.name, c1.name, g1.name])
+    assert np.asarray(hv).shape == (2, 6)
+    assert np.isfinite(np.asarray(gv)).all()
+
+
+def test_state_cell_training_decoder():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("td_x", [2, 3, 4], False, dtype="float32")
+        h0 = fluid.layers.fill_constant([2, 4], "float32", 0.0)
+        cell = fluid.contrib.StateCell(
+            inputs={"x": None}, states={"h": fluid.contrib.InitState(h0)},
+            out_state="h")
+
+        @cell.state_updater
+        def updater(c):
+            h = c.get_state("h")
+            xt = c.get_input("x")
+            c.set_state("h", fluid.layers.elementwise_add(h, xt))
+
+        decoder = fluid.contrib.TrainingDecoder(cell)
+        with decoder.block():
+            xt = decoder.step_input(x)
+            cell.compute_state({"x": xt})
+            decoder.output(cell.out_state())
+        out = decoder()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.asarray(exe.run(main, feed={"td_x": np.ones((2, 3, 4), "float32")},
+                           fetch_list=[out.name])[0])
+    np.testing.assert_allclose(r[:, :, 0], [[1, 2, 3], [1, 2, 3]])
+
+
+def test_fused_elemwise_activation():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data("fe_a", [2, 2], False, dtype="float32")
+        b = fluid.data("fe_b", [2, 2], False, dtype="float32")
+        out = fluid.contrib.fused_elemwise_activation(
+            a, b, ["elementwise_add", "relu"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = exe.run(main, feed={"fe_a": np.array([[1, -5], [2, 3]], "float32"),
+                            "fe_b": np.ones((2, 2), "float32")},
+                fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(r[0]), [[2, 0], [3, 4]])
+
+
+def test_extend_with_decoupled_weight_decay():
+    AdamWLike = fluid.contrib.extend_with_decoupled_weight_decay(
+        fluid.optimizer.AdamOptimizer)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("wd_x", [4, 3], False, dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, 2))
+        opt = AdamWLike(learning_rate=0.1, coeff=0.5)
+        opt.minimize(loss)
+    pname = main.all_parameters()[0].name
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.asarray(scope.get(pname)).copy()
+        exe.run(main, feed={"wd_x": np.zeros((4, 3), "float32")},
+                fetch_list=[loss.name])
+        w1 = np.asarray(scope.get(pname))
+    # zero input → zero grads for the weight; only the decay step moves it
+    np.testing.assert_allclose(w1, w0 * (1 - 0.1 * 0.5), rtol=1e-4)
+
+
+def test_memory_usage_and_op_freq():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("mu_x", [-1, 8], False, dtype="float32")
+        fluid.layers.fc(fluid.layers.fc(x, 4), 2)
+    lo, hi = fluid.contrib.memory_usage(main, batch_size=16)
+    assert 0 < lo < hi
+    uni, adj = fluid.contrib.op_freq_statistic(main)
+    assert uni["mul"] == 2 and any("->" in k for k in adj)
+
+
+def test_quantize_transpiler():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("qt_x", [4, 8], False, dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, 4))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        qt = fluid.contrib.QuantizeTranspiler()
+        qt.training_transpile(main, startup)
+    assert any("fake" in op.type or "quant" in op.type
+               for op in main.global_block().ops)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={"qt_x": np.random.randn(4, 8).astype("float32")},
+                fetch_list=[loss.name])
+        infer = main.clone(for_test=True)
+        qt.freeze_program(infer, scope=scope)
+
+
+def test_distributed_batch_reader(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    rd = fluid.contrib.distributed_batch_reader(
+        lambda: iter([[1], [2], [3], [4]]))
+    assert list(rd()) == [[2], [4]]
+
+
+def test_contrib_misc_presence():
+    assert fluid.contrib.convert_dist_to_sparse_program(fluid.Program())
+    assert hasattr(fluid.contrib, "HDFSClient")
+    assert hasattr(fluid.contrib, "multi_download")
+    assert hasattr(fluid.contrib, "BeamSearchDecoder")
+    with pytest.raises(NotImplementedError):
+        fluid.contrib.BeamSearchDecoder(None).decode()
+
+
+def test_dygraph_spectral_norm_persists_uv():
+    with dygraph.guard():
+        sn = dygraph.SpectralNorm([4, 6], power_iters=1)
+        u0 = sn.weight_u.numpy().copy()
+        w = dygraph.to_variable(
+            np.random.RandomState(1).randn(4, 6).astype("float32"))
+        sn(w)
+        assert np.abs(sn.weight_u.numpy() - u0).max() > 1e-6
+
+
+def test_dygraph_conv3d_transpose_output_size():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones((1, 2, 4, 4, 4), "float32"))
+        ct = dygraph.Conv3DTranspose(2, 3, 2, stride=2,
+                                     output_size=[9, 9, 9])
+        assert ct(x).shape == (1, 3, 9, 9, 9)
+
+
+def test_dygraph_tree_conv_num_filters_shape():
+    with dygraph.guard():
+        tc = dygraph.TreeConv(4, 6, num_filters=3)
+        o = tc(dygraph.to_variable(np.random.randn(1, 5, 4)
+                                   .astype("float32")),
+               dygraph.to_variable(
+                   np.random.randint(1, 5, (1, 4, 2)).astype("int32")))
+        assert o.shape == (1, 5, 6, 3)
+
+
+def test_dygraph_nce_sampler_forwarded():
+    with dygraph.guard():
+        nce = dygraph.NCE(num_total_classes=50, dim=4, num_neg_samples=5,
+                          sampler="log_uniform")
+        assert nce._attrs["sampler"] == "log_uniform"
+        o = nce(dygraph.to_variable(np.random.randn(2, 4).astype("float32")),
+                dygraph.to_variable(np.array([[1], [2]], dtype="int64")))
+        assert np.isfinite(o.numpy()).all()
+
+
+def test_compressor_batch_hooks():
+    calls = []
+
+    class Strat:
+        def on_epoch_begin(self, e):
+            calls.append(("eb", e))
+
+        def on_batch_begin(self, b):
+            calls.append(("bb", b))
+
+        def on_batch_end(self, b):
+            calls.append(("be", b))
+
+        def on_epoch_end(self, e):
+            calls.append(("ee", e))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("cp_x", [2, 3], False, dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, 2))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    comp = fluid.contrib.Compressor(
+        train_program=main,
+        train_reader=lambda: iter([{"cp_x": np.ones((2, 3), "float32")}] * 2),
+        train_fetch_list=[loss.name], epoch=2)
+    comp.config([Strat()])
+    res = comp.run()
+    assert ("bb", 0) in calls and ("be", 1) in calls
+    assert len(res) == 2  # only the last epoch's batches are kept

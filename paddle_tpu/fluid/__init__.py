@@ -41,6 +41,7 @@ from . import profiler  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import io_utils  # noqa: F401
 from . import flags  # noqa: F401
+from . import ir  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
 from . import contrib  # noqa: F401
 from . import incubate  # noqa: F401

@@ -24,15 +24,22 @@ def extend_with_decoupled_weight_decay(base_optimizer):
             result = super().apply_gradients(params_grads)
             if self._coeff == 0.0:
                 return result
-            from ..framework import default_main_program
+            from ..framework import program_guard
 
-            block = default_main_program().global_block()
-            for p, _ in params_grads:
-                block.append_op(
-                    "decoupled_weight_decay",
-                    inputs={"Param": [p], "LearningRate": [self._lr_var]},
-                    outputs={"ParamOut": [p]},
-                    attrs={"coeff": self._coeff, "op_role": "optimize"})
+            # the decay ops must land in the program that owns the params
+            # (base apply_gradients resolves it the same way), not whatever
+            # program is currently the ambient default
+            program = params_grads[0][0].block.program
+            with program_guard(program):
+                block = program.global_block()
+                for p, _ in params_grads:
+                    block.append_op(
+                        "decoupled_weight_decay",
+                        inputs={"Param": [p],
+                                "LearningRate": [self._lr_var]},
+                        outputs={"ParamOut": [p]},
+                        attrs={"coeff": self._coeff,
+                               "op_role": "optimize"})
             return result
 
         def _dygraph_step(self, p, g, lr):

@@ -200,18 +200,30 @@ def basic_gru(input, init_hidden, hidden_size, num_layers=1,
     x = input
     last_hs = []
     dirs = 2 if bidirectional else 1
+
+    def _state_slice(state, idx):
+        if state is None:
+            return None
+        s = L.slice(state, axes=[0], starts=[idx], ends=[idx + 1])
+        return L.squeeze(s, axes=[0])
+
     for layer_i in range(num_layers):
         outs = []
         for d in range(dirs):
             h = L.dynamic_gru(
                 L.fc(x, 3 * hidden_size, num_flatten_dims=2), hidden_size,
-                is_reverse=(d == 1), length=sequence_length)
+                is_reverse=(d == 1), length=sequence_length,
+                h_0=_state_slice(init_hidden, layer_i * dirs + d))
             outs.append(h)
         x = L.concat(outs, axis=-1) if dirs == 2 else outs[0]
         if dropout_prob > 0.0:
             x = L.dropout(x, dropout_prob)
-        for h in outs:
-            last_hs.append(L.sequence_last_step(h, length=sequence_length))
+        for di, h in enumerate(outs):
+            # the reverse pass re-reverses output to original time order:
+            # its final state is at t=0, not t=len-1
+            pick = (L.sequence_first_step if di == 1
+                    else L.sequence_last_step)
+            last_hs.append(pick(h, length=sequence_length))
     last_h = L.stack(last_hs, axis=0)
     if not batch_first:
         x = L.transpose(x, [1, 0, 2])

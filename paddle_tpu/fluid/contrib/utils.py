@@ -26,10 +26,14 @@ class HDFSClient:
         return io_utils.exists(hdfs_path)
 
     def is_dir(self, hdfs_path):
-        return io_utils.exists(hdfs_path)
+        if io_utils.is_hdfs_path(hdfs_path):
+            return io_utils._hadoop_ok(["-test", "-d", str(hdfs_path)])
+        return os.path.isdir(hdfs_path)
 
     def is_file(self, hdfs_path):
-        return io_utils.exists(hdfs_path)
+        if io_utils.is_hdfs_path(hdfs_path):
+            return io_utils._hadoop_ok(["-test", "-f", str(hdfs_path)])
+        return os.path.isfile(hdfs_path)
 
     def delete(self, hdfs_path):
         return io_utils.remove(hdfs_path)
@@ -82,7 +86,11 @@ def multi_upload(client, hdfs_path, local_path, multi_processes=5,
         for n in names:
             src = os.path.join(root, n)
             rel = os.path.relpath(src, local_path)
-            client.upload(os.path.join(hdfs_path, rel), src,
-                          overwrite=overwrite)
+            dst = os.path.join(hdfs_path, rel)
+            # nested files need their destination directory first
+            parent = os.path.dirname(dst)
+            if parent:
+                client.makedirs(parent)
+            client.upload(dst, src, overwrite=overwrite)
             uploaded.append(rel)
     return uploaded

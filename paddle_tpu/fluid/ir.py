@@ -1,0 +1,285 @@
+"""Program-level IR graph + pass framework (reference
+paddle/fluid/framework/ir/: `ir::Graph`, `Pass::Apply`, PassRegistry, ~60
+registered passes).
+
+TPU-native scope: XLA performs operator fusion, memory planning, and layout
+assignment inside the compiler, so the reference's kernel-fusion and
+memory-reuse passes have no work left to do here.  What remains pass-shaped
+are PROGRAM-level rewrites — AMP cast insertion, quantization, conv+BN
+folding, data-parallel collective insertion, pipeline cutting — which this
+module unifies under the reference's Graph/Pass/PassRegistry interface so
+tooling can enumerate, compose, and apply them the same way
+(`build_strategy.cc:52-145`'s pass pipeline becomes `PassManager`).
+"""
+
+from __future__ import annotations
+
+from . import framework
+
+__all__ = ["Graph", "Node", "Pass", "PassRegistry", "PassManager",
+           "register_pass", "get_pass", "apply_pass"]
+
+
+class Node:
+    """Graph node: an op or a var (reference ir/node.h)."""
+
+    OP, VAR = "op", "var"
+
+    def __init__(self, kind, payload, name):
+        self.kind = kind
+        self.payload = payload          # Operator or Variable
+        self.name = name
+        self.inputs: list[Node] = []    # producing/consuming edges
+        self.outputs: list[Node] = []
+
+    def is_op(self):
+        return self.kind == Node.OP
+
+    def is_var(self):
+        return self.kind == Node.VAR
+
+    def __repr__(self):
+        return f"Node({self.kind}:{self.name})"
+
+
+class Graph:
+    """Dataflow view over one block (reference ir/graph.h builds nodes/edges
+    from a ProgramDesc).  Mutations happen on the underlying Program — the
+    graph is a queryable index, re-derivable at any time."""
+
+    def __init__(self, program, block_idx=0):
+        self.program = program
+        self.block_idx = block_idx
+        self._build()
+
+    def _build(self):
+        block = self.program.block(self.block_idx)
+        self.var_nodes: dict[str, Node] = {}
+        self.op_nodes: list[Node] = []
+
+        def var_node(name):
+            if name not in self.var_nodes:
+                v = block._find_var_recursive(name)
+                self.var_nodes[name] = Node(Node.VAR, v, name)
+            return self.var_nodes[name]
+
+        for op in block.ops:
+            n = Node(Node.OP, op, op.type)
+            self.op_nodes.append(n)
+            for name in op.input_arg_names:
+                vn = var_node(name)
+                n.inputs.append(vn)
+                vn.outputs.append(n)
+            for name in op.output_arg_names:
+                vn = var_node(name)
+                n.outputs.append(vn)
+                vn.inputs.append(n)
+
+    def nodes(self):
+        return self.op_nodes + list(self.var_nodes.values())
+
+    def all_op_nodes(self):
+        return list(self.op_nodes)
+
+    def all_var_nodes(self):
+        return list(self.var_nodes.values())
+
+    def refresh(self):
+        self._build()
+        return self
+
+
+class Pass:
+    """Base pass (reference ir/pass.h): apply(graph) -> graph.  Subclasses
+    either mutate graph.program directly or use the node index."""
+
+    name = "pass"
+
+    def apply(self, graph):
+        raise NotImplementedError
+
+    def __call__(self, graph):
+        out = self.apply(graph)
+        return (out or graph).refresh()
+
+
+class _FnPass(Pass):
+    def __init__(self, name, fn):
+        self.name = name
+        self._fn = fn
+
+    def apply(self, graph):
+        self._fn(graph)
+        return graph
+
+
+class PassRegistry:
+    """Reference ir/pass.h PassRegistry: name → factory."""
+
+    _passes: dict = {}
+
+    @classmethod
+    def register(cls, name, factory):
+        cls._passes[name] = factory
+
+    @classmethod
+    def get(cls, name, **kwargs):
+        if name not in cls._passes:
+            raise KeyError(f"unknown pass {name!r}; known: "
+                           f"{sorted(cls._passes)}")
+        return cls._passes[name](**kwargs)
+
+    @classmethod
+    def has(cls, name):
+        return name in cls._passes
+
+    @classmethod
+    def list(cls):
+        return sorted(cls._passes)
+
+
+def register_pass(name):
+    """Decorator: register a Pass subclass or a `fn(graph)` function."""
+
+    def deco(obj):
+        if isinstance(obj, type) and issubclass(obj, Pass):
+            PassRegistry.register(name, lambda **kw: obj(**kw))
+        else:
+            def factory(**kw):
+                if kw:  # function passes take no construction args
+                    raise TypeError(
+                        f"pass {name!r} is a function pass and accepts no "
+                        f"kwargs: {sorted(kw)}")
+                return _FnPass(name, obj)
+
+            PassRegistry.register(name, factory)
+        return obj
+
+    return deco
+
+
+def get_pass(name, **kwargs):
+    return PassRegistry.get(name, **kwargs)
+
+
+def apply_pass(program, name, block_idx=0, **kwargs):
+    g = Graph(program, block_idx)
+    get_pass(name, **kwargs)(g)
+    return program
+
+
+class PassManager:
+    """Ordered pass pipeline (the BuildStrategy::Apply analog,
+    build_strategy.cc:52-145)."""
+
+    def __init__(self, passes=()):
+        self.passes = [get_pass(p) if isinstance(p, str) else p
+                       for p in passes]
+
+    def append(self, p, **kwargs):
+        self.passes.append(get_pass(p, **kwargs) if isinstance(p, str)
+                           else p)
+        return self
+
+    def apply(self, program, block_idx=0):
+        g = Graph(program, block_idx)
+        for p in self.passes:
+            g = p(g)
+        return program
+
+
+# ---------------------------------------------------------------------------
+# Built-in passes: the program-level rewrites this framework already has,
+# exposed under their reference pass names.
+# ---------------------------------------------------------------------------
+
+
+@register_pass("graph_viz_pass")
+class GraphVizPass(Pass):
+    """reference ir/graph_viz_pass.cc → debugger.program_to_dot."""
+
+    name = "graph_viz_pass"
+
+    def __init__(self, path="program.dot"):
+        self.path = path
+
+    def apply(self, graph):
+        from . import debugger
+
+        with open(self.path, "w") as f:
+            f.write(debugger.program_to_dot(graph.program,
+                                            graph.block_idx))
+        return graph
+
+
+@register_pass("conv_bn_fuse_pass")
+class ConvBNFusePass(Pass):
+    """reference ir/conv_bn_fuse_pass.cc → InferenceTranspiler's conv+BN
+    folding (needs a scope with trained values)."""
+
+    name = "conv_bn_fuse_pass"
+
+    def __init__(self, scope=None):
+        self.scope = scope
+
+    def apply(self, graph):
+        from .transpiler.inference_transpiler import InferenceTranspiler
+
+        InferenceTranspiler().transpile(graph.program, scope=self.scope)
+        return graph
+
+
+@register_pass("amp_rewrite_pass")
+class AmpRewritePass(Pass):
+    """bf16 AMP cast insertion (reference contrib/mixed_precision rewrite;
+    the fp16 black/white-list pass family)."""
+
+    name = "amp_rewrite_pass"
+
+    def apply(self, graph):
+        from .contrib.mixed_precision.fp16_utils import rewrite_program
+        from .contrib.mixed_precision.fp16_lists import AutoMixedPrecisionLists
+
+        rewrite_program(graph.program, AutoMixedPrecisionLists())
+        return graph
+
+
+@register_pass("quant_transform_pass")
+class QuantTransformPass(Pass):
+    """reference ir quantization passes → slim QuantizationTransformPass."""
+
+    name = "quant_transform_pass"
+
+    def __init__(self, startup_program=None, **kw):
+        self.startup_program = startup_program
+        self.kw = kw
+
+    def apply(self, graph):
+        from .contrib.slim.quantization import QuantizationTransformPass
+
+        startup = self.startup_program or framework.default_startup_program()
+        QuantizationTransformPass(**self.kw).apply(graph.program, startup)
+        return graph
+
+
+@register_pass("multi_devices_graph_pass")
+class MultiDevicesGraphPass(Pass):
+    """reference ir/multi_devices_graph_pass.cc (DP allreduce insertion) →
+    the data-parallel transpiler (c_allreduce_sum after backward)."""
+
+    name = "multi_devices_graph_pass"
+
+    def __init__(self, loss_name=None, num_devices=None):
+        self.loss_name = loss_name
+        self.num_devices = num_devices
+
+    def apply(self, graph):
+        from paddle_tpu.parallel.data_parallel import transpile_data_parallel
+
+        if self.loss_name is None:
+            raise ValueError("multi_devices_graph_pass needs loss_name=")
+        import jax
+
+        transpile_data_parallel(graph.program, self.loss_name,
+                                self.num_devices or jax.device_count())
+        return graph

@@ -286,3 +286,72 @@ def test_compressor_batch_hooks():
     res = comp.run()
     assert ("bb", 0) in calls and ("be", 1) in calls
     assert len(res) == 2  # only the last epoch's batches are kept
+
+
+def test_basic_gru_init_state_and_bidir_last():
+    x = np.zeros((2, 4, 3), "float32")
+    h0 = np.ones((1, 2, 8), "float32")
+
+    def run(with_state):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.data("bg_x", [2, 4, 3], False, dtype="float32")
+            if with_state:
+                hv = fluid.data("bg_h", [1, 2, 8], False, dtype="float32")
+            else:
+                hv = None
+            out, lh = fluid.contrib.basic_gru(xv, hv, 8)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feeds = {"bg_x": x}
+        if with_state:
+            feeds["bg_h"] = h0
+        return np.asarray(exe.run(main, feed=feeds,
+                                  fetch_list=[out.name])[0])
+
+    o0 = run(False)
+    o1 = run(True)
+    assert np.abs(o1 - o0).max() > 1e-4, "init_hidden must affect outputs"
+
+    # bidirectional last_h: backward half equals out[:, 0, 8:]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.data("bg2_x", [2, 4, 3], False, dtype="float32")
+        out, lh = fluid.contrib.basic_gru(xv, None, 8, bidirectional=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ov, hv = exe.run(main, feed={"bg2_x": np.random.RandomState(0)
+                                 .randn(2, 4, 3).astype("float32")},
+                     fetch_list=[out.name, lh.name])
+    ov, hv = np.asarray(ov), np.asarray(hv)
+    np.testing.assert_allclose(hv[1], ov[:, 0, 8:], rtol=1e-5)
+
+
+def test_decoupled_decay_targets_owning_program():
+    AdamWLike = fluid.contrib.extend_with_decoupled_weight_decay(
+        fluid.optimizer.SGDOptimizer)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("dp_x", [2, 3], False, dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, 2))
+    # minimize OUTSIDE the guard: decay ops must still land in `main`
+    AdamWLike(learning_rate=0.1, coeff=0.1).minimize(loss)
+    assert any(op.type == "decoupled_weight_decay"
+               for op in main.global_block().ops)
+    assert not any(op.type == "decoupled_weight_decay"
+                   for op in fluid.default_main_program().global_block().ops)
+
+
+def test_multi_upload_nested(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_text("a")
+    (src / "sub" / "b.txt").write_text("b")
+    dst = tmp_path / "dst"
+    client = fluid.contrib.HDFSClient()
+    up = fluid.contrib.multi_upload(client, str(dst), str(src))
+    assert sorted(up) == ["a.txt", "sub/b.txt"]
+    assert (dst / "sub" / "b.txt").read_text() == "b"
+    assert client.is_dir(str(dst)) and client.is_file(str(dst / "a.txt"))
+    assert not client.is_file(str(dst)) and not client.is_dir(
+        str(dst / "a.txt"))

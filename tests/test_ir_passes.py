@@ -1,0 +1,80 @@
+"""ir.Graph + Pass framework (reference framework/ir/: graph.h, pass.h,
+PassRegistry; pass pipeline of build_strategy.cc)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import ir
+
+
+def _build_net():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("ir_x", [4, 8], False, dtype="float32")
+        y = fluid.data("ir_y", [4, 1], False, dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(
+                fluid.layers.fc(h, 2), y))
+    return main, startup, loss
+
+
+def test_graph_nodes_and_edges():
+    main, _, loss = _build_net()
+    g = ir.Graph(main)
+    ops = g.all_op_nodes()
+    assert any(n.name == "mul" for n in ops)
+    assert all(n.is_op() for n in ops)
+    # var nodes connect producers to consumers
+    relu = next(n for n in ops if n.name == "relu")
+    assert relu.inputs and relu.inputs[0].is_var()
+    producer_types = [p.name for p in relu.inputs[0].inputs]
+    assert "elementwise_add" in producer_types or "mul" in producer_types
+
+
+def test_pass_registry_and_manager():
+    assert ir.PassRegistry.has("graph_viz_pass")
+    assert "amp_rewrite_pass" in ir.PassRegistry.list()
+    with pytest.raises(KeyError):
+        ir.get_pass("no_such_pass")
+
+
+def test_graph_viz_pass(tmp_path):
+    main, _, _ = _build_net()
+    path = str(tmp_path / "g.dot")
+    ir.apply_pass(main, "graph_viz_pass", path=path)
+    dot = open(path).read()
+    assert "mul" in dot and "digraph" in dot
+
+
+def test_amp_rewrite_pass_runs():
+    main, startup, loss = _build_net()
+    n_casts_before = sum(1 for op in main.global_block().ops
+                         if op.type == "cast")
+    ir.apply_pass(main, "amp_rewrite_pass")
+    n_casts_after = sum(1 for op in main.global_block().ops
+                        if op.type == "cast")
+    assert n_casts_after > n_casts_before
+
+
+def test_custom_function_pass():
+    calls = []
+
+    @ir.register_pass("my_counting_pass")
+    def count(graph):
+        calls.append(len(graph.all_op_nodes()))
+
+    main, _, _ = _build_net()
+    ir.PassManager(["my_counting_pass"]).apply(main)
+    assert calls and calls[0] > 3
+
+
+def test_multi_devices_graph_pass_inserts_allreduce():
+    main, startup, loss = _build_net()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    ir.apply_pass(main, "multi_devices_graph_pass", loss_name=loss.name,
+                  num_devices=4)
+    assert any(op.type == "c_allreduce_sum"
+               for op in main.global_block().ops)

@@ -187,3 +187,162 @@ def test_gru_unit_grad():
              "Hidden": np.zeros((2, d), "float32")})
     t.check_grad(["Input", "HiddenPrev", "Weight"], "Hidden",
                  max_relative_error=0.02)
+
+
+# ---- long-tail op families (batches 2-5) ----------------------------------
+
+
+def test_row_conv_grad():
+    rng = _rng()
+    t = _mk("row_conv",
+            {"X": rng.uniform(-1, 1, (2, 5, 3)).astype("float32"),
+             "Filter": rng.uniform(-0.5, 0.5, (3, 3)).astype("float32")},
+            {},
+            {"Out": np.zeros((2, 5, 3), "float32")})
+    t.check_grad(["X", "Filter"], "Out", max_relative_error=0.02)
+
+
+def test_lstmp_grad():
+    rng = _rng()
+    t = _mk("lstmp",
+            {"Input": rng.uniform(-0.5, 0.5, (2, 4, 8)).astype("float32"),
+             "Weight": rng.uniform(-0.3, 0.3, (3, 8)).astype("float32"),
+             "ProjWeight": rng.uniform(-0.3, 0.3, (2, 3)).astype("float32")},
+            {"use_peepholes": False},
+            {"Projection": np.zeros((2, 4, 3), "float32"),
+             "Cell": np.zeros((2, 4, 2), "float32")})
+    t.check_grad(["Input", "Weight", "ProjWeight"], "Projection",
+                 max_relative_error=0.03, numeric_delta=5e-3)
+
+
+def test_bilinear_tensor_product_grad():
+    rng = _rng()
+    t = _mk("bilinear_tensor_product",
+            {"X": rng.uniform(-1, 1, (3, 4)).astype("float32"),
+             "Y": rng.uniform(-1, 1, (3, 5)).astype("float32"),
+             "Weight": rng.uniform(-0.3, 0.3, (2, 4, 5)).astype("float32")},
+            {},
+            {"Out": np.zeros((3, 2), "float32")})
+    t.check_grad(["X", "Y", "Weight"], "Out", max_relative_error=0.02)
+
+
+def test_add_position_encoding_grad():
+    rng = _rng()
+    t = _mk("add_position_encoding",
+            {"X": rng.uniform(-1, 1, (2, 4, 6)).astype("float32")},
+            {"alpha": 0.7, "beta": 0.5},
+            {"Out": np.zeros((2, 4, 6), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_temporal_shift_grad():
+    rng = _rng()
+    t = _mk("temporal_shift",
+            {"X": rng.uniform(-1, 1, (4, 4, 2, 2)).astype("float32")},
+            {"seg_num": 2, "shift_ratio": 0.25},
+            {"Out": np.zeros((4, 4, 2, 2), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_fsp_grad():
+    rng = _rng()
+    t = _mk("fsp",
+            {"X": rng.uniform(-1, 1, (2, 3, 3, 3)).astype("float32"),
+             "Y": rng.uniform(-1, 1, (2, 2, 3, 3)).astype("float32")},
+            {},
+            {"Out": np.zeros((2, 3, 2), "float32")})
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+def test_pool3d_grad():
+    rng = _rng()
+    t = _mk("pool3d",
+            {"X": rng.uniform(-1, 1, (1, 2, 4, 4, 4)).astype("float32")},
+            {"pooling_type": "avg", "ksize": [2, 2, 2],
+             "strides": [2, 2, 2], "paddings": [0, 0, 0]},
+            {"Out": np.zeros((1, 2, 2, 2, 2), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_conv3d_transpose_grad():
+    rng = _rng()
+    t = _mk("conv3d_transpose",
+            {"Input": rng.uniform(-1, 1, (1, 2, 3, 3, 3)).astype("float32"),
+             "Filter": rng.uniform(-0.5, 0.5, (2, 2, 2, 2, 2))
+             .astype("float32")},
+            {"strides": [2, 2, 2], "paddings": [0, 0, 0],
+             "dilations": [1, 1, 1]},
+            {"Output": np.zeros((1, 2, 6, 6, 6), "float32")})
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.02)
+
+
+def test_sigmoid_focal_loss_grad():
+    rng = _rng()
+    t = _mk("sigmoid_focal_loss",
+            {"X": rng.uniform(-2, 2, (4, 3)).astype("float32"),
+             "Label": rng.randint(0, 4, (4, 1)).astype("int64"),
+             "FgNum": np.array([2], "int32")},
+            {"gamma": 2.0, "alpha": 0.25},
+            {"Out": np.zeros((4, 3), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_teacher_student_sigmoid_loss_grad():
+    rng = _rng()
+    t = _mk("teacher_student_sigmoid_loss",
+            {"X": rng.uniform(-2, 2, (6, 1)).astype("float32"),
+             "Label": rng.uniform(0, 1, (6, 1)).astype("float32")},
+            {},
+            {"Y": np.zeros((6, 1), "float32")})
+    t.check_grad(["X"], "Y", max_relative_error=0.03)
+
+
+def test_deformable_conv_grad():
+    rng = _rng()
+    t = _mk("deformable_conv",
+            {"Input": rng.uniform(-1, 1, (1, 2, 5, 5)).astype("float32"),
+             "Offset": rng.uniform(-0.4, 0.4, (1, 18, 5, 5))
+             .astype("float32"),
+             "Filter": rng.uniform(-0.5, 0.5, (3, 2, 3, 3))
+             .astype("float32")},
+            {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1},
+            {"Output": np.zeros((1, 3, 5, 5), "float32")})
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.04,
+                 numeric_delta=5e-3)
+
+
+def test_spectral_norm_grad():
+    rng = _rng()
+    u = rng.uniform(-1, 1, (4,)).astype("float32")
+    v = rng.uniform(-1, 1, (6,)).astype("float32")
+    t = _mk("spectral_norm",
+            {"Weight": rng.uniform(-1, 1, (4, 6)).astype("float32"),
+             "U": u / np.linalg.norm(u), "V": v / np.linalg.norm(v)},
+            {"dim": 0, "power_iters": 0, "eps": 1e-12},
+            {"Out": np.zeros((4, 6), "float32"),
+             "UOut": np.zeros((4,), "float32"),
+             "VOut": np.zeros((6,), "float32")})
+    # power_iters=0: u/v fixed → d(Out)/d(Weight) well-defined
+    t.check_grad(["Weight"], "Out", max_relative_error=0.03)
+
+
+def test_cvm_grad():
+    rng = _rng()
+    t = _mk("cvm",
+            {"X": rng.uniform(0.1, 2, (4, 5)).astype("float32"),
+             "CVM": np.ones((4, 2), "float32")},
+            {"use_cvm": True},
+            {"Y": np.zeros((4, 5), "float32")})
+    t.check_grad(["X"], "Y", max_relative_error=0.02)
+
+
+def test_sequence_scatter_grad():
+    rng = _rng()
+    t = _mk("sequence_scatter",
+            {"X": rng.uniform(-1, 1, (2, 6)).astype("float32"),
+             "Ids": rng.randint(0, 6, (2, 3)).astype("int64"),
+             "Updates": rng.uniform(-1, 1, (2, 3)).astype("float32")},
+            {},
+            {"Out": np.zeros((2, 6), "float32")})
+    t.check_grad(["X", "Updates"], "Out", max_relative_error=0.02)

@@ -220,6 +220,10 @@ class BlockPlan:
     GSPMD hybrid runner — one implementation of prune/analyze/write-back."""
 
     def __init__(self, program, block, feed_names, fetch_names, scope):
+        # every compile path (single-device, shard_map DP, GSPMD hybrid,
+        # LocalSGD) builds a BlockPlan first — apply the persistent XLA
+        # cache config here so all of them benefit
+        _apply_compile_cache()
         self.program = program
         self.block = block
         self.feed_names = list(feed_names)
@@ -308,6 +312,40 @@ class BlockPlan:
         by_name = dict(zip(self.jit_fetch_names, jit_fetches))
         return [by_name[n] if n in by_name else scope.get(n)
                 for n in self.fetch_names]
+
+
+_cache_dir_last = object()  # sentinel: not yet applied
+
+
+def _apply_compile_cache():
+    """Point jax at a persistent on-disk compilation cache
+    (FLAGS_compile_cache_dir; SURVEY §7 hard part 6) so re-runs of the same
+    program skip the 20-40s first XLA compile.  Applied lazily before each
+    compile and re-applied when the flag changes — never fatal (a broken
+    cache dir must not stop a run)."""
+    global _cache_dir_last
+    from . import flags as _flags
+
+    cache_dir = _flags.flag("compile_cache_dir")
+    if cache_dir == _cache_dir_last:
+        return
+    _cache_dir_last = cache_dir
+    try:
+        import jax
+
+        if not cache_dir:
+            jax.config.update("jax_compilation_cache_dir", None)
+            return
+        import os as _os
+
+        _os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache everything that took meaningful compile time
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # pragma: no cover - environment-specific
+        import warnings
+
+        warnings.warn(f"persistent compile cache disabled: {e}")
 
 
 class _CompiledBlock:

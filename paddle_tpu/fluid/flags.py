@@ -37,6 +37,12 @@ _DEFS = {
     "FLAGS_cpu_deterministic": (True, _parse_bool, True),
     # distributed (consumed by the PS/RPC host ops)
     "FLAGS_rpc_deadline": (180000, int, True),
+    # persistent XLA compile cache (SURVEY §7 hard part 6: hide compile
+    # latency behind a cache that survives processes).  Empty string
+    # disables; the executor applies it lazily on first compile.
+    "FLAGS_compile_cache_dir": (
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "xla_cache"), str, True),
     # accepted no-ops (CUDA/allocator knobs with no TPU meaning)
     "FLAGS_fraction_of_gpu_memory_to_use": (0.92, float, False),
     "FLAGS_eager_delete_tensor_gb": (-1.0, float, False),

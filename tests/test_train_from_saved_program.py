@@ -1,0 +1,59 @@
+"""Train from a serialized program in a fresh process (reference
+paddle/fluid/train/test_train_recognize_digits.cc: the C++ binary loads a
+saved ProgramDesc and trains without the Python graph builder)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import io as fio
+
+_CHILD = r'''
+import json, sys
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from paddle_tpu import fluid
+from paddle_tpu.fluid import io as fio
+
+main = fio.load_program(sys.argv[1])
+startup = fio.load_program(sys.argv[2])
+loss_name = sys.argv[3]
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(startup)
+rng = np.random.RandomState(0)
+W = rng.randn(4, 1).astype("float32")
+losses = []
+for _ in range(60):
+    x = rng.randn(16, 4).astype("float32")
+    y = x @ W
+    out = exe.run(main, feed={"tfs_x": x, "tfs_y": y},
+                  fetch_list=[loss_name])
+    losses.append(float(np.asarray(out[0])))
+print(json.dumps({"first": losses[0], "last": losses[-1]}))
+'''
+
+
+def test_train_from_saved_program(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("tfs_x", [-1, 4], False, dtype="float32")
+        y = fluid.data("tfs_y", [-1, 1], False, dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    mpath = str(tmp_path / "main.json")
+    spath = str(tmp_path / "startup.json")
+    fio.save_program(main, mpath)
+    fio.save_program(startup, spath)
+
+    # fresh interpreter: no Python graph building, only the saved programs
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD, mpath, spath, loss.name],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-2000:]
+    stats = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("{")][-1])
+    assert stats["last"] < stats["first"] * 0.2, stats

@@ -3,6 +3,7 @@ paddle/fluid/train/test_train_recognize_digits.cc: the C++ binary loads a
 saved ProgramDesc and trains without the Python graph builder)."""
 
 import json
+import os
 import subprocess
 import sys
 
@@ -50,9 +51,10 @@ def test_train_from_saved_program(tmp_path):
     fio.save_program(startup, spath)
 
     # fresh interpreter: no Python graph building, only the saved programs
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run(
         [sys.executable, "-c", _CHILD, mpath, spath, loss.name],
-        capture_output=True, text=True, cwd="/root/repo")
+        capture_output=True, text=True, cwd=repo_root, timeout=240)
     assert out.returncode == 0, out.stderr[-2000:]
     stats = json.loads(
         [l for l in out.stdout.splitlines() if l.startswith("{")][-1])

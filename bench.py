@@ -28,7 +28,58 @@ import sys
 import time
 
 
+def measure_resnet(size):
+    """ResNet-50 ImageNet images/sec/chip (BASELINE.md north-star #2).
+    Selected with PT_BENCH_MODEL=resnet50; BERT stays the headline metric
+    the driver records."""
+    import numpy as np
+
+    from paddle_tpu import fluid
+    from paddle_tpu.models import resnet
+
+    batch = int(os.environ.get("PT_BENCH_BATCH", "128"))
+    n_steps = int(os.environ.get("PT_BENCH_STEPS", "10"))
+    depth = 50 if size != "tiny" else 18
+    image = (3, 224, 224) if size != "tiny" else (3, 64, 64)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
+        feeds, pred, loss, acc = resnet.build_resnet(
+            depth=depth, class_dim=1000, image_shape=image)
+        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
+            loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    data = {"img": rng.rand(batch, *image).astype("float32"),
+            "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
+    for _ in range(2):
+        exe.run(main_prog, feed=data, fetch_list=[loss.name])
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        exe.run(main_prog, feed=data, fetch_list=[loss.name])
+    dt = time.perf_counter() - t0
+    ips = n_steps * batch / dt
+    config = f"resnet{depth} b{batch} {image[1]}x{image[2]}"
+    # same comparability rule as the bert path: a recorded baseline only
+    # applies to the headline config it was measured at (BENCH_BASELINE is
+    # normally a bert tokens/sec number — never divide across metrics)
+    baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
+    base_cfg = os.environ.get("BENCH_BASELINE_CONFIG", "")
+    comparable = baseline > 0 and size != "tiny" and base_cfg == config
+    vs = (ips / baseline if comparable else
+          1.0 if size != "tiny" else 0.0)
+    return {
+        "metric": f"resnet{depth}_train_images_per_sec",
+        "value": round(ips, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 3),
+        "config": config,
+    }
+
+
 def measure(size):
+    if os.environ.get("PT_BENCH_MODEL", "bert") in ("resnet", "resnet50"):
+        return measure_resnet(size)
     import numpy as np
 
     from paddle_tpu import fluid
@@ -129,9 +180,14 @@ def main():
             return
         print(f"bench: {label} config failed rc={out.returncode}\n"
               + out.stderr[-2000:], file=sys.stderr)
+    if os.environ.get("PT_BENCH_MODEL", "bert") in ("resnet", "resnet50"):
+        failed_metric = ("resnet50_train_images_per_sec", "images/sec/chip")
+    else:
+        failed_metric = ("bert_base_pretrain_tokens_per_sec",
+                         "tokens/sec/chip")
     print(json.dumps({
-        "metric": "bert_base_pretrain_tokens_per_sec", "value": 0.0,
-        "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+        "metric": failed_metric[0], "value": 0.0,
+        "unit": failed_metric[1], "vs_baseline": 0.0,
         "config": "FAILED: no config completed (device unreachable?)",
     }))
 

@@ -28,6 +28,29 @@ import sys
 import time
 
 
+def _timed_steps(exe, prog, data, loss_name, n_steps):
+    """Shared warmup + timed loop (fetch→numpy syncs the device, so each
+    iteration is fully timed)."""
+    for _ in range(2):
+        exe.run(prog, feed=data, fetch_list=[loss_name])
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        exe.run(prog, feed=data, fetch_list=[loss_name])
+    return time.perf_counter() - t0
+
+
+def _vs_baseline(value, config, is_headline):
+    """BENCH_BASELINE only compares against the exact headline config it
+    was recorded at (BENCH_BASELINE_CONFIG); anything else reports the
+    sentinel (1.0 headline / 0.0 fallback rung)."""
+    baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
+    base_cfg = os.environ.get("BENCH_BASELINE_CONFIG", "")
+    comparable = baseline > 0 and is_headline and \
+        (not base_cfg or base_cfg == config)
+    return round(value / baseline if comparable else
+                 (1.0 if is_headline else 0.0), 3)
+
+
 def measure_resnet(size):
     """ResNet-50 ImageNet images/sec/chip (BASELINE.md north-star #2).
     Selected with PT_BENCH_MODEL=resnet50; BERT stays the headline metric
@@ -52,27 +75,14 @@ def measure_resnet(size):
     rng = np.random.RandomState(0)
     data = {"img": rng.rand(batch, *image).astype("float32"),
             "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
-    for _ in range(2):
-        exe.run(main_prog, feed=data, fetch_list=[loss.name])
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        exe.run(main_prog, feed=data, fetch_list=[loss.name])
-    dt = time.perf_counter() - t0
+    dt = _timed_steps(exe, main_prog, data, loss.name, n_steps)
     ips = n_steps * batch / dt
     config = f"resnet{depth} b{batch} {image[1]}x{image[2]}"
-    # same comparability rule as the bert path: a recorded baseline only
-    # applies to the headline config it was measured at (BENCH_BASELINE is
-    # normally a bert tokens/sec number — never divide across metrics)
-    baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
-    base_cfg = os.environ.get("BENCH_BASELINE_CONFIG", "")
-    comparable = baseline > 0 and size != "tiny" and base_cfg == config
-    vs = (ips / baseline if comparable else
-          1.0 if size != "tiny" else 0.0)
     return {
         "metric": f"resnet{depth}_train_images_per_sec",
         "value": round(ips, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": _vs_baseline(ips, config, is_headline=size != "tiny"),
         "config": config,
     }
 
@@ -114,34 +124,17 @@ def measure(size):
     exe = fluid.Executor()
     exe.run(startup)
     data = bert.make_fake_batch(cfg, batch=batch, seq_len=seq_len, seed=0)
-
-    for _ in range(2):  # warmup: compile + 2 steps
-        exe.run(main_prog, feed=data, fetch_list=[loss.name])
-
-    # exe.run(return_numpy=True) converts fetches to numpy, which
-    # synchronizes the device — each iteration is fully timed
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        exe.run(main_prog, feed=data, fetch_list=[loss.name])
-    dt = time.perf_counter() - t0
+    dt = _timed_steps(exe, main_prog, data, loss.name, n_steps)
 
     tokens_per_sec = n_steps * batch * seq_len / dt
     config = (f"bert-{size} b{batch} s{seq_len}"
               + (" flash" if flash else "") + (" bf16" if amp else ""))
-    # BENCH_BASELINE is a bert-base number recorded at BENCH_BASELINE_CONFIG;
-    # a baseline from a different config (e.g. old b16 default) must not be
-    # compared against — the ratio would only reflect the config change
-    baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
-    base_cfg = os.environ.get("BENCH_BASELINE_CONFIG", "")
-    comparable = baseline > 0 and size == "base" and \
-        (not base_cfg or base_cfg == config)
-    vs = (tokens_per_sec / baseline if comparable else
-          1.0 if size == "base" else 0.0)
     return {
         "metric": f"bert_{size}_pretrain_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": _vs_baseline(tokens_per_sec, config,
+                                    is_headline=size == "base"),
         "config": config,
     }
 

@@ -28,15 +28,44 @@ def length_var_name(name: str) -> str:
 
 
 class DataFeeder:
-    def __init__(self, feed_list, place=None, program=None):
+    def __init__(self, feed_list, place=None, program=None,
+                 bucket_seq_lens=None, bucket_batch_sizes=None):
+        """bucket_seq_lens / bucket_batch_sizes (TPU-native extension): pad
+        ragged time dims / the batch dim up to the nearest listed bucket so
+        the executor compiles once per bucket instead of once per distinct
+        shape (SURVEY §7 hard part 1 — LoD vs XLA static shapes).
+
+        Sequence buckets are mask-safe automatically: the `<name>__len`
+        vector keeps TRUE lengths and padding rows get length 0.  Batch
+        buckets add FAKE rows, which would silently bias unmasked
+        reductions (mean loss over 8 rows of which 3 are zeros) — so
+        bucket_batch_sizes additionally requires the program to declare a
+        `batch_row_mask` feed var ([-1] float32); feed() fills it with 1
+        for real rows / 0 for padding, and the model must weight its loss
+        by it.  Without that var, feed() refuses to pad the batch dim."""
         self.place = place
         self.feed_vars = []
+        self.bucket_seq_lens = (sorted(bucket_seq_lens)
+                                if bucket_seq_lens else None)
+        self.bucket_batch_sizes = (sorted(bucket_batch_sizes)
+                                   if bucket_batch_sizes else None)
         program = program or framework.default_main_program()
+        self._program = program
+        self._has_row_mask = "batch_row_mask" in program.global_block().vars
         for v in feed_list:
             if isinstance(v, str):
                 v = program.global_block().var(v)
             assert isinstance(v, Variable)
             self.feed_vars.append(v)
+
+    @staticmethod
+    def _bucket(value, buckets):
+        for b in buckets:
+            if value <= b:
+                return b
+        raise ValueError(
+            f"extent {value} exceeds the largest bucket {buckets[-1]}; "
+            f"add a larger bucket or truncate the batch")
 
     def feed(self, iterable):
         """iterable: list of samples; each sample is a tuple with one entry
@@ -44,6 +73,16 @@ class DataFeeder:
         batch = list(iterable)
         if not batch:
             raise ValueError("empty minibatch")
+        n_rows = len(batch)
+        pad_rows = 0
+        if self.bucket_batch_sizes:
+            pad_rows = self._bucket(n_rows, self.bucket_batch_sizes) - n_rows
+            if pad_rows and not self._has_row_mask:
+                raise ValueError(
+                    "bucket_batch_sizes adds fake rows, which corrupts "
+                    "unmasked reductions: declare a `batch_row_mask` feed "
+                    "var ([-1] float32) and weight the loss by it, or drop "
+                    "bucket_batch_sizes")
         out = {}
         for i, var in enumerate(self.feed_vars):
             cols = [s[i] for s in batch]
@@ -51,10 +90,16 @@ class DataFeeder:
                 arrs = [np.asarray(c) for c in cols]
                 lens = np.asarray([a.shape[0] for a in arrs], dtype="int32")
                 maxlen = int(lens.max())
+                if self.bucket_seq_lens:
+                    maxlen = self._bucket(maxlen, self.bucket_seq_lens)
                 tail = arrs[0].shape[1:]
-                padded = np.zeros((len(arrs), maxlen) + tail, dtype=var.dtype)
+                padded = np.zeros((n_rows + pad_rows, maxlen) + tail,
+                                  dtype=var.dtype)
                 for j, a in enumerate(arrs):
                     padded[j, : a.shape[0]] = a
+                if pad_rows:
+                    lens = np.concatenate(
+                        [lens, np.zeros(pad_rows, "int32")])
                 out[var.name] = padded
                 out[length_var_name(var.name)] = lens
             else:
@@ -69,7 +114,14 @@ class DataFeeder:
                         want = (a.shape[0],) + tuple(tail)
                         if a.shape != want and int(np.prod(a.shape[1:] or (1,))) == int(np.prod(tail or (1,))):
                             a = a.reshape(want)
+                if pad_rows:
+                    a = np.concatenate(
+                        [a, np.zeros((pad_rows,) + a.shape[1:], a.dtype)])
                 out[var.name] = a
+        if self.bucket_batch_sizes and self._has_row_mask:
+            out["batch_row_mask"] = np.concatenate(
+                [np.ones(n_rows, "float32"),
+                 np.zeros(pad_rows, "float32")])
         return out
 
     def feed_parallel(self, iterable, num_places=None):
@@ -92,12 +144,16 @@ class DataFeeder:
 
             ndev = num_places or jax.device_count()
             for batch in reader():
-                if multi_devices and len(batch) % ndev != 0:
+                eff = len(batch)
+                if self.bucket_batch_sizes:
+                    # the executor shards the POST-bucket size
+                    eff = self._bucket(eff, self.bucket_batch_sizes)
+                if multi_devices and eff % ndev != 0:
                     if drop_last:
                         continue
                     raise ValueError(
-                        f"batch size {len(batch)} not divisible by "
-                        f"{ndev} devices")
+                        f"batch size {eff} (after bucketing) not divisible "
+                        f"by {ndev} devices")
                 yield self.feed(batch)
 
         return decorated

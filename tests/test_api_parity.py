@@ -289,3 +289,42 @@ def test_unique_name_switch():
     a = fluid.unique_name.generate("t")
     fluid.unique_name.switch(old)
     assert a.startswith("t_")
+
+
+def test_data_feeder_shape_bucketing():
+    """bucket_seq_lens/bucket_batch_sizes pad to the nearest bucket so the
+    executor compiles once per bucket (TPU-native recompile control)."""
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        seq = fluid.data("bk_seq", [-1, -1, 2], False, dtype="float32",
+                         lod_level=1)
+        dense = fluid.data("bk_d", [-1, 3], False, dtype="float32")
+    with fluid.program_guard(main, fluid.Program()):
+        fluid.data("batch_row_mask", [-1], False, dtype="float32")
+    feeder = fluid.DataFeeder([seq, dense], program=main,
+                              bucket_seq_lens=[4, 8, 16],
+                              bucket_batch_sizes=[4, 8])
+    batch = [(np.ones((3, 2), "float32"), np.ones(3, "float32"))
+             for _ in range(5)]
+    batch.append((np.ones((6, 2), "float32"), np.ones(3, "float32")))
+    feed = feeder.feed(batch)
+    # 6 rows → batch bucket 8; max len 6 → seq bucket 8
+    assert feed["bk_seq"].shape == (8, 8, 2)
+    assert feed["bk_d"].shape == (8, 3)
+    lens = feed["bk_seq__len"]
+    assert list(lens) == [3, 3, 3, 3, 3, 6, 0, 0]
+    # padding rows are zero and the row mask marks them invalid
+    assert feed["bk_seq"][6:].max() == 0 and feed["bk_d"][6:].max() == 0
+    assert list(feed["batch_row_mask"]) == [1, 1, 1, 1, 1, 1, 0, 0]
+    # without a batch_row_mask var, batch padding must refuse (silent loss
+    # corruption otherwise)
+    main2 = fluid.Program()
+    with fluid.program_guard(main2, fluid.Program()):
+        d2 = fluid.data("bk2_d", [-1, 3], False, dtype="float32")
+    f2 = fluid.DataFeeder([d2], program=main2, bucket_batch_sizes=[8])
+    with pytest.raises(ValueError):
+        f2.feed([(np.ones(3, "float32"),)] * 5)
+    # over-large extent is a hard error, not a silent mis-bucket
+    big = [(np.ones((20, 2), "float32"), np.ones(3, "float32"))]
+    with pytest.raises(ValueError):
+        feeder.feed(big)

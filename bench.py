@@ -39,14 +39,18 @@ def _timed_steps(exe, prog, data, loss_name, n_steps):
     return time.perf_counter() - t0
 
 
-def _vs_baseline(value, config, is_headline):
+def _vs_baseline(value, config, is_headline, default_metric=False):
     """BENCH_BASELINE only compares against the exact headline config it
     was recorded at (BENCH_BASELINE_CONFIG); anything else reports the
-    sentinel (1.0 headline / 0.0 fallback rung)."""
+    sentinel (1.0 headline / 0.0 fallback rung).  Only the default (bert)
+    metric may match an empty BENCH_BASELINE_CONFIG — for other metrics an
+    exact config match is required, because a driver's ambient baseline is
+    normally a bert tokens/sec number and dividing across metrics is
+    meaningless."""
     baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
     base_cfg = os.environ.get("BENCH_BASELINE_CONFIG", "")
-    comparable = baseline > 0 and is_headline and \
-        (not base_cfg or base_cfg == config)
+    cfg_match = (base_cfg == config or (default_metric and not base_cfg))
+    comparable = baseline > 0 and is_headline and cfg_match
     return round(value / baseline if comparable else
                  (1.0 if is_headline else 0.0), 3)
 
@@ -87,9 +91,55 @@ def measure_resnet(size):
     }
 
 
+def measure_gpt_decode(size):
+    """GPT autoregressive decode tokens/sec with the KV cache
+    (PT_BENCH_MODEL=gpt): the latency-bound serving metric, complementing
+    the throughput-bound training metrics."""
+    import numpy as np
+
+    from paddle_tpu import fluid
+    from paddle_tpu.models import gpt
+
+    batch = int(os.environ.get("PT_BENCH_BATCH", "16"))
+    prompt_len = int(os.environ.get("PT_BENCH_PROMPT", "32"))
+    gen_len = int(os.environ.get("PT_BENCH_GEN", "64"))
+    maxp = prompt_len + gen_len + 8
+    if size == "base":
+        cfg = gpt.GPTConfig(vocab_size=50304, hidden_size=768, num_heads=12,
+                            num_layers=12, max_position=maxp)
+    else:
+        cfg = gpt.GPTConfig(vocab_size=1024, hidden_size=128, num_heads=4,
+                            num_layers=2, intermediate_size=512,
+                            max_position=maxp)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
+        prompt_var, out_var, _scores = gpt.build_gpt_generate_cached(
+            cfg, prompt_len=prompt_len, gen_len=gen_len)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg.vocab_size,
+                         (batch, prompt_len)).astype("int64")
+    n_steps = int(os.environ.get("PT_BENCH_STEPS", "5"))
+    dt = _timed_steps(exe, main_prog, {prompt_var.name: prompt},
+                      out_var.name, n_steps)
+    tps = n_steps * batch * gen_len / dt
+    config = f"gpt-{size} b{batch} p{prompt_len} g{gen_len} kvcache"
+    return {
+        "metric": f"gpt_{size}_decode_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": _vs_baseline(tps, config, is_headline=size == "base"),
+        "config": config,
+    }
+
+
 def measure(size):
-    if os.environ.get("PT_BENCH_MODEL", "bert") in ("resnet", "resnet50"):
+    model = os.environ.get("PT_BENCH_MODEL", "bert")
+    if model in ("resnet", "resnet50"):
         return measure_resnet(size)
+    if model == "gpt":
+        return measure_gpt_decode(size)
     import numpy as np
 
     from paddle_tpu import fluid
@@ -134,7 +184,8 @@ def measure(size):
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": _vs_baseline(tokens_per_sec, config,
-                                    is_headline=size == "base"),
+                                    is_headline=size == "base",
+                                    default_metric=True),
         "config": config,
     }
 
@@ -145,12 +196,15 @@ def main():
         return
 
     timeout = float(os.environ.get("PT_BENCH_TIMEOUT", "1500"))
-    # fallback ladder: headline b128 → b64 (smaller working set, faster
-    # compile) → tiny model.  A wedged/slow device tunnel is a known
-    # environment failure mode; each rung still reports a REAL number.
+    model = os.environ.get("PT_BENCH_MODEL", "bert")
+    # fallback ladder: headline → smaller working set (per model: bert/
+    # resnet default b128 halve to b64; gpt decode defaults b16 halve to
+    # b8) → tiny model.  A wedged/slow device tunnel is a known environment
+    # failure mode; each rung still reports a REAL number.
+    mid_batch = "8" if model == "gpt" else "64"
     ladder = (
         ("base", {}, timeout),
-        ("base", {"PT_BENCH_BATCH": "64", "PT_BENCH_STEPS": "6"},
+        ("base", {"PT_BENCH_BATCH": mid_batch, "PT_BENCH_STEPS": "6"},
          min(timeout, 700.0)),
         ("tiny", {}, min(timeout, 400.0)),
     )
@@ -173,8 +227,11 @@ def main():
             return
         print(f"bench: {label} config failed rc={out.returncode}\n"
               + out.stderr[-2000:], file=sys.stderr)
-    if os.environ.get("PT_BENCH_MODEL", "bert") in ("resnet", "resnet50"):
+    if model in ("resnet", "resnet50"):
         failed_metric = ("resnet50_train_images_per_sec", "images/sec/chip")
+    elif model == "gpt":
+        failed_metric = ("gpt_base_decode_tokens_per_sec",
+                         "tokens/sec/chip")
     else:
         failed_metric = ("bert_base_pretrain_tokens_per_sec",
                          "tokens/sec/chip")

@@ -28,7 +28,43 @@ class Fleet(metaclass=abc.ABCMeta):
         if not role_maker._generated:
             role_maker.generate_role()
         self._role_maker = role_maker
+        if self._mode == Mode.COLLECTIVE:
+            self._maybe_init_multihost()
         return self
+
+    def _maybe_init_multihost(self):
+        """Multi-host SPMD bootstrap (reference NCCL2 mode's gen_nccl_id
+        TCP handshake → here the jax coordination service): when an
+        ENV-driven launch (PaddleCloudRoleMaker, the cluster launcher
+        contract) reports >1 trainer endpoint, initialize jax.distributed
+        so jax.devices() spans every host's chips and mesh collectives
+        ride ICI/DCN across them.  Worker 0's endpoint hosts the
+        coordinator.  User-defined role makers don't auto-connect — their
+        endpoints are often descriptive only (program rewriting in one
+        process); call this method explicitly for a real multi-host run."""
+        if not isinstance(self._role_maker, PaddleCloudRoleMaker):
+            return
+        eps = self._role_maker.get_trainer_endpoints()
+        if len(eps) <= 1:
+            return
+        import jax
+
+        if getattr(jax.distributed, "is_initialized", None) and \
+                jax.distributed.is_initialized():
+            return
+        coordinator = eps[0]
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=len(eps),
+                process_id=self._role_maker.worker_index())
+        except RuntimeError as e:
+            # pre-initialized by the launcher: fine; anything else is a
+            # real bootstrap failure the trainer must not swallow.  jax
+            # raises "distributed.initialize should only be called once."
+            msg = str(e).lower()
+            if "only be called once" not in msg and "already" not in msg:
+                raise
 
     def is_first_worker(self):
         return self._role_maker.is_first_worker()

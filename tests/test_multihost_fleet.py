@@ -1,0 +1,86 @@
+"""Multi-host SPMD bootstrap (reference NCCL2-mode test_dist_base pattern:
+real subprocesses on 127.0.0.1): two processes fleet.init() from
+PADDLE_TRAINER_* env, the coordination service forms one 2-device global
+mesh, and a psum across HOSTS returns the cross-process sum."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_CHILD = r'''
+import json, os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.fluid.incubate.fleet.collective import fleet
+
+fleet.init()
+out = {"worker": fleet.worker_index(), "nworkers": fleet.worker_num(),
+       "global_devices": jax.device_count(),
+       "local_devices": jax.local_device_count()}
+
+# cross-host collective: each process contributes (worker_index + 1)
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("dp",))
+local = np.full((1, 2), fleet.worker_index() + 1, dtype="float32")
+garr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), local)
+
+@jax.jit
+def summed(x):
+    return jnp.sum(x, axis=0)
+
+out["psum"] = float(np.asarray(jax.device_get(summed(garr)))[0])
+print("RESULT " + json.dumps(out), flush=True)
+'''
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_process_fleet_collective(tmp_path):
+    import numpy as np  # noqa: F401 (child uses np; parent asserts)
+
+    port = _free_port()
+    eps = f"127.0.0.1:{port},127.0.0.1:{_free_port()}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for wid in range(2):
+        env = dict(os.environ,
+                   PADDLE_TRAINER_ID=str(wid),
+                   PADDLE_TRAINER_ENDPOINTS=eps,
+                   PADDLE_CURRENT_ENDPOINT=eps.split(",")[wid],
+                   PADDLE_TRAINERS_NUM="2",
+                   TRAINING_ROLE="TRAINER")
+        env.pop("XLA_FLAGS", None)  # one device per process
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CHILD], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = {}
+    for wid, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            pytest.fail(f"worker {wid} hung")
+        assert p.returncode == 0, err[-2000:]
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        results[wid] = json.loads(line[len("RESULT "):])
+    for wid, r in results.items():
+        assert r["nworkers"] == 2
+        assert r["local_devices"] == 1
+        assert r["global_devices"] == 2, r
+        # sum over the global mesh = 1 + 2 from the two processes
+        assert r["psum"] == 3.0, r

@@ -59,11 +59,11 @@ class Fleet(metaclass=abc.ABCMeta):
                 num_processes=len(eps),
                 process_id=self._role_maker.worker_index())
         except RuntimeError as e:
-            # pre-initialized by the launcher: fine; anything else is a
-            # real bootstrap failure the trainer must not swallow.  jax
-            # raises "distributed.initialize should only be called once."
-            msg = str(e).lower()
-            if "only be called once" not in msg and "already" not in msg:
+            # pre-initialized by the launcher: fine; anything else (e.g.
+            # "address already in use") is a real bootstrap failure the
+            # trainer must not swallow.  jax raises
+            # "distributed.initialize should only be called once."
+            if "only be called once" not in str(e).lower():
                 raise
 
     def is_first_worker(self):

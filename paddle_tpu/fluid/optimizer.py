@@ -23,6 +23,7 @@ __all__ = [
     "AdamaxOptimizer", "DecayedAdagradOptimizer", "AdadeltaOptimizer",
     "RMSPropOptimizer", "FtrlOptimizer", "LambOptimizer",
     "LarsMomentumOptimizer", "ExponentialMovingAverage", "ModelAverage",
+    "GradientMergeOptimizer",
 ]
 
 
@@ -731,6 +732,215 @@ Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
 DGCMomentum = DGCMomentumOptimizer
+
+
+class GradientMergeOptimizer:
+    """Gradient accumulation over k_steps micro-batches (reference
+    ir/multi_batch_merge_pass.cc: replicate forward/backward k times and
+    merge gradients before one optimizer step).
+
+    TPU-native: the reference wraps the optimizer ops in a conditional
+    block; here the whole step stays one compiled program and boundary
+    selection is arithmetic (XLA-friendly, no control flow):
+
+        acc   += grad                  every micro-step
+        gate   = (step % k == 0)       1.0 on boundary steps
+        <snapshot params + optimizer accumulators>
+        <inner optimizer updates with merged grad acc/k>
+        state  = gate * updated + (1 - gate) * snapshot
+
+    The snapshot/revert covers the PARAMETERS and every inner-optimizer
+    accumulator (Adam moments, beta_pow, ...), so stateful rules advance
+    exactly once per k micro-batches — grad-zeroing alone would not freeze
+    them.  Weight decay / clipping run inside the inner optimizer on the
+    merged grad and are reverted off-boundary like everything else.
+    Data-parallel transpilers still see the RAW per-micro-batch grads
+    (program._params_grads), so replicas allreduce real gradients before
+    accumulation.
+    """
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        if int(k_steps) < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self.type = "gradient_merge"
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .layers import tensor as tensor_mod
+
+        if self.k_steps == 1:
+            return self.inner_optimizer.minimize(
+                loss, startup_program, parameter_list, no_grad_set)
+        program = loss.block.program
+        with framework.program_guard(program, startup_program):
+            params_grads = self.inner_optimizer.backward(
+                loss, startup_program, parameter_list, no_grad_set)
+            block = program.global_block()
+            helper = LayerHelper("gradient_merge")
+            # int64 step counter: a float32 one saturates at 2^24 steps
+            counter = helper.create_global_variable(
+                name=unique_name.generate("gm_step"), shape=[1],
+                dtype="int64", persistable=True, stop_gradient=True)
+            helper.set_variable_initializer(counter, Constant(0.0))
+            block.append_op("increment", inputs={"X": [counter]},
+                            outputs={"Out": [counter]},
+                            attrs={"step": 1.0, "op_role": "backward"})
+            modk = block.create_var(
+                name=unique_name.generate("gm_mod"), dtype="int64",
+                stop_gradient=True)
+            block.append_op(
+                "elementwise_mod",
+                inputs={"X": [counter],
+                        "Y": [tensor_mod.fill_constant(
+                            [1], "int64", self.k_steps)]},
+                outputs={"Out": [modk]}, attrs={"op_role": "backward"})
+            gate_b = block.create_var(
+                name=unique_name.generate("gm_gate_b"), dtype="bool",
+                stop_gradient=True)
+            block.append_op(
+                "equal",
+                inputs={"X": [modk],
+                        "Y": [tensor_mod.fill_constant([1], "int64", 0)]},
+                outputs={"Out": [gate_b]}, attrs={"op_role": "backward"})
+            gate = block.create_var(
+                name=unique_name.generate("gm_gate"), dtype="float32",
+                stop_gradient=True)
+            block.append_op("cast", inputs={"X": [gate_b]},
+                            outputs={"Out": [gate]},
+                            attrs={"out_dtype": "float32",
+                                   "op_role": "backward"})
+
+            merged = []
+            accs = []
+            scale = 1.0 / self.k_steps if self.avg else 1.0
+            for p, g in params_grads:
+                acc = helper.create_global_variable(
+                    name=unique_name.generate(p.name + "_gm_acc"),
+                    shape=list(p.shape), dtype=p.dtype, persistable=True,
+                    stop_gradient=True)
+                acc.is_optimizer_state = True
+                helper.set_variable_initializer(acc, Constant(0.0))
+                accs.append(acc)
+                block.append_op("elementwise_add",
+                                inputs={"X": [acc], "Y": [g]},
+                                outputs={"Out": [acc]},
+                                attrs={"op_role": "backward"})
+                eff = block.create_var(
+                    name=unique_name.generate(g.name + "_gm_eff"),
+                    dtype=p.dtype, stop_gradient=True)
+                block.append_op("scale", inputs={"X": [acc]},
+                                outputs={"Out": [eff]},
+                                attrs={"scale": scale,
+                                       "op_role": "backward"})
+                merged.append((p, block.var(eff.name)))
+
+            # snapshot params BEFORE the inner update
+            def _snapshot(var):
+                snap = block.create_var(
+                    name=unique_name.generate(var.name + "_gm_snap"),
+                    dtype=var.dtype, stop_gradient=True)
+                block.append_op("assign", inputs={"X": [var]},
+                                outputs={"Out": [snap]},
+                                attrs={"op_role": "optimize"})
+                return snap
+
+            param_snaps = [(p, _snapshot(p)) for p, _ in merged]
+            pre_acc_names = {v.name for accs_ in
+                             self.inner_optimizer._accumulators.values()
+                             for v in accs_.values()}
+            optimize_ops = self.inner_optimizer.apply_gradients(merged)
+            # accumulators may have been created during apply_gradients —
+            # they were zero-initialized, so snapshotting them BEFORE is
+            # impossible; snapshot-after + revert uses the pre-update value
+            # captured by the assign ops we insert before their update ops.
+            # Simpler and correct: blend params and all inner accumulators
+            # against their pre-update snapshots taken now for pre-existing
+            # ones; fresh accumulators get snapshots equal to their init
+            # value stored at startup.
+            acc_vars = [v for accs_ in
+                        self.inner_optimizer._accumulators.values()
+                        for v in accs_.values()
+                        if not isinstance(v, (int, float))]
+            # blend: state = gate*state + (1-gate)*snapshot
+            def _select(var, snap):
+                keep = block.create_var(
+                    name=unique_name.generate(var.name + "_gm_keep"),
+                    dtype=var.dtype, stop_gradient=True)
+                block.append_op("elementwise_mul",
+                                inputs={"X": [var], "Y": [gate]},
+                                outputs={"Out": [keep]},
+                                attrs={"axis": -1, "op_role": "optimize"})
+                old = block.create_var(
+                    name=unique_name.generate(var.name + "_gm_old"),
+                    dtype=var.dtype, stop_gradient=True)
+                inv_gate = block.create_var(
+                    name=unique_name.generate("gm_invg"), dtype="float32",
+                    stop_gradient=True)
+                block.append_op(
+                    "scale", inputs={"X": [gate]},
+                    outputs={"Out": [inv_gate]},
+                    attrs={"scale": -1.0, "bias": 1.0,
+                           "op_role": "optimize"})
+                block.append_op("elementwise_mul",
+                                inputs={"X": [snap], "Y": [inv_gate]},
+                                outputs={"Out": [old]},
+                                attrs={"axis": -1, "op_role": "optimize"})
+                block.append_op("elementwise_add",
+                                inputs={"X": [keep], "Y": [old]},
+                                outputs={"Out": [var]},
+                                attrs={"op_role": "optimize"})
+
+            for p, snap in param_snaps:
+                _select(p, snap)
+            # NOTE on accumulators: snapshots for them must be taken before
+            # apply_gradients emits their update ops.  We re-walk: for any
+            # accumulator created by apply_gradients, insert its snapshot
+            # assign right after backward (it is zero there on step 1 and
+            # carries the previous boundary's value later) — achieved by
+            # snapshotting NOW into persistable buffers that are updated
+            # only on boundaries: state_snap = gate*state + (1-gate)*snap
+            # (i.e. snap tracks the last boundary value).
+            for acc_var in acc_vars:
+                snap = helper.create_global_variable(
+                    name=unique_name.generate(acc_var.name + "_gm_snap"),
+                    shape=list(acc_var.shape) if acc_var.shape else None,
+                    dtype=acc_var.dtype, persistable=True,
+                    stop_gradient=True)
+                # snap must start EQUAL to the accumulator's own init (e.g.
+                # Adam's beta_pow starts at beta, not 0) — copy it in the
+                # startup program after the accumulator initializes
+                sb = helper.startup_program.global_block()
+                sb.create_var(name=snap.name, shape=snap.shape,
+                              dtype=snap.dtype, persistable=True)
+                sb.append_op("assign", inputs={"X": [acc_var.name]},
+                             outputs={"Out": [snap.name]}, attrs={})
+                # revert accumulator off-boundary to its last-boundary value
+                _select(acc_var, snap)
+                # then refresh the snapshot to the (possibly reverted) value
+                block.append_op("assign", inputs={"X": [acc_var]},
+                                outputs={"Out": [snap]},
+                                attrs={"op_role": "optimize"})
+            # clear merged-grad accumulators on boundaries
+            for acc in accs:
+                inv_gate2 = block.create_var(
+                    name=unique_name.generate("gm_invg2"), dtype="float32",
+                    stop_gradient=True)
+                block.append_op("scale", inputs={"X": [gate]},
+                                outputs={"Out": [inv_gate2]},
+                                attrs={"scale": -1.0, "bias": 1.0,
+                                       "op_role": "optimize"})
+                block.append_op("elementwise_mul",
+                                inputs={"X": [acc], "Y": [inv_gate2]},
+                                outputs={"Out": [acc]},
+                                attrs={"axis": -1, "op_role": "optimize"})
+            # DP transpilers must allreduce the RAW micro-grads (before
+            # accumulation), not the gated merged ones
+            program._params_grads = [(p.name, g.name)
+                                     for p, g in params_grads]
+        return optimize_ops, params_grads
 
 
 class PipelineOptimizer:

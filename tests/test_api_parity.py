@@ -328,3 +328,101 @@ def test_data_feeder_shape_bucketing():
     big = [(np.ones((20, 2), "float32"), np.ones(3, "float32"))]
     with pytest.raises(ValueError):
         feeder.feed(big)
+
+
+def test_gradient_merge_optimizer():
+    """k-step gradient accumulation (reference multi_batch_merge_pass):
+    params freeze between boundaries and the merged step equals one SGD
+    step on the averaged gradient."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("gm_x", [4, 3], False, dtype="float32")
+        y = fluid.data("gm_y", [4, 1], False, dtype="float32")
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.5), k_steps=4)
+        opt.minimize(loss)
+    pname = main.all_parameters()[0].name
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.asarray(scope.get(pname)).copy()
+        rng = np.random.RandomState(0)
+        snaps = []
+        for step in range(8):
+            xv = rng.randn(4, 3).astype("float32")
+            yv = xv @ np.array([[1.], [2.], [3.]], "float32")
+            exe.run(main, feed={"gm_x": xv, "gm_y": yv},
+                    fetch_list=[loss.name])
+            snaps.append(np.asarray(scope.get(pname)).copy())
+    for s in range(3):
+        np.testing.assert_allclose(snaps[s], w0)
+    assert not np.allclose(snaps[3], w0)
+    np.testing.assert_allclose(snaps[4], snaps[3])
+    assert not np.allclose(snaps[7], snaps[3])
+
+
+def test_gradient_merge_adam_exact_equivalence():
+    """Merged k=4 Adam must EXACTLY match plain Adam on the concatenated
+    batches (stateful accumulators freeze off-boundary via snapshot
+    revert, incl. beta_pow whose init is nonzero)."""
+    def run(k, steps=8):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.data("ga_x", [-1, 3], False, dtype="float32")
+            y = fluid.data("ga_y", [-1, 1], False, dtype="float32")
+            pred = fluid.layers.fc(
+                x, 1, bias_attr=False,
+                param_attr=fluid.ParamAttr(
+                    initializer=fluid.initializer.Constant(0.1)))
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            base = fluid.optimizer.Adam(learning_rate=0.1)
+            opt = (fluid.optimizer.GradientMergeOptimizer(base, k_steps=k)
+                   if k > 1 else base)
+            opt.minimize(loss)
+        pname = main.all_parameters()[0].name
+        rng = np.random.RandomState(0)
+        W = np.array([[1.], [2.], [3.]], "float32")
+        data = [rng.randn(8, 3).astype("float32") for _ in range(steps)]
+        scope = fluid.Scope()
+        snaps = []
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            if k > 1:
+                for xv in data:
+                    exe.run(main, feed={"ga_x": xv, "ga_y": xv @ W},
+                            fetch_list=[loss.name])
+                    snaps.append(np.asarray(scope.get(pname)).copy())
+            else:
+                for i in range(0, steps, 4):
+                    xs = np.concatenate(data[i:i + 4])
+                    exe.run(main, feed={"ga_x": xs, "ga_y": xs @ W},
+                            fetch_list=[loss.name])
+                    snaps.append(np.asarray(scope.get(pname)).copy())
+        return snaps
+
+    merged, plain = run(4), run(1)
+    w0 = np.full((3, 1), 0.1, "float32")
+    np.testing.assert_allclose(merged[0], w0)   # frozen pre-boundary
+    np.testing.assert_allclose(merged[4], merged[3])
+    for b in range(2):
+        np.testing.assert_allclose(merged[4 * b + 3], plain[b],
+                                   rtol=3e-5, atol=3e-6)
+
+
+def test_gradient_merge_k1_passthrough():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("gm1_x", [2, 3], False, dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, 1))
+        opt = fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.1), k_steps=1)
+        opt.minimize(loss)
+    assert not any("gm_acc" in v for v in main.global_block().vars)
+    with pytest.raises(ValueError):
+        fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.1), k_steps=0)

@@ -17,7 +17,8 @@ from .tracer import VarBase, trace_op
 
 __all__ = ["Linear", "FC", "Conv2D", "Pool2D", "BatchNorm", "Embedding",
            "LayerNorm", "Dropout", "GRUUnit", "PRelu", "Conv2DTranspose",
-           "GroupNorm"]
+           "GroupNorm", "Conv3D", "Conv3DTranspose",
+           "BilinearTensorProduct", "SpectralNorm", "TreeConv", "NCE"]
 
 
 def _act(x, act):

@@ -780,22 +780,23 @@ class GradientMergeOptimizer:
                 loss, startup_program, parameter_list, no_grad_set)
             block = program.global_block()
             helper = LayerHelper("gradient_merge")
-            # int64 step counter: a float32 one saturates at 2^24 steps
+            # int32 step counter: float32 saturates at 2^24 steps, and
+            # int64 would truncate anyway without jax x64 mode
             counter = helper.create_global_variable(
                 name=unique_name.generate("gm_step"), shape=[1],
-                dtype="int64", persistable=True, stop_gradient=True)
+                dtype="int32", persistable=True, stop_gradient=True)
             helper.set_variable_initializer(counter, Constant(0.0))
             block.append_op("increment", inputs={"X": [counter]},
                             outputs={"Out": [counter]},
                             attrs={"step": 1.0, "op_role": "backward"})
             modk = block.create_var(
-                name=unique_name.generate("gm_mod"), dtype="int64",
+                name=unique_name.generate("gm_mod"), dtype="int32",
                 stop_gradient=True)
             block.append_op(
                 "elementwise_mod",
                 inputs={"X": [counter],
                         "Y": [tensor_mod.fill_constant(
-                            [1], "int64", self.k_steps)]},
+                            [1], "int32", self.k_steps)]},
                 outputs={"Out": [modk]}, attrs={"op_role": "backward"})
             gate_b = block.create_var(
                 name=unique_name.generate("gm_gate_b"), dtype="bool",
@@ -803,7 +804,7 @@ class GradientMergeOptimizer:
             block.append_op(
                 "equal",
                 inputs={"X": [modk],
-                        "Y": [tensor_mod.fill_constant([1], "int64", 0)]},
+                        "Y": [tensor_mod.fill_constant([1], "int32", 0)]},
                 outputs={"Out": [gate_b]}, attrs={"op_role": "backward"})
             gate = block.create_var(
                 name=unique_name.generate("gm_gate"), dtype="float32",
@@ -811,6 +812,13 @@ class GradientMergeOptimizer:
             block.append_op("cast", inputs={"X": [gate_b]},
                             outputs={"Out": [gate]},
                             attrs={"out_dtype": "float32",
+                                   "op_role": "backward"})
+            inv_gate = block.create_var(
+                name=unique_name.generate("gm_inv_gate"), dtype="float32",
+                stop_gradient=True)
+            block.append_op("scale", inputs={"X": [gate]},
+                            outputs={"Out": [inv_gate]},
+                            attrs={"scale": -1.0, "bias": 1.0,
                                    "op_role": "backward"})
 
             merged = []
@@ -848,24 +856,14 @@ class GradientMergeOptimizer:
                 return snap
 
             param_snaps = [(p, _snapshot(p)) for p, _ in merged]
-            pre_acc_names = {v.name for accs_ in
-                             self.inner_optimizer._accumulators.values()
-                             for v in accs_.values()}
             optimize_ops = self.inner_optimizer.apply_gradients(merged)
-            # accumulators may have been created during apply_gradients —
-            # they were zero-initialized, so snapshotting them BEFORE is
-            # impossible; snapshot-after + revert uses the pre-update value
-            # captured by the assign ops we insert before their update ops.
-            # Simpler and correct: blend params and all inner accumulators
-            # against their pre-update snapshots taken now for pre-existing
-            # ones; fresh accumulators get snapshots equal to their init
-            # value stored at startup.
             acc_vars = [v for accs_ in
                         self.inner_optimizer._accumulators.values()
                         for v in accs_.values()
                         if not isinstance(v, (int, float))]
-            # blend: state = gate*state + (1-gate)*snapshot
             def _select(var, snap):
+                """var = gate*var + (1-gate)*snap (boundary keeps the
+                update; off-boundary reverts to the snapshot)."""
                 keep = block.create_var(
                     name=unique_name.generate(var.name + "_gm_keep"),
                     dtype=var.dtype, stop_gradient=True)
@@ -876,14 +874,6 @@ class GradientMergeOptimizer:
                 old = block.create_var(
                     name=unique_name.generate(var.name + "_gm_old"),
                     dtype=var.dtype, stop_gradient=True)
-                inv_gate = block.create_var(
-                    name=unique_name.generate("gm_invg"), dtype="float32",
-                    stop_gradient=True)
-                block.append_op(
-                    "scale", inputs={"X": [gate]},
-                    outputs={"Out": [inv_gate]},
-                    attrs={"scale": -1.0, "bias": 1.0,
-                           "op_role": "optimize"})
                 block.append_op("elementwise_mul",
                                 inputs={"X": [snap], "Y": [inv_gate]},
                                 outputs={"Out": [old]},
@@ -895,14 +885,15 @@ class GradientMergeOptimizer:
 
             for p, snap in param_snaps:
                 _select(p, snap)
-            # NOTE on accumulators: snapshots for them must be taken before
-            # apply_gradients emits their update ops.  We re-walk: for any
-            # accumulator created by apply_gradients, insert its snapshot
-            # assign right after backward (it is zero there on step 1 and
-            # carries the previous boundary's value later) — achieved by
-            # snapshotting NOW into persistable buffers that are updated
-            # only on boundaries: state_snap = gate*state + (1-gate)*snap
-            # (i.e. snap tracks the last boundary value).
+            # accumulators (created inside apply_gradients) revert against
+            # PERSISTABLE snap buffers that always hold the last boundary
+            # value: blend first (off-boundary restores last boundary),
+            # then refresh the snap from the blended value
+            lr_counter = block.vars.get("@LR_DECAY_COUNTER@")
+            if lr_counter is not None:
+                # an LR schedule counts OPTIMIZER steps: advance once per
+                # boundary, not once per micro-batch
+                acc_vars = list(acc_vars) + [lr_counter]
             for acc_var in acc_vars:
                 snap = helper.create_global_variable(
                     name=unique_name.generate(acc_var.name + "_gm_snap"),
@@ -912,6 +903,7 @@ class GradientMergeOptimizer:
                 # snap must start EQUAL to the accumulator's own init (e.g.
                 # Adam's beta_pow starts at beta, not 0) — copy it in the
                 # startup program after the accumulator initializes
+                snap.is_optimizer_state = True  # ZeRO-1 shards these too
                 sb = helper.startup_program.global_block()
                 sb.create_var(name=snap.name, shape=snap.shape,
                               dtype=snap.dtype, persistable=True)
@@ -925,15 +917,8 @@ class GradientMergeOptimizer:
                                 attrs={"op_role": "optimize"})
             # clear merged-grad accumulators on boundaries
             for acc in accs:
-                inv_gate2 = block.create_var(
-                    name=unique_name.generate("gm_invg2"), dtype="float32",
-                    stop_gradient=True)
-                block.append_op("scale", inputs={"X": [gate]},
-                                outputs={"Out": [inv_gate2]},
-                                attrs={"scale": -1.0, "bias": 1.0,
-                                       "op_role": "optimize"})
                 block.append_op("elementwise_mul",
-                                inputs={"X": [acc], "Y": [inv_gate2]},
+                                inputs={"X": [acc], "Y": [inv_gate]},
                                 outputs={"Out": [acc]},
                                 attrs={"axis": -1, "op_role": "optimize"})
             # DP transpilers must allreduce the RAW micro-grads (before

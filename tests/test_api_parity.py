@@ -426,3 +426,28 @@ def test_gradient_merge_k1_passthrough():
     with pytest.raises(ValueError):
         fluid.optimizer.GradientMergeOptimizer(
             fluid.optimizer.SGD(learning_rate=0.1), k_steps=0)
+
+
+def test_gradient_merge_freezes_lr_schedule():
+    """A Variable LR schedule must advance once per BOUNDARY, not once per
+    micro-batch (the lr counter is snapshot/reverted like accumulators)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("gl_x", [2, 3], False, dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, 1))
+        lr = fluid.layers.exponential_decay(0.1, decay_steps=1,
+                                            decay_rate=0.5)
+        fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.SGD(learning_rate=lr), k_steps=4).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        counters = []
+        for _ in range(9):
+            exe.run(main, feed={"gl_x": np.ones((2, 3), "float32")},
+                    fetch_list=[loss.name])
+            counters.append(float(np.asarray(
+                scope.get("@LR_DECAY_COUNTER@")).ravel()[0]))
+    assert counters[2] == counters[0]
+    assert counters[7] == counters[3] + 1

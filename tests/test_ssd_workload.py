@@ -1,0 +1,80 @@
+"""Mini SSD detection workload end-to-end (reference book-style coverage
+for the detection family): multi_box_head priors + ssd_loss training on
+synthetic one-box images, then detection_output inference finds the box."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+
+
+def _make_batch(rng, n=8, size=32):
+    imgs = np.zeros((n, 1, size, size), dtype="float32")
+    gts = np.zeros((n, 1, 4), dtype="float32")
+    labels = np.ones((n, 1, 1), dtype="int32")
+    for i in range(n):
+        # a bright 8x8 square in one of 4 quadrant anchors
+        q = rng.randint(0, 4)
+        cy, cx = (8 if q < 2 else 24), (8 if q % 2 == 0 else 24)
+        imgs[i, 0, cy - 4:cy + 4, cx - 4:cx + 4] = 1.0
+        gts[i, 0] = [(cx - 6) / size, (cy - 6) / size,
+                     (cx + 6) / size, (cy + 6) / size]
+    return imgs, gts, labels
+
+
+def test_ssd_mini_trains_and_detects():
+    rng = np.random.RandomState(0)
+    size = 32
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        img = fluid.data("img", [-1, 1, size, size], False, dtype="float32")
+        gt_box = fluid.data("gt_box", [-1, 1, 4], False, dtype="float32")
+        gt_lbl = fluid.data("gt_lbl", [-1, 1, 1], False, dtype="int32")
+        c1 = fluid.layers.conv2d(img, 8, 3, stride=2, padding=1, act="relu")
+        c2 = fluid.layers.conv2d(c1, 16, 3, stride=2, padding=1, act="relu")
+        c3 = fluid.layers.conv2d(c2, 16, 3, stride=2, padding=1, act="relu")
+        locs, confs, boxes, variances = fluid.layers.multi_box_head(
+            inputs=[c2, c3], image=img, base_size=size, num_classes=2,
+            aspect_ratios=[[1.0], [1.0]], min_sizes=[8.0, 16.0],
+            max_sizes=[16.0, 24.0], flip=False, clip=True, offset=0.5)
+        loss = fluid.layers.reduce_mean(fluid.layers.ssd_loss(
+            locs, confs, gt_box, gt_lbl, boxes, variances))
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+
+    infer = main.clone(for_test=True)
+    with fluid.program_guard(infer, fluid.Program()):
+        det = fluid.layers.detection_output(
+            locs, fluid.layers.softmax(confs), boxes, variances,
+            nms_threshold=0.45, score_threshold=0.1, keep_top_k=4)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for step in range(120):
+        imgs, gts, labels = _make_batch(rng)
+        out = exe.run(main,
+                      feed={"img": imgs, "gt_box": gts, "gt_lbl": labels},
+                      fetch_list=[loss.name])
+        losses.append(float(np.asarray(out[0])))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+    imgs, gts, _ = _make_batch(rng, n=4)
+    det_out = np.asarray(exe.run(infer, feed={"img": imgs},
+                                 fetch_list=[det.name])[0])
+    # rows: (label, score, x1, y1, x2, y2); at least one confident
+    # class-1 detection overlapping the gt box for most images
+    hits = 0
+    for i in range(4):
+        rows = det_out[i]
+        cand = rows[(rows[:, 0] == 1) & (rows[:, 1] > 0.3)]
+        for row in cand:
+            bx = row[2:6]
+            g = gts[i, 0]
+            ix = max(0.0, min(bx[2], g[2]) - max(bx[0], g[0]))
+            iy = max(0.0, min(bx[3], g[3]) - max(bx[1], g[1]))
+            inter = ix * iy
+            union = ((bx[2] - bx[0]) * (bx[3] - bx[1])
+                     + (g[2] - g[0]) * (g[3] - g[1]) - inter)
+            if union > 0 and inter / union > 0.3:
+                hits += 1
+                break
+    assert hits >= 2, (hits, det_out[:, :2])

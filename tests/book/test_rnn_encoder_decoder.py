@@ -1,0 +1,109 @@
+"""Book 07: vanilla RNN encoder-decoder WITHOUT attention (reference
+tests/book/test_rnn_encoder_decoder.py: GRU encoder, decoder conditioned
+only on the encoder's final state — distinct from the attention+beam
+machine-translation book test).  Dense padded sequences + masked CE;
+decoder runs as one lax.scan via dynamic_gru."""
+
+import numpy as np
+
+from book_util import train_save_load_infer
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+DICT = 64
+EMB = 24
+HID = 32
+SRC_LEN = 8
+TRG_LEN = 8
+BATCH = 64
+BOS, EOS = paddle.dataset.wmt16.BOS, paddle.dataset.wmt16.EOS
+
+
+def _synthetic_pairs(seed=0, n=2048):
+    """Reversal task: target = reversed source (learnable without
+    attention via the thought vector)."""
+    rng = np.random.RandomState(seed)
+
+    def gen():
+        for _ in range(n):
+            L = rng.randint(3, SRC_LEN + 1)
+            src = rng.randint(4, DICT, L)
+            yield src, src[::-1]
+
+    return gen
+
+
+def to_feed(batch):
+    srcs, src_lens, trg_in, trg_out, masks = [], [], [], [], []
+    for src, trg in batch:
+        s = np.zeros(SRC_LEN, "int64")
+        s[:len(src)] = src
+        srcs.append(s)
+        src_lens.append(len(src))
+        ti = np.zeros(TRG_LEN, "int64")
+        to = np.zeros(TRG_LEN, "int64")
+        m = np.zeros(TRG_LEN, "float32")
+        t = list(trg)[: TRG_LEN - 1]
+        ti[0] = BOS
+        ti[1:1 + len(t)] = t
+        to[:len(t)] = t
+        to[len(t)] = EOS
+        m[:len(t) + 1] = 1.0
+        trg_in.append(ti)
+        trg_out.append(to)
+        masks.append(m)
+    return {"src": np.stack(srcs),
+            "src_len": np.asarray(src_lens, "int32"),
+            "trg_in": np.stack(trg_in), "trg_out": np.stack(trg_out),
+            "trg_mask": np.stack(masks)}
+
+
+def build():
+    src = fluid.layers.data(name="src", shape=[SRC_LEN], dtype="int64")
+    src_len = fluid.layers.data(name="src_len", shape=[], dtype="int32")
+    trg_in = fluid.layers.data(name="trg_in", shape=[TRG_LEN], dtype="int64")
+    trg_out = fluid.layers.data(name="trg_out", shape=[TRG_LEN],
+                                dtype="int64")
+    trg_mask = fluid.layers.data(name="trg_mask", shape=[TRG_LEN],
+                                 dtype="float32")
+    # encoder: embedding → GRU → final state (the thought vector)
+    src_emb = fluid.layers.embedding(src, size=[DICT, EMB])
+    enc = fluid.layers.dynamic_gru(
+        fluid.layers.fc(src_emb, 3 * HID, num_flatten_dims=2), HID,
+        length=src_len)
+    thought = fluid.layers.sequence_last_step(enc, length=src_len)  # [B,H]
+    # decoder: embedding ⊕ (broadcast thought) → GRU seeded with thought
+    trg_emb = fluid.layers.embedding(trg_in, size=[DICT, EMB])
+    ctx = fluid.layers.expand(
+        fluid.layers.unsqueeze(thought, axes=[1]), [1, TRG_LEN, 1])
+    dec_in = fluid.layers.concat([trg_emb, ctx], axis=2)
+    dec = fluid.layers.dynamic_gru(
+        fluid.layers.fc(dec_in, 3 * HID, num_flatten_dims=2), HID,
+        h_0=thought)
+    logits = fluid.layers.fc(dec, DICT, num_flatten_dims=2)
+    ce = fluid.layers.softmax_with_cross_entropy(
+        fluid.layers.reshape(logits, [-1, DICT]),
+        fluid.layers.reshape(trg_out, [-1, 1]))
+    m = fluid.layers.reshape(trg_mask, [-1, 1])
+    loss = fluid.layers.reduce_sum(ce * m) / (
+        fluid.layers.reduce_sum(m) + 1e-6)
+    sm = fluid.layers.softmax(logits)
+    return [src, src_len, trg_in], loss, sm
+
+
+def test_rnn_encoder_decoder(tmp_path):
+    gen = _synthetic_pairs()
+
+    def reader():
+        for b in paddle.batch(gen, BATCH, drop_last=True)():
+            yield to_feed(b)
+
+    losses = train_save_load_infer(
+        build, reader, tmp_path, epochs=10, lr=8e-3,
+        feed_names=["src", "src_len", "trg_in"])
+    # teacher-forced CE well below random (ln 64 ≈ 4.16); full reversal
+    # without attention converges slowly — require clear learning, not
+    # memorization
+    assert np.mean(losses[-4:]) < 2.2, np.mean(losses[-4:])
+    assert losses[-1] < losses[0] * 0.5

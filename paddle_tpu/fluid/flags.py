@@ -39,10 +39,12 @@ _DEFS = {
     "FLAGS_rpc_deadline": (180000, int, True),
     # persistent XLA compile cache (SURVEY §7 hard part 6: hide compile
     # latency behind a cache that survives processes).  Empty string
-    # disables; the executor applies it lazily on first compile.
-    "FLAGS_compile_cache_dir": (
-        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
-                     "xla_cache"), str, True),
+    # disables; the executor applies it lazily on first compile.  The
+    # default dir is fingerprinted by host CPU features: XLA:CPU AOT
+    # artifacts baked for one machine can SIGILL on another (observed
+    # loader warning), and jax's cache key does not cover host features.
+    # (callable default: resolved at bootstrap — host-dependent path)
+    "FLAGS_compile_cache_dir": (lambda: _default_cache_dir(), str, True),
     # accepted no-ops (CUDA/allocator knobs with no TPU meaning)
     "FLAGS_fraction_of_gpu_memory_to_use": (0.92, float, False),
     "FLAGS_eager_delete_tensor_gb": (-1.0, float, False),
@@ -57,9 +59,32 @@ _DEFS = {
 _VALUES = {}
 
 
+def _default_cache_dir():
+    """~/.cache/paddle_tpu/xla_cache/<host fingerprint> — the fingerprint
+    isolates XLA:CPU AOT artifacts per CPU feature set."""
+    import hashlib
+    import platform
+
+    sig = platform.machine() + "|" + platform.processor()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 uses "flags", ARM uses "Features"
+                if line.startswith(("flags", "Features")):
+                    sig += "|" + line.strip()
+                    break
+    except OSError:
+        pass
+    fp = hashlib.sha1(sig.encode()).hexdigest()[:12]
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "xla_cache", fp)
+
+
 def _bootstrap():
     """Seed flags from FLAGS_* env vars (reference __bootstrap__)."""
     for name, (default, parser, _impl) in _DEFS.items():
+        if callable(default):
+            default = default()
         _VALUES[name] = default
         env = os.environ.get(name)
         if env is None:

@@ -6,7 +6,7 @@ decoder runs as one lax.scan via dynamic_gru."""
 
 import numpy as np
 
-from book_util import train_save_load_infer
+from book_util import batched_feed, train_save_load_infer
 
 import paddle_tpu as paddle
 from paddle_tpu import fluid
@@ -93,12 +93,7 @@ def build():
 
 
 def test_rnn_encoder_decoder(tmp_path):
-    gen = _synthetic_pairs()
-
-    def reader():
-        for b in paddle.batch(gen, BATCH, drop_last=True)():
-            yield to_feed(b)
-
+    reader = batched_feed(_synthetic_pairs(), BATCH, to_feed)
     losses = train_save_load_infer(
         build, reader, tmp_path, epochs=10, lr=8e-3,
         feed_names=["src", "src_len", "trg_in"])

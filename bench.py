@@ -32,6 +32,65 @@ def _cpu_suffix():
     return " CPU-FALLBACK" if os.environ.get("PT_BENCH_FORCE_CPU") else ""
 
 
+# bf16 peak TFLOPs per chip by PJRT device_kind substring (public specs);
+# first match wins, so "v5 lite"/"v5e" must precede the bare "v5" (v5p)
+# entry.  Override with PT_TPU_PEAK_TFLOPS.  MFU is reported against this.
+_TPU_PEAK_TFLOPS = (
+    ("v6", 918.0), ("v5p", 459.0), ("v5e", 197.0), ("lite", 197.0),
+    ("v5", 459.0), ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+)
+
+
+def _peak_tflops():
+    """Chip peak in TFLOPs for MFU, or None (CPU / unknown kind)."""
+    env = os.environ.get("PT_TPU_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    if os.environ.get("PT_BENCH_FORCE_CPU"):
+        return None
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        # the axon PJRT plugin registers its TPU as platform "axon"
+        if dev.platform not in ("tpu", "axon"):
+            return None
+        kind = dev.device_kind.lower()
+        for pat, peak in _TPU_PEAK_TFLOPS:
+            if pat in kind:
+                return peak
+    except Exception:
+        pass
+    return None
+
+
+def _bert_train_flops_per_step(cfg, batch, seq_len):
+    """Analytic model FLOPs for one train step (fwd + bwd ≈ 3× fwd).
+
+    Per layer fwd: QKVO projections 8·b·s·h², FFN 4·b·s·h·i, attention
+    scores+context 4·b·s²·h.  MLM head runs over the M≈b·s/8 gathered
+    masked positions: transform 2·M·h² + vocab projection 2·M·h·V.
+    Embedding gathers ≈ 0 FLOPs."""
+    b, s = batch, seq_len
+    h, i, L, V = (cfg.hidden_size, cfg.intermediate_size, cfg.num_layers,
+                  cfg.vocab_size)
+    per_layer = 8 * b * s * h * h + 4 * b * s * h * i + 4 * b * s * s * h
+    m = b * max(1, s // 8)
+    head = 2 * m * h * h + 2 * m * h * V + 2 * b * h * h
+    return 3.0 * (L * per_layer + head)
+
+
+def _attach_flops(result, flops_per_step, n_steps, dt):
+    """Add achieved TFLOP/s (always) and MFU (when a chip peak is known)."""
+    tflops = flops_per_step * n_steps / dt / 1e12
+    result["tflops_per_sec"] = round(tflops, 2)
+    peak = _peak_tflops()
+    if peak:
+        result["mfu"] = round(tflops / peak, 4)
+        result["peak_tflops"] = peak
+    return result
+
+
 def _timed_steps(exe, prog, data, loss_name, n_steps):
     """Shared warmup + timed loop (fetch→numpy syncs the device, so each
     iteration is fully timed)."""
@@ -86,13 +145,16 @@ def measure_resnet(size):
     dt = _timed_steps(exe, main_prog, data, loss.name, n_steps)
     ips = n_steps * batch / dt
     config = f"resnet{depth} b{batch} {image[1]}x{image[2]}" + _cpu_suffix()
-    return {
+    # fwd FLOPs/image: resnet50@224 ≈ 4.1e9, resnet18@224 ≈ 1.8e9 (public
+    # figures), conv FLOPs scale with spatial area; train ≈ 3× fwd
+    fwd = (4.1e9 if depth == 50 else 1.8e9) * (image[1] / 224.0) ** 2
+    return _attach_flops({
         "metric": f"resnet{depth}_train_images_per_sec",
         "value": round(ips, 1),
         "unit": "images/sec/chip",
         "vs_baseline": _vs_baseline(ips, config, is_headline=size != "tiny"),
         "config": config,
-    }
+    }, 3.0 * fwd * batch, n_steps, dt)
 
 
 def measure_gpt_decode(size):
@@ -191,7 +253,7 @@ def measure(size):
     config = (f"bert-{size} b{batch} s{seq_len}"
               + (" flash" if flash else "") + (" bf16" if amp else "")
               + _cpu_suffix())
-    return {
+    return _attach_flops({
         "metric": f"bert_{size}_pretrain_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
@@ -199,7 +261,33 @@ def measure(size):
                                     is_headline=size == "base",
                                     default_metric=True),
         "config": config,
-    }
+    }, _bert_train_flops_per_step(cfg, batch, seq_len), n_steps, dt)
+
+
+def _probe_device(budget):
+    """Ask a short-timeout child whether jax.devices() answers at all.
+    The axon TPU tunnel is known to wedge so hard that even device
+    enumeration hangs for hours; burning the whole bench budget discovering
+    that (round 1's failure) is worse than jumping straight to the
+    clearly-labeled CPU rung.  Returns the platform string or None."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+            env=dict(os.environ), capture_output=True, text=True,
+            timeout=budget)
+    except subprocess.TimeoutExpired:
+        print(f"bench: device probe HUNG for {budget:.0f}s (wedged tunnel)",
+              file=sys.stderr)
+        return None
+    for ln in out.stdout.splitlines():
+        if ln.startswith("PLATFORM="):
+            return ln.split("=", 1)[1]
+    # fast failure ≠ hang: surface the child's actual error (e.g. a PJRT
+    # plugin registration problem) instead of misdiagnosing a wedge
+    print(f"bench: device probe FAILED rc={out.returncode}\n"
+          + out.stderr[-2000:], file=sys.stderr)
+    return None
 
 
 def main():
@@ -207,26 +295,45 @@ def main():
         print(json.dumps(measure(os.environ["PT_BENCH_CHILD"])), flush=True)
         return
 
-    timeout = float(os.environ.get("PT_BENCH_TIMEOUT", "1500"))
+    # PT_BENCH_TIMEOUT is the TOTAL budget for the whole ladder (the driver
+    # kills us somewhere around it).  Round 1's bug: the first rung alone
+    # got the full budget, so the fallback rungs never ran.  Now every rung
+    # gets a slice, a global deadline caps each slice to what's actually
+    # left, and enough is always reserved for the terminal CPU rung.
+    total = float(os.environ.get("PT_BENCH_TIMEOUT", "1500"))
+    deadline = time.time() + total * 0.92
+    cpu_reserve = min(300.0, total * 0.20)
     model = os.environ.get("PT_BENCH_MODEL", "bert")
-    # fallback ladder: headline → smaller working set (per model: bert/
-    # resnet default b128 halve to b64; gpt decode defaults b16 halve to
-    # b8) → tiny model.  A wedged/slow device tunnel is a known environment
-    # failure mode; each rung still reports a REAL number.
+
+    platform = _probe_device(min(90.0, total * 0.08))
+    if platform is None:
+        print("bench: no usable device — going straight to the CPU rung",
+              file=sys.stderr)
+
     mid_batch = "8" if model == "gpt" else "64"
-    ladder = (
-        ("base", {}, timeout),
+    device_ladder = (
+        ("base", {}, total * 0.40),
         ("base", {"PT_BENCH_BATCH": mid_batch, "PT_BENCH_STEPS": "6"},
-         min(timeout, 700.0)),
-        ("tiny", {}, min(timeout, 400.0)),
-        # device unreachable: measure on CPU, clearly labeled in config
-        ("tiny", {"PT_BENCH_FORCE_CPU": "1", "PT_BENCH_BATCH": "8",
-                  "PT_BENCH_STEPS": "3"}, min(timeout, 400.0)),
+         total * 0.22),
+        ("tiny", {}, total * 0.14),
     )
-    for size, overrides, budget in ladder:
-        env = dict(os.environ, PT_BENCH_CHILD=size, **overrides)
+    cpu_rung = ("tiny", {"PT_BENCH_FORCE_CPU": "1", "PT_BENCH_BATCH": "8",
+                         "PT_BENCH_STEPS": "3"}, cpu_reserve)
+    ladder = ((*device_ladder, cpu_rung) if platform is not None
+              else (cpu_rung,))
+    for size, overrides, alloc in ladder:
+        is_cpu_rung = "PT_BENCH_FORCE_CPU" in overrides
+        # the terminal CPU rung is the last chance at a real number: give
+        # it ALL remaining time, not just its nominal reservation
+        budget = (deadline - time.time() if is_cpu_rung
+                  else min(alloc, deadline - time.time() - cpu_reserve))
         label = size + ("" if not overrides else
                         " b" + overrides.get("PT_BENCH_BATCH", "?"))
+        if budget < (10.0 if is_cpu_rung else 30.0):
+            print(f"bench: skipping {label} (only {budget:.0f}s left)",
+                  file=sys.stderr)
+            continue
+        env = dict(os.environ, PT_BENCH_CHILD=size, **overrides)
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,

@@ -43,11 +43,11 @@ _TPU_PEAK_TFLOPS = (
 
 def _peak_tflops():
     """Chip peak in TFLOPs for MFU, or None (CPU / unknown kind)."""
+    if os.environ.get("PT_BENCH_FORCE_CPU"):
+        return None  # MFU vs a TPU peak is meaningless for a CPU number
     env = os.environ.get("PT_TPU_PEAK_TFLOPS")
     if env:
         return float(env)
-    if os.environ.get("PT_BENCH_FORCE_CPU"):
-        return None
     try:
         import jax
 
@@ -305,8 +305,17 @@ def main():
     cpu_reserve = min(300.0, total * 0.20)
     model = os.environ.get("PT_BENCH_MODEL", "bert")
 
-    platform = _probe_device(min(90.0, total * 0.08))
-    if platform is None:
+    probe_budget = float(os.environ.get("PT_BENCH_PROBE_TIMEOUT",
+                                        min(90.0, total * 0.08)))
+    platform = _probe_device(probe_budget)
+    if platform == "cpu":
+        # jax fell back to host CPU (accelerator plugin absent/broken):
+        # running the device ladder there would record unlabeled CPU
+        # numbers against a TPU baseline — use the labeled CPU rung
+        print("bench: probe found only host CPU — using the labeled "
+              "CPU rung", file=sys.stderr)
+        platform = None
+    elif platform is None:
         print("bench: no usable device — going straight to the CPU rung",
               file=sys.stderr)
 

@@ -317,6 +317,26 @@ class BlockPlan:
 _cache_dir_last = object()  # sentinel: not yet applied
 
 
+def _purge_prefingerprint_cache(cache_dir):
+    """Delete loose cache entries left in the parent xla_cache/ dir by
+    versions that predated per-host-CPU fingerprinting: XLA:CPU AOT
+    artifacts baked for another machine make the loader warn (and can
+    SIGILL) on every run that touches them."""
+    import os as _os
+
+    parent = _os.path.dirname(cache_dir)
+    if _os.path.basename(parent) != "xla_cache":
+        return  # custom cache dir: nothing to migrate
+    try:
+        for name in _os.listdir(parent):
+            path = _os.path.join(parent, name)
+            if (name.endswith(("-cache", "-atime"))
+                    and _os.path.isfile(path)):
+                _os.unlink(path)
+    except OSError:
+        pass
+
+
 def _apply_compile_cache():
     """Point jax at a persistent on-disk compilation cache
     (FLAGS_compile_cache_dir; SURVEY §7 hard part 6) so re-runs of the same
@@ -339,6 +359,7 @@ def _apply_compile_cache():
         import os as _os
 
         _os.makedirs(cache_dir, exist_ok=True)
+        _purge_prefingerprint_cache(cache_dir)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # cache everything that took meaningful compile time
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,69 @@
+"""Pin resolution of the REFERENCE's public API surface.
+
+tests/data/reference_api_names.txt is a snapshot of the first column of the
+reference's paddle/fluid/API.spec (the frozen public surface its CI diffs
+via tools/diff_api.py).  Every dotted name there must resolve on paddle_tpu
+— this is the compatibility contract a reference user relies on when
+switching.  A regression that silently drops one of these names fails here.
+"""
+
+import pathlib
+
+import pytest
+
+import paddle_tpu
+import paddle_tpu.fluid  # noqa: F401 — populate the package tree
+
+NAMES_FILE = pathlib.Path(__file__).parent / "data" / "reference_api_names.txt"
+
+# names in the reference spec that intentionally do not resolve here, with
+# the reason; growing this list is an explicit decision, not an accident
+KNOWN_UNRESOLVED = {
+    # artifact of the reference's spec generator leaking a decorator
+    # internals attribute (wrap_decorator's __impl__), not a real API
+    "paddle.fluid.dygraph.__impl__",
+}
+
+
+def _resolve(dotted):
+    parts = dotted.split(".")
+    assert parts[0] == "paddle"
+    obj = paddle_tpu
+    for part in parts[1:]:
+        try:
+            obj = getattr(obj, part)
+        except AttributeError:
+            return None
+    return obj
+
+
+def _load_names():
+    return [ln.strip() for ln in NAMES_FILE.read_text().splitlines()
+            if ln.strip()]
+
+
+def test_reference_api_names_resolve():
+    names = _load_names()
+    assert len(names) >= 1000, "snapshot file truncated?"
+    missing = [n for n in names
+               if n not in KNOWN_UNRESOLVED and _resolve(n) is None]
+    assert not missing, (
+        f"{len(missing)} reference API names no longer resolve "
+        f"(first 20): {missing[:20]}")
+
+
+def test_known_unresolved_is_tight():
+    """If a KNOWN_UNRESOLVED name starts resolving, shrink the list."""
+    fixed = [n for n in KNOWN_UNRESOLVED if _resolve(n) is not None]
+    assert not fixed, f"now resolve — remove from KNOWN_UNRESOLVED: {fixed}"
+
+
+@pytest.mark.parametrize("name", [
+    "paddle.fluid.layers.fc",
+    "paddle.fluid.Program.clone",
+    "paddle.fluid.optimizer.AdamOptimizer",
+    "paddle.fluid.io.save_inference_model",
+    "paddle.fluid.transpiler.DistributeTranspiler",
+])
+def test_spot_names_are_in_snapshot(name):
+    assert name in _load_names()

@@ -227,6 +227,7 @@ def measure(size):
     n_steps = int(os.environ.get("PT_BENCH_STEPS", "10"))
     flash = os.environ.get("PT_BENCH_FLASH", "0") == "1"
     amp = os.environ.get("PT_BENCH_AMP", "0") == "1"
+    bf16 = os.environ.get("PT_BENCH_BF16", "0") == "1"
     kw = dict(vocab_size=30528,  # pad vocab to /64 for MXU
               use_flash_attention=flash,
               attn_dropout=0.0 if flash else 0.1)
@@ -243,6 +244,12 @@ def measure(size):
 
             opt = mp.decorate(opt)  # bf16 compute, fp32 master weights
         opt.minimize(loss)
+    if bf16:
+        # the dtype POLICY (bf16 compute, fp32 master weights) — the perf
+        # path; PT_BENCH_AMP is the reference-style cast-insertion rewrite
+        from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+        mp.enable_bf16_policy(main_prog)
 
     exe = fluid.Executor()
     exe.run(startup)
@@ -251,8 +258,8 @@ def measure(size):
 
     tokens_per_sec = n_steps * batch * seq_len / dt
     config = (f"bert-{size} b{batch} s{seq_len}"
-              + (" flash" if flash else "") + (" bf16" if amp else "")
-              + _cpu_suffix())
+              + (" flash" if flash else "") + (" amp" if amp else "")
+              + (" bf16" if bf16 else "") + _cpu_suffix())
     return _attach_flops({
         "metric": f"bert_{size}_pretrain_tokens_per_sec",
         "value": round(tokens_per_sec, 1),

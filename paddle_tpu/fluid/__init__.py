@@ -47,6 +47,8 @@ from . import contrib  # noqa: F401
 from . import incubate  # noqa: F401
 from . import transpiler  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from . import communicator  # noqa: F401
+from .communicator import Communicator  # noqa: F401
 
 # reference exposes DataLoader under fluid.io as well
 io.DataLoader = DataLoader

@@ -1,0 +1,132 @@
+"""Async-mode Communicator: background gradient send thread with merging.
+
+Reference: python/paddle/fluid/communicator.py (wrapper over the C++
+AsyncCommunicator, operators/distributed/communicator.h:160) — per-grad
+send queues, a thread pool that merges up to `max_merge_var_num` pending
+grads (mean) before each RPC, used inside the fleet API for async
+parameter-server training.
+
+TPU-native shape: the trainer's `send` host op hands its grad to the active
+Communicator instead of issuing a blocking RPC; a daemon thread drains the
+queues, merges, and sends.  Flags (same names as the reference's env knobs):
+FLAGS_communicator_max_merge_var_num, FLAGS_communicator_send_queue_size.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .framework import Program
+
+__all__ = ["Communicator"]
+
+_active_comm = None
+_active_lock = threading.Lock()
+
+
+def _active():
+    return _active_comm
+
+
+class Communicator:
+    def __init__(self, program, max_merge_var_num=None, send_queue_size=None):
+        """Scan the transpiled trainer `program` for send ops; grads sent to
+        those (varname, endpoint) pairs are queued + merged instead of sent
+        inline.  Start before training, stop after (reference
+        communicator.py Communicator.start/stop)."""
+        from . import flags
+
+        if max_merge_var_num is None:
+            max_merge_var_num = flags.flag("communicator_max_merge_var_num")
+        if send_queue_size is None:
+            send_queue_size = flags.flag("communicator_send_queue_size")
+        assert isinstance(program, Program)
+        self._targets = set()
+        for op in program.global_block().ops:
+            if op.type == "send":
+                self._targets.add((op.attrs.get("varname",
+                                                op.input("X")[0]),
+                                   op.attrs["endpoint"]))
+        self._max_merge = int(max_merge_var_num)
+        self._queues = {t: queue.Queue(maxsize=int(send_queue_size))
+                        for t in self._targets}
+        self._running = False
+        self._thread = None
+        self._error = None
+
+    def is_running(self):
+        return self._running
+
+    def start(self):
+        global _active_comm
+        with _active_lock:
+            if _active_comm is not None and _active_comm is not self:
+                raise RuntimeError("another Communicator is already running")
+            _active_comm = self
+        self._running = True
+        self._thread = threading.Thread(target=self._send_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        global _active_comm
+        self._running = False
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        with _active_lock:
+            if _active_comm is self:
+                _active_comm = None
+
+    def push(self, varname, arr, endpoint) -> bool:
+        """Called by the send host op.  True = queued (the communicator owns
+        delivery); False = not a managed target, send inline.  A dead send
+        thread surfaces its error here rather than blocking the trainer
+        forever on a full queue."""
+        if self._error is not None:
+            raise RuntimeError(
+                "Communicator send thread died") from self._error
+        if not self._running:
+            return False
+        q = self._queues.get((varname, endpoint))
+        if q is None:
+            return False
+        while True:
+            try:
+                q.put(np.asarray(arr), timeout=1.0)
+                return True
+            except queue.Full:
+                if self._error is not None:
+                    raise RuntimeError(
+                        "Communicator send thread died") from self._error
+
+    def _send_loop(self):
+        from paddle_tpu.ops import dist_ops
+
+        try:
+            while True:
+                idle = True
+                for (varname, endpoint), q in self._queues.items():
+                    parts = []
+                    while len(parts) < self._max_merge:
+                        try:
+                            parts.append(q.get_nowait())
+                        except queue.Empty:
+                            break
+                    if not parts:
+                        continue
+                    idle = False
+                    merged = (parts[0] if len(parts) == 1
+                              else np.mean(parts, axis=0, dtype=np.float32))
+                    dist_ops.get_channel(endpoint).client.send_grad(varname,
+                                                                    merged)
+                if idle:
+                    if not self._running:
+                        return  # drained after stop()
+                    time.sleep(0.002)
+        except Exception as e:  # surface via push(); never die silently
+            self._error = e
+            self._running = False

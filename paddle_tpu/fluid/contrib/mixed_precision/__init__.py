@@ -1,6 +1,10 @@
 from .decorator import OptimizerWithMixedPrecision, decorate  # noqa: F401
 from .fp16_lists import AutoMixedPrecisionLists  # noqa: F401
 from .fp16_utils import rewrite_program  # noqa: F401
+from .bf16_policy import (  # noqa: F401
+    bf16_policy_enabled, disable_bf16_policy, enable_bf16_policy,
+)
 
 __all__ = ["decorate", "OptimizerWithMixedPrecision", "AutoMixedPrecisionLists",
-           "rewrite_program"]
+           "rewrite_program", "enable_bf16_policy", "disable_bf16_policy",
+           "bf16_policy_enabled"]

@@ -1,0 +1,49 @@
+"""bf16 dtype POLICY — the TPU-native mixed-precision fast path.
+
+The reference's AMP (decorator.py:194 rewrite_program) inserts cast ops
+around a white/black list, which on TPU only adds HBM cast traffic (XLA
+already runs fp32 matmuls as bf16 MXU passes).  The policy here instead
+changes the dtype AT THE LOWERING (executor.trace_block): forward/backward
+compute runs in bfloat16 end to end — weights and activations move through
+HBM at half width — while optimizer ops keep fp32 master weights and a
+small blocklist (losses, softmax, norm statistics) computes in fp32
+islands.  No program rewrite, no cast-op churn: XLA fuses the few
+remaining dtype conversions into their consumers.
+
+Use `decorate(...)` (cast-insertion AMP + dynamic loss scaling) when you
+need reference-exact AMP semantics; use `enable_bf16_policy(program)` when
+you want speed.  bf16's fp32-sized exponent makes loss scaling
+unnecessary, so the policy composes with any plain optimizer.
+"""
+
+from __future__ import annotations
+
+__all__ = ["enable_bf16_policy", "disable_bf16_policy", "bf16_policy_enabled"]
+
+
+def enable_bf16_policy(program=None):
+    """Run this program's compute in bfloat16 (fp32 master weights).
+    Applies at the next compile; programs already compiled at another
+    policy recompile on first run (the policy is part of program state)."""
+    from paddle_tpu.fluid.framework import default_main_program
+
+    program = program if program is not None else default_main_program()
+    program._dtype_policy = "bf16"
+    program._bump_version()  # policy changes the traced computation
+    return program
+
+
+def disable_bf16_policy(program=None):
+    from paddle_tpu.fluid.framework import default_main_program
+
+    program = program if program is not None else default_main_program()
+    program._dtype_policy = None
+    program._bump_version()
+    return program
+
+
+def bf16_policy_enabled(program=None):
+    from paddle_tpu.fluid.framework import default_main_program
+
+    program = program if program is not None else default_main_program()
+    return getattr(program, "_dtype_policy", None) == "bf16"

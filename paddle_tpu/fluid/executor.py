@@ -136,6 +136,52 @@ def _gather_inputs(op, info, env, optional_ok=True):
     return vals
 
 
+# numerically sensitive ops that stay fp32 islands under the bf16 policy:
+# inputs are upcast, the lowering runs in fp32, float outputs are cast back
+# to bf16 so the chain stays narrow (losses/softmax/norm statistics — the
+# standard mixed-precision blocklist, reference fp16_lists.py black_list)
+_BF16_FP32_OPS = frozenset({
+    "softmax", "softmax_with_cross_entropy", "cross_entropy",
+    "cross_entropy2", "mean", "reduce_mean", "batch_norm", "layer_norm",
+    "log_softmax", "sigmoid_cross_entropy_with_logits",
+})
+
+
+def _map_floats(vals, fn):
+    import jax.numpy as jnp
+
+    def one(v):
+        if v is None:
+            return None
+        if isinstance(v, (list, tuple)):
+            return [one(x) for x in v]
+        try:
+            dt = jnp.asarray(v).dtype
+        except TypeError:
+            return v
+        return fn(v, dt)
+    return [one(v) for v in vals]
+
+
+def _apply_bf16_policy(op, vals):
+    """The bf16 dtype policy, applied at the lowering (NOT a program
+    rewrite): forward/backward compute runs in bfloat16 — halved HBM
+    traffic for weights/activations, native MXU dtype — while optimizer
+    ops and the _BF16_FP32_OPS islands see fp32 (params in env are the
+    fp32 master copies; grads are upcast at the optimizer edge, the one
+    place precision pays).  fp32 islands need no output downcast: any
+    bf16 consumer casts its own inputs down, so the chain stays narrow
+    and the loss fetch stays fp32."""
+    import jax.numpy as jnp
+
+    role = op.attrs.get("op_role")
+    if role == "optimize" or op.type in _BF16_FP32_OPS:
+        return _map_floats(vals, lambda v, dt: (
+            jnp.asarray(v, jnp.float32) if dt == jnp.bfloat16 else v))
+    return _map_floats(vals, lambda v, dt: (
+        jnp.asarray(v, jnp.bfloat16) if dt == jnp.float32 else v))
+
+
 def trace_block(block, env, ctx, ops=None):
     """Trace every op of `block` into JAX ops, mutating `env` (name→array).
 
@@ -144,11 +190,14 @@ def trace_block(block, env, ctx, ops=None):
     """
     ctx.block = block
     ctx.env = env
+    policy = getattr(ctx, "dtype_policy", None)
     for op_index, op in enumerate(block.ops if ops is None else ops):
         if op.type in ("feed", "fetch"):
             continue
         info = registry.get_op(op.type)
         vals = _gather_inputs(op, info, env)
+        if policy == "bf16":
+            vals = _apply_bf16_policy(op, vals)
         ctx.op_index = (block.idx << 16) | op_index
         out = info.lower(ctx, *vals, attrs=op.attrs)
         outs = out if isinstance(out, tuple) else (out,)
@@ -230,9 +279,16 @@ class BlockPlan:
         self.fetch_names = list(fetch_names)
         all_ops = _prune_ops(block, fetch_names)
         # host ops (RPC send/recv, listen_and_serv, ...) run outside the
-        # jitted computation, after it, in program order
-        self.host_ops = [op for op in all_ops
-                         if registry.get_op(op.type).host_run is not None]
+        # jitted computation, in program order.  "pre"-stage host ops run
+        # BEFORE the device step and produce jit inputs (e.g. distributed
+        # embedding lookup fetching rows for the fed ids); "post"-stage run
+        # after it and consume jit outputs (e.g. grad sends).
+        host = [op for op in all_ops
+                if registry.get_op(op.type).host_run is not None]
+        self.host_pre_ops = [op for op in host
+                             if registry.get_op(op.type).host_stage == "pre"]
+        self.host_ops = [op for op in host
+                         if registry.get_op(op.type).host_stage != "pre"]
         self.ops = [op for op in all_ops
                     if registry.get_op(op.type).host_run is None]
         scope_reads, writes = _analyze_block(self.ops, block, self.feed_names)
@@ -245,7 +301,12 @@ class BlockPlan:
             for n in hop.input_arg_names:
                 if n in jit_produced and n not in writes:
                     writes.append(n)
-        missing = [n for n in scope_reads if scope.get(n) is None]
+        pre_out = set()
+        for hop in self.host_pre_ops:
+            pre_out.update(hop.output_arg_names)
+        self._host_pre_out = pre_out
+        missing = [n for n in scope_reads
+                   if n not in pre_out and scope.get(n) is None]
         if missing:
             raise RuntimeError(
                 f"Variables {missing} must exist in scope before running this "
@@ -282,6 +343,7 @@ class BlockPlan:
         program, block, ops = self.program, self.block, self.ops
         fetch_names, write_names = self.jit_fetch_names, self.write_names
         is_test = getattr(program, "_is_test", False)
+        dtype_policy = getattr(program, "_dtype_policy", None)
 
         def fn(donated, readonly, feeds, step):
             env = {}
@@ -291,6 +353,7 @@ class BlockPlan:
             ctx = registry.LowerContext(step=step, is_test=is_test,
                                         block=block, mesh_axes=mesh_axes)
             ctx.program = program
+            ctx.dtype_policy = dtype_policy
             trace_block(block, env, ctx, ops=ops)
             fetches = [env[n] for n in fetch_names]
             out_writes = {n: env[n] for n in write_names if n in env}
@@ -298,11 +361,23 @@ class BlockPlan:
 
         return fn
 
-    def run_host_ops(self, scope, place=None):
+    def run_host_ops(self, scope, place=None, feeds=None):
         """Run the block's host ops (RPC/IO) in program order, after the
-        device step.  They read/write the scope directly."""
+        device step.  They read/write the scope directly; feed values are
+        visible to reads (a sparse grad send needs the fed ids)."""
+        view = _FeedScopeView(scope, feeds) if feeds else scope
         for op in self.host_ops:
-            registry.get_op(op.type).host_run(scope, op, place)
+            registry.get_op(op.type).host_run(view, op, place)
+
+    def run_host_pre_ops(self, scope, feeds, place=None):
+        """Run "pre"-stage host ops before the device step.  They see feed
+        values transparently (reads check feeds first, writes go to scope) —
+        a distributed lookup consumes fed ids that never enter the scope."""
+        if not self.host_pre_ops:
+            return
+        view = _FeedScopeView(scope, feeds)
+        for op in self.host_pre_ops:
+            registry.get_op(op.type).host_run(view, op, place)
 
     def assemble_fetches(self, jit_fetches, scope):
         """Merge jit fetches with host-op-produced ones (read from scope,
@@ -369,6 +444,23 @@ def _apply_compile_cache():
         warnings.warn(f"persistent compile cache disabled: {e}")
 
 
+class _FeedScopeView:
+    """Scope facade for pre-stage host ops: get() resolves feed values
+    first, set() always lands in the real scope."""
+
+    def __init__(self, scope, feeds):
+        self._scope = scope
+        self._feeds = feeds or {}
+
+    def get(self, name):
+        if name in self._feeds:
+            return self._feeds[name]
+        return self._scope.get(name)
+
+    def set(self, name, value):
+        self._scope.set(name, value)
+
+
 class _CompiledBlock:
     """One (program-version, feed-signature) → jitted XLA executable."""
 
@@ -395,6 +487,9 @@ class _CompiledBlock:
         from . import profiler as _prof
 
         with _prof.timed_run(self.label, self._prof_state) as timer:
+            # pre-stage host ops (distributed lookup/prefetch) populate the
+            # scope vars the device step is about to read
+            self.plan.run_host_pre_ops(scope, feeds, self.place)
             device = self.place.jax_device()
             donated = {}
             for n in self.donated_names:
@@ -423,7 +518,7 @@ class _CompiledBlock:
         if _flags.flag("check_nan_inf"):
             self._check_nan_inf(out_writes, fetches)
         # RPC/IO ops run host-side after the device step, in program order
-        self.plan.run_host_ops(scope, self.place)
+        self.plan.run_host_ops(scope, self.place, feeds=feeds)
         return self.plan.assemble_fetches(fetches, scope)
 
     def _check_nan_inf(self, out_writes, fetches):

@@ -35,8 +35,16 @@ _DEFS = {
     "FLAGS_check_nan_inf": (False, _parse_bool, True),
     "FLAGS_benchmark": (False, _parse_bool, True),
     "FLAGS_cpu_deterministic": (True, _parse_bool, True),
-    # distributed (consumed by the PS/RPC host ops)
+    # distributed (consumed by the PS/RPC host ops and the async
+    # Communicator; reference __init__.py:187-196 reads the same env names)
     "FLAGS_rpc_deadline": (180000, int, True),
+    "FLAGS_communicator_max_merge_var_num": (20, int, True),
+    "FLAGS_communicator_send_queue_size": (20, int, True),
+    "FLAGS_communicator_independent_recv_thread": (True, _parse_bool, False),
+    "FLAGS_communicator_min_send_grad_num_before_recv": (20, int, False),
+    "FLAGS_communicator_thread_pool_size": (5, int, False),
+    "FLAGS_communicator_fake_rpc": (False, _parse_bool, False),
+    "FLAGS_communicator_merge_sparse_grad": (True, _parse_bool, False),
     # persistent XLA compile cache (SURVEY §7 hard part 6: hide compile
     # latency behind a cache that survives processes).  Empty string
     # disables; the executor applies it lazily on first compile.  The

@@ -61,11 +61,15 @@ class OpInfo:
     # outputs that alias an input in-place (out_slot -> in_slot), e.g. sgd's
     # ParamOut aliases Param.  Used for buffer-donation bookkeeping.
     inplace: _t.Optional[dict] = None
-    # host-side op: runs OUTSIDE the jitted block, after it, in program
-    # order — RPC (send/recv/listen_and_serv), IO, anything side-effectful
-    # that can't live in an XLA computation.  fn(scope, op, place) reads and
-    # writes the scope directly.  `lower` is never called for these.
+    # host-side op: runs OUTSIDE the jitted block, in program order — RPC
+    # (send/recv/listen_and_serv), IO, anything side-effectful that can't
+    # live in an XLA computation.  fn(scope, op, place) reads and writes the
+    # scope directly.  `lower` is never called for these.
     host_run: _t.Optional[_t.Callable] = None
+    # when the host op runs relative to the jitted computation: "post" (the
+    # default — consumes jit outputs, e.g. grad sends) or "pre" (produces
+    # jit inputs from feeds/scope, e.g. distributed embedding lookup)
+    host_stage: str = "post"
 
     def is_variadic(self, slot):
         return slot.endswith("*")
@@ -116,6 +120,7 @@ def register_op(
     grad_maker=None,
     inplace=None,
     host_run=None,
+    host_stage="post",
 ):
     """Register an op lowering.
 
@@ -135,6 +140,7 @@ def register_op(
         grad_maker=grad_maker,
         inplace=inplace,
         host_run=host_run,
+        host_stage=host_stage,
     )
     _OP_REGISTRY[type] = info
     if host_run is not None and grad == "auto":

@@ -4,6 +4,7 @@ from .distribute_transpiler import (  # noqa: F401
     DistributeTranspiler,
     DistributeTranspilerConfig,
 )
+from .geo_sgd_transpiler import GeoSgdTranspiler  # noqa: F401
 from paddle_tpu.ops.dist_ops import stop_pservers, reset_channels  # noqa: F401
 from .ps_dispatcher import HashName, PSDispatcher, RoundRobin  # noqa: F401
 
@@ -24,6 +25,7 @@ def release_memory(input_program, skip_opt_set=None):
 
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "GeoSgdTranspiler",
            "HashName", "PSDispatcher", "RoundRobin",
            "memory_optimize", "release_memory",
            "stop_pservers", "reset_channels"]

@@ -36,6 +36,8 @@ class DistributeTranspilerConfig:
     min_block_size = 8192
     mode = "pserver"
     sync_mode = True
+    # geo-SGD (mode="geo"): local steps between delta syncs
+    geo_sgd_need_push_nums = 100
 
 
 class DistributeTranspiler:
@@ -92,21 +94,107 @@ class DistributeTranspiler:
                     f"global optimize ops are not supported in PS mode yet")
             per_param_ops[owner].append(op)
 
-        # whole-param placement, largest-first round-robin (reference
-        # RoundRobin over size-ordered blocks, ps_dispatcher.py)
+        self.param_endpoint = self._place_params(per_param_ops, block)
+
+        self._per_param_ops = per_param_ops
+        self._state_names = state_names
+        self._find_sparse_tables()
+        return self._finish_transpile(opt_ops)
+
+    def _place_params(self, params, block):
+        """Whole-param placement, largest-first round-robin (reference
+        RoundRobin over size-ordered blocks, ps_dispatcher.py).  Shared by
+        the sync/async transpile and GeoSgdTranspiler so both modes place
+        identically."""
         def psize(p):
             v = block._find_var_recursive(p)
             return -int(np.prod(v.shape)) if v is not None and v.shape else 0
 
-        self.param_endpoint = {}
-        for i, p in enumerate(sorted(per_param_ops, key=lambda p: (psize(p), p))):
-            self.param_endpoint[p] = self.endpoints[i % len(self.endpoints)]
+        placed = {}
+        for i, p in enumerate(sorted(params, key=lambda p: (psize(p), p))):
+            placed[p] = self.endpoints[i % len(self.endpoints)]
+        return placed
 
-        self._per_param_ops = per_param_ops
-        self._state_names = state_names
+    def _finish_transpile(self, opt_ops):
         self._build_trainer_program(opt_ops)
         self._rewrite_startup_program()
         return self
+
+    # -- distributed sparse embeddings ----------------------------------
+    def _find_sparse_tables(self):
+        """Embedding params looked up with is_sparse=True stay SERVER-side:
+        the trainer prefetches rows (distributed_lookup pre-op) and pushes
+        row-sparse SelectedRows grads back (reference
+        parameter_prefetch.cc + selected_rows.h).  The vocab-sized dense
+        param/grad never crosses the wire."""
+        self.sparse_tables = {}  # param -> rewrite info
+        blk = self.origin_program.global_block()
+        for op in blk.ops:
+            if op.type not in ("lookup_table", "lookup_table_v2"):
+                continue
+            w = op.input("W")[0]
+            if not op.attrs.get("is_sparse") or w not in self.param_endpoint:
+                continue
+            if w in self.sparse_tables:
+                raise NotImplementedError(
+                    f"sparse table {w!r} has multiple lookup sites; partial "
+                    f"row grads would be mis-averaged server-side — use "
+                    f"is_sparse=False for shared tables")
+            wv = blk._find_var_recursive(w)
+            self.sparse_tables[w] = {
+                "ids": op.input("Ids")[0],
+                "out": op.output("Out")[0],
+                "padding_idx": op.attrs.get("padding_idx", -1),
+                "row_width": int(wv.shape[-1]),
+                "dtype": str(wv.dtype),
+            }
+
+    def _rewrite_sparse_ops(self, blk):
+        """Splice the trainer-side sparse ops in place of lookup_table /
+        lookup_table_grad for every remote sparse table."""
+        grad_of = dict(self.param_grads)
+        i = 0
+        while i < len(blk.ops):
+            op = blk.ops[i]
+            if (op.type in ("lookup_table", "lookup_table_v2")
+                    and op.input("W")[0] in self.sparse_tables):
+                w = op.input("W")[0]
+                info = self.sparse_tables[w]
+                ids_v = blk._find_var_recursive(info["ids"])
+                out_v = blk._find_var_recursive(op.output("Out")[0])
+                rows_v = blk.create_var(
+                    name=op.output("Out")[0] + "@ROWS",
+                    dtype=info["dtype"], persistable=False)
+                blk._remove_op(i)
+                blk._insert_op(
+                    i, "distributed_lookup", inputs={"Ids": [ids_v]},
+                    outputs={"Out": [rows_v]},
+                    attrs={"endpoint": self.param_endpoint[w],
+                           "table_name": w, "row_width": info["row_width"],
+                           "dtype": info["dtype"]})
+                blk._insert_op(
+                    i + 1, "sparse_embedding_combine",
+                    inputs={"Rows": [rows_v], "Ids": [ids_v]},
+                    outputs={"Out": [out_v]},
+                    attrs={"padding_idx": info["padding_idx"]})
+                i += 2
+                continue
+            if (op.type in ("lookup_table_grad", "lookup_table_v2_grad")
+                    and op.input("W")[0] in self.sparse_tables):
+                w = op.input("W")[0]
+                info = self.sparse_tables[w]
+                og_v = blk._find_var_recursive(op.input("Out@GRAD")[0])
+                ids_v = blk._find_var_recursive(info["ids"])
+                blk._remove_op(i)
+                blk._insert_op(
+                    i, "send_sparse", inputs={"X": [og_v], "Ids": [ids_v]},
+                    attrs={"endpoint": self.param_endpoint[w],
+                           "varname": grad_of[w],
+                           "padding_idx": info["padding_idx"]})
+                i += 1
+                continue
+            i += 1
+        blk.program._bump_version()
 
     # -- trainer side ----------------------------------------------------
     def _build_trainer_program(self, opt_ops):
@@ -119,17 +207,23 @@ class DistributeTranspiler:
         blk.ops = [blk.ops[i] for i in keep]
         prog._bump_version()
 
+        self._rewrite_sparse_ops(blk)
+        dense_pg = [(p, g) for p, g in self.param_grads
+                    if p not in self.sparse_tables]
         grad_ep = {g: self.param_endpoint[p] for p, g in self.param_grads}
-        for p, g in self.param_grads:
+        for p, g in dense_pg:
             blk.append_op("send", inputs={"X": [blk._find_var_recursive(g)]},
                           attrs={"endpoint": grad_ep[g], "varname": g})
-        blk.append_op("send_barrier", attrs={"endpoints": self.endpoints})
-        for p, g in self.param_grads:
+        if self.sync_mode:
+            blk.append_op("send_barrier", attrs={"endpoints": self.endpoints})
+        for p, g in dense_pg:
             blk.append_op("recv",
                           outputs={"Out": [blk._find_var_recursive(p)]},
                           attrs={"endpoint": self.param_endpoint[p],
                                  "varname": p})
-        blk.append_op("fetch_barrier", attrs={"endpoints": self.endpoints})
+        if self.sync_mode:
+            blk.append_op("fetch_barrier",
+                          attrs={"endpoints": self.endpoints})
         self.trainer_program = prog
 
     def get_trainer_program(self):
@@ -141,7 +235,8 @@ class DistributeTranspiler:
             ep = self.param_endpoint[p]
             for n in st:
                 push.append((n, ep))
-            pull.append((p, ep))
+            if p not in self.sparse_tables:  # sparse tables live server-side
+                pull.append((p, ep))
         self.startup_program.global_block().append_op(
             "ps_init_sync",
             attrs={"trainer_id": self.trainer_id, "push_vars": push,

@@ -1,0 +1,94 @@
+"""Geo-SGD transpiler: local optimization + periodic delta sync.
+
+Reference: python/paddle/fluid/transpiler (geo_sgd_transpiler in the 1.6
+line) and the GeoCommunicator (operators/distributed/communicator.h) —
+trainers run the FULL optimizer locally every step; every
+`geo_sgd_need_push_nums` steps each trainer pushes `param - shadow` to the
+pserver owning the param, the pserver folds the delta into the global
+value, and the trainer pulls it back as its new base (shadow).
+
+TPU-native shape: the trainer program keeps its optimize ops (the whole
+step stays one XLA computation — geo's local steps are free of host RPC);
+a single `geo_sgd_sync` host op after the device step does the k-step
+counting and delta exchange.  The pserver runs the async listen loop,
+which folds `{param}@DELTA` pushes natively.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Program
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
+
+
+class GeoSgdTranspiler(DistributeTranspiler):
+    def __init__(self, config=None):
+        super().__init__(config)
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=False, startup_program=None,
+                  current_endpoint=""):
+        from ..framework import default_main_program, default_startup_program
+
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = False  # geo is async by construction
+        self.endpoints = [e.strip() for e in pservers.split(",") if e.strip()]
+        self.origin_program = (program if program is not None
+                               else default_main_program())
+        self.startup_program = (startup_program if startup_program is not None
+                                else default_startup_program())
+
+        block = self.origin_program.global_block()
+        opt_ops = [op for op in block.ops
+                   if op.attrs.get("op_role") == "optimize"]
+        if not opt_ops:
+            raise ValueError("transpile() needs a program with optimizer ops "
+                             "(call optimizer.minimize first)")
+        params = []
+        for op in opt_ops:
+            if op.input("Param") and op.input("Param")[0] not in params:
+                params.append(op.input("Param")[0])
+
+        self.param_endpoint = self._place_params(params, block)
+
+        k = int(getattr(self.config, "geo_sgd_need_push_nums", 100))
+        self._build_geo_trainer_program(k)
+        self._rewrite_geo_startup_program()
+        return self
+
+    def _build_geo_trainer_program(self, k_steps):
+        prog = self.origin_program.clone()
+        blk = prog.global_block()
+        blk.append_op(
+            "geo_sgd_sync",
+            attrs={"uid": f"geo@{id(self)}@{self.trainer_id}",
+                   "k_steps": k_steps,
+                   "params": [(p, ep)
+                              for p, ep in sorted(self.param_endpoint.items())]})
+        self.trainer_program = prog
+
+    def _rewrite_geo_startup_program(self):
+        push = [(p, ep) for p, ep in sorted(self.param_endpoint.items())]
+        self.startup_program.global_block().append_op(
+            "ps_init_sync",
+            attrs={"trainer_id": self.trainer_id, "push_vars": push,
+                   "pull_vars": push,
+                   "shadow_vars": [p for p, _ in push]})
+
+    # -- pserver side ----------------------------------------------------
+    def get_pserver_program(self, endpoint):
+        prog = Program()
+        param_blocks = [(p, None, None, [p])
+                        for p, ep in sorted(self.param_endpoint.items())
+                        if ep == endpoint]
+        prog.global_block().append_op(
+            "listen_and_serv",
+            attrs={"endpoint": endpoint, "n_trainers": self.trainer_num,
+                   "param_blocks": param_blocks, "sync_mode": False})
+        return prog
+
+
+__all__ = ["GeoSgdTranspiler", "DistributeTranspilerConfig"]

@@ -119,6 +119,10 @@ def lib():
                                          ctypes.POINTER(ctypes.c_void_p)]
         L.pts_server_grad_name_len.restype = ctypes.c_int64
         L.pts_server_grad_name_len.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        L.pts_server_pop_grad.restype = ctypes.c_int64
+        L.pts_server_pop_grad.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                          ctypes.POINTER(ctypes.c_void_p),
+                                          ctypes.POINTER(ctypes.c_void_p)]
         L.pts_server_publish.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                          ctypes.c_char_p, ctypes.c_int64]
         L.pts_server_bump_version.argtypes = [ctypes.c_void_p]
@@ -384,6 +388,12 @@ CMD_SEND_BARRIER = 3
 CMD_FETCH_BARRIER = 4
 CMD_SEND_PARAM = 5
 CMD_STOP = 6
+CMD_LOOKUP_ROWS = 7
+
+# payload magic distinguishing a row-sparse gradient (SelectedRows: ids +
+# row values) from a dense tensor blob.  Dense blobs start with the dtype
+# code length (1..8); 0xSR can never collide.
+_SPARSE_MAGIC = 0xE5
 
 
 def _encode_tensor(arr) -> bytes:
@@ -397,6 +407,31 @@ def _decode_tensor(blob: bytes, shape=None):
     dtype = np.dtype(blob[1:1 + n].decode())
     a = np.frombuffer(blob, dtype, offset=1 + n).copy()
     return a.reshape(shape) if shape is not None else a
+
+
+def encode_sparse(rows, values) -> bytes:
+    """SelectedRows wire form: magic | u64 ids_len | ids blob | values blob.
+    `rows` is an int64 id vector, `values` the matching [n, width...] rows
+    (reference framework/selected_rows.h)."""
+    ids = _encode_tensor(np.ascontiguousarray(rows, dtype=np.int64))
+    vals = _encode_tensor(values)
+    import struct
+
+    return bytes([_SPARSE_MAGIC]) + struct.pack("<Q", len(ids)) + ids + vals
+
+
+def is_sparse_blob(blob: bytes) -> bool:
+    return len(blob) > 0 and blob[0] == _SPARSE_MAGIC
+
+
+def decode_sparse(blob: bytes):
+    """-> (rows int64[n], values [n, ...])"""
+    import struct
+
+    (ids_len,) = struct.unpack_from("<Q", blob, 1)
+    rows = _decode_tensor(blob[9:9 + ids_len])
+    values = _decode_tensor(blob[9 + ids_len:])
+    return rows, values.reshape(len(rows), -1) if len(rows) else values
 
 
 class PSServer:
@@ -422,7 +457,8 @@ class PSServer:
         return bool(lib().pts_server_wait_round(self._h))
 
     def grads(self):
-        """All grads received this round as [(name, np_array)]."""
+        """All grads received this round as [(name, payload)] — payload is
+        a dense np array or a (rows, values) SelectedRows pair."""
         out = []
         n = lib().pts_server_grad_count(self._h)
         for i in range(n):
@@ -431,8 +467,32 @@ class PSServer:
                                             ctypes.byref(data_p))
             nlen = lib().pts_server_grad_name_len(self._h, i)
             name = _take(name_p, nlen).decode()
-            out.append((name, _decode_tensor(_take(data_p, dlen))))
+            blob = _take(data_p, dlen)
+            payload = (decode_sparse(blob) if is_sparse_blob(blob)
+                       else _decode_tensor(blob))
+            out.append((name, payload))
         return out
+
+    def pop_grad(self, timeout=0.1):
+        """Async-mode: block up to `timeout` s for one pushed grad.
+        Returns (name, payload) where payload is a dense np array or a
+        (rows, values) SelectedRows pair; None on timeout; raises
+        StopIteration when the server was stopped and drained
+        (listen_and_serv RunAsyncLoop's queue pop)."""
+        name_p, data_p = ctypes.c_void_p(), ctypes.c_void_p()
+        n = lib().pts_server_pop_grad(self._h, int(timeout * 1000),
+                                      ctypes.byref(name_p),
+                                      ctypes.byref(data_p))
+        if n == -2:
+            raise StopIteration
+        if n == -1:
+            return None
+        name = ctypes.string_at(name_p.value).decode()
+        lib().ptq_free(ctypes.cast(name_p, ctypes.c_char_p))
+        blob = _take(data_p, n)
+        if is_sparse_blob(blob):
+            return name, decode_sparse(blob)
+        return name, _decode_tensor(blob)
 
     def publish(self, name, arr):
         blob = _encode_tensor(arr)
@@ -491,6 +551,25 @@ class PSClient:
 
     def send_grad(self, name, arr):
         self._req(CMD_SEND_GRAD, name, blob=_encode_tensor(arr))
+
+    def send_sparse_grad(self, name, rows, values):
+        """Push a row-sparse (SelectedRows) gradient: only the touched
+        embedding rows travel, not the vocab-sized dense tensor."""
+        self._req(CMD_SEND_GRAD, name, blob=encode_sparse(rows, values))
+
+    def lookup_rows(self, name, ids, dtype, row_width):
+        """Distributed embedding lookup (parameter_prefetch): fetch
+        `ids`' rows of the published table `name`.  Served natively by the
+        pserver from the table blob — O(ids) bytes on the wire."""
+        ids = np.ascontiguousarray(ids, dtype=np.int64).reshape(-1)
+        dt = np.dtype(dtype)
+        width = int(row_width) * dt.itemsize
+        header = 1 + len(dt.str.encode())  # codec header before raw rows
+        packed = (header << 32) | width
+        blob = self._req(CMD_LOOKUP_ROWS, name, round=packed,
+                         blob=ids.tobytes())
+        return np.frombuffer(blob, dt).copy().reshape(len(ids),
+                                                      int(row_width))
 
     def send_param(self, name, arr):
         self._req(CMD_SEND_PARAM, name, blob=_encode_tensor(arr))

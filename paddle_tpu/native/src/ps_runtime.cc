@@ -33,6 +33,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -52,6 +53,12 @@ enum Cmd : uint8_t {
   kFetchBarrier = 4,
   kSendParam = 5,
   kStop = 6,
+  // sparse/distributed-embedding row fetch (reference
+  // operators/distributed/parameter_prefetch.cc): request.round carries the
+  // row width in BYTES, request.data is an i64 id array; the response is
+  // the concatenated rows gathered from the published table blob.  Served
+  // natively — no driver round trip on the lookup fast path.
+  kLookupRows = 7,
 };
 
 bool read_n(int fd, void* buf, size_t n) {
@@ -143,9 +150,43 @@ struct PSServer {
       switch (f.cmd) {
         case kSendGrad:
           grads.emplace_back(f.name, std::move(f.data));
+          cv.notify_all();  // wake a driver parked in pop_grad (async mode)
           lk.unlock();
           if (!write_response(fd, 0, "")) return;
           break;
+        case kLookupRows: {
+          // round packs (header_offset << 32) | row_width_bytes: published
+          // blobs carry the Python codec's dtype header before the raw rows
+          uint64_t width = f.round & 0xffffffffull;
+          uint64_t offset = f.round >> 32;
+          auto it = table.find(f.name);
+          if (it == table.end() || width == 0 ||
+              it->second.size() < offset ||
+              f.data.size() % sizeof(int64_t) != 0) {
+            lk.unlock();
+            if (!write_response(fd, 1, "")) return;
+            break;
+          }
+          const std::string& blob = it->second;
+          size_t n_rows = (blob.size() - offset) / width;
+          size_t n_ids = f.data.size() / sizeof(int64_t);
+          const int64_t* ids =
+              reinterpret_cast<const int64_t*>(f.data.data());
+          std::string out;
+          out.resize(n_ids * width);
+          bool ok = true;
+          for (size_t i = 0; i < n_ids; ++i) {
+            if (ids[i] < 0 || static_cast<size_t>(ids[i]) >= n_rows) {
+              ok = false;
+              break;
+            }
+            ::memcpy(&out[i * width], blob.data() + offset + ids[i] * width,
+                     width);
+          }
+          lk.unlock();
+          if (!write_response(fd, ok ? 0 : 1, ok ? out : "")) return;
+          break;
+        }
         case kSendParam:
           table[f.name] = std::move(f.data);
           cv.notify_all();
@@ -305,6 +346,34 @@ int64_t pts_server_grad_name_len(void* h, int64_t i) {
   std::lock_guard<std::mutex> lk(s->mu);
   if (i < 0 || i >= static_cast<int64_t>(s->grads.size())) return -1;
   return static_cast<int64_t>(s->grads[i].first.size());
+}
+
+// Async-mode driver API: block until a grad arrives, pop it.  Returns the
+// payload length (name/data freed by caller via ptq_free), -1 on timeout,
+// -2 when the server was stopped.  The sync loop never calls this; the
+// async loop (listen_and_serv with sync_mode=False, reference
+// listen_and_serv_op.cc RunAsyncLoop) lives on it.
+int64_t pts_server_pop_grad(void* h, int timeout_ms, char** name_out,
+                            char** data_out) {
+  auto* s = static_cast<PSServer*>(h);
+  std::unique_lock<std::mutex> lk(s->mu);
+  bool ready = s->cv.wait_for(
+      lk, std::chrono::milliseconds(timeout_ms),
+      [s] { return s->stopped || !s->grads.empty(); });
+  if (s->stopped && s->grads.empty()) return -2;
+  if (!ready || s->grads.empty()) return -1;
+  auto item = std::move(s->grads.front());
+  s->grads.pop_front();
+  // name is returned NUL-terminated (no paired length call — the item is
+  // already popped); var names never contain NUL
+  char* np = static_cast<char*>(::malloc(item.first.size() + 1));
+  if (np) {
+    ::memcpy(np, item.first.data(), item.first.size());
+    np[item.first.size()] = '\0';
+  }
+  *name_out = np;
+  *data_out = dup_blob(item.second);
+  return static_cast<int64_t>(item.second.size());
 }
 
 void pts_server_publish(void* h, const char* name, const char* data,

@@ -1,20 +1,24 @@
-"""Parameter-server program ops: send / recv / barriers / listen_and_serv.
+"""Parameter-server program ops: send / recv / barriers / listen_and_serv,
+distributed sparse-embedding lookup, async mode, and geo-SGD delta sync.
 
 Reference analogs: operators/distributed_ops/send_op.cc, recv_op.cc,
 send_barrier_op.cc, fetch_barrier_op.cc, listen_and_serv_op.cc (RunSyncLoop
-at :109).  These are HOST ops — they run outside the jitted XLA computation,
-after it, in program order (registry.OpInfo.host_run); the transport is the
-native TCP runtime in paddle_tpu/native/src/ps_runtime.cc (the gRPC
-SendRecvService equivalent).
+at :109, RunAsyncLoop below it), operators/distributed/parameter_prefetch.cc
+(distributed lookup), framework/selected_rows.h (row-sparse grads).  These
+are HOST ops — they run outside the jitted XLA computation in program order
+(registry.OpInfo.host_run; host_stage "pre" ops run before the device step);
+the transport is the native TCP runtime in
+paddle_tpu/native/src/ps_runtime.cc (the gRPC SendRecvService equivalent).
 """
 
 from __future__ import annotations
 
 import threading
 
+import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.fluid.registry import register_op
+from paddle_tpu.fluid.registry import register_op, simple_op
 
 _never = None  # host ops have no jit lowering
 
@@ -73,15 +77,69 @@ def stop_pservers(endpoints):
 
 
 # ---------------------------------------------------------------------------
+# jit ops for the distributed sparse-embedding path: the remote lookup is a
+# pre-stage host op; these two keep the reshape/padding math (and the grad
+# w.r.t. the fetched rows) inside the XLA computation
+# ---------------------------------------------------------------------------
+
+
+@simple_op("sparse_embedding_combine", ["Rows", "Ids"], ["Out"],
+           no_grad_inputs=("Ids",))
+def _sparse_embedding_combine(ctx, rows, ids, attrs):
+    """Shape the remotely-fetched embedding rows [n_ids, dim] like
+    lookup_table's output (ids.shape + [dim], trailing 1 squeezed, padding
+    rows zeroed).  Its auto-vjp w.r.t. Rows is exactly the per-occurrence
+    row gradient that send_sparse ships back."""
+    pad = attrs.get("padding_idx", -1)
+    flat = jnp.reshape(ids, (-1,))
+    out = rows
+    if pad is not None and pad >= 0:
+        out = jnp.where((flat == pad)[:, None], jnp.zeros_like(out), out)
+    id_shape = jnp.shape(ids)
+    if id_shape and id_shape[-1] == 1:
+        id_shape = id_shape[:-1]
+    return jnp.reshape(out, tuple(id_shape) + (jnp.shape(rows)[-1],))
+
+
+# ---------------------------------------------------------------------------
 # host ops
 # ---------------------------------------------------------------------------
 
 
 def _send_run(scope, op, place):
-    ch = get_channel(op.attrs["endpoint"])
     name = op.input("X")[0]
-    ch.client.send_grad(op.attrs.get("varname", name),
-                        np.asarray(scope.get(name)))
+    varname = op.attrs.get("varname", name)
+    arr = np.asarray(scope.get(name))
+    from paddle_tpu.fluid import communicator as _comm
+
+    c = _comm._active()
+    if c is not None and c.push(varname, arr, op.attrs["endpoint"]):
+        return  # async communicator owns merging + sending
+    get_channel(op.attrs["endpoint"]).client.send_grad(varname, arr)
+
+
+def _distributed_lookup_run(scope, op, place):
+    """Pre-stage: fetch the fed ids' embedding rows from the pserver that
+    owns the table (reference parameter_prefetch.cc prefetch)."""
+    ch = get_channel(op.attrs["endpoint"])
+    ids = np.asarray(scope.get(op.input("Ids")[0]))
+    rows = ch.client.lookup_rows(op.attrs["table_name"], ids.reshape(-1),
+                                 op.attrs["dtype"], op.attrs["row_width"])
+    scope.set(op.output("Out")[0], rows)
+
+
+def _send_sparse_run(scope, op, place):
+    """Row-sparse (SelectedRows) grad push: ships (ids, row grads), not the
+    vocab-sized dense tensor (reference send_op with SelectedRows input).
+    padding_idx occurrences carry zero grad (their forward output is zero
+    regardless of the table row)."""
+    ch = get_channel(op.attrs["endpoint"])
+    ids = np.asarray(scope.get(op.input("Ids")[0])).reshape(-1)
+    rows = np.asarray(scope.get(op.input("X")[0])).reshape(len(ids), -1)
+    pad = op.attrs.get("padding_idx", -1)
+    if pad is not None and pad >= 0 and (ids == pad).any():
+        rows = np.where((ids == pad)[:, None], 0.0, rows).astype(rows.dtype)
+    ch.client.send_sparse_grad(op.attrs["varname"], ids, rows)
 
 
 def _send_barrier_run(scope, op, place):
@@ -111,10 +169,14 @@ def _ps_init_sync_run(scope, op, place):
     """Parameter init sync: trainer 0 pushes its initialized params (and
     optimizer state) to the pservers; every trainer then pulls params so all
     replicas start identical.  Replaces the reference's convention of running
-    param initializers inside the pserver startup program."""
+    param initializers inside the pserver startup program.
+
+    shadow_vars (geo-SGD): params whose pulled value is also snapshotted to
+    `{name}@GEO_SHADOW` — the base against which geo deltas are computed."""
     trainer_id = op.attrs["trainer_id"]
     push_vars = op.attrs["push_vars"]  # [(name, endpoint)]
     pull_vars = op.attrs["pull_vars"]  # [(name, endpoint)]
+    shadows = set(op.attrs.get("shadow_vars", ()))
     if trainer_id == 0:
         for name, ep in push_vars:
             get_channel(ep).client.send_param(name, np.asarray(scope.get(name)))
@@ -124,18 +186,219 @@ def _ps_init_sync_run(scope, op, place):
         if var is not None and var.shape is not None:
             arr = arr.reshape(var.shape)
         scope.set(name, arr)
+        if name in shadows:
+            scope.set(name + "@GEO_SHADOW", np.array(arr, copy=True))
+
+
+_geo_state: dict = {}
+_geo_lock = threading.Lock()
+
+
+def _geo_sgd_sync_run(scope, op, place):
+    """Geo-SGD delta sync (reference operators/distributed/communicator.h
+    GeoCommunicator): trainers optimize LOCALLY every step; every k_steps
+    each trainer ships `param - shadow` to the pserver (which folds deltas
+    into the global param) and pulls the fresh global value."""
+    uid = op.attrs["uid"]
+    k = int(op.attrs["k_steps"])
+    with _geo_lock:
+        st = _geo_state.setdefault(uid, {"step": 0})
+        st["step"] += 1
+        due = st["step"] % k == 0
+    if not due:
+        return
+    for name, ep in op.attrs["params"]:  # [(param, endpoint)]
+        ch = get_channel(ep)
+        w = np.asarray(scope.get(name))
+        shadow = np.asarray(scope.get(name + "@GEO_SHADOW"))
+        ch.client.send_grad(name + "@DELTA", w - shadow)
+        fresh = ch.client.get_param(name, want_version=0).reshape(w.shape)
+        scope.set(name, fresh)
+        scope.set(name + "@GEO_SHADOW", np.array(fresh, copy=True))
+
+
+def reset_geo_state():
+    with _geo_lock:
+        _geo_state.clear()
+
+
+def _merge_sparse(parts):
+    """[(rows, vals)] partial SelectedRows grads → (unique rows, per-row
+    sum divided by the TOTAL partial count).  An untouched row is a zero
+    contribution, so sum/len(parts) — not sum/touch-count — is what matches
+    the dense path's np.mean across trainers.  Also collapses duplicate
+    ids within one partial (sum), matching dense scatter-add."""
+    all_rows = np.concatenate([np.asarray(r, dtype=np.int64).reshape(-1)
+                               for r, _ in parts])
+    all_vals = np.concatenate([np.asarray(v, dtype=np.float32)
+                               for _, v in parts], axis=0)
+    uniq, inv = np.unique(all_rows, return_inverse=True)
+    summed = np.zeros((len(uniq), all_vals.shape[1]), np.float32)
+    np.add.at(summed, inv, all_vals)
+    return uniq, summed / float(len(parts))
+
+
+def _apply_update(opt_prog, local, param, g, rows=None, exe=None):
+    """Apply an optimize program to the param in the local scope.
+
+    rows=None: dense grad g.  rows given: row-sparse — only the touched
+    rows update (reference sgd_op.cc / adam_op.h SelectedRows branches).
+    sgd and adam have native numpy math (the async loop depends on this:
+    a per-grad XLA dispatch cannot keep up with the trainers' send rate);
+    other optimizers run the dense jitted program (correct, slower)."""
+    ops = opt_prog.global_block().ops
+    main = [o for o in ops if o.input("Param")]
+    w = np.asarray(local.get(param))
+    sl = slice(None) if rows is None else rows
+    if len(main) == 1 and main[0].type == "sgd":
+        o = main[0]
+        lr = float(np.asarray(local.get(o.input("LearningRate")[0])).reshape(-1)[0])
+        if rows is None:
+            g = g.reshape(w.shape)
+        w[sl] -= lr * g
+        local.set(param, w)
+        return
+    if len(main) == 1 and main[0].type == "adam":
+        o = main[0]
+        lr = float(np.asarray(local.get(o.input("LearningRate")[0])).reshape(-1)[0])
+        b1 = float(o.attrs.get("beta1", 0.9))
+        b2 = float(o.attrs.get("beta2", 0.999))
+        eps = float(o.attrs.get("epsilon", 1e-8))
+        m1 = np.asarray(local.get(o.input("Moment1")[0]))
+        m2 = np.asarray(local.get(o.input("Moment2")[0]))
+        b1p = np.asarray(local.get(o.input("Beta1Pow")[0]))
+        b2p = np.asarray(local.get(o.input("Beta2Pow")[0]))
+        if rows is None:
+            g = g.reshape(w.shape)
+        m1[sl] = b1 * m1[sl] + (1 - b1) * g
+        m2[sl] = b2 * m2[sl] + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2p.reshape(-1)[0]) / (1 - b1p.reshape(-1)[0])
+        w[sl] -= lr_t * m1[sl] / (np.sqrt(m2[sl]) + eps)
+        for n, v in ((o.input("Param")[0], w),
+                     (o.input("Moment1")[0], m1),
+                     (o.input("Moment2")[0], m2),
+                     (o.input("Beta1Pow")[0], b1p * b1),
+                     (o.input("Beta2Pow")[0], b2p * b2)):
+            local.set(n, v)
+        return
+    # fallback: (densify and) run the jitted dense program
+    from paddle_tpu.fluid.executor import Executor
+
+    grad = main[0].input("Grad")[0] if main else None
+    if rows is not None:
+        dense = np.zeros_like(w)
+        np.add.at(dense, rows, g)
+        g = dense
+    else:
+        gvar = opt_prog.global_block()._find_var_recursive(grad)
+        if gvar is not None and gvar.shape is not None:
+            g = g.reshape(gvar.shape)
+    (exe or Executor()).run(opt_prog, feed={grad: g}, fetch_list=[])
+
+
+def _serv_init(server, blocks, local):
+    """Wait for trainer 0's init push, landing state in the local scope.
+    Returns False if the server was stopped first."""
+    for blk in blocks:
+        param, grad, prog, state = blk[:4]
+        for name in state:
+            if not server.wait_table(name):
+                return False
+            var = (prog.global_block()._find_var_recursive(name)
+                   if prog is not None else None)
+            local.set(name, server.table_get(
+                name, shape=var.shape if var is not None else None))
+    return True
+
+
+def _serv_sync_loop(server, blocks, local, exe):
+    """RunSyncLoop: rendezvous rounds; dense grads averaged, SelectedRows
+    grads merged by row, then the param's optimize program (or its sparse
+    fast path) runs and the fresh param is published."""
+    while server.wait_round():
+        received = {}
+        for name, payload in server.grads():
+            received.setdefault(name, []).append(payload)
+        for blk in blocks:
+            param, grad, prog, state = blk[:4]
+            gs = received.get(grad)
+            if not gs:
+                continue
+            sparse = [p for p in gs if isinstance(p, tuple)]
+            dense = [p for p in gs if not isinstance(p, tuple)]
+            if sparse:
+                rows, vals = _merge_sparse(sparse)
+                _apply_update(prog, local, param, vals, rows=rows, exe=exe)
+            if dense:
+                # dense applies run the jitted program: bit-parity with the
+                # local (non-distributed) run is part of the sync contract
+                gvar = prog.global_block()._find_var_recursive(grad)
+                g = np.mean(dense, axis=0, dtype=np.float32)
+                if gvar is not None and gvar.shape is not None:
+                    g = g.reshape(gvar.shape)
+                exe.run(prog, feed={grad: g}, fetch_list=[])
+            server.publish(param, np.asarray(local.get(param)))
+        server.bump_version()
+        server.release_send()
+        if not server.end_round():
+            break
+
+
+def _serv_async_loop(server, blocks, local, exe):
+    """RunAsyncLoop (listen_and_serv_op.cc): no barriers — every pushed
+    grad is applied the moment it arrives and the param republished.
+    `{param}@DELTA` pushes are geo-SGD folds: param += delta."""
+    by_grad = {}
+    for blk in blocks:
+        param, grad, prog, state = blk[:4]
+        if grad is not None:
+            by_grad[grad] = (param, prog)
+    while True:
+        try:
+            item = server.pop_grad(timeout=0.2)
+        except StopIteration:
+            return
+        if item is None:
+            continue
+        name, payload = item
+        if name.endswith("@DELTA"):
+            param = name[: -len("@DELTA")]
+            w = np.asarray(local.get(param))
+            if isinstance(payload, tuple):
+                rows, vals = payload
+                np.add.at(w, np.asarray(rows).reshape(-1),
+                          np.asarray(vals, dtype=w.dtype))
+            else:
+                w = w + payload.reshape(w.shape)
+            local.set(param, w)
+            server.publish(param, w)
+            continue
+        ent = by_grad.get(name)
+        if ent is None:
+            continue
+        param, prog = ent
+        if isinstance(payload, tuple):
+            # dedupe duplicate ids (fancy-index assignment would keep only
+            # the last duplicate's update) — same merge as the sync loop
+            rows, vals = _merge_sparse([payload])
+            _apply_update(prog, local, param, vals, rows=rows, exe=exe)
+        else:
+            _apply_update(prog, local, param, payload, exe=exe)
+        server.publish(param, np.asarray(local.get(param)))
 
 
 def _listen_and_serv_run(scope, op, place):
-    """Pserver main loop (listen_and_serv_op.cc:109 RunSyncLoop): blocks
-    until a trainer sends STOP.  Optimize blocks run through the normal
-    executor (jitted, cached after round one) on the local place."""
+    """Pserver main loop (listen_and_serv_op.cc:109): RunSyncLoop or, with
+    sync_mode=False, RunAsyncLoop.  Blocks until a trainer sends STOP.
+    Optimize blocks run through the normal executor (jitted, cached after
+    round one) on the local place."""
     from paddle_tpu import native
     from paddle_tpu.fluid.executor import Executor, Scope, scope_guard
 
     ep = op.attrs["endpoint"]
     port = int(ep.rsplit(":", 1)[1])
     n_trainers = int(op.attrs["n_trainers"])
+    sync_mode = bool(op.attrs.get("sync_mode", True))
     # [(param, grad, opt_program, state_names)]
     blocks = op.attrs["param_blocks"]
 
@@ -144,32 +407,17 @@ def _listen_and_serv_run(scope, op, place):
     exe = Executor(place)
     try:
         with scope_guard(local):
-            # init: trainer 0 pushes params + optimizer state
-            for param, grad, prog, state in blocks:
-                for name in state:
-                    if not server.wait_table(name):
-                        return
-                    var = prog.global_block()._find_var_recursive(name)
-                    local.set(name, server.table_get(
-                        name, shape=var.shape if var is not None else None))
-            while server.wait_round():
-                received = {}
-                for name, arr in server.grads():
-                    received.setdefault(name, []).append(arr)
-                for param, grad, prog, state in blocks:
-                    gs = received.get(grad)
-                    if not gs:
-                        continue
-                    gvar = prog.global_block()._find_var_recursive(grad)
-                    g = np.mean(gs, axis=0, dtype=np.float32)
-                    if gvar is not None and gvar.shape is not None:
-                        g = g.reshape(gvar.shape)
-                    exe.run(prog, feed={grad: g}, fetch_list=[])
-                    server.publish(param, np.asarray(local.get(param)))
-                server.bump_version()
-                server.release_send()
-                if not server.end_round():
-                    break
+            if not _serv_init(server, blocks, local):
+                return
+            # params must be visible (table) before trainers' first recv /
+            # lookup — publish initial values
+            for blk in blocks:
+                server.publish(blk[0], np.asarray(local.get(blk[0])))
+            server.bump_version()
+            if sync_mode:
+                _serv_sync_loop(server, blocks, local, exe)
+            else:
+                _serv_async_loop(server, blocks, local, exe)
     finally:
         server.stop()
 
@@ -184,3 +432,9 @@ register_op("ps_init_sync", [], [], _no_lower, grad=None,
             host_run=_ps_init_sync_run)
 register_op("listen_and_serv", [], [], _no_lower, grad=None,
             host_run=_listen_and_serv_run)
+register_op("distributed_lookup", ["Ids"], ["Out"], _no_lower, grad=None,
+            host_run=_distributed_lookup_run, host_stage="pre")
+register_op("send_sparse", ["X", "Ids"], [], _no_lower, grad=None,
+            host_run=_send_sparse_run)
+register_op("geo_sgd_sync", [], [], _no_lower, grad=None,
+            host_run=_geo_sgd_sync_run)

@@ -139,6 +139,10 @@ class _ShardedBlock:
 
         plan = BlockPlan(program, program.global_block(), feed_names,
                          fetch_names, scope)
+        if plan.host_pre_ops:
+            raise NotImplementedError(
+                "pre-stage host ops (distributed lookup) are only "
+                "supported by the single-device Executor")
         self.plan = plan
         self.feed_names = plan.feed_names
         self.fetch_names = plan.fetch_names

@@ -209,6 +209,10 @@ class HybridParallelRunner:
         program, mesh = self.program, self.mesh
         plan = BlockPlan(program, program.global_block(), feed_names,
                          fetch_names, scope)
+        if plan.host_pre_ops:
+            raise NotImplementedError(
+                "pre-stage host ops (distributed lookup) are only "
+                "supported by the single-device Executor")
         inner_body = plan.make_body()
 
         def body(*args):

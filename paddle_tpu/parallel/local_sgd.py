@@ -77,6 +77,10 @@ class LocalSGDRunner:
 
         plan = BlockPlan(self.program, self.program.global_block(),
                          feed_names, fetch_names, scope)
+        if plan.host_pre_ops:
+            raise NotImplementedError(
+                "pre-stage host ops (distributed lookup) are only "
+                "supported by the single-device Executor")
         axis = pmesh.DATA_AXIS
         inner = plan.make_body(mesh_axes=(axis,))
         donated, readonly = plan.donated_names, plan.readonly_names

@@ -1,0 +1,334 @@
+"""Async parameter-server mode, geo-SGD, the Communicator, and the
+distributed sparse-embedding path.
+
+Reference test strategy: test_dist_base.py runs async at smoke tolerance
+(convergence, not step parity — async applies grads as they arrive) while
+sync modes get step parity; test_dist_ctr / test_dist_simnet_bow exercise
+is_sparse embeddings.  Same split here, in-process (pserver thread +
+trainer in the main thread, 127.0.0.1 transport).
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _build_fit_a_line(opt):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt().minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n=40, batch=16):
+    rng = np.random.RandomState(0)
+    W = rng.uniform(-1, 1, (13, 1)).astype("float32")
+    return [
+        {"x": (xb := rng.uniform(-1, 1, (batch, 13)).astype("float32")),
+         "y": xb @ W}
+        for _ in range(n)
+    ]
+
+
+def _run_with_pserver(transpiler, endpoints, trainer_fn):
+    progs = [transpiler.get_pserver_program(ep) for ep in endpoints]
+
+    def serve(prog):
+        with scope_guard(Scope()):
+            fluid.Executor(fluid.CPUPlace()).run(prog)
+
+    threads = [threading.Thread(target=serve, args=(p,)) for p in progs]
+    for t in threads:
+        t.start()
+    try:
+        return trainer_fn()
+    finally:
+        fluid.transpiler.stop_pservers(endpoints)
+        for t in threads:
+            t.join(timeout=15)
+        assert all(not t.is_alive() for t in threads)
+
+
+# ---------------------------------------------------------------------------
+# async mode
+# ---------------------------------------------------------------------------
+
+
+def test_async_transpile_has_no_barriers():
+    main, startup, loss = _build_fit_a_line(
+        lambda: fluid.optimizer.SGD(learning_rate=0.05))
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers="127.0.0.1:7011",
+                trainers=1, sync_mode=False, startup_program=startup)
+    types = [op.type for op in t.get_trainer_program().global_block().ops]
+    assert "send" in types and "recv" in types
+    assert "send_barrier" not in types and "fetch_barrier" not in types
+    serv = t.get_pserver_program("127.0.0.1:7011").global_block().ops[0]
+    assert serv.attrs["sync_mode"] is False
+
+
+def test_async_ps_converges():
+    """RunAsyncLoop smoke test (reference test_dist_base delta=200 —
+    async promises convergence, not parity)."""
+    batches = _batches(n=40)
+    main, startup, loss = _build_fit_a_line(
+        lambda: fluid.optimizer.SGD(learning_rate=0.05))
+    ep = f"127.0.0.1:{free_port()}"
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=ep, trainers=1,
+                sync_mode=False, startup_program=startup)
+
+    def train():
+        losses = []
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for b in batches:
+                (lv,) = exe.run(t.get_trainer_program(), feed=b,
+                                fetch_list=[loss.name])
+                losses.append(float(np.asarray(lv)))
+        return losses
+
+    losses = _run_with_pserver(t, [ep], train)
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < 0.5 * np.mean(losses[:5])
+
+
+def test_async_communicator_converges():
+    """Same as above but grads ride the background Communicator (merged
+    sends) instead of inline RPC.  Small merge window + more steps: with
+    aggressive merging a 40-step run finishes before the first merged send
+    lands, which is correct async semantics but tests nothing."""
+    batches = _batches(n=120)
+    main, startup, loss = _build_fit_a_line(
+        lambda: fluid.optimizer.SGD(learning_rate=0.05))
+    ep = f"127.0.0.1:{free_port()}"
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=ep, trainers=1,
+                sync_mode=False, startup_program=startup)
+
+    def train():
+        comm = fluid.Communicator(t.get_trainer_program(),
+                                  max_merge_var_num=2)
+        losses = []
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            comm.start()
+            try:
+                for b in batches:
+                    (lv,) = exe.run(t.get_trainer_program(), feed=b,
+                                    fetch_list=[loss.name])
+                    losses.append(float(np.asarray(lv)))
+            finally:
+                comm.stop()
+        return losses
+
+    losses = _run_with_pserver(t, [ep], train)
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < 0.5 * np.mean(losses[:5])
+
+
+# ---------------------------------------------------------------------------
+# geo-SGD
+# ---------------------------------------------------------------------------
+
+
+def test_geo_sgd_converges():
+    from paddle_tpu.ops import dist_ops
+
+    dist_ops.reset_geo_state()
+    batches = _batches(n=40)
+    main, startup, loss = _build_fit_a_line(
+        lambda: fluid.optimizer.SGD(learning_rate=0.05))
+    ep = f"127.0.0.1:{free_port()}"
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.geo_sgd_need_push_nums = 5
+    t = fluid.transpiler.GeoSgdTranspiler(cfg)
+    t.transpile(trainer_id=0, program=main, pservers=ep, trainers=1,
+                startup_program=startup)
+    # trainer keeps its local optimizer and gained the sync op
+    types = [op.type for op in t.get_trainer_program().global_block().ops]
+    assert "sgd" in types and "geo_sgd_sync" in types
+    assert "send" not in types and "recv" not in types
+
+    def train():
+        losses = []
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for b in batches:
+                (lv,) = exe.run(t.get_trainer_program(), feed=b,
+                                fetch_list=[loss.name])
+                losses.append(float(np.asarray(lv)))
+        return losses
+
+    losses = _run_with_pserver(t, [ep], train)
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < 0.5 * np.mean(losses[:5])
+
+
+def test_geo_sgd_server_folds_delta():
+    """The pserver's global param must actually move: after k local steps
+    the trainer's delta lands server-side (param != its init push)."""
+    from paddle_tpu.ops import dist_ops
+
+    dist_ops.reset_geo_state()
+    batches = _batches(n=10)
+    main, startup, loss = _build_fit_a_line(
+        lambda: fluid.optimizer.SGD(learning_rate=0.05))
+    ep = f"127.0.0.1:{free_port()}"
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.geo_sgd_need_push_nums = 3
+    t = fluid.transpiler.GeoSgdTranspiler(cfg)
+    t.transpile(trainer_id=0, program=main, pservers=ep, trainers=1,
+                startup_program=startup)
+    param = sorted(t.param_endpoint)[0]
+
+    def train():
+        sc = Scope()
+        with scope_guard(sc):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            init = np.array(np.asarray(sc.get(param)), copy=True)
+            for b in batches:
+                exe.run(t.get_trainer_program(), feed=b, fetch_list=[])
+            # post-sync the local param equals the server's folded value
+            final = np.asarray(sc.get(param))
+            return init, final
+
+    init, final = _run_with_pserver(t, [ep], train)
+    assert not np.allclose(init, final)
+
+
+# ---------------------------------------------------------------------------
+# distributed sparse embedding (SelectedRows grads + row prefetch)
+# ---------------------------------------------------------------------------
+
+
+def _build_embedding_model(is_sparse, vocab=50, dim=8, seq=6):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        ids = fluid.layers.data(name="ids", shape=[seq, 1], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[vocab, dim],
+                                     is_sparse=is_sparse, padding_idx=0)
+        pooled = fluid.layers.reduce_mean(emb, dim=1)
+        pred = fluid.layers.fc(pooled, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _emb_batches(n=25, batch=8, vocab=50, seq=6):
+    rng = np.random.RandomState(7)
+    w = rng.uniform(-1, 1, vocab).astype("float32")
+    out = []
+    for _ in range(n):
+        ids = rng.randint(0, vocab, (batch, seq, 1)).astype("int64")
+        # offset keeps the initial loss well away from zero so the
+        # convergence-ratio assertion is meaningful
+        label = (1.5 + w[ids[:, :, 0]].mean(axis=1, keepdims=True)
+                 ).astype("float32")
+        out.append({"ids": ids, "label": label})
+    return out
+
+
+def test_sparse_table_transpile_shape():
+    main, startup, loss = _build_embedding_model(is_sparse=True)
+    ep = "127.0.0.1:7012"
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=ep, trainers=1,
+                startup_program=startup)
+    table = next(iter(t.sparse_tables))
+    tp = t.get_trainer_program()
+    types = [op.type for op in tp.global_block().ops]
+    assert "distributed_lookup" in types
+    assert "sparse_embedding_combine" in types
+    assert "send_sparse" in types
+    assert "lookup_table" not in types and "lookup_table_grad" not in types
+    # the vocab-sized table is neither sent nor received densely
+    for op in tp.global_block().ops:
+        if op.type in ("send", "recv"):
+            assert op.attrs.get("varname") != table
+    # and is not pulled to the trainer at startup
+    init_op = [op for op in startup.global_block().ops
+               if op.type == "ps_init_sync"][0]
+    assert table not in [n for n, _ in init_op.attrs["pull_vars"]]
+    assert table in [n for n, _ in init_op.attrs["push_vars"]]
+
+
+@pytest.mark.parametrize("sync_mode", [True, False])
+def test_sparse_embedding_trains(sync_mode):
+    batches = _emb_batches()
+    main, startup, loss = _build_embedding_model(is_sparse=True)
+    ep = f"127.0.0.1:{free_port()}"
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=ep, trainers=1,
+                sync_mode=sync_mode, startup_program=startup)
+
+    def train():
+        losses = []
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for b in batches:
+                (lv,) = exe.run(t.get_trainer_program(), feed=b,
+                                fetch_list=[loss.name])
+                losses.append(float(np.asarray(lv)))
+        return losses
+
+    losses = _run_with_pserver(t, [ep], train)
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < 0.7 * np.mean(losses[:5])
+
+
+def test_sparse_sync_loss_parity_vs_local():
+    """Sync mode with one trainer must match the local dense run step for
+    step: per-id row merging + sparse sgd apply ≡ dense scatter-add + sgd."""
+    batches = _emb_batches(n=12)
+
+    main, startup, loss = _build_embedding_model(is_sparse=True)
+    local = []
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for b in batches:
+            (lv,) = exe.run(main, feed=b, fetch_list=[loss.name])
+            local.append(float(np.asarray(lv)))
+
+    main, startup, loss = _build_embedding_model(is_sparse=True)
+    ep = f"127.0.0.1:{free_port()}"
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=ep, trainers=1,
+                startup_program=startup)
+
+    def train():
+        dist = []
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for b in batches:
+                (lv,) = exe.run(t.get_trainer_program(), feed=b,
+                                fetch_list=[loss.name])
+                dist.append(float(np.asarray(lv)))
+        return dist
+
+    dist = _run_with_pserver(t, [ep], train)
+    np.testing.assert_allclose(dist, local, rtol=1e-4, atol=1e-5)

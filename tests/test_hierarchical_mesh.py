@@ -1,0 +1,144 @@
+"""Hierarchical (dcn, dp) mesh semantics — the multi-slice story.
+
+Reference analog: nccl_helper.h:179 NCCLCommunicator's hierarchical
+allreduce (inter_trainers_/exter_trainers_ rings, build_strategy.h:130
+use_hierarchical_allreduce) — intra-node ring reduce then inter-node ring
+over the slower fabric.  TPU-native: a 2-D Mesh ('dcn','dp') where the dp
+axis rides ICI within a slice and the dcn axis crosses slices over DCN;
+XLA lowers per-axis psums to the matching fabric.  These tests pin the
+semantics on the 8-device virtual CPU mesh: per-axis reduction scopes,
+two-stage == global equivalence, and the framework's ring_id → axis
+routing over both levels.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import mesh as pmesh
+
+
+@pytest.fixture
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return pmesh.build_mesh({"dcn": 2, "dp": 4})
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs)
+
+
+def test_mesh_structure(mesh):
+    assert mesh.axis_names == ("dcn", "dp")
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"dcn": 2,
+                                                              "dp": 4}
+
+
+def test_per_axis_reduction_scopes(mesh):
+    """psum over 'dp' reduces within a slice only; psum over 'dcn' reduces
+    the same dp-rank across slices; psum over both is global."""
+    x = np.arange(8, dtype=np.float32)  # one value per device
+
+    def body(v):
+        return (lax.psum(v, "dp"), lax.psum(v, "dcn"),
+                lax.psum(v, ("dcn", "dp")))
+
+    dp_sum, dcn_sum, both = _shard_map(
+        body, mesh, in_specs=(P(("dcn", "dp")),),
+        out_specs=(P(("dcn", "dp")), P(("dcn", "dp")), P(("dcn", "dp"))))(x)
+    grid = x.reshape(2, 4)
+    want_dp = np.repeat(grid.sum(axis=1, keepdims=True), 4, axis=1).reshape(-1)
+    want_dcn = np.tile(grid.sum(axis=0, keepdims=True), (2, 1)).reshape(-1)
+    np.testing.assert_allclose(np.asarray(dp_sum), want_dp)
+    np.testing.assert_allclose(np.asarray(dcn_sum), want_dcn)
+    np.testing.assert_allclose(np.asarray(both), np.full(8, x.sum()))
+
+
+def test_two_stage_equals_global(mesh):
+    """The hierarchical allreduce identity the reference engineers by hand
+    (intra ring, then inter ring): psum(psum(x,'dp'),'dcn') == global."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 5).astype(np.float32)
+
+    def body(v):
+        staged = lax.psum(lax.psum(v, "dp"), "dcn")
+        direct = lax.psum(v, ("dcn", "dp"))
+        return staged, direct
+
+    staged, direct = _shard_map(
+        body, mesh, in_specs=(P(("dcn", "dp")),),
+        out_specs=(P(("dcn", "dp")), P(("dcn", "dp"))))(x)
+    np.testing.assert_allclose(np.asarray(staged), np.asarray(direct),
+                               rtol=1e-6)
+
+
+def test_framework_rings_route_to_both_levels(mesh):
+    """c_allreduce_sum with ring 0 → 'dp' and ring 1 → 'dcn': the program's
+    collective ops address either fabric level through ring_id, like the
+    reference's inter/exter NCCL contexts."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.executor import trace_block
+    from paddle_tpu.fluid.registry import LowerContext
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        blk = main.global_block()
+        intra = blk.create_var(name="x@DP_SUM", shape=x.shape,
+                               dtype=x.dtype)
+        blk.append_op("c_allreduce_sum", inputs={"X": [x]},
+                      outputs={"Out": [intra]}, attrs={"ring_id": 0})
+
+    pmesh.set_ring_axis(0, "dp")
+    pmesh.set_ring_axis(1, "dcn")
+    try:
+        def body(v):
+            env = {"x": v}
+            ctx = LowerContext(mesh_axes=("dcn", "dp"))
+            ctx.program = main
+            trace_block(blk, env, ctx)
+            intra_v = env[intra.name]
+            # second level by hand through the same lowering machinery:
+            from paddle_tpu.fluid import registry
+
+            info = registry.get_op("c_allreduce_sum")
+            inter_v = info.lower(ctx, intra_v, attrs={"ring_id": 1})
+            return intra_v, inter_v
+
+        vals = np.arange(8 * 1 * 3, dtype=np.float32).reshape(8, 1, 3)
+        intra_o, inter_o = _shard_map(
+            body, mesh, in_specs=(P(("dcn", "dp")),),
+            out_specs=(P(("dcn", "dp")), P(("dcn", "dp"))))(vals)
+        grid = vals.reshape(2, 4, 3)
+        want_intra = np.repeat(grid.sum(axis=1, keepdims=True), 4,
+                               axis=1).reshape(8, 1, 3)
+        np.testing.assert_allclose(np.asarray(intra_o), want_intra)
+        np.testing.assert_allclose(
+            np.asarray(inter_o),
+            np.broadcast_to(vals.sum(axis=0), (8, 1, 3)))
+    finally:
+        pmesh.set_ring_axis(0, pmesh.DATA_AXIS)
+        pmesh._ring_axes.pop(1, None)
+
+
+def test_hierarchical_gradient_averaging(mesh):
+    """Data-parallel gradient mean over a 2-level mesh: mean over dp then
+    mean over dcn == global mean (uniform group sizes) — the semantics
+    use_hierarchical_allreduce promises."""
+    rng = np.random.RandomState(1)
+    g = rng.randn(8, 4).astype(np.float32)
+
+    def body(v):
+        return lax.pmean(lax.pmean(v, "dp"), "dcn")
+
+    out = _shard_map(body, mesh, in_specs=(P(("dcn", "dp")),),
+                     out_specs=P(("dcn", "dp")))(g)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.broadcast_to(g.mean(axis=0), (8, 4)),
+                               rtol=1e-6)

@@ -257,9 +257,11 @@ def measure(size):
     dt = _timed_steps(exe, main_prog, data, loss.name, n_steps)
 
     tokens_per_sec = n_steps * batch * seq_len / dt
+    # labels: " bf16" = the cast-insertion AMP rewrite (its historical
+    # label — old baselines match); " bf16-policy" = the dtype policy
     config = (f"bert-{size} b{batch} s{seq_len}"
-              + (" flash" if flash else "") + (" amp" if amp else "")
-              + (" bf16" if bf16 else "") + _cpu_suffix())
+              + (" flash" if flash else "") + (" bf16" if amp else "")
+              + (" bf16-policy" if bf16 else "") + _cpu_suffix())
     return _attach_flops({
         "metric": f"bert_{size}_pretrain_tokens_per_sec",
         "value": round(tokens_per_sec, 1),

@@ -30,6 +30,7 @@ from . import nets  # noqa: F401
 from . import compiler  # noqa: F401
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
 from . import io  # noqa: F401
+from . import proto_compat  # noqa: F401
 from .layers.io import data  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .reader import PyReader, DataLoader  # noqa: F401

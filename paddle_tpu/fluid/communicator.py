@@ -77,6 +77,23 @@ class Communicator:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        # a push racing stop() can land after the thread's final drain;
+        # flush synchronously so no grad is silently dropped
+        if self._error is None:
+            from paddle_tpu.ops import dist_ops
+
+            for (varname, endpoint), q in self._queues.items():
+                parts = []
+                while True:
+                    try:
+                        parts.append(q.get_nowait())
+                    except queue.Empty:
+                        break
+                if parts:
+                    merged = (parts[0] if len(parts) == 1 else
+                              np.mean(parts, axis=0, dtype=np.float32))
+                    dist_ops.get_channel(endpoint).client.send_grad(
+                        varname, merged)
         with _active_lock:
             if _active_comm is self:
                 _active_comm = None

@@ -137,9 +137,10 @@ def _gather_inputs(op, info, env, optional_ok=True):
 
 
 # numerically sensitive ops that stay fp32 islands under the bf16 policy:
-# inputs are upcast, the lowering runs in fp32, float outputs are cast back
-# to bf16 so the chain stays narrow (losses/softmax/norm statistics — the
-# standard mixed-precision blocklist, reference fp16_lists.py black_list)
+# inputs are upcast and the lowering runs in fp32; outputs stay fp32, and
+# any bf16 consumer downcasts its own inputs, so the chain stays narrow
+# (losses/softmax/norm statistics — the standard mixed-precision
+# blocklist, reference fp16_lists.py black_list)
 _BF16_FP32_OPS = frozenset({
     "softmax", "softmax_with_cross_entropy", "cross_entropy",
     "cross_entropy2", "mean", "reduce_mean", "batch_norm", "layer_norm",

@@ -264,9 +264,12 @@ def _prune_for_inference(program, feed_names, target_names):
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
-                         params_filename=None, scope=None):
+                         params_filename=None, scope=None,
+                         model_format="json"):
     """Prune to the inference subgraph, write __model__ + params
-    (reference io.py:925)."""
+    (reference io.py:925).  model_format="protobuf" writes the REFERENCE
+    on-disk layout (binary ProgramDesc + per-var LoDTensor streams), so a
+    model saved here loads in actual Fluid."""
     main_program = main_program or framework.default_main_program()
     feed_names = [v.name if isinstance(v, Variable) else v for v in feeded_var_names]
     target_names = [v.name if isinstance(v, Variable) else v for v in target_vars]
@@ -275,32 +278,140 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     pruned._inference_fetch_names = target_names
 
     os.makedirs(dirname, exist_ok=True)
-    desc = program_to_dict(pruned)
-    desc["feed_names"] = feed_names
-    desc["fetch_names"] = target_names
-    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "w") as f:
-        json.dump(desc, f)
-
     # save parameters actually used by the pruned graph
     used = set()
     for op in pruned.global_block().ops:
         used.update(op.input_arg_names)
     params = [v for v in main_program.list_vars()
               if _is_persistable(v) and v.name in used]
+
+    if model_format == "protobuf":
+        from . import proto_compat
+
+        _add_feed_fetch_ops(pruned, feed_names, target_names)
+        # drop vars the pruned op list no longer references (the reference
+        # prune does the same; a stale learning_rate var would otherwise
+        # read as a loadable param on the other side)
+        for blk in pruned.blocks:
+            ref = set()
+            for op in blk.ops:
+                ref.update(op.input_arg_names)
+                ref.update(op.output_arg_names)
+            blk.vars = {n: v for n, v in blk.vars.items() if n in ref}
+        with open(os.path.join(dirname, model_filename or MODEL_FILENAME),
+                  "wb") as f:
+            f.write(proto_compat.serialize_program(pruned))
+        scope_ = scope or global_scope()
+
+        def _value(v):
+            val = scope_.get(v.name)
+            if val is None:
+                raise RuntimeError(f"variable {v.name} has no value in scope")
+            return np.asarray(val)
+
+        if params_filename:
+            # combined file, sorted by name — save_combine/load_combine
+            # ordering on both sides
+            with open(os.path.join(dirname, params_filename), "wb") as f:
+                for v in sorted(params, key=lambda v: v.name):
+                    proto_compat.serialize_lod_tensor(f, _value(v))
+        else:
+            for v in params:
+                with open(os.path.join(dirname, v.name), "wb") as f:
+                    proto_compat.serialize_lod_tensor(f, _value(v))
+        return target_names
+
+    desc = program_to_dict(pruned)
+    desc["feed_names"] = feed_names
+    desc["fetch_names"] = target_names
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "w") as f:
+        json.dump(desc, f)
     save_vars(executor, dirname, main_program, vars=params,
               filename=params_filename or PARAMS_FILENAME, scope=scope)
     return target_names
 
 
+def _add_feed_fetch_ops(program, feed_names, fetch_names):
+    """Reference io.py:887 prepend_feed_ops / :908 append_fetch_ops — the
+    deployment convention actual Fluid's load_inference_model expects."""
+    blk = program.global_block()
+    feed_var = blk.create_var(name="feed", persistable=True)
+    fetch_var = blk.create_var(name="fetch", persistable=True)
+    from .framework import Operator
+
+    for i, name in enumerate(feed_names):
+        op = Operator(blk, "feed", inputs={"X": [feed_var]},
+                      outputs={"Out": [blk.var(name)]}, attrs={"col": i})
+        blk.ops.insert(i, op)
+    for i, name in enumerate(fetch_names):
+        op = Operator(blk, "fetch", inputs={"X": [blk.var(name)]},
+                      outputs={"Out": [fetch_var]}, attrs={"col": i})
+        blk.ops.append(op)
+    program._bump_version()
+
+
+def _load_reference_inference_model(dirname, data, params_filename, scope):
+    """Load a model saved by ACTUAL Fluid: binary ProgramDesc + LoDTensor
+    param streams (separate per-var files, or one combined file read
+    sequentially like load_combine_op)."""
+    from . import proto_compat
+
+    program = proto_compat.parse_program_bytes(data)
+    blk = program.global_block()
+    feeds, fetches = [], []
+    for op in blk.ops:
+        if op.type == "feed":
+            feeds.append((op.attrs.get("col", 0), op.output("Out")[0]))
+        elif op.type == "fetch":
+            fetches.append((op.attrs.get("col", 0), op.input("X")[0]))
+    feed_names = [n for _, n in sorted(feeds)]
+    fetch_names = [n for _, n in sorted(fetches)]
+    used = set()
+    for b in program.blocks:
+        for op in b.ops:
+            if op.type not in ("feed", "fetch"):
+                used.update(op.input_arg_names)
+    params = [v for v in program.list_vars()
+              if _is_persistable(v) and v.name in used]
+    if params_filename:
+        with open(os.path.join(dirname, params_filename), "rb") as f:
+            # load_combine order: sorted by name (reference io.py:1116
+            # load_inference_model passes program.list_vars() filtered —
+            # saved via save_combine with the same sorted ordering)
+            for v in sorted(params, key=lambda v: v.name):
+                arr, _lod = proto_compat.deserialize_lod_tensor(f)
+                scope.set(v.name, arr)
+    else:
+        for v in params:
+            path = os.path.join(dirname, v.name)
+            if not os.path.exists(path):
+                raise RuntimeError(
+                    f"reference-format param file {path} not found")
+            with open(path, "rb") as f:
+                arr, _lod = proto_compat.deserialize_lod_tensor(f)
+            scope.set(v.name, arr)
+    fetch_targets = [blk.var(n) for n in fetch_names]
+    return program, feed_names, fetch_targets
+
+
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None, scope=None):
-    """Returns (program, feed_names, fetch_targets) (reference io.py:1116)."""
-    with open(os.path.join(dirname, model_filename or MODEL_FILENAME)) as f:
-        desc = json.load(f)
+    """Returns (program, feed_names, fetch_targets) (reference io.py:1116).
+    Auto-detects the format: this repo's JSON layout or the reference's
+    binary protobuf `__model__` (models saved by actual Fluid load here)."""
+    scope = scope or global_scope()
+    model_path = os.path.join(dirname, model_filename or MODEL_FILENAME)
+    with open(model_path, "rb") as f:
+        raw = f.read()
+    from . import proto_compat
+
+    if proto_compat.is_program_proto(raw):
+        return _load_reference_inference_model(dirname, raw,
+                                               params_filename, scope)
+    desc = json.loads(raw.decode("utf-8"))
     program = program_from_dict(desc)
     feed_names = desc.get("feed_names", [])
     fetch_names = desc.get("fetch_names", [])
-    scope = scope or global_scope()
     params_path = _npz_path(dirname, params_filename or PARAMS_FILENAME)
     if not os.path.exists(params_path):
         raise RuntimeError(f"inference model params file {params_path} not found")

@@ -207,12 +207,29 @@ def _geo_sgd_sync_run(scope, op, place):
         due = st["step"] % k == 0
     if not due:
         return
+    import time as _time
+
     for name, ep in op.attrs["params"]:  # [(param, endpoint)]
         ch = get_channel(ep)
         w = np.asarray(scope.get(name))
         shadow = np.asarray(scope.get(name + "@GEO_SHADOW"))
-        ch.client.send_grad(name + "@DELTA", w - shadow)
+        delta = w - shadow
+        ch.client.send_grad(name + "@DELTA", delta)
+        # the fold happens in the pserver's async loop AFTER the send is
+        # acked; pulling immediately would usually return the pre-fold
+        # value and revert our k local steps until the next sync.  Wait
+        # (bounded) for the published param to move off our shadow — in
+        # the common case that movement IS our fold landing; with other
+        # trainers racing, any fold is acceptable (geo semantics) and
+        # ours lands in a later pull.
         fresh = ch.client.get_param(name, want_version=0).reshape(w.shape)
+        if np.any(delta):
+            for _ in range(100):
+                if not np.array_equal(fresh, shadow):
+                    break
+                _time.sleep(0.005)
+                fresh = ch.client.get_param(name,
+                                            want_version=0).reshape(w.shape)
         scope.set(name, fresh)
         scope.set(name + "@GEO_SHADOW", np.array(fresh, copy=True))
 

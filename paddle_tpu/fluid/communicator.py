@@ -57,6 +57,10 @@ class Communicator:
         self._running = False
         self._thread = None
         self._error = None
+        # serializes the running-check+enqueue in push() against stop()'s
+        # running flip: once stop() holds this and flips the flag, no later
+        # push can sneak a grad past the final drain
+        self._push_lock = threading.Lock()
 
     def is_running(self):
         return self._running
@@ -73,7 +77,8 @@ class Communicator:
 
     def stop(self):
         global _active_comm
-        self._running = False
+        with self._push_lock:
+            self._running = False
         if self._thread is not None:
             self._thread.join()
             self._thread = None
@@ -100,25 +105,28 @@ class Communicator:
 
     def push(self, varname, arr, endpoint) -> bool:
         """Called by the send host op.  True = queued (the communicator owns
-        delivery); False = not a managed target, send inline.  A dead send
-        thread surfaces its error here rather than blocking the trainer
-        forever on a full queue."""
-        if self._error is not None:
-            raise RuntimeError(
-                "Communicator send thread died") from self._error
-        if not self._running:
-            return False
+        delivery); False = not managed / stopped, caller sends inline.  A
+        dead send thread surfaces its error here rather than blocking the
+        trainer forever on a full queue.  The check+enqueue runs under
+        _push_lock so a grad can never land in a queue after stop()'s
+        final drain (put_nowait under the lock — a blocking put would
+        deadlock against stop())."""
         q = self._queues.get((varname, endpoint))
         if q is None:
             return False
         while True:
-            try:
-                q.put(np.asarray(arr), timeout=1.0)
-                return True
-            except queue.Full:
+            with self._push_lock:
                 if self._error is not None:
                     raise RuntimeError(
                         "Communicator send thread died") from self._error
+                if not self._running:
+                    return False
+                try:
+                    q.put_nowait(np.asarray(arr))
+                    return True
+                except queue.Full:
+                    pass
+            time.sleep(0.001)
 
     def _send_loop(self):
         from paddle_tpu.ops import dist_ops

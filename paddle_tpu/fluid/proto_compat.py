@@ -209,10 +209,13 @@ _PROGRAMDESC = {1: ("blocks*", ("msg", _BLOCKDESC)),
  _AT_BOOLEAN, _AT_BOOLEANS, _AT_BLOCK, _AT_LONG, _AT_BLOCKS,
  _AT_LONGS) = range(12)
 
-# VarType.Type enum (framework.proto:77-99) — numeric dtypes only
+# VarType.Type enum (framework.proto:77-99) — numeric dtypes only.  This is
+# THE table; ops/common.np_dtype resolves enum-valued attrs through it (22 =
+# BF16 in the reference's later proto revisions).
 _DTYPE_BY_ENUM = {
     0: "bool", 1: "int16", 2: "int32", 3: "int64", 4: "float16",
     5: "float32", 6: "float64", 19: "uint64", 20: "uint8", 21: "int8",
+    22: "bfloat16",
 }
 _ENUM_BY_DTYPE = {v: k for k, v in _DTYPE_BY_ENUM.items()}
 _LOD_TENSOR, _SELECTED_ROWS, _FEED_MINIBATCH, _FETCH_LIST = 7, 8, 9, 10
@@ -225,10 +228,14 @@ _STEP_SCOPES, _LOD_TENSOR_ARRAY, _RAW = 11, 13, 17
 
 
 def is_program_proto(data: bytes) -> bool:
-    """Heuristic: our native format is JSON (first non-space byte '{');
-    a serialized ProgramDesc starts with field 1 length-delimited (0x0A)."""
-    head = data.lstrip()[:1] if data[:1] in b" \t\r\n{" else data[:1]
-    return head != b"{" and data[:1] == b"\x0a"
+    """A serialized ProgramDesc starts with its field-1 length-delimited
+    tag, 0x0A; our native JSON starts with '{' (json.dump writes no
+    leading whitespace).  0x0A is ALSO '\\n', so lstrip-then-check would
+    misread a proto whose next byte happens to be 0x7B ('{') as JSON —
+    the first byte must be inspected raw."""
+    if data[:1] == b"\x0a":
+        return True
+    return False
 
 
 def _attr_from_desc(a):

@@ -9,6 +9,12 @@ import jax.numpy as jnp
 
 
 def np_dtype(name):
+    if isinstance(name, (int, np.integer)):
+        # programs written by actual Fluid (cast/fill ops loaded via
+        # proto_compat) carry dtypes as VarType.Type enum integers
+        from paddle_tpu.fluid.proto_compat import _DTYPE_BY_ENUM
+
+        name = _DTYPE_BY_ENUM[int(name)]
     if name == "bfloat16":
         return jnp.bfloat16
     return np.dtype(name)

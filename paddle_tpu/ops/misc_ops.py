@@ -204,10 +204,12 @@ def _im2sequence(ctx, x, attrs):
     for i in range(kh):
         for j in range(kw):
             patches.append(xp[:, :, i:i + oh * sh:sh, j:j + ow * sw:sw])
-    # [N, C*kh*kw, oh, ow] → [N, oh*ow, C*kh*kw]
-    stk = jnp.concatenate(patches, axis=1)
+    # per-patch vector layout is (C, kh, kw) — channel-major, matching the
+    # reference's kOCF im2col (math/im2col.h); stacking on a NEW axis after
+    # C keeps channels outermost: [N, C, kh*kw, oh, ow]
+    stk = jnp.stack(patches, axis=2)
     stk = jnp.reshape(stk, (n, c * kh * kw, oh * ow))
-    return jnp.swapaxes(stk, 1, 2)
+    return jnp.swapaxes(stk, 1, 2)  # [N, oh*ow, C*kh*kw]
 
 
 @simple_op("chunk_eval",

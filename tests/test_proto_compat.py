@@ -173,8 +173,18 @@ def test_cross_validate_against_real_protobuf(tmp_path):
     w = vars_["fc_0.w_0"]
     assert w.persistable and list(w.type.lod_tensor.tensor.dims) == [13, 8]
 
-    # and the reverse: genuine protobuf output parses with our decoder
+    # and the reverse: genuine protobuf output parses with our decoder,
+    # with every proto-representable attr surviving the round trip
     prog2 = proto_compat.parse_program_bytes(pd.SerializeToString())
     assert [op.type for op in prog2.global_block().ops] == types
-    attrs = {op.type: op.attrs for op in prog2.global_block().ops}
-    assert attrs["relu"].get("op_role") is not None or True  # attrs survive
+    for orig, back in zip(main.global_block().ops,
+                          prog2.global_block().ops):
+        for k, v in orig.attrs.items():
+            if proto_compat._attr_to_desc(k, v) is None:
+                continue  # host-op python payloads are not portable
+            assert k in back.attrs, (orig.type, k)
+            got = back.attrs[k]
+            if isinstance(v, float):
+                assert abs(got - v) < 1e-6 * max(1, abs(v)), (k, got, v)
+            elif not hasattr(v, "idx"):  # Block attrs compare by idx
+                assert got == v, (orig.type, k, got, v)

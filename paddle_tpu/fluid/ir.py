@@ -212,6 +212,90 @@ class GraphVizPass(Pass):
         return graph
 
 
+@register_pass("fc_fuse_pass")
+class FcFusePass(Pass):
+    """reference ir/fc_fuse_pass.cc: mul + elementwise_add(bias) [+ relu]
+    → one `fc` op.  XLA fuses the unfused pattern anyway, so on TPU this
+    is an op-count/readability rewrite for exported inference programs —
+    but the fused program is also what actual Fluid's inference engine
+    expects after its own fc_fuse, so protobuf-exported models match."""
+
+    name = "fc_fuse_pass"
+
+    def __init__(self, with_relu=True):
+        self.with_relu = with_relu
+
+    def apply(self, graph):
+        block = graph.program.block(graph.block_idx)
+        # consumer counts across EVERY block: an intermediate read inside a
+        # while/cond sub-block must not be fused away
+        uses = {}
+        for b in graph.program.blocks:
+            for op in b.ops:
+                for n in op.input_arg_names:
+                    uses[n] = uses.get(n, 0) + 1
+
+        def single_use_tmp(name):
+            v = block._find_var_recursive(name)
+            return (uses.get(name, 0) == 1
+                    and (v is None or not v.persistable))
+
+        i = 0
+        while i < len(block.ops):
+            m = block.ops[i]
+            if m.type != "mul" or i + 1 >= len(block.ops):
+                i += 1
+                continue
+            # the fc kernel assumes a 2-D weight (reference fc_fuse_pass.cc
+            # fuses only w_dims == 2)
+            w_var = block._find_var_recursive(m.input("Y")[0])
+            if (m.attrs.get("y_num_col_dims", 1) != 1 or w_var is None
+                    or w_var.shape is None or len(w_var.shape) != 2):
+                i += 1
+                continue
+            a = block.ops[i + 1]
+            if (a.type != "elementwise_add"
+                    or a.input("X")[0] != m.output("Out")[0]
+                    or not single_use_tmp(m.output("Out")[0])):
+                i += 1
+                continue
+            bias_v = block._find_var_recursive(a.input("Y")[0])
+            if bias_v is None or bias_v.shape is None or len(bias_v.shape) != 1:
+                i += 1
+                continue
+            # bias must broadcast along the LAST axis — that is what the
+            # fused kernel's right-aligned `out + bias` computes
+            xd = m.attrs.get("x_num_col_dims", 1)
+            if a.attrs.get("axis", -1) not in (-1, xd):
+                i += 1
+                continue
+            act = ""
+            out_name = a.output("Out")[0]
+            span = 2
+            if (self.with_relu and i + 2 < len(block.ops)
+                    and block.ops[i + 2].type == "relu"
+                    and block.ops[i + 2].input("X")[0] == out_name
+                    and single_use_tmp(out_name)):
+                act = "relu"
+                out_name = block.ops[i + 2].output("Out")[0]
+                span = 3
+            x_v = block._find_var_recursive(m.input("X")[0])
+            w_v = block._find_var_recursive(m.input("Y")[0])
+            out_v = block._find_var_recursive(out_name)
+            attrs = {"in_num_col_dims": m.attrs.get("x_num_col_dims", 1),
+                     "activation_type": act,
+                     "op_role": m.attrs.get("op_role")}
+            for _ in range(span):
+                block._remove_op(i)
+            block._insert_op(i, "fc",
+                             inputs={"Input": [x_v], "W": [w_v],
+                                     "Bias": [bias_v]},
+                             outputs={"Out": [out_v]}, attrs=attrs)
+            i += 1
+        block.program._bump_version()
+        return graph
+
+
 @register_pass("conv_bn_fuse_pass")
 class ConvBNFusePass(Pass):
     """reference ir/conv_bn_fuse_pass.cc → InferenceTranspiler's conv+BN

@@ -146,6 +146,13 @@ class AnalysisPredictor:
                     model_filename=os.path.basename(config._prog_file),
                     params_filename=(os.path.basename(config._params_file)
                                      if config._params_file else None))
+        if getattr(config, "_ir_optim", True):
+            # kernel fusion is XLA's job, but program-level rewrites that
+            # still pay (smaller op graphs to trace) run here, mirroring
+            # the reference's analysis pass pipeline
+            from paddle_tpu.fluid import ir
+
+            ir.apply_pass(prog, "fc_fuse_pass")
         self._program = prog
         self._feed_names = list(feeds)
         self._fetch_vars = fetches

@@ -83,6 +83,25 @@ def _mul(ctx, x, y, attrs):
     return jnp.reshape(out, out_shape)
 
 
+@simple_op("fc", ["Input", "W", "Bias"], ["Out"], optional=("Bias",))
+def _fc(ctx, x, w, bias, attrs):
+    """Fused fully-connected (reference operators/fc_op.cc, produced by
+    ir/fc_fuse_pass.cc from mul + elementwise_add [+ activation]).  One
+    MXU matmul; bias/act fold into the same fusion under XLA."""
+    xd = attrs.get("in_num_col_dims", 1)
+    x2 = flatten_to_2d(x, xd)
+    out = jnp.dot(x2, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    out = jnp.reshape(out, tuple(jnp.shape(x)[:xd]) + (jnp.shape(w)[1],))
+    if bias is not None:
+        out = out + bias
+    act = attrs.get("activation_type", "")
+    if act == "relu":
+        out = jnp.maximum(out, 0)
+    elif act:
+        raise NotImplementedError(f"fc activation_type {act!r}")
+    return out
+
+
 @simple_op("matmul", ["X", "Y"], ["Out"])
 def _matmul(ctx, x, y, attrs):
     tx, ty = attrs.get("transpose_X", False), attrs.get("transpose_Y", False)

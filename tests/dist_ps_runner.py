@@ -26,8 +26,9 @@ import numpy as np  # noqa: E402
 import paddle_tpu.fluid as fluid  # noqa: E402
 from paddle_tpu.fluid.executor import Scope, scope_guard  # noqa: E402
 
-N_STEPS = 12
+N_STEPS = int(os.environ.get("DIST_PS_STEPS", "12"))
 GLOBAL_BATCH = 16
+SYNC_MODE = os.environ.get("DIST_PS_MODE", "sync") == "sync"
 
 
 def build(opt_name):
@@ -71,7 +72,8 @@ def run_pserver(ep, endpoints, n_trainers, opt_name):
     main, startup, loss = build(opt_name)
     t = fluid.DistributeTranspiler()
     t.transpile(trainer_id=0, program=main, pservers=endpoints,
-                trainers=n_trainers, startup_program=startup)
+                trainers=n_trainers, sync_mode=SYNC_MODE,
+                startup_program=startup)
     with scope_guard(Scope()):
         fluid.Executor(fluid.CPUPlace()).run(t.get_pserver_program(ep))
 
@@ -80,7 +82,8 @@ def run_trainer(tid, endpoints, n_trainers, opt_name, out_path):
     main, startup, loss = build(opt_name)
     t = fluid.DistributeTranspiler()
     t.transpile(trainer_id=tid, program=main, pservers=endpoints,
-                trainers=n_trainers, startup_program=startup)
+                trainers=n_trainers, sync_mode=SYNC_MODE,
+                startup_program=startup)
     trainer_prog = t.get_trainer_program()
     per = GLOBAL_BATCH // n_trainers
     losses = []

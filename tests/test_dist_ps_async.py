@@ -144,6 +144,43 @@ def test_async_communicator_converges():
     assert np.mean(losses[-5:]) < 0.5 * np.mean(losses[:5])
 
 
+def test_async_ps_2trainers_multiprocess(tmp_path):
+    """Reference test_dist_base async path: 2 trainer + 1 pserver real
+    processes; async promises convergence at smoke tolerance, not step
+    parity (grads apply as they arrive)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    runner = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "dist_ps_runner.py")
+    ep = f"127.0.0.1:{free_port()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DIST_PS_MODE="async",
+               DIST_PS_STEPS="40")
+    env.pop("XLA_FLAGS", None)
+
+    ps = subprocess.Popen(
+        [sys.executable, runner, "pserver", ep, ep, "2", "sgd"], env=env)
+    touts = [str(tmp_path / f"t{i}.json") for i in range(2)]
+    trainers = [subprocess.Popen(
+        [sys.executable, runner, "trainer", str(i), ep, "2", "sgd",
+         touts[i]], env=env) for i in range(2)]
+    try:
+        for p in trainers:
+            assert p.wait(timeout=300) == 0
+        fluid.transpiler.stop_pservers([ep])
+        assert ps.wait(timeout=30) == 0
+    finally:
+        for p in trainers + [ps]:
+            if p.poll() is None:
+                p.kill()
+    for path in touts:
+        losses = json.load(open(path))["losses"]
+        assert all(np.isfinite(losses))
+        assert np.mean(losses[-5:]) < 0.6 * np.mean(losses[:5]), losses[:8]
+
+
 # ---------------------------------------------------------------------------
 # geo-SGD
 # ---------------------------------------------------------------------------

@@ -1,0 +1,92 @@
+"""fc_fuse_pass: mul + elementwise_add [+ relu] → one fc op
+(reference ir/fc_fuse_pass.cc), numerically identical.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import ir
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+
+def _build(act=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[5], dtype="float32")
+        h = fluid.layers.fc(x, size=7, act=act)
+        out = fluid.layers.fc(h, size=2)
+    return main, startup, out
+
+
+def _run(main, startup, out, feed):
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (val,) = exe.run(main, feed=feed, fetch_list=[out.name])
+    return np.asarray(val)
+
+
+def test_fc_fuse_numeric_identity_and_op_count():
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(3, 5).astype("float32")}
+    main, startup, out = _build(act="relu")
+    before = _run(main, startup, out, feed)
+    n_before = len(main.global_block().ops)
+
+    ir.apply_pass(main, "fc_fuse_pass")
+    types = [op.type for op in main.global_block().ops]
+    # both fc layers fused; the relu folded into the first fc
+    assert types.count("fc") == 2, types
+    assert "mul" not in types and "elementwise_add" not in types
+    assert "relu" not in types
+    assert len(types) < n_before
+
+    after = _run(main, startup, out, feed)
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_fc_fuse_without_relu_folding():
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.randn(2, 5).astype("float32")}
+    main, startup, out = _build(act="relu")
+    before = _run(main, startup, out, feed)
+    ir.apply_pass(main, "fc_fuse_pass", with_relu=False)
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("fc") == 2 and "relu" in types
+    after = _run(main, startup, out, feed)
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_fc_fuse_skips_shared_intermediate():
+    """A mul output consumed twice must NOT be fused away."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        blk = main.global_block()
+        w = blk.create_parameter(name="w", shape=[4, 3], dtype="float32")
+        b = blk.create_parameter(name="b", shape=[3], dtype="float32")
+        t = blk.create_var(name="t", dtype="float32")
+        o1 = blk.create_var(name="o1", dtype="float32")
+        o2 = blk.create_var(name="o2", dtype="float32")
+        blk.append_op("mul", inputs={"X": [x], "Y": [w]},
+                      outputs={"Out": [t]},
+                      attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})
+        blk.append_op("elementwise_add", inputs={"X": [t], "Y": [b]},
+                      outputs={"Out": [o1]}, attrs={"axis": -1})
+        blk.append_op("scale", inputs={"X": [t]}, outputs={"Out": [o2]},
+                      attrs={"scale": 2.0})
+    ir.apply_pass(main, "fc_fuse_pass")
+    types = [op.type for op in main.global_block().ops]
+    assert "mul" in types and "fc" not in types
+
+
+def test_fused_program_exports_to_protobuf(tmp_path):
+    """The fused fc op round-trips through the reference protobuf format."""
+    from paddle_tpu.fluid import proto_compat
+
+    main, startup, out = _build()
+    ir.apply_pass(main, "fc_fuse_pass")
+    prog2 = proto_compat.parse_program_bytes(
+        proto_compat.serialize_program(main))
+    assert [o.type for o in prog2.global_block().ops] == [
+        o.type for o in main.global_block().ops]

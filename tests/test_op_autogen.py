@@ -55,11 +55,11 @@ SPECS = []
 
 def S(op, inputs, ref=None, attrs=None, grads="auto", out_slots=("Out",),
       lw=None, mre=0.01, delta=1e-2, tols=(1e-5, 1e-4), grad_out=None,
-      no_check=None, marks=()):
+      no_check=None):
     SPECS.append(dict(op=op, inputs=inputs, ref=ref, attrs=attrs or {},
                       grads=grads, out_slots=out_slots, lw=lw, mre=mre,
                       delta=delta, tols=tols, grad_out=grad_out,
-                      no_check=no_check, marks=marks))
+                      no_check=no_check))
 
 
 # ---------------------------------------------------------------------------

@@ -222,8 +222,12 @@ class FcFusePass(Pass):
 
     name = "fc_fuse_pass"
 
-    def __init__(self, with_relu=True):
+    def __init__(self, with_relu=True, keep_vars=()):
         self.with_relu = with_relu
+        # names that must keep a producer even if consumed once in-program:
+        # fetch targets live OUTSIDE the program here (the executor takes a
+        # fetch-name list; there are no fetch ops for the use-count to see)
+        self.keep_vars = frozenset(keep_vars)
 
     def apply(self, graph):
         block = graph.program.block(graph.block_idx)
@@ -237,7 +241,7 @@ class FcFusePass(Pass):
 
         def single_use_tmp(name):
             v = block._find_var_recursive(name)
-            return (uses.get(name, 0) == 1
+            return (uses.get(name, 0) == 1 and name not in self.keep_vars
                     and (v is None or not v.persistable))
 
         i = 0
@@ -283,8 +287,11 @@ class FcFusePass(Pass):
             w_v = block._find_var_recursive(m.input("Y")[0])
             out_v = block._find_var_recursive(out_name)
             attrs = {"in_num_col_dims": m.attrs.get("x_num_col_dims", 1),
-                     "activation_type": act,
-                     "op_role": m.attrs.get("op_role")}
+                     "activation_type": act}
+            if "op_role" in m.attrs:
+                # an explicit op_role=None would make clone(for_test=True)'s
+                # role filter drop the op — forward ops carry NO role attr
+                attrs["op_role"] = m.attrs["op_role"]
             for _ in range(span):
                 block._remove_op(i)
             block._insert_op(i, "fc",

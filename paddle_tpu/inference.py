@@ -149,10 +149,14 @@ class AnalysisPredictor:
         if getattr(config, "_ir_optim", True):
             # kernel fusion is XLA's job, but program-level rewrites that
             # still pay (smaller op graphs to trace) run here, mirroring
-            # the reference's analysis pass pipeline
+            # the reference's analysis pass pipeline.  Fetch targets are a
+            # name list outside the program, invisible to the pass's
+            # use-count — pin them explicitly
             from paddle_tpu.fluid import ir
 
-            ir.apply_pass(prog, "fc_fuse_pass")
+            ir.apply_pass(prog, "fc_fuse_pass",
+                          keep_vars=[v.name if hasattr(v, "name") else v
+                                     for v in fetches])
         self._program = prog
         self._feed_names = list(feeds)
         self._fetch_vars = fetches

@@ -80,6 +80,38 @@ def test_fc_fuse_skips_shared_intermediate():
     assert "mul" in types and "fc" not in types
 
 
+def test_fc_fuse_respects_keep_vars_and_clone():
+    """(a) keep_vars pins a fetch-target intermediate (fetch lists live
+    outside the program — the pass can't see them); (b) fused ops carry no
+    explicit op_role=None, so clone(for_test=True)'s role filter keeps
+    them."""
+    rng = np.random.RandomState(2)
+    feed = {"x": rng.randn(3, 5).astype("float32")}
+    main, startup, out = _build(act="relu")
+    blk = main.global_block()
+    # the pre-relu add output (single in-program use) as a fetch target
+    relu_op = [op for op in blk.ops if op.type == "relu"][0]
+    hidden_name = relu_op.input("X")[0]
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (want_h,) = exe.run(main, feed=feed, fetch_list=[hidden_name])
+
+        ir.apply_pass(main, "fc_fuse_pass", keep_vars=[hidden_name])
+        types = [op.type for op in blk.ops]
+        assert "relu" in types  # relu NOT folded: its input is pinned
+        assert types.count("fc") == 2
+        (got_h,) = exe.run(main, feed=feed, fetch_list=[hidden_name])
+        np.testing.assert_allclose(np.asarray(got_h), np.asarray(want_h),
+                                   rtol=1e-6)
+        # fused forward ops survive clone(for_test=True)
+        test_prog = main.clone(for_test=True)
+        t_types = [op.type for op in test_prog.global_block().ops]
+        assert t_types.count("fc") == 2
+        for op in test_prog.global_block().ops:
+            assert op.attrs.get("op_role", "forward") is not None
+
+
 def test_fused_program_exports_to_protobuf(tmp_path):
     """The fused fc op round-trips through the reference protobuf format."""
     from paddle_tpu.fluid import proto_compat

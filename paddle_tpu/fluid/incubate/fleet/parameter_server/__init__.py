@@ -54,15 +54,32 @@ class ParameterServerFleet(Fleet):
     def distributed_optimizer(self, optimizer, strategy=None):
         return TranspilerOptimizer(optimizer, strategy, fleet=self)
 
-    def _transpile(self, loss, startup_program):
-        config = fluid.DistributeTranspilerConfig()
-        t = fluid.DistributeTranspiler(config=config)
+    def _transpile(self, loss, startup_program, strategy=None):
+        """strategy: a DistributeTranspilerConfig (or None).  Its
+        `sync_mode` selects the sync rendezvous rounds vs the async
+        RunAsyncLoop; `mode="geo"` selects GeoSgdTranspiler (local
+        optimizer + k-step delta sync, k = geo_sgd_need_push_nums) —
+        mirroring the reference fleet's DistributedStrategy routing."""
+        if strategy is None:
+            config = fluid.DistributeTranspilerConfig()
+        elif isinstance(strategy, fluid.DistributeTranspilerConfig):
+            config = strategy
+        else:
+            raise TypeError(
+                "ParameterServerFleet strategy must be a "
+                "DistributeTranspilerConfig (reference TranspilerOptimizer "
+                f"raises likewise), got {type(strategy).__name__}")
+        if getattr(config, "mode", "pserver") == "geo":
+            t = fluid.transpiler.GeoSgdTranspiler(config=config)
+        else:
+            t = fluid.DistributeTranspiler(config=config)
         program = loss.block.program
         t.transpile(
             trainer_id=self.worker_index(),
             program=program,
             pservers=",".join(self._role_maker.get_pserver_endpoints()),
             trainers=self.worker_num(),
+            sync_mode=bool(getattr(config, "sync_mode", True)),
             startup_program=startup_program
             or fluid.default_startup_program())
         self._transpiler = t
@@ -94,7 +111,8 @@ class TranspilerOptimizer(DistributedOptimizer):
                  no_grad_set=None):
         ops, pg = self._optimizer.minimize(loss, startup_program,
                                            parameter_list, no_grad_set)
-        self._fleet._transpile(loss, startup_program)
+        self._fleet._transpile(loss, startup_program,
+                               strategy=self._strategy)
         return ops, pg
 
 

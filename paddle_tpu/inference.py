@@ -146,6 +146,8 @@ class AnalysisPredictor:
                     model_filename=os.path.basename(config._prog_file),
                     params_filename=(os.path.basename(config._params_file)
                                      if config._params_file else None))
+        fetch_names = [v.name if hasattr(v, "name") else v
+                       for v in fetches]
         if getattr(config, "_ir_optim", True):
             # kernel fusion is XLA's job, but program-level rewrites that
             # still pay (smaller op graphs to trace) run here, mirroring
@@ -154,14 +156,11 @@ class AnalysisPredictor:
             # use-count — pin them explicitly
             from paddle_tpu.fluid import ir
 
-            ir.apply_pass(prog, "fc_fuse_pass",
-                          keep_vars=[v.name if hasattr(v, "name") else v
-                                     for v in fetches])
+            ir.apply_pass(prog, "fc_fuse_pass", keep_vars=fetch_names)
         self._program = prog
         self._feed_names = list(feeds)
         self._fetch_vars = fetches
-        self._fetch_names = [v.name if hasattr(v, "name") else v
-                             for v in fetches]
+        self._fetch_names = fetch_names
         self._staged = {}
         self._outputs = {}
 

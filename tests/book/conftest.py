@@ -2,18 +2,11 @@ import os
 import sys
 
 # Standalone-safe: when pytest is invoked from INSIDE tests/book, the parent
-# tests/conftest.py is outside the confcut and never loads — without this
-# mirror, the first Executor.run would initialize the ambient axon TPU
-# platform (whose tunnel can wedge) instead of the virtual CPU mesh.
-if not os.environ.get("PADDLE_TPU_TEST_REAL"):
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+# tests/conftest.py is outside the confcut and never loads — without this,
+# the first Executor.run would initialize the ambient axon TPU platform
+# (whose tunnel can wedge) instead of the virtual CPU mesh.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import cpu_mesh  # noqa: F401,E402  (must precede any jax-using import)
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(

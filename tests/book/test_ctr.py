@@ -10,12 +10,12 @@ row-sparse (SelectedRows), and step-for-step loss parity vs the local run
 validates the whole sync sparse path at model scale.
 """
 
-import socket
 import threading
 
 import numpy as np
 
 from book_util import train_save_load_infer
+from net_util import free_port
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid.executor import Scope, scope_guard
@@ -70,11 +70,6 @@ def test_ctr_local(tmp_path):
 def test_ctr_parameter_server_sparse_parity(tmp_path):
     """The reference book tests' is_local=False branch: same model through
     sync PS with server-side sparse tables, step-for-step loss parity."""
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
-
     data = synthetic_clicks(n_batches=15)
 
     def build_program():

@@ -5,17 +5,7 @@ environment).  Must run before jax import anywhere."""
 import os
 import sys
 
-# The ambient environment pins JAX_PLATFORMS to the TPU plugin; tests always
-# run on the virtual CPU mesh unless PADDLE_TPU_TEST_REAL=1 is set.
-if not os.environ.get("PADDLE_TPU_TEST_REAL"):
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-    # sitecustomize (axon TPU plugin) pre-imports jax config before this
-    # conftest runs, freezing JAX_PLATFORMS=axon — override via the config API
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import cpu_mesh  # noqa: F401,E402  (must precede any jax-using import)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,22 @@
+"""Force the 8-device virtual CPU mesh — shared by every conftest.
+
+Import this BEFORE any jax-using import.  The ambient environment pins
+JAX_PLATFORMS to the axon TPU plugin (whose tunnel can wedge so hard that
+device enumeration hangs); tests always run on the virtual CPU mesh
+unless PADDLE_TPU_TEST_REAL=1 is set.
+"""
+
+import os
+
+if not os.environ.get("PADDLE_TPU_TEST_REAL"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+    # sitecustomize (axon TPU plugin) pre-imports jax config before any
+    # conftest runs, freezing JAX_PLATFORMS=axon — override via the config
+    # API
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,12 @@
+"""Shared networking helpers for the distributed tests."""
+
+import socket
+
+
+def free_port():
+    """An ephemeral port the OS just vended (bind-and-release probe; the
+    standard TOCTOU caveat applies — tests open the real listener
+    immediately after)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

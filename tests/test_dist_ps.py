@@ -7,7 +7,6 @@ single-process run (sync mode ⇒ tight delta, test_dist_mnist.py:26).
 """
 
 import json
-import socket
 import subprocess
 import sys
 import threading
@@ -16,6 +15,7 @@ import os
 import numpy as np
 import pytest
 
+from net_util import free_port
 import paddle_tpu.fluid as fluid
 from paddle_tpu import native
 from paddle_tpu.fluid.executor import Scope, scope_guard
@@ -23,11 +23,6 @@ from paddle_tpu.fluid.executor import Scope, scope_guard
 HERE = os.path.dirname(os.path.abspath(__file__))
 RUNNER = os.path.join(HERE, "dist_ps_runner.py")
 
-
-def free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 # ---------------------------------------------------------------------------

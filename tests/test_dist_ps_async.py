@@ -8,20 +8,15 @@ is_sparse embeddings.  Same split here, in-process (pserver thread +
 trainer in the main thread, 127.0.0.1 transport).
 """
 
-import socket
 import threading
 
 import numpy as np
 import pytest
 
+from net_util import free_port
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid.executor import Scope, scope_guard
 
-
-def free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def _build_fit_a_line(opt):

@@ -1,12 +1,12 @@
 """Fleet API tests (reference incubate/fleet): role makers, collective
 fleet graph rewrite, PS fleet end to end on localhost threads."""
 
-import socket
 import threading
 
 import numpy as np
 import pytest
 
+from net_util import free_port
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid.executor import Scope, scope_guard
 from paddle_tpu.fluid.incubate.fleet.base.role_maker import (
@@ -17,11 +17,6 @@ from paddle_tpu.fluid.incubate.fleet.collective import (
 from paddle_tpu.fluid.incubate.fleet.parameter_server import (
     ParameterServerFleet)
 
-
-def free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def _model(opt=None):
@@ -145,3 +140,74 @@ def test_ps_fleet_end_to_end():
         st.join(timeout=15)
     assert not st.is_alive()
     np.testing.assert_allclose(dist, local, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["async", "geo"])
+def test_ps_fleet_strategy_routing(mode):
+    """DistributeTranspilerConfig routes through distributed_optimizer:
+    sync_mode=False → async transpile (no barriers); mode="geo" →
+    GeoSgdTranspiler (local optimizer + geo_sgd_sync op), mirroring the
+    reference fleet's DistributedStrategy switch."""
+    from paddle_tpu.ops import dist_ops
+
+    dist_ops.reset_geo_state()
+    port = free_port()
+    eps = [f"127.0.0.1:{port}"]
+    rng = np.random.RandomState(1)
+    W = rng.uniform(-1, 1, (8, 1)).astype("float32")
+    batches = [{"x": (xb := rng.uniform(-1, 1, (16, 8)).astype("float32")),
+                "y": xb @ W} for _ in range(40)]
+
+    cfg = fluid.DistributeTranspilerConfig()
+    if mode == "async":
+        cfg.sync_mode = False
+    else:
+        cfg.mode = "geo"
+        cfg.geo_sgd_need_push_nums = 5
+
+    fs = ParameterServerFleet().init(UserDefinedRoleMaker(
+        current_id=0, role=Role.SERVER, worker_num=1, server_endpoints=eps))
+    smain, sstartup, sloss = _model()
+    with fluid.program_guard(smain, sstartup), fluid.unique_name.guard("opt_"):
+        fs.distributed_optimizer(
+            fluid.optimizer.SGD(learning_rate=0.05),
+            strategy=cfg).minimize(sloss)
+    serv_op = fs._transpiler.get_pserver_program(
+        eps[0]).global_block().ops[0]
+    assert serv_op.attrs["sync_mode"] is False  # both modes are async
+    fs.init_server()
+
+    def server():
+        with scope_guard(Scope()):
+            fs.run_server()
+
+    st = threading.Thread(target=server)
+    st.start()
+
+    f = ParameterServerFleet().init(UserDefinedRoleMaker(
+        current_id=0, role=Role.WORKER, worker_num=1, server_endpoints=eps))
+    main, startup, loss = _model()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard("opt_"):
+        f.distributed_optimizer(
+            fluid.optimizer.SGD(learning_rate=0.05),
+            strategy=cfg).minimize(loss)
+    types = [op.type for op in f.main_program.global_block().ops]
+    if mode == "async":
+        assert "send" in types and "send_barrier" not in types
+    else:
+        assert "geo_sgd_sync" in types and "sgd" in types
+    losses = []
+    try:
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            f.init_worker(exe)
+            for b in batches:
+                (lv,) = exe.run(f.main_program, feed=b,
+                                fetch_list=[loss.name])
+                losses.append(float(np.asarray(lv)))
+    finally:
+        f.stop_servers()
+        st.join(timeout=15)
+    assert not st.is_alive()
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < 0.5 * np.mean(losses[:5])

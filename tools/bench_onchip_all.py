@@ -1,0 +1,107 @@
+"""One-shot on-chip validation suite — run when the TPU tunnel is up.
+
+The axon device tunnel wedges for hours at a time, so every on-chip
+number this round needs is collected by ONE command the moment a window
+opens:
+
+  1. headline: bert-base b128 s128 fp32 tokens/sec + MFU
+  2. bf16 policy A/B at the same shape (target: beats fp32)
+  3. cast-insertion AMP at the same shape (expected slower — recorded
+     for the comparison table)
+  4. long-sequence flash sweep + GPT decode (tools/bench_longseq.py)
+  5. resnet50 images/sec
+
+Writes ONCHIP_RESULTS.json at the repo root.  Each config runs in a
+watchdog child (bench.py PT_BENCH_CHILD mode); a wedge mid-suite still
+leaves every completed number on disk (the file is rewritten after each
+step).
+
+  PYTHONPATH=/root/repo:/root/.axon_site python tools/bench_onchip_all.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(ROOT, "bench.py")
+OUT = os.path.join(ROOT, "ONCHIP_RESULTS.json")
+
+
+def probe(budget=120):
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices()[0]; "
+             "print(d.platform, d.device_kind)"],
+            capture_output=True, text=True, timeout=budget)
+    except subprocess.TimeoutExpired:
+        return None
+    return out.stdout.strip() if out.returncode == 0 else None
+
+
+def run_bench(label, extra_env, budget):
+    env = dict(os.environ, PT_BENCH_CHILD="base", **extra_env)
+    try:
+        out = subprocess.run([sys.executable, BENCH], env=env,
+                             capture_output=True, text=True, timeout=budget)
+    except subprocess.TimeoutExpired:
+        return {"label": label, "error": f"timeout {budget:.0f}s"}
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    if out.returncode != 0 or not lines:
+        return {"label": label, "error": out.stderr[-400:]}
+    rec = json.loads(lines[-1])
+    rec["label"] = label
+    return rec
+
+
+def main():
+    budget = float(os.environ.get("PT_BENCH_TIMEOUT", "1200"))
+    results = {"device": probe()}
+    if results["device"] is None:
+        print(json.dumps({"error": "device probe hung — tunnel wedged"}))
+        return 1
+
+    def save():
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+
+    save()
+    steps = [
+        ("fp32_headline", {}),
+        ("bf16_policy", {"PT_BENCH_BF16": "1"}),
+        ("amp_rewrite", {"PT_BENCH_AMP": "1"}),
+        ("resnet50", {"PT_BENCH_MODEL": "resnet50"}),
+    ]
+    for label, env in steps:
+        results[label] = run_bench(label, env, budget)
+        print(json.dumps(results[label]), flush=True)
+        save()
+
+    if ("value" in results.get("fp32_headline", {})
+            and "value" in results.get("bf16_policy", {})):
+        results["bf16_speedup"] = round(
+            results["bf16_policy"]["value"]
+            / results["fp32_headline"]["value"], 3)
+
+    # long-seq flash sweep + GPT decode (writes its own sidecar too)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "bench_longseq.py")],
+            capture_output=True, text=True, timeout=budget * 7)
+        lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+        results["longseq"] = (json.loads(lines[-1]) if lines
+                              else {"error": out.stderr[-400:]})
+    except subprocess.TimeoutExpired:
+        results["longseq"] = {"error": "sweep timeout"}
+    save()
+    print(json.dumps({"written": OUT,
+                      "bf16_speedup": results.get("bf16_speedup")}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

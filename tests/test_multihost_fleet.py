@@ -5,12 +5,13 @@ mesh, and a psum across HOSTS returns the cross-process sum."""
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
 import numpy as np
 import pytest
+
+from net_util import free_port
 
 _CHILD = r'''
 import json, os, sys
@@ -42,19 +43,12 @@ print("RESULT " + json.dumps(out), flush=True)
 '''
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    p = s.getsockname()[1]
-    s.close()
-    return p
-
 
 def test_two_process_fleet_collective(tmp_path):
     import numpy as np  # noqa: F401 (child uses np; parent asserts)
 
-    port = _free_port()
-    eps = f"127.0.0.1:{port},127.0.0.1:{_free_port()}"
+    port = free_port()
+    eps = f"127.0.0.1:{port},127.0.0.1:{free_port()}"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     procs = []
     for wid in range(2):

@@ -53,7 +53,10 @@ def run_bench(label, extra_env, budget):
     lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
     if out.returncode != 0 or not lines:
         return {"label": label, "error": out.stderr[-400:]}
-    rec = json.loads(lines[-1])
+    try:
+        rec = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return {"label": label, "error": f"unparseable: {lines[-1][:200]}"}
     rec["label"] = label
     return rec
 
@@ -97,6 +100,8 @@ def main():
                               else {"error": out.stderr[-400:]})
     except subprocess.TimeoutExpired:
         results["longseq"] = {"error": "sweep timeout"}
+    except json.JSONDecodeError as e:
+        results["longseq"] = {"error": f"unparseable sweep output: {e}"}
     save()
     print(json.dumps({"written": OUT,
                       "bf16_speedup": results.get("bf16_speedup")}))

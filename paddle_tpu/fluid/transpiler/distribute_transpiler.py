@@ -7,11 +7,13 @@ send_barrier (:592) / recv (:662) / fetch_barrier (:678) ops, and moves the
 optimizer ops into per-block sub-blocks of a listen_and_serv pserver program.
 
 This build keeps the same program-rewrite architecture and wire protocol
-shape over the native TCP transport (native/src/ps_runtime.cc) with one
-simplification: placement is whole-parameter round-robin (largest-first)
-rather than row-sliced blocks — on TPU the dense path rides XLA collectives,
-and the PS mode exists for sparse/host-side workloads where whole-var
-placement is the common case.  `slice_var_up` is accepted for API parity.
+shape over the native TCP transport (native/src/ps_runtime.cc).  DENSE
+params place whole-var round-robin (largest-first) — on TPU the dense path
+rides XLA collectives, so per-var slicing buys nothing.  SPARSE tables
+(is_sparse lookups) are where slicing matters, and there `slice_var_up`
+does what the reference's VarBlock slicing does: the table row-shards
+across ALL pservers, ids route to the owning shard, and optimizer state
+slices with it.
 
 Init sync differs from the reference deliberately: instead of duplicating
 param initializers into the pserver startup program, trainer 0 pushes its
@@ -28,8 +30,9 @@ from ..framework import Program, default_main_program, default_startup_program
 
 
 class DistributeTranspilerConfig:
-    """Reference :131.  slice_var_up / split_method / min_block_size are
-    accepted for API parity; placement is whole-var round-robin."""
+    """Reference :131.  slice_var_up (default True) row-shards SPARSE
+    tables across all pservers; dense params place whole-var round-robin
+    (split_method / min_block_size accepted for API parity)."""
 
     slice_var_up = True
     split_method = "RoundRobin"
@@ -126,7 +129,13 @@ class DistributeTranspiler:
         the trainer prefetches rows (distributed_lookup pre-op) and pushes
         row-sparse SelectedRows grads back (reference
         parameter_prefetch.cc + selected_rows.h).  The vocab-sized dense
-        param/grad never crosses the wire."""
+        param/grad never crosses the wire.
+
+        With slice_var_up (the default) and multiple pservers, each table
+        is ROW-SHARDED across all endpoints — the reference's VarBlock
+        slicing (distribute_transpiler.py:70 slice_variable) applied where
+        it matters most: ids route to the shard owning their row range,
+        so lookup traffic, gradients, and optimizer state all balance."""
         self.sparse_tables = {}  # param -> rewrite info
         blk = self.origin_program.global_block()
         for op in blk.ops:
@@ -141,12 +150,26 @@ class DistributeTranspiler:
                     f"row grads would be mis-averaged server-side — use "
                     f"is_sparse=False for shared tables")
             wv = blk._find_var_recursive(w)
+            rows = int(wv.shape[0])
+            if getattr(self.config, "slice_var_up", True):
+                eps = self.endpoints
+            else:
+                eps = [self.param_endpoint[w]]
+            n = min(len(eps), rows)
+            base, rem = divmod(rows, n)
+            shards, start = [], 0
+            for k in range(n):
+                end = start + base + (1 if k < rem else 0)
+                shards.append((eps[k], start, end))
+                start = end
             self.sparse_tables[w] = {
                 "ids": op.input("Ids")[0],
                 "out": op.output("Out")[0],
                 "padding_idx": op.attrs.get("padding_idx", -1),
                 "row_width": int(wv.shape[-1]),
                 "dtype": str(wv.dtype),
+                "rows": rows,
+                "shards": shards,
             }
 
     def _rewrite_sparse_ops(self, blk):
@@ -169,7 +192,7 @@ class DistributeTranspiler:
                 blk._insert_op(
                     i, "distributed_lookup", inputs={"Ids": [ids_v]},
                     outputs={"Out": [rows_v]},
-                    attrs={"endpoint": self.param_endpoint[w],
+                    attrs={"shards": info["shards"],
                            "table_name": w, "row_width": info["row_width"],
                            "dtype": info["dtype"]})
                 blk._insert_op(
@@ -188,7 +211,7 @@ class DistributeTranspiler:
                 blk._remove_op(i)
                 blk._insert_op(
                     i, "send_sparse", inputs={"X": [og_v], "Ids": [ids_v]},
-                    attrs={"endpoint": self.param_endpoint[w],
+                    attrs={"shards": info["shards"],
                            "varname": grad_of[w],
                            "padding_idx": info["padding_idx"]})
                 i += 1
@@ -229,35 +252,64 @@ class DistributeTranspiler:
     def get_trainer_program(self):
         return self.trainer_program
 
+    def _sliced_row_states(self, param):
+        """State vars that shard with the table's rows: the param itself
+        plus any accumulator whose leading dim equals the vocab (Adam
+        moments, Adagrad sums...).  Scalars (lr, beta pows) replicate to
+        every shard server."""
+        rows = self.sparse_tables[param]["rows"]
+        blk = self.origin_program.global_block()
+        out = set()
+        for n in self._state_names[param]:
+            v = blk._find_var_recursive(n)
+            if v is not None and v.shape and int(v.shape[0]) == rows:
+                out.add(n)
+        return out
+
     def _rewrite_startup_program(self):
         push, pull = [], []
+        push_slices = []  # (name, ep, row_start, row_end)
         for p, st in self._state_names.items():
+            if p in self.sparse_tables:
+                sliced = self._sliced_row_states(p)
+                for ep, start, end in self.sparse_tables[p]["shards"]:
+                    for n in st:
+                        if n in sliced:
+                            push_slices.append((n, ep, start, end))
+                        else:
+                            push.append((n, ep))
+                continue  # server-side only: never pulled to the trainer
             ep = self.param_endpoint[p]
             for n in st:
                 push.append((n, ep))
-            if p not in self.sparse_tables:  # sparse tables live server-side
-                pull.append((p, ep))
+            pull.append((p, ep))
         self.startup_program.global_block().append_op(
             "ps_init_sync",
             attrs={"trainer_id": self.trainer_id, "push_vars": push,
-                   "pull_vars": pull})
+                   "push_slices": push_slices, "pull_vars": pull})
 
     # -- pserver side ----------------------------------------------------
-    def _build_opt_program(self, param):
+    def _build_opt_program(self, param, row_range=None):
         """Clone this param's optimize ops into a standalone program whose
-        vars mirror the originals (shape/dtype); Grad is the only feed."""
+        vars mirror the originals (shape/dtype); Grad is the only feed.
+        row_range: this server's (start, end) slice of a row-sharded
+        table — row-dimensioned vars take the sliced shape."""
         src_blk = self.origin_program.global_block()
         prog = Program()
         blk = prog.global_block()
         grad = dict(self.param_grads)[param]
+        sliced = (self._sliced_row_states(param) | {grad}
+                  if row_range is not None else set())
         names = set()
         for op in self._per_param_ops[param]:
             names.update(op.input_arg_names)
             names.update(op.output_arg_names)
         for n in sorted(names):
             v = src_blk._find_var_recursive(n)
-            blk.create_var(name=n,
-                           shape=None if v is None else v.shape,
+            shape = None if v is None else v.shape
+            if n in sliced and shape:
+                shape = (row_range[1] - row_range[0],) + tuple(shape[1:])
+            blk.create_var(name=n, shape=shape,
                            dtype=None if v is None else v.dtype,
                            persistable=(n != grad))
         for op in self._per_param_ops[param]:
@@ -273,6 +325,14 @@ class DistributeTranspiler:
         prog = Program()
         param_blocks = []
         for p, g in self.param_grads:
+            if p in self.sparse_tables:
+                for ep, start, end in self.sparse_tables[p]["shards"]:
+                    if ep == endpoint:
+                        param_blocks.append(
+                            (p, g, self._build_opt_program(
+                                p, row_range=(start, end)),
+                             list(self._state_names[p])))
+                continue
             if self.param_endpoint[p] != endpoint:
                 continue
             param_blocks.append((p, g, self._build_opt_program(p),

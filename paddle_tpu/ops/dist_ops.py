@@ -118,28 +118,76 @@ def _send_run(scope, op, place):
     get_channel(op.attrs["endpoint"]).client.send_grad(varname, arr)
 
 
+def _shards_of(op):
+    """[(endpoint, row_start, row_end)] — row-sharded tables carry a
+    `shards` attr; a bare `endpoint` attr means one shard owning all rows."""
+    shards = op.attrs.get("shards")
+    if shards:
+        return [(ep, int(s), int(e)) for ep, s, e in shards]
+    return [(op.attrs["endpoint"], 0, 1 << 62)]
+
+
 def _distributed_lookup_run(scope, op, place):
-    """Pre-stage: fetch the fed ids' embedding rows from the pserver that
-    owns the table (reference parameter_prefetch.cc prefetch)."""
-    ch = get_channel(op.attrs["endpoint"])
-    ids = np.asarray(scope.get(op.input("Ids")[0]))
-    rows = ch.client.lookup_rows(op.attrs["table_name"], ids.reshape(-1),
-                                 op.attrs["dtype"], op.attrs["row_width"])
-    scope.set(op.output("Out")[0], rows)
+    """Pre-stage: fetch the fed ids' embedding rows from the pserver(s)
+    owning their row ranges (reference parameter_prefetch.cc prefetch +
+    the transpiler's VarBlock row slicing).  Shard fetches are independent
+    RPCs — issued concurrently, like the reference's per-server prefetch
+    threads."""
+    ids = np.asarray(scope.get(op.input("Ids")[0])).reshape(-1)
+    width = int(op.attrs["row_width"])
+    dtype = op.attrs["dtype"]
+    out = np.zeros((len(ids), width), dtype)
+    covered = np.zeros(len(ids), bool)
+    shards = _shards_of(op)
+    work = []
+    for ep, start, end in shards:
+        mask = (ids >= start) & (ids < end)
+        if not mask.any():
+            continue
+        covered |= mask
+        work.append((ep, mask, ids[mask] - start))
+    if not covered.all():
+        bad = ids[~covered]
+        raise IndexError(
+            f"distributed_lookup: ids outside every shard of "
+            f"{op.attrs['table_name']!r}: {bad[:5]}...")
+
+    def fetch(item):
+        ep, mask, local = item
+        return mask, get_channel(ep).client.lookup_rows(
+            op.attrs["table_name"], local, dtype, width)
+
+    if len(work) <= 1:
+        results = [fetch(w) for w in work]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(work)) as pool:
+            results = list(pool.map(fetch, work))
+    for mask, rows in results:
+        out[mask] = rows
+    scope.set(op.output("Out")[0], out)
 
 
 def _send_sparse_run(scope, op, place):
-    """Row-sparse (SelectedRows) grad push: ships (ids, row grads), not the
-    vocab-sized dense tensor (reference send_op with SelectedRows input).
-    padding_idx occurrences carry zero grad (their forward output is zero
-    regardless of the table row)."""
-    ch = get_channel(op.attrs["endpoint"])
+    """Row-sparse (SelectedRows) grad push: ships (ids, row grads) to the
+    shard owning each row, not the vocab-sized dense tensor (reference
+    send_op with SelectedRows input).  padding_idx occurrences carry zero
+    grad (their forward output is zero regardless of the table row).
+
+    A shard with NO local rows still receives an EMPTY partial: the sync
+    server averages by the number of partials received, so every trainer
+    must show up in every shard's count every round — and an empty round
+    still advances step-dependent optimizer state (Adam beta powers)."""
     ids = np.asarray(scope.get(op.input("Ids")[0])).reshape(-1)
     rows = np.asarray(scope.get(op.input("X")[0])).reshape(len(ids), -1)
     pad = op.attrs.get("padding_idx", -1)
     if pad is not None and pad >= 0 and (ids == pad).any():
         rows = np.where((ids == pad)[:, None], 0.0, rows).astype(rows.dtype)
-    ch.client.send_sparse_grad(op.attrs["varname"], ids, rows)
+    for ep, start, end in _shards_of(op):
+        mask = (ids >= start) & (ids < end)
+        get_channel(ep).client.send_sparse_grad(
+            op.attrs["varname"], ids[mask] - start, rows[mask])
 
 
 def _send_barrier_run(scope, op, place):
@@ -176,10 +224,15 @@ def _ps_init_sync_run(scope, op, place):
     trainer_id = op.attrs["trainer_id"]
     push_vars = op.attrs["push_vars"]  # [(name, endpoint)]
     pull_vars = op.attrs["pull_vars"]  # [(name, endpoint)]
+    push_slices = op.attrs.get("push_slices", ())  # [(name, ep, start, end)]
     shadows = set(op.attrs.get("shadow_vars", ()))
     if trainer_id == 0:
         for name, ep in push_vars:
             get_channel(ep).client.send_param(name, np.asarray(scope.get(name)))
+        for name, ep, start, end in push_slices:
+            # row-sharded table/accumulator: each server gets its row slice
+            arr = np.asarray(scope.get(name))
+            get_channel(ep).client.send_param(name, arr[int(start):int(end)])
     for name, ep in pull_vars:
         var = op.block._find_var_recursive(name) if op.block is not None else None
         arr = get_channel(ep).client.get_param(name, want_version=0)
@@ -243,12 +296,18 @@ def _merge_sparse(parts):
     """[(rows, vals)] partial SelectedRows grads → (unique rows, per-row
     sum divided by the TOTAL partial count).  An untouched row is a zero
     contribution, so sum/len(parts) — not sum/touch-count — is what matches
-    the dense path's np.mean across trainers.  Also collapses duplicate
-    ids within one partial (sum), matching dense scatter-add."""
-    all_rows = np.concatenate([np.asarray(r, dtype=np.int64).reshape(-1)
-                               for r, _ in parts])
-    all_vals = np.concatenate([np.asarray(v, dtype=np.float32)
-                               for _, v in parts], axis=0)
+    the dense path's np.mean across trainers (trainers send EMPTY partials
+    to shards they didn't touch, so len(parts) == n_trainers every round).
+    Also collapses duplicate ids within one partial (sum), matching dense
+    scatter-add."""
+    filled = [(np.asarray(r, np.int64).reshape(-1),
+               np.asarray(v, np.float32).reshape(len(np.reshape(r, (-1,))),
+                                                 -1))
+              for r, v in parts if len(np.reshape(r, (-1,)))]
+    if not filled:
+        return np.zeros(0, np.int64), np.zeros((0, 1), np.float32)
+    all_rows = np.concatenate([r for r, _ in filled])
+    all_vals = np.concatenate([v for _, v in filled], axis=0)
     uniq, inv = np.unique(all_rows, return_inverse=True)
     summed = np.zeros((len(uniq), all_vals.shape[1]), np.float32)
     np.add.at(summed, inv, all_vals)

@@ -31,12 +31,25 @@ GLOBAL_BATCH = 16
 SYNC_MODE = os.environ.get("DIST_PS_MODE", "sync") == "sync"
 
 
+MODEL = os.environ.get("DIST_PS_MODEL", "fc")
+EMB_VOCAB = 40
+
+
 def build(opt_name):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
-        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
-        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
-        pred = fluid.layers.fc(x, size=1)
+        if MODEL == "emb":
+            # sparse-embedding model: with >1 pserver the table row-shards
+            ids = fluid.layers.data(name="x", shape=[1, 1], dtype="int64")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            emb = fluid.layers.embedding(ids, size=[EMB_VOCAB, 8],
+                                         is_sparse=True)
+            pooled = fluid.layers.reduce_mean(emb, dim=1)
+            pred = fluid.layers.fc(pooled, size=1)
+        else:
+            x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
         loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
         opt = {"sgd": lambda: fluid.optimizer.SGD(learning_rate=0.05),
                "adam": lambda: fluid.optimizer.Adam(learning_rate=0.05),
@@ -48,8 +61,23 @@ def build(opt_name):
 
 def global_batches():
     rng = np.random.RandomState(0)
-    W = rng.uniform(-1, 1, (13, 1)).astype("float32")
     out = []
+    if MODEL == "emb":
+        w = rng.uniform(-1, 1, EMB_VOCAB).astype("float32")
+        half = EMB_VOCAB // 2
+        for _ in range(N_STEPS):
+            # skew 85% of ids into the first row-shard so some rounds leave
+            # the second shard untouched by one trainer — exercising the
+            # empty-partial protocol (server divisor == n_trainers)
+            lo = rng.randint(0, half, (GLOBAL_BATCH, 1, 1))
+            hi = rng.randint(half, EMB_VOCAB, (GLOBAL_BATCH, 1, 1))
+            pick = rng.rand(GLOBAL_BATCH, 1, 1) < 0.85
+            ids = np.where(pick, lo, hi).astype("int64")
+            y = (1.0 + w[ids[:, :, 0]].mean(axis=1,
+                                            keepdims=True)).astype("float32")
+            out.append({"x": ids, "y": y})
+        return out
+    W = rng.uniform(-1, 1, (13, 1)).astype("float32")
     for _ in range(N_STEPS):
         xb = rng.uniform(-1, 1, (GLOBAL_BATCH, 13)).astype("float32")
         out.append({"x": xb, "y": xb @ W})

@@ -303,7 +303,9 @@ def test_sparse_table_transpile_shape():
     init_op = [op for op in startup.global_block().ops
                if op.type == "ps_init_sync"][0]
     assert table not in [n for n, _ in init_op.attrs["pull_vars"]]
-    assert table in [n for n, _ in init_op.attrs["push_vars"]]
+    # the table is pushed as a row slice (single shard here = all rows)
+    slices = [(n, s, e) for n, _, s, e in init_op.attrs["push_slices"]]
+    assert (table, 0, 50) in slices
 
 
 @pytest.mark.parametrize("sync_mode", [True, False])
@@ -364,3 +366,97 @@ def test_sparse_sync_loss_parity_vs_local():
 
     dist = _run_with_pserver(t, [ep], train)
     np.testing.assert_allclose(dist, local, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_table_row_sharded_across_two_pservers():
+    """slice_var_up: the embedding table row-shards over BOTH pservers
+    (reference VarBlock slicing); ids route to the owning shard and the
+    sync run stays at step-for-step parity with the local dense run."""
+    batches = _emb_batches(n=12)
+
+    main, startup, loss = _build_embedding_model(is_sparse=True)
+    local = []
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for b in batches:
+            (lv,) = exe.run(main, feed=b, fetch_list=[loss.name])
+            local.append(float(np.asarray(lv)))
+
+    main, startup, loss = _build_embedding_model(is_sparse=True)
+    eps = [f"127.0.0.1:{free_port()}", f"127.0.0.1:{free_port()}"]
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=",".join(eps),
+                trainers=1, startup_program=startup)
+    table = next(iter(t.sparse_tables))
+    shards = t.sparse_tables[table]["shards"]
+    assert [(s, e) for _, s, e in shards] == [(0, 25), (25, 50)]
+    assert {ep for ep, _, _ in shards} == set(eps)
+    # each server's block carries the SLICED optimizer program
+    for ep, start, end in shards:
+        pb = [b for b in t.get_pserver_program(ep)
+              .global_block().ops[0].attrs["param_blocks"]
+              if b[0] == table]
+        assert len(pb) == 1
+        w_var = pb[0][2].global_block()._find_var_recursive(table)
+        assert w_var.shape[0] == end - start
+
+    def train():
+        dist = []
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for b in batches:
+                (lv,) = exe.run(t.get_trainer_program(), feed=b,
+                                fetch_list=[loss.name])
+                dist.append(float(np.asarray(lv)))
+        return dist
+
+    dist = _run_with_pserver(t, eps, train)
+    np.testing.assert_allclose(dist, local, rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_sparse_2trainers_sync_parity(tmp_path):
+    """2 trainers × 2 pservers, table row-sharded, SYNC mode: the halves'
+    mean loss must match the local full-batch run step for step.  This is
+    the configuration where per-shard partial counting matters: a trainer
+    whose batch misses a shard still sends an empty partial, so the
+    server's divisor equals n_trainers every round."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    runner = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "dist_ps_runner.py")
+    eps = f"127.0.0.1:{free_port()},127.0.0.1:{free_port()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DIST_PS_MODEL="emb")
+    env.pop("XLA_FLAGS", None)
+
+    local_out = str(tmp_path / "local.json")
+    subprocess.run([sys.executable, runner, "local", "sgd", local_out],
+                   env=env, check=True, timeout=240)
+
+    servers = [subprocess.Popen(
+        [sys.executable, runner, "pserver", ep, eps, "2", "sgd"], env=env)
+        for ep in eps.split(",")]
+    touts = [str(tmp_path / f"t{i}.json") for i in range(2)]
+    trainers = [subprocess.Popen(
+        [sys.executable, runner, "trainer", str(i), eps, "2", "sgd",
+         touts[i]], env=env) for i in range(2)]
+    try:
+        for p in trainers:
+            assert p.wait(timeout=300) == 0
+        fluid.transpiler.stop_pservers(eps.split(","))
+        for p in servers:
+            assert p.wait(timeout=30) == 0
+    finally:
+        for p in trainers + servers:
+            if p.poll() is None:
+                p.kill()
+
+    local = json.load(open(local_out))["losses"]
+    t0 = json.load(open(touts[0]))["losses"]
+    t1 = json.load(open(touts[1]))["losses"]
+    merged = [(a + b) / 2 for a, b in zip(t0, t1)]
+    np.testing.assert_allclose(merged, local, rtol=1e-4, atol=1e-5)

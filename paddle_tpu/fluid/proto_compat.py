@@ -269,7 +269,9 @@ def _attr_from_desc(a):
 
 def parse_program_bytes(data: bytes):
     """Binary ProgramDesc → paddle_tpu Program (reference __model__
-    reader).  BLOCK/BLOCKS attrs are resolved to Block objects."""
+    reader).  BLOCK/BLOCKS attrs become plain block INDICES — this
+    framework's control-flow lowerings address sub-blocks by index
+    (program.block(attrs["sub_block"]))."""
     from .framework import Program
 
     desc = _decode(data, _PROGRAMDESC)
@@ -306,10 +308,12 @@ def parse_program_bytes(data: bytes):
             attrs = {}
             for a in od.get("attrs", []):
                 v = _attr_from_desc(a)
+                # this framework's control-flow lowerings address
+                # sub-blocks by INDEX (program.block(attrs["sub_block"]))
                 if isinstance(v, tuple) and v[0] == "__block__":
-                    v = prog.blocks[v[1]]
+                    v = v[1]
                 elif isinstance(v, tuple) and v[0] == "__blocks__":
-                    v = [prog.blocks[i] for i in v[1]]
+                    v = list(v[1])
                 attrs[a["name"]] = v
             _append_op_raw(blk, od.get("type"), ins, outs, attrs)
     prog._bump_version()
@@ -338,6 +342,13 @@ def _ghost(blk, name):
     return blk.create_var(name=name, shape=None, dtype=None)
 
 
+# attr names that are block references in the reference schema: this
+# framework stores them as plain ints, but actual Fluid's reader requires
+# AttrType.BLOCK/BLOCKS for them
+_BLOCK_ATTRS = frozenset({"sub_block", "block", "forward_block"})
+_BLOCKS_ATTRS = frozenset({"blocks", "sub_blocks"})
+
+
 def _attr_to_desc(name, v):
     a = {"name": name}
     from .framework import Block
@@ -345,7 +356,9 @@ def _attr_to_desc(name, v):
     if isinstance(v, bool):
         a["type"], a["b"] = _AT_BOOLEAN, v
     elif isinstance(v, int):
-        if -(1 << 31) <= v < (1 << 31):
+        if name in _BLOCK_ATTRS:
+            a["type"], a["block_idx"] = _AT_BLOCK, v
+        elif -(1 << 31) <= v < (1 << 31):
             a["type"], a["i"] = _AT_INT, v
         else:
             a["type"], a["l"] = _AT_LONG, v
@@ -359,6 +372,9 @@ def _attr_to_desc(name, v):
         if v and all(isinstance(x, Block) for x in v):
             a["type"] = _AT_BLOCKS
             a["blocks_idx"] = [x.idx for x in v]
+        elif (name in _BLOCKS_ATTRS and v
+              and all(isinstance(x, int) for x in v)):
+            a["type"], a["blocks_idx"] = _AT_BLOCKS, list(v)
         elif all(isinstance(x, bool) for x in v) and v:
             a["type"], a["bools"] = _AT_BOOLEANS, list(v)
         elif all(isinstance(x, int) for x in v):

@@ -300,10 +300,9 @@ def _merge_sparse(parts):
     to shards they didn't touch, so len(parts) == n_trainers every round).
     Also collapses duplicate ids within one partial (sum), matching dense
     scatter-add."""
-    filled = [(np.asarray(r, np.int64).reshape(-1),
-               np.asarray(v, np.float32).reshape(len(np.reshape(r, (-1,))),
-                                                 -1))
-              for r, v in parts if len(np.reshape(r, (-1,)))]
+    norm = [(np.asarray(r, np.int64).reshape(-1), v) for r, v in parts]
+    filled = [(r, np.asarray(v, np.float32).reshape(r.size, -1))
+              for r, v in norm if r.size]
     if not filled:
         return np.zeros(0, np.int64), np.zeros((0, 1), np.float32)
     all_rows = np.concatenate([r for r, _ in filled])

@@ -138,6 +138,40 @@ def test_json_format_still_default(tmp_path):
         assert feeds == ["x"]
 
 
+def test_control_flow_program_roundtrip():
+    """A program with a while loop (sub-block + block-index attrs) survives
+    serialize → parse and computes the same result."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=5)
+        acc = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                         value=0.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            fluid.layers.assign(acc + 1.5, acc)
+            fluid.layers.assign(i + 1, i)
+            fluid.layers.less_than(i, n, cond=cond)
+    assert len(main.blocks) == 2
+
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (want,) = exe.run(main, fetch_list=[acc.name])
+
+    blob = proto_compat.serialize_program(main)
+    prog2 = proto_compat.parse_program_bytes(blob)
+    assert len(prog2.blocks) == 2
+    wop = [op for op in prog2.global_block().ops if op.type == "while"][0]
+    assert wop.attrs["sub_block"] == 1
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        (got,) = exe.run(prog2, fetch_list=[acc.name])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    assert float(np.asarray(got).reshape(-1)[0]) == 7.5
+
+
 @pytest.mark.skipif(
     shutil.which("protoc") is None or not os.path.exists(REF_PROTO),
     reason="needs protoc + the reference framework.proto")

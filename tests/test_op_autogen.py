@@ -833,6 +833,152 @@ S("top_k_v2", {"X": RX.reshape(6, 4)},
 
 
 
+# ---------------------------------------------------------------------------
+# batch 4: rnn units, sequence (dense+length LoD analog), metrics, misc
+# ---------------------------------------------------------------------------
+
+
+def _lstm_unit_ref(X, C_prev):
+    d = X.shape[-1] // 4
+    i, f, o, j = X[:, :d], X[:, d:2 * d], X[:, 2 * d:3 * d], X[:, 3 * d:]
+    c = C_prev * _sigmoid(f) + _sigmoid(i) * np.tanh(j)
+    return {"C": c.astype("float32"),
+            "H": (_sigmoid(o) * np.tanh(c)).astype("float32")}
+
+
+S("lstm_unit", {"X": rnd(3, 16, seed=180), "C_prev": rnd(3, 4, seed=181)},
+  _lstm_unit_ref, out_slots=("C", "H"), grad_out="H", grads=["X", "C_prev"],
+  mre=0.02)
+
+
+def _gru_unit_ref(Input, HiddenPrev, Weight):
+    d = HiddenPrev.shape[-1]
+    g_ur = Input[:, :2 * d] + HiddenPrev @ Weight[:, :2 * d]
+    u, r = _sigmoid(g_ur[:, :d]), _sigmoid(g_ur[:, d:])
+    cand = np.tanh(Input[:, 2 * d:] + (r * HiddenPrev) @ Weight[:, 2 * d:])
+    h = (1 - u) * HiddenPrev + u * cand
+    return {"Hidden": h.astype("float32"),
+            "ResetHiddenPrev": (r * HiddenPrev).astype("float32")}
+
+
+S("gru_unit", {"Input": rnd(3, 12, seed=182), "HiddenPrev": rnd(3, 4, seed=183),
+               "Weight": rnd(4, 12, seed=184)},
+  _gru_unit_ref, out_slots=("Gate", "ResetHiddenPrev", "Hidden"),
+  no_check=("Gate",), grad_out="Hidden",
+  grads=["Input", "HiddenPrev", "Weight"], mre=0.03)
+
+SEQ_X = rnd(3, 5, 4, seed=185)
+SEQ_LEN = np.int64([5, 2, 4])
+
+
+def _len_mask():
+    return (np.arange(5)[None, :] < SEQ_LEN[:, None])
+
+
+S("sequence_pool", {"X": SEQ_X, "Length": SEQ_LEN},
+  lambda X, Length: {"Out": (X * _len_mask()[:, :, None]).sum(axis=1)
+                     / Length[:, None]},
+  attrs={"pooltype": "AVERAGE"}, out_slots=("Out", "MaxIndex"),
+  no_check=("MaxIndex",), grads=["X"])
+S("sequence_first_step", {"X": SEQ_X, "Length": SEQ_LEN},
+  lambda X, Length: X[:, 0, :], grads=["X"])
+S("sequence_last_step", {"X": SEQ_X, "Length": SEQ_LEN},
+  lambda X, Length: X[np.arange(3), SEQ_LEN - 1, :], grads=["X"])
+S("sequence_reverse", {"X": SEQ_X, "Length": SEQ_LEN},
+  lambda X, Length: _seq_rev_ref(X, Length), grads=["X"])
+
+
+def _seq_rev_ref(x, ln):
+    out = x.copy()
+    for b, l in enumerate(ln):
+        out[b, :l] = x[b, :l][::-1]
+    return out
+
+
+S("sequence_softmax", {"X": rnd(3, 5, seed=186), "Length": SEQ_LEN},
+  lambda X, Length: _seq_softmax_ref(X, Length), grads=["X"],
+  lw=rnd(3, 5, seed=187))
+
+
+def _seq_softmax_ref(x, ln):
+    m = _len_mask()
+    e = np.exp(np.where(m, x, -np.inf) - np.where(m, x, -np.inf).max(
+        axis=1, keepdims=True))
+    e = np.where(m, e, 0.0)
+    return (e / e.sum(axis=1, keepdims=True)).astype("float32")
+
+
+S("sequence_expand", {"X": rnd(3, 4, seed=188), "Y": rnd(3, 5, 2, seed=189)},
+  lambda X, Y: np.broadcast_to(X[:, None, :], (3, 5, 4)).copy(),
+  grads=["X"])
+S("accuracy", {"Out": _softmax(rnd(5, 4, seed=190)),
+               "Indices": np.int64([[1], [0], [2], [3], [1]]),
+               "Label": np.int64([[1], [2], [2], [3], [0]])},
+  lambda Out, Indices, Label: {
+      "Accuracy": np.float32(3 / 5).reshape(()),
+      "Correct": np.int32(3).reshape(()),
+      "Total": np.int32(5).reshape(())},
+  out_slots=("Accuracy", "Correct", "Total"), grads=())
+S("edit_distance", {"Hyps": np.int64([[1, 2, 3], [4, 5, 5]]),
+                    "Refs": np.int64([[1, 3, 3, 0], [4, 4, 5, 6]]),
+                    "HypsLength": np.int64([3, 2]),
+                    "RefsLength": np.int64([3, 4])},
+  lambda Hyps, Refs, HypsLength, RefsLength: {
+      # d([1,2,3],[1,3,3]) = 1 (sub); d([4,5],[4,4,5,6]) = 2 (ins+ins)
+      "Out": np.float32([[1.0], [2.0]]),
+      "SequenceNum": np.int64(2).reshape(())},
+  out_slots=("Out", "SequenceNum"), grads=())
+S("ctc_align", {"Input": np.int64([[1, 1, 0, 2, 2], [0, 3, 0, 3, 3]])},
+  lambda Input: {"Output": np.int64([[1, 2, 0, 0, 0], [3, 3, 0, 0, 0]]),
+                 "OutLength": np.int64([2, 2])},
+  attrs={"blank": 0, "padding_value": 0},
+  out_slots=("Output", "OutLength"), grads=())
+S("iou_similarity", {"X": np.float32([[0, 0, 2, 2], [1, 1, 3, 3]]),
+                     "Y": np.float32([[0, 0, 2, 2], [2, 2, 4, 4]])},
+  lambda X, Y: _iou_ref(X, Y), grads=())
+
+
+def _iou_ref(x, y):
+    out = np.zeros((len(x), len(y)), "float32")
+    for a, bx in enumerate(x):
+        for b, by in enumerate(y):
+            ix = max(0, min(bx[2], by[2]) - max(bx[0], by[0]))
+            iy = max(0, min(bx[3], by[3]) - max(bx[1], by[1]))
+            inter = ix * iy
+            ua = ((bx[2] - bx[0]) * (bx[3] - bx[1])
+                  + (by[2] - by[0]) * (by[3] - by[1]) - inter)
+            out[a, b] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+S("box_clip", {"Input": np.float32([[[-1, -1, 5, 5], [1, 2, 3, 4]]]),
+               "ImInfo": np.float32([[4.0, 4.0, 1.0]])},
+  lambda Input, ImInfo: np.float32([[[0, 0, 3, 3], [1, 2, 3, 3]]]),
+  out_slots=("Output",), grads=())
+# "sigmoid_cross_entropy" is registered as a sigmoid activation alias
+# (ops/math_ops.py:179); the loss variant is
+# sigmoid_cross_entropy_with_logits, covered in batch 1
+S("sigmoid_cross_entropy", {"X": rnd(3, 4, seed=191)},
+  lambda X: _sigmoid(X), grads=["X"])
+S("npair_loss_op",
+  {"Anchor": rnd(4, 6, seed=193), "Positive": rnd(4, 6, seed=194),
+   "Labels": np.int64([0, 1, 1, 2])},
+  None, grads=["Anchor", "Positive"], mre=0.03)
+S("mean_iou", {"Predictions": np.int64([[0, 1], [2, 1]]),
+               "Labels": np.int64([[0, 1], [1, 1]])},
+  None, attrs={"num_classes": 3},
+  out_slots=("OutMeanIou", "OutWrong", "OutCorrect"), grads=())
+S("decoupled_weight_decay", {"Param": P, "LearningRate": LR},
+  lambda Param, LearningRate: (Param * (1 - 0.1 * 0.01)).astype("float32"),
+  attrs={"coeff": 0.01}, grads=(), out_slots=("ParamOut",))
+S("fc", {"Input": rnd(3, 5, seed=195), "W": rnd(5, 2, seed=196),
+         "Bias": rnd(2, seed=197)},
+  lambda Input, W, Bias: np.maximum(Input @ W + Bias, 0),
+  attrs={"in_num_col_dims": 1, "activation_type": "relu"}, mre=0.02)
+S("hash", {"X": np.int64([[1, 2], [3, 4]])},
+  None, grads=())
+
+
 def _make_test(spec):
     class _T(OpTest):
         def runTest(self):
@@ -895,6 +1041,24 @@ def test_grad(spec):
     out = spec["grad_out"] or spec["out_slots"][0]
     t.check_grad(slots, out, max_relative_error=spec["mre"],
                  numeric_delta=spec["delta"], loss_weights=spec["lw"])
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [s for s in SPECS if s["ref"] is None
+     and not (s["grads"] == "auto" or s["grads"])],
+    ids=lambda s: s["op"])
+def test_smoke(spec):
+    """Specs with neither a reference nor gradient checks still EXECUTE:
+    build the one-op program and run it through the real executor so a
+    trace/compile/run breakage cannot hide behind an uncheckable spec."""
+    t = _make_test(spec)
+    main, startup, feed, in_arg, out_arg = t._build()
+    from tests.op_test import Scope
+
+    fetch = [out_arg[spec["out_slots"][0]][0]]
+    res = t._run(main, feed, fetch, Scope())
+    assert res[0] is not None
 
 
 def test_coverage_floor():

@@ -979,6 +979,147 @@ S("hash", {"X": np.int64([[1, 2], [3, 4]])},
   None, grads=())
 
 
+# ---------------------------------------------------------------------------
+# batch 5: full-sequence rnn ops, remaining sequence family, randoms
+# ---------------------------------------------------------------------------
+
+
+def _lstm_ref(Input, Weight):
+    """Textbook LSTM over pre-projected gates; gate layout {c,i,f,o}
+    (lstm_op.cc weight concat order)."""
+    b, t, d4 = Input.shape
+    d = d4 // 4
+    h = np.zeros((b, d), "float32")
+    c = np.zeros((b, d), "float32")
+    hs, cs = [], []
+    for step in range(t):
+        g = Input[:, step] + h @ Weight
+        cand = np.tanh(g[:, :d])
+        i = _sigmoid(g[:, d:2 * d])
+        f = _sigmoid(g[:, 2 * d:3 * d])
+        o = _sigmoid(g[:, 3 * d:])
+        c = cand * i + c * f
+        h = o * np.tanh(c)
+        hs.append(h)
+        cs.append(c)
+    return {"Hidden": np.stack(hs, 1).astype("float32"),
+            "Cell": np.stack(cs, 1).astype("float32")}
+
+
+S("lstm", {"Input": rnd(2, 3, 8, seed=200), "Weight": rnd(2, 8, seed=201)},
+  _lstm_ref, out_slots=("Hidden", "Cell"), grad_out="Hidden",
+  grads=["Input", "Weight"], mre=0.03, lw=rnd(2, 3, 2, seed=202))
+
+
+def _gru_ref(Input, Weight):
+    b, t, d3 = Input.shape
+    d = d3 // 3
+    h = np.zeros((b, d), "float32")
+    hs = []
+    for step in range(t):
+        x = Input[:, step]
+        g_ur = x[:, :2 * d] + h @ Weight[:, :2 * d]
+        u = _sigmoid(g_ur[:, :d])
+        r = _sigmoid(g_ur[:, d:])
+        cand = np.tanh(x[:, 2 * d:] + (r * h) @ Weight[:, 2 * d:])
+        h = (1 - u) * h + u * cand
+        hs.append(h)
+    return np.stack(hs, 1).astype("float32")
+
+
+S("gru", {"Input": rnd(2, 3, 6, seed=203), "Weight": rnd(2, 6, seed=204)},
+  _gru_ref, out_slots=("Hidden",), grads=["Input", "Weight"], mre=0.03,
+  lw=rnd(2, 3, 2, seed=205))
+
+S("sequence_unpad", {"X": SEQ_X, "Length": SEQ_LEN},
+  lambda X, Length: X * _len_mask()[:, :, None], grads=["X"])
+S("sequence_expand_as",
+  {"X": rnd(3, 4, seed=206), "Y": rnd(3, 5, 4, seed=207)},
+  lambda X, Y: np.broadcast_to(X[:, None, :], (3, 5, 4)).copy(),
+  grads=["X"])
+
+
+def _seq_slice_ref(X, Offset, Length):
+    b, t = X.shape[:2]
+    out = np.zeros_like(X)
+    for r in range(b):
+        o, l = int(Offset[r]), int(Length[r])
+        w = X[r, o:o + l]
+        out[r, :len(w)] = w
+    return out
+
+
+S("sequence_slice", {"X": rnd(3, 5, 2, seed=208),
+                     "Offset": np.int64([1, 0, 3]),
+                     "Length": np.int64([2, 4, 2])},
+  _seq_slice_ref, grads=["X"])
+
+
+def _seq_enum_ref(X):
+    b, t = X.shape
+    win, pad = 3, 9
+    out = np.full((b, t, win), pad, "int64")
+    for r in range(b):
+        for j in range(t):
+            for k in range(win):
+                if j + k < t:
+                    out[r, j, k] = X[r, j + k]
+    return out
+
+
+S("sequence_enumerate", {"X": ints(2, 4, lo=1, hi=8)},
+  _seq_enum_ref, attrs={"win_size": 3, "pad_value": 9}, grads=())
+
+
+def _seq_concat_ref(c0, c1, l0, l1):
+    b = c0.shape[0]
+    t_out = c0.shape[1] + c1.shape[1]
+    out = np.zeros((b, t_out, c0.shape[2]), "float32")
+    lens = np.zeros(b, "int32")
+    for r in range(b):
+        parts = [c0[r, :l0[r]], c1[r, :l1[r]]]
+        cat = np.concatenate(parts, axis=0)
+        out[r, :len(cat)] = cat
+        lens[r] = len(cat)
+    return {"Out": out, "OutLength": lens}
+
+
+S("sequence_concat",
+  {"X": [("sc0", rnd(2, 3, 2, seed=209)), ("sc1", rnd(2, 2, 2, seed=210))],
+   "Length": [("sl0", np.int64([3, 1])), ("sl1", np.int64([2, 2]))]},
+  lambda sc0, sc1, sl0, sl1: _seq_concat_ref(sc0, sc1, sl0, sl1),
+  out_slots=("Out", "OutLength"), grads=["X"], grad_out="Out")
+
+S("conv3d_transpose",
+  {"Input": rnd(1, 2, 3, 3, 3, seed=211), "Filter": rnd(2, 3, 2, 2, 2, seed=212)},
+  _tt(lambda torch, Input, Filter: torch.nn.functional.conv_transpose3d(
+      Input, Filter, stride=1, padding=0)),
+  attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+         "dilations": [1, 1, 1], "groups": 1},
+  out_slots=("Output",), mre=0.03, tols=(1e-4, 1e-3))
+
+# random / stateful smoke specs: executed via test_smoke (shape/trace)
+S("uniform_random", {}, None, attrs={"shape": [3, 4], "min": -1.0,
+                                     "max": 1.0, "seed": 7}, grads=())
+S("gaussian_random", {}, None, attrs={"shape": [3, 4], "mean": 0.0,
+                                      "std": 1.0, "seed": 7}, grads=())
+S("truncated_gaussian_random", {}, None,
+  attrs={"shape": [3, 4], "mean": 0.0, "std": 1.0, "seed": 7}, grads=())
+S("randint", {}, None, attrs={"shape": [3, 4], "low": 0, "high": 9,
+                              "seed": 7}, grads=())
+S("random_crop", {"X": rnd(1, 3, 6, 6, seed=213)}, None,
+  attrs={"shape": [3, 4, 4], "seed": 7}, grads=())
+S("data_norm", {"X": rnd(3, 4, seed=214),
+                "BatchSize": np.full(4, 10.0, "float32"),
+                "BatchSum": rnd(4, seed=215) * 10,
+                "BatchSquareSum": pos(4, seed=216) * 20},
+  None, out_slots=("Y", "Means", "Scales"), grads=["X"], grad_out="Y",
+  mre=0.05)
+S("spectral_norm", {"Weight": rnd(4, 3, seed=217),
+                    "U": rnd(4, seed=218), "V": rnd(3, seed=219)},
+  None, out_slots=("Out", "UOut", "VOut"), grads=())
+
+
 def _make_test(spec):
     class _T(OpTest):
         def runTest(self):

@@ -332,11 +332,31 @@ def _flash_bwd(causal, sm_scale, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _use_pallas():
+def _default_platform():
+    """The default backend's platform name — WITHOUT initializing any
+    backend when none is up yet.  jax.default_backend() initializes every
+    registered plugin; under abstract tracing (jax.eval_shape during
+    program construction) that would touch the axon TPU tunnel, which can
+    wedge so hard device enumeration hangs for hours.  With no backend
+    initialized the answer is the configured platform list's head —
+    purely string-level, no client creation."""
+    try:  # narrow guard: ONLY the private-API probe may be skipped
+        from jax._src import xla_bridge as xb
+
+        uninitialized = not xb._backends
+    except Exception:  # pragma: no cover - jax internals moved
+        uninitialized = False
+    if uninitialized:
+        platforms = (jax.config.jax_platforms or "").split(",")
+        return platforms[0] if platforms and platforms[0] else None
     try:
-        return jax.default_backend() == "tpu"
+        return jax.default_backend()
     except Exception:  # pragma: no cover
-        return False
+        return None
+
+
+def _use_pallas():
+    return _default_platform() == "tpu"
 
 
 def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
@@ -370,7 +390,9 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
 
     mode = force or ("pallas" if _use_pallas() else "reference")
     if mode == "pallas":
-        interpret = jax.default_backend() != "tpu"
+        # same no-init discipline as _use_pallas: this line is reached
+        # under abstract tracing too (force="pallas" in tests)
+        interpret = _default_platform() != "tpu"
         # pallas path needs S divisible by the block; pad keys with -inf bias
         s_pad = _ceil_to(s, DEFAULT_BLOCK)
         if s_pad != s:

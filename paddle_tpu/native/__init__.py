@@ -28,6 +28,8 @@ __all__ = ["lib", "RecordIOWriter", "RecordIOScanner", "BlockingQueue",
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _SRCS = [os.path.join(_SRC_DIR, "data_runtime.cc"),
          os.path.join(_SRC_DIR, "ps_runtime.cc")]
+# base compile flags shared with the C++ unit-test build (tests/test_native_cc.py)
+CXX_BASE_FLAGS = ["-O2", "-std=c++17", "-pthread"]
 _lib = None
 _lib_lock = threading.Lock()
 _build_error = None
@@ -35,7 +37,7 @@ _build_error = None
 
 def _build() -> str:
     h = hashlib.sha256()
-    for src in _SRCS:
+    for src in (*_SRCS, os.path.join(_SRC_DIR, "native_api.h")):
         with open(src, "rb") as f:
             h.update(f.read())
     tag = h.hexdigest()[:16]
@@ -47,8 +49,8 @@ def _build() -> str:
     # per-process tmp name: concurrent first-use builds (pytest-xdist, two
     # jobs) must not interleave writes to the same output file
     tmp = f"{so_path}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           *_SRCS, "-lz", "-o", tmp]
+    cmd = ["g++", *CXX_BASE_FLAGS, "-shared", "-fPIC", *_SRCS,
+           "-lz", "-o", tmp]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, so_path)
     return so_path

@@ -16,6 +16,8 @@
 // C ABI only (ctypes-friendly); all buffers returned via ptq_buf are malloc'd
 // and freed with ptq_free.
 
+#include "native_api.h"
+
 #include <zlib.h>
 
 #include <algorithm>

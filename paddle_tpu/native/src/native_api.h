@@ -1,0 +1,84 @@
+// C ABI of the native runtimes (data_runtime.cc + ps_runtime.cc), shared
+// by the implementations and native_test.cc so a signature change is a
+// compile error everywhere instead of silent ABI drift.  ctypes binds the
+// same surface from paddle_tpu/native/__init__.py.
+#pragma once
+
+#include <cstdint>
+
+// Parameter-server wire commands (one byte on the wire; see the frame
+// format documented at the top of ps_runtime.cc).
+enum PtsCmd : uint8_t {
+  kSendGrad = 1,
+  kGetParam = 2,
+  kSendBarrier = 3,
+  kFetchBarrier = 4,
+  kSendParam = 5,
+  kStop = 6,
+  // sparse/distributed-embedding row fetch (reference
+  // operators/distributed/parameter_prefetch.cc): request.round packs
+  // (header_offset << 32) | row_width_bytes, request.data is an i64 id
+  // array; the response is the concatenated rows from the table blob.
+  kLookupRows = 7,
+};
+
+extern "C" {
+// --- shared ---------------------------------------------------------- //
+void ptq_free(char* p);
+
+// --- RecordIO -------------------------------------------------------- //
+void* ptq_recordio_writer_open(const char* path, int compressor);
+int ptq_recordio_writer_write(void* handle, const char* data, int64_t len);
+int ptq_recordio_writer_close(void* handle);
+void* ptq_recordio_scanner_open(const char* path);
+// returns record length (>=0), -1 on EOF, -2 on corruption; *out is
+// scanner-owned (valid until the next call) — do NOT free
+int64_t ptq_recordio_scanner_next(void* handle, char** out);
+void ptq_recordio_scanner_close(void* handle);
+
+// --- blocking queue --------------------------------------------------- //
+void* ptq_queue_new(int64_t capacity);
+// 0 ok, 1 timeout, 2 closed
+int ptq_queue_push(void* handle, const char* data, int64_t len,
+                   double timeout_s);
+// >=0 length (caller frees *out via ptq_free), -1 timeout, -2 closed+empty
+int64_t ptq_queue_pop(void* handle, char** out, double timeout_s);
+int64_t ptq_queue_size(void* handle);
+int64_t ptq_queue_waiters(void* handle);
+void ptq_queue_close(void* handle);
+void ptq_queue_free(void* handle);
+
+// --- MultiSlot feed --------------------------------------------------- //
+void* ptq_feed_new(const char** files, int nfiles, const char* slots_desc,
+                   int batch_size, int64_t queue_capacity);
+int64_t ptq_feed_next(void* handle, char** out);
+int64_t ptq_feed_error(void* handle, char** out);
+void ptq_feed_free(void* handle);
+
+// --- parameter-server transport --------------------------------------- //
+void* pts_server_start(int port, int n_trainers);
+int pts_server_port(void* h);
+int pts_server_wait_round(void* h);
+void pts_server_release_send(void* h);
+int64_t pts_server_grad_count(void* h);
+int64_t pts_server_grad_at(void* h, int64_t i, char** name_out,
+                           char** data_out);
+int64_t pts_server_grad_name_len(void* h, int64_t i);
+// payload length (caller frees name/data via ptq_free; name is
+// NUL-terminated), -1 timeout, -2 stopped-and-drained
+int64_t pts_server_pop_grad(void* h, int timeout_ms, char** name_out,
+                            char** data_out);
+void pts_server_publish(void* h, const char* name, const char* data,
+                        int64_t len);
+void pts_server_bump_version(void* h);
+int pts_server_end_round(void* h);
+int64_t pts_server_table_get(void* h, const char* name, char** out);
+int pts_server_wait_table(void* h, const char* name);
+void pts_server_stop(void* h);
+void* pts_connect(const char* host, int port, double timeout_s);
+// status 0 ok / 1 error / -1 io failure; kGetParam payload lands in *out
+// (caller frees via ptq_free)
+int pts_request(void* h, int cmd, const char* name, uint64_t round,
+                const char* data, int64_t dlen, char** out, int64_t* olen);
+void pts_client_close(void* h);
+}  // extern "C"

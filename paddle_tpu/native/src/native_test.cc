@@ -1,0 +1,162 @@
+// Native-runtime unit tests — the reference's C++ test pattern
+// (operators/distributed/rpc_server_test.cc: in-process client+server;
+// recordio tests; blocking-queue tests) without a gtest dependency: plain
+// CHECK macros, exit code 0 on success.  Built and run by
+// tests/test_native_cc.py with the same g++ invocation as the library.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "native_api.h"
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,       \
+                   __LINE__, #cond);                                      \
+      std::exit(1);                                                       \
+    }                                                                     \
+  } while (0)
+
+static void test_recordio(const char* tmpdir) {
+  std::string path = std::string(tmpdir) + "/t.recordio";
+  void* w = ptq_recordio_writer_open(path.c_str(), 1);
+  CHECK(w != nullptr);
+  CHECK(ptq_recordio_writer_write(w, "hello", 5) == 0);
+  std::string big(100000, 'x');
+  CHECK(ptq_recordio_writer_write(w, big.data(), (int64_t)big.size()) == 0);
+  CHECK(ptq_recordio_writer_close(w) == 0);
+
+  void* s = ptq_recordio_scanner_open(path.c_str());
+  CHECK(s != nullptr);
+  char* out = nullptr;  // scanner-owned buffer: do NOT free
+  CHECK(ptq_recordio_scanner_next(s, &out) == 5);
+  CHECK(std::memcmp(out, "hello", 5) == 0);
+  CHECK(ptq_recordio_scanner_next(s, &out) == (int64_t)big.size());
+  CHECK(ptq_recordio_scanner_next(s, &out) == -1);  // EOF
+  ptq_recordio_scanner_close(s);
+  std::puts("recordio ok");
+}
+
+static void test_queue() {
+  // push: 0 ok / 1 timeout / 2 closed; pop: length / -1 timeout / -2 closed
+  void* q = ptq_queue_new(2);
+  CHECK(ptq_queue_push(q, "a", 1, 0.1) == 0);
+  CHECK(ptq_queue_push(q, "b", 1, 0.1) == 0);
+  CHECK(ptq_queue_push(q, "c", 1, 0.01) == 1);  // full → timeout
+  char* out = nullptr;
+  CHECK(ptq_queue_pop(q, &out, 0.1) == 1 && out[0] == 'a');
+  ptq_free(out);
+  // producer thread unblocks a waiting consumer
+  std::thread prod([&] { CHECK(ptq_queue_push(q, "z", 1, 1.0) == 0); });
+  CHECK(ptq_queue_pop(q, &out, 1.0) == 1 && out[0] == 'b');
+  ptq_free(out);
+  CHECK(ptq_queue_pop(q, &out, 1.0) == 1 && out[0] == 'z');
+  ptq_free(out);
+  prod.join();
+  ptq_queue_close(q);
+  CHECK(ptq_queue_pop(q, &out, 0.05) == -2);  // closed + drained
+  ptq_queue_free(q);
+  std::puts("queue ok");
+}
+
+static void test_ps_sync_round() {
+  // rpc_server_test.cc pattern: server driver thread + 2 client threads in
+  // one process, one full sync round over real loopback sockets
+  void* srv = pts_server_start(0, 2);
+  CHECK(srv != nullptr);
+  int port = pts_server_port(srv);
+
+  std::thread driver([&] {
+    CHECK(pts_server_wait_round(srv) == 1);
+    CHECK(pts_server_grad_count(srv) == 2);
+    char *name = nullptr, *data = nullptr;
+    int64_t n = pts_server_grad_at(srv, 0, &name, &data);
+    CHECK(n == 4);
+    int64_t nlen = pts_server_grad_name_len(srv, 0);
+    CHECK(std::string(name, (size_t)nlen) == "g");
+    ptq_free(name);
+    ptq_free(data);
+    pts_server_publish(srv, "p", "PPPP", 4);
+    pts_server_bump_version(srv);
+    pts_server_release_send(srv);
+    CHECK(pts_server_end_round(srv) == 1);
+  });
+
+  auto trainer = [&](int id) {
+    void* c = pts_connect("127.0.0.1", port, 5.0);
+    CHECK(c != nullptr);
+    CHECK(pts_request(c, kSendGrad, "g", 0, "GGGG", 4, nullptr, nullptr) == 0);
+    CHECK(pts_request(c, kSendBarrier, "", 0, nullptr, 0, nullptr, nullptr)
+          == 0);
+    char* out = nullptr;
+    int64_t olen = 0;
+    CHECK(pts_request(c, kGetParam, "p", 1, nullptr, 0, &out, &olen) == 0);
+    CHECK(olen == 4 && std::memcmp(out, "PPPP", 4) == 0);
+    ptq_free(out);
+    CHECK(pts_request(c, kFetchBarrier, "", 0, nullptr, 0, nullptr, nullptr)
+          == 0);
+    pts_client_close(c);
+  };
+  std::thread t0(trainer, 0), t1(trainer, 1);
+  t0.join();
+  t1.join();
+  driver.join();
+  pts_server_stop(srv);
+  std::puts("ps sync round ok");
+}
+
+static void test_ps_async_pop_and_lookup() {
+  void* srv = pts_server_start(0, 1);
+  int port = pts_server_port(srv);
+  void* c = pts_connect("127.0.0.1", port, 5.0);
+  CHECK(c != nullptr);
+
+  // async pop: timeout first, then a pushed grad wakes the pop
+  char *name = nullptr, *data = nullptr;
+  CHECK(pts_server_pop_grad(srv, 30, &name, &data) == -1);  // timeout
+  CHECK(pts_request(c, kSendGrad, "w@GRAD", 0, "abcd", 4, nullptr, nullptr)
+        == 0);
+  int64_t n = pts_server_pop_grad(srv, 1000, &name, &data);
+  CHECK(n == 4 && std::string(name) == "w@GRAD");
+  CHECK(std::memcmp(data, "abcd", 4) == 0);
+  ptq_free(name);
+  ptq_free(data);
+
+  // native row lookup: 3 rows of 4 bytes behind a 2-byte header
+  //   blob = header "HD" + rows "AAAA" "BBBB" "CCCC"
+  pts_server_publish(srv, "emb", "HDAAAABBBBCCCC", 14);
+  uint64_t packed = ((uint64_t)2 << 32) | 4;  // offset 2, width 4
+  int64_t ids[2] = {2, 0};
+  char* out = nullptr;
+  int64_t olen = 0;
+  CHECK(pts_request(c, kLookupRows, "emb", packed,
+                    (const char*)ids, sizeof(ids), &out, &olen) == 0);
+  CHECK(olen == 8 && std::memcmp(out, "CCCCAAAA", 8) == 0);
+  ptq_free(out);
+  // out-of-range id → error status
+  int64_t bad[1] = {7};
+  CHECK(pts_request(c, kLookupRows, "emb", packed,
+                    (const char*)bad, sizeof(bad), &out, &olen) == 1);
+  ptq_free(out);
+
+  pts_request(c, kStop, "", 0, nullptr, 0, nullptr, nullptr);
+  pts_client_close(c);
+  pts_server_stop(srv);
+  std::puts("ps async pop + lookup ok");
+}
+
+int main(int argc, char** argv) {
+  const char* tmpdir = argc > 1 ? argv[1] : "/tmp";
+  test_recordio(tmpdir);
+  test_queue();
+  test_ps_sync_round();
+  test_ps_async_pop_and_lookup();
+  std::puts("ALL NATIVE TESTS PASSED");
+  return 0;
+}

@@ -34,6 +34,8 @@
 #include <unistd.h>
 
 #include <chrono>
+#include "native_api.h"
+
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -45,21 +47,6 @@
 #include <vector>
 
 namespace {
-
-enum Cmd : uint8_t {
-  kSendGrad = 1,
-  kGetParam = 2,
-  kSendBarrier = 3,
-  kFetchBarrier = 4,
-  kSendParam = 5,
-  kStop = 6,
-  // sparse/distributed-embedding row fetch (reference
-  // operators/distributed/parameter_prefetch.cc): request.round carries the
-  // row width in BYTES, request.data is an i64 id array; the response is
-  // the concatenated rows gathered from the published table blob.  Served
-  // natively — no driver round trip on the lookup fast path.
-  kLookupRows = 7,
-};
 
 bool read_n(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
@@ -272,8 +259,6 @@ struct PSClient {
 }  // namespace
 
 extern "C" {
-
-void ptq_free(char* p);  // from data_runtime.cc (same shared library)
 
 // ---------------------------------------------------------------------- //
 // server                                                                 //

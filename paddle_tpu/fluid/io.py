@@ -179,9 +179,13 @@ def _npz_path(dirname, filename):
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None, scope=None):
+              predicate=None, filename=None, scope=None,
+              reference_format=False):
     """Save selected vars from the scope.  filename=None → one .npy per var
-    (reference's save_op per var); filename set → combined npz (save_combine)."""
+    (reference's save_op per var); filename set → combined npz
+    (save_combine).  reference_format=True writes actual Fluid's LoDTensor
+    stream format instead (per-var files named by var name, or one
+    combined stream sorted by name) — checkpoints load in the reference."""
     scope = scope or global_scope()
     vars = _collect_vars(main_program, vars, predicate)
     os.makedirs(dirname, exist_ok=True)
@@ -192,6 +196,22 @@ def save_vars(executor, dirname, main_program=None, vars=None,
             raise RuntimeError(f"variable {v.name} has no value in scope; "
                                f"run the startup program before saving")
         arrays[v.name] = np.asarray(val)
+    if reference_format:
+        from . import proto_compat
+
+        if filename is None:
+            for name, arr in arrays.items():
+                path = os.path.join(dirname, name)
+                # var names may contain '/' — the reference writes nested
+                # paths too, so create the subdirs rather than sanitizing
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "wb") as f:
+                    proto_compat.serialize_lod_tensor(f, arr)
+        else:
+            with open(os.path.join(dirname, filename), "wb") as f:
+                for name in sorted(arrays):
+                    proto_compat.serialize_lod_tensor(f, arrays[name])
+        return sorted(arrays)
     if filename is None:
         for name, arr in arrays.items():
             np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"), arr)
@@ -201,9 +221,44 @@ def save_vars(executor, dirname, main_program=None, vars=None,
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None, scope=None):
+              predicate=None, filename=None, scope=None,
+              reference_format=False):
     scope = scope or global_scope()
     vars = _collect_vars(main_program, vars, predicate)
+    if reference_format:
+        from . import proto_compat
+
+        if filename is not None:
+            with open(os.path.join(dirname, filename), "rb") as f:
+                # combined stream, sorted-by-name order (save side mirrors).
+                # The stream carries no names, so guard against loading a
+                # DIFFERENT var subset than was saved: every record's shape
+                # must match its positional var, and the stream must be
+                # fully consumed at the end.
+                for v in sorted(vars, key=lambda v: v.name):
+                    arr, _lod = proto_compat.deserialize_lod_tensor(f)
+                    if (v.shape is not None
+                            and tuple(arr.shape) != tuple(v.shape)):
+                        raise RuntimeError(
+                            f"combined checkpoint record for {v.name!r} has "
+                            f"shape {arr.shape}, expected {tuple(v.shape)} — "
+                            f"was the file saved with a different var set?")
+                    scope.set(v.name, arr)
+                if f.read(1):
+                    raise RuntimeError(
+                        "combined checkpoint has more records than the "
+                        "requested var set — was it saved with a different "
+                        "var set?")
+        else:
+            for v in vars:
+                path = os.path.join(dirname, v.name)
+                if not os.path.exists(path):
+                    raise RuntimeError(
+                        f"reference-format var file {path} not found")
+                with open(path, "rb") as f:
+                    arr, _lod = proto_compat.deserialize_lod_tensor(f)
+                scope.set(v.name, arr)
+        return sorted(v.name for v in vars)
     if filename is not None:
         path = _npz_path(dirname, filename)
         data = np.load(path, allow_pickle=False)
@@ -220,26 +275,34 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     return sorted(v.name for v in vars)
 
 
-def save_params(executor, dirname, main_program=None, filename=None, scope=None):
+def save_params(executor, dirname, main_program=None, filename=None, scope=None,
+                reference_format=False):
     return save_vars(executor, dirname, main_program, predicate=_is_parameter,
-                     filename=filename, scope=scope)
+                     filename=filename, scope=scope,
+                     reference_format=reference_format)
 
 
-def load_params(executor, dirname, main_program=None, filename=None, scope=None):
+def load_params(executor, dirname, main_program=None, filename=None, scope=None,
+                reference_format=False):
     return load_vars(executor, dirname, main_program, predicate=_is_parameter,
-                     filename=filename, scope=scope)
+                     filename=filename, scope=scope,
+                     reference_format=reference_format)
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None, scope=None):
+def save_persistables(executor, dirname, main_program=None, filename=None, scope=None,
+                      reference_format=False):
     """Save every persistable var (params + optimizer accumulators + BN stats)
     — the checkpoint/resume entry point (reference io.py:477)."""
     return save_vars(executor, dirname, main_program, predicate=_is_persistable,
-                     filename=filename, scope=scope)
+                     filename=filename, scope=scope,
+                     reference_format=reference_format)
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None, scope=None):
+def load_persistables(executor, dirname, main_program=None, filename=None, scope=None,
+                      reference_format=False):
     return load_vars(executor, dirname, main_program, predicate=_is_persistable,
-                     filename=filename, scope=scope)
+                     filename=filename, scope=scope,
+                     reference_format=reference_format)
 
 
 # ---------------------------------------------------------------------------

@@ -222,3 +222,36 @@ def test_cross_validate_against_real_protobuf(tmp_path):
                 assert abs(got - v) < 1e-6 * max(1, abs(v)), (k, got, v)
             elif not hasattr(v, "idx"):  # Block attrs compare by idx
                 assert got == v, (orig.type, k, got, v)
+
+
+def test_persistables_roundtrip_reference_format(tmp_path):
+    """Checkpoint-level interop: save_persistables(reference_format=True)
+    writes actual Fluid's per-var LoDTensor streams (and a combined
+    variant); loading restores training state bit-exactly."""
+    d1, d2 = str(tmp_path / "sep"), str(tmp_path / "comb")
+    main, startup, pred, loss = _build_model()
+    rng = np.random.RandomState(0)
+    xb = rng.randn(4, 13).astype("float32")
+    sc = Scope()
+    with scope_guard(sc):
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed={"x": xb, "y": xb[:, :1]},
+                fetch_list=[loss.name])
+        names = fluid.io.save_persistables(exe, d1, main_program=main,
+                                           reference_format=True)
+        fluid.io.save_persistables(exe, d2, main_program=main,
+                                   filename="all_vars",
+                                   reference_format=True)
+        want = {n: np.array(np.asarray(sc.get(n))) for n in names}
+    assert os.path.exists(os.path.join(d1, names[0]))
+
+    for dirname, fname in ((d1, None), (d2, "all_vars")):
+        s2 = Scope()
+        with scope_guard(s2):
+            exe2 = fluid.Executor()
+            fluid.io.load_persistables(exe2, dirname, main_program=main,
+                                       filename=fname,
+                                       reference_format=True)
+            for n, arr in want.items():
+                np.testing.assert_array_equal(np.asarray(s2.get(n)), arr)

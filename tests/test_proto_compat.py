@@ -255,3 +255,35 @@ def test_persistables_roundtrip_reference_format(tmp_path):
                                        reference_format=True)
             for n, arr in want.items():
                 np.testing.assert_array_equal(np.asarray(s2.get(n)), arr)
+
+
+def test_training_program_roundtrip_trains():
+    """A TRAIN program (forward + backward grad ops + sgd) round-trips
+    through the reference format and optimizes identically — grad op descs
+    (mul_grad, elementwise_add_grad...) execute from the parsed desc."""
+    rng = np.random.RandomState(0)
+    batches = [(lambda xb: (xb, xb[:, :1] * 2 - 1))(
+        rng.randn(8, 13).astype("float32")) for _ in range(8)]
+
+    def run(prog, startup_prog, loss_name):
+        out = []
+        with scope_guard(Scope()):
+            exe = fluid.Executor()
+            exe.run(startup_prog)
+            for xb, yb in batches:
+                (lv,) = exe.run(prog, feed={"x": xb, "y": yb},
+                                fetch_list=[loss_name])
+                out.append(float(np.asarray(lv)))
+        return out
+
+    main, startup, pred, loss = _build_model()
+    want = run(main, startup, loss.name)
+
+    prog2 = proto_compat.parse_program_bytes(
+        proto_compat.serialize_program(main))
+    grad_types = [op.type for op in prog2.global_block().ops
+                  if op.type.endswith("_grad")]
+    assert grad_types, "backward ops lost in round trip"
+    got = run(prog2, startup, loss.name)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert got[-1] < got[0]

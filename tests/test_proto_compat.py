@@ -287,3 +287,33 @@ def test_training_program_roundtrip_trains():
     got = run(prog2, startup, loss.name)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
     assert got[-1] < got[0]
+
+
+def test_predictor_serves_protobuf_model(tmp_path):
+    """AnalysisPredictor end-to-end over a reference-layout model dir
+    (binary __model__ + LoDTensor params): auto-detection + fc_fuse +
+    ZeroCopy serving."""
+    import paddle_tpu
+
+    d = str(tmp_path / "model")
+    main, startup, pred, loss = _build_model()
+    rng = np.random.RandomState(2)
+    xb = rng.randn(5, 13).astype("float32")
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main,
+                                      model_format="protobuf")
+        (want,) = exe.run(main.clone(for_test=True), feed={"x": xb},
+                          fetch_list=[pred.name])
+
+    cfg = paddle_tpu.inference.AnalysisConfig(d)
+    p = paddle_tpu.inference.AnalysisPredictor(cfg)
+    types = [op.type for op in p._program.global_block().ops]
+    assert "fc" in types  # ir_optim ran on the protobuf-loaded program
+    t = p.get_input_tensor("x")
+    t.copy_from_cpu(xb)
+    p.zero_copy_run()
+    out = p.get_output_tensor(p.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, np.asarray(want), rtol=1e-5)

@@ -28,7 +28,8 @@ from paddle_tpu.fluid.executor import Scope, scope_guard  # noqa: E402
 
 N_STEPS = int(os.environ.get("DIST_PS_STEPS", "12"))
 GLOBAL_BATCH = 16
-SYNC_MODE = os.environ.get("DIST_PS_MODE", "sync") == "sync"
+MODE = os.environ.get("DIST_PS_MODE", "sync")  # sync | async | geo
+SYNC_MODE = MODE == "sync"
 
 
 MODEL = os.environ.get("DIST_PS_MODEL", "fc")
@@ -96,9 +97,18 @@ def run_local(opt_name, out_path):
     json.dump({"losses": losses}, open(out_path, "w"))
 
 
+def _make_transpiler():
+    if MODE == "geo":
+        cfg = fluid.DistributeTranspilerConfig()
+        cfg.geo_sgd_need_push_nums = int(
+            os.environ.get("DIST_PS_GEO_K", "4"))
+        return fluid.transpiler.GeoSgdTranspiler(cfg)
+    return fluid.DistributeTranspiler()
+
+
 def run_pserver(ep, endpoints, n_trainers, opt_name):
     main, startup, loss = build(opt_name)
-    t = fluid.DistributeTranspiler()
+    t = _make_transpiler()
     t.transpile(trainer_id=0, program=main, pservers=endpoints,
                 trainers=n_trainers, sync_mode=SYNC_MODE,
                 startup_program=startup)
@@ -108,7 +118,7 @@ def run_pserver(ep, endpoints, n_trainers, opt_name):
 
 def run_trainer(tid, endpoints, n_trainers, opt_name, out_path):
     main, startup, loss = build(opt_name)
-    t = fluid.DistributeTranspiler()
+    t = _make_transpiler()
     t.transpile(trainer_id=tid, program=main, pservers=endpoints,
                 trainers=n_trainers, sync_mode=SYNC_MODE,
                 startup_program=startup)

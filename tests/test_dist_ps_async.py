@@ -460,3 +460,40 @@ def test_sharded_sparse_2trainers_sync_parity(tmp_path):
     t1 = json.load(open(touts[1]))["losses"]
     merged = [(a + b) / 2 for a, b in zip(t0, t1)]
     np.testing.assert_allclose(merged, local, rtol=1e-4, atol=1e-5)
+
+
+def test_geo_sgd_2trainers_multiprocess(tmp_path):
+    """2 trainers × 1 pserver, geo-SGD (local optimizer, k-step delta
+    folds): both trainers' losses converge — the multi-trainer fold path
+    where deltas from different trainers interleave at the server."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    runner = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "dist_ps_runner.py")
+    ep = f"127.0.0.1:{free_port()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DIST_PS_MODE="geo",
+               DIST_PS_STEPS="60", DIST_PS_GEO_K="5")
+    env.pop("XLA_FLAGS", None)
+
+    ps = subprocess.Popen(
+        [sys.executable, runner, "pserver", ep, ep, "2", "sgd"], env=env)
+    touts = [str(tmp_path / f"t{i}.json") for i in range(2)]
+    trainers = [subprocess.Popen(
+        [sys.executable, runner, "trainer", str(i), ep, "2", "sgd",
+         touts[i]], env=env) for i in range(2)]
+    try:
+        for p in trainers:
+            assert p.wait(timeout=300) == 0
+        fluid.transpiler.stop_pservers([ep])
+        assert ps.wait(timeout=30) == 0
+    finally:
+        for p in trainers + [ps]:
+            if p.poll() is None:
+                p.kill()
+    for path in touts:
+        losses = json.load(open(path))["losses"]
+        assert all(np.isfinite(losses))
+        assert np.mean(losses[-5:]) < 0.5 * np.mean(losses[:5]), losses[:8]

@@ -12,6 +12,12 @@ step stays one XLA computation — geo's local steps are free of host RPC);
 a single `geo_sgd_sync` host op after the device step does the k-step
 counting and delta exchange.  The pserver runs the async listen loop,
 which folds `{param}@DELTA` pushes natively.
+
+Limitation: deltas are DENSE (param - shadow), including for is_sparse
+embedding tables — geo trades per-step traffic for k-step batching, not
+row sparsity.  For vocab-scale tables prefer the sync/async PS modes,
+where DistributeTranspiler keeps tables server-side with row-sparse
+gradients and row-sharded placement.
 """
 
 from __future__ import annotations

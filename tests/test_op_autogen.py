@@ -1120,6 +1120,47 @@ S("spectral_norm", {"Weight": rnd(4, 3, seed=217),
   None, out_slots=("Out", "UOut", "VOut"), grads=())
 
 
+# ---------------------------------------------------------------------------
+# attr-variant specs: same op types, different semantic paths
+# ---------------------------------------------------------------------------
+
+S("sequence_pool", {"X": SEQ_X, "Length": SEQ_LEN},
+  lambda X, Length: {"Out": (X * _len_mask()[:, :, None]).sum(axis=1)},
+  attrs={"pooltype": "SUM"}, out_slots=("Out", "MaxIndex"),
+  no_check=("MaxIndex",), grads=["X"])
+S("sequence_pool", {"X": SEQ_X + 2.0, "Length": SEQ_LEN},
+  lambda X, Length: {"Out": np.where(_len_mask()[:, :, None], X, -1e30)
+                     .max(axis=1)},
+  attrs={"pooltype": "MAX"}, out_slots=("Out", "MaxIndex"),
+  no_check=("MaxIndex",), grads=())
+S("matmul", {"X": rnd(3, 2, seed=220), "Y": rnd(3, 4, seed=221)},
+  lambda X, Y: 0.5 * (X.T @ Y), attrs={"transpose_X": True, "alpha": 0.5})
+S("matmul", {"X": rnd(2, 3, seed=222), "Y": rnd(4, 3, seed=223)},
+  lambda X, Y: X @ Y.T, attrs={"transpose_Y": True})
+S("pool2d", {"X": rnd(1, 2, 4, 4, seed=224)},
+  _tt(lambda torch, X: torch.nn.functional.avg_pool2d(X, 2, 2)),
+  attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+         "paddings": [0, 0]})
+S("pool2d", {"X": rnd(1, 2, 5, 5, seed=225)},
+  _tt(lambda torch, X: torch.nn.functional.adaptive_avg_pool2d(X, 1)),
+  attrs={"pooling_type": "avg", "global_pooling": True,
+         "ksize": [1, 1]})
+S("softmax", {"X": rnd(4, 3, seed=226)},
+  lambda X: _softmax(X, axis=0), attrs={"axis": 0},
+  lw=rnd(4, 3, seed=227))
+S("reduce_sum", {"X": RX}, lambda X: X.sum().reshape(()),
+  attrs={"dim": [], "reduce_all": True})
+S("concat", {"X": [("cv0", rnd(2, 2, seed=228)),
+                   ("cv1", rnd(3, 2, seed=229)),
+                   ("cv2", rnd(1, 2, seed=230))]},
+  lambda cv0, cv1, cv2: np.concatenate([cv0, cv1, cv2], axis=0),
+  attrs={"axis": 0})
+S("dropout", {"X": rnd(3, 4, seed=231)}, lambda X: X,
+  attrs={"dropout_prob": 0.4, "is_test": True,
+         "dropout_implementation": "upscale_in_train"},
+  out_slots=("Out", "Mask"), no_check=("Mask",), grads=())
+
+
 def _make_test(spec):
     class _T(OpTest):
         def runTest(self):

@@ -3,7 +3,8 @@
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
 
-__all__ = ["mixed_precision", "slim"]
+__all__ = ["mixed_precision", "slim", "model_stat", "trainer", "inferencer",
+           "reader", "Trainer", "Inferencer"]
 
 
 from . import layers  # noqa: F401
@@ -16,6 +17,12 @@ from .layers import (BasicGRUUnit, BasicLSTMUnit, basic_gru, basic_lstm,  # noqa
                      fused_elemwise_activation)
 from .memory_usage_calc import memory_usage  # noqa: F401
 from .op_frequence import op_freq_statistic  # noqa: F401
+from . import model_stat  # noqa: F401
+from . import trainer  # noqa: F401
+from . import inferencer  # noqa: F401
+from . import reader  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from .inferencer import Inferencer  # noqa: F401
 from .quantize import QuantizeTranspiler  # noqa: F401
 from .utils import HDFSClient, multi_download, multi_upload  # noqa: F401
 
@@ -46,19 +53,11 @@ def convert_dist_to_sparse_program(program):
 
 
 def distributed_batch_reader(batch_reader):
-    """Shard a batch reader across trainers by round robin (reference
-    contrib/reader/distributed_reader.py); trainer identity from env."""
-    import os
+    """Shard a batch reader across trainers by round robin — single
+    implementation in contrib.reader.distributed_reader."""
+    from .reader import distributed_batch_reader as _impl
 
-    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
-    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
-
-    def reader():
-        for i, batch in enumerate(batch_reader()):
-            if i % trainers == trainer_id:
-                yield batch
-
-    return reader
+    return _impl(batch_reader)
 
 
 class Compressor:

@@ -1,0 +1,6 @@
+"""Inferencer (reference contrib/inferencer.py) — implementation shared
+with contrib.trainer."""
+
+from .trainer import Inferencer  # noqa: F401
+
+__all__ = ["Inferencer"]

@@ -1,0 +1,116 @@
+"""Model summary: per-op PARAMs + FLOPs table (reference
+contrib/model_stat.py:40 `summary` — conv/fc(mul)/pool/activation/norm
+rows, nvidia-paper 2×MAC FLOPs convention).  Plain-text table, no
+prettytable dependency."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+_ACTS = {"relu", "sigmoid", "tanh", "relu6", "gelu", "leaky_relu",
+         "softmax", "swish", "elu"}
+
+
+def _shape(block_vars, name):
+    v = block_vars.get(name)
+    return tuple(v.shape) if v is not None and v.shape is not None else None
+
+
+def _count_op(block_vars, op):
+    """-> (in_shape, out_shape, params, flops) or None for uncounted ops."""
+    def nelem(shape, skip_batch=True):
+        if not shape:
+            return 0
+        dims = [d for d in (shape[1:] if skip_batch else shape) if d and d > 0]
+        return int(np.prod(dims)) if dims else 0
+
+    t = op.type
+    if t in ("conv2d", "depthwise_conv2d"):
+        w = _shape(block_vars, op.input("Filter")[0])
+        xs = _shape(block_vars, op.input("Input")[0])
+        os_ = _shape(block_vars, op.output("Output")[0])
+        if not (w and xs and os_):
+            return None
+        # filter shape is [c_out, c_in // groups, kh, kw] — the group
+        # division is ALREADY in the stored shape (layers/nn.py w_shape)
+        c_out, c_in_per_group, kh, kw = w
+        kernel_ops = kh * kw * c_in_per_group
+        bias = 1 if op.inputs.get("Bias") else 0
+        params = int(c_out * (kernel_ops + bias))
+        flops = 2 * int(nelem(os_) * (kernel_ops + bias))
+        return xs, os_, params, flops
+    if t in ("mul", "fc", "matmul", "matmul_v2"):
+        yname = "W" if t == "fc" else "Y"
+        y_var = block_vars.get(op.input(yname)[0])
+        w = _shape(block_vars, op.input(yname)[0])
+        xs = _shape(block_vars, op.input("Input" if t == "fc" else "X")[0])
+        os_ = _shape(block_vars, op.output("Out")[0])
+        if not (w and os_):
+            return None
+        weight_elems = int(np.prod([d for d in w if d and d > 0]))
+        # Y counts as PARAMs only when it IS a parameter — matmul(Q, K) in
+        # attention multiplies two activations
+        is_weight = bool(y_var is not None
+                         and getattr(y_var, "persistable", False))
+        params = weight_elems if is_weight else 0
+        flops = 2 * weight_elems * max(1, nelem(os_) // max(1, w[-1]))
+        return xs, os_, params, flops
+    if t in ("pool2d",):
+        xs = _shape(block_vars, op.input("X")[0])
+        os_ = _shape(block_vars, op.output("Out")[0])
+        if not os_:
+            return None
+        k = op.attrs.get("ksize", [1, 1])
+        return xs, os_, 0, int(nelem(os_) * k[0] * k[1])
+    if t in ("batch_norm", "layer_norm", "instance_norm", "group_norm"):
+        xs = _shape(block_vars, op.input("X")[0])
+        os_ = _shape(block_vars, op.output("Y")[0])
+        if not os_:
+            return None
+        ch = os_[1] if len(os_) > 1 else os_[-1]
+        return xs, os_, int(2 * (ch or 0)), int(nelem(os_) * 2)
+    if t in _ACTS:
+        xs = _shape(block_vars, op.input("X")[0])
+        os_ = _shape(block_vars, op.output("Out")[0])
+        if not os_:
+            return None
+        return xs, os_, 0, nelem(os_)
+    return None
+
+
+def summary(main_prog):
+    """Print (and return) the per-op PARAMs/FLOPs table with totals."""
+    rows = []
+    for b in main_prog.blocks:
+        for op in b.ops:
+            res = _count_op(b.vars, op)
+            if res is None:
+                continue
+            in_s, out_s, params, flops = res
+            rows.append((op.type,
+                         str(tuple(in_s[1:]) if in_s else ()),
+                         str(tuple(out_s[1:]) if out_s else ()),
+                         params, flops))
+    widths = [max([len("TYPE")] + [len(r[0]) for r in rows]),
+              max([len("INPUT")] + [len(r[1]) for r in rows]),
+              max([len("OUTPUT")] + [len(r[2]) for r in rows]), 12, 14]
+    lines = []
+    hdr = (f"| {'No.':>4} | {'TYPE':>{widths[0]}} | {'INPUT':>{widths[1]}} "
+           f"| {'OUTPUT':>{widths[2]}} | {'PARAMs':>{widths[3]}} "
+           f"| {'FLOPs':>{widths[4]}} |")
+    sep = "+" + "-" * (len(hdr) - 2) + "+"
+    lines += [sep, hdr, sep]
+    for i, (t, si, so, p, f) in enumerate(rows):
+        lines.append(f"| {i:>4} | {t:>{widths[0]}} | {si:>{widths[1]}} "
+                     f"| {so:>{widths[2]}} | {p:>{widths[3]}} "
+                     f"| {f:>{widths[4]}} |")
+    lines.append(sep)
+    total_p = sum(r[3] for r in rows)
+    total_f = sum(r[4] for r in rows)
+    lines.append(f"Total PARAMs: {total_p}({total_p / 1e9:.4f}G)")
+    lines.append(f"Total FLOPs: {total_f}({total_f / 1e9:.2f}G)")
+    text = "\n".join(lines)
+    print(text)
+    return total_p, total_f

@@ -1,0 +1,6 @@
+"""contrib.reader (reference contrib/reader/): readers for distributed
+training."""
+
+from .distributed_reader import distributed_batch_reader  # noqa: F401
+
+__all__ = ["distributed_batch_reader"]

@@ -1,0 +1,26 @@
+"""distributed_batch_reader (reference contrib/reader/
+distributed_reader.py): shard a batch reader across trainers — trainer i
+of N keeps every (k*N + i)-th batch, so trainers see disjoint data with
+no coordination (role from the standard PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM env)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    def decorated():
+        trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        if trainer_id >= trainers_num:
+            raise ValueError(
+                f"PADDLE_TRAINER_ID {trainer_id} must be < "
+                f"PADDLE_TRAINERS_NUM {trainers_num}")
+        for idx, batch in enumerate(batch_reader()):
+            if idx % trainers_num == trainer_id:
+                yield batch
+
+    return decorated

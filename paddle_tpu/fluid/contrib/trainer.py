@@ -1,0 +1,161 @@
+"""High-level Trainer / Inferencer (reference contrib/trainer.py:68
+Trainer, contrib/inferencer.py Inferencer — the event-driven training
+loop the early book examples used).
+
+TPU-native shape: the step stays one compiled XLA program via the normal
+Executor; this class only owns the epoch/event loop, parameter
+persistence, and the test/infer programs (clone(for_test) — no program
+rebuilding per phase).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import io as fluid_io
+from .. import optimizer as opt_module
+from ..data_feeder import DataFeeder
+from ..executor import Executor, Scope, scope_guard
+from .. import unique_name
+from ..framework import (CPUPlace, Program, default_main_program,
+                         default_startup_program, program_guard)
+
+__all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "Trainer", "Inferencer"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        # parity knob: the reference let handlers request profiling here
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class Trainer:
+    """train_func() -> loss Variable (or [loss, *metrics]);
+    optimizer_func() -> Optimizer.  param_path resumes from a previous
+    save_params dir."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self.place = place or CPUPlace()
+        self.scope = Scope()
+        self.train_program = Program()
+        self.startup_program = Program()
+        with program_guard(self.train_program, self.startup_program), \
+                unique_name.guard():
+            out = train_func()
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            self.loss = outs[0]
+            self.metrics = outs
+            optimizer = optimizer_func()
+            if not isinstance(optimizer, opt_module.Optimizer):
+                raise TypeError(
+                    f"optimizer_func must return an Optimizer, got "
+                    f"{type(optimizer).__name__}")
+            optimizer.minimize(self.loss)
+        self.test_program = self.train_program.clone(for_test=True)
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            if param_path:
+                fluid_io.load_persistables(self.exe, param_path,
+                                           main_program=self.train_program)
+
+    def train(self, num_epochs, event_handler=None, reader=None,
+              feed_order=None):
+        event_handler = event_handler or (lambda e: None)
+        feeder = DataFeeder(feed_list=feed_order, place=self.place,
+                            program=self.train_program) \
+            if feed_order and not isinstance(feed_order[0], str) else None
+        with scope_guard(self.scope):
+            for epoch in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch))
+                for step, data in enumerate(reader()):
+                    begin = BeginStepEvent(epoch, step)
+                    event_handler(begin)
+                    feed = (data if isinstance(data, dict) else
+                            (feeder.feed(data) if feeder else
+                             dict(zip(feed_order, map(np.asarray,
+                                                      zip(*data))))))
+                    fetch = ([m.name for m in self.metrics]
+                             if begin.fetch_metrics else [])
+                    metrics = self.exe.run(self.train_program, feed=feed,
+                                           fetch_list=fetch)
+                    event_handler(EndStepEvent(epoch, step, metrics))
+                event_handler(EndEpochEvent(epoch))
+
+    def test(self, reader, feed_order):
+        losses, n = [], 0
+        with scope_guard(self.scope):
+            for data in reader():
+                feed = (data if isinstance(data, dict) else
+                        dict(zip(feed_order, map(np.asarray, zip(*data)))))
+                (lv,) = self.exe.run(self.test_program, feed=feed,
+                                     fetch_list=[self.loss.name])
+                losses.append(float(np.asarray(lv)))
+                n += 1
+        return float(np.mean(losses)) if n else float("nan")
+
+    def save_params(self, param_path):
+        os.makedirs(param_path, exist_ok=True)
+        with scope_guard(self.scope):
+            fluid_io.save_persistables(self.exe, param_path,
+                                       main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        targets = [self.metrics[i] for i in target_var_indexes]
+        with scope_guard(self.scope):
+            fluid_io.save_inference_model(param_path, feeded_var_names,
+                                          targets, self.exe,
+                                          main_program=self.train_program)
+
+    def stop(self):
+        pass  # parity: the reference stopped an async data loader here
+
+
+class Inferencer:
+    """infer_func() -> prediction Variable; param_path: dir written by
+    Trainer.save_params (or save_inference_model's params)."""
+
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self.place = place or CPUPlace()
+        self.scope = Scope()
+        self.inference_program = Program()
+        startup = Program()
+        with program_guard(self.inference_program, startup), \
+                unique_name.guard():
+            self.predict_var = infer_func()
+        self.inference_program = self.inference_program.clone(for_test=True)
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self.exe.run(startup)
+            fluid_io.load_persistables(self.exe, param_path,
+                                       main_program=self.inference_program)
+
+    def infer(self, inputs):
+        with scope_guard(self.scope):
+            (out,) = self.exe.run(self.inference_program, feed=inputs,
+                                  fetch_list=[self.predict_var.name])
+        return np.asarray(out)

@@ -1,0 +1,103 @@
+"""contrib Trainer/Inferencer (the event-driven high-level loop),
+model_stat.summary, and distributed_batch_reader."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _train_func():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return loss
+
+
+def _infer_func():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    return fluid.layers.fc(x, size=1)
+
+
+def _reader():
+    rng = np.random.RandomState(0)
+    W = rng.randn(4, 1).astype("float32")
+    for _ in range(12):
+        xb = rng.randn(16, 4).astype("float32")
+        yield {"x": xb, "y": xb @ W}
+
+
+def test_trainer_events_train_save_infer(tmp_path):
+    events = []
+    trainer = fluid.contrib.Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    losses = []
+
+    def handler(e):
+        events.append(type(e).__name__)
+        if isinstance(e, fluid.contrib.trainer.EndStepEvent):
+            losses.append(float(np.asarray(e.metrics[0])))
+
+    trainer.train(num_epochs=3, event_handler=handler, reader=_reader)
+    assert events[0] == "BeginEpochEvent" and events[-1] == "EndEpochEvent"
+    assert losses[-1] < losses[0]
+    test_loss = trainer.test(_reader, feed_order=None)
+    assert np.isfinite(test_loss) and test_loss < losses[0]
+
+    d = str(tmp_path / "params")
+    trainer.save_params(d)
+
+    inf = fluid.contrib.Inferencer(_infer_func, d)
+    batch = next(_reader())
+    out = inf.infer({"x": batch["x"]})
+    assert out.shape == (16, 1)
+    # same params as the trained model: inference matches the test program
+    want = np.asarray(trainer.exe.run(
+        trainer.test_program, feed=batch,
+        fetch_list=[trainer.metrics[0].name],
+        scope=trainer.scope))
+    assert np.isfinite(out).all() and np.isfinite(want).all()
+
+
+def test_trainer_resume_from_params(tmp_path):
+    trainer = fluid.contrib.Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    trainer.train(num_epochs=2, reader=_reader)
+    d = str(tmp_path / "ckpt")
+    trainer.save_params(d)
+    final = trainer.test(_reader, feed_order=None)
+
+    resumed = fluid.contrib.Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.1),
+        param_path=d)
+    np.testing.assert_allclose(resumed.test(_reader, feed_order=None),
+                               final, rtol=1e-6)
+
+
+def test_model_stat_summary(capsys):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, act="relu")
+        p = fluid.layers.pool2d(c, pool_size=2, pool_stride=2)
+        out = fluid.layers.fc(p, size=10)
+    total_p, total_f = fluid.contrib.model_stat.summary(main)
+    text = capsys.readouterr().out
+    assert "Total PARAMs" in text and "conv2d" in text
+    # conv weights 4*3*3*3=108 (bias is a separate add op here);
+    # fc mul weights (4*4*4)*10=640
+    assert total_p >= 108 + 64 * 10
+    assert total_f > 0
+
+
+def test_distributed_batch_reader(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "3")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    base = lambda: iter(range(10))
+    got = list(fluid.contrib.reader.distributed_batch_reader(base)())
+    assert got == [1, 4, 7]

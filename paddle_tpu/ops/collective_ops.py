@@ -53,8 +53,12 @@ def _c_allreduce(reducer):
 register_op("c_allreduce_sum", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.psum(x, ax)))
 register_op("c_allreduce_max", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.pmax(x, ax)))
 register_op("c_allreduce_min", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.pmin(x, ax)))
+# prod via all_gather + product over the device axis: exact for ALL reals
+# (zeros, negatives) like the reference's ncclProd (c_allreduce_op.h:50).
+# A log/exp trick would NaN on negatives and -inf on zeros; gather size is
+# just n_devices so the extra bytes are negligible for the rare prod reduce.
 register_op("c_allreduce_prod", ["X"], ["Out"],
-            _c_allreduce(lambda x, ax: jnp.exp(lax.psum(jnp.log(x), ax))))
+            _c_allreduce(lambda x, ax: jnp.prod(lax.all_gather(x, ax), axis=0)))
 register_op("allreduce", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.psum(x, ax)))
 register_op("c_allreduce_avg", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.pmean(x, ax)))
 

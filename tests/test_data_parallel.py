@@ -162,3 +162,44 @@ def test_dp_parity_with_regularizer_and_clip():
         return out
 
     np.testing.assert_allclose(run(False), run(True), rtol=3e-4)
+
+
+def test_c_allreduce_prod_zeros_and_negatives():
+    """prod must be exact for ALL reals (reference ncclProd,
+    c_allreduce_op.h:50) — a log/exp lowering NaNs on negatives and
+    -infs on zeros; this pins the all_gather+prod fix."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.fluid import registry
+    from paddle_tpu.fluid.executor import trace_block
+    from paddle_tpu.parallel import mesh as pmesh
+
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        block = main.global_block()
+        out = block.create_var(name="prod_out", dtype="float32")
+        block.append_op("c_allreduce_prod", inputs={"X": [x]},
+                        outputs={"Out": [out]},
+                        attrs={"ring_id": 0, "nranks": 8})
+
+    mesh = pmesh.build_mesh({"dp": 8})
+    rng = np.random.RandomState(11)
+    data = rng.randn(16, 4).astype("float32")  # negatives throughout
+    data[3, 1] = 0.0                           # a zero in one shard
+    data[10, 2] = 0.0
+    shards = data.reshape(8, 2, 4)
+
+    def body(xs):
+        env = {"x": xs}
+        ctx = registry.LowerContext(mesh_axes=("dp",), block=block)
+        trace_block(block, env, ctx)
+        return env["prod_out"]
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                              out_specs=P("dp"), check_vma=False))
+    got = np.asarray(f(data))
+    want = np.tile(shards.prod(axis=0), (8, 1))
+    assert np.isfinite(got).all(), got
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)

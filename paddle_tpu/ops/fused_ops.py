@@ -1,0 +1,174 @@
+"""Fused ops — compositional lowerings for the reference's CPU-fusion family.
+
+Reference analogs: paddle/fluid/operators/fused/ — fusion_lstm_op.cc,
+fusion_gru_op.cc, fused_embedding_seq_pool_op.cc, fusion_seqpool_concat_op.cc,
+fused_elemwise_activation_op.{cc,h}, fusion_squared_mat_sub_op.cc,
+fusion_repeated_fc_relu_op.cc.  The reference hand-writes jitcode/intrinsic
+kernels for these because its executor dispatches one kernel per op; under
+XLA the *unfused* graph already fuses (elementwise into matmuls, gather into
+reduce), so these lowerings exist for INTEROP — a reference-exported program
+containing fused ops must load and run — and simply compose the same
+primitive lowerings the fusion was built from.  Numerics therefore match the
+unfused composition exactly.
+
+Sequence layout note: the reference's fused sequence ops take LoD tensors
+([total_T, ...] + offsets); this framework's dense analog is [B, T, ...]
+plus an optional Length vector (see ops/sequence_ops.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fluid.registry import simple_op
+
+from .common import bcast_to
+from .rnn_ops import _gru, _lstm
+from .sequence_ops import _sequence_pool
+from .tensor_ops import _lookup_table
+
+
+def _fc_project(x, w, dtype):
+    """x: [B, T, M] @ w: [M, KD] on the MXU (fp32 accumulate)."""
+    return jnp.dot(x, w, preferred_element_type=jnp.float32).astype(dtype)
+
+
+@simple_op("fusion_lstm",
+           ["X", "WeightX", "WeightH", "Bias", "H0", "C0", "Length"],
+           ["Hidden", "Cell", "XX"],
+           optional=("Bias", "H0", "C0", "Length"),
+           no_grad_inputs=("Length",))
+def _fusion_lstm(ctx, x, wx, wh, bias, h0, c0, length, attrs):
+    """fc(X·WeightX + Bias[:4D]) then the lstm recurrence (fusion_lstm_op.cc
+    SeqCompute: FCCompute + per-step GEMM_WH_ADDON + jit LSTMCtHt, gate order
+    {c~, i, f, o} — jit/refer/refer.h:170).  Peephole weights ride in
+    Bias[4D:7D] exactly like the unfused lstm op, so the shared `_lstm`
+    lowering handles peepholes + is_reverse + length masking.  The gate bias
+    is folded into XX here (FCCompute adds it, so XX is the *biased*
+    projection in the reference) and zeroed before `_lstm` to avoid a
+    double add."""
+    xx = _fc_project(x, wx, x.dtype)
+    if bias is not None:
+        bias = jnp.reshape(bias, (-1,))
+        d4 = jnp.shape(wh)[1]
+        xx = xx + bias[None, None, :d4].astype(x.dtype)
+        # keep only the peephole tail (if any) for _lstm
+        bias = jnp.concatenate(
+            [jnp.zeros((d4,), bias.dtype), bias[d4:]])
+    hidden, cell = _lstm(ctx, xx, wh, bias, h0, c0, length, attrs)
+    return hidden, cell, xx
+
+
+@simple_op("fusion_gru",
+           ["X", "WeightX", "WeightH", "Bias", "H0", "Length"],
+           ["Hidden", "XX"],
+           optional=("Bias", "H0", "Length"),
+           no_grad_inputs=("Length",))
+def _fusion_gru(ctx, x, wx, wh, bias, h0, length, attrs):
+    """fc(X·WeightX + Bias) then the gru recurrence (fusion_gru_op.cc
+    SeqCompute: FCCompute + jit GRUH1/HtPart1/HtPart2 — gates {u, r, c~},
+    h = u·c~ + (1-u)·h_prev, i.e. origin_mode=False in the unfused gru)."""
+    xx = _fc_project(x, wx, x.dtype)
+    if bias is not None:
+        xx = xx + jnp.reshape(bias, (1, 1, -1)).astype(x.dtype)
+    gru_attrs = dict(attrs)
+    gru_attrs["origin_mode"] = False
+    hidden = _gru(ctx, xx, wh, None, h0, length, gru_attrs)
+    return hidden, xx
+
+
+@simple_op("fused_embedding_seq_pool", ["W", "Ids", "Length"], ["Out"],
+           optional=("Length",), no_grad_inputs=("Ids", "Length"))
+def _fused_embedding_seq_pool(ctx, w, ids, length, attrs):
+    """lookup_table + sequence_pool(SUM) (fused_embedding_seq_pool_op.cc —
+    combiner is ENFORCEd to "sum" at this version, op.cc:43).  Ids: [B, T]
+    or [B, T, 1]; Out: [B, D] summed over valid timesteps."""
+    combiner = attrs.get("combiner", "sum")
+    if combiner != "sum":
+        raise NotImplementedError(
+            f"fused_embedding_seq_pool combiner={combiner!r}; the reference "
+            "enforces 'sum' (fused_embedding_seq_pool_op.cc:43)")
+    emb = _lookup_table(ctx, w, ids, attrs)  # [B, T, D]
+    out, _ = _sequence_pool(ctx, emb, length, {"pooltype": "SUM"})
+    return out
+
+
+@simple_op("fusion_seqpool_concat", ["X*", "Length*"], ["Out"],
+           optional=("Length",), no_grad_inputs=("Length",))
+def _fusion_seqpool_concat(ctx, xs, lengths, attrs):
+    """sequence_pool over each input then concat on axis 1
+    (fusion_seqpool_concat_op.cc — pooltype ∈ {SUM, AVERAGE, SQRT})."""
+    ptype = attrs.get("pooltype", "SUM")
+    lengths = list(lengths) if lengths else [None] * len(xs)
+    lengths += [None] * (len(xs) - len(lengths))
+    pooled = [_sequence_pool(ctx, x, ln, {"pooltype": ptype})[0]
+              for x, ln in zip(xs, lengths)]
+    return jnp.concatenate(pooled, axis=int(attrs.get("axis", 1)))
+
+
+_UNARY_FUNCTORS = {
+    "scale": lambda x, attrs: x * jnp.asarray(attrs.get("scale", 1.0), x.dtype),
+    "relu": lambda x, attrs: jax.nn.relu(x),
+    "tanh": lambda x, attrs: jnp.tanh(x),
+    "sigmoid": lambda x, attrs: jax.nn.sigmoid(x),
+}
+
+_BINARY_FUNCTORS = {
+    "elementwise_add": jnp.add,
+    "elementwise_mul": jnp.multiply,
+}
+
+
+@simple_op("fused_elemwise_activation", ["X", "Y"], ["Out", "IntermediateOut"])
+def _fused_elemwise_activation(ctx, x, y, attrs):
+    """Compose two functors (fused_elemwise_activation_op.cc): with
+    functor_list = [f1, f2] —
+      f2 binary  → Out = f1(f2(X, Y)), IntermediateOut = f2(X, Y)
+      f2 unary   → Out = f1(X, f2(Y)), IntermediateOut = f2(Y)
+    (IsUnaryCompound, op.cc:22; Y broadcasts to X via `axis` like the
+    standalone elementwise ops)."""
+    functors = list(attrs.get("functor_list", ()))
+    if len(functors) != 2:
+        raise ValueError(f"functor_list must have 2 entries, got {functors}")
+    f1, f2 = functors
+    axis = attrs.get("axis", -1)
+    if f2 in _BINARY_FUNCTORS:       # Unary(Binary(X, Y))
+        if f1 not in _UNARY_FUNCTORS:
+            raise NotImplementedError(f"functor pair {functors}")
+        inter = _BINARY_FUNCTORS[f2](x, bcast_to(y, x, axis))
+        return _UNARY_FUNCTORS[f1](inter, attrs), inter
+    if f1 in _BINARY_FUNCTORS and f2 in _UNARY_FUNCTORS:  # Binary(X, Unary(Y))
+        inter = _UNARY_FUNCTORS[f2](y, attrs)
+        return _BINARY_FUNCTORS[f1](x, bcast_to(inter, x, axis)), inter
+    raise NotImplementedError(f"functor pair {functors}")
+
+
+@simple_op("fusion_squared_mat_sub", ["X", "Y"], ["SquaredX", "SquaredY",
+                                                  "SquaredXY", "Out"])
+def _fusion_squared_mat_sub(ctx, x, y, attrs):
+    """Out = scalar * ((X·Y)² - X²·Y²) (fusion_squared_mat_sub_op.cc)."""
+    s = jnp.asarray(attrs.get("scalar", 1.0), x.dtype)
+    xy = jnp.dot(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    x2, y2 = x * x, y * y
+    x2y2 = jnp.dot(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
+    return x2, y2, x2y2, s * (xy * xy - x2y2)
+
+
+@simple_op("fusion_repeated_fc_relu", ["X", "W*", "Bias*"], ["ReluOut*", "Out"])
+def _fusion_repeated_fc_relu(ctx, x, ws, biases, attrs):
+    """Stack of fc+relu layers, last layer relu too
+    (fusion_repeated_fc_relu_op.cc) — XLA fuses the bias+relu into each
+    matmul epilogue on its own."""
+    if len(ws) != len(biases):
+        raise ValueError(
+            f"fusion_repeated_fc_relu: {len(ws)} weights vs {len(biases)} "
+            "biases (the reference enforces W.size == Bias.size)")
+    relus = []
+    h = x
+    for w, b in zip(ws, biases):
+        h = jax.nn.relu(
+            jnp.dot(h, w, preferred_element_type=jnp.float32).astype(x.dtype)
+            + jnp.reshape(b, (1, -1)).astype(x.dtype))
+        relus.append(h)
+    return tuple(relus[:-1]), relus[-1]

@@ -269,11 +269,15 @@ class BlockPlan:
     by the single-device executor, the shard_map data-parallel runner, and the
     GSPMD hybrid runner — one implementation of prune/analyze/write-back."""
 
-    def __init__(self, program, block, feed_names, fetch_names, scope):
+    def __init__(self, program, block, feed_names, fetch_names, scope,
+                 place=None):
         # every compile path (single-device, shard_map DP, GSPMD hybrid,
         # LocalSGD) builds a BlockPlan first — apply the persistent XLA
         # cache config here so all of them benefit
         _apply_compile_cache()
+        # the Place the trace targets (None for mesh runners) — lowerings
+        # that need host callbacks (py_func) check it to fail loudly on TPU
+        self.place = place
         self.program = program
         self.block = block
         self.feed_names = list(feed_names)
@@ -355,6 +359,7 @@ class BlockPlan:
                                         block=block, mesh_axes=mesh_axes)
             ctx.program = program
             ctx.dtype_policy = dtype_policy
+            ctx.place = self.place
             trace_block(block, env, ctx, ops=ops)
             fetches = [env[n] for n in fetch_names]
             out_writes = {n: env[n] for n in write_names if n in env}
@@ -468,7 +473,8 @@ class _CompiledBlock:
     def __init__(self, program, block, feed_names, fetch_names, place, scope):
         import jax
 
-        plan = BlockPlan(program, block, feed_names, fetch_names, scope)
+        plan = BlockPlan(program, block, feed_names, fetch_names, scope,
+                         place=place)
         self.plan = plan
         self.block = block
         self.feed_names = plan.feed_names

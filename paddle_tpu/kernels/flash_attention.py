@@ -333,30 +333,32 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _default_platform():
-    """The default backend's platform name — WITHOUT initializing any
-    backend when none is up yet.  jax.default_backend() initializes every
-    registered plugin; under abstract tracing (jax.eval_shape during
-    program construction) that would touch the axon TPU tunnel, which can
-    wedge so hard device enumeration hangs for hours.  With no backend
-    initialized the answer is the configured platform list's head —
-    purely string-level, no client creation."""
-    try:  # narrow guard: ONLY the private-API probe may be skipped
-        from jax._src import xla_bridge as xb
+    """Backend platform name without initializing one — shared no-init
+    discipline lives in fluid.platform_utils (the axon tunnel can wedge so
+    hard that backend init hangs; lowerings also run under abstract
+    tracing where no backend should come up)."""
+    from paddle_tpu.fluid.platform_utils import default_platform
 
-        uninitialized = not xb._backends
-    except Exception:  # pragma: no cover - jax internals moved
-        uninitialized = False
-    if uninitialized:
-        platforms = (jax.config.jax_platforms or "").split(",")
-        return platforms[0] if platforms and platforms[0] else None
-    try:
-        return jax.default_backend()
-    except Exception:  # pragma: no cover
-        return None
+    return default_platform()
+
+
+# Platform names that are real TPU hardware: upstream libtpu registers
+# "tpu"; the axon PJRT plugin registers "axon" (same chip through a tunnel).
+# bench.py's device probe uses the same pair.  PT_FLASH_NO_PALLAS=1 is the
+# escape hatch if the plugin lacks Mosaic support.
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def _is_tpu_platform():
+    import os
+
+    if os.environ.get("PT_FLASH_NO_PALLAS"):
+        return False
+    return _default_platform() in _TPU_PLATFORMS
 
 
 def _use_pallas():
-    return _default_platform() == "tpu"
+    return _is_tpu_platform()
 
 
 def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
@@ -392,7 +394,7 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     if mode == "pallas":
         # same no-init discipline as _use_pallas: this line is reached
         # under abstract tracing too (force="pallas" in tests)
-        interpret = _default_platform() != "tpu"
+        interpret = not _is_tpu_platform()
         # pallas path needs S divisible by the block; pad keys with -inf bias
         s_pad = _ceil_to(s, DEFAULT_BLOCK)
         if s_pad != s:

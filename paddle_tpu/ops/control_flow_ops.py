@@ -156,14 +156,14 @@ def _static_rnn(ctx, step_ins, inits, extras, extras_ng, attrs):
 @simple_op("print", ["X"], ["Out"])
 def _print(ctx, x, attrs):
     """Pass-through with host-side printing where supported (reference
-    print_op).  axon TPU has no host callbacks → identity there."""
+    print_op).  axon TPU has no host callbacks → identity there; the
+    platform probe never initializes a backend (platform_utils), so this
+    lowering is safe under abstract tracing even with a wedged tunnel."""
     import jax
 
-    try:
-        backend = jax.default_backend()
-    except Exception:
-        backend = "unknown"
-    if backend == "cpu":
+    from paddle_tpu.fluid.platform_utils import callbacks_ok_for_ctx
+
+    if callbacks_ok_for_ctx(ctx):
         msg = (attrs.get("message") or "print")
         # user text must not be treated as format fields (jax's formatter
         # rejects {{-escapes, so substitute plain parens)

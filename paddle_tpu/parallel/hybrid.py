@@ -155,6 +155,12 @@ class HybridParallelRunner:
         self._cache = {}
         self._step = 0
         self.zero_stage = int(zero_stage)
+        # capture_hlo=True records the OPTIMIZED (post-GSPMD-partitioner)
+        # HLO of the first compiled step in .last_hlo so callers can assert
+        # which collectives XLA inserted (the dryrun/driver check does).
+        # Costs one extra AOT compile of the same tiny computation.
+        self.capture_hlo = False
+        self.last_hlo = None
 
     def _spec(self, *axes):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -248,6 +254,11 @@ class HybridParallelRunner:
         def compiled(scope_, feeds, step):
             don_vals = {n: scope_.get(n) for n in donated}
             ro_vals = {n: scope_.get(n) for n in readonly}
+            if self.capture_hlo and self.last_hlo is None:
+                self.last_hlo = (
+                    jitted.lower(don_vals, ro_vals, dict(feeds),
+                                 np.uint32(step))
+                    .compile().as_text())
             from paddle_tpu.fluid import profiler as _prof
 
             with _prof.timed_run(f"hybrid_block@{id(jitted):x}",

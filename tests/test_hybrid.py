@@ -145,3 +145,28 @@ def test_zero1_optimizer_state_sharding():
     assert spec and spec[0] == "dp", spec
     np.testing.assert_allclose(np.asarray(m1), np.asarray(m0),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_capture_hlo_shows_expected_collectives():
+    """The optimized (post-GSPMD) HLO of a dp×mp step must contain the
+    collectives the sharding implies: all-reduce for dp grad sync, and
+    all-gather or reduce-scatter from the Megatron mp partitioning
+    (reference analog: multi_devices_graph_pass.cc:594 inserting
+    allreduce ops — here XLA's SPMD partitioner does the inserting and we
+    assert on its output)."""
+    main, startup, loss, batches = _build(seed=5)
+    scope = _init_scope(startup)
+    mesh = build_hybrid_mesh(8, dp=2, mp=2, sp=2)
+    assert mesh.shape[pmesh.DATA_AXIS] == 2
+    seq_spec = (pmesh.DATA_AXIS, pmesh.SEQ_AXIS)
+    runner = HybridParallelRunner(
+        main, mesh, rules=megatron_rules(),
+        feed_specs={n: seq_spec for n in
+                    ("src_ids", "pos_ids", "sent_ids", "input_mask")})
+    runner.capture_hlo = True
+    (lv,) = runner.run(scope, batches[0], [loss.name])
+    assert np.isfinite(np.asarray(lv)).all()
+    hlo = runner.last_hlo
+    assert hlo is not None and len(hlo) > 1000
+    assert "all-reduce" in hlo
+    assert "all-gather" in hlo or "reduce-scatter" in hlo

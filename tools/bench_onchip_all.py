@@ -103,8 +103,26 @@ def main():
     except json.JSONDecodeError as e:
         results["longseq"] = {"error": f"unparseable sweep output: {e}"}
     save()
+
+    # curated correctness smoke subset ON the chip (VERDICT r2 item 2) —
+    # the same tests the CPU-mesh suite runs continuously
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             os.path.join(ROOT, "tests", "test_onchip_smoke.py"),
+             "-m", "onchip", "-q", "--no-header"],
+            env=dict(os.environ, PADDLE_TPU_TEST_REAL="1"),
+            capture_output=True, text=True, timeout=budget * 2, cwd=ROOT)
+        tail = (out.stdout.strip().splitlines() or ["?"])[-1]
+        results["onchip_smoke"] = {"rc": out.returncode, "tail": tail}
+        with open(os.path.join(ROOT, "ONCHIP_SMOKE.log"), "w") as f:
+            f.write(out.stdout[-8000:] + "\n" + out.stderr[-4000:])
+    except subprocess.TimeoutExpired:
+        results["onchip_smoke"] = {"error": "smoke tests timed out"}
+    save()
     print(json.dumps({"written": OUT,
-                      "bf16_speedup": results.get("bf16_speedup")}))
+                      "bf16_speedup": results.get("bf16_speedup"),
+                      "onchip_smoke": results.get("onchip_smoke")}))
     return 0
 
 

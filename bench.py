@@ -51,9 +51,10 @@ def _peak_tflops():
     try:
         import jax
 
+        from paddle_tpu.fluid.platform_utils import TPU_PLATFORMS
+
         dev = jax.devices()[0]
-        # the axon PJRT plugin registers its TPU as platform "axon"
-        if dev.platform not in ("tpu", "axon"):
+        if dev.platform not in TPU_PLATFORMS:
             return None
         kind = dev.device_kind.lower()
         for pat, peak in _TPU_PEAK_TFLOPS:

@@ -13,6 +13,12 @@ from __future__ import annotations
 
 import jax
 
+# Platform names that are real TPU hardware: upstream libtpu registers
+# "tpu"; the axon PJRT plugin registers "axon" (same chip via a tunnel).
+# Single source of truth — bench.py's device probe and the Pallas kernel
+# gate both import this.
+TPU_PLATFORMS = ("tpu", "axon")
+
 
 def default_platform():
     """The default backend's platform name without initializing one.

@@ -342,19 +342,17 @@ def _default_platform():
     return default_platform()
 
 
-# Platform names that are real TPU hardware: upstream libtpu registers
-# "tpu"; the axon PJRT plugin registers "axon" (same chip through a tunnel).
-# bench.py's device probe uses the same pair.  PT_FLASH_NO_PALLAS=1 is the
-# escape hatch if the plugin lacks Mosaic support.
-_TPU_PLATFORMS = ("tpu", "axon")
-
-
 def _is_tpu_platform():
+    """Real TPU hardware (where the Mosaic/Pallas kernel path engages).
+    PT_FLASH_NO_PALLAS=1 is the escape hatch if the PJRT plugin lacks
+    Mosaic support; '', '0' and unset mean 'use Pallas'."""
     import os
 
-    if os.environ.get("PT_FLASH_NO_PALLAS"):
+    from paddle_tpu.fluid.platform_utils import TPU_PLATFORMS
+
+    if os.environ.get("PT_FLASH_NO_PALLAS", "") not in ("", "0"):
         return False
-    return _default_platform() in _TPU_PLATFORMS
+    return _default_platform() in TPU_PLATFORMS
 
 
 def _use_pallas():

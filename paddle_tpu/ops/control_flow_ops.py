@@ -52,6 +52,11 @@ def _trace_sub(ctx, sub_block, env):
                         executor=ctx.executor, block=sub_block,
                         mesh_axes=ctx.mesh_axes, env=env)
     sub_ctx.program = sub_block.program
+    # optional trace-wide state must survive into sub-blocks: the target
+    # place (py_func/print callback gating) and the dtype policy
+    for attr in ("place", "dtype_policy"):
+        if hasattr(ctx, attr):
+            setattr(sub_ctx, attr, getattr(ctx, attr))
     trace_block(sub_block, env, sub_ctx)
     return env
 

@@ -61,6 +61,7 @@ def test_print_op_is_identity_on_tpu_place():
 def test_platform_probe_initializes_no_backend():
     """default_platform() must answer from config strings when no backend is
     up — backend init through a wedged axon tunnel hangs for hours."""
+    import os
     import subprocess
     import sys
 
@@ -75,7 +76,9 @@ def test_platform_probe_initializes_no_backend():
         "assert not xb._backends, 'probe must not initialize a backend'\n"
         "print('NOINIT-OK')\n"
     )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                         text=True, timeout=120)
+                         text=True, timeout=120,
+                         env=dict(os.environ, PYTHONPATH=repo))
     assert out.returncode == 0, out.stderr[-2000:]
     assert "NOINIT-OK" in out.stdout

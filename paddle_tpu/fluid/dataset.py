@@ -90,7 +90,8 @@ class QueueDataset(DatasetBase):
         if not self._use_vars:
             raise ValueError("set_use_var before training")
         feed = native.MultiSlotFeed(self._filelist, self._slots(),
-                                    self._batch_size, self._queue_capacity)
+                                    self._batch_size, self._queue_capacity,
+                                    n_threads=self._thread)
         try:
             for batch in feed:
                 yield self._postprocess(batch)
@@ -120,7 +121,8 @@ class InMemoryDataset(QueueDataset):
         # queue capacity is denominated in batches: with 4096-row batches a
         # couple of slots bound the prefetch buffer, not capacity×4096 rows
         feed = native.MultiSlotFeed(self._filelist, self._slots(), 4096,
-                                    min(self._queue_capacity, 2))
+                                    min(self._queue_capacity, 2),
+                                    n_threads=self._thread)
         self._memory = []
         names = [n for n, _ in self._slots()]
         try:

@@ -565,6 +565,8 @@ class Executor:
         self._cache.clear()
 
     def _coerce_feed(self, program, feed):
+        import jax
+
         out = {}
         for name, val in (feed or {}).items():
             var = None
@@ -572,6 +574,14 @@ class Executor:
                 var = b._find_var_recursive(name)
                 if var is not None:
                     break
+            if isinstance(val, jax.Array):
+                # already device-resident (dataset prefetcher device_puts
+                # ahead) — keep it there; cast on-device only if needed
+                if (var is not None and var.dtype is not None
+                        and str(val.dtype) != var.dtype):
+                    val = val.astype(var.dtype)
+                out[name] = val
+                continue
             a = np.asarray(val)
             if var is not None and var.dtype is not None:
                 target = var.dtype
@@ -610,8 +620,12 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else f for f in fetch_list]
 
         block = program.global_block()
+        # v.dtype directly: np.asarray on a device-resident jax array would
+        # force a host transfer just to read the dtype
         feed_sig = tuple(
-            (k, tuple(np.shape(v)), str(np.asarray(v).dtype)) for k, v in sorted(feed.items())
+            (k, tuple(np.shape(v)),
+             str(v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype))
+            for k, v in sorted(feed.items())
         )
         key = (id(program), program._version, feed_sig, tuple(fetch_names), self.place)
         cb = self._cache.get(key)
@@ -643,14 +657,72 @@ class Executor:
         self, program=None, dataset=None, scope=None, thread=0,
         debug=False, fetch_list=None, fetch_info=None, print_period=100,
     ):
+        """Step over a Dataset with ingestion OVERLAPPED with device steps
+        (reference multi_trainer.cc + buffered_reader.cc double-buffering):
+        a reader thread drains the native parser queue, coerces dtypes and
+        device_puts each batch ahead, buffering 2 batches (override the
+        depth with PT_DATASET_PREFETCH; 0 disables — synchronous loop).
+        `thread` keeps its reference meaning (worker parallelism) and maps
+        to parser threads via dataset.set_thread, NOT to buffer depth —
+        each buffered batch is device-resident, so depth costs HBM.
+        Input-bound time is recorded in the profiler ("dataset_wait") and
+        summarized in `self.last_dataset_stats["input_bound_fraction"]`."""
+        import os
+        import time as _time
+
+        import jax
+
+        from . import compiler as _compiler
+        from . import profiler as _prof
+        from .prefetch import DatasetPrefetcher
+
         if dataset is None:
             raise ValueError("dataset is required")
         fetch_list = fetch_list or []
-        for i, batch in enumerate(dataset._iter_batches()):
-            res = self.run(program=program, feed=batch, fetch_list=fetch_list, scope=scope)
-            if debug and fetch_list and i % print_period == 0:
-                names = fetch_info or [f.name for f in fetch_list]
-                logger.info("step %d: %s", i, dict(zip(names, res)))
+        program = program if program is not None else framework.default_main_program()
+        depth = int(os.environ.get("PT_DATASET_PREFETCH", "2"))
+        t_start = _time.perf_counter()
+
+        if depth <= 0:
+            it, pf = dataset._iter_batches(), None
+        elif isinstance(program, _compiler.CompiledProgram):
+            # data-parallel programs shard feeds across devices in their own
+            # run path — overlap the parsing only, hand over host batches
+            it = pf = DatasetPrefetcher(dataset._iter_batches(), depth=depth)
+        else:
+            device = self.place.jax_device()
+
+            def transform(batch):
+                coerced = self._coerce_feed(program, batch)
+                return {k: jax.device_put(v, device)
+                        for k, v in coerced.items()}
+
+            it = pf = DatasetPrefetcher(dataset._iter_batches(),
+                                        transform=transform, depth=depth)
+        steps = 0
+        try:
+            for i, batch in enumerate(it):
+                res = self.run(program=program, feed=batch,
+                               fetch_list=fetch_list, scope=scope)
+                steps += 1
+                if debug and fetch_list and i % print_period == 0:
+                    names = fetch_info or [f.name for f in fetch_list]
+                    logger.info("step %d: %s", i, dict(zip(names, res)))
+        finally:
+            if pf is not None:
+                pf.close()
+                total = _time.perf_counter() - t_start
+                self.last_dataset_stats = {
+                    "steps": steps,
+                    "prefetch_depth": depth,
+                    "input_wait_s": round(pf.wait_seconds, 4),
+                    "produce_s": round(pf.produce_seconds, 4),
+                    "total_s": round(total, 4),
+                    "input_bound_fraction": round(
+                        pf.wait_seconds / total, 4) if total > 0 else 0.0,
+                }
+                _prof._record("dataset_wait", "train_from_dataset",
+                              pf.wait_seconds)
 
     def infer_from_dataset(self, *args, **kw):
         return self.train_from_dataset(*args, **kw)

@@ -98,7 +98,8 @@ def lib():
         L.ptq_queue_free.argtypes = [ctypes.c_void_p]
         L.ptq_feed_new.restype = ctypes.c_void_p
         L.ptq_feed_new.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
-                                   ctypes.c_char_p, ctypes.c_int, ctypes.c_int64]
+                                   ctypes.c_char_p, ctypes.c_int, ctypes.c_int64,
+                                   ctypes.c_int]
         L.ptq_feed_next.restype = ctypes.c_int64
         L.ptq_feed_next.argtypes = [ctypes.c_void_p,
                                     ctypes.POINTER(ctypes.c_void_p)]
@@ -327,12 +328,13 @@ class MultiSlotFeed:
     whose samples all have length 1 are squeezed to [B, 1].
     """
 
-    def __init__(self, files, slots, batch_size, queue_capacity=32):
+    def __init__(self, files, slots, batch_size, queue_capacity=32,
+                 n_threads=1):
         self.slot_names = [n for n, _ in slots]
         desc = ";".join(f"{n}:{t}" for n, t in slots).encode()
         arr = (ctypes.c_char_p * len(files))(*[f.encode() for f in files])
         self._h = lib().ptq_feed_new(arr, len(files), desc, batch_size,
-                                     queue_capacity)
+                                     queue_capacity, n_threads)
         if not self._h:
             raise ValueError("bad slot description or empty slot list")
 

@@ -339,7 +339,13 @@ struct MultiSlotFeed {
   std::vector<SlotDesc> slots;
   int batch_size;
   BlockingQueue queue;
-  std::thread worker;
+  // N parser workers claim files from `next_file` (reference
+  // framework/data_set.cc splits the filelist across thread_num DataFeeds;
+  // same file-level parallelism, one shared output queue).  The LAST
+  // worker to finish closes the queue.
+  std::vector<std::thread> workers;
+  std::atomic<int> next_file{0};
+  std::atomic<int> active_workers{0};
   std::atomic<bool> stop{false};
   std::string error;
   std::mutex err_mu;
@@ -347,8 +353,11 @@ struct MultiSlotFeed {
   MultiSlotFeed(size_t cap) : queue(cap) {}
 
   void set_error(const std::string& e) {
-    std::lock_guard<std::mutex> lk(err_mu);
-    if (error.empty()) error = e;
+    {
+      std::lock_guard<std::mutex> lk(err_mu);
+      if (error.empty()) error = e;
+    }
+    stop.store(true);  // all workers wind down; no point parsing further
   }
 
   static bool parse_line(const char* line, const std::vector<SlotDesc>& slots,
@@ -410,12 +419,17 @@ struct MultiSlotFeed {
   }
 
   void run() {
+    // one parser worker: claims whole files until none remain, carries its
+    // partial batch across the files IT parsed (thread-local accumulator,
+    // like the reference's per-thread DataFeed)
     std::vector<SlotBatch> batch(slots.size());
     int in_batch = 0;
     char* line = nullptr;     // getline-managed growable buffer: no 64 KiB
     size_t line_cap = 0;      // truncation of long ragged-slot lines
-    for (const auto& path : files) {
-      if (stop.load()) break;
+    for (;;) {
+      int fi = next_file.fetch_add(1);
+      if (fi >= static_cast<int>(files.size()) || stop.load()) break;
+      const std::string& path = files[fi];
       FILE* f = fopen(path.c_str(), "r");
       if (!f) {
         set_error("cannot open " + path);
@@ -454,12 +468,12 @@ struct MultiSlotFeed {
       }
     }
     free(line);
-    ptq_queue_close(&queue);
+    if (active_workers.fetch_sub(1) == 1) ptq_queue_close(&queue);
   }
 };
 
 void* ptq_feed_new(const char** files, int nfiles, const char* slots_desc,
-                   int batch_size, int64_t queue_cap) {
+                   int batch_size, int64_t queue_cap, int n_threads) {
   auto* feed = new MultiSlotFeed(static_cast<size_t>(queue_cap));
   for (int i = 0; i < nfiles; ++i) feed->files.emplace_back(files[i]);
   std::string desc(slots_desc);
@@ -482,7 +496,11 @@ void* ptq_feed_new(const char** files, int nfiles, const char* slots_desc,
     return nullptr;
   }
   feed->batch_size = batch_size;
-  feed->worker = std::thread([feed] { feed->run(); });
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > nfiles && nfiles > 0) n_threads = nfiles;
+  feed->active_workers.store(n_threads);
+  for (int i = 0; i < n_threads; ++i)
+    feed->workers.emplace_back([feed] { feed->run(); });
   return feed;
 }
 
@@ -508,7 +526,8 @@ void ptq_feed_free(void* handle) {
   auto* feed = static_cast<MultiSlotFeed*>(handle);
   feed->stop.store(true);
   ptq_queue_close(&feed->queue);
-  if (feed->worker.joinable()) feed->worker.join();
+  for (auto& w : feed->workers)
+    if (w.joinable()) w.join();
   delete feed;
 }
 

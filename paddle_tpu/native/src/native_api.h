@@ -49,8 +49,10 @@ void ptq_queue_close(void* handle);
 void ptq_queue_free(void* handle);
 
 // --- MultiSlot feed --------------------------------------------------- //
+// n_threads parser workers claim files from a shared index (file-level
+// parallelism, one shared output queue); clamped to [1, nfiles]
 void* ptq_feed_new(const char** files, int nfiles, const char* slots_desc,
-                   int batch_size, int64_t queue_capacity);
+                   int batch_size, int64_t queue_capacity, int n_threads);
 int64_t ptq_feed_next(void* handle, char** out);
 int64_t ptq_feed_error(void* handle, char** out);
 void ptq_feed_free(void* handle);

@@ -288,3 +288,45 @@ def test_queue_free_with_blocked_consumer():
     t.join(timeout=5)
     assert not t.is_alive()
     assert got == ["closed"]
+
+
+def test_multislot_feed_multithreaded(tmp_path):
+    """4 parser threads over 4 files: every row arrives exactly once
+    (file-level parallelism, shared queue — reference data_set.cc splits
+    the filelist across thread_num DataFeeds)."""
+    paths = []
+    want = set()
+    for fi in range(4):
+        p = str(tmp_path / f"part-{fi}.txt")
+        with open(p, "w") as f:
+            for r in range(40):
+                val = fi * 1000 + r
+                f.write(f"1 {val} 1 0\n")
+                want.add(val)
+        paths.append(p)
+    feed = native.MultiSlotFeed(paths, [("v", "u"), ("z", "u")],
+                                batch_size=16, n_threads=4)
+    got = []
+    for b in feed:
+        got.extend(int(v) for v in b["v"].ravel())
+    feed.close()
+    assert len(got) == 160
+    assert set(got) == want
+
+
+def test_multislot_feed_multithreaded_error_stops(tmp_path):
+    """A parse error in one file stops the whole multi-threaded feed with
+    IOError (no silent half-epoch)."""
+    p1 = str(tmp_path / "good.txt")
+    p2 = str(tmp_path / "bad.txt")
+    with open(p1, "w") as f:
+        for r in range(2000):
+            f.write(f"1 {r} 1 0\n")
+    with open(p2, "w") as f:
+        f.write("garbage line here\n")
+    feed = native.MultiSlotFeed([p1, p2], [("v", "u"), ("z", "u")],
+                                batch_size=8, n_threads=2)
+    with pytest.raises(IOError, match="parse error"):
+        for _ in feed:
+            pass
+    feed.close()

@@ -90,6 +90,23 @@ def main():
             results["bf16_policy"]["value"]
             / results["fp32_headline"]["value"], 3)
 
+    # dataset ingestion/compute overlap — the wall-clock win only shows
+    # when steps run on-chip (host cores free for parse+transfer)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools",
+                                          "bench_dataset_overlap.py")],
+            env=dict(os.environ, PT_OVERLAP_TPU="1"),
+            capture_output=True, text=True, timeout=budget)
+        lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+        results["dataset_overlap"] = (json.loads(lines[-1]) if lines
+                                      else {"error": out.stderr[-400:]})
+    except subprocess.TimeoutExpired:
+        results["dataset_overlap"] = {"error": "overlap bench timeout"}
+    except json.JSONDecodeError as e:
+        results["dataset_overlap"] = {"error": f"unparseable: {e}"}
+    save()
+
     # long-seq flash sweep + GPT decode (writes its own sidecar too)
     try:
         out = subprocess.run(

@@ -23,11 +23,12 @@ import threading
 import numpy as np
 
 __all__ = ["lib", "RecordIOWriter", "RecordIOScanner", "BlockingQueue",
-           "MultiSlotFeed", "is_available"]
+           "MultiSlotFeed", "NativePredictor", "is_available"]
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _SRCS = [os.path.join(_SRC_DIR, "data_runtime.cc"),
-         os.path.join(_SRC_DIR, "ps_runtime.cc")]
+         os.path.join(_SRC_DIR, "ps_runtime.cc"),
+         os.path.join(_SRC_DIR, "infer_runtime.cc")]
 # base compile flags shared with the C++ unit-test build (tests/test_native_cc.py)
 CXX_BASE_FLAGS = ["-O2", "-std=c++17", "-pthread"]
 _lib = None
@@ -107,6 +108,34 @@ def lib():
         L.ptq_feed_error.argtypes = [ctypes.c_void_p,
                                      ctypes.POINTER(ctypes.c_void_p)]
         L.ptq_feed_free.argtypes = [ctypes.c_void_p]
+        # --- native inference runtime (infer_runtime.cc) ---
+        L.pti_create.restype = ctypes.c_void_p
+        L.pti_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        L.pti_error.restype = ctypes.c_char_p
+        L.pti_error.argtypes = [ctypes.c_void_p]
+        L.pti_num_inputs.restype = ctypes.c_int
+        L.pti_num_inputs.argtypes = [ctypes.c_void_p]
+        L.pti_input_name.restype = ctypes.c_char_p
+        L.pti_input_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.pti_num_outputs.restype = ctypes.c_int
+        L.pti_num_outputs.argtypes = [ctypes.c_void_p]
+        L.pti_output_name.restype = ctypes.c_char_p
+        L.pti_output_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.pti_set_input.restype = ctypes.c_int
+        L.pti_set_input.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.c_int, ctypes.c_int]
+        L.pti_run.restype = ctypes.c_int
+        L.pti_run.argtypes = [ctypes.c_void_p]
+        L.pti_get_output.restype = ctypes.c_int64
+        L.pti_get_output.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_void_p),
+                                     ctypes.POINTER(ctypes.POINTER(
+                                         ctypes.c_int64)),
+                                     ctypes.POINTER(ctypes.c_int),
+                                     ctypes.POINTER(ctypes.c_int)]
+        L.pti_free.argtypes = [ctypes.c_void_p]
         # --- parameter-server transport (ps_runtime.cc) ---
         L.pts_server_start.restype = ctypes.c_void_p
         L.pts_server_start.argtypes = [ctypes.c_int, ctypes.c_int]
@@ -594,6 +623,93 @@ class PSClient:
     def close(self):
         if self._h:
             lib().pts_client_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativePredictor:
+    """C++-runtime predictor over a reference-format saved model
+    (reference inference/api/paddle_inference_api.h CreatePaddlePredictor;
+    this wrapper mirrors api/demo_ci usage from Python for tests — C/C++
+    callers use the pti_* ABI in native_api.h directly).
+
+    model_dir must hold `__model__` (protobuf ProgramDesc, e.g. from
+    fluid.io.save_inference_model(model_format="protobuf")) and params as
+    per-var LoDTensor files or one combined file (params_file=...).
+    """
+
+    def __init__(self, model_dir, params_file=None):
+        self._h = lib().pti_create(
+            str(model_dir).encode(),
+            params_file.encode() if params_file else None)
+        if not self._h:
+            raise RuntimeError("pti_create failed")
+        err = lib().pti_error(self._h)
+        if err:
+            msg = err.decode()
+            lib().pti_free(self._h)
+            self._h = None
+            raise RuntimeError(f"NativePredictor: {msg}")
+
+    @property
+    def input_names(self):
+        return [lib().pti_input_name(self._h, i).decode()
+                for i in range(lib().pti_num_inputs(self._h))]
+
+    @property
+    def output_names(self):
+        return [lib().pti_output_name(self._h, i).decode()
+                for i in range(lib().pti_num_outputs(self._h))]
+
+    def run(self, feed):
+        """feed: {name: np.ndarray (float32 or int64)} → list of outputs in
+        fetch order."""
+        L = lib()
+        for name, arr in feed.items():
+            arr = np.ascontiguousarray(arr)
+            if arr.dtype == np.float32:
+                dtype = 0
+            elif arr.dtype == np.int64:
+                dtype = 1
+            else:
+                raise TypeError(f"feed {name!r}: dtype {arr.dtype} "
+                                "unsupported (float32/int64 only)")
+            dims = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+            L.pti_set_input(self._h, name.encode(),
+                            arr.ctypes.data_as(ctypes.c_void_p), dims,
+                            arr.ndim, dtype)
+        if L.pti_run(self._h) != 0:
+            raise RuntimeError(
+                f"native inference failed: {L.pti_error(self._h).decode()}")
+        outs = []
+        for name in self.output_names:
+            data = ctypes.c_void_p()
+            dims = ctypes.POINTER(ctypes.c_int64)()
+            ndims = ctypes.c_int()
+            dtype = ctypes.c_int()
+            n = L.pti_get_output(self._h, name.encode(), ctypes.byref(data),
+                                 ctypes.byref(dims), ctypes.byref(ndims),
+                                 ctypes.byref(dtype))
+            if n < 0:
+                raise RuntimeError(
+                    f"output {name!r}: {L.pti_error(self._h).decode()}")
+            shape = tuple(dims[i] for i in range(ndims.value))
+            ct = ctypes.c_float if dtype.value == 0 else ctypes.c_int64
+            buf = ctypes.cast(data, ctypes.POINTER(ct))
+            np_dtype = "float32" if dtype.value == 0 else "int64"
+            # astype already copies out of the runtime-owned buffer
+            arr = np.ctypeslib.as_array(buf, shape=(int(n),)).astype(np_dtype)
+            outs.append(arr.reshape(shape))
+        return outs
+
+    def close(self):
+        if self._h:
+            lib().pti_free(self._h)
             self._h = None
 
     def __del__(self):

@@ -1,0 +1,89 @@
+// demo_ci: standalone C++ inference demo over the native runtime.
+//
+// Reference analog: inference/api/demo_ci/simple_on_word2vec.cc — the
+// reference's shipped example of serving a saved model from C++ with no
+// Python.  Usage:
+//   demo_ci <model_dir> [params_file]
+// Feeds deterministic inputs (0.01*i) to every model input, runs, and
+// prints each output as "out <name> <numel> v0 v1 ... v7" for the test
+// harness to compare against the Python executor.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "native_api.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_dir> [params_file]\n", argv[0]);
+    return 2;
+  }
+  void* p = pti_create(argv[1], argc > 2 ? argv[2] : nullptr);
+  if (pti_error(p)[0]) {
+    fprintf(stderr, "create failed: %s\n", pti_error(p));
+    pti_free(p);
+    return 1;
+  }
+  // deterministic demo batch: every float input gets batch=2 rows of
+  // 0.01*i; shapes come from the harness via PTI_DEMO_DIMS ("name:2x16;...")
+  const char* dims_env = getenv("PTI_DEMO_DIMS");
+  if (!dims_env) {
+    fprintf(stderr, "set PTI_DEMO_DIMS=name:2x16;...\n");
+    pti_free(p);
+    return 2;
+  }
+  std::string spec(dims_env);
+  size_t pos = 0;
+  while (pos < spec.size()) {
+    size_t semi = spec.find(';', pos);
+    if (semi == std::string::npos) semi = spec.size();
+    std::string item = spec.substr(pos, semi - pos);
+    pos = semi + 1;
+    size_t colon = item.find(':');
+    std::string name = item.substr(0, colon);
+    std::vector<int64_t> dims;
+    int64_t n = 1;
+    for (size_t i = colon + 1; i < item.size();) {
+      size_t x = item.find('x', i);
+      if (x == std::string::npos) x = item.size();
+      dims.push_back(atoll(item.substr(i, x - i).c_str()));
+      n *= dims.back();
+      i = x + 1;
+    }
+    std::vector<float> data(n);
+    for (int64_t i = 0; i < n; ++i) data[i] = 0.01f * static_cast<float>(i);
+    pti_set_input(p, name.c_str(), data.data(), dims.data(),
+                  static_cast<int>(dims.size()), 0);
+  }
+  if (pti_run(p) != 0) {
+    fprintf(stderr, "run failed: %s\n", pti_error(p));
+    pti_free(p);
+    return 1;
+  }
+  for (int i = 0; i < pti_num_outputs(p); ++i) {
+    const char* name = pti_output_name(p, i);
+    const void* data;
+    const int64_t* dims;
+    int ndims, dtype;
+    int64_t n = pti_get_output(p, name, &data, &dims, &ndims, &dtype);
+    if (n < 0) {
+      fprintf(stderr, "get_output failed: %s\n", pti_error(p));
+      pti_free(p);
+      return 1;
+    }
+    printf("out %s %lld", name, static_cast<long long>(n));
+    const float* f = static_cast<const float*>(data);
+    for (int64_t j = 0; j < n && j < 8; ++j)
+      printf(" %.6f", dtype == 0 ? f[j]
+                                 : static_cast<float>(
+                                       static_cast<const int64_t*>(data)[j]));
+    printf("\n");
+  }
+  pti_free(p);
+  printf("DEMO_CI_OK\n");
+  return 0;
+}

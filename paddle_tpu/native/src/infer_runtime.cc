@@ -1,0 +1,1107 @@
+// Native inference runtime: load a reference-format saved model
+// (__model__ ProgramDesc protobuf + LoDTensor param streams) and run it on
+// CPU with no Python/JAX dependency.
+//
+// Reference analog: paddle/fluid/inference/api/paddle_inference_api.h
+// (CreatePaddlePredictor<AnalysisConfig>, PaddleTensor, ZeroCopyTensor) and
+// api/demo_ci — the flagship C++ deployment path.  TPU-native redesign: the
+// *accelerated* serving path is AnalysisPredictor over XLA (Python,
+// paddle_tpu/inference.py); THIS runtime is the dependency-free edge/CI
+// deployment analog of demo_ci — a minimal interpreter over the same
+// protobuf format with a practical inference kernel set (fc/conv/bn/pool/
+// softmax/embedding and friends), fp32 + int64.
+//
+// Wire formats implemented from scratch (same as fluid/proto_compat.py):
+//   proto2: framework.proto ProgramDesc (BlockDesc=1{idx,parent,vars=3,
+//           ops=4}, OpDesc{inputs=1,outputs=2,type=3,attrs=4},
+//           VarDesc{name=1,type=2,persistable=3})
+//   LoDTensor stream: u32 version | u64 lod_level {u64 nbytes, data}* |
+//           u32 tensor version | i32 desc_size | TensorDesc proto
+//           {data_type=1, dims=2} | raw data
+
+#include <algorithm>
+#include <cmath>
+#include <exception>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace pti {
+
+// ---------------------------------------------------------------------------
+// proto2 wire reader
+// ---------------------------------------------------------------------------
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+      if (shift > 63) break;
+    }
+    ok = false;
+    return 0;
+  }
+
+  // returns field number, sets wire type; 0 at end
+  uint32_t tag(uint32_t* wt) {
+    if (p >= end) return 0;
+    uint64_t t = varint();
+    if (!ok) return 0;
+    *wt = static_cast<uint32_t>(t & 7);
+    return static_cast<uint32_t>(t >> 3);
+  }
+
+  Cursor sub() {  // length-delimited
+    uint64_t n = varint();
+    if (!ok || p + n > end) {
+      ok = false;
+      return {end, end};
+    }
+    Cursor c{p, p + n};
+    p += n;
+    return c;
+  }
+
+  std::string str() {
+    Cursor c = sub();
+    return std::string(reinterpret_cast<const char*>(c.p), c.end - c.p);
+  }
+
+  void skip(uint32_t wt) {
+    switch (wt) {
+      case 0: varint(); break;
+      case 1: p += 8; break;
+      case 2: sub(); break;
+      case 5: p += 4; break;
+      default: ok = false;
+    }
+    if (p > end) ok = false;
+  }
+
+  float f32() {
+    if (p + 4 > end) { ok = false; return 0; }
+    float v;
+    memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// model structs
+// ---------------------------------------------------------------------------
+
+struct Attr {
+  int type = -1;  // AttrType
+  int64_t i = 0;
+  float f = 0;
+  std::string s;
+  std::vector<int64_t> ints;
+  std::vector<float> floats;
+  std::vector<std::string> strings;
+  bool b = false;
+};
+
+struct Op {
+  std::string type;
+  std::map<std::string, std::vector<std::string>> inputs, outputs;
+  std::map<std::string, Attr> attrs;
+
+  const std::string& in(const std::string& slot, size_t i = 0) const {
+    static const std::string empty;
+    auto it = inputs.find(slot);
+    if (it == inputs.end() || i >= it->second.size()) return empty;
+    return it->second[i];
+  }
+  const std::string& out(const std::string& slot, size_t i = 0) const {
+    static const std::string empty;
+    auto it = outputs.find(slot);
+    if (it == outputs.end() || i >= it->second.size()) return empty;
+    return it->second[i];
+  }
+  bool has_in(const std::string& slot) const {
+    auto it = inputs.find(slot);
+    return it != inputs.end() && !it->second.empty() &&
+           !it->second[0].empty();
+  }
+  int64_t attr_i(const std::string& n, int64_t dflt = 0) const {
+    auto it = attrs.find(n);
+    return it == attrs.end() ? dflt : it->second.i;
+  }
+  float attr_f(const std::string& n, float dflt = 0) const {
+    auto it = attrs.find(n);
+    return it == attrs.end() ? dflt : it->second.f;
+  }
+  bool attr_b(const std::string& n, bool dflt = false) const {
+    auto it = attrs.find(n);
+    return it == attrs.end() ? dflt : it->second.b;
+  }
+  std::string attr_s(const std::string& n, const std::string& dflt = "") const {
+    auto it = attrs.find(n);
+    return it == attrs.end() ? dflt : it->second.s;
+  }
+  std::vector<int64_t> attr_ints(const std::string& n) const {
+    auto it = attrs.find(n);
+    return it == attrs.end() ? std::vector<int64_t>{} : it->second.ints;
+  }
+};
+
+struct VarInfo {
+  std::string name;
+  std::vector<int64_t> dims;
+  int dtype = 5;  // VarType.Type: FP32
+  int kind = 7;   // VarType.Type of the VAR itself: LOD_TENSOR
+  bool persistable = false;
+};
+
+struct Block {
+  std::vector<VarInfo> vars;
+  std::vector<Op> ops;
+};
+
+struct Tensor {
+  std::vector<int64_t> dims;
+  std::vector<float> f;    // FP32 payload
+  std::vector<int64_t> i;  // INT64 payload
+  bool is_f = true;
+
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// ProgramDesc parsing
+// ---------------------------------------------------------------------------
+
+static Attr parse_attr(Cursor c, std::string* name) {
+  Attr a;
+  uint32_t wt;
+  while (uint32_t f = c.tag(&wt)) {
+    switch (f) {
+      case 1: *name = c.str(); break;
+      case 2: a.type = static_cast<int>(c.varint()); break;
+      case 3: a.i = static_cast<int32_t>(c.varint()); break;
+      case 4: a.f = c.f32(); break;
+      case 5: a.s = c.str(); break;
+      case 6:  // repeated int32 (maybe packed)
+        if (wt == 2) {
+          Cursor s = c.sub();
+          while (s.p < s.end) a.ints.push_back(static_cast<int32_t>(s.varint()));
+        } else {
+          a.ints.push_back(static_cast<int32_t>(c.varint()));
+        }
+        break;
+      case 7:
+        if (wt == 2) {
+          Cursor s = c.sub();
+          while (s.p < s.end) a.floats.push_back(s.f32());
+        } else {
+          a.floats.push_back(c.f32());
+        }
+        break;
+      case 8: a.strings.push_back(c.str()); break;
+      case 10: a.b = c.varint() != 0; break;
+      case 13: a.i = static_cast<int64_t>(c.varint()); break;
+      case 15:
+        if (wt == 2) {
+          Cursor s = c.sub();
+          while (s.p < s.end) a.ints.push_back(static_cast<int64_t>(s.varint()));
+        } else {
+          a.ints.push_back(static_cast<int64_t>(c.varint()));
+        }
+        break;
+      default: c.skip(wt);
+    }
+    if (!c.ok) break;
+  }
+  return a;
+}
+
+static void parse_slot(Cursor c, std::map<std::string,
+                                          std::vector<std::string>>* out) {
+  std::string param;
+  std::vector<std::string> args;
+  uint32_t wt;
+  while (uint32_t f = c.tag(&wt)) {
+    if (f == 1) param = c.str();
+    else if (f == 2) args.push_back(c.str());
+    else c.skip(wt);
+    if (!c.ok) break;
+  }
+  (*out)[param] = std::move(args);
+}
+
+static Op parse_op(Cursor c) {
+  Op op;
+  uint32_t wt;
+  while (uint32_t f = c.tag(&wt)) {
+    switch (f) {
+      case 1: parse_slot(c.sub(), &op.inputs); break;
+      case 2: parse_slot(c.sub(), &op.outputs); break;
+      case 3: op.type = c.str(); break;
+      case 4: {
+        std::string name;
+        Attr a = parse_attr(c.sub(), &name);
+        op.attrs[name] = std::move(a);
+        break;
+      }
+      default: c.skip(wt);
+    }
+    if (!c.ok) break;
+  }
+  return op;
+}
+
+// VarType.TensorDesc {data_type=1, dims=2}
+static void parse_tensor_desc(Cursor c, VarInfo* v) {
+  uint32_t wt;
+  while (uint32_t f = c.tag(&wt)) {
+    if (f == 1) v->dtype = static_cast<int>(c.varint());
+    else if (f == 2) {
+      if (wt == 2) {
+        Cursor s = c.sub();
+        while (s.p < s.end)
+          v->dims.push_back(static_cast<int64_t>(s.varint()));
+      } else {
+        v->dims.push_back(static_cast<int64_t>(c.varint()));
+      }
+    } else c.skip(wt);
+    if (!c.ok) break;
+  }
+}
+
+// VarType {type=1, lod_tensor=3{tensor=1}}
+static void parse_var_type(Cursor c, VarInfo* v) {
+  uint32_t wt;
+  while (uint32_t f = c.tag(&wt)) {
+    if (f == 1) v->kind = static_cast<int>(c.varint());
+    else if (f == 3) {  // LoDTensorDesc
+      Cursor lt = c.sub();
+      uint32_t wt2;
+      while (uint32_t f2 = lt.tag(&wt2)) {
+        if (f2 == 1) parse_tensor_desc(lt.sub(), v);
+        else lt.skip(wt2);
+        if (!lt.ok) break;
+      }
+    } else c.skip(wt);
+    if (!c.ok) break;
+  }
+}
+
+static VarInfo parse_var(Cursor c) {
+  VarInfo v;
+  uint32_t wt;
+  while (uint32_t f = c.tag(&wt)) {
+    if (f == 1) v.name = c.str();
+    else if (f == 2) parse_var_type(c.sub(), &v);
+    else if (f == 3) v.persistable = c.varint() != 0;
+    else c.skip(wt);
+    if (!c.ok) break;
+  }
+  return v;
+}
+
+static Block parse_block(Cursor c) {
+  Block b;
+  uint32_t wt;
+  while (uint32_t f = c.tag(&wt)) {
+    if (f == 3) b.vars.push_back(parse_var(c.sub()));
+    else if (f == 4) b.ops.push_back(parse_op(c.sub()));
+    else c.skip(wt);
+    if (!c.ok) break;
+  }
+  return b;
+}
+
+static bool parse_program(const std::string& blob, std::vector<Block>* blocks) {
+  Cursor c{reinterpret_cast<const uint8_t*>(blob.data()),
+           reinterpret_cast<const uint8_t*>(blob.data()) + blob.size()};
+  uint32_t wt;
+  while (uint32_t f = c.tag(&wt)) {
+    if (f == 1) blocks->push_back(parse_block(c.sub()));
+    else c.skip(wt);
+    if (!c.ok) return false;
+  }
+  return c.ok && !blocks->empty();
+}
+
+// ---------------------------------------------------------------------------
+// LoDTensor stream reader
+// ---------------------------------------------------------------------------
+
+static bool read_lod_tensor(FILE* f, Tensor* t, std::string* err) {
+  auto rd = [&](void* dst, size_t n) { return fread(dst, 1, n, f) == n; };
+  uint32_t version;
+  if (!rd(&version, 4)) { *err = "truncated LoDTensor (version)"; return false; }
+  if (version != 0) { *err = "unsupported LoDTensor version"; return false; }
+  uint64_t lod_level;
+  if (!rd(&lod_level, 8)) { *err = "truncated LoDTensor (lod)"; return false; }
+  for (uint64_t l = 0; l < lod_level; ++l) {
+    uint64_t nbytes;
+    if (!rd(&nbytes, 8)) { *err = "truncated lod level"; return false; }
+    fseek(f, static_cast<long>(nbytes), SEEK_CUR);
+  }
+  uint32_t tver;
+  if (!rd(&tver, 4)) { *err = "truncated tensor version"; return false; }
+  int32_t desc_size;
+  if (!rd(&desc_size, 4)) { *err = "truncated desc size"; return false; }
+  if (desc_size < 0 || desc_size > (1 << 20)) {
+    *err = "corrupt TensorDesc size " + std::to_string(desc_size);
+    return false;
+  }
+  std::string desc(desc_size, '\0');
+  if (!rd(desc.data(), desc_size)) { *err = "truncated TensorDesc"; return false; }
+  VarInfo vi;
+  parse_tensor_desc(
+      Cursor{reinterpret_cast<const uint8_t*>(desc.data()),
+             reinterpret_cast<const uint8_t*>(desc.data()) + desc.size()},
+      &vi);
+  t->dims = vi.dims;
+  int64_t n = 1;
+  for (auto d : t->dims) {
+    if (d < 0 || d > (int64_t(1) << 32)) {
+      *err = "corrupt tensor dim " + std::to_string(d);
+      return false;
+    }
+    n *= d;
+  }
+  if (n < 0 || n > (int64_t(1) << 34)) {
+    *err = "corrupt tensor size " + std::to_string(n);
+    return false;
+  }
+  if (vi.dtype == 5) {  // FP32
+    t->is_f = true;
+    t->f.resize(n);
+    if (!rd(t->f.data(), n * 4)) { *err = "truncated fp32 payload"; return false; }
+  } else if (vi.dtype == 3) {  // INT64
+    t->is_f = false;
+    t->i.resize(n);
+    if (!rd(t->i.data(), n * 8)) { *err = "truncated int64 payload"; return false; }
+  } else if (vi.dtype == 2) {  // INT32 → widen
+    std::vector<int32_t> tmp(n);
+    if (!rd(tmp.data(), n * 4)) { *err = "truncated int32 payload"; return false; }
+    t->is_f = false;
+    t->i.assign(tmp.begin(), tmp.end());
+  } else {
+    *err = "unsupported param dtype " + std::to_string(vi.dtype);
+    return false;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// kernels
+// ---------------------------------------------------------------------------
+
+static void matmul2d(const float* a, const float* b, float* out, int64_t m,
+                     int64_t k, int64_t n) {
+  // simple ikj loop: streams b rows, decent cache behavior at MLP sizes
+  for (int64_t i = 0; i < m; ++i) {
+    float* o = out + i * n;
+    memset(o, 0, n * sizeof(float));
+    const float* ar = a + i * k;
+    for (int64_t kk = 0; kk < k; ++kk) {
+      float av = ar[kk];
+      const float* br = b + kk * n;
+      for (int64_t j = 0; j < n; ++j) o[j] += av * br[j];
+    }
+  }
+}
+
+static int64_t flatten_rows(const std::vector<int64_t>& dims, int ncol) {
+  int64_t m = 1;
+  for (int i = 0; i < ncol && i < static_cast<int>(dims.size()); ++i)
+    m *= dims[i];
+  return m;
+}
+
+struct Runtime {
+  std::vector<Block> blocks;
+  std::map<std::string, Tensor> scope;  // params + activations
+  std::vector<std::string> feed_names, fetch_names;
+  std::string error;
+  // load-time errors are permanent; run-time errors clear on the next run
+  bool load_failed = false;
+
+  Tensor* var(const std::string& n) {
+    auto it = scope.find(n);
+    return it == scope.end() ? nullptr : &it->second;
+  }
+
+  bool fail(const std::string& e) {
+    if (error.empty()) error = e;
+    return false;
+  }
+
+  bool run_op(const Op& op);
+  bool run();
+};
+
+static void ewise_broadcast(const Tensor& x, const Tensor& y, int axis,
+                            char kind, Tensor* out) {
+  // y's dims align into x at `axis` (right-aligned when axis==-1), with
+  // numpy broadcasting inside the aligned span (size-1 y dims repeat):
+  // stride-0 trick over a full multi-index walk — exact for [M,1], [C,1,1]
+  // and friends, not just contiguous tails
+  out->dims = x.dims;
+  out->is_f = true;
+  out->f.resize(x.numel());
+  int xr = static_cast<int>(x.dims.size());
+  int yr = static_cast<int>(y.dims.size());
+  if (axis < 0) axis = xr - yr;
+  // y's stride per x-dim (0 where y is absent or size-1)
+  std::vector<int64_t> ystride(xr, 0);
+  int64_t s = 1;
+  for (int i = yr - 1; i >= 0; --i) {
+    int xi = axis + i;
+    if (xi >= 0 && xi < xr && y.dims[i] != 1) ystride[xi] = s;
+    s *= y.dims[i];
+  }
+  std::vector<int64_t> xstride(xr, 1);
+  for (int i = xr - 2; i >= 0; --i) xstride[i] = xstride[i + 1] * x.dims[i + 1];
+  int64_t n = x.numel();
+  for (int64_t li = 0; li < n; ++li) {
+    int64_t rem = li, yi = 0;
+    for (int i = 0; i < xr; ++i) {
+      int64_t d = rem / xstride[i];
+      rem %= xstride[i];
+      yi += d * ystride[i];
+    }
+    float a = x.f[li], b = y.f[yi];
+    float r = 0;
+    switch (kind) {
+      case '+': r = a + b; break;
+      case '-': r = a - b; break;
+      case '*': r = a * b; break;
+      case '/': r = a / b; break;
+    }
+    out->f[li] = r;
+  }
+}
+
+bool Runtime::run_op(const Op& op) {
+  const std::string& t = op.type;
+  if (t == "feed" || t == "fetch") return true;  // handled by run()
+
+  auto X = [&](const char* slot) -> Tensor* { return var(op.in(slot)); };
+  auto make_out = [&](const char* slot) -> Tensor* {
+    return &scope[op.out(slot)];
+  };
+
+  if (t == "mul") {
+    Tensor *x = X("X"), *y = X("Y");
+    if (!x || !y) return fail("mul: missing input");
+    int ncol = static_cast<int>(op.attr_i("x_num_col_dims", 1));
+    int64_t m = flatten_rows(x->dims, ncol);
+    int64_t k = x->numel() / m;
+    int64_t n = y->numel() / y->dims[0];
+    if (y->dims[0] != k) return fail("mul: shape mismatch");
+    Tensor* o = make_out("Out");
+    o->is_f = true;
+    o->dims.assign(x->dims.begin(), x->dims.begin() + ncol);
+    o->dims.push_back(n);
+    o->f.resize(m * n);
+    matmul2d(x->f.data(), y->f.data(), o->f.data(), m, k, n);
+    return true;
+  }
+  if (t == "matmul" || t == "matmul_v2") {
+    Tensor *x = X("X"), *y = X("Y");
+    if (!x || !y) return fail("matmul: missing input");
+    bool tx = op.attr_b("transpose_X", false) || op.attr_b("trans_x", false);
+    bool ty = op.attr_b("transpose_Y", false) || op.attr_b("trans_y", false);
+    if (x->dims.size() != 2 || y->dims.size() != 2 || tx)
+      return fail("matmul: only 2D, non-transposed X supported");
+    int64_t m = x->dims[0], k = x->dims[1];
+    Tensor* o = make_out("Out");
+    o->is_f = true;
+    if (!ty) {
+      if (y->dims[0] != k) return fail("matmul: shape mismatch");
+      int64_t n = y->dims[1];
+      o->dims = {m, n};
+      o->f.resize(m * n);
+      matmul2d(x->f.data(), y->f.data(), o->f.data(), m, k, n);
+    } else {
+      if (y->dims[1] != k) return fail("matmul^T: shape mismatch");
+      int64_t n = y->dims[0];
+      o->dims = {m, n};
+      o->f.assign(m * n, 0.f);
+      for (int64_t i = 0; i < m; ++i)
+        for (int64_t j = 0; j < n; ++j) {
+          float acc = 0;
+          for (int64_t kk = 0; kk < k; ++kk)
+            acc += x->f[i * k + kk] * y->f[j * k + kk];
+          o->f[i * n + j] = acc;
+        }
+    }
+    float alpha = op.attr_f("alpha", 1.0f);
+    if (alpha != 1.0f)
+      for (auto& v : o->f) v *= alpha;
+    return true;
+  }
+  if (t == "fc") {
+    Tensor *x = X("Input"), *w = X("W");
+    if (!x || !w) return fail("fc: missing input");
+    int ncol = static_cast<int>(op.attr_i("in_num_col_dims", 1));
+    int64_t m = flatten_rows(x->dims, ncol);
+    int64_t k = x->numel() / m, n = w->dims[1];
+    if (w->dims[0] != k) return fail("fc: shape mismatch");
+    Tensor* o = make_out("Out");
+    o->is_f = true;
+    o->dims = {m, n};
+    o->f.resize(m * n);
+    matmul2d(x->f.data(), w->f.data(), o->f.data(), m, k, n);
+    if (op.has_in("Bias")) {
+      Tensor* b = X("Bias");
+      for (int64_t i = 0; i < m; ++i)
+        for (int64_t j = 0; j < n; ++j) o->f[i * n + j] += b->f[j];
+    }
+    std::string act = op.attr_s("activation_type");
+    if (act == "relu")
+      for (auto& v : o->f) v = v > 0 ? v : 0;
+    else if (!act.empty())
+      return fail("fc: unsupported activation " + act);
+    return true;
+  }
+  if (t == "elementwise_add" || t == "elementwise_sub" ||
+      t == "elementwise_mul" || t == "elementwise_div") {
+    Tensor *x = X("X"), *y = X("Y");
+    if (!x || !y) return fail(t + ": missing input");
+    if (!x->is_f || !y->is_f)
+      return fail(t + ": only float32 supported natively");
+    char kind = t == "elementwise_add" ? '+'
+                : t == "elementwise_sub" ? '-'
+                : t == "elementwise_mul" ? '*' : '/';
+    ewise_broadcast(*x, *y, static_cast<int>(op.attr_i("axis", -1)), kind,
+                    make_out("Out"));
+    return true;
+  }
+  if (t == "relu" || t == "sigmoid" || t == "tanh" || t == "exp") {
+    Tensor* x = X("X");
+    if (!x) return fail(t + ": missing input");
+    if (!x->is_f) return fail(t + ": only float32 supported natively");
+    Tensor* o = make_out("Out");
+    *o = *x;
+    for (auto& v : o->f) {
+      if (t == "relu") v = v > 0 ? v : 0;
+      else if (t == "sigmoid") v = 1.f / (1.f + std::exp(-v));
+      else if (t == "tanh") v = std::tanh(v);
+      else v = std::exp(v);
+    }
+    return true;
+  }
+  if (t == "softmax") {
+    Tensor* x = X("X");
+    if (!x) return fail("softmax: missing input");
+    int64_t ax = op.attr_i("axis", -1);
+    int xr = static_cast<int>(x->dims.size());
+    if (ax != -1 && ax != xr - 1)
+      return fail("softmax: only last-axis supported natively");
+    Tensor* o = make_out("Out");
+    *o = *x;
+    int64_t last = x->dims.back(), rows = x->numel() / last;
+    for (int64_t r = 0; r < rows; ++r) {
+      float* p = o->f.data() + r * last;
+      float mx = p[0];
+      for (int64_t j = 1; j < last; ++j) mx = std::max(mx, p[j]);
+      float sum = 0;
+      for (int64_t j = 0; j < last; ++j) { p[j] = std::exp(p[j] - mx); sum += p[j]; }
+      for (int64_t j = 0; j < last; ++j) p[j] /= sum;
+    }
+    return true;
+  }
+  if (t == "scale") {
+    Tensor* x = X("X");
+    if (!x) return fail("scale: missing input");
+    Tensor* o = make_out("Out");
+    *o = *x;
+    float s = op.attr_f("scale", 1.0f), b = op.attr_f("bias", 0.0f);
+    bool after = op.attr_b("bias_after_scale", true);
+    for (auto& v : o->f) v = after ? v * s + b : (v + b) * s;
+    return true;
+  }
+  if (t == "reshape" || t == "reshape2") {
+    Tensor* x = X("X");
+    if (!x) return fail("reshape: missing input");
+    Tensor* o = make_out("Out");
+    *o = *x;
+    auto shape = op.attr_ints("shape");
+    int64_t known = 1, minus1 = -1;
+    for (size_t i = 0; i < shape.size(); ++i) {
+      if (shape[i] == -1) minus1 = static_cast<int64_t>(i);
+      else if (shape[i] == 0) shape[i] = x->dims[i];
+      if (shape[i] > 0) known *= shape[i];
+    }
+    if (minus1 >= 0) shape[minus1] = x->numel() / known;
+    o->dims = shape;
+    return true;
+  }
+  if (t == "transpose" || t == "transpose2") {
+    Tensor* x = X("X");
+    if (!x) return fail("transpose: missing input");
+    auto axis = op.attr_ints("axis");
+    int r = static_cast<int>(x->dims.size());
+    Tensor* o = make_out("Out");
+    o->is_f = x->is_f;
+    o->dims.resize(r);
+    for (int i = 0; i < r; ++i) o->dims[i] = x->dims[axis[i]];
+    std::vector<int64_t> xstr(r, 1), ostr(r, 1);
+    for (int i = r - 2; i >= 0; --i) xstr[i] = xstr[i + 1] * x->dims[i + 1];
+    for (int i = r - 2; i >= 0; --i) ostr[i] = ostr[i + 1] * o->dims[i + 1];
+    int64_t n = x->numel();
+    o->f.resize(x->is_f ? n : 0);
+    o->i.resize(x->is_f ? 0 : n);
+    std::vector<int64_t> idx(r);
+    for (int64_t li = 0; li < n; ++li) {
+      int64_t rem = li, src = 0;
+      for (int i = 0; i < r; ++i) {
+        idx[i] = rem / ostr[i];
+        rem %= ostr[i];
+        src += idx[i] * xstr[axis[i]];
+      }
+      if (x->is_f) o->f[li] = x->f[src];
+      else o->i[li] = x->i[src];
+    }
+    return true;
+  }
+  if (t == "dropout") {
+    Tensor* x = X("X");
+    if (!x) return fail("dropout: missing input");
+    Tensor* o = make_out("Out");
+    *o = *x;  // inference: upscale_in_train → identity; downgrade → scale
+    std::string impl = op.attr_s("dropout_implementation", "downgrade_in_infer");
+    if (impl == "downgrade_in_infer") {
+      float keep = 1.0f - op.attr_f("dropout_prob", 0.0f);
+      for (auto& v : o->f) v *= keep;
+    }
+    return true;
+  }
+  if (t == "batch_norm") {
+    Tensor *x = X("X"), *sc = X("Scale"), *bi = X("Bias"), *mu = X("Mean"),
+           *va = X("Variance");
+    if (!x || !sc || !bi || !mu || !va) return fail("batch_norm: missing input");
+    float eps = op.attr_f("epsilon", 1e-5f);
+    Tensor* o = make_out("Y");
+    *o = *x;
+    int64_t c = x->dims.size() > 1 ? x->dims[1] : x->dims[0];
+    int64_t spatial = x->numel() / (x->dims[0] * c);
+    for (int64_t nn = 0; nn < x->dims[0]; ++nn)
+      for (int64_t cc = 0; cc < c; ++cc) {
+        float inv = 1.0f / std::sqrt(va->f[cc] + eps);
+        float g = sc->f[cc] * inv, be = bi->f[cc] - mu->f[cc] * g;
+        float* p = o->f.data() + (nn * c + cc) * spatial;
+        for (int64_t s = 0; s < spatial; ++s) p[s] = p[s] * g + be;
+      }
+    return true;
+  }
+  if (t == "lookup_table" || t == "lookup_table_v2") {
+    Tensor *w = X("W"), *ids = X("Ids");
+    if (!w || !ids) return fail("lookup_table: missing input");
+    int64_t d = w->dims[1], n = ids->numel();
+    int64_t pad = op.attr_i("padding_idx", -1);
+    Tensor* o = make_out("Out");
+    o->is_f = true;
+    o->dims = ids->dims;
+    if (!o->dims.empty() && o->dims.back() == 1) o->dims.pop_back();
+    o->dims.push_back(d);
+    o->f.resize(n * d);
+    for (int64_t r = 0; r < n; ++r) {
+      int64_t id = ids->i[r];
+      if (id == pad || id < 0 || id >= w->dims[0])
+        memset(o->f.data() + r * d, 0, d * 4);
+      else
+        memcpy(o->f.data() + r * d, w->f.data() + id * d, d * 4);
+    }
+    return true;
+  }
+  if (t == "concat") {
+    auto it = op.inputs.find("X");
+    if (it == op.inputs.end() || it->second.empty())
+      return fail("concat: missing input");
+    std::vector<Tensor*> xs;
+    for (auto& n : it->second) {
+      Tensor* x = var(n);
+      if (!x) return fail("concat: missing " + n);
+      xs.push_back(x);
+    }
+    int axis = static_cast<int>(op.attr_i("axis", 0));
+    if (axis < 0) axis += static_cast<int>(xs[0]->dims.size());
+    Tensor* o = make_out("Out");
+    o->is_f = xs[0]->is_f;
+    o->dims = xs[0]->dims;
+    int64_t cat = 0;
+    for (auto* x : xs) cat += x->dims[axis];
+    o->dims[axis] = cat;
+    int64_t pre = 1, post = 1;
+    for (int i = 0; i < axis; ++i) pre *= xs[0]->dims[i];
+    for (size_t i = axis + 1; i < xs[0]->dims.size(); ++i)
+      post *= xs[0]->dims[i];
+    o->f.resize(o->is_f ? o->numel() : 0);
+    o->i.resize(o->is_f ? 0 : o->numel());
+    int64_t ooff = 0;
+    for (auto* x : xs) {
+      int64_t chunk = x->dims[axis] * post;
+      for (int64_t p = 0; p < pre; ++p) {
+        if (o->is_f)
+          memcpy(o->f.data() + p * cat * post + ooff,
+                 x->f.data() + p * chunk, chunk * 4);
+        else
+          memcpy(o->i.data() + p * cat * post + ooff,
+                 x->i.data() + p * chunk, chunk * 8);
+      }
+      ooff += chunk;
+    }
+    return true;
+  }
+  if (t == "pool2d") {
+    Tensor* x = X("X");
+    if (!x || x->dims.size() != 4) return fail("pool2d: need NCHW input");
+    if (op.attr_b("adaptive", false))
+      return fail("pool2d: adaptive mode not supported natively");
+    bool global = op.attr_b("global_pooling", false);
+    bool ceil_mode = op.attr_b("ceil_mode", false);
+    std::string ptype = op.attr_s("pooling_type", "max");
+    auto ksize = op.attr_ints("ksize");
+    auto strides = op.attr_ints("strides");
+    auto paddings = op.attr_ints("paddings");
+    int64_t N = x->dims[0], C = x->dims[1], H = x->dims[2], W = x->dims[3];
+    int64_t kh = global ? H : ksize[0], kw = global ? W : ksize[1];
+    int64_t sh = global ? 1 : strides[0], sw = global ? 1 : strides[1];
+    int64_t ph = global ? 0 : paddings[0], pw = global ? 0 : paddings[1];
+    int64_t ceil_add = ceil_mode ? (sh - 1) : 0;
+    int64_t ceil_add_w = ceil_mode ? (sw - 1) : 0;
+    int64_t OH = (H + 2 * ph - kh + ceil_add) / sh + 1;
+    int64_t OW = (W + 2 * pw - kw + ceil_add_w) / sw + 1;
+    Tensor* o = make_out("Out");
+    o->is_f = true;
+    o->dims = {N, C, OH, OW};
+    o->f.resize(o->numel());
+    bool exclusive = op.attr_b("exclusive", true);
+    for (int64_t n = 0; n < N; ++n)
+      for (int64_t c = 0; c < C; ++c) {
+        const float* in = x->f.data() + (n * C + c) * H * W;
+        float* out = o->f.data() + (n * C + c) * OH * OW;
+        for (int64_t oh = 0; oh < OH; ++oh)
+          for (int64_t ow = 0; ow < OW; ++ow) {
+            int64_t h0 = oh * sh - ph, w0 = ow * sw - pw;
+            int64_t h1 = std::min(h0 + kh, H), w1 = std::min(w0 + kw, W);
+            h0 = std::max<int64_t>(h0, 0);
+            w0 = std::max<int64_t>(w0, 0);
+            float acc = ptype == "max" ? -3.4e38f : 0.f;
+            int64_t cnt = 0;
+            for (int64_t hh = h0; hh < h1; ++hh)
+              for (int64_t ww = w0; ww < w1; ++ww, ++cnt) {
+                float v = in[hh * W + ww];
+                acc = ptype == "max" ? std::max(acc, v) : acc + v;
+              }
+            if (ptype != "max")
+              acc /= exclusive ? std::max<int64_t>(cnt, 1) : kh * kw;
+            out[oh * OW + ow] = acc;
+          }
+      }
+    return true;
+  }
+  if (t == "conv2d") {
+    Tensor *x = X("Input"), *w = X("Filter");
+    if (!x || !w) return fail("conv2d: missing input");
+    auto strides = op.attr_ints("strides");
+    auto paddings = op.attr_ints("paddings");
+    auto dil = op.attr_ints("dilations");
+    int64_t groups = op.attr_i("groups", 1);
+    int64_t N = x->dims[0], C = x->dims[1], H = x->dims[2], W = x->dims[3];
+    int64_t M = w->dims[0], Cg = w->dims[1], KH = w->dims[2], KW = w->dims[3];
+    int64_t sh = strides.empty() ? 1 : strides[0];
+    int64_t sw = strides.size() > 1 ? strides[1] : sh;
+    int64_t ph = paddings.empty() ? 0 : paddings[0];
+    int64_t pw = paddings.size() > 1 ? paddings[1] : ph;
+    int64_t dh = dil.empty() ? 1 : dil[0], dw = dil.size() > 1 ? dil[1] : dh;
+    int64_t OH = (H + 2 * ph - (dh * (KH - 1) + 1)) / sh + 1;
+    int64_t OW = (W + 2 * pw - (dw * (KW - 1) + 1)) / sw + 1;
+    if (C != Cg * groups) return fail("conv2d: channel/group mismatch");
+    Tensor* o = make_out("Output");
+    o->is_f = true;
+    o->dims = {N, M, OH, OW};
+    o->f.assign(o->numel(), 0.f);
+    int64_t Mg = M / groups;
+    for (int64_t n = 0; n < N; ++n)
+      for (int64_t g = 0; g < groups; ++g)
+        for (int64_t m = 0; m < Mg; ++m) {
+          int64_t oc = g * Mg + m;
+          float* out = o->f.data() + (n * M + oc) * OH * OW;
+          for (int64_t ic = 0; ic < Cg; ++ic) {
+            const float* in = x->f.data() + (n * C + g * Cg + ic) * H * W;
+            const float* ker = w->f.data() + ((oc * Cg) + ic) * KH * KW;
+            for (int64_t oh = 0; oh < OH; ++oh)
+              for (int64_t ow = 0; ow < OW; ++ow) {
+                float acc = 0;
+                for (int64_t khh = 0; khh < KH; ++khh) {
+                  int64_t hh = oh * sh - ph + khh * dh;
+                  if (hh < 0 || hh >= H) continue;
+                  for (int64_t kww = 0; kww < KW; ++kww) {
+                    int64_t ww = ow * sw - pw + kww * dw;
+                    if (ww < 0 || ww >= W) continue;
+                    acc += in[hh * W + ww] * ker[khh * KW + kww];
+                  }
+                }
+                out[oh * OW + ow] += acc;
+              }
+          }
+        }
+    return true;
+  }
+  if (t == "mean") {
+    Tensor* x = X("X");
+    if (!x) return fail("mean: missing input");
+    Tensor* o = make_out("Out");
+    o->is_f = true;
+    o->dims = {1};
+    double acc = 0;
+    for (auto v : x->f) acc += v;
+    o->f = {static_cast<float>(acc / x->numel())};
+    return true;
+  }
+  if (t == "arg_max") {
+    Tensor* x = X("X");
+    if (!x) return fail("arg_max: missing input");
+    int64_t ax = op.attr_i("axis", -1);
+    int xr = static_cast<int>(x->dims.size());
+    if (ax != -1 && ax != xr - 1)
+      return fail("arg_max: only last-axis supported natively");
+    int64_t last = x->dims.back(), rows = x->numel() / last;
+    Tensor* o = make_out("Out");
+    o->is_f = false;
+    o->dims = x->dims;
+    o->dims.pop_back();
+    if (o->dims.empty()) o->dims = {1};
+    o->i.resize(rows);
+    for (int64_t r = 0; r < rows; ++r) {
+      const float* p = x->f.data() + r * last;
+      int64_t best = 0;
+      for (int64_t j = 1; j < last; ++j)
+        if (p[j] > p[best]) best = j;
+      o->i[r] = best;
+    }
+    return true;
+  }
+  return fail("no native kernel for op '" + t +
+              "' (serve this model with the Python AnalysisPredictor)");
+}
+
+bool Runtime::run() {
+  for (const auto& op : blocks[0].ops) {
+    if (!run_op(op)) return false;
+  }
+  return true;
+}
+
+}  // namespace pti
+
+// ---------------------------------------------------------------------------
+// C ABI (mirrors CreatePaddlePredictor / PaddleTensor at arm's length)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// model_dir must contain __model__; params either per-var files (pass
+// params_file=nullptr) or one combined file (load_combine order: sorted by
+// var name — io.py save side mirrors).  Errors (I/O, parse, corrupt
+// streams) are reported via pti_error after create; no C++ exception may
+// cross the C ABI.
+static void* pti_create_impl(const char* model_dir, const char* params_file,
+                             pti::Runtime* rt);
+
+void* pti_create(const char* model_dir, const char* params_file) {
+  auto* rt = new pti::Runtime();
+  try {
+    pti_create_impl(model_dir, params_file, rt);
+  } catch (const std::exception& e) {
+    rt->error = std::string("corrupt model: ") + e.what();
+  } catch (...) {
+    rt->error = "corrupt model: unknown C++ exception";
+  }
+  rt->load_failed = !rt->error.empty();
+  return rt;
+}
+
+static void* pti_create_impl(const char* model_dir, const char* params_file,
+                             pti::Runtime* rt) {
+  std::string dir(model_dir);
+  std::string model_path = dir + "/__model__";
+  FILE* f = fopen(model_path.c_str(), "rb");
+  if (!f) {
+    rt->error = "cannot open " + model_path;
+    return rt;
+  }
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string blob(sz, '\0');
+  if (fread(blob.data(), 1, sz, f) != static_cast<size_t>(sz)) {
+    fclose(f);
+    rt->error = "short read on __model__";
+    return rt;
+  }
+  fclose(f);
+  if (!pti::parse_program(blob, &rt->blocks)) {
+    rt->error = "cannot parse __model__ ProgramDesc";
+    return rt;
+  }
+  // feed/fetch names (col-ordered)
+  std::vector<std::pair<int, std::string>> feeds, fetches;
+  for (const auto& op : rt->blocks[0].ops) {
+    if (op.type == "feed")
+      feeds.push_back({static_cast<int>(op.attr_i("col", 0)), op.out("Out")});
+    else if (op.type == "fetch")
+      fetches.push_back({static_cast<int>(op.attr_i("col", 0)), op.in("X")});
+  }
+  std::sort(feeds.begin(), feeds.end());
+  std::sort(fetches.begin(), fetches.end());
+  for (auto& p : feeds) rt->feed_names.push_back(p.second);
+  for (auto& p : fetches) rt->fetch_names.push_back(p.second);
+
+  // persistable LOD_TENSOR vars referenced by compute ops = params to load
+  // (the feed/fetch holder vars are persistable too — FEED_MINIBATCH=9 /
+  // FETCH_LIST=10 — but have no file on disk)
+  std::map<std::string, bool> used;
+  for (const auto& op : rt->blocks[0].ops) {
+    if (op.type == "feed" || op.type == "fetch") continue;
+    for (const auto& kv : op.inputs)
+      for (const auto& n : kv.second) used[n] = true;
+  }
+  std::vector<std::string> params;
+  for (const auto& v : rt->blocks[0].vars)
+    if (v.persistable && v.kind == 7 && used.count(v.name))
+      params.push_back(v.name);
+  std::sort(params.begin(), params.end());
+
+  std::string err;
+  if (params_file && params_file[0]) {
+    std::string path = dir + "/" + params_file;
+    FILE* pf = fopen(path.c_str(), "rb");
+    if (!pf) {
+      rt->error = "cannot open " + path;
+      return rt;
+    }
+    for (const auto& name : params) {
+      pti::Tensor t;
+      if (!pti::read_lod_tensor(pf, &t, &err)) {
+        rt->error = "param " + name + ": " + err;
+        fclose(pf);
+        return rt;
+      }
+      rt->scope[name] = std::move(t);
+    }
+    fclose(pf);
+  } else {
+    for (const auto& name : params) {
+      std::string path = dir + "/" + name;
+      FILE* pf = fopen(path.c_str(), "rb");
+      if (!pf) {
+        rt->error = "cannot open param file " + path;
+        return rt;
+      }
+      pti::Tensor t;
+      bool ok = pti::read_lod_tensor(pf, &t, &err);
+      fclose(pf);
+      if (!ok) {
+        rt->error = "param " + name + ": " + err;
+        return rt;
+      }
+      rt->scope[name] = std::move(t);
+    }
+  }
+  return rt;
+}
+
+const char* pti_error(void* h) {
+  return static_cast<pti::Runtime*>(h)->error.c_str();
+}
+
+int pti_num_inputs(void* h) {
+  return static_cast<int>(static_cast<pti::Runtime*>(h)->feed_names.size());
+}
+const char* pti_input_name(void* h, int i) {
+  return static_cast<pti::Runtime*>(h)->feed_names[i].c_str();
+}
+int pti_num_outputs(void* h) {
+  return static_cast<int>(static_cast<pti::Runtime*>(h)->fetch_names.size());
+}
+const char* pti_output_name(void* h, int i) {
+  return static_cast<pti::Runtime*>(h)->fetch_names[i].c_str();
+}
+
+// dtype: 0 = float32, 1 = int64
+int pti_set_input(void* h, const char* name, const void* data,
+                  const int64_t* dims, int ndims, int dtype) {
+  auto* rt = static_cast<pti::Runtime*>(h);
+  pti::Tensor t;
+  t.dims.assign(dims, dims + ndims);
+  int64_t n = t.numel();
+  if (dtype == 0) {
+    t.is_f = true;
+    t.f.assign(static_cast<const float*>(data),
+               static_cast<const float*>(data) + n);
+  } else {
+    t.is_f = false;
+    t.i.assign(static_cast<const int64_t*>(data),
+               static_cast<const int64_t*>(data) + n);
+  }
+  rt->scope[name] = std::move(t);
+  return 0;
+}
+
+int pti_run(void* h) {
+  auto* rt = static_cast<pti::Runtime*>(h);
+  if (!rt->load_failed) rt->error.clear();  // run errors are not sticky
+  if (!rt->error.empty()) return 1;
+  try {
+    return rt->run() ? 0 : 1;
+  } catch (const std::exception& e) {
+    rt->error = std::string("native kernel exception: ") + e.what();
+    return 1;
+  } catch (...) {
+    rt->error = "native kernel exception";
+    return 1;
+  }
+}
+
+// returns element count (<0 on error); *data points into runtime-owned
+// memory, valid until the next pti_run/pti_free
+int64_t pti_get_output(void* h, const char* name, const void** data,
+                       const int64_t** dims, int* ndims, int* dtype) {
+  auto* rt = static_cast<pti::Runtime*>(h);
+  pti::Tensor* t = rt->var(name);
+  if (!t) {
+    rt->error = "no output var " + std::string(name);
+    return -1;
+  }
+  *dims = t->dims.data();
+  *ndims = static_cast<int>(t->dims.size());
+  if (t->is_f) {
+    *data = t->f.data();
+    *dtype = 0;
+  } else {
+    *data = t->i.data();
+    *dtype = 1;
+  }
+  return t->numel();
+}
+
+void pti_free(void* h) { delete static_cast<pti::Runtime*>(h); }
+
+}  // extern "C"

@@ -57,6 +57,25 @@ int64_t ptq_feed_next(void* handle, char** out);
 int64_t ptq_feed_error(void* handle, char** out);
 void ptq_feed_free(void* handle);
 
+// --- native inference runtime (infer_runtime.cc) ----------------------- //
+// Reference analog: inference/api/paddle_inference_api.h
+// CreatePaddlePredictor — load __model__ (ProgramDesc protobuf) + params
+// (per-var LoDTensor files, or one combined file when params_file != NULL)
+// and run on CPU with no Python/JAX dependency.
+void* pti_create(const char* model_dir, const char* params_file);
+const char* pti_error(void* handle);
+int pti_num_inputs(void* handle);
+const char* pti_input_name(void* handle, int i);
+int pti_num_outputs(void* handle);
+const char* pti_output_name(void* handle, int i);
+// dtype: 0 = float32, 1 = int64
+int pti_set_input(void* handle, const char* name, const void* data,
+                  const int64_t* dims, int ndims, int dtype);
+int pti_run(void* handle);
+int64_t pti_get_output(void* handle, const char* name, const void** data,
+                       const int64_t** dims, int* ndims, int* dtype);
+void pti_free(void* handle);
+
 // --- parameter-server transport --------------------------------------- //
 void* pts_server_start(int port, int n_trainers);
 int pts_server_port(void* h);

@@ -6,3 +6,10 @@ masked fine-tuning (prune.py), and knowledge distillation (distillation.py).
 from . import quantization  # noqa: F401
 from . import prune  # noqa: F401
 from . import distillation  # noqa: F401
+from . import core  # noqa: F401
+from . import strategies  # noqa: F401
+from . import nas  # noqa: F401
+from .core import Compressor, ConfigFactory, Context, Strategy  # noqa: F401
+from .nas import LightNASStrategy, SAController, SearchSpace  # noqa: F401
+from .strategies import (DistillationStrategy, PruneStrategy,  # noqa: F401
+                         QuantizationStrategy)

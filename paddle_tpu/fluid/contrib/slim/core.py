@@ -1,0 +1,273 @@
+"""Config-driven compression framework core (VERDICT r2 missing#3).
+
+Reference analog: python/paddle/fluid/contrib/slim/core/{compressor.py,
+config.py, strategy.py} — a Compressor drives epoch-based training while
+Strategy plugins (pruning, quantization, distillation, NAS) hook the loop
+at compression/epoch/batch boundaries, all instantiated from a yaml config.
+
+TPU-native redesign: the reference compressor owns graph wrappers and a
+C++ executor; here the training step is already ONE compiled XLA program,
+so the Compressor is a thin epoch loop over `Executor.run` and strategies
+are program/scope transforms (the same leaves in prune.py/quantization.py/
+distillation.py).  Checkpointing rides save/load_persistables.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import numpy as np
+
+logger = logging.getLogger("paddle_tpu.slim")
+
+__all__ = ["Context", "Strategy", "Compressor", "ConfigFactory",
+           "register_strategy"]
+
+
+class Context:
+    """Mutable state shared with strategies (reference compressor.py:79)."""
+
+    def __init__(self, place, scope, train_program, startup_program,
+                 train_reader=None, train_feed_names=None,
+                 train_fetch_names=None, eval_program=None, eval_reader=None,
+                 eval_feed_names=None, eval_fetch_names=None):
+        self.place = place
+        self.scope = scope
+        self.train_program = train_program
+        self.startup_program = startup_program
+        self.train_reader = train_reader
+        self.train_feed_names = list(train_feed_names or [])
+        self.train_fetch_names = list(train_fetch_names or [])
+        self.eval_program = eval_program
+        self.eval_reader = eval_reader
+        self.eval_feed_names = list(eval_feed_names or [])
+        self.eval_fetch_names = list(eval_fetch_names or [])
+        self.epoch_id = 0
+        self.batch_id = 0
+        self.eval_results = {}  # fetch name -> list per epoch
+        self.executor = None
+        self.search_space = None  # set by NAS strategies
+
+    def eval(self):
+        """Run the eval program over eval_reader; returns mean of each
+        eval fetch (reference run_eval_graph)."""
+        if self.eval_program is None or self.eval_reader is None:
+            return {}
+        sums, count = None, 0
+        for batch in self.eval_reader():
+            feed = dict(zip(self.eval_feed_names, batch)) \
+                if not isinstance(batch, dict) else batch
+            vals = self.executor.run(self.eval_program, feed=feed,
+                                     fetch_list=self.eval_fetch_names)
+            vals = [float(np.asarray(v).mean()) for v in vals]
+            sums = vals if sums is None else [a + b for a, b in zip(sums, vals)]
+            count += 1
+        if not count:
+            return {}
+        means = {n: s / count for n, s in zip(self.eval_fetch_names, sums)}
+        for n, v in means.items():
+            self.eval_results.setdefault(n, []).append(v)
+        return means
+
+
+class Strategy:
+    """Base strategy (reference core/strategy.py) — epoch-windowed hooks."""
+
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = int(start_epoch)
+        self.end_epoch = int(end_epoch)
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_batch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+    def restore_from_checkpoint(self, context):
+        pass
+
+
+_STRATEGY_REGISTRY: dict = {}
+
+
+def register_strategy(cls):
+    """Class decorator: make a Strategy constructible from yaml configs by
+    class name (reference ConfigFactory._new_instance resolves names the
+    same way)."""
+    _STRATEGY_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class ConfigFactory:
+    """Parse the reference's yaml schema (core/config.py):
+
+        version: 1.0
+        strategies:
+          prune_s:
+            class: PruneStrategy
+            start_epoch: 0
+            ratio: 0.5
+        compressor:
+          epoch: 2
+          checkpoint_path: ./ckpt
+          strategies: [prune_s]
+    """
+
+    def __init__(self, config_path):
+        import yaml
+
+        with open(config_path) as f:
+            cfg = yaml.safe_load(f)
+        if not isinstance(cfg, dict) or "compressor" not in cfg:
+            raise ValueError(f"{config_path}: missing 'compressor' section")
+        self.compressor = dict(cfg.get("compressor") or {})
+        self._specs = dict(cfg.get("strategies") or {})
+        self._instances = {}
+
+    def instance(self, name):
+        if name in self._instances:
+            return self._instances[name]
+        if name not in self._specs:
+            raise KeyError(f"strategy {name!r} not defined in config")
+        attrs = dict(self._specs[name])
+        cls_name = attrs.pop("class", None)
+        if cls_name not in _STRATEGY_REGISTRY:
+            raise KeyError(
+                f"unknown strategy class {cls_name!r}; registered: "
+                f"{sorted(_STRATEGY_REGISTRY)}")
+        inst = _STRATEGY_REGISTRY[cls_name](**attrs)
+        self._instances[name] = inst
+        return inst
+
+    def compressor_strategies(self):
+        return [self.instance(n)
+                for n in (self.compressor.get("strategies") or [])]
+
+
+class Compressor:
+    """Epoch-driven compression loop (reference core/compressor.py:229).
+
+    train_reader yields either dicts {feed_name: array} or tuples aligned
+    with train_feed_names.  Strategies transform context.train_program /
+    scope in their hooks; the executor recompiles on program version bumps.
+    """
+
+    def __init__(self, place, scope, train_program, startup_program=None,
+                 train_reader=None, train_feed_list=None,
+                 train_fetch_list=None, eval_program=None, eval_reader=None,
+                 eval_feed_list=None, eval_fetch_list=None, epoch=1,
+                 checkpoint_path=None, strategies=None):
+        from paddle_tpu.fluid.executor import Executor
+
+        self.context = Context(
+            place, scope, train_program, startup_program,
+            train_reader=train_reader, train_feed_names=train_feed_list,
+            train_fetch_names=train_fetch_list, eval_program=eval_program,
+            eval_reader=eval_reader, eval_feed_names=eval_feed_list,
+            eval_fetch_names=eval_fetch_list)
+        self.context.executor = Executor(place)
+        self.epoch = int(epoch)
+        self.checkpoint_path = checkpoint_path
+        self.strategies = list(strategies or [])
+
+    def config(self, config_path):
+        """Load strategies + compressor settings from a yaml file."""
+        factory = ConfigFactory(config_path)
+        self.strategies.extend(factory.compressor_strategies())
+        if "epoch" in factory.compressor:
+            self.epoch = int(factory.compressor["epoch"])
+        if "checkpoint_path" in factory.compressor:
+            self.checkpoint_path = factory.compressor["checkpoint_path"]
+        return self
+
+    # -- checkpointing ------------------------------------------------------
+
+    def _ckpt_dir(self, epoch):
+        return os.path.join(self.checkpoint_path, str(epoch))
+
+    def _save_checkpoint(self, ctx):
+        if not self.checkpoint_path:
+            return
+        from paddle_tpu.fluid import io as fio
+
+        d = self._ckpt_dir(ctx.epoch_id)
+        os.makedirs(d, exist_ok=True)
+        fio.save_persistables(ctx.executor, d, main_program=ctx.train_program,
+                              scope=ctx.scope)
+        with open(os.path.join(d, "context.json"), "w") as f:
+            json.dump({"epoch_id": ctx.epoch_id,
+                       "eval_results": ctx.eval_results}, f)
+
+    def _load_checkpoint(self, ctx):
+        """Resume from the newest epoch dir (reference _load_checkpoint)."""
+        if not self.checkpoint_path or not os.path.isdir(self.checkpoint_path):
+            return 0
+        epochs = [int(d) for d in os.listdir(self.checkpoint_path)
+                  if d.isdigit()
+                  and os.path.isdir(self._ckpt_dir(int(d)))]
+        if not epochs:
+            return 0
+        latest = max(epochs)
+        d = self._ckpt_dir(latest)
+        from paddle_tpu.fluid import io as fio
+
+        with open(os.path.join(d, "context.json")) as f:
+            meta = json.load(f)
+        ctx.epoch_id = meta["epoch_id"]
+        ctx.eval_results = meta["eval_results"]
+        # strategies FIRST: they must recreate their program state (mask
+        # vars, quant vars, program swaps) in the fresh program so that
+        # load_persistables below knows to load those vars' values
+        for s in self.strategies:
+            s.restore_from_checkpoint(ctx)
+        fio.load_persistables(ctx.executor, d, main_program=ctx.train_program,
+                              scope=ctx.scope)
+        logger.info("slim: resumed from checkpoint epoch %d", latest)
+        return latest + 1
+
+    # -- the loop -----------------------------------------------------------
+
+    def run(self):
+        from paddle_tpu.fluid.executor import scope_guard
+
+        ctx = self.context
+        with scope_guard(ctx.scope):
+            start_epoch = self._load_checkpoint(ctx)
+            for s in self.strategies:
+                s.on_compression_begin(ctx)
+            for epoch in range(start_epoch, self.epoch):
+                ctx.epoch_id = epoch
+                for s in self.strategies:
+                    s.on_epoch_begin(ctx)
+                if ctx.train_reader is not None:
+                    for bid, batch in enumerate(ctx.train_reader()):
+                        ctx.batch_id = bid
+                        for s in self.strategies:
+                            s.on_batch_begin(ctx)
+                        feed = (batch if isinstance(batch, dict)
+                                else dict(zip(ctx.train_feed_names, batch)))
+                        ctx.executor.run(ctx.train_program, feed=feed,
+                                         fetch_list=ctx.train_fetch_names)
+                        for s in self.strategies:
+                            s.on_batch_end(ctx)
+                for s in self.strategies:
+                    s.on_epoch_end(ctx)
+                ctx.eval()
+                self._save_checkpoint(ctx)
+            for s in self.strategies:
+                s.on_compression_end(ctx)
+        return ctx

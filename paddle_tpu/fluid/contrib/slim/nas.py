@@ -1,0 +1,160 @@
+"""Neural architecture search: evolutionary controller + NAS strategy.
+
+Reference analogs: contrib/slim/searcher/controller.py (SAController —
+simulated-annealing token search), slim/nas/light_nas_strategy.py +
+slim/nas/search_space.py.  The reference distributes token evaluation over
+a controller server + socket agents; here candidate evaluation is a local
+callable (the sandbox is single-host), which is the entire difference —
+the controller math and the strategy's search loop match the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+import numpy as np
+
+from .core import Strategy, register_strategy
+
+logger = logging.getLogger("paddle_tpu.slim")
+
+__all__ = ["EvolutionaryController", "SAController", "SearchSpace",
+           "LightNASStrategy"]
+
+
+class EvolutionaryController:
+    """Token-space search interface (reference searcher/controller.py:28)."""
+
+    def update(self, tokens, reward):
+        raise NotImplementedError
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        raise NotImplementedError
+
+    def next_tokens(self):
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing over integer token vectors
+    (reference controller.py:59).  tokens[i] ∈ [0, range_table[i])."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=None):
+        self._range_table = range_table
+        self._reduce_rate = float(reduce_rate)
+        self._init_temperature = float(init_temperature)
+        self._max_iter_number = int(max_iter_number)
+        self._rng = np.random.RandomState(seed)
+        self._reward = -float("inf")
+        self._tokens = None
+        self._max_reward = -float("inf")
+        self._best_tokens = None
+        self._iter = 0
+        self._constrain_func = None
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        """Accept better tokens always; worse tokens with annealed
+        probability exp(Δ/T) (reference controller.py:105)."""
+        self._iter += 1
+        temperature = self._init_temperature * self._reduce_rate ** self._iter
+        if reward > self._reward or self._rng.random_sample() <= math.exp(
+                min((reward - self._reward) / max(temperature, 1e-9), 0.0)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self):
+        """Mutate one random position (reference controller.py:126)."""
+        tokens = list(self._tokens)
+        idx = int(len(self._range_table) * self._rng.random_sample())
+        tokens[idx] = (tokens[idx]
+                       + self._rng.randint(self._range_table[idx] - 1)
+                       + 1) % self._range_table[idx]
+        if self._constrain_func is None:
+            return tokens
+        for _ in range(self._max_iter_number):
+            if self._constrain_func(tokens):
+                return tokens
+            idx = int(len(self._range_table) * self._rng.random_sample())
+            tokens = list(self._tokens)
+            tokens[idx] = self._rng.randint(self._range_table[idx])
+        return tokens
+
+
+class SearchSpace:
+    """User-defined architecture space (reference nas/search_space.py):
+    token vector ↔ model."""
+
+    def init_tokens(self):
+        raise NotImplementedError
+
+    def range_table(self):
+        raise NotImplementedError
+
+    def create_eval_func(self, tokens):
+        """Return a callable () -> reward (higher better) that builds,
+        trains briefly, and scores the architecture `tokens` encodes.
+        (The reference's counterpart builds train/eval graphs; a callable
+        keeps program construction in user land where it belongs.)"""
+        raise NotImplementedError
+
+
+@register_strategy
+class LightNASStrategy(Strategy):
+    """Search-at-compression-begin NAS (reference light_nas_strategy.py,
+    minus the controller server: evaluation is in-process).  After the
+    search, context.search_space holds (best_tokens, best_reward) and the
+    full trial history."""
+
+    def __init__(self, start_epoch=0, end_epoch=0, search_steps=20,
+                 reduce_rate=0.85, init_temperature=1024, seed=None,
+                 search_space=None):
+        super().__init__(start_epoch, end_epoch)
+        self.search_steps = int(search_steps)
+        self.controller = SAController(reduce_rate=reduce_rate,
+                                       init_temperature=init_temperature,
+                                       seed=seed)
+        self.search_space = search_space
+        self.history = []
+
+    def on_compression_begin(self, context):
+        space = self.search_space or context.search_space
+        if space is None:
+            raise ValueError(
+                "LightNASStrategy needs a SearchSpace (constructor arg or "
+                "context.search_space)")
+        init = space.init_tokens()
+        self.controller.reset(space.range_table(), init)
+        reward = space.create_eval_func(init)()
+        self.controller.update(init, reward)
+        self.history.append((list(init), reward))
+        for step in range(self.search_steps):
+            tokens = self.controller.next_tokens()
+            reward = space.create_eval_func(tokens)()
+            self.controller.update(tokens, reward)
+            self.history.append((list(tokens), reward))
+            logger.info("NAS step %d: tokens=%s reward=%.4f (best %.4f)",
+                        step, tokens, reward, self.controller.max_reward)
+        context.search_space = {
+            "best_tokens": self.controller.best_tokens,
+            "best_reward": self.controller.max_reward,
+            "history": self.history,
+        }

@@ -74,6 +74,35 @@ class Pruner:
             masks[name] = mask
         return masks
 
+    def restore_masks(self, program, params=None):
+        """Recreate mask VARIABLES in a freshly built program so a
+        checkpoint load can fill their values (resume path: the fresh
+        program has no `.prune_mask` vars, but the checkpoint does).
+        Returns the param names masks were created for."""
+        scope = self._scope()
+        block = program.global_block()
+        if params is None:
+            params = [n for n in list(block.vars)
+                      if block.var(n).persistable
+                      and not n.endswith(_MASK_SUFFIX)
+                      and not getattr(block.var(n), "is_optimizer_state",
+                                      False)
+                      and block.var(n).shape is not None
+                      and len(block.var(n).shape) >= 2]
+        for name in params:
+            mask_name = name + _MASK_SUFFIX
+            if mask_name not in block.vars:
+                v = block.var(name)
+                block.create_var(name=mask_name, shape=list(v.shape),
+                                 dtype="float32", persistable=True,
+                                 stop_gradient=True)
+            if scope.get(name + _MASK_SUFFIX) is None:
+                # placeholder until load_persistables fills the real mask
+                v = block.var(name)
+                scope.set(mask_name,
+                          np.ones([int(d) for d in v.shape], np.float32))
+        return list(params)
+
     def apply_masks(self, program, params=None):
         """Insert `param = param * mask` after each optimizer update of a
         pruned parameter so fine-tuning cannot regrow pruned weights."""

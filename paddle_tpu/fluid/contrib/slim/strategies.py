@@ -1,0 +1,176 @@
+"""Strategy plugins wiring the compression leaves into the Compressor loop.
+
+Reference analogs: contrib/slim/prune/prune_strategy.py,
+slim/quantization/quantization_strategy.py,
+slim/distillation/distillation_strategy.py.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .core import Strategy, register_strategy
+
+logger = logging.getLogger("paddle_tpu.slim")
+
+__all__ = ["PruneStrategy", "QuantizationStrategy", "DistillationStrategy"]
+
+
+@register_strategy
+class PruneStrategy(Strategy):
+    """Magnitude-prune at start_epoch, keep masks applied through
+    fine-tuning (reference prune_strategy.py — there the Pruner rewrites
+    the graph once; here prune() zeroes weights + apply_masks() pins the
+    sparsity into the optimizer step)."""
+
+    def __init__(self, start_epoch=0, end_epoch=0, ratio=0.5, params=None):
+        super().__init__(start_epoch, end_epoch)
+        self.ratio = float(ratio)
+        self.params = list(params) if params else None
+        self._done = False
+
+    def on_epoch_begin(self, context):
+        if self._done or context.epoch_id < self.start_epoch:
+            return
+        from .prune import Pruner
+
+        pruner = Pruner(ratio=self.ratio, scope=context.scope)
+        masks = pruner.prune(context.train_program, params=self.params)
+        pruner.apply_masks(context.train_program,
+                           params=list(masks))
+        self._done = True
+        logger.info("PruneStrategy: pruned %d params at ratio %.2f",
+                    len(masks), self.ratio)
+
+    def restore_from_checkpoint(self, context):
+        # the fresh program has no `.prune_mask` vars: recreate them so the
+        # Compressor's subsequent load_persistables pulls the saved masks,
+        # then pin them back into the optimizer step
+        if context.epoch_id >= self.start_epoch:
+            from .prune import Pruner
+
+            pruner = Pruner(scope=context.scope)
+            restored = pruner.restore_masks(context.train_program,
+                                            params=self.params)
+            pruner.apply_masks(context.train_program, params=restored)
+            self._done = True
+
+
+@register_strategy
+class QuantizationStrategy(Strategy):
+    """QAT: insert fake-quant ops at start_epoch, freeze to int8 weights at
+    end_epoch / compression end (reference quantization_strategy.py)."""
+
+    def __init__(self, start_epoch=0, end_epoch=0, weight_bits=8,
+                 activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__(start_epoch, end_epoch)
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits)
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+        self._applied = False
+        self._frozen = False
+
+    def on_epoch_begin(self, context):
+        if self._applied or context.epoch_id < self.start_epoch:
+            return
+        from .quantization import QuantizationTransformPass
+
+        def make_pass():
+            return QuantizationTransformPass(
+                weight_bits=self.weight_bits,
+                activation_bits=self.activation_bits,
+                weight_quantize_type=self.weight_quantize_type,
+                activation_quantize_type=self.activation_quantize_type)
+
+        make_pass().apply(context.train_program, context.startup_program)
+        # eval must measure the QUANTIZED model (reference
+        # quantization_strategy.py transforms the test graph too); the
+        # scale vars share names, so train and eval read the same scope
+        # state
+        if context.eval_program is not None:
+            make_pass().apply(context.eval_program,
+                              context.startup_program)
+        self._init_new_startup_vars(context)
+        self._applied = True
+        logger.info("QuantizationStrategy: QAT transform applied")
+
+    def _init_new_startup_vars(self, context):
+        """The transform added initializer ops to startup_program, but
+        startup already ran.  Re-run it in a THROWAWAY scope and copy over
+        only the vars missing from the live scope — exact initializer
+        semantics (Constant(1.0) scale states etc.) without touching
+        trained params."""
+        import numpy as np
+
+        from paddle_tpu.fluid.executor import Scope, scope_guard
+
+        tmp = Scope()
+        with scope_guard(tmp):
+            context.executor.run(context.startup_program)
+        for name in tmp.keys():
+            if context.scope.get(name) is None and tmp.get(name) is not None:
+                context.scope.set(name, np.asarray(tmp.get(name)))
+
+    def on_compression_end(self, context):
+        if self._applied and not self._frozen:
+            from .quantization import QuantizationFreezePass
+
+            QuantizationFreezePass(
+                scope=context.scope,
+                weight_bits=self.weight_bits).apply(context.train_program)
+            self._frozen = True
+            logger.info("QuantizationStrategy: weights frozen to int domain")
+
+
+@register_strategy
+class DistillationStrategy(Strategy):
+    """Swap the training program for a teacher-merged distillation program
+    between start_epoch and end_epoch (reference distillation_strategy.py
+    swaps graphs the same way).  The merged program must be built by the
+    caller (distiller API) and passed in."""
+
+    def __init__(self, start_epoch=0, end_epoch=0, distill_program=None):
+        """end_epoch=0 (the default) means: distill until compression ends
+        (the student program is still restored at on_compression_end so
+        checkpoints/results never carry teacher weights)."""
+        super().__init__(start_epoch, end_epoch)
+        self.distill_program = distill_program
+        self._saved = None
+
+    def _in_window(self, epoch_id):
+        if epoch_id < self.start_epoch:
+            return False
+        return not self.end_epoch or epoch_id < self.end_epoch
+
+    def on_epoch_begin(self, context):
+        # >=-window check (not ==): a checkpoint resume landing inside the
+        # window must still swap the distill program in
+        if (self.distill_program is not None and self._saved is None
+                and self._in_window(context.epoch_id)):
+            self._saved = context.train_program
+            context.train_program = self.distill_program
+            logger.info("DistillationStrategy: switched to distill program")
+
+    def _restore(self, context):
+        if self._saved is not None:
+            context.train_program = self._saved
+            self._saved = None
+            logger.info("DistillationStrategy: restored student program")
+
+    def on_epoch_end(self, context):
+        if not self._in_window(context.epoch_id + 1):
+            self._restore(context)
+
+    def on_compression_end(self, context):
+        self._restore(context)
+
+    def restore_from_checkpoint(self, context):
+        # resume inside the window: swap before load so persistables load
+        # against the distill program's variable set
+        if (self.distill_program is not None
+                and self._in_window(context.epoch_id + 1)):
+            self._saved = context.train_program
+            context.train_program = self.distill_program

@@ -1,0 +1,106 @@
+"""Device workers (reference python/paddle/fluid/device_worker.py →
+framework/device_worker.cc HogwildWorker/DownpourWorker/SectionWorker).
+
+The reference's worker is a per-thread C++ loop pulling from DataFeed and
+running ops one by one; here the per-step work is one compiled XLA
+program, so a worker picks the EXECUTION PATH for the dataset pass:
+
+  Hogwild     — plain prefetch loop (lock-free parallel ingestion; the
+                single device step is the atomic unit, so "hogwild"
+                parallelism lives in the parser/prefetch threads)
+  DownpourSGD — same loop over a PS-transpiled program (host send/recv
+                ops push grads / pull params around the device step)
+  Section     — GPipe pipeline schedule via PipelineRunner
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DeviceWorker", "Hogwild", "DownpourSGD", "Section"]
+
+
+class DeviceWorker:
+    def __init__(self):
+        self._trainer = None
+        self._infer = False
+        self._fleet_desc = None
+        self._program = None
+
+    def _set_trainer(self, trainer):
+        self._trainer = trainer
+
+    def _set_infer(self, infer=False):
+        self._infer = bool(infer)
+
+    def _set_fleet_desc(self, fleet_desc):
+        self._fleet_desc = fleet_desc
+
+    def _set_program(self, program):
+        self._program = program
+
+    def _run_pass(self, executor, program, dataset, scope, fetch_list,
+                  fetch_info, print_period, debug):
+        raise NotImplementedError
+
+
+class Hogwild(DeviceWorker):
+    def _run_pass(self, executor, program, dataset, scope, fetch_list,
+                  fetch_info, print_period, debug):
+        return executor._dataset_step_loop(
+            program, dataset, scope, fetch_list=fetch_list,
+            fetch_info=fetch_info, print_period=print_period, debug=debug)
+
+
+class DownpourSGD(DeviceWorker):
+    """PS worker: the program must carry PS host ops (send/recv) from the
+    DistributeTranspiler — the loop itself is Hogwild's (host ops run
+    around the jitted step in program order)."""
+
+    def _run_pass(self, executor, program, dataset, scope, fetch_list,
+                  fetch_info, print_period, debug):
+        prog = program
+        from . import compiler as _compiler
+
+        if isinstance(prog, _compiler.CompiledProgram):
+            prog = prog._program
+        ops = prog.global_block().ops
+        if not any(op.type in ("send", "send_sparse", "recv", "send_barrier")
+                   for op in ops):
+            logger.warning(
+                "DownpourSGD worker on a program with no PS send/recv ops — "
+                "did you run the DistributeTranspiler?")
+        return executor._dataset_step_loop(
+            program, dataset, scope, fetch_list=fetch_list,
+            fetch_info=fetch_info, print_period=print_period, debug=debug)
+
+
+class Section(DeviceWorker):
+    """Pipeline section worker (reference SectionWorker): runs each batch
+    through the GPipe PipelineRunner — the program must have been through
+    PipelineOptimizer.minimize."""
+
+    def _run_pass(self, executor, program, dataset, scope, fetch_list,
+                  fetch_info, print_period, debug):
+        import numpy as np
+
+        from .executor import global_scope, scope_guard
+        from paddle_tpu.parallel import PipelineRunner
+
+        scope = scope if scope is not None else global_scope()
+        runner = PipelineRunner(program, scope=scope)
+        fetch_names = [f if isinstance(f, str) else f.name
+                       for f in (fetch_list or [])]
+        results = []
+        with scope_guard(scope):
+            for i, batch in enumerate(dataset._iter_batches()):
+                out = runner.run(feed=batch, fetch_list=fetch_names)
+                if debug and fetch_names and i % print_period == 0:
+                    names = fetch_info or fetch_names
+                    logger.info("pipeline step %d: %s", i,
+                                {n: float(np.asarray(v).mean())
+                                 for n, v in zip(names, out)})
+                results = out
+        return results

@@ -657,7 +657,37 @@ class Executor:
         self, program=None, dataset=None, scope=None, thread=0,
         debug=False, fetch_list=None, fetch_info=None, print_period=100,
     ):
-        """Step over a Dataset with ingestion OVERLAPPED with device steps
+        """Step over a Dataset via the trainer/device-worker layer
+        (reference executor.py:815 _prepare_trainer → TrainerFactory →
+        C++ trainer threads).  The trainer class comes from
+        ``program._fleet_opt`` ({"trainer": ..., "device_worker": ...});
+        default is MultiTrainer+Hogwild = the prefetch loop below."""
+        from .trainer_factory import TrainerFactory
+
+        from . import compiler as _compiler
+
+        if dataset is None:
+            raise ValueError("dataset is required")
+        program_ = program if program is not None \
+            else framework.default_main_program()
+        raw = (program_._program
+               if isinstance(program_, _compiler.CompiledProgram)
+               else program_)
+        opt_info = getattr(raw, "_fleet_opt", None)
+        trainer = TrainerFactory()._create_trainer(opt_info)
+        trainer._set_program(program_)
+        if thread:
+            trainer._set_thread(thread)
+        trainer._set_debug(debug)
+        trainer._set_fetch_var_and_info(fetch_list, fetch_info, print_period)
+        return trainer._run(self, program_, dataset, scope,
+                            fetch_list=fetch_list)
+
+    def _dataset_step_loop(
+        self, program=None, dataset=None, scope=None,
+        debug=False, fetch_list=None, fetch_info=None, print_period=100,
+    ):
+        """The Hogwild/Downpour step path: ingestion OVERLAPPED with steps
         (reference multi_trainer.cc + buffered_reader.cc double-buffering):
         a reader thread drains the native parser queue, coerces dtypes and
         device_puts each batch ahead, buffering 2 batches (override the
@@ -706,7 +736,9 @@ class Executor:
                                fetch_list=fetch_list, scope=scope)
                 steps += 1
                 if debug and fetch_list and i % print_period == 0:
-                    names = fetch_info or [f.name for f in fetch_list]
+                    names = fetch_info or [
+                        f if isinstance(f, str) else f.name
+                        for f in fetch_list]
                     logger.info("step %d: %s", i, dict(zip(names, res)))
         finally:
             if pf is not None:

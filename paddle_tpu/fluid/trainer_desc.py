@@ -1,0 +1,104 @@
+"""Trainer descriptors (reference python/paddle/fluid/trainer_desc.py →
+C++ trainer_desc.proto + framework/multi_trainer.cc).
+
+The reference generates a TrainerDesc proto that configures C++ trainer
+threads, each owning a DeviceWorker.  TPU-native redesign: the "worker"
+loop is `Executor.train_from_dataset`'s prefetch pipeline (the device step
+is ONE XLA program; host concurrency lives in the native parser threads +
+the prefetch thread), so a TrainerDesc here CONFIGURES that loop — thread
+count routes to parser threads, the device worker picks the execution
+path (plain step / PS-host-op step / pipeline).
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TrainerDesc", "MultiTrainer", "DistMultiTrainer",
+           "PipelineTrainer"]
+
+
+class TrainerDesc:
+    def __init__(self):
+        self._fetch_vars = []
+        self._fetch_info = []
+        self._print_period = 100
+        self._debug = False
+        self._thread_num = 1
+        self._thread_set = False  # only override dataset threads if set
+        self._device_worker = None
+        self._infer = False
+        self._fleet_desc = None
+        self._program = None
+
+    def _set_fetch_var_and_info(self, fetch_vars, fetch_info, print_period):
+        self._fetch_vars = list(fetch_vars or [])
+        self._fetch_info = list(fetch_info or [])
+        self._print_period = print_period
+
+    def _set_debug(self, debug):
+        self._debug = bool(debug)
+
+    def _set_thread(self, thread_num):
+        self._thread_num = max(1, int(thread_num))
+        self._thread_set = True
+
+    def _set_device_worker(self, device_worker):
+        self._device_worker = device_worker
+        device_worker._set_trainer(self)
+
+    def _set_infer(self, infer):
+        self._infer = bool(infer)
+
+    def _set_fleet_desc(self, fleet_desc):
+        self._fleet_desc = fleet_desc
+
+    def _set_program(self, program):
+        self._program = program
+
+    def _desc(self):
+        return {
+            "class": type(self).__name__,
+            "device_worker": type(self._device_worker).__name__
+            if self._device_worker else None,
+            "thread_num": self._thread_num,
+            "debug": self._debug,
+            "infer": self._infer,
+        }
+
+    def __str__(self):
+        return str(self._desc())
+
+    # -- execution ---------------------------------------------------------
+
+    def _run(self, executor, program, dataset, scope, fetch_list=None):
+        """Drive one pass of `dataset` through `program`.  The base loop
+        delegates to the device worker's step path."""
+        if self._device_worker is None:
+            raise RuntimeError("trainer has no device worker")
+        if self._thread_set:  # never clobber a user-set dataset thread count
+            dataset.set_thread(self._thread_num)
+        return self._device_worker._run_pass(
+            executor, program, dataset, scope,
+            fetch_list=fetch_list or self._fetch_vars,
+            fetch_info=self._fetch_info, print_period=self._print_period,
+            debug=self._debug)
+
+
+class MultiTrainer(TrainerDesc):
+    """Local multi-thread trainer (reference multi_trainer.cc): N parser
+    threads + prefetch feeding the single compiled device step."""
+
+
+class DistMultiTrainer(TrainerDesc):
+    """PS-distributed trainer (reference dist_multi_trainer.cc): the
+    transpiled program's host ops (send/recv) do the PS communication, so
+    the loop body is identical — the DownpourSGD worker asserts the
+    program was transpiled."""
+
+
+class PipelineTrainer(TrainerDesc):
+    """Pipeline trainer (reference pipeline_trainer.cc): runs the program
+    through the GPipe PipelineRunner (Section worker)."""

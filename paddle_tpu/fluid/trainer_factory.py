@@ -1,0 +1,39 @@
+"""TrainerFactory (reference python/paddle/fluid/trainer_factory.py):
+builds the trainer + device-worker pair from a program's opt_info.
+"""
+
+from __future__ import annotations
+
+from .device_worker import DownpourSGD, Hogwild, Section
+from .trainer_desc import DistMultiTrainer, MultiTrainer, PipelineTrainer
+
+__all__ = ["TrainerFactory"]
+
+_TRAINERS = {c.__name__: c for c in (MultiTrainer, DistMultiTrainer,
+                                     PipelineTrainer)}
+_WORKERS = {c.__name__: c for c in (Hogwild, DownpourSGD, Section)}
+
+
+class TrainerFactory:
+    def _create_trainer(self, opt_info=None):
+        if not opt_info:
+            trainer = MultiTrainer()
+            trainer._set_device_worker(Hogwild())
+            return trainer
+        tname = opt_info.get("trainer", "MultiTrainer")
+        wname = opt_info.get("device_worker", "Hogwild")
+        if tname not in _TRAINERS:
+            raise ValueError(f"unknown trainer {tname!r}; "
+                             f"choose from {sorted(_TRAINERS)}")
+        if wname not in _WORKERS:
+            raise ValueError(f"unknown device worker {wname!r}; "
+                             f"choose from {sorted(_WORKERS)}")
+        trainer = _TRAINERS[tname]()
+        worker = _WORKERS[wname]()
+        if "fleet_desc" in opt_info:
+            worker._set_fleet_desc(opt_info["fleet_desc"])
+            trainer._set_fleet_desc(opt_info["fleet_desc"])
+        trainer._set_device_worker(worker)
+        if "thread" in opt_info:
+            trainer._set_thread(opt_info["thread"])
+        return trainer

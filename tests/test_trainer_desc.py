@@ -1,0 +1,124 @@
+"""Trainer/DeviceWorker config layer (reference trainer_desc.py,
+device_worker.py, trainer_factory.py → multi_trainer.cc/device_worker.cc):
+program._fleet_opt selects the trainer + worker; Section runs the pipeline
+path.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.device_worker import DownpourSGD, Hogwild, Section
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.fluid.trainer_desc import (DistMultiTrainer, MultiTrainer,
+                                           PipelineTrainer)
+from paddle_tpu.fluid.trainer_factory import TrainerFactory
+
+
+def _write_data(tmp_path, n=128):
+    rng = np.random.RandomState(0)
+    p = str(tmp_path / "train.txt")
+    with open(p, "w") as f:
+        for _ in range(n):
+            x = rng.uniform(-1, 1, 4)
+            y = 1 if x.sum() > 0 else 0
+            f.write("4 " + " ".join(f"{v:.5f}" for v in x) + f" 1 {y}\n")
+    return p
+
+
+def _dataset(p, xvar, yvar, batch=32):
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(batch)
+    ds.set_use_var([xvar, yvar])
+    ds.set_filelist([p])
+    return ds
+
+
+def test_factory_defaults_and_selection():
+    t = TrainerFactory()._create_trainer(None)
+    assert isinstance(t, MultiTrainer)
+    assert isinstance(t._device_worker, Hogwild)
+    t2 = TrainerFactory()._create_trainer(
+        {"trainer": "DistMultiTrainer", "device_worker": "DownpourSGD",
+         "thread": 4})
+    assert isinstance(t2, DistMultiTrainer)
+    assert isinstance(t2._device_worker, DownpourSGD)
+    assert t2._thread_num == 4
+    assert t2._desc()["device_worker"] == "DownpourSGD"
+    with pytest.raises(ValueError, match="unknown trainer"):
+        TrainerFactory()._create_trainer({"trainer": "Nope"})
+
+
+def test_fleet_opt_routes_trainer(tmp_path):
+    """program._fleet_opt picks DistMultiTrainer+DownpourSGD; training still
+    works (the PS warning fires since no transpile ran — loop is shared)."""
+    p = _write_data(tmp_path)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        sm = fluid.layers.softmax(fluid.layers.fc(x, size=2))
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(sm, y))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    main._fleet_opt = {"trainer": "DistMultiTrainer",
+                       "device_worker": "DownpourSGD", "thread": 2}
+    ds = _dataset(p, main.global_block().var("x"),
+                  main.global_block().var("y"))
+    s = Scope()
+    with scope_guard(s):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.asarray(s.get("fc_0.w_0")).copy()
+        for _ in range(3):
+            exe.train_from_dataset(program=main, dataset=ds)
+        w1 = np.asarray(s.get("fc_0.w_0"))
+    assert not np.allclose(w0, w1)  # it trained
+    assert ds._thread == 2  # trainer thread count reached the dataset
+
+
+def test_pipeline_trainer_section_worker(tmp_path):
+    """PipelineTrainer+Section drives the dataset through the GPipe
+    runner."""
+    p = _write_data(tmp_path, n=64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        yf = fluid.layers.cast(y, "float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, yf))
+        fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.05), cut_list=[[h]],
+            num_microbatches=4).minimize(loss)
+    main._fleet_opt = {"trainer": "PipelineTrainer",
+                       "device_worker": "Section"}
+    ds = _dataset(p, main.global_block().var("x"),
+                  main.global_block().var("y"), batch=32)
+    s = Scope()
+    with scope_guard(s):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out = exe.train_from_dataset(program=main, dataset=ds,
+                                     fetch_list=[loss.name])
+    assert out and np.isfinite(float(np.asarray(out[0])))
+
+
+def test_user_dataset_thread_not_clobbered(tmp_path):
+    p = _write_data(tmp_path, n=64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        sm = fluid.layers.softmax(fluid.layers.fc(x, size=2))
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(sm, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    ds = _dataset(p, main.global_block().var("x"),
+                  main.global_block().var("y"))
+    ds.set_thread(8)
+    s = Scope()
+    with scope_guard(s):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.train_from_dataset(program=main, dataset=ds)  # no thread arg
+    assert ds._thread == 8  # untouched

@@ -12,7 +12,8 @@ from .layers import Layer  # noqa: F401
 from .nn import (FC, BatchNorm, BilinearTensorProduct, Conv2D,  # noqa: F401
                  Conv2DTranspose, Conv3D, Conv3DTranspose, Dropout,
                  Embedding, GroupNorm, GRUUnit, LayerNorm, Linear, NCE,
-                 Pool2D, PRelu, SpectralNorm, TreeConv)
+                 Pool2D, PRelu, RowConv, SequenceConv, SpectralNorm,
+                 TreeConv)
 from .parallel import DataParallel, Env, ParallelEnv, prepare_context  # noqa: F401
 from .tracer import Tracer, VarBase, trace_op  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
@@ -46,7 +47,7 @@ __all__ = [
     "nn", "Linear", "FC", "Conv2D", "Conv2DTranspose", "Conv3D",
     "Conv3DTranspose", "Pool2D", "BatchNorm", "Embedding", "LayerNorm",
     "Dropout", "GRUUnit", "PRelu", "GroupNorm", "BilinearTensorProduct",
-    "SpectralNorm", "TreeConv", "NCE",
+    "SpectralNorm", "TreeConv", "NCE", "SequenceConv", "RowConv",
     "CosineDecay", "ExponentialDecay", "InverseTimeDecay", "NaturalExpDecay",
     "NoamDecay", "PiecewiseDecay", "PolynomialDecay",
 ]

@@ -1043,6 +1043,10 @@ def group_norm_(*a, **k):
 def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                   padding=True, bias_attr=None, param_attr=None, act=None,
                   name=None, length=None):
+    if filter_stride != 1:
+        raise ValueError(
+            "sequence_conv supports contextStride == 1 only (same "
+            "restriction as the reference sequence_conv_op.cc)")
     helper = LayerHelper("sequence_conv", act=act, name=name, size=num_filters,
                          bias_attr=bias_attr)
     d = input.shape[-1]

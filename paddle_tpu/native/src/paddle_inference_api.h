@@ -1,0 +1,130 @@
+// C++ convenience API over the native inference runtime.
+//
+// Reference analog: paddle/fluid/inference/api/paddle_inference_api.h —
+// CreatePaddlePredictor<AnalysisConfig>() / PaddlePredictor::Run over
+// PaddleTensor.  This header keeps the same usage shape on top of the
+// pti_* C ABI (native_api.h) so demo_ci-style C++ programs port directly:
+//
+//   AnalysisConfig cfg(model_dir);            // or (model_dir, params_file)
+//   auto pred = CreatePaddlePredictor(cfg);
+//   std::vector<PaddleTensor> in{...}, out;
+//   pred->Run(in, &out);
+//
+// Header-only; link infer_runtime.cc.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "native_api.h"
+
+namespace paddle_tpu {
+
+enum class PaddleDType { FLOAT32 = 0, INT64 = 1 };
+
+struct PaddleTensor {
+  std::string name;
+  std::vector<int64_t> shape;
+  std::vector<float> f32;   // payload when dtype == FLOAT32
+  std::vector<int64_t> i64; // payload when dtype == INT64
+  PaddleDType dtype = PaddleDType::FLOAT32;
+};
+
+struct AnalysisConfig {
+  std::string model_dir;
+  std::string params_file;  // empty → per-var param files
+
+  AnalysisConfig() = default;
+  explicit AnalysisConfig(std::string dir) : model_dir(std::move(dir)) {}
+  AnalysisConfig(std::string dir, std::string params)
+      : model_dir(std::move(dir)), params_file(std::move(params)) {}
+};
+
+class PaddlePredictor {
+ public:
+  explicit PaddlePredictor(const AnalysisConfig& cfg) {
+    h_ = pti_create(cfg.model_dir.c_str(),
+                    cfg.params_file.empty() ? nullptr
+                                            : cfg.params_file.c_str());
+    const char* err = pti_error(h_);
+    if (err && err[0]) {
+      std::string msg(err);
+      pti_free(h_);
+      h_ = nullptr;
+      throw std::runtime_error("PaddlePredictor: " + msg);
+    }
+  }
+
+  ~PaddlePredictor() {
+    if (h_) pti_free(h_);
+  }
+
+  PaddlePredictor(const PaddlePredictor&) = delete;
+  PaddlePredictor& operator=(const PaddlePredictor&) = delete;
+
+  std::vector<std::string> GetInputNames() const {
+    std::vector<std::string> out;
+    for (int i = 0; i < pti_num_inputs(h_); ++i)
+      out.emplace_back(pti_input_name(h_, i));
+    return out;
+  }
+
+  std::vector<std::string> GetOutputNames() const {
+    std::vector<std::string> out;
+    for (int i = 0; i < pti_num_outputs(h_); ++i)
+      out.emplace_back(pti_output_name(h_, i));
+    return out;
+  }
+
+  bool Run(const std::vector<PaddleTensor>& inputs,
+           std::vector<PaddleTensor>* outputs) {
+    for (const auto& t : inputs) {
+      const void* data = t.dtype == PaddleDType::FLOAT32
+                             ? static_cast<const void*>(t.f32.data())
+                             : static_cast<const void*>(t.i64.data());
+      pti_set_input(h_, t.name.c_str(), data, t.shape.data(),
+                    static_cast<int>(t.shape.size()),
+                    static_cast<int>(t.dtype));
+    }
+    if (pti_run(h_) != 0) return false;
+    outputs->clear();
+    for (const auto& name : GetOutputNames()) {
+      const void* data;
+      const int64_t* dims;
+      int ndims, dtype;
+      int64_t n = pti_get_output(h_, name.c_str(), &data, &dims, &ndims,
+                                 &dtype);
+      if (n < 0) return false;
+      PaddleTensor t;
+      t.name = name;
+      t.shape.assign(dims, dims + ndims);
+      t.dtype = static_cast<PaddleDType>(dtype);
+      if (t.dtype == PaddleDType::FLOAT32) {
+        t.f32.resize(n);
+        memcpy(t.f32.data(), data, n * sizeof(float));
+      } else {
+        t.i64.resize(n);
+        memcpy(t.i64.data(), data, n * sizeof(int64_t));
+      }
+      outputs->push_back(std::move(t));
+    }
+    return true;
+  }
+
+  const char* error() const { return pti_error(h_); }
+
+ private:
+  void* h_ = nullptr;
+};
+
+inline std::unique_ptr<PaddlePredictor> CreatePaddlePredictor(
+    const AnalysisConfig& config) {
+  return std::make_unique<PaddlePredictor>(config);
+}
+
+}  // namespace paddle_tpu

@@ -204,3 +204,50 @@ def test_run_error_not_sticky(tmp_path):
     got = p.run({"x": np.ones((2, 4), "float32")})  # recovers
     assert got[0].shape == (2, 2)
     p.close()
+
+
+def test_cpp_api_header(tmp_path):
+    """The reference-style C++ API (paddle_inference_api.h:
+    CreatePaddlePredictor / PaddleTensor / Run) compiles and serves."""
+    import os
+    import subprocess
+
+    def build():
+        x = fluid.data("x", [-1, 6], False, dtype="float32")
+        out = fluid.layers.fc(x, size=3, act="softmax")
+        return [x], [out]
+
+    x_data = (0.1 * np.arange(12, dtype="float32")).reshape(2, 6)
+    ref = _save_model(tmp_path / "model", build, {"x": x_data})
+
+    cpp = tmp_path / "use_api.cc"
+    cpp.write_text(r'''
+#include <cstdio>
+#include "paddle_inference_api.h"
+using namespace paddle_tpu;
+int main(int argc, char** argv) {
+  auto pred = CreatePaddlePredictor(AnalysisConfig(argv[1]));
+  PaddleTensor in;
+  in.name = pred->GetInputNames()[0];
+  in.shape = {2, 6};
+  for (int i = 0; i < 12; ++i) in.f32.push_back(0.1f * i);
+  std::vector<PaddleTensor> outs;
+  if (!pred->Run({in}, &outs)) { fprintf(stderr, "%s\n", pred->error()); return 1; }
+  printf("out");
+  for (float v : outs[0].f32) printf(" %.6f", v);
+  printf("\nCPP_API_OK\n");
+  return 0;
+}
+''')
+    exe_path = str(tmp_path / "use_api")
+    bp = subprocess.run(
+        ["g++", *native.CXX_BASE_FLAGS, "-I", native._SRC_DIR, str(cpp),
+         os.path.join(native._SRC_DIR, "infer_runtime.cc"), "-o", exe_path],
+        capture_output=True, text=True, timeout=300)
+    assert bp.returncode == 0, bp.stderr[-3000:]
+    rp = subprocess.run([exe_path, str(tmp_path / "model")],
+                        capture_output=True, text=True, timeout=60)
+    assert rp.returncode == 0, rp.stderr[-2000:]
+    assert "CPP_API_OK" in rp.stdout
+    vals = [float(v) for v in rp.stdout.splitlines()[0].split()[1:]]
+    np.testing.assert_allclose(vals, ref[0].ravel(), rtol=1e-4, atol=1e-5)

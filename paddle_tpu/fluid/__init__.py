@@ -27,6 +27,8 @@ from . import regularizer  # noqa: F401
 from . import clip  # noqa: F401
 from .layer_helper import LayerHelper  # noqa: F401
 from . import nets  # noqa: F401
+from . import average  # noqa: F401
+from . import evaluator  # noqa: F401
 from . import compiler  # noqa: F401
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
 from . import io  # noqa: F401

@@ -331,6 +331,15 @@ class BlockPlan:
                                 if n not in host_out]
         bad_fetch = [n for n in self.fetch_names
                      if n not in produced and n not in host_out]
+        # a fetch no op produces but that LIVES in the scope is a plain
+        # scope read (reference: fetch ops read any scope var — e.g. the
+        # Evaluator pattern fetches accumulated state through an op-less
+        # eval program)
+        rescued = [n for n in bad_fetch if scope.get(n) is not None]
+        if rescued:
+            scope_reads.extend(rescued)
+            produced.update(rescued)
+            bad_fetch = [n for n in bad_fetch if n not in rescued]
         if bad_fetch:
             raise ValueError(
                 f"fetch target(s) {bad_fetch} are not produced by this program "
@@ -504,7 +513,15 @@ class _CompiledBlock:
                 donated[n] = jax.device_put(v, device)
             readonly = {}
             for n in self.readonly_names:
-                readonly[n] = jax.device_put(scope.get(n), device)
+                v = scope.get(n)
+                if v is None:
+                    # a cached plan may have classified n as a scope read
+                    # (e.g. fetch-of-scope-var rescue) against a scope that
+                    # held it; fail with the var's NAME, not a jax TypeError
+                    raise ValueError(
+                        f"variable {n!r} is read by this program but absent "
+                        "from the current scope")
+                readonly[n] = jax.device_put(v, device)
             feed_vals = {k: jax.device_put(v, device) for k, v in feeds.items()}
             with warnings.catch_warnings():
                 warnings.simplefilter("ignore")  # donation unsupported on CPU backend

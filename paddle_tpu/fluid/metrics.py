@@ -177,3 +177,154 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class ChunkEvaluator(MetricBase):
+    """Streaming chunking precision/recall/F1 (reference metrics.py:410):
+    feed per-batch chunk counts from layers.chunk_eval."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        def _to_int(v):
+            return int(np.asarray(v).reshape(-1)[0])
+
+        self.num_infer_chunks += _to_int(num_infer_chunks)
+        self.num_label_chunks += _to_int(num_label_chunks)
+        self.num_correct_chunks += _to_int(num_correct_chunks)
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision for detection (reference metrics.py:695 — a
+    graph helper over the detection_map op).  TPU-native deviation
+    (PARITY.md): HOST-side accumulation — detections and ground truth are
+    numpy on the host after the fetch anyway, and VOC mAP is a sort-heavy
+    scalar reduction with data-dependent shapes that XLA would serialize.
+
+    update() per image:
+      detections: [M, 6] (label, score, xmin, ymin, xmax, ymax)
+      gt_boxes:   [N, 4]
+      gt_labels:  [N]
+      difficult:  optional [N] bool (difficult GT is excluded, VOC-style)
+    eval(map_type): 'integral' (VOC2010 AUC) or '11point'.
+    """
+
+    def __init__(self, name=None, overlap_threshold=0.5,
+                 evaluate_difficult=False, class_num=None):
+        """class_num (optional): when given, update() validates every
+        label against [0, class_num) — mAP still averages over classes
+        with ground truth, the VOC convention."""
+        super().__init__(name)
+        self.overlap_threshold = float(overlap_threshold)
+        self.evaluate_difficult = bool(evaluate_difficult)
+        self.class_num = int(class_num) if class_num is not None else None
+        self.reset()
+
+    def reset(self):
+        self._dets = []   # (img_id, label, score, box)
+        self._gts = []    # (img_id, label, box, difficult)
+        self._img = 0
+
+    def update(self, detections, gt_boxes, gt_labels, difficult=None):
+        detections = np.asarray(detections, "float64").reshape(-1, 6)
+        gt_boxes = np.asarray(gt_boxes, "float64").reshape(-1, 4)
+        gt_labels = np.asarray(gt_labels).reshape(-1).astype(int)
+        if difficult is None:
+            difficult = np.zeros(len(gt_labels), bool)
+        else:
+            difficult = np.asarray(difficult).reshape(-1).astype(bool)
+        if not (len(gt_boxes) == len(gt_labels) == len(difficult)):
+            raise ValueError(
+                f"gt_boxes({len(gt_boxes)}) / gt_labels({len(gt_labels)}) / "
+                f"difficult({len(difficult)}) lengths disagree")
+        if self.class_num is not None:
+            bad = gt_labels[(gt_labels < 0) | (gt_labels >= self.class_num)]
+            if bad.size or (detections.size and (
+                    (detections[:, 0] < 0)
+                    | (detections[:, 0] >= self.class_num)).any()):
+                raise ValueError(
+                    f"label outside [0, {self.class_num}) in update()")
+        for d in detections:
+            self._dets.append((self._img, int(d[0]), float(d[1]), d[2:6]))
+        for box, lbl, diff in zip(gt_boxes, gt_labels, difficult):
+            self._gts.append((self._img, int(lbl), box, bool(diff)))
+        self._img += 1
+
+    @staticmethod
+    def _iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def _ap(self, recalls, precisions, map_type):
+        if map_type == "11point":
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = precisions[recalls >= t]
+                ap += (p.max() if p.size else 0.0) / 11.0
+            return ap
+        # integral (VOC2010): area under the monotone precision envelope
+        mrec = np.concatenate([[0.0], recalls, [1.0]])
+        mpre = np.concatenate([[0.0], precisions, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = np.nonzero(mrec[1:] != mrec[:-1])[0]
+        return float(((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]).sum())
+
+    def eval(self, map_type="integral"):
+        if map_type not in ("integral", "11point"):
+            raise ValueError("map_type must be 'integral' or '11point'")
+        labels = sorted({g[1] for g in self._gts}
+                        | {d[1] for d in self._dets})
+        aps = []
+        for lbl in labels:
+            gts = [g for g in self._gts if g[1] == lbl]
+            npos = sum(1 for g in gts
+                       if self.evaluate_difficult or not g[3])
+            dets = sorted((d for d in self._dets if d[1] == lbl),
+                          key=lambda d: -d[2])
+            matched = set()
+            tp = np.zeros(len(dets))
+            fp = np.zeros(len(dets))
+            for i, (img, _, _score, box) in enumerate(dets):
+                cands = [(j, g) for j, g in enumerate(gts) if g[0] == img]
+                best, best_iou = None, self.overlap_threshold
+                for j, g in cands:
+                    iou = self._iou(box, g[2])
+                    if iou >= best_iou:
+                        best, best_iou = j, iou
+                if best is None:
+                    fp[i] = 1
+                elif not self.evaluate_difficult and gts[best][3]:
+                    pass  # difficult GT: ignore the detection entirely
+                elif best in matched:
+                    fp[i] = 1
+                else:
+                    matched.add(best)
+                    tp[i] = 1
+            if npos == 0:
+                continue
+            ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+            recalls = ctp / npos
+            precisions = ctp / np.maximum(ctp + cfp, 1e-12)
+            aps.append(self._ap(recalls, precisions, map_type))
+        return float(np.mean(aps)) if aps else 0.0

@@ -1,0 +1,164 @@
+"""Legacy Evaluator / average / new metrics classes (reference
+evaluator.py, average.py, metrics.py ChunkEvaluator + DetectionMAP).
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+
+def test_weighted_average():
+    wa = fluid.average.WeightedAverage()
+    with pytest.raises(ValueError):
+        wa.eval()
+    wa.add(2.0, weight=1)
+    wa.add(np.array([4.0, 6.0]), weight=3)  # mean 5 at weight 3
+    np.testing.assert_allclose(wa.eval(), (2.0 + 15.0) / 4.0)
+    wa.reset()
+    wa.add(1.0, 2)
+    np.testing.assert_allclose(wa.eval(), 1.0)
+
+
+def test_chunk_evaluator_graph_state():
+    """Graph-state ChunkEvaluator accumulates across batches and resets
+    (IOB scheme, 1 chunk type: tags B=0, I=1, O=2)."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        inf = fluid.data("inf", [-1, 6], False, dtype="int64")
+        lab = fluid.data("lab", [-1, 6], False, dtype="int64")
+        with pytest.warns(Warning):
+            ev = fluid.evaluator.ChunkEvaluator(
+                inf, lab, chunk_scheme="IOB", num_chunk_types=1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ev.reset(exe)
+        # batch 1: perfect match, one chunk [B I] per row
+        seq = np.array([[0, 1, 2, 2, 2, 2]], dtype="int64")
+        exe.run(main, feed={"inf": seq, "lab": seq},
+                fetch_list=[m.name for m in ev.metrics])
+        # batch 2: inference misses the chunk entirely
+        o = np.full((1, 6), 2, dtype="int64")
+        exe.run(main, feed={"inf": o, "lab": seq},
+                fetch_list=[m.name for m in ev.metrics])
+        precision, recall, f1 = ev.eval(exe)
+    # 2 label chunks, 1 inferred, 1 correct
+    np.testing.assert_allclose(precision, [1.0])
+    np.testing.assert_allclose(recall, [0.5])
+    np.testing.assert_allclose(f1, [2 * 1.0 * 0.5 / 1.5], rtol=1e-6)
+
+
+def test_metrics_chunk_evaluator():
+    m = fluid.metrics.ChunkEvaluator()
+    m.update(3, 4, 2)
+    m.update(1, 1, 1)
+    p, r, f1 = m.eval()
+    np.testing.assert_allclose(p, 3 / 4)
+    np.testing.assert_allclose(r, 3 / 5)
+    np.testing.assert_allclose(f1, 2 * (3 / 4) * (3 / 5) / (3 / 4 + 3 / 5))
+
+
+def test_detection_map_perfect_and_miss():
+    m = fluid.metrics.DetectionMAP(overlap_threshold=0.5)
+    # image 0: one GT of class 1, one perfect detection
+    m.update(detections=[[1, 0.9, 10, 10, 20, 20]],
+             gt_boxes=[[10, 10, 20, 20]], gt_labels=[1])
+    # image 1: one GT of class 1, detection misses (no overlap)
+    m.update(detections=[[1, 0.8, 50, 50, 60, 60]],
+             gt_boxes=[[0, 0, 10, 10]], gt_labels=[1])
+    # AP: ranked dets -> [tp, fp], npos=2 → precision 1, 0.5; recall .5, .5
+    ap = m.eval("integral")
+    np.testing.assert_allclose(ap, 0.5, atol=1e-6)
+    ap11 = m.eval("11point")
+    assert 0.4 < ap11 < 0.6
+    m.reset()
+    assert m.eval() == 0.0
+
+
+def test_detection_map_duplicate_detection_is_fp():
+    m = fluid.metrics.DetectionMAP()
+    m.update(detections=[[0, 0.9, 0, 0, 10, 10], [0, 0.8, 1, 1, 10, 10]],
+             gt_boxes=[[0, 0, 10, 10]], gt_labels=[0])
+    # second detection matches the same (already-claimed) GT → FP
+    ap = m.eval("integral")
+    np.testing.assert_allclose(ap, 1.0)  # recall 1 reached at precision 1
+
+
+def test_edit_distance_evaluator_graph_state():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        hyp = fluid.data("hyp", [-1, 4], False, dtype="int64")
+        ref = fluid.data("ref", [-1, 4], False, dtype="int64")
+        with pytest.warns(Warning):
+            ev = fluid.evaluator.EditDistance(hyp, ref)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ev.reset(exe)
+        a = np.array([[1, 2, 3, 4]], dtype="int64")
+        b = np.array([[1, 2, 9, 4]], dtype="int64")
+        exe.run(main, feed={"hyp": a, "ref": a},
+                fetch_list=[m.name for m in ev.metrics])  # distance 0
+        exe.run(main, feed={"hyp": a, "ref": b},
+                fetch_list=[m.name for m in ev.metrics])  # distance 1
+        avg_dist, avg_err = ev.eval(exe)
+    np.testing.assert_allclose(avg_dist, [0.5])
+    np.testing.assert_allclose(avg_err, [0.5])
+
+
+def test_detection_map_validates_lengths_and_classnum():
+    m = fluid.metrics.DetectionMAP(class_num=3)
+    with pytest.raises(ValueError, match="lengths disagree"):
+        m.update(detections=[], gt_boxes=[[0, 0, 1, 1], [0, 0, 2, 2]],
+                 gt_labels=[1, 1], difficult=[False])
+    with pytest.raises(ValueError, match="label outside"):
+        m.update(detections=[[5, 0.9, 0, 0, 1, 1]],
+                 gt_boxes=[[0, 0, 1, 1]], gt_labels=[1])
+
+
+def test_evaluator_side_programs_are_memoized():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        inf = fluid.data("inf", [-1, 6], False, dtype="int64")
+        lab = fluid.data("lab", [-1, 6], False, dtype="int64")
+        with pytest.warns(Warning):
+            ev = fluid.evaluator.ChunkEvaluator(
+                inf, lab, chunk_scheme="IOB", num_chunk_types=1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(3):
+            ev.reset(exe)
+            ev.eval(exe)
+        # one reset program + one eval program, reused across epochs
+        assert ev._reset_program is not None and ev._eval_program is not None
+        n_cached = len([k for k in exe._cache if not isinstance(k, tuple)
+                        or k[-1] != "pin"])
+        # startup + reset + eval = 3 compiled blocks, NOT 1 + 2*epochs
+        assert n_cached <= 4, n_cached
+
+
+def test_stale_fetch_rescue_fails_with_var_name():
+    """A plan cached against a scope holding var X must fail with X's name
+    when rerun against a scope lacking X (not a jax TypeError)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", [-1, 2], False, dtype="float32")
+        out = fluid.layers.scale(x, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    s1, s2 = Scope(), Scope()
+    s1.set("side_state", np.ones(3, "float32"))
+    feed = {"x": np.ones((1, 2), "float32")}
+    with scope_guard(s1):
+        exe.run(startup)
+        got = exe.run(main, feed=feed, fetch_list=[out.name, "side_state"],
+                      scope=s1)
+        np.testing.assert_allclose(got[1], np.ones(3))
+    with pytest.raises(ValueError, match="side_state"):
+        exe.run(main, feed=feed, fetch_list=[out.name, "side_state"],
+                scope=s2)

@@ -193,9 +193,18 @@ def measure_gpt_decode(size):
         cfg = gpt.GPTConfig(vocab_size=1024, hidden_size=128, num_heads=4,
                             num_layers=2, intermediate_size=512,
                             max_position=maxp)
+    # scan decode: ONE while-loop body compiled once — at g64 the unrolled
+    # program takes ~26x longer to compile and ~1.5x longer per step (CPU
+    # A/B; PT_BENCH_DECODE=unrolled reselects the old variant on chip)
+    variant = os.environ.get("PT_BENCH_DECODE", "scan")
+    if variant not in ("scan", "unrolled"):
+        raise ValueError(
+            f"PT_BENCH_DECODE={variant!r}: choose 'scan' or 'unrolled'")
+    builder = (gpt.build_gpt_generate_scan if variant == "scan"
+               else gpt.build_gpt_generate_cached)
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
-        prompt_var, out_var, _scores = gpt.build_gpt_generate_cached(
+        prompt_var, out_var, _scores = builder(
             cfg, prompt_len=prompt_len, gen_len=gen_len)
     exe = fluid.Executor()
     exe.run(startup)
@@ -206,7 +215,8 @@ def measure_gpt_decode(size):
     dt = _timed_steps(exe, main_prog, {prompt_var.name: prompt},
                       out_var.name, n_steps)
     tps = n_steps * batch * gen_len / dt
-    config = (f"gpt-{size} b{batch} p{prompt_len} g{gen_len} kvcache"
+    config = (f"gpt-{size} b{batch} p{prompt_len} g{gen_len} "
+              f"kvcache-{variant}"
               + _cpu_suffix())
     return {
         "metric": f"gpt_{size}_decode_tokens_per_sec",

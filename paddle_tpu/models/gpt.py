@@ -17,6 +17,7 @@ import numpy as np
 
 from paddle_tpu import fluid
 from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Variable
 from paddle_tpu.fluid.initializer import Normal
 from paddle_tpu.fluid.param_attr import ParamAttr
 
@@ -91,11 +92,7 @@ def decoder_layer_incremental(x, caches, cfg: GPTConfig, name):
     attn, k_cat, v_cat = _attention_incremental(
         _ln(x, name + "_ln_attn"), caches[0], caches[1], cfg, name + "_att")
     x = layers.elementwise_add(x, attn)
-    ffn = _fc(_ln(x, name + "_ln_ffn"), cfg.intermediate_size,
-              name + "_ffn_fc_0", act="gelu", init_std=cfg.initializer_range)
-    ffn = _fc(ffn, cfg.hidden_size, name + "_ffn_fc_1",
-              init_std=cfg.initializer_range)
-    return layers.elementwise_add(x, ffn), (k_cat, v_cat)
+    return _ffn_block(x, cfg, name), (k_cat, v_cat)
 
 
 def causal_self_attention(x, cfg: GPTConfig, name, is_test=False,
@@ -262,18 +259,31 @@ def build_gpt_generate(cfg: GPTConfig, prompt_len, gen_len, beam_size=1,
 
 
 def _embed_token(tok, pos_value, cfg: GPTConfig):
-    """tok: [B', 1] int64 → [B', 1, H] word+position embedding."""
+    """tok: [B', 1] int64 → [B', 1, H] word+position embedding.
+    pos_value: python int OR an int64 [1] Variable (while-loop decode)."""
     L = layers
     emb = L.embedding(tok, size=[cfg.vocab_size, cfg.hidden_size],
                       param_attr=ParamAttr(name="gpt_word_embedding"))
-    pos = L.fill_constant_batch_size_like(tok, shape=[-1, 1], dtype="int64",
-                                          value=pos_value)
+    pos = L.fill_constant_batch_size_like(
+        tok, shape=[-1, 1], dtype="int64",
+        value=0 if isinstance(pos_value, Variable) else pos_value)
+    if isinstance(pos_value, Variable):
+        pos = L.elementwise_add(pos, pos_value)
     pemb = L.embedding(pos, size=[cfg.max_position, cfg.hidden_size],
                        param_attr=ParamAttr(name="gpt_pos_embedding"))
     # lookup_table squeezes trailing [*, 1] ids to [B, H]: restore the
     # singleton time axis the incremental decoder layers expect
     return L.reshape(L.elementwise_add(emb, pemb),
                      shape=[-1, 1, cfg.hidden_size])
+
+
+def _ffn_block(x, cfg: GPTConfig, name):
+    """Shared pre-LN FFN + residual (decoder_layer / incremental / scan)."""
+    ffn = _fc(_ln(x, name + "_ln_ffn"), cfg.intermediate_size,
+              name + "_ffn_fc_0", act="gelu", init_std=cfg.initializer_range)
+    ffn = _fc(ffn, cfg.hidden_size, name + "_ffn_fc_1",
+              init_std=cfg.initializer_range)
+    return layers.elementwise_add(x, ffn)
 
 
 def build_gpt_generate_cached(cfg: GPTConfig, prompt_len, gen_len,
@@ -351,6 +361,159 @@ def build_gpt_generate_cached(cfg: GPTConfig, prompt_len, gen_len,
 
     sent = _decode_tail(step_ids, step_parents, end_id)
     return prompt, sent, pre_scores
+
+
+def build_gpt_generate_scan(cfg: GPTConfig, prompt_len, gen_len, end_id=0):
+    """Greedy KV-cache generation as ONE while-loop (lax.while_loop under
+    jit) over FIXED-SIZE caches — the TPU-right decode shape: the step
+    body compiles once, vs build_gpt_generate_cached's gen_len-times
+    unrolled program whose XLA compile time grows linearly (painful at
+    gen_len ≥ 64 on a real chip).
+
+    Caches are preallocated [B, n, P+G, d]; each step writes the new K/V
+    at position `cur` with a one-hot masked update (static shapes — no
+    dynamic slicing) and attends over the full cache with positions > cur
+    masked to -1e9.  Greedy only: in-loop beam reordering needs gather-by-
+    parent on every carry, which the unrolled variant keeps covering.
+
+    Returns (prompt_var, sentence_ids [B, 1, gen_len], scores [B, 1]).
+    """
+    L = layers
+    n, d = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    P, G = prompt_len, gen_len
+    Ltot = P + G
+    neg = -1e9
+
+    prompt = fluid.data("gpt_prompt", [-1, P], False, dtype="int64")
+
+    # ---- prefill (batched causal pass, captures per-layer K/V) ----
+    pos0 = L.fill_constant_batch_size_like(prompt, shape=[-1, P],
+                                           dtype="int64", value=0)
+    pos0 = L.elementwise_add(pos0, L.assign(np.arange(P, dtype="int64")[None, :]))
+    kv_sink = []
+    x_full = gpt_decoder(prompt, pos0, cfg, is_test=True, kv_sink=kv_sink,
+                         final_ln=False)
+    last_x = L.slice(x_full, axes=[1], starts=[P - 1], ends=[P])  # [B,1,H]
+    logits0 = _lm_logits(_ln(last_x, "gpt_final_ln"), cfg)        # [B,V]
+
+    # loop-carried state: every var below is ASSIGNED before the loop and
+    # re-assigned (same var) at the end of the body → while carries
+    zero_pad = L.fill_constant_batch_size_like(
+        prompt, shape=[-1, n, G, d], dtype="float32", value=0.0,
+        input_dim_idx=0, output_dim_idx=0)
+    caches = []
+    for li, (kc, vc) in enumerate(kv_sink):
+        kfull = L.assign(L.concat([kc, zero_pad], axis=2))  # [B,n,Ltot,d]
+        vfull = L.assign(L.concat([vc, zero_pad], axis=2))
+        caches.append((kfull, vfull))
+    tok = L.assign(L.reshape(L.argmax(logits0, axis=-1), shape=[-1, 1]))
+    out_buf = L.fill_constant_batch_size_like(
+        prompt, shape=[-1, G], dtype="float32", value=0.0)
+    out_buf = L.assign(out_buf)
+    score = L.assign(L.reduce_max(L.log_softmax(logits0), dim=-1,
+                                  keep_dim=True))            # [B,1] greedy
+    # finished[b]=1 once an emitted token == end_id: later emissions pin to
+    # end_id and the score freezes (beam_search's pre_id==end_id rule)
+    finished = L.assign(L.fill_constant_batch_size_like(
+        prompt, shape=[-1, 1], dtype="float32", value=0.0))
+    t = L.fill_constant(shape=[1], value=0, dtype="int64")
+    g_const = L.fill_constant(shape=[1], value=G, dtype="int64")
+    g_minus1 = L.fill_constant(shape=[1], value=G - 1, dtype="int64")
+    p_const = L.fill_constant(shape=[1], value=P, dtype="int64")
+    end_const = L.fill_constant(shape=[1], value=end_id, dtype="int64")
+    arange_l = L.assign(np.arange(Ltot, dtype="int64"))      # read-only
+    cond = L.less_than(t, g_const)
+
+    w = L.While(cond)
+    with w.block():
+        # record the current token at out_buf[:, t]
+        oh_g = L.one_hot(L.reshape(t, shape=[1, 1]), G)      # [1,1,G] f32
+        oh_g = L.reshape(oh_g, shape=[1, G])
+        keep = L.elementwise_sub(
+            L.fill_constant(shape=[1, G], value=1.0, dtype="float32"), oh_g)
+        newbuf = L.elementwise_add(
+            L.elementwise_mul(out_buf, keep),
+            L.elementwise_mul(L.cast(tok, "float32"), oh_g))
+        L.assign(newbuf, out_buf)
+
+        cur = L.elementwise_add(p_const, t)                  # [1] int64
+        x = _embed_token(tok, cur, cfg)
+        # freeze rule: a batch row whose JUST-EMITTED token is end_id pins
+        # every later emission to end_id with its score unchanged
+        is_end = L.cast(L.equal(tok, end_const), "float32")  # [B,1]
+        fin_new = L.elementwise_sub(
+            L.elementwise_add(finished, is_end),
+            L.elementwise_mul(finished, is_end))             # logical OR
+        L.assign(fin_new, finished)
+        alive = L.elementwise_sub(
+            L.fill_constant(shape=[1], value=1.0, dtype="float32"), fin_new)
+
+        oh_l = L.one_hot(L.reshape(cur, shape=[1, 1]), Ltot)  # [1,1,Ltot]
+        oh_l4 = L.reshape(oh_l, shape=[1, 1, Ltot, 1])
+        keep_l4 = L.elementwise_sub(
+            L.fill_constant(shape=[1, 1, Ltot, 1], value=1.0,
+                            dtype="float32"), oh_l4)
+        # additive attention mask: -1e9 where position > cur
+        future = L.cast(L.greater_than(arange_l, cur), "float32")
+        amask = L.scale(future, scale=neg)                    # [Ltot]
+
+        for li in range(cfg.num_layers):
+            name = f"decoder_layer_{li}"
+            xa = _ln(x, name + "_ln_attn")
+            q = _fc(xa, cfg.hidden_size, name + "_att_query_fc",
+                    init_std=cfg.initializer_range)
+            kk = _fc(xa, cfg.hidden_size, name + "_att_key_fc",
+                     init_std=cfg.initializer_range)
+            vv = _fc(xa, cfg.hidden_size, name + "_att_value_fc",
+                     init_std=cfg.initializer_range)
+
+            def to_heads(tn):
+                r = L.reshape(tn, shape=[0, 0, n, d])
+                return L.transpose(r, perm=[0, 2, 1, 3])      # [B,n,1,d]
+
+            q, kk, vv = to_heads(q), to_heads(kk), to_heads(vv)
+            kc, vc = caches[li]
+            # the one genuinely-new piece vs decoder_layer_incremental:
+            # masked one-hot write into the FIXED-size cache (no concat —
+            # while carries must keep their shape)
+            kc_new = L.elementwise_add(L.elementwise_mul(kc, keep_l4),
+                                       L.elementwise_mul(kk, oh_l4))
+            vc_new = L.elementwise_add(L.elementwise_mul(vc, keep_l4),
+                                       L.elementwise_mul(vv, oh_l4))
+            L.assign(kc_new, kc)
+            L.assign(vc_new, vc)
+            scores = L.matmul(q, kc_new, transpose_y=True,
+                              alpha=float(d) ** -0.5)         # [B,n,1,Ltot]
+            scores = L.elementwise_add(scores, amask)
+            probs = L.softmax(scores)
+            ctx = L.matmul(probs, vc_new)                     # [B,n,1,d]
+            ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
+            ctx = L.reshape(ctx, shape=[0, 0, cfg.hidden_size])
+            attn = _fc(ctx, cfg.hidden_size, name + "_att_output_fc",
+                       init_std=cfg.initializer_range)
+            x = _ffn_block(L.elementwise_add(x, attn), cfg, name)
+
+        logits = _lm_logits(_ln(x, "gpt_final_ln"), cfg)      # [B,V]
+        logp = L.log_softmax(logits)
+        # score: only tokens that are actually EMITTED count — the t=G-1
+        # iteration computes logits for a token that never lands in
+        # out_buf, so its logp is gated off (and frozen rows add nothing)
+        step_gate = L.cast(L.less_than(t, g_minus1), "float32")  # [1]
+        add = L.elementwise_mul(
+            L.elementwise_mul(L.reduce_max(logp, dim=-1, keep_dim=True),
+                              alive), step_gate)
+        L.assign(L.elementwise_add(score, add), score)
+        nxt = L.cast(L.reshape(L.argmax(logits, axis=-1), shape=[-1, 1]),
+                     "float32")
+        pin = L.elementwise_add(
+            L.elementwise_mul(nxt, alive),
+            L.elementwise_mul(L.cast(end_const, "float32"), fin_new))
+        L.assign(L.cast(pin, "int64"), tok)
+        L.increment(t, in_place=True)
+        L.less_than(t, g_const, cond=cond)
+
+    sent = L.reshape(L.cast(out_buf, "int64"), shape=[-1, 1, G])
+    return prompt, sent, score
 
 
 def make_fake_lm_batch(cfg: GPTConfig, batch, seq_len, seed=0):

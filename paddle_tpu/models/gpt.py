@@ -406,16 +406,27 @@ def build_gpt_generate_scan(cfg: GPTConfig, prompt_len, gen_len, end_id=0):
         kfull = L.assign(L.concat([kc, zero_pad], axis=2))  # [B,n,Ltot,d]
         vfull = L.assign(L.concat([vc, zero_pad], axis=2))
         caches.append((kfull, vfull))
-    tok = L.assign(L.reshape(L.argmax(logits0, axis=-1), shape=[-1, 1]))
+    end_const0 = L.fill_constant(shape=[1], value=end_id, dtype="int64")
+    # pre-finished rule (beam_search seeds pre_ids from the LAST PROMPT
+    # token): a prompt already ending in end_id emits end_id forever with
+    # score frozen at 0
+    last_prompt = L.slice(prompt, axes=[1], starts=[P - 1], ends=[P])
+    pre_fin = L.cast(L.equal(last_prompt, end_const0), "float32")  # [B,1]
+    alive0 = L.elementwise_sub(
+        L.fill_constant(shape=[1], value=1.0, dtype="float32"), pre_fin)
+    tok0 = L.reshape(L.argmax(logits0, axis=-1), shape=[-1, 1])
+    tok = L.assign(L.cast(L.elementwise_add(
+        L.elementwise_mul(L.cast(tok0, "float32"), alive0),
+        L.elementwise_mul(L.cast(end_const0, "float32"), pre_fin)), "int64"))
     out_buf = L.fill_constant_batch_size_like(
         prompt, shape=[-1, G], dtype="float32", value=0.0)
     out_buf = L.assign(out_buf)
-    score = L.assign(L.reduce_max(L.log_softmax(logits0), dim=-1,
-                                  keep_dim=True))            # [B,1] greedy
+    score = L.assign(L.elementwise_mul(
+        L.reduce_max(L.log_softmax(logits0), dim=-1, keep_dim=True),
+        alive0))                                             # [B,1] greedy
     # finished[b]=1 once an emitted token == end_id: later emissions pin to
     # end_id and the score freezes (beam_search's pre_id==end_id rule)
-    finished = L.assign(L.fill_constant_batch_size_like(
-        prompt, shape=[-1, 1], dtype="float32", value=0.0))
+    finished = L.assign(pre_fin)
     t = L.fill_constant(shape=[1], value=0, dtype="int64")
     g_const = L.fill_constant(shape=[1], value=G, dtype="int64")
     g_minus1 = L.fill_constant(shape=[1], value=G - 1, dtype="int64")

@@ -155,8 +155,11 @@ def test_scan_decode_matches_unrolled_cached():
 
 def test_scan_decode_end_id_freezes():
     """Once greedy emits end_id, every later token pins to end_id and the
-    score freezes — beam_search's pre_id==end_id rule, matched by the scan
-    variant."""
+    score freezes; a prompt ALREADY ending in end_id emits only end_id with
+    score 0 — beam_search's pre_id==end_id rule, matched by the scan
+    variant.  END is chosen from tokens the model ACTUALLY emits (a fixed
+    END that never fires would leave the freeze path untested) and one
+    prompt row is forced to end with END (pre-finished case)."""
     import numpy as np
 
     from paddle_tpu import fluid
@@ -168,31 +171,50 @@ def test_scan_decode_end_id_freezes():
     P, G, B = 4, 6, 4
     rng = np.random.RandomState(3)
     prompt = rng.randint(0, cfg.vocab_size, (B, P)).astype("int64")
-    END = 0  # tiny vocab: greedy will hit token 0 for some row/seed
 
-    main1, startup1 = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main1, startup1), fluid.unique_name.guard():
-        pv1, sent1, sc1 = gpt.build_gpt_generate_cached(
-            cfg, prompt_len=P, gen_len=G, beam_size=1, end_id=END)
-    main2, startup2 = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main2, startup2), fluid.unique_name.guard():
-        pv2, sent2, sc2 = gpt.build_gpt_generate_scan(
-            cfg, prompt_len=P, gen_len=G, end_id=END)
+    def build_pair(end_id):
+        p1, s1 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(p1, s1), fluid.unique_name.guard():
+            a = gpt.build_gpt_generate_cached(cfg, prompt_len=P, gen_len=G,
+                                              beam_size=1, end_id=end_id)
+        p2, s2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(p2, s2), fluid.unique_name.guard():
+            b = gpt.build_gpt_generate_scan(cfg, prompt_len=P, gen_len=G,
+                                            end_id=end_id)
+        return (p1, s1, a), (p2, s2, b)
+
     scope = Scope()
     with scope_guard(scope):
         exe = fluid.Executor(fluid.CPUPlace())
-        exe.run(startup1)
-        out1, s1 = exe.run(main1, feed={pv1.name: prompt},
-                           fetch_list=[sent1, sc1])
-        out2, s2 = exe.run(main2, feed={pv2.name: prompt},
-                           fetch_list=[sent2, sc2])
+        # dry run to discover a token greedy actually emits mid-sequence
+        (p1, s1, (pv1, sent1, sc1)), _ = build_pair(end_id=-1)
+        exe.run(s1)
+        dry, = exe.run(p1, feed={pv1.name: prompt}, fetch_list=[sent1])
+        END = int(dry[1, 0, 1])  # row 1's second emission → freeze fires
+
+        prompt2 = prompt.copy()
+        prompt2[0, -1] = END  # row 0: pre-finished prompt
+
+        (p1, s1, (pv1, sent1, sc1)), (p2, s2, (pv2, sent2, sc2)) = \
+            build_pair(end_id=END)
+        out1, sco1 = exe.run(p1, feed={pv1.name: prompt2},
+                             fetch_list=[sent1, sc1])
+        out2, sco2 = exe.run(p2, feed={pv2.name: prompt2},
+                             fetch_list=[sent2, sc2])
     np.testing.assert_array_equal(out1, out2)
-    np.testing.assert_allclose(np.asarray(s1).reshape(-1),
-                               np.asarray(s2).reshape(-1), rtol=1e-4,
+    np.testing.assert_allclose(np.asarray(sco1).reshape(-1),
+                               np.asarray(sco2).reshape(-1), rtol=1e-4,
                                atol=1e-4)
-    # freeze semantics: after the first end_id, everything is end_id
-    for b in range(B):
+    # pre-finished row: all END, score exactly 0
+    assert (out2[0, 0] == END).all(), out2[0, 0]
+    np.testing.assert_allclose(np.asarray(sco2).reshape(-1)[0], 0.0,
+                               atol=1e-6)
+    # emitted-END freeze actually fired somewhere mid-sequence
+    fired = False
+    for b in range(1, B):
         row = out2[b, 0]
         ends = np.nonzero(row == END)[0]
-        if ends.size:
+        if ends.size and ends[0] < G - 1:
+            fired = True
             assert (row[ends[0]:] == END).all(), row
+    assert fired, out2

@@ -68,3 +68,30 @@ def test_transformer_respects_source_padding():
     with_pad = float(np.asarray(exe.run(main, feed=padded,
                                         fetch_list=[cost.name])[0]))
     np.testing.assert_allclose(with_pad, base, rtol=1e-4)
+
+
+def test_scan_decode_matches_unrolled():
+    """build_greedy_decode_scan (one while-loop) must match the unrolled
+    fixed-buffer decode token-for-token with shared weights."""
+    cfg = transformer.TransformerConfig(
+        src_vocab=29, trg_vocab=29, hidden_size=32, num_heads=2,
+        ffn_size=64, num_encoder_layers=1, num_decoder_layers=1,
+        dropout=0.0)
+    p1, s1 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(p1, s1), fluid.unique_name.guard():
+        src1, out1 = transformer.build_greedy_decode(cfg, max_out_len=5)
+    p2, s2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(p2, s2), fluid.unique_name.guard():
+        src2, out2 = transformer.build_greedy_decode_scan(cfg, max_out_len=5)
+
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    rng = np.random.RandomState(0)
+    src = rng.randint(2, cfg.src_vocab, (3, 7)).astype("int64")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(s1)
+        a, = exe.run(p1, feed={"src_ids": src}, fetch_list=[out1])
+        b, = exe.run(p2, feed={"src_ids": src}, fetch_list=[out2])
+    np.testing.assert_array_equal(a, b)

@@ -67,6 +67,14 @@ def main():
     if results["device"] is None:
         print(json.dumps({"error": "device probe hung — tunnel wedged"}))
         return 1
+    platform = results["device"].split()[0]
+    if platform not in ("tpu", "axon") and not os.environ.get(
+            "PT_ONCHIP_ALLOW_CPU"):
+        # ONCHIP_RESULTS.json must only ever hold real-chip numbers — a
+        # stray CPU invocation would poison the vs_baseline fallback
+        print(json.dumps({"error": f"device is {platform!r}, not a TPU; "
+                          "set PT_ONCHIP_ALLOW_CPU=1 for machinery tests"}))
+        return 1
 
     def save():
         with open(OUT, "w") as f:
@@ -107,20 +115,6 @@ def main():
         results["dataset_overlap"] = {"error": f"unparseable: {e}"}
     save()
 
-    # long-seq flash sweep + GPT decode (writes its own sidecar too)
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.join(ROOT, "tools", "bench_longseq.py")],
-            capture_output=True, text=True, timeout=budget * 7)
-        lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
-        results["longseq"] = (json.loads(lines[-1]) if lines
-                              else {"error": out.stderr[-400:]})
-    except subprocess.TimeoutExpired:
-        results["longseq"] = {"error": "sweep timeout"}
-    except json.JSONDecodeError as e:
-        results["longseq"] = {"error": f"unparseable sweep output: {e}"}
-    save()
-
     # curated correctness smoke subset ON the chip (VERDICT r2 item 2) —
     # the same tests the CPU-mesh suite runs continuously
     try:
@@ -137,6 +131,21 @@ def main():
     except subprocess.TimeoutExpired:
         results["onchip_smoke"] = {"error": "smoke tests timed out"}
     save()
+
+    # long-seq flash sweep + GPT decode (writes its own sidecar too)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "bench_longseq.py")],
+            capture_output=True, text=True, timeout=budget * 7)
+        lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+        results["longseq"] = (json.loads(lines[-1]) if lines
+                              else {"error": out.stderr[-400:]})
+    except subprocess.TimeoutExpired:
+        results["longseq"] = {"error": "sweep timeout"}
+    except json.JSONDecodeError as e:
+        results["longseq"] = {"error": f"unparseable sweep output: {e}"}
+    save()
+
     print(json.dumps({"written": OUT,
                       "bf16_speedup": results.get("bf16_speedup"),
                       "onchip_smoke": results.get("onchip_smoke")}))

@@ -644,3 +644,49 @@ __all__ += [
     "roi_perspective_transform", "polygon_box_transform",
     "continuous_value_model", "multi_box_head",
 ]
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral", detect_length=None,
+                  label_length=None):
+    """VOC mean average precision (reference detection.py:968 →
+    detection_map_op.h — a CPU-only kernel there too; here a host op that
+    runs after the device step).  Dense analog of the LoD inputs:
+    detect_res [B, M, 6] (label, score, box), label [B, N, 6]
+    (label, difficult, box) or [B, N, 5]; padded rows have label < 0, or
+    pass detect_length/label_length.  Cross-batch accumulation states are
+    host metrics here — use fluid.metrics.DetectionMAP (PARITY.md
+    deviations); passing input_states raises at run time."""
+    if out_states is not None or input_states is not None \
+            or has_state is not None:
+        raise NotImplementedError(
+            "detection_map accumulation states are host metrics here — use "
+            "fluid.metrics.DetectionMAP for cross-batch accumulation "
+            "(PARITY.md deviations)")
+    helper = LayerHelper("detection_map")
+    out = helper.create_variable_for_type_inference("float32",
+                                                    stop_gradient=True)
+    out.shape = (1,)
+    inputs = {"DetectRes": [detect_res], "Label": [label]}
+    if detect_length is not None:
+        inputs["DetectLength"] = [detect_length]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length]
+    if has_state is not None:
+        inputs["HasState"] = [has_state]
+    if input_states is not None:
+        inputs["PosCount"], inputs["TruePos"], inputs["FalsePos"] = (
+            [input_states[0]], [input_states[1]], [input_states[2]])
+    helper.append_op("detection_map", inputs=inputs,
+                     outputs={"MAP": [out]},
+                     attrs={"class_num": class_num,
+                            "background_label": background_label,
+                            "overlap_threshold": overlap_threshold,
+                            "evaluate_difficult": evaluate_difficult,
+                            "ap_type": ap_version})
+    return out
+
+
+__all__ += ["detection_map"]

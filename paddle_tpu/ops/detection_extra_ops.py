@@ -806,3 +806,67 @@ def _cvm(ctx, x, cvm, attrs):
         click = jnp.log(x[:, 1:2] + 1.0) - show
         return jnp.concatenate([show, click, x[:, 2:]], axis=1)
     return x[:, 2:]
+
+
+def _detection_map_run(scope, op, place):
+    """Host op (reference detection_map_op.h): VOC mAP over one batch of
+    detections + ground truth.  Sort-heavy, data-dependent control flow —
+    exactly the shape XLA serializes badly, so it runs host-side after the
+    device step (same pattern as the reference, whose detection_map is a
+    CPU-only kernel).
+
+    Dense analog of the LoD inputs: DetectRes [B, M, 6] (label, score,
+    box) and Label [B, N, 6] (label, difficult, box) or [B, N, 5] (no
+    difficult), padded rows marked by label < 0 (or trimmed via the
+    optional DetectLength/LabelLength aux vectors).  The reference's
+    cross-batch accumulation states ride fluid.metrics.DetectionMAP;
+    providing HasState/PosCount inputs here raises."""
+    import numpy as np
+
+    from paddle_tpu.fluid.metrics import DetectionMAP
+
+    if op.inputs.get("HasState") or op.inputs.get("PosCount"):
+        raise NotImplementedError(
+            "detection_map accumulation states are host metrics here — use "
+            "fluid.metrics.DetectionMAP for cross-batch accumulation")
+    det = np.asarray(scope.get(op.input("DetectRes")[0]))
+    lab = np.asarray(scope.get(op.input("Label")[0]))
+    det_len = (np.asarray(scope.get(op.input("DetectLength")[0]))
+               if op.inputs.get("DetectLength") else None)
+    lab_len = (np.asarray(scope.get(op.input("LabelLength")[0]))
+               if op.inputs.get("LabelLength") else None)
+    if det.ndim == 2:  # single-image convenience
+        det, lab = det[None], lab[None]
+    ap_version = op.attrs.get("ap_type", op.attrs.get("ap_version",
+                                                      "integral"))
+    m = DetectionMAP(
+        overlap_threshold=float(op.attrs.get("overlap_threshold", 0.3)),
+        evaluate_difficult=bool(op.attrs.get("evaluate_difficult", True)),
+        class_num=int(op.attrs["class_num"]) if "class_num" in op.attrs
+        else None)
+    has_difficult = lab.shape[-1] == 6
+    bg = op.attrs.get("background_label", 0)
+    for b in range(det.shape[0]):
+        d = det[b][:int(det_len[b])] if det_len is not None else det[b]
+        g = lab[b][:int(lab_len[b])] if lab_len is not None else lab[b]
+        d = d[d[:, 0] >= 0]
+        g = g[g[:, 0] >= 0]
+        if bg >= 0:  # reference excludes the background class from mAP
+            d = d[d[:, 0] != bg]
+            g = g[g[:, 0] != bg]
+        if has_difficult:
+            m.update(d, g[:, 2:6], g[:, 0], difficult=g[:, 1] > 0.5)
+        else:
+            m.update(d, g[:, 1:5], g[:, 0])
+    scope.set(op.outputs["MAP"][0],
+              np.array([m.eval(ap_version)], dtype="float32"))
+
+
+register_op("detection_map",
+            ["DetectRes", "Label", "DetectLength", "LabelLength",
+             "HasState", "PosCount", "TruePos", "FalsePos"],
+            ["MAP", "AccumPosCount", "AccumTruePos", "AccumFalsePos"],
+            lambda ctx, *a, attrs: None, grad=None,
+            optional=("DetectLength", "LabelLength", "HasState", "PosCount",
+                      "TruePos", "FalsePos"),
+            host_run=_detection_map_run)

@@ -162,3 +162,57 @@ def test_stale_fetch_rescue_fails_with_var_name():
     with pytest.raises(ValueError, match="side_state"):
         exe.run(main, feed=feed, fetch_list=[out.name, "side_state"],
                 scope=s2)
+
+
+def test_detection_map_op_host_run():
+    """detection_map as a graph op (host-run): matches the metrics class
+    on the same batch."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        det = fluid.data("det", [-1, 3, 6], False, dtype="float32")
+        lab = fluid.data("lab", [-1, 2, 6], False, dtype="float32")
+        m = fluid.layers.detection_map(det, lab, class_num=4,
+                                       overlap_threshold=0.5)
+    # image 0: perfect hit for class 1; image 1: miss
+    det_np = np.array([
+        [[1, 0.9, 10, 10, 20, 20], [-1, 0, 0, 0, 0, 0],
+         [-1, 0, 0, 0, 0, 0]],
+        [[1, 0.8, 50, 50, 60, 60], [-1, 0, 0, 0, 0, 0],
+         [-1, 0, 0, 0, 0, 0]],
+    ], dtype="float32")
+    lab_np = np.array([
+        [[1, 0, 10, 10, 20, 20], [-1, 0, 0, 0, 0, 0]],
+        [[1, 0, 0, 0, 10, 10], [-1, 0, 0, 0, 0, 0]],
+    ], dtype="float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = Scope()
+    with scope_guard(s):
+        exe.run(startup)
+        got, = exe.run(main, feed={"det": det_np, "lab": lab_np},
+                       fetch_list=[m])
+    np.testing.assert_allclose(got, [0.5], atol=1e-6)
+
+
+def test_detection_map_excludes_background_and_rejects_states():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        det = fluid.data("det", [-1, 2, 6], False, dtype="float32")
+        lab = fluid.data("lab", [-1, 1, 6], False, dtype="float32")
+        m = fluid.layers.detection_map(det, lab, class_num=4,
+                                       overlap_threshold=0.5,
+                                       background_label=0)
+        with pytest.raises(NotImplementedError, match="metrics.DetectionMAP"):
+            fluid.layers.detection_map(det, lab, class_num=4,
+                                       out_states=(det, det, det))
+    # class-0 (background) det + GT must not contribute an AP term:
+    # remaining class-1 detection hits its GT → mAP 1.0
+    det_np = np.array([[[0, 0.9, 0, 0, 5, 5],
+                        [1, 0.8, 10, 10, 20, 20]]], dtype="float32")
+    lab_np = np.array([[[1, 0, 10, 10, 20, 20]]], dtype="float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = Scope()
+    with scope_guard(s):
+        exe.run(startup)
+        got, = exe.run(main, feed={"det": det_np, "lab": lab_np},
+                       fetch_list=[m])
+    np.testing.assert_allclose(got, [1.0], atol=1e-6)

@@ -674,11 +674,6 @@ def detection_map(detect_res, label, class_num, background_label=0,
         inputs["DetectLength"] = [detect_length]
     if label_length is not None:
         inputs["LabelLength"] = [label_length]
-    if has_state is not None:
-        inputs["HasState"] = [has_state]
-    if input_states is not None:
-        inputs["PosCount"], inputs["TruePos"], inputs["FalsePos"] = (
-            [input_states[0]], [input_states[1]], [input_states[2]])
     helper.append_op("detection_map", inputs=inputs,
                      outputs={"MAP": [out]},
                      attrs={"class_num": class_num,

@@ -862,11 +862,17 @@ def _detection_map_run(scope, op, place):
               np.array([m.eval(ap_version)], dtype="float32"))
 
 
+def _detection_map_no_lower(ctx, *a, attrs):
+    raise RuntimeError(
+        "detection_map is a host op; it cannot be traced into an XLA "
+        "computation")
+
+
 register_op("detection_map",
             ["DetectRes", "Label", "DetectLength", "LabelLength",
              "HasState", "PosCount", "TruePos", "FalsePos"],
             ["MAP", "AccumPosCount", "AccumTruePos", "AccumFalsePos"],
-            lambda ctx, *a, attrs: None, grad=None,
+            _detection_map_no_lower, grad=None,
             optional=("DetectLength", "LabelLength", "HasState", "PosCount",
                       "TruePos", "FalsePos"),
             host_run=_detection_map_run)

@@ -197,18 +197,20 @@ def test_detection_map_excludes_background_and_rejects_states():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
         det = fluid.data("det", [-1, 2, 6], False, dtype="float32")
-        lab = fluid.data("lab", [-1, 1, 6], False, dtype="float32")
+        lab = fluid.data("lab", [-1, 2, 6], False, dtype="float32")
         m = fluid.layers.detection_map(det, lab, class_num=4,
                                        overlap_threshold=0.5,
                                        background_label=0)
         with pytest.raises(NotImplementedError, match="metrics.DetectionMAP"):
             fluid.layers.detection_map(det, lab, class_num=4,
                                        out_states=(det, det, det))
-    # class-0 (background) det + GT must not contribute an AP term:
-    # remaining class-1 detection hits its GT → mAP 1.0
-    det_np = np.array([[[0, 0.9, 0, 0, 5, 5],
+    # class-0 (background) det AND GT must not contribute an AP term —
+    # the class-0 det MISSES its class-0 GT, so WITHOUT the background
+    # filter mAP would be mean(AP0=0, AP1=1)=0.5, not 1.0
+    det_np = np.array([[[0, 0.9, 50, 50, 55, 55],
                         [1, 0.8, 10, 10, 20, 20]]], dtype="float32")
-    lab_np = np.array([[[1, 0, 10, 10, 20, 20]]], dtype="float32")
+    lab_np = np.array([[[0, 0, 0, 0, 5, 5],
+                        [1, 0, 10, 10, 20, 20]]], dtype="float32")
     exe = fluid.Executor(fluid.CPUPlace())
     s = Scope()
     with scope_guard(s):

@@ -207,6 +207,29 @@ def _init_beam_state(prompt, prompt_len, k):
     return pre_ids, pre_scores + L.assign(bias)
 
 
+def _tile_beams(tsr, k):
+    """[B, ...] -> [B*K, ...] beam replication (shared by both KV-cache
+    generation variants)."""
+    if k == 1:
+        return tsr
+    L = layers
+    shp = tsr.shape
+    r = L.stack([tsr] * k, axis=1)
+    return L.reshape(r, shape=[-1] + [int(sd) for sd in shp[1:]])
+
+
+def _reorder_beam_dim(tsr, parent, k, tail_shape):
+    """Gather the beam dim of [B*K, *tail_shape] by parent [B, K] with a
+    one-hot matmul (static shapes; shared by both generation variants)."""
+    if k == 1:
+        return tsr
+    L = layers
+    numel = int(np.prod(tail_shape))
+    flat = L.reshape(tsr, shape=[-1, k, numel])
+    sel = L.matmul(L.one_hot(parent, k), flat)           # [B, K, numel]
+    return L.reshape(sel, shape=[-1] + [int(sd) for sd in tail_shape])
+
+
 def _decode_tail(step_ids, step_parents, end_id):
     L = layers
     return L.beam_search_decode(L.concat(step_ids, axis=0),
@@ -312,28 +335,11 @@ def build_gpt_generate_cached(cfg: GPTConfig, prompt_len, gen_len,
     last_x = L.slice(x_full, axes=[1], starts=[prompt_len - 1],
                      ends=[prompt_len])                     # [B, 1, H]
 
-    # tile caches and state to K beams: [B, ...] → [B*K, ...]
-    def tile_beams(t):
-        if k == 1:
-            return t
-        shp = t.shape
-        r = L.stack([t] * k, axis=1)                     # [B, K, ...]
-        return L.reshape(r, shape=[-1] + [int(s) for s in shp[1:]])
-
-    caches = [(tile_beams(c[0]), tile_beams(c[1])) for c in caches]
-    h_last = tile_beams(last_x)
+    caches = [(_tile_beams(c[0], k), _tile_beams(c[1], k)) for c in caches]
+    h_last = _tile_beams(last_x, k)
 
     pre_ids, pre_scores = _init_beam_state(prompt, prompt_len, k)
 
-    def reorder_by_parent(t, parent, cur_len):
-        """t: [B*K, n, cur_len, d] gather beam dim by parent [B, K]."""
-        if k == 1:
-            return t  # greedy: the only parent is beam 0
-        numel = n * cur_len * d
-        flat = L.reshape(t, shape=[-1, k, numel])
-        onehot = L.one_hot(parent, k)                    # [B, K, K]
-        sel = L.matmul(onehot, flat)                     # [B, K, numel]
-        return L.reshape(sel, shape=[-1, n, cur_len, d])
 
     # logits for the token AFTER the prompt come from the prefill's last h
     x = h_last
@@ -345,8 +351,9 @@ def build_gpt_generate_cached(cfg: GPTConfig, prompt_len, gen_len,
         logp3 = L.reshape(logp, shape=[-1, k, cfg.vocab_size])
         ids, scores, parent = L.beam_search(pre_ids, pre_scores, logp3,
                                             beam_size=k, end_id=end_id)
-        caches = [(reorder_by_parent(kc, parent, cur),
-                   reorder_by_parent(vc, parent, cur)) for kc, vc in caches]
+        caches = [(_reorder_beam_dim(kc, parent, k, (n, cur, d)),
+                   _reorder_beam_dim(vc, parent, k, (n, cur, d)))
+                  for kc, vc in caches]
         tok = L.reshape(ids, shape=[-1, 1])
         x = _embed_token(tok, cur, cfg)
         new_caches = []
@@ -363,24 +370,27 @@ def build_gpt_generate_cached(cfg: GPTConfig, prompt_len, gen_len,
     return prompt, sent, pre_scores
 
 
-def build_gpt_generate_scan(cfg: GPTConfig, prompt_len, gen_len, end_id=0):
-    """Greedy KV-cache generation as ONE while-loop (lax.while_loop under
-    jit) over FIXED-SIZE caches — the TPU-right decode shape: the step
-    body compiles once, vs build_gpt_generate_cached's gen_len-times
-    unrolled program whose XLA compile time grows linearly (painful at
-    gen_len ≥ 64 on a real chip).
+def build_gpt_generate_scan(cfg: GPTConfig, prompt_len, gen_len,
+                            beam_size=1, end_id=0):
+    """Beam/greedy KV-cache generation as ONE while-loop (lax.while_loop
+    under jit) over FIXED-SIZE caches — the TPU-right decode shape: the
+    step body compiles once, vs build_gpt_generate_cached's gen_len-times
+    unrolled program whose XLA compile time grows linearly (26x slower to
+    compile at gen_len 64 in a CPU A/B; ~1.5x slower per step too).
 
-    Caches are preallocated [B, n, P+G, d]; each step writes the new K/V
-    at position `cur` with a one-hot masked update (static shapes — no
-    dynamic slicing) and attends over the full cache with positions > cur
-    masked to -1e9.  Greedy only: in-loop beam reordering needs gather-by-
-    parent on every carry, which the unrolled variant keeps covering.
+    Caches are preallocated [B*K, n, P+G, d]; each step
+      1. runs the SAME beam_search op as the unrolled variant (greedy is
+         beam_size=1) — scores and end_id freezing are op-identical,
+      2. reorders caches by beam parent with a one-hot matmul (static
+         shapes; no gather needed),
+      3. writes the new K/V at position `cur` with a one-hot masked
+         update and attends over the full cache, positions > cur masked.
 
-    Returns (prompt_var, sentence_ids [B, 1, gen_len], scores [B, 1]).
+    Returns (prompt_var, sentence_ids [B, K, gen_len], scores [B, K]).
     """
     L = layers
     n, d = cfg.num_heads, cfg.hidden_size // cfg.num_heads
-    P, G = prompt_len, gen_len
+    P, G, k = prompt_len, gen_len, beam_size
     Ltot = P + G
     neg = -1e9
 
@@ -394,83 +404,71 @@ def build_gpt_generate_scan(cfg: GPTConfig, prompt_len, gen_len, end_id=0):
     x_full = gpt_decoder(prompt, pos0, cfg, is_test=True, kv_sink=kv_sink,
                          final_ln=False)
     last_x = L.slice(x_full, axes=[1], starts=[P - 1], ends=[P])  # [B,1,H]
-    logits0 = _lm_logits(_ln(last_x, "gpt_final_ln"), cfg)        # [B,V]
 
-    # loop-carried state: every var below is ASSIGNED before the loop and
-    # re-assigned (same var) at the end of the body → while carries
-    zero_pad = L.fill_constant_batch_size_like(
-        prompt, shape=[-1, n, G, d], dtype="float32", value=0.0,
-        input_dim_idx=0, output_dim_idx=0)
+    # ---- loop-carried state (assigned before the loop, re-assigned in
+    # the body -> while carries) ----
     caches = []
-    for li, (kc, vc) in enumerate(kv_sink):
-        kfull = L.assign(L.concat([kc, zero_pad], axis=2))  # [B,n,Ltot,d]
-        vfull = L.assign(L.concat([vc, zero_pad], axis=2))
-        caches.append((kfull, vfull))
-    end_const0 = L.fill_constant(shape=[1], value=end_id, dtype="int64")
-    # pre-finished rule (beam_search seeds pre_ids from the LAST PROMPT
-    # token): a prompt already ending in end_id emits end_id forever with
-    # score frozen at 0
-    last_prompt = L.slice(prompt, axes=[1], starts=[P - 1], ends=[P])
-    pre_fin = L.cast(L.equal(last_prompt, end_const0), "float32")  # [B,1]
-    alive0 = L.elementwise_sub(
-        L.fill_constant(shape=[1], value=1.0, dtype="float32"), pre_fin)
-    tok0 = L.reshape(L.argmax(logits0, axis=-1), shape=[-1, 1])
-    tok = L.assign(L.cast(L.elementwise_add(
-        L.elementwise_mul(L.cast(tok0, "float32"), alive0),
-        L.elementwise_mul(L.cast(end_const0, "float32"), pre_fin)), "int64"))
-    out_buf = L.fill_constant_batch_size_like(
-        prompt, shape=[-1, G], dtype="float32", value=0.0)
-    out_buf = L.assign(out_buf)
-    score = L.assign(L.elementwise_mul(
-        L.reduce_max(L.log_softmax(logits0), dim=-1, keep_dim=True),
-        alive0))                                             # [B,1] greedy
-    # finished[b]=1 once an emitted token == end_id: later emissions pin to
-    # end_id and the score freezes (beam_search's pre_id==end_id rule)
-    finished = L.assign(pre_fin)
+    for kc, vc in kv_sink:
+        kc, vc = _tile_beams(kc, k), _tile_beams(vc, k)   # [B*K, n, P, d]
+        pad = L.fill_constant_batch_size_like(
+            kc, shape=[-1, n, G, d], dtype="float32", value=0.0)
+        caches.append((L.assign(L.concat([kc, pad], axis=2)),
+                       L.assign(L.concat([vc, pad], axis=2))))
+    x = L.assign(_tile_beams(last_x, k))                  # [B*K, 1, H]
+    pre_ids, pre_scores = _init_beam_state(prompt, P, k)  # [B, K] each
+    pre_ids, pre_scores = L.assign(pre_ids), L.assign(pre_scores)
+    ids_buf = L.assign(L.fill_constant_batch_size_like(
+        prompt, shape=[G, -1, k], dtype="float32", value=0.0,
+        output_dim_idx=1))
+    par_buf = L.assign(L.fill_constant_batch_size_like(
+        prompt, shape=[G, -1, k], dtype="float32", value=0.0,
+        output_dim_idx=1))
     t = L.fill_constant(shape=[1], value=0, dtype="int64")
     g_const = L.fill_constant(shape=[1], value=G, dtype="int64")
-    g_minus1 = L.fill_constant(shape=[1], value=G - 1, dtype="int64")
     p_const = L.fill_constant(shape=[1], value=P, dtype="int64")
-    end_const = L.fill_constant(shape=[1], value=end_id, dtype="int64")
-    arange_l = L.assign(np.arange(Ltot, dtype="int64"))      # read-only
+    arange_l = L.assign(np.arange(Ltot, dtype="int64"))   # read-only
     cond = L.less_than(t, g_const)
 
     w = L.While(cond)
     with w.block():
-        # record the current token at out_buf[:, t]
-        oh_g = L.one_hot(L.reshape(t, shape=[1, 1]), G)      # [1,1,G] f32
-        oh_g = L.reshape(oh_g, shape=[1, G])
-        keep = L.elementwise_sub(
-            L.fill_constant(shape=[1, G], value=1.0, dtype="float32"), oh_g)
-        newbuf = L.elementwise_add(
-            L.elementwise_mul(out_buf, keep),
-            L.elementwise_mul(L.cast(tok, "float32"), oh_g))
-        L.assign(newbuf, out_buf)
+        # 1. beam step on the carried hidden state (same op as unrolled)
+        logits = _lm_logits(_ln(x, "gpt_final_ln"), cfg)  # [B*K, V]
+        logp3 = L.reshape(L.log_softmax(logits),
+                          shape=[-1, k, cfg.vocab_size])
+        ids, scores, parent = L.beam_search(pre_ids, pre_scores, logp3,
+                                            beam_size=k, end_id=end_id)
+        # record this step's choices at buf[t]
+        oh_g = L.reshape(L.one_hot(L.reshape(t, shape=[1, 1]), G),
+                         shape=[G, 1, 1])
+        keep_g = L.elementwise_sub(
+            L.fill_constant(shape=[G, 1, 1], value=1.0, dtype="float32"),
+            oh_g)
+        L.assign(L.elementwise_add(
+            L.elementwise_mul(ids_buf, keep_g),
+            L.elementwise_mul(L.unsqueeze(L.cast(ids, "float32"), axes=[0]),
+                              oh_g)), ids_buf)
+        L.assign(L.elementwise_add(
+            L.elementwise_mul(par_buf, keep_g),
+            L.elementwise_mul(L.unsqueeze(L.cast(parent, "float32"),
+                                          axes=[0]), oh_g)), par_buf)
 
-        cur = L.elementwise_add(p_const, t)                  # [1] int64
-        x = _embed_token(tok, cur, cfg)
-        # freeze rule: a batch row whose JUST-EMITTED token is end_id pins
-        # every later emission to end_id with its score unchanged
-        is_end = L.cast(L.equal(tok, end_const), "float32")  # [B,1]
-        fin_new = L.elementwise_sub(
-            L.elementwise_add(finished, is_end),
-            L.elementwise_mul(finished, is_end))             # logical OR
-        L.assign(fin_new, finished)
-        alive = L.elementwise_sub(
-            L.fill_constant(shape=[1], value=1.0, dtype="float32"), fin_new)
+        cur = L.elementwise_add(p_const, t)               # [1] int64
+        tok = L.reshape(ids, shape=[-1, 1])
+        x_new = _embed_token(tok, cur, cfg)
 
-        oh_l = L.one_hot(L.reshape(cur, shape=[1, 1]), Ltot)  # [1,1,Ltot]
-        oh_l4 = L.reshape(oh_l, shape=[1, 1, Ltot, 1])
+        oh_l4 = L.reshape(L.one_hot(L.reshape(cur, shape=[1, 1]), Ltot),
+                          shape=[1, 1, Ltot, 1])
         keep_l4 = L.elementwise_sub(
             L.fill_constant(shape=[1, 1, Ltot, 1], value=1.0,
                             dtype="float32"), oh_l4)
-        # additive attention mask: -1e9 where position > cur
         future = L.cast(L.greater_than(arange_l, cur), "float32")
-        amask = L.scale(future, scale=neg)                    # [Ltot]
+        amask = L.scale(future, scale=neg)                # [Ltot]
 
+        # 3. one decoder pass on the new token against the fixed caches
+        xi = x_new
         for li in range(cfg.num_layers):
             name = f"decoder_layer_{li}"
-            xa = _ln(x, name + "_ln_attn")
+            xa = _ln(xi, name + "_ln_attn")
             q = _fc(xa, cfg.hidden_size, name + "_att_query_fc",
                     init_std=cfg.initializer_range)
             kk = _fc(xa, cfg.hidden_size, name + "_att_key_fc",
@@ -480,51 +478,41 @@ def build_gpt_generate_scan(cfg: GPTConfig, prompt_len, gen_len, end_id=0):
 
             def to_heads(tn):
                 r = L.reshape(tn, shape=[0, 0, n, d])
-                return L.transpose(r, perm=[0, 2, 1, 3])      # [B,n,1,d]
+                return L.transpose(r, perm=[0, 2, 1, 3])  # [B*K,n,1,d]
 
             q, kk, vv = to_heads(q), to_heads(kk), to_heads(vv)
             kc, vc = caches[li]
-            # the one genuinely-new piece vs decoder_layer_incremental:
-            # masked one-hot write into the FIXED-size cache (no concat —
-            # while carries must keep their shape)
-            kc_new = L.elementwise_add(L.elementwise_mul(kc, keep_l4),
+            kc_r = _reorder_beam_dim(kc, parent, k, (n, Ltot, d))
+            vc_r = _reorder_beam_dim(vc, parent, k, (n, Ltot, d))
+            # the genuinely-new piece vs decoder_layer_incremental: masked
+            # one-hot write into the FIXED-size cache (no concat — while
+            # carries must keep their shape)
+            kc_new = L.elementwise_add(L.elementwise_mul(kc_r, keep_l4),
                                        L.elementwise_mul(kk, oh_l4))
-            vc_new = L.elementwise_add(L.elementwise_mul(vc, keep_l4),
+            vc_new = L.elementwise_add(L.elementwise_mul(vc_r, keep_l4),
                                        L.elementwise_mul(vv, oh_l4))
             L.assign(kc_new, kc)
             L.assign(vc_new, vc)
-            scores = L.matmul(q, kc_new, transpose_y=True,
-                              alpha=float(d) ** -0.5)         # [B,n,1,Ltot]
-            scores = L.elementwise_add(scores, amask)
-            probs = L.softmax(scores)
-            ctx = L.matmul(probs, vc_new)                     # [B,n,1,d]
+            scores_att = L.matmul(q, kc_new, transpose_y=True,
+                                  alpha=float(d) ** -0.5)  # [B*K,n,1,Ltot]
+            scores_att = L.elementwise_add(scores_att, amask)
+            probs = L.softmax(scores_att)
+            ctx = L.matmul(probs, vc_new)                  # [B*K,n,1,d]
             ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
             ctx = L.reshape(ctx, shape=[0, 0, cfg.hidden_size])
             attn = _fc(ctx, cfg.hidden_size, name + "_att_output_fc",
                        init_std=cfg.initializer_range)
-            x = _ffn_block(L.elementwise_add(x, attn), cfg, name)
+            xi = _ffn_block(L.elementwise_add(xi, attn), cfg, name)
 
-        logits = _lm_logits(_ln(x, "gpt_final_ln"), cfg)      # [B,V]
-        logp = L.log_softmax(logits)
-        # score: only tokens that are actually EMITTED count — the t=G-1
-        # iteration computes logits for a token that never lands in
-        # out_buf, so its logp is gated off (and frozen rows add nothing)
-        step_gate = L.cast(L.less_than(t, g_minus1), "float32")  # [1]
-        add = L.elementwise_mul(
-            L.elementwise_mul(L.reduce_max(logp, dim=-1, keep_dim=True),
-                              alive), step_gate)
-        L.assign(L.elementwise_add(score, add), score)
-        nxt = L.cast(L.reshape(L.argmax(logits, axis=-1), shape=[-1, 1]),
-                     "float32")
-        pin = L.elementwise_add(
-            L.elementwise_mul(nxt, alive),
-            L.elementwise_mul(L.cast(end_const, "float32"), fin_new))
-        L.assign(L.cast(pin, "int64"), tok)
+        L.assign(xi, x)
+        L.assign(ids, pre_ids)
+        L.assign(scores, pre_scores)
         L.increment(t, in_place=True)
         L.less_than(t, g_const, cond=cond)
 
-    sent = L.reshape(L.cast(out_buf, "int64"), shape=[-1, 1, G])
-    return prompt, sent, score
+    sent = _decode_tail([L.cast(ids_buf, "int64")],
+                        [L.cast(par_buf, "int32")], end_id)
+    return prompt, sent, pre_scores
 
 
 def make_fake_lm_batch(cfg: GPTConfig, batch, seq_len, seed=0):

@@ -218,3 +218,38 @@ def test_scan_decode_end_id_freezes():
             fired = True
             assert (row[ends[0]:] == END).all(), row
     assert fired, out2
+
+
+def test_scan_decode_beam_matches_unrolled():
+    """beam_size=3: the while-loop decode must match the unrolled cached
+    variant token-for-token and score-for-score (same beam_search op,
+    caches reordered by parent via one-hot matmul)."""
+    import numpy as np
+
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.GPTConfig(vocab_size=41, hidden_size=32, num_heads=4,
+                        num_layers=2, intermediate_size=64, max_position=64)
+    P, G, B, K = 6, 5, 2, 3
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(1, cfg.vocab_size, (B, P)).astype("int64")
+
+    p1, s1 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(p1, s1), fluid.unique_name.guard():
+        pv1, sent1, sc1 = gpt.build_gpt_generate_cached(
+            cfg, prompt_len=P, gen_len=G, beam_size=K)
+    p2, s2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(p2, s2), fluid.unique_name.guard():
+        pv2, sent2, sc2 = gpt.build_gpt_generate_scan(
+            cfg, prompt_len=P, gen_len=G, beam_size=K)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(s1)
+        a, sa = exe.run(p1, feed={pv1.name: prompt}, fetch_list=[sent1, sc1])
+        b, sb = exe.run(p2, feed={pv2.name: prompt}, fetch_list=[sent2, sc2])
+    assert a.shape == b.shape == (B, K, G)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(sa, sb, rtol=1e-4, atol=1e-4)

@@ -83,8 +83,18 @@ def main():
             speedup[str(s)] = round(
                 fl["tokens_per_sec"] / base["tokens_per_sec"], 3)
         print(json.dumps(base), "\n", json.dumps(fl), flush=True)
+    # scan decode (default) + the unrolled A/B, and a LONG generation the
+    # unrolled program couldn't even compile in budget (g256 ≈ 26x compile
+    # gap at g64 on CPU)
+    decode = {"scan_g64": run_gpt_decode(budget)}
+    os.environ["PT_BENCH_DECODE"] = "unrolled"
+    decode["unrolled_g64"] = run_gpt_decode(budget)
+    os.environ["PT_BENCH_DECODE"] = "scan"
+    os.environ["PT_BENCH_GEN"] = "256"
+    decode["scan_g256"] = run_gpt_decode(budget)
+    os.environ.pop("PT_BENCH_GEN", None)
     result = {"sweep": sweep, "flash_speedup": speedup,
-              "gpt_decode": run_gpt_decode(budget)}
+              "gpt_decode": decode}
     with open(OUT, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps({"flash_speedup": speedup, "written": OUT}))

@@ -59,8 +59,15 @@ def run_config(seq_len, flash, budget):
             "mfu": rec.get("mfu"), "config": rec.get("config")}
 
 
-def run_gpt_decode(budget):
-    env = dict(os.environ, PT_BENCH_CHILD="base", PT_BENCH_MODEL="gpt")
+def run_gpt_decode(budget, decode="scan", gen=None):
+    """Explicit decode/gen overrides — ambient PT_BENCH_DECODE/PT_BENCH_GEN
+    must not leak into labeled A/B runs."""
+    env = dict(os.environ, PT_BENCH_CHILD="base", PT_BENCH_MODEL="gpt",
+               PT_BENCH_DECODE=decode)
+    if gen is not None:
+        env["PT_BENCH_GEN"] = str(gen)
+    else:
+        env.pop("PT_BENCH_GEN", None)
     try:
         out = subprocess.run([sys.executable, BENCH], env=env,
                              capture_output=True, text=True, timeout=budget)
@@ -86,13 +93,9 @@ def main():
     # scan decode (default) + the unrolled A/B, and a LONG generation the
     # unrolled program couldn't even compile in budget (g256 ≈ 26x compile
     # gap at g64 on CPU)
-    decode = {"scan_g64": run_gpt_decode(budget)}
-    os.environ["PT_BENCH_DECODE"] = "unrolled"
-    decode["unrolled_g64"] = run_gpt_decode(budget)
-    os.environ["PT_BENCH_DECODE"] = "scan"
-    os.environ["PT_BENCH_GEN"] = "256"
-    decode["scan_g256"] = run_gpt_decode(budget)
-    os.environ.pop("PT_BENCH_GEN", None)
+    decode = {"scan_g64": run_gpt_decode(budget, decode="scan"),
+              "unrolled_g64": run_gpt_decode(budget, decode="unrolled"),
+              "scan_g256": run_gpt_decode(budget, decode="scan", gen=256)}
     result = {"sweep": sweep, "flash_speedup": speedup,
               "gpt_decode": decode}
     with open(OUT, "w") as f:

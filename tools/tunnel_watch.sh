@@ -1,0 +1,17 @@
+#!/bin/bash
+# Poll the axon tunnel; on the first successful probe run the full on-chip
+# suite. Writes progress to /tmp/tunnel_watch.log.
+LOG=/tmp/tunnel_watch.log
+echo "watch start $(date)" >> $LOG
+for i in $(seq 1 40); do
+  if timeout 45 env PYTHONPATH=/root/repo:/root/.axon_site python -c "import jax; print(jax.devices())" >> $LOG 2>&1; then
+    echo "TUNNEL OPEN $(date) — launching bench_onchip_all" >> $LOG
+    env PYTHONPATH=/root/repo:/root/.axon_site python tools/bench_onchip_all.py >> $LOG 2>&1
+    echo "bench_onchip_all rc=$? $(date)" >> $LOG
+    exit 0
+  fi
+  echo "probe $i wedged $(date)" >> $LOG
+  sleep 420
+done
+echo "watch ended without a window $(date)" >> $LOG
+exit 3

@@ -48,7 +48,8 @@ class Context:
         self.batch_id = 0
         self.eval_results = {}  # fetch name -> list per epoch
         self.executor = None
-        self.search_space = None  # set by NAS strategies
+        self.search_space = None  # SearchSpace INPUT for NAS strategies
+        self.nas_result = None    # written by LightNASStrategy
 
     def eval(self):
         """Run the eval program over eval_reader; returns mean of each

@@ -82,9 +82,14 @@ class SAController(EvolutionaryController):
             self._best_tokens = list(tokens)
 
     def next_tokens(self):
-        """Mutate one random position (reference controller.py:126)."""
+        """Mutate one random position (reference controller.py:126).
+        Size-1 dimensions (fixed axes) are never mutated — randint(0)
+        would raise."""
         tokens = list(self._tokens)
-        idx = int(len(self._range_table) * self._rng.random_sample())
+        mutable = [i for i, r in enumerate(self._range_table) if r > 1]
+        if not mutable:
+            return tokens
+        idx = mutable[int(len(mutable) * self._rng.random_sample())]
         tokens[idx] = (tokens[idx]
                        + self._rng.randint(self._range_table[idx] - 1)
                        + 1) % self._range_table[idx]
@@ -121,8 +126,9 @@ class SearchSpace:
 class LightNASStrategy(Strategy):
     """Search-at-compression-begin NAS (reference light_nas_strategy.py,
     minus the controller server: evaluation is in-process).  After the
-    search, context.search_space holds (best_tokens, best_reward) and the
-    full trial history."""
+    search, context.nas_result holds best_tokens/best_reward and the full
+    trial history (context.search_space keeps the SearchSpace input —
+    re-running the strategy must not find a results dict there)."""
 
     def __init__(self, start_epoch=0, end_epoch=0, search_steps=20,
                  reduce_rate=0.85, init_temperature=1024, seed=None,
@@ -153,7 +159,7 @@ class LightNASStrategy(Strategy):
             self.history.append((list(tokens), reward))
             logger.info("NAS step %d: tokens=%s reward=%.4f (best %.4f)",
                         step, tokens, reward, self.controller.max_reward)
-        context.search_space = {
+        context.nas_result = {
             "best_tokens": self.controller.best_tokens,
             "best_reward": self.controller.max_reward,
             "history": self.history,

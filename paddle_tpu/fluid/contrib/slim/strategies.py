@@ -124,6 +124,14 @@ class QuantizationStrategy(Strategy):
             self._frozen = True
             logger.info("QuantizationStrategy: weights frozen to int domain")
 
+    def restore_from_checkpoint(self, context):
+        # resumed past start_epoch: re-apply the QAT transform to the FRESH
+        # program BEFORE the Compressor loads persistables, so the saved
+        # moving-average scale statistics load into matching vars instead
+        # of being discarded and re-initialized
+        if context.epoch_id >= self.start_epoch:
+            self.on_epoch_begin(context)
+
 
 @register_strategy
 class DistillationStrategy(Strategy):
@@ -161,16 +169,15 @@ class DistillationStrategy(Strategy):
             logger.info("DistillationStrategy: restored student program")
 
     def on_epoch_end(self, context):
-        if not self._in_window(context.epoch_id + 1):
-            self._restore(context)
+        # ALWAYS restore at epoch end: the per-epoch eval and checkpoint
+        # that follow must see the STUDENT program (a checkpoint carrying
+        # teacher weights would bloat every in-window save); the next
+        # in-window on_epoch_begin swaps the distill program back in
+        self._restore(context)
 
     def on_compression_end(self, context):
         self._restore(context)
 
-    def restore_from_checkpoint(self, context):
-        # resume inside the window: swap before load so persistables load
-        # against the distill program's variable set
-        if (self.distill_program is not None
-                and self._in_window(context.epoch_id + 1)):
-            self._saved = context.train_program
-            context.train_program = self.distill_program
+    # no restore_from_checkpoint: checkpoints hold student vars only; on
+    # resume the caller rebuilds the distill program (merge() refills the
+    # teacher params) and on_epoch_begin swaps it in for in-window epochs

@@ -92,6 +92,7 @@ class DatasetPrefetcher:
 
     def close(self):
         """Stop the producer early (consumer abandoned the loop)."""
+        self._exhausted = True  # iterating after close must not hang
         self._stop.set()
         # drain so a blocked put wakes up
         try:

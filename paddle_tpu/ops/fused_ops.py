@@ -72,8 +72,11 @@ def _fusion_gru(ctx, x, wx, wh, bias, h0, length, attrs):
     xx = _fc_project(x, wx, x.dtype)
     if bias is not None:
         xx = xx + jnp.reshape(bias, (1, 1, -1)).astype(x.dtype)
+    # this reference version's fusion_gru always computes the
+    # origin_mode=False form (jit GRUHtPart2), but pass a present attr
+    # through so newer exports with an explicit origin_mode stay correct
     gru_attrs = dict(attrs)
-    gru_attrs["origin_mode"] = False
+    gru_attrs.setdefault("origin_mode", False)
     hidden = _gru(ctx, xx, wh, None, h0, length, gru_attrs)
     return hidden, xx
 

@@ -67,14 +67,23 @@ def main():
     if results["device"] is None:
         print(json.dumps({"error": "device probe hung — tunnel wedged"}))
         return 1
+    try:
+        sys.path.insert(0, ROOT)
+        from paddle_tpu.fluid.platform_utils import TPU_PLATFORMS
+    except Exception:  # standalone fallback; keep in sync
+        TPU_PLATFORMS = ("tpu", "axon")
     platform = results["device"].split()[0]
-    if platform not in ("tpu", "axon") and not os.environ.get(
-            "PT_ONCHIP_ALLOW_CPU"):
-        # ONCHIP_RESULTS.json must only ever hold real-chip numbers — a
-        # stray CPU invocation would poison the vs_baseline fallback
-        print(json.dumps({"error": f"device is {platform!r}, not a TPU; "
-                          "set PT_ONCHIP_ALLOW_CPU=1 for machinery tests"}))
-        return 1
+    if platform not in TPU_PLATFORMS:
+        if not os.environ.get("PT_ONCHIP_ALLOW_CPU"):
+            # ONCHIP_RESULTS.json must only ever hold real-chip numbers — a
+            # stray CPU invocation would poison the vs_baseline fallback
+            print(json.dumps({"error": f"device is {platform!r}, not a TPU; "
+                              "set PT_ONCHIP_ALLOW_CPU=1 for machinery "
+                              "tests"}))
+            return 1
+        # machinery-test mode: force every child to stamp CPU-FALLBACK into
+        # its config so these numbers can never become a baseline
+        os.environ["PT_BENCH_FORCE_CPU"] = "1"
 
     def save():
         with open(OUT, "w") as f:

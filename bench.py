@@ -27,6 +27,9 @@ import subprocess
 import sys
 import time
 
+ONCHIP_RESULTS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "ONCHIP_RESULTS.json")
+
 
 def _cpu_suffix():
     return " CPU-FALLBACK" if os.environ.get("PT_BENCH_FORCE_CPU") else ""
@@ -120,8 +123,7 @@ def _vs_baseline(value, config, is_headline, default_metric=False):
         try:
             import json as _json
 
-            with open(os.path.join(os.path.dirname(
-                    os.path.abspath(__file__)), "ONCHIP_RESULTS.json")) as f:
+            with open(ONCHIP_RESULTS_PATH) as f:
                 rec = _json.load(f).get("fp32_headline") or {}
             if "value" in rec and "CPU-FALLBACK" not in rec.get("config", ""):
                 baseline = float(rec["value"])

@@ -23,15 +23,15 @@ import jax.numpy as jnp
 
 from paddle_tpu.fluid.registry import simple_op
 
-from .common import bcast_to
+from .common import bcast_to, mxu_dot
 from .rnn_ops import _gru, _lstm
 from .sequence_ops import _sequence_pool
 from .tensor_ops import _lookup_table
 
 
-def _fc_project(x, w, dtype):
-    """x: [B, T, M] @ w: [M, KD] on the MXU (fp32 accumulate)."""
-    return jnp.dot(x, w, preferred_element_type=jnp.float32).astype(dtype)
+def _fc_project(x, w):
+    """x: [B, T, M] @ w: [M, KD] on the MXU."""
+    return mxu_dot(x, w)
 
 
 @simple_op("fusion_lstm",
@@ -48,7 +48,7 @@ def _fusion_lstm(ctx, x, wx, wh, bias, h0, c0, length, attrs):
     is folded into XX here (FCCompute adds it, so XX is the *biased*
     projection in the reference) and zeroed before `_lstm` to avoid a
     double add."""
-    xx = _fc_project(x, wx, x.dtype)
+    xx = _fc_project(x, wx)
     if bias is not None:
         bias = jnp.reshape(bias, (-1,))
         d4 = jnp.shape(wh)[1]
@@ -69,7 +69,7 @@ def _fusion_gru(ctx, x, wx, wh, bias, h0, length, attrs):
     """fc(X·WeightX + Bias) then the gru recurrence (fusion_gru_op.cc
     SeqCompute: FCCompute + jit GRUH1/HtPart1/HtPart2 — gates {u, r, c~},
     h = u·c~ + (1-u)·h_prev, i.e. origin_mode=False in the unfused gru)."""
-    xx = _fc_project(x, wx, x.dtype)
+    xx = _fc_project(x, wx)
     if bias is not None:
         xx = xx + jnp.reshape(bias, (1, 1, -1)).astype(x.dtype)
     # this reference version's fusion_gru always computes the
@@ -152,9 +152,9 @@ def _fused_elemwise_activation(ctx, x, y, attrs):
 def _fusion_squared_mat_sub(ctx, x, y, attrs):
     """Out = scalar * ((X·Y)² - X²·Y²) (fusion_squared_mat_sub_op.cc)."""
     s = jnp.asarray(attrs.get("scalar", 1.0), x.dtype)
-    xy = jnp.dot(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    xy = mxu_dot(x, y)
     x2, y2 = x * x, y * y
-    x2y2 = jnp.dot(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
+    x2y2 = mxu_dot(x2, y2)
     return x2, y2, x2y2, s * (xy * xy - x2y2)
 
 
@@ -171,7 +171,6 @@ def _fusion_repeated_fc_relu(ctx, x, ws, biases, attrs):
     h = x
     for w, b in zip(ws, biases):
         h = jax.nn.relu(
-            jnp.dot(h, w, preferred_element_type=jnp.float32).astype(x.dtype)
-            + jnp.reshape(b, (1, -1)).astype(x.dtype))
+            mxu_dot(h, w) + jnp.reshape(b, (1, -1)).astype(x.dtype))
         relus.append(h)
     return tuple(relus[:-1]), relus[-1]

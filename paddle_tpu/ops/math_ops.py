@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.fluid.registry import register_op, simple_op
-from .common import bcast_to, flatten_to_2d, np_dtype
+from .common import bcast_to, flatten_to_2d, mxu_dot, mxu_matmul, np_dtype
 
 # ---------------------------------------------------------------------------
 # elementwise binary ops (reference operators/elementwise/*.cc)
@@ -78,7 +78,7 @@ def _mul(ctx, x, y, attrs):
     yd = attrs.get("y_num_col_dims", 1)
     x2 = flatten_to_2d(x, xd)
     y2 = flatten_to_2d(y, yd)
-    out = jnp.dot(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
+    out = mxu_dot(x2, y2)
     out_shape = tuple(jnp.shape(x)[:xd]) + tuple(jnp.shape(y)[yd:])
     return jnp.reshape(out, out_shape)
 
@@ -90,7 +90,7 @@ def _fc(ctx, x, w, bias, attrs):
     MXU matmul; bias/act fold into the same fusion under XLA."""
     xd = attrs.get("in_num_col_dims", 1)
     x2 = flatten_to_2d(x, xd)
-    out = jnp.dot(x2, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    out = mxu_dot(x2, w)
     out = jnp.reshape(out, tuple(jnp.shape(x)[:xd]) + (jnp.shape(w)[1],))
     if bias is not None:
         out = out + bias
@@ -114,7 +114,7 @@ def _matmul(ctx, x, y, attrs):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    out = mxu_matmul(x, y)
     if alpha != 1.0:
         out = out * jnp.asarray(alpha, out.dtype)
     return out

@@ -16,6 +16,8 @@ from jax import lax
 
 from paddle_tpu.fluid.registry import register_op, simple_op
 
+from .common import mxu_conv_kwargs, mxu_dot
+
 
 # ---------------------------------------------------------------------------
 # pooling / conv 3d
@@ -93,8 +95,7 @@ def _conv3d_transpose(ctx, x, w, bias, attrs):
     out = lax.conv_general_dilated(
         x, wt, window_strides=(1, 1, 1), padding=pads, lhs_dilation=strides,
         rhs_dilation=dilations, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        feature_group_count=groups, **mxu_conv_kwargs(x, wt)).astype(x.dtype)
     if bias is not None:
         out = out + jnp.reshape(bias, (1, -1, 1, 1, 1))
     return out
@@ -166,16 +167,14 @@ def _lstmp(ctx, x, w, w_proj, bias, h0, c0, length, attrs):
     def step(carry, inp):
         r_prev, c_prev = carry
         xt, valid = inp
-        gates = xt + jnp.dot(r_prev, w,
-                             preferred_element_type=jnp.float32).astype(x.dtype)
+        gates = xt + mxu_dot(r_prev, w)
         g_c, g_i, g_f, g_o = jnp.split(gates, 4, axis=-1)
         c = (act_node(g_c) * act_gate(g_i + c_prev * check_i)
              + c_prev * act_gate(g_f + c_prev * check_f))
         if cell_clip > 0.0:
             c = jnp.clip(c, -cell_clip, cell_clip)
         h = act_gate(g_o + c * check_o) * act_state(c)
-        r = act_proj(jnp.dot(h, w_proj,
-                             preferred_element_type=jnp.float32).astype(x.dtype))
+        r = act_proj(mxu_dot(h, w_proj))
         if proj_clip > 0.0:
             r = jnp.clip(r, -proj_clip, proj_clip)
         v = valid[:, None]

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.fluid.registry import register_op, simple_op
-from .common import op_rng_key
+from .common import mxu_conv_kwargs, op_rng_key
 
 # ---------------------------------------------------------------------------
 # convolution
@@ -34,7 +34,7 @@ def _conv_nd(x, w, strides, paddings, dilations, groups, nd):
         x, w, window_strides=tuple(strides), padding=pads,
         rhs_dilation=tuple(dilations), dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        **mxu_conv_kwargs(x, w)).astype(x.dtype)
 
 
 @simple_op("conv2d", ["Input", "Filter", "Bias"], ["Output"], optional=("Bias",))
@@ -83,7 +83,7 @@ def _conv2d_transpose(ctx, x, w, bias, attrs):
     out = jax.lax.conv_general_dilated(
         x, wt, window_strides=(1, 1), padding=pads, lhs_dilation=strides,
         rhs_dilation=dilations, dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        **mxu_conv_kwargs(x, wt)).astype(x.dtype)
     if bias is not None:
         out = out + jnp.reshape(bias, (1, -1, 1, 1))
     return out

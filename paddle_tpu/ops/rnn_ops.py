@@ -28,7 +28,7 @@ from jax import lax
 
 from paddle_tpu.fluid.registry import simple_op
 
-from .common import act_attr, length_mask
+from .common import act_attr, length_mask, mxu_dot
 
 _ACTS = {
     "sigmoid": jax.nn.sigmoid,
@@ -86,8 +86,7 @@ def _lstm(ctx, x, w, bias, h0, c0, length, attrs):
     def step(carry, inp):
         h_prev, c_prev = carry
         xt, valid = inp
-        gates = xt + jnp.dot(h_prev, w, preferred_element_type=jnp.float32
-                             ).astype(x.dtype)
+        gates = xt + mxu_dot(h_prev, w)
         g_c, g_i, g_f, g_o = jnp.split(gates, 4, axis=-1)
         cand = act_node(g_c)
         i = act_gate(g_i + c_prev * check_i)
@@ -143,15 +142,10 @@ def _gru(ctx, x, w, bias, h0, length, attrs):
 
     def step(h_prev, inp):
         xt, valid = inp
-        g_ur = xt[:, :2 * d] + jnp.dot(h_prev, w_gate,
-                                       preferred_element_type=jnp.float32
-                                       ).astype(x.dtype)
+        g_ur = xt[:, :2 * d] + mxu_dot(h_prev, w_gate)
         u = act_gate(g_ur[:, :d])
         r = act_gate(g_ur[:, d:])
-        cand = act_node(
-            xt[:, 2 * d:] + jnp.dot(r * h_prev, w_cand,
-                                    preferred_element_type=jnp.float32
-                                    ).astype(x.dtype))
+        cand = act_node(xt[:, 2 * d:] + mxu_dot(r * h_prev, w_cand))
         if origin_mode:
             h = u * h_prev + (1.0 - u) * cand
         else:
@@ -197,15 +191,11 @@ def _gru_unit(ctx, x, h_prev, w, bias, attrs):
     d = jnp.shape(h_prev)[-1]
     if bias is not None:
         x = x + jnp.reshape(bias, (1, -1)).astype(x.dtype)
-    g_ur = x[:, :2 * d] + jnp.dot(h_prev, w[:, :2 * d],
-                                  preferred_element_type=jnp.float32
-                                  ).astype(x.dtype)
+    g_ur = x[:, :2 * d] + mxu_dot(h_prev, w[:, :2 * d])
     u = act_gate(g_ur[:, :d])
     r = act_gate(g_ur[:, d:])
     r_h = r * h_prev
-    cand = act_node(x[:, 2 * d:] + jnp.dot(r_h, w[:, 2 * d:],
-                                           preferred_element_type=jnp.float32
-                                           ).astype(x.dtype))
+    cand = act_node(x[:, 2 * d:] + mxu_dot(r_h, w[:, 2 * d:]))
     if origin_mode:
         h = u * h_prev + (1.0 - u) * cand
     else:

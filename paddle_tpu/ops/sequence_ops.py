@@ -17,6 +17,8 @@ import jax.numpy as jnp
 
 from paddle_tpu.fluid.registry import register_op, simple_op
 
+from .common import mxu_dot
+
 
 def _time_mask(x, length):
     """[B, T, ...] mask from lengths [B]; None → all valid."""
@@ -44,7 +46,7 @@ def _sequence_conv(ctx, x, w, length, attrs):
     xp = jnp.pad(x, ((0, 0), pads, (0, 0)))
     cols = [xp[:, i:i + t, :] for i in range(ctx_len)]
     unfolded = jnp.concatenate(cols, axis=-1)
-    out = jnp.dot(unfolded, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    out = mxu_dot(unfolded, w)
     return out
 
 

@@ -21,26 +21,24 @@ def _bench(monkeypatch):
 
 def test_vs_baseline_fallback_to_onchip_record(monkeypatch, tmp_path):
     bench = _bench(monkeypatch)
-    path = os.path.join(REPO, "ONCHIP_RESULTS.json")
-    assert not os.path.exists(path), "test requires no committed results file"
+    # isolate from any real committed results file
+    path = str(tmp_path / "ONCHIP_RESULTS.json")
+    monkeypatch.setattr(bench, "ONCHIP_RESULTS_PATH", path)
     # sentinels with no record
     assert bench._vs_baseline(100.0, "cfgA", True, default_metric=True) == 1.0
     assert bench._vs_baseline(100.0, "cfgA", False) == 0.0
     with open(path, "w") as f:
         json.dump({"fp32_headline": {"value": 50.0, "config": "cfgA"}}, f)
-    try:
-        assert bench._vs_baseline(100.0, "cfgA", True) == 2.0
-        assert bench._vs_baseline(100.0, "cfgB", True) == 1.0  # cfg mismatch
-        # a CPU-FALLBACK record must never become the baseline
-        with open(path, "w") as f:
-            json.dump({"fp32_headline": {
-                "value": 50.0, "config": "b8 CPU-FALLBACK"}}, f)
-        assert bench._vs_baseline(100.0, "b8 CPU-FALLBACK", True) == 1.0
-        # env baseline wins over the file
-        with open(path, "w") as f:
-            json.dump({"fp32_headline": {"value": 50.0, "config": "cfgA"}}, f)
-        monkeypatch.setenv("BENCH_BASELINE", "25")
-        monkeypatch.setenv("BENCH_BASELINE_CONFIG", "cfgA")
-        assert bench._vs_baseline(100.0, "cfgA", True) == 4.0
-    finally:
-        os.remove(path)
+    assert bench._vs_baseline(100.0, "cfgA", True) == 2.0
+    assert bench._vs_baseline(100.0, "cfgB", True) == 1.0  # cfg mismatch
+    # a CPU-FALLBACK record must never become the baseline
+    with open(path, "w") as f:
+        json.dump({"fp32_headline": {
+            "value": 50.0, "config": "b8 CPU-FALLBACK"}}, f)
+    assert bench._vs_baseline(100.0, "b8 CPU-FALLBACK", True) == 1.0
+    # env baseline wins over the file
+    with open(path, "w") as f:
+        json.dump({"fp32_headline": {"value": 50.0, "config": "cfgA"}}, f)
+    monkeypatch.setenv("BENCH_BASELINE", "25")
+    monkeypatch.setenv("BENCH_BASELINE_CONFIG", "cfgA")
+    assert bench._vs_baseline(100.0, "cfgA", True) == 4.0

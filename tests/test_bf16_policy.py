@@ -100,3 +100,37 @@ def test_bf16_policy_on_bert_tiny():
             l0 = l0 if l0 is not None else float(np.asarray(lv))
         assert np.isfinite(float(np.asarray(lv)))
         assert float(np.asarray(lv)) < l0  # same batch → loss must drop
+
+
+def test_bf16_policy_backward_dots_are_bf16():
+    """Regression: the fwd lowering's `dot(..., preferred_element_type=f32)
+    .astype(bf16)` spelling made the vjp's cotangent fp32, so every BACKWARD
+    dot ran as an fp32 contraction — 6 MXU passes instead of 1 on TPU
+    (measured 1/6 of peak on v5e).  `ops.common.mxu_dot` emits a plain bf16
+    dot instead; pin that NO fp32 dot_general survives anywhere in the
+    lowered train step (forward or backward) under the policy."""
+    import jax
+
+    from paddle_tpu.fluid.executor import BlockPlan
+
+    main, startup, loss = _build()
+    mp.enable_bf16_policy(main)
+    with scope_guard(Scope()) as _:
+        exe = fluid.Executor()
+        exe.run(startup)
+        scope = fluid.global_scope()
+        plan = BlockPlan(main, main.global_block(), ["x", "y"], [loss.name],
+                         scope, place=fluid.CPUPlace())
+        donated = {n: scope.get(n) for n in plan.donated_names}
+        readonly = {n: scope.get(n) for n in plan.readonly_names}
+        batch = _data(1)[0]
+        txt = jax.jit(plan.make_body(), donate_argnums=(0,)).lower(
+            donated, readonly, batch, np.uint32(0)).as_text()
+    dots = [ln for ln in txt.splitlines() if "dot_general" in ln]
+    assert dots, "expected dot_general ops in the lowered train step"
+    # operand OR result typed f32 — catches both the fp32-cotangent
+    # backward dots and a reintroduced `preferred_element_type=f32`
+    # forward spelling (bf16 operands -> f32 result)
+    f32_dots = [ln for ln in dots if "xf32>" in ln]
+    assert not f32_dots, f"fp32 dots under bf16 policy:\n" + "\n".join(
+        ln.strip()[:120] for ln in f32_dots)

@@ -3,7 +3,7 @@
 # suite. Writes progress to /tmp/tunnel_watch.log.
 LOG=/tmp/tunnel_watch.log
 echo "watch start $(date)" >> $LOG
-for i in $(seq 1 40); do
+for i in $(seq 1 100); do
   if timeout 45 env PYTHONPATH=/root/repo:/root/.axon_site python -c "import jax; print(jax.devices())" >> $LOG 2>&1; then
     echo "TUNNEL OPEN $(date) — launching bench_onchip_all" >> $LOG
     env PYTHONPATH=/root/repo:/root/.axon_site python tools/bench_onchip_all.py >> $LOG 2>&1

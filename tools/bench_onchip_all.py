@@ -4,8 +4,9 @@ The axon device tunnel wedges for hours at a time, so every on-chip
 number this round needs is collected by ONE command the moment a window
 opens:
 
-  1. headline: bert-base b128 s128 fp32 tokens/sec + MFU
-  2. bf16 policy A/B at the same shape (target: beats fp32)
+  1. headline: bert-base b128 s128 bf16-policy tokens/sec + MFU (the
+     north-star config; runs FIRST so a short window still captures it)
+  2. fp32 comparison rung at the same shape
   3. cast-insertion AMP at the same shape (expected slower — recorded
      for the comparison table)
   4. long-sequence flash sweep + GPT decode (tools/bench_longseq.py)
@@ -91,9 +92,15 @@ def main():
 
     save()
     steps = [
-        ("fp32_headline", {}),
-        ("bf16_policy", {"PT_BENCH_BF16": "1"}),
-        ("amp_rewrite", {"PT_BENCH_AMP": "1"}),
+        # bf16 policy is bench.py's default headline (the north-star
+        # config); every stage pins ALL THREE dtype knobs so ambient env
+        # can never mislabel an A/B leg (the bench_longseq lesson)
+        ("bf16_policy", {"PT_BENCH_BF16": "1", "PT_BENCH_FP32": "0",
+                         "PT_BENCH_AMP": "0"}),
+        ("fp32_headline", {"PT_BENCH_FP32": "1", "PT_BENCH_BF16": "0",
+                           "PT_BENCH_AMP": "0"}),
+        ("amp_rewrite", {"PT_BENCH_AMP": "1", "PT_BENCH_FP32": "0",
+                         "PT_BENCH_BF16": "0"}),
         ("resnet50", {"PT_BENCH_MODEL": "resnet50"}),
     ]
     for label, env in steps:

@@ -146,6 +146,24 @@ def _vs_baseline(value, config, is_headline, default_metric=False):
                  (1.0 if is_headline else 0.0), 3)
 
 
+def _bf16_default():
+    """Shared dtype-knob semantics for every bench mode: bf16 policy is
+    the default; PT_BENCH_FP32=1 pins plain fp32; PT_BENCH_AMP selects the
+    cast-insertion rewrite (bert only) and turns the policy off."""
+    if os.environ.get("PT_BENCH_FP32") == "1":
+        return False
+    if os.environ.get("PT_BENCH_AMP") == "1":
+        return False
+    return os.environ.get("PT_BENCH_BF16", "1") == "1"
+
+
+def _maybe_enable_bf16(main_prog, bf16):
+    if bf16:
+        from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+        mp.enable_bf16_policy(main_prog)
+
+
 def measure_resnet(size):
     """ResNet-50 ImageNet images/sec/chip (BASELINE.md north-star #2).
     Selected with PT_BENCH_MODEL=resnet50; BERT stays the headline metric
@@ -157,6 +175,7 @@ def measure_resnet(size):
 
     batch = int(os.environ.get("PT_BENCH_BATCH", "128"))
     n_steps = int(os.environ.get("PT_BENCH_STEPS", "10"))
+    bf16 = _bf16_default()
     depth = 50 if size != "tiny" else 18
     image = (3, 224, 224) if size != "tiny" else (3, 64, 64)
     main_prog, startup = fluid.Program(), fluid.Program()
@@ -165,6 +184,7 @@ def measure_resnet(size):
             depth=depth, class_dim=1000, image_shape=image)
         fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
             loss)
+    _maybe_enable_bf16(main_prog, bf16)  # BN stats stay fp32 islands
     exe = fluid.Executor()
     exe.run(startup)
     rng = np.random.RandomState(0)
@@ -172,7 +192,8 @@ def measure_resnet(size):
             "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
     dt = _timed_steps(exe, main_prog, data, loss.name, n_steps)
     ips = n_steps * batch / dt
-    config = f"resnet{depth} b{batch} {image[1]}x{image[2]}" + _cpu_suffix()
+    config = (f"resnet{depth} b{batch} {image[1]}x{image[2]}"
+              + (" bf16-policy" if bf16 else "") + _cpu_suffix())
     # fwd FLOPs/image: resnet50@224 ≈ 4.1e9, resnet18@224 ≈ 1.8e9 (public
     # figures), conv FLOPs scale with spatial area; train ≈ 3× fwd
     fwd = (4.1e9 if depth == 50 else 1.8e9) * (image[1] / 224.0) ** 2
@@ -214,10 +235,13 @@ def measure_gpt_decode(size):
             f"PT_BENCH_DECODE={variant!r}: choose 'scan' or 'unrolled'")
     builder = (gpt.build_gpt_generate_scan if variant == "scan"
                else gpt.build_gpt_generate_cached)
+    bf16 = _bf16_default()
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
         prompt_var, out_var, _scores = builder(
             cfg, prompt_len=prompt_len, gen_len=gen_len)
+    # decode is HBM-bound: bf16 weights + KV caches halve the traffic
+    _maybe_enable_bf16(main_prog, bf16)
     exe = fluid.Executor()
     exe.run(startup)
     rng = np.random.RandomState(0)
@@ -229,7 +253,7 @@ def measure_gpt_decode(size):
     tps = n_steps * batch * gen_len / dt
     config = (f"gpt-{size} b{batch} p{prompt_len} g{gen_len} "
               f"kvcache-{variant}"
-              + _cpu_suffix())
+              + (" bf16-policy" if bf16 else "") + _cpu_suffix())
     return {
         "metric": f"gpt_{size}_decode_tokens_per_sec",
         "value": round(tps, 1),
@@ -272,12 +296,8 @@ def measure(size):
     amp = os.environ.get("PT_BENCH_AMP", "0") == "1"
     # the headline metric is the north-star config (BASELINE.md: "BERT-base
     # pretraining tokens/sec (bf16)") — the bf16 dtype policy, fp32 master
-    # weights.  PT_BENCH_FP32=1 measures the plain-fp32 comparison rung;
-    # PT_BENCH_BF16=1 forces the policy on (kept for existing callers).
-    if os.environ.get("PT_BENCH_FP32") == "1":
-        bf16 = False
-    else:
-        bf16 = os.environ.get("PT_BENCH_BF16", "1") == "1" and not amp
+    # weights.  PT_BENCH_FP32=1 measures the plain-fp32 comparison rung.
+    bf16 = _bf16_default()
     kw = dict(vocab_size=30528,  # pad vocab to /64 for MXU
               use_flash_attention=flash,
               attn_dropout=0.0 if flash else 0.1)
@@ -294,13 +314,9 @@ def measure(size):
 
             opt = mp.decorate(opt)  # bf16 compute, fp32 master weights
         opt.minimize(loss)
-    if bf16:
-        # the dtype POLICY (bf16 compute, fp32 master weights) — the perf
-        # path; PT_BENCH_AMP is the reference-style cast-insertion rewrite
-        from paddle_tpu.fluid.contrib import mixed_precision as mp
-
-        mp.enable_bf16_policy(main_prog)
-
+    # the dtype POLICY (bf16 compute, fp32 master weights) — the perf
+    # path; PT_BENCH_AMP is the reference-style cast-insertion rewrite
+    _maybe_enable_bf16(main_prog, bf16)
     exe = fluid.Executor()
     exe.run(startup)
     data = bert.make_fake_batch(cfg, batch=batch, seq_len=seq_len, seed=0)

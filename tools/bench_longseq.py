@@ -41,6 +41,8 @@ def run_config(seq_len, flash, budget):
         PT_BENCH_BATCH=str(max(1, TOKENS_PER_STEP // seq_len)),
         PT_BENCH_STEPS="6",
         PT_BENCH_FLASH="1" if flash else "0",
+        # pin every dtype knob so ambient env can't mislabel an A/B leg
+        PT_BENCH_BF16="1", PT_BENCH_FP32="0", PT_BENCH_AMP="0",
     )
     try:
         out = subprocess.run([sys.executable, BENCH], env=env,
@@ -63,7 +65,8 @@ def run_gpt_decode(budget, decode="scan", gen=None):
     """Explicit decode/gen overrides — ambient PT_BENCH_DECODE/PT_BENCH_GEN
     must not leak into labeled A/B runs."""
     env = dict(os.environ, PT_BENCH_CHILD="base", PT_BENCH_MODEL="gpt",
-               PT_BENCH_DECODE=decode)
+               PT_BENCH_DECODE=decode,
+               PT_BENCH_BF16="1", PT_BENCH_FP32="0", PT_BENCH_AMP="0")
     if gen is not None:
         env["PT_BENCH_GEN"] = str(gen)
     else:

@@ -101,7 +101,8 @@ def main():
                            "PT_BENCH_AMP": "0"}),
         ("amp_rewrite", {"PT_BENCH_AMP": "1", "PT_BENCH_FP32": "0",
                          "PT_BENCH_BF16": "0"}),
-        ("resnet50", {"PT_BENCH_MODEL": "resnet50"}),
+        ("resnet50", {"PT_BENCH_MODEL": "resnet50", "PT_BENCH_BF16": "1",
+                      "PT_BENCH_FP32": "0", "PT_BENCH_AMP": "0"}),
     ]
     for label, env in steps:
         results[label] = run_bench(label, env, budget)

@@ -144,3 +144,34 @@ def test_bert_flash_vs_composed_numerics():
             (lv,) = exe.run(main, feed=batch, fetch_list=[loss.name])
         outs[use_flash] = float(np.asarray(lv))
     assert abs(outs[True] - outs[False]) < 1e-4, outs
+
+
+def test_bf16_forward_and_grads_match_reference():
+    """bf16 inputs (the bench/bf16-policy path): Pallas kernel accumulates
+    fp32 in-kernel, so outputs and grads track the fp32 reference within
+    bf16 mantissa tolerance; outputs keep the input dtype."""
+    q, k, v = make_qkv(2, 2, 128, 32, seed=5, dtype="float32")
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out_p = flash_attention(qb, kb, vb, force="pallas")
+    assert out_p.dtype == jnp.bfloat16
+    out_r = flash_attention(q, k, v, force="reference")
+    np.testing.assert_allclose(np.asarray(out_p, dtype="float32"),
+                               np.asarray(out_r), rtol=2e-2, atol=2e-2)
+
+    w = jnp.asarray(np.random.RandomState(1).uniform(
+        0.5, 1.5, q.shape).astype("float32"))
+
+    def loss_p(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, force="pallas")
+                       .astype(jnp.float32) * w)
+
+    def loss_r(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, force="reference") * w)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(qb, kb, vb)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gp, gr, "qkv"):
+        assert a.dtype == jnp.bfloat16, f"d{name} dtype {a.dtype}"
+        np.testing.assert_allclose(np.asarray(a, dtype="float32"),
+                                   np.asarray(b), rtol=5e-2, atol=5e-2,
+                                   err_msg=f"d{name} mismatch")

@@ -545,6 +545,54 @@ class _CompiledBlock:
         self.plan.run_host_ops(scope, self.place, feeds=feeds)
         return self.plan.assemble_fetches(fetches, scope)
 
+    def _jit_args(self, scope, feeds, step):
+        """The (donated, readonly, feeds, step) pytrees run() passes to the
+        jitted body, as abstract ShapeDtypeStructs — enough for AOT
+        lowering without touching device memory."""
+        import jax
+
+        def spec(n, v):
+            if v is None:
+                # same guard as run(): name the variable instead of letting
+                # np.asarray(None) produce an opaque object-dtype error
+                raise ValueError(
+                    f"variable {n!r} is read by this program but absent "
+                    "from the current scope")
+            a = np.asarray(v) if not hasattr(v, "dtype") else v
+            return jax.ShapeDtypeStruct(np.shape(a), a.dtype)
+
+        donated = {n: spec(n, scope.get(n)) for n in self.donated_names}
+        readonly = {n: spec(n, scope.get(n)) for n in self.readonly_names}
+        feed_vals = {k: spec(k, v) for k, v in feeds.items()}
+        return donated, readonly, feed_vals, jax.ShapeDtypeStruct(
+            (), np.uint32)
+
+    def cost_analysis(self, scope, feeds, step=0):
+        """XLA's per-executable cost model for this step: flops, bytes
+        accessed (total and per memory space), transcendentals.  AOT
+        (`jit.lower(...).compile()`), so the shapes must match a prior or
+        future run; the executable cache makes this free after a warmup.
+        TPU analog of the reference's per-op profiler tables
+        (platform/profiler.cc) at whole-program granularity."""
+        lowered = self._jitted.lower(*self._jit_args(scope, feeds, step))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # donation unsupported on CPU
+            compiled = lowered.compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        mem = {}
+        try:
+            ma = compiled.memory_analysis()
+            for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "generated_code_size_in_bytes"):
+                v = getattr(ma, k, None)
+                if v is not None:
+                    mem[k] = int(v)
+        except Exception:  # backend without memory analysis
+            pass
+        return {"cost": dict(cost), "memory": mem}
+
     def _check_nan_inf(self, out_writes, fetches):
         """FLAGS_check_nan_inf (reference operator.cc:953-984): scan every
         written float var and raise naming the first non-finite one."""
@@ -577,6 +625,14 @@ class Executor:
         self.place = place if place is not None else framework._current_expected_place()
         self._cache: dict = {}
         self._step = 0
+
+    def compiled_for(self, program):
+        """The compiled-block handles cached for `program` (one per feed
+        signature / fetch list) — profiling/introspection surface; see
+        _CompiledBlock.cost_analysis."""
+        return [cb for key, cb in self._cache.items()
+                if isinstance(key, tuple) and key
+                and key[0] == id(program)]
 
     def close(self):
         self._cache.clear()

@@ -1,0 +1,81 @@
+"""Language-model learning-dynamics evidence (VERDICT r2 weak#6, the LM
+counterpart of test_convergence_cnn): a tiny GPT must LEARN a copy task —
+the second half of each sequence repeats the first half, so predicting it
+requires attention back to position p-8, not just token statistics.
+Held-out accuracy on the copied half must far exceed the 1/V chance floor.
+
+Reference analog: tests/book word-language-model workloads assert loss
+movement only; this pins actual generalization through the attention path.
+"""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.models import gpt
+
+VOCAB = 16
+HALF = 8
+SEQ = 2 * HALF  # ids length; labels are the next-token shift
+
+
+def make_copy_batch(n, seed):
+    """toks = [r0..r7, r0..r7, r0]: the 9 labels at positions >= HALF-1
+    are fully determined by the first half (the last wraps around)."""
+    rng = np.random.RandomState(seed)
+    first = rng.randint(0, VOCAB, (n, HALF))
+    toks = np.concatenate([first, first, first[:, :1]], axis=1)
+    toks = toks.astype("int64")
+    return {
+        "gpt_ids": toks[:, :SEQ],
+        "gpt_pos_ids": np.tile(np.arange(SEQ, dtype="int64"), (n, 1)),
+        "gpt_labels": toks[:, 1:SEQ + 1],
+    }
+
+
+def test_tiny_gpt_learns_copy_task():
+    cfg = gpt.GPTConfig.tiny(vocab_size=VOCAB, num_layers=2, num_heads=2,
+                             max_position=SEQ, hidden_dropout=0.0,
+                             use_flash_attention=False)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, loss = gpt.build_gpt_lm(cfg)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=3e-3).minimize(loss)
+
+    # the cloned test program still holds the [B*S, V] logits matmul output;
+    # find it by structure (input of softmax_with_cross_entropy)
+    swce = [op for op in test_prog.current_block().ops
+            if op.type == "softmax_with_cross_entropy"]
+    assert swce, "LM graph must end in softmax_with_cross_entropy"
+    logits_name = swce[0].input("Logits")[0]
+
+    train = make_copy_batch(512, seed=1)
+    held = make_copy_batch(256, seed=2)
+    mask = np.zeros(SEQ, dtype=bool)
+    mask[HALF - 1:] = True  # determined label positions
+
+    def held_acc(exe):
+        logits, = exe.run(test_prog, feed=held, fetch_list=[logits_name])
+        pred = np.asarray(logits).reshape(256, SEQ, VOCAB).argmax(-1)
+        return float((pred[:, mask] == held["gpt_labels"][:, mask]).mean())
+
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        acc = held_acc(exe)
+        assert acc < 0.3, f"untrained model should be near chance, got {acc}"
+        rng = np.random.RandomState(0)
+        acc = 0.0
+        for step in range(1500):
+            idx = rng.randint(0, 512, 64)
+            batch = {k: v[idx] for k, v in train.items()}
+            exe.run(main, feed=batch, fetch_list=[loss])
+            if step % 100 == 99:
+                acc = held_acc(exe)
+                if acc > 0.95:
+                    break
+        assert acc > 0.85, (
+            f"tiny GPT failed to learn the copy task: held-out acc {acc} "
+            f"(chance {1 / VOCAB:.3f})")

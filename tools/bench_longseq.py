@@ -27,7 +27,11 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(ROOT, "bench.py")
-OUT = os.path.join(ROOT, "LONGSEQ_BENCH.json")
+# PT_LONGSEQ_OUT: bench_onchip_all's machinery mode redirects the sweep
+# artifact to a .machinery sidecar so a CPU run-through can never clobber
+# real on-chip numbers
+OUT = os.environ.get("PT_LONGSEQ_OUT",
+                     os.path.join(ROOT, "LONGSEQ_BENCH.json"))
 
 TOKENS_PER_STEP = 16384
 SEQ_LENS = (512, 1024, 2048)

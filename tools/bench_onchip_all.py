@@ -1,21 +1,22 @@
-"""One-shot on-chip validation suite — run when the TPU tunnel is up.
+"""On-chip validation suite — wedge-tolerant collector for the TPU numbers.
 
-The axon device tunnel wedges for hours at a time, so every on-chip
-number this round needs is collected by ONE command the moment a window
-opens:
+The axon device tunnel wedges for hours and opens for windows as short as
+a few minutes, so this collector is built around three rules:
 
-  1. headline: bert-base b128 s128 bf16-policy tokens/sec + MFU (the
-     north-star config; runs FIRST so a short window still captures it)
-  2. fp32 comparison rung at the same shape
-  3. cast-insertion AMP at the same shape (expected slower — recorded
-     for the comparison table)
-  4. long-sequence flash sweep + GPT decode (tools/bench_longseq.py)
-  5. resnet50 images/sec
+  1. **Probe before every leg.**  A 45 s device probe decides whether the
+     leg runs at all; a wedged tunnel costs 45 s, not the leg's 15-minute
+     watchdog.
+  2. **Merge, never clobber.**  ONCHIP_RESULTS.json is loaded first and a
+     captured number (an entry with "value") is never overwritten by an
+     error/timeout from a later, unluckier pass.
+  3. **Loop.**  PT_ONCHIP_PASSES (default 1) full passes, headline leg
+     first in each, sleeping PT_ONCHIP_SLEEP (default 300 s) between
+     passes; the loop exits early once every leg holds a real number.
 
-Writes ONCHIP_RESULTS.json at the repo root.  Each config runs in a
-watchdog child (bench.py PT_BENCH_CHILD mode); a wedge mid-suite still
-leaves every completed number on disk (the file is rewritten after each
-step).
+Leg order (bf16 first so a short window still captures the north-star):
+  bf16_policy / fp32_headline / amp_rewrite / bf16_b256 / resnet50,
+  then dataset-overlap A/B, the curated on-chip smoke pytest subset
+  (writes ONCHIP_SMOKE.log), and the long-seq flash + decode sweep.
 
   PYTHONPATH=/root/repo:/root/.axon_site python tools/bench_onchip_all.py
 """
@@ -26,13 +27,14 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(ROOT, "bench.py")
 OUT = os.path.join(ROOT, "ONCHIP_RESULTS.json")
 
 
-def probe(budget=120):
+def probe(budget=45):
     # machinery-test mode must not touch the axon tunnel at all: the
     # ambient sitecustomize freezes platform selection so JAX_PLATFORMS=cpu
     # alone is ignored — override via the config API inside the child
@@ -67,48 +69,113 @@ def run_bench(label, extra_env, budget):
     return rec
 
 
-def main():
-    budget = float(os.environ.get("PT_BENCH_TIMEOUT", "1200"))
-    results = {"device": probe()}
-    if results["device"] is None:
-        print(json.dumps({"error": "device probe hung — tunnel wedged"}))
-        return 1
-    try:
-        sys.path.insert(0, ROOT)
-        from paddle_tpu.fluid.platform_utils import TPU_PLATFORMS
-    except Exception:  # standalone fallback; keep in sync
-        TPU_PLATFORMS = ("tpu", "axon")
-    platform = results["device"].split()[0]
-    # machinery = the probe found no TPU and the operator opted into a
-    # CPU run-through.  Derived from the platform check, NOT from env:
-    # a stale PT_BENCH_FORCE_CPU in the shell must not flip a real
-    # tunnel-window run into machinery behavior.
-    machinery = platform not in TPU_PLATFORMS
-    if machinery:
-        if not os.environ.get("PT_ONCHIP_ALLOW_CPU"):
-            # ONCHIP_RESULTS.json must only ever hold real-chip numbers — a
-            # stray CPU invocation would poison the vs_baseline fallback
-            print(json.dumps({"error": f"device is {platform!r}, not a TPU; "
-                              "set PT_ONCHIP_ALLOW_CPU=1 for machinery "
-                              "tests"}))
-            return 1
-        # machinery-test mode: force every child to stamp CPU-FALLBACK into
-        # its config so these numbers can never become a baseline, and
-        # write to a sidecar so the real on-chip artifact is never clobbered
-        os.environ["PT_BENCH_FORCE_CPU"] = "1"
-        global OUT
-        OUT = os.path.join(ROOT, "ONCHIP_RESULTS.machinery.json")
-    else:
-        # conversely, a stale flag must not stamp CPU-FALLBACK into a
-        # real on-chip record
-        os.environ.pop("PT_BENCH_FORCE_CPU", None)
+def _captured(entry):
+    """True if the entry holds a real result worth keeping: a bench value
+    (not the CPU-FALLBACK rung), a passing smoke run (rc 0), a profile
+    breakdown (full_step), or a longseq sweep (flash_speedup)."""
+    if not isinstance(entry, dict) or "error" in entry:
+        return False
+    if "CPU-FALLBACK" in str(entry.get("config", "")):
+        return False
+    if entry.get("rc") not in (None, 0):
+        return False  # smoke subset ran but failed — retry next window
+    if "flash_speedup" in entry:
+        # a sweep where every leg failed prints {"flash_speedup": {}} —
+        # that is not a capture, retry it
+        return bool(entry["flash_speedup"])
+    return any(k in entry for k in ("value", "rc", "full_step"))
 
-    def save():
-        with open(OUT, "w") as f:
-            json.dump(results, f, indent=1)
 
-    save()
-    steps = [
+class Suite:
+    def __init__(self):
+        self.machinery = False
+        self.out = OUT
+        self.results = {}
+        # PT_ONCHIP_REFRESH: comma-list of legs (or "all") whose previously
+        # captured numbers are STALE (e.g. a perf fix landed since) — they
+        # re-run even though captured, and the old value stays on disk until
+        # a fresh capture replaces it, so the vs_baseline fallback never
+        # loses its reference mid-hunt.
+        refresh = os.environ.get("PT_ONCHIP_REFRESH", "")
+        self.stale = (set(k for k, _ in self.BENCH_LEGS)
+                      | {"dataset_overlap", "onchip_smoke", "longseq"}
+                      if refresh.strip() == "all"
+                      else {s.strip() for s in refresh.split(",") if s.strip()})
+
+    def load(self):
+        """Merge any previously captured numbers so a pass can only add."""
+        try:
+            with open(self.out) as f:
+                prev = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return
+        for key, entry in prev.items():
+            if key == "device" or _captured(entry):
+                self.results.setdefault(key, entry)
+
+    def save(self):
+        with open(self.out, "w") as f:
+            json.dump(self.results, f, indent=1)
+
+    def record(self, label, entry):
+        """Keep the fresh entry unless it would clobber a captured one."""
+        if _captured(self.results.get(label)) and not _captured(entry):
+            return
+        self.stale.discard(label)
+        self.results[label] = entry
+        print(json.dumps({"label": label, **{k: v for k, v in entry.items()
+                                             if k != "label"}}), flush=True)
+        self.save()
+
+    def gate(self, label):
+        """45 s probe before a leg; records a cheap wedge marker on hang."""
+        dev = probe()
+        if dev is None:
+            self.record(label, {"label": label,
+                                "error": "tunnel wedged at probe"})
+            return False
+        self.results["device"] = dev
+        return True
+
+    def setup(self):
+        """One device probe decides machinery vs on-chip for this pass."""
+        dev = probe(budget=120)
+        if dev is None:
+            return False
+        self.results["device"] = dev
+        try:
+            sys.path.insert(0, ROOT)
+            from paddle_tpu.fluid.platform_utils import TPU_PLATFORMS
+        except Exception:  # standalone fallback; keep in sync
+            TPU_PLATFORMS = ("tpu", "axon")
+        platform = dev.split()[0]
+        # machinery = the probe found no TPU and the operator opted into a
+        # CPU run-through.  Derived from the platform check, NOT from env:
+        # a stale PT_BENCH_FORCE_CPU in the shell must not flip a real
+        # tunnel-window run into machinery behavior.
+        self.machinery = platform not in TPU_PLATFORMS
+        if self.machinery:
+            if not os.environ.get("PT_ONCHIP_ALLOW_CPU"):
+                # ONCHIP_RESULTS.json must only ever hold real-chip numbers —
+                # a stray CPU invocation would poison the vs_baseline fallback
+                print(json.dumps({"error": f"device is {platform!r}, not a "
+                                  "TPU; set PT_ONCHIP_ALLOW_CPU=1 for "
+                                  "machinery tests"}))
+                return None
+            # machinery-test mode: force every child to stamp CPU-FALLBACK
+            # into its config so these numbers can never become a baseline,
+            # and write to a sidecar so the real artifact is never clobbered
+            os.environ["PT_BENCH_FORCE_CPU"] = "1"
+            self.out = os.path.join(ROOT, "ONCHIP_RESULTS.machinery.json")
+        else:
+            # conversely, a stale flag must not stamp CPU-FALLBACK into a
+            # real on-chip record
+            os.environ.pop("PT_BENCH_FORCE_CPU", None)
+        return True
+
+    # --- stages -----------------------------------------------------------
+
+    BENCH_LEGS = [
         # bf16 policy is bench.py's default headline (the north-star
         # config); every stage pins ALL THREE dtype knobs so ambient env
         # can never mislabel an A/B leg (the bench_longseq lesson)
@@ -125,84 +192,140 @@ def main():
         ("resnet50", {"PT_BENCH_MODEL": "resnet50", "PT_BENCH_BF16": "1",
                       "PT_BENCH_FP32": "0", "PT_BENCH_AMP": "0"}),
     ]
-    for label, env in steps:
-        results[label] = run_bench(label, env, budget)
-        print(json.dumps(results[label]), flush=True)
-        save()
 
-    if ("value" in results.get("fp32_headline", {})
-            and "value" in results.get("bf16_policy", {})):
-        results["bf16_speedup"] = round(
-            results["bf16_policy"]["value"]
-            / results["fp32_headline"]["value"], 3)
+    def bench_legs(self, budget):
+        for label, env in self.BENCH_LEGS:
+            if self.done(label):
+                continue
+            if not (self.machinery or self.gate(label)):
+                continue
+            self.record(label, run_bench(label, env, budget))
+        if ("value" in self.results.get("fp32_headline", {})
+                and "value" in self.results.get("bf16_policy", {})):
+            self.results["bf16_speedup"] = round(
+                self.results["bf16_policy"]["value"]
+                / self.results["fp32_headline"]["value"], 3)
+            self.save()
 
-    # dataset ingestion/compute overlap — the wall-clock win only shows
-    # when steps run on-chip (host cores free for parse+transfer).
-    # Machinery mode must NOT set PT_OVERLAP_TPU: the overlap child forces
-    # CPU only when that flag is unset, so setting it would drive the
-    # wedged tunnel for the full budget.
-    overlap_env = dict(os.environ)
-    if not machinery:
-        overlap_env["PT_OVERLAP_TPU"] = "1"
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.join(ROOT, "tools",
-                                          "bench_dataset_overlap.py")],
-            env=overlap_env,
-            capture_output=True, text=True, timeout=budget)
-        lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
-        results["dataset_overlap"] = (json.loads(lines[-1]) if lines
-                                      else {"error": out.stderr[-400:]})
-    except subprocess.TimeoutExpired:
-        results["dataset_overlap"] = {"error": "overlap bench timeout"}
-    except json.JSONDecodeError as e:
-        results["dataset_overlap"] = {"error": f"unparseable: {e}"}
-    save()
+    def _run_tool(self, label, script, timeout, extra_env=None):
+        """Probe-gate, run a tools/ script, record its last JSON line."""
+        if self.done(label):
+            return
+        if not (self.machinery or self.gate(label)):
+            return
+        env = dict(os.environ, **(extra_env or {}))
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.join(ROOT, "tools", script)],
+                env=env, capture_output=True, text=True, timeout=timeout)
+            lines = [ln for ln in out.stdout.splitlines()
+                     if ln.startswith("{")]
+            rec = (json.loads(lines[-1]) if lines
+                   else {"error": out.stderr[-400:]})
+        except subprocess.TimeoutExpired:
+            rec = {"error": f"{label} timeout {timeout:.0f}s"}
+        except json.JSONDecodeError as e:
+            rec = {"error": f"unparseable: {e}"}
+        self.record(label, rec)
 
-    # curated correctness smoke subset ON the chip (VERDICT r2 item 2) —
-    # the same tests the CPU-mesh suite runs continuously.  Machinery mode
-    # runs it on the CPU mesh instead (PADDLE_TPU_TEST_REAL=1 would hang
-    # for 2x budget against a wedged tunnel) and logs to the sidecar.
-    smoke_env = dict(os.environ)
-    if machinery:
-        smoke_env.pop("PADDLE_TPU_TEST_REAL", None)
-    else:
-        smoke_env["PADDLE_TPU_TEST_REAL"] = "1"
-    smoke_log = os.path.join(
-        ROOT, "ONCHIP_SMOKE.machinery.log" if machinery
-        else "ONCHIP_SMOKE.log")
-    try:
-        out = subprocess.run(
-            [sys.executable, "-m", "pytest",
-             os.path.join(ROOT, "tests", "test_onchip_smoke.py"),
-             "-m", "onchip", "-q", "--no-header"],
-            env=smoke_env,
-            capture_output=True, text=True, timeout=budget * 2, cwd=ROOT)
-        tail = (out.stdout.strip().splitlines() or ["?"])[-1]
-        results["onchip_smoke"] = {"rc": out.returncode, "tail": tail}
-        with open(smoke_log, "w") as f:
-            f.write(out.stdout[-8000:] + "\n" + out.stderr[-4000:])
-    except subprocess.TimeoutExpired:
-        results["onchip_smoke"] = {"error": "smoke tests timed out"}
-    save()
+    def dataset_overlap(self, budget):
+        # the wall-clock win only shows when steps run on-chip (host cores
+        # free for parse+transfer).  Machinery mode must NOT set
+        # PT_OVERLAP_TPU: the overlap child forces CPU only when that flag
+        # is unset, so setting it would drive the wedged tunnel all budget.
+        env = {} if self.machinery else {"PT_OVERLAP_TPU": "1"}
+        self._run_tool("dataset_overlap", "bench_dataset_overlap.py",
+                       budget, env)
 
-    # long-seq flash sweep + GPT decode (writes its own sidecar too)
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.join(ROOT, "tools", "bench_longseq.py")],
-            capture_output=True, text=True, timeout=budget * 7)
-        lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
-        results["longseq"] = (json.loads(lines[-1]) if lines
-                              else {"error": out.stderr[-400:]})
-    except subprocess.TimeoutExpired:
-        results["longseq"] = {"error": "sweep timeout"}
-    except json.JSONDecodeError as e:
-        results["longseq"] = {"error": f"unparseable sweep output: {e}"}
-    save()
+    def smoke(self, budget):
+        # curated correctness smoke subset ON the chip (VERDICT r2 item 2) —
+        # the same tests the CPU-mesh suite runs continuously.  Machinery
+        # mode runs it on the CPU mesh instead (PADDLE_TPU_TEST_REAL=1 would
+        # hang for the whole budget against a wedged tunnel).
+        if self.done("onchip_smoke"):
+            return
+        if not (self.machinery or self.gate("onchip_smoke")):
+            return
+        env = dict(os.environ)
+        if self.machinery:
+            env.pop("PADDLE_TPU_TEST_REAL", None)
+        else:
+            env["PADDLE_TPU_TEST_REAL"] = "1"
+        log = os.path.join(
+            ROOT, "ONCHIP_SMOKE.machinery.log" if self.machinery
+            else "ONCHIP_SMOKE.log")
+        try:
+            out = subprocess.run(
+                [sys.executable, "-m", "pytest",
+                 os.path.join(ROOT, "tests", "test_onchip_smoke.py"),
+                 "-m", "onchip", "-q", "--no-header"],
+                env=env, capture_output=True, text=True,
+                timeout=budget * 2, cwd=ROOT)
+            tail = (out.stdout.strip().splitlines() or ["?"])[-1]
+            rec = {"rc": out.returncode, "tail": tail}
+            with open(log, "w") as f:
+                f.write(out.stdout[-8000:] + "\n" + out.stderr[-4000:])
+        except subprocess.TimeoutExpired:
+            rec = {"error": "smoke tests timed out"}
+        self.record("onchip_smoke", rec)
 
-    print(json.dumps({"written": OUT,
-                      "bf16_speedup": results.get("bf16_speedup"),
-                      "onchip_smoke": results.get("onchip_smoke")}))
+    def profile(self, budget):
+        # step-time breakdown + XLA cost/roofline analysis for the headline
+        # config (PERF.md lever 2) — tools/profile_step.py
+        self._run_tool("profile_step", "profile_step.py", budget)
+
+    def longseq(self, budget):
+        # long-seq flash sweep + GPT decode; its sidecar goes to .machinery
+        # in machinery mode so CPU numbers never clobber the on-chip sweep
+        env = ({"PT_LONGSEQ_OUT": os.path.join(
+                    ROOT, "LONGSEQ_BENCH.machinery.json")}
+               if self.machinery else {})
+        self._run_tool("longseq", "bench_longseq.py", budget * 7, env)
+
+    def done(self, label):
+        return (_captured(self.results.get(label))
+                and label not in self.stale)
+
+    def complete(self):
+        keys = [label for label, _ in self.BENCH_LEGS]
+        keys += ["dataset_overlap", "onchip_smoke", "profile_step",
+                 "longseq"]
+        return all(self.done(k) for k in keys)
+
+
+def main():
+    budget = float(os.environ.get("PT_BENCH_TIMEOUT", "900"))
+    passes = int(os.environ.get("PT_ONCHIP_PASSES", "1"))
+    sleep_s = float(os.environ.get("PT_ONCHIP_SLEEP", "300"))
+    suite = Suite()
+    ran = False
+    for i in range(passes):
+        if i:
+            time.sleep(sleep_s)
+        ok = suite.setup()
+        if ok is None:
+            return 1  # CPU device without the machinery opt-in
+        if not ok:
+            print(json.dumps({"pass": i, "error": "device probe hung — "
+                              "tunnel wedged"}), flush=True)
+            continue
+        ran = True
+        suite.load()
+        suite.save()
+        suite.bench_legs(budget)
+        suite.dataset_overlap(budget)
+        suite.smoke(budget)
+        suite.profile(budget)
+        suite.longseq(budget)
+        if suite.complete():
+            break
+    if not ran:
+        print(json.dumps({"error": "no tunnel window in "
+                          f"{passes} pass(es)"}))
+        return 1
+    print(json.dumps({"written": suite.out,
+                      "bf16_speedup": suite.results.get("bf16_speedup"),
+                      "onchip_smoke": suite.results.get("onchip_smoke")}))
     return 0
 
 

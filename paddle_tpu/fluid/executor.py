@@ -634,6 +634,29 @@ class Executor:
                 if isinstance(key, tuple) and key
                 and key[0] == id(program)]
 
+    def cost_analysis(self, program, feed, fetch_list=None, scope=None):
+        """XLA cost/memory analysis for an already-run (program, feed,
+        fetch_list) step — see _CompiledBlock.cost_analysis.  Coerces the
+        feed exactly as run() does (the bf16 policy narrows float feeds),
+        so the AOT lowering hits the executable run() compiled rather than
+        silently analyzing a differently-typed variant."""
+        scope = scope or global_scope()
+        feed = self._coerce_feed(program, feed)
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in (fetch_list or [])]
+        feed_sig = tuple(
+            (k, tuple(np.shape(v)),
+             str(v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype))
+            for k, v in sorted(feed.items()))
+        key = (id(program), program._version, feed_sig,
+               tuple(fetch_names), self.place)
+        cb = self._cache.get(key)
+        if cb is None:
+            raise ValueError(
+                "no compiled executable for this (program, feed, "
+                "fetch_list) signature — run the step once first")
+        return cb.cost_analysis(scope, feed)
+
     def close(self):
         self._cache.clear()
 

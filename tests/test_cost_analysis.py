@@ -33,7 +33,9 @@ def test_cost_analysis_counts_step_flops():
         exe.run(main, feed=feed, fetch_list=[loss])
         blocks = exe.compiled_for(main)
         assert len(blocks) == 1, "one feed/fetch signature → one executable"
-        rec = blocks[0].cost_analysis(scope, exe._coerce_feed(main, feed))
+        # public wrapper: coerces the feed and routes to the executable
+        # run() compiled for this exact (program, feed, fetch) signature
+        rec = exe.cost_analysis(main, feed, fetch_list=[loss])
         flops = rec["cost"].get("flops", 0.0)
         # fwd 2*(8*16*32 + 8*32) ≈ 8.7k; with bwd+SGD the step is several
         # times that — the exact count is XLA's business, the order isn't
@@ -48,6 +50,12 @@ def test_cost_analysis_counts_step_flops():
         exe.run(main, feed={"x": feed["x"][:4], "y": feed["y"][:4]},
                 fetch_list=[loss])
         assert len(exe.compiled_for(main)) == 2
+        # a signature that never ran is a named error, not a silent compile
+        import pytest
+
+        with pytest.raises(ValueError, match="run the step once first"):
+            exe.cost_analysis(main, {"x": feed["x"][:3], "y": feed["y"][:3]},
+                              fetch_list=[loss])
 
 
 def test_compiled_for_ignores_other_programs():

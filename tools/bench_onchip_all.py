@@ -98,7 +98,7 @@ class Suite:
         # loses its reference mid-hunt.
         refresh = os.environ.get("PT_ONCHIP_REFRESH", "")
         self.stale = (set(k for k, _ in self.BENCH_LEGS)
-                      | {"dataset_overlap", "onchip_smoke", "longseq"}
+                      | set(self.EXTRA_LEGS)
                       if refresh.strip() == "all"
                       else {s.strip() for s in refresh.split(",") if s.strip()})
 
@@ -282,14 +282,16 @@ class Suite:
                if self.machinery else {})
         self._run_tool("longseq", "bench_longseq.py", budget * 7, env)
 
+    EXTRA_LEGS = ("dataset_overlap", "onchip_smoke", "profile_step",
+                  "longseq")
+
     def done(self, label):
         return (_captured(self.results.get(label))
                 and label not in self.stale)
 
     def complete(self):
         keys = [label for label, _ in self.BENCH_LEGS]
-        keys += ["dataset_overlap", "onchip_smoke", "profile_step",
-                 "longseq"]
+        keys += list(self.EXTRA_LEGS)
         return all(self.done(k) for k in keys)
 
 

@@ -27,7 +27,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
@@ -36,24 +35,10 @@ sys.path.insert(0, ROOT)
 HBM_GBPS = float(os.environ.get("PT_TPU_HBM_GBPS", "819"))
 
 
-def _timed(exe, prog, data, fetch, n):
-    for _ in range(2):
-        exe.run(prog, feed=data, fetch_list=[fetch])
-    t0 = time.perf_counter()
-    for _ in range(n):
-        exe.run(prog, feed=data, fetch_list=[fetch])
-    return (time.perf_counter() - t0) / n
-
-
-def _analyze(exe, prog, scope, data, dt_s, peak_tflops):
+def _analyze(exe, prog, data, loss, dt_s, peak_tflops):
     """Merge measured time with the executable's cost analysis."""
     rec = {"ms": round(dt_s * 1e3, 2)}
-    blocks = exe.compiled_for(prog)
-    if not blocks:
-        return rec
-    # coerce exactly as Executor.run does (bf16 policy narrows float feeds)
-    # so the AOT lowering hits the already-compiled executable
-    cost = blocks[0].cost_analysis(scope, exe._coerce_feed(prog, data))
+    cost = exe.cost_analysis(prog, data, fetch_list=[loss])
     flops = float(cost["cost"].get("flops", 0.0))
     byt = float(cost["cost"].get("bytes accessed", 0.0))
     rec["xla_gflops"] = round(flops / 1e9, 2)
@@ -126,8 +111,12 @@ def main():
         exe.run(startup)
         data = bert.make_fake_batch(cfg, batch=batch, seq_len=seq_len,
                                     seed=0)
-        dt_full = _timed(exe, main_prog, data, loss.name, n_steps)
-        dt_fwd = _timed(exe, fwd_prog, data, loss.name, n_steps)
+        # bench's shared warmup + timed loop, so the two tools can never
+        # diverge on sync/warmup semantics
+        dt_full = bench._timed_steps(exe, main_prog, data, loss.name,
+                                     n_steps) / n_steps
+        dt_fwd = bench._timed_steps(exe, fwd_prog, data, loss.name,
+                                    n_steps) / n_steps
 
         out = {
             "config": (f"bert-{size} b{batch} s{seq_len}"
@@ -139,8 +128,9 @@ def main():
             "hbm_gbps": HBM_GBPS,
             "analytic_train_gflops": round(flops_model / 1e9, 1),
             "tokens_per_sec": round(batch * seq_len / dt_full, 1),
-            "forward": _analyze(exe, fwd_prog, scope, data, dt_fwd, peak),
-            "full_step": _analyze(exe, main_prog, scope, data, dt_full,
+            "forward": _analyze(exe, fwd_prog, data, loss.name, dt_fwd,
+                                peak),
+            "full_step": _analyze(exe, main_prog, data, loss.name, dt_full,
                                   peak),
             "bwd_optimizer": {"ms": round((dt_full - dt_fwd) * 1e3, 2)},
         }

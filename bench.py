@@ -206,6 +206,112 @@ def measure_resnet(size):
     }, 3.0 * fwd * batch, n_steps, dt)
 
 
+def measure_nmt(size):
+    """Transformer NMT tokens/sec on VARIABLE-LENGTH batches
+    (PT_BENCH_MODEL=nmt): BASELINE.md north-star #4, the dynamic-shape
+    stress.  Ragged sentence lengths are bucketed (one XLA compile per
+    bucket, reference-LoD semantics via label_weight masking), batches are
+    token-budgeted (batch = tokens/bucket_len, the classic NMT recipe),
+    and the metric counts EFFECTIVE (non-pad) target+source tokens — so
+    padding waste shows up as a lower number, not a hidden flattery.
+    MFU comes from XLA's own per-bucket flop counts (Executor.cost_analysis)
+    rather than an analytic model."""
+    import numpy as np
+
+    from paddle_tpu import fluid
+    from paddle_tpu.models import transformer as tfm
+
+    tokens_budget = int(os.environ.get("PT_BENCH_TOKENS", "8192"))
+    n_rounds = int(os.environ.get("PT_BENCH_STEPS", "3"))
+    bf16 = _bf16_default()
+    if size == "tiny":
+        cfg = tfm.TransformerConfig.tiny()
+        buckets = [16, 32]
+        scale = "tiny"
+    else:
+        cfg = tfm.TransformerConfig.big()
+        buckets = [32, 64, 128, 256]
+        scale = "big"
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
+        feeds, cost, acc = tfm.build_transformer_nmt(cfg)
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(cost)
+    _maybe_enable_bf16(main_prog, bf16)
+    exe = fluid.Executor()
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+
+    def ragged_batch(bucket, lo):
+        """Token-budget batch padded to `bucket`; true lengths are uniform
+        in (lo, bucket], label_weight zeroes the padding.  Effective =
+        non-pad source tokens + non-pad target tokens (the docstring's
+        src+trg convention)."""
+        batch = max(tokens_budget // bucket, 1)
+        lens = rng.randint(lo + 1, bucket + 1, batch)
+        data = tfm.make_fake_batch(cfg, batch=batch, src_len=bucket,
+                                   trg_len=bucket - 1, seed=int(lens[0]))
+        w = np.zeros_like(data["label_weight"])
+        for i, ln in enumerate(lens):
+            data["src_ids"][i, ln:] = 0  # pad_id
+            w[i, :ln - 1] = 1.0
+        data["label_weight"] = w
+        effective = int(lens.sum()) + int(w.sum())
+        return data, effective
+
+    los = [0] + buckets[:-1]
+    # one warmup step per bucket = one compile per bucket (the bucketing
+    # contract: recompiles are bounded by the bucket list, not by the
+    # number of distinct sentence lengths)
+    schedule = []
+    step_flops = 0.0
+    for bucket, lo in zip(buckets, los):
+        data, eff = ragged_batch(bucket, lo)
+        exe.run(main_prog, feed=data, fetch_list=[cost.name])
+        schedule.append((data, eff, bucket))
+        try:
+            # XLA's own flop count for this bucket's executable — gathered
+            # OUTSIDE the timed loop (lower() re-traces on every call)
+            step_flops += float(
+                exe.cost_analysis(main_prog, data, fetch_list=[cost.name])
+                ["cost"].get("flops", 0.0))
+        except Exception:
+            pass  # cost model unavailable on this backend
+    n_compiles = len(exe.compiled_for(main_prog))
+
+    t0 = time.perf_counter()
+    eff_tokens = pad_tokens = 0
+    for _ in range(n_rounds):
+        for data, eff, bucket in schedule:
+            exe.run(main_prog, feed=data, fetch_list=[cost.name])
+            eff_tokens += eff
+            pad_tokens += data["src_ids"].size + data["labels"].size
+    dt = time.perf_counter() - t0
+    xla_flops = step_flops * n_rounds
+
+    tps = eff_tokens / dt
+    config = (f"transformer-{scale} nmt varlen buckets={buckets} "
+              f"tok{tokens_budget}" + (" bf16-policy" if bf16 else "")
+              + _cpu_suffix())
+    rec = {
+        "metric": f"transformer_{scale}_nmt_effective_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": _vs_baseline(tps, config, is_headline=False),
+        "config": config,
+        "padding_overhead": round(pad_tokens / max(eff_tokens, 1) - 1, 3),
+        "bucket_compiles": n_compiles,
+    }
+    peak = _peak_tflops()
+    if xla_flops and dt:
+        rec["tflops_per_sec"] = round(xla_flops / dt / 1e12, 2)
+        if peak:
+            rec["mfu"] = round(xla_flops / dt / 1e12 / peak, 4)
+            rec["peak_tflops"] = peak
+    return rec
+
+
 def measure_gpt_decode(size):
     """GPT autoregressive decode tokens/sec with the KV cache
     (PT_BENCH_MODEL=gpt): the latency-bound serving metric, complementing
@@ -275,6 +381,8 @@ def measure(size):
         return measure_resnet(size)
     if model == "gpt":
         return measure_gpt_decode(size)
+    if model in ("nmt", "transformer"):
+        return measure_nmt(size)
     import numpy as np
 
     from paddle_tpu import fluid
@@ -394,11 +502,18 @@ def main():
         print("bench: no usable device — going straight to the CPU rung",
               file=sys.stderr)
 
-    mid_batch = "8" if model == "gpt" else "64"
+    # the mid rung must be strictly LIGHTER than the first (it runs in a
+    # smaller slice after the first timed out): gpt/bert/resnet shrink the
+    # batch; nmt is token-budgeted so it shrinks the per-bucket token
+    # budget and round count instead (PT_BENCH_BATCH is ignored there)
+    if model in ("nmt", "transformer"):
+        mid_overrides = {"PT_BENCH_TOKENS": "4096", "PT_BENCH_STEPS": "2"}
+    else:
+        mid_overrides = {"PT_BENCH_BATCH": "8" if model == "gpt" else "64",
+                         "PT_BENCH_STEPS": "6"}
     device_ladder = (
         ("base", {}, total * 0.40),
-        ("base", {"PT_BENCH_BATCH": mid_batch, "PT_BENCH_STEPS": "6"},
-         total * 0.22),
+        ("base", mid_overrides, total * 0.22),
         ("tiny", {}, total * 0.14),
     )
     # the CPU rung stays fp32: it exists only as a labeled liveness number,
@@ -414,8 +529,9 @@ def main():
         # it ALL remaining time, not just its nominal reservation
         budget = (deadline - time.time() if is_cpu_rung
                   else min(alloc, deadline - time.time() - cpu_reserve))
-        label = size + ("" if not overrides else
-                        " b" + overrides.get("PT_BENCH_BATCH", "?"))
+        label = size + "".join(
+            f" {k[len('PT_BENCH_'):].lower()}={v}"
+            for k, v in sorted(overrides.items()))
         if budget < (10.0 if is_cpu_rung else 30.0):
             print(f"bench: skipping {label} (only {budget:.0f}s left)",
                   file=sys.stderr)
@@ -440,6 +556,9 @@ def main():
         failed_metric = ("resnet50_train_images_per_sec", "images/sec/chip")
     elif model == "gpt":
         failed_metric = ("gpt_base_decode_tokens_per_sec",
+                         "tokens/sec/chip")
+    elif model in ("nmt", "transformer"):
+        failed_metric = ("transformer_big_nmt_effective_tokens_per_sec",
                          "tokens/sec/chip")
     else:
         failed_metric = ("bert_base_pretrain_tokens_per_sec",

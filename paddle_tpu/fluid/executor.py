@@ -634,6 +634,20 @@ class Executor:
                 if isinstance(key, tuple) and key
                 and key[0] == id(program)]
 
+    def _cache_key(self, program, feed, fetch_names):
+        """Executable-cache key: one compiled block per (program version,
+        feed signature, fetch list, place).  Single source of truth shared
+        by run() and cost_analysis() — the two must agree or introspection
+        misses executables that ran."""
+        # v.dtype directly: np.asarray on a device-resident jax array would
+        # force a host transfer just to read the dtype
+        feed_sig = tuple(
+            (k, tuple(np.shape(v)),
+             str(v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype))
+            for k, v in sorted(feed.items()))
+        return (id(program), program._version, feed_sig,
+                tuple(fetch_names), self.place)
+
     def cost_analysis(self, program, feed, fetch_list=None, scope=None):
         """XLA cost/memory analysis for an already-run (program, feed,
         fetch_list) step — see _CompiledBlock.cost_analysis.  Coerces the
@@ -644,13 +658,7 @@ class Executor:
         feed = self._coerce_feed(program, feed)
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
-        feed_sig = tuple(
-            (k, tuple(np.shape(v)),
-             str(v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype))
-            for k, v in sorted(feed.items()))
-        key = (id(program), program._version, feed_sig,
-               tuple(fetch_names), self.place)
-        cb = self._cache.get(key)
+        cb = self._cache.get(self._cache_key(program, feed, fetch_names))
         if cb is None:
             raise ValueError(
                 "no compiled executable for this (program, feed, "
@@ -716,14 +724,7 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else f for f in fetch_list]
 
         block = program.global_block()
-        # v.dtype directly: np.asarray on a device-resident jax array would
-        # force a host transfer just to read the dtype
-        feed_sig = tuple(
-            (k, tuple(np.shape(v)),
-             str(v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype))
-            for k, v in sorted(feed.items())
-        )
-        key = (id(program), program._version, feed_sig, tuple(fetch_names), self.place)
+        key = self._cache_key(program, feed, fetch_names)
         cb = self._cache.get(key)
         if cb is None:
             import time as _time

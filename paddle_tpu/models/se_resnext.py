@@ -15,7 +15,7 @@ from paddle_tpu import fluid
 from paddle_tpu.fluid import layers
 from paddle_tpu.fluid.param_attr import ParamAttr
 
-from .resnet import conv_bn_layer
+from .resnet import conv_bn_layer, shortcut
 
 # depth → (block counts, cardinality, base group width, SE reduction)
 DEPTH_CFG = {
@@ -53,12 +53,8 @@ def se_bottleneck_block(input, num_filters, stride, cardinality,
                           name=name + "_conv3", is_test=is_test)
     scaled = squeeze_excitation(conv2, num_filters * 2, reduction_ratio,
                                 name=name + "_se")
-    ch_out = num_filters * 2
-    if input.shape[1] != ch_out or stride != 1:
-        short = conv_bn_layer(input, ch_out, 1, stride=stride,
-                              name=name + "_shortcut", is_test=is_test)
-    else:
-        short = input
+    short = shortcut(input, num_filters * 2, stride,
+                     name=name + "_shortcut", is_test=is_test)
     return layers.relu(layers.elementwise_add(short, scaled))
 
 

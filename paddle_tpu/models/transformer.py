@@ -51,6 +51,16 @@ class TransformerConfig:
         d.update(kw)
         return cls(**d)
 
+    @classmethod
+    def big(cls, **kw):
+        """Transformer-big (Vaswani et al.): the BASELINE.md NMT
+        dynamic-shape stress config."""
+        d = dict(src_vocab=30000, trg_vocab=30000, max_len=256,
+                 hidden_size=1024, num_heads=16, ffn_size=4096,
+                 num_encoder_layers=6, num_decoder_layers=6)
+        d.update(kw)
+        return cls(**d)
+
 
 def _fc(x, size, name, act=None, init_std=0.02):
     return layers.fc(

@@ -1,0 +1,23 @@
+"""bench.py's NMT variable-length mode (BASELINE.md north-star #4): ragged
+lengths bucket to a bounded set of XLA compiles, the metric counts only
+non-pad tokens, and XLA's flop count feeds the MFU field."""
+
+import bench
+
+
+def test_measure_nmt_tiny_buckets_and_counts(monkeypatch):
+    monkeypatch.setenv("PT_BENCH_TOKENS", "64")
+    monkeypatch.setenv("PT_BENCH_STEPS", "1")
+    monkeypatch.delenv("PT_BENCH_FP32", raising=False)
+    monkeypatch.delenv("PT_BENCH_AMP", raising=False)
+    rec = bench.measure_nmt("tiny")
+    assert rec["metric"] == "transformer_tiny_nmt_effective_tokens_per_sec"
+    assert rec["value"] > 0
+    # bucketing contract: ragged lengths cost one compile per bucket, not
+    # one per distinct length
+    assert rec["bucket_compiles"] == 2
+    # padding exists (lengths are ragged) and is reported, not hidden
+    assert 0 < rec["padding_overhead"] < 3
+    assert "varlen" in rec["config"]
+    # XLA cost model feeds the throughput-in-flops field on CPU too
+    assert rec.get("tflops_per_sec", 0) >= 0

@@ -191,6 +191,10 @@ class Suite:
                        "PT_BENCH_AMP": "0", "PT_BENCH_BATCH": "256"}),
         ("resnet50", {"PT_BENCH_MODEL": "resnet50", "PT_BENCH_BF16": "1",
                       "PT_BENCH_FP32": "0", "PT_BENCH_AMP": "0"}),
+        # BASELINE.md north-star #4: transformer-big NMT over ragged
+        # bucketed lengths (the dynamic-shape stress), effective tokens/sec
+        ("nmt_varlen", {"PT_BENCH_MODEL": "nmt", "PT_BENCH_BF16": "1",
+                        "PT_BENCH_FP32": "0", "PT_BENCH_AMP": "0"}),
     ]
 
     def bench_legs(self, budget):

@@ -34,8 +34,25 @@ ONCHIP_RESULTS_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "ONCHIP_RESULTS.json")
 
 
+# effective dispatch of the last _timed_steps call: "pipelined" when the
+# fetch-free chain ran, "syncfetch" when per-step fetches did (either the
+# env knob or the write-free-program fallback)
+_last_dispatch = None
+
+
 def _cpu_suffix():
-    return " CPU-FALLBACK" if os.environ.get("PT_BENCH_FORCE_CPU") else ""
+    suffix = " CPU-FALLBACK" if os.environ.get("PT_BENCH_FORCE_CPU") else ""
+    if os.environ.get("PT_BENCH_SYNC_FETCH") == "1":
+        # fetch-every-step A/B variant: labeled so it can never be compared
+        # against a pipelined-dispatch record of the same shape
+        suffix = " syncfetch" + suffix
+    elif _last_dispatch == "pipelined":
+        # methodology marker: pre-pipelining records carry no marker, so an
+        # exact config match can never silently cross methodologies (the
+        # baseline fallback may still compare, but the configs differ on
+        # the record for anyone reading it)
+        suffix = " pipelined" + suffix
+    return suffix
 
 
 # bf16 peak TFLOPs per chip by PJRT device_kind substring (public specs);
@@ -99,12 +116,41 @@ def _attach_flops(result, flops_per_step, n_steps, dt):
 
 
 def _timed_steps(exe, prog, data, loss_name, n_steps):
-    """Shared warmup + timed loop (fetch→numpy syncs the device, so each
-    iteration is fully timed)."""
+    """Shared warmup + timed loop.
+
+    Default: steps dispatch WITHOUT per-step fetches so they pipeline on
+    the device through the donated param chain — the real training pattern
+    (losses are logged every ~100 steps, not every one); the final step
+    fetches the loss, which transitively blocks on the whole chain, so the
+    total time stays honest.  PT_BENCH_SYNC_FETCH=1 restores the
+    fetch-every-step variant; the A/B isolates the per-step host/tunnel
+    round-trip (large when the device is reached over the axon tunnel)."""
+    global _last_dispatch
+    sync = os.environ.get("PT_BENCH_SYNC_FETCH") == "1"
+    # warm BOTH signatures (fetch and no-fetch compile separate
+    # executables) so no compile lands inside the timed region
     for _ in range(2):
         exe.run(prog, feed=data, fetch_list=[loss_name])
+    if not sync:
+        exe.run(prog, feed=data, fetch_list=[])
+        cb = exe._cache.get(exe._cache_key(
+            prog, exe._coerce_feed(prog, data), ()))
+        if cb is None or not cb.write_names:
+            # write-free program (inference/decode): with nothing fetched
+            # AND nothing written, XLA dead-code-eliminates the whole step,
+            # so fetch-free iterations would time an empty executable —
+            # keep the per-step fetch for these
+            sync = True
+        else:
+            exe.run(prog, feed=data, fetch_list=[loss_name])  # drain chain
+    _last_dispatch = "syncfetch" if sync else "pipelined"
     t0 = time.perf_counter()
-    for _ in range(n_steps):
+    if sync:
+        for _ in range(n_steps):
+            exe.run(prog, feed=data, fetch_list=[loss_name])
+    else:
+        for _ in range(n_steps - 1):
+            exe.run(prog, feed=data, fetch_list=[])
         exe.run(prog, feed=data, fetch_list=[loss_name])
     return time.perf_counter() - t0
 
@@ -132,15 +178,23 @@ def _vs_baseline(value, config, is_headline, default_metric=False):
                 onchip = _json.load(f)
             recs = [onchip.get(k) or {} for k in
                     ("bf16_policy", "fp32_headline")]
-            match = [r for r in recs if "value" in r
-                     and "CPU-FALLBACK" not in r.get("config", "")
-                     and r.get("config") == config]
+
+            def find(cfg):
+                return [r for r in recs if "value" in r
+                        and "CPU-FALLBACK" not in r.get("config", "")
+                        and r.get("config") == cfg]
+
+            # exact config first; else the pre-pipelining record of the
+            # same shape (the ratio then includes the dispatch-methodology
+            # change — visible, because the two configs differ on disk)
+            match = find(config) or find(config.replace(" pipelined", ""))
             if match:
                 baseline = float(match[0]["value"])
                 base_cfg = base_cfg or match[0].get("config", "")
         except Exception:
             pass
-    cfg_match = (base_cfg == config or (default_metric and not base_cfg))
+    cfg_match = (base_cfg in (config, config.replace(" pipelined", ""))
+                 or (default_metric and not base_cfg))
     comparable = baseline > 0 and is_headline and cfg_match
     return round(value / baseline if comparable else
                  (1.0 if is_headline else 0.0), 3)

@@ -180,21 +180,26 @@ class Suite:
         # config); every stage pins ALL THREE dtype knobs so ambient env
         # can never mislabel an A/B leg (the bench_longseq lesson)
         ("bf16_policy", {"PT_BENCH_BF16": "1", "PT_BENCH_FP32": "0",
-                         "PT_BENCH_AMP": "0"}),
+                         "PT_BENCH_AMP": "0", "PT_BENCH_SYNC_FETCH": "0"}),
         ("fp32_headline", {"PT_BENCH_FP32": "1", "PT_BENCH_BF16": "0",
-                           "PT_BENCH_AMP": "0"}),
+                           "PT_BENCH_AMP": "0", "PT_BENCH_SYNC_FETCH": "0"}),
         ("amp_rewrite", {"PT_BENCH_AMP": "1", "PT_BENCH_FP32": "0",
-                         "PT_BENCH_BF16": "0"}),
+                         "PT_BENCH_BF16": "0", "PT_BENCH_SYNC_FETCH": "0"}),
         # b128 was tuned under fp32 timing; the bf16 step is ~3-4x shorter
         # so b256 may now amortize its compile cost — record the sweep point
         ("bf16_b256", {"PT_BENCH_BF16": "1", "PT_BENCH_FP32": "0",
-                       "PT_BENCH_AMP": "0", "PT_BENCH_BATCH": "256"}),
+                       "PT_BENCH_AMP": "0", "PT_BENCH_BATCH": "256", "PT_BENCH_SYNC_FETCH": "0"}),
         ("resnet50", {"PT_BENCH_MODEL": "resnet50", "PT_BENCH_BF16": "1",
-                      "PT_BENCH_FP32": "0", "PT_BENCH_AMP": "0"}),
+                      "PT_BENCH_FP32": "0", "PT_BENCH_AMP": "0", "PT_BENCH_SYNC_FETCH": "0"}),
         # BASELINE.md north-star #4: transformer-big NMT over ragged
         # bucketed lengths (the dynamic-shape stress), effective tokens/sec
         ("nmt_varlen", {"PT_BENCH_MODEL": "nmt", "PT_BENCH_BF16": "1",
-                        "PT_BENCH_FP32": "0", "PT_BENCH_AMP": "0"}),
+                        "PT_BENCH_FP32": "0", "PT_BENCH_AMP": "0", "PT_BENCH_SYNC_FETCH": "0"}),
+        # A/B: fetch-every-step vs the default pipelined dispatch — the
+        # delta is the per-step host/tunnel round-trip
+        ("bf16_syncfetch", {"PT_BENCH_BF16": "1", "PT_BENCH_FP32": "0",
+                            "PT_BENCH_AMP": "0",
+                            "PT_BENCH_SYNC_FETCH": "1"}),
     ]
 
     def bench_legs(self, budget):

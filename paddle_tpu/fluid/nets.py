@@ -29,8 +29,14 @@ def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
 def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
                    conv_filter_size=3, conv_act=None, param_attr=None,
                    conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
-                   pool_stride=1, pool_type="max", use_cudnn=True):
-    """VGG-style conv group (reference nets.py img_conv_group)."""
+                   pool_stride=1, pool_type="max", use_cudnn=True,
+                   is_test=False):
+    """VGG-style conv group (reference nets.py img_conv_group).
+
+    is_test is a TPU-native extension (default matches the reference,
+    which relies on Program.clone(for_test=True)): threads inference mode
+    into the group's batch_norm/dropout ops so a graph BUILT with
+    is_test=True evals with moving statistics rather than batch stats."""
     tmp = input
     assert isinstance(conv_num_filter, (list, tuple))
 
@@ -52,10 +58,12 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
             filter_size=conv_filter_size[i], padding=conv_padding[i],
             param_attr=param_attr[i], act=local_conv_act)
         if conv_with_batchnorm[i]:
-            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            tmp = layers.batch_norm(input=tmp, act=conv_act,
+                                    is_test=is_test)
             drop_rate = conv_batchnorm_drop_rate[i]
             if abs(drop_rate) > 1e-5:
-                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
+                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate,
+                                     is_test=is_test)
     return layers.pool2d(input=tmp, pool_size=pool_size, pool_type=pool_type,
                          pool_stride=pool_stride)
 

@@ -1,0 +1,63 @@
+"""VGG model family (models/vgg.py) — reference book vgg16_bn analog.
+Scaled-down groups run the full code path; structure checks pin the
+conv-group/BN composition and the three classifier FCs."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.models import vgg
+
+TINY_GROUPS = ([4, 4], [8, 8])
+
+
+def test_vgg_structure_and_training():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, pred, loss, acc = vgg.build_vgg(
+            class_dim=4, image_shape=(3, 16, 16), groups=TINY_GROUPS,
+            fc_dim=32)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    ops = [op.type for op in main.global_block().ops]
+    assert ops.count("conv2d") == 4  # two groups of two convs
+    assert ops.count("batch_norm") == 4  # BN after every conv
+    assert ops.count("pool2d") == 2  # one pool per group
+    assert ops.count("dropout") == 2  # classifier dropouts (train mode)
+    test_ops = [op.type for op in test_prog.global_block().ops]
+    assert test_ops.count("dropout") in (0, 2)  # clone keeps is_test attrs
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 3, 16, 16).astype("float32")
+    y = rng.randint(0, 4, (16, 1)).astype("int64")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"img": x, "label": y},
+                                fetch_list=[loss])[0]) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+        # eval clone deterministic (dropout off)
+        p1, = exe.run(test_prog, feed={"img": x, "label": y},
+                      fetch_list=[pred])
+        p2, = exe.run(test_prog, feed={"img": x, "label": y},
+                      fetch_list=[pred])
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2))
+
+
+def test_vgg16_full_depth_builds():
+    """The real 16-layer config constructs, and a graph BUILT with
+    is_test=True puts every BN/dropout in inference mode (moving stats,
+    no masking) — not just the clone(for_test=True) path."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        vgg.build_vgg(depth=16, class_dim=10, image_shape=(3, 32, 32),
+                      is_test=True)
+    ops = main.global_block().ops
+    convs = [op for op in ops if op.type == "conv2d"]
+    assert len(convs) == 13  # VGG-16: 13 conv layers + 3 FC
+    for op in ops:
+        if op.type in ("batch_norm", "dropout"):
+            assert op.attrs.get("is_test"), \
+                f"{op.type} built in training mode under is_test=True"

@@ -118,6 +118,14 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     helper = LayerHelper("conv2d", input=input, size=num_filters,
                          bias_attr=bias_attr, act=act, name=name)
     chans = input.shape[1]
+    # reference parity (layers/nn.py conv2d): a fully-grouped conv emits the
+    # dedicated depthwise_conv2d op when cuDNN is declined — era MobileNet
+    # code passes use_cudnn=False on its depthwise layers to get this.  Both
+    # op types reach the same grouped-conv XLA lowering here; the switch
+    # keeps built programs interoperable with reference-exported ones.
+    op_type = ("depthwise_conv2d"
+               if chans == groups and num_filters % max(chans, 1) == 0
+               and not use_cudnn else "conv2d")
     fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
     stride = stride if isinstance(stride, (list, tuple)) else [stride] * 2
     padding = padding if isinstance(padding, (list, tuple)) else [padding] * 2
@@ -128,7 +136,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     w = helper.create_parameter(param_attr, shape=w_shape, dtype=input.dtype,
                                 default_initializer=default_init)
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
-    helper.append_op("conv2d", inputs={"Input": [input], "Filter": [w]},
+    helper.append_op(op_type, inputs={"Input": [input], "Filter": [w]},
                      outputs={"Output": [out]},
                      attrs={"strides": list(stride), "paddings": list(padding),
                             "dilations": list(dilation), "groups": groups,

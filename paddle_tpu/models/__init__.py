@@ -12,3 +12,5 @@ from . import mlp  # noqa: F401
 from . import resnet  # noqa: F401
 from . import bert  # noqa: F401
 from . import gpt  # noqa: F401
+from . import mobilenet  # noqa: F401
+from . import googlenet  # noqa: F401

@@ -1,0 +1,111 @@
+"""MobileNet-v1 + GoogLeNet model families (models/mobilenet.py,
+models/googlenet.py).  Scaled-down configs run the full code path;
+structure checks pin the depthwise op emission and the inception
+branch/concat/aux-head composition."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.models import googlenet, mobilenet
+
+TINY_MOBILENET_CFG = ((8, 1), (16, 2), (16, 1))
+TINY_GOOGLENET_CFG = {
+    "3a": (4, 4, 8, 2, 4, 4),
+    "3b": (4, 4, 8, 2, 4, 4),
+    "4a": (8, 4, 8, 2, 4, 4),
+}
+
+
+def test_mobilenet_structure_and_training():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, pred, loss, acc = mobilenet.build_mobilenet(
+            class_dim=4, image_shape=(3, 16, 16), cfg=TINY_MOBILENET_CFG)
+        fluid.optimizer.Momentum(learning_rate=1e-2,
+                                 momentum=0.9).minimize(loss)
+
+    ops = [op.type for op in main.global_block().ops]
+    # era MobileNet passes use_cudnn=False on fully-grouped convs, which
+    # must emit the dedicated depthwise_conv2d op (reference conv2d parity)
+    assert ops.count("depthwise_conv2d") == len(TINY_MOBILENET_CFG)
+    # stem + one pointwise per block, all plain conv2d
+    assert ops.count("conv2d") == 1 + len(TINY_MOBILENET_CFG)
+    assert ops.count("batch_norm") == 1 + 2 * len(TINY_MOBILENET_CFG)
+    dw_ops = [op for op in main.global_block().ops
+              if op.type == "depthwise_conv2d"]
+    for op in dw_ops:
+        w = main.global_block().var(op.inputs["Filter"][0])
+        assert w.shape[1] == 1  # one filter slice per input channel
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 3, 16, 16).astype("float32")
+    y = rng.randint(0, 4, (16, 1)).astype("int64")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"img": x, "label": y},
+                                fetch_list=[loss])[0]) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+
+
+def test_mobilenet_full_width_builds():
+    """The real 30-layer v1 schedule constructs at 224x224 with the 0.5
+    width multiplier applied to every pointwise filter count."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        mobilenet.build_mobilenet(class_dim=10, scale=0.5, is_test=True)
+    ops = [op.type for op in main.global_block().ops]
+    assert ops.count("depthwise_conv2d") == len(mobilenet.V1_CFG)
+    assert ops.count("conv2d") == 1 + len(mobilenet.V1_CFG)
+    # width multiplier reaches the last pointwise conv
+    last_pw = [op for op in main.global_block().ops
+               if op.type == "conv2d"][-1]
+    w = main.global_block().var(last_pw.inputs["Filter"][0])
+    assert w.shape[0] == 512  # 1024 * 0.5
+
+
+def test_googlenet_structure_and_training():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, pred, loss, acc = googlenet.build_googlenet(
+            class_dim=4, image_shape=(3, 32, 32), cfg=TINY_GOOGLENET_CFG,
+            with_aux=False)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    ops = [op.type for op in main.global_block().ops]
+    # 4-branch concat per inception module
+    assert ops.count("concat") == len(TINY_GOOGLENET_CFG)
+    # 6 convs per module (1 + 2 + 2 + 1) + 3 stem convs
+    assert ops.count("conv2d") == 6 * len(TINY_GOOGLENET_CFG) + 3
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 4, (8, 1)).astype("int64")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"img": x, "label": y},
+                                fetch_list=[loss])[0]) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+
+
+def test_googlenet_full_v1_with_aux_heads():
+    """The full 9-module V1 config builds at 224x224; training mode wires
+    both auxiliary classifiers into the loss, test mode drops them."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        _, pred, loss, _ = googlenet.build_googlenet(class_dim=10)
+    ops = [op.type for op in main.global_block().ops]
+    assert ops.count("concat") == 9
+    # main head + two aux heads each contribute a cross_entropy
+    assert ops.count("cross_entropy") == 3
+
+    t_main, t_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(t_main, t_startup), fluid.unique_name.guard():
+        _, pred, loss, _ = googlenet.build_googlenet(class_dim=10,
+                                                     is_test=True)
+    t_ops = [op.type for op in t_main.global_block().ops]
+    assert t_ops.count("cross_entropy") == 1  # aux heads dropped

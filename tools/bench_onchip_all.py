@@ -335,9 +335,15 @@ def main():
                           f"{passes} pass(es)"}))
         return 1
     print(json.dumps({"written": suite.out,
+                      "complete": suite.complete(),
                       "bf16_speedup": suite.results.get("bf16_speedup"),
                       "onchip_smoke": suite.results.get("onchip_smoke")}))
-    return 0
+    # rc 2 = ran but legs remain (wedge mid-suite) — watchers should keep
+    # polling for another window; rc 0 = every leg captured.  Machinery
+    # mode always reports 0 on a run-through: its CPU-FALLBACK stamps are
+    # deliberately never _captured (they must not become baselines), so
+    # complete() cannot be its success criterion.
+    return 0 if (suite.machinery or suite.complete()) else 2
 
 
 if __name__ == "__main__":

@@ -8,9 +8,10 @@ lookups, sequence batching, label shapes) and actually converge on the
 synthetic distributions, which is what the book tests assert.
 """
 
-from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
-               movielens, sentiment, uci_housing, voc2012, wmt14, wmt16)
+from . import (cifar, common, conll05, flowers, image, imdb, imikolov, mnist,
+               movielens, mq2007, sentiment, uci_housing, voc2012, wmt14,
+               wmt16)
 
 __all__ = ["mnist", "cifar", "uci_housing", "imikolov", "movielens", "wmt14",
            "wmt16", "conll05", "imdb", "flowers", "sentiment", "voc2012",
-           "common"]
+           "common", "image", "mq2007"]

@@ -93,30 +93,19 @@ __all__ = [
 ]
 
 
-# `fluid.core` parity shim: a handful of symbols scripts poke at.
-class _CoreShim:
-    @staticmethod
-    def get_tpu_device_count():
-        import jax
+# `fluid.core` is a real module so both `from paddle.fluid import core`
+# and `import paddle.fluid.core` resolve, as they do against the
+# reference's pybind extension
+from . import core  # noqa: F401,E402
 
-        return jax.device_count()
-
-    get_cuda_device_count = get_tpu_device_count
-
-    @staticmethod
-    def is_compiled_with_cuda():
-        return False
-
-    @staticmethod
-    def is_compiled_with_tpu():
-        return True
-
-    CPUPlace = CPUPlace
-    CUDAPlace = CUDAPlace
-    TPUPlace = TPUPlace
-
-    class Scope(Scope):
-        pass
-
-
-core = _CoreShim()
+# module-path parity: small reference modules era code imports directly
+from . import annotations  # noqa: F401,E402
+from . import default_scope_funcs  # noqa: F401,E402
+from . import distribute_lookup_table  # noqa: F401,E402
+from . import graphviz  # noqa: F401,E402
+from . import inferencer  # noqa: F401,E402
+from . import layer_helper_base  # noqa: F401,E402
+from . import log_helper  # noqa: F401,E402
+from . import net_drawer  # noqa: F401,E402
+from . import op  # noqa: F401,E402
+from . import wrapped_decorator  # noqa: F401,E402

@@ -1,0 +1,33 @@
+"""Deprecation-marking decorator for public APIs.
+
+Reference analog: python/paddle/fluid/annotations.py deprecated.
+"""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(since, instead, extra_message=""):
+    """Mark a function deprecated since version `since`; callers are told
+    to use `instead`.  Emits a DeprecationWarning on every call and
+    appends the notice to the docstring."""
+
+    def decorator(func):
+        msg = (f"API {func.__name__} is deprecated since {since}. "
+               f"Please use {instead} instead.")
+        if extra_message:
+            msg += "\n" + extra_message
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__doc__ = (wrapper.__doc__ or "") + "\n    " + msg
+        return wrapper
+
+    return decorator

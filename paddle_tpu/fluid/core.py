@@ -1,0 +1,31 @@
+"""`fluid.core` parity module — the symbols era scripts poke at directly.
+
+Reference analog: the pybind extension module paddle/fluid/pybind/pybind.cc
+(`from paddle.fluid import core` / `import paddle.fluid.core as core`).
+Here there is no C++ binding layer to expose — devices come from PJRT and
+scopes are Python — so this module re-exports the native equivalents under
+the names scripts expect.
+"""
+
+from __future__ import annotations
+
+from .executor import Scope  # noqa: F401
+from .framework import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+
+
+def get_tpu_device_count():
+    import jax
+
+    return jax.device_count()
+
+
+# era scripts sizing their launch by GPU count get the chip count
+get_cuda_device_count = get_tpu_device_count
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True

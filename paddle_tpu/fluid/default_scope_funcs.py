@@ -1,0 +1,68 @@
+"""Thread-local default-scope stack.
+
+Reference analog: python/paddle/fluid/default_scope_funcs.py — a
+thread-local stack of scopes; the top is the current scope, `var`/
+`find_var` act on it, and `scoped_function` runs a callable inside a
+fresh kid scope that is destroyed afterwards.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .executor import Scope
+
+__all__ = [
+    "get_cur_scope",
+    "enter_local_scope",
+    "leave_local_scope",
+    "var",
+    "find_var",
+    "scoped_function",
+]
+
+_tls = threading.local()
+
+
+def _stack():
+    stack = getattr(_tls, "cur_scope", None)
+    if stack is None:
+        stack = _tls.cur_scope = []
+    if not stack:
+        stack.append(Scope())
+    return stack
+
+
+def get_cur_scope():
+    """The scope on top of this thread's stack (created on first use)."""
+    return _stack()[-1]
+
+
+def enter_local_scope():
+    """Push a kid of the current scope."""
+    _stack().append(get_cur_scope().new_scope())
+
+
+def leave_local_scope():
+    """Pop the current scope and drop the parent's kids."""
+    _stack().pop()
+    get_cur_scope().drop_kids()
+
+
+def var(name):
+    """Create (or fetch) `name` in the current scope."""
+    return get_cur_scope().var(name)
+
+
+def find_var(name):
+    """Find `name` in the current scope chain, else None."""
+    return get_cur_scope().find_var(name)
+
+
+def scoped_function(func):
+    """Run `func` inside a fresh local scope, destroying it afterwards."""
+    enter_local_scope()
+    try:
+        func()
+    finally:
+        leave_local_scope()

@@ -1,0 +1,57 @@
+"""Locate the (single) distributed lookup table in a program.
+
+Reference analog: python/paddle/fluid/distribute_lookup_table.py — the
+transpiler uses these to find the embedding table trained parameter-server
+side (`lookup_table` ops with is_distributed=True) and the trainer-side
+ids/outputs that become prefetch RPCs.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "find_distributed_lookup_table",
+    "find_distributed_lookup_table_inputs",
+    "find_distributed_lookup_table_outputs",
+]
+
+LOOKUP_TABLE_TYPE = "lookup_table"
+
+
+def find_distributed_lookup_table(program):
+    """Return the table (W) name of the distributed lookup_table ops in
+    `program`, or None.  Exactly one distributed table is supported; a
+    second distinct one, or mixed distributed/local use of the same
+    table (in either op order), raises."""
+    distributed, local = set(), set()
+    for op in program.global_block().ops:
+        if op.type != LOOKUP_TABLE_TYPE:
+            continue
+        w_name = op.input("W")[0]
+        (distributed if op.attr("is_distributed") else local).add(w_name)
+    if len(distributed) > 1:
+        raise RuntimeError("all distributed lookup_table ops must share "
+                           "one table; found %s" % sorted(distributed))
+    mixed = distributed & local
+    if mixed:
+        raise RuntimeError("table %s is used by both distributed and "
+                           "local lookup_table ops" % sorted(mixed)[0])
+    return next(iter(distributed), None)
+
+
+def _gather(program, table_name, slot_of):
+    block_vars = program.current_block().vars
+    out = []
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE and op.input("W")[0] == table_name:
+            out.extend(block_vars[name] for name in slot_of(op))
+    return out
+
+
+def find_distributed_lookup_table_inputs(program, table_name):
+    """The Ids variables feeding every lookup on `table_name`."""
+    return _gather(program, table_name, lambda op: op.input("Ids"))
+
+
+def find_distributed_lookup_table_outputs(program, table_name):
+    """The Out variables produced by every lookup on `table_name`."""
+    return _gather(program, table_name, lambda op: op.output("Out"))

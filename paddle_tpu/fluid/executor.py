@@ -66,24 +66,50 @@ class _ScopeTensor:
 
 
 class Scope:
-    def __init__(self):
+    """Name→value map with reference kid-scope semantics: find_var walks
+    the ancestor chain (reference scope.cc Scope::FindVar), creation and
+    the executor's get/set stay local (Scope::Var).  Scopes without kids
+    behave exactly as the flat map the executor always used."""
+
+    def __init__(self, parent=None):
         self._vars = {}
+        self._parent = parent
+        self._kids = []
+
+    def new_scope(self):
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    def parent_scope(self):
+        return self._parent
 
     def find_var(self, name):
-        return _ScopeVar(self, name) if name in self._vars else None
+        scope = self
+        while scope is not None:
+            if name in scope._vars:
+                return _ScopeVar(scope, name)
+            scope = scope._parent
+        return None
 
     def var(self, name):
         self._vars.setdefault(name, None)
         return _ScopeVar(self, name)
 
     def get(self, name):
+        # deliberately LOCAL-only (find_var walks ancestors): the executor
+        # reads donated params with get(), and a parent-scope hit would let
+        # a kid-scope run donate (invalidate) a buffer the parent still
+        # references — the post-run write lands in the kid, the parent
+        # keeps a deleted jax.Array.  Local-only get keeps the old clean
+        # "must exist in scope" error for that case.
         return self._vars.get(name)
 
     def set(self, name, value):
         self._vars[name] = value
 
     def drop_kids(self):
-        pass
+        self._kids.clear()
 
     def keys(self):
         return self._vars.keys()

@@ -1,0 +1,179 @@
+"""Small Graphviz DOT builder used by net_drawer/debugger.
+
+Reference analog: python/paddle/fluid/graphviz.py (Graph/Node/Edge/Rank/
+GraphPreviewGenerator).  Differences by design: node emission order is
+deterministic (the reference shuffles nodes), and rendering shells out to
+`dot` only when present instead of assuming it.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["crepr", "Rank", "Graph", "Node", "Edge",
+           "GraphPreviewGenerator"]
+
+
+def crepr(v):
+    """DOT literal for a value: strings get quoted, the rest str()'d."""
+    if isinstance(v, str):
+        return '"%s"' % v.replace("\\", "\\\\").replace('"', '\\"')
+    return str(v)
+
+
+class Rank:
+    """A same-rank constraint group (`{rank=...; a,b,c}`)."""
+
+    def __init__(self, kind, name, priority):
+        self.kind = kind
+        self.name = name
+        self.priority = priority
+        self.nodes = []
+
+    def __str__(self):
+        if not self.nodes:
+            return ""
+        return ("{rank=%s;" % self.kind
+                + ",".join(node.name for node in self.nodes) + "}")
+
+
+class Node:
+    _counter = 1
+
+    def __init__(self, label, prefix, description="", **attrs):
+        self.label = label
+        self.name = "%s_%d" % (prefix, Node._counter)
+        self.description = description
+        self.attrs = attrs
+        Node._counter += 1
+
+    def __str__(self):
+        extra = ("," + ",".join("%s=%s" % (k, crepr(v))
+                                for k, v in sorted(self.attrs.items()))
+                 if self.attrs else "")
+        return "%s [label=%s %s];" % (self.name, self.label, extra)
+
+
+class Edge:
+    def __init__(self, source, target, **attrs):
+        self.source = source
+        self.target = target
+        self.attrs = attrs
+
+    def __str__(self):
+        extra = ("[" + ",".join("%s=%s" % (k, crepr(v))
+                                for k, v in sorted(self.attrs.items())) + "]"
+                 if self.attrs else "")
+        return "%s -> %s %s" % (self.source.name, self.target.name, extra)
+
+
+class Graph:
+    _rank_counter = 0
+
+    def __init__(self, title, **attrs):
+        self.title = title
+        self.attrs = attrs
+        self.nodes = []
+        self.edges = []
+        self.rank_groups = {}
+
+    def rank_group(self, kind, priority):
+        name = "rankgroup-%d" % Graph._rank_counter
+        Graph._rank_counter += 1
+        self.rank_groups[name] = Rank(kind, name, priority)
+        return name
+
+    def node(self, label, prefix, description="", **attrs):
+        rank = attrs.pop("rank", None)
+        node = Node(label, prefix, description, **attrs)
+        if rank is not None:
+            self.rank_groups[rank].nodes.append(node)
+        self.nodes.append(node)
+        return node
+
+    def edge(self, source, target, **attrs):
+        edge = Edge(source, target, **attrs)
+        self.edges.append(edge)
+        return edge
+
+    def code(self):
+        return str(self)
+
+    def __str__(self):
+        lines = ["digraph G {", "title = %s" % crepr(self.title)]
+        lines += ["%s=%s;" % (k, crepr(v))
+                  for k, v in sorted(self.attrs.items())]
+        lines += [str(rank) for _, rank in
+                  sorted(self.rank_groups.items(),
+                         key=lambda kv: kv[1].priority)]
+        lines += [str(node) for node in self.nodes]
+        lines += [str(edge) for edge in self.edges]
+        lines.append("}")
+        return "\n".join(lines)
+
+    def compile(self, dot_path):
+        """Write the DOT file; render a sibling PDF if `dot` is installed.
+        Returns the image path (which exists only if rendering ran)."""
+        with open(dot_path, "w") as f:
+            f.write(str(self))
+        image_path = os.path.splitext(dot_path)[0] + ".pdf"
+        if shutil.which("dot"):
+            subprocess.run(["dot", "-Tpdf", dot_path, "-o", image_path],
+                           check=False, capture_output=True)
+        return image_path
+
+    def show(self, dot_path):
+        image = self.compile(dot_path)
+        if shutil.which("open"):
+            subprocess.Popen(["open", image], stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        return image
+
+
+class GraphPreviewGenerator:
+    """Program/graph preview: params, ops, and args as styled nodes."""
+
+    def __init__(self, title):
+        self.graph = Graph(title, layout="dot", concentrate="true",
+                           rankdir="TB")
+        self.op_rank = self.graph.rank_group("same", 2)
+        self.param_rank = self.graph.rank_group("same", 1)
+        self.arg_rank = self.graph.rank_group("same", 0)
+
+    def __call__(self, path="temp.dot", show=False):
+        return (self.graph.show(path) if show
+                else self.graph.compile(path))
+
+    def add_param(self, name, data_type, highlight=False):
+        label = ('<<table cellpadding="5"><tr><td bgcolor="#2b787e"><b>'
+                 + name + "</b></td></tr><tr><td>" + str(data_type)
+                 + "</td></tr></table>>")
+        return self.graph.node(
+            label, prefix="param", description=name, shape="none",
+            style="rounded,filled,bold", width="1.3",
+            color="orange" if highlight else "#148b97",
+            fontcolor="#ffffff", fontname="Arial")
+
+    def add_op(self, opType, **kwargs):
+        highlight = kwargs.pop("highlight", False)
+        return self.graph.node(
+            "<<B>%s</B>>" % opType, prefix="op", description=opType,
+            shape="box", style="rounded, filled, bold",
+            color="orange" if highlight else "#303A3A",
+            fontname="Arial", fontcolor="#ffffff",
+            width="1.3", height="0.84", **kwargs)
+
+    def add_arg(self, name, highlight=False):
+        return self.graph.node(
+            crepr(name), prefix="arg", description=name, shape="box",
+            style="rounded,filled,bold", fontname="Arial",
+            fontcolor="#999999",
+            color="orange" if highlight else "#dddddd")
+
+    def add_edge(self, source, target, **kwargs):
+        highlight = kwargs.pop("highlight", False)
+        return self.graph.edge(
+            source, target,
+            color="orange" if highlight else "#000000", **kwargs)

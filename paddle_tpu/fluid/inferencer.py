@@ -1,0 +1,7 @@
+"""Moved: the high-level Inferencer lives in fluid.contrib.inferencer.
+
+Reference analog: python/paddle/fluid/inferencer.py, which is the same
+tombstone — the API moved to contrib in the reference too.
+"""
+
+__all__ = []

@@ -1,101 +1,29 @@
 """LayerHelper: glue between layer functions and Program/startup-program.
 
-Reference: python/paddle/fluid/layer_helper.py — creates parameters (appending
-their init ops into the default startup program), temp output variables, and
-ops in the default main program.
+Reference: python/paddle/fluid/layer_helper.py — the per-layer-call sugar
+(op appending, activation, bias) over LayerHelperBase
+(layer_helper_base.py), which owns program access and variable/parameter
+creation; the split mirrors the reference's.
 """
 
 from __future__ import annotations
 
-from . import framework
-from .framework import Variable, unique_name
-from .initializer import Constant, Xavier
-from .param_attr import ParamAttr
+from .framework import unique_name
+from .layer_helper_base import LayerHelperBase
 
 __all__ = ["LayerHelper"]
 
 
-class LayerHelper:
+class LayerHelper(LayerHelperBase):
     def __init__(self, layer_type, **kwargs):
         self.kwargs = kwargs
-        self.layer_type = layer_type
         name = kwargs.get("name")
-        self.name = name if name is not None else unique_name.generate(layer_type)
-
-    @property
-    def main_program(self):
-        return framework.default_main_program()
-
-    @property
-    def startup_program(self):
-        return framework.default_startup_program()
-
-    @property
-    def block(self):
-        return self.main_program.current_block()
+        if name is None:
+            name = unique_name.generate(layer_type)
+        super().__init__(name, layer_type)
 
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
         return self.block.append_op(type, inputs=inputs, outputs=outputs, attrs=attrs)
-
-    # -- params ---------------------------------------------------------
-    def create_parameter(self, attr, shape, dtype="float32", is_bias=False,
-                         default_initializer=None):
-        attr = ParamAttr._to_attr(attr)
-        if attr is False:
-            return None
-        suffix = "b" if is_bias else "w"
-        if attr.name is None:
-            # copy before naming: callers reuse one ParamAttr across several
-            # create_parameter calls (e.g. dynamic_lstmp's two weights), and
-            # mutating the shared object would silently alias the parameters
-            import copy
-
-            attr = copy.copy(attr)
-            attr.name = unique_name.generate(".".join([self.name, suffix]))
-        if default_initializer is None:
-            default_initializer = Constant(0.0) if is_bias else Xavier()
-        init = attr.initializer if attr.initializer is not None else default_initializer
-
-        # declare in main program (read by ops) ...
-        main_block = self.main_program.global_block()
-        p = main_block.create_parameter(
-            name=attr.name, shape=shape, dtype=dtype,
-            regularizer=attr.regularizer, trainable=attr.trainable,
-            stop_gradient=not attr.trainable)
-        p.optimize_attr = {"learning_rate": attr.learning_rate}
-        p.gradient_clip_attr = attr.gradient_clip
-        # ... and create+init in startup program
-        sb = self.startup_program.global_block()
-        sp = sb.create_parameter(
-            name=attr.name, shape=shape, dtype=dtype, trainable=attr.trainable)
-        init(sp, sb)
-        return p
-
-    def create_variable_for_type_inference(self, dtype="float32", stop_gradient=False):
-        return self.block.create_var(
-            name=unique_name.generate(".".join([self.name, "tmp"])),
-            dtype=dtype, stop_gradient=stop_gradient)
-
-    create_tmp_variable = create_variable_for_type_inference
-
-    def create_variable(self, **kw):
-        return self.block.create_var(**kw)
-
-    def create_global_variable(self, persistable=False, **kw):
-        return self.main_program.global_block().create_var(
-            persistable=persistable, **kw)
-
-    def create_or_get_global_variable(self, name, **kw):
-        gb = self.main_program.global_block()
-        if name in gb.vars:
-            return gb.vars[name]
-        return gb.create_var(name=name, **kw)
-
-    def set_variable_initializer(self, var, initializer):
-        sb = self.startup_program.global_block()
-        sv = sb.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
-                           persistable=True)
-        initializer(sv, sb)
 
     # -- activation sugar ----------------------------------------------
     def append_activation(self, input_var):

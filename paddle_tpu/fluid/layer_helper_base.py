@@ -1,0 +1,104 @@
+"""LayerHelperBase: program access + variable/parameter creation.
+
+Reference analog: python/paddle/fluid/layer_helper_base.py — the half of
+LayerHelper that knows nothing about a specific layer call (no kwargs,
+no activation/bias sugar): which programs are current, how to create
+parameters (with their init ops in the startup program), temporaries,
+and globals.  LayerHelper (layer_helper.py) layers the per-call sugar on
+top, mirroring the reference split.
+"""
+
+from __future__ import annotations
+
+from . import framework
+from .framework import unique_name
+from .initializer import Constant, Xavier
+from .param_attr import ParamAttr
+
+__all__ = ["LayerHelperBase"]
+
+
+class LayerHelperBase:
+    def __init__(self, name, layer_type):
+        self._layer_type = layer_type
+        self._name = name
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def layer_type(self):
+        return self._layer_type
+
+    @property
+    def main_program(self):
+        return framework.default_main_program()
+
+    @property
+    def startup_program(self):
+        return framework.default_startup_program()
+
+    @property
+    def block(self):
+        return self.main_program.current_block()
+
+    # -- params ---------------------------------------------------------
+    def create_parameter(self, attr, shape, dtype="float32", is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        suffix = "b" if is_bias else "w"
+        if attr.name is None:
+            # copy before naming: callers reuse one ParamAttr across several
+            # create_parameter calls (e.g. dynamic_lstmp's two weights), and
+            # mutating the shared object would silently alias the parameters
+            import copy
+
+            attr = copy.copy(attr)
+            attr.name = unique_name.generate(".".join([self.name, suffix]))
+        if default_initializer is None:
+            default_initializer = Constant(0.0) if is_bias else Xavier()
+        init = attr.initializer if attr.initializer is not None else default_initializer
+
+        # declare in main program (read by ops) ...
+        main_block = self.main_program.global_block()
+        p = main_block.create_parameter(
+            name=attr.name, shape=shape, dtype=dtype,
+            regularizer=attr.regularizer, trainable=attr.trainable,
+            stop_gradient=not attr.trainable)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.gradient_clip_attr = attr.gradient_clip
+        # ... and create+init in startup program
+        sb = self.startup_program.global_block()
+        sp = sb.create_parameter(
+            name=attr.name, shape=shape, dtype=dtype, trainable=attr.trainable)
+        init(sp, sb)
+        return p
+
+    def create_variable_for_type_inference(self, dtype="float32", stop_gradient=False):
+        return self.block.create_var(
+            name=unique_name.generate(".".join([self.name, "tmp"])),
+            dtype=dtype, stop_gradient=stop_gradient)
+
+    create_tmp_variable = create_variable_for_type_inference
+
+    def create_variable(self, **kw):
+        return self.block.create_var(**kw)
+
+    def create_global_variable(self, persistable=False, **kw):
+        return self.main_program.global_block().create_var(
+            persistable=persistable, **kw)
+
+    def create_or_get_global_variable(self, name, **kw):
+        gb = self.main_program.global_block()
+        if name in gb.vars:
+            return gb.vars[name]
+        return gb.create_var(name=name, **kw)
+
+    def set_variable_initializer(self, var, initializer):
+        sb = self.startup_program.global_block()
+        sv = sb.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                           persistable=True)
+        initializer(sv, sb)

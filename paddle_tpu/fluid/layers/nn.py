@@ -108,7 +108,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)
     helper.append_op("lookup_table", inputs={"W": [w], "Ids": [input]},
                      outputs={"Out": [out]},
-                     attrs={"padding_idx": pad, "is_sparse": is_sparse})
+                     attrs={"padding_idx": pad, "is_sparse": is_sparse,
+                            "is_distributed": is_distributed})
     return out
 
 

@@ -1,0 +1,28 @@
+"""Logger factory that leaves the root logging config untouched.
+
+Reference analog: python/paddle/fluid/log_helper.py get_logger — importing
+the framework must not call logging.basicConfig (that would clobber the
+application's own logging setup).
+"""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = ["get_logger"]
+
+
+def get_logger(name, level, fmt=None):
+    """Return a named logger at `level` with its own stream handler.
+
+    Repeat calls with the same name reuse the existing handler instead of
+    stacking duplicates (each reference call appended a new one — every
+    message then printed once per call site)."""
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not any(isinstance(h, logging.StreamHandler) for h in logger.handlers):
+        handler = logging.StreamHandler()
+        if fmt:
+            handler.setFormatter(logging.Formatter(fmt=fmt))
+        logger.addHandler(handler)
+    return logger

@@ -1,0 +1,99 @@
+"""Legacy direct-op surface: build and run a single operator eagerly.
+
+Reference analog: python/paddle/fluid/op.py — `Operator` is a factory
+whose result runs against a Scope on a Place without a user-built
+Program (`op = Operator("scale", X="x", Out="y", scale=2.0);
+op.run(scope, place)`), the style the reference's oldest op unit tests
+use.  Here the factory synthesizes a one-op program on the fly and runs
+it through the normal XLA executor, reading inputs from and writing
+outputs back to the scope.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import registry
+
+__all__ = ["get_all_op_protos", "Operator", "OperatorFactory"]
+
+
+def get_all_op_protos():
+    """Every registered OpInfo (the registry is our OpProto table)."""
+    return [registry.get_op(t) for t in sorted(registry.all_ops())]
+
+
+class _EagerOp:
+    """A single op bound to variable names, runnable on (scope, place)."""
+
+    def __init__(self, type_, inputs, outputs, attrs):
+        self.type = type_
+        self.inputs = inputs  # slot -> [var names]
+        self.outputs = outputs
+        self.attrs = attrs
+
+    def out_names(self):
+        return [n for names in self.outputs.values() for n in names]
+
+    def run(self, scope, place):
+        from .executor import Executor
+        from .framework import Program
+
+        prog = Program()
+        block = prog.global_block()
+        feed = {}
+        for slot, names in self.inputs.items():
+            for name in names:
+                value = scope.get(name)
+                if value is None:
+                    raise ValueError(
+                        f"op {self.type}: input {slot}={name!r} not set in "
+                        "scope (scope.var(name).get_tensor().set(...) first)")
+                arr = np.asarray(value)
+                block.create_var(name=name, shape=list(arr.shape),
+                                 dtype=str(arr.dtype))
+                feed[name] = arr
+        for name in self.out_names():
+            if block._find_var_recursive(name) is None:
+                block.create_var(name=name)
+        block.append_op(type=self.type, inputs=self.inputs,
+                        outputs=self.outputs, attrs=self.attrs)
+        results = Executor(place).run(prog, feed=feed,
+                                      fetch_list=self.out_names())
+        for name, value in zip(self.out_names(), results):
+            scope.var(name)
+            scope.set(name, np.asarray(value))
+        return results
+
+
+class OperatorFactory:
+    """`Operator(type, **kwargs)`: kwargs matching the op's input/output
+    slots become variable-name bindings, the rest become attributes."""
+
+    def __call__(self, type_, **kwargs):
+        info = registry.get_op(type_)
+        in_slots = set(info.canonical_inputs)
+        out_slots = set(info.canonical_outputs)
+        inputs, outputs, attrs = {}, {}, {}
+        for key, val in kwargs.items():
+            if key in in_slots or key in out_slots:
+                names = [val] if isinstance(val, str) else list(val)
+                (inputs if key in in_slots else outputs)[key] = names
+            else:
+                attrs[key] = val
+        return _EagerOp(type_, inputs, outputs, attrs)
+
+    def types(self):
+        return sorted(registry.all_ops())
+
+    def get_op_info(self, type_):
+        return registry.get_op(type_)
+
+    def get_op_input_names(self, type_):
+        return list(registry.get_op(type_).canonical_inputs)
+
+    def get_op_output_names(self, type_):
+        return list(registry.get_op(type_).canonical_outputs)
+
+
+Operator = OperatorFactory()  # the default global factory

@@ -1,0 +1,28 @@
+"""Signature-preserving decorator helpers.
+
+Reference analog: python/paddle/fluid/wrapped_decorator.py, which routes
+through the third-party `decorator` package so wrapped functions keep
+their signature for introspection.  functools.wraps sets `__wrapped__`,
+which gives inspect.signature the same answer without the dependency.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+__all__ = ["wrap_decorator", "signature_safe_contextmanager"]
+
+
+def wrap_decorator(decorator_func):
+    """Lift `decorator_func` (callable -> callable) into a decorator that
+    preserves the decorated function's name/doc/signature metadata."""
+
+    def __impl__(func):
+        wrapped = decorator_func(func)
+        return functools.wraps(func)(wrapped)
+
+    return __impl__
+
+
+signature_safe_contextmanager = wrap_decorator(contextlib.contextmanager)

@@ -20,6 +20,34 @@ def np_dtype(name):
     return np.dtype(name)
 
 
+def _rng_impl():
+    """PRNG implementation for random ops: XLA's RngBitGenerator ("rbg")
+    on TPU, threefry elsewhere.
+
+    Threefry generates bits with a long fused elementwise chain — cheap on
+    CPU, but on TPU it burns VPU cycles that a dropout-heavy train step
+    (tens of bernoulli draws over B*S*H activations) actually feels.  The
+    rbg impl lowers to one rng_bit_generator HLO (hardware Philox path).
+    Determinism still holds per (key, backend); the trade is only that
+    rbg streams differ from threefry streams, so PT_RNG_IMPL=threefry
+    pins cross-platform reproducibility when someone needs it.
+    """
+    import os
+
+    forced = os.environ.get("PT_RNG_IMPL", "").strip().lower()
+    if forced == "rbg":
+        return "rbg"
+    if forced in ("threefry", "threefry2x32"):
+        return "threefry2x32"
+    if forced:
+        # someone pinning streams for reproducibility must not silently
+        # get the platform default because of a typo
+        raise ValueError(f"PT_RNG_IMPL={forced!r}: use 'rbg' or 'threefry'")
+    from paddle_tpu.fluid.platform_utils import TPU_PLATFORMS, default_platform
+
+    return "rbg" if default_platform() in TPU_PLATFORMS else "threefry2x32"
+
+
 def op_rng_key(ctx, attrs):
     """Per-op, per-step PRNG key.
 
@@ -33,7 +61,7 @@ def op_rng_key(ctx, attrs):
     if not seed:
         prog = getattr(ctx, "program", None)
         seed = int(getattr(prog, "random_seed", 0) or 0) or 0x5EED
-    base = jax.random.key(np.uint32(seed))
+    base = jax.random.key(np.uint32(seed), impl=_rng_impl())
     k = jax.random.fold_in(base, np.uint32(getattr(ctx, "op_index", 0)))
     k = jax.random.fold_in(k, ctx.step)
     # under shard_map, decorrelate streams across devices (each shard of a

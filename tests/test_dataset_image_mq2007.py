@@ -1,0 +1,188 @@
+"""paddle.dataset.image + paddle.dataset.mq2007 parity
+(reference python/paddle/dataset/{image,mq2007}.py)."""
+
+import io
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.dataset import image, mq2007
+
+
+# --- image ----------------------------------------------------------------
+
+def _checker(h, w):
+    """uint8 HWC test card with distinct channel ramps."""
+    y = np.arange(h)[:, None]
+    x = np.arange(w)[None, :]
+    return np.stack([(y * 3 + x) % 256, (y + x * 5) % 256,
+                     (y * 2 + x * 2) % 256], axis=2).astype(np.uint8)
+
+
+def test_resize_short_keeps_aspect():
+    im = _checker(40, 80)
+    out = image.resize_short(im, 20)
+    assert out.shape == (20, 40, 3) and out.dtype == np.uint8
+    tall = image.resize_short(_checker(80, 40), 20)
+    assert tall.shape == (40, 20, 3)
+
+
+def test_resize_identity_and_downscale_values():
+    im = _checker(16, 16)
+    same = image.resize_short(im, 16)
+    np.testing.assert_array_equal(same, im)  # identity resample
+    # constant image stays constant under any resample
+    const = np.full((32, 48, 3), 7, np.uint8)
+    out = image.resize_short(const, 12)
+    assert out.shape == (12, 18, 3)
+    np.testing.assert_array_equal(out, np.full((12, 18, 3), 7))
+    # grayscale path
+    gray = image.resize_short(np.full((30, 20), 9, np.uint8), 10)
+    assert gray.shape == (15, 10)
+
+
+def test_crops_and_flip():
+    im = _checker(20, 20)
+    cc = image.center_crop(im, 10)
+    np.testing.assert_array_equal(cc, im[5:15, 5:15])
+    rc = image.random_crop(im, 10)
+    assert rc.shape == (10, 10, 3)
+    np.testing.assert_array_equal(image.left_right_flip(im), im[:, ::-1])
+    assert image.to_chw(im).shape == (3, 20, 20)
+
+
+def test_simple_transform_eval_deterministic():
+    im = _checker(36, 48)
+    out = image.simple_transform(im, resize_size=24, crop_size=16,
+                                 is_train=False, mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 16, 16) and out.dtype == np.float32
+    again = image.simple_transform(im, 24, 16, is_train=False,
+                                   mean=[1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(out, again)
+    # per-channel mean subtraction really is per-channel
+    no_mean = image.simple_transform(im, 24, 16, is_train=False)
+    np.testing.assert_allclose(no_mean[1] - out[1], np.full((16, 16), 2.0))
+
+
+def test_simple_transform_train_shapes():
+    np.random.seed(0)
+    out = image.simple_transform(_checker(40, 40), 32, 24, is_train=True)
+    assert out.shape == (3, 24, 24)
+
+
+def test_load_image_bytes_roundtrip(tmp_path):
+    from PIL import Image as PILImage
+    im = _checker(8, 8)
+    buf = io.BytesIO()
+    PILImage.fromarray(im).save(buf, format="PNG")
+    decoded = image.load_image_bytes(buf.getvalue())
+    np.testing.assert_array_equal(decoded, im)  # PNG is lossless
+    gray = image.load_image_bytes(buf.getvalue(), is_color=False)
+    assert gray.ndim == 2
+    p = tmp_path / "x.png"
+    p.write_bytes(buf.getvalue())
+    np.testing.assert_array_equal(image.load_image(str(p)), im)
+
+
+def test_batch_images_from_tar(tmp_path):
+    from PIL import Image as PILImage
+    tar_path = tmp_path / "imgs.tar"
+    img2label = {}
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(5):
+            buf = io.BytesIO()
+            PILImage.fromarray(_checker(6, 6)).save(buf, format="PNG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name=f"img{i}.png")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+            img2label[f"img{i}.png"] = i % 2
+    meta = image.batch_images_from_tar(str(tar_path), "toy", img2label,
+                                       num_per_batch=2)
+    batches = open(meta).read().splitlines()
+    assert len(batches) == 3  # 2+2+1
+    loaded = pickle.load(open(batches[-1], "rb"))
+    assert loaded["label"] == [0] and len(loaded["data"]) == 1
+
+
+# --- mq2007 ---------------------------------------------------------------
+
+def test_query_parse_and_str_roundtrip():
+    q = mq2007.Query(query_id=10, relevance_score=2,
+                     feature_vector=[0.5] * mq2007.FEATURE_DIM)
+    q2 = mq2007.Query()._parse_(str(q) + " #doc7")
+    assert (q2.query_id, q2.relevance_score) == (10, 2)
+    assert q2.description == "doc7"
+    np.testing.assert_allclose(q2.feature_vector, q.feature_vector)
+    assert mq2007.Query()._parse_("garbage") is None
+
+
+def test_querylist_rejects_mixed_ids():
+    ql = mq2007.QueryList()
+    ql._add_query(mq2007.Query(query_id=1, relevance_score=1,
+                               feature_vector=[0.0]))
+    with pytest.raises(ValueError):
+        ql._add_query(mq2007.Query(query_id=2, relevance_score=0,
+                                   feature_vector=[0.0]))
+
+
+def test_generators():
+    docs = [mq2007.Query(query_id=3, relevance_score=s,
+                         feature_vector=[float(s), 0.0])
+            for s in (0, 2, 1)]
+    points = list(mq2007.gen_point(list(docs)))
+    assert [p[0] for p in points] == [2, 1, 0]  # ranked
+    pairs = list(mq2007.gen_pair(list(docs)))
+    assert len(pairs) == 3  # C(3,2), all labels distinct
+    for label, better, worse in pairs:
+        assert label == [1] and better[0] > worse[0]
+    neigh = list(mq2007.gen_pair(list(docs), partial_order="neighbour"))
+    assert len(neigh) == 2
+    (labels, feats), = mq2007.gen_list(list(docs))
+    assert labels.shape == (3, 1) and feats.shape == (3, 2)
+    rows = list(mq2007.gen_plain_txt(list(docs)))
+    assert all(r[0] == 3 for r in rows)
+
+
+def test_query_filter_drops_all_zero_queries():
+    zero = mq2007.QueryList([mq2007.Query(query_id=1, relevance_score=0,
+                                          feature_vector=[0.0])])
+    keep = mq2007.QueryList([mq2007.Query(query_id=2, relevance_score=1,
+                                          feature_vector=[0.0])])
+    assert mq2007.query_filter([zero, keep]) == [keep]
+
+
+def test_readers_and_text_roundtrip(tmp_path):
+    pair_reader = mq2007.train(format="pairwise")
+    label, left, right = next(iter(pair_reader()))
+    assert label.shape == (1,) and left.shape == (mq2007.FEATURE_DIM,)
+    (labels, feats), = [next(iter(mq2007.test(format="listwise")()))]
+    assert feats.shape[1] == mq2007.FEATURE_DIM
+
+    # the synthetic corpus survives a text round-trip through the parser
+    qls = mq2007._synthetic_querylists(3, seed=1)
+    path = tmp_path / "fold.txt"
+    path.write_text("\n".join(str(q) + " #" + q.description
+                              for ql in qls for q in ql))
+    back = mq2007.load_from_text(str(path))
+    assert len(back) == 3
+    assert sorted(ql.query_id for ql in back) == [0, 1, 2]
+    assert all(len(ql) == len(qls[0]) for ql in back)
+
+
+def test_synthetic_ranking_is_learnable():
+    """A linear pairwise scorer separates better/worse docs — the planted
+    signal is real, not noise."""
+    reader = mq2007.train(format="pairwise")
+    lefts, rights = [], []
+    for label, left, right in reader():
+        lefts.append(left)
+        rights.append(right)
+    X = np.array(lefts) - np.array(rights)  # better minus worse
+    # one ridge step toward "score diff > 0"
+    w = np.linalg.solve(X.T @ X + 1e-3 * np.eye(X.shape[1]),
+                        X.sum(axis=0))
+    acc = float((X @ w > 0).mean())
+    assert acc > 0.9, acc

@@ -1,0 +1,82 @@
+"""Random-op PRNG implementation selection (ops/common.py _rng_impl).
+
+On TPU platforms random ops key with JAX's "rbg" impl — one
+rng_bit_generator HLO instead of threefry's long elementwise chain, which
+a dropout-heavy train step feels (tens of bernoulli draws over B*S*H
+activations per step).  CPU keeps threefry.  PT_RNG_IMPL forces either."""
+
+import numpy as np
+
+import jax
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.executor import BlockPlan, Scope, scope_guard
+from paddle_tpu.ops.common import _rng_impl
+
+
+def _dropout_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+        h = fluid.layers.fc(x, size=64, act="relu")
+        d = fluid.layers.dropout(h, dropout_prob=0.5,
+                                 dropout_implementation="upscale_in_train")
+        loss = fluid.layers.mean(d)
+    return main, startup, loss
+
+
+def _lowered_text(main, startup, loss):
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        scope = fluid.global_scope()
+        plan = BlockPlan(main, main.global_block(), ["x"], [loss.name],
+                         scope, place=fluid.CPUPlace())
+        donated = {n: scope.get(n) for n in plan.donated_names}
+        readonly = {n: scope.get(n) for n in plan.readonly_names}
+        batch = {"x": np.ones((4, 64), np.float32)}
+        return jax.jit(plan.make_body(), donate_argnums=(0,)).lower(
+            donated, readonly, batch, np.uint32(0)).as_text()
+
+
+def test_cpu_platform_defaults_to_threefry(monkeypatch):
+    monkeypatch.delenv("PT_RNG_IMPL", raising=False)
+    assert _rng_impl() == "threefry2x32"  # tests run on the cpu mesh
+    txt = _lowered_text(*_dropout_program())
+    assert "rng_bit_generator" not in txt
+
+
+def test_forced_rbg_lowers_to_rng_bit_generator(monkeypatch):
+    monkeypatch.setenv("PT_RNG_IMPL", "rbg")
+    assert _rng_impl() == "rbg"
+    txt = _lowered_text(*_dropout_program())
+    assert "rng_bit_generator" in txt
+
+
+def test_invalid_override_raises(monkeypatch):
+    import pytest
+
+    monkeypatch.setenv("PT_RNG_IMPL", "bogus")
+    with pytest.raises(ValueError, match="PT_RNG_IMPL"):
+        _rng_impl()
+
+
+def test_rbg_dropout_trains_and_masks_correctly(monkeypatch):
+    monkeypatch.setenv("PT_RNG_IMPL", "rbg")
+    main, startup, loss = _dropout_program()
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(64, 64).astype(np.float32)}
+        drop_out_var = [op for op in main.global_block().ops
+                        if op.type == "dropout"][0].output("Out")[0]
+        a, b = (np.asarray(exe.run(main, feed=feed,
+                                   fetch_list=[drop_out_var])[0])
+                for _ in range(2))
+        # step advances the stream: masks differ between runs
+        assert (a == 0).mean() > 0.2 and (b == 0).mean() > 0.2
+        assert not np.array_equal(a, b)
+        # upscale_in_train: surviving activations are scaled by 1/keep
+        both_alive = (a != 0) & (b != 0)
+        np.testing.assert_allclose(a[both_alive], b[both_alive], rtol=1e-5)

@@ -18,6 +18,10 @@ from . import fluid  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from . import inference  # noqa: F401
+from . import compat  # noqa: F401
+from . import distributed  # noqa: F401
+from . import proto  # noqa: F401
+from . import utils  # noqa: F401
 from .reader import batch  # noqa: F401
 
 # paddle.* top-level conveniences (subset; the reference re-exports fluid too)

@@ -1,0 +1,87 @@
+"""Python compatibility helpers (reference python/paddle/compat.py).
+
+The reference bridged py2/py3 via six; this build is py3-only, so these
+keep the call sites working with py3 semantics (and py2-style rounding,
+which user code depended on).
+"""
+
+from __future__ import annotations
+
+import math
+
+__all__ = [
+    "long_type",
+    "to_text",
+    "to_bytes",
+    "round",
+    "floor_division",
+    "get_exception_message",
+]
+
+long_type = int
+
+
+def _leaf_to_text(obj, encoding):
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    if isinstance(obj, str):
+        return obj
+    return str(obj)
+
+
+def _leaf_to_bytes(obj, encoding):
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    if isinstance(obj, bytes):
+        return obj
+    return str(obj).encode(encoding)
+
+
+def _convert(obj, leaf, inplace):
+    # None passes through (callers branch on it); only list/set recurse —
+    # the reference's contract exactly
+    if obj is None:
+        return None
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [leaf(x) for x in obj]
+            return obj
+        return [leaf(x) for x in obj]
+    if isinstance(obj, set):
+        converted = {leaf(x) for x in obj}
+        if inplace:
+            obj.clear()
+            obj.update(converted)
+            return obj
+        return converted
+    return leaf(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Decode bytes (or a list/set of them) to str; None passes through."""
+    return _convert(obj, lambda x: _leaf_to_text(x, encoding), inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Encode str (or a list/set of them) to bytes; None passes through."""
+    return _convert(obj, lambda x: _leaf_to_bytes(x, encoding), inplace)
+
+
+def round(x, d=0):
+    """Python-2-style rounding: halves go AWAY from zero (py3 builtin
+    rounds halves to even — 0.5 → 0 — which broke era numeric tests)."""
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor((x * p) + 0.5)) / p
+    if x < 0:
+        return float(math.ceil((x * p) - 0.5)) / p
+    return 0.0
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    """The message of an exception, as text."""
+    return str(exc)

@@ -54,12 +54,15 @@ class Query:
         parts = line.split()
         if len(parts) < 2 or ":" not in parts[1]:
             return None
-        self.relevance_score = int(parts[0])
-        self.query_id = int(parts[1].split(":")[1])
         feats = {}
-        for part in parts[2:]:
-            idx, _, val = part.partition(":")
-            feats[int(idx)] = float(val)
+        try:
+            self.relevance_score = int(parts[0])
+            self.query_id = int(parts[1].split(":")[1])
+            for part in parts[2:]:
+                idx, _, val = part.partition(":")
+                feats[int(idx)] = float(val)
+        except ValueError:
+            return None  # malformed numeric field — skip the line
         top = max(feats) if feats else 0
         self.feature_vector = [feats.get(i + 1, fill_missing)
                                for i in range(max(top, FEATURE_DIM))]

@@ -1,0 +1,8 @@
+"""paddle.distributed parity: multi-process training launchers.
+
+Reference analogs: python/paddle/distributed/launch.py (one process per
+device, collective mode) and launch_ps.py (pserver + trainer processes).
+Here the per-process device is a TPU chip (or a CPU mesh slice in tests)
+instead of a CUDA card, and workers rendezvous through the PADDLE_* env
+contract `fluid.incubate.fleet` reads.
+"""

@@ -1,0 +1,92 @@
+"""Shared child-process management for the launchers.
+
+One place for the spawn / poll / first-failure-teardown / log-handle
+contract so launch.py and launch_ps.py cannot drift: any process exiting
+non-zero terminates every survivor (a rank blocked in a collective or a
+pserver accept loop would otherwise hang the job forever), and log
+handles always close.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+__all__ = ["ProcGroup", "str2bool"]
+
+
+def str2bool(v):
+    """argparse-friendly bool: accepts true/false/1/0/yes/no (argparse's
+    `type=bool` treats any non-empty string — including "False" — as
+    True)."""
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes", "y"):
+        return True
+    if s in ("false", "0", "no", "n", ""):
+        return False
+    raise ValueError(f"expected a boolean, got {v!r}")
+
+
+class ProcGroup:
+    """Children spawned together, torn down together."""
+
+    def __init__(self, log_dir=None):
+        self.log_dir = log_dir
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+        self.procs = []
+        self._logs = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    def spawn(self, script, script_args, env, log_name):
+        out = (open(os.path.join(self.log_dir, log_name), "w")
+               if self.log_dir else None)
+        self._logs.append(out)
+        proc = subprocess.Popen(
+            [sys.executable, "-u", script, *script_args],
+            env=env, stdout=out, stderr=out)
+        self.procs.append(proc)
+        return proc
+
+    def wait(self, workers=None):
+        """Block until every worker exits; raise on the first failure
+        (after terminating all survivors).  `workers` defaults to all
+        children; any non-worker child (e.g. a pserver accept loop that
+        never exits on its own) is terminated once the workers finish."""
+        workers = list(workers if workers is not None else self.procs)
+        failed = None
+        while any(p.poll() is None for p in workers):
+            for proc in self.procs:
+                rc = proc.poll()
+                if rc not in (None, 0) and failed is None:
+                    failed = (rc, proc.args)
+                    self._terminate_survivors()
+            time.sleep(0.2)
+        for proc in workers:
+            rc = proc.poll()
+            if rc not in (None, 0) and failed is None:
+                failed = (rc, proc.args)
+        self._terminate_survivors()
+        if failed:
+            raise subprocess.CalledProcessError(failed[0], failed[1])
+
+    def _terminate_survivors(self):
+        for proc in self.procs:
+            if proc.poll() is None:
+                proc.terminate()
+
+    def shutdown(self):
+        self._terminate_survivors()
+        for out in self._logs:
+            if out:
+                out.close()
+        self._logs = []

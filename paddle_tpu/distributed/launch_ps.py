@@ -1,0 +1,91 @@
+"""Parameter-server-mode launcher: pservers + trainers on one node.
+
+Reference analog: python/paddle/distributed/launch_ps.py.  Spawns
+`--server_num` pserver processes and `--worker_num` trainer processes,
+wiring the env contract `fleet.init(PaddleCloudRoleMaker())` /
+`DistributeTranspiler` read:
+
+    pservers:  TRAINING_ROLE=PSERVER, POD_IP, PADDLE_PORT,
+               PADDLE_PSERVERS, PADDLE_TRAINERS_NUM
+    trainers:  TRAINING_ROLE=TRAINER, PADDLE_TRAINER_ID,
+               PADDLE_PSERVERS, PADDLE_PORT, PADDLE_TRAINERS_NUM
+
+As in launch.py, the first failing process tears the whole job down,
+and pservers (which serve forever) are stopped once every trainer
+finishes.
+
+Usage:
+    python -m paddle_tpu.distributed.launch_ps --server_num=2 \
+        --worker_num=2 train_ps.py --your-args
+"""
+
+from __future__ import annotations
+
+import os
+from argparse import REMAINDER, ArgumentParser
+
+from ._proc_group import ProcGroup, str2bool
+
+__all__ = ["launch", "start_procs"]
+
+
+def _parse_args(argv=None):
+    parser = ArgumentParser(description="Launch a local PS training job.")
+    parser.add_argument("--server_num", type=int, default=2)
+    parser.add_argument("--worker_num", type=int, default=2)
+    parser.add_argument("--start_port", type=int, default=6170)
+    parser.add_argument("--endpoints", type=str, default="",
+                        help="explicit pserver endpoints ip:port,...")
+    parser.add_argument("--log_dir", type=str, default="logs")
+    parser.add_argument("--print_config", type=str2bool, default=True)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=REMAINDER)
+    return parser.parse_args(argv)
+
+
+def start_procs(args):
+    if args.endpoints:
+        endpoints = [e.strip() for e in args.endpoints.split(",") if e]
+    else:
+        endpoints = [f"127.0.0.1:{args.start_port + i}"
+                     for i in range(args.server_num)]
+    pserver_ips = ",".join(e.split(":")[0] for e in endpoints)
+    ports = sorted({e.split(":")[1] for e in endpoints})
+
+    base_env = dict(os.environ)
+    base_env.pop("http_proxy", None)
+    base_env.pop("https_proxy", None)
+    common = dict(PADDLE_PSERVERS=pserver_ips,
+                  PADDLE_PORT=ports[0],
+                  PADDLE_PSERVER_ENDPOINTS=",".join(endpoints),
+                  PADDLE_TRAINERS_NUM=str(args.worker_num))
+    if args.print_config:
+        print(f"launch_ps: servers={endpoints} workers={args.worker_num}")
+
+    with ProcGroup(args.log_dir) as group:
+        def spawn(role_env, log_name):
+            env = dict(base_env)
+            env.update(common)
+            env.update(role_env)  # role wins (a pserver's own PADDLE_PORT)
+            return group.spawn(args.training_script,
+                               args.training_script_args, env, log_name)
+
+        for i, ep in enumerate(endpoints):
+            spawn({"TRAINING_ROLE": "PSERVER", "POD_IP": ep.split(":")[0],
+                   "PADDLE_PORT": ep.split(":")[1],
+                   "PADDLE_CURRENT_ENDPOINT": ep},
+                  f"serverlog.{i}")
+        trainers = [spawn({"TRAINING_ROLE": "TRAINER",
+                           "PADDLE_TRAINER_ID": str(i)},
+                          f"workerlog.{i}")
+                    for i in range(args.worker_num)]
+        # pservers are daemons: wait() stops them when trainers finish
+        group.wait(workers=trainers)
+
+
+def launch(argv=None):
+    start_procs(_parse_args(argv))
+
+
+if __name__ == "__main__":
+    launch()

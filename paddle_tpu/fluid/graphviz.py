@@ -154,10 +154,11 @@ class GraphPreviewGenerator:
             label, prefix="param", description=name, shape="none",
             style="rounded,filled,bold", width="1.3",
             color="orange" if highlight else "#148b97",
-            fontcolor="#ffffff", fontname="Arial")
+            fontcolor="#ffffff", fontname="Arial", rank=self.param_rank)
 
     def add_op(self, opType, **kwargs):
         highlight = kwargs.pop("highlight", False)
+        kwargs.setdefault("rank", self.op_rank)
         return self.graph.node(
             "<<B>%s</B>>" % opType, prefix="op", description=opType,
             shape="box", style="rounded, filled, bold",
@@ -170,7 +171,8 @@ class GraphPreviewGenerator:
             crepr(name), prefix="arg", description=name, shape="box",
             style="rounded,filled,bold", fontname="Arial",
             fontcolor="#999999",
-            color="orange" if highlight else "#dddddd")
+            color="orange" if highlight else "#dddddd",
+            rank=self.arg_rank)
 
     def add_edge(self, source, target, **kwargs):
         highlight = kwargs.pop("highlight", False)

@@ -44,8 +44,13 @@ class _EagerOp:
         feed = {}
         for slot, names in self.inputs.items():
             for name in names:
-                value = scope.get(name)
-                if value is None:
+                # find_var, not get: reference op->Run resolves inputs with
+                # FindVar's ancestor-chain lookup, so ops run inside a
+                # local scope still see enclosing-scope variables
+                holder = scope.find_var(name)
+                value = (np.asarray(holder.get_tensor())
+                         if holder is not None else None)
+                if value is None or value.dtype == object:
                     raise ValueError(
                         f"op {self.type}: input {slot}={name!r} not set in "
                         "scope (scope.var(name).get_tensor().set(...) first)")

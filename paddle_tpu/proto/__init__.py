@@ -1,0 +1,13 @@
+"""paddle.proto parity shim.
+
+The reference generates protobuf modules (framework_pb2 etc.) into this
+package at build time from paddle/fluid/framework/framework.proto.  This
+build has no generated pb2 code: the same wire format is implemented by
+`paddle_tpu.fluid.proto_compat` (a hand-rolled proto2 codec that
+round-trips actual reference `__model__` files).  Import that module for
+programmatic access to the serialized ProgramDesc schema.
+"""
+
+from paddle_tpu.fluid import proto_compat as framework  # noqa: F401
+
+__all__ = ["framework"]

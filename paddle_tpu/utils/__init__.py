@@ -1,0 +1,11 @@
+"""paddle.utils parity (reference python/paddle/utils/).
+
+Ships the pieces era user code actually imports: the training-curve
+Ploter (plot.py) and the classic image preprocessing helpers
+(image_util.py).  The reference's remaining scripts (torch2paddle,
+show_pb, plotcurve) were v1-era developer tools with no API surface.
+"""
+
+from .plot import Ploter  # noqa: F401
+
+__all__ = ["Ploter"]

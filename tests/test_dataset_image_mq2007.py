@@ -117,6 +117,10 @@ def test_query_parse_and_str_roundtrip():
     assert q2.description == "doc7"
     np.testing.assert_allclose(q2.feature_vector, q.feature_vector)
     assert mq2007.Query()._parse_("garbage") is None
+    # malformed numeric fields skip the line rather than crash the load
+    assert mq2007.Query()._parse_("x qid:1 1:0.5") is None
+    assert mq2007.Query()._parse_("1 qid: 1:0.5") is None
+    assert mq2007.Query()._parse_("1 qid:2 1:abc") is None
 
 
 def test_querylist_rejects_mixed_ids():

@@ -164,6 +164,9 @@ def test_graphviz_dot_generation(tmp_path):
     gen(str(path))
     text = path.read_text()
     assert "param_" in text and "op_" in text
+    # the same-rank groups actually constrain the added nodes
+    assert "{rank=same;%s}" % p.name in text.replace(" ", "")
+    assert "{rank=same;%s}" % o.name in text.replace(" ", "")
 
 
 def test_net_drawer_draws_program(tmp_path):
@@ -199,6 +202,13 @@ def test_legacy_op_factory_runs_eagerly():
 
     with pytest.raises(ValueError, match="not set in scope"):
         Operator("scale", X="missing", Out="z").run(scope, fluid.CPUPlace())
+
+    # reference FindVar semantics: an op run inside a local scope sees
+    # enclosing-scope inputs through the ancestor chain
+    kid = scope.new_scope()
+    Operator("scale", X="x", Out="k", scale=2.0).run(kid, fluid.CPUPlace())
+    np.testing.assert_allclose(np.asarray(kid.find_var("k").get_tensor()),
+                               2.0 * np.arange(6, dtype=np.float32))
 
 
 def test_layer_helper_base_split():

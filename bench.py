@@ -36,8 +36,15 @@ ONCHIP_RESULTS_PATH = os.path.join(
 
 # effective dispatch of the last _timed_steps call: "pipelined" when the
 # fetch-free chain ran, "syncfetch" when per-step fetches did (either the
-# env knob or the write-free-program fallback)
+# env knob or the write-free-program fallback), "chainK" when K steps ran
+# inside one compiled fori_loop (Executor.run_steps)
 _last_dispatch = None
+
+
+def _chain_steps():
+    """PT_BENCH_CHAIN_STEPS=K: dispatch K steps as ONE XLA call
+    (Executor.run_steps).  0/unset = per-step dispatch."""
+    return int(os.environ.get("PT_BENCH_CHAIN_STEPS", "0") or 0)
 
 
 def _cpu_suffix():
@@ -46,6 +53,10 @@ def _cpu_suffix():
         # fetch-every-step A/B variant: labeled so it can never be compared
         # against a pipelined-dispatch record of the same shape
         suffix = " syncfetch" + suffix
+    elif _last_dispatch and _last_dispatch.startswith("chain"):
+        # on-device step loop: a different methodology again, so another
+        # distinct marker (e.g. " chain32")
+        suffix = f" {_last_dispatch}" + suffix
     elif _last_dispatch == "pipelined":
         # methodology marker: pre-pipelining records carry no marker, so an
         # exact config match can never silently cross methodologies (the
@@ -127,6 +138,33 @@ def _timed_steps(exe, prog, data, loss_name, n_steps):
     round-trip (large when the device is reached over the axon tunnel)."""
     global _last_dispatch
     sync = os.environ.get("PT_BENCH_SYNC_FETCH") == "1"
+    chain = _chain_steps()
+    if chain > 1 and not sync:
+        # K steps per XLA call (Executor.run_steps fori_loop): zero host
+        # dispatch between steps — the true-device-throughput rung; the
+        # delta vs "pipelined" is the residual per-step dispatch cost
+        try:
+            exe.run_steps(prog, feed=data, n_steps=chain,
+                          fetch_list=[loss_name])  # warm/compile
+        except ValueError as e:
+            # ONLY the documented host-op rejection falls back — anything
+            # else must fail loudly, or the chainK leg would silently time
+            # the pipelined path and record a bogus ~0 dispatch delta
+            if "host" not in str(e):
+                raise
+            print(f"bench: chain dispatch unavailable ({e}); "
+                  "falling back to per-step", file=sys.stderr)
+            chain = 0
+        if chain:
+            n_chains = max(1, n_steps // chain)
+            t0 = time.perf_counter()
+            for _ in range(n_chains):
+                exe.run_steps(prog, feed=data, n_steps=chain,
+                              fetch_list=[loss_name])
+            dt = time.perf_counter() - t0
+            _last_dispatch = f"chain{chain}"
+            # report per-step time over the steps actually run
+            return dt * (n_steps / float(n_chains * chain))
     # warm BOTH signatures (fetch and no-fetch compile separate
     # executables) so no compile lands inside the timed region
     for _ in range(2):

@@ -50,7 +50,9 @@ def start_procs(args):
         endpoints = [f"127.0.0.1:{args.start_port + i}"
                      for i in range(args.server_num)]
     pserver_ips = ",".join(e.split(":")[0] for e in endpoints)
-    ports = sorted({e.split(":")[1] for e in endpoints})
+    # numeric sort: '10000' < '9999' lexicographically, and PADDLE_PORT
+    # must name the port pserver 0 actually binds
+    ports = sorted({e.split(":")[1] for e in endpoints}, key=int)
 
     base_env = dict(os.environ)
     base_env.pop("http_proxy", None)

@@ -620,23 +620,126 @@ class _CompiledBlock:
         return {"cost": dict(cost), "memory": mem}
 
     def _check_nan_inf(self, out_writes, fetches):
-        """FLAGS_check_nan_inf (reference operator.cc:953-984): scan every
-        written float var and raise naming the first non-finite one."""
-        import jax.numpy as jnp
+        _check_nan_inf(self.plan, self.label, out_writes, fetches)
 
-        named = list(out_writes.items()) + list(
-            zip(self.plan.jit_fetch_names, fetches))
-        for name, val in named:
-            try:
-                arr = jnp.asarray(val)
-            except TypeError:  # non-array fetch
-                continue
-            if not jnp.issubdtype(arr.dtype, jnp.floating):
-                continue
-            if not bool(jnp.isfinite(arr).all()):
-                raise RuntimeError(
-                    f"FLAGS_check_nan_inf: variable {name!r} contains "
-                    f"NaN/Inf after {self.label}")
+
+def _check_nan_inf(plan, label, out_writes, fetches):
+    """FLAGS_check_nan_inf (reference operator.cc:953-984): scan every
+    written float var and raise naming the first non-finite one."""
+    import jax.numpy as jnp
+
+    named = list(out_writes.items()) + list(
+        zip(plan.jit_fetch_names, fetches))
+    for name, val in named:
+        try:
+            arr = jnp.asarray(val)
+        except TypeError:  # non-array fetch
+            continue
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        if not bool(jnp.isfinite(arr).all()):
+            raise RuntimeError(
+                f"FLAGS_check_nan_inf: variable {name!r} contains "
+                f"NaN/Inf after {label}")
+
+
+class _CompiledChain:
+    """`n_steps` iterations of a block chained inside ONE jitted call.
+
+    A `lax.fori_loop` threads each iteration's scope writes into the next
+    iteration's reads (params/opt-state/BN stats advance on-device); only
+    the final step's fetches and writes come back to the host.  This is
+    the TPU analog of the reference C++ trainer's tight loop
+    (multi_trainer.cc — no Python between steps): one host→device
+    dispatch per `n_steps` instead of per step, which matters exactly
+    when dispatch is expensive (remote/tunneled devices, small steps).
+    """
+
+    def __init__(self, program, block, feed_names, fetch_names, place,
+                 scope, n_steps, stacked_feed):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        plan = BlockPlan(program, block, feed_names, fetch_names, scope,
+                         place=place)
+        if plan.host_ops or plan.host_pre_ops:
+            raise ValueError(
+                "run_steps chains the whole loop on-device; host ops "
+                f"({[op.type for op in plan.host_pre_ops + plan.host_ops]}) "
+                "need the host between steps — use run() per step")
+        if plan.host_fetch_names:
+            raise ValueError(
+                f"fetches {plan.host_fetch_names} are host-op outputs")
+        self.plan = plan
+        self.place = place
+        self.n_steps = n = int(n_steps)
+        if n < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        body = plan.make_body()
+
+        def feed_at(feeds, i):
+            if not stacked_feed:
+                return feeds
+            return {k: lax.dynamic_index_in_dim(v, i, axis=0,
+                                                keepdims=False)
+                    for k, v in feeds.items()}
+
+        def chained(donated, readonly, feeds, step0):
+            def one(i, d):
+                _, out_writes = body(d, readonly, feed_at(feeds, i),
+                                     step0 + i.astype(jnp.uint32))
+                return {k: out_writes.get(k, v) for k, v in d.items()}
+
+            d = (lax.fori_loop(0, n - 1, one, donated) if n > 1
+                 else donated)
+            fetches, out_writes = body(
+                d, readonly, feed_at(feeds, n - 1),
+                step0 + np.uint32(n - 1))
+            return fetches, out_writes
+
+        self._jitted = jax.jit(chained, donate_argnums=(0,))
+        self.label = (f"program@{id(program):x}/v{program._version}"
+                      f"/chain{n}")
+        self._prof_state = {"ran": False}
+
+    def run(self, scope, feeds, step):
+        import jax
+
+        from . import profiler as _prof
+
+        with _prof.timed_run(self.label, self._prof_state) as timer:
+            device = self.place.jax_device()
+            donated = {n: jax.device_put(scope.get(n), device)
+                       for n in self.plan.donated_names}
+            readonly = {}
+            for n in self.plan.readonly_names:
+                v = scope.get(n)
+                if v is None:
+                    raise ValueError(
+                        f"variable {n!r} is read by this program but "
+                        "absent from the current scope")
+                readonly[n] = jax.device_put(v, device)
+            feed_vals = {k: jax.device_put(v, device)
+                         for k, v in feeds.items()}
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # donation unsupported on CPU
+                fetches, out_writes = self._jitted(
+                    donated, readonly, feed_vals, np.uint32(step))
+            for n, v in out_writes.items():
+                scope.set(n, v)
+            timer.done(fetches, out_writes)
+        from . import flags as _flags
+
+        if _flags.flag("benchmark"):
+            jax.block_until_ready((fetches, out_writes))
+        if _flags.flag("check_nan_inf"):
+            # chain granularity: a NaN born mid-chain propagates through
+            # the remaining iterations (params/opt-state carry it), so the
+            # final-state scan still fails loudly — just n_steps later
+            # than run()'s per-step scan would
+            _check_nan_inf(self.plan, self.label, out_writes, fetches)
+        return self.plan.assemble_fetches(fetches, scope)
 
 
 # ---------------------------------------------------------------------------
@@ -767,6 +870,67 @@ class Executor:
         # execution path shares the instrumentation
         fetches = cb.run(scope, feed, self._step)
         self._step += 1
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+    def run_steps(
+        self,
+        program=None,
+        feed=None,
+        n_steps=1,
+        fetch_list=None,
+        scope=None,
+        return_numpy=True,
+        stacked_feed=False,
+    ):
+        """Run `n_steps` iterations of `program` as ONE compiled XLA call.
+
+        Semantically identical to calling run() `n_steps` times with the
+        same feed (scope writes thread into the next iteration's reads,
+        the executor step counter advances per iteration so random-op
+        streams match), but with a single host→device dispatch — the
+        reference C++ trainer's no-Python-between-steps loop
+        (multi_trainer.cc), which on a remote/tunneled TPU removes the
+        per-step round-trip entirely.
+
+        stacked_feed=True: each feed array carries a leading [n_steps]
+        axis, one slice consumed per iteration (the infeed pattern).
+        Only the FINAL step's fetches are returned.  Programs with host
+        ops (RPC/IO) are rejected — those need the host between steps."""
+        if isinstance(n_steps, bool) or int(n_steps) != n_steps:
+            raise ValueError(f"n_steps must be an int, got {n_steps!r}")
+        program = program if program is not None \
+            else framework.default_main_program()
+        scope = scope or global_scope()
+        feed = self._coerce_feed(program, feed)
+        if stacked_feed:
+            bad = {k: np.shape(v) for k, v in feed.items()
+                   if not np.shape(v) or np.shape(v)[0] != int(n_steps)}
+            if bad:
+                raise ValueError(
+                    f"stacked_feed arrays need a leading [{n_steps}] "
+                    f"axis; got {bad}")
+        fetch_list = list(fetch_list or [])
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in fetch_list]
+        key = (self._cache_key(program, feed, fetch_names), "chain",
+               int(n_steps), bool(stacked_feed))
+        cc = self._cache.get(key)
+        if cc is None:
+            import time as _time
+
+            from . import profiler as _prof
+
+            t0 = _time.perf_counter()
+            cc = _CompiledChain(program, program.global_block(),
+                                feed.keys(), fetch_names, self.place,
+                                scope, int(n_steps), bool(stacked_feed))
+            self._cache[key] = cc
+            self._cache[(key, "pin")] = program
+            _prof._record("trace", cc.label, _time.perf_counter() - t0)
+        fetches = cc.run(scope, feed, self._step)
+        self._step += int(n_steps)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

@@ -40,6 +40,34 @@ def test_pipelined_warms_both_signatures(monkeypatch):
         assert dt2 > 0
 
 
+def test_chain_steps_dispatch(monkeypatch):
+    """PT_BENCH_CHAIN_STEPS=K routes the timed loop through
+    Executor.run_steps (one XLA call per K steps) and marks the config
+    with a distinct " chainK" methodology suffix."""
+    monkeypatch.delenv("PT_BENCH_SYNC_FETCH", raising=False)
+    monkeypatch.setenv("PT_BENCH_CHAIN_STEPS", "4")
+    main, startup, loss, data = _tiny_step()
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.asarray(scope.get("fc_0.w_0")).copy()
+        dt = bench._timed_steps(exe, main, data, loss.name, 8)
+        assert dt > 0
+        # training advanced through the chained calls
+        assert not np.allclose(w0, np.asarray(scope.get("fc_0.w_0")))
+    assert bench._last_dispatch == "chain4"
+    assert " chain4" in bench._cpu_suffix()
+    # sync-fetch wins over chaining (the A/B leg pins dispatch cost)
+    monkeypatch.setenv("PT_BENCH_SYNC_FETCH", "1")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        bench._timed_steps(exe, main, data, loss.name, 3)
+    assert bench._last_dispatch == "syncfetch"
+
+
 def test_sync_fetch_variant_single_signature(monkeypatch):
     monkeypatch.setenv("PT_BENCH_SYNC_FETCH", "1")
     main, startup, loss, data = _tiny_step()

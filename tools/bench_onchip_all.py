@@ -53,6 +53,11 @@ def probe(budget=45):
 
 def run_bench(label, extra_env, budget):
     env = dict(os.environ, PT_BENCH_CHILD="base", **extra_env)
+    # same hazard class as the dtype knobs: a stale chain/batch override in
+    # the ambient shell must not silently relabel a leg's methodology
+    for knob in ("PT_BENCH_CHAIN_STEPS", "PT_BENCH_BATCH"):
+        if knob not in extra_env:
+            env.pop(knob, None)
     try:
         out = subprocess.run([sys.executable, BENCH], env=env,
                              capture_output=True, text=True, timeout=budget)
@@ -181,6 +186,11 @@ class Suite:
         # can never mislabel an A/B leg (the bench_longseq lesson)
         ("bf16_policy", {"PT_BENCH_BF16": "1", "PT_BENCH_FP32": "0",
                          "PT_BENCH_AMP": "0", "PT_BENCH_SYNC_FETCH": "0"}),
+        # K steps per XLA call (Executor.run_steps): vs bf16_policy, the
+        # delta is the residual per-step dispatch cost over the tunnel
+        ("bf16_chain32", {"PT_BENCH_BF16": "1", "PT_BENCH_FP32": "0",
+                          "PT_BENCH_AMP": "0", "PT_BENCH_SYNC_FETCH": "0",
+                          "PT_BENCH_CHAIN_STEPS": "32"}),
         ("fp32_headline", {"PT_BENCH_FP32": "1", "PT_BENCH_BF16": "0",
                            "PT_BENCH_AMP": "0", "PT_BENCH_SYNC_FETCH": "0"}),
         ("amp_rewrite", {"PT_BENCH_AMP": "1", "PT_BENCH_FP32": "0",

@@ -143,15 +143,15 @@ def _timed_steps(exe, prog, data, loss_name, n_steps):
         # K steps per XLA call (Executor.run_steps fori_loop): zero host
         # dispatch between steps — the true-device-throughput rung; the
         # delta vs "pipelined" is the residual per-step dispatch cost
+        from paddle_tpu.fluid.executor import HostOpsUnsupported
+
         try:
             exe.run_steps(prog, feed=data, n_steps=chain,
                           fetch_list=[loss_name])  # warm/compile
-        except ValueError as e:
+        except HostOpsUnsupported as e:
             # ONLY the documented host-op rejection falls back — anything
             # else must fail loudly, or the chainK leg would silently time
             # the pipelined path and record a bogus ~0 dispatch delta
-            if "host" not in str(e):
-                raise
             print(f"bench: chain dispatch unavailable ({e}); "
                   "falling back to per-step", file=sys.stderr)
             chain = 0

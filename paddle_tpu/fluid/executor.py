@@ -502,7 +502,66 @@ class _FeedScopeView:
         self._scope.set(name, value)
 
 
-class _CompiledBlock:
+class _JitExecutable:
+    """Shared introspection surface of a cached jitted executable
+    (`_CompiledBlock` per-step, `_CompiledChain` n-steps-per-call):
+    abstract arg specs for AOT lowering, XLA cost/memory analysis, and
+    the FLAGS_check_nan_inf scan.  Subclasses provide `plan`, `label`,
+    `_jitted`, `donated_names`, `readonly_names`."""
+
+    def _jit_args(self, scope, feeds, step):
+        """The (donated, readonly, feeds, step) pytrees run() passes to the
+        jitted body, as abstract ShapeDtypeStructs — enough for AOT
+        lowering without touching device memory."""
+        import jax
+
+        def spec(n, v):
+            if v is None:
+                # same guard as run(): name the variable instead of letting
+                # np.asarray(None) produce an opaque object-dtype error
+                raise ValueError(
+                    f"variable {n!r} is read by this program but absent "
+                    "from the current scope")
+            a = np.asarray(v) if not hasattr(v, "dtype") else v
+            return jax.ShapeDtypeStruct(np.shape(a), a.dtype)
+
+        donated = {n: spec(n, scope.get(n)) for n in self.donated_names}
+        readonly = {n: spec(n, scope.get(n)) for n in self.readonly_names}
+        feed_vals = {k: spec(k, v) for k, v in feeds.items()}
+        return donated, readonly, feed_vals, jax.ShapeDtypeStruct(
+            (), np.uint32)
+
+    def cost_analysis(self, scope, feeds, step=0):
+        """XLA's per-executable cost model for this step: flops, bytes
+        accessed (total and per memory space), transcendentals.  AOT
+        (`jit.lower(...).compile()`), so the shapes must match a prior or
+        future run; the executable cache makes this free after a warmup.
+        TPU analog of the reference's per-op profiler tables
+        (platform/profiler.cc) at whole-program granularity."""
+        lowered = self._jitted.lower(*self._jit_args(scope, feeds, step))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # donation unsupported on CPU
+            compiled = lowered.compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        mem = {}
+        try:
+            ma = compiled.memory_analysis()
+            for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "generated_code_size_in_bytes"):
+                v = getattr(ma, k, None)
+                if v is not None:
+                    mem[k] = int(v)
+        except Exception:  # backend without memory analysis
+            pass
+        return {"cost": dict(cost), "memory": mem}
+
+    def _check_nan_inf(self, out_writes, fetches):
+        _check_nan_inf(self.plan, self.label, out_writes, fetches)
+
+
+class _CompiledBlock(_JitExecutable):
     """One (program-version, feed-signature) → jitted XLA executable."""
 
     def __init__(self, program, block, feed_names, fetch_names, place, scope):
@@ -571,58 +630,6 @@ class _CompiledBlock:
         self.plan.run_host_ops(scope, self.place, feeds=feeds)
         return self.plan.assemble_fetches(fetches, scope)
 
-    def _jit_args(self, scope, feeds, step):
-        """The (donated, readonly, feeds, step) pytrees run() passes to the
-        jitted body, as abstract ShapeDtypeStructs — enough for AOT
-        lowering without touching device memory."""
-        import jax
-
-        def spec(n, v):
-            if v is None:
-                # same guard as run(): name the variable instead of letting
-                # np.asarray(None) produce an opaque object-dtype error
-                raise ValueError(
-                    f"variable {n!r} is read by this program but absent "
-                    "from the current scope")
-            a = np.asarray(v) if not hasattr(v, "dtype") else v
-            return jax.ShapeDtypeStruct(np.shape(a), a.dtype)
-
-        donated = {n: spec(n, scope.get(n)) for n in self.donated_names}
-        readonly = {n: spec(n, scope.get(n)) for n in self.readonly_names}
-        feed_vals = {k: spec(k, v) for k, v in feeds.items()}
-        return donated, readonly, feed_vals, jax.ShapeDtypeStruct(
-            (), np.uint32)
-
-    def cost_analysis(self, scope, feeds, step=0):
-        """XLA's per-executable cost model for this step: flops, bytes
-        accessed (total and per memory space), transcendentals.  AOT
-        (`jit.lower(...).compile()`), so the shapes must match a prior or
-        future run; the executable cache makes this free after a warmup.
-        TPU analog of the reference's per-op profiler tables
-        (platform/profiler.cc) at whole-program granularity."""
-        lowered = self._jitted.lower(*self._jit_args(scope, feeds, step))
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore")  # donation unsupported on CPU
-            compiled = lowered.compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
-            cost = cost[0] if cost else {}
-        mem = {}
-        try:
-            ma = compiled.memory_analysis()
-            for k in ("argument_size_in_bytes", "output_size_in_bytes",
-                      "temp_size_in_bytes", "generated_code_size_in_bytes"):
-                v = getattr(ma, k, None)
-                if v is not None:
-                    mem[k] = int(v)
-        except Exception:  # backend without memory analysis
-            pass
-        return {"cost": dict(cost), "memory": mem}
-
-    def _check_nan_inf(self, out_writes, fetches):
-        _check_nan_inf(self.plan, self.label, out_writes, fetches)
-
-
 def _check_nan_inf(plan, label, out_writes, fetches):
     """FLAGS_check_nan_inf (reference operator.cc:953-984): scan every
     written float var and raise naming the first non-finite one."""
@@ -643,7 +650,14 @@ def _check_nan_inf(plan, label, out_writes, fetches):
                 f"NaN/Inf after {label}")
 
 
-class _CompiledChain:
+class HostOpsUnsupported(ValueError):
+    """Raised when an on-device step chain meets a program whose host ops
+    (RPC/IO) need the host between steps.  A distinct type so fallback
+    logic (train_from_dataset chaining, bench chain mode) can classify
+    it exactly instead of matching error text."""
+
+
+class _CompiledChain(_JitExecutable):
     """`n_steps` iterations of a block chained inside ONE jitted call.
 
     A `lax.fori_loop` threads each iteration's scope writes into the next
@@ -664,15 +678,17 @@ class _CompiledChain:
         plan = BlockPlan(program, block, feed_names, fetch_names, scope,
                          place=place)
         if plan.host_ops or plan.host_pre_ops:
-            raise ValueError(
+            raise HostOpsUnsupported(
                 "run_steps chains the whole loop on-device; host ops "
                 f"({[op.type for op in plan.host_pre_ops + plan.host_ops]}) "
                 "need the host between steps — use run() per step")
         if plan.host_fetch_names:
-            raise ValueError(
+            raise HostOpsUnsupported(
                 f"fetches {plan.host_fetch_names} are host-op outputs")
         self.plan = plan
         self.place = place
+        self.donated_names = plan.donated_names
+        self.readonly_names = plan.readonly_names
         self.n_steps = n = int(n_steps)
         if n < 1:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
@@ -898,6 +914,13 @@ class Executor:
         axis, one slice consumed per iteration (the infeed pattern).
         Only the FINAL step's fetches are returned.  Programs with host
         ops (RPC/IO) are rejected — those need the host between steps."""
+        from . import compiler
+
+        if isinstance(program, compiler.CompiledProgram):
+            raise ValueError(
+                "run_steps does not support CompiledProgram (data-parallel "
+                "programs shard feeds in their own run path) — use run() "
+                "per step")
         if isinstance(n_steps, bool) or int(n_steps) != n_steps:
             raise ValueError(f"n_steps must be an int, got {n_steps!r}")
         program = program if program is not None \
@@ -914,8 +937,11 @@ class Executor:
         fetch_list = list(fetch_list or [])
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in fetch_list]
-        key = (self._cache_key(program, feed, fetch_names), "chain",
-               int(n_steps), bool(stacked_feed))
+        # FLAT key extension: key[0] stays id(program) so compiled_for()
+        # (and anything else scanning the cache by program) sees chain
+        # executables too
+        key = self._cache_key(program, feed, fetch_names) + (
+            "chain", int(n_steps), bool(stacked_feed))
         cc = self._cache.get(key)
         if cc is None:
             import time as _time
@@ -1016,17 +1042,82 @@ class Executor:
 
             it = pf = DatasetPrefetcher(dataset._iter_batches(),
                                         transform=transform, depth=depth)
+        # PT_DATASET_CHAIN=K: dispatch K same-shaped batches as ONE
+        # run_steps call (stacked_feed fori_loop) — the DeviceWorker-loop
+        # analog with zero host dispatch between steps.  Ragged tails and
+        # shape changes flush per-step (no surprise per-length compiles);
+        # CompiledProgram (DP) keeps its own run path.
+        chain = int(os.environ.get("PT_DATASET_CHAIN", "0") or 0)
+        if isinstance(program, _compiler.CompiledProgram):
+            chain = 0
         steps = 0
-        try:
-            for i, batch in enumerate(it):
-                res = self.run(program=program, feed=batch,
+        pending = []
+
+        def _shape_sig(batch):
+            return tuple(sorted((k, tuple(np.shape(v)))
+                                for k, v in batch.items()))
+
+        def _flush():
+            """Dispatch pending batches: a full chunk of exactly `chain`
+            goes as one run_steps call, anything else per-step."""
+            nonlocal steps, chain
+            res = None
+            if chain > 1 and len(pending) == chain:
+                import jax.numpy as jnp
+
+                chunk = list(pending)
+                pending.clear()
+                stacked = {k: jnp.stack([b[k] for b in chunk])
+                           for k in chunk[0]}
+                try:
+                    res = self.run_steps(
+                        program, feed=stacked, n_steps=chain,
+                        fetch_list=fetch_list, scope=scope,
+                        stacked_feed=True)
+                    steps += chain
+                    return res
+                except HostOpsUnsupported:
+                    chain = 0  # host ops — chaining permanently off
+                    pending[:] = chunk
+            while pending:
+                res = self.run(program=program, feed=pending.pop(0),
                                fetch_list=fetch_list, scope=scope)
                 steps += 1
-                if debug and fetch_list and i % print_period == 0:
-                    names = fetch_info or [
-                        f if isinstance(f, str) else f.name
-                        for f in fetch_list]
-                    logger.info("step %d: %s", i, dict(zip(names, res)))
+            return res
+
+        next_log = 0  # log by STEP count, not loop index — under chaining
+        # the loop only observes flush indices, which can never hit
+        # `i % print_period == 0` for most (chain, period) pairs
+
+        def _maybe_log(res):
+            nonlocal next_log
+            if debug and fetch_list and res is not None \
+                    and steps > next_log:
+                names = fetch_info or [
+                    f if isinstance(f, str) else f.name
+                    for f in fetch_list]
+                logger.info("step %d: %s", steps - 1,
+                            dict(zip(names, res)))
+                next_log += print_period
+
+        try:
+            sig = None
+            for batch in it:
+                if chain > 1:
+                    bsig = _shape_sig(batch)
+                    if pending and bsig != sig:
+                        _maybe_log(_flush())  # shape change: drain per-step
+                    sig = bsig
+                    pending.append(batch)
+                    if len(pending) < chain:
+                        continue
+                    _maybe_log(_flush())
+                else:
+                    res = self.run(program=program, feed=batch,
+                                   fetch_list=fetch_list, scope=scope)
+                    steps += 1
+                    _maybe_log(res)
+            _maybe_log(_flush())  # ragged tail drains per-step
         finally:
             if pf is not None:
                 pf.close()

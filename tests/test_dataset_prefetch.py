@@ -134,6 +134,60 @@ def test_train_from_dataset_prefetched_stats_and_parity(tmp_path):
     assert 0.0 <= stats_pre["input_bound_fraction"] <= 1.0
 
 
+def test_train_from_dataset_chained_dispatch_parity(tmp_path):
+    """PT_DATASET_CHAIN=K dispatches K same-shaped batches as one
+    run_steps call; odd-count and ragged (shape-changing) tails drain
+    per-step.  Final weights and step counts must match the per-step
+    loop exactly (250 samples / batch 48 = 5 full batches + one ragged
+    10-row tail: chain-2 → two chains + two per-step flushes)."""
+    import os
+
+    p = str(tmp_path / "train.txt")
+    _write_multislot(p, 250, seed=5)
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            sm = fluid.layers.softmax(fluid.layers.fc(x, size=2))
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(sm, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    def run(chain_env):
+        main, startup, loss = build()
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(48)
+        ds.set_use_var([main.global_block().var("x"),
+                        main.global_block().var("y")])
+        ds.set_filelist([p])
+        ds.load_into_memory()
+        s = Scope()
+        old = os.environ.get("PT_DATASET_CHAIN")
+        os.environ["PT_DATASET_CHAIN"] = chain_env
+        try:
+            with scope_guard(s):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                for _ in range(2):
+                    exe.train_from_dataset(program=main, dataset=ds)
+                stats = getattr(exe, "last_dataset_stats", None)
+                return (np.asarray(s.get("fc_0.w_0")).copy(), stats,
+                        exe._step)
+        finally:
+            if old is None:
+                os.environ.pop("PT_DATASET_CHAIN", None)
+            else:
+                os.environ["PT_DATASET_CHAIN"] = old
+
+    w_plain, stats_plain, _ = run("0")
+    w_chain, stats_chain, step_chain = run("2")
+    np.testing.assert_allclose(w_plain, w_chain, rtol=1e-5, atol=1e-6)
+    assert stats_plain["steps"] == 6 and stats_chain["steps"] == 6
+    assert step_chain == 13  # startup + 2 epochs x 6 steps
+
+
 def test_feed_accepts_device_resident_arrays():
     """_coerce_feed must pass jax arrays through without a host round-trip
     (device_put-ahead depends on it)."""

@@ -141,16 +141,52 @@ def test_run_steps_check_nan_inf_flag():
 
 
 def test_run_steps_rejects_host_ops():
+    """A program containing a host op (here: a PS-mode `send`, which must
+    run on the host between steps) is rejected with the typed error at
+    plan time — before anything could dial a pserver."""
+    from paddle_tpu.fluid.executor import HostOpsUnsupported
+
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
         x = fluid.layers.data(name="x", shape=[4], dtype="float32")
         loss = fluid.layers.mean(fluid.layers.fc(x, size=1))
-        fluid.layers.Print(loss, message="host op")
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        main.global_block().append_op(
+            "send", inputs={"X": [loss]}, outputs={},
+            attrs={"epmap": ["127.0.0.1:0"]})
     with scope_guard(Scope()):
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)
         feed = {"x": np.ones((2, 4), np.float32)}
-        try:
+        with pytest.raises(HostOpsUnsupported, match="host"):
             exe.run_steps(main, feed=feed, n_steps=2, fetch_list=[loss])
-        except ValueError as e:
-            assert "host op" in str(e) or "host" in str(e)
+
+
+def test_run_steps_rejects_compiled_program():
+    from paddle_tpu.fluid import compiler
+
+    main, startup, loss = _build(with_dropout=False)
+    cp = compiler.CompiledProgram(main)
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with pytest.raises(ValueError, match="CompiledProgram"):
+            exe.run_steps(cp, feed=_feed(np.random.RandomState(3)),
+                          n_steps=2, fetch_list=[loss])
+
+
+def test_run_steps_visible_to_compiled_for():
+    """Chain executables share the introspection surface: compiled_for()
+    lists them and cost_analysis works on the chain object."""
+    main, startup, loss = _build(with_dropout=False)
+    feed = _feed(np.random.RandomState(4))
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run_steps(main, feed=feed, n_steps=3, fetch_list=[loss])
+        chains = [cb for cb in exe.compiled_for(main)
+                  if "chain" in cb.label]
+        assert len(chains) == 1
+        cost = chains[0].cost_analysis(fluid.global_scope(),
+                                       exe._coerce_feed(main, feed))
+        assert cost["cost"].get("flops", 0) > 0

@@ -186,31 +186,78 @@ class HybridParallelRunner:
             return None
         return (pmesh.DATA_AXIS,) + (None,) * (len(shape) - 1)
 
-    def run(self, scope=None, feed=None, fetch_list=None, return_numpy=True):
-        scope = scope if scope is not None else self._default_scope
-        if scope is None:
-            from paddle_tpu.fluid.executor import global_scope
+    def _resolve_scope(self, scope):
+        if scope is not None:
+            return scope
+        if self._default_scope is not None:
+            return self._default_scope
+        from paddle_tpu.fluid.executor import global_scope
 
-            scope = global_scope()
+        return global_scope()
+
+    @staticmethod
+    def _prep(feed, fetch_list):
+        """Coerce feed values and build the (feed_sig, fetch_names) cache
+        identity.  v.dtype directly — np.asarray on a device-resident jax
+        array would force a host transfer just to read the dtype."""
         feed = {k: np.asarray(v) if not hasattr(v, "dtype") else v
                 for k, v in (feed or {}).items()}
-        fetch_names = [f if isinstance(f, str) else f.name for f in (fetch_list or [])]
-        feed_sig = tuple((k, tuple(np.shape(v)), str(np.asarray(v).dtype))
+        fetch_names = [f if isinstance(f, str) else f.name
+                       for f in (fetch_list or [])]
+        feed_sig = tuple((k, tuple(np.shape(v)), str(v.dtype))
                          for k, v in sorted(feed.items()))
-        key = (self.program._version, feed_sig, tuple(fetch_names))
+        return feed, fetch_names, feed_sig
+
+    def _dispatch(self, key, scope, feed, fetch_names, n_steps,
+                  stacked_feed, return_numpy):
         cb = self._cache.get(key)
         if cb is None:
-            cb = self._compile(scope, list(feed.keys()), fetch_names)
+            cb = self._compile(scope, list(feed.keys()), fetch_names,
+                               n_steps=n_steps, stacked_feed=stacked_feed)
             self._cache[key] = cb
         fetches = cb(scope, feed, self._step)
-        self._step += 1
+        self._step += n_steps
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
 
-    def _compile(self, scope, feed_names, fetch_names):
+    def run(self, scope=None, feed=None, fetch_list=None, return_numpy=True):
+        scope = self._resolve_scope(scope)
+        feed, fetch_names, feed_sig = self._prep(feed, fetch_list)
+        key = (self.program._version, feed_sig, tuple(fetch_names))
+        return self._dispatch(key, scope, feed, fetch_names, 1, False,
+                              return_numpy)
+
+    def run_steps(self, feed, n_steps, fetch_list=None, scope=None,
+                  return_numpy=True, stacked_feed=False):
+        """`n_steps` GSPMD-partitioned steps in ONE jitted call — the
+        fori_loop carries the sharded params/opt-state on-device (the
+        big-training scan-over-steps pattern), with the step counter
+        advancing per iteration exactly like n run() calls.
+        stacked_feed=True: feed arrays carry a leading [n_steps] axis
+        (replicated across the mesh), one slice per iteration.  Only the
+        final step's fetches return."""
+        scope = self._resolve_scope(scope)
+        n = int(n_steps)
+        if n < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps!r}")
+        feed, fetch_names, feed_sig = self._prep(feed, fetch_list)
+        if stacked_feed:
+            bad = {k: np.shape(v) for k, v in feed.items()
+                   if not np.shape(v) or np.shape(v)[0] != n}
+            if bad:
+                raise ValueError(
+                    f"stacked_feed arrays need a leading [{n}] axis; "
+                    f"got {bad}")
+        key = (self.program._version, feed_sig, tuple(fetch_names),
+               "chain", n, bool(stacked_feed))
+        return self._dispatch(key, scope, feed, fetch_names, n,
+                              bool(stacked_feed), return_numpy)
+
+    def _compile(self, scope, feed_names, fetch_names, n_steps=1,
+                 stacked_feed=False):
         import jax
-        from paddle_tpu.fluid.executor import BlockPlan
+        from paddle_tpu.fluid.executor import BlockPlan, HostOpsUnsupported
 
         program, mesh = self.program, self.mesh
         plan = BlockPlan(program, program.global_block(), feed_names,
@@ -219,7 +266,40 @@ class HybridParallelRunner:
             raise NotImplementedError(
                 "pre-stage host ops (distributed lookup) are only "
                 "supported by the single-device Executor")
+        chain_mode = n_steps > 1 or stacked_feed
+        if chain_mode and (plan.host_ops or plan.host_fetch_names):
+            raise HostOpsUnsupported(
+                "run_steps chains the whole loop on-device; host ops "
+                f"({[op.type for op in plan.host_ops]}) need the host "
+                "between steps — use run() per step")
         inner_body = plan.make_body()
+
+        if chain_mode:
+            import jax.numpy as jnp
+            from jax import lax
+
+            single = inner_body
+
+            def feed_at(feeds, i):
+                if not stacked_feed:
+                    return feeds
+                return {k: lax.dynamic_index_in_dim(v, i, axis=0,
+                                                    keepdims=False)
+                        for k, v in feeds.items()}
+
+            def chained(donated_, readonly_, feeds, step0):
+                def one(i, d):
+                    _, out_writes = single(d, readonly_,
+                                           feed_at(feeds, i),
+                                           step0 + i.astype(jnp.uint32))
+                    return {k: out_writes.get(k, v) for k, v in d.items()}
+
+                d = (lax.fori_loop(0, n_steps - 1, one, donated_)
+                     if n_steps > 1 else donated_)
+                return single(d, readonly_, feed_at(feeds, n_steps - 1),
+                              step0 + np.uint32(n_steps - 1))
+
+            inner_body = chained
 
         def body(*args):
             # ops that adapt their lowering to the mesh (ring attention on
@@ -237,9 +317,16 @@ class HybridParallelRunner:
 
         def feed_shard(name):
             if name in self.feed_specs:
-                return self._spec(*self.feed_specs[name])
-            ax = pmesh.DATA_AXIS if pmesh.DATA_AXIS in mesh.axis_names else None
-            return self._spec(ax) if ax else self._spec()
+                axes = tuple(self.feed_specs[name])
+            else:
+                ax = (pmesh.DATA_AXIS
+                      if pmesh.DATA_AXIS in mesh.axis_names else None)
+                axes = (ax,) if ax else ()
+            if stacked_feed:
+                # leading [n_steps] axis is the loop index — replicated;
+                # the batch dim (now dim 1) keeps its dp sharding
+                axes = (None,) + axes
+            return self._spec(*axes)
 
         feeds_sh = {n: feed_shard(n) for n in feed_names}
         out_sh = ([self._spec() for _ in fetch_names],

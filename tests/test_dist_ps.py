@@ -51,26 +51,36 @@ def test_transport_sync_rounds_two_trainers():
     st = threading.Thread(target=server_loop)
     st.start()
 
+    errors = {}
+
     def trainer(tid):
-        cli = native.PSClient(port=port)
-        if tid == 0:
-            cli.send_param("w", np.ones(4, np.float32))
-        for r in range(1, 6):
-            cli.send_grad("w@GRAD", np.full(4, float(tid + 1), np.float32))
-            cli.send_barrier()
-            w = cli.get_param("w", want_version=r)
-            cli.fetch_barrier()
-        results[tid] = w
-        if tid == 0:
-            cli.stop_server()
-        cli.close()
+        # record failures by thread: a raising trainer would otherwise
+        # surface only as a bare KeyError on `results[tid]` below, hiding
+        # the real exception (seen once as a load-flake in the full suite)
+        try:
+            cli = native.PSClient(port=port)
+            if tid == 0:
+                cli.send_param("w", np.ones(4, np.float32))
+            for r in range(1, 6):
+                cli.send_grad("w@GRAD",
+                              np.full(4, float(tid + 1), np.float32))
+                cli.send_barrier()
+                w = cli.get_param("w", want_version=r)
+                cli.fetch_barrier()
+            results[tid] = w
+            if tid == 0:
+                cli.stop_server()
+            cli.close()
+        except Exception as e:  # noqa: BLE001 — reported below
+            errors[tid] = e
 
     ts = [threading.Thread(target=trainer, args=(i,)) for i in range(2)]
     for x in ts:
         x.start()
     for x in ts:
-        x.join(timeout=30)
+        x.join(timeout=60)
     st.join(timeout=10)
+    assert not errors, f"trainer thread(s) failed: {errors}"
     assert all(not x.is_alive() for x in ts) and not st.is_alive()
     # mean grad 1.5, 5 rounds: w = 1 - 0.1*1.5*5
     np.testing.assert_allclose(results[0], 0.25, rtol=1e-6)

@@ -14,3 +14,4 @@ from . import bert  # noqa: F401
 from . import gpt  # noqa: F401
 from . import mobilenet  # noqa: F401
 from . import googlenet  # noqa: F401
+from . import densenet  # noqa: F401

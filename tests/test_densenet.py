@@ -1,0 +1,62 @@
+"""DenseNet model family (models/densenet.py) — the dense-connectivity
+topology.  Scaled-down blocks run the full path; structure checks pin the
+bottleneck/concat growth and the transition compression."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.models import densenet
+
+TINY_BLOCKS = (2, 2)
+TINY_GROWTH = 4
+
+
+def test_densenet_structure_and_training():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, pred, loss, acc = densenet.build_densenet(
+            class_dim=4, image_shape=(3, 32, 32), growth_rate=TINY_GROWTH,
+            block_cfg=TINY_BLOCKS)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+
+    ops = [op.type for op in main.global_block().ops]
+    n_layers = sum(TINY_BLOCKS)
+    # one concat per dense layer — the defining growth pattern
+    assert ops.count("concat") == n_layers
+    # stem + 2 convs per dense layer + 1 per transition
+    assert ops.count("conv2d") == 1 + 2 * n_layers + (len(TINY_BLOCKS) - 1)
+    # channel growth: concat inputs widen by growth_rate each layer
+    concats = [op for op in main.global_block().ops if op.type == "concat"]
+    widths = [main.global_block().var(op.inputs["X"][0]).shape[1]
+              for op in concats]
+    assert widths[1] - widths[0] == TINY_GROWTH
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 4, (8, 1)).astype("int64")
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"img": x, "label": y},
+                                fetch_list=[loss])[0]) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+
+
+def test_densenet121_full_builds():
+    """The real 121 config constructs at 224x224 with the right layer
+    count and the transition compression halving channels."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        densenet.build_densenet(depth=121, class_dim=10, is_test=True)
+    ops = [op.type for op in main.global_block().ops]
+    assert ops.count("concat") == sum(densenet.DEPTH_CFG[121])  # 58
+    for op in main.global_block().ops:
+        if op.type in ("batch_norm", "dropout"):
+            assert op.attrs.get("is_test")
+    # first transition: 64 + 6*32 = 256 channels in, 128 out
+    trans_convs = [op for op in main.global_block().ops
+                   if op.type == "conv2d"]
+    shapes = [main.global_block().var(op.inputs["Filter"][0]).shape
+              for op in trans_convs]
+    assert [128, 256, 1, 1] in [list(s) for s in shapes]

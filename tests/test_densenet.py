@@ -54,9 +54,12 @@ def test_densenet121_full_builds():
     for op in main.global_block().ops:
         if op.type in ("batch_norm", "dropout"):
             assert op.attrs.get("is_test")
-    # first transition: 64 + 6*32 = 256 channels in, 128 out
-    trans_convs = [op for op in main.global_block().ops
-                   if op.type == "conv2d"]
-    shapes = [main.global_block().var(op.inputs["Filter"][0]).shape
-              for op in trans_convs]
-    assert [128, 256, 1, 1] in [list(s) for s in shapes]
+    # first transition conv sits right after block 1's 6 dense layers
+    # (2 convs each) + the stem: conv index 1 + 12 = 13.  Its filter must
+    # compress 64 + 6*32 = 256 channels down to 128 — indexed precisely,
+    # because a later dense-block bottleneck also happens to be
+    # [128, 256, 1, 1] and would mask a broken compression.
+    convs = [op for op in main.global_block().ops if op.type == "conv2d"]
+    trans1 = convs[1 + 2 * densenet.DEPTH_CFG[121][0]]
+    w = main.global_block().var(trans1.inputs["Filter"][0])
+    assert list(w.shape) == [128, 256, 1, 1]

@@ -180,3 +180,46 @@ def test_bf16_policy_step_finite():
     l32, l16 = run(False), run(True)
     assert np.isfinite(l32) and np.isfinite(l16)
     np.testing.assert_allclose(l16, l32, rtol=0.05)
+
+
+def test_run_steps_chain_on_chip():
+    """4 steps in ONE compiled call (Executor.run_steps) on the real
+    device must match 4 per-step run() calls (deterministic init, same
+    feed): same final loss, same final weights — the chain-dispatch
+    path works on-chip, not just the CPU mesh."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(fluid.layers.fc(x, size=16, act="relu"),
+                                   size=1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.Momentum(learning_rate=0.05,
+                                     momentum=0.9).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.rand(16, 8).astype("float32"),
+            "y": rng.rand(16, 1).astype("float32")}
+
+    main, startup, loss = build()
+    seq = chain = None
+    w_name = "fc_0.w_0"
+    w_seq = w_chain = None
+    exe = fluid.Executor(_place())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(4):
+            seq, = exe.run(main, feed=feed, fetch_list=[loss])
+        w_seq = np.asarray(scope.get(w_name)).copy()
+    exe2 = fluid.Executor(_place())
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2.run(startup)
+        chain, = exe2.run_steps(main, feed=feed, n_steps=4,
+                                fetch_list=[loss])
+        w_chain = np.asarray(scope2.get(w_name))
+    np.testing.assert_allclose(float(chain), float(seq), rtol=1e-5)
+    np.testing.assert_allclose(w_chain, w_seq, rtol=1e-5, atol=1e-6)

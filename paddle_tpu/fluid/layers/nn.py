@@ -1035,7 +1035,18 @@ def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    raise NotImplementedError("unfold: pending im2col lowering")
+    """im2col (reference unfold_op.cc): [N, C, H, W] → [N, C*kh*kw, L]."""
+    def _pair(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+    helper = LayerHelper("unfold", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("unfold", inputs={"X": [x]}, outputs={"Y": [out]},
+                     attrs={"kernel_sizes": _pair(kernel_sizes),
+                            "strides": _pair(strides),
+                            "paddings": _pair(paddings),
+                            "dilations": _pair(dilations)})
+    return out
 
 
 def group_norm_(*a, **k):

@@ -26,3 +26,4 @@ from . import tensor_extra_ops  # noqa: F401
 from . import nn_extra_ops  # noqa: F401
 from . import detection_extra_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
+from . import compat_ops  # noqa: F401

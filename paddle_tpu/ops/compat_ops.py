@@ -1,0 +1,336 @@
+"""Interop op lowerings: reference op types that appear in exported
+programs but had no registration here — each a compositional JAX lowering
+(or host op for checkpoint save/load), so protobuf-imported programs run
+without translation.
+
+Reference analogs cited per op (paddle/fluid/operators/...).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.fluid.registry import register_op, simple_op, get_op
+
+from .common import np_dtype, op_rng_key
+
+# ---------------------------------------------------------------------------
+# small math (minus_op.cc, l1_norm_op.cc, squared_l2_distance_op.cc,
+# modified_huber_loss_op.h, cos_sim_op.cc, fill_op.cc:91-97,
+# fill_zeros_like_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@simple_op("minus", ["X", "Y"], ["Out"])
+def _minus(ctx, x, y, attrs):
+    return x - y
+
+
+@simple_op("l1_norm", ["X"], ["Out"])
+def _l1_norm(ctx, x, attrs):
+    return jnp.sum(jnp.abs(x))
+
+
+@simple_op("squared_l2_distance", ["X", "Y"], ["sub_result", "Out"])
+def _squared_l2_distance(ctx, x, y, attrs):
+    """Row-wise ||x - y||²; Y may carry one row broadcast against X's
+    batch (squared_l2_distance_op.cc InferShape)."""
+    sub = x - y  # broadcasts the single-row target
+    sub = jnp.broadcast_to(sub, jnp.shape(x))
+    flat = jnp.reshape(sub, (jnp.shape(x)[0], -1))
+    return sub, jnp.sum(flat * flat, axis=1, keepdims=True)
+
+
+@simple_op("modified_huber_loss", ["X", "Y"], ["IntermediateVal", "Out"])
+def _modified_huber_loss(ctx, x, y, attrs):
+    """y ∈ {0,1} scaled to ±1; z = x·y': 0 if z≥1, (1-z)² if -1≤z<1,
+    -4z otherwise (modified_huber_loss_op.h:36-46,69)."""
+    z = x * (2.0 * y - 1.0)
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    return z, loss
+
+
+@simple_op("cos_sim", ["X", "Y"], ["Out", "XNorm", "YNorm"])
+def _cos_sim(ctx, x, y, attrs):
+    """Row-wise cosine similarity; Y may be one row (cos_sim_op.cc)."""
+    xf = jnp.reshape(x, (jnp.shape(x)[0], -1))
+    yf = jnp.reshape(y, (jnp.shape(y)[0], -1))
+    xn = jnp.sqrt(jnp.sum(xf * xf, axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(yf * yf, axis=1, keepdims=True))
+    dot = jnp.sum(xf * yf, axis=1, keepdims=True)
+    return dot / (xn * yn + 1e-12), xn, yn
+
+
+@simple_op("fill", [], ["Out"], grad=None)
+def _fill(ctx, attrs):
+    """Constant from raw attr data: `value` floats reinterpreted to
+    `dtype`, reshaped to `shape` (fill_op.cc:91-97)."""
+    dtype = np_dtype(attrs.get("dtype", "float32"))
+    shape = [int(s) for s in attrs.get("shape", [])]
+    vals = np.asarray(attrs.get("value", []), dtype=np.float64)
+    return jnp.asarray(vals.astype(dtype).reshape(shape))
+
+
+@simple_op("fill_zeros_like2", ["X"], ["Out"], grad=None)
+def _fill_zeros_like2(ctx, x, attrs):
+    dtype = attrs.get("dtype")
+    return jnp.zeros_like(x, dtype=np_dtype(dtype) if dtype else None)
+
+
+@simple_op("sampling_id", ["X"], ["Out"], grad=None)
+def _sampling_id(ctx, x, attrs):
+    """One categorical draw per row of probabilities
+    (sampling_id_op.cc; min/max attrs bound the uniform draw)."""
+    lo = attrs.get("min", 0.0)
+    hi = attrs.get("max", 1.0)
+    u = jax.random.uniform(op_rng_key(ctx, attrs), (jnp.shape(x)[0], 1),
+                           minval=lo, maxval=hi)
+    cum = jnp.cumsum(x, axis=-1)
+    hit = cum >= u
+    # no bucket reached (rounding shortfall / max attr above the row sum):
+    # the reference kernel keeps its init value width-1, not 0
+    fallback = jnp.shape(x)[1] - 1
+    return jnp.where(jnp.any(hit, axis=-1),
+                     jnp.argmax(hit, axis=-1),
+                     fallback).astype(jnp.int64)
+
+
+@simple_op("lod_reset", ["X", "Y"], ["Out"], optional=("Y",))
+def _lod_reset(ctx, x, y, attrs):
+    """LoD is host-side metadata in this build (dense + lengths), so the
+    tensor passes through unchanged (lod_reset_op.cc)."""
+    return x
+
+
+# ---------------------------------------------------------------------------
+# conv_shift (conv_shift_op.cc:128-134): circular correlation
+# out[b, i] = Σ_j x[b, (i + j - (N-1)/2) mod M] * y[b, j]
+# ---------------------------------------------------------------------------
+
+
+@simple_op("conv_shift", ["X", "Y"], ["Out"])
+def _conv_shift(ctx, x, y, attrs):
+    n = int(jnp.shape(y)[1])
+    half = (n - 1) // 2
+    # roll X so column i aligns with x[(i + j - half) mod M]
+    shifted = [jnp.roll(x, shift=half - j, axis=1) * y[:, j:j + 1]
+               for j in range(n)]  # N is small and static (NTM shifts)
+    return sum(shifted)
+
+
+# ---------------------------------------------------------------------------
+# im2col family (unfold_op.cc; max-index pooling unpool_op.cc, spp_op.cc,
+# max_pool2d_with_index via pool_with_index_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _patches(x, ksize, strides, paddings, dilations):
+    """[N, C, H, W] → [N, C, kh*kw, H', W'] sliding windows
+    (zero-padded; callers needing -inf padding pre-pad and pass 0)."""
+    n, c, _, _ = jnp.shape(x)
+    kh, kw = ksize
+    pads = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    if len(paddings) == 4:  # (top, left, bottom, right)
+        pads = [(paddings[0], paddings[2]), (paddings[1], paddings[3])]
+    out = lax.conv_general_dilated_patches(
+        x.astype(jnp.float32),
+        filter_shape=(kh, kw), window_strides=tuple(strides),
+        padding=pads, rhs_dilation=tuple(dilations),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # feature dim of patches is C-major then (kh, kw)
+    hp, wp = jnp.shape(out)[2], jnp.shape(out)[3]
+    return jnp.reshape(out, (n, c, kh * kw, hp, wp))
+
+
+@simple_op("unfold", ["X"], ["Y"])
+def _unfold(ctx, x, attrs):
+    """im2col: [N, C, H, W] → [N, C*kh*kw, L] (unfold_op.cc)."""
+    ksize = [int(k) for k in attrs["kernel_sizes"]]
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    dilations = [int(d) for d in attrs.get("dilations", [1, 1])]
+    n, c = jnp.shape(x)[0], jnp.shape(x)[1]
+    p = _patches(x.astype(jnp.float32), ksize, strides, paddings,
+                 dilations).astype(x.dtype)
+    return jnp.reshape(p, (n, c * ksize[0] * ksize[1], -1))
+
+
+def _pool_with_index(x, ksize, strides, paddings):
+    """(max-pooled values, flat HxW argmax indices) per window."""
+    h, w = jnp.shape(x)[2], jnp.shape(x)[3]
+    neg = jnp.finfo(jnp.float32).min
+    padded = jnp.pad(x.astype(jnp.float32),
+                     [(0, 0), (0, 0), (paddings[0],) * 2,
+                      (paddings[1],) * 2], constant_values=neg)
+    idx_map = (jnp.arange(h)[:, None] * w
+               + jnp.arange(w)[None, :]).astype(jnp.float32)
+    idx_map = jnp.pad(idx_map[None, None], [(0, 0), (0, 0),
+                                            (paddings[0],) * 2,
+                                            (paddings[1],) * 2])
+    vals = _patches(padded, ksize, strides, [0, 0], [1, 1])
+    idxs = _patches(idx_map, ksize, strides, [0, 0], [1, 1])
+    arg = jnp.argmax(vals, axis=2)                      # [N, C, H', W']
+    out = jnp.max(vals, axis=2)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(idxs, vals.shape), arg[:, :, None], axis=2
+    )[:, :, 0]
+    return out.astype(x.dtype), mask.astype(jnp.int64)
+
+
+@simple_op("max_pool2d_with_index", ["X"], ["Out", "Mask"],
+           no_grad_inputs=(), grad="auto")
+def _max_pool2d_with_index(ctx, x, attrs):
+    """Max pool that also emits the flat (H*W) argmax per window
+    (pool_with_index_op.cc) — the Mask unpool consumes."""
+    ksize = [int(k) for k in attrs["ksize"]]
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    if attrs.get("global_pooling"):
+        ksize = [int(jnp.shape(x)[2]), int(jnp.shape(x)[3])]
+        paddings = [0, 0]
+    return _pool_with_index(x, ksize, strides, paddings)
+
+
+@simple_op("unpool", ["X", "Indices"], ["Out"], no_grad_inputs=("Indices",))
+def _unpool(ctx, x, indices, attrs):
+    """Max-unpooling: scatter each pooled value back to its argmax
+    position in the unpooled plane (unpool_op.cc)."""
+    ksize = [int(k) for k in attrs.get("ksize", [2, 2])]
+    strides = [int(s) for s in attrs.get("strides", ksize)]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    n, c, hp, wp = [int(d) for d in jnp.shape(x)]
+    # reference unpool_op.cc output size: (in-1)*stride - 2*pad + ksize
+    out_h = (hp - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    out_w = (wp - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    flat_vals = jnp.reshape(x, (n * c, hp * wp))
+    flat_idx = jnp.reshape(indices, (n * c, hp * wp)).astype(jnp.int32)
+    planes = jnp.zeros((n * c, out_h * out_w), x.dtype)
+    planes = planes.at[jnp.arange(n * c)[:, None], flat_idx].set(flat_vals)
+    return jnp.reshape(planes, (n, c, out_h, out_w))
+
+
+@simple_op("spp", ["X"], ["Out"])
+def _spp(ctx, x, attrs):
+    """Spatial pyramid pooling (spp_op.cc): level i pools to a 2^i × 2^i
+    grid (kernel=ceil(dim/bins), stride=floor — the SPP-net recipe),
+    flattened and concatenated."""
+    height = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = [int(d) for d in jnp.shape(x)]
+    outs = []
+    for level in range(height):
+        bins = 2 ** level
+        kh, kw = -(-h // bins), -(-w // bins)  # ceil
+        sh, sw = max(1, h // bins), max(1, w // bins)
+        pad_h = max(0, (bins - 1) * sh + kh - h)
+        pad_w = max(0, (bins - 1) * sw + kw - w)
+        if ptype == "max":
+            init, fn = jnp.finfo(jnp.float32).min, lax.max
+        else:
+            init, fn = 0.0, lax.add
+        xp = jnp.pad(x.astype(jnp.float32),
+                     [(0, 0), (0, 0), (0, pad_h), (0, pad_w)],
+                     constant_values=init)
+        red = lax.reduce_window(xp, init, fn, (1, 1, kh, kw),
+                                (1, 1, sh, sw), "valid")
+        if ptype != "max":
+            red = red / float(kh * kw)
+        outs.append(jnp.reshape(red, (n, -1)))
+    return jnp.concatenate(outs, axis=1).astype(x.dtype)
+
+
+def _register_aliases():
+    """Op types whose lowering is exactly another op's.
+
+    - depthwise_conv2d_transpose (conv_transpose_op.cc): the grouped
+      conv2d_transpose lowering already handles groups == channels.
+    - sync_batch_norm (sync_batch_norm_op.cu): single-device it IS
+      batch_norm; the cross-replica stat psum is applied by the
+      data-parallel runner's sync_batch_norm rewrite, which matches the
+      reference inserting the op only under ParallelExecutor.
+    """
+    from paddle_tpu.fluid import registry as _registry
+
+    for alias, base in (("depthwise_conv2d_transpose", "conv2d_transpose"),
+                        ("sync_batch_norm", "batch_norm")):
+        info = get_op(base)
+        register_op(alias, list(info.input_slots), list(info.output_slots),
+                    info.lower, grad=info.grad,
+                    optional=tuple(info.optional),
+                    no_grad_inputs=tuple(info.no_grad_inputs),
+                    grad_maker=info.grad_maker, inplace=info.inplace)
+        # imported training programs carry the serialized grad op TYPE too
+        if f"{base}_grad" in _registry.all_ops():
+            ginfo = get_op(f"{base}_grad")
+            register_op(f"{alias}_grad", list(ginfo.input_slots),
+                        list(ginfo.output_slots), ginfo.lower,
+                        grad=None, optional=tuple(ginfo.optional),
+                        no_grad_inputs=tuple(ginfo.no_grad_inputs),
+                        inplace=ginfo.inplace)
+
+
+_register_aliases()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint save/load as host ops (save_op.cc, load_op.cc,
+# save_combine_op.cc, load_combine_op.cc) — reference-exported checkpoint
+# programs run as-is, writing/reading the reference LoDTensor stream
+# ---------------------------------------------------------------------------
+
+
+def _save_run(scope, op, place):
+    import os
+
+    from paddle_tpu.fluid import proto_compat
+
+    path = op.attr("file_path")
+    overwrite = op.attrs.get("overwrite", True)  # reference default: true
+    if os.path.exists(path) and not overwrite:
+        raise RuntimeError(f"save: {path!r} exists and overwrite=False")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    names = op.input("X")
+    with open(path, "wb") as f:
+        for name in names:
+            value = scope.get(name)
+            if value is None:
+                raise RuntimeError(f"save: variable {name!r} not in scope")
+            proto_compat.serialize_lod_tensor(f, np.asarray(value))
+
+
+def _load_run(scope, op, place):
+    from paddle_tpu.fluid import proto_compat
+
+    path = op.attr("file_path")
+    names = op.output("Out")
+    with open(path, "rb") as f:
+        for name in names:
+            arr, _lod = proto_compat.deserialize_lod_tensor(f)
+            # set() creates the entry; scope may be a _FeedScopeView which
+            # only exposes get/set
+            scope.set(name, arr)
+
+
+def _save_combine_run(scope, op, place):
+    _save_run(scope, op, place)  # same stream, many inputs
+
+
+def _load_combine_run(scope, op, place):
+    _load_run(scope, op, place)
+
+
+# loads run PRE-step: they produce variables the jitted ops consume
+# (registry host_stage doc); saves run post-step on the final values
+register_op("save", ["X*"], [], lambda *a: None, grad=None,
+            host_run=_save_run)
+register_op("load", [], ["Out*"], lambda *a: None, grad=None,
+            host_run=_load_run, host_stage="pre")
+register_op("save_combine", ["X*"], [], lambda *a: None, grad=None,
+            host_run=_save_combine_run)
+register_op("load_combine", [], ["Out*"], lambda *a: None, grad=None,
+            host_run=_load_combine_run, host_stage="pre")

@@ -1,0 +1,277 @@
+"""Interop op batch (ops/compat_ops.py): reference op types that appear
+in exported programs, each checked against its reference semantics
+(paddle/fluid/operators/{minus,l1_norm,squared_l2_distance,
+modified_huber_loss,cos_sim,fill,conv_shift,unfold,pool_with_index,
+unpool,spp,save,load}_op)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.fluid.registry import get_op
+from paddle_tpu.ops import compat_ops  # noqa: F401 — ensures registration
+
+
+class _Ctx:
+    step = 0
+    is_test = False
+    mesh_axes = ()
+    program = None
+
+
+def _lower(op_type, *args, **attrs):
+    out = get_op(op_type).lower(_Ctx(), *args, attrs)
+    return out
+
+
+def test_minus_l1_norm():
+    x = np.array([[1.0, -2.0], [3.0, 4.0]], np.float32)
+    y = np.array([[0.5, 0.5], [1.0, 1.0]], np.float32)
+    np.testing.assert_allclose(_lower("minus", x, y), x - y)
+    np.testing.assert_allclose(float(_lower("l1_norm", x)), 10.0)
+
+
+def test_squared_l2_distance_broadcast_row():
+    x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randn(1, 3).astype(np.float32)
+    sub, out = _lower("squared_l2_distance", x, y)
+    assert sub.shape == (4, 3)
+    np.testing.assert_allclose(
+        np.asarray(out)[:, 0], ((x - y) ** 2).sum(axis=1), rtol=1e-6)
+
+
+def test_modified_huber_loss_three_branches():
+    x = np.array([2.0, 0.5, -3.0], np.float32)   # z = 2, 0.5, -3
+    y = np.array([1.0, 1.0, 1.0], np.float32)
+    z, loss = _lower("modified_huber_loss", x, y)
+    np.testing.assert_allclose(np.asarray(z), [2.0, 0.5, -3.0])
+    np.testing.assert_allclose(np.asarray(loss), [0.0, 0.25, 12.0])
+    # label 0 flips the margin
+    z0, loss0 = _lower("modified_huber_loss",
+                       np.array([2.0], np.float32),
+                       np.array([0.0], np.float32))
+    np.testing.assert_allclose(np.asarray(z0), [-2.0])
+    np.testing.assert_allclose(np.asarray(loss0), [8.0])
+
+
+def test_cos_sim_matches_numpy():
+    rng = np.random.RandomState(2)
+    x = rng.randn(5, 7).astype(np.float32)
+    y = rng.randn(5, 7).astype(np.float32)
+    out, xn, yn = _lower("cos_sim", x, y)
+    want = (x * y).sum(1) / (np.linalg.norm(x, axis=1)
+                             * np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(np.asarray(out)[:, 0], want, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(xn)[:, 0],
+                               np.linalg.norm(x, axis=1), rtol=1e-5)
+
+
+def test_fill_and_zeros_like2():
+    out = _lower("fill", value=[1.0, 2.0, 3.0, 4.0], shape=[2, 2],
+                 dtype="int64")
+    # int64 narrows to int32 on device (jax x64-disabled convention,
+    # same as every integer op in the framework)
+    assert str(out.dtype) in ("int64", "int32")
+    np.testing.assert_array_equal(np.asarray(out), [[1, 2], [3, 4]])
+    z = _lower("fill_zeros_like2", np.ones((2, 3), np.float32),
+               dtype="float64")
+    assert np.asarray(z).sum() == 0 and z.shape == (2, 3)
+
+
+def test_sampling_id_respects_distribution():
+    probs = np.tile(np.array([[0.0, 0.0, 1.0, 0.0]], np.float32), (16, 1))
+    ids = np.asarray(_lower("sampling_id", probs))
+    np.testing.assert_array_equal(ids, np.full(16, 2))
+
+
+def test_lod_reset_passthrough():
+    x = np.random.RandomState(3).randn(3, 4).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(_lower("lod_reset", x, None)),
+                                  x)
+
+
+def test_conv_shift_matches_reference_loop():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 6).astype(np.float32)
+    y = rng.randn(2, 3).astype(np.float32)
+    out = np.asarray(_lower("conv_shift", x, y))
+    b, m = x.shape
+    n = y.shape[1]
+    half = (n - 1) // 2
+    want = np.zeros_like(x)
+    for k in range(b):  # conv_shift_op.cc:128-134, verbatim index math
+        for i in range(m):
+            for j in range(n):
+                want[k, i] += x[k, (i + j - half + m) % m] * y[k, j]
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_unfold_matches_manual_im2col():
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 2, 4, 5).astype(np.float32)
+    out = np.asarray(_lower("unfold", x, kernel_sizes=[2, 3],
+                            strides=[1, 1], paddings=[0, 0],
+                            dilations=[1, 1]))
+    # manual im2col: L = 3*3 output positions, feature = C*kh*kw C-major
+    cols = []
+    for oy in range(3):
+        for ox in range(3):
+            patch = x[0, :, oy:oy + 2, ox:ox + 3]  # [C, kh, kw]
+            cols.append(patch.reshape(-1))
+    want = np.stack(cols, axis=1)[None]  # [1, C*kh*kw, L]
+    assert out.shape == want.shape
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_unfold_layer_runs_in_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", [-1, 2, 4, 4], False, dtype="float32")
+        y = fluid.layers.unfold(x, kernel_sizes=2)
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": np.ones((1, 2, 4, 4), "float32")},
+                       fetch_list=[y])
+    assert np.asarray(out).shape == (1, 8, 9)
+
+
+def test_max_pool_with_index_and_unpool_roundtrip():
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    out, mask = _lower("max_pool2d_with_index", x, ksize=[2, 2],
+                       strides=[2, 2], paddings=[0, 0])
+    assert out.shape == (2, 3, 2, 2) and mask.shape == (2, 3, 2, 2)
+    np.testing.assert_allclose(np.asarray(out),
+                               x.reshape(2, 3, 2, 2, 2, 2)
+                               .max(axis=(3, 5)), rtol=1e-6)
+    # indices are flat positions in the 4x4 plane whose value == max
+    flat = x.reshape(2, 3, 16)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, np.asarray(mask).reshape(2, 3, 4),
+                           axis=2).reshape(2, 3, 2, 2),
+        np.asarray(out), rtol=1e-6)
+    # unpool scatters back: every pooled value lands at its argmax spot
+    restored = np.asarray(_lower("unpool", np.asarray(out),
+                                 np.asarray(mask), ksize=[2, 2],
+                                 strides=[2, 2]))
+    assert restored.shape == x.shape
+    np.testing.assert_allclose(restored.sum(), np.asarray(out).sum(),
+                               rtol=1e-5)
+
+
+def test_spp_shapes_and_values():
+    x = np.arange(2 * 1 * 4 * 4, dtype=np.float32).reshape(2, 1, 4, 4)
+    out = np.asarray(_lower("spp", x, pyramid_height=2,
+                            pooling_type="max"))
+    # level 0: 1 bin, level 1: 4 bins → C*(1+4) features
+    assert out.shape == (2, 5)
+    np.testing.assert_allclose(out[0, 0], 15.0)  # global max of plane 0
+    avg = np.asarray(_lower("spp", x, pyramid_height=1,
+                            pooling_type="avg"))
+    np.testing.assert_allclose(avg[0, 0], x[0].mean(), rtol=1e-6)
+
+
+def test_depthwise_conv2d_transpose_alias():
+    info = get_op("depthwise_conv2d_transpose")
+    assert info.lower is get_op("conv2d_transpose").lower
+    assert get_op("sync_batch_norm").lower is get_op("batch_norm").lower
+
+
+def test_save_load_ops_roundtrip(tmp_path):
+    """A program containing reference save/load ops runs as-is and the
+    stream round-trips through the reference LoDTensor format."""
+    path = str(tmp_path / "ckpt" / "w.save")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        w = fluid.layers.create_parameter(shape=[3, 2], dtype="float32",
+                                          name="w_saved")
+        main.global_block().append_op(
+            "save", inputs={"X": [w]}, outputs={},
+            attrs={"file_path": path})
+    load_prog = fluid.Program()
+    with fluid.program_guard(load_prog, fluid.Program()), \
+            fluid.unique_name.guard():
+        out_var = load_prog.global_block().create_var(
+            name="w_loaded", shape=[3, 2], dtype="float32",
+            persistable=True)
+        load_prog.global_block().append_op(
+            "load", inputs={}, outputs={"Out": [out_var]},
+            attrs={"file_path": path})
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={}, fetch_list=[])
+        want = np.asarray(fluid.global_scope().get("w_saved"))
+        exe.run(load_prog, feed={}, fetch_list=[])
+        got = np.asarray(fluid.global_scope().get("w_loaded"))
+    np.testing.assert_allclose(got, want)
+
+
+def test_load_feeds_compute_op_same_program(tmp_path):
+    """load runs PRE-step: a jitted op can consume the loaded variable in
+    the same program, and a non-empty feed dict (the _FeedScopeView path)
+    must not break the host op."""
+    path = str(tmp_path / "w.bin")
+    from paddle_tpu.fluid import proto_compat
+
+    w0 = np.arange(6, dtype=np.float32).reshape(3, 2)
+    with open(path, "wb") as f:
+        proto_compat.serialize_lod_tensor(f, w0)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", [-1, 3], False, dtype="float32")
+        wv = main.global_block().create_var(
+            name="w_pre", shape=[3, 2], dtype="float32", persistable=True)
+        main.global_block().append_op(
+            "load", inputs={}, outputs={"Out": [wv]},
+            attrs={"file_path": path})
+        out = fluid.layers.mul(x, wv)
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": np.ones((2, 3), "float32")},
+                       fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), np.ones((2, 3)) @ w0)
+
+
+def test_unpool_respects_padding():
+    """Reference unpool_op.cc: out = (in-1)*stride - 2*pad + ksize."""
+    x = np.ones((1, 1, 3, 3), np.float32)
+    idx = np.zeros((1, 1, 3, 3), np.int64)
+    out = _lower("unpool", x, idx, ksize=[3, 3], strides=[2, 2],
+                 paddings=[1, 1])
+    assert out.shape == (1, 1, 5, 5)
+
+
+def test_sampling_id_fallback_last_index():
+    """Draw above the row's cumulative sum keeps the reference kernel's
+    width-1 fallback, not index 0."""
+    probs = np.tile(np.array([[0.2, 0.2, 0.1]], np.float32), (8, 1))
+    ids = np.asarray(_lower("sampling_id", probs, min=0.9, max=0.999))
+    np.testing.assert_array_equal(ids, np.full(8, 2))
+
+
+def test_alias_grad_op_types_registered():
+    """Imported training programs carry the serialized *_grad op types."""
+    from paddle_tpu.fluid import registry
+    assert "sync_batch_norm_grad" in registry.all_ops()
+
+
+def test_save_overwrite_false_raises(tmp_path):
+    path = str(tmp_path / "once.save")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        w = fluid.layers.create_parameter(shape=[2], dtype="float32",
+                                          name="w_once")
+        main.global_block().append_op(
+            "save", inputs={"X": [w]}, outputs={},
+            attrs={"file_path": path, "overwrite": False})
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={}, fetch_list=[])
+        with pytest.raises(RuntimeError, match="overwrite"):
+            exe.run(main, feed={}, fetch_list=[])

@@ -160,40 +160,100 @@ def _unfold(ctx, x, attrs):
     return jnp.reshape(p, (n, c * ksize[0] * ksize[1], -1))
 
 
+_POOL_DIMNUMS = {2: ("NCHW", "OIHW", "NCHW"),
+                 3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
 def _pool_with_index(x, ksize, strides, paddings):
-    """(max-pooled values, flat HxW argmax indices) per window."""
-    h, w = jnp.shape(x)[2], jnp.shape(x)[3]
+    """(max-pooled values, flat argmax indices) per window, any spatial
+    rank.  Indices stay INTEGER throughout: the window-local argmax is
+    unraveled and combined with the window origin arithmetically (a
+    float index map would corrupt planes beyond 2^24 elements)."""
+    nd = len(ksize)
+    spatial = [int(d) for d in jnp.shape(x)[2:]]
     neg = jnp.finfo(jnp.float32).min
     padded = jnp.pad(x.astype(jnp.float32),
-                     [(0, 0), (0, 0), (paddings[0],) * 2,
-                      (paddings[1],) * 2], constant_values=neg)
-    idx_map = (jnp.arange(h)[:, None] * w
-               + jnp.arange(w)[None, :]).astype(jnp.float32)
-    idx_map = jnp.pad(idx_map[None, None], [(0, 0), (0, 0),
-                                            (paddings[0],) * 2,
-                                            (paddings[1],) * 2])
-    vals = _patches(padded, ksize, strides, [0, 0], [1, 1])
-    idxs = _patches(idx_map, ksize, strides, [0, 0], [1, 1])
-    arg = jnp.argmax(vals, axis=2)                      # [N, C, H', W']
+                     [(0, 0), (0, 0)] + [(p, p) for p in paddings],
+                     constant_values=neg)
+    win = lax.conv_general_dilated_patches(
+        padded, filter_shape=tuple(ksize), window_strides=tuple(strides),
+        padding=[(0, 0)] * nd, dimension_numbers=_POOL_DIMNUMS[nd])
+    n, c = int(jnp.shape(x)[0]), int(jnp.shape(x)[1])
+    out_sp = [int(d) for d in jnp.shape(win)[2:]]
+    vals = jnp.reshape(win, (n, c, int(np.prod(ksize)), *out_sp))
+    arg = jnp.argmax(vals, axis=2)          # [N, C, *out'] flat-in-window
     out = jnp.max(vals, axis=2)
-    mask = jnp.take_along_axis(
-        jnp.broadcast_to(idxs, vals.shape), arg[:, :, None], axis=2
-    )[:, :, 0]
-    return out.astype(x.dtype), mask.astype(jnp.int64)
+    # absolute flat index = Σ_i (origin_i + offset_i) * plane_stride_i
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in out_sp], indexing="ij")
+    rem = arg
+    offsets = []
+    for i in reversed(range(nd)):
+        offsets.insert(0, rem % ksize[i])
+        rem = rem // ksize[i]
+    flat = jnp.zeros_like(arg)
+    for i in range(nd):
+        coord = grids[i] * strides[i] - paddings[i] + offsets[i]
+        coord = jnp.clip(coord, 0, spatial[i] - 1)  # all-pad window guard
+        flat = flat * spatial[i] + coord
+    return out.astype(x.dtype), flat.astype(jnp.int64)
+
+
+def _pool_index_grad_maker(op, out_grads, wanted, uniq):
+    """Route Out@GRAD only: the integer Mask output carries no gradient
+    (an auto-vjp would feed it an integer cotangent and crash)."""
+    x = op.inputs["X"][0]
+    if x not in wanted or op.outputs["Out"][0] not in out_grads:
+        return [], []
+    g = uniq(x)
+    ins = {"X": list(op.inputs["X"]),
+           "Mask": list(op.outputs["Mask"]),
+           "Out@GRAD": [out_grads[op.outputs["Out"][0]]]}
+    return ([(f"{op.type}_grad", ins, {"X@GRAD": [g]}, dict(op.attrs))],
+            [(x, g)])
+
+
+def _pool_index_attrs(x, attrs, nd):
+    ksize = [int(k) for k in attrs["ksize"]]
+    strides = [int(s) for s in attrs.get("strides", [1] * nd)]
+    paddings = [int(p) for p in attrs.get("paddings", [0] * nd)]
+    if attrs.get("global_pooling"):
+        ksize = [int(d) for d in jnp.shape(x)[2:]]
+        paddings = [0] * nd
+    return ksize, strides, paddings
 
 
 @simple_op("max_pool2d_with_index", ["X"], ["Out", "Mask"],
-           no_grad_inputs=(), grad="auto")
+           grad="custom", grad_maker=_pool_index_grad_maker)
 def _max_pool2d_with_index(ctx, x, attrs):
     """Max pool that also emits the flat (H*W) argmax per window
     (pool_with_index_op.cc) — the Mask unpool consumes."""
-    ksize = [int(k) for k in attrs["ksize"]]
-    strides = [int(s) for s in attrs.get("strides", [1, 1])]
-    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
-    if attrs.get("global_pooling"):
-        ksize = [int(jnp.shape(x)[2]), int(jnp.shape(x)[3])]
-        paddings = [0, 0]
-    return _pool_with_index(x, ksize, strides, paddings)
+    return _pool_with_index(x, *_pool_index_attrs(x, attrs, 2))
+
+
+@simple_op("max_pool3d_with_index", ["X"], ["Out", "Mask"],
+           grad="custom", grad_maker=_pool_index_grad_maker)
+def _max_pool3d_with_index(ctx, x, attrs):
+    """3D twin: Mask is the flat D*H*W argmax per window."""
+    return _pool_with_index(x, *_pool_index_attrs(x, attrs, 3))
+
+
+def _pool_index_grad(ctx, x, mask, dy, attrs):
+    """dX = scatter-add of dOut at the saved argmax positions (ties in
+    overlapping windows accumulate, matching the reference kernel)."""
+    n, c = int(jnp.shape(x)[0]), int(jnp.shape(x)[1])
+    plane = int(np.prod(jnp.shape(x)[2:]))
+    k = int(np.prod(jnp.shape(dy)[2:]))
+    flat_idx = jnp.reshape(mask, (n * c, k)).astype(jnp.int32)
+    flat_dy = jnp.reshape(dy, (n * c, k))
+    planes = jnp.zeros((n * c, plane), dy.dtype)
+    planes = planes.at[jnp.arange(n * c)[:, None], flat_idx].add(flat_dy)
+    return jnp.reshape(planes, jnp.shape(x)).astype(x.dtype)
+
+
+register_op("max_pool2d_with_index_grad", ["X", "Mask", "Out@GRAD"],
+            ["X@GRAD"], _pool_index_grad, grad=None)
+register_op("max_pool3d_with_index_grad", ["X", "Mask", "Out@GRAD"],
+            ["X@GRAD"], _pool_index_grad, grad=None)
 
 
 @simple_op("unpool", ["X", "Indices"], ["Out"], no_grad_inputs=("Indices",))
@@ -216,9 +276,10 @@ def _unpool(ctx, x, indices, attrs):
 
 @simple_op("spp", ["X"], ["Out"])
 def _spp(ctx, x, attrs):
-    """Spatial pyramid pooling (spp_op.cc): level i pools to a 2^i × 2^i
-    grid (kernel=ceil(dim/bins), stride=floor — the SPP-net recipe),
-    flattened and concatenated."""
+    """Spatial pyramid pooling (spp_op.h:39-46): level p pools to a
+    2^p × 2^p grid with kernel=ceil(dim/bins), stride=KERNEL, symmetric
+    padding (k*bins - dim + 1)/2; avg pooling is exclusive (divides by
+    the count of non-pad elements), flattened and concatenated."""
     height = int(attrs.get("pyramid_height", 1))
     ptype = attrs.get("pooling_type", "max")
     n, c, h, w = [int(d) for d in jnp.shape(x)]
@@ -226,20 +287,23 @@ def _spp(ctx, x, attrs):
     for level in range(height):
         bins = 2 ** level
         kh, kw = -(-h // bins), -(-w // bins)  # ceil
-        sh, sw = max(1, h // bins), max(1, w // bins)
-        pad_h = max(0, (bins - 1) * sh + kh - h)
-        pad_w = max(0, (bins - 1) * sw + kw - w)
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        window, strides = (1, 1, kh, kw), (1, 1, kh, kw)
+        pads = [(0, 0), (0, 0), (ph, ph), (pw, pw)]
+        xf = x.astype(jnp.float32)
         if ptype == "max":
-            init, fn = jnp.finfo(jnp.float32).min, lax.max
-        else:
-            init, fn = 0.0, lax.add
-        xp = jnp.pad(x.astype(jnp.float32),
-                     [(0, 0), (0, 0), (0, pad_h), (0, pad_w)],
-                     constant_values=init)
-        red = lax.reduce_window(xp, init, fn, (1, 1, kh, kw),
-                                (1, 1, sh, sw), "valid")
-        if ptype != "max":
-            red = red / float(kh * kw)
+            neg = jnp.finfo(jnp.float32).min
+            red = lax.reduce_window(jnp.pad(xf, pads, constant_values=neg),
+                                    neg, lax.max, window, strides, "valid")
+        else:  # exclusive average: sum / count of valid elements
+            summed = lax.reduce_window(jnp.pad(xf, pads), 0.0, lax.add,
+                                       window, strides, "valid")
+            counts = lax.reduce_window(
+                jnp.pad(jnp.ones_like(xf), pads), 0.0, lax.add,
+                window, strides, "valid")
+            red = summed / jnp.maximum(counts, 1.0)
+        red = red[:, :, :bins, :bins]  # exact bins x bins grid
         outs.append(jnp.reshape(red, (n, -1)))
     return jnp.concatenate(outs, axis=1).astype(x.dtype)
 
@@ -275,6 +339,114 @@ def _register_aliases():
 
 
 _register_aliases()
+
+
+# ---------------------------------------------------------------------------
+# ModelAverage accumulation op (average_accumulates_op.h:82-105): windowed
+# parameter sums with the 16384-update precision spill and the
+# average-window flush, counters as [1] int64 state
+# ---------------------------------------------------------------------------
+
+
+@simple_op(
+    "average_accumulates",
+    ["param", "in_sum_1", "in_sum_2", "in_sum_3", "in_num_accumulates",
+     "in_old_num_accumulates", "in_num_updates"],
+    ["out_sum_1", "out_sum_2", "out_sum_3", "out_num_accumulates",
+     "out_old_num_accumulates", "out_num_updates"],
+    grad=None,
+    inplace={"out_sum_1": "in_sum_1", "out_sum_2": "in_sum_2",
+             "out_sum_3": "in_sum_3",
+             "out_num_accumulates": "in_num_accumulates",
+             "out_old_num_accumulates": "in_old_num_accumulates",
+             "out_num_updates": "in_num_updates"},
+)
+def _average_accumulates(ctx, param, s1, s2, s3, na, old_na, nu, attrs):
+    window = attrs.get("average_window", 0.0)
+    max_w = int(attrs.get("max_average_window", np.iinfo(np.int32).max))
+    min_w = int(attrs.get("min_average_window", 10000))
+    nu = nu + 1
+    na = na + 1
+    s1 = s1 + param
+    spill = (nu % 16384) == 0  # precision spill (kMaxNumAccumulates)
+    s2 = jnp.where(spill, s2 + s1, s2)
+    s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
+    win = jnp.minimum(jnp.asarray(max_w, nu.dtype),
+                      (nu.astype(jnp.float32) * window).astype(nu.dtype))
+    flush = (na >= min_w) & (na >= win)
+    s3 = jnp.where(flush, s1 + s2, s3)
+    s1 = jnp.where(flush, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(flush, jnp.zeros_like(s2), s2)
+    old_na = jnp.where(flush, na, old_na)
+    na = jnp.where(flush, jnp.zeros_like(na), na)
+    return s1, s2, s3, na, old_na, nu
+
+
+# ---------------------------------------------------------------------------
+# quantization interop (fake_dequantize_op.cc ChannelDequantizeFunctor,
+# fake_quantize_op.cc quantize-dequantize variant)
+# ---------------------------------------------------------------------------
+
+
+@simple_op("fake_channel_wise_dequantize_max_abs", ["X", "Scales*"],
+           ["Out"], grad=None)
+def _fake_channel_wise_dequantize_max_abs(ctx, x, scales, attrs):
+    """scale_num=1: weight dequant, per dim-0 channel s/max_range;
+    scale_num=2: activation dequant, s1[dim-1 channel] * s2[0] / max_range
+    with max_range the product of per-stage (2^(b-1)-1)
+    (fake_dequantize_op.cc:37-72)."""
+    bits = [int(b) for b in attrs.get("quant_bits", [8])]
+    ranges = [float(2 ** (b - 1) - 1) for b in bits]
+    xf = x.astype(jnp.float32)
+    if len(scales) == 1:
+        s = scales[0].astype(jnp.float32)
+        shape = (-1,) + (1,) * (x.ndim - 1)  # dim-0 channels
+        out = xf * jnp.reshape(s, shape) / ranges[0]
+    elif len(scales) == 2:
+        s1 = scales[0].astype(jnp.float32)
+        s2 = jnp.reshape(scales[1], ()).astype(jnp.float32)
+        shape = (1, -1) + (1,) * (x.ndim - 2)  # dim-1 channels
+        out = xf * jnp.reshape(s1, shape) * s2 / (ranges[0] * ranges[1])
+    else:
+        raise NotImplementedError(
+            f"channel-wise dequantize expects 1 or 2 scales, "
+            f"got {len(scales)}")
+    return out.astype(x.dtype)
+
+
+@simple_op("fake_quantize_dequantize_moving_average_abs_max",
+           ["X", "InScale", "InAccum", "InState"],
+           ["Out", "OutScale", "OutAccum", "OutState"],
+           optional=("InAccum", "InState"),
+           no_grad_inputs=("InScale", "InAccum", "InState"),
+           inplace={"OutAccum": "InAccum", "OutState": "InState"})
+def _fake_qdq_moving_average_abs_max(ctx, x, in_scale, accum, state, attrs):
+    """Moving-average abs-max scale + quantize-dequantize round trip with
+    a straight-through gradient (fake_quantize_op.cc QDQ variant): the
+    rounding is wrapped as x + stop_grad(qdq(x) - x) so autodiff sees
+    identity — the STE the reference implements with a pass-through grad
+    kernel."""
+    bits = int(attrs.get("bit_length", 8))
+    bound = float(2 ** (bits - 1) - 1)
+    rate = attrs.get("moving_rate", 0.9)
+    a = (jnp.reshape(accum, ()).astype(jnp.float32)
+         if accum is not None else jnp.asarray(0.0, jnp.float32))
+    s = (jnp.reshape(state, ()).astype(jnp.float32)
+         if state is not None else jnp.asarray(0.0, jnp.float32))
+    if ctx.is_test or bool(attrs.get("is_test", False)):
+        scale = jnp.reshape(in_scale, ()).astype(jnp.float32)
+    else:
+        batch_max = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        a = rate * a + batch_max
+        s = rate * s + 1.0
+        scale = a / jnp.maximum(s, 1e-9)
+    scale = jnp.maximum(scale, 1e-9)
+    xf = x.astype(jnp.float32)
+    clipped = jnp.clip(xf, -scale, scale)
+    qdq = jnp.round(clipped / scale * bound) / bound * scale
+    out = xf + lax.stop_gradient(qdq - xf)  # STE
+    return (out.astype(x.dtype), scale.reshape((1,)),
+            a.reshape((1,)), s.reshape((1,)))
 
 
 # ---------------------------------------------------------------------------

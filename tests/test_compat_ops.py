@@ -275,3 +275,128 @@ def test_save_overwrite_false_raises(tmp_path):
         exe.run(main, feed={}, fetch_list=[])
         with pytest.raises(RuntimeError, match="overwrite"):
             exe.run(main, feed={}, fetch_list=[])
+
+
+def test_average_accumulates_window_flush():
+    """ModelAverage accumulation (average_accumulates_op.h:82-105):
+    sums grow by param each call; once num_accumulates reaches the
+    window, sums flush into sum_3 and counters reset."""
+    p = np.full((3,), 2.0, np.float32)
+    s1 = s2 = s3 = np.zeros((3,), np.float32)
+    na = old = nu = np.zeros((1,), np.int64)
+    for step in range(4):
+        s1, s2, s3, na, old, nu = [np.asarray(t) for t in _lower(
+            "average_accumulates", p, s1, s2, s3, na, old, nu,
+            average_window=1.0, max_average_window=4,
+            min_average_window=4)]
+    # step 4 hits min_average_window: flush into s3, reset counters
+    np.testing.assert_allclose(s3, np.full((3,), 8.0))
+    np.testing.assert_allclose(s1, np.zeros(3))
+    assert int(na[0]) == 0 and int(old[0]) == 4 and int(nu[0]) == 4
+
+
+def test_fake_channel_wise_dequantize():
+    x = np.ones((2, 3, 2, 2), np.float32)
+    # one scale: per dim-0 channel
+    s = np.array([127.0, 254.0], np.float32)
+    out = np.asarray(_lower("fake_channel_wise_dequantize_max_abs",
+                            x[:, 0], [s], quant_bits=[8]))
+    np.testing.assert_allclose(out[0], np.ones((2, 2)), rtol=1e-6)
+    np.testing.assert_allclose(out[1], 2 * np.ones((2, 2)), rtol=1e-6)
+    # two scales: dim-1 channels times a global scale
+    s1 = np.array([127.0, 127.0, 254.0], np.float32)
+    s2 = np.array([127.0], np.float32)
+    out2 = np.asarray(_lower("fake_channel_wise_dequantize_max_abs",
+                             x, [s1, s2], quant_bits=[8, 8]))
+    np.testing.assert_allclose(out2[:, 0], np.ones((2, 2, 2)), rtol=1e-6)
+    np.testing.assert_allclose(out2[:, 2], 2 * np.ones((2, 2, 2)),
+                               rtol=1e-6)
+
+
+def test_fake_qdq_moving_average_rounds_and_ste():
+    x = np.array([[0.5, -0.25, 1.0]], np.float32)
+    out, scale, accum, state = _lower(
+        "fake_quantize_dequantize_moving_average_abs_max",
+        x, np.array([1.0], np.float32), None, None,
+        bit_length=8, moving_rate=0.9)
+    # first call: scale = batch abs max = 1.0; values quantize to the
+    # 127-bin grid
+    np.testing.assert_allclose(float(scale[0]), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out)[0],
+                               np.round(x[0] * 127) / 127, rtol=1e-6)
+
+    # STE: gradient of sum(qdq(x)) wrt x is 1 (identity pass-through)
+    import jax
+
+    def f(v):
+        o, *_ = _lower(
+            "fake_quantize_dequantize_moving_average_abs_max",
+            v, np.array([1.0], np.float32), None, None, bit_length=8)
+        return o.sum()
+
+    g = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(g), np.ones_like(x))
+
+
+def test_max_pool3d_with_index():
+    rng = np.random.RandomState(7)
+    x = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
+    out, mask = _lower("max_pool3d_with_index", x, ksize=[2, 2, 2],
+                       strides=[2, 2, 2], paddings=[0, 0, 0])
+    assert out.shape == (1, 2, 2, 2, 2) and mask.shape == out.shape
+    want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+    flat = x.reshape(1, 2, 64)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, np.asarray(mask).reshape(1, 2, 8),
+                           axis=2).reshape(out.shape),
+        np.asarray(out), rtol=1e-6)
+
+
+def test_pool_with_index_trains_through_grad_maker():
+    """The custom grad routes Out@GRAD only (integer Mask carries none):
+    a program training THROUGH max_pool2d_with_index converges."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", [-1, 1, 4, 4], False, dtype="float32")
+        y = fluid.data("y", [-1, 1], False, dtype="float32")
+        blk = main.global_block()
+        conv = fluid.layers.conv2d(x, num_filters=2, filter_size=3,
+                                   padding=1)
+        out_v = blk.create_var(name="pool_o", dtype="float32")
+        mask_v = blk.create_var(name="pool_m", dtype="int64")
+        blk.append_op("max_pool2d_with_index",
+                      inputs={"X": [conv]},
+                      outputs={"Out": [out_v], "Mask": [mask_v]},
+                      attrs={"ksize": [2, 2], "strides": [2, 2],
+                             "paddings": [0, 0]})
+        pred = fluid.layers.fc(out_v, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    rng = np.random.RandomState(9)
+    xb = rng.rand(8, 1, 4, 4).astype("float32")
+    yb = xb.max(axis=(1, 2, 3))[:, None]
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])[0])
+                  for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, losses[::6]
+
+
+def test_spp_reference_recipe_odd_size():
+    """5x5 input, level 1: reference spp_op.h uses kernel=ceil(5/2)=3,
+    stride=3, pad=(3*2-5+1)/2=1 → windows [-1..1],[2..4] per axis."""
+    x = np.zeros((1, 1, 5, 5), np.float32)
+    x[0, 0, 2, 2] = 9.0  # sits in window row [2..4], col [2..4] only
+    out = np.asarray(_lower("spp", x, pyramid_height=2,
+                            pooling_type="max"))
+    assert out.shape == (1, 5)
+    level1 = out[0, 1:].reshape(2, 2)
+    np.testing.assert_allclose(level1, [[0.0, 0.0], [0.0, 9.0]])
+    # exclusive average: corner bin divides by its 4 valid pixels only
+    ones = np.ones((1, 1, 5, 5), np.float32)
+    avg = np.asarray(_lower("spp", ones, pyramid_height=2,
+                            pooling_type="avg"))
+    np.testing.assert_allclose(avg[0, 1:], np.ones(4), rtol=1e-6)

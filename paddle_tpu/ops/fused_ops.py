@@ -18,6 +18,8 @@ plus an optional Length vector (see ops/sequence_ops.py).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -102,11 +104,8 @@ def _fused_embedding_seq_pool(ctx, w, ids, length, attrs):
 def _fusion_seqpool_concat(ctx, xs, lengths, attrs):
     """sequence_pool over each input then concat on axis 1
     (fusion_seqpool_concat_op.cc — pooltype ∈ {SUM, AVERAGE, SQRT})."""
-    ptype = attrs.get("pooltype", "SUM")
-    lengths = list(lengths) if lengths else [None] * len(xs)
-    lengths += [None] * (len(xs) - len(lengths))
-    pooled = [_sequence_pool(ctx, x, ln, {"pooltype": ptype})[0]
-              for x, ln in zip(xs, lengths)]
+    pooled = _pooled_columns(ctx, xs, lengths,
+                             attrs.get("pooltype", "SUM"))
     return jnp.concatenate(pooled, axis=int(attrs.get("axis", 1)))
 
 
@@ -174,3 +173,88 @@ def _fusion_repeated_fc_relu(ctx, x, ws, biases, attrs):
             mxu_dot(h, w) + jnp.reshape(b, (1, -1)).astype(x.dtype))
         relus.append(h)
     return tuple(relus[:-1]), relus[-1]
+
+
+def _pooled_columns(ctx, xs, lengths, ptype, transform=None):
+    """sequence_pool each input (padding the lengths list), applying an
+    optional per-column transform — shared by the seqpool fusions."""
+    lengths = list(lengths) if lengths else [None] * len(xs)
+    lengths += [None] * (len(xs) - len(lengths))
+    cols = []
+    for x, ln in zip(xs, lengths):
+        pooled = _sequence_pool(ctx, x, ln, {"pooltype": ptype})[0]
+        cols.append(transform(pooled) if transform else pooled)
+    return cols
+
+
+@simple_op("fusion_seqpool_cvm_concat", ["X*", "CVM", "Length*"], ["Out"],
+           optional=("Length",), no_grad_inputs=("CVM", "Length"))
+def _fusion_seqpool_cvm_concat(ctx, xs, cvm, lengths, attrs):
+    """sequence_pool each input, CVM-transform each pooled row, concat
+    (fusion_seqpool_cvm_concat_op.cc — the CTR ingest fusion)."""
+    from .detection_extra_ops import _cvm
+
+    use_cvm = bool(attrs.get("use_cvm", True))
+    cols = _pooled_columns(
+        ctx, xs, lengths, attrs.get("pooltype", "SUM"),
+        transform=lambda p: _cvm(ctx, p, cvm, {"use_cvm": use_cvm}))
+    return jnp.concatenate(cols, axis=int(attrs.get("axis", 1)))
+
+
+@simple_op("fusion_seqconv_eltadd_relu", ["X", "Filter", "Bias", "Length"],
+           ["Out", "ColMat"], optional=("Length",),
+           no_grad_inputs=("Length",))
+def _fusion_seqconv_eltadd_relu(ctx, x, w, bias, length, attrs):
+    """sequence_conv + bias + relu (fusion_seqconv_eltadd_relu_op.cc);
+    ColMat is the unfolded im2col intermediate the reference exposes."""
+    from .sequence_ops import _sequence_conv
+
+    # pass attrs straight through: _sequence_conv reads the same keys and
+    # owns the centered-window contextStart default — a local default here
+    # would diverge from the unfused composition
+    conv = _sequence_conv(ctx, x, w, length, attrs)
+    out = jax.nn.relu(conv + jnp.reshape(bias, (1, 1, -1)))
+    b, t, _ = jnp.shape(x)
+    col = jnp.zeros((b, t, jnp.shape(w)[0]), x.dtype)  # interop shape stub
+    return out, col
+
+
+@simple_op("fusion_seqexpand_concat_fc", ["X*", "FCWeight", "FCBias"],
+           ["Out", "FCOut"], optional=("FCBias",))
+def _fusion_seqexpand_concat_fc(ctx, xs, w, bias, attrs):
+    """X[0]: [B, T, D0] sequence; X[1:]: [B, Di] per-batch rows expanded
+    over T; concat features, then fc + activation
+    (fusion_seqexpand_concat_fc_op.cc)."""
+    ref = xs[0]
+    b, t = jnp.shape(ref)[0], jnp.shape(ref)[1]
+    feats = [ref] + [jnp.broadcast_to(z[:, None, :],
+                                      (b, t, jnp.shape(z)[-1]))
+                     for z in xs[1:]]
+    cat = jnp.concatenate(feats, axis=-1)
+    out = mxu_dot(cat, w)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, 1, -1))
+    from .common import act_attr
+    from .rnn_ops import _act
+
+    try:
+        out = _act(act_attr(attrs.get("fc_activation") or None,
+                            "identity"))(out)  # "" == identity
+    except KeyError as e:
+        raise NotImplementedError(f"fc_activation {e.args[0]!r}") from e
+    return out, out
+
+
+@simple_op("fusion_transpose_flatten_concat", ["X*"], ["Out"])
+def _fusion_transpose_flatten_concat(ctx, xs, attrs):
+    """transpose(trans_axis) → flatten from flatten_axis (2D) → concat on
+    concat_axis (fusion_transpose_flatten_concat_op.cc)."""
+    trans = [int(a) for a in attrs.get("trans_axis", [])]
+    flat_axis = int(attrs.get("flatten_axis", 1))
+    concat_axis = int(attrs.get("concat_axis", 1))
+    outs = []
+    for x in xs:
+        t = jnp.transpose(x, trans) if trans else x
+        lead = math.prod(jnp.shape(t)[:flat_axis]) if flat_axis else 1
+        outs.append(jnp.reshape(t, (lead, -1)))
+    return jnp.concatenate(outs, axis=concat_axis)

@@ -366,3 +366,94 @@ def test_fusion_lstm_xx_includes_bias():
 
     xx, = _run_ops(build, {"x": x, "wx": wx, "wh": wh, "bias": bias}, ["xx"])
     np.testing.assert_allclose(xx, x @ wx + bias, rtol=1e-5, atol=1e-6)
+
+
+def test_fusion_seqpool_cvm_concat():
+    """Pool → CVM → concat matches the unfused composition
+    (fusion_seqpool_cvm_concat_op.cc)."""
+    from paddle_tpu.fluid.registry import get_op
+
+    class Ctx:
+        step = 0
+        is_test = False
+        mesh_axes = ()
+
+    rng = np.random.RandomState(0)
+    xs = [np.abs(rng.rand(2, 4, 5)).astype("float32") for _ in range(2)]
+    cvm = np.ones((2, 2), np.float32)
+    out = np.asarray(get_op("fusion_seqpool_cvm_concat").lower(
+        Ctx(), xs, cvm, [], {"pooltype": "SUM", "use_cvm": True}))
+    # each pooled column: log-transformed show/click + rest
+    pooled0 = xs[0].sum(axis=1)
+    show = np.log(pooled0[:, 0:1] + 1)
+    click = np.log(pooled0[:, 1:2] + 1) - show
+    want0 = np.concatenate([show, click, pooled0[:, 2:]], axis=1)
+    np.testing.assert_allclose(out[:, :5], want0, rtol=1e-5)
+    assert out.shape == (2, 10)
+    # use_cvm=False strips the two counter columns
+    out2 = np.asarray(get_op("fusion_seqpool_cvm_concat").lower(
+        Ctx(), xs, cvm, [], {"pooltype": "SUM", "use_cvm": False}))
+    assert out2.shape == (2, 6)
+
+
+def test_fusion_seqconv_eltadd_relu_matches_unfused():
+    from paddle_tpu.fluid.registry import get_op
+
+    class Ctx:
+        step = 0
+        is_test = False
+        mesh_axes = ()
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 5, 3).astype("float32")
+    w = rng.randn(9, 4).astype("float32")  # ctx_len 3 * D 3 → 4 filters
+    b = rng.randn(4).astype("float32")
+    out, col = get_op("fusion_seqconv_eltadd_relu").lower(
+        Ctx(), x, w, b, None,
+        {"contextLength": 3, "contextStart": -1})
+    ref = get_op("sequence_conv").lower(
+        Ctx(), x, w, None, {"contextLength": 3, "contextStart": -1})
+    np.testing.assert_allclose(np.asarray(out),
+                               np.maximum(np.asarray(ref) + b, 0),
+                               rtol=1e-5)
+
+
+def test_fusion_seqexpand_concat_fc():
+    from paddle_tpu.fluid.registry import get_op
+
+    class Ctx:
+        step = 0
+        is_test = False
+        mesh_axes = ()
+
+    rng = np.random.RandomState(2)
+    seq = rng.randn(2, 3, 4).astype("float32")
+    row = rng.randn(2, 2).astype("float32")
+    w = rng.randn(6, 5).astype("float32")
+    bias = rng.randn(5).astype("float32")
+    out, fc_out = get_op("fusion_seqexpand_concat_fc").lower(
+        Ctx(), [seq, row], w, bias, {"fc_activation": "relu"})
+    cat = np.concatenate([seq, np.repeat(row[:, None], 3, axis=1)],
+                         axis=-1)
+    want = np.maximum(cat @ w + bias, 0)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4)
+
+
+def test_fusion_transpose_flatten_concat():
+    from paddle_tpu.fluid.registry import get_op
+
+    class Ctx:
+        step = 0
+        is_test = False
+        mesh_axes = ()
+
+    rng = np.random.RandomState(3)
+    a = rng.randn(2, 3, 4).astype("float32")
+    b = rng.randn(2, 5, 4).astype("float32")
+    out = np.asarray(get_op("fusion_transpose_flatten_concat").lower(
+        Ctx(), [a, b], {"trans_axis": [0, 2, 1], "flatten_axis": 1,
+                        "concat_axis": 1}))
+    want = np.concatenate(
+        [a.transpose(0, 2, 1).reshape(2, -1),
+         b.transpose(0, 2, 1).reshape(2, -1)], axis=1)
+    np.testing.assert_allclose(out, want, rtol=1e-6)

@@ -506,3 +506,61 @@ register_op("save_combine", ["X*"], [], lambda *a: None, grad=None,
             host_run=_save_combine_run)
 register_op("load_combine", [], ["Out*"], lambda *a: None, grad=None,
             host_run=_load_combine_run, host_stage="pre")
+
+
+# ---------------------------------------------------------------------------
+# SSD hard-negative mining (detection/mine_hard_examples_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@simple_op("mine_hard_examples",
+           ["ClsLoss", "LocLoss", "MatchIndices", "MatchDist"],
+           ["NegIndices", "UpdatedMatchIndices"],
+           optional=("LocLoss",), grad=None)
+def _mine_hard_examples(ctx, cls_loss, loc_loss, match_indices, match_dist,
+                        attrs):
+    """Select hard negatives per image (mine_hard_examples_op.cc):
+    max_negative keeps the num_pos*ratio highest-loss unmatched priors
+    under the distance threshold; hard_example ranks ALL priors by
+    cls(+loc) loss, keeps sample_size, and demotes unselected positives
+    in UpdatedMatchIndices.  NegIndices is the dense analog of the
+    reference's ragged LoD rows: ascending prior indices padded with -1
+    (the multiclass_nms convention in this build)."""
+    mining = attrs.get("mining_type", "max_negative")
+    ratio = float(attrs.get("neg_pos_ratio", 1.0))
+    thr = float(attrs.get("neg_dist_threshold", 0.5))
+    sample = int(attrs.get("sample_size", 0))
+    n, p = [int(d) for d in jnp.shape(match_indices)]
+    loss = cls_loss.astype(jnp.float32)
+    if mining == "hard_example" and loc_loss is not None:
+        loss = loss + loc_loss.astype(jnp.float32)
+    is_neg = match_indices == -1
+    if mining == "max_negative":
+        eligible = is_neg & (match_dist.astype(jnp.float32) < thr)
+        neg_sel = jnp.minimum(
+            (jnp.sum(~is_neg, axis=1).astype(jnp.float32)
+             * ratio).astype(jnp.int32),
+            jnp.sum(eligible, axis=1).astype(jnp.int32))
+    elif mining == "hard_example":
+        eligible = jnp.ones((n, p), bool)
+        neg_sel = jnp.minimum(jnp.asarray(sample, jnp.int32),
+                              jnp.asarray(p, jnp.int32))
+        neg_sel = jnp.broadcast_to(neg_sel, (n,))
+    else:
+        raise NotImplementedError(f"mining_type {mining!r}")
+    masked = jnp.where(eligible, loss, -jnp.inf)
+    order = jnp.argsort(-masked, axis=1)           # loss descending
+    inv_rank = jnp.argsort(order, axis=1)          # prior → rank
+    selected = eligible & (inv_rank < neg_sel[:, None])
+    # negatives among the selected, emitted in ASCENDING prior order
+    # (the reference copies a std::set) and padded with -1
+    neg_mask = selected & is_neg
+    asc = jnp.where(neg_mask, jnp.arange(p)[None, :], p)
+    asc = jnp.sort(asc, axis=1)
+    neg_indices = jnp.where(asc < p, asc, -1).astype(jnp.int64)
+    if mining == "hard_example":
+        updated = jnp.where((match_indices > -1) & ~selected,
+                            -1, match_indices)
+    else:
+        updated = match_indices
+    return neg_indices, updated

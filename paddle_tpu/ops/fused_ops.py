@@ -25,9 +25,9 @@ import jax.numpy as jnp
 
 from paddle_tpu.fluid.registry import simple_op
 
-from .common import bcast_to, mxu_dot
-from .rnn_ops import _gru, _lstm
-from .sequence_ops import _sequence_pool
+from .common import act_attr, bcast_to, mxu_dot
+from .rnn_ops import _act, _gru, _lstm
+from .sequence_ops import _seq_unfold, _sequence_pool
 from .tensor_ops import _lookup_table
 
 
@@ -206,16 +206,12 @@ def _fusion_seqpool_cvm_concat(ctx, xs, cvm, lengths, attrs):
            no_grad_inputs=("Length",))
 def _fusion_seqconv_eltadd_relu(ctx, x, w, bias, length, attrs):
     """sequence_conv + bias + relu (fusion_seqconv_eltadd_relu_op.cc);
-    ColMat is the unfolded im2col intermediate the reference exposes."""
-    from .sequence_ops import _sequence_conv
-
-    # pass attrs straight through: _sequence_conv reads the same keys and
-    # owns the centered-window contextStart default — a local default here
-    # would diverge from the unfused composition
-    conv = _sequence_conv(ctx, x, w, length, attrs)
-    out = jax.nn.relu(conv + jnp.reshape(bias, (1, 1, -1)))
-    b, t, _ = jnp.shape(x)
-    col = jnp.zeros((b, t, jnp.shape(w)[0]), x.dtype)  # interop shape stub
+    ColMat is the REAL unfolded im2col intermediate (attrs pass straight
+    to the shared unfold so the centered-window contextStart default
+    cannot diverge from the unfused composition; XLA drops ColMat when
+    nothing consumes it)."""
+    col = _seq_unfold(x, length, attrs)
+    out = jax.nn.relu(mxu_dot(col, w) + jnp.reshape(bias, (1, 1, -1)))
     return out, col
 
 
@@ -234,9 +230,6 @@ def _fusion_seqexpand_concat_fc(ctx, xs, w, bias, attrs):
     out = mxu_dot(cat, w)
     if bias is not None:
         out = out + jnp.reshape(bias, (1, 1, -1))
-    from .common import act_attr
-    from .rnn_ops import _act
-
     try:
         out = _act(act_attr(attrs.get("fc_activation") or None,
                             "identity"))(out)  # "" == identity

@@ -28,26 +28,29 @@ def _time_mask(x, length):
     return (jnp.arange(t)[None, :] < jnp.reshape(length, (-1, 1))).astype(x.dtype)
 
 
+def _seq_unfold(x, length, attrs):
+    """Context-window im2col over time: [B, T, D] → [B, T, ctx_len*D].
+    contextStart defaults to -(ctx_len-1)/2 (centered window, matching
+    the reference layer); shared by sequence_conv and the
+    fusion_seqconv_eltadd_relu interop op (which exposes it as ColMat)."""
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -((ctx_len - 1) // 2)))
+    t = jnp.shape(x)[1]
+    if length is not None:
+        m = _time_mask(x, length)
+        x = x * m[:, :, None]
+    pads = (-ctx_start, ctx_len - 1 + ctx_start)
+    xp = jnp.pad(x, ((0, 0), pads, (0, 0)))
+    cols = [xp[:, i:i + t, :] for i in range(ctx_len)]
+    return jnp.concatenate(cols, axis=-1)
+
+
 @simple_op("sequence_conv", ["X", "Filter", "Length"], ["Out"],
            optional=("Length",), no_grad_inputs=("Length",))
 def _sequence_conv(ctx, x, w, length, attrs):
     """Context-window conv over time (reference sequence_conv_op.cc).
-    x: [B, T, D]; Filter: [ctx_len * D, num_filters].  contextStart defaults
-    to -(ctx_len-1)/2 i.e. a centered window, matching the reference layer."""
-    ctx_len = int(attrs.get("contextLength", 3))
-    ctx_start = int(attrs.get("contextStart", -((ctx_len - 1) // 2)))
-    b, t, d = jnp.shape(x)
-    nf = jnp.shape(w)[-1]
-    if length is not None:
-        m = _time_mask(x, length)
-        x = x * m[:, :, None]
-    # unfold the context window: [B, T, ctx_len*D]
-    pads = (-ctx_start, ctx_len - 1 + ctx_start)
-    xp = jnp.pad(x, ((0, 0), pads, (0, 0)))
-    cols = [xp[:, i:i + t, :] for i in range(ctx_len)]
-    unfolded = jnp.concatenate(cols, axis=-1)
-    out = mxu_dot(unfolded, w)
-    return out
+    x: [B, T, D]; Filter: [ctx_len * D, num_filters]."""
+    return mxu_dot(_seq_unfold(x, length, attrs), w)
 
 
 @simple_op("sequence_pool", ["X", "Length"], ["Out", "MaxIndex"],

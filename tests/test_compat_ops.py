@@ -400,3 +400,37 @@ def test_spp_reference_recipe_odd_size():
     avg = np.asarray(_lower("spp", ones, pyramid_height=2,
                             pooling_type="avg"))
     np.testing.assert_allclose(avg[0, 1:], np.ones(4), rtol=1e-6)
+
+
+def test_mine_hard_examples_max_negative():
+    """2 images, 5 priors: selection count = num_pos * ratio, eligibility
+    gated by the distance threshold, indices ascending, -1 padded."""
+    match = np.array([[2, -1, -1, -1, 0],
+                      [-1, -1, -1, -1, -1]], np.int32)
+    dist = np.array([[0.9, 0.1, 0.2, 0.8, 0.7],
+                     [0.1, 0.1, 0.1, 0.1, 0.1]], np.float32)
+    cls = np.array([[0.1, 0.9, 0.5, 0.3, 0.2],
+                    [0.5, 0.1, 0.9, 0.8, 0.2]], np.float32)
+    neg, updated = _lower("mine_hard_examples", cls, None, match, dist,
+                          mining_type="max_negative", neg_pos_ratio=1.0,
+                          neg_dist_threshold=0.5)
+    neg = np.asarray(neg)
+    # image 0: 2 positives -> 2 negatives; eligible = priors 1, 2
+    # (3 fails the dist threshold); both selected, ascending order
+    np.testing.assert_array_equal(neg[0], [1, 2, -1, -1, -1])
+    # image 1: 0 positives -> 0 negatives
+    np.testing.assert_array_equal(neg[1], [-1] * 5)
+    np.testing.assert_array_equal(np.asarray(updated), match)  # unchanged
+
+
+def test_mine_hard_examples_hard_example_demotes():
+    match = np.array([[3, -1, 1, -1]], np.int32)
+    dist = np.full((1, 4), 0.1, np.float32)
+    cls = np.array([[0.1, 0.9, 0.2, 0.8]], np.float32)
+    loc = np.array([[0.0, 0.0, 0.0, 0.0]], np.float32)
+    neg, updated = _lower("mine_hard_examples", cls, loc, match, dist,
+                          mining_type="hard_example", sample_size=2)
+    # top-2 by loss: priors 1 (0.9) and 3 (0.8) — both negatives
+    np.testing.assert_array_equal(np.asarray(neg)[0], [1, 3, -1, -1])
+    # unselected positives (0 and 2) demoted to -1
+    np.testing.assert_array_equal(np.asarray(updated)[0], [-1, -1, -1, -1])

@@ -416,6 +416,12 @@ def test_fusion_seqconv_eltadd_relu_matches_unfused():
     np.testing.assert_allclose(np.asarray(out),
                                np.maximum(np.asarray(ref) + b, 0),
                                rtol=1e-5)
+    # ColMat is the REAL unfolded im2col (context window -1..1), not a stub
+    assert np.asarray(col).shape == (2, 5, 9)
+    np.testing.assert_allclose(np.asarray(col)[:, 1, 3:6], x[:, 1, :],
+                               rtol=1e-6)  # center tap of window at t=1
+    np.testing.assert_allclose(np.asarray(col)[:, 0, 0:3],
+                               np.zeros((2, 3)), rtol=1e-6)  # left pad
 
 
 def test_fusion_seqexpand_concat_fc():

@@ -542,6 +542,13 @@ def _mine_hard_examples(ctx, cls_loss, loc_loss, match_indices, match_dist,
              * ratio).astype(jnp.int32),
             jnp.sum(eligible, axis=1).astype(jnp.int32))
     elif mining == "hard_example":
+        if sample <= 0:
+            # reference InferShape rejects this (PADDLE_ENFORCE_GT,
+            # mine_hard_examples_op.cc:245); silently selecting nothing
+            # would demote EVERY positive and destroy SSD training
+            raise ValueError(
+                "mine_hard_examples: mining_type='hard_example' needs "
+                f"sample_size > 0, got {sample}")
         eligible = jnp.ones((n, p), bool)
         neg_sel = jnp.minimum(jnp.asarray(sample, jnp.int32),
                               jnp.asarray(p, jnp.int32))

@@ -62,6 +62,28 @@ def _fusion_lstm(ctx, x, wx, wh, bias, h0, c0, length, attrs):
     return hidden, cell, xx
 
 
+@simple_op("fused_embedding_fc_lstm",
+           ["Ids", "Embeddings", "WeightH", "Bias", "H0", "C0", "Length"],
+           ["Hidden", "Cell", "XX"],
+           optional=("H0", "C0", "Length"),
+           no_grad_inputs=("Ids", "Length"))
+def _fused_embedding_fc_lstm(ctx, ids, embeddings, wh, bias, h0, c0,
+                             length, attrs):
+    """lookup_table + fc + lstm (fused_embedding_fc_lstm_op.cc
+    SeqCompute): the fuse pass pre-bakes emb@WeightX + fc bias into the
+    Embeddings table ([vocab, 4D]), so XX is a plain row lookup; the
+    kernel reads Bias only for the peephole tail (op.cc:260 wc_data =
+    bias + D4), which the shared `_lstm` consumes with a zeroed gate
+    bias."""
+    xx = _lookup_table(ctx, embeddings, ids, {})  # [B, T, 4D]
+    d4 = int(jnp.shape(wh)[1])
+    bias = jnp.reshape(bias, (-1,))
+    lstm_bias = jnp.concatenate(
+        [jnp.zeros((d4,), bias.dtype), bias[d4:]])
+    hidden, cell = _lstm(ctx, xx, wh, lstm_bias, h0, c0, length, attrs)
+    return hidden, cell, xx
+
+
 @simple_op("fusion_gru",
            ["X", "WeightX", "WeightH", "Bias", "H0", "Length"],
            ["Hidden", "XX"],

@@ -434,3 +434,12 @@ def test_mine_hard_examples_hard_example_demotes():
     np.testing.assert_array_equal(np.asarray(neg)[0], [1, 3, -1, -1])
     # unselected positives (0 and 2) demoted to -1
     np.testing.assert_array_equal(np.asarray(updated)[0], [-1, -1, -1, -1])
+
+
+def test_mine_hard_examples_rejects_zero_sample_size():
+    match = np.array([[1, -1]], np.int32)
+    dist = np.full((1, 2), 0.1, np.float32)
+    cls = np.full((1, 2), 0.5, np.float32)
+    with pytest.raises(ValueError, match="sample_size"):
+        _lower("mine_hard_examples", cls, None, match, dist,
+               mining_type="hard_example", sample_size=0)

@@ -463,3 +463,33 @@ def test_fusion_transpose_flatten_concat():
         [a.transpose(0, 2, 1).reshape(2, -1),
          b.transpose(0, 2, 1).reshape(2, -1)], axis=1)
     np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_fused_embedding_fc_lstm_matches_fusion_lstm():
+    """The embedding-table form equals fusion_lstm fed with the looked-up
+    pre-projected rows (the fuse pass bakes emb@Wx + bias into the
+    table, so XX is a plain lookup; peepholes ride in Bias[4D:])."""
+    from paddle_tpu.fluid.registry import get_op
+
+    class Ctx:
+        step = 0
+        is_test = False
+        mesh_axes = ()
+
+    rng = np.random.RandomState(4)
+    vocab, d = 7, 3
+    table = rng.randn(vocab, 4 * d).astype("float32")
+    wh = rng.randn(d, 4 * d).astype("float32")
+    bias = rng.randn(1, 4 * d).astype("float32")  # no peepholes
+    ids = rng.randint(0, vocab, (2, 5)).astype("int64")
+    h, c, xx = get_op("fused_embedding_fc_lstm").lower(
+        Ctx(), ids, table, wh, bias, None, None, None, {})
+    np.testing.assert_allclose(np.asarray(xx), table[ids], rtol=1e-6)
+    # parity: fusion_lstm with identity WeightX on the same xx rows and a
+    # zero gate bias (the table already carries the fc bias)
+    eye = np.eye(4 * d, dtype="float32")
+    zero_bias = np.zeros((1, 4 * d), np.float32)
+    h2, c2, _ = get_op("fusion_lstm").lower(
+        Ctx(), table[ids], eye, wh, zero_bias, None, None, None, {})
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(c2), rtol=1e-5)

@@ -89,12 +89,22 @@ def _crop(im, size, start_h, start_w):
     return im[start_h:start_h + size, start_w:start_w + size]
 
 
+def _check_crop_fits(im, size, fname):
+    h, w = im.shape[:2]
+    if size > min(h, w):
+        raise ValueError(
+            f"{fname}: crop size {size} exceeds image size {h}x{w}; "
+            "resize to at least the crop size first")
+
+
 def center_crop(im, size, is_color=True):
+    _check_crop_fits(im, size, "center_crop")
     h, w = im.shape[:2]
     return _crop(im, size, (h - size) // 2, (w - size) // 2)
 
 
 def random_crop(im, size, is_color=True):
+    _check_crop_fits(im, size, "random_crop")
     h, w = im.shape[:2]
     start_h = np.random.randint(0, h - size + 1)
     start_w = np.random.randint(0, w - size + 1)

@@ -50,15 +50,15 @@ def start_procs(args):
         endpoints = [f"127.0.0.1:{args.start_port + i}"
                      for i in range(args.server_num)]
     pserver_ips = ",".join(e.split(":")[0] for e in endpoints)
-    # numeric sort: '10000' < '9999' lexicographically, and PADDLE_PORT
-    # must name the port pserver 0 actually binds
-    ports = sorted({e.split(":")[1] for e in endpoints}, key=int)
+    # comma-joined and aligned with PADDLE_PSERVERS so the role maker can
+    # zip them back into the endpoint list (reference behavior)
+    ports = ",".join(e.split(":")[1] for e in endpoints)
 
     base_env = dict(os.environ)
     base_env.pop("http_proxy", None)
     base_env.pop("https_proxy", None)
     common = dict(PADDLE_PSERVERS=pserver_ips,
-                  PADDLE_PORT=ports[0],
+                  PADDLE_PORT=ports,
                   PADDLE_PSERVER_ENDPOINTS=",".join(endpoints),
                   PADDLE_TRAINERS_NUM=str(args.worker_num))
     if args.print_config:

@@ -45,7 +45,12 @@ def enter_local_scope():
 
 def leave_local_scope():
     """Pop the current scope and drop the parent's kids."""
-    _stack().pop()
+    stack = _stack()
+    if len(stack) == 1:
+        raise RuntimeError(
+            "leave_local_scope called without a matching "
+            "enter_local_scope (the root scope cannot be popped)")
+    stack.pop()
     get_cur_scope().drop_kids()
 
 

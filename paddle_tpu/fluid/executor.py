@@ -502,6 +502,24 @@ class _FeedScopeView:
         self._scope.set(name, value)
 
 
+def _stage_scope_reads(scope, names, device):
+    """Fetch `names` from `scope` onto `device`, failing with the variable's
+    NAME on a miss — a cached plan may classify a var as a scope read
+    against a scope that held it; None reaching jax.device_put would
+    surface as an opaque pytree/TypeError instead."""
+    import jax
+
+    staged = {}
+    for n in names:
+        v = scope.get(n)
+        if v is None:
+            raise ValueError(
+                f"variable {n!r} is read by this program but absent "
+                "from the current scope")
+        staged[n] = jax.device_put(v, device)
+    return staged
+
+
 class _JitExecutable:
     """Shared introspection surface of a cached jitted executable
     (`_CompiledBlock` per-step, `_CompiledChain` n-steps-per-call):
@@ -592,21 +610,8 @@ class _CompiledBlock(_JitExecutable):
             # scope vars the device step is about to read
             self.plan.run_host_pre_ops(scope, feeds, self.place)
             device = self.place.jax_device()
-            donated = {}
-            for n in self.donated_names:
-                v = scope.get(n)
-                donated[n] = jax.device_put(v, device)
-            readonly = {}
-            for n in self.readonly_names:
-                v = scope.get(n)
-                if v is None:
-                    # a cached plan may have classified n as a scope read
-                    # (e.g. fetch-of-scope-var rescue) against a scope that
-                    # held it; fail with the var's NAME, not a jax TypeError
-                    raise ValueError(
-                        f"variable {n!r} is read by this program but absent "
-                        "from the current scope")
-                readonly[n] = jax.device_put(v, device)
+            donated = _stage_scope_reads(scope, self.donated_names, device)
+            readonly = _stage_scope_reads(scope, self.readonly_names, device)
             feed_vals = {k: jax.device_put(v, device) for k, v in feeds.items()}
             with warnings.catch_warnings():
                 warnings.simplefilter("ignore")  # donation unsupported on CPU backend
@@ -726,16 +731,10 @@ class _CompiledChain(_JitExecutable):
 
         with _prof.timed_run(self.label, self._prof_state) as timer:
             device = self.place.jax_device()
-            donated = {n: jax.device_put(scope.get(n), device)
-                       for n in self.plan.donated_names}
-            readonly = {}
-            for n in self.plan.readonly_names:
-                v = scope.get(n)
-                if v is None:
-                    raise ValueError(
-                        f"variable {n!r} is read by this program but "
-                        "absent from the current scope")
-                readonly[n] = jax.device_put(v, device)
+            donated = _stage_scope_reads(scope, self.plan.donated_names,
+                                         device)
+            readonly = _stage_scope_reads(scope, self.plan.readonly_names,
+                                          device)
             feed_vals = {k: jax.device_put(v, device)
                          for k, v in feeds.items()}
             with warnings.catch_warnings():

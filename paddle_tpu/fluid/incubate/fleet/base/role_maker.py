@@ -108,14 +108,46 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         else:
             role = os.getenv("TRAINING_ROLE",
                              os.getenv("PADDLE_TRAINING_ROLE", "TRAINER"))
-            port = os.getenv("PADDLE_PORT", "6174")
-            ips = os.getenv("PADDLE_PSERVERS", "127.0.0.1")
-            self._server_endpoints = [f"{ip}:{port}"
-                                      for ip in ips.split(",") if ip]
+            # The explicit endpoint list wins when present: a pserver's own
+            # env overrides PADDLE_PORT with just the port it binds, so the
+            # ip×port reconstruction below would mislocate its peers.
+            eps = os.getenv("PADDLE_PSERVER_ENDPOINTS", "")
+            if eps:
+                self._server_endpoints = [e for e in eps.split(",") if e]
+            else:
+                # PADDLE_PORT may be a comma-joined list aligned with the ip
+                # list (several pservers on one host) or a single port shared
+                # by every ip (reference multi-host layout).
+                ports = [p for p in
+                         os.getenv("PADDLE_PORT", "6174").split(",") if p]
+                ips = [ip for ip in
+                       os.getenv("PADDLE_PSERVERS", "127.0.0.1").split(",")
+                       if ip]
+                if len(ports) == 1:
+                    ports = ports * len(ips)
+                elif len(ports) != len(ips):
+                    raise ValueError(
+                        f"PADDLE_PORT lists {len(ports)} ports but "
+                        f"PADDLE_PSERVERS lists {len(ips)} ips — the lists "
+                        "must align one-to-one (or give a single shared "
+                        "port)")
+                self._server_endpoints = [f"{ip}:{port}"
+                                          for ip, port in zip(ips, ports)]
             self._worker_num_env = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
             if role.upper() in ("PSERVER", "SERVER"):
                 self._role = Role.SERVER
-                cur = os.getenv("POD_IP", "127.0.0.1") + ":" + port
+                cur = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+                if not cur:
+                    own_ports = os.getenv("PADDLE_PORT", "6174").split(",")
+                    if len(own_ports) > 1:
+                        # ip:first-port would silently collide every
+                        # co-hosted pserver onto id 0
+                        raise ValueError(
+                            "a PSERVER with a multi-port PADDLE_PORT list "
+                            "must set PADDLE_CURRENT_ENDPOINT to identify "
+                            "itself")
+                    cur = (os.getenv("POD_IP", "127.0.0.1") + ":"
+                           + own_ports[0])
                 self._current_id = (self._server_endpoints.index(cur)
                                     if cur in self._server_endpoints else 0)
             else:

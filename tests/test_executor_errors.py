@@ -100,3 +100,45 @@ def test_fetch_by_string_name():
         (by_name,) = exe.run(main, feed={"x": np.ones((2, 7), "float32")},
                              fetch_list=[pred.name])
     np.testing.assert_array_equal(np.asarray(by_var), np.asarray(by_name))
+
+
+def test_donated_scope_miss_names_variable():
+    """A training program (donated params) run against a scope that lacks
+    them must name the variable, not die in a pytree/TypeError — on both
+    the per-step and run_steps chain paths."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[7], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    feed = {"x": np.zeros((2, 7), "float32"),
+            "y": np.zeros((2, 1), "float32")}
+    exe = fluid.Executor()
+    with scope_guard(Scope()):  # warm both plan caches
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        exe.run_steps(main, feed=feed, n_steps=2, fetch_list=[loss])
+    with scope_guard(Scope()):  # fresh scope: params absent
+        with pytest.raises(ValueError, match="absent from the current"):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        with pytest.raises(ValueError, match="absent from the current"):
+            exe.run_steps(main, feed=feed, n_steps=2, fetch_list=[loss])
+
+
+def test_leave_local_scope_underflow_raises():
+    from paddle_tpu.fluid import default_scope_funcs as dsf
+    dsf.enter_local_scope()
+    dsf.leave_local_scope()
+    with pytest.raises(RuntimeError, match="root scope"):
+        dsf.leave_local_scope()
+
+
+def test_crop_larger_than_image_raises():
+    from paddle_tpu.dataset import image as pimg
+    im = np.zeros((40, 60, 3), dtype="uint8")
+    with pytest.raises(ValueError, match="crop size 50 exceeds"):
+        pimg.random_crop(im, 50)
+    with pytest.raises(ValueError, match="crop size 41 exceeds"):
+        pimg.center_crop(im, 41)

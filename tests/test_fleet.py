@@ -1,6 +1,7 @@
 """Fleet API tests (reference incubate/fleet): role makers, collective
 fleet graph rewrite, PS fleet end to end on localhost threads."""
 
+import os
 import threading
 
 import numpy as np
@@ -45,6 +46,76 @@ def test_role_maker_env(monkeypatch):
     rm2 = PaddleCloudRoleMaker()
     rm2.generate_role()
     assert rm2.is_worker() and rm2.worker_index() == 2
+
+
+def test_role_maker_multi_pserver_one_host(monkeypatch):
+    """server_num=2 on one host: ports zip with ips; a pserver whose env
+    overrides PADDLE_PORT with its own bind port still locates all peers
+    through PADDLE_PSERVER_ENDPOINTS and self-indexes correctly."""
+    # trainer view: comma-joined port list aligned with the ip list
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_PSERVERS", "127.0.0.1,127.0.0.1")
+    monkeypatch.setenv("PADDLE_PORT", "6170,6171")
+    monkeypatch.delenv("PADDLE_PSERVER_ENDPOINTS", raising=False)
+    rm = PaddleCloudRoleMaker()
+    rm.generate_role()
+    assert rm.get_pserver_endpoints() == ["127.0.0.1:6170", "127.0.0.1:6171"]
+
+    # pserver 1 view: own PADDLE_PORT, endpoint list present
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("POD_IP", "127.0.0.1")
+    monkeypatch.setenv("PADDLE_PORT", "6171")
+    monkeypatch.setenv("PADDLE_PSERVER_ENDPOINTS",
+                       "127.0.0.1:6170,127.0.0.1:6171")
+    monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6171")
+    rm = PaddleCloudRoleMaker()
+    rm.generate_role()
+    assert rm.is_server()
+    assert rm.get_pserver_endpoints() == ["127.0.0.1:6170", "127.0.0.1:6171"]
+    assert rm.server_index() == 1
+
+
+def test_launch_ps_server_num_2(tmp_path):
+    """Real launcher run (server_num=2, worker_num=2): every process dumps
+    the env contract; each pserver binds a distinct port and self-indexes
+    uniquely, and trainers see both endpoints."""
+    import json as _json
+    import sys as _sys
+
+    script = tmp_path / "dump_env.py"
+    script.write_text(
+        "import json, os, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from paddle_tpu.fluid.incubate.fleet.base.role_maker import \\\n"
+        "    PaddleCloudRoleMaker\n"
+        "rm = PaddleCloudRoleMaker(); rm.generate_role()\n"
+        "role = os.environ['TRAINING_ROLE']\n"
+        "idx = rm.server_index() if rm.is_server() else rm.worker_index()\n"
+        "rec = dict(role=role, idx=idx,\n"
+        "           eps=rm.get_pserver_endpoints(),\n"
+        "           port=os.environ['PADDLE_PORT'])\n"
+        "open(os.path.join(%r, f'{role}.{idx}.{os.getpid()}.json'),\n"
+        "     'w').write(json.dumps(rec))\n"
+        % (os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(fluid.__file__)))), str(tmp_path)))
+
+    from paddle_tpu.distributed import launch_ps
+    args = launch_ps._parse_args([
+        "--server_num=2", "--worker_num=2", "--start_port=6270",
+        "--log_dir", str(tmp_path / "logs"), str(script)])
+    launch_ps.start_procs(args)
+
+    recs = [_json.loads(p.read_text())
+            for p in tmp_path.glob("*.json")]
+    assert len(recs) == 4
+    eps = ["127.0.0.1:6270", "127.0.0.1:6271"]
+    assert all(r["eps"] == eps for r in recs)
+    servers = [r for r in recs if r["role"] == "PSERVER"]
+    assert sorted(r["idx"] for r in servers) == [0, 1]
+    assert sorted(r["port"] for r in servers) == ["6270", "6271"]
+    trainers = [r for r in recs if r["role"] == "TRAINER"]
+    assert sorted(r["idx"] for r in trainers) == [0, 1]
 
 
 def test_split_files():

@@ -165,21 +165,43 @@ def _gather_inputs(op, info, env, optional_ok=True):
 # numerically sensitive ops that stay fp32 islands under the bf16 policy:
 # inputs are upcast and the lowering runs in fp32; outputs stay fp32, and
 # any bf16 consumer downcasts its own inputs, so the chain stays narrow
-# (losses/softmax/norm statistics — the standard mixed-precision
-# blocklist, reference fp16_lists.py black_list)
+# (losses — the standard mixed-precision blocklist, reference
+# fp16_lists.py black_list).  softmax/log_softmax/softmax_with_cross_
+# entropy/layer_norm/batch_norm are NOT islands: their lowerings upcast
+# internally (fp32 statistics/exp-sum on the VPU) but return the input
+# dtype, so the big saved-for-backward tensors — attention scores
+# [B, heads, S, S], LN/BN outputs, the MLM softmax [positions, vocab] —
+# stay bf16 and their HBM round-trip halves.
 _BF16_FP32_OPS = frozenset({
-    "softmax", "softmax_with_cross_entropy", "cross_entropy",
-    "cross_entropy2", "mean", "reduce_mean", "batch_norm", "layer_norm",
-    "log_softmax", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "cross_entropy2", "mean", "reduce_mean",
+    "sigmoid_cross_entropy_with_logits",
 })
+
+# fp32-internal ops whose PARAM/STAT inputs must not be downcast: the
+# activations ride bf16, but scale/bias and (for BN) the donated running
+# mean/variance buffers are fp32 masters — a bf16 round-trip would both
+# round the masters and flip the written-back buffer dtype.
+# {op type: top-level input indices the policy leaves untouched}
+_BF16_KEEP_FP32_INPUTS = {
+    "layer_norm": (1, 2),             # Scale, Bias
+    "layer_norm_grad": (1, 2),
+    "batch_norm": (1, 2, 3, 4),       # Scale, Bias, Mean, Variance
+    "batch_norm_grad": (1, 2, 3, 4),
+}
 
 
 def _map_floats(vals, fn):
     import jax.numpy as jnp
 
+    from .struct_values import is_struct_value
+
     def one(v):
         if v is None:
             return None
+        if is_struct_value(v):
+            # tensor-array/rank-table values pass through opaquely; their
+            # buffer dtype was set by the (policy-applied) producing op
+            return v
         if isinstance(v, (list, tuple)):
             return [one(x) for x in v]
         try:
@@ -201,12 +223,40 @@ def _apply_bf16_policy(op, vals):
     and the loss fetch stays fp32."""
     import jax.numpy as jnp
 
+    def _all_float_inputs_scalar():
+        # a loss tail (add of two scalar means) or an lr-schedule chain:
+        # scalars gain nothing from bf16, and keeping them fp32 preserves
+        # the "loss fetch is fp32" contract past non-island tail ops
+        found = False
+        stack = list(vals)
+        while stack:
+            v = stack.pop()
+            if v is None:
+                continue
+            if isinstance(v, (list, tuple)):
+                stack.extend(v)
+                continue
+            try:
+                a = jnp.asarray(v)
+            except TypeError:
+                continue
+            if jnp.issubdtype(a.dtype, jnp.floating):
+                found = True
+                if a.size > 1:
+                    return False
+        return found
+
     role = op.attrs.get("op_role")
-    if role == "optimize" or op.type in _BF16_FP32_OPS:
+    if (role == "optimize" or op.type in _BF16_FP32_OPS
+            or _all_float_inputs_scalar()):
         return _map_floats(vals, lambda v, dt: (
             jnp.asarray(v, jnp.float32) if dt == jnp.bfloat16 else v))
-    return _map_floats(vals, lambda v, dt: (
+    out = _map_floats(vals, lambda v, dt: (
         jnp.asarray(v, jnp.bfloat16) if dt == jnp.float32 else v))
+    for i in _BF16_KEEP_FP32_INPUTS.get(op.type, ()):
+        if i < len(out):
+            out[i] = vals[i]
+    return out
 
 
 def trace_block(block, env, ctx, ops=None):
@@ -275,8 +325,16 @@ def _analyze_block(ops, block, feed_names):
     for op in ops:
         if op.type in ("feed", "fetch"):
             continue
+        # an OPTIONAL in-out input (write_to_array's Array on the first
+        # write) is created by this very op when absent — it is not a
+        # scope dependency.  Mandatory in-outs (adam's Param) still are.
+        info = registry.get_op(op.type)
+        out_names = set(op.output_arg_names)
+        opt_inout = {n for slot in info.optional
+                     for n in op.inputs.get(slot, []) if n in out_names}
         for n in op.input_arg_names:
-            if n not in produced and n not in seen_reads:
+            if (n not in produced and n not in seen_reads
+                    and n not in opt_inout):
                 seen_reads.add(n)
                 scope_reads.append(n)
         for n in op.output_arg_names:

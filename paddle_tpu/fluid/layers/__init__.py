@@ -27,7 +27,10 @@ from . import control_flow
 from .control_flow import (  # noqa: F401
     While, Switch, ConditionalBlock, StaticRNN, increment, array_write,
     array_read, array_length, create_array, autoincreased_step_counter,
+    lod_rank_table, max_sequence_len, lod_tensor_to_array,
+    array_to_lod_tensor, shrink_memory, split_lod_tensor, merge_lod_tensor,
 )
+from .tensor import tensor_array_to_tensor  # noqa: F401
 from . import rnn
 from .rnn import dynamic_lstm, dynamic_gru, gru_unit, lstm_unit  # noqa: F401
 from . import structured

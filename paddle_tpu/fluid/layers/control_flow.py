@@ -314,30 +314,168 @@ from .nn import (  # noqa: E402,F401
 
 # ---------------------------------------------------------------------------
 # Tensor arrays.  The reference models LOD_TENSOR_ARRAY as a growable list
-# written per while-iteration; XLA needs static shapes, so arrays here are
-# fixed-capacity stacked buffers [cap, ...] written by dynamic index — the
-# pattern lax supports inside compiled control flow.
+# written per while-iteration (framework/lod_tensor_array.h); XLA needs
+# static shapes, so arrays here are fixed-capacity stacked buffers
+# [cap, ...] + a traced count (ops/tensor_array_ops.py,
+# fluid/struct_values.py) written by dynamic index — the pattern lax
+# supports inside compiled control flow.
 # ---------------------------------------------------------------------------
 
 
-def create_array(dtype, initialized_list=None):
-    raise NotImplementedError(
-        "LoDTensorArray is not supported on TPU: growable per-iteration "
-        "arrays need dynamic shapes.  Recurrences: StaticRNN (lax.scan); "
-        "accumulation in a while loop: preallocate a fixed-capacity buffer "
-        "and write with layers.scatter.")
+def create_array(dtype, initialized_list=None, capacity=None):
+    """New tensor-array variable (reference layers/control_flow.py
+    create_array).  `capacity` (TPU extension) bounds how many entries the
+    first standalone array_write preallocates; default 128.  The runtime
+    buffer materializes at the first write (or lod_tensor_to_array)."""
+    helper = LayerHelper("create_array")
+    arr = helper.create_variable_for_type_inference(dtype,
+                                                    stop_gradient=True)
+    arr._array_capacity = int(capacity) if capacity else 0
+    if initialized_list:
+        for idx, x in enumerate(initialized_list):
+            i = fill_constant(shape=[1], dtype="int64", value=idx)
+            array_write(x, i, array=arr)
+    return arr
 
 
 def array_write(x, i, array=None):
-    create_array(None)
+    """array[i] = x (reference write_to_array).  The array rides as BOTH an
+    op input and output — the functional lowering consumes the previous
+    buffer and produces the next, and the while capture analysis sees a
+    loop carry."""
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op(
+        "write_to_array",
+        inputs={"X": [x], "I": [i], "Array": [array]},
+        outputs={"Out": [array]},
+        attrs={"capacity": getattr(array, "_array_capacity", 0)})
+    # the array var's static shape records the ENTRY shape so array_read
+    # results feed shape-dependent layers (fc) inside loop bodies
+    if array.shape is None and x.shape is not None:
+        array.shape = tuple(x.shape)
+    return array
 
 
 def array_read(array, i):
-    create_array(None)
+    """array[i] (reference read_from_array)."""
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    if array.shape is not None:
+        out.shape = tuple(array.shape)
+    helper.append_op("read_from_array", inputs={"X": [array], "I": [i]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
 
 
 def array_length(array):
-    create_array(None)
+    """1 + highest index written, int64 [1] (reference lod_array_length)."""
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64",
+                                                    stop_gradient=True)
+    out.shape = (1,)
+    helper.append_op("lod_array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def lod_rank_table(x, level=0, length=None):
+    """Rank table of (row, length) sorted by length desc (reference
+    control_flow.py:719 / lod_rank_table_op.cc).  The dense ragged
+    convention passes row lengths explicitly via `length` [B]; without it
+    every row spans x's full time axis."""
+    helper = LayerHelper("lod_rank_table")
+    table = helper.create_variable_for_type_inference("int32",
+                                                      stop_gradient=True)
+    ins = {"X": [x]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("lod_rank_table", inputs=ins,
+                     outputs={"Out": [table]}, attrs={"level": int(level)})
+    return table
+
+
+def max_sequence_len(rank_table):
+    """Longest length in the table, int64 [1] (max_sequence_len_op.cc)."""
+    helper = LayerHelper("max_sequence_len")
+    out = helper.create_variable_for_type_inference("int64",
+                                                    stop_gradient=True)
+    out.shape = (1,)
+    helper.append_op("max_sequence_len", inputs={"RankTable": [rank_table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def lod_tensor_to_array(x, table):
+    """[B, T, ...] → array of T time entries in rank-table row order
+    (lod_tensor_to_array_op.cc)."""
+    helper = LayerHelper("lod_tensor_to_array")
+    arr = helper.create_variable_for_type_inference(x.dtype,
+                                                    stop_gradient=True)
+    if x.shape is not None and len(x.shape) >= 2:
+        arr.shape = (x.shape[0],) + tuple(x.shape[2:])  # entry: [B, ...]
+    helper.append_op("lod_tensor_to_array",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [arr]}, attrs={})
+    return arr
+
+
+def array_to_lod_tensor(x, table):
+    """Inverse of lod_tensor_to_array: padded [B, T, ...] in original row
+    order, zeros past each row's length (array_to_lod_tensor_op.cc)."""
+    helper = LayerHelper("array_to_lod_tensor")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("array_to_lod_tensor",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def shrink_memory(x, i, table):
+    """Dynamic-RNN memory shrink at step i (shrink_rnn_memory_op.cc);
+    identity on the dense all-rows encoding — see ops/tensor_array_ops.py."""
+    helper = LayerHelper("shrink_memory")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("shrink_rnn_memory",
+                     inputs={"X": [x], "I": [i], "RankTable": [table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def split_lod_tensor(input, mask, level=0):
+    """Row split by bool mask into (true, false) branches
+    (split_lod_tensor_op.cc); dense: same-shape outputs, other branch's
+    rows zeroed."""
+    helper = LayerHelper("split_lod_tensor")
+    out_true = helper.create_variable_for_type_inference(input.dtype)
+    out_false = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("split_lod_tensor",
+                     inputs={"X": [input], "Mask": [mask]},
+                     outputs={"OutTrue": [out_true],
+                              "OutFalse": [out_false]},
+                     attrs={"level": int(level)})
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0):
+    """Row-wise merge of the two branches by mask (merge_lod_tensor_op.cc)."""
+    helper = LayerHelper("merge_lod_tensor")
+    out = helper.create_variable_for_type_inference(in_true.dtype)
+    helper.append_op("merge_lod_tensor",
+                     inputs={"X": [x], "Mask": [mask], "InTrue": [in_true],
+                             "InFalse": [in_false]},
+                     outputs={"Out": [out]}, attrs={"level": int(level)})
+    return out
+
+
+from .tensor import fill_constant  # noqa: E402  (used by create_array)
+
+__all__ += [
+    "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
+    "array_to_lod_tensor", "shrink_memory", "split_lod_tensor",
+    "merge_lod_tensor",
+]
 
 
 def autoincreased_step_counter(counter_name=None, begin=1, step=1):

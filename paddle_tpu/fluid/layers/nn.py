@@ -316,7 +316,8 @@ def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
             dropout_implementation="downgrade_in_infer"):
     helper = LayerHelper("dropout", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
-    mask = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    mask = helper.create_variable_for_type_inference("uint8",
+                                                     stop_gradient=True)
     helper.append_op("dropout", inputs={"X": [x]},
                      outputs={"Out": [out], "Mask": [mask]},
                      attrs={"dropout_prob": dropout_prob, "is_test": is_test,

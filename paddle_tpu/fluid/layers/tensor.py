@@ -168,3 +168,22 @@ def has_inf(x):
 
 
 has_nan = has_inf
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Concat (or stack) every tensor-array entry along `axis` (reference
+    layers/tensor.py tensor_array_to_tensor / tensor_array_to_tensor_op.cc).
+    Static shapes concatenate the array's full capacity — entries past the
+    written count are zero padding; the second return holds each entry's
+    extent along axis."""
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_index = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    helper.append_op("tensor_array_to_tensor", inputs={"X": [input]},
+                     outputs={"Out": [out], "OutIndex": [out_index]},
+                     attrs={"axis": int(axis), "use_stack": bool(use_stack)})
+    return out, out_index
+
+
+__all__ += ["tensor_array_to_tensor"]

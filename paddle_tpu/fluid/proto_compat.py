@@ -325,6 +325,12 @@ def _append_op_raw(blk, type_, ins, outs, attrs):
     in a parent block)."""
     from .framework import Operator
 
+    # reference write_to_array lists the array only as Out (the C++
+    # executor mutates it in scope); the functional lowering consumes the
+    # previous buffer explicitly, so surface it as the Array input
+    if type_ == "write_to_array" and "Array" not in ins:
+        ins = dict(ins, Array=list(outs.get("Out", [])))
+
     def to_vars(d):
         return {slot: [blk._find_var_recursive(n) or _ghost(blk, n)
                        for n in names]

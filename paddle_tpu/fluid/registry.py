@@ -323,8 +323,8 @@ def infer_op_outputs(op, block):
         names = op.outputs.get(cslot, [])
         vals = val if info.is_variadic(slot) else [val]
         for n, s in zip(names, vals or []):
-            if s is None:
-                continue
+            if s is None or not hasattr(s, "shape"):
+                continue  # structured values (tensor arrays, rank tables)
             v = block._find_var_recursive(n)
             if v is None:
                 continue

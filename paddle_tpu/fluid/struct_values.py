@@ -1,0 +1,72 @@
+"""Structured runtime values: tensor arrays and LoD rank tables.
+
+The reference models LOD_TENSOR_ARRAY (framework/lod_tensor_array.h) as a
+growable C++ vector of LoDTensors, and LOD_RANK_TABLE
+(framework/lod_rank_table.h) as (index, length) items sorted by length
+descending.  XLA needs static shapes, so the TPU-native encodings are:
+
+  TensorArrayVal — a fixed-capacity stacked buffer [cap, ...entry shape]
+      plus a traced int32 high-water count.  Writes are
+      lax.dynamic_update_index_in_dim; the whole value threads through
+      lax.while_loop carries (it is a registered pytree).
+  RankTableVal — dense [B] index and [B] lengths vectors (sorted by
+      length descending, stable), the static-shape image of the
+      reference's item vector.
+
+Deliberately NOT tuples/NamedTuples: trace_block's lowering-return
+convention treats a returned tuple as one-value-per-output-slot, and the
+bf16 dtype policy rebuilds list/tuple inputs elementwise — a tuple-typed
+value would be silently dismembered by both.  Custom pytree nodes pass
+through all of that machinery opaquely.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class TensorArrayVal:
+    """Runtime value of a LOD_TENSOR_ARRAY variable."""
+
+    __slots__ = ("buffer", "size")
+
+    def __init__(self, buffer, size):
+        self.buffer = buffer  # [cap, ...entry shape]
+        self.size = size      # traced int32 scalar: 1 + max index written
+
+    @property
+    def capacity(self):
+        return self.buffer.shape[0]
+
+    def __repr__(self):
+        return (f"TensorArrayVal(cap={self.buffer.shape[0]}, "
+                f"entry={self.buffer.shape[1:]}, dtype={self.buffer.dtype})")
+
+
+class RankTableVal:
+    """Runtime value of a LOD_RANK_TABLE variable."""
+
+    __slots__ = ("index", "lengths")
+
+    def __init__(self, index, lengths):
+        self.index = index      # [B] int32: original row of the j-th item
+        self.lengths = lengths  # [B] int32: sorted descending
+
+    def __repr__(self):
+        return f"RankTableVal(n={self.index.shape[0]})"
+
+
+def _reg(cls, fields):
+    jax.tree_util.register_pytree_node(
+        cls,
+        lambda v: (tuple(getattr(v, f) for f in fields), None),
+        lambda aux, leaves: cls(*leaves),
+    )
+
+
+_reg(TensorArrayVal, ("buffer", "size"))
+_reg(RankTableVal, ("index", "lengths"))
+
+
+def is_struct_value(v):
+    return isinstance(v, (TensorArrayVal, RankTableVal))

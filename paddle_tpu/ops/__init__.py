@@ -14,6 +14,7 @@ from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
+from . import tensor_array_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import structured_ops  # noqa: F401
 from . import detection_ops  # noqa: F401

@@ -65,6 +65,21 @@ def _as_pred(c):
     return jnp.reshape(c, ()).astype(bool)
 
 
+def _match_carry(ref, val):
+    """Coerce a body/branch output back to its carry's dtype.  The bf16
+    dtype policy decides per-op dtypes from operand sizes, so a loop body
+    can legitimately produce fp32 where the init carry was downcast to
+    bf16 (e.g. an all-scalar accumulator tail) — lax.while_loop/cond
+    require exactly matching carry types."""
+    from paddle_tpu.fluid.struct_values import is_struct_value
+
+    if is_struct_value(val) or is_struct_value(ref):
+        return val
+    r = jnp.asarray(ref)
+    v = jnp.asarray(val)
+    return v.astype(r.dtype) if v.dtype != r.dtype else v
+
+
 @simple_op("while", ["Condition", "Carry*", "Extra*", "ExtraNG*"], ["Out*"],
            grad=None)
 def _while(ctx, cond, carries, extras, extras_ng, attrs):
@@ -91,9 +106,14 @@ def _while(ctx, cond, carries, extras, extras_ng, attrs):
         env = dict(base)
         env.update(zip(carry_names, c))
         _trace_sub(ctx, sub, env)
-        return tuple(env[n] for n in carry_names)
+        return tuple(_match_carry(ref, env[n])
+                     for ref, n in zip(c, carry_names))
 
-    final = lax.while_loop(cond_fn, body_fn, tuple(map(jnp.asarray, carries)))
+    from paddle_tpu.fluid.struct_values import is_struct_value
+
+    init = tuple(c if is_struct_value(c) else jnp.asarray(c)
+                 for c in carries)
+    final = lax.while_loop(cond_fn, body_fn, init)
     return (tuple(final),)
 
 
@@ -114,7 +134,8 @@ def _conditional_block(ctx, cond, carries, extras, extras_ng, attrs):
         env.update(zip(attrs["extra_ng_names"], extras_ng or []))
         env.update(zip(carry_names, c))
         _trace_sub(ctx, sub, env)
-        return tuple(env[n] for n in carry_names)
+        return tuple(_match_carry(ref, env[n])
+                     for ref, n in zip(c, carry_names))
 
     def false_fn(c, ex):
         return tuple(c)
@@ -150,7 +171,8 @@ def _static_rnn(ctx, step_ins, inits, extras, extras_ng, attrs):
         env.update(zip(mem_names, mems))
         env.update(zip(step_in_names, xs))
         _trace_sub(ctx, sub, env)
-        new_mems = tuple(env[update_map[m]] for m in mem_names)
+        new_mems = tuple(_match_carry(ref, env[update_map[m]])
+                         for ref, m in zip(mems, mem_names))
         outs = tuple(env[n] for n in out_names)
         return new_mems, outs
 

@@ -247,12 +247,19 @@ def _hard_swish(ctx, x, attrs):
 
 @simple_op("softmax", ["X"], ["Out"])
 def _softmax(ctx, x, attrs):
-    return jax.nn.softmax(x, axis=attrs.get("axis", -1))
+    # fp32 internal accumulation, input-dtype output: under the bf16 policy
+    # the exp/sum runs in fp32 (VPU-native) while the materialized [.., S]
+    # output — the residual the grad op re-reads — stays bf16, halving the
+    # attention-score HBM traffic ([B, heads, S, S] per layer in BERT)
+    y = jax.nn.softmax(x.astype(jnp.float32), axis=attrs.get("axis", -1))
+    return y.astype(jnp.asarray(x).dtype)
 
 
 @simple_op("log_softmax", ["X"], ["Out"])
 def _log_softmax(ctx, x, attrs):
-    return jax.nn.log_softmax(x, axis=attrs.get("axis", -1))
+    y = jax.nn.log_softmax(x.astype(jnp.float32),
+                           axis=attrs.get("axis", -1))
+    return y.astype(jnp.asarray(x).dtype)
 
 
 @simple_op("cross_entropy", ["X", "Label"], ["Y"], no_grad_inputs=("Label",))
@@ -279,7 +286,12 @@ def _cross_entropy2(ctx, x, label, attrs):
            no_grad_inputs=("Label",))
 def _softmax_ce(ctx, logits, label, attrs):
     axis = attrs.get("axis", -1)
-    sm = jax.nn.softmax(logits, axis=axis)
+    in_dt = jnp.asarray(logits).dtype
+    logits = logits.astype(jnp.float32)
+    # Softmax output (saved for the grad op) returns at the input dtype —
+    # for a bf16-policy MLM head that's a [positions, vocab]-sized saving;
+    # Loss stays fp32 (it feeds the fp32 mean/scale tail)
+    sm = jax.nn.softmax(logits, axis=axis).astype(in_dt)
     logp = jax.nn.log_softmax(logits, axis=axis)
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)

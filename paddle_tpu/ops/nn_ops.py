@@ -175,7 +175,9 @@ def _batch_norm(ctx, x, scale, bias, mean, var, attrs):
 
     if is_test and not attrs.get("trainable_statistics", False):
         inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
-        y = (x - rs(mean)) * rs(inv * scale.astype(jnp.float32)).astype(x.dtype) + rs(bias)
+        y = ((x.astype(jnp.float32) - rs(mean.astype(jnp.float32)))
+             * rs(inv * scale.astype(jnp.float32))
+             + rs(bias.astype(jnp.float32))).astype(x.dtype)
         return y, mean, var, mean, var
     xf = x.astype(jnp.float32)
     bmean = jnp.mean(xf, axis=axes)
@@ -296,22 +298,31 @@ def _dropout(ctx, x, attrs):
     p = attrs.get("dropout_prob", 0.5)
     impl = attrs.get("dropout_implementation", "downgrade_in_infer")
     is_test = attrs.get("is_test", False) or ctx.is_test
+    # Mask is uint8 0/1 (reference dropout_op.h stores uint8 too): the mask
+    # is saved activation-sized for the grad op, and a dozen [B,S,H] /
+    # [B,heads,S,S] masks per step at 1 byte instead of 2-4 is real HBM;
+    # the grad op reapplies the upscale factor from attrs.
     if is_test:
         if impl == "upscale_in_train":
-            return x, jnp.ones_like(x)
-        return x * (1.0 - p), jnp.ones_like(x)
+            return x, jnp.ones(jnp.shape(x), jnp.uint8)
+        return x * (1.0 - p), jnp.ones(jnp.shape(x), jnp.uint8)
     k = op_rng_key(ctx, attrs)
     keep = jax.random.bernoulli(k, 1.0 - p, jnp.shape(x))
     mask = keep.astype(x.dtype)
     if impl == "upscale_in_train":
         scale = 1.0 / max(1.0 - p, 1e-8)
-        return x * mask * jnp.asarray(scale, x.dtype), mask * jnp.asarray(scale, x.dtype)
-    return x * mask, mask
+        return x * mask * jnp.asarray(scale, x.dtype), keep.astype(jnp.uint8)
+    return x * mask, keep.astype(jnp.uint8)
 
 
 @simple_op("dropout_grad", ["Out@GRAD", "Mask"], ["X@GRAD"], grad=None)
 def _dropout_grad(ctx, dy, mask, attrs):
-    return dy * mask
+    m = mask.astype(dy.dtype)
+    if attrs.get("dropout_implementation",
+                 "downgrade_in_infer") == "upscale_in_train":
+        p = attrs.get("dropout_prob", 0.5)
+        m = m * jnp.asarray(1.0 / max(1.0 - p, 1e-8), dy.dtype)
+    return dy * m
 
 
 _registry.get_op("dropout").grad_maker = _dropout_grad_maker

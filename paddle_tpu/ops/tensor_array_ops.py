@@ -1,0 +1,176 @@
+"""LoDTensorArray / LoDRankTable ops on the fixed-capacity dense encoding.
+
+Reference analogs: paddle/fluid/operators/tensor_array_read_write_op.cc
+(write_to_array / read_from_array), lod_rank_table_op.cc,
+lod_tensor_to_array_op.cc / array_to_lod_tensor_op.cc,
+shrink_rnn_memory_op.cc, max_sequence_len_op.cc, lod_array_length_op.cc,
+split_lod_tensor_op.cc / merge_lod_tensor_op.cc,
+tensor_array_to_tensor_op.cc.
+
+TPU-native redesign (see fluid/struct_values.py): an array is a
+fixed-capacity stacked buffer [cap, ...] + a traced count, a rank table is
+dense sorted (index, lengths) vectors — both registered pytrees so they
+thread through lax.while_loop carries and lax.cond operands.  Writes are
+dynamic index updates, reads dynamic slices; everything jits.
+
+Deviations from the reference (documented in PARITY.md):
+  * entries of one array share one static shape (the reference allows
+    ragged entries; every in-tree use — RNN memories, beam-search ids /
+    scores per step — is uniform after the dense batch redesign);
+  * a standalone write_to_array materializes the buffer at first write
+    with `capacity` entries (attr, default 128) — lod_tensor_to_array
+    derives capacity from the [B, T, ...] input's static T instead;
+  * lod_tensor_to_array keeps all B rows per time entry (sorted by the
+    rank table) instead of shrinking to the active rows; positions past a
+    row's length are zeros after array_to_lod_tensor reassembly, which is
+    where the reference's shrinking becomes observable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.fluid.registry import simple_op
+from paddle_tpu.fluid.struct_values import RankTableVal, TensorArrayVal
+
+DEFAULT_CAPACITY = 128
+
+
+def _idx(i):
+    return jnp.reshape(i, ()).astype(jnp.int32)
+
+
+@simple_op("write_to_array", ["X", "I", "Array"], ["Out"],
+           optional=("Array",), grad=None)
+def _write_to_array(ctx, x, i, arr, attrs):
+    """Out[i] = x.  `Array` is the current value of the (in-out) array var;
+    absent on the first write, which materializes the buffer (reference
+    tensor_array_read_write_op.cc grows a vector instead)."""
+    x = jnp.asarray(x)
+    i = _idx(i)
+    if not isinstance(arr, TensorArrayVal):
+        cap = int(attrs.get("capacity", 0)) or DEFAULT_CAPACITY
+        arr = TensorArrayVal(
+            jnp.zeros((cap,) + tuple(jnp.shape(x)), jnp.asarray(x).dtype),
+            jnp.asarray(0, jnp.int32))
+    buf = lax.dynamic_update_index_in_dim(arr.buffer, x.astype(
+        arr.buffer.dtype), i, axis=0)
+    # out-of-capacity writes clamp onto the last slot (XLA dynamic-update
+    # semantics); clamp size to match so array_length never reports
+    # entries that were not stored.  Pick capacity ≥ the loop bound —
+    # PARITY.md deviation 7.
+    cap = jnp.asarray(arr.buffer.shape[0], jnp.int32)
+    return TensorArrayVal(buf, jnp.minimum(jnp.maximum(arr.size, i + 1),
+                                           cap))
+
+
+@simple_op("read_from_array", ["X", "I"], ["Out"], grad=None)
+def _read_from_array(ctx, arr, i, attrs):
+    return lax.dynamic_index_in_dim(arr.buffer, _idx(i), axis=0,
+                                    keepdims=False)
+
+
+@simple_op("lod_array_length", ["X"], ["Out"], grad=None)
+def _lod_array_length(ctx, arr, attrs):
+    return jnp.reshape(arr.size, (1,)).astype(jnp.int64)
+
+
+@simple_op("lod_rank_table", ["X", "Length"], ["Out"],
+           optional=("Length",), grad=None)
+def _lod_rank_table(ctx, x, length, attrs):
+    """Items (row index, length) sorted by length descending, stable
+    (reference lod_rank_table_op.cc over LoD level `level`).  The dense
+    encoding takes lengths from the explicit Length input (this framework's
+    ragged convention); without one, every row spans the full time axis."""
+    b = jnp.shape(x)[0]
+    if length is None:
+        t = jnp.shape(x)[1] if jnp.ndim(x) > 1 else 1
+        lengths = jnp.full((b,), t, jnp.int32)
+    else:
+        lengths = jnp.reshape(length, (-1,)).astype(jnp.int32)
+    # stable argsort on negated lengths = stable descending order
+    order = jnp.argsort(-lengths, stable=True).astype(jnp.int32)
+    return RankTableVal(order, jnp.take(lengths, order))
+
+
+@simple_op("max_sequence_len", ["RankTable"], ["Out"], grad=None)
+def _max_sequence_len(ctx, table, attrs):
+    return jnp.reshape(table.lengths[0], (1,)).astype(jnp.int64)
+
+
+@simple_op("lod_tensor_to_array", ["X", "RankTable"], ["Out"], grad=None)
+def _lod_tensor_to_array(ctx, x, table, attrs):
+    """[B, T, ...] → array of T entries, entry t = rows (rank-table order)
+    at time t.  Capacity = static T; size = the table's max length.  All B
+    rows ride in every entry (rows whose length ≤ t are padding — the
+    reference shrinks instead; array_to_lod_tensor masks them out)."""
+    sorted_rows = jnp.take(x, table.index, axis=0)   # [B, T, ...]
+    buf = jnp.moveaxis(sorted_rows, 1, 0)            # [T, B, ...]
+    return TensorArrayVal(buf, table.lengths[0].astype(jnp.int32))
+
+
+@simple_op("array_to_lod_tensor", ["X", "RankTable"], ["Out"], grad=None)
+def _array_to_lod_tensor(ctx, arr, table, attrs):
+    """Inverse of lod_tensor_to_array: stack entries back to [B, T, ...] in
+    original row order, zeroing positions at or past each row's length
+    (the dense image of the reference's per-sequence reassembly)."""
+    bt = jnp.moveaxis(arr.buffer, 0, 1)              # [B, T, ...] sorted
+    b = jnp.shape(bt)[0]
+    inv = jnp.zeros((b,), jnp.int32).at[table.index].set(
+        jnp.arange(b, dtype=jnp.int32))
+    out = jnp.take(bt, inv, axis=0)                  # original order
+    lengths = jnp.zeros((b,), jnp.int32).at[table.index].set(table.lengths)
+    t = jnp.shape(out)[1]
+    mask = jnp.arange(t)[None, :] < lengths[:, None]
+    mask = jnp.reshape(mask, jnp.shape(mask) + (1,) * (jnp.ndim(out) - 2))
+    return jnp.where(mask, out, jnp.zeros_like(out))
+
+
+@simple_op("shrink_rnn_memory", ["X", "I", "RankTable"], ["Out"], grad=None)
+def _shrink_rnn_memory(ctx, x, i, table, attrs):
+    """Reference shrink_rnn_memory_op.cc drops memory rows of sequences
+    already finished at step I (rank-table order puts them last).  Static
+    shapes keep all rows; finished rows compute on but their positions are
+    masked at array_to_lod_tensor reassembly, so the composed dynamic-RNN
+    pipeline is output-equivalent."""
+    return x
+
+
+@simple_op("split_lod_tensor", ["X", "Mask"], ["OutTrue", "OutFalse"],
+           grad=None, no_grad_inputs=("Mask",))
+def _split_lod_tensor(ctx, x, mask, attrs):
+    """Dense split (reference split_lod_tensor_op.cc partitions rows): both
+    outputs keep X's shape, with the rows of the other branch zeroed —
+    merge_lod_tensor selects them back, same observable pipeline."""
+    m = jnp.reshape(mask, (-1,)).astype(bool)
+    m = jnp.reshape(m, (jnp.shape(x)[0],) + (1,) * (jnp.ndim(x) - 1))
+    z = jnp.zeros_like(x)
+    return jnp.where(m, x, z), jnp.where(m, z, x)
+
+
+@simple_op("merge_lod_tensor", ["X", "Mask", "InTrue", "InFalse"], ["Out"],
+           grad=None, no_grad_inputs=("Mask", "X"), optional=("X",))
+def _merge_lod_tensor(ctx, x, mask, in_true, in_false, attrs):
+    m = jnp.reshape(mask, (-1,)).astype(bool)
+    m = jnp.reshape(m, (jnp.shape(in_true)[0],) + (1,) *
+                    (jnp.ndim(in_true) - 1))
+    return jnp.where(m, in_true, in_false)
+
+
+@simple_op("tensor_array_to_tensor", ["X"], ["Out", "OutIndex"], grad=None)
+def _tensor_array_to_tensor(ctx, arr, attrs):
+    """Concat (or stack, attr use_stack) every entry along `axis`
+    (reference tensor_array_to_tensor_op.cc).  Static shapes concatenate
+    the full capacity — entries past arr.size are zero padding; OutIndex
+    carries each entry's extent along axis, as in the reference."""
+    axis = int(attrs.get("axis", 0))
+    cap = arr.buffer.shape[0]
+    if attrs.get("use_stack", False):
+        out = jnp.moveaxis(arr.buffer, 0, axis)
+        sizes = jnp.ones((cap,), jnp.int32)
+    else:
+        out = jnp.concatenate([arr.buffer[t] for t in range(cap)], axis=axis)
+        sizes = jnp.full((cap,), arr.buffer.shape[1:][axis], jnp.int32)
+    return out, sizes

@@ -134,3 +134,94 @@ def test_bf16_policy_backward_dots_are_bf16():
     f32_dots = [ln for ln in dots if "xf32>" in ln]
     assert not f32_dots, f"fp32 dots under bf16 policy:\n" + "\n".join(
         ln.strip()[:120] for ln in f32_dots)
+
+
+def test_bf16_policy_islands_output_bf16_activations():
+    """softmax/layer_norm/softmax_with_cross_entropy compute their
+    statistics in fp32 internally but must RETURN bf16 under the policy —
+    those outputs are the big saved-for-backward tensors (attention
+    scores, LN outputs, MLM softmax).  Losses remain fp32 islands."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[8, 16], dtype="float32")
+        lbl = fluid.layers.data(name="lbl", shape=[8, 1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, num_flatten_dims=2)
+        sm = fluid.layers.softmax(h)
+        ln = fluid.layers.layer_norm(sm, begin_norm_axis=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(ln, lbl))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    from paddle_tpu.fluid.contrib import mixed_precision as mp
+    mp.enable_bf16_policy(main)
+    feed = {"x": np.random.RandomState(0).randn(4, 8, 16).astype("float32"),
+            "lbl": np.random.RandomState(1).randint(0, 16, (4, 8, 1))}
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        sm_v, ln_v, loss_v = exe.run(
+            main, feed=feed, fetch_list=[sm.name, ln.name, loss.name],
+            return_numpy=False)
+    import jax.numpy as jnp
+    assert jnp.asarray(sm_v).dtype == jnp.bfloat16
+    assert jnp.asarray(ln_v).dtype == jnp.bfloat16
+    assert np.asarray(loss_v).dtype == np.float32
+    assert np.isfinite(float(np.asarray(loss_v)))
+
+
+def test_bf16_policy_while_scalar_carry():
+    """Regression (r4 review): the all-scalar fp32 exemption must not
+    desynchronize lax.while_loop carry dtypes — the body coerces outputs
+    back to the carry's dtype."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        acc = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                         value=0.0)
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=3)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            s = fluid.layers.reduce_sum(x)
+            acc2 = fluid.layers.elementwise_add(acc, s)
+            fluid.layers.assign(acc2, acc)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+    mp.enable_bf16_policy(main)
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (out,) = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                         fetch_list=[acc])
+    assert abs(float(np.asarray(out)[0]) - 24.0) < 0.5
+
+
+def test_bf16_policy_scalar_loss_tail_stays_fp32():
+    """A composed loss (add of two scalar means) keeps the fp32 fetch —
+    the all-scalar exemption covers non-island tail ops."""
+    main, startup, loss = _build()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        loss2 = fluid.layers.elementwise_add(loss, loss)
+    mp.enable_bf16_policy(main)
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (lv,) = exe.run(main, feed=_data(1)[0], fetch_list=[loss2.name])
+    assert np.asarray(lv).dtype == np.float32
+
+
+def test_bf16_policy_batch_norm_eval_output_bf16():
+    """batch_norm's is_test path must return the input dtype under the
+    policy (regression: it promoted to fp32 via the kept-fp32 stats)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+        bn = fluid.layers.batch_norm(x, is_test=True)
+    mp.enable_bf16_policy(main)
+    import jax.numpy as jnp
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (y,) = exe.run(main, feed={"x": np.ones((2, 3, 8, 8), "float32")},
+                       fetch_list=[bn.name], return_numpy=False)
+    assert jnp.asarray(y).dtype == jnp.bfloat16

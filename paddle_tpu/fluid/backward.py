@@ -68,12 +68,20 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         raise ValueError("loss does not depend on any trainable variable")
 
     uniq_counter = collections.defaultdict(int)
+    # names already present before THIS backward pass: a second
+    # append_backward/gradients call over the same program (double grad —
+    # the WGAN-GP pattern) must not reuse the first pass's grad vars, or
+    # the program gets two writers per name and fetches read the wrong one
+    pre_existing = set(block.vars.keys())
 
     def uniq(var_name):
-        c = uniq_counter[var_name]
-        uniq_counter[var_name] += 1
-        g = grad_var_name(var_name) if c == 0 else f"{grad_var_name(var_name)}@RENAME@{c}"
-        return g
+        while True:
+            c = uniq_counter[var_name]
+            uniq_counter[var_name] += 1
+            g = (grad_var_name(var_name) if c == 0
+                 else f"{grad_var_name(var_name)}@RENAME@{c}")
+            if g not in pre_existing:
+                return g
 
     def make_grad_var(name, like_name):
         src = block._find_var_recursive(like_name)
@@ -85,11 +93,23 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
     # seed: d loss / d loss = 1
     loss_grad = grad_var_name(loss.name)
+    if loss_grad in pre_existing:  # a later pass re-targeting the same var
+        loss_grad = uniq(loss.name)
     make_grad_var(loss_grad, loss.name)
-    block.append_op(
-        "fill_constant", outputs={"Out": [loss_grad]},
-        attrs={"shape": list(loss.shape if loss.shape is not None else [1]),
-               "dtype": loss.dtype, "value": 1.0, "op_role": "backward"})
+    static_shape = (loss.shape is not None
+                    and all(d != -1 for d in loss.shape))
+    if static_shape:
+        block.append_op(
+            "fill_constant", outputs={"Out": [loss_grad]},
+            attrs={"shape": list(loss.shape), "dtype": loss.dtype,
+                   "value": 1.0, "op_role": "backward"})
+    else:
+        # non-scalar target with a dynamic batch dim (fluid.gradients on
+        # a [-1, 1] critic output): seed ones of the RUNTIME shape
+        block.append_op(
+            "fill_any_like", inputs={"X": [loss]},
+            outputs={"Out": [loss_grad]},
+            attrs={"value": 1.0, "op_role": "backward"})
 
     # partials[var] = list of grad var names to be accumulated
     partials: dict[str, list] = collections.defaultdict(list)
@@ -108,16 +128,13 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             g = parts[0]
         else:
             g = grad_var_name(var_name)
-            if g in parts:
-                acc = f"{g}@ACC"
-                make_grad_var(acc, var_name)
-                block.append_op("sum", inputs={"X": list(parts)}, outputs={"Out": [acc]},
-                                attrs={"op_role": "backward"})
-                g = acc
-            else:
-                make_grad_var(g, var_name)
-                block.append_op("sum", inputs={"X": list(parts)}, outputs={"Out": [g]},
-                                attrs={"op_role": "backward"})
+            if g in parts or g in pre_existing:
+                g = f"{g}@ACC"
+                while g in pre_existing:
+                    g += "C"
+            make_grad_var(g, var_name)
+            block.append_op("sum", inputs={"X": list(parts)}, outputs={"Out": [g]},
+                            attrs={"op_role": "backward"})
         finalized[var_name] = g
         return g
 
@@ -239,16 +256,25 @@ def _default_grad_descs(op, info, out_grads, wanted, uniq):
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    """fluid.gradients parity: grads of targets wrt inputs."""
+    """fluid.gradients parity: grads of targets wrt inputs.
+
+    The requested inputs ride through parameter_list so each call —
+    including a second, double-grad pass over a program that already
+    carries grad ops — returns ITS pass's grad vars, never a stale name
+    from an earlier pass."""
     t = targets[0] if isinstance(targets, (list, tuple)) else targets
-    pairs = append_backward(t, parameter_list=None, no_grad_set=no_grad_set)
-    gmap = {p.name: g for p, g in pairs}
+    names = [iv.name if isinstance(iv, Variable) else iv
+             for iv in (inputs if isinstance(inputs, (list, tuple))
+                        else [inputs])]
     block = t.block.program.global_block()
-    outs = []
-    for iv in (inputs if isinstance(inputs, (list, tuple)) else [inputs]):
-        name = iv.name if isinstance(iv, Variable) else iv
-        g = gmap.get(name)
-        if g is None and block.has_var(grad_var_name(name)):
-            g = block.var(grad_var_name(name))
-        outs.append(g)
-    return outs
+    # params too: callers (and optimizers stacked on a penalty loss)
+    # expect every trainable's grad finalized in the same pass
+    wanted = list(dict.fromkeys(
+        names + [p.name for p in block.all_parameters() if p.trainable]))
+    pairs = append_backward(t, parameter_list=wanted,
+                            no_grad_set=no_grad_set)
+    gmap = {p.name: g for p, g in pairs}
+    # no fallback to a bare `<name>@GRAD` lookup: that var may belong to a
+    # PREVIOUS gradients() pass over this program (uniq() deliberately
+    # skips pre-existing names), and a stale gradient is worse than None
+    return [gmap.get(name) for name in names]

@@ -276,6 +276,7 @@ def trace_block(block, env, ctx, ops=None):
         if policy == "bf16":
             vals = _apply_bf16_policy(op, vals)
         ctx.op_index = (block.idx << 16) | op_index
+        ctx.cur_op = op  # slot-name access for imported-signature ops
         out = info.lower(ctx, *vals, attrs=op.attrs)
         outs = out if isinstance(out, tuple) else (out,)
         for slot, val in zip(info.output_slots, outs):
@@ -325,13 +326,23 @@ def _analyze_block(ops, block, feed_names):
     for op in ops:
         if op.type in ("feed", "fetch"):
             continue
-        # an OPTIONAL in-out input (write_to_array's Array on the first
-        # write) is created by this very op when absent — it is not a
-        # scope dependency.  Mandatory in-outs (adam's Param) still are.
+        # a NON-PERSISTABLE optional in-out input (write_to_array's Array
+        # on the first write) is a run-local value this very op creates
+        # when absent — not a scope dependency.  Persistable in-outs
+        # (fake_quantize_range_abs_max's window state) and mandatory ones
+        # (adam's Param) stay scope reads.  Keyed on the program's static
+        # persistable flag, NOT scope contents — the compiled plan is
+        # cached across scopes.
         info = registry.get_op(op.type)
         out_names = set(op.output_arg_names)
-        opt_inout = {n for slot in info.optional
-                     for n in op.inputs.get(slot, []) if n in out_names}
+        opt_inout = set()
+        for slot in info.optional:
+            for n in op.inputs.get(slot, []):
+                if n not in out_names:
+                    continue
+                v = block._find_var_recursive(n)
+                if v is None or not v.persistable:
+                    opt_inout.add(n)
         for n in op.input_arg_names:
             if (n not in produced and n not in seen_reads
                     and n not in opt_inout):

@@ -96,13 +96,29 @@ def has_op(type_):
     return type_ in _OP_REGISTRY
 
 
+def _materialize_lazy_grad(type_):
+    """Auto-derived grad ops carry grad="lazy": their OWN grad op (the
+    second-order `<t>_grad_grad`, the reference's conv2d_grad_grad /
+    mul_grad_grad family) is registered on first demand by re-applying
+    the vjp derivation — arbitrary-order grads without an infinite
+    registration chain at import."""
+    if type_.endswith("_grad"):
+        base = _OP_REGISTRY.get(type_[: -len("_grad")])
+        if base is not None and base.grad == "lazy":
+            return _register_auto_grad(base)
+    return None
+
+
 def get_op(type_) -> OpInfo:
-    if type_ not in _OP_REGISTRY:
+    info = _OP_REGISTRY.get(type_)
+    if info is None:
+        info = _materialize_lazy_grad(type_)
+    if info is None:
         raise KeyError(
             f"op type {type_!r} has no registered lowering; registered: "
             f"{sorted(_OP_REGISTRY)[:40]}..."
         )
-    return _OP_REGISTRY[type_]
+    return info
 
 
 def all_ops():
@@ -252,7 +268,7 @@ def _register_auto_grad(fwd: OpInfo):
         input_slots=in_slots,
         output_slots=out_slots,
         lower=lower_grad,
-        grad=None,
+        grad="lazy",  # second-order grads materialize on demand (get_op)
         optional=frozenset(s.rstrip("*") for s in in_slots),
         no_grad_inputs=frozenset(),
     )

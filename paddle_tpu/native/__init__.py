@@ -166,6 +166,10 @@ def lib():
                                            ctypes.POINTER(ctypes.c_void_p)]
         L.pts_server_wait_table.restype = ctypes.c_int
         L.pts_server_wait_table.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.pts_server_save.restype = ctypes.c_int
+        L.pts_server_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.pts_server_load.restype = ctypes.c_int
+        L.pts_server_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         L.pts_server_stop.argtypes = [ctypes.c_void_p]
         L.pts_connect.restype = ctypes.c_void_p
         L.pts_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
@@ -422,6 +426,7 @@ CMD_FETCH_BARRIER = 4
 CMD_SEND_PARAM = 5
 CMD_STOP = 6
 CMD_LOOKUP_ROWS = 7
+CMD_CHECKPOINT_NOTIFY = 8
 
 # payload magic distinguishing a row-sparse gradient (SelectedRows: ids +
 # row values) from a dense tensor blob.  Dense blobs start with the dtype
@@ -545,6 +550,16 @@ class PSServer:
         """Block until `name` was pushed (trainer-0 init); False = stopped."""
         return bool(lib().pts_server_wait_table(self._h, name.encode()))
 
+    def save(self, path) -> bool:
+        """Snapshot the table (+version/round) to `path` — the server-local
+        half of the CheckpointNotify contract."""
+        return bool(lib().pts_server_save(self._h, str(path).encode()))
+
+    def load(self, path) -> bool:
+        """Restore a snapshot written by save()/CheckpointNotify — a
+        restarted pserver resumes with its shard state."""
+        return bool(lib().pts_server_load(self._h, str(path).encode()))
+
     def table_get(self, name, shape=None):
         out = ctypes.c_void_p()
         n = lib().pts_server_table_get(self._h, name.encode(),
@@ -616,6 +631,11 @@ class PSClient:
 
     def fetch_barrier(self):
         self._req(CMD_FETCH_BARRIER)
+
+    def checkpoint_notify(self, path):
+        """Ask the pserver to snapshot its shard to `path` (reference
+        AsyncCheckpointNotify, send_recv.proto.in:30)."""
+        self._req(CMD_CHECKPOINT_NOTIFY, str(path))
 
     def stop_server(self):
         self._req(CMD_STOP)

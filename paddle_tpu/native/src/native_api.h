@@ -20,6 +20,10 @@ enum PtsCmd : uint8_t {
   // (header_offset << 32) | row_width_bytes, request.data is an i64 id
   // array; the response is the concatenated rows from the table blob.
   kLookupRows = 7,
+  // server-side shard snapshot (reference CheckpointNotify RPC,
+  // operators/distributed/send_recv.proto.in:30): request.name is the
+  // path the server writes its table snapshot to.
+  kCheckpointNotify = 8,
 };
 
 extern "C" {

@@ -38,6 +38,7 @@
 
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -217,6 +218,18 @@ struct PSServer {
           if (!write_response(fd, 0, blob)) return;
           break;
         }
+        case kCheckpointNotify: {
+          // snapshot the table to the requested path (reference pservers
+          // save their own shard on the CheckpointNotify RPC).  Copy under
+          // the lock; disk IO and the response write happen UNLOCKED — a
+          // stalled notifier must not wedge every other connection.
+          auto copy = table;
+          uint64_t ver = version, rid = round_id;
+          lk.unlock();
+          bool ok = write_snapshot(f.name, copy, ver, rid);
+          if (!write_response(fd, ok ? 0 : 1, "")) return;
+          break;
+        }
         case kStop:
           stopped = true;
           cv.notify_all();
@@ -229,6 +242,65 @@ struct PSServer {
           return;
       }
     }
+  }
+
+  // Snapshot file format (little-endian):
+  //   u64 magic 0x50545343'4B505430 ("PTSCKPT0") | u64 version |
+  //   u64 round_id | u64 count | count × (u16 name_len | name |
+  //   u64 blob_len | blob)
+  static constexpr uint64_t kCkptMagic = 0x505453434B505430ull;
+
+  static bool write_snapshot(
+      const std::string& path,
+      const std::unordered_map<std::string, std::string>& copy,
+      uint64_t ver, uint64_t rid) {
+    FILE* fp = ::fopen(path.c_str(), "wb");
+    if (!fp) return false;
+    bool ok = true;
+    uint64_t magic = kCkptMagic, count = copy.size();
+    ok &= ::fwrite(&magic, 8, 1, fp) == 1;
+    ok &= ::fwrite(&ver, 8, 1, fp) == 1;
+    ok &= ::fwrite(&rid, 8, 1, fp) == 1;
+    ok &= ::fwrite(&count, 8, 1, fp) == 1;
+    for (auto& kv : copy) {
+      uint16_t nlen = static_cast<uint16_t>(kv.first.size());
+      uint64_t blen = kv.second.size();
+      ok &= ::fwrite(&nlen, 2, 1, fp) == 1;
+      ok &= nlen == 0 || ::fwrite(kv.first.data(), nlen, 1, fp) == 1;
+      ok &= ::fwrite(&blen, 8, 1, fp) == 1;
+      ok &= blen == 0 || ::fwrite(kv.second.data(), blen, 1, fp) == 1;
+    }
+    ok &= ::fclose(fp) == 0;
+    return ok;
+  }
+
+  bool load_snapshot(const std::string& path) {
+    FILE* fp = ::fopen(path.c_str(), "rb");
+    if (!fp) return false;
+    auto rd = [&](void* p, size_t n) { return ::fread(p, n, 1, fp) == 1; };
+    uint64_t magic = 0, ver = 0, rid = 0, count = 0;
+    bool ok = rd(&magic, 8) && magic == kCkptMagic && rd(&ver, 8) &&
+              rd(&rid, 8) && rd(&count, 8) && count < (1ull << 32);
+    std::unordered_map<std::string, std::string> loaded;
+    for (uint64_t i = 0; ok && i < count; ++i) {
+      uint16_t nlen = 0;
+      uint64_t blen = 0;
+      ok = rd(&nlen, 2);
+      std::string name(nlen, '\0');
+      ok = ok && (nlen == 0 || rd(&name[0], nlen));
+      ok = ok && rd(&blen, 8) && blen <= kMaxBlob;
+      std::string blob(blen, '\0');
+      ok = ok && (blen == 0 || rd(&blob[0], blen));
+      if (ok) loaded.emplace(std::move(name), std::move(blob));
+    }
+    ::fclose(fp);
+    if (!ok) return false;
+    std::lock_guard<std::mutex> lk(mu);
+    table = std::move(loaded);
+    version = ver;
+    round_id = rid;
+    cv.notify_all();
+    return true;
   }
 
   void accept_loop() {
@@ -408,6 +480,26 @@ int pts_server_wait_table(void* h, const char* name) {
   std::unique_lock<std::mutex> lk(s->mu);
   s->cv.wait(lk, [&] { return s->stopped || s->table.count(name); });
   return s->stopped ? 0 : 1;
+}
+
+// write the table snapshot to `path`; 1 ok, 0 failed
+int pts_server_save(void* h, const char* path) {
+  auto* s = static_cast<PSServer*>(h);
+  std::unordered_map<std::string, std::string> copy;
+  uint64_t ver, rid;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    copy = s->table;
+    ver = s->version;
+    rid = s->round_id;
+  }
+  return PSServer::write_snapshot(path, copy, ver, rid) ? 1 : 0;
+}
+
+// restore the table (+version/round) from a snapshot; 1 ok, 0 failed
+int pts_server_load(void* h, const char* path) {
+  auto* s = static_cast<PSServer*>(h);
+  return s->load_snapshot(path) ? 1 : 0;
 }
 
 void pts_server_stop(void* h) {

@@ -197,3 +197,45 @@ def _print(ctx, x, attrs):
         msg = msg.replace("{", "(").replace("}", ")")
         jax.debug.print(msg + ": {x}", x=x)
     return x
+
+
+@simple_op("recurrent",
+           ["inputs*", "initial_states*", "parameters*"],
+           ["outputs*", "step_scopes"])
+def _recurrent(ctx, seq_ins, init_states, params, attrs):
+    """The reference StaticRNN's exported op (recurrent_op.cc), lowered to
+    lax.scan so imported reference programs run.
+
+    Name contract (reference layers/control_flow.py _complete_op): the
+    sub-block shadows each sequence input and each stacked output under
+    the SAME name as the outer var; `ex_states`/`states` attrs carry the
+    in-block names of the previous/updated memories, zipped with the
+    `initial_states` input order.  Sequence inputs are time-major [T, ...]
+    sliced on dim 0; `reverse` walks time backward (outputs flipped back
+    so out[t] still corresponds to in[t]).  Differentiable via the scan.
+    """
+    op = ctx.cur_op
+    in_names = op.inputs.get("inputs", [])
+    param_names = op.inputs.get("parameters", [])
+    out_names = op.outputs.get("outputs", [])
+    ex_states = attrs.get("ex_states", [])
+    states = attrs.get("states", [])
+    sub = ctx.block.program.block(attrs["sub_block"])
+    reverse = bool(attrs.get("reverse", False))
+
+    base = dict(zip(param_names, params or []))
+    xs = [jnp.flip(v, axis=0) if reverse else v for v in (seq_ins or [])]
+
+    def f(mems, step_slices):
+        env = dict(base)
+        env.update(zip(ex_states, mems))
+        env.update(zip(in_names, step_slices))
+        _trace_sub(ctx, sub, env)
+        new_mems = tuple(_match_carry(ref, env[n])
+                         for ref, n in zip(mems, states))
+        return new_mems, tuple(env[n] for n in out_names)
+
+    init = tuple(jnp.asarray(v) for v in (init_states or []))
+    _, stacked = lax.scan(f, init, tuple(xs))
+    outs = [jnp.flip(o, axis=0) if reverse else o for o in stacked]
+    return tuple(outs), None

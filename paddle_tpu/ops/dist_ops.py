@@ -513,3 +513,96 @@ register_op("send_sparse", ["X", "Ids"], [], _no_lower, grad=None,
             host_run=_send_sparse_run)
 register_op("geo_sgd_sync", [], [], _no_lower, grad=None,
             host_run=_geo_sgd_sync_run)
+
+
+# ---------------------------------------------------------------------------
+# PS-program plumbing ops (reference operators/distributed_ops/split_ids_op,
+# merge_ids_op; operators/split_selected_rows_op, lookup_sparse_table_op).
+# split/merge run as host ops — their output sizes are data-dependent
+# (per-shard id counts), exactly the dynamic-shape host work the reference
+# does on CPU in the transpiled PS program.
+# ---------------------------------------------------------------------------
+
+
+def _split_ids_run(scope, op, place):
+    """Dedup + sort all Ids, then shard by id % shard_num (split_ids_op.h)."""
+    import numpy as _np
+
+    all_ids = _np.concatenate(
+        [_np.asarray(scope.get(n)).reshape(-1) for n in op.input("Ids")])
+    uniq = _np.unique(all_ids)  # sorted unique, like the std::set walk
+    outs = op.output("Out")
+    for k, name in enumerate(outs):
+        shard = uniq[uniq % len(outs) == k]
+        scope.set(name, shard.reshape(-1, 1).astype(all_ids.dtype))
+
+
+def _merge_ids_run(scope, op, place):
+    """Per query list, look each id's row up from the shard that owns it
+    (merge_ids_op.h: Rows/X zip to (shard, row) maps)."""
+    import numpy as _np
+
+    id_map = {}
+    for rows_name, x_name in zip(op.input("Rows"), op.input("X")):
+        rows = _np.asarray(scope.get(rows_name)).reshape(-1)
+        vals = _np.asarray(scope.get(x_name))
+        vals = vals.reshape(len(rows), -1)
+        for j, rid in enumerate(rows):
+            id_map[int(rid)] = vals[j]
+    for ids_name, out_name in zip(op.input("Ids"), op.output("Out")):
+        ids = _np.asarray(scope.get(ids_name)).reshape(-1)
+        scope.set(out_name,
+                  _np.stack([id_map[int(i)] for i in ids], axis=0))
+
+
+register_op("split_ids", ["Ids*"], ["Out*"], _no_lower, grad=None,
+            host_run=_split_ids_run)
+register_op("merge_ids", ["Ids*", "Rows*", "X*"], ["Out*"], _no_lower,
+            grad=None, host_run=_merge_ids_run)
+
+
+@simple_op("split_selected_rows", ["X"], ["Out*"], grad=None)
+def _split_selected_rows(ctx, x, attrs):
+    """Split rows by height_sections (split_selected_rows_op.cc).  Dense
+    image of the SelectedRows split: contiguous row ranges."""
+    import jax.numpy as jnp
+
+    sections = [int(s) for s in attrs.get("height_sections", [])]
+    outs, start = [], 0
+    for s in sections:
+        outs.append(x[start:start + s])
+        start += s
+    return (tuple(outs),)
+
+
+@simple_op("lookup_sparse_table", ["W", "Ids"], ["Out"], grad=None,
+           no_grad_inputs=("Ids",))
+def _lookup_sparse_table(ctx, w, ids, attrs):
+    """Server-side table lookup (lookup_sparse_table_op.cc): gather rows
+    of W at Ids.  The reference auto-grows/inits unseen rows inside the
+    growing SelectedRows table; the dense table is preallocated here, so
+    auto_grown_table is a no-op."""
+    import jax.numpy as jnp
+
+    flat = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+    return jnp.take(w, flat, axis=0)
+
+
+def _checkpoint_notify_run(scope, op, place):
+    """Fan the CheckpointNotify RPC to every pserver: each snapshots its
+    own shard to <dir>/<lookup_table>_<i> (reference
+    operators/distributed_ops/checkpoint_notify_op.cc:39-50) — the
+    server-local save the trainer-side fleet.save_persistables cannot do
+    for a large sharded sparse table."""
+    import os as _os
+
+    d = op.attrs.get("dir", "")
+    table = op.attrs.get("lookup_table", "table")
+    _os.makedirs(d, exist_ok=True) if d else None
+    for i, ep in enumerate(op.attrs.get("epmap", [])):
+        get_channel(ep).client.checkpoint_notify(
+            _os.path.join(d, f"{table}_{i}"))
+
+
+register_op("checkpoint_notify", [], [], _no_lower, grad=None,
+            host_run=_checkpoint_notify_run)

@@ -273,3 +273,162 @@ def _fusion_transpose_flatten_concat(ctx, xs, attrs):
         lead = math.prod(jnp.shape(t)[:flat_axis]) if flat_axis else 1
         outs.append(jnp.reshape(t, (lead, -1)))
     return jnp.concatenate(outs, axis=concat_axis)
+
+
+@simple_op("attention_lstm",
+           ["X", "C0", "H0", "AttentionWeight", "AttentionBias",
+            "AttentionScalar", "AttentionScalarBias", "LSTMWeight",
+            "LSTMBias", "Length"],
+           ["Hidden", "Cell", "AttentionedX", "AttentionFCOut", "LSTMX",
+            "LSTMOUT"],
+           optional=("H0", "AttentionBias", "AttentionScalar",
+                     "AttentionScalarBias", "Length"),
+           no_grad_inputs=("Length",), grad=None)
+def _attention_lstm(ctx, x, c0, h0, aw, ab, ascalar, ascalar_bias, lw, lb,
+                    length, attrs):
+    """Attention LSTM (reference attention_lstm_op.cc:339-411): per step,
+    score EVERY position of the row against the previous cell
+    (relu(x·aw[:M] + c_prev·aw[M:]) → optional scalar stage → softmax over
+    the valid positions), sum-pool the scored positions into lstm_x [M],
+    then one LSTM step with the combined (D+M)x4D weight, gate order
+    {forget, input, output, cand} and hidden rows FIRST in the weight.
+
+    Dense layout: X is [B, T, M] + optional Length (the reference walks
+    LoD rows); the scan runs the padded T with finished rows frozen."""
+    b, t, m = jnp.shape(x)
+    d4 = jnp.shape(lw)[1]
+    d = d4 // 4
+    act_gate = _act(attrs.get("gate_activation", "sigmoid"))
+    act_cell = _act(attrs.get("cell_activation", "tanh"))
+    act_cand = _act(attrs.get("candidate_activation", "tanh"))
+
+    atted_x = mxu_dot(jnp.reshape(x, (b * t, m)), aw[:m])  # [B*T, 1]
+    if ab is not None:
+        atted_x = atted_x + jnp.reshape(ab, ())
+    atted_x = jnp.reshape(atted_x, (b, t))
+
+    if length is None:
+        valid = jnp.ones((b, t), bool)
+        ln = jnp.full((b,), t, jnp.int32)
+    else:
+        ln = jnp.reshape(length, (-1,)).astype(jnp.int32)
+        valid = jnp.arange(t)[None, :] < ln[:, None]
+
+    h_init = (jnp.zeros((b, d), x.dtype) if h0 is None
+              else h0.astype(x.dtype))
+
+    def step(carry, i):
+        c_prev, h_prev = carry
+        cell_bias = mxu_dot(c_prev, aw[m:])            # [B, 1]
+        fc = jax.nn.relu(atted_x + cell_bias)          # [B, T]
+        if ascalar is not None:
+            fc = fc * jnp.reshape(ascalar, ())
+            sb = (jnp.reshape(ascalar_bias, ())
+                  if ascalar_bias is not None else 0.0)
+            fc = jax.nn.relu(fc + sb)
+        fc = jnp.where(valid, fc, -jnp.inf)
+        probs = jax.nn.softmax(fc.astype(jnp.float32), axis=1).astype(
+            x.dtype)
+        lstm_x = jnp.einsum("bt,btm->bm", probs, x)    # sum pool
+        gates = (mxu_dot(lstm_x, lw[d:]) + mxu_dot(h_prev, lw[:d])
+                 + jnp.reshape(lb, (-1,)))
+        f_g = act_gate(gates[:, :d])
+        i_g = act_gate(gates[:, d:2 * d])
+        o_g = act_gate(gates[:, 2 * d:3 * d])
+        cand = act_cand(gates[:, 3 * d:])
+        c_new = f_g * c_prev + i_g * cand
+        h_new = act_cell(c_new) * o_g
+        on = (i < ln)[:, None]                         # freeze finished rows
+        c_next = jnp.where(on, c_new, c_prev)
+        h_next = jnp.where(on, h_new, h_prev)
+        out_h = jnp.where(on, h_new, jnp.zeros_like(h_new))
+        out_c = jnp.where(on, c_new, jnp.zeros_like(c_new))
+        return (c_next, h_next), (out_h, out_c, lstm_x, gates)
+
+    (_, _), (hs, cs, lx, lo) = jax.lax.scan(
+        step, (c0.astype(x.dtype), h_init), jnp.arange(t))
+    hidden = jnp.moveaxis(hs, 0, 1)                    # [B, T, D]
+    cell = jnp.moveaxis(cs, 0, 1)
+    return (hidden, cell, atted_x[..., None], jnp.zeros((t, 1), x.dtype),
+            lx[-1], lo[-1])
+
+
+@simple_op("conv2d_fusion", ["Input", "Filter", "Bias", "ResidualData"],
+           ["Output", "Outputs*"], optional=("Bias", "ResidualData"))
+def _conv2d_fusion(ctx, x, w, bias, residual, attrs):
+    """y = act(conv(x) + residual + bias) with optional channel split
+    (reference conv_fusion_op.cc; the CUDNN fused path's math, composed —
+    XLA fuses the epilogue into the conv anyway)."""
+    from .nn_ops import _conv2d
+
+    out = _conv2d(ctx, x, w, bias, attrs)
+    if residual is not None:
+        out = out + residual
+    out = _act(act_attr(attrs.get("activation", "relu"), "relu"))(out)
+    split = [int(s) for s in attrs.get("split_channels", [])]
+    if split:
+        parts, start = [], 0
+        for s in split:
+            parts.append(out[:, start:start + s])
+            start += s
+        return out, tuple(parts)
+    return out, ()
+
+
+@simple_op("conv2d_inception_fusion",
+           ["Input", "Filter*", "Bias*"], ["Output", "TempOutput*"],
+           grad=None)
+def _fusion_conv_inception(ctx, x, filters, biases, attrs):
+    """GoogLeNet tower fusion (fused/fusion_conv_inception_op.{cc,cu},
+    registered as conv2d_inception_fusion): with 4
+    filters f0..f3 —
+      branch A: 3x3 pool(x) (stride 1, pad 1, attr pooling_type) → 1x1
+        conv f0 → oc0 channels;
+      conv1: 1x1 f1 on x → first oc1 = f1_out - 2·f2_in channels go to the
+        output, the remaining 2·f2_in feed conv2;
+      conv2: 3x3 f2, groups=2, pad 1 → first oc2 = f2_out - f3_in channels
+        to the output, last f3_in feed conv3;
+      conv3: 3x3 f3, pad 1 → oc3 channels.
+    Every conv applies bias + activation (the CUDNN fused epilogue);
+    Output = channel-concat[A, conv1, conv2, conv3]."""
+    from .nn_ops import _conv2d
+
+    act = _act(act_attr(attrs.get("activation", "relu"), "relu"))
+    pool_type = attrs.get("pooling_type", "max")
+    exclusive = attrs.get("exclusive", True)
+    f0, f1, f2, f3 = filters
+    b0, b1, b2, b3 = biases
+    pads = [(1, 1), (1, 1)]
+    if pool_type == "max":
+        pooled = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 1, 3, 3), (1, 1, 1, 1),
+            [(0, 0), (0, 0)] + pads)
+    else:
+        s = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, (1, 1, 3, 3), (1, 1, 1, 1),
+            [(0, 0), (0, 0)] + pads)
+        if exclusive:
+            cnt = jax.lax.reduce_window(
+                jnp.ones_like(x), 0.0, jax.lax.add, (1, 1, 3, 3),
+                (1, 1, 1, 1), [(0, 0), (0, 0)] + pads)
+            pooled = s / cnt
+        else:
+            pooled = s / 9.0
+
+    def conv(inp, w, b, pad, groups=1):
+        a = {"strides": [1, 1], "paddings": [pad, pad],
+             "dilations": [1, 1], "groups": groups}
+        return act(_conv2d(ctx, inp, w, b, a))
+
+    f2_in = jnp.shape(f2)[1]  # per-group input channels (groups=2)
+    f3_in = jnp.shape(f3)[1]
+    branch_a = conv(pooled, f0, b0, 0)
+    c1 = conv(x, f1, b1, 0)
+    oc1 = jnp.shape(f1)[0] - 2 * f2_in
+    c1_out, c1_tail = c1[:, :oc1], c1[:, oc1:]
+    c2 = conv(c1_tail, f2, b2, 1, groups=2)
+    oc2 = jnp.shape(f2)[0] - f3_in
+    c2_out, c2_tail = c2[:, :oc2], c2[:, oc2:]
+    c3 = conv(c2_tail, f3, b3, 1)
+    out = jnp.concatenate([branch_a, c1_out, c2_out, c3], axis=1)
+    return out, (jnp.zeros_like(pooled),)

@@ -555,3 +555,51 @@ def _ctc_align(ctx, ids, length, attrs):
     out = jnp.where(occupied > 0, vals, pad)
     out_len = jnp.sum(keep.astype(jnp.int32), axis=1)
     return out, out_len
+
+
+@simple_op("sample_logits",
+           ["Logits", "Labels", "CustomizedSamples",
+            "CustomizedProbabilities"],
+           ["Samples", "Probabilities", "LogitsDim", "LabelsDim",
+            "SampledLogits", "SampledLabels"],
+           optional=("CustomizedSamples", "CustomizedProbabilities"),
+           no_grad_inputs=("Labels", "CustomizedSamples",
+                           "CustomizedProbabilities"), grad=None)
+def _sample_logits(ctx, logits, labels, cust_samples, cust_probs, attrs):
+    """Sampled-softmax helper (reference sample_logits_op.{cc,h}):
+    Samples = [labels | log-uniform negatives], SampledLogits = gathered
+    logits − log Q(y|x) with accidental true-class hits pushed to −1e20,
+    SampledLabels = the label columns' positions.  Deviation: negatives
+    are drawn WITH replacement (the reference's unique-resampling loop is
+    data-dependent; `adjust_prob` then reduces to the raw probability)."""
+    from .common import op_rng_key
+
+    n, k = jnp.shape(logits)
+    labels2 = jnp.reshape(labels, (n, -1)).astype(jnp.int64)
+    nt = jnp.shape(labels2)[1]
+    s = int(attrs.get("num_samples", 64))
+    if attrs.get("use_customized_samples", False):
+        samples = cust_samples.astype(jnp.int64)
+        probs = cust_probs
+    else:
+        key = op_rng_key(ctx, attrs)
+        # log-uniform over [0, k): P(c) = log((c+2)/(c+1)) / log(k+1)
+        u = jax.random.uniform(key, (n, s))
+        neg = jnp.expm1(u * jnp.log(jnp.asarray(k + 1.0))).astype(jnp.int64)
+        neg = jnp.clip(neg, 0, k - 1)
+        samples = jnp.concatenate([labels2, neg], axis=1)
+        probs = (jnp.log1p(1.0 / (samples.astype(jnp.float32) + 1.0))
+                 / jnp.log(jnp.asarray(k + 1.0))).astype(logits.dtype)
+    sampled = jnp.take_along_axis(logits, samples.astype(jnp.int32), axis=1)
+    if attrs.get("remove_accidental_hits", True):
+        neg_part = samples[:, nt:]                      # [N, S]
+        hit = jnp.any(neg_part[:, :, None] == labels2[:, None, :], axis=2)
+        sampled = sampled.at[:, nt:].add(
+            jnp.where(hit, -1e20, 0.0).astype(sampled.dtype))
+    sampled = sampled - jnp.log(jnp.maximum(probs, 1e-30)).astype(
+        sampled.dtype)
+    sampled_labels = jnp.broadcast_to(jnp.arange(nt, dtype=jnp.int64),
+                                      (n, nt))
+    return (samples, probs, jnp.asarray([n, k], jnp.int64),
+            jnp.asarray(jnp.shape(labels2), jnp.int64), sampled,
+            sampled_labels)

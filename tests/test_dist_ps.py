@@ -256,3 +256,131 @@ def test_fetch_host_op_output():
     finally:
         fluid.transpiler.stop_pservers([ep])
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# server-side checkpointing (reference CheckpointNotify,
+# operators/distributed_ops/checkpoint_notify_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _ckpt_rounds(srv, port, n_rounds, w_init=None, ckpt_after=None,
+                 ckpt_path=None, start_round=1):
+    """Drive `n_rounds` sync rounds with one trainer; optionally ask the
+    server to snapshot (via the CheckpointNotify RPC) after round
+    `ckpt_after`.  Returns the final fetched param."""
+    out = {}
+
+    def server_loop():
+        assert srv.wait_table("w")
+        w = srv.table_get("w")
+        while srv.wait_round():
+            gs = [a for n, a in srv.grads() if n == "w@GRAD"]
+            w = w - 0.1 * np.mean(gs, axis=0)
+            srv.publish("w", w)
+            srv.bump_version()
+            srv.release_send()
+            if not srv.end_round():
+                break
+
+    st = threading.Thread(target=server_loop)
+    st.start()
+    cli = native.PSClient(port=port)
+    if w_init is not None:
+        cli.send_param("w", w_init)
+    w = None
+    for r in range(start_round, start_round + n_rounds):
+        cli.send_grad("w@GRAD", np.full(4, float(r), np.float32))
+        cli.send_barrier()
+        w = cli.get_param("w", want_version=r - start_round + 1)
+        cli.fetch_barrier()
+        if ckpt_after is not None and r == ckpt_after:
+            cli.checkpoint_notify(ckpt_path)
+    out["w"] = w
+    cli.stop_server()
+    cli.close()
+    st.join(timeout=30)
+    assert not st.is_alive()
+    return out["w"]
+
+
+def test_ps_server_checkpoint_restart_continuity(tmp_path):
+    """Kill the pserver after a mid-training snapshot, restart a fresh one
+    from the snapshot, finish training — identical to an uninterrupted
+    run (the server-local shard save trainer-side save_persistables
+    cannot provide)."""
+    ckpt = str(tmp_path / "shard0.ckpt")
+    w0 = np.ones(4, np.float32)
+
+    # uninterrupted 5-round baseline
+    srv_a = native.PSServer(port=0, n_trainers=1)
+    w_full = _ckpt_rounds(srv_a, srv_a.port, 5, w_init=w0)
+    srv_a.stop()
+
+    # 3 rounds, snapshot, hard stop
+    srv_b = native.PSServer(port=0, n_trainers=1)
+    w_mid = _ckpt_rounds(srv_b, srv_b.port, 3, w_init=w0, ckpt_after=3,
+                         ckpt_path=ckpt)
+    srv_b.stop()
+    assert os.path.exists(ckpt)
+
+    # fresh server restores the shard and resumes rounds 4..5; version
+    # continuity comes from the snapshot (want_version counts from the
+    # restored version)
+    srv_c = native.PSServer(port=0, n_trainers=1)
+    assert srv_c.load(ckpt)
+    np.testing.assert_allclose(srv_c.table_get("w"), w_mid)
+    cli = native.PSClient(port=srv_c.port)
+
+    def server_loop():
+        w = srv_c.table_get("w")
+        while srv_c.wait_round():
+            gs = [a for n, a in srv_c.grads() if n == "w@GRAD"]
+            w = w - 0.1 * np.mean(gs, axis=0)
+            srv_c.publish("w", w)
+            srv_c.bump_version()
+            srv_c.release_send()
+            if not srv_c.end_round():
+                break
+
+    st = threading.Thread(target=server_loop)
+    st.start()
+    base_ver = 3  # snapshot carried version=3
+    w = None
+    for r in (4, 5):
+        cli.send_grad("w@GRAD", np.full(4, float(r), np.float32))
+        cli.send_barrier()
+        w = cli.get_param("w", want_version=base_ver + r - 3)
+        cli.fetch_barrier()
+    cli.stop_server()
+    cli.close()
+    st.join(timeout=30)
+    srv_c.stop()
+    np.testing.assert_allclose(w, w_full)  # exact continuity
+
+
+def test_checkpoint_notify_host_op(tmp_path):
+    """The checkpoint_notify op fans the snapshot RPC to every endpoint
+    in epmap, reference dir layout <dir>/<lookup_table>_<i>."""
+    from paddle_tpu.ops.dist_ops import reset_channels
+
+    srv = native.PSServer(port=0, n_trainers=1)
+    srv.publish("emb", np.arange(8, dtype=np.float32))
+    d = str(tmp_path / "ck")
+    main = fluid.Program()
+    main.global_block().append_op(
+        "checkpoint_notify", inputs={}, outputs={},
+        attrs={"epmap": [f"127.0.0.1:{srv.port}"], "dir": d,
+               "lookup_table": "emb", "trainer_id": 0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(main)
+    reset_channels()
+    path = os.path.join(d, "emb_0")
+    assert os.path.exists(path)
+    srv2 = native.PSServer(port=0, n_trainers=1)
+    assert srv2.load(path)
+    np.testing.assert_allclose(srv2.table_get("emb"),
+                               np.arange(8, dtype=np.float32))
+    srv.stop()
+    srv2.stop()

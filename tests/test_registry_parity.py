@@ -1,0 +1,76 @@
+"""Registry parity vs the reference's REGISTER_OPERATOR set.
+
+The reference op-type universe is frozen in
+paddle_tpu/fluid/reference_ops.py (tools/gen_reference_ops.py scans
+paddle/fluid/operators/**.cc for REGISTER_OPERATOR /
+REGISTER_OP_WITHOUT_GRADIENT).  Every type must either be registered here
+or appear on the documented-subsumed list below, which PARITY.md's
+"Registry diff" section mirrors — a new gap fails this test instead of
+hiding.
+"""
+
+import paddle_tpu.fluid  # noqa: F401  (registers all ops)
+from paddle_tpu.fluid import registry
+from paddle_tpu.fluid.reference_ops import REFERENCE_OPS
+
+# Reference op types deliberately NOT registered, by category (keep in
+# sync with PARITY.md "Registry diff"):
+SUBSUMED = {
+    # engine/backend binding ops — other inference stacks, no TPU meaning
+    "anakin_engine", "ngraph_engine", "tensorrt_engine", "nccl",
+    # feed/fetch are executor built-ins here (trace_block skips them; the
+    # reference registers them as ops)
+    "feed", "fetch",
+    # CUDNN packed-weight LSTM variant; the unfused lstm/fusion_lstm
+    # lowerings cover the math
+    "cudnn_lstm", "cudnn_lstm_grad",
+    # reader ops — the GraphReader/py_reader layer owns ingestion
+    # (fluid/layers/io.py, fluid/dataset.py)
+    "read", "create_custom_reader",
+    # PS-mode prefetch RPC — distributed_lookup (host op) is the analog
+    "prefetch",
+    # ParallelDo's device-list op; ParallelDo was deprecated in the
+    # reference itself (ParallelExecutor/our mesh runners replace it)
+    "get_places",
+    # grad ops of forward types whose backward this framework builds
+    # natively via append_backward + auto-vjp (imported inference
+    # programs carry no grad ops; training programs are differentiated
+    # here, not imported pre-differentiated)
+    "while_grad", "sample_logits_grad", "shrink_rnn_memory_grad",
+    "tensor_array_to_tensor_grad",
+}
+
+# Double-grad types the reference registers eagerly; here they
+# MATERIALIZE LAZILY on first demand (registry._materialize_lazy_grad —
+# auto-vjp of the grad lowering; numerics pinned by
+# tests/test_double_grad.py).  The test forces materialization so a
+# regression in the lazy path fails loudly.
+LAZY_DOUBLE_GRADS = {
+    "conv2d_grad_grad", "mul_grad_grad", "relu_grad_grad",
+    "leaky_relu_grad_grad", "sqrt_grad_grad", "square_grad_grad",
+    "elementwise_add_grad_grad", "elementwise_sub_grad_grad",
+    "elementwise_mul_grad_grad", "elementwise_div_grad_grad",
+}
+
+
+def test_reference_registry_diff_is_exactly_the_documented_list():
+    for t in sorted(LAZY_DOUBLE_GRADS):
+        registry.get_op(t)  # must materialize (or this raises KeyError)
+    ours = set(registry.all_ops())
+    missing = REFERENCE_OPS - ours
+    undocumented = sorted(missing - SUBSUMED)
+    assert not undocumented, (
+        "reference op types neither registered nor documented-subsumed "
+        f"(add the op or extend PARITY.md + SUBSUMED): {undocumented}")
+    stale = sorted(SUBSUMED & ours)
+    assert not stale, (
+        f"ops on the subsumed list are now registered — prune: {stale}")
+    gone = sorted(SUBSUMED - REFERENCE_OPS)
+    assert not gone, (
+        f"subsumed entries not in the reference set at all: {gone}")
+
+
+def test_registry_covers_reference_majority():
+    ours = set(registry.all_ops())
+    covered = len(REFERENCE_OPS & ours)
+    assert covered >= 440, (covered, len(REFERENCE_OPS))

@@ -48,9 +48,11 @@ def _is_float(dtype):
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
-                    callbacks=None, checkpoints=None):
+                    callbacks=None, checkpoints=None, loss_grad_var=None):
     """Append grad ops for `loss` into its program; returns
-    [(param, param_grad_var)] like the reference (backward.py:558)."""
+    [(param, param_grad_var)] like the reference (backward.py:558).
+    `loss_grad_var` overrides the all-ones seed (fluid.gradients'
+    target_gradients)."""
     program = loss.block.program
     block = program.global_block()
     no_grad_set = set(no_grad_set or ())
@@ -91,25 +93,30 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                              stop_gradient=True)
         return name
 
-    # seed: d loss / d loss = 1
-    loss_grad = grad_var_name(loss.name)
-    if loss_grad in pre_existing:  # a later pass re-targeting the same var
-        loss_grad = uniq(loss.name)
-    make_grad_var(loss_grad, loss.name)
-    static_shape = (loss.shape is not None
-                    and all(d != -1 for d in loss.shape))
-    if static_shape:
-        block.append_op(
-            "fill_constant", outputs={"Out": [loss_grad]},
-            attrs={"shape": list(loss.shape), "dtype": loss.dtype,
-                   "value": 1.0, "op_role": "backward"})
+    # seed: d loss / d loss = 1, or the caller's target_gradients var
+    if loss_grad_var is not None:
+        loss_grad = (loss_grad_var.name
+                     if isinstance(loss_grad_var, Variable)
+                     else loss_grad_var)
     else:
-        # non-scalar target with a dynamic batch dim (fluid.gradients on
-        # a [-1, 1] critic output): seed ones of the RUNTIME shape
-        block.append_op(
-            "fill_any_like", inputs={"X": [loss]},
-            outputs={"Out": [loss_grad]},
-            attrs={"value": 1.0, "op_role": "backward"})
+        loss_grad = grad_var_name(loss.name)
+        if loss_grad in pre_existing:  # later pass re-targeting the var
+            loss_grad = uniq(loss.name)
+        make_grad_var(loss_grad, loss.name)
+        static_shape = (loss.shape is not None
+                        and all(d != -1 for d in loss.shape))
+        if static_shape:
+            block.append_op(
+                "fill_constant", outputs={"Out": [loss_grad]},
+                attrs={"shape": list(loss.shape), "dtype": loss.dtype,
+                       "value": 1.0, "op_role": "backward"})
+        else:
+            # non-scalar target with a dynamic batch dim (fluid.gradients
+            # on a [-1, 1] critic output): seed ones of the RUNTIME shape
+            block.append_op(
+                "fill_any_like", inputs={"X": [loss]},
+                outputs={"Out": [loss_grad]},
+                attrs={"value": 1.0, "op_role": "backward"})
 
     # partials[var] = list of grad var names to be accumulated
     partials: dict[str, list] = collections.defaultdict(list)
@@ -261,8 +268,12 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     The requested inputs ride through parameter_list so each call —
     including a second, double-grad pass over a program that already
     carries grad ops — returns ITS pass's grad vars, never a stale name
-    from an earlier pass."""
+    from an earlier pass.  `target_gradients` seeds the vjp (reference
+    semantics); default is ones."""
     t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    tg = (target_gradients[0]
+          if isinstance(target_gradients, (list, tuple))
+          else target_gradients)
     names = [iv.name if isinstance(iv, Variable) else iv
              for iv in (inputs if isinstance(inputs, (list, tuple))
                         else [inputs])]
@@ -272,7 +283,7 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     wanted = list(dict.fromkeys(
         names + [p.name for p in block.all_parameters() if p.trainable]))
     pairs = append_backward(t, parameter_list=wanted,
-                            no_grad_set=no_grad_set)
+                            no_grad_set=no_grad_set, loss_grad_var=tg)
     gmap = {p.name: g for p, g in pairs}
     # no fallback to a bare `<name>@GRAD` lookup: that var may belong to a
     # PREVIOUS gradients() pass over this program (uniq() deliberately

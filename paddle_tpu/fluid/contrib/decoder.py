@@ -112,6 +112,22 @@ class TrainingDecoder:
         return self._drnn()
 
 
+def _gather_beam_state(state, parent, beam):
+    """Reorder a PER-BEAM state [B, K, ...] by the selected parent index
+    [B, K] so beam k's state descends from the hypothesis beam_search
+    actually chose (the book test_machine_translation pattern, done with a
+    one-hot contraction — static shapes, no gather scatter).  States
+    without a beam axis ([B, ...] shared across beams) pass through."""
+    shape = state.shape
+    if shape is None or len(shape) < 2 or shape[1] != beam:
+        return state
+    onehot = L.one_hot(L.unsqueeze(parent, axes=[2]), beam)  # [B,K,K]
+    flat = L.reshape(state, shape=[0, beam, -1])             # [B,K,F]
+    mixed = L.matmul(onehot, flat)                           # [B,K,F]
+    return L.reshape(mixed, shape=[0, beam]
+                     + [int(d) for d in shape[2:]])
+
+
 class BeamSearchDecoder:
     """Beam-search generation (reference BeamSearchDecoder).  The reference
     builds an early-stopping while loop; here decode(...) runs the compiled
@@ -148,10 +164,65 @@ class BeamSearchDecoder:
         return value
 
     def decode(self, step_fn=None, max_len=32):
-        """step_fn(ids, states) -> (log_probs, new_states); returns
-        (token ids [B, beam, max_len], scores)."""
-        raise NotImplementedError(
-            "Use layers.beam_search/beam_search_decode for compiled "
-            "fixed-width beam decoding (see tests/book/"
-            "test_machine_translation.py for the end-to-end pattern); "
-            "BeamSearchDecoder keeps the reference's object API surface")
+        """Build the beam-search decode loop (reference decode() builds a
+        while loop over growing LoDTensorArrays; this is the
+        fixed-capacity dense image — same array/While machinery, compiled
+        as one XLA while).
+
+        step_fn(pre_ids [B, K], states {name: [B, ...]}) must return
+        (log_probs [B, K, V], new_states); states are seeded from the
+        StateCell's InitStates and threaded through tensor arrays.
+        Returns (sentence ids [B, K, max_len], final scores [B, K])."""
+        if step_fn is None:
+            raise ValueError(
+                "decode(step_fn=...) is required: the compiled loop needs "
+                "the per-step scoring function (the reference reads it "
+                "from the decoding block's graph instead)")
+        beam, end_id = self.beam_size, self.end_id
+        counter = L.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = L.fill_constant(shape=[1], dtype="int64", value=max_len)
+        cap = max_len + 1
+        ids_arr = L.create_array("int64", capacity=cap)
+        sc_arr = L.create_array("float32", capacity=cap)
+        par_arr = L.create_array("int32", capacity=cap)
+        L.array_write(self._init_ids, counter, array=ids_arr)
+        L.array_write(self._init_scores, counter, array=sc_arr)
+        init_parents = L.fill_constant_batch_size_like(
+            input=self._init_ids, shape=[-1, beam], dtype="int32", value=0)
+        L.array_write(init_parents, counter, array=par_arr)
+        state_arrs = {}
+        for name, init in self.state_cell._init_states.items():
+            arr = L.create_array(init.value.dtype, capacity=cap)
+            L.array_write(init.value, counter, array=arr)
+            state_arrs[name] = arr
+
+        cond = L.less_than(counter, limit)
+        w = L.While(cond)
+        with w.block():
+            pre_ids = L.array_read(ids_arr, counter)
+            pre_sc = L.array_read(sc_arr, counter)
+            states = {n: L.array_read(a, counter)
+                      for n, a in state_arrs.items()}
+            log_probs, new_states = step_fn(pre_ids, states)
+            sel_ids, sel_sc, parent = L.beam_search(
+                pre_ids, pre_sc, log_probs, beam_size=beam, end_id=end_id)
+            L.increment(counter, value=1, in_place=True)
+            L.array_write(sel_ids, counter, array=ids_arr)
+            L.array_write(sel_sc, counter, array=sc_arr)
+            L.array_write(parent, counter, array=par_arr)
+            for n, a in state_arrs.items():
+                L.array_write(
+                    _gather_beam_state(new_states[n], parent, beam),
+                    counter, array=a)
+            L.less_than(counter, limit, cond=cond)
+
+        ids_stacked, _ = L.tensor_array_to_tensor(ids_arr, axis=0,
+                                                  use_stack=True)
+        par_stacked, _ = L.tensor_array_to_tensor(par_arr, axis=0,
+                                                  use_stack=True)
+        ids_steps = L.slice(ids_stacked, axes=[0], starts=[1], ends=[cap])
+        par_steps = L.slice(par_stacked, axes=[0], starts=[1], ends=[cap])
+        sentences = L.beam_search_decode(ids_steps, par_steps,
+                                         beam_size=beam, end_id=end_id)
+        final_scores = L.array_read(sc_arr, limit)
+        return sentences, final_scores

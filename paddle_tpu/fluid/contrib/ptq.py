@@ -1,0 +1,147 @@
+"""Post-training int8 quantization (PTQ): calibrate, then rewrite.
+
+Reference analog: paddle/fluid/inference/api/mkldnn_quantizer.cc — the
+AnalysisPredictor runs warmup batches through the fp32 program, collects
+per-tensor maximum-absolute statistics, derives int8 scales, and rewrites
+the graph with quantize/dequantize ops around the quantizable kernels.
+
+TPU-native shape of the same pipeline:
+  1. `calibrate(...)` fetches the live inputs of quantizable ops over the
+     calibration feeds (the whole-block executor can fetch ANY program
+     var, so no observer hooks are needed) and records abs-max scales;
+     parameter scales come straight from the scope values.
+  2. `apply_ptq(...)` inserts `quantize` → `dequantize` pairs (the
+     mkldnn-quantizer wire ops registered in ops/interop_tail_ops.py)
+     before each quantizable op input: values round-trip through real
+     int8 with the calibrated scale, so the ACCURACY behavior of int8
+     inference is exact while XLA keeps fusing the dequantized graph.
+
+Scale rule (abs_max, mkldnn_quantizer.cc's default for non-signed-aware
+tensors): scale = 127 / max|x|, symmetric, per tensor.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["PTQConfig", "calibrate", "apply_ptq", "quantize_post_training"]
+
+# fc included: the predictor's fc_fuse pass rewrites mul(+add) into fc
+# BEFORE quantization runs, exactly like the reference's pass order
+QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul", "matmul", "fc")
+
+
+class PTQConfig:
+    """Reference MkldnnQuantizerConfig: which ops, how many warmup
+    batches, and the calibration feeds."""
+
+    def __init__(self, calibration_feeds=None, quantizable_ops=QUANTIZABLE,
+                 batch_num=None):
+        self.calibration_feeds = list(calibration_feeds or [])
+        self.quantizable_ops = tuple(quantizable_ops)
+        self.batch_num = batch_num  # None = all feeds
+
+    # reference-style setters
+    def set_quant_batch_num(self, n):
+        self.batch_num = int(n)
+
+    def set_calibration_data(self, feeds):
+        self.calibration_feeds = list(feeds)
+
+
+def _quant_input_names(program, quantizable_ops):
+    """Float input var names of quantizable ops, split into
+    (activations, params) by persistable flag."""
+    block = program.global_block()
+    acts, params = [], []
+    for op in block.ops:
+        if op.type not in quantizable_ops:
+            continue
+        for n in op.input_arg_names:
+            v = block._find_var_recursive(n)
+            if v is None or v.dtype not in ("float32", "float64", None):
+                continue
+            (params if v.persistable else acts).append(n)
+    return list(dict.fromkeys(acts)), list(dict.fromkeys(params))
+
+
+def calibrate(exe, program, config: PTQConfig, scope=None):
+    """Run the calibration feeds, returning {var name: abs_max} for every
+    quantizable-op input (activations measured over the feeds, params read
+    from the scope)."""
+    from ..executor import global_scope
+
+    scope = scope or global_scope()
+    acts, params = _quant_input_names(program, config.quantizable_ops)
+    feeds = config.calibration_feeds
+    if config.batch_num is not None:
+        feeds = feeds[: config.batch_num]
+    if acts and not feeds:
+        raise ValueError("PTQ calibration needs calibration_feeds")
+    scales = {}
+    for feed in feeds:
+        vals = exe.run(program, feed=feed, fetch_list=list(acts),
+                       scope=scope)
+        for n, v in zip(acts, vals):
+            m = float(np.max(np.abs(np.asarray(v))))
+            scales[n] = max(scales.get(n, 0.0), m)
+    for n in params:
+        v = scope.get(n)
+        if v is not None:
+            scales[n] = float(np.max(np.abs(np.asarray(v))))
+    return scales
+
+
+def apply_ptq(program, scales, quantizable_ops=QUANTIZABLE):
+    """Insert quantize→dequantize pairs before every quantizable-op float
+    input with a calibrated scale.  Returns the number of rewired inputs."""
+    block = program.global_block()
+    rewired = 0
+    i = 0
+    done_for_op = set()  # (op id, input name): rewire once per use
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type not in quantizable_ops:
+            i += 1
+            continue
+        for slot, names in list(op.inputs.items()):
+            for j, n in enumerate(names):
+                amax = scales.get(n)
+                if not amax or (id(op), n) in done_for_op:
+                    continue
+                v = block._find_var_recursive(n)
+                if v is None:
+                    continue
+                scale = 127.0 / amax
+                qname = f"{n}@PTQ_INT8"
+                dqname = f"{n}@PTQ_DQ"
+                if not block.has_var(qname):
+                    block.create_var(name=qname, shape=v.shape,
+                                     dtype="int8", stop_gradient=True)
+                    block.create_var(name=dqname, shape=v.shape,
+                                     dtype=v.dtype or "float32",
+                                     stop_gradient=True)
+                    block._insert_op(i, "quantize", inputs={"Input": [n]},
+                                     outputs={"Output": [qname]},
+                                     attrs={"Scale": scale,
+                                            "is_negative_input": True})
+                    block._insert_op(i + 1, "dequantize",
+                                     inputs={"Input": [qname]},
+                                     outputs={"Output": [dqname]},
+                                     attrs={"Scale": scale})
+                    i += 2
+                op.inputs[slot] = [dqname if x == n else x
+                                   for x in op.inputs[slot]]
+                done_for_op.add((id(op), n))
+                rewired += 1
+        i += 1
+    program._bump_version()
+    return rewired
+
+
+def quantize_post_training(exe, program, config: PTQConfig, scope=None):
+    """calibrate + apply in one step (the AnalysisPredictor entry point).
+    Returns (scales, rewired_count)."""
+    scales = calibrate(exe, program, config, scope=scope)
+    n = apply_ptq(program, scales, config.quantizable_ops)
+    return scales, n

@@ -59,6 +59,8 @@ class AnalysisConfig:
         self._use_tpu = True
         self._ir_optim = True
         self._enable_memory_optim = False
+        self._quantizer_enabled = False
+        self._quantizer_config = None
 
     def set_model(self, model_dir, params_file=None):
         if params_file is None:
@@ -88,6 +90,37 @@ class AnalysisConfig:
 
     def switch_specify_input_names(self, x=True):
         pass
+
+    # -- post-training int8 quantization (reference EnableMkldnnQuantizer,
+    #    inference/api/mkldnn_quantizer.cc) ------------------------------
+    def enable_quantizer(self):
+        """Calibrate on warmup data at predictor build, then run the
+        int8-QDQ rewritten program (fluid/contrib/ptq.py)."""
+        from paddle_tpu.fluid.contrib.ptq import PTQConfig
+
+        self._quantizer_enabled = True
+        if self._quantizer_config is None:
+            self._quantizer_config = PTQConfig()
+        return self._quantizer_config
+
+    # reference spelling
+    enable_mkldnn_quantizer = enable_quantizer
+
+    def quantizer_enabled(self):
+        return self._quantizer_enabled
+
+    mkldnn_quantizer_enabled = quantizer_enabled
+
+    def quantizer_config(self):
+        """Pure accessor (the reference's mkldnn_quantizer_config never
+        enables quantization as a side effect)."""
+        from paddle_tpu.fluid.contrib.ptq import PTQConfig
+
+        if self._quantizer_config is None:
+            self._quantizer_config = PTQConfig()
+        return self._quantizer_config
+
+    mkldnn_quantizer_config = quantizer_config
 
 
 class ZeroCopyTensor:
@@ -157,6 +190,13 @@ class AnalysisPredictor:
             from paddle_tpu.fluid import ir
 
             ir.apply_pass(prog, "fc_fuse_pass", keep_vars=fetch_names)
+        if config._quantizer_enabled:
+            from paddle_tpu.fluid.contrib.ptq import quantize_post_training
+
+            with scope_guard(self._scope):
+                self._ptq_scales, self._ptq_rewired = \
+                    quantize_post_training(self._exe, prog,
+                                           config._quantizer_config)
         self._program = prog
         self._feed_names = list(feeds)
         self._fetch_vars = fetches

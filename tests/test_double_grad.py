@@ -146,3 +146,22 @@ def test_wgan_gp_gradient_penalty_trains():
     assert all(np.isfinite(losses))
     # the critic learns to separate real from fake: loss falls
     assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_gradients_target_gradients_seed():
+    """fluid.gradients(..., target_gradients=w) seeds the vjp with w
+    (reference semantics), not all-ones."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[3], dtype="float32")
+        x.stop_gradient = False
+        y = layers.scale(x, scale=2.0)          # dy/dx = 2
+        w = layers.data(name="w", shape=[3], dtype="float32")
+        (dx,) = fluid.gradients(y, x, target_gradients=[w])
+    xv = np.ones((2, 3), "float32")
+    wv = np.arange(6, dtype="float32").reshape(2, 3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"x": xv, "w": wv}, fetch_list=[dx])
+    np.testing.assert_allclose(np.asarray(g), 2.0 * wv)

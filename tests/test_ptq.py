@@ -1,0 +1,125 @@
+"""Post-training int8 quantization (VERDICT r3 item 8 — reference
+inference/api/mkldnn_quantizer.cc): calibrate on warmup batches, rewrite
+with quantize/dequantize pairs, and hold accuracy within a small delta of
+fp32 on a trained CNN."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.contrib import ptq
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+
+def _dataset(n, seed):
+    """4-class separable 1x8x8 images: a bright quadrant marks the class."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 1, 8, 8).astype("float32") * 0.4
+    y = rng.randint(0, 4, (n, 1))
+    for i, c in enumerate(y[:, 0]):
+        r, cc = divmod(int(c), 2)
+        x[i, 0, r * 4:(r + 1) * 4, cc * 4:(cc + 1) * 4] += 1.0
+    return x, y.astype("int64")
+
+
+def _build_cnn():
+    img = layers.data(name="img", shape=[1, 8, 8], dtype="float32")
+    lbl = layers.data(name="lbl", shape=[1], dtype="int64")
+    c = layers.conv2d(img, num_filters=6, filter_size=3, padding=1,
+                      act="relu")
+    p = layers.pool2d(c, pool_size=2, pool_type="max", pool_stride=2)
+    fcin = layers.reshape(p, shape=[-1, 6 * 4 * 4])
+    h = layers.fc(fcin, size=24, act="relu")
+    logits = layers.fc(h, size=4)
+    prob = layers.softmax(logits)
+    loss = layers.mean(layers.cross_entropy(prob, lbl))
+    return img, lbl, prob, loss
+
+
+@pytest.fixture(scope="module")
+def trained_model(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("ptq_model"))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        img, lbl, prob, loss = _build_cnn()
+        fluid.optimizer.Adam(learning_rate=3e-3).minimize(loss)
+    xtr, ytr = _dataset(512, seed=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for ep in range(6):
+            for i in range(0, len(xtr), 64):
+                exe.run(main, feed={"img": xtr[i:i + 64],
+                                    "lbl": ytr[i:i + 64]},
+                        fetch_list=[loss])
+        fluid.io.save_inference_model(d, ["img"], [prob], exe,
+                                      main_program=main)
+    return d
+
+
+def _accuracy(pred, x, y):
+    names = pred.get_input_names()
+    inp = pred.get_input_tensor(names[0])
+    out = pred.get_output_tensor(pred.get_output_names()[0])
+    hits = 0
+    for i in range(0, len(x), 64):
+        inp.copy_from_cpu(x[i:i + 64])
+        pred.zero_copy_run()
+        probs = out.copy_to_cpu()
+        hits += int((probs.argmax(1) == y[i:i + 64, 0]).sum())
+    return hits / len(x)
+
+
+def test_ptq_accuracy_delta_vs_fp32(trained_model):
+    xte, yte = _dataset(256, seed=9)
+    xcal, _ = _dataset(64, seed=5)
+
+    cfg32 = AnalysisConfig(trained_model)
+    cfg32.disable_gpu()
+    p32 = create_paddle_predictor(cfg32)
+    acc32 = _accuracy(p32, xte, yte)
+    assert acc32 > 0.9, f"fp32 model under-trained: {acc32}"
+
+    cfg8 = AnalysisConfig(trained_model)
+    cfg8.disable_gpu()
+    qcfg = cfg8.enable_mkldnn_quantizer()
+    qcfg.set_calibration_data(
+        [{"img": xcal[i:i + 16]} for i in range(0, len(xcal), 16)])
+    p8 = create_paddle_predictor(cfg8)
+    assert p8._ptq_rewired > 0  # conv + fc inputs actually rewired
+    # the program now runs through real int8 round-trips
+    types = [op.type for op in p8.program().global_block().ops]
+    assert "quantize" in types and "dequantize" in types
+    acc8 = _accuracy(p8, xte, yte)
+    assert acc8 >= acc32 - 0.03, (acc32, acc8)
+
+
+def test_ptq_scales_are_abs_max():
+    """calibrate() records per-tensor abs-max over the calibration set and
+    reads parameter scales from the scope."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=3, param_attr="ptq_w", bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        w = np.asarray(fluid.global_scope().get("ptq_w"))
+        feeds = [{"x": np.full((2, 4), 3.0, "float32")},
+                 {"x": np.full((2, 4), -7.0, "float32")}]
+        cfg = ptq.PTQConfig(calibration_feeds=feeds)
+        scales = ptq.calibrate(exe, main, cfg)
+    assert scales["x"] == 7.0
+    np.testing.assert_allclose(scales["ptq_w"], np.abs(w).max())
+
+
+def test_quantizer_config_accessor_does_not_enable():
+    cfg = AnalysisConfig("unused")
+    cfg.mkldnn_quantizer_config()
+    assert not cfg.quantizer_enabled()
+    cfg.enable_mkldnn_quantizer()
+    assert cfg.quantizer_enabled()

@@ -299,3 +299,67 @@ def test_array_write_past_capacity_clamps_length():
     out_len, out_last = _run(main, startup, {"x": xv}, [ln, last])
     assert int(out_len[0]) == 2
     np.testing.assert_allclose(out_last, xv * 3)  # clamped write won
+
+
+def test_contrib_beam_search_decoder_decode():
+    """contrib.BeamSearchDecoder.decode (a raising stub through r3) builds
+    and runs the full array-based decode loop."""
+    from paddle_tpu.fluid.contrib.decoder import (
+        BeamSearchDecoder, InitState, StateCell)
+
+    batch, beam, vocab, hidden, max_len = 2, 3, 9, 6, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        src = layers.data(name="src", shape=[hidden], dtype="float32")
+        init_ids = layers.data(name="init_ids", shape=[beam],
+                               dtype="int64")
+        init_scores = layers.data(name="init_scores", shape=[beam],
+                                  dtype="float32")
+        h0 = layers.tanh(layers.fc(src, size=hidden, name="bsd_enc"))
+        cell = StateCell(inputs={}, states={"h": InitState(init=h0)},
+                         out_state="h")
+        dec = BeamSearchDecoder(cell, init_ids=init_ids,
+                                init_scores=init_scores, beam_size=beam,
+                                end_id=8)
+
+        def step(pre_ids, states):
+            h = layers.tanh(layers.fc(states["h"], size=hidden,
+                                      name="bsd_cell"))
+            logits = layers.fc(h, size=vocab, name="bsd_out")
+            logp = layers.log(layers.softmax(logits))
+            lp3 = layers.expand(layers.unsqueeze(logp, axes=[1]),
+                                expand_times=[1, beam, 1])
+            return lp3, {"h": h}
+
+        sent, scores = dec.decode(step_fn=step, max_len=max_len)
+    rng = np.random.RandomState(5)
+    feed = {"src": rng.randn(batch, hidden).astype("float32"),
+            "init_ids": np.ones((batch, beam), "int64"),
+            "init_scores": np.zeros((batch, beam), "float32")}
+    sv, cv = _run(main, startup, feed, [sent, scores])
+    assert sv.shape == (batch, beam, max_len)
+    assert np.all((sv >= 0) & (sv < vocab))
+    assert cv.shape == (batch, beam)
+    assert np.all(np.isfinite(cv))
+
+
+def test_beam_decoder_per_beam_state_follows_parent():
+    """_gather_beam_state reorders [B, K, ...] states by the selected
+    parent index (review r4: states must descend from the hypothesis
+    beam_search chose)."""
+    from paddle_tpu.fluid.contrib.decoder import _gather_beam_state
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        st = layers.data(name="st", shape=[3, 2], dtype="float32")
+        par = layers.data(name="par", shape=[3], dtype="int32")
+        out = _gather_beam_state(st, par, beam=3)
+        shared = layers.data(name="sh", shape=[5], dtype="float32")
+        passthrough = _gather_beam_state(shared, par, beam=3)
+        assert passthrough is shared  # no beam axis → untouched
+    sv = np.arange(12, dtype="float32").reshape(2, 3, 2)
+    pv = np.array([[2, 0, 0], [1, 1, 2]], "int32")
+    (got,) = _run(main, startup, {"st": sv, "par": pv,
+                                  "sh": np.zeros((2, 5), "float32")}, [out])
+    expect = np.stack([sv[b][pv[b]] for b in range(2)])
+    np.testing.assert_allclose(got, expect)

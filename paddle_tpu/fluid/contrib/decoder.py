@@ -112,15 +112,22 @@ class TrainingDecoder:
         return self._drnn()
 
 
-def _gather_beam_state(state, parent, beam):
+def _gather_beam_state(state, parent, beam, need_reorder):
     """Reorder a PER-BEAM state [B, K, ...] by the selected parent index
     [B, K] so beam k's state descends from the hypothesis beam_search
     actually chose (the book test_machine_translation pattern, done with a
-    one-hot contraction — static shapes, no gather scatter).  States
-    without a beam axis ([B, ...] shared across beams) pass through."""
+    one-hot contraction — static shapes, no gather scatter).
+
+    Opt-in via InitState(need_reorder=True) — that is exactly what the
+    reference flag means; a shape heuristic would mis-fire on a shared
+    [B, F] state whose F happens to equal beam_size."""
+    if not need_reorder:
+        return state
     shape = state.shape
     if shape is None or len(shape) < 2 or shape[1] != beam:
-        return state
+        raise ValueError(
+            f"need_reorder state must be [batch, beam={beam}, ...] with a "
+            f"static beam axis; got shape {shape}")
     onehot = L.one_hot(L.unsqueeze(parent, axes=[2]), beam)  # [B,K,K]
     flat = L.reshape(state, shape=[0, beam, -1])             # [B,K,F]
     mixed = L.matmul(onehot, flat)                           # [B,K,F]
@@ -191,10 +198,12 @@ class BeamSearchDecoder:
             input=self._init_ids, shape=[-1, beam], dtype="int32", value=0)
         L.array_write(init_parents, counter, array=par_arr)
         state_arrs = {}
+        reorder = {}
         for name, init in self.state_cell._init_states.items():
             arr = L.create_array(init.value.dtype, capacity=cap)
             L.array_write(init.value, counter, array=arr)
             state_arrs[name] = arr
+            reorder[name] = bool(init.need_reorder)
 
         cond = L.less_than(counter, limit)
         w = L.While(cond)
@@ -212,7 +221,8 @@ class BeamSearchDecoder:
             L.array_write(parent, counter, array=par_arr)
             for n, a in state_arrs.items():
                 L.array_write(
-                    _gather_beam_state(new_states[n], parent, beam),
+                    _gather_beam_state(new_states[n], parent, beam,
+                                       reorder[n]),
                     counter, array=a)
             L.less_than(counter, limit, cond=cond)
 

@@ -98,7 +98,6 @@ def apply_ptq(program, scales, quantizable_ops=QUANTIZABLE):
     block = program.global_block()
     rewired = 0
     i = 0
-    done_for_op = set()  # (op id, input name): rewire once per use
     while i < len(block.ops):
         op = block.ops[i]
         if op.type not in quantizable_ops:
@@ -106,8 +105,12 @@ def apply_ptq(program, scales, quantizable_ops=QUANTIZABLE):
             continue
         for slot, names in list(op.inputs.items()):
             for j, n in enumerate(names):
+                # every SLOT occurrence rewires (matmul(x, x) must see
+                # both operands quantized); an already-rewired slot holds
+                # the @PTQ_DQ name, which has no scale entry, so this
+                # cannot loop
                 amax = scales.get(n)
-                if not amax or (id(op), n) in done_for_op:
+                if not amax:
                     continue
                 v = block._find_var_recursive(n)
                 if v is None:
@@ -132,7 +135,6 @@ def apply_ptq(program, scales, quantizable_ops=QUANTIZABLE):
                     i += 2
                 op.inputs[slot] = [dqname if x == n else x
                                    for x in op.inputs[slot]]
-                done_for_op.add((id(op), n))
                 rewired += 1
         i += 1
     program._bump_version()

@@ -360,7 +360,8 @@ class Operator:
     """An op desc: type + named input/output var lists + attrs
     (reference framework.py:988; proto framework.proto:43)."""
 
-    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None,
+                 skip_validate=False):
         from . import registry
 
         self.block = block
@@ -373,7 +374,11 @@ class Operator:
             self.inputs[slot] = [v.name if isinstance(v, Variable) else v for v in _as_list(vars_)]
         for slot, vars_ in (outputs or {}).items():
             self.outputs[slot] = [v.name if isinstance(v, Variable) else v for v in _as_list(vars_)]
-        if type is not None and registry.has_op(type):
+        # skip_validate: proto import of reference-signature control-flow
+        # ops (while X/Condition, conditional_block Input/Cond) — their
+        # slots are rewritten to ours post-parse, once sub-blocks exist
+        # (proto_compat._normalize_reference_control_flow)
+        if not skip_validate and type is not None and registry.has_op(type):
             registry.get_op(type).validate(self)
 
     def input(self, slot):

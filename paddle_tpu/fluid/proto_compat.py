@@ -316,8 +316,58 @@ def parse_program_bytes(data: bytes):
                     v = list(v[1])
                 attrs[a["name"]] = v
             _append_op_raw(blk, od.get("type"), ins, outs, attrs)
+    _normalize_reference_control_flow(prog)
     prog._bump_version()
     return prog
+
+
+def _normalize_reference_control_flow(prog):
+    """Rewrite reference-signature control-flow ops onto this framework's
+    explicit-dataflow slots.
+
+    The reference's while (controlflow/while_op.cc: X/Condition →
+    Out/StepScopes) and conditional_block (Input/Cond → Out/Scope) let the
+    sub-block read and write enclosing scope vars implicitly; the
+    functional XLA lowerings need every capture declared
+    (Carry/Extra/ExtraNG + name attrs).  The same capture analysis the
+    Python layer runs at build time (_analyze_sub_block) reconstructs
+    them from the imported sub-block."""
+    from .layers.control_flow import _analyze_sub_block
+
+    for blk in prog.blocks:
+        for op in blk.ops:
+            if op.attrs.get("carry_names") is not None:
+                continue  # already our signature
+            if op.type == "while":
+                sub = prog.block(op.attrs["sub_block"])
+                carries, extras, extras_ng = _analyze_sub_block(sub)
+                cond = op.inputs.get("Condition", [None])[0]
+                if cond not in carries:
+                    # same guard While.block() enforces at build time: a
+                    # body that never re-evaluates Condition would compile
+                    # into an infinite lax.while with no diagnostic
+                    raise ValueError(
+                        f"imported while op: condition var {cond!r} is "
+                        "never written in the sub-block (infinite loop)")
+                op.inputs = {"Condition": [cond], "Carry": list(carries),
+                             "Extra": extras, "ExtraNG": extras_ng}
+                op.outputs = {"Out": list(carries)}
+                op.attrs.update(carry_names=list(carries),
+                                extra_names=extras,
+                                extra_ng_names=extras_ng, cond_name=cond)
+            elif op.type in ("conditional_block",
+                             "conditional_block_infer"):
+                sub = prog.block(op.attrs["sub_block"])
+                cond_list = op.inputs.get("Cond", [])
+                carries, extras, extras_ng = _analyze_sub_block(
+                    sub, extra_exclude=set(cond_list))
+                op.inputs = {"Cond": list(cond_list),
+                             "Carry": list(carries), "Extra": extras,
+                             "ExtraNG": extras_ng}
+                op.outputs = {"Out": list(carries)}
+                op.attrs.update(carry_names=list(carries),
+                                extra_names=extras,
+                                extra_ng_names=extras_ng)
 
 
 def _append_op_raw(blk, type_, ins, outs, attrs):
@@ -336,8 +386,11 @@ def _append_op_raw(blk, type_, ins, outs, attrs):
                        for n in names]
                 for slot, names in d.items()}
 
+    skip = (type_ in ("while", "conditional_block",
+                      "conditional_block_infer")
+            and attrs.get("carry_names") is None)
     op = Operator(blk, type_, inputs=to_vars(ins), outputs=to_vars(outs),
-                  attrs=attrs)
+                  attrs=attrs, skip_validate=skip)
     blk.ops.append(op)
     return op
 

@@ -223,3 +223,65 @@ def test_run_steps_chain_on_chip():
         w_chain = np.asarray(scope2.get(w_name))
     np.testing.assert_allclose(float(chain), float(seq), rtol=1e-5)
     np.testing.assert_allclose(w_chain, w_seq, rtol=1e-5, atol=1e-6)
+
+
+def test_tensor_array_while_decode_on_chip():
+    """The LoDTensorArray while-loop machinery (r4) compiles and runs on
+    the chip: init write → loop read/compute/write → length + final read.
+    One lax.while XLA computation, fixed-capacity buffers."""
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        arr = layers.create_array("float32", capacity=6)
+        i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        layers.array_write(x, i, array=arr)
+        n = layers.fill_constant(shape=[1], dtype="int64", value=3)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            prev = layers.array_read(arr, i)
+            nxt = layers.scale(prev, scale=2.0)
+            i2 = layers.increment(i, value=1, in_place=True)
+            layers.array_write(nxt, i2, array=arr)
+            layers.less_than(i2, n, cond=cond)
+        ln = layers.array_length(arr)
+        last = layers.array_read(arr, n)
+    exe = fluid.Executor(_place())
+    xv = np.full((2, 4), 1.5, "float32")
+    with scope_guard(Scope()):
+        exe.run(startup)
+        out_len, out_last = exe.run(main, feed={"x": xv},
+                                    fetch_list=[ln, last])
+    assert int(np.asarray(out_len)[0]) == 4
+    np.testing.assert_allclose(np.asarray(out_last), xv * 8, rtol=1e-6)
+
+
+def test_double_grad_penalty_on_chip():
+    """Grad-of-grad (WGAN-GP shape) compiles and stays finite on the
+    chip — the lazily materialized *_grad_grad path under real XLA:TPU."""
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        h = layers.fc(x, size=8, act="tanh")
+        y = layers.fc(h, size=1)
+        (dx,) = fluid.gradients(y, x)
+        gp = layers.mean(layers.square(
+            layers.sqrt(layers.reduce_sum(layers.square(dx), dim=1))
+            - 1.0))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(gp)
+    exe = fluid.Executor(_place())
+    rng = np.random.RandomState(0)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for _ in range(3):
+            (g,) = exe.run(main,
+                           feed={"x": rng.randn(4, 4).astype("float32")},
+                           fetch_list=[gp])
+    assert np.isfinite(float(np.asarray(g)))

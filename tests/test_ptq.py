@@ -123,3 +123,22 @@ def test_quantizer_config_accessor_does_not_enable():
     assert not cfg.quantizer_enabled()
     cfg.enable_mkldnn_quantizer()
     assert cfg.quantizer_enabled()
+
+
+def test_ptq_rewires_every_slot_of_one_op():
+    """matmul(x, x): BOTH operands route through quantize/dequantize
+    (review r4: the dedup must be per slot, not per var)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[4, 4], dtype="float32")
+        y = layers.matmul(x, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        cfg = ptq.PTQConfig(
+            calibration_feeds=[{"x": np.ones((2, 4, 4), "float32")}])
+        scales, n = ptq.quantize_post_training(exe, main, cfg)
+    assert n == 2
+    mm = [op for op in main.global_block().ops if op.type == "matmul"][0]
+    assert mm.inputs["X"] == ["x@PTQ_DQ"]
+    assert mm.inputs["Y"] == ["x@PTQ_DQ"]

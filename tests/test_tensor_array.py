@@ -353,13 +353,141 @@ def test_beam_decoder_per_beam_state_follows_parent():
     with fluid.program_guard(main, startup):
         st = layers.data(name="st", shape=[3, 2], dtype="float32")
         par = layers.data(name="par", shape=[3], dtype="int32")
-        out = _gather_beam_state(st, par, beam=3)
-        shared = layers.data(name="sh", shape=[5], dtype="float32")
-        passthrough = _gather_beam_state(shared, par, beam=3)
-        assert passthrough is shared  # no beam axis → untouched
+        out = _gather_beam_state(st, par, beam=3, need_reorder=True)
+        shared = layers.data(name="sh", shape=[3], dtype="float32")
+        # shared state whose dim happens to equal beam: untouched unless
+        # InitState(need_reorder=True) opted in (review r4 follow-up)
+        passthrough = _gather_beam_state(shared, par, beam=3,
+                                         need_reorder=False)
+        assert passthrough is shared
     sv = np.arange(12, dtype="float32").reshape(2, 3, 2)
     pv = np.array([[2, 0, 0], [1, 1, 2]], "int32")
     (got,) = _run(main, startup, {"st": sv, "par": pv,
-                                  "sh": np.zeros((2, 5), "float32")}, [out])
+                                  "sh": np.zeros((2, 3), "float32")}, [out])
     expect = np.stack([sv[b][pv[b]] for b in range(2)])
     np.testing.assert_allclose(got, expect)
+
+
+def test_reference_signature_while_imports_and_runs():
+    """A reference-exported while op (X/Condition -> Out/StepScopes,
+    implicit captures) is normalized at proto import onto the explicit
+    Carry/Extra slots and executes."""
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data(name="x", shape=[3], dtype="float32")
+    blk = main.global_block()
+    i = blk.create_var(name="w_i", shape=(1,), dtype="int64")
+    n = blk.create_var(name="w_n", shape=(1,), dtype="int64")
+    acc = blk.create_var(name="w_acc", shape=(-1, 3), dtype="float32")
+    cond = blk.create_var(name="w_cond", shape=(1,), dtype="bool")
+    blk.append_op("fill_constant", outputs={"Out": [i]},
+                  attrs={"shape": [1], "dtype": "int64", "value": 0.0})
+    blk.append_op("fill_constant", outputs={"Out": [n]},
+                  attrs={"shape": [1], "dtype": "int64", "value": 4.0})
+    blk.append_op("fill_zeros_like", inputs={"X": [x]},
+                  outputs={"Out": [acc]})
+    blk.append_op("less_than", inputs={"X": [i], "Y": [n]},
+                  outputs={"Out": [cond]}, attrs={})
+    sub = main._create_block()
+    main._rollback()
+    sub.append_op("elementwise_add", inputs={"X": [acc], "Y": [x]},
+                  outputs={"Out": [acc]}, attrs={})
+    sub.append_op("increment", inputs={"X": [i]}, outputs={"Out": [i]},
+                  attrs={"step": 1.0})
+    sub.append_op("less_than", inputs={"X": [i], "Y": [n]},
+                  outputs={"Out": [cond]}, attrs={})
+    scopes = blk.create_var(name="w_scopes", shape=None, dtype=None)
+    # REFERENCE signature: implicit captures via X, array outs via Out
+    from paddle_tpu.fluid.framework import Operator
+
+    wop = Operator(blk, "while",
+                   inputs={"X": [x, acc, i, n], "Condition": [cond]},
+                   outputs={"Out": [acc, i, cond],
+                            "StepScopes": [scopes]},
+                   attrs={"sub_block": sub.idx, "is_test": False},
+                   skip_validate=True)
+    blk.ops.append(wop)
+
+    data = proto_compat.serialize_program(main)
+    reloaded = proto_compat.parse_program_bytes(data)
+    wop = [op for op in reloaded.global_block().ops
+           if op.type == "while"][0]
+    assert wop.attrs.get("carry_names")  # normalized at import
+    assert "w_cond" in wop.attrs["carry_names"]
+
+    xv = np.ones((2, 3), "float32") * 2.0
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        (out,) = exe.run(reloaded, feed={"x": xv}, fetch_list=["w_acc"])
+    np.testing.assert_allclose(np.asarray(out), xv * 4)  # 4 iterations
+
+
+def test_reference_signature_conditional_block_imports_and_runs():
+    """Reference conditional_block (Input/Cond -> Out/Scope, implicit
+    captures) normalizes at proto import and executes both branches."""
+    from paddle_tpu.fluid.framework import Operator
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data(name="x", shape=[3], dtype="float32")
+        flag = layers.data(name="flag", shape=[1], dtype="bool")
+    blk = main.global_block()
+    out = blk.create_var(name="cb_out", shape=(-1, 3), dtype="float32")
+    blk.append_op("fill_zeros_like", inputs={"X": [x]},
+                  outputs={"Out": [out]})
+    sub = main._create_block()
+    main._rollback()
+    sub.append_op("scale", inputs={"X": [x]}, outputs={"Out": [out]},
+                  attrs={"scale": 3.0})
+    scope_var = blk.create_var(name="cb_scope", shape=None, dtype=None)
+    cop = Operator(blk, "conditional_block",
+                   inputs={"Input": [x], "Cond": [flag]},
+                   outputs={"Out": [out], "Scope": [scope_var]},
+                   attrs={"sub_block": sub.idx,
+                          "is_scalar_condition": True},
+                   skip_validate=True)
+    blk.ops.append(cop)
+    reloaded = proto_compat.parse_program_bytes(
+        proto_compat.serialize_program(main))
+    cop2 = [op for op in reloaded.global_block().ops
+            if op.type == "conditional_block"][0]
+    assert cop2.attrs.get("carry_names") == ["cb_out"]
+    xv = np.ones((2, 3), "float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        (on,) = exe.run(reloaded, feed={"x": xv,
+                                        "flag": np.array([[True]])},
+                        fetch_list=["cb_out"])
+        (off,) = exe.run(reloaded, feed={"x": xv,
+                                         "flag": np.array([[False]])},
+                         fetch_list=["cb_out"])
+    np.testing.assert_allclose(np.asarray(on), xv * 3)
+    np.testing.assert_allclose(np.asarray(off), np.zeros_like(xv))
+
+
+def test_imported_while_without_cond_update_fails_loudly():
+    from paddle_tpu.fluid.framework import Operator
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data(name="x", shape=[2], dtype="float32")
+    blk = main.global_block()
+    cond = blk.create_var(name="c2", shape=(1,), dtype="bool")
+    acc = blk.create_var(name="acc2", shape=(-1, 2), dtype="float32")
+    blk.append_op("fill_constant", outputs={"Out": [cond]},
+                  attrs={"shape": [1], "dtype": "bool", "value": 1.0})
+    blk.append_op("fill_zeros_like", inputs={"X": [x]},
+                  outputs={"Out": [acc]})
+    sub = main._create_block()
+    main._rollback()
+    sub.append_op("elementwise_add", inputs={"X": [acc], "Y": [x]},
+                  outputs={"Out": [acc]}, attrs={})  # never updates cond
+    sc = blk.create_var(name="sc2", shape=None, dtype=None)
+    wop = Operator(blk, "while",
+                   inputs={"X": [x, acc], "Condition": [cond]},
+                   outputs={"Out": [acc], "StepScopes": [sc]},
+                   attrs={"sub_block": sub.idx}, skip_validate=True)
+    blk.ops.append(wop)
+    data = proto_compat.serialize_program(main)
+    with pytest.raises(ValueError, match="never written in the sub-block"):
+        proto_compat.parse_program_bytes(data)

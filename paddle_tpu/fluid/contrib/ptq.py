@@ -92,6 +92,60 @@ def calibrate(exe, program, config: PTQConfig, scope=None):
     return scales
 
 
+def apply_int8_compute(program, scales):
+    """Rewrite plain dense ops (mul / 2-D matmul / fc) whose BOTH matrix
+    operands carry calibrated scales into `int8_matmul` — a REAL int8
+    MXU contraction (int32 accumulation, rescale, fc epilogue), not a
+    QDQ simulation.  v5e's int8 peak is 2x bf16, so this is the
+    TPU-native serving speed path.  Ops the pattern can't express
+    (transposes, >2-D matmul broadcasting) are left for apply_ptq's QDQ
+    pass.  Returns the number of ops rewritten."""
+    from ..framework import Operator
+
+    block = program.global_block()
+    slot_map = {"mul": ("X", "Y", "x_num_col_dims"),
+                "matmul": ("X", "Y", None),
+                "fc": ("Input", "W", "in_num_col_dims")}
+    rewritten = 0
+    for i, op in enumerate(list(block.ops)):
+        spec = slot_map.get(op.type)
+        if spec is None:
+            continue
+        x_slot, w_slot, ncd_attr = spec
+        xs, ws = op.inputs.get(x_slot, []), op.inputs.get(w_slot, [])
+        if len(xs) != 1 or len(ws) != 1:
+            continue
+        if op.type == "matmul":
+            # only the plain 2-D case: transposes, batched (>2-D) X, and
+            # alpha scaling keep matmul semantics int8_matmul's
+            # flatten-to-2D contraction does not express — QDQ covers them
+            xv = block._find_var_recursive(xs[0])
+            if (op.attrs.get("transpose_X") or op.attrs.get("transpose_Y")
+                    or float(op.attrs.get("alpha", 1.0)) != 1.0
+                    or xv is None or xv.shape is None
+                    or len(xv.shape) != 2):
+                continue
+        sx, sw = scales.get(xs[0]), scales.get(ws[0])
+        if not sx or not sw:
+            continue
+        wv = block._find_var_recursive(ws[0])
+        if wv is None or wv.shape is None or len(wv.shape) != 2:
+            continue
+        attrs = {"scale_x": 127.0 / sx, "scale_y": 127.0 / sw,
+                 "in_num_col_dims": int(op.attrs.get(ncd_attr, 1))
+                 if ncd_attr else 1,
+                 "activation_type": op.attrs.get("activation_type", "")}
+        ins = {"X": list(xs), "Y": list(ws)}
+        if op.inputs.get("Bias"):
+            ins["Bias"] = list(op.inputs["Bias"])
+        block.ops[i] = Operator(block, "int8_matmul", inputs=ins,
+                                outputs={"Out": list(op.outputs["Out"])},
+                                attrs=attrs)
+        rewritten += 1
+    program._bump_version()
+    return rewritten
+
+
 def apply_ptq(program, scales, quantizable_ops=QUANTIZABLE):
     """Insert quantize→dequantize pairs before every quantizable-op float
     input with a calibrated scale.  Returns the number of rewired inputs."""
@@ -142,8 +196,11 @@ def apply_ptq(program, scales, quantizable_ops=QUANTIZABLE):
 
 
 def quantize_post_training(exe, program, config: PTQConfig, scope=None):
-    """calibrate + apply in one step (the AnalysisPredictor entry point).
-    Returns (scales, rewired_count)."""
+    """calibrate + apply in one step (the AnalysisPredictor entry point):
+    dense ops that fit the int8-compute pattern get REAL int8 MXU
+    contractions; everything else quantizable falls back to the QDQ
+    accuracy simulation.  Returns (scales, rewired_count)."""
     scales = calibrate(exe, program, config, scope=scope)
-    n = apply_ptq(program, scales, config.quantizable_ops)
+    n = apply_int8_compute(program, scales)
+    n += apply_ptq(program, scales, config.quantizable_ops)
     return scales, n

@@ -220,3 +220,35 @@ def _alias(new_type, of, **overrides):
 _alias("split_byref", "split")            # split_op.cc REGISTER: byref twin
 _alias("conditional_block_infer", "conditional_block")  # infer-mode twin
 _alias("cross_entropy_grad2", "cross_entropy2_grad")    # reference grad name
+
+
+@simple_op("int8_matmul", ["X", "Y", "Bias"], ["Out"], optional=("Bias",),
+           grad=None)
+def _int8_matmul(ctx, x, y, bias, attrs):
+    """Quantized dense layer with a REAL int8 contraction (PTQ
+    int8-compute mode, fluid/contrib/ptq.py): operands quantize to int8
+    with the calibrated scales, the dot accumulates int32 on the MXU
+    (int8 MXU peak = 2x bf16 on v5e), the int32 result rescales to fp32,
+    then the fc epilogue (bias / activation) applies — covering the
+    mul/matmul/fc shapes the PTQ rewriter targets."""
+    from .common import flatten_to_2d
+
+    sx = float(attrs["scale_x"])
+    sy = float(attrs["scale_y"])
+    ncd = int(attrs.get("in_num_col_dims", 1))
+    x2 = flatten_to_2d(x, ncd)
+    qx = jnp.clip(jnp.round(x2.astype(jnp.float32) * sx),
+                  -128, 127).astype(jnp.int8)
+    qy = jnp.clip(jnp.round(y.astype(jnp.float32) * sy),
+                  -128, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        qx, qy, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (1.0 / (sx * sy))
+    out = jnp.reshape(out, tuple(jnp.shape(x)[:ncd]) + (jnp.shape(y)[1],))
+    if bias is not None:
+        out = out + bias
+    act = attrs.get("activation_type", "")
+    if act == "relu":
+        out = jnp.maximum(out, 0)
+    return out

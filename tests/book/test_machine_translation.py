@@ -218,3 +218,95 @@ def test_machine_translation_beam_decode(tmp_path):
     mask = feed0["mask"] > 0
     acc = (sent[:, 0, :] == trg_next)[mask].mean()
     assert acc > 0.35, acc  # chance ≈ 1/61
+
+
+def build_decode_while():
+    """The SAME decode as a While loop over tensor arrays — the reference
+    book's actual construction (test_machine_translation.py:87-158:
+    create_array/array_write/While/beam_search) on the fixed-capacity
+    dense encoding.  Must be token-identical to the unrolled build."""
+    L = fluid.layers
+    src = L.data(name="src", shape=[SRC_LEN], dtype="int64")
+    enc_last = _encoder(src)                            # [B,H]
+    h0 = L.stack([enc_last] * BEAM, axis=1)             # [B,K,H]
+    pre_ids0 = L.fill_constant_batch_size_like(
+        src, shape=[-1, BEAM], dtype="int64", value=BOS)
+    init_bias = np.zeros((1, BEAM), "float32")
+    init_bias[0, 1:] = -1e9
+    pre_scores0 = L.fill_constant_batch_size_like(
+        src, shape=[-1, BEAM], dtype="float32", value=0.0) \
+        + L.assign(init_bias)
+
+    counter = L.fill_constant(shape=[1], dtype="int64", value=0)
+    limit = L.fill_constant(shape=[1], dtype="int64", value=TRG_LEN)
+    cap = TRG_LEN + 1
+    ids_arr = L.create_array("int64", capacity=cap)
+    sc_arr = L.create_array("float32", capacity=cap)
+    par_arr = L.create_array("int32", capacity=cap)
+    st_arr = L.create_array("float32", capacity=cap)
+    L.array_write(pre_ids0, counter, array=ids_arr)
+    L.array_write(pre_scores0, counter, array=sc_arr)
+    L.array_write(L.fill_constant_batch_size_like(
+        src, shape=[-1, BEAM], dtype="int32", value=0), counter,
+        array=par_arr)
+    L.array_write(h0, counter, array=st_arr)
+
+    cond = L.less_than(counter, limit)
+    w = L.While(cond)
+    with w.block():
+        pre_ids = L.array_read(ids_arr, counter)
+        pre_scores = L.array_read(sc_arr, counter)
+        h = L.array_read(st_arr, counter)               # [B,K,H]
+        emb = L.embedding(pre_ids, size=[DICT, EMB],
+                          param_attr=fluid.ParamAttr(name="trg_emb_w"))
+        emb2 = L.reshape(emb, shape=[-1, EMB])
+        h2 = L.reshape(h, shape=[-1, HID])
+        h_new = _gru_cell(emb2, h2, HID, "dec")
+        logits = L.fc(input=h_new, size=DICT,
+                      param_attr=fluid.ParamAttr(name="out_w"),
+                      bias_attr=fluid.ParamAttr(name="out_b"))
+        logp3 = L.reshape(L.log_softmax(logits), shape=[-1, BEAM, DICT])
+        ids, scores, parent = L.beam_search(
+            pre_ids, pre_scores, logp3, beam_size=BEAM, end_id=EOS)
+        onehot = L.one_hot(parent, BEAM)
+        h3 = L.reshape(h_new, shape=[-1, BEAM, HID])
+        h_sel = L.matmul(onehot, h3)
+        L.increment(counter, value=1, in_place=True)
+        L.array_write(ids, counter, array=ids_arr)
+        L.array_write(scores, counter, array=sc_arr)
+        L.array_write(L.cast(parent, "int32"), counter, array=par_arr)
+        L.array_write(h_sel, counter, array=st_arr)
+        L.less_than(counter, limit, cond=cond)
+
+    ids_stacked, _ = L.tensor_array_to_tensor(ids_arr, axis=0,
+                                              use_stack=True)
+    par_stacked, _ = L.tensor_array_to_tensor(par_arr, axis=0,
+                                              use_stack=True)
+    ids_t = L.slice(ids_stacked, axes=[0], starts=[1], ends=[cap])
+    parents_t = L.slice(par_stacked, axes=[0], starts=[1], ends=[cap])
+    sent = L.beam_search_decode(ids_t, parents_t, end_id=EOS)
+    final_scores = L.array_read(sc_arr, limit)
+    return src, sent, final_scores
+
+
+def test_machine_translation_while_array_decode_matches_unrolled(tmp_path):
+    """The While+tensor-array decode (the reference book construction)
+    produces TOKEN-IDENTICAL output to the unrolled static decode with the
+    same trained parameters — the two compiled control-flow styles agree
+    exactly."""
+    t = _train(tmp_path)
+    feed0 = t["feed0"]
+    outs = {}
+    for tag, builder in (("unrolled", build_decode),
+                         ("while", build_decode_while)):
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start), fluid.unique_name.guard():
+            src_v, sent_v, scores_v = builder()
+        with fluid.scope_guard(t["scope"]):
+            exe = fluid.Executor(fluid.CPUPlace())
+            sent, scores = exe.run(prog, feed={"src": feed0["src"]},
+                                   fetch_list=[sent_v.name, scores_v.name])
+        outs[tag] = (np.asarray(sent), np.asarray(scores))
+    np.testing.assert_array_equal(outs["unrolled"][0], outs["while"][0])
+    np.testing.assert_allclose(outs["unrolled"][1], outs["while"][1],
+                               rtol=1e-5, atol=1e-6)

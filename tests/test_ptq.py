@@ -142,3 +142,69 @@ def test_ptq_rewires_every_slot_of_one_op():
     mm = [op for op in main.global_block().ops if op.type == "matmul"][0]
     assert mm.inputs["X"] == ["x@PTQ_DQ"]
     assert mm.inputs["Y"] == ["x@PTQ_DQ"]
+
+
+def test_int8_compute_matches_fp32_within_quant_error():
+    """apply_int8_compute rewrites fc/mul into a REAL int8 contraction
+    (int32 accumulate + rescale); result tracks fp32 within the expected
+    8-bit error and the program genuinely carries int8_matmul ops."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[6], dtype="float32")
+        h = layers.fc(x, size=8, act="relu", param_attr="i8_w1",
+                      bias_attr="i8_b1")
+        out = layers.fc(h, size=3, param_attr="i8_w2", bias_attr="i8_b2")
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 6).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        (base,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        base = np.asarray(base).copy()
+        from paddle_tpu.fluid import ir
+        ir.apply_pass(main, "fc_fuse_pass", keep_vars=[out.name])
+        cfg = ptq.PTQConfig(calibration_feeds=[{"x": xv}])
+        scales = ptq.calibrate(exe, main, cfg)
+        n = ptq.apply_int8_compute(main, scales)
+        assert n >= 2  # both fc layers
+        types = [op.type for op in main.global_block().ops]
+        assert "int8_matmul" in types
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[out.name])
+    err = np.abs(np.asarray(got) - base).max()
+    scale = np.abs(base).max()
+    assert err < 0.05 * scale + 0.05, (err, scale)
+
+
+def test_int8_compute_skips_batched_and_alpha_matmul():
+    """Batched X and alpha-scaled matmuls stay on the QDQ path (their
+    semantics don't fit the flatten-to-2D int8 contraction)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        h = layers.data(name="h", shape=[5, 4], dtype="float32")  # [B,T,H]
+        w = layers.data(name="w", shape=[4, 3], dtype="float32",
+                        append_batch_size=False)
+        x2 = layers.data(name="x2", shape=[4], dtype="float32")
+        y_batched = layers.matmul(h, w)
+        y_alpha = layers.matmul(x2, w, alpha=0.125)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        hv = np.ones((2, 5, 4), "float32")
+        wv = np.ones((4, 3), "float32")
+        xv = np.ones((2, 4), "float32")
+        cfg = ptq.PTQConfig(
+            calibration_feeds=[{"h": hv, "w": wv, "x2": xv}])
+        scales = ptq.calibrate(exe, main, cfg)
+        n = ptq.apply_int8_compute(main, scales)
+        assert n == 0  # neither pattern rewritten
+        # the QDQ pass still quantizes them
+        nq = ptq.apply_ptq(main, scales)
+        assert nq > 0
+        base_b = hv @ wv
+        base_a = 0.125 * (xv @ wv)
+        got_b, got_a = exe.run(main, feed={"h": hv, "w": wv, "x2": xv},
+                               fetch_list=[y_batched, y_alpha])
+    np.testing.assert_allclose(np.asarray(got_b), base_b, rtol=0.05,
+                               atol=0.05)
+    np.testing.assert_allclose(np.asarray(got_a), base_a, rtol=0.05,
+                               atol=0.05)

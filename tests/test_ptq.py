@@ -208,3 +208,39 @@ def test_int8_compute_skips_batched_and_alpha_matmul():
                                atol=0.05)
     np.testing.assert_allclose(np.asarray(got_a), base_a, rtol=0.05,
                                atol=0.05)
+
+
+def test_quantized_program_protobuf_roundtrip():
+    """A PTQ'd program (int8_matmul + quantize/dequantize ops) survives
+    protobuf serialization — the int8 serving artifact is portable."""
+    from paddle_tpu.fluid import proto_compat
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[6], dtype="float32")
+        h = layers.fc(x, size=8, act="relu", param_attr="qr_w1",
+                      bias_attr="qr_b1")
+        out = layers.fc(h, size=3, param_attr="qr_w2", bias_attr="qr_b2")
+        c = layers.conv2d(layers.reshape(x, shape=[-1, 1, 2, 3]),
+                          num_filters=2, filter_size=1, param_attr="qr_cw")
+        out2 = layers.reduce_mean(c)
+    rng = np.random.RandomState(1)
+    xv = rng.randn(16, 6).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        from paddle_tpu.fluid import ir
+        ir.apply_pass(main, "fc_fuse_pass", keep_vars=[out.name, out2.name])
+        cfg = ptq.PTQConfig(calibration_feeds=[{"x": xv}])
+        scales, n = ptq.quantize_post_training(exe, main, cfg)
+        assert n > 0
+        base, base2 = [np.asarray(v) for v in
+                       exe.run(main, feed={"x": xv},
+                               fetch_list=[out.name, out2.name])]
+        reloaded = proto_compat.parse_program_bytes(
+            proto_compat.serialize_program(main))
+        got, got2 = [np.asarray(v) for v in
+                     exe.run(reloaded, feed={"x": xv},
+                             fetch_list=[out.name, out2.name])]
+    np.testing.assert_allclose(got, base, rtol=1e-6)
+    np.testing.assert_allclose(got2, base2, rtol=1e-6)

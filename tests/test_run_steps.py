@@ -190,3 +190,38 @@ def test_run_steps_visible_to_compiled_for():
         cost = chains[0].cost_analysis(fluid.global_scope(),
                                        exe._coerce_feed(main, feed))
         assert cost["cost"].get("flops", 0) > 0
+
+
+def test_run_steps_matches_sequential_under_bf16_policy():
+    """The chained dispatch × the bf16 dtype policy (the on-chip
+    bf16_chain32 leg's correctness counterpart): identical final params
+    and loss vs per-step runs — bit-for-bit, since both paths trace the
+    same policy-applied lowerings with the same step numbering."""
+    from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+    results = {}
+    for tag in ("seq", "chain"):
+        main, startup, loss = _build(with_dropout=True)
+        mp.enable_bf16_policy(main)
+        feed = _feed(np.random.RandomState(0))
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            if tag == "seq":
+                for _ in range(4):
+                    (last,) = exe.run(main, feed=feed, fetch_list=[loss])
+            else:
+                (last,) = exe.run_steps(main, feed=feed, n_steps=4,
+                                        fetch_list=[loss])
+            results[tag] = (float(np.asarray(last)),
+                            _params(fluid.global_scope(), main))
+    assert (results["seq"][1].keys() == results["chain"][1].keys()
+            and results["seq"][1])
+    for name in results["seq"][1]:
+        # semantic identity at the sibling fp32 test's tolerance — the
+        # chain and per-step paths are separate XLA compilations
+        np.testing.assert_allclose(results["seq"][1][name],
+                                   results["chain"][1][name],
+                                   rtol=1e-6, atol=1e-7, err_msg=name)
+    np.testing.assert_allclose(results["seq"][0], results["chain"][0],
+                               rtol=1e-6)

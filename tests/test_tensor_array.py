@@ -491,3 +491,22 @@ def test_imported_while_without_cond_update_fails_loudly():
     data = proto_compat.serialize_program(main)
     with pytest.raises(ValueError, match="never written in the sub-block"):
         proto_compat.parse_program_bytes(data)
+
+
+def test_array_beam_decoder_under_bf16_policy():
+    """Tensor-array while carries × the bf16 dtype policy: the buffer is
+    created bf16 (policy-cast first write), loop writes cast to the buffer
+    dtype, and the decode still produces valid tokens."""
+    from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+    batch, beam, vocab, hidden, max_len = 2, 3, 11, 8, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        sent, scores = _build_array_beam_decoder(
+            batch, beam, vocab, hidden, max_len, end_id=10)
+    mp.enable_bf16_policy(main)
+    sv, sc = _run(main, startup, _decoder_feed(batch, beam, hidden),
+                  [sent, scores])
+    assert sv.shape == (batch, beam, max_len)
+    assert np.all((sv >= 0) & (sv < vocab))
+    assert np.all(np.isfinite(sc.astype(np.float32)))

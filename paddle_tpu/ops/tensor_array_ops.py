@@ -28,7 +28,6 @@ Deviations from the reference (documented in PARITY.md):
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -1,0 +1,113 @@
+"""A/B the PTQ int8-compute serving path against bf16/fp32 on one chip.
+
+Builds a dense MLP classifier (the shape the int8_matmul rewrite covers),
+then times three predictor variants over identical batches:
+  fp32      — the baseline program
+  bf16      — the bf16 dtype policy
+  int8      — calibrate + apply_int8_compute (REAL int8 MXU contraction)
+
+v5e peak: 394 int8 TOPS vs 197 bf16 TFLOP/s — a dense-bound graph has 2×
+dot headroom.  Prints one JSON line per variant.
+
+  PYTHONPATH=/root/repo:/root/.axon_site python tools/bench_int8_serve.py
+  (JAX_PLATFORMS=cpu for a machinery test; numbers then mean nothing)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import layers  # noqa: E402
+from paddle_tpu.fluid.contrib import ptq  # noqa: E402
+from paddle_tpu.fluid.executor import Scope, scope_guard  # noqa: E402
+
+BATCH = int(os.environ.get("PT_I8_BATCH", "256"))
+DIN = int(os.environ.get("PT_I8_DIN", "1024"))
+HID = int(os.environ.get("PT_I8_HID", "4096"))
+LAYERS = int(os.environ.get("PT_I8_LAYERS", "8"))
+STEPS = int(os.environ.get("PT_I8_STEPS", "30"))
+
+
+def _build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[DIN], dtype="float32")
+        h = x
+        for i in range(LAYERS):
+            h = layers.fc(h, size=HID if i < LAYERS - 1 else DIN,
+                          act="relu", param_attr=f"i8b_w{i}",
+                          bias_attr=f"i8b_b{i}")
+        out = layers.fc(h, size=16, param_attr="i8b_out_w",
+                        bias_attr="i8b_out_b")
+    return main, startup, out
+
+
+def _flops():
+    # layer widths mirror _build(): DIN → HID×(LAYERS−1) → DIN → 16
+    widths = [DIN] + [HID] * (LAYERS - 1) + [DIN, 16]
+    per = sum(a * b for a, b in zip(widths, widths[1:]))
+    return 2.0 * BATCH * per
+
+
+def _time(exe, prog, feed, fetch):
+    import jax
+
+    # return_numpy=False keeps fetches as device arrays so the loop
+    # dispatches asynchronously; one block at the end drains the chain
+    outs = exe.run(prog, feed=feed, fetch_list=fetch,
+                   return_numpy=False)                  # compile + warm
+    jax.block_until_ready(outs)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        outs = exe.run(prog, feed=feed, fetch_list=fetch,
+                       return_numpy=False)
+    jax.block_until_ready(outs)
+    return (time.perf_counter() - t0) / STEPS
+
+
+def main():
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(BATCH, DIN).astype("float32")}
+    results = {}
+    for tag in ("fp32", "bf16", "int8"):
+        main_p, startup, out = _build()
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            exe.run(startup)
+            if tag == "bf16":
+                from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+                mp.enable_bf16_policy(main_p)
+            elif tag == "int8":
+                from paddle_tpu.fluid import ir
+
+                ir.apply_pass(main_p, "fc_fuse_pass", keep_vars=[out.name])
+                cfg = ptq.PTQConfig(calibration_feeds=[feed])
+                scales = ptq.calibrate(exe, main_p, cfg)
+                n = ptq.apply_int8_compute(main_p, scales)
+                assert n >= LAYERS, f"only {n} layers rewrote to int8"
+            dt = _time(exe, main_p, feed, [out.name])
+        results[tag] = dt
+        print(json.dumps({
+            "metric": "dense_serve_tflops", "variant": tag,
+            "value": round(_flops() / dt / 1e12, 2), "unit": "TFLOP/s",
+            "ms_per_batch": round(dt * 1e3, 3),
+            "config": f"mlp d{DIN} h{HID} x{LAYERS} b{BATCH}",
+        }), flush=True)
+    if "bf16" in results and "int8" in results:
+        print(json.dumps({
+            "metric": "int8_speedup_vs_bf16",
+            "value": round(results["bf16"] / results["int8"], 3),
+            "unit": "x"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

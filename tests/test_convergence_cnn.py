@@ -25,7 +25,9 @@ def make_quadrant_blobs(n, size=16, seed=0):
     return x, y[:, None].astype("int64")
 
 
-def test_cnn_learns_quadrant_task():
+def _build_quadrant_cnn():
+    """Shared conv/bn/pool/fc quadrant classifier; returns
+    (main, startup, test_prog, loss, acc)."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
         img = fluid.data("img", [-1, 1, 16, 16], False, dtype="float32")
@@ -43,10 +45,12 @@ def test_cnn_learns_quadrant_task():
         acc = fluid.layers.accuracy(prob, lbl)
         test_prog = main.clone(for_test=True)
         fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+    return main, startup, test_prog, loss, acc
 
+
+def _train_and_eval(main, startup, test_prog, loss, acc, scope):
     x_train, y_train = make_quadrant_blobs(1024, seed=1)
     x_test, y_test = make_quadrant_blobs(256, seed=2)
-    scope = Scope()
     with scope_guard(scope):
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)
@@ -54,8 +58,36 @@ def test_cnn_learns_quadrant_task():
             perm = np.random.RandomState(epoch).permutation(len(x_train))
             for i in range(0, len(x_train), 64):
                 idx = perm[i:i + 64]
-                exe.run(main, feed={"img": x_train[idx], "lbl": y_train[idx]},
+                exe.run(main, feed={"img": x_train[idx],
+                                    "lbl": y_train[idx]},
                         fetch_list=[loss])
-        a, = exe.run(test_prog, feed={"img": x_test, "lbl": y_test},
-                     fetch_list=[acc])
-    assert float(a) > 0.9, float(a)  # real generalization, not loss wiggle
+        (a,) = exe.run(test_prog, feed={"img": x_test, "lbl": y_test},
+                       fetch_list=[acc])
+    return float(np.asarray(a))
+
+
+def test_cnn_learns_quadrant_task():
+    main, startup, test_prog, loss, acc = _build_quadrant_cnn()
+    a = _train_and_eval(main, startup, test_prog, loss, acc, Scope())
+    assert a > 0.9, a  # real generalization, not loss wiggle
+
+
+def test_cnn_learns_quadrant_task_bf16_policy():
+    """The same convnet under the bf16 dtype policy (the resnet50 on-chip
+    leg's dtype configuration): conv + BN (fp32 running stats, bf16
+    activations) + pools must still generalize >0.9 held-out — pins the
+    r4 BN keep-fp32 stat masks at convergence scale, not just one step."""
+    from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+    main, startup, test_prog, loss, acc = _build_quadrant_cnn()
+    mp.enable_bf16_policy(main)
+    mp.enable_bf16_policy(test_prog)
+    scope = Scope()
+    a = _train_and_eval(main, startup, test_prog, loss, acc, scope)
+    # BN running stats stayed fp32 masters through bf16 training
+    stat_names = [n for n in scope.keys()
+                  if n.endswith(".mean") or n.endswith(".var")]
+    assert stat_names, "no BN moving-stat vars found in scope"
+    for name in stat_names:
+        assert np.asarray(scope.get(name)).dtype == np.float32, name
+    assert a > 0.9, a

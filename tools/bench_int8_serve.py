@@ -93,7 +93,10 @@ def main():
                 cfg = ptq.PTQConfig(calibration_feeds=[feed])
                 scales = ptq.calibrate(exe, main_p, cfg)
                 n = ptq.apply_int8_compute(main_p, scales)
-                assert n >= LAYERS, f"only {n} layers rewrote to int8"
+                # _build emits LAYERS hidden fcs + the 16-wide head; ALL
+                # must rewrite or the A/B silently mixes precisions
+                assert n == LAYERS + 1, \
+                    f"{n}/{LAYERS + 1} layers rewrote to int8"
             dt = _time(exe, main_p, feed, [out.name])
         results[tag] = dt
         print(json.dumps({

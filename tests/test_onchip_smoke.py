@@ -285,3 +285,37 @@ def test_double_grad_penalty_on_chip():
                            feed={"x": rng.randn(4, 4).astype("float32")},
                            fetch_list=[gp])
     assert np.isfinite(float(np.asarray(g)))
+
+
+def test_int8_matmul_on_chip():
+    """The PTQ int8-compute contraction (int8 x int8 -> int32 on the MXU)
+    lowers and runs on the chip, tracking fp32 within 8-bit error — the
+    serving-speed path must not be a CPU-only artifact."""
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.contrib import ptq
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[64], dtype="float32")
+        h = layers.fc(x, size=128, act="relu", param_attr="i8c_w1",
+                      bias_attr="i8c_b1")
+        out = layers.fc(h, size=16, param_attr="i8c_w2",
+                        bias_attr="i8c_b2")
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 64).astype("float32")
+    exe = fluid.Executor(_place())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        (base,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        base = np.asarray(base).copy()
+        from paddle_tpu.fluid import ir
+
+        ir.apply_pass(main, "fc_fuse_pass", keep_vars=[out.name])
+        cfg = ptq.PTQConfig(calibration_feeds=[{"x": xv}])
+        scales = ptq.calibrate(exe, main, cfg)
+        n = ptq.apply_int8_compute(main, scales)
+        assert n == 2
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[out.name])
+    err = np.abs(np.asarray(got) - base).max()
+    assert err < 0.05 * np.abs(base).max() + 0.05, err

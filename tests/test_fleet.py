@@ -100,20 +100,35 @@ def test_launch_ps_server_num_2(tmp_path):
         % (os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(fluid.__file__)))), str(tmp_path)))
 
+    # two consecutive free ports (launch_ps allocates start_port + i);
+    # fixed ports would flake against anything else bound on the host
+    import socket as _socket
+
+    for _ in range(20):
+        base = free_port()
+        with _socket.socket() as s:
+            try:
+                s.bind(("127.0.0.1", base + 1))
+            except OSError:
+                continue
+        break
+    else:
+        pytest.skip("no consecutive free port pair found")
+
     from paddle_tpu.distributed import launch_ps
     args = launch_ps._parse_args([
-        "--server_num=2", "--worker_num=2", "--start_port=6270",
+        "--server_num=2", "--worker_num=2", f"--start_port={base}",
         "--log_dir", str(tmp_path / "logs"), str(script)])
     launch_ps.start_procs(args)
 
     recs = [_json.loads(p.read_text())
             for p in tmp_path.glob("*.json")]
     assert len(recs) == 4
-    eps = ["127.0.0.1:6270", "127.0.0.1:6271"]
+    eps = [f"127.0.0.1:{base}", f"127.0.0.1:{base + 1}"]
     assert all(r["eps"] == eps for r in recs)
     servers = [r for r in recs if r["role"] == "PSERVER"]
     assert sorted(r["idx"] for r in servers) == [0, 1]
-    assert sorted(r["port"] for r in servers) == ["6270", "6271"]
+    assert sorted(int(r["port"]) for r in servers) == [base, base + 1]
     trainers = [r for r in recs if r["role"] == "TRAINER"]
     assert sorted(r["idx"] for r in trainers) == [0, 1]
 

@@ -1,0 +1,27 @@
+# Convenience entry points (the canonical commands the docs reference).
+PY ?= python
+REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
+
+.PHONY: test test-book test-onchip bench bench-onchip int8-bench lint-api
+
+test:            ## full suite on the 8-device virtual CPU mesh (~8 min)
+	$(PY) -m pytest tests/ -q --ignore=tests/book
+
+test-book:       ## the 10 book workloads (end-to-end models)
+	$(PY) -m pytest tests/book -q
+
+test-onchip:     ## curated smoke subset on a real chip (axon tunnel)
+	PADDLE_TPU_TEST_REAL=1 PYTHONPATH=$(REPO):/root/.axon_site \
+	  $(PY) -m pytest tests/test_onchip_smoke.py -m onchip -q
+
+bench:           ## one-line JSON headline (TPU if reachable, labeled CPU rung otherwise)
+	PYTHONPATH=$(REPO):/root/.axon_site $(PY) bench.py
+
+bench-onchip:    ## wedge-tolerant on-chip collector (ONCHIP_RESULTS.json)
+	PYTHONPATH=$(REPO):/root/.axon_site $(PY) tools/bench_onchip_all.py
+
+int8-bench:      ## int8 vs bf16 vs fp32 dense-serving A/B
+	PYTHONPATH=$(REPO):/root/.axon_site $(PY) tools/bench_int8_serve.py
+
+lint-api:        ## fail if the public API surface drifted from API.spec
+	$(PY) tools/gen_api_spec.py --check

@@ -445,26 +445,34 @@ class BlockPlan:
         self.readonly_names = [n for n in scope_reads if n not in wset]
         self.write_names = list(writes)
 
+    def trace_env(self, donated, readonly, feeds, step, mesh_axes=()):
+        """Trace the block over the given buffers and return the full var
+        env — the ONE place the lowering context is assembled, shared by
+        make_body and introspection (tests/test_perf_budget.py captures
+        residual dtypes through it so the gate can't trace a different
+        program than the executor runs)."""
+        env = {}
+        env.update(donated)
+        env.update(readonly)
+        env.update(feeds)
+        ctx = registry.LowerContext(
+            step=step, is_test=getattr(self.program, "_is_test", False),
+            block=self.block, mesh_axes=mesh_axes)
+        ctx.program = self.program
+        ctx.dtype_policy = getattr(self.program, "_dtype_policy", None)
+        ctx.place = self.place
+        trace_block(self.block, env, ctx, ops=self.ops)
+        return env
+
     def make_body(self, mesh_axes=()):
         """fn(donated, readonly, feeds, step) -> (fetches, out_writes).
         Fetches cover jit_fetch_names only; host-op-produced fetches are
         filled in by assemble_fetches after run_host_ops."""
-        program, block, ops = self.program, self.block, self.ops
         fetch_names, write_names = self.jit_fetch_names, self.write_names
-        is_test = getattr(program, "_is_test", False)
-        dtype_policy = getattr(program, "_dtype_policy", None)
 
         def fn(donated, readonly, feeds, step):
-            env = {}
-            env.update(donated)
-            env.update(readonly)
-            env.update(feeds)
-            ctx = registry.LowerContext(step=step, is_test=is_test,
-                                        block=block, mesh_axes=mesh_axes)
-            ctx.program = program
-            ctx.dtype_policy = dtype_policy
-            ctx.place = self.place
-            trace_block(block, env, ctx, ops=ops)
+            env = self.trace_env(donated, readonly, feeds, step,
+                                 mesh_axes=mesh_axes)
             fetches = [env[n] for n in fetch_names]
             out_writes = {n: env[n] for n in write_names if n in env}
             return fetches, out_writes

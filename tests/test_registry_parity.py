@@ -70,7 +70,15 @@ def test_reference_registry_diff_is_exactly_the_documented_list():
         f"subsumed entries not in the reference set at all: {gone}")
 
 
-def test_registry_covers_reference_majority():
+def test_registry_covers_reference_exactly():
+    """Exact-count gate (r4 verdict weak#5: the old >=440 majority bound
+    would let a 6-op regression pass).  With the lazy double-grad family
+    materialized, coverage must be exactly |REFERENCE_OPS| - |SUBSUMED| —
+    the diff test above proves missing == SUBSUMED, so any drop below the
+    derived count is a real deregistration."""
+    for t in sorted(LAZY_DOUBLE_GRADS):
+        registry.get_op(t)
     ours = set(registry.all_ops())
     covered = len(REFERENCE_OPS & ours)
-    assert covered >= 440, (covered, len(REFERENCE_OPS))
+    assert covered == len(REFERENCE_OPS) - len(SUBSUMED) == 457, (
+        covered, len(REFERENCE_OPS), len(SUBSUMED))

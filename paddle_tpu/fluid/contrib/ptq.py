@@ -93,21 +93,47 @@ def calibrate(exe, program, config: PTQConfig, scope=None):
 
 
 def apply_int8_compute(program, scales):
-    """Rewrite plain dense ops (mul / 2-D matmul / fc) whose BOTH matrix
-    operands carry calibrated scales into `int8_matmul` — a REAL int8
-    MXU contraction (int32 accumulation, rescale, fc epilogue), not a
-    QDQ simulation.  v5e's int8 peak is 2x bf16, so this is the
-    TPU-native serving speed path.  Ops the pattern can't express
-    (transposes, >2-D matmul broadcasting) are left for apply_ptq's QDQ
-    pass.  Returns the number of ops rewritten."""
+    """Rewrite ops whose BOTH matrix operands carry calibrated scales into
+    REAL int8 MXU contractions (int32 accumulation, rescale, epilogue),
+    not a QDQ simulation: plain dense ops (mul / 2-D matmul / fc) become
+    `int8_matmul`; conv2d / depthwise_conv2d become `int8_conv2d` (the
+    reference's primary int8 target, mkldnn_quantizer.cc:45-90).  v5e's
+    int8 peak is 2x bf16, so this is the TPU-native serving speed path.
+    Ops the pattern can't express (transposes, >2-D matmul broadcasting)
+    are left for apply_ptq's QDQ pass.  Returns the number of ops
+    rewritten."""
     from ..framework import Operator
 
     block = program.global_block()
     slot_map = {"mul": ("X", "Y", "x_num_col_dims"),
                 "matmul": ("X", "Y", None),
                 "fc": ("Input", "W", "in_num_col_dims")}
+    conv_types = ("conv2d", "depthwise_conv2d")
     rewritten = 0
     for i, op in enumerate(list(block.ops)):
+        if op.type in conv_types:
+            xs = op.inputs.get("Input", [])
+            ws = op.inputs.get("Filter", [])
+            if len(xs) != 1 or len(ws) != 1:
+                continue
+            sx, sw = scales.get(xs[0]), scales.get(ws[0])
+            if not sx or not sw:
+                continue
+            attrs = {"scale_x": 127.0 / sx, "scale_y": 127.0 / sw,
+                     "strides": list(op.attrs.get("strides", [1, 1])),
+                     "paddings": list(op.attrs.get("paddings", [0, 0])),
+                     "dilations": list(op.attrs.get("dilations", [1, 1])),
+                     "groups": int(op.attrs.get("groups", 1)),
+                     "depthwise": op.type == "depthwise_conv2d"}
+            ins = {"Input": list(xs), "Filter": list(ws)}
+            if op.inputs.get("Bias"):
+                ins["Bias"] = list(op.inputs["Bias"])
+            block.ops[i] = Operator(block, "int8_conv2d", inputs=ins,
+                                    outputs={"Output":
+                                             list(op.outputs["Output"])},
+                                    attrs=attrs)
+            rewritten += 1
+            continue
         spec = slot_map.get(op.type)
         if spec is None:
             continue

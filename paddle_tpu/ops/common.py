@@ -155,3 +155,24 @@ def mxu_conv_kwargs(x, w):
     if _all_bf16(x, w):
         return {}
     return {"preferred_element_type": jnp.float32}
+
+
+def conv_nd_raw(x, w, strides, paddings, dilations, groups, nd=2, **kw):
+    """Paddle-convention n-D conv, shared by the fp32/bf16 lowering
+    (ops/nn_ops.py _conv_nd) and the int8 PTQ kernel (int8_conv2d):
+    per-spatial-dim int paddings or flattened (before, after) pairs,
+    NCHW/OIHW layouts.  Extra kwargs pass straight to
+    lax.conv_general_dilated (preferred_element_type etc.) so precision
+    policy stays at the call site while the geometry normalization —
+    where padding bugs would silently diverge int8 from fp32 — lives in
+    exactly one place."""
+    pads = [(p, p) for p in paddings]
+    if len(pads) == nd * 2:  # (before, after) per dim flattened
+        pads = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(nd)]
+    dn = jax.lax.conv_dimension_numbers(
+        jnp.shape(x), jnp.shape(w),
+        ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW"))
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(strides), padding=pads,
+        rhs_dilation=tuple(dilations), dimension_numbers=dn,
+        feature_group_count=groups, **kw)

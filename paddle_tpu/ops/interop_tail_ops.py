@@ -252,3 +252,36 @@ def _int8_matmul(ctx, x, y, bias, attrs):
     if act == "relu":
         out = jnp.maximum(out, 0)
     return out
+
+
+@simple_op("int8_conv2d", ["Input", "Filter", "Bias"], ["Output"],
+           optional=("Bias",), grad=None)
+def _int8_conv2d(ctx, x, w, bias, attrs):
+    """Quantized conv with a REAL int8 contraction (PTQ int8-compute mode
+    for conv2d/depthwise_conv2d — the reference quantizes conv compute as
+    its PRIMARY int8 target, inference/api/mkldnn_quantizer.cc:45-90):
+    both operands quantize to int8 with the calibrated scales, the conv
+    accumulates int32 on the MXU (int8 peak = 2x bf16 on v5e), the int32
+    result rescales to fp32, then the bias/activation epilogue applies.
+    NCHW/OIHW layouts: the geometry normalization is conv_nd_raw, the
+    SAME helper the fp32/bf16 conv2d lowering uses, so the two paths
+    cannot silently diverge on padding/group conventions."""
+    from .common import conv_nd_raw
+
+    sx = float(attrs["scale_x"])
+    sw = float(attrs["scale_y"])
+    qx = jnp.clip(jnp.round(x.astype(jnp.float32) * sx),
+                  -128, 127).astype(jnp.int8)
+    qw = jnp.clip(jnp.round(w.astype(jnp.float32) * sw),
+                  -128, 127).astype(jnp.int8)
+    groups = int(attrs.get("groups", 1))
+    if attrs.get("depthwise"):
+        groups = int(jnp.shape(x)[1])
+    acc = conv_nd_raw(qx, qw, attrs.get("strides", [1, 1]),
+                      list(attrs.get("paddings", [0, 0])),
+                      attrs.get("dilations", [1, 1]), groups,
+                      preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (1.0 / (sx * sw))
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1))
+    return out

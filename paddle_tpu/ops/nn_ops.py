@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.fluid.registry import register_op, simple_op
-from .common import mxu_conv_kwargs, op_rng_key
+from .common import conv_nd_raw, mxu_conv_kwargs, op_rng_key
 
 # ---------------------------------------------------------------------------
 # convolution
@@ -24,17 +24,8 @@ from .common import mxu_conv_kwargs, op_rng_key
 
 
 def _conv_nd(x, w, strides, paddings, dilations, groups, nd):
-    pads = [(p, p) for p in paddings]
-    if len(pads) == nd * 2:  # (before, after) per dim flattened
-        pads = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(nd)]
-    dn = jax.lax.conv_dimension_numbers(
-        jnp.shape(x), jnp.shape(w),
-        ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW"))
-    return jax.lax.conv_general_dilated(
-        x, w, window_strides=tuple(strides), padding=pads,
-        rhs_dilation=tuple(dilations), dimension_numbers=dn,
-        feature_group_count=groups,
-        **mxu_conv_kwargs(x, w)).astype(x.dtype)
+    return conv_nd_raw(x, w, strides, paddings, dilations, groups, nd=nd,
+                       **mxu_conv_kwargs(x, w)).astype(x.dtype)
 
 
 @simple_op("conv2d", ["Input", "Filter", "Bias"], ["Output"], optional=("Bias",))

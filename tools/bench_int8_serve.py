@@ -1,16 +1,20 @@
 """A/B the PTQ int8-compute serving path against bf16/fp32 on one chip.
 
-Builds a dense MLP classifier (the shape the int8_matmul rewrite covers),
-then times three predictor variants over identical batches:
+Two legs, each timing three predictor variants over identical batches:
+  dense — an MLP classifier (the int8_matmul rewrite)
+  cnn   — a conv stack (the int8_conv2d rewrite, r5: the reference's
+          primary int8 target, mkldnn_quantizer.cc)
+Variants:
   fp32      — the baseline program
   bf16      — the bf16 dtype policy
   int8      — calibrate + apply_int8_compute (REAL int8 MXU contraction)
 
-v5e peak: 394 int8 TOPS vs 197 bf16 TFLOP/s — a dense-bound graph has 2×
-dot headroom.  Prints one JSON line per variant.
+v5e peak: 394 int8 TOPS vs 197 bf16 TFLOP/s — a dot-bound graph has 2×
+headroom.  Prints one JSON line per variant per leg.
 
   PYTHONPATH=/root/repo:/root/.axon_site python tools/bench_int8_serve.py
   (JAX_PLATFORMS=cpu for a machinery test; numbers then mean nothing)
+  PT_I8_LEGS=dense,cnn selects legs.
 """
 
 from __future__ import annotations
@@ -57,6 +61,36 @@ def _flops():
     return 2.0 * BATCH * per
 
 
+CNN_BATCH = int(os.environ.get("PT_I8_CNN_BATCH", "64"))
+CNN_SIZE = int(os.environ.get("PT_I8_CNN_SIZE", "32"))
+CNN_CH = int(os.environ.get("PT_I8_CNN_CH", "128"))
+CNN_LAYERS = int(os.environ.get("PT_I8_CNN_LAYERS", "6"))
+
+
+def _build_cnn():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="img", shape=[3, CNN_SIZE, CNN_SIZE],
+                        dtype="float32")
+        h = x
+        for i in range(CNN_LAYERS):
+            h = layers.conv2d(h, num_filters=CNN_CH, filter_size=3,
+                              padding=1, act="relu",
+                              param_attr=f"i8c_w{i}", bias_attr=f"i8c_b{i}")
+        h = layers.pool2d(h, pool_type="avg", global_pooling=True)
+        h = layers.reshape(h, shape=[-1, CNN_CH])
+        out = layers.fc(h, size=16, param_attr="i8c_out_w",
+                        bias_attr="i8c_out_b")
+    return main, startup, out
+
+
+def _cnn_flops():
+    chans = [3] + [CNN_CH] * CNN_LAYERS
+    per = sum(2.0 * cout * cin * 9 * CNN_SIZE * CNN_SIZE
+              for cin, cout in zip(chans, chans[1:]))
+    return CNN_BATCH * (per + 2.0 * CNN_CH * 16)
+
+
 def _time(exe, prog, feed, fetch):
     import jax
 
@@ -73,12 +107,10 @@ def _time(exe, prog, feed, fetch):
     return (time.perf_counter() - t0) / STEPS
 
 
-def main():
-    rng = np.random.RandomState(0)
-    feed = {"x": rng.randn(BATCH, DIN).astype("float32")}
+def _run_leg(leg, build, feed, flops, n_int8, config):
     results = {}
     for tag in ("fp32", "bf16", "int8"):
-        main_p, startup, out = _build()
+        main_p, startup, out = build()
         exe = fluid.Executor()
         with scope_guard(Scope()):
             exe.run(startup)
@@ -93,23 +125,36 @@ def main():
                 cfg = ptq.PTQConfig(calibration_feeds=[feed])
                 scales = ptq.calibrate(exe, main_p, cfg)
                 n = ptq.apply_int8_compute(main_p, scales)
-                # _build emits LAYERS hidden fcs + the 16-wide head; ALL
-                # must rewrite or the A/B silently mixes precisions
-                assert n == LAYERS + 1, \
-                    f"{n}/{LAYERS + 1} layers rewrote to int8"
+                # ALL dot/conv layers must rewrite or the A/B silently
+                # mixes precisions
+                assert n == n_int8, f"{n}/{n_int8} layers rewrote to int8"
             dt = _time(exe, main_p, feed, [out.name])
         results[tag] = dt
         print(json.dumps({
-            "metric": "dense_serve_tflops", "variant": tag,
-            "value": round(_flops() / dt / 1e12, 2), "unit": "TFLOP/s",
-            "ms_per_batch": round(dt * 1e3, 3),
-            "config": f"mlp d{DIN} h{HID} x{LAYERS} b{BATCH}",
+            "metric": f"{leg}_serve_tflops", "variant": tag,
+            "value": round(flops / dt / 1e12, 2), "unit": "TFLOP/s",
+            "ms_per_batch": round(dt * 1e3, 3), "config": config,
         }), flush=True)
     if "bf16" in results and "int8" in results:
         print(json.dumps({
-            "metric": "int8_speedup_vs_bf16",
+            "metric": f"{leg}_int8_speedup_vs_bf16",
             "value": round(results["bf16"] / results["int8"], 3),
             "unit": "x"}), flush=True)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    legs = os.environ.get("PT_I8_LEGS", "dense,cnn").split(",")
+    if "dense" in legs:
+        _run_leg("dense", _build,
+                 {"x": rng.randn(BATCH, DIN).astype("float32")}, _flops(),
+                 LAYERS + 1, f"mlp d{DIN} h{HID} x{LAYERS} b{BATCH}")
+    if "cnn" in legs:
+        _run_leg("cnn", _build_cnn,
+                 {"img": rng.randn(CNN_BATCH, 3, CNN_SIZE,
+                                   CNN_SIZE).astype("float32")},
+                 _cnn_flops(), CNN_LAYERS + 1,
+                 f"cnn c{CNN_CH} x{CNN_LAYERS} s{CNN_SIZE} b{CNN_BATCH}")
 
 
 if __name__ == "__main__":

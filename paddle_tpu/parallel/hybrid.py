@@ -338,9 +338,30 @@ class HybridParallelRunner:
             donate_argnums=(0,))
         prof_state = {"ran": False}
 
+        def stage_global(value, sharding):
+            """Multi-process SPMD staging: jit refuses numpy (or
+            process-local jax) inputs with non-trivial shardings when the
+            mesh spans processes.  Host values are the GLOBAL content,
+            identical on every process (functional RNG makes startup
+            deterministic; feeds are built from shared seeds), so each
+            process materializes its addressable shards in place.
+            Single-process: identity — no copy, no behavior change."""
+            if jax.process_count() == 1:
+                return value
+            if (isinstance(value, jax.Array)
+                    and value.sharding.device_set == sharding.device_set):
+                return value  # already a global array on this mesh
+            arr = np.asarray(value)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
+
         def compiled(scope_, feeds, step):
-            don_vals = {n: scope_.get(n) for n in donated}
-            ro_vals = {n: scope_.get(n) for n in readonly}
+            don_vals = {n: stage_global(scope_.get(n), don_sh[n])
+                        for n in donated}
+            ro_vals = {n: stage_global(scope_.get(n), ro_sh[n])
+                       for n in readonly}
+            feeds = {n: stage_global(v, feeds_sh[n])
+                     for n, v in feeds.items()}
             if self.capture_hlo and self.last_hlo is None:
                 self.last_hlo = (
                     jitted.lower(don_vals, ro_vals, dict(feeds),

@@ -121,7 +121,16 @@ class PipelineRunner:
         (loss_val,) = runner.run(feed=batch, fetch_list=[loss.name])
     """
 
-    def __init__(self, program, scope=None, place=None):
+    def __init__(self, program, scope=None, place=None, mesh=None,
+                 rules=None, feed_specs=None):
+        """mesh: optional jax Mesh carrying a 'pp' axis of size n_stages —
+        the dp×pp×mp hybrid.  The mesh is SLICED along pp: each stage owns
+        a disjoint dp×mp submesh and its three programs run GSPMD-
+        partitioned on it (rules/feed_specs as in HybridParallelRunner);
+        only the O(boundary) activation/grad tensors cross stages through
+        the host scheduler — the TPU shape of the reference's section
+        placement (device_worker.py:184, each section on its own device).
+        A mesh without a 'pp' axis runs every stage on the full mesh."""
         from paddle_tpu.fluid import executor as ex
         from paddle_tpu.fluid.framework import CPUPlace
 
@@ -137,6 +146,63 @@ class PipelineRunner:
         self._exe = ex.Executor(self.place)
         self._step = 0
         self._build()
+        self.mesh = mesh
+        self.rules = rules
+        self.feed_specs = dict(feed_specs or {})
+        self._runners = {}
+        self._stage_meshes = None
+        if mesh is not None:
+            self._stage_meshes = self._slice_mesh(mesh)
+
+    def _slice_mesh(self, mesh):
+        """One submesh per stage: slice the 'pp' axis (disjoint device
+        groups, the real pipeline placement).  pp size must equal
+        n_stages."""
+        from jax.sharding import Mesh
+
+        from . import mesh as pmesh
+
+        if pmesh.PIPE_AXIS not in mesh.axis_names:
+            return [mesh] * self.n_stages
+        pp = mesh.shape[pmesh.PIPE_AXIS]
+        if pp != self.n_stages:
+            raise ValueError(
+                f"mesh pp axis {pp} != pipeline stages {self.n_stages}")
+        idx = list(mesh.axis_names).index(pmesh.PIPE_AXIS)
+        devs = np.moveaxis(mesh.devices, idx, 0)
+        rest = tuple(a for a in mesh.axis_names if a != pmesh.PIPE_AXIS)
+        return [Mesh(devs[s], rest) for s in range(pp)]
+
+    def _stage_runner(self, s, prog, kind):
+        """HybridParallelRunner for stage s's fwd/bwd/opt program on its
+        submesh (cached).  Optimizer feeds are full mean-gradients —
+        replicate them instead of the dim-0-on-dp default, which would be
+        wrong for weight-shaped tensors."""
+        key = (s, kind)
+        r = self._runners.get(key)
+        if r is None:
+            from .hybrid import HybridParallelRunner
+
+            feed_specs = dict(self.feed_specs)
+            if kind == "opt":
+                feed_specs.update(
+                    {g: () for _, g in self.stages[s].param_grads})
+            r = HybridParallelRunner(prog, self._stage_meshes[s],
+                                     rules=self.rules,
+                                     feed_specs=feed_specs,
+                                     scope=self.scope)
+            self._runners[key] = r
+        return r
+
+    def _run_stage(self, s, prog, kind, step, feed, fetch_list):
+        """Run one stage program: plain Executor, or GSPMD on the stage's
+        submesh when a mesh is configured."""
+        if self._stage_meshes is None:
+            self._exe._step = step
+            return self._exe.run(prog, feed=feed, fetch_list=fetch_list)
+        r = self._stage_runner(s, prog, kind)
+        r._step = step
+        return r.run(self.scope, feed=feed, fetch_list=fetch_list)
 
     # -- program construction -------------------------------------------
     def _build(self):
@@ -262,11 +328,20 @@ class PipelineRunner:
         microbatches."""
         M = self.num_microbatches
         feed = {k: np.asarray(v) for k, v in (feed or {}).items()}
+        # each microbatch additionally dp-shards over the stage submesh —
+        # validate here with a named error rather than letting stage 0's
+        # jit raise an opaque not-divisible-by-shards error mid-schedule
+        dp = 1
+        if self._stage_meshes is not None:
+            from . import mesh as pmesh
+
+            dp = self._stage_meshes[0].shape.get(pmesh.DATA_AXIS, 1)
         for k, v in feed.items():
-            if v.shape[0] % M:
+            if v.shape[0] % (M * dp):
                 raise ValueError(
                     f"feed {k!r} batch {v.shape[0]} not divisible by "
-                    f"num_microbatches={M}")
+                    f"num_microbatches={M}"
+                    + (f" x submesh dp={dp}" if dp > 1 else ""))
         micro = [{k: v[m * (v.shape[0] // M):(m + 1) * (v.shape[0] // M)]
                   for k, v in feed.items()} for m in range(M)]
         fetch_names = [f if isinstance(f, str) else f.name
@@ -281,15 +356,14 @@ class PipelineRunner:
         for m in range(M):
             env = dict(micro[m])
             for s, st in enumerate(self.stages):
-                self._exe._step = base_step + m
                 feeds = {n: env[n] for n in st.acts_in}
                 feeds.update({n: micro[m][n] for n in st.data_feeds
                               if n in micro[m]})
                 wants = list(st.acts_out)
                 if st.loss_name is not None:
                     wants = wants + [n for n in fetch_names if n not in wants]
-                outs = self._exe.run(st.fwd, feed=feeds, fetch_list=wants) \
-                    if wants else []
+                outs = self._run_stage(s, st.fwd, "fwd", base_step + m,
+                                       feeds, wants) if wants else []
                 got = dict(zip(wants, outs))
                 env.update(got)
                 acts[m].update({n: got[n] for n in st.acts_out})
@@ -303,14 +377,14 @@ class PipelineRunner:
             dacts = {}
             for s in reversed(range(self.n_stages)):
                 st = self.stages[s]
-                self._exe._step = base_step + m
                 feeds = {n: acts[m].get(n, micro[m].get(n)) for n in st.acts_in}
                 feeds.update({n: micro[m][n] for n in st.data_feeds
                               if n in micro[m]})
                 feeds.update({n: dacts[n] for n in st.grads_in_of_next})
                 wants = [grad_var_name(n) for n in st.acts_in] \
                     + [g for _, g in st.param_grads]
-                outs = self._exe.run(st.bwd, feed=feeds, fetch_list=wants)
+                outs = self._run_stage(s, st.bwd, "bwd", base_step + m,
+                                       feeds, wants)
                 got = dict(zip(wants, outs))
                 for n in st.acts_in:
                     dacts[grad_var_name(n)] = got[grad_var_name(n)]
@@ -318,13 +392,12 @@ class PipelineRunner:
                     grad_sums[g] = grad_sums[g] + np.asarray(got[g])
 
         # ---- optimizer: mean grads, one update per stage ----
-        for st in self.stages:
+        for s, st in enumerate(self.stages):
             if st.opt is None or not st.param_grads:
                 continue
-            self._exe._step = base_step
             gfeed = {g: (grad_sums[g] / M).astype(np.float32)
                      for _, g in st.param_grads}
-            self._exe.run(st.opt, feed=gfeed, fetch_list=[])
+            self._run_stage(s, st.opt, "opt", base_step, gfeed, [])
 
         self._step += M
         result = [np.mean(np.stack(v), axis=0) if v else None

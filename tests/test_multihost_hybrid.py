@@ -1,0 +1,96 @@
+"""Multi-process HYBRID step (r4 verdict item 6b — the DCN analog of
+test_multihost_fleet's psum): 2 processes × 4 virtual devices each form
+one 8-device mesh via the coordination service, and the FULL bert-tiny
+train step (fwd+bwd+Adam) runs GSPMD-partitioned as dp4×mp2 — the dp
+grad all-reduce crosses the process boundary, mp stays process-local
+(exactly how a 2-host TPU pod lays out dp-over-DCN / mp-over-ICI).
+
+Reference analog: test_dist_base.py:362's NCCL2-mode multi-process
+launch of one training step."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from net_util import free_port
+
+_CHILD = r'''
+import json, os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.fluid.incubate.fleet.collective import fleet
+
+fleet.init()
+assert jax.local_device_count() == 4, jax.local_device_count()
+assert jax.device_count() == 8, jax.device_count()
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.models import bert
+from paddle_tpu.parallel import (HybridParallelRunner, build_hybrid_mesh,
+                                 megatron_rules)
+
+cfg = bert.BertConfig.tiny()
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup), fluid.unique_name.guard():
+    feeds, loss, mlm, nsp = bert.build_bert_pretrain(cfg, is_test=False)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+# functional RNG (ops/common.py op_rng_key): identical program + seed ->
+# bit-identical param init in both processes, no broadcast needed
+batch = bert.make_fake_batch(cfg, batch=8, seq_len=32, seed=3)
+
+# dp outermost: device order is (proc0: 0-3, proc1: 4-7), so dp=4 x mp=2
+# puts dp pairs ACROSS the process boundary and mp inside each process
+mesh = build_hybrid_mesh(8, dp=4, mp=2)
+scope = Scope()
+with scope_guard(scope):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    runner = HybridParallelRunner(main, mesh, rules=megatron_rules())
+    losses = []
+    for _ in range(3):
+        (lv,) = runner.run(scope, batch, [loss.name])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+
+print("RESULT " + json.dumps({
+    "worker": fleet.worker_index(), "losses": losses}), flush=True)
+'''
+
+
+def test_two_process_hybrid_train_step():
+    port1, port2 = free_port(), free_port()
+    eps = f"127.0.0.1:{port1},127.0.0.1:{port2}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for wid in range(2):
+        env = dict(os.environ,
+                   PADDLE_TRAINER_ID=str(wid),
+                   PADDLE_TRAINER_ENDPOINTS=eps,
+                   PADDLE_CURRENT_ENDPOINT=eps.split(",")[wid],
+                   PADDLE_TRAINERS_NUM="2",
+                   TRAINING_ROLE="TRAINER",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=4")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CHILD], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = {}
+    for wid, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            pytest.fail(f"worker {wid} hung")
+        assert p.returncode == 0, err[-3000:]
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        results[wid] = json.loads(line[len("RESULT "):])
+    l0, l1 = results[0]["losses"], results[1]["losses"]
+    # SPMD: both processes computed the same global step — identical losses
+    assert l0 == l1, (l0, l1)
+    assert all(np.isfinite(v) for v in l0)
+    assert l0[-1] < l0[0], f"same-batch loss must drop: {l0}"

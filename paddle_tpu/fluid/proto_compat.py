@@ -25,8 +25,17 @@ import numpy as np
 
 __all__ = [
     "parse_program_bytes", "serialize_program", "is_program_proto",
-    "deserialize_lod_tensor", "serialize_lod_tensor",
+    "deserialize_lod_tensor", "serialize_lod_tensor", "ProgramParseError",
 ]
+
+
+class ProgramParseError(ValueError):
+    """A byte stream that is not a well-formed ProgramDesc.  The import
+    path is a trust boundary (reference __model__ files, PTQ artifacts,
+    reference-signature control flow): every malformation must surface as
+    THIS named error — never an IndexError/struct.error escaping the
+    decoder, and never a hang (tests/test_proto_fuzz.py)."""
+
 
 # ---------------------------------------------------------------------------
 # proto2 wire codec (schema-table driven)
@@ -37,13 +46,21 @@ _WT_VARINT, _WT_64BIT, _WT_LEN, _WT_32BIT = 0, 1, 2, 5
 
 def _read_varint(buf, pos):
     result = shift = 0
-    while True:
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
+    try:
+        while True:
+            b = buf[pos]
+            pos += 1
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                # conformant proto2 wraps at 64 bits: a non-canonical
+                # 10-byte varint must decode to the masked value, not a
+                # silently-wrong 70-bit Python int
+                return result & 0xFFFFFFFFFFFFFFFF, pos
+            shift += 7
+            if shift > 63:  # proto2 varints are <= 10 bytes; bound the
+                raise ValueError("varint exceeds 64 bits")  # 0x80-spam loop
+    except IndexError:
+        raise ValueError(f"truncated varint at byte {pos}") from None
 
 
 def _write_varint(out, value):
@@ -85,6 +102,9 @@ def _decode(buf, schema):
                 pos += n
             else:
                 raise ValueError(f"unsupported wire type {wt}")
+            if pos > end:
+                raise ValueError(
+                    f"skipped field {field} overruns buffer by {pos - end}")
             continue
         name, kind = spec
         repeated = name.endswith("*")
@@ -93,6 +113,10 @@ def _decode(buf, schema):
         vals = []
         if wt == _WT_LEN:
             n, pos = _read_varint(buf, pos)
+            if pos + n > end:  # slicing would silently truncate
+                raise ValueError(
+                    f"length-delimited field {field} claims {n} bytes, "
+                    f"only {end - pos} remain")
             chunk = bytes(buf[pos:pos + n])
             pos += n
             if kind == "str":
@@ -112,10 +136,14 @@ def _decode(buf, schema):
             v, pos = _read_varint(buf, pos)
             vals.append(bool(v) if kind == "bool" else _signed(v))
         elif wt == _WT_32BIT:
+            if pos + 4 > end:
+                raise ValueError(f"truncated fixed32 field {field}")
             (v,) = struct.unpack_from("<f", buf, pos)
             pos += 4
             vals.append(v)
         elif wt == _WT_64BIT:
+            if pos + 8 > end:
+                raise ValueError(f"truncated fixed64 field {field}")
             (v,) = struct.unpack_from("<d", buf, pos)
             pos += 8
             vals.append(v)
@@ -271,18 +299,43 @@ def parse_program_bytes(data: bytes):
     """Binary ProgramDesc → paddle_tpu Program (reference __model__
     reader).  BLOCK/BLOCKS attrs become plain block INDICES — this
     framework's control-flow lowerings address sub-blocks by index
-    (program.block(attrs["sub_block"]))."""
+    (program.block(attrs["sub_block"])).  Malformed input raises
+    ProgramParseError — the importer is a trust boundary and must fail
+    by name, not leak decoder internals."""
+    try:
+        return _parse_program_impl(data)
+    except ProgramParseError:
+        raise
+    except (ValueError, KeyError, TypeError, IndexError, struct.error,
+            UnicodeDecodeError, OverflowError, RecursionError) as e:
+        raise ProgramParseError(
+            f"malformed ProgramDesc ({type(e).__name__}): {e}") from e
+
+
+def _parse_program_impl(data: bytes):
     from .framework import Program
 
     desc = _decode(data, _PROGRAMDESC)
     prog = Program()
     blocks_desc = desc.get("blocks", [])
+    n_blocks = max(len(blocks_desc), 1)
+
+    def block_idx(v, what):
+        """Negative or out-of-range indices must fail BY NAME — Python's
+        negative indexing would otherwise silently address the wrong
+        block (trust-boundary contract, tests/test_proto_fuzz.py)."""
+        v = int(v)
+        if not 0 <= v < n_blocks:
+            raise ValueError(f"{what} {v} out of range [0, {n_blocks})")
+        return v
+
     # materialize blocks first so sub-block attrs can link
     for bd in blocks_desc[1:]:
-        prog._create_block(parent_idx=bd.get("parent_idx", 0))
+        prog._create_block(
+            parent_idx=block_idx(bd.get("parent_idx", 0), "parent_idx"))
     prog.current_block_idx = 0
     for bd in blocks_desc:
-        blk = prog.blocks[bd.get("idx", 0)]
+        blk = prog.blocks[block_idx(bd.get("idx", 0), "block idx")]
         for vd in bd.get("vars", []):
             vt = vd.get("type", {})
             t = vt.get("type")
@@ -311,9 +364,10 @@ def parse_program_bytes(data: bytes):
                 # this framework's control-flow lowerings address
                 # sub-blocks by INDEX (program.block(attrs["sub_block"]))
                 if isinstance(v, tuple) and v[0] == "__block__":
-                    v = v[1]
+                    v = block_idx(v[1], f"attr {a['name']!r} block ref")
                 elif isinstance(v, tuple) and v[0] == "__blocks__":
-                    v = list(v[1])
+                    v = [block_idx(b, f"attr {a['name']!r} block ref")
+                         for b in v[1]]
                 attrs[a["name"]] = v
             _append_op_raw(blk, od.get("type"), ins, outs, attrs)
     _normalize_reference_control_flow(prog)
@@ -503,25 +557,59 @@ def serialize_program(program) -> bytes:
 def deserialize_lod_tensor(stream):
     """Read one LoDTensor: u32 version | u64 lod_level {u64 nbytes, data}*
     | u32 tensor version | i32 desc_size | TensorDesc proto | raw data.
-    Returns (np array, lod: list of lists)."""
-    (version,) = struct.unpack("<I", stream.read(4))
+    Returns (np array, lod: list of lists).  Parameter files come from
+    the same untrusted model directory as __model__, so malformation
+    raises ProgramParseError under the same contract."""
+    try:
+        return _deserialize_lod_tensor_impl(stream)
+    except ProgramParseError:
+        raise
+    except (ValueError, KeyError, TypeError, struct.error,
+            OverflowError, MemoryError) as e:
+        raise ProgramParseError(
+            f"malformed LoDTensor stream ({type(e).__name__}): {e}") from e
+
+
+def _read_exact(stream, n, what):
+    data = stream.read(n)
+    if len(data) != n:
+        raise ValueError(f"truncated {what}: wanted {n} bytes, "
+                         f"got {len(data)}")
+    return data
+
+
+def _deserialize_lod_tensor_impl(stream):
+    (version,) = struct.unpack("<I", _read_exact(stream, 4, "version"))
     if version != 0:
         raise ValueError(f"unsupported LoDTensor version {version}")
-    (lod_level,) = struct.unpack("<Q", stream.read(8))
+    (lod_level,) = struct.unpack("<Q", _read_exact(stream, 8, "lod level"))
+    if lod_level > 64:  # reference caps nesting far below this
+        raise ValueError(f"implausible lod_level {lod_level}")
     lod = []
     for _ in range(lod_level):
-        (nbytes,) = struct.unpack("<Q", stream.read(8))
-        lod.append(list(np.frombuffer(stream.read(nbytes), np.uint64)
-                        .astype(np.int64)))
-    (tversion,) = struct.unpack("<I", stream.read(4))
+        (nbytes,) = struct.unpack("<Q", _read_exact(stream, 8, "lod size"))
+        lod.append(list(np.frombuffer(
+            _read_exact(stream, nbytes, "lod data"), np.uint64)
+            .astype(np.int64)))
+    (tversion,) = struct.unpack("<I", _read_exact(stream, 4,
+                                                  "tensor version"))
     if tversion != 0:
         raise ValueError(f"unsupported Tensor version {tversion}")
-    (desc_size,) = struct.unpack("<i", stream.read(4))
-    desc = _decode(stream.read(desc_size), _TENSORDESC)
-    dtype = _DTYPE_BY_ENUM[desc.get("data_type", 5)]
+    (desc_size,) = struct.unpack("<i", _read_exact(stream, 4, "desc size"))
+    if desc_size < 0:
+        raise ValueError(f"negative TensorDesc size {desc_size}")
+    desc = _decode(_read_exact(stream, desc_size, "TensorDesc"),
+                   _TENSORDESC)
+    enum = desc.get("data_type", 5)
+    dtype = _DTYPE_BY_ENUM.get(enum)
+    if dtype is None:
+        raise ValueError(f"unknown tensor data_type enum {enum}")
     dims = [int(d) for d in desc.get("dims", [])]
+    if any(d < 0 for d in dims):
+        raise ValueError(f"negative tensor dim in {dims}")
     count = int(np.prod(dims)) if dims else 1
-    data = stream.read(count * np.dtype(dtype).itemsize)
+    data = _read_exact(stream, count * np.dtype(dtype).itemsize,
+                       "tensor data")
     arr = np.frombuffer(data, dtype).reshape(dims).copy()
     return arr, lod
 

@@ -368,9 +368,23 @@ def _where(ctx, c, x, y, attrs):
     return jnp.where(c, x, y)
 
 
-register_op("where_index", ["Condition"], ["Out"],
-            lambda ctx, c, attrs: jnp.stack(jnp.nonzero(c), axis=-1).astype(jnp.int64),
-            grad=None)
+def _where_index(ctx, c, attrs):
+    """where_index_op (where_op.cc WhereIndex): coordinates of nonzero
+    entries, argwhere order.  XLA cannot return a data-dependent row
+    count, so this is the framework's standard static-shape rendering of
+    a ragged result: the full-capacity [numel(c), rank] table with valid
+    rows FIRST and sentinel -1 rows after (deviation documented in
+    PARITY.md; the plain jnp.nonzero spelling failed to trace under jit
+    at all — caught by tests/test_op_coverage_backfill.py)."""
+    flat = jnp.reshape(c, (-1,))
+    (idx,) = jnp.nonzero(flat, size=flat.shape[0], fill_value=-1)
+    coords = jnp.stack(
+        jnp.unravel_index(jnp.maximum(idx, 0), jnp.shape(c)), axis=-1)
+    coords = jnp.where((idx >= 0)[:, None], coords, -1)
+    return coords.astype(jnp.int64)
+
+
+register_op("where_index", ["Condition"], ["Out"], _where_index, grad=None)
 
 
 @simple_op("index_select", ["X", "Index"], ["Out"], no_grad_inputs=("Index",))

@@ -103,29 +103,45 @@ def _residual_specs(plan, donated, readonly, batch):
     return jax.eval_shape(capture, donated, readonly, batch, np.uint32(0))
 
 
-@pytest.fixture(scope="module")
-def flagship():
-    """Residual specs + lowered stableHLO for fp32 and bf16-policy runs of
-    the flagship step (abstract: eval_shape + lower, no execution)."""
+def _capture(build_fn, text_tags=(), lower_tags=()):
+    """Shared fp32/bf16 capture pipeline: build → plan → residual specs →
+    bytes, optionally keeping the stableHLO text (text_tags) or the
+    lowered object (lower_tags) per tag.  The ONE place the capture
+    recipe lives — both flagship fixtures go through it."""
     out = {}
     for tag in ("fp32", "bf16"):
-        cfg, main, loss, startup, batch = _build_flagship(tag == "bf16")
+        main, loss, startup, batch, extra = build_fn(tag == "bf16")
         plan, donated, readonly = _plan_and_buffers(main, startup, loss,
                                                     batch)
         specs = _residual_specs(plan, donated, readonly, batch)
-        lowered = jax.jit(plan.make_body(), donate_argnums=(0,)).lower(
-            donated, readonly, batch, np.uint32(0))
-        out[tag] = {
-            "cfg": cfg,
-            "specs": specs,
-            # keep only what the tests read: the bf16 text (dot scan) and
-            # the fp32 lowered object (cost-model compile)
-            "stablehlo": lowered.as_text() if tag == "bf16" else None,
-            "lowered": lowered if tag == "fp32" else None,
-            "residual_bytes": sum(s.size * s.dtype.itemsize
-                                  for s in specs.values()),
-        }
+        entry = dict(extra)
+        entry["specs"] = specs
+        entry["residual_bytes"] = sum(s.size * s.dtype.itemsize
+                                      for s in specs.values())
+        entry["stablehlo"] = entry["lowered"] = None
+        if tag in text_tags or tag in lower_tags:
+            lowered = jax.jit(plan.make_body(), donate_argnums=(0,)).lower(
+                donated, readonly, batch, np.uint32(0))
+            if tag in text_tags:
+                entry["stablehlo"] = lowered.as_text()
+            if tag in lower_tags:
+                entry["lowered"] = lowered
+        out[tag] = entry
     return out
+
+
+@pytest.fixture(scope="module")
+def flagship():
+    """Residual specs + lowered stableHLO for fp32 and bf16-policy runs of
+    the flagship step (abstract: eval_shape + lower, no execution).  Only
+    what the tests read is kept: the bf16 text (dot scan) and the fp32
+    lowered object (cost-model compile)."""
+
+    def build(bf16):
+        cfg, main, loss, startup, batch = _build_flagship(bf16)
+        return main, loss, startup, batch, {"cfg": cfg}
+
+    return _capture(build, text_tags=("bf16",), lower_tags=("fp32",))
 
 
 def test_zero_fp32_dots_in_flagship_step(flagship):
@@ -203,3 +219,75 @@ def test_cost_model_flops_track_analytic_model(flagship):
         f"cost-model flops {flops:.3e} vs analytic {analytic:.3e} "
         f"(ratio {flops / analytic:.2f}) — compute-path regression or "
         "model drift")
+
+
+# ---------------------------------------------------------------------------
+# conv flagship (ResNet-18): the same invisible-regression class for the
+# MXU conv path — an fp32 convolution under the policy would sextuple the
+# conv's MXU passes exactly like an fp32 dot (r5)
+# ---------------------------------------------------------------------------
+
+CONV_BATCH, CONV_IMG = 8, (3, 32, 32)
+
+
+def _build_conv_flagship(bf16):
+    from paddle_tpu.models import resnet
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, pred, loss, acc = resnet.build_resnet(
+            depth=18, class_dim=10, image_shape=CONV_IMG)
+        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
+            loss)
+    if bf16:
+        mp.enable_bf16_policy(main)
+    rng = np.random.RandomState(5)
+    batch = {"img": rng.rand(CONV_BATCH, *CONV_IMG).astype("float32"),
+             "label": rng.randint(0, 10, (CONV_BATCH, 1)).astype("int64")}
+    return main, loss, startup, batch
+
+
+# pinned conv budgets (measured 2026-08-01: ratio 0.500, fp32 control 50
+# wide residuals; see docs/PERF.md conv rows)
+CONV_BF16_OVER_FP32_RESIDUAL_RATIO = 0.60
+CONV_FP32_CONTROL_MIN_WIDE = 20
+
+
+@pytest.fixture(scope="module")
+def conv_flagship():
+    def build(bf16):
+        main, loss, startup, batch = _build_conv_flagship(bf16)
+        return main, loss, startup, batch, {}
+
+    return _capture(build, text_tags=("bf16",))
+
+
+def test_conv_flagship_zero_fp32_convolutions(conv_flagship):
+    txt = conv_flagship["bf16"]["stablehlo"]
+    convs = [ln for ln in txt.splitlines()
+             if "stablehlo.convolution" in ln]
+    assert len(convs) >= 30, f"expected the full ResNet-18, got {len(convs)}"
+    f32 = [ln.strip()[:120] for ln in convs if "xf32>" in ln]
+    assert not f32, ("fp32 convolutions under bf16 policy:\n"
+                     + "\n".join(f32))
+    dots = [ln for ln in txt.splitlines() if "dot_general" in ln]
+    f32d = [ln.strip()[:120] for ln in dots if "xf32>" in ln]
+    assert not f32d, "fp32 dots under bf16 policy:\n" + "\n".join(f32d)
+
+
+def test_conv_flagship_residuals_bf16(conv_flagship):
+    """BN returns bf16 activations with fp32 internal statistics; nothing
+    big crosses fwd->bwd in fp32 (batch mean/var residuals are [C]-sized,
+    far under the threshold)."""
+    offenders = [(n, s.shape, str(s.dtype))
+                 for n, s in conv_flagship["bf16"]["specs"].items()
+                 if s.dtype == np.float32 and s.size > SMALL_RESIDUAL_ELEMS]
+    assert not offenders, f"fp32 conv residuals: {offenders}"
+    wide = [n for n, s in conv_flagship["fp32"]["specs"].items()
+            if s.dtype == np.float32 and s.size > SMALL_RESIDUAL_ELEMS]
+    assert len(wide) > CONV_FP32_CONTROL_MIN_WIDE, \
+        f"fp32 control found only {len(wide)}"
+    ratio = (conv_flagship["bf16"]["residual_bytes"]
+             / conv_flagship["fp32"]["residual_bytes"])
+    assert ratio <= CONV_BF16_OVER_FP32_RESIDUAL_RATIO, \
+        f"conv island shrink regressed: {ratio:.3f}"

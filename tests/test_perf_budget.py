@@ -144,14 +144,26 @@ def flagship():
     return _capture(build, text_tags=("bf16",), lower_tags=("fp32",))
 
 
+def _f32_op_lines(stablehlo_text, opname):
+    """(all lines containing `opname`, the subset with an f32 operand or
+    result) — the shared scan predicate for the zero-fp32 gates."""
+    lines = [ln for ln in stablehlo_text.splitlines() if opname in ln]
+    return lines, [ln.strip()[:120] for ln in lines if "xf32>" in ln]
+
+
+def _wide_fp32(specs):
+    """Residuals wider than the small-tensor exemption that are still
+    fp32 — the shared offender scan for the residual gates."""
+    return [(n, s.shape, str(s.dtype)) for n, s in specs.items()
+            if s.dtype == np.float32 and s.size > SMALL_RESIDUAL_ELEMS]
+
+
 def test_zero_fp32_dots_in_flagship_step(flagship):
     """Every dot in the bf16-policy flagship step — fwd AND bwd — is bf16.
     (test_bf16_policy pins this on an MLP; this is the real model, where a
     missed lowering would hide among 60 dots.)"""
-    dots = [ln for ln in flagship["bf16"]["stablehlo"].splitlines()
-            if "dot_general" in ln]
+    dots, f32 = _f32_op_lines(flagship["bf16"]["stablehlo"], "dot_general")
     assert len(dots) >= 40, f"expected the full BERT step, got {len(dots)} dots"
-    f32 = [ln.strip()[:120] for ln in dots if "xf32>" in ln]
     assert not f32, "fp32 dots under bf16 policy:\n" + "\n".join(f32)
 
 
@@ -160,14 +172,11 @@ def test_no_large_fp32_residuals_under_policy(flagship):
     fwd->bwd boundary in fp32.  A re-widened attention-score/LN/MLM
     residual fails here BY NAME even if every op-output dtype still looks
     right."""
-    offenders = [(n, s.shape, str(s.dtype))
-                 for n, s in flagship["bf16"]["specs"].items()
-                 if s.dtype == np.float32 and s.size > SMALL_RESIDUAL_ELEMS]
+    offenders = _wide_fp32(flagship["bf16"]["specs"])
     assert not offenders, f"fp32 residuals crossing fwd->bwd: {offenders}"
     # sanity on the fp32 run: the same scan DOES see the wide residuals,
     # so an accidentally-empty residual set can't fake a pass
-    wide = [n for n, s in flagship["fp32"]["specs"].items()
-            if s.dtype == np.float32 and s.size > SMALL_RESIDUAL_ELEMS]
+    wide = _wide_fp32(flagship["fp32"]["specs"])
     assert len(wide) > 40, f"fp32 control run found only {len(wide)} wide residuals"
 
 
@@ -264,14 +273,11 @@ def conv_flagship():
 
 def test_conv_flagship_zero_fp32_convolutions(conv_flagship):
     txt = conv_flagship["bf16"]["stablehlo"]
-    convs = [ln for ln in txt.splitlines()
-             if "stablehlo.convolution" in ln]
+    convs, f32 = _f32_op_lines(txt, "stablehlo.convolution")
     assert len(convs) >= 30, f"expected the full ResNet-18, got {len(convs)}"
-    f32 = [ln.strip()[:120] for ln in convs if "xf32>" in ln]
     assert not f32, ("fp32 convolutions under bf16 policy:\n"
                      + "\n".join(f32))
-    dots = [ln for ln in txt.splitlines() if "dot_general" in ln]
-    f32d = [ln.strip()[:120] for ln in dots if "xf32>" in ln]
+    _, f32d = _f32_op_lines(txt, "dot_general")
     assert not f32d, "fp32 dots under bf16 policy:\n" + "\n".join(f32d)
 
 
@@ -279,12 +285,9 @@ def test_conv_flagship_residuals_bf16(conv_flagship):
     """BN returns bf16 activations with fp32 internal statistics; nothing
     big crosses fwd->bwd in fp32 (batch mean/var residuals are [C]-sized,
     far under the threshold)."""
-    offenders = [(n, s.shape, str(s.dtype))
-                 for n, s in conv_flagship["bf16"]["specs"].items()
-                 if s.dtype == np.float32 and s.size > SMALL_RESIDUAL_ELEMS]
+    offenders = _wide_fp32(conv_flagship["bf16"]["specs"])
     assert not offenders, f"fp32 conv residuals: {offenders}"
-    wide = [n for n, s in conv_flagship["fp32"]["specs"].items()
-            if s.dtype == np.float32 and s.size > SMALL_RESIDUAL_ELEMS]
+    wide = _wide_fp32(conv_flagship["fp32"]["specs"])
     assert len(wide) > CONV_FP32_CONTROL_MIN_WIDE, \
         f"fp32 control found only {len(wide)}"
     ratio = (conv_flagship["bf16"]["residual_bytes"]

@@ -1,0 +1,157 @@
+"""Independent-oracle cross-checks for the LSTM-family kernels (r4
+verdict weak#3: the attention_lstm/recurrent cross-checks were written
+by the same author from the same reading of the reference — this file
+pins the recurrence against torch (CPU), an implementation nobody here
+wrote).
+
+Layout mapping (verified against reference lstm_op.h / torch docs):
+  torch LSTMCell gate chunk order: (i, f, g, o), gates = W_ih x + b_ih +
+  W_hh h + b_hh, c' = f*c + i*tanh(g), h' = o*tanh(c').
+  paddle lstm op: Input is the PRE-PROJECTED [B,T,4D] in chunk order
+  (c, i, f, o); Weight [D,4D] is the hidden-hidden matrix; Bias [4D].
+  paddle attention_lstm's inner step: chunk order (f, i, o, cand),
+  LSTMWeight rows = [hidden(D); input(M)].
+
+torch GRUCell is deliberately NOT used as a GRU oracle: it applies the
+reset gate AFTER the hidden linear (r * (W_hn h + b_hn),
+linear-before-reset), while the reference gru_op resets BEFORE
+((r*h) W_c) — mathematically different variants; gru stays pinned by
+its existing numeric tests."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+B, T, M, D = 4, 5, 6, 8  # batch, steps, input dim, hidden dim
+
+
+def _run_op(op_type, inputs, outputs, attrs):
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        block = main.global_block()
+        feed, ins = {}, {}
+        for slot, (name, arr) in inputs.items():
+            arr = np.asarray(arr)
+            block.create_var(name=name, shape=arr.shape,
+                             dtype=str(arr.dtype), is_data=True)
+            feed[name] = arr
+            ins[slot] = [name]
+        outs = {}
+        for slot, name in outputs.items():
+            block.create_var(name=name, shape=None, dtype="float32")
+            outs[slot] = [name]
+        block.append_op(op_type, inputs=ins, outputs=outs, attrs=attrs)
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        vals = exe.run(main, feed=feed,
+                       fetch_list=list(outputs.values()))
+    return {k: np.asarray(v) for k, v in zip(outputs, vals)}
+
+
+def test_lstm_op_matches_torch_lstmcell():
+    """The `lstm` op (reference lstm_op.h recurrence) against
+    torch.nn.LSTMCell with the weight layouts mapped: torch chunks
+    (i,f,g,o) → paddle pre-projection/bias chunks (g,i,f,o)."""
+    g = torch.Generator().manual_seed(0)
+    cell = torch.nn.LSTMCell(M, D)
+    for p in cell.parameters():
+        with torch.no_grad():
+            p.uniform_(-0.4, 0.4, generator=g)
+    xs = torch.rand((B, T, M), generator=g) * 2 - 1
+
+    # torch reference run
+    h = torch.zeros(B, D)
+    c = torch.zeros(B, D)
+    hs = []
+    with torch.no_grad():
+        for t in range(T):
+            h, c = cell(xs[:, t], (h, c))
+            hs.append(h.clone())
+    want_h = torch.stack(hs, dim=1).numpy()
+
+    # map onto the paddle op's layout
+    def reorder(mat_or_vec):
+        """torch chunk order (i,f,g,o) → paddle (c=g, i, f, o) on dim 0."""
+        a = mat_or_vec.detach().numpy()
+        i, f, gg, o = np.split(a, 4, axis=0)
+        return np.concatenate([gg, i, f, o], axis=0)
+
+    w_ih = reorder(cell.weight_ih)          # [4D, M]
+    w_hh = reorder(cell.weight_hh)          # [4D, D]
+    b_ih = reorder(cell.bias_ih)            # [4D]
+    b_hh = reorder(cell.bias_hh)            # [4D]
+
+    x_np = xs.numpy()
+    x_pre = x_np.reshape(B * T, M) @ w_ih.T + b_ih
+    x_pre = x_pre.reshape(B, T, 4 * D).astype("float32")
+
+    got = _run_op(
+        "lstm",
+        {"Input": ("x", x_pre), "Weight": ("w", w_hh.T.astype("float32")),
+         "Bias": ("b", b_hh.astype("float32"))},
+        {"Hidden": "hid", "Cell": "cel"},
+        {"use_peepholes": False})
+    np.testing.assert_allclose(got["Hidden"], want_h, rtol=2e-5, atol=2e-5)
+
+
+def test_attention_lstm_recurrence_matches_torch():
+    """attention_lstm (fused_ops.py, reference attention_lstm_op.cc): the
+    oracle is numpy attention pooling + torch LSTMCell recurrence —
+    the LSTM core comes from an implementation nobody here wrote.
+    Mapping: inner chunk order (f,i,o,cand) ← torch (i,f,g,o);
+    LSTMWeight rows [hidden; input]; single fused bias."""
+    rng = np.random.RandomState(1)
+    g = torch.Generator().manual_seed(2)
+    cell = torch.nn.LSTMCell(M, D)
+    for p in cell.parameters():
+        with torch.no_grad():
+            p.uniform_(-0.4, 0.4, generator=g)
+
+    x = rng.uniform(-1, 1, (B, T, M)).astype("float32")
+    c0 = rng.uniform(-0.5, 0.5, (B, D)).astype("float32")
+    h0 = rng.uniform(-0.5, 0.5, (B, D)).astype("float32")
+    aw = rng.uniform(-0.5, 0.5, (M + D, 1)).astype("float32")
+    ab = rng.uniform(-0.5, 0.5, (1,)).astype("float32")
+
+    def reorder_fio_cand(a):
+        """torch (i,f,g,o) → attention_lstm (f,i,o,g) on dim 0."""
+        i, f, gg, o = np.split(a.detach().numpy(), 4, axis=0)
+        return np.concatenate([f, i, o, gg], axis=0)
+
+    w_ih = reorder_fio_cand(cell.weight_ih)      # [4D, M]
+    w_hh = reorder_fio_cand(cell.weight_hh)      # [4D, D]
+    lb = (reorder_fio_cand(cell.bias_ih)
+          + reorder_fio_cand(cell.bias_hh)).astype("float32")[None, :]
+    # LSTMWeight rows: hidden block first, then input block → [(D+M), 4D]
+    lw = np.concatenate([w_hh.T, w_ih.T], axis=0).astype("float32")
+
+    # oracle: numpy attention + torch cell
+    ht = torch.tensor(h0)
+    ct = torch.tensor(c0)
+    want = []
+    with torch.no_grad():
+        for step in range(T):
+            # reference scoring: relu(x·aw_x + c_prev·aw_c) then softmax
+            score = np.maximum(
+                (x.reshape(B * T, M) @ aw[:M]).reshape(B, T) + ab[0]
+                + (ct.numpy() @ aw[M:]), 0.0)
+            e = np.exp(score - score.max(axis=1, keepdims=True))
+            probs = e / e.sum(axis=1, keepdims=True)
+            pooled = np.einsum("bt,btm->bm", probs, x).astype("float32")
+            ht, ct = cell(torch.tensor(pooled), (ht, ct))
+            want.append(ht.numpy().copy())
+    want_h = np.stack(want, axis=1)
+
+    got = _run_op(
+        "attention_lstm",
+        {"X": ("x", x), "C0": ("c0", c0), "H0": ("h0", h0),
+         "AttentionWeight": ("aw", aw), "AttentionBias": ("ab", ab),
+         "LSTMWeight": ("lw", lw), "LSTMBias": ("lb", lb)},
+        {"Hidden": "hid", "Cell": "cel", "AttentionedX": "ax",
+         "AttentionFCOut": "afc", "LSTMX": "lx", "LSTMOUT": "lo"},
+        {})
+    np.testing.assert_allclose(got["Hidden"], want_h, rtol=3e-5, atol=3e-5)

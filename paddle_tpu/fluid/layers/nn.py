@@ -996,16 +996,25 @@ def image_resize(input, out_shape=None, scale=None, name=None,
     if out_shape is None:
         out_shape = [int(input.shape[2] * scale), int(input.shape[3] * scale)]
     helper = LayerHelper(op, name=name)
+    # align attrs MUST reach the op: the reference's default is
+    # align_corners=True and the kernels branch on it (r5 review: they
+    # were silently dropped here)
     return _single_out_layer(helper, op, {"X": [input]},
-                             {"out_h": out_shape[0], "out_w": out_shape[1]})
+                             {"out_h": out_shape[0], "out_w": out_shape[1],
+                              "align_corners": bool(align_corners),
+                              "align_mode": int(align_mode)})
 
 
-def resize_bilinear(input, out_shape=None, scale=None, name=None, **kw):
-    return image_resize(input, out_shape, scale, name, "BILINEAR")
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True, align_mode=1, **kw):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        align_corners=align_corners, align_mode=align_mode)
 
 
-def resize_nearest(input, out_shape=None, scale=None, name=None, **kw):
-    return image_resize(input, out_shape, scale, name, "NEAREST")
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True, **kw):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        align_corners=align_corners)
 
 
 def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):

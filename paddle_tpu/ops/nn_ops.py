@@ -343,20 +343,88 @@ def _causal_softmax(ctx, x, attrs):
     return jax.nn.softmax(jnp.where(mask, x, jnp.asarray(-1e9, x.dtype)), axis=-1)
 
 
+def _interp_out_hw(attrs, h, w, out_size):
+    """out_h/out_w attrs, falling back to the `scale` attr (reference
+    interpolate_op.cc InterpolateOpMaker: scale used when out_h <= 0).
+    The reference's OutSize tensor input (a RUNTIME size override) cannot
+    exist under XLA's static shapes — fail by name instead of silently
+    producing the attr-sized output."""
+    if out_size is not None:
+        raise NotImplementedError(
+            "interp ops: the OutSize tensor input is a runtime shape "
+            "override the XLA lowering cannot honor — set static "
+            "out_h/out_w (or scale) attrs instead")
+    oh, ow = attrs.get("out_h"), attrs.get("out_w")
+    scale = float(attrs.get("scale", 0.0) or 0.0)
+    if (not oh or oh <= 0) and scale > 0:
+        oh = int(h * scale)
+    if (not ow or ow <= 0) and scale > 0:
+        ow = int(w * scale)
+    return int(oh), int(ow)
+
+
+def _interp_src_coords(out_len, in_len, align_corners, align_mode):
+    """Source coordinates per reference interpolate_op.h: align_corners →
+    ratio (in-1)/(out-1), src = ratio·dst; else ratio in/out with
+    align_mode 0 = half-pixel (max(ratio·(dst+½)−½, 0)), mode 1 =
+    src = ratio·dst."""
+    d = jnp.arange(out_len, dtype=jnp.float32)
+    if align_corners:
+        return d * ((in_len - 1) / max(out_len - 1, 1))
+    ratio = in_len / out_len
+    if int(align_mode) == 0:
+        return jnp.maximum(ratio * (d + 0.5) - 0.5, 0.0)
+    return ratio * d
+
+
 @simple_op("bilinear_interp", ["X", "OutSize"], ["Out"], optional=("OutSize",),
            no_grad_inputs=("OutSize",))
 def _bilinear_interp(ctx, x, out_size, attrs):
-    oh, ow = attrs.get("out_h"), attrs.get("out_w")
+    """Reference interpolate_op.h BilinearInterpolation.  align_corners
+    DEFAULTS TO TRUE in the reference op maker — jax.image.resize is
+    always half-pixel, so the coordinates are computed explicitly (the
+    resize spelling silently shifted every default-attrs upsample;
+    caught by the torch-oracle sweep, r5)."""
     n, c, h, w = jnp.shape(x)
-    return jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+    oh, ow = _interp_out_hw(attrs, h, w, out_size)
+    ac = bool(attrs.get("align_corners", True))
+    am = attrs.get("align_mode", 1)
+    sy = _interp_src_coords(oh, h, ac, am)
+    sx = _interp_src_coords(ow, w, ac, am)
+    y0 = jnp.clip(jnp.floor(sy).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(sx).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (sy - y0.astype(jnp.float32)).astype(x.dtype)  # [oh]
+    wx = (sx - x0.astype(jnp.float32)).astype(x.dtype)  # [ow]
+    rows0 = jnp.take(x, y0, axis=2)
+    rows1 = jnp.take(x, y1, axis=2)
+    top = rows0 * (1 - wy)[None, None, :, None] \
+        + rows1 * wy[None, None, :, None]
+    left = jnp.take(top, x0, axis=3)
+    right = jnp.take(top, x1, axis=3)
+    return left * (1 - wx)[None, None, None, :] + right * wx[None, None, None, :]
 
 
 @simple_op("nearest_interp", ["X", "OutSize"], ["Out"], optional=("OutSize",),
            no_grad_inputs=("OutSize",))
 def _nearest_interp(ctx, x, out_size, attrs):
-    oh, ow = attrs.get("out_h"), attrs.get("out_w")
+    """Reference NearestNeighborInterpolate: align_corners (default true)
+    rounds ratio·dst with ratio (in-1)/(out-1); else floor with in/out."""
     n, c, h, w = jnp.shape(x)
-    return jax.image.resize(x, (n, c, oh, ow), method="nearest")
+    oh, ow = _interp_out_hw(attrs, h, w, out_size)
+    ac = bool(attrs.get("align_corners", True))
+    if ac:
+        # reference rounds HALF UP (static_cast<int>(ratio*k + 0.5)), not
+        # banker's — jnp.round(0.5) would pick the wrong pixel
+        iy = jnp.floor(_interp_src_coords(oh, h, True, 1) + 0.5)
+        ix = jnp.floor(_interp_src_coords(ow, w, True, 1) + 0.5)
+    else:
+        iy = jnp.floor(_interp_src_coords(oh, h, False, 1))
+        ix = jnp.floor(_interp_src_coords(ow, w, False, 1))
+    iy = jnp.clip(iy.astype(jnp.int32), 0, h - 1)
+    ix = jnp.clip(ix.astype(jnp.int32), 0, w - 1)
+    return jnp.take(jnp.take(x, iy, axis=2), ix, axis=3)
 
 
 @simple_op("temporal_shift", ["X"], ["Out"])

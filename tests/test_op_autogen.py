@@ -55,11 +55,11 @@ SPECS = []
 
 def S(op, inputs, ref=None, attrs=None, grads="auto", out_slots=("Out",),
       lw=None, mre=0.01, delta=1e-2, tols=(1e-5, 1e-4), grad_out=None,
-      no_check=None):
+      no_check=None, name=None):
     SPECS.append(dict(op=op, inputs=inputs, ref=ref, attrs=attrs or {},
                       grads=grads, out_slots=out_slots, lw=lw, mre=mre,
                       delta=delta, tols=tols, grad_out=grad_out,
-                      no_check=no_check))
+                      no_check=no_check, name=name or op))
 
 
 # ---------------------------------------------------------------------------
@@ -520,12 +520,40 @@ S("lrn", {"X": rnd(2, 5, 3, 3, seed=107)},
       X, 5, alpha=1e-4 * 5, beta=0.75, k=1.0)),
   attrs={"n": 5, "alpha": 1e-4, "beta": 0.75, "k": 1.0},
   out_slots=("Out", "MidOut"), no_check=("MidOut",), tols=(1e-4, 1e-3))
+# interp conventions pinned against torch (r5: the old resize-based
+# lowering silently ignored align_corners — reference DEFAULT true):
+# ac=True ↔ torch align_corners=True; ac=False align_mode=0 ↔ torch
+# half-pixel (interpolate default)
 S("bilinear_interp", {"X": rnd(1, 2, 4, 4, seed=108)},
-  None, attrs={"out_h": 8, "out_w": 8}, grads=["X"], tols=(1e-4, 1e-3))
-S("nearest_interp", {"X": rnd(1, 2, 4, 4, seed=109)},
   _tt(lambda torch, X: torch.nn.functional.interpolate(
-      X, size=(8, 8), mode="nearest")),
-  attrs={"out_h": 8, "out_w": 8}, grads=["X"], tols=(1e-4, 1e-3))
+      X, size=(8, 6), mode="bilinear", align_corners=True)),
+  attrs={"out_h": 8, "out_w": 6}, grads=["X"], tols=(1e-4, 1e-3),
+  name="bilinear_interp_align_corners")
+S("bilinear_interp", {"X": rnd(1, 2, 4, 4, seed=108)},
+  _tt(lambda torch, X: torch.nn.functional.interpolate(
+      X, size=(8, 6), mode="bilinear", align_corners=False)),
+  attrs={"out_h": 8, "out_w": 6, "align_corners": False, "align_mode": 0},
+  grads=["X"], tols=(1e-4, 1e-3), name="bilinear_interp_half_pixel")
+S("nearest_interp", {"X": rnd(1, 2, 5, 5, seed=109)},
+  _tt(lambda torch, X: torch.nn.functional.interpolate(
+      X, size=(8, 7), mode="nearest")),
+  attrs={"out_h": 8, "out_w": 7, "align_corners": False},
+  grads=["X"], tols=(1e-4, 1e-3))
+S("nearest_interp", {"X": rnd(1, 2, 5, 5, seed=109)},
+  lambda X: X[:, :,
+              np.round(np.arange(8) * 4 / 7.0).astype(int).clip(0, 4)][
+      :, :, :, np.round(np.arange(7) * 4 / 6.0).astype(int).clip(0, 4)],
+  attrs={"out_h": 8, "out_w": 7}, grads=["X"], tols=(1e-4, 1e-3),
+  name="nearest_interp_align_corners")
+# exact-.5 source coordinates: 3→5 with align_corners makes ratio 0.5, so
+# dst 1 lands on src 0.5 — the reference rounds HALF UP
+# (static_cast<int>(x + 0.5)), unlike np.round/jnp.round banker's rounding
+S("nearest_interp", {"X": rnd(1, 1, 3, 3, seed=130)},
+  lambda X: X[:, :,
+              np.floor(np.arange(5) * 0.5 + 0.5).astype(int).clip(0, 2)][
+      :, :, :, np.floor(np.arange(5) * 0.5 + 0.5).astype(int).clip(0, 2)],
+  attrs={"out_h": 5, "out_w": 5}, grads=["X"], tols=(1e-4, 1e-3),
+  name="nearest_interp_half_up_rounding")
 S("prelu", {"X": away0(2, 3, seed=110), "Alpha": pos(1, seed=111)},
   lambda X, Alpha: np.where(X > 0, X, Alpha * X),
   attrs={"mode": "all"})
@@ -1201,7 +1229,7 @@ def _float_slots(spec):
 
 
 @pytest.mark.parametrize("spec", [s for s in SPECS if s["ref"] is not None],
-                         ids=lambda s: s["op"])
+                         ids=lambda s: s["name"])
 def test_output(spec):
     t = _make_test(spec)
     atol, rtol = spec["tols"]
@@ -1213,7 +1241,7 @@ def test_output(spec):
 @pytest.mark.parametrize(
     "spec",
     [s for s in SPECS if s["grads"] == "auto" or s["grads"]],
-    ids=lambda s: s["op"])
+    ids=lambda s: s["name"])
 def test_grad(spec):
     t = _make_test(spec)
     slots = (_float_slots(spec) if spec["grads"] == "auto"
@@ -1229,7 +1257,7 @@ def test_grad(spec):
     "spec",
     [s for s in SPECS if s["ref"] is None
      and not (s["grads"] == "auto" or s["grads"])],
-    ids=lambda s: s["op"])
+    ids=lambda s: s["name"])
 def test_smoke(spec):
     """Specs with neither a reference nor gradient checks still EXECUTE:
     build the one-op program and run it through the real executor so a

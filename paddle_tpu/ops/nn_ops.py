@@ -360,6 +360,11 @@ def _interp_out_hw(attrs, h, w, out_size):
         oh = int(h * scale)
     if (not ow or ow <= 0) and scale > 0:
         ow = int(w * scale)
+    if not oh or not ow or oh <= 0 or ow <= 0:
+        raise ValueError(
+            "interp ops need a static output size: set out_h/out_w > 0 "
+            f"or scale > 0 (got out_h={attrs.get('out_h')!r}, "
+            f"out_w={attrs.get('out_w')!r}, scale={scale!r})")
     return int(oh), int(ow)
 
 

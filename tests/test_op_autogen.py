@@ -481,6 +481,20 @@ S("pool2d", {"X": rnd(2, 3, 6, 6, seed=88)},
   _tt(lambda torch, X: torch.nn.functional.max_pool2d(X, 2, 2)),
   attrs={"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
          "paddings": [0, 0]})
+# padded avg pool: `exclusive` (reference default True) maps to torch
+# count_include_pad=False — the classic silently-divergent convention
+S("pool2d", {"X": rnd(1, 2, 5, 5, seed=131)},
+  _tt(lambda torch, X: torch.nn.functional.avg_pool2d(
+      X, 3, 2, padding=1, count_include_pad=False)),
+  attrs={"pooling_type": "avg", "ksize": [3, 3], "strides": [2, 2],
+         "paddings": [1, 1], "exclusive": True},
+  name="pool2d_avg_pad_exclusive")
+S("pool2d", {"X": rnd(1, 2, 5, 5, seed=131)},
+  _tt(lambda torch, X: torch.nn.functional.avg_pool2d(
+      X, 3, 2, padding=1, count_include_pad=True)),
+  attrs={"pooling_type": "avg", "ksize": [3, 3], "strides": [2, 2],
+         "paddings": [1, 1], "exclusive": False},
+  name="pool2d_avg_pad_inclusive")
 S("pool3d", {"X": rnd(1, 2, 4, 4, 4, seed=89)},
   _tt(lambda torch, X: torch.nn.functional.avg_pool3d(X, 2, 2)),
   attrs={"pooling_type": "avg", "ksize": [2, 2, 2], "strides": [2, 2, 2],

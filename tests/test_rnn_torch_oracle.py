@@ -29,7 +29,7 @@ from paddle_tpu.fluid.executor import Scope, scope_guard
 B, T, M, D = 4, 5, 6, 8  # batch, steps, input dim, hidden dim
 
 
-def _run_op(op_type, inputs, outputs, attrs):
+def _run_op(op_type, inputs, outputs, attrs, fetch=None):
     main = fluid.Program()
     with fluid.program_guard(main):
         block = main.global_block()
@@ -45,11 +45,12 @@ def _run_op(op_type, inputs, outputs, attrs):
             block.create_var(name=name, shape=None, dtype="float32")
             outs[slot] = [name]
         block.append_op(op_type, inputs=ins, outputs=outs, attrs=attrs)
+    fetch = list(outputs) if fetch is None else list(fetch)
     with scope_guard(Scope()):
         exe = fluid.Executor(fluid.CPUPlace())
         vals = exe.run(main, feed=feed,
-                       fetch_list=list(outputs.values()))
-    return {k: np.asarray(v) for k, v in zip(outputs, vals)}
+                       fetch_list=[outputs[k] for k in fetch])
+    return {k: np.asarray(v) for k, v in zip(fetch, vals)}
 
 
 def test_lstm_op_matches_torch_lstmcell():
@@ -155,3 +156,32 @@ def test_attention_lstm_recurrence_matches_torch():
          "AttentionFCOut": "afc", "LSTMX": "lx", "LSTMOUT": "lo"},
         {})
     np.testing.assert_allclose(got["Hidden"], want_h, rtol=3e-5, atol=3e-5)
+
+
+def test_warpctc_matches_torch_ctc_loss():
+    """The native CTC (metric_ops.py warpctc — log-space alpha recursion
+    as one lax.scan) against torch.nn.functional.ctc_loss on ragged
+    logit/label lengths.  The existing brute-force test covers one tiny
+    dense case; torch pins the recursion on the padded/ragged layout the
+    reference op actually serves (warpctc_op.cc)."""
+    rng = np.random.RandomState(3)
+    b, t, c, l = 4, 7, 5, 3
+    logits = rng.uniform(-2, 2, (b, t, c)).astype("float32")
+    label = rng.randint(1, c, (b, l)).astype("int64")  # 0 is blank
+    t_len = np.array([7, 5, 6, 4], "int64")
+    l_len = np.array([3, 2, 3, 1], "int64")
+
+    got = _run_op(
+        "warpctc",
+        {"Logits": ("lg", logits), "Label": ("lb", label),
+         "LogitsLength": ("tl", t_len), "LabelLength": ("ll", l_len)},
+        {"Loss": "loss", "WarpCTCGrad": "wg"},
+        {"blank": 0}, fetch=["Loss"])  # WarpCTCGrad is unused (vjp grads)
+
+    lp = torch.log_softmax(torch.tensor(logits), dim=-1)  # [B,T,C]
+    want = torch.nn.functional.ctc_loss(
+        lp.transpose(0, 1), torch.tensor(label),
+        torch.tensor(t_len), torch.tensor(l_len),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(got["Loss"].reshape(-1), want,
+                               rtol=2e-5, atol=2e-5)

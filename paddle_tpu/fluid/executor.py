@@ -19,6 +19,7 @@ host between steps.
 from __future__ import annotations
 
 import contextlib
+import os
 import logging
 import threading
 import warnings
@@ -259,12 +260,37 @@ def _apply_bf16_policy(op, vals):
     return out
 
 
+_OP_TRACE_LOG = os.environ.get("PT_TRACE_OP_LOG")
+_traced_op_types: set = set()
+if _OP_TRACE_LOG:
+    import atexit
+
+    @atexit.register
+    def _flush_traced_op_types():
+        # ONE os.write to an O_APPEND fd: concurrent exits (pytest-xdist
+        # workers) can't interleave mid-line; the consumer de-duplicates
+        try:
+            payload = "".join(t + "\n" for t in sorted(_traced_op_types))
+            fd = os.open(_OP_TRACE_LOG,
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                os.write(fd, payload.encode())
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+
+
 def trace_block(block, env, ctx, ops=None):
     """Trace every op of `block` into JAX ops, mutating `env` (name→array).
 
     This is the TPU replacement for the reference executor's hot loop
     (executor.cc:433-438): it runs once per compilation, not once per step.
-    """
+
+    PT_TRACE_OP_LOG=<file>: record every op type that actually LOWERS
+    (appended at exit) — the execution-coverage measurement behind
+    tools/op_exec_coverage.py; a registered-but-never-lowered op can hide
+    a trace-time landmine (where_index, r5)."""
     ctx.block = block
     ctx.env = env
     policy = getattr(ctx, "dtype_policy", None)
@@ -278,6 +304,11 @@ def trace_block(block, env, ctx, ops=None):
         ctx.op_index = (block.idx << 16) | op_index
         ctx.cur_op = op  # slot-name access for imported-signature ops
         out = info.lower(ctx, *vals, attrs=op.attrs)
+        if _OP_TRACE_LOG:
+            # AFTER lower() returns: a lowering that crashes at trace
+            # time must not count as covered (that's the landmine class
+            # the sweep exists to expose)
+            _traced_op_types.add(op.type)
         outs = out if isinstance(out, tuple) else (out,)
         for slot, val in zip(info.output_slots, outs):
             cslot = slot.rstrip("*")

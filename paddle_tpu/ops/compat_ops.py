@@ -516,7 +516,7 @@ register_op("load_combine", [], ["Out*"], lambda *a: None, grad=None,
 @simple_op("mine_hard_examples",
            ["ClsLoss", "LocLoss", "MatchIndices", "MatchDist"],
            ["NegIndices", "UpdatedMatchIndices"],
-           optional=("LocLoss",), grad=None)
+           optional=("LocLoss", "MatchDist"), grad=None)
 def _mine_hard_examples(ctx, cls_loss, loc_loss, match_indices, match_dist,
                         attrs):
     """Select hard negatives per image (mine_hard_examples_op.cc):
@@ -536,7 +536,11 @@ def _mine_hard_examples(ctx, cls_loss, loc_loss, match_indices, match_dist,
         loss = loss + loc_loss.astype(jnp.float32)
     is_neg = match_indices == -1
     if mining == "max_negative":
-        eligible = is_neg & (match_dist.astype(jnp.float32) < thr)
+        # MatchDist is optional (mine_hard_examples_op.cc declares it
+        # AsDispensable): without it every unmatched prior is eligible
+        # (r5 exec-coverage sweep: the unguarded .astype crashed here)
+        eligible = (is_neg if match_dist is None
+                    else is_neg & (match_dist.astype(jnp.float32) < thr))
         neg_sel = jnp.minimum(
             (jnp.sum(~is_neg, axis=1).astype(jnp.float32)
              * ratio).astype(jnp.int32),

@@ -1,0 +1,305 @@
+"""Execute-the-lowering tests for ops the r5 execution-coverage sweep
+(PT_TRACE_OP_LOG + tools/op_exec_coverage.py) found registered and
+token-covered but never actually LOWERED by any test — the class of gap
+that hid the where_index trace-time landmine.  Each test runs the op
+through the real jitted executor with a numpy/torch reference where the
+math is cheap, invariants otherwise."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from test_op_coverage_backfill import _run_one_op
+
+rng = np.random.RandomState(7)
+
+
+def test_minus_and_fill_zeros_like2_and_l1_norm():
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    got = _run_one_op("minus", {"X": [("x", x)], "Y": [("y", y)]},
+                      {"Out": ["o"]})
+    np.testing.assert_allclose(got["o"], x - y, rtol=1e-6)
+    got = _run_one_op("fill_zeros_like2", {"X": [("x", x)]}, {"Out": ["o"]})
+    np.testing.assert_allclose(got["o"], np.zeros_like(x))
+    got = _run_one_op("l1_norm", {"X": [("x", x)]}, {"Out": ["o"]})
+    np.testing.assert_allclose(got["o"], np.abs(x).sum(), rtol=1e-6)
+
+
+def test_fill_literal():
+    got = _run_one_op("fill", {}, {"Out": ["o"]},
+                      {"shape": [2, 3], "dtype": 5,  # fp32 enum
+                       "value": [1.5] * 6})
+    np.testing.assert_allclose(got["o"], np.full((2, 3), 1.5, "float32"))
+
+
+def test_squared_l2_distance_and_cos_sim():
+    x = rng.randn(4, 5).astype("float32")
+    y = rng.randn(4, 5).astype("float32")
+    got = _run_one_op("squared_l2_distance",
+                      {"X": [("x", x)], "Y": [("y", y)]},
+                      {"sub_result": ["s"], "Out": ["o"]})
+    np.testing.assert_allclose(got["s"], x - y, rtol=1e-6)
+    np.testing.assert_allclose(got["o"].reshape(-1),
+                               ((x - y) ** 2).sum(1), rtol=1e-5)
+    got = _run_one_op("cos_sim", {"X": [("x", x)], "Y": [("y", y)]},
+                      {"Out": ["o"], "XNorm": ["xn"], "YNorm": ["yn"]})
+    want = (x * y).sum(1) / (np.linalg.norm(x, axis=1)
+                             * np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(got["o"].reshape(-1), want, rtol=1e-5)
+
+
+def test_modified_huber_loss_formula():
+    """modified_huber_loss_op.h: a = (2y-1)·x; loss = (max(0,1-a))² for
+    a >= -1, else -4a."""
+    x = np.array([[2.0], [0.5], [-0.5], [-2.0]], "float32")
+    y = np.array([[1.0], [0.0], [1.0], [1.0]], "float32")
+    a = (2 * y - 1) * x
+    want = np.where(a >= -1, np.maximum(0, 1 - a) ** 2, -4 * a)
+    got = _run_one_op("modified_huber_loss",
+                      {"X": [("x", x)], "Y": [("y", y)]},
+                      {"IntermediateVal": ["iv"], "Out": ["o"]})
+    np.testing.assert_allclose(got["o"], want, rtol=1e-5)
+
+
+def test_conv_shift_circular():
+    """conv_shift_op.cc: circular correlation, Y length M odd, out[i,j] =
+    sum_k x[i, (j + k - M//2) mod N] * y[i, k]."""
+    x = rng.randn(2, 6).astype("float32")
+    y = rng.randn(2, 3).astype("float32")
+    n, m = 6, 3
+    want = np.zeros((2, n), "float32")
+    for i in range(2):
+        for j in range(n):
+            for k in range(m):
+                want[i, j] += x[i, (j + k - m // 2) % n] * y[i, k]
+    got = _run_one_op("conv_shift", {"X": [("x", x)], "Y": [("y", y)]},
+                      {"Out": ["o"]})
+    np.testing.assert_allclose(got["o"], want, rtol=1e-5)
+
+
+def test_depthwise_conv2d_transpose_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = rng.randn(1, 4, 5, 5).astype("float32")
+    w = rng.randn(4, 1, 3, 3).astype("float32")
+    got = _run_one_op("depthwise_conv2d_transpose",
+                      {"Input": [("x", x)], "Filter": [("w", w)]},
+                      {"Output": ["o"]},
+                      {"strides": [2, 2], "paddings": [1, 1],
+                       "dilations": [1, 1], "groups": 4})
+    want = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+        groups=4).numpy()
+    np.testing.assert_allclose(got["o"], want, rtol=1e-4, atol=1e-5)
+
+
+def test_fake_channel_wise_dequantize_max_abs():
+    x = rng.randint(-127, 128, (3, 4)).astype("float32")
+    scales = np.array([2.0, 4.0, 6.0], "float32")
+    got = _run_one_op("fake_channel_wise_dequantize_max_abs",
+                      {"X": [("x", x)], "Scales": [("s", scales)]},
+                      {"Out": ["o"]}, {"quant_bits": [8]})
+    want = x * scales[:, None] / 127.0
+    np.testing.assert_allclose(got["o"], want, rtol=1e-5)
+
+
+def test_fake_quantize_dequantize_moving_average():
+    x = rng.uniform(-3, 3, (4, 4)).astype("float32")
+    got = _run_one_op(
+        "fake_quantize_dequantize_moving_average_abs_max",
+        {"X": [("x", x)], "InScale": [("sc", np.array([1.0], "float32"))],
+         "InAccum": [("ac", np.array([0.9], "float32"))],
+         "InState": [("st", np.array([1.0], "float32"))]},
+        {"Out": ["o"], "OutScale": ["os"], "OutAccum": ["oa"],
+         "OutState": ["ost"]},
+        {"moving_rate": 0.9, "bit_length": 8})
+    # QDQ round-trip at the updated moving-average scale: values beyond
+    # the scale saturate, inside it the 8-bit step bounds the error
+    scale = float(got["os"].reshape(-1)[0])
+    assert scale > 0
+    np.testing.assert_allclose(got["o"], np.clip(x, -scale, scale),
+                               atol=scale / 127.0 + 1e-6)
+    assert np.isfinite(got["oa"]).all() and np.isfinite(got["ost"]).all()
+
+
+def test_lod_reset_dense_identity():
+    x = rng.randn(3, 4).astype("float32")
+    got = _run_one_op("lod_reset", {"X": [("x", x)]}, {"Out": ["o"]},
+                      {"target_lod": [0, 2, 3]})
+    np.testing.assert_allclose(got["o"], x)
+
+
+def test_max_pool3d_with_index():
+    x = rng.randn(1, 2, 4, 4, 4).astype("float32")
+    got = _run_one_op("max_pool3d_with_index", {"X": [("x", x)]},
+                      {"Out": ["o"], "Mask": ["m"]},
+                      {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                       "paddings": [0, 0, 0]})
+    want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7))
+    np.testing.assert_allclose(got["o"], want, rtol=1e-6)
+    assert got["m"].shape == got["o"].shape
+
+
+def test_sampling_id_distribution():
+    probs = np.array([[0.0, 1.0, 0.0], [0.0, 0.0, 1.0]], "float32")
+    got = _run_one_op("sampling_id", {"X": [("x", probs)]}, {"Out": ["o"]},
+                      {"seed": 5})
+    np.testing.assert_array_equal(got["o"].reshape(-1).astype(int), [1, 2])
+
+
+def test_spp_output_dim():
+    """spp_op: pyramid levels 2 → bins 1+4 per channel."""
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    got = _run_one_op("spp", {"X": [("x", x)]}, {"Out": ["o"]},
+                      {"pyramid_height": 2, "pooling_type": "max"})
+    assert got["o"].shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(got["o"][:, :3],
+                               x.max((2, 3)), rtol=1e-6)
+
+
+def test_sync_batch_norm_single_device_matches_batch_norm():
+    x = rng.rand(4, 3, 5, 5).astype("float32")
+    scale = rng.rand(3).astype("float32") + 0.5
+    bias = rng.rand(3).astype("float32")
+    outs = {}
+    for t in ("batch_norm", "sync_batch_norm"):
+        got = _run_one_op(
+            t, {"X": [("x", x)], "Scale": [("s", scale)],
+                "Bias": [("b", bias)],
+                "Mean": [("m", np.zeros(3, "float32"))],
+                "Variance": [("v", np.ones(3, "float32"))]},
+            {"Y": ["y"], "MeanOut": ["mo"], "VarianceOut": ["vo"],
+             "SavedMean": ["sm"], "SavedVariance": ["sv"]},
+            {"momentum": 0.9, "epsilon": 1e-5, "is_test": False})
+        outs[t] = got
+    # without a mesh, sync == plain batch norm exactly
+    np.testing.assert_allclose(outs["sync_batch_norm"]["y"],
+                               outs["batch_norm"]["y"], rtol=1e-6)
+    np.testing.assert_allclose(outs["sync_batch_norm"]["mo"],
+                               outs["batch_norm"]["mo"], rtol=1e-6)
+
+
+def test_unpool_roundtrip():
+    """unpool places pooled maxima back at their Indices (max_unpool)."""
+    x = np.zeros((1, 1, 4, 4), "float32")
+    x[0, 0, 1, 1] = 5.0
+    x[0, 0, 2, 3] = 7.0
+    pooled = _run_one_op("max_pool2d_with_index", {"X": [("x", x)]},
+                         {"Out": ["o"], "Mask": ["m"]},
+                         {"ksize": [2, 2], "strides": [2, 2],
+                          "paddings": [0, 0]})
+    got = _run_one_op(
+        "unpool",
+        {"X": [("p", pooled["o"])],
+         "Indices": [("i", pooled["m"].astype("int32"))]},
+        {"Out": ["u"]},
+        {"unpooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+         "paddings": [0, 0]})
+    assert got["u"].shape == x.shape
+    assert got["u"][0, 0, 1, 1] == 5.0
+    assert got["u"][0, 0, 2, 3] == 7.0
+    assert got["u"].sum() == 12.0
+
+
+def test_average_accumulates_updates():
+    """average_accumulates_op: sum_1 += param each step; counters tick."""
+    p = rng.randn(3, 2).astype("float32")
+    s1 = np.zeros((3, 2), "float32")
+    s2 = np.zeros((3, 2), "float32")
+    s3 = np.zeros((3, 2), "float32")
+    got = _run_one_op(
+        "average_accumulates",
+        {"param": [("p", p)], "in_sum_1": [("s1", s1)],
+         "in_sum_2": [("s2", s2)], "in_sum_3": [("s3", s3)],
+         "in_num_accumulates": [("na", np.array([0], "int64"))],
+         "in_old_num_accumulates": [("ona", np.array([0], "int64"))],
+         "in_num_updates": [("nu", np.array([0], "int64"))]},
+        {"out_sum_1": ["o1"], "out_sum_2": ["o2"], "out_sum_3": ["o3"],
+         "out_num_accumulates": ["ocn"], "out_old_num_accumulates": ["oon"],
+         "out_num_updates": ["onu"]},
+        {"average_window": 10, "max_average_window": 20,
+         "min_average_window": 5})
+    np.testing.assert_allclose(got["o1"], p, rtol=1e-6)
+    assert int(np.asarray(got["ocn"]).reshape(-1)[0]) == 1
+    assert int(np.asarray(got["onu"]).reshape(-1)[0]) == 1
+
+
+def test_mine_hard_examples_invariants():
+    """mine_hard_examples_op: hard-negative mining by classification loss;
+    negatives picked are the highest-loss unmatched priors."""
+    cls_loss = np.array([[0.9, 0.1, 0.8, 0.2]], "float32")
+    match = np.array([[0, -1, -1, -1]], "int32")  # prior 0 matched
+    got = _run_one_op(
+        "mine_hard_examples",
+        {"ClsLoss": [("cl", cls_loss)], "MatchIndices": [("mi", match)]},
+        {"NegIndices": ["ni"], "UpdatedMatchIndices": ["umi"]},
+        {"neg_pos_ratio": 1.0, "mining_type": "max_negative"})
+    ni = got["ni"].reshape(-1)
+    # 1 positive → 1 negative: the highest-loss unmatched prior (index 2)
+    assert 2 in ni.tolist()
+    assert got["umi"].shape == match.shape
+
+
+def test_fusion_transpose_flatten_concat():
+    a = rng.randn(2, 3, 4).astype("float32")
+    b = rng.randn(2, 3, 4).astype("float32")
+    got = _run_one_op(
+        "fusion_transpose_flatten_concat",
+        {"X": [("a", a), ("b", b)]}, {"Out": ["o"]},
+        {"trans_axis": [0, 2, 1], "flatten_axis": 1, "concat_axis": 1})
+    want = np.concatenate([a.transpose(0, 2, 1).reshape(2, -1),
+                           b.transpose(0, 2, 1).reshape(2, -1)], axis=1)
+    np.testing.assert_allclose(got["o"], want, rtol=1e-6)
+
+
+def test_fused_embedding_fc_lstm_smoke():
+    """Fused ids→embedding→(fc)→lstm: finite outputs, correct shapes,
+    and equality with manual embedding + lstm composition is covered by
+    the kernel's own docstring contract — here: lowers and runs."""
+    ids = rng.randint(0, 10, (2, 5)).astype("int64")
+    emb = rng.randn(10, 16).astype("float32")  # 4*D with D=4
+    wh = rng.randn(4, 16).astype("float32")
+    bias = rng.randn(1, 16).astype("float32")
+    got = _run_one_op(
+        "fused_embedding_fc_lstm",
+        {"Ids": [("ids", ids)], "Embeddings": [("e", emb)],
+         "WeightH": [("wh", wh)], "Bias": [("b", bias)]},
+        {"Hidden": ["h"], "Cell": ["c"], "XX": ["xx"]}, {})
+    assert got["h"].shape == (2, 5, 4)
+    assert np.isfinite(got["h"]).all() and np.isfinite(got["c"]).all()
+
+
+def test_fusion_seq_ops_smoke():
+    """fusion_seqconv_eltadd_relu / fusion_seqexpand_concat_fc /
+    fusion_seqpool_cvm_concat: lower and run with sane shapes."""
+    x = rng.randn(2, 6, 4).astype("float32")
+    filt = rng.randn(3 * 4, 5).astype("float32")
+    fb = rng.randn(5).astype("float32")
+    got = _run_one_op(
+        "fusion_seqconv_eltadd_relu",
+        {"X": [("x", x)], "Filter": [("f", filt)], "Bias": [("b", fb)]},
+        {"Out": ["o"], "ColMat": ["cm"]},
+        {"contextLength": 3, "contextStart": -1, "contextStride": 1})
+    assert got["o"].shape == (2, 6, 5)
+    assert (got["o"] >= 0).all()  # relu epilogue
+
+    seq = rng.randn(2, 3, 4).astype("float32")   # X[0]: [B, T, D0]
+    row = rng.randn(2, 4).astype("float32")      # X[1]: [B, D1], expanded
+    w = rng.randn(8, 6).astype("float32")
+    got = _run_one_op(
+        "fusion_seqexpand_concat_fc",
+        {"X": [("seq", seq), ("row", row)], "FCWeight": [("w", w)]},
+        {"Out": ["o"], "FCOut": ["fo"]}, {"fc_activation": "relu"})
+    assert got["o"].shape[0] == 2 and np.isfinite(got["o"]).all()
+
+    # first two feature columns are show/click COUNTS (cvm_op.cc log-
+    # transforms them): keep the pooled sums nonnegative
+    xs = rng.rand(2, 3, 4).astype("float32")
+    cvm = np.ones((2, 2), "float32")
+    got = _run_one_op(
+        "fusion_seqpool_cvm_concat",
+        {"X": [("xs", xs)], "CVM": [("cvm", cvm)]},
+        {"Out": ["o"]}, {"pooltype": "SUM", "use_cvm": True})
+    assert got["o"].shape[0] == 2 and np.isfinite(got["o"]).all()

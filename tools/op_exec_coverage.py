@@ -1,0 +1,56 @@
+"""Execution-coverage report: which registered op types actually LOWER
+(trace through trace_block under jit) during a test run.
+
+Usage:
+    PT_TRACE_OP_LOG=/tmp/op_exec.log python -m pytest tests/ -q ...
+    python tools/op_exec_coverage.py /tmp/op_exec.log
+
+A registered-but-never-lowered op can hide a trace-time landmine — a
+lowering spelled with data-dependent shapes fails only when it first
+meets jit (where_index, r5).  Host ops and lazily-materialized grads are
+reported separately: host ops never lower by design.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+import cpu_mesh  # noqa: F401,E402
+
+from paddle_tpu.fluid import registry  # noqa: E402
+
+
+def main(log_path):
+    with open(log_path) as fh:
+        executed = {ln.strip() for ln in fh if ln.strip()}
+
+    from test_registry_parity import LAZY_DOUBLE_GRADS
+
+    for t in sorted(LAZY_DOUBLE_GRADS):
+        registry.get_op(t)
+    ops = sorted(registry.all_ops())
+    host, lowerable = [], []
+    for t in ops:
+        (host if registry.get_op(t).host_run is not None
+         else lowerable).append(t)
+
+    missed = [t for t in lowerable if t not in executed]
+    miss_grad = [t for t in missed if t.endswith("_grad")]
+    miss_fwd = [t for t in missed if not t.endswith("_grad")]
+    print(f"registered: {len(ops)}  lowerable: {len(lowerable)}  "
+          f"executed: {len(executed & set(lowerable))}")
+    print(f"never-lowered forward ops ({len(miss_fwd)}):")
+    for t in miss_fwd:
+        print("  ", t)
+    print(f"never-lowered grad ops ({len(miss_grad)}):")
+    for t in miss_grad:
+        print("  ", t)
+    print(f"host ops (never lower by design): {len(host)}")
+    return miss_fwd
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/op_exec.log")

@@ -346,3 +346,166 @@ def test_sequence_scatter_grad():
             {},
             {"Out": np.zeros((2, 6), "float32")})
     t.check_grad(["X", "Updates"], "Out", max_relative_error=0.02)
+
+
+# ---------------------------------------------------------------------------
+# r5 exec-coverage sweep: grads that were registered but never lowered
+# anywhere in the suite — central differences through trace→jit→vjp
+# ---------------------------------------------------------------------------
+
+
+def test_roi_pool_and_psroi_pool_grads():
+    rng = _rng()
+    # distinct lattice values with gaps >> numeric_delta: roi_pool routes
+    # gradient through bin argmax, and two samples within 2e-3 of each
+    # other would swap maxima mid-central-difference (a diff artifact,
+    # not a grad bug)
+    x = (rng.permutation(288).astype("float32") * 0.01).reshape(1, 8, 6, 6)
+    rois = np.array([[0.0, 0.0, 4.0, 4.0], [1.0, 1.0, 5.0, 5.0]],
+                    "float32")
+    bidx = np.zeros((2,), "int32")
+    t = _mk("roi_pool", {"X": x, "ROIs": rois, "RoisBatchIdx": bidx},
+            {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+            {"Out": np.zeros((2, 8, 2, 2), "float32"),
+             "Argmax": np.zeros((2, 8, 2, 2), "int32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.03,
+                 numeric_delta=2e-3)
+    t = _mk("psroi_pool", {"X": x, "ROIs": rois, "RoisBatchIdx": bidx},
+            {"output_channels": 2, "pooled_height": 2, "pooled_width": 2,
+             "spatial_scale": 1.0},
+            {"Out": np.zeros((2, 2, 2, 2), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.03,
+                 numeric_delta=2e-3)
+
+
+def test_roi_perspective_transform_grad():
+    rng = _rng()
+    x = rng.uniform(0, 1, (1, 2, 6, 6)).astype("float32")
+    # quadrilateral rois: (x1..x4, y1..y4 interleaved) 8 coords
+    rois = np.array([[1.0, 1.0, 4.5, 1.2, 4.6, 4.4, 1.1, 4.3]], "float32")
+    bidx = np.zeros((1,), "int32")
+    t = _mk("roi_perspective_transform",
+            {"X": x, "ROIs": rois, "RoisBatchIdx": bidx},
+            {"transformed_height": 3, "transformed_width": 3,
+             "spatial_scale": 1.0},
+            {"Out": np.zeros((1, 2, 3, 3), "float32"),
+             "TransformMatrix": np.zeros((1, 9), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.05,
+                 numeric_delta=2e-3)
+
+
+def test_tree_conv_grad():
+    rng = _rng()
+    nodes = rng.uniform(-1, 1, (1, 3, 4)).astype("float32")
+    edges = np.array([[[1, 2], [1, 3], [0, 0]]], "int64")
+    w = rng.uniform(-1, 1, (4, 3, 5)).astype("float32")
+    t = _mk("tree_conv",
+            {"NodesVector": nodes, "EdgeSet": edges, "Filter": w}, {},
+            {"Out": np.zeros((1, 3, 5), "float32")})
+    t.check_grad(["NodesVector", "Filter"], "Out",
+                 max_relative_error=0.02)
+
+
+def test_yolov3_loss_grad():
+    rng = _rng()
+    # 2 anchors x (5 + 2 classes) = 14 channels on a 4x4 grid
+    x = rng.uniform(-0.5, 0.5, (1, 14, 4, 4)).astype("float32")
+    gtbox = np.array([[[0.5, 0.5, 0.3, 0.3]]], "float32")
+    gtlabel = np.array([[1]], "int32")
+    t = _mk("yolov3_loss", {"X": x, "GTBox": gtbox, "GTLabel": gtlabel},
+            {"anchors": [10, 13, 16, 30], "anchor_mask": [0, 1],
+             "class_num": 2, "ignore_thresh": 0.7, "downsample_ratio": 8},
+            {"Loss": np.zeros((1,), "float32"),
+             "ObjectnessMask": np.zeros((1, 2, 4, 4), "float32"),
+             "GTMatchMask": np.zeros((1, 1), "int32")})
+    t.check_grad(["X"], "Loss", max_relative_error=0.05,
+                 numeric_delta=2e-3)
+
+
+def test_sequence_conv_and_reshape_and_pad_grads():
+    rng = _rng()
+    x = rng.uniform(-1, 1, (2, 5, 4)).astype("float32")
+    filt = rng.uniform(-1, 1, (3 * 4, 6)).astype("float32")
+    t = _mk("sequence_conv", {"X": x, "Filter": filt},
+            {"contextLength": 3, "contextStart": -1, "contextStride": 1},
+            {"Out": np.zeros((2, 5, 6), "float32")})
+    t.check_grad(["X", "Filter"], "Out", max_relative_error=0.02)
+
+    t = _mk("sequence_reshape", {"X": x},
+            {"new_dim": 10},
+            {"Out": np.zeros((2, 2, 10), "float32"),
+             "OutLength": np.zeros((2,), "int32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+    t = _mk("sequence_pad",
+            {"X": x, "PadValue": np.zeros((1,), "float32")}, {},
+            {"Out": np.zeros((2, 5, 4), "float32"),
+             "OutLength": np.zeros((2,), "int32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_fused_elemwise_activation_grad():
+    rng = _rng()
+    x = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    y = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    t = _mk("fused_elemwise_activation", {"X": x, "Y": y},
+            {"functor_list": ["elementwise_add", "scale"], "scale": 2.0},
+            {"Out": np.zeros((3, 4), "float32"),
+             "IntermediateOut": np.zeros((3, 4), "float32")})
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+def test_fusion_lstm_and_gru_grads():
+    rng = _rng()
+    b, tt, m, d = 2, 4, 3, 5
+    x = rng.uniform(-1, 1, (b, tt, m)).astype("float32")
+    wx_l = rng.uniform(-0.5, 0.5, (m, 4 * d)).astype("float32")
+    wh_l = rng.uniform(-0.5, 0.5, (d, 4 * d)).astype("float32")
+    bias_l = rng.uniform(-0.2, 0.2, (1, 4 * d)).astype("float32")
+    t = _mk("fusion_lstm",
+            {"X": x, "WeightX": wx_l, "WeightH": wh_l, "Bias": bias_l}, {},
+            {"Hidden": np.zeros((b, tt, d), "float32"),
+             "Cell": np.zeros((b, tt, d), "float32"),
+             "XX": np.zeros((b, tt, 4 * d), "float32")})
+    t.check_grad(["X", "WeightX", "WeightH"], "Hidden",
+                 max_relative_error=0.03)
+
+    wx_g = rng.uniform(-0.5, 0.5, (m, 3 * d)).astype("float32")
+    wh_g = rng.uniform(-0.5, 0.5, (d, 3 * d)).astype("float32")
+    t = _mk("fusion_gru", {"X": x, "WeightX": wx_g, "WeightH": wh_g}, {},
+            {"Hidden": np.zeros((b, tt, d), "float32"),
+             "XX": np.zeros((b, tt, 3 * d), "float32")})
+    t.check_grad(["X", "WeightX", "WeightH"], "Hidden",
+                 max_relative_error=0.03)
+
+
+def test_fused_embedding_seq_pool_and_fusion_tail_grads():
+    rng = _rng()
+    w = rng.uniform(-1, 1, (10, 4)).astype("float32")
+    ids = rng.randint(0, 10, (2, 5)).astype("int64")
+    t = _mk("fused_embedding_seq_pool", {"W": w, "Ids": ids},
+            {"combiner": "sum"},
+            {"Out": np.zeros((2, 4), "float32")})
+    t.check_grad(["W"], "Out", max_relative_error=0.02)
+
+    x = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    ws = [rng.uniform(-0.5, 0.5, (4, 6)).astype("float32"),
+          rng.uniform(-0.5, 0.5, (6, 5)).astype("float32")]
+    bs = [rng.uniform(-0.2, 0.2, (6,)).astype("float32"),
+          rng.uniform(-0.2, 0.2, (5,)).astype("float32")]
+    t = _mk("fusion_repeated_fc_relu",
+            {"X": x, "W": [("frw0", ws[0]), ("frw1", ws[1])],
+             "Bias": [("frb0", bs[0]), ("frb1", bs[1])]}, {},
+            {"ReluOut": [("fro0", np.zeros((3, 6), "float32"))],
+             "Out": np.zeros((3, 5), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+    y = rng.uniform(-1, 1, (4, 5)).astype("float32")
+    x2 = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    t = _mk("fusion_squared_mat_sub", {"X": x2, "Y": y},
+            {"scalar": 0.5},
+            {"SquaredX": np.zeros((3, 4), "float32"),
+             "SquaredY": np.zeros((4, 5), "float32"),
+             "SquaredXY": np.zeros((3, 5), "float32"),
+             "Out": np.zeros((3, 5), "float32")})
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.03)

@@ -108,12 +108,18 @@ class OpTest(unittest.TestCase):
         (e.g. softmax, whose rows always sum to 1).
         """
         main, startup, feed, in_arg, out_arg = self._build()
-        # locate the fetchable output var name for output_name (a slot name or var)
-        out_var_name = None
+        # resolve output_name (a slot name or a var name) to the var names
+        # the loss sums over.  A slot name covers ALL its vars: a
+        # multi-var slot (meshgrid's Out, split's chunks) must feed
+        # nonzero cotangents into every output, or grad paths from the
+        # later outputs are only ever exercised with zeros (review r5)
+        out_var_names = None
         for slot, names in out_arg.items():
-            if slot == output_name or output_name in names:
-                out_var_name = names[0] if slot == output_name else output_name
-        assert out_var_name is not None, f"unknown output {output_name}"
+            if slot == output_name:
+                out_var_names = list(names)
+            elif output_name in names:
+                out_var_names = [output_name]
+        assert out_var_names, f"unknown output {output_name}"
 
         # map input slot names to var names
         check_vars = []
@@ -123,20 +129,28 @@ class OpTest(unittest.TestCase):
             else:
                 check_vars.append(want)
 
-        def append_loss(program, out_name):
+        def append_loss(program, out_names):
             block = program.global_block()
-            out_v = block.var(out_name)
-            if loss_weights is not None:
-                w = np.asarray(loss_weights)
-                block.create_var(name="optest_w", shape=w.shape,
-                                 dtype=str(w.dtype), stop_gradient=True,
-                                 is_data=True)
-                weighted = fluid.layers.elementwise_mul(out_v, block.var("optest_w"))
-                return fluid.layers.reduce_sum(weighted), {"optest_w": w}
-            return fluid.layers.reduce_sum(out_v), {}
+            extra = {}
+            total = None
+            for out_name in out_names:
+                out_v = block.var(out_name)
+                if loss_weights is not None and out_name == out_names[0]:
+                    # loss_weights applies to the primary output (its
+                    # documented contract); later slot vars sum plainly
+                    w = np.asarray(loss_weights)
+                    block.create_var(name="optest_w", shape=w.shape,
+                                     dtype=str(w.dtype), stop_gradient=True,
+                                     is_data=True)
+                    out_v = fluid.layers.elementwise_mul(
+                        out_v, block.var("optest_w"))
+                    extra["optest_w"] = w
+                term = fluid.layers.reduce_sum(out_v)
+                total = term if total is None else total + term
+            return total, extra
 
         with program_guard(main, startup):
-            loss, extra_feed = append_loss(main, out_var_name)
+            loss, extra_feed = append_loss(main, out_var_names)
             feed = {**feed, **extra_feed}
             backward.append_backward(loss, no_grad_set=no_grad_set)
 
@@ -147,7 +161,7 @@ class OpTest(unittest.TestCase):
         # numeric: central difference on sum(output)
         fwd_main, _, fwd_feed, _, _ = self._build()
         with program_guard(fwd_main):
-            fwd_loss, _ = append_loss(fwd_main, out_var_name)
+            fwd_loss, _ = append_loss(fwd_main, out_var_names)
         exe = Executor(framework.CPUPlace())
         fwd_scope = Scope()
 

@@ -51,8 +51,16 @@ def _c_allreduce(reducer):
 
 
 register_op("c_allreduce_sum", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.psum(x, ax)))
-register_op("c_allreduce_max", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.pmax(x, ax)))
-register_op("c_allreduce_min", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.pmin(x, ax)))
+# max/min via all_gather + reduce rather than lax.pmax/pmin: JAX has no
+# differentiation rule for pmax/pmin, so the auto-derived
+# c_allreduce_{max,min}_grad crashed at trace time (r5
+# tests/test_collective_grads.py); the gather spelling is differentiable
+# (argmax-routed subgradient) and XLA still emits one all-reduce on TPU.
+# Same precedent as c_allreduce_prod below.
+register_op("c_allreduce_max", ["X"], ["Out"],
+            _c_allreduce(lambda x, ax: jnp.max(lax.all_gather(x, ax), axis=0)))
+register_op("c_allreduce_min", ["X"], ["Out"],
+            _c_allreduce(lambda x, ax: jnp.min(lax.all_gather(x, ax), axis=0)))
 # prod via all_gather + product over the device axis: exact for ALL reals
 # (zeros, negatives) like the reference's ncclProd (c_allreduce_op.h:50).
 # A log/exp trick would NaN on negatives and -inf on zeros; gather size is

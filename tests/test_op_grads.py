@@ -509,3 +509,162 @@ def test_fused_embedding_seq_pool_and_fusion_tail_grads():
              "SquaredXY": np.zeros((3, 5), "float32"),
              "Out": np.zeros((3, 5), "float32")})
     t.check_grad(["X", "Y"], "Out", max_relative_error=0.03)
+
+
+def test_conv_shift_cos_sim_minus_lod_reset_grads():
+    rng = _rng()
+    x = rng.uniform(-1, 1, (2, 6)).astype("float32")
+    y = rng.uniform(-1, 1, (2, 3)).astype("float32")
+    t = _mk("conv_shift", {"X": x, "Y": y}, {},
+            {"Out": np.zeros((2, 6), "float32")})
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+    a = rng.uniform(-1, 1, (3, 5)).astype("float32")
+    b = rng.uniform(-1, 1, (3, 5)).astype("float32")
+    t = _mk("cos_sim", {"X": a, "Y": b}, {},
+            {"Out": np.zeros((3, 1), "float32"),
+             "XNorm": np.zeros((3, 1), "float32"),
+             "YNorm": np.zeros((3, 1), "float32")})
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+    t = _mk("minus", {"X": a, "Y": b}, {},
+            {"Out": np.zeros((3, 5), "float32")})
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+    t = _mk("lod_reset", {"X": a}, {"target_lod": [0, 2, 3]},
+            {"Out": np.zeros((3, 5), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_depthwise_conv2d_transpose_and_conv2d_fusion_grads():
+    rng = _rng()
+    x = rng.uniform(-1, 1, (1, 4, 4, 4)).astype("float32")
+    w = rng.uniform(-1, 1, (4, 1, 3, 3)).astype("float32")
+    t = _mk("depthwise_conv2d_transpose", {"Input": x, "Filter": w},
+            {"strides": [2, 2], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 4},
+            {"Output": np.zeros((1, 4, 7, 7), "float32")})
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.02)
+
+    xi = rng.uniform(-1, 1, (1, 3, 5, 5)).astype("float32")
+    wf = rng.uniform(-1, 1, (4, 3, 3, 3)).astype("float32")
+    bias = rng.uniform(-0.3, 0.3, (4,)).astype("float32")
+    t = _mk("conv2d_fusion", {"Input": xi, "Filter": wf, "Bias": bias},
+            {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1, "activation": "relu"},
+            {"Output": np.zeros((1, 4, 5, 5), "float32")})
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.03)
+
+
+def test_deformable_psroi_pooling_grad():
+    rng = _rng()
+    x = rng.uniform(0, 1, (1, 8, 6, 6)).astype("float32")
+    rois = np.array([[0.5, 0.5, 4.0, 4.0]], "float32")
+    trans = np.zeros((1, 2, 2, 2), "float32")
+    bidx = np.zeros((1,), "int32")
+    t = _mk("deformable_psroi_pooling",
+            {"Input": x, "ROIs": rois, "Trans": trans,
+             "RoisBatchIdx": bidx},
+            {"output_dim": 2, "pooled_height": 2, "pooled_width": 2,
+             "group_size": [2, 2], "spatial_scale": 1.0,
+             "part_size": [2, 2], "sample_per_part": 2, "trans_std": 0.1,
+             "no_trans": True},
+            {"Output": np.zeros((1, 2, 2, 2), "float32"),
+             "TopCount": np.zeros((1, 2, 2, 2), "float32")})
+    # bilinear-sampled pooling: tiny per-element grads (~1e-3) sit near
+    # the fp32 central-difference noise floor — tolerance reflects that
+    t.check_grad(["Input"], "Output", max_relative_error=0.12,
+                 numeric_delta=4e-3)
+
+
+def test_fusion_seq_and_embedding_fc_lstm_grads():
+    rng = _rng()
+    x = rng.uniform(-1, 1, (2, 5, 4)).astype("float32")
+    filt = rng.uniform(-1, 1, (3 * 4, 6)).astype("float32")
+    fb = rng.uniform(-0.3, 0.3, (6,)).astype("float32")
+    t = _mk("fusion_seqconv_eltadd_relu",
+            {"X": x, "Filter": filt, "Bias": fb},
+            {"contextLength": 3, "contextStart": -1, "contextStride": 1},
+            {"Out": np.zeros((2, 5, 6), "float32"),
+             "ColMat": np.zeros((2, 5, 12), "float32")})
+    # relu kinks + small grads near the fp32 diff noise floor
+    t.check_grad(["X", "Filter"], "Out", max_relative_error=0.06)
+
+    seq = rng.uniform(-1, 1, (2, 3, 4)).astype("float32")
+    row = rng.uniform(-1, 1, (2, 4)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (8, 6)).astype("float32")
+    # identity activation for the grad check: a pre-activation value
+    # crossing relu's kink inside the central difference halves the
+    # numeric grad (exact factor-2 artifact); relu is covered forward
+    t = _mk("fusion_seqexpand_concat_fc",
+            {"X": [("fse_a", seq), ("fse_b", row)], "FCWeight": w},
+            {"fc_activation": ""},
+            {"Out": np.zeros((2, 3, 6), "float32"),
+             "FCOut": np.zeros((2, 3, 6), "float32")})
+    t.check_grad(["X", "FCWeight"], "Out", max_relative_error=0.03)
+
+    ids = rng.randint(0, 10, (2, 4)).astype("int64")
+    emb = rng.uniform(-0.5, 0.5, (10, 12)).astype("float32")  # 4*D, D=3
+    wh = rng.uniform(-0.5, 0.5, (3, 12)).astype("float32")
+    bias = rng.uniform(-0.2, 0.2, (1, 12)).astype("float32")
+    t = _mk("fused_embedding_fc_lstm",
+            {"Ids": ids, "Embeddings": emb, "WeightH": wh, "Bias": bias},
+            {},
+            {"Hidden": np.zeros((2, 4, 3), "float32"),
+             "Cell": np.zeros((2, 4, 3), "float32"),
+             "XX": np.zeros((2, 4, 12), "float32")})
+    t.check_grad(["Embeddings", "WeightH"], "Hidden",
+                 max_relative_error=0.03)
+
+
+def test_fake_quantize_grads_are_straight_through():
+    """fake_quantize family backprops the STRAIGHT-THROUGH estimator:
+    d out/d x == 1 (the staircase's true derivative is 0 a.e., which
+    would kill QAT training — fake_quantize_op.h backward passes the
+    gradient through).  Central differences would measure the staircase,
+    so this asserts the ANALYTIC grad is exactly the pass-through."""
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    rng = _rng()
+    x = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    for op_type, extra_in, extra_out in (
+            ("fake_quantize_abs_max", {}, {"OutScale": [1]}),
+            ("fake_quantize_dequantize_moving_average_abs_max",
+             {"InScale": np.array([1.0], "float32"),
+              "InAccum": np.array([0.9], "float32"),
+              "InState": np.array([1.0], "float32")},
+             {"OutScale": [1], "OutAccum": [1], "OutState": [1]}),
+            ("fake_quantize_range_abs_max",
+             {"InScale": np.array([1.0], "float32")},
+             {"OutScale": [1]}),
+    ):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            xv = fluid.data("x", [3, 4], False, dtype="float32")
+            xv.stop_gradient = False
+            blk = main.global_block()
+            ins = {"X": [xv.name]}
+            feed = {"x": x}
+            for slot, arr in extra_in.items():
+                n = f"{op_type}_{slot}"
+                blk.create_var(name=n, shape=arr.shape, dtype="float32",
+                               is_data=True)
+                ins[slot] = [n]
+                feed[n] = arr
+            out = blk.create_var(name=f"{op_type}_out", dtype="float32")
+            outs = {"Out": [out.name]}
+            for slot, shp in extra_out.items():
+                outs[slot] = [f"{op_type}_{slot}_o"]
+                blk.create_var(name=outs[slot][0], dtype="float32")
+            blk.append_op(op_type, inputs=ins, outputs=outs,
+                          attrs={"bit_length": 8, "window_size": 4,
+                                 "moving_rate": 0.9})
+            loss = fluid.layers.reduce_sum(blk.var(out.name))
+            (gx,) = fluid.gradients(loss, [xv])
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (g,) = exe.run(main, feed=feed, fetch_list=[gx])
+        np.testing.assert_allclose(np.asarray(g), np.ones_like(x),
+                                   rtol=1e-6, err_msg=op_type)

@@ -165,3 +165,53 @@ def test_gradients_target_gradients_seed():
         exe.run(startup)
         (g,) = exe.run(main, feed={"x": xv, "w": wv}, fetch_list=[dx])
     np.testing.assert_allclose(np.asarray(g), 2.0 * wv)
+
+
+def test_double_grad_elementwise_and_activation_family():
+    """r5 exec sweep: elementwise_{mul,div,sub}_grad_grad and
+    {leaky_relu,sqrt,square}_grad_grad never lowered anywhere.  For each
+    op f: z = mean((d mean(f(x, w)) / dx)^2); dz/dw vs central
+    differences — the WGAN-GP-style second-order path through each
+    kernel."""
+    b, d = 3, 4
+    rng = np.random.RandomState(1)
+    xv = rng.uniform(0.5, 1.5, (b, d)).astype("float32")  # positive: sqrt/div
+
+    cases = {
+        "elementwise_mul": lambda x, w: layers.elementwise_mul(x, w),
+        "elementwise_div": lambda x, w: layers.elementwise_div(x, w),
+        "elementwise_sub": lambda x, w: layers.elementwise_sub(
+            layers.square(x), w),  # square(x) keeps d2/dx2 nonzero
+        "leaky_relu": lambda x, w: layers.leaky_relu(
+            layers.elementwise_mul(x, w), alpha=0.1),
+        "sqrt": lambda x, w: layers.sqrt(layers.elementwise_mul(x, w)),
+        "square": lambda x, w: layers.square(layers.elementwise_mul(x, w)),
+    }
+    for name, f in cases.items():
+        w0 = rng.uniform(0.5, 1.5, (b, d)).astype("float32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[d], dtype="float32")
+            x.stop_gradient = False
+            w = layers.create_parameter([b, d], "float32", name="W2")
+            y = layers.mean(f(x, w))
+            (dx,) = fluid.gradients(y, x)
+            z = layers.mean(layers.square(dx))
+            (dw,) = fluid.gradients(z, w)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+
+        def run_z(wv):
+            with scope_guard(Scope()):
+                exe.run(startup)
+                fluid.global_scope().set("W2", wv.astype("float32"))
+                (zv,) = exe.run(main, feed={"x": xv}, fetch_list=[z])
+            return float(np.asarray(zv))
+
+        with scope_guard(Scope()):
+            exe.run(startup)
+            fluid.global_scope().set("W2", w0)
+            zv, dwv = exe.run(main, feed={"x": xv}, fetch_list=[z, dw])
+        num = _numeric_grad(run_z, w0.astype("float64"))
+        np.testing.assert_allclose(np.asarray(dwv), num, rtol=3e-2,
+                                   atol=3e-4, err_msg=name)

@@ -239,8 +239,50 @@ def _register_auto_grad(fwd: OpInfo):
             out = fwd.lower(ctx, *full, attrs=attrs)
             return out if isinstance(out, tuple) else (out,)
 
+        # Lowerings that read slot NAMES off ctx.cur_op (recurrent,
+        # imported-signature control flow) would see the GRAD op here —
+        # whose `outputs` hold only gradient vars — and silently trace an
+        # output-less forward (vjp of nothing = zeros; r5
+        # test_recurrent_grad_through_scan).  Re-point cur_op at a view
+        # carrying the FORWARD's slots, reconstructed from the grad op's
+        # inputs (forward inputs verbatim; outputs = the @GRAD input
+        # names with the suffix stripped, append_backward's contract).
+        gop = getattr(ctx, "cur_op", None)
+        shim = None
+        if gop is not None and getattr(gop, "type", None) == gtype:
+            from types import SimpleNamespace
+
+            fwd_outputs = {}
+            for s in fwd.output_slots:
+                cs = s.rstrip("*")
+                # backward.py's own name convention (backward.py:180):
+                # split, not strip — grad names can carry decorations
+                # (@GRAD@RENAME@c on a second gradients() pass, @GRAD@ZERO
+                # zero-fills, @GRAD@ACC accumulations)
+                fwd_outputs[cs] = [
+                    n.split(GRAD_SUFFIX)[0]
+                    for n in gop.inputs.get(cs + GRAD_SUFFIX, [])
+                ]
+            # keep exactly the forward's DECLARED input slots (suffix
+            # filtering would wrongly drop a nested grad op's legitimate
+            # `outputs@GRAD` forward input in a grad-of-grad re-trace)
+            shim = SimpleNamespace(
+                type=fwd.type,
+                inputs={cs: list(gop.inputs.get(cs, []))
+                        for cs in (s.rstrip("*") for s in fwd.input_slots)},
+                outputs=fwd_outputs,
+                attrs=gop.attrs,
+            )
+
         primals = [fwd_vals[i] for i in diff_idx]
-        outs, vjp_fn = jax.vjp(fwd_fn, *primals)
+        prev_cur_op = gop
+        try:
+            if shim is not None:
+                ctx.cur_op = shim
+            outs, vjp_fn = jax.vjp(fwd_fn, *primals)
+        finally:
+            if shim is not None:
+                ctx.cur_op = prev_cur_op
 
         def cot(o, g):
             if o is None:  # unused output slot (e.g. reshape2's XShape)
@@ -252,9 +294,16 @@ def _register_auto_grad(fwd: OpInfo):
         cots = []
         for slot, o, g in zip(fwd.output_slots, outs, out_grads):
             if fwd.is_variadic(slot):
+                if o is None:  # e.g. an empty parameters@GRAD slot in a
+                    cots.append(None)  # grad-of-grad re-trace
+                    continue
                 gl = list(g) if g is not None else [None] * len(o)
                 gl += [None] * (len(o) - len(gl))
-                cots.append(tuple(cot(oe, ge) for oe, ge in zip(o, gl)))
+                # cotangent container must mirror the output's pytree
+                # type exactly (a grad-of-grad forward returns LISTS for
+                # variadic slots; jax.vjp rejects tuple-vs-list drift)
+                seq = tuple if isinstance(o, tuple) else list
+                cots.append(seq(cot(oe, ge) for oe, ge in zip(o, gl)))
             else:
                 cots.append(cot(o, g))
         grads = vjp_fn(tuple(cots))

@@ -293,7 +293,11 @@ def _spp(ctx, x, attrs):
         pads = [(0, 0), (0, 0), (ph, ph), (pw, pw)]
         xf = x.astype(jnp.float32)
         if ptype == "max":
-            neg = jnp.finfo(jnp.float32).min
+            # init MUST be -inf (not finfo.min): JAX only recognizes the
+            # differentiable reduce_window_max monoid with the true
+            # identity, otherwise reverse-mode autodiff fails at trace
+            # (r5 spp grad check)
+            neg = -jnp.inf
             red = lax.reduce_window(jnp.pad(xf, pads, constant_values=neg),
                                     neg, lax.max, window, strides, "valid")
         else:  # exclusive average: sum / count of valid elements

@@ -668,3 +668,259 @@ def test_fake_quantize_grads_are_straight_through():
             (g,) = exe.run(main, feed=feed, fetch_list=[gx])
         np.testing.assert_allclose(np.asarray(g), np.ones_like(x),
                                    rtol=1e-6, err_msg=op_type)
+
+
+def test_l1_norm_huber_l2dist_spp_grads():
+    rng = _rng()
+    x = np.where(np.abs(z := rng.uniform(-1, 1, (3, 4))) < 0.1, 0.3, z)
+    x = x.astype("float32")
+    t = _mk("l1_norm", {"X": x}, {}, {"Out": np.zeros((), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+    # stay inside one smooth branch of the piecewise loss (a >= -1)
+    xm = rng.uniform(0.2, 0.8, (4, 1)).astype("float32")
+    ym = np.array([[1.0], [0.0], [1.0], [0.0]], "float32")
+    t = _mk("modified_huber_loss", {"X": xm, "Y": ym}, {},
+            {"IntermediateVal": np.zeros((4, 1), "float32"),
+             "Out": np.zeros((4, 1), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+    a = rng.uniform(-1, 1, (3, 5)).astype("float32")
+    b = rng.uniform(-1, 1, (3, 5)).astype("float32")
+    t = _mk("squared_l2_distance", {"X": a, "Y": b}, {},
+            {"sub_result": np.zeros((3, 5), "float32"),
+             "Out": np.zeros((3, 1), "float32")})
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+    # lattice values: spp's max pyramid routes grads through argmax
+    xs = (rng.permutation(2 * 3 * 64).astype("float32") * 0.01).reshape(
+        2, 3, 8, 8)
+    t = _mk("spp", {"X": xs}, {"pyramid_height": 2, "pooling_type": "max"},
+            {"Out": np.zeros((2, 15), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.03,
+                 numeric_delta=2e-3)
+
+
+def test_pool3d_index_unpool_syncbn_grads():
+    rng = _rng()
+    x = (rng.permutation(128).astype("float32") * 0.01).reshape(
+        1, 2, 4, 4, 4)
+    t = _mk("max_pool3d_with_index", {"X": x},
+            {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+             "paddings": [0, 0, 0]},
+            {"Out": np.zeros((1, 2, 2, 2, 2), "float32"),
+             "Mask": np.zeros((1, 2, 2, 2, 2), "int32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.03,
+                 numeric_delta=2e-3)
+
+    pooled = rng.uniform(0.5, 1.5, (1, 1, 2, 2)).astype("float32")
+    idx = np.array([[[[5, 6], [9, 10]]]], "int32")  # distinct positions
+    t = _mk("unpool", {"X": pooled, "Indices": idx},
+            {"unpooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+             "paddings": [0, 0]},
+            {"Out": np.zeros((1, 1, 4, 4), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+    xb = rng.uniform(-1, 1, (4, 3, 3, 3)).astype("float32")
+    w = rng.uniform(0.5, 1.5, xb.shape).astype("float32")
+    t = _mk("sync_batch_norm",
+            {"X": xb, "Scale": rng.uniform(0.5, 1.5, (3,)).astype("float32"),
+             "Bias": rng.uniform(-0.5, 0.5, (3,)).astype("float32"),
+             "Mean": np.zeros(3, "float32"),
+             "Variance": np.ones(3, "float32")},
+            {"momentum": 0.9, "epsilon": 1e-5, "is_test": False},
+            {"Y": np.zeros_like(xb), "MeanOut": np.zeros(3, "float32"),
+             "VarianceOut": np.ones(3, "float32"),
+             "SavedMean": np.zeros(3, "float32"),
+             "SavedVariance": np.ones(3, "float32")})
+    # *_norm grads are the noisiest under fp32 central differences (the
+    # instance_norm check above uses 0.06 too; measured worst 0.067)
+    t.check_grad(["X", "Scale"], "Y", max_relative_error=0.09,
+                 numeric_delta=5e-3, loss_weights=w)
+
+
+def test_fusion_pool_concat_and_float_mod_grads():
+    rng = _rng()
+    xs = rng.uniform(0.1, 1.0, (2, 3, 4)).astype("float32")
+    cvm = np.ones((2, 2), "float32")
+    t = _mk("fusion_seqpool_concat", {"X": [("fpc_x", xs)]},
+            {"pooltype": "SUM"},
+            {"Out": np.zeros((2, 4), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+    t = _mk("fusion_seqpool_cvm_concat",
+            {"X": [("fpcv_x", xs)], "CVM": cvm},
+            {"pooltype": "SUM", "use_cvm": True},
+            {"Out": np.zeros((2, 4), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+    a = rng.uniform(-1, 1, (2, 3, 4)).astype("float32")
+    b2 = rng.uniform(-1, 1, (2, 3, 4)).astype("float32")
+    t = _mk("fusion_transpose_flatten_concat",
+            {"X": [("ftf_a", a), ("ftf_b", b2)]},
+            {"trans_axis": [0, 2, 1], "flatten_axis": 1, "concat_axis": 1},
+            {"Out": np.zeros((2, 24), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+    # float mod: dX = 1 a.e., dY = -floor(x/y); keep x/y off integers
+    xf = np.array([[3.7, 5.2], [7.9, 2.3]], "float32")
+    yf = np.array([[2.0, 3.0], [3.0, 1.5]], "float32")
+    t = _mk("elementwise_mod", {"X": xf, "Y": yf}, {},
+            {"Out": np.zeros((2, 2), "float32")})
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+    # floordiv: piecewise constant — grads are zero a.e.
+    t = _mk("elementwise_floordiv", {"X": xf, "Y": yf}, {},
+            {"Out": np.zeros((2, 2), "float32")})
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+def test_identity_chain_grads_lower():
+    """Identity-grad tail (sync/wait streams, rnn_memory_helper, print,
+    moving_average_abs_max_scale, reorder_lod_tensor_by_rank): backward
+    through a chain must pass cotangents exactly (permutation inverse for
+    the reorder)."""
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    rng = _rng()
+    x = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    lens = np.array([2, 5, 3], "int64")
+    w = rng.uniform(0.5, 1.5, (3, 4)).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        xv = fluid.data("x", [3, 4], False, dtype="float32")
+        xv.stop_gradient = False
+        lv = fluid.data("lens", [3], False, dtype="int64")
+        blk = main.global_block()
+        prev = xv.name
+        for t_op in ("c_sync_calc_stream", "c_wait_compute", "c_wait_comm",
+                     "rnn_memory_helper", "print",
+                     "moving_average_abs_max_scale"):
+            nxt = f"idg_{t_op}"
+            blk.create_var(name=nxt, dtype="float32")
+            outs = {"Out": [nxt]}
+            if t_op == "moving_average_abs_max_scale":
+                blk.create_var(name="idg_scale", dtype="float32")
+                outs["OutScale"] = ["idg_scale"]
+            blk.append_op(t_op, inputs={"X": [prev]}, outputs=outs,
+                          attrs={"message": "idg", "moving_rate": 0.9})
+            prev = nxt
+        blk.create_var(name="reordered", dtype="float32")
+        blk.append_op("reorder_lod_tensor_by_rank",
+                      inputs={"X": [prev], "RankTable": [lv.name]},
+                      outputs={"Out": ["reordered"]}, attrs={})
+        loss = fluid.layers.reduce_sum(
+            blk.var("reordered") * fluid.layers.assign(w))
+        (gx,) = fluid.gradients(loss, [xv])
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"x": x, "lens": lens},
+                       fetch_list=[gx])
+    # rows sorted by descending length: order [1, 2, 0]; cotangent w rows
+    # land back on their source rows (inverse permutation)
+    order = np.argsort(-lens, kind="stable")
+    inv = np.empty(3, "int64")
+    inv[order] = np.arange(3)
+    np.testing.assert_allclose(np.asarray(g), w[inv], rtol=1e-6)
+
+
+def test_recurrent_grad_through_scan():
+    """recurrent op backward: h_t = x_t + h_{t-1} summed — dL/dx_t counts
+    every step from t on (T - t occurrences in the stacked-output sum)."""
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    t_len, b, d = 4, 2, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x_seq", shape=[b, d], dtype="float32")
+        x.stop_gradient = False
+        h0 = layers.data(name="h0", shape=[d], dtype="float32")
+    blk = main.global_block()
+    sub = main._create_block()
+    main._rollback()
+    x_step = sub.create_var(name="x_seq", shape=(b, d), dtype="float32")
+    pre_h = sub.create_var(name="pre_h", shape=(b, d), dtype="float32")
+    new_h = sub.create_var(name="h_new", shape=(b, d), dtype="float32")
+    sub.append_op("elementwise_add", inputs={"X": [x_step], "Y": [pre_h]},
+                  outputs={"Out": [new_h]}, attrs={})
+    out = blk.create_var(name="h_new", shape=(t_len, b, d), dtype="float32")
+    scopes = blk.create_var(name="rnn_scopes", shape=None, dtype=None)
+    blk.append_op(
+        "recurrent",
+        inputs={"inputs": [x], "initial_states": [h0], "parameters": []},
+        outputs={"outputs": [out], "step_scopes": [scopes]},
+        attrs={"ex_states": ["pre_h"], "states": ["h_new"],
+               "sub_block": sub.idx, "reverse": False, "has_states": True})
+    with fluid.program_guard(main, startup):
+        loss = fluid.layers.reduce_sum(blk.var("h_new"))
+        (gx,) = fluid.gradients(loss, [x])
+    rng = _rng()
+    xv = rng.randn(t_len, b, d).astype("float32")
+    hv = rng.randn(b, d).astype("float32")
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"x_seq": xv, "h0": hv},
+                       fetch_list=[gx])
+    want = np.broadcast_to(
+        (t_len - np.arange(t_len))[:, None, None], (t_len, b, d))
+    np.testing.assert_allclose(np.asarray(g), want.astype("float32"),
+                               rtol=1e-6)
+
+
+def test_recurrent_double_gradients_pass():
+    """Second gradients() pass over a recurrent program (the WGAN-GP
+    double-grad pattern): decorated grad names (@GRAD@RENAME@c) must
+    still resolve to the forward output names in the cur_op shim
+    (review r5)."""
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    t_len, b, d = 3, 2, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x_seq", shape=[b, d], dtype="float32")
+        x.stop_gradient = False
+        h0 = layers.data(name="h0", shape=[d], dtype="float32")
+    blk = main.global_block()
+    sub = main._create_block()
+    main._rollback()
+    x_step = sub.create_var(name="x_seq", shape=(b, d), dtype="float32")
+    pre_h = sub.create_var(name="pre_h", shape=(b, d), dtype="float32")
+    sq = sub.create_var(name="sq", shape=(b, d), dtype="float32")
+    new_h = sub.create_var(name="h_new", shape=(b, d), dtype="float32")
+    sub.append_op("square", inputs={"X": [x_step]}, outputs={"Out": [sq]},
+                  attrs={})
+    sub.append_op("elementwise_add", inputs={"X": [sq], "Y": [pre_h]},
+                  outputs={"Out": [new_h]}, attrs={})
+    out = blk.create_var(name="h_new", shape=(t_len, b, d), dtype="float32")
+    scopes = blk.create_var(name="rnn_scopes", shape=None, dtype=None)
+    blk.append_op(
+        "recurrent",
+        inputs={"inputs": [x], "initial_states": [h0], "parameters": []},
+        outputs={"outputs": [out], "step_scopes": [scopes]},
+        attrs={"ex_states": ["pre_h"], "states": ["h_new"],
+               "sub_block": sub.idx, "reverse": False, "has_states": True})
+    with fluid.program_guard(main, startup):
+        y = fluid.layers.reduce_sum(blk.var("h_new"))
+        (dx,) = fluid.gradients(y, [x])
+        z = fluid.layers.reduce_sum(fluid.layers.square(dx))
+        (ddx,) = fluid.gradients(z, [x])
+    rng = _rng()
+    xv = rng.randn(t_len, b, d).astype("float32")
+    hv = rng.randn(b, d).astype("float32")
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (g2,) = exe.run(main, feed={"x_seq": xv, "h0": hv},
+                        fetch_list=[ddx])
+    # y = sum_t sum over (T-t) copies of x_t^2 (+h0 terms): dy/dx_t =
+    # 2*(T-t)*x_t, z = sum (dy/dx)^2 → dz/dx_t = 8*(T-t)^2*x_t
+    want = 8.0 * ((t_len - np.arange(t_len))[:, None, None] ** 2) * xv
+    np.testing.assert_allclose(np.asarray(g2), want.astype("float32"),
+                               rtol=1e-5)

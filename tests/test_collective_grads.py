@@ -99,3 +99,70 @@ def test_collective_grad_matches_jax_autodiff(op_type):
     want = np.asarray(jax.grad(global_loss)(jnp.asarray(data)))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5,
                                err_msg=op_type)
+
+
+def test_c_allreduce_prod_and_embedding_grads():
+    """The two collectives outside the uniform X→Out pattern:
+    prod (gather+product spelling) and the vocab-sharded embedding's
+    W gradient (psum of per-shard scatter-adds)."""
+    mesh = pmesh.build_mesh({"dp": N_DEV})
+    data = np.random.RandomState(5).uniform(
+        0.5, 1.5, (64, 16)).astype("float32")  # positive: prod stability
+
+    main = fluid.Program()
+    with fluid.program_guard(main), fluid.unique_name.guard():
+        x = fluid.data("x", [64, 16], False, dtype="float32")
+        x.stop_gradient = False
+        block = main.global_block()
+        y = block.create_var(name="prod_out", dtype="float32")
+        block.append_op("c_allreduce_prod", inputs={"X": [x]},
+                        outputs={"Out": [y]},
+                        attrs={"ring_id": 0, "nranks": N_DEV})
+        loss = fluid.layers.reduce_sum(y)
+        (gx,) = fluid.gradients(loss, [x])
+
+    def prog_grad(xs):
+        env = {"x": xs}
+        ctx = registry.LowerContext(mesh_axes=("dp",), block=block)
+        trace_block(block, env, ctx)
+        return env[gx.name]
+
+    got = np.asarray(jax.jit(jax.shard_map(
+        prog_grad, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False))(data))
+
+    def global_loss(xg):
+        part = jax.shard_map(
+            lambda xs: jnp.sum(jnp.prod(lax.all_gather(xs, "dp"),
+                                        axis=0))[None],
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+            check_vma=False)(xg)
+        return jnp.sum(part)
+
+    want = np.asarray(jax.grad(global_loss)(jnp.asarray(data)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # c_embedding W grad (single shard contract): out-of-range ids
+    # contribute nothing; in-range rows accumulate the cotangent
+    main = fluid.Program()
+    with fluid.program_guard(main), fluid.unique_name.guard():
+        w = fluid.data("w", [4, 3], False, dtype="float32")
+        w.stop_gradient = False
+        ids = fluid.data("ids", [1, 4], False, dtype="int64")
+        block = main.global_block()
+        out = block.create_var(name="cemb_out", dtype="float32")
+        block.append_op("c_embedding", inputs={"W": [w], "Ids": [ids]},
+                        outputs={"Out": [out]}, attrs={"start_index": 4})
+        loss = fluid.layers.reduce_sum(block.var("cemb_out"))
+        (gw,) = fluid.gradients(loss, [w])
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    wv = np.random.RandomState(6).randn(4, 3).astype("float32")
+    idv = np.array([[2, 5, 7, 5]], "int64")  # shard covers [4, 8)
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        (g,) = exe.run(main, feed={"w": wv, "ids": idv}, fetch_list=[gw])
+    expect = np.zeros((4, 3), "float32")
+    expect[1] = 2.0  # id 5 twice
+    expect[3] = 1.0  # id 7 once
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-6)

@@ -135,26 +135,50 @@ def _run_leg(leg, build, feed, flops, n_int8, config):
             "value": round(flops / dt / 1e12, 2), "unit": "TFLOP/s",
             "ms_per_batch": round(dt * 1e3, 3), "config": config,
         }), flush=True)
-    if "bf16" in results and "int8" in results:
+    speedup = (round(results["bf16"] / results["int8"], 3)
+               if "bf16" in results and "int8" in results else None)
+    if speedup is not None:
         print(json.dumps({
             "metric": f"{leg}_int8_speedup_vs_bf16",
-            "value": round(results["bf16"] / results["int8"], 3),
-            "unit": "x"}), flush=True)
+            "value": speedup, "unit": "x"}), flush=True)
+    rec = {tag: round(dt * 1e3, 3) for tag, dt in results.items()}
+    if speedup is not None:
+        rec["int8_speedup_vs_bf16"] = speedup
+    return rec
 
 
 def main():
+    # machinery mode (Suite.setup sets PT_BENCH_FORCE_CPU=1): force the
+    # CPU platform via the config API — the ambient sitecustomize freezes
+    # platform selection, so env alone is ignored and a wedged tunnel
+    # would hang the whole budget — and stamp the record CPU-FALLBACK so
+    # these timings can never read as chip numbers (bench.py pattern)
+    fallback = ""
+    if os.environ.get("PT_BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        fallback = " CPU-FALLBACK"
     rng = np.random.RandomState(0)
     legs = os.environ.get("PT_I8_LEGS", "dense,cnn").split(",")
+    summary = {"metric": "int8_serve_summary"}
+    if fallback:
+        summary["config"] = fallback.strip()
     if "dense" in legs:
-        _run_leg("dense", _build,
-                 {"x": rng.randn(BATCH, DIN).astype("float32")}, _flops(),
-                 LAYERS + 1, f"mlp d{DIN} h{HID} x{LAYERS} b{BATCH}")
+        summary["dense"] = _run_leg(
+            "dense", _build,
+            {"x": rng.randn(BATCH, DIN).astype("float32")}, _flops(),
+            LAYERS + 1, f"mlp d{DIN} h{HID} x{LAYERS} b{BATCH}")
     if "cnn" in legs:
-        _run_leg("cnn", _build_cnn,
-                 {"img": rng.randn(CNN_BATCH, 3, CNN_SIZE,
-                                   CNN_SIZE).astype("float32")},
-                 _cnn_flops(), CNN_LAYERS + 1,
-                 f"cnn c{CNN_CH} x{CNN_LAYERS} s{CNN_SIZE} b{CNN_BATCH}")
+        summary["cnn"] = _run_leg(
+            "cnn", _build_cnn,
+            {"img": rng.randn(CNN_BATCH, 3, CNN_SIZE,
+                              CNN_SIZE).astype("float32")},
+            _cnn_flops(), CNN_LAYERS + 1,
+            f"cnn c{CNN_CH} x{CNN_LAYERS} s{CNN_SIZE} b{CNN_BATCH}")
+    # one final line carrying every number — bench_onchip_all's int8 leg
+    # records the LAST json line, so the whole A/B survives the capture
+    print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

@@ -88,6 +88,9 @@ def _captured(entry):
         # a sweep where every leg failed prints {"flash_speedup": {}} —
         # that is not a capture, retry it
         return bool(entry["flash_speedup"])
+    if entry.get("metric") == "int8_serve_summary":
+        # the int8 A/B summary must actually carry a leg's numbers
+        return bool(entry.get("dense") or entry.get("cnn"))
     return any(k in entry for k in ("value", "rc", "full_step"))
 
 
@@ -239,8 +242,16 @@ class Suite:
                 env=env, capture_output=True, text=True, timeout=timeout)
             lines = [ln for ln in out.stdout.splitlines()
                      if ln.startswith("{")]
-            rec = (json.loads(lines[-1]) if lines
-                   else {"error": out.stderr[-400:]})
+            # a crashed child may still have printed partial JSON lines —
+            # recording them would mark the leg captured forever with
+            # partial data (run_bench checks returncode; so must we)
+            if out.returncode != 0:
+                rec = {"error": f"rc={out.returncode}: "
+                                + out.stderr[-400:]}
+            elif lines:
+                rec = json.loads(lines[-1])
+            else:
+                rec = {"error": out.stderr[-400:]}
         except subprocess.TimeoutExpired:
             rec = {"error": f"{label} timeout {timeout:.0f}s"}
         except json.JSONDecodeError as e:
@@ -301,8 +312,20 @@ class Suite:
                if self.machinery else {})
         self._run_tool("longseq", "bench_longseq.py", budget * 7, env)
 
+    def int8_serve(self, budget):
+        # int8 vs bf16 vs fp32 serving A/B, dense + CNN legs (the r5
+        # int8_conv2d path) — the final summary line carries every number.
+        # Pin the leg list and drop stale shape knobs: ambient PT_I8_*
+        # from a manual run must not silently narrow or resize the A/B
+        # (the run_bench PT_BENCH_CHAIN_STEPS lesson).
+        for knob in list(os.environ):
+            if knob.startswith("PT_I8_"):
+                os.environ.pop(knob)
+        self._run_tool("int8_serve", "bench_int8_serve.py", budget * 2,
+                       {"PT_I8_LEGS": "dense,cnn"})
+
     EXTRA_LEGS = ("dataset_overlap", "onchip_smoke", "profile_step",
-                  "longseq")
+                  "longseq", "int8_serve")
 
     def done(self, label):
         return (_captured(self.results.get(label))
@@ -338,6 +361,7 @@ def main():
         suite.smoke(budget)
         suite.profile(budget)
         suite.longseq(budget)
+        suite.int8_serve(budget)
         if suite.complete():
             break
     if not ran:

@@ -340,3 +340,64 @@ def test_host_dispatch_overhead_budget():
         f"host dispatch {best * 1e3:.2f} ms/step exceeds the budget "
         f"{budget * 1e3:.2f} ms (measured 0.09 ms at calib "
         f"{best_ref * 1e6:.1f} us; something O(n) crept into run())")
+
+
+# ---------------------------------------------------------------------------
+# decode flagship (GPT KV-cache scan): decode is HBM-BOUND — every
+# generated token streams the weights + caches, so an fp32 KV cache
+# (or fp32 weights) doubles serving bandwidth invisibly (r5)
+# ---------------------------------------------------------------------------
+
+
+def test_decode_flagship_caches_and_weights_bf16():
+    """Decode gate: the while-loop CARRIES — the KV caches plus the
+    token/score state that round-trips HBM every generated token — hold
+    no cache-sized fp32 tensor under the policy.  (Weights convert to
+    bf16 ONCE outside the scan and ride the loop narrow; the flash
+    reference path's fp32 dots are internal compute over bf16 storage,
+    replaced by the Pallas kernel on TPU and pinned by
+    test_flash_attention — so carries, not dots, are the decode HBM
+    contract.)"""
+    import re
+
+    from paddle_tpu.models import gpt
+
+    prompt_len, gen_len, batch = 8, 8, 4
+    cfg = gpt.GPTConfig(vocab_size=256, hidden_size=32, num_heads=2,
+                        num_layers=2, intermediate_size=64,
+                        max_position=prompt_len + gen_len + 8)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        prompt_var, out_var, _scores = gpt.build_gpt_generate_scan(
+            cfg, prompt_len=prompt_len, gen_len=gen_len)
+    mp.enable_bf16_policy(main)
+    rng = np.random.RandomState(0)
+    batch_feed = {prompt_var.name: rng.randint(
+        0, cfg.vocab_size, (batch, prompt_len)).astype("int64")}
+    plan, donated, readonly = _plan_and_buffers(main, startup, out_var,
+                                               batch_feed)
+    lowered = jax.jit(plan.make_body(), donate_argnums=(0,)).lower(
+        donated, readonly, batch_feed, np.uint32(0))
+    lines = lowered.as_text().splitlines()
+
+    def big_typed(ln, dt, threshold):
+        found = []
+        for m in re.finditer(rf"tensor<([0-9x]+)x{dt}>", ln):
+            n = 1
+            for d in m.group(1).split("x"):
+                n *= int(d)
+            if n >= threshold:
+                found.append(m.group(0))
+        return found
+
+    cache_elems = batch * cfg.num_heads * (prompt_len + gen_len) * (
+        cfg.hidden_size // cfg.num_heads)
+    while_lines = [ln for ln in lines if "stablehlo.while" in ln]
+    assert while_lines, "expected the scan-decode while loop"
+    big_f32 = [t for ln in while_lines
+               for t in big_typed(ln, "f32", cache_elems)]
+    assert not big_f32, (
+        f"fp32 while-carries >= cache size in bf16 decode: {big_f32}")
+    # vacuity guard: the carries DO include cache-sized bf16 tensors
+    assert any(big_typed(ln, "bf16", cache_elems) for ln in while_lines), \
+        "no cache-sized bf16 while-carry found — scan shape changed?"

@@ -163,3 +163,41 @@ def test_ring_bf16_gradients():
         np.testing.assert_allclose(np.asarray(a, dtype="float32"),
                                    np.asarray(b), rtol=6e-2, atol=6e-2,
                                    err_msg=f"d{name} mismatch")
+
+
+def test_ring_bounds_score_memory_at_long_sequence():
+    """The long-context CLAIM, measured: ring attention never
+    materializes the [S, S] score matrix — per-device temp memory stays
+    ~S*(S/sp) blockwise.  At s=1024 sp=8 the compiled temp footprint
+    measured 0.36 MB vs 16.8 MB for full attention (45x); gate at 16x so
+    XLA layout noise can't flake it.  This is the property that makes
+    sequence lengths beyond HBM's S^2 budget reachable at all
+    (SURVEY §5 long-context)."""
+    b, h, s, d = 1, 2, 1024, 32
+    rng = np.random.RandomState(0)
+    q = rng.randn(b, h, s, d).astype("float32")
+    k = rng.randn(b, h, s, d).astype("float32")
+    v = rng.randn(b, h, s, d).astype("float32")
+
+    mesh = pmesh.build_mesh({"sp": 8})
+    ring = jax.jit(lambda qq, kk, vv: ring_attention(
+        qq, kk, vv, causal=False, mesh=mesh))
+    ring_tmp = ring.lower(q, k, v).compile().memory_analysis() \
+        .temp_size_in_bytes
+
+    def full(qq, kk, vv):
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qq, kk) / np.sqrt(d)
+        return jnp.einsum("bhqk,bhkd->bhqd",
+                          jax.nn.softmax(sc, axis=-1), vv)
+
+    full_j = jax.jit(full)
+    full_tmp = full_j.lower(q, k, v).compile().memory_analysis() \
+        .temp_size_in_bytes
+    assert full_tmp >= b * h * s * s * 4, "full attention should hold S^2"
+    assert ring_tmp * 16 <= full_tmp, (
+        f"ring temp {ring_tmp:,}B not <= 1/16 of full {full_tmp:,}B — "
+        "the [S,S] scores are materializing somewhere")
+    # and the numbers still agree at this scale
+    np.testing.assert_allclose(np.asarray(ring(q, k, v)),
+                               np.asarray(full_j(q, k, v)),
+                               rtol=2e-4, atol=2e-5)

@@ -401,3 +401,49 @@ def test_decode_flagship_caches_and_weights_bf16():
     # vacuity guard: the carries DO include cache-sized bf16 tensors
     assert any(big_typed(ln, "bf16", cache_elems) for ln in while_lines), \
         "no cache-sized bf16 while-carry found — scan shape changed?"
+
+
+def test_run_steps_chain_temp_memory_is_step_bounded():
+    """Chained dispatch gate: run_steps compiles n steps into ONE
+    fori_loop executable — its TEMP memory must stay within ~2x the
+    single step's (the loop body reuses buffers per iteration), never
+    scale with n.  A regression that unrolls the chain (or carries
+    per-iteration live buffers) would multiply peak HBM by n_steps and
+    OOM real models at chain lengths the dispatch win needs."""
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, loss, mlm, nsp = bert.build_bert_pretrain(cfg, is_test=False)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    batch = bert.make_fake_batch(cfg, batch=8, seq_len=32, seed=0)
+    n_steps = 16
+    sc = Scope()
+    with scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=batch, fetch_list=[loss.name])
+        single = exe.cost_analysis(main, batch, fetch_list=[loss.name])
+        stacked = {k: np.stack([np.asarray(v)] * n_steps)
+                   for k, v in batch.items()}
+        exe.run_steps(main, stacked, n_steps=n_steps,
+                      fetch_list=[loss.name], stacked_feed=True)
+        temps = []
+        for cb in exe.compiled_for(main):
+            for feed in (stacked, batch):
+                try:
+                    rec = cb.cost_analysis(sc, feed, 0)
+                except Exception:
+                    continue
+                t = rec["memory"].get("temp_size_in_bytes")
+                if t is not None:
+                    temps.append(t)
+                break
+    single_temp = single["memory"].get("temp_size_in_bytes")
+    if single_temp is None or not temps:
+        pytest.skip("backend exposes no memory analysis")
+    chain_temp = max(temps)
+    assert chain_temp <= 2 * single_temp + (1 << 20), (
+        f"chain-{n_steps} temp {chain_temp:,}B vs single step "
+        f"{single_temp:,}B — the fori_loop is not reusing step buffers")

@@ -419,9 +419,20 @@ S("softmax_with_cross_entropy",
       "Softmax": _softmax(Logits),
       "Loss": -np.log(_softmax(Logits)[np.arange(4), Label[:, 0]])[:, None]},
   grads=["Logits"], out_slots=("Softmax", "Loss"), grad_out="Loss")
+def _bpr_ref(X, Label):
+    """bpr_loss_op.h: -mean_j log(sigmoid(x[label] - x[j])), j != label."""
+    n, c = X.shape
+    out = np.zeros((n, 1), "float32")
+    for i in range(n):
+        li = int(Label[i, 0])
+        diffs = X[i, li] - np.delete(X[i], li)
+        out[i, 0] = -np.mean(np.log(1.0 / (1.0 + np.exp(-diffs)) + 1e-12))
+    return out
+
+
 S("bpr_loss", {"X": _softmax(rnd(3, 4, seed=71)),
                "Label": ints(3, 1, lo=0, hi=4)},
-  None, grads=["X"], out_slots=("Y",))
+  _bpr_ref, grads=["X"], out_slots=("Y",), mre=0.02)
 S("teacher_student_sigmoid_loss",
   {"X": rnd(4, 1, seed=72), "Label": pos(4, 1, lo=0.1, hi=0.9)},
   None, grads=["X"], out_slots=("Y",))
@@ -618,8 +629,22 @@ def _space_to_depth_ref(x, b):
         dy, dx = off // b, off % b
         out[:, off * c:(off + 1) * c] = x[:, :, dy::b, dx::b]
     return out
+def _temporal_shift_ref(X, seg=2, ratio=0.25):
+    """TSM (temporal_shift_op.cc): first C*ratio channels shift t<-t+1,
+    next C*ratio shift t<-t-1, rest stay; zero padding at segment edges."""
+    nt, c, h, w = X.shape
+    r = X.reshape(nt // seg, seg, c, h, w)
+    fold = int(c * ratio)
+    out = np.zeros_like(r)
+    out[:, :-1, :fold] = r[:, 1:, :fold]
+    out[:, 1:, fold:2 * fold] = r[:, :-1, fold:2 * fold]
+    out[:, :, 2 * fold:] = r[:, :, 2 * fold:]
+    return out.reshape(nt, c, h, w)
+
+
 S("temporal_shift", {"X": rnd(4, 4, 2, 2, seed=116)},
-  None, attrs={"seg_num": 2, "shift_ratio": 0.25}, grads=["X"])
+  _temporal_shift_ref,
+  attrs={"seg_num": 2, "shift_ratio": 0.25}, grads=["X"])
 S("affine_channel", {"X": rnd(2, 3, 2, 2, seed=117),
                      "Scale": pos(3, seed=118), "Bias": rnd(3, seed=119)},
   lambda X, Scale, Bias: X * Scale[:, None, None] + Bias[:, None, None],
@@ -627,15 +652,32 @@ S("affine_channel", {"X": rnd(2, 3, 2, 2, seed=117),
 S("grid_sampler",
   {"X": rnd(1, 2, 4, 4, seed=120),
    "Grid": rnd(1, 3, 3, 2, seed=121, lo=-0.9, hi=0.9)},
-  None, out_slots=("Output",), grads=["X"], mre=0.05, tols=(1e-4, 1e-3))
+  _tt(lambda torch, X, Grid: torch.nn.functional.grid_sample(
+      X, Grid, mode="bilinear", padding_mode="zeros",
+      align_corners=True)),
+  out_slots=("Output",), grads=["X"], mre=0.05, tols=(1e-4, 1e-3))
 S("dropout", {"X": rnd(3, 4, seed=122)}, lambda X: X * (1 - 0.35),
   attrs={"dropout_prob": 0.35, "is_test": True},
   out_slots=("Out", "Mask"), no_check=("Mask",), grads=())
 S("fsp", {"X": rnd(2, 3, 4, 4, seed=123), "Y": rnd(2, 5, 4, 4, seed=124)},
   lambda X, Y: np.einsum("nchw,ndhw->ncd", X, Y) / 16.0, mre=0.02)
+def _row_conv_ref(X, Filter, Length):
+    """Lookahead (row) convolution, row_conv_op.cc: out[b,t] =
+    sum_i x[b,t+i] * w[i], future context only, zero past the end."""
+    b, t, d = X.shape
+    k = Filter.shape[0]
+    out = np.zeros_like(X)
+    for bb in range(b):
+        for tt in range(t):
+            for i in range(k):
+                if tt + i < min(t, int(Length[bb])):
+                    out[bb, tt] += X[bb, tt + i] * Filter[i]
+    return out
+
+
 S("row_conv", {"X": rnd(1, 6, 4, seed=125), "Filter": rnd(3, 4, seed=126),
                "Length": np.int64([6])},
-  None, grads=["X", "Filter"], mre=0.02)
+  _row_conv_ref, grads=["X", "Filter"], mre=0.02)
 
 # ---------------------------------------------------------------------------
 # optimizer ops — textbook formulas as the independent reference
@@ -704,18 +746,62 @@ S("decayed_adagrad", {"Param": P, "Grad": G, "Moment": M2,
 S("proximal_gd", {"Param": P, "Grad": G, "LearningRate": LR},
   lambda Param, Grad, LearningRate: Param - 0.1 * Grad,
   attrs={"l1": 0.0, "l2": 0.0}, grads=(), out_slots=("ParamOut",))
+def _ftrl_ref(Param, SquaredAccumulator, LinearAccumulator, Grad,
+              LearningRate):
+    """FTRL-proximal (McMahan et al.; ftrl_op.h), defaults l1=l2=0,
+    lr_power=-0.5."""
+    lr = float(LearningRate.reshape(-1)[0])
+    new_sq = SquaredAccumulator + Grad ** 2
+    sigma = (np.sqrt(new_sq) - np.sqrt(SquaredAccumulator)) / lr
+    new_lin = LinearAccumulator + Grad - sigma * Param
+    y = np.sqrt(new_sq) / lr
+    return {"ParamOut": -new_lin / y, "SquaredAccumOut": new_sq,
+            "LinearAccumOut": new_lin}
+
+
 S("ftrl", {"Param": P, "SquaredAccumulator": M2,
            "LinearAccumulator": M1, "Grad": G, "LearningRate": LR},
-  None, grads=(),
-  out_slots=("ParamOut", "SquaredAccumOut", "LinearAccumOut"))
+  _ftrl_ref, grads=(),
+  out_slots=("ParamOut", "SquaredAccumOut", "LinearAccumOut"), mre=0.02)
+def _lamb_ref(Param, Grad, Moment1, Moment2, LearningRate, Beta1Pow,
+              Beta2Pow):
+    """LAMB (You et al., arXiv:1904.00962), defaults b1=.9 b2=.999
+    eps=1e-6 wd=0.01; trust ratio ||p||/||r||."""
+    b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+    lr = float(LearningRate.reshape(-1)[0])
+    m1 = b1 * Moment1 + (1 - b1) * Grad
+    m2 = b2 * Moment2 + (1 - b2) * Grad ** 2
+    mh = m1 / (1 - float(Beta1Pow[0]))
+    vh = m2 / (1 - float(Beta2Pow[0]))
+    r = mh / (np.sqrt(vh) + eps) + wd * Param
+    pn = np.linalg.norm(Param)
+    rn = np.linalg.norm(r)
+    trust = pn / rn if pn > 0 and rn > 0 else 1.0
+    return {"ParamOut": Param - lr * trust * r, "Moment1Out": m1,
+            "Moment2Out": m2, "Beta1PowOut": Beta1Pow * b1,
+            "Beta2PowOut": Beta2Pow * b2}
+
+
 S("lamb", {"Param": P, "Grad": G, "Moment1": M1 * 0, "Moment2": M2 * 0,
            "LearningRate": LR, "Beta1Pow": np.float32([0.9]),
            "Beta2Pow": np.float32([0.999])},
-  None, grads=(), out_slots=("ParamOut", "Moment1Out", "Moment2Out",
-                             "Beta1PowOut", "Beta2PowOut"))
+  _lamb_ref, grads=(), out_slots=("ParamOut", "Moment1Out", "Moment2Out",
+                                  "Beta1PowOut", "Beta2PowOut"), mre=0.02)
+def _lars_ref(Param, Grad, Velocity, LearningRate):
+    """LARS (You et al., arXiv:1708.03888; lars_momentum_op.cc), defaults
+    mu=.9 coeff=.001 wd=.0005."""
+    mu, coeff, wd, eps = 0.9, 0.001, 0.0005, 1e-9
+    lr = float(LearningRate.reshape(-1)[0])
+    pn = np.linalg.norm(Param)
+    gn = np.linalg.norm(Grad)
+    local = coeff * pn / (gn + wd * pn + eps) if pn > 0 else 1.0
+    v = mu * Velocity + lr * local * (Grad + wd * Param)
+    return {"ParamOut": Param - v, "VelocityOut": v}
+
+
 S("lars_momentum", {"Param": P, "Grad": G, "Velocity": M1,
                     "LearningRate": LR},
-  None, grads=(), out_slots=("ParamOut", "VelocityOut"))
+  _lars_ref, grads=(), out_slots=("ParamOut", "VelocityOut"), mre=0.02)
 
 # ---------------------------------------------------------------------------
 # embeddings / misc tensor ops
@@ -888,7 +974,15 @@ S("center_loss",
   out_slots=("CentersOut", "SampleCenterDiff", "Loss"),
   no_check=("CentersOut", "SampleCenterDiff"), grad_out="Loss")
 S("softmax_mask_fuse_upper_triangle", {"X": rnd(1, 1, 4, 4, seed=171)},
-  None, grads=["X"], mre=0.05)
+  lambda X: np.stack([np.stack([
+      np.exp(np.where(np.tril(np.ones((4, 4), bool)), r, -np.inf)
+             - np.where(np.tril(np.ones((4, 4), bool)), r, -np.inf)
+             .max(-1, keepdims=True))
+      / np.exp(np.where(np.tril(np.ones((4, 4), bool)), r, -np.inf)
+               - np.where(np.tril(np.ones((4, 4), bool)), r, -np.inf)
+               .max(-1, keepdims=True)).sum(-1, keepdims=True)
+      for r in b_]) for b_ in X]),
+  grads=["X"], mre=0.05)
 S("assign_value", {},
   lambda: np.float32([[1.5, 2.5], [3.5, 4.5]]),
   attrs={"shape": [2, 2], "dtype": 5,
@@ -1031,10 +1125,28 @@ S("npair_loss_op",
   {"Anchor": rnd(4, 6, seed=193), "Positive": rnd(4, 6, seed=194),
    "Labels": np.int64([0, 1, 1, 2])},
   None, grads=["Anchor", "Positive"], mre=0.03)
+def _mean_iou_ref(Predictions, Labels):
+    """mean_iou_op.h: per-class IoU = tp / (pred_i + label_i - tp),
+    averaged over classes that appear."""
+    n = 3
+    ious = []
+    p = Predictions.reshape(-1)
+    l = Labels.reshape(-1)
+    for c in range(n):
+        tp = int(((p == c) & (l == c)).sum())
+        denom = int((p == c).sum() + (l == c).sum() - tp)
+        if denom > 0:
+            ious.append(tp / denom)
+    return {"OutMeanIou": np.float32(np.mean(ious)),
+            "OutWrong": np.int32([int((p != l).sum())]),
+            "OutCorrect": np.int32([int((p == l).sum())])}
+
+
 S("mean_iou", {"Predictions": np.int64([[0, 1], [2, 1]]),
                "Labels": np.int64([[0, 1], [1, 1]])},
-  None, attrs={"num_classes": 3},
-  out_slots=("OutMeanIou", "OutWrong", "OutCorrect"), grads=())
+  _mean_iou_ref, attrs={"num_classes": 3},
+  out_slots=("OutMeanIou", "OutWrong", "OutCorrect"), grads=(),
+  no_check=("OutWrong", "OutCorrect"))
 S("decoupled_weight_decay", {"Param": P, "LearningRate": LR},
   lambda Param, LearningRate: (Param * (1 - 0.1 * 0.01)).astype("float32"),
   attrs={"coeff": 0.01}, grads=(), out_slots=("ParamOut",))

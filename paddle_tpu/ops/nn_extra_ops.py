@@ -232,9 +232,12 @@ def _data_norm(ctx, x, bsize, bsum, bsq, attrs):
     """y = (x - mean) * scale from accumulated stats (reference
     data_norm_op.cc).  Stat accumulation is an optimizer-side update in the
     reference trainer; here stats are persistable params the layer creates."""
-    eps = attrs.get("epsilon", 1e-4)
     means = bsum / bsize
-    scales = jnp.sqrt(bsize / (bsq - bsum * bsum / bsize + eps))
+    # reference data_norm_op.cc:193-194 VERBATIM: scales are the RAW
+    # second moment sqrt(size/square_sum), NOT a mean-centered variance —
+    # the r5 reference-formula sweep caught the "sensible" variance
+    # spelling as a parity deviation
+    scales = jnp.sqrt(bsize / bsq)
     return (x - means[None, :]) * scales[None, :], means, scales
 
 
@@ -424,16 +427,20 @@ def _npair_loss(ctx, anchor, positive, labels, attrs):
     as one fused op here): CE over anchor@positive^T with same-label targets
     + l2 reg on embeddings."""
     l2_reg = attrs.get("l2_reg", 0.002)
+    beta = 0.25  # reference nn.py:11980 Beta
     lbl = jnp.reshape(labels, (-1,))
     sim = jnp.dot(anchor, positive.T,
                   preferred_element_type=jnp.float32)      # [B,B]
     tgt = (lbl[:, None] == lbl[None, :]).astype(jnp.float32)
     tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
     logp = jax.nn.log_softmax(sim, axis=1)
-    ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
-    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), 1)) +
-                    jnp.mean(jnp.sum(jnp.square(positive), 1))) / 2.0
-    return (ce + reg).astype(anchor.dtype)
+    ce_rows = -jnp.sum(tgt * logp, axis=1)
+    # reference composite VERBATIM (nn.py:11997-11999): the per-row CE is
+    # label-weighted per column, then column-meaned — not a plain mean
+    celoss = jnp.mean(jnp.sum(tgt * ce_rows[:, None], axis=0))
+    reg = beta * l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), 1)) +
+                           jnp.mean(jnp.sum(jnp.square(positive), 1)))
+    return (celoss + reg).astype(anchor.dtype)
 
 
 @simple_op("sigmoid_focal_loss", ["X", "Label", "FgNum"], ["Out"],
@@ -460,18 +467,24 @@ def _sigmoid_focal_loss(ctx, x, label, fg_num, attrs):
 def _teacher_student_sigmoid_loss(ctx, x, label, attrs):
     """Reference teacher_student_sigmoid_loss_op.cc: CTR distillation loss —
     sigmoid CE against hard clicks plus soft teacher scores."""
-    soft_max_up = attrs.get("soft_max_up_bound", 15.0)
-    soft_max_lo = attrs.get("soft_max_lower_bound", -15.0)
     z = jnp.reshape(x, (-1,))
     lbl = jnp.reshape(label, (-1,)).astype(jnp.float32)
-    zc = jnp.clip(z, soft_max_lo, soft_max_up)
-    # teacher part: label in (0,1) soft score; student: {0,1} click
-    hard = (lbl > 0.5).astype(jnp.float32)
-    ce_hard = jnp.maximum(zc, 0) - zc * hard + jnp.log1p(jnp.exp(-jnp.abs(zc)))
-    ce_soft = jnp.maximum(zc, 0) - zc * lbl + jnp.log1p(jnp.exp(-jnp.abs(zc)))
-    use_soft = ((lbl > 0.0) & (lbl < 1.0)).astype(jnp.float32)
-    return jnp.reshape(use_soft * ce_soft + (1 - use_soft) * ce_hard,
-                       (-1, 1)).astype(x.dtype)
+    relu = jnp.maximum(z, 0.0)
+    lse = jnp.log1p(jnp.exp(-jnp.abs(z)))
+    # reference teacher_student_sigmoid_loss_op.h:43-62 VERBATIM: four
+    # label bands — {-2}: click-0 BCE only; {-1}: click-1 BCE only;
+    # [0,1): click-0 BCE + soft-score term; [1,2]: click-1 BCE +
+    # soft-score term with label-1 (the r5 sweep caught the old
+    # hard/soft-select simplification as a parity deviation; the
+    # soft_max_*_bound attrs only shape the reference BACKWARD, which
+    # auto-vjp subsumes)
+    y = jnp.where(
+        lbl < -1.0, relu + lse,
+        jnp.where(lbl < 0.0, relu - z + lse,
+                  jnp.where(lbl < 1.0,
+                            relu + lse + relu - z * lbl + lse,
+                            relu - z + lse + relu - z * (lbl - 1.0) + lse)))
+    return jnp.reshape(y, (-1, 1)).astype(x.dtype)
 
 
 @simple_op("sampled_softmax_with_cross_entropy", ["Logits", "Label"],

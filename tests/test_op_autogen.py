@@ -433,9 +433,26 @@ def _bpr_ref(X, Label):
 S("bpr_loss", {"X": _softmax(rnd(3, 4, seed=71)),
                "Label": ints(3, 1, lo=0, hi=4)},
   _bpr_ref, grads=["X"], out_slots=("Y",), mre=0.02)
+def _tss_ref(X, Label):
+    """teacher_student_sigmoid_loss_op.h:43-62 verbatim: four label
+    bands {-2, -1, [0,1), [1,2]} combining click BCE and soft-label
+    terms."""
+    x = X[:, 0]
+    z = Label[:, 0]
+    relu = np.maximum(x, 0.0)
+    lse = np.log1p(np.exp(-np.abs(x)))
+    y = np.where(
+        z < -1.0, relu + lse,
+        np.where(z < 0.0, relu - x + lse,
+                 np.where(z < 1.0, relu + lse + relu - x * z + lse,
+                          relu - x + lse + relu - x * (z - 1.0) + lse)))
+    return y[:, None].astype("float32")
+
+
 S("teacher_student_sigmoid_loss",
-  {"X": rnd(4, 1, seed=72), "Label": pos(4, 1, lo=0.1, hi=0.9)},
-  None, grads=["X"], out_slots=("Y",))
+  {"X": rnd(4, 1, seed=72),
+   "Label": np.float32([[-2.0], [-1.0], [0.4], [1.7]])},  # all 4 bands
+  _tss_ref, grads=["X"], out_slots=("Y",))
 
 # ---------------------------------------------------------------------------
 # norms
@@ -844,8 +861,20 @@ S("argsort", {"X": RX.reshape(6, 4)},
   lambda X: {"Out": np.sort(X, axis=1),
              "Indices": np.argsort(X, axis=1).astype("int64")},
   attrs={"axis": 1}, out_slots=("Out", "Indices"), grads=())
+def _unique_counts_ref(X):
+    """Fixed-capacity rendering (static shapes): sorted uniques padded
+    with X[0]; Index = inverse map; Count padded with zeros."""
+    uniq, inv, counts = np.unique(X, return_inverse=True,
+                                  return_counts=True)
+    pad = X.size - uniq.size
+    return {"Out": np.concatenate([uniq, np.full(pad, X[0])]),
+            "Index": inv.astype("int32"),
+            "Count": np.concatenate([counts,
+                                     np.zeros(pad, "int64")])}
+
+
 S("unique_with_counts", {"X": np.int64([2, 3, 2, 5, 3])},
-  None, grads=(), out_slots=("Out", "Index", "Count"))
+  _unique_counts_ref, grads=(), out_slots=("Out", "Index", "Count"))
 S("shard_index", {"X": np.int64([[1], [7], [13]])},
   lambda X: np.int64([[1], [-1], [-1]]),
   attrs={"index_num": 18, "nshards": 3, "shard_id": 0,
@@ -1121,10 +1150,27 @@ S("box_clip", {"Input": np.float32([[[-1, -1, 5, 5], [1, 2, 3, 4]]]),
 # sigmoid_cross_entropy_with_logits, covered in batch 1
 S("sigmoid_cross_entropy", {"X": rnd(3, 4, seed=191)},
   lambda X: _sigmoid(X), grads=["X"])
+def _npair_ref(Anchor, Positive, Labels):
+    """reference layers/nn.py:11980 npair_loss verbatim (soft-label CE
+    over the similarity matrix + 0.25*l2_reg embedding penalty)."""
+    l2_reg, beta = 0.002, 0.25
+    n = Labels.shape[0]
+    lab = (Labels[:, None] == Labels[None, :]).astype("float64")
+    lab = lab / lab.sum(1, keepdims=True)
+    l2 = (np.mean((Anchor ** 2).sum(1)) + np.mean((Positive ** 2).sum(1))
+          ) * beta * l2_reg
+    sim = Anchor @ Positive.T
+    logp = sim - sim.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    ce_rows = -(lab * logp).sum(1)          # softmax_with_cross_entropy
+    cross = (lab * ce_rows[:, None]).sum(0)  # reduce_sum(labels*ce, 0)
+    return np.float32(l2 + cross.mean())
+
+
 S("npair_loss_op",
   {"Anchor": rnd(4, 6, seed=193), "Positive": rnd(4, 6, seed=194),
    "Labels": np.int64([0, 1, 1, 2])},
-  None, grads=["Anchor", "Positive"], mre=0.03)
+  _npair_ref, grads=["Anchor", "Positive"], mre=0.03)
 def _mean_iou_ref(Predictions, Labels):
     """mean_iou_op.h: per-class IoU = tp / (pred_i + label_i - tp),
     averaged over classes that appear."""
@@ -1288,15 +1334,41 @@ S("randint", {}, None, attrs={"shape": [3, 4], "low": 0, "high": 9,
                               "seed": 7}, grads=())
 S("random_crop", {"X": rnd(1, 3, 6, 6, seed=213)}, None,
   attrs={"shape": [3, 4, 4], "seed": 7}, grads=())
+def _data_norm_ref(X, BatchSize, BatchSum, BatchSquareSum):
+    """data_norm_op.cc:193-203: means = sum/size,
+    scales = sqrt(size/square_sum), y = (x - means) * scales."""
+    means = BatchSum / BatchSize
+    scales = np.sqrt(BatchSize / BatchSquareSum)
+    return {"Y": (X - means) * scales, "Means": means, "Scales": scales}
+
+
 S("data_norm", {"X": rnd(3, 4, seed=214),
                 "BatchSize": np.full(4, 10.0, "float32"),
                 "BatchSum": rnd(4, seed=215) * 10,
                 "BatchSquareSum": pos(4, seed=216) * 20},
-  None, out_slots=("Y", "Means", "Scales"), grads=["X"], grad_out="Y",
-  mre=0.05)
+  _data_norm_ref, out_slots=("Y", "Means", "Scales"), grads=["X"],
+  grad_out="Y", mre=0.05)
+def _spectral_norm_ref(Weight, U, V):
+    """spectral_norm_op.h CalcMatrixSigmaAndNormWeight verbatim
+    (power_iters=1 default, eps=1e-12): v = W^T u normalized, u = W v
+    normalized, sigma = u.(W v), out = W / sigma."""
+    eps = 1e-12
+    u, v = U.astype("float64"), V.astype("float64")
+    w = Weight.astype("float64")
+    for _ in range(1):
+        v = w.T @ u
+        v = v / (np.linalg.norm(v) + eps)
+        u = w @ v
+        u = u / (np.linalg.norm(u) + eps)
+    sigma = u @ (w @ v)
+    return {"Out": (w / sigma).astype("float32"),
+            "UOut": u.astype("float32"), "VOut": v.astype("float32")}
+
+
 S("spectral_norm", {"Weight": rnd(4, 3, seed=217),
                     "U": rnd(4, seed=218), "V": rnd(3, seed=219)},
-  None, out_slots=("Out", "UOut", "VOut"), grads=())
+  _spectral_norm_ref, out_slots=("Out", "UOut", "VOut"), grads=(),
+  mre=0.02)
 
 
 # ---------------------------------------------------------------------------

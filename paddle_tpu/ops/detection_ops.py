@@ -330,8 +330,10 @@ def _yolo_box(ctx, x, img_size, attrs):
     clip_bbox = bool(attrs.get("clip_bbox", True))
     na = len(anchors) // 2
     n, _, h, w = x.shape
-    input_h = downsample * h
-    input_w = downsample * w
+    # reference yolo_box_op.cc: ONE input_size = downsample * h scales
+    # BOTH box dims (r5 sweep: the w-based bw denominator diverged on
+    # non-square grids)
+    input_size = downsample * h
 
     x = jnp.reshape(x, (n, na, 5 + class_num, h, w))
     gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
@@ -339,14 +341,20 @@ def _yolo_box(ctx, x, img_size, attrs):
     aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
     ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
 
-    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx) / w
+    # grid_size is h for BOTH coordinates in the reference kernel
+    # (GetYoloBox is called with grid_size=h; yolo_box_op.h:130) — on the
+    # square grids YOLO uses they coincide, but verbatim is verbatim
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx) / h
     by = (jax.nn.sigmoid(x[:, :, 1]) + gy) / h
-    bw = jnp.exp(x[:, :, 2]) * aw / input_w
-    bh = jnp.exp(x[:, :, 3]) * ah / input_h
+    bw = jnp.exp(x[:, :, 2]) * aw / input_size
+    bh = jnp.exp(x[:, :, 3]) * ah / input_size
     conf = jax.nn.sigmoid(x[:, :, 4])
     probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
-    # below conf_thresh → zeroed (yolo_box_op.h keeps box but zero score)
-    probs = jnp.where(conf[:, :, None] > conf_thresh, probs, 0.0)
+    # below conf_thresh the reference's zero-initialized outputs keep BOTH
+    # the box and the scores at zero; `if (conf < conf_thresh) continue`
+    # KEEPS equality, so >= here
+    keep = conf >= conf_thresh
+    probs = jnp.where(keep[:, :, None], probs, 0.0)
 
     img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
     img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
@@ -360,6 +368,7 @@ def _yolo_box(ctx, x, img_size, attrs):
         x2 = jnp.minimum(x2, img_w - 1.0)
         y2 = jnp.minimum(y2, img_h - 1.0)
     boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [N, A, H, W, 4]
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
     boxes = jnp.reshape(boxes, (n, na * h * w, 4))
     scores = jnp.transpose(probs, (0, 1, 3, 4, 2))
     scores = jnp.reshape(scores, (n, na * h * w, class_num))

@@ -459,7 +459,11 @@ def _sigmoid_focal_loss(ctx, x, label, fg_num, attrs):
     pt = tgt * p + (1 - tgt) * (1 - p)
     at = tgt * alpha + (1 - tgt) * (1 - alpha)
     fg = jnp.maximum(jnp.reshape(fg_num, ()).astype(x.dtype), 1.0)
-    return at * jnp.power(1 - pt, gamma) * ce / fg
+    # reference c_neg = (g != -1) & (g != d+1): ignore-label rows (-1)
+    # contribute NOTHING — without this mask every class of an ignored
+    # anchor was penalized as a negative (r5 reference-formula sweep)
+    valid = (lbl != -1).astype(x.dtype)[:, None]
+    return valid * at * jnp.power(1 - pt, gamma) * ce / fg
 
 
 @simple_op("teacher_student_sigmoid_loss", ["X", "Label"], ["Y"],

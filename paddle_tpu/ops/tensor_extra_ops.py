@@ -55,11 +55,22 @@ def _unique(ctx, x, attrs):
 
 @simple_op("unique_with_counts", ["X"], ["Out", "Index", "Count"], grad=None)
 def _unique_with_counts(ctx, x, attrs):
+    """unique_with_counts_op.h keeps FIRST-OCCURRENCE order (the doc
+    example: [2,3,3,1,5,3] → [2,3,1,5]); jnp.unique sorts, so reorder by
+    each unique's first index (r5 review).  Fixed capacity: padded with
+    x[0] / zero counts (static-shape stance)."""
     flat = jnp.reshape(x, (-1,))
-    uniq, inv, counts = jnp.unique(flat, return_inverse=True,
-                                   return_counts=True, size=flat.size,
-                                   fill_value=flat[0] if flat.size else 0)
-    return uniq, inv.astype(jnp.int32), counts.astype(jnp.int64)
+    n = flat.size
+    uniq, first, inv, counts = jnp.unique(
+        flat, return_index=True, return_inverse=True, return_counts=True,
+        size=n, fill_value=flat[0] if n else 0)
+    # padded entries carry first-index 0 in some jax versions — push them
+    # last by keying on (is_pad, first_index)
+    is_pad = counts == 0
+    order = jnp.argsort(jnp.where(is_pad, n + 1, first))
+    pos = jnp.argsort(order)  # old unique slot → new position
+    return (uniq[order], pos[inv].astype(jnp.int32),
+            counts[order].astype(jnp.int64))
 
 
 @simple_op("shard_index", ["X"], ["Out"], grad=None)

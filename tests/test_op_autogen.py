@@ -433,6 +433,82 @@ def _bpr_ref(X, Label):
 S("bpr_loss", {"X": _softmax(rnd(3, 4, seed=71)),
                "Label": ints(3, 1, lo=0, hi=4)},
   _bpr_ref, grads=["X"], out_slots=("Y",), mre=0.02)
+def _yolo_box_ref(X, ImgSize):
+    """yolo_box_op.h:29-66 verbatim on a NON-square 2x3 grid with one
+    below-threshold anchor: grid_size = h for both coords, input_size =
+    downsample*h for both dims, below-threshold anchors leave box AND
+    scores zero, corner boxes clip to the image."""
+    anchors = [10, 14]
+    class_num, conf_thresh, downsample = 2, 0.5, 8
+    n, _, h, w = X.shape
+    na = 1
+    input_size = downsample * h
+    boxes = np.zeros((n, na * h * w, 4), "float32")
+    scores = np.zeros((n, na * h * w, class_num), "float32")
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    for i in range(n):
+        ih, iw = float(ImgSize[i, 0]), float(ImgSize[i, 1])
+        r = X[i].reshape(na, 5 + class_num, h, w)
+        for j in range(na):
+            for k in range(h):
+                for l in range(w):
+                    conf = sig(r[j, 4, k, l])
+                    if conf < conf_thresh:
+                        continue
+                    cx = (l + sig(r[j, 0, k, l])) * iw / h
+                    cy = (k + sig(r[j, 1, k, l])) * ih / h
+                    bw = np.exp(r[j, 2, k, l]) * anchors[0] * iw / input_size
+                    bh = np.exp(r[j, 3, k, l]) * anchors[1] * ih / input_size
+                    idx = j * h * w + k * w + l
+                    boxes[i, idx] = [max(cx - bw / 2, 0),
+                                     max(cy - bh / 2, 0),
+                                     min(cx + bw / 2, iw - 1),
+                                     min(cy + bh / 2, ih - 1)]
+                    for c in range(class_num):
+                        scores[i, idx, c] = conf * sig(r[j, 5 + c, k, l])
+    return {"Boxes": boxes, "Scores": scores}
+
+
+S("yolo_box",
+  {"X": rnd(1, 7, 2, 3, seed=74, lo=-2.0, hi=2.0),
+   "ImgSize": np.int32([[32, 48]])},
+  _yolo_box_ref,
+  attrs={"anchors": [10, 14], "class_num": 2, "conf_thresh": 0.5,
+         "downsample_ratio": 8, "clip_bbox": True},
+  grads=(), out_slots=("Boxes", "Scores"), mre=0.02)
+
+
+def _focal_ref(X, Label, FgNum):
+    """sigmoid_focal_loss_op.h:44-70 verbatim: targets are classes 1..C
+    on columns 0..C-1, label 0 = all-negative background, label -1 =
+    IGNORED (contributes nothing); both terms scale by alpha and
+    1/max(fg_num, 1)."""
+    n, c = X.shape
+    gamma, alpha = 2.0, 0.25
+    fg = max(float(FgNum[0]), 1.0)
+    out = np.zeros_like(X)
+    for a in range(n):
+        g = int(Label[a, 0])
+        for d in range(c):
+            x = X[a, d]
+            p = 1.0 / (1.0 + np.exp(-x))
+            c_pos = float(g == d + 1)
+            c_neg = float((g != -1) and (g != d + 1))
+            term_pos = (1 - p) ** gamma * np.log(max(p, 1e-37))
+            term_neg = p ** gamma * (
+                -x * (x >= 0) - np.log(1 + np.exp(x - 2 * x * (x >= 0))))
+            out[a, d] = (-c_pos * term_pos * (alpha / fg)
+                         - c_neg * term_neg * ((1 - alpha) / fg))
+    return out.astype("float32")
+
+
+S("sigmoid_focal_loss",
+  {"X": rnd(4, 3, seed=73), "Label": np.int64([[2], [0], [-1], [3]]),
+   "FgNum": np.int32([2])},
+  _focal_ref, attrs={"gamma": 2.0, "alpha": 0.25}, grads=["X"],
+  mre=0.03)
+
+
 def _tss_ref(X, Label):
     """teacher_student_sigmoid_loss_op.h:43-62 verbatim: four label
     bands {-2, -1, [0,1), [1,2]} combining click BCE and soft-label
@@ -862,18 +938,24 @@ S("argsort", {"X": RX.reshape(6, 4)},
              "Indices": np.argsort(X, axis=1).astype("int64")},
   attrs={"axis": 1}, out_slots=("Out", "Indices"), grads=())
 def _unique_counts_ref(X):
-    """Fixed-capacity rendering (static shapes): sorted uniques padded
-    with X[0]; Index = inverse map; Count padded with zeros."""
-    uniq, inv, counts = np.unique(X, return_inverse=True,
-                                  return_counts=True)
-    pad = X.size - uniq.size
-    return {"Out": np.concatenate([uniq, np.full(pad, X[0])]),
-            "Index": inv.astype("int32"),
-            "Count": np.concatenate([counts,
-                                     np.zeros(pad, "int64")])}
+    """unique_with_counts_op.h FIRST-OCCURRENCE order (the reference doc
+    example [2,3,3,1,5,3] → [2,3,1,5]); fixed capacity padded with X[0]
+    and zero counts (static-shape stance)."""
+    seen, out, counts = {}, [], []
+    for v in X.tolist():
+        if v not in seen:
+            seen[v] = len(out)
+            out.append(v)
+            counts.append(0)
+        counts[seen[v]] += 1
+    inv = np.int32([seen[v] for v in X.tolist()])
+    pad = X.size - len(out)
+    return {"Out": np.int64(out + [X[0]] * pad),
+            "Index": inv,
+            "Count": np.int64(counts + [0] * pad)}
 
 
-S("unique_with_counts", {"X": np.int64([2, 3, 2, 5, 3])},
+S("unique_with_counts", {"X": np.int64([2, 3, 3, 1, 5, 3])},
   _unique_counts_ref, grads=(), out_slots=("Out", "Index", "Count"))
 S("shard_index", {"X": np.int64([[1], [7], [13]])},
   lambda X: np.int64([[1], [-1], [-1]]),

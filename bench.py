@@ -40,6 +40,39 @@ ONCHIP_RESULTS_PATH = os.path.join(
 # inside one compiled fori_loop (Executor.run_steps)
 _last_dispatch = None
 
+# timing-methodology config tokens (plus the dynamic "chainK" family) —
+# owned here; tools/bench_onchip_all.py imports these for its
+# same-methodology comparability gate.  Two kinds:
+#   era markers — labels the DEFAULT methodology gained over time
+#     (pre-pipelining and pre-devfeed records carry none); a baseline
+#     match may cross these, so a re-capture still finds the older-era
+#     record of the same shape (the movement signal), visibly, because
+#     the configs differ on disk.
+#   A/B markers — deliberate variants (fetch-every-step, host feeds,
+#     chainK dispatch); these must match EXACTLY, or an A/B leg would be
+#     ratioed against the default-methodology record it exists to
+#     contrast with.
+ERA_MARKERS = ("devfeed", "pipelined")
+AB_MARKERS = ("hostfeed", "syncfetch")
+METHODOLOGY_MARKERS = ERA_MARKERS + AB_MARKERS
+
+
+def is_chain_marker(tok):
+    """True for the dynamic chainK dispatch marker ("chain32"), false for
+    model tokens that merely start with "chain"."""
+    return tok.startswith("chain") and tok[5:].isdigit()
+
+
+def strip_methodology(config, era_only=False):
+    """A config string with timing-methodology tokens removed.  The full
+    strip is the shape-and-dtype identity; era_only keeps the A/B markers
+    (hostfeed/syncfetch/chainK) so deliberate variants never alias the
+    default methodology's records."""
+    drop = ERA_MARKERS if era_only else METHODOLOGY_MARKERS
+    return " ".join(
+        t for t in config.split(" ")
+        if not (t in drop or (not era_only and is_chain_marker(t))))
+
 
 def _chain_steps():
     """PT_BENCH_CHAIN_STEPS=K: dispatch K steps as ONE XLA call
@@ -63,6 +96,15 @@ def _cpu_suffix():
         # baseline fallback may still compare, but the configs differ on
         # the record for anyone reading it)
         suffix = " pipelined" + suffix
+    if os.environ.get("PT_BENCH_HOST_FEED") == "1":
+        # per-step host-feed A/B variant (feeds re-transferred every step
+        # instead of device_put once) — distinct methodology, distinct label
+        suffix = " hostfeed" + suffix
+    else:
+        # device-resident feed default (r5): marked like " pipelined" was
+        # when it became the default — unmarked records are host-feed era,
+        # so an exact config match never crosses the feed methodologies
+        suffix = " devfeed" + suffix
     return suffix
 
 
@@ -135,8 +177,21 @@ def _timed_steps(exe, prog, data, loss_name, n_steps):
     fetches the loss, which transitively blocks on the whole chain, so the
     total time stays honest.  PT_BENCH_SYNC_FETCH=1 restores the
     fetch-every-step variant; the A/B isolates the per-step host/tunnel
-    round-trip (large when the device is reached over the axon tunnel)."""
+    round-trip (large when the device is reached over the axon tunnel).
+
+    The synthetic feed is device_put ONCE before the timed loop (the
+    executor keeps jax.Arrays device-resident) — the prefetched-input
+    pattern real training uses, and the only honest reading of
+    "throughput/chip" when the chip sits behind a ~45 MB/s tunnel: the
+    ResNet leg's b128 image batch is ~77 MB/step, so per-step host feeds
+    time the tunnel, not the chip (measured 75.5 img/s).  The input
+    pipeline is measured separately by the dataset_overlap leg;
+    PT_BENCH_HOST_FEED=1 restores per-step host feeds for that A/B."""
     global _last_dispatch
+    if os.environ.get("PT_BENCH_HOST_FEED") != "1":
+        import jax
+
+        data = jax.device_put(data)
     sync = os.environ.get("PT_BENCH_SYNC_FETCH") == "1"
     chain = _chain_steps()
     if chain > 1 and not sync:
@@ -217,21 +272,28 @@ def _vs_baseline(value, config, is_headline, default_metric=False):
             recs = [onchip.get(k) or {} for k in
                     ("bf16_policy", "fp32_headline")]
 
-            def find(cfg):
+            def find(pred):
                 return [r for r in recs if "value" in r
                         and "CPU-FALLBACK" not in r.get("config", "")
-                        and r.get("config") == cfg]
+                        and pred(r.get("config", ""))]
 
-            # exact config first; else the pre-pipelining record of the
-            # same shape (the ratio then includes the dispatch-methodology
-            # change — visible, because the two configs differ on disk)
-            match = find(config) or find(config.replace(" pipelined", ""))
+            # exact config first; else a record of the same shape under an
+            # older DEFAULT methodology (pre-pipelining, pre-devfeed) — the
+            # ratio then includes the era change, which stays visible
+            # because the two configs differ on disk.  A/B markers
+            # (syncfetch/hostfeed/chainK) survive the strip, so a variant
+            # leg can never ratio against the default's record.
+            match = (find(lambda c: c == config)
+                     or find(lambda c: strip_methodology(c, era_only=True)
+                             == strip_methodology(config, era_only=True)))
             if match:
                 baseline = float(match[0]["value"])
                 base_cfg = base_cfg or match[0].get("config", "")
         except Exception:
             pass
-    cfg_match = (base_cfg in (config, config.replace(" pipelined", ""))
+    cfg_match = (base_cfg == config
+                 or strip_methodology(base_cfg, era_only=True)
+                 == strip_methodology(config, era_only=True)
                  or (default_metric and not base_cfg))
     comparable = baseline > 0 and is_headline and cfg_match
     return round(value / baseline if comparable else
@@ -361,6 +423,13 @@ def measure_nmt(size):
     for bucket, lo in zip(buckets, los):
         data, eff = ragged_batch(bucket, lo)
         exe.run(main_prog, feed=data, fetch_list=[cost.name])
+        if os.environ.get("PT_BENCH_HOST_FEED") != "1":
+            # device-resident like _timed_steps: the timed loop below
+            # re-feeds these batches every round, and the ` devfeed`
+            # config marker must describe what actually ran
+            import jax
+
+            data = jax.device_put(data)
         schedule.append((data, eff, bucket))
         try:
             # XLA's own flop count for this bucket's executable — gathered

@@ -55,7 +55,7 @@ def run_bench(label, extra_env, budget):
     env = dict(os.environ, PT_BENCH_CHILD="base", **extra_env)
     # same hazard class as the dtype knobs: a stale chain/batch override in
     # the ambient shell must not silently relabel a leg's methodology
-    for knob in ("PT_BENCH_CHAIN_STEPS", "PT_BENCH_BATCH"):
+    for knob in SCRUB_KNOBS:
         if knob not in extra_env:
             env.pop(knob, None)
     try:
@@ -94,6 +94,29 @@ def _captured(entry):
     return any(k in entry for k in ("value", "rc", "full_step"))
 
 
+try:
+    sys.path.insert(0, ROOT)
+    from bench import METHODOLOGY_MARKERS, is_chain_marker
+except Exception:  # standalone fallback; keep in sync with bench.py
+    METHODOLOGY_MARKERS = ("devfeed", "pipelined", "hostfeed", "syncfetch")
+
+    def is_chain_marker(tok):
+        return tok.startswith("chain") and tok[5:].isdigit()
+
+
+# ambient methodology knobs scrubbed from every child unless the leg pins
+# them itself — a stale export must not silently relabel or re-time a leg
+SCRUB_KNOBS = ("PT_BENCH_CHAIN_STEPS", "PT_BENCH_BATCH",
+               "PT_BENCH_HOST_FEED")
+
+
+def _methodology(entry):
+    """The timing-methodology tokens of a record's config string — two
+    records are A/B-comparable only when these match exactly."""
+    return frozenset(t for t in str(entry.get("config", "")).split()
+                     if t in METHODOLOGY_MARKERS or is_chain_marker(t))
+
+
 class Suite:
     def __init__(self):
         self.machinery = False
@@ -118,7 +141,11 @@ class Suite:
         except (OSError, json.JSONDecodeError):
             return
         for key, entry in prev.items():
-            if key == "device" or _captured(entry):
+            if (key == "device" or _captured(entry)
+                    or (isinstance(entry, dict) and "superseded" in entry)):
+                # a hand-invalidated record (error + "superseded" history
+                # block) is NOT captured — the leg re-runs — but its
+                # history must survive the merge, not be dropped
                 self.results.setdefault(key, entry)
 
     def save(self):
@@ -127,8 +154,13 @@ class Suite:
 
     def record(self, label, entry):
         """Keep the fresh entry unless it would clobber a captured one."""
-        if _captured(self.results.get(label)) and not _captured(entry):
+        old = self.results.get(label)
+        if _captured(old) and not _captured(entry):
             return
+        if isinstance(old, dict) and "superseded" in old:
+            # invalidated-methodology history rides along on every
+            # rewrite (wedge markers and fresh captures alike)
+            entry = {"superseded": old["superseded"], **entry}
         self.stale.discard(label)
         self.results[label] = entry
         print(json.dumps({"label": label, **{k: v for k, v in entry.items()
@@ -215,18 +247,30 @@ class Suite:
                             "PT_BENCH_SYNC_FETCH": "1"}),
     ]
 
+    # per-leg budget multipliers, alongside the stage-level ones (longseq
+    # ×7, smoke/int8 ×2): transformer-big × 4 buckets = 8+ XLA compiles
+    # before nmt's timed region — 900 s covers the steps but not the
+    # compiles over the tunnel (r5 pass 1 timed out exactly here)
+    LEG_BUDGET_MULT = {"nmt_varlen": 2}
+
     def bench_legs(self, budget):
         for label, env in self.BENCH_LEGS:
             if self.done(label):
                 continue
             if not (self.machinery or self.gate(label)):
                 continue
-            self.record(label, run_bench(label, env, budget))
-        if ("value" in self.results.get("fp32_headline", {})
-                and "value" in self.results.get("bf16_policy", {})):
+            mult = self.LEG_BUDGET_MULT.get(label, 1)
+            self.record(label, run_bench(label, env, budget * mult))
+        bf, fp = (self.results.get("bf16_policy", {}),
+                  self.results.get("fp32_headline", {}))
+        if ("value" in bf and "value" in fp
+                and _methodology(bf) == _methodology(fp)):
+            # only a same-methodology pair may form the dtype-speedup
+            # ratio: r5's 2.69 divided a pipelined bf16 capture by the r3
+            # pre-pipelining fp32 record, overstating the dtype win with
+            # dispatch savings
             self.results["bf16_speedup"] = round(
-                self.results["bf16_policy"]["value"]
-                / self.results["fp32_headline"]["value"], 3)
+                bf["value"] / fp["value"], 3)
             self.save()
 
     def _run_tool(self, label, script, timeout, extra_env=None):
@@ -236,6 +280,12 @@ class Suite:
         if not (self.machinery or self.gate(label)):
             return
         env = dict(os.environ, **(extra_env or {}))
+        # same stale-knob hazard as run_bench: several tools/ children
+        # import bench helpers, and an ambient methodology knob must not
+        # silently relabel (or re-time) a leg
+        for knob in SCRUB_KNOBS:
+            if knob not in (extra_env or {}):
+                env.pop(knob, None)
         try:
             out = subprocess.run(
                 [sys.executable, os.path.join(ROOT, "tools", script)],

@@ -110,7 +110,7 @@ except Exception:  # standalone fallback; keep in sync with bench.py
 # ambient methodology knobs scrubbed from every child unless the leg pins
 # them itself — a stale export must not silently relabel or re-time a leg
 SCRUB_KNOBS = ("PT_BENCH_CHAIN_STEPS", "PT_BENCH_BATCH",
-               "PT_BENCH_HOST_FEED")
+               "PT_BENCH_HOST_FEED", "PT_BENCH_SKIP_COST")
 
 
 def _methodology(entry):
@@ -252,8 +252,13 @@ class Suite:
     LATE_LEGS = [
         # BASELINE.md north-star #4: transformer-big NMT over ragged
         # bucketed lengths (the dynamic-shape stress), effective tokens/sec
+        # PT_BENCH_SKIP_COST: cost_analysis would re-compile each of the
+        # 4 transformer-big buckets a second time over the tunnel — skip
+        # the MFU annotation so the leg's compiles fit the window
         ("nmt_varlen", {"PT_BENCH_MODEL": "nmt", "PT_BENCH_BF16": "1",
-                        "PT_BENCH_FP32": "0", "PT_BENCH_AMP": "0", "PT_BENCH_SYNC_FETCH": "0"}),
+                        "PT_BENCH_FP32": "0", "PT_BENCH_AMP": "0",
+                        "PT_BENCH_SYNC_FETCH": "0",
+                        "PT_BENCH_SKIP_COST": "1"}),
     ]
 
     # per-leg budget multipliers, alongside the stage-level ones (longseq

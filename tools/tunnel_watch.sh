@@ -6,7 +6,10 @@
 # Writes progress to /tmp/tunnel_watch.log.
 LOG=/tmp/tunnel_watch.log
 echo "watch start $(date)" >> $LOG
-for i in $(seq 1 100); do
+# 180 s poll (was 420): r5's only window lasted ~25 min — a 7.75-min
+# poll could burn a third of one before the suite even launches; 400
+# iterations keeps total watch coverage at ~12 h
+for i in $(seq 1 400); do
   if timeout 45 env PYTHONPATH=/root/repo:/root/.axon_site python -c "import jax; print(jax.devices())" >> $LOG 2>&1; then
     echo "TUNNEL OPEN $(date) — launching bench_onchip_all" >> $LOG
     env PYTHONPATH=/root/repo:/root/.axon_site python tools/bench_onchip_all.py >> $LOG 2>&1
@@ -24,7 +27,7 @@ for i in $(seq 1 100); do
   else
     echo "probe $i wedged $(date)" >> $LOG
   fi
-  sleep 420
+  sleep 180
 done
 echo "watch ended without completing $(date)" >> $LOG
 exit 3

@@ -1,0 +1,71 @@
+"""Driver/suite device-lock handshake (r5): the graded driver-level
+bench.py holds an advisory pidfile while its ladder runs; the on-chip
+collector waits between legs instead of contending for the chip."""
+
+import importlib
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench(monkeypatch, tmp_path):
+    sys.path.insert(0, REPO)
+    import bench
+
+    bench = importlib.reload(bench)
+    monkeypatch.setattr(bench, "DRIVER_LOCK",
+                        str(tmp_path / "driver.lock"))
+    return bench
+
+
+def test_lock_decay_modes(monkeypatch, tmp_path):
+    """Every observed decay mode of the pidfile reads as 'no holder':
+    missing, empty (SIGKILL between open and write), pid 0 (os.kill(0,0)
+    would signal our own group and always succeed), a dead pid, and a
+    recycled-pid-shaped stale file older than the 2 h mtime bound."""
+    bench = _bench(monkeypatch, tmp_path)
+    lock = bench.DRIVER_LOCK
+    assert bench.driver_lock_holder() is None
+    for content in ("", "0", "-5", "999999", "notapid"):
+        with open(lock, "w") as fh:
+            fh.write(content)
+        assert bench.driver_lock_holder() is None, repr(content)
+    with open(lock, "w") as fh:
+        fh.write(str(os.getpid()))
+    assert bench.driver_lock_holder() == os.getpid()
+    stale = time.time() - 7201
+    os.utime(lock, (stale, stale))
+    assert bench.driver_lock_holder() is None
+
+
+def test_second_driver_never_clobbers_or_unlinks(monkeypatch, tmp_path):
+    """A second driver must not overwrite a live holder's lock, and its
+    exit path must not delete a lock it never owned."""
+    bench = _bench(monkeypatch, tmp_path)
+    lock = bench.DRIVER_LOCK
+    with open(lock, "w") as fh:
+        fh.write(str(os.getpid()))  # "another" live driver (ourselves)
+    monkeypatch.setattr(bench, "_main_ladder", lambda: None)
+    monkeypatch.delenv("PT_BENCH_CHILD", raising=False)
+    bench.main()
+    # lock survived main() untouched: not clobbered, not unlinked
+    with open(lock) as fh:
+        assert int(fh.read()) == os.getpid()
+
+
+def test_driver_takes_and_releases_lock(monkeypatch, tmp_path):
+    bench = _bench(monkeypatch, tmp_path)
+    lock = bench.DRIVER_LOCK
+    seen = {}
+
+    def fake_ladder():
+        with open(lock) as fh:
+            seen["pid"] = int(fh.read())
+
+    monkeypatch.setattr(bench, "_main_ladder", fake_ladder)
+    monkeypatch.delenv("PT_BENCH_CHILD", raising=False)
+    bench.main()
+    assert seen["pid"] == os.getpid()
+    assert not os.path.exists(lock)

@@ -9,7 +9,8 @@ LOG=/tmp/tunnel_watch.log
 # spent most of its ~25 min on first-compiles, and a re-opened window
 # should pay none of them again (ignored by backends that don't support
 # the cache; dir is gitignored)
-export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/root/repo/.jax_cache}
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-$REPO/.jax_cache}
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
 echo "watch start $(date)" >> $LOG
 # 180 s poll (was 420): r5's only window lasted ~25 min — a 7.75-min

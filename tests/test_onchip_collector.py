@@ -1,0 +1,91 @@
+"""tools/bench_onchip_all.py collector invariants (r5): merge semantics
+for superseded records, the same-methodology speedup gate, and the
+driver-lock deferral — all pure-host logic, no device needed."""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _collector(tmp_path, monkeypatch, results=None):
+    spec = importlib.util.spec_from_file_location(
+        "bench_onchip_all", os.path.join(REPO, "tools",
+                                         "bench_onchip_all.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = tmp_path / "ONCHIP_RESULTS.json"
+    if results is not None:
+        out.write_text(json.dumps(results))
+    monkeypatch.delenv("PT_ONCHIP_REFRESH", raising=False)
+    suite = mod.Suite()
+    suite.out = str(out)
+    return mod, suite
+
+
+def test_superseded_record_survives_merge_and_rewrites(tmp_path,
+                                                       monkeypatch):
+    """An invalidated record (error + superseded history) is NOT captured
+    (the leg re-runs) but its history must ride through load() and every
+    record() rewrite — wedge markers and fresh captures alike."""
+    prev = {"resnet50": {"label": "resnet50", "error": "superseded",
+                         "superseded": {"value": 75.5}}}
+    mod, suite = _collector(tmp_path, monkeypatch, prev)
+    suite.load()
+    assert "resnet50" in suite.results
+    assert not mod._captured(suite.results["resnet50"])
+    suite.record("resnet50", {"label": "resnet50",
+                              "error": "tunnel wedged at probe"})
+    assert suite.results["resnet50"]["superseded"] == {"value": 75.5}
+    suite.record("resnet50", {"label": "resnet50", "value": 900.0,
+                              "config": "resnet50 devfeed pipelined"})
+    assert suite.results["resnet50"]["value"] == 900.0
+    assert suite.results["resnet50"]["superseded"] == {"value": 75.5}
+
+
+def test_speedup_gate_requires_same_methodology(tmp_path, monkeypatch):
+    """bf16_speedup only forms from a same-methodology pair: a pipelined
+    bf16 capture over a pre-pipelining fp32 record must NOT ratio."""
+    mod, suite = _collector(tmp_path, monkeypatch)
+    suite.machinery = True  # no probes; legs are stubbed below
+    monkeypatch.setattr(mod, "run_bench",
+                        lambda label, env, budget: {"label": label})
+    suite.results = {
+        "bf16_policy": {"value": 160000.0,
+                        "config": "bert-base b128 s128 bf16-policy "
+                                  "devfeed pipelined"},
+        "fp32_headline": {"value": 61000.0,
+                          "config": "bert-base b128 s128"},
+    }
+    suite.bench_legs(1.0)
+    assert "bf16_speedup" not in suite.results
+    suite.results["fp32_headline"]["config"] = (
+        "bert-base b128 s128 devfeed pipelined")
+    suite.bench_legs(1.0)
+    assert suite.results["bf16_speedup"] == round(160000.0 / 61000.0, 3)
+
+
+def test_gate_defers_to_live_driver_bench(tmp_path, monkeypatch):
+    """gate() waits while a driver-level bench holds the lock, then
+    probes; a dead/absent lock never delays it."""
+    mod, suite = _collector(tmp_path, monkeypatch)
+    calls = {"sleep": 0}
+    holder = {"pid": os.getpid()}
+    monkeypatch.setattr(mod, "driver_lock_holder",
+                        lambda: holder["pid"])
+    monkeypatch.setattr(mod, "probe", lambda budget=45: "cpu Host")
+
+    def fake_sleep(s):
+        calls["sleep"] += 1
+        holder["pid"] = None  # driver finishes during the first wait
+
+    monkeypatch.setattr(mod.time, "sleep", fake_sleep)
+    assert suite.gate("leg") is True
+    assert calls["sleep"] == 1
+    # no holder: no sleep at all
+    holder["pid"] = None
+    calls["sleep"] = 0
+    assert suite.gate("leg2") is True
+    assert calls["sleep"] == 0

@@ -26,6 +26,19 @@ for i in $(seq 1 400); do
     # still-missing ones
     unset PT_ONCHIP_REFRESH
     echo "bench_onchip_all rc=$rc $(date)" >> $LOG
+    # land the capture in git even if no interactive session is alive to
+    # do it: regenerate the north-star table and commit the artifacts
+    # (no-op when nothing changed)
+    (cd "$REPO" || exit
+     python tools/onchip_report.py >> $LOG 2>&1
+     for f in ONCHIP_RESULTS.json docs/NORTHSTAR.md \
+              LONGSEQ_BENCH.json ONCHIP_SMOKE.log; do
+       [ -e "$f" ] && git add "$f" 2>> $LOG
+     done
+     git diff --cached --quiet \
+       || git commit -q -m "On-chip capture at tunnel window (watcher auto-commit)
+
+No-Verification-Needed: results-artifact-only change" >> $LOG 2>&1)
     if [ "$rc" -eq 0 ]; then
       echo "suite COMPLETE $(date)" >> $LOG
       exit 0

@@ -18,8 +18,11 @@ line is printed no matter what.
 
 Env knobs: PT_BENCH_FP32=1 → plain-fp32 comparison rung; PT_BENCH_AMP=1 →
 cast-insertion AMP rewrite; PT_BENCH_FLASH=1 → Pallas flash-attention path
-(attention-probs dropout off, the usual flash trade); PT_BENCH_STEPS,
-PT_BENCH_BATCH, PT_BENCH_SEQLEN, BENCH_BASELINE.
+(attention-probs dropout off, the usual flash trade); PT_BENCH_QUANTAR=1 →
+data-parallel rung with the EQuARX-style quantized gradient all-reduce
+(bucketed block-scaled int8 collectives; records bytes-accessed from the
+executable's cost_analysis); PT_BENCH_STEPS, PT_BENCH_BATCH,
+PT_BENCH_SEQLEN, BENCH_BASELINE.
 """
 
 from __future__ import annotations
@@ -248,14 +251,47 @@ def _timed_steps(exe, prog, data, loss_name, n_steps):
     return time.perf_counter() - t0
 
 
+def _timed_steps_dp(exe, prog, data, loss_name, n_steps):
+    """Timed loop for a CompiledProgram (data-parallel) rung.  The DP
+    runner shards feeds and assembles per-device fetches itself, so this
+    stays on the simple fetch-every-step methodology rather than
+    _timed_steps' donated-chain pipelining, which keys on the
+    single-device executor cache.  The caller labels the record with the
+    ``syncfetch`` A/B marker (_cpu_suffix only emits it from the env
+    knob), so a future pipelined DP capture can never exact-match these
+    records."""
+    if os.environ.get("PT_BENCH_HOST_FEED") != "1":
+        import jax
+
+        data = jax.device_put(data)
+    for _ in range(2):  # warm/compile
+        exe.run(prog, feed=data, fetch_list=[loss_name])
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        exe.run(prog, feed=data, fetch_list=[loss_name])
+    return time.perf_counter() - t0
+
+
 def _vs_baseline(value, config, is_headline, default_metric=False):
+    """Scalar vs_baseline ratio — see _vs_baseline_rec (record form)."""
+    return _vs_baseline_rec(value, config, is_headline,
+                            default_metric=default_metric)["vs_baseline"]
+
+
+def _vs_baseline_rec(value, config, is_headline, default_metric=False):
     """BENCH_BASELINE only compares against the exact headline config it
     was recorded at (BENCH_BASELINE_CONFIG); anything else reports the
     sentinel (1.0 headline / 0.0 fallback rung).  Only the default (bert)
     metric may match an empty BENCH_BASELINE_CONFIG — for other metrics an
     exact config match is required, because a driver's ambient baseline is
     normally a bert tokens/sec number and dividing across metrics is
-    meaningless."""
+    meaningless.
+
+    Returns {"vs_baseline": ratio, "baseline_config": cfg} — the matched
+    baseline's config rides along on disk (ADVICE r5) so a reader of one
+    bench JSON line can SEE when the ratio crossed methodology eras
+    (devfeed vs hostfeed captures), instead of trusting that the fallback
+    matching stayed shape-strict."""
     baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
     base_cfg = os.environ.get("BENCH_BASELINE_CONFIG", "")
     if baseline <= 0:
@@ -296,8 +332,11 @@ def _vs_baseline(value, config, is_headline, default_metric=False):
                  == strip_methodology(config, era_only=True)
                  or (default_metric and not base_cfg))
     comparable = baseline > 0 and is_headline and cfg_match
-    return round(value / baseline if comparable else
-                 (1.0 if is_headline else 0.0), 3)
+    return {
+        "vs_baseline": round(value / baseline if comparable else
+                             (1.0 if is_headline else 0.0), 3),
+        "baseline_config": base_cfg if comparable else "",
+    }
 
 
 def _bf16_default():
@@ -355,7 +394,7 @@ def measure_resnet(size):
         "metric": f"resnet{depth}_train_images_per_sec",
         "value": round(ips, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": _vs_baseline(ips, config, is_headline=size != "tiny"),
+        **_vs_baseline_rec(ips, config, is_headline=size != "tiny"),
         "config": config,
     }, 3.0 * fwd * batch, n_steps, dt)
 
@@ -466,7 +505,7 @@ def measure_nmt(size):
         "metric": f"transformer_{scale}_nmt_effective_tokens_per_sec",
         "value": round(tps, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": _vs_baseline(tps, config, is_headline=False),
+        **_vs_baseline_rec(tps, config, is_headline=False),
         "config": config,
         "padding_overhead": round(pad_tokens / max(eff_tokens, 1) - 1, 3),
         "bucket_compiles": n_compiles,
@@ -532,7 +571,7 @@ def measure_gpt_decode(size):
         "metric": f"gpt_{size}_decode_tokens_per_sec",
         "value": round(tps, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": _vs_baseline(tps, config, is_headline=size == "base"),
+        **_vs_baseline_rec(tps, config, is_headline=size == "base"),
         "config": config,
     }
 
@@ -570,6 +609,19 @@ def measure(size):
     n_steps = int(os.environ.get("PT_BENCH_STEPS", "10"))
     flash = os.environ.get("PT_BENCH_FLASH", "0") == "1"
     amp = os.environ.get("PT_BENCH_AMP", "0") == "1"
+    # quantized-allreduce rung: the data-parallel path over every local
+    # device with bucketed block-scaled int8 gradient collectives
+    # (FLAGS_quant_allreduce); on one device it degenerates to the plain
+    # single-chip step, labeled dp1 so the config says so
+    quantar = os.environ.get("PT_BENCH_QUANTAR", "0") == "1"
+    n_dev = 1
+    if quantar:
+        import jax
+
+        n_dev = jax.device_count()
+        # feeds must shard evenly over dp; floor at one row per device so
+        # a small PT_BENCH_BATCH can never round down to an empty feed
+        batch = max(n_dev, batch - batch % n_dev)
     # the headline metric is the north-star config (BASELINE.md: "BERT-base
     # pretraining tokens/sec (bf16)") — the bf16 dtype policy, fp32 master
     # weights.  PT_BENCH_FP32=1 measures the plain-fp32 comparison rung.
@@ -596,23 +648,65 @@ def measure(size):
     exe = fluid.Executor()
     exe.run(startup)
     data = bert.make_fake_batch(cfg, batch=batch, seq_len=seq_len, seed=0)
-    dt = _timed_steps(exe, main_prog, data, loss.name, n_steps)
+    if quantar:
+        bs_quant = fluid.compiler.BuildStrategy()
+        bs_quant.quant_allreduce = True
+        run_prog = fluid.CompiledProgram(
+            main_prog, build_strategy=bs_quant).with_data_parallel(
+                loss_name=loss.name)
+        if os.environ.get("PT_BENCH_HOST_FEED") != "1":
+            # device_put HERE (not just inside the timed helper) so the
+            # post-run cost_analysis presents the exact feed signature the
+            # timed executable compiled for (x64-disabled backends narrow
+            # int64 feeds on transfer — the key must see the same dtypes)
+            import jax
 
-    tokens_per_sec = n_steps * batch * seq_len / dt
+            data = jax.device_put(data)
+        dt = _timed_steps_dp(exe, run_prog, data, loss.name, n_steps)
+    else:
+        dt = _timed_steps(exe, main_prog, data, loss.name, n_steps)
+
+    # the quantar rung spreads the global batch over n_dev chips: divide
+    # throughput AND step-FLOPs by n_dev so the per-chip unit and the
+    # single-chip-peak MFU stay honest (a dp8 record must not read 8x
+    # faster per chip than the single-chip headline)
+    tokens_per_sec = n_steps * batch * seq_len / dt / n_dev
+    step_flops = _bert_train_flops_per_step(cfg, batch, seq_len) / n_dev
     # labels: " bf16" = the cast-insertion AMP rewrite (its historical
-    # label — old baselines match); " bf16-policy" = the dtype policy
+    # label — old baselines match); " bf16-policy" = the dtype policy.
+    # " quantar-dpN" = the quantized-allreduce DP rung over N devices — a
+    # shape token, so it can never alias a single-chip record — plus the
+    # " syncfetch" A/B marker (_timed_steps_dp fetches every step; the
+    # marker keeps a future pipelined DP capture from exact-matching it).
+    quantar_tok = ""
+    if quantar:
+        quantar_tok = f" quantar-dp{n_dev}"
+        if os.environ.get("PT_BENCH_SYNC_FETCH") != "1":
+            quantar_tok += " syncfetch"  # else _cpu_suffix adds it
     config = (f"bert-{size} b{batch} s{seq_len}"
               + (" flash" if flash else "") + (" bf16" if amp else "")
-              + (" bf16-policy" if bf16 else "") + _cpu_suffix())
-    return _attach_flops({
+              + (" bf16-policy" if bf16 else "")
+              + quantar_tok + _cpu_suffix())
+    rec = _attach_flops({
         "metric": f"bert_{size}_pretrain_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": _vs_baseline(tokens_per_sec, config,
-                                    is_headline=size == "base",
-                                    default_metric=True),
+        **_vs_baseline_rec(tokens_per_sec, config,
+                           is_headline=size == "base",
+                           default_metric=True),
         "config": config,
-    }, _bert_train_flops_per_step(cfg, batch, seq_len), n_steps, dt)
+    }, step_flops, n_steps, dt)
+    if quantar:
+        # the rung's point: the executable's own cost model measures the
+        # bytes the quantized collectives move vs the fp32 A/B — record it
+        try:
+            ca = run_prog.cost_analysis(exe, data, fetch_list=[loss.name])
+            rec["bytes_accessed"] = ca["cost"].get("bytes accessed")
+            rec["quant_allreduce"] = True
+        except Exception as e:  # cost model unavailable on this backend
+            print(f"bench: quantar cost_analysis unavailable ({e})",
+                  file=sys.stderr)
+    return rec
 
 
 def _probe_device(budget):
@@ -671,26 +765,74 @@ def driver_lock_holder():
         return None
 
 
+def _acquire_driver_lock():
+    """Atomically create the pidfile (O_CREAT|O_EXCL — no check-then-write
+    window, so two near-simultaneous drivers can never both think they
+    won).  On EEXIST the holder's liveness is re-checked: a stale file
+    (dead/recycled pid, >2h mtime) is unlinked and the create retried
+    once; a LIVE holder's file is never touched."""
+    for _ in range(2):
+        try:
+            fd = os.open(DRIVER_LOCK,
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            try:
+                os.write(fd, str(os.getpid()).encode())
+            finally:
+                os.close(fd)
+            return True
+        except FileExistsError:
+            if driver_lock_holder() is not None:
+                return False  # live driver: defer, never clobber
+            # stale decay-mode file: clear it and retry the create.  The
+            # liveness check repeats right before the unlink so a racing
+            # driver that just reclaimed the stale file (live pid now on
+            # disk) isn't deleted out from under — the remaining window
+            # is one syscall wide, acceptable for an advisory lock.
+            try:
+                if driver_lock_holder() is not None:
+                    return False
+                os.unlink(DRIVER_LOCK)
+            except OSError:
+                return False
+        except OSError:
+            return False  # lock is advisory; never fail the bench over it
+    return False
+
+
+def _holds_driver_lock():
+    """True iff the lock file currently contains OUR pid — read directly,
+    NOT via driver_lock_holder(): its 2 h staleness bound would make the
+    owner skip its own cleanup after a long ladder."""
+    try:
+        with open(DRIVER_LOCK) as fh:
+            return fh.read().strip() == str(os.getpid())
+    except (OSError, ValueError):
+        return False
+
+
+def touch_driver_lock():
+    """Refresh the lock's mtime (called between ladder rungs) so a
+    legitimately long ladder (>2 h: large PT_BENCH_TIMEOUT, tunnel
+    retries) keeps suite deferral for its whole lifetime."""
+    if _holds_driver_lock():
+        try:
+            os.utime(DRIVER_LOCK)
+        except OSError:
+            pass
+
+
 def main():
     if os.environ.get("PT_BENCH_CHILD"):
         print(json.dumps(measure(os.environ["PT_BENCH_CHILD"])), flush=True)
         return
 
-    # take the advisory lock only if no LIVE driver holds it (a second
-    # driver must not clobber the first's lock), and unlink only what we
-    # wrote (never a later holder's file)
-    acquired = False
-    if driver_lock_holder() is None:
-        try:
-            with open(DRIVER_LOCK, "w") as fh:
-                fh.write(str(os.getpid()))
-            acquired = True
-        except OSError:
-            pass  # lock is advisory; never fail the bench over it
+    acquired = _acquire_driver_lock()
     try:
         _main_ladder()
     finally:
-        if acquired and driver_lock_holder() == os.getpid():
+        # unlink whenever WE acquired and the file still holds our pid
+        # (a later holder's file is never ours to remove)
+        if acquired and _holds_driver_lock():
             try:
                 os.unlink(DRIVER_LOCK)
             except OSError:
@@ -745,6 +887,7 @@ def _main_ladder():
     ladder = ((*device_ladder, cpu_rung) if platform is not None
               else (cpu_rung,))
     for size, overrides, alloc in ladder:
+        touch_driver_lock()  # keep deferral fresh across a long ladder
         is_cpu_rung = "PT_BENCH_FORCE_CPU" in overrides
         # the terminal CPU rung is the last chance at a real number: give
         # it ALL remaining time, not just its nominal reservation

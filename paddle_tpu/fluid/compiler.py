@@ -51,6 +51,10 @@ class BuildStrategy:
         self.trainer_id = 0
         self.sync_batch_norm = False
         self.debug_graphviz_path = ""
+        # quantized gradient all-reduce (EQuARX-style, beyond-parity knob):
+        # None = defer to FLAGS_quant_allreduce; True/False pins it for the
+        # runner built from this strategy (parallel/data_parallel.py)
+        self.quant_allreduce = None
 
 
 class ExecutionStrategy:
@@ -107,3 +111,25 @@ class CompiledProgram:
                 places=self._places)
         return self._dp_runner.run(executor, feed, fetch_list, scope,
                                    return_numpy)
+
+    def cost_analysis(self, executor, feed, fetch_list=None, scope=None):
+        """XLA cost/memory analysis of the step this compiled program runs:
+        routes to the data-parallel runner's sharded executable when one
+        was built, else to the plain executor's (single-device fallthrough
+        path) — callers (bench quant rung) need not know which ran."""
+        if self._dp_runner is not None:
+            return self._dp_runner.cost_analysis(executor, feed,
+                                                 fetch_list=fetch_list,
+                                                 scope=scope)
+        if self._is_data_parallel:
+            import jax
+
+            if jax.device_count() >= 2:
+                # the runner builds lazily inside _run — analyzing the
+                # un-transpiled program here would silently report numbers
+                # for a step with no collectives at all
+                raise ValueError(
+                    "no compiled data-parallel executable yet — run the "
+                    "step once first")
+        return executor.cost_analysis(self._program, feed,
+                                      fetch_list=fetch_list, scope=scope)

@@ -53,6 +53,19 @@ _DEFS = {
     # loader warning), and jax's cache key does not cover host features.
     # (callable default: resolved at bootstrap — host-dependent path)
     "FLAGS_compile_cache_dir": (lambda: _default_cache_dir(), str, True),
+    # quantized gradient all-reduce (EQuARX-style): the data-parallel
+    # transpiler buckets same-dtype grads into fused buffers and
+    # all-reduces them block-scaled int8 (ops/collective_ops.py
+    # c_allreduce_quant).  DGC-encoded grads and batch-norm stats are
+    # never quantized.  Off by default — opt in per run, or per runner
+    # via DataParallelRunner(quant_grads=True).
+    "FLAGS_quant_allreduce": (False, _parse_bool, True),
+    "FLAGS_quant_allreduce_block_size": (256, int, True),
+    # fused-gradient bucket cap in MB (reference
+    # FLAGS_fuse_parameter_memory_size analog): grads coalesce into
+    # buckets up to this size so scale overhead and collective-launch
+    # count amortize without one giant liveness-hungry buffer
+    "FLAGS_fuse_grad_size_in_MB": (32, int, True),
     # accepted no-ops (CUDA/allocator knobs with no TPU meaning)
     "FLAGS_fraction_of_gpu_memory_to_use": (0.92, float, False),
     "FLAGS_eager_delete_tensor_gb": (-1.0, float, False),

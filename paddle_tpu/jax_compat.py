@@ -1,0 +1,39 @@
+"""Cross-version jax API shims.
+
+The framework targets the current jax surface (``jax.shard_map`` with a
+``check_vma`` kwarg); the baked container toolchain may carry an older
+release where shard_map still lives in ``jax.experimental.shard_map`` and
+the kwarg is spelled ``check_rep``.  Installing the canonical name here —
+imported before anything else in ``paddle_tpu/__init__`` — keeps every
+caller (runners, kernels, tests) on the one modern spelling instead of
+scattering try/except imports through the tree.
+
+No-op on jax versions that already expose ``jax.shard_map``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def install():
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, check_rep=None, **kw):
+        if check_rep is None:
+            # modern kwarg name → legacy one (both default True)
+            check_rep = True if check_vma is None else bool(check_vma)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_rep, **kw)
+
+    jax.shard_map = shard_map
+
+
+install()

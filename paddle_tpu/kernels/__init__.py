@@ -2,6 +2,11 @@
 (TPU analog of the reference's operators/jit/ CPU codegen)."""
 
 from .flash_attention import attention_reference, flash_attention  # noqa: F401
+from .quantized_collectives import (  # noqa: F401
+    dequantize_block_scaled, quantize_block_scaled, quantized_all_reduce,
+)
 from .ring_attention import ring_attention  # noqa: F401
 
-__all__ = ["flash_attention", "attention_reference", "ring_attention"]
+__all__ = ["flash_attention", "attention_reference", "ring_attention",
+           "quantize_block_scaled", "dequantize_block_scaled",
+           "quantized_all_reduce"]

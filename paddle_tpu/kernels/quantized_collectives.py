@@ -1,0 +1,173 @@
+"""Quantized gradient all-reduce — EQuARX-style block-scaled int8 collectives.
+
+Reference analog: the reference's SparseAllReduceOpHandle (DGC) and
+fuse_all_reduce_op_pass shrink/fuse gradient traffic on NCCL rings.
+TPU-native redesign following EQuARX (arXiv:2506.17615): the all-reduce is
+decomposed into its scatter and gather phases and the payload crossing ICI
+is block-scaled int8 instead of fp32.
+
+Pipeline (under shard_map over the dp axis, n devices):
+
+  1. flatten + pad the tensor to a multiple of ``n * block_size`` and view
+     it as n equal shards (blocks never straddle a shard boundary);
+  2. quantize each shard block-scaled (int8 payload + one fp32 scale per
+     ``block_size`` elements);
+  3. scatter phase: ``lax.all_to_all`` moves shard i of every device's
+     quantized payload to device i — int8 on the wire (this is
+     ``lax.psum_scatter`` with the reduction peeled off, which is what
+     makes a quantized wire format possible: int8 blocks with
+     heterogeneous per-device scales cannot be summed by the fabric);
+  4. dequant-reduce: dequantize the n received shards and sum in fp32;
+  5. requant: block-quantize the reduced shard;
+  6. gather phase: ``lax.all_gather`` the quantized reduced shard — int8
+     on the wire again — then dequantize, unpad, and restore shape/dtype.
+
+Precision: the default wire format is DUAL int8 — a hi int8 plus a second
+int8 carrying the quantization residual at 1/254 of the block scale
+(together an int16-grade representation at half the bytes of fp32).  Worst
+case per-element error is ``block_max / 64516`` per quantization, so a
+4-device sum of N(0,1) gradients lands well under 1e-2 max abs error.
+``dual_int8=False`` selects the aggressive single-int8 format (quarter
+bytes, EQuARX's headline mode) for workloads that tolerate ~1e-1 error on
+the summed gradient.
+
+The backward rule is the straight-through estimator: the cotangent takes
+the exact fp32 ``lax.psum`` path (quantization is forward-only noise), so
+``c_allreduce_quant`` differentiates exactly like ``c_allreduce_sum``.
+
+Out of scope for this phase (ROADMAP "EQuARX phase-2"): requantizing
+inside the scatter hops of a ring so every hop, not just the two phase
+boundaries, moves int8.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "quantize_block_scaled",
+    "dequantize_block_scaled",
+    "quantized_all_reduce",
+    "DEFAULT_BLOCK_SIZE",
+]
+
+DEFAULT_BLOCK_SIZE = 256
+
+# int8 symmetric range: +-127 (never -128, keeping the scale symmetric —
+# the convention of every block-scaled training format)
+_QMAX = 127.0
+# the residual is bounded by scale/2, so its own scale is scale/(2*127)
+_RESID_DIV = 2.0 * _QMAX
+
+
+def quantize_block_scaled(x, block_size=DEFAULT_BLOCK_SIZE, dual_int8=True):
+    """Block-scaled symmetric int8 quantization of a flat fp array.
+
+    ``x.size`` must be a multiple of ``block_size`` (callers pad).
+    Returns ``(q_hi, q_lo, scales)`` where ``q_hi``/``q_lo`` are int8 of
+    x's shape and ``scales`` holds one fp32 scale per block.  ``q_lo``
+    carries the quantization residual at ``scales / 254`` resolution
+    (``None`` when ``dual_int8=False``).
+    """
+    xf = jnp.reshape(x.astype(jnp.float32), (-1, block_size))
+    amax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+    # all-zero block: scale 1.0 quantizes it to exact zeros (0/0 guard)
+    scale = jnp.where(amax > 0.0, amax / _QMAX, 1.0)
+    q_hi = jnp.clip(jnp.round(xf / scale), -_QMAX, _QMAX)
+    if not dual_int8:
+        return (q_hi.astype(jnp.int8).reshape(x.shape), None,
+                scale[:, 0])
+    resid = xf - q_hi * scale
+    q_lo = jnp.clip(jnp.round(resid * (_RESID_DIV / scale)), -_QMAX, _QMAX)
+    return (q_hi.astype(jnp.int8).reshape(x.shape),
+            q_lo.astype(jnp.int8).reshape(x.shape), scale[:, 0])
+
+
+def dequantize_block_scaled(q_hi, q_lo, scales, block_size=DEFAULT_BLOCK_SIZE):
+    """Inverse of :func:`quantize_block_scaled` (fp32, flat-block view)."""
+    hi = jnp.reshape(q_hi.astype(jnp.float32), (-1, block_size))
+    s = scales.reshape(-1, 1)
+    out = hi * s
+    if q_lo is not None:
+        lo = jnp.reshape(q_lo.astype(jnp.float32), (-1, block_size))
+        out = out + lo * (s / _RESID_DIV)
+    return out.reshape(q_hi.shape)
+
+
+def _quantized_all_reduce_impl(x, axis_name, block_size, dual_int8):
+    n = lax.psum(1, axis_name)  # static axis size under shard_map
+    if n == 1:
+        # dp=1 fallback: the sum over one device is the identity — stay
+        # EXACT (and skip the quantize/collective machinery entirely)
+        return x
+    orig_shape, orig_dtype = jnp.shape(x), x.dtype
+    flat = jnp.ravel(x).astype(jnp.float32)
+    size = flat.size
+    pad = (-size) % (n * block_size)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    per_shard = flat.size // n
+    shards = flat.reshape(n, per_shard)
+
+    # (2) quantize per shard — blocks are within-row so all_to_all keeps
+    # each block with its own scale
+    q_hi, q_lo, scales = quantize_block_scaled(
+        shards, block_size, dual_int8=dual_int8)
+    scales = scales.reshape(n, per_shard // block_size)
+
+    # (3) scatter phase: int8 (+ per-block fp32 scales) on the wire.
+    # Row i of each operand goes to device i; afterwards row j holds what
+    # device j contributed to OUR shard.
+    a2a = partial(lax.all_to_all, axis_name=axis_name, split_axis=0,
+                  concat_axis=0, tiled=False)
+    q_hi = a2a(q_hi)
+    q_lo = a2a(q_lo) if dual_int8 else None
+    scales = a2a(scales)
+
+    # (4) dequant-reduce: fp32 accumulation of the n contributions
+    parts = dequantize_block_scaled(q_hi, q_lo, scales, block_size)
+    reduced = jnp.sum(parts, axis=0)  # [per_shard]
+
+    # (5) requant the reduced shard, (6) gather phase: int8 on the wire
+    r_hi, r_lo, r_scales = quantize_block_scaled(
+        reduced, block_size, dual_int8=dual_int8)
+    g_hi = lax.all_gather(r_hi, axis_name)
+    g_lo = lax.all_gather(r_lo, axis_name) if dual_int8 else None
+    g_scales = lax.all_gather(r_scales, axis_name)
+
+    out = dequantize_block_scaled(g_hi, g_lo, g_scales.reshape(-1),
+                                  block_size)
+    out = out.reshape(-1)
+    if pad:
+        out = out[:size]
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def quantized_all_reduce(x, axis_name, block_size=DEFAULT_BLOCK_SIZE,
+                         dual_int8=True):
+    """Block-scaled int8 all-reduce-sum of ``x`` over mesh axis
+    ``axis_name``.  Must be called under shard_map; exact identity when
+    the axis has a single device."""
+    return _quantized_all_reduce_impl(x, axis_name, block_size, dual_int8)
+
+
+def _qar_fwd(x, axis_name, block_size, dual_int8):
+    return _quantized_all_reduce_impl(x, axis_name, block_size,
+                                      dual_int8), None
+
+
+def _qar_bwd(axis_name, block_size, dual_int8, _res, g):
+    # straight-through: the gradient of sum_i x_i w.r.t. each x_i is the
+    # identity, and under the global-loss convention the cotangent is
+    # psum'd across devices — exactly c_allreduce_sum's derived grad
+    # (tests/test_collective_grads.py pins that convention).  Quantization
+    # noise is forward-only.
+    return (lax.psum(g, axis_name),)
+
+
+quantized_all_reduce.defvjp(_qar_fwd, _qar_bwd)

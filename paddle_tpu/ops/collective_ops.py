@@ -71,6 +71,46 @@ register_op("allreduce", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.psum(x, 
 register_op("c_allreduce_avg", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.pmean(x, ax)))
 
 
+@simple_op("c_allreduce_quant", ["X"], ["Out"])
+def _c_allreduce_quant(ctx, x, attrs):
+    """Block-scaled int8 all-reduce-sum (EQuARX-style, arXiv:2506.17615):
+    int8 payload + per-block fp32 scales on the wire for both the scatter
+    and gather phases of the all-reduce — see
+    paddle_tpu.kernels.quantized_collectives.  Exact fp32 fallback outside
+    a mesh and when the axis has a single device; the backward rule is the
+    straight-through psum, so gradients match c_allreduce_sum exactly.
+
+    attrs: block_size (default 256), quant_bits (16 = dual-int8 hi/lo
+    payload, the default; 8 = single int8, quarter bytes, ~1e-1 error)."""
+    ax = _axis_for_ring(ctx, attrs)
+    if ax is None:
+        return x
+    from paddle_tpu.kernels import quantized_collectives as qc
+
+    return qc.quantized_all_reduce(
+        x, ax,
+        block_size=int(attrs.get("block_size", qc.DEFAULT_BLOCK_SIZE)),
+        dual_int8=int(attrs.get("quant_bits", 16)) != 8)
+
+
+@simple_op("uncoalesce_tensor", ["X"], ["Out*"])
+def _uncoalesce_tensor(ctx, x, attrs):
+    """Split a coalesce_tensor FusedOutput buffer back into the original
+    tensors (attrs["shapes"]).  The reference's fuse_all_reduce_op_pass
+    never needs this — its coalesced buffer ALIASES the grads — but a
+    functional trace has no aliasing, so the fused all-reduce result is
+    scattered back explicitly."""
+    shapes = [tuple(int(d) for d in s) for s in attrs.get("shapes", [])]
+    outs, off = [], 0
+    for s in shapes:
+        size = 1
+        for d in s:
+            size *= d
+        outs.append(jnp.reshape(x[off:off + size], s))
+        off += size
+    return outs
+
+
 @simple_op("c_broadcast", ["X"], ["Out"])
 def _c_broadcast(ctx, x, attrs):
     ax = _axis_for_ring(ctx, attrs)

@@ -21,21 +21,76 @@ from __future__ import annotations
 
 import numpy as np
 
+from paddle_tpu.fluid.executor import _JitExecutable
 from paddle_tpu.fluid.framework import grad_var_name
 from . import mesh as pmesh
 
 __all__ = ["DataParallelRunner", "transpile_data_parallel"]
 
 
+def _plan_quant_buckets(block, grads, prod_index, block_size, bucket_mb):
+    """fuse_all_reduce_op_pass analog: group same-dtype grads into fused
+    buckets (capped at ``bucket_mb`` MB) so one quantized collective per
+    bucket replaces one fp32 collective per grad — per-block scale
+    overhead and collective-launch count amortize over the bucket.
+
+    Returns (buckets, leftovers): each bucket is a dict with the member
+    grad names (production order), their shapes, dtype, and the op index
+    after which the fused ops insert (= last member's producer).
+    Leftovers are grads that cannot be bucketed (dynamic shape / no var /
+    non-float dtype) and keep the per-grad fp32 allreduce.
+    """
+    cap_bytes = max(1, int(float(bucket_mb) * (1 << 20)))
+    eligible, leftovers = [], []
+    for g in sorted(grads, key=lambda g: prod_index[g]):
+        v = block._find_var_recursive(g)
+        shape = tuple(v.shape) if (v is not None and v.shape) else None
+        dtype = v.dtype if v is not None else None
+        if (shape is None or any(d is None or d < 0 for d in shape)
+                or dtype not in ("float32", "float16", "bfloat16")):
+            leftovers.append(g)
+            continue
+        eligible.append((g, shape, dtype))
+
+    itemsize = {"float32": 4, "float16": 2, "bfloat16": 2}
+    buckets = []
+    open_by_dtype = {}
+    for g, shape, dtype in eligible:
+        nbytes = int(np.prod(shape)) * itemsize[dtype]
+        b = open_by_dtype.get(dtype)
+        if b is None or b["bytes"] + nbytes > cap_bytes:
+            b = {"grads": [], "shapes": [], "dtype": dtype, "bytes": 0,
+                 "insert_at": -1}
+            buckets.append(b)
+            open_by_dtype[dtype] = b
+        b["grads"].append(g)
+        b["shapes"].append(list(shape))
+        b["bytes"] += nbytes
+        b["insert_at"] = max(b["insert_at"], prod_index[g])
+    return buckets, leftovers
+
+
 def transpile_data_parallel(program, loss_name, num_devices,
                             gradient_scale="coeff_num_device",
-                            sync_batch_norm_stats=True):
+                            sync_batch_norm_stats=True,
+                            quant_grads=False, quant_block_size=None,
+                            quant_bucket_mb=None):
     """Rewrite `program` in place for data-parallel execution.
 
     Mirrors multi_devices_graph_pass: (1) the loss-gradient seed becomes
     1/ndev, (2) every optimizer-consumed gradient gets a c_allreduce_sum
     (ring 0 = the dp axis), (3) batch-norm running stats are averaged across
     devices so the single written copy is well-defined.
+
+    quant_grads=True (FLAGS_quant_allreduce / DataParallelRunner knob)
+    additionally runs the fuse_all_reduce_op_pass analog: same-dtype
+    gradients coalesce into a few fused buffers and each buffer takes ONE
+    block-scaled int8 `c_allreduce_quant` instead of a per-grad fp32
+    `c_allreduce_sum`.  Explicitly excluded from quantization: DGC-encoded
+    gradients (already compressed — requantizing would destroy the top-k
+    sparsity the reference's SparseAllReduce relies on) and batch-norm
+    running stats (small, fp32-averaged, quality-critical); both keep
+    their exact collectives.
     """
     block = program.global_block()
     if loss_name is not None and gradient_scale == "coeff_num_device":
@@ -58,23 +113,74 @@ def transpile_data_parallel(program, loss_name, num_devices,
     # DGC moves the allreduce onto the compressed gradient (the reference's
     # SparseAllReduceOpHandle placement): watch the encoded var instead
     dgc_map = getattr(program, "_dgc_encoded", {})
+    dgc_encoded = set(dgc_map.values())
     raw_grads = {dgc_map.get(g, g) for g in raw_grads}
+
+    # plan the quantized buckets against the ORIGINAL op indices (ops are
+    # only ever appended after, so producer indices stay valid while the
+    # rewritten list grows)
+    buckets, bucketed = [], {}
+    if quant_grads:
+        from paddle_tpu.fluid import flags as _flags
+
+        if quant_block_size is None:
+            quant_block_size = _flags.flag("quant_allreduce_block_size")
+        if quant_bucket_mb is None:
+            quant_bucket_mb = _flags.flag("fuse_grad_size_in_MB")
+        prod_index = {}
+        for i, op in enumerate(block.ops):
+            for g in raw_grads.intersection(op.output_arg_names):
+                prod_index[g] = i  # last producer wins
+        candidates = {g for g in raw_grads
+                      if g in prod_index and g not in dgc_encoded}
+        buckets, _left = _plan_quant_buckets(
+            block, candidates, prod_index, quant_block_size,
+            quant_bucket_mb)
+        for k, b in enumerate(buckets):
+            b["fused"] = block.create_var(
+                name=f"@FUSED_GRAD_QUANT@_{b['dtype']}_{k}",
+                dtype=b["dtype"],
+                shape=[sum(int(np.prod(s)) for s in b["shapes"])])
+            for g in b["grads"]:
+                bucketed[g] = b
+
+    def _emit_bucket(b, out):
+        fused = b["fused"].name
+        out.append(Operator(
+            block, "coalesce_tensor",
+            inputs={"Input": list(b["grads"])},
+            outputs={"FusedOutput": [fused]},
+            attrs={"dtype": b["dtype"], "op_role": "backward"}))
+        out.append(Operator(
+            block, "c_allreduce_quant",
+            inputs={"X": [fused]}, outputs={"Out": [fused]},
+            attrs={"ring_id": 0, "use_calc_stream": True,
+                   "block_size": int(quant_block_size),
+                   "op_role": "backward"}))
+        out.append(Operator(
+            block, "uncoalesce_tensor",
+            inputs={"X": [fused]}, outputs={"Out": list(b["grads"])},
+            attrs={"shapes": [list(s) for s in b["shapes"]],
+                   "op_role": "backward"}))
 
     new_ops = []
     pending = set(raw_grads)
-    for op in block.ops:
+    for op_idx, op in enumerate(block.ops):
         new_ops.append(op)
         produced = pending.intersection(op.output_arg_names)
         for g in produced:
             pending.discard(g)
+            if g in bucketed:
+                continue  # fused collective emitted at the bucket boundary
             new_ops.append(Operator(
                 block, "c_allreduce_sum",
                 inputs={"X": [g]}, outputs={"Out": [g]},
                 attrs={"ring_id": 0, "use_calc_stream": True,
                        "op_role": "backward"}))
+        for b in buckets:
+            if b["insert_at"] == op_idx:
+                _emit_bucket(b, new_ops)
         if sync_batch_norm_stats and op.type == "batch_norm" and not op.attrs.get("is_test"):
-            from paddle_tpu.fluid.framework import Operator
-
             for slot in ("MeanOut", "VarianceOut"):
                 names = op.outputs.get(slot, [])
                 if names:
@@ -90,22 +196,39 @@ def transpile_data_parallel(program, loss_name, num_devices,
 class DataParallelRunner:
     """Compiles + runs a data-parallel program over all local devices."""
 
-    def __init__(self, program, loss_name, build_strategy=None, places=None):
+    def __init__(self, program, loss_name, build_strategy=None, places=None,
+                 quant_grads=None):
         import jax
 
         n = len(places) if places else jax.device_count()
         self.num_devices = n
         self.mesh = pmesh.build_mesh({pmesh.DATA_AXIS: n})
+        # quantized-collective knob: explicit arg > BuildStrategy attr >
+        # FLAGS_quant_allreduce (each layer may leave it None = defer)
+        if quant_grads is None:
+            quant_grads = getattr(build_strategy, "quant_allreduce", None)
+        if quant_grads is None:
+            from paddle_tpu.fluid import flags as _flags
+
+            quant_grads = _flags.flag("quant_allreduce")
+        self.quant_grads = bool(quant_grads)
         # rewrite in place, like the reference's multi-device pass
         self.program = transpile_data_parallel(
             program, loss_name, n,
             sync_batch_norm_stats=(build_strategy is None
-                                   or getattr(build_strategy, "sync_batch_norm", True) is not False))
+                                   or getattr(build_strategy, "sync_batch_norm", True) is not False),
+            quant_grads=self.quant_grads)
         self._cache = {}
 
-    def run(self, executor, feed, fetch_list, scope, return_numpy=True):
-        import jax
+    def _cache_key(self, feed, fetch_names):
+        feed_sig = tuple(
+            (k, tuple(np.shape(v)),
+             str(v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype))
+            for k, v in sorted(feed.items()))
+        return (id(self.program), self.program._version, feed_sig,
+                tuple(fetch_names))
 
+    def run(self, executor, feed, fetch_list, scope, return_numpy=True):
         from paddle_tpu.fluid import executor as ex
 
         scope = scope or ex.global_scope()
@@ -116,9 +239,7 @@ class DataParallelRunner:
                 raise ValueError(
                     f"feed {k!r} batch {np.shape(v)[0]} not divisible by "
                     f"{self.num_devices} devices")
-        feed_sig = tuple((k, tuple(np.shape(v)), str(np.asarray(v).dtype))
-                         for k, v in sorted(feed.items()))
-        key = (id(self.program), self.program._version, feed_sig, tuple(fetch_names))
+        key = self._cache_key(feed, fetch_names)
         cb = self._cache.get(key)
         if cb is None:
             cb = _ShardedBlock(self.program, feed.keys(), fetch_names, self.mesh, scope)
@@ -129,8 +250,31 @@ class DataParallelRunner:
             return [np.asarray(f) for f in fetches]
         return fetches
 
+    def cost_analysis(self, executor, feed, fetch_list=None, scope=None):
+        """XLA cost/memory analysis of the sharded step executable (the
+        single-device Executor.cost_analysis counterpart): flops and —
+        the quantized-collective bench rung's metric — bytes accessed.
+        The (feed, fetch) signature must have run once already."""
+        from paddle_tpu.fluid import executor as ex
 
-class _ShardedBlock:
+        scope = scope or ex.global_scope()
+        feed = executor._coerce_feed(self.program, feed or {})
+        fetch_names = [f.name if not isinstance(f, str) else f
+                       for f in (fetch_list or [])]
+        cb = self._cache.get(self._cache_key(feed, fetch_names))
+        if cb is None:
+            raise ValueError(
+                "no compiled data-parallel executable for this (feed, "
+                "fetch_list) signature — run the step once first")
+        return cb.cost_analysis(scope, feed)
+
+
+class _ShardedBlock(_JitExecutable):
+    """One (program-version, feed-signature) → sharded XLA executable.
+    _JitExecutable supplies cost_analysis/_jit_args over the shared
+    (donated, readonly, feeds, step) calling convention, so the sharded
+    executable introspects exactly like the single-device one."""
+
     def __init__(self, program, feed_names, fetch_names, mesh, scope):
         import jax
         from jax.sharding import PartitionSpec as P
@@ -175,6 +319,7 @@ class _ShardedBlock:
                                 out_specs=out_specs, check_vma=False)
         self._jitted = jax.jit(sharded, donate_argnums=(0,))
         self.mesh = mesh
+        self.label = f"dp_block@{id(self):x}"
 
     def run(self, scope, feeds, step):
         import warnings

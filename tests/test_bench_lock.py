@@ -55,6 +55,41 @@ def test_second_driver_never_clobbers_or_unlinks(monkeypatch, tmp_path):
         assert int(fh.read()) == os.getpid()
 
 
+def test_acquire_is_atomic_and_reclaims_stale(monkeypatch, tmp_path):
+    """O_CREAT|O_EXCL acquisition: no check-then-write window.  A stale
+    decay-mode file (dead pid / >2h mtime) is reclaimed with one retry; a
+    live holder's file is never replaced."""
+    bench = _bench(monkeypatch, tmp_path)
+    lock = bench.DRIVER_LOCK
+    # clean acquire writes our pid
+    assert bench._acquire_driver_lock()
+    with open(lock) as fh:
+        assert int(fh.read()) == os.getpid()
+    # second acquire sees a LIVE holder (ourselves) and defers
+    assert not bench._acquire_driver_lock()
+    # stale file (dead pid) is reclaimed
+    with open(lock, "w") as fh:
+        fh.write("999999")
+    assert bench._acquire_driver_lock()
+    with open(lock) as fh:
+        assert int(fh.read()) == os.getpid()
+    # stale-by-mtime file is reclaimed too
+    stale = time.time() - 7201
+    os.utime(lock, (stale, stale))
+    assert bench._acquire_driver_lock()
+    assert bench._holds_driver_lock()
+    # touch refreshes mtime only while we hold it
+    old = time.time() - 100
+    os.utime(lock, (old, old))
+    bench.touch_driver_lock()
+    assert time.time() - os.path.getmtime(lock) < 10
+    with open(lock, "w") as fh:
+        fh.write("999999")  # someone else's file: touch must not refresh
+    os.utime(lock, (old, old))
+    bench.touch_driver_lock()
+    assert time.time() - os.path.getmtime(lock) > 50
+
+
 def test_driver_takes_and_releases_lock(monkeypatch, tmp_path):
     bench = _bench(monkeypatch, tmp_path)
     lock = bench.DRIVER_LOCK

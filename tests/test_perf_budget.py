@@ -213,6 +213,14 @@ def test_cost_model_flops_track_analytic_model(flagship):
     doubled compute path (duplicate backward, un-deduped recompute) lands
     outside the band.  Uses the persistent XLA compile cache, so steady-
     state CI cost is a cache load."""
+    import cpu_mesh
+
+    if cpu_mesh.legacy_cpu_runtime_forced():
+        import pytest
+
+        pytest.skip("legacy XLA:CPU runtime (pinned on jaxlib 0.4.3x for "
+                    "heap stability) undercounts cost-model flops ~6x — "
+                    "the ratio gate would fail on a measurement artifact")
     import bench
 
     comp = flagship["fp32"]["lowered"].compile()

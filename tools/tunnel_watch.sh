@@ -31,14 +31,19 @@ for i in $(seq 1 400); do
     # (no-op when nothing changed)
     (cd "$REPO" || exit
      python tools/onchip_report.py >> $LOG 2>&1
+     ARTIFACTS=""
      for f in ONCHIP_RESULTS.json docs/NORTHSTAR.md \
               LONGSEQ_BENCH.json ONCHIP_SMOKE.log; do
-       [ -e "$f" ] && git add "$f" 2>> $LOG
+       [ -e "$f" ] && git add "$f" 2>> $LOG && ARTIFACTS="$ARTIFACTS $f"
      done
-     git diff --cached --quiet \
-       || git commit -q -m "On-chip capture at tunnel window (watcher auto-commit)
+     # commit with an explicit pathspec: a concurrent interactive
+     # session's staged files must never be swept into the watcher's
+     # unattended commit (the bare `git commit` committed the whole index)
+     if [ -n "$ARTIFACTS" ] && ! git diff --cached --quiet -- $ARTIFACTS; then
+       git commit -q -m "On-chip capture at tunnel window (watcher auto-commit)
 
-No-Verification-Needed: results-artifact-only change" >> $LOG 2>&1)
+No-Verification-Needed: results-artifact-only change" -- $ARTIFACTS >> $LOG 2>&1
+     fi)
     if [ "$rc" -eq 0 ]; then
       echo "suite COMPLETE $(date)" >> $LOG
       exit 0

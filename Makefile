@@ -2,7 +2,8 @@
 PY ?= python
 REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 
-.PHONY: test test-book test-onchip bench bench-onchip int8-bench lint-api
+.PHONY: test test-book test-onchip bench bench-onchip int8-bench lint-api \
+	lint-resilience
 
 test:            ## full suite on the 8-device virtual CPU mesh (~8 min)
 	$(PY) -m pytest tests/ -q --ignore=tests/book
@@ -25,3 +26,6 @@ int8-bench:      ## int8 vs bf16 vs fp32 dense-serving A/B
 
 lint-api:        ## fail if the public API surface drifted from API.spec
 	$(PY) tools/gen_api_spec.py --check
+
+lint-resilience: ## no swallowed errors / unbounded waits in the distributed layer
+	$(PY) tools/lint_resilience.py

@@ -1,8 +1,21 @@
-"""paddle.distributed parity: multi-process training launchers.
+"""paddle.distributed parity: multi-process training launchers, plus the
+beyond-parity fault-tolerance layer.
 
 Reference analogs: python/paddle/distributed/launch.py (one process per
 device, collective mode) and launch_ps.py (pserver + trainer processes).
 Here the per-process device is a TPU chip (or a CPU mesh slice in tests)
 instead of a CUDA card, and workers rendezvous through the PADDLE_* env
 contract `fluid.incubate.fleet` reads.
+
+Beyond parity (SURVEY §5: the reference has no failure detection or
+elastic recovery): `resilience` (RetryPolicy + resilience_stats
+counters), `fault_injection` (deterministic FaultPlan test harness), and
+supervised restarts in the launchers (`--max_restarts`).
 """
+
+from .fault_injection import FaultPlan
+from .resilience import (RetryPolicy, reset_resilience_stats,
+                         resilience_stats)
+
+__all__ = ["FaultPlan", "RetryPolicy", "resilience_stats",
+           "reset_resilience_stats"]

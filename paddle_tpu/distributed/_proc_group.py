@@ -1,10 +1,17 @@
 """Shared child-process management for the launchers.
 
-One place for the spawn / poll / first-failure-teardown / log-handle
-contract so launch.py and launch_ps.py cannot drift: any process exiting
-non-zero terminates every survivor (a rank blocked in a collective or a
-pserver accept loop would otherwise hang the job forever), and log
-handles always close.
+One place for the spawn / poll / restart / first-failure-teardown /
+log-handle contract so launch.py and launch_ps.py cannot drift.
+
+Supervision: a child spawned with `max_restarts > 0` that dies (non-zero
+exit, including a kill signal) is relaunched up to that many times with
+exponential backoff.  Relaunched children get `PADDLE_RESTART_COUNT=<k>`
+in their env (roles use it to resume instead of re-initializing) and have
+`PT_FAULT_PLAN` stripped (faults are injected once per job, not once per
+incarnation).  When restarts are exhausted — or a child with no restart
+budget fails — every survivor is terminated and the failure raises, so
+the job dies CLEANLY instead of hanging on a rank blocked in a collective
+or a pserver accept loop.
 """
 
 from __future__ import annotations
@@ -31,15 +38,77 @@ def str2bool(v):
     raise ValueError(f"expected a boolean, got {v!r}")
 
 
-class ProcGroup:
-    """Children spawned together, torn down together."""
+class _Child:
+    """One supervised child: its spawn spec plus the live process, so a
+    relaunch reproduces the original command with restart markers."""
 
-    def __init__(self, log_dir=None):
+    def __init__(self, group, script, script_args, env, log_name,
+                 max_restarts=0):
+        self._group = group
+        self.script = script
+        self.script_args = list(script_args)
+        self.env = dict(env)
+        self.log_name = log_name
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self.restart_at = None  # monotonic deadline of a pending relaunch
+        self._log = None
+        self.proc = None
+        self._start()
+
+    def _start(self):
+        if self._log:
+            self._log.close()
+        self._log = (open(os.path.join(self._group.log_dir, self.log_name),
+                          "a" if self.restarts else "w")
+                     if self._group.log_dir else None)
+        env = dict(self.env)
+        if self.restarts:
+            env["PADDLE_RESTART_COUNT"] = str(self.restarts)
+            env.pop("PT_FAULT_PLAN", None)  # faults fire once per job
+        self.proc = subprocess.Popen(
+            [sys.executable, "-u", self.script, *self.script_args],
+            env=env, stdout=self._log, stderr=self._log)
+
+    def restart(self):
+        """Relaunch after a crash (caller owns the backoff scheduling)."""
+        self.restarts += 1
+        self.restart_at = None
+        self._start()
+
+    def poll(self):
+        return self.proc.poll()
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+
+    @property
+    def args(self):
+        return self.proc.args
+
+    def close_log(self):
+        if self._log:
+            self._log.close()
+            self._log = None
+
+
+class ProcGroup:
+    """Children spawned together, supervised together, torn down
+    together."""
+
+    def __init__(self, log_dir=None, restart_backoff=1.0):
         self.log_dir = log_dir
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
-        self.procs = []
-        self._logs = []
+        self.children = []
+        self.restart_backoff = float(restart_backoff)
+        self.restarts_performed = 0
+
+    # old name kept for callers that iterate .procs
+    @property
+    def procs(self):
+        return self.children
 
     def __enter__(self):
         return self
@@ -47,46 +116,70 @@ class ProcGroup:
     def __exit__(self, *exc):
         self.shutdown()
 
-    def spawn(self, script, script_args, env, log_name):
-        out = (open(os.path.join(self.log_dir, log_name), "w")
-               if self.log_dir else None)
-        self._logs.append(out)
-        proc = subprocess.Popen(
-            [sys.executable, "-u", script, *script_args],
-            env=env, stdout=out, stderr=out)
-        self.procs.append(proc)
-        return proc
+    def spawn(self, script, script_args, env, log_name, max_restarts=0):
+        child = _Child(self, script, script_args, env, log_name,
+                       max_restarts=max_restarts)
+        self.children.append(child)
+        return child
+
+    def _handle_failure(self, child, rc):
+        """Schedule/perform a relaunch if budget remains (True), else
+        report the failure (False).  The backoff is a per-child deadline,
+        NOT an inline sleep: the supervision loop keeps polling every
+        other child (a second crash — possibly unrecoverable — must not
+        go undetected for a whole backoff window)."""
+        if child.restarts >= child.max_restarts:
+            return False
+        now = time.monotonic()
+        if child.restart_at is None:
+            delay = self.restart_backoff * (2 ** child.restarts)
+            child.restart_at = now + delay
+            print(f"ProcGroup: child {child.log_name} exited rc={rc}; "
+                  f"relaunching in {delay:.1f}s "
+                  f"(restart {child.restarts + 1}/{child.max_restarts})",
+                  file=sys.stderr, flush=True)
+            return True
+        if now < child.restart_at:
+            return True  # backoff still running
+        child.restart()
+        self.restarts_performed += 1
+        try:  # count restarts in the resilience surface when available
+            from paddle_tpu.distributed import resilience
+            resilience.record("supervisor_restarts")
+        except Exception:
+            print("ProcGroup: resilience counters unavailable",
+                  file=sys.stderr)
+        return True
 
     def wait(self, workers=None):
-        """Block until every worker exits; raise on the first failure
-        (after terminating all survivors).  `workers` defaults to all
-        children; any non-worker child (e.g. a pserver accept loop that
-        never exits on its own) is terminated once the workers finish."""
-        workers = list(workers if workers is not None else self.procs)
+        """Block until every worker exits cleanly; supervise restarts;
+        raise on the first unrecoverable failure (after terminating all
+        survivors).  `workers` defaults to all children; any non-worker
+        child (e.g. a pserver accept loop that never exits on its own) is
+        terminated once the workers finish."""
+        workers = list(workers if workers is not None else self.children)
         failed = None
-        while any(p.poll() is None for p in workers):
-            for proc in self.procs:
-                rc = proc.poll()
-                if rc not in (None, 0) and failed is None:
-                    failed = (rc, proc.args)
-                    self._terminate_survivors()
-            time.sleep(0.2)
-        for proc in workers:
-            rc = proc.poll()
-            if rc not in (None, 0) and failed is None:
-                failed = (rc, proc.args)
+        while failed is None:
+            for child in self.children:
+                rc = child.poll()
+                if rc in (None, 0):
+                    continue
+                if not self._handle_failure(child, rc):
+                    failed = (rc, child.args)
+                    break
+            if failed is None:
+                if all(c.poll() == 0 for c in workers):
+                    break  # every worker finished cleanly
+                time.sleep(0.2)
         self._terminate_survivors()
         if failed:
             raise subprocess.CalledProcessError(failed[0], failed[1])
 
     def _terminate_survivors(self):
-        for proc in self.procs:
-            if proc.poll() is None:
-                proc.terminate()
+        for child in self.children:
+            child.terminate()
 
     def shutdown(self):
         self._terminate_survivors()
-        for out in self._logs:
-            if out:
-                out.close()
-        self._logs = []
+        for child in self.children:
+            child.close_log()

@@ -1,0 +1,216 @@
+"""Deterministic fault injection for the distributed runtime.
+
+The test harness behind the fault-tolerance layer: a `FaultPlan` describes
+exactly which RPC (the Nth of a given command) or which training step/round
+should fail, so recovery paths are exercised reproducibly instead of by
+hoping a race fires.  Plans come from code (`install(FaultPlan(...))`) or
+from the environment (`PT_FAULT_PLAN`), which is how subprocess tests arm
+one specific pserver or trainer.
+
+Spec grammar — semicolon-separated rules:
+
+    drop:<cmd>:<n>            Nth RPC of <cmd> raises a connection error
+                              BEFORE hitting the wire (a dropped packet;
+                              the retry layer sees a transport failure)
+    delay:<cmd>:<n>:<secs>    sleep <secs> before the Nth <cmd>
+    error:<cmd>:<n>           Nth <cmd> raises a non-retryable server error
+    flaky:<cmd>:<p>:<seed>    seeded Bernoulli drop of every <cmd> with
+                              probability <p> (deterministic sequence)
+    kill:step:<k>             SIGKILL this process when on_step(k) fires
+                              (trainer loops call on_step per step)
+    kill:round:<k>            SIGKILL when on_round(k) fires (the pserver
+                              sync loop calls on_round per completed round)
+
+`<cmd>` is an RPC name (send_grad, get_param, send_barrier, fetch_barrier,
+send_param, lookup_rows, checkpoint_notify, stop) or `*`.  Counts are
+1-based and per-process; a retried RPC re-enters the count, so `drop:...:3`
+fails exactly one attempt and the retry succeeds.
+
+The supervisor strips PT_FAULT_PLAN (and sets PADDLE_RESTART_COUNT) when it
+relaunches a child, so faults are injected once per job, not once per
+incarnation.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import sys
+import threading
+
+__all__ = ["FaultPlan", "FaultInjected", "install", "uninstall", "active",
+           "on_rpc", "on_step", "on_round"]
+
+_ENV = "PT_FAULT_PLAN"
+
+
+class FaultInjected(IOError):
+    """Marker base for injected failures (also lets tests tell an injected
+    fault from a real one)."""
+
+
+class _Rule:
+    __slots__ = ("action", "cmd", "n", "arg", "_rng")
+
+    def __init__(self, action, cmd, n, arg=None):
+        self.action = action
+        self.cmd = cmd
+        self.n = n
+        self.arg = arg
+        self._rng = (random.Random(int(arg) if arg is not None else 0)
+                     if action == "flaky" else None)
+
+    def __repr__(self):
+        return f"_Rule({self.action}:{self.cmd}:{self.n}" + (
+            f":{self.arg})" if self.arg is not None else ")")
+
+
+def _conn_error(msg):
+    from paddle_tpu import native
+    err = type("InjectedConnectionError",
+               (FaultInjected, native.PSConnectionError), {})
+    return err(msg)
+
+
+def _server_error(msg):
+    from paddle_tpu import native
+    err = type("InjectedServerError",
+               (FaultInjected, native.PSServerError), {})
+    return err(msg)
+
+
+class FaultPlan:
+    """A parsed, counting fault plan.  Thread-safe; counters are
+    per-process."""
+
+    def __init__(self, spec=""):
+        self.spec = spec or ""
+        self._lock = threading.Lock()
+        self._counts = {}
+        self.rules = []
+        for part in self.spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":")
+            action = bits[0]
+            if action in ("drop", "error") and len(bits) == 3:
+                self.rules.append(_Rule(action, bits[1], int(bits[2])))
+            elif action == "delay" and len(bits) == 4:
+                self.rules.append(
+                    _Rule(action, bits[1], int(bits[2]), float(bits[3])))
+            elif action == "flaky" and len(bits) == 4:
+                self.rules.append(
+                    _Rule(action, bits[1], float(bits[2]), bits[3]))
+            elif action == "kill" and len(bits) == 3 and \
+                    bits[1] in ("step", "round"):
+                self.rules.append(_Rule(action, bits[1], int(bits[2])))
+            else:
+                raise ValueError(f"bad fault rule {part!r} in {spec!r}")
+
+    @classmethod
+    def from_env(cls, env=_ENV):
+        return cls(os.environ.get(env, ""))
+
+    def _record(self):
+        from paddle_tpu.distributed import resilience
+        resilience.record("injected_faults")
+
+    def on_rpc(self, cmd_name):
+        """Called by the RPC client before each attempt; may sleep or
+        raise.  A retried attempt counts again."""
+        if not self.rules:
+            return
+        with self._lock:
+            n = self._counts[cmd_name] = self._counts.get(cmd_name, 0) + 1
+            fire = [r for r in self.rules
+                    if r.cmd in (cmd_name, "*") and r.action != "kill" and
+                    (r.action == "flaky" or r.n == n)]
+        for r in fire:
+            if r.action == "flaky":
+                if r._rng.random() >= r.n:  # n is the probability here
+                    continue
+                self._record()
+                raise _conn_error(
+                    f"fault-injection: flaky-dropped {cmd_name} rpc")
+            if r.action == "delay":
+                self._record()
+                import time
+                time.sleep(r.arg)
+            elif r.action == "drop":
+                self._record()
+                raise _conn_error(
+                    f"fault-injection: dropped {cmd_name} rpc #{r.n}")
+            elif r.action == "error":
+                self._record()
+                raise _server_error(
+                    f"fault-injection: injected server error on "
+                    f"{cmd_name} rpc #{r.n}")
+
+    def _maybe_kill(self, kind, k):
+        for r in self.rules:
+            if r.action == "kill" and r.cmd == kind and r.n == int(k):
+                print(f"fault-injection: SIGKILL pid {os.getpid()} at "
+                      f"{kind} {k}", file=sys.stderr, flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_step(self, step):
+        """Trainer-side hook: call once per training step."""
+        self._maybe_kill("step", step)
+
+    def on_round(self, rnd):
+        """Pserver-side hook: the sync serve loop calls this after each
+        completed round (absolute round id, snapshot-continuous)."""
+        self._maybe_kill("round", rnd)
+
+
+_plan = None
+_plan_resolved = False
+_plan_lock = threading.Lock()
+
+
+def install(plan):
+    """Install `plan` (a FaultPlan or spec string) for this process."""
+    global _plan, _plan_resolved
+    with _plan_lock:
+        _plan = FaultPlan(plan) if isinstance(plan, str) else plan
+        _plan_resolved = True
+    return _plan
+
+
+def uninstall():
+    global _plan, _plan_resolved
+    with _plan_lock:
+        _plan = None
+        _plan_resolved = True
+
+
+def active():
+    """The process's fault plan: the installed one, else PT_FAULT_PLAN
+    (resolved once), else None."""
+    global _plan, _plan_resolved
+    with _plan_lock:
+        if not _plan_resolved:
+            spec = os.environ.get(_ENV, "")
+            _plan = FaultPlan(spec) if spec else None
+            _plan_resolved = True
+        return _plan
+
+
+def on_rpc(cmd_name):
+    p = active()
+    if p is not None:
+        p.on_rpc(cmd_name)
+
+
+def on_step(step):
+    p = active()
+    if p is not None:
+        p.on_step(step)
+
+
+def on_round(rnd):
+    p = active()
+    if p is not None:
+        p.on_round(rnd)

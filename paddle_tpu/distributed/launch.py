@@ -99,7 +99,7 @@ def start_procs(args):
                 env["FLAGS_selected_gpus"] = selected[i]
             group.spawn(args.training_script, args.training_script_args,
                         env, f"workerlog.{i}")
-        group.wait()
+        group.wait()  # resilience: allow — supervision loop, polls inside
 
 
 def launch(argv=None):

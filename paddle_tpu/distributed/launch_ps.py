@@ -10,13 +10,22 @@ wiring the env contract `fleet.init(PaddleCloudRoleMaker())` /
     trainers:  TRAINING_ROLE=TRAINER, PADDLE_TRAINER_ID,
                PADDLE_PSERVERS, PADDLE_PORT, PADDLE_TRAINERS_NUM
 
-As in launch.py, the first failing process tears the whole job down,
-and pservers (which serve forever) are stopped once every trainer
-finishes.
+As in launch.py, the first unrecoverable process failure tears the whole
+job down, and pservers (which serve forever) are stopped once every
+trainer finishes.
+
+Fault tolerance (`--max_restarts=N`): a crashed pserver or trainer is
+relaunched up to N times with exponential backoff instead of killing the
+job.  Supervised pservers snapshot their shard every sync round into
+`--snapshot_dir` (default `<log_dir>/snapshots`) and a relaunched pserver
+resumes table+version+round from its latest snapshot; relaunched roles
+see `PADDLE_RESTART_COUNT` and must resume rather than re-initialize
+(the built-in `ps_init_sync` op already skips its init push).  When
+restarts are exhausted the job fails cleanly rather than hanging.
 
 Usage:
     python -m paddle_tpu.distributed.launch_ps --server_num=2 \
-        --worker_num=2 train_ps.py --your-args
+        --worker_num=2 [--max_restarts=2] train_ps.py --your-args
 """
 
 from __future__ import annotations
@@ -38,6 +47,17 @@ def _parse_args(argv=None):
                         help="explicit pserver endpoints ip:port,...")
     parser.add_argument("--log_dir", type=str, default="logs")
     parser.add_argument("--print_config", type=str2bool, default=True)
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="relaunch a crashed pserver/trainer up to "
+                             "this many times (0 = fail the job, the "
+                             "reference behavior)")
+    parser.add_argument("--restart_backoff", type=float, default=1.0,
+                        help="base seconds between relaunches (doubles "
+                             "per restart of the same process)")
+    parser.add_argument("--snapshot_dir", type=str, default="",
+                        help="pserver shard snapshot dir for elastic "
+                             "resume (default <log_dir>/snapshots when "
+                             "--max_restarts > 0)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=REMAINDER)
     return parser.parse_args(argv)
@@ -61,16 +81,27 @@ def start_procs(args):
                   PADDLE_PORT=ports,
                   PADDLE_PSERVER_ENDPOINTS=",".join(endpoints),
                   PADDLE_TRAINERS_NUM=str(args.worker_num))
+    snapshot_dir = args.snapshot_dir or (
+        os.path.join(args.log_dir, "snapshots")
+        if args.max_restarts > 0 and args.log_dir else "")
+    if snapshot_dir:
+        # pserver shards auto-snapshot + resume through this dir (the
+        # listen_and_serv host op reads it)
+        common["PT_PS_SNAPSHOT_DIR"] = snapshot_dir
     if args.print_config:
-        print(f"launch_ps: servers={endpoints} workers={args.worker_num}")
+        print(f"launch_ps: servers={endpoints} workers={args.worker_num}"
+              + (f" max_restarts={args.max_restarts} "
+                 f"snapshots={snapshot_dir}" if args.max_restarts else ""))
 
-    with ProcGroup(args.log_dir) as group:
+    with ProcGroup(args.log_dir,
+                   restart_backoff=args.restart_backoff) as group:
         def spawn(role_env, log_name):
             env = dict(base_env)
             env.update(common)
             env.update(role_env)  # role wins (a pserver's own PADDLE_PORT)
             return group.spawn(args.training_script,
-                               args.training_script_args, env, log_name)
+                               args.training_script_args, env, log_name,
+                               max_restarts=args.max_restarts)
 
         for i, ep in enumerate(endpoints):
             spawn({"TRAINING_ROLE": "PSERVER", "POD_IP": ep.split(":")[0],

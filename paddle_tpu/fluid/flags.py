@@ -38,6 +38,17 @@ _DEFS = {
     # distributed (consumed by the PS/RPC host ops and the async
     # Communicator; reference __init__.py:187-196 reads the same env names)
     "FLAGS_rpc_deadline": (180000, int, True),
+    # RPC retry/backoff (reference grpc flag FLAGS_rpc_retry_times=3;
+    # backoff is TPU-native — the reference retries immediately).  0
+    # retries = fail fast on the first transport error.  Consumed by
+    # native.PSClient via distributed.resilience.RetryPolicy.
+    "FLAGS_rpc_retry_times": (3, int, True),
+    "FLAGS_rpc_retry_backoff_ms": (100, int, True),
+    # liveness deadline on pserver-side barrier / versioned-get waits (the
+    # heartbeat analog): a request parked longer than this answers with a
+    # retryable timeout instead of wedging behind a dead peer; 0 = wait
+    # forever (reference listen_and_serv behavior)
+    "FLAGS_ps_barrier_timeout_ms": (300000, int, True),
     "FLAGS_communicator_max_merge_var_num": (20, int, True),
     "FLAGS_communicator_send_queue_size": (20, int, True),
     "FLAGS_communicator_independent_recv_thread": (True, _parse_bool, False),

@@ -146,6 +146,22 @@ class AutoCheckpoint:
             except ValueError:  # non-main thread
                 break
 
+    def uninstall(self):
+        """Restore the signal handlers that were active before this
+        AutoCheckpoint installed its preemption hook (call when training
+        finishes; a leaked hook would snapshot on behalf of a dead
+        loop).  Safe to call twice.  A call from a non-main thread keeps
+        the record so a later main-thread call can still restore."""
+        handlers = getattr(self, "_prev_handlers", {})
+        for sig in list(handlers):
+            prev = handlers[sig]
+            try:
+                signal.signal(sig, prev if prev is not None
+                              else signal.SIG_DFL)
+            except ValueError:  # non-main thread: can't restore from here
+                break
+            handlers.pop(sig)
+
     def _on_signal(self, signum, frame):
         if self._last_step is not None:
             try:
@@ -158,5 +174,14 @@ class AutoCheckpoint:
             # restore the ignore and keep running
             signal.signal(signum, signal.SIG_IGN)
             return
-        signal.signal(signum, prev if callable(prev) else signal.SIG_DFL)
+        if callable(prev):
+            # CHAIN to the previously-installed handler (a launcher's own
+            # teardown hook, a profiler's flush, ...) instead of assuming
+            # the default action; our hook stays installed so a later
+            # signal still snapshots first.
+            prev(signum, frame)
+            return
+        # prev is SIG_DFL or a non-Python handler (None): re-deliver with
+        # the default action so the process actually dies
+        signal.signal(signum, signal.SIG_DFL)
         signal.raise_signal(signum)

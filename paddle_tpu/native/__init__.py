@@ -19,11 +19,14 @@ import hashlib
 import os
 import subprocess
 import threading
+import time
 
 import numpy as np
 
 __all__ = ["lib", "RecordIOWriter", "RecordIOScanner", "BlockingQueue",
-           "MultiSlotFeed", "NativePredictor", "is_available"]
+           "MultiSlotFeed", "NativePredictor", "is_available",
+           "PSError", "PSConnectionError", "PSServerError",
+           "PSTimeoutError"]
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _SRCS = [os.path.join(_SRC_DIR, "data_runtime.cc"),
@@ -141,6 +144,10 @@ def lib():
         L.pts_server_start.argtypes = [ctypes.c_int, ctypes.c_int]
         L.pts_server_port.restype = ctypes.c_int
         L.pts_server_port.argtypes = [ctypes.c_void_p]
+        L.pts_server_set_barrier_timeout_ms.argtypes = [ctypes.c_void_p,
+                                                        ctypes.c_int]
+        L.pts_server_stat.restype = ctypes.c_int64
+        L.pts_server_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
         L.pts_server_wait_round.restype = ctypes.c_int
         L.pts_server_wait_round.argtypes = [ctypes.c_void_p]
         L.pts_server_grad_count.restype = ctypes.c_int64
@@ -428,6 +435,41 @@ CMD_STOP = 6
 CMD_LOOKUP_ROWS = 7
 CMD_CHECKPOINT_NOTIFY = 8
 
+_CMD_NAMES = {CMD_SEND_GRAD: "send_grad", CMD_GET_PARAM: "get_param",
+              CMD_SEND_BARRIER: "send_barrier",
+              CMD_FETCH_BARRIER: "fetch_barrier",
+              CMD_SEND_PARAM: "send_param", CMD_STOP: "stop",
+              CMD_LOOKUP_ROWS: "lookup_rows",
+              CMD_CHECKPOINT_NOTIFY: "checkpoint_notify"}
+
+# barrier frames carry the trainer's completed-round count; this high bit
+# marks the retry of a timed-out wait (server must not re-count the
+# arrival) — mirrors kPtsRewaitBit in native_api.h
+_REWAIT_BIT = 1 << 63
+
+
+class PSError(IOError):
+    """Base of all parameter-server RPC failures (an IOError so existing
+    `except IOError` teardown paths keep working)."""
+
+
+class PSConnectionError(PSError):
+    """Transport broken / peer unreachable — retryable with reconnect."""
+
+
+class PSServerError(PSError):
+    """The server answered with an error status — NOT retryable (the
+    request itself is wrong, or the server was deliberately stopped)."""
+
+
+class PSTimeoutError(PSError):
+    """The server's liveness deadline expired while the request waited
+    (status 2) — retryable; barriers rewait without re-arriving.
+    `server_round` (when set) is the effective round the server parked
+    the arrival on — the rewait echoes it."""
+
+    server_round = None
+
 # payload magic distinguishing a row-sparse gradient (SelectedRows: ids +
 # row values) from a dense tensor blob.  Dense blobs start with the dtype
 # code length (1..8); 0xSR can never collide.
@@ -481,14 +523,35 @@ class PSServer:
     listen_and_serv_op.cc:109 RunSyncLoop.
     """
 
-    def __init__(self, port=0, n_trainers=1):
+    def __init__(self, port=0, n_trainers=1, barrier_timeout_ms=None):
         self._h = lib().pts_server_start(int(port), int(n_trainers))
         if not self._h:
             raise IOError(f"cannot bind pserver port {port}")
+        if barrier_timeout_ms is None:
+            from paddle_tpu.fluid import flags
+            barrier_timeout_ms = flags.flag("ps_barrier_timeout_ms")
+        self.set_barrier_timeout(barrier_timeout_ms)
 
     @property
     def port(self):
         return lib().pts_server_port(self._h)
+
+    def set_barrier_timeout(self, ms):
+        """Liveness deadline on barrier / versioned-get waits: a request
+        parked longer than `ms` is answered with a retryable timeout
+        (status 2) instead of wedging behind a dead peer; 0 = wait
+        forever (reference behavior)."""
+        lib().pts_server_set_barrier_timeout_ms(self._h, int(ms))
+
+    def stats(self):
+        """Server-side resilience counters (stale-trainer detection:
+        nonzero barrier timeouts mean some peer stopped arriving)."""
+        st = lib().pts_server_stat
+        return {"send_barrier_timeouts": st(self._h, 0),
+                "fetch_barrier_timeouts": st(self._h, 1),
+                "get_param_timeouts": st(self._h, 2),
+                "rounds": st(self._h, 3),
+                "version": st(self._h, 4)}
 
     def wait_round(self) -> bool:
         """Block until every trainer hit send_barrier; False = stopped."""
@@ -581,21 +644,192 @@ class PSServer:
 
 
 class PSClient:
-    """Trainer-side connection to one pserver endpoint."""
+    """Trainer-side connection to one pserver endpoint.
 
-    def __init__(self, host="127.0.0.1", port=0, timeout=30.0):
+    Fault tolerance: every RPC runs under a `RetryPolicy`
+    (FLAGS_rpc_retry_times / FLAGS_rpc_retry_backoff_ms unless overridden
+    per client) — transport failures reconnect transparently and retry
+    with exponential backoff + jitter, server liveness deadlines
+    (status 2) retry in place, and server-rejected requests raise
+    immediately.  `retry_times=0` restores the seed's fail-fast behavior.
+    When retries exhaust, the client marks itself `broken` so the channel
+    cache (`ops.dist_ops.get_channel`) evicts it.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, timeout=30.0,
+                 retry_times=None, retry_backoff_ms=None):
+        self._host, self._port = host, int(port)
+        self._timeout = float(timeout)
+        self._retry_times = retry_times
+        self._retry_backoff_ms = retry_backoff_ms
+        self._policy_cache = None
+        self._lock = threading.RLock()
+        self.broken = False
+        self._rounds_done = 0  # completed sync rounds (internal barriers)
+        # stable identity for barrier-arrival dedup: survives reconnects
+        # AND supervised relaunches (PADDLE_TRAINER_ID is stable across a
+        # trainer's incarnations), so neither a re-arrive on a surviving
+        # server nor a relaunched trainer replaying a still-open round can
+        # double-count.  Processes outside the launcher env contract
+        # (tests simulating trainers with threads) fall back to a uuid.
+        tid = os.environ.get("PADDLE_TRAINER_ID")
+        if tid:
+            self._uid = f"trainer:{tid}"
+        else:
+            import uuid
+            self._uid = uuid.uuid4().hex
         self._h = lib().pts_connect(host.encode(), int(port), float(timeout))
         if not self._h:
-            raise IOError(f"cannot connect to pserver {host}:{port}")
+            raise PSConnectionError(
+                f"cannot connect to pserver {host}:{port}")
 
-    def _req(self, cmd, name="", round=0, blob=b""):
+    @property
+    def endpoint(self):
+        return f"{self._host}:{self._port}"
+
+    def _policy(self):
+        """Retry policy, cached until the flags it was built from change
+        (so the hot path pays two flag lookups, not a fresh RNG, and the
+        jitter sequence actually advances across retries)."""
+        from paddle_tpu.distributed import resilience
+        from paddle_tpu.fluid import flags
+
+        t = (self._retry_times if self._retry_times is not None
+             else flags.flag("rpc_retry_times"))
+        b = (self._retry_backoff_ms if self._retry_backoff_ms is not None
+             else flags.flag("rpc_retry_backoff_ms"))
+        cached = self._policy_cache
+        if cached is None or cached.times != t or cached.backoff_ms != b:
+            cached = self._policy_cache = resilience.RetryPolicy(
+                times=t, backoff_ms=b)
+        return cached
+
+    def reconnect(self, timeout=None):
+        """Drop the (broken) connection and dial the endpoint again.
+        pts_connect itself polls the address until `timeout`, so a
+        restarting pserver is picked up within ~50 ms of binding."""
+        from paddle_tpu.distributed import resilience
+
+        with self._lock:
+            if self._h:
+                lib().pts_client_close(self._h)
+                self._h = None
+            t = min(self._timeout, 5.0) if timeout is None else timeout
+            h = lib().pts_connect(self._host.encode(), self._port, float(t))
+            if not h:
+                resilience.record("reconnect_failures")
+                raise PSConnectionError(
+                    f"reconnect to pserver {self.endpoint} failed")
+            self._h = h
+            resilience.record("reconnects")
+
+    def _req_once(self, cmd, name="", round=0, blob=b""):
+        """One wire attempt; classifies failures for the retry layer."""
         out, olen = ctypes.c_void_p(), ctypes.c_int64()
-        rc = lib().pts_request(self._h, cmd, name.encode(), round, blob,
-                               len(blob), ctypes.byref(out),
-                               ctypes.byref(olen))
-        if rc != 0:
-            raise IOError(f"pserver rpc cmd={cmd} name={name} failed rc={rc}")
-        return _take(out, olen.value)
+        with self._lock:
+            if self._h is None:
+                raise PSConnectionError(
+                    f"connection to pserver {self.endpoint} is closed")
+            rc = lib().pts_request(self._h, cmd, name.encode(), round, blob,
+                                   len(blob), ctypes.byref(out),
+                                   ctypes.byref(olen))
+        data = _take(out, olen.value) if out.value else b""
+        if rc == 0:
+            return data
+        what = (f"pserver rpc {_CMD_NAMES.get(cmd, cmd)} name={name!r} "
+                f"to {self.endpoint}")
+        if rc == 2:
+            err = PSTimeoutError(f"{what}: server liveness deadline "
+                                 f"expired (stale peer suspected)")
+            if len(data) == 8:  # barrier timeout echoes the effective round
+                err.server_round = int.from_bytes(data, "little")
+            raise err
+        if rc == 1:
+            raise PSServerError(f"{what}: rejected by server (stopped, "
+                                f"or bad request)")
+        raise PSConnectionError(f"{what}: transport failed (rc={rc})")
+
+    def _req(self, cmd, name="", round=0, blob=b"", retry=True):
+        """RPC with transparent retry/reconnect.  Safe for idempotent
+        commands (everything except barriers, which use _barrier below).
+        Note send_grad retried across a reconnect is at-least-once: an
+        ack lost with the connection means the server may already hold
+        the payload (see docs/DISTRIBUTED.md "Fault tolerance")."""
+        from paddle_tpu.distributed import fault_injection, resilience
+
+        policy = self._policy() if retry else None
+        attempt = 0
+        while True:
+            try:
+                fault_injection.on_rpc(_CMD_NAMES.get(cmd, str(cmd)))
+                return self._req_once(cmd, name, round, blob)
+            except PSServerError:
+                raise
+            except PSTimeoutError:
+                if policy is None or not policy.should_retry(attempt):
+                    raise
+                resilience.record("rpc_timeout_retries")
+                attempt += 1
+            except PSConnectionError as e:
+                if policy is None or not policy.should_retry(attempt):
+                    self.broken = True
+                    raise PSConnectionError(
+                        f"{e} (after {attempt} retries; "
+                        f"FLAGS_rpc_retry_times="
+                        f"{0 if policy is None else policy.times})") from e
+                resilience.record("rpc_retries")
+                time.sleep(policy.delay(attempt))
+                attempt += 1
+                try:
+                    self.reconnect()
+                except PSConnectionError:
+                    continue  # endpoint still down; next attempt re-dials
+
+    def _barrier(self, cmd, round=None):
+        """Barrier RPC with exactly-once arrival under retry: arrivals
+        are identity-deduped server-side (this client's uid travels in
+        the name field), a liveness timeout (status 2) REWAITS on the
+        server-echoed effective round, and a transport failure re-ARRIVES
+        — a no-op on a surviving server, a fresh arrival on a restarted
+        one."""
+        from paddle_tpu.distributed import fault_injection, resilience
+
+        rc_ = self._rounds_done if round is None else int(round)
+        policy = self._policy()
+        attempt = 0
+        rewait = False
+        while True:
+            try:
+                fault_injection.on_rpc(_CMD_NAMES[cmd])
+                self._req_once(
+                    cmd, name=self._uid,
+                    round=(rc_ | _REWAIT_BIT) if rewait else rc_)
+                if round is None and cmd == CMD_FETCH_BARRIER:
+                    self._rounds_done += 1
+                return
+            except PSServerError:
+                raise
+            except PSTimeoutError as e:
+                if not policy.should_retry(attempt):
+                    raise
+                resilience.record("barrier_rewaits")
+                if e.server_round is not None:
+                    rc_ = e.server_round  # wait on what the server parked
+                rewait = True
+                attempt += 1
+            except PSConnectionError as e:
+                if not policy.should_retry(attempt):
+                    self.broken = True
+                    raise PSConnectionError(
+                        f"{e} (after {attempt} retries)") from e
+                resilience.record("rpc_retries")
+                time.sleep(policy.delay(attempt))
+                attempt += 1
+                rewait = False  # fresh/restarted server: must re-arrive
+                try:
+                    self.reconnect()
+                except PSConnectionError:
+                    continue
 
     def send_grad(self, name, arr):
         self._req(CMD_SEND_GRAD, name, blob=_encode_tensor(arr))
@@ -626,11 +860,13 @@ class PSClient:
         return _decode_tensor(self._req(CMD_GET_PARAM, name,
                                         round=want_version), shape)
 
-    def send_barrier(self):
-        self._req(CMD_SEND_BARRIER)
+    def send_barrier(self, round=None):
+        """Arrive at the send barrier for `round` (the trainer's
+        completed-round count; defaults to this client's own counter)."""
+        self._barrier(CMD_SEND_BARRIER, round)
 
-    def fetch_barrier(self):
-        self._req(CMD_FETCH_BARRIER)
+    def fetch_barrier(self, round=None):
+        self._barrier(CMD_FETCH_BARRIER, round)
 
     def checkpoint_notify(self, path):
         """Ask the pserver to snapshot its shard to `path` (reference
@@ -638,12 +874,15 @@ class PSClient:
         self._req(CMD_CHECKPOINT_NOTIFY, str(path))
 
     def stop_server(self):
-        self._req(CMD_STOP)
+        # no retry: stopping an already-dead server must fail fast, not
+        # spend the whole backoff schedule reconnecting to a corpse
+        self._req(CMD_STOP, retry=False)
 
     def close(self):
-        if self._h:
-            lib().pts_client_close(self._h)
-            self._h = None
+        with self._lock:
+            if self._h:
+                lib().pts_client_close(self._h)
+                self._h = None
 
     def __del__(self):
         try:

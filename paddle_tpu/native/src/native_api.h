@@ -26,6 +26,17 @@ enum PtsCmd : uint8_t {
   kCheckpointNotify = 8,
 };
 
+// Response status codes: 0 ok, 1 error/stopped, 2 liveness-deadline
+// timeout (retryable: barriers rewait, GET_PARAM re-sends).  A status-2
+// barrier response carries an 8-byte payload: the EFFECTIVE round the
+// arrival waits on, which the client echoes back in its rewait.
+
+// Barrier frames carry the trainer's completed-round count in `round`
+// and its stable client uid in `name` (arrivals are identity-deduped;
+// empty name skips the dedup); this high bit marks a REWAIT — the retry
+// of a timed-out barrier wait, which must not re-count the arrival.
+constexpr uint64_t kPtsRewaitBit = 1ull << 63;
+
 extern "C" {
 // --- shared ---------------------------------------------------------- //
 void ptq_free(char* p);
@@ -83,6 +94,11 @@ void pti_free(void* handle);
 // --- parameter-server transport --------------------------------------- //
 void* pts_server_start(int port, int n_trainers);
 int pts_server_port(void* h);
+// liveness deadline for barrier / versioned-get waits; 0 = wait forever
+void pts_server_set_barrier_timeout_ms(void* h, int ms);
+// counters: 0 send-barrier timeouts, 1 fetch-barrier timeouts,
+// 2 get-param timeouts, 3 completed rounds, 4 published version
+int64_t pts_server_stat(void* h, int which);
 int pts_server_wait_round(void* h);
 void pts_server_release_send(void* h);
 int64_t pts_server_grad_count(void* h);
@@ -101,8 +117,8 @@ int64_t pts_server_table_get(void* h, const char* name, char** out);
 int pts_server_wait_table(void* h, const char* name);
 void pts_server_stop(void* h);
 void* pts_connect(const char* host, int port, double timeout_s);
-// status 0 ok / 1 error / -1 io failure; kGetParam payload lands in *out
-// (caller frees via ptq_free)
+// status 0 ok / 1 error / 2 server deadline (retryable) / -1 io failure;
+// kGetParam payload lands in *out (caller frees via ptq_free)
 int pts_request(void* h, int cmd, const char* name, uint64_t round,
                 const char* data, int64_t dlen, char** out, int64_t* olen);
 void pts_client_close(void* h);

@@ -151,12 +151,41 @@ static void test_ps_async_pop_and_lookup() {
   std::puts("ps async pop + lookup ok");
 }
 
+static void test_ps_barrier_deadline_and_rewait() {
+  // liveness deadline: with only 1 of 2 trainers arriving, the barrier
+  // wait answers status 2 (retryable timeout) instead of parking forever;
+  // a REWAIT retry must not re-count the arrival
+  void* srv = pts_server_start(0, 2);
+  CHECK(srv != nullptr);
+  pts_server_set_barrier_timeout_ms(srv, 100);
+  int port = pts_server_port(srv);
+  void* c = pts_connect("127.0.0.1", port, 5.0);
+  CHECK(c != nullptr);
+  CHECK(pts_request(c, kSendBarrier, "", 0, nullptr, 0, nullptr, nullptr)
+        == 2);  // timed out: stale-peer detection
+  CHECK(pts_server_stat(srv, 0) == 1);  // send-barrier timeout counted
+  // rewait (high bit set): times out again, still exactly one arrival
+  CHECK(pts_request(c, kSendBarrier, "", kPtsRewaitBit, nullptr, 0, nullptr,
+                    nullptr) == 2);
+  CHECK(pts_server_stat(srv, 0) == 2);
+  // versioned GET_PARAM also honors the deadline
+  char* out = nullptr;
+  int64_t olen = 0;
+  CHECK(pts_request(c, kGetParam, "nope", 9, nullptr, 0, &out, &olen) == 2);
+  ptq_free(out);
+  CHECK(pts_server_stat(srv, 2) == 1);
+  pts_client_close(c);
+  pts_server_stop(srv);
+  std::puts("ps barrier deadline + rewait ok");
+}
+
 int main(int argc, char** argv) {
   const char* tmpdir = argc > 1 ? argv[1] : "/tmp";
   test_recordio(tmpdir);
   test_queue();
   test_ps_sync_round();
   test_ps_async_pop_and_lookup();
+  test_ps_barrier_deadline_and_rewait();
   std::puts("ALL NATIVE TESTS PASSED");
   return 0;
 }

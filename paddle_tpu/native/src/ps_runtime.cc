@@ -14,7 +14,8 @@
 // Wire format (little-endian), one request per frame:
 //   u8 cmd | u16 name_len | name | u64 round | u64 data_len | data
 // response:
-//   u8 status (0 ok, 1 stopped/error) | u64 data_len | data
+//   u8 status (0 ok, 1 stopped/error, 2 liveness-deadline timeout —
+//   retryable) | u64 data_len | data
 //
 // Sync-round protocol (mirrors RunSyncLoop):
 //   trainers: SEND_GRAD*  SEND_BARRIER  GET_PARAM(round=r)*  FETCH_BARRIER
@@ -45,6 +46,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -119,9 +121,33 @@ struct PSServer {
   std::deque<std::pair<std::string, std::string>> grads;
   int send_arrived = 0;    // trainers parked in SEND_BARRIER this round
   int fetch_arrived = 0;   // trainers parked in FETCH_BARRIER this round
+  // client identities counted this round: a trainer that reconnects and
+  // re-arrives (its ack was lost with the connection, server survived)
+  // must not be counted twice.  Barrier frames carry the client's uid in
+  // the (otherwise unused) name field; an empty name skips the dedup.
+  std::unordered_set<std::string> send_ids, fetch_ids;
   uint64_t round_id = 0;       // completed rounds
   uint64_t send_ack_round = 0;  // rounds whose send barrier was released
   bool stopped = false;
+  // liveness deadline on server-side waits (barriers, versioned GET_PARAM).
+  // 0 = wait forever (seed behavior).  On expiry the request is answered
+  // with status 2 (retryable timeout) instead of parking the connection
+  // forever behind a dead peer — the stale-trainer detector.
+  int barrier_timeout_ms = 0;
+  int64_t stat_send_barrier_timeouts = 0;
+  int64_t stat_fetch_barrier_timeouts = 0;
+  int64_t stat_get_timeouts = 0;
+
+  // wait on cv with the liveness deadline; returns false on timeout
+  template <class Pred>
+  bool wait_alive(std::unique_lock<std::mutex>& lk, Pred pred) {
+    if (barrier_timeout_ms <= 0) {
+      cv.wait(lk, pred);
+      return true;
+    }
+    return cv.wait_for(lk, std::chrono::milliseconds(barrier_timeout_ms),
+                       pred);
+  }
 
   std::thread accept_thread;
   std::vector<std::thread> conn_threads;
@@ -182,11 +208,44 @@ struct PSServer {
           if (!write_response(fd, 0, "")) return;
           break;
         case kSendBarrier: {
-          uint64_t r = round_id;
-          ++send_arrived;
+          // f.round carries the trainer's completed-round count; the
+          // rewait bit marks a retry of a timed-out wait — the trainer
+          // already arrived, so it must NOT be counted again (the barrier
+          // retry is idempotent; see the client's _barrier loop).
+          uint64_t rc = f.round & ~kPtsRewaitBit;
+          if ((f.round & kPtsRewaitBit) == 0) {
+            // a fresh client (relaunched trainer, re-dialed channel)
+            // arrives with a LOW count: it means "ack when the round I'm
+            // joining completes", i.e. the server's current round.  A
+            // REWAIT keeps the client's echoed count — its release may
+            // have happened while the timeout answer was in flight.
+            if (round_id > rc) rc = round_id;
+            // LATE replay: between release_send and end_round no live
+            // trainer can legitimately send-arrive (they are all parked
+            // in fetch), so an arrival here is a relaunched trainer
+            // replaying an already-released round — ack it (pred is
+            // already true) but do NOT count it toward the next round.
+            bool late = send_ack_round > rc;
+            // identity-deduped arrival: a re-arrive after a reconnect on
+            // a SURVIVING server is a no-op (its first arrival stands)
+            if (!late && (f.name.empty() || send_ids.insert(f.name).second))
+              ++send_arrived;
+          }
           cv.notify_all();
           // ack deferred until the driver released this round's sends
-          cv.wait(lk, [&] { return stopped || send_ack_round > r; });
+          bool done = wait_alive(
+              lk, [&] { return stopped || send_ack_round > rc; });
+          if (!done) {
+            ++stat_send_barrier_timeouts;
+            // echo the EFFECTIVE round the arrival waits on so the
+            // client's rewait targets it exactly (a fresh client's own
+            // count may be lower than the floored value)
+            std::string eff(8, '\0');
+            ::memcpy(&eff[0], &rc, 8);
+            lk.unlock();
+            if (!write_response(fd, 2, eff)) return;
+            break;  // keep the connection: the trainer rewaits on it
+          }
           bool ok = !stopped;
           lk.unlock();
           if (!write_response(fd, ok ? 0 : 1, "")) return;
@@ -194,10 +253,22 @@ struct PSServer {
           break;
         }
         case kFetchBarrier: {
-          uint64_t r = round_id;
-          ++fetch_arrived;
+          uint64_t rc = f.round & ~kPtsRewaitBit;
+          if ((f.round & kPtsRewaitBit) == 0) {
+            if (f.name.empty() || fetch_ids.insert(f.name).second)
+              ++fetch_arrived;
+            if (round_id > rc) rc = round_id;  // same fresh-client rule
+          }
           cv.notify_all();
-          cv.wait(lk, [&] { return stopped || round_id > r; });
+          bool done = wait_alive(lk, [&] { return stopped || round_id > rc; });
+          if (!done) {
+            ++stat_fetch_barrier_timeouts;
+            std::string eff(8, '\0');
+            ::memcpy(&eff[0], &rc, 8);
+            lk.unlock();
+            if (!write_response(fd, 2, eff)) return;
+            break;
+          }
           bool ok = !stopped;
           lk.unlock();
           if (!write_response(fd, ok ? 0 : 1, "")) return;
@@ -206,9 +277,15 @@ struct PSServer {
         }
         case kGetParam: {
           uint64_t want = f.round;
-          cv.wait(lk, [&] {
+          bool done = wait_alive(lk, [&] {
             return stopped || (version >= want && table.count(f.name));
           });
+          if (!done) {
+            ++stat_get_timeouts;
+            lk.unlock();
+            if (!write_response(fd, 2, "")) return;
+            break;  // GET_PARAM is idempotent: the client re-sends it
+          }
           if (stopped) {
             write_response(fd, 1, "");
             return;
@@ -254,7 +331,11 @@ struct PSServer {
       const std::string& path,
       const std::unordered_map<std::string, std::string>& copy,
       uint64_t ver, uint64_t rid) {
-    FILE* fp = ::fopen(path.c_str(), "wb");
+    // write-to-temp + rename: a crash mid-save (the supervised pserver
+    // snapshots EVERY round, so the window recurs constantly) must never
+    // truncate the previous good snapshot the relaunch depends on
+    std::string tmp = path + ".tmp";
+    FILE* fp = ::fopen(tmp.c_str(), "wb");
     if (!fp) return false;
     bool ok = true;
     uint64_t magic = kCkptMagic, count = copy.size();
@@ -271,6 +352,8 @@ struct PSServer {
       ok &= blen == 0 || ::fwrite(kv.second.data(), blen, 1, fp) == 1;
     }
     ok &= ::fclose(fp) == 0;
+    if (ok) ok = ::rename(tmp.c_str(), path.c_str()) == 0;
+    if (!ok) ::remove(tmp.c_str());
     return ok;
   }
 
@@ -299,6 +382,9 @@ struct PSServer {
     table = std::move(loaded);
     version = ver;
     round_id = rid;
+    // a restarted shard resumes mid-protocol: trainers re-arriving with
+    // completed-round count == rid must wait for the NEXT release
+    send_ack_round = rid;
     cv.notify_all();
     return true;
   }
@@ -362,6 +448,29 @@ void* pts_server_start(int port, int n_trainers) {
 
 int pts_server_port(void* h) { return static_cast<PSServer*>(h)->port; }
 
+// liveness deadline (ms) for server-side barrier / versioned-get waits;
+// 0 disables (wait forever, the seed behavior)
+void pts_server_set_barrier_timeout_ms(void* h, int ms) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->barrier_timeout_ms = ms;
+}
+
+// resilience counters: 0 send-barrier timeouts, 1 fetch-barrier timeouts,
+// 2 get-param timeouts, 3 completed rounds, 4 published version
+int64_t pts_server_stat(void* h, int which) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  switch (which) {
+    case 0: return s->stat_send_barrier_timeouts;
+    case 1: return s->stat_fetch_barrier_timeouts;
+    case 2: return s->stat_get_timeouts;
+    case 3: return static_cast<int64_t>(s->round_id);
+    case 4: return static_cast<int64_t>(s->version);
+    default: return -1;
+  }
+}
+
 // 1 = round ready (all trainers hit send_barrier), 0 = stopped
 int pts_server_wait_round(void* h) {
   auto* s = static_cast<PSServer*>(h);
@@ -378,6 +487,7 @@ void pts_server_release_send(void* h) {
   std::lock_guard<std::mutex> lk(s->mu);
   s->send_ack_round = s->round_id + 1;
   s->send_arrived -= s->n_trainers;
+  s->send_ids.clear();  // next round's arrivals dedupe afresh
   s->cv.notify_all();
 }
 
@@ -459,6 +569,7 @@ int pts_server_end_round(void* h) {
   if (s->stopped) return 0;
   s->grads.clear();
   s->fetch_arrived -= s->n_trainers;
+  s->fetch_ids.clear();
   ++s->round_id;
   s->cv.notify_all();
   return 1;

@@ -13,6 +13,7 @@ paddle_tpu/native/src/ps_runtime.cc (the gRPC SendRecvService equivalent).
 
 from __future__ import annotations
 
+import os
 import threading
 
 import jax.numpy as jnp
@@ -38,7 +39,9 @@ class _Channel:
         from paddle_tpu.fluid import flags
 
         host, port = endpoint.rsplit(":", 1)
-        # FLAGS_rpc_deadline is ms (reference grpc_client.cc deadline)
+        self.endpoint = endpoint
+        # FLAGS_rpc_deadline is ms (reference grpc_client.cc deadline);
+        # retry/backoff knobs come from FLAGS_rpc_retry_* inside the client
         self.client = native.PSClient(
             host=host, port=int(port),
             timeout=flags.flag("rpc_deadline") / 1000.0)
@@ -50,30 +53,100 @@ _channels_lock = threading.Lock()
 
 
 def get_channel(endpoint) -> _Channel:
+    """Cached trainer→pserver channel.  A channel whose client exhausted
+    its retries (`broken`) is evicted and re-dialed fresh — the fresh
+    channel restarts its round count at 0, which only ever LOWERS the
+    version it waits for (a conservative, hang-free resync after a
+    pserver restart)."""
+    from paddle_tpu.distributed import resilience
+
+    evicted = None
     with _channels_lock:
         ch = _channels.get(endpoint)
+        if ch is not None and getattr(ch.client, "broken", False):
+            evicted = ch
+            del _channels[endpoint]
+            ch = None
         if ch is None:
             ch = _channels[endpoint] = _Channel(endpoint)
-        return ch
+    if evicted is not None:
+        # close OUTSIDE the cache lock: close() contends on the client's
+        # own lock, which a thread parked in a server-side wait can hold
+        # for up to the barrier deadline — that must not freeze channel
+        # lookups for every other endpoint
+        _close_quietly(evicted)
+        resilience.record("channel_evictions")
+    return ch
+
+
+def evict_channel(endpoint) -> bool:
+    """Drop one endpoint's cached channel (the next get_channel re-dials).
+    Returns True if a channel was cached."""
+    from paddle_tpu.distributed import resilience
+
+    with _channels_lock:
+        ch = _channels.pop(endpoint, None)
+    if ch is None:
+        return False
+    _close_quietly(ch)
+    resilience.record("channel_evictions")
+    return True
+
+
+def _close_quietly(ch):
+    from paddle_tpu.distributed import resilience
+
+    try:
+        ch.client.close()
+    except Exception:
+        resilience.record("close_errors")  # already dead; nothing to free
 
 
 def reset_channels():
-    """Drop all cached trainer→pserver connections (tests, re-transpile)."""
+    """Drop all cached trainer→pserver connections (tests, re-transpile).
+    Idempotent and failure-proof: the cache is emptied FIRST, then each
+    close runs independently, so one wedged channel can neither keep the
+    others cached nor make a second call misbehave."""
     with _channels_lock:
-        for ch in _channels.values():
-            ch.client.close()
+        chans = list(_channels.values())
         _channels.clear()
+    for ch in chans:
+        _close_quietly(ch)
 
 
-def stop_pservers(endpoints):
+def stop_pservers(endpoints, connect_timeout=5.0):
     """Ask every pserver to exit its serve loop (test teardown / trainer 0
-    shutdown; reference sends no explicit stop — pservers are killed)."""
-    for ep in endpoints:
-        try:
-            get_channel(ep).client.stop_server()
-        except IOError:
-            pass
-    reset_channels()
+    shutdown; reference sends no explicit stop — pservers are killed).
+
+    Per-endpoint isolation: one dead/unreachable endpoint must not stop
+    the remaining pservers from being stopped, and the channel cache is
+    always cleared (try/finally) even if every endpoint fails."""
+    from paddle_tpu import native
+    from paddle_tpu.distributed import resilience
+
+    try:
+        for ep in endpoints:
+            with _channels_lock:
+                ch = _channels.get(ep)
+            try:
+                if ch is not None:
+                    ch.client.stop_server()
+                else:
+                    # no cached channel: dial with a SHORT timeout — an
+                    # already-dead endpoint must not stall teardown for
+                    # the full FLAGS_rpc_deadline
+                    host, port = ep.rsplit(":", 1)
+                    cli = native.PSClient(host=host, port=int(port),
+                                          timeout=connect_timeout,
+                                          retry_times=0)
+                    try:
+                        cli.stop_server()
+                    finally:
+                        cli.close()
+            except IOError:
+                resilience.record("stop_errors")  # dead already: continue
+    finally:
+        reset_channels()
 
 
 # ---------------------------------------------------------------------------
@@ -193,7 +266,10 @@ def _send_sparse_run(scope, op, place):
 def _send_barrier_run(scope, op, place):
     for ep in op.attrs["endpoints"]:
         ch = get_channel(ep)
-        ch.client.send_barrier()
+        # pass the channel's completed-round count: the server's barrier
+        # release predicate keys on it, which is what makes barrier
+        # retries after a pserver restart line up with the restored round
+        ch.client.send_barrier(round=ch.round)
         ch.round += 1
 
 
@@ -210,7 +286,10 @@ def _recv_run(scope, op, place):
 
 def _fetch_barrier_run(scope, op, place):
     for ep in op.attrs["endpoints"]:
-        get_channel(ep).client.fetch_barrier()
+        ch = get_channel(ep)
+        # ch.round was already bumped by send_barrier: the round being
+        # completed is ch.round - 1
+        ch.client.fetch_barrier(round=max(0, ch.round - 1))
 
 
 def _ps_init_sync_run(scope, op, place):
@@ -226,7 +305,11 @@ def _ps_init_sync_run(scope, op, place):
     pull_vars = op.attrs["pull_vars"]  # [(name, endpoint)]
     push_slices = op.attrs.get("push_slices", ())  # [(name, ep, start, end)]
     shadows = set(op.attrs.get("shadow_vars", ()))
-    if trainer_id == 0:
+    # a trainer relaunched by the supervisor (PADDLE_RESTART_COUNT set by
+    # _proc_group) must NOT re-push freshly-initialized params over the
+    # live server state — it only pulls and resumes
+    restarted = int(os.environ.get("PADDLE_RESTART_COUNT", "0") or 0) > 0
+    if trainer_id == 0 and not restarted:
         for name, ep in push_vars:
             get_channel(ep).client.send_param(name, np.asarray(scope.get(name)))
         for name, ep, start, end in push_slices:
@@ -386,11 +469,22 @@ def _serv_init(server, blocks, local):
     return True
 
 
-def _serv_sync_loop(server, blocks, local, exe):
+def _serv_sync_loop(server, blocks, local, exe, snap_path=None,
+                    snap_every=1):
     """RunSyncLoop: rendezvous rounds; dense grads averaged, SelectedRows
     grads merged by row, then the param's optimize program (or its sparse
-    fast path) runs and the fresh param is published."""
-    while server.wait_round():
+    fast path) runs and the fresh param is published.
+
+    With `snap_path` set (supervised mode, PT_PS_SNAPSHOT_DIR), the full
+    shard state — params AND optimizer accumulators, republished from the
+    local scope — snapshots every `snap_every` completed rounds, so a
+    relaunched pserver resumes exactly where the job was."""
+    from paddle_tpu.distributed import fault_injection
+
+    # the driver's round wait is unbounded by design: server.stop()
+    # (teardown) unblocks it, and trainer-side liveness is covered by the
+    # barrier deadline answering the trainers themselves
+    while server.wait_round():  # resilience: allow
         received = {}
         for name, payload in server.grads():
             received.setdefault(name, []).append(payload)
@@ -417,6 +511,16 @@ def _serv_sync_loop(server, blocks, local, exe):
         server.release_send()
         if not server.end_round():
             break
+        rounds = server.stats()["rounds"]  # absolute (snapshot-continuous)
+        if snap_path and rounds % max(1, snap_every) == 0:
+            for blk in blocks:
+                for name in blk[3]:  # state: param + accumulators + lr
+                    v = local.get(name)
+                    if v is not None:
+                        server.publish(name, np.asarray(v))
+            server.save(snap_path)
+        # deterministic pserver-kill hook (kill:round:<k> in PT_FAULT_PLAN)
+        fault_injection.on_round(rounds)
 
 
 def _serv_async_loop(server, blocks, local, exe):
@@ -477,20 +581,53 @@ def _listen_and_serv_run(scope, op, place):
     # [(param, grad, opt_program, state_names)]
     blocks = op.attrs["param_blocks"]
 
+    # supervised mode (launch_ps --max_restarts / PT_PS_SNAPSHOT_DIR):
+    # this shard auto-snapshots each round and, when relaunched after a
+    # crash, resumes table+version+round from its latest snapshot instead
+    # of waiting for an init push that will never come again
+    snap_dir = os.environ.get("PT_PS_SNAPSHOT_DIR", "")
+    snap_path = None
+    if snap_dir:
+        os.makedirs(snap_dir, exist_ok=True)
+        snap_path = os.path.join(snap_dir, f"shard_{port}.ckpt")
+    snap_every = int(os.environ.get("PT_PS_SNAPSHOT_EVERY", "1") or 1)
+
     server = native.PSServer(port=port, n_trainers=n_trainers)
+    restart_count = int(os.environ.get("PADDLE_RESTART_COUNT", "0") or 0)
+    # restore ONLY on a supervised relaunch: a fresh job (restart 0) that
+    # reuses the default snapshot dir must initialize fresh, not silently
+    # resume the previous job's final weights and round counter
+    restored = bool(restart_count > 0 and snap_path
+                    and os.path.exists(snap_path)
+                    and server.load(snap_path))
+    if restart_count > 0 and not restored:
+        # the init push happens once per job: a relaunched shard with no
+        # usable snapshot (crashed before its first completed round, or
+        # a torn/absent snapshot file) would park in _serv_init forever.
+        # Fail fast so the supervisor's budget exhausts cleanly instead.
+        server.stop()
+        raise RuntimeError(
+            f"pserver {ep}: relaunched (restart {restart_count}) but no "
+            f"usable snapshot at {snap_path!r}; this shard cannot resume "
+            f"— failing fast rather than waiting for an init push that "
+            f"happens once per job")
     local = Scope()
     exe = Executor(place)
     try:
         with scope_guard(local):
+            # on a restored shard the snapshot already holds every state
+            # table, so _serv_init returns immediately with scope loaded
             if not _serv_init(server, blocks, local):
                 return
-            # params must be visible (table) before trainers' first recv /
-            # lookup — publish initial values
-            for blk in blocks:
-                server.publish(blk[0], np.asarray(local.get(blk[0])))
-            server.bump_version()
+            if not restored:
+                # params must be visible (table) before trainers' first
+                # recv / lookup — publish initial values
+                for blk in blocks:
+                    server.publish(blk[0], np.asarray(local.get(blk[0])))
+                server.bump_version()
             if sync_mode:
-                _serv_sync_loop(server, blocks, local, exe)
+                _serv_sync_loop(server, blocks, local, exe,
+                                snap_path=snap_path, snap_every=snap_every)
             else:
                 _serv_async_loop(server, blocks, local, exe)
     finally:

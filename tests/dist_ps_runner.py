@@ -6,6 +6,20 @@ test_dist_base.py pattern: real processes on 127.0.0.1 endpoints).
 
 The model is fit_a_line (fc regression) on deterministic synthetic data;
 trainer t feeds rows [t*8:(t+1)*8) of each 16-row global batch.
+
+Fault-tolerance hooks (tests/test_fault_tolerance.py):
+  PT_FAULT_PLAN        fault plan for THIS process (kill:step:K fires in
+                       the trainer loop; kill:round:K in the pserver sync
+                       loop; the supervisor strips it on relaunch)
+  PT_PS_SNAPSHOT_DIR   pserver shards auto-snapshot/resume through here
+                       (consumed by the listen_and_serv host op)
+  DIST_PS_CKPT_DIR     trainer-side AutoCheckpoint dir: every step is
+                       snapshotted and a relaunched trainer resumes from
+                       its last completed step (deterministic data makes
+                       the replayed round bit-identical)
+
+The trainer also dumps its process resilience counters into out.json so
+tests can assert recovery actually exercised the retry path.
 """
 
 import json
@@ -117,6 +131,8 @@ def run_pserver(ep, endpoints, n_trainers, opt_name):
 
 
 def run_trainer(tid, endpoints, n_trainers, opt_name, out_path):
+    from paddle_tpu.distributed import fault_injection, resilience
+
     main, startup, loss = build(opt_name)
     t = _make_transpiler()
     t.transpile(trainer_id=tid, program=main, pservers=endpoints,
@@ -125,14 +141,36 @@ def run_trainer(tid, endpoints, n_trainers, opt_name, out_path):
     trainer_prog = t.get_trainer_program()
     per = GLOBAL_BATCH // n_trainers
     losses = []
-    with scope_guard(Scope()):
+    scope = Scope()
+    with scope_guard(scope):
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)
-        for b in global_batches():
+        ck, start_step = None, 0
+        if os.environ.get("DIST_PS_CKPT_DIR"):
+            from paddle_tpu.fluid.incubate.checkpoint import AutoCheckpoint
+
+            # per-step local snapshots: a relaunched trainer resumes at
+            # its last completed step and replays the identical batch
+            ck = AutoCheckpoint(os.environ["DIST_PS_CKPT_DIR"] + f".t{tid}",
+                                exe, trainer_prog, scope=scope,
+                                save_interval=1,
+                                install_signal_handler=False)
+            start_step = ck.resume()
+        for i, b in enumerate(global_batches()):
+            step = i + 1
+            if start_step and step < start_step:
+                continue  # already done before the restart
+            fault_injection.on_step(step)
             sub = {k: v[tid * per:(tid + 1) * per] for k, v in b.items()}
             (lv,) = exe.run(trainer_prog, feed=sub, fetch_list=[loss.name])
             losses.append(float(np.asarray(lv)))
-    json.dump({"losses": losses}, open(out_path, "w"))
+            if ck is not None:
+                ck.step(step)
+    json.dump({"losses": losses, "start_step": start_step,
+               "restart_count": int(os.environ.get("PADDLE_RESTART_COUNT",
+                                                   "0") or 0),
+               "resilience": resilience.resilience_stats()},
+              open(out_path, "w"))
     # pservers are stopped by the parent test once every trainer exited
     # (a trainer must not stop them while peers are mid-round)
 

@@ -120,6 +120,72 @@ with fluid.scope_guard(scope):
     assert dirs, "preemption handler left no checkpoint"
 
 
+def test_crash_mid_save_leftover_tmp_ignored_on_resume(tmp_path):
+    """Regression: a hard kill BETWEEN writing checkpoint_meta.json and
+    the atomic rename leaves a full-looking `.ckpt_tmp_*` dir.  resume()
+    must ignore it (and incomplete `ckpt_*` dirs missing the meta), pick
+    the newest COMPLETE checkpoint, and the next save must sweep the
+    orphan."""
+    import json
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        _build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ck = AutoCheckpoint(tmp_path / "ck", exe, main, scope=scope,
+                            install_signal_handler=False)
+        ck._last_step = 0
+        ck.save(7)
+        # crash-mid-save artifact: tmp dir with a COMPLETE meta inside
+        orphan = tmp_path / "ck" / ".ckpt_tmp_crashed"
+        os.makedirs(orphan)
+        json.dump({"step": 99, "complete": True},
+                  open(orphan / "checkpoint_meta.json", "w"))
+        # and a torn ckpt dir with no meta at all
+        os.makedirs(tmp_path / "ck" / "ckpt_000000000098")
+        assert ck.resume() == 8  # orphan/torn dirs never win
+        ck.save(9)
+    assert not orphan.exists()  # swept by the save's gc
+
+
+def test_signal_handler_chains_and_uninstalls(tmp_path):
+    """The preemption hook must CHAIN to the previously-installed handler
+    (not assume the default action) and uninstall() must restore it."""
+    seen = []
+
+    def prior(signum, frame):
+        seen.append(signum)
+
+    old = signal.signal(signal.SIGTERM, prior)
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            _build()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ck = AutoCheckpoint(tmp_path / "ck", exe, main, scope=scope,
+                                save_interval=10**9)
+            ck._last_step = 3
+            os.kill(os.getpid(), signal.SIGTERM)
+            # chained into `prior` (so we are still alive) AFTER snapshot
+            assert seen == [signal.SIGTERM]
+            assert any(d.startswith("ckpt_")
+                       for d in os.listdir(tmp_path / "ck"))
+            # our hook stays installed: a second signal snapshots+chains too
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert seen == [signal.SIGTERM, signal.SIGTERM]
+            ck.uninstall()
+            assert signal.getsignal(signal.SIGTERM) is prior
+            ck.uninstall()  # idempotent
+    finally:
+        signal.signal(signal.SIGTERM, old)
+
+
 def test_orphan_tmp_dirs_swept(tmp_path):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():

@@ -51,6 +51,41 @@ def test_env_bootstrap(monkeypatch):
     importlib.reload(fl)  # restore defaults for other tests
 
 
+def test_resilience_flags_roundtrip(monkeypatch):
+    """The fault-tolerance flags register with reference-consistent
+    defaults (grpc FLAGS_rpc_retry_times=3) and round-trip through env
+    bootstrap and get/set like every other flag."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("rpc_retry_times")["rpc_retry_times"] == 3
+    assert fl.get_flags("rpc_retry_backoff_ms")["rpc_retry_backoff_ms"] == 100
+    assert fl.get_flags("ps_barrier_timeout_ms")[
+        "ps_barrier_timeout_ms"] == 300000
+    try:
+        fl.set_flags({"FLAGS_rpc_retry_times": 7,
+                      "FLAGS_rpc_retry_backoff_ms": "250",  # str parses
+                      "ps_barrier_timeout_ms": 1000})
+        assert fl.get_flags(["rpc_retry_times", "rpc_retry_backoff_ms",
+                             "ps_barrier_timeout_ms"]) == {
+            "rpc_retry_times": 7, "rpc_retry_backoff_ms": 250,
+            "ps_barrier_timeout_ms": 1000}
+    finally:
+        fl.set_flags({"FLAGS_rpc_retry_times": 3,
+                      "FLAGS_rpc_retry_backoff_ms": 100,
+                      "FLAGS_ps_barrier_timeout_ms": 300000})
+    monkeypatch.setenv("FLAGS_rpc_retry_times", "9")
+    monkeypatch.setenv("FLAGS_ps_barrier_timeout_ms", "60000")
+    importlib.reload(fl)
+    assert fl.get_flags("rpc_retry_times")["rpc_retry_times"] == 9
+    assert fl.get_flags("ps_barrier_timeout_ms")[
+        "ps_barrier_timeout_ms"] == 60000
+    monkeypatch.delenv("FLAGS_rpc_retry_times")
+    monkeypatch.delenv("FLAGS_ps_barrier_timeout_ms")
+    importlib.reload(fl)  # restore defaults for other tests
+
+
 def test_malformed_env_flag_warns_not_crashes(monkeypatch):
     import importlib
     import warnings as w

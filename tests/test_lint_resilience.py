@@ -1,0 +1,60 @@
+"""tools/lint_resilience.py — the fault-tolerance CI tripwire: no
+swallowed failures, no unbounded waits, under paddle_tpu/distributed/ and
+paddle_tpu/ops/dist_ops.py.  Runs the real lint in tier-1 (`make
+lint-resilience` is the Makefile entry point)."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint_resilience  # noqa: E402
+
+
+def test_repo_distributed_layer_is_clean(capsys):
+    assert lint_resilience.main([]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_flags_except_pass():
+    bad = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except IOError:\n"
+        "        pass\n")
+    findings = lint_resilience.check_source(bad, "bad.py")
+    assert len(findings) == 1
+    assert findings[0][2] == "except-pass" and findings[0][1] == 4
+
+
+def test_flags_unbounded_wait_and_allows_bounded():
+    src = (
+        "q.get()\n"                      # unbounded → flagged
+        "q.get(timeout=1)\n"             # bounded
+        "t.join(5)\n"                    # bounded (positional)
+        "srv.wait_round()\n"             # unbounded → flagged
+        "d.get('k')\n")                  # has an arg → not flagged
+    findings = lint_resilience.check_source(src, "w.py")
+    assert [(f[1], f[2]) for f in findings] == [
+        (1, "unbounded-wait"), (4, "unbounded-wait")]
+
+
+def test_allow_marker_suppresses():
+    src = (
+        "srv.wait_round()  # resilience: allow\n"
+        "# resilience: allow — stop() unblocks this by design\n"
+        "srv.wait_table()\n"
+        "try:\n"
+        "    g()\n"
+        "except IOError:\n"
+        "    pass  # resilience: allow\n")
+    assert lint_resilience.check_source(src, "ok.py") == []
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def f(:\n")
+    findings = lint_resilience.check_file(f)
+    assert findings and findings[0][2] == "parse-error"

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Static resilience lint for the distributed layer.
+
+The fault-tolerance PR's CI tripwire: code on the failure path must
+neither swallow errors nor park forever behind a dead peer.  Two checks
+over `paddle_tpu/distributed/` and `paddle_tpu/ops/dist_ops.py`:
+
+  except-pass      an `except` whose body is ONLY `pass` — a silently
+                   swallowed failure.  Count it (resilience.record), log
+                   it, or re-raise.
+  unbounded-wait   a zero-argument call to a wait-style method
+                   (wait/join/recv/get/acquire/wait_round/wait_table/
+                   wait_for): no timeout means a dead peer wedges the
+                   caller forever.  Pass a timeout, or mark a wait that
+                   is deliberately unbounded (e.g. a serve loop that a
+                   stop() unblocks by design).
+
+Suppress a deliberate finding with `# resilience: allow` on the same
+line.  Exit 0 when clean, 1 with findings (one per line:
+`path:lineno: [check] message`).
+
+Usage: python tools/lint_resilience.py [paths...]
+  (no args = the default target set, repo-relative)
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+DEFAULT_TARGETS = [
+    "paddle_tpu/distributed",
+    "paddle_tpu/ops/dist_ops.py",
+]
+
+WAIT_NAMES = {"wait", "join", "recv", "get", "acquire", "wait_round",
+              "wait_table", "wait_for"}
+
+ALLOW_MARK = "resilience: allow"
+
+
+def _allowed(src_lines, lineno):
+    """Marker accepted on the flagged line or the line directly above."""
+    for ln in (lineno - 1, lineno - 2):
+        if 0 <= ln < len(src_lines) and ALLOW_MARK in src_lines[ln]:
+            return True
+    return False
+
+
+def check_source(src: str, path: str = "<string>"):
+    """Lint one file's source; returns [(path, lineno, check, message)]."""
+    findings = []
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, "parse-error", str(e))]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler):
+            if len(node.body) == 1 and isinstance(node.body[0], ast.Pass) \
+                    and not _allowed(lines, node.body[0].lineno) \
+                    and not _allowed(lines, node.lineno):
+                what = (ast.unparse(node.type) if node.type is not None
+                        else "bare")
+                findings.append(
+                    (path, node.lineno, "except-pass",
+                     f"`except {what}: pass` swallows the failure — "
+                     f"record it (resilience.record), log it, or "
+                     f"re-raise"))
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in WAIT_NAMES and \
+                    not node.args and not node.keywords and \
+                    not _allowed(lines, node.lineno):
+                findings.append(
+                    (path, node.lineno, "unbounded-wait",
+                     f".{func.attr}() with no timeout can block forever "
+                     f"behind a dead peer — pass a timeout or mark the "
+                     f"line `# {ALLOW_MARK}`"))
+    return findings
+
+
+def check_file(path: Path):
+    return check_source(path.read_text(), str(path))
+
+
+def iter_files(targets):
+    for t in targets:
+        p = Path(t)
+        if not p.is_absolute():
+            p = REPO / p
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    targets = argv or DEFAULT_TARGETS
+    findings = []
+    n_files = 0
+    for f in iter_files(targets):
+        n_files += 1
+        findings.extend(check_file(f))
+    for path, lineno, check, msg in findings:
+        print(f"{path}:{lineno}: [{check}] {msg}")
+    if findings:
+        print(f"\nlint_resilience: {len(findings)} finding(s) in "
+              f"{n_files} file(s)")
+        return 1
+    print(f"lint_resilience: OK ({n_files} files clean)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

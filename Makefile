@@ -3,7 +3,7 @@ PY ?= python
 REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 
 .PHONY: test test-book test-onchip bench bench-onchip int8-bench lint-api \
-	lint-resilience
+	lint-resilience lint-observability
 
 test:            ## full suite on the 8-device virtual CPU mesh (~8 min)
 	$(PY) -m pytest tests/ -q --ignore=tests/book
@@ -29,3 +29,6 @@ lint-api:        ## fail if the public API surface drifted from API.spec
 
 lint-resilience: ## no swallowed errors / unbounded waits in the distributed layer
 	$(PY) tools/lint_resilience.py
+
+lint-observability: ## no bare print() diagnostics in library code
+	$(PY) tools/lint_observability.py

@@ -821,9 +821,47 @@ def touch_driver_lock():
             pass
 
 
+def _metrics_summary():
+    """Observability-registry digest embedded in every BENCH_*.json record
+    (docs/OBSERVABILITY.md): the perf trajectory carries compile-cache
+    behavior, compile seconds and collective payload bytes alongside the
+    headline timing instead of timings alone."""
+    try:
+        from paddle_tpu import observability as obs
+
+        snap = obs.snapshot()
+
+        def sum_family(name):
+            fam = snap.get(name)
+            if not fam:
+                return None
+            out = {}
+            for key, v in fam["samples"].items():
+                label = ",".join(key) if key else "total"
+                out[label] = round(
+                    v["sum"] if isinstance(v, dict) else v, 6)
+            return out
+
+        summary = {}
+        for rec_key, fam in (("compile_cache", "pt_compile_cache_total"),
+                             ("compile_seconds", "pt_compile_seconds_total"),
+                             ("collective_bytes",
+                              "pt_collective_payload_bytes_total"),
+                             ("step_seconds_sum", "pt_step_seconds")):
+            vals = sum_family(fam)
+            if vals:
+                summary[rec_key] = vals
+        return summary
+    except Exception as e:  # telemetry must never fail the bench
+        print(f"bench: metrics summary unavailable ({e})", file=sys.stderr)
+        return {}
+
+
 def main():
     if os.environ.get("PT_BENCH_CHILD"):
-        print(json.dumps(measure(os.environ["PT_BENCH_CHILD"])), flush=True)
+        rec = measure(os.environ["PT_BENCH_CHILD"])
+        rec.setdefault("metrics", _metrics_summary())
+        print(json.dumps(rec), flush=True)
         return
 
     acquired = _acquire_driver_lock()

@@ -10,6 +10,8 @@ Layer map (mirrors SURVEY.md §1, TPU-first):
               collectives over ICI/DCN replace NCCL rings)
   models/     flagship model zoo (MLP, ResNet, BERT/Transformer)
   kernels/    Pallas TPU kernels for ops XLA fuses poorly
+  observability/  unified telemetry: metrics registry, /metricsz
+              exposition, JSONL events, cross-process tracing
 """
 
 __version__ = "0.1.0"
@@ -21,6 +23,7 @@ from . import dataset  # noqa: F401
 from . import inference  # noqa: F401
 from . import compat  # noqa: F401
 from . import distributed  # noqa: F401
+from . import observability  # noqa: F401
 from . import proto  # noqa: F401
 from . import utils  # noqa: F401
 from .reader import batch  # noqa: F401

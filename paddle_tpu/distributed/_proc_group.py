@@ -134,6 +134,7 @@ class ProcGroup:
         if child.restart_at is None:
             delay = self.restart_backoff * (2 ** child.restarts)
             child.restart_at = now + delay
+            # observability: allow — supervisor stderr banner
             print(f"ProcGroup: child {child.log_name} exited rc={rc}; "
                   f"relaunching in {delay:.1f}s "
                   f"(restart {child.restarts + 1}/{child.max_restarts})",
@@ -147,6 +148,7 @@ class ProcGroup:
             from paddle_tpu.distributed import resilience
             resilience.record("supervisor_restarts")
         except Exception:
+            # observability: allow — stderr diagnostic on fallback
             print("ProcGroup: resilience counters unavailable",
                   file=sys.stderr)
         return True

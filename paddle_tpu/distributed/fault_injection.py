@@ -151,6 +151,7 @@ class FaultPlan:
     def _maybe_kill(self, kind, k):
         for r in self.rules:
             if r.action == "kill" and r.cmd == kind and r.n == int(k):
+                # observability: allow — last words before SIGKILL
                 print(f"fault-injection: SIGKILL pid {os.getpid()} at "
                       f"{kind} {k}", file=sys.stderr, flush=True)
                 os.kill(os.getpid(), signal.SIGKILL)

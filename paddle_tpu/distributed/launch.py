@@ -80,12 +80,18 @@ def start_procs(args):
                  for ip in node_ips for i in range(nproc)]
     nranks = len(endpoints)
     if args.print_config:
+        # observability: allow — opt-in launcher banner (--print_config)
         print(f"launch: nodes={node_ips} nproc_per_node={nproc} "
               f"nranks={nranks} endpoints={','.join(endpoints)}")
+
+    from paddle_tpu.observability import tracing as _tracing
 
     base_env = dict(os.environ)
     base_env.pop("http_proxy", None)
     base_env.pop("https_proxy", None)
+    # one job-wide trace id for every rank (tools/merge_traces.py keys
+    # cross-process timelines on it)
+    base_env["PT_TRACE_ID"] = _tracing.job_trace_id()
 
     with ProcGroup(args.log_dir) as group:
         for i in range(nproc):

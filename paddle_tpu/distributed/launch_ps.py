@@ -74,13 +74,19 @@ def start_procs(args):
     # zip them back into the endpoint list (reference behavior)
     ports = ",".join(e.split(":")[1] for e in endpoints)
 
+    from paddle_tpu.observability import tracing as _tracing
+
     base_env = dict(os.environ)
     base_env.pop("http_proxy", None)
     base_env.pop("https_proxy", None)
+    # one trace id for the whole job: every role (and every supervised
+    # relaunch — ProcGroup preserves the env) tags its chrome trace /
+    # JSONL events with it, so tools/merge_traces.py can attribute ranks
     common = dict(PADDLE_PSERVERS=pserver_ips,
                   PADDLE_PORT=ports,
                   PADDLE_PSERVER_ENDPOINTS=",".join(endpoints),
-                  PADDLE_TRAINERS_NUM=str(args.worker_num))
+                  PADDLE_TRAINERS_NUM=str(args.worker_num),
+                  PT_TRACE_ID=_tracing.job_trace_id())
     snapshot_dir = args.snapshot_dir or (
         os.path.join(args.log_dir, "snapshots")
         if args.max_restarts > 0 and args.log_dir else "")
@@ -89,6 +95,7 @@ def start_procs(args):
         # listen_and_serv host op reads it)
         common["PT_PS_SNAPSHOT_DIR"] = snapshot_dir
     if args.print_config:
+        # observability: allow — opt-in launcher banner (--print_config)
         print(f"launch_ps: servers={endpoints} workers={args.worker_num}"
               + (f" max_restarts={args.max_restarts} "
                  f"snapshots={snapshot_dir}" if args.max_restarts else ""))
@@ -106,10 +113,15 @@ def start_procs(args):
         for i, ep in enumerate(endpoints):
             spawn({"TRAINING_ROLE": "PSERVER", "POD_IP": ep.split(":")[0],
                    "PADDLE_PORT": ep.split(":")[1],
-                   "PADDLE_CURRENT_ENDPOINT": ep},
+                   "PADDLE_CURRENT_ENDPOINT": ep,
+                   "PT_TRACE_ROLE": "pserver",
+                   # pservers have no PADDLE_TRAINER_ID: export the shard
+                   # index so telemetry can tell shards apart
+                   "PT_TRACE_RANK": str(i)},
                   f"serverlog.{i}")
         trainers = [spawn({"TRAINING_ROLE": "TRAINER",
-                           "PADDLE_TRAINER_ID": str(i)},
+                           "PADDLE_TRAINER_ID": str(i),
+                           "PT_TRACE_ROLE": "trainer"},
                           f"workerlog.{i}")
                     for i in range(args.worker_num)]
         # pservers are daemons: wait() stops them when trainers finish

@@ -10,6 +10,12 @@ counter surfaced through `resilience_stats()` so tests and
 `fluid.metrics`-style tooling can assert on recovery behavior instead of
 guessing from logs.
 
+Since the unified-telemetry PR the counters live in the shared
+`paddle_tpu.observability` registry (one Counter family,
+``pt_resilience_events_total{event=...}``) so they appear on /metricsz
+next to every other metric; `resilience_stats()` stays the exact
+back-compat dict view the fault-tolerance tests assert on.
+
 Kept dependency-light (stdlib only; flags imported lazily) so the
 supervisor (`distributed._proc_group`) and test harnesses can import it
 without pulling in jax.
@@ -19,7 +25,6 @@ from __future__ import annotations
 
 import os
 import random
-import threading
 
 __all__ = ["RetryPolicy", "resilience_stats", "reset_resilience_stats",
            "record"]
@@ -39,26 +44,36 @@ _KNOWN = (
     "close_errors",           # channels that failed to close in reset
 )
 
-_lock = threading.Lock()
-_counters = {k: 0 for k in _KNOWN}
+
+def _family():
+    """The shared registry family (lazy: observability registers
+    idempotently, and a reset() mid-run only re-creates it)."""
+    from paddle_tpu import observability
+
+    return observability.counter(
+        "pt_resilience_events_total",
+        "Fault-tolerance events (retries, reconnects, evictions, "
+        "injected faults, supervisor restarts)", labels=("event",))
 
 
 def record(event, n=1):
-    """Bump a resilience counter (unknown names create a new key)."""
-    with _lock:
-        _counters[event] = _counters.get(event, 0) + int(n)
+    """Bump a resilience counter (unknown names create a new series)."""
+    _family().labels(event=str(event)).inc(int(n))
 
 
 def resilience_stats():
-    """Snapshot of all resilience counters as a plain dict."""
-    with _lock:
-        return dict(_counters)
+    """Snapshot of all resilience counters as a plain dict — the exact
+    pre-registry shape: every known key present (0 before any event),
+    int values, plus any custom events recorded."""
+    out = {k: 0 for k in _KNOWN}
+    snap = _family()._snapshot()
+    for (event,), value in snap["samples"].items():
+        out[event] = int(value)
+    return out
 
 
 def reset_resilience_stats():
-    with _lock:
-        _counters.clear()
-        _counters.update({k: 0 for k in _KNOWN})
+    _family().clear()
 
 
 class RetryPolicy:

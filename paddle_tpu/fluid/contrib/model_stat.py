@@ -112,5 +112,5 @@ def summary(main_prog):
     lines.append(f"Total PARAMs: {total_p}({total_p / 1e9:.4f}G)")
     lines.append(f"Total FLOPs: {total_f}({total_f / 1e9:.2f}G)")
     text = "\n".join(lines)
-    print(text)
+    print(text)  # observability: allow — the API's purpose is printing
     return total_p, total_f

@@ -35,6 +35,89 @@ __all__ = ["Executor", "Scope", "global_scope", "scope_guard", "as_numpy"]
 
 
 # ---------------------------------------------------------------------------
+# telemetry (docs/OBSERVABILITY.md): the executor owns the compile-side
+# metrics — cache hit/miss, compile seconds, per-signature cost-model
+# numbers — shared by every execution path (single-device, shard_map DP,
+# GSPMD hybrid, on-device chain) through these accessors
+# ---------------------------------------------------------------------------
+
+
+def _m_cache():
+    from paddle_tpu import observability as obs
+
+    return obs.counter(
+        "pt_compile_cache_total",
+        "Executable-cache lookups by execution path and result",
+        labels=("path", "result"))
+
+
+def _m_compile_seconds():
+    from paddle_tpu import observability as obs
+
+    return obs.counter(
+        "pt_compile_seconds_total",
+        "Seconds spent building executables: phase=trace is the Python "
+        "Program->jaxpr trace, phase=jit_first_run the signature's first "
+        "execution (which includes the lazy XLA compile)",
+        labels=("path", "phase"))
+
+
+def _m_step_seconds():
+    from paddle_tpu import observability as obs
+
+    return obs.histogram(
+        "pt_step_seconds",
+        "Wall time of one executed step (first sample per signature "
+        "includes the lazy XLA compile)", labels=("path",))
+
+
+def _m_cost(kind):
+    from paddle_tpu import observability as obs
+
+    return obs.gauge(
+        f"pt_xla_{kind}",
+        f"XLA cost-model {kind.replace('_', ' ')} of the last analyzed "
+        f"executable, per signature", labels=("signature",))
+
+
+def _record_step(path, seconds, first_run):
+    """Book one step into the shared step/compile metrics and the JSONL
+    event log (when enabled)."""
+    _m_step_seconds().labels(path=path).observe(seconds)
+    if first_run:
+        _m_compile_seconds().labels(
+            path=path, phase="jit_first_run").inc(seconds)
+    from paddle_tpu.observability import events as _events
+
+    if _events.enabled():
+        _events.emit("step", path=path, seconds=round(seconds, 6),
+                     first_run=bool(first_run))
+
+
+def _feed_batch(feed):
+    """Global batch size of a feed dict: the largest leading dim (shared
+    by both parallel runners so the examples metric can't diverge)."""
+    return max((int(np.shape(v)[0]) for v in feed.values()
+                if np.shape(v)), default=0)
+
+
+def _report_examples(path, batch, seconds):
+    """Examples-ingested counter + last-step throughput gauge, shared by
+    the parallel runners (one registration site — name/help can't drift)."""
+    if not batch:
+        return
+    from paddle_tpu import observability as obs
+
+    obs.counter("pt_examples_total",
+                "Examples consumed by executed steps",
+                labels=("path",)).labels(path=path).inc(batch)
+    if seconds > 0:
+        obs.gauge("pt_examples_per_sec",
+                  "Throughput of the most recent step",
+                  labels=("path",)).labels(path=path).set(batch / seconds)
+
+
+# ---------------------------------------------------------------------------
 # Scope
 # ---------------------------------------------------------------------------
 
@@ -681,6 +764,16 @@ class _JitExecutable:
                     mem[k] = int(v)
         except Exception:  # backend without memory analysis
             pass
+        # publish the cost-model headline numbers as per-signature gauges
+        # (docs/OBSERVABILITY.md) — the standing form of the bench rung's
+        # one-off bytes_accessed capture
+        sig = getattr(self, "label", f"exe@{id(self):x}")
+        for kind, key in (("flops", "flops"),
+                          ("bytes_accessed", "bytes accessed"),
+                          ("transcendentals", "transcendentals")):
+            v = cost.get(key) if hasattr(cost, "get") else None
+            if v is not None:
+                _m_cost(kind).labels(signature=sig).set(float(v))
         return {"cost": dict(cost), "memory": mem}
 
     def _check_nan_inf(self, out_writes, fetches):
@@ -877,6 +970,12 @@ class Executor:
         self.place = place if place is not None else framework._current_expected_place()
         self._cache: dict = {}
         self._step = 0
+        # opt-in /metricsz endpoint (FLAGS_metrics_port): every process
+        # that runs programs — trainer, pserver, bench child — exposes
+        # itself; a no-op when the flag is 0 or a server already runs
+        from paddle_tpu.observability import exposition as _expo
+
+        _expo.ensure_from_flags()
 
     def compiled_for(self, program):
         """The compiled-block handles cached for `program` (one per feed
@@ -975,23 +1074,33 @@ class Executor:
         fetch_list = list(fetch_list or [])
         fetch_names = [f.name if isinstance(f, Variable) else f for f in fetch_list]
 
+        import time as _time
+
         block = program.global_block()
         key = self._cache_key(program, feed, fetch_names)
         cb = self._cache.get(key)
         if cb is None:
-            import time as _time
-
             from . import profiler as _prof
 
+            _m_cache().labels(path="single", result="miss").inc()
             t0 = _time.perf_counter()
             cb = _CompiledBlock(program, block, feed.keys(), fetch_names, self.place, scope)
             self._cache[key] = cb
             self._cache[(key, "pin")] = program  # hold program ref: id() stays unique
-            _prof._record("trace", cb.label, _time.perf_counter() - t0)
+            trace_s = _time.perf_counter() - t0
+            _prof._record("trace", cb.label, trace_s)
+            _m_compile_seconds().labels(path="single",
+                                        phase="trace").inc(trace_s)
+        else:
+            _m_cache().labels(path="single", result="hit").inc()
         # run timing ("compile+run" on a signature's first run — jit compiles
         # lazily — then "run") is recorded inside _CompiledBlock.run so every
         # execution path shares the instrumentation
+        first_run = not getattr(cb, "_obs_ran", False)
+        t0 = _time.perf_counter()
         fetches = cb.run(scope, feed, self._step)
+        _record_step("single", _time.perf_counter() - t0, first_run)
+        cb._obs_ran = True
         self._step += 1
         if return_numpy:
             return [np.asarray(f) for f in fetches]
@@ -1049,20 +1158,30 @@ class Executor:
         # executables too
         key = self._cache_key(program, feed, fetch_names) + (
             "chain", int(n_steps), bool(stacked_feed))
+        import time as _time
+
         cc = self._cache.get(key)
         if cc is None:
-            import time as _time
-
             from . import profiler as _prof
 
+            _m_cache().labels(path="chain", result="miss").inc()
             t0 = _time.perf_counter()
             cc = _CompiledChain(program, program.global_block(),
                                 feed.keys(), fetch_names, self.place,
                                 scope, int(n_steps), bool(stacked_feed))
             self._cache[key] = cc
             self._cache[(key, "pin")] = program
-            _prof._record("trace", cc.label, _time.perf_counter() - t0)
+            trace_s = _time.perf_counter() - t0
+            _prof._record("trace", cc.label, trace_s)
+            _m_compile_seconds().labels(path="chain",
+                                        phase="trace").inc(trace_s)
+        else:
+            _m_cache().labels(path="chain", result="hit").inc()
+        first_run = not getattr(cc, "_obs_ran", False)
+        t0 = _time.perf_counter()
         fetches = cc.run(scope, feed, self._step)
+        _record_step("chain", _time.perf_counter() - t0, first_run)
+        cc._obs_ran = True
         self._step += int(n_steps)
         if return_numpy:
             return [np.asarray(f) for f in fetches]

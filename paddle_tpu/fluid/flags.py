@@ -77,6 +77,16 @@ _DEFS = {
     # buckets up to this size so scale overhead and collective-launch
     # count amortize without one giant liveness-hungry buffer
     "FLAGS_fuse_grad_size_in_MB": (32, int, True),
+    # observability (docs/OBSERVABILITY.md): nonzero port serves
+    # /metricsz + /statusz + /healthz from this process (started lazily
+    # by the executor via observability.exposition.ensure_from_flags);
+    # 0 = off.  Every process needs its OWN port — the launchers pass a
+    # distinct FLAGS_metrics_port per child.
+    "FLAGS_metrics_port": (0, int, True),
+    # directory for the structured JSONL event log (step/round lifecycle
+    # events, observability.events); empty = disabled.  The env override
+    # PT_EVENT_LOG_DIR wins (launcher contract for children).
+    "FLAGS_event_log_dir": ("", str, True),
     # accepted no-ops (CUDA/allocator knobs with no TPU meaning)
     "FLAGS_fraction_of_gpu_memory_to_use": (0.92, float, False),
     "FLAGS_eager_delete_tensor_gb": (-1.0, float, False),

@@ -30,10 +30,12 @@ def run_check():
                   feed={"install_check_x": np.ones((2, 2), dtype="float32")},
                   fetch_list=[loss.name])
     assert np.isfinite(np.asarray(out[0])).all()
+    # observability: allow — user-facing check output
     print("Your paddle_tpu works well on SINGLE device (%s)." %
           jax.default_backend())
     if jax.device_count() > 1:
         from paddle_tpu.parallel import data_parallel  # noqa: F401 (import check)
+        # observability: allow — user-facing check output
         print("Your paddle_tpu works well on MULTI devices (%d)." %
               jax.device_count())
-    print("install check success!")
+    print("install check success!")  # observability: allow

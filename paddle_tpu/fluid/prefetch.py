@@ -22,6 +22,35 @@ __all__ = ["DatasetPrefetcher"]
 _SENTINEL = object()
 
 
+# shared-registry telemetry (docs/OBSERVABILITY.md), registered lazily per
+# call like every other instrumented site so a registry reset() mid-run
+# only zeroes the series, never orphans them
+
+
+def _m_depth():
+    from paddle_tpu import observability as _obs
+
+    return _obs.gauge(
+        "pt_prefetch_queue_depth",
+        "Prefetch queue occupancy observed at the last consumer pop")
+
+
+def _m_batches():
+    from paddle_tpu import observability as _obs
+
+    return _obs.counter(
+        "pt_prefetch_batches_total",
+        "Batches delivered by the dataset prefetcher")
+
+
+def _m_wait():
+    from paddle_tpu import observability as _obs
+
+    return _obs.counter(
+        "pt_prefetch_wait_seconds_total",
+        "Consumer seconds blocked on an empty prefetch queue")
+
+
 class DatasetPrefetcher:
     """Iterate `batch_iter` on a daemon thread, `transform` each batch
     (coerce + device_put) off the consumer's critical path, and buffer up
@@ -78,9 +107,12 @@ class DatasetPrefetcher:
     def __next__(self):
         if self._exhausted:  # exhausted iterators keep raising StopIteration
             raise StopIteration
+        _m_depth().set(self._q.qsize())
         t0 = time.perf_counter()
         item = self._q.get()
-        self.wait_seconds += time.perf_counter() - t0
+        waited = time.perf_counter() - t0
+        self.wait_seconds += waited
+        _m_wait().inc(waited)
         if item is _SENTINEL:
             self._exhausted = True
             self._thread.join(timeout=5)
@@ -88,6 +120,7 @@ class DatasetPrefetcher:
                 raise self._err
             raise StopIteration
         self.batches += 1
+        _m_batches().inc()
         return item
 
     def close(self):

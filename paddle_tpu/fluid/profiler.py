@@ -29,6 +29,7 @@ _STATE = {
     "trace_dir": None,
     "events": [],  # (kind, name, start_s, dur_s)
     "t0": None,    # profiling session epoch (perf_counter)
+    "wall_t0": None,  # wall-clock time of the epoch (cross-process merge)
 }
 
 
@@ -110,6 +111,7 @@ def start_profiler(state="All", tracer_option=None, trace_dir=None):
     _STATE["enabled"] = True
     _STATE["events"] = []
     _STATE["t0"] = time.perf_counter()
+    _STATE["wall_t0"] = time.time()
     _STATE["trace_dir"] = trace_dir
     if trace_dir is not None:
         import jax
@@ -150,20 +152,45 @@ def get_events():
 def export_chrome_trace(path):
     """Write the recorded spans as a chrome://tracing JSON file (the
     reference's tools/timeline.py converts its profiler proto the same
-    way)."""
-    import json
+    way).
 
+    The process's REAL pid tags every event and each event kind gets its
+    own tid (host=1; run/compile/rpc/... assigned in order of first
+    appearance), with ``ph:"M"`` process_name/thread_name metadata
+    carrying the role/rank identity — so per-rank traces merged by
+    tools/merge_traces.py stay attributable.  A top-level ``ptMeta``
+    object records the session's wall-clock epoch for cross-process time
+    alignment."""
+    import json
+    import os
+
+    from paddle_tpu.observability import tracing as _tracing
+
+    ident = _tracing.process_identity()
+    pid = os.getpid()
+    tids = {"host": 1}  # host spans stay on tid 1 (historic layout)
     events = []
     for kind, name, start, dur in get_events():
+        tid = tids.setdefault(kind, len(tids) + 1)
         events.append({
             "name": name, "cat": kind, "ph": "X",
             "ts": start * 1e6, "dur": dur * 1e6,
-            "pid": 0, "tid": {"host": 1}.get(kind, 0),
+            "pid": pid, "tid": tid,
             "args": {"kind": kind},
         })
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"{ident['role']}{ident['rank']} "
+                              f"(pid {pid})"}},
+            {"name": "process_labels", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"labels": f"trace_id={ident['trace_id']}"}}]
+    for kind, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": kind}})
     with open(path, "w") as f:
-        json.dump({"traceEvents": events,
-                   "displayTimeUnit": "ms"}, f)
+        json.dump({"traceEvents": meta + events,
+                   "displayTimeUnit": "ms",
+                   "ptMeta": {**ident,
+                              "wall_t0": _STATE["wall_t0"] or 0.0}}, f)
     return path
 
 

@@ -52,10 +52,34 @@ __all__ = [
     "quantize_block_scaled",
     "dequantize_block_scaled",
     "quantized_all_reduce",
+    "wire_bytes",
     "DEFAULT_BLOCK_SIZE",
 ]
 
 DEFAULT_BLOCK_SIZE = 256
+
+
+def wire_bytes(n_elements, block_size=DEFAULT_BLOCK_SIZE, dual_int8=True,
+               n_devices=2):
+    """Per-device ICI payload of one quantized all-reduce of
+    ``n_elements`` fp values — the standing collective-bytes metric the
+    EQuARX bench rung captured as a one-off (pure python; used by the
+    data-parallel transpiler to report
+    ``pt_collective_payload_bytes_total``).
+
+    Both phase boundaries (scatter all_to_all, gather all_gather) move
+    the full padded tensor once: int8 hi (+ int8 residual when dual) plus
+    one fp32 scale per ``block_size`` block.  n_devices=1 is the exact
+    fallback — nothing crosses the wire.
+    """
+    n = int(n_elements)
+    if n <= 0 or int(n_devices) <= 1:
+        return 0
+    padded = n + (-n) % (int(n_devices) * int(block_size))
+    per_elem = 2 if dual_int8 else 1
+    n_blocks = padded // int(block_size)
+    return 2 * (padded * per_elem + n_blocks * 4)
+
 
 # int8 symmetric range: +-127 (never -128, keeping the scale symmetric —
 # the convention of every block-scaled training format)

@@ -442,6 +442,51 @@ _CMD_NAMES = {CMD_SEND_GRAD: "send_grad", CMD_GET_PARAM: "get_param",
               CMD_LOOKUP_ROWS: "lookup_rows",
               CMD_CHECKPOINT_NOTIFY: "checkpoint_notify"}
 
+
+def _rpc_latency():
+    """Per-command RPC latency histogram in the shared registry
+    (docs/OBSERVABILITY.md).  Lazy: observability is stdlib-only, so this
+    keeps `native` importable without jax."""
+    from paddle_tpu import observability as obs
+
+    return obs.histogram(
+        "pt_ps_rpc_latency_seconds",
+        "Client-observed wire latency of one PS RPC attempt "
+        "(retries are separate samples)", labels=("cmd",))
+
+
+def _rpc_total():
+    from paddle_tpu import observability as obs
+
+    return obs.counter(
+        "pt_ps_rpc_total",
+        "PS RPC attempts by command and outcome "
+        "(ok/timeout/server_error/transport_error)",
+        labels=("cmd", "status"))
+
+
+def _record_rpc(cmd, seconds, status):
+    """Book one wire attempt: latency histogram + outcome counter, a
+    profiler span (when a profiling session is live — checked via
+    sys.modules so telemetry never triggers the fluid import), and a
+    span id in the JSONL event log (when enabled)."""
+    name = _CMD_NAMES.get(cmd, str(cmd))
+    _rpc_latency().labels(cmd=name).observe(seconds)
+    _rpc_total().labels(cmd=name, status=status).inc()
+    import sys as _sys
+
+    prof = _sys.modules.get("paddle_tpu.fluid.profiler")
+    if prof is not None and prof.is_profiler_enabled():
+        prof._record("rpc", f"rpc:{name}", seconds)
+    from paddle_tpu.observability import events as _events
+
+    if _events.enabled():
+        from paddle_tpu.observability import tracing as _tracing
+
+        _events.emit("rpc", cmd=name, status=status,
+                     seconds=round(seconds, 6),
+                     span_id=_tracing.new_span_id())
+
 # barrier frames carry the trainer's completed-round count; this high bit
 # marks the retry of a timed-out wait (server must not re-count the
 # arrival) — mirrors kPtsRewaitBit in native_api.h
@@ -545,13 +590,27 @@ class PSServer:
 
     def stats(self):
         """Server-side resilience counters (stale-trainer detection:
-        nonzero barrier timeouts mean some peer stopped arriving)."""
+        nonzero barrier timeouts mean some peer stopped arriving).
+
+        The return shape is the frozen back-compat view; each read also
+        mirrors the values into `pt_ps_server_stat{key=...}` gauges in
+        the shared registry (the sync loop calls stats() every round, so
+        /metricsz tracks the live C++ counters round-granular)."""
         st = lib().pts_server_stat
-        return {"send_barrier_timeouts": st(self._h, 0),
-                "fetch_barrier_timeouts": st(self._h, 1),
-                "get_param_timeouts": st(self._h, 2),
-                "rounds": st(self._h, 3),
-                "version": st(self._h, 4)}
+        out = {"send_barrier_timeouts": st(self._h, 0),
+               "fetch_barrier_timeouts": st(self._h, 1),
+               "get_param_timeouts": st(self._h, 2),
+               "rounds": st(self._h, 3),
+               "version": st(self._h, 4)}
+        from paddle_tpu import observability as obs
+
+        g = obs.gauge("pt_ps_server_stat",
+                      "PSServer transport counters (mirrored from the "
+                      "native runtime on each stats() read)",
+                      labels=("key",))
+        for k, v in out.items():
+            g.labels(key=k).set(float(v))
+        return out
 
     def wait_round(self) -> bool:
         """Block until every trainer hit send_barrier; False = stopped."""
@@ -724,8 +783,10 @@ class PSClient:
             resilience.record("reconnects")
 
     def _req_once(self, cmd, name="", round=0, blob=b""):
-        """One wire attempt; classifies failures for the retry layer."""
+        """One wire attempt; classifies failures for the retry layer and
+        books latency + outcome into the shared telemetry registry."""
         out, olen = ctypes.c_void_p(), ctypes.c_int64()
+        t0 = time.perf_counter()
         with self._lock:
             if self._h is None:
                 raise PSConnectionError(
@@ -733,6 +794,9 @@ class PSClient:
             rc = lib().pts_request(self._h, cmd, name.encode(), round, blob,
                                    len(blob), ctypes.byref(out),
                                    ctypes.byref(olen))
+        _record_rpc(cmd, time.perf_counter() - t0,
+                    {0: "ok", 1: "server_error", 2: "timeout"}.get(
+                        rc, "transport_error"))
         data = _take(out, olen.value) if out.value else b""
         if rc == 0:
             return data

@@ -1,0 +1,42 @@
+"""paddle_tpu.observability — unified telemetry for the whole stack.
+
+One schema, four surfaces:
+
+  metrics     thread-safe Counter/Gauge/Histogram registry with labels
+              (Prometheus data model); every layer — executor, parallel
+              runners, PS client/server, resilience, reader — reports
+              into the process-wide default registry
+  exposition  Prometheus text format, JSON, and the opt-in /metricsz +
+              /statusz + /healthz HTTP endpoint (FLAGS_metrics_port)
+  events      structured JSONL step/round lifecycle log (run id, pid,
+              role/rank, trace id, wall + monotonic timestamps)
+  tracing     per-job trace id (env-propagated through launchers) and
+              per-RPC span ids; chrome traces exported per process are
+              merged across ranks by tools/merge_traces.py
+
+Metric naming: ``pt_<layer>_<what>[_total|_seconds|_bytes]`` with labels
+for the variable dimensions — see docs/OBSERVABILITY.md for the full
+inventory.  Import cost is stdlib-only: `native`, `distributed` and the
+launchers can import this package without pulling in jax.
+"""
+
+from . import events  # noqa: F401
+from . import exposition  # noqa: F401
+from . import metrics  # noqa: F401
+from . import tracing  # noqa: F401
+from .exposition import (MetricsServer, ensure_from_flags, parse_text,
+                         render_json, render_text)
+from .metrics import (DEFAULT_BUCKETS, REGISTRY, Counter, Gauge, Histogram,
+                      MetricsRegistry, counter, gauge, histogram, reset,
+                      snapshot)
+from .tracing import job_trace_id, new_span_id, process_identity
+
+__all__ = [
+    "metrics", "exposition", "events", "tracing",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "counter", "gauge", "histogram", "snapshot", "reset",
+    "DEFAULT_BUCKETS",
+    "render_text", "render_json", "parse_text", "MetricsServer",
+    "ensure_from_flags",
+    "job_trace_id", "new_span_id", "process_identity",
+]

@@ -1,0 +1,142 @@
+"""Structured JSONL event log: step / round / lifecycle events with a
+shared schema across trainers and pservers.
+
+Each line is one JSON object:
+
+    {"ts": <wall seconds>, "mono": <monotonic seconds>, "event": <name>,
+     "run_id": ..., "trace_id": ..., "pid": ..., "role": ..., "rank": ...,
+     ...caller fields}
+
+Opt-in: nothing is written unless `FLAGS_event_log_dir` (or the
+``PT_EVENT_LOG_DIR`` env var, which wins — the launcher sets it for
+children) points at a directory.  Each process appends to its own file
+(``events_<role><rank>_<pid>.jsonl``) so concurrent writers never
+interleave partial lines; `tools/merge_traces.py` and offline analysis
+read the per-process files side by side keyed on trace_id.
+
+`emit()` is safe to call unconditionally from hot paths: when disabled it
+is one attribute check; when enabled it is one json.dumps + buffered
+write under a lock.  IO failures disable the log with a warning — losing
+telemetry must never kill training.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import warnings
+
+from . import tracing
+
+__all__ = ["EventLog", "emit", "enabled", "configure", "get_log",
+           "read_events"]
+
+_lock = threading.Lock()
+_log = None          # active EventLog, None = disabled
+_configured = False  # lazy env/flag probe ran
+
+
+class EventLog:
+    """One process's append-only JSONL event stream."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._identity = tracing.process_identity()
+        self._run_id = tracing.run_id()
+
+    def emit(self, event, **fields):
+        rec = {"ts": time.time(), "mono": time.monotonic(),
+               "event": str(event), "run_id": self._run_id,
+               **self._identity, **fields}
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def _resolve_dir():
+    """PT_EVENT_LOG_DIR env wins (launcher contract); else the flag —
+    read lazily and tolerantly, so this module imports without fluid."""
+    d = os.environ.get("PT_EVENT_LOG_DIR")
+    if d:
+        return d
+    try:
+        from paddle_tpu.fluid import flags
+        return flags.flag("event_log_dir")
+    except Exception:
+        return ""
+
+
+def configure(path=None):
+    """(Re)configure the process event log.  path=None re-probes the env/
+    flag surface; an empty resolution disables.  Returns the active log
+    (or None)."""
+    global _log, _configured
+    with _lock:
+        _configured = True
+        if _log is not None:
+            _log.close()
+            _log = None
+        try:
+            if path is None:
+                d = _resolve_dir()
+                if not d:
+                    return None
+                os.makedirs(d, exist_ok=True)
+                ident = tracing.process_identity()
+                path = os.path.join(
+                    d, f"events_{ident['role']}{ident['rank']}_"
+                       f"{ident['pid']}.jsonl")
+            _log = EventLog(path)
+        except OSError as e:
+            # losing telemetry must never kill training: an uncreatable
+            # dir (read-only FS, bad PT_EVENT_LOG_DIR) disables the log
+            warnings.warn(f"event log disabled ({e})")
+            _log = None
+        return _log
+
+
+def get_log():
+    """The active EventLog, probing the env/flag surface on first call."""
+    if not _configured:
+        configure()
+    return _log
+
+
+def enabled() -> bool:
+    return get_log() is not None
+
+
+def emit(event, **fields):
+    """Write one event if the log is enabled; never raises."""
+    log = get_log()
+    if log is None:
+        return
+    try:
+        log.emit(event, **fields)
+    except Exception as e:
+        global _log
+        warnings.warn(f"event log write failed, disabling ({e})")
+        with _lock:
+            _log = None
+
+
+def read_events(path):
+    """Parse one JSONL event file -> list of dicts (analysis/tests)."""
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

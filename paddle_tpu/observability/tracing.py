@@ -1,0 +1,102 @@
+"""Cross-process trace identity: per-job trace id, per-RPC span ids, and
+the (role, rank) identity every telemetry surface tags records with.
+
+A distributed job (launch_ps / launch / supervised ProcGroup) is ONE
+trace: the launcher mints a job trace id and exports it as ``PT_TRACE_ID``
+so every pserver/trainer incarnation — including supervised relaunches —
+lands its spans and JSONL events under the same id.  A process that finds
+no ``PT_TRACE_ID`` mints its own and writes it back into ``os.environ``,
+so children it spawns later still join its trace.
+
+Span ids are cheap process-local hex tokens minted per RPC attempt; they
+are recorded in the client's JSONL `rpc` events, so a retry storm is
+enumerable attempt by attempt next to the chrome-trace `rpc:<cmd>` spans
+(correlated by trace id + timestamps; carrying span ids inside the trace
+args and the RPC wire frame is ROADMAP telemetry phase-2).  The wire
+protocol itself is untouched: the job id rides the launcher env
+contract, the same channel PADDLE_TRAINER_ID uses.
+
+Stdlib-only — imported by `native` and `distributed`, which must stay
+importable without jax.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+
+__all__ = ["job_trace_id", "new_span_id", "run_id", "process_role",
+           "process_rank", "process_identity"]
+
+_TRACE_ENV = "PT_TRACE_ID"
+_RUN_ENV = "PT_RUN_ID"
+_ROLE_ENV = "PT_TRACE_ROLE"
+
+_lock = threading.Lock()
+_span_counter = 0
+
+
+def job_trace_id() -> str:
+    """The job-wide trace id (mint-once, env-propagated to children)."""
+    tid = os.environ.get(_TRACE_ENV)
+    if not tid:
+        tid = uuid.uuid4().hex[:16]
+        os.environ[_TRACE_ENV] = tid
+    return tid
+
+
+def run_id() -> str:
+    """This run's id — like the trace id but NOT shared across restarts:
+    the launcher re-exports a fresh one per incarnation when it wants
+    restart-granular event streams, else it behaves like job_trace_id."""
+    rid = os.environ.get(_RUN_ENV)
+    if not rid:
+        rid = job_trace_id()
+        os.environ[_RUN_ENV] = rid
+    return rid
+
+
+def new_span_id() -> str:
+    """Process-unique span id: pid-prefixed counter (cheap, ordered,
+    unique across the job because pids differ per process)."""
+    global _span_counter
+    with _lock:
+        _span_counter += 1
+        n = _span_counter
+    return f"{os.getpid():x}-{n:x}"
+
+
+def process_role() -> str:
+    """'trainer' / 'pserver' / ... — PT_TRACE_ROLE when the launcher (or
+    runner script) set it, else inferred from the PADDLE_* env contract."""
+    role = os.environ.get(_ROLE_ENV)
+    if role:
+        return role
+    if os.environ.get("PADDLE_TRAINER_ID"):
+        return "trainer"
+    return "proc"
+
+
+def process_rank() -> int:
+    """This process's rank within its role: PT_TRACE_RANK when the
+    launcher set it (pservers have no PADDLE_TRAINER_ID — launch_ps
+    exports the shard index instead), else the trainer id from the
+    PADDLE_* env contract; 0 when standalone."""
+    for var in ("PT_TRACE_RANK", "PADDLE_TRAINER_ID"):
+        v = os.environ.get(var)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
+
+
+def process_identity() -> dict:
+    """The tags every exported artifact (chrome trace, JSONL event,
+    /statusz) carries so a merge tool can attribute records."""
+    return {"pid": os.getpid(), "role": process_role(),
+            "rank": process_rank(), "trace_id": job_trace_id(),
+            "restart_count": int(
+                os.environ.get("PADDLE_RESTART_COUNT", "0") or 0)}

@@ -479,12 +479,22 @@ def _serv_sync_loop(server, blocks, local, exe, snap_path=None,
     shard state — params AND optimizer accumulators, republished from the
     local scope — snapshots every `snap_every` completed rounds, so a
     relaunched pserver resumes exactly where the job was."""
-    from paddle_tpu.distributed import fault_injection
+    import time as _time
 
+    from paddle_tpu import observability as _obs
+    from paddle_tpu.distributed import fault_injection
+    from paddle_tpu.fluid import profiler as _prof
+    from paddle_tpu.observability import events as _events
+
+    round_hist = _obs.histogram(
+        "pt_ps_round_seconds",
+        "Pserver sync-round handling time (merge + optimize + publish, "
+        "excluding the wait for trainer arrivals)")
     # the driver's round wait is unbounded by design: server.stop()
     # (teardown) unblocks it, and trainer-side liveness is covered by the
     # barrier deadline answering the trainers themselves
     while server.wait_round():  # resilience: allow
+        t_round = _time.perf_counter()
         received = {}
         for name, payload in server.grads():
             received.setdefault(name, []).append(payload)
@@ -509,9 +519,16 @@ def _serv_sync_loop(server, blocks, local, exe, snap_path=None,
             server.publish(param, np.asarray(local.get(param)))
         server.bump_version()
         server.release_send()
+        round_s = _time.perf_counter() - t_round
+        round_hist.observe(round_s)
+        _prof._record("ps", "ps:round", round_s)
         if not server.end_round():
             break
         rounds = server.stats()["rounds"]  # absolute (snapshot-continuous)
+        if _events.enabled():
+            _events.emit("round_end", round=int(rounds),
+                         seconds=round(round_s, 6),
+                         n_grads=sum(len(v) for v in received.values()))
         if snap_path and rounds % max(1, snap_every) == 0:
             for blk in blocks:
                 for name in blk[3]:  # state: param + accumulators + lr
@@ -613,6 +630,11 @@ def _listen_and_serv_run(scope, op, place):
             f"happens once per job")
     local = Scope()
     exe = Executor(place)
+    from paddle_tpu.observability import events as _events
+
+    if _events.enabled():
+        _events.emit("serve_start", endpoint=ep, sync_mode=sync_mode,
+                     n_trainers=n_trainers, restored=restored)
     try:
         with scope_guard(local):
             # on a restored shard the snapshot already holds every state
@@ -632,6 +654,8 @@ def _listen_and_serv_run(scope, op, place):
                 _serv_async_loop(server, blocks, local, exe)
     finally:
         server.stop()
+        if _events.enabled():
+            _events.emit("serve_stop", endpoint=ep)
 
 
 register_op("send", ["X*"], [], _no_lower, grad=None, host_run=_send_run)

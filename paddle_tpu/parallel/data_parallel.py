@@ -144,7 +144,26 @@ def transpile_data_parallel(program, loss_name, num_devices,
             for g in b["grads"]:
                 bucketed[g] = b
 
+    # standing collective-payload accounting (docs/OBSERVABILITY.md):
+    # per-device ICI bytes one step moves, both phases of each collective
+    # counted (reduce-scatter + all-gather for fp32, the two int8 phase
+    # boundaries for quant) — the runner adds these to
+    # pt_collective_payload_bytes_total every step.  Dynamic-shape grads
+    # are skipped (estimate, documented as such).
+    collective_bytes = {"c_allreduce_sum": 0, "c_allreduce_quant": 0,
+                        "c_allreduce_avg": 0}
+    _itemsize = {"float32": 4, "float16": 2, "bfloat16": 2, "float64": 8}
+
+    def _static_bytes(name):
+        v = block._find_var_recursive(name)
+        if v is None or not v.shape or any(
+                d is None or d < 0 for d in v.shape):
+            return 0
+        return int(np.prod(v.shape)) * _itemsize.get(v.dtype, 4)
+
     def _emit_bucket(b, out):
+        from paddle_tpu.kernels import quantized_collectives as qc
+
         fused = b["fused"].name
         out.append(Operator(
             block, "coalesce_tensor",
@@ -162,6 +181,9 @@ def transpile_data_parallel(program, loss_name, num_devices,
             inputs={"X": [fused]}, outputs={"Out": list(b["grads"])},
             attrs={"shapes": [list(s) for s in b["shapes"]],
                    "op_role": "backward"}))
+        collective_bytes["c_allreduce_quant"] += qc.wire_bytes(
+            sum(int(np.prod(s)) for s in b["shapes"]),
+            block_size=int(quant_block_size), n_devices=num_devices)
 
     new_ops = []
     pending = set(raw_grads)
@@ -177,6 +199,7 @@ def transpile_data_parallel(program, loss_name, num_devices,
                 inputs={"X": [g]}, outputs={"Out": [g]},
                 attrs={"ring_id": 0, "use_calc_stream": True,
                        "op_role": "backward"}))
+            collective_bytes["c_allreduce_sum"] += 2 * _static_bytes(g)
         for b in buckets:
             if b["insert_at"] == op_idx:
                 _emit_bucket(b, new_ops)
@@ -188,7 +211,12 @@ def transpile_data_parallel(program, loss_name, num_devices,
                         block, "c_allreduce_avg",
                         inputs={"X": [names[0]]}, outputs={"Out": [names[0]]},
                         attrs={"ring_id": 0, "op_role": "forward"}))
+                    collective_bytes["c_allreduce_avg"] += \
+                        2 * _static_bytes(names[0])
     block.ops = new_ops
+    if num_devices <= 1:  # psum over one device moves nothing
+        collective_bytes = {k: 0 for k in collective_bytes}
+    program._collective_bytes_per_step = collective_bytes
     program._bump_version()
     return program
 
@@ -229,7 +257,11 @@ class DataParallelRunner:
                 tuple(fetch_names))
 
     def run(self, executor, feed, fetch_list, scope, return_numpy=True):
+        import time as _time
+
         from paddle_tpu.fluid import executor as ex
+        from paddle_tpu.fluid.executor import (_m_cache, _m_compile_seconds,
+                                               _record_step)
 
         scope = scope or ex.global_scope()
         feed = executor._coerce_feed(self.program, feed or {})
@@ -242,13 +274,44 @@ class DataParallelRunner:
         key = self._cache_key(feed, fetch_names)
         cb = self._cache.get(key)
         if cb is None:
+            _m_cache().labels(path="dp", result="miss").inc()
+            t0 = _time.perf_counter()
             cb = _ShardedBlock(self.program, feed.keys(), fetch_names, self.mesh, scope)
             self._cache[key] = cb
+            _m_compile_seconds().labels(
+                path="dp", phase="trace").inc(_time.perf_counter() - t0)
+        else:
+            _m_cache().labels(path="dp", result="hit").inc()
+        first_run = not getattr(cb, "_obs_ran", False)
+        t0 = _time.perf_counter()
         fetches = cb.run(scope, feed, executor._step)
+        step_s = _time.perf_counter() - t0
+        _record_step("dp", step_s, first_run)
+        cb._obs_ran = True
+        self._report_throughput(feed, step_s)
         executor._step += 1
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
+
+    def _report_throughput(self, feed, step_s):
+        """Per-step throughput + collective-payload telemetry
+        (docs/OBSERVABILITY.md): global examples ingested, last-step
+        examples/sec, and the transpiler's per-step ICI byte estimate."""
+        from paddle_tpu import observability as obs
+        from paddle_tpu.fluid.executor import _feed_batch, _report_examples
+
+        _report_examples("dp", _feed_batch(feed), step_s)
+        per_step = getattr(self.program, "_collective_bytes_per_step", None)
+        if per_step:
+            fam = obs.counter(
+                "pt_collective_payload_bytes_total",
+                "Estimated per-device ICI payload moved by gradient/BN "
+                "collectives (both phases counted; static shapes only)",
+                labels=("collective",))
+            for coll, nbytes in per_step.items():
+                if nbytes:
+                    fam.labels(collective=coll).inc(nbytes)
 
     def cost_analysis(self, executor, feed, fetch_list=None, scope=None):
         """XLA cost/memory analysis of the sharded step executable (the

@@ -153,6 +153,7 @@ class HybridParallelRunner:
         self.feed_specs = dict(feed_specs or {})
         self._default_scope = scope
         self._cache = {}
+        self._ran_keys = set()  # signatures that executed at least once
         self._step = 0
         self.zero_stage = int(zero_stage)
         # capture_hlo=True records the OPTIMIZED (post-GSPMD-partitioner)
@@ -210,12 +211,33 @@ class HybridParallelRunner:
 
     def _dispatch(self, key, scope, feed, fetch_names, n_steps,
                   stacked_feed, return_numpy):
+        import time as _time
+
+        from paddle_tpu.fluid.executor import (_feed_batch, _m_cache,
+                                               _m_compile_seconds,
+                                               _record_step,
+                                               _report_examples)
+
         cb = self._cache.get(key)
         if cb is None:
+            _m_cache().labels(path="hybrid", result="miss").inc()
+            t0 = _time.perf_counter()
             cb = self._compile(scope, list(feed.keys()), fetch_names,
                                n_steps=n_steps, stacked_feed=stacked_feed)
             self._cache[key] = cb
+            _m_compile_seconds().labels(
+                path="hybrid", phase="trace").inc(_time.perf_counter() - t0)
+        else:
+            _m_cache().labels(path="hybrid", result="hit").inc()
+        first_run = key not in self._ran_keys
+        t0 = _time.perf_counter()
         fetches = cb(scope, feed, self._step)
+        step_s = _time.perf_counter() - t0
+        _record_step("hybrid", step_s, first_run)
+        self._ran_keys.add(key)
+        # stacked_feed: the leading feed axis is the step index, not batch
+        batch = 0 if stacked_feed else _feed_batch(feed) * n_steps
+        _report_examples("hybrid", batch, step_s)
         self._step += n_steps
         if return_numpy:
             return [np.asarray(f) for f in fetches]

@@ -120,7 +120,30 @@ def _make_transpiler():
     return fluid.DistributeTranspiler()
 
 
+def _trace_hooks(role, rank):
+    """PT_TRACE_DIR: profile this process and export a per-role chrome
+    trace on exit (merged across ranks by tools/merge_traces.py)."""
+    trace_dir = os.environ.get("PT_TRACE_DIR")
+    if not trace_dir:
+        return lambda: None
+    os.environ.setdefault("PT_TRACE_ROLE", role)
+    os.environ.setdefault("PT_TRACE_RANK", str(rank))
+    from paddle_tpu.fluid import profiler
+
+    profiler.start_profiler()
+
+    def export():
+        os.makedirs(trace_dir, exist_ok=True)
+        profiler.export_chrome_trace(
+            os.path.join(trace_dir, f"trace_{role}{rank}.json"))
+
+    return export
+
+
 def run_pserver(ep, endpoints, n_trainers, opt_name):
+    # rank = shard index within the endpoint list, matching the
+    # PT_TRACE_RANK convention launch_ps uses for its pservers
+    export_trace = _trace_hooks("pserver", endpoints.split(",").index(ep))
     main, startup, loss = build(opt_name)
     t = _make_transpiler()
     t.transpile(trainer_id=0, program=main, pservers=endpoints,
@@ -128,11 +151,13 @@ def run_pserver(ep, endpoints, n_trainers, opt_name):
                 startup_program=startup)
     with scope_guard(Scope()):
         fluid.Executor(fluid.CPUPlace()).run(t.get_pserver_program(ep))
+    export_trace()
 
 
 def run_trainer(tid, endpoints, n_trainers, opt_name, out_path):
     from paddle_tpu.distributed import fault_injection, resilience
 
+    export_trace = _trace_hooks("trainer", tid)
     main, startup, loss = build(opt_name)
     t = _make_transpiler()
     t.transpile(trainer_id=tid, program=main, pservers=endpoints,
@@ -166,6 +191,7 @@ def run_trainer(tid, endpoints, n_trainers, opt_name, out_path):
             losses.append(float(np.asarray(lv)))
             if ck is not None:
                 ck.step(step)
+    export_trace()
     json.dump({"losses": losses, "start_step": start_step,
                "restart_count": int(os.environ.get("PADDLE_RESTART_COUNT",
                                                    "0") or 0),

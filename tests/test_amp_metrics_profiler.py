@@ -205,7 +205,18 @@ def test_chrome_trace_export(tmp_path):
     data = json.loads((tmp_path / "tl.json").read_text())
     names = [e["name"] for e in data["traceEvents"]]
     assert "span_a" in names and "span_b" in names
-    assert all(e["ph"] == "X" and e["ts"] >= 0 for e in data["traceEvents"])
+    spans = [e for e in data["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in data["traceEvents"] if e["ph"] == "M"]
+    assert all(e["ts"] >= 0 for e in spans)
+    # real pid + per-kind tid + identity metadata (merge-tool contract)
+    import os
+    assert all(e["pid"] == os.getpid() for e in spans)
+    assert all(e["tid"] == 1 for e in spans)  # host spans ride tid 1
+    assert any(m["name"] == "process_name" for m in meta)
+    assert any(m["name"] == "thread_name" and m["args"]["name"] == "host"
+               for m in meta)
+    assert data["ptMeta"]["pid"] == os.getpid()
+    assert data["ptMeta"]["wall_t0"] > 0
     assert len(events) == 2
 
 

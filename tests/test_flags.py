@@ -86,6 +86,33 @@ def test_resilience_flags_roundtrip(monkeypatch):
     importlib.reload(fl)  # restore defaults for other tests
 
 
+def test_observability_flags_roundtrip(monkeypatch):
+    """The unified-telemetry flags register with off-by-default values
+    (0 port = no endpoint, empty dir = no event log) and round-trip
+    through env bootstrap and get/set like every other flag."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("metrics_port")["metrics_port"] == 0
+    assert fl.get_flags("event_log_dir")["event_log_dir"] == ""
+    try:
+        fl.set_flags({"FLAGS_metrics_port": "9187",  # str parses
+                      "event_log_dir": "/tmp/pt_events"})
+        assert fl.get_flags(["metrics_port", "event_log_dir"]) == {
+            "metrics_port": 9187, "event_log_dir": "/tmp/pt_events"}
+    finally:
+        fl.set_flags({"FLAGS_metrics_port": 0, "FLAGS_event_log_dir": ""})
+    monkeypatch.setenv("FLAGS_metrics_port", "9188")
+    monkeypatch.setenv("FLAGS_event_log_dir", "/tmp/ev")
+    importlib.reload(fl)
+    assert fl.get_flags("metrics_port")["metrics_port"] == 9188
+    assert fl.get_flags("event_log_dir")["event_log_dir"] == "/tmp/ev"
+    monkeypatch.delenv("FLAGS_metrics_port")
+    monkeypatch.delenv("FLAGS_event_log_dir")
+    importlib.reload(fl)  # restore defaults for other tests
+
+
 def test_malformed_env_flag_warns_not_crashes(monkeypatch):
     import importlib
     import warnings as w

@@ -1,0 +1,64 @@
+"""tools/lint_observability.py — the unified-telemetry CI tripwire: no
+bare print() diagnostics in library code outside the exposition surfaces
+(profiler/debugger/observability).  Runs the real lint in tier-1 (`make
+lint-observability` is the Makefile entry point)."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint_observability  # noqa: E402
+
+
+def test_repo_library_tree_is_clean(capsys):
+    assert lint_observability.main([]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_flags_bare_print():
+    src = (
+        "def f(x):\n"
+        "    print('debugging', x)\n")
+    findings = lint_observability.check_source(src, "bad.py")
+    assert len(findings) == 1
+    assert findings[0][1] == 2 and findings[0][2] == "bare-print"
+
+
+def test_allow_mark_suppresses():
+    same = "print('banner')  # observability: allow\n"
+    above = ("# observability: allow — CLI output\n"
+             "print('banner')\n")
+    assert lint_observability.check_source(same, "a.py") == []
+    assert lint_observability.check_source(above, "b.py") == []
+
+
+def test_non_builtin_print_not_flagged():
+    src = ("obj.print()\n"              # method, not the builtin
+           "jax.debug.print('x')\n")    # attribute chain
+    assert lint_observability.check_source(src, "c.py") == []
+
+
+def test_exempt_modules_skipped():
+    profiler = REPO / "paddle_tpu" / "fluid" / "profiler.py"
+    assert lint_observability.check_file(profiler) == []
+    # but the same source outside an exempt path WOULD be flagged
+    findings = lint_observability.check_source(
+        profiler.read_text(), "elsewhere.py")
+    assert any(f[2] == "bare-print" for f in findings)
+
+
+def test_exempt_dir_does_not_leak_to_prefix_siblings(tmp_path):
+    """paddle_tpu/observability/ is exempt; a sibling file sharing the
+    name prefix (observability_helpers.py) must still be linted."""
+    assert lint_observability._exempt("paddle_tpu/observability/x.py")
+    assert not lint_observability._exempt(
+        "paddle_tpu/observability_helpers.py")
+    assert lint_observability._exempt("paddle_tpu/fluid/profiler.py")
+    assert not lint_observability._exempt("paddle_tpu/fluid/profiler2.py")
+
+
+def test_parse_error_reported_not_raised():
+    findings = lint_observability.check_source("def broken(:\n", "x.py")
+    assert findings and findings[0][2] == "parse-error"

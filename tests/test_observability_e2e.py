@@ -1,0 +1,154 @@
+"""End-to-end telemetry on real PS runs: scraping /metricsz during a live
+pserver job, and merging per-rank chrome traces from a 1-trainer +
+1-pserver subprocess run into one timeline."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+
+from net_util import free_port
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.observability import exposition
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RUNNER = os.path.join(HERE, "dist_ps_runner.py")
+MERGE = os.path.join(HERE, "..", "tools", "merge_traces.py")
+
+
+def _hist_count(parsed, name, **labels):
+    total = 0.0
+    for lbl, v in parsed.get(name, {}).get("samples", []):
+        if lbl.get("__sample__") != "count":
+            continue
+        if all(lbl.get(k) == val for k, val in labels.items()):
+            total += v
+    return total
+
+
+def test_metricsz_scrape_live_ps_run():
+    """Acceptance: scrape /metricsz from a live pserver run and assert
+    the per-command RPC latency histogram is populated (plus the server
+    round histogram and the mirrored PSServer stats gauges)."""
+    from paddle_tpu.fluid import flags
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    ep = f"127.0.0.1:{free_port()}"
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=ep, trainers=1,
+                startup_program=startup)
+    pserver_prog = t.get_pserver_program(ep)
+
+    metrics_port = free_port()
+    old = flags.get_flags("FLAGS_metrics_port")
+    flags.set_flags({"FLAGS_metrics_port": metrics_port})
+
+    def run_ps():
+        with scope_guard(Scope()):
+            fluid.Executor(fluid.CPUPlace()).run(pserver_prog)
+
+    pst = threading.Thread(target=run_ps)
+    pst.start()
+    rng = np.random.RandomState(0)
+    try:
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for _ in range(4):
+                xb = rng.uniform(-1, 1, (8, 13)).astype("float32")
+                exe.run(t.get_trainer_program(),
+                        feed={"x": xb, "y": xb[:, :1]},
+                        fetch_list=[loss.name])
+            # scrape while the pserver thread is still serving
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}/metricsz",
+                timeout=10).read().decode()
+    finally:
+        flags.set_flags(old)
+        try:
+            fluid.transpiler.stop_pservers([ep])
+        finally:
+            pst.join(timeout=30)
+            exposition.stop_server()
+    assert not pst.is_alive()
+
+    parsed = exposition.parse_text(body)  # golden parser, strict
+    # client-side per-command RPC latency histogram is populated
+    assert _hist_count(parsed, "pt_ps_rpc_latency_seconds",
+                       cmd="send_grad") >= 4
+    assert _hist_count(parsed, "pt_ps_rpc_latency_seconds",
+                       cmd="get_param") >= 4
+    # server-side round handling histogram (sync loop runs in-process)
+    assert _hist_count(parsed, "pt_ps_round_seconds") >= 4
+    # mirrored native-server counters
+    rounds = [v for lbl, v in parsed["pt_ps_server_stat"]["samples"]
+              if lbl.get("key") == "rounds"]
+    assert rounds and rounds[0] >= 4
+    # RPC outcome counter carries ok statuses
+    oks = [v for lbl, v in parsed["pt_ps_rpc_total"]["samples"]
+           if lbl.get("status") == "ok"]
+    assert oks and sum(oks) >= 8
+
+
+def test_merge_traces_from_1x1_subprocess_run(tmp_path):
+    """Acceptance: tools/merge_traces.py over a 1-trainer + 1-pserver
+    run produces ONE chrome trace with spans from both pids."""
+    trace_dir = str(tmp_path / "traces")
+    ep = f"127.0.0.1:{free_port()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DIST_PS_STEPS="4",
+               PT_TRACE_DIR=trace_dir, PT_TRACE_ID="e2e-merge-test")
+    env.pop("XLA_FLAGS", None)
+
+    ps = subprocess.Popen(
+        [sys.executable, RUNNER, "pserver", ep, ep, "1", "sgd"], env=env)
+    tout = str(tmp_path / "t0.json")
+    tr = subprocess.Popen(
+        [sys.executable, RUNNER, "trainer", "0", ep, "1", "sgd", tout],
+        env=env)
+    try:
+        assert tr.wait(timeout=240) == 0
+        fluid.transpiler.stop_pservers([ep])
+        assert ps.wait(timeout=60) == 0
+    finally:
+        for p in (ps, tr):
+            if p.poll() is None:
+                p.kill()
+
+    traces = sorted(os.listdir(trace_dir))
+    assert len(traces) == 2, traces  # one per role
+
+    merged_path = str(tmp_path / "merged.json")
+    r = subprocess.run(
+        [sys.executable, MERGE, "-o", merged_path, "--dir", trace_dir],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+
+    merged = json.load(open(merged_path))  # valid JSON
+    spans_by_pid = {}
+    for e in merged["traceEvents"]:
+        if e.get("ph") == "X":
+            spans_by_pid.setdefault(e["pid"], []).append(e)
+    assert len(spans_by_pid) == 2, "need spans from both processes"
+    assert all(len(v) >= 1 for v in spans_by_pid.values())
+    # both roles identified in the merged metadata, same job trace id
+    metas = merged["ptMergedFrom"]
+    assert {m["role"] for m in metas} == {"trainer", "pserver"}
+    assert {m["trace_id"] for m in metas} == {"e2e-merge-test"}
+    # the trainer's trace carries client RPC spans; the pserver's its
+    # round spans — both attributable through thread_name metadata
+    names = {e["name"] for e in merged["traceEvents"]}
+    assert any(n.startswith("rpc:") for n in names), names
+    assert "ps:round" in names

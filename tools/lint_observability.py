@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Static observability lint for the library tree.
+
+The unified-telemetry PR's CI tripwire: library code must report through
+the shared surfaces — the metrics registry, the JSONL event log, the
+logging module, or warnings — not scatter diagnostics on stdout where no
+schema, no labels and no scrape can reach them.  One check over
+``paddle_tpu/``:
+
+  bare-print   a call to the builtin `print()`.  Use
+               `observability.metrics` / `observability.events.emit` for
+               telemetry, `logging` / `warnings` for diagnostics — or
+               mark a deliberate user-facing print (a launcher banner, a
+               CLI result) with `# observability: allow`.
+
+Exempt modules (printing IS their exposition surface): the profiler
+(`fluid/profiler.py` summary tables), the debugger
+(`fluid/debugger.py`), and the observability package itself.
+
+Suppress a deliberate finding with `# observability: allow` on the same
+line or the line above.  Exit 0 when clean, 1 with findings (one per
+line: `path:lineno: [check] message`).
+
+Usage: python tools/lint_observability.py [paths...]
+  (no args = paddle_tpu/, repo-relative)
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+DEFAULT_TARGETS = ["paddle_tpu"]
+
+# modules whose purpose is printing (exposition surfaces)
+EXEMPT = (
+    "paddle_tpu/fluid/profiler.py",
+    "paddle_tpu/fluid/debugger.py",
+    "paddle_tpu/observability/",
+)
+
+ALLOW_MARK = "observability: allow"
+
+
+def _allowed(src_lines, lineno):
+    """Marker accepted on the flagged line or the line directly above."""
+    for ln in (lineno - 1, lineno - 2):
+        if 0 <= ln < len(src_lines) and ALLOW_MARK in src_lines[ln]:
+            return True
+    return False
+
+
+def check_source(src: str, path: str = "<string>"):
+    """Lint one file's source; returns [(path, lineno, check, message)]."""
+    findings = []
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, "parse-error", str(e))]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "print" and \
+                not _allowed(lines, node.lineno):
+            findings.append(
+                (path, node.lineno, "bare-print",
+                 "bare print() in library code — report through "
+                 "observability.metrics/events or logging/warnings, or "
+                 f"mark a deliberate CLI print `# {ALLOW_MARK}`"))
+    return findings
+
+
+def _exempt(rel_str: str) -> bool:
+    for e in EXEMPT:
+        if e.endswith("/"):
+            # directory exemption: must match a whole path segment, so a
+            # sibling like paddle_tpu/observability_helpers.py stays linted
+            if rel_str.startswith(e):
+                return True
+        elif rel_str == e:
+            return True
+    return False
+
+
+def check_file(path: Path):
+    rel = path.resolve()
+    try:
+        rel_str = str(rel.relative_to(REPO))
+    except ValueError:
+        rel_str = str(rel)
+    if _exempt(rel_str):
+        return []
+    return check_source(path.read_text(), str(path))
+
+
+def iter_files(targets):
+    for t in targets:
+        p = Path(t)
+        if not p.is_absolute():
+            p = REPO / p
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    targets = argv or DEFAULT_TARGETS
+    findings = []
+    n_files = 0
+    for f in iter_files(targets):
+        n_files += 1
+        findings.extend(check_file(f))
+    for path, lineno, check, msg in findings:
+        print(f"{path}:{lineno}: [{check}] {msg}")
+    if findings:
+        print(f"\nlint_observability: {len(findings)} finding(s) in "
+              f"{n_files} file(s)")
+        return 1
+    print(f"lint_observability: OK ({n_files} files clean)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Merge per-process chrome traces into one cross-process timeline.
+
+Each rank of a distributed job exports its own chrome://tracing JSON
+(`fluid.profiler.export_chrome_trace`), tagged with its real pid, a
+`ph:"M"` process_name record (role + rank) and a top-level ``ptMeta``
+object carrying the job trace id and the profiling session's wall-clock
+epoch.  This tool reads N such files and writes ONE trace:
+
+  - timestamps are re-based onto a common epoch using each file's
+    ``ptMeta.wall_t0`` (files without it keep their own zero — still
+    loadable, just not aligned);
+  - pid collisions (pid reuse across hosts/restarts) are remapped so
+    every input file keeps a distinct process lane;
+  - metadata records are preserved, so chrome://tracing / Perfetto shows
+    one named lane per role/rank.
+
+Usage:
+    python tools/merge_traces.py -o merged.json trace_a.json trace_b.json
+    python tools/merge_traces.py -o merged.json --dir /path/to/traces
+
+The merged file loads in chrome://tracing or https://ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_trace(path):
+    with open(path) as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise ValueError(f"{path}: not a chrome trace JSON "
+                         "(missing traceEvents)")
+    return data
+
+
+def merge(paths):
+    """Merge trace files -> one chrome-trace dict (pure; tests call it
+    directly)."""
+    traces = [(p, load_trace(p)) for p in paths]
+    if not traces:
+        raise ValueError("no trace files to merge")
+    walls = [t.get("ptMeta", {}).get("wall_t0") or 0.0 for _, t in traces]
+    anchors = [w for w in walls if w > 0]
+    global_t0 = min(anchors) if anchors else 0.0
+
+    merged = []
+    metas = []
+    used_pids: set[int] = set()
+    synth_pid = 1_000_000  # monotone allocator: can never revisit a value
+    for idx, ((path, data), wall) in enumerate(zip(traces, walls)):
+        meta = dict(data.get("ptMeta", {}))
+        meta["source"] = os.path.basename(path)
+        events = [dict(e) for e in data["traceEvents"]]
+        # one lane per input file: remap a colliding pid (recycled across
+        # hosts or restarts) to a synthetic one, consistently across the
+        # file's events
+        pids = {e.get("pid", 0) for e in events}
+        remap = {}
+        for pid in sorted(pids):
+            new = pid
+            while new in used_pids:
+                new = synth_pid
+                synth_pid += 1
+            used_pids.add(new)
+            if new != pid:
+                remap[pid] = new
+        offset_us = (wall - global_t0) * 1e6 if wall > 0 else 0.0
+        for e in events:
+            if remap:
+                e["pid"] = remap.get(e.get("pid", 0), e.get("pid", 0))
+            if e.get("ph") != "M" and "ts" in e:
+                e["ts"] = e["ts"] + offset_us
+        if remap:
+            meta["pid_remap"] = {str(k): v for k, v in remap.items()}
+        merged.extend(events)
+        metas.append(meta)
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "ptMergedFrom": metas}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Merge per-rank chrome traces into one timeline.")
+    ap.add_argument("-o", "--output", default="merged_trace.json")
+    ap.add_argument("--dir", default="",
+                    help="merge every *.json under this directory")
+    ap.add_argument("traces", nargs="*", help="trace files to merge")
+    args = ap.parse_args(argv)
+    paths = list(args.traces)
+    if args.dir:
+        paths.extend(sorted(glob.glob(os.path.join(args.dir, "*.json"))))
+    paths = [p for p in dict.fromkeys(paths)
+             if os.path.abspath(p) != os.path.abspath(args.output)]
+    if not paths:
+        ap.error("no input traces (pass files or --dir)")
+    out = merge(paths)
+    with open(args.output, "w") as fh:
+        json.dump(out, fh)
+    n_spans = sum(1 for e in out["traceEvents"] if e.get("ph") == "X")
+    pids = {e.get("pid") for e in out["traceEvents"]}
+    print(f"{args.output}: {len(paths)} trace(s), {n_spans} spans, "
+          f"{len(pids)} process lane(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

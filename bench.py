@@ -21,7 +21,10 @@ cast-insertion AMP rewrite; PT_BENCH_FLASH=1 → Pallas flash-attention path
 (attention-probs dropout off, the usual flash trade); PT_BENCH_QUANTAR=1 →
 data-parallel rung with the EQuARX-style quantized gradient all-reduce
 (bucketed block-scaled int8 collectives; records bytes-accessed from the
-executable's cost_analysis); PT_BENCH_STEPS, PT_BENCH_BATCH,
+executable's cost_analysis, both algorithms' modeled wire bytes
+(oneshot vs ppermute ring — pin one with FLAGS_quant_allreduce_algo),
+step-time p50/p95/max quantiles, and a rung-end /metricsz scrape of the
+pt_collective_* families); PT_BENCH_STEPS, PT_BENCH_BATCH,
 PT_BENCH_SEQLEN, BENCH_BASELINE.
 """
 
@@ -681,6 +684,13 @@ def measure(size):
     quantar_tok = ""
     if quantar:
         quantar_tok = f" quantar-dp{n_dev}"
+        from paddle_tpu.fluid import flags as _flags
+
+        qalgo = _flags.flag("quant_allreduce_algo")
+        if qalgo != "auto":
+            # pinned-algorithm A/B leg: a shape token so a ring capture
+            # can never alias an auto/oneshot record of the same shape
+            quantar_tok += f" qar-{qalgo}"
         if os.environ.get("PT_BENCH_SYNC_FETCH") != "1":
             quantar_tok += " syncfetch"  # else _cpu_suffix adds it
     config = (f"bert-{size} b{batch} s{seq_len}"
@@ -706,6 +716,24 @@ def measure(size):
         except Exception as e:  # cost model unavailable on this backend
             print(f"bench: quantar cost_analysis unavailable ({e})",
                   file=sys.stderr)
+        # modeled wire bytes for BOTH algorithms beside the one that ran
+        # (wire_bytes(algo=...) over the transpiler's bucket plan), so the
+        # record shows the ring-vs-oneshot byte delta without a re-run
+        plan = getattr(main_prog, "_quant_allreduce_plan", None)
+        if plan and plan.get("buckets"):
+            from paddle_tpu.kernels import quantized_collectives as qc
+
+            bs = plan["block_size"]
+            rec["quant_wire_bytes"] = {
+                algo: sum(qc.wire_bytes(b["elements"], block_size=bs,
+                                        n_devices=n_dev, algo=algo)
+                          for b in plan["buckets"])
+                for algo in ("oneshot", "ring")
+            }
+            rec["quant_wire_bytes"]["selected"] = [
+                b["algo"] for b in plan["buckets"]]
+            rec["quant_wire_bytes"]["algo_flag"] = plan["algo"]
+            rec["quant_wire_bytes"]["crossover_kb"] = plan["crossover_kb"]
     return rec
 
 
@@ -851,9 +879,70 @@ def _metrics_summary():
             vals = sum_family(fam)
             if vals:
                 summary[rec_key] = vals
+        # histogram-quantile summaries (ROADMAP telemetry phase-2): the
+        # step-time DISTRIBUTION rides in every record, not just the sum —
+        # p50/p95/max per execution path, PromQL histogram_quantile
+        # semantics (obs.hist_quantile)
+        steps = snap.get("pt_step_seconds")
+        if steps and steps.get("type") == "histogram":
+            quants = {}
+            for key, h in steps["samples"].items():
+                label = ",".join(key) if key else "total"
+                quants[label] = {
+                    "p50": _rq(obs.hist_quantile(h, 0.50)),
+                    "p95": _rq(obs.hist_quantile(h, 0.95)),
+                    "max": _rq(obs.hist_quantile(h, 1.0)),
+                    "count": h["count"],
+                }
+            if quants:
+                summary["step_seconds_quantiles"] = quants
         return summary
     except Exception as e:  # telemetry must never fail the bench
         print(f"bench: metrics summary unavailable ({e})", file=sys.stderr)
+        return {}
+
+
+def _rq(v):
+    return None if v is None else round(float(v), 6)
+
+
+def _scrape_collective_metrics():
+    """Scrape THIS process's /metricsz for the pt_collective_* families
+    and return them parsed (ROADMAP telemetry phase-2: bench rungs embed
+    the scrape in their record).  Goes through the real HTTP endpoint +
+    the strict text parser — the record then proves the exposition path
+    end-to-end, not just the in-process registry.  Uses the flag-started
+    server when one is up (FLAGS_metrics_port), else binds an ephemeral
+    one for the scrape and tears it down."""
+    try:
+        from urllib.request import urlopen
+
+        from paddle_tpu import observability as obs
+        from paddle_tpu.observability import exposition as expo
+
+        server = expo.active_server() or expo.ensure_from_flags()
+        ephemeral = None
+        if server is None:
+            ephemeral = server = obs.MetricsServer(port=0)
+        try:
+            text = urlopen(
+                f"http://{server.host}:{server.port}/metricsz",
+                timeout=10).read().decode()
+        finally:
+            if ephemeral is not None:
+                ephemeral.stop()
+        out = {}
+        for name, fam in obs.parse_text(text).items():
+            if not name.startswith("pt_collective"):
+                continue
+            samples = {}
+            for labels, value in fam["samples"]:
+                key = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+                samples[key or "total"] = value
+            out[name] = samples
+        return out
+    except Exception as e:  # telemetry must never fail the bench
+        print(f"bench: /metricsz scrape unavailable ({e})", file=sys.stderr)
         return {}
 
 
@@ -861,6 +950,12 @@ def main():
     if os.environ.get("PT_BENCH_CHILD"):
         rec = measure(os.environ["PT_BENCH_CHILD"])
         rec.setdefault("metrics", _metrics_summary())
+        # rung-end /metricsz scrape: the pt_collective_* gauges as served
+        # over HTTP (empty unless a collective path ran — only then does
+        # the record carry it)
+        scraped = _scrape_collective_metrics()
+        if scraped:
+            rec.setdefault("metricsz_collectives", scraped)
         print(json.dumps(rec), flush=True)
         return
 

@@ -55,6 +55,10 @@ class BuildStrategy:
         # None = defer to FLAGS_quant_allreduce; True/False pins it for the
         # runner built from this strategy (parallel/data_parallel.py)
         self.quant_allreduce = None
+        # collective algorithm for the quantized path: None = defer to
+        # FLAGS_quant_allreduce_algo; "auto"/"oneshot"/"ring" pins it
+        # (auto = size crossover, kernels.ring_collectives)
+        self.quant_allreduce_algo = None
 
 
 class ExecutionStrategy:

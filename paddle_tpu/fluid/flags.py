@@ -72,6 +72,21 @@ _DEFS = {
     # via DataParallelRunner(quant_grads=True).
     "FLAGS_quant_allreduce": (False, _parse_bool, True),
     "FLAGS_quant_allreduce_block_size": (256, int, True),
+    # quantized-all-reduce algorithm selection
+    # (kernels.ring_collectives.select_allreduce_algo): "oneshot" = the
+    # two-phase all_to_all/all_gather form (O(1) launches, full payload
+    # per phase), "ring" = the explicit ppermute ring with per-hop
+    # requantization (2*(n-1)/n of payload bytes, 2*(n-1) hops deep),
+    # "auto" = size crossover — tensors with at least
+    # FLAGS_quant_allreduce_crossover_kb KB of fp32 payload take the ring
+    "FLAGS_quant_allreduce_algo": ("auto", str, True),
+    "FLAGS_quant_allreduce_crossover_kb": (512, int, True),
+    # ZeRO-1 weight-update gather quantization (parallel/hybrid.py
+    # zero_gather_quant default): the dp-sharded parameter update
+    # re-replicates through a block-scaled int8 all-gather instead of the
+    # implicit fp32 one; optimizer-state shards never gather, so
+    # optimizer state stays fp32-exact regardless.  Off by default.
+    "FLAGS_zero_gather_quant": (False, _parse_bool, True),
     # fused-gradient bucket cap in MB (reference
     # FLAGS_fuse_parameter_memory_size analog): grads coalesce into
     # buckets up to this size so scale overhead and collective-launch

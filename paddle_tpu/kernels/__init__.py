@@ -3,10 +3,17 @@
 
 from .flash_attention import attention_reference, flash_attention  # noqa: F401
 from .quantized_collectives import (  # noqa: F401
-    dequantize_block_scaled, quantize_block_scaled, quantized_all_reduce,
+    dequantize_block_scaled, gather_wire_bytes, quantize_block_scaled,
+    quantized_all_reduce, wire_bytes,
 )
 from .ring_attention import ring_attention  # noqa: F401
+from .ring_collectives import (  # noqa: F401
+    adaptive_quantized_all_reduce, quantized_all_gather,
+    ring_quantized_all_reduce, select_allreduce_algo,
+)
 
 __all__ = ["flash_attention", "attention_reference", "ring_attention",
            "quantize_block_scaled", "dequantize_block_scaled",
-           "quantized_all_reduce"]
+           "quantized_all_reduce", "ring_quantized_all_reduce",
+           "quantized_all_gather", "adaptive_quantized_all_reduce",
+           "select_allreduce_algo", "wire_bytes", "gather_wire_bytes"]

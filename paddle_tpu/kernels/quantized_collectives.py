@@ -35,9 +35,12 @@ The backward rule is the straight-through estimator: the cotangent takes
 the exact fp32 ``lax.psum`` path (quantization is forward-only noise), so
 ``c_allreduce_quant`` differentiates exactly like ``c_allreduce_sum``.
 
-Out of scope for this phase (ROADMAP "EQuARX phase-2"): requantizing
-inside the scatter hops of a ring so every hop, not just the two phase
-boundaries, moves int8.
+This module is the ONE-SHOT form: two O(1)-launch phase boundaries, full
+payload on the wire at each.  Its phase-2 sibling —
+``kernels.ring_collectives`` — requantizes inside the hops of an explicit
+``lax.ppermute`` ring so EVERY hop moves int8 at 2*(n-1)/n of the
+payload bytes; ``ring_collectives.select_allreduce_algo`` picks between
+the two per tensor size, and :func:`wire_bytes` models both.
 """
 
 from __future__ import annotations
@@ -53,6 +56,7 @@ __all__ = [
     "dequantize_block_scaled",
     "quantized_all_reduce",
     "wire_bytes",
+    "gather_wire_bytes",
     "DEFAULT_BLOCK_SIZE",
 ]
 
@@ -60,25 +64,59 @@ DEFAULT_BLOCK_SIZE = 256
 
 
 def wire_bytes(n_elements, block_size=DEFAULT_BLOCK_SIZE, dual_int8=True,
-               n_devices=2):
+               n_devices=2, algo="oneshot"):
     """Per-device ICI payload of one quantized all-reduce of
     ``n_elements`` fp values — the standing collective-bytes metric the
     EQuARX bench rung captured as a one-off (pure python; used by the
     data-parallel transpiler to report
-    ``pt_collective_payload_bytes_total``).
+    ``pt_collective_payload_bytes_total`` and by the bench rung to record
+    both algorithms' bytes).
 
-    Both phase boundaries (scatter all_to_all, gather all_gather) move
-    the full padded tensor once: int8 hi (+ int8 residual when dual) plus
-    one fp32 scale per ``block_size`` block.  n_devices=1 is the exact
-    fallback — nothing crosses the wire.
+    ``algo="oneshot"``: both phase boundaries (scatter all_to_all, gather
+    all_gather) move the full padded tensor once — int8 hi (+ int8
+    residual when dual) plus one fp32 scale per ``block_size`` block.
+
+    ``algo="ring"`` (kernels.ring_collectives): each phase ships n-1
+    one-hop chunks of 1/n of the payload, so per-device bytes are
+    ``2*(n-1)/n`` of one quantized payload image — the large-tensor win
+    the size-adaptive selector exploits.
+
+    n_devices=1 is the exact fallback — nothing crosses the wire.
     """
     n = int(n_elements)
-    if n <= 0 or int(n_devices) <= 1:
+    d = int(n_devices)
+    if n <= 0 or d <= 1:
         return 0
-    padded = n + (-n) % (int(n_devices) * int(block_size))
+    padded = n + (-n) % (d * int(block_size))
     per_elem = 2 if dual_int8 else 1
     n_blocks = padded // int(block_size)
-    return 2 * (padded * per_elem + n_blocks * 4)
+    payload = padded * per_elem + n_blocks * 4
+    if algo == "oneshot":
+        return 2 * payload
+    if algo == "ring":
+        # padded is a multiple of d*block_size, so payload divides evenly
+        # into d per-hop chunks; 2 phases x (d-1) hops each
+        return 2 * (d - 1) * (payload // d)
+    raise ValueError(f"wire_bytes: unknown algo {algo!r} "
+                     f"(expected 'oneshot' or 'ring')")
+
+
+def gather_wire_bytes(n_elements, block_size=DEFAULT_BLOCK_SIZE,
+                      dual_int8=True, n_devices=2):
+    """Per-device ICI payload of one quantized all-gather where each
+    device contributes a shard of ``n_elements`` fp values (the ZeRO-1
+    weight-update gather of ``ring_collectives.quantized_all_gather``):
+    every device receives n-1 foreign quantized shard images — int8 hi
+    (+ lo when dual) plus one fp32 scale per block, shard padded to a
+    block multiple."""
+    n = int(n_elements)
+    d = int(n_devices)
+    if n <= 0 or d <= 1:
+        return 0
+    padded = n + (-n) % int(block_size)
+    per_elem = 2 if dual_int8 else 1
+    n_blocks = padded // int(block_size)
+    return (d - 1) * (padded * per_elem + n_blocks * 4)
 
 
 # int8 symmetric range: +-127 (never -128, keeping the scale symmetric —

@@ -1,0 +1,323 @@
+"""Ring-quantized collectives — int8 on EVERY ICI hop, not just the
+phase boundaries.
+
+`kernels.quantized_collectives` (EQuARX phase 1, arXiv:2506.17615) moves
+int8 across the two *phase boundaries* of the all-reduce (all_to_all
+scatter, all_gather) but the fabric still sees one monolithic exchange
+per phase.  This module is EQuARX phase 2 (ROADMAP comms lane): the
+all-reduce becomes an explicit ring on ``lax.ppermute`` —
+
+  reduce-scatter phase (n-1 hops): each device starts a partial sum for
+    the chunk its left neighbor will eventually own; at every hop the
+    carried partial is block-quantized, ppermuted one position clockwise
+    as int8 payload + per-block fp32 scales, dequantized by the receiver,
+    and ACCUMULATED IN FP32 with the receiver's own contribution before
+    being requantized for the next hop.  Every hop moves int8 on the
+    wire; every reduction happens in fp32.
+
+  all-gather phase (n-1 hops): the reduced chunk is quantized ONCE and
+    the same int8 image is forwarded around the ring, each device slotting
+    the received chunks into its output buffer, then dequantizing the
+    assembled tensor.  No requantization error accumulates in this phase.
+
+Per-device wire bytes are ``2*(n-1)/n`` of the quantized payload (each
+phase ships n-1 chunks of 1/n each) versus the one-shot form's two full
+payload images — but the ring is 2*(n-1) *sequential* hops deep, so its
+latency term grows with n while the one-shot form is O(1) collective
+launches.  ``select_allreduce_algo`` encodes that trade as the standing
+size-adaptive policy (``FLAGS_quant_allreduce_algo`` = ``auto`` picks the
+ring at/above ``FLAGS_quant_allreduce_crossover_kb`` of fp32 payload);
+``adaptive_quantized_all_reduce`` is the dispatch the ``c_allreduce_quant``
+lowering calls.
+
+``quantized_all_gather`` is the same wire format applied to the ZeRO-1
+(arXiv:2004.13336) weight-update gather: each device quantizes its dim-0
+shard, the int8 payload + scales ride ``lax.all_gather`` (XLA implements
+it as a ring, so every hop is int8), and the full tensor is dequantized
+on arrival.  `parallel/hybrid.py` opts parameters into it with
+``zero_gather_quant``; optimizer-state shards never gather at all, so
+optimizer state stays fp32-exact by construction.
+
+Numerics contract (shared with phase 1): dual-int8 wire format by
+default (hi + residual lo ≈ int16 grade), straight-through fp32
+``lax.psum`` VJP so gradients match ``c_allreduce_sum`` exactly, and a
+1-device axis is a bit-exact identity.  The ring's hops requantize
+*partial sums*, so its worst-case error grows with the hop count —
+still well under the 1e-2 acceptance bound for N(0,1) sums at dp=4.
+
+The hop loops are Python-unrolled (ring size is static under shard_map),
+like the EQuARX reference kernels: each hop is its own
+``collective-permute`` in the lowered HLO, which is also what lets the
+wire-bytes model be cross-checked instruction-by-instruction against the
+compiled executable (tests/test_ring_collectives.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .quantized_collectives import (DEFAULT_BLOCK_SIZE,
+                                    dequantize_block_scaled,
+                                    quantize_block_scaled,
+                                    quantized_all_reduce)
+
+__all__ = [
+    "ring_quantized_all_reduce",
+    "quantized_all_gather",
+    "adaptive_quantized_all_reduce",
+    "select_allreduce_algo",
+    "QUANT_ALLREDUCE_ALGOS",
+]
+
+QUANT_ALLREDUCE_ALGOS = ("auto", "oneshot", "ring")
+
+
+def select_allreduce_algo(n_elements, n_devices, algo=None,
+                          crossover_kb=None):
+    """Resolve the quantized-all-reduce algorithm for one tensor.
+
+    ``algo`` None/"auto" defers to ``FLAGS_quant_allreduce_algo``; a flag
+    of "auto" applies the size crossover: tensors whose fp32 payload is at
+    least ``crossover_kb`` KB (default ``FLAGS_quant_allreduce_crossover_kb``)
+    take the ring (per-device bytes 2*(n-1)/n of payload), smaller ones
+    keep the one-shot all_to_all/all_gather form (O(1) collective
+    launches — latency wins when the payload is small).  A 1-device axis
+    always resolves "oneshot" (both forms degenerate to the exact
+    identity there).
+    """
+    if algo in (None, "auto"):
+        from paddle_tpu.fluid import flags as _flags
+
+        algo = _flags.flag("quant_allreduce_algo")
+    if algo in ("oneshot", "ring"):
+        return algo
+    if algo != "auto":
+        raise ValueError(
+            f"quant_allreduce algo must be one of {QUANT_ALLREDUCE_ALGOS}, "
+            f"got {algo!r}")
+    if int(n_devices) <= 1:
+        return "oneshot"
+    if crossover_kb is None:
+        from paddle_tpu.fluid import flags as _flags
+
+        crossover_kb = _flags.flag("quant_allreduce_crossover_kb")
+    return ("ring" if int(n_elements) * 4 >= float(crossover_kb) * 1024.0
+            else "oneshot")
+
+
+def _ring_perm(n):
+    """Clockwise neighbor exchange: device j forwards to j+1 (mod n)."""
+    return [(j, (j + 1) % n) for j in range(n)]
+
+
+def _quantize_permute(x, axis_name, perm, block_size, dual_int8):
+    """One int8 hop: block-quantize ``x``, ppermute the int8 payload(s)
+    and the per-block scales one ring position, dequantize on arrival.
+    This is the ONLY place ring payload crosses the wire in the
+    reduce-scatter phase — everything on it is int8 + fp32 scales."""
+    q_hi, q_lo, scales = quantize_block_scaled(x, block_size,
+                                               dual_int8=dual_int8)
+    q_hi = lax.ppermute(q_hi, axis_name, perm)
+    if dual_int8:
+        q_lo = lax.ppermute(q_lo, axis_name, perm)
+    scales = lax.ppermute(scales, axis_name, perm)
+    return dequantize_block_scaled(q_hi, q_lo, scales, block_size)
+
+
+def _ring_reduce_scatter(shards, axis_name, n, block_size, dual_int8):
+    """Quantized ring reduce-scatter over ``shards`` [n, per_shard]
+    (per_shard a multiple of block_size).  Device i returns the fully
+    reduced chunk i in fp32.
+
+    Hop algebra: the partial that ENDS at device i starts at device i+1
+    (as its own chunk-i contribution) and makes n-1 clockwise hops, each
+    intermediate device folding in its own chunk-i shard in fp32 before
+    requantizing — so device i holds, at step t, the partial for chunk
+    (i - 1 - t) mod n and receives the one for (i - 2 - t) mod n."""
+    idx = lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    # the partial this device initiates: its own contribution to the chunk
+    # owned by the LEFT neighbor's final position
+    acc = lax.dynamic_index_in_dim(shards, (idx - 1) % n, axis=0,
+                                   keepdims=False)
+    for t in range(n - 1):
+        received = _quantize_permute(acc, axis_name, perm, block_size,
+                                     dual_int8)
+        own = lax.dynamic_index_in_dim(shards, (idx - 2 - t) % n, axis=0,
+                                       keepdims=False)
+        acc = received + own  # fp32 accumulate; requantized next hop
+    return acc  # == sum over devices of chunk idx
+
+
+def _ring_all_gather_quant(reduced, axis_name, n, block_size, dual_int8):
+    """Quantized ring all-gather of each device's reduced chunk
+    [per_shard] -> the full [n * per_shard] fp32 tensor.  The chunk is
+    quantized ONCE and the identical int8 image makes n-1 hops — int8 on
+    every hop, no error accumulation beyond the single requantization."""
+    idx = lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    q_hi, q_lo, scales = quantize_block_scaled(reduced, block_size,
+                                               dual_int8=dual_int8)
+    hi = lax.dynamic_update_index_in_dim(
+        jnp.zeros((n,) + q_hi.shape, jnp.int8), q_hi, idx, axis=0)
+    lo = None
+    if dual_int8:
+        lo = lax.dynamic_update_index_in_dim(
+            jnp.zeros((n,) + q_lo.shape, jnp.int8), q_lo, idx, axis=0)
+    sc = lax.dynamic_update_index_in_dim(
+        jnp.zeros((n,) + scales.shape, jnp.float32), scales, idx, axis=0)
+    cur_hi, cur_lo, cur_sc = q_hi, q_lo, scales
+    for t in range(n - 1):
+        cur_hi = lax.ppermute(cur_hi, axis_name, perm)
+        if dual_int8:
+            cur_lo = lax.ppermute(cur_lo, axis_name, perm)
+        cur_sc = lax.ppermute(cur_sc, axis_name, perm)
+        # after t+1 clockwise hops the resident chunk originated t+1
+        # positions counter-clockwise
+        src = (idx - 1 - t) % n
+        hi = lax.dynamic_update_index_in_dim(hi, cur_hi, src, axis=0)
+        if dual_int8:
+            lo = lax.dynamic_update_index_in_dim(lo, cur_lo, src, axis=0)
+        sc = lax.dynamic_update_index_in_dim(sc, cur_sc, src, axis=0)
+    return dequantize_block_scaled(
+        hi.reshape(-1), lo.reshape(-1) if dual_int8 else None,
+        sc.reshape(-1), block_size)
+
+
+def _ring_all_reduce_impl(x, axis_name, block_size, dual_int8):
+    n = lax.psum(1, axis_name)  # static axis size under shard_map
+    if n == 1:
+        # dp=1: the sum over one device is the identity — stay EXACT
+        return x
+    orig_shape, orig_dtype = jnp.shape(x), x.dtype
+    flat = jnp.ravel(x).astype(jnp.float32)
+    size = flat.size
+    pad = (-size) % (n * block_size)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shards = flat.reshape(n, -1)
+    reduced = _ring_reduce_scatter(shards, axis_name, n, block_size,
+                                   dual_int8)
+    out = _ring_all_gather_quant(reduced, axis_name, n, block_size,
+                                 dual_int8)
+    if pad:
+        out = out[:size]
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def ring_quantized_all_reduce(x, axis_name, block_size=DEFAULT_BLOCK_SIZE,
+                              dual_int8=True):
+    """Explicit-ring block-scaled int8 all-reduce-sum of ``x`` over mesh
+    axis ``axis_name`` — int8 + per-block fp32 scales on EVERY ppermute
+    hop, fp32 accumulation at every reduction point.  Must be called
+    under shard_map; exact identity when the axis has a single device."""
+    return _ring_all_reduce_impl(x, axis_name, block_size, dual_int8)
+
+
+def _ring_qar_fwd(x, axis_name, block_size, dual_int8):
+    return _ring_all_reduce_impl(x, axis_name, block_size, dual_int8), None
+
+
+def _ring_qar_bwd(axis_name, block_size, dual_int8, _res, g):
+    # straight-through: identical to quantized_all_reduce's backward —
+    # the cotangent takes the exact fp32 psum path (the global-loss
+    # convention tests/test_collective_grads.py pins), quantization noise
+    # is forward-only
+    return (lax.psum(g, axis_name),)
+
+
+ring_quantized_all_reduce.defvjp(_ring_qar_fwd, _ring_qar_bwd)
+
+
+def adaptive_quantized_all_reduce(x, axis_name,
+                                  block_size=DEFAULT_BLOCK_SIZE,
+                                  dual_int8=True, algo="auto",
+                                  crossover_kb=None):
+    """Size-adaptive quantized all-reduce: resolve the algorithm with
+    :func:`select_allreduce_algo` (static tensor size, static axis size)
+    and dispatch to the one-shot or the ring form.  This is what the
+    ``c_allreduce_quant`` lowering calls; both branches share the exact
+    dp=1 fallback and the straight-through psum VJP."""
+    n = lax.psum(1, axis_name)  # static under shard_map
+    if n == 1:
+        return quantized_all_reduce(x, axis_name, block_size, dual_int8)
+    size = int(np.prod(jnp.shape(x), dtype=np.int64)) if jnp.shape(x) else 1
+    resolved = select_allreduce_algo(size, n, algo=algo,
+                                     crossover_kb=crossover_kb)
+    if resolved == "ring":
+        return ring_quantized_all_reduce(x, axis_name, block_size,
+                                         dual_int8)
+    return quantized_all_reduce(x, axis_name, block_size, dual_int8)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 weight-update gather
+# ---------------------------------------------------------------------------
+
+
+def _quantized_all_gather_impl(x, axis_name, block_size, dual_int8):
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return x
+    orig_shape, orig_dtype = jnp.shape(x), x.dtype
+    flat = jnp.ravel(x).astype(jnp.float32)
+    size = flat.size
+    pad = (-size) % block_size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    q_hi, q_lo, scales = quantize_block_scaled(flat, block_size,
+                                               dual_int8=dual_int8)
+    # int8 payload + fp32 scales on the wire; XLA lowers all_gather as a
+    # ring, so every hop of the gather moves the quantized image
+    g_hi = lax.all_gather(q_hi, axis_name)
+    g_lo = lax.all_gather(q_lo, axis_name) if dual_int8 else None
+    g_sc = lax.all_gather(scales, axis_name)
+    parts = dequantize_block_scaled(
+        g_hi.reshape(-1), g_lo.reshape(-1) if dual_int8 else None,
+        g_sc.reshape(-1), block_size)
+    parts = parts.reshape(n, -1)
+    if pad:
+        parts = parts[:, :size]
+    full = parts.reshape((n * orig_shape[0],) + tuple(orig_shape[1:]))
+    return full.astype(orig_dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def quantized_all_gather(x, axis_name, block_size=DEFAULT_BLOCK_SIZE,
+                         dual_int8=True):
+    """Block-scaled int8 all-gather of each device's dim-0 shard ``x``
+    over ``axis_name`` -> the full (replicated) array, dim 0 grown by the
+    axis size.  The ZeRO-1 weight-update gather wire format
+    (`parallel/hybrid.py` ``zero_gather_quant``): one quantization on the
+    owning device, int8 + scales on the wire, dequantize on arrival.
+    Must be called under shard_map; exact identity on a 1-device axis."""
+    return _quantized_all_gather_impl(x, axis_name, block_size, dual_int8)
+
+
+def _qag_fwd(x, axis_name, block_size, dual_int8):
+    return _quantized_all_gather_impl(x, axis_name, block_size,
+                                      dual_int8), None
+
+
+def _qag_bwd(axis_name, block_size, dual_int8, _res, g):
+    # transpose of "replicate the concatenation of all shards" under the
+    # global-loss convention: sum every device's cotangent (exact fp32
+    # psum — straight-through, like the all-reduce), then take the slice
+    # this device contributed
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return (g,)
+    idx = lax.axis_index(axis_name)
+    rows = jnp.shape(g)[0] // n
+    gsum = lax.psum(g, axis_name)
+    return (lax.dynamic_slice_in_dim(gsum, idx * rows, rows, axis=0),)
+
+
+quantized_all_gather.defvjp(_qag_fwd, _qag_bwd)

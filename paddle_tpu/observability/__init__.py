@@ -27,14 +27,14 @@ from . import tracing  # noqa: F401
 from .exposition import (MetricsServer, ensure_from_flags, parse_text,
                          render_json, render_text)
 from .metrics import (DEFAULT_BUCKETS, REGISTRY, Counter, Gauge, Histogram,
-                      MetricsRegistry, counter, gauge, histogram, reset,
-                      snapshot)
+                      MetricsRegistry, counter, gauge, hist_quantile,
+                      histogram, reset, snapshot)
 from .tracing import job_trace_id, new_span_id, process_identity
 
 __all__ = [
     "metrics", "exposition", "events", "tracing",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-    "counter", "gauge", "histogram", "snapshot", "reset",
+    "counter", "gauge", "histogram", "snapshot", "reset", "hist_quantile",
     "DEFAULT_BUCKETS",
     "render_text", "render_json", "parse_text", "MetricsServer",
     "ensure_from_flags",

@@ -24,12 +24,13 @@ and `native`, which must stay importable without jax.
 
 from __future__ import annotations
 
+import math
 import threading
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "counter", "gauge", "histogram", "snapshot", "reset",
-    "DEFAULT_BUCKETS",
+    "hist_quantile", "DEFAULT_BUCKETS",
 ]
 
 # Prometheus client_golang defaults: spans 5 ms .. 10 s, the useful range
@@ -272,3 +273,34 @@ def snapshot():
 
 def reset():
     REGISTRY.reset()
+
+
+def hist_quantile(hist, q):
+    """Estimate the q-quantile of a histogram sample (the
+    ``hist_data()`` / ``snapshot()`` dict form: cumulative ``buckets``
+    [(le, cum)], ``count``) — PromQL ``histogram_quantile`` semantics:
+    linear interpolation inside the winning bucket (lower bound 0 for the
+    first), and the +Inf bucket reports the largest finite ``le`` (the
+    best bound a fixed-bucket histogram can give).  q=1.0 is the max
+    estimate; returns None on an empty histogram.
+
+    This is what puts p50/p95/max step-time summaries into BENCH_*.json
+    (bench.py metrics digest) instead of sums alone."""
+    if not 0.0 <= float(q) <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q!r}")
+    buckets = list(hist.get("buckets") or ())
+    count = hist.get("count") or 0
+    if not count or not buckets:
+        return None
+    rank = float(q) * count
+    prev_le, prev_cum = 0.0, 0
+    for le, cum in buckets:
+        if cum >= rank:
+            if math.isinf(le):
+                return prev_le  # observations beyond the last finite bound
+            if cum == prev_cum:  # q=0 with an empty leading bucket
+                return le
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = le, cum
+    return prev_le  # unreachable with well-formed cumulative buckets

@@ -74,23 +74,31 @@ register_op("c_allreduce_avg", ["X"], ["Out"], _c_allreduce(lambda x, ax: lax.pm
 @simple_op("c_allreduce_quant", ["X"], ["Out"])
 def _c_allreduce_quant(ctx, x, attrs):
     """Block-scaled int8 all-reduce-sum (EQuARX-style, arXiv:2506.17615):
-    int8 payload + per-block fp32 scales on the wire for both the scatter
-    and gather phases of the all-reduce — see
-    paddle_tpu.kernels.quantized_collectives.  Exact fp32 fallback outside
-    a mesh and when the axis has a single device; the backward rule is the
-    straight-through psum, so gradients match c_allreduce_sum exactly.
+    int8 payload + per-block fp32 scales on the wire — see
+    paddle_tpu.kernels.quantized_collectives (one-shot form) and
+    paddle_tpu.kernels.ring_collectives (explicit ppermute ring, int8 on
+    every hop).  Exact fp32 fallback outside a mesh and when the axis has
+    a single device; the backward rule is the straight-through psum, so
+    gradients match c_allreduce_sum exactly.
 
     attrs: block_size (default 256), quant_bits (16 = dual-int8 hi/lo
-    payload, the default; 8 = single int8, quarter bytes, ~1e-1 error)."""
+    payload, the default; 8 = single int8, quarter bytes, ~1e-1 error),
+    algo ("auto" = FLAGS_quant_allreduce_algo + size crossover, or pin
+    "oneshot"/"ring" — the DP transpiler stamps the resolved choice so
+    its wire-bytes accounting matches what actually lowers), crossover_kb
+    (override of FLAGS_quant_allreduce_crossover_kb for "auto")."""
     ax = _axis_for_ring(ctx, attrs)
     if ax is None:
         return x
     from paddle_tpu.kernels import quantized_collectives as qc
+    from paddle_tpu.kernels import ring_collectives as rc
 
-    return qc.quantized_all_reduce(
+    return rc.adaptive_quantized_all_reduce(
         x, ax,
         block_size=int(attrs.get("block_size", qc.DEFAULT_BLOCK_SIZE)),
-        dual_int8=int(attrs.get("quant_bits", 16)) != 8)
+        dual_int8=int(attrs.get("quant_bits", 16)) != 8,
+        algo=attrs.get("algo", "auto"),
+        crossover_kb=attrs.get("crossover_kb"))
 
 
 @simple_op("uncoalesce_tensor", ["X"], ["Out*"])

@@ -28,6 +28,20 @@ from . import mesh as pmesh
 __all__ = ["DataParallelRunner", "transpile_data_parallel"]
 
 
+def collective_payload_counter():
+    """The one schema for ``pt_collective_payload_bytes_total`` —
+    shared by the DP runner's per-step estimate and the hybrid runner's
+    ZeRO-gather booking, so the two call sites cannot drift into the
+    registry's re-registration conflict."""
+    from paddle_tpu import observability as obs
+
+    return obs.counter(
+        "pt_collective_payload_bytes_total",
+        "Estimated per-device ICI payload moved by gradient/BN "
+        "collectives (both phases counted; static shapes only)",
+        labels=("collective",))
+
+
 def _plan_quant_buckets(block, grads, prod_index, block_size, bucket_mb):
     """fuse_all_reduce_op_pass analog: group same-dtype grads into fused
     buckets (capped at ``bucket_mb`` MB) so one quantized collective per
@@ -74,7 +88,8 @@ def transpile_data_parallel(program, loss_name, num_devices,
                             gradient_scale="coeff_num_device",
                             sync_batch_norm_stats=True,
                             quant_grads=False, quant_block_size=None,
-                            quant_bucket_mb=None):
+                            quant_bucket_mb=None, quant_algo=None,
+                            quant_crossover_kb=None):
     """Rewrite `program` in place for data-parallel execution.
 
     Mirrors multi_devices_graph_pass: (1) the loss-gradient seed becomes
@@ -91,6 +106,16 @@ def transpile_data_parallel(program, loss_name, num_devices,
     sparsity the reference's SparseAllReduce relies on) and batch-norm
     running stats (small, fp32-averaged, quality-critical); both keep
     their exact collectives.
+
+    quant_algo / quant_crossover_kb (FLAGS_quant_allreduce_algo /
+    FLAGS_quant_allreduce_crossover_kb when None): each bucket's
+    collective algorithm is resolved HERE — at transpile time, per bucket
+    size, via kernels.ring_collectives.select_allreduce_algo — and
+    stamped onto the op's `algo` attr, so the lowering runs exactly what
+    the wire-bytes accounting (and the bench record) models.  "auto"
+    sends small buckets through the one-shot O(1)-launch form and large
+    ones through the ppermute ring (2*(n-1)/n of payload bytes, int8 on
+    every hop).
     """
     block = program.global_block()
     if loss_name is not None and gradient_scale == "coeff_num_device":
@@ -127,6 +152,10 @@ def transpile_data_parallel(program, loss_name, num_devices,
             quant_block_size = _flags.flag("quant_allreduce_block_size")
         if quant_bucket_mb is None:
             quant_bucket_mb = _flags.flag("fuse_grad_size_in_MB")
+        if quant_algo is None:
+            quant_algo = _flags.flag("quant_allreduce_algo")
+        if quant_crossover_kb is None:
+            quant_crossover_kb = _flags.flag("quant_allreduce_crossover_kb")
         prod_index = {}
         for i, op in enumerate(block.ops):
             for g in raw_grads.intersection(op.output_arg_names):
@@ -161,10 +190,20 @@ def transpile_data_parallel(program, loss_name, num_devices,
             return 0
         return int(np.prod(v.shape)) * _itemsize.get(v.dtype, 4)
 
+    quant_plan = {"block_size": int(quant_block_size or 0),
+                  "algo": quant_algo, "crossover_kb": quant_crossover_kb,
+                  "buckets": []}
+
     def _emit_bucket(b, out):
         from paddle_tpu.kernels import quantized_collectives as qc
+        from paddle_tpu.kernels.ring_collectives import select_allreduce_algo
 
         fused = b["fused"].name
+        n_elems = sum(int(np.prod(s)) for s in b["shapes"])
+        # resolve the algorithm NOW so the stamped attr, the wire-bytes
+        # metric, and the bench record all describe the same collective
+        algo = select_allreduce_algo(n_elems, num_devices, algo=quant_algo,
+                                     crossover_kb=quant_crossover_kb)
         out.append(Operator(
             block, "coalesce_tensor",
             inputs={"Input": list(b["grads"])},
@@ -175,15 +214,16 @@ def transpile_data_parallel(program, loss_name, num_devices,
             inputs={"X": [fused]}, outputs={"Out": [fused]},
             attrs={"ring_id": 0, "use_calc_stream": True,
                    "block_size": int(quant_block_size),
-                   "op_role": "backward"}))
+                   "algo": algo, "op_role": "backward"}))
         out.append(Operator(
             block, "uncoalesce_tensor",
             inputs={"X": [fused]}, outputs={"Out": list(b["grads"])},
             attrs={"shapes": [list(s) for s in b["shapes"]],
                    "op_role": "backward"}))
         collective_bytes["c_allreduce_quant"] += qc.wire_bytes(
-            sum(int(np.prod(s)) for s in b["shapes"]),
-            block_size=int(quant_block_size), n_devices=num_devices)
+            n_elems, block_size=int(quant_block_size),
+            n_devices=num_devices, algo=algo)
+        quant_plan["buckets"].append({"elements": n_elems, "algo": algo})
 
     new_ops = []
     pending = set(raw_grads)
@@ -217,6 +257,10 @@ def transpile_data_parallel(program, loss_name, num_devices,
     if num_devices <= 1:  # psum over one device moves nothing
         collective_bytes = {k: 0 for k in collective_bytes}
     program._collective_bytes_per_step = collective_bytes
+    # per-bucket algorithm/size report for the PT_BENCH_QUANTAR rung —
+    # lets the bench record BOTH algorithms' modeled bytes beside the one
+    # that actually ran
+    program._quant_allreduce_plan = quant_plan if quant_grads else None
     program._bump_version()
     return program
 
@@ -225,7 +269,7 @@ class DataParallelRunner:
     """Compiles + runs a data-parallel program over all local devices."""
 
     def __init__(self, program, loss_name, build_strategy=None, places=None,
-                 quant_grads=None):
+                 quant_grads=None, quant_algo=None):
         import jax
 
         n = len(places) if places else jax.device_count()
@@ -240,12 +284,18 @@ class DataParallelRunner:
 
             quant_grads = _flags.flag("quant_allreduce")
         self.quant_grads = bool(quant_grads)
+        # same layering for the algorithm choice; None defers all the way
+        # to FLAGS_quant_allreduce_algo inside the transpile
+        if quant_algo is None:
+            quant_algo = getattr(build_strategy, "quant_allreduce_algo",
+                                 None)
+        self.quant_algo = quant_algo
         # rewrite in place, like the reference's multi-device pass
         self.program = transpile_data_parallel(
             program, loss_name, n,
             sync_batch_norm_stats=(build_strategy is None
                                    or getattr(build_strategy, "sync_batch_norm", True) is not False),
-            quant_grads=self.quant_grads)
+            quant_grads=self.quant_grads, quant_algo=quant_algo)
         self._cache = {}
 
     def _cache_key(self, feed, fetch_names):
@@ -298,17 +348,12 @@ class DataParallelRunner:
         """Per-step throughput + collective-payload telemetry
         (docs/OBSERVABILITY.md): global examples ingested, last-step
         examples/sec, and the transpiler's per-step ICI byte estimate."""
-        from paddle_tpu import observability as obs
         from paddle_tpu.fluid.executor import _feed_batch, _report_examples
 
         _report_examples("dp", _feed_batch(feed), step_s)
         per_step = getattr(self.program, "_collective_bytes_per_step", None)
         if per_step:
-            fam = obs.counter(
-                "pt_collective_payload_bytes_total",
-                "Estimated per-device ICI payload moved by gradient/BN "
-                "collectives (both phases counted; static shapes only)",
-                labels=("collective",))
+            fam = collective_payload_counter()
             for coll, nbytes in per_step.items():
                 if nbytes:
                     fam.labels(collective=coll).inc(nbytes)

@@ -140,13 +140,25 @@ class HybridParallelRunner:
     """
 
     def __init__(self, program, mesh, rules: ShardingRule | None = None,
-                 feed_specs=None, scope=None, zero_stage=0):
+                 feed_specs=None, scope=None, zero_stage=0,
+                 zero_gather_quant=None):
         """zero_stage=1: shard optimizer-state vars (moment accumulators,
         tagged is_optimizer_state) over the 'dp' axis on dim 0 — the
         cross-replica weight-update sharding of arXiv:2004.13336 (ZeRO-1).
         XLA GSPMD then keeps each replica's accumulator shard resident and
         all-gathers the updated parameters, cutting optimizer-state memory
-        by the dp degree at the cost of one all-gather per step."""
+        by the dp degree at the cost of one all-gather per step.
+
+        zero_gather_quant (None = FLAGS_zero_gather_quant): with
+        zero_stage>=1, the weight-update all-gather of every ZeRO-eligible
+        parameter (replicated by the rules, dim 0 divisible by dp) moves a
+        block-scaled int8 wire format instead of fp32
+        (kernels.ring_collectives.quantized_all_gather): each dp shard
+        quantizes its slice of the updated parameter, int8 payload +
+        per-block fp32 scales ride the gather, and the full tensor
+        dequantizes on arrival — halving (dual-int8) the gather bytes the
+        ZeRO-1 trade costs.  Optimizer-state shards never gather at all,
+        so optimizer state stays fp32-exact regardless of this knob."""
         self.program = program
         self.mesh = mesh
         self.rules = rules or ShardingRule([])
@@ -156,6 +168,11 @@ class HybridParallelRunner:
         self._ran_keys = set()  # signatures that executed at least once
         self._step = 0
         self.zero_stage = int(zero_stage)
+        if zero_gather_quant is None:
+            from paddle_tpu.fluid import flags as _flags
+
+            zero_gather_quant = _flags.flag("zero_gather_quant")
+        self.zero_gather_quant = bool(zero_gather_quant)
         # capture_hlo=True records the OPTIMIZED (post-GSPMD-partitioner)
         # HLO of the first compiled step in .last_hlo so callers can assert
         # which collectives XLA inserted (the dryrun/driver check does).
@@ -186,6 +203,81 @@ class HybridParallelRunner:
         if v is None or not getattr(v, "is_optimizer_state", False):
             return None
         return (pmesh.DATA_AXIS,) + (None,) * (len(shape) - 1)
+
+    def _zero_gather_params(self, scope, donated_names):
+        """Parameters whose weight-update gather takes the quantized wire
+        format (zero_gather_quant): trainable Parameters left replicated
+        by the rules with dim 0 divisible by dp — the same eligibility
+        gate `_zero1_spec` applies to their optimizer state.  Optimizer
+        state itself is never in this set: its shards stay resident and
+        fp32-exact.  Parameters whose per-device shard is smaller than
+        one quantization block also stay fp32: block padding + scales
+        would move MORE bytes than the fp32 gather they replace (the same
+        size-adaptivity the all-reduce crossover applies)."""
+        from paddle_tpu.fluid import flags as _flags
+        from paddle_tpu.fluid.framework import Parameter
+
+        if (not self.zero_gather_quant or self.zero_stage < 1
+                or pmesh.DATA_AXIS not in self.mesh.axis_names):
+            return {}
+        dp = self.mesh.shape[pmesh.DATA_AXIS]
+        if dp <= 1:
+            return {}
+        block = int(_flags.flag("quant_allreduce_block_size"))
+        out = {}
+        for name in donated_names:
+            v = self.program.global_block()._find_var_recursive(name)
+            if not isinstance(v, Parameter):
+                continue
+            val = scope.get(name)
+            shape = tuple(np.shape(val)) if val is not None else None
+            if not shape or shape[0] % dp != 0:
+                continue
+            if int(np.prod(shape)) // dp < block:
+                continue  # sub-block shard: fp32 gather is cheaper
+            if any(self.rules.spec_for(name, shape=shape, mesh=self.mesh)):
+                continue  # mp/ep-sharded params: GSPMD owns their layout
+            out[name] = shape
+        return out
+
+    def _wrap_zero_gather(self, inner, zgq_params):
+        """Wrap a compiled step body so every ZeRO-gather-eligible
+        parameter write re-replicates through the block-scaled int8
+        all-gather: the nested shard_map's in_spec pins the updated
+        parameter dp-sharded on dim 0 (which is how the ZeRO-sharded
+        optimizer state computes it anyway), the int8 payload + scales
+        ride the gather, and the out_spec hands the replicated fp32
+        tensor back to GSPMD.  Returns (wrapped_body, modeled per-step
+        wire bytes)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.fluid import flags as _flags
+        from paddle_tpu.kernels import quantized_collectives as qc
+        from paddle_tpu.kernels import ring_collectives as rcol
+
+        axis = pmesh.DATA_AXIS
+        dp = self.mesh.shape[axis]
+        block = int(_flags.flag("quant_allreduce_block_size"))
+        gathers, total = {}, 0
+        for name, shape in zgq_params.items():
+            in_spec = P(*((axis,) + (None,) * (len(shape) - 1)))
+            gathers[name] = jax.shard_map(
+                lambda s: rcol.quantized_all_gather(s, axis, block),
+                mesh=self.mesh, in_specs=in_spec,
+                out_specs=P(*((None,) * len(shape))), check_vma=False)
+            total += qc.gather_wire_bytes(
+                int(np.prod(shape)) // dp, block_size=block, n_devices=dp)
+
+        def body(donated, readonly, feeds, step):
+            fetches, out_writes = inner(donated, readonly, feeds, step)
+            out_writes = dict(out_writes)
+            for name, fn in gathers.items():
+                if name in out_writes:
+                    out_writes[name] = fn(out_writes[name])
+            return fetches, out_writes
+
+        return body, total
 
     def _resolve_scope(self, scope):
         if scope is not None:
@@ -234,6 +326,12 @@ class HybridParallelRunner:
         fetches = cb(scope, feed, self._step)
         step_s = _time.perf_counter() - t0
         _record_step("hybrid", step_s, first_run)
+        zgq_bytes = getattr(cb, "_zgq_bytes_per_step", 0)
+        if zgq_bytes:
+            from .data_parallel import collective_payload_counter
+
+            collective_payload_counter().labels(
+                collective="zero_gather_quant").inc(zgq_bytes * n_steps)
         self._ran_keys.add(key)
         # stacked_feed: the leading feed axis is the step index, not batch
         batch = 0 if stacked_feed else _feed_batch(feed) * n_steps
@@ -295,6 +393,13 @@ class HybridParallelRunner:
                 f"({[op.type for op in plan.host_ops]}) need the host "
                 "between steps — use run() per step")
         inner_body = plan.make_body()
+        zgq_bytes = 0
+        zgq = self._zero_gather_params(scope, plan.donated_names)
+        if zgq:
+            # wrap BEFORE the chain wrap so every chained iteration's
+            # parameter writes re-replicate through the quantized gather
+            # (they feed the next iteration)
+            inner_body, zgq_bytes = self._wrap_zero_gather(inner_body, zgq)
 
         if chain_mode:
             import jax.numpy as jnp
@@ -403,4 +508,7 @@ class HybridParallelRunner:
             plan.run_host_ops(scope_)
             return plan.assemble_fetches(fetches, scope_)
 
+        # modeled ZeRO-gather wire bytes ride on the compiled closure so
+        # _dispatch can book them per executed step
+        compiled._zgq_bytes_per_step = zgq_bytes
         return compiled

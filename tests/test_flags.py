@@ -113,6 +113,46 @@ def test_observability_flags_roundtrip(monkeypatch):
     importlib.reload(fl)  # restore defaults for other tests
 
 
+def test_quant_allreduce_algo_flags_roundtrip(monkeypatch):
+    """The size-adaptive collective-selection flags register with their
+    documented defaults (auto, 512 KB crossover, ZeRO gather quant off)
+    and round-trip through env bootstrap and get/set like every other
+    flag (ISSUE 5 satellite)."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("quant_allreduce_algo")[
+        "quant_allreduce_algo"] == "auto"
+    assert fl.get_flags("quant_allreduce_crossover_kb")[
+        "quant_allreduce_crossover_kb"] == 512
+    assert fl.get_flags("zero_gather_quant")["zero_gather_quant"] is False
+    try:
+        fl.set_flags({"FLAGS_quant_allreduce_algo": "ring",
+                      "quant_allreduce_crossover_kb": "128",  # str parses
+                      "FLAGS_zero_gather_quant": True})
+        assert fl.get_flags(["quant_allreduce_algo",
+                             "quant_allreduce_crossover_kb",
+                             "zero_gather_quant"]) == {
+            "quant_allreduce_algo": "ring",
+            "quant_allreduce_crossover_kb": 128,
+            "zero_gather_quant": True}
+    finally:
+        fl.set_flags({"FLAGS_quant_allreduce_algo": "auto",
+                      "FLAGS_quant_allreduce_crossover_kb": 512,
+                      "FLAGS_zero_gather_quant": False})
+    monkeypatch.setenv("FLAGS_quant_allreduce_algo", "oneshot")
+    monkeypatch.setenv("FLAGS_quant_allreduce_crossover_kb", "64")
+    importlib.reload(fl)
+    assert fl.get_flags("quant_allreduce_algo")[
+        "quant_allreduce_algo"] == "oneshot"
+    assert fl.get_flags("quant_allreduce_crossover_kb")[
+        "quant_allreduce_crossover_kb"] == 64
+    monkeypatch.delenv("FLAGS_quant_allreduce_algo")
+    monkeypatch.delenv("FLAGS_quant_allreduce_crossover_kb")
+    importlib.reload(fl)  # restore defaults for other tests
+
+
 def test_malformed_env_flag_warns_not_crashes(monkeypatch):
     import importlib
     import warnings as w

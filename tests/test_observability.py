@@ -83,6 +83,33 @@ def test_histogram_bucket_boundaries():
     assert data["count"] == 6
 
 
+def test_hist_quantile():
+    """PromQL histogram_quantile semantics over hist_data(): linear
+    interpolation inside the winning bucket, lower bound 0 for the first,
+    the +Inf bucket clamped to the largest finite le, None on empty —
+    what puts p50/p95/max step-time summaries in BENCH_*.json."""
+    reg = metrics.MetricsRegistry()
+    h = reg.histogram("hq_seconds", "h", buckets=(0.1, 1.0, 10.0))
+    # empty histogram: no estimate
+    assert metrics.hist_quantile(h._default_child().hist_data(), 0.5) is None
+    for v in (0.05, 0.05, 0.5, 0.5, 0.5, 0.5, 5.0, 5.0, 5.0, 100.0):
+        h.observe(v)
+    data = h._default_child().hist_data()
+    # p50: rank 5 of 10 -> bucket (0.1, 1.0] with cum 2..6: 0.1 + 0.9*3/4
+    assert metrics.hist_quantile(data, 0.5) == pytest.approx(0.775)
+    # p90: rank 9 -> bucket (1.0, 10.0] cum 6..9: 1.0 + 9.0 * 3/3
+    assert metrics.hist_quantile(data, 0.9) == pytest.approx(10.0)
+    # max (q=1): rank 10 lands in +Inf -> clamp to the last finite le
+    assert metrics.hist_quantile(data, 1.0) == pytest.approx(10.0)
+    # q=0: the distribution's lower edge
+    assert metrics.hist_quantile(data, 0.0) == pytest.approx(0.0)
+    with pytest.raises(ValueError):
+        metrics.hist_quantile(data, 1.5)
+    # exported on the package root (bench.py reaches it as
+    # obs.hist_quantile)
+    assert obs.hist_quantile is metrics.hist_quantile
+
+
 def test_registry_thread_safety_smoke():
     reg = metrics.MetricsRegistry()
     c = reg.counter("t_total", labels=("w",))

@@ -1,0 +1,568 @@
+"""Ring-quantized collectives (EQuARX phase 2): the explicit ppermute
+ring with per-hop requantization, the size-adaptive algorithm selector,
+the quantized ZeRO-1 weight-update gather kernel, and the wire-bytes
+model cross-checked instruction-by-instruction against the compiled
+executable on the CPU mesh.
+
+Acceptance contract (ISSUE 5): the ring matches `lax.psum` within the
+dual-int8 bound (<= 1e-2 max abs on N(0,1) sums at dp=4) across axis
+sizes 1/2/4 including a non-divisible payload; gradients keep the
+straight-through psum convention of tests/test_collective_grads.py;
+`wire_bytes(algo=...)` is within 10% of the bytes the compiled
+executable's collective instructions actually move for BOTH algorithms;
+and a 20-step DP convergence smoke passes with `algo=ring`.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import registry
+from paddle_tpu.fluid.executor import trace_block
+from paddle_tpu.kernels import quantized_collectives as qc
+from paddle_tpu.kernels import ring_collectives as rc
+from paddle_tpu.parallel import mesh as pmesh
+from paddle_tpu.parallel.data_parallel import transpile_data_parallel
+
+
+def _mesh(n):
+    return pmesh.build_mesh({"dp": n}, devices=jax.devices()[:n])
+
+
+def _shard_run(fn, data, n, out_specs=None):
+    """jit(shard_map(fn)) over a dp mesh of n devices, data sharded on
+    dim 0 (tests/test_quant_allreduce.py idiom)."""
+    f = jax.jit(jax.shard_map(fn, mesh=_mesh(n), in_specs=P("dp"),
+                              out_specs=out_specs or P("dp"),
+                              check_vma=False))
+    return np.asarray(f(data))
+
+
+# ---------------------------------------------------------------------------
+# ring all-reduce numerics
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4])
+def test_ring_matches_psum_across_axis_sizes(n_dev):
+    """Ring vs exact lax.psum at axis sizes 1/2/4 on a NON-divisible
+    payload (13*7 = 91 elements per device, block 64 — exercises the
+    pad-to-n*block path): dual-int8 error within the acceptance bound,
+    dp=1 bit-exact."""
+    rng = np.random.RandomState(0)
+    data = rng.randn(n_dev * 13, 7).astype("float32")
+    got = _shard_run(lambda x: rc.ring_quantized_all_reduce(x, "dp", 64),
+                     data, n_dev)
+    want = _shard_run(lambda x: lax.psum(x, "dp"), data, n_dev)
+    err = np.abs(got - want).max()
+    if n_dev == 1:
+        np.testing.assert_array_equal(got, want)  # exact identity
+    else:
+        assert 0.0 < err <= 1e-2, err  # quantized, within bound
+
+
+def test_ring_acceptance_bound_dp4():
+    """The headline acceptance gate: N(0,1) gradients, block 256, dp=4 —
+    max abs error vs the exact fp32 sum <= 1e-2 even though every one of
+    the 2*(n-1) hops requantizes."""
+    n_dev = 4
+    rng = np.random.RandomState(1)
+    data = rng.randn(n_dev * 512, 16).astype("float32")
+    got = _shard_run(lambda x: rc.ring_quantized_all_reduce(x, "dp", 256),
+                     data, n_dev)
+    want = _shard_run(lambda x: lax.psum(x, "dp"), data, n_dev)
+    err = np.abs(got - want).max()
+    assert 0.0 < err <= 1e-2, err
+
+
+def test_ring_dual_vs_single_int8_error_bounds():
+    """The aggressive single-int8 wire format trades bytes for error: its
+    ring error must stay bounded (~1e-1 grade on N(0,1) dp=4 sums) but is
+    strictly worse than dual-int8 — per-hop requantization compounds the
+    coarser residual."""
+    n_dev = 4
+    rng = np.random.RandomState(2)
+    data = rng.randn(n_dev * 256, 8).astype("float32")
+    want = _shard_run(lambda x: lax.psum(x, "dp"), data, n_dev)
+    dual = _shard_run(
+        lambda x: rc.ring_quantized_all_reduce(x, "dp", 256, True),
+        data, n_dev)
+    single = _shard_run(
+        lambda x: rc.ring_quantized_all_reduce(x, "dp", 256, False),
+        data, n_dev)
+    dual_err = np.abs(dual - want).max()
+    single_err = np.abs(single - want).max()
+    assert dual_err <= 1e-2, dual_err
+    assert single_err <= 0.5, single_err
+    assert single_err > dual_err, (single_err, dual_err)
+
+
+def test_ring_grad_matches_psum_convention():
+    """Program-level gradient through `c_allreduce_quant` with algo=ring
+    equals jax.grad of the exact psum oracle under the global-loss
+    convention (tests/test_collective_grads.py): the VJP is the
+    straight-through fp32 psum, so quantization never touches the
+    cotangent."""
+    n_dev = 4
+    data = np.random.RandomState(3).randn(n_dev * 16, 8).astype("float32")
+    mesh = _mesh(n_dev)
+
+    main = fluid.Program()
+    with fluid.program_guard(main), fluid.unique_name.guard():
+        x = fluid.data("x", [n_dev * 16, 8], False, dtype="float32")
+        x.stop_gradient = False
+        block = main.global_block()
+        y = block.create_var(name="ring_out", dtype="float32")
+        block.append_op("c_allreduce_quant", inputs={"X": [x]},
+                        outputs={"Out": [y]},
+                        attrs={"ring_id": 0, "algo": "ring",
+                               "block_size": 64})
+        loss = fluid.layers.reduce_sum(y)
+        (gx,) = fluid.gradients(loss, [x])
+
+    def prog_grad(xs):
+        env = {"x": xs}
+        ctx = registry.LowerContext(mesh_axes=("dp",), block=block)
+        trace_block(block, env, ctx)
+        return env[gx.name]
+
+    got = np.asarray(jax.jit(jax.shard_map(
+        prog_grad, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False))(data))
+
+    def global_loss(xg):
+        part = jax.shard_map(
+            lambda xs: jnp.sum(lax.psum(xs, "dp"))[None], mesh=mesh,
+            in_specs=P("dp"), out_specs=P("dp"), check_vma=False)(xg)
+        return jnp.sum(part)
+
+    want = np.asarray(jax.grad(global_loss)(jnp.asarray(data)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# quantized ZeRO-1 gather kernel
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_all_gather_roundtrip_and_grad():
+    """Each device's dim-0 shard quantizes once, rides the gather int8,
+    and dequantizes into the full replicated tensor — error bounded by a
+    single dual-int8 quantization; the VJP is the exact psum-and-slice
+    transpose (the cotangent each shard contributed)."""
+    n_dev = 4
+    rng = np.random.RandomState(4)
+    data = rng.randn(n_dev * 5, 9).astype("float32")  # 45 elems: padded
+    got = _shard_run(lambda x: rc.quantized_all_gather(x, "dp", 64),
+                     data, n_dev, out_specs=P(None, None))
+    # one quantization's error bound: block_max / 64516 per element
+    bound = np.abs(data).max() / 64516.0 * 1.01 + 1e-8
+    assert got.shape == data.shape
+    assert 0.0 < np.abs(got - data).max() <= bound
+
+    mesh = _mesh(n_dev)
+
+    def global_loss(xg):
+        part = jax.shard_map(
+            lambda s: jnp.sum(rc.quantized_all_gather(s, "dp", 64))[None],
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+            check_vma=False)(xg)
+        return jnp.sum(part)
+
+    g = np.asarray(jax.grad(global_loss)(jnp.asarray(data)))
+    # every device's local loss counts the full gathered tensor, so each
+    # shard's cotangent is n_dev * ones — identical to the exact
+    # lax.all_gather oracle's gradient
+    np.testing.assert_allclose(g, n_dev * np.ones_like(data), rtol=1e-6)
+
+
+def test_quantized_all_gather_dp1_exact():
+    rng = np.random.RandomState(5)
+    data = rng.randn(6, 3).astype("float32")
+    got = _shard_run(lambda x: rc.quantized_all_gather(x, "dp"),
+                     data, 1, out_specs=P(None, None))
+    np.testing.assert_array_equal(got, data)
+
+
+# ---------------------------------------------------------------------------
+# size-adaptive selection
+# ---------------------------------------------------------------------------
+
+
+def test_select_allreduce_algo():
+    """Explicit algo wins; "auto" applies the fp32-payload crossover;
+    1-device axes always resolve oneshot; junk raises."""
+    sel = rc.select_allreduce_algo
+    assert sel(10 ** 9, 4, algo="oneshot") == "oneshot"
+    assert sel(1, 4, algo="ring") == "ring"
+    # crossover at 1 KB = 256 fp32 elements
+    assert sel(255, 4, algo="auto", crossover_kb=1) == "oneshot"
+    assert sel(256, 4, algo="auto", crossover_kb=1) == "ring"
+    assert sel(10 ** 9, 1, algo="auto", crossover_kb=1) == "oneshot"
+    with pytest.raises(ValueError, match="algo"):
+        sel(1, 4, algo="bogus")
+    # None / "auto" defer to the flag
+    fluid.set_flags({"FLAGS_quant_allreduce_algo": "ring"})
+    try:
+        assert sel(1, 4) == "ring"
+        assert sel(1, 4, algo="auto") == "ring"
+    finally:
+        fluid.set_flags({"FLAGS_quant_allreduce_algo": "auto"})
+    # flag "auto" reads the crossover flag
+    fluid.set_flags({"FLAGS_quant_allreduce_crossover_kb": 1})
+    try:
+        assert sel(255, 4) == "oneshot"
+        assert sel(256, 4) == "ring"
+    finally:
+        fluid.set_flags({"FLAGS_quant_allreduce_crossover_kb": 512})
+
+
+# ---------------------------------------------------------------------------
+# wire-bytes model
+# ---------------------------------------------------------------------------
+
+
+def test_wire_bytes_algo_parameter():
+    """oneshot keeps the phase-1 formula (2 full payload images); ring is
+    exactly (n-1)/n of it; dp=1 moves nothing; junk algo raises."""
+    n, bs, d = 100_000, 256, 4
+    padded = n + (-n) % (d * bs)
+    payload = padded * 2 + (padded // bs) * 4
+    assert qc.wire_bytes(n, n_devices=d) == 2 * payload  # default=oneshot
+    assert qc.wire_bytes(n, n_devices=d, algo="oneshot") == 2 * payload
+    ring = qc.wire_bytes(n, n_devices=d, algo="ring")
+    assert ring == 2 * (d - 1) * (payload // d)
+    assert ring < qc.wire_bytes(n, n_devices=d, algo="oneshot")
+    assert qc.wire_bytes(n, n_devices=1, algo="ring") == 0
+    assert qc.wire_bytes(0, n_devices=d, algo="ring") == 0
+    with pytest.raises(ValueError, match="algo"):
+        qc.wire_bytes(n, n_devices=d, algo="bogus")
+    # the ZeRO gather: n-1 foreign quantized shard images per device
+    g = qc.gather_wire_bytes(n, block_size=bs, n_devices=d)
+    gp = n + (-n) % bs
+    assert g == (d - 1) * (gp * 2 + (gp // bs) * 4)
+    assert qc.gather_wire_bytes(n, n_devices=1) == 0
+
+
+_HLO_ITEMSIZE = {"s8": 1, "u8": 1, "pred": 1, "bf16": 2, "f16": 2, "s16": 2,
+                 "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8}
+
+
+def _hlo_collective_bytes(hlo):
+    """Sum the output bytes of every cross-device collective instruction
+    in an optimized (per-device SPMD) HLO module — the wire payloads the
+    executable actually moves.  all-to-all tuples and all-gather outputs
+    count the full tensor image (matching wire_bytes' oneshot
+    accounting); each unrolled collective-permute counts its one-hop
+    chunk."""
+    def shape_bytes(tok):
+        m = re.match(r"([a-z0-9]+)\[([0-9,]*)\]", tok)
+        dt, dims = m.groups()
+        size = 1
+        for d in dims.split(","):
+            if d:
+                size *= int(d)
+        return size * _HLO_ITEMSIZE[dt]
+
+    total = 0
+    pat = re.compile(
+        r"=\s+(\([^)]*\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+        r"(all-to-all|all-gather|collective-permute|all-reduce)\(")
+    for m in pat.finditer(hlo):
+        total += sum(shape_bytes(t)
+                     for t in re.findall(r"[a-z0-9]+\[[0-9,]*\]",
+                                         m.group(1)))
+    return total
+
+
+@pytest.mark.parametrize("algo", ["oneshot", "ring"])
+def test_wire_bytes_matches_compiled_executable(algo):
+    """Acceptance gate: wire_bytes(algo=...) within 10% of the bytes the
+    compiled executable's collective instructions move on the CPU mesh —
+    measured from the same lowered.compile() artifact cost_analysis reads
+    (the module-level 'bytes accessed' only counts entry params+outputs,
+    so the cross-check sums the collective instructions' payloads)."""
+    n_dev = 4
+    per_dev = 1024 * 64  # per-device elements, divisible case
+    fn = (qc.quantized_all_reduce if algo == "oneshot"
+          else rc.ring_quantized_all_reduce)
+    f = jax.jit(jax.shard_map(lambda x: fn(x, "dp"), mesh=_mesh(n_dev),
+                              in_specs=P("dp"), out_specs=P("dp"),
+                              check_vma=False))
+    spec = jax.ShapeDtypeStruct((n_dev * 1024, 64), jnp.float32)
+    measured = _hlo_collective_bytes(f.lower(spec).compile().as_text())
+    model = qc.wire_bytes(per_dev, n_devices=n_dev, algo=algo)
+    assert measured > 0
+    assert abs(measured - model) / model <= 0.10, (algo, measured, model)
+
+
+def test_algo_attr_drives_lowering():
+    """The op's `algo` attr selects the lowering: ring emits unrolled
+    collective-permutes, oneshot emits all-to-all — visible in the
+    compiled HLO, so the transpiler-stamped attr provably controls what
+    runs."""
+    n_dev = 4
+
+    def lower(algo):
+        main = fluid.Program()
+        with fluid.program_guard(main), fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            block = main.global_block()
+            out = block.create_var(name="q_out", dtype="float32")
+            block.append_op("c_allreduce_quant", inputs={"X": [x]},
+                            outputs={"Out": [out]},
+                            attrs={"ring_id": 0, "algo": algo,
+                                   "block_size": 64})
+
+        def body(xs):
+            env = {"x": xs}
+            ctx = registry.LowerContext(mesh_axes=("dp",), block=block)
+            trace_block(block, env, ctx)
+            return env["q_out"]
+
+        f = jax.jit(jax.shard_map(body, mesh=_mesh(n_dev),
+                                  in_specs=P("dp"), out_specs=P("dp"),
+                                  check_vma=False))
+        return f.lower(jax.ShapeDtypeStruct((n_dev * 8, 16),
+                                            jnp.float32)).compile().as_text()
+
+    ring_hlo = lower("ring")
+    oneshot_hlo = lower("oneshot")
+    assert "collective-permute" in ring_hlo
+    assert "all-to-all" not in ring_hlo
+    assert "all-to-all" in oneshot_hlo
+    assert "collective-permute" not in oneshot_hlo
+
+
+# ---------------------------------------------------------------------------
+# transpiler threading
+# ---------------------------------------------------------------------------
+
+
+def _small_net(n_hidden=3):
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = x
+    for _ in range(n_hidden):
+        h = fluid.layers.fc(h, size=6, act="relu")
+    pred = fluid.layers.fc(h, size=3, act="softmax")
+    return fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+
+
+def _transpiled(n_dev=4, **kw):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        loss = _small_net()
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    transpile_data_parallel(main, loss.name, n_dev, quant_grads=True, **kw)
+    return main
+
+
+def test_transpiler_stamps_algo_and_honest_bytes():
+    """The bucketing pass resolves the algorithm per bucket at transpile
+    time: the op attr, the collective-bytes estimate, and the
+    _quant_allreduce_plan report all describe the SAME algorithm."""
+    for algo in ("ring", "oneshot"):
+        main = _transpiled(quant_algo=algo)
+        ops = [op for op in main.global_block().ops
+               if op.type == "c_allreduce_quant"]
+        assert ops and all(op.attrs["algo"] == algo for op in ops)
+        plan = main._quant_allreduce_plan
+        assert [b["algo"] for b in plan["buckets"]] == [algo] * len(ops)
+        want = sum(qc.wire_bytes(b["elements"],
+                                 block_size=plan["block_size"],
+                                 n_devices=4, algo=algo)
+                   for b in plan["buckets"])
+        assert main._collective_bytes_per_step["c_allreduce_quant"] == want
+    ring_bytes = _transpiled(quant_algo="ring") \
+        ._collective_bytes_per_step["c_allreduce_quant"]
+    oneshot_bytes = _transpiled(quant_algo="oneshot") \
+        ._collective_bytes_per_step["c_allreduce_quant"]
+    assert 0 < ring_bytes < oneshot_bytes  # (n-1)/n of the payload
+
+
+def test_transpiler_auto_crossover_per_bucket():
+    """auto + a crossover between this net's bucket size and infinity
+    flips the choice; the tiny-net bucket (117 fp32 elements < 1 KB) goes
+    oneshot under the default crossover and ring under a 0 KB one."""
+    small = _transpiled(quant_algo="auto")
+    assert all(op.attrs["algo"] == "oneshot"
+               for op in small.global_block().ops
+               if op.type == "c_allreduce_quant")
+    forced = _transpiled(quant_algo="auto", quant_crossover_kb=0)
+    assert all(op.attrs["algo"] == "ring"
+               for op in forced.global_block().ops
+               if op.type == "c_allreduce_quant")
+
+
+def test_build_strategy_algo_threads_to_runner():
+    """BuildStrategy.quant_allreduce_algo reaches the transpile through
+    DataParallelRunner (explicit arg > strategy > flag layering)."""
+    from paddle_tpu.parallel.data_parallel import DataParallelRunner
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        loss = _small_net(1)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    bs = fluid.compiler.BuildStrategy()
+    bs.quant_allreduce = True
+    bs.quant_allreduce_algo = "ring"
+    runner = DataParallelRunner(main, loss.name, build_strategy=bs)
+    assert runner.quant_grads and runner.quant_algo == "ring"
+    assert all(op.attrs["algo"] == "ring"
+               for op in runner.program.global_block().ops
+               if op.type == "c_allreduce_quant")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end DP convergence on the ring
+# ---------------------------------------------------------------------------
+
+
+def _run_dp_train(algo, steps, batch=16, seed=5):
+    fluid.set_flags({"FLAGS_quant_allreduce_algo": algo})
+    try:
+        rng = np.random.RandomState(seed)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            np.random.seed(seed)
+            loss = _small_net(2)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        bs = fluid.compiler.BuildStrategy()
+        bs.quant_allreduce = algo != "fp32"
+        exe = fluid.Executor(fluid.CPUPlace())
+        xs = rng.randn(batch, 8).astype("float32")
+        ys = rng.randint(0, 3, (batch, 1)).astype("int64")
+        losses = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            prog = fluid.CompiledProgram(main, build_strategy=bs) \
+                .with_data_parallel(loss_name=loss.name)
+            for _ in range(steps):
+                out = exe.run(prog, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])
+                losses.append(float(np.mean(out[0])))
+        return losses
+    finally:
+        fluid.set_flags({"FLAGS_quant_allreduce_algo": "auto"})
+
+
+def test_dp_ring_training_20_step_convergence_smoke():
+    """20 data-parallel steps through the per-hop-requantizing ring track
+    the per-grad fp32 path closely and converge — the ISSUE 5 DP smoke."""
+    lr = _run_dp_train("ring", steps=20)
+    lf = _run_dp_train("fp32", steps=20)
+    np.testing.assert_allclose(lr, lf, rtol=5e-3)
+    assert lr[-1] < lr[0]
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 quantized weight-update gather, end to end
+# ---------------------------------------------------------------------------
+
+
+_ZGQ_CHILD = r"""
+import sys
+sys.path.insert(0, {tests_dir!r})
+import cpu_mesh  # noqa: F401  (8-device CPU mesh before jax import)
+import json
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.parallel import HybridParallelRunner, build_hybrid_mesh
+
+# q_w1 shards to 32 elements/device: quantized under block 16 (under the
+# 256 default nothing in a net this small would clear the sub-block
+# gate); q_w2 (4 elements/device) stays below it -> fp32 gather
+fluid.set_flags({{"FLAGS_quant_allreduce_block_size": 16}})
+rng = np.random.RandomState(7)
+xd = rng.uniform(-1, 1, (16, 8)).astype("float32")
+yd = (xd @ rng.randn(8, 1)).astype("float32")
+
+
+def build_and_run(zgq):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", [-1, 8], False, dtype="float32")
+        y = fluid.data("y", [-1, 1], False, dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu",
+                            param_attr=fluid.ParamAttr(name="q_w1"))
+        pred = fluid.layers.fc(h, size=1,
+                               param_attr=fluid.ParamAttr(name="q_w2"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        runner = HybridParallelRunner(main, build_hybrid_mesh(4, mp=1),
+                                      scope=scope, zero_stage=1,
+                                      zero_gather_quant=zgq)
+        losses = []
+        for _ in range(5):
+            (lv,) = runner.run(feed={{"x": xd, "y": yd}},
+                               fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        w = np.asarray(scope.get("q_w1"))
+    return losses, w
+
+
+l_exact, w_exact = build_and_run(False)
+l_quant, w_quant = build_and_run(True)
+from paddle_tpu import observability as obs
+
+fam = obs.snapshot().get("pt_collective_payload_bytes_total", {{}})
+print("ZGQ_RESULT " + json.dumps({{
+    "l_exact": l_exact, "l_quant": l_quant,
+    "w_max_delta": float(np.abs(w_quant - w_exact).max()),
+    "zgq_booked": ("zero_gather_quant",) in fam.get("samples", {{}}),
+}}))
+"""
+
+
+def test_zero1_quantized_weight_gather_subprocess():
+    """zero_gather_quant end to end: the ZeRO-1 weight-update gather
+    moves the block-scaled int8 wire format (quantized_all_gather) under
+    a real GSPMD-jitted step.  Losses/weights track the fp32-gather run
+    within the dual-int8 bound, training converges, and the per-step
+    payload books under pt_collective_payload_bytes_total
+    {collective="zero_gather_quant"}.  Runs in a SUBPROCESS: the 0.4.3x
+    XLA:CPU GSPMD heap corruption (cpu_mesh.gspmd_cpu_heap_broken) is a
+    nondeterministic abort — isolation keeps a bad roll from killing the
+    whole pytest session, unlike tests/test_hybrid.py's blanket skip,
+    which would leave this feature with zero executed coverage."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    r = subprocess.run(
+        [sys.executable, "-c", _ZGQ_CHILD.format(tests_dir=tests_dir)],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(tests_dir))
+    lines = [ln for ln in r.stdout.splitlines()
+             if ln.startswith("ZGQ_RESULT ")]
+    if r.returncode != 0 and not lines:
+        if r.returncode < 0:  # signal: the known nondeterministic abort
+            pytest.skip(f"GSPMD child died with signal {-r.returncode} "
+                        "(0.4.3x XLA:CPU heap corruption)")
+        raise AssertionError(
+            f"zero_gather_quant child failed rc={r.returncode}\n"
+            f"{r.stderr[-2000:]}")
+    res = json.loads(lines[-1][len("ZGQ_RESULT "):])
+    l_exact, l_quant = res["l_exact"], res["l_quant"]
+    assert l_quant[-1] < l_quant[0]  # it trains
+    np.testing.assert_allclose(l_quant, l_exact, rtol=1e-3, atol=1e-3)
+    # quantization DID happen (guards against the gather silently
+    # resolving to the exact path), within the dual-int8 bound
+    assert 0.0 < res["w_max_delta"] < 1e-2
+    assert res["zgq_booked"]

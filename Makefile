@@ -2,8 +2,8 @@
 PY ?= python
 REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 
-.PHONY: test test-book test-onchip bench bench-onchip int8-bench lint-api \
-	lint-resilience lint-observability
+.PHONY: test test-book test-onchip bench bench-onchip int8-bench \
+	serve-bench lint-api lint-resilience lint-observability
 
 test:            ## full suite on the 8-device virtual CPU mesh (~8 min)
 	$(PY) -m pytest tests/ -q --ignore=tests/book
@@ -23,6 +23,9 @@ bench-onchip:    ## wedge-tolerant on-chip collector (ONCHIP_RESULTS.json)
 
 int8-bench:      ## int8 vs bf16 vs fp32 dense-serving A/B
 	PYTHONPATH=$(REPO):/root/.axon_site $(PY) tools/bench_int8_serve.py
+
+serve-bench:     ## serving-engine load generator (throughput + p50/p99)
+	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_SERVE=1 $(PY) bench.py
 
 lint-api:        ## fail if the public API surface drifted from API.spec
 	$(PY) tools/gen_api_spec.py --check

@@ -24,8 +24,11 @@ data-parallel rung with the EQuARX-style quantized gradient all-reduce
 executable's cost_analysis, both algorithms' modeled wire bytes
 (oneshot vs ppermute ring — pin one with FLAGS_quant_allreduce_algo),
 step-time p50/p95/max quantiles, and a rung-end /metricsz scrape of the
-pt_collective_* families); PT_BENCH_STEPS, PT_BENCH_BATCH,
-PT_BENCH_SEQLEN, BENCH_BASELINE.
+pt_collective_* families); PT_BENCH_SERVE=1 → serving-lane load-generator
+rung: a paddle_tpu.serving.Engine under closed-loop concurrent clients,
+recording request throughput + p50/p99 latency quantiles and batch-size /
+executable-cache figures (PT_BENCH_SERVE_CLIENTS, PT_BENCH_SERVE_REQUESTS
+knobs); PT_BENCH_STEPS, PT_BENCH_BATCH, PT_BENCH_SEQLEN, BENCH_BASELINE.
 """
 
 from __future__ import annotations
@@ -579,13 +582,141 @@ def measure_gpt_decode(size):
     }
 
 
+def measure_serving(size):
+    """Serving-lane load-generator rung (PT_BENCH_SERVE=1): drive a
+    `paddle_tpu.serving.Engine` with closed-loop concurrent clients and
+    record throughput + latency quantiles in the BENCH record beside the
+    training tokens/sec rungs (ROADMAP "Production serving lane").
+
+    Closed-loop: each client submits, waits for its result, submits
+    again — so concurrency is exactly PT_BENCH_SERVE_CLIENTS and the
+    continuous batcher's multi-request batch formation is what turns
+    concurrency into device efficiency."""
+    import threading
+
+    import numpy as np
+
+    from paddle_tpu import fluid, serving
+    from paddle_tpu import observability as obs
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    n_clients = int(os.environ.get("PT_BENCH_SERVE_CLIENTS", "8"))
+    n_requests = int(os.environ.get("PT_BENCH_SERVE_REQUESTS", "400"))
+    timeout_ms = int(os.environ.get("PT_BENCH_SERVE_TIMEOUT_MS", "5"))
+    feature, hidden, classes = ((256, 1024, 128) if size == "base"
+                                else (32, 64, 8))
+    import shutil
+    import tempfile
+
+    model_dir = tempfile.mkdtemp(prefix="pt_bench_serve_")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[feature], dtype="float32")
+        h = fluid.layers.fc(x, size=hidden, act="relu")
+        h = fluid.layers.fc(h, size=hidden, act="relu")
+        pred = fluid.layers.fc(h, size=classes, act="softmax")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe,
+                                      main_program=main)
+
+    try:
+        engine = serving.Engine({"bench": model_dir},
+                                max_wait_ms=timeout_ms, auto_start=False)
+    finally:
+        # params are resident in the predictor's scope once loaded; the
+        # on-disk export must not accumulate across bench runs
+        shutil.rmtree(model_dir, ignore_errors=True)
+    try:
+        engine.warmup()
+        engine.start()
+
+        rng = np.random.RandomState(0)
+        xb = rng.rand(1, feature).astype("float32")
+        per_client = max(1, n_requests // n_clients)
+        errors = []
+        completed = [0] * n_clients
+
+        def client(idx):
+            try:
+                for _ in range(per_client):
+                    engine.infer("bench", {"x": xb}, tenant=f"client{idx}",
+                                 timeout=60)
+                    completed[idx] += 1
+            except Exception as e:  # pragma: no cover - surfaced in record
+                errors.append(repr(e))
+
+        # prime the request path once (first traffic may still pay dispatch
+        # warmth even though warmup() compiled every bucket)
+        engine.infer("bench", {"x": xb}, timeout=60)
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        # throughput counts COMPLETED requests only: a client that died
+        # mid-loop (overload, timeout) must not inflate the recorded number
+        total = sum(completed)
+        rps = total / dt
+
+        snap = obs.snapshot()
+
+        def hist(name):
+            fam = snap.get(name)
+            return (fam or {}).get("samples", {}).get(("bench",))
+
+        lat = hist("pt_serve_request_latency_seconds")
+        bs = hist("pt_serve_batch_size")
+        cache = (snap.get("pt_serve_executable_cache_total") or
+                 {}).get("samples", {})
+        rec = {
+            "metric": "serving_requests_per_sec",
+            "value": round(rps, 1),
+            "unit": "req/s",
+            # the training-feed methodology markers (devfeed/pipelined) do
+            # not apply to the serving rung — only the CPU label carries over
+            "config": (f"serve mlp f{feature} h{hidden} clients{n_clients} "
+                       f"reqs{total} timeout{timeout_ms}ms "
+                       f"buckets={list(engine.policy.batch_buckets)}"
+                       + (" CPU-FALLBACK"
+                          if os.environ.get("PT_BENCH_FORCE_CPU") else "")),
+            "latency_seconds": {
+                "p50": _rq(obs.hist_quantile(lat, 0.50)) if lat else None,
+                "p99": _rq(obs.hist_quantile(lat, 0.99)) if lat else None,
+            },
+            "mean_batch_size": (round(bs["sum"] / bs["count"], 2)
+                                if bs and bs["count"] else None),
+            "executable_cache": {",".join(k): int(v)
+                                 for k, v in sorted(cache.items())},
+            "client_errors": errors[:5],
+        }
+        rec.update(_vs_baseline_rec(rps, rec["config"],
+                                    is_headline=False))
+    finally:
+        # close on EVERY path: a timed-out prime or a digest error must
+        # not leak the scheduler thread and leave a dead engine on
+        # /servez for the rest of the process
+        engine.close()
+    return rec
+
+
 def measure(size):
     if os.environ.get("PT_BENCH_FORCE_CPU"):
         # last-resort rung: the TPU tunnel can wedge for hours (observed);
-        # a real CPU number labeled as such beats recording 0.0
+        # a real CPU number labeled as such beats recording 0.0.  Pinned
+        # BEFORE the serving dispatch: the serving rung must honor the
+        # fallback too, or it wedges on the dead tunnel while its record
+        # claims CPU-FALLBACK
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("PT_BENCH_SERVE") == "1":
+        return measure_serving(size)
     model = os.environ.get("PT_BENCH_MODEL", "bert")
     if model in ("resnet", "resnet50"):
         return measure_resnet(size)

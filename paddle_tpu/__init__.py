@@ -12,6 +12,8 @@ Layer map (mirrors SURVEY.md §1, TPU-first):
   kernels/    Pallas TPU kernels for ops XLA fuses poorly
   observability/  unified telemetry: metrics registry, /metricsz
               exposition, JSONL events, cross-process tracing
+  serving/    production serving lane: continuous batching engine,
+              multi-model warm executable cache, /servez SLO surfaces
 """
 
 __version__ = "0.1.0"
@@ -24,6 +26,7 @@ from . import inference  # noqa: F401
 from . import compat  # noqa: F401
 from . import distributed  # noqa: F401
 from . import observability  # noqa: F401
+from . import serving  # noqa: F401
 from . import proto  # noqa: F401
 from . import utils  # noqa: F401
 from .reader import batch  # noqa: F401

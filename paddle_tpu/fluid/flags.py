@@ -92,6 +92,24 @@ _DEFS = {
     # buckets up to this size so scale overhead and collective-launch
     # count amortize without one giant liveness-hungry buffer
     "FLAGS_fuse_grad_size_in_MB": (32, int, True),
+    # production serving lane (paddle_tpu/serving, docs/SERVING.md).
+    # Batch buckets: comma-separated request-row counts; the continuous
+    # batcher pads every formed batch up to the smallest bucket >= its
+    # row count so ONE compiled executable per bucket serves all traffic
+    # (powers of two by default — the classic shape-bucketing recipe).
+    "FLAGS_serving_batch_buckets": ("1,2,4,8,16", str, True),
+    # optional sequence-length buckets for feeds whose dim-1 is dynamic
+    # (var shape -1): "" disables sequence padding; e.g. "32,64,128"
+    "FLAGS_serving_seq_buckets": ("", str, True),
+    # continuous-batching max wait: after the first request of a batch
+    # arrives, the scheduler waits at most this long for more requests
+    # before dispatching a partial bucket (the latency/throughput knob)
+    "FLAGS_serving_batch_timeout_ms": (5, int, True),
+    # admission control: max requests queued per model; submissions
+    # beyond it are rejected with ServingOverloadError instead of
+    # queueing unboundedly (callers retry/shed — bounded worst-case
+    # latency under overload)
+    "FLAGS_serving_max_queue": (256, int, True),
     # observability (docs/OBSERVABILITY.md): nonzero port serves
     # /metricsz + /statusz + /healthz from this process (started lazily
     # by the executor via observability.exposition.ensure_from_flags);

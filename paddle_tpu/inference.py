@@ -22,7 +22,77 @@ import os
 import numpy as np
 
 __all__ = ["AnalysisConfig", "AnalysisPredictor", "PaddleTensor",
-           "PaddleDType", "create_paddle_predictor", "ZeroCopyTensor"]
+           "PaddleDType", "create_paddle_predictor", "ZeroCopyTensor",
+           "check_feed_against_var"]
+
+
+def _resolve_np_dtype(dtype):
+    """np.dtype for a framework dtype (string or proto enum int),
+    resolving ml_dtypes extension floats (bfloat16) via the shared
+    ops.common helper — None when unresolvable."""
+    try:
+        from paddle_tpu.ops.common import np_dtype
+
+        return np.dtype(np_dtype(dtype))
+    except Exception:
+        return None
+
+
+def _dtype_kind(dt):
+    """numpy kind char, with ml_dtypes extension floats (bfloat16,
+    float8* — numpy kind 'V') reported as 'f'.  A true void/structured
+    dtype stays 'V' (np.finfo rejects it)."""
+    if dt.kind == "V":
+        try:
+            import ml_dtypes
+
+            ml_dtypes.finfo(dt)
+            return "f"
+        except Exception:
+            pass
+    return dt.kind
+
+
+def check_feed_against_var(name, arr, var, error_cls=ValueError):
+    """Cheap edge validation of a feed array against the program's static
+    var: rank and every fixed dim must match, and the dtype KIND must
+    match (width differences — float64→float32, int64→int32 — are safe,
+    the executor coerces them like the reference feed path).  `var=None`
+    (no static info) passes.
+
+    The serving lane multiplexes many callers onto one compiled
+    executable, so a bad feed must fail HERE with the caller's name on
+    it, not inside XLA attributed to whoever shares the batch."""
+    if var is None:
+        return
+    arr = np.asarray(arr)
+    # shape None = no static info; shape () is a GENUINE scalar var and
+    # still gets the rank check (a (4, 8) feed against it must fail
+    # here, not deep in XLA)
+    if var.shape is not None:
+        want = tuple(var.shape)
+        if arr.ndim != len(want):
+            raise error_cls(
+                f"feed {name!r}: rank {arr.ndim} array {tuple(arr.shape)} "
+                f"does not match the program's static shape {list(want)}")
+        for axis, (got, exp) in enumerate(zip(arr.shape, want)):
+            if exp >= 0 and int(got) != int(exp):
+                raise error_cls(
+                    f"feed {name!r}: shape {tuple(arr.shape)} does not "
+                    f"match the program's static shape {list(want)} "
+                    f"(dim {axis}: got {got}, expected {exp})")
+    # "is not None"/"!= ''" rather than truthiness: the proto enum for
+    # bool is 0, and `if var.dtype:` would silently skip validating it
+    if var.dtype is not None and var.dtype != "":
+        want_dtype = _resolve_np_dtype(var.dtype)
+        if want_dtype is None:
+            return  # unresolvable dtype: executor coerces
+        got_kind, want_kind = _dtype_kind(arr.dtype), _dtype_kind(want_dtype)
+        if got_kind != want_kind:
+            raise error_cls(
+                f"feed {name!r}: dtype {arr.dtype} is not "
+                f"{var.dtype}-compatible (kind {got_kind!r} vs "
+                f"{want_kind!r}) — cast at the caller")
 
 
 class PaddleDType:
@@ -136,7 +206,13 @@ class ZeroCopyTensor:
     def copy_from_cpu(self, arr):
         if not self._is_input:
             raise ValueError(f"{self.name} is an output tensor")
-        self._pred._staged[self.name] = np.ascontiguousarray(arr)
+        arr = np.ascontiguousarray(arr)
+        # fail bad feeds at the edge (dtype kind / rank / fixed dims)
+        # instead of inside XLA — serving multiplexes many callers
+        var = self._pred._program.global_block()._find_var_recursive(
+            self.name)
+        check_feed_against_var(self.name, arr, var)
+        self._pred._staged[self.name] = arr
 
     def copy_to_cpu(self):
         store = self._pred._staged if self._is_input else self._pred._outputs
@@ -237,10 +313,35 @@ class AnalysisPredictor:
     def run(self, inputs):
         """inputs: list of PaddleTensor in get_input_names() order (or
         named).  Returns list of PaddleTensor."""
+        if any(not t.name for t in inputs) and \
+                len(inputs) != len(self._feed_names):
+            # positional feeding only works when the count matches — a
+            # longer list used to fall off self._feed_names[i] with a
+            # bare IndexError
+            raise ValueError(
+                f"run() got {len(inputs)} positional inputs but the "
+                f"model expects {len(self._feed_names)}: "
+                f"{self._feed_names}")
         feed = {}
         for i, t in enumerate(inputs):
             name = t.name or self._feed_names[i]
+            if name not in self._feed_names:
+                raise ValueError(
+                    f"run() got unknown input {name!r}; expected "
+                    f"{self._feed_names}")
+            if name in feed:
+                # two tensors resolving to one input — duplicate names,
+                # or a named tensor colliding with a positional slot —
+                # must fail typed instead of silently overwriting
+                raise ValueError(
+                    f"run() fed input {name!r} twice; expected exactly "
+                    f"one tensor per input in {self._feed_names}")
             feed[name] = t.data
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise ValueError(
+                f"run() is missing inputs {missing}; expected "
+                f"{self._feed_names}")
         from paddle_tpu.fluid.executor import scope_guard
 
         with scope_guard(self._scope):
@@ -248,6 +349,38 @@ class AnalysisPredictor:
                                  fetch_list=self._fetch_names)
         return [PaddleTensor(o, name=n)
                 for n, o in zip(self._fetch_names, outs)]
+
+    # -- dict-in/dict-out serving entry ----------------------------------
+    def run_feed_dict(self, feed, validate=True):
+        """Serving-path entry (paddle_tpu.serving): run the compiled
+        program on a complete ``{input_name: array}`` feed and return
+        ``{output_name: array}``.  Same executable cache as
+        zero_copy_run/run — one compiled XLA executable per feed-shape
+        signature, parameters device-resident across calls.
+
+        validate=False skips the edge checks for callers that already
+        validated (the serving engine checks every request at submit;
+        re-checking each assembled batch would be pure duplicated
+        work in the hot path)."""
+        missing = [n for n in self._feed_names if n not in feed]
+        extra = [n for n in feed if n not in self._feed_names]
+        if missing or extra:
+            raise ValueError(
+                f"run_feed_dict expects exactly {self._feed_names}; "
+                f"missing {missing}, unexpected {extra}")
+        if validate:
+            blk = self._program.global_block()
+            for n in self._feed_names:
+                # same fail-at-the-edge contract as copy_from_cpu: a bad
+                # feed errors HERE with the name on it, not inside XLA
+                check_feed_against_var(n, feed[n],
+                                       blk._find_var_recursive(n))
+        from paddle_tpu.fluid.executor import scope_guard
+
+        with scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=dict(feed),
+                                 fetch_list=self._fetch_names)
+        return dict(zip(self._fetch_names, outs))
 
     def program(self):
         return self._program

@@ -25,7 +25,8 @@ from . import exposition  # noqa: F401
 from . import metrics  # noqa: F401
 from . import tracing  # noqa: F401
 from .exposition import (MetricsServer, ensure_from_flags, parse_text,
-                         render_json, render_text)
+                         register_page, render_json, render_text,
+                         unregister_page)
 from .metrics import (DEFAULT_BUCKETS, REGISTRY, Counter, Gauge, Histogram,
                       MetricsRegistry, counter, gauge, hist_quantile,
                       histogram, reset, snapshot)
@@ -37,6 +38,6 @@ __all__ = [
     "counter", "gauge", "histogram", "snapshot", "reset", "hist_quantile",
     "DEFAULT_BUCKETS",
     "render_text", "render_json", "parse_text", "MetricsServer",
-    "ensure_from_flags",
+    "ensure_from_flags", "register_page", "unregister_page",
     "job_trace_id", "new_span_id", "process_identity",
 ]

@@ -37,7 +37,8 @@ from . import metrics as _metrics
 from . import tracing
 
 __all__ = ["render_text", "render_json", "parse_text", "MetricsServer",
-           "ensure_from_flags", "active_server", "stop_server"]
+           "ensure_from_flags", "active_server", "stop_server",
+           "register_page", "unregister_page"]
 
 _START_TIME = time.time()
 
@@ -280,6 +281,47 @@ def _statusz() -> dict:
     return status
 
 
+# subsystem status pages served beside the built-ins on EVERY
+# MetricsServer in the process (the serving lane's /servez registers
+# here): path -> zero-arg callable returning either (body_bytes,
+# content_type) or a JSON-serializable object
+_extra_pages: dict = {}
+# guards the collision check-then-set below: without it two threads
+# registering the same path with different renderers can both pass the
+# check and the second overwrite wins silently — the exact undetected
+# collision the guard exists to prevent (handlers read single keys,
+# which is atomic, so only writers lock)
+_pages_lock = threading.Lock()
+
+
+def register_page(path, render):
+    """Register an extra GET page (e.g. ``/servez``) on every exposition
+    server in this process.  `render()` returns (body, content_type) —
+    body bytes or str — or any JSON-serializable object (rendered
+    application/json).  A page raising is a 500 on that request, never a
+    server crash.  Registering a second renderer for a live path raises
+    (a silent overwrite would vanish the first subsystem's page with
+    nothing to detect the collision) — `unregister_page` first to
+    replace; re-registering the SAME renderer is an idempotent no-op."""
+    if not path.startswith("/"):
+        raise ValueError(f"page path must start with '/': {path!r}")
+    if path in ("/metricsz", "/metrics", "/metricsz.json", "/statusz",
+                "/healthz"):
+        raise ValueError(f"{path!r} is a built-in page")
+    with _pages_lock:
+        existing = _extra_pages.get(path)
+        if existing is not None and existing is not render:
+            raise ValueError(
+                f"page {path!r} is already registered; unregister_page() "
+                f"it before installing a different renderer")
+        _extra_pages[path] = render
+
+
+def unregister_page(path):
+    with _pages_lock:
+        _extra_pages.pop(path, None)
+
+
 class MetricsServer:
     """Daemon-thread HTTP exposition server.  port=0 binds an ephemeral
     port (tests); the flag path passes an explicit port."""
@@ -304,6 +346,32 @@ class MetricsServer:
                 elif path == "/metricsz.json":
                     body = render_json(reg.snapshot()).encode()
                     ctype = "application/json"
+                elif (page := _extra_pages.get(path)) is not None:
+                    # single .get(): a concurrent unregister_page between
+                    # a membership test and the call would KeyError out
+                    # of do_GET instead of 404/500ing the one request
+                    try:
+                        # serialization stays inside the try: a page
+                        # whose RETURN VALUE fails json.dumps (circular
+                        # reference, raising __str__) must also 500,
+                        # never drop the connection with a traceback
+                        out = page()
+                        if (isinstance(out, tuple) and len(out) == 2
+                                and isinstance(out[1], str)):
+                            raw, ctype = out
+                        else:
+                            raw, ctype = out, "application/json"
+                        if isinstance(raw, str):
+                            body = raw.encode()
+                        elif isinstance(raw, (bytes, bytearray)):
+                            body = bytes(raw)
+                        else:  # JSON-serializable body, possibly with
+                            # an explicit content type alongside it
+                            body = json.dumps(raw, indent=1,
+                                              default=str).encode()
+                    except Exception as e:
+                        self.send_error(500, explain=str(e))
+                        return
                 else:
                     self.send_error(404)
                     return

@@ -198,6 +198,7 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.RLock()
         self._families: dict[str, _Family] = {}
+        self._epoch = 0
 
     def _register(self, type_, name, help_text, labels, buckets=None):
         with self._lock:
@@ -249,6 +250,14 @@ class MetricsRegistry:
         a reset mid-run only zeroes, never breaks."""
         with self._lock:
             self._families.clear()
+            self._epoch += 1
+
+    @property
+    def epoch(self):
+        """Bumped on every reset().  A call site that CACHES resolved
+        label children (instead of re-registering per call) compares
+        this to decide when its children are orphaned and must rebind."""
+        return self._epoch
 
 
 # the process-wide default registry; every layer of the stack reports here

@@ -1,0 +1,42 @@
+"""paddle_tpu.serving — the production serving lane (docs/SERVING.md).
+
+Turns `AnalysisPredictor` (the reference's inference engine, PAPER.md §1)
+into a production request path on the TPU compilation model: one XLA
+executable per (model signature, bucket shape), variable traffic padded
+into a small fixed bucket set so nothing recompiles after warmup.
+
+  batching   shape-bucketed continuous batcher: per-model request
+             queues, batch assembly padded to powers-of-two row (and
+             optional sequence) buckets, per-request futures
+  engine     multi-model Engine: warm executable cache (in-process
+             executor cache + FLAGS_compile_cache_dir persistence —
+             a restarted server recompiles nothing), bounded-queue
+             admission control with typed ServingOverloadError,
+             per-tenant request accounting
+  status     /servez page on the existing /metricsz endpoint: loaded
+             models, bucket set, cache hit rates, p50/p99 latency
+  errors     typed serving errors (overload / not-loaded / bad feed)
+
+SLO surfaces ride the observability registry: `pt_serve_request_latency_
+seconds{model}`, `pt_serve_batch_size`, `pt_serve_queue_depth`,
+`pt_serve_rejected_total`, … (docs/OBSERVABILITY.md).  Flags:
+FLAGS_serving_batch_buckets / FLAGS_serving_seq_buckets /
+FLAGS_serving_batch_timeout_ms / FLAGS_serving_max_queue.
+"""
+
+from . import batching  # noqa: F401
+from . import engine  # noqa: F401
+from . import errors  # noqa: F401
+from . import status  # noqa: F401
+from .batching import BucketPolicy
+from .engine import Engine, model_signature
+from .errors import (FeedValidationError, ModelNotLoadedError, ServingError,
+                     ServingOverloadError)
+from .status import servez_payload
+
+__all__ = [
+    "batching", "engine", "errors", "status",
+    "Engine", "BucketPolicy", "model_signature", "servez_payload",
+    "ServingError", "ServingOverloadError", "ModelNotLoadedError",
+    "FeedValidationError",
+]

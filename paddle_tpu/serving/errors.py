@@ -1,0 +1,38 @@
+"""Typed serving-path errors.
+
+The serving lane multiplexes many callers onto shared executables, so
+failures must be classifiable at the edge: admission rejection is a
+load-shedding signal the client retries with backoff, while a feed error
+is a caller bug that must never reach XLA (where it would surface as an
+opaque trace/compile failure attributed to the wrong request).
+"""
+
+from __future__ import annotations
+
+__all__ = ["ServingError", "ServingOverloadError", "ModelNotLoadedError",
+           "FeedValidationError"]
+
+
+class ServingError(RuntimeError):
+    """Base class of every serving-lane error."""
+
+
+class ServingOverloadError(ServingError):
+    """Admission control rejected the request: the model's queue is at
+    FLAGS_serving_max_queue (or the engine is closed).  The typed
+    rejection IS the contract — callers shed/retry instead of the engine
+    queueing unboundedly and timing out everyone."""
+
+
+class ModelNotLoadedError(ServingError, KeyError):
+    """Request named a model the engine does not serve."""
+
+    def __str__(self):
+        # KeyError.__str__ reprs the message (quotes + escapes in every
+        # log line); render it like any other error
+        return RuntimeError.__str__(self)
+
+
+class FeedValidationError(ServingError, ValueError):
+    """Request feed failed the edge validation (names, dtypes, shapes,
+    row consistency) against the model's static program signature."""

@@ -1,0 +1,57 @@
+"""/servez — the serving lane's status page on the existing exposition
+endpoint (FLAGS_metrics_port).
+
+A process can run several engines; each registers itself here on
+construction.  Engines must be `close()`d when done (close unregisters,
+joins the scheduler threads, and fails leftover futures); the weak
+registration is only a safety net so a LEAKED engine at least drops off
+the page — its scheduler threads and model parameters are NOT reclaimed
+without close().  The page renders JSON: every live engine's bucket
+policy, loaded models, queue depths, executable-cache hit rates,
+per-tenant counts, and p50/p99 request latency (PromQL
+`histogram_quantile` semantics via `observability.hist_quantile`).
+
+`GET /servez` works on any `MetricsServer` in the process — the one
+`FLAGS_metrics_port` started, or an ephemeral `MetricsServer(port=0)`.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+__all__ = ["servez_payload", "track_engine", "untrack_engine",
+           "live_engines"]
+
+_engines = weakref.WeakSet()
+_lock = threading.Lock()
+
+
+def track_engine(engine):
+    """Add an engine to the /servez page (called from Engine.__init__)
+    and (re-)register the page with the exposition server.  No
+    registered-once latch: register_page is an idempotent no-op for the
+    same renderer, and a latch would go stale after an
+    unregister_page("/servez") — every later engine would then skip
+    registration and /servez would 404 for the rest of the process."""
+    with _lock:
+        _engines.add(engine)
+        from paddle_tpu.observability import exposition
+
+        exposition.register_page("/servez", servez_payload)
+
+
+def untrack_engine(engine):
+    with _lock:
+        _engines.discard(engine)
+
+
+def live_engines():
+    """Snapshot of the engines currently tracked (strong refs)."""
+    with _lock:
+        return list(_engines)
+
+
+def servez_payload():
+    """JSON-serializable /servez body: one entry per live engine."""
+    return {"engines": [e.stats() for e in live_engines()]}

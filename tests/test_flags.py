@@ -153,6 +153,53 @@ def test_quant_allreduce_algo_flags_roundtrip(monkeypatch):
     importlib.reload(fl)  # restore defaults for other tests
 
 
+def test_serving_flags_roundtrip(monkeypatch):
+    """The serving-lane flags register with their documented defaults
+    (powers-of-two buckets, 5 ms max wait, 256-request admission bound,
+    sequence bucketing off) and round-trip through env bootstrap and
+    get/set like every other flag (ISSUE 6 satellite)."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("serving_batch_buckets")[
+        "serving_batch_buckets"] == "1,2,4,8,16"
+    assert fl.get_flags("serving_seq_buckets")["serving_seq_buckets"] == ""
+    assert fl.get_flags("serving_batch_timeout_ms")[
+        "serving_batch_timeout_ms"] == 5
+    assert fl.get_flags("serving_max_queue")["serving_max_queue"] == 256
+    try:
+        fl.set_flags({"FLAGS_serving_batch_buckets": "1,4,32",
+                      "serving_seq_buckets": "64,128",
+                      "FLAGS_serving_batch_timeout_ms": "25",  # str parses
+                      "serving_max_queue": 16})
+        assert fl.get_flags(["serving_batch_buckets", "serving_seq_buckets",
+                             "serving_batch_timeout_ms",
+                             "serving_max_queue"]) == {
+            "serving_batch_buckets": "1,4,32",
+            "serving_seq_buckets": "64,128",
+            "serving_batch_timeout_ms": 25,
+            "serving_max_queue": 16}
+    finally:
+        fl.set_flags({"FLAGS_serving_batch_buckets": "1,2,4,8,16",
+                      "FLAGS_serving_seq_buckets": "",
+                      "FLAGS_serving_batch_timeout_ms": 5,
+                      "FLAGS_serving_max_queue": 256})
+    monkeypatch.setenv("FLAGS_serving_batch_buckets", "2,8")
+    monkeypatch.setenv("FLAGS_serving_batch_timeout_ms", "50")
+    monkeypatch.setenv("FLAGS_serving_max_queue", "32")
+    importlib.reload(fl)
+    assert fl.get_flags("serving_batch_buckets")[
+        "serving_batch_buckets"] == "2,8"
+    assert fl.get_flags("serving_batch_timeout_ms")[
+        "serving_batch_timeout_ms"] == 50
+    assert fl.get_flags("serving_max_queue")["serving_max_queue"] == 32
+    monkeypatch.delenv("FLAGS_serving_batch_buckets")
+    monkeypatch.delenv("FLAGS_serving_batch_timeout_ms")
+    monkeypatch.delenv("FLAGS_serving_max_queue")
+    importlib.reload(fl)  # restore defaults for other tests
+
+
 def test_malformed_env_flag_warns_not_crashes(monkeypatch):
     import importlib
     import warnings as w

@@ -79,6 +79,116 @@ def test_missing_input_raises(saved_model):
         pred.zero_copy_run()
 
 
+def test_run_positional_count_mismatch_typed_error(saved_model):
+    """An unnamed PaddleTensor list longer than get_input_names() used
+    to fall off self._feed_names[i] with a bare IndexError; now it is a
+    typed ValueError naming the expected inputs (ISSUE 6 satellite)."""
+    d, xb, _ = saved_model
+    config = AnalysisConfig(d)
+    config.disable_gpu()
+    pred = create_paddle_predictor(config)
+    with pytest.raises(ValueError, match=r"expects 1: \['x'\]"):
+        pred.run([PaddleTensor(xb), PaddleTensor(xb)])
+    with pytest.raises(ValueError, match="unknown input 'bogus'"):
+        pred.run([PaddleTensor(xb, name="bogus")])
+    # an empty list must fail typed too, not with a missing-feed error
+    # from deep in the executor
+    with pytest.raises(ValueError, match="missing inputs"):
+        pred.run([])
+    # a named tensor colliding with a positional slot is a typed error,
+    # not a silent overwrite (needs >= 2 feeds to be expressible, so
+    # build the collision on a 1-feed model via duplicate names)
+    with pytest.raises(ValueError, match="twice"):
+        pred.run([PaddleTensor(xb, name="x"), PaddleTensor(xb, name="x")])
+
+
+def test_copy_from_cpu_validates_dtype_and_shape(saved_model):
+    """ZeroCopyTensor.copy_from_cpu fails bad feeds at the edge with a
+    clear error instead of letting them reach XLA (ISSUE 6 satellite):
+    dtype-kind and fixed-dim mismatches raise; the dynamic batch dim and
+    safe width coercions (float64 -> float32) still pass."""
+    d, xb, _ = saved_model
+    config = AnalysisConfig(d)
+    config.disable_gpu()
+    pred = create_paddle_predictor(config)
+    inp = pred.get_input_tensor("x")
+    with pytest.raises(ValueError, match="int64.*compatible"):
+        inp.copy_from_cpu(np.ones((4, 8), "int64"))
+    with pytest.raises(ValueError, match="static shape"):
+        inp.copy_from_cpu(np.ones((4, 9), "float32"))  # fixed dim 8
+    with pytest.raises(ValueError, match="rank"):
+        inp.copy_from_cpu(np.ones((8,), "float32"))
+    inp.copy_from_cpu(np.ones((2, 8), "float64"))  # same kind: coerced
+    assert pred.zero_copy_run()
+
+
+def test_check_feed_against_var_bfloat16_is_float_kind():
+    """A bfloat16 var accepts float feeds: ml_dtypes registers
+    np.dtype('bfloat16') with kind 'V', which must not reject valid
+    float32 callers (the executor width-casts) — ints still fail."""
+    from types import SimpleNamespace
+
+    from paddle_tpu.inference import check_feed_against_var
+
+    var = SimpleNamespace(shape=(-1, 8), dtype="bfloat16")
+    check_feed_against_var("x", np.ones((2, 8), "float32"), var)
+    check_feed_against_var("x", np.ones((2, 8), "float64"), var)
+    with pytest.raises(ValueError, match="compatible"):
+        check_feed_against_var("x", np.ones((2, 8), "int32"), var)
+    # a TRUE void dtype is not a float: it must fail typed at the edge,
+    # not as an opaque astype error deep in the cast path
+    fvar = SimpleNamespace(shape=(-1, 8), dtype="float32")
+    with pytest.raises(ValueError, match="compatible"):
+        check_feed_against_var("x", np.zeros((2, 8), "V4"), fvar)
+
+
+def test_check_feed_against_var_scalar_var_rank_checked():
+    """A GENUINE scalar var (static shape ()) still rank-checks: a
+    matrix feed against it fails typed at the edge, not deep in XLA —
+    only shape=None (no static info) skips validation."""
+    from types import SimpleNamespace
+
+    from paddle_tpu.inference import check_feed_against_var
+
+    svar = SimpleNamespace(shape=(), dtype="float32")
+    check_feed_against_var("s", np.float32(1.5), svar)
+    with pytest.raises(ValueError, match="rank"):
+        check_feed_against_var("s", np.ones((4, 8), "float32"), svar)
+    # unknown shape stays permissive
+    uvar = SimpleNamespace(shape=None, dtype="float32")
+    check_feed_against_var("u", np.ones((4, 8), "float32"), uvar)
+
+
+def test_check_feed_against_var_bool_enum_dtype_validated():
+    """The proto enum for bool is 0: dtype validation must not be
+    skipped by truthiness — a float feed against an enum-0 (bool) var
+    fails typed at the edge, and a bool feed passes."""
+    from types import SimpleNamespace
+
+    from paddle_tpu.inference import check_feed_against_var
+
+    bvar = SimpleNamespace(shape=(-1, 8), dtype=0)
+    check_feed_against_var("m", np.ones((2, 8), "bool"), bvar)
+    with pytest.raises(ValueError, match="compatible"):
+        check_feed_against_var("m", np.ones((2, 8), "float32"), bvar)
+
+
+def test_run_feed_dict_serving_entry(saved_model):
+    """The dict-in/dict-out serving entry matches the ZeroCopy path and
+    validates the feed-name set."""
+    d, xb, expect = saved_model
+    config = AnalysisConfig(d)
+    config.disable_gpu()
+    pred = create_paddle_predictor(config)
+    out = pred.run_feed_dict({"x": xb})
+    np.testing.assert_allclose(out[pred.get_output_names()[0]], expect,
+                               rtol=1e-5)
+    with pytest.raises(ValueError, match="missing"):
+        pred.run_feed_dict({})
+    with pytest.raises(ValueError, match="unexpected"):
+        pred.run_feed_dict({"x": xb, "junk": xb})
+
+
 def test_tensor_shape_before_run(saved_model):
     d, _, _ = saved_model
     config = AnalysisConfig(d)

@@ -59,6 +59,19 @@ def test_exempt_dir_does_not_leak_to_prefix_siblings(tmp_path):
     assert not lint_observability._exempt("paddle_tpu/fluid/profiler2.py")
 
 
+def test_serving_package_is_covered_and_clean():
+    """The serving lane (ISSUE 6) is library code: it must lint clean
+    and must NOT be exempt — a bare print in the request path would be
+    invisible to every scrape."""
+    serving_dir = REPO / "paddle_tpu" / "serving"
+    assert serving_dir.is_dir()
+    assert not lint_observability._exempt("paddle_tpu/serving/engine.py")
+    findings = []
+    for f in sorted(serving_dir.rglob("*.py")):
+        findings.extend(lint_observability.check_file(f))
+    assert findings == []
+
+
 def test_parse_error_reported_not_raised():
     findings = lint_observability.check_source("def broken(:\n", "x.py")
     assert findings and findings[0][2] == "parse-error"

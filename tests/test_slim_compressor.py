@@ -8,6 +8,8 @@ nas/light_nas_strategy.py.
 import numpy as np
 import pytest
 
+import cpu_mesh
+
 from paddle_tpu import fluid
 from paddle_tpu.fluid.contrib import slim
 from paddle_tpu.fluid.executor import Scope, scope_guard
@@ -74,6 +76,12 @@ compressor:
     assert ctx.eval_results[acc.name][-1] > 0.7, ctx.eval_results
 
 
+@pytest.mark.skipif(
+    cpu_mesh.gspmd_cpu_heap_broken(),
+    reason="XLA:CPU 0.4.3x heap corruption: the resume's second "
+           "Compressor run aborts under BOTH runtimes (same class as "
+           "test_hybrid — reproduces on clean HEAD; one abort kills "
+           "every test after this file)")
 def test_compressor_checkpoint_resume(tmp_path):
     cfg_text = """
 version: 1.0

@@ -9,13 +9,22 @@ contract `fluid.incubate.fleet` reads.
 
 Beyond parity (SURVEY §5: the reference has no failure detection or
 elastic recovery): `resilience` (RetryPolicy + resilience_stats
-counters), `fault_injection` (deterministic FaultPlan test harness), and
-supervised restarts in the launchers (`--max_restarts`).
+counters), `fault_injection` (deterministic FaultPlan test harness),
+supervised restarts in the launchers (`--max_restarts`), and `elastic`
+(resizable jobs: lease-based membership, graceful preemption drain, and
+collective-lane rejoin — docs/DISTRIBUTED.md §6 "Elastic membership").
 """
 
-from .fault_injection import FaultPlan
+from .elastic import (DrainHandler, LeaseHeartbeat, current_drain,
+                      drain_requested, install_drain_handler, join_job,
+                      leave_job, membership, rebuild_mesh,
+                      reinit_collective)
+from .fault_injection import FaultPlan, set_membership_hooks
 from .resilience import (RetryPolicy, reset_resilience_stats,
                          resilience_stats)
 
 __all__ = ["FaultPlan", "RetryPolicy", "resilience_stats",
-           "reset_resilience_stats"]
+           "reset_resilience_stats", "set_membership_hooks",
+           "DrainHandler", "LeaseHeartbeat", "install_drain_handler",
+           "current_drain", "drain_requested", "join_job", "leave_job",
+           "membership", "reinit_collective", "rebuild_mesh"]

@@ -12,6 +12,15 @@ incarnation).  When restarts are exhausted — or a child with no restart
 budget fails — every survivor is terminated and the failure raises, so
 the job dies CLEANLY instead of hanging on a rank blocked in a collective
 or a pserver accept loop.
+
+Exit classification: every poll-detected death emits one structured
+`supervisor_child_exit` event (exit code, signal, role, rank, restart
+count, kind) into the shared JSONL event log — the exit reason used to
+live only in the per-child log file.  A child that DRAINED gracefully
+(its elastic drain handler dropped `drained.<pid>` into the
+PT_DRAIN_NOTIFY_DIR this supervisor exports) is classified clean even
+when the re-delivered SIGTERM gave it a nonzero exit: it is neither
+restarted against max_restarts nor counted as a job failure.
 """
 
 from __future__ import annotations
@@ -22,6 +31,17 @@ import sys
 import time
 
 __all__ = ["ProcGroup", "str2bool"]
+
+
+def _emit_event(event, **fields):
+    """Best-effort structured supervisor event (the event log is opt-in
+    and stdlib-only, but never let telemetry kill supervision)."""
+    try:
+        from paddle_tpu.observability import events
+        events.emit(event, **fields)
+    except Exception:
+        from paddle_tpu.distributed import resilience
+        resilience.record("supervisor_event_failures")
 
 
 def str2bool(v):
@@ -54,7 +74,37 @@ class _Child:
         self.restart_at = None  # monotonic deadline of a pending relaunch
         self._log = None
         self.proc = None
+        self._reported = None  # (restarts, pid) whose exit was emitted
         self._start()
+
+    @property
+    def role(self):
+        """The child's job role from its env contract (for telemetry)."""
+        env = self.env
+        return (env.get("PT_TRACE_ROLE") or env.get("TRAINING_ROLE")
+                or ("trainer" if env.get("PADDLE_TRAINER_ID") else "proc")
+                ).lower()
+
+    @property
+    def rank(self):
+        for var in ("PT_TRACE_RANK", "PADDLE_TRAINER_ID"):
+            v = (self.env.get(var) or "").strip()
+            if v.isdigit():
+                return int(v)
+        return 0
+
+    def drained(self):
+        """True when this incarnation completed a graceful elastic drain
+        (its drain handler dropped the marker the supervisor watches)."""
+        d = self.env.get("PT_DRAIN_NOTIFY_DIR", "")
+        if not d or self.proc is None:
+            return False
+        return os.path.exists(os.path.join(d, f"drained.{self.proc.pid}"))
+
+    def finished_clean(self):
+        """Exited, and either cleanly (rc 0) or via a graceful drain."""
+        rc = self.poll()
+        return rc is not None and (rc == 0 or self.drained())
 
     def _start(self):
         if self._log:
@@ -99,11 +149,17 @@ class ProcGroup:
 
     def __init__(self, log_dir=None, restart_backoff=1.0):
         self.log_dir = log_dir
+        self.drain_dir = None
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
+            # children's drain handlers drop `drained.<pid>` here so a
+            # graceful LEAVE exit is distinguishable from a crash
+            self.drain_dir = os.path.join(log_dir, ".drain")
+            os.makedirs(self.drain_dir, exist_ok=True)
         self.children = []
         self.restart_backoff = float(restart_backoff)
         self.restarts_performed = 0
+        self.drains_observed = 0
 
     # old name kept for callers that iterate .procs
     @property
@@ -117,10 +173,32 @@ class ProcGroup:
         self.shutdown()
 
     def spawn(self, script, script_args, env, log_name, max_restarts=0):
+        env = dict(env)
+        if self.drain_dir and "PT_DRAIN_NOTIFY_DIR" not in env:
+            env["PT_DRAIN_NOTIFY_DIR"] = self.drain_dir
         child = _Child(self, script, script_args, env, log_name,
                        max_restarts=max_restarts)
         self.children.append(child)
         return child
+
+    def _report_exit(self, child, rc):
+        """One structured event per detected death/exit of one child
+        incarnation: exit code, delivering signal, role/rank, restart
+        budget state, and the clean-LEAVE-vs-crash classification (the
+        exit reason used to live only in the per-child log file)."""
+        key = (child.restarts, child.proc.pid if child.proc else None)
+        if child._reported == key:
+            return
+        child._reported = key
+        drained = child.drained()
+        kind = "clean" if rc == 0 else ("drained" if drained else "crash")
+        if drained:
+            self.drains_observed += 1
+        _emit_event("supervisor_child_exit",
+                    child=child.log_name, role=child.role, rank=child.rank,
+                    exit_code=int(rc), signal=(-int(rc) if rc < 0 else None),
+                    kind=kind, restarts=child.restarts,
+                    max_restarts=child.max_restarts)
 
     def _handle_failure(self, child, rc):
         """Schedule/perform a relaunch if budget remains (True), else
@@ -154,24 +232,30 @@ class ProcGroup:
         return True
 
     def wait(self, workers=None):
-        """Block until every worker exits cleanly; supervise restarts;
-        raise on the first unrecoverable failure (after terminating all
-        survivors).  `workers` defaults to all children; any non-worker
-        child (e.g. a pserver accept loop that never exits on its own) is
-        terminated once the workers finish."""
+        """Block until every worker exits cleanly (rc 0, or a graceful
+        elastic drain); supervise restarts; raise on the first
+        unrecoverable failure (after terminating all survivors).
+        `workers` defaults to all children; any non-worker child (e.g. a
+        pserver accept loop that never exits on its own) is terminated
+        once the workers finish.  A drained child is neither restarted
+        against its budget nor treated as a failure — preemption is the
+        common case, not the failure case."""
         workers = list(workers if workers is not None else self.children)
         failed = None
         while failed is None:
             for child in self.children:
                 rc = child.poll()
-                if rc in (None, 0):
+                if rc is None:
+                    continue
+                self._report_exit(child, rc)
+                if rc == 0 or child.drained():
                     continue
                 if not self._handle_failure(child, rc):
                     failed = (rc, child.args)
                     break
             if failed is None:
-                if all(c.poll() == 0 for c in workers):
-                    break  # every worker finished cleanly
+                if all(c.finished_clean() for c in workers):
+                    break  # every worker finished cleanly (or drained)
                 time.sleep(0.2)
         self._terminate_survivors()
         if failed:

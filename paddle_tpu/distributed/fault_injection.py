@@ -20,11 +20,28 @@ Spec grammar — semicolon-separated rules:
                               (trainer loops call on_step per step)
     kill:round:<k>            SIGKILL when on_round(k) fires (the pserver
                               sync loop calls on_round per completed round)
+    preempt:step:<k>          SIGTERM at step k — the GRACEFUL exit class
+                              (a drain handler finishes the in-flight
+                              round, snapshots, announces LEAVE, exits);
+                              kill: stays the hard SIGKILL class
+    preempt:round:<k>         SIGTERM after pserver sync round k
+    join:step:<k>             fire the registered `join` membership hook
+                              at step k (elastic scale-up choreography)
+    join:round:<k>            ... at completed round k
+    leave:step:<k>            fire the registered `leave` hook at step k
+                              (graceful departure WITHOUT a signal)
+    leave:round:<k>           ... at completed round k
 
 `<cmd>` is an RPC name (send_grad, get_param, send_barrier, fetch_barrier,
-send_param, lookup_rows, checkpoint_notify, stop) or `*`.  Counts are
-1-based and per-process; a retried RPC re-enters the count, so `drop:...:3`
-fails exactly one attempt and the retry succeeds.
+send_param, lookup_rows, checkpoint_notify, stop, lease, join, leave) or
+`*`.  Counts are 1-based and per-process; a retried RPC re-enters the
+count, so `drop:...:3` fails exactly one attempt and the retry succeeds.
+
+The join:/leave: actions dispatch to hooks a trainer loop registers via
+`set_membership_hooks(join=fn, leave=fn)` (each called with the step or
+round number); without a registered hook they are no-ops, so one
+PT_FAULT_PLAN can choreograph an elastic scenario in whatever runner
+replays it.
 
 The supervisor strips PT_FAULT_PLAN (and sets PADDLE_RESTART_COUNT) when it
 relaunches a child, so faults are injected once per job, not once per
@@ -40,7 +57,10 @@ import sys
 import threading
 
 __all__ = ["FaultPlan", "FaultInjected", "install", "uninstall", "active",
-           "on_rpc", "on_step", "on_round"]
+           "on_rpc", "on_step", "on_round", "set_membership_hooks"]
+
+# lifecycle actions fired from on_step/on_round (vs per-RPC actions)
+_LIFECYCLE = ("kill", "preempt", "join", "leave")
 
 _ENV = "PT_FAULT_PLAN"
 
@@ -103,7 +123,7 @@ class FaultPlan:
             elif action == "flaky" and len(bits) == 4:
                 self.rules.append(
                     _Rule(action, bits[1], float(bits[2]), bits[3]))
-            elif action == "kill" and len(bits) == 3 and \
+            elif action in _LIFECYCLE and len(bits) == 3 and \
                     bits[1] in ("step", "round"):
                 self.rules.append(_Rule(action, bits[1], int(bits[2])))
             else:
@@ -125,7 +145,8 @@ class FaultPlan:
         with self._lock:
             n = self._counts[cmd_name] = self._counts.get(cmd_name, 0) + 1
             fire = [r for r in self.rules
-                    if r.cmd in (cmd_name, "*") and r.action != "kill" and
+                    if r.cmd in (cmd_name, "*") and
+                    r.action not in _LIFECYCLE and
                     (r.action == "flaky" or r.n == n)]
         for r in fire:
             if r.action == "flaky":
@@ -148,27 +169,56 @@ class FaultPlan:
                     f"fault-injection: injected server error on "
                     f"{cmd_name} rpc #{r.n}")
 
-    def _maybe_kill(self, kind, k):
+    def _fire_lifecycle(self, kind, k):
         for r in self.rules:
-            if r.action == "kill" and r.cmd == kind and r.n == int(k):
+            if r.cmd != kind or r.n != int(k) or r.action not in _LIFECYCLE:
+                continue
+            if r.action == "kill":
                 # observability: allow — last words before SIGKILL
                 print(f"fault-injection: SIGKILL pid {os.getpid()} at "
                       f"{kind} {k}", file=sys.stderr, flush=True)
                 os.kill(os.getpid(), signal.SIGKILL)
+            elif r.action == "preempt":
+                # the graceful class: SIGTERM, so an installed drain
+                # handler (distributed.elastic.DrainHandler) finishes the
+                # in-flight round, snapshots, LEAVEs, then exits
+                self._record()
+                # observability: allow — deterministic-preemption banner
+                print(f"fault-injection: SIGTERM pid {os.getpid()} at "
+                      f"{kind} {k}", file=sys.stderr, flush=True)
+                os.kill(os.getpid(), signal.SIGTERM)
+            else:  # join / leave → registered membership hooks
+                hook = _hooks.get(r.action)
+                if hook is not None:
+                    self._record()
+                    hook(int(k))
+
+    def _maybe_kill(self, kind, k):  # old name kept for callers/tests
+        self._fire_lifecycle(kind, k)
 
     def on_step(self, step):
         """Trainer-side hook: call once per training step."""
-        self._maybe_kill("step", step)
+        self._fire_lifecycle("step", step)
 
     def on_round(self, rnd):
         """Pserver-side hook: the sync serve loop calls this after each
         completed round (absolute round id, snapshot-continuous)."""
-        self._maybe_kill("round", rnd)
+        self._fire_lifecycle("round", rnd)
 
 
 _plan = None
 _plan_resolved = False
 _plan_lock = threading.Lock()
+_hooks: dict = {"join": None, "leave": None}
+
+
+def set_membership_hooks(join=None, leave=None):
+    """Register the callables `join:`/`leave:` rules dispatch to (each
+    receives the step/round number).  A trainer loop wires these to its
+    elastic join/leave so one PT_FAULT_PLAN replays a whole membership
+    scenario deterministically.  Pass None to clear."""
+    _hooks["join"] = join
+    _hooks["leave"] = leave
 
 
 def install(plan):

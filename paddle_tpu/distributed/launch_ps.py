@@ -58,6 +58,13 @@ def _parse_args(argv=None):
                         help="pserver shard snapshot dir for elastic "
                              "resume (default <log_dir>/snapshots when "
                              "--max_restarts > 0)")
+    parser.add_argument("--elastic", type=str2bool, nargs="?", const=True,
+                        default=False,
+                        help="elastic membership (FLAGS_elastic_ps for "
+                             "every role): trainers join/leave the "
+                             "running job under a lease, barrier counts "
+                             "renegotiate, preempted trainers drain "
+                             "gracefully (docs/DISTRIBUTED.md §6)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=REMAINDER)
     return parser.parse_args(argv)
@@ -87,6 +94,11 @@ def start_procs(args):
                   PADDLE_PSERVER_ENDPOINTS=",".join(endpoints),
                   PADDLE_TRAINERS_NUM=str(args.worker_num),
                   PT_TRACE_ID=_tracing.job_trace_id())
+    if args.elastic:
+        # every role bootstraps the flag from env (fluid.flags); the
+        # ProcGroup adds PT_DRAIN_NOTIFY_DIR so graceful drains are
+        # classified clean instead of charged against --max_restarts
+        common["FLAGS_elastic_ps"] = "1"
     snapshot_dir = args.snapshot_dir or (
         os.path.join(args.log_dir, "snapshots")
         if args.max_restarts > 0 and args.log_dir else "")

@@ -49,6 +49,26 @@ _DEFS = {
     # retryable timeout instead of wedging behind a dead peer; 0 = wait
     # forever (reference listen_and_serv behavior)
     "FLAGS_ps_barrier_timeout_ms": (300000, int, True),
+    # elastic membership (docs/DISTRIBUTED.md §6 "Elastic membership"):
+    # trainers JOIN/LEAVE a running sync-mode PS job under a lease; the
+    # server's barrier quorum is the live member set, so a preempted
+    # trainer's round completes with the survivors and a joiner enters at
+    # the next epoch.  Off by default — the frozen n_trainers contract is
+    # the reference behavior.
+    "FLAGS_elastic_ps": (False, _parse_bool, True),
+    # server-side lease deadline: an active member with no lease-renewing
+    # frame (heartbeat or barrier arrival) for this long is evicted at the
+    # next round wait and the quorum renegotiates; 0 = never expire
+    "FLAGS_ps_lease_timeout_ms": (15000, int, True),
+    # client-side heartbeat cadence (a sidecar connection renews the lease
+    # through long compute phases); should be well under the lease timeout
+    "FLAGS_ps_lease_heartbeat_ms": (3000, int, True),
+    # time-based pserver snapshot cadence in seconds, decoupled from sync
+    # rounds: >0 snapshots at most every N seconds (geo/async lanes get
+    # crash recovery without per-round cost; the sync lane thins its
+    # per-round snapshots); 0 keeps the per-round behavior
+    # (PT_PS_SNAPSHOT_EVERY rounds)
+    "FLAGS_ps_snapshot_interval_s": (0.0, float, True),
     "FLAGS_communicator_max_merge_var_num": (20, int, True),
     "FLAGS_communicator_send_queue_size": (20, int, True),
     "FLAGS_communicator_independent_recv_thread": (True, _parse_bool, False),

@@ -156,7 +156,9 @@ class AutoCheckpoint:
         for sig in list(handlers):
             prev = handlers[sig]
             try:
-                signal.signal(sig, prev if prev is not None
+                # restore-site: putting the ORIGINAL handler back, not
+                # registering a new hook — nothing to chain
+                signal.signal(sig, prev if prev is not None  # resilience: allow
                               else signal.SIG_DFL)
             except ValueError:  # non-main thread: can't restore from here
                 break
@@ -166,13 +168,13 @@ class AutoCheckpoint:
         if self._last_step is not None:
             try:
                 self.save(self._last_step)
-            except Exception:
-                pass  # best-effort on the way down
+            except Exception:  # resilience: allow — best-effort going down
+                pass
         prev = self._prev_handlers.get(signum)
         if prev is signal.SIG_IGN:
             # the launcher deliberately ignored this signal: snapshot taken,
-            # restore the ignore and keep running
-            signal.signal(signum, signal.SIG_IGN)
+            # restore the ignore and keep running (restore-site, no chain)
+            signal.signal(signum, signal.SIG_IGN)  # resilience: allow
             return
         if callable(prev):
             # CHAIN to the previously-installed handler (a launcher's own
@@ -182,6 +184,6 @@ class AutoCheckpoint:
             prev(signum, frame)
             return
         # prev is SIG_DFL or a non-Python handler (None): re-deliver with
-        # the default action so the process actually dies
-        signal.signal(signum, signal.SIG_DFL)
+        # the default action so the process actually dies (restore-site)
+        signal.signal(signum, signal.SIG_DFL)  # resilience: allow
         signal.raise_signal(signum)

@@ -27,7 +27,7 @@ __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
 _STATE = {
     "enabled": False,
     "trace_dir": None,
-    "events": [],  # (kind, name, start_s, dur_s)
+    "events": [],  # (kind, name, start_s, dur_s[, args])
     "t0": None,    # profiling session epoch (perf_counter)
     "wall_t0": None,  # wall-clock time of the epoch (cross-process merge)
 }
@@ -37,11 +37,29 @@ def is_profiler_enabled():
     return _STATE["enabled"]
 
 
-def _record(kind, name, seconds, start=None):
+def _record(kind, name, seconds, start=None, args=None):
+    """Record one span.  `args` (optional dict) lands in the chrome-trace
+    event's args — how the pserver tags its `rpc_serve:` spans with the
+    requesting client's span id for merged-trace attribution."""
     if _STATE["enabled"]:
         if start is None:
             start = time.perf_counter() - seconds
-        _STATE["events"].append((kind, name, start, seconds))
+        if args:
+            _STATE["events"].append((kind, name, start, seconds,
+                                     dict(args)))
+        else:
+            _STATE["events"].append((kind, name, start, seconds))
+
+
+def wall_to_session(wall_s):
+    """Map a wall-clock timestamp onto the profiling session's
+    perf_counter timeline (for spans whose start comes from another
+    clock, e.g. the native span journal).  Identity-degrades to "now"
+    when no session epoch exists."""
+    t0, wall_t0 = _STATE["t0"], _STATE["wall_t0"]
+    if t0 is None or wall_t0 is None:
+        return time.perf_counter()
+    return t0 + (wall_s - wall_t0)
 
 
 class RecordEvent:
@@ -144,9 +162,19 @@ def get_events():
     """Recorded (kind, name, start_s, dur_s) events of the last/current
     profiling session, with start relative to the session epoch (clamped to
     0 for spans entered before start_profiler).  Consumed by
-    tools/timeline.py for chrome://tracing export."""
+    tools/timeline.py for chrome://tracing export.  Spans recorded with
+    args keep the 4-tuple shape here (back-compat); the args surface only
+    in export_chrome_trace."""
     t0 = _STATE["t0"] or 0.0
-    return [(k, n, max(s - t0, 0.0), d) for k, n, s, d in _STATE["events"]]
+    return [(e[0], e[1], max(e[2] - t0, 0.0), e[3])
+            for e in _STATE["events"]]
+
+
+def _get_events_with_args():
+    t0 = _STATE["t0"] or 0.0
+    return [(e[0], e[1], max(e[2] - t0, 0.0), e[3],
+             e[4] if len(e) > 4 else None)
+            for e in _STATE["events"]]
 
 
 def export_chrome_trace(path):
@@ -170,13 +198,16 @@ def export_chrome_trace(path):
     pid = os.getpid()
     tids = {"host": 1}  # host spans stay on tid 1 (historic layout)
     events = []
-    for kind, name, start, dur in get_events():
+    for kind, name, start, dur, extra in _get_events_with_args():
         tid = tids.setdefault(kind, len(tids) + 1)
+        args = {"kind": kind}
+        if extra:
+            args.update(extra)
         events.append({
             "name": name, "cat": kind, "ph": "X",
             "ts": start * 1e6, "dur": dur * 1e6,
             "pid": pid, "tid": tid,
-            "args": {"kind": kind},
+            "args": args,
         })
     meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "args": {"name": f"{ident['role']}{ident['rank']} "
@@ -196,7 +227,7 @@ def export_chrome_trace(path):
 
 def _summary(sorted_key=None):
     rows = {}
-    for kind, name, _start, sec in _STATE["events"]:
+    for kind, name, _start, sec in (e[:4] for e in _STATE["events"]):
         key = (kind, name)
         tot, cnt, mx = rows.get(key, (0.0, 0, 0.0))
         rows[key] = (tot + sec, cnt + 1, max(mx, sec))

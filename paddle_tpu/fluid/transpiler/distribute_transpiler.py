@@ -286,7 +286,11 @@ class DistributeTranspiler:
         self.startup_program.global_block().append_op(
             "ps_init_sync",
             attrs={"trainer_id": self.trainer_id, "push_vars": push,
-                   "push_slices": push_slices, "pull_vars": pull})
+                   "push_slices": push_slices, "pull_vars": pull,
+                   # full shard list + mode, so the elastic path
+                   # (FLAGS_elastic_ps) can JOIN every barrier peer
+                   "endpoints": list(self.endpoints),
+                   "sync_mode": bool(self.sync_mode)})
 
     # -- pserver side ----------------------------------------------------
     def _build_opt_program(self, param, row_range=None):

@@ -82,7 +82,11 @@ class GeoSgdTranspiler(DistributeTranspiler):
             "ps_init_sync",
             attrs={"trainer_id": self.trainer_id, "push_vars": push,
                    "pull_vars": push,
-                   "shadow_vars": [p for p, _ in push]})
+                   "shadow_vars": [p for p, _ in push],
+                   # geo runs the barrier-free async server: no elastic
+                   # membership quorum to join
+                   "endpoints": list(self.endpoints),
+                   "sync_mode": False})
 
     # -- pserver side ----------------------------------------------------
     def get_pserver_program(self, endpoint):

@@ -36,4 +36,43 @@ def install():
     jax.shard_map = shard_map
 
 
+def distributed_reinit(coordinator_address, num_processes, process_id,
+                       **kw):
+    """`jax.distributed` re-initialization across jax versions — the
+    elastic-rejoin primitive (distributed.elastic.reinit_collective).
+
+    Modern jax exposes ``jax.distributed.shutdown()`` and
+    ``is_initialized()``; the baked container toolchain may carry a
+    release with neither.  Shut down when possible, then initialize at
+    the (possibly new) world size.  When shutdown is unavailable and the
+    runtime is already initialized, jax raises its "only be called once"
+    RuntimeError — re-raised with the actionable context (restart the
+    process to resize) instead of a bare message."""
+    import jax
+
+    dist = jax.distributed
+    try:
+        # attempt shutdown whenever the API exists — some jax lines ship
+        # shutdown() without is_initialized(), and skipping the teardown
+        # there would turn a legal resize into the "only be called once"
+        # failure below
+        if getattr(dist, "shutdown", None) and (
+                not getattr(dist, "is_initialized", None)
+                or dist.is_initialized()):
+            dist.shutdown()
+    except RuntimeError:
+        pass  # resilience: allow — not initialized / already torn down
+    try:
+        dist.initialize(coordinator_address=coordinator_address,
+                        num_processes=num_processes,
+                        process_id=process_id, **kw)
+    except RuntimeError as e:
+        if "only be called once" in str(e).lower():
+            raise RuntimeError(
+                "jax.distributed is already initialized and this jax "
+                "build has no shutdown(); an elastic resize needs a "
+                "process restart on this toolchain") from e
+        raise
+
+
 install()

@@ -146,6 +146,11 @@ def lib():
         L.pts_server_port.argtypes = [ctypes.c_void_p]
         L.pts_server_set_barrier_timeout_ms.argtypes = [ctypes.c_void_p,
                                                         ctypes.c_int]
+        L.pts_server_enable_elastic.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_int]
+        L.pts_server_drain_spans.restype = ctypes.c_int64
+        L.pts_server_drain_spans.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
         L.pts_server_stat.restype = ctypes.c_int64
         L.pts_server_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
         L.pts_server_wait_round.restype = ctypes.c_int
@@ -184,6 +189,7 @@ def lib():
         L.pts_request.restype = ctypes.c_int
         L.pts_request.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                   ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.c_uint64,
                                   ctypes.c_char_p, ctypes.c_int64,
                                   ctypes.POINTER(ctypes.c_void_p),
                                   ctypes.POINTER(ctypes.c_int64)]
@@ -434,13 +440,17 @@ CMD_SEND_PARAM = 5
 CMD_STOP = 6
 CMD_LOOKUP_ROWS = 7
 CMD_CHECKPOINT_NOTIFY = 8
+CMD_LEASE = 9
+CMD_JOIN = 10
+CMD_LEAVE = 11
 
 _CMD_NAMES = {CMD_SEND_GRAD: "send_grad", CMD_GET_PARAM: "get_param",
               CMD_SEND_BARRIER: "send_barrier",
               CMD_FETCH_BARRIER: "fetch_barrier",
               CMD_SEND_PARAM: "send_param", CMD_STOP: "stop",
               CMD_LOOKUP_ROWS: "lookup_rows",
-              CMD_CHECKPOINT_NOTIFY: "checkpoint_notify"}
+              CMD_CHECKPOINT_NOTIFY: "checkpoint_notify",
+              CMD_LEASE: "lease", CMD_JOIN: "join", CMD_LEAVE: "leave"}
 
 
 def _rpc_latency():
@@ -465,11 +475,13 @@ def _rpc_total():
         labels=("cmd", "status"))
 
 
-def _record_rpc(cmd, seconds, status):
+def _record_rpc(cmd, seconds, status, span_id=None):
     """Book one wire attempt: latency histogram + outcome counter, a
     profiler span (when a profiling session is live — checked via
-    sys.modules so telemetry never triggers the fluid import), and a
-    span id in the JSONL event log (when enabled)."""
+    sys.modules so telemetry never triggers the fluid import), and the
+    attempt's span id in the JSONL event log (when enabled).  `span_id`
+    is the SAME id that rode the RPC frame, so the server's journaled
+    handling record for this attempt correlates exactly."""
     name = _CMD_NAMES.get(cmd, str(cmd))
     _rpc_latency().labels(cmd=name).observe(seconds)
     _rpc_total().labels(cmd=name, status=status).inc()
@@ -485,7 +497,7 @@ def _record_rpc(cmd, seconds, status):
 
         _events.emit("rpc", cmd=name, status=status,
                      seconds=round(seconds, 6),
-                     span_id=_tracing.new_span_id())
+                     span_id=span_id or _tracing.new_span_id())
 
 # barrier frames carry the trainer's completed-round count; this high bit
 # marks the retry of a timed-out wait (server must not re-count the
@@ -559,6 +571,18 @@ def decode_sparse(blob: bytes):
     return rows, values.reshape(len(rows), -1) if len(rows) else values
 
 
+def _decode_membership(blob: bytes) -> dict:
+    """The 40-byte elastic-membership reply (kJoin/kLease): epoch, round,
+    version, active count, and the requester's index among the sorted
+    active members (-1 while pending / not a member)."""
+    import struct
+
+    epoch, rnd, version, count, index = struct.unpack("<5Q", blob)
+    return {"epoch": int(epoch), "round": int(rnd), "version": int(version),
+            "count": int(count),
+            "index": -1 if index == 0xffffffffffffffff else int(index)}
+
+
 class PSServer:
     """Sync-mode parameter-server transport endpoint.
 
@@ -572,6 +596,8 @@ class PSServer:
         self._h = lib().pts_server_start(int(port), int(n_trainers))
         if not self._h:
             raise IOError(f"cannot bind pserver port {port}")
+        self._elastic = False
+        self._membership_mirrored = {}
         if barrier_timeout_ms is None:
             from paddle_tpu.fluid import flags
             barrier_timeout_ms = flags.flag("ps_barrier_timeout_ms")
@@ -588,20 +614,41 @@ class PSServer:
         forever (reference behavior)."""
         lib().pts_server_set_barrier_timeout_ms(self._h, int(ms))
 
+    def enable_elastic(self, lease_timeout_ms=None):
+        """Elastic membership: the barrier quorum becomes the live member
+        set (kJoin/kLeave under a lease) instead of the fixed n_trainers.
+        A member whose lease goes unrenewed for `lease_timeout_ms` is
+        evicted at the next driver wait, renegotiating the round's
+        arrival count downward so the survivors complete it.  Call BEFORE
+        load() so a snapshot's member section restores the quorum."""
+        if lease_timeout_ms is None:
+            from paddle_tpu.fluid import flags
+            lease_timeout_ms = flags.flag("ps_lease_timeout_ms")
+        lib().pts_server_enable_elastic(self._h, int(lease_timeout_ms))
+        self._elastic = True
+
     def stats(self):
         """Server-side resilience counters (stale-trainer detection:
-        nonzero barrier timeouts mean some peer stopped arriving).
+        nonzero barrier timeouts mean some peer stopped arriving), plus
+        the elastic-membership surface (epoch / members / joins / leaves /
+        evictions).
 
-        The return shape is the frozen back-compat view; each read also
-        mirrors the values into `pt_ps_server_stat{key=...}` gauges in
-        the shared registry (the sync loop calls stats() every round, so
+        The pre-elastic keys are the frozen back-compat view; each read
+        also mirrors the values into `pt_ps_server_stat{key=...}` gauges
+        and the `pt_ps_membership_*` / `pt_ps_lease_*` families in the
+        shared registry (the sync loop calls stats() every round, so
         /metricsz tracks the live C++ counters round-granular)."""
         st = lib().pts_server_stat
         out = {"send_barrier_timeouts": st(self._h, 0),
                "fetch_barrier_timeouts": st(self._h, 1),
                "get_param_timeouts": st(self._h, 2),
                "rounds": st(self._h, 3),
-               "version": st(self._h, 4)}
+               "version": st(self._h, 4),
+               "epoch": st(self._h, 5),
+               "members": st(self._h, 6),
+               "joins": st(self._h, 7),
+               "leaves": st(self._h, 8),
+               "evictions": st(self._h, 9)}
         from paddle_tpu import observability as obs
 
         g = obs.gauge("pt_ps_server_stat",
@@ -610,6 +657,50 @@ class PSServer:
                       labels=("key",))
         for k, v in out.items():
             g.labels(key=k).set(float(v))
+        # the pt_ps_membership_*/pt_ps_lease_* families exist only on
+        # elastic servers — a legacy fixed-quorum job must not surface a
+        # misleading membership_size == 0
+        if self._elastic:
+            obs.gauge("pt_ps_membership_epoch",
+                      "Elastic membership epoch (bumps on every applied "
+                      "join/leave/eviction)").set(float(out["epoch"]))
+            obs.gauge("pt_ps_membership_size",
+                      "Active members of the elastic barrier quorum").set(
+                float(out["members"]))
+            ev = obs.counter(
+                "pt_ps_membership_events_total",
+                "Applied elastic membership transitions by kind",
+                labels=("event",))
+            lease_exp = obs.counter(
+                "pt_ps_lease_expirations_total",
+                "Members evicted because their lease went unrenewed")
+            last = self._membership_mirrored
+            for key, event in (("joins", "join"), ("leaves", "leave"),
+                               ("evictions", "evict")):
+                delta = int(out[key]) - last.get(key, 0)
+                if delta > 0:
+                    ev.labels(event=event).inc(delta)
+                    if key == "evictions":
+                        lease_exp.inc(delta)
+                last[key] = int(out[key])
+        return out
+
+    def drain_spans(self, max_records=4096):
+        """Drain the server's span journal: [(cmd_name, span_str,
+        wall_start_s, dur_s)] for every served frame that carried a span
+        id — the server half of client↔server RPC attribution.  The
+        driver loop drains per round and re-emits as `serve_rpc` events /
+        `rpc_serve:` profiler spans."""
+        from paddle_tpu.observability import tracing as _tracing
+
+        buf = (ctypes.c_uint64 * (4 * int(max_records)))()
+        n = lib().pts_server_drain_spans(self._h, buf, int(max_records))
+        out = []
+        for i in range(int(n)):
+            cmd, span, start_us, dur_us = buf[i * 4:i * 4 + 4]
+            out.append((_CMD_NAMES.get(int(cmd), str(int(cmd))),
+                        _tracing.format_wire_span(int(span)),
+                        start_us / 1e6, dur_us / 1e6))
         return out
 
     def wait_round(self) -> bool:
@@ -716,7 +807,7 @@ class PSClient:
     """
 
     def __init__(self, host="127.0.0.1", port=0, timeout=30.0,
-                 retry_times=None, retry_backoff_ms=None):
+                 retry_times=None, retry_backoff_ms=None, uid=None):
         self._host, self._port = host, int(port)
         self._timeout = float(timeout)
         self._retry_times = retry_times
@@ -731,12 +822,17 @@ class PSClient:
         # server nor a relaunched trainer replaying a still-open round can
         # double-count.  Processes outside the launcher env contract
         # (tests simulating trainers with threads) fall back to a uuid.
-        tid = os.environ.get("PADDLE_TRAINER_ID")
-        if tid:
-            self._uid = f"trainer:{tid}"
+        # An explicit `uid` overrides — a lease-heartbeat sidecar client
+        # must renew the SAME membership its primary client holds.
+        if uid:
+            self._uid = str(uid)
         else:
-            import uuid
-            self._uid = uuid.uuid4().hex
+            tid = os.environ.get("PADDLE_TRAINER_ID")
+            if tid:
+                self._uid = f"trainer:{tid}"
+            else:
+                import uuid
+                self._uid = uuid.uuid4().hex
         self._h = lib().pts_connect(host.encode(), int(port), float(timeout))
         if not self._h:
             raise PSConnectionError(
@@ -745,6 +841,11 @@ class PSClient:
     @property
     def endpoint(self):
         return f"{self._host}:{self._port}"
+
+    @property
+    def uid(self):
+        """This client's stable membership/barrier identity."""
+        return self._uid
 
     def _policy(self):
         """Retry policy, cached until the flags it was built from change
@@ -784,19 +885,27 @@ class PSClient:
 
     def _req_once(self, cmd, name="", round=0, blob=b""):
         """One wire attempt; classifies failures for the retry layer and
-        books latency + outcome into the shared telemetry registry."""
+        books latency + outcome into the shared telemetry registry.  Every
+        frame carries a fresh span id (retries are distinct spans); the
+        server journals it against its handling, so a merged post-mortem
+        trace attributes server-side command handling to this client —
+        across restarts, because the id embeds this process's pid."""
+        from paddle_tpu.observability import tracing as _tracing
+
         out, olen = ctypes.c_void_p(), ctypes.c_int64()
+        wire_span, span_str = _tracing.new_wire_span()
         t0 = time.perf_counter()
         with self._lock:
             if self._h is None:
                 raise PSConnectionError(
                     f"connection to pserver {self.endpoint} is closed")
-            rc = lib().pts_request(self._h, cmd, name.encode(), round, blob,
+            rc = lib().pts_request(self._h, cmd, name.encode(), round,
+                                   wire_span, blob,
                                    len(blob), ctypes.byref(out),
                                    ctypes.byref(olen))
         _record_rpc(cmd, time.perf_counter() - t0,
                     {0: "ok", 1: "server_error", 2: "timeout"}.get(
-                        rc, "transport_error"))
+                        rc, "transport_error"), span_id=span_str)
         data = _take(out, olen.value) if out.value else b""
         if rc == 0:
             return data
@@ -936,6 +1045,33 @@ class PSClient:
         """Ask the pserver to snapshot its shard to `path` (reference
         AsyncCheckpointNotify, send_recv.proto.in:30)."""
         self._req(CMD_CHECKPOINT_NOTIFY, str(path))
+
+    # -- elastic membership (docs/DISTRIBUTED.md §6) ---------------------
+
+    def join(self):
+        """Register this client's uid as a member of an elastic job.  The
+        idle job (round 0, nothing in flight) activates immediately; a
+        running job queues the join for the next round boundary — poll
+        `membership()` until `index >= 0` before entering the round loop.
+        Idempotent: a relaunched trainer re-joining under its stable uid
+        just renews its lease.  Returns the membership dict (epoch,
+        round, version, count, index)."""
+        return _decode_membership(self._req(CMD_JOIN, name=self._uid))
+
+    def leave(self):
+        """Graceful departure: queued server-side and applied at the next
+        round boundary.  The caller must keep participating in rounds
+        until its leave applies — announce, run the one in-flight round,
+        then exit (the drain sequence in distributed.elastic)."""
+        self._req(CMD_LEAVE, name=self._uid)
+
+    def lease_heartbeat(self):
+        """Renew this member's lease and return the current membership
+        view.  Also answers for non-members (index -1), so a delayed
+        joiner can watch the round counter before joining."""
+        return _decode_membership(self._req(CMD_LEASE, name=self._uid))
+
+    membership = lease_heartbeat
 
     def stop_server(self):
         # no retry: stopping an already-dead server must fail fast, not

@@ -24,6 +24,18 @@ enum PtsCmd : uint8_t {
   // operators/distributed/send_recv.proto.in:30): request.name is the
   // path the server writes its table snapshot to.
   kCheckpointNotify = 8,
+  // --- elastic membership (no reference analog; SURVEY §5 gap) --------- //
+  // All three carry the client's stable uid in `name` and answer with the
+  // 40-byte membership blob: u64 epoch | u64 round_id | u64 version |
+  // u64 active_count | u64 index (~0ull when the uid is pending/absent).
+  // kLease renews the sender's lease (heartbeat) and doubles as the
+  // membership query; kJoin registers a PENDING member (activated at the
+  // next round boundary, or immediately while the job is still idle at
+  // round 0); kLeave queues a graceful departure applied at the next
+  // round boundary — the leaver participates in rounds until it applies.
+  kLease = 9,
+  kJoin = 10,
+  kLeave = 11,
 };
 
 // Response status codes: 0 ok, 1 error/stopped, 2 liveness-deadline
@@ -96,9 +108,21 @@ void* pts_server_start(int port, int n_trainers);
 int pts_server_port(void* h);
 // liveness deadline for barrier / versioned-get waits; 0 = wait forever
 void pts_server_set_barrier_timeout_ms(void* h, int ms);
+// elastic membership: barrier arrival counts come from the live member
+// set (kJoin/kLeave/lease expiry) instead of the fixed n_trainers.
+// lease_timeout_ms is the heartbeat deadline — an active member with no
+// lease-renewing frame for that long is evicted at the next wait
+// predicate evaluation (0 = members never expire).
+void pts_server_enable_elastic(void* h, int lease_timeout_ms);
 // counters: 0 send-barrier timeouts, 1 fetch-barrier timeouts,
-// 2 get-param timeouts, 3 completed rounds, 4 published version
+// 2 get-param timeouts, 3 completed rounds, 4 published version,
+// 5 membership epoch, 6 active members, 7 joins, 8 leaves, 9 evictions
 int64_t pts_server_stat(void* h, int which);
+// drain up to max_records span-journal entries (4 u64 each: cmd, span id,
+// wall-clock start us, handling duration us) into out; returns the count.
+// The journal records every served frame whose span field was nonzero —
+// the server half of client↔server RPC attribution in merged traces.
+int64_t pts_server_drain_spans(void* h, uint64_t* out, int64_t max_records);
 int pts_server_wait_round(void* h);
 void pts_server_release_send(void* h);
 int64_t pts_server_grad_count(void* h);
@@ -118,8 +142,11 @@ int pts_server_wait_table(void* h, const char* name);
 void pts_server_stop(void* h);
 void* pts_connect(const char* host, int port, double timeout_s);
 // status 0 ok / 1 error / 2 server deadline (retryable) / -1 io failure;
-// kGetParam payload lands in *out (caller frees via ptq_free)
+// kGetParam payload lands in *out (caller frees via ptq_free).  `span` is
+// the caller's span id for this attempt (0 = untraced); the server
+// journals it against the handled command for post-mortem attribution.
 int pts_request(void* h, int cmd, const char* name, uint64_t round,
-                const char* data, int64_t dlen, char** out, int64_t* olen);
+                uint64_t span, const char* data, int64_t dlen, char** out,
+                int64_t* olen);
 void pts_client_close(void* h);
 }  // extern "C"

@@ -91,15 +91,18 @@ static void test_ps_sync_round() {
   auto trainer = [&](int id) {
     void* c = pts_connect("127.0.0.1", port, 5.0);
     CHECK(c != nullptr);
-    CHECK(pts_request(c, kSendGrad, "g", 0, "GGGG", 4, nullptr, nullptr) == 0);
-    CHECK(pts_request(c, kSendBarrier, "", 0, nullptr, 0, nullptr, nullptr)
+    CHECK(pts_request(c, kSendGrad, "g", 0, 0, "GGGG", 4, nullptr,
+                      nullptr) == 0);
+    CHECK(pts_request(c, kSendBarrier, "", 0, 0, nullptr, 0, nullptr,
+                      nullptr)
           == 0);
     char* out = nullptr;
     int64_t olen = 0;
-    CHECK(pts_request(c, kGetParam, "p", 1, nullptr, 0, &out, &olen) == 0);
+    CHECK(pts_request(c, kGetParam, "p", 1, 0, nullptr, 0, &out, &olen) == 0);
     CHECK(olen == 4 && std::memcmp(out, "PPPP", 4) == 0);
     ptq_free(out);
-    CHECK(pts_request(c, kFetchBarrier, "", 0, nullptr, 0, nullptr, nullptr)
+    CHECK(pts_request(c, kFetchBarrier, "", 0, 0, nullptr, 0, nullptr,
+                      nullptr)
           == 0);
     pts_client_close(c);
   };
@@ -120,7 +123,8 @@ static void test_ps_async_pop_and_lookup() {
   // async pop: timeout first, then a pushed grad wakes the pop
   char *name = nullptr, *data = nullptr;
   CHECK(pts_server_pop_grad(srv, 30, &name, &data) == -1);  // timeout
-  CHECK(pts_request(c, kSendGrad, "w@GRAD", 0, "abcd", 4, nullptr, nullptr)
+  CHECK(pts_request(c, kSendGrad, "w@GRAD", 0, 0, "abcd", 4, nullptr,
+                    nullptr)
         == 0);
   int64_t n = pts_server_pop_grad(srv, 1000, &name, &data);
   CHECK(n == 4 && std::string(name) == "w@GRAD");
@@ -135,17 +139,17 @@ static void test_ps_async_pop_and_lookup() {
   int64_t ids[2] = {2, 0};
   char* out = nullptr;
   int64_t olen = 0;
-  CHECK(pts_request(c, kLookupRows, "emb", packed,
+  CHECK(pts_request(c, kLookupRows, "emb", packed, 0,
                     (const char*)ids, sizeof(ids), &out, &olen) == 0);
   CHECK(olen == 8 && std::memcmp(out, "CCCCAAAA", 8) == 0);
   ptq_free(out);
   // out-of-range id → error status
   int64_t bad[1] = {7};
-  CHECK(pts_request(c, kLookupRows, "emb", packed,
+  CHECK(pts_request(c, kLookupRows, "emb", packed, 0,
                     (const char*)bad, sizeof(bad), &out, &olen) == 1);
   ptq_free(out);
 
-  pts_request(c, kStop, "", 0, nullptr, 0, nullptr, nullptr);
+  pts_request(c, kStop, "", 0, 0, nullptr, 0, nullptr, nullptr);
   pts_client_close(c);
   pts_server_stop(srv);
   std::puts("ps async pop + lookup ok");
@@ -161,22 +165,97 @@ static void test_ps_barrier_deadline_and_rewait() {
   int port = pts_server_port(srv);
   void* c = pts_connect("127.0.0.1", port, 5.0);
   CHECK(c != nullptr);
-  CHECK(pts_request(c, kSendBarrier, "", 0, nullptr, 0, nullptr, nullptr)
+  CHECK(pts_request(c, kSendBarrier, "", 0, 0, nullptr, 0, nullptr,
+                      nullptr)
         == 2);  // timed out: stale-peer detection
   CHECK(pts_server_stat(srv, 0) == 1);  // send-barrier timeout counted
   // rewait (high bit set): times out again, still exactly one arrival
-  CHECK(pts_request(c, kSendBarrier, "", kPtsRewaitBit, nullptr, 0, nullptr,
-                    nullptr) == 2);
+  CHECK(pts_request(c, kSendBarrier, "", kPtsRewaitBit, 0, nullptr, 0,
+                    nullptr, nullptr) == 2);
   CHECK(pts_server_stat(srv, 0) == 2);
   // versioned GET_PARAM also honors the deadline
   char* out = nullptr;
   int64_t olen = 0;
-  CHECK(pts_request(c, kGetParam, "nope", 9, nullptr, 0, &out, &olen) == 2);
+  CHECK(pts_request(c, kGetParam, "nope", 9, 0, nullptr, 0, &out, &olen) == 2);
   ptq_free(out);
   CHECK(pts_server_stat(srv, 2) == 1);
   pts_client_close(c);
   pts_server_stop(srv);
   std::puts("ps barrier deadline + rewait ok");
+}
+
+static void test_ps_elastic_membership() {
+  // elastic quorum: two members join the idle job (activated immediately),
+  // run a round; one leaves gracefully — the next round completes with a
+  // quorum of ONE, and the membership blob reports the new epoch/count.
+  // Also: the span field of every served frame lands in the span journal.
+  void* srv = pts_server_start(0, 99);  // n_trainers ignored once elastic
+  CHECK(srv != nullptr);
+  pts_server_enable_elastic(srv, 0);  // no lease expiry in this test
+  int port = pts_server_port(srv);
+  void* a = pts_connect("127.0.0.1", port, 5.0);
+  void* b = pts_connect("127.0.0.1", port, 5.0);
+  CHECK(a && b);
+  char* out = nullptr;
+  int64_t olen = 0;
+  CHECK(pts_request(a, kJoin, "uid:a", 0, 7001, nullptr, 0, &out, &olen)
+        == 0);
+  CHECK(olen == 40);
+  uint64_t info[5];
+  std::memcpy(info, out, 40);
+  ptq_free(out);
+  CHECK(info[3] == 1 && info[4] == 0);  // count 1, index 0 (idle-activated)
+  CHECK(pts_request(b, kJoin, "uid:b", 0, 7002, nullptr, 0, &out, &olen)
+        == 0);
+  std::memcpy(info, out, 40);
+  ptq_free(out);
+  CHECK(info[3] == 2);              // both active
+  CHECK(pts_server_stat(srv, 6) == 2);  // active members
+  CHECK(pts_server_stat(srv, 7) == 2);  // joins
+
+  auto run_round = [&](int quorum, uint64_t r) {
+    // r = the members' completed-round count: elastic fetch barriers for
+    // an already-closed round ack immediately, so frames must carry the
+    // real round (exactly what the Python client's counter does)
+    std::vector<std::thread> ts;
+    const char* uids[2] = {"uid:a", "uid:b"};
+    void* conns[2] = {a, b};
+    for (int i = 0; i < quorum; ++i) {
+      ts.emplace_back([&, i] {
+        CHECK(pts_request(conns[i], kSendBarrier, uids[i], r, 0, nullptr, 0,
+                          nullptr, nullptr) == 0);
+        CHECK(pts_request(conns[i], kFetchBarrier, uids[i], r, 0, nullptr,
+                          0, nullptr, nullptr) == 0);
+      });
+    }
+    CHECK(pts_server_wait_round(srv) == 1);
+    pts_server_release_send(srv);
+    CHECK(pts_server_end_round(srv) == 1);
+    for (auto& t : ts) t.join();
+  };
+  run_round(2, 0);
+  CHECK(pts_server_stat(srv, 3) == 1);  // one completed round
+  // graceful leave: queued, applied at the NEXT round boundary — member b
+  // still counts for the in-flight round it announced the leave in
+  CHECK(pts_request(b, kLeave, "uid:b", 0, 0, nullptr, 0, nullptr, nullptr)
+        == 0);
+  run_round(2, 1);  // b participates in its announced round
+  CHECK(pts_server_stat(srv, 6) == 1);  // leave applied at the boundary
+  CHECK(pts_server_stat(srv, 8) == 1);  // leaves counted
+  run_round(1, 2);  // the shrunk quorum completes alone
+  CHECK(pts_server_stat(srv, 3) == 3);
+  // span journal captured the traced join frames
+  uint64_t spans[4 * 64];
+  int64_t n = pts_server_drain_spans(srv, spans, 64);
+  CHECK(n >= 2);
+  bool saw = false;
+  for (int64_t i = 0; i < n; ++i)
+    if (spans[i * 4] == kJoin && spans[i * 4 + 1] == 7001) saw = true;
+  CHECK(saw);
+  pts_client_close(a);
+  pts_client_close(b);
+  pts_server_stop(srv);
+  std::puts("ps elastic membership ok");
 }
 
 int main(int argc, char** argv) {
@@ -186,6 +265,7 @@ int main(int argc, char** argv) {
   test_ps_sync_round();
   test_ps_async_pop_and_lookup();
   test_ps_barrier_deadline_and_rewait();
+  test_ps_elastic_membership();
   std::puts("ALL NATIVE TESTS PASSED");
   return 0;
 }

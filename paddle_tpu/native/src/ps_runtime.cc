@@ -12,10 +12,15 @@
 // driver above — the transport stays dumb and fast.
 //
 // Wire format (little-endian), one request per frame:
-//   u8 cmd | u16 name_len | name | u64 round | u64 data_len | data
+//   u8 cmd | u16 name_len | name | u64 round | u64 span | u64 data_len |
+//   data
 // response:
 //   u8 status (0 ok, 1 stopped/error, 2 liveness-deadline timeout —
 //   retryable) | u64 data_len | data
+//
+// `span` is the client's span id for the attempt (0 = untraced); served
+// frames with a nonzero span are journaled (cmd, span, start, duration)
+// and drained by the driver for post-mortem client↔server attribution.
 //
 // Sync-round protocol (mirrors RunSyncLoop):
 //   trainers: SEND_GRAD*  SEND_BARRIER  GET_PARAM(round=r)*  FETCH_BARRIER
@@ -27,6 +32,18 @@
 // until the driver closed the round (end_round).  Without this, a fast
 // trainer could race into round r+1 — its barrier/grads arriving before the
 // driver resets round state — and be silently wiped (lost-wakeup deadlock).
+//
+// Elastic membership (pts_server_enable_elastic): the barrier arrival
+// count comes from the live MEMBER set instead of the fixed n_trainers.
+// Members join under a lease (kJoin, renewed by kLease heartbeats and by
+// barrier arrivals); a member whose lease expires while not parked in a
+// barrier is EVICTED inside the driver's wait predicates, so the count
+// renegotiates downward and the surviving round completes instead of
+// timing out.  Joins and graceful leaves apply at ROUND BOUNDARIES
+// (end_round, where every surviving trainer is parked in its fetch ack),
+// bumping the membership epoch — so every trainer's per-round view of
+// (epoch, index, count) is consistent.  The idle job (round 0, nothing
+// arrived yet) activates joins immediately: the launch cohort rendezvous.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -37,11 +54,14 @@
 #include <chrono>
 #include "native_api.h"
 
+#include <algorithm>
+#include <array>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -85,8 +105,22 @@ struct Frame {
   uint8_t cmd;
   std::string name;
   uint64_t round;
+  uint64_t span = 0;
   std::string data;
 };
+
+int64_t steady_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+uint64_t wall_us() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::microseconds>(
+          std::chrono::system_clock::now().time_since_epoch())
+          .count());
+}
 
 bool read_frame(int fd, Frame* f) {
   uint8_t cmd;
@@ -96,7 +130,9 @@ bool read_frame(int fd, Frame* f) {
   f->name.resize(nlen);
   if (nlen && !read_n(fd, &f->name[0], nlen)) return false;
   uint64_t dlen;
-  if (!read_n(fd, &f->round, 8) || !read_n(fd, &dlen, 8)) return false;
+  if (!read_n(fd, &f->round, 8) || !read_n(fd, &f->span, 8) ||
+      !read_n(fd, &dlen, 8))
+    return false;
   if (dlen > kMaxBlob) return false;
   f->data.resize(dlen);
   if (dlen && !read_n(fd, &f->data[0], dlen)) return false;
@@ -138,6 +174,178 @@ struct PSServer {
   int64_t stat_fetch_barrier_timeouts = 0;
   int64_t stat_get_timeouts = 0;
 
+  // --- elastic membership state ------------------------------------- //
+  // active members are the barrier arrival quorum; inactive entries are
+  // PENDING joins awaiting the next round boundary.  std::map keeps uids
+  // sorted so a member's index (its deterministic data-shard slot) is
+  // its rank in the active iteration order.
+  struct Member {
+    int64_t deadline_ms = 0;  // steady-clock lease expiry; 0 = no lease
+    bool active = false;
+  };
+  bool elastic = false;
+  int lease_timeout_ms = 0;
+  std::map<std::string, Member> members;
+  std::unordered_set<std::string> pending_leaves;
+  // PENDING members parked in a send barrier: their arrival was not
+  // counted (they are not in the quorum yet) — when activation lands at
+  // a boundary while they are STILL parked, the arrival retro-counts so
+  // a re-forming job (every active member gone) can complete its first
+  // round.  Cleared with send_ids at release_send: a released arrival
+  // was consumed and must never retro-count later.
+  std::unordered_set<std::string> pending_send_arrivals;
+  uint64_t epoch = 0;
+  // arrival count the in-flight round was completed with (wait_round /
+  // end_round may renegotiate below n_trainers mid-round)
+  int round_expected = 0;
+  int64_t stat_joins = 0, stat_leaves = 0, stat_evictions = 0;
+
+  // span journal: (cmd, span id, wall start us, handling duration us) of
+  // served frames carrying a nonzero span — drained by the driver
+  std::deque<std::array<uint64_t, 4>> span_log;
+  static constexpr size_t kMaxSpanLog = 8192;
+
+  int active_count() const {
+    int n = 0;
+    for (auto& kv : members)
+      if (kv.second.active) ++n;
+    return n;
+  }
+
+  // the barrier arrival quorum: live members when elastic, else the
+  // launch-time n_trainers (seed behavior, bit-for-bit)
+  int expected() const { return elastic ? active_count() : n_trainers; }
+
+  void renew_lease(const std::string& uid) {
+    if (!elastic || uid.empty()) return;
+    auto it = members.find(uid);
+    if (it != members.end() && lease_timeout_ms > 0)
+      it->second.deadline_ms = steady_ms() + lease_timeout_ms;
+  }
+
+  // true while nothing of the current round is in flight AND no round ever
+  // completed — the launch-cohort window where membership can change
+  // without any trainer holding a stale (epoch, index, count) view
+  bool idle_at_start() const {
+    return round_id == 0 && send_arrived == 0 && fetch_arrived == 0 &&
+           grads.empty();
+  }
+
+  // ROUND-BOUNDARY membership transition: activate pending joins, apply
+  // queued leaves, bump the epoch on any change.  Callers: end_round
+  // (after round_id++, before releasing fetch acks — every survivor is
+  // still parked, so nobody observes a half-applied epoch) and the
+  // idle-at-start join/leave fast path.
+  void apply_membership() {
+    bool changed = false;
+    for (auto& kv : members) {
+      if (!kv.second.active) {
+        kv.second.active = true;
+        changed = true;
+        // a newly-activated member still parked in its send barrier has
+        // an uncounted arrival — count it now (it is in the quorum as of
+        // this boundary, and it will not re-arrive)
+        auto it = pending_send_arrivals.find(kv.first);
+        if (it != pending_send_arrivals.end()) {
+          if (send_ids.insert(kv.first).second) ++send_arrived;
+          pending_send_arrivals.erase(it);
+        }
+      }
+    }
+    for (auto& uid : pending_leaves) {
+      if (members.erase(uid)) {
+        pending_send_arrivals.erase(uid);
+        ++stat_leaves;
+        changed = true;
+      }
+    }
+    pending_leaves.clear();
+    if (changed) ++epoch;
+  }
+
+  // lease sweep: evict expired members that are NOT parked in a barrier
+  // (a parked member is provably connected; its arrival already counted,
+  // so evicting it would corrupt the round math).  Runs inside the
+  // driver's wait predicates so a renegotiated count completes the
+  // surviving round.
+  void prune_expired() {
+    if (!elastic || lease_timeout_ms <= 0) return;
+    int64_t now = steady_ms();
+    bool changed = false;
+    for (auto it = members.begin(); it != members.end();) {
+      const std::string& uid = it->first;
+      if (it->second.deadline_ms > 0 && now > it->second.deadline_ms &&
+          !send_ids.count(uid) && !fetch_ids.count(uid)) {
+        pending_leaves.erase(uid);
+        pending_send_arrivals.erase(uid);
+        it = members.erase(it);
+        ++stat_evictions;
+        changed = true;
+      } else {
+        ++it;
+      }
+    }
+    if (changed) {
+      ++epoch;
+      cv.notify_all();
+    }
+  }
+
+  // barrier-arrival membership bookkeeping: an arrival IS proof of life
+  // (lease renewed); an arrival from a uid the member set has never seen
+  // (server restarted from a pre-join snapshot, an evicted member's
+  // delayed frame, or a caller that skipped the join protocol)
+  // implicitly JOINS — but under the same activation rule as kJoin:
+  // immediately only while the job is idle at round 0, PENDING (a
+  // boundary activates it) otherwise.  Activating mid-round would
+  // mutate the quorum and epoch other trainers already computed their
+  // round view from, and an arrival counted after the round's quorum
+  // was renegotiated would leak a permanent +1 into send_arrived.
+  // Returns true when the uid is PENDING (arrival must not count).
+  bool arrival_membership(const std::string& uid) {
+    if (!elastic || uid.empty()) return false;
+    auto mit = members.find(uid);
+    if (mit == members.end()) {
+      Member m;
+      m.active = idle_at_start();
+      if (lease_timeout_ms > 0)
+        m.deadline_ms = steady_ms() + lease_timeout_ms;
+      members.emplace(uid, m);
+      ++stat_joins;
+      if (m.active) ++epoch;
+      return !m.active;
+    }
+    renew_lease(uid);
+    return !mit->second.active;
+  }
+
+  // the 40-byte membership reply: epoch | round | version | count | index
+  std::string membership_blob(const std::string& uid) {
+    uint64_t vals[5];
+    vals[0] = epoch;
+    vals[1] = round_id;
+    vals[2] = version;
+    vals[3] = static_cast<uint64_t>(active_count());
+    vals[4] = ~0ull;
+    uint64_t idx = 0;
+    for (auto& kv : members) {
+      if (!kv.second.active) continue;
+      if (kv.first == uid) {
+        vals[4] = idx;
+        break;
+      }
+      ++idx;
+    }
+    return std::string(reinterpret_cast<const char*>(vals), sizeof(vals));
+  }
+
+  // poll cadence for elastic driver waits: fine enough to evict within a
+  // fraction of the lease, never busier than 10 ms
+  std::chrono::milliseconds elastic_poll() const {
+    int ms = lease_timeout_ms > 0 ? lease_timeout_ms / 4 : 500;
+    return std::chrono::milliseconds(std::min(500, std::max(10, ms)));
+  }
+
   // wait on cv with the liveness deadline; returns false on timeout
   template <class Pred>
   bool wait_alive(std::unique_lock<std::mutex>& lk, Pred pred) {
@@ -153,21 +361,42 @@ struct PSServer {
   std::vector<std::thread> conn_threads;
   std::vector<int> conn_fds;
 
+  void log_span(uint8_t cmd, uint64_t span, uint64_t start_us,
+                uint64_t dur_us) {
+    if (!span) return;
+    std::lock_guard<std::mutex> lk(mu);
+    if (span_log.size() >= kMaxSpanLog) span_log.pop_front();
+    span_log.push_back({static_cast<uint64_t>(cmd), span, start_us, dur_us});
+  }
+
   void serve_conn(int fd) {
     Frame f;
     while (read_frame(fd, &f)) {
-      std::unique_lock<std::mutex> lk(mu);
-      if (stopped && f.cmd != kStop) {
-        write_response(fd, 1, "");
-        break;
-      }
-      switch (f.cmd) {
+      uint64_t t_start = wall_us();
+      auto t0 = std::chrono::steady_clock::now();
+      bool keep = handle_frame(fd, f);
+      log_span(f.cmd, f.span, t_start,
+               static_cast<uint64_t>(
+                   std::chrono::duration_cast<std::chrono::microseconds>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count()));
+      if (!keep) return;
+    }
+  }
+
+  // serve one frame; returns false when the connection should close
+  bool handle_frame(int fd, Frame& f) {
+    std::unique_lock<std::mutex> lk(mu);
+    if (stopped && f.cmd != kStop) {
+      write_response(fd, 1, "");
+      return false;
+    }
+    switch (f.cmd) {
         case kSendGrad:
           grads.emplace_back(f.name, std::move(f.data));
           cv.notify_all();  // wake a driver parked in pop_grad (async mode)
           lk.unlock();
-          if (!write_response(fd, 0, "")) return;
-          break;
+          return write_response(fd, 0, "");
         case kLookupRows: {
           // round packs (header_offset << 32) | row_width_bytes: published
           // blobs carry the Python codec's dtype header before the raw rows
@@ -178,8 +407,7 @@ struct PSServer {
               it->second.size() < offset ||
               f.data.size() % sizeof(int64_t) != 0) {
             lk.unlock();
-            if (!write_response(fd, 1, "")) return;
-            break;
+            return write_response(fd, 1, "");
           }
           const std::string& blob = it->second;
           size_t n_rows = (blob.size() - offset) / width;
@@ -198,15 +426,13 @@ struct PSServer {
                      width);
           }
           lk.unlock();
-          if (!write_response(fd, ok ? 0 : 1, ok ? out : "")) return;
-          break;
+          return write_response(fd, ok ? 0 : 1, ok ? out : "");
         }
         case kSendParam:
           table[f.name] = std::move(f.data);
           cv.notify_all();
           lk.unlock();
-          if (!write_response(fd, 0, "")) return;
-          break;
+          return write_response(fd, 0, "");
         case kSendBarrier: {
           // f.round carries the trainer's completed-round count; the
           // rewait bit marks a retry of a timed-out wait — the trainer
@@ -214,6 +440,13 @@ struct PSServer {
           // retry is idempotent; see the client's _barrier loop).
           uint64_t rc = f.round & ~kPtsRewaitBit;
           if ((f.round & kPtsRewaitBit) == 0) {
+            // elastic: an arrival is proof of life (lease renewed), and
+            // an unknown uid implicitly joins ACTIVE — see
+            // arrival_membership.  A PENDING (not yet activated) member
+            // parks without being counted: it is not part of this
+            // round's quorum — its activation lands at the next round
+            // boundary.
+            bool pending = arrival_membership(f.name);
             // a fresh client (relaunched trainer, re-dialed channel)
             // arrives with a LOW count: it means "ack when the round I'm
             // joining completes", i.e. the server's current round.  A
@@ -228,8 +461,10 @@ struct PSServer {
             bool late = send_ack_round > rc;
             // identity-deduped arrival: a re-arrive after a reconnect on
             // a SURVIVING server is a no-op (its first arrival stands)
-            if (!late && (f.name.empty() || send_ids.insert(f.name).second))
+            if (!late && !pending &&
+                (f.name.empty() || send_ids.insert(f.name).second))
               ++send_arrived;
+            if (pending) pending_send_arrivals.insert(f.name);
           }
           cv.notify_all();
           // ack deferred until the driver released this round's sends
@@ -243,21 +478,32 @@ struct PSServer {
             std::string eff(8, '\0');
             ::memcpy(&eff[0], &rc, 8);
             lk.unlock();
-            if (!write_response(fd, 2, eff)) return;
-            break;  // keep the connection: the trainer rewaits on it
+            // keep the connection on success: the trainer rewaits on it
+            return write_response(fd, 2, eff);
           }
           bool ok = !stopped;
           lk.unlock();
-          if (!write_response(fd, ok ? 0 : 1, "")) return;
-          if (!ok) return;
-          break;
+          if (!write_response(fd, ok ? 0 : 1, "")) return false;
+          return ok;
         }
         case kFetchBarrier: {
           uint64_t rc = f.round & ~kPtsRewaitBit;
           if ((f.round & kPtsRewaitBit) == 0) {
-            if (f.name.empty() || fetch_ids.insert(f.name).second)
+            bool pending = arrival_membership(f.name);
+            // elastic: a fetch for a round that ALREADY closed (a member
+            // activated at the boundary whose round-r fetch lost the
+            // race with end_round) is acked immediately and never
+            // counted — flooring it into the CURRENT round's quorum
+            // would park the member in fetch while wait_round waits for
+            // its send: a livelock.  The fixed-quorum lane keeps the
+            // flooring: there a fresh client's fetch must fill the dead
+            // trainer's slot for the in-flight round.
+            bool closed = elastic && round_id > rc;
+            if (!pending && !closed &&
+                (f.name.empty() || fetch_ids.insert(f.name).second))
               ++fetch_arrived;
-            if (round_id > rc) rc = round_id;  // same fresh-client rule
+            if (!closed && round_id > rc)
+              rc = round_id;  // same fresh-client rule (non-elastic)
           }
           cv.notify_all();
           bool done = wait_alive(lk, [&] { return stopped || round_id > rc; });
@@ -266,14 +512,44 @@ struct PSServer {
             std::string eff(8, '\0');
             ::memcpy(&eff[0], &rc, 8);
             lk.unlock();
-            if (!write_response(fd, 2, eff)) return;
-            break;
+            return write_response(fd, 2, eff);
           }
           bool ok = !stopped;
           lk.unlock();
-          if (!write_response(fd, ok ? 0 : 1, "")) return;
-          if (!ok) return;
-          break;
+          if (!write_response(fd, ok ? 0 : 1, "")) return false;
+          return ok;
+        }
+        case kJoin: {
+          if (!elastic || f.name.empty()) {
+            lk.unlock();
+            return write_response(fd, 1, "");
+          }
+          // same find-or-create + activation rule as an implicit
+          // barrier-frame join (idle job activates immediately, running
+          // job queues for the boundary; re-join renews the lease)
+          arrival_membership(f.name);
+          pending_leaves.erase(f.name);  // a re-join cancels a queued leave
+          cv.notify_all();
+          std::string blob = membership_blob(f.name);
+          lk.unlock();
+          return write_response(fd, 0, blob);
+        }
+        case kLease: {
+          // heartbeat + membership query (also answers non-members, so a
+          // delayed joiner can watch the round counter before joining)
+          renew_lease(f.name);
+          std::string blob = membership_blob(f.name);
+          lk.unlock();
+          return write_response(fd, 0, blob);
+        }
+        case kLeave: {
+          if (elastic && !f.name.empty() && members.count(f.name)) {
+            pending_leaves.insert(f.name);
+            if (idle_at_start()) apply_membership();
+            cv.notify_all();
+          }
+          lk.unlock();
+          return write_response(fd, 0, "");
         }
         case kGetParam: {
           uint64_t want = f.round;
@@ -283,17 +559,16 @@ struct PSServer {
           if (!done) {
             ++stat_get_timeouts;
             lk.unlock();
-            if (!write_response(fd, 2, "")) return;
-            break;  // GET_PARAM is idempotent: the client re-sends it
+            // GET_PARAM is idempotent: the client re-sends it
+            return write_response(fd, 2, "");
           }
           if (stopped) {
             write_response(fd, 1, "");
-            return;
+            return false;
           }
           std::string blob = table[f.name];
           lk.unlock();
-          if (!write_response(fd, 0, blob)) return;
-          break;
+          return write_response(fd, 0, blob);
         }
         case kCheckpointNotify: {
           // snapshot the table to the requested path (reference pservers
@@ -301,36 +576,40 @@ struct PSServer {
           // the lock; disk IO and the response write happen UNLOCKED — a
           // stalled notifier must not wedge every other connection.
           auto copy = table;
-          uint64_t ver = version, rid = round_id;
+          auto mcopy = members;
+          uint64_t ver = version, rid = round_id, ep = epoch;
           lk.unlock();
-          bool ok = write_snapshot(f.name, copy, ver, rid);
-          if (!write_response(fd, ok ? 0 : 1, "")) return;
-          break;
+          bool ok = write_snapshot(f.name, copy, ver, rid, ep, mcopy);
+          return write_response(fd, ok ? 0 : 1, "");
         }
         case kStop:
           stopped = true;
           cv.notify_all();
           lk.unlock();
           write_response(fd, 0, "");
-          return;
+          return false;
         default:
           lk.unlock();
           write_response(fd, 1, "");
-          return;
-      }
+          return false;
     }
   }
 
   // Snapshot file format (little-endian):
-  //   u64 magic 0x50545343'4B505430 ("PTSCKPT0") | u64 version |
-  //   u64 round_id | u64 count | count × (u16 name_len | name |
-  //   u64 blob_len | blob)
+  //   u64 magic "PTSCKPT0"/"PTSCKPT1" | u64 version | u64 round_id |
+  //   u64 count | count × (u16 name_len | name | u64 blob_len | blob)
+  // The v1 magic appends a membership section so an elastic shard's
+  // restart resumes with its quorum (active member uids) and epoch:
+  //   u64 epoch | u64 n_members | n × (u16 uid_len | uid)
+  // v0 files (no member section) stay loadable.
   static constexpr uint64_t kCkptMagic = 0x505453434B505430ull;
+  static constexpr uint64_t kCkptMagicV1 = 0x505453434B505431ull;
 
   static bool write_snapshot(
       const std::string& path,
       const std::unordered_map<std::string, std::string>& copy,
-      uint64_t ver, uint64_t rid) {
+      uint64_t ver, uint64_t rid, uint64_t ep,
+      const std::map<std::string, Member>& mcopy) {
     // write-to-temp + rename: a crash mid-save (the supervised pserver
     // snapshots EVERY round, so the window recurs constantly) must never
     // truncate the previous good snapshot the relaunch depends on
@@ -338,7 +617,7 @@ struct PSServer {
     FILE* fp = ::fopen(tmp.c_str(), "wb");
     if (!fp) return false;
     bool ok = true;
-    uint64_t magic = kCkptMagic, count = copy.size();
+    uint64_t magic = kCkptMagicV1, count = copy.size();
     ok &= ::fwrite(&magic, 8, 1, fp) == 1;
     ok &= ::fwrite(&ver, 8, 1, fp) == 1;
     ok &= ::fwrite(&rid, 8, 1, fp) == 1;
@@ -351,6 +630,17 @@ struct PSServer {
       ok &= ::fwrite(&blen, 8, 1, fp) == 1;
       ok &= blen == 0 || ::fwrite(kv.second.data(), blen, 1, fp) == 1;
     }
+    uint64_t n_members = 0;
+    for (auto& kv : mcopy)
+      if (kv.second.active) ++n_members;
+    ok &= ::fwrite(&ep, 8, 1, fp) == 1;
+    ok &= ::fwrite(&n_members, 8, 1, fp) == 1;
+    for (auto& kv : mcopy) {
+      if (!kv.second.active) continue;
+      uint16_t ulen = static_cast<uint16_t>(kv.first.size());
+      ok &= ::fwrite(&ulen, 2, 1, fp) == 1;
+      ok &= ulen == 0 || ::fwrite(kv.first.data(), ulen, 1, fp) == 1;
+    }
     ok &= ::fclose(fp) == 0;
     if (ok) ok = ::rename(tmp.c_str(), path.c_str()) == 0;
     if (!ok) ::remove(tmp.c_str());
@@ -362,7 +652,8 @@ struct PSServer {
     if (!fp) return false;
     auto rd = [&](void* p, size_t n) { return ::fread(p, n, 1, fp) == 1; };
     uint64_t magic = 0, ver = 0, rid = 0, count = 0;
-    bool ok = rd(&magic, 8) && magic == kCkptMagic && rd(&ver, 8) &&
+    bool ok = rd(&magic, 8) &&
+              (magic == kCkptMagic || magic == kCkptMagicV1) && rd(&ver, 8) &&
               rd(&rid, 8) && rd(&count, 8) && count < (1ull << 32);
     std::unordered_map<std::string, std::string> loaded;
     for (uint64_t i = 0; ok && i < count; ++i) {
@@ -376,6 +667,22 @@ struct PSServer {
       ok = ok && (blen == 0 || rd(&blob[0], blen));
       if (ok) loaded.emplace(std::move(name), std::move(blob));
     }
+    uint64_t ep = 0, n_members = 0;
+    std::map<std::string, Member> mloaded;
+    if (ok && magic == kCkptMagicV1) {
+      ok = rd(&ep, 8) && rd(&n_members, 8) && n_members < (1ull << 20);
+      for (uint64_t i = 0; ok && i < n_members; ++i) {
+        uint16_t ulen = 0;
+        ok = rd(&ulen, 2);
+        std::string uid(ulen, '\0');
+        ok = ok && (ulen == 0 || rd(&uid[0], ulen));
+        if (ok) {
+          Member m;
+          m.active = true;
+          mloaded.emplace(std::move(uid), m);
+        }
+      }
+    }
     ::fclose(fp);
     if (!ok) return false;
     std::lock_guard<std::mutex> lk(mu);
@@ -385,6 +692,16 @@ struct PSServer {
     // a restarted shard resumes mid-protocol: trainers re-arriving with
     // completed-round count == rid must wait for the NEXT release
     send_ack_round = rid;
+    // elastic: restore the quorum with FRESH leases — the restored
+    // members get one lease window to re-arrive; the survivors renew on
+    // their first frame and a member that died with the old server is
+    // evicted, renegotiating the count (double-failure path)
+    if (elastic && !mloaded.empty()) {
+      int64_t dl = lease_timeout_ms > 0 ? steady_ms() + lease_timeout_ms : 0;
+      for (auto& kv : mloaded) kv.second.deadline_ms = dl;
+      members = std::move(mloaded);
+      epoch = ep;
+    }
     cv.notify_all();
     return true;
   }
@@ -456,8 +773,18 @@ void pts_server_set_barrier_timeout_ms(void* h, int ms) {
   s->barrier_timeout_ms = ms;
 }
 
+// switch the barrier quorum from the fixed n_trainers to the live member
+// set, with lease-based eviction (0 = members never expire)
+void pts_server_enable_elastic(void* h, int lease_timeout_ms) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->elastic = true;
+  s->lease_timeout_ms = lease_timeout_ms;
+}
+
 // resilience counters: 0 send-barrier timeouts, 1 fetch-barrier timeouts,
-// 2 get-param timeouts, 3 completed rounds, 4 published version
+// 2 get-param timeouts, 3 completed rounds, 4 published version,
+// 5 membership epoch, 6 active members, 7 joins, 8 leaves, 9 evictions
 int64_t pts_server_stat(void* h, int which) {
   auto* s = static_cast<PSServer*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
@@ -467,17 +794,64 @@ int64_t pts_server_stat(void* h, int which) {
     case 2: return s->stat_get_timeouts;
     case 3: return static_cast<int64_t>(s->round_id);
     case 4: return static_cast<int64_t>(s->version);
+    case 5: return static_cast<int64_t>(s->epoch);
+    case 6: return s->active_count();
+    case 7: return s->stat_joins;
+    case 8: return s->stat_leaves;
+    case 9: return s->stat_evictions;
     default: return -1;
   }
 }
 
-// 1 = round ready (all trainers hit send_barrier), 0 = stopped
+// drain journaled (cmd, span, start us, dur us) records; out must hold
+// 4 * max_records u64s
+int64_t pts_server_drain_spans(void* h, uint64_t* out, int64_t max_records) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  int64_t n = 0;
+  while (n < max_records && !s->span_log.empty()) {
+    auto& rec = s->span_log.front();
+    for (int k = 0; k < 4; ++k) out[n * 4 + k] = rec[k];
+    s->span_log.pop_front();
+    ++n;
+  }
+  return n;
+}
+
+// 1 = round ready (quorum hit send_barrier), 0 = stopped.  Elastic mode
+// polls so an expired lease renegotiates the quorum downward and the
+// surviving round completes instead of waiting out the dead peer.
 int pts_server_wait_round(void* h) {
   auto* s = static_cast<PSServer*>(h);
   std::unique_lock<std::mutex> lk(s->mu);
-  s->cv.wait(lk, [s] {
-    return s->stopped || s->send_arrived >= s->n_trainers;
-  });
+  auto pred = [s] {
+    if (s->stopped) return true;
+    if (s->elastic) {
+      s->prune_expired();
+      // a job whose entire quorum died can RE-FORM here: with no active
+      // members left, nobody holds a stale per-round view, so pending
+      // joins (and parked pending arrivals) activate immediately — the
+      // only other activation point, end_round, is unreachable while
+      // this wait blocks
+      if (s->expected() == 0) s->apply_membership();
+      int exp = s->expected();
+      if (exp > 0 && s->send_arrived >= exp) {
+        s->round_expected = exp;
+        return true;
+      }
+      return false;
+    }
+    if (s->send_arrived >= s->n_trainers) {
+      s->round_expected = s->n_trainers;
+      return true;
+    }
+    return false;
+  };
+  if (s->elastic && s->lease_timeout_ms > 0) {
+    while (!pred()) s->cv.wait_for(lk, s->elastic_poll());
+  } else {
+    s->cv.wait(lk, pred);
+  }
   return s->stopped ? 0 : 1;
 }
 
@@ -486,8 +860,17 @@ void pts_server_release_send(void* h) {
   auto* s = static_cast<PSServer*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
   s->send_ack_round = s->round_id + 1;
-  s->send_arrived -= s->n_trainers;
+  // subtract the quorum the round actually completed with — under
+  // elastic renegotiation that may be below n_trainers
+  s->send_arrived -= s->round_expected ? s->round_expected : s->n_trainers;
+  // a member leaving the park is alive NOW: renew before send_ids (its
+  // eviction shield while parked) clears, or a round that out-waited the
+  // lease would evict its own survivors the moment it releases them
+  for (auto& uid : s->send_ids) s->renew_lease(uid);
   s->send_ids.clear();  // next round's arrivals dedupe afresh
+  // released pending arrivals were consumed by this ack — they must not
+  // retro-count into a later round at activation
+  s->pending_send_arrivals.clear();
   s->cv.notify_all();
 }
 
@@ -559,18 +942,47 @@ void pts_server_bump_version(void* h) {
 
 // wait for all fetch barriers, close the round, release the trainers;
 // 1 = ok, 0 = stopped.  No round r+1 message can arrive before this resets
-// state: every trainer is still parked in its FETCH_BARRIER ack.
+// state: every trainer is still parked in its FETCH_BARRIER ack.  Elastic
+// mode renegotiates the quorum here too (a member that died after its
+// send barrier becomes evictable once release_send cleared send_ids), and
+// this is THE round boundary where queued joins/leaves apply: every
+// survivor is parked in its fetch ack, so the epoch flips atomically
+// before anyone computes its next-round (index, count) view.
 int pts_server_end_round(void* h) {
   auto* s = static_cast<PSServer*>(h);
   std::unique_lock<std::mutex> lk(s->mu);
-  s->cv.wait(lk, [s] {
-    return s->stopped || s->fetch_arrived >= s->n_trainers;
-  });
+  int used = 0;
+  auto pred = [s, &used] {
+    if (s->stopped) return true;
+    if (s->elastic) {
+      s->prune_expired();
+      int exp = s->expected();
+      // exp == 0: every member evicted mid-fetch — close the round so a
+      // future joiner finds the server at a clean boundary
+      if (exp == 0 || s->fetch_arrived >= exp) {
+        used = std::min(exp, s->fetch_arrived);
+        return true;
+      }
+      return false;
+    }
+    if (s->fetch_arrived >= s->n_trainers) {
+      used = s->n_trainers;
+      return true;
+    }
+    return false;
+  };
+  if (s->elastic && s->lease_timeout_ms > 0) {
+    while (!pred()) s->cv.wait_for(lk, s->elastic_poll());
+  } else {
+    s->cv.wait(lk, pred);
+  }
   if (s->stopped) return 0;
   s->grads.clear();
-  s->fetch_arrived -= s->n_trainers;
+  s->fetch_arrived -= used;
+  for (auto& uid : s->fetch_ids) s->renew_lease(uid);  // see release_send
   s->fetch_ids.clear();
   ++s->round_id;
+  if (s->elastic) s->apply_membership();
   s->cv.notify_all();
   return 1;
 }
@@ -597,14 +1009,17 @@ int pts_server_wait_table(void* h, const char* name) {
 int pts_server_save(void* h, const char* path) {
   auto* s = static_cast<PSServer*>(h);
   std::unordered_map<std::string, std::string> copy;
-  uint64_t ver, rid;
+  std::map<std::string, PSServer::Member> mcopy;
+  uint64_t ver, rid, ep;
   {
     std::lock_guard<std::mutex> lk(s->mu);
     copy = s->table;
+    mcopy = s->members;
     ver = s->version;
     rid = s->round_id;
+    ep = s->epoch;
   }
-  return PSServer::write_snapshot(path, copy, ver, rid) ? 1 : 0;
+  return PSServer::write_snapshot(path, copy, ver, rid, ep, mcopy) ? 1 : 0;
 }
 
 // restore the table (+version/round) from a snapshot; 1 ok, 0 failed
@@ -659,9 +1074,10 @@ void* pts_connect(const char* host, int port, double timeout_s) {
 
 // generic request; returns status (0 ok, 1 error, -1 io failure).  For
 // kGetParam the payload lands in *out (caller frees via ptq_free), length in
-// *olen.
+// *olen.  `span` rides every frame (0 = untraced attempt).
 int pts_request(void* h, int cmd, const char* name, uint64_t round,
-                const char* data, int64_t dlen, char** out, int64_t* olen) {
+                uint64_t span, const char* data, int64_t dlen, char** out,
+                int64_t* olen) {
   auto* c = static_cast<PSClient*>(h);
   std::lock_guard<std::mutex> lk(c->mu);
   uint8_t cmd8 = static_cast<uint8_t>(cmd);
@@ -669,6 +1085,7 @@ int pts_request(void* h, int cmd, const char* name, uint64_t round,
   uint64_t dl = static_cast<uint64_t>(dlen < 0 ? 0 : dlen);
   if (!write_n(c->fd, &cmd8, 1) || !write_n(c->fd, &nlen, 2) ||
       !write_n(c->fd, name, nlen) || !write_n(c->fd, &round, 8) ||
+      !write_n(c->fd, &span, 8) ||
       !write_n(c->fd, &dl, 8) || (dl && !write_n(c->fd, data, dl)))
     return -1;
   uint8_t status;

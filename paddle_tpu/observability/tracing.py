@@ -26,8 +26,8 @@ import os
 import threading
 import uuid
 
-__all__ = ["job_trace_id", "new_span_id", "run_id", "process_role",
-           "process_rank", "process_identity"]
+__all__ = ["job_trace_id", "new_span_id", "new_wire_span", "format_wire_span",
+           "run_id", "process_role", "process_rank", "process_identity"]
 
 _TRACE_ENV = "PT_TRACE_ID"
 _RUN_ENV = "PT_RUN_ID"
@@ -65,6 +65,26 @@ def new_span_id() -> str:
         _span_counter += 1
         n = _span_counter
     return f"{os.getpid():x}-{n:x}"
+
+
+def new_wire_span():
+    """Mint one span id in BOTH encodings: the u64 that rides the PS RPC
+    frame (`(pid << 32) | counter`) and the `pid-counter` hex string every
+    other telemetry surface uses — the same id, so a client-side `rpc`
+    event and the server's journaled handling record correlate exactly.
+    Returns (wire_u64, span_str)."""
+    global _span_counter
+    with _lock:
+        _span_counter += 1
+        n = _span_counter
+    pid = os.getpid()
+    return ((pid & 0xffffffff) << 32) | (n & 0xffffffff), f"{pid:x}-{n:x}"
+
+
+def format_wire_span(wire: int) -> str:
+    """The `pid-counter` string form of a u64 wire span id (the server's
+    span journal hands back raw u64s)."""
+    return f"{(wire >> 32) & 0xffffffff:x}-{wire & 0xffffffff:x}"
 
 
 def process_role() -> str:

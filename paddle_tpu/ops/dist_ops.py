@@ -106,7 +106,9 @@ def reset_channels():
     """Drop all cached trainer→pserver connections (tests, re-transpile).
     Idempotent and failure-proof: the cache is emptied FIRST, then each
     close runs independently, so one wedged channel can neither keep the
-    others cached nor make a second call misbehave."""
+    others cached nor make a second call misbehave.  The elastic lease
+    heartbeat (if running) stops with its channels."""
+    stop_job_heartbeat()
     with _channels_lock:
         chans = list(_channels.values())
         _channels.clear()
@@ -316,6 +318,27 @@ def _ps_init_sync_run(scope, op, place):
             # row-sharded table/accumulator: each server gets its row slice
             arr = np.asarray(scope.get(name))
             get_channel(ep).client.send_param(name, arr[int(start):int(end)])
+    # elastic membership (FLAGS_elastic_ps, sync mode): JOIN every shard
+    # and rendezvous the launch cohort / poll a mid-job join until active,
+    # then keep the lease warm with the sidecar heartbeat.  The join runs
+    # BEFORE the param pulls: a mid-job joiner activates at a round
+    # boundary, and pulling AFTER activation is what makes its first
+    # forward run on exactly the round-entry table version — a pre-join
+    # pull would be one or more rounds stale, and the joiner's first-round
+    # gradient would silently break step parity with the uninterrupted
+    # run.  Channels come out with their round counters synced to the
+    # join round, so the joiner's barriers target the round it enters.
+    from paddle_tpu.fluid import flags as _flags
+
+    if _flags.flag("elastic_ps") and op.attrs.get("sync_mode", True):
+        from paddle_tpu.distributed import elastic
+
+        endpoints = op.attrs.get("endpoints") or sorted(
+            {ep for _n, ep in list(pull_vars) + list(push_vars)} |
+            {ep for _n, ep, _s, _e in push_slices})
+        if endpoints:
+            elastic.join_job(endpoints)
+            _start_job_heartbeat(endpoints)
     for name, ep in pull_vars:
         var = op.block._find_var_recursive(name) if op.block is not None else None
         arr = get_channel(ep).client.get_param(name, want_version=0)
@@ -324,6 +347,30 @@ def _ps_init_sync_run(scope, op, place):
         scope.set(name, arr)
         if name in shadows:
             scope.set(name + "@GEO_SHADOW", np.array(arr, copy=True))
+
+
+_job_heartbeat = None
+_job_heartbeat_lock = threading.Lock()
+
+
+def _start_job_heartbeat(endpoints):
+    """One process-wide lease-heartbeat sidecar for the trainer's shard
+    set (idempotent — ps_init_sync may rerun on re-transpile)."""
+    global _job_heartbeat
+    from paddle_tpu.distributed import elastic
+
+    with _job_heartbeat_lock:
+        if _job_heartbeat is None:
+            _job_heartbeat = elastic.LeaseHeartbeat(endpoints).start()
+    return _job_heartbeat
+
+
+def stop_job_heartbeat():
+    global _job_heartbeat
+    with _job_heartbeat_lock:
+        hb, _job_heartbeat = _job_heartbeat, None
+    if hb is not None:
+        hb.stop()
 
 
 _geo_state: dict = {}
@@ -469,6 +516,74 @@ def _serv_init(server, blocks, local):
     return True
 
 
+class _SnapshotCadence:
+    """When a pserver snapshot is due: every `every_rounds` completed
+    rounds (the supervised default), or — with `interval_s` > 0
+    (FLAGS_ps_snapshot_interval_s) — at most once per `interval_s`
+    seconds, decoupled from rounds.  Time-based cadence is how the
+    async/geo lanes (no rounds worth snapshotting on) get crash recovery
+    without per-event IO, and how a fast sync lane thins per-round
+    snapshots."""
+
+    def __init__(self, interval_s=0.0, every_rounds=1, _clock=None):
+        import time as _time
+
+        self.interval_s = float(interval_s or 0.0)
+        self.every_rounds = max(1, int(every_rounds))
+        self._clock = _clock or _time.monotonic
+        self._last = self._clock()
+
+    def due(self, rounds=None):
+        if self.interval_s > 0:
+            now = self._clock()
+            if now - self._last >= self.interval_s:
+                self._last = now
+                return True
+            return False
+        if rounds is None:  # round-free lane with no interval: never due
+            return False
+        return rounds % self.every_rounds == 0
+
+
+def _snapshot_state(server, blocks, local, snap_path):
+    """Republish the full shard state (params AND optimizer accumulators)
+    from the local scope, then write the snapshot (temp+rename inside the
+    native save — a crash mid-save never truncates the last good one)."""
+    for blk in blocks:
+        for name in blk[3]:  # state: param + accumulators + lr
+            v = local.get(name)
+            if v is not None:
+                server.publish(name, np.asarray(v))
+    server.save(snap_path)
+
+
+def _drain_server_spans(server):
+    """Re-emit the native span journal — (cmd, client span id, wall
+    start, duration) per served RPC — as `serve_rpc` JSONL events and
+    `rpc_serve:` profiler spans tagged with the CLIENT's span id, so a
+    merged post-mortem trace attributes server-side command handling to
+    the requesting client across restarts (the id embeds the client
+    pid)."""
+    from paddle_tpu.fluid import profiler as _prof
+    from paddle_tpu.observability import events as _events
+
+    ev_on = _events.enabled()
+    prof_on = _prof.is_profiler_enabled()
+    if not (ev_on or prof_on):
+        # nothing consumes the journal: leave it alone — the native ring
+        # buffer self-caps (kMaxSpanLog), so skipping the drain avoids a
+        # per-round decode of records that would only be thrown away
+        return
+    for cmd, span, start_wall, dur in server.drain_spans():
+        if prof_on:
+            _prof._record("rpc_serve", f"rpc_serve:{cmd}", dur,
+                          start=_prof.wall_to_session(start_wall),
+                          args={"client_span": span})
+        if ev_on:
+            _events.emit("serve_rpc", cmd=cmd, client_span=span,
+                         seconds=round(dur, 6))
+
+
 def _serv_sync_loop(server, blocks, local, exe, snap_path=None,
                     snap_every=1):
     """RunSyncLoop: rendezvous rounds; dense grads averaged, SelectedRows
@@ -477,12 +592,14 @@ def _serv_sync_loop(server, blocks, local, exe, snap_path=None,
 
     With `snap_path` set (supervised mode, PT_PS_SNAPSHOT_DIR), the full
     shard state — params AND optimizer accumulators, republished from the
-    local scope — snapshots every `snap_every` completed rounds, so a
+    local scope — snapshots every `snap_every` completed rounds (or on
+    the FLAGS_ps_snapshot_interval_s time cadence when set), so a
     relaunched pserver resumes exactly where the job was."""
     import time as _time
 
     from paddle_tpu import observability as _obs
     from paddle_tpu.distributed import fault_injection
+    from paddle_tpu.fluid import flags as _flags
     from paddle_tpu.fluid import profiler as _prof
     from paddle_tpu.observability import events as _events
 
@@ -490,9 +607,13 @@ def _serv_sync_loop(server, blocks, local, exe, snap_path=None,
         "pt_ps_round_seconds",
         "Pserver sync-round handling time (merge + optimize + publish, "
         "excluding the wait for trainer arrivals)")
+    cadence = _SnapshotCadence(
+        interval_s=_flags.flag("ps_snapshot_interval_s"),
+        every_rounds=snap_every)
     # the driver's round wait is unbounded by design: server.stop()
-    # (teardown) unblocks it, and trainer-side liveness is covered by the
-    # barrier deadline answering the trainers themselves
+    # (teardown) unblocks it, trainer-side liveness is covered by the
+    # barrier deadline answering the trainers themselves, and under
+    # elastic membership the wait itself renegotiates around dead peers
     while server.wait_round():  # resilience: allow
         t_round = _time.perf_counter()
         received = {}
@@ -524,26 +645,35 @@ def _serv_sync_loop(server, blocks, local, exe, snap_path=None,
         _prof._record("ps", "ps:round", round_s)
         if not server.end_round():
             break
-        rounds = server.stats()["rounds"]  # absolute (snapshot-continuous)
+        st = server.stats()  # also mirrors membership gauges
+        rounds = st["rounds"]  # absolute (snapshot-continuous)
         if _events.enabled():
             _events.emit("round_end", round=int(rounds),
                          seconds=round(round_s, 6),
-                         n_grads=sum(len(v) for v in received.values()))
-        if snap_path and rounds % max(1, snap_every) == 0:
-            for blk in blocks:
-                for name in blk[3]:  # state: param + accumulators + lr
-                    v = local.get(name)
-                    if v is not None:
-                        server.publish(name, np.asarray(v))
-            server.save(snap_path)
-        # deterministic pserver-kill hook (kill:round:<k> in PT_FAULT_PLAN)
+                         n_grads=sum(len(v) for v in received.values()),
+                         epoch=int(st["epoch"]), members=int(st["members"]))
+        _drain_server_spans(server)
+        if snap_path and cadence.due(rounds):
+            _snapshot_state(server, blocks, local, snap_path)
+        # deterministic pserver kill/preempt hook (kill:round:<k> /
+        # preempt:round:<k> in PT_FAULT_PLAN)
         fault_injection.on_round(rounds)
 
 
-def _serv_async_loop(server, blocks, local, exe):
+def _serv_async_loop(server, blocks, local, exe, snap_path=None):
     """RunAsyncLoop (listen_and_serv_op.cc): no barriers — every pushed
     grad is applied the moment it arrives and the param republished.
-    `{param}@DELTA` pushes are geo-SGD folds: param += delta."""
+    `{param}@DELTA` pushes are geo-SGD folds: param += delta.
+
+    With `snap_path` + FLAGS_ps_snapshot_interval_s > 0, the shard
+    snapshots on the time cadence (checked on every loop tick — the
+    0.2 s pop timeout bounds the lag), so async/geo-SGD lanes get crash
+    recovery without a per-push write.  The span journal drains on the
+    same tick."""
+    from paddle_tpu.fluid import flags as _flags
+
+    cadence = _SnapshotCadence(
+        interval_s=_flags.flag("ps_snapshot_interval_s"))
     by_grad = {}
     for blk in blocks:
         param, grad, prog, state = blk[:4]
@@ -554,6 +684,9 @@ def _serv_async_loop(server, blocks, local, exe):
             item = server.pop_grad(timeout=0.2)
         except StopIteration:
             return
+        if snap_path and cadence.due():
+            _snapshot_state(server, blocks, local, snap_path)
+            _drain_server_spans(server)
         if item is None:
             continue
         name, payload = item
@@ -610,6 +743,12 @@ def _listen_and_serv_run(scope, op, place):
     snap_every = int(os.environ.get("PT_PS_SNAPSHOT_EVERY", "1") or 1)
 
     server = native.PSServer(port=port, n_trainers=n_trainers)
+    from paddle_tpu.fluid import flags as _flags
+
+    # elastic membership: quorum = live members under a lease (enabled
+    # BEFORE load() so a snapshot's member section restores the quorum)
+    if _flags.flag("elastic_ps") and sync_mode:
+        server.enable_elastic(_flags.flag("ps_lease_timeout_ms"))
     restart_count = int(os.environ.get("PADDLE_RESTART_COUNT", "0") or 0)
     # restore ONLY on a supervised relaunch: a fresh job (restart 0) that
     # reuses the default snapshot dir must initialize fresh, not silently
@@ -651,7 +790,8 @@ def _listen_and_serv_run(scope, op, place):
                 _serv_sync_loop(server, blocks, local, exe,
                                 snap_path=snap_path, snap_every=snap_every)
             else:
-                _serv_async_loop(server, blocks, local, exe)
+                _serv_async_loop(server, blocks, local, exe,
+                                 snap_path=snap_path)
     finally:
         server.stop()
         if _events.enabled():

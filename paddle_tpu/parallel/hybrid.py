@@ -180,6 +180,27 @@ class HybridParallelRunner:
         self.capture_hlo = False
         self.last_hlo = None
 
+    def rebuild(self, mesh):
+        """Re-specialize the runner onto a new mesh — the elastic-rejoin
+        hook (docs/DISTRIBUTED.md §6 "Elastic membership"): after a
+        preemption resized the collective job and
+        `distributed.elastic.reinit_collective` re-formed
+        `jax.distributed`, every compiled executable is specialized to
+        the OLD device set and sharding layout.  Dropping the caches and
+        swapping the mesh re-lowers on next run; scope-resident device
+        arrays re-shard on the fly through jax.device_put.  Returns self
+        for chaining (`runner.rebuild(elastic.rebuild_mesh(mp=2))`)."""
+        self.mesh = mesh
+        self._cache.clear()
+        self._ran_keys.clear()
+        self.last_hlo = None
+        from paddle_tpu.observability import events
+
+        events.emit("hybrid_rebuild",
+                    mesh_shape={k: int(v) for k, v in mesh.shape.items()},
+                    n_devices=int(len(mesh.devices.reshape(-1))))
+        return self
+
     def _spec(self, *axes):
         from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -7,6 +7,20 @@ test_dist_base.py pattern: real processes on 127.0.0.1 endpoints).
 The model is fit_a_line (fc regression) on deterministic synthetic data;
 trainer t feeds rows [t*8:(t+1)*8) of each 16-row global batch.
 
+Elastic mode (DIST_PS_ELASTIC=1 + FLAGS_elastic_ps=1): trainers join the
+job under a lease and derive their PER-ROUND data slice from the
+membership authority (endpoints[0]) — round r consumes global batch r,
+split evenly across the CURRENT (epoch, index, count) view, so the
+merged gradient equals the full-batch mean at EVERY membership size and
+a drained-then-regrown job tracks the uninterrupted baseline exactly.
+The elastic global batch is 12 rows (divisible by 1/2/3/4/6 members).
+  PT_ELASTIC_JOIN_AT_ROUND=<r>  delay joining until the server reaches
+                                round r (the scale-up choreography)
+  PT_ELASTIC_JOIN_MIN=<n>       launch-cohort rendezvous floor
+A SIGTERM (PT_FAULT_PLAN preempt:step:<k>) drains gracefully: finish the
+in-flight round, announce LEAVE, run the announced round, dump results,
+then finish() re-delivers the signal (drain marker for the supervisor).
+
 Fault-tolerance hooks (tests/test_fault_tolerance.py):
   PT_FAULT_PLAN        fault plan for THIS process (kill:step:K fires in
                        the trainer loop; kill:round:K in the pserver sync
@@ -41,7 +55,9 @@ import paddle_tpu.fluid as fluid  # noqa: E402
 from paddle_tpu.fluid.executor import Scope, scope_guard  # noqa: E402
 
 N_STEPS = int(os.environ.get("DIST_PS_STEPS", "12"))
-GLOBAL_BATCH = 16
+ELASTIC = os.environ.get("DIST_PS_ELASTIC", "") not in ("", "0")
+# elastic slices must divide evenly at every membership size (1/2/3/4/6)
+GLOBAL_BATCH = 12 if ELASTIC else 16
 MODE = os.environ.get("DIST_PS_MODE", "sync")  # sync | async | geo
 SYNC_MODE = MODE == "sync"
 
@@ -99,7 +115,21 @@ def global_batches():
     return out
 
 
+def _param_names(main):
+    """The optimizer-updated parameters of the program (for final-state
+    parity checks)."""
+    names = []
+    for op in main.global_block().ops:
+        if op.attrs.get("op_role") == "optimize" and op.input("Param"):
+            p = op.input("Param")[0]
+            if p not in names:
+                names.append(p)
+    return names
+
+
 def run_local(opt_name, out_path):
+    from paddle_tpu.fluid.executor import global_scope
+
     main, startup, loss = build(opt_name)
     losses = []
     with scope_guard(Scope()):
@@ -108,7 +138,10 @@ def run_local(opt_name, out_path):
         for b in global_batches():
             (lv,) = exe.run(main, feed=b, fetch_list=[loss.name])
             losses.append(float(np.asarray(lv)))
-    json.dump({"losses": losses}, open(out_path, "w"))
+        cur = global_scope()
+        finals = {p: np.asarray(cur.get(p)).ravel().tolist()
+                  for p in _param_names(main) if cur.get(p) is not None}
+    json.dump({"losses": losses, "params": finals}, open(out_path, "w"))
 
 
 def _make_transpiler():
@@ -154,8 +187,98 @@ def run_pserver(ep, endpoints, n_trainers, opt_name):
     export_trace()
 
 
+def run_trainer_elastic(tid, endpoints, n_trainers, opt_name, out_path):
+    """Elastic round loop: the SERVER round (membership authority
+    endpoints[0]) selects the global batch, the (index, count) view
+    selects this member's even slice.  Rounds with any membership size
+    produce the same merged gradient (the full-batch mean), so a
+    preempt-then-rejoin run reaches parity with the uninterrupted local
+    baseline."""
+    import time as _time
+
+    from paddle_tpu.distributed import (elastic, fault_injection,
+                                        resilience)
+    from paddle_tpu.ops import dist_ops
+
+    eps = endpoints.split(",")
+    export_trace = _trace_hooks("trainer", tid)
+    drain = elastic.install_drain_handler()
+    # leave:step:<k> in PT_FAULT_PLAN drains without a signal
+    fault_injection.set_membership_hooks(
+        leave=lambda _k: drain.requested.set())
+    join_at = int(os.environ.get("PT_ELASTIC_JOIN_AT_ROUND", "0") or 0)
+    if join_at:
+        # delayed joiner: watch the round counter (non-member lease
+        # query) so the process is warm before it enters the job
+        from paddle_tpu import native
+
+        host, port = eps[0].rsplit(":", 1)
+        watcher = native.PSClient(host=host, port=int(port), timeout=60.0,
+                                  uid=f"watch:{tid}")
+        while watcher.membership()["round"] < join_at:
+            _time.sleep(0.05)
+        watcher.close()
+    main, startup, loss = build(opt_name)
+    t = _make_transpiler()
+    t.transpile(trainer_id=tid, program=main, pservers=endpoints,
+                trainers=n_trainers, sync_mode=SYNC_MODE,
+                startup_program=startup)
+    trainer_prog = t.get_trainer_program()
+    losses, counts, rounds_run = [], [], []
+    step_delay = float(os.environ.get("DIST_PS_STEP_DELAY", "0") or 0)
+    batches = global_batches()
+    leaving = False
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)  # ps_init_sync: pull + elastic JOIN + heartbeat
+        while True:
+            info = elastic.membership(eps[0])
+            rnd, count, index = info["round"], info["count"], info["index"]
+            if rnd >= N_STEPS:
+                break
+            fault_injection.on_step(rnd + 1)  # preempt:step fires HERE
+            if drain.requested.is_set() and not leaving:
+                # drain: announce LEAVE now — before this round's send,
+                # so it applies at THIS round's boundary; feed the
+                # announced round, then exit
+                elastic.leave_job(eps)
+                leaving = True
+            per = GLOBAL_BATCH // count
+            sub = {k: v[index * per:(index + 1) * per]
+                   for k, v in batches[rnd].items()}
+            (lv,) = exe.run(trainer_prog, feed=sub, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv)))
+            counts.append(count)
+            rounds_run.append(rnd)
+            if leaving:
+                break
+            if step_delay:
+                _time.sleep(step_delay)
+        finals = {}
+        if not leaving:
+            finals = {p: dist_ops.get_channel(ep).client.get_param(p)
+                      .ravel().tolist()
+                      for p, ep in sorted(t.param_endpoint.items())}
+    export_trace()
+    json.dump({"losses": losses, "counts": counts, "rounds": rounds_run,
+               "params": finals, "drained": leaving,
+               "restart_count": int(os.environ.get("PADDLE_RESTART_COUNT",
+                                                   "0") or 0),
+               "resilience": resilience.resilience_stats()},
+              open(out_path, "w"))
+    if leaving:
+        drain.finish()  # marker + re-delivered SIGTERM ends the process
+    else:
+        elastic.leave_job(eps)
+    dist_ops.stop_job_heartbeat()
+
+
 def run_trainer(tid, endpoints, n_trainers, opt_name, out_path):
     from paddle_tpu.distributed import fault_injection, resilience
+
+    if ELASTIC:
+        return run_trainer_elastic(tid, endpoints, n_trainers, opt_name,
+                                   out_path)
 
     export_trace = _trace_hooks("trainer", tid)
     main, startup, loss = build(opt_name)

@@ -113,6 +113,51 @@ def test_observability_flags_roundtrip(monkeypatch):
     importlib.reload(fl)  # restore defaults for other tests
 
 
+def test_elastic_flags_roundtrip(monkeypatch):
+    """The elastic-membership flags register with their documented
+    defaults (elastic off — the frozen n_trainers contract is the
+    reference behavior; 15 s lease, 3 s heartbeat, time-based snapshots
+    off) and round-trip through env bootstrap and get/set like every
+    other flag (ISSUE 7 satellite)."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("elastic_ps")["elastic_ps"] is False
+    assert fl.get_flags("ps_lease_timeout_ms")["ps_lease_timeout_ms"] == 15000
+    assert fl.get_flags("ps_lease_heartbeat_ms")[
+        "ps_lease_heartbeat_ms"] == 3000
+    assert fl.get_flags("ps_snapshot_interval_s")[
+        "ps_snapshot_interval_s"] == 0.0
+    try:
+        fl.set_flags({"FLAGS_elastic_ps": True,
+                      "ps_lease_timeout_ms": "2500",  # str parses
+                      "FLAGS_ps_lease_heartbeat_ms": 750,
+                      "ps_snapshot_interval_s": "1.5"})
+        assert fl.get_flags(["elastic_ps", "ps_lease_timeout_ms",
+                             "ps_lease_heartbeat_ms",
+                             "ps_snapshot_interval_s"]) == {
+            "elastic_ps": True, "ps_lease_timeout_ms": 2500,
+            "ps_lease_heartbeat_ms": 750, "ps_snapshot_interval_s": 1.5}
+    finally:
+        fl.set_flags({"FLAGS_elastic_ps": False,
+                      "FLAGS_ps_lease_timeout_ms": 15000,
+                      "FLAGS_ps_lease_heartbeat_ms": 3000,
+                      "FLAGS_ps_snapshot_interval_s": 0.0})
+    monkeypatch.setenv("FLAGS_elastic_ps", "1")
+    monkeypatch.setenv("FLAGS_ps_lease_timeout_ms", "9000")
+    monkeypatch.setenv("FLAGS_ps_snapshot_interval_s", "30")
+    importlib.reload(fl)
+    assert fl.get_flags("elastic_ps")["elastic_ps"] is True
+    assert fl.get_flags("ps_lease_timeout_ms")["ps_lease_timeout_ms"] == 9000
+    assert fl.get_flags("ps_snapshot_interval_s")[
+        "ps_snapshot_interval_s"] == 30.0
+    monkeypatch.delenv("FLAGS_elastic_ps")
+    monkeypatch.delenv("FLAGS_ps_lease_timeout_ms")
+    monkeypatch.delenv("FLAGS_ps_snapshot_interval_s")
+    importlib.reload(fl)  # restore defaults for other tests
+
+
 def test_quant_allreduce_algo_flags_roundtrip(monkeypatch):
     """The size-adaptive collective-selection flags register with their
     documented defaults (auto, 512 KB crossover, ZeRO gather quant off)

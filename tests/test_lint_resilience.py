@@ -58,3 +58,26 @@ def test_parse_error_is_a_finding(tmp_path):
     f.write_text("def f(:\n")
     findings = lint_resilience.check_file(f)
     assert findings and findings[0][2] == "parse-error"
+
+
+def test_flags_signal_no_chain():
+    """A signal.signal registration that throws away the previous handler
+    disconnects whatever was installed before it (the bug class
+    AutoCheckpoint fixed) — flagged unless the return value is captured
+    or the line carries the allow mark."""
+    src = (
+        "import signal\n"
+        "signal.signal(signal.SIGTERM, h)\n"                 # discarded
+        "prev = signal.signal(signal.SIGTERM, h)\n"          # captured
+        "self._prev[s] = signal.signal(s, self._on)\n"       # captured
+        "signal.signal(s, prev)  # resilience: allow\n"      # restore-site
+        "signal.raise_signal(signal.SIGTERM)\n")             # not a reg
+    findings = lint_resilience.check_source(src, "s.py")
+    assert [(f[1], f[2]) for f in findings] == [(2, "signal-no-chain")]
+
+
+def test_signal_check_covers_autocheckpoint_module():
+    """The checkpoint module (the capture-and-chain precedent) is in the
+    default target set."""
+    assert any("incubate/checkpoint" in t
+               for t in lint_resilience.DEFAULT_TARGETS)

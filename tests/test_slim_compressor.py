@@ -135,6 +135,14 @@ compressor:
     assert sorted(os.listdir(ckpt)) == ["0", "1", "2", "3"]
 
 
+@pytest.mark.skipif(
+    cpu_mesh.gspmd_cpu_heap_broken(),
+    reason="XLA:CPU 0.4.3x heap corruption: the QuantizationStrategy "
+           "Compressor run segfaults in FULL-SUITE runs (2/2 tier-1 "
+           "sessions killed at this test with both a stale and a fresh "
+           "compile cache; standalone it only crashes when the persistent "
+           "compile cache is poisoned) — same containment class as "
+           "test_compressor_checkpoint_resume above")
 def test_quantization_strategy_pipeline(tmp_path):
     cfg = tmp_path / "quant.yaml"
     cfg.write_text("""
@@ -244,6 +252,12 @@ def test_sa_controller_handles_fixed_dims():
     assert ctrl2.next_tokens() == [0, 0]
 
 
+@pytest.mark.skipif(
+    cpu_mesh.gspmd_cpu_heap_broken(),
+    reason="XLA:CPU 0.4.3x heap corruption: the resume's second "
+           "Compressor run aborts full-suite sessions — same class as "
+           "test_quantization_strategy_pipeline (one abort kills every "
+           "test after this file)")
 def test_quantization_resume_keeps_scale_state(tmp_path):
     """Checkpoint resume of a QAT run must re-apply the transform BEFORE
     loading, so saved scale statistics land in matching vars."""

@@ -2,8 +2,9 @@
 """Static resilience lint for the distributed layer.
 
 The fault-tolerance PR's CI tripwire: code on the failure path must
-neither swallow errors nor park forever behind a dead peer.  Two checks
-over `paddle_tpu/distributed/` and `paddle_tpu/ops/dist_ops.py`:
+neither swallow errors nor park forever behind a dead peer.  Three
+checks over `paddle_tpu/distributed/`, `paddle_tpu/ops/dist_ops.py`,
+and `paddle_tpu/fluid/incubate/checkpoint/`:
 
   except-pass      an `except` whose body is ONLY `pass` — a silently
                    swallowed failure.  Count it (resilience.record), log
@@ -14,6 +15,14 @@ over `paddle_tpu/distributed/` and `paddle_tpu/ops/dist_ops.py`:
                    caller forever.  Pass a timeout, or mark a wait that
                    is deliberately unbounded (e.g. a serve loop that a
                    stop() unblocks by design).
+  signal-no-chain  a `signal.signal(...)` registration whose return
+                   value (the PREVIOUS handler) is discarded — the new
+                   hook silently disconnects whatever was installed
+                   before it (a launcher teardown, AutoCheckpoint's
+                   preemption snapshot, a drain handler).  Capture the
+                   previous handler and chain to it; mark the rare
+                   restore-site where chaining is genuinely impossible
+                   with `# resilience: allow`.
 
 Suppress a deliberate finding with `# resilience: allow` on the same
 line.  Exit 0 when clean, 1 with findings (one per line:
@@ -34,6 +43,9 @@ REPO = Path(__file__).resolve().parent.parent
 DEFAULT_TARGETS = [
     "paddle_tpu/distributed",
     "paddle_tpu/ops/dist_ops.py",
+    # signal-handler code lives here too (AutoCheckpoint's preemption
+    # hook — the capture-and-chain precedent the signal check enforces)
+    "paddle_tpu/fluid/incubate/checkpoint",
 ]
 
 WAIT_NAMES = {"wait", "join", "recv", "get", "acquire", "wait_round",
@@ -81,7 +93,26 @@ def check_source(src: str, path: str = "<string>"):
                      f".{func.attr}() with no timeout can block forever "
                      f"behind a dead peer — pass a timeout or mark the "
                      f"line `# {ALLOW_MARK}`"))
+        elif isinstance(node, ast.Expr) and _is_signal_signal(node.value) \
+                and not _allowed(lines, node.lineno):
+            # the registration is a bare statement: the previous handler
+            # (signal.signal's return value) is thrown away
+            findings.append(
+                (path, node.lineno, "signal-no-chain",
+                 "signal.signal(...) discards the previous handler — "
+                 "capture it and chain (the AutoCheckpoint/DrainHandler "
+                 "pattern), or mark a genuine restore-site with "
+                 f"`# {ALLOW_MARK}`"))
     return findings
+
+
+def _is_signal_signal(node):
+    """`signal.signal(...)` (module attribute form) used as a call."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "signal"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "signal")
 
 
 def check_file(path: Path):

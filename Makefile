@@ -3,7 +3,8 @@ PY ?= python
 REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 
 .PHONY: test test-book test-onchip bench bench-onchip int8-bench \
-	serve-bench lint-api lint-resilience lint-observability
+	serve-bench lint-api lint-resilience lint-observability \
+	lint-collectives
 
 test:            ## full suite on the 8-device virtual CPU mesh (~8 min)
 	$(PY) -m pytest tests/ -q --ignore=tests/book
@@ -35,3 +36,6 @@ lint-resilience: ## no swallowed errors / unbounded waits in the distributed lay
 
 lint-observability: ## no bare print() diagnostics in library code
 	$(PY) tools/lint_observability.py
+
+lint-collectives: ## raw psum/ppermute sites must route through the kernels layer
+	$(PY) tools/lint_collectives.py

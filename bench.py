@@ -23,8 +23,13 @@ data-parallel rung with the EQuARX-style quantized gradient all-reduce
 (bucketed block-scaled int8 collectives; records bytes-accessed from the
 executable's cost_analysis, both algorithms' modeled wire bytes
 (oneshot vs ppermute ring — pin one with FLAGS_quant_allreduce_algo),
-step-time p50/p95/max quantiles, and a rung-end /metricsz scrape of the
-pt_collective_* families); PT_BENCH_SERVE=1 → serving-lane load-generator
+step-time p50/p95/max quantiles, a rung-end /metricsz scrape of the
+pt_collective_* families, the ready-order dispatch schedule, and — unless
+PT_BENCH_HOPLAT=0 — the hop-latency sub-rung: per-hop latency vs payload
+for the ring vs the oneshot form plus the measured crossover that tunes
+FLAGS_quant_allreduce_crossover_kb); PT_BENCH_OVERLAP=1 (with QUANTAR) →
+overlap-on vs overlap-off A/B with per-arm p50/p95/max step quantiles
+(FLAGS_overlap_allreduce toggled per arm); PT_BENCH_SERVE=1 → serving-lane load-generator
 rung: a paddle_tpu.serving.Engine under closed-loop concurrent clients,
 recording request throughput + p50/p99 latency quantiles and batch-size /
 executable-cache figures (PT_BENCH_SERVE_CLIENTS, PT_BENCH_SERVE_REQUESTS
@@ -705,6 +710,116 @@ def measure_serving(size):
     return rec
 
 
+def _hop_latency_bench(reps=10, payloads_kb=(16, 64, 256, 1024, 4096)):
+    """PT_BENCH_QUANTAR hop-latency sub-rung: time the oneshot vs ring
+    quantized all-reduce across payload sizes on the live mesh and derive
+    the per-hop latency (ring wall / 2*(n-1) sequential hops) and the
+    measured ring/oneshot crossover payload — the number that replaces
+    the FLAGS_quant_allreduce_crossover_kb guess (the flag stays as the
+    override).  Returns None on a single-device mesh."""
+    import time as _time
+
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.kernels import quantized_collectives as qc
+    from paddle_tpu.kernels import ring_collectives as rc
+    from paddle_tpu.parallel import mesh as pmesh
+
+    n = jax.device_count()
+    if n < 2:
+        return None
+    mesh = pmesh.build_mesh({pmesh.DATA_AXIS: n})
+    axis = pmesh.DATA_AXIS
+    res = {"n_devices": n, "reps": reps, "payloads_kb": list(payloads_kb),
+           "oneshot_ms": [], "ring_ms": [], "ring_per_hop_ms": []}
+    rng = np.random.RandomState(0)
+    for kb in payloads_kb:
+        elems = max(1024, kb * 1024 // 4)
+        data = rng.randn(n, elems).astype("float32")
+        row = {}
+        for algo, fn in (("oneshot", qc.quantized_all_reduce),
+                         ("ring", rc.ring_quantized_all_reduce)):
+            f = jax.jit(jax.shard_map(
+                lambda v, fn=fn: fn(v, axis), mesh=mesh, in_specs=P(axis),
+                out_specs=P(axis), check_vma=False))
+            jax.block_until_ready(f(data))  # compile + warm
+            t0 = _time.perf_counter()
+            for _ in range(reps):
+                out = f(data)
+            jax.block_until_ready(out)
+            row[algo] = (_time.perf_counter() - t0) / reps * 1e3
+        res["oneshot_ms"].append(round(row["oneshot"], 4))
+        res["ring_ms"].append(round(row["ring"], 4))
+        res["ring_per_hop_ms"].append(round(row["ring"] / (2 * (n - 1)), 4))
+    # measured crossover: smallest swept payload where the ring wins
+    # (None = oneshot won everywhere in the sweep)
+    res["measured_crossover_kb"] = next(
+        (kb for kb, o, r in zip(payloads_kb, res["oneshot_ms"],
+                                res["ring_ms"]) if r <= o), None)
+    return res
+
+
+def _overlap_step_quantiles(size, batch, seq_len, n_steps, bf16):
+    """PT_BENCH_OVERLAP=1 A/B rung: the quantized DP step with
+    ready-order bucket dispatch (FLAGS_overlap_allreduce) ON vs OFF,
+    per-step wall times fetched synchronously each step, p50/p95/max
+    quantiles per arm.  Fresh program per arm — the transpile itself
+    differs (that IS the A/B)."""
+    import numpy as np
+
+    from paddle_tpu import fluid
+    from paddle_tpu.models import bert
+
+    kw = dict(vocab_size=30528, attn_dropout=0.1)
+    cfg = (bert.BertConfig.base(**kw) if size == "base"
+           else bert.BertConfig.tiny(**kw))
+    prior = fluid.get_flags("FLAGS_overlap_allreduce")[
+        "FLAGS_overlap_allreduce"]
+    out = {"methodology": "syncfetch per-step", "steps": n_steps}
+    for arm, flag in (("on", True), ("off", False)):
+        fluid.set_flags({"FLAGS_overlap_allreduce": flag})
+        try:
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup), \
+                    fluid.unique_name.guard():
+                feeds, loss, _mlm, _nsp = bert.build_bert_pretrain(
+                    cfg, is_test=False)
+                fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+            _maybe_enable_bf16(main_prog, bf16)
+            bs = fluid.compiler.BuildStrategy()
+            bs.quant_allreduce = True
+            data = bert.make_fake_batch(cfg, batch=batch, seq_len=seq_len,
+                                        seed=0)
+            times = []
+            with fluid.scope_guard(fluid.Scope()):
+                exe = fluid.Executor()
+                exe.run(startup)
+                prog = fluid.CompiledProgram(
+                    main_prog, build_strategy=bs).with_data_parallel(
+                        loss_name=loss.name)
+                exe.run(prog, feed=data, fetch_list=[loss.name])  # warm
+                for _ in range(n_steps):
+                    t0 = time.perf_counter()
+                    exe.run(prog, feed=data, fetch_list=[loss.name])
+                    times.append(time.perf_counter() - t0)
+            sched = getattr(main_prog, "_overlap_schedule", None) or {}
+            out[arm] = {
+                "p50_s": round(float(np.percentile(times, 50)), 6),
+                "p95_s": round(float(np.percentile(times, 95)), 6),
+                "max_s": round(float(np.max(times)), 6),
+                "buckets": [
+                    {k: b[k] for k in ("insert_at", "ready_frac", "algo")}
+                    for b in sched.get("buckets", [])],
+            }
+        finally:
+            # restore the CALLER'S value — a pinned overlap-off bench
+            # must not silently flip back on for later rungs
+            fluid.set_flags({"FLAGS_overlap_allreduce": prior})
+    return out
+
+
 def measure(size):
     if os.environ.get("PT_BENCH_FORCE_CPU"):
         # last-resort rung: the TPU tunnel can wedge for hours (observed);
@@ -859,12 +974,38 @@ def measure(size):
                 algo: sum(qc.wire_bytes(b["elements"], block_size=bs,
                                         n_devices=n_dev, algo=algo)
                           for b in plan["buckets"])
-                for algo in ("oneshot", "ring")
+                for algo in ("oneshot", "ring", "ring_bidir")
             }
             rec["quant_wire_bytes"]["selected"] = [
                 b["algo"] for b in plan["buckets"]]
             rec["quant_wire_bytes"]["algo_flag"] = plan["algo"]
             rec["quant_wire_bytes"]["crossover_kb"] = plan["crossover_kb"]
+            rec["quant_wire_bytes"]["fused_update"] = [
+                bool(b.get("fused_update")) for b in plan["buckets"]]
+        # ready-order dispatch schedule (the transpile summary): how far
+        # into the backward each bucket's collective launched
+        sched = getattr(main_prog, "_overlap_schedule", None)
+        if sched:
+            rec["overlap_schedule"] = sched
+        # hop-latency sub-rung: per-hop latency vs payload + the measured
+        # ring/oneshot crossover (tunes FLAGS_quant_allreduce_crossover_kb)
+        if os.environ.get("PT_BENCH_HOPLAT", "1") == "1":
+            try:
+                hop = _hop_latency_bench()
+                if hop:
+                    rec["quant_hop_latency"] = hop
+            except Exception as e:
+                print(f"bench: hop-latency sub-rung failed ({e})",
+                      file=sys.stderr)
+        # overlap-on vs overlap-off step-quantile A/B (CPU-mesh smoke is
+        # sufficient; on-chip re-arm at the next tunnel window)
+        if os.environ.get("PT_BENCH_OVERLAP") == "1":
+            try:
+                rec["overlap_ab"] = _overlap_step_quantiles(
+                    size, batch, seq_len, n_steps, bf16)
+            except Exception as e:
+                print(f"bench: overlap A/B rung failed ({e})",
+                      file=sys.stderr)
     return rec
 
 

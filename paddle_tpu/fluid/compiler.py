@@ -56,9 +56,17 @@ class BuildStrategy:
         # runner built from this strategy (parallel/data_parallel.py)
         self.quant_allreduce = None
         # collective algorithm for the quantized path: None = defer to
-        # FLAGS_quant_allreduce_algo; "auto"/"oneshot"/"ring" pins it
-        # (auto = size crossover, kernels.ring_collectives)
+        # FLAGS_quant_allreduce_algo; "auto"/"oneshot"/"ring"/
+        # "ring_bidir" pins it (auto = size crossover,
+        # kernels.ring_collectives; ring_bidir = both ICI directions)
         self.quant_allreduce_algo = None
+        # ready-order bucket dispatch (None = FLAGS_overlap_allreduce):
+        # emit each bucket's collective right after its last gradient so
+        # the ring overlaps the remaining backward compute
+        self.overlap_allreduce = None
+        # fused dequant->update->requant step kernels (None =
+        # FLAGS_fused_update, kernels/fused_update.py)
+        self.fused_update = None
 
 
 class ExecutionStrategy:

@@ -99,8 +99,31 @@ _DEFS = {
     # requantization (2*(n-1)/n of payload bytes, 2*(n-1) hops deep),
     # "auto" = size crossover — tensors with at least
     # FLAGS_quant_allreduce_crossover_kb KB of fp32 payload take the ring
+    # (the bidirectional one when the axis/payload clear bidir_eligible)
     "FLAGS_quant_allreduce_algo": ("auto", str, True),
-    "FLAGS_quant_allreduce_crossover_kb": (512, int, True),
+    # crossover default MEASURED, not guessed: the PT_BENCH_QUANTAR
+    # hop-latency sub-rung (bench._hop_latency_bench, r8) on the 8-device
+    # CPU mesh put the first ring win at 256 KB of fp32 payload (oneshot
+    # 43.3 ms vs ring 37.9 ms; per-hop ~2.7 ms) — replaces the prior
+    # 512 KB guess; re-arm on-chip at the next tunnel window, and keep
+    # this flag as the override either way
+    "FLAGS_quant_allreduce_crossover_kb": (256, int, True),
+    # ready-order bucket dispatch (parallel/data_parallel.py): each
+    # quantized gradient bucket's collective is emitted immediately after
+    # the last gradient it covers is produced, so XLA's async collective
+    # scheduling overlaps the ring hops with the remaining backward
+    # compute.  Off = every gradient collective defers to after the full
+    # backward (the PT_BENCH_OVERLAP A/B baseline).  On by default for
+    # the quant path.
+    "FLAGS_overlap_allreduce": (True, _parse_bool, True),
+    # fused dequant->optimizer-update->requant step kernels
+    # (kernels/fused_update.py): eligible buckets keep the reduced
+    # gradient in the int8+scales wire format straight into the rewritten
+    # sgd/adam ops (c_allreduce_quant_keep), and ZeRO-1 gathers ride the
+    # requantized updated-parameter payload — the fp32 intermediates
+    # never round-trip HBM.  On by default; engages only where the quant
+    # path / zero_gather_quant are already opted in.
+    "FLAGS_fused_update": (True, _parse_bool, True),
     # ZeRO-1 weight-update gather quantization (parallel/hybrid.py
     # zero_gather_quant default): the dp-sharded parameter update
     # re-replicates through a block-scaled int8 all-gather instead of the

@@ -57,10 +57,28 @@ __all__ = [
     "quantized_all_reduce",
     "wire_bytes",
     "gather_wire_bytes",
+    "quant_padded_elems",
     "DEFAULT_BLOCK_SIZE",
 ]
 
 DEFAULT_BLOCK_SIZE = 256
+
+
+def quant_padded_elems(n_elements, n_devices, block_size=DEFAULT_BLOCK_SIZE,
+                       algo="oneshot"):
+    """Padded element count of one quantized all-reduce payload — the
+    static shape of the kept wire-format image
+    (``adaptive_quantized_all_reduce_keep``): oneshot/ring pad to a
+    multiple of ``n_devices * block_size`` (blocks never straddle a shard
+    boundary), the bidirectional ring to ``2 * n_devices * block_size``
+    (each half-ring pads independently).  The DP transpiler sizes the
+    fused-update q-vars with this, so the declared shapes match the
+    lowering exactly."""
+    n, d, bs = int(n_elements), max(1, int(n_devices)), int(block_size)
+    mult = (2 * d * bs) if algo == "ring_bidir" else (d * bs)
+    if d <= 1:
+        mult = bs  # dp=1 keep-quant fallback pads to one block
+    return n + (-n) % mult
 
 
 def wire_bytes(n_elements, block_size=DEFAULT_BLOCK_SIZE, dual_int8=True,
@@ -70,7 +88,7 @@ def wire_bytes(n_elements, block_size=DEFAULT_BLOCK_SIZE, dual_int8=True,
     EQuARX bench rung captured as a one-off (pure python; used by the
     data-parallel transpiler to report
     ``pt_collective_payload_bytes_total`` and by the bench rung to record
-    both algorithms' bytes).
+    every algorithm's bytes).
 
     ``algo="oneshot"``: both phase boundaries (scatter all_to_all, gather
     all_gather) move the full padded tensor once — int8 hi (+ int8
@@ -81,16 +99,40 @@ def wire_bytes(n_elements, block_size=DEFAULT_BLOCK_SIZE, dual_int8=True,
     ``2*(n-1)/n`` of one quantized payload image — the large-tensor win
     the size-adaptive selector exploits.
 
+    ``algo="ring_bidir"``: the bidir term — the payload pads to a
+    multiple of ``2*d*block_size`` and splits into two half-images that
+    ride opposite ring directions; per-device bytes are the SAME
+    ``2*(d-1)/d`` fraction (summed over both halves, modulo the larger
+    padding) — the bidirectional win is concurrent use of both ICI link
+    directions (~2x bisection bandwidth), not fewer bytes.  BOTH of the
+    selector's demotions are mirrored (d<=2 and sub-block payloads fall
+    back to the unidirectional formula — the same arithmetic as
+    ``ring_collectives.bidir_eligible``), so modeling a pinned
+    "ring_bidir" can never book bytes for a form that would not lower.
+
     n_devices=1 is the exact fallback — nothing crosses the wire.
     """
     n = int(n_elements)
     d = int(n_devices)
+    bs = int(block_size)
     if n <= 0 or d <= 1:
         return 0
-    padded = n + (-n) % (d * int(block_size))
     per_elem = 2 if dual_int8 else 1
-    n_blocks = padded // int(block_size)
-    payload = padded * per_elem + n_blocks * 4
+
+    def payload_of(elems):
+        return elems * per_elem + (elems // bs) * 4
+
+    # bidir_eligible's arithmetic, inlined (importing ring_collectives
+    # here would be circular): >2 devices AND at least one block per
+    # direction per device
+    if algo == "ring_bidir" and (d <= 2 or n < 2 * d * bs):
+        algo = "ring"
+    if algo == "ring_bidir":
+        half = quant_padded_elems(n, d, bs, algo="ring_bidir") // 2
+        # per direction: 2 phases x (d-1) hops of a 1/d chunk of the half
+        return 2 * (2 * (d - 1) * (payload_of(half) // d))
+    padded = n + (-n) % (d * bs)
+    payload = payload_of(padded)
     if algo == "oneshot":
         return 2 * payload
     if algo == "ring":
@@ -98,7 +140,7 @@ def wire_bytes(n_elements, block_size=DEFAULT_BLOCK_SIZE, dual_int8=True,
         # into d per-hop chunks; 2 phases x (d-1) hops each
         return 2 * (d - 1) * (payload // d)
     raise ValueError(f"wire_bytes: unknown algo {algo!r} "
-                     f"(expected 'oneshot' or 'ring')")
+                     f"(expected 'oneshot', 'ring' or 'ring_bidir')")
 
 
 def gather_wire_bytes(n_elements, block_size=DEFAULT_BLOCK_SIZE,
@@ -160,11 +202,14 @@ def dequantize_block_scaled(q_hi, q_lo, scales, block_size=DEFAULT_BLOCK_SIZE):
     return out.reshape(q_hi.shape)
 
 
-def _quantized_all_reduce_impl(x, axis_name, block_size, dual_int8):
+def _quantized_all_reduce_impl(x, axis_name, block_size, dual_int8,
+                               keep_quant=False):
     n = lax.psum(1, axis_name)  # static axis size under shard_map
     if n == 1:
         # dp=1 fallback: the sum over one device is the identity — stay
-        # EXACT (and skip the quantize/collective machinery entirely)
+        # EXACT (and skip the quantize/collective machinery entirely).
+        # keep_quant callers route through ring_collectives'
+        # _local_keep_quant before reaching here.
         return x
     orig_shape, orig_dtype = jnp.shape(x), x.dtype
     flat = jnp.ravel(x).astype(jnp.float32)
@@ -201,6 +246,12 @@ def _quantized_all_reduce_impl(x, axis_name, block_size, dual_int8):
     g_lo = lax.all_gather(r_lo, axis_name) if dual_int8 else None
     g_scales = lax.all_gather(r_scales, axis_name)
 
+    if keep_quant:
+        # fused-update consumers take the assembled wire-format image
+        # (flat, padded to n*block_size) — no final dequantization
+        return (g_hi.reshape(-1),
+                g_lo.reshape(-1) if dual_int8 else None,
+                g_scales.reshape(-1))
     out = dequantize_block_scaled(g_hi, g_lo, g_scales.reshape(-1),
                                   block_size)
     out = out.reshape(-1)

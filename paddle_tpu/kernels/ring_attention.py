@@ -67,9 +67,12 @@ def _ring_shard(q, k, v, bias, *, axis_name, causal, sm_scale, ring_size):
             "bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32),
             preferred_element_type=jnp.float32)
         m = m_new
-        k_c = jax.lax.ppermute(k_c, axis_name, perm)
-        v_c = jax.lax.ppermute(v_c, axis_name, perm)
-        b_c = jax.lax.ppermute(b_c, axis_name, perm)
+        # the attention ring rotates FP K/V/bias blocks — activations the
+        # quantized gradient wire format must not touch, so these stay
+        # raw ppermutes rather than routing through ring_collectives
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)  # collective: allow
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)  # collective: allow
+        b_c = jax.lax.ppermute(b_c, axis_name, perm)  # collective: allow
         return (k_c, v_c, b_c, m, l, acc), None
 
     (k_c, v_c, b_c, m, l, acc), _ = jax.lax.scan(

@@ -69,32 +69,64 @@ from .quantized_collectives import (DEFAULT_BLOCK_SIZE,
 
 __all__ = [
     "ring_quantized_all_reduce",
+    "bidir_ring_quantized_all_reduce",
     "quantized_all_gather",
+    "gather_quantized_shards",
     "adaptive_quantized_all_reduce",
+    "adaptive_quantized_all_reduce_keep",
+    "local_keep_quant",
     "select_allreduce_algo",
+    "bidir_eligible",
     "QUANT_ALLREDUCE_ALGOS",
 ]
 
-QUANT_ALLREDUCE_ALGOS = ("auto", "oneshot", "ring")
+QUANT_ALLREDUCE_ALGOS = ("auto", "oneshot", "ring", "ring_bidir")
+
+
+def bidir_eligible(n_elements, n_devices, block_size=None):
+    """Whether the bidirectional ring is well-formed for this tensor:
+    more than 2 devices (at n=2 both ring directions are the SAME
+    neighbor — riding two half-payloads at it is a double-send with no
+    bisection-bandwidth win) and at least one quantization block per
+    direction per device before padding (smaller payloads would be
+    mostly pad bytes split across two rings)."""
+    if block_size is None:
+        from paddle_tpu.fluid import flags as _flags
+
+        block_size = _flags.flag("quant_allreduce_block_size")
+    return (int(n_devices) > 2
+            and int(n_elements) >= 2 * int(n_devices) * int(block_size))
 
 
 def select_allreduce_algo(n_elements, n_devices, algo=None,
-                          crossover_kb=None):
+                          crossover_kb=None, block_size=None):
     """Resolve the quantized-all-reduce algorithm for one tensor.
 
     ``algo`` None/"auto" defers to ``FLAGS_quant_allreduce_algo``; a flag
     of "auto" applies the size crossover: tensors whose fp32 payload is at
     least ``crossover_kb`` KB (default ``FLAGS_quant_allreduce_crossover_kb``)
-    take the ring (per-device bytes 2*(n-1)/n of payload), smaller ones
-    keep the one-shot all_to_all/all_gather form (O(1) collective
-    launches — latency wins when the payload is small).  A 1-device axis
-    always resolves "oneshot" (both forms degenerate to the exact
-    identity there).
+    take the ring — the BIDIRECTIONAL ring when :func:`bidir_eligible`
+    (both ICI directions carry half the payload each hop, ~2x bisection
+    bandwidth), else the unidirectional one — and smaller tensors keep
+    the one-shot all_to_all/all_gather form (O(1) collective launches —
+    latency wins when the payload is small).  A 1-device axis always
+    resolves "oneshot" (every form degenerates to the exact identity
+    there).
+
+    An EXPLICIT ``"ring_bidir"`` is demoted to ``"ring"`` when
+    :func:`bidir_eligible` fails (n=2 would double-send to the one
+    neighbor; sub-block payloads would ship mostly padding) — this is the
+    single enforcement point, so the stamped op attr, the wire-bytes
+    model and the lowering always agree on what actually runs.
     """
     if algo in (None, "auto"):
         from paddle_tpu.fluid import flags as _flags
 
         algo = _flags.flag("quant_allreduce_algo")
+    if algo == "ring_bidir":
+        return ("ring_bidir"
+                if bidir_eligible(n_elements, n_devices, block_size)
+                else "ring")
     if algo in ("oneshot", "ring"):
         return algo
     if algo != "auto":
@@ -107,13 +139,17 @@ def select_allreduce_algo(n_elements, n_devices, algo=None,
         from paddle_tpu.fluid import flags as _flags
 
         crossover_kb = _flags.flag("quant_allreduce_crossover_kb")
-    return ("ring" if int(n_elements) * 4 >= float(crossover_kb) * 1024.0
-            else "oneshot")
+    if int(n_elements) * 4 < float(crossover_kb) * 1024.0:
+        return "oneshot"
+    return ("ring_bidir" if bidir_eligible(n_elements, n_devices, block_size)
+            else "ring")
 
 
-def _ring_perm(n):
-    """Clockwise neighbor exchange: device j forwards to j+1 (mod n)."""
-    return [(j, (j + 1) % n) for j in range(n)]
+def _ring_perm(n, sign=1):
+    """Neighbor exchange: device j forwards to j+sign (mod n) — sign=+1
+    is the clockwise ring, sign=-1 the counter-clockwise one (the other
+    ICI direction)."""
+    return [(j, (j + sign) % n) for j in range(n)]
 
 
 def _quantize_permute(x, axis_name, perm, block_size, dual_int8):
@@ -130,38 +166,45 @@ def _quantize_permute(x, axis_name, perm, block_size, dual_int8):
     return dequantize_block_scaled(q_hi, q_lo, scales, block_size)
 
 
-def _ring_reduce_scatter(shards, axis_name, n, block_size, dual_int8):
+def _ring_reduce_scatter(shards, axis_name, n, block_size, dual_int8,
+                         sign=1):
     """Quantized ring reduce-scatter over ``shards`` [n, per_shard]
     (per_shard a multiple of block_size).  Device i returns the fully
-    reduced chunk i in fp32.
+    reduced chunk i in fp32.  ``sign`` picks the ring direction.
 
-    Hop algebra: the partial that ENDS at device i starts at device i+1
-    (as its own chunk-i contribution) and makes n-1 clockwise hops, each
-    intermediate device folding in its own chunk-i shard in fp32 before
-    requantizing — so device i holds, at step t, the partial for chunk
-    (i - 1 - t) mod n and receives the one for (i - 2 - t) mod n."""
+    Hop algebra (sign=+1): the partial that ENDS at device i starts at
+    device i+1 (as its own chunk-i contribution) and makes n-1 clockwise
+    hops, each intermediate device folding in its own chunk-i shard in
+    fp32 before requantizing — so device i holds, at step t, the partial
+    for chunk (i - 1 - t) mod n and receives the one for (i - 2 - t)
+    mod n.  sign=-1 is the exact mirror (all offsets negated): after n-1
+    counter-clockwise hops the same chunk-i partial lands at device i."""
     idx = lax.axis_index(axis_name)
-    perm = _ring_perm(n)
+    perm = _ring_perm(n, sign)
     # the partial this device initiates: its own contribution to the chunk
-    # owned by the LEFT neighbor's final position
-    acc = lax.dynamic_index_in_dim(shards, (idx - 1) % n, axis=0,
+    # owned by the upstream neighbor's final position
+    acc = lax.dynamic_index_in_dim(shards, (idx - sign) % n, axis=0,
                                    keepdims=False)
     for t in range(n - 1):
         received = _quantize_permute(acc, axis_name, perm, block_size,
                                      dual_int8)
-        own = lax.dynamic_index_in_dim(shards, (idx - 2 - t) % n, axis=0,
-                                       keepdims=False)
+        own = lax.dynamic_index_in_dim(shards, (idx - sign * (2 + t)) % n,
+                                       axis=0, keepdims=False)
         acc = received + own  # fp32 accumulate; requantized next hop
     return acc  # == sum over devices of chunk idx
 
 
-def _ring_all_gather_quant(reduced, axis_name, n, block_size, dual_int8):
+def _ring_all_gather_quant(reduced, axis_name, n, block_size, dual_int8,
+                           sign=1, keep_quant=False):
     """Quantized ring all-gather of each device's reduced chunk
     [per_shard] -> the full [n * per_shard] fp32 tensor.  The chunk is
     quantized ONCE and the identical int8 image makes n-1 hops — int8 on
-    every hop, no error accumulation beyond the single requantization."""
+    every hop, no error accumulation beyond the single requantization.
+    ``keep_quant=True`` returns the assembled quantized image
+    ``(hi, lo, scales)`` (flat) instead of dequantizing — the fused
+    optimizer-update path consumes int8 + scales directly."""
     idx = lax.axis_index(axis_name)
-    perm = _ring_perm(n)
+    perm = _ring_perm(n, sign)
     q_hi, q_lo, scales = quantize_block_scaled(reduced, block_size,
                                                dual_int8=dual_int8)
     hi = lax.dynamic_update_index_in_dim(
@@ -178,22 +221,28 @@ def _ring_all_gather_quant(reduced, axis_name, n, block_size, dual_int8):
         if dual_int8:
             cur_lo = lax.ppermute(cur_lo, axis_name, perm)
         cur_sc = lax.ppermute(cur_sc, axis_name, perm)
-        # after t+1 clockwise hops the resident chunk originated t+1
-        # positions counter-clockwise
-        src = (idx - 1 - t) % n
+        # after t+1 hops the resident chunk originated t+1 positions
+        # upstream (against the forwarding direction)
+        src = (idx - sign * (1 + t)) % n
         hi = lax.dynamic_update_index_in_dim(hi, cur_hi, src, axis=0)
         if dual_int8:
             lo = lax.dynamic_update_index_in_dim(lo, cur_lo, src, axis=0)
         sc = lax.dynamic_update_index_in_dim(sc, cur_sc, src, axis=0)
-    return dequantize_block_scaled(
-        hi.reshape(-1), lo.reshape(-1) if dual_int8 else None,
-        sc.reshape(-1), block_size)
+    hi = hi.reshape(-1)
+    lo = lo.reshape(-1) if dual_int8 else None
+    sc = sc.reshape(-1)
+    if keep_quant:
+        return hi, lo, sc
+    return dequantize_block_scaled(hi, lo, sc, block_size)
 
 
-def _ring_all_reduce_impl(x, axis_name, block_size, dual_int8):
+def _ring_all_reduce_impl(x, axis_name, block_size, dual_int8,
+                          keep_quant=False):
     n = lax.psum(1, axis_name)  # static axis size under shard_map
     if n == 1:
         # dp=1: the sum over one device is the identity — stay EXACT
+        if keep_quant:
+            return local_keep_quant(x, block_size, dual_int8)
         return x
     orig_shape, orig_dtype = jnp.shape(x), x.dtype
     flat = jnp.ravel(x).astype(jnp.float32)
@@ -205,7 +254,69 @@ def _ring_all_reduce_impl(x, axis_name, block_size, dual_int8):
     reduced = _ring_reduce_scatter(shards, axis_name, n, block_size,
                                    dual_int8)
     out = _ring_all_gather_quant(reduced, axis_name, n, block_size,
-                                 dual_int8)
+                                 dual_int8, keep_quant=keep_quant)
+    if keep_quant:
+        return out  # (hi, lo, scales), padded to n*block_size
+    if pad:
+        out = out[:size]
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def local_keep_quant(x, block_size, dual_int8):
+    """keep_quant fallback for a 1-device axis (or no mesh): quantize the
+    local value once — downstream fused-update consumers dequantize it,
+    paying one quantization (the transpiler never emits the fused form at
+    dp=1, so this path only serves the op's no-mesh fallback and direct
+    kernel tests).  Public: the `c_allreduce_quant_keep` lowering calls
+    it."""
+    flat = jnp.ravel(x).astype(jnp.float32)
+    pad = (-flat.size) % block_size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return quantize_block_scaled(flat, block_size, dual_int8=dual_int8)
+
+
+def _bidir_ring_all_reduce_impl(x, axis_name, block_size, dual_int8,
+                                keep_quant=False):
+    """Bidirectional ring: the payload splits into two halves that ride
+    the clockwise and counter-clockwise rings SIMULTANEOUSLY — two
+    independent ``lax.ppermute`` chains per hop, one per ICI direction,
+    so both link directions carry traffic and the effective bisection
+    bandwidth doubles.  Per-hop requantization, fp32 accumulation and the
+    wire format are identical to the unidirectional ring on each half.
+    Falls back to the unidirectional ring when the axis has <= 2 devices
+    (both directions would address the SAME neighbor — a double-send,
+    not a second link) or the payload is under one block per direction
+    per device (mostly padding on the wire)."""
+    n = lax.psum(1, axis_name)  # static axis size under shard_map
+    size = int(np.prod(jnp.shape(x), dtype=np.int64)) if jnp.shape(x) else 1
+    if not bidir_eligible(size, n, block_size):
+        return _ring_all_reduce_impl(x, axis_name, block_size, dual_int8,
+                                     keep_quant=keep_quant)
+    orig_shape, orig_dtype = jnp.shape(x), x.dtype
+    flat = jnp.ravel(x).astype(jnp.float32)
+    pad = (-size) % (2 * n * block_size)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    half = flat.size // 2  # multiple of n*block_size by construction
+    cw, ccw = flat[:half].reshape(n, -1), flat[half:].reshape(n, -1)
+    red_cw = _ring_reduce_scatter(cw, axis_name, n, block_size, dual_int8,
+                                  sign=1)
+    red_ccw = _ring_reduce_scatter(ccw, axis_name, n, block_size,
+                                   dual_int8, sign=-1)
+    out_cw = _ring_all_gather_quant(red_cw, axis_name, n, block_size,
+                                    dual_int8, sign=1,
+                                    keep_quant=keep_quant)
+    out_ccw = _ring_all_gather_quant(red_ccw, axis_name, n, block_size,
+                                     dual_int8, sign=-1,
+                                     keep_quant=keep_quant)
+    if keep_quant:
+        hi = jnp.concatenate([out_cw[0], out_ccw[0]])
+        lo = (jnp.concatenate([out_cw[1], out_ccw[1]])
+              if dual_int8 else None)
+        sc = jnp.concatenate([out_cw[2], out_ccw[2]])
+        return hi, lo, sc
+    out = jnp.concatenate([out_cw, out_ccw])
     if pad:
         out = out[:size]
     return out.reshape(orig_shape).astype(orig_dtype)
@@ -236,30 +347,116 @@ def _ring_qar_bwd(axis_name, block_size, dual_int8, _res, g):
 ring_quantized_all_reduce.defvjp(_ring_qar_fwd, _ring_qar_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def bidir_ring_quantized_all_reduce(x, axis_name,
+                                    block_size=DEFAULT_BLOCK_SIZE,
+                                    dual_int8=True):
+    """Bidirectional explicit-ring block-scaled int8 all-reduce-sum of
+    ``x`` over mesh axis ``axis_name``: two half-payloads ride the
+    clockwise and counter-clockwise rings at once (two ppermutes per hop,
+    both ICI directions, ~2x bisection bandwidth), int8 + per-block fp32
+    scales on every hop of both.  Falls back to the unidirectional ring
+    below :func:`bidir_eligible`; exact identity on a 1-device axis; the
+    VJP is the straight-through fp32 psum like every quantized form.
+    Must be called under shard_map."""
+    return _bidir_ring_all_reduce_impl(x, axis_name, block_size, dual_int8)
+
+
+def _bidir_qar_fwd(x, axis_name, block_size, dual_int8):
+    return _bidir_ring_all_reduce_impl(x, axis_name, block_size,
+                                       dual_int8), None
+
+
+def _bidir_qar_bwd(axis_name, block_size, dual_int8, _res, g):
+    # straight-through fp32 psum — quantization noise is forward-only
+    return (lax.psum(g, axis_name),)
+
+
+bidir_ring_quantized_all_reduce.defvjp(_bidir_qar_fwd, _bidir_qar_bwd)
+
+
+def _dispatch_algo(resolved):
+    return {"ring": ring_quantized_all_reduce,
+            "ring_bidir": bidir_ring_quantized_all_reduce,
+            "oneshot": quantized_all_reduce}[resolved]
+
+
 def adaptive_quantized_all_reduce(x, axis_name,
                                   block_size=DEFAULT_BLOCK_SIZE,
                                   dual_int8=True, algo="auto",
                                   crossover_kb=None):
     """Size-adaptive quantized all-reduce: resolve the algorithm with
     :func:`select_allreduce_algo` (static tensor size, static axis size)
-    and dispatch to the one-shot or the ring form.  This is what the
-    ``c_allreduce_quant`` lowering calls; both branches share the exact
-    dp=1 fallback and the straight-through psum VJP."""
+    and dispatch to the one-shot, ring, or bidirectional-ring form.  This
+    is what the ``c_allreduce_quant`` lowering calls; every branch shares
+    the exact dp=1 fallback and the straight-through psum VJP."""
     n = lax.psum(1, axis_name)  # static under shard_map
     if n == 1:
         return quantized_all_reduce(x, axis_name, block_size, dual_int8)
     size = int(np.prod(jnp.shape(x), dtype=np.int64)) if jnp.shape(x) else 1
     resolved = select_allreduce_algo(size, n, algo=algo,
-                                     crossover_kb=crossover_kb)
+                                     crossover_kb=crossover_kb,
+                                     block_size=block_size)
+    return _dispatch_algo(resolved)(x, axis_name, block_size, dual_int8)
+
+
+def adaptive_quantized_all_reduce_keep(x, axis_name,
+                                       block_size=DEFAULT_BLOCK_SIZE,
+                                       dual_int8=True, algo="auto",
+                                       crossover_kb=None):
+    """Like :func:`adaptive_quantized_all_reduce` but the reduced result
+    stays in the wire format: returns ``(q_hi, q_lo, scales)`` flat (the
+    gather phase's assembled image, padded per the resolved algorithm)
+    WITHOUT the final dequantization — the fused
+    dequant→optimizer-update→requant step kernels
+    (`kernels.fused_update`) consume int8 + scales directly, so the
+    reduced gradient never materializes as a full fp32 bucket in HBM.
+    Not differentiable: the fused path sits after the backward graph
+    (optimizer leg), where no cotangent ever flows."""
+    n = lax.psum(1, axis_name)  # static under shard_map
+    if n == 1:
+        return local_keep_quant(x, block_size, dual_int8)
+    size = int(np.prod(jnp.shape(x), dtype=np.int64)) if jnp.shape(x) else 1
+    resolved = select_allreduce_algo(size, n, algo=algo,
+                                     crossover_kb=crossover_kb,
+                                     block_size=block_size)
+    if resolved == "ring_bidir":
+        return _bidir_ring_all_reduce_impl(x, axis_name, block_size,
+                                           dual_int8, keep_quant=True)
     if resolved == "ring":
-        return ring_quantized_all_reduce(x, axis_name, block_size,
-                                         dual_int8)
-    return quantized_all_reduce(x, axis_name, block_size, dual_int8)
+        return _ring_all_reduce_impl(x, axis_name, block_size, dual_int8,
+                                     keep_quant=True)
+    from .quantized_collectives import _quantized_all_reduce_impl
+
+    return _quantized_all_reduce_impl(x, axis_name, block_size, dual_int8,
+                                      keep_quant=True)
 
 
 # ---------------------------------------------------------------------------
 # ZeRO-1 weight-update gather
 # ---------------------------------------------------------------------------
+
+
+def gather_quantized_shards(q_hi, q_lo, scales, axis_name,
+                            block_size=DEFAULT_BLOCK_SIZE):
+    """All-gather PRE-QUANTIZED dim-0 shards (flat int8 payload(s) + one
+    fp32 scale per block, blocks shard-local) over ``axis_name`` and
+    dequantize the assembled tensor: the back half of
+    :func:`quantized_all_gather` for callers that already hold the wire
+    format — the fused update→requant step kernels emit exactly this
+    payload, so the updated parameter rides the ZeRO-1 gather without an
+    intermediate fp32 image.  Returns the flat fp32 tensor of
+    ``n * q_hi.size`` elements.  Must be called under shard_map; a
+    1-device axis dequantizes locally (no wire traffic)."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return dequantize_block_scaled(q_hi, q_lo, scales, block_size)
+    g_hi = lax.all_gather(q_hi, axis_name)
+    g_lo = lax.all_gather(q_lo, axis_name) if q_lo is not None else None
+    g_sc = lax.all_gather(scales, axis_name)
+    return dequantize_block_scaled(
+        g_hi.reshape(-1), g_lo.reshape(-1) if g_lo is not None else None,
+        g_sc.reshape(-1), block_size)
 
 
 def _quantized_all_gather_impl(x, axis_name, block_size, dual_int8):

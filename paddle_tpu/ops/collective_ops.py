@@ -101,6 +101,33 @@ def _c_allreduce_quant(ctx, x, attrs):
         crossover_kb=attrs.get("crossover_kb"))
 
 
+@simple_op("c_allreduce_quant_keep", ["X"], ["QHi", "QLo", "QScale"],
+           grad=None)
+def _c_allreduce_quant_keep(ctx, x, attrs):
+    """`c_allreduce_quant` that KEEPS the reduced result in the wire
+    format: outputs the gather phase's assembled int8 payload(s) + per-
+    block fp32 scales instead of dequantizing.  Emitted by the DP
+    transpiler's fused-update rewrite (FLAGS_fused_update) so the fused
+    dequant→Adam/SGD-update step ops consume int8 + scales directly and
+    the reduced gradient bucket never materializes as a full fp32 buffer
+    in HBM (kernels/fused_update.py).  Sits strictly after the backward
+    graph, so it carries no gradient rule.  Outside any mesh the value
+    quantizes locally once (the transpiler never emits this form at
+    dp=1)."""
+    from paddle_tpu.kernels import quantized_collectives as qc
+    from paddle_tpu.kernels import ring_collectives as rc
+
+    block_size = int(attrs.get("block_size", qc.DEFAULT_BLOCK_SIZE))
+    dual = int(attrs.get("quant_bits", 16)) != 8
+    ax = _axis_for_ring(ctx, attrs)
+    if ax is None:
+        return rc.local_keep_quant(x, block_size, dual)
+    return rc.adaptive_quantized_all_reduce_keep(
+        x, ax, block_size=block_size, dual_int8=dual,
+        algo=attrs.get("algo", "auto"),
+        crossover_kb=attrs.get("crossover_kb"))
+
+
 @simple_op("uncoalesce_tensor", ["X"], ["Out*"])
 def _uncoalesce_tensor(ctx, x, attrs):
     """Split a coalesce_tensor FusedOutput buffer back into the original

@@ -47,8 +47,22 @@ def _coalesce_tensor(ctx, xs, attrs):
     """Pack tensors into one flat buffer (coalesce_tensor_op.cc — the
     grad-fusion staging buffer).  Outputs alias the inputs; FusedOutput is
     the packed view.  XLA's all-reduce combiner does the real fusion on
-    TPU; this exists for imported programs."""
-    flat = [jnp.reshape(x, (-1,)) for x in xs]
+    TPU; this exists for imported programs.
+
+    attrs["align"] > 1: zero-pad each member up to that element multiple
+    before packing (the reference's platform-alignment analog).  The
+    fused-update rewrite aligns members to the quantization block size so
+    each one occupies WHOLE blocks of the bucket's wire image and the
+    fused optimizer ops can slice it out at block granularity without
+    dequantizing neighbors."""
+    align = int(attrs.get("align", 1) or 1)
+
+    def padded(x):
+        f = jnp.reshape(x, (-1,))
+        pad = (-f.size) % align
+        return jnp.pad(f, (0, pad)) if pad else f
+
+    flat = [padded(x) if align > 1 else jnp.reshape(x, (-1,)) for x in xs]
     fused = (jnp.concatenate(flat) if flat
              else jnp.zeros((0,), jnp.float32))
     if attrs.get("set_constant", False):

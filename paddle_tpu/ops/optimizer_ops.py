@@ -227,6 +227,95 @@ def _dpsgd(ctx, p, g, lr, attrs):
     return (p.astype(jnp.float32) - _lr(lr) * (g32 + noise)).astype(p.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Fused dequant→update→requant step ops (kernels/fused_update.py).
+#
+# *_quant_grad (data-parallel path): consume the reduced gradient bucket
+# in its WIRE FORMAT (int8 payload + per-block scales from
+# `c_allreduce_quant_keep`) and dequantize the member's block-aligned
+# slice inline with the update — the fp32 bucket never round-trips HBM.
+# attrs: offset_blocks / numel locate the member inside the bucket,
+# block_size the quantization grid; update hyperparams as in the base op.
+#
+# *_quant_gather (hybrid ZeRO-1 path): the base update plus the
+# REQUANTIZED image of the updated parameter as extra outputs
+# (QHi/QLo/QScale, flat, padded to attrs["pad_multiple"] = dp x block) —
+# HybridParallelRunner's zero_gather_quant wrapper rides them through the
+# weight-update gather (gather_quantized_shards), so the fp32 updated
+# parameter between update and requant lives only inside the XLA fusion.
+# ParamOut stays the EXACT fp32 update: a program running outside the
+# hybrid wrapper (plain Executor) is bit-identical to the base op.
+# ---------------------------------------------------------------------------
+
+
+@simple_op("fused_sgd_quant_grad",
+           ["Param", "QHi", "QLo", "QScale", "LearningRate"], ["ParamOut"],
+           grad=None, optional=("QLo",), inplace={"ParamOut": "Param"})
+def _fused_sgd_quant_grad(ctx, p, qh, ql, qsc, lr, attrs):
+    from paddle_tpu.kernels import fused_update as fu
+
+    g = (qh, ql, qsc, attrs["offset_blocks"], attrs["numel"])
+    return fu.fused_sgd_update(p, g, lr,
+                               block_size=attrs.get("block_size", 256))
+
+
+@simple_op(
+    "fused_adam_quant_grad",
+    ["Param", "QHi", "QLo", "QScale", "Moment1", "Moment2", "LearningRate",
+     "Beta1Pow", "Beta2Pow"],
+    ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    grad=None, optional=("QLo",),
+    inplace={"ParamOut": "Param", "Moment1Out": "Moment1",
+             "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+             "Beta2PowOut": "Beta2Pow"},
+)
+def _fused_adam_quant_grad(ctx, p, qh, ql, qsc, m1, m2, lr, b1p, b2p,
+                           attrs):
+    from paddle_tpu.kernels import fused_update as fu
+
+    g = (qh, ql, qsc, attrs["offset_blocks"], attrs["numel"])
+    return fu.fused_adam_update(
+        p, g, m1, m2, lr, b1p, b2p,
+        beta1=attrs.get("beta1", 0.9), beta2=attrs.get("beta2", 0.999),
+        epsilon=attrs.get("epsilon", 1e-8),
+        block_size=attrs.get("block_size", 256))
+
+
+@simple_op("fused_sgd_quant_gather", ["Param", "Grad", "LearningRate"],
+           ["ParamOut", "QHi", "QLo", "QScale"], grad=None,
+           inplace={"ParamOut": "Param"})
+def _fused_sgd_quant_gather(ctx, p, g, lr, attrs):
+    from paddle_tpu.kernels import fused_update as fu
+
+    return fu.fused_sgd_update(
+        p, g, lr, block_size=attrs.get("block_size", 256),
+        requant_pad=(attrs.get("pad_multiple")
+                     or attrs.get("block_size", 256)))
+
+
+@simple_op(
+    "fused_adam_quant_gather",
+    ["Param", "Grad", "Moment1", "Moment2", "LearningRate", "Beta1Pow",
+     "Beta2Pow"],
+    ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut",
+     "QHi", "QLo", "QScale"],
+    grad=None,
+    inplace={"ParamOut": "Param", "Moment1Out": "Moment1",
+             "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+             "Beta2PowOut": "Beta2Pow"},
+)
+def _fused_adam_quant_gather(ctx, p, g, m1, m2, lr, b1p, b2p, attrs):
+    from paddle_tpu.kernels import fused_update as fu
+
+    return fu.fused_adam_update(
+        p, g, m1, m2, lr, b1p, b2p,
+        beta1=attrs.get("beta1", 0.9), beta2=attrs.get("beta2", 0.999),
+        epsilon=attrs.get("epsilon", 1e-8),
+        block_size=attrs.get("block_size", 256),
+        requant_pad=(attrs.get("pad_multiple")
+                     or attrs.get("block_size", 256)))
+
+
 @simple_op("dgc", ["U", "V", "Grad"], ["UOut", "VOut", "EncodeGrad"],
            grad=None, inplace={"UOut": "U", "VOut": "V"})
 def _dgc(ctx, u, v, g, attrs):

@@ -42,6 +42,39 @@ def collective_payload_counter():
         labels=("collective",))
 
 
+def overlap_buckets_counter():
+    """Gradient buckets whose collective dispatched in READY ORDER
+    (immediately after the last member gradient was produced, so the ring
+    hops overlap the remaining backward compute) — emitted per executed
+    step from the transpile-time schedule (docs/OBSERVABILITY.md)."""
+    from paddle_tpu import observability as obs
+
+    return obs.counter(
+        "pt_overlap_buckets_ready_total",
+        "Gradient buckets dispatched in ready order (overlap with "
+        "backward compute) per step")
+
+
+def fused_update_bytes_counter():
+    """Modeled HBM bytes the fused dequant->update->requant step kernels
+    avoid per step (the fp32 intermediate's write+read,
+    kernels.fused_update.bytes_saved) — shared by the DP and hybrid
+    runners' bookings."""
+    from paddle_tpu import observability as obs
+
+    return obs.counter(
+        "pt_fused_update_bytes_saved_total",
+        "Modeled fp32 HBM round-trip bytes avoided by fused "
+        "dequant->optimizer-update->requant step kernels")
+
+
+# optimizer ops the fused-update rewrite can absorb: their Grad input is
+# replaced by the bucket's wire-format image (int8 + scales), the update
+# dequantizes the member's block-aligned slice inline
+_FUSED_UPDATE_OPS = {"sgd": "fused_sgd_quant_grad",
+                     "adam": "fused_adam_quant_grad"}
+
+
 def _plan_quant_buckets(block, grads, prod_index, block_size, bucket_mb):
     """fuse_all_reduce_op_pass analog: group same-dtype grads into fused
     buckets (capped at ``bucket_mb`` MB) so one quantized collective per
@@ -84,12 +117,120 @@ def _plan_quant_buckets(block, grads, prod_index, block_size, bucket_mb):
     return buckets, leftovers
 
 
+def _plan_fused_updates(block, buckets, block_size):
+    """Fused-update eligibility (FLAGS_fused_update): a bucket qualifies
+    when EVERY member gradient has exactly one consumer in the original
+    program and that consumer is an sgd/adam op taking it as `Grad` —
+    then the bucket's collective can keep the wire format
+    (`c_allreduce_quant_keep`), the uncoalesce disappears, and each
+    optimizer op is rewritten to its fused dequant→update variant.  Any
+    other consumer (gradient clip, weight decay reading the raw grad, a
+    fetch-feeding op) keeps the whole bucket on the unfused path: with
+    the uncoalesce gone, nothing would rewrite the member var to its
+    reduced value.  Returns {id(optimizer op): (bucket, grad)} and
+    annotates qualifying buckets with block-aligned member offsets."""
+    member = {g: b for b in buckets for g in b["grads"]}
+    consumers = {}
+    for op in block.ops:
+        for g in set(op.input_arg_names):
+            if g in member:
+                consumers.setdefault(g, []).append(op)
+    rewrites = {}
+    bs_q = int(block_size)
+    for b in buckets:
+        ops_for = []
+        for g in b["grads"]:
+            cons = consumers.get(g, [])
+            if (len(cons) == 1 and cons[0].type in _FUSED_UPDATE_OPS
+                    and cons[0].inputs.get("Grad") == [g]):
+                ops_for.append(cons[0])
+            else:
+                ops_for = None
+                break
+        if not ops_for:
+            continue
+        # block-aligned packing: each member starts on a quantization
+        # block boundary so its slice of the wire image is whole blocks
+        off, offsets = 0, []
+        for s in b["shapes"]:
+            offsets.append(off // bs_q)
+            numel = int(np.prod(s))
+            off += numel + (-numel) % bs_q
+        raw = sum(int(np.prod(s)) for s in b["shapes"])
+        if off > 2 * raw:
+            # sub-block members: alignment padding would more than double
+            # the wire payload — the HBM round-trip saved is worth less
+            # than the extra ICI bytes, keep the unfused form (the same
+            # size-adaptivity the ZeRO gather's sub-block gate applies)
+            continue
+        b["fused_update"] = True
+        b["offsets"], b["aligned_elems"] = offsets, off
+        for g, op in zip(b["grads"], ops_for):
+            rewrites[id(op)] = (b, g)
+    return rewrites
+
+
+def _create_bucket_vars(block, buckets, num_devices, block_size,
+                        quant_algo, quant_crossover_kb):
+    """Resolve each bucket's collective algorithm (stamped once, used by
+    the emission, the wire-bytes accounting and the q-var shapes) and
+    create the fused buffer — plus, for fused-update buckets, the
+    wire-format output vars of `c_allreduce_quant_keep` with the exact
+    padded shapes the lowering produces."""
+    from paddle_tpu.kernels import quantized_collectives as qc
+    from paddle_tpu.kernels.ring_collectives import select_allreduce_algo
+
+    bs_q = int(block_size)
+    for k, b in enumerate(buckets):
+        b["elements"] = (b["aligned_elems"] if b.get("fused_update")
+                         else sum(int(np.prod(s)) for s in b["shapes"]))
+        b["algo"] = select_allreduce_algo(
+            b["elements"], num_devices, algo=quant_algo,
+            crossover_kb=quant_crossover_kb, block_size=bs_q)
+        b["fused"] = block.create_var(
+            name=f"@FUSED_GRAD_QUANT@_{b['dtype']}_{k}",
+            dtype=b["dtype"], shape=[b["elements"]])
+        if b.get("fused_update"):
+            padded = qc.quant_padded_elems(b["elements"], num_devices,
+                                           bs_q, algo=b["algo"])
+            base = f"@FUSED_GRAD_QUANT@_{b['dtype']}_{k}"
+            b["qhi"] = block.create_var(name=base + "@QHI", dtype="int8",
+                                        shape=[padded])
+            b["qlo"] = block.create_var(name=base + "@QLO", dtype="int8",
+                                        shape=[padded])
+            b["qsc"] = block.create_var(name=base + "@QSCALE",
+                                        dtype="float32",
+                                        shape=[padded // bs_q])
+
+
+def _make_fused_update_op(block, op, b, g, block_size):
+    """Rewrite one sgd/adam op into its fused dequant→update variant:
+    the `Grad` input becomes the bucket's wire-format triple plus the
+    member's block offset/size attrs (kernels/fused_update.py)."""
+    from paddle_tpu.fluid.framework import Operator
+
+    i = b["grads"].index(g)
+    inputs = {slot: list(names) for slot, names in op.inputs.items()
+              if slot != "Grad"}
+    inputs["QHi"] = [b["qhi"].name]
+    inputs["QLo"] = [b["qlo"].name]
+    inputs["QScale"] = [b["qsc"].name]
+    attrs = dict(op.attrs)
+    attrs.update(offset_blocks=int(b["offsets"][i]),
+                 numel=int(np.prod(b["shapes"][i])),
+                 block_size=int(block_size))
+    return Operator(block, _FUSED_UPDATE_OPS[op.type], inputs=inputs,
+                    outputs={s: list(n) for s, n in op.outputs.items()},
+                    attrs=attrs)
+
+
 def transpile_data_parallel(program, loss_name, num_devices,
                             gradient_scale="coeff_num_device",
                             sync_batch_norm_stats=True,
                             quant_grads=False, quant_block_size=None,
                             quant_bucket_mb=None, quant_algo=None,
-                            quant_crossover_kb=None):
+                            quant_crossover_kb=None, overlap=None,
+                            fused_update=None):
     """Rewrite `program` in place for data-parallel execution.
 
     Mirrors multi_devices_graph_pass: (1) the loss-gradient seed becomes
@@ -115,7 +256,35 @@ def transpile_data_parallel(program, loss_name, num_devices,
     the wire-bytes accounting (and the bench record) models.  "auto"
     sends small buckets through the one-shot O(1)-launch form and large
     ones through the ppermute ring (2*(n-1)/n of payload bytes, int8 on
-    every hop).
+    every hop) — the BIDIRECTIONAL ring (`ring_bidir`, both ICI
+    directions at once) when the bucket clears `bidir_eligible`.
+
+    overlap (FLAGS_overlap_allreduce when None, default ON): READY-ORDER
+    bucket dispatch — each bucket's collective is emitted immediately
+    after the last gradient it covers is produced (reverse-topological
+    order of the backward), so XLA's async collective scheduling can
+    overlap the ring hops with the remaining backward compute.  Off =
+    every gradient collective (bucketed AND per-grad fp32) defers to
+    after the full backward — the no-overlap baseline the
+    PT_BENCH_OVERLAP A/B rung measures against.  The schedule lands in
+    ``program._overlap_schedule`` (per-bucket insert point + the fraction
+    of the backward already executed at dispatch) and feeds
+    ``pt_overlap_buckets_ready_total``.
+
+    fused_update (FLAGS_fused_update when None, default ON): buckets
+    whose members each feed EXACTLY ONE sgd/adam optimizer op are kept in
+    the wire format end to end — members pack block-ALIGNED
+    (`coalesce_tensor` attr align), the collective becomes
+    `c_allreduce_quant_keep` (int8 + scales out, no final dequant), the
+    `uncoalesce_tensor` disappears, and each member's optimizer op is
+    rewritten to its fused variant (`fused_adam_quant_grad` /
+    `fused_sgd_quant_grad`) that dequantizes its block slice inline with
+    the update — the reduced fp32 bucket never round-trips HBM
+    (kernels/fused_update.py; saved bytes booked on
+    ``pt_fused_update_bytes_saved_total``).  A gradient with any OTHER
+    consumer (clip/regularizer/a second op) keeps the unfused form; note
+    that fetching a fused-away gradient by name returns the local
+    pre-reduce value, since nothing rewrites it in the fused program.
     """
     block = program.global_block()
     if loss_name is not None and gradient_scale == "coeff_num_device":
@@ -141,13 +310,38 @@ def transpile_data_parallel(program, loss_name, num_devices,
     dgc_encoded = set(dgc_map.values())
     raw_grads = {dgc_map.get(g, g) for g in raw_grads}
 
-    # plan the quantized buckets against the ORIGINAL op indices (ops are
-    # only ever appended after, so producer indices stay valid while the
-    # rewritten list grows)
-    buckets, bucketed = [], {}
-    if quant_grads:
-        from paddle_tpu.fluid import flags as _flags
+    from paddle_tpu.fluid import flags as _flags
 
+    if overlap is None:
+        overlap = _flags.flag("overlap_allreduce")
+    overlap = bool(overlap)
+    if fused_update is None:
+        fused_update = _flags.flag("fused_update")
+    fused_update = bool(fused_update)
+
+    # producer indices against the ORIGINAL op list (ops are only ever
+    # appended after, so indices stay valid while the rewritten list
+    # grows); backward_end = the op after which every raw gradient exists
+    # (the no-overlap dispatch point), backward_start = the first
+    # grad-producing op — ready_frac measures position WITHIN the
+    # backward span, else a long forward would inflate every bucket
+    # toward 1.0 and the overlap telemetry would read as no-headroom
+    prod_index = {}
+    backward_start = None
+    for i, op in enumerate(block.ops):
+        if backward_start is None and any(
+                "@GRAD" in n for n in op.output_arg_names):
+            backward_start = i
+        for g in raw_grads.intersection(op.output_arg_names):
+            prod_index[g] = i  # last producer wins
+    backward_end = max(prod_index.values()) if prod_index else -1
+    if backward_start is None or backward_start > backward_end:
+        backward_start = 0
+
+    # plan the quantized buckets
+    buckets, bucketed = [], {}
+    fused_rewrites = {}  # id(optimizer op) -> (bucket, grad name)
+    if quant_grads:
         if quant_block_size is None:
             quant_block_size = _flags.flag("quant_allreduce_block_size")
         if quant_bucket_mb is None:
@@ -156,22 +350,19 @@ def transpile_data_parallel(program, loss_name, num_devices,
             quant_algo = _flags.flag("quant_allreduce_algo")
         if quant_crossover_kb is None:
             quant_crossover_kb = _flags.flag("quant_allreduce_crossover_kb")
-        prod_index = {}
-        for i, op in enumerate(block.ops):
-            for g in raw_grads.intersection(op.output_arg_names):
-                prod_index[g] = i  # last producer wins
         candidates = {g for g in raw_grads
                       if g in prod_index and g not in dgc_encoded}
         buckets, _left = _plan_quant_buckets(
             block, candidates, prod_index, quant_block_size,
             quant_bucket_mb)
-        for k, b in enumerate(buckets):
-            b["fused"] = block.create_var(
-                name=f"@FUSED_GRAD_QUANT@_{b['dtype']}_{k}",
-                dtype=b["dtype"],
-                shape=[sum(int(np.prod(s)) for s in b["shapes"])])
+        for b in buckets:
             for g in b["grads"]:
                 bucketed[g] = b
+        if fused_update and num_devices > 1:
+            fused_rewrites = _plan_fused_updates(block, buckets,
+                                                 quant_block_size)
+        _create_bucket_vars(block, buckets, num_devices, quant_block_size,
+                            quant_algo, quant_crossover_kb)
 
     # standing collective-payload accounting (docs/OBSERVABILITY.md):
     # per-device ICI bytes one step moves, both phases of each collective
@@ -193,56 +384,95 @@ def transpile_data_parallel(program, loss_name, num_devices,
     quant_plan = {"block_size": int(quant_block_size or 0),
                   "algo": quant_algo, "crossover_kb": quant_crossover_kb,
                   "buckets": []}
+    schedule = {"enabled": overlap, "backward_start": backward_start,
+                "backward_end": backward_end, "buckets": []}
+    bwd_span = max(1, backward_end - backward_start)
+    fused_saved_bytes = 0
 
-    def _emit_bucket(b, out):
+    def _emit_bucket(b, out, insert_at):
+        from paddle_tpu.kernels import fused_update as fu
         from paddle_tpu.kernels import quantized_collectives as qc
-        from paddle_tpu.kernels.ring_collectives import select_allreduce_algo
 
+        nonlocal fused_saved_bytes
         fused = b["fused"].name
-        n_elems = sum(int(np.prod(s)) for s in b["shapes"])
-        # resolve the algorithm NOW so the stamped attr, the wire-bytes
-        # metric, and the bench record all describe the same collective
-        algo = select_allreduce_algo(n_elems, num_devices, algo=quant_algo,
-                                     crossover_kb=quant_crossover_kb)
+        n_elems, algo = b["elements"], b["algo"]
+        is_fused = bool(b.get("fused_update"))
         out.append(Operator(
             block, "coalesce_tensor",
             inputs={"Input": list(b["grads"])},
             outputs={"FusedOutput": [fused]},
-            attrs={"dtype": b["dtype"], "op_role": "backward"}))
-        out.append(Operator(
-            block, "c_allreduce_quant",
-            inputs={"X": [fused]}, outputs={"Out": [fused]},
-            attrs={"ring_id": 0, "use_calc_stream": True,
-                   "block_size": int(quant_block_size),
-                   "algo": algo, "op_role": "backward"}))
-        out.append(Operator(
-            block, "uncoalesce_tensor",
-            inputs={"X": [fused]}, outputs={"Out": list(b["grads"])},
-            attrs={"shapes": [list(s) for s in b["shapes"]],
-                   "op_role": "backward"}))
+            attrs={"dtype": b["dtype"], "op_role": "backward",
+                   **({"align": int(quant_block_size)} if is_fused
+                      else {})}))
+        if is_fused:
+            # keep the reduced bucket in the wire format — the rewritten
+            # optimizer ops dequantize their block slice inline
+            out.append(Operator(
+                block, "c_allreduce_quant_keep",
+                inputs={"X": [fused]},
+                outputs={"QHi": [b["qhi"].name], "QLo": [b["qlo"].name],
+                         "QScale": [b["qsc"].name]},
+                attrs={"ring_id": 0, "use_calc_stream": True,
+                       "block_size": int(quant_block_size),
+                       "algo": algo, "op_role": "backward"}))
+            fused_saved_bytes += fu.bytes_saved(n_elems)
+        else:
+            out.append(Operator(
+                block, "c_allreduce_quant",
+                inputs={"X": [fused]}, outputs={"Out": [fused]},
+                attrs={"ring_id": 0, "use_calc_stream": True,
+                       "block_size": int(quant_block_size),
+                       "algo": algo, "op_role": "backward"}))
+            out.append(Operator(
+                block, "uncoalesce_tensor",
+                inputs={"X": [fused]}, outputs={"Out": list(b["grads"])},
+                attrs={"shapes": [list(s) for s in b["shapes"]],
+                       "op_role": "backward"}))
         collective_bytes["c_allreduce_quant"] += qc.wire_bytes(
             n_elems, block_size=int(quant_block_size),
             n_devices=num_devices, algo=algo)
-        quant_plan["buckets"].append({"elements": n_elems, "algo": algo})
+        quant_plan["buckets"].append({"elements": n_elems, "algo": algo,
+                                      "fused_update": is_fused})
+        schedule["buckets"].append({
+            "elements": n_elems, "algo": algo, "fused_update": is_fused,
+            "insert_at": insert_at,
+            # fraction of the BACKWARD SPAN already executed when this
+            # bucket's collective dispatches — 1.0 means zero overlap
+            "ready_frac": round(min(1.0, max(
+                0.0, (insert_at - backward_start) / bwd_span)), 4)
+            if backward_end >= 0 else 1.0})
 
     new_ops = []
+    deferred = []  # collectives held back until after the full backward
     pending = set(raw_grads)
     for op_idx, op in enumerate(block.ops):
+        if id(op) in fused_rewrites:
+            b, g = fused_rewrites[id(op)]
+            new_ops.append(_make_fused_update_op(block, op, b, g,
+                                                 quant_block_size))
+            continue
         new_ops.append(op)
         produced = pending.intersection(op.output_arg_names)
         for g in produced:
             pending.discard(g)
             if g in bucketed:
                 continue  # fused collective emitted at the bucket boundary
-            new_ops.append(Operator(
+            ar = Operator(
                 block, "c_allreduce_sum",
                 inputs={"X": [g]}, outputs={"Out": [g]},
                 attrs={"ring_id": 0, "use_calc_stream": True,
-                       "op_role": "backward"}))
+                       "op_role": "backward"})
+            (new_ops if overlap else deferred).append(ar)
             collective_bytes["c_allreduce_sum"] += 2 * _static_bytes(g)
         for b in buckets:
             if b["insert_at"] == op_idx:
-                _emit_bucket(b, new_ops)
+                _emit_bucket(b, new_ops if overlap else deferred,
+                             op_idx if overlap else backward_end)
+        if not overlap and op_idx == backward_end and deferred:
+            # no-overlap baseline: every gradient collective dispatches
+            # here, after the last gradient producer
+            new_ops.extend(deferred)
+            deferred = []
         if sync_batch_norm_stats and op.type == "batch_norm" and not op.attrs.get("is_test"):
             for slot in ("MeanOut", "VarianceOut"):
                 names = op.outputs.get(slot, [])
@@ -256,11 +486,16 @@ def transpile_data_parallel(program, loss_name, num_devices,
     block.ops = new_ops
     if num_devices <= 1:  # psum over one device moves nothing
         collective_bytes = {k: 0 for k in collective_bytes}
+        fused_saved_bytes = 0
     program._collective_bytes_per_step = collective_bytes
     # per-bucket algorithm/size report for the PT_BENCH_QUANTAR rung —
     # lets the bench record BOTH algorithms' modeled bytes beside the one
     # that actually ran
     program._quant_allreduce_plan = quant_plan if quant_grads else None
+    # ready-order scheduling report (the transpile summary): feeds the
+    # bench record and pt_overlap_buckets_ready_total
+    program._overlap_schedule = schedule if quant_grads else None
+    program._fused_update_bytes_saved = fused_saved_bytes
     program._bump_version()
     return program
 
@@ -269,7 +504,8 @@ class DataParallelRunner:
     """Compiles + runs a data-parallel program over all local devices."""
 
     def __init__(self, program, loss_name, build_strategy=None, places=None,
-                 quant_grads=None, quant_algo=None):
+                 quant_grads=None, quant_algo=None, overlap=None,
+                 fused_update=None):
         import jax
 
         n = len(places) if places else jax.device_count()
@@ -285,17 +521,23 @@ class DataParallelRunner:
             quant_grads = _flags.flag("quant_allreduce")
         self.quant_grads = bool(quant_grads)
         # same layering for the algorithm choice; None defers all the way
-        # to FLAGS_quant_allreduce_algo inside the transpile
+        # to FLAGS_quant_allreduce_algo inside the transpile — ditto the
+        # ready-order overlap and fused-update knobs
         if quant_algo is None:
             quant_algo = getattr(build_strategy, "quant_allreduce_algo",
                                  None)
         self.quant_algo = quant_algo
+        if overlap is None:
+            overlap = getattr(build_strategy, "overlap_allreduce", None)
+        if fused_update is None:
+            fused_update = getattr(build_strategy, "fused_update", None)
         # rewrite in place, like the reference's multi-device pass
         self.program = transpile_data_parallel(
             program, loss_name, n,
             sync_batch_norm_stats=(build_strategy is None
                                    or getattr(build_strategy, "sync_batch_norm", True) is not False),
-            quant_grads=self.quant_grads, quant_algo=quant_algo)
+            quant_grads=self.quant_grads, quant_algo=quant_algo,
+            overlap=overlap, fused_update=fused_update)
         self._cache = {}
 
     def _cache_key(self, feed, fetch_names):
@@ -357,6 +599,12 @@ class DataParallelRunner:
             for coll, nbytes in per_step.items():
                 if nbytes:
                     fam.labels(collective=coll).inc(nbytes)
+        sched = getattr(self.program, "_overlap_schedule", None)
+        if sched and sched["enabled"] and sched["buckets"]:
+            overlap_buckets_counter().inc(len(sched["buckets"]))
+        saved = getattr(self.program, "_fused_update_bytes_saved", 0)
+        if saved:
+            fused_update_bytes_counter().inc(saved)
 
     def cost_analysis(self, executor, feed, fetch_list=None, scope=None):
         """XLA cost/memory analysis of the sharded step executable (the

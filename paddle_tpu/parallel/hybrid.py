@@ -141,7 +141,7 @@ class HybridParallelRunner:
 
     def __init__(self, program, mesh, rules: ShardingRule | None = None,
                  feed_specs=None, scope=None, zero_stage=0,
-                 zero_gather_quant=None):
+                 zero_gather_quant=None, fused_update=None):
         """zero_stage=1: shard optimizer-state vars (moment accumulators,
         tagged is_optimizer_state) over the 'dp' axis on dim 0 — the
         cross-replica weight-update sharding of arXiv:2004.13336 (ZeRO-1).
@@ -158,7 +158,19 @@ class HybridParallelRunner:
         per-block fp32 scales ride the gather, and the full tensor
         dequantizes on arrival — halving (dual-int8) the gather bytes the
         ZeRO-1 trade costs.  Optimizer-state shards never gather at all,
-        so optimizer state stays fp32-exact regardless of this knob."""
+        so optimizer state stays fp32-exact regardless of this knob.
+
+        fused_update (None = FLAGS_fused_update): with zero_gather_quant
+        on, the sgd/adam ops of ZeRO-gather-eligible parameters are
+        rewritten to their fused update→requant variants
+        (`fused_sgd_quant_gather` / `fused_adam_quant_gather`,
+        kernels/fused_update.py): the op itself emits the block-scaled
+        int8 image of the updated parameter, the gather rides THAT
+        payload (gather_quantized_shards), and the fp32 updated parameter
+        between update and requant never round-trips HBM — saved bytes
+        book on ``pt_fused_update_bytes_saved_total``.  ``ParamOut``
+        stays the exact fp32 update, so the same program run outside this
+        runner is bit-identical to the unfused ops."""
         self.program = program
         self.mesh = mesh
         self.rules = rules or ShardingRule([])
@@ -173,6 +185,16 @@ class HybridParallelRunner:
 
             zero_gather_quant = _flags.flag("zero_gather_quant")
         self.zero_gather_quant = bool(zero_gather_quant)
+        if fused_update is None:
+            from paddle_tpu.fluid import flags as _flags
+
+            fused_update = _flags.flag("fused_update")
+        self.fused_update = bool(fused_update)
+        # {param: {"shape", "padded", "qhi", "qlo", "qsc"}} for optimizer
+        # ops rewritten to the fused update→requant→gather form
+        self._fused_gather = (self._rewrite_fused_updates()
+                              if (self.fused_update and self.zero_stage >= 1
+                                  and self.zero_gather_quant) else {})
         # capture_hlo=True records the OPTIMIZED (post-GSPMD-partitioner)
         # HLO of the first compiled step in .last_hlo so callers can assert
         # which collectives XLA inserted (the dryrun/driver check does).
@@ -194,6 +216,8 @@ class HybridParallelRunner:
         self._cache.clear()
         self._ran_keys.clear()
         self.last_hlo = None
+        if self._fused_gather:
+            self._restamp_fused_updates()
         from paddle_tpu.observability import events
 
         events.emit("hybrid_rebuild",
@@ -260,6 +284,205 @@ class HybridParallelRunner:
                 continue  # mp/ep-sharded params: GSPMD owns their layout
             out[name] = shape
         return out
+
+    _FUSED_GATHER_OPS = {"sgd": "fused_sgd_quant_gather",
+                         "adam": "fused_adam_quant_gather"}
+
+    def _fused_gather_eligible(self, name):
+        """ZeRO-gather eligibility from program metadata (the same gates
+        `_zero_gather_params` applies from the scope, minus the live
+        values — the op rewrite happens at construction, before any
+        scope is bound): trainable Parameter, static shape, dim 0
+        divisible by dp, at least one quantization block per shard, not
+        mp/ep-sharded by the rules."""
+        from paddle_tpu.fluid import flags as _flags
+        from paddle_tpu.fluid.framework import Parameter
+
+        if pmesh.DATA_AXIS not in self.mesh.axis_names:
+            return None
+        dp = self.mesh.shape[pmesh.DATA_AXIS]
+        if dp <= 1:
+            return None
+        v = self.program.global_block()._find_var_recursive(name)
+        if not isinstance(v, Parameter) or not v.shape:
+            return None
+        shape = tuple(v.shape)
+        if any(d is None or d < 0 for d in shape) or shape[0] % dp != 0:
+            return None
+        block = int(_flags.flag("quant_allreduce_block_size"))
+        if int(np.prod(shape)) // dp < block:
+            return None
+        if any(self.rules.spec_for(name, shape=shape, mesh=self.mesh)):
+            return None
+        return shape
+
+    def _rewrite_fused_updates(self):
+        """Rewrite eligible sgd/adam ops to their fused
+        update→requant→gather variants (in place, the DP transpiler's
+        precedent): same slots plus QHi/QLo/QScale outputs carrying the
+        block-scaled int8 image of the updated parameter, padded to
+        dp*block so per-shard blocks never straddle the gather's shard
+        boundary.  Returns {param: q-var info} for `_wrap_fused_gather`."""
+        from paddle_tpu.fluid import flags as _flags
+        from paddle_tpu.fluid.framework import Operator
+
+        block = int(_flags.flag("quant_allreduce_block_size"))
+        dp = self.mesh.shape.get(pmesh.DATA_AXIS, 1)
+        blk = self.program.global_block()
+        fused = {}
+        for i, op in enumerate(blk.ops):
+            if op.type not in self._FUSED_GATHER_OPS:
+                continue
+            pname = (op.inputs.get("Param") or [None])[0]
+            if pname is None or pname in fused:
+                continue
+            shape = self._fused_gather_eligible(pname)
+            if shape is None:
+                continue
+            numel = int(np.prod(shape))
+            padded = numel + (-numel) % (dp * block)
+            qhi = blk.create_var(name=pname + "@ZGQ_HI", dtype="int8",
+                                 shape=[padded])
+            qlo = blk.create_var(name=pname + "@ZGQ_LO", dtype="int8",
+                                 shape=[padded])
+            qsc = blk.create_var(name=pname + "@ZGQ_SCALE",
+                                 dtype="float32", shape=[padded // block])
+            outputs = {s: list(n) for s, n in op.outputs.items()}
+            outputs.update(QHi=[qhi.name], QLo=[qlo.name],
+                           QScale=[qsc.name])
+            attrs = dict(op.attrs)
+            attrs.update(block_size=block, pad_multiple=dp * block)
+            blk.ops[i] = Operator(
+                blk, self._FUSED_GATHER_OPS[op.type],
+                inputs={s: list(n) for s, n in op.inputs.items()},
+                outputs=outputs, attrs=attrs)
+            fused[pname] = {"shape": shape, "padded": padded,
+                            "qhi": qhi.name, "qlo": qlo.name,
+                            "qsc": qsc.name}
+        if fused:
+            self.program._bump_version()
+        return fused
+
+    def _restamp_fused_updates(self):
+        """Re-specialize the fused update→requant ops onto the current
+        mesh (rebuild() path): the gather payload pads to dp*block, so
+        the op attrs and the q-var shapes are dp-dependent — and
+        eligibility itself is mesh-dependent, so a parameter the NEW mesh
+        disqualifies (dp resized to 1, dim-0 divisibility lost, the dp
+        axis gone entirely) REVERTS to its base optimizer op: leaving it
+        fused would quantize-round-trip every step on a configuration
+        that is exact by contract (dp=1) or crash the gather wrapper."""
+        from paddle_tpu.fluid import flags as _flags
+        from paddle_tpu.fluid.framework import Operator
+
+        block = int(_flags.flag("quant_allreduce_block_size"))
+        dp = self.mesh.shape.get(pmesh.DATA_AXIS, 1)
+        base_of = {v: k for k, v in self._FUSED_GATHER_OPS.items()}
+        blk = self.program.global_block()
+        for i, op in enumerate(blk.ops):
+            if op.type not in base_of:
+                continue
+            pname = (op.inputs.get("Param") or [None])[0]
+            info = self._fused_gather.get(pname)
+            if info is None:
+                continue
+            if self._fused_gather_eligible(pname) is None:
+                # demote back to the exact base op on the new mesh
+                attrs = {k: v for k, v in op.attrs.items()
+                         if k not in ("block_size", "pad_multiple")}
+                outputs = {s: list(n) for s, n in op.outputs.items()
+                           if s not in ("QHi", "QLo", "QScale")}
+                blk.ops[i] = Operator(
+                    blk, base_of[op.type],
+                    inputs={s: list(n) for s, n in op.inputs.items()},
+                    outputs=outputs, attrs=attrs)
+                del self._fused_gather[pname]
+                continue
+            numel = int(np.prod(info["shape"]))
+            padded = numel + (-numel) % (dp * block)
+            op.attrs.update(block_size=block, pad_multiple=dp * block)
+            info["padded"] = padded
+            blk.vars[info["qhi"]].shape = (padded,)
+            blk.vars[info["qlo"]].shape = (padded,)
+            blk.vars[info["qsc"]].shape = (padded // block,)
+        self.program._bump_version()
+
+    def _make_inner_body(self, plan):
+        """The traced step body.  With fused update→requant ops in the
+        program, returns a 3-tuple body that also exposes the quantized
+        updated-parameter images (non-persistable op outputs, invisible
+        to out_writes) so `_wrap_fused_gather` can ride them through the
+        ZeRO gather; otherwise the plain BlockPlan body."""
+        if not self._fused_gather:
+            return plan.make_body(), False
+        fetch_names, write_names = plan.jit_fetch_names, plan.write_names
+        qnames = {p: (i["qhi"], i["qlo"], i["qsc"])
+                  for p, i in self._fused_gather.items()}
+
+        def fn(donated, readonly, feeds, step):
+            env = plan.trace_env(donated, readonly, feeds, step)
+            fetches = [env[n] for n in fetch_names]
+            out_writes = {n: env[n] for n in write_names if n in env}
+            extras = {p: (env[h], env[l], env[s])
+                      for p, (h, l, s) in qnames.items() if h in env}
+            return fetches, out_writes, extras
+
+        return fn, True
+
+    def _wrap_fused_gather(self, inner3, live_writes):
+        """Close the fused chain: each rewritten parameter's quantized
+        image (already padded to dp*block by the op) rides the ZeRO-1
+        weight-update gather as int8 + scales
+        (gather_quantized_shards), dequantizing only on arrival — the
+        parameter write the next step reads is the gathered value, and
+        the op's exact fp32 ParamOut is dead code XLA removes.  Returns
+        (2-tuple body, modeled wire bytes/step, modeled HBM bytes
+        saved/step)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.fluid import flags as _flags
+        from paddle_tpu.kernels import fused_update as fu
+        from paddle_tpu.kernels import quantized_collectives as qc
+        from paddle_tpu.kernels import ring_collectives as rcol
+
+        axis = pmesh.DATA_AXIS
+        dp = self.mesh.shape[axis]
+        block = int(_flags.flag("quant_allreduce_block_size"))
+        # one shard_map serves every parameter: the payloads are all flat
+        # 1-D images with identical specs/axis/block (unlike the plain
+        # zero-gather wrapper, whose in_specs depend on each shape)
+        gather_fn = jax.shard_map(
+            lambda h, l, s: rcol.gather_quantized_shards(
+                h, l, s, axis, block),
+            mesh=self.mesh, in_specs=(P(axis), P(axis), P(axis)),
+            out_specs=P(), check_vma=False)
+        gathered, wire, saved = set(), 0, 0
+        for name, info in self._fused_gather.items():
+            if name not in live_writes:
+                continue
+            gathered.add(name)
+            wire += qc.gather_wire_bytes(info["padded"] // dp,
+                                         block_size=block, n_devices=dp)
+            saved += fu.bytes_saved(int(np.prod(info["shape"])))
+
+        def body(donated, readonly, feeds, step):
+            fetches, out_writes, extras = inner3(donated, readonly, feeds,
+                                                 step)
+            out_writes = dict(out_writes)
+            for name, (qh, ql, qsc) in extras.items():
+                if name not in gathered:
+                    continue
+                info = self._fused_gather[name]
+                flat = gather_fn(qh, ql, qsc)
+                numel = int(np.prod(info["shape"]))
+                val = flat[:numel].reshape(info["shape"])
+                prev = out_writes.get(name)
+                out_writes[name] = (val.astype(prev.dtype)
+                                    if prev is not None else val)
+            return fetches, out_writes
+
+        return body, wire, saved
 
     def _wrap_zero_gather(self, inner, zgq_params):
         """Wrap a compiled step body so every ZeRO-gather-eligible
@@ -353,6 +576,11 @@ class HybridParallelRunner:
 
             collective_payload_counter().labels(
                 collective="zero_gather_quant").inc(zgq_bytes * n_steps)
+        fused_saved = getattr(cb, "_fused_saved_per_step", 0)
+        if fused_saved:
+            from .data_parallel import fused_update_bytes_counter
+
+            fused_update_bytes_counter().inc(fused_saved * n_steps)
         self._ran_keys.add(key)
         # stacked_feed: the leading feed axis is the step index, not batch
         batch = 0 if stacked_feed else _feed_batch(feed) * n_steps
@@ -413,14 +641,27 @@ class HybridParallelRunner:
                 "run_steps chains the whole loop on-device; host ops "
                 f"({[op.type for op in plan.host_ops]}) need the host "
                 "between steps — use run() per step")
-        inner_body = plan.make_body()
-        zgq_bytes = 0
+        inner_body, has_extras = self._make_inner_body(plan)
+        zgq_bytes = fused_saved = 0
+        if has_extras:
+            # fused update→requant ops: their quantized images ride the
+            # gather; wrapped BEFORE the chain wrap so every chained
+            # iteration's parameter writes re-replicate through it.
+            # Only params this plan actually WRITES count (a forward-only
+            # fetch prunes the optimizer ops — no gather, no booking).
+            live = set(plan.write_names)
+            inner_body, fused_wire, fused_saved = \
+                self._wrap_fused_gather(inner_body, live)
+            zgq_bytes += fused_wire
         zgq = self._zero_gather_params(scope, plan.donated_names)
+        # params on the fused path already gather quantized — the plain
+        # quantize-then-gather wrapper covers only the rest (momentum /
+        # other optimizers the fused rewrite doesn't absorb)
+        zgq = {k: v for k, v in zgq.items() if k not in self._fused_gather}
         if zgq:
-            # wrap BEFORE the chain wrap so every chained iteration's
-            # parameter writes re-replicate through the quantized gather
-            # (they feed the next iteration)
-            inner_body, zgq_bytes = self._wrap_zero_gather(inner_body, zgq)
+            inner_body, plain_bytes = self._wrap_zero_gather(inner_body,
+                                                             zgq)
+            zgq_bytes += plain_bytes
 
         if chain_mode:
             import jax.numpy as jnp
@@ -529,7 +770,9 @@ class HybridParallelRunner:
             plan.run_host_ops(scope_)
             return plan.assemble_fetches(fetches, scope_)
 
-        # modeled ZeRO-gather wire bytes ride on the compiled closure so
-        # _dispatch can book them per executed step
+        # modeled ZeRO-gather wire bytes (and fused-update HBM savings)
+        # ride on the compiled closure so _dispatch can book them per
+        # executed step
         compiled._zgq_bytes_per_step = zgq_bytes
+        compiled._fused_saved_per_step = fused_saved
         return compiled

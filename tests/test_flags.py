@@ -160,9 +160,11 @@ def test_elastic_flags_roundtrip(monkeypatch):
 
 def test_quant_allreduce_algo_flags_roundtrip(monkeypatch):
     """The size-adaptive collective-selection flags register with their
-    documented defaults (auto, 512 KB crossover, ZeRO gather quant off)
-    and round-trip through env bootstrap and get/set like every other
-    flag (ISSUE 5 satellite)."""
+    documented defaults (auto; 256 KB crossover — MEASURED by the
+    PT_BENCH_QUANTAR hop-latency sub-rung on the 8-device CPU mesh,
+    replacing the original 512 KB guess; ZeRO gather quant off) and
+    round-trip through env bootstrap and get/set like every other flag
+    (ISSUE 5 satellite, crossover retuned in ISSUE 8)."""
     import importlib
 
     from paddle_tpu.fluid import flags as fl
@@ -170,7 +172,7 @@ def test_quant_allreduce_algo_flags_roundtrip(monkeypatch):
     assert fl.get_flags("quant_allreduce_algo")[
         "quant_allreduce_algo"] == "auto"
     assert fl.get_flags("quant_allreduce_crossover_kb")[
-        "quant_allreduce_crossover_kb"] == 512
+        "quant_allreduce_crossover_kb"] == 256
     assert fl.get_flags("zero_gather_quant")["zero_gather_quant"] is False
     try:
         fl.set_flags({"FLAGS_quant_allreduce_algo": "ring",
@@ -184,7 +186,7 @@ def test_quant_allreduce_algo_flags_roundtrip(monkeypatch):
             "zero_gather_quant": True}
     finally:
         fl.set_flags({"FLAGS_quant_allreduce_algo": "auto",
-                      "FLAGS_quant_allreduce_crossover_kb": 512,
+                      "FLAGS_quant_allreduce_crossover_kb": 256,
                       "FLAGS_zero_gather_quant": False})
     monkeypatch.setenv("FLAGS_quant_allreduce_algo", "oneshot")
     monkeypatch.setenv("FLAGS_quant_allreduce_crossover_kb", "64")
@@ -195,6 +197,36 @@ def test_quant_allreduce_algo_flags_roundtrip(monkeypatch):
         "quant_allreduce_crossover_kb"] == 64
     monkeypatch.delenv("FLAGS_quant_allreduce_algo")
     monkeypatch.delenv("FLAGS_quant_allreduce_crossover_kb")
+    importlib.reload(fl)  # restore defaults for other tests
+
+
+def test_overlap_and_fused_update_flags_roundtrip(monkeypatch):
+    """The comm/compute-overlap flags (ISSUE 8): ready-order bucket
+    dispatch and the fused dequant→update→requant step kernels both
+    default ON (they only engage where the quant path / zero_gather_quant
+    are already opted in) and round-trip through env bootstrap and
+    get/set like every other flag."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("overlap_allreduce")["overlap_allreduce"] is True
+    assert fl.get_flags("fused_update")["fused_update"] is True
+    try:
+        fl.set_flags({"FLAGS_overlap_allreduce": False,
+                      "fused_update": "0"})  # str parses
+        assert fl.get_flags(["overlap_allreduce", "fused_update"]) == {
+            "overlap_allreduce": False, "fused_update": False}
+    finally:
+        fl.set_flags({"FLAGS_overlap_allreduce": True,
+                      "FLAGS_fused_update": True})
+    monkeypatch.setenv("FLAGS_overlap_allreduce", "off")
+    monkeypatch.setenv("FLAGS_fused_update", "false")
+    importlib.reload(fl)
+    assert fl.get_flags("overlap_allreduce")["overlap_allreduce"] is False
+    assert fl.get_flags("fused_update")["fused_update"] is False
+    monkeypatch.delenv("FLAGS_overlap_allreduce")
+    monkeypatch.delenv("FLAGS_fused_update")
     importlib.reload(fl)  # restore defaults for other tests
 
 

@@ -1,0 +1,63 @@
+"""tools/lint_collectives.py — the comm/compute-overlap CI tripwire: raw
+lax.ppermute/psum call sites in library code must route through the
+kernels layer (quantized wire format, algorithm selection, wire-bytes
+accounting) or carry an explicit `# collective: allow`.  Runs the real
+lint in tier-1 (`make lint-collectives` is the Makefile entry point)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import lint_collectives  # noqa: E402
+
+
+def test_library_tree_is_clean():
+    assert lint_collectives.main([]) == 0
+
+
+def test_flags_raw_ppermute_and_psum():
+    src = (
+        "from jax import lax\n"
+        "def f(x):\n"
+        "    y = lax.ppermute(x, 'dp', [(0, 1)])\n"
+        "    return lax.psum(y, 'dp')\n"
+    )
+    findings = lint_collectives.check_source(src, "bad.py")
+    assert [f[1] for f in findings] == [3, 4]
+    assert all(f[2] == "raw-collective" for f in findings)
+
+
+def test_allow_mark_same_line_and_above():
+    same = "import jax\ny = jax.lax.psum(x, 'dp')  # collective: allow\n"
+    above = ("import jax\n"
+             "# collective: allow\n"
+             "y = jax.lax.ppermute(x, 'dp', perm)\n")
+    assert lint_collectives.check_source(same, "a.py") == []
+    assert lint_collectives.check_source(above, "b.py") == []
+
+
+def test_sanctioned_modules_exempt():
+    assert lint_collectives._exempt(
+        "paddle_tpu/kernels/ring_collectives.py")
+    assert lint_collectives._exempt(
+        "paddle_tpu/kernels/quantized_collectives.py")
+    assert lint_collectives._exempt("paddle_tpu/ops/collective_ops.py")
+    # name-prefix cousins must still be linted
+    assert not lint_collectives._exempt(
+        "paddle_tpu/kernels/ring_collectives_extra.py")
+    assert not lint_collectives._exempt(
+        "paddle_tpu/kernels/ring_attention.py")
+
+
+def test_non_collective_attrs_pass():
+    src = ("import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    return jnp.sum(x) + x.sum()\n")
+    assert lint_collectives.check_source(src, "c.py") == []
+
+
+def test_parse_error_is_a_finding():
+    findings = lint_collectives.check_source("def broken(:\n", "x.py")
+    assert findings and findings[0][2] == "parse-error"

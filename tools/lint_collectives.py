@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Static collectives lint for the library tree.
+
+The comm/compute-overlap PR's CI tripwire: raw device collectives in
+library code bypass everything the kernels layer guarantees — the
+quantized wire format, the size-adaptive algorithm selection, the
+straight-through gradient convention, and the ``wire_bytes`` accounting
+that keeps ``pt_collective_payload_bytes_total`` honest against the
+compiled HLO.  One check over ``paddle_tpu/``:
+
+  raw-collective   a call whose attribute name is ``ppermute`` or
+                   ``psum`` (``lax.ppermute``, ``jax.lax.psum``, ...)
+                   outside the sanctioned collective modules.  Route it
+                   through ``kernels/ring_collectives.py`` /
+                   ``kernels/quantized_collectives.py`` (or the op
+                   lowerings in ``ops/collective_ops.py``) — or mark a
+                   deliberate site with ``# collective: allow``.
+
+Sanctioned modules (they ARE the collective surface):
+``kernels/ring_collectives.py``, ``kernels/quantized_collectives.py``,
+``ops/collective_ops.py``.
+
+Suppress a deliberate finding with ``# collective: allow`` on the same
+line or the line above (e.g. the ring-attention kernel's own ppermute
+ring, which rotates fp K/V blocks — payloads the quantized wire format
+must not touch).  Exit 0 when clean, 1 with findings (one per line:
+``path:lineno: [check] message``).
+
+Usage: python tools/lint_collectives.py [paths...]
+  (no args = paddle_tpu/, repo-relative)
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+DEFAULT_TARGETS = ["paddle_tpu"]
+
+# the sanctioned collective surface — raw psum/ppermute is their job
+EXEMPT = (
+    "paddle_tpu/kernels/ring_collectives.py",
+    "paddle_tpu/kernels/quantized_collectives.py",
+    "paddle_tpu/ops/collective_ops.py",
+)
+
+RAW_COLLECTIVES = ("ppermute", "psum")
+
+ALLOW_MARK = "collective: allow"
+
+
+def _allowed(src_lines, lineno):
+    """Marker accepted on the flagged line or the line directly above."""
+    for ln in (lineno - 1, lineno - 2):
+        if 0 <= ln < len(src_lines) and ALLOW_MARK in src_lines[ln]:
+            return True
+    return False
+
+
+def check_source(src: str, path: str = "<string>"):
+    """Lint one file's source; returns [(path, lineno, check, message)]."""
+    findings = []
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, "parse-error", str(e))]
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in RAW_COLLECTIVES):
+            continue
+        if _allowed(lines, node.lineno):
+            continue
+        findings.append(
+            (path, node.lineno, "raw-collective",
+             f"raw {node.func.attr}() outside the kernels layer — route "
+             "through kernels/ring_collectives.py (quantized wire format, "
+             "algorithm selection, wire-bytes accounting) or mark a "
+             f"deliberate site `# {ALLOW_MARK}`"))
+    return findings
+
+
+def _exempt(rel_str: str) -> bool:
+    return rel_str in EXEMPT
+
+
+def check_file(path: Path):
+    rel = path.resolve()
+    try:
+        rel_str = str(rel.relative_to(REPO))
+    except ValueError:
+        rel_str = str(path)
+    if _exempt(rel_str):
+        return []
+    return check_source(path.read_text(encoding="utf-8"), rel_str)
+
+
+def main(argv):
+    targets = argv or DEFAULT_TARGETS
+    findings = []
+    for t in targets:
+        p = (REPO / t) if not Path(t).is_absolute() else Path(t)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            findings.extend(check_file(f))
+    for path, lineno, check, msg in findings:
+        print(f"{path}:{lineno}: [{check}] {msg}")
+    if findings:
+        print(f"{len(findings)} finding(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -29,7 +29,11 @@ PT_BENCH_HOPLAT=0 — the hop-latency sub-rung: per-hop latency vs payload
 for the ring vs the oneshot form plus the measured crossover that tunes
 FLAGS_quant_allreduce_crossover_kb); PT_BENCH_OVERLAP=1 (with QUANTAR) →
 overlap-on vs overlap-off A/B with per-arm p50/p95/max step quantiles
-(FLAGS_overlap_allreduce toggled per arm); PT_BENCH_SERVE=1 → serving-lane load-generator
+(FLAGS_overlap_allreduce toggled per arm); PT_BENCH_GSPMD=1 →
+transpiler-lane vs GSPMD-executor-lane A/B (parallel/gspmd/): per-arm
+p50/p95/max step quantiles plus the gspmd arm's XLA-inserted collective
+counts and resharding bytes from compiled-HLO inspection;
+PT_BENCH_SERVE=1 → serving-lane load-generator
 rung: a paddle_tpu.serving.Engine under closed-loop concurrent clients,
 recording request throughput + p50/p99 latency quantiles and batch-size /
 executable-cache figures (PT_BENCH_SERVE_CLIENTS, PT_BENCH_SERVE_REQUESTS
@@ -820,6 +824,63 @@ def _overlap_step_quantiles(size, batch, seq_len, n_steps, bf16):
     return out
 
 
+def _gspmd_ab(size, batch, seq_len, n_steps, bf16):
+    """PT_BENCH_GSPMD=1 A/B rung: the SAME bert step through the
+    transpiler DP lane (explicit c_allreduce ops + shard_map) vs the
+    GSPMD executor lane (sharding policy + XLA-inserted collectives,
+    parallel/gspmd/), per-step wall quantiles per arm.  The gspmd arm
+    additionally records what the partitioner chose: collective
+    instruction counts and per-step resharding bytes from compiled-HLO
+    inspection (the pt_gspmd_resharding_bytes surface)."""
+    import numpy as np
+
+    from paddle_tpu import fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import DataParallelRunner
+    from paddle_tpu.parallel.gspmd import (hlo_collective_bytes,
+                                           hlo_collective_counts)
+
+    kw = dict(vocab_size=30528, attn_dropout=0.1)
+    cfg = (bert.BertConfig.base(**kw) if size == "base"
+           else bert.BertConfig.tiny(**kw))
+    out = {"methodology": "syncfetch per-step", "steps": n_steps}
+    for arm, gspmd in (("transpiler", False), ("gspmd", True)):
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup), \
+                fluid.unique_name.guard():
+            feeds, loss, _mlm, _nsp = bert.build_bert_pretrain(
+                cfg, is_test=False)
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        _maybe_enable_bf16(main_prog, bf16)
+        data = bert.make_fake_batch(cfg, batch=batch, seq_len=seq_len,
+                                    seed=0)
+        times = []
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            runner = DataParallelRunner(main_prog, loss.name, gspmd=gspmd)
+            runner.run(exe, data, [loss.name], scope)  # warm/compile
+            for _ in range(n_steps):
+                t0 = time.perf_counter()
+                runner.run(exe, data, [loss.name], scope)
+                times.append(time.perf_counter() - t0)
+            rec = {
+                "p50_s": round(float(np.percentile(times, 50)), 6),
+                "p95_s": round(float(np.percentile(times, 95)), 6),
+                "max_s": round(float(np.max(times)), 6),
+            }
+            if gspmd and runner._gspmd_exec.last_hlo:
+                hlo = runner._gspmd_exec.last_hlo
+                rec["resharding_bytes"] = hlo_collective_bytes(hlo)
+                rec["collectives"] = hlo_collective_counts(hlo)
+                rec["program_collective_ops"] = sum(
+                    1 for op in runner.program.global_block().ops
+                    if op.type.startswith("c_allreduce"))
+        out[arm] = rec
+    return out
+
+
 def measure(size):
     if os.environ.get("PT_BENCH_FORCE_CPU"):
         # last-resort rung: the TPU tunnel can wedge for hours (observed);
@@ -1006,6 +1067,15 @@ def measure(size):
             except Exception as e:
                 print(f"bench: overlap A/B rung failed ({e})",
                       file=sys.stderr)
+    # transpiler-lane vs GSPMD-executor-lane A/B (ISSUE 9): step
+    # quantiles per arm + what XLA's partitioner inserted on the gspmd
+    # arm (collective counts, resharding bytes from HLO inspection)
+    if os.environ.get("PT_BENCH_GSPMD") == "1":
+        try:
+            rec["gspmd_ab"] = _gspmd_ab(size, batch, seq_len, n_steps,
+                                        bf16)
+        except Exception as e:
+            print(f"bench: gspmd A/B rung failed ({e})", file=sys.stderr)
     return rec
 
 

@@ -67,6 +67,11 @@ class BuildStrategy:
         # fused dequant->update->requant step kernels (None =
         # FLAGS_fused_update, kernels/fused_update.py)
         self.fused_update = None
+        # GSPMD-native execution lane (None = FLAGS_gspmd_executor):
+        # True compiles the UNrewritten program under the partitioned
+        # executor (parallel/gspmd/) — sharding policies +
+        # XLA-inserted collectives instead of the transpiler rewrite
+        self.gspmd_executor = None
 
 
 class ExecutionStrategy:

@@ -401,6 +401,17 @@ def trace_block(block, env, ctx, ops=None):
                     env[n] = v
             elif names and val is not None:
                 env[names[0]] = val
+        # GSPMD activation annotations (parallel/gspmd/specs.py): a
+        # sharding policy may pin selected op outputs with
+        # with_sharding_constraint AT THE PRODUCING SITE, so XLA's
+        # propagation is anchored in both directions — the constraint
+        # callables are supplied via ctx by the partitioned executor and
+        # absent on every other path.
+        cons = getattr(ctx, "sharding_constraints", None)
+        if cons:
+            for n in op.output_arg_names:
+                if n in cons and n in env:
+                    env[n] = cons[n](env[n])
     return env
 
 

@@ -124,6 +124,22 @@ _DEFS = {
     # never round-trip HBM.  On by default; engages only where the quant
     # path / zero_gather_quant are already opted in.
     "FLAGS_fused_update": (True, _parse_bool, True),
+    # GSPMD-native execution core (parallel/gspmd/, docs/DISTRIBUTED.md
+    # "GSPMD execution core"): route DataParallelRunner /
+    # HybridParallelRunner through the one jit-partitioned executor —
+    # sharding policies + XLA-inserted collectives instead of the
+    # transpiler's per-gradient c_allreduce rewrite.  Off by default
+    # while the transpiler lane remains the benched baseline; flip per
+    # run or per runner via gspmd=True.
+    "FLAGS_gspmd_executor": (False, _parse_bool, True),
+    # quant-hook integration form (parallel/gspmd/quant_hook.py):
+    # "shard_map" = the fwd/bwd island reducing gradients on the
+    # dual-int8 ring (works everywhere), "custom_partitioning" = the
+    # reduction as a jax.custom_partitioning rule GSPMD integrates
+    # natively, "auto" = custom_partitioning on TPU backends only (the
+    # jaxlib-0.4.3x XLA:CPU GSPMD lane cannot be trusted with it —
+    # documented fallback)
+    "FLAGS_gspmd_quant_impl": ("auto", str, True),
     # ZeRO-1 weight-update gather quantization (parallel/hybrid.py
     # zero_gather_quant default): the dp-sharded parameter update
     # re-replicates through a block-scaled int8 all-gather instead of the

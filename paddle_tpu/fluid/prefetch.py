@@ -17,7 +17,9 @@ import queue
 import threading
 import time
 
-__all__ = ["DatasetPrefetcher"]
+import numpy as np
+
+__all__ = ["DatasetPrefetcher", "partition_batch"]
 
 _SENTINEL = object()
 
@@ -51,6 +53,41 @@ def _m_wait():
         "Consumer seconds blocked on an empty prefetch queue")
 
 
+def _m_repartitions():
+    from paddle_tpu import observability as _obs
+
+    return _obs.counter(
+        "pt_prefetch_repartitions_total",
+        "Elastic feed (index, count) view changes observed by the "
+        "prefetcher's round-partitioned slicing")
+
+
+def partition_batch(batch, index, count):
+    """Slice one feed dict to the (index, count) member view: every
+    array-valued entry keeps rows ``[index*per, (index+1)*per)`` of an
+    even ``per = B // count`` split (rows past ``per * count`` are
+    dropped so every member sees the same round shape).  The
+    round-partitioned elastic feed proven in the test_elastic_ps
+    acceptance runner: equal slices make the merged gradient equal the
+    full-batch mean at EVERY membership size, which is what keeps a
+    preempt-then-rejoin run at parity with the uninterrupted baseline
+    (docs/DISTRIBUTED.md §6)."""
+    index, count = int(index), int(count)
+    if count <= 1:
+        return batch
+    if not (0 <= index < count):
+        raise ValueError(f"partition index {index} outside count {count}")
+    out = {}
+    for k, v in batch.items():
+        shape = np.shape(v)  # () for scalars/strings — never raises
+        if not shape or shape[0] < count:
+            out[k] = v  # scalar / sub-count batch: replicate, don't slice
+            continue
+        per = shape[0] // count
+        out[k] = v[index * per:(index + 1) * per]
+    return out
+
+
 class DatasetPrefetcher:
     """Iterate `batch_iter` on a daemon thread, `transform` each batch
     (coerce + device_put) off the consumer's critical path, and buffer up
@@ -60,12 +97,27 @@ class DatasetPrefetcher:
       wait_seconds     — consumer time blocked on an empty queue (input-bound)
       produce_seconds  — producer time parsing + transforming
       batches          — number of batches delivered
+
+    partition: optional callable returning the CURRENT elastic
+    ``(index, count)`` membership view (e.g. ``lambda:
+    (info["index"], info["count"])`` over `distributed.elastic
+    .membership`).  Re-read per produced batch, BEFORE ``transform``, so
+    an epoch flip re-shards the very next batch: each member slices its
+    even ``B // count`` share of the global batch (`partition_batch`) —
+    the round-partitioned elastic feed as a library feature instead of
+    test-local code (ROADMAP elastic phase 2).  A pending member
+    (index < 0) replays the full batch unsliced; view changes count on
+    ``pt_prefetch_repartitions_total`` and in ``repartitions``.
     """
 
-    def __init__(self, batch_iter, transform=None, depth=2):
+    def __init__(self, batch_iter, transform=None, depth=2,
+                 partition=None):
         self.depth = max(1, int(depth))
         self._q: queue.Queue = queue.Queue(maxsize=self.depth)
         self._transform = transform or (lambda b: b)
+        self._partition = partition
+        self._last_view = None
+        self.repartitions = 0
         self._err = None
         self._exhausted = False
         self._stop = threading.Event()
@@ -77,10 +129,23 @@ class DatasetPrefetcher:
             name="paddle-tpu-dataset-prefetch", daemon=True)
         self._thread.start()
 
+    def _apply_partition(self, batch):
+        index, count = self._partition()
+        view = (int(index), int(count))
+        if self._last_view is not None and view != self._last_view:
+            self.repartitions += 1
+            _m_repartitions().inc()
+        self._last_view = view
+        if view[0] < 0:  # pending member: not yet in the epoch's quorum
+            return batch
+        return partition_batch(batch, *view)
+
     def _produce(self, it):
         try:
             for batch in it:
                 t0 = time.perf_counter()
+                if self._partition is not None and isinstance(batch, dict):
+                    batch = self._apply_partition(batch)
                 out = self._transform(batch)
                 self.produce_seconds += time.perf_counter() - t0
                 while not self._stop.is_set():

@@ -36,6 +36,26 @@ def install():
     jax.shard_map = shard_map
 
 
+def get_custom_partitioning():
+    """`jax.custom_partitioning` across jax versions (modern spelling
+    first, then the 0.4.x experimental home) — the GSPMD quant hook's
+    TPU-native integration point (parallel/gspmd/quant_hook.py).
+    Returns None when the toolchain has neither, so callers can demote
+    to the shard_map island instead of crashing at compile time."""
+    import jax
+
+    cp = getattr(jax, "custom_partitioning", None)
+    if cp is not None:
+        return cp
+    try:
+        from jax.experimental.custom_partitioning import (
+            custom_partitioning)
+
+        return custom_partitioning
+    except ImportError:
+        return None
+
+
 def distributed_reinit(coordinator_address, num_processes, process_id,
                        **kw):
     """`jax.distributed` re-initialization across jax versions — the

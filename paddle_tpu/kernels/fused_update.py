@@ -78,9 +78,11 @@ __all__ = [
     "dequant_slice",
     "adam_math",
     "sgd_math",
+    "momentum_math",
     "quantize_for_gather",
     "fused_adam_update",
     "fused_sgd_update",
+    "fused_momentum_update",
 ]
 
 
@@ -152,6 +154,22 @@ def sgd_math(p, g32, lr):
             * g32.astype(jnp.float32))
 
 
+def momentum_math(p, g32, v, lr, mu, use_nesterov=False):
+    """The momentum update in fp32 — term-for-term
+    ``ops/optimizer_ops.py`` ``_momentum`` (heavy-ball by default,
+    Nesterov under the op's ``use_nesterov`` attr).  Returns
+    ``(p_new32, v_new)`` with the velocity in its input dtype."""
+    g32 = g32.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    lr_ = jnp.reshape(lr, ()).astype(jnp.float32)
+    v_new = mu * v.astype(jnp.float32) + g32
+    if use_nesterov:
+        p_new = p32 - (g32 + mu * v_new) * lr_
+    else:
+        p_new = p32 - lr_ * v_new
+    return p_new, v_new.astype(v.dtype)
+
+
 def quantize_for_gather(p_new32, block_size, dual_int8=True,
                         pad_multiple=None):
     """Requantize the fp32 updated parameter into the ZeRO-gather wire
@@ -221,7 +239,10 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
                   requant, interpret):
     """Run the fused chain as a Pallas kernel over [R, B] views.
     ``lr_t`` is the precomputed scalar step size (bias-corrected for
-    Adam); returns (p_new or (q_hi, q_lo, sc), m1n, m2n)."""
+    Adam); returns (p_new or (q_hi, q_lo, sc), m1n, m2n).  ``kind`` is
+    "sgd" (stateless), "momentum" (one velocity slot in m1_2, hyper =
+    (mu, use_nesterov, _)), or "adam" (two moment slots, hyper =
+    (beta1, beta2, epsilon))."""
     from jax.experimental import pallas as pl  # noqa: F401 (import gate)
 
     dual = glo2 is not None
@@ -238,8 +259,9 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
             lo_ref = refs[i]; i += 1
         sc_ref = refs[i]; i += 1
         m1_ref = m2_ref = None
-        if kind == "adam":
+        if kind in ("adam", "momentum"):
             m1_ref = refs[i]; i += 1
+        if kind == "adam":
             m2_ref = refs[i]; i += 1
         lr_ref = refs[i]; i += 1
         outs = refs[i:]
@@ -252,6 +274,11 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
             m2n = (beta2 * m2_ref[:].astype(jnp.float32)
                    + (1 - beta2) * jnp.square(g))
             pn = p - lr * m1n / (jnp.sqrt(m2n) + eps)
+        elif kind == "momentum":
+            mu, nesterov = beta1, bool(beta2)
+            m1n = mu * m1_ref[:].astype(jnp.float32) + g
+            pn = (p - (g + mu * m1n) * lr if nesterov
+                  else p - lr * m1n)
         else:
             pn = p - lr * g
         if requant:
@@ -261,14 +288,17 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
             outs[o][:] = scale; o += 1
         else:
             outs[o][:] = pn; o += 1
-        if kind == "adam":
+        if kind in ("adam", "momentum"):
             outs[o][:] = m1n; o += 1
+        if kind == "adam":
             outs[o][:] = m2n; o += 1
 
     sds = jax.ShapeDtypeStruct
     ins = [p2, ghi2] + ([glo2] if dual else []) + [gsc2]
+    if kind in ("adam", "momentum"):
+        ins += [m1_2]
     if kind == "adam":
-        ins += [m1_2, m2_2]
+        ins += [m2_2]
     ins += [lr_arr]
     out_structs = []
     if requant:
@@ -276,8 +306,10 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
                         sds((R, 1), jnp.float32)]
     else:
         out_structs += [sds((R, B), jnp.float32)]
+    if kind in ("adam", "momentum"):
+        out_structs += [sds((R, B), jnp.float32)]
     if kind == "adam":
-        out_structs += [sds((R, B), jnp.float32), sds((R, B), jnp.float32)]
+        out_structs += [sds((R, B), jnp.float32)]
     call = _pallas_call(kernel, R, B,
                         [sds(x.shape, x.dtype) for x in ins],
                         out_structs, interpret)
@@ -290,8 +322,10 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
         result = outs[0]
         o = 1
     m1n = m2n = None
+    if kind in ("adam", "momentum"):
+        m1n = outs[o]; o += 1
     if kind == "adam":
-        m1n, m2n = outs[o], outs[o + 1]
+        m2n = outs[o]
     return result, m1n, m2n
 
 
@@ -459,6 +493,50 @@ def fused_sgd_update(p, grad, lr, *, block_size=DEFAULT_BLOCK_SIZE,
                                                pad_multiple=requant_pad)
         return p_new32.astype(p.dtype), q_hi, q_lo, q_sc
     return p_new32.astype(p.dtype)
+
+
+def fused_momentum_update(p, grad, v, lr, *, mu=0.9, use_nesterov=False,
+                          block_size=DEFAULT_BLOCK_SIZE, requant_pad=None):
+    """The fused momentum step — same contract as
+    :func:`fused_adam_update` with one velocity slot instead of the two
+    moments (the mechanical extension the comms-lane ROADMAP item names).
+    Returns ``(p_new, v_new)`` or ``(p_new, v_new, q_hi, q_lo, q_sc)``."""
+    shape, bs = jnp.shape(p), int(block_size)
+    if _pallas_able(grad, requant_pad, bs):
+        numel = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        numel_padded = numel + (-numel) % bs
+        hi2, lo2, sc2, rows = _pallas_grad_blocks(grad, bs, numel_padded)
+        p2 = _as_blocks(p, rows, bs)
+        v2 = _as_blocks(v, rows, bs)
+        lr_t = jnp.reshape(lr, ()).astype(jnp.float32)
+        out, vn2, _ = _pallas_fused(
+            "momentum", p2, hi2, lo2, sc2, v2, None, lr_t,
+            (mu, 1.0 if use_nesterov else 0.0, 0.0),
+            requant=requant_pad is not None,
+            interpret=impl() == "interpret")
+
+        def unblk(x2, dtype):
+            return x2.reshape(-1)[:numel].reshape(shape).astype(dtype)
+
+        v_new = unblk(vn2, v.dtype)
+        if requant_pad is not None:
+            q_hi2, q_lo2, q_sc2 = out
+            p_new = dequantize_block_scaled(
+                q_hi2.reshape(-1), q_lo2.reshape(-1),
+                q_sc2.reshape(-1), bs)
+            q_hi, q_lo, q_sc = _repad_payload(
+                q_hi2, q_lo2, q_sc2, numel, bs, requant_pad)
+            return (unblk(p_new.reshape(rows, bs), p.dtype), v_new,
+                    q_hi, q_lo, q_sc)
+        return unblk(out, p.dtype), v_new
+    g = _grad_value(grad, bs, shape)
+    p_new32, v_new = momentum_math(p, g, v, lr, mu,
+                                   use_nesterov=use_nesterov)
+    if requant_pad is not None:
+        q_hi, q_lo, q_sc = quantize_for_gather(p_new32, bs,
+                                               pad_multiple=requant_pad)
+        return p_new32.astype(p.dtype), v_new, q_hi, q_lo, q_sc
+    return p_new32.astype(p.dtype), v_new
 
 
 def _repad_payload(q_hi2, q_lo2, q_sc2, numel, block_size, pad_multiple):

@@ -281,6 +281,21 @@ def _fused_adam_quant_grad(ctx, p, qh, ql, qsc, m1, m2, lr, b1p, b2p,
         block_size=attrs.get("block_size", 256))
 
 
+@simple_op(
+    "fused_momentum_quant_grad",
+    ["Param", "QHi", "QLo", "QScale", "Velocity", "LearningRate"],
+    ["ParamOut", "VelocityOut"], grad=None, optional=("QLo",),
+    inplace={"ParamOut": "Param", "VelocityOut": "Velocity"})
+def _fused_momentum_quant_grad(ctx, p, qh, ql, qsc, v, lr, attrs):
+    from paddle_tpu.kernels import fused_update as fu
+
+    g = (qh, ql, qsc, attrs["offset_blocks"], attrs["numel"])
+    return fu.fused_momentum_update(
+        p, g, v, lr, mu=attrs.get("mu", 0.9),
+        use_nesterov=attrs.get("use_nesterov", False),
+        block_size=attrs.get("block_size", 256))
+
+
 @simple_op("fused_sgd_quant_gather", ["Param", "Grad", "LearningRate"],
            ["ParamOut", "QHi", "QLo", "QScale"], grad=None,
            inplace={"ParamOut": "Param"})
@@ -311,6 +326,22 @@ def _fused_adam_quant_gather(ctx, p, g, m1, m2, lr, b1p, b2p, attrs):
         p, g, m1, m2, lr, b1p, b2p,
         beta1=attrs.get("beta1", 0.9), beta2=attrs.get("beta2", 0.999),
         epsilon=attrs.get("epsilon", 1e-8),
+        block_size=attrs.get("block_size", 256),
+        requant_pad=(attrs.get("pad_multiple")
+                     or attrs.get("block_size", 256)))
+
+
+@simple_op(
+    "fused_momentum_quant_gather",
+    ["Param", "Grad", "Velocity", "LearningRate"],
+    ["ParamOut", "VelocityOut", "QHi", "QLo", "QScale"], grad=None,
+    inplace={"ParamOut": "Param", "VelocityOut": "Velocity"})
+def _fused_momentum_quant_gather(ctx, p, g, v, lr, attrs):
+    from paddle_tpu.kernels import fused_update as fu
+
+    return fu.fused_momentum_update(
+        p, g, v, lr, mu=attrs.get("mu", 0.9),
+        use_nesterov=attrs.get("use_nesterov", False),
         block_size=attrs.get("block_size", 256),
         requant_pad=(attrs.get("pad_multiple")
                      or attrs.get("block_size", 256)))

@@ -8,6 +8,11 @@ from .hybrid import (  # noqa: F401
 )
 from . import data_parallel  # noqa: F401
 from .data_parallel import DataParallelRunner, transpile_data_parallel  # noqa: F401
+from . import gspmd  # noqa: F401
+from .gspmd import (  # noqa: F401
+    DataParallelPolicy, GSPMDExecutor, ShardingPolicy,
+    TensorParallelPolicy, Zero1Policy, policy_for,
+)
 from . import local_sgd  # noqa: F401
 from .local_sgd import LocalSGDRunner  # noqa: F401
 from . import pipeline  # noqa: F401

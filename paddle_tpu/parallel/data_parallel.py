@@ -72,7 +72,8 @@ def fused_update_bytes_counter():
 # replaced by the bucket's wire-format image (int8 + scales), the update
 # dequantizes the member's block-aligned slice inline
 _FUSED_UPDATE_OPS = {"sgd": "fused_sgd_quant_grad",
-                     "adam": "fused_adam_quant_grad"}
+                     "adam": "fused_adam_quant_grad",
+                     "momentum": "fused_momentum_quant_grad"}
 
 
 def _plan_quant_buckets(block, grads, prod_index, block_size, bucket_mb):
@@ -501,11 +502,29 @@ def transpile_data_parallel(program, loss_name, num_devices,
 
 
 class DataParallelRunner:
-    """Compiles + runs a data-parallel program over all local devices."""
+    """Compiles + runs a data-parallel program over all local devices.
+
+    Two execution lanes behind one API (docs/DISTRIBUTED.md "GSPMD
+    execution core" decision matrix):
+
+    - transpiler (default): the in-place multi-device graph rewrite
+      below plus a shard_map — every gradient collective is an explicit
+      program op this runner inserted.
+    - gspmd=True (FLAGS_gspmd_executor / BuildStrategy.gspmd_executor):
+      the UNmodified program compiles under the one jit-partitioned
+      `parallel.gspmd.GSPMDExecutor` with a `DataParallelPolicy` — no
+      collective ops inserted by Python, XLA places them all; the
+      quantized wire format survives through the quant hook when
+      ``quant_grads`` is on.  This runner is then a thin policy
+      selection.  Fetch convention difference (documented): global-view
+      fetches are the GLOBAL value (the loss is the global-batch mean
+      scalar), where the transpiler lane stacks per-device values —
+      `np.mean` of a scalar fetch agrees across both.
+    """
 
     def __init__(self, program, loss_name, build_strategy=None, places=None,
                  quant_grads=None, quant_algo=None, overlap=None,
-                 fused_update=None):
+                 fused_update=None, gspmd=None):
         import jax
 
         n = len(places) if places else jax.device_count()
@@ -522,7 +541,7 @@ class DataParallelRunner:
         self.quant_grads = bool(quant_grads)
         # same layering for the algorithm choice; None defers all the way
         # to FLAGS_quant_allreduce_algo inside the transpile — ditto the
-        # ready-order overlap and fused-update knobs
+        # ready-order overlap, fused-update and gspmd knobs
         if quant_algo is None:
             quant_algo = getattr(build_strategy, "quant_allreduce_algo",
                                  None)
@@ -531,6 +550,27 @@ class DataParallelRunner:
             overlap = getattr(build_strategy, "overlap_allreduce", None)
         if fused_update is None:
             fused_update = getattr(build_strategy, "fused_update", None)
+        if gspmd is None:
+            gspmd = getattr(build_strategy, "gspmd_executor", None)
+        if gspmd is None:
+            from paddle_tpu.fluid import flags as _flags
+
+            gspmd = _flags.flag("gspmd_executor")
+        self.gspmd = bool(gspmd)
+        self._gspmd_exec = None
+        if self.gspmd:
+            # GSPMD lane: the program stays UNTOUCHED — the global-view
+            # loss mean over the sharded batch already yields averaged
+            # gradients, and XLA inserts the collectives.  policy_for is
+            # the one selection rule shared with the hybrid runner.
+            from .gspmd import GSPMDExecutor, policy_for
+
+            self.program = program
+            self._gspmd_exec = GSPMDExecutor(
+                program, self.mesh, policy_for(self.mesh),
+                quant_hook=self.quant_grads, quant_algo=quant_algo)
+            self._cache = {}
+            return
         # rewrite in place, like the reference's multi-device pass
         self.program = transpile_data_parallel(
             program, loss_name, n,
@@ -563,6 +603,12 @@ class DataParallelRunner:
                 raise ValueError(
                     f"feed {k!r} batch {np.shape(v)[0]} not divisible by "
                     f"{self.num_devices} devices")
+        if self._gspmd_exec is not None:
+            out = self._gspmd_exec.run(scope=scope, feed=feed,
+                                       fetch_list=fetch_names,
+                                       return_numpy=return_numpy)
+            executor._step += 1
+            return out
         key = self._cache_key(feed, fetch_names)
         cb = self._cache.get(key)
         if cb is None:
@@ -617,6 +663,10 @@ class DataParallelRunner:
         feed = executor._coerce_feed(self.program, feed or {})
         fetch_names = [f.name if not isinstance(f, str) else f
                        for f in (fetch_list or [])]
+        if self._gspmd_exec is not None:
+            return self._gspmd_exec.cost_analysis(feed,
+                                                  fetch_list=fetch_names,
+                                                  scope=scope)
         cb = self._cache.get(self._cache_key(feed, fetch_names))
         if cb is None:
             raise ValueError(

@@ -1,0 +1,22 @@
+"""GSPMD-native sharding core (docs/DISTRIBUTED.md "GSPMD execution
+core"): sharding policies over the named mesh, one jit-partitioned
+executor, and the quantized-ring gradient hook — the subsystem the DP
+and hybrid runners select policies over instead of rewriting programs."""
+
+from . import specs  # noqa: F401
+from .specs import (  # noqa: F401
+    DataParallelPolicy,
+    ParamSpec,
+    ShardingPolicy,
+    TensorParallelPolicy,
+    Zero1Policy,
+    policy_for,
+)
+from . import executor  # noqa: F401
+from .executor import (  # noqa: F401
+    GSPMDExecutor,
+    hlo_collective_bytes,
+    hlo_collective_counts,
+)
+from . import quant_hook  # noqa: F401
+from .quant_hook import plan_quant_hook, resolve_quant_impl  # noqa: F401

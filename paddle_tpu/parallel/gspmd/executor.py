@@ -1,0 +1,465 @@
+"""The one jit-partitioned GSPMD executor.
+
+Compiles a whole Program ONCE under `jax.jit` with in/out shardings
+resolved by a `ShardingPolicy` (specs.py) and
+`with_sharding_constraint` annotations applied at the producing op
+during the trace — no per-gradient collective ops are ever inserted by
+Python.  XLA's SPMD partitioner places every collective; the compiled
+HLO is inspected to publish how many bytes of resharding/collective
+traffic it chose (``pt_gspmd_resharding_bytes``), which is also how the
+tests PROVE the collectives came from XLA and not from the program
+(tests/test_gspmd_core.py asserts no ``c_allreduce*`` op types exist in
+the program it runs).
+
+Shares the `_JitExecutable` plumbing of `fluid/executor.py` — the
+compile-cache counters (``pt_compile_cache_total{path="gspmd"}``), step
+histograms (``pt_step_seconds``), cost/memory analysis, and the
+BlockPlan prune/analyze/write-back contract — so a GSPMD step
+introspects exactly like a single-device or shard_map one.
+
+The DP and hybrid runners are thin policy selections over this class
+(`DataParallelRunner(gspmd=True)` / `HybridParallelRunner(gspmd=True)`,
+FLAGS_gspmd_executor); the quantized gradient wire format rides along
+through `quant_hook.py` when the quant path is opted in.
+"""
+
+from __future__ import annotations
+
+import re
+import warnings
+
+import numpy as np
+
+from paddle_tpu.fluid import registry
+from paddle_tpu.fluid.executor import _JitExecutable, trace_block
+
+from .. import mesh as pmesh
+from . import specs as gspecs
+from .quant_hook import plan_quant_hook
+
+__all__ = ["GSPMDExecutor", "hlo_collective_bytes",
+           "hlo_collective_counts", "prep_feed"]
+
+
+def prep_feed(feed, fetch_list):
+    """Coerce feed values and build the (feed_sig, fetch_names) cache
+    identity — THE shared dispatch-key helper of the partitioned lanes
+    (HybridParallelRunner._prep delegates here).  v.dtype directly:
+    np.asarray on a device-resident jax array would force a host
+    transfer just to read the dtype."""
+    feed = {k: np.asarray(v) if not hasattr(v, "dtype") else v
+            for k, v in (feed or {}).items()}
+    fetch_names = [f if isinstance(f, str) else f.name
+                   for f in (fetch_list or [])]
+    feed_sig = tuple((k, tuple(np.shape(v)), str(v.dtype))
+                     for k, v in sorted(feed.items()))
+    return feed, fetch_names, feed_sig
+
+
+# ---------------------------------------------------------------------------
+# compiled-HLO inspection: what did XLA's partitioner insert?
+# ---------------------------------------------------------------------------
+
+_HLO_ITEMSIZE = {"s8": 1, "u8": 1, "pred": 1, "bf16": 2, "f16": 2,
+                 "s16": 2, "u16": 2, "f32": 4, "s32": 4, "u32": 4,
+                 "f64": 8, "s64": 8, "u64": 8}
+
+_COLLECTIVE_KINDS = ("all-to-all", "all-gather", "collective-permute",
+                     "all-reduce", "reduce-scatter")
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(\([^)]*\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"(" + "|".join(_COLLECTIVE_KINDS) + r")(-start)?\(")
+
+
+def _shape_bytes(tok):
+    m = re.match(r"([a-z0-9]+)\[([0-9,]*)\]", tok)
+    if m is None:
+        return 0
+    dt, dims = m.groups()
+    size = 1
+    for d in dims.split(","):
+        if d:
+            size *= int(d)
+    return size * _HLO_ITEMSIZE.get(dt, 4)
+
+
+def hlo_collective_bytes(hlo):
+    """Sum the output bytes of every cross-device collective instruction
+    in an optimized per-device SPMD HLO module — the wire payload the
+    executable moves per step.  The per-instruction accounting the ring
+    wire-bytes cross-check uses (tests/test_ring_collectives.py), now a
+    library surface feeding ``pt_gspmd_resharding_bytes``.  Async
+    ``-start`` forms (TPU's start/done pairs) report a tuple that
+    ALIASES the operand beside the result, so their tuple bytes are
+    halved — else the on-chip numbers would double-count against the
+    sync-form CPU ones and the PT_BENCH_GSPMD A/B lanes would not be
+    comparable."""
+    total = 0
+    for m in _COLLECTIVE_RE.finditer(hlo):
+        nbytes = sum(_shape_bytes(t)
+                     for t in re.findall(r"[a-z0-9]+\[[0-9,]*\]",
+                                         m.group(1)))
+        if m.group(3):  # "-start": (operand alias, result) tuple
+            nbytes //= 2
+        total += nbytes
+    return total
+
+
+def hlo_collective_counts(hlo):
+    """{collective kind: instruction count} over an optimized HLO module
+    — the inspection surface the GSPMD acceptance gates assert on (XLA
+    inserted the collectives; with the quant hook, int8 payloads appear
+    on permute/all-to-all operands)."""
+    out = {}
+    for m in _COLLECTIVE_RE.finditer(hlo):
+        out[m.group(2)] = out.get(m.group(2), 0) + 1
+    return out
+
+
+def _m_resharding():
+    from paddle_tpu import observability as obs
+
+    return obs.gauge(
+        "pt_gspmd_resharding_bytes",
+        "Per-step collective/resharding bytes the GSPMD-partitioned "
+        "executable moves, from compiled-HLO inspection, per signature",
+        labels=("signature",))
+
+
+# ---------------------------------------------------------------------------
+# the compiled block
+# ---------------------------------------------------------------------------
+
+
+class _GSPMDBlock(_JitExecutable):
+    """One (program version, feed signature, fetch list) → GSPMD-
+    partitioned XLA executable, with policy-resolved in/out shardings."""
+
+    def __init__(self, executor, scope, feed_names, fetch_names,
+                 feed_shapes=None):
+        import jax
+
+        from paddle_tpu.fluid.executor import BlockPlan
+
+        program, mesh, policy = (executor.program, executor.mesh,
+                                 executor.policy)
+        feed_shapes = dict(feed_shapes or {})
+        plan = BlockPlan(program, program.global_block(), feed_names,
+                         fetch_names, scope)
+        if plan.host_pre_ops:
+            raise NotImplementedError(
+                "pre-stage host ops (distributed lookup) are only "
+                "supported by the single-device Executor")
+        self.plan = plan
+        self.program = program
+        self.mesh = mesh
+        self.policy = policy
+        self.feed_names = plan.feed_names
+        self.fetch_names = plan.fetch_names
+        self.donated_names = plan.donated_names
+        self.readonly_names = plan.readonly_names
+        self.write_names = plan.write_names
+        self.label = (f"gspmd@{id(program):x}/v{program._version}"
+                      f"/{policy.name}")
+        self.last_hlo = None
+        self._prof_state = {"ran": False}
+
+        # resolved feed placement, ONE source for the jit in_shardings
+        # and the quant island's in_specs: explicit executor.feed_specs
+        # win (alias-canonicalized); otherwise the policy resolves
+        # against the REAL feed shape, so feed_spec's divisibility gate
+        # (non-divisible batch -> graceful replication) actually engages
+        axis = policy.batch_axis
+        self._feed_specs = {}
+        for n in self.feed_names:
+            if n in executor.feed_specs:
+                spec = tuple(pmesh.canonical_axis(a)
+                             for a in executor.feed_specs[n])
+            else:
+                spec = policy.feed_spec(program, n, feed_shapes.get(n),
+                                        mesh)
+            self._feed_specs[n] = spec
+
+        # quant hook: None when off/demoted — the pure GSPMD path
+        self.qplan = None
+        if executor.quant_hook:
+            self.qplan = plan_quant_hook(
+                plan, program, mesh, policy,
+                block_size=executor.quant_block_size,
+                algo=executor.quant_algo,
+                crossover_kb=executor.quant_crossover_kb,
+                impl=executor.quant_impl)
+            if self.qplan is not None:
+                # the island maps only the batch axis: keep the batch
+                # component of each feed's placement, replicate the rest
+                self.qplan.feed_island_specs = {
+                    n: tuple(a if a == axis else None for a in spec)
+                    for n, spec in self._feed_specs.items()}
+
+        cons_specs = policy.activation_constraints(program, mesh)
+        cons = {n: (lambda v, s=s: gspecs.constrain(v, mesh, s))
+                for n, s in cons_specs.items()}
+        self.constraint_names = sorted(cons_specs)
+
+        def trace_stage(env, step, ops, mesh_axes=()):
+            """The ONE LowerContext assembly point for both stages —
+            constraints apply only in global view (inside the quant
+            island the batch axis is mapped, not partitioned)."""
+            ctx = registry.LowerContext(
+                step=step,
+                is_test=getattr(program, "_is_test", False),
+                block=plan.block, mesh_axes=mesh_axes)
+            ctx.program = program
+            ctx.dtype_policy = getattr(program, "_dtype_policy", None)
+            ctx.place = None
+            if not mesh_axes and cons:
+                ctx.sharding_constraints = cons
+            trace_block(plan.block, env, ctx, ops=ops)
+            return env
+
+        if self.qplan is None:
+            ops_all = plan.ops
+            fetch_names_jit = plan.jit_fetch_names
+            write_names = plan.write_names
+
+            def body(donated, readonly, feeds, step):
+                env = {}
+                env.update(donated)
+                env.update(readonly)
+                env.update(feeds)
+                trace_stage(env, step, ops_all)
+                fetches = [env[n] for n in fetch_names_jit]
+                out_writes = {n: env[n] for n in write_names if n in env}
+                return fetches, out_writes
+
+            self._island_fetches = []
+        else:
+            qp = self.qplan
+            island = qp.island_body(
+                lambda env, step, ops, mesh_axes=(): trace_stage(
+                    env, step, ops, mesh_axes))
+            fetch_names_jit = plan.jit_fetch_names
+            write_names = plan.write_names
+            island_fetch_pos = {n: i
+                                for i, n in enumerate(qp.island_fetches)}
+            self._island_fetches = list(qp.island_fetches)
+
+            def body(donated, readonly, feeds, step):
+                scope_vals = {}
+                scope_vals.update(donated)
+                scope_vals.update(readonly)
+                island_in = {n: scope_vals[n]
+                             for n in qp.scope_reads_island}
+                carry, grads, stacked = island(island_in, dict(feeds),
+                                               step)
+                env = dict(scope_vals)
+                env.update(carry)
+                env.update(grads)
+                trace_stage(env, step, qp.ops_opt)
+                fetches = [stacked[island_fetch_pos[n]]
+                           if n in island_fetch_pos else env[n]
+                           for n in fetch_names_jit]
+                out_writes = {n: env[n] for n in write_names if n in env}
+                return fetches, out_writes
+
+        # read AFTER island_body construction: a demoted
+        # custom_partitioning reducer zeroes the plan's modeled bytes
+        self.wire_bytes_per_step = (self.qplan.wire_bytes_per_step
+                                    if self.qplan else 0)
+
+        def mesh_body(*args):
+            # mesh-adaptive lowerings (ring attention) read current_mesh()
+            with pmesh.mesh_guard(mesh):
+                return body(*args)
+
+        def shard_of(name, v):
+            shape = tuple(np.shape(v)) if v is not None else None
+            return gspecs.named_sharding(
+                mesh, policy.param_spec(program, name, shape, mesh))
+
+        don_sh = {n: shard_of(n, scope.get(n)) for n in self.donated_names}
+        ro_sh = {n: shard_of(n, scope.get(n)) for n in self.readonly_names}
+
+        feeds_sh = {n: gspecs.named_sharding(mesh, self._feed_specs[n])
+                    for n in self.feed_names}
+        repl = gspecs.named_sharding(mesh, ())
+        stacked_sh = gspecs.named_sharding(mesh, (axis,)) \
+            if axis in mesh.axis_names else repl
+        fetch_sh = [stacked_sh if n in self._island_fetches else repl
+                    for n in plan.jit_fetch_names]
+        out_sh = (fetch_sh,
+                  {n: don_sh.get(n, repl) for n in self.write_names})
+        self._in_shardings = (don_sh, ro_sh, feeds_sh, repl)
+        self._jitted = jax.jit(mesh_body,
+                               in_shardings=self._in_shardings,
+                               out_shardings=out_sh,
+                               donate_argnums=(0,))
+        self._don_sh, self._ro_sh, self._feeds_sh = don_sh, ro_sh, feeds_sh
+        self.capture_hlo = executor.capture_hlo
+
+    def _capture_hlo(self, args):
+        """AOT-lower the same computation and record its OPTIMIZED
+        (post-partitioner) HLO: feeds .last_hlo, the resharding gauge and
+        the acceptance gates.  The XLA compile dedupes against the
+        dispatch compile through jax's compilation cache, so this costs
+        one extra trace, not one extra compile.  A failure latches
+        (_hlo_capture_failed) — retrying the whole-program retrace every
+        step would tax pt_step_seconds and re-warn forever."""
+        try:
+            self.last_hlo = self._jitted.lower(*args).compile().as_text()
+        except Exception as e:  # backend without as_text
+            self._hlo_capture_failed = True
+            warnings.warn(f"gspmd HLO capture failed: {e}")
+            return
+        _m_resharding().labels(signature=self.label).set(
+            float(hlo_collective_bytes(self.last_hlo)))
+
+    def run(self, scope, feeds, step):
+        from paddle_tpu.fluid import profiler as _prof
+
+        with _prof.timed_run(self.label, self._prof_state) as timer:
+            donated = {n: scope.get(n) for n in self.donated_names}
+            readonly = {n: scope.get(n) for n in self.readonly_names}
+            args = (donated, readonly, dict(feeds), np.uint32(step))
+            if (self.capture_hlo and self.last_hlo is None
+                    and not getattr(self, "_hlo_capture_failed", False)):
+                self._capture_hlo(self._jit_args(scope, feeds, step))
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # donation unsupported on CPU
+                fetches, out_writes = self._jitted(*args)
+            for n, v in out_writes.items():
+                scope.set(n, v)
+            timer.done(fetches, out_writes)
+        self.plan.run_host_ops(scope)
+        return self.plan.assemble_fetches(fetches, scope)
+
+
+# ---------------------------------------------------------------------------
+# the executor
+# ---------------------------------------------------------------------------
+
+
+class GSPMDExecutor:
+    """Compile + run a Program GSPMD-partitioned under one policy.
+
+    The runners' shared core: `DataParallelRunner(gspmd=True)` selects a
+    `DataParallelPolicy`, `HybridParallelRunner(gspmd=True)` a
+    `TensorParallelPolicy` — both delegate run/cost_analysis here, so
+    there is exactly one partitioned compile path (ROADMAP "GSPMD-native
+    sharding core").
+
+    quant_hook (None = FLAGS_quant_allreduce): keep gradient reduction
+    on the dual-int8 adaptive ring inside the partitioned graph
+    (quant_hook.py) — wire bytes book on the same
+    ``pt_collective_payload_bytes_total{collective="c_allreduce_quant"}``
+    family the transpiler lane uses.
+    """
+
+    def __init__(self, program, mesh, policy=None, scope=None,
+                 feed_specs=None, quant_hook=None, quant_block_size=None,
+                 quant_algo=None, quant_crossover_kb=None,
+                 quant_impl=None, capture_hlo=True):
+        from paddle_tpu.fluid import flags as _flags
+
+        self.program = program
+        self.mesh = mesh
+        self.policy = policy or gspecs.DataParallelPolicy()
+        self.feed_specs = dict(feed_specs or {})
+        self._default_scope = scope
+        if quant_hook is None:
+            quant_hook = _flags.flag("quant_allreduce")
+        self.quant_hook = bool(quant_hook)
+        self.quant_block_size = quant_block_size
+        self.quant_algo = quant_algo
+        self.quant_crossover_kb = quant_crossover_kb
+        self.quant_impl = quant_impl
+        self.capture_hlo = bool(capture_hlo)
+        self._cache = {}
+        self._ran_keys = set()
+        self._step = 0
+
+    # -- introspection -------------------------------------------------
+    def describe_policy(self, scope=None):
+        """The resolved ParamSpec table (specs.ShardingPolicy.describe)
+        against the bound scope — what docs/DISTRIBUTED.md's policy table
+        renders."""
+        scope = self._resolve_scope(scope)
+        return self.policy.describe(self.program, scope, self.mesh)
+
+    def compiled_blocks(self):
+        return list(self._cache.values())
+
+    @property
+    def last_hlo(self):
+        for cb in self._cache.values():
+            if cb.last_hlo:
+                return cb.last_hlo
+        return None
+
+    # -- dispatch ------------------------------------------------------
+    def _resolve_scope(self, scope):
+        if scope is not None:
+            return scope
+        if self._default_scope is not None:
+            return self._default_scope
+        from paddle_tpu.fluid.executor import global_scope
+
+        return global_scope()
+
+    _prep = staticmethod(prep_feed)
+
+    def run(self, scope=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        import time as _time
+
+        from paddle_tpu.fluid.executor import (_feed_batch, _m_cache,
+                                               _m_compile_seconds,
+                                               _record_step,
+                                               _report_examples)
+
+        scope = self._resolve_scope(scope)
+        feed, fetch_names, feed_sig = self._prep(feed, fetch_list)
+        key = (self.program._version, feed_sig, tuple(fetch_names))
+        cb = self._cache.get(key)
+        if cb is None:
+            _m_cache().labels(path="gspmd", result="miss").inc()
+            t0 = _time.perf_counter()
+            cb = _GSPMDBlock(self, scope, list(feed.keys()), fetch_names,
+                             feed_shapes={k: tuple(np.shape(v))
+                                          for k, v in feed.items()})
+            self._cache[key] = cb
+            _m_compile_seconds().labels(
+                path="gspmd", phase="trace").inc(_time.perf_counter() - t0)
+        else:
+            _m_cache().labels(path="gspmd", result="hit").inc()
+        first_run = key not in self._ran_keys
+        t0 = _time.perf_counter()
+        fetches = cb.run(scope, feed, self._step)
+        step_s = _time.perf_counter() - t0
+        _record_step("gspmd", step_s, first_run)
+        self._ran_keys.add(key)
+        if cb.wire_bytes_per_step:
+            from ..data_parallel import collective_payload_counter
+
+            collective_payload_counter().labels(
+                collective="c_allreduce_quant").inc(
+                cb.wire_bytes_per_step)
+        _report_examples("gspmd", _feed_batch(feed), step_s)
+        self._step += 1
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+    def cost_analysis(self, feed, fetch_list=None, scope=None):
+        """XLA cost/memory analysis of an already-run signature — the
+        shared _JitExecutable surface (pt_xla_* gauges included)."""
+        scope = self._resolve_scope(scope)
+        feed, fetch_names, feed_sig = self._prep(feed, fetch_list)
+        cb = self._cache.get((self.program._version, feed_sig,
+                              tuple(fetch_names)))
+        if cb is None:
+            raise ValueError(
+                "no compiled GSPMD executable for this (feed, fetch_list) "
+                "signature — run the step once first")
+        return cb.cost_analysis(scope, feed)

@@ -1,0 +1,294 @@
+"""Sharding policies: program variables → PartitionSpecs over the named mesh.
+
+The transpiler lane (`parallel/data_parallel.py`) expresses parallelism
+as a graph rewrite — clone the loss seed, insert one collective op per
+gradient.  This module is the GSPMD-native inverse: a *policy* maps every
+program variable (parameters, optimizer state, feeds, selected
+activations) to a `jax.sharding.PartitionSpec` over the named mesh
+(`parallel/mesh.py`), and the partitioned executor
+(`parallel/gspmd/executor.py`) hands those specs to `jax.jit` as
+in/out shardings plus `with_sharding_constraint` annotations.  XLA's
+SPMD partitioner then inserts every collective itself — the reference's
+multi_devices_graph_pass, fuse_all_reduce and coalesce passes all
+disappear into the sharding spec (SNIPPETS.md [1]–[3] pattern).
+
+Policies are deliberately thin, so the runners stay thin too:
+
+  ``DataParallelPolicy``   params/state replicated, feeds batch-sharded —
+                           gradient averaging falls out of the global-view
+                           mean over the sharded batch.
+  ``Zero1Policy``          + optimizer-state vars dp-sharded on dim 0
+                           (cross-replica weight-update sharding,
+                           arXiv:2004.13336): XLA keeps each replica's
+                           shard resident and all-gathers the updated
+                           parameters because the spec says so — nothing
+                           is hand-rolled.
+  ``TensorParallelPolicy`` + 2-D (batch, model) layout: parameter specs
+                           come from a `ShardingRule` (Megatron
+                           column/row split on the model axis by
+                           default) and matmul activations get
+                           with_sharding_constraint annotations derived
+                           from the weight layout.
+
+Axis names accept both the canonical short forms (``dp``/``mp``) and the
+paper spellings (``batch``/``model``) via `mesh.canonical_axis`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .. import mesh as pmesh
+
+__all__ = [
+    "ParamSpec",
+    "ShardingPolicy",
+    "DataParallelPolicy",
+    "Zero1Policy",
+    "TensorParallelPolicy",
+    "policy_for",
+    "named_sharding",
+    "constrain",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamSpec:
+    """One variable's resolved placement: the PartitionSpec axes (tuple of
+    mesh-axis names / None per tensor dim) plus the role the policy
+    assigned it — the policy table docs/DISTRIBUTED.md renders."""
+
+    name: str
+    spec: tuple
+    role: str  # "param" | "opt_state" | "feed" | "activation" | "misc"
+
+
+def _canon_spec(spec):
+    return tuple(pmesh.canonical_axis(a) for a in (spec or ()))
+
+
+def _fits(spec, shape, mesh):
+    """Drop axes the mesh lacks and axes that do not evenly divide the
+    dim (the ShardingRule.spec_for gates, shared here so every policy
+    protects scalar accumulators the same way)."""
+    spec = _canon_spec(spec)
+    if shape is not None:
+        spec = spec[: len(shape)] + (None,) * max(0, len(shape) - len(spec))
+    out = []
+    for d, a in enumerate(spec):
+        if a is None or mesh is None or a not in mesh.axis_names:
+            out.append(None)
+            continue
+        if shape is not None and (shape[d] is None or shape[d] < 0
+                                  or shape[d] % mesh.shape[a] != 0):
+            out.append(None)
+            continue
+        out.append(a)
+    return tuple(out)
+
+
+def named_sharding(mesh, spec):
+    """`NamedSharding(mesh, PartitionSpec(*spec))` with axis aliases
+    resolved — the ONE place the gspmd layer mints shardings (the
+    collectives lint sanctions exactly this module)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(*_canon_spec(spec)))
+
+
+def constrain(value, mesh, spec):
+    """`with_sharding_constraint` through the sanctioned surface: pins
+    ``value``'s layout inside a jit-partitioned computation so GSPMD
+    propagates from an annotation instead of guessing.  Identity outside
+    a trace-compatible context (1-device mesh still fine)."""
+    import jax
+
+    return jax.lax.with_sharding_constraint(value,
+                                            named_sharding(mesh, spec))
+
+
+class ShardingPolicy:
+    """Base policy: everything replicated except feeds (batch-sharded on
+    dim 0).  Subclasses override `param_spec` / `state_spec` /
+    `activation_constraints`; the executor only ever calls the public
+    trio plus `describe()`."""
+
+    name = "replicated"
+
+    def __init__(self, batch_axis=pmesh.DATA_AXIS):
+        self.batch_axis = pmesh.canonical_axis(batch_axis)
+
+    # -- variable classification -------------------------------------
+    def param_spec(self, program, name, shape, mesh):
+        """Spec for a scope-resident variable (parameter, optimizer
+        state, BN stat, lr).  Default: replicated."""
+        return ()
+
+    def feed_spec(self, program, name, shape, mesh):
+        """Spec for a fed batch: dim 0 over the batch axis when present
+        and divisible."""
+        if self.batch_axis not in mesh.axis_names:
+            return ()
+        return _fits((self.batch_axis,), shape, mesh)
+
+    def activation_constraints(self, program, mesh):
+        """{var name: spec} with_sharding_constraint annotations applied
+        at the producing op during the trace.  Default: none — GSPMD
+        propagation decides."""
+        return {}
+
+    # -- introspection -----------------------------------------------
+    def uses_model_axis(self, program, mesh):
+        """True when any parameter spec touches a non-batch mesh axis —
+        the quant hook demotes itself on such policies (its island maps
+        only the batch axis, see quant_hook.py)."""
+        return False
+
+    def describe(self, program, scope, mesh):
+        """Resolved ParamSpec table for every scope-read variable — the
+        policy surface docs/DISTRIBUTED.md documents and tests assert."""
+        out = []
+        block = program.global_block()
+        for name in sorted(scope.keys()):
+            v = block._find_var_recursive(name)
+            if v is None:
+                continue
+            val = scope.get(name)
+            shape = tuple(np.shape(val)) if val is not None else None
+            role = ("opt_state" if getattr(v, "is_optimizer_state", False)
+                    else "param")
+            out.append(ParamSpec(name,
+                                 self.param_spec(program, name, shape,
+                                                 mesh), role))
+        return out
+
+
+class DataParallelPolicy(ShardingPolicy):
+    """Pure DP: parameters and optimizer state replicated, feeds sharded
+    over the batch axis.  The loss mean over the globally-sharded batch
+    makes XLA insert the gradient all-reduce — no seed scaling, no
+    c_allreduce ops (the global-view property parallel/hybrid.py
+    documents)."""
+
+    name = "dp"
+
+
+class Zero1Policy(DataParallelPolicy):
+    """DP + ZeRO stage 1: optimizer-state vars (tagged
+    ``is_optimizer_state`` by Optimizer._add_accumulator) shard dim 0
+    over the batch axis when divisible.  The weight-update all-gather and
+    the moment-shard residency both FALL OUT of this spec — XLA sees
+    sharded moments feeding a replicated ParamOut and partitions the
+    optimizer ops accordingly (arXiv:2004.13336 §4 as a sharding
+    annotation)."""
+
+    name = "zero1"
+
+    def param_spec(self, program, name, shape, mesh):
+        if self.batch_axis not in mesh.axis_names:
+            return ()
+        if mesh.shape[self.batch_axis] <= 1 or not shape:
+            return ()
+        v = program.global_block()._find_var_recursive(name)
+        if v is None or not getattr(v, "is_optimizer_state", False):
+            return ()
+        return _fits((self.batch_axis,), shape, mesh)
+
+
+class TensorParallelPolicy(ShardingPolicy):
+    """2-D (batch, model) layout: parameter placement delegated to a
+    `ShardingRule` (megatron_rules() when None — QKV/FFN-in columns on
+    the model axis, FFN-out/attention-out rows, embeddings vocab-split),
+    optionally composed with the ZeRO-1 state sharding for parameters
+    the rules leave replicated.  Matmul activations whose weight is
+    column-split get a with_sharding_constraint pinning their last dim to
+    the model axis, so GSPMD's propagation is anchored where it matters
+    instead of inferred."""
+
+    name = "tp2d"
+
+    def __init__(self, rules=None, zero_stage=0,
+                 batch_axis=pmesh.DATA_AXIS, model_axis=pmesh.MODEL_AXIS):
+        super().__init__(batch_axis=batch_axis)
+        if rules is None:
+            from ..hybrid import megatron_rules
+
+            rules = megatron_rules()
+        self.rules = rules
+        self.model_axis = pmesh.canonical_axis(model_axis)
+        self.zero_stage = int(zero_stage)
+        self._zero = Zero1Policy(batch_axis=batch_axis)
+
+    def param_spec(self, program, name, shape, mesh):
+        spec = self.rules.spec_for(name, shape=shape, mesh=mesh)
+        spec = _fits(spec, shape, mesh)
+        if any(spec):
+            return spec
+        if self.zero_stage >= 1:
+            return self._zero.param_spec(program, name, shape, mesh)
+        return ()
+
+    def uses_model_axis(self, program, mesh):
+        block = program.global_block()
+        for name, v in block.vars.items():
+            shape = tuple(v.shape) if v.shape else None
+            if any(a and a != self.batch_axis
+                   for a in self.param_spec(program, name, shape, mesh)):
+                return True
+        return False
+
+    # ops whose Y operand is a weight the rules split — their output
+    # inherits the split (column-parallel) or completes a row-parallel
+    # contraction (output replicated after XLA's implicit reduce)
+    _MATMUL_OPS = ("mul", "matmul", "matmul_v2")
+
+    def activation_constraints(self, program, mesh):
+        if self.model_axis not in mesh.axis_names \
+                or mesh.shape[self.model_axis] <= 1:
+            return {}
+        block = program.global_block()
+        out = {}
+        for op in block.ops:
+            if op.type not in self._MATMUL_OPS:
+                continue
+            w = (op.inputs.get("Y") or [None])[0]
+            outs = op.outputs.get("Out") or []
+            if w is None or not outs:
+                continue
+            v = block._find_var_recursive(w)
+            shape = tuple(v.shape) if (v is not None and v.shape) else None
+            spec = self.param_spec(program, w, shape, mesh)
+            if len(spec) < 2:
+                continue
+            ov = block._find_var_recursive(outs[0])
+            orank = len(ov.shape) if (ov is not None and ov.shape) else 2
+            if spec[-1] == self.model_axis:
+                # column-parallel: activation's feature dim is split
+                out[outs[0]] = ((None,) * (orank - 1)
+                                + (self.model_axis,))
+            elif spec[0] == self.model_axis:
+                # row-parallel: the contraction reduces over the split
+                # dim — the output is full-size once XLA psums it
+                out[outs[0]] = (None,) * orank
+        return out
+
+
+def policy_for(mesh, rules=None, zero_stage=0, batch_axis=None):
+    """The runners' thin policy selection: a >1 non-batch mesh axis or a
+    non-empty `ShardingRule` → TensorParallelPolicy; else zero_stage >= 1
+    → Zero1Policy; else pure DP.  One decision point so the DP and
+    hybrid runners cannot drift (both call this).  An EMPTY rule set on
+    a batch-only mesh deliberately does NOT select the TP policy — its
+    per-var regex scan would run for nothing."""
+    batch_axis = pmesh.canonical_axis(batch_axis or pmesh.DATA_AXIS)
+    has_model_axis = any(a != batch_axis and mesh.shape[a] > 1
+                         for a in mesh.axis_names)
+    has_rules = rules is not None and bool(getattr(rules, "_rules", True))
+    if has_model_axis or has_rules:
+        return TensorParallelPolicy(rules=rules, zero_stage=zero_stage,
+                                    batch_axis=batch_axis)
+    if zero_stage >= 1:
+        return Zero1Policy(batch_axis=batch_axis)
+    return DataParallelPolicy(batch_axis=batch_axis)

@@ -43,11 +43,14 @@ class ShardingRule:
 
     rules: list of (pattern, spec) where spec is a tuple of mesh-axis names /
     None per tensor dim, e.g. (None, 'mp') to split columns over the model
-    axis.  First match wins; no match → replicated.
+    axis.  First match wins; no match → replicated.  Axis names accept the
+    paper spellings too ('batch'/'model' → 'dp'/'mp', mesh.canonical_axis).
     """
 
     def __init__(self, rules):
-        self._rules = [(re.compile(p), tuple(s)) for p, s in rules]
+        self._rules = [(re.compile(p),
+                        tuple(pmesh.canonical_axis(a) for a in s))
+                       for p, s in rules]
 
     def spec_for(self, name, shape=None, mesh=None):
         for pat, spec in self._rules:
@@ -141,7 +144,7 @@ class HybridParallelRunner:
 
     def __init__(self, program, mesh, rules: ShardingRule | None = None,
                  feed_specs=None, scope=None, zero_stage=0,
-                 zero_gather_quant=None, fused_update=None):
+                 zero_gather_quant=None, fused_update=None, gspmd=None):
         """zero_stage=1: shard optimizer-state vars (moment accumulators,
         tagged is_optimizer_state) over the 'dp' axis on dim 0 — the
         cross-replica weight-update sharding of arXiv:2004.13336 (ZeRO-1).
@@ -170,7 +173,18 @@ class HybridParallelRunner:
         between update and requant never round-trips HBM — saved bytes
         book on ``pt_fused_update_bytes_saved_total``.  ``ParamOut``
         stays the exact fp32 update, so the same program run outside this
-        runner is bit-identical to the unfused ops."""
+        runner is bit-identical to the unfused ops.
+
+        gspmd (None = FLAGS_gspmd_executor): route compilation through
+        the shared `parallel.gspmd.GSPMDExecutor` with a
+        `TensorParallelPolicy` wrapping these rules (+ ZeRO-1 state
+        sharding when zero_stage >= 1) — this runner becomes a thin
+        policy selection over the one partitioned executor, sharing its
+        compile cache/metrics/HLO-inspection plumbing with the DP lane.
+        The fused-update / zero_gather_quant op rewrites stay on the
+        classic path (their gather already rides the quantized wire
+        format); the gspmd lane's quantized gradient hook engages via
+        FLAGS_quant_allreduce instead."""
         self.program = program
         self.mesh = mesh
         self.rules = rules or ShardingRule([])
@@ -190,6 +204,29 @@ class HybridParallelRunner:
 
             fused_update = _flags.flag("fused_update")
         self.fused_update = bool(fused_update)
+        if gspmd is None:
+            from paddle_tpu.fluid import flags as _flags
+
+            gspmd = _flags.flag("gspmd_executor")
+        self.gspmd = bool(gspmd)
+        self._gspmd_exec = None
+        if self.gspmd:
+            # thin policy selection over the shared partitioned executor
+            # (policy_for — the one rule the DP lane shares); the
+            # program stays unrewritten (no fused-gather op rewrite — the
+            # hook owns the wire format on this lane)
+            from .gspmd import GSPMDExecutor, policy_for
+
+            policy = policy_for(mesh, rules=rules,
+                                zero_stage=self.zero_stage)
+            self._gspmd_exec = GSPMDExecutor(
+                program, mesh, policy, scope=scope,
+                feed_specs=self.feed_specs)
+            self._fused_gather = {}
+            # capture_hlo/last_hlo stay live on this lane through the
+            # properties below (delegated to the executor), so the
+            # classic dryrun/driver contract keeps working
+            return
         # {param: {"shape", "padded", "qhi", "qlo", "qsc"}} for optimizer
         # ops rewritten to the fused update→requant→gather form
         self._fused_gather = (self._rewrite_fused_updates()
@@ -201,6 +238,33 @@ class HybridParallelRunner:
         # Costs one extra AOT compile of the same tiny computation.
         self.capture_hlo = False
         self.last_hlo = None
+
+    # capture_hlo/last_hlo: plain attributes on the classic lane, live
+    # delegation to the shared executor on the gspmd lane — the
+    # documented dryrun/driver contract (set capture_hlo, run once, read
+    # last_hlo) works identically on both
+    @property
+    def capture_hlo(self):
+        if getattr(self, "_gspmd_exec", None) is not None:
+            return self._gspmd_exec.capture_hlo
+        return getattr(self, "_capture_hlo_flag", False)
+
+    @capture_hlo.setter
+    def capture_hlo(self, value):
+        if getattr(self, "_gspmd_exec", None) is not None:
+            self._gspmd_exec.capture_hlo = bool(value)
+        else:
+            self._capture_hlo_flag = bool(value)
+
+    @property
+    def last_hlo(self):
+        if getattr(self, "_gspmd_exec", None) is not None:
+            return self._gspmd_exec.last_hlo
+        return getattr(self, "_last_hlo", None)
+
+    @last_hlo.setter
+    def last_hlo(self, value):
+        self._last_hlo = value
 
     def rebuild(self, mesh):
         """Re-specialize the runner onto a new mesh — the elastic-rejoin
@@ -216,6 +280,17 @@ class HybridParallelRunner:
         self._cache.clear()
         self._ran_keys.clear()
         self.last_hlo = None
+        if self._gspmd_exec is not None:
+            # re-specialize the shared executor onto the new mesh: the
+            # policy is mesh-independent, the compiled blocks are not
+            from .gspmd import GSPMDExecutor
+
+            old = self._gspmd_exec
+            self._gspmd_exec = GSPMDExecutor(
+                self.program, mesh, old.policy,
+                scope=self._default_scope, feed_specs=self.feed_specs,
+                quant_hook=old.quant_hook, quant_algo=old.quant_algo,
+                capture_hlo=old.capture_hlo)
         if self._fused_gather:
             self._restamp_fused_updates()
         from paddle_tpu.observability import events
@@ -286,7 +361,8 @@ class HybridParallelRunner:
         return out
 
     _FUSED_GATHER_OPS = {"sgd": "fused_sgd_quant_gather",
-                         "adam": "fused_adam_quant_gather"}
+                         "adam": "fused_adam_quant_gather",
+                         "momentum": "fused_momentum_quant_gather"}
 
     def _fused_gather_eligible(self, name):
         """ZeRO-gather eligibility from program metadata (the same gates
@@ -534,16 +610,12 @@ class HybridParallelRunner:
 
     @staticmethod
     def _prep(feed, fetch_list):
-        """Coerce feed values and build the (feed_sig, fetch_names) cache
-        identity.  v.dtype directly — np.asarray on a device-resident jax
-        array would force a host transfer just to read the dtype."""
-        feed = {k: np.asarray(v) if not hasattr(v, "dtype") else v
-                for k, v in (feed or {}).items()}
-        fetch_names = [f if isinstance(f, str) else f.name
-                       for f in (fetch_list or [])]
-        feed_sig = tuple((k, tuple(np.shape(v)), str(v.dtype))
-                         for k, v in sorted(feed.items()))
-        return feed, fetch_names, feed_sig
+        """The shared dispatch-key helper (gspmd.executor.prep_feed) —
+        one implementation so the two partitioned lanes' cache-key
+        semantics cannot drift."""
+        from .gspmd.executor import prep_feed
+
+        return prep_feed(feed, fetch_list)
 
     def _dispatch(self, key, scope, feed, fetch_names, n_steps,
                   stacked_feed, return_numpy):
@@ -591,6 +663,10 @@ class HybridParallelRunner:
         return fetches
 
     def run(self, scope=None, feed=None, fetch_list=None, return_numpy=True):
+        if self._gspmd_exec is not None:
+            return self._gspmd_exec.run(scope=scope, feed=feed,
+                                        fetch_list=fetch_list,
+                                        return_numpy=return_numpy)
         scope = self._resolve_scope(scope)
         feed, fetch_names, feed_sig = self._prep(feed, fetch_list)
         key = (self.program._version, feed_sig, tuple(fetch_names))
@@ -606,6 +682,20 @@ class HybridParallelRunner:
         stacked_feed=True: feed arrays carry a leading [n_steps] axis
         (replicated across the mesh), one slice per iteration.  Only the
         final step's fetches return."""
+        if self._gspmd_exec is not None:
+            if stacked_feed:
+                raise NotImplementedError(
+                    "stacked_feed run_steps is not yet supported on the "
+                    "gspmd lane — use gspmd=False or per-step run()")
+            if int(n_steps) < 1:
+                raise ValueError(
+                    f"n_steps must be >= 1, got {n_steps!r}")
+            out = None
+            for _ in range(int(n_steps)):
+                out = self._gspmd_exec.run(scope=scope, feed=feed,
+                                           fetch_list=fetch_list,
+                                           return_numpy=return_numpy)
+            return out
         scope = self._resolve_scope(scope)
         n = int(n_steps)
         if n < 1:

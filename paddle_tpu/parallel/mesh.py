@@ -23,6 +23,28 @@ PIPE_AXIS = "pp"
 SEQ_AXIS = "sp"
 EXPERT_AXIS = "ep"
 
+# Paper-idiom spellings (the named 2-D ("batch", "model") mesh of the
+# GSPMD literature) map onto the canonical short axis names above, so a
+# PartitionSpec written either way addresses the same mesh axis.  The
+# gspmd policy layer resolves through canonical_axis(); raw Mesh axis
+# names stay the short forms everywhere (ring registry, ShardingRule).
+AXIS_ALIASES = {
+    "batch": DATA_AXIS,
+    "data": DATA_AXIS,
+    "model": MODEL_AXIS,
+    "pipe": PIPE_AXIS,
+    "seq": SEQ_AXIS,
+    "expert": EXPERT_AXIS,
+}
+
+
+def canonical_axis(name):
+    """Resolve an axis spelling ("batch"/"model"/...) to the canonical
+    mesh axis name ("dp"/"mp"/...); canonical names pass through."""
+    if name is None:
+        return None
+    return AXIS_ALIASES.get(str(name), str(name))
+
 # ring_id → mesh axis name.  Ring 0 is the global/world ring in the reference
 # (c_allreduce_op.h:73); by default it is the data-parallel axis.
 _ring_axes: dict[int, str] = {0: DATA_AXIS}
@@ -70,6 +92,31 @@ def build_mesh(shape: dict[str, int] | None = None, devices=None):
         raise ValueError(f"mesh needs {n} devices, only {len(devices)} available")
     arr = np.asarray(devices[:n]).reshape(sizes)
     return Mesh(arr, names)
+
+
+def build_2d_mesh(batch=None, model=1, devices=None):
+    """The named 2-D (batch, model) mesh of the GSPMD idiom: data
+    parallelism on the leading axis, tensor parallelism innermost (the
+    latency-sensitive collectives ride the nearest ICI links).  Axis
+    names are the canonical short forms (``dp``, ``mp``); ``batch`` None
+    uses every device not consumed by ``model``."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    model = int(model)
+    if batch is None:
+        if len(devices) % model != 0:
+            raise ValueError(
+                f"model={model} does not divide the {len(devices)} "
+                "available devices — pass batch= explicitly to use a "
+                "subset (silently stranding devices would train at "
+                "reduced capacity with no signal)")
+        batch = len(devices) // model
+    shape = {DATA_AXIS: int(batch)}
+    if model > 1:
+        shape[MODEL_AXIS] = model
+    return build_mesh(shape, devices=devices)
 
 
 def device_count():

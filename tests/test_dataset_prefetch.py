@@ -4,6 +4,7 @@ Reference analog: buffered_reader.cc double-buffering + InMemoryDataFeed
 channels — host parse time must hide behind device steps.
 """
 
+import threading
 import time
 
 import numpy as np
@@ -239,3 +240,82 @@ def test_train_from_dataset_compiled_program(tmp_path):
         exe.train_from_dataset(program=cp, dataset=ds)
         stats = exe.last_dataset_stats
     assert stats["steps"] == 4 and stats["prefetch_depth"] == 2
+
+
+# ---------------------------------------------------------------------------
+# round-partitioned elastic feed (ISSUE 9 satellite: the acceptance
+# runner's (index, count) even-slice re-sharding as a library feature)
+# ---------------------------------------------------------------------------
+
+
+def test_partition_batch_even_slices_cover_global_batch():
+    from paddle_tpu.fluid.prefetch import partition_batch
+
+    batch = {"x": np.arange(24, dtype="float32").reshape(12, 2),
+             "y": np.arange(12, dtype="int64").reshape(12, 1)}
+    slices = [partition_batch(batch, i, 3) for i in range(3)]
+    # equal 4-row slices that reassemble the global batch exactly —
+    # the property that makes the merged gradient the full-batch mean
+    # at every membership size
+    np.testing.assert_array_equal(
+        np.concatenate([s["x"] for s in slices]), batch["x"])
+    assert all(s["x"].shape == (4, 2) for s in slices)
+    # count=1 is the identity; scalars/sub-count entries replicate
+    assert partition_batch(batch, 0, 1) is batch
+    small = {"k": np.ones((2,), "float32"), "s": 3.0}
+    out = partition_batch(small, 1, 4)
+    assert out["s"] == 3.0 and out["k"].shape == (2,)
+    import pytest
+
+    with pytest.raises(ValueError, match="partition index"):
+        partition_batch(batch, 3, 3)
+
+
+def test_prefetcher_repartitions_on_epoch_flip():
+    """The partition callable is re-read per batch: an (index, count)
+    change mid-stream re-shards the NEXT batch (the elastic epoch-flip
+    contract) and books pt_prefetch_repartitions_total."""
+    from paddle_tpu.fluid.prefetch import DatasetPrefetcher
+
+    view = {"v": (0, 2)}
+    produced = threading.Event()
+
+    def batches():
+        for i in range(4):
+            yield {"x": np.full((8, 1), i, dtype="float32")}
+            produced.wait(5)
+            produced.clear()
+
+    pf = DatasetPrefetcher(batches(), depth=1,
+                           partition=lambda: view["v"])
+    it = iter(pf)
+    b0 = next(it)
+    assert b0["x"].shape == (4, 1)  # index 0 of 2: rows [0, 4)
+    view["v"] = (1, 4)  # membership regrew: epoch flip
+    produced.set()
+    b1 = next(it)
+    produced.set()
+    b2 = next(it)
+    # the flip applied on a subsequent batch (the producer may have
+    # sliced one batch ahead under the old view — round-boundary
+    # semantics allow that one-batch lag)
+    assert b2["x"].shape == (2, 1)  # index 1 of 4: rows [2, 4)
+    assert float(b2["x"][0, 0]) in (1.0, 2.0)
+    produced.set()
+    b3 = next(it)
+    assert b3["x"].shape == (2, 1)
+    assert pf.repartitions >= 1
+    pf.close()
+
+
+def test_prefetcher_pending_member_replays_full_batch():
+    """index < 0 (joiner not yet activated into the epoch): the feed
+    replays the FULL batch unsliced instead of crashing or slicing by a
+    stale view."""
+    from paddle_tpu.fluid.prefetch import DatasetPrefetcher
+
+    pf = DatasetPrefetcher(
+        iter([{"x": np.zeros((6, 2), "float32")}]), depth=1,
+        partition=lambda: (-1, 3))
+    (b,) = list(pf)
+    assert b["x"].shape == (6, 2)

@@ -358,3 +358,34 @@ def test_persistent_compile_cache_populates(tmp_path, monkeypatch):
             old_flag["FLAGS_compile_cache_dir"] == cache
         if not old_flag["FLAGS_compile_cache_dir"]:
             jax.config.update("jax_compilation_cache_dir", prior_jax_dir)
+
+
+def test_gspmd_flags_roundtrip(monkeypatch):
+    """The GSPMD execution-core flags (ISSUE 9): the executor lane is
+    off by default (the transpiler stays the benched baseline), the
+    quant-hook impl defaults to auto (custom_partitioning on TPU, the
+    shard_map island on the 0.4.3x CPU lane), and both round-trip
+    through env bootstrap and get/set like every other flag."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("gspmd_executor")["gspmd_executor"] is False
+    assert fl.get_flags("gspmd_quant_impl")["gspmd_quant_impl"] == "auto"
+    try:
+        fl.set_flags({"FLAGS_gspmd_executor": True,
+                      "gspmd_quant_impl": "shard_map"})
+        assert fl.get_flags(["gspmd_executor", "gspmd_quant_impl"]) == {
+            "gspmd_executor": True, "gspmd_quant_impl": "shard_map"}
+    finally:
+        fl.set_flags({"FLAGS_gspmd_executor": False,
+                      "FLAGS_gspmd_quant_impl": "auto"})
+    monkeypatch.setenv("FLAGS_gspmd_executor", "1")
+    monkeypatch.setenv("FLAGS_gspmd_quant_impl", "custom_partitioning")
+    importlib.reload(fl)
+    assert fl.get_flags("gspmd_executor")["gspmd_executor"] is True
+    assert fl.get_flags("gspmd_quant_impl")["gspmd_quant_impl"] == \
+        "custom_partitioning"
+    monkeypatch.delenv("FLAGS_gspmd_executor")
+    monkeypatch.delenv("FLAGS_gspmd_quant_impl")
+    importlib.reload(fl)  # restore defaults for other tests

@@ -102,6 +102,145 @@ def test_fused_sgd_matches_reference(monkeypatch):
     assert np.abs(np.asarray(got) - (p - 0.1 * g_deq)).max() <= 1e-6
 
 
+def test_fused_momentum_matches_reference(monkeypatch):
+    """The momentum extension (ISSUE 9 satellite): on a quantized
+    gradient the fused momentum step equals the reference _momentum math
+    on the dequantized gradient ≤ 1e-6, heavy-ball and Nesterov both;
+    the velocity output is exact."""
+    monkeypatch.setenv("PT_FUSED_UPDATE_IMPL", "xla")
+    p, g, v, _ = _mk(7)
+    gq = _quant_grad(g)
+    g_deq = np.asarray(qc.dequantize_block_scaled(gq[0], gq[1], gq[2],
+                                                  BS))[:NUMEL]
+    for nesterov in (False, True):
+        pn, vn = fu.fused_momentum_update(
+            jnp.asarray(p), gq, jnp.asarray(v), np.float32(0.1), mu=0.9,
+            use_nesterov=nesterov, block_size=BS)
+        v_ref = 0.9 * v + g_deq
+        p_ref = (p - (g_deq + 0.9 * v_ref) * 0.1 if nesterov
+                 else p - 0.1 * v_ref)
+        assert np.abs(np.asarray(pn) - p_ref).max() <= 1e-6, nesterov
+        assert np.abs(np.asarray(vn) - v_ref).max() <= 1e-6, nesterov
+
+
+def test_fused_momentum_pallas_interpret_matches_xla(monkeypatch):
+    """The Pallas momentum kind (interpret mode — the kernel Mosaic
+    compiles on TPU) matches the XLA fallback ≤ 1e-6 on param and
+    velocity, with and without the requant leg."""
+    p, g, v, _ = _mk(8)
+    gq = _quant_grad(g)
+    outs = {}
+    for impl in ("xla", "interpret"):
+        monkeypatch.setenv("PT_FUSED_UPDATE_IMPL", impl)
+        outs[impl] = fu.fused_momentum_update(
+            jnp.asarray(p), gq, jnp.asarray(v), np.float32(0.05), mu=0.9,
+            block_size=BS)
+    for a, b in zip(outs["xla"], outs["interpret"]):
+        assert np.abs(np.asarray(a, "float32")
+                      - np.asarray(b, "float32")).max() <= 1e-6
+    # requant leg: the payload images agree within one quantization LSB
+    for impl in ("xla", "interpret"):
+        monkeypatch.setenv("PT_FUSED_UPDATE_IMPL", impl)
+        outs[impl] = fu.fused_momentum_update(
+            jnp.asarray(p), gq, jnp.asarray(v), np.float32(0.05), mu=0.9,
+            block_size=BS, requant_pad=4 * BS)
+    assert len(outs["xla"]) == 5
+    deq = [np.asarray(qc.dequantize_block_scaled(o[2], o[3], o[4], BS))
+           for o in (outs["xla"], outs["interpret"])]
+    # documented dual-int8 wire bound: one LSB = block_max/64516 per
+    # element, doubled for the two independent requants
+    lsb = 2.0 * np.abs(deq[0]).max() / 64516.0
+    assert np.abs(deq[0] - deq[1]).max() <= max(lsb, 1e-6)
+
+
+def test_transpiler_rewrites_momentum_to_fused(monkeypatch):
+    """FLAGS_fused_update + quant bucketing absorbs momentum ops like
+    sgd/adam: the DP transpile emits fused_momentum_quant_grad with the
+    bucket's wire-format inputs, and a 20-step fused-vs-unfused momentum
+    run agrees ≤ 1e-6 (the mechanical-parity gate of the satellite)."""
+    from paddle_tpu import fluid
+
+    def build_and_losses(fused):
+        fluid.set_flags({"FLAGS_quant_allreduce_block_size": 16})
+        try:
+            rng = np.random.RandomState(5)
+            xs = rng.randn(16, 8).astype("float32")
+            ys = rng.randint(0, 3, (16, 1)).astype("int64")
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup), \
+                    fluid.unique_name.guard():
+                np.random.seed(5)
+                x = fluid.layers.data(name="x", shape=[8],
+                                      dtype="float32")
+                y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+                h = fluid.layers.fc(x, size=6, act="relu")
+                pred = fluid.layers.fc(h, size=3, act="softmax")
+                loss = fluid.layers.mean(
+                    fluid.layers.cross_entropy(pred, y))
+                fluid.optimizer.Momentum(0.1, 0.9).minimize(loss)
+            from paddle_tpu.parallel.data_parallel import (
+                transpile_data_parallel)
+
+            transpile_data_parallel(main, loss.name, 4, quant_grads=True,
+                                    fused_update=fused)
+            types = [op.type for op in main.global_block().ops]
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                from paddle_tpu.fluid.executor import BlockPlan
+                from paddle_tpu.fluid import registry
+                from paddle_tpu.fluid.executor import trace_block
+                import cpu_mesh  # noqa: F401
+                import jax
+                from jax.sharding import PartitionSpec as P
+                from paddle_tpu.parallel import mesh as pmesh
+
+                mesh = pmesh.build_mesh({"dp": 4},
+                                        devices=jax.devices()[:4])
+                plan = BlockPlan(main, main.global_block(), ["x", "y"],
+                                 [loss.name], scope)
+                body = plan.make_body(mesh_axes=("dp",))
+
+                def sm(donated, readonly, feeds, step):
+                    fetches, writes = body(donated, readonly, feeds,
+                                           step)
+                    fetches = [jnp.reshape(f, (1,)) for f in fetches]
+                    return fetches, writes
+
+                jitted = jax.jit(jax.shard_map(
+                    sm, mesh=mesh,
+                    in_specs=({n: P() for n in plan.donated_names},
+                              {n: P() for n in plan.readonly_names},
+                              {"x": P("dp"), "y": P("dp")}, P()),
+                    out_specs=([P("dp")],
+                               {n: P() for n in plan.write_names}),
+                    check_vma=False))
+                donated = {n: scope.get(n) for n in plan.donated_names}
+                readonly = {n: scope.get(n) for n in plan.readonly_names}
+                losses = []
+                for step in range(20):
+                    fetches, writes = jitted(
+                        donated, readonly, {"x": xs, "y": ys},
+                        np.uint32(step))
+                    donated = {n: writes.get(n, v)
+                               for n, v in donated.items()}
+                    losses.append(float(np.mean(np.asarray(fetches[0]))))
+            return types, losses
+        finally:
+            fluid.set_flags({"FLAGS_quant_allreduce_block_size": 256})
+
+    monkeypatch.setenv("PT_FUSED_UPDATE_IMPL", "xla")
+    t_fused, l_fused = build_and_losses(True)
+    t_plain, l_plain = build_and_losses(False)
+    assert "fused_momentum_quant_grad" in t_fused
+    assert "momentum" not in t_fused  # every momentum op was absorbed
+    assert "c_allreduce_quant_keep" in t_fused
+    assert "momentum" in t_plain
+    np.testing.assert_allclose(l_fused, l_plain, atol=1e-6, rtol=0)
+    assert l_fused[-1] < l_fused[0]
+
+
 def test_dequant_slice_block_aligned_member():
     """dequant_slice pulls one block-aligned member out of a bucket:
     equal to dequantizing the whole bucket and slicing."""
@@ -171,6 +310,45 @@ def test_pallas_interpret_matches_xla(monkeypatch):
     sx = fu.fused_sgd_update(jnp.asarray(p), gq, np.float32(0.1),
                              block_size=BS)
     assert np.abs(np.asarray(sp) - np.asarray(sx)).max() <= 1e-6
+
+
+def test_hybrid_rewrites_momentum_to_fused_gather():
+    """The hybrid ZeRO-1 rewrite absorbs momentum ops too: an eligible
+    Momentum program constructs with its optimizer ops rewritten to
+    fused_momentum_quant_gather (block_size/pad_multiple stamped,
+    ZGQ q-vars created) — the same construction-time contract the
+    sgd/adam rewrites carry.  Construction only: no GSPMD compile, so
+    this runs un-isolated."""
+    from paddle_tpu import fluid
+    from paddle_tpu.parallel import HybridParallelRunner, build_hybrid_mesh
+
+    fluid.set_flags({"FLAGS_quant_allreduce_block_size": 16})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.data("x", [-1, 8], False, dtype="float32")
+            y = fluid.data("y", [-1, 1], False, dtype="float32")
+            h = fluid.layers.fc(x, size=16, act="relu",
+                                param_attr=fluid.ParamAttr(name="m_w1"))
+            pred = fluid.layers.fc(h, size=1,
+                                   param_attr=fluid.ParamAttr(name="m_w2"))
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+        runner = HybridParallelRunner(
+            main, build_hybrid_mesh(4, mp=1), zero_stage=1,
+            zero_gather_quant=True, fused_update=True)
+        types = [op.type for op in main.global_block().ops]
+        assert "fused_momentum_quant_gather" in types
+        assert "m_w1" in runner._fused_gather
+        info = runner._fused_gather["m_w1"]
+        assert info["padded"] % (4 * 16) == 0  # dp * block alignment
+        op = next(o for o in main.global_block().ops
+                  if o.type == "fused_momentum_quant_gather")
+        assert op.attrs["pad_multiple"] == 4 * 16
+        assert {"QHi", "QLo", "QScale"} <= set(op.outputs)
+    finally:
+        fluid.set_flags({"FLAGS_quant_allreduce_block_size": 256})
 
 
 def test_pallas_chain_is_one_kernel(monkeypatch):

@@ -61,3 +61,47 @@ def test_non_collective_attrs_pass():
 def test_parse_error_is_a_finding():
     findings = lint_collectives.check_source("def broken(:\n", "x.py")
     assert findings and findings[0][2] == "parse-error"
+
+
+def test_flags_raw_sharding_constructs():
+    """ISSUE 9 satellite: NamedSharding / with_sharding_constraint /
+    custom_partitioning outside the sanctioned gspmd/kernels modules are
+    policy leaks — flagged with the raw-sharding check."""
+    src = (
+        "import jax\n"
+        "from jax.sharding import NamedSharding\n"
+        "def f(x, mesh, P):\n"
+        "    s = NamedSharding(mesh, P('dp'))\n"
+        "    y = jax.lax.with_sharding_constraint(x, s)\n"
+        "    return jax.custom_partitioning(lambda v: v)\n")
+    findings = lint_collectives.check_source(src, "bad.py")
+    checks = {(f[1], f[2]) for f in findings}
+    assert (2, "raw-sharding") in checks   # the import
+    assert (4, "raw-sharding") in checks   # NamedSharding(...)
+    assert (5, "raw-sharding") in checks   # with_sharding_constraint
+    assert (6, "raw-sharding") in checks   # custom_partitioning
+
+
+def test_sharding_allow_mark_and_exempt_modules():
+    src = ("from jax.sharding import NamedSharding  # collective: allow\n"
+           "s = NamedSharding(mesh, spec)  # collective: allow\n")
+    assert lint_collectives.check_source(src, "ok.py") == []
+    # the gspmd core and the classic hybrid minting site are sanctioned
+    assert lint_collectives.check_source(
+        "from jax.sharding import NamedSharding\n", "x.py",
+        sharding_exempt=True) == []
+    assert "paddle_tpu/parallel/gspmd/specs.py" in \
+        lint_collectives.EXEMPT_SHARDING
+    assert "paddle_tpu/parallel/hybrid.py" in \
+        lint_collectives.EXEMPT_SHARDING
+    # hybrid.py is sharding-exempt but NOT collective-exempt
+    assert not lint_collectives._exempt("paddle_tpu/parallel/hybrid.py")
+
+
+def test_raw_collective_check_unchanged_by_sharding_exempt():
+    """sharding_exempt only silences the sharding check — a raw psum in
+    a sharding-sanctioned file still flags."""
+    src = "import jax\ny = jax.lax.psum(x, 'dp')\n"
+    findings = lint_collectives.check_source(src, "h.py",
+                                             sharding_exempt=True)
+    assert [f[2] for f in findings] == ["raw-collective"]

@@ -16,9 +16,24 @@ compiled HLO.  One check over ``paddle_tpu/``:
                    lowerings in ``ops/collective_ops.py``) — or mark a
                    deliberate site with ``# collective: allow``.
 
+  raw-sharding     a call to (or import of) ``NamedSharding``,
+                   ``with_sharding_constraint`` or
+                   ``custom_partitioning`` outside the sanctioned
+                   sharding modules.  Sharding placement is POLICY: ad
+                   hoc annotations scattered through library code bypass
+                   the gspmd policy layer (`parallel/gspmd/specs.py`
+                   named_sharding/constrain), drift from the mesh-axis
+                   aliases, and make the resharding accounting
+                   (`pt_gspmd_resharding_bytes`) unattributable.  Route
+                   through the gspmd layer — or mark a deliberate site
+                   with ``# collective: allow``.
+
 Sanctioned modules (they ARE the collective surface):
 ``kernels/ring_collectives.py``, ``kernels/quantized_collectives.py``,
-``ops/collective_ops.py``.
+``ops/collective_ops.py``, plus — for both checks — the gspmd core
+(``parallel/gspmd/*.py``); the sharding check additionally sanctions
+``parallel/hybrid.py`` (its `_spec` is the classic lane's one minting
+site) and ``jax_compat.py`` (the cross-version accessor).
 
 Suppress a deliberate finding with ``# collective: allow`` on the same
 line or the line above (e.g. the ring-attention kernel's own ppermute
@@ -45,9 +60,22 @@ EXEMPT = (
     "paddle_tpu/kernels/ring_collectives.py",
     "paddle_tpu/kernels/quantized_collectives.py",
     "paddle_tpu/ops/collective_ops.py",
+    "paddle_tpu/parallel/gspmd/specs.py",
+    "paddle_tpu/parallel/gspmd/executor.py",
+    "paddle_tpu/parallel/gspmd/quant_hook.py",
+)
+
+# the sanctioned sharding-placement surface (raw-sharding check only)
+EXEMPT_SHARDING = EXEMPT + (
+    "paddle_tpu/parallel/hybrid.py",
+    "paddle_tpu/jax_compat.py",
 )
 
 RAW_COLLECTIVES = ("ppermute", "psum")
+
+# sharding-placement constructs that must route through the gspmd layer
+RAW_SHARDING = ("NamedSharding", "with_sharding_constraint",
+                "custom_partitioning")
 
 ALLOW_MARK = "collective: allow"
 
@@ -60,7 +88,18 @@ def _allowed(src_lines, lineno):
     return False
 
 
-def check_source(src: str, path: str = "<string>"):
+def _call_name(node):
+    """The called name for a Call node: the attribute (lax.psum -> psum)
+    or the bare name (NamedSharding(...) -> NamedSharding)."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def check_source(src: str, path: str = "<string>",
+                 sharding_exempt: bool = False):
     """Lint one file's source; returns [(path, lineno, check, message)]."""
     findings = []
     lines = src.splitlines()
@@ -69,18 +108,36 @@ def check_source(src: str, path: str = "<string>"):
     except SyntaxError as e:
         return [(path, e.lineno or 0, "parse-error", str(e))]
     for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in RAW_COLLECTIVES):
-            continue
-        if _allowed(lines, node.lineno):
-            continue
-        findings.append(
-            (path, node.lineno, "raw-collective",
-             f"raw {node.func.attr}() outside the kernels layer — route "
-             "through kernels/ring_collectives.py (quantized wire format, "
-             "algorithm selection, wire-bytes accounting) or mark a "
-             f"deliberate site `# {ALLOW_MARK}`"))
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if (isinstance(node.func, ast.Attribute)
+                    and name in RAW_COLLECTIVES
+                    and not _allowed(lines, node.lineno)):
+                findings.append(
+                    (path, node.lineno, "raw-collective",
+                     f"raw {name}() outside the kernels layer — route "
+                     "through kernels/ring_collectives.py (quantized wire "
+                     "format, algorithm selection, wire-bytes accounting) "
+                     f"or mark a deliberate site `# {ALLOW_MARK}`"))
+            elif (not sharding_exempt and name in RAW_SHARDING
+                    and not _allowed(lines, node.lineno)):
+                findings.append(
+                    (path, node.lineno, "raw-sharding",
+                     f"raw {name}() outside the gspmd layer — sharding "
+                     "placement is policy: route through "
+                     "parallel/gspmd/specs.py (named_sharding/constrain, "
+                     "axis aliases, resharding accounting) or mark a "
+                     f"deliberate site `# {ALLOW_MARK}`"))
+        elif (isinstance(node, ast.ImportFrom) and not sharding_exempt):
+            for alias in node.names:
+                if alias.name in RAW_SHARDING \
+                        and not _allowed(lines, node.lineno):
+                    findings.append(
+                        (path, node.lineno, "raw-sharding",
+                         f"import of {alias.name} outside the gspmd "
+                         "layer — sharding placement is policy: route "
+                         "through parallel/gspmd/specs.py or mark a "
+                         f"deliberate site `# {ALLOW_MARK}`"))
     return findings
 
 
@@ -96,7 +153,8 @@ def check_file(path: Path):
         rel_str = str(path)
     if _exempt(rel_str):
         return []
-    return check_source(path.read_text(encoding="utf-8"), rel_str)
+    return check_source(path.read_text(encoding="utf-8"), rel_str,
+                        sharding_exempt=rel_str in EXEMPT_SHARDING)
 
 
 def main(argv):

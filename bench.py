@@ -33,7 +33,10 @@ overlap-on vs overlap-off A/B with per-arm p50/p95/max step quantiles
 transpiler-lane vs GSPMD-executor-lane A/B (parallel/gspmd/): per-arm
 p50/p95/max step quantiles plus the gspmd arm's XLA-inserted collective
 counts and resharding bytes from compiled-HLO inspection;
-PT_BENCH_SERVE=1 → serving-lane load-generator
+PT_BENCH_HEALTH=1 → health-sentinel-on vs -off A/B
+(paddle_tpu/health/): per-arm p50/p95/max step quantiles + the p50
+overhead fraction of the in-graph finite check / skip gate (acceptance:
+<=2% on the CPU smoke); PT_BENCH_SERVE=1 → serving-lane load-generator
 rung: a paddle_tpu.serving.Engine under closed-loop concurrent clients,
 recording request throughput + p50/p99 latency quantiles and batch-size /
 executable-cache figures (PT_BENCH_SERVE_CLIENTS, PT_BENCH_SERVE_REQUESTS
@@ -824,6 +827,75 @@ def _overlap_step_quantiles(size, batch, seq_len, n_steps, bf16):
     return out
 
 
+def _health_ab(size, batch, seq_len, n_steps, bf16):
+    """PT_BENCH_HEALTH=1 A/B rung: the DP step with the training health
+    sentinel (FLAGS_health_sentinel, action=skip — the in-graph finite
+    check + state gate + the host-side scalar read) ON vs OFF, per-step
+    wall quantiles per arm and the p50 overhead fraction.  Fresh program
+    per arm — the sentinel transpile itself is the A/B.  The acceptance
+    bar (ISSUE 10): overhead <= 2% p50 on the CPU smoke."""
+    import numpy as np
+
+    from paddle_tpu import fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import DataParallelRunner
+
+    kw = dict(vocab_size=30528, attn_dropout=0.1)
+    cfg = (bert.BertConfig.base(**kw) if size == "base"
+           else bert.BertConfig.tiny(**kw))
+    prior = fluid.get_flags(["FLAGS_health_sentinel",
+                             "FLAGS_health_action"])
+    out = {"methodology": "syncfetch per-step, arms interleaved",
+           "steps": n_steps, "action": "skip"}
+    data = bert.make_fake_batch(cfg, batch=batch, seq_len=seq_len,
+                                seed=0)
+    arms = {}
+    try:
+        # build + fully warm BOTH arms first, then interleave the timed
+        # steps round-robin: a sequential A-then-B run measures compile
+        # cache / page-cache warmth and allocator state as "overhead"
+        # (observed 10x run-to-run swings on the 2-vCPU container) --
+        # exactly the bias a <=2% gate cannot survive
+        for arm, enabled in (("off", False), ("on", True)):
+            fluid.set_flags({"FLAGS_health_sentinel": enabled,
+                             "FLAGS_health_action": "skip"})
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup), \
+                    fluid.unique_name.guard():
+                feeds, loss, _mlm, _nsp = bert.build_bert_pretrain(
+                    cfg, is_test=False)
+                fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+            _maybe_enable_bf16(main_prog, bf16)
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor()
+                exe.run(startup)
+                runner = DataParallelRunner(main_prog, loss.name,
+                                            quant_grads=True)
+                runner.run(exe, data, [loss.name], scope)  # warm
+                runner.run(exe, data, [loss.name], scope)
+            arms[arm] = (runner, exe, scope, loss, [])
+        for _ in range(n_steps):
+            for arm, (runner, exe, scope, loss, times) in arms.items():
+                with fluid.scope_guard(scope):
+                    t0 = time.perf_counter()
+                    runner.run(exe, data, [loss.name], scope)
+                    times.append(time.perf_counter() - t0)
+        for arm, (_r, _e, _s, _l, times) in arms.items():
+            out[arm] = {
+                "p50_s": round(float(np.percentile(times, 50)), 6),
+                "p95_s": round(float(np.percentile(times, 95)), 6),
+                "max_s": round(float(np.max(times)), 6),
+            }
+        if out["off"]["p50_s"] > 0:
+            out["overhead_p50_pct"] = round(
+                100.0 * (out["on"]["p50_s"] - out["off"]["p50_s"])
+                / out["off"]["p50_s"], 2)
+    finally:
+        fluid.set_flags(prior)
+    return out
+
+
 def _gspmd_ab(size, batch, seq_len, n_steps, bf16):
     """PT_BENCH_GSPMD=1 A/B rung: the SAME bert step through the
     transpiler DP lane (explicit c_allreduce ops + shard_map) vs the
@@ -1076,6 +1148,14 @@ def measure(size):
                                         bf16)
         except Exception as e:
             print(f"bench: gspmd A/B rung failed ({e})", file=sys.stderr)
+    # health-sentinel-on vs -off A/B (ISSUE 10): in-graph finite check +
+    # skip gate overhead, gated at <=2% p50 on the CPU smoke
+    if os.environ.get("PT_BENCH_HEALTH") == "1":
+        try:
+            rec["health_ab"] = _health_ab(size, batch, seq_len, n_steps,
+                                          bf16)
+        except Exception as e:
+            print(f"bench: health A/B rung failed ({e})", file=sys.stderr)
     return rec
 
 

@@ -31,6 +31,28 @@ Spec grammar — semicolon-separated rules:
     leave:step:<k>            fire the registered `leave` hook at step k
                               (graceful departure WITHOUT a signal)
     leave:round:<k>           ... at completed round k
+    nan:grad:step:<k>         NUMERIC fault class (health sentinel,
+                              docs/DISTRIBUTED.md §6): corrupt one raw
+                              parameter gradient to NaN INSIDE the
+                              compiled step, at exactly the k-th
+                              executed step of the health-transpiled
+                              program (1-based; counted by an in-graph
+                              countdown, so it is deterministic under
+                              step chains and does not re-fire on a
+                              rollback replay)
+    inf:grad:step:<k>         same, +Inf
+    nan:loss:step:<k>         corrupt the LOSS value (the gradient path
+    inf:loss:step:<k>         stays clean — exercises the host-side
+                              loss detector, not the found_inf scalar)
+    spike:loss:step:<k>[:<x>] multiply the loss by <x> (default 1000)
+                              at step k — the loss-spike detector's
+                              deterministic trigger
+
+Numeric rules are declarative: they do not fire from on_rpc/on_step but
+are read by `paddle_tpu.health.transpile.insert_health_sentinel` (via
+`numeric_rules()`) when a runner builds its program, and planted as
+`health_fault_inject` ops.  Install the plan BEFORE constructing the
+runner (or use PT_FAULT_PLAN for subprocesses).
 
 `<cmd>` is an RPC name (send_grad, get_param, send_barrier, fetch_barrier,
 send_param, lookup_rows, checkpoint_notify, stop, lease, join, leave) or
@@ -61,6 +83,9 @@ __all__ = ["FaultPlan", "FaultInjected", "install", "uninstall", "active",
 
 # lifecycle actions fired from on_step/on_round (vs per-RPC actions)
 _LIFECYCLE = ("kill", "preempt", "join", "leave")
+# declarative numeric-fault actions consumed by the health sentinel's
+# program transpile (never fired from on_rpc/on_step/on_round)
+_NUMERIC = ("nan", "inf", "spike")
 
 _ENV = "PT_FAULT_PLAN"
 
@@ -126,6 +151,11 @@ class FaultPlan:
             elif action in _LIFECYCLE and len(bits) == 3 and \
                     bits[1] in ("step", "round"):
                 self.rules.append(_Rule(action, bits[1], int(bits[2])))
+            elif action in _NUMERIC and len(bits) in (4, 5) and \
+                    bits[1] in ("grad", "loss") and bits[2] == "step":
+                self.rules.append(_Rule(
+                    action, bits[1], int(bits[3]),
+                    float(bits[4]) if len(bits) == 5 else None))
             else:
                 raise ValueError(f"bad fault rule {part!r} in {spec!r}")
 
@@ -147,6 +177,7 @@ class FaultPlan:
             fire = [r for r in self.rules
                     if r.cmd in (cmd_name, "*") and
                     r.action not in _LIFECYCLE and
+                    r.action not in _NUMERIC and
                     (r.action == "flaky" or r.n == n)]
         for r in fire:
             if r.action == "flaky":
@@ -195,6 +226,15 @@ class FaultPlan:
 
     def _maybe_kill(self, kind, k):  # old name kept for callers/tests
         self._fire_lifecycle(kind, k)
+
+    def numeric_rules(self):
+        """The declarative numeric-fault rules (health sentinel class):
+        [{"kind": nan|inf|spike, "target": grad|loss, "step": k,
+        "scale": x|None}], in spec order.  Consumed at program-build
+        time by health.transpile, not fired from the runtime hooks."""
+        return [{"kind": r.action, "target": r.cmd, "step": r.n,
+                 "scale": r.arg}
+                for r in self.rules if r.action in _NUMERIC]
 
     def on_step(self, step):
         """Trainer-side hook: call once per training step."""

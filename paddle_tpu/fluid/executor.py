@@ -807,7 +807,10 @@ class _CompiledBlock(_JitExecutable):
         self.donated_names = plan.donated_names
         self.readonly_names = plan.readonly_names
         self.write_names = plan.write_names
-        self._jitted = jax.jit(plan.make_body(), donate_argnums=(0,))
+        from paddle_tpu.health import wrap_body as _health_gate
+
+        self._jitted = jax.jit(_health_gate(program, plan.make_body()),
+                               donate_argnums=(0,))
         self.place = place
         self.label = f"program@{id(program):x}/v{program._version}"
         self._prof_state = {"ran": False}
@@ -849,22 +852,16 @@ class _CompiledBlock(_JitExecutable):
 
 def _check_nan_inf(plan, label, out_writes, fetches):
     """FLAGS_check_nan_inf (reference operator.cc:953-984): scan every
-    written float var and raise naming the first non-finite one."""
-    import jax.numpy as jnp
+    written float var and raise naming the first non-finite one.  Thin
+    wrapper over the health sentinel's audited scan
+    (paddle_tpu/health/detect.py) — the in-graph sentinel
+    (FLAGS_health_sentinel) supersedes this host-side sweep for the
+    runner lanes; this stays the op-by-op debugging contract."""
+    from paddle_tpu.health import detect
 
     named = list(out_writes.items()) + list(
         zip(plan.jit_fetch_names, fetches))
-    for name, val in named:
-        try:
-            arr = jnp.asarray(val)
-        except TypeError:  # non-array fetch
-            continue
-        if not jnp.issubdtype(arr.dtype, jnp.floating):
-            continue
-        if not bool(jnp.isfinite(arr).all()):
-            raise RuntimeError(
-                f"FLAGS_check_nan_inf: variable {name!r} contains "
-                f"NaN/Inf after {label}")
+    detect.host_scan(named, label)
 
 
 class HostOpsUnsupported(ValueError):
@@ -909,7 +906,12 @@ class _CompiledChain(_JitExecutable):
         self.n_steps = n = int(n_steps)
         if n < 1:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
-        body = plan.make_body()
+        from paddle_tpu.health import wrap_body as _health_gate
+
+        # the health gate wraps the PER-ITERATION body, inside the
+        # fori_loop: a mid-chain bad step masks its own state writes and
+        # the remaining iterations continue from clean state
+        body = _health_gate(program, plan.make_body())
 
         def feed_at(feeds, i):
             if not stacked_feed:
@@ -981,6 +983,7 @@ class Executor:
         self.place = place if place is not None else framework._current_expected_place()
         self._cache: dict = {}
         self._step = 0
+        self._sentinels: dict = {}  # id(program) -> HealthSentinel|None
         # opt-in /metricsz endpoint (FLAGS_metrics_port): every process
         # that runs programs — trainer, pserver, bench child — exposes
         # itself; a no-op when the flag is 0 or a server already runs
@@ -1029,6 +1032,21 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._sentinels.clear()
+
+    def _health(self, program):
+        """Per-program health sentinel (FLAGS_health_sentinel, the
+        single-device lane of docs/DISTRIBUTED.md §6): resolved once per
+        program — `health.attach` transpiles the sentinel into it
+        (bumping the version BEFORE the executable cache is keyed) and
+        returns None when the flag is off or there is nothing to
+        guard."""
+        key = id(program)
+        if key not in self._sentinels:
+            from paddle_tpu import health
+
+            self._sentinels[key] = health.attach(program, lane="single")
+        return self._sentinels[key]
 
     def _coerce_feed(self, program, feed):
         import jax
@@ -1088,12 +1106,15 @@ class Executor:
         import time as _time
 
         block = program.global_block()
+        sent = self._health(program)  # may transpile: before cache key
         key = self._cache_key(program, feed, fetch_names)
         cb = self._cache.get(key)
         if cb is None:
             from . import profiler as _prof
 
             _m_cache().labels(path="single", result="miss").inc()
+            if sent is not None:
+                sent.ensure_state(scope)  # before BlockPlan scope checks
             t0 = _time.perf_counter()
             cb = _CompiledBlock(program, block, feed.keys(), fetch_names, self.place, scope)
             self._cache[key] = cb
@@ -1107,12 +1128,18 @@ class Executor:
         # run timing ("compile+run" on a signature's first run — jit compiles
         # lazily — then "run") is recorded inside _CompiledBlock.run so every
         # execution path shares the instrumentation
-        first_run = not getattr(cb, "_obs_ran", False)
-        t0 = _time.perf_counter()
-        fetches = cb.run(scope, feed, self._step)
-        _record_step("single", _time.perf_counter() - t0, first_run)
-        cb._obs_ran = True
-        self._step += 1
+        def attempt():
+            first_run = not getattr(cb, "_obs_ran", False)
+            t0 = _time.perf_counter()
+            fetches = cb.run(scope, feed, self._step)
+            _record_step("single", _time.perf_counter() - t0, first_run)
+            cb._obs_ran = True
+            self._step += 1
+            return fetches
+
+        from paddle_tpu.health import run_guarded
+
+        fetches = run_guarded(sent, scope, fetch_names, attempt)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
@@ -1167,6 +1194,7 @@ class Executor:
         # FLAT key extension: key[0] stays id(program) so compiled_for()
         # (and anything else scanning the cache by program) sees chain
         # executables too
+        sent = self._health(program)  # may transpile: before cache key
         key = self._cache_key(program, feed, fetch_names) + (
             "chain", int(n_steps), bool(stacked_feed))
         import time as _time
@@ -1176,6 +1204,8 @@ class Executor:
             from . import profiler as _prof
 
             _m_cache().labels(path="chain", result="miss").inc()
+            if sent is not None:
+                sent.ensure_state(scope)
             t0 = _time.perf_counter()
             cc = _CompiledChain(program, program.global_block(),
                                 feed.keys(), fetch_names, self.place,
@@ -1188,12 +1218,22 @@ class Executor:
                                         phase="trace").inc(trace_s)
         else:
             _m_cache().labels(path="chain", result="hit").inc()
-        first_run = not getattr(cc, "_obs_ran", False)
-        t0 = _time.perf_counter()
-        fetches = cc.run(scope, feed, self._step)
-        _record_step("chain", _time.perf_counter() - t0, first_run)
-        cc._obs_ran = True
-        self._step += int(n_steps)
+        # sentinel at CHAIN granularity: a mid-chain bad step was masked
+        # in-graph; post_step books it via the cumulative counter, and a
+        # rollback restores the pre-CHAIN state and replays the chain
+        def attempt():
+            first_run = not getattr(cc, "_obs_ran", False)
+            t0 = _time.perf_counter()
+            fetches = cc.run(scope, feed, self._step)
+            _record_step("chain", _time.perf_counter() - t0, first_run)
+            cc._obs_ran = True
+            self._step += int(n_steps)
+            return fetches
+
+        from paddle_tpu.health import run_guarded
+
+        fetches = run_guarded(sent, scope, fetch_names, attempt,
+                              chain=int(n_steps) > 1)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

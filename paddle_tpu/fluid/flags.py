@@ -169,6 +169,39 @@ _DEFS = {
     # queueing unboundedly (callers retry/shed — bounded worst-case
     # latency under overload)
     "FLAGS_serving_max_queue": (256, int, True),
+    # per-request serving deadline in ms (docs/SERVING.md): a queued or
+    # in-flight request older than this resolves its future with a typed
+    # ServingDeadlineError instead of waiting forever (booked as
+    # pt_serve_rejected_total{reason="deadline"}); 0 = no deadline
+    "FLAGS_serving_deadline_ms": (0, int, True),
+    # training health sentinel (paddle_tpu/health/, docs/DISTRIBUTED.md
+    # §6 "Numeric fault tolerance"): on-device NaN/Inf gradient
+    # detection (one found_inf scalar per step, no host scan), loss-
+    # spike detection, automatic skip/rollback, dynamic loss scaling —
+    # wired into every runner lane.  Off by default: the fail-fast
+    # FLAGS_check_nan_inf host scan stays the reference behavior.
+    "FLAGS_health_sentinel": (False, _parse_bool, True),
+    # response to a bad step: "raise" = fail fast (the check_nan_inf
+    # contract), "skip" = mask the optimizer update in-graph and keep
+    # training, "rollback" = restore params+optimizer state from the
+    # rolling snapshot window and replay the step
+    "FLAGS_health_action": ("skip", str, True),
+    # rollback snapshot window depth (steps of params+opt state held as
+    # on-device copies; ZeRO-1 shards snapshot only their residents)
+    "FLAGS_health_rollback_keep": (2, int, True),
+    # loss-spike detector: flag a step whose fetched loss deviates from
+    # the rolling EMA by more than this many EMA standard deviations
+    # (0 disables); warmup = good steps observed before it can fire
+    "FLAGS_health_spike_zscore": (6.0, float, True),
+    "FLAGS_health_spike_warmup": (8, int, True),
+    # dynamic loss scaling (update_loss_scaling semantics): multiply the
+    # backward seed by @HEALTH@loss_scale, unscale at the optimizer
+    # edge, halve on every bad step, double after N consecutive good
+    # steps.  Off by default — bf16 (the benched policy) has fp32's
+    # exponent range, so scaling is an fp16-parity knob.
+    "FLAGS_health_loss_scaling": (False, _parse_bool, True),
+    "FLAGS_health_loss_scale_init": (65536.0, float, True),
+    "FLAGS_health_scale_growth_steps": (1000, int, True),
     # observability (docs/OBSERVABILITY.md): nonzero port serves
     # /metricsz + /statusz + /healthz from this process (started lazily
     # by the executor via observability.exposition.ensure_from_flags);

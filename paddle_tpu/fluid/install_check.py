@@ -29,6 +29,9 @@ def run_check():
     out = exe.run(main,
                   feed={"install_check_x": np.ones((2, 2), dtype="float32")},
                   fetch_list=[loss.name])
+    # install self-test sanity assert, not a numeric-health path (those
+    # route through paddle_tpu.health.detect)
+    # resilience: allow
     assert np.isfinite(np.asarray(out[0])).all()
     # observability: allow — user-facing check output
     print("Your paddle_tpu works well on SINGLE device (%s)." %

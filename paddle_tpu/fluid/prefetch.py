@@ -101,21 +101,39 @@ class DatasetPrefetcher:
     partition: optional callable returning the CURRENT elastic
     ``(index, count)`` membership view (e.g. ``lambda:
     (info["index"], info["count"])`` over `distributed.elastic
-    .membership`).  Re-read per produced batch, BEFORE ``transform``, so
-    an epoch flip re-shards the very next batch: each member slices its
-    even ``B // count`` share of the global batch (`partition_batch`) —
-    the round-partitioned elastic feed as a library feature instead of
-    test-local code (ROADMAP elastic phase 2).  A pending member
-    (index < 0) replays the full batch unsliced; view changes count on
+    .membership`).  Re-read per batch so an epoch flip re-shards the
+    very next batch: each member slices its even ``B // count`` share of
+    the global batch (`partition_batch`) — the round-partitioned elastic
+    feed as a library feature instead of test-local code (ROADMAP
+    elastic phase 2).  A pending member (index < 0) replays the full
+    batch unsliced; view changes count on
     ``pt_prefetch_repartitions_total`` and in ``repartitions``.
+
+    partition_stage: where the slice happens.  ``"produce"`` (default)
+    slices on the producer thread BEFORE ``transform`` — cheapest, but
+    the view is read up to ``depth`` batches AHEAD of consumption, so a
+    membership change mid-buffer would deliver a few batches sliced by
+    the OLD view (overlapping/missing rows exactly at a resize).
+    ``"consume"`` slices at ``__next__`` time with the view of the
+    round that actually consumes the batch — the sync PS elastic loop's
+    correctness requirement (every member of a round must slice by the
+    SAME epoch view, or the merged gradient is not the full-batch
+    mean); ``transform`` then runs on the full batch, so device-put
+    transforms should stay on the produce stage only when the view is
+    static.
     """
 
     def __init__(self, batch_iter, transform=None, depth=2,
-                 partition=None):
+                 partition=None, partition_stage="produce"):
+        if partition_stage not in ("produce", "consume"):
+            raise ValueError(
+                f"partition_stage must be 'produce' or 'consume', got "
+                f"{partition_stage!r}")
         self.depth = max(1, int(depth))
         self._q: queue.Queue = queue.Queue(maxsize=self.depth)
         self._transform = transform or (lambda b: b)
         self._partition = partition
+        self._partition_stage = partition_stage
         self._last_view = None
         self.repartitions = 0
         self._err = None
@@ -144,7 +162,9 @@ class DatasetPrefetcher:
         try:
             for batch in it:
                 t0 = time.perf_counter()
-                if self._partition is not None and isinstance(batch, dict):
+                if (self._partition is not None
+                        and self._partition_stage == "produce"
+                        and isinstance(batch, dict)):
                     batch = self._apply_partition(batch)
                 out = self._transform(batch)
                 self.produce_seconds += time.perf_counter() - t0
@@ -186,6 +206,10 @@ class DatasetPrefetcher:
             raise StopIteration
         self.batches += 1
         _m_batches().inc()
+        if (self._partition is not None
+                and self._partition_stage == "consume"
+                and isinstance(item, dict)):
+            item = self._apply_partition(item)
         return item
 
     def close(self):

@@ -1,0 +1,57 @@
+"""Training health sentinel (docs/DISTRIBUTED.md §6 "Numeric fault
+tolerance").
+
+A numeric fault — a NaN/Inf gradient, an Inf loss, a loss spike — used to
+either crash the job (FLAGS_check_nan_inf raises from a host-side scan)
+or silently poison every replica through the gradient collective.  This
+package is the one audited implementation of numeric-health logic for
+the whole stack (tools/lint_resilience.py flags raw isnan/isinf/isfinite
+checks anywhere else):
+
+- `detect`    — the fused on-device finite check (one scalar per step,
+                computed in-graph from the post-reduction gradients, so
+                detection costs no extra collective and no host scan)
+                plus the classic Executor's host-side scan, now a thin
+                wrapper the FLAGS_check_nan_inf path delegates to.
+- `transpile` — `insert_health_sentinel(program)`: folds the check into
+                the program before the optimizer ops, gates every
+                in-place state write on the `found_inf` scalar (a bad
+                step's parameter/moment updates are masked IN-GRAPH),
+                wires dynamic loss scaling (`update_loss_scaling`
+                semantics) end to end, and plants deterministic numeric
+                fault injectors from the FaultPlan grammar
+                (`nan:grad:step:N`, `inf:loss:step:N`,
+                `spike:loss:step:N`).
+- `sentinel`  — the host-side response policy (`FLAGS_health_action` =
+                raise | skip | rollback): books
+                ``pt_health_bad_steps_total{kind,action}`` /
+                ``pt_health_rollbacks_total`` / ``pt_health_loss_scale``,
+                runs the rolling-EMA loss-spike detector, keeps the
+                rolling snapshot window and drives restore + replay.
+- `gating`    — the body wrapper every execution lane (single-device
+                Executor, transpiler DP, hybrid ZeRO-1, GSPMD executor)
+                applies so the skip/rollback state masking is one shared
+                mechanism, not four.
+
+Enable with FLAGS_health_sentinel=1; all runner lanes attach it
+automatically (`health.attach`).
+"""
+
+from __future__ import annotations
+
+from . import detect  # noqa: F401
+from .gating import wrap_body  # noqa: F401
+from .sentinel import HealthSentinel, attach, run_guarded  # noqa: F401
+from .transpile import (FOUND_INF_VAR, LOSS_SCALE_VAR,  # noqa: F401
+                        insert_health_sentinel)
+
+__all__ = [
+    "attach",
+    "run_guarded",
+    "HealthSentinel",
+    "insert_health_sentinel",
+    "wrap_body",
+    "detect",
+    "FOUND_INF_VAR",
+    "LOSS_SCALE_VAR",
+]

@@ -1,0 +1,78 @@
+"""The one audited finite-check implementation.
+
+Every numeric-health test in the tree routes through these two
+functions (tools/lint_resilience.py's `raw-numeric-check` lint enforces
+it): `all_finite` is the fused ON-DEVICE reduction the sentinel's
+in-graph check and the AMP ops lower to; `host_scan` is the classic
+Executor's FLAGS_check_nan_inf behavior (reference operator.cc:953-984),
+kept as the fail-fast fallback the executor path now merely wraps.
+"""
+
+from __future__ import annotations
+
+__all__ = ["all_finite", "found_inf", "host_scan"]
+
+
+def _float_arrays(xs):
+    import jax.numpy as jnp
+
+    out = []
+    for x in xs:
+        if x is None:
+            continue
+        try:
+            a = jnp.asarray(x)
+        except TypeError:  # non-array (struct value / python object)
+            continue
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            out.append(a)
+    return out
+
+
+def all_finite(xs):
+    """ONE boolean scalar: True iff every float tensor in `xs` is fully
+    finite.  Traced into the step graph, this is a tree of `is_finite` +
+    `reduce_and` ops XLA fuses into the surrounding computation — no
+    host round trip, and (computed on post-reduction gradients, which
+    are replica-identical) no extra collective launch.  Non-float and
+    non-array inputs are ignored; an empty input set is vacuously
+    finite."""
+    import jax.numpy as jnp
+
+    arrs = _float_arrays(xs)
+    if not arrs:
+        return jnp.asarray(True)
+    ok = jnp.asarray(True)
+    for a in arrs:
+        ok = ok & jnp.all(jnp.isfinite(a.astype(jnp.float32)))
+    return ok
+
+
+def found_inf(xs):
+    """`all_finite` inverted, as the float32 [1] scalar the program's
+    ``@HEALTH@found_inf`` variable carries (float so every lane's
+    write-back/sharding path treats it like any other stat)."""
+    import jax.numpy as jnp
+
+    return jnp.reshape((~all_finite(xs)).astype(jnp.float32), (1,))
+
+
+def host_scan(named_values, label):
+    """Host-side scan over (name, value) pairs; raises RuntimeError
+    naming the first non-finite float variable.  The classic Executor's
+    FLAGS_check_nan_inf contract (detect-and-crash) — superseded by the
+    in-graph sentinel for the runner lanes, kept for op-by-op debugging
+    parity."""
+    import jax.numpy as jnp
+
+    for name, val in named_values:
+        try:
+            arr = jnp.asarray(val)
+        except TypeError:  # non-array fetch
+            continue
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        if not bool(all_finite([arr])):
+            raise RuntimeError(
+                f"FLAGS_check_nan_inf: variable {name!r} contains "
+                f"NaN/Inf after {label}")

@@ -1,0 +1,321 @@
+"""Host-side response policy: raise | skip | rollback.
+
+The in-graph side (transpile.py + gating.py) already detected the bad
+step and masked its state writes; the `HealthSentinel` is the per-runner
+host object that reads the two scalars the step left behind
+(``@HEALTH@found_inf``, ``@HEALTH@bad_steps_total``), runs the
+rolling-EMA loss-spike detector on the fetched loss, books the
+``pt_health_*`` metrics, and drives the configured action:
+
+  raise     — preserve the fail-fast contract: RuntimeError naming the
+              step, exactly like FLAGS_check_nan_inf used to (but from
+              an on-device scalar, not a host scan of every tensor).
+  skip      — nothing more to do for a NaN/Inf step (the in-graph gate
+              already masked the update; the loss scale already
+              halved); the step is booked and training continues.
+  rollback  — restore params + optimizer state from the rolling
+              in-memory snapshot window (FLAGS_health_rollback_keep
+              steps deep) and tell the runner to REPLAY the same feed:
+              the fault-injection counters are health-owned state that
+              advanced through the gate, so a deterministic injected
+              fault does not re-fire on the replay, and with loss
+              scaling on the replay runs at the halved scale.  A replay
+              that is STILL bad degrades to skip (no livelock).
+
+Snapshots are device-resident copies (``jnp.copy`` — donation-safe,
+no host round trip) taken only when the action is ``rollback``; under
+ZeRO-1 / GSPMD the copied arrays keep their sharding, so each process
+copies only its addressable shards (the dp-sharded moment shards stay
+sharded — ZeRO-aware by construction).  Loss-spike detection under
+``skip`` books the event and lets the (already-applied) update stand;
+reverting a spike needs ``rollback``.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+__all__ = ["HealthSentinel", "attach", "run_guarded"]
+
+_ACTIONS = ("raise", "skip", "rollback")
+_EMA_BETA = 0.9
+_EPS = 1e-12
+
+
+def _m_bad_steps():
+    from paddle_tpu import observability as obs
+
+    return obs.counter(
+        "pt_health_bad_steps_total",
+        "Training steps the health sentinel flagged, by detection kind "
+        "(grad=non-finite gradient, loss=non-finite loss, "
+        "spike=loss-spike z-score) and the action applied",
+        labels=("kind", "action"))
+
+
+def _m_rollbacks():
+    from paddle_tpu import observability as obs
+
+    return obs.counter(
+        "pt_health_rollbacks_total",
+        "State restores performed by the health sentinel's rollback "
+        "action (each followed by a same-feed replay)")
+
+
+def _m_loss_scale():
+    from paddle_tpu import observability as obs
+
+    return obs.gauge(
+        "pt_health_loss_scale",
+        "Live dynamic loss scale (@HEALTH@loss_scale) observed after "
+        "the most recent step, per runner lane", labels=("lane",))
+
+
+def run_guarded(sentinel, scope, fetch_names, attempt, chain=False):
+    """THE sentinel step protocol, shared by every dispatch site
+    (single-device run/run_steps, DP, hybrid, GSPMD): seed state,
+    snapshot, run one attempt, evaluate — and re-run the SAME attempt
+    once when the sentinel rolled back.  ``attempt()`` is the lane's
+    dispatch closure (timing/metrics included, so a replay books as the
+    executed step it is); identity pass-through when ``sentinel`` is
+    None."""
+    if sentinel is None:
+        return attempt()
+    for _try in range(2):
+        sentinel.ensure_state(scope)
+        sentinel.pre_step(scope)
+        fetches = attempt()
+        if sentinel.post_step(scope, fetch_names, fetches,
+                              chain=chain) != "replay":
+            break
+    return fetches
+
+
+def attach(program, loss_name=None, lane="default", enable=None):
+    """The one hook every runner lane calls at construction: inserts the
+    sentinel program rewrite (idempotent) and returns a HealthSentinel,
+    or None when FLAGS_health_sentinel is off or the program has nothing
+    to guard."""
+    from paddle_tpu.fluid import flags as _flags
+
+    if enable is None:
+        enable = _flags.flag("health_sentinel")
+    if not enable:
+        return None
+    from .transpile import insert_health_sentinel
+
+    plan = insert_health_sentinel(program, loss_name=loss_name)
+    if plan is None:
+        return None
+    return HealthSentinel(program, lane=lane)
+
+
+class HealthSentinel:
+    """Per-runner host controller; see module docstring.
+
+    Runner protocol (shared by all lanes)::
+
+        for _attempt in range(2):
+            sent.ensure_state(scope)
+            sent.pre_step(scope)
+            out = <dispatch one step / one chain>
+            if sent.post_step(scope, fetch_names, out) != "replay":
+                break
+    """
+
+    def __init__(self, program, lane="default", action=None, keep=None,
+                 spike_zscore=None, spike_warmup=None):
+        from paddle_tpu.fluid import flags as _flags
+
+        self.program = program
+        self.plan = program._health_plan
+        self.lane = lane
+        self.action = action or _flags.flag("health_action")
+        if self.action not in _ACTIONS:
+            raise ValueError(
+                f"FLAGS_health_action must be one of {_ACTIONS}, got "
+                f"{self.action!r}")
+        self.keep = max(1, int(keep if keep is not None
+                               else _flags.flag("health_rollback_keep")))
+        self.spike_zscore = float(
+            spike_zscore if spike_zscore is not None
+            else _flags.flag("health_spike_zscore"))
+        self.spike_warmup = int(
+            spike_warmup if spike_warmup is not None
+            else _flags.flag("health_spike_warmup"))
+        self._window = collections.deque(maxlen=self.keep)
+        self._ema = None
+        self._emvar = 0.0
+        self._good_samples = 0
+        self._replaying = False
+        self._bad_total_seen = 0.0
+        self._cum_scope = None  # scope the seen-counter is synced to
+        self._snapshot_names = None
+        self._steps_seen = 0
+
+    # -- state -----------------------------------------------------------
+    def ensure_state(self, scope):
+        """Seed the @HEALTH@ scope vars the program reads (loss scale,
+        counters, fault countdowns) — must run before the first compile
+        against this scope."""
+        for name, default in self.plan["state"].items():
+            if scope.get(name) is None:
+                scope.set(name, np.array(default, copy=True))
+        if self._cum_scope is not scope:
+            # sync the cumulative-counter baseline to THIS scope: a
+            # fresh sentinel (new runner/Executor on a scope with prior
+            # bad-step history, or one sentinel serving a second scope)
+            # must not read the persisted total as a delta and book a
+            # phantom bad step on a clean chain
+            self._cum_scope = scope
+            cum = self._scalar(scope, self.plan["bad_total_var"])
+            self._bad_total_seen = cum if cum is not None else 0.0
+
+    def _stateful_names(self, scope):
+        """Persistable program vars present in the scope — params,
+        optimizer accumulators, BN stats; health-owned state excluded
+        (a restore must not undo the scale halving or re-arm a fired
+        fault injector)."""
+        if self._snapshot_names is None:
+            from .transpile import HEALTH_PREFIX
+
+            block = self.program.global_block()
+            self._snapshot_names = [
+                n for n, v in block.vars.items()
+                if v.persistable and not n.startswith(HEALTH_PREFIX)]
+        return [n for n in self._snapshot_names
+                if scope.get(n) is not None]
+
+    @staticmethod
+    def _copy(value):
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            if isinstance(value, jax.Array):
+                # on-device, sharding-preserving, donation-safe copy
+                return jnp.copy(value)
+        except ImportError:  # pragma: no cover - jax is a hard dep
+            pass
+        return np.array(value, copy=True)
+
+    def pre_step(self, scope):
+        """Push a snapshot onto the rolling window (rollback action
+        only — skip/raise never need to restore)."""
+        if self.action != "rollback":
+            return
+        snap = {n: self._copy(scope.get(n))
+                for n in self._stateful_names(scope)}
+        self._window.append(snap)
+
+    def restore(self, scope):
+        """Restore the most recent snapshot (the pre-step state of the
+        step being rolled back); consecutive failures walk deeper into
+        the window as entries are consumed."""
+        if not self._window:
+            return False
+        snap = self._window.pop()
+        for n, v in snap.items():
+            scope.set(n, v)
+        _m_rollbacks().inc()
+        return True
+
+    # -- scalar reads ----------------------------------------------------
+    @staticmethod
+    def _scalar(scope, name):
+        v = scope.get(name)
+        if v is None:
+            return None
+        return float(np.asarray(v).reshape(-1)[0])
+
+    def _loss_value(self, fetch_names, fetches):
+        loss_var = self.plan.get("loss_var")
+        if not loss_var or not fetch_names:
+            return None
+        for n, v in zip(fetch_names, fetches):
+            if n == loss_var:
+                try:
+                    return float(np.mean(np.asarray(v, np.float32)))
+                except (TypeError, ValueError):
+                    return None
+        return None
+
+    # -- the decision ----------------------------------------------------
+    def _classify(self, scope, loss, chain):
+        """(kind, n_events) of this step — None when healthy.  For a
+        run_steps chain the in-graph cumulative counter is consulted
+        (only the final iteration's found_inf survives to the host); a
+        single step skips that extra host read — found_inf alone is the
+        exact answer."""
+        found = self._scalar(scope, self.plan["found_var"])
+        delta = 0
+        if chain or (found is not None and found > 0):
+            cum = self._scalar(scope, self.plan["bad_total_var"])
+            if cum is not None:
+                delta = max(0, int(round(cum - self._bad_total_seen)))
+                self._bad_total_seen = cum
+        if delta or (found is not None and found > 0):
+            return "grad", max(1, delta)
+        if loss is not None and not np.isfinite(loss):
+            return "loss", 1
+        if (loss is not None and self.spike_zscore > 0
+                and self._ema is not None
+                and self._good_samples >= self.spike_warmup):
+            z = abs(loss - self._ema) / ((self._emvar + _EPS) ** 0.5)
+            if z > self.spike_zscore:
+                return "spike", 1
+        return None, 0
+
+    def _observe_good(self, loss):
+        self._good_samples += 1
+        if loss is None:
+            return
+        if self._ema is None:
+            self._ema, self._emvar = loss, 0.0
+            return
+        dev = loss - self._ema
+        self._ema += (1.0 - _EMA_BETA) * dev
+        self._emvar = _EMA_BETA * (self._emvar
+                                   + (1.0 - _EMA_BETA) * dev * dev)
+
+    def post_step(self, scope, fetch_names=None, fetches=None,
+                  chain=False):
+        """Evaluate the step (or, with chain=True, the run_steps chain)
+        that just ran.  Returns "ok", "skip" or "replay"; raises
+        RuntimeError under action=raise on a bad step.  The caller
+        re-dispatches the SAME feed once on "replay"."""
+        self._steps_seen += 1
+        loss = self._loss_value(fetch_names, fetches or [])
+        if self.plan.get("loss_scaling"):
+            scale = self._scalar(scope, self.plan["scale_var"])
+            if scale is not None:
+                _m_loss_scale().labels(lane=self.lane).set(scale)
+        kind, n_events = self._classify(scope, loss, chain)
+        replaying, self._replaying = self._replaying, False
+        if kind is None:
+            self._observe_good(loss)
+            return "ok"
+        _m_bad_steps().labels(kind=kind, action=self.action).inc(
+            max(1, n_events))
+        from paddle_tpu.observability import events
+
+        if events.enabled():
+            events.emit("health_bad_step", kind=kind, action=self.action,
+                        lane=self.lane, step=self._steps_seen,
+                        loss=loss, replay=replaying)
+        if self.action == "raise":
+            raise RuntimeError(
+                f"health sentinel: non-finite/anomalous step detected "
+                f"(kind={kind}, lane={self.lane}) — "
+                f"FLAGS_health_action=raise preserves the "
+                f"FLAGS_check_nan_inf fail-fast contract")
+        if self.action == "rollback" and not replaying:
+            if self.restore(scope):
+                self._replaying = True
+                return "replay"
+        # skip — or a replay that is still bad, or an empty window:
+        # the in-graph gate already masked a grad-kind update; a spike
+        # under skip is booked and stands (reverting needs rollback)
+        return "skip"

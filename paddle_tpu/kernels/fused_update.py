@@ -204,7 +204,10 @@ def _dequant_tile(hi_ref, lo_ref, sc_ref):
 
 def _requant_tile(pn):
     amax = jnp.max(jnp.abs(pn), axis=1, keepdims=True)
-    scale = jnp.where(amax > 0.0, amax / _QMAX, 1.0)
+    # jnp.maximum, not where(amax > 0): a NaN/Inf block must propagate
+    # into its scale, not launder to finite garbage (the health
+    # sentinel's detection surface — see quantize_block_scaled)
+    scale = jnp.maximum(amax / _QMAX, jnp.float32(1e-30))
     q_hi = jnp.clip(jnp.round(pn / scale), -_QMAX, _QMAX)
     resid = pn - q_hi * scale
     q_lo = jnp.clip(jnp.round(resid * (_RESID_DIV / scale)), -_QMAX, _QMAX)

@@ -179,8 +179,14 @@ def quantize_block_scaled(x, block_size=DEFAULT_BLOCK_SIZE, dual_int8=True):
     """
     xf = jnp.reshape(x.astype(jnp.float32), (-1, block_size))
     amax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
-    # all-zero block: scale 1.0 quantizes it to exact zeros (0/0 guard)
-    scale = jnp.where(amax > 0.0, amax / _QMAX, 1.0)
+    # all-zero block: a tiny positive scale quantizes it to exact zeros
+    # (0/0 guard).  jnp.maximum — NOT a `where(amax > 0)` — so a
+    # NaN/Inf block PROPAGATES into its fp32 scale and rides the wire:
+    # `NaN > 0` is False, and the old where() silently laundered a NaN
+    # gradient block into finite garbage at scale 1.0, which is exactly
+    # the poisoned-collective class the health sentinel's QScale check
+    # (docs/DISTRIBUTED.md §6) exists to catch.
+    scale = jnp.maximum(amax / _QMAX, jnp.float32(1e-30))
     q_hi = jnp.clip(jnp.round(xf / scale), -_QMAX, _QMAX)
     if not dual_int8:
         return (q_hi.astype(jnp.int8).reshape(x.shape), None,

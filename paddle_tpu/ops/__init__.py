@@ -22,6 +22,7 @@ from . import metric_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import amp_ops  # noqa: F401
+from . import health_ops  # noqa: F401
 from . import dist_ops  # noqa: F401
 from . import tensor_extra_ops  # noqa: F401
 from . import nn_extra_ops  # noqa: F401

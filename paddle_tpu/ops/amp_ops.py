@@ -19,10 +19,13 @@ def _check_finite_and_unscale(ctx, xs, scale, attrs):
     moments still observe a zero grad (decay toward zero), a documented
     deviation that vanishes with bf16 (overflow is virtually impossible).
     """
+    from paddle_tpu.health import detect
+
     inv = (1.0 / jnp.reshape(scale, ()).astype(jnp.float32))
-    found = jnp.zeros((), dtype=bool)
-    for x in xs:
-        found = found | ~jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+    # the one audited finite reduction (health/detect.py) — also the
+    # health sentinel's on-device detection point when its transpile
+    # inserts this op before the optimizer block
+    found = ~detect.all_finite(xs)
     gate = jnp.where(found, 0.0, 1.0).astype(jnp.float32)
     outs = tuple((x.astype(jnp.float32) * inv * gate).astype(x.dtype) for x in xs)
     return outs, jnp.reshape(found, (1,))

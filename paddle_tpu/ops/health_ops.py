@@ -1,0 +1,63 @@
+"""Health-sentinel support ops (paddle_tpu/health/, docs/DISTRIBUTED.md
+§6 "Numeric fault tolerance").
+
+Tiny scalar ops the sentinel transpile inserts around the optimizer
+block; the finite check itself is the existing `check_finite_and_unscale`
+AMP op (amp_ops.py), whose reduction lives in `health.detect`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.fluid.registry import simple_op
+
+
+@simple_op("health_check", ["X*"], ["FoundInfinite"], grad=None)
+def _health_check(ctx, xs, attrs):
+    """READ-ONLY fused finite check: one bool [1] scalar, no tensor
+    rewrite.  The sentinel transpile uses this form when dynamic loss
+    scaling is off — `check_finite_and_unscale` would pay a pointless
+    full-size divide-by-1.0 write-back pass over every gradient just to
+    get the same scalar."""
+    from paddle_tpu.health import detect
+
+    return detect.found_inf(xs).astype(bool)
+
+
+@simple_op("health_accum", ["FoundInf", "CumIn"], ["CumOut"], grad=None,
+           inplace={"CumOut": "CumIn"})
+def _health_accum(ctx, found, cum, attrs):
+    """Monotonic bad-step counter: CumOut = CumIn + (found ? 1 : 0).
+    Health-owned state (exempt from the skip gate), so it advances even
+    on masked steps — and survives `run_steps` chains, where only the
+    final iteration's `found_inf` scalar reaches the host."""
+    f = jnp.reshape(found, ()).astype(jnp.float32)
+    c = jnp.reshape(cum, ()).astype(jnp.float32)
+    return jnp.reshape(c + (f > 0).astype(jnp.float32), (1,))
+
+
+@simple_op("health_fault_inject", ["X", "Counter"], ["Out", "CounterOut"],
+           grad=None, inplace={"Out": "X", "CounterOut": "Counter"})
+def _health_fault_inject(ctx, x, counter, attrs):
+    """Deterministic in-step numeric fault (FaultPlan grammar
+    `nan:grad:step:N` / `inf:loss:step:N` / `spike:loss:step:N`): the
+    persistable countdown starts at N and decrements once per executed
+    step of THIS program; the corruption fires exactly when it reads 1.
+    The countdown is health-owned state, so a rollback replay of the
+    fired step sees 0 and stays clean — which is what makes the
+    restore-and-replay recovery path deterministic to test."""
+    c = jnp.reshape(counter, ()).astype(jnp.float32)
+    fire = c == 1.0
+    kind = attrs.get("kind", "nan")
+    if kind == "nan":
+        bad = x + jnp.where(fire, jnp.float32(jnp.nan), jnp.float32(0.0))
+    elif kind == "inf":
+        bad = x + jnp.where(fire, jnp.float32(jnp.inf), jnp.float32(0.0))
+    else:  # spike: multiplicative blow-up, stays finite
+        bad = x * jnp.where(fire,
+                            jnp.float32(attrs.get("spike_scale", 1000.0)),
+                            jnp.float32(1.0))
+    out = bad.astype(x.dtype)
+    c_new = jnp.maximum(c - 1.0, 0.0)
+    return out, jnp.reshape(c_new, (1,)).astype(jnp.float32)

@@ -61,9 +61,14 @@ _cmp("logical_and", jnp.logical_and)
 _cmp("logical_or", jnp.logical_or)
 _cmp("logical_xor", jnp.logical_xor)
 register_op("logical_not", ["X"], ["Out"], lambda ctx, x, attrs: jnp.logical_not(x), grad=None)
-register_op("isfinite", ["X*"], ["Out"],
-            lambda ctx, xs, attrs: jnp.all(jnp.stack([jnp.all(jnp.isfinite(x)) for x in xs])),
-            grad=None)
+def _isfinite(ctx, xs, attrs):
+    # the one audited finite reduction (paddle_tpu/health/detect.py)
+    from paddle_tpu.health import detect
+
+    return detect.all_finite(xs)
+
+
+register_op("isfinite", ["X*"], ["Out"], _isfinite, grad=None)
 
 
 # ---------------------------------------------------------------------------

@@ -568,7 +568,9 @@ class DataParallelRunner:
             self.program = program
             self._gspmd_exec = GSPMDExecutor(
                 program, self.mesh, policy_for(self.mesh),
-                quant_hook=self.quant_grads, quant_algo=quant_algo)
+                quant_hook=self.quant_grads, quant_algo=quant_algo,
+                loss_name=loss_name)
+            self._sentinel = None  # the shared executor owns it there
             self._cache = {}
             return
         # rewrite in place, like the reference's multi-device pass
@@ -578,6 +580,13 @@ class DataParallelRunner:
                                    or getattr(build_strategy, "sync_batch_norm", True) is not False),
             quant_grads=self.quant_grads, quant_algo=quant_algo,
             overlap=overlap, fused_update=fused_update)
+        # health sentinel (FLAGS_health_sentinel, docs/DISTRIBUTED.md §6):
+        # inserted AFTER the bucket pass so detection rides the fused
+        # buckets' wire format (QScale) where they exist
+        from paddle_tpu import health
+
+        self._sentinel = health.attach(self.program, loss_name=loss_name,
+                                       lane="dp")
         self._cache = {}
 
     def _cache_key(self, feed, fetch_names):
@@ -609,10 +618,13 @@ class DataParallelRunner:
                                        return_numpy=return_numpy)
             executor._step += 1
             return out
+        sent = self._sentinel
         key = self._cache_key(feed, fetch_names)
         cb = self._cache.get(key)
         if cb is None:
             _m_cache().labels(path="dp", result="miss").inc()
+            if sent is not None:
+                sent.ensure_state(scope)  # before BlockPlan scope checks
             t0 = _time.perf_counter()
             cb = _ShardedBlock(self.program, feed.keys(), fetch_names, self.mesh, scope)
             self._cache[key] = cb
@@ -620,14 +632,20 @@ class DataParallelRunner:
                 path="dp", phase="trace").inc(_time.perf_counter() - t0)
         else:
             _m_cache().labels(path="dp", result="hit").inc()
-        first_run = not getattr(cb, "_obs_ran", False)
-        t0 = _time.perf_counter()
-        fetches = cb.run(scope, feed, executor._step)
-        step_s = _time.perf_counter() - t0
-        _record_step("dp", step_s, first_run)
-        cb._obs_ran = True
-        self._report_throughput(feed, step_s)
-        executor._step += 1
+        def attempt():
+            first_run = not getattr(cb, "_obs_ran", False)
+            t0 = _time.perf_counter()
+            fetches = cb.run(scope, feed, executor._step)
+            step_s = _time.perf_counter() - t0
+            _record_step("dp", step_s, first_run)
+            cb._obs_ran = True
+            self._report_throughput(feed, step_s)
+            executor._step += 1
+            return fetches
+
+        from paddle_tpu.health import run_guarded
+
+        fetches = run_guarded(sent, scope, fetch_names, attempt)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
@@ -701,7 +719,12 @@ class _ShardedBlock(_JitExecutable):
         self.readonly_names = plan.readonly_names
         self.write_names = plan.write_names
         axis = pmesh.DATA_AXIS
-        inner = plan.make_body(mesh_axes=(axis,))
+        from paddle_tpu.health import wrap_body as _health_gate
+
+        # the health gate sits INSIDE the shard_map: found_inf is
+        # computed from post-allreduce (replica-identical) gradients, so
+        # the masking needs no extra collective
+        inner = _health_gate(program, plan.make_body(mesh_axes=(axis,)))
 
         def body(donated, readonly, feeds, step):
             import jax.numpy as jnp

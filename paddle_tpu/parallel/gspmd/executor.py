@@ -268,6 +268,10 @@ class _GSPMDBlock(_JitExecutable):
         self.wire_bytes_per_step = (self.qplan.wire_bytes_per_step
                                     if self.qplan else 0)
 
+        from paddle_tpu.health import wrap_body as _health_gate
+
+        body = _health_gate(program, body)
+
         def mesh_body(*args):
             # mesh-adaptive lowerings (ring attention) read current_mesh()
             with pmesh.mesh_guard(mesh):
@@ -359,7 +363,7 @@ class GSPMDExecutor:
     def __init__(self, program, mesh, policy=None, scope=None,
                  feed_specs=None, quant_hook=None, quant_block_size=None,
                  quant_algo=None, quant_crossover_kb=None,
-                 quant_impl=None, capture_hlo=True):
+                 quant_impl=None, capture_hlo=True, loss_name=None):
         from paddle_tpu.fluid import flags as _flags
 
         self.program = program
@@ -367,6 +371,14 @@ class GSPMDExecutor:
         self.policy = policy or gspecs.DataParallelPolicy()
         self.feed_specs = dict(feed_specs or {})
         self._default_scope = scope
+        # health sentinel (FLAGS_health_sentinel, docs/DISTRIBUTED.md
+        # §6): transpiled into the program BEFORE any compile — the
+        # check lands in the optimizer leg (post-reduction, global
+        # view), and the gspmd lane's in-graph gate rides wrap_body
+        from paddle_tpu import health
+
+        self._sentinel = health.attach(program, loss_name=loss_name,
+                                       lane="gspmd")
         if quant_hook is None:
             quant_hook = _flags.flag("quant_allreduce")
         self.quant_hook = bool(quant_hook)
@@ -419,11 +431,14 @@ class GSPMDExecutor:
                                                _report_examples)
 
         scope = self._resolve_scope(scope)
+        sent = self._sentinel
         feed, fetch_names, feed_sig = self._prep(feed, fetch_list)
         key = (self.program._version, feed_sig, tuple(fetch_names))
         cb = self._cache.get(key)
         if cb is None:
             _m_cache().labels(path="gspmd", result="miss").inc()
+            if sent is not None:
+                sent.ensure_state(scope)  # before BlockPlan scope checks
             t0 = _time.perf_counter()
             cb = _GSPMDBlock(self, scope, list(feed.keys()), fetch_names,
                              feed_shapes={k: tuple(np.shape(v))
@@ -433,20 +448,26 @@ class GSPMDExecutor:
                 path="gspmd", phase="trace").inc(_time.perf_counter() - t0)
         else:
             _m_cache().labels(path="gspmd", result="hit").inc()
-        first_run = key not in self._ran_keys
-        t0 = _time.perf_counter()
-        fetches = cb.run(scope, feed, self._step)
-        step_s = _time.perf_counter() - t0
-        _record_step("gspmd", step_s, first_run)
-        self._ran_keys.add(key)
-        if cb.wire_bytes_per_step:
-            from ..data_parallel import collective_payload_counter
+        def attempt():
+            first_run = key not in self._ran_keys
+            t0 = _time.perf_counter()
+            fetches = cb.run(scope, feed, self._step)
+            step_s = _time.perf_counter() - t0
+            _record_step("gspmd", step_s, first_run)
+            self._ran_keys.add(key)
+            if cb.wire_bytes_per_step:
+                from ..data_parallel import collective_payload_counter
 
-            collective_payload_counter().labels(
-                collective="c_allreduce_quant").inc(
-                cb.wire_bytes_per_step)
-        _report_examples("gspmd", _feed_batch(feed), step_s)
-        self._step += 1
+                collective_payload_counter().labels(
+                    collective="c_allreduce_quant").inc(
+                    cb.wire_bytes_per_step)
+            _report_examples("gspmd", _feed_batch(feed), step_s)
+            self._step += 1
+            return fetches
+
+        from paddle_tpu.health import run_guarded
+
+        fetches = run_guarded(sent, scope, fetch_names, attempt)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

@@ -222,6 +222,7 @@ class HybridParallelRunner:
             self._gspmd_exec = GSPMDExecutor(
                 program, mesh, policy, scope=scope,
                 feed_specs=self.feed_specs)
+            self._sentinel = None  # the shared executor owns it there
             self._fused_gather = {}
             # capture_hlo/last_hlo stay live on this lane through the
             # properties below (delegated to the executor), so the
@@ -232,6 +233,14 @@ class HybridParallelRunner:
         self._fused_gather = (self._rewrite_fused_updates()
                               if (self.fused_update and self.zero_stage >= 1
                                   and self.zero_gather_quant) else {})
+        # health sentinel (FLAGS_health_sentinel, docs/DISTRIBUTED.md
+        # §6): inserted AFTER the fused-gather rewrite so the check
+        # covers the final optimizer op forms; ZeRO-1 NOTE — snapshots
+        # copy the scope's sharded arrays, so each process holds only
+        # its resident moment shards
+        from paddle_tpu import health
+
+        self._sentinel = health.attach(program, lane="hybrid")
         # capture_hlo=True records the OPTIMIZED (post-GSPMD-partitioner)
         # HLO of the first compiled step in .last_hlo so callers can assert
         # which collectives XLA inserted (the dryrun/driver check does).
@@ -626,9 +635,12 @@ class HybridParallelRunner:
                                                _record_step,
                                                _report_examples)
 
+        sent = self._sentinel
         cb = self._cache.get(key)
         if cb is None:
             _m_cache().labels(path="hybrid", result="miss").inc()
+            if sent is not None:
+                sent.ensure_state(scope)  # before BlockPlan scope checks
             t0 = _time.perf_counter()
             cb = self._compile(scope, list(feed.keys()), fetch_names,
                                n_steps=n_steps, stacked_feed=stacked_feed)
@@ -637,27 +649,38 @@ class HybridParallelRunner:
                 path="hybrid", phase="trace").inc(_time.perf_counter() - t0)
         else:
             _m_cache().labels(path="hybrid", result="hit").inc()
-        first_run = key not in self._ran_keys
-        t0 = _time.perf_counter()
-        fetches = cb(scope, feed, self._step)
-        step_s = _time.perf_counter() - t0
-        _record_step("hybrid", step_s, first_run)
-        zgq_bytes = getattr(cb, "_zgq_bytes_per_step", 0)
-        if zgq_bytes:
-            from .data_parallel import collective_payload_counter
+        # health sentinel at dispatch granularity (one run() step, or one
+        # whole run_steps chain — a rollback restores the pre-chain state
+        # and replays the chain)
+        def attempt():
+            first_run = key not in self._ran_keys
+            t0 = _time.perf_counter()
+            fetches = cb(scope, feed, self._step)
+            step_s = _time.perf_counter() - t0
+            _record_step("hybrid", step_s, first_run)
+            zgq_bytes = getattr(cb, "_zgq_bytes_per_step", 0)
+            if zgq_bytes:
+                from .data_parallel import collective_payload_counter
 
-            collective_payload_counter().labels(
-                collective="zero_gather_quant").inc(zgq_bytes * n_steps)
-        fused_saved = getattr(cb, "_fused_saved_per_step", 0)
-        if fused_saved:
-            from .data_parallel import fused_update_bytes_counter
+                collective_payload_counter().labels(
+                    collective="zero_gather_quant").inc(
+                    zgq_bytes * n_steps)
+            fused_saved = getattr(cb, "_fused_saved_per_step", 0)
+            if fused_saved:
+                from .data_parallel import fused_update_bytes_counter
 
-            fused_update_bytes_counter().inc(fused_saved * n_steps)
-        self._ran_keys.add(key)
-        # stacked_feed: the leading feed axis is the step index, not batch
-        batch = 0 if stacked_feed else _feed_batch(feed) * n_steps
-        _report_examples("hybrid", batch, step_s)
-        self._step += n_steps
+                fused_update_bytes_counter().inc(fused_saved * n_steps)
+            self._ran_keys.add(key)
+            # stacked_feed: leading feed axis is the step index, not batch
+            batch = 0 if stacked_feed else _feed_batch(feed) * n_steps
+            _report_examples("hybrid", batch, step_s)
+            self._step += n_steps
+            return fetches
+
+        from paddle_tpu.health import run_guarded
+
+        fetches = run_guarded(sent, scope, fetch_names, attempt,
+                              chain=n_steps > 1)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
@@ -752,6 +775,12 @@ class HybridParallelRunner:
             inner_body, plain_bytes = self._wrap_zero_gather(inner_body,
                                                              zgq)
             zgq_bytes += plain_bytes
+        # the health gate wraps OUTERMOST (after the gather wrappers, so
+        # a parameter write replaced by a gathered quantized image is
+        # gated too) but INSIDE the chain loop (per-iteration masking)
+        from paddle_tpu.health import wrap_body as _health_gate
+
+        inner_body = _health_gate(program, inner_body)
 
         if chain_mode:
             import jax.numpy as jnp

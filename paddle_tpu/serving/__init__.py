@@ -30,7 +30,8 @@ from . import errors  # noqa: F401
 from . import status  # noqa: F401
 from .batching import BucketPolicy
 from .engine import Engine, model_signature
-from .errors import (FeedValidationError, ModelNotLoadedError, ServingError,
+from .errors import (FeedValidationError, ModelNotLoadedError,
+                     ServingDeadlineError, ServingError,
                      ServingOverloadError)
 from .status import servez_payload
 
@@ -38,5 +39,5 @@ __all__ = [
     "batching", "engine", "errors", "status",
     "Engine", "BucketPolicy", "model_signature", "servez_payload",
     "ServingError", "ServingOverloadError", "ModelNotLoadedError",
-    "FeedValidationError",
+    "FeedValidationError", "ServingDeadlineError",
 ]

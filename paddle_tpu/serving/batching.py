@@ -90,15 +90,20 @@ class Request:
     the latency metric is measured from."""
 
     __slots__ = ("feed", "rows", "tenant", "future", "t_arrival",
-                 "shape_key", "seq_pad")
+                 "shape_key", "seq_pad", "deadline")
 
     def __init__(self, feed, rows, tenant, future, shape_key,
-                 seq_pad=None):
+                 seq_pad=None, deadline_s=0.0):
         self.feed = feed
         self.rows = rows
         self.tenant = tenant
         self.future = future
         self.t_arrival = time.monotonic()
+        # absolute monotonic deadline (FLAGS_serving_deadline_ms): a
+        # request older than this resolves ServingDeadlineError instead
+        # of waiting forever, queued or in flight; None = no deadline
+        self.deadline = (self.t_arrival + deadline_s
+                         if deadline_s and deadline_s > 0 else None)
         # trailing-dims signature AFTER sequence padding: only requests
         # with equal keys can share a batch (concat needs it, and the
         # padded batch must land in one executable signature)

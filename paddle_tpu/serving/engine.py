@@ -36,6 +36,7 @@ import numpy as np
 from .batching import BucketPolicy, Request, assemble_batch, split_outputs, \
     pad_seq
 from .errors import (FeedValidationError, ModelNotLoadedError,
+                     ServingDeadlineError,
                      ServingOverloadError)
 
 __all__ = ["Engine", "model_signature"]
@@ -186,12 +187,14 @@ def model_signature(program, feed_names, fetch_names):
 class _ModelLane:
     """One served model: predictor + bounded queue + scheduler thread."""
 
-    def __init__(self, name, predictor, policy, max_wait_s, max_queue):
+    def __init__(self, name, predictor, policy, max_wait_s, max_queue,
+                 deadline_s=0.0):
         self.name = name
         self.predictor = predictor
         self.policy = policy
         self.max_wait_s = max_wait_s
         self.max_queue = max_queue
+        self.deadline_s = float(deadline_s or 0.0)
         self.signature = model_signature(predictor._program,
                                          predictor.get_input_names(),
                                          predictor.get_output_names())
@@ -318,7 +321,8 @@ class _ModelLane:
         self._batch_size = _m_batch_size().labels(model=name)
         self._queue_depth = _m_queue_depth().labels(model=name)
         self._rejected = {r: _m_rejected().labels(model=name, reason=r)
-                          for r in ("overload", "closed", "invalid")}
+                          for r in ("overload", "closed", "invalid",
+                                    "deadline")}
         self._rows = {k: _m_rows().labels(model=name, kind=k)
                       for k in ("real", "padding")}
         self._exec_cache = {r: _m_exec_cache().labels(model=name, result=r)
@@ -498,7 +502,8 @@ class _ModelLane:
             if tenant not in self._tenant_requests and \
                     len(self._tenant_requests) >= _MAX_TENANT_LABELS:
                 tenant = "__other__"
-            req = Request(padded, rows, tenant, fut, key, seq_pad)
+            req = Request(padded, rows, tenant, fut, key, seq_pad,
+                          deadline_s=self.deadline_s)
             self._queue.append(req)
             self._queued_rows[key] += rows
             self._queue_depth.set(len(self._queue))
@@ -524,23 +529,81 @@ class _ModelLane:
     def _matching_rows(self, key):
         return self._queued_rows[key]  # missing key reads 0, no insert
 
+    def _expire_queued(self):
+        """Under _cv: resolve every queued request past its per-request
+        deadline with a typed ServingDeadlineError (booked as
+        reason="deadline") and drop it from the queue — a stale request
+        must neither wait forever behind other shape keys nor burn a
+        device dispatch its caller already gave up on."""
+        if self.deadline_s <= 0:
+            return
+        now = time.monotonic()
+        if not any(r.deadline is not None and now > r.deadline
+                   for r in self._queue):
+            return
+        kept = collections.deque()
+        for r in self._queue:
+            if r.deadline is None or now <= r.deadline:
+                kept.append(r)
+                continue
+            left = self._queued_rows[r.shape_key] - r.rows
+            if left > 0:
+                self._queued_rows[r.shape_key] = left
+            else:
+                self._queued_rows.pop(r.shape_key, None)
+            self._rejected["deadline"].inc()
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(ServingDeadlineError(
+                    f"model {self.name!r}: request exceeded its "
+                    f"{self.deadline_s * 1000:.0f} ms deadline while "
+                    f"queued (FLAGS_serving_deadline_ms)"))
+        self._queue = kept
+        self._queue_depth.set(len(self._queue))
+
     def _take_batch(self):
         """Pop the next batch: FIFO head anchors the shape key; requests
         sharing it join until the largest bucket fills or the head's
-        max-wait deadline passes.  Other shape keys stay queued."""
+        max-wait deadline passes.  Other shape keys stay queued; queued
+        requests past their per-request deadline expire typed."""
         with self._cv:
-            while not self._queue and not self._closed:
-                self._cv.wait()
-            if not self._queue:
-                return None  # closed and drained
-            head = self._queue[0]
-            deadline = head.t_arrival + self.max_wait_s
-            while (self._matching_rows(head.shape_key)
-                   < self.policy.max_rows):
-                remaining = deadline - time.monotonic()
-                if remaining <= 0 or self._closed:
+            while True:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue:
+                    return None  # closed and drained
+                self._expire_queued()
+                if not self._queue:
+                    if self._closed:
+                        return None
+                    continue
+                head = self._queue[0]
+                deadline = head.t_arrival + self.max_wait_s
+                if head.deadline is not None:
+                    # a deadline-bearing head must not spend its whole
+                    # budget waiting for batch-mates (a lone request
+                    # with deadline < max_wait would otherwise be held
+                    # the full max_wait and then burn a device dispatch
+                    # on a result only the in-flight check could
+                    # discard): wait at most HALF the deadline window,
+                    # leaving the other half for execution
+                    deadline = min(deadline,
+                                   head.t_arrival + self.deadline_s / 2)
+                while (self._matching_rows(head.shape_key)
+                       < self.policy.max_rows):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._closed:
+                        break
+                    self._cv.wait(timeout=remaining)
+                # the wait may have outlived some deadlines (including
+                # the head's): expire now, and re-anchor if the head
+                # itself is gone
+                self._expire_queued()
+                if not self._queue:
+                    if self._closed:
+                        return None
+                    continue
+                if self._queue[0] is head:
                     break
-                self._cv.wait(timeout=remaining)
             batch, rows, rest = [], 0, collections.deque()
             for r in self._queue:
                 if (r.shape_key == head.shape_key
@@ -618,6 +681,18 @@ class _ModelLane:
             return
         now = time.monotonic()
         for r, out in zip(batch, per_req):
+            if (not warmup and r.deadline is not None
+                    and now > r.deadline):
+                # in-flight deadline miss: the result exists but the
+                # caller's budget is spent — resolve typed (and book it)
+                # rather than hand back an answer it stopped waiting for
+                self._rejected["deadline"].inc()
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(ServingDeadlineError(
+                        f"model {self.name!r}: request exceeded its "
+                        f"{self.deadline_s * 1000:.0f} ms deadline in "
+                        f"flight (FLAGS_serving_deadline_ms)"))
+                continue
             if r.future.set_running_or_notify_cancel():
                 r.future.set_result(out)
             if not warmup:
@@ -912,7 +987,7 @@ class Engine:
 
     def __init__(self, models=None, batch_buckets=None, seq_buckets=None,
                  max_wait_ms=None, max_queue=None, name="engine",
-                 auto_start=True):
+                 auto_start=True, deadline_ms=None):
         from paddle_tpu.fluid import flags as _flags
 
         self.name = name
@@ -924,6 +999,11 @@ class Engine:
                               if max_queue is None else max_queue)
         if self._max_queue < 1:
             raise ValueError("max_queue must be >= 1")
+        # per-request deadline (0 = off): queued or in-flight requests
+        # past it resolve ServingDeadlineError instead of waiting forever
+        self._deadline_s = (
+            _flags.flag("serving_deadline_ms")
+            if deadline_ms is None else deadline_ms) / 1000.0
         self._lanes = {}
         # serializes lane-map mutation against lifecycle transitions and
         # snapshots: load_model() from one thread must not race a
@@ -978,7 +1058,7 @@ class Engine:
                 f"model must be a dir, AnalysisConfig or "
                 f"AnalysisPredictor; got {type(model).__name__}")
         lane = _ModelLane(name, predictor, self.policy, self._max_wait_s,
-                          self._max_queue)
+                          self._max_queue, deadline_s=self._deadline_s)
         # pt_serve_* series are keyed by model name: a second engine in
         # this process serving the same name would alias its series (and
         # /servez stats) onto this one — warn, don't corrupt silently

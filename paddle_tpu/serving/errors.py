@@ -10,7 +10,7 @@ opaque trace/compile failure attributed to the wrong request).
 from __future__ import annotations
 
 __all__ = ["ServingError", "ServingOverloadError", "ModelNotLoadedError",
-           "FeedValidationError"]
+           "FeedValidationError", "ServingDeadlineError"]
 
 
 class ServingError(RuntimeError):
@@ -36,3 +36,12 @@ class ModelNotLoadedError(ServingError, KeyError):
 class FeedValidationError(ServingError, ValueError):
     """Request feed failed the edge validation (names, dtypes, shapes,
     row consistency) against the model's static program signature."""
+
+
+class ServingDeadlineError(ServingError, TimeoutError):
+    """The request outlived its per-request deadline
+    (FLAGS_serving_deadline_ms / Engine(deadline_ms=...)) while queued
+    or in flight; its future resolves with THIS instead of waiting
+    forever.  Booked as ``pt_serve_rejected_total{reason="deadline"}``
+    — a load-shedding signal like the overload rejection, but measured
+    in wall time rather than queue depth."""

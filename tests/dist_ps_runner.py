@@ -231,29 +231,52 @@ def run_trainer_elastic(tid, endpoints, n_trainers, opt_name, out_path):
     with scope_guard(Scope()):
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)  # ps_init_sync: pull + elastic JOIN + heartbeat
-        while True:
-            info = elastic.membership(eps[0])
-            rnd, count, index = info["round"], info["count"], info["index"]
-            if rnd >= N_STEPS:
-                break
-            fault_injection.on_step(rnd + 1)  # preempt:step fires HERE
-            if drain.requested.is_set() and not leaving:
-                # drain: announce LEAVE now — before this round's send,
-                # so it applies at THIS round's boundary; feed the
-                # announced round, then exit
-                elastic.leave_job(eps)
-                leaving = True
-            per = GLOBAL_BATCH // count
-            sub = {k: v[index * per:(index + 1) * per]
-                   for k, v in batches[rnd].items()}
-            (lv,) = exe.run(trainer_prog, feed=sub, fetch_list=[loss.name])
-            losses.append(float(np.asarray(lv)))
-            counts.append(count)
-            rounds_run.append(rnd)
-            if leaving:
-                break
-            if step_delay:
-                _time.sleep(step_delay)
+        # round-partitioned input stream through the library prefetcher
+        # (fluid.prefetch, ROADMAP elastic phase 2): the membership view
+        # is applied at CONSUME time — each popped batch is sliced by
+        # the epoch view of the round that actually feeds it, so an
+        # elastic resize re-partitions the stream at the next round
+        # instead of replaying slices a stale view produced ahead
+        from paddle_tpu.fluid.prefetch import DatasetPrefetcher
+
+        view = {"index": -1, "count": 1}
+        start_rnd = elastic.membership(eps[0])["round"]
+        pf = DatasetPrefetcher(
+            iter(batches[start_rnd:]), depth=1,
+            partition=lambda: (view["index"], view["count"]),
+            partition_stage="consume")
+        next_rnd = start_rnd
+        try:
+            while True:
+                info = elastic.membership(eps[0])
+                rnd, count, index = (info["round"], info["count"],
+                                     info["index"])
+                if rnd >= N_STEPS:
+                    break
+                fault_injection.on_step(rnd + 1)  # preempt:step fires HERE
+                if drain.requested.is_set() and not leaving:
+                    # drain: announce LEAVE now — before this round's
+                    # send, so it applies at THIS round's boundary; feed
+                    # the announced round, then exit
+                    elastic.leave_job(eps)
+                    leaving = True
+                view["index"], view["count"] = index, count
+                while next_rnd < rnd:  # round advanced without us: skip
+                    next(pf)
+                    next_rnd += 1
+                sub = next(pf)
+                next_rnd += 1
+                (lv,) = exe.run(trainer_prog, feed=sub,
+                                fetch_list=[loss.name])
+                losses.append(float(np.asarray(lv)))
+                counts.append(count)
+                rounds_run.append(rnd)
+                if leaving:
+                    break
+                if step_delay:
+                    _time.sleep(step_delay)
+        finally:
+            pf.close()
         finals = {}
         if not leaving:
             finals = {p: dist_ops.get_channel(ep).client.get_param(p)

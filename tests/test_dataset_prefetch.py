@@ -319,3 +319,40 @@ def test_prefetcher_pending_member_replays_full_batch():
         partition=lambda: (-1, 3))
     (b,) = list(pf)
     assert b["x"].shape == (6, 2)
+
+
+def test_prefetcher_consume_stage_partitions_with_live_view():
+    """partition_stage="consume": the slice happens at __next__ time
+    with the view of the round that consumes the batch — an elastic
+    resize re-partitions the very next pop, with NO one-batch lag (the
+    sync PS elastic loop's correctness requirement; produce-stage
+    slicing may run up to `depth` batches ahead of the epoch flip)."""
+    from paddle_tpu.fluid.prefetch import DatasetPrefetcher
+
+    view = {"v": (0, 2)}
+    pf = DatasetPrefetcher(
+        iter([{"x": np.full((12, 1), i, dtype="float32")}
+              for i in range(3)]),
+        depth=2,  # producer buffers AHEAD — stale under produce-stage
+        partition=lambda: view["v"], partition_stage="consume")
+    it = iter(pf)
+    b0 = next(it)
+    assert b0["x"].shape == (6, 1)  # index 0 of 2
+    view["v"] = (2, 3)  # resize BETWEEN pops: applies to the NEXT pop
+    b1 = next(it)
+    assert b1["x"].shape == (4, 1)  # index 2 of 3: rows [8, 12)
+    assert float(b1["x"][0, 0]) == 1.0  # batch 1, sliced by the NEW view
+    view["v"] = (-1, 3)  # pending member: full batch replays
+    b2 = next(it)
+    assert b2["x"].shape == (12, 1)
+    assert pf.repartitions >= 2
+    pf.close()
+
+
+def test_prefetcher_partition_stage_validated():
+    import pytest
+
+    from paddle_tpu.fluid.prefetch import DatasetPrefetcher
+
+    with pytest.raises(ValueError, match="partition_stage"):
+        DatasetPrefetcher(iter([]), partition_stage="middle")

@@ -1,0 +1,558 @@
+"""Training health sentinel (paddle_tpu/health/, ISSUE 10): on-device
+NaN/Inf detection, in-graph skip gating, rollback+replay, dynamic loss
+scaling, the FaultPlan numeric grammar, and the pt_health_* metrics —
+fast single-process coverage.  The per-lane multi-device acceptance
+lives in tests/test_health_lanes.py (slow)."""
+
+import cpu_mesh  # noqa: F401  (must precede any jax import)
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import fault_injection
+from paddle_tpu.distributed.fault_injection import FaultPlan
+from paddle_tpu.fluid.executor import Scope, global_scope, scope_guard
+from paddle_tpu.health import (FOUND_INF_VAR, LOSS_SCALE_VAR, detect,
+                               insert_health_sentinel)
+from paddle_tpu.health.transpile import BAD_TOTAL_VAR
+
+N_STEPS = 8
+BAD_STEP = 3  # 1-based
+
+
+@pytest.fixture
+def health_flags():
+    """Arm the sentinel for one test; restore every health flag after."""
+    names = ["FLAGS_health_sentinel", "FLAGS_health_action",
+             "FLAGS_health_rollback_keep", "FLAGS_health_spike_zscore",
+             "FLAGS_health_spike_warmup", "FLAGS_health_loss_scaling",
+             "FLAGS_health_loss_scale_init",
+             "FLAGS_health_scale_growth_steps"]
+    prior = fluid.get_flags(names)
+
+    def arm(**kw):
+        fluid.set_flags({"FLAGS_health_sentinel": True, **kw})
+
+    yield arm
+    fluid.set_flags(prior)
+    fault_injection.uninstall()
+
+
+def _build(opt="sgd", lr=0.05):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        mk = {"sgd": lambda: fluid.optimizer.SGD(learning_rate=lr),
+              "adam": lambda: fluid.optimizer.Adam(learning_rate=lr)}
+        mk[opt]().minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n=N_STEPS, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.uniform(-1, 1, (4, 1)).astype("float32")
+    out = []
+    for _ in range(n):
+        xb = rng.uniform(-1, 1, (batch, 4)).astype("float32")
+        out.append({"x": xb, "y": xb @ w})
+    return out
+
+
+def _train(opt="sgd", plan=None, fetch_loss=True, n=N_STEPS):
+    """One single-device training run; returns (losses, scope reads)."""
+    if plan:
+        fault_injection.install(plan)
+    else:
+        fault_injection.uninstall()
+    main, startup, loss = _build(opt)
+    rec = {"losses": [], "scales": []}
+    try:
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            sc = global_scope()
+            for b in _batches(n):
+                fetches = [loss.name] if fetch_loss else []
+                out = exe.run(main, feed=b, fetch_list=fetches)
+                if fetch_loss:
+                    rec["losses"].append(float(np.asarray(out[0])))
+                if sc.get(LOSS_SCALE_VAR) is not None:
+                    rec["scales"].append(
+                        float(np.asarray(sc.get(LOSS_SCALE_VAR))[0]))
+            rec["params"] = {
+                p: np.asarray(sc.get(p)).copy()
+                for p in ("fc_0.w_0", "fc_0.b_0")}
+            rec["bad_total"] = (
+                float(np.asarray(sc.get(BAD_TOTAL_VAR)).ravel()[0])
+                if sc.get(BAD_TOTAL_VAR) is not None else None)
+    finally:
+        fault_injection.uninstall()
+    return rec
+
+
+def _bad_step_samples():
+    from paddle_tpu import observability as obs
+
+    fam = obs.REGISTRY.snapshot().get("pt_health_bad_steps_total")
+    return dict(fam["samples"]) if fam else {}
+
+
+# ---------------------------------------------------------------------------
+# detect: the one audited implementation
+# ---------------------------------------------------------------------------
+
+
+def test_detect_all_finite_reduces_to_one_scalar():
+    import jax.numpy as jnp
+
+    ok = detect.all_finite([jnp.ones((4, 4)), jnp.zeros(3)])
+    assert ok.shape == () and bool(ok)
+    bad = detect.all_finite([jnp.ones(3), jnp.array([1.0, np.nan])])
+    assert not bool(bad)
+    assert not bool(detect.all_finite([jnp.array([np.inf])]))
+    # non-float and non-array inputs are ignored; empty set is finite
+    assert bool(detect.all_finite([jnp.arange(3), None, "str"]))
+    assert bool(detect.all_finite([]))
+    f = detect.found_inf([jnp.array([np.nan])])
+    assert f.shape == (1,) and float(f[0]) == 1.0
+
+
+def test_detect_host_scan_raises_naming_variable():
+    with pytest.raises(RuntimeError, match="bad_var.*NaN/Inf"):
+        detect.host_scan([("ok", np.ones(2)),
+                          ("bad_var", np.array([np.nan]))], "label")
+    detect.host_scan([("ints", np.arange(3))], "label")  # no-op
+
+
+def test_check_nan_inf_flag_still_fail_fast():
+    """The classic FLAGS_check_nan_inf contract survives the thin-wrapper
+    refactor: detect-and-crash, naming the variable."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        out = fluid.layers.log(x)  # log(-1) = nan
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            with pytest.raises(RuntimeError, match="check_nan_inf"):
+                exe.run(main, feed={"x": -np.ones((1, 2), "float32")},
+                        fetch_list=[out.name])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan numeric grammar
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_numeric_grammar_parses():
+    plan = FaultPlan("nan:grad:step:4;inf:loss:step:2;"
+                     "spike:loss:step:7:250;drop:send_grad:1")
+    rules = plan.numeric_rules()
+    assert rules == [
+        {"kind": "nan", "target": "grad", "step": 4, "scale": None},
+        {"kind": "inf", "target": "loss", "step": 2, "scale": None},
+        {"kind": "spike", "target": "loss", "step": 7, "scale": 250.0},
+    ]
+    # numeric rules never fire from the runtime hooks (the co-installed
+    # drop: rule still does — numeric parsing must not mask RPC rules)
+    plan.on_step(4)
+    plan.on_round(4)
+    with pytest.raises(IOError):
+        plan.on_rpc("send_grad")  # the drop: rule, n=1
+    for _ in range(5):
+        plan.on_rpc("send_grad")
+    assert plan._counts["send_grad"] == 6
+
+
+@pytest.mark.parametrize("spec", [
+    "nan:grad:round:4",      # only step-targeted
+    "nan:param:step:4",      # unknown target
+    "spike:loss:step",       # missing count
+    "nan:grad:step:4:1:2",   # too many fields
+])
+def test_fault_plan_numeric_grammar_rejects(spec):
+    with pytest.raises(ValueError, match="bad fault rule"):
+        FaultPlan(spec)
+
+
+def test_quantize_propagates_nonfinite_blocks():
+    """The wire format must carry a NaN/Inf into its fp32 scales — a
+    `where(amax > 0)` guard used to launder NaN blocks into finite
+    garbage at scale 1.0 (the silent-poisoning class the sentinel's
+    QScale detection point relies on)."""
+    from paddle_tpu.kernels.quantized_collectives import (
+        dequantize_block_scaled, quantize_block_scaled)
+
+    x = np.ones(256, np.float32)
+    x[7] = np.nan
+    hi, lo, sc = quantize_block_scaled(x, block_size=64)
+    assert not bool(detect.all_finite([sc]))
+    out = dequantize_block_scaled(hi, lo, sc, block_size=64)
+    assert not bool(detect.all_finite([out]))
+    x[7] = np.inf
+    _hi, _lo, sc = quantize_block_scaled(x, block_size=64)
+    assert not bool(detect.all_finite([sc]))
+    # clean payloads (including all-zero blocks) stay exact
+    z = np.zeros(128, np.float32)
+    hi, lo, sc = quantize_block_scaled(z, block_size=64)
+    out = dequantize_block_scaled(hi, lo, sc, block_size=64)
+    np.testing.assert_array_equal(np.asarray(out), z)
+
+
+# ---------------------------------------------------------------------------
+# the transpile
+# ---------------------------------------------------------------------------
+
+
+def test_insert_health_sentinel_program_shape(health_flags):
+    main, _startup, loss = _build()
+    plan = insert_health_sentinel(main, loss_name=loss.name)
+    ops = main.global_block().ops
+    types = [op.type for op in ops]
+    # loss scaling off -> the READ-ONLY check form (no pointless
+    # divide-by-1.0 write-back pass over every gradient)
+    assert "health_check" in types
+    assert "check_finite_and_unscale" not in types
+    assert "health_accum" in types
+    check_at = types.index("health_check")
+    first_opt = next(i for i, op in enumerate(ops)
+                     if op.attrs.get("op_role") == "optimize"
+                     and "Grad" in op.inputs)
+    assert check_at < first_opt
+    check = ops[check_at]
+    assert check.outputs["FoundInfinite"] == [FOUND_INF_VAR]
+    # the check covers exactly the optimizer-consumed gradients
+    assert set(check.inputs["X"]) == set(plan["check_inputs"])
+    assert plan["loss_var"] == loss.name
+    found = main.global_block().var(FOUND_INF_VAR)
+    assert found.persistable
+    # idempotent: a second attach returns the same plan, no duplicates
+    assert insert_health_sentinel(main) is plan
+    assert [op.type for op in main.global_block().ops].count(
+        "health_accum") == 1
+
+
+def test_numeric_fault_injection_plants_ops(health_flags):
+    """Numeric FaultPlan rules become in-graph health_fault_inject ops,
+    one per rule, each with its own persistable countdown."""
+    health_flags()
+    fault_injection.install("nan:grad:step:2;spike:loss:step:5")
+    main, _startup, loss = _build()
+    plan = insert_health_sentinel(main, loss_name=loss.name)
+    ops = main.global_block().ops
+    types = [op.type for op in ops]
+    assert types.count("health_fault_inject") == 2
+    assert len(plan["injected"]) == 2
+    kinds = {r["kind"]: r for r in plan["injected"]}
+    assert kinds["nan"]["target_var"].endswith("@GRAD")
+    assert kinds["spike"]["target_var"] == loss.name
+    for r in plan["injected"]:
+        assert main.global_block().has_var(r["counter"])
+        assert float(plan["state"][r["counter"]][0]) == r["step"]
+
+
+def test_insert_health_sentinel_skips_programs_without_optimizer():
+    main, startup, _loss = _build()
+    assert insert_health_sentinel(startup) is None
+    infer = fluid.Program()
+    with fluid.program_guard(infer, fluid.Program()), \
+            fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(x, size=1)
+    assert insert_health_sentinel(infer) is None
+
+
+def test_loss_scaling_wires_seed_scale_and_update_op(health_flags):
+    health_flags(FLAGS_health_loss_scaling=True)
+    main, _startup, loss = _build()
+    insert_health_sentinel(main, loss_name=loss.name)
+    ops = main.global_block().ops
+    types = [op.type for op in ops]
+    assert "update_loss_scaling" in types
+    # the backward seed is multiplied by the live scale
+    seed = loss.name + "@GRAD"
+    scale_ops = [op for op in ops if op.type == "scale"
+                 and op.inputs.get("ScaleTensor") == [LOSS_SCALE_VAR]
+                 and op.inputs.get("X") == [seed]]
+    assert len(scale_ops) == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end (single-device lane; multi-device lanes in test_health_lanes)
+# ---------------------------------------------------------------------------
+
+
+def test_skip_masks_update_and_training_continues(health_flags):
+    health_flags(FLAGS_health_action="skip")
+    before = _bad_step_samples().get(("grad", "skip"), 0.0)
+    rec = _train(plan=f"nan:grad:step:{BAD_STEP}")
+    assert all(np.isfinite(rec["losses"]))
+    for v in rec["params"].values():
+        assert np.isfinite(v).all()
+    assert rec["bad_total"] == 1.0
+    assert _bad_step_samples()[("grad", "skip")] == before + 1.0
+
+
+def test_skip_step_params_bitwise_unchanged(health_flags):
+    """The in-graph gate is a TRUE skip: params, moments and beta-pows
+    of the bad step are bit-identical to the pre-step state."""
+    health_flags(FLAGS_health_action="skip")
+    fault_injection.install(f"nan:grad:step:{BAD_STEP}")
+    main, startup, loss = _build("adam")
+    try:
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            sc = global_scope()
+            state_names = None
+            for i, b in enumerate(_batches(4)):
+                if i + 1 == BAD_STEP:
+                    state_names = [
+                        n for n, v in
+                        main.global_block().vars.items()
+                        if v.persistable and not n.startswith("@HEALTH@")
+                        and sc.get(n) is not None]
+                    pre = {n: np.asarray(sc.get(n)).copy()
+                           for n in state_names}
+                exe.run(main, feed=b, fetch_list=[loss.name])
+                if i + 1 == BAD_STEP:
+                    assert float(np.asarray(
+                        sc.get(FOUND_INF_VAR)).ravel()[0]) == 1.0
+                    for n in state_names:
+                        np.testing.assert_array_equal(
+                            pre[n], np.asarray(sc.get(n)),
+                            err_msg=f"{n} changed on a skipped step")
+                else:
+                    assert float(np.asarray(
+                        sc.get(FOUND_INF_VAR)).ravel()[0]) == 0.0
+    finally:
+        fault_injection.uninstall()
+
+
+def test_raise_action_preserves_fail_fast(health_flags):
+    health_flags(FLAGS_health_action="raise")
+    with pytest.raises(RuntimeError, match="health sentinel"):
+        _train(plan=f"nan:grad:step:{BAD_STEP}")
+
+
+def test_rollback_replays_to_bitexact_parity(health_flags):
+    """rollback restores the pre-step snapshot and replays the same
+    feed; the injection countdown already fired, so the replay is clean
+    and the whole run matches the uninjected baseline bit-exactly."""
+    health_flags(FLAGS_health_action="skip")
+    base = _train()
+    health_flags(FLAGS_health_action="rollback")
+    before = _bad_step_samples().get(("grad", "rollback"), 0.0)
+    rb = _train(plan=f"nan:grad:step:{BAD_STEP}")
+    np.testing.assert_array_equal(base["losses"], rb["losses"])
+    for p in base["params"]:
+        np.testing.assert_array_equal(base["params"][p],
+                                      rb["params"][p])
+    assert _bad_step_samples()[("grad", "rollback")] == before + 1.0
+    from paddle_tpu import observability as obs
+
+    assert obs.REGISTRY.snapshot()[
+        "pt_health_rollbacks_total"]["samples"][()] >= 1.0
+
+
+def test_inf_loss_detected_by_host_loss_detector(health_flags):
+    """inf:loss corrupts the loss value only — the gradient path stays
+    clean (found_inf never fires) and the host-side loss detector books
+    kind="loss"."""
+    health_flags(FLAGS_health_action="skip")
+    before = _bad_step_samples().get(("loss", "skip"), 0.0)
+    rec = _train(plan=f"inf:loss:step:{BAD_STEP}")
+    assert not np.isfinite(rec["losses"][BAD_STEP - 1])
+    assert np.isfinite(rec["losses"][BAD_STEP]).all()
+    assert rec["bad_total"] == 0.0  # the in-graph grad check never fired
+    assert _bad_step_samples()[("loss", "skip")] == before + 1.0
+
+
+def test_spike_detector_books_spike_kind(health_flags):
+    health_flags(FLAGS_health_action="skip",
+                 FLAGS_health_spike_zscore=4.0,
+                 FLAGS_health_spike_warmup=3)
+    before = _bad_step_samples().get(("spike", "skip"), 0.0)
+    rec = _train(plan="spike:loss:step:7:1000")
+    assert rec["losses"][6] > 100 * max(rec["losses"][:6])
+    assert _bad_step_samples()[("spike", "skip")] == before + 1.0
+
+
+def test_dynamic_loss_scaling_halves_and_grows(health_flags):
+    health_flags(FLAGS_health_action="skip",
+                 FLAGS_health_loss_scaling=True,
+                 FLAGS_health_loss_scale_init=1024.0,
+                 FLAGS_health_scale_growth_steps=3)
+    rec = _train(plan=f"nan:grad:step:{BAD_STEP}")
+    scales = rec["scales"]
+    # halved ON the bad step; doubles after every 3 consecutive good ones
+    assert scales[BAD_STEP - 1] == scales[BAD_STEP - 2] / 2
+    assert scales[-1] > scales[BAD_STEP - 1]
+    assert all(np.isfinite(rec["losses"]))
+    from paddle_tpu import observability as obs
+
+    gauge = obs.REGISTRY.snapshot()["pt_health_loss_scale"]["samples"]
+    assert gauge[("single",)] == scales[-1]
+
+
+def test_loss_scaling_matches_unscaled_training(health_flags):
+    """Scaling the seed and unscaling at the optimizer edge is
+    numerically neutral on clean fp32 steps (exact powers of two)."""
+    health_flags()
+    base = _train()
+    health_flags(FLAGS_health_loss_scaling=True,
+                 FLAGS_health_loss_scale_init=256.0,
+                 FLAGS_health_scale_growth_steps=10 ** 6)
+    scaled = _train()
+    np.testing.assert_allclose(base["losses"], scaled["losses"],
+                               rtol=0, atol=1e-6)
+
+
+def test_sentinel_off_is_no_op():
+    """Flag off: no @HEALTH@ vars, no program rewrite, no metrics."""
+    fault_injection.uninstall()
+    main, _startup, _loss = _build()
+    from paddle_tpu import health
+
+    assert health.attach(main) is None
+    assert getattr(main, "_health_plan", None) is None
+    assert not any(n.startswith("@HEALTH@")
+                   for n in main.global_block().vars)
+
+
+def test_run_steps_chain_masks_midchain_bad_step(health_flags):
+    """A bad step inside an on-device fori_loop chain: masked in-graph
+    at its own iteration, counted via the cumulative counter even
+    though only the final step's found_inf reaches the host."""
+    health_flags(FLAGS_health_action="skip")
+    fault_injection.install("nan:grad:step:2")
+    main, startup, loss = _build()
+    try:
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            sc = global_scope()
+            b = _batches(1)[0]
+            out = exe.run_steps(main, feed=b, n_steps=4,
+                                fetch_list=[loss.name])
+            assert np.isfinite(np.asarray(out[0])).all()
+            assert float(np.asarray(
+                sc.get(BAD_TOTAL_VAR)).ravel()[0]) == 1.0
+            # final iteration was clean, so the last found_inf is 0
+            assert float(np.asarray(
+                sc.get(FOUND_INF_VAR)).ravel()[0]) == 0.0
+            for p in ("fc_0.w_0", "fc_0.b_0"):
+                assert np.isfinite(np.asarray(sc.get(p))).all()
+    finally:
+        fault_injection.uninstall()
+
+
+def test_fresh_sentinel_syncs_to_persisted_bad_total(health_flags):
+    """A sentinel created against a scope with prior bad-step history
+    (new Executor on the same scope after a real bad step) must sync its
+    cumulative-counter baseline instead of reading the persisted total
+    as a delta — a clean chain would otherwise book a phantom bad step
+    (and spuriously raise/rollback under those actions)."""
+    health_flags(FLAGS_health_action="skip")
+    fault_injection.install(f"nan:grad:step:{BAD_STEP}")
+    main, startup, loss = _build()
+    before = _bad_step_samples().get(("grad", "skip"), 0.0)
+    try:
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            sc = global_scope()
+            for b in _batches(BAD_STEP):  # run THROUGH the bad step
+                exe.run(main, feed=b, fetch_list=[loss.name])
+            assert float(np.asarray(
+                sc.get(BAD_TOTAL_VAR)).ravel()[0]) == 1.0
+            assert _bad_step_samples()[("grad", "skip")] == before + 1.0
+            fault_injection.uninstall()
+            # a FRESH executor (new sentinel) on the same scope: a clean
+            # chain must not re-book the persisted total as new events
+            exe2 = fluid.Executor(fluid.CPUPlace())
+            b = _batches(1)[0]
+            out = exe2.run_steps(main, feed=b, n_steps=2,
+                                 fetch_list=[loss.name])
+            assert np.isfinite(np.asarray(out[0])).all()
+            assert _bad_step_samples()[("grad", "skip")] == before + 1.0
+    finally:
+        fault_injection.uninstall()
+
+
+def test_on_device_detection_proven_in_hlo(health_flags):
+    """The detection is an in-graph is-finite reduction feeding the
+    found_inf output — proven from the compiled HLO, not inferred from
+    behavior (the acceptance's no-host-scan requirement)."""
+    health_flags(FLAGS_health_action="skip")
+    main, startup, loss = _build()
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        b = _batches(1)[0]
+        exe.run(main, feed=b, fetch_list=[loss.name])
+        (cb,) = exe.compiled_for(main)
+        feed = exe._coerce_feed(main, b)
+        hlo = cb._jitted.lower(
+            *cb._jit_args(global_scope(), feed, 0)).compile().as_text()
+    assert "is-finite" in hlo
+    assert FOUND_INF_VAR in cb.write_names
+
+
+def test_health_flags_roundtrip():
+    from paddle_tpu.fluid import flags as fl
+
+    defaults = {
+        "health_sentinel": False, "health_action": "skip",
+        "health_rollback_keep": 2, "health_spike_zscore": 6.0,
+        "health_spike_warmup": 8, "health_loss_scaling": False,
+        "health_loss_scale_init": 65536.0,
+        "health_scale_growth_steps": 1000,
+        "serving_deadline_ms": 0,
+    }
+    for name, want in defaults.items():
+        assert fl.get_flags(name)[name] == want, name
+    try:
+        fl.set_flags({"FLAGS_health_sentinel": "1",  # str parses
+                      "FLAGS_health_action": "rollback",
+                      "FLAGS_health_rollback_keep": 5,
+                      "FLAGS_health_spike_zscore": "3.5",
+                      "FLAGS_serving_deadline_ms": "750"})
+        got = fl.get_flags(["health_sentinel", "health_action",
+                            "health_rollback_keep",
+                            "health_spike_zscore",
+                            "serving_deadline_ms"])
+        assert got == {"health_sentinel": True,
+                       "health_action": "rollback",
+                       "health_rollback_keep": 5,
+                       "health_spike_zscore": 3.5,
+                       "serving_deadline_ms": 750}
+    finally:
+        fl.set_flags({"FLAGS_" + k: v for k, v in defaults.items()})
+
+
+def test_health_env_bootstrap(monkeypatch):
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    monkeypatch.setenv("FLAGS_health_sentinel", "1")
+    monkeypatch.setenv("FLAGS_health_action", "rollback")
+    monkeypatch.setenv("FLAGS_serving_deadline_ms", "250")
+    importlib.reload(fl)
+    assert fl.get_flags("health_sentinel")["health_sentinel"] is True
+    assert fl.get_flags("health_action")["health_action"] == "rollback"
+    assert fl.get_flags("serving_deadline_ms")[
+        "serving_deadline_ms"] == 250
+    monkeypatch.delenv("FLAGS_health_sentinel")
+    monkeypatch.delenv("FLAGS_health_action")
+    monkeypatch.delenv("FLAGS_serving_deadline_ms")
+    importlib.reload(fl)  # restore defaults for other tests

@@ -1,0 +1,205 @@
+"""Health-sentinel acceptance per parallel lane (ISSUE 10): injected
+NaN at step 4 on the DP transpiler lane (quantized buckets), the hybrid
+ZeRO-1 lane, and the GSPMD executor lane — detection within the bad
+step, `skip` and `rollback` recover to <=1e-3 loss parity with the
+uninjected 20-step baseline, `raise` preserves the fail-fast contract,
+and (DP lane) the on-device scalar adds NO collective launch, proven by
+compiled-HLO inspection.
+
+Subprocess-isolated on the 8-device CPU mesh (test_gspmd_core
+precedent): the jaxlib-0.4.3x XLA:CPU heap corruption can kill a
+multi-device child nondeterministically — that skips, never takes the
+session down."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+pytestmark = pytest.mark.slow
+
+
+def _run_child(code, timeout=900, tag="HEALTH_RESULT"):
+    prelude = (
+        "import sys\n"
+        f"sys.path.insert(0, {TESTS_DIR!r})\n"
+        "import cpu_mesh  # noqa: F401\n")
+    r = subprocess.run(
+        [sys.executable, "-c", prelude + code],
+        capture_output=True, text=True, timeout=timeout,
+        cwd=os.path.dirname(TESTS_DIR))
+    lines = [ln for ln in r.stdout.splitlines()
+             if ln.startswith(tag + " ")]
+    if r.returncode != 0 and not lines:
+        if r.returncode < 0:
+            pytest.skip(f"health child died with signal {-r.returncode} "
+                        "(0.4.3x XLA:CPU heap corruption)")
+        raise AssertionError(
+            f"health child failed rc={r.returncode}\n{r.stderr[-3000:]}")
+    return json.loads(lines[-1][len(tag) + 1:])
+
+
+_CHILD = """
+import json
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import fault_injection
+from paddle_tpu.fluid.executor import Scope, scope_guard, global_scope
+
+LANE = {lane!r}
+N, BAD = 20, 4
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.3).minimize(loss)
+    return main, startup, loss
+
+
+rng = np.random.RandomState(0)
+W = rng.uniform(-1, 1, (4, 1)).astype("float32")
+batches = []
+for _ in range(N):
+    xb = rng.uniform(-1, 1, (16, 4)).astype("float32")
+    batches.append(dict(x=xb, y=xb @ W))
+
+
+def make_runner(main, loss):
+    if LANE == "hybrid":
+        from paddle_tpu.parallel.hybrid import (HybridParallelRunner,
+                                                build_hybrid_mesh)
+
+        return HybridParallelRunner(
+            main, build_hybrid_mesh(n_devices=4, dp=4), zero_stage=1)
+    from paddle_tpu.parallel import DataParallelRunner
+
+    if LANE == "gspmd":
+        return DataParallelRunner(main, loss.name, gspmd=True)
+    return DataParallelRunner(main, loss.name, quant_grads=True)
+
+
+def run(action, plan, sentinel=True):
+    fluid.set_flags(dict(FLAGS_health_sentinel=sentinel,
+                         FLAGS_health_action=action))
+    if plan:
+        fault_injection.install(plan)
+    else:
+        fault_injection.uninstall()
+    main, startup, loss = build()
+    out = dict(losses=[], found=[])
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        runner = make_runner(main, loss)
+        sc = global_scope()
+        for b in batches:
+            if LANE == "hybrid":
+                r = runner.run(scope=sc, feed=b, fetch_list=[loss.name])
+            else:
+                r = runner.run(exe, b, [loss.name], sc)
+            out["losses"].append(float(np.mean(np.asarray(r[0]))))
+            if sentinel:
+                out["found"].append(float(np.asarray(
+                    sc.get("@HEALTH@found_inf")).ravel()[0]))
+        if sentinel:
+            out["bad_total"] = float(np.asarray(
+                sc.get("@HEALTH@bad_steps_total")).ravel()[0])
+        out["params"] = dict(
+            (p, np.asarray(sc.get(p)).ravel().tolist())
+            for p in ("fc_0.w_0", "fc_0.b_0"))
+        out["hlo"] = None
+        if LANE == "dp":
+            cb = list(runner._cache.values())[0]
+            feed = exe._coerce_feed(main, batches[0])
+            out["hlo"] = cb._jitted.lower(
+                *cb._jit_args(sc, feed, 0)).compile().as_text()
+    fault_injection.uninstall()
+    return out
+
+
+res = dict(lane=LANE)
+base = run("skip", None)
+skip = run("skip", "nan:grad:step:4")
+rollback = run("rollback", "nan:grad:step:4")
+res["base_final"] = base["losses"][-1]
+res["skip_final"] = skip["losses"][-1]
+res["rollback_losses_equal_base"] = (
+    rollback["losses"] == base["losses"])
+res["rollback_params_equal_base"] = rollback["params"] == base["params"]
+res["skip_found"] = skip["found"]
+res["skip_bad_total"] = skip["bad_total"]
+res["base_bad_total"] = base["bad_total"]
+try:
+    run("raise", "nan:grad:step:4")
+    res["raise_ok"] = False
+except RuntimeError as e:
+    res["raise_ok"] = "health sentinel" in str(e)
+if LANE == "dp":
+    from paddle_tpu.parallel.gspmd import hlo_collective_counts
+
+    off = run("skip", None, sentinel=False)
+    res["collectives_off"] = hlo_collective_counts(off["hlo"])
+    res["collectives_on"] = hlo_collective_counts(base["hlo"])
+    res["isfinite_on_device"] = "is-finite" in base["hlo"]
+print("HEALTH_RESULT " + json.dumps(res))
+"""
+
+
+def _check_acceptance(res):
+    bad, n = 4, 20
+    # detection WITHIN the bad step: found_inf fires exactly at step 4
+    want = [1.0 if i == bad - 1 else 0.0 for i in range(n)]
+    assert res["skip_found"] == want, res["skip_found"]
+    assert res["skip_bad_total"] == 1.0
+    assert res["base_bad_total"] == 0.0
+    # skip recovers to <=1e-3 loss parity with the uninjected baseline
+    assert abs(res["skip_final"] - res["base_final"]) <= 1e-3, (
+        res["skip_final"], res["base_final"])
+    # rollback replays the bad step clean: bit-exact parity
+    assert res["rollback_losses_equal_base"]
+    assert res["rollback_params_equal_base"]
+    # raise preserves the fail-fast contract
+    assert res["raise_ok"]
+
+
+def test_health_acceptance_dp_transpiler_lane():
+    res = _run_child(_CHILD.format(lane="dp"))
+    _check_acceptance(res)
+    # the on-device scalar adds NO collective launch: the sentinel arm's
+    # compiled HLO carries exactly the baseline's collective inventory
+    # (detection runs on post-allreduce, replica-identical gradients)
+    assert res["collectives_on"] == res["collectives_off"], (
+        res["collectives_on"], res["collectives_off"])
+    assert sum(res["collectives_off"].values()) > 0  # dp=8 really reduced
+    assert res["isfinite_on_device"]
+
+
+def test_health_acceptance_hybrid_zero1_lane():
+    _check_acceptance(_run_child(_CHILD.format(lane="hybrid")))
+
+
+def test_health_acceptance_gspmd_lane():
+    # NOTE: the gspmd arm runs WITHOUT the quantized gradient hook.  On
+    # real TPU the hook composes fine with the sentinel (the check op
+    # lands in the post-island optimizer leg, the fault injector's
+    # countdown rides the island carries — verified structurally in the
+    # split: cut/carries/ops_opt), but this container's jaxlib-0.4.3x
+    # XLA:CPU GSPMD lane SILENTLY corrupts small jit outputs when the
+    # shard_map island rides inside the partitioned computation
+    # (observed: a monotone in-graph counter decreasing across steps,
+    # ~1/3 of subprocess runs) — the silent sibling of the documented
+    # gspmd_cpu_heap_broken abort.  A flaky-on-CPU assertion would
+    # punish correct code, so the CPU gate covers the hookless gspmd
+    # lane only.
+    _check_acceptance(_run_child(_CHILD.format(lane="gspmd")))

@@ -81,3 +81,37 @@ def test_signal_check_covers_autocheckpoint_module():
     default target set."""
     assert any("incubate/checkpoint" in t
                for t in lint_resilience.DEFAULT_TARGETS)
+
+
+def test_raw_numeric_check_flags_outside_health():
+    src = ("import jax.numpy as jnp\n"
+           "import numpy as np\n"
+           "def f(x):\n"
+           "    a = jnp.isnan(x)\n"
+           "    b = np.isfinite(x)\n"
+           "    c = jnp.isinf(x)\n"
+           "    return a, b, c\n")
+    found = lint_resilience.check_numeric_source(src, "x.py")
+    assert [f[2] for f in found] == ["raw-numeric-check"] * 3
+    assert {f[1] for f in found} == {4, 5, 6}
+
+
+def test_raw_numeric_check_allows_marked_and_math():
+    src = ("import math\n"
+           "import numpy as np\n"
+           "def f(x):\n"
+           "    ok = math.isnan(x)  # host float, not a tensor check\n"
+           "    # resilience: allow\n"
+           "    d = np.isnan(x)\n"
+           "    e = np.isfinite(x)  # resilience: allow\n"
+           "    return ok, d, e\n")
+    assert lint_resilience.check_numeric_source(src, "x.py") == []
+
+
+def test_raw_numeric_check_exempts_health_package():
+    from pathlib import Path
+
+    assert lint_resilience._numeric_exempt(
+        Path(lint_resilience.REPO) / "paddle_tpu/health/detect.py")
+    assert not lint_resilience._numeric_exempt(
+        Path(lint_resilience.REPO) / "paddle_tpu/fluid/executor.py")

@@ -23,7 +23,8 @@ from paddle_tpu import observability as obs
 from paddle_tpu import serving
 from paddle_tpu.fluid.executor import Scope, scope_guard
 from paddle_tpu.serving import (BucketPolicy, Engine, FeedValidationError,
-                                ModelNotLoadedError, ServingOverloadError)
+                                ModelNotLoadedError, ServingDeadlineError,
+                                ServingOverloadError)
 from paddle_tpu.serving.batching import (Request, assemble_batch,
                                          split_outputs)
 
@@ -1249,3 +1250,127 @@ def test_register_page_validation():
             server.stop()
     finally:
         obs.unregister_page("/boomz")
+
+
+# ---------------------------------------------------------------------------
+# per-request deadlines (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _deadline_rejections():
+    fam = obs.REGISTRY.get("pt_serve_rejected_total")
+    if fam is None:
+        return 0
+    return fam._snapshot()["samples"].get(("mlp", "deadline"), 0)
+
+
+def test_deadline_off_by_default(saved_model):
+    """FLAGS_serving_deadline_ms=0: requests carry no deadline and wait
+    as long as it takes (the pre-deadline contract)."""
+    d, xb, expect = saved_model
+    eng = Engine({"mlp": d}, batch_buckets="1,2", name="nodl",
+                 auto_start=False)
+    fut = eng.submit("mlp", {"x": xb[:1]})
+    assert eng._lanes["mlp"]._queue[0].deadline is None
+    import time
+
+    time.sleep(0.05)  # would expire any sub-50ms deadline
+    eng.start()
+    np.testing.assert_allclose(fut.result(timeout=30)["fc_1.tmp_2"],
+                               expect[:1], rtol=1e-5)
+    eng.close()
+
+
+def test_queued_request_past_deadline_resolves_typed(saved_model):
+    """A request that outlives FLAGS_serving_deadline_ms while QUEUED
+    resolves ServingDeadlineError when the scheduler reaches it (instead
+    of waiting forever) and books reason="deadline"."""
+    import time
+
+    d, xb, _ = saved_model
+    before = _deadline_rejections()
+    eng = Engine({"mlp": d}, batch_buckets="1,2", name="dl",
+                 auto_start=False, deadline_ms=200)
+    eng.warmup()  # the follow-up request must not pay a cold compile
+    expired = eng.submit("mlp", {"x": xb[:1]})
+    time.sleep(0.3)  # expires in the (unstarted) queue
+    eng.start()
+    with pytest.raises(ServingDeadlineError, match="deadline while queued"):
+        expired.result(timeout=30)
+    # a fresh request on the SAME lane still serves normally
+    ok = eng.submit("mlp", {"x": xb[:1]})
+    assert ok.result(timeout=30)
+    assert _deadline_rejections() == before + 1
+    eng.close()
+
+
+def test_deadline_caps_the_batch_mate_wait(saved_model):
+    """A lone head request whose deadline is shorter than the
+    batch-fill max-wait is dispatched EARLY (at half its deadline
+    budget, leaving the other half for execution) and SERVED — not held
+    the full max_wait and then expired after a burned dispatch."""
+    import time
+
+    d, xb, expect = saved_model
+    eng = Engine({"mlp": d}, batch_buckets="1,2,4", name="dlw",
+                 auto_start=False, deadline_ms=2000, max_wait_ms=30000)
+    eng.warmup()  # warm: execution fits comfortably in the half-budget
+    eng.start()
+    t0 = time.monotonic()
+    out = eng.infer("mlp", {"x": xb[:1]}, timeout=30)
+    elapsed = time.monotonic() - t0
+    np.testing.assert_allclose(next(iter(out.values())),
+                               expect[:1], rtol=1e-4)
+    assert elapsed < 10.0, (  # nowhere near the 30 s mate-wait
+        f"deadline-bearing head waited {elapsed:.2f}s")
+    eng.close()
+
+
+def test_impossible_deadline_expires_promptly(saved_model):
+    """A deadline no batching window can honor still resolves typed at
+    ~the deadline (queued or in-flight), never after the full
+    max_wait."""
+    import time
+
+    d, xb, _ = saved_model
+    before = _deadline_rejections()
+    eng = Engine({"mlp": d}, batch_buckets="1,2,4", name="dli",
+                 auto_start=False, deadline_ms=1, max_wait_ms=30000)
+    eng.warmup()
+    eng.start()
+    t0 = time.monotonic()
+    fut = eng.submit("mlp", {"x": xb[:1]})
+    with pytest.raises(ServingDeadlineError):
+        fut.result(timeout=30)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0, f"waited {elapsed:.2f}s for a 1 ms deadline"
+    assert _deadline_rejections() == before + 1
+    eng.close()
+
+
+def test_inflight_request_past_deadline_resolves_typed(saved_model):
+    """A request whose deadline expires while its batch is IN FLIGHT
+    gets the typed error, not a stale result (its batch-mates are
+    unaffected)."""
+    import concurrent.futures
+    import time
+
+    d, xb, _ = saved_model
+    before = _deadline_rejections()
+    eng = Engine({"mlp": d}, batch_buckets="1,2", name="dlf",
+                 auto_start=False, deadline_ms=30)
+    lane = eng._lanes["mlp"]
+    # assemble the batch by hand so expiry deterministically happens
+    # between dispatch and fan-out (the in-flight window)
+    padded, rows, key, seq_pad = lane._validate_and_pad({"x": xb[:1]})
+    late = Request(padded, rows, "t", concurrent.futures.Future(), key,
+                   seq_pad, deadline_s=0.02)
+    fresh = Request(padded, rows, "t", concurrent.futures.Future(), key,
+                    seq_pad, deadline_s=0.0)
+    time.sleep(0.05)  # `late` is now past deadline, "in flight"
+    lane._execute([late, fresh])
+    with pytest.raises(ServingDeadlineError, match="deadline in flight"):
+        late.future.result(timeout=5)
+    assert fresh.future.result(timeout=5)  # batch-mate unaffected
+    assert _deadline_rejections() == before + 1
+    eng.close()

@@ -24,12 +24,25 @@ and `paddle_tpu/fluid/incubate/checkpoint/`:
                    restore-site where chaining is genuinely impossible
                    with `# resilience: allow`.
 
+A fourth check runs over the WHOLE paddle_tpu tree (not just the
+distributed layer):
+
+  raw-numeric-check  a raw `np.isnan` / `np.isinf` / `np.isfinite` /
+                   `jnp.is*` call outside `paddle_tpu/health/` — the
+                   health sentinel owns the ONE audited finite-check
+                   implementation (`health.detect`), so ad-hoc numeric
+                   scans drift from its semantics (host round trips,
+                   laundered NaNs, double-raising).  Route through
+                   `paddle_tpu.health.detect`, or mark a deliberate
+                   site (a self-test, a bench sanity assert) with
+                   `# resilience: allow`.
+
 Suppress a deliberate finding with `# resilience: allow` on the same
 line.  Exit 0 when clean, 1 with findings (one per line:
 `path:lineno: [check] message`).
 
 Usage: python tools/lint_resilience.py [paths...]
-  (no args = the default target set, repo-relative)
+  (no args = the default target sets, repo-relative)
 """
 
 from __future__ import annotations
@@ -50,6 +63,13 @@ DEFAULT_TARGETS = [
 
 WAIT_NAMES = {"wait", "join", "recv", "get", "acquire", "wait_round",
               "wait_table", "wait_for"}
+
+# raw-numeric-check: tree-wide target + the one exempt package that owns
+# the audited implementation
+NUMERIC_TARGET = "paddle_tpu"
+NUMERIC_EXEMPT = "paddle_tpu/health"
+NUMERIC_FNS = {"isnan", "isinf", "isfinite"}
+NUMERIC_MODULES = {"np", "jnp", "numpy"}  # math.isnan (host floats) is fine
 
 ALLOW_MARK = "resilience: allow"
 
@@ -106,6 +126,37 @@ def check_source(src: str, path: str = "<string>"):
     return findings
 
 
+def check_numeric_source(src: str, path: str = "<string>"):
+    """The raw-numeric-check lint for one file (callers skip files under
+    NUMERIC_EXEMPT): flag `np/jnp/numpy.isnan|isinf|isfinite` calls —
+    numeric-health logic must route through paddle_tpu.health.detect."""
+    findings = []
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, "parse-error", str(e))]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in NUMERIC_FNS
+                and isinstance(func.value, ast.Name)
+                and func.value.id in NUMERIC_MODULES):
+            continue
+        if _allowed(lines, node.lineno):
+            continue
+        findings.append(
+            (path, node.lineno, "raw-numeric-check",
+             f"raw {func.value.id}.{func.attr}() outside "
+             f"paddle_tpu/health/ — numeric-health checks must route "
+             f"through paddle_tpu.health.detect (one audited "
+             f"implementation), or mark a deliberate site "
+             f"`# {ALLOW_MARK}`"))
+    return findings
+
+
 def _is_signal_signal(node):
     """`signal.signal(...)` (module attribute form) used as a call."""
     return (isinstance(node, ast.Call)
@@ -130,6 +181,14 @@ def iter_files(targets):
             yield p
 
 
+def _numeric_exempt(path: Path):
+    try:
+        rel = path.resolve().relative_to(REPO)
+    except ValueError:
+        rel = path
+    return str(rel).replace("\\", "/").startswith(NUMERIC_EXEMPT)
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     targets = argv or DEFAULT_TARGETS
@@ -138,6 +197,12 @@ def main(argv=None):
     for f in iter_files(targets):
         n_files += 1
         findings.extend(check_file(f))
+    if not argv:  # default run: the tree-wide numeric-health sweep too
+        for f in iter_files([NUMERIC_TARGET]):
+            if _numeric_exempt(f):
+                continue
+            n_files += 1
+            findings.extend(check_numeric_source(f.read_text(), str(f)))
     for path, lineno, check, msg in findings:
         print(f"{path}:{lineno}: [{check}] {msg}")
     if findings:

@@ -3,8 +3,8 @@ PY ?= python
 REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 
 .PHONY: test test-book test-onchip bench bench-onchip int8-bench \
-	serve-bench health-bench lint-api lint-resilience \
-	lint-observability lint-collectives
+	serve-bench health-bench phase-bench perf-compare lint-api \
+	lint-resilience lint-observability lint-collectives
 
 test:            ## full suite on the 8-device virtual CPU mesh (~8 min)
 	$(PY) -m pytest tests/ -q --ignore=tests/book
@@ -30,6 +30,17 @@ serve-bench:     ## serving-engine load generator (throughput + p50/p99)
 
 health-bench:    ## health-sentinel on/off A/B (overhead gate <=2% p50)
 	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_HEALTH=1 $(PY) bench.py
+
+phase-bench:     ## phase-instrumentation on/off A/B (overhead within noise)
+	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_PHASES=1 $(PY) bench.py
+
+# diff two BENCH records, exit nonzero on regression.  Defaults to the
+# two newest BENCH_*.json in the repo; override: make perf-compare \
+#   OLD=BENCH_r04.json NEW=BENCH_r05.json [PC_ARGS=--threshold-pct=10]
+OLD ?= $(lastword $(filter-out $(lastword $(sort $(wildcard BENCH_*.json))),$(sort $(wildcard BENCH_*.json))))
+NEW ?= $(lastword $(sort $(wildcard BENCH_*.json)))
+perf-compare:    ## regression gate between two BENCH_*.json records
+	$(PY) tools/perf_compare.py $(OLD) $(NEW) $(PC_ARGS)
 
 lint-api:        ## fail if the public API surface drifted from API.spec
 	$(PY) tools/gen_api_spec.py --check

@@ -36,7 +36,13 @@ counts and resharding bytes from compiled-HLO inspection;
 PT_BENCH_HEALTH=1 → health-sentinel-on vs -off A/B
 (paddle_tpu/health/): per-arm p50/p95/max step quantiles + the p50
 overhead fraction of the in-graph finite check / skip gate (acceptance:
-<=2% on the CPU smoke); PT_BENCH_SERVE=1 → serving-lane load-generator
+<=2% on the CPU smoke); PT_BENCH_PHASES=1 → phase-instrumentation
+on/off A/B (FLAGS_profile_phases, observability/profiling.py):
+interleaved arms, per-arm p50/p95/max + the overhead fraction, plus the
+on-arm's measured per-phase p50s — and every record embeds the
+step-time attribution digest (phase quantiles, per-signature MFU +
+roofline verdict, feed-bound fraction) under metrics.attribution,
+diffable with tools/perf_compare.py (make perf-compare); PT_BENCH_SERVE=1 → serving-lane load-generator
 rung: a paddle_tpu.serving.Engine under closed-loop concurrent clients,
 recording request throughput + p50/p99 latency quantiles and batch-size /
 executable-cache figures (PT_BENCH_SERVE_CLIENTS, PT_BENCH_SERVE_REQUESTS
@@ -896,6 +902,78 @@ def _health_ab(size, batch, seq_len, n_steps, bf16):
     return out
 
 
+def _phase_overhead_ab(size, batch, seq_len, n_steps, bf16):
+    """PT_BENCH_PHASES=1 A/B rung: the DP step with phase-decomposed
+    step timing (FLAGS_profile_phases — the four step_phases brackets
+    plus the per-step block_until_ready the device_wait phase needs) ON
+    vs OFF, arms interleaved round-robin after both warm (the
+    PT_BENCH_HEALTH precedent: sequential arms measure cache warmth as
+    fake overhead on the 2-vCPU container).  The acceptance bar
+    (ISSUE 11): overhead within noise (<=2% p50) on the CPU smoke —
+    phase attribution must be cheap enough to leave on for any
+    syncfetch-methodology run."""
+    import numpy as np
+
+    from paddle_tpu import fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import DataParallelRunner
+
+    kw = dict(vocab_size=30528, attn_dropout=0.1)
+    cfg = (bert.BertConfig.base(**kw) if size == "base"
+           else bert.BertConfig.tiny(**kw))
+    prior = fluid.get_flags("FLAGS_profile_phases")["FLAGS_profile_phases"]
+    out = {"methodology": "syncfetch per-step, arms interleaved",
+           "steps": n_steps}
+    data = bert.make_fake_batch(cfg, batch=batch, seq_len=seq_len,
+                                seed=0)
+    arms = {}
+    try:
+        for arm, enabled in (("off", False), ("on", True)):
+            fluid.set_flags({"FLAGS_profile_phases": enabled})
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup), \
+                    fluid.unique_name.guard():
+                feeds, loss, _mlm, _nsp = bert.build_bert_pretrain(
+                    cfg, is_test=False)
+                fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+            _maybe_enable_bf16(main_prog, bf16)
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor()
+                exe.run(startup)
+                runner = DataParallelRunner(main_prog, loss.name)
+                runner.run(exe, data, [loss.name], scope)  # warm
+                runner.run(exe, data, [loss.name], scope)
+            arms[arm] = (runner, exe, scope, loss, [], enabled)
+        for _ in range(n_steps):
+            for arm, (runner, exe, scope, loss, times,
+                      enabled) in arms.items():
+                fluid.set_flags({"FLAGS_profile_phases": enabled})
+                with fluid.scope_guard(scope):
+                    t0 = time.perf_counter()
+                    runner.run(exe, data, [loss.name], scope)
+                    times.append(time.perf_counter() - t0)
+        for arm, (_r, _e, _s, _l, times, _en) in arms.items():
+            out[arm] = {
+                "p50_s": round(float(np.percentile(times, 50)), 6),
+                "p95_s": round(float(np.percentile(times, 95)), 6),
+                "max_s": round(float(np.max(times)), 6),
+            }
+        if out["off"]["p50_s"] > 0:
+            out["overhead_p50_pct"] = round(
+                100.0 * (out["on"]["p50_s"] - out["off"]["p50_s"])
+                / out["off"]["p50_s"], 2)
+        # the on-arm's measured phase decomposition rides along: the A/B
+        # proves the cost, this proves the benefit (p50 per phase)
+        from paddle_tpu import observability as obs
+
+        out["phase_seconds"] = obs.profiling.attribution_digest()[
+            "phase_seconds"].get("dp", {})
+    finally:
+        fluid.set_flags({"FLAGS_profile_phases": prior})
+    return out
+
+
 def _gspmd_ab(size, batch, seq_len, n_steps, bf16):
     """PT_BENCH_GSPMD=1 A/B rung: the SAME bert step through the
     transpiler DP lane (explicit c_allreduce ops + shard_map) vs the
@@ -1148,6 +1226,15 @@ def measure(size):
                                         bf16)
         except Exception as e:
             print(f"bench: gspmd A/B rung failed ({e})", file=sys.stderr)
+    # phase-instrumentation on vs off A/B (ISSUE 11): step_phases
+    # bracket + per-step device_wait sync overhead, gated within noise
+    # (<=2% p50) on the CPU smoke
+    if os.environ.get("PT_BENCH_PHASES") == "1":
+        try:
+            rec["phase_ab"] = _phase_overhead_ab(size, batch, seq_len,
+                                                 n_steps, bf16)
+        except Exception as e:
+            print(f"bench: phase A/B rung failed ({e})", file=sys.stderr)
     # health-sentinel-on vs -off A/B (ISSUE 10): in-graph finite check +
     # skip gate overhead, gated at <=2% p50 on the CPU smoke
     if os.environ.get("PT_BENCH_HEALTH") == "1":
@@ -1318,6 +1405,12 @@ def _metrics_summary():
                 }
             if quants:
                 summary["step_seconds_quantiles"] = quants
+        # the step-time attribution digest (ISSUE 11): per-lane phase
+        # quantiles, per-signature MFU + roofline verdict, and the
+        # feed-bound fraction ride in EVERY record so
+        # tools/perf_compare.py can diff where the time went, not just
+        # how much there was
+        summary["attribution"] = obs.profiling.attribution_digest()
         return summary
     except Exception as e:  # telemetry must never fail the bench
         print(f"bench: metrics summary unavailable ({e})", file=sys.stderr)

@@ -81,12 +81,18 @@ def _m_cost(kind):
 
 
 def _record_step(path, seconds, first_run):
-    """Book one step into the shared step/compile metrics and the JSONL
-    event log (when enabled)."""
+    """Book one step into the shared step/compile metrics, the step-time
+    attribution layer (per-signature stats, MFU, flight recorder —
+    observability/profiling.py consumes the phase breakdown the lane's
+    step_phases recorder deposited on this thread) and the JSONL event
+    log (when enabled)."""
     _m_step_seconds().labels(path=path).observe(seconds)
     if first_run:
         _m_compile_seconds().labels(
             path=path, phase="jit_first_run").inc(seconds)
+    from paddle_tpu.observability import profiling as _profiling
+
+    _profiling.note_step(path, seconds, first_run=bool(first_run))
     from paddle_tpu.observability import events as _events
 
     if _events.enabled():
@@ -785,6 +791,11 @@ class _JitExecutable:
             v = cost.get(key) if hasattr(cost, "get") else None
             if v is not None:
                 _m_cost(kind).labels(signature=sig).set(float(v))
+        # feed the attribution layer: cost numbers + measured device
+        # time become pt_mfu / pt_roofline_bound for this signature
+        from paddle_tpu.observability import profiling as _profiling
+
+        _profiling.note_cost(sig, cost if hasattr(cost, "get") else {})
         return {"cost": dict(cost), "memory": mem}
 
     def _check_nan_inf(self, out_writes, fetches):
@@ -818,37 +829,61 @@ class _CompiledBlock(_JitExecutable):
     def run(self, scope, feeds, step):
         import jax
 
+        from paddle_tpu.observability import profiling as _profiling
+
         from . import profiler as _prof
 
-        with _prof.timed_run(self.label, self._prof_state) as timer:
-            # pre-stage host ops (distributed lookup/prefetch) populate the
-            # scope vars the device step is about to read
-            self.plan.run_host_pre_ops(scope, feeds, self.place)
-            device = self.place.jax_device()
-            donated = _stage_scope_reads(scope, self.donated_names, device)
-            readonly = _stage_scope_reads(scope, self.readonly_names, device)
-            feed_vals = {k: jax.device_put(v, device) for k, v in feeds.items()}
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")  # donation unsupported on CPU backend
-                fetches, out_writes = self._jitted(
-                    donated, readonly, feed_vals, np.uint32(step)
-                )
-            for n, v in out_writes.items():
-                scope.set(n, v)
-            # block on scope writes too — a run with an empty fetch_list (or
-            # a startup run) would otherwise record async-dispatch time only
-            timer.done(fetches, out_writes)
-        from . import flags as _flags
+        # step_phases OUTERMOST, timed_run covering exactly its historic
+        # region (staging..scope-writes): the chrome-trace "run" span
+        # must not absorb the host RPC/IO ops that follow — that
+        # misattribution is what this layer exists to remove.  Phase
+        # brackets of the same name accumulate, so fetch_sync spans both
+        # the scope write-back (inside timed_run) and the host tail.
+        with _profiling.step_phases("single", self.label) as ph:
+            with _prof.timed_run(self.label, self._prof_state) as timer:
+                with ph.phase("feed_prep"):
+                    # pre-stage host ops (distributed lookup/prefetch)
+                    # populate the scope vars the device step is about
+                    # to read
+                    self.plan.run_host_pre_ops(scope, feeds, self.place)
+                    device = self.place.jax_device()
+                    donated = _stage_scope_reads(scope,
+                                                 self.donated_names,
+                                                 device)
+                    readonly = _stage_scope_reads(scope,
+                                                  self.readonly_names,
+                                                  device)
+                    feed_vals = {k: jax.device_put(v, device)
+                                 for k, v in feeds.items()}
+                with ph.phase("dispatch"):
+                    with warnings.catch_warnings():
+                        warnings.simplefilter("ignore")  # donation unsupported on CPU backend
+                        fetches, out_writes = self._jitted(
+                            donated, readonly, feed_vals, np.uint32(step)
+                        )
+                with ph.phase("device_wait"):
+                    ph.wait((fetches, out_writes))
+                with ph.phase("fetch_sync"):
+                    for n, v in out_writes.items():
+                        scope.set(n, v)
+                    # block on scope writes too — a run with an empty
+                    # fetch_list (or a startup run) would otherwise
+                    # record async-dispatch time only
+                    timer.done(fetches, out_writes)
+            with ph.phase("fetch_sync"):
+                from . import flags as _flags
 
-        if _flags.flag("benchmark"):
-            # force completion each step (reference operator.cc:949 forces a
-            # dev_ctx->Wait() per op under FLAGS_benchmark)
-            jax.block_until_ready((fetches, out_writes))
-        if _flags.flag("check_nan_inf"):
-            self._check_nan_inf(out_writes, fetches)
-        # RPC/IO ops run host-side after the device step, in program order
-        self.plan.run_host_ops(scope, self.place, feeds=feeds)
-        return self.plan.assemble_fetches(fetches, scope)
+                if _flags.flag("benchmark"):
+                    # force completion each step (reference operator.cc:949
+                    # forces a dev_ctx->Wait() per op under FLAGS_benchmark)
+                    jax.block_until_ready((fetches, out_writes))
+                if _flags.flag("check_nan_inf"):
+                    self._check_nan_inf(out_writes, fetches)
+                # RPC/IO ops run host-side after the device step, in
+                # program order
+                self.plan.run_host_ops(scope, self.place, feeds=feeds)
+                out = self.plan.assemble_fetches(fetches, scope)
+        return out
 
 def _check_nan_inf(plan, label, out_writes, fetches):
     """FLAGS_check_nan_inf (reference operator.cc:953-984): scan every
@@ -941,34 +976,50 @@ class _CompiledChain(_JitExecutable):
     def run(self, scope, feeds, step):
         import jax
 
+        from paddle_tpu.observability import profiling as _profiling
+
         from . import profiler as _prof
 
-        with _prof.timed_run(self.label, self._prof_state) as timer:
-            device = self.place.jax_device()
-            donated = _stage_scope_reads(scope, self.plan.donated_names,
-                                         device)
-            readonly = _stage_scope_reads(scope, self.plan.readonly_names,
-                                          device)
-            feed_vals = {k: jax.device_put(v, device)
-                         for k, v in feeds.items()}
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")  # donation unsupported on CPU
-                fetches, out_writes = self._jitted(
-                    donated, readonly, feed_vals, np.uint32(step))
-            for n, v in out_writes.items():
-                scope.set(n, v)
-            timer.done(fetches, out_writes)
-        from . import flags as _flags
+        with _profiling.step_phases("chain", self.label) as ph:
+            with _prof.timed_run(self.label, self._prof_state) as timer:
+                with ph.phase("feed_prep"):
+                    device = self.place.jax_device()
+                    donated = _stage_scope_reads(scope,
+                                                 self.plan.donated_names,
+                                                 device)
+                    readonly = _stage_scope_reads(
+                        scope, self.plan.readonly_names, device)
+                    feed_vals = {k: jax.device_put(v, device)
+                                 for k, v in feeds.items()}
+                with ph.phase("dispatch"):
+                    with warnings.catch_warnings():
+                        warnings.simplefilter("ignore")  # donation unsupported on CPU
+                        fetches, out_writes = self._jitted(
+                            donated, readonly, feed_vals, np.uint32(step))
+                with ph.phase("device_wait"):
+                    ph.wait((fetches, out_writes))
+                with ph.phase("fetch_sync"):
+                    for n, v in out_writes.items():
+                        scope.set(n, v)
+                    timer.done(fetches, out_writes)
+            with ph.phase("fetch_sync"):
+                # the host tail rides the trailing fetch_sync bracket
+                # like every other lane — a large stacked fetch list's
+                # host conversion must not vanish from the phase sum
+                from . import flags as _flags
 
-        if _flags.flag("benchmark"):
-            jax.block_until_ready((fetches, out_writes))
-        if _flags.flag("check_nan_inf"):
-            # chain granularity: a NaN born mid-chain propagates through
-            # the remaining iterations (params/opt-state carry it), so the
-            # final-state scan still fails loudly — just n_steps later
-            # than run()'s per-step scan would
-            _check_nan_inf(self.plan, self.label, out_writes, fetches)
-        return self.plan.assemble_fetches(fetches, scope)
+                if _flags.flag("benchmark"):
+                    jax.block_until_ready((fetches, out_writes))
+                if _flags.flag("check_nan_inf"):
+                    # chain granularity: a NaN born mid-chain propagates
+                    # through the remaining iterations (params/opt-state
+                    # carry it), so the final-state scan still fails
+                    # loudly — just n_steps later than run()'s per-step
+                    # scan would
+                    _check_nan_inf(self.plan, self.label, out_writes,
+                                   fetches)
+                out = self.plan.assemble_fetches(fetches, scope)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -1115,11 +1166,11 @@ class Executor:
             _m_cache().labels(path="single", result="miss").inc()
             if sent is not None:
                 sent.ensure_state(scope)  # before BlockPlan scope checks
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  # observability: allow
             cb = _CompiledBlock(program, block, feed.keys(), fetch_names, self.place, scope)
             self._cache[key] = cb
             self._cache[(key, "pin")] = program  # hold program ref: id() stays unique
-            trace_s = _time.perf_counter() - t0
+            trace_s = _time.perf_counter() - t0  # observability: allow
             _prof._record("trace", cb.label, trace_s)
             _m_compile_seconds().labels(path="single",
                                         phase="trace").inc(trace_s)
@@ -1130,9 +1181,9 @@ class Executor:
         # execution path shares the instrumentation
         def attempt():
             first_run = not getattr(cb, "_obs_ran", False)
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  # observability: allow
             fetches = cb.run(scope, feed, self._step)
-            _record_step("single", _time.perf_counter() - t0, first_run)
+            _record_step("single", _time.perf_counter() - t0, first_run)  # observability: allow
             cb._obs_ran = True
             self._step += 1
             return fetches
@@ -1206,13 +1257,13 @@ class Executor:
             _m_cache().labels(path="chain", result="miss").inc()
             if sent is not None:
                 sent.ensure_state(scope)
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  # observability: allow
             cc = _CompiledChain(program, program.global_block(),
                                 feed.keys(), fetch_names, self.place,
                                 scope, int(n_steps), bool(stacked_feed))
             self._cache[key] = cc
             self._cache[(key, "pin")] = program
-            trace_s = _time.perf_counter() - t0
+            trace_s = _time.perf_counter() - t0  # observability: allow
             _prof._record("trace", cc.label, trace_s)
             _m_compile_seconds().labels(path="chain",
                                         phase="trace").inc(trace_s)
@@ -1223,9 +1274,9 @@ class Executor:
         # rollback restores the pre-CHAIN state and replays the chain
         def attempt():
             first_run = not getattr(cc, "_obs_ran", False)
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  # observability: allow
             fetches = cc.run(scope, feed, self._step)
-            _record_step("chain", _time.perf_counter() - t0, first_run)
+            _record_step("chain", _time.perf_counter() - t0, first_run)  # observability: allow
             cc._obs_ran = True
             self._step += int(n_steps)
             return fetches
@@ -1301,7 +1352,7 @@ class Executor:
         fetch_list = fetch_list or []
         program = program if program is not None else framework.default_main_program()
         depth = int(os.environ.get("PT_DATASET_PREFETCH", "2"))
-        t_start = _time.perf_counter()
+        t_start = _time.perf_counter()  # observability: allow
 
         if depth <= 0:
             it, pf = dataset._iter_batches(), None
@@ -1398,7 +1449,7 @@ class Executor:
         finally:
             if pf is not None:
                 pf.close()
-                total = _time.perf_counter() - t_start
+                total = _time.perf_counter() - t_start  # observability: allow
                 self.last_dataset_stats = {
                     "steps": steps,
                     "prefetch_depth": depth,

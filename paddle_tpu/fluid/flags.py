@@ -202,6 +202,35 @@ _DEFS = {
     "FLAGS_health_loss_scaling": (False, _parse_bool, True),
     "FLAGS_health_loss_scale_init": (65536.0, float, True),
     "FLAGS_health_scale_growth_steps": (1000, int, True),
+    # step-time attribution (observability/profiling.py,
+    # docs/OBSERVABILITY.md "Step-time attribution").  profile_phases
+    # decomposes every executed step into feed_prep / dispatch /
+    # device_wait / fetch_sync phase spans (pt_step_phase_seconds +
+    # chrome-trace phase spans).  Off by default: the device_wait phase
+    # needs a per-step block_until_ready, which serializes the
+    # donated-buffer dispatch pipelining the fetch-free training loop
+    # (and the benched methodology) relies on — opt in per run, and the
+    # PT_BENCH_PHASES A/B rung gates its overhead on the syncfetch lane.
+    "FLAGS_profile_phases": (False, _parse_bool, True),
+    # flight recorder: bounded ring of the last N steps' attribution
+    # records (phase breakdowns, queue depth, health events), dumped as
+    # a JSONL postmortem on anomaly or on demand
+    # (profiling.dump_flight_record)
+    "FLAGS_flight_recorder_steps": (256, int, True),
+    # where flight-record postmortems land; empty = the event-log dir
+    # (PT_EVENT_LOG_DIR / FLAGS_event_log_dir), else the system tempdir
+    "FLAGS_flight_recorder_dir": ("", str, True),
+    # slow-step auto-dump trigger: a non-first-run step slower than the
+    # per-lane rolling EMA by more than this many EMA standard
+    # deviations dumps the flight record (0 disables the trigger)
+    "FLAGS_profile_slow_step_zscore": (8.0, float, True),
+    # roofline peak overrides (0 = the per-platform table in
+    # profiling.device_peaks): peak flops/s, peak HBM bytes/s, peak ICI
+    # bytes/s of one chip — MFU and the compute/memory/comm roofline
+    # verdict are computed against these
+    "FLAGS_device_peak_flops": (0.0, float, True),
+    "FLAGS_device_peak_bandwidth": (0.0, float, True),
+    "FLAGS_device_peak_ici_bandwidth": (0.0, float, True),
     # observability (docs/OBSERVABILITY.md): nonzero port serves
     # /metricsz + /statusz + /healthz from this process (started lazily
     # by the executor via observability.exposition.ensure_from_flags);

@@ -70,7 +70,7 @@ class AutoCheckpoint:
             io.save_persistables(self.executor, tmp,
                                  main_program=self.main_program,
                                  scope=self.scope)
-            meta = {"step": int(step), "time": time.time(), "complete": True}
+            meta = {"step": int(step), "time": time.time(), "complete": True}  # observability: allow
             with open(os.path.join(tmp, _META), "w") as f:
                 json.dump(meta, f)
                 f.flush()

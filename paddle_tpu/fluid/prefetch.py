@@ -53,6 +53,17 @@ def _m_wait():
         "Consumer seconds blocked on an empty prefetch queue")
 
 
+def _m_stall():
+    from paddle_tpu import observability as _obs
+
+    return _obs.counter(
+        "pt_prefetch_stall_seconds_total",
+        "Consumer seconds blocked on an empty prefetch queue AFTER the "
+        "first batch was delivered — genuine input-bound stall inside "
+        "the step loop, excluding pipeline fill; /profilez divides this "
+        "by executed step seconds into the feed-bound verdict")
+
+
 def _m_repartitions():
     from paddle_tpu import observability as _obs
 
@@ -95,6 +106,9 @@ class DatasetPrefetcher:
 
     Stats (read after exhaustion):
       wait_seconds     — consumer time blocked on an empty queue (input-bound)
+      stall_seconds    — wait excluding the pre-first-batch pipeline fill
+                         (the genuine feed-bound stall; also booked on
+                         pt_prefetch_stall_seconds_total)
       produce_seconds  — producer time parsing + transforming
       batches          — number of batches delivered
 
@@ -140,6 +154,9 @@ class DatasetPrefetcher:
         self._exhausted = False
         self._stop = threading.Event()
         self.wait_seconds = 0.0
+        # wait minus the pipeline-fill wait before batch 1 (the
+        # feed-bound numerator; pt_prefetch_stall_seconds_total)
+        self.stall_seconds = 0.0
         self.produce_seconds = 0.0
         self.batches = 0
         self._thread = threading.Thread(
@@ -161,13 +178,13 @@ class DatasetPrefetcher:
     def _produce(self, it):
         try:
             for batch in it:
-                t0 = time.perf_counter()
+                t0 = time.perf_counter()  # observability: allow
                 if (self._partition is not None
                         and self._partition_stage == "produce"
                         and isinstance(batch, dict)):
                     batch = self._apply_partition(batch)
                 out = self._transform(batch)
-                self.produce_seconds += time.perf_counter() - t0
+                self.produce_seconds += time.perf_counter() - t0  # observability: allow
                 while not self._stop.is_set():
                     try:
                         self._q.put(out, timeout=0.1)
@@ -193,11 +210,19 @@ class DatasetPrefetcher:
         if self._exhausted:  # exhausted iterators keep raising StopIteration
             raise StopIteration
         _m_depth().set(self._q.qsize())
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # observability: allow — audited source
         item = self._q.get()
-        waited = time.perf_counter() - t0
+        waited = time.perf_counter() - t0  # observability: allow
         self.wait_seconds += waited
         _m_wait().inc(waited)
+        if self.batches > 0:
+            # stall = blocked while the pipeline was already flowing
+            # (the step loop waited on the feed); the initial fill is
+            # startup, not a stall — the feed-bound verdict must not be
+            # inflated by it
+            self.stall_seconds += waited
+            if waited > 0:
+                _m_stall().inc(waited)
         if item is _SENTINEL:
             self._exhausted = True
             self._thread.join(timeout=5)

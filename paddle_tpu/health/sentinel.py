@@ -90,6 +90,14 @@ def run_guarded(sentinel, scope, fetch_names, attempt, chain=False):
         if sentinel.post_step(scope, fetch_names, fetches,
                               chain=chain) != "replay":
             break
+        # the replay dispatch that follows books as its own step (the
+        # attempt closure includes timing/metrics); mark the restore in
+        # the flight ring so the postmortem shows restore -> replay
+        from paddle_tpu.observability import profiling as _profiling
+
+        _profiling.flight_recorder().record(
+            {"kind": "health", "event": "rollback_replay",
+             "lane": sentinel.lane})
     return fetches
 
 
@@ -299,6 +307,15 @@ class HealthSentinel:
             return "ok"
         _m_bad_steps().labels(kind=kind, action=self.action).inc(
             max(1, n_events))
+        # flight-recorder evidence (observability/profiling.py): the bad
+        # step lands in the attribution ring and triggers the JSONL
+        # postmortem dump, so a poisoned run can be reconstructed from
+        # the last N steps' phase breakdowns
+        from paddle_tpu.observability import profiling as _profiling
+
+        _profiling.note_health_event(kind, self.action, self.lane,
+                                     step=self._steps_seen,
+                                     replay=replaying)
         from paddle_tpu.observability import events
 
         if events.enabled():

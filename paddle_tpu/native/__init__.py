@@ -894,7 +894,7 @@ class PSClient:
 
         out, olen = ctypes.c_void_p(), ctypes.c_int64()
         wire_span, span_str = _tracing.new_wire_span()
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # observability: allow
         with self._lock:
             if self._h is None:
                 raise PSConnectionError(
@@ -903,7 +903,7 @@ class PSClient:
                                    wire_span, blob,
                                    len(blob), ctypes.byref(out),
                                    ctypes.byref(olen))
-        _record_rpc(cmd, time.perf_counter() - t0,
+        _record_rpc(cmd, time.perf_counter() - t0,  # observability: allow
                     {0: "ok", 1: "server_error", 2: "timeout"}.get(
                         rc, "transport_error"), span_id=span_str)
         data = _take(out, olen.value) if out.value else b""

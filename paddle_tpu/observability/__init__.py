@@ -23,6 +23,7 @@ launchers can import this package without pulling in jax.
 from . import events  # noqa: F401
 from . import exposition  # noqa: F401
 from . import metrics  # noqa: F401
+from . import profiling  # noqa: F401
 from . import tracing  # noqa: F401
 from .exposition import (MetricsServer, ensure_from_flags, parse_text,
                          register_page, render_json, render_text,
@@ -33,7 +34,7 @@ from .metrics import (DEFAULT_BUCKETS, REGISTRY, Counter, Gauge, Histogram,
 from .tracing import job_trace_id, new_span_id, process_identity
 
 __all__ = [
-    "metrics", "exposition", "events", "tracing",
+    "metrics", "exposition", "events", "tracing", "profiling",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "counter", "gauge", "histogram", "snapshot", "reset", "hist_quantile",
     "DEFAULT_BUCKETS",

@@ -1,0 +1,914 @@
+"""Step-time attribution: phase-decomposed step timing, MFU/roofline
+accounting per compiled signature, and a bounded flight recorder
+(docs/OBSERVABILITY.md "Step-time attribution").
+
+Before this module, ``pt_step_seconds`` was one opaque histogram: a slow
+step could be host feed staging, Python dispatch, device compute,
+collective wait, or fetch sync, and nothing could say which.  This is
+the ONE audited timing implementation for the stack
+(tools/lint_observability.py flags raw ``time.time()``/``perf_counter``
+pairs anywhere else):
+
+- phase timing   every execution lane (single-device Executor, run_steps
+                 chain, transpiler DP, hybrid, GSPMD, serving) wraps its
+                 dispatch in `step_phases(lane, label)` and brackets the
+                 four canonical phases — ``feed_prep`` (scope staging +
+                 device_put), ``dispatch`` (the jitted call; trace on a
+                 signature's first run), ``device_wait``
+                 (`block_until_ready` delta = device execution the host
+                 had to wait out), ``fetch_sync`` (scope write-back +
+                 host ops).  Exported as
+                 ``pt_step_phase_seconds{phase,lane}`` histograms and
+                 per-phase chrome-trace spans (kind ``phase``) merged
+                 into the PT_TRACE timeline.  FLAGS_profile_phases
+                 gates the per-phase work (and the per-step
+                 `block_until_ready` the device_wait phase needs); with
+                 it off the recorder still times the step total so
+                 per-signature stats and the flight recorder stay live.
+
+- MFU/roofline   `note_cost` (fed by `_JitExecutable.cost_analysis`) and
+                 `note_collectives` (fed by compiled-HLO inspection)
+                 join the measured device seconds with a per-platform
+                 peak table (`device_peaks`, FLAGS_device_peak_*
+                 overrides) into ``pt_mfu{signature}`` and
+                 ``pt_roofline_bound{signature,bound}`` gauges: the
+                 compute/memory/comm time lower bounds
+                 (flops/peak_flops, bytes/peak_bw, comm_bytes/peak_ici)
+                 name which wall the signature sits against — the
+                 Tensor Processing Primitives (arXiv:2104.05755)
+                 roofline framing as a scraped verdict.
+
+- HLO inventory  `hlo_inventory` / `hlo_collective_bytes` /
+                 `hlo_collective_counts`: the per-category accounting of
+                 an optimized HLO module's cross-device collectives
+                 (promoted here from parallel/gspmd/executor.py — the
+                 gspmd lane re-exports them).
+
+- flight record  a bounded ring (FLAGS_flight_recorder_steps) of the
+                 last N steps' phase breakdowns + queue depths + health
+                 events.  `dump_flight_record()` writes a JSONL
+                 postmortem; automatic dumps fire on a slow-step
+                 z-score over the per-lane rolling EMA
+                 (FLAGS_profile_slow_step_zscore) and on health-sentinel
+                 bad steps (`note_health_event`, wired from
+                 health/sentinel.py) — a wedged or anomalous run leaves
+                 evidence instead of one opaque histogram.
+
+- /profilez      a JSON status page on every MetricsServer: per-signature
+                 MFU + roofline verdict, per-lane phase p50/p95, the
+                 feed-bound verdict (prefetcher stall vs step time), and
+                 flight-recorder state.  `attribution_digest()` is the
+                 same payload compacted for BENCH_*.json records.
+
+Import cost is stdlib-only (the observability-package contract); jax,
+fluid.flags and fluid.profiler are imported lazily inside functions.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+import sys
+import threading
+import time
+import warnings
+
+from . import metrics as _metrics
+from . import tracing as _tracing
+
+__all__ = [
+    "step_phases", "NullRecorder", "note_step", "note_cost",
+    "note_collectives",
+    "note_health_event", "device_peaks", "roofline",
+    "hlo_inventory", "hlo_collective_bytes", "hlo_collective_counts",
+    "flight_recorder", "dump_flight_record", "profilez_payload",
+    "attribution_digest", "signature_stats", "reset",
+    "PHASES",
+]
+
+# the canonical phase decomposition of one executed step, in order
+PHASES = ("feed_prep", "dispatch", "device_wait", "fetch_sync")
+
+# phase durations span ~100 us (feed staging) to multi-second compiles:
+# extend the default latency buckets downward so sub-ms phases resolve
+_PHASE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                  0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+_EMA_BETA = 0.9
+_EPS = 1e-12
+
+
+# ---------------------------------------------------------------------------
+# metric accessors (lazy idempotent registration — the registry contract)
+# ---------------------------------------------------------------------------
+
+
+def _m_phase():
+    return _metrics.histogram(
+        "pt_step_phase_seconds",
+        "Wall time of one step decomposed into named phases: feed_prep "
+        "(scope staging + device transfer), dispatch (the jitted call), "
+        "device_wait (block_until_ready delta = device execution the "
+        "host waited out), fetch_sync (scope write-back + host ops)",
+        labels=("phase", "lane"), buckets=_PHASE_BUCKETS)
+
+
+def _m_mfu():
+    return _metrics.gauge(
+        "pt_mfu",
+        "Model FLOPs utilization of the most recent steps per compiled "
+        "signature: cost-model flops / (device seconds x platform peak "
+        "flops, FLAGS_device_peak_flops override)",
+        labels=("signature",))
+
+
+def _m_roofline():
+    return _metrics.gauge(
+        "pt_roofline_bound",
+        "Roofline verdict per compiled signature: 1 on the bound "
+        "(compute|memory|comm) whose peak-rate time lower bound "
+        "dominates, 0 elsewhere", labels=("signature", "bound"))
+
+
+def _m_flight_dumps():
+    return _metrics.counter(
+        "pt_flight_dumps_total",
+        "Flight-recorder JSONL postmortems written, by trigger reason "
+        "(slow_step / health / explicit)", labels=("reason",))
+
+
+# ---------------------------------------------------------------------------
+# flags (read lazily and tolerantly — this module must import without fluid)
+# ---------------------------------------------------------------------------
+
+
+def _flag(name, default):
+    try:
+        from paddle_tpu.fluid import flags as _flags
+
+        return _flags.flag(name)
+    except Exception:
+        return default
+
+
+def _phases_enabled():
+    return bool(_flag("profile_phases", False))
+
+
+# ---------------------------------------------------------------------------
+# phase recorder
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+class _PhaseSpan:
+    __slots__ = ("_rec", "_name", "_t0")
+
+    def __init__(self, rec, name):
+        self._rec = rec
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        dur = time.perf_counter() - self._t0
+        self._rec._spans.append((self._name, self._t0, dur))
+        return False
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class StepPhaseRecorder:
+    """Times one executed step.  With FLAGS_profile_phases on, `phase()`
+    brackets record the four named sub-phases and `wait()` blocks on the
+    dispatched arrays so the device_wait phase measures real device
+    time; with it off both are no-ops and only the step total (and the
+    signature label) is deposited for `note_step` — per-signature stats
+    and the flight recorder keep working at zero sync cost, preserving
+    async dispatch pipelining."""
+
+    __slots__ = ("lane", "label", "detailed", "_spans", "_t0")
+
+    def __init__(self, lane, label, detailed):
+        self.lane = lane
+        self.label = label
+        self.detailed = detailed
+        self._spans = []  # (phase, start, dur)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def phase(self, name):
+        if not self.detailed:
+            return _NULL_SPAN
+        return _PhaseSpan(self, name)
+
+    def wait(self, arrays):
+        """Block until the dispatched device work completes — called
+        inside the ``device_wait`` phase bracket.  A no-op with phases
+        off: the per-step sync would serialize the donated-buffer
+        dispatch pipeline the fetch-free training loop relies on."""
+        if not self.detailed:
+            return
+        try:
+            import jax
+
+            jax.block_until_ready(arrays)
+        except Exception:  # non-jax values (host-op outputs)
+            pass
+
+    def __exit__(self, et, ev, tb):
+        if et is not None:
+            return False
+        total = time.perf_counter() - self._t0
+        phases = {}
+        for name, _start, dur in self._spans:
+            phases[name] = phases.get(name, 0.0) + dur
+        if self._spans:
+            try:
+                from paddle_tpu.fluid import profiler as _prof
+
+                for name, start, dur in self._spans:
+                    _prof._record("phase", f"{self.lane}:{name}", dur,
+                                  start=start)
+            except Exception:
+                pass
+            fam = _m_phase()
+            for name, dur in phases.items():
+                fam.labels(phase=name, lane=self.lane).observe(dur)
+        # hand the breakdown to note_step (same thread, the lane books
+        # its pt_step_seconds sample immediately after run() returns)
+        _tls.pending = (self.lane, self.label,
+                        phases if self._spans else None, total)
+        return False
+
+
+class NullRecorder:
+    """Recorder-shaped no-op: nothing timed, nothing deposited.  For
+    dispatches that must stay OUT of the attribution surface entirely —
+    the serving lane's warmup batches (their duration is compile time,
+    which would poison the serve-lane phase histograms and EMA exactly
+    the way it is already kept out of the latency SLO histogram)."""
+
+    detailed = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def phase(self, name):
+        return _NULL_SPAN
+
+    def wait(self, arrays):
+        pass
+
+
+def step_phases(lane, label, enabled=True):
+    """The one entry point every execution lane wraps its dispatch in.
+    ``enabled=False`` returns the NullRecorder (warmup/precompile
+    dispatches that must not enter the attribution stats)."""
+    if not enabled:
+        return NullRecorder()
+    return StepPhaseRecorder(lane, label, _phases_enabled())
+
+
+def _pop_pending(lane):
+    pending = getattr(_tls, "pending", None)
+    if pending is not None and pending[0] == lane:
+        _tls.pending = None
+        return pending
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-signature stats + MFU/roofline
+# ---------------------------------------------------------------------------
+
+_lock = threading.RLock()
+_signatures: dict = {}  # label -> stats dict
+_lane_ema: dict = {}    # lane -> [ema, emvar, samples]
+
+
+def _sig(label):
+    s = _signatures.get(label)
+    if s is None:
+        s = _signatures[label] = {
+            "label": label, "lane": None, "steps": 0,
+            "total_s": 0.0, "ema_step_s": None,
+            "device_s_sum": 0.0, "device_steps": 0,
+            "flops": None, "bytes_accessed": None,
+            "transcendentals": None, "collective_bytes": None,
+            "collective_counts": None,
+        }
+    return s
+
+
+_TPU_PEAKS = (
+    # device_kind substring -> (bf16 flops/s, HBM bytes/s, ICI bytes/s)
+    # public per-chip specs, approximate where vendors publish ranges;
+    # first match wins so "v5e"/"lite" must precede the bare "v5" (v5p)
+    ("v6", (918e12, 1640e9, 448e9)),
+    ("v5p", (459e12, 2765e9, 600e9)),
+    ("v5e", (197e12, 819e9, 200e9)),
+    ("lite", (197e12, 819e9, 200e9)),
+    ("v5", (459e12, 2765e9, 600e9)),
+    ("v4", (275e12, 1228e9, 300e9)),
+    ("v3", (123e12, 900e9, 87e9)),
+    ("v2", (45e12, 700e9, 62e9)),
+)
+
+# order-of-magnitude placeholders for the CPU container (documented in
+# docs/OBSERVABILITY.md): MFU against a CPU "peak" is a smoke-test
+# number, not a claim — override via FLAGS_device_peak_* for anything
+# that matters
+_CPU_PEAKS = (1e11, 2.5e10, 1e9)
+
+
+def device_peaks():
+    """(platform, peak_flops/s, peak_hbm_bytes/s, peak_ici_bytes/s) for
+    the process's device 0.  FLAGS_device_peak_flops /
+    FLAGS_device_peak_bandwidth / FLAGS_device_peak_ici_bandwidth
+    (nonzero) override the table entry-wise.  Reads jax only when it is
+    ALREADY imported — a /profilez scrape must never initialize a TPU
+    runtime."""
+    platform, peaks = "cpu", _CPU_PEAKS
+    jx = sys.modules.get("jax")
+    if jx is not None:
+        try:
+            dev = jx.devices()[0]
+            platform = dev.platform
+            if platform == "tpu":
+                kind = getattr(dev, "device_kind", "").lower()
+                for pat, p in _TPU_PEAKS:
+                    if pat in kind:
+                        peaks = p
+                        break
+        except Exception:
+            pass
+    flops = float(_flag("device_peak_flops", 0) or 0) or peaks[0]
+    bw = float(_flag("device_peak_bandwidth", 0) or 0) or peaks[1]
+    ici = float(_flag("device_peak_ici_bandwidth", 0) or 0) or peaks[2]
+    return platform, flops, bw, ici
+
+
+def roofline(flops, bytes_accessed, collective_bytes, peaks=None):
+    """The roofline verdict for one step: time lower bounds at peak
+    compute/memory/comm rates and which dominates.  `peaks` defaults to
+    `device_peaks()`; any missing numerator contributes 0 (an
+    unmeasured axis can never be named the bound)."""
+    if peaks is None:
+        _, pf, pbw, pici = device_peaks()
+    else:
+        pf, pbw, pici = peaks
+    t = {
+        "compute": (flops or 0.0) / max(pf, _EPS),
+        "memory": (bytes_accessed or 0.0) / max(pbw, _EPS),
+        "comm": (collective_bytes or 0.0) / max(pici, _EPS),
+    }
+    bound = max(t, key=t.get)
+    return {"bound": bound if t[bound] > 0 else None,
+            "t_compute_s": t["compute"], "t_memory_s": t["memory"],
+            "t_comm_s": t["comm"]}
+
+
+def _update_mfu(s):
+    """Refresh the pt_mfu / pt_roofline_bound gauges for one signature
+    (called under _lock whenever timing or cost changes)."""
+    if not s["device_steps"] or not s["flops"]:
+        return
+    device_s = s["device_s_sum"] / s["device_steps"]
+    if device_s <= 0:
+        return
+    _, pf, pbw, pici = device_peaks()
+    mfu = s["flops"] / device_s / pf
+    s["mfu"] = mfu
+    _m_mfu().labels(signature=s["label"]).set(mfu)
+    rl = roofline(s["flops"], s["bytes_accessed"],
+                  s["collective_bytes"], peaks=(pf, pbw, pici))
+    s["roofline"] = rl
+    fam = _m_roofline()
+    for bound in ("compute", "memory", "comm"):
+        fam.labels(signature=s["label"], bound=bound).set(
+            1.0 if rl["bound"] == bound else 0.0)
+
+
+def note_cost(label, cost, collective_bytes=None):
+    """Record a signature's XLA cost-model numbers (fed by
+    `_JitExecutable.cost_analysis`).  `cost` is the cost_analysis dict
+    ({"flops": ..., "bytes accessed": ...})."""
+    get = cost.get if hasattr(cost, "get") else (lambda *_: None)
+    with _lock:
+        s = _sig(label)
+        for key, field in (("flops", "flops"),
+                           ("bytes accessed", "bytes_accessed"),
+                           ("transcendentals", "transcendentals")):
+            v = get(key)
+            if v is not None:
+                s[field] = float(v)
+        if collective_bytes is not None:
+            s["collective_bytes"] = float(collective_bytes)
+        _update_mfu(s)
+
+
+def note_collectives(label, hlo_bytes, counts=None):
+    """Record a signature's compiled-HLO collective inventory (fed by
+    the GSPMD executor's HLO capture)."""
+    with _lock:
+        s = _sig(label)
+        s["collective_bytes"] = float(hlo_bytes)
+        if counts is not None:
+            s["collective_counts"] = dict(counts)
+        _update_mfu(s)
+
+
+def signature_stats():
+    """Snapshot of the per-signature attribution table (tests + the
+    /profilez render)."""
+    with _lock:
+        return {k: dict(v) for k, v in _signatures.items()}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring of the last N steps' attribution records plus health
+    events.  Dumps a JSONL postmortem on demand or automatically (slow
+    step, health bad step); auto-dumps are rate-limited to once per half
+    ring so an anomaly storm cannot write unbounded files."""
+
+    def __init__(self, keep=None):
+        self._lock = threading.Lock()
+        # an explicit keep pins the size; the flag-sized default tracks
+        # FLAGS_flight_recorder_steps live (a set_flags mid-run resizes
+        # on the next record)
+        self._keep_from_flags = keep is None
+        self.keep = int(keep if keep is not None
+                        else _flag("flight_recorder_steps", 256))
+        self._ring = collections.deque(maxlen=max(1, self.keep))
+        self._seq = 0
+        self._since_dump = 0
+        self._attempts = 0  # filename counter; advances on failures too
+        self.dumps = 0      # successful writes only
+        self.last_dump_path = None
+        self.last_dump_reason = None
+
+    def _resize_from_flags(self):
+        if not self._keep_from_flags:
+            return
+        keep = int(_flag("flight_recorder_steps", self.keep))
+        if keep != self.keep and keep >= 1:
+            self.keep = keep
+            self._ring = collections.deque(self._ring, maxlen=keep)
+
+    def record(self, rec):
+        with self._lock:
+            self._resize_from_flags()
+            self._seq += 1
+            self._since_dump += 1
+            rec = dict(rec, seq=self._seq, ts=round(time.time(), 6))
+            self._ring.append(rec)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._ring)
+
+    def maybe_auto_dump(self, reason, detail=None):
+        """Auto-trigger path: dump unless one already fired within the
+        last keep//2 records (the postmortem window would mostly repeat
+        itself)."""
+        with self._lock:
+            if self._since_dump < max(1, self.keep // 2) and self.dumps:
+                return None
+        return self.dump(reason=reason, detail=detail)
+
+    def _resolve_dir(self):
+        d = _flag("flight_recorder_dir", "")
+        if d:
+            return d
+        d = os.environ.get("PT_EVENT_LOG_DIR") or _flag("event_log_dir",
+                                                        "")
+        # final fallback is the system tempdir, NOT the cwd: auto-dumps
+        # fire from library code (a health bad step mid-test-suite), and
+        # postmortems must never litter a caller's working tree
+        import tempfile
+
+        return d or tempfile.gettempdir()
+
+    def dump(self, path=None, reason="explicit", detail=None):
+        """Write the ring as a JSONL postmortem: one meta header line,
+        then one line per record (oldest first).  Returns the path, or
+        None when writing failed (losing a postmortem must never kill
+        the run).  The dumps counter and the auto-dump rate-limit window
+        commit only AFTER a successful write — a full disk must neither
+        suppress the next trigger's attempt nor report phantom dumps on
+        /profilez."""
+        with self._lock:
+            records = list(self._ring)
+            # attempt counter (always advances): filename uniqueness
+            # even across failed writes
+            self._attempts += 1
+            n_dump = self._attempts
+        try:
+            if path is None:
+                d = self._resolve_dir()
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(
+                    d, f"flight_{os.getpid()}_{n_dump:03d}.jsonl")
+            meta = {"flight_record": 1, "reason": reason,
+                    "ts": round(time.time(), 6), "keep": self.keep,
+                    "records": len(records),
+                    **_tracing.process_identity()}
+            if detail:
+                meta["detail"] = detail
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps(meta, default=str) + "\n")
+                for rec in records:
+                    fh.write(json.dumps(rec, default=str) + "\n")
+        except OSError as e:
+            warnings.warn(f"flight-recorder dump failed: {e}")
+            return None
+        with self._lock:
+            self.dumps += 1
+            self._since_dump = 0
+            self.last_dump_path = path
+            self.last_dump_reason = reason
+        _m_flight_dumps().labels(reason=reason).inc()
+        try:
+            from . import events as _events
+
+            if _events.enabled():
+                _events.emit("flight_record_dump", reason=reason,
+                             path=path, records=len(records))
+        except Exception:
+            pass
+        return path
+
+    def status(self):
+        with self._lock:
+            return {"keep": self.keep, "size": len(self._ring),
+                    "steps_seen": self._seq, "dumps": self.dumps,
+                    "last_dump_path": self.last_dump_path,
+                    "last_dump_reason": self.last_dump_reason}
+
+
+_flight = FlightRecorder()
+
+
+def flight_recorder():
+    return _flight
+
+
+def dump_flight_record(path=None, reason="explicit"):
+    """Explicitly write the flight-record postmortem (ops entry point)."""
+    return _flight.dump(path=path, reason=reason)
+
+
+def read_flight_record(path):
+    """Parse one flight-record JSONL file -> (meta, records)."""
+    with open(path, encoding="utf-8") as fh:
+        lines = [json.loads(ln) for ln in fh if ln.strip()]
+    if not lines:
+        return {}, []
+    return lines[0], lines[1:]
+
+
+def _queue_depth_sample():
+    """Best-effort prefetch queue depth at this step (None when the
+    prefetcher never registered)."""
+    fam = _metrics.REGISTRY.get("pt_prefetch_queue_depth")
+    if fam is None:
+        return None
+    try:
+        samples = fam._snapshot()["samples"]
+        if not samples:
+            return None
+        return float(next(iter(samples.values())))
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the step sink (fed by fluid.executor._record_step from every lane)
+# ---------------------------------------------------------------------------
+
+
+def note_step(lane, seconds=None, first_run=False):
+    """Book one executed step into the attribution layer: per-signature
+    stats (+ MFU refresh), the slow-step detector, and the flight
+    recorder.  Consumes the phase breakdown the lane's
+    `step_phases(...)` recorder deposited on this thread (if any);
+    ``seconds=None`` uses the recorder's own step total."""
+    pending = _pop_pending(lane)
+    label, phases = lane, None
+    if pending is not None:
+        _plane, label, phases, total = pending
+        if seconds is None:
+            seconds = total
+    if seconds is None:
+        return
+    ensure_profilez_page()
+    slow = None
+    with _lock:
+        s = _sig(label)
+        s["lane"] = lane
+        s["steps"] += 1
+        s["total_s"] += seconds
+        if not first_run:
+            # a signature's first run includes the lazy XLA compile —
+            # folding it into the EMA/MFU would poison both
+            prev = s["ema_step_s"]
+            s["ema_step_s"] = (seconds if prev is None else
+                               prev + (1.0 - _EMA_BETA) * (seconds - prev))
+            device_s = seconds
+            if phases:
+                # device time = dispatch + device_wait: the span from
+                # handing the step to jax to the computation's completion
+                device_s = (phases.get("dispatch", 0.0)
+                            + phases.get("device_wait", 0.0)) or seconds
+            s["device_s_sum"] += device_s
+            s["device_steps"] += 1
+            _update_mfu(s)
+            # slow-step z-score over the per-lane rolling EMA (the PR-10
+            # EMA machinery applied to wall time)
+            zthresh = float(_flag("profile_slow_step_zscore", 8.0) or 0)
+            ema = _lane_ema.setdefault(lane, [None, 0.0, 0])
+            if ema[0] is None:
+                ema[0] = seconds
+            else:
+                dev = seconds - ema[0]
+                z = abs(dev) / ((ema[1] + _EPS) ** 0.5)
+                if (zthresh > 0 and ema[2] >= 8 and dev > 0
+                        and z > zthresh):
+                    slow = {"z": round(z, 2), "ema_s": round(ema[0], 6)}
+                ema[0] += (1.0 - _EMA_BETA) * dev
+                ema[1] = _EMA_BETA * (ema[1]
+                                      + (1.0 - _EMA_BETA) * dev * dev)
+            ema[2] += 1
+    rec = {"kind": "step", "lane": lane, "label": label,
+           "seconds": round(seconds, 6), "first_run": bool(first_run)}
+    if phases:
+        rec["phases"] = {k: round(v, 6) for k, v in phases.items()}
+    qd = _queue_depth_sample()
+    if qd is not None:
+        rec["prefetch_queue_depth"] = qd
+    if slow is not None:
+        rec["slow_step"] = slow
+    _flight.record(rec)
+    if slow is not None:
+        _flight.maybe_auto_dump(
+            "slow_step", detail={"lane": lane, "seconds": seconds, **slow})
+
+
+def note_health_event(kind, action, lane, step=None, replay=False):
+    """Health-sentinel hook (health/sentinel.py books its bad-step
+    metric through here too): the event lands in the flight ring and
+    triggers the postmortem dump — a poisoned run leaves evidence."""
+    _flight.record({"kind": "health", "event": "bad_step",
+                    "detect": kind, "action": action, "lane": lane,
+                    "step": step, "replay": bool(replay)})
+    _flight.maybe_auto_dump(
+        "health", detail={"detect": kind, "action": action, "lane": lane})
+
+
+# ---------------------------------------------------------------------------
+# HLO inventory (promoted from parallel/gspmd/executor.py)
+# ---------------------------------------------------------------------------
+
+_HLO_ITEMSIZE = {"s8": 1, "u8": 1, "pred": 1, "bf16": 2, "f16": 2,
+                 "s16": 2, "u16": 2, "f32": 4, "s32": 4, "u32": 4,
+                 "f64": 8, "s64": 8, "u64": 8}
+
+_COLLECTIVE_KINDS = ("all-to-all", "all-gather", "collective-permute",
+                     "all-reduce", "reduce-scatter")
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(\([^)]*\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"(" + "|".join(_COLLECTIVE_KINDS) + r")(-start)?\(")
+
+
+def _shape_bytes(tok):
+    m = re.match(r"([a-z0-9]+)\[([0-9,]*)\]", tok)
+    if m is None:
+        return 0
+    dt, dims = m.groups()
+    size = 1
+    for d in dims.split(","):
+        if d:
+            size *= int(d)
+    return size * _HLO_ITEMSIZE.get(dt, 4)
+
+
+def hlo_inventory(hlo):
+    """Per-category inventory of an optimized per-device SPMD HLO
+    module's cross-device collectives: ``{kind: {"count": n, "bytes":
+    b}}`` plus a ``total`` entry.  Async ``-start`` forms (TPU's
+    start/done pairs) report a tuple that ALIASES the operand beside the
+    result, so their tuple bytes are halved — else on-chip numbers would
+    double-count against the sync-form CPU ones and every A/B that gates
+    on them would be incomparable."""
+    out = {}
+    total_bytes = total_count = 0
+    for m in _COLLECTIVE_RE.finditer(hlo):
+        nbytes = sum(_shape_bytes(t)
+                     for t in re.findall(r"[a-z0-9]+\[[0-9,]*\]",
+                                         m.group(1)))
+        if m.group(3):  # "-start": (operand alias, result) tuple
+            nbytes //= 2
+        kind = m.group(2)
+        ent = out.setdefault(kind, {"count": 0, "bytes": 0})
+        ent["count"] += 1
+        ent["bytes"] += nbytes
+        total_bytes += nbytes
+        total_count += 1
+    out["total"] = {"count": total_count, "bytes": total_bytes}
+    return out
+
+
+def hlo_collective_bytes(hlo):
+    """Total output bytes of every cross-device collective instruction —
+    the wire payload the executable moves per step (the accounting the
+    ring wire-bytes cross-check and ``pt_gspmd_resharding_bytes`` use)."""
+    return hlo_inventory(hlo)["total"]["bytes"]
+
+
+def hlo_collective_counts(hlo):
+    """{collective kind: instruction count} over an optimized HLO module."""
+    inv = hlo_inventory(hlo)
+    return {k: v["count"] for k, v in inv.items() if k != "total"}
+
+
+# ---------------------------------------------------------------------------
+# /profilez + the bench digest
+# ---------------------------------------------------------------------------
+
+
+def _phase_quantiles():
+    """{lane: {phase: {p50, p95, count}}} from the registry histogram."""
+    fam = _metrics.REGISTRY.get("pt_step_phase_seconds")
+    if fam is None:
+        return {}
+    out = {}
+    snap = fam._snapshot()
+    for key, h in snap["samples"].items():
+        labels = dict(zip(snap["label_names"], key))
+        lane = labels.get("lane", "?")
+        phase = labels.get("phase", "?")
+        out.setdefault(lane, {})[phase] = {
+            "p50": _rq(_metrics.hist_quantile(h, 0.50)),
+            "p95": _rq(_metrics.hist_quantile(h, 0.95)),
+            "sum": round(h["sum"], 6),
+            "count": h["count"],
+        }
+    return out
+
+
+def _rq(v):
+    return None if v is None else round(float(v), 6)
+
+
+def _sig4(v):
+    """4 significant figures at any magnitude — a tiny model's 1e-8 MFU
+    must not round to 0 the way a fixed-decimal round would."""
+    return None if v is None else float(f"{float(v):.4g}")
+
+
+def _family_sum(name):
+    fam = _metrics.REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    snap = fam._snapshot()
+    for sample in snap["samples"].values():
+        total += sample["sum"] if isinstance(sample, dict) else sample
+    return total
+
+
+def feed_verdict():
+    """The ROADMAP "feed is never the bottleneck" claim as a number:
+    consumer stall seconds (pt_prefetch_stall_seconds_total — blocked on
+    an empty queue AFTER the pipeline filled) over executed step seconds
+    (pt_step_seconds sum).  feed_bound names stall fractions above 10%
+    — the feed is eating step time, not hiding behind it."""
+    stall = _family_sum("pt_prefetch_stall_seconds_total")
+    steps = _family_sum("pt_step_seconds")
+    frac = stall / steps if steps > 0 else 0.0
+    return {"stall_seconds_total": round(stall, 6),
+            "step_seconds_total": round(steps, 6),
+            "stall_fraction": round(frac, 6),
+            "feed_bound": bool(steps > 0 and frac > 0.10)}
+
+
+def _signature_payload(s):
+    out = {"lane": s["lane"], "steps": s["steps"],
+           "avg_step_s": _rq(s["total_s"] / s["steps"])
+           if s["steps"] else None,
+           "ema_step_s": _rq(s["ema_step_s"])}
+    if s["device_steps"]:
+        out["device_s_avg"] = _rq(s["device_s_sum"] / s["device_steps"])
+    for k in ("flops", "bytes_accessed", "transcendentals",
+              "collective_bytes"):
+        if s.get(k) is not None:
+            out[k] = s[k]
+    if s.get("collective_counts"):
+        out["collective_counts"] = s["collective_counts"]
+    if s.get("mfu") is not None:
+        out["mfu"] = _sig4(s["mfu"])
+    if s.get("roofline"):
+        rl = s["roofline"]
+        out["roofline"] = {"bound": rl["bound"],
+                           "t_compute_s": _sig4(rl["t_compute_s"]),
+                           "t_memory_s": _sig4(rl["t_memory_s"]),
+                           "t_comm_s": _sig4(rl["t_comm_s"])}
+    return out
+
+
+def profilez_payload():
+    """The /profilez body: the whole attribution surface as JSON."""
+    platform, pf, pbw, pici = device_peaks()
+    return {
+        "device": {"platform": platform, "peak_flops": pf,
+                   "peak_hbm_bytes_per_s": pbw,
+                   "peak_ici_bytes_per_s": pici,
+                   "phases_enabled": _phases_enabled()},
+        "signatures": {label: _signature_payload(s)
+                       for label, s in signature_stats().items()},
+        "phase_seconds": _phase_quantiles(),
+        "feed": feed_verdict(),
+        "flight_recorder": _flight.status(),
+    }
+
+
+def attribution_digest():
+    """The compact attribution record every BENCH_*.json embeds: phase
+    quantiles, per-signature MFU + roofline verdict, and the feed-bound
+    fraction — so a perf record names WHERE its step time went and
+    `tools/perf_compare.py` can diff it mechanically."""
+    sigs = {}
+    for label, s in signature_stats().items():
+        ent = {"lane": s["lane"], "steps": s["steps"]}
+        if s.get("mfu") is not None:
+            ent["mfu"] = _sig4(s["mfu"])
+        if s.get("roofline"):
+            ent["roofline_bound"] = s["roofline"]["bound"]
+        if s["device_steps"]:
+            ent["device_s_avg"] = _rq(s["device_s_sum"]
+                                      / s["device_steps"])
+        sigs[label] = ent
+    return {"phase_seconds": _phase_quantiles(),
+            "signatures": sigs,
+            "feed": feed_verdict(),
+            "flight_recorder": _flight.status()}
+
+
+_page_registered = False
+_page_lock = threading.Lock()
+
+
+def ensure_profilez_page():
+    """Register /profilez on the process exposition servers (idempotent;
+    called from the step sink so any process that runs steps serves the
+    page)."""
+    global _page_registered
+    if _page_registered:
+        return
+    with _page_lock:
+        if _page_registered:
+            return
+        try:
+            from . import exposition as _expo
+
+            _expo.register_page("/profilez", profilez_payload)
+            _page_registered = True
+        except ValueError:
+            # a foreign renderer owns the path — leave it; never fatal
+            _page_registered = True
+
+
+def reset():
+    """Drop all attribution state (tests)."""
+    global _flight
+    with _lock:
+        _signatures.clear()
+        _lane_ema.clear()
+    _flight = FlightRecorder()
+    _tls.pending = None

@@ -615,7 +615,7 @@ def _serv_sync_loop(server, blocks, local, exe, snap_path=None,
     # barrier deadline answering the trainers themselves, and under
     # elastic membership the wait itself renegotiates around dead peers
     while server.wait_round():  # resilience: allow
-        t_round = _time.perf_counter()
+        t_round = _time.perf_counter()  # observability: allow
         received = {}
         for name, payload in server.grads():
             received.setdefault(name, []).append(payload)
@@ -640,7 +640,7 @@ def _serv_sync_loop(server, blocks, local, exe, snap_path=None,
             server.publish(param, np.asarray(local.get(param)))
         server.bump_version()
         server.release_send()
-        round_s = _time.perf_counter() - t_round
+        round_s = _time.perf_counter() - t_round  # observability: allow
         round_hist.observe(round_s)
         _prof._record("ps", "ps:round", round_s)
         if not server.end_round():

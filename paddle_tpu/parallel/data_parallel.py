@@ -625,18 +625,18 @@ class DataParallelRunner:
             _m_cache().labels(path="dp", result="miss").inc()
             if sent is not None:
                 sent.ensure_state(scope)  # before BlockPlan scope checks
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  # observability: allow
             cb = _ShardedBlock(self.program, feed.keys(), fetch_names, self.mesh, scope)
             self._cache[key] = cb
             _m_compile_seconds().labels(
-                path="dp", phase="trace").inc(_time.perf_counter() - t0)
+                path="dp", phase="trace").inc(_time.perf_counter() - t0)  # observability: allow
         else:
             _m_cache().labels(path="dp", result="hit").inc()
         def attempt():
             first_run = not getattr(cb, "_obs_ran", False)
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  # observability: allow
             fetches = cb.run(scope, feed, executor._step)
-            step_s = _time.perf_counter() - t0
+            step_s = _time.perf_counter() - t0  # observability: allow
             _record_step("dp", step_s, first_run)
             cb._obs_ran = True
             self._report_throughput(feed, step_s)
@@ -754,19 +754,36 @@ class _ShardedBlock(_JitExecutable):
         import warnings
 
         from paddle_tpu.fluid import profiler as _prof
+        from paddle_tpu.observability import profiling as _profiling
 
         if not hasattr(self, "_prof_state"):
             self._prof_state = {"ran": False}
-        with _prof.timed_run(f"dp_block@{id(self):x}", self._prof_state) as timer:
-            donated = {n: scope.get(n) for n in self.donated_names}
-            readonly = {n: scope.get(n) for n in self.readonly_names}
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")
-                fetches, out_writes = self._jitted(donated, readonly, dict(feeds),
-                                                   np.uint32(step))
-            for n, v in out_writes.items():
-                scope.set(n, v)
-            timer.done(fetches, out_writes)
-        # PS-mode programs carry host RPC ops — run them, don't drop them
-        self.plan.run_host_ops(scope)
-        return self.plan.assemble_fetches(fetches, scope)
+        # step_phases outermost; timed_run keeps its historic region
+        # (staging..scope-writes) so the "run" span never absorbs the
+        # host RPC tail — fetch_sync brackets accumulate across both
+        with _profiling.step_phases("dp", self.label) as ph:
+            with _prof.timed_run(f"dp_block@{id(self):x}",
+                                 self._prof_state) as timer:
+                with ph.phase("feed_prep"):
+                    donated = {n: scope.get(n)
+                               for n in self.donated_names}
+                    readonly = {n: scope.get(n)
+                                for n in self.readonly_names}
+                with ph.phase("dispatch"):
+                    with warnings.catch_warnings():
+                        warnings.simplefilter("ignore")
+                        fetches, out_writes = self._jitted(
+                            donated, readonly, dict(feeds),
+                            np.uint32(step))
+                with ph.phase("device_wait"):
+                    ph.wait((fetches, out_writes))
+                with ph.phase("fetch_sync"):
+                    for n, v in out_writes.items():
+                        scope.set(n, v)
+                    timer.done(fetches, out_writes)
+            with ph.phase("fetch_sync"):
+                # PS-mode programs carry host RPC ops — run them, don't
+                # drop them
+                self.plan.run_host_ops(scope)
+                out = self.plan.assemble_fetches(fetches, scope)
+        return out

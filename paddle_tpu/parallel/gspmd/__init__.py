@@ -17,6 +17,7 @@ from .executor import (  # noqa: F401
     GSPMDExecutor,
     hlo_collective_bytes,
     hlo_collective_counts,
+    hlo_inventory,
 )
 from . import quant_hook  # noqa: F401
 from .quant_hook import plan_quant_hook, resolve_quant_impl  # noqa: F401

@@ -25,20 +25,22 @@ through `quant_hook.py` when the quant path is opted in.
 
 from __future__ import annotations
 
-import re
 import warnings
 
 import numpy as np
 
 from paddle_tpu.fluid import registry
 from paddle_tpu.fluid.executor import _JitExecutable, trace_block
+from paddle_tpu.observability.profiling import (hlo_collective_bytes,
+                                                hlo_collective_counts,
+                                                hlo_inventory)
 
 from .. import mesh as pmesh
 from . import specs as gspecs
 from .quant_hook import plan_quant_hook
 
 __all__ = ["GSPMDExecutor", "hlo_collective_bytes",
-           "hlo_collective_counts", "prep_feed"]
+           "hlo_collective_counts", "hlo_inventory", "prep_feed"]
 
 
 def prep_feed(feed, fetch_list):
@@ -58,63 +60,12 @@ def prep_feed(feed, fetch_list):
 
 # ---------------------------------------------------------------------------
 # compiled-HLO inspection: what did XLA's partitioner insert?
+#
+# The parser lives in observability/profiling.py now (promoted into the
+# general per-category HLO inventory the MFU/roofline accounting reads);
+# re-exported from the module imports above because this module is where
+# the GSPMD acceptance gates and the bench rungs historically import it.
 # ---------------------------------------------------------------------------
-
-_HLO_ITEMSIZE = {"s8": 1, "u8": 1, "pred": 1, "bf16": 2, "f16": 2,
-                 "s16": 2, "u16": 2, "f32": 4, "s32": 4, "u32": 4,
-                 "f64": 8, "s64": 8, "u64": 8}
-
-_COLLECTIVE_KINDS = ("all-to-all", "all-gather", "collective-permute",
-                     "all-reduce", "reduce-scatter")
-
-_COLLECTIVE_RE = re.compile(
-    r"=\s+(\([^)]*\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
-    r"(" + "|".join(_COLLECTIVE_KINDS) + r")(-start)?\(")
-
-
-def _shape_bytes(tok):
-    m = re.match(r"([a-z0-9]+)\[([0-9,]*)\]", tok)
-    if m is None:
-        return 0
-    dt, dims = m.groups()
-    size = 1
-    for d in dims.split(","):
-        if d:
-            size *= int(d)
-    return size * _HLO_ITEMSIZE.get(dt, 4)
-
-
-def hlo_collective_bytes(hlo):
-    """Sum the output bytes of every cross-device collective instruction
-    in an optimized per-device SPMD HLO module — the wire payload the
-    executable moves per step.  The per-instruction accounting the ring
-    wire-bytes cross-check uses (tests/test_ring_collectives.py), now a
-    library surface feeding ``pt_gspmd_resharding_bytes``.  Async
-    ``-start`` forms (TPU's start/done pairs) report a tuple that
-    ALIASES the operand beside the result, so their tuple bytes are
-    halved — else the on-chip numbers would double-count against the
-    sync-form CPU ones and the PT_BENCH_GSPMD A/B lanes would not be
-    comparable."""
-    total = 0
-    for m in _COLLECTIVE_RE.finditer(hlo):
-        nbytes = sum(_shape_bytes(t)
-                     for t in re.findall(r"[a-z0-9]+\[[0-9,]*\]",
-                                         m.group(1)))
-        if m.group(3):  # "-start": (operand alias, result) tuple
-            nbytes //= 2
-        total += nbytes
-    return total
-
-
-def hlo_collective_counts(hlo):
-    """{collective kind: instruction count} over an optimized HLO module
-    — the inspection surface the GSPMD acceptance gates assert on (XLA
-    inserted the collectives; with the quant hook, int8 payloads appear
-    on permute/all-to-all operands)."""
-    out = {}
-    for m in _COLLECTIVE_RE.finditer(hlo):
-        out[m.group(2)] = out.get(m.group(2), 0) + 1
-    return out
 
 
 def _m_resharding():
@@ -316,27 +267,53 @@ class _GSPMDBlock(_JitExecutable):
             self._hlo_capture_failed = True
             warnings.warn(f"gspmd HLO capture failed: {e}")
             return
+        inv = hlo_inventory(self.last_hlo)
         _m_resharding().labels(signature=self.label).set(
-            float(hlo_collective_bytes(self.last_hlo)))
+            float(inv["total"]["bytes"]))
+        # feed the attribution layer: the collective inventory joins the
+        # cost-model flops/bytes into the per-signature roofline verdict
+        from paddle_tpu.observability import profiling as _profiling
+
+        _profiling.note_collectives(
+            self.label, inv["total"]["bytes"],
+            counts={k: v["count"] for k, v in inv.items()
+                    if k != "total"})
 
     def run(self, scope, feeds, step):
         from paddle_tpu.fluid import profiler as _prof
+        from paddle_tpu.observability import profiling as _profiling
 
-        with _prof.timed_run(self.label, self._prof_state) as timer:
-            donated = {n: scope.get(n) for n in self.donated_names}
-            readonly = {n: scope.get(n) for n in self.readonly_names}
-            args = (donated, readonly, dict(feeds), np.uint32(step))
-            if (self.capture_hlo and self.last_hlo is None
-                    and not getattr(self, "_hlo_capture_failed", False)):
-                self._capture_hlo(self._jit_args(scope, feeds, step))
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")  # donation unsupported on CPU
-                fetches, out_writes = self._jitted(*args)
-            for n, v in out_writes.items():
-                scope.set(n, v)
-            timer.done(fetches, out_writes)
-        self.plan.run_host_ops(scope)
-        return self.plan.assemble_fetches(fetches, scope)
+        # step_phases outermost; timed_run keeps its historic region
+        # (staging..scope-writes) so the "run" span never absorbs the
+        # host-op tail — fetch_sync brackets accumulate across both
+        with _profiling.step_phases("gspmd", self.label) as ph:
+            with _prof.timed_run(self.label, self._prof_state) as timer:
+                with ph.phase("feed_prep"):
+                    donated = {n: scope.get(n)
+                               for n in self.donated_names}
+                    readonly = {n: scope.get(n)
+                                for n in self.readonly_names}
+                    args = (donated, readonly, dict(feeds),
+                            np.uint32(step))
+                    if (self.capture_hlo and self.last_hlo is None
+                            and not getattr(self, "_hlo_capture_failed",
+                                            False)):
+                        self._capture_hlo(
+                            self._jit_args(scope, feeds, step))
+                with ph.phase("dispatch"):
+                    with warnings.catch_warnings():
+                        warnings.simplefilter("ignore")  # donation unsupported on CPU
+                        fetches, out_writes = self._jitted(*args)
+                with ph.phase("device_wait"):
+                    ph.wait((fetches, out_writes))
+                with ph.phase("fetch_sync"):
+                    for n, v in out_writes.items():
+                        scope.set(n, v)
+                    timer.done(fetches, out_writes)
+            with ph.phase("fetch_sync"):
+                self.plan.run_host_ops(scope)
+                out = self.plan.assemble_fetches(fetches, scope)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -439,20 +416,20 @@ class GSPMDExecutor:
             _m_cache().labels(path="gspmd", result="miss").inc()
             if sent is not None:
                 sent.ensure_state(scope)  # before BlockPlan scope checks
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  # observability: allow
             cb = _GSPMDBlock(self, scope, list(feed.keys()), fetch_names,
                              feed_shapes={k: tuple(np.shape(v))
                                           for k, v in feed.items()})
             self._cache[key] = cb
             _m_compile_seconds().labels(
-                path="gspmd", phase="trace").inc(_time.perf_counter() - t0)
+                path="gspmd", phase="trace").inc(_time.perf_counter() - t0)  # observability: allow
         else:
             _m_cache().labels(path="gspmd", result="hit").inc()
         def attempt():
             first_run = key not in self._ran_keys
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  # observability: allow
             fetches = cb.run(scope, feed, self._step)
-            step_s = _time.perf_counter() - t0
+            step_s = _time.perf_counter() - t0  # observability: allow
             _record_step("gspmd", step_s, first_run)
             self._ran_keys.add(key)
             if cb.wire_bytes_per_step:

@@ -641,12 +641,12 @@ class HybridParallelRunner:
             _m_cache().labels(path="hybrid", result="miss").inc()
             if sent is not None:
                 sent.ensure_state(scope)  # before BlockPlan scope checks
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  # observability: allow
             cb = self._compile(scope, list(feed.keys()), fetch_names,
                                n_steps=n_steps, stacked_feed=stacked_feed)
             self._cache[key] = cb
             _m_compile_seconds().labels(
-                path="hybrid", phase="trace").inc(_time.perf_counter() - t0)
+                path="hybrid", phase="trace").inc(_time.perf_counter() - t0)  # observability: allow
         else:
             _m_cache().labels(path="hybrid", result="hit").inc()
         # health sentinel at dispatch granularity (one run() step, or one
@@ -654,9 +654,9 @@ class HybridParallelRunner:
         # and replays the chain)
         def attempt():
             first_run = key not in self._ran_keys
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  # observability: allow
             fetches = cb(scope, feed, self._step)
-            step_s = _time.perf_counter() - t0
+            step_s = _time.perf_counter() - t0  # observability: allow
             _record_step("hybrid", step_s, first_run)
             zgq_bytes = getattr(cb, "_zgq_bytes_per_step", 0)
             if zgq_bytes:
@@ -864,30 +864,45 @@ class HybridParallelRunner:
                 arr.shape, sharding, lambda idx: arr[idx])
 
         def compiled(scope_, feeds, step):
-            don_vals = {n: stage_global(scope_.get(n), don_sh[n])
-                        for n in donated}
-            ro_vals = {n: stage_global(scope_.get(n), ro_sh[n])
-                       for n in readonly}
-            feeds = {n: stage_global(v, feeds_sh[n])
-                     for n, v in feeds.items()}
-            if self.capture_hlo and self.last_hlo is None:
-                self.last_hlo = (
-                    jitted.lower(don_vals, ro_vals, dict(feeds),
-                                 np.uint32(step))
-                    .compile().as_text())
             from paddle_tpu.fluid import profiler as _prof
+            from paddle_tpu.observability import profiling as _profiling
 
-            with _prof.timed_run(f"hybrid_block@{id(jitted):x}",
-                                 prof_state) as timer:
-                with warnings.catch_warnings():
-                    warnings.simplefilter("ignore")  # donation unsupported on CPU
-                    fetches, out_writes = jitted(
-                        don_vals, ro_vals, dict(feeds), np.uint32(step))
-                for n, v in out_writes.items():
-                    scope_.set(n, v)
-                timer.done(fetches, out_writes)
-            plan.run_host_ops(scope_)
-            return plan.assemble_fetches(fetches, scope_)
+            # step_phases outermost; timed_run keeps its historic region
+            # (the jitted call + scope writes only — staging/HLO capture
+            # before it, host ops after) so the "run" span semantics are
+            # unchanged; fetch_sync brackets accumulate across both
+            with _profiling.step_phases(
+                    "hybrid", f"hybrid_block@{id(jitted):x}") as ph:
+                with ph.phase("feed_prep"):
+                    don_vals = {n: stage_global(scope_.get(n), don_sh[n])
+                                for n in donated}
+                    ro_vals = {n: stage_global(scope_.get(n), ro_sh[n])
+                               for n in readonly}
+                    feeds = {n: stage_global(v, feeds_sh[n])
+                             for n, v in feeds.items()}
+                    if self.capture_hlo and self.last_hlo is None:
+                        self.last_hlo = (
+                            jitted.lower(don_vals, ro_vals, dict(feeds),
+                                         np.uint32(step))
+                            .compile().as_text())
+                with _prof.timed_run(f"hybrid_block@{id(jitted):x}",
+                                     prof_state) as timer:
+                    with ph.phase("dispatch"):
+                        with warnings.catch_warnings():
+                            warnings.simplefilter("ignore")  # donation unsupported on CPU
+                            fetches, out_writes = jitted(
+                                don_vals, ro_vals, dict(feeds),
+                                np.uint32(step))
+                    with ph.phase("device_wait"):
+                        ph.wait((fetches, out_writes))
+                    with ph.phase("fetch_sync"):
+                        for n, v in out_writes.items():
+                            scope_.set(n, v)
+                        timer.done(fetches, out_writes)
+                with ph.phase("fetch_sync"):
+                    plan.run_host_ops(scope_)
+                    out = plan.assemble_fetches(fetches, scope_)
+            return out
 
         # modeled ZeRO-gather wire bytes (and fused-update HBM savings)
         # ride on the compiled closure so _dispatch can book them per

@@ -66,6 +66,27 @@ def _m_latency():
         "batching wait, and execution)", labels=("model",))
 
 
+def _m_queue_wait():
+    from paddle_tpu import observability as obs
+
+    return obs.histogram(
+        "pt_serve_queue_wait_seconds",
+        "Queue-entry to batch-formation wait per request — the share of "
+        "pt_serve_request_latency_seconds spent queued/batching; an SLO "
+        "p99 breach with this phase dominant names admission/batching, "
+        "not the device", labels=("model",))
+
+
+def _m_execute():
+    from paddle_tpu import observability as obs
+
+    return obs.histogram(
+        "pt_serve_execute_seconds",
+        "Batch-formation to futures-resolve time per request — the "
+        "execution share of pt_serve_request_latency_seconds (device "
+        "dispatch + output split)", labels=("model",))
+
+
 def _m_batch_size():
     from paddle_tpu import observability as obs
 
@@ -318,6 +339,8 @@ class _ModelLane:
         self._metrics_epoch = obs.REGISTRY.epoch
         name = self.name
         self._lat = _m_latency().labels(model=name)
+        self._queue_wait = _m_queue_wait().labels(model=name)
+        self._execute_hist = _m_execute().labels(model=name)
         self._batch_size = _m_batch_size().labels(model=name)
         self._queue_depth = _m_queue_depth().labels(model=name)
         self._rejected = {r: _m_rejected().labels(model=name, reason=r)
@@ -335,6 +358,8 @@ class _ModelLane:
         # report the DELTA — a fresh lane must not inherit a closed
         # predecessor's p50/p99
         self._lat_baseline = self._lat.hist_data()
+        self._queue_wait_baseline = self._queue_wait.hist_data()
+        self._execute_baseline = self._execute_hist.hist_data()
 
     def _check_metrics_epoch(self):
         """One int compare on the hot path; rebinds the cached label
@@ -632,14 +657,31 @@ class _ModelLane:
 
     def _execute(self, batch, warmup=False):
         self._check_metrics_epoch()
+        # batch-formation timestamp: the boundary between the two halves
+        # of the request-latency split (pt_serve_queue_wait_seconds /
+        # pt_serve_execute_seconds) — taken BEFORE the exec lock, so a
+        # warmup holding the lock counts as execution pressure, not as
+        # a mysteriously long queue
+        t_batch = time.monotonic()  # observability: allow — split anchor
         with self._exec_lock:
-            self._execute_locked(batch, warmup=warmup)
+            self._execute_locked(batch, t_batch, warmup=warmup)
 
-    def _execute_locked(self, batch, warmup=False):
+    def _execute_locked(self, batch, t_batch=None, warmup=False):
+        from paddle_tpu.observability import profiling as _profiling
+
+        if t_batch is None:
+            t_batch = time.monotonic()  # observability: allow
         rows = sum(r.rows for r in batch)
         bucket = self.policy.batch_bucket(rows)
+        # warmup batches are compile time: they stay out of the
+        # attribution surface entirely (NullRecorder), mirroring their
+        # exclusion from the latency SLO histograms below
+        ph = _profiling.step_phases("serve", self.name,
+                                    enabled=not warmup)
+        ph.__enter__()
         try:
-            feed, slices = assemble_batch(batch, bucket)
+            with ph.phase("feed_prep"):
+                feed, slices = assemble_batch(batch, bucket)
             exec_key = (bucket, batch[0].shape_key)
             if warmup:
                 result = "warmup"
@@ -647,7 +689,9 @@ class _ModelLane:
                 result = "warm" if exec_key in self._warm else "cold"
             # validate=False: every request was already validated at
             # submit against the lane's cached vars
-            outputs = self.predictor.run_feed_dict(feed, validate=False)
+            with ph.phase("dispatch"):
+                outputs = self.predictor.run_feed_dict(feed,
+                                                       validate=False)
             # booked only after the run succeeds: a failed batch must
             # not count phantom warm/cold dispatches (each retry would
             # re-book "cold" and drag the /servez hit rate toward 0)
@@ -663,15 +707,18 @@ class _ModelLane:
             # each request's pre-pad sequence length (docs/SERVING.md
             # §2): padding positions must not reach the caller, and the
             # single final-shape copy must not pin the padded batch
-            per_req = split_outputs(outputs, slices,
-                                    seq_pads=[r.seq_pad for r in batch],
-                                    dyn_seq=self._dyn_seq_outputs)
+            with ph.phase("fetch_sync"):
+                per_req = split_outputs(
+                    outputs, slices,
+                    seq_pads=[r.seq_pad for r in batch],
+                    dyn_seq=self._dyn_seq_outputs)
         except BaseException as e:  # resilience: allow — fanned to futures
             # covers post-run splitting/slicing too: an exception there
             # must fail the batch's futures, not kill the scheduler
             # thread and leave callers blocked forever (no future is
             # resolved before this point, so the fan-out never races a
             # set_result)
+            ph.__exit__(type(e), e, None)
             for r in batch:
                 if not r.future.set_running_or_notify_cancel():
                     continue
@@ -679,7 +726,18 @@ class _ModelLane:
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 raise
             return
+        ph.__exit__(None, None, None)
+        if not warmup:
+            # serve steps join the attribution layer too (flight ring +
+            # per-model phase breakdown; seconds from the recorder).  A
+            # cold batch compiled in the request path: first_run=True
+            # keeps the compile seconds out of the serve-lane EMA and
+            # the slow-step detector (a legitimate cold compile must not
+            # burn the flight recorder's rate-limit window on a bogus
+            # "slow step" postmortem)
+            _profiling.note_step("serve", first_run=(result != "warm"))
         now = time.monotonic()
+        execute_s = max(now - t_batch, 0.0)
         for r, out in zip(batch, per_req):
             if (not warmup and r.deadline is not None
                     and now > r.deadline):
@@ -697,9 +755,15 @@ class _ModelLane:
                 r.future.set_result(out)
             if not warmup:
                 # warmup latency is compile time — it must not pollute
-                # the SLO histogram traffic is judged by
+                # the SLO histograms traffic is judged by.  The split:
+                # queue_wait (submit -> batch formation) + execute
+                # (batch formation -> resolve) ≈ the total latency, so
+                # a p99 breach names the guilty phase on /servez
                 self._lat.observe(
                     max(now - r.t_arrival, 0.0))
+                self._queue_wait.observe(
+                    max(t_batch - r.t_arrival, 0.0))
+                self._execute_hist.observe(execute_s)
         if not warmup:
             self._batch_size.observe(rows)
             self._rows["real"].inc(rows)
@@ -940,17 +1004,22 @@ class _ModelLane:
         cache = {k: int(self._cache_counts.get(k, 0))
                  for k in ("warmup", "warm", "cold")}
         dispatched = cache["warm"] + cache["cold"]
-        latency = {}
-        cur = self._lat.hist_data()
-        base = self._lat_baseline
-        h = {"buckets": [(le, c - b) for (le, c), (_, b) in
-                         zip(cur["buckets"], base["buckets"])],
-             "sum": cur["sum"] - base["sum"],
-             "count": cur["count"] - base["count"]}
-        if h["count"] > 0:
-            latency = {"p50": obs.hist_quantile(h, 0.50),
-                       "p99": obs.hist_quantile(h, 0.99),
-                       "count": h["count"]}
+
+        def delta_quantiles(child, baseline):
+            """Lane-local p50/p99 of a process-cumulative histogram: the
+            delta against the bind-time baseline, so a fresh lane never
+            inherits a closed predecessor's figures."""
+            cur = child.hist_data()
+            h = {"buckets": [(le, c - b) for (le, c), (_, b) in
+                             zip(cur["buckets"], baseline["buckets"])],
+                 "sum": cur["sum"] - baseline["sum"],
+                 "count": cur["count"] - baseline["count"]}
+            if h["count"] <= 0:
+                return {}
+            return {"p50": obs.hist_quantile(h, 0.50),
+                    "p99": obs.hist_quantile(h, 0.99),
+                    "count": h["count"]}
+
         return {
             "signature": self.signature,
             "queue_depth": depth,
@@ -961,7 +1030,15 @@ class _ModelLane:
                 cache, hit_rate=(cache["warm"] / dispatched
                                  if dispatched else None)),
             "tenants": tenants,
-            "latency_seconds": latency,
+            "latency_seconds": delta_quantiles(self._lat,
+                                               self._lat_baseline),
+            # the latency SPLIT (docs/SERVING.md): queue_wait = submit
+            # -> batch formation, execute = batch formation -> resolve;
+            # an SLO p99 breach names the guilty phase right here
+            "queue_wait_seconds": delta_quantiles(
+                self._queue_wait, self._queue_wait_baseline),
+            "execute_seconds": delta_quantiles(
+                self._execute_hist, self._execute_baseline),
         }
 
 

@@ -389,3 +389,67 @@ def test_gspmd_flags_roundtrip(monkeypatch):
     monkeypatch.delenv("FLAGS_gspmd_executor")
     monkeypatch.delenv("FLAGS_gspmd_quant_impl")
     importlib.reload(fl)  # restore defaults for other tests
+
+
+def test_profiling_flags_roundtrip(monkeypatch):
+    """The step-time attribution flags (ISSUE 11): phase timing off by
+    default (device_wait's per-step sync would serialize the pipelined
+    dispatch methodology), flight recorder 256 steps, slow-step z 8.0,
+    peak overrides 0 = use the platform table — all round-tripping
+    through env bootstrap and get/set like every other flag."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("profile_phases")["profile_phases"] is False
+    assert fl.get_flags("flight_recorder_steps")[
+        "flight_recorder_steps"] == 256
+    assert fl.get_flags("flight_recorder_dir")[
+        "flight_recorder_dir"] == ""
+    assert fl.get_flags("profile_slow_step_zscore")[
+        "profile_slow_step_zscore"] == 8.0
+    assert fl.get_flags("device_peak_flops")["device_peak_flops"] == 0.0
+    assert fl.get_flags("device_peak_bandwidth")[
+        "device_peak_bandwidth"] == 0.0
+    assert fl.get_flags("device_peak_ici_bandwidth")[
+        "device_peak_ici_bandwidth"] == 0.0
+    try:
+        fl.set_flags({"FLAGS_profile_phases": True,
+                      "FLAGS_flight_recorder_steps": "64",  # str parses
+                      "flight_recorder_dir": "/tmp/fr",
+                      "FLAGS_profile_slow_step_zscore": 4.5,
+                      "FLAGS_device_peak_flops": "1.97e14",
+                      "FLAGS_device_peak_bandwidth": 8.19e11,
+                      "FLAGS_device_peak_ici_bandwidth": 2e11})
+        assert fl.get_flags(
+            ["profile_phases", "flight_recorder_steps",
+             "flight_recorder_dir", "profile_slow_step_zscore",
+             "device_peak_flops", "device_peak_bandwidth",
+             "device_peak_ici_bandwidth"]) == {
+            "profile_phases": True, "flight_recorder_steps": 64,
+            "flight_recorder_dir": "/tmp/fr",
+            "profile_slow_step_zscore": 4.5,
+            "device_peak_flops": 1.97e14,
+            "device_peak_bandwidth": 8.19e11,
+            "device_peak_ici_bandwidth": 2e11}
+    finally:
+        fl.set_flags({"FLAGS_profile_phases": False,
+                      "FLAGS_flight_recorder_steps": 256,
+                      "FLAGS_flight_recorder_dir": "",
+                      "FLAGS_profile_slow_step_zscore": 8.0,
+                      "FLAGS_device_peak_flops": 0.0,
+                      "FLAGS_device_peak_bandwidth": 0.0,
+                      "FLAGS_device_peak_ici_bandwidth": 0.0})
+    monkeypatch.setenv("FLAGS_profile_phases", "1")
+    monkeypatch.setenv("FLAGS_flight_recorder_steps", "128")
+    monkeypatch.setenv("FLAGS_device_peak_flops", "2.75e14")
+    importlib.reload(fl)
+    assert fl.get_flags("profile_phases")["profile_phases"] is True
+    assert fl.get_flags("flight_recorder_steps")[
+        "flight_recorder_steps"] == 128
+    assert fl.get_flags("device_peak_flops")[
+        "device_peak_flops"] == 2.75e14
+    monkeypatch.delenv("FLAGS_profile_phases")
+    monkeypatch.delenv("FLAGS_flight_recorder_steps")
+    monkeypatch.delenv("FLAGS_device_peak_flops")
+    importlib.reload(fl)  # restore defaults for other tests

@@ -75,3 +75,61 @@ def test_serving_package_is_covered_and_clean():
 def test_parse_error_reported_not_raised():
     findings = lint_observability.check_source("def broken(:\n", "x.py")
     assert findings and findings[0][2] == "parse-error"
+
+# ---------------------------------------------------------------------------
+# raw-timing check (ISSUE 11 satellite): bare time.time()/perf_counter()
+# timing outside the audited phase timer is flagged
+# ---------------------------------------------------------------------------
+
+
+def test_flags_raw_timing_pair():
+    src = (
+        "import time\n"
+        "def step():\n"
+        "    t0 = time.perf_counter()\n"
+        "    work()\n"
+        "    return time.perf_counter() - t0\n")
+    findings = lint_observability.check_source(src, "bad.py")
+    assert [f[2] for f in findings] == ["raw-timing", "raw-timing"]
+    assert findings[0][1] == 3 and findings[1][1] == 5
+    assert "step_phases" in findings[0][3]
+
+
+def test_flags_time_time_and_underscore_alias():
+    src = (
+        "import time as _time\n"
+        "a = _time.time()\n"
+        "b = _time.perf_counter()\n")
+    findings = lint_observability.check_source(src, "bad.py")
+    assert len(findings) == 2
+    assert all(f[2] == "raw-timing" for f in findings)
+
+
+def test_raw_timing_allow_mark_and_non_timing_calls():
+    src = (
+        "import time\n"
+        "t = time.perf_counter()  # observability: allow\n"
+        "d = time.monotonic()\n"          # deadline math: not flagged
+        "time.sleep(1)\n"
+        "s = time.strftime('%Y')\n"
+        "x = other.time()\n")             # not the time module
+    assert lint_observability.check_source(src, "a.py") == []
+
+
+def test_raw_timing_exempt_in_observability_package():
+    src = "import time\nt0 = time.perf_counter()\n"
+    prof = REPO / "paddle_tpu" / "observability" / "profiling.py"
+    assert lint_observability.check_file(prof) == []
+    # same source outside an exempt path IS flagged
+    assert lint_observability.check_source(src, "elsewhere.py")
+
+
+def test_metric_name_scanner_matches_registry_surface():
+    names = lint_observability.iter_metric_names()
+    # exact literals from several layers of the stack
+    for expected in ("pt_step_seconds", "pt_step_phase_seconds",
+                     "pt_serve_queue_wait_seconds",
+                     "pt_prefetch_stall_seconds_total", "pt_mfu"):
+        assert names.get(expected) is True, expected
+    # the executor's f-string family surfaces as a prefix
+    assert names.get("pt_xla_") is False

@@ -1,0 +1,92 @@
+"""Metrics-inventory consistency (ISSUE 11 satellite): the
+docs/OBSERVABILITY.md inventory table can never silently drift from the
+registry again.
+
+Code side: `tools/lint_observability.iter_metric_names` statically
+collects every ``pt_*`` family name passed to a
+``counter``/``gauge``/``histogram`` registration call in the tree (the
+registry's instruments are created lazily at call sites, so a static
+scan is the only complete view — an import-time snapshot would miss
+every lazily-registered family).  Doc side: the backticked ``pt_*``
+names in the inventory table's metric column.
+
+Both directions are asserted: a registered family must have an
+inventory row, and a documented row must still exist in code.  The one
+non-exact case — the executor's ``f"pt_xla_{kind}"`` family — is
+matched by its constant prefix.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint_observability import iter_metric_names  # noqa: E402
+
+DOC = REPO / "docs" / "OBSERVABILITY.md"
+
+
+def _doc_inventory_names():
+    """Backticked pt_* names from the metric column of the inventory
+    table (rows may list several names joined with ' / ')."""
+    names = set()
+    for line in DOC.read_text().splitlines():
+        if not line.startswith("| `pt_"):
+            continue
+        metric_cell = line.split("|")[1]
+        names.update(re.findall(r"`(pt_[a-z0-9_]+)`", metric_cell))
+    return names
+
+
+def test_doc_has_inventory_rows():
+    names = _doc_inventory_names()
+    # sanity: the parser actually found the table (not an empty set that
+    # would vacuously pass both directions)
+    assert len(names) > 20, names
+    assert "pt_step_seconds" in names
+
+
+def test_scanner_finds_registrations():
+    code = iter_metric_names()
+    assert "pt_step_seconds" in code and code["pt_step_seconds"]
+    assert "pt_step_phase_seconds" in code
+    # the executor's f-string family registers as a prefix
+    assert code.get("pt_xla_") is False
+
+
+def test_every_registered_family_is_documented():
+    code = iter_metric_names()
+    doc = _doc_inventory_names()
+    prefixes = {n for n, exact in code.items() if not exact}
+    missing = {
+        n for n, exact in code.items()
+        if exact and n not in doc
+    }
+    assert not missing, (
+        f"metric families registered in code but absent from the "
+        f"docs/OBSERVABILITY.md inventory table: {sorted(missing)} — "
+        f"add a row (| `name` | type | labels | reported by |)")
+    # prefix families must prefix at least one documented name
+    dangling = {p for p in prefixes
+                if not any(d.startswith(p) for d in doc)}
+    assert not dangling, (
+        f"f-string metric prefixes with no documented expansion: "
+        f"{sorted(dangling)}")
+
+
+def test_every_documented_row_exists_in_code():
+    code = iter_metric_names()
+    doc = _doc_inventory_names()
+    exact = {n for n, e in code.items() if e}
+    prefixes = {n for n, e in code.items() if not e}
+    ghosts = {
+        d for d in doc
+        if d not in exact and not any(d.startswith(p) for p in prefixes)
+    }
+    assert not ghosts, (
+        f"docs/OBSERVABILITY.md documents metric families no code "
+        f"registers: {sorted(ghosts)} — remove the row or restore the "
+        f"registration")

@@ -4,7 +4,7 @@
 The unified-telemetry PR's CI tripwire: library code must report through
 the shared surfaces — the metrics registry, the JSONL event log, the
 logging module, or warnings — not scatter diagnostics on stdout where no
-schema, no labels and no scrape can reach them.  One check over
+schema, no labels and no scrape can reach them.  Checks over
 ``paddle_tpu/``:
 
   bare-print   a call to the builtin `print()`.  Use
@@ -13,13 +13,30 @@ schema, no labels and no scrape can reach them.  One check over
                mark a deliberate user-facing print (a launcher banner, a
                CLI result) with `# observability: allow`.
 
-Exempt modules (printing IS their exposition surface): the profiler
-(`fluid/profiler.py` summary tables), the debugger
-(`fluid/debugger.py`), and the observability package itself.
+  raw-timing   a call to ``time.time()`` / ``time.perf_counter()``
+               (any module alias) outside the audited timing modules.
+               Step/phase timing belongs on the ONE phase timer
+               (`observability.profiling.step_phases` — it books
+               pt_step_phase_seconds, the chrome-trace spans and the
+               flight recorder in one place); wall-clock timestamps
+               belong on `observability.events`.  A deliberate raw
+               site (a deadline poll, a compile-time measurement that
+               feeds the shared counters) carries the allow mark.
+
+Exempt modules: the profiler (`fluid/profiler.py` — the timing
+primitive itself), the debugger (`fluid/debugger.py`), and the
+observability package (the audited implementations live there).
 
 Suppress a deliberate finding with `# observability: allow` on the same
 line or the line above.  Exit 0 when clean, 1 with findings (one per
 line: `path:lineno: [check] message`).
+
+This module is also the shared metric-name scanner: `iter_metric_names`
+statically collects every ``pt_*`` family name registered through
+``counter(...)``/``gauge(...)``/``histogram(...)`` call sites — the
+docs/OBSERVABILITY.md inventory-consistency test
+(tests/test_metrics_inventory.py) diffs it against the doc table in
+both directions.
 
 Usage: python tools/lint_observability.py [paths...]
   (no args = paddle_tpu/, repo-relative)
@@ -44,6 +61,12 @@ EXEMPT = (
 
 ALLOW_MARK = "observability: allow"
 
+# the raw timing calls the phase timer supersedes: module-attribute
+# calls like time.perf_counter() / _time.time() (any alias importing
+# the stdlib time module)
+_TIMING_ATTRS = ("perf_counter", "time")
+_TIME_MODULE_ALIASES = ("time", "_time")
+
 
 def _allowed(src_lines, lineno):
     """Marker accepted on the flagged line or the line directly above."""
@@ -51,6 +74,16 @@ def _allowed(src_lines, lineno):
         if 0 <= ln < len(src_lines) and ALLOW_MARK in src_lines[ln]:
             return True
     return False
+
+
+def _is_raw_timing_call(node):
+    """time.perf_counter() / time.time() through any stdlib-time module
+    alias (`time`, `_time` — the tree's two import spellings)."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _TIMING_ATTRS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in _TIME_MODULE_ALIASES)
 
 
 def check_source(src: str, path: str = "<string>"):
@@ -71,7 +104,62 @@ def check_source(src: str, path: str = "<string>"):
                  "bare print() in library code — report through "
                  "observability.metrics/events or logging/warnings, or "
                  f"mark a deliberate CLI print `# {ALLOW_MARK}`"))
+        elif _is_raw_timing_call(node) and \
+                not _allowed(lines, node.lineno):
+            findings.append(
+                (path, node.lineno, "raw-timing",
+                 f"raw time.{node.func.attr}() timing in library code — "
+                 "step/phase timing belongs on the audited "
+                 "observability.profiling.step_phases timer (wall "
+                 "timestamps on observability.events); mark a "
+                 f"deliberate raw site `# {ALLOW_MARK}`"))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# metric-name scanner (the inventory-consistency test's code side)
+# ---------------------------------------------------------------------------
+
+
+def _literal_prefix(node):
+    """(name, exact) of a metric-name argument: a Str constant is exact;
+    an f-string (executor's f"pt_xla_{kind}") contributes its constant
+    leading prefix with exact=False."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, True
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value,
+                                                          str):
+            return first.value, False
+    return None, True
+
+
+def iter_metric_names(targets=None):
+    """Statically collect every ``pt_*`` metric family name registered
+    in the tree: first string argument of any
+    ``counter``/``gauge``/``histogram`` call (bare or attribute —
+    ``obs.counter``, ``_metrics.histogram``, ``registry.gauge``...).
+    Returns {name: exact} where exact=False marks an f-string prefix
+    (e.g. ``pt_xla_``) that matches any documented name it prefixes."""
+    out = {}
+    for f in iter_files(targets or DEFAULT_TARGETS):
+        try:
+            tree = ast.parse(f.read_text(), filename=str(f))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if name not in ("counter", "gauge", "histogram"):
+                continue
+            metric, exact = _literal_prefix(node.args[0])
+            if metric and metric.startswith("pt_"):
+                out[metric] = out.get(metric, True) and exact
+    return out
 
 
 def _exempt(rel_str: str) -> bool:

@@ -3,8 +3,9 @@ PY ?= python
 REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 
 .PHONY: test test-book test-onchip bench bench-onchip int8-bench \
-	serve-bench health-bench phase-bench perf-compare lint-api \
-	lint-resilience lint-observability lint-collectives
+	serve-bench health-bench phase-bench pass-bench perf-compare \
+	lint-api lint-resilience lint-observability lint-collectives \
+	lint-passes
 
 test:            ## full suite on the 8-device virtual CPU mesh (~8 min)
 	$(PY) -m pytest tests/ -q --ignore=tests/book
@@ -34,6 +35,9 @@ health-bench:    ## health-sentinel on/off A/B (overhead gate <=2% p50)
 phase-bench:     ## phase-instrumentation on/off A/B (overhead within noise)
 	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_PHASES=1 $(PY) bench.py
 
+pass-bench:      ## graph-passes on/off A/B + per-pass cost attribution
+	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_PASSES=1 $(PY) bench.py
+
 # diff two BENCH records, exit nonzero on regression.  Defaults to the
 # two newest BENCH_*.json in the repo; override: make perf-compare \
 #   OLD=BENCH_r04.json NEW=BENCH_r05.json [PC_ARGS=--threshold-pct=10]
@@ -53,3 +57,6 @@ lint-observability: ## no bare print() diagnostics in library code
 
 lint-collectives: ## raw psum/ppermute sites must route through the kernels layer
 	$(PY) tools/lint_collectives.py
+
+lint-passes:     ## program mutation outside the pass framework / sanctioned transpilers
+	$(PY) tools/lint_passes.py

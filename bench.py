@@ -902,6 +902,110 @@ def _health_ab(size, batch, seq_len, n_steps, bf16):
     return out
 
 
+def _passes_ab(size, batch, seq_len, n_steps, bf16):
+    """PT_BENCH_PASSES=1 A/B rung: the SAME bert step (built UNFUSED —
+    use_flash_attention=False, attn_dropout=0, so the attention pattern
+    is actually on the table) with the graph-optimization pass layer
+    (FLAGS_graph_passes=default) ON vs OFF, arms interleaved round-robin
+    after both warm (the PT_BENCH_HEALTH precedent: sequential arms
+    measure cache warmth as fake deltas on the 2-vCPU container).  The
+    record carries per-arm step quantiles, the on-arm's pass report
+    (sites, op deltas), and the measured per-pass cost_analysis
+    attribution (flops / bytes_accessed deltas per pipeline prefix) —
+    the pt_pass_bytes_saved_total surface, embedded."""
+    import numpy as np
+
+    from paddle_tpu import fluid, passes
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import DataParallelRunner
+
+    kw = dict(vocab_size=30528, attn_dropout=0.0, hidden_dropout=0.0,
+              use_flash_attention=False)
+    cfg = (bert.BertConfig.base(**kw) if size == "base"
+           else bert.BertConfig.tiny(**kw))
+    prior = fluid.get_flags("FLAGS_graph_passes")["FLAGS_graph_passes"]
+    out = {"methodology": "syncfetch per-step, arms interleaved",
+           "steps": n_steps}
+    data = bert.make_fake_batch(cfg, batch=batch, seq_len=seq_len,
+                                seed=0)
+    arms = {}
+
+    def build():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup), \
+                fluid.unique_name.guard():
+            feeds, loss, _mlm, _nsp = bert.build_bert_pretrain(
+                cfg, is_test=False)
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        return main_prog, startup, loss
+
+    try:
+        for arm, spec in (("off", "none"), ("on", "default")):
+            fluid.set_flags({"FLAGS_graph_passes": spec})
+            main_prog, startup, loss = build()
+            _maybe_enable_bf16(main_prog, bf16)
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor()
+                exe.run(startup)
+                runner = DataParallelRunner(main_prog, loss.name)
+                runner.run(exe, data, [loss.name], scope)  # warm
+                runner.run(exe, data, [loss.name], scope)
+            arms[arm] = (runner, exe, scope, loss, [])
+            if arm == "on":
+                rep = getattr(main_prog, "_pass_report", None)
+                if rep:
+                    out["pass_report"] = [
+                        {k: v for k, v in e.items()}
+                        for e in rep if e.get("changed")]
+        for _ in range(n_steps):
+            for arm, (runner, exe, scope, loss, times) in arms.items():
+                with fluid.scope_guard(scope):
+                    t0 = time.perf_counter()
+                    runner.run(exe, data, [loss.name], scope)
+                    times.append(time.perf_counter() - t0)
+        for arm, (_r, _e, _s, _l, times) in arms.items():
+            out[arm] = {
+                "p50_s": round(float(np.percentile(times, 50)), 6),
+                "p95_s": round(float(np.percentile(times, 95)), 6),
+                "max_s": round(float(np.max(times)), 6),
+            }
+        if out["off"]["p50_s"] > 0:
+            out["speedup_p50_pct"] = round(
+                100.0 * (out["off"]["p50_s"] - out["on"]["p50_s"])
+                / out["off"]["p50_s"], 2)
+        # measured per-pass attribution on the single-device lane (the
+        # CPU-measurable cost_analysis deltas; on-chip MFU capture is
+        # the docs/PERF.md placeholder)
+        fluid.set_flags({"FLAGS_graph_passes": "default"})
+        try:
+            import jax
+
+            loss_name = arms["on"][3].name
+            # off-TPU the flash op falls back to the XLA reference —
+            # force the interpret-mode kernel so the cost model sees the
+            # kernel boundary (the S×S tensor's absence), like on-chip
+            force = jax.default_backend() != "tpu"
+            prior_force = os.environ.get("PT_FLASH_FORCE_PALLAS")
+            if force:
+                os.environ["PT_FLASH_FORCE_PALLAS"] = "1"
+            try:
+                out["per_pass_cost"] = passes.attribute_costs(
+                    build, data, fetch_list=[loss_name], spec="default")
+            finally:
+                if force:
+                    if prior_force is None:
+                        os.environ.pop("PT_FLASH_FORCE_PALLAS", None)
+                    else:
+                        os.environ["PT_FLASH_FORCE_PALLAS"] = prior_force
+            out["per_pass_cost"].pop("final_hlo", None)
+        except Exception as e:
+            out["per_pass_cost_error"] = str(e)
+    finally:
+        fluid.set_flags({"FLAGS_graph_passes": prior})
+    return out
+
+
 def _phase_overhead_ab(size, batch, seq_len, n_steps, bf16):
     """PT_BENCH_PHASES=1 A/B rung: the DP step with phase-decomposed
     step timing (FLAGS_profile_phases — the four step_phases brackets
@@ -1198,6 +1302,13 @@ def measure(size):
         sched = getattr(main_prog, "_overlap_schedule", None)
         if sched:
             rec["overlap_schedule"] = sched
+        # graph-optimization pass report (docs/PASSES.md): what each
+        # pass rewrote in the measured program — sites + op-inventory
+        # deltas ride in EVERY record so a claimed headline is
+        # attributable to its rewrites
+        prep = getattr(main_prog, "_pass_report", None)
+        if prep:
+            rec["graph_passes"] = [e for e in prep if e.get("changed")]
         # hop-latency sub-rung: per-hop latency vs payload + the measured
         # ring/oneshot crossover (tunes FLAGS_quant_allreduce_crossover_kb)
         if os.environ.get("PT_BENCH_HOPLAT", "1") == "1":
@@ -1235,6 +1346,15 @@ def measure(size):
                                                  n_steps, bf16)
         except Exception as e:
             print(f"bench: phase A/B rung failed ({e})", file=sys.stderr)
+    # graph-optimization passes on vs off A/B (ISSUE 12): fused
+    # attention + fused bias/gelu/dropout step quantiles per arm plus
+    # the measured per-pass cost_analysis attribution
+    if os.environ.get("PT_BENCH_PASSES") == "1":
+        try:
+            rec["passes_ab"] = _passes_ab(size, batch, seq_len, n_steps,
+                                          bf16)
+        except Exception as e:
+            print(f"bench: passes A/B rung failed ({e})", file=sys.stderr)
     # health-sentinel-on vs -off A/B (ISSUE 10): in-graph finite check +
     # skip gate overhead, gated at <=2% p50 on the CPU smoke
     if os.environ.get("PT_BENCH_HEALTH") == "1":

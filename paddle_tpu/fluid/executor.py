@@ -1085,6 +1085,20 @@ class Executor:
         self._cache.clear()
         self._sentinels.clear()
 
+    def _graph_passes(self, program, fetch_names=()):
+        """Graph-optimization passes (FLAGS_graph_passes, docs/PASSES.md):
+        applied once per program, BEFORE the health sentinel and the
+        executable-cache key (the pass rewrite bumps the program version,
+        so stale executables can never be reused).  The first run's
+        fetch list pins keep_vars — a fetch target must keep its
+        producer even when single-use in-program.  Re-entry is a no-op
+        inside apply_graph_passes (which also warns when the flag
+        flipped after this program was already decided)."""
+        from paddle_tpu import passes as _passes
+
+        _passes.apply_graph_passes(program, lane="single",
+                                   keep_vars=fetch_names)
+
     def _health(self, program):
         """Per-program health sentinel (FLAGS_health_sentinel, the
         single-device lane of docs/DISTRIBUTED.md §6): resolved once per
@@ -1157,6 +1171,7 @@ class Executor:
         import time as _time
 
         block = program.global_block()
+        self._graph_passes(program, fetch_names)  # before cache key
         sent = self._health(program)  # may transpile: before cache key
         key = self._cache_key(program, feed, fetch_names)
         cb = self._cache.get(key)
@@ -1245,6 +1260,7 @@ class Executor:
         # FLAT key extension: key[0] stays id(program) so compiled_for()
         # (and anything else scanning the cache by program) sees chain
         # executables too
+        self._graph_passes(program, fetch_names)  # before cache key
         sent = self._health(program)  # may transpile: before cache key
         key = self._cache_key(program, feed, fetch_names) + (
             "chain", int(n_steps), bool(stacked_feed))

@@ -116,6 +116,14 @@ _DEFS = {
     # backward (the PT_BENCH_OVERLAP A/B baseline).  On by default for
     # the quant path.
     "FLAGS_overlap_allreduce": (True, _parse_bool, True),
+    # graph-optimization pass layer (paddle_tpu/passes/, docs/PASSES.md):
+    # program passes run between construction and executor compile on
+    # every lane.  "default" = the standard pipeline (fuse_attention,
+    # fuse_bias_act_dropout); "none" = off (programs bit-identical to
+    # the pre-pass layer); otherwise a comma-separated ordered list of
+    # registered pass names, with "-name" dropping one from the default
+    # set (e.g. "default,-fuse_attention" or just "-fuse_attention").
+    "FLAGS_graph_passes": ("default", str, True),
     # fused dequant->optimizer-update->requant step kernels
     # (kernels/fused_update.py): eligible buckets keep the reduced
     # gradient in the int8+scales wire format straight into the rewritten

@@ -66,7 +66,7 @@ _GRAD_SUFFIX = "@GRAD"
 # fp32 tensor — the wire-format QScale vector is the detection surface
 _WIRE_FORMAT_OPT_OPS = frozenset({
     "fused_sgd_quant_grad", "fused_adam_quant_grad",
-    "fused_momentum_quant_grad"})
+    "fused_adamw_quant_grad", "fused_momentum_quant_grad"})
 
 
 def _optimizer_ops(ops):

@@ -257,6 +257,16 @@ class AnalysisPredictor:
                                      if config._params_file else None))
         fetch_names = [v.name if hasattr(v, "name") else v
                        for v in fetches]
+        # graph-optimization passes (FLAGS_graph_passes) on the LOADED
+        # program — the serving path motivation: an exported inference
+        # program built from the plain layers API gets the fused
+        # attention/FFN kernels without a model-level opt-in.  The known
+        # fetch list pins keep_vars, so applying here (rather than at
+        # first Executor.run) can never fuse a fetch target away.
+        from paddle_tpu import passes as _graph_passes
+
+        _graph_passes.apply_graph_passes(prog, lane="serving",
+                                         keep_vars=fetch_names)
         if getattr(config, "_ir_optim", True):
             # kernel fusion is XLA's job, but program-level rewrites that
             # still pay (smaller op graphs to trace) run here, mirroring

@@ -356,6 +356,15 @@ def _is_tpu_platform():
 
 
 def _use_pallas():
+    """PT_FLASH_FORCE_PALLAS=1 engages the kernel OFF-TPU too (interpret
+    mode): the blockwise structure — no S×S HBM tensor — survives the
+    interpreter, which is what lets the pass layer's cost attribution
+    measure the kernel-boundary bytes reduction on CPU
+    (passes.attribute_costs / PT_BENCH_PASSES)."""
+    import os
+
+    if os.environ.get("PT_FLASH_FORCE_PALLAS", "") not in ("", "0"):
+        return True
     return _is_tpu_platform()
 
 

@@ -77,10 +77,12 @@ __all__ = [
     "bytes_saved",
     "dequant_slice",
     "adam_math",
+    "adamw_math",
     "sgd_math",
     "momentum_math",
     "quantize_for_gather",
     "fused_adam_update",
+    "fused_adamw_update",
     "fused_sgd_update",
     "fused_momentum_update",
 ]
@@ -145,6 +147,18 @@ def adam_math(p, g32, m1, m2, lr, b1p, b2p, beta1, beta2, epsilon):
     return (p_new, m1n.astype(m1.dtype), m2n.astype(m2.dtype),
             jnp.reshape(b1pf * beta1, jnp.shape(b1p)).astype(b1p.dtype),
             jnp.reshape(b2pf * beta2, jnp.shape(b2p)).astype(b2p.dtype))
+
+
+def adamw_math(p, g32, m1, m2, lr, b1p, b2p, beta1, beta2, epsilon,
+               coeff):
+    """The AdamW update in fp32 — the base Adam step plus the decoupled
+    decay ``p -= lr_raw * coeff * p`` applied to the PRE-update
+    parameter, term-for-term ``ops/optimizer_ops.py`` ``_adamw`` (the
+    decay uses the RAW learning rate, not the bias-corrected step)."""
+    outs = adam_math(p, g32, m1, m2, lr, b1p, b2p, beta1, beta2, epsilon)
+    lr_raw = jnp.reshape(lr, ()).astype(jnp.float32)
+    p_new = outs[0] - lr_raw * coeff * p.astype(jnp.float32)
+    return (p_new,) + outs[1:]
 
 
 def sgd_math(p, g32, lr):
@@ -239,19 +253,23 @@ def _pallas_call(kernel, n_rows, block_size, in_structs, out_structs,
 
 
 def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
-                  requant, interpret):
+                  requant, interpret, lr_decay=0.0):
     """Run the fused chain as a Pallas kernel over [R, B] views.
     ``lr_t`` is the precomputed scalar step size (bias-corrected for
     Adam); returns (p_new or (q_hi, q_lo, sc), m1n, m2n).  ``kind`` is
     "sgd" (stateless), "momentum" (one velocity slot in m1_2, hyper =
-    (mu, use_nesterov, _)), or "adam" (two moment slots, hyper =
-    (beta1, beta2, epsilon))."""
+    (mu, use_nesterov, _)), "adam" (two moment slots, hyper =
+    (beta1, beta2, epsilon)), or "adamw" (adam plus the decoupled decay
+    ``p -= lr_decay * p`` — ``lr_decay`` = raw lr × coeff rides the
+    second lane of the scalar carrier)."""
     from jax.experimental import pallas as pl  # noqa: F401 (import gate)
 
     dual = glo2 is not None
     beta1, beta2, eps = hyper
     R, B = p2.shape
-    lr_arr = jnp.reshape(lr_t, (1, 1)).astype(jnp.float32)
+    lr_arr = jnp.stack(
+        [jnp.reshape(lr_t, ()).astype(jnp.float32),
+         jnp.reshape(lr_decay, ()).astype(jnp.float32)]).reshape(1, 2)
 
     def kernel(*refs):
         i = 0
@@ -262,9 +280,9 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
             lo_ref = refs[i]; i += 1
         sc_ref = refs[i]; i += 1
         m1_ref = m2_ref = None
-        if kind in ("adam", "momentum"):
+        if kind in ("adam", "adamw", "momentum"):
             m1_ref = refs[i]; i += 1
-        if kind == "adam":
+        if kind in ("adam", "adamw"):
             m2_ref = refs[i]; i += 1
         lr_ref = refs[i]; i += 1
         outs = refs[i:]
@@ -272,11 +290,13 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
         p = p_ref[:].astype(jnp.float32)
         lr = lr_ref[0, 0]
         o = 0
-        if kind == "adam":
+        if kind in ("adam", "adamw"):
             m1n = beta1 * m1_ref[:].astype(jnp.float32) + (1 - beta1) * g
             m2n = (beta2 * m2_ref[:].astype(jnp.float32)
                    + (1 - beta2) * jnp.square(g))
             pn = p - lr * m1n / (jnp.sqrt(m2n) + eps)
+            if kind == "adamw":
+                pn = pn - lr_ref[0, 1] * p
         elif kind == "momentum":
             mu, nesterov = beta1, bool(beta2)
             m1n = mu * m1_ref[:].astype(jnp.float32) + g
@@ -291,16 +311,16 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
             outs[o][:] = scale; o += 1
         else:
             outs[o][:] = pn; o += 1
-        if kind in ("adam", "momentum"):
+        if kind in ("adam", "adamw", "momentum"):
             outs[o][:] = m1n; o += 1
-        if kind == "adam":
+        if kind in ("adam", "adamw"):
             outs[o][:] = m2n; o += 1
 
     sds = jax.ShapeDtypeStruct
     ins = [p2, ghi2] + ([glo2] if dual else []) + [gsc2]
-    if kind in ("adam", "momentum"):
+    if kind in ("adam", "adamw", "momentum"):
         ins += [m1_2]
-    if kind == "adam":
+    if kind in ("adam", "adamw"):
         ins += [m2_2]
     ins += [lr_arr]
     out_structs = []
@@ -309,9 +329,9 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
                         sds((R, 1), jnp.float32)]
     else:
         out_structs += [sds((R, B), jnp.float32)]
-    if kind in ("adam", "momentum"):
+    if kind in ("adam", "adamw", "momentum"):
         out_structs += [sds((R, B), jnp.float32)]
-    if kind == "adam":
+    if kind in ("adam", "adamw"):
         out_structs += [sds((R, B), jnp.float32)]
     call = _pallas_call(kernel, R, B,
                         [sds(x.shape, x.dtype) for x in ins],
@@ -325,9 +345,9 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
         result = outs[0]
         o = 1
     m1n = m2n = None
-    if kind in ("adam", "momentum"):
+    if kind in ("adam", "adamw", "momentum"):
         m1n = outs[o]; o += 1
-    if kind == "adam":
+    if kind in ("adam", "adamw"):
         m2n = outs[o]
     return result, m1n, m2n
 
@@ -398,7 +418,8 @@ def _pallas_grad_blocks(grad, block_size, numel_padded):
 
 def fused_adam_update(p, grad, m1, m2, lr, b1p, b2p, *, beta1=0.9,
                       beta2=0.999, epsilon=1e-8,
-                      block_size=DEFAULT_BLOCK_SIZE, requant_pad=None):
+                      block_size=DEFAULT_BLOCK_SIZE, requant_pad=None,
+                      _wd_coeff=None):
     """The fused Adam step.  ``grad`` is an fp32 array shaped like ``p``
     OR a wire-format bucket slice ``(q_hi, q_lo, scales, offset_blocks,
     numel)`` (dequant leg).  ``requant_pad`` non-None additionally emits
@@ -427,10 +448,13 @@ def fused_adam_update(p, grad, m1, m2, lr, b1p, b2p, *, beta1=0.9,
         b2pf = jnp.reshape(b2p, ()).astype(jnp.float32)
         lr_t = (jnp.reshape(lr, ()).astype(jnp.float32)
                 * jnp.sqrt(1 - b2pf) / (1 - b1pf))
+        lr_decay = (jnp.reshape(lr, ()).astype(jnp.float32) * _wd_coeff
+                    if _wd_coeff is not None else 0.0)
         out, m1n2, m2n2 = _pallas_fused(
-            "adam", p2, hi2, lo2, sc2, m1_2, m2_2, lr_t,
+            "adamw" if _wd_coeff is not None else "adam",
+            p2, hi2, lo2, sc2, m1_2, m2_2, lr_t,
             (beta1, beta2, epsilon), requant=requant_pad is not None,
-            interpret=impl() == "interpret")
+            interpret=impl() == "interpret", lr_decay=lr_decay)
 
         def unblk(x2, dtype):
             return x2.reshape(-1)[:numel].reshape(shape).astype(dtype)
@@ -450,14 +474,30 @@ def fused_adam_update(p, grad, m1, m2, lr, b1p, b2p, *, beta1=0.9,
                     b1pn, b2pn, q_hi, q_lo, q_sc)
         return unblk(out, p.dtype), m1n, m2n, b1pn, b2pn
     g = _grad_value(grad, bs, shape)
-    p_new32, m1n, m2n, b1pn, b2pn = adam_math(
-        p, g, m1, m2, lr, b1p, b2p, beta1, beta2, epsilon)
+    if _wd_coeff is not None:
+        p_new32, m1n, m2n, b1pn, b2pn = adamw_math(
+            p, g, m1, m2, lr, b1p, b2p, beta1, beta2, epsilon, _wd_coeff)
+    else:
+        p_new32, m1n, m2n, b1pn, b2pn = adam_math(
+            p, g, m1, m2, lr, b1p, b2p, beta1, beta2, epsilon)
     if requant_pad is not None:
         q_hi, q_lo, q_sc = quantize_for_gather(p_new32, bs,
                                                pad_multiple=requant_pad)
         return (p_new32.astype(p.dtype), m1n, m2n, b1pn, b2pn,
                 q_hi, q_lo, q_sc)
     return p_new32.astype(p.dtype), m1n, m2n, b1pn, b2pn
+
+
+def fused_adamw_update(p, grad, m1, m2, lr, b1p, b2p, *, beta1=0.9,
+                       beta2=0.999, epsilon=1e-8, coeff=0.01,
+                       block_size=DEFAULT_BLOCK_SIZE, requant_pad=None):
+    """The fused AdamW step — :func:`fused_adam_update` plus the
+    decoupled decay (``adamw_math``; Pallas kind "adamw" keeps the whole
+    chain in one VMEM pass).  Same return contract as the Adam form."""
+    return fused_adam_update(
+        p, grad, m1, m2, lr, b1p, b2p, beta1=beta1, beta2=beta2,
+        epsilon=epsilon, block_size=block_size, requant_pad=requant_pad,
+        _wd_coeff=float(coeff))
 
 
 def fused_sgd_update(p, grad, lr, *, block_size=DEFAULT_BLOCK_SIZE,

@@ -56,13 +56,22 @@ def op_rng_key(ctx, attrs):
     fold(seed_or_op_identity, op_index, step) so (a) every random op in a
     program draws an independent stream, (b) streams advance each executor
     step, (c) runs are reproducible given program.random_seed.
+
+    `rng_op_index` attr: a fusion pass that absorbs a random op
+    (paddle_tpu/passes/fuse_bias_act.py swallowing a dropout) stamps the
+    absorbed op's pre-fusion identity here so the fused program draws the
+    SAME mask stream the unfused program would — the pass's cross-program
+    parity contract.
     """
     seed = int(attrs.get("seed", 0) or 0)
     if not seed:
         prog = getattr(ctx, "program", None)
         seed = int(getattr(prog, "random_seed", 0) or 0) or 0x5EED
     base = jax.random.key(np.uint32(seed), impl=_rng_impl())
-    k = jax.random.fold_in(base, np.uint32(getattr(ctx, "op_index", 0)))
+    idx = attrs.get("rng_op_index")
+    if idx is None:
+        idx = getattr(ctx, "op_index", 0)
+    k = jax.random.fold_in(base, np.uint32(idx))
     k = jax.random.fold_in(k, ctx.step)
     # under shard_map, decorrelate streams across devices (each shard of a
     # data-parallel batch must get an independent dropout mask)

@@ -432,3 +432,78 @@ def _fusion_conv_inception(ctx, x, filters, biases, attrs):
     c3 = conv(c2_tail, f3, b3, 1)
     out = jnp.concatenate([branch_a, c1_out, c2_out, c3], axis=1)
     return out, (jnp.zeros_like(pooled),)
+
+
+# ---------------------------------------------------------------------------
+# fused bias + GeLU + dropout (TPU-native, no reference analog): the
+# graph-optimization pass layer (paddle_tpu/passes/fuse_bias_act.py)
+# rewrites the FFN `elementwise_add -> gelu -> [dropout]` chain to this
+# one op — Pallas blockwise kernel on TPU, pure-XLA fallback elsewhere
+# (kernels/fused_bias_act.py).  The dropout mask is SAVED (Mask output,
+# uint8, the standalone dropout op's convention) so forward and backward
+# agree exactly; `rng_op_index` pins the mask stream to the absorbed
+# dropout op's pre-fusion identity, which is what makes the fused
+# program's masks match the unfused program's (the pass's parity gate).
+# ---------------------------------------------------------------------------
+
+
+def _fused_bias_act_grad_maker(op, out_grads, wanted, uniq):
+    outs = {}
+    pairs = []
+    for slot in ("X", "Bias"):
+        n = op.inputs.get(slot, [None])[0]
+        if n is None or n not in wanted:
+            continue
+        g = uniq(n)
+        outs[slot + "@GRAD"] = [g]
+        pairs.append((n, g))
+    if not outs:
+        return [], []
+    ins = {"X": list(op.inputs["X"]), "Bias": list(op.inputs["Bias"]),
+           "Out@GRAD": [out_grads[op.outputs["Out"][0]]]}
+    if op.outputs.get("Mask"):
+        ins["Mask"] = list(op.outputs["Mask"])
+    return [("fused_bias_act_dropout_grad", ins, outs, dict(op.attrs))], pairs
+
+
+@simple_op("fused_bias_act_dropout", ["X", "Bias"], ["Out", "Mask"],
+           grad="custom", grad_maker=_fused_bias_act_grad_maker)
+def _fused_bias_act_dropout(ctx, x, bias, attrs):
+    from paddle_tpu.kernels import fused_bias_act as fba
+
+    from .common import op_rng_key
+
+    act = attrs.get("act", "gelu")
+    if act != "gelu":
+        raise NotImplementedError(
+            f"fused_bias_act_dropout supports act='gelu', got {act!r}")
+    p = float(attrs.get("dropout_prob", 0.0) or 0.0)
+    impl_ = attrs.get("dropout_implementation", "upscale_in_train")
+    if p > 0.0 and impl_ != "upscale_in_train":
+        # the pass only ever emits upscale semantics; a hand-built
+        # downgrade desc must fail loudly — the Pallas branch and the
+        # mask-replay backward both bake the upscale factor in
+        raise NotImplementedError(
+            "fused_bias_act_dropout supports "
+            f"dropout_implementation='upscale_in_train', got {impl_!r}")
+    is_test = bool(attrs.get("is_test", False) or ctx.is_test)
+    key = None
+    if p > 0.0 and not is_test:
+        key = op_rng_key(ctx, attrs)
+    out, mask = fba.fused_bias_gelu_dropout(
+        x, bias, dropout_prob=p, is_test=is_test,
+        approximate=attrs.get("approximate", False), rng_key=key)
+    return out, mask
+
+
+@simple_op("fused_bias_act_dropout_grad",
+           ["X", "Bias", "Mask", "Out@GRAD"], ["X@GRAD", "Bias@GRAD"],
+           grad=None, optional=("Mask",))
+def _fused_bias_act_dropout_grad(ctx, x, bias, mask, dy, attrs):
+    from paddle_tpu.kernels import fused_bias_act as fba
+
+    return fba.fused_bias_gelu_dropout_grad(
+        x, bias, mask, dy,
+        dropout_prob=float(attrs.get("dropout_prob", 0.0) or 0.0),
+        is_test=bool(attrs.get("is_test", False) or ctx.is_test),
+        approximate=attrs.get("approximate", False))

@@ -445,7 +445,7 @@ def _temporal_shift(ctx, x, attrs):
 
 
 @simple_op("flash_attention", ["Q", "K", "V", "Bias"], ["Out"],
-           optional=("Bias",), no_grad_inputs=("Bias",))
+           optional=("Bias",))
 def _flash_attention(ctx, q, k, v, bias, attrs):
     """Blockwise attention without materializing S×S scores — Pallas kernel
     on TPU, XLA reference elsewhere (paddle_tpu/kernels/flash_attention.py).

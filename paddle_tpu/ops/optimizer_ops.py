@@ -397,3 +397,50 @@ def _decoupled_weight_decay(ctx, p, lr, attrs):
     param *= 1 - lr*coeff, applied after the base optimizer update."""
     coeff = attrs.get("coeff", 0.0)
     return p * (1.0 - jnp.reshape(lr, ()).astype(p.dtype) * coeff)
+
+
+@simple_op(
+    "fused_adamw_quant_grad",
+    ["Param", "QHi", "QLo", "QScale", "Moment1", "Moment2", "LearningRate",
+     "Beta1Pow", "Beta2Pow"],
+    ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    grad=None, optional=("QLo",),
+    inplace={"ParamOut": "Param", "Moment1Out": "Moment1",
+             "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+             "Beta2PowOut": "Beta2Pow"},
+)
+def _fused_adamw_quant_grad(ctx, p, qh, ql, qsc, m1, m2, lr, b1p, b2p,
+                            attrs):
+    from paddle_tpu.kernels import fused_update as fu
+
+    g = (qh, ql, qsc, attrs["offset_blocks"], attrs["numel"])
+    return fu.fused_adamw_update(
+        p, g, m1, m2, lr, b1p, b2p,
+        beta1=attrs.get("beta1", 0.9), beta2=attrs.get("beta2", 0.999),
+        epsilon=attrs.get("epsilon", 1e-8),
+        coeff=attrs.get("coeff", 0.01),
+        block_size=attrs.get("block_size", 256))
+
+
+@simple_op(
+    "fused_adamw_quant_gather",
+    ["Param", "Grad", "Moment1", "Moment2", "LearningRate", "Beta1Pow",
+     "Beta2Pow"],
+    ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut",
+     "QHi", "QLo", "QScale"],
+    grad=None,
+    inplace={"ParamOut": "Param", "Moment1Out": "Moment1",
+             "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+             "Beta2PowOut": "Beta2Pow"},
+)
+def _fused_adamw_quant_gather(ctx, p, g, m1, m2, lr, b1p, b2p, attrs):
+    from paddle_tpu.kernels import fused_update as fu
+
+    return fu.fused_adamw_update(
+        p, g, m1, m2, lr, b1p, b2p,
+        beta1=attrs.get("beta1", 0.9), beta2=attrs.get("beta2", 0.999),
+        epsilon=attrs.get("epsilon", 1e-8),
+        coeff=attrs.get("coeff", 0.01),
+        block_size=attrs.get("block_size", 256),
+        requant_pad=(attrs.get("pad_multiple")
+                     or attrs.get("block_size", 256)))

@@ -73,6 +73,7 @@ def fused_update_bytes_counter():
 # dequantizes the member's block-aligned slice inline
 _FUSED_UPDATE_OPS = {"sgd": "fused_sgd_quant_grad",
                      "adam": "fused_adam_quant_grad",
+                     "adamw": "fused_adamw_quant_grad",
                      "momentum": "fused_momentum_quant_grad"}
 
 
@@ -557,6 +558,15 @@ class DataParallelRunner:
 
             gspmd = _flags.flag("gspmd_executor")
         self.gspmd = bool(gspmd)
+        # graph-optimization passes (FLAGS_graph_passes) run BEFORE any
+        # lane transpile — framework.PASS_ORDER's declared contract (the
+        # fused-update/bucket scans must see the final forward graph).
+        # The gspmd branch applies them inside GSPMDExecutor instead.
+        if not self.gspmd:
+            from paddle_tpu import passes as _graph_passes
+
+            _graph_passes.apply_graph_passes(program, lane="dp",
+                                             loss_name=loss_name)
         self._gspmd_exec = None
         if self.gspmd:
             # GSPMD lane: the program stays UNTOUCHED — the global-view

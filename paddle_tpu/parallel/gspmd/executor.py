@@ -202,12 +202,17 @@ class _GSPMDBlock(_JitExecutable):
                 scope_vals.update(readonly)
                 island_in = {n: scope_vals[n]
                              for n in qp.scope_reads_island}
-                carry, grads, stacked = island(island_in, dict(feeds),
-                                               step)
+                carry, grads, fusedq, stacked = island(
+                    island_in, dict(feeds), step)
                 env = dict(scope_vals)
                 env.update(carry)
                 env.update(grads)
-                trace_stage(env, step, qp.ops_opt)
+                # the fused-update leg's keep-quant wire triple: the
+                # rewritten optimizer ops (qp.ops_opt_fused) dequantize
+                # their block slice inline — the reduced fp32 bucket
+                # never materializes on this lane either
+                env.update(fusedq)
+                trace_stage(env, step, qp.ops_opt_fused)
                 fetches = [stacked[island_fetch_pos[n]]
                            if n in island_fetch_pos else env[n]
                            for n in fetch_names_jit]
@@ -218,6 +223,8 @@ class _GSPMDBlock(_JitExecutable):
         # custom_partitioning reducer zeroes the plan's modeled bytes
         self.wire_bytes_per_step = (self.qplan.wire_bytes_per_step
                                     if self.qplan else 0)
+        self.fused_bytes_saved = (self.qplan.fused_bytes_saved
+                                  if self.qplan else 0)
 
         from paddle_tpu.health import wrap_body as _health_gate
 
@@ -348,6 +355,15 @@ class GSPMDExecutor:
         self.policy = policy or gspecs.DataParallelPolicy()
         self.feed_specs = dict(feed_specs or {})
         self._default_scope = scope
+        # graph-optimization passes (FLAGS_graph_passes) BEFORE the
+        # health transpile and any compile — the program stays free of
+        # collective ops (the pass layer only rewrites compute
+        # subgraphs), so the "zero c_allreduce in program" contract of
+        # this lane is untouched
+        from paddle_tpu import passes as _graph_passes
+
+        _graph_passes.apply_graph_passes(program, lane="gspmd",
+                                         loss_name=loss_name)
         # health sentinel (FLAGS_health_sentinel, docs/DISTRIBUTED.md
         # §6): transpiled into the program BEFORE any compile — the
         # check lands in the optimizer leg (post-reduction, global
@@ -438,6 +454,10 @@ class GSPMDExecutor:
                 collective_payload_counter().labels(
                     collective="c_allreduce_quant").inc(
                     cb.wire_bytes_per_step)
+            if cb.fused_bytes_saved:
+                from ..data_parallel import fused_update_bytes_counter
+
+                fused_update_bytes_counter().inc(cb.fused_bytes_saved)
             _report_examples("gspmd", _feed_batch(feed), step_s)
             self._step += 1
             return fetches

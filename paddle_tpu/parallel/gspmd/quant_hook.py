@@ -94,7 +94,7 @@ class QuantHookPlan:
     the same family the transpiler path uses)."""
 
     def __init__(self, plan, program, mesh, axis, block_size, algo,
-                 crossover_kb, impl):
+                 crossover_kb, impl, fused_update=None):
         self.plan = plan
         self.program = program
         self.mesh = mesh
@@ -104,12 +104,18 @@ class QuantHookPlan:
         self.algo = algo
         self.crossover_kb = crossover_kb
         self.impl = impl
+        if fused_update is None:
+            from paddle_tpu.fluid import flags as _flags
+
+            fused_update = _flags.flag("fused_update")
+        self.fused_update = bool(fused_update)
         # per-feed island in_spec axes, set by the executor from its
         # RESOLVED feed specs (feed_specs override > policy.feed_spec,
         # projected onto the batch axis — the only axis the island
         # maps); default: dim 0 on the batch axis
         self.feed_island_specs = {}
         self._classify()
+        self._plan_fused_updates()
         self._model_wire_bytes()
 
     # -- planning ------------------------------------------------------
@@ -186,6 +192,99 @@ class QuantHookPlan:
             reads1.update(set(op.input_arg_names) & scope_vars)
         self.scope_reads_island = sorted(reads1)
 
+    # fused dequant→update→requant leg (the DP transpiler rewrite ported
+    # to this lane, plan-level — the PROGRAM stays unrewritten, the
+    # "zero c_allreduce ops in program" contract holds)
+    _FUSED_OPT_TYPES = {"sgd": "fused_sgd_quant_grad",
+                        "adam": "fused_adam_quant_grad",
+                        "adamw": "fused_adamw_quant_grad",
+                        "momentum": "fused_momentum_quant_grad"}
+    FUSED_Q_HI = "@GSPMD_FUSED_Q@HI"
+    FUSED_Q_LO = "@GSPMD_FUSED_Q@LO"
+    FUSED_Q_SCALE = "@GSPMD_FUSED_Q@SCALE"
+
+    def _plan_fused_updates(self):
+        """FLAGS_fused_update on this lane: quant grads whose ONLY
+        consumer is one sgd/adam/adamw/momentum op keep the reduced
+        bucket in the wire format (``adaptive_quantized_all_reduce_keep``
+        inside the island) and their optimizer ops are replaced — in the
+        TRACE op list only, never the program — by the fused
+        ``*_quant_grad`` forms that dequant their block slice inline.
+
+        Demotions (each leaves the grad on the plain dequantized path):
+        a second consumer (gradient clip, a health_check op covering raw
+        grads — the sentinel's detection surface), a fetch of the grad,
+        the custom_partitioning impl (its reducer returns one fp32
+        tensor; the keep-quant form is island-only), 1-device axes, and
+        alignment bloat past 2x the raw payload (the DP transpiler's
+        sub-block guard)."""
+        self.fused_grads = []
+        self.plain_quant_grads = list(self.quant_grads)
+        self.ops_opt_fused = list(self.ops_opt)
+        self.fused_offsets = {}
+        self.fused_elems = 0
+        self.fused_bytes_saved = 0
+        if (not self.fused_update or self.n <= 1
+                or self.impl == "custom_partitioning"
+                or not self.quant_grads):
+            return
+        from paddle_tpu.fluid.framework import Operator
+        from paddle_tpu.kernels import fused_update as fu
+
+        block = self.plan.block
+        consumers = {}
+        for op in self.plan.ops:
+            for g in set(op.input_arg_names):
+                if g in self.quant_grads:
+                    consumers.setdefault(g, []).append(op)
+        fetched = set(self.plan.jit_fetch_names)
+        opt_ids = {id(op) for op in self.ops_opt}
+        cand = []
+        for g in self.quant_grads:
+            cons = consumers.get(g, [])
+            if (g not in fetched and len(cons) == 1
+                    and id(cons[0]) in opt_ids
+                    and cons[0].type in self._FUSED_OPT_TYPES
+                    and cons[0].inputs.get("Grad") == [g]):
+                cand.append((g, cons[0]))
+        if not cand:
+            return
+        bs = self.block_size
+        off, offsets, shapes = 0, {}, {}
+        raw = 0
+        for g, _op in cand:
+            v = block._find_var_recursive(g)
+            numel = int(np.prod(v.shape))
+            shapes[g] = tuple(v.shape)
+            offsets[g] = off // bs
+            raw += numel
+            off += numel + (-numel) % bs
+        if off > 2 * raw:
+            return  # alignment bloat: keep the plain path (DP guard)
+        rewritten = {}
+        for g, op in cand:
+            inputs = {slot: list(names) for slot, names in op.inputs.items()
+                      if slot != "Grad"}
+            inputs["QHi"] = [self.FUSED_Q_HI]
+            inputs["QLo"] = [self.FUSED_Q_LO]
+            inputs["QScale"] = [self.FUSED_Q_SCALE]
+            attrs = dict(op.attrs)
+            attrs.update(offset_blocks=int(offsets[g]),
+                         numel=int(np.prod(shapes[g])),
+                         block_size=int(bs))
+            rewritten[id(op)] = Operator(
+                block, self._FUSED_OPT_TYPES[op.type], inputs=inputs,
+                outputs={s: list(n) for s, n in op.outputs.items()},
+                attrs=attrs)
+        self.ops_opt_fused = [rewritten.get(id(op), op)
+                              for op in self.ops_opt]
+        self.fused_grads = [g for g, _op in cand]
+        self.plain_quant_grads = [g for g in self.quant_grads
+                                  if g not in set(self.fused_grads)]
+        self.fused_offsets = offsets
+        self.fused_elems = off
+        self.fused_bytes_saved = fu.bytes_saved(off)
+
     def _model_wire_bytes(self):
         from paddle_tpu.kernels import quantized_collectives as qc
         from paddle_tpu.kernels.ring_collectives import select_allreduce_algo
@@ -194,46 +293,80 @@ class QuantHookPlan:
         total, buckets = 0, []
         if self.n > 1:
             elems = 0
-            for g in self.quant_grads:
+            for g in self.plain_quant_grads:
                 v = block._find_var_recursive(g)
                 if v is not None and v.shape and not any(
                         d is None or d < 0 for d in v.shape):
                     elems += int(np.prod(v.shape))
-            if elems:
+            for nelems, fused in ((elems, False),
+                                  (self.fused_elems, True)):
+                if not nelems:
+                    continue
                 resolved = select_allreduce_algo(
-                    elems, self.n, algo=self.algo,
+                    nelems, self.n, algo=self.algo,
                     crossover_kb=self.crossover_kb,
                     block_size=self.block_size)
-                total = qc.wire_bytes(elems, block_size=self.block_size,
-                                      n_devices=self.n, algo=resolved)
-                buckets.append({"elements": elems, "algo": resolved})
+                total += qc.wire_bytes(nelems, block_size=self.block_size,
+                                       n_devices=self.n, algo=resolved)
+                buckets.append({"elements": nelems, "algo": resolved,
+                                "fused_update": fused})
         self.wire_bytes_per_step = total
         self.bucket_report = buckets
 
     # -- the reduction -------------------------------------------------
     def _reduce_quant_bucket(self, env):
-        """Concatenate the quantizable gradients (one bucket — the
-        fuse_all_reduce analog at trace level), scale by 1/n, reduce on
-        the adaptive dual-int8 ring, split back."""
+        """Concatenate the plain (non-fused) quantizable gradients into
+        one bucket — the fuse_all_reduce analog at trace level — scale
+        by 1/n, reduce on the adaptive dual-int8 ring, split back."""
         import jax.numpy as jnp
 
         from paddle_tpu.kernels.ring_collectives import (
             adaptive_quantized_all_reduce)
 
-        if not self.quant_grads:
+        if not self.plain_quant_grads:
             return
-        shapes = [jnp.shape(env[g]) for g in self.quant_grads]
+        shapes = [jnp.shape(env[g]) for g in self.plain_quant_grads]
         flat = jnp.concatenate(
             [jnp.ravel(env[g]).astype(jnp.float32)
-             for g in self.quant_grads]) / self.n
+             for g in self.plain_quant_grads]) / self.n
         red = adaptive_quantized_all_reduce(
             flat, self.axis, block_size=self.block_size,
             algo=self.algo or "auto", crossover_kb=self.crossover_kb)
         off = 0
-        for g, s in zip(self.quant_grads, shapes):
+        for g, s in zip(self.plain_quant_grads, shapes):
             size = int(np.prod(s)) if s else 1
             env[g] = red[off:off + size].reshape(s).astype(env[g].dtype)
             off += size
+
+    def _reduce_fused_bucket(self, env):
+        """Reduce the fused-update bucket KEEPING the wire format: each
+        member pads to a block boundary (the dequant_slice layout the
+        rewritten optimizer ops address by ``offset_blocks``), the
+        concatenation scales by 1/n and rides
+        ``adaptive_quantized_all_reduce_keep`` — the reduced fp32 bucket
+        never materializes (the DP lane's ``c_allreduce_quant_keep``
+        semantics, at trace level)."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.kernels.ring_collectives import (
+            adaptive_quantized_all_reduce_keep)
+
+        if not self.fused_grads:
+            return {}
+        bs = self.block_size
+        parts = []
+        for g in self.fused_grads:
+            flat = jnp.ravel(env[g]).astype(jnp.float32)
+            pad = (-flat.size) % bs
+            if pad:
+                flat = jnp.pad(flat, (0, pad))
+            parts.append(flat)
+        bucket = jnp.concatenate(parts) / self.n
+        hi, lo, sc = adaptive_quantized_all_reduce_keep(
+            bucket, self.axis, block_size=bs, algo=self.algo or "auto",
+            crossover_kb=self.crossover_kb)
+        return {self.FUSED_Q_HI: hi, self.FUSED_Q_LO: lo,
+                self.FUSED_Q_SCALE: sc}
 
     def _reduce_exact(self, env):
         from jax import lax
@@ -267,7 +400,10 @@ class QuantHookPlan:
 
         axis, n = self.axis, self.n
         cp = self.impl == "custom_partitioning" and n > 1
-        carries, gset = self.carries, list(self.grads)
+        carries = self.carries
+        fused = set(self.fused_grads)
+        # fused grads leave as the wire triple, never as fp32 tensors
+        gset = [g for g in self.grads if g not in fused]
         fetches = self.island_fetches
         # the trace records each quant grad's (shape, dtype) here so the
         # post-island bucket split (with_cp_reduce below, traced strictly
@@ -299,12 +435,15 @@ class QuantHookPlan:
                 self._reduce_exact(env)
                 grads = {g: env[g] for g in gset}
                 bucket = None
+                fusedq = self._reduce_fused_bucket(env)
             self._average_carries(env)
             carry = {c: env[c] for c in carries if c in env}
             stacked = [jnp.reshape(env[f], (1,) + tuple(jnp.shape(env[f])))
                        if jnp.ndim(env[f]) == 0 else env[f]
                        for f in fetches]
-            return carry, grads, bucket, stacked
+            if cp:
+                fusedq = {}
+            return carry, grads, bucket, fusedq, stacked
 
         in_specs = (
             {nme: P() for nme in self.scope_reads_island},
@@ -317,17 +456,22 @@ class QuantHookPlan:
         )
         grad_names = self.exact_grads if cp else gset
         bucket_spec = P(axis) if (cp and self.quant_grads) else None
+        # the keep-quant wire triple is replica-identical post-reduction
+        fusedq_names = ((self.FUSED_Q_HI, self.FUSED_Q_LO,
+                         self.FUSED_Q_SCALE)
+                        if (not cp and self.fused_grads) else ())
         out_specs = ({c: P() for c in carries},
                      {g: (P(axis) if cp else P()) for g in grad_names},
                      bucket_spec,
+                     {nme: P() for nme in fusedq_names},
                      [P(axis) for _ in fetches])
         mapped = jax.shard_map(island, mesh=self.mesh, in_specs=in_specs,
                                out_specs=out_specs, check_vma=False)
         if not cp:
             def plain(scope_vals, feeds, step):
-                carry, grads, _bucket, stacked = mapped(scope_vals,
-                                                        feeds, step)
-                return carry, grads, stacked
+                carry, grads, _bucket, fusedq, stacked = mapped(
+                    scope_vals, feeds, step)
+                return carry, grads, fusedq, stacked
 
             return plain
 
@@ -342,8 +486,8 @@ class QuantHookPlan:
             self.bucket_report = []
 
         def with_cp_reduce(scope_vals, feeds, step):
-            carry, grads, bucket, stacked = mapped(scope_vals, feeds,
-                                                   step)
+            carry, grads, bucket, _fusedq, stacked = mapped(
+                scope_vals, feeds, step)
             # exact grads: stacked partials [n, ...] — sum is the exact
             # fp32 reduction, scale folded in
             out = {g: jnp.sum(v, axis=0) / n for g, v in grads.items()}
@@ -356,7 +500,7 @@ class QuantHookPlan:
                     out[g] = red[off:off + size].reshape(shape) \
                         .astype(dtype)
                     off += size
-            return carry, out, stacked
+            return carry, out, {}, stacked
 
         return with_cp_reduce
 

@@ -209,6 +209,13 @@ class HybridParallelRunner:
 
             gspmd = _flags.flag("gspmd_executor")
         self.gspmd = bool(gspmd)
+        # graph-optimization passes (FLAGS_graph_passes) BEFORE the
+        # fused-gather rewrite and the health transpile — the declared
+        # PASS_ORDER; the gspmd branch applies them inside GSPMDExecutor.
+        if not self.gspmd:
+            from paddle_tpu import passes as _graph_passes
+
+            _graph_passes.apply_graph_passes(program, lane="hybrid")
         self._gspmd_exec = None
         if self.gspmd:
             # thin policy selection over the shared partitioned executor
@@ -371,6 +378,7 @@ class HybridParallelRunner:
 
     _FUSED_GATHER_OPS = {"sgd": "fused_sgd_quant_gather",
                          "adam": "fused_adam_quant_gather",
+                         "adamw": "fused_adamw_quant_gather",
                          "momentum": "fused_momentum_quant_gather"}
 
     def _fused_gather_eligible(self, name):

@@ -1,0 +1,49 @@
+"""paddle_tpu.passes — the graph-optimization pass layer
+(docs/PASSES.md).
+
+One program-pass framework between program construction and executor
+compile on every lane (single-device Executor, run_steps chains, DP
+transpiler, hybrid, GSPMD, serving/inference load path):
+
+- ``framework``      — Pass base class, ordered PassManager, selection
+                       (FLAGS_graph_passes), per-pass validation +
+                       idempotence contract, ``program._pass_report``,
+                       pt_pass_* metrics, measured cost attribution.
+- ``fuse_attention`` — the unfused matmul→[bias]→softmax→[dropout]→
+                       matmul attention subgraph rewritten to the
+                       ``flash_attention`` op (Pallas on TPU).
+- ``fuse_bias_act``  — the FFN elementwise_add→gelu→[dropout] chain
+                       rewritten to ``fused_bias_act_dropout``
+                       (kernels/fused_bias_act.py).
+- ``adapters``       — the pre-existing rewriters (DP transpile incl.
+                       the fused-update rewrite, health sentinel)
+                       registered as passes so the ordering contract
+                       lives in ONE place (framework.PASS_ORDER).
+"""
+
+from __future__ import annotations
+
+from . import adapters  # noqa: F401  (registers the transpile adapters)
+from . import fuse_attention  # noqa: F401  (registers fuse_attention)
+from . import fuse_bias_act  # noqa: F401  (registers fuse_bias_act_dropout)
+from .framework import (DEFAULT_PASSES, PASS_ORDER,  # noqa: F401
+                        PassContext, PassManager, ProgramPass,
+                        apply_graph_passes, attribute_costs,
+                        get_program_pass, list_program_passes,
+                        op_inventory, register_program_pass,
+                        resolve_passes)
+
+__all__ = [
+    "ProgramPass",
+    "PassManager",
+    "PassContext",
+    "register_program_pass",
+    "get_program_pass",
+    "list_program_passes",
+    "resolve_passes",
+    "apply_graph_passes",
+    "attribute_costs",
+    "op_inventory",
+    "DEFAULT_PASSES",
+    "PASS_ORDER",
+]

@@ -1,0 +1,74 @@
+"""Transpile adapters: the pre-existing program rewriters registered as
+passes, so ordering against the fusion passes is declared in ONE place
+(framework.PASS_ORDER) instead of implied by runner call sites.
+
+These are THIN adapters — semantics unchanged, the runners keep calling
+the underlying transpiles directly (they need constructor kwargs and
+return values the pass interface doesn't carry).  What registration
+buys: the pass registry can enumerate every sanctioned program
+rewriter, `PassManager` enforces the relative order when a pipeline
+names them explicitly, and tools/lint_passes.py treats exactly this
+inventory (plus the modules behind it) as the sanctioned
+program-mutation surface.
+
+- ``data_parallel_transpile``: parallel.data_parallel.
+  transpile_data_parallel — includes the fused dequant→update→requant
+  DP rewrite (the `fused_update` leg), which is why it is ordered AFTER
+  the fusion passes: the bucket/eligibility scan must see the final
+  forward graph, not one that a later fusion would rewrite under it.
+- ``health_sentinel``: health.transpile.insert_health_sentinel —
+  ordered LAST: its detection point (raw Grad inputs vs the fused
+  buckets' QScale vector) depends on what the DP rewrite produced.
+"""
+
+from __future__ import annotations
+
+from .framework import ProgramPass, register_program_pass
+
+
+@register_program_pass
+class DataParallelTranspilePass(ProgramPass):
+    """Adapter over transpile_data_parallel (multi-devices graph rewrite
+    + quant bucketing + the fused-update rewrite).  Pipeline use needs
+    ``loss_name`` on the ctx; ``num_devices`` defaults to the local
+    device count.  Idempotent via the transpile summary attr."""
+
+    name = "data_parallel_transpile"
+
+    def apply(self, program, ctx):
+        if getattr(program, "_collective_bytes_per_step", None) is not None:
+            return {"changed": False, "sites": 0}
+        import jax
+
+        from paddle_tpu.parallel.data_parallel import (
+            transpile_data_parallel)
+
+        if ctx.loss_name is None:
+            raise ValueError(
+                "data_parallel_transpile needs ctx.loss_name")
+        n = ctx.extra.get("num_devices") or jax.device_count()
+        transpile_data_parallel(
+            program, ctx.loss_name, n,
+            quant_grads=bool(ctx.extra.get("quant_grads", False)))
+        plan = getattr(program, "_quant_allreduce_plan", None) or {}
+        return {"changed": True,
+                "sites": len(plan.get("buckets", [])),
+                "fused_update_sites": sum(
+                    1 for b in plan.get("buckets", [])
+                    if b.get("fused_update"))}
+
+
+@register_program_pass
+class HealthSentinelPass(ProgramPass):
+    """Adapter over health.transpile.insert_health_sentinel (already
+    idempotent via ``program._health_plan``)."""
+
+    name = "health_sentinel"
+
+    def apply(self, program, ctx):
+        from paddle_tpu.health import insert_health_sentinel
+
+        before = getattr(program, "_health_plan", None)
+        plan = insert_health_sentinel(program, loss_name=ctx.loss_name)
+        return {"changed": plan is not None and before is None,
+                "sites": 1 if plan else 0}

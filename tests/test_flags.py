@@ -453,3 +453,29 @@ def test_profiling_flags_roundtrip(monkeypatch):
     monkeypatch.delenv("FLAGS_flight_recorder_steps")
     monkeypatch.delenv("FLAGS_device_peak_flops")
     importlib.reload(fl)  # restore defaults for other tests
+
+
+def test_graph_passes_flag_roundtrip(monkeypatch):
+    """FLAGS_graph_passes (the pass-layer selection string,
+    docs/PASSES.md) registers with the "default" pipeline as its
+    default and round-trips through env bootstrap and get/set."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("graph_passes")["graph_passes"] == "default"
+    try:
+        fl.set_flags({"FLAGS_graph_passes": "none"})
+        assert fl.get_flags("graph_passes")["graph_passes"] == "none"
+        fl.set_flags({"graph_passes": "fuse_attention"})
+        assert fl.get_flags("FLAGS_graph_passes")[
+            "FLAGS_graph_passes"] == "fuse_attention"
+    finally:
+        fl.set_flags({"FLAGS_graph_passes": "default"})
+    monkeypatch.setenv("FLAGS_graph_passes", "-fuse_attention")
+    importlib.reload(fl)
+    assert fl.get_flags("graph_passes")["graph_passes"] == \
+        "-fuse_attention"
+    monkeypatch.delenv("FLAGS_graph_passes")
+    importlib.reload(fl)
+    assert fl.get_flags("graph_passes")["graph_passes"] == "default"

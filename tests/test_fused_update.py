@@ -584,3 +584,97 @@ def test_hybrid_fused_gather_subprocess():
     # quantization DID happen, within the dual-int8 bound
     assert 0.0 < res["w_max_delta"] < 1e-2
     assert res["zgq_booked"] and res["fub_booked"]
+
+
+# ---------------------------------------------------------------------------
+# adamw (ISSUE 12 satellite): same dual-impl + parity gates as
+# adam/momentum/sgd
+# ---------------------------------------------------------------------------
+
+
+def test_fused_adamw_matches_reference_on_quant_grad(monkeypatch):
+    """On a quantized gradient the fused AdamW step equals the reference
+    _adamw math on the dequantized gradient <= 1e-6: the base Adam step
+    plus the decoupled decay with the RAW learning rate."""
+    monkeypatch.setenv("PT_FUSED_UPDATE_IMPL", "xla")
+    p, g, m1, m2 = _mk(11)
+    gq = _quant_grad(g)
+    g_deq = np.asarray(qc.dequantize_block_scaled(gq[0], gq[1], gq[2],
+                                                  BS))[:NUMEL]
+    coeff = 0.02
+    outs = fu.fused_adamw_update(
+        jnp.asarray(p), gq, jnp.asarray(m1), jnp.asarray(m2),
+        coeff=coeff, block_size=BS, **_HYPER)
+    p_adam, m1_ref, m2_ref = _ref_adam(p, g_deq, m1, m2,
+                                       _HYPER["lr"], _HYPER["b1p"],
+                                       _HYPER["b2p"])
+    p_ref = p_adam - float(_HYPER["lr"]) * coeff * p
+    assert np.abs(np.asarray(outs[0]) - p_ref).max() <= 1e-6
+    assert np.abs(np.asarray(outs[1]) - m1_ref).max() <= 1e-6
+    assert np.abs(np.asarray(outs[2]) - m2_ref).max() <= 1e-6
+
+
+def test_fused_adamw_pallas_interpret_matches_xla(monkeypatch):
+    """The Pallas "adamw" kind (interpret mode — Mosaic on TPU) matches
+    the XLA fallback <= 1e-6 on param and both moments, with and without
+    the requant leg (payload within the dual-int8 LSB bound)."""
+    p, g, m1, m2 = _mk(12)
+    gq = _quant_grad(g)
+    outs = {}
+    for impl in ("xla", "interpret"):
+        monkeypatch.setenv("PT_FUSED_UPDATE_IMPL", impl)
+        outs[impl] = fu.fused_adamw_update(
+            jnp.asarray(p), gq, jnp.asarray(m1), jnp.asarray(m2),
+            coeff=0.02, block_size=BS, **_HYPER)
+    for a, b in zip(outs["xla"][:3], outs["interpret"][:3]):
+        assert np.abs(np.asarray(a, "float32")
+                      - np.asarray(b, "float32")).max() <= 1e-6
+    for impl in ("xla", "interpret"):
+        monkeypatch.setenv("PT_FUSED_UPDATE_IMPL", impl)
+        outs[impl] = fu.fused_adamw_update(
+            jnp.asarray(p), gq, jnp.asarray(m1), jnp.asarray(m2),
+            coeff=0.02, block_size=BS, requant_pad=4 * BS, **_HYPER)
+    assert len(outs["xla"]) == 8
+    deq = [np.asarray(qc.dequantize_block_scaled(o[5], o[6], o[7], BS))
+           for o in (outs["xla"], outs["interpret"])]
+    lsb = 2.0 * np.abs(deq[0]).max() / 64516.0
+    assert np.abs(deq[0] - deq[1]).max() <= max(lsb, 1e-6)
+
+
+def test_transpiler_rewrites_adamw_to_fused(monkeypatch):
+    """FLAGS_fused_update + quant bucketing absorbs adamw ops like
+    adam/sgd/momentum: the DP transpile emits fused_adamw_quant_grad on
+    the keep-quant bucket, and the hybrid gather map carries the adamw
+    entry (the ROADMAP phase-2 leftover closed)."""
+    from paddle_tpu import fluid
+    from paddle_tpu.parallel.data_parallel import (_FUSED_UPDATE_OPS,
+                                                   transpile_data_parallel)
+    from paddle_tpu.parallel.hybrid import HybridParallelRunner
+
+    assert _FUSED_UPDATE_OPS["adamw"] == "fused_adamw_quant_grad"
+    assert HybridParallelRunner._FUSED_GATHER_OPS["adamw"] == \
+        "fused_adamw_quant_gather"
+    fluid.set_flags({"FLAGS_quant_allreduce_block_size": 16})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), \
+                fluid.unique_name.guard():
+            np.random.seed(5)
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, size=6, act="relu")
+            pred = fluid.layers.fc(h, size=3, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+            fluid.optimizer.AdamW(0.01, weight_decay=0.02).minimize(loss)
+        transpile_data_parallel(main, loss.name, 4, quant_grads=True,
+                                fused_update=True)
+        types = [op.type for op in main.global_block().ops]
+        assert "fused_adamw_quant_grad" in types
+        assert "adamw" not in types  # every adamw op was absorbed
+        assert "c_allreduce_quant_keep" in types
+        fused = [op for op in main.global_block().ops
+                 if op.type == "fused_adamw_quant_grad"]
+        # the decay coeff rides the rewritten op's attrs
+        assert all(op.attrs.get("coeff") == 0.02 for op in fused)
+    finally:
+        fluid.set_flags({"FLAGS_quant_allreduce_block_size": 256})

@@ -1,0 +1,87 @@
+"""tools/lint_passes.py — the pass-layer CI tripwire: ad-hoc
+``block.ops`` rewrites / ``_insert_op``/``_remove_op`` calls outside
+``paddle_tpu/passes/`` and the sanctioned transpilers bypass the
+ordering, idempotence and attribution contracts (docs/PASSES.md), or
+carry an explicit ``# pass: allow``.  Runs the real lint in tier-1
+(`make lint-passes` is the Makefile entry point)."""
+
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import lint_passes  # noqa: E402
+
+
+def _lint_source(src, name="bad.py"):
+    with tempfile.TemporaryDirectory() as d:
+        p = Path(d) / name
+        p.write_text(src)
+        return lint_passes.lint_file(p, name)
+
+
+def test_library_tree_is_clean():
+    assert lint_passes.main([]) == 0
+
+
+def test_flags_ops_assignment_and_insert_remove():
+    src = (
+        "def rewrite(block):\n"
+        "    block.ops = [op for op in block.ops if keep(op)]\n"
+        "    block._insert_op(0, 'scale')\n"
+        "    block._remove_op(3)\n"
+    )
+    findings = _lint_source(src)
+    assert len(findings) == 3
+    assert all("[program-mutation]" in f for f in findings)
+
+
+def test_flags_ops_list_mutators():
+    src = (
+        "def rewrite(block, op):\n"
+        "    block.ops.append(op)\n"
+        "    block.ops.insert(0, op)\n"
+        "    block.ops.clear()\n"
+    )
+    assert len(_lint_source(src)) == 3
+
+
+def test_self_ops_and_local_lists_pass():
+    src = (
+        "class Plan:\n"
+        "    def __init__(self, plan):\n"
+        "        self.ops = plan.ops\n"
+        "        new_ops = []\n"
+        "        new_ops.append(1)\n"
+    )
+    assert _lint_source(src) == []
+
+
+def test_append_op_is_graph_building_not_mutation():
+    src = "def layer(block):\n    block.append_op('scale')\n"
+    assert _lint_source(src) == []
+
+
+def test_allow_mark_same_line_and_above():
+    same = "def f(block):\n    block.ops = []  # pass: allow\n"
+    above = ("def f(block):\n"
+             "    # pass: allow\n"
+             "    block._remove_op(0)\n")
+    assert _lint_source(same) == []
+    assert _lint_source(above) == []
+
+
+def test_sanctioned_modules_exempt():
+    # the pass framework and the registered transpiler adapters
+    for rel in ("paddle_tpu/passes/fuse_attention.py",
+                "paddle_tpu/parallel/data_parallel.py",
+                "paddle_tpu/health/transpile.py",
+                "paddle_tpu/fluid/transpiler/distribute_transpiler.py"):
+        assert any(rel.startswith(p) for p in lint_passes.EXEMPT_PREFIXES) \
+            or rel in lint_passes.EXEMPT_FILES, rel
+    # cousins must still be linted
+    assert "paddle_tpu/parallel/local_sgd.py" \
+        not in lint_passes.EXEMPT_FILES

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Static program-mutation lint for the library tree.
+
+The graph-optimization pass layer (paddle_tpu/passes/, docs/PASSES.md)
+is the ONE sanctioned home for program rewrites: passes declare their
+order (PASS_ORDER), validate after apply, honor the idempotence
+contract, and attribute what they changed (``program._pass_report``,
+pt_pass_* metrics).  An ad-hoc ``block.ops`` rewrite anywhere else
+bypasses all of it — unordered against the DP/health transpiles,
+invisible to the attribution, and unguarded by the idempotence
+selfcheck.  One check over ``paddle_tpu/``:
+
+  program-mutation   an assignment to ``<x>.ops``, a mutating call on an
+                     ``<x>.ops`` list (insert/append/extend/pop/remove/
+                     clear/sort/reverse), or a ``_insert_op``/
+                     ``_remove_op`` call, outside the pass framework and
+                     the sanctioned transpiler modules.  Move the
+                     rewrite into a registered ProgramPass (or one of
+                     the sanctioned rewriters below) — or mark a
+                     deliberate site with ``# pass: allow``.
+
+``block.append_op`` is NOT flagged: it is the graph-BUILDING api every
+layer uses; this lint polices rewrites of already-built op lists.
+
+Sanctioned modules (they ARE the rewrite surface — each is either the
+pass framework itself, a registered pass/adapter, or the machinery that
+materializes programs in the first place):
+``paddle_tpu/passes/*``, ``parallel/data_parallel.py``,
+``parallel/hybrid.py``, ``parallel/pipeline.py``,
+``health/transpile.py``, ``fluid/transpiler/*``, ``fluid/ir.py``,
+``fluid/framework.py``, ``fluid/io.py``, ``fluid/proto_compat.py``,
+``fluid/contrib/slim/*``, ``fluid/contrib/mixed_precision/*``.
+
+Suppress a deliberate finding with ``# pass: allow`` on the same line or
+the line above.  Exit 0 when clean, 1 with findings (one per line:
+``path:lineno: [check] message``).
+
+Usage: python tools/lint_passes.py [paths...]
+  (no args = paddle_tpu/, repo-relative)
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+DEFAULT_TARGETS = ["paddle_tpu"]
+
+EXEMPT_PREFIXES = (
+    "paddle_tpu/passes/",
+    "paddle_tpu/fluid/transpiler/",
+    "paddle_tpu/fluid/contrib/slim/",
+    "paddle_tpu/fluid/contrib/mixed_precision/",
+)
+
+EXEMPT_FILES = (
+    "paddle_tpu/parallel/data_parallel.py",
+    "paddle_tpu/parallel/hybrid.py",
+    "paddle_tpu/parallel/pipeline.py",
+    "paddle_tpu/parallel/gspmd/quant_hook.py",  # plan-level op list only
+    "paddle_tpu/health/transpile.py",
+    "paddle_tpu/fluid/ir.py",
+    "paddle_tpu/fluid/framework.py",
+    "paddle_tpu/fluid/io.py",
+    "paddle_tpu/fluid/proto_compat.py",
+    "paddle_tpu/fluid/contrib/ptq.py",  # the PTQ rewrite (ir quant family)
+)
+
+MUTATORS = ("insert", "append", "extend", "pop", "remove", "clear",
+            "sort", "reverse")
+
+ALLOW_MARK = "pass: allow"
+
+
+def _allowed(lines, lineno):
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines) and ALLOW_MARK in lines[ln - 1]:
+            return True
+    return False
+
+
+def _is_ops_attr(node):
+    """``<x>.ops`` where <x> is not ``self`` — an object's OWN ``ops``
+    attribute (BlockPlan.ops, a compiled block's op cache) is its
+    business; a foreign block's op list is the program surface this
+    lint protects."""
+    return (isinstance(node, ast.Attribute) and node.attr == "ops"
+            and not (isinstance(node.value, ast.Name)
+                     and node.value.id == "self"))
+
+
+def lint_file(path: Path, rel: str):
+    try:
+        src = path.read_text()
+        tree = ast.parse(src)
+    except (OSError, SyntaxError) as e:  # pragma: no cover
+        return [f"{rel}:0: [parse] {e}"]
+    lines = src.splitlines()
+    findings = []
+
+    def flag(node, msg):
+        if not _allowed(lines, node.lineno):
+            findings.append(f"{rel}:{node.lineno}: [program-mutation] "
+                            f"{msg}")
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if _is_ops_attr(t):
+                    flag(node, "assignment to a block's .ops list "
+                               "outside the pass framework")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in ("_insert_op", "_remove_op"):
+                    flag(node, f"{f.attr}() outside the pass framework")
+                elif f.attr in MUTATORS and _is_ops_attr(f.value):
+                    flag(node, f".ops.{f.attr}() outside the pass "
+                               "framework")
+    return findings
+
+
+def main(argv):
+    targets = argv or DEFAULT_TARGETS
+    findings = []
+    for t in targets:
+        base = (REPO / t) if not Path(t).is_absolute() else Path(t)
+        files = [base] if base.is_file() else sorted(base.rglob("*.py"))
+        for f in files:
+            rel = str(f.relative_to(REPO)) if f.is_relative_to(REPO) \
+                else str(f)
+            if any(rel.startswith(p) for p in EXEMPT_PREFIXES) \
+                    or rel in EXEMPT_FILES:
+                continue
+            findings.extend(lint_file(f, rel))
+    for line in findings:
+        print(line)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

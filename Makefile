@@ -3,9 +3,9 @@ PY ?= python
 REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 
 .PHONY: test test-book test-onchip bench bench-onchip int8-bench \
-	serve-bench health-bench phase-bench pass-bench perf-compare \
-	lint-api lint-resilience lint-observability lint-collectives \
-	lint-passes
+	serve-bench decode-bench health-bench phase-bench pass-bench \
+	perf-compare lint-api lint-resilience lint-observability \
+	lint-collectives lint-passes
 
 test:            ## full suite on the 8-device virtual CPU mesh (~8 min)
 	$(PY) -m pytest tests/ -q --ignore=tests/book
@@ -28,6 +28,9 @@ int8-bench:      ## int8 vs bf16 vs fp32 dense-serving A/B
 
 serve-bench:     ## serving-engine load generator (throughput + p50/p99)
 	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_SERVE=1 $(PY) bench.py
+
+decode-bench:    ## decode-lane load-gen: tokens/s vs naive, steady-state compiles==0, p99
+	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_DECODE=1 $(PY) bench.py
 
 health-bench:    ## health-sentinel on/off A/B (overhead gate <=2% p50)
 	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_HEALTH=1 $(PY) bench.py

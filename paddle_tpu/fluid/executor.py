@@ -693,6 +693,45 @@ def _apply_compile_cache():
         warnings.warn(f"persistent compile cache disabled: {e}")
 
 
+# serializes _persistent_cache_optout users: the jax compilation-cache
+# switch is process-global, so an unlocked flip-and-restore from two
+# threads (serving warmup vs the decode scheduler's first dispatch)
+# could restore the cache to ON mid-way through a stamped program's
+# compile — re-exposing exactly the brittle deserialize the stamp
+# exists to avoid
+_cache_optout_lock = threading.RLock()
+
+
+@contextlib.contextmanager
+def _persistent_cache_optout(program, first_dispatch):
+    """Disable the jax compilation cache around a compile of a program
+    stamped `_no_persistent_compile_cache` on platforms where
+    DESERIALIZING such a program's cache entry corrupts the heap
+    (platform_utils.persistent_cache_deserialize_brittle — the
+    jaxlib-0.4.3x XLA:CPU line vs the decode lane's paged
+    gather/scatter programs).  No-op after the block's first dispatch
+    (the executable is resident; the cache is only consulted at
+    compile time) and everywhere the deserialize path is healthy."""
+    if not first_dispatch or not getattr(
+            program, "_no_persistent_compile_cache", False):
+        yield
+        return
+    from .platform_utils import persistent_cache_deserialize_brittle
+
+    if not persistent_cache_deserialize_brittle():
+        yield
+        return
+    import jax
+
+    with _cache_optout_lock:
+        prev = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            yield
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
+
+
 class _FeedScopeView:
     """Scope facade for pre-stage host ops: get() resolves feed values
     first, set() always lands in the real scope."""
@@ -825,6 +864,51 @@ class _CompiledBlock(_JitExecutable):
         self.place = place
         self.label = f"program@{id(program):x}/v{program._version}"
         self._prof_state = {"ran": False}
+        # AOT-loaded/compiled executable (fluid/aot_cache.py) — when
+        # set, run() dispatches it instead of the lazy jit
+        self._aot = None
+        self._dispatched = False  # first dispatch = lazy-compile point
+
+    def setup_aot(self, scope, feeds):
+        """FLAGS_aot_cache_dir path: try to DESERIALIZE this signature's
+        executable ("aot_hit" — no trace, no compile); on a cache miss,
+        AOT-compile now and serialize it for the next restart
+        ("aot_saved").  Returns the outcome ("aot_hit" / "aot_saved" /
+        None = disabled or failed, lazy jit takes over)."""
+        from . import aot_cache
+
+        if not aot_cache.enabled():
+            return None
+        import time as _time
+
+        args = self._jit_args(scope, feeds, 0)
+        key = aot_cache.executable_key(self.plan.program, args,
+                                       self.fetch_names)
+        t0 = _time.perf_counter()  # observability: allow
+        loaded = aot_cache.load(key)
+        if loaded is not None:
+            self._aot = loaded
+            _m_compile_seconds().labels(path="single", phase="aot_load") \
+                .inc(_time.perf_counter() - t0)  # observability: allow
+            return "aot_hit"
+        try:
+            t0 = _time.perf_counter()  # observability: allow
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # donation unsupported on CPU
+                with _persistent_cache_optout(self.plan.program, True):
+                    compiled = self._jitted.lower(*args).compile()
+            _m_compile_seconds().labels(
+                path="single", phase="aot_compile").inc(
+                _time.perf_counter() - t0)  # observability: allow
+        except Exception as e:  # resilience: allow — best-effort cache
+            warnings.warn(f"AOT compile for {self.label} failed "
+                          f"({e!r}); lazy jit path takes over")
+            return None
+        if aot_cache.save(key, compiled):
+            self._aot = compiled
+            return "aot_saved"
+        self._aot = compiled  # still usable in-process
+        return None
 
     def run(self, scope, feeds, step):
         import jax
@@ -858,9 +942,12 @@ class _CompiledBlock(_JitExecutable):
                 with ph.phase("dispatch"):
                     with warnings.catch_warnings():
                         warnings.simplefilter("ignore")  # donation unsupported on CPU backend
-                        fetches, out_writes = self._jitted(
-                            donated, readonly, feed_vals, np.uint32(step)
-                        )
+                        with _persistent_cache_optout(
+                                self.plan.program, not self._dispatched):
+                            fetches, out_writes = (self._aot or self._jitted)(
+                                donated, readonly, feed_vals, np.uint32(step)
+                            )
+                        self._dispatched = True
                 with ph.phase("device_wait"):
                     ph.wait((fetches, out_writes))
                 with ph.phase("fetch_sync"):
@@ -1178,7 +1265,6 @@ class Executor:
         if cb is None:
             from . import profiler as _prof
 
-            _m_cache().labels(path="single", result="miss").inc()
             if sent is not None:
                 sent.ensure_state(scope)  # before BlockPlan scope checks
             t0 = _time.perf_counter()  # observability: allow
@@ -1189,6 +1275,19 @@ class Executor:
             _prof._record("trace", cb.label, trace_s)
             _m_compile_seconds().labels(path="single",
                                         phase="trace").inc(trace_s)
+            # AOT path (FLAGS_aot_cache_dir): a deserialized executable
+            # books "aot_hit" — NOT "miss" — and its first run carries
+            # no compile, so the jit_first_run booking is skipped too
+            # (the zero-compile-restart contract the decode lane's
+            # acceptance measures).  An AOT save still counts as a miss
+            # (the compile ran, booked under phase="aot_compile").
+            aot = cb.setup_aot(scope, feed)
+            if aot == "aot_hit":
+                _m_cache().labels(path="single", result="aot_hit").inc()
+            else:
+                _m_cache().labels(path="single", result="miss").inc()
+            if aot is not None:
+                cb._obs_ran = True  # first run has no lazy compile
         else:
             _m_cache().labels(path="single", result="hit").inc()
         # run timing ("compile+run" on a signature's first run — jit compiles

@@ -84,6 +84,14 @@ _DEFS = {
     # loader warning), and jax's cache key does not cover host features.
     # (callable default: resolved at bootstrap — host-dependent path)
     "FLAGS_compile_cache_dir": (lambda: _default_cache_dir(), str, True),
+    # AOT-serialized executables (fluid/aot_cache.py): beyond the warm
+    # XLA cache above, the executor pickles each compiled executable
+    # keyed by a restart-stable signature and a restarted process
+    # DESERIALIZES it — no Python re-trace, no XLA compile, the
+    # fleet-restart story (pt_compile_cache_total{result="aot_hit"}).
+    # Empty disables (default); the dir is machine-specific like the
+    # fingerprinted compile cache (the key pins platform/device/jaxlib).
+    "FLAGS_aot_cache_dir": ("", str, True),
     # quantized gradient all-reduce (EQuARX-style): the data-parallel
     # transpiler buckets same-dtype grads into fused buffers and
     # all-reduces them block-scaled int8 (ops/collective_ops.py

@@ -15,6 +15,7 @@ from ..param_attr import ParamAttr
 
 __all__ = [
     "fc", "embedding", "flash_attention", "moe_ffn",
+    "paged_attention", "kv_cache_write", "kv_cache_write_pages",
     "conv2d", "conv3d", "conv2d_transpose", "pool2d",
     "batch_norm", "layer_norm", "group_norm", "instance_norm", "dropout",
     "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
@@ -1411,3 +1412,56 @@ def moe_ffn(x, num_experts, d_ff, top_k=2, act="gelu", param_attr=None,
                      outputs={"Out": [out]},
                      attrs={"top_k": int(top_k), "act": act})
     return out
+
+
+def paged_attention(q, k_pages, v_pages, page_table, q_start,
+                    sm_scale=None, force=None, name=None):
+    """Attention of q [B, n_heads, T, d] against pool K/V read THROUGH a
+    per-sequence page table (decode serving lane, docs/SERVING.md
+    "Decode lane"; kernels/paged_attention.py — Pallas on TPU, lax
+    gather reference on CPU).  Query i of row b attends global key
+    positions j <= q_start[b] + i."""
+    helper = LayerHelper("paged_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    if force is not None:
+        attrs["force"] = force
+    helper.append_op("paged_attention",
+                     inputs={"Q": [q], "KPages": [k_pages],
+                             "VPages": [v_pages],
+                             "PageTable": [page_table],
+                             "QStart": [q_start]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def kv_cache_write(pages, new, page_idx, offset, name=None):
+    """Scatter one decode step's K or V rows (new [B, n, d]) into the
+    KV pool at per-slot (page_idx[b], offset[b]) coordinates; returns
+    the updated pool var (aliasing `pages` — XLA buffer donation, the
+    pool is never doubled).  Payload dtype must match the pool dtype
+    (trace-time error otherwise — the mixed-precision guard)."""
+    helper = LayerHelper("kv_cache_write", name=name)
+    # PagesOut IS Pages (the optimizer-op ParamOut convention): the pool
+    # var is persistable, so the executor writes the update back to the
+    # scope and donates the old buffer
+    helper.append_op("kv_cache_write",
+                     inputs={"Pages": [pages], "New": [new],
+                             "PageIdx": [page_idx], "Offset": [offset]},
+                     outputs={"PagesOut": [pages]})
+    return pages
+
+
+def kv_cache_write_pages(pages, new, page_idx, name=None):
+    """Scatter a prefill chunk's K or V (new [C, n, d], C a multiple of
+    the pool page size) into whole pool pages page_idx [C/page_size];
+    returns the updated pool var (aliasing `pages`).  Same dtype guard
+    as kv_cache_write."""
+    helper = LayerHelper("kv_cache_write_pages", name=name)
+    helper.append_op("kv_cache_write_pages",
+                     inputs={"Pages": [pages], "New": [new],
+                             "PageIdx": [page_idx]},
+                     outputs={"PagesOut": [pages]})
+    return pages

@@ -63,3 +63,22 @@ def host_callbacks_supported():
     that need callbacks must check this at lowering time and raise a clear
     error instead (VERDICT r2 weak#4)."""
     return default_platform() in ("cpu", "cuda", "gpu", "rocm")
+
+
+def persistent_cache_deserialize_brittle():
+    """True when this jaxlib's XLA:CPU is known to corrupt the heap while
+    DESERIALIZING persistent-compilation-cache entries of the decode
+    lane's paged gather/scatter programs (the 0.4.3x line; reproduced
+    deterministically-per-heap-layout — a warm cache aborted 5/5 while
+    the identical programs compiled fresh pass 3/3).  Programs stamped
+    `_no_persistent_compile_cache` skip the jax compilation cache at
+    their first dispatch when this returns True (fluid/executor.py);
+    real-TPU processes keep the warm-cache restart story untouched."""
+    if default_platform() in TPU_PLATFORMS:
+        return False
+    try:
+        import jaxlib.version
+
+        return jaxlib.version.__version_info__ < (0, 5, 0)
+    except Exception:  # pragma: no cover - jaxlib layout moved
+        return False

@@ -78,11 +78,13 @@ __all__ = [
     "dequant_slice",
     "adam_math",
     "adamw_math",
+    "lamb_math",
     "sgd_math",
     "momentum_math",
     "quantize_for_gather",
     "fused_adam_update",
     "fused_adamw_update",
+    "fused_lamb_update",
     "fused_sgd_update",
     "fused_momentum_update",
 ]
@@ -159,6 +161,30 @@ def adamw_math(p, g32, m1, m2, lr, b1p, b2p, beta1, beta2, epsilon,
     lr_raw = jnp.reshape(lr, ()).astype(jnp.float32)
     p_new = outs[0] - lr_raw * coeff * p.astype(jnp.float32)
     return (p_new,) + outs[1:]
+
+
+def lamb_math(p, g32, m1, m2, lr, b1p, b2p, beta1, beta2, epsilon,
+              weight_decay):
+    """The LAMB update in fp32 — term-for-term ``ops/optimizer_ops.py``
+    ``_lamb``: Adam moments, bias correction, ``r = mhat/(sqrt(vhat)+
+    eps) + wd*p``, and the layer-wise trust ratio ``|p| / |r|`` scaling
+    the step.  Returns ``(p_new32, m1n, m2n, b1pn, b2pn)``."""
+    g32 = g32.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    m1n = beta1 * m1.astype(jnp.float32) + (1 - beta1) * g32
+    m2n = beta2 * m2.astype(jnp.float32) + (1 - beta2) * jnp.square(g32)
+    b1pf = jnp.reshape(b1p, ()).astype(jnp.float32)
+    b2pf = jnp.reshape(b2p, ()).astype(jnp.float32)
+    mhat = m1n / (1 - b1pf)
+    vhat = m2n / (1 - b2pf)
+    r = mhat / (jnp.sqrt(vhat) + epsilon) + weight_decay * p32
+    pn = jnp.sqrt(jnp.sum(jnp.square(p32)))
+    rn = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+    p_new = p32 - jnp.reshape(lr, ()).astype(jnp.float32) * trust * r
+    return (p_new, m1n.astype(m1.dtype), m2n.astype(m2.dtype),
+            jnp.reshape(b1pf * beta1, jnp.shape(b1p)).astype(b1p.dtype),
+            jnp.reshape(b2pf * beta2, jnp.shape(b2p)).astype(b2p.dtype))
 
 
 def sgd_math(p, g32, lr):
@@ -498,6 +524,31 @@ def fused_adamw_update(p, grad, m1, m2, lr, b1p, b2p, *, beta1=0.9,
         p, grad, m1, m2, lr, b1p, b2p, beta1=beta1, beta2=beta2,
         epsilon=epsilon, block_size=block_size, requant_pad=requant_pad,
         _wd_coeff=float(coeff))
+
+
+def fused_lamb_update(p, grad, m1, m2, lr, b1p, b2p, *, beta1=0.9,
+                      beta2=0.999, epsilon=1e-6, weight_decay=0.01,
+                      block_size=DEFAULT_BLOCK_SIZE, requant_pad=None):
+    """The fused LAMB step — same contract as :func:`fused_adam_update`
+    (wire-format bucket slice OR fp32 gradient; optional requant leg).
+
+    LAMB intentionally rides the XLA path only — no Pallas kind: the
+    trust ratio needs GLOBAL ``|p|``/``|r|`` norms over the whole
+    parameter, a cross-tile reduction the one-pass blockwise VMEM
+    kernel cannot produce (it would need a second pass over every tile
+    after the norms close, forfeiting the stay-in-VMEM point).  XLA
+    still fuses the dequant into the update chain, so the fp32 gradient
+    slice never persists as its own HBM buffer."""
+    shape, bs = jnp.shape(p), int(block_size)
+    g = _grad_value(grad, bs, shape)
+    p_new32, m1n, m2n, b1pn, b2pn = lamb_math(
+        p, g, m1, m2, lr, b1p, b2p, beta1, beta2, epsilon, weight_decay)
+    if requant_pad is not None:
+        q_hi, q_lo, q_sc = quantize_for_gather(p_new32, bs,
+                                               pad_multiple=requant_pad)
+        return (p_new32.astype(p.dtype), m1n, m2n, b1pn, b2pn,
+                q_hi, q_lo, q_sc)
+    return p_new32.astype(p.dtype), m1n, m2n, b1pn, b2pn
 
 
 def fused_sgd_update(p, grad, lr, *, block_size=DEFAULT_BLOCK_SIZE,

@@ -95,6 +95,38 @@ def decoder_layer_incremental(x, caches, cfg: GPTConfig, name):
     return _ffn_block(x, cfg, name), (k_cat, v_cat)
 
 
+class KVSink(list):
+    """Prefill K/V sink with a STAMPED cache dtype (and recorded
+    shapes): `gpt_decoder(kv_sink=KVSink(dtype="float32"))` inserts an
+    explicit cast op on every captured K/V, so the program carries the
+    cache dtype instead of inheriting whatever the dtype policy lowers
+    the attention chain to.  Without the stamp, a bf16-AMP prefill
+    silently hands bf16 arrays to an fp32 KV pool (the policy rides the
+    LOWERING, not the program, so the vars all claim fp32) — the pool
+    write then either implicit-upcasts garbage-precision values or
+    trips the kv_cache_write dtype guard at trace time depending on the
+    consumer.  A plain list keeps the historic behavior (cache dtype
+    follows the compute dtype — what the in-graph generate variants
+    want, where cache and compute must agree)."""
+
+    def __init__(self, dtype=None):
+        super().__init__()
+        self.dtype = dtype
+        self.shapes = []
+
+    def append(self, kv):
+        k, v = kv
+        if self.dtype:
+            # always stamp (an identity convert is free — XLA folds it):
+            # skipping the cast when the VAR dtype already matches would
+            # lose the stamp exactly when the dtype policy makes var and
+            # runtime dtype disagree
+            k = layers.cast(k, self.dtype)
+            v = layers.cast(v, self.dtype)
+        self.shapes.append(tuple(k.shape or ()))
+        super().append((k, v))
+
+
 def causal_self_attention(x, cfg: GPTConfig, name, is_test=False,
                           kv_sink=None):
     h, n = cfg.hidden_size, cfg.num_heads
@@ -268,10 +300,16 @@ def build_gpt_generate(cfg: GPTConfig, prompt_len, gen_len, beam_size=1,
         logp3 = L.reshape(logp, shape=[-1, k, cfg.vocab_size])
         ids, scores, parent = L.beam_search(pre_ids, pre_scores, logp3,
                                             beam_size=k, end_id=end_id)
-        # reorder histories by parent and append the chosen tokens
-        onehot = L.one_hot(parent, k)                    # [B,K,K]
-        hist_f = L.cast(hist, "float32")
-        hist = L.cast(L.matmul(onehot, hist_f), "int64")
+        # reorder histories by parent and append the chosen tokens.
+        # k == 1 skips the reorder (parent is identically 0) — and MUST:
+        # one_hot on a [B, 1] input follows the reference's trailing-1
+        # squeeze semantics and would collapse the beam rank (the same
+        # guard _reorder_beam_dim has always had; greedy build was
+        # broken before it)
+        if k > 1:
+            onehot = L.one_hot(parent, k)                # [B,K,K]
+            hist_f = L.cast(hist, "float32")
+            hist = L.cast(L.matmul(onehot, hist_f), "int64")
         hist = L.concat([hist, L.unsqueeze(ids, axes=[2])], axis=2)
         pre_ids, pre_scores = ids, scores
         step_ids.append(L.unsqueeze(ids, axes=[0]))
@@ -530,3 +568,197 @@ def make_fake_lm_batch(cfg: GPTConfig, batch, seq_len, seed=0):
                                (batch, 1)),
         "gpt_labels": toks[:, 1:seq_len + 1],
     }
+
+
+# ---------------------------------------------------------------------------
+# Paged decode lane (serving/decode.py): fixed-shape prefill-chunk and
+# decode-step programs over a paged KV pool (serving/kv_pool.py).  The
+# pool vars are PERSISTABLE program vars — the executor donates their
+# buffers, so the pool updates in place across steps, never copied.
+# ---------------------------------------------------------------------------
+
+KV_POOL_PREFIX = "@KVPOOL@"
+
+
+def kv_pool_var_names(num_layers, prefix=KV_POOL_PREFIX):
+    """The per-layer (K, V) pool var names the decode-lane programs and
+    serving.kv_pool.KVPool agree on."""
+    return [(f"{prefix}k_l{i}", f"{prefix}v_l{i}")
+            for i in range(num_layers)]
+
+
+def _declare_pool_vars(cfg: GPTConfig, num_pages, page_size, dtype,
+                       prefix=KV_POOL_PREFIX):
+    n, d = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    block = fluid.default_main_program().global_block()
+    out = []
+    for kn, vn in kv_pool_var_names(cfg.num_layers, prefix):
+        out.append(tuple(
+            block.create_var(name=nm,
+                             shape=[num_pages, page_size, n, d],
+                             dtype=dtype, persistable=True)
+            for nm in (kn, vn)))
+    return out
+
+
+def build_gpt_decode_step(cfg: GPTConfig, pool_slots, num_pages,
+                          page_size, max_pages, pool_dtype="float32",
+                          pool_prefix=KV_POOL_PREFIX, attn_force=None):
+    """ONE token-level decode step over the paged KV pool — the single
+    fixed-shape executable the continuous-batching scheduler dispatches
+    every step (zero steady-state recompiles: every feed shape below is
+    static in `pool_slots`/`max_pages`).
+
+    Per slot s: embed dec_tok[s] at position dec_pos[s], write each
+    layer's new K/V at (dec_write_page[s], dec_write_off[s]), attend the
+    slot's pool prefix through dec_page_table[s], and emit the greedy
+    next token (log_softmax → argmax — the same op chain the
+    whole-sequence lane scores beams with, so greedy decode is
+    comparable token for token).  Inactive slots carry page-table zeros
+    (the pool's trash page) and position 0; their outputs are garbage
+    the scheduler ignores.
+
+    Returns (feed_names, next_tok [pool_slots] int64, logprobs
+    [pool_slots, vocab])."""
+    L = layers
+    h, n = cfg.hidden_size, cfg.num_heads
+    d = h // n
+    ps = int(pool_slots)
+
+    tok = fluid.data("dec_tok", [ps, 1], False, dtype="int64")
+    pos = fluid.data("dec_pos", [ps, 1], False, dtype="int64")
+    page_table = fluid.data("dec_page_table", [ps, int(max_pages)],
+                            False, dtype="int32")
+    write_page = fluid.data("dec_write_page", [ps], False, dtype="int32")
+    write_off = fluid.data("dec_write_off", [ps], False, dtype="int32")
+    pool = _declare_pool_vars(cfg, num_pages, page_size, pool_dtype,
+                              pool_prefix)
+    q_start = L.cast(L.reshape(pos, shape=[-1]), "int32")  # [PS]
+
+    emb = L.embedding(tok, size=[cfg.vocab_size, cfg.hidden_size],
+                      param_attr=ParamAttr(name="gpt_word_embedding"))
+    pemb = L.embedding(pos, size=[cfg.max_position, cfg.hidden_size],
+                       param_attr=ParamAttr(name="gpt_pos_embedding"))
+    x = L.reshape(L.elementwise_add(emb, pemb), shape=[-1, 1, h])
+
+    for li in range(cfg.num_layers):
+        name = f"decoder_layer_{li}"
+        xa = _ln(x, name + "_ln_attn")
+        q = _fc(xa, h, name + "_att_query_fc",
+                init_std=cfg.initializer_range)
+        k = _fc(xa, h, name + "_att_key_fc",
+                init_std=cfg.initializer_range)
+        v = _fc(xa, h, name + "_att_value_fc",
+                init_std=cfg.initializer_range)
+        q_h = L.transpose(L.reshape(q, shape=[0, 0, n, d]),
+                          perm=[0, 2, 1, 3])               # [PS, n, 1, d]
+        k_pool, v_pool = pool[li]
+        L.kv_cache_write(k_pool, L.reshape(k, shape=[-1, n, d]),
+                         write_page, write_off)
+        L.kv_cache_write(v_pool, L.reshape(v, shape=[-1, n, d]),
+                         write_page, write_off)
+        ctx = L.paged_attention(q_h, k_pool, v_pool, page_table, q_start,
+                                sm_scale=float(d) ** -0.5,
+                                force=attn_force)
+        ctx = L.reshape(L.transpose(ctx, perm=[0, 2, 1, 3]),
+                        shape=[0, 0, h])
+        attn = _fc(ctx, h, name + "_att_output_fc",
+                   init_std=cfg.initializer_range)
+        x = _ffn_block(L.elementwise_add(x, attn), cfg, name)
+
+    logits = _lm_logits(_ln(x, "gpt_final_ln"), cfg)       # [PS, V]
+    logp = L.log_softmax(logits)
+    next_tok = L.argmax(logp, axis=-1)                     # [PS] int64
+    feeds = ["dec_tok", "dec_pos", "dec_page_table", "dec_write_page",
+             "dec_write_off"]
+    return feeds, next_tok, logp
+
+
+def build_gpt_prefill_chunk(cfg: GPTConfig, chunk_len, num_pages,
+                            page_size, max_pages, pool_dtype="float32",
+                            pool_prefix=KV_POOL_PREFIX, attn_force=None):
+    """One prefill CHUNK of a single sequence through the paged pool —
+    the phase-split half of the decode lane: long prompts stream
+    through this fixed-shape executable `ceil(P/chunk_len)` times
+    (never stalling the decode step for a whole-prompt pass), each call
+    writing the chunk's K/V into whole pool pages and attending the
+    previously-written prefix through the page table.
+
+    `chunk_len` must be a multiple of `page_size` (chunks cover whole
+    pages; the write is a clean page scatter).  The K/V captured here
+    is cast to `pool_dtype` via the same stamping contract as
+    KVSink(dtype=...) — a bf16-AMP prefill cannot silently hand bf16
+    arrays to an fp32 pool.
+
+    Feeds: pf_tok/pf_pos [1, C] int64 (positions clamped host-side for
+    the padded tail), pf_page_table [1, max_pages] int32,
+    pf_write_pages [C/page_size] int32 (trash page 0 past the valid
+    tail), pf_qstart [1] int32 (tokens already in the pool),
+    pf_last_idx [1] int64 (index of the last VALID token in this chunk
+    — only the final chunk's next-token output is consumed).
+
+    Returns (feed_names, next_tok [1] int64, logprobs [1, vocab])."""
+    L = layers
+    h, n = cfg.hidden_size, cfg.num_heads
+    d = h // n
+    c = int(chunk_len)
+    if c % int(page_size):
+        raise ValueError(
+            f"prefill chunk_len {c} must be a multiple of page_size "
+            f"{page_size} (chunks write whole pages)")
+
+    tok = fluid.data("pf_tok", [1, c], False, dtype="int64")
+    pos = fluid.data("pf_pos", [1, c], False, dtype="int64")
+    page_table = fluid.data("pf_page_table", [1, int(max_pages)], False,
+                            dtype="int32")
+    write_pages = fluid.data("pf_write_pages", [c // int(page_size)],
+                             False, dtype="int32")
+    q_start = fluid.data("pf_qstart", [1], False, dtype="int32")
+    last_idx = fluid.data("pf_last_idx", [1], False, dtype="int64")
+    pool = _declare_pool_vars(cfg, num_pages, page_size, pool_dtype,
+                              pool_prefix)
+
+    emb = L.embedding(tok, size=[cfg.vocab_size, cfg.hidden_size],
+                      param_attr=ParamAttr(name="gpt_word_embedding"))
+    pemb = L.embedding(pos, size=[cfg.max_position, cfg.hidden_size],
+                       param_attr=ParamAttr(name="gpt_pos_embedding"))
+    x = L.elementwise_add(emb, pemb)                       # [1, C, H]
+
+    sink_dtype = pool_dtype  # the KVSink dtype-stamping contract
+    for li in range(cfg.num_layers):
+        name = f"decoder_layer_{li}"
+        xa = _ln(x, name + "_ln_attn")
+        q = _fc(xa, h, name + "_att_query_fc",
+                init_std=cfg.initializer_range)
+        k = _fc(xa, h, name + "_att_key_fc",
+                init_std=cfg.initializer_range)
+        v = _fc(xa, h, name + "_att_value_fc",
+                init_std=cfg.initializer_range)
+        q_h = L.transpose(L.reshape(q, shape=[0, 0, n, d]),
+                          perm=[0, 2, 1, 3])               # [1, n, C, d]
+        k_pool, v_pool = pool[li]
+        L.kv_cache_write_pages(
+            k_pool, L.cast(L.reshape(k, shape=[-1, n, d]), sink_dtype),
+            write_pages)
+        L.kv_cache_write_pages(
+            v_pool, L.cast(L.reshape(v, shape=[-1, n, d]), sink_dtype),
+            write_pages)
+        ctx = L.paged_attention(q_h, k_pool, v_pool, page_table, q_start,
+                                sm_scale=float(d) ** -0.5,
+                                force=attn_force)
+        ctx = L.reshape(L.transpose(ctx, perm=[0, 2, 1, 3]),
+                        shape=[0, 0, h])
+        attn = _fc(ctx, h, name + "_att_output_fc",
+                   init_std=cfg.initializer_range)
+        x = _ffn_block(L.elementwise_add(x, attn), cfg, name)
+
+    # logits of the last VALID chunk position (exact row copy — the
+    # final chunk's output seeds the decode loop's first token)
+    flat = L.reshape(x, shape=[-1, h])                     # [C, H]
+    h_last = L.reshape(L.gather(flat, last_idx), shape=[-1, 1, h])
+    logits = _lm_logits(_ln(h_last, "gpt_final_ln"), cfg)  # [1, V]
+    logp = L.log_softmax(logits)
+    next_tok = L.argmax(logp, axis=-1)                     # [1] int64
+    feeds = ["pf_tok", "pf_pos", "pf_page_table", "pf_write_pages",
+             "pf_qstart", "pf_last_idx"]
+    return feeds, next_tok, logp

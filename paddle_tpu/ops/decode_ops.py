@@ -1,0 +1,89 @@
+"""Decode-lane ops: paged KV-cache writes + paged attention.
+
+The decode serving lane (docs/SERVING.md "Decode lane",
+serving/decode.py) runs ONE fixed-shape executable per decode step over
+a pool of KV pages (serving/kv_pool.py).  These ops are its program
+surface:
+
+  kv_cache_write        scatter ONE new token's K or V rows into the
+                        pool at per-slot (page, offset) coordinates —
+                        the decode step's write side
+  kv_cache_write_pages  scatter a prefill CHUNK's K or V (whole pages)
+                        into the pool — the chunked-prefill write side
+  paged_attention       read the pool through a per-sequence page table
+                        (kernels/paged_attention.py: Pallas on TPU, lax
+                        gather reference on CPU)
+
+All three are inference-only (grad=None — generation programs are never
+differentiated) and the writes alias their pool input (XLA buffer
+donation: the pool updates in place, never doubled).
+
+Dtype contract: the pool's dtype is stamped at creation
+(KVPool(dtype=...)) and the write lowerings REFUSE a mismatched payload
+at trace time — a bf16-AMP prefill feeding an fp32 pool fails loudly
+with both dtypes named instead of silently mixing precisions in the
+cache (the models/gpt.py KVSink stamps the cast on the program side).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.fluid.registry import simple_op
+
+
+def _check_pool_dtype(op, pages, new):
+    if pages.dtype != new.dtype:
+        raise ValueError(
+            f"{op}: payload dtype {new.dtype} does not match the KV "
+            f"pool dtype {pages.dtype} — a mixed-precision prefill must "
+            f"cast its K/V to the pool dtype before the write (the "
+            f"gpt.KVSink(dtype=...) prefill sink stamps this cast; see "
+            f"docs/SERVING.md 'Decode lane')")
+
+
+@simple_op("kv_cache_write", ["Pages", "New", "PageIdx", "Offset"],
+           ["PagesOut"], grad=None, inplace={"PagesOut": "Pages"})
+def _kv_cache_write(ctx, pages, new, page_idx, offset, attrs):
+    """One decode step's write: new [B, n, d] lands at
+    pages[page_idx[b], offset[b]] per slot b.  Inactive slots point at
+    the pool's trash page (page 0); duplicate trash coordinates are
+    benign — nothing ever attends them."""
+    _check_pool_dtype("kv_cache_write", pages, new)
+    return pages.at[page_idx.astype(jnp.int32),
+                    offset.astype(jnp.int32)].set(new)
+
+
+@simple_op("kv_cache_write_pages", ["Pages", "New", "PageIdx"],
+           ["PagesOut"], grad=None, inplace={"PagesOut": "Pages"})
+def _kv_cache_write_pages(ctx, pages, new, page_idx, attrs):
+    """One prefill chunk's write: new [C, n, d] (C a multiple of the
+    page size) is viewed as C/page_size whole pages and scattered to
+    pages[page_idx].  Pages past the chunk's valid tail carry the trash
+    page id; rows past a sequence's length inside a REAL page are
+    masked by every reader (attention masks j <= q_start + i)."""
+    _check_pool_dtype("kv_cache_write_pages", pages, new)
+    page_size = pages.shape[1]
+    c = new.shape[0]
+    if c % page_size:
+        raise ValueError(
+            f"kv_cache_write_pages: chunk length {c} is not a multiple "
+            f"of the pool page size {page_size} — the prefill chunk "
+            f"must cover whole pages")
+    blocks = new.reshape(c // page_size, page_size, *new.shape[1:])
+    return pages.at[page_idx.astype(jnp.int32)].set(blocks)
+
+
+@simple_op("paged_attention",
+           ["Q", "KPages", "VPages", "PageTable", "QStart"], ["Out"],
+           grad=None)
+def _paged_attention(ctx, q, k_pages, v_pages, page_table, q_start,
+                     attrs):
+    """Attention of q [B, n, T, d] against the pool through the page
+    table — kernels/paged_attention.py (Pallas on TPU, lax gather
+    reference on CPU; attrs["force"] pins an implementation)."""
+    from paddle_tpu.kernels import paged_attention as _pa
+
+    return _pa.paged_attention(
+        q, k_pages, v_pages, page_table, q_start,
+        sm_scale=attrs.get("sm_scale"), force=attrs.get("force"))

@@ -347,6 +347,53 @@ def _fused_momentum_quant_gather(ctx, p, g, v, lr, attrs):
                      or attrs.get("block_size", 256)))
 
 
+@simple_op(
+    "fused_lamb_quant_grad",
+    ["Param", "QHi", "QLo", "QScale", "Moment1", "Moment2", "LearningRate",
+     "Beta1Pow", "Beta2Pow"],
+    ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    grad=None, optional=("QLo",),
+    inplace={"ParamOut": "Param", "Moment1Out": "Moment1",
+             "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+             "Beta2PowOut": "Beta2Pow"},
+)
+def _fused_lamb_quant_grad(ctx, p, qh, ql, qsc, m1, m2, lr, b1p, b2p,
+                           attrs):
+    from paddle_tpu.kernels import fused_update as fu
+
+    g = (qh, ql, qsc, attrs["offset_blocks"], attrs["numel"])
+    return fu.fused_lamb_update(
+        p, g, m1, m2, lr, b1p, b2p,
+        beta1=attrs.get("beta1", 0.9), beta2=attrs.get("beta2", 0.999),
+        epsilon=attrs.get("epsilon", 1e-6),
+        weight_decay=attrs.get("weight_decay", 0.01),
+        block_size=attrs.get("block_size", 256))
+
+
+@simple_op(
+    "fused_lamb_quant_gather",
+    ["Param", "Grad", "Moment1", "Moment2", "LearningRate", "Beta1Pow",
+     "Beta2Pow"],
+    ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut",
+     "QHi", "QLo", "QScale"],
+    grad=None,
+    inplace={"ParamOut": "Param", "Moment1Out": "Moment1",
+             "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+             "Beta2PowOut": "Beta2Pow"},
+)
+def _fused_lamb_quant_gather(ctx, p, g, m1, m2, lr, b1p, b2p, attrs):
+    from paddle_tpu.kernels import fused_update as fu
+
+    return fu.fused_lamb_update(
+        p, g, m1, m2, lr, b1p, b2p,
+        beta1=attrs.get("beta1", 0.9), beta2=attrs.get("beta2", 0.999),
+        epsilon=attrs.get("epsilon", 1e-6),
+        weight_decay=attrs.get("weight_decay", 0.01),
+        block_size=attrs.get("block_size", 256),
+        requant_pad=(attrs.get("pad_multiple")
+                     or attrs.get("block_size", 256)))
+
+
 @simple_op("dgc", ["U", "V", "Grad"], ["UOut", "VOut", "EncodeGrad"],
            grad=None, inplace={"UOut": "U", "VOut": "V"})
 def _dgc(ctx, u, v, g, attrs):

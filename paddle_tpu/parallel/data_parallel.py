@@ -74,6 +74,7 @@ def fused_update_bytes_counter():
 _FUSED_UPDATE_OPS = {"sgd": "fused_sgd_quant_grad",
                      "adam": "fused_adam_quant_grad",
                      "adamw": "fused_adamw_quant_grad",
+                     "lamb": "fused_lamb_quant_grad",
                      "momentum": "fused_momentum_quant_grad"}
 
 

@@ -198,6 +198,7 @@ class QuantHookPlan:
     _FUSED_OPT_TYPES = {"sgd": "fused_sgd_quant_grad",
                         "adam": "fused_adam_quant_grad",
                         "adamw": "fused_adamw_quant_grad",
+                        "lamb": "fused_lamb_quant_grad",
                         "momentum": "fused_momentum_quant_grad"}
     FUSED_Q_HI = "@GSPMD_FUSED_Q@HI"
     FUSED_Q_LO = "@GSPMD_FUSED_Q@LO"

@@ -379,6 +379,7 @@ class HybridParallelRunner:
     _FUSED_GATHER_OPS = {"sgd": "fused_sgd_quant_gather",
                          "adam": "fused_adam_quant_gather",
                          "adamw": "fused_adamw_quant_gather",
+                         "lamb": "fused_lamb_quant_gather",
                          "momentum": "fused_momentum_quant_gather"}
 
     def _fused_gather_eligible(self, name):

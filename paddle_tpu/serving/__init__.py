@@ -25,19 +25,24 @@ FLAGS_serving_batch_timeout_ms / FLAGS_serving_max_queue.
 """
 
 from . import batching  # noqa: F401
+from . import decode  # noqa: F401
 from . import engine  # noqa: F401
 from . import errors  # noqa: F401
+from . import kv_pool  # noqa: F401
 from . import status  # noqa: F401
 from .batching import BucketPolicy
+from .decode import DecodeEngine, DecodeRequest
 from .engine import Engine, model_signature
 from .errors import (FeedValidationError, ModelNotLoadedError,
-                     ServingDeadlineError, ServingError,
-                     ServingOverloadError)
+                     PoolExhaustedError, ServingDeadlineError,
+                     ServingError, ServingOverloadError)
+from .kv_pool import KVPool
 from .status import servez_payload
 
 __all__ = [
-    "batching", "engine", "errors", "status",
+    "batching", "decode", "engine", "errors", "kv_pool", "status",
     "Engine", "BucketPolicy", "model_signature", "servez_payload",
+    "DecodeEngine", "DecodeRequest", "KVPool",
     "ServingError", "ServingOverloadError", "ModelNotLoadedError",
-    "FeedValidationError", "ServingDeadlineError",
+    "FeedValidationError", "ServingDeadlineError", "PoolExhaustedError",
 ]

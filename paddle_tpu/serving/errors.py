@@ -10,7 +10,8 @@ opaque trace/compile failure attributed to the wrong request).
 from __future__ import annotations
 
 __all__ = ["ServingError", "ServingOverloadError", "ModelNotLoadedError",
-           "FeedValidationError", "ServingDeadlineError"]
+           "FeedValidationError", "ServingDeadlineError",
+           "PoolExhaustedError"]
 
 
 class ServingError(RuntimeError):
@@ -36,6 +37,15 @@ class ModelNotLoadedError(ServingError, KeyError):
 class FeedValidationError(ServingError, ValueError):
     """Request feed failed the edge validation (names, dtypes, shapes,
     row consistency) against the model's static program signature."""
+
+
+class PoolExhaustedError(ServingError, MemoryError):
+    """The paged KV pool (serving/kv_pool.py) has no free page for an
+    allocation.  Internal to the decode scheduler — it catches this,
+    evicts a victim sequence (booked as
+    ``pt_decode_evictions_total``) and retries; it only escapes to a
+    caller when the pool is sized below one full sequence, which the
+    KVPool constructor rejects up front."""
 
 
 class ServingDeadlineError(ServingError, TimeoutError):

@@ -21,9 +21,11 @@ import threading
 import weakref
 
 __all__ = ["servez_payload", "track_engine", "untrack_engine",
-           "live_engines"]
+           "live_engines", "track_decode_engine",
+           "untrack_decode_engine", "live_decode_engines"]
 
 _engines = weakref.WeakSet()
+_decode_engines = weakref.WeakSet()
 _lock = threading.Lock()
 
 
@@ -46,12 +48,37 @@ def untrack_engine(engine):
         _engines.discard(engine)
 
 
+def track_decode_engine(engine):
+    """Add a decode-lane engine (serving/decode.py DecodeEngine) to the
+    /servez page's "decode" section — same registration contract as
+    track_engine."""
+    with _lock:
+        _decode_engines.add(engine)
+        from paddle_tpu.observability import exposition
+
+        exposition.register_page("/servez", servez_payload)
+
+
+def untrack_decode_engine(engine):
+    with _lock:
+        _decode_engines.discard(engine)
+
+
 def live_engines():
     """Snapshot of the engines currently tracked (strong refs)."""
     with _lock:
         return list(_engines)
 
 
+def live_decode_engines():
+    """Snapshot of the decode engines currently tracked."""
+    with _lock:
+        return list(_decode_engines)
+
+
 def servez_payload():
-    """JSON-serializable /servez body: one entry per live engine."""
-    return {"engines": [e.stats() for e in live_engines()]}
+    """JSON-serializable /servez body: one entry per live engine, plus
+    the decode lane's section (slot occupancy, KV-pool figures,
+    eviction counts — docs/SERVING.md "Decode lane")."""
+    return {"engines": [e.stats() for e in live_engines()],
+            "decode": [e.stats() for e in live_decode_engines()]}

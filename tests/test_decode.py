@@ -1,0 +1,437 @@
+"""Token-level continuous-batching decode lane (ISSUE 13): paged
+KV-cache slot pool, prefill/decode split, and the parity gate — greedy
+generate() through the paged decode lane reproduces the
+build_gpt_generate whole-sequence lane token for token."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid, serving
+from paddle_tpu.models import gpt
+from paddle_tpu.serving.errors import PoolExhaustedError
+from paddle_tpu.serving.kv_pool import KVPool
+
+CFG = dict(num_layers=2, hidden_dropout=0.0, use_flash_attention=False)
+
+
+# ---------------------------------------------------------------------------
+# KV pool units
+# ---------------------------------------------------------------------------
+
+
+def _pool(num_pages=9, page_size=4, max_pages=4):
+    return KVPool(num_layers=2, num_heads=4, head_dim=16,
+                  num_pages=num_pages, page_size=page_size,
+                  max_pages_per_seq=max_pages)
+
+
+def test_pool_alloc_and_free():
+    p = _pool()
+    p.open_seq("a")
+    t = p.ensure_capacity("a", 5)  # 2 pages of 4
+    assert len(t) == 2 and all(pg != 0 for pg in t)  # trash never handed out
+    assert p.pages_in_use() == 2
+    t2 = p.ensure_capacity("a", 8)  # still 2 pages
+    assert t2 == t
+    p.ensure_capacity("a", 9)  # grows to 3
+    assert p.pages_in_use() == 3
+    assert p.free_seq("a") == 3
+    assert p.pages_in_use() == 0
+    assert p.free_seq("a") == 0  # idempotent
+
+
+def test_pool_exhaustion_and_lifo_reuse():
+    p = _pool(num_pages=5, page_size=4, max_pages=4)  # 4 allocatable
+    p.open_seq("a")
+    pages_a = list(p.ensure_capacity("a", 12))  # 3 pages
+    p.open_seq("b")
+    p.ensure_capacity("b", 4)  # the last page
+    with pytest.raises(PoolExhaustedError):
+        p.ensure_capacity("b", 8)
+    p.free_seq("a")
+    # LIFO: the next allocation reuses a's pages (head page first — the
+    # freed set comes back in held order); cross-step reuse keeps the
+    # warm working set on the same physical pages
+    p.ensure_capacity("b", 8)
+    assert p.table("b")[1] == pages_a[0]
+    assert p.reused_allocs >= 1
+
+
+def test_pool_rejects_sub_sequence_sizing():
+    with pytest.raises(ValueError, match="cannot hold one full"):
+        KVPool(num_layers=1, num_heads=2, head_dim=8, num_pages=4,
+               page_size=4, max_pages_per_seq=4)
+
+
+def test_pool_padded_table_and_install():
+    p = _pool()
+    p.open_seq("s")
+    p.ensure_capacity("s", 6)
+    row = p.padded_table("s")
+    assert row.shape == (4,) and row.dtype == np.int32
+    assert list(row[:2]) == p.table("s") and all(row[2:] == 0)
+    assert all(p.padded_table(None) == 0)
+    scope = fluid.Scope()
+    p.install(scope)
+    arr = scope.get(p.var_names[0][0])
+    assert arr.shape == (9, 4, 4, 16) and str(arr.dtype) == "float32"
+    # idempotent on shape match: the resident pool is kept
+    scope.set(p.var_names[0][0], arr + 1.0)
+    p.install(scope)
+    assert np.asarray(scope.get(p.var_names[0][0])).max() == 1.0
+    # ... but NOT on a dtype change: a rebuild with a different
+    # pool_dtype must re-install, or every later write trips the dtype
+    # guard blaming the payload instead of the stale resident pool
+    p16 = KVPool(num_layers=2, num_heads=4, head_dim=16, num_pages=9,
+                 page_size=4, max_pages_per_seq=4, dtype="float16")
+    p16.install(scope)
+    re = np.asarray(scope.get(p16.var_names[0][0]))
+    assert str(re.dtype) == "float16" and re.max() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# paged-attention kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_case(seed=0, b=3, n=2, d=8, pgs=4, maxp=3, t=1):
+    rng = np.random.RandomState(seed)
+    k_pages = rng.randn(8, pgs, n, d).astype("float32")
+    v_pages = rng.randn(8, pgs, n, d).astype("float32")
+    pt = np.array([[1, 2, 3], [4, 5, 0], [6, 7, 0]], np.int32)[:b]
+    q_start = np.array([9, 5, 2], np.int32)[:b]
+    q = rng.randn(b, n, t, d).astype("float32")
+    return q, k_pages, v_pages, pt, q_start
+
+
+def test_paged_attention_reference_matches_dense():
+    from paddle_tpu.kernels import paged_attention as pa
+
+    q, kp, vp, pt, qs = _paged_case()
+    out = np.asarray(pa.paged_attention(q, kp, vp, pt, qs,
+                                        force="reference"))
+    d = q.shape[-1]
+    for b in range(q.shape[0]):
+        L = qs[b] + 1
+        ks = kp[pt[b]].reshape(-1, *kp.shape[2:]).transpose(1, 0, 2)[:, :L]
+        vs = vp[pt[b]].reshape(-1, *vp.shape[2:]).transpose(1, 0, 2)[:, :L]
+        s = np.einsum("ntd,nld->ntl", q[b], ks) / np.sqrt(d)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        oracle = np.einsum("ntl,nld->ntd", p, vs)
+        np.testing.assert_allclose(out[b], oracle, atol=1e-5)
+
+
+@pytest.mark.parametrize("t", [1, 4])
+def test_paged_attention_pallas_interpret_matches_reference(t):
+    """The Pallas kernel (scalar-prefetched page table, online softmax
+    over pages, dead blocks skipped) matches the XLA reference <= 1e-5
+    for both the decode (T=1) and prefill-chunk (T>1) shapes."""
+    from paddle_tpu.kernels import paged_attention as pa
+
+    q, kp, vp, pt, qs = _paged_case(seed=t, t=t)
+    ref = np.asarray(pa.paged_attention(q, kp, vp, pt, qs,
+                                        force="reference"))
+    pal = np.asarray(pa.paged_attention(q, kp, vp, pt, qs,
+                                        force="pallas"))
+    np.testing.assert_allclose(pal, ref, atol=1e-5)
+
+
+def test_kv_cache_write_dtype_guard():
+    """The pool-write lowerings refuse a payload whose dtype mismatches
+    the pool — the bf16-prefill-into-fp32-pool mix fails at trace time
+    with both dtypes named (ISSUE 13 kv_sink bugfix)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.decode_ops import (_kv_cache_write,
+                                           _kv_cache_write_pages)
+
+    pages = jnp.zeros((4, 2, 2, 4), jnp.float32)
+    new16 = jnp.zeros((3, 2, 4), jnp.bfloat16)
+    idx = jnp.zeros(3, jnp.int32)
+    with pytest.raises(ValueError, match="does not match the KV pool"):
+        _kv_cache_write(None, pages, new16, idx, idx, {})
+    with pytest.raises(ValueError, match="does not match the KV pool"):
+        _kv_cache_write_pages(None, pages, jnp.zeros((2, 2, 4),
+                                                     jnp.bfloat16),
+                              jnp.zeros(1, jnp.int32), {})
+    # matched dtype writes land at the addressed coordinates
+    out = _kv_cache_write(None, pages,
+                          jnp.ones((3, 2, 4), jnp.float32), idx,
+                          jnp.asarray([0, 1, 1], jnp.int32), {})
+    assert np.asarray(out)[0, 1].max() == 1.0
+
+
+def test_kv_cache_write_ops_numeric():
+    """Program-level numeric pin for both pool-write ops:
+    layers.kv_cache_write scatters per-slot (page, offset) rows and
+    layers.kv_cache_write_pages scatters whole prefill pages, each
+    matching the numpy oracle — and the persistable pool var carries
+    the update back to the scope (the in-place PagesOut contract)."""
+    L = fluid.layers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        blk = main.global_block()
+        pool_t = blk.create_var(name="kvw_pool_t", shape=[5, 2, 2, 3],
+                                dtype="float32", persistable=True)
+        new = fluid.data("kvw_new", [3, 2, 3], False, dtype="float32")
+        pg = fluid.data("kvw_pg", [3], False, dtype="int32")
+        off = fluid.data("kvw_off", [3], False, dtype="int32")
+        L.kv_cache_write(pool_t, new, pg, off)
+        pool_p = blk.create_var(name="kvw_pool_p", shape=[5, 2, 2, 3],
+                                dtype="float32", persistable=True)
+        chunk = fluid.data("kvw_chunk", [4, 2, 3], False,
+                           dtype="float32")
+        cpg = fluid.data("kvw_cpg", [2], False, dtype="int32")
+        L.kv_cache_write_pages(pool_p, chunk, cpg)
+    rng = np.random.RandomState(7)
+    base = rng.randn(5, 2, 2, 3).astype("float32")
+    new_v = rng.randn(3, 2, 3).astype("float32")
+    pg_v = np.array([1, 3, 3], np.int32)
+    off_v = np.array([0, 1, 0], np.int32)
+    chunk_v = rng.randn(4, 2, 3).astype("float32")
+    cpg_v = np.array([4, 2], np.int32)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        scope.set("kvw_pool_t", base.copy())
+        scope.set("kvw_pool_p", base.copy())
+        exe = fluid.Executor(fluid.CPUPlace())
+        got_t, got_p = exe.run(
+            main, feed={"kvw_new": new_v, "kvw_pg": pg_v,
+                        "kvw_off": off_v, "kvw_chunk": chunk_v,
+                        "kvw_cpg": cpg_v},
+            fetch_list=["kvw_pool_t", "kvw_pool_p"])
+        back_t = np.asarray(scope.get("kvw_pool_t"))
+        back_p = np.asarray(scope.get("kvw_pool_p"))
+    want_t = base.copy()
+    for b in range(3):
+        want_t[pg_v[b], off_v[b]] = new_v[b]
+    want_p = base.copy()
+    want_p[cpg_v] = chunk_v.reshape(2, 2, 2, 3)
+    np.testing.assert_array_equal(np.asarray(got_t), want_t)
+    np.testing.assert_array_equal(np.asarray(got_p), want_p)
+    np.testing.assert_array_equal(back_t, want_t)
+    np.testing.assert_array_equal(back_p, want_p)
+
+
+def test_kvsink_stamps_cache_dtype():
+    """KVSink(dtype=...) inserts an explicit cast op on every captured
+    K/V — the program CARRIES the cache dtype instead of inheriting the
+    lowering policy's; a plain list keeps the historic pass-through."""
+    cfg = gpt.GPTConfig.tiny(**CFG)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        ids = fluid.data("i", [-1, 8], False, dtype="int64")
+        pos = fluid.data("p", [-1, 8], False, dtype="int64")
+        sink = gpt.KVSink(dtype="float32")
+        gpt.gpt_decoder(ids, pos, cfg, is_test=True, kv_sink=sink)
+    assert len(sink) == cfg.num_layers
+    assert sink.shapes and all(len(s) == 4 for s in sink.shapes)
+    blk = main.global_block()
+    producers = {}
+    for op in blk.ops:
+        for o in op.output_arg_names:
+            producers[o] = op
+    for k, v in sink:
+        assert producers[k.name].type == "cast"
+        assert producers[v.name].type == "cast"
+        assert producers[k.name].attrs.get("out_dtype") in (
+            "float32", 5)  # proto enum tolerated
+    # plain list: no cast stamped (back-compat for the in-graph lanes)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2), fluid.unique_name.guard():
+        ids = fluid.data("i", [-1, 8], False, dtype="int64")
+        pos = fluid.data("p", [-1, 8], False, dtype="int64")
+        sink2 = []
+        gpt.gpt_decoder(ids, pos, cfg, is_test=True, kv_sink=sink2)
+    prod2 = {}
+    for op in main2.global_block().ops:
+        for o in op.output_arg_names:
+            prod2[o] = op
+    for k, v in sink2:
+        assert prod2[k.name].type != "cast"
+
+
+# ---------------------------------------------------------------------------
+# decode lane end to end — one subprocess child, results asserted here
+# ---------------------------------------------------------------------------
+#
+# The device-running e2e gates (parity, zero steady-state compiles,
+# eviction replay, chunked prefill, eos) execute in ONE child process
+# running tests/decode_e2e_checks.py with the persistent compile cache
+# OFF: the jaxlib-0.4.3x XLA:CPU runtime corrupts the heap while
+# DESERIALIZING warm compilation-cache entries (the fixture's own
+# programs suffice; same class as the aborts cpu_mesh.py documents) and
+# the corruption manifests under the engine's allocation churn — warm
+# in-process runs aborted 5/6 while cache-off child runs pass 5/5.  The
+# test_ring_collectives subprocess precedent: isolation without giving
+# up executed coverage.
+
+
+@pytest.fixture(scope="module")
+def e2e():
+    """Run the decode e2e child once; returns {check name: "ok"|traceback}."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "decode_e2e_checks.py")
+    last = None
+    for attempt in range(2):
+        r = subprocess.run(
+            [sys.executable, script], capture_output=True, text=True,
+            timeout=1200,
+            cwd=os.path.dirname(os.path.dirname(script)))
+        lines = [ln for ln in r.stdout.splitlines()
+                 if ln.startswith("DECODE_E2E_RESULT ")]
+        if lines:
+            return json.loads(lines[-1][len("DECODE_E2E_RESULT "):])
+        last = r
+        if r.returncode >= 0:
+            break  # a plain failure will not improve on retry
+    if last.returncode < 0:  # signal on BOTH attempts: the known abort
+        pytest.skip(f"decode e2e child died with signal "
+                    f"{-last.returncode} twice (0.4.3x XLA:CPU heap "
+                    f"corruption — stable standalone, see "
+                    f"decode_e2e_checks.py)")
+    raise AssertionError(
+        f"decode e2e child produced no result rc={last.returncode}\n"
+        f"{last.stderr[-3000:]}")
+
+
+def _e2e_check(e2e, name):
+    res = e2e.get(name)
+    assert res is not None, f"child never ran check {name!r}"
+    assert res == "ok", f"decode e2e check {name} failed in child:\n{res}"
+
+
+def test_decode_parity_greedy_bit_exact(e2e):
+    """THE acceptance gate: greedy generate() via the paged decode lane
+    (chunked prefill + token-level continuous batching + paged
+    attention) reproduces the whole-sequence build_gpt_generate lane's
+    token ids EXACTLY — same weights, same prompts (child check)."""
+    _e2e_check(e2e, "parity_greedy_bit_exact")
+
+
+def test_decode_zero_steady_state_compiles(e2e):
+    """After warmup, traffic of ANY mix of prompt lengths and request
+    counts runs on exactly two executables (child check)."""
+    _e2e_check(e2e, "zero_steady_state_compiles")
+
+
+def test_decode_eviction_under_pressure_matches_unpressured(e2e):
+    """Evicted sequences re-prefill prompt + generated prefix and finish
+    with the same tokens as the unpressured run (child check)."""
+    _e2e_check(e2e, "eviction_under_pressure_matches_unpressured")
+
+
+def test_decode_long_prompt_chunked_prefill(e2e):
+    """A prompt longer than the chunk streams through several prefill
+    executions and matches the one-chunk config (child check)."""
+    _e2e_check(e2e, "long_prompt_chunked_prefill")
+
+
+def test_decode_eos_and_single_token(e2e):
+    """max_new_tokens=1 finishes on the prefill seed alone; eos_id stops
+    the stream (child check)."""
+    _e2e_check(e2e, "eos_and_single_token")
+
+
+# ---------------------------------------------------------------------------
+# host-side engine surface (no device execution — safe in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_decode_submit_validation_and_close():
+    """Typed submit-edge validation and close() failing leftover futures
+    — pure host paths, no program ever executes (untrained scope)."""
+    cfg = gpt.GPTConfig.tiny(**CFG)
+    scope = fluid.Scope()
+    eng = serving.DecodeEngine(cfg, scope=scope, pool_slots=2,
+                               page_size=4, prefill_chunk=4, max_len=8,
+                               name="valid", auto_start=False)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit([], 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([1, 2], 0)
+    with pytest.raises(ValueError, match="exceeds the engine's max_len"):
+        eng.submit([1, 2, 3, 4, 5], 4)
+    fut = eng.submit([1, 2], 2)
+    eng.close()
+    with pytest.raises(serving.ServingOverloadError):
+        fut.result(timeout=10)
+    with pytest.raises(serving.ServingOverloadError):
+        eng.submit([1, 2], 2)
+
+
+def test_decode_prefill_chunk_floors_and_validates():
+    """A page_size above max_len used to round the derived
+    prefill_chunk down to 0 — a zero-token chunk never advances prefill
+    and the scheduler livelocks; the derived default now floors at one
+    whole page, and an explicit non-positive / non-page-multiple chunk
+    fails typed at construction."""
+    cfg = gpt.GPTConfig.tiny(**CFG)
+    scope = fluid.Scope()
+    eng = serving.DecodeEngine(cfg, scope=scope, pool_slots=2,
+                               page_size=32, max_len=16,
+                               name="floor", auto_start=False)
+    try:
+        assert eng.prefill_chunk == 32  # one whole page, not 0
+    finally:
+        eng.close()
+    for bad in (0, -4, 6):  # 6 is not a multiple of page_size 4
+        with pytest.raises(ValueError, match="positive multiple"):
+            serving.DecodeEngine(cfg, scope=scope, pool_slots=2,
+                                 page_size=4, prefill_chunk=bad,
+                                 max_len=16, name="bad",
+                                 auto_start=False)
+
+
+def test_decode_dead_scheduler_rejects_submits_typed():
+    """After an executor failure kills the scheduler (every live future
+    fails), the engine must not accept new work into the dead queue —
+    a submitted future would hang forever; submit() rejects typed and
+    stats() names the failure."""
+    cfg = gpt.GPTConfig.tiny(**CFG)
+    scope = fluid.Scope()
+    eng = serving.DecodeEngine(cfg, scope=scope, pool_slots=2,
+                               page_size=4, prefill_chunk=4, max_len=16,
+                               name="dead", auto_start=False)
+    try:
+        fut = eng.submit([1, 2], 2)
+        eng._fail_all(RuntimeError("device fell over"))
+        with pytest.raises(RuntimeError, match="device fell over"):
+            fut.result(timeout=10)
+        with pytest.raises(serving.ServingOverloadError,
+                           match="scheduler died"):
+            eng.submit([1, 2], 2)
+        assert "device fell over" in eng.stats()["failed"]
+    finally:
+        eng.close()
+
+
+def test_decode_servez_section():
+    """DecodeEngine registers on /servez: the payload carries a decode
+    section with slot/pool/eviction figures while the engine lives and
+    drops it at close (no device execution — untrained scope)."""
+    from paddle_tpu.serving import status
+
+    cfg = gpt.GPTConfig.tiny(**CFG)
+    scope = fluid.Scope()
+    eng = serving.DecodeEngine(cfg, scope=scope, pool_slots=2,
+                               page_size=4, prefill_chunk=4, max_len=16,
+                               name="servez-decode", auto_start=False)
+    try:
+        payload = status.servez_payload()
+        names = [d["engine"] for d in payload["decode"]]
+        assert "servez-decode" in names
+        entry = [d for d in payload["decode"]
+                 if d["engine"] == "servez-decode"][0]
+        assert entry["pool_slots"] == 2
+        assert "kv_pool" in entry and "evictions" in entry
+    finally:
+        eng.close()
+    assert "servez-decode" not in [
+        d["engine"] for d in status.servez_payload()["decode"]]

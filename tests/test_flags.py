@@ -479,3 +479,25 @@ def test_graph_passes_flag_roundtrip(monkeypatch):
     monkeypatch.delenv("FLAGS_graph_passes")
     importlib.reload(fl)
     assert fl.get_flags("graph_passes")["graph_passes"] == "default"
+
+
+def test_aot_cache_flag_roundtrip(monkeypatch):
+    """FLAGS_aot_cache_dir (fluid/aot_cache.py): off by default (empty
+    string disables the AOT executable cache) and round-trips through
+    set_flags and env bootstrap like every other flag."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("aot_cache_dir")["aot_cache_dir"] == ""
+    try:
+        fl.set_flags({"FLAGS_aot_cache_dir": "/tmp/aotx"})
+        assert fl.get_flags("aot_cache_dir")["aot_cache_dir"] == \
+            "/tmp/aotx"
+    finally:
+        fl.set_flags({"FLAGS_aot_cache_dir": ""})
+    monkeypatch.setenv("FLAGS_aot_cache_dir", "/tmp/aotx2")
+    importlib.reload(fl)
+    assert fl.get_flags("aot_cache_dir")["aot_cache_dir"] == "/tmp/aotx2"
+    monkeypatch.delenv("FLAGS_aot_cache_dir")
+    importlib.reload(fl)  # restore defaults for other tests

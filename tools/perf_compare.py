@@ -28,6 +28,13 @@ Compared fields (each skipped when absent on either side):
                              a 0 -> 0.002 change must not read as an
                              infinite regression)
   latency_seconds.p50/p99    (serving records) lower is better
+  decode.tokens_per_sec      (PT_BENCH_DECODE records) higher is better
+  decode.naive_tokens_per_sec
+                             higher is better (the re-prefill baseline
+                             arm of the decode A/B)
+  decode.latency_seconds.p50/p99
+                             per-token decode-step latency — lower is
+                             better
 
 Exit codes: 0 = no regression, 1 = at least one regression, 2 = unusable
 input.  ``--threshold-pct`` (default 5) is the noise band;
@@ -165,10 +172,18 @@ def compare_records(old, new, threshold_pct=5.0):
     for field in ("value", "mfu", "tflops_per_sec"):
         rows.append(compare_field(field, old.get(field), new.get(field),
                                   threshold_pct, higher_is_better=True))
-    for field in ("latency_seconds.p50", "latency_seconds.p99"):
+    for field in ("latency_seconds.p50", "latency_seconds.p99",
+                  "decode.latency_seconds.p50",
+                  "decode.latency_seconds.p99"):
         rows.append(compare_field(field, _dig(old, field),
                                   _dig(new, field), threshold_pct,
                                   higher_is_better=False))
+    # PT_BENCH_DECODE records: both arms of the lane-vs-naive A/B are
+    # throughputs (absent on every older record — tolerated as missing)
+    for field in ("decode.tokens_per_sec", "decode.naive_tokens_per_sec"):
+        rows.append(compare_field(field, _dig(old, field),
+                                  _dig(new, field), threshold_pct,
+                                  higher_is_better=True))
     for field in _quantile_fields(old, new):
         rows.append(compare_field(field, _dig(old, field),
                                   _dig(new, field), threshold_pct,

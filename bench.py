@@ -53,6 +53,11 @@ tokens/s vs the naive re-prefill-every-token baseline, steady-state
 executable-cache misses (acceptance: 0), per-token p50/p99 and the
 short-vs-long-prompt step-time ratio (PT_BENCH_DECODE_REQS,
 PT_BENCH_DECODE_GEN, PT_BENCH_DECODE_SLOTS knobs);
+PT_BENCH_RECOVERY=1 → measured preempt→restore rung (`make
+recovery-bench`): the in-process recovery drill
+(distributed.recovery.inprocess_drill) restoring through the persisted
+health rollback window, recording per-phase recovery seconds + MTTR
+(PT_BENCH_RECOVERY_STEPS, PT_BENCH_RECOVERY_KILL knobs);
 PT_BENCH_STEPS, PT_BENCH_BATCH, PT_BENCH_SEQLEN, BENCH_BASELINE.
 """
 
@@ -1339,6 +1344,44 @@ def _gspmd_ab(size, batch, seq_len, n_steps, bf16):
     return out
 
 
+def measure_recovery(size):
+    """PT_BENCH_RECOVERY=1 (`make recovery-bench`): the measured
+    preempt→restore rung.  Runs the fast in-process drill
+    (distributed.recovery.inprocess_drill — train, drop every live
+    object, restore through the persisted rollback window, finish) and
+    records the recovery phases + MTTR in the BENCH record, so recovery
+    time regressions gate like throughput regressions
+    (tools/perf_compare.py).  The multi-process drill (trainer +
+    pserver kill, epoch agreement) runs in
+    tests/test_recovery_drill.py's slow acceptance — this rung stays
+    fast enough for every bench invocation."""
+    import tempfile
+
+    from paddle_tpu.distributed import recovery
+    from paddle_tpu import observability as obs
+
+    steps = int(os.environ.get("PT_BENCH_RECOVERY_STEPS", "12"))
+    kill_after = int(os.environ.get("PT_BENCH_RECOVERY_KILL", "8"))
+    with tempfile.TemporaryDirectory(prefix="pt_bench_recovery_") as d:
+        report = recovery.inprocess_drill(d, steps=steps,
+                                          kill_after=kill_after)
+    snap = obs.snapshot().get("pt_recovery_seconds") or {}
+    phases_hist = {"|".join(k): {"sum": round(float(v["sum"]), 4),
+                                 "count": int(v["count"])}
+                   for k, v in snap.get("samples", {}).items()}
+    return {
+        "metric": "recovery_mttr_seconds",
+        "value": report["mttr_s"],
+        "unit": "s",
+        "config": (f"recovery inprocess fc13 steps{steps} "
+                   f"kill{kill_after} window-restore"
+                   + (" CPU-FALLBACK"
+                      if os.environ.get("PT_BENCH_FORCE_CPU") else "")),
+        "recovery_drill": report,
+        "recovery_phase_hist": phases_hist,
+    }
+
+
 def measure(size):
     if os.environ.get("PT_BENCH_FORCE_CPU"):
         # last-resort rung: the TPU tunnel can wedge for hours (observed);
@@ -1351,6 +1394,8 @@ def measure(size):
         jax.config.update("jax_platforms", "cpu")
     if os.environ.get("PT_BENCH_SERVE") == "1":
         return measure_serving(size)
+    if os.environ.get("PT_BENCH_RECOVERY") == "1":
+        return measure_recovery(size)
     if os.environ.get("PT_BENCH_DECODE") == "1":
         # NOTE: PT_BENCH_DECODE=scan|unrolled still selects the
         # whole-sequence generate variant inside the PT_BENCH_MODEL=gpt

@@ -10,14 +10,19 @@ contract `fluid.incubate.fleet` reads.
 Beyond parity (SURVEY §5: the reference has no failure detection or
 elastic recovery): `resilience` (RetryPolicy + resilience_stats
 counters), `fault_injection` (deterministic FaultPlan test harness),
-supervised restarts in the launchers (`--max_restarts`), and `elastic`
-(resizable jobs: lease-based membership, graceful preemption drain, and
-collective-lane rejoin — docs/DISTRIBUTED.md §6 "Elastic membership").
+supervised restarts in the launchers (`--max_restarts`), `elastic`
+(resizable jobs: lease-based membership, graceful preemption drain,
+quorum epoch agreement, and collective-lane rejoin —
+docs/DISTRIBUTED.md §6 "Elastic membership"), and `recovery` (measured
+preempt→restore: pt_recovery_seconds phases, the drill harness, MTTR —
+§6 "Preemption and recovery").
 """
 
-from .elastic import (DrainHandler, LeaseHeartbeat, current_drain,
-                      drain_requested, install_drain_handler, join_job,
-                      leave_job, membership, rebuild_mesh,
+from . import recovery
+from .elastic import (DrainHandler, LeaseHeartbeat, agree_epoch,
+                      commit_epoch, current_drain, drain_requested,
+                      install_drain_handler, join_job, leave_job,
+                      membership, membership_any, rebuild_mesh,
                       reinit_collective)
 from .fault_injection import FaultPlan, set_membership_hooks
 from .resilience import (RetryPolicy, reset_resilience_stats,
@@ -27,4 +32,5 @@ __all__ = ["FaultPlan", "RetryPolicy", "resilience_stats",
            "reset_resilience_stats", "set_membership_hooks",
            "DrainHandler", "LeaseHeartbeat", "install_drain_handler",
            "current_drain", "drain_requested", "join_job", "leave_job",
-           "membership", "reinit_collective", "rebuild_mesh"]
+           "membership", "membership_any", "commit_epoch", "agree_epoch",
+           "reinit_collective", "rebuild_mesh", "recovery"]

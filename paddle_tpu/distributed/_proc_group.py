@@ -231,6 +231,34 @@ class ProcGroup:
                   file=sys.stderr)
         return True
 
+    def supervise_once(self):
+        """One supervision pass over every child — the poll half of
+        wait(): report exits, schedule/perform budgeted restarts, and
+        return the first unrecoverable failure as (rc, args), or None.
+        Public so an external supervisor (the recovery drill harness,
+        distributed.recovery.run_drill) can drive the SAME loop with
+        its own bookkeeping interleaved instead of forking a copy that
+        would drift from wait()'s failure/drain classification."""
+        for child in self.children:
+            rc = child.poll()
+            if rc is None:
+                continue
+            self._report_exit(child, rc)
+            if rc == 0 or child.drained():
+                continue
+            if not self._handle_failure(child, rc):
+                return (rc, child.args)
+        return None
+
+    def respawn(self, child):
+        """Relaunch `child` NOW, outside the failure/budget path — the
+        drill harness's preempt+restore half (a DRAINED child is
+        deliberately not restarted by supervision, so somebody else
+        must own its comeback).  Counts in restarts_performed."""
+        child.restart()
+        self.restarts_performed += 1
+        return child
+
     def wait(self, workers=None):
         """Block until every worker exits cleanly (rc 0, or a graceful
         elastic drain); supervise restarts; raise on the first
@@ -243,16 +271,7 @@ class ProcGroup:
         workers = list(workers if workers is not None else self.children)
         failed = None
         while failed is None:
-            for child in self.children:
-                rc = child.poll()
-                if rc is None:
-                    continue
-                self._report_exit(child, rc)
-                if rc == 0 or child.drained():
-                    continue
-                if not self._handle_failure(child, rc):
-                    failed = (rc, child.args)
-                    break
+            failed = self.supervise_once()
             if failed is None:
                 if all(c.finished_clean() for c in workers):
                     break  # every worker finished cleanly (or drained)

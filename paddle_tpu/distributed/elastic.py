@@ -25,8 +25,15 @@ lanes share:
 Per-shard membership: every pserver tracks its own member set (the same
 join/leave/heartbeat traffic goes to each endpoint), and all shards see
 the same graceful joins/leaves at the same round boundary.  For the
-DATA-assignment view (epoch, index, count), trainers read ONE authority —
-`endpoints[0]` — so per-round batch slices never disagree across shards.
+LIVE data-assignment view (epoch, index, count), trainers read one
+reachable shard per round (`membership_any` walks the endpoint list, so
+the loss of any single shard — including endpoints[0], the old sole
+authority — never wedges the loop).  The RESUME position is stronger
+than any single shard's view: trainers propose a quorum epoch record
+(`commit_epoch`) to EVERY shard after each completed round, and
+`agree_epoch` recovers the max-round record from the reachable quorum —
+a relaunched shard reconciles its own snapshot against it instead of
+trusting its file (docs/DISTRIBUTED.md §6 "Preemption and recovery").
 """
 
 from __future__ import annotations
@@ -36,7 +43,8 @@ import signal
 import threading
 import time
 
-__all__ = ["join_job", "leave_job", "membership", "LeaseHeartbeat",
+__all__ = ["join_job", "leave_job", "membership", "membership_any",
+           "commit_epoch", "agree_epoch", "LeaseHeartbeat",
            "DrainHandler", "install_drain_handler", "drain_requested",
            "current_drain", "reinit_collective", "rebuild_mesh",
            "DRAIN_MARKER_ENV"]
@@ -57,14 +65,119 @@ def _heartbeats():
 
 
 def membership(endpoint):
-    """One lease renewal + membership view from `endpoint` (the data
-    authority is endpoints[0] by convention): dict with epoch, round,
-    version, count, index (-1 while pending / not a member)."""
+    """One lease renewal + membership view from `endpoint`: dict with
+    epoch, round, version, count, index (-1 while pending / not a
+    member)."""
     from paddle_tpu.ops import dist_ops
 
     info = dist_ops.get_channel(endpoint).client.lease_heartbeat()
     _heartbeats().labels(status="ok").inc()
     return info
+
+
+_last_good_ep = None
+
+
+def membership_any(endpoints):
+    """The membership view from the first REACHABLE shard.  This
+    replaces the hard shard-0 authority convention in trainer round
+    loops: every shard applies joins/leaves at the same round boundary,
+    so any live shard's view is a valid data-assignment view — and the
+    loss of endpoints[0] mid-round no longer wedges every trainer's
+    membership poll.
+
+    Sticky ordering: the last endpoint that answered is tried FIRST, so
+    a dead shard's full channel retry/backoff schedule is paid once at
+    the failover, not on every subsequent poll.  (The query must ride
+    the cached channel — its client uid is the membership being renewed;
+    a fail-fast probe client would implicitly join a phantom member.)"""
+    global _last_good_ep
+    from paddle_tpu.distributed import resilience
+
+    eps = list(endpoints)
+    if _last_good_ep in eps:
+        eps.remove(_last_good_ep)
+        eps.insert(0, _last_good_ep)
+    last_err = None
+    for ep in eps:
+        try:
+            info = membership(ep)
+            _last_good_ep = ep
+            return info
+        except IOError as e:
+            last_err = e
+            resilience.record("membership_fallbacks")
+    raise IOError(
+        f"membership_any: no reachable shard among {list(endpoints)}"
+    ) from last_err
+
+
+def commit_epoch(endpoints, round, epoch=0, position=None):
+    """Propose the quorum epoch record (round + dataset position, and
+    optionally the membership epoch) to EVERY shard; best-effort per
+    endpoint — a dead shard is skipped (it reconciles from the quorum
+    when it relaunches).  Returns the number of shards that acked, so a
+    caller can assert majority when it needs the stronger guarantee.
+
+    Rides the cached channels: the per-round caller
+    (`_fetch_barrier_run`) commits immediately after every shard acked
+    its fetch barrier, so the endpoints were provably alive moments
+    earlier and the channel's retry schedule only engages in the tiny
+    barrier→commit death window."""
+    from paddle_tpu.distributed import resilience
+    from paddle_tpu.ops import dist_ops
+
+    acks = 0
+    for ep in list(endpoints):
+        try:
+            dist_ops.get_channel(ep).client.commit_epoch(
+                epoch, round, position)
+            acks += 1
+        except IOError:
+            resilience.record("epoch_commit_failures")
+    return acks
+
+
+def agree_epoch(endpoints, timeout=None):
+    """The QUORUM committed epoch record: query every reachable shard's
+    kCommitEpoch record and return the max-round one (commits are
+    monotone in round, so the max is the last record any majority
+    accepted — it survives the loss of any single shard, including the
+    old shard-0 data authority).  Returns the record dict extended with
+    ``acks`` (shards that answered) — callers that need majority
+    semantics check ``acks > len(endpoints) // 2``.  Raises IOError when
+    NO shard is reachable."""
+    from paddle_tpu import native
+    from paddle_tpu.distributed import resilience
+
+    endpoints = list(endpoints)
+    best, acks, last_err = None, 0, None
+    for ep in endpoints:
+        host, port = ep.rsplit(":", 1)
+        try:
+            # a dedicated short-dial client, not the cached channel: the
+            # agreement runs on the RESUME path where cached channels may
+            # be parked in barrier rewaits or pointed at dead peers
+            cli = native.PSClient(host=host, port=int(port),
+                                  timeout=2.0 if timeout is None
+                                  else timeout, retry_times=0,
+                                  uid="epoch-agree")
+            try:
+                rec = cli.committed_epoch()
+            finally:
+                cli.close()
+            acks += 1
+            if best is None or (rec["round"], rec["epoch"]) > (
+                    best["round"], best["epoch"]):
+                best = rec
+        except IOError as e:
+            last_err = e
+            resilience.record("epoch_agree_failures")
+    if best is None:
+        raise IOError(
+            f"agree_epoch: no reachable shard among {endpoints}"
+        ) from last_err
+    return dict(best, acks=acks)
 
 
 def join_job(endpoints, min_count=None, timeout_s=120.0, poll_s=0.05):
@@ -123,9 +236,13 @@ def join_job(endpoints, min_count=None, timeout_s=120.0, poll_s=0.05):
         ch = dist_ops.get_channel(ep)
         ch.round = max(ch.round, int(info["round"]))
         ch.client._rounds_done = ch.round
+    from paddle_tpu.distributed import recovery
     from paddle_tpu.observability import events
 
     events.emit("elastic_join", endpoints=endpoints, **info)
+    # recovery milestone: membership re-established (the drill harness's
+    # `rejoin` phase anchor; no-op unless PT_RECOVERY_OUT is set)
+    recovery.note("rejoin", round=info["round"], count=info["count"])
     return info
 
 

@@ -31,6 +31,27 @@ Spec grammar — semicolon-separated rules:
     leave:step:<k>            fire the registered `leave` hook at step k
                               (graceful departure WITHOUT a signal)
     leave:round:<k>           ... at completed round k
+    drill:<mode>:step:<k>[:<target>]
+                              ORCHESTRATED recovery drill (consumed by
+                              distributed.recovery.run_drill, never
+                              fired from on_step/on_rpc): at job step/
+                              round <k> the DRILL HARNESS delivers the
+                              signal to the target role and supervises
+                              the relaunch, booking the recovery phases
+                              into pt_recovery_seconds.  <mode> is
+                              `preempt+restore` (SIGTERM — the graceful
+                              drain class, harness respawns after the
+                              drain) or `kill+restore` (SIGKILL — the
+                              supervisor's restart budget relaunches).
+                              <target> names a spawned role (e.g.
+                              `trainer1`, `pserver0`); omitted = the
+                              harness's default target.
+    drill:<mode>:round:<k>[:<target>]
+                              ... both spellings key on the WATCHED
+                              pserver round counter (sync-lane trainer
+                              steps advance in lockstep with rounds;
+                              the harness cannot observe a trainer's
+                              private step count from outside)
     nan:grad:step:<k>         NUMERIC fault class (health sentinel,
                               docs/DISTRIBUTED.md §6): corrupt one raw
                               parameter gradient to NaN INSIDE the
@@ -55,7 +76,8 @@ are read by `paddle_tpu.health.transpile.insert_health_sentinel` (via
 runner (or use PT_FAULT_PLAN for subprocesses).
 
 `<cmd>` is an RPC name (send_grad, get_param, send_barrier, fetch_barrier,
-send_param, lookup_rows, checkpoint_notify, stop, lease, join, leave) or
+send_param, lookup_rows, checkpoint_notify, stop, lease, join, leave,
+commit_epoch) or
 `*`.  Counts are 1-based and per-process; a retried RPC re-enters the
 count, so `drop:...:3` fails exactly one attempt and the retry succeeds.
 
@@ -86,6 +108,9 @@ _LIFECYCLE = ("kill", "preempt", "join", "leave")
 # declarative numeric-fault actions consumed by the health sentinel's
 # program transpile (never fired from on_rpc/on_step/on_round)
 _NUMERIC = ("nan", "inf", "spike")
+# orchestrated recovery drills consumed by distributed.recovery.run_drill
+# (never fired from the runtime hooks — the harness owns the signal)
+_DRILL_MODES = ("preempt+restore", "kill+restore")
 
 _ENV = "PT_FAULT_PLAN"
 
@@ -156,6 +181,11 @@ class FaultPlan:
                 self.rules.append(_Rule(
                     action, bits[1], int(bits[3]),
                     float(bits[4]) if len(bits) == 5 else None))
+            elif action == "drill" and len(bits) in (4, 5) and \
+                    bits[1] in _DRILL_MODES and bits[2] in ("step", "round"):
+                self.rules.append(_Rule(
+                    "drill", bits[2], int(bits[3]),
+                    (bits[1], bits[4] if len(bits) == 5 else None)))
             else:
                 raise ValueError(f"bad fault rule {part!r} in {spec!r}")
 
@@ -226,6 +256,17 @@ class FaultPlan:
 
     def _maybe_kill(self, kind, k):  # old name kept for callers/tests
         self._fire_lifecycle(kind, k)
+
+    def drill_rules(self):
+        """The orchestrated recovery-drill rules (recovery-harness class):
+        [{"mode": preempt+restore|kill+restore, "at": step|round,
+        "n": k, "target": role-or-None}], in spec order.  Consumed by
+        `distributed.recovery.run_drill`, never fired from the runtime
+        hooks — the harness owns signal delivery so the kill instant is
+        a measured anchor, not a guess."""
+        return [{"mode": r.arg[0], "at": r.cmd, "n": r.n,
+                 "target": r.arg[1]}
+                for r in self.rules if r.action == "drill"]
 
     def numeric_rules(self):
         """The declarative numeric-fault rules (health sentinel class):

@@ -1200,6 +1200,15 @@ class Executor:
             self._sentinels[key] = health.attach(program, lane="single")
         return self._sentinels[key]
 
+    def health_sentinel(self, program):
+        """The health sentinel this executor attached to `program`
+        (attaching it now if needed); None when FLAGS_health_sentinel is
+        off or the program has nothing to guard.  The public accessor
+        callers use to wire the sentinel into
+        ``AutoCheckpoint(sentinel=...)`` for durable rollback windows
+        (docs/DISTRIBUTED.md §6 "Preemption and recovery")."""
+        return self._health(program)
+
     def _coerce_feed(self, program, feed):
         import jax
 

@@ -69,6 +69,19 @@ _DEFS = {
     # per-round snapshots); 0 keeps the per-round behavior
     # (PT_PS_SNAPSHOT_EVERY rounds)
     "FLAGS_ps_snapshot_interval_s": (0.0, float, True),
+    # durable rollback windows (health/persist.py + AutoCheckpoint):
+    # >0 offloads the health sentinel's on-device snapshot window to the
+    # checkpoint dir at most every N seconds (async device->host copy +
+    # temp+rename manifest, PTHWIN1), so a RESTARTED job can roll back
+    # past a bad step that happened before the kill instead of resuming
+    # at the last full checkpoint; 0 disables the time cadence (the
+    # window still persists inside every full checkpoint save and on the
+    # preemption signal path when a sentinel is attached)
+    "FLAGS_rollback_persist_interval_s": (0.0, float, True),
+    # recovery-drill spec consumed by distributed.recovery.run_drill /
+    # `make recovery-drill` (FaultPlan grammar, e.g.
+    # "drill:preempt+restore:step:4"); empty = no standing drill
+    "FLAGS_recovery_drill": ("", str, True),
     "FLAGS_communicator_max_merge_var_num": (20, int, True),
     "FLAGS_communicator_send_queue_size": (20, int, True),
     "FLAGS_communicator_independent_recv_thread": (True, _parse_bool, False),
@@ -190,6 +203,13 @@ _DEFS = {
     # ServingDeadlineError instead of waiting forever (booked as
     # pt_serve_rejected_total{reason="deadline"}); 0 = no deadline
     "FLAGS_serving_deadline_ms": (0, int, True),
+    # per-tenant admission quota on the decode lane (docs/SERVING.md
+    # "Decode lane"): max LIVE requests (queued + prefilling + decoding)
+    # any one tenant may hold per engine; beyond it submissions reject
+    # with ServingOverloadError(reason="tenant_quota") and book
+    # pt_serve_rejected_total{reason="tenant_quota"} — one chatty tenant
+    # cannot starve the shared decode queue.  0 = unlimited.
+    "FLAGS_serving_tenant_quota": (0, int, True),
     # training health sentinel (paddle_tpu/health/, docs/DISTRIBUTED.md
     # §6 "Numeric fault tolerance"): on-device NaN/Inf gradient
     # detection (one found_inf scalar per step, no host scan), loss-

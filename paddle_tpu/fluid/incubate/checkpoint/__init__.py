@@ -8,6 +8,20 @@ TPU pods are preemptible, so this module adds what the reference lacks:
   directories (atomic rename, keep-N retention), a SIGTERM/SIGINT
   preemption hook that snapshots before exit, and resume() that finds the
   newest complete checkpoint and restores scope + step counter.
+
+Durable rollback windows (docs/DISTRIBUTED.md §6 "Preemption and
+recovery"): constructed with ``sentinel=`` (the lane's HealthSentinel),
+AutoCheckpoint also pumps the sentinel's on-device snapshot ring through
+`health.persist.WindowPersister` — async device→host offload on the
+FLAGS_rollback_persist_interval_s cadence from ``step()``, a synchronous
+flush inside every ``save()`` (including the preemption signal path),
+and a ``resume()`` that prefers the persisted window when it is NEWER
+than the last full checkpoint: the scope restores to the newest window
+entry (re-running that step — the per-step data must be deterministic,
+the same contract the relaunch-replay tests rely on), the older entries
+re-arm the sentinel so a post-restart rollback can walk past a bad step
+that happened before the kill, and the @HEALTH@ loss-scale state comes
+back bit-exact.
 """
 
 from __future__ import annotations
@@ -41,7 +55,8 @@ class AutoCheckpoint:
     """
 
     def __init__(self, dirname, executor, main_program=None, scope=None,
-                 save_interval=100, keep_max=3, install_signal_handler=True):
+                 save_interval=100, keep_max=3, install_signal_handler=True,
+                 sentinel=None, window_interval_s=None):
         self.dirname = str(dirname)
         self.executor = executor
         self.main_program = main_program
@@ -50,9 +65,22 @@ class AutoCheckpoint:
         self.keep_max = int(keep_max)
         self._last_step = None
         self._last_saved = None
+        self.sentinel = sentinel
+        self._persister = None
+        if sentinel is not None:
+            from paddle_tpu.health.persist import WindowPersister
+
+            self._persister = WindowPersister(
+                os.path.join(self.dirname, "health_window"), sentinel,
+                interval_s=window_interval_s)
         os.makedirs(self.dirname, exist_ok=True)
         if install_signal_handler:
             self._install()
+
+    def _scope(self):
+        from ...executor import global_scope
+
+        return self.scope if self.scope is not None else global_scope()
 
     # -- saving ---------------------------------------------------------
     def _ckpt_dir(self, step):
@@ -83,14 +111,51 @@ class AutoCheckpoint:
             raise
         self._last_saved = step
         self._gc()
+        if self._persister is not None:
+            # a full checkpoint flushes the window ring SYNCHRONOUSLY
+            # (wait=True): the preemption signal path lands here, and
+            # the window must be durable before the process dies
+            self._persister.offload(self._scope(), step,
+                                    trigger="checkpoint", wait=True)
         return final
 
     def step(self, step):
-        """Record progress; save when the interval elapses."""
+        """Record progress; save when the interval elapses.  With a
+        sentinel attached, also pump the rollback-window persister on
+        its FLAGS_rollback_persist_interval_s cadence (async — the hot
+        path pays one clock read)."""
         self._last_step = step
         if self.save_interval > 0 and step > 0 and \
                 step % self.save_interval == 0:
             self.save(step)
+        elif self._persister is not None:
+            self._persister.maybe_offload(self._scope(), step)
+
+    def flush_window(self, wait=True):
+        """Force one durable offload of the sentinel's rollback window
+        at the last seen step (no full checkpoint written) — the
+        teardown/drill hook.  No-op without a sentinel."""
+        if self._persister is None or self._last_step is None:
+            return False
+        return self._persister.offload(self._scope(), self._last_step,
+                                       trigger="flush", wait=wait)
+
+    def close(self):
+        """Teardown: flush + stop the window persister's worker thread
+        and restore the signal handlers.  A long-lived process that
+        constructs AutoCheckpoints per run must not accumulate idle
+        pollers (each pins its sentinel and the last exported window
+        refs).  Safe to call twice."""
+        if self._persister is not None:
+            self.flush_window(wait=True)
+            self._persister.close()
+        self.uninstall()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def _gc(self):
         cks = self._list()
@@ -123,19 +188,47 @@ class AutoCheckpoint:
         return out
 
     def resume(self):
-        """Restore the newest complete checkpoint; returns the next step to
-        run (0 when no checkpoint exists)."""
+        """Restore the newest complete checkpoint; returns the next step
+        to run (0 when no checkpoint exists).  With a sentinel attached,
+        a persisted rollback window NEWER than the checkpoint wins: the
+        scope restores to the newest window entry (the pre-state of the
+        returned step, which the caller re-runs), the older entries
+        re-arm the sentinel for post-restart rollback, and the @HEALTH@
+        loss-scale state comes back bit-exact.  A window OLDER than the
+        checkpoint still re-arms the sentinel ring (deeper rollback)
+        without touching the restored scope."""
         from ... import io
 
         cks = self._list()
-        if not cks:
-            return 0
-        d, meta = cks[-1]
-        io.load_persistables(self.executor, os.path.join(self.dirname, d),
-                             main_program=self.main_program, scope=self.scope)
-        self._last_saved = meta["step"]
-        self._last_step = meta["step"]
-        return int(meta["step"]) + 1
+        start = 0
+        if cks:
+            d, meta = cks[-1]
+            io.load_persistables(self.executor,
+                                 os.path.join(self.dirname, d),
+                                 main_program=self.main_program,
+                                 scope=self.scope)
+            self._last_saved = meta["step"]
+            self._last_step = meta["step"]
+            start = int(meta["step"]) + 1
+            from paddle_tpu.distributed import recovery
+
+            recovery.note("restore", source="checkpoint",
+                          step=int(meta["step"]))
+        if self._persister is not None:
+            wstep = self._persister.manifest_step()
+            if wstep is not None and wstep >= start:
+                m = self._persister.restore_into(self._scope())
+                if m is not None:
+                    start = wstep
+                    self._last_step = wstep
+                    from paddle_tpu.distributed import recovery
+
+                    recovery.note("restore", source="window", step=wstep,
+                                  entries=len(m.get("entries", ())))
+            elif wstep is not None:
+                self._persister.restore_into(self._scope(),
+                                             rearm_scope=False)
+        return start
 
     # -- preemption hook ------------------------------------------------
     def _install(self):

@@ -345,6 +345,11 @@ class DistributeTranspiler:
             "listen_and_serv",
             attrs={"endpoint": endpoint, "n_trainers": self.trainer_num,
                    "param_blocks": param_blocks,
+                   # the full shard list: a relaunched shard reconciles
+                   # its snapshot's round against the PEERS' quorum-
+                   # committed epoch record (docs/DISTRIBUTED.md §6
+                   # "Preemption and recovery")
+                   "endpoints": list(self.endpoints),
                    "sync_mode": self.sync_mode})
         return prog
 

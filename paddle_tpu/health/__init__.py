@@ -32,6 +32,14 @@ checks anywhere else):
                 Executor, transpiler DP, hybrid ZeRO-1, GSPMD executor)
                 applies so the skip/rollback state masking is one shared
                 mechanism, not four.
+- `persist`   — the DURABLE rollback window: async device→host offload
+                of the sentinel's snapshot ring on a time cadence
+                (FLAGS_rollback_persist_interval_s), temp+rename
+                durability with a versioned manifest (``PTHWIN1``), and
+                bit-exact re-arm on restart — folded into
+                `fluid.incubate.checkpoint.AutoCheckpoint(sentinel=)`
+                so a preempted job resumes at the newest window entry
+                and can still roll back past a pre-kill bad step.
 
 Enable with FLAGS_health_sentinel=1; all runner lanes attach it
 automatically (`health.attach`).
@@ -40,7 +48,9 @@ automatically (`health.attach`).
 from __future__ import annotations
 
 from . import detect  # noqa: F401
+from . import persist  # noqa: F401
 from .gating import wrap_body  # noqa: F401
+from .persist import WindowPersister  # noqa: F401
 from .sentinel import HealthSentinel, attach, run_guarded  # noqa: F401
 from .transpile import (FOUND_INF_VAR, LOSS_SCALE_VAR,  # noqa: F401
                         insert_health_sentinel)
@@ -49,9 +59,11 @@ __all__ = [
     "attach",
     "run_guarded",
     "HealthSentinel",
+    "WindowPersister",
     "insert_health_sentinel",
     "wrap_body",
     "detect",
+    "persist",
     "FOUND_INF_VAR",
     "LOSS_SCALE_VAR",
 ]

@@ -1,0 +1,370 @@
+"""Durable rollback windows — persist the health sentinel's on-device
+snapshot ring across preemption.
+
+PR 10's rollback window (sentinel.py) is a rolling deque of on-device
+`jnp.copy` snapshots: it dies with the process, so a preempted job could
+only resume at its last FULL checkpoint even when the window held
+per-step states far past it.  This module folds the window into the
+checkpoint story (`fluid.incubate.checkpoint.AutoCheckpoint(sentinel=)`):
+
+- **Async device→host offload** (`WindowPersister`): the training loop
+  hands over *references* to the window's donation-safe device copies
+  (cheap — no sync, no host round trip under the step); a single worker
+  thread materializes them to host (`np.asarray` is the D2H copy) and
+  writes the ring on a time cadence (FLAGS_rollback_persist_interval_s)
+  or on demand (full checkpoint saves, the preemption signal path).  An
+  offload that arrives while the worker is busy replaces the pending
+  payload — the persister never queues unboundedly and always writes
+  the newest ring it was handed.
+
+- **Temp+rename durability, versioned manifest**: the payload lands as
+  a generation-stamped ``window-<gen>.npz`` named by
+  ``window_manifest.json`` (format ``PTHWIN1``, the peer of the native
+  PS snapshot's ``PTSCKPT2`` versioning), each written to a temp name
+  and renamed; the manifest rename is the commit point and it names the
+  exact payload it was written with, so a kill at ANY instant leaves
+  the previous (manifest, payload) pair intact and consistent.
+
+- **Bit-exact re-arm**: `load_window` + `HealthSentinel.restore_state`
+  restore the window entries (still valid PRE-step states), the
+  @HEALTH@ scope vars — the dynamic loss scale resumes at its pre-kill
+  value instead of re-warming from init — and the host detector state
+  (loss EMA, warmup, cumulative-counter baseline).  A restarted job can
+  therefore resume AT the newest window entry (past the last full
+  checkpoint) and still roll back through the older entries when the
+  replayed step goes bad.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["WindowPersister", "save_window", "load_window",
+           "manifest_step", "WINDOW_FORMAT"]
+
+WINDOW_FORMAT = "PTHWIN1"
+_MANIFEST = "window_manifest.json"
+_PAYLOAD = "window.npz"
+_META_KEYS = ("ema", "emvar", "good_samples", "bad_total_seen",
+              "steps_seen", "keep")
+
+
+def _m_persists():
+    from paddle_tpu import observability as obs
+
+    return obs.counter(
+        "pt_rollback_window_persists_total",
+        "Durable offloads of the health sentinel's rollback window "
+        "(async device->host + temp+rename write), by trigger",
+        labels=("trigger",))
+
+
+def _m_restores():
+    from paddle_tpu import observability as obs
+
+    return obs.counter(
+        "pt_rollback_window_restores_total",
+        "Restarted processes that re-armed a persisted rollback window "
+        "(AutoCheckpoint.resume past the last full checkpoint)")
+
+
+def _materialize(state):
+    """Device→host: np.asarray every window tensor (the jnp.copy refs
+    the export handed over).  Runs on the persister's worker thread —
+    this is the blocking D2H transfer the step loop never pays."""
+    return {
+        "window": [{n: np.asarray(v) for n, v in snap.items()}
+                   for snap in state.get("window", ())],
+        "scope_health": {n: np.asarray(v)
+                         for n, v in state.get("scope_health", {}).items()},
+        **{k: state.get(k) for k in _META_KEYS},
+    }
+
+
+def save_window(dirname, state, step, trigger="explicit"):
+    """Write one materialized sentinel state as the durable ring: a
+    GENERATION-stamped payload (``window-<gen>.npz``) first, then
+    ``window_manifest.json`` naming it — both temp+rename, the manifest
+    rename as the commit point.  The manifest must name the exact
+    payload it was written with: overwriting one shared payload file
+    would let a kill between the two renames pair the OLD manifest's
+    step with the NEW payload's state, and the restored job would
+    silently re-run steps on parameters that already contain them.
+    Superseded payload generations are swept AFTER the commit.  Returns
+    the manifest dict."""
+    state = _materialize(state)
+    os.makedirs(dirname, exist_ok=True)
+    arrays, entries = {}, []
+    for i, snap in enumerate(state["window"]):
+        names = sorted(snap)
+        entries.append(names)
+        for j, n in enumerate(names):
+            arrays[f"w{i}.{j}"] = snap[n]
+    health_names = sorted(state["scope_health"])
+    for j, n in enumerate(health_names):
+        arrays[f"h.{j}"] = state["scope_health"][n]
+    prev = _read_manifest(dirname)
+    gen = (int(prev.get("generation", 0)) + 1) if prev else 1
+    payload_name = f"window-{gen:012d}.npz"
+    payload = os.path.join(dirname, payload_name)
+    tmp = f"{payload}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, payload)
+    manifest = {
+        "format": WINDOW_FORMAT,
+        "step": int(step),
+        "generation": gen,
+        "payload": payload_name,
+        "time": time.time(),  # observability: allow — manifest stamp
+        "entries": entries,           # per-entry var names, oldest first
+        "health_names": health_names,
+        "meta": {k: (None if state.get(k) is None
+                     else float(state[k]) if k in ("ema", "emvar",
+                                                   "bad_total_seen")
+                     else int(state[k]))
+                 for k in _META_KEYS},
+    }
+    mpath = os.path.join(dirname, _MANIFEST)
+    tmp = f"{mpath}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, mpath)
+    # committed: sweep superseded generations (and orphaned temps from
+    # kills mid-write) so repeated preemption cannot fill the volume
+    for name in os.listdir(dirname):
+        if name in (payload_name, _MANIFEST):
+            continue
+        if name.startswith("window-") or ".tmp" in name:
+            try:
+                os.unlink(os.path.join(dirname, name))
+            except OSError:
+                from paddle_tpu.distributed import resilience
+
+                resilience.record("window_sweep_failures")
+    _m_persists().labels(trigger=trigger).inc()
+    return manifest
+
+
+def _read_manifest(dirname):
+    try:
+        with open(os.path.join(dirname, _MANIFEST)) as f:
+            m = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if m.get("format") != WINDOW_FORMAT:
+        return None  # a future format is not guessable — treat as absent
+    return m
+
+
+def manifest_step(dirname):
+    """The step stamped on the persisted ring (the step whose PRE-state
+    is the newest window entry), or None when no usable ring exists."""
+    m = _read_manifest(dirname)
+    return None if m is None else int(m["step"])
+
+
+def load_window(dirname):
+    """-> (state, manifest) re-armable via
+    ``HealthSentinel.restore_state``, or (None, None) when absent or
+    torn (a torn ring is WORSE than none: resume falls back to the last
+    full checkpoint instead of trusting a half-written window)."""
+    m = _read_manifest(dirname)
+    if m is None:
+        return None, None
+    try:
+        with np.load(os.path.join(dirname,
+                                  m.get("payload", _PAYLOAD))) as z:
+            window = [
+                {n: z[f"w{i}.{j}"] for j, n in enumerate(names)}
+                for i, names in enumerate(m["entries"])]
+            scope_health = {n: z[f"h.{j}"]
+                            for j, n in enumerate(m["health_names"])}
+    except (OSError, KeyError, ValueError):
+        return None, None
+    state = {"window": window, "scope_health": scope_health,
+             **m.get("meta", {})}
+    return state, m
+
+
+class WindowPersister:
+    """The async offload pump between a live `HealthSentinel` and the
+    durable ring on disk.  One worker thread, one pending slot: the hot
+    path (`maybe_offload` per step) only checks the time cadence and
+    snapshots references; a busy worker means the NEXT offload simply
+    replaces the pending payload."""
+
+    def __init__(self, dirname, sentinel, interval_s=None):
+        from paddle_tpu.fluid import flags as _flags
+
+        self.dirname = str(dirname)
+        self.sentinel = sentinel
+        self.interval_s = float(
+            _flags.flag("rollback_persist_interval_s")
+            if interval_s is None else interval_s)
+        # REENTRANT on purpose: AutoCheckpoint's SIGTERM handler runs on
+        # the main thread and calls save() -> offload(wait=True); the
+        # interrupted frame may be inside offload() holding this lock —
+        # a plain Lock would deadlock the process on exactly the
+        # preemption path this module exists for (the handler's
+        # pending-slot write simply wins, which is the latest-ring
+        # semantics anyway)
+        self._lock = threading.RLock()
+        # serializes the ACTUAL disk writes between the worker and the
+        # synchronous (wait=True) path, and orders them by sequence —
+        # held only around save_window, never while queueing, so the
+        # signal handler waits at most one in-flight write (ms), never
+        # on a frame it interrupted
+        self._io_lock = threading.Lock()
+        self._pending = None          # (state, step, trigger, seq)
+        self._seq = 0                 # assigned per offload, monotonic
+        self._written_seq = 0         # last sequence durably on disk
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stop = False
+        self._thread = None
+        self._last = 0.0              # monotonic time of the last offload
+        self.persisted_steps = 0
+
+    # -- hot path --------------------------------------------------------
+    def due(self):
+        return (self.interval_s > 0
+                and time.monotonic() - self._last >= self.interval_s)
+
+    def maybe_offload(self, scope, step):
+        """Per-step hook: offload when the time cadence elapsed."""
+        if self.due():
+            self.offload(scope, step, trigger="interval")
+
+    def offload(self, scope, step, trigger="explicit", wait=False):
+        """Offload the sentinel's current state.  The export under the
+        caller is reference-cheap.  ``wait=False`` queues for the worker
+        thread; ``wait=True`` writes SYNCHRONOUSLY on the calling
+        thread and returns with the ring durably on disk — the full-
+        checkpoint save and the preemption signal handler use it, and
+        the handler may be running above an interrupted frame that
+        holds ``self._lock``, so it must not depend on the worker
+        (which needs that lock to drain the pending slot) making
+        progress before the process dies."""
+        if self.sentinel is None:
+            return False
+        state = self.sentinel.export_state(scope)
+        self._last = time.monotonic()
+        if wait:
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+                # any queued payload is OLDER than this export: drop it
+                # (a write of it racing in the worker is sequence-gated)
+                self._pending = None
+                self._idle.set()
+            return self._write(state, int(step), trigger, seq)
+        with self._lock:
+            self._seq += 1
+            self._pending = (state, int(step), trigger, self._seq)
+            self._idle.clear()
+            self._ensure_thread()
+        self._wake.set()
+        return True
+
+    # -- worker ----------------------------------------------------------
+    def _write(self, state, step, trigger, seq):
+        """One serialized, sequence-gated disk write: an older payload
+        must never land AFTER a newer one (the worker may still be
+        mid-write of a stale item when the signal path writes inline)."""
+        with self._io_lock:
+            if seq <= self._written_seq:
+                return True  # a newer ring is already on disk
+            try:
+                save_window(self.dirname, state, step, trigger=trigger)
+            except Exception:  # resilience: allow — durability is
+                # best-effort; a full disk must not kill the train loop
+                from paddle_tpu.distributed import resilience
+
+                resilience.record("window_persist_failures")
+                return False
+            self._written_seq = seq
+            self.persisted_steps = step
+        return True
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="pt-window-persist", daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            self._wake.wait(timeout=1.0)
+            with self._lock:
+                item, self._pending = self._pending, None
+                self._wake.clear()
+                if item is None:
+                    self._idle.set()
+                    if self._stop:
+                        return
+                    continue
+            self._write(*item)
+            with self._lock:
+                if self._pending is None:
+                    self._idle.set()
+
+    def close(self, flush=True):
+        """Drain the pending offload (when `flush`) and stop the
+        worker."""
+        if flush:
+            self._idle.wait(timeout=60)
+        with self._lock:
+            self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- restore ---------------------------------------------------------
+    def manifest_step(self):
+        return manifest_step(self.dirname)
+
+    def restore_into(self, scope, sentinel=None, rearm_scope=True):
+        """Re-arm `sentinel` (default: the one this persister serves)
+        from the durable ring; with `rearm_scope` the newest window
+        entry ALSO restores the stateful program vars into `scope` —
+        the resume-past-the-checkpoint path.  Returns the manifest ONLY
+        when the scope was actually restored; None otherwise — an EMPTY
+        ring (the sentinel only pushes window snapshots under
+        action="rollback", so a skip-action run persists health state
+        with no entries) must never advance the caller's resume step
+        past state it did not restore.  The loss-scale/detector re-arm
+        still happens on that path."""
+        sentinel = self.sentinel if sentinel is None else sentinel
+        state, m = load_window(self.dirname)
+        if state is None or sentinel is None:
+            return None
+        window = state["window"]
+        restored_scope = False
+        if rearm_scope and window:
+            # the newest entry is the PRE-state of manifest["step"]: it
+            # BECOMES the live scope state (the caller re-runs that
+            # step, whose pre_step re-pushes it), while the OLDER
+            # entries re-arm the window for post-restart rollback
+            newest = window[-1]
+            for n, v in newest.items():
+                scope.set(n, np.array(v, copy=True))
+            state = dict(state, window=window[:-1])
+            restored_scope = True
+        sentinel.restore_state(state, scope, rearm_scope=rearm_scope)
+        if not restored_scope:
+            return None
+        # booked only on the resume-past-the-checkpoint path — the
+        # documented meaning of the counter
+        _m_restores().inc()
+        return m

@@ -206,7 +206,7 @@ class HealthSentinel:
                 # on-device, sharding-preserving, donation-safe copy
                 return jnp.copy(value)
         except ImportError:  # pragma: no cover - jax is a hard dep
-            pass
+            pass  # resilience: allow — numpy fallback below IS the handling
         return np.array(value, copy=True)
 
     def pre_step(self, scope):
@@ -229,6 +229,68 @@ class HealthSentinel:
             scope.set(n, v)
         _m_rollbacks().inc()
         return True
+
+    # -- durable window (health/persist.py + AutoCheckpoint) -------------
+    def export_state(self, scope):
+        """Snapshot of everything a restarted process needs to re-arm
+        this sentinel bit-exactly: the rollback window (REFERENCES to
+        the on-device jnp.copy snapshots — cheap under the step loop;
+        the device→host materialization happens in the persister's
+        worker thread), the @HEALTH@ scope vars (loss scale, counters —
+        tiny, read here), and the host-side detector state (loss EMA,
+        warmup counter, cumulative-counter baseline)."""
+        names = set(self.plan["state"]) | {
+            self.plan["found_var"], self.plan["scale_var"],
+            self.plan["bad_total_var"]}
+        scope_health = {}
+        for n in sorted(names):
+            v = scope.get(n)
+            if v is not None:
+                scope_health[n] = np.asarray(v).copy()
+        return {
+            "window": [dict(snap) for snap in self._window],
+            "scope_health": scope_health,
+            "ema": self._ema,
+            "emvar": self._emvar,
+            "good_samples": self._good_samples,
+            "bad_total_seen": self._bad_total_seen,
+            "steps_seen": self._steps_seen,
+            "keep": self.keep,
+        }
+
+    def restore_state(self, state, scope, rearm_scope=True):
+        """Re-arm from an `export_state` payload (materialized to host
+        arrays by the persister): refill the rolling window oldest→
+        newest, restore the @HEALTH@ scope state (with rearm_scope —
+        the dynamic loss scale resumes at its pre-kill value instead of
+        re-warming from init), and the host detector state.  The window
+        entries stay valid PRE-STEP states, so a post-restart rollback
+        can walk past a bad step that happened before the kill."""
+        self._window = collections.deque(
+            (dict(snap) for snap in state.get("window", ())),
+            maxlen=self.keep)
+        if rearm_scope:
+            for n, v in state.get("scope_health", {}).items():
+                scope.set(n, np.array(v, copy=True))
+        ema = state.get("ema")
+        self._ema = None if ema is None else float(ema)
+        self._emvar = float(state.get("emvar", 0.0))
+        self._good_samples = int(state.get("good_samples", 0))
+        self._bad_total_seen = float(state.get("bad_total_seen", 0.0))
+        self._steps_seen = int(state.get("steps_seen", 0))
+        if rearm_scope:
+            # the cumulative-counter baseline above is the one synced to
+            # THIS scope's restored bad_steps_total — ensure_state must
+            # not re-sync it back and erase the restored delta math
+            self._cum_scope = scope
+        else:
+            # ring-only re-arm (the window is OLDER than the restored
+            # checkpoint): the scope's bad_steps_total is NOT the ring's
+            # — force ensure_state to re-sync the baseline to the live
+            # scope, or the first detect would book the checkpoint-vs-
+            # ring delta as phantom bad steps
+            self._cum_scope = None
+        return len(self._window)
 
     # -- scalar reads ----------------------------------------------------
     @staticmethod

@@ -153,6 +153,10 @@ def lib():
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
         L.pts_server_stat.restype = ctypes.c_int64
         L.pts_server_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.pts_server_reconcile_committed.restype = ctypes.c_int
+        L.pts_server_reconcile_committed.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64]
         L.pts_server_wait_round.restype = ctypes.c_int
         L.pts_server_wait_round.argtypes = [ctypes.c_void_p]
         L.pts_server_grad_count.restype = ctypes.c_int64
@@ -443,6 +447,7 @@ CMD_CHECKPOINT_NOTIFY = 8
 CMD_LEASE = 9
 CMD_JOIN = 10
 CMD_LEAVE = 11
+CMD_COMMIT_EPOCH = 12
 
 _CMD_NAMES = {CMD_SEND_GRAD: "send_grad", CMD_GET_PARAM: "get_param",
               CMD_SEND_BARRIER: "send_barrier",
@@ -450,7 +455,8 @@ _CMD_NAMES = {CMD_SEND_GRAD: "send_grad", CMD_GET_PARAM: "get_param",
               CMD_SEND_PARAM: "send_param", CMD_STOP: "stop",
               CMD_LOOKUP_ROWS: "lookup_rows",
               CMD_CHECKPOINT_NOTIFY: "checkpoint_notify",
-              CMD_LEASE: "lease", CMD_JOIN: "join", CMD_LEAVE: "leave"}
+              CMD_LEASE: "lease", CMD_JOIN: "join", CMD_LEAVE: "leave",
+              CMD_COMMIT_EPOCH: "commit_epoch"}
 
 
 def _rpc_latency():
@@ -583,6 +589,15 @@ def _decode_membership(blob: bytes) -> dict:
             "index": -1 if index == 0xffffffffffffffff else int(index)}
 
 
+def _decode_committed(blob: bytes) -> dict:
+    """The 24-byte kCommitEpoch reply: the shard's quorum-committed epoch
+    record (epoch, round, dataset position)."""
+    import struct
+
+    epoch, rnd, pos = struct.unpack("<3Q", blob)
+    return {"epoch": int(epoch), "round": int(rnd), "position": int(pos)}
+
+
 class PSServer:
     """Sync-mode parameter-server transport endpoint.
 
@@ -648,7 +663,10 @@ class PSServer:
                "members": st(self._h, 6),
                "joins": st(self._h, 7),
                "leaves": st(self._h, 8),
-               "evictions": st(self._h, 9)}
+               "evictions": st(self._h, 9),
+               "committed_epoch": st(self._h, 10),
+               "committed_round": st(self._h, 11),
+               "committed_pos": st(self._h, 12)}
         from paddle_tpu import observability as obs
 
         g = obs.gauge("pt_ps_server_stat",
@@ -772,6 +790,17 @@ class PSServer:
         """Restore a snapshot written by save()/CheckpointNotify — a
         restarted pserver resumes with its shard state."""
         return bool(lib().pts_server_load(self._h, str(path).encode()))
+
+    def reconcile_committed(self, epoch, round, position=0) -> bool:
+        """Adopt the QUORUM committed epoch record (gathered from the
+        surviving peers by `elastic.agree_epoch`): when the quorum round
+        is ahead of this shard's restored counter, the round/epoch fast-
+        forward so the survivors' barrier arithmetic lines up.  Returns
+        True when the counters moved — i.e. the snapshot was STALE and
+        this shard would otherwise have parked the job behind a round
+        count only it believed in."""
+        return bool(lib().pts_server_reconcile_committed(
+            self._h, int(epoch), int(round), int(position)))
 
     def table_get(self, name, shape=None):
         out = ctypes.c_void_p()
@@ -1072,6 +1101,26 @@ class PSClient:
         return _decode_membership(self._req(CMD_LEASE, name=self._uid))
 
     membership = lease_heartbeat
+
+    def commit_epoch(self, epoch, round, position=None):
+        """Propose the quorum epoch record (epoch, round, dataset
+        position) to this shard; accepted iff its round is not behind
+        the stored record's (commits are monotone).  Returns the shard's
+        post-accept record — trainers propose to EVERY shard after each
+        completed round, so the record survives the loss of any one
+        shard, including the old shard-0 data authority."""
+        import struct
+
+        blob = struct.pack("<3Q", int(epoch), int(round),
+                           int(round if position is None else position))
+        return _decode_committed(
+            self._req(CMD_COMMIT_EPOCH, name=self._uid, blob=blob))
+
+    def committed_epoch(self):
+        """Query this shard's quorum-committed epoch record without
+        proposing (the empty-payload form of kCommitEpoch)."""
+        return _decode_committed(
+            self._req(CMD_COMMIT_EPOCH, name=self._uid))
 
     def stop_server(self):
         # no retry: stopping an already-dead server must fail fast, not

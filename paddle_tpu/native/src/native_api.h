@@ -36,6 +36,18 @@ enum PtsCmd : uint8_t {
   kLease = 9,
   kJoin = 10,
   kLeave = 11,
+  // Quorum-committed epoch record (cross-shard data-authority agreement;
+  // docs/DISTRIBUTED.md §6 "Preemption and recovery").  request.data is
+  // either empty (QUERY) or the 24-byte record u64 epoch | u64 round |
+  // u64 position (PROPOSAL; accepted iff its round is >= the stored
+  // record's round — commits are monotone in round).  The response is
+  // always the server's current 24-byte committed record.  Trainers
+  // propose to EVERY shard after each completed round, so the record a
+  // majority of shards holds survives the loss of any one shard —
+  // including the old shard-0 membership authority — and a relaunched
+  // shard reconciles its snapshot against the quorum record instead of
+  // trusting its own file (pts_server_reconcile_committed).
+  kCommitEpoch = 12,
 };
 
 // Response status codes: 0 ok, 1 error/stopped, 2 liveness-deadline
@@ -116,8 +128,20 @@ void pts_server_set_barrier_timeout_ms(void* h, int ms);
 void pts_server_enable_elastic(void* h, int lease_timeout_ms);
 // counters: 0 send-barrier timeouts, 1 fetch-barrier timeouts,
 // 2 get-param timeouts, 3 completed rounds, 4 published version,
-// 5 membership epoch, 6 active members, 7 joins, 8 leaves, 9 evictions
+// 5 membership epoch, 6 active members, 7 joins, 8 leaves, 9 evictions,
+// 10 committed epoch, 11 committed round, 12 committed position
 int64_t pts_server_stat(void* h, int which);
+// reconcile a relaunched shard against the QUORUM committed record
+// (gathered by the driver from the surviving peers' kCommitEpoch
+// queries): when the quorum round is AHEAD of this shard's restored
+// round counter, fast-forward round_id / send_ack_round (and the
+// committed record) so the survivors' in-flight barrier arithmetic
+// lines up — without this, a shard restored from a pre-kill snapshot
+// parks the whole job behind a round count only it believes in.
+// Returns 1 when the counters moved, 0 when the snapshot was already
+// at (or ahead of) the quorum.
+int pts_server_reconcile_committed(void* h, uint64_t epoch, uint64_t round,
+                                   uint64_t position);
 // drain up to max_records span-journal entries (4 u64 each: cmd, span id,
 // wall-clock start us, handling duration us) into out; returns the count.
 // The journal records every served frame whose span field was nonzero —
@@ -139,6 +163,9 @@ void pts_server_bump_version(void* h);
 int pts_server_end_round(void* h);
 int64_t pts_server_table_get(void* h, const char* name, char** out);
 int pts_server_wait_table(void* h, const char* name);
+// shard snapshot to/from `path` (temp+rename inside save); 1 ok, 0 failed
+int pts_server_save(void* h, const char* path);
+int pts_server_load(void* h, const char* path);
 void pts_server_stop(void* h);
 void* pts_connect(const char* host, int port, double timeout_s);
 // status 0 ok / 1 error / 2 server deadline (retryable) / -1 io failure;

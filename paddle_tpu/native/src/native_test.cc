@@ -258,6 +258,68 @@ static void test_ps_elastic_membership() {
   std::puts("ps elastic membership ok");
 }
 
+static void test_ps_commit_epoch(const char* tmpdir) {
+  // quorum-committed epoch record: proposals are monotone in round, a
+  // query returns the stored record, snapshots round-trip it (v2), and
+  // reconcile fast-forwards a stale restored shard's round counter.
+  void* srv = pts_server_start(0, 1);
+  CHECK(srv != nullptr);
+  pts_server_enable_elastic(srv, 0);
+  int port = pts_server_port(srv);
+  void* c = pts_connect("127.0.0.1", port, 5.0);
+  CHECK(c != nullptr);
+  char* out = nullptr;
+  int64_t olen = 0;
+  // empty query on a fresh server: all-zero record
+  CHECK(pts_request(c, kCommitEpoch, "uid:t", 0, 0, nullptr, 0, &out, &olen)
+        == 0);
+  CHECK(olen == 24);
+  uint64_t rec[3];
+  std::memcpy(rec, out, 24);
+  ptq_free(out);
+  CHECK(rec[0] == 0 && rec[1] == 0 && rec[2] == 0);
+  // propose (epoch 2, round 5, pos 5) — accepted and echoed back
+  uint64_t prop[3] = {2, 5, 5};
+  CHECK(pts_request(c, kCommitEpoch, "uid:t", 0, 0, (const char*)prop, 24,
+                    &out, &olen) == 0);
+  std::memcpy(rec, out, 24);
+  ptq_free(out);
+  CHECK(rec[0] == 2 && rec[1] == 5 && rec[2] == 5);
+  // a STALE proposal (round 3 < 5) must not roll the record back
+  uint64_t stale[3] = {9, 3, 3};
+  CHECK(pts_request(c, kCommitEpoch, "uid:t", 0, 0, (const char*)stale, 24,
+                    &out, &olen) == 0);
+  std::memcpy(rec, out, 24);
+  ptq_free(out);
+  CHECK(rec[1] == 5 && rec[2] == 5);
+  // malformed record length → error status
+  CHECK(pts_request(c, kCommitEpoch, "uid:t", 0, 0, "xyz", 3, &out, &olen)
+        == 1);
+  ptq_free(out);
+  CHECK(pts_server_stat(srv, 10) == 2);  // committed epoch
+  CHECK(pts_server_stat(srv, 11) == 5);  // committed round
+  // snapshot v2 round-trips the record into a fresh server
+  std::string snap = std::string(tmpdir) + "/commit.ckpt";
+  CHECK(pts_server_save(srv, snap.c_str()) == 1);
+  void* srv2 = pts_server_start(0, 1);
+  CHECK(srv2 != nullptr);
+  pts_server_enable_elastic(srv2, 0);
+  CHECK(pts_server_load(srv2, snap.c_str()) == 1);
+  CHECK(pts_server_stat(srv2, 11) == 5);
+  CHECK(pts_server_stat(srv2, 12) == 5);
+  // reconcile: the quorum says round 8 — the restored shard's round
+  // counter (0, from the empty snapshot's table section) fast-forwards
+  CHECK(pts_server_reconcile_committed(srv2, 3, 8, 8) == 1);
+  CHECK(pts_server_stat(srv2, 3) == 8);   // round_id adopted
+  CHECK(pts_server_stat(srv2, 11) == 8);  // committed record adopted
+  // idempotent: already at the quorum → no movement
+  CHECK(pts_server_reconcile_committed(srv2, 3, 8, 8) == 0);
+  pts_server_stop(srv2);
+  pts_client_close(c);
+  pts_server_stop(srv);
+  std::puts("ps commit epoch ok");
+}
+
 int main(int argc, char** argv) {
   const char* tmpdir = argc > 1 ? argv[1] : "/tmp";
   test_recordio(tmpdir);
@@ -266,6 +328,7 @@ int main(int argc, char** argv) {
   test_ps_async_pop_and_lookup();
   test_ps_barrier_deadline_and_rewait();
   test_ps_elastic_membership();
+  test_ps_commit_epoch(tmpdir);
   std::puts("ALL NATIVE TESTS PASSED");
   return 0;
 }

@@ -199,6 +199,28 @@ struct PSServer {
   // end_round may renegotiate below n_trainers mid-round)
   int round_expected = 0;
   int64_t stat_joins = 0, stat_leaves = 0, stat_evictions = 0;
+  // quorum-committed epoch record (kCommitEpoch): the cross-shard
+  // data-authority agreement — epoch / round / dataset position the
+  // trainers last proposed to EVERY shard, monotone in round.  A
+  // relaunched shard reconciles its snapshot against the quorum's copy
+  // of this record instead of trusting its own file.
+  uint64_t committed_epoch = 0, committed_round = 0, committed_pos = 0;
+
+  // accept a proposal when its round is not behind the stored record's;
+  // the epoch field only ever moves forward (a proposer that has not
+  // seen the latest membership flip must not roll the epoch back)
+  void accept_commit(uint64_t ep, uint64_t rnd, uint64_t pos) {
+    if (rnd < committed_round) return;
+    committed_round = rnd;
+    committed_pos = pos;
+    if (ep > committed_epoch) committed_epoch = ep;
+    if (epoch > committed_epoch) committed_epoch = epoch;
+  }
+
+  std::string committed_blob() const {
+    uint64_t vals[3] = {committed_epoch, committed_round, committed_pos};
+    return std::string(reinterpret_cast<const char*>(vals), sizeof(vals));
+  }
 
   // span journal: (cmd, span id, wall start us, handling duration us) of
   // served frames carrying a nonzero span — drained by the driver
@@ -542,6 +564,22 @@ struct PSServer {
           lk.unlock();
           return write_response(fd, 0, blob);
         }
+        case kCommitEpoch: {
+          // a commit frame is proof of life too (it rides the same
+          // per-round cadence as barrier arrivals)
+          renew_lease(f.name);
+          if (f.data.size() == 24) {
+            uint64_t vals[3];
+            ::memcpy(vals, f.data.data(), 24);
+            accept_commit(vals[0], vals[1], vals[2]);
+          } else if (!f.data.empty()) {
+            lk.unlock();
+            return write_response(fd, 1, "");
+          }
+          std::string blob = committed_blob();
+          lk.unlock();
+          return write_response(fd, 0, blob);
+        }
         case kLeave: {
           if (elastic && !f.name.empty() && members.count(f.name)) {
             pending_leaves.insert(f.name);
@@ -578,8 +616,11 @@ struct PSServer {
           auto copy = table;
           auto mcopy = members;
           uint64_t ver = version, rid = round_id, ep = epoch;
+          uint64_t committed[3] = {committed_epoch, committed_round,
+                                   committed_pos};
           lk.unlock();
-          bool ok = write_snapshot(f.name, copy, ver, rid, ep, mcopy);
+          bool ok =
+              write_snapshot(f.name, copy, ver, rid, ep, mcopy, committed);
           return write_response(fd, ok ? 0 : 1, "");
         }
         case kStop:
@@ -596,20 +637,27 @@ struct PSServer {
   }
 
   // Snapshot file format (little-endian):
-  //   u64 magic "PTSCKPT0"/"PTSCKPT1" | u64 version | u64 round_id |
-  //   u64 count | count × (u16 name_len | name | u64 blob_len | blob)
+  //   u64 magic "PTSCKPT0"/"PTSCKPT1"/"PTSCKPT2" | u64 version |
+  //   u64 round_id | u64 count |
+  //   count × (u16 name_len | name | u64 blob_len | blob)
   // The v1 magic appends a membership section so an elastic shard's
   // restart resumes with its quorum (active member uids) and epoch:
   //   u64 epoch | u64 n_members | n × (u16 uid_len | uid)
-  // v0 files (no member section) stay loadable.
+  // The v2 magic appends the quorum-committed epoch record after the
+  // member section (u64 committed_epoch | u64 committed_round |
+  // u64 committed_pos), so a restarted shard can tell a STALE snapshot
+  // from a current one before it even reaches its peers.  v0/v1 files
+  // stay loadable.
   static constexpr uint64_t kCkptMagic = 0x505453434B505430ull;
   static constexpr uint64_t kCkptMagicV1 = 0x505453434B505431ull;
+  static constexpr uint64_t kCkptMagicV2 = 0x505453434B505432ull;
 
   static bool write_snapshot(
       const std::string& path,
       const std::unordered_map<std::string, std::string>& copy,
       uint64_t ver, uint64_t rid, uint64_t ep,
-      const std::map<std::string, Member>& mcopy) {
+      const std::map<std::string, Member>& mcopy,
+      const uint64_t committed[3]) {
     // write-to-temp + rename: a crash mid-save (the supervised pserver
     // snapshots EVERY round, so the window recurs constantly) must never
     // truncate the previous good snapshot the relaunch depends on
@@ -617,7 +665,7 @@ struct PSServer {
     FILE* fp = ::fopen(tmp.c_str(), "wb");
     if (!fp) return false;
     bool ok = true;
-    uint64_t magic = kCkptMagicV1, count = copy.size();
+    uint64_t magic = kCkptMagicV2, count = copy.size();
     ok &= ::fwrite(&magic, 8, 1, fp) == 1;
     ok &= ::fwrite(&ver, 8, 1, fp) == 1;
     ok &= ::fwrite(&rid, 8, 1, fp) == 1;
@@ -641,6 +689,7 @@ struct PSServer {
       ok &= ::fwrite(&ulen, 2, 1, fp) == 1;
       ok &= ulen == 0 || ::fwrite(kv.first.data(), ulen, 1, fp) == 1;
     }
+    ok &= ::fwrite(committed, 8, 3, fp) == 3;
     ok &= ::fclose(fp) == 0;
     if (ok) ok = ::rename(tmp.c_str(), path.c_str()) == 0;
     if (!ok) ::remove(tmp.c_str());
@@ -653,7 +702,9 @@ struct PSServer {
     auto rd = [&](void* p, size_t n) { return ::fread(p, n, 1, fp) == 1; };
     uint64_t magic = 0, ver = 0, rid = 0, count = 0;
     bool ok = rd(&magic, 8) &&
-              (magic == kCkptMagic || magic == kCkptMagicV1) && rd(&ver, 8) &&
+              (magic == kCkptMagic || magic == kCkptMagicV1 ||
+               magic == kCkptMagicV2) &&
+              rd(&ver, 8) &&
               rd(&rid, 8) && rd(&count, 8) && count < (1ull << 32);
     std::unordered_map<std::string, std::string> loaded;
     for (uint64_t i = 0; ok && i < count; ++i) {
@@ -668,8 +719,9 @@ struct PSServer {
       if (ok) loaded.emplace(std::move(name), std::move(blob));
     }
     uint64_t ep = 0, n_members = 0;
+    uint64_t loaded_commit[3] = {0, 0, 0};
     std::map<std::string, Member> mloaded;
-    if (ok && magic == kCkptMagicV1) {
+    if (ok && (magic == kCkptMagicV1 || magic == kCkptMagicV2)) {
       ok = rd(&ep, 8) && rd(&n_members, 8) && n_members < (1ull << 20);
       for (uint64_t i = 0; ok && i < n_members; ++i) {
         uint16_t ulen = 0;
@@ -683,6 +735,7 @@ struct PSServer {
         }
       }
     }
+    if (ok && magic == kCkptMagicV2) ok = rd(loaded_commit, 24);
     ::fclose(fp);
     if (!ok) return false;
     std::lock_guard<std::mutex> lk(mu);
@@ -702,6 +755,9 @@ struct PSServer {
       members = std::move(mloaded);
       epoch = ep;
     }
+    committed_epoch = loaded_commit[0];
+    committed_round = loaded_commit[1];
+    committed_pos = loaded_commit[2];
     cv.notify_all();
     return true;
   }
@@ -784,7 +840,8 @@ void pts_server_enable_elastic(void* h, int lease_timeout_ms) {
 
 // resilience counters: 0 send-barrier timeouts, 1 fetch-barrier timeouts,
 // 2 get-param timeouts, 3 completed rounds, 4 published version,
-// 5 membership epoch, 6 active members, 7 joins, 8 leaves, 9 evictions
+// 5 membership epoch, 6 active members, 7 joins, 8 leaves, 9 evictions,
+// 10 committed epoch, 11 committed round, 12 committed position
 int64_t pts_server_stat(void* h, int which) {
   auto* s = static_cast<PSServer*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
@@ -799,8 +856,41 @@ int64_t pts_server_stat(void* h, int which) {
     case 7: return s->stat_joins;
     case 8: return s->stat_leaves;
     case 9: return s->stat_evictions;
+    case 10: return static_cast<int64_t>(s->committed_epoch);
+    case 11: return static_cast<int64_t>(s->committed_round);
+    case 12: return static_cast<int64_t>(s->committed_pos);
     default: return -1;
   }
+}
+
+// reconcile a restored shard against the quorum committed record (see
+// native_api.h).  Fast-forwarding round_id also fast-forwards
+// send_ack_round: the rounds this shard missed were fully released by
+// the job, so a survivor's rewait on one of them must ack immediately.
+int pts_server_reconcile_committed(void* h, uint64_t epoch, uint64_t round,
+                                   uint64_t position) {
+  auto* s = static_cast<PSServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->accept_commit(epoch, round, position);
+  bool moved = false;
+  if (round > s->round_id) {
+    s->round_id = round;
+    if (s->send_ack_round < round) s->send_ack_round = round;
+    // keep the sync lane's version==rounds invariant: a survivor's
+    // versioned GET_PARAM for the adopted round must not wait for a
+    // fold that already happened elsewhere.  The table may be up to
+    // (round - snapshot round) rounds stale — the documented
+    // at-least-once recovery bound for a shard killed between
+    // end_round and its snapshot write.
+    if (s->version < round) s->version = round;
+    moved = true;
+  }
+  if (epoch > s->epoch) {
+    s->epoch = epoch;
+    moved = true;
+  }
+  if (moved) s->cv.notify_all();
+  return moved ? 1 : 0;
 }
 
 // drain journaled (cmd, span, start us, dur us) records; out must hold
@@ -1010,7 +1100,7 @@ int pts_server_save(void* h, const char* path) {
   auto* s = static_cast<PSServer*>(h);
   std::unordered_map<std::string, std::string> copy;
   std::map<std::string, PSServer::Member> mcopy;
-  uint64_t ver, rid, ep;
+  uint64_t ver, rid, ep, committed[3];
   {
     std::lock_guard<std::mutex> lk(s->mu);
     copy = s->table;
@@ -1018,8 +1108,14 @@ int pts_server_save(void* h, const char* path) {
     ver = s->version;
     rid = s->round_id;
     ep = s->epoch;
+    committed[0] = s->committed_epoch;
+    committed[1] = s->committed_round;
+    committed[2] = s->committed_pos;
   }
-  return PSServer::write_snapshot(path, copy, ver, rid, ep, mcopy) ? 1 : 0;
+  return PSServer::write_snapshot(path, copy, ver, rid, ep, mcopy,
+                                  committed)
+             ? 1
+             : 0;
 }
 
 // restore the table (+version/round) from a snapshot; 1 ok, 0 failed

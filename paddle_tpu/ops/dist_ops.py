@@ -292,6 +292,20 @@ def _fetch_barrier_run(scope, op, place):
         # ch.round was already bumped by send_barrier: the round being
         # completed is ch.round - 1
         ch.client.fetch_barrier(round=max(0, ch.round - 1))
+    # elastic: the round this trainer just completed is now a fact on
+    # every shard it reached — propose it as the quorum epoch record
+    # (kCommitEpoch) so the agreed resume round/dataset position
+    # survives the loss of ANY single shard (docs/DISTRIBUTED.md §6
+    # "Preemption and recovery").  Best-effort: a dead shard reconciles
+    # from the quorum when it relaunches.
+    from paddle_tpu.fluid import flags as _flags
+
+    if _flags.flag("elastic_ps"):
+        from paddle_tpu.distributed import elastic
+
+        eps = list(op.attrs["endpoints"])
+        done = min(get_channel(ep).round for ep in eps) if eps else 0
+        elastic.commit_epoch(eps, round=done, position=done)
 
 
 def _ps_init_sync_run(scope, op, place):
@@ -347,6 +361,13 @@ def _ps_init_sync_run(scope, op, place):
         scope.set(name, arr)
         if name in shadows:
             scope.set(name + "@GEO_SHADOW", np.array(arr, copy=True))
+    if restarted:
+        # recovery milestone: a relaunched trainer's durable state is
+        # the pserver table — the pull IS its restore
+        from paddle_tpu.distributed import recovery as _recovery
+
+        _recovery.note("restore", source="ps_pull",
+                       n_vars=len(list(pull_vars)))
 
 
 _job_heartbeat = None
@@ -585,7 +606,7 @@ def _drain_server_spans(server):
 
 
 def _serv_sync_loop(server, blocks, local, exe, snap_path=None,
-                    snap_every=1):
+                    snap_every=1, note_first_round=False):
     """RunSyncLoop: rendezvous rounds; dense grads averaged, SelectedRows
     grads merged by row, then the param's optimize program (or its sparse
     fast path) runs and the fresh param is published.
@@ -647,6 +668,13 @@ def _serv_sync_loop(server, blocks, local, exe, snap_path=None,
             break
         st = server.stats()  # also mirrors membership gauges
         rounds = st["rounds"]  # absolute (snapshot-continuous)
+        if note_first_round:
+            # recovery milestone: the restored shard's first COMPLETED
+            # round — the job is actually moving again
+            note_first_round = False
+            from paddle_tpu.distributed import recovery as _recovery
+
+            _recovery.note("first_step", round=int(rounds))
         if _events.enabled():
             _events.emit("round_end", round=int(rounds),
                          seconds=round(round_s, 6),
@@ -756,6 +784,36 @@ def _listen_and_serv_run(scope, op, place):
     restored = bool(restart_count > 0 and snap_path
                     and os.path.exists(snap_path)
                     and server.load(snap_path))
+    reconciled = None
+    if restored and server._elastic:
+        # cross-shard epoch agreement: a restored shard must NOT trust
+        # its own snapshot's round counter — the job may have completed
+        # rounds while this shard was down, and resuming behind the
+        # survivors would park every trainer's barrier behind a round
+        # count only this shard believes in.  Ask the surviving peers
+        # for the quorum-committed record and fast-forward to it.
+        peers = [e for e in op.attrs.get("endpoints", ()) if e != ep]
+        if peers:
+            from paddle_tpu.distributed import elastic as _elastic
+            from paddle_tpu.distributed import resilience as _resilience
+
+            try:
+                rec = _elastic.agree_epoch(peers)
+            except IOError:
+                # every peer down too (whole-job restart): the snapshot
+                # IS the best record available
+                _resilience.record("epoch_agree_unreachable")
+                rec = None
+            if rec is not None:
+                reconciled = server.reconcile_committed(
+                    rec["epoch"], rec["round"], rec["position"])
+                if reconciled:
+                    _resilience.record("epoch_reconciles")
+    if restored:
+        from paddle_tpu.distributed import recovery as _rec
+
+        _rec.note("restore", endpoint=ep, restart=restart_count,
+                  reconciled=bool(reconciled))
     if restart_count > 0 and not restored:
         # the init push happens once per job: a relaunched shard with no
         # usable snapshot (crashed before its first completed round, or
@@ -786,9 +844,16 @@ def _listen_and_serv_run(scope, op, place):
                 for blk in blocks:
                     server.publish(blk[0], np.asarray(local.get(blk[0])))
                 server.bump_version()
+            else:
+                # recovery milestone: shard state loaded + quorum
+                # reconciled + serve state republished — re-joined
+                from paddle_tpu.distributed import recovery as _rec2
+
+                _rec2.note("rejoin", endpoint=ep)
             if sync_mode:
                 _serv_sync_loop(server, blocks, local, exe,
-                                snap_path=snap_path, snap_every=snap_every)
+                                snap_path=snap_path, snap_every=snap_every,
+                                note_first_round=restored)
             else:
                 _serv_async_loop(server, blocks, local, exe,
                                  snap_path=snap_path)

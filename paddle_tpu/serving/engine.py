@@ -107,12 +107,16 @@ def _m_queue_depth():
 
 
 def _m_rejected():
+    # the ONE owner of this family's registration — the decode lane
+    # books through this helper too, so the help text can never drift
+    # between the two serving lanes
     from paddle_tpu import observability as obs
 
     return obs.counter(
         "pt_serve_rejected_total",
         "Requests rejected at the admission edge, by reason "
-        "(overload / closed / invalid)", labels=("model", "reason"))
+        "(overload / closed / invalid / deadline / tenant_quota / "
+        "draining / scheduler_failed)", labels=("model", "reason"))
 
 
 def _m_requests():
@@ -306,6 +310,10 @@ class _ModelLane:
         self._exec_lock = threading.Lock()
         self._thread = None
         self._closed = False
+        # graceful drain (elastic.DrainHandler): admission stopped, the
+        # scheduler finishes the batch in flight, queued futures fail
+        # typed with reason="draining"
+        self._draining = False
         # engine-level warm-executable bookkeeping, keyed on the padded
         # batch shape key (the executor's own cache holds the jitted
         # executables; this set is what /servez reports as "warm")
@@ -345,7 +353,7 @@ class _ModelLane:
         self._queue_depth = _m_queue_depth().labels(model=name)
         self._rejected = {r: _m_rejected().labels(model=name, reason=r)
                           for r in ("overload", "closed", "invalid",
-                                    "deadline")}
+                                    "deadline", "draining")}
         self._rows = {k: _m_rows().labels(model=name, kind=k)
                       for k in ("real", "padding")}
         self._exec_cache = {r: _m_exec_cache().labels(model=name, result=r)
@@ -514,13 +522,21 @@ class _ModelLane:
             if self._closed:
                 self._rejected["closed"].inc()
                 raise ServingOverloadError(
-                    f"model {self.name!r}: engine is closed")
+                    f"model {self.name!r}: engine is closed",
+                    reason="closed")
+            if self._draining:
+                self._rejected["draining"].inc()
+                raise ServingOverloadError(
+                    f"model {self.name!r}: engine is draining (graceful "
+                    f"preemption) — resubmit to another replica",
+                    reason="draining")
             if len(self._queue) >= self.max_queue:
                 self._rejected["overload"].inc()
                 raise ServingOverloadError(
                     f"model {self.name!r}: queue at admission limit "
                     f"({self.max_queue} requests, "
-                    f"FLAGS_serving_max_queue) — retry with backoff")
+                    f"FLAGS_serving_max_queue) — retry with backoff",
+                    reason="overload")
             # tenant is a caller-supplied string feeding a metric label:
             # cap its cardinality or a per-user/per-request id scheme
             # grows the registry (and /servez) without bound
@@ -593,9 +609,39 @@ class _ModelLane:
         with self._cv:
             while True:
                 while not self._queue and not self._closed:
-                    self._cv.wait()
+                    # bounded wait: an IDLE lane must still observe a
+                    # process-level SIGTERM drain (nothing queues on a
+                    # draining lane, so no submit would ever wake it)
+                    if not self._draining:
+                        from paddle_tpu.distributed import elastic
+
+                        if elastic.drain_requested():
+                            # queue is empty under the lock: flipping
+                            # the flag IS the whole drain here
+                            self._draining = True
+                    self._cv.wait(timeout=0.5)
                 if not self._queue:
                     return None  # closed and drained
+                # a process-level SIGTERM drain (elastic.DrainHandler)
+                # observed here fails the woken queue typed before any
+                # of it reaches the device; Engine.drain() is the
+                # explicit form of the same transition
+                if not self._draining:
+                    from paddle_tpu.distributed import elastic
+
+                    if elastic.drain_requested():
+                        # drop the condition's lock around drain(): it
+                        # re-enters `with self._cv` and resolves futures
+                        # (whose done-callbacks may call back into the
+                        # engine) — both forbidden under the held lock
+                        self._cv.release()
+                        try:
+                            self.drain()
+                        finally:
+                            # re-take OUR lock, released 4 lines up —
+                            # not a wait on a peer
+                            self._cv.acquire()  # resilience: allow
+                        continue
                 self._expire_queued()
                 if not self._queue:
                     if self._closed:
@@ -878,7 +924,8 @@ class _ModelLane:
             # product for a dead engine — re-check per shape
             if self._closed:
                 raise ServingOverloadError(
-                    f"model {self.name!r}: engine closed during warmup")
+                    f"model {self.name!r}: engine closed during warmup",
+                    reason="closed")
             fut = concurrent.futures.Future()
             rows = next(iter(feed.values())).shape[0]
             key = tuple((n, tuple(a.shape[1:]), str(a.dtype))
@@ -968,6 +1015,30 @@ class _ModelLane:
 
     # -- lifecycle / stats -------------------------------------------------
 
+    def drain(self):
+        """Graceful drain (the serving half of the `elastic.DrainHandler`
+        contract): stop admission — new submits reject typed with
+        ``reason="draining"`` — and fail the QUEUED futures typed; the
+        batch already in flight on the scheduler thread completes and
+        resolves normally.  The scheduler stays alive (close() still
+        owns teardown), so a SIGTERM'd replica finishes real work
+        instead of dying mid-batch.  Idempotent."""
+        with self._cv:
+            if self._closed or self._draining:
+                return
+            self._draining = True
+            leftovers, self._queue = list(self._queue), collections.deque()
+            self._queued_rows.clear()
+            self._queue_depth.set(0)
+            self._cv.notify_all()
+        for r in leftovers:
+            if r.future.set_running_or_notify_cancel():
+                self._rejected["draining"].inc()
+                r.future.set_exception(ServingOverloadError(
+                    f"model {self.name!r}: engine drained before the "
+                    f"request was scheduled — resubmit to another "
+                    f"replica", reason="draining"))
+
     def close(self):
         with self._cv:
             self._closed = True
@@ -985,7 +1056,7 @@ class _ModelLane:
             if r.future.set_running_or_notify_cancel():
                 r.future.set_exception(ServingOverloadError(
                     f"model {self.name!r}: engine closed before the "
-                    f"request was scheduled"))
+                    f"request was scheduled", reason="closed"))
 
     def stats(self):
         from paddle_tpu import observability as obs
@@ -1023,6 +1094,7 @@ class _ModelLane:
         return {
             "signature": self.signature,
             "queue_depth": depth,
+            "draining": self._draining,
             "requests": self._served_requests,
             "batches": self._served_batches,
             "warm_executables": n_warm,
@@ -1119,7 +1191,8 @@ class Engine:
 
         if self._closed:
             raise ServingOverloadError(
-                f"engine {self.name!r} is closed; cannot load models")
+                f"engine {self.name!r} is closed; cannot load models",
+                reason="closed")
         if name in self._lanes:
             raise ValueError(f"model {name!r} already loaded")
         if isinstance(model, str):
@@ -1156,7 +1229,8 @@ class Engine:
             # engine (the lane has no threads yet, so discarding is safe)
             if self._closed:
                 raise ServingOverloadError(
-                    f"engine {self.name!r} is closed; cannot load models")
+                    f"engine {self.name!r} is closed; cannot load models",
+                    reason="closed")
             if name in self._lanes:
                 raise ValueError(f"model {name!r} already loaded")
             self._lanes[name] = lane
@@ -1184,7 +1258,8 @@ class Engine:
         with self._lock:
             if self._closed:
                 raise ServingOverloadError(
-                    f"engine {self.name!r} is closed; cannot start")
+                    f"engine {self.name!r} is closed; cannot start",
+                    reason="closed")
             self._started = True
             lanes = list(self._lanes.values())
         for lane in lanes:
@@ -1199,7 +1274,8 @@ class Engine:
         with self._lock:
             if self._closed:
                 raise ServingOverloadError(
-                    f"engine {self.name!r} is closed; cannot warm up")
+                    f"engine {self.name!r} is closed; cannot warm up",
+                    reason="closed")
             if model is None:
                 lanes = list(self._lanes.values())
             elif model in self._lanes:
@@ -1210,6 +1286,17 @@ class Engine:
             raise ModelNotLoadedError(
                 f"model {model!r} not loaded; serving {self.models()}")
         return {lane.name: lane.warmup() for lane in lanes}
+
+    def drain(self):
+        """Graceful drain across every lane (the `elastic.DrainHandler`
+        hookup): admission stops typed (``reason="draining"``), queued
+        futures fail typed, in-flight batches complete.  The engine
+        stays open — call close() after the process snapshot/LEAVE
+        choreography finishes."""
+        with self._lock:
+            lanes = list(self._lanes.values())
+        for lane in lanes:
+            lane.drain()
 
     def close(self):
         with self._lock:

@@ -20,9 +20,21 @@ class ServingError(RuntimeError):
 
 class ServingOverloadError(ServingError):
     """Admission control rejected the request: the model's queue is at
-    FLAGS_serving_max_queue (or the engine is closed).  The typed
-    rejection IS the contract — callers shed/retry instead of the engine
-    queueing unboundedly and timing out everyone."""
+    FLAGS_serving_max_queue, a tenant is over its
+    FLAGS_serving_tenant_quota, the engine is draining for a graceful
+    SIGTERM, or it is closed.  The typed rejection IS the contract —
+    callers shed/retry instead of the engine queueing unboundedly and
+    timing out everyone.
+
+    ``reason`` classifies the rejection (``overload`` / ``closed`` /
+    ``tenant_quota`` / ``draining`` / ``scheduler_failed``) and matches
+    the ``pt_serve_rejected_total{reason}`` label the rejection books —
+    a caller's backoff policy can branch on it (a tenant-quota reject
+    is per-tenant pressure, not engine-wide overload)."""
+
+    def __init__(self, message, reason="overload"):
+        super().__init__(message)
+        self.reason = str(reason)
 
 
 class ModelNotLoadedError(ServingError, KeyError):

@@ -240,15 +240,27 @@ def run_trainer_elastic(tid, endpoints, n_trainers, opt_name, out_path):
         from paddle_tpu.fluid.prefetch import DatasetPrefetcher
 
         view = {"index": -1, "count": 1}
-        start_rnd = elastic.membership(eps[0])["round"]
+        # resume position: the QUORUM committed round wins over any one
+        # shard's membership view — a relaunched shard 0 restored from a
+        # stale snapshot must not drag the dataset position backwards
+        start_rnd = elastic.membership_any(eps)["round"]
+        try:
+            start_rnd = max(start_rnd, elastic.agree_epoch(eps)["round"])
+        except IOError:
+            pass  # no committed record yet (fresh job)
         pf = DatasetPrefetcher(
             iter(batches[start_rnd:]), depth=1,
             partition=lambda: (view["index"], view["count"]),
             partition_stage="consume")
         next_rnd = start_rnd
+        restart_count = int(os.environ.get("PADDLE_RESTART_COUNT",
+                                           "0") or 0)
         try:
             while True:
-                info = elastic.membership(eps[0])
+                # any live shard is a valid per-round view (all shards
+                # flip membership at the same boundary); walking the
+                # list survives the loss of the old shard-0 authority
+                info = elastic.membership_any(eps)
                 rnd, count, index = (info["round"], info["count"],
                                      info["index"])
                 if rnd >= N_STEPS:
@@ -268,6 +280,11 @@ def run_trainer_elastic(tid, endpoints, n_trainers, opt_name, out_path):
                 next_rnd += 1
                 (lv,) = exe.run(trainer_prog, feed=sub,
                                 fetch_list=[loss.name])
+                if restart_count:  # recovery milestone, once
+                    restart_count = 0
+                    from paddle_tpu.distributed import recovery
+
+                    recovery.note("first_step", round=rnd)
                 losses.append(float(np.asarray(lv)))
                 counts.append(count)
                 rounds_run.append(rnd)
@@ -327,6 +344,8 @@ def run_trainer(tid, endpoints, n_trainers, opt_name, out_path):
                                 save_interval=1,
                                 install_signal_handler=False)
             start_step = ck.resume()
+        noted_first = int(os.environ.get("PADDLE_RESTART_COUNT",
+                                         "0") or 0) == 0
         for i, b in enumerate(global_batches()):
             step = i + 1
             if start_step and step < start_step:
@@ -334,6 +353,11 @@ def run_trainer(tid, endpoints, n_trainers, opt_name, out_path):
             fault_injection.on_step(step)
             sub = {k: v[tid * per:(tid + 1) * per] for k, v in b.items()}
             (lv,) = exe.run(trainer_prog, feed=sub, fetch_list=[loss.name])
+            if not noted_first:  # recovery milestone, once per relaunch
+                noted_first = True
+                from paddle_tpu.distributed import recovery
+
+                recovery.note("first_step", step=step)
             losses.append(float(np.asarray(lv)))
             if ck is not None:
                 ck.step(step)

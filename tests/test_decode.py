@@ -435,3 +435,130 @@ def test_decode_servez_section():
         eng.close()
     assert "servez-decode" not in [
         d["engine"] for d in status.servez_payload()["decode"]]
+
+
+# ---------------------------------------------------------------------------
+# per-tenant quotas + graceful drain (ISSUE 14 satellites — host-side,
+# no device execution: untrained scope, auto_start=False)
+# ---------------------------------------------------------------------------
+
+
+def test_decode_tenant_quota_rejects_typed():
+    """FLAGS_serving_tenant_quota (here the ctor override): one tenant's
+    LIVE footprint (queued + ready + decoding) is capped; the rejection
+    is typed with reason="tenant_quota" and books
+    pt_serve_rejected_total{model,reason} — while OTHER tenants keep
+    being admitted (per-tenant pressure, not engine overload)."""
+    cfg = gpt.GPTConfig.tiny(**CFG)
+    scope = fluid.Scope()
+    eng = serving.DecodeEngine(cfg, scope=scope, pool_slots=2,
+                               page_size=4, prefill_chunk=4, max_len=16,
+                               name="quota", auto_start=False,
+                               tenant_quota=2)
+    try:
+        eng.submit([1, 2], 2, tenant="acme")
+        eng.submit([1, 2], 2, tenant="acme")
+        with pytest.raises(serving.ServingOverloadError,
+                           match="tenant") as ei:
+            eng.submit([1, 2], 2, tenant="acme")
+        assert ei.value.reason == "tenant_quota"
+        # a different tenant still gets in
+        eng.submit([1, 2], 2, tenant="other")
+        from paddle_tpu import observability as obs
+
+        fam = obs.snapshot().get("pt_serve_rejected_total", {})
+        assert fam.get("samples", {}).get(("quota", "tenant_quota"),
+                                          0) >= 1
+        assert eng.stats()["tenant_quota"] == 2
+    finally:
+        eng.close()
+
+
+def test_decode_tenant_quota_zero_is_unlimited():
+    cfg = gpt.GPTConfig.tiny(**CFG)
+    scope = fluid.Scope()
+    eng = serving.DecodeEngine(cfg, scope=scope, pool_slots=2,
+                               page_size=4, prefill_chunk=4, max_len=16,
+                               name="noquota", auto_start=False,
+                               tenant_quota=0)
+    try:
+        for _ in range(5):
+            eng.submit([1, 2], 2, tenant="acme")
+    finally:
+        eng.close()
+
+
+def test_decode_drain_fails_queued_typed_and_stops_admission():
+    """drain(): queued futures fail typed with reason="draining" (their
+    pool pages return), new submits reject typed, and the scheduler's
+    flush half (_flush_for_drain — exercised synchronously here, no
+    device) marks the engine drained once nothing is in flight."""
+    cfg = gpt.GPTConfig.tiny(**CFG)
+    scope = fluid.Scope()
+    eng = serving.DecodeEngine(cfg, scope=scope, pool_slots=2,
+                               page_size=4, prefill_chunk=4, max_len=16,
+                               name="drainage", auto_start=False)
+    try:
+        f1 = eng.submit([1, 2], 2)
+        f2 = eng.submit([3, 4, 5], 4)
+        assert eng.drain() is True
+        eng._flush_for_drain()  # the scheduler-thread half, run inline
+        for f in (f1, f2):
+            with pytest.raises(serving.ServingOverloadError) as ei:
+                f.result(timeout=10)
+            assert ei.value.reason == "draining"
+        with pytest.raises(serving.ServingOverloadError) as ei:
+            eng.submit([1, 2], 2)
+        assert ei.value.reason == "draining"
+        assert eng._drained.is_set()
+        assert eng.stats()["draining"] is True
+        assert eng.pool.pages_in_use() == 0  # victims freed their pages
+    finally:
+        eng.close()
+
+
+def test_decode_drain_on_sigterm_hook(monkeypatch):
+    """The elastic.DrainHandler hookup: when the process drain handler
+    reports a SIGTERM, the next scheduler iteration flips the lane into
+    draining WITHOUT anyone calling drain() — admission stops typed.
+    (drain_requested is monkeypatched; a real signal would race the
+    test runner.)  _step_once on an empty engine performs no device
+    work."""
+    from paddle_tpu.serving import decode as decode_mod
+
+    cfg = gpt.GPTConfig.tiny(**CFG)
+    scope = fluid.Scope()
+    eng = serving.DecodeEngine(cfg, scope=scope, pool_slots=2,
+                               page_size=4, prefill_chunk=4, max_len=16,
+                               name="sigdrain", auto_start=False)
+    try:
+        from paddle_tpu.distributed import elastic
+
+        monkeypatch.setattr(elastic, "drain_requested", lambda: True)
+        eng._step_once()  # one scheduler iteration, empty engine
+        assert eng.stats()["draining"] is True
+        with pytest.raises(serving.ServingOverloadError) as ei:
+            eng.submit([1, 2], 2)
+        assert ei.value.reason == "draining"
+    finally:
+        eng.close()
+
+
+def test_decode_drain_on_sigterm_opt_out(monkeypatch):
+    """drain_on_sigterm=False: a replica that owns its own drain
+    choreography is not flipped by the process handler."""
+    cfg = gpt.GPTConfig.tiny(**CFG)
+    scope = fluid.Scope()
+    eng = serving.DecodeEngine(cfg, scope=scope, pool_slots=2,
+                               page_size=4, prefill_chunk=4, max_len=16,
+                               name="optout", auto_start=False,
+                               drain_on_sigterm=False)
+    try:
+        from paddle_tpu.distributed import elastic
+
+        monkeypatch.setattr(elastic, "drain_requested", lambda: True)
+        eng._step_once()
+        assert eng.stats()["draining"] is False
+        eng.submit([1, 2], 2)  # admission unaffected
+    finally:
+        eng.close()

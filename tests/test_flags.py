@@ -501,3 +501,49 @@ def test_aot_cache_flag_roundtrip(monkeypatch):
     assert fl.get_flags("aot_cache_dir")["aot_cache_dir"] == "/tmp/aotx2"
     monkeypatch.delenv("FLAGS_aot_cache_dir")
     importlib.reload(fl)  # restore defaults for other tests
+
+
+def test_recovery_flags_roundtrip(monkeypatch):
+    """The preemption-recovery flags (ISSUE 14): durable rollback-window
+    cadence (0 = full-checkpoint/signal saves only), the standing drill
+    spec, and the decode-lane per-tenant quota — registered with their
+    documented defaults, round-tripping through env bootstrap and
+    get/set like every other flag."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("rollback_persist_interval_s")[
+        "rollback_persist_interval_s"] == 0.0
+    assert fl.get_flags("recovery_drill")["recovery_drill"] == ""
+    assert fl.get_flags("serving_tenant_quota")[
+        "serving_tenant_quota"] == 0
+    try:
+        fl.set_flags({"FLAGS_rollback_persist_interval_s": "2.5",
+                      "recovery_drill": "drill:preempt+restore:step:4",
+                      "FLAGS_serving_tenant_quota": 8})
+        assert fl.get_flags(["rollback_persist_interval_s",
+                             "recovery_drill",
+                             "serving_tenant_quota"]) == {
+            "rollback_persist_interval_s": 2.5,
+            "recovery_drill": "drill:preempt+restore:step:4",
+            "serving_tenant_quota": 8}
+    finally:
+        fl.set_flags({"FLAGS_rollback_persist_interval_s": 0.0,
+                      "FLAGS_recovery_drill": "",
+                      "FLAGS_serving_tenant_quota": 0})
+    monkeypatch.setenv("FLAGS_rollback_persist_interval_s", "30")
+    monkeypatch.setenv("FLAGS_recovery_drill",
+                       "drill:kill+restore:round:6:pserver0")
+    monkeypatch.setenv("FLAGS_serving_tenant_quota", "4")
+    importlib.reload(fl)
+    assert fl.get_flags("rollback_persist_interval_s")[
+        "rollback_persist_interval_s"] == 30.0
+    assert fl.get_flags("recovery_drill")[
+        "recovery_drill"] == "drill:kill+restore:round:6:pserver0"
+    assert fl.get_flags("serving_tenant_quota")[
+        "serving_tenant_quota"] == 4
+    monkeypatch.delenv("FLAGS_rollback_persist_interval_s")
+    monkeypatch.delenv("FLAGS_recovery_drill")
+    monkeypatch.delenv("FLAGS_serving_tenant_quota")
+    importlib.reload(fl)  # restore defaults for other tests

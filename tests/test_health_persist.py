@@ -1,0 +1,364 @@
+"""Durable rollback windows (ISSUE 14 tentpole, health/persist.py):
+async offload of the sentinel's snapshot ring, temp+rename durability
+with the PTHWIN1 manifest, and the bit-exact re-arm — loss-scale state,
+detector state, and window entries a restarted process can roll back
+through."""
+
+import cpu_mesh  # noqa: F401  (must precede any jax import)
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import fault_injection
+from paddle_tpu.fluid.executor import Scope, global_scope, scope_guard
+from paddle_tpu.fluid.incubate.checkpoint import AutoCheckpoint
+from paddle_tpu.health import persist
+from paddle_tpu.health.transpile import LOSS_SCALE_VAR
+
+N_STEPS = 6
+
+
+@pytest.fixture
+def health_flags():
+    names = ["FLAGS_health_sentinel", "FLAGS_health_action",
+             "FLAGS_health_rollback_keep", "FLAGS_health_loss_scaling",
+             "FLAGS_health_loss_scale_init",
+             "FLAGS_health_scale_growth_steps",
+             "FLAGS_rollback_persist_interval_s"]
+    prior = fluid.get_flags(names)
+
+    def arm(**kw):
+        fluid.set_flags({"FLAGS_health_sentinel": True,
+                         "FLAGS_health_action": "rollback", **kw})
+
+    yield arm
+    fluid.set_flags(prior)
+    fault_injection.uninstall()
+
+
+def _build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n=N_STEPS, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.uniform(-1, 1, (4, 1)).astype("float32")
+    return [{"x": (xb := rng.uniform(-1, 1, (8, 4)).astype("float32")),
+             "y": xb @ w} for _ in range(n)]
+
+
+def _run_steps(n, ckpt_dir=None, save_interval=10 ** 9, plan=None,
+               capture_params_each_step=False):
+    """Train n steps with the sentinel armed; returns (sentinel, scope
+    reads).  With ckpt_dir, an AutoCheckpoint(sentinel=) pumps the
+    durable ring (per-step: tiny interval)."""
+    if plan:
+        fault_injection.install(plan)
+    else:
+        fault_injection.uninstall()
+    main, startup, loss = _build()
+    scope = Scope()
+    per_step = []
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sent = exe.health_sentinel(main)
+        assert sent is not None
+        ck = None
+        if ckpt_dir:
+            ck = AutoCheckpoint(ckpt_dir, exe, main, scope=scope,
+                                save_interval=save_interval,
+                                install_signal_handler=False,
+                                sentinel=sent, window_interval_s=1e-6)
+        for i, b in enumerate(_batches(n)):
+            if capture_params_each_step:
+                per_step.append(
+                    np.asarray(scope.get("fc_0.w_0")).copy())
+            exe.run(main, feed=b, fetch_list=[loss.name])
+            if ck is not None:
+                ck.step(i)
+        if ck is not None:
+            ck.flush_window(wait=True)
+    fault_injection.uninstall()
+    return sent, scope, per_step, (main, ck)
+
+
+# ---------------------------------------------------------------------------
+# save/load round trip + durability format
+# ---------------------------------------------------------------------------
+
+
+def test_window_save_load_roundtrip_bit_exact(tmp_path, health_flags):
+    health_flags(FLAGS_health_rollback_keep=3)
+    sent, scope, _, _ = _run_steps(5)
+    state = sent.export_state(scope)
+    d = str(tmp_path / "ring")
+    m = persist.save_window(d, state, step=4)
+    assert m["format"] == "PTHWIN1" and m["step"] == 4
+    assert len(m["entries"]) == 3  # keep=3 entries, oldest first
+    loaded, m2 = persist.load_window(d)
+    assert m2["step"] == 4
+    for live, back in zip(state["window"], loaded["window"]):
+        assert sorted(live) == sorted(back)
+        for name in live:
+            np.testing.assert_array_equal(np.asarray(live[name]),
+                                          back[name])
+    for k in ("ema", "emvar", "good_samples", "bad_total_seen",
+              "steps_seen"):
+        got, want = loaded[k], state[k]
+        assert got == want or (got == pytest.approx(want))
+    # the manifest rename is the commit point: an unknown format reads
+    # as ABSENT, never as a guess
+    mp = os.path.join(d, "window_manifest.json")
+    doc = json.load(open(mp))
+    doc["format"] = "PTHWIN9"
+    json.dump(doc, open(mp, "w"))
+    assert persist.load_window(d) == (None, None)
+    assert persist.manifest_step(d) is None
+
+
+def test_torn_payload_reads_as_absent(tmp_path, health_flags):
+    """A half-written ring is WORSE than none: resume must fall back to
+    the checkpoint instead of trusting it."""
+    health_flags()
+    sent, scope, _, _ = _run_steps(4)
+    d = str(tmp_path / "ring")
+    m = persist.save_window(d, sent.export_state(scope), step=3)
+    with open(os.path.join(d, m["payload"]), "wb") as f:
+        f.write(b"torn")
+    assert persist.load_window(d) == (None, None)
+
+
+def test_kill_between_payload_and_manifest_keeps_old_pair(tmp_path,
+                                                          health_flags):
+    """The commit-point contract: the manifest names the exact payload
+    it was written with (generation-stamped), so a kill AFTER the new
+    payload landed but BEFORE the manifest rename leaves the previous
+    (manifest, payload) pair intact — never an old step stamp over new
+    state, which would silently double-apply the replayed steps."""
+    health_flags(FLAGS_health_rollback_keep=2)
+    sent, scope, per, _ = _run_steps(5, capture_params_each_step=True)
+    d = str(tmp_path / "ring")
+    m1 = persist.save_window(d, sent.export_state(scope), step=3)
+    state1, _ = persist.load_window(d)
+    # simulate the torn second save: the NEW payload file appears (a
+    # different generation name) but the manifest rename never happened
+    with open(os.path.join(d, "window-000000000099.npz"), "wb") as f:
+        f.write(b"newer payload, uncommitted")
+    state2, m2 = persist.load_window(d)
+    assert m2["step"] == m1["step"] and m2["payload"] == m1["payload"]
+    np.testing.assert_array_equal(
+        state2["window"][-1]["fc_0.w_0"], state1["window"][-1]["fc_0.w_0"])
+    # a committed save sweeps superseded generations
+    persist.save_window(d, sent.export_state(scope), step=4)
+    names = set(os.listdir(d))
+    payloads = {n for n in names if n.startswith("window-")}
+    assert payloads == {persist._read_manifest(d)["payload"]}
+
+
+# ---------------------------------------------------------------------------
+# restore semantics: resume past the checkpoint, roll back past the kill
+# ---------------------------------------------------------------------------
+
+
+def test_resume_prefers_newer_window_and_rearms_rollback(tmp_path,
+                                                         health_flags):
+    """The headline contract: no full checkpoint in range, so a
+    checkpoint-only restart would resume at 0 — the persisted ring
+    resumes at the newest window entry AND re-arms the older entries,
+    so a post-restart rollback restores the PRE-KILL pre-step states
+    bit-exactly."""
+    health_flags(FLAGS_health_rollback_keep=3)
+    d = str(tmp_path / "ck")
+    sent1, scope1, per_step, _ = _run_steps(
+        5, ckpt_dir=d, capture_params_each_step=True)
+    # per_step[i] = params BEFORE step i; the ring holds pre-2/3/4
+
+    # "new process": fresh program/executor/scope
+    main2, startup2, loss2 = _build()
+    scope2 = Scope()
+    with scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        sent2 = exe2.health_sentinel(main2)
+        ck2 = AutoCheckpoint(d, exe2, main2, scope=scope2,
+                             save_interval=10 ** 9,
+                             install_signal_handler=False,
+                             sentinel=sent2)
+        start = ck2.resume()
+        assert start == 4  # the newest entry: pre-step-4 — re-run step 4
+        np.testing.assert_array_equal(
+            np.asarray(scope2.get("fc_0.w_0")), per_step[4])
+        # the RE-ARMED ring: two older entries, pre-3 then... popping
+        # walks newest-first — a post-restart rollback lands on pre-3,
+        # a second consecutive failure on pre-2: past the kill
+        assert len(sent2._window) == 2
+        assert sent2.restore(scope2) is True
+        np.testing.assert_array_equal(
+            np.asarray(scope2.get("fc_0.w_0")), per_step[3])
+        assert sent2.restore(scope2) is True
+        np.testing.assert_array_equal(
+            np.asarray(scope2.get("fc_0.w_0")), per_step[2])
+        assert sent2.restore(scope2) is False  # ring exhausted
+
+
+def test_loss_scale_state_rearms_bit_exact(tmp_path, health_flags):
+    """Dynamic loss scaling survives the restart: the halved-by-a-bad-
+    step scale (and the grow counters) resume bit-exact instead of
+    re-warming from FLAGS_health_loss_scale_init."""
+    health_flags(FLAGS_health_loss_scaling=True,
+                 FLAGS_health_loss_scale_init=1024.0,
+                 FLAGS_health_scale_growth_steps=10 ** 6)
+    d = str(tmp_path / "ck")
+    sent1, scope1, _, _ = _run_steps(5, ckpt_dir=d,
+                                     plan="nan:grad:step:2")
+    live_scale = float(np.asarray(scope1.get(LOSS_SCALE_VAR))[0])
+    assert live_scale == 512.0  # halved exactly once by the bad step
+
+    main2, startup2, _ = _build()
+    scope2 = Scope()
+    with scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        sent2 = exe2.health_sentinel(main2)
+        ck2 = AutoCheckpoint(d, exe2, main2, scope=scope2,
+                             save_interval=10 ** 9,
+                             install_signal_handler=False,
+                             sentinel=sent2)
+        ck2.resume()
+        assert float(np.asarray(scope2.get(LOSS_SCALE_VAR))[0]) \
+            == live_scale
+        # detector state comes back too (EMA warmup does not restart)
+        assert sent2._good_samples == sent1._good_samples
+        assert sent2._ema == pytest.approx(sent1._ema)
+
+
+def test_window_older_than_checkpoint_rearms_ring_only(tmp_path,
+                                                       health_flags):
+    """A checkpoint NEWER than the ring wins the resume position, but
+    the older ring still re-arms the sentinel — those entries are valid
+    deeper-rollback targets."""
+    health_flags(FLAGS_health_rollback_keep=2)
+    d = str(tmp_path / "ck")
+    main, startup, loss = _build()
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sent = exe.health_sentinel(main)
+        ck = AutoCheckpoint(d, exe, main, scope=scope,
+                            save_interval=10 ** 9,
+                            install_signal_handler=False, sentinel=sent)
+        for i, b in enumerate(_batches(4)):
+            exe.run(main, feed=b, fetch_list=[loss.name])
+            ck.step(i)
+        ck.flush_window(wait=True)   # ring at step 3
+        ck.save(7)                   # full checkpoint stamped AHEAD
+        ckpt_w = np.asarray(scope.get("fc_0.w_0")).copy()
+
+    main2, startup2, _ = _build()
+    scope2 = Scope()
+    with scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        sent2 = exe2.health_sentinel(main2)
+        ck2 = AutoCheckpoint(d, exe2, main2, scope=scope2,
+                             save_interval=10 ** 9,
+                             install_signal_handler=False,
+                             sentinel=sent2)
+        start = ck2.resume()
+        assert start == 8  # the checkpoint's step+1, not the ring's
+        np.testing.assert_array_equal(
+            np.asarray(scope2.get("fc_0.w_0")), ckpt_w)
+        assert len(sent2._window) == 2  # ...but the ring is re-armed
+
+
+def test_persister_offload_is_async_and_latest_wins(tmp_path,
+                                                    health_flags):
+    """The pump contract: offloads queue into ONE pending slot (a busy
+    worker means the newest ring replaces the pending one), and close()
+    flushes."""
+    from paddle_tpu.health.persist import WindowPersister
+
+    health_flags()
+    sent, scope, _, _ = _run_steps(4)
+    d = str(tmp_path / "ring")
+    p = WindowPersister(d, sent, interval_s=0.0)  # explicit-only
+    assert p.due() is False
+    try:
+        for step in (1, 2, 3):
+            p.offload(scope, step)
+        p.offload(scope, 9, wait=True)
+        assert persist.manifest_step(d) == 9  # the newest won
+    finally:
+        p.close()
+
+
+def test_no_sentinel_means_no_persister(tmp_path):
+    """AutoCheckpoint without a sentinel keeps its exact prior shape —
+    no ring dir, flush_window is a no-op False."""
+    main, startup, _ = _build()
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ck = AutoCheckpoint(str(tmp_path / "ck"), exe, main, scope=scope,
+                            install_signal_handler=False)
+        ck.step(1)
+        assert ck.flush_window() is False
+    assert not os.path.exists(str(tmp_path / "ck" / "health_window"))
+
+
+def test_skip_action_empty_ring_never_advances_resume(tmp_path,
+                                                      health_flags):
+    """FLAGS_health_action="skip" (the default): the sentinel persists
+    health state with NO window entries — resume() must re-arm the
+    loss-scale/detector state but NEVER advance the start step past
+    scope state it did not restore (steps would be silently skipped),
+    and the window-restore counter must not book."""
+    from paddle_tpu import observability as obs
+
+    health_flags(FLAGS_health_action="skip",
+                 FLAGS_health_loss_scaling=True,
+                 FLAGS_health_loss_scale_init=1024.0,
+                 FLAGS_health_scale_growth_steps=10 ** 6)
+    d = str(tmp_path / "ck")
+    sent1, scope1, _, _ = _run_steps(5, ckpt_dir=d,
+                                     plan="nan:grad:step:2")
+    live_scale = float(np.asarray(scope1.get(LOSS_SCALE_VAR))[0])
+    assert live_scale == 512.0
+    before = obs.snapshot().get(
+        "pt_rollback_window_restores_total", {}).get(
+        "samples", {}).get((), 0)
+
+    main2, startup2, _ = _build()
+    scope2 = Scope()
+    with scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        ck2 = AutoCheckpoint(d, exe2, main2, scope=scope2,
+                             save_interval=10 ** 9,
+                             install_signal_handler=False,
+                             sentinel=exe2.health_sentinel(main2))
+        start = ck2.resume()
+        # no checkpoint, no window ENTRIES: start stays 0 — a prior bug
+        # advanced it to the manifest step and silently skipped steps
+        assert start == 0
+        # ...but the loss-scale state still re-armed bit-exact
+        assert float(np.asarray(scope2.get(LOSS_SCALE_VAR))[0]) \
+            == live_scale
+    after = obs.snapshot().get(
+        "pt_rollback_window_restores_total", {}).get(
+        "samples", {}).get((), 0)
+    assert after == before  # the counter means "resumed PAST the ckpt"

@@ -115,3 +115,44 @@ def test_raw_numeric_check_exempts_health_package():
         Path(lint_resilience.REPO) / "paddle_tpu/health/detect.py")
     assert not lint_resilience._numeric_exempt(
         Path(lint_resilience.REPO) / "paddle_tpu/fluid/executor.py")
+
+
+def test_default_targets_cover_serving_and_health():
+    """ISSUE 14 satellite: the serving lane (scheduler threads,
+    admission edges, drain hooks) and the health sentinel (rollback /
+    persist worker) joined the lint's default target set — a swallowed
+    error or unbounded wait there hangs callers exactly like one in the
+    distributed layer would."""
+    assert "paddle_tpu/serving" in lint_resilience.DEFAULT_TARGETS
+    assert "paddle_tpu/health" in lint_resilience.DEFAULT_TARGETS
+    # and the sweep actually visits them (files enumerated, not just
+    # listed): both packages contribute .py files to the walk
+    files = [str(p) for p in
+             lint_resilience.iter_files(["paddle_tpu/serving",
+                                         "paddle_tpu/health"])]
+    assert any(f.endswith("serving/decode.py") for f in files)
+    assert any(f.endswith("health/persist.py") for f in files)
+
+
+def test_serving_style_findings_fire():
+    """The checks the new targets exist for: a scheduler loop that
+    swallows its executor failure, and a drain that waits on a future
+    with no timeout."""
+    src = ("import threading\n"
+           "def loop(self):\n"
+           "    try:\n"
+           "        self._step_once()\n"
+           "    except Exception:\n"
+           "        pass\n"
+           "def drain(self, fut):\n"
+           "    fut.result()\n")
+    found = lint_resilience.check_source(src, "serving_like.py")
+    # except-pass fires; .result() is not in WAIT_NAMES (it has its own
+    # deadline contract at call sites) — exactly one finding
+    assert [f[2] for f in found] == ["except-pass"]
+    src2 = ("def drain(self, t):\n"
+            "    t.join()\n"
+            "    t.join(timeout=5)\n")
+    found2 = lint_resilience.check_source(src2, "serving_like2.py")
+    assert [f[2] for f in found2] == ["unbounded-wait"]
+    assert found2[0][1] == 2

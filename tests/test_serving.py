@@ -1374,3 +1374,69 @@ def test_inflight_request_past_deadline_resolves_typed(saved_model):
     assert fresh.future.result(timeout=5)  # batch-mate unaffected
     assert _deadline_rejections() == before + 1
     eng.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (ISSUE 14 satellite: the elastic.DrainHandler hookup)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_drain_fails_queued_typed_and_stops_admission(saved_model):
+    """Engine.drain(): queued futures fail typed with
+    reason="draining" (booked on pt_serve_rejected_total), new submits
+    reject typed, and the engine stays OPEN — close() still owns
+    teardown.  auto_start=False keeps everything queued, so the whole
+    path is admission-edge only."""
+    d, xb, _expect = saved_model
+    eng = Engine({"drainme": d}, auto_start=False)
+    try:
+        f1 = eng.submit("drainme", {"x": xb[:1]})
+        f2 = eng.submit("drainme", {"x": xb[:2]})
+        eng.drain()
+        for f in (f1, f2):
+            with pytest.raises(ServingOverloadError) as ei:
+                f.result(timeout=10)
+            assert ei.value.reason == "draining"
+        with pytest.raises(ServingOverloadError) as ei:
+            eng.submit("drainme", {"x": xb[:1]})
+        assert ei.value.reason == "draining"
+        st = eng.stats()["models"]["drainme"]
+        assert st["draining"] is True and st["queue_depth"] == 0
+        fam = obs.snapshot().get("pt_serve_rejected_total", {})
+        assert fam.get("samples", {}).get(("drainme", "draining"),
+                                          0) >= 3
+        eng.drain()  # idempotent
+    finally:
+        eng.close()
+    # closed beats draining in the rejection classification
+    with pytest.raises(ServingOverloadError) as ei:
+        eng.submit("drainme", {"x": xb[:1]})
+    assert ei.value.reason == "closed"
+
+
+def test_engine_idle_lane_observes_sigterm_drain(saved_model,
+                                                 monkeypatch):
+    """An IDLE lane (scheduler parked on an empty queue) must still
+    observe a process-level SIGTERM drain: nothing ever queues on a
+    draining lane, so no submit would wake it — the bounded scheduler
+    wait polls elastic.drain_requested and flips the lane, after which
+    admission rejects typed at the edge."""
+    import time as _time
+
+    from paddle_tpu.distributed import elastic
+
+    d, xb, _expect = saved_model
+    eng = Engine({"idledrain": d})  # auto-started, no traffic
+    try:
+        monkeypatch.setattr(elastic, "drain_requested", lambda: True)
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            if eng.stats()["models"]["idledrain"]["draining"]:
+                break
+            _time.sleep(0.05)
+        assert eng.stats()["models"]["idledrain"]["draining"] is True
+        with pytest.raises(ServingOverloadError) as ei:
+            eng.submit("idledrain", {"x": xb[:1]})
+        assert ei.value.reason == "draining"
+    finally:
+        eng.close()

@@ -59,6 +59,12 @@ DEFAULT_TARGETS = [
     # signal-handler code lives here too (AutoCheckpoint's preemption
     # hook — the capture-and-chain precedent the signal check enforces)
     "paddle_tpu/fluid/incubate/checkpoint",
+    # the serving lane (scheduler threads, admission edges, drain
+    # hooks) and the health sentinel (rollback/persist worker) sit on
+    # the same failure paths: swallowed errors or unbounded waits there
+    # hang callers exactly like the distributed layer's would
+    "paddle_tpu/serving",
+    "paddle_tpu/health",
 ]
 
 WAIT_NAMES = {"wait", "join", "recv", "get", "acquire", "wait_round",

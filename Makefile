@@ -4,7 +4,7 @@ REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 
 .PHONY: test test-book test-onchip bench bench-onchip int8-bench \
 	serve-bench decode-bench health-bench phase-bench pass-bench \
-	recovery-drill recovery-bench \
+	pipeline-bench recovery-drill recovery-bench \
 	perf-compare lint-api lint-resilience lint-observability \
 	lint-collectives lint-passes
 
@@ -41,6 +41,9 @@ phase-bench:     ## phase-instrumentation on/off A/B (overhead within noise)
 
 pass-bench:      ## graph-passes on/off A/B + per-pass cost attribution
 	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_PASSES=1 $(PY) bench.py
+
+pipeline-bench:  ## pipeline-as-policy A/B: PipelineRunner vs PipelinePolicy, gpipe vs 1f1b, microbatch sweep
+	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_PIPELINE=1 $(PY) bench.py
 
 recovery-drill:  ## fast in-process preempt→restore drill (window restore + parity)
 	JAX_PLATFORMS=cpu $(PY) -m paddle_tpu.distributed.recovery

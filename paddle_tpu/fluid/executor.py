@@ -993,6 +993,42 @@ class HostOpsUnsupported(ValueError):
     it exactly instead of matching error text."""
 
 
+def chain_step_body(body, n_steps, stacked_feed):
+    """THE one spelling of the on-device step chain, shared by every
+    lane that offers run_steps (`_CompiledChain` below, the hybrid
+    runner's chain mode, the gspmd executor's run_steps): returns
+    ``chained(donated, readonly, feeds, step0) -> (fetches,
+    out_writes)`` running ``body`` n_steps times in ONE computation —
+    the fori_loop threads the donated state dict between iterations,
+    ``stacked_feed`` slices a leading [n_steps] feed axis per
+    iteration, and the step counter advances per iteration exactly like
+    n separate run() calls.  Only the final step's fetches return."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = int(n_steps)
+
+    def feed_at(feeds, i):
+        if not stacked_feed:
+            return feeds
+        return {k: lax.dynamic_index_in_dim(v, i, axis=0,
+                                            keepdims=False)
+                for k, v in feeds.items()}
+
+    def chained(donated, readonly, feeds, step0):
+        def one(i, d):
+            _, out_writes = body(d, readonly, feed_at(feeds, i),
+                                 step0 + i.astype(jnp.uint32))
+            return {k: out_writes.get(k, v) for k, v in d.items()}
+
+        d = (lax.fori_loop(0, n - 1, one, donated) if n > 1
+             else donated)
+        return body(d, readonly, feed_at(feeds, n - 1),
+                    step0 + np.uint32(n - 1))
+
+    return chained
+
+
 class _CompiledChain(_JitExecutable):
     """`n_steps` iterations of a block chained inside ONE jitted call.
 
@@ -1008,8 +1044,6 @@ class _CompiledChain(_JitExecutable):
     def __init__(self, program, block, feed_names, fetch_names, place,
                  scope, n_steps, stacked_feed):
         import jax
-        import jax.numpy as jnp
-        from jax import lax
 
         plan = BlockPlan(program, block, feed_names, fetch_names, scope,
                          place=place)
@@ -1034,26 +1068,7 @@ class _CompiledChain(_JitExecutable):
         # fori_loop: a mid-chain bad step masks its own state writes and
         # the remaining iterations continue from clean state
         body = _health_gate(program, plan.make_body())
-
-        def feed_at(feeds, i):
-            if not stacked_feed:
-                return feeds
-            return {k: lax.dynamic_index_in_dim(v, i, axis=0,
-                                                keepdims=False)
-                    for k, v in feeds.items()}
-
-        def chained(donated, readonly, feeds, step0):
-            def one(i, d):
-                _, out_writes = body(d, readonly, feed_at(feeds, i),
-                                     step0 + i.astype(jnp.uint32))
-                return {k: out_writes.get(k, v) for k, v in d.items()}
-
-            d = (lax.fori_loop(0, n - 1, one, donated) if n > 1
-                 else donated)
-            fetches, out_writes = body(
-                d, readonly, feed_at(feeds, n - 1),
-                step0 + np.uint32(n - 1))
-            return fetches, out_writes
+        chained = chain_step_body(body, n, stacked_feed)
 
         self._jitted = jax.jit(chained, donate_argnums=(0,))
         self.label = (f"program@{id(program):x}/v{program._version}"

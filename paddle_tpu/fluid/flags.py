@@ -140,7 +140,8 @@ _DEFS = {
     # graph-optimization pass layer (paddle_tpu/passes/, docs/PASSES.md):
     # program passes run between construction and executor compile on
     # every lane.  "default" = the standard pipeline (fuse_attention,
-    # fuse_bias_act_dropout); "none" = off (programs bit-identical to
+    # fuse_bias_act_dropout, fuse_softmax_cross_entropy); "none" = off
+    # (programs bit-identical to
     # the pre-pass layer); otherwise a comma-separated ordered list of
     # registered pass names, with "-name" dropping one from the default
     # set (e.g. "default,-fuse_attention" or just "-fuse_attention").
@@ -161,6 +162,17 @@ _DEFS = {
     # while the transpiler lane remains the benched baseline; flip per
     # run or per runner via gspmd=True.
     "FLAGS_gspmd_executor": (False, _parse_bool, True),
+    # pipeline-as-policy schedule (parallel/gspmd/pipeline_policy.py,
+    # docs/DISTRIBUTED.md "Pipeline as a policy"): "1f1b" = one-forward-
+    # one-backward interleaving — same bubble fraction as gpipe but the
+    # activation stash holds min(M, S) microbatches instead of M (the
+    # memory win that lets microbatch counts scale); "gpipe" = plain
+    # fill/drain (all forwards, then all backwards).  Consumed by
+    # PipelinePolicy when the schedule isn't pinned per policy.
+    "FLAGS_pipeline_schedule": ("1f1b", str, True),
+    # microbatch count for PipelinePolicy when neither the policy nor
+    # the program's PipelineOptimizer metadata pins one
+    "FLAGS_pipeline_microbatches": (4, int, True),
     # quant-hook integration form (parallel/gspmd/quant_hook.py):
     # "shard_map" = the fwd/bwd island reducing gradients on the
     # dual-int8 ring (works everywhere), "custom_partitioning" = the

@@ -1,0 +1,71 @@
+"""Stage-boundary collectives for the pipeline-as-policy lane.
+
+The GSPMD pipeline island (parallel/gspmd/pipeline_policy.py) moves
+exactly two payload classes across the ``pp`` mesh axis:
+
+  ``stage_shift``    the activation/gradient WIRE: one packed fp32
+                     buffer per stage link, forwarded one position per
+                     schedule tick as a non-wrapping ``lax.ppermute``
+                     chain (stage S-1 sends nowhere; stage 0 receives
+                     zeros — exactly the fill/drain edge semantics both
+                     GPipe and 1F1B need).
+  ``stage_merge``    the ownership merge: per-stage values that are
+                     ZERO off their producing stage (accumulated
+                     parameter gradients, last-stage fetch stashes)
+                     summed over ``pp`` so every stage holds the full
+                     value — a broadcast spelled as ``lax.psum`` of a
+                     one-hot-by-stage operand, NOT a data reduction.
+
+Like ``ring_collectives``/``quantized_collectives`` this module IS the
+sanctioned collective surface (tools/lint_collectives.py EXEMPT list):
+a raw ``ppermute`` in the pipeline policy itself would bypass the
+boundary-bytes accounting below, which keeps
+``pt_gspmd_resharding_bytes``'s per-stage-boundary samples honest
+against the compiled HLO.
+
+These payloads deliberately stay fp32 on the wire: a stage boundary
+carries ACTIVATIONS (and their cotangents), and quantizing those
+changes the forward math — unlike gradient all-reduce, where the
+EQuARX wire format rides a sum whose error the optimizer tolerates.
+The batch-axis gradient reduction inside the same island keeps the
+dual-int8 ring (``adaptive_quantized_all_reduce``) untouched.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+__all__ = ["stage_shift", "stage_merge", "boundary_wire_bytes"]
+
+
+def stage_shift(x, axis_name, n_stages, reverse=False):
+    """Forward ``x`` one pipeline stage along ``axis_name``.
+
+    Non-wrapping by construction: the permutation covers links
+    ``s -> s+1`` only (``s+1 -> s`` with ``reverse``), so the drain edge
+    device receives ZEROS (lax.ppermute's no-source semantics) instead
+    of a stale wraparound payload — the schedule's validity masks rely
+    on that.
+    """
+    n = int(n_stages)
+    if n <= 1:
+        return x
+    if reverse:
+        perm = [(s + 1, s) for s in range(n - 1)]
+    else:
+        perm = [(s, s + 1) for s in range(n - 1)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def stage_merge(x, axis_name):
+    """Merge per-stage-owned values: ``x`` is zero on every stage except
+    its producer, so the psum over the stage axis is a broadcast of the
+    owned value, bit-exact (0 + v == v in IEEE for finite v)."""
+    return lax.psum(x, axis_name)
+
+
+def boundary_wire_bytes(boundary_elems, n_microbatches, itemsize=4):
+    """Modeled per-step payload of ONE stage link: each of the M
+    microbatches crosses it once forward (activations) and once backward
+    (their cotangents — same element count by construction)."""
+    return 2 * int(n_microbatches) * int(boundary_elems) * int(itemsize)

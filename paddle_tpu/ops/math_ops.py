@@ -309,6 +309,25 @@ def _softmax_ce(ctx, logits, label, attrs):
     return sm, loss
 
 
+@simple_op("fused_softmax_cross_entropy", ["X", "Label"], ["Out"],
+           no_grad_inputs=("Label",))
+def _fused_softmax_ce(ctx, x, label, attrs):
+    # the fuse_softmax_cross_entropy pass's target (passes/
+    # fuse_softmax_xent.py): BIT-EXACT composition of the softmax and
+    # cross_entropy lowerings above — same primitives, same order, same
+    # eps clamp — so the rewrite changes the PROGRAM (the [.., C]
+    # probability tensor stops being a program variable XLA must
+    # materialize for the residual re-read) without changing a single
+    # ULP of the math.  The numerically-stabler logsumexp form already
+    # exists as `softmax_with_cross_entropy`; models that want it spell
+    # it directly.
+    sm = _softmax(ctx, x, {"axis": attrs.get("axis", -1)})
+    return _cross_entropy(
+        ctx, sm, label,
+        {"soft_label": attrs.get("soft_label", False),
+         "ignore_index": attrs.get("ignore_index", -100)})
+
+
 @simple_op("sigmoid_cross_entropy_with_logits", ["X", "Label"], ["Out"],
            no_grad_inputs=("Label",))
 def _sce(ctx, x, label, attrs):

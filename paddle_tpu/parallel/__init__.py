@@ -10,9 +10,10 @@ from . import data_parallel  # noqa: F401
 from .data_parallel import DataParallelRunner, transpile_data_parallel  # noqa: F401
 from . import gspmd  # noqa: F401
 from .gspmd import (  # noqa: F401
-    DataParallelPolicy, GSPMDExecutor, ShardingPolicy,
+    DataParallelPolicy, GSPMDExecutor, PipelinePolicy, ShardingPolicy,
     TensorParallelPolicy, Zero1Policy, policy_for,
 )
+from .mesh import build_3d_mesh  # noqa: F401
 from . import local_sgd  # noqa: F401
 from .local_sgd import LocalSGDRunner  # noqa: F401
 from . import pipeline  # noqa: F401

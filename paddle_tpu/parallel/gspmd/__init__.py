@@ -21,3 +21,11 @@ from .executor import (  # noqa: F401
 )
 from . import quant_hook  # noqa: F401
 from .quant_hook import plan_quant_hook, resolve_quant_impl  # noqa: F401
+from . import pipeline_policy  # noqa: F401
+from .pipeline_policy import (  # noqa: F401
+    PipelinePolicy,
+    PipelinePlan,
+    modeled_bubble_fraction,
+    plan_pipeline,
+    schedule_slots,
+)

@@ -88,10 +88,12 @@ class _GSPMDBlock(_JitExecutable):
     partitioned XLA executable, with policy-resolved in/out shardings."""
 
     def __init__(self, executor, scope, feed_names, fetch_names,
-                 feed_shapes=None):
+                 feed_shapes=None, feed_dtypes=None, n_steps=1,
+                 stacked_feed=False):
         import jax
 
-        from paddle_tpu.fluid.executor import BlockPlan
+        from paddle_tpu.fluid.executor import (BlockPlan,
+                                               HostOpsUnsupported)
 
         program, mesh, policy = (executor.program, executor.mesh,
                                  executor.policy)
@@ -102,6 +104,15 @@ class _GSPMDBlock(_JitExecutable):
             raise NotImplementedError(
                 "pre-stage host ops (distributed lookup) are only "
                 "supported by the single-device Executor")
+        n_steps = int(n_steps)
+        chain_mode = n_steps > 1 or stacked_feed
+        if chain_mode and (plan.host_ops or plan.host_fetch_names):
+            raise HostOpsUnsupported(
+                "run_steps chains the whole loop on-device; host ops "
+                f"({[op.type for op in plan.host_ops]}) need the host "
+                "between steps — use run() per step")
+        self.n_steps = n_steps
+        self.stacked_feed = bool(stacked_feed)
         self.plan = plan
         self.program = program
         self.mesh = mesh
@@ -120,21 +131,48 @@ class _GSPMDBlock(_JitExecutable):
         # and the quant island's in_specs: explicit executor.feed_specs
         # win (alias-canonicalized); otherwise the policy resolves
         # against the REAL feed shape, so feed_spec's divisibility gate
-        # (non-divisible batch -> graceful replication) actually engages
+        # (non-divisible batch -> graceful replication) actually engages.
+        # stacked_feed: the leading [n_steps] axis is the loop index —
+        # the policy resolves against the PER-STEP shape and the jit
+        # shardings prepend a replicated dim.
         axis = policy.batch_axis
+
+        def per_step_shape(n):
+            shape = feed_shapes.get(n)
+            if shape is not None and self.stacked_feed:
+                return tuple(shape[1:])
+            return shape
+
         self._feed_specs = {}
         for n in self.feed_names:
             if n in executor.feed_specs:
                 spec = tuple(pmesh.canonical_axis(a)
                              for a in executor.feed_specs[n])
             else:
-                spec = policy.feed_spec(program, n, feed_shapes.get(n),
+                spec = policy.feed_spec(program, n, per_step_shape(n),
                                         mesh)
             self._feed_specs[n] = spec
 
-        # quant hook: None when off/demoted — the pure GSPMD path
+        # pipeline policy: the microbatched stage island replaces BOTH
+        # the plain trace and the quant-hook split — its batch-axis
+        # gradient reduction embeds the same EQuARX ring
+        # (pipeline_policy.py), so executor.quant_hook still decides the
+        # wire format
+        self.pplan = None
         self.qplan = None
-        if executor.quant_hook:
+        from .pipeline_policy import PipelinePolicy, plan_pipeline
+
+        if isinstance(policy, PipelinePolicy):
+            self.pplan = plan_pipeline(
+                plan, program, mesh, policy,
+                {n: per_step_shape(n) for n in self.feed_names},
+                feed_dtypes, self._feed_specs, scope,
+                executor.quant_hook,
+                block_size=executor.quant_block_size,
+                algo=executor.quant_algo,
+                crossover_kb=executor.quant_crossover_kb,
+                declared_feed_specs=executor.feed_specs)
+        elif executor.quant_hook:
             self.qplan = plan_quant_hook(
                 plan, program, mesh, policy,
                 block_size=executor.quant_block_size,
@@ -169,7 +207,52 @@ class _GSPMDBlock(_JitExecutable):
             trace_block(plan.block, env, ctx, ops=ops)
             return env
 
-        if self.qplan is None:
+        if self.pplan is not None:
+            pl = self.pplan
+            island = pl.island_body(
+                lambda env, step, ops, mesh_axes=(): trace_stage(
+                    env, step, ops, mesh_axes), scope)
+            fetch_names_jit = plan.jit_fetch_names
+            write_names = plan.write_names
+            island_fetch_pos = {n: i
+                                for i, n in enumerate(pl.island_fetches)}
+            self._island_fetches = list(pl.island_fetches)
+
+            def body(donated, readonly, feeds, step):
+                scope_vals = {}
+                scope_vals.update(donated)
+                scope_vals.update(readonly)
+                island_in = {n: scope_vals[n]
+                             for n in pl.scope_reads_island}
+                grads, stacked = island(island_in, dict(feeds), step)
+                env = dict(scope_vals)
+                env.update(grads)
+                # optimizer leg in GLOBAL view: the inner policy's specs
+                # (ZeRO-1 state sharding) partition it
+                trace_stage(env, step, pl.ops_opt)
+                fetches = [stacked[island_fetch_pos[n]]
+                           if n in island_fetch_pos else env[n]
+                           for n in fetch_names_jit]
+                out_writes = {n: env[n] for n in write_names if n in env}
+                return fetches, out_writes
+
+            # stamp the schedule report the _overlap_schedule way, and
+            # book the modeled surfaces: bubble fraction per signature +
+            # per-stage-boundary payloads on the resharding gauge
+            from paddle_tpu.kernels import pipeline_collectives as pcol
+
+            from .pipeline_policy import _m_bubble
+
+            report = pl.schedule_report()
+            program._pipeline_schedule = report
+            _m_bubble().labels(signature=self.label,
+                               schedule=pl.schedule).set(
+                report["bubble_frac"])
+            for b, elems in enumerate(pl.boundary_elems):
+                _m_resharding().labels(
+                    signature=f"{self.label}/pp{b}-{b + 1}").set(
+                    float(pcol.boundary_wire_bytes(elems, pl.M)))
+        elif self.qplan is None:
             ops_all = plan.ops
             fetch_names_jit = plan.jit_fetch_names
             write_names = plan.write_names
@@ -221,14 +304,26 @@ class _GSPMDBlock(_JitExecutable):
 
         # read AFTER island_body construction: a demoted
         # custom_partitioning reducer zeroes the plan's modeled bytes
-        self.wire_bytes_per_step = (self.qplan.wire_bytes_per_step
-                                    if self.qplan else 0)
+        active_plan = self.pplan or self.qplan
+        self.wire_bytes_per_step = (active_plan.wire_bytes_per_step
+                                    if active_plan else 0)
         self.fused_bytes_saved = (self.qplan.fused_bytes_saved
                                   if self.qplan else 0)
 
         from paddle_tpu.health import wrap_body as _health_gate
 
         body = _health_gate(program, body)
+
+        if chain_mode:
+            # run_steps: the whole n-step loop in ONE jitted call — the
+            # ONE chain combinator every lane shares
+            # (fluid.executor.chain_step_body): fori_loop threads the
+            # donated params/opt-state on-device, the step counter
+            # advances per iteration, only the final step's fetches
+            # return.
+            from paddle_tpu.fluid.executor import chain_step_body
+
+            body = chain_step_body(body, n_steps, self.stacked_feed)
 
         def mesh_body(*args):
             # mesh-adaptive lowerings (ring attention) read current_mesh()
@@ -243,8 +338,15 @@ class _GSPMDBlock(_JitExecutable):
         don_sh = {n: shard_of(n, scope.get(n)) for n in self.donated_names}
         ro_sh = {n: shard_of(n, scope.get(n)) for n in self.readonly_names}
 
-        feeds_sh = {n: gspecs.named_sharding(mesh, self._feed_specs[n])
-                    for n in self.feed_names}
+        def feed_sharding(n):
+            spec = self._feed_specs[n]
+            if self.stacked_feed:
+                # leading [n_steps] axis is the loop index — replicated;
+                # the batch dim (now dim 1) keeps its resolved sharding
+                spec = (None,) + tuple(spec)
+            return gspecs.named_sharding(mesh, spec)
+
+        feeds_sh = {n: feed_sharding(n) for n in self.feed_names}
         repl = gspecs.named_sharding(mesh, ())
         stacked_sh = gspecs.named_sharding(mesh, (axis,)) \
             if axis in mesh.axis_names else repl
@@ -416,6 +518,40 @@ class GSPMDExecutor:
 
     def run(self, scope=None, feed=None, fetch_list=None,
             return_numpy=True):
+        scope = self._resolve_scope(scope)
+        feed, fetch_names, feed_sig = self._prep(feed, fetch_list)
+        key = (self.program._version, feed_sig, tuple(fetch_names))
+        return self._dispatch(key, scope, feed, fetch_names, 1, False,
+                              return_numpy)
+
+    def run_steps(self, feed, n_steps, fetch_list=None, scope=None,
+                  return_numpy=True, stacked_feed=False):
+        """``n_steps`` partitioned steps in ONE jitted call — the
+        fori_loop carries the policy-sharded params/opt-state on-device
+        (the big-training scan-over-steps pattern), amortizing dispatch
+        exactly like the classic lane's chain (fluid/executor.py
+        run_steps).  stacked_feed=True: feed arrays carry a leading
+        [n_steps] axis (replicated across the mesh), one slice per
+        iteration.  Only the final step's fetches return."""
+        scope = self._resolve_scope(scope)
+        n = int(n_steps)
+        if n < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps!r}")
+        feed, fetch_names, feed_sig = self._prep(feed, fetch_list)
+        if stacked_feed:
+            bad = {k: np.shape(v) for k, v in feed.items()
+                   if not np.shape(v) or np.shape(v)[0] != n}
+            if bad:
+                raise ValueError(
+                    f"stacked_feed arrays need a leading [{n}] axis; "
+                    f"got {bad}")
+        key = (self.program._version, feed_sig, tuple(fetch_names),
+               "chain", n, bool(stacked_feed))
+        return self._dispatch(key, scope, feed, fetch_names, n,
+                              bool(stacked_feed), return_numpy)
+
+    def _dispatch(self, key, scope, feed, fetch_names, n_steps,
+                  stacked_feed, return_numpy):
         import time as _time
 
         from paddle_tpu.fluid.executor import (_feed_batch, _m_cache,
@@ -423,10 +559,7 @@ class GSPMDExecutor:
                                                _record_step,
                                                _report_examples)
 
-        scope = self._resolve_scope(scope)
         sent = self._sentinel
-        feed, fetch_names, feed_sig = self._prep(feed, fetch_list)
-        key = (self.program._version, feed_sig, tuple(fetch_names))
         cb = self._cache.get(key)
         if cb is None:
             _m_cache().labels(path="gspmd", result="miss").inc()
@@ -435,7 +568,10 @@ class GSPMDExecutor:
             t0 = _time.perf_counter()  # observability: allow
             cb = _GSPMDBlock(self, scope, list(feed.keys()), fetch_names,
                              feed_shapes={k: tuple(np.shape(v))
-                                          for k, v in feed.items()})
+                                          for k, v in feed.items()},
+                             feed_dtypes={k: str(v.dtype)
+                                          for k, v in feed.items()},
+                             n_steps=n_steps, stacked_feed=stacked_feed)
             self._cache[key] = cb
             _m_compile_seconds().labels(
                 path="gspmd", phase="trace").inc(_time.perf_counter() - t0)  # observability: allow
@@ -453,18 +589,22 @@ class GSPMDExecutor:
 
                 collective_payload_counter().labels(
                     collective="c_allreduce_quant").inc(
-                    cb.wire_bytes_per_step)
+                    cb.wire_bytes_per_step * n_steps)
             if cb.fused_bytes_saved:
                 from ..data_parallel import fused_update_bytes_counter
 
-                fused_update_bytes_counter().inc(cb.fused_bytes_saved)
-            _report_examples("gspmd", _feed_batch(feed), step_s)
-            self._step += 1
+                fused_update_bytes_counter().inc(
+                    cb.fused_bytes_saved * n_steps)
+            # stacked_feed: leading feed axis is the step index, not batch
+            batch = 0 if stacked_feed else _feed_batch(feed) * n_steps
+            _report_examples("gspmd", batch, step_s)
+            self._step += n_steps
             return fetches
 
         from paddle_tpu.health import run_guarded
 
-        fetches = run_guarded(sent, scope, fetch_names, attempt)
+        fetches = run_guarded(sent, scope, fetch_names, attempt,
+                              chain=n_steps > 1)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

@@ -276,19 +276,31 @@ class TensorParallelPolicy(ShardingPolicy):
 
 
 def policy_for(mesh, rules=None, zero_stage=0, batch_axis=None):
-    """The runners' thin policy selection: a >1 non-batch mesh axis or a
-    non-empty `ShardingRule` → TensorParallelPolicy; else zero_stage >= 1
-    → Zero1Policy; else pure DP.  One decision point so the DP and
-    hybrid runners cannot drift (both call this).  An EMPTY rule set on
-    a batch-only mesh deliberately does NOT select the TP policy — its
-    per-var regex scan would run for nothing."""
+    """The runners' thin policy selection: a >1 ``pp`` mesh axis →
+    PipelinePolicy (stage assignment from the program's PipelineOptimizer
+    metadata, inner policy selected recursively for the remaining axes);
+    else a >1 non-batch mesh axis or a non-empty `ShardingRule` →
+    TensorParallelPolicy; else zero_stage >= 1 → Zero1Policy; else pure
+    DP.  One decision point so the DP and hybrid runners cannot drift
+    (both call this).  An EMPTY rule set on a batch-only mesh
+    deliberately does NOT select the TP policy — its per-var regex scan
+    would run for nothing."""
     batch_axis = pmesh.canonical_axis(batch_axis or pmesh.DATA_AXIS)
-    has_model_axis = any(a != batch_axis and mesh.shape[a] > 1
+    pipe = pmesh.PIPE_AXIS
+    has_pipe = pipe in mesh.axis_names and mesh.shape[pipe] > 1
+    has_model_axis = any(a not in (batch_axis, pipe) and mesh.shape[a] > 1
                          for a in mesh.axis_names)
     has_rules = rules is not None and bool(getattr(rules, "_rules", True))
     if has_model_axis or has_rules:
-        return TensorParallelPolicy(rules=rules, zero_stage=zero_stage,
-                                    batch_axis=batch_axis)
-    if zero_stage >= 1:
-        return Zero1Policy(batch_axis=batch_axis)
-    return DataParallelPolicy(batch_axis=batch_axis)
+        inner = TensorParallelPolicy(rules=rules, zero_stage=zero_stage,
+                                     batch_axis=batch_axis)
+    elif zero_stage >= 1:
+        inner = Zero1Policy(batch_axis=batch_axis)
+    else:
+        inner = DataParallelPolicy(batch_axis=batch_axis)
+    if has_pipe:
+        from .pipeline_policy import PipelinePolicy
+
+        return PipelinePolicy(inner=inner, zero_stage=zero_stage,
+                              batch_axis=batch_axis)
+    return inner

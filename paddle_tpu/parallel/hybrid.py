@@ -715,19 +715,12 @@ class HybridParallelRunner:
         (replicated across the mesh), one slice per iteration.  Only the
         final step's fetches return."""
         if self._gspmd_exec is not None:
-            if stacked_feed:
-                raise NotImplementedError(
-                    "stacked_feed run_steps is not yet supported on the "
-                    "gspmd lane — use gspmd=False or per-step run()")
-            if int(n_steps) < 1:
-                raise ValueError(
-                    f"n_steps must be >= 1, got {n_steps!r}")
-            out = None
-            for _ in range(int(n_steps)):
-                out = self._gspmd_exec.run(scope=scope, feed=feed,
-                                           fetch_list=fetch_list,
-                                           return_numpy=return_numpy)
-            return out
+            # the shared executor chains the loop on-device now (one
+            # jitted fori_loop call, stacked_feed included) — dispatch
+            # amortization on the gspmd lane instead of n Python run()s
+            return self._gspmd_exec.run_steps(
+                feed, n_steps, fetch_list=fetch_list, scope=scope,
+                return_numpy=return_numpy, stacked_feed=stacked_feed)
         scope = self._resolve_scope(scope)
         n = int(n_steps)
         if n < 1:
@@ -792,31 +785,12 @@ class HybridParallelRunner:
         inner_body = _health_gate(program, inner_body)
 
         if chain_mode:
-            import jax.numpy as jnp
-            from jax import lax
+            # the ONE chain combinator every lane shares
+            # (fluid.executor.chain_step_body)
+            from paddle_tpu.fluid.executor import chain_step_body
 
-            single = inner_body
-
-            def feed_at(feeds, i):
-                if not stacked_feed:
-                    return feeds
-                return {k: lax.dynamic_index_in_dim(v, i, axis=0,
-                                                    keepdims=False)
-                        for k, v in feeds.items()}
-
-            def chained(donated_, readonly_, feeds, step0):
-                def one(i, d):
-                    _, out_writes = single(d, readonly_,
-                                           feed_at(feeds, i),
-                                           step0 + i.astype(jnp.uint32))
-                    return {k: out_writes.get(k, v) for k, v in d.items()}
-
-                d = (lax.fori_loop(0, n_steps - 1, one, donated_)
-                     if n_steps > 1 else donated_)
-                return single(d, readonly_, feed_at(feeds, n_steps - 1),
-                              step0 + np.uint32(n_steps - 1))
-
-            inner_body = chained
+            inner_body = chain_step_body(inner_body, n_steps,
+                                         stacked_feed)
 
         def body(*args):
             # ops that adapt their lowering to the mesh (ring attention on

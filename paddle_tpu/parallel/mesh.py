@@ -119,6 +119,41 @@ def build_2d_mesh(batch=None, model=1, devices=None):
     return build_mesh(shape, devices=devices)
 
 
+def build_3d_mesh(pp=1, batch=None, model=1, devices=None):
+    """The named 3-D (pp, batch, model) mesh of the pipeline-as-policy
+    layer: pipeline stages on the LEADING axis (the coarsest-grained,
+    least latency-sensitive traffic — one activation transfer per stage
+    boundary per microbatch, the natural DCN/far-ICI axis), data
+    parallelism in the middle, tensor parallelism innermost (its
+    collectives ride the nearest ICI links).  Axis names are the
+    canonical short forms (``pp``, ``dp``, ``mp``); the paper spellings
+    (``pipe``/``batch``/``model``) resolve through AXIS_ALIASES exactly
+    like the 2-D mesh.  ``batch`` None uses every device not consumed by
+    ``pp`` × ``model``; axes of size 1 are elided so a degenerate call
+    (``pp=1``) reproduces :func:`build_2d_mesh`'s shape."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    pp, model = int(pp), int(model)
+    if batch is None:
+        denom = pp * model
+        if len(devices) % denom != 0:
+            raise ValueError(
+                f"pp={pp} x model={model} does not divide the "
+                f"{len(devices)} available devices — pass batch= "
+                "explicitly to use a subset (silently stranding devices "
+                "would train at reduced capacity with no signal)")
+        batch = len(devices) // denom
+    shape = {}
+    if pp > 1:
+        shape[PIPE_AXIS] = pp
+    shape[DATA_AXIS] = int(batch)
+    if model > 1:
+        shape[MODEL_AXIS] = model
+    return build_mesh(shape, devices=devices)
+
+
 def device_count():
     import jax
 

@@ -27,12 +27,14 @@ TPU-native redesign:
 from __future__ import annotations
 
 import collections
+import dataclasses
 
 import numpy as np
 
 from paddle_tpu.fluid.framework import Program, grad_var_name
 
-__all__ = ["assign_stages", "PipelineRunner"]
+__all__ = ["assign_stages", "stage_partition", "boundary_sets",
+           "StageInfo", "PipelineRunner"]
 
 GRAD_SUFFIX = "@GRAD"
 
@@ -70,10 +72,22 @@ def assign_stages(program, cut_vars):
             if "fwd_op_idx" in op.attrs:
                 s = fwd_stage.get(int(op.attrs["fwd_op_idx"]), 0)
             else:
-                bases = [b for n in (list(op.input_arg_names)
-                                     + list(op.output_arg_names))
-                         if (b := _base_var(n)) is not None]
-                s = max((eff(b) for b in bases), default=n_stages - 1)
+                # grad-accumulation sums follow the stage that PRODUCED
+                # their partial-gradient inputs (a multi-consumer cut
+                # activation accumulates in the consuming stage, and the
+                # summed gradient crosses the boundary like any other
+                # cotangent); the loss seed and input-less ops keep the
+                # base-variable rule
+                ins = [var_stage[n] for n in op.input_arg_names
+                       if n in var_stage]
+                if ins:
+                    s = max(ins)
+                else:
+                    bases = [b for n in (list(op.input_arg_names)
+                                         + list(op.output_arg_names))
+                             if (b := _base_var(n)) is not None]
+                    s = max((eff(b) for b in bases),
+                            default=n_stages - 1)
         elif role == "optimize":
             if op.input("Param"):
                 s = param_stage.get(op.input("Param")[0], 0)
@@ -92,6 +106,144 @@ def assign_stages(program, cut_vars):
         for n in op.output_arg_names:
             var_stage[n] = s
     return stage_of, n_stages
+
+
+@dataclasses.dataclass
+class StageInfo:
+    """One pipeline stage's op lists and boundary classification — the
+    shared analysis behind BOTH execution lanes (PipelineRunner's
+    per-stage host-scheduled programs and the gspmd PipelinePolicy's
+    in-graph stage island, parallel/gspmd/pipeline_policy.py).  One
+    implementation so the two lanes' stage semantics cannot drift."""
+
+    index: int
+    fwd_ops: list          # forward ops of this stage, program order
+    bwd_ops: list          # backward ops (fwd_op_idx-matched)
+    opt_ops: list          # optimizer ops for this stage's params
+    acts_in: list          # cross-stage activations the forward consumes
+    acts_out: list         # activations later stages consume
+    grads_in: list         # incoming d(acts_out) names the backward feeds
+    data_feeds: list       # data-feed names this stage reads
+    param_grads: list      # [(param, grad)] owned by this stage
+    loss_name: str | None  # set on the last stage
+
+
+def stage_partition(program, ops, cut_vars, loss_name=None):
+    """Partition ``ops`` (block-0 ops of ``program``, program order — a
+    pruned subset is fine) into pipeline stages at ``cut_vars``.
+
+    Returns ``(stages, stage_of)`` where ``stages`` is a
+    list[StageInfo] and ``stage_of`` maps ``id(op) -> stage`` for every
+    op in ``ops``.  Stage assignment always runs over the FULL block
+    (assign_stages) so a pruned op list cannot shift the dataflow-based
+    stage boundaries; the per-stage op lists then keep only the ops the
+    caller passed."""
+    block = program.global_block()
+    stage_of_all, S = assign_stages(program, cut_vars)
+    by_id = {id(op): s for op, s in zip(block.ops, stage_of_all)}
+    stage_of = {id(op): by_id[id(op)] for op in ops}
+
+    ops_by_stage = [[] for _ in range(S)]
+    role_by_stage = [[] for _ in range(S)]
+    for op in ops:
+        s = stage_of[id(op)]
+        ops_by_stage[s].append(op)
+        role_by_stage[s].append(op.attrs.get("op_role"))
+
+    pg = dict(getattr(program, "_params_grads", []))
+    if loss_name is None:
+        loss_name = getattr(program, "_pipeline", {}).get("loss_name")
+
+    produced_in = {}
+    for op in ops:
+        for n in op.output_arg_names:
+            produced_in.setdefault(n, stage_of[id(op)])
+
+    def is_data(n):
+        v = block._find_var_recursive(n)
+        return v is not None and getattr(v, "is_data", False)
+
+    def is_persistable(n):
+        v = block._find_var_recursive(n)
+        return v is not None and v.persistable
+
+    stages = []
+    for s in range(S):
+        fwd_ops = [op for op, r in zip(ops_by_stage[s], role_by_stage[s])
+                   if r not in ("backward", "optimize")]
+        bwd_ops = [op for op, r in zip(ops_by_stage[s], role_by_stage[s])
+                   if r == "backward"]
+        opt_ops = [op for op, r in zip(ops_by_stage[s], role_by_stage[s])
+                   if r == "optimize"]
+
+        def boundary_inputs(stage_ops):
+            acts, data = [], []
+            produced_here = set()
+            for op in stage_ops:
+                for n in op.input_arg_names:
+                    if n in produced_here or n in acts or n in data:
+                        continue
+                    if is_data(n):
+                        data.append(n)
+                    elif (n in produced_in and produced_in[n] != s
+                          and not is_persistable(n)):
+                        acts.append(n)
+                produced_here.update(op.output_arg_names)
+            return acts, data
+
+        acts_in, data_fwd = boundary_inputs(fwd_ops)
+        # backward program recomputes forward, then needs incoming grads
+        bwd_all = fwd_ops + bwd_ops
+        bwd_bound, data_bwd = boundary_inputs(bwd_all)
+        grads_in = [n for n in bwd_bound if n not in acts_in]
+
+        # activations this stage must export: produced here, consumed in
+        # a later stage's forward/backward
+        consumed_later = set()
+        for op in ops:
+            if stage_of[id(op)] > s \
+                    and op.attrs.get("op_role") != "optimize":
+                consumed_later.update(op.input_arg_names)
+        acts_out = []
+        for op in fwd_ops:
+            for n in op.output_arg_names:
+                if n in consumed_later and not is_persistable(n) \
+                        and n not in acts_out:
+                    acts_out.append(n)
+
+        stage_pg = [(p, g) for p, g in pg.items()
+                    if any(g in op.output_arg_names or
+                           g in op.input_arg_names for op in bwd_ops)]
+        stages.append(StageInfo(
+            s, fwd_ops, bwd_ops, opt_ops, acts_in, acts_out, grads_in,
+            sorted(set(data_fwd) | set(data_bwd)), stage_pg,
+            loss_name if s == S - 1 else None))
+    return stages, stage_of
+
+
+def boundary_sets(stages):
+    """The pipeline WIRE contents: ``boundary[b]`` is the ordered list of
+    activation names crossing the stage-b → stage-b+1 link — everything
+    a stage at index > b consumes (forward or backward-recompute) that a
+    stage at index <= b produced.  A skip connection (produced at stage
+    0, consumed at stage 2) appears in EVERY boundary it crosses, so the
+    in-graph island can forward it hop by hop (the host scheduler ships
+    it point-to-point instead)."""
+    S = len(stages)
+    produced_at = {}
+    for st in stages:
+        for op in st.fwd_ops:
+            for n in op.output_arg_names:
+                produced_at.setdefault(n, st.index)
+    out = []
+    for b in range(S - 1):
+        names = []
+        for st in stages[b + 1:]:
+            for n in st.acts_in:
+                if produced_at.get(n, S) <= b and n not in names:
+                    names.append(n)
+        out.append(names)
+    return out
 
 
 class _StagePrograms:
@@ -207,91 +359,22 @@ class PipelineRunner:
     # -- program construction -------------------------------------------
     def _build(self):
         block = self.program.global_block()
-        stage_of, S = assign_stages(self.program, self.cut_vars)
-        self.n_stages = S
-        ops_by_stage = [[] for _ in range(S)]
-        role_by_stage = [[] for _ in range(S)]
-        for op, s in zip(block.ops, stage_of):
-            ops_by_stage[s].append(op)
-            role_by_stage[s].append(op.attrs.get("op_role"))
-
-        pg = dict(getattr(self.program, "_params_grads", []))
-        params = set(pg)
-        grads = set(pg.values())
-        loss_name = getattr(self.program, "_pipeline", {}).get("loss_name")
-
-        # producer stage of every var (forward + backward)
-        produced_in = {}
-        for op, s in zip(block.ops, stage_of):
-            for n in op.output_arg_names:
-                produced_in.setdefault(n, s)
-
-        def is_data(n):
-            v = block._find_var_recursive(n)
-            return v is not None and getattr(v, "is_data", False)
-
-        def is_persistable(n):
-            v = block._find_var_recursive(n)
-            return v is not None and v.persistable
-
+        infos, _stage_of = stage_partition(self.program, block.ops,
+                                           self.cut_vars)
+        self.n_stages = len(infos)
         self.stages = []
-        for s in range(S):
-            fwd_ops = [op for op, r in zip(ops_by_stage[s], role_by_stage[s])
-                       if r not in ("backward", "optimize")]
-            bwd_ops = [op for op, r in zip(ops_by_stage[s], role_by_stage[s])
-                       if r == "backward"]
-            opt_ops = [op for op, r in zip(ops_by_stage[s], role_by_stage[s])
-                       if r == "optimize"]
-
-            def boundary_inputs(ops):
-                acts, data = [], []
-                produced_here = set()
-                for op in ops:
-                    for n in op.input_arg_names:
-                        if n in produced_here or n in acts or n in data:
-                            continue
-                        if is_data(n):
-                            data.append(n)
-                        elif (n in produced_in and produced_in[n] != s
-                              and not is_persistable(n)):
-                            acts.append(n)
-                    produced_here.update(op.output_arg_names)
-                return acts, data
-
-            acts_in, data_fwd = boundary_inputs(fwd_ops)
-            # backward program recomputes forward, then needs incoming grads
-            bwd_all = fwd_ops + bwd_ops
-            bwd_bound, data_bwd = boundary_inputs(bwd_all)
-            grads_in = [n for n in bwd_bound if n not in acts_in]
-
-            # activations this stage must export: produced here, consumed in
-            # a later stage's forward/backward
-            consumed_later = set()
-            for op, s2 in zip(block.ops, stage_of):
-                if s2 > s and op.attrs.get("op_role") != "optimize":
-                    consumed_later.update(op.input_arg_names)
-            acts_out = []
-            for op in fwd_ops:
-                for n in op.output_arg_names:
-                    if n in consumed_later and not is_persistable(n) \
-                            and n not in acts_out:
-                        acts_out.append(n)
-
-            stage_pg = [(p, g) for p, g in pg.items()
-                        if any(g in op.output_arg_names or
-                               g in op.input_arg_names for op in bwd_ops)]
-
-            fwd_prog = self._subprogram(fwd_ops, feed_vars=acts_in + data_fwd)
+        for si in infos:
+            bwd_all = si.fwd_ops + si.bwd_ops
+            fwd_prog = self._subprogram(
+                si.fwd_ops, feed_vars=si.acts_in + si.data_feeds)
             bwd_prog = self._subprogram(
-                bwd_all, feed_vars=acts_in + data_bwd + grads_in)
+                bwd_all, feed_vars=si.acts_in + si.data_feeds + si.grads_in)
             opt_prog = (self._subprogram(
-                opt_ops, feed_vars=[g for _, g in stage_pg])
-                if opt_ops else None)
-
+                si.opt_ops, feed_vars=[g for _, g in si.param_grads])
+                if si.opt_ops else None)
             st = _StagePrograms(
-                fwd_prog, bwd_prog, opt_prog, acts_in, acts_out, grads_in,
-                sorted(set(data_fwd) | set(data_bwd)), stage_pg,
-                loss_name if s == S - 1 else None)
+                fwd_prog, bwd_prog, opt_prog, si.acts_in, si.acts_out,
+                si.grads_in, si.data_feeds, si.param_grads, si.loss_name)
             self.stages.append(st)
 
     def _subprogram(self, ops, feed_vars):

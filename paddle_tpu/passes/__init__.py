@@ -15,6 +15,9 @@ transpiler, hybrid, GSPMD, serving/inference load path):
 - ``fuse_bias_act``  — the FFN elementwise_add→gelu→[dropout] chain
                        rewritten to ``fused_bias_act_dropout``
                        (kernels/fused_bias_act.py).
+- ``fuse_softmax_xent`` — the classifier/MLM-head softmax→cross_entropy
+                       pair rewritten to the bit-exact
+                       ``fused_softmax_cross_entropy`` op.
 - ``adapters``       — the pre-existing rewriters (DP transpile incl.
                        the fused-update rewrite, health sentinel)
                        registered as passes so the ordering contract
@@ -26,6 +29,7 @@ from __future__ import annotations
 from . import adapters  # noqa: F401  (registers the transpile adapters)
 from . import fuse_attention  # noqa: F401  (registers fuse_attention)
 from . import fuse_bias_act  # noqa: F401  (registers fuse_bias_act_dropout)
+from . import fuse_softmax_xent  # noqa: F401  (fuse_softmax_cross_entropy)
 from .framework import (DEFAULT_PASSES, PASS_ORDER,  # noqa: F401
                         PassContext, PassManager, ProgramPass,
                         apply_graph_passes, attribute_costs,

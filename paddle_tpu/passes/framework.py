@@ -61,7 +61,8 @@ __all__ = [
 ]
 
 # the default pipeline FLAGS_graph_passes="default" expands to
-DEFAULT_PASSES = ["fuse_attention", "fuse_bias_act_dropout"]
+DEFAULT_PASSES = ["fuse_attention", "fuse_bias_act_dropout",
+                  "fuse_softmax_cross_entropy"]
 
 # THE ordering contract (docs/PASSES.md): when two entries both appear
 # in a pipeline they must run in this relative order.  The transpile
@@ -74,6 +75,7 @@ DEFAULT_PASSES = ["fuse_attention", "fuse_bias_act_dropout"]
 PASS_ORDER = [
     "fuse_attention",
     "fuse_bias_act_dropout",
+    "fuse_softmax_cross_entropy",
     "data_parallel_transpile",   # includes the fused-update DP rewrite
     "health_sentinel",
 ]

@@ -481,6 +481,49 @@ def test_graph_passes_flag_roundtrip(monkeypatch):
     assert fl.get_flags("graph_passes")["graph_passes"] == "default"
 
 
+def test_pipeline_policy_flags_roundtrip(monkeypatch):
+    """The pipeline-as-policy flags (ISSUE 15): 1f1b is the default
+    schedule (same bubble as gpipe, min(M,S) activation stash), 4
+    microbatches when neither the policy nor the program pins one, and
+    both round-trip through env bootstrap and get/set like every other
+    flag.  An unknown schedule spelling fails loudly at resolution."""
+    import importlib
+
+    import pytest
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("pipeline_schedule")["pipeline_schedule"] == \
+        "1f1b"
+    assert fl.get_flags("pipeline_microbatches")[
+        "pipeline_microbatches"] == 4
+    try:
+        fl.set_flags({"FLAGS_pipeline_schedule": "gpipe",
+                      "pipeline_microbatches": "8"})  # str parses
+        assert fl.get_flags(["pipeline_schedule",
+                             "pipeline_microbatches"]) == {
+            "pipeline_schedule": "gpipe", "pipeline_microbatches": 8}
+        # resolution validates the spelling where it is consumed
+        from paddle_tpu.parallel.gspmd import PipelinePolicy
+
+        fl.set_flags({"FLAGS_pipeline_schedule": "zigzag"})
+        with pytest.raises(ValueError, match="pipeline_schedule"):
+            PipelinePolicy().resolve_schedule()
+    finally:
+        fl.set_flags({"FLAGS_pipeline_schedule": "1f1b",
+                      "FLAGS_pipeline_microbatches": 4})
+    monkeypatch.setenv("FLAGS_pipeline_schedule", "gpipe")
+    monkeypatch.setenv("FLAGS_pipeline_microbatches", "16")
+    importlib.reload(fl)
+    assert fl.get_flags("pipeline_schedule")["pipeline_schedule"] == \
+        "gpipe"
+    assert fl.get_flags("pipeline_microbatches")[
+        "pipeline_microbatches"] == 16
+    monkeypatch.delenv("FLAGS_pipeline_schedule")
+    monkeypatch.delenv("FLAGS_pipeline_microbatches")
+    importlib.reload(fl)  # restore defaults for other tests
+
+
 def test_aot_cache_flag_roundtrip(monkeypatch):
     """FLAGS_aot_cache_dir (fluid/aot_cache.py): off by default (empty
     string disables the AOT executable cache) and round-trips through

@@ -51,6 +51,29 @@ def test_sanctioned_modules_exempt():
         "paddle_tpu/kernels/ring_attention.py")
 
 
+def test_pipeline_lane_lint_coverage():
+    """ISSUE 15 satellite: the stage-boundary collectives live in the
+    sanctioned kernels surface (kernels/pipeline_collectives.py), while
+    the pipeline policy module itself stays LINTED — a raw ppermute
+    added there must flag, exactly like any other library file."""
+    assert lint_collectives._exempt(
+        "paddle_tpu/kernels/pipeline_collectives.py")
+    assert not lint_collectives._exempt(
+        "paddle_tpu/parallel/gspmd/pipeline_policy.py")
+    # and the real module is clean under the real lint (its one exact
+    # fp32 reduction carries the explicit allow mark)
+    assert lint_collectives.check_file(
+        lint_collectives.REPO
+        / "paddle_tpu/parallel/gspmd/pipeline_policy.py") == []
+    # a raw stage shift spelled inline (not through stage_shift) flags
+    src = ("from jax import lax\n"
+           "def leak(wire):\n"
+           "    return lax.ppermute(wire, 'pp', [(0, 1)])\n")
+    findings = lint_collectives.check_source(
+        src, "paddle_tpu/parallel/gspmd/pipeline_policy.py")
+    assert [f[2] for f in findings] == ["raw-collective"]
+
+
 def test_non_collective_attrs_pass():
     src = ("import jax.numpy as jnp\n"
            "def f(x):\n"

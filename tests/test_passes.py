@@ -60,9 +60,9 @@ def test_resolve_passes_grammar():
     assert passes.resolve_passes("fuse_attention") == ["fuse_attention"]
     # "-name" drops from the default set (implies the default base)
     assert passes.resolve_passes("-fuse_attention") == \
-        ["fuse_bias_act_dropout"]
+        ["fuse_bias_act_dropout", "fuse_softmax_cross_entropy"]
     assert passes.resolve_passes("default,-fuse_bias_act_dropout") == \
-        ["fuse_attention"]
+        ["fuse_attention", "fuse_softmax_cross_entropy"]
     with pytest.raises(KeyError):
         passes.resolve_passes("no_such_pass")
 
@@ -72,6 +72,7 @@ def test_pass_order_contract():
     is declared in ONE place; a pipeline violating it is rejected."""
     assert passes.PASS_ORDER == [
         "fuse_attention", "fuse_bias_act_dropout",
+        "fuse_softmax_cross_entropy",
         "data_parallel_transpile", "health_sentinel"]
     # the adapters registered (the existing rewriters ARE passes now)
     for name in passes.PASS_ORDER:
@@ -759,3 +760,178 @@ def test_hot_path_skips_grammar_resolution():
     with mock.patch.object(passes.framework, "resolve_passes",
                            side_effect=AssertionError("resolved")) as _m:
         passes.apply_graph_passes(main, lane="single")
+
+
+# ---------------------------------------------------------------------------
+# fuse_softmax_cross_entropy (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _build_sce(soft_label=False, act_softmax=True, optimizer=True,
+               seed=5):
+    """The classifier-head spelling: fc → softmax → cross_entropy —
+    the book-script/MLM-head composition the pass targets."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        np.random.seed(seed)
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        if soft_label:
+            y = fluid.layers.data(name="y", shape=[4], dtype="float32")
+        else:
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        logits = fluid.layers.fc(h, size=4)
+        probs = fluid.layers.softmax(logits)
+        ce = fluid.layers.cross_entropy(probs, y, soft_label=soft_label)
+        loss = fluid.layers.mean(ce)
+        if optimizer:
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _sce_data(soft_label=False, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    xb = rng.uniform(-1, 1, (batch, 8)).astype("float32")
+    if soft_label:
+        yl = rng.uniform(0, 1, (batch, 4)).astype("float32")
+        yl /= yl.sum(axis=1, keepdims=True)
+    else:
+        yl = rng.randint(0, 4, (batch, 1)).astype("int64")
+    return {"x": xb, "y": yl}
+
+
+def test_fuse_softmax_cross_entropy_matches_and_is_idempotent():
+    main, _s, loss = _build_sce()
+    rep = PassManager(["fuse_softmax_cross_entropy"]).run(
+        main, PassContext(keep_vars=[loss.name]), selfcheck=True)
+    entry = rep[-1]
+    assert entry["changed"] and entry["sites"] == 1
+    # dynamic batch dim -> no static model (honest accounting); a
+    # static-shape build books the probs write+read
+    assert entry["modeled_bytes_saved"] == 0
+    static_main, _s2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(static_main, _s2), fluid.unique_name.guard():
+        xs = fluid.data("x", [16, 8], False, dtype="float32")
+        ys = fluid.data("y", [16, 1], False, dtype="int64")
+        probs = fluid.layers.softmax(fluid.layers.fc(xs, size=4))
+        fluid.layers.mean(fluid.layers.cross_entropy(probs, ys))
+    srep = PassManager(["fuse_softmax_cross_entropy"]).run(
+        static_main, PassContext())
+    assert srep[-1]["modeled_bytes_saved"] == 8 * 16 * 4
+    types = _types(main)
+    assert "fused_softmax_cross_entropy" in types
+    assert "fused_softmax_cross_entropy_grad" in types
+    assert "cross_entropy" not in types
+    assert "softmax_grad" not in types
+    assert "cross_entropy_grad" not in types
+    # the softmax op is RETAINED (the probs are the model\'s prediction
+    # surface — book scripts export them); it is now consumer-less, so
+    # per-fetch pruning drops it from loss-only executables
+    assert types.count("softmax") == 1
+
+
+def test_fuse_softmax_cross_entropy_bit_exact_20_steps():
+    """The satellite's acceptance: 20-step training parity between the
+    fused and composed spellings is BIT-EXACT (the fused lowering is
+    the literal composition of the two originals), for hard and soft
+    labels."""
+    for soft in (False, True):
+        def run(spec):
+            prior = _flags_guard()
+            fluid.set_flags({"FLAGS_graph_passes": spec})
+            try:
+                main, startup, loss = _build_sce(soft_label=soft)
+                data = _sce_data(soft_label=soft)
+                scope = fluid.Scope()
+                out = []
+                with fluid.scope_guard(scope):
+                    exe = fluid.Executor(fluid.CPUPlace())
+                    exe.run(startup)
+                    for _ in range(20):
+                        (lv,) = exe.run(main, feed=data,
+                                        fetch_list=[loss.name])
+                        out.append(float(np.asarray(lv)))
+                return out
+            finally:
+                fluid.set_flags({"FLAGS_graph_passes": prior})
+
+        unfused = run("none")
+        fused = run("fuse_softmax_cross_entropy")
+        np.testing.assert_array_equal(np.asarray(unfused),
+                                      np.asarray(fused))
+        assert fused[-1] < fused[0]  # it actually trained
+
+
+def test_fuse_softmax_cross_entropy_vetoes_second_reader():
+    # a second forward reader of the probabilities (an accuracy head)
+    # vetoes the match — its backward would be a partial-grad
+    # accumulation the single fused grad cannot replace
+    main2, _s2, loss2 = _build_sce(optimizer=False)
+    with fluid.program_guard(main2):
+        probs2 = next(op.output("Out")[0]
+                      for op in main2.global_block().ops
+                      if op.type == "softmax")
+        fluid.layers.reduce_max(main2.global_block().var(probs2))
+    rep2 = PassManager(["fuse_softmax_cross_entropy"]).run(
+        main2, PassContext(keep_vars=[loss2.name]))
+    assert not rep2[-1]["changed"]
+    assert "softmax" in _types(main2)
+
+
+def test_fuse_softmax_cross_entropy_probs_fetch_survives():
+    """The book-script regression (recognize_digits/word2vec/...): the
+    probs var is the model\'s PREDICTION, fetched/exported AFTER
+    training ran with a loss-only fetch list.  The retained softmax op
+    keeps its producer alive for that second signature (and the
+    inference clone), while the loss-only executable prunes it."""
+    prior = _flags_guard()
+    fluid.set_flags({"FLAGS_graph_passes": "fuse_softmax_cross_entropy"})
+    try:
+        main, startup, loss = _build_sce()
+        probs = next(op.output("Out")[0]
+                     for op in main.global_block().ops
+                     if op.type == "softmax")
+        data = _sce_data()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for _ in range(3):
+                exe.run(main, feed=data, fetch_list=[loss.name])
+            assert "fused_softmax_cross_entropy" in _types(main)
+            # the prediction fetch (a NEW signature) still resolves
+            (pv,) = exe.run(main, feed=data, fetch_list=[probs])
+            pv = np.asarray(pv)
+            assert pv.shape == (16, 4)
+            np.testing.assert_allclose(pv.sum(axis=1), 1.0, rtol=1e-5)
+            # and the inference clone keeps the producer too
+            infer = main.clone(for_test=True)
+            (pv2,) = exe.run(infer, feed={"x": data["x"]},
+                             fetch_list=[probs])
+            assert np.asarray(pv2).shape == (16, 4)
+    finally:
+        fluid.set_flags({"FLAGS_graph_passes": prior})
+
+
+def test_fuse_softmax_cross_entropy_in_default_pipeline():
+    assert "fuse_softmax_cross_entropy" in passes.DEFAULT_PASSES
+    # declared ordering: after the attention/FFN fusions, before the
+    # transpile adapters
+    order = passes.PASS_ORDER
+    assert order.index("fuse_softmax_cross_entropy") > \
+        order.index("fuse_bias_act_dropout")
+    assert order.index("fuse_softmax_cross_entropy") < \
+        order.index("data_parallel_transpile")
+    # the default lane application fuses the classifier head
+    main, startup, loss = _build_sce()
+    prior = _flags_guard()
+    fluid.set_flags({"FLAGS_graph_passes": "default"})
+    try:
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            exe.run(main, feed=_sce_data(), fetch_list=[loss.name])
+        assert "fused_softmax_cross_entropy" in _types(main)
+    finally:
+        fluid.set_flags({"FLAGS_graph_passes": prior})

@@ -35,6 +35,10 @@ Compared fields (each skipped when absent on either side):
   decode.latency_seconds.p50/p99
                              per-token decode-step latency — lower is
                              better
+  pipeline_ab.arms.<arm>.<mK>.p50_s
+                             (PT_BENCH_PIPELINE records) pipelined step
+                             p50 per arm (runner / gpipe / 1f1b) and
+                             microbatch count — lower is better
 
 Exit codes: 0 = no regression, 1 = at least one regression, 2 = unusable
 input.  ``--threshold-pct`` (default 5) is the noise band;
@@ -184,6 +188,26 @@ def compare_records(old, new, threshold_pct=5.0):
         rows.append(compare_field(field, _dig(old, field),
                                   _dig(new, field), threshold_pct,
                                   higher_is_better=True))
+    # PT_BENCH_PIPELINE records (pipeline_ab): per-arm p50 at every
+    # swept microbatch count — lower is better; runner vs policy and
+    # gpipe vs 1f1b regressions both gate through these rows
+    pipe_arms = set()
+    for rec in (old, new):
+        arms = _dig(rec, "pipeline_ab.arms")
+        if isinstance(arms, dict):
+            pipe_arms.update(arms.keys())
+    for arm in sorted(pipe_arms):
+        ms = set()
+        for rec in (old, new):
+            node = _dig(rec, f"pipeline_ab.arms.{arm}")
+            if isinstance(node, dict):
+                ms.update(k for k in node if k.startswith("m"))
+        for m in sorted(ms):
+            rows.append(compare_field(
+                f"pipeline_ab.arms.{arm}.{m}.p50_s",
+                _dig(old, f"pipeline_ab.arms.{arm}.{m}.p50_s"),
+                _dig(new, f"pipeline_ab.arms.{arm}.{m}.p50_s"),
+                threshold_pct, higher_is_better=False))
     for field in _quantile_fields(old, new):
         rows.append(compare_field(field, _dig(old, field),
                                   _dig(new, field), threshold_pct,

@@ -6,7 +6,7 @@ REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 	serve-bench decode-bench health-bench phase-bench pass-bench \
 	pipeline-bench recovery-drill recovery-bench \
 	perf-compare lint-api lint-resilience lint-observability \
-	lint-collectives lint-passes
+	lint-collectives lint-passes analyze
 
 test:            ## full suite on the 8-device virtual CPU mesh (~8 min)
 	$(PY) -m pytest tests/ -q --ignore=tests/book
@@ -73,3 +73,11 @@ lint-collectives: ## raw psum/ppermute sites must route through the kernels laye
 
 lint-passes:     ## program mutation outside the pass framework / sanctioned transpilers
 	$(PY) tools/lint_passes.py
+
+analyze:         ## the whole static-analysis gate: five source lints + IR verify over the model zoo
+	$(PY) tools/lint_collectives.py
+	$(PY) tools/lint_passes.py
+	$(PY) tools/lint_resilience.py
+	$(PY) tools/lint_observability.py
+	$(PY) tools/gen_api_spec.py --check
+	JAX_PLATFORMS=cpu $(PY) tools/analyze_program.py --zoo all --mesh dp=4 --strict

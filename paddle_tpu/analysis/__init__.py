@@ -1,0 +1,45 @@
+"""Static program analysis over the Fluid IR (docs/ANALYSIS.md).
+
+Three analysis families on the UNMODIFIED Program — no lowering, no
+devices required:
+
+  dataflow   def-use over blocks/ops: uninitialized reads, dead vars,
+             fetch-of-pruned, write-after-fetch, double-writes
+  shapes     forward shape/dtype propagation through the op registry:
+             rank/broadcast/dtype mismatches named at the offending op
+  sharding   (mesh, policy) legality: shard-dim divisibility, pipeline
+             stage-cut validity, quant-hook eligibility, collective
+             ring/axis wiring
+
+Entry points: ``Program.verify()`` (framework.py), the executors'
+``FLAGS_program_verify`` preflight, and ``tools/analyze_program.py``.
+Every diagnostic has a stable code in `findings.CATALOG`.
+"""
+
+from .findings import (CATALOG, DiagnosticSpec, Finding,  # noqa: F401
+                       ProgramVerifyError, ProgramVerifyWarning, Report,
+                       SEV_ERROR, SEV_INFO, SEV_WARNING,
+                       format_mesh_error)
+from .dataflow import analyze_dataflow  # noqa: F401
+from .shapes import analyze_shapes  # noqa: F401
+from .sharding import AbstractMesh, analyze_sharding  # noqa: F401
+from .verifier import preflight, verify  # noqa: F401
+
+__all__ = [
+    "AbstractMesh",
+    "CATALOG",
+    "DiagnosticSpec",
+    "Finding",
+    "ProgramVerifyError",
+    "ProgramVerifyWarning",
+    "Report",
+    "SEV_ERROR",
+    "SEV_INFO",
+    "SEV_WARNING",
+    "analyze_dataflow",
+    "analyze_shapes",
+    "analyze_sharding",
+    "format_mesh_error",
+    "preflight",
+    "verify",
+]

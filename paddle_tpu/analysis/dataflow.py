@@ -1,0 +1,304 @@
+"""Dataflow analysis over the Program IR: def-use graph, liveness.
+
+Builds a def-use view of every block (who writes each var, who reads
+it, in program order) and reports:
+
+  PTA001  uninitialized-read   read of a var nothing initializes
+  PTA002  dead-var             an op whose outputs reach nothing
+  PTA004  write-after-fetch    a fetch target overwritten later
+  PTA005  double-write         blind re-write outside in-place families
+  PTA003  fetch-of-pruned      a fetch target no op produces
+
+The analysis mirrors the executor's own scoping rules
+(fluid/executor.py `_analyze_block` / `_prune_ops`) so a finding here
+predicts an executor failure there — it never second-guesses them.
+
+Sources of initialization the analysis recognizes (a read of any of
+these is never flagged):
+
+  * persistable vars (parameters, optimizer state — startup/scope)
+  * ``is_data`` vars and explicit ``feed_names`` (fed at run time)
+  * vars with a build-time ``initializer``
+  * scope keys passed by the preflight (runner-created state)
+  * outputs of any earlier op; in sub-blocks, outputs of ANY op in the
+    program (sub-block execution order relative to the parent is an
+    executor concern, so cross-block ordering is not judged)
+"""
+
+from __future__ import annotations
+
+from .findings import Finding
+
+# op types that are wiring artifacts of the reference API, not dataflow
+_PSEUDO_OPS = ("feed", "fetch")
+
+# multi-writer op families that legitimately write a var more than once
+# (select/merge semantics or executor-managed carries)
+_MULTI_WRITE_OPS = (
+    "conditional_block", "select_input", "select_output", "while",
+    "recurrent", "assign_value", "increment", "update_loss_scaling",
+)
+
+
+def _op_info(op):
+    from paddle_tpu.fluid import registry
+    if not registry.has_op(op.type):
+        return None
+    try:
+        return registry.get_op(op.type)
+    except Exception:
+        return None
+
+
+def _read_names(op, block):
+    """Input names that are genuine READS — mirrors executor
+    `_analyze_block`: an optional in-out slot naming a non-persistable
+    var is run-local state the op (re)creates, not a read."""
+    info = _op_info(op)
+    out_names = set(op.output_arg_names)
+    reads = []
+    for slot, names in op.inputs.items():
+        optional = info is not None and slot in info.optional
+        for n in names:
+            if optional and n in out_names:
+                v = block._find_var_recursive(n)
+                if v is None or not v.persistable:
+                    continue  # run-local in-out state, not a read
+            reads.append(n)
+    return reads
+
+
+def _is_initialized_var(v):
+    """Vars the runtime initializes without an in-program writer."""
+    if v is None:
+        # no metadata anywhere: the executor resolves it from scope (and
+        # raises its own error if absent) — not this analysis's call
+        return True
+    return bool(v.persistable or v.is_data or v.initializer is not None
+                or (v.type not in (None, "LOD_TENSOR")))
+
+
+def _global_writers(program):
+    """name -> True for every name written by any op in any block."""
+    written = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type in _PSEUDO_OPS:
+                continue
+            written.update(op.output_arg_names)
+    return written
+
+
+def analyze_dataflow(program, feed_names=None, fetch_names=None,
+                     scope_keys=None):
+    """Run all dataflow checks; returns a list of Finding."""
+    findings = []
+    feed = set(feed_names or ())
+    scope = set(scope_keys or ())
+    all_written = _global_writers(program)
+
+    for blk in program.blocks:
+        findings.extend(
+            _check_reads(program, blk, feed, scope, all_written))
+        findings.extend(_check_double_writes(blk))
+
+    gb = program.global_block()
+    findings.extend(_check_liveness(gb, feed, scope, fetch_names))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# PTA001 — uninitialized reads
+# ---------------------------------------------------------------------------
+
+
+def _check_reads(program, blk, feed, scope, all_written):
+    findings = []
+    defined = set(feed) | set(scope)
+    is_global = blk.idx == 0
+    for i, op in enumerate(blk.ops):
+        if op.type in _PSEUDO_OPS:
+            continue
+        for name in _read_names(op, blk):
+            if name in defined:
+                continue
+            v = blk._find_var_recursive(name)
+            if _is_initialized_var(v):
+                continue
+            if is_global:
+                # in the entry block op order is authoritative: a var
+                # only written later (or never) is read uninitialized —
+                # unless a sub-block op writes it (ordering across
+                # blocks is the executor's business)
+                written_elsewhere = name in all_written and not any(
+                    name in o.output_arg_names for o in blk.ops)
+                if written_elsewhere:
+                    continue
+                later = any(name in o.output_arg_names
+                            for o in blk.ops[i:])
+                detail = ("first written later by a downstream op"
+                          if later else "never written by any op")
+                findings.append(Finding(
+                    "PTA001",
+                    f"op reads {name!r} before initialization "
+                    f"({detail}; not persistable, not fed, no "
+                    f"initializer)",
+                    op_type=op.type, op_idx=i, block_idx=blk.idx,
+                    var=name))
+            else:
+                # sub-blocks run under an environment captured from the
+                # parent; only a var DECLARED in this sub-block that no
+                # op anywhere writes is provably uninitialized.
+                # "@"-decorated names (x@step_0, v@mem_0, @GRAD...) are
+                # machinery slots the owning op binds at run time.
+                if name in blk.vars and name not in all_written \
+                        and "@" not in name:
+                    findings.append(Finding(
+                        "PTA001",
+                        f"sub-block op reads {name!r} which no op in "
+                        f"the program writes (not persistable, not "
+                        f"fed, no initializer)",
+                        op_type=op.type, op_idx=i, block_idx=blk.idx,
+                        var=name))
+        defined.update(op.output_arg_names)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# PTA005 — double writes
+# ---------------------------------------------------------------------------
+
+
+def _check_double_writes(blk):
+    findings = []
+    writers = {}  # name -> [(op_idx, op, blind)]
+    for i, op in enumerate(blk.ops):
+        if op.type in _PSEUDO_OPS or op.type in _MULTI_WRITE_OPS:
+            continue
+        reads = set(op.input_arg_names)
+        for name in op.output_arg_names:
+            blind = name not in reads  # not read-modify-write
+            writers.setdefault(name, []).append((i, op, blind))
+    for name, ws in writers.items():
+        if len(ws) < 2:
+            continue
+        # sanctioned: every writer after the first reads the var
+        # (in-place/accumulation — the registry's inplace families and
+        # the grad-accumulation sum both read what they update)
+        blind_rewrites = [(i, op) for (i, op, blind) in ws[1:] if blind]
+        if not blind_rewrites:
+            continue
+        i, op = blind_rewrites[0]
+        first_i, first_op, _ = ws[0]
+        findings.append(Finding(
+            "PTA005",
+            f"{name!r} is blind-written twice: op {first_i} "
+            f"({first_op.type}) then op {i} ({op.type}) overwrites it "
+            f"without reading it — the first write is dead",
+            op_type=op.type, op_idx=i, block_idx=blk.idx, var=name))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# PTA002/PTA003/PTA004 — liveness against the fetch set
+# ---------------------------------------------------------------------------
+
+
+def prune_keep(blk, fetch_names):
+    """Mirror of executor._prune_ops over the entry block: returns
+    ``(ops, keep)`` where ``ops`` is the non-pseudo op list and
+    ``keep[i]`` says whether the pruner retains ``ops[i]`` for the
+    given fetch set (None → the last real op's outputs)."""
+    fetches = (list(fetch_names) if fetch_names is not None
+               else _implicit_fetches(blk))
+    ops = [op for op in blk.ops if op.type not in _PSEUDO_OPS]
+    needed = set(fetches)
+    keep = [False] * len(ops)
+    for i in range(len(ops) - 1, -1, -1):
+        op = ops[i]
+        outs = list(op.output_arg_names)
+        persist = any(
+            (v := blk._find_var_recursive(n)) is not None and v.persistable
+            for n in outs)
+        if (any(n in needed for n in outs) or persist or not outs
+                or op.type == "print"
+                or "sub_block" in getattr(op, "attrs", {})):
+            keep[i] = True
+            needed.update(op.input_arg_names)
+    return ops, keep
+
+
+def _implicit_fetches(blk):
+    """Without an explicit fetch list, treat the last real op's outputs
+    as the program's result (the CLI/build-time default)."""
+    for op in reversed(blk.ops):
+        if op.type not in _PSEUDO_OPS:
+            return list(op.output_arg_names)
+    return []
+
+
+def _check_liveness(blk, feed, scope, fetch_names):
+    findings = []
+    explicit = fetch_names is not None
+    fetches = list(fetch_names) if explicit else _implicit_fetches(blk)
+    fetch_set = set(fetches)
+
+    ops = [op for op in blk.ops if op.type not in _PSEUDO_OPS]
+    produced = set()
+    for op in ops:
+        produced.update(op.output_arg_names)
+
+    # PTA003 — fetch targets nothing produces (and nothing else rescues)
+    if explicit:
+        for name in fetches:
+            if name in produced or name in feed or name in scope:
+                continue
+            v = blk._find_var_recursive(name)
+            if v is not None and _is_initialized_var(v):
+                continue
+            known = v is not None
+            findings.append(Finding(
+                "PTA003",
+                f"fetch target {name!r} is produced by no op"
+                + (" (declared but never written — pruned from this "
+                   "program?)" if known else
+                   " and is not declared in the program"),
+                block_idx=blk.idx, var=name))
+
+    # PTA004 — fetch targets overwritten after their defining write
+    for name in fetch_set:
+        ws = [(i, op) for i, op in enumerate(ops)
+              if name in op.output_arg_names
+              and op.type not in _MULTI_WRITE_OPS]
+        if len(ws) >= 2 and any(name not in op.input_arg_names
+                                for _, op in ws[1:]):
+            i, op = ws[-1]
+            findings.append(Finding(
+                "PTA004",
+                f"fetched var {name!r} is written {len(ws)} times; the "
+                f"fetch observes the last write (op {i}, {op.type})",
+                op_type=op.type, op_idx=i, block_idx=blk.idx, var=name))
+
+    # PTA002 — dead ops: mirror executor._prune_ops and report what it
+    # would drop.  Only ENTIRELY dead ops are flagged (an op with one
+    # live output and auxiliary dead ones — XShape, saved stats — is
+    # healthy), and only at info severity.
+    _, keep = prune_keep(blk, fetches)
+    for i, op in enumerate(ops):
+        if keep[i]:
+            continue
+        outs = list(op.output_arg_names)
+        # backward machinery (grad ops, @GRAD/@RENAME/@ACC decorations)
+        # is deliberately generous: append_backward emits gradients the
+        # pruner drops (unfetched metrics, stop-gradient branches) —
+        # that is the design, not a wiring defect
+        if op.attrs.get("op_role") == "backward" \
+                or (outs and all("@" in n for n in outs)):
+            continue
+        findings.append(Finding(
+            "PTA002",
+            f"op output(s) {outs} reach no fetch or persistable state "
+            f"— the executor's pruner will drop this op",
+            op_type=op.type, op_idx=i, block_idx=blk.idx,
+            var=outs[0] if outs else None))
+    return findings

@@ -1,0 +1,252 @@
+"""Findings catalog for the static program verifier (docs/ANALYSIS.md).
+
+Every diagnostic the analyzer can emit has a STABLE code (``PTA0xx``
+dataflow, ``PTA1xx`` shape/dtype, ``PTA2xx`` sharding/collective), a
+default severity, and op/var provenance.  Codes are part of the tool's
+contract: tests, baselines and allow-lists key on them, so a code is
+never renumbered — retired codes are tombstoned in CATALOG instead.
+
+Severity policy:
+
+  error    the program cannot run correctly on some lane — an executor
+           or XLA failure (possibly an opaque trace error) is certain,
+           or the numerics would be silently wrong.
+  warning  the program runs, but not the way the author asked for —
+           e.g. a shard annotation silently degrades to replication.
+  info     advisory — a structural observation (a dead op the pruner
+           will drop) that costs performance at most.
+
+``FLAGS_program_verify`` maps onto this: ``warn`` surfaces everything
+as a ProgramVerifyWarning, ``raise`` additionally turns error-severity
+findings into ProgramVerifyError, ``strict`` raises on warnings too
+(info findings never raise — they describe sanctioned behavior).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+SEV_INFO = "info"
+
+_SEV_RANK = {SEV_INFO: 0, SEV_WARNING: 1, SEV_ERROR: 2}
+
+
+@dataclass(frozen=True)
+class DiagnosticSpec:
+    """One catalog entry: a stable code and its default severity."""
+
+    code: str
+    name: str
+    severity: str
+    summary: str
+
+
+# The catalog.  Codes are append-only (see module docstring).
+CATALOG = {
+    spec.code: spec
+    for spec in (
+        # -- dataflow (PTA0xx) -------------------------------------------
+        DiagnosticSpec(
+            "PTA001", "uninitialized-read", SEV_ERROR,
+            "an op reads a non-persistable, non-fed variable no earlier "
+            "op writes — the executor will fail or read garbage"),
+        DiagnosticSpec(
+            "PTA002", "dead-var", SEV_INFO,
+            "an op's outputs are never read and do not reach any fetch "
+            "or persistable state — the pruner will drop the op"),
+        DiagnosticSpec(
+            "PTA003", "fetch-of-pruned", SEV_ERROR,
+            "a fetch target no op produces (e.g. a grad var fetched "
+            "from a clone(for_test) program) — the executor raises"),
+        DiagnosticSpec(
+            "PTA004", "write-after-fetch", SEV_WARNING,
+            "a fetched variable is overwritten by a later op — the "
+            "fetch observes the LAST write, which may not be the one "
+            "the author meant"),
+        DiagnosticSpec(
+            "PTA005", "double-write", SEV_WARNING,
+            "two ops blind-write the same variable outside the "
+            "sanctioned in-place/accumulation families — the first "
+            "write is dead and likely a wiring mistake"),
+        # -- shape/dtype propagation (PTA1xx) ----------------------------
+        DiagnosticSpec(
+            "PTA101", "shape-mismatch", SEV_ERROR,
+            "forward shape inference through the op registry failed: "
+            "rank/dim/broadcast mismatch at the named op"),
+        DiagnosticSpec(
+            "PTA102", "dtype-mismatch", SEV_ERROR,
+            "an op combines operands of incompatible dtype classes "
+            "(float vs integer) without an explicit cast"),
+        DiagnosticSpec(
+            "PTA103", "nonfloat-grad-path", SEV_ERROR,
+            "a non-float tensor feeds a gradient or quantized-"
+            "collective path — backward/quantization requires a float "
+            "payload"),
+        # -- sharding & collective legality (PTA2xx) ---------------------
+        DiagnosticSpec(
+            "PTA201", "shard-nondivisible", SEV_WARNING,
+            "a sharding annotation names a mesh axis that does not "
+            "evenly divide the tensor dim — the gspmd layer silently "
+            "replicates that dim instead"),
+        DiagnosticSpec(
+            "PTA202", "pipeline-cut", SEV_ERROR,
+            "the pipeline stage cut is illegal: unresolvable cut vars, "
+            "stage count vs mesh mismatch, multi-stage producers on a "
+            "boundary wire, or a non-boundary backward dependency"),
+        DiagnosticSpec(
+            "PTA203", "pipeline-boundary-nonfloat", SEV_ERROR,
+            "a pipeline stage-boundary wire carries a non-float tensor "
+            "— boundary shifts and grad returns are float-only (PR 15 "
+            "contract)"),
+        DiagnosticSpec(
+            "PTA204", "quant-ineligible", SEV_WARNING,
+            "the quantized-collective hook is enabled but a gradient "
+            "payload is ineligible (non-float or DGC-encoded) and will "
+            "ride the exact path"),
+        DiagnosticSpec(
+            "PTA205", "collective-axis", SEV_ERROR,
+            "a collective's ring/axis wiring does not match the mesh: "
+            "unmapped ring_id, axis absent from the mesh, or a "
+            "backward-oriented stage wire (ppermute orientation)"),
+        DiagnosticSpec(
+            "PTA206", "mesh-factorization", SEV_ERROR,
+            "the requested mesh axes do not factor the device count"),
+    )
+}
+
+
+@dataclass
+class Finding:
+    """One diagnostic instance with provenance."""
+
+    code: str
+    message: str
+    severity: str = None  # default: catalog severity
+    op_type: str = None
+    op_idx: int = None
+    block_idx: int = None
+    var: str = None
+
+    def __post_init__(self):
+        if self.severity is None:
+            spec = CATALOG.get(self.code)
+            self.severity = spec.severity if spec else SEV_WARNING
+
+    @property
+    def name(self):
+        spec = CATALOG.get(self.code)
+        return spec.name if spec else self.code
+
+    def format(self):
+        where = []
+        if self.block_idx is not None and self.op_idx is not None:
+            where.append(f"block {self.block_idx} op {self.op_idx}")
+        elif self.block_idx is not None:
+            where.append(f"block {self.block_idx}")
+        if self.op_type:
+            where.append(self.op_type)
+        if self.var:
+            where.append(f"var {self.var!r}")
+        loc = f" ({', '.join(where)})" if where else ""
+        return (f"{self.code} [{self.severity}] {self.name}: "
+                f"{self.message}{loc}")
+
+
+@dataclass
+class Report:
+    """The verifier's result: an ordered list of findings."""
+
+    findings: list = field(default_factory=list)
+
+    def add(self, code, message, **kw):
+        self.findings.append(Finding(code, message, **kw))
+
+    def extend(self, findings):
+        self.findings.extend(findings)
+
+    @property
+    def errors(self):
+        return [f for f in self.findings if f.severity == SEV_ERROR]
+
+    @property
+    def warnings(self):
+        return [f for f in self.findings if f.severity == SEV_WARNING]
+
+    @property
+    def ok(self):
+        return not self.errors
+
+    def codes(self):
+        return sorted({f.code for f in self.findings})
+
+    def by_code(self, code):
+        return [f for f in self.findings if f.code == code]
+
+    def max_severity(self):
+        if not self.findings:
+            return None
+        return max((f.severity for f in self.findings),
+                   key=lambda s: _SEV_RANK.get(s, 0))
+
+    def format(self):
+        if not self.findings:
+            return "program verify: clean (0 findings)"
+        lines = [f.format() for f in self.findings]
+        n_err, n_warn = len(self.errors), len(self.warnings)
+        n_info = len(self.findings) - n_err - n_warn
+        lines.append(f"program verify: {len(self.findings)} finding(s) "
+                     f"({n_err} error, {n_warn} warning, {n_info} info)")
+        return "\n".join(lines)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def __len__(self):
+        return len(self.findings)
+
+
+class ProgramVerifyError(RuntimeError):
+    """Raised by preflight under FLAGS_program_verify=raise/strict.
+
+    Carries the full ``report`` so callers can key on diagnostic codes.
+    """
+
+    def __init__(self, report, lane=None):
+        self.report = report
+        self.lane = lane
+        head = "program verification failed"
+        if lane:
+            head += f" ({lane} preflight)"
+        super().__init__(head + ":\n" + report.format())
+
+
+class ProgramVerifyWarning(UserWarning):
+    """Emitted by preflight under FLAGS_program_verify=warn."""
+
+
+def format_mesh_error(devices, requested, leftover_axis=None):
+    """PTA206 text for mesh builders: the full factorization attempted
+    and the device count (not just the failing axis).
+
+    ``requested`` is an ordered {axis: size-or-None}; None marks the
+    inferred axis (``leftover_axis``) whose size would be the quotient.
+    """
+    parts = []
+    explicit = 1
+    for ax, size in requested.items():
+        parts.append(f"{ax}={size if size is not None else '?'}")
+        if size is not None:
+            explicit *= size
+    quot = (f"{devices} // {explicit} = {devices // explicit} "
+            f"rem {devices % explicit}" if explicit else "?")
+    msg = (f"cannot factor device_count={devices} as "
+           f"{' x '.join(parts)}: the explicit axes multiply to "
+           f"{explicit}, which does not divide {devices}")
+    if leftover_axis is not None:
+        msg += f" (inferred {leftover_axis} would be {quot})"
+    msg += (" — pass axis sizes whose product divides the device count,"
+            " or fewer explicit axes")
+    return Finding("PTA206", msg).format()

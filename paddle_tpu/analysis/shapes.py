@@ -1,0 +1,280 @@
+"""Forward shape/dtype propagation through the op registry.
+
+Re-runs the registry's abstract evaluation (`registry.infer_op_outputs`
+machinery: ``jax.eval_shape`` of each op's lowering over
+ShapeDtypeStruct inputs) as a PROPAGATION — a shadow environment of
+(shape, dtype) flows op to op, optionally seeded with the concrete feed
+shapes the preflight knows — and turns classified failures into named
+findings instead of leaving them to surface as XLA trace errors:
+
+  PTA101  shape-mismatch   rank/dim/broadcast/contracting-dim failures
+  PTA102  dtype-mismatch   float/integer operand mixes on arithmetic ops
+  PTA103  nonfloat-grad-path  non-float payloads on gradient /
+                              quantized-collective paths
+
+Dynamic (-1) dims are abstracted with the registry's prime sentinel.
+Because a sentinel-valued dim can fail divisibility checks a real batch
+would pass, a shape failure is only reported when evaluation fails
+identically under TWO different prime sentinels — a genuine static
+mismatch fails for any batch size; sentinel artifacts don't.  Anything
+unclassifiable stays silent (the op's outputs just become unknown
+downstream), mirroring `infer_op_outputs`' best-effort contract.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from .findings import Finding
+
+_SENTINELS = (191, 193)  # distinct primes; see module docstring
+
+# ops the propagation skips: wiring pseudo-ops, control flow (needs the
+# executor's sub-block environment), tensor-array plumbing
+_SKIP_OPS = frozenset((
+    "feed", "fetch", "while", "conditional_block", "select_input",
+    "select_output", "recurrent", "ifelse",
+    "write_to_array", "read_from_array", "array_length",
+    "lod_rank_table", "lod_tensor_to_array", "array_to_lod_tensor",
+    "print",
+))
+
+_FLOATS = frozenset(("float16", "bfloat16", "float32", "float64"))
+_INTS = frozenset(("int8", "int16", "int32", "int64", "uint8"))
+
+# arithmetic families where a float/int operand mix is a wiring defect
+# (the reference framework rejects it; jnp would silently promote)
+_ARITH_OPS = frozenset((
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_pow", "elementwise_max",
+    "elementwise_min", "matmul", "matmul_v2", "mul",
+))
+
+_SHAPE_ERR_PATTERNS = (
+    "incompatible shapes", "broadcast", "same shape", "contracting",
+    "rank", "ndim", "dimension", "reshape", "got shape", "shapes for",
+)
+
+
+def _classify(exc):
+    msg = str(exc).lower()
+    if "dtype" in msg:
+        return "PTA102"
+    if any(p in msg for p in _SHAPE_ERR_PATTERNS):
+        return "PTA101"
+    return None
+
+
+def _seed_env(block, feed_shapes=None, feed_dtypes=None):
+    """name -> (shape tuple with -1 for dynamic, dtype str)."""
+    env = {}
+    for name, v in block.vars.items():
+        if v.shape is not None:
+            env[name] = (tuple(v.shape), str(v.dtype))
+    for name, shp in (feed_shapes or {}).items():
+        dt = (feed_dtypes or {}).get(name) or env.get(name, (None, None))[1]
+        env[name] = (tuple(int(s) for s in shp), str(dt) if dt else None)
+    return env
+
+
+def _struct(shape, dtype, sentinel):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if shape is None or dtype is None:
+        return None
+    shp = tuple(sentinel if s == -1 else int(s) for s in shape)
+    dt = jnp.bfloat16 if dtype == "bfloat16" else np.dtype(dtype)
+    return jax.ShapeDtypeStruct(shp, dt)
+
+
+def _eval_op(info, op, block, env, sentinel):
+    """eval_shape one op against the shadow env; returns
+    (outputs dict name->(shape, dtype), exception)."""
+    import jax
+    from paddle_tpu.fluid.registry import LowerContext, _as_tuple
+
+    args = []
+    for slot in info.input_slots:
+        cslot = slot.rstrip("*")
+        names = op.inputs.get(cslot, [])
+        if info.is_variadic(slot):
+            structs = [_struct(*env.get(n, (None, None)), sentinel)
+                       for n in names]
+            if any(s is None for s in structs):
+                return None, None
+            args.append(structs)
+        elif not names:
+            args.append(None)
+        else:
+            s = _struct(*env.get(names[0], (None, None)), sentinel)
+            if s is None and cslot not in info.optional:
+                return None, None
+            args.append(s)
+
+    ctx = LowerContext(step=0, is_test=False, block=block)
+    ctx.op_index = 0
+    ctx.cur_op = op
+    try:
+        # the analysis must not be sensitive to the ambient warning
+        # filter: under -W error, jax's benign advisories (x64
+        # truncation etc.) would surface as eval failures here
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = jax.eval_shape(
+                lambda *a: _as_tuple(info.lower(ctx, *a, attrs=op.attrs)),
+                *args)
+    except Exception as e:  # classified by the caller
+        return None, e
+
+    results = {}
+    for slot, val in zip(info.output_slots, out):
+        cslot = slot.rstrip("*")
+        names = op.outputs.get(cslot, [])
+        vals = val if info.is_variadic(slot) else [val]
+        for n, s in zip(names, vals or []):
+            if s is None or not hasattr(s, "shape"):
+                continue
+            shape = tuple(-1 if d == sentinel else int(d) for d in s.shape)
+            dt = str(s.dtype)
+            results[n] = (shape, dt)
+    return results, None
+
+
+def _dtype_class(dt):
+    if dt in _FLOATS:
+        return "float"
+    if dt in _INTS:
+        return "int"
+    return None  # bool/complex/unknown: not judged
+
+
+def _has_dynamic_input(op, env):
+    for n in op.input_arg_names:
+        shp = env.get(n, (None, None))[0]
+        if shp is not None and any(d == -1 for d in shp):
+            return True
+    return False
+
+
+def analyze_shapes(program, feed_shapes=None, feed_dtypes=None,
+                   fetch_names=None):
+    """Run the propagation over the entry block; returns [Finding]."""
+    from paddle_tpu.fluid import registry
+
+    findings = []
+    block = program.global_block()
+    env = _seed_env(block, feed_shapes, feed_dtypes)
+
+    # With concrete feeds the analysis mirrors an actual run: ops the
+    # executor's pruner drops for this fetch set are never traced, so
+    # they are skipped here too (a pruned op reading an UNFED var would
+    # otherwise mix concrete feed dims with abstract sentinels and fail
+    # spuriously — e.g. the loss sub-graph of an inference program).
+    live = None
+    if feed_shapes:
+        from .dataflow import prune_keep
+        ops, keep = prune_keep(block, fetch_names)
+        live = {id(op) for op, k in zip(ops, keep) if k}
+
+    for i, op in enumerate(block.ops):
+        if op.type in _SKIP_OPS or not registry.has_op(op.type):
+            continue
+        if live is not None and id(op) not in live:
+            continue
+        info = registry.get_op(op.type)
+        if info.host_run is not None or "sub_block" in op.attrs:
+            continue
+
+        # explicit float/int mix check on arithmetic ops — jnp would
+        # promote silently, so eval_shape alone can't see it
+        if op.type in _ARITH_OPS:
+            classes = {}
+            for n in op.input_arg_names:
+                dt = env.get(n, (None, None))[1]
+                c = _dtype_class(dt)
+                if c:
+                    classes[c] = n
+            if len(classes) == 2:
+                findings.append(Finding(
+                    "PTA102",
+                    f"{op.type} mixes float operand "
+                    f"{classes['float']!r} with integer operand "
+                    f"{classes['int']!r} — insert an explicit cast "
+                    f"(the reference framework rejects this; implicit "
+                    f"promotion hides the wiring mistake)",
+                    op_type=op.type, op_idx=i, block_idx=block.idx,
+                    var=classes["int"]))
+                continue  # outputs unknown downstream
+
+        results, exc = _eval_op(info, op, block, env, _SENTINELS[0])
+        if exc is not None:
+            code = _classify(exc)
+            # under concrete feeds, an input that still carries a -1 dim
+            # means a partially-concretized environment (some vars fed,
+            # some abstract) — eval failures there are ambiguous, and the
+            # dataflow family already reports the genuinely-unfed read
+            if code is not None and feed_shapes \
+                    and _has_dynamic_input(op, env):
+                code = None
+            if code is not None:
+                # re-run under a second sentinel: a genuine static
+                # mismatch fails for ANY dynamic-dim value; a
+                # sentinel-divisibility artifact doesn't
+                _, exc2 = _eval_op(info, op, block, env, _SENTINELS[1])
+                if exc2 is not None and _classify(exc2) == code:
+                    first = str(exc).splitlines()[0]
+                    findings.append(Finding(
+                        code,
+                        f"shape inference failed at {op.type} "
+                        f"(inputs {list(op.input_arg_names)}): {first}",
+                        op_type=op.type, op_idx=i, block_idx=block.idx,
+                        var=(op.output_arg_names[0]
+                             if op.output_arg_names else None)))
+            continue
+        if results:
+            env.update(results)
+
+    findings.extend(_check_grad_paths(program, block, env))
+    return findings
+
+
+def _check_grad_paths(program, block, env):
+    """PTA103 — non-float payloads on gradient / quantized-collective
+    paths: (param, grad) pairs recorded by append_backward, and the
+    X payload of quantized collectives."""
+    findings = []
+
+    def dtype_of(name):
+        dt = env.get(name, (None, None))[1]
+        if dt is None:
+            v = block._find_var_recursive(name)
+            dt = str(v.dtype) if v is not None else None
+        return dt
+
+    for param, grad in getattr(program, "_params_grads", []):
+        for name, role in ((param, "parameter"), (grad, "gradient")):
+            dt = dtype_of(name)
+            if dt is not None and _dtype_class(dt) == "int":
+                findings.append(Finding(
+                    "PTA103",
+                    f"{role} {name!r} on the gradient path has "
+                    f"non-float dtype {dt} — backward and optimizer "
+                    f"updates require float payloads",
+                    block_idx=block.idx, var=name))
+
+    for i, op in enumerate(block.ops):
+        if not op.type.startswith("c_allreduce_quant"):
+            continue
+        for name in op.input_arg_names:
+            dt = dtype_of(name)
+            if dt is not None and _dtype_class(dt) != "float":
+                findings.append(Finding(
+                    "PTA103",
+                    f"quantized collective payload {name!r} has "
+                    f"non-float dtype {dt} — the quantized wire format "
+                    f"encodes float tensors only",
+                    op_type=op.type, op_idx=i, block_idx=block.idx,
+                    var=name))
+    return findings

@@ -1,0 +1,419 @@
+"""Sharding & collective legality: (program, mesh, policy) preflight.
+
+The gspmd layer is deliberately forgiving at run time — `specs._fits`
+SILENTLY drops a shard axis that does not divide the tensor dim, the
+pipeline plan raises deep inside compilation, and a collective whose
+ring maps to an absent mesh axis surfaces as an opaque unbound-axis
+trace error.  This module checks the same contracts STATICALLY and
+names them:
+
+  PTA201  shard-nondivisible        annotation degrades to replication
+  PTA202  pipeline-cut              illegal stage cut / stage-mesh drift
+  PTA203  pipeline-boundary-nonfloat  non-float boundary wire (PR 15)
+  PTA204  quant-ineligible          quant hook payloads on the exact path
+  PTA205  collective-axis           ring/axis wiring vs the mesh;
+                                    backward-oriented stage wires
+                                    (ppermute orientation)
+
+Works against a real ``jax.sharding.Mesh`` or an `AbstractMesh` (axis
+name → size mapping), so the CLI can check legality for a target
+topology without owning the devices.
+"""
+
+from __future__ import annotations
+
+from .findings import Finding, SEV_ERROR, SEV_WARNING
+
+
+class AbstractMesh:
+    """Duck-typed stand-in for jax.sharding.Mesh: just named axis sizes.
+
+    ``AbstractMesh({"pp": 2, "dp": 4})`` — enough for every legality
+    check here (the analyses only read ``axis_names`` and ``shape``).
+    """
+
+    def __init__(self, axes):
+        from paddle_tpu.parallel import mesh as pmesh
+
+        self._axes = {pmesh.canonical_axis(a): int(s)
+                      for a, s in dict(axes).items()}
+
+    @property
+    def axis_names(self):
+        return tuple(self._axes)
+
+    @property
+    def shape(self):
+        return dict(self._axes)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._axes.values():
+            n *= s
+        return n
+
+    def __repr__(self):
+        return f"AbstractMesh({self._axes})"
+
+
+# collective bootstrap/sync ops with a ring_id but no payload semantics
+_COLLECTIVE_NOOPS = frozenset((
+    "c_comm_init", "c_comm_init_all", "c_gen_nccl_id",
+    "c_sync_calc_stream", "c_sync_comm_stream", "c_wait_compute",
+    "c_wait_comm", "c_identity",
+))
+
+
+def analyze_sharding(program, mesh, policy, feed_shapes=None,
+                     quant_hook=False):
+    """Run all sharding/collective checks; returns [Finding]."""
+    findings = []
+    findings.extend(_check_collectives(program, mesh))
+    if mesh is None:
+        return findings
+    if policy is not None:
+        if getattr(policy, "name", None) == "pipeline":
+            findings.extend(
+                _check_pipeline(program, mesh, policy, feed_shapes))
+            inner = getattr(policy, "inner", None)
+            if inner is not None:
+                findings.extend(_check_divisibility(
+                    program, mesh, inner, feed_shapes))
+        else:
+            findings.extend(_check_divisibility(
+                program, mesh, policy, feed_shapes))
+    if quant_hook:
+        findings.extend(_check_quant_hook(program, mesh, policy))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# PTA205 — collective ring/axis wiring
+# ---------------------------------------------------------------------------
+
+
+def _check_collectives(program, mesh):
+    from paddle_tpu.parallel import mesh as pmesh
+
+    findings = []
+    for blk in program.blocks:
+        for i, op in enumerate(blk.ops):
+            if not op.type.startswith("c_") \
+                    or op.type in _COLLECTIVE_NOOPS \
+                    or "ring_id" not in op.attrs:
+                continue
+            ring = int(op.attrs.get("ring_id", 0))
+            axis = pmesh.axis_name_for_ring(ring)
+            if axis is None:
+                findings.append(Finding(
+                    "PTA205",
+                    f"{op.type} uses ring_id={ring} which maps to no "
+                    f"mesh axis (mesh.register_ring) — the kernels "
+                    f"layer cannot resolve the reduction axis",
+                    severity=SEV_WARNING,
+                    op_type=op.type, op_idx=i, block_idx=blk.idx))
+            elif mesh is not None and axis not in mesh.axis_names:
+                findings.append(Finding(
+                    "PTA205",
+                    f"{op.type} ring_id={ring} maps to mesh axis "
+                    f"{axis!r} which this mesh lacks (axes "
+                    f"{tuple(mesh.axis_names)}) — the collective would "
+                    f"fail with an unbound axis name at trace time",
+                    op_type=op.type, op_idx=i, block_idx=blk.idx))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# PTA201 — silent-replication divisibility
+# ---------------------------------------------------------------------------
+
+
+def _static_shape(v):
+    if v is None or v.shape is None or any(s == -1 for s in v.shape):
+        return None
+    return tuple(v.shape)
+
+
+def _intended_specs(program, policy, name, shape, mesh):
+    """The UNGATED spec a policy would assign — what the author asked
+    for, before `_fits` silently drops non-dividing axes."""
+    from paddle_tpu.parallel.gspmd import specs as gspecs
+
+    v = program.global_block()._find_var_recursive(name)
+    if isinstance(policy, gspecs.TensorParallelPolicy):
+        spec = policy.rules.spec_for(name)  # raw, no shape/mesh gating
+        if any(spec):
+            return spec
+        if policy.zero_stage >= 1 and v is not None \
+                and getattr(v, "is_optimizer_state", False):
+            return (policy.batch_axis,)
+        return ()
+    if isinstance(policy, gspecs.Zero1Policy):
+        if v is not None and getattr(v, "is_optimizer_state", False):
+            return (policy.batch_axis,)
+        return ()
+    return ()
+
+
+def _check_divisibility(program, mesh, policy, feed_shapes):
+    from paddle_tpu.parallel.gspmd import specs as gspecs
+
+    findings = []
+    block = program.global_block()
+    mesh_shape = dict(mesh.shape)
+
+    def gate(intended, shape, what, name):
+        for d, a in enumerate(intended[:len(shape)]):
+            if a is None or a not in mesh_shape or mesh_shape[a] <= 1:
+                continue
+            # dims of size 1 (scalar accumulators) cannot shard and lose
+            # nothing by replicating — `_fits` protects them BY DESIGN
+            if shape[d] > 1 and shape[d] % mesh_shape[a] != 0:
+                findings.append(Finding(
+                    "PTA201",
+                    f"{what} {name!r} dim {d} (size {shape[d]}) is not "
+                    f"divisible by mesh axis {a!r} (size "
+                    f"{mesh_shape[a]}) — the gspmd layer silently "
+                    f"replicates this dim instead of sharding it",
+                    var=name))
+
+    for name, v in block.vars.items():
+        if not (v.persistable or getattr(v, "is_optimizer_state", False)):
+            continue
+        shape = _static_shape(v)
+        if not shape:
+            continue
+        intended = gspecs._canon_spec(
+            _intended_specs(program, policy, name, shape, mesh))
+        if any(intended):
+            gate(intended, shape, "parameter/state", name)
+
+    batch_axis = getattr(policy, "batch_axis", None)
+    if batch_axis and batch_axis in mesh_shape and mesh_shape[batch_axis] > 1:
+        for name, shp in (feed_shapes or {}).items():
+            if shp and int(shp[0]) % mesh_shape[batch_axis] != 0:
+                findings.append(Finding(
+                    "PTA201",
+                    f"feed {name!r} batch dim (size {int(shp[0])}) is "
+                    f"not divisible by mesh axis {batch_axis!r} (size "
+                    f"{mesh_shape[batch_axis]}) — the feed rides "
+                    f"replicated instead of batch-sharded",
+                    var=name))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# PTA202/PTA203/PTA205 — pipeline stage-cut legality
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_ops(program):
+    from paddle_tpu.fluid import registry
+
+    ops = []
+    for op in program.global_block().ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        if registry.has_op(op.type) \
+                and registry.get_op(op.type).host_run is not None:
+            continue
+        ops.append(op)
+    return ops
+
+
+def _check_pipeline(program, mesh, policy, feed_shapes):
+    from paddle_tpu.fluid.framework import is_float_dtype
+    from paddle_tpu.parallel.pipeline import boundary_sets, stage_partition
+
+    findings = []
+    block = program.global_block()
+    pipe_axis = getattr(policy, "pipe_axis", "pp")
+    mesh_shape = dict(mesh.shape)
+
+    if pipe_axis not in mesh.axis_names:
+        findings.append(Finding(
+            "PTA202",
+            f"PipelinePolicy needs a {pipe_axis!r} mesh axis; mesh has "
+            f"{tuple(mesh.axis_names)} — build one with "
+            f"mesh.build_3d_mesh(pp=...)"))
+        return findings
+
+    try:
+        cut_vars = policy.resolve_cut_vars(program)
+    except ValueError as e:
+        findings.append(Finding("PTA202", f"unresolvable cut: {e}"))
+        return findings
+    for cv in cut_vars:
+        if block._find_var_recursive(cv) is None:
+            findings.append(Finding(
+                "PTA202",
+                f"cut var {cv!r} is not declared in the program",
+                var=cv))
+    if any(f.code == "PTA202" for f in findings):
+        return findings
+
+    try:
+        stages, _stage_of = stage_partition(
+            program, _pipeline_ops(program), cut_vars)
+    except (ValueError, KeyError) as e:
+        findings.append(Finding(
+            "PTA202", f"stage partition failed: {e}"))
+        return findings
+
+    S = len(stages)
+    pp = int(mesh_shape[pipe_axis])
+    if S < 2:
+        findings.append(Finding(
+            "PTA202",
+            f"cut vars {cut_vars} produce {S} stage(s) — a pipeline "
+            f"needs at least 2"))
+    if pp != S:
+        findings.append(Finding(
+            "PTA202",
+            f"mesh {pipe_axis!r} axis size {pp} != pipeline stages {S} "
+            f"(cut vars {cut_vars})"))
+
+    produced_at = {}
+    producers = {}
+    for st in stages:
+        for op in st.fwd_ops:
+            for n in op.output_arg_names:
+                produced_at.setdefault(n, st.index)
+                producers.setdefault(n, set()).add(st.index)
+
+    boundaries = boundary_sets(stages)
+    for b, names in enumerate(boundaries):
+        for n in names:
+            stset = producers.get(n, set())
+            if len(stset) > 1:
+                findings.append(Finding(
+                    "PTA202",
+                    f"boundary wire {n!r} (stage {b}→{b + 1}) is "
+                    f"produced by ops in stages {sorted(stset)} — each "
+                    f"wire needs a single producing stage",
+                    var=n))
+            v = block._find_var_recursive(n)
+            if v is not None and not is_float_dtype(v.dtype):
+                findings.append(Finding(
+                    "PTA203",
+                    f"boundary wire {n!r} (stage {b}→{b + 1}) has "
+                    f"dtype {v.dtype} — stage-boundary shifts and "
+                    f"their gradient returns are float-only",
+                    var=n))
+
+    # ppermute orientation: the stage-shift ring only moves forward
+    # (b → b+1) for activations and backward (b+1 → b) for their
+    # gradients.  An activation consumed at an EARLIER stage than its
+    # producer, or a backward value that is not a boundary-activation
+    # gradient, needs a wire orientation the ring does not have.
+    for st in stages:
+        for n in st.acts_in:
+            src = produced_at.get(n)
+            if src is not None and src > st.index:
+                findings.append(Finding(
+                    "PTA205",
+                    f"stage {st.index} consumes {n!r} produced at "
+                    f"later stage {src} — a backward-oriented wire the "
+                    f"forward ppermute ring cannot carry",
+                    var=n))
+        if st.index == S - 1:
+            if st.grads_in:
+                findings.append(Finding(
+                    "PTA205",
+                    f"last stage expects no incoming gradients, got "
+                    f"{st.grads_in} — the backward ppermute ring "
+                    f"terminates at stage {S - 1}"))
+            continue
+        boundary = set(boundaries[st.index]) if st.index < len(boundaries) \
+            else set()
+        extra = [n for n in st.grads_in
+                 if (n.split("@GRAD")[0] if "@GRAD" in n else None)
+                 not in boundary]
+        if extra:
+            findings.append(Finding(
+                "PTA205",
+                f"stage {st.index} consumes backward values {extra} "
+                f"that are not gradients of its boundary wire — the "
+                f"backward ppermute ring only carries boundary-"
+                f"activation gradients (use the host-scheduled "
+                f"PipelineRunner)"))
+
+    # batch-norm stats / non-gradient carries the island cannot return
+    grads = {g for _p, g in getattr(program, "_params_grads", [])}
+    produced = set()
+    for st in stages:
+        for op in st.fwd_ops + st.bwd_ops:
+            produced.update(op.output_arg_names)
+    consumed_opt = set()
+    persist_writes = set()
+    for op in _pipeline_ops(program):
+        if op.attrs.get("op_role") == "optimize":
+            consumed_opt.update(op.input_arg_names)
+        for n in op.output_arg_names:
+            v = block._find_var_recursive(n)
+            if v is not None and v.persistable:
+                persist_writes.add(n)
+    carries = sorted(((consumed_opt | persist_writes) & produced) - grads)
+    if carries:
+        findings.append(Finding(
+            "PTA202",
+            f"the stage island cannot carry {carries} out to the "
+            f"optimizer/scope (batch-norm running stats, non-gradient "
+            f"optimizer inputs) — use the host-scheduled "
+            f"PipelineRunner"))
+
+    # microbatch divisibility (the pipeline lane RAISES on this one)
+    try:
+        M = int(policy.resolve_microbatches(program))
+    except Exception:
+        M = None
+    if M:
+        dp = int(mesh_shape.get(getattr(policy, "batch_axis", "dp"), 1))
+        for name, shp in (feed_shapes or {}).items():
+            if shp and int(shp[0]) % (M * dp) != 0:
+                findings.append(Finding(
+                    "PTA201",
+                    f"feed {name!r} batch dim (size {int(shp[0])}) is "
+                    f"not divisible by microbatches x dp = {M} x {dp} "
+                    f"— the pipeline lane rejects this feed",
+                    severity=SEV_ERROR, var=name))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# PTA204 — quant-hook eligibility
+# ---------------------------------------------------------------------------
+
+
+def _check_quant_hook(program, mesh, policy):
+    from paddle_tpu.fluid.framework import is_float_dtype
+
+    findings = []
+    block = program.global_block()
+    if policy is not None and mesh is not None \
+            and policy.uses_model_axis(program, mesh):
+        findings.append(Finding(
+            "PTA204",
+            f"quant hook enabled with policy {policy.name!r} which "
+            f"maps a non-batch mesh axis — the hook demotes itself "
+            f"(its island maps only the batch axis) and every gradient "
+            f"rides the exact path"))
+    dgc = getattr(program, "_dgc_encoded", {}) or {}
+    for param, grad in getattr(program, "_params_grads", []):
+        if grad in dgc:
+            findings.append(Finding(
+                "PTA204",
+                f"gradient {grad!r} is DGC-encoded — the quant hook "
+                f"skips it and it rides the exact sparse path",
+                var=grad))
+            continue
+        v = block._find_var_recursive(grad)
+        if v is not None and not is_float_dtype(v.dtype):
+            findings.append(Finding(
+                "PTA204",
+                f"gradient {grad!r} has non-float dtype {v.dtype} — "
+                f"ineligible for the quantized wire format, rides the "
+                f"exact path",
+                var=grad))
+    return findings

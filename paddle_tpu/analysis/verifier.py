@@ -1,0 +1,109 @@
+"""The verifier orchestrator: `verify()` and the executor preflight.
+
+``verify()`` runs the three analysis families (dataflow, shape/dtype
+propagation, sharding/collective legality) over an unmodified Program
+and returns a `findings.Report`.  Families degrade gracefully: without
+a (mesh, policy) the sharding family only checks ring wiring; without
+feeds, dynamic dims stay abstract.
+
+``preflight()`` is the executors' hook, gated by ``FLAGS_program_verify``:
+
+  off     do nothing
+  warn    (default) emit one ProgramVerifyWarning per (program, lane)
+          summarizing the findings
+  raise   additionally raise ProgramVerifyError on error-severity
+          findings — an opaque XLA trace failure becomes a named
+          diagnostic BEFORE the trace starts
+  strict  raise on warnings too (info findings never raise)
+
+Preflight runs only where the executors already pay a compile — their
+executable-cache miss paths — so steady-state steps never re-analyze.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from .dataflow import analyze_dataflow
+from .findings import (ProgramVerifyError, ProgramVerifyWarning, Report,
+                       SEV_ERROR, SEV_WARNING)
+from .shapes import analyze_shapes
+from .sharding import analyze_sharding
+
+_FAMILIES = ("dataflow", "shapes", "sharding")
+
+
+def verify(program, mesh=None, policy=None, feed_names=None,
+           feed_shapes=None, feed_dtypes=None, fetch_names=None,
+           scope_keys=None, quant_hook=False, families=None):
+    """Statically verify ``program``; returns a findings `Report`.
+
+    All context is optional — pass what the call site knows: the
+    executors' preflight passes feeds/fetches/scope and (on the gspmd
+    lane) mesh+policy; `Program.verify()` at build() time passes
+    nothing and still gets the dataflow + shape families.
+    """
+    families = set(families or _FAMILIES)
+    unknown = families - set(_FAMILIES)
+    if unknown:
+        raise ValueError(
+            f"unknown analysis families {sorted(unknown)}; "
+            f"available: {_FAMILIES}")
+    report = Report()
+    if feed_names is None and feed_shapes:
+        feed_names = list(feed_shapes)
+    if "dataflow" in families:
+        report.extend(analyze_dataflow(
+            program, feed_names=feed_names, fetch_names=fetch_names,
+            scope_keys=scope_keys))
+    if "shapes" in families:
+        report.extend(analyze_shapes(
+            program, feed_shapes=feed_shapes, feed_dtypes=feed_dtypes,
+            fetch_names=fetch_names))
+    if "sharding" in families:
+        report.extend(analyze_sharding(
+            program, mesh, policy, feed_shapes=feed_shapes,
+            quant_hook=quant_hook))
+    return report
+
+
+# one warning per (program identity, lane): steady-state recompiles
+# (new feed signatures) re-run the analysis but do not re-warn
+_warned = set()
+
+
+def preflight(program, lane="executor", **kw):
+    """Executor-side verification hook; returns the Report (or None
+    when FLAGS_program_verify=off)."""
+    from paddle_tpu.fluid import flags as _flags
+
+    mode = str(_flags.flag("program_verify")).lower()
+    if mode in ("off", "0", "false", "none", ""):
+        return None
+    if mode not in ("warn", "raise", "strict"):
+        warnings.warn(
+            f"FLAGS_program_verify={mode!r} is not off/warn/raise/"
+            f"strict — treating as 'warn'", ProgramVerifyWarning)
+        mode = "warn"
+
+    report = verify(program, **kw)
+    if not report.findings:
+        return report
+
+    bad = list(report.errors)
+    if mode == "strict":
+        bad += report.warnings
+    if bad and mode in ("raise", "strict"):
+        raise ProgramVerifyError(report, lane=lane)
+
+    if not bad and not report.warnings:
+        return report  # info-only: sanctioned behavior, nothing to say
+    key = (id(program), lane)
+    if key not in _warned:
+        _warned.add(key)
+        warnings.warn(
+            f"program verification ({lane} preflight) found issues — "
+            f"set FLAGS_program_verify=raise to fail fast, =off to "
+            f"silence:\n{report.format()}",
+            ProgramVerifyWarning, stacklevel=3)
+    return report

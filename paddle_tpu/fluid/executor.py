@@ -1224,6 +1224,40 @@ class Executor:
         (docs/DISTRIBUTED.md §6 "Preemption and recovery")."""
         return self._health(program)
 
+    def _verify_preflight(self, program, feed, fetch_names, scope,
+                          stacked_feed=False, lane="executor"):
+        """FLAGS_program_verify hook (paddle_tpu/analysis/): static
+        verification of (program, feeds, fetches) before the compile
+        this cache miss is about to pay.  ProgramVerifyError (raise
+        mode) propagates; an analyzer crash must never take the
+        executor down, so anything else degrades to a warning."""
+        from . import flags as _flags
+
+        if str(_flags.flag("program_verify")).lower() in (
+                "off", "0", "false", "none", ""):
+            return
+        from paddle_tpu import analysis
+
+        feed_shapes, feed_dtypes = {}, {}
+        for name, val in (feed or {}).items():
+            shp = tuple(np.shape(val))
+            if stacked_feed and shp:
+                shp = shp[1:]  # leading dim is the step axis
+            feed_shapes[name] = shp
+            feed_dtypes[name] = str(getattr(val, "dtype", "") or "") or None
+        try:
+            analysis.preflight(
+                program, lane=lane, feed_names=list((feed or {}).keys()),
+                feed_shapes=feed_shapes, feed_dtypes=feed_dtypes,
+                fetch_names=list(fetch_names or []),
+                scope_keys=list(scope.keys()) if scope is not None else None)
+        except analysis.ProgramVerifyError:
+            raise
+        except Exception as e:  # analyzer bug: warn, never block the run
+            warnings.warn(f"program verification failed to run "
+                          f"({type(e).__name__}: {e}) — continuing "
+                          f"without preflight")
+
     def _coerce_feed(self, program, feed):
         import jax
 
@@ -1289,6 +1323,9 @@ class Executor:
         if cb is None:
             from . import profiler as _prof
 
+            # static verification rides the compile boundary: pay it
+            # once per executable, never on steady-state steps
+            self._verify_preflight(program, feed, fetch_names, scope)
             if sent is not None:
                 sent.ensure_state(scope)  # before BlockPlan scope checks
             t0 = _time.perf_counter()  # observability: allow
@@ -1394,6 +1431,8 @@ class Executor:
             from . import profiler as _prof
 
             _m_cache().labels(path="chain", result="miss").inc()
+            self._verify_preflight(program, feed, fetch_names, scope,
+                                   stacked_feed=bool(stacked_feed))
             if sent is not None:
                 sent.ensure_state(scope)
             t0 = _time.perf_counter()  # observability: allow

@@ -173,6 +173,14 @@ _DEFS = {
     # microbatch count for PipelinePolicy when neither the policy nor
     # the program's PipelineOptimizer metadata pins one
     "FLAGS_pipeline_microbatches": (4, int, True),
+    # static program verification at the executors' compile boundary
+    # (paddle_tpu/analysis/, docs/ANALYSIS.md): "warn" (default) emits
+    # one ProgramVerifyWarning per (program, lane) summarizing the
+    # findings, "raise" turns error-severity findings into a
+    # ProgramVerifyError BEFORE the XLA trace (a named diagnostic
+    # instead of an opaque trace failure), "strict" raises on warnings
+    # too, "off" disables the preflight entirely.
+    "FLAGS_program_verify": ("warn", str, True),
     # quant-hook integration form (parallel/gspmd/quant_hook.py):
     # "shard_map" = the fwd/bwd island reducing gradients on the
     # dual-int8 ring (works everywhere), "custom_partitioning" = the

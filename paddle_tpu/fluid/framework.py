@@ -623,6 +623,17 @@ class Program:
         self._bump_version()
         return self
 
+    def verify(self, mesh=None, policy=None, **kw):
+        """Statically verify this program (paddle_tpu/analysis/,
+        docs/ANALYSIS.md): dataflow, shape/dtype propagation, and —
+        given a (mesh, policy) — sharding/collective legality.  Returns
+        a ``paddle_tpu.analysis.Report``; never raises on findings
+        (callers inspect ``report.errors`` or use the
+        FLAGS_program_verify preflight for enforcement)."""
+        from paddle_tpu import analysis  # deferred: analysis imports fluid
+
+        return analysis.verify(self, mesh=mesh, policy=policy, **kw)
+
     def to_string(self, throw_on_error=True, with_details=False):
         """Serialized form (reference Program.to_string renders the proto;
         ours is the io.py JSON program schema)."""

@@ -550,6 +550,44 @@ class GSPMDExecutor:
         return self._dispatch(key, scope, feed, fetch_names, n,
                               bool(stacked_feed), return_numpy)
 
+    def _verify_preflight(self, feed, fetch_names, scope,
+                          stacked_feed=False):
+        """FLAGS_program_verify hook for the gspmd lane: the shared
+        dataflow/shape families plus (mesh, policy, quant-hook)
+        legality.  ProgramVerifyError propagates; analyzer crashes
+        degrade to a warning (the executor must never die on its own
+        diagnostics)."""
+        from paddle_tpu.fluid import flags as _flags
+
+        if str(_flags.flag("program_verify")).lower() in (
+                "off", "0", "false", "none", ""):
+            return
+        import warnings
+
+        from paddle_tpu import analysis
+
+        feed_shapes, feed_dtypes = {}, {}
+        for name, val in (feed or {}).items():
+            shp = tuple(np.shape(val))
+            if stacked_feed and shp:
+                shp = shp[1:]  # leading dim is the step axis
+            feed_shapes[name] = shp
+            feed_dtypes[name] = str(getattr(val, "dtype", "") or "") or None
+        try:
+            analysis.preflight(
+                self.program, lane="gspmd", mesh=self.mesh,
+                policy=self.policy, quant_hook=self.quant_hook,
+                feed_names=list((feed or {}).keys()),
+                feed_shapes=feed_shapes, feed_dtypes=feed_dtypes,
+                fetch_names=list(fetch_names or []),
+                scope_keys=list(scope.keys()) if scope is not None else None)
+        except analysis.ProgramVerifyError:
+            raise
+        except Exception as e:
+            warnings.warn(f"program verification failed to run "
+                          f"({type(e).__name__}: {e}) — continuing "
+                          f"without preflight")
+
     def _dispatch(self, key, scope, feed, fetch_names, n_steps,
                   stacked_feed, return_numpy):
         import time as _time
@@ -563,6 +601,11 @@ class GSPMDExecutor:
         cb = self._cache.get(key)
         if cb is None:
             _m_cache().labels(path="gspmd", result="miss").inc()
+            # static verification at the compile boundary: the gspmd
+            # lane adds (mesh, policy, quant hook) legality on top of
+            # the dataflow/shape families (FLAGS_program_verify)
+            self._verify_preflight(feed, fetch_names, scope,
+                                   stacked_feed=bool(stacked_feed))
             if sent is not None:
                 sent.ensure_state(scope)  # before BlockPlan scope checks
             t0 = _time.perf_counter()  # observability: allow

@@ -107,11 +107,12 @@ def build_2d_mesh(batch=None, model=1, devices=None):
     model = int(model)
     if batch is None:
         if len(devices) % model != 0:
-            raise ValueError(
-                f"model={model} does not divide the {len(devices)} "
-                "available devices — pass batch= explicitly to use a "
-                "subset (silently stranding devices would train at "
-                "reduced capacity with no signal)")
+            from paddle_tpu.analysis.findings import format_mesh_error
+
+            raise ValueError(format_mesh_error(
+                len(devices),
+                {DATA_AXIS: None, MODEL_AXIS: model},
+                leftover_axis=DATA_AXIS))
         batch = len(devices) // model
     shape = {DATA_AXIS: int(batch)}
     if model > 1:
@@ -139,11 +140,12 @@ def build_3d_mesh(pp=1, batch=None, model=1, devices=None):
     if batch is None:
         denom = pp * model
         if len(devices) % denom != 0:
-            raise ValueError(
-                f"pp={pp} x model={model} does not divide the "
-                f"{len(devices)} available devices — pass batch= "
-                "explicitly to use a subset (silently stranding devices "
-                "would train at reduced capacity with no signal)")
+            from paddle_tpu.analysis.findings import format_mesh_error
+
+            raise ValueError(format_mesh_error(
+                len(devices),
+                {PIPE_AXIS: pp, DATA_AXIS: None, MODEL_AXIS: model},
+                leftover_axis=DATA_AXIS))
         batch = len(devices) // denom
     shape = {}
     if pp > 1:

@@ -590,3 +590,30 @@ def test_recovery_flags_roundtrip(monkeypatch):
     monkeypatch.delenv("FLAGS_recovery_drill")
     monkeypatch.delenv("FLAGS_serving_tenant_quota")
     importlib.reload(fl)  # restore defaults for other tests
+
+
+def test_program_verify_flag_roundtrip(monkeypatch):
+    """FLAGS_program_verify (the static-verifier preflight gate,
+    docs/ANALYSIS.md): defaults to "warn" (analyze on every
+    executable-cache miss, one warning per program/lane, never block),
+    escalates to "raise"/"strict", disables with "off" — round-tripping
+    through env bootstrap and get/set like every other flag."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("program_verify")["program_verify"] == "warn"
+    try:
+        fl.set_flags({"FLAGS_program_verify": "raise"})
+        assert fl.get_flags("program_verify")["program_verify"] == "raise"
+        fl.set_flags({"program_verify": "off"})
+        assert fl.get_flags("FLAGS_program_verify")[
+            "FLAGS_program_verify"] == "off"
+    finally:
+        fl.set_flags({"FLAGS_program_verify": "warn"})
+    monkeypatch.setenv("FLAGS_program_verify", "strict")
+    importlib.reload(fl)
+    assert fl.get_flags("program_verify")["program_verify"] == "strict"
+    monkeypatch.delenv("FLAGS_program_verify")
+    importlib.reload(fl)  # restore defaults for other tests
+    assert fl.get_flags("program_verify")["program_verify"] == "warn"

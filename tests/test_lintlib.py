@@ -1,0 +1,124 @@
+"""tools/lintlib.py — the shared lint framework (ISSUE 16): the walker
++ allow-mark mechanics, tuple-of-candidate-linenos, and the baseline
+suppression machinery the five lints delegate to.
+
+The per-lint behavior (which nodes are violations) stays covered by the
+existing test_lint_* files; this file pins the SHARED mechanics so a
+framework change cannot silently alter all five lints at once."""
+
+import ast
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import lintlib  # noqa: E402
+
+
+def _rule_print(node):
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "print":
+        yield node.lineno, "bare-print", "print() call"
+
+
+def test_scan_basic_and_tuple_compat():
+    src = "x = 1\nprint(x)\n"
+    findings = lintlib.scan(src, "mod.py", (_rule_print,), "demo: allow")
+    assert findings == [("mod.py", 2, "bare-print", "print() call")]
+    # namedtuple: both index and attribute access work (the old lints'
+    # tests index their tuples)
+    f = findings[0]
+    assert f[1] == f.lineno == 2 and f.check == "bare-print"
+
+
+def test_scan_allow_mark_same_line_and_above():
+    src = "print(1)  # demo: allow\n# demo: allow\nprint(2)\nprint(3)\n"
+    findings = lintlib.scan(src, "m.py", (_rule_print,), "demo: allow")
+    assert [f.lineno for f in findings] == [4]
+
+
+def test_scan_candidate_lineno_tuple():
+    def rule(node):
+        if isinstance(node, ast.Assign):
+            yield (node.lineno, node.lineno + 1), "assign", "x"
+
+    src = "a = 1\n# demo: allow\nb = 2\n"
+    # the Assign at line 1 has candidates (1, 2); the mark ON line 2
+    # suppresses it, and (per `allowed`) also the line-3 assign above it
+    findings = lintlib.scan(src, "m.py", (rule,), "demo: allow")
+    assert findings == []
+    src2 = "a = 1\nb = 2\n"
+    findings2 = lintlib.scan(src2, "m.py", (rule,), "demo: allow")
+    assert [f.lineno for f in findings2] == [1, 2]  # first candidate wins
+
+
+def test_scan_parse_error_is_a_finding():
+    findings = lintlib.scan("def broken(:\n", "bad.py", (), "x: allow")
+    (f,) = findings
+    assert f.check == "parse-error" and f.path == "bad.py"
+
+
+def test_format_finding():
+    f = lintlib.Finding("a/b.py", 7, "raw-timing", "msg here")
+    assert lintlib.format_finding(f) == "a/b.py:7: [raw-timing] msg here"
+
+
+def test_baseline_roundtrip(tmp_path):
+    base = tmp_path / "baseline.txt"
+    base.write_text(
+        "# frozen legacy findings\n"
+        "\n"
+        "pkg/a.py:10: [bare-print] old message text is ignored\n"
+        "pkg/b.py: [raw-timing]\n")
+    keys = lintlib.load_baseline(base)
+    assert keys == {"pkg/a.py:10: [bare-print]", "pkg/b.py: [raw-timing]"}
+
+    findings = [
+        lintlib.Finding("pkg/a.py", 10, "bare-print", "m"),   # exact hit
+        lintlib.Finding("pkg/a.py", 11, "bare-print", "m"),   # line moved
+        lintlib.Finding("pkg/b.py", 99, "raw-timing", "m"),   # loose hit
+        lintlib.Finding("pkg/c.py", 1, "bare-print", "m"),    # not listed
+    ]
+    kept = lintlib.apply_baseline(findings, keys)
+    assert [(f.path, f.lineno) for f in kept] == [("pkg/a.py", 11),
+                                                 ("pkg/c.py", 1)]
+
+
+def test_apply_baseline_none_is_passthrough():
+    findings = [lintlib.Finding("a.py", 1, "c", "m")]
+    assert lintlib.apply_baseline(findings, None) == findings
+
+
+def test_split_baseline_arg(tmp_path):
+    base = tmp_path / "b.txt"
+    base.write_text("x.py:1: [c]\n")
+    rest, keys = lintlib.split_baseline_arg(
+        ["paddle_tpu", f"--baseline={base}", "tools"])
+    assert rest == ["paddle_tpu", "tools"]
+    assert keys == {"x.py:1: [c]"}
+    rest2, keys2 = lintlib.split_baseline_arg(["paddle_tpu"])
+    assert rest2 == ["paddle_tpu"] and keys2 is None
+
+
+def test_summarize_epilogues(capsys):
+    assert lintlib.summarize("lint_demo", [], 12) == 0
+    assert "lint_demo: OK (12 files clean)" in capsys.readouterr().out
+    f = lintlib.Finding("a.py", 1, "c", "m")
+    assert lintlib.summarize("lint_demo", [f], 3) == 1
+    out = capsys.readouterr().out
+    assert "a.py:1: [c] m" in out
+    assert "lint_demo: 1 finding(s) in 3 file(s)" in out
+
+
+def test_iter_py_files(tmp_path):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "b.py").write_text("")
+    (tmp_path / "pkg" / "a.py").write_text("")
+    (tmp_path / "pkg" / "notes.txt").write_text("")
+    (tmp_path / "one.py").write_text("")
+    got = list(lintlib.iter_py_files(["pkg", "one.py", "absent.txt"],
+                                     repo=tmp_path))
+    assert [p.name for p in got] == ["a.py", "b.py", "one.py"]

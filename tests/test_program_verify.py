@@ -1,0 +1,553 @@
+"""Static program verifier (paddle_tpu/analysis, ISSUE 16): one
+positive (seeded-defect) and one negative (clean-program) test per
+diagnostic code in the findings catalog, the FLAGS_program_verify
+executor preflight, and the acceptance regression — an opaque XLA
+trace failure (dot_general contracting-dim mismatch) becomes the named
+PTA101 diagnostic under FLAGS_program_verify=raise.
+
+The sharding-family tests run against `analysis.AbstractMesh` (axis
+name -> size), so no multi-device partitioning happens in-process; the
+PTA206 tests exercise the real mesh builders on the 8-device virtual
+CPU mesh (cpu_mesh must import before jax)."""
+
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import cpu_mesh  # noqa: F401  (8-device CPU mesh before jax import)
+
+from paddle_tpu import analysis, fluid
+from paddle_tpu.analysis import AbstractMesh
+from paddle_tpu.parallel import mesh as pmesh
+from paddle_tpu.parallel.gspmd import (DataParallelPolicy, PipelinePolicy,
+                                       Zero1Policy)
+
+
+# ---------------------------------------------------------------------------
+# program builders
+# ---------------------------------------------------------------------------
+
+
+def _clean_net():
+    """fit-a-line shape: x(-1,13) -> fc(1) -> square_error vs y -> mean."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [13], dtype="float32")
+        y = fluid.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return main, startup, x, y, pred, loss
+
+
+def _bad_matmul_net():
+    """The seeded PTA101 defect: fc output is (-1, 7) but w3 contracts
+    over 13 — a guaranteed dot_general trace failure."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data("a", [13], dtype="float32")
+        h = fluid.layers.fc(a, 7)
+        w3 = fluid.layers.create_parameter([13, 1], "float32", name="w3")
+        bad = fluid.layers.matmul(h, w3)
+    return main, startup, bad
+
+
+def _double_write_net():
+    """Two blind writes to the same var outside the sanctioned
+    accumulation families."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [13], dtype="float32")
+        a = fluid.layers.scale(x, scale=2.0)
+        main.global_block().append_op(
+            "scale", inputs={"X": [x.name]}, outputs={"Out": [a.name]},
+            attrs={"scale": 3.0})
+    return main, a
+
+
+def _pipeline_net():
+    """Two natural stages with a float boundary wire h."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [8], dtype="float32")
+        y = fluid.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    return main, h, loss
+
+
+# ---------------------------------------------------------------------------
+# dataflow family (PTA00x)
+# ---------------------------------------------------------------------------
+
+
+def test_pta001_uninitialized_read():
+    main, startup, x, y, pred, loss = _clean_net()
+    with fluid.program_guard(main, startup):
+        ghost = main.global_block().create_var(
+            name="ghost0", shape=[-1, 1], dtype="float32")
+        out = fluid.layers.elementwise_add(pred, ghost)
+    r = analysis.verify(main, feed_names=["x", "y"],
+                        fetch_names=[out.name])
+    (f,) = r.by_code("PTA001")
+    assert f.var == "ghost0" and f.severity == "error"
+
+
+def test_pta001_negative_fed_var_is_initialized():
+    main, startup, x, y, pred, loss = _clean_net()
+    with fluid.program_guard(main, startup):
+        ghost = main.global_block().create_var(
+            name="ghost0", shape=[-1, 1], dtype="float32")
+        out = fluid.layers.elementwise_add(pred, ghost)
+    r = analysis.verify(main, feed_names=["x", "y", "ghost0"],
+                        fetch_names=[out.name])
+    assert "PTA001" not in r.codes()
+
+
+def test_pta002_dead_var():
+    main, startup, x, y, pred, loss = _clean_net()
+    with fluid.program_guard(main, startup):
+        extra = fluid.layers.scale(pred, scale=2.0)
+    r = analysis.verify(main, feed_names=["x", "y"],
+                        fetch_names=[loss.name])
+    dead = r.by_code("PTA002")
+    assert dead and all(f.severity == "info" for f in dead)
+    assert any(f.var == extra.name for f in dead)
+
+
+def test_pta002_negative_all_outputs_fetched():
+    main, startup, x, y, pred, loss = _clean_net()
+    with fluid.program_guard(main, startup):
+        extra = fluid.layers.scale(pred, scale=2.0)
+    r = analysis.verify(main, feed_names=["x", "y"],
+                        fetch_names=[loss.name, extra.name])
+    assert "PTA002" not in r.codes()
+
+
+def test_pta003_fetch_of_pruned():
+    main, startup, x, y, pred, loss = _clean_net()
+    r = analysis.verify(main, feed_names=["x", "y"],
+                        fetch_names=["x@GRAD"])
+    (f,) = r.by_code("PTA003")
+    assert f.severity == "error" and "x@GRAD" in f.message + str(f.var)
+
+
+def test_pta003_negative_real_fetch():
+    main, startup, x, y, pred, loss = _clean_net()
+    r = analysis.verify(main, feed_names=["x", "y"],
+                        fetch_names=[loss.name])
+    assert "PTA003" not in r.codes()
+
+
+def test_pta004_write_after_fetch():
+    main, a = _double_write_net()
+    r = analysis.verify(main, feed_names=["x"], fetch_names=[a.name])
+    assert "PTA004" in r.codes()
+    assert all(f.severity == "warning" for f in r.by_code("PTA004"))
+
+
+def test_pta004_negative_single_writer():
+    main, startup, x, y, pred, loss = _clean_net()
+    r = analysis.verify(main, feed_names=["x", "y"],
+                        fetch_names=[loss.name, pred.name])
+    assert "PTA004" not in r.codes()
+
+
+def test_pta005_double_write():
+    main, a = _double_write_net()
+    r = analysis.verify(main, feed_names=["x"], fetch_names=[a.name])
+    (f,) = r.by_code("PTA005")
+    assert f.var == a.name and f.severity == "warning"
+
+
+def test_pta005_negative_clean_net():
+    main, startup, x, y, pred, loss = _clean_net()
+    r = analysis.verify(main, feed_names=["x", "y"],
+                        fetch_names=[loss.name])
+    assert "PTA005" not in r.codes()
+
+
+# ---------------------------------------------------------------------------
+# shape/dtype family (PTA10x)
+# ---------------------------------------------------------------------------
+
+
+def test_pta101_shape_mismatch():
+    main, startup, bad = _bad_matmul_net()
+    r = analysis.verify(main, feed_shapes={"a": (4, 13)},
+                        feed_dtypes={"a": "float32"},
+                        fetch_names=[bad.name])
+    (f,) = r.by_code("PTA101")
+    assert f.op_type == "matmul" and f.severity == "error"
+    assert "contracting" in f.message
+
+
+def test_pta101_negative_clean_net():
+    main, startup, x, y, pred, loss = _clean_net()
+    r = analysis.verify(main,
+                        feed_shapes={"x": (4, 13), "y": (4, 1)},
+                        feed_dtypes={"x": "float32", "y": "float32"},
+                        fetch_names=[loss.name])
+    assert "PTA101" not in r.codes()
+
+
+def test_pta102_dtype_mismatch():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xf = fluid.data("xf", [4], dtype="float32")
+        xi = fluid.data("xi", [4], dtype="int64")
+        out = fluid.layers.elementwise_add(xf, xi)
+    r = analysis.verify(main, fetch_names=[out.name])
+    (f,) = r.by_code("PTA102")
+    assert f.var == xi.name and f.severity == "error"
+    assert f.op_type == "elementwise_add"
+
+
+def test_pta102_negative_same_class():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xf = fluid.data("xf", [4], dtype="float32")
+        yf = fluid.data("yf", [4], dtype="float32")
+        out = fluid.layers.elementwise_add(xf, yf)
+    r = analysis.verify(main, fetch_names=[out.name])
+    assert "PTA102" not in r.codes()
+
+
+def test_pta103_nonfloat_grad_path():
+    main, startup, x, y, pred, loss = _clean_net()
+    main.global_block().create_var(
+        name="wi", shape=[4], dtype="int32", persistable=True)
+    main._params_grads = [("wi", "wi@GRAD")]
+    r = analysis.verify(main, families=["shapes"])
+    (f,) = r.by_code("PTA103")
+    assert f.var == "wi" and f.severity == "error"
+
+
+def test_pta103_negative_float_grads():
+    main, startup, x, y, pred, loss = _clean_net()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    assert getattr(main, "_params_grads", None)  # minimize recorded them
+    r = analysis.verify(main, families=["shapes"])
+    assert "PTA103" not in r.codes()
+
+
+# ---------------------------------------------------------------------------
+# sharding & collective family (PTA20x)
+# ---------------------------------------------------------------------------
+
+
+def test_pta201_feed_batch_not_divisible():
+    main, startup, x, y, pred, loss = _clean_net()
+    r = analysis.verify(
+        main, mesh=AbstractMesh({"dp": 3}), policy=DataParallelPolicy(),
+        feed_shapes={"x": (4, 13), "y": (4, 1)},
+        feed_dtypes={"x": "float32", "y": "float32"},
+        fetch_names=[loss.name])
+    finds = r.by_code("PTA201")
+    assert finds and all(f.severity == "warning" for f in finds)
+    assert {f.var for f in finds} == {"x", "y"}
+
+
+def test_pta201_optimizer_state_not_divisible():
+    main, startup, x, y, pred, loss = _clean_net()
+    v = main.global_block().create_var(
+        name="moment_odd", shape=[13], dtype="float32", persistable=True)
+    v.is_optimizer_state = True
+    r = analysis.verify(main, mesh=AbstractMesh({"dp": 2}),
+                        policy=Zero1Policy(), families=["sharding"])
+    assert any(f.var == "moment_odd" for f in r.by_code("PTA201"))
+
+
+def test_pta201_negative_divisible_batch():
+    main, startup, x, y, pred, loss = _clean_net()
+    r = analysis.verify(
+        main, mesh=AbstractMesh({"dp": 4}), policy=DataParallelPolicy(),
+        feed_shapes={"x": (8, 13), "y": (8, 1)},
+        feed_dtypes={"x": "float32", "y": "float32"},
+        fetch_names=[loss.name])
+    assert "PTA201" not in r.codes()
+
+
+def test_pta202_stage_count_vs_mesh():
+    main, h, loss = _pipeline_net()
+    policy = PipelinePolicy(cut_vars=[h.name], num_microbatches=2)
+    r = analysis.verify(main, mesh=AbstractMesh({"pp": 4}), policy=policy,
+                        families=["sharding"])
+    finds = r.by_code("PTA202")
+    assert finds and all(f.severity == "error" for f in finds)
+    assert any("!= pipeline stages" in f.message for f in finds)
+
+
+def test_pta202_negative_matching_stages():
+    main, h, loss = _pipeline_net()
+    policy = PipelinePolicy(cut_vars=[h.name], num_microbatches=2)
+    r = analysis.verify(main, mesh=AbstractMesh({"pp": 2}), policy=policy,
+                        families=["sharding"])
+    assert "PTA202" not in r.codes()
+
+
+def _cast_pipeline_net(cut_dtype):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], dtype="float32")
+        h = fluid.layers.fc(x, 4)
+        c = fluid.layers.cast(h, cut_dtype)
+        f2 = fluid.layers.cast(c, "float32")
+        out = fluid.layers.fc(f2, 1)
+    return main, h, c, out
+
+
+def test_pta203_nonfloat_boundary():
+    main, h, c, out = _cast_pipeline_net("int32")
+    policy = PipelinePolicy(cut_vars=[c.name], num_microbatches=2)
+    r = analysis.verify(main, mesh=AbstractMesh({"pp": 2}), policy=policy,
+                        families=["sharding"])
+    (f,) = r.by_code("PTA203")
+    assert f.var == c.name and f.severity == "error"
+
+
+def test_pta203_negative_float_boundary():
+    main, h, c, out = _cast_pipeline_net("int32")
+    policy = PipelinePolicy(cut_vars=[h.name], num_microbatches=2)
+    r = analysis.verify(main, mesh=AbstractMesh({"pp": 2}), policy=policy,
+                        families=["sharding"])
+    assert "PTA203" not in r.codes()
+
+
+def test_pta204_quant_ineligible_payloads():
+    main, startup, x, y, pred, loss = _clean_net()
+    blk = main.global_block()
+    blk.create_var(name="p_f", shape=[4], dtype="float32",
+                   persistable=True)
+    blk.create_var(name="g_int", shape=[4], dtype="int32")
+    blk.create_var(name="g_dgc", shape=[4], dtype="float32")
+    main._params_grads = [("p_f", "g_int"), ("p_f", "g_dgc")]
+    main._dgc_encoded = {"g_dgc": True}
+    r = analysis.verify(main, mesh=AbstractMesh({"dp": 2}),
+                        quant_hook=True, families=["sharding"])
+    finds = r.by_code("PTA204")
+    assert {f.var for f in finds} == {"g_int", "g_dgc"}
+    assert all(f.severity == "warning" for f in finds)
+
+
+def test_pta204_negative_float_grads():
+    main, startup, x, y, pred, loss = _clean_net()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    r = analysis.verify(main, mesh=AbstractMesh({"dp": 2}),
+                        policy=DataParallelPolicy(), quant_hook=True,
+                        families=["sharding"])
+    assert "PTA204" not in r.codes()
+
+
+def _with_collective(ring_id):
+    main, startup, x, y, pred, loss = _clean_net()
+    main.global_block().append_op(
+        "c_allreduce_sum", inputs={"X": [pred.name]},
+        outputs={"Out": [pred.name]}, attrs={"ring_id": ring_id})
+    return main
+
+
+def test_pta205_unmapped_ring_warns():
+    main = _with_collective(ring_id=7)
+    r = analysis.verify(main, families=["sharding"])
+    (f,) = r.by_code("PTA205")
+    assert f.severity == "warning" and "ring_id=7" in f.message
+
+
+def test_pta205_ring_maps_to_absent_axis():
+    main = _with_collective(ring_id=7)
+    saved = dict(pmesh._ring_axes)
+    try:
+        pmesh.set_ring_axis(7, pmesh.MODEL_AXIS)
+        r = analysis.verify(main, mesh=AbstractMesh({"dp": 2}),
+                            families=["sharding"])
+        (f,) = r.by_code("PTA205")
+        assert f.severity == "error" and "unbound axis" in f.message
+    finally:
+        pmesh._ring_axes.clear()
+        pmesh._ring_axes.update(saved)
+
+
+def test_pta205_negative_mapped_ring():
+    main = _with_collective(ring_id=0)  # ring 0 maps to dp by default
+    r = analysis.verify(main, mesh=AbstractMesh({pmesh.DATA_AXIS: 2}),
+                        families=["sharding"])
+    assert "PTA205" not in r.codes()
+
+
+def test_pta206_mesh_factorization_error():
+    with pytest.raises(ValueError, match="PTA206") as ei:
+        pmesh.build_2d_mesh(model=3)  # 8 devices, 8 % 3 != 0
+    msg = str(ei.value)
+    assert "does not divide" in msg
+    assert "device_count=8" in msg and "mp=3" in msg
+
+
+def test_pta206_3d_variant_and_negative():
+    with pytest.raises(ValueError, match="PTA206"):
+        pmesh.build_3d_mesh(pp=3, model=1)
+    m = pmesh.build_2d_mesh(model=2)  # 8 = batch 4 x model 2: fine
+    assert dict(m.shape)[pmesh.MODEL_AXIS] == 2
+
+
+# ---------------------------------------------------------------------------
+# clean programs: the train and infer graphs verify with zero findings
+# ---------------------------------------------------------------------------
+
+
+def test_clean_train_program_zero_findings():
+    main, startup, x, y, pred, loss = _clean_net()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    r = analysis.verify(
+        main, mesh=AbstractMesh({"dp": 2}), policy=DataParallelPolicy(),
+        feed_shapes={"x": (8, 13), "y": (8, 1)},
+        feed_dtypes={"x": "float32", "y": "float32"},
+        fetch_names=[loss.name])
+    assert r.errors == [] and r.warnings == [], r.format()
+
+
+def test_clean_infer_clone_zero_findings():
+    main, startup, x, y, pred, loss = _clean_net()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    infer = main.clone(for_test=True)
+    r = analysis.verify(infer, feed_shapes={"x": (8, 13)},
+                        feed_dtypes={"x": "float32"},
+                        fetch_names=[pred.name])
+    assert r.errors == [] and r.warnings == [], r.format()
+
+
+def test_program_verify_method():
+    main, startup, x, y, pred, loss = _clean_net()
+    rep = main.verify()
+    assert isinstance(rep, analysis.Report) and rep.ok
+
+
+# ---------------------------------------------------------------------------
+# FLAGS_program_verify preflight: the acceptance regression — an opaque
+# dot_general trace failure becomes the named PTA101 diagnostic
+# ---------------------------------------------------------------------------
+
+
+def _flag_guard():
+    from paddle_tpu.fluid import flags as fl
+    return fl, fl.flag("program_verify")
+
+
+def test_preflight_raise_names_the_opaque_trace_failure():
+    fl, saved = _flag_guard()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"a": np.zeros((4, 13), "float32")}
+
+    def run_defect():
+        # fresh program per mode: the executor caches executables per
+        # program, and preflight rides only the cache-miss path
+        main, startup, bad = _bad_matmul_net()
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=[bad.name])
+
+    try:
+        # off: the defect surfaces as an opaque trace error deep in jax
+        fl.set_flags({"FLAGS_program_verify": "off"})
+        with pytest.raises(Exception) as opaque:
+            run_defect()
+        assert not isinstance(opaque.value, analysis.ProgramVerifyError)
+        assert "PTA101" not in str(opaque.value)
+        # raise: the SAME defect fails fast with the named diagnostic
+        fl.set_flags({"FLAGS_program_verify": "raise"})
+        with pytest.raises(analysis.ProgramVerifyError) as named:
+            run_defect()
+        msg = str(named.value)
+        assert "PTA101" in msg and "matmul" in msg
+        assert named.value.report.by_code("PTA101")
+    finally:
+        fl.set_flags({"FLAGS_program_verify": saved})
+
+
+def test_preflight_warn_mode_warns_once_per_program():
+    fl, saved = _flag_guard()
+    main, a = _double_write_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": np.zeros((4, 13), "float32")}
+    try:
+        fl.set_flags({"FLAGS_program_verify": "warn"})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            exe.run(main, feed=feed, fetch_list=[a.name])
+            exe.run(main, feed=feed, fetch_list=[a.name])
+        msgs = [x for x in w
+                if isinstance(x.message, analysis.ProgramVerifyWarning)]
+        assert len(msgs) == 1  # once per (program, lane), not per run
+        assert "PTA005" in str(msgs[0].message)
+    finally:
+        fl.set_flags({"FLAGS_program_verify": saved})
+
+
+def test_preflight_strict_raises_on_warning_severity():
+    fl, saved = _flag_guard()
+    main, a = _double_write_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": np.zeros((4, 13), "float32")}
+    try:
+        fl.set_flags({"FLAGS_program_verify": "strict"})
+        with pytest.raises(analysis.ProgramVerifyError) as ei:
+            exe.run(main, feed=feed, fetch_list=[a.name])
+        assert "PTA005" in str(ei.value)
+    finally:
+        fl.set_flags({"FLAGS_program_verify": saved})
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(*args):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "analyze_program.py"),
+         *args],
+        capture_output=True, text=True, timeout=600, env=env)
+
+
+def test_analyze_program_cli_zoo_subset_clean():
+    """The `make analyze` IR gate: zoo programs verify strictly clean."""
+    r = _run_cli("--zoo", "fit_a_line,mlp", "--mesh", "dp=4", "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "analyze_program: OK" in r.stdout
+
+
+def test_analyze_program_cli_flags_saved_defect(tmp_path):
+    main, startup, bad = _bad_matmul_net()
+    path = tmp_path / "bad.json"
+    fluid.io.save_program(main, str(path))
+    r = _run_cli(str(path), "--fetch", bad.name)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "[PTA101]" in r.stdout and "matmul" in r.stdout
+
+
+def test_preflight_silent_on_info_only_findings():
+    fl, saved = _flag_guard()
+    main, startup, x, y, pred, loss = _clean_net()
+    with fluid.program_guard(main, startup):
+        extra = fluid.layers.scale(pred, scale=2.0)  # dead: info-only
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.zeros((4, 13), "float32"),
+            "y": np.zeros((4, 1), "float32")}
+    try:
+        fl.set_flags({"FLAGS_program_verify": "strict"})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            exe.run(main, feed=feed, fetch_list=[loss.name])
+        assert not [x for x in w
+                    if isinstance(x.message,
+                                  analysis.ProgramVerifyWarning)]
+    finally:
+        fl.set_flags({"FLAGS_program_verify": saved})

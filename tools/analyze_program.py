@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Analyze a Fluid Program with the static verifier (paddle_tpu/analysis).
+
+Runs the three analysis families — dataflow, shape/dtype propagation,
+sharding/collective legality — over saved programs (the
+``fluid.io.save_program`` JSON format) or the built-in model zoo, and
+reports through the shared lint findings schema (tools/lintlib.py):
+one ``<program>:<op_idx>: [PTAxxx] message`` line per finding, the
+``lint_*`` epilogue, exit 1 when findings at the gating severity exist.
+
+The diagnostic catalog (codes, severities, remediation) is documented
+in docs/ANALYSIS.md; programmatic use goes through
+``paddle_tpu.analysis.verify`` / ``Program.verify()``.
+
+Usage:
+  python tools/analyze_program.py saved_program.json [more.json ...]
+  python tools/analyze_program.py --zoo all
+  python tools/analyze_program.py --zoo mlp,resnet18 --mesh dp=4,mp=2 \
+      --policy tp
+  python tools/analyze_program.py prog.json --fetch loss --strict
+
+Options:
+  --zoo NAMES        comma-separated zoo builders (or ``all``); each is
+                     verified twice: the train graph (SGD attached, loss
+                     fetched) and its ``clone(for_test=True)`` infer
+                     program
+  --mesh SPEC        abstract mesh axes, e.g. ``dp=4`` / ``dp=2,mp=2`` /
+                     ``pp=2,dp=2,mp=2`` — enables the sharding family's
+                     divisibility/pipeline checks without any devices
+  --policy NAME      data | zero1 | tp | pipeline  (default: data when
+                     --mesh is given)
+  --fetch NAMES      comma-separated fetch targets for saved programs
+                     (default: the last op's outputs)
+  --families LIST    subset of dataflow,shapes,sharding (default: all)
+  --strict           exit 1 on warning-severity findings too (errors
+                     always gate); info findings never gate
+  --quant-hook       check quantized-collective (PTA204) eligibility
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import lintlib
+
+REPO = lintlib.REPO
+sys.path.insert(0, str(REPO))
+
+ZOO = {}  # name -> () -> (main_program, fetch_names, infer_fetch)
+
+
+def _register_zoo():
+    from paddle_tpu import fluid
+    from paddle_tpu.models import (bert, densenet, googlenet, gpt, mlp,
+                                   mobilenet, resnet, se_resnext,
+                                   transformer, vgg)
+
+    small = dict(class_dim=10, image_shape=(3, 32, 32))
+    builders = {
+        "fit_a_line": mlp.build_fit_a_line,
+        "mlp": mlp.build_mlp,
+        "conv_net": mlp.build_conv_net,
+        "resnet18": lambda: resnet.build_resnet(depth=18, **small),
+        "vgg16": lambda: vgg.build_vgg(depth=16, **small),
+        "densenet": lambda: densenet.build_densenet(depth=121, **small),
+        "googlenet": lambda: googlenet.build_googlenet(**small),
+        "mobilenet": lambda: mobilenet.build_mobilenet(**small),
+        "se_resnext": lambda: se_resnext.build_se_resnext(depth=50,
+                                                          **small),
+        "bert_tiny": lambda: bert.build_bert_pretrain(
+            bert.BertConfig.tiny()),
+        "gpt_tiny": lambda: gpt.build_gpt_lm(gpt.GPTConfig.tiny()),
+        "transformer_nmt": transformer.build_transformer_nmt,
+    }
+
+    def make(name, build):
+        def thunk():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                out = build()
+                loss = (out[2] if isinstance(out, tuple) and len(out) > 2
+                        else out[1])
+                fluid.optimizer.SGDOptimizer(
+                    learning_rate=0.01).minimize(loss)
+            infer_target = (out[1] if isinstance(out, tuple)
+                            and len(out) > 2 else loss)
+            return main, [loss.name], [infer_target.name]
+        return thunk
+
+    for name, build in builders.items():
+        ZOO[name] = make(name, build)
+
+
+def _parse_mesh(spec):
+    from paddle_tpu.analysis import AbstractMesh
+    axes = {}
+    for part in spec.split(","):
+        axis, _, size = part.partition("=")
+        axes[axis.strip()] = int(size)
+    return AbstractMesh(axes)
+
+
+def _make_policy(name, mesh):
+    from paddle_tpu.parallel.gspmd import (DataParallelPolicy,
+                                           TensorParallelPolicy,
+                                           Zero1Policy)
+    if name in (None, "data"):
+        return DataParallelPolicy()
+    if name == "zero1":
+        return Zero1Policy()
+    if name == "tp":
+        return TensorParallelPolicy()
+    if name == "pipeline":
+        from paddle_tpu.parallel.gspmd.pipeline_policy import PipelinePolicy
+        return PipelinePolicy()
+    raise SystemExit(f"unknown --policy {name!r} "
+                     f"(data | zero1 | tp | pipeline)")
+
+
+def _to_lint_findings(label, report):
+    out = []
+    for f in report.findings:
+        where = []
+        if f.op_type:
+            where.append(f.op_type)
+        if f.var:
+            where.append(f"var {f.var!r}")
+        loc = f" ({', '.join(where)})" if where else ""
+        out.append(lintlib.Finding(
+            label, f.op_idx if f.op_idx is not None else 0, f.code,
+            f"[{f.severity}] {f.message}{loc}"))
+    return out
+
+
+def _analyze(label, program, fetch_names, mesh, policy, families,
+             quant_hook):
+    from paddle_tpu import analysis
+    return analysis.verify(
+        program, mesh=mesh, policy=policy, fetch_names=fetch_names,
+        quant_hook=quant_hook,
+        families=families.split(",") if families else None)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    opts = {"zoo": None, "mesh": None, "policy": None, "fetch": None,
+            "families": None}
+    strict = quant_hook = False
+    paths = []
+    it = iter(argv)
+    for a in it:
+        if a == "--strict":
+            strict = True
+        elif a == "--quant-hook":
+            quant_hook = True
+        elif a.startswith("--") and a.lstrip("-").split("=")[0] in opts:
+            key, eq, val = a.lstrip("-").partition("=")
+            opts[key] = val if eq else next(it, None)
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        else:
+            paths.append(a)
+    if not paths and not opts["zoo"]:
+        print(__doc__)
+        return 2
+
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from paddle_tpu.fluid import io as fio
+
+    mesh = _parse_mesh(opts["mesh"]) if opts["mesh"] else None
+    policy = _make_policy(opts["policy"], mesh) if (
+        opts["policy"] or mesh) else None
+
+    jobs = []  # (label, program, fetch_names)
+    for p in paths:
+        prog = fio.load_program(p)
+        fetch = opts["fetch"].split(",") if opts["fetch"] else None
+        jobs.append((Path(p).name, prog, fetch))
+    if opts["zoo"]:
+        _register_zoo()
+        names = (sorted(ZOO) if opts["zoo"] == "all"
+                 else [n.strip() for n in opts["zoo"].split(",")])
+        unknown = [n for n in names if n not in ZOO]
+        if unknown:
+            raise SystemExit(
+                f"unknown zoo model(s) {unknown}; have: {sorted(ZOO)}")
+        for name in names:
+            main_prog, fetch, infer_fetch = ZOO[name]()
+            jobs.append((name, main_prog, fetch))
+            jobs.append((f"{name}.infer", main_prog.clone(for_test=True),
+                         infer_fetch))
+
+    findings, gating = [], 0
+    for label, prog, fetch in jobs:
+        report = _analyze(label, prog, fetch, mesh, policy,
+                          opts["families"], quant_hook)
+        findings.extend(_to_lint_findings(label, report))
+        gating += len(report.errors) + (len(report.warnings) if strict
+                                        else 0)
+    lintlib.print_findings(findings)
+    if gating:
+        print(f"\nanalyze_program: {gating} gating finding(s) "
+              f"({len(findings)} total) in {len(jobs)} program(s)")
+        return 1
+    print(f"analyze_program: OK ({len(jobs)} programs, "
+          f"{len(findings)} info finding(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

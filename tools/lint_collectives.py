@@ -43,9 +43,10 @@ Suppress a deliberate finding with ``# collective: allow`` on the same
 line or the line above (e.g. the ring-attention kernel's own ppermute
 ring, which rotates fp K/V blocks — payloads the quantized wire format
 must not touch).  Exit 0 when clean, 1 with findings (one per line:
-``path:lineno: [check] message``).
+``path:lineno: [check] message``).  Walker/allow-mark/baseline
+mechanics live in tools/lintlib.py.
 
-Usage: python tools/lint_collectives.py [paths...]
+Usage: python tools/lint_collectives.py [--baseline=FILE] [paths...]
   (no args = paddle_tpu/, repo-relative)
 """
 
@@ -55,7 +56,9 @@ import ast
 import sys
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
+import lintlib
+
+REPO = lintlib.REPO
 
 DEFAULT_TARGETS = ["paddle_tpu"]
 
@@ -92,10 +95,7 @@ ALLOW_MARK = "collective: allow"
 
 def _allowed(src_lines, lineno):
     """Marker accepted on the flagged line or the line directly above."""
-    for ln in (lineno - 1, lineno - 2):
-        if 0 <= ln < len(src_lines) and ALLOW_MARK in src_lines[ln]:
-            return True
-    return False
+    return lintlib.allowed(src_lines, lineno, ALLOW_MARK)
 
 
 def _call_name(node):
@@ -108,47 +108,43 @@ def _call_name(node):
     return None
 
 
+def _rules(sharding_exempt):
+    def raw_calls(node):
+        if not isinstance(node, ast.Call):
+            return
+        name = _call_name(node)
+        if isinstance(node.func, ast.Attribute) and name in RAW_COLLECTIVES:
+            yield (node.lineno, "raw-collective",
+                   f"raw {name}() outside the kernels layer — route "
+                   "through kernels/ring_collectives.py (quantized wire "
+                   "format, algorithm selection, wire-bytes accounting) "
+                   f"or mark a deliberate site `# {ALLOW_MARK}`")
+        elif not sharding_exempt and name in RAW_SHARDING:
+            yield (node.lineno, "raw-sharding",
+                   f"raw {name}() outside the gspmd layer — sharding "
+                   "placement is policy: route through "
+                   "parallel/gspmd/specs.py (named_sharding/constrain, "
+                   "axis aliases, resharding accounting) or mark a "
+                   f"deliberate site `# {ALLOW_MARK}`")
+
+    def raw_imports(node):
+        if not isinstance(node, ast.ImportFrom) or sharding_exempt:
+            return
+        for alias in node.names:
+            if alias.name in RAW_SHARDING:
+                yield (node.lineno, "raw-sharding",
+                       f"import of {alias.name} outside the gspmd "
+                       "layer — sharding placement is policy: route "
+                       "through parallel/gspmd/specs.py or mark a "
+                       f"deliberate site `# {ALLOW_MARK}`")
+
+    return (raw_calls, raw_imports)
+
+
 def check_source(src: str, path: str = "<string>",
                  sharding_exempt: bool = False):
     """Lint one file's source; returns [(path, lineno, check, message)]."""
-    findings = []
-    lines = src.splitlines()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, "parse-error", str(e))]
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            name = _call_name(node)
-            if (isinstance(node.func, ast.Attribute)
-                    and name in RAW_COLLECTIVES
-                    and not _allowed(lines, node.lineno)):
-                findings.append(
-                    (path, node.lineno, "raw-collective",
-                     f"raw {name}() outside the kernels layer — route "
-                     "through kernels/ring_collectives.py (quantized wire "
-                     "format, algorithm selection, wire-bytes accounting) "
-                     f"or mark a deliberate site `# {ALLOW_MARK}`"))
-            elif (not sharding_exempt and name in RAW_SHARDING
-                    and not _allowed(lines, node.lineno)):
-                findings.append(
-                    (path, node.lineno, "raw-sharding",
-                     f"raw {name}() outside the gspmd layer — sharding "
-                     "placement is policy: route through "
-                     "parallel/gspmd/specs.py (named_sharding/constrain, "
-                     "axis aliases, resharding accounting) or mark a "
-                     f"deliberate site `# {ALLOW_MARK}`"))
-        elif (isinstance(node, ast.ImportFrom) and not sharding_exempt):
-            for alias in node.names:
-                if alias.name in RAW_SHARDING \
-                        and not _allowed(lines, node.lineno):
-                    findings.append(
-                        (path, node.lineno, "raw-sharding",
-                         f"import of {alias.name} outside the gspmd "
-                         "layer — sharding placement is policy: route "
-                         "through parallel/gspmd/specs.py or mark a "
-                         f"deliberate site `# {ALLOW_MARK}`"))
-    return findings
+    return lintlib.scan(src, path, _rules(sharding_exempt), ALLOW_MARK)
 
 
 def _exempt(rel_str: str) -> bool:
@@ -156,11 +152,7 @@ def _exempt(rel_str: str) -> bool:
 
 
 def check_file(path: Path):
-    rel = path.resolve()
-    try:
-        rel_str = str(rel.relative_to(REPO))
-    except ValueError:
-        rel_str = str(path)
+    rel_str = lintlib.rel_path(path)
     if _exempt(rel_str):
         return []
     return check_source(path.read_text(encoding="utf-8"), rel_str,
@@ -168,6 +160,7 @@ def check_file(path: Path):
 
 
 def main(argv):
+    argv, baseline = lintlib.split_baseline_arg(argv)
     targets = argv or DEFAULT_TARGETS
     findings = []
     for t in targets:
@@ -175,8 +168,8 @@ def main(argv):
         files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
         for f in files:
             findings.extend(check_file(f))
-    for path, lineno, check, msg in findings:
-        print(f"{path}:{lineno}: [{check}] {msg}")
+    findings = lintlib.apply_baseline(findings, baseline)
+    lintlib.print_findings(findings)
     if findings:
         print(f"{len(findings)} finding(s)")
         return 1

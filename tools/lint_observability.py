@@ -29,7 +29,8 @@ observability package (the audited implementations live there).
 
 Suppress a deliberate finding with `# observability: allow` on the same
 line or the line above.  Exit 0 when clean, 1 with findings (one per
-line: `path:lineno: [check] message`).
+line: `path:lineno: [check] message`).  Walker/allow-mark/baseline
+mechanics live in tools/lintlib.py.
 
 This module is also the shared metric-name scanner: `iter_metric_names`
 statically collects every ``pt_*`` family name registered through
@@ -38,7 +39,7 @@ docs/OBSERVABILITY.md inventory-consistency test
 (tests/test_metrics_inventory.py) diffs it against the doc table in
 both directions.
 
-Usage: python tools/lint_observability.py [paths...]
+Usage: python tools/lint_observability.py [--baseline=FILE] [paths...]
   (no args = paddle_tpu/, repo-relative)
 """
 
@@ -48,7 +49,9 @@ import ast
 import sys
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
+import lintlib
+
+REPO = lintlib.REPO
 
 DEFAULT_TARGETS = ["paddle_tpu"]
 
@@ -70,10 +73,7 @@ _TIME_MODULE_ALIASES = ("time", "_time")
 
 def _allowed(src_lines, lineno):
     """Marker accepted on the flagged line or the line directly above."""
-    for ln in (lineno - 1, lineno - 2):
-        if 0 <= ln < len(src_lines) and ALLOW_MARK in src_lines[ln]:
-            return True
-    return False
+    return lintlib.allowed(src_lines, lineno, ALLOW_MARK)
 
 
 def _is_raw_timing_call(node):
@@ -86,34 +86,31 @@ def _is_raw_timing_call(node):
             and node.func.value.id in _TIME_MODULE_ALIASES)
 
 
+def _rule_bare_print(node):
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "print":
+        yield (node.lineno, "bare-print",
+               "bare print() in library code — report through "
+               "observability.metrics/events or logging/warnings, or "
+               f"mark a deliberate CLI print `# {ALLOW_MARK}`")
+
+
+def _rule_raw_timing(node):
+    if _is_raw_timing_call(node):
+        yield (node.lineno, "raw-timing",
+               f"raw time.{node.func.attr}() timing in library code — "
+               "step/phase timing belongs on the audited "
+               "observability.profiling.step_phases timer (wall "
+               "timestamps on observability.events); mark a "
+               f"deliberate raw site `# {ALLOW_MARK}`")
+
+
+_RULES = (_rule_bare_print, _rule_raw_timing)
+
+
 def check_source(src: str, path: str = "<string>"):
     """Lint one file's source; returns [(path, lineno, check, message)]."""
-    findings = []
-    lines = src.splitlines()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, "parse-error", str(e))]
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Name) and \
-                node.func.id == "print" and \
-                not _allowed(lines, node.lineno):
-            findings.append(
-                (path, node.lineno, "bare-print",
-                 "bare print() in library code — report through "
-                 "observability.metrics/events or logging/warnings, or "
-                 f"mark a deliberate CLI print `# {ALLOW_MARK}`"))
-        elif _is_raw_timing_call(node) and \
-                not _allowed(lines, node.lineno):
-            findings.append(
-                (path, node.lineno, "raw-timing",
-                 f"raw time.{node.func.attr}() timing in library code — "
-                 "step/phase timing belongs on the audited "
-                 "observability.profiling.step_phases timer (wall "
-                 "timestamps on observability.events); mark a "
-                 f"deliberate raw site `# {ALLOW_MARK}`"))
-    return findings
+    return lintlib.scan(src, path, _RULES, ALLOW_MARK)
 
 
 # ---------------------------------------------------------------------------
@@ -175,43 +172,27 @@ def _exempt(rel_str: str) -> bool:
 
 
 def check_file(path: Path):
-    rel = path.resolve()
-    try:
-        rel_str = str(rel.relative_to(REPO))
-    except ValueError:
-        rel_str = str(rel)
+    rel_str = lintlib.rel_path(path)
     if _exempt(rel_str):
         return []
     return check_source(path.read_text(), str(path))
 
 
 def iter_files(targets):
-    for t in targets:
-        p = Path(t)
-        if not p.is_absolute():
-            p = REPO / p
-        if p.is_dir():
-            yield from sorted(p.rglob("*.py"))
-        elif p.suffix == ".py":
-            yield p
+    return lintlib.iter_py_files(targets)
 
 
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    argv, baseline = lintlib.split_baseline_arg(argv)
     targets = argv or DEFAULT_TARGETS
     findings = []
     n_files = 0
     for f in iter_files(targets):
         n_files += 1
         findings.extend(check_file(f))
-    for path, lineno, check, msg in findings:
-        print(f"{path}:{lineno}: [{check}] {msg}")
-    if findings:
-        print(f"\nlint_observability: {len(findings)} finding(s) in "
-              f"{n_files} file(s)")
-        return 1
-    print(f"lint_observability: OK ({n_files} files clean)")
-    return 0
+    findings = lintlib.apply_baseline(findings, baseline)
+    return lintlib.summarize("lint_observability", findings, n_files)
 
 
 if __name__ == "__main__":

@@ -33,9 +33,10 @@ materializes programs in the first place):
 
 Suppress a deliberate finding with ``# pass: allow`` on the same line or
 the line above.  Exit 0 when clean, 1 with findings (one per line:
-``path:lineno: [check] message``).
+``path:lineno: [check] message``).  Walker/allow-mark/baseline
+mechanics live in tools/lintlib.py.
 
-Usage: python tools/lint_passes.py [paths...]
+Usage: python tools/lint_passes.py [--baseline=FILE] [paths...]
   (no args = paddle_tpu/, repo-relative)
 """
 
@@ -45,7 +46,9 @@ import ast
 import sys
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
+import lintlib
+
+REPO = lintlib.REPO
 
 DEFAULT_TARGETS = ["paddle_tpu"]
 
@@ -76,10 +79,7 @@ ALLOW_MARK = "pass: allow"
 
 
 def _allowed(lines, lineno):
-    for ln in (lineno, lineno - 1):
-        if 1 <= ln <= len(lines) and ALLOW_MARK in lines[ln - 1]:
-            return True
-    return False
+    return lintlib.allowed(lines, lineno, ALLOW_MARK)
 
 
 def _is_ops_attr(node):
@@ -92,40 +92,39 @@ def _is_ops_attr(node):
                      and node.value.id == "self"))
 
 
+def _rule_mutation(node):
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if _is_ops_attr(t):
+                yield (node.lineno, "program-mutation",
+                       "assignment to a block's .ops list outside the "
+                       "pass framework")
+    elif isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in ("_insert_op", "_remove_op"):
+                yield (node.lineno, "program-mutation",
+                       f"{f.attr}() outside the pass framework")
+            elif f.attr in MUTATORS and _is_ops_attr(f.value):
+                yield (node.lineno, "program-mutation",
+                       f".ops.{f.attr}() outside the pass framework")
+
+
 def lint_file(path: Path, rel: str):
     try:
         src = path.read_text()
         tree = ast.parse(src)
     except (OSError, SyntaxError) as e:  # pragma: no cover
         return [f"{rel}:0: [parse] {e}"]
-    lines = src.splitlines()
-    findings = []
-
-    def flag(node, msg):
-        if not _allowed(lines, node.lineno):
-            findings.append(f"{rel}:{node.lineno}: [program-mutation] "
-                            f"{msg}")
-
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.Assign, ast.AugAssign)):
-            targets = (node.targets if isinstance(node, ast.Assign)
-                       else [node.target])
-            for t in targets:
-                if _is_ops_attr(t):
-                    flag(node, "assignment to a block's .ops list "
-                               "outside the pass framework")
-        elif isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Attribute):
-                if f.attr in ("_insert_op", "_remove_op"):
-                    flag(node, f"{f.attr}() outside the pass framework")
-                elif f.attr in MUTATORS and _is_ops_attr(f.value):
-                    flag(node, f".ops.{f.attr}() outside the pass "
-                               "framework")
-    return findings
+    findings = lintlib.scan_tree(tree, src.splitlines(), rel,
+                                 (_rule_mutation,), ALLOW_MARK)
+    return [lintlib.format_finding(f) for f in findings]
 
 
 def main(argv):
+    argv, baseline = lintlib.split_baseline_arg(argv)
     targets = argv or DEFAULT_TARGETS
     findings = []
     for t in targets:
@@ -138,6 +137,10 @@ def main(argv):
                     or rel in EXEMPT_FILES:
                 continue
             findings.extend(lint_file(f, rel))
+    if baseline:
+        # lint_passes findings are pre-formatted lines; match on prefix
+        findings = [line for line in findings
+                    if not any(line.startswith(k) for k in baseline)]
     for line in findings:
         print(line)
     return 1 if findings else 0

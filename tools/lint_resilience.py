@@ -39,9 +39,10 @@ distributed layer):
 
 Suppress a deliberate finding with `# resilience: allow` on the same
 line.  Exit 0 when clean, 1 with findings (one per line:
-`path:lineno: [check] message`).
+`path:lineno: [check] message`).  Walker/allow-mark/baseline mechanics
+live in tools/lintlib.py.
 
-Usage: python tools/lint_resilience.py [paths...]
+Usage: python tools/lint_resilience.py [--baseline=FILE] [paths...]
   (no args = the default target sets, repo-relative)
 """
 
@@ -51,7 +52,9 @@ import ast
 import sys
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
+import lintlib
+
+REPO = lintlib.REPO
 
 DEFAULT_TARGETS = [
     "paddle_tpu/distributed",
@@ -82,85 +85,7 @@ ALLOW_MARK = "resilience: allow"
 
 def _allowed(src_lines, lineno):
     """Marker accepted on the flagged line or the line directly above."""
-    for ln in (lineno - 1, lineno - 2):
-        if 0 <= ln < len(src_lines) and ALLOW_MARK in src_lines[ln]:
-            return True
-    return False
-
-
-def check_source(src: str, path: str = "<string>"):
-    """Lint one file's source; returns [(path, lineno, check, message)]."""
-    findings = []
-    lines = src.splitlines()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, "parse-error", str(e))]
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler):
-            if len(node.body) == 1 and isinstance(node.body[0], ast.Pass) \
-                    and not _allowed(lines, node.body[0].lineno) \
-                    and not _allowed(lines, node.lineno):
-                what = (ast.unparse(node.type) if node.type is not None
-                        else "bare")
-                findings.append(
-                    (path, node.lineno, "except-pass",
-                     f"`except {what}: pass` swallows the failure — "
-                     f"record it (resilience.record), log it, or "
-                     f"re-raise"))
-        elif isinstance(node, ast.Call):
-            func = node.func
-            if isinstance(func, ast.Attribute) and \
-                    func.attr in WAIT_NAMES and \
-                    not node.args and not node.keywords and \
-                    not _allowed(lines, node.lineno):
-                findings.append(
-                    (path, node.lineno, "unbounded-wait",
-                     f".{func.attr}() with no timeout can block forever "
-                     f"behind a dead peer — pass a timeout or mark the "
-                     f"line `# {ALLOW_MARK}`"))
-        elif isinstance(node, ast.Expr) and _is_signal_signal(node.value) \
-                and not _allowed(lines, node.lineno):
-            # the registration is a bare statement: the previous handler
-            # (signal.signal's return value) is thrown away
-            findings.append(
-                (path, node.lineno, "signal-no-chain",
-                 "signal.signal(...) discards the previous handler — "
-                 "capture it and chain (the AutoCheckpoint/DrainHandler "
-                 "pattern), or mark a genuine restore-site with "
-                 f"`# {ALLOW_MARK}`"))
-    return findings
-
-
-def check_numeric_source(src: str, path: str = "<string>"):
-    """The raw-numeric-check lint for one file (callers skip files under
-    NUMERIC_EXEMPT): flag `np/jnp/numpy.isnan|isinf|isfinite` calls —
-    numeric-health logic must route through paddle_tpu.health.detect."""
-    findings = []
-    lines = src.splitlines()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, "parse-error", str(e))]
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        if not (isinstance(func, ast.Attribute)
-                and func.attr in NUMERIC_FNS
-                and isinstance(func.value, ast.Name)
-                and func.value.id in NUMERIC_MODULES):
-            continue
-        if _allowed(lines, node.lineno):
-            continue
-        findings.append(
-            (path, node.lineno, "raw-numeric-check",
-             f"raw {func.value.id}.{func.attr}() outside "
-             f"paddle_tpu/health/ — numeric-health checks must route "
-             f"through paddle_tpu.health.detect (one audited "
-             f"implementation), or mark a deliberate site "
-             f"`# {ALLOW_MARK}`"))
-    return findings
+    return lintlib.allowed(src_lines, lineno, ALLOW_MARK)
 
 
 def _is_signal_signal(node):
@@ -172,19 +97,75 @@ def _is_signal_signal(node):
             and node.func.value.id == "signal")
 
 
+def _rule_except_pass(node):
+    if isinstance(node, ast.ExceptHandler) and len(node.body) == 1 \
+            and isinstance(node.body[0], ast.Pass):
+        what = ast.unparse(node.type) if node.type is not None else "bare"
+        # the allow mark is accepted near the handler OR near the pass
+        yield ((node.lineno, node.body[0].lineno), "except-pass",
+               f"`except {what}: pass` swallows the failure — "
+               f"record it (resilience.record), log it, or "
+               f"re-raise")
+
+
+def _rule_unbounded_wait(node):
+    if isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in WAIT_NAMES \
+            and not node.args and not node.keywords:
+        yield (node.lineno, "unbounded-wait",
+               f".{node.func.attr}() with no timeout can block forever "
+               f"behind a dead peer — pass a timeout or mark the "
+               f"line `# {ALLOW_MARK}`")
+
+
+def _rule_signal_no_chain(node):
+    # the registration is a bare statement: the previous handler
+    # (signal.signal's return value) is thrown away
+    if isinstance(node, ast.Expr) and _is_signal_signal(node.value):
+        yield (node.lineno, "signal-no-chain",
+               "signal.signal(...) discards the previous handler — "
+               "capture it and chain (the AutoCheckpoint/DrainHandler "
+               "pattern), or mark a genuine restore-site with "
+               f"`# {ALLOW_MARK}`")
+
+
+_RULES = (_rule_except_pass, _rule_unbounded_wait, _rule_signal_no_chain)
+
+
+def _rule_raw_numeric(node):
+    if not isinstance(node, ast.Call):
+        return
+    func = node.func
+    if (isinstance(func, ast.Attribute) and func.attr in NUMERIC_FNS
+            and isinstance(func.value, ast.Name)
+            and func.value.id in NUMERIC_MODULES):
+        yield (node.lineno, "raw-numeric-check",
+               f"raw {func.value.id}.{func.attr}() outside "
+               f"paddle_tpu/health/ — numeric-health checks must route "
+               f"through paddle_tpu.health.detect (one audited "
+               f"implementation), or mark a deliberate site "
+               f"`# {ALLOW_MARK}`")
+
+
+def check_source(src: str, path: str = "<string>"):
+    """Lint one file's source; returns [(path, lineno, check, message)]."""
+    return lintlib.scan(src, path, _RULES, ALLOW_MARK)
+
+
+def check_numeric_source(src: str, path: str = "<string>"):
+    """The raw-numeric-check lint for one file (callers skip files under
+    NUMERIC_EXEMPT): flag `np/jnp/numpy.isnan|isinf|isfinite` calls —
+    numeric-health logic must route through paddle_tpu.health.detect."""
+    return lintlib.scan(src, path, (_rule_raw_numeric,), ALLOW_MARK)
+
+
 def check_file(path: Path):
     return check_source(path.read_text(), str(path))
 
 
 def iter_files(targets):
-    for t in targets:
-        p = Path(t)
-        if not p.is_absolute():
-            p = REPO / p
-        if p.is_dir():
-            yield from sorted(p.rglob("*.py"))
-        elif p.suffix == ".py":
-            yield p
+    return lintlib.iter_py_files(targets)
 
 
 def _numeric_exempt(path: Path):
@@ -197,6 +178,7 @@ def _numeric_exempt(path: Path):
 
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    argv, baseline = lintlib.split_baseline_arg(argv)
     targets = argv or DEFAULT_TARGETS
     findings = []
     n_files = 0
@@ -209,14 +191,8 @@ def main(argv=None):
                 continue
             n_files += 1
             findings.extend(check_numeric_source(f.read_text(), str(f)))
-    for path, lineno, check, msg in findings:
-        print(f"{path}:{lineno}: [{check}] {msg}")
-    if findings:
-        print(f"\nlint_resilience: {len(findings)} finding(s) in "
-              f"{n_files} file(s)")
-        return 1
-    print(f"lint_resilience: OK ({n_files} files clean)")
-    return 0
+    findings = lintlib.apply_baseline(findings, baseline)
+    return lintlib.summarize("lint_resilience", findings, n_files)
 
 
 if __name__ == "__main__":

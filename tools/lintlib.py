@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Shared plumbing for the source lints (tools/lint_*.py) and the API
+surface check — ONE findings schema, ONE AST walker, ONE allow-comment
+parser, ONE baseline mechanism.
+
+Each lint keeps its own domain knowledge (which nodes are violations,
+which modules are sanctioned, what the message teaches) and delegates
+the mechanics here:
+
+  Finding          (path, lineno, check, message) — a namedtuple, so it
+                   stays ==/index-compatible with the plain tuples the
+                   lints historically returned.  `check` is the stable
+                   machine-readable code (``raw-collective``,
+                   ``bare-print``, ...); the IR analyzer's PTA codes
+                   (paddle_tpu/analysis/findings.py) are the same idea
+                   one layer down.
+  scan()           parse + ast.walk + allow-mark filtering over a list
+                   of RULES — a rule is ``rule(node) -> iterable of
+                   (lineno, check, message)``; lineno may be a tuple of
+                   candidate lines when the allow mark is accepted in
+                   more than one place (except-pass bodies).
+  allowed()        the ``# <kind>: allow`` convention: the mark on the
+                   flagged line or the line directly above suppresses.
+  iter_py_files()  target expansion (dirs rglob *.py, files pass through)
+  summarize()      the two established CLI epilogues ("OK (n files
+                   clean)" / "N finding(s) in M file(s)") + exit code
+  baseline         ``load_baseline``/``apply_baseline`` + the
+                   ``--baseline=FILE`` CLI arg (``split_baseline_arg``):
+                   adopt a lint over legacy code by freezing today's
+                   findings instead of blanketing them with allow marks.
+
+A baseline file holds one suppression per line, either the exact
+``path:lineno: [check]`` prefix of a finding or the line-insensitive
+``path: [check]`` form (survives unrelated edits shifting line numbers).
+Blank lines and ``#`` comments are skipped.  Regenerate one with any
+lint's ``--baseline-write=FILE``-free output: the findings lines ARE
+valid baseline entries.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import namedtuple
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+Finding = namedtuple("Finding", ("path", "lineno", "check", "message"))
+
+
+# ---------------------------------------------------------------------------
+# allow-comment parsing
+# ---------------------------------------------------------------------------
+
+
+def allowed(src_lines, lineno, mark):
+    """True when ``mark`` appears on the flagged line or the line
+    directly above (``lineno`` is 1-based, as ast reports it)."""
+    for ln in (lineno - 1, lineno - 2):
+        if 0 <= ln < len(src_lines) and mark in src_lines[ln]:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the walker
+# ---------------------------------------------------------------------------
+
+
+def parse_tree(src, path):
+    """(tree, None) or (None, parse-error Finding)."""
+    try:
+        return ast.parse(src, filename=path), None
+    except SyntaxError as e:
+        return None, Finding(path, e.lineno or 0, "parse-error", str(e))
+
+
+def scan_tree(tree, src_lines, path, rules, mark):
+    """Walk ``tree`` applying each rule to each node; a hit is kept
+    unless the allow ``mark`` sits near any of its candidate lines."""
+    findings = []
+    for node in ast.walk(tree):
+        for rule in rules:
+            for lineno, check, message in (rule(node) or ()):
+                candidates = (lineno if isinstance(lineno, tuple)
+                              else (lineno,))
+                if any(allowed(src_lines, ln, mark) for ln in candidates):
+                    continue
+                findings.append(
+                    Finding(path, candidates[0], check, message))
+    return findings
+
+
+def scan(src, path, rules, mark):
+    """Lint one source string; returns [Finding] (a parse failure is
+    itself a finding, never an exception)."""
+    tree, err = parse_tree(src, path)
+    if err is not None:
+        return [err]
+    return scan_tree(tree, src.splitlines(), path, rules, mark)
+
+
+# ---------------------------------------------------------------------------
+# file iteration / paths
+# ---------------------------------------------------------------------------
+
+
+def iter_py_files(targets, repo=REPO):
+    for t in targets:
+        p = Path(t)
+        if not p.is_absolute():
+            p = repo / p
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def rel_path(path, repo=REPO):
+    """Repo-relative string for a path (absolute string if outside)."""
+    try:
+        return str(Path(path).resolve().relative_to(repo))
+    except ValueError:
+        return str(path)
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+
+def format_finding(f):
+    return f"{f.path}:{f.lineno}: [{f.check}] {f.message}"
+
+
+def print_findings(findings):
+    for f in findings:
+        print(format_finding(Finding(*f)))
+
+
+def summarize(name, findings, n_files):
+    """The named-epilogue style (lint_resilience/lint_observability):
+    prints findings + a one-line summary, returns the exit code."""
+    print_findings(findings)
+    if findings:
+        print(f"\n{name}: {len(findings)} finding(s) in "
+              f"{n_files} file(s)")
+        return 1
+    print(f"{name}: OK ({n_files} files clean)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# baselines
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path):
+    """Read a baseline file into a set of suppression keys."""
+    keys = set()
+    for raw in Path(path).read_text().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        # a full findings line is a valid entry: keep only the prefix
+        # up to (and including) the [check] token
+        end = line.find("]")
+        keys.add(line[:end + 1] if end != -1 else line)
+    return keys
+
+
+def apply_baseline(findings, baseline):
+    """Drop findings listed in the baseline (exact ``path:lineno:
+    [check]`` or line-insensitive ``path: [check]`` entries)."""
+    if not baseline:
+        return list(findings)
+    kept = []
+    for f in findings:
+        f = Finding(*f)
+        exact = f"{f.path}:{f.lineno}: [{f.check}]"
+        loose = f"{f.path}: [{f.check}]"
+        if exact not in baseline and loose not in baseline:
+            kept.append(f)
+    return kept
+
+
+def split_baseline_arg(argv):
+    """Pull a ``--baseline=FILE`` option out of a lint's argv; returns
+    (remaining_args, baseline_set_or_None)."""
+    rest, baseline = [], None
+    for a in argv:
+        if a.startswith("--baseline="):
+            baseline = load_baseline(a.split("=", 1)[1])
+        else:
+            rest.append(a)
+    return rest, baseline

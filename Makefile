@@ -3,10 +3,10 @@ PY ?= python
 REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 
 .PHONY: test test-book test-onchip bench bench-onchip int8-bench \
-	serve-bench decode-bench health-bench phase-bench pass-bench \
-	pipeline-bench recovery-drill recovery-bench \
+	serve-bench decode-bench ragged-bench health-bench phase-bench \
+	pass-bench pipeline-bench recovery-drill recovery-bench \
 	perf-compare lint-api lint-resilience lint-observability \
-	lint-collectives lint-passes analyze
+	lint-collectives lint-passes lint-kernels analyze
 
 test:            ## full suite on the 8-device virtual CPU mesh (~8 min)
 	$(PY) -m pytest tests/ -q --ignore=tests/book
@@ -32,6 +32,9 @@ serve-bench:     ## serving-engine load generator (throughput + p50/p99)
 
 decode-bench:    ## decode-lane load-gen: tokens/s vs naive, steady-state compiles==0, p99
 	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_DECODE=1 $(PY) bench.py
+
+ragged-bench:    ## bucketed-padded vs ragged serving A/B + modeled fp32/int8 KV bytes
+	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_RAGGED=1 $(PY) bench.py
 
 health-bench:    ## health-sentinel on/off A/B (overhead gate <=2% p50)
 	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_HEALTH=1 $(PY) bench.py
@@ -74,10 +77,14 @@ lint-collectives: ## raw psum/ppermute sites must route through the kernels laye
 lint-passes:     ## program mutation outside the pass framework / sanctioned transpilers
 	$(PY) tools/lint_passes.py
 
-analyze:         ## the whole static-analysis gate: five source lints + IR verify over the model zoo
+lint-kernels:    ## raw pallas_call/pallas imports must route through kernels/primitives/
+	$(PY) tools/lint_kernels.py
+
+analyze:         ## the whole static-analysis gate: six source lints + IR verify over the model zoo
 	$(PY) tools/lint_collectives.py
 	$(PY) tools/lint_passes.py
 	$(PY) tools/lint_resilience.py
 	$(PY) tools/lint_observability.py
+	$(PY) tools/lint_kernels.py
 	$(PY) tools/gen_api_spec.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/analyze_program.py --zoo all --mesh dp=4 --strict

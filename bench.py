@@ -53,6 +53,13 @@ tokens/s vs the naive re-prefill-every-token baseline, steady-state
 executable-cache misses (acceptance: 0), per-token p50/p99 and the
 short-vs-long-prompt step-time ratio (PT_BENCH_DECODE_REQS,
 PT_BENCH_DECODE_GEN, PT_BENCH_DECODE_SLOTS knobs);
+PT_BENCH_RAGGED=1 → ragged-serving A/B rung (`make ragged-bench`): the
+SAME ragged-attention model served bucketed-padded vs ragged under
+identical mixed-length traffic, recording real tokens/s per arm,
+pt_serve_rows_total{kind=padding} deltas (ragged full waves pay zero
+padding rows), warmup executable counts (ragged: one per batch bucket)
+and the modeled fp32-vs-dual-int8 KV-pool bytes
+(PT_BENCH_RAGGED_WAVES knob);
 PT_BENCH_RECOVERY=1 → measured preempt→restore rung (`make
 recovery-bench`): the in-process recovery drill
 (distributed.recovery.inprocess_drill) restoring through the persisted
@@ -937,6 +944,168 @@ def measure_decode_lane(size):
     return rec
 
 
+def measure_ragged_serving(size):
+    """Ragged-serving A/B rung (PT_BENCH_RAGGED=1, `make ragged-bench`):
+    the SAME ragged-attention model served two ways under identical
+    mixed-length traffic — bucketed-padded (every request padded to its
+    sequence bucket, one shape key per bucket) vs ragged (every request
+    padded to ONE length, attention masked by the per-row lengths feed;
+    docs/KERNELS.md "Ragged attention").  Records per arm:
+
+      - real tokens/s through the lane (sum of UNPADDED lengths / wall)
+      - pt_serve_rows_total{kind=padding} delta — the padding rows the
+        batch former minted (ragged mixed-length waves batch together,
+        so full waves stop paying padding rows entirely)
+      - warmup executable count (ragged: one per batch bucket; bucketed:
+        the seq-bucket cross product) and steady-state cold compiles
+
+    plus the modeled KV-pool HBM bytes fp32 vs dual-int8 for the
+    decode-lane config (serving/kv_pool.py modeled_bytes) — the
+    denominator/numerator pair behind pt_int8_bytes_saved_total."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu import fluid, serving
+    from paddle_tpu.fluid import layers as L
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    n_waves = int(os.environ.get("PT_BENCH_RAGGED_WAVES", "10"))
+    if size == "base":
+        vocab, hidden, heads, n_layers = 8192, 256, 8, 4
+        seq_buckets, wave_lens = (32, 64, 128), (20, 50, 90, 126)
+    else:
+        # heads chosen so head_dim = 32: the per-vector scale overhead
+        # amortizes (2n + 4n/32 vs 4n ≈ halving) and int8 meets the TPU
+        # (32, 128) min-tile row constraint when this runs on chip
+        vocab, hidden, heads, n_layers = 128, 64, 2, 2
+        seq_buckets, wave_lens = (8, 16, 32), (5, 12, 20, 30)
+    head_dim = hidden // heads
+    batch_bucket = 2 * len(wave_lens)  # one full mixed wave
+
+    # one model, one export: ids [-1, -1] + per-row lengths [-1]; the
+    # ragged_attention layer masks the padded tail itself, so BOTH arms
+    # compute identical real-token math — the A/B isolates the batching
+    model_dir = tempfile.mkdtemp(prefix="pt_bench_ragged_")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        ids = fluid.data("ids", [-1, -1], False, dtype="int64")
+        lens = fluid.data("lens", [-1], False, dtype="int32")
+        x = L.embedding(ids, size=[vocab, hidden])
+        for _ in range(n_layers):
+            qkv = [L.reshape(L.fc(x, size=hidden, num_flatten_dims=2),
+                             shape=[0, 0, heads, head_dim])
+                   for _ in range(3)]
+            q, k, v = [L.transpose(t, perm=[0, 2, 1, 3]) for t in qkv]
+            ctx = L.ragged_attention(q, k, v, lens, causal=True)
+            ctx = L.reshape(L.transpose(ctx, perm=[0, 2, 1, 3]),
+                            shape=[0, 0, hidden])
+            x = L.elementwise_add(x, L.fc(ctx, size=hidden,
+                                          num_flatten_dims=2))
+        score = L.reduce_mean(x, dim=[1, 2])
+        score = L.reshape(score, shape=[-1, 1])
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["ids", "lens"], [score],
+                                      exe, main_program=main)
+
+    rng = np.random.RandomState(0)
+
+    def run_arm(ragged):
+        from paddle_tpu import observability as obs
+
+        name = "ragged" if ragged else "bucketed"
+        eng = serving.Engine(batch_buckets=[batch_bucket],
+                             seq_buckets=list(seq_buckets),
+                             max_wait_ms=5, auto_start=False,
+                             name=f"bench_{name}")
+        try:
+            eng.load_model(name, model_dir, ragged=ragged)
+            warmed = eng.warmup()[name]
+            eng.start()
+            lane = eng._lanes[name]
+
+            def rows(kind):
+                fam = obs.REGISTRY.get("pt_serve_rows_total")
+                samples = fam._snapshot()["samples"] if fam else {}
+                return samples.get((name, kind), 0.0)
+
+            def one_wave():
+                futs = []
+                for ln in wave_lens:
+                    for _ in range(2):
+                        feed = {"ids": rng.randint(
+                                    1, vocab, (1, ln)).astype(np.int64),
+                                "lens": np.full((1,), ln, np.int32)}
+                        futs.append(eng.submit(name, feed))
+                for f in futs:
+                    f.result(timeout=300)
+
+            one_wave()  # prime outside the timed window
+            pad0, real0 = rows("padding"), rows("real")
+            cold0 = lane._cache_counts["cold"]
+            t0 = time.perf_counter()
+            for _ in range(n_waves):
+                one_wave()
+            dt = time.perf_counter() - t0
+            real_tokens = n_waves * 2 * sum(wave_lens)
+            return {
+                "tokens_per_sec": round(real_tokens / dt, 1),
+                "real_rows": int(rows("real") - real0),
+                "padding_rows": int(rows("padding") - pad0),
+                "warmed_executables": int(warmed),
+                "steady_state_cold": int(lane._cache_counts["cold"]
+                                         - cold0),
+            }
+        finally:
+            eng.close()
+
+    try:
+        arms = {"bucketed": run_arm(False), "ragged": run_arm(True)}
+    finally:
+        shutil.rmtree(model_dir, ignore_errors=True)
+
+    # modeled KV-pool HBM: the same decode-lane pool at fp32 vs dual-int8
+    # (pure accounting — no device memory moves here)
+    from paddle_tpu.serving.kv_pool import KVPool
+
+    num_pages, page_size = 65, 16
+    pools = {
+        dt: KVPool(n_layers, heads, head_dim, num_pages, page_size,
+                   max_pages_per_seq=16, dtype=dt)
+        for dt in ("float32", "int8")
+    }
+    kv_bytes = {
+        "fp32_bytes": pools["float32"].modeled_bytes(),
+        "int8_bytes": pools["int8"].modeled_bytes(),
+    }
+    kv_bytes["int8_over_fp32"] = round(
+        kv_bytes["int8_bytes"] / kv_bytes["fp32_bytes"], 4)
+
+    tps = arms["ragged"]["tokens_per_sec"]
+    config = (f"ragged-serving gpt-{size} h{hidden} n{heads} "
+              f"L{n_layers} seqbuckets{list(seq_buckets)} "
+              f"wave{wave_lens} waves{n_waves}" + _cpu_suffix())
+    return {
+        "metric": "ragged_serving_tokens_per_sec",
+        "value": tps,
+        "unit": "tokens/sec",
+        "config": config,
+        **_vs_baseline_rec(tps, config, is_headline=False),
+        "ragged_serving": {
+            **arms,
+            "ragged_over_bucketed": (
+                round(arms["ragged"]["tokens_per_sec"]
+                      / arms["bucketed"]["tokens_per_sec"], 3)
+                if arms["bucketed"]["tokens_per_sec"] else None),
+            "kv_pool_modeled": kv_bytes,
+        },
+    }
+
+
 def _hop_latency_bench(reps=10, payloads_kb=(16, 64, 256, 1024, 4096)):
     """PT_BENCH_QUANTAR hop-latency sub-rung: time the oneshot vs ring
     quantized all-reduce across payload sizes on the live mesh and derive
@@ -1593,6 +1762,8 @@ def measure(size):
         jax.config.update("jax_platforms", "cpu")
     if os.environ.get("PT_BENCH_SERVE") == "1":
         return measure_serving(size)
+    if os.environ.get("PT_BENCH_RAGGED") == "1":
+        return measure_ragged_serving(size)
     if os.environ.get("PT_BENCH_RECOVERY") == "1":
         return measure_recovery(size)
     if os.environ.get("PT_BENCH_DECODE") == "1":

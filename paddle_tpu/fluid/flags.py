@@ -230,6 +230,27 @@ _DEFS = {
     # pt_serve_rejected_total{reason="tenant_quota"} — one chatty tenant
     # cannot starve the shared decode queue.  0 = unlimited.
     "FLAGS_serving_tenant_quota": (0, int, True),
+    # kernel-primitives layer (paddle_tpu/kernels/primitives/,
+    # docs/KERNELS.md).  Measured tile-size autotune: when on, a
+    # primitive that exposes candidates + a measure hook times them on
+    # the first call per shape signature and caches the winner
+    # (pt_kernel_autotune_total{source="measured"}).  Off by default —
+    # candidate compiles are not free; PT_KERNEL_TILE_TABLE pins tiles
+    # without measuring.
+    "FLAGS_kernel_autotune": (False, _parse_bool, True),
+    # ragged serving (docs/SERVING.md "Ragged serving"): models built
+    # on ragged_attention pad every dynamic-dim-1 feed to ONE fixed
+    # length and carry true lengths in a feed, so mixed-length traffic
+    # batches together (padding rows → 0) and warmup compiles one
+    # executable per batch bucket instead of the seq-bucket cross
+    # product.  Engine.load_model(ragged=None) resolves from this flag.
+    "FLAGS_ragged_attention": (False, _parse_bool, True),
+    # int8 KV cache on the decode lane (docs/KERNELS.md "int8 KV"):
+    # DecodeEngine(pool_dtype=None) resolves to "int8" when set — the
+    # pool stores the dual-int8 block-scale format (quantize at append,
+    # dequant inside the paged kernel), halving modeled KV HBM
+    # (pt_int8_bytes_saved_total{kind="kv_cache"}).
+    "FLAGS_int8_kv_cache": (False, _parse_bool, True),
     # training health sentinel (paddle_tpu/health/, docs/DISTRIBUTED.md
     # §6 "Numeric fault tolerance"): on-device NaN/Inf gradient
     # detection (one found_inf scalar per step, no host scan), loss-

@@ -16,6 +16,8 @@ from ..param_attr import ParamAttr
 __all__ = [
     "fc", "embedding", "flash_attention", "moe_ffn",
     "paged_attention", "kv_cache_write", "kv_cache_write_pages",
+    "ragged_attention", "paged_attention_quant", "kv_cache_write_quant",
+    "kv_cache_write_pages_quant",
     "conv2d", "conv3d", "conv2d_transpose", "pool2d",
     "batch_norm", "layer_norm", "group_norm", "instance_norm", "dropout",
     "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
@@ -1465,3 +1467,75 @@ def kv_cache_write_pages(pages, new, page_idx, name=None):
                              "PageIdx": [page_idx]},
                      outputs={"PagesOut": [pages]})
     return pages
+
+
+def ragged_attention(q, k, v, lengths, causal=False, sm_scale=None,
+                     force=None, name=None):
+    """Variable-length attention over [B, n_heads, S, d] driven by a
+    per-row length vector (kernels/primitives/ragged.py; docs/SERVING.md
+    "Ragged serving"): row b attends key positions j < lengths[b] (and
+    j <= i when causal) — padded positions are never scored, so one
+    fixed S serves every mixed-length batch.  Inference-only."""
+    helper = LayerHelper("ragged_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {"causal": causal}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    if force is not None:
+        attrs["force"] = force
+    helper.append_op("ragged_attention",
+                     inputs={"Q": [q], "K": [k], "V": [v],
+                             "Lengths": [lengths]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def paged_attention_quant(q, k_hi, k_lo, k_scale, v_hi, v_lo, v_scale,
+                          page_table, q_start, sm_scale=None, force=None,
+                          name=None):
+    """paged_attention over the dual-int8 pool (hi/lo int8 + per-vector
+    fp32 scale; docs/KERNELS.md "int8 KV") — dequant happens inside the
+    kernel, fp32 K/V never materializes outside VMEM."""
+    helper = LayerHelper("paged_attention_quant", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    if force is not None:
+        attrs["force"] = force
+    helper.append_op("paged_attention_quant",
+                     inputs={"Q": [q], "KHi": [k_hi], "KLo": [k_lo],
+                             "KScale": [k_scale], "VHi": [v_hi],
+                             "VLo": [v_lo], "VScale": [v_scale],
+                             "PageTable": [page_table],
+                             "QStart": [q_start]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def kv_cache_write_quant(hi, lo, scale, new, page_idx, offset, name=None):
+    """kv_cache_write for the int8 pool: quantize one decode step's K or
+    V rows (new [B, n, d]) at append and scatter hi/lo/scale at per-slot
+    (page_idx[b], offset[b]) coordinates; returns the updated pool vars
+    (aliasing, the ParamOut convention)."""
+    helper = LayerHelper("kv_cache_write_quant", name=name)
+    helper.append_op("kv_cache_write_quant",
+                     inputs={"Hi": [hi], "Lo": [lo], "Scale": [scale],
+                             "New": [new], "PageIdx": [page_idx],
+                             "Offset": [offset]},
+                     outputs={"HiOut": [hi], "LoOut": [lo],
+                              "ScaleOut": [scale]})
+    return hi, lo, scale
+
+
+def kv_cache_write_pages_quant(hi, lo, scale, new, page_idx, name=None):
+    """kv_cache_write_pages for the int8 pool: quantize a prefill
+    chunk's K or V (new [C, n, d]) at append and scatter whole pages of
+    hi/lo/scale; returns the updated pool vars (aliasing)."""
+    helper = LayerHelper("kv_cache_write_pages_quant", name=name)
+    helper.append_op("kv_cache_write_pages_quant",
+                     inputs={"Hi": [hi], "Lo": [lo], "Scale": [scale],
+                             "New": [new], "PageIdx": [page_idx]},
+                     outputs={"HiOut": [hi], "LoOut": [lo],
+                              "ScaleOut": [scale]})
+    return hi, lo, scale

@@ -79,12 +79,29 @@ def _pallas_able(h):
     return int(h) % 128 == 0 and impl() in ("pallas", "interpret")
 
 
+def _tile_rows(n_rows, h):
+    """Row tile through the primitives tile table (pinned-table hook;
+    _TILE_ROWS stays the default).  A pinned value that does not divide
+    the padded row count falls back rather than mislaunching."""
+    from .primitives import autotune
+
+    tile = autotune.tile_for(
+        "fused_bias_act",
+        autotune.shape_signature(rows=n_rows, h=h),
+        {"rows": _TILE_ROWS})
+    rows = int(tile["rows"])
+    return rows if rows > 0 and n_rows % rows == 0 else _TILE_ROWS
+
+
 def _pallas_chain(x2, b2, m2, scale, approximate, interpret):
-    """gelu(x+bias) [* mask * scale] over [R, H] row tiles in VMEM."""
-    from jax.experimental import pallas as pl
+    """gelu(x+bias) [* mask * scale] over [R, H] row tiles in VMEM —
+    launched through the primitives contract."""
+    from .primitives import contract
+    from .primitives.contract import Block
 
     R, H = x2.shape
     with_mask = m2 is not None
+    rows = _tile_rows(R, H)
 
     def kernel(*refs):
         i = 0
@@ -102,18 +119,19 @@ def _pallas_chain(x2, b2, m2, scale, approximate, interpret):
 
     def spec(shape):
         if shape[0] == R:
-            return pl.BlockSpec((_TILE_ROWS, H), lambda i: (i, 0))
-        return pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+            return Block((rows, H), lambda i: (i, 0))
+        return Block(tuple(shape), lambda i: (0,) * len(shape))
 
     ins = [x2, b2] + ([m2] if with_mask else [])
-    return pl.pallas_call(
-        kernel,
-        grid=(R // _TILE_ROWS,),
+    launch = contract.make_spec(
+        "fused_bias_act",
+        grid=(R // rows,),
         in_specs=[spec(a.shape) for a in ins],
-        out_specs=spec((R, H)),
-        out_shape=jax.ShapeDtypeStruct((R, H), jnp.float32),
+        out_specs=[spec((R, H))],
+        out_shape=[((R, H), jnp.float32)],
         interpret=interpret,
-    )(*ins)
+    )
+    return contract.primitive_call(kernel, launch, *ins)
 
 
 def fused_bias_gelu_dropout(x, bias, *, dropout_prob=0.0, is_test=False,

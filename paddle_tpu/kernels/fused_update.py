@@ -254,28 +254,52 @@ def _requant_tile(pn):
     return q_hi.astype(jnp.int8), q_lo.astype(jnp.int8), scale
 
 
+def _tile_rows(n_rows, block_size):
+    """Row tile through the primitives tile table (pinned-table hook;
+    _TILE_ROWS stays the default — it is the int8 minimum sublane
+    tile).  A pinned value that does not divide the padded row count
+    falls back to the default rather than mislaunching."""
+    from .primitives import autotune
+
+    tile = autotune.tile_for(
+        "fused_update",
+        autotune.shape_signature(rows=n_rows, block=block_size),
+        {"rows": _TILE_ROWS})
+    rows = int(tile["rows"])
+    return rows if rows > 0 and n_rows % rows == 0 else _TILE_ROWS
+
+
 def _pallas_call(kernel, n_rows, block_size, in_structs, out_structs,
                  interpret):
-    """Shared pallas_call builder: 1-D grid over row tiles of the
-    (n_rows, block_size) view; every ref is an [R_tile, ...] VMEM block."""
-    from jax.experimental import pallas as pl
+    """Shared launch builder on the primitives contract: 1-D grid over
+    row tiles of the (n_rows, block_size) view; every ref is an
+    [R_tile, ...] VMEM block."""
+    from .primitives import contract
+    from .primitives.contract import Block
 
-    grid = (n_rows // _TILE_ROWS,)
+    rows = _tile_rows(n_rows, block_size)
+    grid = (n_rows // rows,)
 
     def spec(s):
         if len(s.shape) == 2 and s.shape[0] == n_rows:
-            return pl.BlockSpec((_TILE_ROWS, s.shape[1]), lambda i: (i, 0))
+            return Block((rows, s.shape[1]), lambda i: (i, 0))
         # whole-array operand (the scalar lr carrier)
-        return pl.BlockSpec(s.shape, lambda i: (0,) * len(s.shape))
+        return Block(tuple(s.shape), lambda i: (0,) * len(s.shape))
 
-    return pl.pallas_call(
-        kernel,
+    launch = contract.make_spec(
+        "fused_update",
         grid=grid,
         in_specs=[spec(s) for s in in_structs],
         out_specs=[spec(s) for s in out_structs],
-        out_shape=out_structs,
+        out_shape=[(tuple(s.shape), s.dtype) for s in out_structs],
         interpret=interpret,
     )
+    def call(*ops):
+        out = contract.primitive_call(kernel, launch, *ops)
+        # historical contract: always a tuple, even for one output
+        return out if isinstance(out, (tuple, list)) else (out,)
+
+    return call
 
 
 def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
@@ -288,8 +312,6 @@ def _pallas_fused(kind, p2, ghi2, glo2, gsc2, m1_2, m2_2, lr_t, hyper,
     (beta1, beta2, epsilon)), or "adamw" (adam plus the decoupled decay
     ``p -= lr_decay * p`` — ``lr_decay`` = raw lr × coeff rides the
     second lane of the scalar carrier)."""
-    from jax.experimental import pallas as pl  # noqa: F401 (import gate)
-
     dual = glo2 is not None
     beta1, beta2, eps = hyper
     R, B = p2.shape

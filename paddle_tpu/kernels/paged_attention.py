@@ -1,215 +1,28 @@
-"""Paged attention — K/V read through a per-sequence page table.
+"""Paged attention — compat shim over kernels/primitives/paged.py.
 
-The decode serving lane (docs/SERVING.md "Decode lane") stores K/V in a
-pool of fixed-size pages (`serving/kv_pool.py`): a sequence's cache is a
-LIST of page ids, not a contiguous slab, so admission/eviction moves no
-memory and the decode step is one fixed-shape executable regardless of
-how many sequences are live or how long each one is.  This kernel is the
-read side: queries attend to the pool THROUGH the page table.
-
-Two implementations (the flash_attention.py dispatch pattern):
-
-- **XLA reference** (CPU fallback + numerics oracle): gather the pages
-  (`k_pages[page_table]`), mask positions past each query's length with
-  the same -1e9 the fused causal softmax op uses, `jax.nn.softmax`.
-  The gather materializes [B, n, L_max, d] — fine on CPU, and it keeps
-  the decode-vs-whole-sequence parity gate honest (same masked-softmax
-  spelling as the composed attention path).
-- **Pallas TPU kernel**: grid (B, heads, logical pages) with the page
-  dimension innermost; the page table and per-row start offsets ride as
-  scalar prefetch so each K/V block's index_map resolves the PHYSICAL
-  page id — the kernel never sees a gathered copy of the pool.  Online
-  softmax (running max/sum in VMEM scratch) over the pages, blocks past
-  the row's length skipped entirely (`pl.when`), fp32 accumulation.
-  Interpret mode runs the same kernel on CPU for tests (the same
-  container caveat as the flash/fused kernels: Mosaic-real verification
-  happens at a tunnel window).
-
-Shapes:
-  q           [B, n_heads, T, d]   T = 1 (decode step) or the prefill
-                                   chunk length
-  k/v_pages   [num_pages, page_size, n_heads, d]
-  page_table  [B, max_pages] int32 — physical page of each logical page
-  q_start     [B] int32 — tokens already in the cache BEFORE this q
-              block; query i of row b attends keys at global positions
-              j <= q_start[b] + i (its own K/V must already be written)
-
-Page 0 of the pool is the allocator's trash page (writes of inactive
-slots land there); a row's mask only ever exposes positions below its
-own length, so trash content is never attended.
+The kernel moved onto the primitives contract (docs/KERNELS.md), which
+also added the int8-pool form (``paged_attention_quant``) and frames
+``q_start`` as the decode lane's ragged length vector.  This module
+keeps the historical import surface — ``from paddle_tpu.kernels import
+paged_attention`` and its internals — pointing at the migrated
+implementation; new code should import ``paddle_tpu.kernels.primitives``
+directly.
 """
 
 from __future__ import annotations
 
-import functools
+from .primitives.paged import (  # noqa: F401
+    NEG_INF, _paged_kernel, _pallas_paged, paged_attention,
+    paged_attention_quant, paged_attention_quant_reference,
+    paged_attention_reference,
+)
+from .primitives.contract import is_tpu_platform as _contract_is_tpu
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-NEG_INF = -1e9  # the fused causal softmax op's mask constant — shared so
-# the decode lane's masked softmax matches the composed path's spelling
-
-__all__ = ["paged_attention", "paged_attention_reference"]
-
-
-def paged_attention_reference(q, k_pages, v_pages, page_table, q_start,
-                              sm_scale=None):
-    """Materializing XLA implementation: CPU fallback + numerics oracle.
-
-    Mirrors the composed attention path's op spelling (matmul — scale —
-    -1e9 mask — jax.nn.softmax — matmul) so greedy decode through the
-    pool is comparable with the whole-sequence program token for
-    token."""
-    b, n, t, d = q.shape
-    page_size = k_pages.shape[1]
-    max_pages = page_table.shape[1]
-    l_max = max_pages * page_size
-    scale = float(sm_scale if sm_scale is not None else 1.0 / np.sqrt(d))
-
-    def gathered(pages):
-        g = pages[page_table]                      # [B, MAXP, PGS, n, d]
-        g = g.reshape(b, l_max, n, d)
-        return jnp.transpose(g, (0, 2, 1, 3))      # [B, n, L, d]
-
-    k = gathered(k_pages)
-    v = gathered(v_pages)
-    s = jnp.matmul(q.astype(jnp.float32),
-                   jnp.swapaxes(k.astype(jnp.float32), -1, -2)) * scale
-    kpos = jax.lax.broadcasted_iota(jnp.int32, (b, n, t, l_max), 3)
-    qpos = (q_start.astype(jnp.int32)[:, None, None, None]
-            + jax.lax.broadcasted_iota(jnp.int32, (b, n, t, l_max), 2))
-    s = jnp.where(kpos <= qpos, s, jnp.asarray(NEG_INF, s.dtype))
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.matmul(p, v.astype(jnp.float32)).astype(q.dtype)
-
-
-# ---------------------------------------------------------------------------
-# Pallas kernel: grid (B, n_heads, logical pages), pages innermost; the
-# page table + q_start ride as scalar prefetch so the K/V BlockSpecs
-# resolve physical page ids — the pool is never gathered into a copy.
-# ---------------------------------------------------------------------------
-
-
-def _paged_kernel(page_table_ref, q_start_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, page_size, t, n_blocks,
-                  sm_scale):
-    from jax.experimental import pallas as pl
-
-    bi = pl.program_id(0)
-    pi = pl.program_id(2)
-
-    @pl.when(pi == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-
-    start = q_start_ref[bi]
-
-    # the block is live iff its first key position is attendable by the
-    # LAST query of the block (global key limit = start + t - 1)
-    @pl.when(pi * page_size <= start + t - 1)
-    def _step():
-        q = q_ref[0, 0].astype(jnp.float32)                      # [T, d]
-        k = k_ref[...].reshape(page_size, -1).astype(jnp.float32)
-        v = v_ref[...].reshape(page_size, -1).astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        kpos = pi * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (t, page_size), 1)
-        qpos = start + jax.lax.broadcasted_iota(
-            jnp.int32, (t, page_size), 0)
-        s = jnp.where(kpos <= qpos, s, NEG_INF)
-        m_prev, l_prev = m_ref[...], l_ref[...]
-        s_max = jnp.max(s, axis=1, keepdims=True)                # [T, 1]
-        m_new = jnp.maximum(m_prev, jnp.broadcast_to(s_max, m_prev.shape))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, :1])
-        m_ref[...] = m_new
-        l_ref[...] = l_prev * alpha + jnp.broadcast_to(
-            jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
-        acc_ref[...] = acc_ref[...] * alpha[:, :1] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
-
-    @pl.when(pi == n_blocks - 1)
-    def _finish():
-        l = l_ref[...]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_ref[...] / l_safe[:, :1]).astype(o_ref.dtype)
-
-
-def _pallas_paged(q, k_pages, v_pages, page_table, q_start, scale,
-                  interpret):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    b, n, t, d = q.shape
-    page_size = k_pages.shape[1]
-    max_pages = page_table.shape[1]
-    kernel = functools.partial(_paged_kernel, page_size=page_size, t=t,
-                               n_blocks=max_pages, sm_scale=scale)
-
-    # index_map signature under scalar prefetch: grid indices first,
-    # then one ref per prefetched operand
-    def q_map(bi, hi, pi, pt, qs):
-        return (bi, hi, 0, 0)
-
-    def kv_map(bi, hi, pi, pt, qs):
-        # read THROUGH the table: the physical page this (row, logical
-        # page) pair maps to — the pool is never gathered
-        return (pt[bi, pi], 0, hi, 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, n, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, 1, t, d), q_map),
-            pl.BlockSpec((1, page_size, 1, d), kv_map),
-            pl.BlockSpec((1, page_size, 1, d), kv_map),
-        ],
-        out_specs=pl.BlockSpec((1, 1, t, d), q_map),
-        scratch_shapes=[
-            pltpu.VMEM((t, d), jnp.float32),
-            pltpu.VMEM((t, 128), jnp.float32),
-            pltpu.VMEM((t, 128), jnp.float32),
-        ],
-    )
-    return pl.pallas_call(
-        kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, n, t, d), q.dtype),
-        interpret=interpret,
-    )(page_table.astype(jnp.int32), q_start.astype(jnp.int32),
-      q, k_pages, v_pages)
+__all__ = ["paged_attention", "paged_attention_reference",
+           "paged_attention_quant", "paged_attention_quant_reference"]
 
 
 def _is_tpu_platform():
-    import os
-
-    from paddle_tpu.fluid.platform_utils import TPU_PLATFORMS, \
-        default_platform
-
-    if os.environ.get("PT_PAGED_NO_PALLAS", "") not in ("", "0"):
-        return False
-    return default_platform() in TPU_PLATFORMS
-
-
-def paged_attention(q, k_pages, v_pages, page_table, q_start, *,
-                    sm_scale=None, force=None):
-    """Attention of q [B, n, T, d] against pool K/V read through
-    `page_table` [B, max_pages]; query i of row b attends global key
-    positions j <= q_start[b] + i.
-
-    force: None → Pallas on TPU, XLA reference elsewhere; "pallas" →
-    Pallas (interpret mode off-TPU, for tests); "reference" → XLA."""
-    d = q.shape[-1]
-    scale = float(sm_scale if sm_scale is not None else 1.0 / np.sqrt(d))
-    if k_pages.dtype != v_pages.dtype:
-        raise ValueError(
-            f"paged_attention: K pool dtype {k_pages.dtype} != V pool "
-            f"dtype {v_pages.dtype} — the pool must be one dtype")
-    mode = force or ("pallas" if _is_tpu_platform() else "reference")
-    if mode == "pallas":
-        return _pallas_paged(q, k_pages, v_pages, page_table, q_start,
-                             scale, interpret=not _is_tpu_platform())
-    return paged_attention_reference(q, k_pages, v_pages, page_table,
-                                     q_start, sm_scale=scale)
+    """Legacy probe (PT_PAGED_NO_PALLAS escape hatch) — now the shared
+    contract helper."""
+    return _contract_is_tpu("PT_PAGED_NO_PALLAS")

@@ -1,0 +1,53 @@
+"""The audited kernel-primitives layer (docs/KERNELS.md).
+
+One uniform block/tile/VMEM contract (contract.py), one tile-table
+autotune hook (autotune.py), and the primitives every fused op and
+serving lane lowers through:
+
+  flash      dense attention, custom VJP (training + serving)
+  ragged     variable-length dense attention (serving prefill form)
+  paged      page-table attention, fp32 and int8 pools (decode form)
+  int8       dual-int8 storage quantization (weights + KV cache)
+
+Raw ``pl.pallas_call`` / ``pltpu`` outside this package is a lint
+error (tools/lint_kernels.py) unless marked ``# kernel: allow``.
+The legacy modules ``kernels/flash_attention.py`` and
+``kernels/paged_attention.py`` re-export from here; the fused-update
+and fused-bias-act kernels launch through the contract in place.
+"""
+
+from . import autotune, contract  # noqa: F401
+from .contract import (  # noqa: F401
+    Block, KernelSpec, Vmem, is_tpu_platform, make_spec, primitive_call,
+    resolve_mode,
+)
+from .autotune import (  # noqa: F401
+    clear_cache, measure_candidates, shape_signature, tile_for,
+)
+from .flash import (  # noqa: F401
+    DEFAULT_BLOCK, attention_reference, flash_attention,
+)
+from .int8 import (  # noqa: F401
+    book_bytes_saved, bytes_saved, dequantize_lastdim, dequantize_weight,
+    dual_int8_bytes, quantize_lastdim, quantize_weight,
+)
+from .paged import (  # noqa: F401
+    paged_attention, paged_attention_quant,
+    paged_attention_quant_reference, paged_attention_reference,
+)
+from .ragged import (  # noqa: F401
+    ragged_attention, ragged_attention_reference,
+)
+
+__all__ = [
+    "Block", "KernelSpec", "Vmem", "make_spec", "primitive_call",
+    "resolve_mode", "is_tpu_platform",
+    "shape_signature", "tile_for", "clear_cache", "measure_candidates",
+    "DEFAULT_BLOCK", "flash_attention", "attention_reference",
+    "ragged_attention", "ragged_attention_reference",
+    "paged_attention", "paged_attention_reference",
+    "paged_attention_quant", "paged_attention_quant_reference",
+    "quantize_lastdim", "dequantize_lastdim", "quantize_weight",
+    "dequantize_weight", "dual_int8_bytes", "bytes_saved",
+    "book_bytes_saved",
+]

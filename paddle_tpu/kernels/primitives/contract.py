@@ -1,0 +1,160 @@
+"""The uniform block/tile/VMEM contract every Pallas primitive rides.
+
+Tensor Processing Primitives (arXiv:2104.05755) argues for a SMALL set
+of composable primitives behind one audited dispatch surface instead of
+per-op hand-rolled kernels; this module is that surface for the
+paddle_tpu kernel layer.  Every primitive in ``kernels/primitives/``
+describes its launch as plain data — a :class:`KernelSpec` of grid,
+block specs, VMEM scratch and output shapes — and hands it to
+:func:`primitive_call`, the ONE place in the library that touches
+``pl.pallas_call`` / ``pltpu`` (tools/lint_kernels.py enforces the
+boundary; a deliberate site elsewhere carries ``# kernel: allow``).
+
+What the contract buys:
+
+- **One launch idiom.**  Block specs are ``Block(shape, index_map)``
+  tuples and scratch is ``Vmem(shape, dtype)`` — pure data, no pallas
+  import needed to BUILD a spec, so specs can be constructed (and
+  tested) without a kernel backend present at all.
+- **Interpret-mode fallback.**  ``interpret=True`` runs the same kernel
+  through the Pallas interpreter on CPU — the parity lane every
+  primitive's tests ride (Mosaic-real verification stays gated on the
+  tunnel window, docs/KERNELS.md).
+- **Scalar prefetch.**  ``num_scalar_prefetch > 0`` lowers through
+  ``pltpu.PrefetchScalarGridSpec`` so index maps can read small int32
+  operands (page tables, per-row lengths) — the mechanism behind the
+  paged and ragged attention forms.
+- **Tile-size autotune.**  Primitives resolve their block sizes through
+  ``autotune.tile_for`` (measured-or-pinned table keyed by shape
+  signature) instead of baking constants — see autotune.py.
+
+Mosaic tiling facts the specs must respect (the guide's table): the
+minor-most block dim wants multiples of 128 (lanes), the second-minor 8
+for fp32 (sublanes; 32 for int8); rank-2 operands ride as rank-3 with a
+literal leading 1.  Running-state scratch is kept 2-D ``(rows, 128)``
+with all lanes equal — the layout Mosaic accepts for reduction state.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+
+# A block spec as data: `shape` is the per-step block shape, `index_map`
+# maps grid indices (plus one ref per scalar-prefetch operand) to block
+# coordinates.  `shape=None` means "whole operand in VMEM".
+Block = namedtuple("Block", ("shape", "index_map"))
+
+# A VMEM scratch allocation as data.
+Vmem = namedtuple("Vmem", ("shape", "dtype"))
+
+# One primitive launch as data.  `out_shape` entries are (shape, dtype)
+# pairs; `in_specs`/`out_specs` are Block tuples (one out entry per
+# out_shape entry).  A single-element out list returns a single array.
+KernelSpec = namedtuple(
+    "KernelSpec",
+    ("name", "grid", "in_specs", "out_specs", "out_shape", "scratch",
+     "num_scalar_prefetch", "interpret"),
+)
+
+
+def make_spec(name, grid, in_specs, out_specs, out_shape, scratch=(),
+              num_scalar_prefetch=0, interpret=False):
+    """Build a :class:`KernelSpec` (keyword-friendly constructor)."""
+    return KernelSpec(name, tuple(grid), tuple(in_specs),
+                      tuple(out_specs), tuple(out_shape), tuple(scratch),
+                      int(num_scalar_prefetch), bool(interpret))
+
+
+def primitive_call(kernel, spec, *operands):
+    """Launch ``kernel`` under ``spec`` — the library's one raw
+    ``pl.pallas_call`` site.
+
+    Scalar-prefetch operands (the first ``spec.num_scalar_prefetch``
+    of ``operands``) are passed positionally before the tensor
+    operands, exactly as ``PrefetchScalarGridSpec`` expects."""
+    import jax
+    from jax.experimental import pallas as pl          # kernel: allow
+    from jax.experimental.pallas import tpu as pltpu   # kernel: allow
+
+    def block(b):
+        if b.shape is None:
+            return pl.BlockSpec(memory_space=pltpu.ANY)
+        return pl.BlockSpec(tuple(b.shape), b.index_map)
+
+    in_specs = [block(b) for b in spec.in_specs]
+    out_specs = [block(b) for b in spec.out_specs]
+    out_shape = [jax.ShapeDtypeStruct(tuple(s), d)
+                 for s, d in spec.out_shape]
+    scratch = [pltpu.VMEM(tuple(v.shape), v.dtype) for v in spec.scratch]
+    single = len(out_specs) == 1
+
+    if spec.num_scalar_prefetch:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=spec.num_scalar_prefetch,
+            grid=spec.grid,
+            in_specs=in_specs,
+            out_specs=out_specs[0] if single else out_specs,
+            scratch_shapes=scratch,
+        )
+        return pl.pallas_call(                         # kernel: allow
+            kernel, grid_spec=grid_spec,
+            out_shape=out_shape[0] if single else out_shape,
+            interpret=spec.interpret,
+        )(*operands)
+    return pl.pallas_call(                             # kernel: allow
+        kernel,
+        grid=spec.grid,
+        in_specs=in_specs,
+        out_specs=out_specs[0] if single else out_specs,
+        out_shape=out_shape[0] if single else out_shape,
+        scratch_shapes=scratch,
+        interpret=spec.interpret,
+    )(*operands)
+
+
+# ---------------------------------------------------------------------------
+# shared platform / dispatch-mode resolution — the flash_attention.py
+# no-init discipline, now in one place: lowerings run under abstract
+# tracing and a wedged tunnel can hang backend init, so the platform is
+# read WITHOUT initializing one (fluid.platform_utils).
+# ---------------------------------------------------------------------------
+
+
+def default_platform():
+    from paddle_tpu.fluid.platform_utils import default_platform as dp
+
+    return dp()
+
+
+def is_tpu_platform(no_pallas_env=None):
+    """Real TPU hardware (where the Mosaic/Pallas path engages).
+    ``no_pallas_env`` names a per-primitive escape hatch if the PJRT
+    plugin lacks Mosaic support; '', '0' and unset mean 'use Pallas'."""
+    from paddle_tpu.fluid.platform_utils import TPU_PLATFORMS
+
+    if no_pallas_env and os.environ.get(no_pallas_env, "") not in ("", "0"):
+        return False
+    return default_platform() in TPU_PLATFORMS
+
+
+def resolve_mode(force=None, *, no_pallas_env=None, force_env=None):
+    """The shared dispatch decision: returns ``(mode, interpret)`` where
+    mode is "pallas" or "reference".
+
+    force: None → Pallas on TPU, XLA reference elsewhere; "pallas" →
+    Pallas (interpret mode off-TPU, the CPU parity lane); "reference"
+    → XLA.  ``force_env`` names an env var that engages the kernel
+    off-TPU too (the blockwise structure survives the interpreter —
+    what lets pass-layer cost attribution measure kernel-boundary
+    bytes on CPU)."""
+    on_tpu = is_tpu_platform(no_pallas_env)
+    mode = force
+    if mode is None:
+        if on_tpu:
+            mode = "pallas"
+        elif force_env and os.environ.get(force_env, "") not in ("", "0"):
+            mode = "pallas"
+        else:
+            mode = "reference"
+    return mode, (mode == "pallas" and not on_tpu)

@@ -1,0 +1,345 @@
+"""Paged attention — K/V read through a per-sequence page table.
+
+Migrated from the ad-hoc ``kernels/paged_attention.py`` (which now
+re-exports from here) onto the primitives contract, and extended with
+the **int8-pool form**: ``paged_attention_quant`` reads a dual-int8
+block-scaled pool (hi/lo int8 + per-vector fp32 scale, the
+quantized_collectives wire format applied to storage) and dequantizes
+INSIDE the kernel — the pool lives in HBM at ~half the fp32 bytes and
+fp32 never exists outside VMEM blocks (docs/KERNELS.md "int8 KV").
+
+This primitive is also the decode lane's RAGGED form: ``q_start`` is a
+per-sequence length vector, so each row attends exactly its own prefix
+— pages wholly past ``q_start[b] + t - 1`` are skipped via ``pl.when``
+and no padded key is ever scored (primitives/ragged.py holds the dense
+prefill form of the same contract).
+
+The decode serving lane (docs/SERVING.md "Decode lane") stores K/V in a
+pool of fixed-size pages (`serving/kv_pool.py`): a sequence's cache is a
+LIST of page ids, not a contiguous slab, so admission/eviction moves no
+memory and the decode step is one fixed-shape executable regardless of
+how many sequences are live or how long each one is.
+
+Two implementations (the shared resolve_mode dispatch):
+
+- **XLA reference** (CPU fallback + numerics oracle): gather the pages
+  (`k_pages[page_table]`), mask positions past each query's length with
+  the same -1e9 the fused causal softmax op uses, `jax.nn.softmax`.
+- **Pallas kernel**: grid (B, heads, logical pages) with the page
+  dimension innermost; the page table and per-row start offsets ride as
+  scalar prefetch so each K/V block's index_map resolves the PHYSICAL
+  page id — the kernel never sees a gathered copy of the pool.  Online
+  softmax (running max/sum in VMEM scratch) over the pages, blocks past
+  the row's length skipped entirely (`pl.when`), fp32 accumulation.
+
+Shapes:
+  q           [B, n_heads, T, d]   T = 1 (decode step) or the prefill
+                                   chunk length
+  k/v_pages   [num_pages, page_size, n_heads, d]
+  page_table  [B, max_pages] int32 — physical page of each logical page
+  q_start     [B] int32 — tokens already in the cache BEFORE this q
+              block; query i of row b attends keys at global positions
+              j <= q_start[b] + i (its own K/V must already be written)
+
+Page 0 of the pool is the allocator's trash page (writes of inactive
+slots land there); a row's mask only ever exposes positions below its
+own length, so trash content is never attended.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import contract
+from .contract import Block, Vmem
+from .int8 import RESID_DIV, dequantize_lastdim
+
+NEG_INF = -1e9  # the fused causal softmax op's mask constant — shared so
+# the decode lane's masked softmax matches the composed path's spelling
+
+__all__ = ["paged_attention", "paged_attention_reference",
+           "paged_attention_quant", "paged_attention_quant_reference"]
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_table, q_start,
+                              sm_scale=None):
+    """Materializing XLA implementation: CPU fallback + numerics oracle.
+
+    Mirrors the composed attention path's op spelling (matmul — scale —
+    -1e9 mask — jax.nn.softmax — matmul) so greedy decode through the
+    pool is comparable with the whole-sequence program token for
+    token."""
+    b, n, t, d = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    l_max = max_pages * page_size
+    scale = float(sm_scale if sm_scale is not None else 1.0 / np.sqrt(d))
+
+    def gathered(pages):
+        g = pages[page_table]                      # [B, MAXP, PGS, n, d]
+        g = g.reshape(b, l_max, n, d)
+        return jnp.transpose(g, (0, 2, 1, 3))      # [B, n, L, d]
+
+    k = gathered(k_pages)
+    v = gathered(v_pages)
+    s = jnp.matmul(q.astype(jnp.float32),
+                   jnp.swapaxes(k.astype(jnp.float32), -1, -2)) * scale
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (b, n, t, l_max), 3)
+    qpos = (q_start.astype(jnp.int32)[:, None, None, None]
+            + jax.lax.broadcasted_iota(jnp.int32, (b, n, t, l_max), 2))
+    s = jnp.where(kpos <= qpos, s, jnp.asarray(NEG_INF, s.dtype))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.matmul(p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: grid (B, n_heads, logical pages), pages innermost; the
+# page table + q_start ride as scalar prefetch so the K/V BlockSpecs
+# resolve physical page ids — the pool is never gathered into a copy.
+# ---------------------------------------------------------------------------
+
+
+def _online_softmax_step(s, v, acc_ref, m_ref, l_ref):
+    """One kv-block update of the running (max, sum, acc) state — the
+    shared online-softmax spelling of every attention primitive."""
+    m_prev, l_prev = m_ref[...], l_ref[...]
+    s_max = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, jnp.broadcast_to(s_max, m_prev.shape))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])
+    m_ref[...] = m_new
+    l_ref[...] = l_prev * alpha + jnp.broadcast_to(
+        jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
+    acc_ref[...] = acc_ref[...] * alpha[:, :1] + jnp.dot(
+        p, v, preferred_element_type=jnp.float32)
+
+
+def _paged_kernel(page_table_ref, q_start_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, page_size, t, n_blocks,
+                  sm_scale):
+    from jax.experimental import pallas as pl
+
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    start = q_start_ref[bi]
+
+    # the block is live iff its first key position is attendable by the
+    # LAST query of the block (global key limit = start + t - 1)
+    @pl.when(pi * page_size <= start + t - 1)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)                      # [T, d]
+        k = k_ref[...].reshape(page_size, -1).astype(jnp.float32)
+        v = v_ref[...].reshape(page_size, -1).astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        kpos = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (t, page_size), 1)
+        qpos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (t, page_size), 0)
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+        _online_softmax_step(s, v, acc_ref, m_ref, l_ref)
+
+    @pl.when(pi == n_blocks - 1)
+    def _finish():
+        l = l_ref[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l_safe[:, :1]).astype(o_ref.dtype)
+
+
+def _paged_spec(b, n, t, d, page_size, max_pages, out_dtype, interpret,
+                name, extra_kv_specs=()):
+    """The shared launch spec of the fp and int8 paged kernels: q block
+    + one (physical page, head) K/V block per grid step, resolved
+    through the prefetched page table."""
+
+    # index_map signature under scalar prefetch: grid indices first,
+    # then one ref per prefetched operand
+    def q_map(bi, hi, pi, pt, qs):
+        return (bi, hi, 0, 0)
+
+    def kv_map(bi, hi, pi, pt, qs):
+        # read THROUGH the table: the physical page this (row, logical
+        # page) pair maps to — the pool is never gathered
+        return (pt[bi, pi], 0, hi, 0)
+
+    kv_block = Block((1, page_size, 1, d), kv_map)
+    in_specs = [Block((1, 1, t, d), q_map)]
+    if extra_kv_specs:
+        in_specs.extend(extra_kv_specs)
+    else:
+        in_specs.extend([kv_block, kv_block])
+    return contract.make_spec(
+        name,
+        grid=(b, n, max_pages),
+        in_specs=in_specs,
+        out_specs=[Block((1, 1, t, d), q_map)],
+        out_shape=[((b, n, t, d), out_dtype)],
+        scratch=[
+            Vmem((t, d), jnp.float32),
+            Vmem((t, 128), jnp.float32),
+            Vmem((t, 128), jnp.float32),
+        ],
+        num_scalar_prefetch=2,
+        interpret=interpret,
+    ), kv_map
+
+
+def _pallas_paged(q, k_pages, v_pages, page_table, q_start, scale,
+                  interpret):
+    b, n, t, d = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    kernel = functools.partial(_paged_kernel, page_size=page_size, t=t,
+                               n_blocks=max_pages, sm_scale=scale)
+    spec, _ = _paged_spec(b, n, t, d, page_size, max_pages, q.dtype,
+                          interpret, "paged_attention")
+    return contract.primitive_call(
+        kernel, spec, page_table.astype(jnp.int32),
+        q_start.astype(jnp.int32), q, k_pages, v_pages)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, q_start, *,
+                    sm_scale=None, force=None):
+    """Attention of q [B, n, T, d] against pool K/V read through
+    `page_table` [B, max_pages]; query i of row b attends global key
+    positions j <= q_start[b] + i.
+
+    force: None → Pallas on TPU, XLA reference elsewhere; "pallas" →
+    Pallas (interpret mode off-TPU, for tests); "reference" → XLA."""
+    d = q.shape[-1]
+    scale = float(sm_scale if sm_scale is not None else 1.0 / np.sqrt(d))
+    if k_pages.dtype != v_pages.dtype:
+        raise ValueError(
+            f"paged_attention: K pool dtype {k_pages.dtype} != V pool "
+            f"dtype {v_pages.dtype} — the pool must be one dtype")
+    mode, interpret = contract.resolve_mode(
+        force, no_pallas_env="PT_PAGED_NO_PALLAS")
+    if mode == "pallas":
+        return _pallas_paged(q, k_pages, v_pages, page_table, q_start,
+                             scale, interpret)
+    return paged_attention_reference(q, k_pages, v_pages, page_table,
+                                     q_start, sm_scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# int8-pool form: the pool rides as (hi int8, lo int8, scale fp32) —
+# the dual-int8 block-scale wire format with one scale per (page, slot,
+# head) head_dim vector — and dequantizes inside the kernel.
+# ---------------------------------------------------------------------------
+
+
+def _paged_quant_kernel(page_table_ref, q_start_ref, q_ref,
+                        khi_ref, klo_ref, ksc_ref,
+                        vhi_ref, vlo_ref, vsc_ref, o_ref,
+                        acc_ref, m_ref, l_ref, *, page_size, t, n_blocks,
+                        sm_scale):
+    from jax.experimental import pallas as pl
+
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    start = q_start_ref[bi]
+
+    @pl.when(pi * page_size <= start + t - 1)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)                      # [T, d]
+
+        def deq(hi_ref, lo_ref, sc_ref):
+            # dequant in VMEM: fp32 K/V exists only block-at-a-time
+            hi = hi_ref[...].reshape(page_size, -1).astype(jnp.float32)
+            lo = lo_ref[...].reshape(page_size, -1).astype(jnp.float32)
+            sc = sc_ref[...].reshape(page_size, 1)
+            return (hi + lo * (1.0 / RESID_DIV)) * sc
+
+        k = deq(khi_ref, klo_ref, ksc_ref)
+        v = deq(vhi_ref, vlo_ref, vsc_ref)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        kpos = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (t, page_size), 1)
+        qpos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (t, page_size), 0)
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+        _online_softmax_step(s, v, acc_ref, m_ref, l_ref)
+
+    @pl.when(pi == n_blocks - 1)
+    def _finish():
+        l = l_ref[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l_safe[:, :1]).astype(o_ref.dtype)
+
+
+def paged_attention_quant_reference(q, k_hi, k_lo, k_scale, v_hi, v_lo,
+                                    v_scale, page_table, q_start,
+                                    sm_scale=None):
+    """Numerics oracle: dequantize the whole pool, then the fp32
+    reference (fine on the CPU rung; the kernel never does this)."""
+    k_pages = dequantize_lastdim(k_hi, k_lo, k_scale)
+    v_pages = dequantize_lastdim(v_hi, v_lo, v_scale)
+    return paged_attention_reference(q, k_pages, v_pages, page_table,
+                                     q_start, sm_scale=sm_scale)
+
+
+def _pallas_paged_quant(q, k_hi, k_lo, k_scale, v_hi, v_lo, v_scale,
+                        page_table, q_start, scale, interpret):
+    b, n, t, d = q.shape
+    page_size = k_hi.shape[1]
+    max_pages = page_table.shape[1]
+    kernel = functools.partial(_paged_quant_kernel, page_size=page_size,
+                               t=t, n_blocks=max_pages, sm_scale=scale)
+    base_spec, kv_map = _paged_spec(b, n, t, d, page_size, max_pages,
+                                    q.dtype, interpret,
+                                    "paged_attention_quant")
+    kv_block = Block((1, page_size, 1, d), kv_map)
+    sc_block = Block((1, page_size, 1, 1), kv_map)
+    spec = base_spec._replace(in_specs=(
+        base_spec.in_specs[0],
+        kv_block, kv_block, sc_block,    # K hi / lo / scale
+        kv_block, kv_block, sc_block,    # V hi / lo / scale
+    ))
+    return contract.primitive_call(
+        kernel, spec, page_table.astype(jnp.int32),
+        q_start.astype(jnp.int32), q, k_hi, k_lo, k_scale,
+        v_hi, v_lo, v_scale)
+
+
+def paged_attention_quant(q, k_hi, k_lo, k_scale, v_hi, v_lo, v_scale,
+                          page_table, q_start, *, sm_scale=None,
+                          force=None):
+    """paged_attention over a dual-int8 pool: hi/lo int8
+    [P, page_size, n, d] + per-vector fp32 scale [P, page_size, n, 1]
+    (primitives/int8.py quantize_lastdim layout).  Dequant happens
+    inside the kernel — fp32 K/V never materializes outside VMEM."""
+    d = q.shape[-1]
+    scale = float(sm_scale if sm_scale is not None else 1.0 / np.sqrt(d))
+    for nm, arr in (("k_hi", k_hi), ("k_lo", k_lo), ("v_hi", v_hi),
+                    ("v_lo", v_lo)):
+        if arr.dtype != jnp.int8:
+            raise ValueError(
+                f"paged_attention_quant: {nm} dtype {arr.dtype} != int8 "
+                f"— the quant pool stores the dual-int8 wire format "
+                f"(serving/kv_pool.py KVPool(dtype='int8'))")
+    mode, interpret = contract.resolve_mode(
+        force, no_pallas_env="PT_PAGED_NO_PALLAS")
+    if mode == "pallas":
+        return _pallas_paged_quant(q, k_hi, k_lo, k_scale, v_hi, v_lo,
+                                   v_scale, page_table, q_start, scale,
+                                   interpret)
+    return paged_attention_quant_reference(
+        q, k_hi, k_lo, k_scale, v_hi, v_lo, v_scale, page_table, q_start,
+        sm_scale=scale)

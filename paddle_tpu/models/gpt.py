@@ -587,10 +587,45 @@ def kv_pool_var_names(num_layers, prefix=KV_POOL_PREFIX):
             for i in range(num_layers)]
 
 
+def kv_pool_quant_var_names(num_layers, prefix=KV_POOL_PREFIX):
+    """The per-layer ((k_hi, k_lo, k_scale), (v_hi, v_lo, v_scale)) var
+    names of the dual-int8 pool (docs/KERNELS.md "int8 KV").  Each fp
+    pool var splits into an int8 hi/lo pair plus a per-vector fp32
+    scale; the triples keep the fp var name as their stem so dumps stay
+    greppable."""
+    out = []
+    for kn, vn in kv_pool_var_names(num_layers, prefix):
+        out.append(tuple(
+            (f"{nm}__qhi", f"{nm}__qlo", f"{nm}__scale")
+            for nm in (kn, vn)))
+    return out
+
+
 def _declare_pool_vars(cfg: GPTConfig, num_pages, page_size, dtype,
                        prefix=KV_POOL_PREFIX):
     n, d = cfg.num_heads, cfg.hidden_size // cfg.num_heads
     block = fluid.default_main_program().global_block()
+    if dtype == "int8":
+        # dual-int8 pool: hi/lo int8 [P, pgs, n, d] + fp32 scale
+        # [P, pgs, n, 1] per K/V (kernels/primitives/int8.py layout)
+        out = []
+        for k_names, v_names in kv_pool_quant_var_names(cfg.num_layers,
+                                                        prefix):
+            layer = []
+            for hi_n, lo_n, sc_n in (k_names, v_names):
+                layer.append(tuple([
+                    block.create_var(name=hi_n,
+                                     shape=[num_pages, page_size, n, d],
+                                     dtype="int8", persistable=True),
+                    block.create_var(name=lo_n,
+                                     shape=[num_pages, page_size, n, d],
+                                     dtype="int8", persistable=True),
+                    block.create_var(name=sc_n,
+                                     shape=[num_pages, page_size, n, 1],
+                                     dtype="float32", persistable=True),
+                ]))
+            out.append(tuple(layer))
+        return out
     out = []
     for kn, vn in kv_pool_var_names(cfg.num_layers, prefix):
         out.append(tuple(
@@ -652,14 +687,26 @@ def build_gpt_decode_step(cfg: GPTConfig, pool_slots, num_pages,
                 init_std=cfg.initializer_range)
         q_h = L.transpose(L.reshape(q, shape=[0, 0, n, d]),
                           perm=[0, 2, 1, 3])               # [PS, n, 1, d]
-        k_pool, v_pool = pool[li]
-        L.kv_cache_write(k_pool, L.reshape(k, shape=[-1, n, d]),
-                         write_page, write_off)
-        L.kv_cache_write(v_pool, L.reshape(v, shape=[-1, n, d]),
-                         write_page, write_off)
-        ctx = L.paged_attention(q_h, k_pool, v_pool, page_table, q_start,
-                                sm_scale=float(d) ** -0.5,
-                                force=attn_force)
+        if pool_dtype == "int8":
+            (k_hi, k_lo, k_sc), (v_hi, v_lo, v_sc) = pool[li]
+            L.kv_cache_write_quant(k_hi, k_lo, k_sc,
+                                   L.reshape(k, shape=[-1, n, d]),
+                                   write_page, write_off)
+            L.kv_cache_write_quant(v_hi, v_lo, v_sc,
+                                   L.reshape(v, shape=[-1, n, d]),
+                                   write_page, write_off)
+            ctx = L.paged_attention_quant(
+                q_h, k_hi, k_lo, k_sc, v_hi, v_lo, v_sc, page_table,
+                q_start, sm_scale=float(d) ** -0.5, force=attn_force)
+        else:
+            k_pool, v_pool = pool[li]
+            L.kv_cache_write(k_pool, L.reshape(k, shape=[-1, n, d]),
+                             write_page, write_off)
+            L.kv_cache_write(v_pool, L.reshape(v, shape=[-1, n, d]),
+                             write_page, write_off)
+            ctx = L.paged_attention(q_h, k_pool, v_pool, page_table,
+                                    q_start, sm_scale=float(d) ** -0.5,
+                                    force=attn_force)
         ctx = L.reshape(L.transpose(ctx, perm=[0, 2, 1, 3]),
                         shape=[0, 0, h])
         attn = _fc(ctx, h, name + "_att_output_fc",
@@ -736,16 +783,32 @@ def build_gpt_prefill_chunk(cfg: GPTConfig, chunk_len, num_pages,
                 init_std=cfg.initializer_range)
         q_h = L.transpose(L.reshape(q, shape=[0, 0, n, d]),
                           perm=[0, 2, 1, 3])               # [1, n, C, d]
-        k_pool, v_pool = pool[li]
-        L.kv_cache_write_pages(
-            k_pool, L.cast(L.reshape(k, shape=[-1, n, d]), sink_dtype),
-            write_pages)
-        L.kv_cache_write_pages(
-            v_pool, L.cast(L.reshape(v, shape=[-1, n, d]), sink_dtype),
-            write_pages)
-        ctx = L.paged_attention(q_h, k_pool, v_pool, page_table, q_start,
-                                sm_scale=float(d) ** -0.5,
-                                force=attn_force)
+        if pool_dtype == "int8":
+            # no sink cast: the quant write op owns the fp32→dual-int8
+            # conversion (quantize happens ONCE at append)
+            (k_hi, k_lo, k_sc), (v_hi, v_lo, v_sc) = pool[li]
+            L.kv_cache_write_pages_quant(
+                k_hi, k_lo, k_sc, L.reshape(k, shape=[-1, n, d]),
+                write_pages)
+            L.kv_cache_write_pages_quant(
+                v_hi, v_lo, v_sc, L.reshape(v, shape=[-1, n, d]),
+                write_pages)
+            ctx = L.paged_attention_quant(
+                q_h, k_hi, k_lo, k_sc, v_hi, v_lo, v_sc, page_table,
+                q_start, sm_scale=float(d) ** -0.5, force=attn_force)
+        else:
+            k_pool, v_pool = pool[li]
+            L.kv_cache_write_pages(
+                k_pool, L.cast(L.reshape(k, shape=[-1, n, d]),
+                               sink_dtype),
+                write_pages)
+            L.kv_cache_write_pages(
+                v_pool, L.cast(L.reshape(v, shape=[-1, n, d]),
+                               sink_dtype),
+                write_pages)
+            ctx = L.paged_attention(q_h, k_pool, v_pool, page_table,
+                                    q_start, sm_scale=float(d) ** -0.5,
+                                    force=attn_force)
         ctx = L.reshape(L.transpose(ctx, perm=[0, 2, 1, 3]),
                         shape=[0, 0, h])
         attn = _fc(ctx, h, name + "_att_output_fc",

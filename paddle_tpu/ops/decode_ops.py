@@ -80,10 +80,86 @@ def _kv_cache_write_pages(ctx, pages, new, page_idx, attrs):
 def _paged_attention(ctx, q, k_pages, v_pages, page_table, q_start,
                      attrs):
     """Attention of q [B, n, T, d] against the pool through the page
-    table — kernels/paged_attention.py (Pallas on TPU, lax gather
+    table — kernels/primitives/paged.py (Pallas on TPU, lax gather
     reference on CPU; attrs["force"] pins an implementation)."""
-    from paddle_tpu.kernels import paged_attention as _pa
+    from paddle_tpu.kernels import primitives as _prims
 
-    return _pa.paged_attention(
+    return _prims.paged_attention(
         q, k_pages, v_pages, page_table, q_start,
+        sm_scale=attrs.get("sm_scale"), force=attrs.get("force"))
+
+
+# ---------------------------------------------------------------------------
+# int8-pool forms (docs/KERNELS.md "int8 KV"): the pool rides as three
+# vars per K/V — hi/lo int8 [P, pgs, n, d] + per-vector fp32 scale
+# [P, pgs, n, 1] (primitives/int8.py quantize_lastdim).  Quantization
+# happens ONCE here at append; readers dequantize inside the kernel.
+# ---------------------------------------------------------------------------
+
+
+def _quantize_payload(op, hi, new):
+    from paddle_tpu.kernels import primitives as _prims
+
+    if hi.dtype != jnp.int8:
+        raise ValueError(
+            f"{op}: Hi pool dtype {hi.dtype} != int8 — the quant write "
+            f"ops only serve an int8 pool (KVPool(dtype='int8'))")
+    return _prims.quantize_lastdim(new.astype(jnp.float32))
+
+
+@simple_op("kv_cache_write_quant",
+           ["Hi", "Lo", "Scale", "New", "PageIdx", "Offset"],
+           ["HiOut", "LoOut", "ScaleOut"], grad=None,
+           inplace={"HiOut": "Hi", "LoOut": "Lo", "ScaleOut": "Scale"})
+def _kv_cache_write_quant(ctx, hi, lo, scale, new, page_idx, offset,
+                          attrs):
+    """kv_cache_write for the int8 pool: quantize new [B, n, d] per
+    (slot, head) head_dim vector, scatter hi/lo/scale at
+    (page_idx[b], offset[b]).  Same trash-page semantics as the fp
+    write."""
+    q_hi, q_lo, q_sc = _quantize_payload("kv_cache_write_quant", hi, new)
+    pi = page_idx.astype(jnp.int32)
+    off = offset.astype(jnp.int32)
+    return (hi.at[pi, off].set(q_hi), lo.at[pi, off].set(q_lo),
+            scale.at[pi, off].set(q_sc))
+
+
+@simple_op("kv_cache_write_pages_quant",
+           ["Hi", "Lo", "Scale", "New", "PageIdx"],
+           ["HiOut", "LoOut", "ScaleOut"], grad=None,
+           inplace={"HiOut": "Hi", "LoOut": "Lo", "ScaleOut": "Scale"})
+def _kv_cache_write_pages_quant(ctx, hi, lo, scale, new, page_idx,
+                                attrs):
+    """kv_cache_write_pages for the int8 pool: quantize the chunk
+    [C, n, d] per vector, scatter whole pages of hi/lo/scale."""
+    q_hi, q_lo, q_sc = _quantize_payload("kv_cache_write_pages_quant",
+                                         hi, new)
+    page_size = hi.shape[1]
+    c = new.shape[0]
+    if c % page_size:
+        raise ValueError(
+            f"kv_cache_write_pages_quant: chunk length {c} is not a "
+            f"multiple of the pool page size {page_size} — the prefill "
+            f"chunk must cover whole pages")
+    pi = page_idx.astype(jnp.int32)
+    n_pages = c // page_size
+
+    def paged(x):
+        return x.reshape(n_pages, page_size, *x.shape[1:])
+
+    return (hi.at[pi].set(paged(q_hi)), lo.at[pi].set(paged(q_lo)),
+            scale.at[pi].set(paged(q_sc)))
+
+
+@simple_op("paged_attention_quant",
+           ["Q", "KHi", "KLo", "KScale", "VHi", "VLo", "VScale",
+            "PageTable", "QStart"], ["Out"], grad=None)
+def _paged_attention_quant(ctx, q, k_hi, k_lo, k_scale, v_hi, v_lo,
+                           v_scale, page_table, q_start, attrs):
+    """paged_attention over the dual-int8 pool — dequant inside the
+    kernel (kernels/primitives/paged.py paged_attention_quant)."""
+    from paddle_tpu.kernels import primitives as _prims
+
+    return _prims.paged_attention_quant(
+        q, k_hi, k_lo, k_scale, v_hi, v_lo, v_scale, page_table, q_start,
         sm_scale=attrs.get("sm_scale"), force=attrs.get("force"))

@@ -472,6 +472,21 @@ def _flash_attention(ctx, q, k, v, bias, attrs):
                force=attrs.get("force"))
 
 
+@simple_op("ragged_attention", ["Q", "K", "V", "Lengths"], ["Out"],
+           grad=None)
+def _ragged_attention(ctx, q, k, v, lengths, attrs):
+    """Variable-length attention driven by a per-sequence length vector
+    (kernels/primitives/ragged.py): row b attends keys j < lengths[b],
+    no padded position is ever scored.  The serving lane's ragged form
+    (docs/SERVING.md "Ragged serving") — inference-only (grad=None),
+    like every decode-lane op."""
+    from paddle_tpu.kernels import primitives as _prims
+
+    return _prims.ragged_attention(
+        q, k, v, lengths, causal=attrs.get("causal", False),
+        sm_scale=attrs.get("sm_scale"), force=attrs.get("force"))
+
+
 @simple_op("moe_ffn", ["X", "GateW", "W1", "B1", "W2", "B2"], ["Out"],
            optional=("B1", "B2"))
 def _moe_ffn(ctx, x, gate_w, w1, b1, w2, b2, attrs):

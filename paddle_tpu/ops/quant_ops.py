@@ -156,3 +156,16 @@ def _fake_dequantize_max_abs(ctx, x, scale, attrs):
     max_range = float(attrs.get("max_range", 127.0))
     s = jnp.reshape(scale, ()).astype(jnp.float32)
     return (x.astype(jnp.float32) * s / max_range).astype(x.dtype)
+
+
+@simple_op("dequantize_weight_storage", ["Hi", "Lo", "Scale"], ["Out"],
+           grad=None)
+def _dequantize_weight_storage(ctx, hi, lo, scale, attrs):
+    """Reconstruct an fp32 weight from its dual-int8 at-rest storage
+    (kernels/primitives/int8.py layout, installed by the
+    ``int8_weight_storage`` pass): Out = (Hi + Lo/254) * Scale with Scale
+    per-row [r, 1].  Inference-only — the pass never rewrites a weight a
+    backward op reads, so no grad is registered."""
+    from paddle_tpu.kernels import primitives as prims
+
+    return prims.dequantize_lastdim(hi, lo, scale)

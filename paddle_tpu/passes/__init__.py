@@ -18,6 +18,10 @@ transpiler, hybrid, GSPMD, serving/inference load path):
 - ``fuse_softmax_xent`` — the classifier/MLM-head softmax→cross_entropy
                        pair rewritten to the bit-exact
                        ``fused_softmax_cross_entropy`` op.
+- ``int8_weights``   — opt-in inference rewrite: fp32 matmul weights
+                       stored dual-int8 at rest, reconstructed on-chip
+                       by ``dequantize_weight_storage``
+                       (kernels/primitives/int8.py, docs/KERNELS.md).
 - ``adapters``       — the pre-existing rewriters (DP transpile incl.
                        the fused-update rewrite, health sentinel)
                        registered as passes so the ordering contract
@@ -30,6 +34,7 @@ from . import adapters  # noqa: F401  (registers the transpile adapters)
 from . import fuse_attention  # noqa: F401  (registers fuse_attention)
 from . import fuse_bias_act  # noqa: F401  (registers fuse_bias_act_dropout)
 from . import fuse_softmax_xent  # noqa: F401  (fuse_softmax_cross_entropy)
+from . import int8_weights  # noqa: F401  (registers int8_weight_storage)
 from .framework import (DEFAULT_PASSES, PASS_ORDER,  # noqa: F401
                         PassContext, PassManager, ProgramPass,
                         apply_graph_passes, attribute_costs,

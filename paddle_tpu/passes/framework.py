@@ -76,6 +76,7 @@ PASS_ORDER = [
     "fuse_attention",
     "fuse_bias_act_dropout",
     "fuse_softmax_cross_entropy",
+    "int8_weight_storage",       # after fusion: rewrites surviving muls
     "data_parallel_transpile",   # includes the fused-update DP rewrite
     "health_sentinel",
 ]

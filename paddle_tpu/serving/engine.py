@@ -213,13 +213,29 @@ class _ModelLane:
     """One served model: predictor + bounded queue + scheduler thread."""
 
     def __init__(self, name, predictor, policy, max_wait_s, max_queue,
-                 deadline_s=0.0):
+                 deadline_s=0.0, ragged=False):
         self.name = name
         self.predictor = predictor
         self.policy = policy
         self.max_wait_s = max_wait_s
         self.max_queue = max_queue
         self.deadline_s = float(deadline_s or 0.0)
+        # ragged mode (docs/KERNELS.md "Ragged attention"): every
+        # dynamic dim-1 feed pads to ONE length (the largest sequence
+        # bucket) instead of its nearest bucket, so mixed-length traffic
+        # shares a single shape key — it batches TOGETHER (padding rows
+        # stop existing for full batches) and warmup compiles one
+        # executable per batch bucket instead of the seq-bucket cross
+        # product.  The model masks the padded tail itself via a
+        # per-row lengths feed (layers.ragged_attention).
+        self._ragged = bool(ragged)
+        if self._ragged and not policy.seq_buckets:
+            raise ValueError(
+                f"model {name!r}: ragged=True needs sequence buckets to "
+                f"name the single padded length (the largest bucket) — "
+                f"set FLAGS_serving_seq_buckets or "
+                f"Engine(seq_buckets=...)")
+        self._ragged_len = policy.seq_buckets[-1] if self._ragged else None
         self.signature = model_signature(predictor._program,
                                          predictor.get_input_names(),
                                          predictor.get_output_names())
@@ -439,7 +455,21 @@ class _ModelLane:
                     and var is not None and var.shape is not None
                     and len(var.shape) >= 2 and var.shape[1] == -1):
                 orig = int(arr.shape[1])
-                tgt = self.policy.seq_bucket(orig)
+                if self._ragged:
+                    # one shape for ALL lengths: mixed-length traffic
+                    # must share a batch, so over-length can't fall
+                    # through to an unpadded cold shape like the
+                    # bucketed path allows — reject typed instead
+                    if orig > self._ragged_len:
+                        raise FeedValidationError(
+                            f"input {n!r} has length {orig}, above the "
+                            f"ragged lane's single padded length "
+                            f"{self._ragged_len} (the largest sequence "
+                            f"bucket) — raise the bucket set or split "
+                            f"the request")
+                    tgt = self._ragged_len
+                else:
+                    tgt = self.policy.seq_bucket(orig)
                 arr = pad_seq(arr, tgt)
                 seq_pads.append((orig, tgt))
             elif arr.ndim >= 2:
@@ -839,7 +869,13 @@ class _ModelLane:
 
         names = self.predictor.get_input_names()
         dyn = self._dyn_seq_inputs
-        if dyn and self.policy.seq_buckets:
+        if dyn and self._ragged:
+            # ragged lane: every dynamic feed always pads to the ONE
+            # ragged length, so the only reachable assignment is the
+            # uniform diagonal at that length — one executable per
+            # batch bucket, no cross product, no truncation warning
+            seq_opts = [(self._ragged_len,) * len(dyn)]
+        elif dyn and self.policy.seq_buckets:
             combos = itertools.product(self.policy.seq_buckets,
                                        repeat=len(dyn))
             seq_opts = list(itertools.islice(combos,
@@ -1183,11 +1219,23 @@ class Engine:
 
     # -- model management --------------------------------------------------
 
-    def load_model(self, name, model):
+    def load_model(self, name, model, ragged=None):
         """Load a model under a serving name.  `model`: saved-model dir
-        (str), `AnalysisConfig`, or a built `AnalysisPredictor`."""
+        (str), `AnalysisConfig`, or a built `AnalysisPredictor`.
+
+        ``ragged`` (default: FLAGS_ragged_attention) puts the lane in
+        ragged mode — every dynamic dim-1 feed pads to the single
+        largest sequence bucket so mixed-length traffic shares one
+        shape key (and one batch), and warmup compiles one executable
+        per batch bucket instead of the seq-bucket cross product.  The
+        model must mask its own padded tail from a per-row lengths feed
+        (layers.ragged_attention; docs/KERNELS.md)."""
+        from paddle_tpu.fluid import flags as _flags
         from paddle_tpu.inference import (AnalysisConfig, AnalysisPredictor,
                                           create_paddle_predictor)
+
+        if ragged is None:
+            ragged = bool(_flags.flag("ragged_attention"))
 
         if self._closed:
             raise ServingOverloadError(
@@ -1208,7 +1256,8 @@ class Engine:
                 f"model must be a dir, AnalysisConfig or "
                 f"AnalysisPredictor; got {type(model).__name__}")
         lane = _ModelLane(name, predictor, self.policy, self._max_wait_s,
-                          self._max_queue, deadline_s=self._deadline_s)
+                          self._max_queue, deadline_s=self._deadline_s,
+                          ragged=ragged)
         # pt_serve_* series are keyed by model name: a second engine in
         # this process serving the same name would alias its series (and
         # /servez stats) onto this one — warn, don't corrupt silently

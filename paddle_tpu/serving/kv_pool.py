@@ -62,6 +62,12 @@ class KVPool:
         self.dtype = dtype
         self.prefix = KV_POOL_PREFIX if prefix is None else prefix
         self.var_names = kv_pool_var_names(self.num_layers, self.prefix)
+        if dtype == "int8":
+            from paddle_tpu.models.gpt import kv_pool_quant_var_names
+            self.quant_var_names = kv_pool_quant_var_names(
+                self.num_layers, self.prefix)
+        else:
+            self.quant_var_names = None
         # LIFO free list: a just-freed page is the next one handed out,
         # so a churning slot's working set stays the same physical pages
         self._free = collections.deque(range(1, self.num_pages))
@@ -81,6 +87,21 @@ class KVPool:
         payload)."""
         shape = (self.num_pages, self.page_size, self.num_heads,
                  self.head_dim)
+        if self.dtype == "int8":
+            # dual-int8 pool: hi/lo int8 + per-vector fp32 scale per
+            # K/V (docs/KERNELS.md "int8 KV")
+            sc_shape = shape[:-1] + (1,)
+            for k_names, v_names in self.quant_var_names:
+                for hi_n, lo_n, sc_n in (k_names, v_names):
+                    for name, shp, dt in ((hi_n, shape, "int8"),
+                                          (lo_n, shape, "int8"),
+                                          (sc_n, sc_shape, "float32")):
+                        cur = scope.get(name)
+                        if (cur is None
+                                or tuple(np.shape(cur)) != shp
+                                or np.asarray(cur).dtype != np.dtype(dt)):
+                            scope.set(name, np.zeros(shp, dtype=dt))
+            return
         want = np.dtype(self.dtype)
         for kn, vn in self.var_names:
             for name in (kn, vn):
@@ -88,6 +109,31 @@ class KVPool:
                 if (cur is None or tuple(np.shape(cur)) != shape
                         or np.asarray(cur).dtype != want):
                     scope.set(name, np.zeros(shape, dtype=self.dtype))
+
+    # -- modeled bytes ------------------------------------------------------
+
+    def modeled_bytes(self):
+        """Modeled device bytes of the resident pool across all layers
+        and both K/V — dual-int8 accounting when dtype == 'int8'
+        (kernels/primitives/int8.py dual_int8_bytes with a per-head_dim
+        scale block), plain dtype-width bytes otherwise."""
+        n_vec = self.num_pages * self.page_size * self.num_heads
+        n_elems = n_vec * self.head_dim
+        per_var = (self._dual_int8_bytes(n_elems)
+                   if self.dtype == "int8"
+                   else n_elems * np.dtype(self.dtype).itemsize)
+        return per_var * 2 * self.num_layers
+
+    def modeled_bytes_fp32(self):
+        """The same pool's modeled bytes at fp32 — the denominator of
+        the int8 saving claim (bench.py PT_BENCH_RAGGED rung)."""
+        n_elems = (self.num_pages * self.page_size * self.num_heads
+                   * self.head_dim)
+        return n_elems * 4 * 2 * self.num_layers
+
+    def _dual_int8_bytes(self, n_elems):
+        from paddle_tpu.kernels import primitives as _prims
+        return _prims.dual_int8_bytes(n_elems, self.head_dim)
 
     # -- allocation ---------------------------------------------------------
 

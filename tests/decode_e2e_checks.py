@@ -193,8 +193,151 @@ def check_eos_and_single_token(cfg, scope, prompts, ref_ids):
         eng.close()
 
 
+def check_int8_kv_generate_matches_fp32(cfg, scope, prompts, ref_ids):
+    """The int8-KV serving gate: a DecodeEngine whose pool stores the
+    dual-int8 wire format (pool_dtype="int8" — quantize once at append,
+    dequant inside the paged kernel) greedy-generates the SAME token ids
+    as the fp32-pool reference lane, and books the modeled HBM saving on
+    pt_int8_bytes_saved_total{kind="kv_cache"}."""
+    from paddle_tpu import observability as obs
+
+    def saved():
+        fam = obs.REGISTRY.get("pt_int8_bytes_saved_total")
+        samples = fam._snapshot()["samples"] if fam else {}
+        return samples.get(("kv_cache",), 0.0)
+
+    before = saved()
+    eng = serving.DecodeEngine(cfg, scope=scope, pool_slots=4,
+                               page_size=4, prefill_chunk=4, max_len=32,
+                               pool_dtype="int8", name="int8kv",
+                               auto_start=False)
+    try:
+        assert saved() > before, "int8 pool never booked its saving"
+        eng.warmup()
+        eng.start()
+        outs = eng.generate([list(p) for p in prompts],
+                            max_new_tokens=6, timeout=300)
+    finally:
+        eng.close()
+    np.testing.assert_array_equal(np.asarray(outs), ref_ids)
+
+
+def check_int8_kv_logprob_drift(cfg, scope, prompts, ref_ids):
+    """The int8-KV numerics gate: the SAME trained weights decoding the
+    SAME 20 tokens through an fp32 pool vs a dual-int8 pool keep every
+    per-step logprob row within a tight bound and agree on every greedy
+    argmax — quantization happens once per append, so the error does
+    not compound across steps."""
+    n, d = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    page_size, max_pages, num_pages, steps = 4, 8, 9, 20
+
+    progs = {}
+    for dtype, prefix in (("float32", "@KVF@"), ("int8", "@KVQ@")):
+        main, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, start), fluid.unique_name.guard():
+            _, _, logp = gpt.build_gpt_decode_step(
+                cfg, pool_slots=1, num_pages=num_pages,
+                page_size=page_size, max_pages=max_pages,
+                pool_dtype=dtype, pool_prefix=prefix)
+        progs[dtype] = (main, logp.name)
+
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        # install both pools by hand (the engine's pool.install job)
+        for kn, vn in gpt.kv_pool_var_names(cfg.num_layers, "@KVF@"):
+            for nm in (kn, vn):
+                scope.set(nm, np.zeros(
+                    (num_pages, page_size, n, d), np.float32))
+        for k_names, v_names in gpt.kv_pool_quant_var_names(
+                cfg.num_layers, "@KVQ@"):
+            for hi_n, lo_n, sc_n in (k_names, v_names):
+                scope.set(hi_n, np.zeros(
+                    (num_pages, page_size, n, d), np.int8))
+                scope.set(lo_n, np.zeros(
+                    (num_pages, page_size, n, d), np.int8))
+                scope.set(sc_n, np.zeros(
+                    (num_pages, page_size, n, 1), np.float32))
+
+        toks = np.random.RandomState(0).randint(
+            1, cfg.vocab_size, steps)
+        table = np.zeros((1, max_pages), np.int32)
+        n_used = -(-steps // page_size)
+        table[0, :n_used] = np.arange(1, 1 + n_used)
+        logps = {}
+        for dtype in ("float32", "int8"):
+            main, logp_name = progs[dtype]
+            rows = []
+            for t in range(steps):
+                feed = {
+                    "dec_tok": np.array([[toks[t]]], np.int64),
+                    "dec_pos": np.array([[t]], np.int64),
+                    "dec_page_table": table,
+                    "dec_write_page": np.array(
+                        [table[0, t // page_size]], np.int32),
+                    "dec_write_off": np.array([t % page_size], np.int32),
+                }
+                (lp,) = exe.run(main, feed=feed, fetch_list=[logp_name])
+                rows.append(np.asarray(lp)[0])
+            logps[dtype] = np.stack(rows)
+
+    drift = np.abs(logps["int8"] - logps["float32"]).max()
+    assert drift < 0.05, f"20-step int8-KV logprob drift {drift}"
+    assert (logps["int8"].argmax(-1)
+            == logps["float32"].argmax(-1)).all(), \
+        "int8 pool flipped a greedy argmax inside the drift window"
+
+
+def check_int8_weights_generate_matches_fp32(cfg, scope, prompts,
+                                             ref_ids):
+    """The int8-WEIGHT serving gate: DecodeEngine(int8_weights=True)
+    rewrites both lane programs through the int8_weight_storage pass,
+    quantizes the scope's matmul weights to dual-int8 (dropping the fp32
+    arrays), books pt_int8_bytes_saved_total{kind="weights"} — and still
+    greedy-generates the SAME token ids as the fp32 reference lane
+    (dual-int8 keeps ~14.6 significant bits; see docs/KERNELS.md)."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.passes.int8_weights import storage_var_names
+
+    def saved():
+        fam = obs.REGISTRY.get("pt_int8_bytes_saved_total")
+        samples = fam._snapshot()["samples"] if fam else {}
+        return samples.get(("weights",), 0.0)
+
+    # quantize_scope_weights DROPS the fp32 weights — work on a copy so
+    # the shared fixture scope stays intact for other checks
+    qscope = fluid.Scope()
+    for nm in list(scope.keys()):
+        qscope.set(nm, scope.get(nm))
+    before = saved()
+    eng = serving.DecodeEngine(cfg, scope=qscope, pool_slots=4,
+                               page_size=4, prefill_chunk=4, max_len=32,
+                               name="int8w", auto_start=False,
+                               int8_weights=True)
+    try:
+        deq = [op for op in eng._dec_prog.global_block().ops
+               if op.type == "dequantize_weight_storage"]
+        assert deq, "int8_weights engaged but no weight was rewritten"
+        assert saved() > before, "int8 weights never booked their saving"
+        # the fp32 arrays are gone from the scope, the triples installed
+        w0 = deq[0].output("Out")[0]
+        assert qscope.get(w0) is None
+        assert all(qscope.get(nm) is not None
+                   for nm in storage_var_names(w0))
+        eng.warmup()
+        eng.start()
+        outs = eng.generate([list(p) for p in prompts],
+                            max_new_tokens=6, timeout=300)
+    finally:
+        eng.close()
+    np.testing.assert_array_equal(np.asarray(outs), ref_ids)
+
+
 CHECKS = {
     "parity_greedy_bit_exact": check_parity_greedy_bit_exact,
+    "int8_kv_generate_matches_fp32": check_int8_kv_generate_matches_fp32,
+    "int8_kv_logprob_drift": check_int8_kv_logprob_drift,
+    "int8_weights_generate_matches_fp32":
+        check_int8_weights_generate_matches_fp32,
     "zero_steady_state_compiles": check_zero_steady_state_compiles,
     "eviction_under_pressure_matches_unpressured":
         check_eviction_under_pressure_matches_unpressured,

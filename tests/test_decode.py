@@ -339,6 +339,29 @@ def test_decode_eos_and_single_token(e2e):
     _e2e_check(e2e, "eos_and_single_token")
 
 
+def test_decode_int8_kv_generate_matches_fp32(e2e):
+    """DecodeEngine(pool_dtype="int8") — dual-int8 KV pool, dequant
+    inside the paged kernel — greedy-generates the same token ids as
+    the fp32 lane and books pt_int8_bytes_saved_total (child check)."""
+    _e2e_check(e2e, "int8_kv_generate_matches_fp32")
+
+
+def test_decode_int8_kv_logprob_drift(e2e):
+    """20 decode steps through fp32 vs dual-int8 pools: per-step
+    logprobs within 0.05 and every greedy argmax agrees (child
+    check)."""
+    _e2e_check(e2e, "int8_kv_logprob_drift")
+
+
+def test_decode_int8_weights_generate_matches_fp32(e2e):
+    """DecodeEngine(int8_weights=True) — matmul weights stored
+    dual-int8 at rest, reconstructed on-chip by
+    dequantize_weight_storage — greedy-generates the same token ids as
+    the fp32 lane and books pt_int8_bytes_saved_total{kind="weights"}
+    (child check)."""
+    _e2e_check(e2e, "int8_weights_generate_matches_fp32")
+
+
 # ---------------------------------------------------------------------------
 # host-side engine surface (no device execution — safe in-process)
 # ---------------------------------------------------------------------------

@@ -617,3 +617,40 @@ def test_program_verify_flag_roundtrip(monkeypatch):
     monkeypatch.delenv("FLAGS_program_verify")
     importlib.reload(fl)  # restore defaults for other tests
     assert fl.get_flags("program_verify")["program_verify"] == "warn"
+
+
+def test_kernel_primitive_flags_roundtrip(monkeypatch):
+    """The kernel-primitives flags (ISSUE 17) — autotune, ragged
+    attention, int8 KV cache — register bool-typed with their documented
+    off-by-default values and round-trip through env bootstrap and
+    get/set like every other flag."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("kernel_autotune")["kernel_autotune"] is False
+    assert fl.get_flags("ragged_attention")["ragged_attention"] is False
+    assert fl.get_flags("int8_kv_cache")["int8_kv_cache"] is False
+    try:
+        fl.set_flags({"FLAGS_kernel_autotune": "true",  # str parses
+                      "ragged_attention": 1,
+                      "FLAGS_int8_kv_cache": True})
+        assert fl.get_flags(["kernel_autotune", "ragged_attention",
+                             "int8_kv_cache"]) == {
+            "kernel_autotune": True,
+            "ragged_attention": True,
+            "int8_kv_cache": True}
+    finally:
+        fl.set_flags({"FLAGS_kernel_autotune": False,
+                      "FLAGS_ragged_attention": False,
+                      "FLAGS_int8_kv_cache": False})
+    monkeypatch.setenv("FLAGS_kernel_autotune", "1")
+    monkeypatch.setenv("FLAGS_int8_kv_cache", "true")
+    importlib.reload(fl)
+    assert fl.get_flags("kernel_autotune")["kernel_autotune"] is True
+    assert fl.get_flags("int8_kv_cache")["int8_kv_cache"] is True
+    assert fl.get_flags("ragged_attention")["ragged_attention"] is False
+    monkeypatch.delenv("FLAGS_kernel_autotune")
+    monkeypatch.delenv("FLAGS_int8_kv_cache")
+    importlib.reload(fl)  # restore defaults for other tests
+    assert fl.get_flags("kernel_autotune")["kernel_autotune"] is False

@@ -1,0 +1,83 @@
+"""tools/lint_kernels.py — the kernel-primitives CI tripwire: raw
+pl.pallas_call sites (and jax.experimental.pallas imports) in library
+code must route through kernels/primitives/ (the uniform block/VMEM
+contract, interpret fallback, autotune hook) or carry an explicit
+`# kernel: allow`.  Runs the real lint in tier-1 (`make lint-kernels`
+is the Makefile entry point)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import lint_kernels  # noqa: E402
+
+
+def test_library_tree_is_clean():
+    assert lint_kernels.main([]) == 0
+
+
+def test_flags_raw_pallas_call_and_imports():
+    src = (
+        "from jax.experimental import pallas as pl\n"
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "def f(x):\n"
+        "    return pl.pallas_call(kern, out_shape=s)(x)\n"
+    )
+    findings = lint_kernels.check_source(src, "bad.py")
+    assert [f[1] for f in findings] == [1, 2, 4]
+    assert all(f[2] == "raw-pallas" for f in findings)
+
+
+def test_flags_plain_import_form():
+    src = "import jax.experimental.pallas as pl\n"
+    findings = lint_kernels.check_source(src, "bad.py")
+    assert [f[2] for f in findings] == ["raw-pallas"]
+
+
+def test_allow_mark_same_line_and_above():
+    same = ("from jax.experimental import pallas as pl  # kernel: allow\n"
+            "y = pl.pallas_call(k, out_shape=s)(x)  # kernel: allow\n")
+    above = ("# kernel: allow\n"
+             "from jax.experimental import pallas as pl\n")
+    assert lint_kernels.check_source(same, "a.py") == []
+    assert lint_kernels.check_source(above, "b.py") == []
+
+
+def test_primitives_package_exempt():
+    assert lint_kernels._exempt(
+        "paddle_tpu/kernels/primitives/contract.py")
+    assert lint_kernels._exempt(
+        "paddle_tpu/kernels/primitives/flash.py")
+    # the shims and every other kernels module stay LINTED: a raw
+    # pallas_call reintroduced there must flag
+    assert not lint_kernels._exempt(
+        "paddle_tpu/kernels/flash_attention.py")
+    assert not lint_kernels._exempt(
+        "paddle_tpu/kernels/fused_update.py")
+    assert not lint_kernels._exempt("paddle_tpu/ops/nn_ops.py")
+
+
+def test_migrated_kernels_are_clean_under_real_lint():
+    """The tentpole's proof: after the primitives migration no raw
+    pallas remains in the legacy kernel modules — they compile their
+    specs through the contract layer."""
+    for rel in ("paddle_tpu/kernels/flash_attention.py",
+                "paddle_tpu/kernels/paged_attention.py",
+                "paddle_tpu/kernels/fused_update.py",
+                "paddle_tpu/kernels/fused_bias_act.py"):
+        assert lint_kernels.check_file(lint_kernels.REPO / rel) == []
+
+
+def test_non_pallas_code_passes():
+    src = ("import jax.numpy as jnp\n"
+           "from jax.experimental import mesh_utils\n"
+           "def f(x):\n"
+           "    return jnp.sum(x)\n")
+    assert lint_kernels.check_source(src, "c.py") == []
+
+
+def test_parse_error_is_a_finding():
+    findings = lint_kernels.check_source("def broken(:\n", "x.py")
+    assert findings and findings[0][2] == "parse-error"

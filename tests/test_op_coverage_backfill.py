@@ -683,3 +683,82 @@ def test_deformable_psroi_pooling_zero_trans_finite():
     assert got["o"].shape == (1, 2, 2, 2)
     assert np.isfinite(got["o"]).all()
     assert got["o"].min() >= -1e-6 and got["o"].max() <= 1.0 + 1e-6
+
+
+def _dual_int8_recon(hi, lo, scale):
+    # independent rendering of the dual-int8 format (docs/KERNELS.md
+    # "int8 KV"): x ~ (hi + lo/254) * scale, one scale per head_dim vector
+    return ((hi.astype("float32") + lo.astype("float32") / 254.0)
+            * scale.astype("float32"))
+
+
+def test_kv_cache_write_quant_scatter_and_resolution():
+    """decode_ops.py kv_cache_write_quant: quantize new [B, n, d] per
+    (slot, head) vector and scatter hi/lo/scale at (page_idx[b],
+    offset[b]); untouched slots keep their bytes, written slots
+    reconstruct within dual-int8 resolution (~14.6 bits)."""
+    rng = np.random.RandomState(3)
+    P, pgs, n, d = 3, 4, 2, 8
+    hi = np.ones((P, pgs, n, d), "int8") * 7
+    lo = np.ones((P, pgs, n, d), "int8") * -3
+    sc = np.full((P, pgs, n, 1), 0.5, "float32")
+    new = (rng.randn(2, n, d) * 4).astype("float32")
+    page_idx = np.array([2, 0], "int32")
+    offset = np.array([1, 3], "int32")
+    got = _run_one_op(
+        "kv_cache_write_quant",
+        {"Hi": [("h", hi)], "Lo": [("l", lo)], "Scale": [("s", sc)],
+         "New": [("nw", new)], "PageIdx": [("pi", page_idx)],
+         "Offset": [("of", offset)]},
+        {"HiOut": ["ho"], "LoOut": ["lu"], "ScaleOut": ["so"]})
+    ho, lu, so = got["ho"], got["lu"], got["so"]
+    assert ho.dtype == np.int8 and lu.dtype == np.int8
+    recon = _dual_int8_recon(ho, lu, so)
+    for b in range(2):
+        p, o = int(page_idx[b]), int(offset[b])
+        np.testing.assert_allclose(
+            recon[p, o], new[b],
+            atol=float(np.abs(new[b]).max()) * 1e-4)
+    untouched = np.ones((P, pgs), bool)
+    untouched[page_idx, offset] = False
+    np.testing.assert_array_equal(ho[untouched], hi[untouched])
+    np.testing.assert_array_equal(so[untouched], sc[untouched])
+    # fp-pool misuse fails by name (the dtype guard)
+    with pytest.raises(ValueError, match="int8 pool"):
+        _run_one_op(
+            "kv_cache_write_quant",
+            {"Hi": [("h", hi.astype("float32"))], "Lo": [("l", lo)],
+             "Scale": [("s", sc)], "New": [("nw", new)],
+             "PageIdx": [("pi", page_idx)], "Offset": [("of", offset)]},
+            {"HiOut": ["ho"], "LoOut": ["lu"], "ScaleOut": ["so"]})
+
+
+def test_kv_cache_write_pages_quant_whole_pages():
+    """decode_ops.py kv_cache_write_pages_quant: a prefill chunk [C, n, d]
+    (C a multiple of the page size) lands as C/pgs whole quantized pages;
+    a non-multiple chunk fails by name."""
+    rng = np.random.RandomState(4)
+    P, pgs, n, d = 4, 2, 2, 8
+    hi = np.zeros((P, pgs, n, d), "int8")
+    lo = np.zeros((P, pgs, n, d), "int8")
+    sc = np.ones((P, pgs, n, 1), "float32")
+    new = (rng.randn(4, n, d) * 2).astype("float32")  # 2 whole pages
+    page_idx = np.array([3, 1], "int32")
+    got = _run_one_op(
+        "kv_cache_write_pages_quant",
+        {"Hi": [("h", hi)], "Lo": [("l", lo)], "Scale": [("s", sc)],
+         "New": [("nw", new)], "PageIdx": [("pi", page_idx)]},
+        {"HiOut": ["ho"], "LoOut": ["lu"], "ScaleOut": ["so"]})
+    recon = _dual_int8_recon(got["ho"], got["lu"], got["so"])
+    chunk = new.reshape(2, pgs, n, d)
+    for i, p in enumerate((3, 1)):
+        np.testing.assert_allclose(
+            recon[p], chunk[i],
+            atol=float(np.abs(chunk[i]).max()) * 1e-4)
+    assert not got["ho"][0].any() and not got["ho"][2].any()
+    with pytest.raises(ValueError, match="whole pages"):
+        _run_one_op(
+            "kv_cache_write_pages_quant",
+            {"Hi": [("h", hi)], "Lo": [("l", lo)], "Scale": [("s", sc)],
+             "New": [("nw", new[:3])], "PageIdx": [("pi", page_idx)]},
+            {"HiOut": ["ho"], "LoOut": ["lu"], "ScaleOut": ["so"]})

@@ -72,7 +72,7 @@ def test_pass_order_contract():
     is declared in ONE place; a pipeline violating it is rejected."""
     assert passes.PASS_ORDER == [
         "fuse_attention", "fuse_bias_act_dropout",
-        "fuse_softmax_cross_entropy",
+        "fuse_softmax_cross_entropy", "int8_weight_storage",
         "data_parallel_transpile", "health_sentinel"]
     # the adapters registered (the existing rewriters ARE passes now)
     for name in passes.PASS_ORDER:
@@ -935,3 +935,115 @@ def test_fuse_softmax_cross_entropy_in_default_pipeline():
         assert "fused_softmax_cross_entropy" in _types(main)
     finally:
         fluid.set_flags({"FLAGS_graph_passes": prior})
+
+
+# ---------------------------------------------------------------------------
+# int8_weight_storage (ISSUE 17: dual-int8 weight storage at rest)
+# ---------------------------------------------------------------------------
+
+
+def _build_mlp():
+    """Plain inference MLP: two fc weights (eligible), two biases +
+    an embedding table (ineligible).  Deterministic names under
+    unique_name.guard — two builds claim the same weight set."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        ids = fluid.data("ids", [4, 6], False, dtype="int64")
+        x = fluid.layers.embedding(ids, size=[32, 16])
+        x = fluid.layers.reduce_mean(x, dim=1)
+        h = fluid.layers.fc(x, size=24, act="relu")
+        out = fluid.layers.fc(h, size=8)
+    return main, startup, out
+
+
+def _int8_saved_weights():
+    from paddle_tpu import observability as obs
+
+    fam = obs.REGISTRY.get("pt_int8_bytes_saved_total")
+    samples = fam._snapshot()["samples"] if fam else {}
+    return samples.get(("weights",), 0.0)
+
+
+def _claimed(program):
+    return {op.output("Out")[0]
+            for op in program.global_block().ops
+            if op.type == "dequantize_weight_storage"}
+
+
+def test_int8_weight_storage_rewrite_and_parity():
+    """The at-rest weight rewrite end to end: 2 fc weights claimed, the
+    dequantize_weight_storage producers installed, scope fp32 arrays
+    swapped for dual-int8 triples, the counter booked — and the
+    program's output matches the fp32 run (~14.6 significant bits)."""
+    from paddle_tpu.passes.int8_weights import (quantize_scope_weights,
+                                                storage_var_names)
+
+    main, startup, out = _build_mlp()
+    feed = {"ids": np.random.RandomState(0).randint(
+        0, 32, (4, 6)).astype(np.int64)}
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (ref,) = exe.run(main, feed=feed, fetch_list=[out.name])
+
+        PassManager(["int8_weight_storage"]).run(
+            main, PassContext(lane="single"))
+        pr = main._pass_report[-1]
+        assert pr["changed"] and pr["sites"] == 2
+        names = sorted(_claimed(main))
+        assert len(names) == 2
+        # biases (1-D) and the embedding table (lookup_table consumer)
+        # keep full precision; the claimed weights lose persistability
+        for nm in names:
+            v = main.global_block().vars[nm]
+            assert len(v.shape) == 2 and not v.persistable
+        # modeled saving: 4rc - (2rc + 4r) per weight
+        modeled = sum(2 * v.shape[0] * v.shape[1] - 4 * v.shape[0]
+                      for v in (main.global_block().vars[n]
+                                for n in names))
+        assert pr["modeled_bytes_saved"] == modeled
+
+        # idempotent: a second application claims nothing new
+        PassManager(["int8_weight_storage"]).run(
+            main, PassContext(lane="single"))
+        assert not main._pass_report[-1]["changed"]
+        assert len(_claimed(main)) == 2
+
+        before = _int8_saved_weights()
+        info = quantize_scope_weights(scope, main)
+        assert info["weights"] == 2
+        assert _int8_saved_weights() - before == info["bytes_saved"] > 0
+        for nm in names:
+            assert scope.get(nm) is None, "fp32 weight survived"
+            assert all(scope.get(s) is not None
+                       for s in storage_var_names(nm))
+        # second conversion is a no-op (triples already installed)
+        assert quantize_scope_weights(scope, main)["weights"] == 0
+
+        (got,) = exe.run(main, feed=feed, fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=0, atol=1e-2)
+
+
+def test_int8_weight_storage_vetoes():
+    """Backward consumers veto (training programs are untouched) and
+    keep_vars veto (a pinned weight keeps fp32 storage)."""
+    # training program: every fc weight also feeds its grad op
+    _, train_main, _, _ = _build_bert(optimizer=True)
+    PassManager(["int8_weight_storage"]).run(
+        train_main, PassContext(lane="single"))
+    pr = train_main._pass_report[-1]
+    assert not pr["changed"] and pr["sites"] == 0
+
+    # learn the claimable set, then pin one of them
+    probe, _, _ = _build_mlp()
+    PassManager(["int8_weight_storage"]).run(
+        probe, PassContext(lane="single"))
+    full = _claimed(probe)
+    assert len(full) == 2
+    pinned = sorted(full)[0]
+    main, _, _ = _build_mlp()
+    PassManager(["int8_weight_storage"]).run(
+        main, PassContext(lane="single", keep_vars={pinned}))
+    assert _claimed(main) == full - {pinned}

@@ -142,6 +142,10 @@ def test_roundtrip_property_over_registry_ops():
         spec = get_op(t)
         if not spec.output_slots or spec.host_run is not None:
             continue
+        if t in ("while", "conditional_block", "conditional_block_infer"):
+            # the exclusion the docstring promises: import rewrites these
+            # to the capture signature and requires a real sub_block attr
+            continue
         # registry slot names carry a '*' suffix for variadic slots
         ins = {s.rstrip("*"): [f"in_{picked}_{i}"] for i, s in
                enumerate(spec.input_slots)}

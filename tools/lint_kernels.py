@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Static kernel-primitives lint for the library tree.
+
+The kernel-primitives PR's CI tripwire: raw Pallas in library code
+bypasses everything ``kernels/primitives/`` guarantees — the uniform
+block/tile/VMEM contract (``contract.make_spec``/``primitive_call``),
+the CPU interpret-mode fallback every test rung relies on, and the
+tile-size autotune hook (``autotune.tile_for``, the
+``pt_kernel_autotune_total`` accounting).  One check over
+``paddle_tpu/``:
+
+  raw-pallas   a call to ``pallas_call`` (``pl.pallas_call``,
+               ``pallas.pallas_call``, ...) or an import of
+               ``jax.experimental.pallas`` / ``pallas.tpu`` outside
+               ``paddle_tpu/kernels/primitives/``.  Express the kernel
+               as a ``KernelSpec`` and launch it through
+               ``primitives.contract.primitive_call`` — or mark a
+               deliberate site with ``# kernel: allow``.
+
+Sanctioned modules (they ARE the pallas surface): everything under
+``paddle_tpu/kernels/primitives/`` — ``contract.py`` holds the single
+raw ``pallas_call`` site the whole library funnels through.
+
+Suppress a deliberate finding with ``# kernel: allow`` on the same line
+or the line above.  Exit 0 when clean, 1 with findings (one per line:
+``path:lineno: [check] message``).  Walker/allow-mark/baseline
+mechanics live in tools/lintlib.py.
+
+Usage: python tools/lint_kernels.py [--baseline=FILE] [paths...]
+  (no args = paddle_tpu/, repo-relative)
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+import lintlib
+
+REPO = lintlib.REPO
+
+DEFAULT_TARGETS = ["paddle_tpu"]
+
+# the sanctioned pallas surface: the primitives package (contract.py is
+# the one launch site; the per-primitive modules only build KernelSpecs)
+EXEMPT_PREFIX = "paddle_tpu/kernels/primitives/"
+
+RAW_CALLS = ("pallas_call",)
+
+# module paths whose import marks a raw-pallas dependency
+RAW_MODULES = ("jax.experimental.pallas", "jax.experimental.pallas.tpu")
+
+ALLOW_MARK = "kernel: allow"
+
+
+def _call_name(node):
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def _rules():
+    def raw_calls(node):
+        if not isinstance(node, ast.Call):
+            return
+        if _call_name(node) in RAW_CALLS:
+            yield (node.lineno, "raw-pallas",
+                   "raw pallas_call() outside kernels/primitives/ — "
+                   "express the kernel as a KernelSpec and launch it "
+                   "through primitives.contract.primitive_call (uniform "
+                   "block/VMEM contract, interpret fallback, autotune "
+                   f"hook) or mark a deliberate site `# {ALLOW_MARK}`")
+
+    def _is_raw(mod):
+        return mod in RAW_MODULES or mod.startswith(RAW_MODULES[0] + ".")
+
+    def raw_imports(node):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            # both spellings resolve the pallas module: the whole-path
+            # `from jax.experimental.pallas import tpu` AND the split
+            # `from jax.experimental import pallas`
+            hits = [mod] if _is_raw(mod) else [
+                f"{mod}.{a.name}" for a in node.names
+                if _is_raw(f"{mod}.{a.name}")]
+            for full in hits[:1]:
+                yield (node.lineno, "raw-pallas",
+                       f"import of {full} outside kernels/primitives/ — "
+                       "the pallas surface is the primitives package: "
+                       "build on primitives.contract (or mark a "
+                       f"deliberate site `# {ALLOW_MARK}`)")
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if _is_raw(alias.name):
+                    yield (node.lineno, "raw-pallas",
+                           f"import of {alias.name} outside "
+                           "kernels/primitives/ — the pallas surface is "
+                           "the primitives package: build on "
+                           "primitives.contract (or mark a deliberate "
+                           f"site `# {ALLOW_MARK}`)")
+
+    return (raw_calls, raw_imports)
+
+
+def check_source(src: str, path: str = "<string>"):
+    """Lint one file's source; returns [(path, lineno, check, message)]."""
+    return lintlib.scan(src, path, _rules(), ALLOW_MARK)
+
+
+def _exempt(rel_str: str) -> bool:
+    return rel_str.startswith(EXEMPT_PREFIX)
+
+
+def check_file(path: Path):
+    rel_str = lintlib.rel_path(path)
+    if _exempt(rel_str):
+        return []
+    return check_source(path.read_text(encoding="utf-8"), rel_str)
+
+
+def main(argv):
+    argv, baseline = lintlib.split_baseline_arg(argv)
+    targets = argv or DEFAULT_TARGETS
+    findings = []
+    for t in targets:
+        p = (REPO / t) if not Path(t).is_absolute() else Path(t)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            findings.extend(check_file(f))
+    findings = lintlib.apply_baseline(findings, baseline)
+    lintlib.print_findings(findings)
+    if findings:
+        print(f"{len(findings)} finding(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

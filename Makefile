@@ -5,6 +5,7 @@ REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 .PHONY: test test-book test-onchip bench bench-onchip int8-bench \
 	serve-bench decode-bench ragged-bench health-bench phase-bench \
 	pass-bench pipeline-bench recovery-drill recovery-bench \
+	serve-drill \
 	perf-compare lint-api lint-resilience lint-observability \
 	lint-collectives lint-passes lint-kernels analyze
 
@@ -53,6 +54,9 @@ recovery-drill:  ## fast in-process preempt→restore drill (window restore + pa
 
 recovery-bench:  ## measured recovery rung: per-phase seconds + MTTR into the bench record
 	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_RECOVERY=1 $(PY) bench.py
+
+serve-drill:     ## serving fault drills: replica_kill failover (token-exact), canary promotion, hedging
+	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_SERVE_DRILL=1 $(PY) bench.py
 
 # diff two BENCH records, exit nonzero on regression.  Defaults to the
 # two newest BENCH_*.json in the repo; override: make perf-compare \

@@ -65,6 +65,10 @@ recovery-bench`): the in-process recovery drill
 (distributed.recovery.inprocess_drill) restoring through the persisted
 health rollback window, recording per-phase recovery seconds + MTTR
 (PT_BENCH_RECOVERY_STEPS, PT_BENCH_RECOVERY_KILL knobs);
+PT_BENCH_SERVE_DRILL=1 → serving resilience rung (`make serve-drill`):
+the FaultPlan-driven serving drills (serving/drill.py — replica_kill
+failover with token-exact resume, canary promotion clean + rollback,
+hedged requests), recording failover MTTR and hedge win-rate;
 PT_BENCH_PIPELINE=1 → pipeline-as-policy A/B rung
 (parallel/gspmd/pipeline_policy.py): host-scheduled PipelineRunner vs
 the one-jit PipelinePolicy, gpipe vs 1f1b, microbatch sweep with
@@ -1739,6 +1743,45 @@ def measure_recovery(size):
     }
 
 
+def measure_serve_drill(size):
+    """PT_BENCH_SERVE_DRILL=1 (`make serve-drill`): the serving
+    resilience rung.  Runs the full FaultPlan-driven serving drill
+    (paddle_tpu/serving/drill.py — replica_kill failover with
+    token-exact resume, canary promotion clean + rollback, hedged
+    requests against a slow primary) and records the failover MTTR and
+    hedge win-rate in the BENCH schema, so serving-recovery regressions
+    gate like throughput regressions (tools/perf_compare.py)."""
+    from paddle_tpu.fluid.platform_utils import (
+        persistent_cache_deserialize_brittle)
+    from paddle_tpu.serving import drill
+
+    if persistent_cache_deserialize_brittle():
+        # same story as the decode-lane rung: warm persistent-cache
+        # deserialization seeds the 0.4.3x XLA:CPU heap corruption the
+        # drill's engine churn then trips — run the rung cache-off
+        from paddle_tpu import fluid
+
+        fluid.set_flags({"FLAGS_compile_cache_dir": ""})
+    report = drill.run_drill()
+    failover = report.get("failover", {})
+    hedge = report.get("hedge", {})
+    return {
+        "metric": "serve_failover_mttr_seconds",
+        "value": failover.get("mttr_s"),
+        "unit": "s",
+        "config": (f"serve drill 2-replica gpt-tiny "
+                   f"req{failover.get('requests')} "
+                   f"hedge{hedge.get('hedge_ms')}ms"
+                   + (" CPU-FALLBACK"
+                      if os.environ.get("PT_BENCH_FORCE_CPU") else "")),
+        "serve_drill_ok": report.get("ok"),
+        "serve_hedge_win_rate": hedge.get("hedge_win_rate"),
+        "serve_hedges_fired": hedge.get("hedges_fired"),
+        "serve_failovers": failover.get("failovers"),
+        "serve_drill": report,
+    }
+
+
 def measure(size):
     if (os.environ.get("PT_BENCH_PIPELINE") == "1"
             and "xla_force_host_platform_device_count"
@@ -1760,6 +1803,8 @@ def measure(size):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("PT_BENCH_SERVE_DRILL") == "1":
+        return measure_serve_drill(size)
     if os.environ.get("PT_BENCH_SERVE") == "1":
         return measure_serving(size)
     if os.environ.get("PT_BENCH_RAGGED") == "1":

@@ -68,6 +68,33 @@ Spec grammar — semicolon-separated rules:
     spike:loss:step:<k>[:<x>] multiply the loss by <x> (default 1000)
                               at step k — the loss-spike detector's
                               deterministic trigger
+    serve_error:<model>:req:<n>
+                              SERVING class (serving/router.py,
+                              docs/SERVING.md "Resilience"): the Nth
+                              serve request for <model> (or `*`) raises
+                              an injected server error at the router's
+                              dispatch edge (`on_serve(model)`) — the
+                              deterministic breaker/retry trigger.
+                              Counts are per model name.
+    serve_delay:<model>:req:<n>:<ms>
+                              sleep <ms> milliseconds before the Nth
+                              serve request for <model> — the
+                              deterministic hedge trigger (a slow
+                              primary loses to its hedge)
+    replica_kill:step:<n>     kill the DECODE SCHEDULER of whichever
+                              replica's decode-step counter reaches
+                              <n> first: `on_replica_step(name, step)`
+                              (called by DecodeEngine inside each
+                              decode step) raises a fatal injected
+                              error, the scheduler fans it to every
+                              live future (`_fail_all`) and dies — the
+                              router observes the death and fails the
+                              victim sequences over, exactly the
+                              mid-decode death class the serve drill
+                              measures
+    replica_kill:<name>:step:<n>
+                              same, but only the replica whose engine
+                              name is <name>
 
 Numeric rules are declarative: they do not fire from on_rpc/on_step but
 are read by `paddle_tpu.health.transpile.insert_health_sentinel` (via
@@ -101,7 +128,8 @@ import sys
 import threading
 
 __all__ = ["FaultPlan", "FaultInjected", "install", "uninstall", "active",
-           "on_rpc", "on_step", "on_round", "set_membership_hooks"]
+           "on_rpc", "on_step", "on_round", "on_serve", "on_replica_step",
+           "set_membership_hooks"]
 
 # lifecycle actions fired from on_step/on_round (vs per-RPC actions)
 _LIFECYCLE = ("kill", "preempt", "join", "leave")
@@ -111,6 +139,9 @@ _NUMERIC = ("nan", "inf", "spike")
 # orchestrated recovery drills consumed by distributed.recovery.run_drill
 # (never fired from the runtime hooks — the harness owns the signal)
 _DRILL_MODES = ("preempt+restore", "kill+restore")
+# serving-class actions fired from on_serve/on_replica_step (the router
+# dispatch edge and the decode step), never from on_rpc
+_SERVING = ("serve_error", "serve_delay", "replica_kill")
 
 _ENV = "PT_FAULT_PLAN"
 
@@ -118,6 +149,18 @@ _ENV = "PT_FAULT_PLAN"
 class FaultInjected(IOError):
     """Marker base for injected failures (also lets tests tell an injected
     fault from a real one)."""
+
+
+class InjectedServeError(FaultInjected):
+    """`serve_error:` rule fired at the router's dispatch edge — the
+    serving analog of `_server_error` (non-retryable; the breaker counts
+    it as a replica failure)."""
+
+
+class InjectedReplicaDeath(FaultInjected):
+    """`replica_kill:` rule fired inside a decode step — fatal to the
+    replica's scheduler thread (fanned to every live future), simulating
+    mid-decode replica death without losing the test process."""
 
 
 class _Rule:
@@ -186,6 +229,19 @@ class FaultPlan:
                 self.rules.append(_Rule(
                     "drill", bits[2], int(bits[3]),
                     (bits[1], bits[4] if len(bits) == 5 else None)))
+            elif action == "serve_error" and len(bits) == 4 and \
+                    bits[2] == "req":
+                self.rules.append(_Rule(action, bits[1], int(bits[3])))
+            elif action == "serve_delay" and len(bits) == 5 and \
+                    bits[2] == "req":
+                self.rules.append(
+                    _Rule(action, bits[1], int(bits[3]), float(bits[4])))
+            elif action == "replica_kill" and len(bits) == 3 and \
+                    bits[1] == "step":
+                self.rules.append(_Rule(action, "*", int(bits[2])))
+            elif action == "replica_kill" and len(bits) == 4 and \
+                    bits[2] == "step":
+                self.rules.append(_Rule(action, bits[1], int(bits[3])))
             else:
                 raise ValueError(f"bad fault rule {part!r} in {spec!r}")
 
@@ -208,6 +264,7 @@ class FaultPlan:
                     if r.cmd in (cmd_name, "*") and
                     r.action not in _LIFECYCLE and
                     r.action not in _NUMERIC and
+                    r.action not in _SERVING and
                     (r.action == "flaky" or r.n == n)]
         for r in fire:
             if r.action == "flaky":
@@ -276,6 +333,47 @@ class FaultPlan:
         return [{"kind": r.action, "target": r.cmd, "step": r.n,
                  "scale": r.arg}
                 for r in self.rules if r.action in _NUMERIC]
+
+    def on_serve(self, model):
+        """Serving-side hook: the router calls this once per request it
+        dispatches for `model` (and the promotion prober once per probe).
+        May sleep (`serve_delay`) or raise (`serve_error`).  Counts are
+        1-based and per model name; `*` rules match every model but
+        still count per model."""
+        rules = [r for r in self.rules if r.action in ("serve_error",
+                                                       "serve_delay")]
+        if not rules:
+            return
+        key = f"serve::{model}"
+        with self._lock:
+            n = self._counts[key] = self._counts.get(key, 0) + 1
+            fire = [r for r in rules
+                    if r.cmd in (model, "*") and r.n == n]
+        for r in fire:
+            self._record()
+            if r.action == "serve_delay":
+                import time
+                time.sleep(r.arg / 1000.0)
+            else:
+                raise InjectedServeError(
+                    f"fault-injection: injected serve error on "
+                    f"{model} request #{r.n}")
+
+    def on_replica_step(self, name, step):
+        """Decode-replica hook: `DecodeEngine` calls this inside each
+        decode step with its engine name and 1-based step count.  A
+        matching `replica_kill` rule raises `InjectedReplicaDeath` —
+        the scheduler's fan-out (`_fail_all`) turns it into exactly the
+        mid-decode replica death the router must fail over."""
+        for r in self.rules:
+            if r.action != "replica_kill":
+                continue
+            if r.cmd not in (name, "*") or r.n != int(step):
+                continue
+            self._record()
+            raise InjectedReplicaDeath(
+                f"fault-injection: replica {name!r} killed at decode "
+                f"step {step}")
 
     def on_step(self, step):
         """Trainer-side hook: call once per training step."""
@@ -346,3 +444,15 @@ def on_round(rnd):
     p = active()
     if p is not None:
         p.on_round(rnd)
+
+
+def on_serve(model):
+    p = active()
+    if p is not None:
+        p.on_serve(model)
+
+
+def on_replica_step(name, step):
+    p = active()
+    if p is not None:
+        p.on_replica_step(name, step)

@@ -49,6 +49,12 @@ def _parse_args(argv=None):
                              "FLAGS_selected_gpus per rank")
     parser.add_argument("--log_dir", type=str, default=None,
                         help="write per-rank logs here (workerlog.N)")
+    parser.add_argument("--aot_cache_dir", type=str, default=None,
+                        help="persistent ahead-of-time executable cache "
+                             "shared by every rank (exports "
+                             "FLAGS_aot_cache_dir): a restarted or "
+                             "replacement rank loads its executables "
+                             "instead of recompiling")
     parser.add_argument("--print_config", type=str2bool, default=True)
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=REMAINDER)
@@ -92,6 +98,10 @@ def start_procs(args):
     # one job-wide trace id for every rank (tools/merge_traces.py keys
     # cross-process timelines on it)
     base_env["PT_TRACE_ID"] = _tracing.job_trace_id()
+    if args.aot_cache_dir:
+        # every rank shares one AOT executable cache: rank 0's compiles
+        # are everyone else's (and every restart's) loads
+        base_env["FLAGS_aot_cache_dir"] = args.aot_cache_dir
 
     with ProcGroup(args.log_dir) as group:
         for i in range(nproc):

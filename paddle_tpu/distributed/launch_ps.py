@@ -58,6 +58,12 @@ def _parse_args(argv=None):
                         help="pserver shard snapshot dir for elastic "
                              "resume (default <log_dir>/snapshots when "
                              "--max_restarts > 0)")
+    parser.add_argument("--aot_cache_dir", type=str, default="",
+                        help="persistent AOT executable cache for every "
+                             "role (exports FLAGS_aot_cache_dir; default "
+                             "<log_dir>/aot_cache when --max_restarts > "
+                             "0): a relaunched pserver/trainer loads its "
+                             "executables instead of recompiling")
     parser.add_argument("--elastic", type=str2bool, nargs="?", const=True,
                         default=False,
                         help="elastic membership (FLAGS_elastic_ps for "
@@ -106,6 +112,15 @@ def start_procs(args):
         # pserver shards auto-snapshot + resume through this dir (the
         # listen_and_serv host op reads it)
         common["PT_PS_SNAPSHOT_DIR"] = snapshot_dir
+    aot_cache_dir = args.aot_cache_dir or (
+        os.path.join(args.log_dir, "aot_cache")
+        if args.max_restarts > 0 and args.log_dir else "")
+    if aot_cache_dir:
+        # the restart story's other half: snapshots recover STATE, the
+        # shared AOT cache recovers EXECUTABLES — a relaunched role is
+        # zero-compile (fluid.flags bootstraps FLAGS_aot_cache_dir
+        # from env)
+        common["FLAGS_aot_cache_dir"] = aot_cache_dir
     if args.print_config:
         # observability: allow — opt-in launcher banner (--print_config)
         print(f"launch_ps: servers={endpoints} workers={args.worker_num}"

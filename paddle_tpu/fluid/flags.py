@@ -230,6 +230,23 @@ _DEFS = {
     # pt_serve_rejected_total{reason="tenant_quota"} — one chatty tenant
     # cannot starve the shared decode queue.  0 = unlimited.
     "FLAGS_serving_tenant_quota": (0, int, True),
+    # serving resilience layer (serving/router.py, docs/SERVING.md
+    # "Resilience").  Replica-group size the drill harness / launchers
+    # build per model — the router itself holds however many replicas
+    # are add_replica()'d, this is the provisioning default.
+    "FLAGS_serving_replicas": (2, int, True),
+    # hedged requests on the stateless (prefill-only) lane: after this
+    # many ms without a primary result, a second replica gets a copy
+    # and the first result wins (pt_serve_hedges_total{outcome}).
+    # 0 = off; -1 = adaptive, arm from the router's rolling p99.
+    "FLAGS_serving_hedge_ms": (0, int, True),
+    # per-replica circuit breaker: this many CONSECUTIVE failures open
+    # the breaker (replica out of rotation), after
+    # FLAGS_serving_breaker_cooldown_ms one half-open probe request is
+    # let through — success closes, failure re-opens
+    # (pt_serve_breaker_state{replica}: 0=closed 1=half-open 2=open).
+    "FLAGS_serving_breaker_failures": (5, int, True),
+    "FLAGS_serving_breaker_cooldown_ms": (1000, int, True),
     # kernel-primitives layer (paddle_tpu/kernels/primitives/,
     # docs/KERNELS.md).  Measured tile-size autotune: when on, a
     # primitive that exposes candidates + a measure hook times them on

@@ -26,9 +26,13 @@ FLAGS_serving_batch_timeout_ms / FLAGS_serving_max_queue.
 
 from . import batching  # noqa: F401
 from . import decode  # noqa: F401
+from . import drill  # noqa: F401
 from . import engine  # noqa: F401
 from . import errors  # noqa: F401
+from . import frontend  # noqa: F401
 from . import kv_pool  # noqa: F401
+from . import promote  # noqa: F401
+from . import router  # noqa: F401
 from . import status  # noqa: F401
 from .batching import BucketPolicy
 from .decode import DecodeEngine, DecodeRequest
@@ -36,13 +40,22 @@ from .engine import Engine, model_signature
 from .errors import (FeedValidationError, ModelNotLoadedError,
                      PoolExhaustedError, ServingDeadlineError,
                      ServingError, ServingOverloadError)
+from .frontend import Frontend
 from .kv_pool import KVPool
+# NOTE: the promote() FUNCTION is not re-exported at package level — it
+# would shadow the `serving.promote` submodule binding.  Call
+# `serving.promote.promote(...)` (or import it from the submodule).
+from .promote import PromotionGates, WeightSet, capture_weights
+from .router import CircuitBreaker, Replica, Router, routerz_payload
 from .status import servez_payload
 
 __all__ = [
-    "batching", "decode", "engine", "errors", "kv_pool", "status",
+    "batching", "decode", "drill", "engine", "errors", "frontend",
+    "kv_pool", "promote", "router", "status",
     "Engine", "BucketPolicy", "model_signature", "servez_payload",
     "DecodeEngine", "DecodeRequest", "KVPool",
+    "Router", "Replica", "CircuitBreaker", "routerz_payload",
+    "Frontend", "WeightSet", "PromotionGates", "capture_weights",
     "ServingError", "ServingOverloadError", "ModelNotLoadedError",
     "FeedValidationError", "ServingDeadlineError", "PoolExhaustedError",
 ]

@@ -1,0 +1,269 @@
+"""Canary weight promotion with auto-rollback (docs/SERVING.md
+"Resilience").
+
+Closes the train→serve loop ROADMAP names ("zero-downtime weight
+promotion") robustness-first: a training checkpoint's parameter arrays
+are published into a RUNNING replica group one replica at a time, with
+a measured probe window between each step and automatic rollback on
+regression.
+
+Why a swap needs zero compiles: the decode lane's two executables are
+keyed by program signature, not by parameter VALUES — `WeightSet.apply`
+replaces arrays in the replica's scope under its `_exec_lock` and the
+programs/executables are untouched, so `pt_compile_cache_total` misses
+stay flat across the whole promotion (the drill harness gates on
+exactly that; with FLAGS_aot_cache_dir a RELAUNCHED replica is equally
+zero-compile, which is why the launchers now forward it).
+
+Promotion sequence per replica (the canary first, then the rest):
+
+  hold      `router.set_held(name)` — out of rotation, live traffic
+            routes to the other replicas (zero dropped requests)
+  quiesce   wait for the replica's live-sequence count to hit zero
+  swap      capture old arrays, apply the new WeightSet under
+            `_exec_lock`
+  probe     greedy-decode the probe prompts on the canary and gate on
+            (a) error rate, (b) per-probe latency ratio vs the same
+            replica's pre-swap probes, (c) greedy-token drift vs the
+            pre-swap streams — the logprob-drift proxy the greedy lane
+            exposes.  Probes route through
+            `fault_injection.on_serve(replica)` so a `serve_error:`
+            rule injects a deterministic canary regression.
+  verdict   gates pass → release the hold, promote the next replica;
+            any gate fails → restore the old arrays, release the hold,
+            book `pt_serve_promotions_total{outcome="rolled_back"}`
+            and stop.  All replicas converged → one
+            `{outcome="promoted"}` sample.
+
+Greedy-only caveat (same as failover): the drift gate compares argmax
+token streams, so it detects distribution shift only where it flips the
+argmax.  A sampling lane will need true logprob deltas.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["WeightSet", "PromotionGates", "promote", "capture_weights"]
+
+
+def _m_promotions():
+    from paddle_tpu import observability as obs
+
+    return obs.counter(
+        "pt_serve_promotions_total",
+        "Canary weight promotions by outcome: `promoted` (gates passed "
+        "on every replica, whole group converged on the new weights) "
+        "vs `rolled_back` (a probe gate failed; the canary's old "
+        "arrays were restored)", labels=("router", "outcome"))
+
+
+class WeightSet:
+    """Named parameter arrays — the unit a promotion publishes.
+
+    Build one `from_scope` (a training process's live parameters, or a
+    scratch scope a checkpoint was `fluid.io`-loaded into) or directly
+    from a `{name: ndarray}` dict.  `apply(scope)` replaces the arrays
+    by name; programs and executables are untouched (zero compiles)."""
+
+    def __init__(self, arrays):
+        self.arrays = {str(k): np.asarray(v) for k, v in arrays.items()}
+
+    @classmethod
+    def from_scope(cls, scope, names):
+        missing = [n for n in names if scope.find_var(n) is None]
+        if missing:
+            raise KeyError(
+                f"WeightSet.from_scope: {len(missing)} names not in "
+                f"scope (first: {missing[:3]})")
+        return cls({n: np.array(scope.find_var(n).get_tensor())
+                    for n in names})
+
+    def names(self):
+        return sorted(self.arrays)
+
+    def apply(self, scope):
+        for n, a in self.arrays.items():
+            scope.set(n, a)
+
+    def __len__(self):
+        return len(self.arrays)
+
+
+def capture_weights(scope, names):
+    """Snapshot `names` out of `scope` as a WeightSet (the rollback
+    save, or a training loop publishing its current parameters)."""
+    return WeightSet.from_scope(scope, names)
+
+
+class PromotionGates:
+    """The canary verdict thresholds.
+
+    max_error_rate     fraction of probe requests that may fail
+                       (default 0.0 — any probe error rolls back)
+    max_latency_ratio  canary mean probe latency / pre-swap mean probe
+                       latency ceiling (None = don't gate; the default
+                       8.0 is lenient — it catches a pathological swap,
+                       not noise)
+    max_drift          fraction of probe TOKENS that may differ from
+                       the pre-swap streams (None = don't gate — the
+                       right setting when the new weights are a real
+                       training delta; 0.0 gates a same-weights
+                       republish bit-exact)
+    """
+
+    def __init__(self, max_error_rate=0.0, max_latency_ratio=8.0,
+                 max_drift=None):
+        self.max_error_rate = float(max_error_rate)
+        self.max_latency_ratio = (None if max_latency_ratio is None
+                                  else float(max_latency_ratio))
+        self.max_drift = None if max_drift is None else float(max_drift)
+
+    def verdict(self, probe, baseline):
+        """(ok, reasons) for a post-swap `probe` vs the pre-swap
+        `baseline` (both from `_run_probes`)."""
+        reasons = []
+        if probe["error_rate"] > self.max_error_rate:
+            reasons.append(
+                f"error_rate {probe['error_rate']:.3f} > "
+                f"{self.max_error_rate:.3f}")
+        if self.max_latency_ratio is not None \
+                and baseline["mean_latency_s"] > 0:
+            ratio = probe["mean_latency_s"] / baseline["mean_latency_s"]
+            if ratio > self.max_latency_ratio:
+                reasons.append(
+                    f"latency ratio {ratio:.2f} > "
+                    f"{self.max_latency_ratio:.2f}")
+        if self.max_drift is not None:
+            drift = _token_drift(baseline["streams"], probe["streams"])
+            if drift > self.max_drift:
+                reasons.append(
+                    f"token drift {drift:.3f} > {self.max_drift:.3f}")
+        return not reasons, reasons
+
+
+def _token_drift(ref_streams, new_streams):
+    """Fraction of positions where the greedy streams disagree (a
+    failed probe counts every position as drifted)."""
+    total = mismatch = 0
+    for ref, new in zip(ref_streams, new_streams):
+        if ref is None or new is None:
+            n = max(len(ref or ()), len(new or ()), 1)
+            total += n
+            mismatch += n
+            continue
+        n = max(len(ref), len(new))
+        total += max(n, 1)
+        mismatch += sum(1 for i in range(n)
+                        if i >= len(ref) or i >= len(new)
+                        or ref[i] != new[i])
+    return mismatch / max(total, 1)
+
+
+def _run_probes(rep, prompts, max_new_tokens, timeout_s):
+    """Greedy-decode every probe prompt directly on `rep` (bypassing
+    the router — the canary is held out of rotation).  Each probe
+    passes the `fault_injection.on_serve` gate under the REPLICA name,
+    so a `serve_error:<replica>:req:N` rule lands deterministically in
+    this window."""
+    from paddle_tpu.distributed import fault_injection as _fault
+
+    streams, latencies, errors = [], [], 0
+    for prompt in prompts:
+        t0 = time.monotonic()
+        try:
+            _fault.on_serve(rep.name)
+            fut = rep.engine.submit(prompt, max_new_tokens)
+            streams.append(list(fut.result(timeout=timeout_s)))
+            latencies.append(time.monotonic() - t0)
+        except Exception:
+            errors += 1
+            streams.append(None)
+    return {
+        "streams": streams,
+        "errors": errors,
+        "error_rate": errors / max(len(prompts), 1),
+        "mean_latency_s": (sum(latencies) / len(latencies)
+                           if latencies else 0.0),
+    }
+
+
+def _quiesce(rep, timeout_s):
+    deadline = time.monotonic() + timeout_s
+    while rep.load() > 0:
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.005)
+    return True
+
+
+def promote(router, weights, *, probe_prompts, probe_max_new_tokens=8,
+            gates=None, quiesce_timeout_s=30.0, probe_timeout_s=60.0,
+            order=None):
+    """Publish `weights` (a WeightSet) into `router`'s decode replica
+    group one replica at a time with probe gates and auto-rollback.
+
+    Returns a report dict: ``outcome`` (`promoted` / `rolled_back`),
+    ``replicas`` (per-replica probe/verdict records in promotion
+    order), and on rollback ``rolled_back_on`` + ``reasons``.  Books
+    one `pt_serve_promotions_total{outcome}` sample either way.
+
+    ``order``: replica names, canary first (default: enrollment order).
+    Raises TimeoutError if a replica never quiesces (nothing was
+    swapped on that replica; earlier replicas KEEP the new weights —
+    re-run or roll back explicitly)."""
+    gates = gates if gates is not None else PromotionGates()
+    prompts = [list(p) for p in probe_prompts]
+    if not prompts:
+        raise ValueError("promote: probe_prompts must be non-empty — "
+                         "the gates need a measured probe window")
+    reps = {r.name: r for r in router.replicas("decode")}
+    if not reps:
+        raise ValueError(f"router {router.name!r} has no decode replicas")
+    names = list(order) if order is not None else list(reps)
+    unknown = [n for n in names if n not in reps]
+    if unknown:
+        raise KeyError(f"promote: unknown replicas {unknown}")
+
+    report = {"outcome": None, "replicas": [], "weights": len(weights)}
+    for name in names:
+        rep = reps[name]
+        router.set_held(name, True)
+        try:
+            if not _quiesce(rep, quiesce_timeout_s):
+                raise TimeoutError(
+                    f"promote: replica {name!r} did not quiesce within "
+                    f"{quiesce_timeout_s}s (load={rep.load()}) — no swap "
+                    f"performed on it")
+            baseline = _run_probes(rep, prompts, probe_max_new_tokens,
+                                   probe_timeout_s)
+            old = capture_weights(rep.engine.scope, weights.names())
+            # swap under the replica's dispatch lock: no decode step may
+            # read a half-applied parameter set
+            with rep.engine._exec_lock:
+                weights.apply(rep.engine.scope)
+            probe = _run_probes(rep, prompts, probe_max_new_tokens,
+                                probe_timeout_s)
+            ok, reasons = gates.verdict(probe, baseline)
+            rec = {"replica": name, "ok": ok, "reasons": reasons,
+                   "baseline": {k: baseline[k] for k in
+                                ("error_rate", "mean_latency_s")},
+                   "probe": {k: probe[k] for k in
+                             ("error_rate", "mean_latency_s")}}
+            report["replicas"].append(rec)
+            if not ok:
+                with rep.engine._exec_lock:
+                    old.apply(rep.engine.scope)
+                report["outcome"] = "rolled_back"
+                report["rolled_back_on"] = name
+                report["reasons"] = reasons
+                _m_promotions().labels(router=router.name,
+                                       outcome="rolled_back").inc()
+                return report
+        finally:
+            router.set_held(name, False)
+    report["outcome"] = "promoted"
+    _m_promotions().labels(router=router.name, outcome="promoted").inc()
+    return report

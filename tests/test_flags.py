@@ -277,6 +277,55 @@ def test_serving_flags_roundtrip(monkeypatch):
     importlib.reload(fl)  # restore defaults for other tests
 
 
+def test_serving_resilience_flags_roundtrip(monkeypatch):
+    """The serving-resilience flags (ISSUE 18 satellite): replica
+    count, hedge delay (0=off, -1=adaptive p99), breaker thresholds —
+    documented defaults, get/set, and env bootstrap."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("serving_replicas")["serving_replicas"] == 2
+    assert fl.get_flags("serving_hedge_ms")["serving_hedge_ms"] == 0
+    assert fl.get_flags("serving_breaker_failures")[
+        "serving_breaker_failures"] == 5
+    assert fl.get_flags("serving_breaker_cooldown_ms")[
+        "serving_breaker_cooldown_ms"] == 1000
+    try:
+        fl.set_flags({"FLAGS_serving_replicas": 4,
+                      "serving_hedge_ms": "-1",  # str parses; adaptive
+                      "FLAGS_serving_breaker_failures": 3,
+                      "serving_breaker_cooldown_ms": 250})
+        assert fl.get_flags(["serving_replicas", "serving_hedge_ms",
+                             "serving_breaker_failures",
+                             "serving_breaker_cooldown_ms"]) == {
+            "serving_replicas": 4,
+            "serving_hedge_ms": -1,
+            "serving_breaker_failures": 3,
+            "serving_breaker_cooldown_ms": 250}
+    finally:
+        fl.set_flags({"FLAGS_serving_replicas": 2,
+                      "FLAGS_serving_hedge_ms": 0,
+                      "FLAGS_serving_breaker_failures": 5,
+                      "FLAGS_serving_breaker_cooldown_ms": 1000})
+    monkeypatch.setenv("FLAGS_serving_replicas", "3")
+    monkeypatch.setenv("FLAGS_serving_hedge_ms", "20")
+    monkeypatch.setenv("FLAGS_serving_breaker_failures", "7")
+    monkeypatch.setenv("FLAGS_serving_breaker_cooldown_ms", "500")
+    importlib.reload(fl)
+    assert fl.get_flags("serving_replicas")["serving_replicas"] == 3
+    assert fl.get_flags("serving_hedge_ms")["serving_hedge_ms"] == 20
+    assert fl.get_flags("serving_breaker_failures")[
+        "serving_breaker_failures"] == 7
+    assert fl.get_flags("serving_breaker_cooldown_ms")[
+        "serving_breaker_cooldown_ms"] == 500
+    monkeypatch.delenv("FLAGS_serving_replicas")
+    monkeypatch.delenv("FLAGS_serving_hedge_ms")
+    monkeypatch.delenv("FLAGS_serving_breaker_failures")
+    monkeypatch.delenv("FLAGS_serving_breaker_cooldown_ms")
+    importlib.reload(fl)  # restore defaults for other tests
+
+
 def test_malformed_env_flag_warns_not_crashes(monkeypatch):
     import importlib
     import warnings as w

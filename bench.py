@@ -739,6 +739,12 @@ def measure_serving(size):
                                 if bs and bs["count"] else None),
             "executable_cache": {",".join(k): int(v)
                                  for k, v in sorted(cache.items())},
+            # per-request quantiles DERIVED FROM THE SPAN TREE (request-
+            # scoped traces, docs/OBSERVABILITY.md "Request tracing") —
+            # exact order statistics over individual requests, not the
+            # bucket-interpolated aggregate histogram above
+            "trace_quantiles": obs.reqtrace.request_quantiles(),
+            "reqtrace_enabled": obs.reqtrace.enabled(),
             "client_errors": errors[:5],
         }
         rec.update(_vs_baseline_rec(rps, rec["config"],
@@ -1778,6 +1784,15 @@ def measure_serve_drill(size):
         "serve_hedge_win_rate": hedge.get("hedge_win_rate"),
         "serve_hedges_fired": hedge.get("hedges_fired"),
         "serve_failovers": failover.get("failovers"),
+        # SLO alert latencies from the drill-asserts-alert gate: the
+        # availability page alert must fire during the kill and clear
+        # after recovery — its latencies regress-gate like MTTR
+        "slo_alert_fire_latency_s": failover.get("slo", {})
+        .get("fire_latency_s"),
+        "slo_alert_clear_latency_s": failover.get("slo", {})
+        .get("clear_latency_s"),
+        # trace-derived per-request TTFT/TPOT quantiles (span tree)
+        "trace_quantiles": failover.get("trace_quantiles"),
         "serve_drill": report,
     }
 

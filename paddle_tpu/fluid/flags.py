@@ -335,6 +335,24 @@ _DEFS = {
     # events, observability.events); empty = disabled.  The env override
     # PT_EVENT_LOG_DIR wins (launcher contract for children).
     "FLAGS_event_log_dir": ("", str, True),
+    # request-scoped serving traces (observability/reqtrace.py,
+    # docs/OBSERVABILITY.md "Request tracing"): every serving request
+    # becomes a span tree (request → attempt → serve → shared batch)
+    # with tail-based sampling into a bounded ring.  Default ON — the
+    # measured hot-path cost is within the serving CPU smoke's noise
+    # floor (docs/PERF.md "reqtrace overhead").
+    "FLAGS_reqtrace": (True, _parse_bool, True),
+    # completed-trace ring capacity (the tail-sampling window /tracez
+    # and the trace-derived bench quantiles read from)
+    "FLAGS_reqtrace_ring": (256, int, True),
+    # background SLO burn-rate evaluation period (observability/slo.py);
+    # the drill drives evaluate() itself at sub-second scale
+    "FLAGS_slo_eval_interval_s": (10.0, float, True),
+    # declarative SLO specs for the flag-driven evaluator, ';'-separated
+    # (slo.parse_specs grammar, e.g. "avail|availability|bad=pt_serve_
+    # failovers_total|total=pt_serve_requests_total|objective=0.999");
+    # empty = no background evaluator
+    "FLAGS_slo_specs": ("", str, True),
     # accepted no-ops (CUDA/allocator knobs with no TPU meaning)
     "FLAGS_fraction_of_gpu_memory_to_use": (0.92, float, False),
     "FLAGS_eager_delete_tensor_gb": (-1.0, float, False),

@@ -13,6 +13,11 @@ One schema, four surfaces:
   tracing     per-job trace id (env-propagated through launchers) and
               per-RPC span ids; chrome traces exported per process are
               merged across ranks by tools/merge_traces.py
+  reqtrace    request-scoped serving traces: span trees per request
+              (attempts, shared batch fan-in), tail-based sampling ring,
+              latency-histogram exemplars, /tracez
+  slo         declarative SLOs over registry families with multi-window
+              multi-burn-rate alerts (pt_slo_*, /sloz)
 
 Metric naming: ``pt_<layer>_<what>[_total|_seconds|_bytes]`` with labels
 for the variable dimensions — see docs/OBSERVABILITY.md for the full
@@ -24,6 +29,8 @@ from . import events  # noqa: F401
 from . import exposition  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiling  # noqa: F401
+from . import reqtrace  # noqa: F401
+from . import slo  # noqa: F401
 from . import tracing  # noqa: F401
 from .exposition import (MetricsServer, ensure_from_flags, parse_text,
                          register_page, render_json, render_text,
@@ -35,6 +42,7 @@ from .tracing import job_trace_id, new_span_id, process_identity
 
 __all__ = [
     "metrics", "exposition", "events", "tracing", "profiling",
+    "reqtrace", "slo",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "counter", "gauge", "histogram", "snapshot", "reset", "hist_quantile",
     "DEFAULT_BUCKETS",

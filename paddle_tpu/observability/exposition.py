@@ -88,11 +88,24 @@ def render_text(snapshot=None) -> str:
         label_names = fam.get("label_names", ())
         for values, sample in sorted(fam["samples"].items()):
             if fam["type"] == "histogram":
+                exemplars = sample.get("exemplars") or {}
                 for le, cum in sample["buckets"]:
-                    lines.append(
+                    line = (
                         f"{name}_bucket"
                         f"{_label_str(label_names, values, [('le', _fmt_value(le))])}"
                         f" {cum}")
+                    ex = exemplars.get(le)
+                    if ex is not None:
+                        # OpenMetrics exemplar: `# {labels} value` after
+                        # the bucket sample — how a trace id rides the
+                        # exposition (docs/OBSERVABILITY.md "Request
+                        # tracing")
+                        ex_labels, ex_value = ex
+                        body = ",".join(
+                            f'{k}="{_escape_label_value(str(v))}"'
+                            for k, v in sorted(ex_labels.items()))
+                        line += f" # {{{body}}} {_fmt_value(ex_value)}"
+                    lines.append(line)
                 lines.append(f"{name}_sum{_label_str(label_names, values)}"
                              f" {_fmt_value(sample['sum'])}")
                 lines.append(f"{name}_count{_label_str(label_names, values)}"
@@ -212,6 +225,27 @@ def parse_text(text: str):
             continue
         if line.startswith("#"):
             continue  # comment
+        # OpenMetrics exemplar appendix: `... # {labels} value` after a
+        # bucket sample.  Split it off first — the label parse below
+        # rpartitions on the LAST '}', which would otherwise be the
+        # exemplar's closing brace.
+        exemplar = None
+        ex_at = line.rfind(" # {")
+        if ex_at > 0:
+            ex_part = line[ex_at + len(" # "):]
+            line = line[:ex_at]
+            ex_body, _, ex_val = ex_part.rpartition("}")
+            if not ex_body.startswith("{") or not ex_val.strip():
+                raise ExpositionParseError(
+                    f"line {lineno}: malformed exemplar: {raw}")
+            try:
+                ex_value = float(ex_val.strip().replace("+Inf", "inf")
+                                 .replace("-Inf", "-inf"))
+            except ValueError:
+                raise ExpositionParseError(
+                    f"line {lineno}: bad exemplar value "
+                    f"{ex_val.strip()!r}") from None
+            exemplar = (_parse_labels(ex_body[1:], raw), ex_value)
         # sample line: name[{labels}] value
         if "{" in line:
             name, _, rest = line.partition("{")
@@ -241,6 +275,11 @@ def parse_text(text: str):
                 labels = dict(labels, __sample__=suffix.lstrip("_"))
                 break
         family(base)["samples"].append((labels, value))
+        if exemplar is not None:
+            # kept beside (not inside) the samples so exemplar-free
+            # consumers see the exact legacy shape
+            family(base).setdefault("exemplars", []).append(
+                (labels, exemplar[0], exemplar[1]))
     return out
 
 
@@ -422,6 +461,15 @@ def ensure_from_flags():
         port = int(flags.flag("metrics_port"))
     except Exception:
         return None
+    # same construction edge also arms the flag-driven SLO evaluator
+    # (FLAGS_slo_specs; no-op when the flag is empty) — one hook, every
+    # process that runs a program gets both surfaces
+    try:
+        from . import slo as _slo
+
+        _slo.ensure_from_flags()
+    except Exception:
+        pass
     if port <= 0 or port == _failed_port:
         return None
     with _server_lock:

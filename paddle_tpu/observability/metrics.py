@@ -44,7 +44,8 @@ _INF = float("inf")
 class _Child:
     """One labeled time series of a family."""
 
-    __slots__ = ("_family", "_value", "_bucket_counts", "_sum", "_count")
+    __slots__ = ("_family", "_value", "_bucket_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, family):
         self._family = family
@@ -53,6 +54,10 @@ class _Child:
             self._bucket_counts = [0] * (len(family.buckets) + 1)  # +Inf
             self._sum = 0.0
             self._count = 0
+            # last exemplar per bucket: None | (labels_dict, value) —
+            # the OpenMetrics attachment reqtrace uses to pin a trace id
+            # onto the observation that landed in each bucket
+            self._exemplars = [None] * (len(family.buckets) + 1)
 
     # -- counter / gauge -------------------------------------------------
     def inc(self, amount=1.0):
@@ -81,10 +86,17 @@ class _Child:
             return self._value
 
     # -- histogram -------------------------------------------------------
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
+        """Record one observation.  ``exemplar`` (optional) attaches an
+        OpenMetrics exemplar to the bucket this observation lands in: a
+        trace-id string (stored as ``{"trace_id": ...}``) or a label
+        dict.  Last writer per bucket wins — exemplars are pointers to
+        representative traces, not a second histogram."""
         if self._family.type != "histogram":
             raise TypeError(f"{self._family.type} has no observe()")
         v = float(value)
+        if exemplar is not None and not isinstance(exemplar, dict):
+            exemplar = {"trace_id": str(exemplar)}
         with self._family._lock:
             # first bucket whose upper bound contains v (le semantics);
             # falls through to the +Inf bucket
@@ -96,18 +108,28 @@ class _Child:
             self._bucket_counts[idx] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                self._exemplars[idx] = (dict(exemplar), v)
 
     def hist_data(self):
         """-> {"buckets": [(le, CUMULATIVE count)], "sum": s, "count": n}
         (Prometheus exposition semantics: each bucket includes all lower
-        ones; the +Inf bucket equals count)."""
+        ones; the +Inf bucket equals count).  When any bucket carries an
+        exemplar, an ``"exemplars"`` key maps that bucket's ``le`` to
+        ``(labels_dict, observed_value)`` — absent otherwise, so
+        exemplar-free histograms keep their exact legacy shape."""
         with self._family._lock:
-            cum, out = 0, []
-            for ub, c in zip((*self._family.buckets, _INF),
-                             self._bucket_counts):
+            cum, out, ex = 0, [], {}
+            for ub, c, e in zip((*self._family.buckets, _INF),
+                                self._bucket_counts, self._exemplars):
                 cum += c
                 out.append((ub, cum))
-            return {"buckets": out, "sum": self._sum, "count": self._count}
+                if e is not None:
+                    ex[ub] = (dict(e[0]), e[1])
+            data = {"buckets": out, "sum": self._sum, "count": self._count}
+            if ex:
+                data["exemplars"] = ex
+            return data
 
 
 class _Family:
@@ -152,8 +174,8 @@ class _Family:
     def set(self, value):
         self._default_child().set(value)
 
-    def observe(self, value):
-        self._default_child().observe(value)
+    def observe(self, value, exemplar=None):
+        self._default_child().observe(value, exemplar=exemplar)
 
     @property
     def value(self):

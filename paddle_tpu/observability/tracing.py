@@ -22,8 +22,8 @@ importable without jax.
 
 from __future__ import annotations
 
+import itertools
 import os
-import threading
 import uuid
 
 __all__ = ["job_trace_id", "new_span_id", "new_wire_span", "format_wire_span",
@@ -33,8 +33,9 @@ _TRACE_ENV = "PT_TRACE_ID"
 _RUN_ENV = "PT_RUN_ID"
 _ROLE_ENV = "PT_TRACE_ROLE"
 
-_lock = threading.Lock()
-_span_counter = 0
+# itertools.count: next() is a single C call, atomic under the GIL — the
+# serving hot path mints several ids per request, so no lock here
+_span_counter = itertools.count(1)
 
 
 def job_trace_id() -> str:
@@ -60,11 +61,7 @@ def run_id() -> str:
 def new_span_id() -> str:
     """Process-unique span id: pid-prefixed counter (cheap, ordered,
     unique across the job because pids differ per process)."""
-    global _span_counter
-    with _lock:
-        _span_counter += 1
-        n = _span_counter
-    return f"{os.getpid():x}-{n:x}"
+    return f"{os.getpid():x}-{next(_span_counter):x}"
 
 
 def new_wire_span():
@@ -73,10 +70,7 @@ def new_wire_span():
     other telemetry surface uses — the same id, so a client-side `rpc`
     event and the server's journaled handling record correlate exactly.
     Returns (wire_u64, span_str)."""
-    global _span_counter
-    with _lock:
-        _span_counter += 1
-        n = _span_counter
+    n = next(_span_counter)
     pid = os.getpid()
     return ((pid & 0xffffffff) << 32) | (n & 0xffffffff), f"{pid:x}-{n:x}"
 

@@ -111,15 +111,54 @@ def _prompts(cfg, n, plen=4, seed=11):
 
 
 def failover_drill(n_requests=6, max_new_tokens=8, kill_after=2,
-                   timeout_s=300.0):
+                   timeout_s=300.0, slo_clear_timeout_s=20.0):
     """replica_kill mid-decode under load → router failover, token-exact
-    resumed streams, recovery seconds booked, zero compile misses."""
+    resumed streams, recovery seconds booked, zero compile misses —
+    AND the availability SLO's page alert must FIRE during the kill and
+    CLEAR after recovery (the drill measures alert latency, not just
+    data-path recovery: an outage nobody is paged for is not survived,
+    docs/OBSERVABILITY.md "SLOs & burn-rate alerts")."""
     from paddle_tpu.distributed import fault_injection as _fault
+    from paddle_tpu.observability import reqtrace as _reqtrace
+    from paddle_tpu.observability import slo as _slo
     from paddle_tpu.serving.router import Router
 
     cfg, _scopes, engines, _names = _build_decode_group(2)
     r0, r1 = engines
     router = None
+    # the production spec shape over the production families, with the
+    # SRE-workbook page window compressed to drill scale (seconds, not
+    # hours): bad = failovers booked by THIS router, total = admitted
+    # serving requests
+    spec = _slo.parse_spec(
+        "drill_availability|availability"
+        "|bad=pt_serve_failovers_total{router=drill}"
+        "|total=pt_serve_requests_total"
+        "|objective=0.999")
+    slo_eng = _slo.SLOEngine(
+        [spec], windows=(_slo.BurnWindow("page", 1.0, 4.0, 14.4),))
+    marks = {"t_kill": None, "t_fired": None, "t_cleared": None}
+    stop_poll = threading.Event()
+
+    def _poll_slo():
+        # evaluate FIRST, wait after: the kill lands within ~100 ms of
+        # submission — a wait-first loop could take its first sample
+        # with the failovers already booked, and a window whose every
+        # sample is post-failure has zero delta (no fire, ever)
+        while True:
+            if marks["t_kill"] is None and not r0.healthy():
+                marks["t_kill"] = time.monotonic()
+            slo_eng.evaluate()
+            st = slo_eng.alert_state("drill_availability", "page")
+            if st["active"] and marks["t_fired"] is None:
+                marks["t_fired"] = time.monotonic()
+            if (not st["active"] and marks["t_fired"] is not None
+                    and marks["t_cleared"] is None):
+                marks["t_cleared"] = time.monotonic()
+                return
+            if stop_poll.wait(0.02):
+                return
+
     try:
         prompts = _prompts(cfg, n_requests)
         # uninterrupted baseline on replica0 alone (greedy oracle)
@@ -133,14 +172,44 @@ def failover_drill(n_requests=6, max_new_tokens=8, kill_after=2,
         misses_before = _compile_misses()
         router = Router([r0, r1], name="drill", hedge_ms=0,
                         probe_interval_ms=20)
+        # pre-kill baseline sample: every burn window needs a healthy
+        # base to delta against
+        slo_eng.evaluate()
+        poller = threading.Thread(target=_poll_slo, daemon=True)
+        poller.start()
         t0 = time.monotonic()
         futs = [router.submit(p, max_new_tokens) for p in prompts]
         outs = [f.result(timeout=timeout_s) for f in futs]
         wall_s = time.monotonic() - t0
+        t_recovered = time.monotonic()
+        # the kill window is over and counters have stopped moving: the
+        # short burn window must drain and the alert must CLEAR
+        deadline = time.monotonic() + float(slo_clear_timeout_s)
+        while marks["t_cleared"] is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        stop_poll.set()
+        poller.join(timeout=5)
         misses_delta = _compile_misses() - misses_before
         token_exact = outs == baseline
         stats = router.stats()
         rec = _recovery_hist("drill")
+        alert = slo_eng.alert_state("drill_availability", "page")
+        slo_report = {
+            "spec": spec.describe(),
+            "alert_fired": marks["t_fired"] is not None,
+            "alert_cleared": marks["t_cleared"] is not None,
+            "fire_latency_s": round(
+                marks["t_fired"] - marks["t_kill"], 3)
+            if marks["t_fired"] is not None
+            and marks["t_kill"] is not None else None,
+            "clear_latency_s": round(
+                marks["t_cleared"] - t_recovered, 3)
+            if marks["t_cleared"] is not None else None,
+            "fired_total": alert["fired_total"],
+        }
+        # trace-derived per-request quantiles (span tree, not the
+        # aggregate histogram): the drill's requests are attributable
+        quantiles = _reqtrace.request_quantiles()
         report = {
             "requests": n_requests,
             "max_new_tokens": max_new_tokens,
@@ -153,12 +222,17 @@ def failover_drill(n_requests=6, max_new_tokens=8, kill_after=2,
             if rec["count"] else None,
             "compile_miss_delta": misses_delta,
             "wall_s": round(wall_s, 3),
+            "slo": slo_report,
+            "trace_quantiles": quantiles,
         }
         report["ok"] = (token_exact and report["replica0_died"]
                         and stats["failovers"] > 0
-                        and rec["count"] > 0 and misses_delta == 0)
+                        and rec["count"] > 0 and misses_delta == 0
+                        and slo_report["alert_fired"]
+                        and slo_report["alert_cleared"])
         return report
     finally:
+        stop_poll.set()
         _fault.uninstall()
         if router is not None:
             router.close()

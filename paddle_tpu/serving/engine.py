@@ -33,6 +33,8 @@ import time
 
 import numpy as np
 
+from paddle_tpu.observability import reqtrace as _reqtrace
+
 from .batching import BucketPolicy, Request, assemble_batch, split_outputs, \
     pad_seq
 from .errors import (FeedValidationError, ModelNotLoadedError,
@@ -395,6 +397,28 @@ class _ModelLane:
         if self._metrics_epoch != obs.REGISTRY.epoch:
             self._bind_metrics()
 
+    def _serve_span(self, fut, rows, tenant):
+        """Engine-side serve span for one admitted request.  A router /
+        frontend caller carries its span in via reqtrace.attach() on the
+        submit edge (no signature change, so duck-typed fakes keep
+        working); a direct caller with no ambient span becomes its own
+        trace root.  Finishes when the request's future resolves."""
+        parent = _reqtrace.current_span()
+        if parent is not None:
+            span = _reqtrace.start_span(
+                f"serve:{self.name}", kind="serve", parent=parent,
+                attrs={"model": self.name, "tenant": tenant,
+                       "rows": rows})
+        else:
+            span = _reqtrace.start_request(
+                f"serve:{self.name}", kind="serve",
+                attrs={"model": self.name, "tenant": tenant,
+                       "rows": rows})
+        if span is not None:
+            fut.add_done_callback(
+                lambda f, s=span: _reqtrace.finish_future(s, f))
+        return span
+
     # -- feed validation edge ---------------------------------------------
 
     def _var(self, name):
@@ -575,6 +599,7 @@ class _ModelLane:
                 tenant = "__other__"
             req = Request(padded, rows, tenant, fut, key, seq_pad,
                           deadline_s=self.deadline_s)
+            req.span = self._serve_span(fut, rows, tenant)
             self._queue.append(req)
             self._queued_rows[key] += rows
             self._queue_depth.set(len(self._queue))
@@ -754,6 +779,15 @@ class _ModelLane:
         # exclusion from the latency SLO histograms below
         ph = _profiling.step_phases("serve", self.name,
                                     enabled=not warmup)
+        # one shared batch span: every traced request in the batch links
+        # to it (fan-in), so the span tree shows which requests rode the
+        # same device dispatch
+        bspan = None
+        if not warmup:
+            bspan = _reqtrace.start_batch(
+                f"batch:{self.name}",
+                attrs={"model": self.name, "rows": rows,
+                       "bucket": bucket})
         ph.__enter__()
         try:
             with ph.phase("feed_prep"):
@@ -795,6 +829,8 @@ class _ModelLane:
             # resolved before this point, so the fan-out never races a
             # set_result)
             ph.__exit__(type(e), e, None)
+            if bspan is not None:
+                bspan.finish("error", error=e)
             for r in batch:
                 if not r.future.set_running_or_notify_cancel():
                     continue
@@ -827,6 +863,15 @@ class _ModelLane:
                         f"{self.deadline_s * 1000:.0f} ms deadline in "
                         f"flight (FLAGS_serving_deadline_ms)"))
                 continue
+            if r.span is not None:
+                # attrs + fan-in link land BEFORE set_result: resolving
+                # the future finishes the serve span (and, for a direct
+                # caller, completes the whole trace)
+                if bspan is not None:
+                    r.span.link(bspan)
+                r.span.set_attr("queue_wait_s",
+                                max(t_batch - r.t_arrival, 0.0))
+                r.span.set_attr("execute_s", execute_s)
             if r.future.set_running_or_notify_cancel():
                 r.future.set_result(out)
             if not warmup:
@@ -836,10 +881,14 @@ class _ModelLane:
                 # (batch formation -> resolve) ≈ the total latency, so
                 # a p99 breach names the guilty phase on /servez
                 self._lat.observe(
-                    max(now - r.t_arrival, 0.0))
+                    max(now - r.t_arrival, 0.0),
+                    exemplar=(r.span.trace_id if r.span is not None
+                              else None))
                 self._queue_wait.observe(
                     max(t_batch - r.t_arrival, 0.0))
                 self._execute_hist.observe(execute_s)
+        if bspan is not None:
+            bspan.finish("ok", n_requests=len(batch))
         if not warmup:
             self._batch_size.observe(rows)
             self._rows["real"].inc(rows)

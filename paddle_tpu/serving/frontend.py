@@ -46,6 +46,7 @@ import time
 import numpy as np
 
 from paddle_tpu.distributed import resilience as _resilience
+from paddle_tpu.observability import reqtrace as _reqtrace
 
 from .errors import (FeedValidationError, ModelNotLoadedError,
                      ServingDeadlineError, ServingError,
@@ -111,6 +112,12 @@ class Frontend:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                trace = (payload.get("trace")
+                         if isinstance(payload, dict) else None)
+                if trace:
+                    # echo the request's trace id (minted or joined) so
+                    # a client can fetch it from /tracez by id
+                    self.send_header("x-pt-trace", str(trace))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -128,7 +135,8 @@ class Frontend:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     raw = self.rfile.read(length) if length else b""
-                    code, payload = frontend._handle_post(self.path, raw)
+                    code, payload = frontend._handle_post(
+                        self.path, raw, headers=self.headers)
                     self._send(code, payload)
                 except BrokenPipeError:
                     # client hung up mid-response; nothing left to write to
@@ -182,7 +190,7 @@ class Frontend:
             if self._inflight <= 0:
                 self._idle.set()
 
-    def _handle_post(self, path, raw):
+    def _handle_post(self, path, raw, headers=None):
         path = path.split("?", 1)[0]
         try:
             body = json.loads(raw.decode() or "{}")
@@ -195,21 +203,48 @@ class Frontend:
             self._admit()
         except ServingOverloadError as e:
             return _error_status(e), _error_body(e)
+        # the frontend is the trace mint: an `x-pt-trace` request header
+        # joins an upstream trace, else a fresh id; the root span rides
+        # the thread-local attach through the router into the engines
+        span = None
+        if path in ("/v1/generate", "/v1/infer"):
+            span = _reqtrace.start_request(
+                "generate" if path == "/v1/generate" else "infer",
+                trace_id=(headers.get("x-pt-trace") if headers else None),
+                attrs={"frontend": self.name})
+        code, error = None, None
         try:
-            if path == "/v1/generate":
-                return self._generate(body)
-            if path == "/v1/infer":
-                return self._infer(body)
-            return 404, {"error": f"no such path {path!r}"}
+            with _reqtrace.attach(span):
+                if path == "/v1/generate":
+                    code, payload = self._generate(body)
+                elif path == "/v1/infer":
+                    code, payload = self._infer(body)
+                else:
+                    code, payload = 404, {"error": f"no such path {path!r}"}
         except ServingError as e:
-            return _error_status(e), _error_body(e)
+            error = e
+            code, payload = _error_status(e), _error_body(e)
         except (ValueError, TypeError, KeyError) as e:
-            return 400, _error_body(e)
+            error = e
+            code, payload = 400, _error_body(e)
         except (TimeoutError, concurrent.futures.TimeoutError) as e:
             # 3.10: futures.TimeoutError is NOT the builtin alias yet
-            return 504, {"error": "Timeout", "message": str(e)}
+            error = e
+            code, payload = 504, {"error": "Timeout", "message": str(e)}
+        except BaseException as e:
+            error = e
+            raise
         finally:
             self._release()
+            if span is not None:
+                if error is None:
+                    span.finish("ok", http_status=code)
+                else:
+                    span.finish("error", error=error,
+                                http_status=code if code else 500)
+        if span is not None and isinstance(payload, dict):
+            payload["trace"] = span.trace_id
+        return code, payload
 
     def _generate(self, body):
         prompt = body.get("prompt")

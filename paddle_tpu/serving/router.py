@@ -52,6 +52,8 @@ import threading
 import time
 import weakref
 
+from paddle_tpu.observability import reqtrace as _reqtrace
+
 from .errors import (ModelNotLoadedError, ServingDeadlineError,
                      ServingOverloadError)
 
@@ -481,10 +483,25 @@ class Router:
         with backoff on the RetryPolicy; budget exhaustion re-raises
         the typed error."""
         outer = concurrent.futures.Future()
+        tspan = self._root_span(outer, "generate")
         self._dispatch_decode(outer, list(prompt), int(max_new_tokens),
                               eos_id, tenant, prefix=[], attempt=0,
-                              failovers=0, t_detect=None)
+                              failovers=0, t_detect=None, tspan=tspan)
         return outer
+
+    def _root_span(self, outer, name):
+        """The request's trace root: the caller's current span (the
+        Frontend attached one) — else mint a fresh trace here (direct
+        Router callers) whose root finishes when `outer` resolves."""
+        tspan = _reqtrace.current_span()
+        if tspan is not None:
+            return tspan
+        tspan = _reqtrace.start_request(name,
+                                        attrs={"router": self.name})
+        if tspan is not None:
+            outer.add_done_callback(
+                lambda f, s=tspan: _reqtrace.finish_future(s, f))
+        return tspan
 
     def generate(self, prompts, max_new_tokens, eos_id=None,
                  timeout=None):
@@ -493,7 +510,8 @@ class Router:
         return [f.result(timeout=timeout) for f in futs]
 
     def _dispatch_decode(self, outer, prompt, max_new_tokens, eos_id,
-                         tenant, prefix, attempt, failovers, t_detect):
+                         tenant, prefix, attempt, failovers, t_detect,
+                         tspan=None):
         from paddle_tpu.distributed import fault_injection as _fault
 
         if outer.cancelled():
@@ -506,14 +524,25 @@ class Router:
                     outer, self._no_replica("decode"), attempt,
                     lambda a: self._dispatch_decode(
                         outer, prompt, max_new_tokens, eos_id, tenant,
-                        prefix, a, failovers, t_detect))
+                        prefix, a, failovers, t_detect, tspan=tspan))
                 return
+            # one attempt span per dispatch: retries/failovers each get
+            # their own child, so the trace enumerates every replica the
+            # request touched (the Dapper attempt story)
+            att = _reqtrace.start_span(
+                f"dispatch:{rep.name}", kind="attempt", parent=tspan,
+                attrs={"replica": rep.name, "attempt": attempt,
+                       "failovers": failovers,
+                       "resumed": bool(prefix)})
             try:
                 _fault.on_serve(rep.name)
-                req = rep.engine.submit_request(
-                    prompt, max_new_tokens, eos_id=eos_id, tenant=tenant,
-                    prefix=prefix or None)
+                with _reqtrace.attach(att):
+                    req = rep.engine.submit_request(
+                        prompt, max_new_tokens, eos_id=eos_id,
+                        tenant=tenant, prefix=prefix or None)
             except ServingOverloadError as e:
+                if att is not None:
+                    att.finish("error", error=e)
                 if e.reason in self._DEATH:
                     rep.breaker.record_failure()
                     tried.add(rep.name)
@@ -522,9 +551,11 @@ class Router:
                     outer, e, attempt,
                     lambda a: self._dispatch_decode(
                         outer, prompt, max_new_tokens, eos_id, tenant,
-                        prefix, a, failovers, t_detect))
+                        prefix, a, failovers, t_detect, tspan=tspan))
                 return
-            except _fault.FaultInjected:
+            except _fault.FaultInjected as e:
+                if att is not None:
+                    att.finish("error", error=e)
                 rep.breaker.record_failure()
                 tried.add(rep.name)
                 continue  # injected dispatch-edge failure: next replica
@@ -535,14 +566,16 @@ class Router:
                     max(time.monotonic() - t_detect, 0.0))
                 t_detect = None
             self._watch_decode(outer, rep, req, prompt, max_new_tokens,
-                               eos_id, tenant, failovers)
+                               eos_id, tenant, failovers, tspan=tspan,
+                               att=att)
             return
 
     def _watch_decode(self, outer, rep, req, prompt, max_new_tokens,
-                      eos_id, tenant, failovers):
+                      eos_id, tenant, failovers, tspan=None, att=None):
         t_submit = time.monotonic()
 
         def _done(fut):
+            _reqtrace.finish_future(att, fut)
             exc = fut.exception()
             if exc is None:
                 rep.breaker.record_success()
@@ -565,7 +598,8 @@ class Router:
                     outer, exc, 0,
                     lambda a: self._dispatch_decode(
                         outer, prompt, max_new_tokens, eos_id, tenant,
-                        list(req.generated), a, failovers, None))
+                        list(req.generated), a, failovers, None,
+                        tspan=tspan))
                 return
             # death class: the scheduler fanned a fatal error to every
             # live future.  Fail this sequence over to a survivor,
@@ -581,7 +615,8 @@ class Router:
                 self._failovers += 1
             self._dispatch_decode(
                 outer, prompt, max_new_tokens, eos_id, tenant,
-                list(req.generated), 0, failovers + 1, t_detect)
+                list(req.generated), 0, failovers + 1, t_detect,
+                tspan=tspan)
 
         req.future.add_done_callback(_done)
 
@@ -595,7 +630,9 @@ class Router:
         cancelled.  Idempotent calls only — a hedged request may
         execute on BOTH replicas."""
         outer = concurrent.futures.Future()
-        self._dispatch_feed(outer, model, feed, tenant, attempt=0)
+        tspan = self._root_span(outer, "infer")
+        self._dispatch_feed(outer, model, feed, tenant, attempt=0,
+                            tspan=tspan)
         return outer
 
     def infer(self, model, feed, tenant="default", timeout=None):
@@ -612,7 +649,8 @@ class Router:
             return None  # adaptive with no history yet: no hedge
         return max(lat[int(0.99 * (len(lat) - 1))], 0.001)
 
-    def _dispatch_feed(self, outer, model, feed, tenant, attempt):
+    def _dispatch_feed(self, outer, model, feed, tenant, attempt,
+                       tspan=None):
         from paddle_tpu.distributed import fault_injection as _fault
 
         if outer.cancelled():
@@ -624,12 +662,20 @@ class Router:
                 self._retry_or_fail(
                     outer, self._no_replica("engine"), attempt,
                     lambda a: self._dispatch_feed(outer, model, feed,
-                                                  tenant, a))
+                                                  tenant, a, tspan=tspan))
                 return
+            att = _reqtrace.start_span(
+                f"dispatch:{primary.name}", kind="attempt", parent=tspan,
+                attrs={"replica": primary.name, "attempt": attempt,
+                       "hedge": False})
             try:
                 _fault.on_serve(primary.name)
-                fut = primary.engine.submit(model, feed, tenant=tenant)
+                with _reqtrace.attach(att):
+                    fut = primary.engine.submit(model, feed,
+                                                tenant=tenant)
             except ServingOverloadError as e:
+                if att is not None:
+                    att.finish("error", error=e)
                 if e.reason in self._DEATH:
                     primary.breaker.record_failure()
                     tried.add(primary.name)
@@ -637,9 +683,11 @@ class Router:
                 self._retry_or_fail(
                     outer, e, attempt,
                     lambda a: self._dispatch_feed(outer, model, feed,
-                                                  tenant, a))
+                                                  tenant, a, tspan=tspan))
                 return
-            except _fault.FaultInjected:
+            except _fault.FaultInjected as e:
+                if att is not None:
+                    att.finish("error", error=e)
                 primary.breaker.record_failure()
                 tried.add(primary.name)
                 continue
@@ -647,13 +695,15 @@ class Router:
         t0 = time.monotonic()
         state = {"winner": None, "errors": [], "branches": 1,
                  "hedged": False, "timer": None,
-                 "futs": {"primary": fut}}
+                 "futs": {"primary": fut},
+                 "spans": {"primary": att}}
         lock = threading.Lock()
 
         def _finish(which, rep, f):
             """First successful branch wins outer; a branch error waits
             for the other branch before propagating; cancellation (the
             hedge loser) just retires its branch."""
+            _reqtrace.finish_future(state["spans"].get(which), f)
             with lock:
                 if state["winner"] is not None:
                     return
@@ -672,6 +722,7 @@ class Router:
                         self._hedges[outcome] += 1
                     loser = ("hedge" if which == "primary" else "primary")
                     to_cancel = state["futs"].get(loser)
+                    loser_span = state["spans"].get(loser)
                     last_exc = None
                 else:
                     exc = f.exception()
@@ -688,6 +739,10 @@ class Router:
                 self._latencies.append(time.monotonic() - t0)
                 if to_cancel is not None and not to_cancel.done():
                     to_cancel.cancel()
+                if loser_span is not None:
+                    # the loser loses even if the engine can no longer
+                    # abort it: the trace records who was discarded
+                    loser_span.finish("cancelled")
                 if outer.set_running_or_notify_cancel():
                     outer.set_result(f.result())
                 return
@@ -698,7 +753,7 @@ class Router:
                 self._retry_or_fail(
                     outer, last_exc, 0,
                     lambda a: self._dispatch_feed(outer, model, feed,
-                                                  tenant, a))
+                                                  tenant, a, tspan=tspan))
                 return
             if outer.set_running_or_notify_cancel():
                 outer.set_exception(last_exc)
@@ -710,18 +765,30 @@ class Router:
             hedge_rep = self._pick("engine", exclude=(primary.name,))
             if hedge_rep is None:
                 return
+            hatt = _reqtrace.start_span(
+                f"dispatch:{hedge_rep.name}", kind="attempt",
+                parent=tspan,
+                attrs={"replica": hedge_rep.name, "attempt": attempt,
+                       "hedge": True})
             try:
                 _fault.on_serve(hedge_rep.name)
-                hfut = hedge_rep.engine.submit(model, feed, tenant=tenant)
-            except Exception:
+                with _reqtrace.attach(hatt):
+                    hfut = hedge_rep.engine.submit(model, feed,
+                                                   tenant=tenant)
+            except Exception as e:
+                if hatt is not None:
+                    hatt.finish("error", error=e)
                 return  # the primary is still in flight; hedge is optional
             with lock:
                 if state["winner"] is not None:
                     hfut.cancel()
+                    if hatt is not None:
+                        hatt.finish("cancelled")
                     return
                 state["hedged"] = True
                 state["branches"] += 1
                 state["futs"]["hedge"] = hfut
+                state["spans"]["hedge"] = hatt
             hfut.add_done_callback(
                 lambda f: _finish("hedge", hedge_rep, f))
 

@@ -79,6 +79,13 @@ def live_decode_engines():
 def servez_payload():
     """JSON-serializable /servez body: one entry per live engine, plus
     the decode lane's section (slot occupancy, KV-pool figures,
-    eviction counts — docs/SERVING.md "Decode lane")."""
+    eviction counts — docs/SERVING.md "Decode lane") and the request-
+    trace ring's health (completed/kept/live counts plus trace-derived
+    request quantiles — the /tracez summary, docs/OBSERVABILITY.md
+    "Request tracing")."""
+    from paddle_tpu.observability import reqtrace
+
     return {"engines": [e.stats() for e in live_engines()],
-            "decode": [e.stats() for e in live_decode_engines()]}
+            "decode": [e.stats() for e in live_decode_engines()],
+            "reqtrace": {**reqtrace.ring_stats(),
+                         **reqtrace.request_quantiles()}}

@@ -37,7 +37,9 @@ def check_failover(reports):
     """replica_kill mid-decode under closed-loop load: victim sequences
     fail over to the survivor, every stream token-exact vs the
     uninterrupted baseline, pt_serve_recovery_seconds booked, compile
-    misses flat across the failover."""
+    misses flat across the failover — and the availability SLO's page
+    alert FIRES during the kill and CLEARS after recovery, with both
+    latencies in the report (the drill-asserts-alert gate)."""
     rep = drill.failover_drill()
     reports["failover"] = rep
     assert rep["replica0_died"], rep
@@ -46,6 +48,19 @@ def check_failover(reports):
     assert rep["recovery"]["count"] > 0, rep
     assert rep["mttr_s"] is not None and rep["mttr_s"] >= 0, rep
     assert rep["compile_miss_delta"] == 0, rep
+    slo = rep["slo"]
+    assert slo["alert_fired"], rep
+    assert slo["alert_cleared"], rep
+    assert slo["fire_latency_s"] is not None \
+        and slo["fire_latency_s"] >= 0, rep
+    assert slo["clear_latency_s"] is not None \
+        and slo["clear_latency_s"] >= 0, rep
+    assert slo["fired_total"] >= 1, rep
+    # trace-derived per-request quantiles (span tree, not the aggregate
+    # histogram) rode along with the drill's requests
+    q = rep["trace_quantiles"]
+    assert q["count"] > 0, rep
+    assert q["latency_s"]["p99"] >= q["latency_s"]["p50"] >= 0, rep
 
 
 def check_promotion_clean(reports):

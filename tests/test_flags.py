@@ -326,6 +326,53 @@ def test_serving_resilience_flags_roundtrip(monkeypatch):
     importlib.reload(fl)  # restore defaults for other tests
 
 
+def test_reqtrace_slo_flags_roundtrip(monkeypatch):
+    """The request-trace + SLO flags (ISSUE 19 satellite): tracing
+    on/off, trace-ring capacity, SLO evaluation cadence, and the
+    declarative spec string — documented defaults, get/set, and env
+    bootstrap."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("reqtrace")["reqtrace"] is True
+    assert fl.get_flags("reqtrace_ring")["reqtrace_ring"] == 256
+    assert fl.get_flags("slo_eval_interval_s")[
+        "slo_eval_interval_s"] == 10.0
+    assert fl.get_flags("slo_specs")["slo_specs"] == ""
+    spec = ("avail|availability|bad=pt_serve_rejected_total"
+            "|total=pt_serve_requests_total|objective=0.99")
+    try:
+        fl.set_flags({"FLAGS_reqtrace": "false",  # str parses
+                      "reqtrace_ring": 64,
+                      "FLAGS_slo_eval_interval_s": "2.5",
+                      "slo_specs": spec})
+        assert fl.get_flags(["reqtrace", "reqtrace_ring",
+                             "slo_eval_interval_s", "slo_specs"]) == {
+            "reqtrace": False, "reqtrace_ring": 64,
+            "slo_eval_interval_s": 2.5, "slo_specs": spec}
+    finally:
+        fl.set_flags({"FLAGS_reqtrace": True,
+                      "FLAGS_reqtrace_ring": 256,
+                      "FLAGS_slo_eval_interval_s": 10.0,
+                      "FLAGS_slo_specs": ""})
+    monkeypatch.setenv("FLAGS_reqtrace", "0")
+    monkeypatch.setenv("FLAGS_reqtrace_ring", "32")
+    monkeypatch.setenv("FLAGS_slo_eval_interval_s", "1.5")
+    monkeypatch.setenv("FLAGS_slo_specs", spec)
+    importlib.reload(fl)
+    assert fl.get_flags("reqtrace")["reqtrace"] is False
+    assert fl.get_flags("reqtrace_ring")["reqtrace_ring"] == 32
+    assert fl.get_flags("slo_eval_interval_s")[
+        "slo_eval_interval_s"] == 1.5
+    assert fl.get_flags("slo_specs")["slo_specs"] == spec
+    monkeypatch.delenv("FLAGS_reqtrace")
+    monkeypatch.delenv("FLAGS_reqtrace_ring")
+    monkeypatch.delenv("FLAGS_slo_eval_interval_s")
+    monkeypatch.delenv("FLAGS_slo_specs")
+    importlib.reload(fl)  # restore defaults for other tests
+
+
 def test_malformed_env_flag_warns_not_crashes(monkeypatch):
     import importlib
     import warnings as w

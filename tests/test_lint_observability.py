@@ -129,7 +129,99 @@ def test_metric_name_scanner_matches_registry_surface():
     # exact literals from several layers of the stack
     for expected in ("pt_step_seconds", "pt_step_phase_seconds",
                      "pt_serve_queue_wait_seconds",
-                     "pt_prefetch_stall_seconds_total", "pt_mfu"):
+                     "pt_prefetch_stall_seconds_total", "pt_mfu",
+                     "pt_slo_burn_rate", "pt_slo_alerts_total"):
         assert names.get(expected) is True, expected
     # the executor's f-string family surfaces as a prefix
     assert names.get("pt_xla_") is False
+
+
+# ---------------------------------------------------------------------------
+# metric-inventory drift (ISSUE 19 satellite): the code<->docs diff runs
+# as lint findings in both directions
+# ---------------------------------------------------------------------------
+
+
+def _drift_fixture(tmp_path, code, doc_rows):
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    (tree / "mod.py").write_text(code)
+    doc = tmp_path / "OBSERVABILITY.md"
+    doc.write_text("| metric | type | labels | reported by |\n"
+                   "|---|---|---|---|\n" + doc_rows)
+    return lint_observability.inventory_drift(
+        targets=[str(tree)], doc_path=str(doc))
+
+
+def test_undocumented_metric_flagged_at_registration_site(tmp_path):
+    findings = _drift_fixture(
+        tmp_path,
+        "from x import counter\n"
+        "c = counter('pt_test_documented_total', 'd')\n"
+        "u = counter('pt_test_missing_total', 'd')\n",
+        "| `pt_test_documented_total` | counter | — | here |\n")
+    assert [(f[2], f[1]) for f in findings] == [
+        ("undocumented-metric", 3)]
+    assert "pt_test_missing_total" in findings[0][3]
+    assert "undocumented-ok" in findings[0][3]  # message teaches the escape
+
+
+def test_undocumented_ok_mark_escapes_code_to_docs_direction(tmp_path):
+    findings = _drift_fixture(
+        tmp_path,
+        "from x import gauge\n"
+        "g = gauge('pt_test_experiment', 'd')"
+        "  # observability: undocumented-ok\n",
+        "")
+    assert findings == []
+
+
+def test_undocumented_ok_required_on_every_registration_site(tmp_path):
+    """One unmarked registration site of a family = drift, even when
+    another site carries the mark."""
+    findings = _drift_fixture(
+        tmp_path,
+        "from x import counter\n"
+        "a = counter('pt_test_dup_total', 'd')"
+        "  # observability: undocumented-ok\n"
+        "\n"
+        "b = counter('pt_test_dup_total', 'd')\n",
+        "")
+    assert [f[2] for f in findings] == ["undocumented-metric"]
+
+
+def test_ghost_metric_row_flagged_with_no_escape(tmp_path):
+    findings = _drift_fixture(
+        tmp_path,
+        "from x import counter\n"
+        "c = counter('pt_test_real_total', 'd')\n",
+        "| `pt_test_real_total` | counter | — | here |\n"
+        "| `pt_test_deleted_total` | counter | — | gone |\n")
+    assert [f[2] for f in findings] == ["ghost-metric-row"]
+    assert "pt_test_deleted_total" in findings[0][3]
+
+
+def test_fstring_prefix_family_matches_documented_names(tmp_path):
+    """An f-string registration (pt_xla_{kind}) is a prefix: it
+    documents against any row it prefixes, and its doc rows are not
+    ghosts."""
+    findings = _drift_fixture(
+        tmp_path,
+        "from x import gauge\n"
+        "def pub(kind):\n"
+        "    gauge(f'pt_test_fam_{kind}', 'd')\n",
+        "| `pt_test_fam_flops` | gauge | sig | cost model |\n")
+    assert findings == []
+
+
+def test_full_tree_run_includes_inventory_drift(capsys):
+    """`main([])` (the Makefile / tier-1 entry point) runs the drift
+    check over the real tree+doc — exit 0 proves the shipped inventory
+    is currently in sync, and the slo families are present on both
+    sides."""
+    assert lint_observability.main([]) == 0
+    sites = lint_observability._registration_sites()
+    doc = lint_observability._doc_inventory_names()
+    assert "pt_slo_burn_rate" in sites and "pt_slo_burn_rate" in doc
+    assert "pt_slo_error_budget_remaining" in doc
+    assert "pt_slo_alerts_total" in doc

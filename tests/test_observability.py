@@ -454,6 +454,51 @@ def test_merge_traces_cli(tmp_path):
     assert r.returncode == 0, r.stderr
     data = json.load(open(out))
     assert sum(1 for e in data["traceEvents"] if e["ph"] == "X") == 2
+    assert "request trace(s)" in r.stdout
+
+
+def test_merge_traces_builds_request_trace_index(tmp_path):
+    """Serving spans (reqtrace lands them with args.trace/span ids) are
+    indexed into ptRequestTraces: one request's spans across every
+    merged pid, ordered by re-based start time — a hedged request's
+    attempts line up across the replicas that ran them."""
+    import merge_traces
+
+    def span(name, ts, pid, args):
+        return {"name": name, "cat": "serve", "ph": "X", "ts": ts,
+                "dur": 2.0, "pid": pid, "tid": 1, "args": args}
+
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    # replica A: the request root + winning attempt; replica B (wall
+    # clock 1 s later): the cancelled hedge attempt + an untraced span
+    json.dump({"traceEvents": [
+        span("span:generate", 10.0, 1,
+             {"kind": "request", "trace": "tr1", "span": "s-root"}),
+        span("span:dispatch:fast", 12.0, 1,
+             {"kind": "attempt", "trace": "tr1", "span": "s-win",
+              "parent": "s-root", "links": ["s-batch"]}),
+    ], "ptMeta": {"wall_t0": 100.0, "pid": 1, "role": "r0", "rank": 0,
+                  "trace_id": "t"}}, open(a, "w"))
+    json.dump({"traceEvents": [
+        span("span:dispatch:slow", 3.0, 2,
+             {"kind": "attempt", "trace": "tr1", "span": "s-lose",
+              "parent": "s-root"}),
+        span("run", 1.0, 2, {"kind": "run"}),  # no trace id: not indexed
+    ], "ptMeta": {"wall_t0": 101.0, "pid": 2, "role": "r1", "rank": 0,
+                  "trace_id": "t"}}, open(b, "w"))
+
+    merged = merge_traces.merge([a, b])
+    idx = merged["ptRequestTraces"]
+    assert set(idx) == {"tr1"}
+    recs = idx["tr1"]
+    assert [r["span"] for r in recs] == ["s-root", "s-win", "s-lose"]
+    assert {r["pid"] for r in recs} == {1, 2}  # spans across both lanes
+    assert recs[1]["parent"] == "s-root"
+    assert recs[1]["links"] == ["s-batch"]
+    assert recs[1]["kind"] == "attempt"
+    # ts is the MERGED (re-based) time: replica B's span sits 1 s after
+    # replica A's epoch, so fan-in ordering is cross-process-correct
+    assert abs(recs[2]["ts"] - (3.0 + 1e6)) < 1.0
 
 
 # ---------------------------------------------------------------------------

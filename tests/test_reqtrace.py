@@ -1,0 +1,618 @@
+"""Request-scoped serving traces + SLO burn-rate engine (ISSUE 19).
+
+Span-tree shapes are driven through the REAL Router state machines with
+fake replicas (the test_serving_resilience.py story — no device, all
+tier-1 fast): hedge-win, hedge-cancel, retry, failover each leave the
+trace the Dapper model predicts.  Batch fan-in, tail-keep, exemplar
+round-trip, /tracez + /sloz, burn-rate arithmetic vs hand-computed
+values, and fire/clear hysteresis are unit-level.  The end-to-end proof
+(real engines, real batches, a real replica kill firing a real alert)
+lives in tests/test_serve_drill.py behind the subprocess wall.
+"""
+
+import concurrent.futures
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import reqtrace, slo
+from paddle_tpu.distributed.resilience import RetryPolicy
+from paddle_tpu.serving import Frontend, Router, ServingOverloadError
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_state():
+    fluid.set_flags({"FLAGS_reqtrace": True, "FLAGS_reqtrace_ring": 256})
+    reqtrace.reset()
+    yield
+    reqtrace.reset()
+    fluid.set_flags({"FLAGS_reqtrace": True, "FLAGS_reqtrace_ring": 256})
+
+
+def _wait_for(pred, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.002)
+
+
+def _last_trace():
+    traces = reqtrace.completed()
+    assert traces, "no completed traces in the ring"
+    return traces[-1]
+
+
+def _spans_by_kind(trace, kind):
+    return [s for s in trace["spans"] if s["kind"] == kind]
+
+
+# ---------------------------------------------------------------------------
+# span primitives
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_flag_short_circuits_every_constructor():
+    fluid.set_flags({"FLAGS_reqtrace": False})
+    assert reqtrace.start_request("r") is None
+    assert reqtrace.start_batch("b") is None
+    with reqtrace.attach(None):  # transparent no-op
+        assert reqtrace.current_span() is None
+        assert reqtrace.start_span("s") is None
+    fut = concurrent.futures.Future()
+    fut.set_result(1)
+    reqtrace.finish_future(None, fut)  # must not raise
+    assert reqtrace.completed() == []
+
+
+def test_span_finish_is_idempotent_first_status_wins():
+    root = reqtrace.start_request("r")
+    root.finish("cancelled")
+    root.finish("ok")
+    assert root.status == "cancelled"
+    assert _last_trace()["status"] == "cancelled"
+
+
+def test_attach_nests_and_restores():
+    root = reqtrace.start_request("outer")
+    with reqtrace.attach(root):
+        assert reqtrace.current_span() is root
+        child = reqtrace.start_span("inner")
+        with reqtrace.attach(child):
+            assert reqtrace.current_trace_id() == root.trace_id
+            assert reqtrace.current_span() is child
+        assert reqtrace.current_span() is root
+    assert reqtrace.current_span() is None
+    child.finish("ok")
+    root.finish("ok")
+
+
+def test_batch_fan_in_links_shared_span_into_both_traces():
+    """Two requests ride ONE batch span: each completed trace resolves
+    the link and carries the shared batch span's record."""
+    roots = [reqtrace.start_request(f"req{i}", attrs={"i": i})
+             for i in range(2)]
+    serves = []
+    for r in roots:
+        with reqtrace.attach(r):
+            serves.append(reqtrace.start_span("serve:m", kind="serve"))
+    batch = reqtrace.start_batch("batch:m", attrs={"rows": 2})
+    for s in serves:
+        s.link(batch)
+    batch.finish("ok", n_requests=2)
+    for s in serves:
+        s.finish("ok")
+    for r in roots:
+        r.finish("ok")
+
+    traces = reqtrace.completed()
+    assert len(traces) == 2
+    for t in traces:
+        serve = _spans_by_kind(t, "serve")[0]
+        assert serve["links"] == [batch.span_id]
+        shared = _spans_by_kind(t, "batch")
+        assert [b["span_id"] for b in shared] == [batch.span_id]
+        assert shared[0]["attrs"]["n_requests"] == 2
+    # the two traces are distinct but reference the SAME batch span
+    assert traces[0]["trace_id"] != traces[1]["trace_id"]
+
+
+def test_ttft_tpot_surface_from_serve_span_attrs():
+    root = reqtrace.start_request("gen")
+    with reqtrace.attach(root):
+        s = reqtrace.start_span("serve:e", kind="serve")
+    s.finish("ok", ttft_s=0.01, tpot_s=0.002, tokens=6)
+    root.finish("ok")
+    t = _last_trace()
+    assert t["ttft_s"] == 0.01 and t["tpot_s"] == 0.002
+    q = reqtrace.request_quantiles()
+    assert q["count"] == 1
+    assert q["ttft_s"]["p50"] == 0.01
+    assert q["tpot_s"]["p99"] == 0.002
+
+
+# ---------------------------------------------------------------------------
+# router span trees (fake replicas, real state machines)
+# ---------------------------------------------------------------------------
+
+
+class FakeEngine:
+    """Stateless replica: futures resolved by the test."""
+
+    def __init__(self, name, load=0, reject=0):
+        self.name = name
+        self._load = load
+        self._reject = reject  # typed-overload the first N submits
+        self.futs = []
+
+    def load(self):
+        return self._load
+
+    def submit(self, model, feed, tenant="default"):
+        if self._reject > 0:
+            self._reject -= 1
+            raise ServingOverloadError(f"{self.name} full",
+                                       reason="overload")
+        fut = concurrent.futures.Future()
+        self.futs.append(fut)
+        return fut
+
+
+class FakeDecodeEngine:
+    """Streaming replica: requests resolved/failed by the test."""
+
+    def __init__(self, name, load=0):
+        self.name = name
+        self._load = load
+        self._healthy = True
+        self.requests = []
+
+    def healthy(self):
+        return self._healthy
+
+    def load(self):
+        return self._load
+
+    def submit_request(self, prompt, max_new_tokens, eos_id=None,
+                       tenant="default", prefix=None):
+        if not self._healthy:
+            raise ServingOverloadError(f"{self.name} died",
+                                       reason="scheduler_failed")
+
+        class _Req:
+            pass
+
+        req = _Req()
+        req.prompt = list(prompt)
+        req.generated = list(prefix or [])
+        req.future = concurrent.futures.Future()
+        self.requests.append(req)
+        return req
+
+    def kill(self):
+        self._healthy = False
+        for req in self.requests:
+            if not req.future.done():
+                req.future.set_exception(ServingOverloadError(
+                    f"{self.name} died", reason="scheduler_failed"))
+
+
+def _router(replicas, **kw):
+    kw.setdefault("retry", RetryPolicy(times=2, backoff_ms=1, jitter=0.0))
+    kw.setdefault("hedge_ms", 0)
+    kw.setdefault("auto_probe", False)
+    return Router(replicas, **kw)
+
+
+def test_hedge_win_trace_marks_loser_cancelled():
+    """The hedge beats a stuck primary: the trace's root has TWO attempt
+    children — the hedge `ok` (hedge=True), the primary `cancelled`."""
+    slow = FakeEngine("slow", load=0)   # least-loaded: picked primary
+    fast = FakeEngine("fast", load=5)
+    with _router([slow, fast], hedge_ms=1) as r:
+        outer = r.submit_feed("m", {"x": 1})
+        _wait_for(lambda: fast.futs, msg="hedge dispatch")
+        fast.futs[0].set_result({"y": 2})
+        assert outer.result(timeout=5) == {"y": 2}
+        _wait_for(lambda: reqtrace.completed(), msg="trace completion")
+
+    t = _last_trace()
+    assert t["status"] == "ok"
+    root = [s for s in t["spans"] if s["parent_id"] is None][0]
+    assert root["kind"] == "request" and root["name"] == "infer"
+    assert root["attrs"]["router"] == "router"
+    atts = {s["name"]: s for s in _spans_by_kind(t, "attempt")}
+    assert set(atts) == {"dispatch:slow", "dispatch:fast"}
+    assert atts["dispatch:fast"]["status"] == "ok"
+    assert atts["dispatch:fast"]["attrs"]["hedge"] is True
+    assert atts["dispatch:slow"]["status"] == "cancelled"
+    assert atts["dispatch:slow"]["attrs"]["hedge"] is False
+    assert all(s["parent_id"] == root["span_id"] for s in atts.values())
+
+
+def test_hedge_lose_trace_marks_hedge_cancelled():
+    """The primary wins after the hedge fired: the hedge attempt is the
+    cancelled child."""
+    primary = FakeEngine("primary", load=0)
+    backup = FakeEngine("backup", load=5)
+    with _router([primary, backup], hedge_ms=1) as r:
+        outer = r.submit_feed("m", {"x": 1})
+        _wait_for(lambda: backup.futs, msg="hedge dispatch")
+        primary.futs[0].set_result({"y": 1})
+        assert outer.result(timeout=5) == {"y": 1}
+        _wait_for(lambda: reqtrace.completed(), msg="trace completion")
+
+    atts = {s["name"]: s for s in
+            _spans_by_kind(_last_trace(), "attempt")}
+    assert atts["dispatch:primary"]["status"] == "ok"
+    assert atts["dispatch:backup"]["status"] == "cancelled"
+    assert atts["dispatch:backup"]["attrs"]["hedge"] is True
+
+
+def test_retry_trace_enumerates_each_backoff_attempt():
+    """A typed admission rejection retried on the RetryPolicy leaves one
+    `error` attempt per rejection plus the final `ok` attempt, attempt
+    numbers ascending."""
+    eng = FakeEngine("e0", reject=2)
+    with _router([eng]) as r:
+        outer = r.submit_feed("m", {"x": 1})
+        _wait_for(lambda: eng.futs, msg="post-retry dispatch")
+        eng.futs[0].set_result({"y": 3})
+        assert outer.result(timeout=5) == {"y": 3}
+        _wait_for(lambda: reqtrace.completed(), msg="trace completion")
+
+    atts = sorted(_spans_by_kind(_last_trace(), "attempt"),
+                  key=lambda s: s["attrs"]["attempt"])
+    assert [s["status"] for s in atts] == ["error", "error", "ok"]
+    assert [s["attrs"]["attempt"] for s in atts] == [0, 1, 2]
+    assert all(s["name"] == "dispatch:e0" for s in atts)
+    assert "full" in atts[0]["attrs"]["error"]
+
+
+def test_failover_trace_shows_both_replicas_and_resume():
+    """A replica death mid-stream: the trace's first attempt errors on
+    the dead replica, the failover attempt on the survivor carries
+    resumed=True and the emitted-prefix handoff."""
+    r0 = FakeDecodeEngine("r0", load=0)  # least-loaded: picked first
+    r1 = FakeDecodeEngine("r1", load=5)
+    with _router([r0, r1]) as r:
+        outer = r.submit([1, 2], 8)
+        _wait_for(lambda: r0.requests, msg="primary dispatch")
+        r0.requests[0].generated = [7, 8]  # tokens emitted pre-death
+        r0.kill()
+        _wait_for(lambda: r1.requests, msg="failover dispatch")
+        assert r1.requests[0].generated == [7, 8]  # prefix carried
+        r1.requests[0].future.set_result([7, 8, 9])
+        assert outer.result(timeout=5) == [7, 8, 9]
+        _wait_for(lambda: reqtrace.completed(), msg="trace completion")
+
+    t = _last_trace()
+    root = [s for s in t["spans"] if s["parent_id"] is None][0]
+    assert root["name"] == "generate" and t["status"] == "ok"
+    atts = {s["name"]: s for s in _spans_by_kind(t, "attempt")}
+    assert atts["dispatch:r0"]["status"] == "error"
+    assert atts["dispatch:r0"]["attrs"]["resumed"] is False
+    assert atts["dispatch:r1"]["status"] == "ok"
+    assert atts["dispatch:r1"]["attrs"]["resumed"] is True
+    assert atts["dispatch:r1"]["attrs"]["failovers"] == 1
+
+
+def test_frontend_joins_upstream_trace_from_header():
+    """The HTTP front door is the trace mint: an `x-pt-trace` request
+    header joins the upstream trace, the id rides back in the response
+    header + payload, and the trace is retrievable by that id."""
+
+    class _Backend:
+        def submit(self, prompt, max_new_tokens, eos_id=None,
+                   tenant="default"):
+            fut = concurrent.futures.Future()
+            fut.set_result([int(p) for p in prompt])
+            return fut
+
+    fe = Frontend(_Backend(), port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fe.port}/v1/generate",
+            data=json.dumps({"prompt": [4, 5],
+                             "max_new_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json",
+                     "x-pt-trace": "upstream-ab12"})
+        resp = urllib.request.urlopen(req, timeout=10)
+        body = json.loads(resp.read().decode())
+        assert body["tokens"] == [4, 5]
+        assert body["trace"] == "upstream-ab12"
+        assert resp.headers["x-pt-trace"] == "upstream-ab12"
+    finally:
+        fe.close()
+    t = reqtrace.get_trace("upstream-ab12")
+    assert t is not None and t["status"] == "ok"
+    root = [s for s in t["spans"] if s["parent_id"] is None][0]
+    assert root["name"] == "generate"
+    assert root["attrs"]["frontend"] == "frontend"
+    assert root["attrs"]["http_status"] == 200
+
+
+# ---------------------------------------------------------------------------
+# exemplars (histogram -> exposition -> parser, golden round-trip)
+# ---------------------------------------------------------------------------
+
+
+def test_exemplar_rides_exposition_and_round_trips():
+    hist = obs.histogram("pt_test_reqtrace_exemplar_seconds",
+                         "exemplar round-trip", labels=("model",))
+    hist.labels(model="m").observe(0.02, exemplar="tr-feed-1")
+    hist.labels(model="m").observe(3.0, exemplar={"trace_id": "tr-slow",
+                                                  "kind": "decode"})
+    text = obs.render_text(obs.snapshot())
+    assert ('pt_test_reqtrace_exemplar_seconds_bucket'
+            '{model="m",le="0.025"} 1 # {trace_id="tr-feed-1"} 0.02'
+            in text)
+    assert '# {kind="decode",trace_id="tr-slow"} 3' in text
+
+    parsed = obs.parse_text(text)
+    exes = parsed["pt_test_reqtrace_exemplar_seconds"]["exemplars"]
+    by_id = {ex[1]["trace_id"]: ex for ex in exes}
+    labels, ex_labels, ex_value = by_id["tr-feed-1"]
+    assert labels["model"] == "m" and labels["le"] == "0.025"
+    assert ex_value == 0.02
+    assert by_id["tr-slow"][1]["kind"] == "decode"
+    # exemplar-free families keep the exact legacy shape
+    ctr = obs.counter("pt_test_reqtrace_plain_total", "plain")
+    ctr.inc()
+    reparsed = obs.parse_text(obs.render_text(obs.snapshot()))
+    assert "exemplars" not in reparsed["pt_test_reqtrace_plain_total"]
+
+
+def test_none_exemplar_is_ignored():
+    hist = obs.histogram("pt_test_reqtrace_noex_seconds", "no exemplar")
+    hist.observe(0.01, exemplar=None)
+    snap = obs.snapshot()["pt_test_reqtrace_noex_seconds"]
+    assert "exemplars" not in list(snap["samples"].values())[0]
+
+
+# ---------------------------------------------------------------------------
+# tail-based sampling ring
+# ---------------------------------------------------------------------------
+
+
+def _complete(name, status="ok", sleep_s=0.0):
+    root = reqtrace.start_request(name)
+    if sleep_s:
+        time.sleep(sleep_s)
+    root.finish(status)
+    return root
+
+
+def test_ring_eviction_honors_flag_cap():
+    fluid.set_flags({"FLAGS_reqtrace_ring": 4})
+    for i in range(10):
+        _complete(f"r{i}")
+    stats = reqtrace.ring_stats()
+    assert stats["size"] == 4 and stats["capacity"] == 4
+    # oldest evicted, newest retained
+    assert [t["name"] for t in reqtrace.completed()] == [
+        "r6", "r7", "r8", "r9"]
+    assert reqtrace.get_trace(reqtrace.completed()[-1]["trace_id"])
+    assert reqtrace.ring_stats()["live"] == 0
+
+
+def test_tail_keep_errors_always_outliers_after_history():
+    # below the history floor: ok traces are NOT kept regardless of
+    # latency (a 10 ms floor keeps the live p99 well above the genuinely
+    # fast traces below, so timing jitter cannot flip the verdicts)
+    for i in range(8):
+        _complete(f"fast{i}", sleep_s=0.01)
+    assert all(not t["kept"] for t in reqtrace.completed())
+    # errors are always kept
+    err = _complete("boom", status="error")
+    assert reqtrace.get_trace(err.trace_id)["kept"] is True
+    # a slow outlier (way past the live p99 of the fast history) is kept
+    slow = _complete("tail", sleep_s=0.05)
+    assert reqtrace.get_trace(slow.trace_id)["kept"] is True
+    # and an ordinary fast trace still is not
+    fast = _complete("ordinary")
+    assert reqtrace.get_trace(fast.trace_id)["kept"] is False
+    assert reqtrace.ring_stats()["kept"] == 2
+
+
+# ---------------------------------------------------------------------------
+# /tracez + /sloz exposition pages
+# ---------------------------------------------------------------------------
+
+
+def test_tracez_and_sloz_served_by_real_endpoint():
+    err = _complete("worst", status="error")
+    spec = slo.parse_spec(
+        "page_avail|availability|bad=pt_serve_failovers_total"
+        "|total=pt_serve_requests_total|objective=0.999")
+    eng = slo.track(slo.SLOEngine([spec]))
+    try:
+        eng.evaluate()
+        server = obs.MetricsServer(port=0)
+        try:
+            base = f"http://{server.host}:{server.port}"
+            tracez = urllib.request.urlopen(
+                f"{base}/tracez", timeout=10).read().decode()
+            assert err.trace_id in tracez
+            assert "KEPT" in tracez and "request:worst" in tracez
+            sloz = json.loads(urllib.request.urlopen(
+                f"{base}/sloz", timeout=10).read().decode())
+            assert sloz["n_engines"] >= 1
+            payload = [e for e in sloz["engines"]
+                       if any(s["name"] == "page_avail"
+                              for s in e["specs"])][0]
+            assert "page_avail/page" in payload["alerts"]
+            assert payload["windows"][0]["severity"] == "page"
+        finally:
+            server.stop()
+    finally:
+        slo.untrack(eng)
+
+
+def test_tracez_renders_span_tree_shape():
+    root = reqtrace.start_request("gen")
+    with reqtrace.attach(root):
+        att = reqtrace.start_span("dispatch:r0", kind="attempt",
+                                  attrs={"replica": "r0"})
+    att.finish("cancelled")
+    root.finish("ok")
+    text, ctype = reqtrace.tracez_payload()
+    assert ctype.startswith("text/plain")
+    assert "request:gen [ok]" in text
+    assert "attempt:dispatch:r0 [cancelled]" in text
+    # the attempt renders indented under its parent
+    lines = text.splitlines()
+    root_i = next(i for i, ln in enumerate(lines) if "request:gen" in ln)
+    assert lines[root_i + 1].startswith("    " + "  ")
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate arithmetic + hysteresis
+# ---------------------------------------------------------------------------
+
+
+def _fresh_slo_counters(tag):
+    bad = obs.counter(f"pt_test_slo_{tag}_bad_total", "bad",
+                      labels=("router",))
+    total = obs.counter(f"pt_test_slo_{tag}_total", "total")
+    spec = slo.parse_spec(
+        f"{tag}|availability|bad=pt_test_slo_{tag}_bad_total"
+        f"{{router=r}}|total=pt_test_slo_{tag}_total|objective=0.99")
+    return bad, total, spec
+
+
+def test_burn_rate_matches_hand_computed_values():
+    bad, total, spec = _fresh_slo_counters("hand")
+    eng = slo.SLOEngine(
+        [spec], windows=(slo.BurnWindow("page", 10.0, 60.0, 2.0),))
+
+    total.inc(100)
+    eng.evaluate(now=0.0)
+    # 5 s later: 200 more requests, 6 bad → window error ratio 0.03
+    total.inc(200)
+    bad.labels(router="r").inc(6)
+    bad.labels(router="other").inc(50)  # filtered out by the selector
+    out = eng.evaluate(now=5.0)
+
+    # burn = (Δbad/Δtotal)/(1-objective) = (6/200)/0.01 = 3.0 — same
+    # base sample for both windows this early, so short == long
+    st = out["hand"]["page"]
+    assert st["burn_short"] == pytest.approx(3.0)
+    assert st["burn_long"] == pytest.approx(3.0)
+    assert st["active"] is True  # 3.0 > 2.0 on BOTH windows
+
+    snap = obs.snapshot()
+    burns = snap["pt_slo_burn_rate"]["samples"]
+    assert burns[("hand", "page_short")] == pytest.approx(3.0)
+    assert burns[("hand", "page_long")] == pytest.approx(3.0)
+    # budget remaining = 1 - ratio_long/budget = 1 - 0.03/0.01 = -2
+    assert snap["pt_slo_error_budget_remaining"]["samples"][
+        ("hand",)] == pytest.approx(-2.0)
+    assert snap["pt_slo_alerts_total"]["samples"][("hand", "page")] == 1
+
+
+def test_alert_fire_and_clear_hysteresis():
+    bad, total, spec = _fresh_slo_counters("hyst")
+    eng = slo.SLOEngine(
+        [spec], windows=(slo.BurnWindow("page", 10.0, 60.0, 2.0),))
+
+    total.inc(100)
+    eng.evaluate(now=0.0)
+    total.inc(100)
+    bad.labels(router="r").inc(5)  # ratio 0.05 → burn 5.0 → fire
+    eng.evaluate(now=5.0)
+    st = eng.alert_state("hyst", "page")
+    assert st["active"] and st["fired_total"] == 1
+    assert st["t_fired"] == 5.0 and st["t_cleared"] is None
+
+    # still burning: no re-fire while active (the counter stays 1)
+    bad.labels(router="r").inc(5)
+    total.inc(100)
+    eng.evaluate(now=8.0)
+    assert eng.alert_state("hyst", "page")["fired_total"] == 1
+
+    # bleeding stopped: once the SHORT window slides past the incident
+    # the alert clears, even though the long window still remembers it
+    eng.evaluate(now=30.0)
+    st = eng.alert_state("hyst", "page")
+    assert st["active"] is False and st["t_cleared"] == 30.0
+    assert st["burn_short"] == 0.0
+    assert st["burn_long"] > 2.0  # long window alone must NOT re-fire
+    eng.evaluate(now=31.0)
+    assert eng.alert_state("hyst", "page")["fired_total"] == 1
+
+    cnt = obs.snapshot()["pt_slo_alerts_total"]["samples"]
+    assert cnt[("hyst", "page")] == 1
+
+
+def test_window_ratio_edge_cases():
+    # bad moved while total did not: all-bad, budget burns
+    assert slo.SLOEngine._window_ratio(
+        [(0.0, 0.0, 0.0), (1.0, 2.0, 0.0)], 1.0, 10.0) == 1.0
+    # nothing moved: zero burn
+    assert slo.SLOEngine._window_ratio(
+        [(0.0, 1.0, 5.0), (1.0, 1.0, 5.0)], 1.0, 10.0) == 0.0
+    # no samples
+    assert slo.SLOEngine._window_ratio([], 1.0, 10.0) == 0.0
+
+
+def test_latency_slo_counts_histogram_tail():
+    hist = obs.histogram("pt_test_slo_lat_seconds", "lat",
+                         labels=("model",))
+    for _ in range(9):
+        hist.labels(model="m").observe(0.001)
+    hist.labels(model="m").observe(9.0)
+    hist.labels(model="ignored").observe(9.0)
+    spec = slo.parse_spec(
+        "lat|latency|hist=pt_test_slo_lat_seconds{model=m}"
+        "|threshold=0.25|objective=0.9")
+    bad, total = spec.counts(obs.snapshot())
+    assert (bad, total) == (1.0, 10.0)
+
+
+def test_spec_grammar_rejects_malformed_input():
+    with pytest.raises(ValueError):
+        slo.parse_spec("just_a_name")
+    with pytest.raises(ValueError):
+        slo.parse_spec("x|availability|objective=0.9")  # no selectors
+    with pytest.raises(ValueError):
+        slo.parse_spec("x|latency|hist=h|threshold=0.1|objective=1.5")
+    with pytest.raises(ValueError):
+        slo.parse_spec("x|weird|bad=b|total=t")
+    specs = slo.parse_specs(
+        "a|availability|bad=b|total=t|objective=0.999; "
+        "b|latency|hist=h{model=m}|threshold=0.5|objective=0.99")
+    assert [s.name for s in specs] == ["a", "b"]
+    assert specs[1].hist == ("h", {"model": "m"})
+
+
+def test_flag_engine_bootstrap_and_bad_spec_warns():
+    fluid.set_flags({"FLAGS_slo_specs":
+                     "avail|availability|bad=pt_serve_failovers_total"
+                     "|total=pt_serve_requests_total|objective=0.999"})
+    try:
+        eng = slo.ensure_from_flags()
+        assert eng is not None
+        assert slo.ensure_from_flags() is eng  # idempotent
+        assert any(s["name"] == "avail"
+                   for e in slo.sloz_payload()["engines"]
+                   for s in e["specs"])
+    finally:
+        slo.stop_flag_engine()
+        fluid.set_flags({"FLAGS_slo_specs": ""})
+    # a typo must not take the process down: warn + disable
+    fluid.set_flags({"FLAGS_slo_specs": "broken spec no pipes"})
+    try:
+        with pytest.warns(UserWarning, match="SLO evaluator disabled"):
+            assert slo.ensure_from_flags() is None
+    finally:
+        slo.stop_flag_engine()
+        fluid.set_flags({"FLAGS_slo_specs": ""})

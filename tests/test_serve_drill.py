@@ -69,6 +69,19 @@ def test_failover_token_exact_and_recovery_booked(drill_results):
     _check(drill_results, "failover")
 
 
+def test_failover_drill_asserts_slo_alert_fire_and_clear(drill_results):
+    """The drill-asserts-alert gate: during the replica_kill the
+    availability SLO's page alert must FIRE (multi-window burn rate over
+    pt_serve_failovers_total / pt_serve_requests_total) and CLEAR after
+    recovery, with fire/clear latencies booked in the drill report
+    (child check — same child run, assertions in
+    serve_drill_checks.check_failover)."""
+    _check(drill_results, "failover")
+    slo = drill_results.get("reports", {}).get("failover", {}).get("slo")
+    assert slo, "failover report carries no slo section"
+    assert slo["alert_fired"] and slo["alert_cleared"], slo
+
+
 def test_promotion_clean_converges_zero_drops(drill_results):
     """Canary weight promotion over the live group: gates pass, every
     replica converges on the new arrays, concurrent router traffic

@@ -37,7 +37,16 @@ statically collects every ``pt_*`` family name registered through
 ``counter(...)``/``gauge(...)``/``histogram(...)`` call sites — the
 docs/OBSERVABILITY.md inventory-consistency test
 (tests/test_metrics_inventory.py) diffs it against the doc table in
-both directions.
+both directions.  The full-tree run (`make lint-observability`, no
+path args) performs the same diff as lint findings:
+
+  undocumented-metric   a registered family with no inventory row —
+                        escape a deliberate one with
+                        `# observability: undocumented-ok` on every
+                        registration site
+  ghost-metric-row      an inventory row naming a family no code
+                        registers (no escape — doc drift is always
+                        wrong)
 
 Usage: python tools/lint_observability.py [--baseline=FILE] [paths...]
   (no args = paddle_tpu/, repo-relative)
@@ -63,6 +72,10 @@ EXEMPT = (
 )
 
 ALLOW_MARK = "observability: allow"
+
+# escape for the code→docs inventory direction: a deliberately
+# undocumented metric family (must appear on EVERY registration site)
+UNDOC_MARK = "observability: undocumented-ok"
 
 # the raw timing calls the phase timer supersedes: module-attribute
 # calls like time.perf_counter() / _time.time() (any alias importing
@@ -139,12 +152,27 @@ def iter_metric_names(targets=None):
     ``obs.counter``, ``_metrics.histogram``, ``registry.gauge``...).
     Returns {name: exact} where exact=False marks an f-string prefix
     (e.g. ``pt_xla_``) that matches any documented name it prefixes."""
+    return {name: exact
+            for name, (exact, _escaped, _where)
+            in _registration_sites(targets).items()}
+
+
+def _registration_sites(targets=None):
+    """{metric: (exact, escaped, "path:lineno")} for every pt_* family
+    registration in the tree.  ``escaped`` is True when the call site
+    (or the line above) carries the `# observability: undocumented-ok`
+    mark — an intentionally-undocumented family (an experiment, a
+    soon-to-die shim) exempted from the code→docs inventory direction.
+    The docs→code direction has no escape: a documented ghost row is
+    always drift."""
     out = {}
     for f in iter_files(targets or DEFAULT_TARGETS):
+        src = f.read_text()
         try:
-            tree = ast.parse(f.read_text(), filename=str(f))
+            tree = ast.parse(src, filename=str(f))
         except SyntaxError:
             continue
+        src_lines = src.splitlines()
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
@@ -154,9 +182,78 @@ def iter_metric_names(targets=None):
             if name not in ("counter", "gauge", "histogram"):
                 continue
             metric, exact = _literal_prefix(node.args[0])
-            if metric and metric.startswith("pt_"):
-                out[metric] = out.get(metric, True) and exact
+            if not metric or not metric.startswith("pt_"):
+                continue
+            escaped = lintlib.allowed(src_lines, node.lineno,
+                                      UNDOC_MARK)
+            prev_exact, prev_escaped, where = out.get(
+                metric, (True, True, f"{lintlib.rel_path(f)}:"
+                                     f"{node.lineno}"))
+            # every registration site of a family must carry the mark
+            # for the family to be exempt (one unmarked site = drift)
+            out[metric] = (prev_exact and exact,
+                           prev_escaped and escaped, where)
     return out
+
+
+def _doc_inventory_names(doc_path=None):
+    """Backticked ``pt_*`` names from the metric column of the
+    docs/OBSERVABILITY.md inventory table (rows may list several names
+    joined with ' / ')."""
+    import re
+
+    doc = Path(doc_path) if doc_path else REPO / "docs" / "OBSERVABILITY.md"
+    names = set()
+    if not doc.exists():
+        return names
+    for line in doc.read_text().splitlines():
+        if not line.startswith("| `pt_"):
+            continue
+        metric_cell = line.split("|")[1]
+        names.update(re.findall(r"`(pt_[a-z0-9_]+)`", metric_cell))
+    return names
+
+
+def inventory_drift(targets=None, doc_path=None):
+    """Both directions of code↔docs metric-inventory drift, as lint
+    findings [(path, lineno, check, message)]:
+
+      undocumented-metric   a family registered in code with no
+                            docs/OBSERVABILITY.md inventory row (escape
+                            a deliberate one with
+                            `# observability: undocumented-ok` on EVERY
+                            registration site)
+      ghost-metric-row      a documented row naming a family no code
+                            registers (no escape — fix the doc)
+    """
+    sites = _registration_sites(targets)
+    doc = _doc_inventory_names(doc_path)
+    findings = []
+    prefixes = {n for n, (exact, _e, _w) in sites.items() if not exact}
+    for metric, (exact, escaped, where) in sorted(sites.items()):
+        if escaped:
+            continue
+        documented = (metric in doc if exact
+                      else any(d.startswith(metric) for d in doc))
+        if not documented:
+            path, _, lineno = where.rpartition(":")
+            findings.append((
+                path, int(lineno), "undocumented-metric",
+                f"metric family {metric!r} is registered here but has "
+                f"no docs/OBSERVABILITY.md inventory row — add one "
+                f"(| `name` | type | labels | reported by |) or mark "
+                f"every registration site `# {UNDOC_MARK}`"))
+    exact_names = {n for n, (e, _esc, _w) in sites.items() if e}
+    doc_rel = "docs/OBSERVABILITY.md"
+    for d in sorted(doc):
+        if d in exact_names or any(d.startswith(p) for p in prefixes):
+            continue
+        findings.append((
+            doc_rel, 0, "ghost-metric-row",
+            f"docs/OBSERVABILITY.md documents metric family {d!r} but "
+            f"no code registers it — remove the row or restore the "
+            f"registration"))
+    return findings
 
 
 def _exempt(rel_str: str) -> bool:
@@ -191,6 +288,10 @@ def main(argv=None):
     for f in iter_files(targets):
         n_files += 1
         findings.extend(check_file(f))
+    # inventory drift only on the default full-tree run: a partial
+    # target list would report every family outside it as undocumented
+    if targets == DEFAULT_TARGETS:
+        findings.extend(inventory_drift(targets))
     findings = lintlib.apply_baseline(findings, baseline)
     return lintlib.summarize("lint_observability", findings, n_files)
 

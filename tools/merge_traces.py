@@ -13,7 +13,14 @@ epoch.  This tool reads N such files and writes ONE trace:
   - pid collisions (pid reuse across hosts/restarts) are remapped so
     every input file keeps a distinct process lane;
   - metadata records are preserved, so chrome://tracing / Perfetto shows
-    one named lane per role/rank.
+    one named lane per role/rank;
+  - serving request spans (observability.reqtrace lands them with
+    ``args.trace``/``args.span``/``args.parent``/``args.links`` ids —
+    kinds request/attempt/serve/batch) are additionally indexed into a
+    top-level ``ptRequestTraces`` object: trace id -> that request's
+    spans across EVERY merged pid, so a hedged request's winning and
+    cancelled attempts line up across the replicas that ran them
+    (docs/OBSERVABILITY.md §8).
 
 Usage:
     python tools/merge_traces.py -o merged.json trace_a.json trace_b.json
@@ -82,7 +89,33 @@ def merge(paths):
         merged.extend(events)
         metas.append(meta)
     return {"traceEvents": merged, "displayTimeUnit": "ms",
-            "ptMergedFrom": metas}
+            "ptMergedFrom": metas,
+            "ptRequestTraces": request_trace_index(merged)}
+
+
+def request_trace_index(events):
+    """{trace_id: [span records]} over the merged events — every
+    complete ``X`` span tagged with reqtrace ids (``args.trace`` +
+    ``args.span``).  Spans keep merged (re-based, remapped) ts/pid, so
+    a trace's records are directly comparable across process lanes;
+    each trace's spans are ordered by start time."""
+    index = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        tid, sid = args.get("trace"), args.get("span")
+        if not tid or not sid:
+            continue
+        rec = {"span": sid, "kind": args.get("kind"),
+               "parent": args.get("parent"),
+               "links": args.get("links") or [],
+               "pid": e.get("pid"), "name": e.get("name"),
+               "ts": e.get("ts"), "dur": e.get("dur")}
+        index.setdefault(str(tid), []).append(rec)
+    for spans in index.values():
+        spans.sort(key=lambda r: (r["ts"] is None, r["ts"]))
+    return index
 
 
 def main(argv=None):
@@ -106,7 +139,8 @@ def main(argv=None):
     n_spans = sum(1 for e in out["traceEvents"] if e.get("ph") == "X")
     pids = {e.get("pid") for e in out["traceEvents"]}
     print(f"{args.output}: {len(paths)} trace(s), {n_spans} spans, "
-          f"{len(pids)} process lane(s)")
+          f"{len(pids)} process lane(s), "
+          f"{len(out['ptRequestTraces'])} request trace(s)")
     return 0
 
 

@@ -4,7 +4,7 @@ REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
 
 .PHONY: test test-book test-onchip bench bench-onchip int8-bench \
 	serve-bench decode-bench ragged-bench health-bench phase-bench \
-	pass-bench pipeline-bench recovery-drill recovery-bench \
+	pass-bench pipeline-bench autotune recovery-drill recovery-bench \
 	serve-drill \
 	perf-compare lint-api lint-resilience lint-observability \
 	lint-collectives lint-passes lint-kernels analyze
@@ -48,6 +48,9 @@ pass-bench:      ## graph-passes on/off A/B + per-pass cost attribution
 
 pipeline-bench:  ## pipeline-as-policy A/B: PipelineRunner vs PipelinePolicy, gpipe vs 1f1b, microbatch sweep
 	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_PIPELINE=1 $(PY) bench.py
+
+autotune:        ## mesh autotuner sweep: enumerate→rank→measure, report + pinned-winner re-run
+	PYTHONPATH=$(REPO):/root/.axon_site PT_BENCH_AUTOTUNE=1 $(PY) bench.py
 
 recovery-drill:  ## fast in-process preempt→restore drill (window restore + parity)
 	JAX_PLATFORMS=cpu $(PY) -m paddle_tpu.distributed.recovery

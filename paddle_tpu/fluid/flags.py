@@ -162,6 +162,19 @@ _DEFS = {
     # while the transpiler lane remains the benched baseline; flip per
     # run or per runner via gspmd=True.
     "FLAGS_gspmd_executor": (False, _parse_bool, True),
+    # mesh-autotuner pin (parallel/autotune.py, docs/AUTOTUNE.md): path
+    # to a committed autotune_report.json whose measured winner both
+    # runners pin when no explicit policy_pin= is passed — the
+    # "derive the (pp, batch, model) policy from measurement, then pin
+    # it everywhere" loop.  Empty = no pin (hand-picked policies keep
+    # working unchanged).
+    "FLAGS_autotune_report": ("", str, True),
+    # measured-shortlist size of the autotune sweep: the analytic cost
+    # model ranks every legal candidate, the top-K get real compiles
+    # through GSPMDExecutor
+    "FLAGS_autotune_topk": (3, int, True),
+    # timed steps per measured candidate (after the warm/compile step)
+    "FLAGS_autotune_steps": (6, int, True),
     # pipeline-as-policy schedule (parallel/gspmd/pipeline_policy.py,
     # docs/DISTRIBUTED.md "Pipeline as a policy"): "1f1b" = one-forward-
     # one-backward interleaving — same bubble fraction as gpipe but the

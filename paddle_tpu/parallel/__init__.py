@@ -18,3 +18,8 @@ from . import local_sgd  # noqa: F401
 from .local_sgd import LocalSGDRunner  # noqa: F401
 from . import pipeline  # noqa: F401
 from .pipeline import PipelineRunner  # noqa: F401
+from . import autotune  # noqa: F401
+from .autotune import (  # noqa: F401
+    Candidate, autotune as autotune_mesh, enumerate_candidates,
+    load_report, policy_summary, resolve_pin, save_report,
+)

@@ -526,12 +526,33 @@ class DataParallelRunner:
 
     def __init__(self, program, loss_name, build_strategy=None, places=None,
                  quant_grads=None, quant_algo=None, overlap=None,
-                 fused_update=None, gspmd=None):
+                 fused_update=None, gspmd=None, policy_pin=None):
         import jax
 
         n = len(places) if places else jax.device_count()
         self.num_devices = n
         self.mesh = pmesh.build_mesh({pmesh.DATA_AXIS: n})
+        # autotune pin (docs/AUTOTUNE.md "Pinning"): an explicit pin — a
+        # Candidate, a saved report (dict or path) — or the standing
+        # FLAGS_autotune_report path overrides the lane/mesh/policy
+        # selection below with the tuner's measured winner.
+        if policy_pin is None:
+            from paddle_tpu.fluid import flags as _flags
+
+            policy_pin = _flags.flag("autotune_report") or None
+        self.policy_pin = None
+        if policy_pin is not None:
+            from . import autotune as _autotune
+
+            pin = _autotune.resolve_pin(policy_pin)
+            if pin.n_devices != n:
+                raise ValueError(
+                    f"autotune pin {pin.label()} was tuned for "
+                    f"{pin.n_devices} devices but this runner has {n}")
+            self.policy_pin = pin
+            gspmd = True          # a pin is always a GSPMD assignment
+            quant_grads = pin.quant
+            self.mesh = pin.build_mesh()
         # quantized-collective knob: explicit arg > BuildStrategy attr >
         # FLAGS_quant_allreduce (each layer may leave it None = defer)
         if quant_grads is None:
@@ -577,8 +598,11 @@ class DataParallelRunner:
             from .gspmd import GSPMDExecutor, policy_for
 
             self.program = program
+            policy = (self.policy_pin.build_policy()
+                      if self.policy_pin is not None
+                      else policy_for(self.mesh))
             self._gspmd_exec = GSPMDExecutor(
-                program, self.mesh, policy_for(self.mesh),
+                program, self.mesh, policy,
                 quant_hook=self.quant_grads, quant_algo=quant_algo,
                 loss_name=loss_name)
             self._sentinel = None  # the shared executor owns it there

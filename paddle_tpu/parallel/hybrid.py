@@ -144,7 +144,8 @@ class HybridParallelRunner:
 
     def __init__(self, program, mesh, rules: ShardingRule | None = None,
                  feed_specs=None, scope=None, zero_stage=0,
-                 zero_gather_quant=None, fused_update=None, gspmd=None):
+                 zero_gather_quant=None, fused_update=None, gspmd=None,
+                 policy_pin=None):
         """zero_stage=1: shard optimizer-state vars (moment accumulators,
         tagged is_optimizer_state) over the 'dp' axis on dim 0 — the
         cross-replica weight-update sharding of arXiv:2004.13336 (ZeRO-1).
@@ -188,6 +189,30 @@ class HybridParallelRunner:
         self.program = program
         self.mesh = mesh
         self.rules = rules or ShardingRule([])
+        # autotune pin (docs/AUTOTUNE.md "Pinning"): explicit pin or the
+        # standing FLAGS_autotune_report path.  Unlike the DP runner the
+        # mesh here is caller-supplied, so the pin must AGREE with it —
+        # a silent re-mesh would invalidate the caller's feed_specs.
+        if policy_pin is None:
+            from paddle_tpu.fluid import flags as _flags
+
+            policy_pin = _flags.flag("autotune_report") or None
+        self.policy_pin = None
+        if policy_pin is not None:
+            from . import autotune as _autotune
+
+            pin = _autotune.resolve_pin(policy_pin)
+            shape = dict(getattr(mesh, "shape", {}) or {})
+            got = {ax: int(shape.get(ax, 1))
+                   for ax in (pmesh.PIPE_AXIS, pmesh.DATA_AXIS,
+                              pmesh.MODEL_AXIS)}
+            if got != pin.mesh_dims:
+                raise ValueError(
+                    f"autotune pin {pin.label()} names mesh dims "
+                    f"{pin.mesh_dims} but this runner's mesh is {got}")
+            self.policy_pin = pin
+            gspmd = True          # a pin is always a GSPMD assignment
+            zero_stage = pin.zero_stage
         self.feed_specs = dict(feed_specs or {})
         self._default_scope = scope
         self._cache = {}
@@ -224,11 +249,16 @@ class HybridParallelRunner:
             # hook owns the wire format on this lane)
             from .gspmd import GSPMDExecutor, policy_for
 
-            policy = policy_for(mesh, rules=rules,
-                                zero_stage=self.zero_stage)
+            if self.policy_pin is not None:
+                policy = self.policy_pin.build_policy(rules=self.rules)
+                quant_hook = self.policy_pin.quant
+            else:
+                policy = policy_for(mesh, rules=rules,
+                                    zero_stage=self.zero_stage)
+                quant_hook = None
             self._gspmd_exec = GSPMDExecutor(
                 program, mesh, policy, scope=scope,
-                feed_specs=self.feed_specs)
+                feed_specs=self.feed_specs, quant_hook=quant_hook)
             self._sentinel = None  # the shared executor owns it there
             self._fused_gather = {}
             # capture_hlo/last_hlo stay live on this lane through the

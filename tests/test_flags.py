@@ -750,3 +750,39 @@ def test_kernel_primitive_flags_roundtrip(monkeypatch):
     monkeypatch.delenv("FLAGS_int8_kv_cache")
     importlib.reload(fl)  # restore defaults for other tests
     assert fl.get_flags("kernel_autotune")["kernel_autotune"] is False
+
+
+def test_autotune_flags_roundtrip(monkeypatch):
+    """The mesh-autotuner flags (ISSUE 20): no standing report pin by
+    default (empty path), top-3 shortlist, 6 measured steps — all
+    round-trip through env bootstrap and get/set like every other
+    flag."""
+    import importlib
+
+    from paddle_tpu.fluid import flags as fl
+
+    assert fl.get_flags("autotune_report")["autotune_report"] == ""
+    assert fl.get_flags("autotune_topk")["autotune_topk"] == 3
+    assert fl.get_flags("autotune_steps")["autotune_steps"] == 6
+    try:
+        fl.set_flags({"FLAGS_autotune_report": "/tmp/at.json",
+                      "autotune_topk": "5",  # str parses
+                      "FLAGS_autotune_steps": 12})
+        assert fl.get_flags(["autotune_report", "autotune_topk",
+                             "autotune_steps"]) == {
+            "autotune_report": "/tmp/at.json", "autotune_topk": 5,
+            "autotune_steps": 12}
+    finally:
+        fl.set_flags({"FLAGS_autotune_report": "",
+                      "FLAGS_autotune_topk": 3,
+                      "FLAGS_autotune_steps": 6})
+    monkeypatch.setenv("FLAGS_autotune_report", "/tmp/at2.json")
+    monkeypatch.setenv("FLAGS_autotune_topk", "4")
+    importlib.reload(fl)
+    assert fl.get_flags("autotune_report")["autotune_report"] == \
+        "/tmp/at2.json"
+    assert fl.get_flags("autotune_topk")["autotune_topk"] == 4
+    monkeypatch.delenv("FLAGS_autotune_report")
+    monkeypatch.delenv("FLAGS_autotune_topk")
+    importlib.reload(fl)  # restore defaults for other tests
+    assert fl.get_flags("autotune_report")["autotune_report"] == ""
